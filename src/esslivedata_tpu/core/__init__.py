@@ -1,0 +1,38 @@
+"""Core runtime: domain types, batchers, service loop, jobs, control plane.
+
+Mirrors the responsibilities of the reference's ``src/ess/livedata/core/``
+(SURVEY.md section 2.1) with the same protocol seams — MessageSource /
+MessageSink / Processor / Accumulator / Workflow — so every layer above and
+below can be faked in tests exactly like the reference does.
+"""
+
+from .message import (
+    COMMANDS_STREAM_ID,
+    RESPONSES_STREAM_ID,
+    RUN_CONTROL_STREAM_ID,
+    STATUS_STREAM_ID,
+    Message,
+    MessageSink,
+    MessageSource,
+    RunStart,
+    RunStop,
+    StreamId,
+    StreamKind,
+)
+from .timestamp import Duration, Timestamp
+
+__all__ = [
+    "COMMANDS_STREAM_ID",
+    "Duration",
+    "Message",
+    "MessageSink",
+    "MessageSource",
+    "RESPONSES_STREAM_ID",
+    "RUN_CONTROL_STREAM_ID",
+    "RunStart",
+    "RunStop",
+    "STATUS_STREAM_ID",
+    "StreamId",
+    "StreamKind",
+    "Timestamp",
+]

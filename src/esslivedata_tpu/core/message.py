@@ -1,0 +1,103 @@
+"""Domain message model: stream identities, messages, source/sink protocols.
+
+Parity with reference ``core/message.py`` (StreamKind:17, StreamId:35,
+Message:70, RunStart:47/RunStop:59, MessageSource:95/MessageSink:100).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+from dataclasses import dataclass, field
+from enum import StrEnum
+from typing import Generic, Protocol, TypeVar, runtime_checkable
+
+from .timestamp import Timestamp
+
+T = TypeVar("T")
+Tin = TypeVar("Tin")
+Tout = TypeVar("Tout")
+
+__all__ = [
+    "Message",
+    "MessageSink",
+    "MessageSource",
+    "RunStart",
+    "RunStop",
+    "StreamId",
+    "StreamKind",
+]
+
+
+class StreamKind(StrEnum):
+    """Kinds of streams flowing through a service (13 kinds, matching the
+    reference so stream routing tables translate one-to-one)."""
+
+    UNKNOWN = "unknown"
+    MONITOR_COUNTS = "monitor_counts"
+    MONITOR_EVENTS = "monitor_events"
+    DETECTOR_EVENTS = "detector_events"
+    AREA_DETECTOR = "area_detector"
+    LOG = "log"
+    DEVICE = "device"
+    LIVEDATA_COMMANDS = "livedata_commands"
+    LIVEDATA_RESPONSES = "livedata_responses"
+    LIVEDATA_DATA = "livedata_data"
+    LIVEDATA_NICOS_DATA = "livedata_nicos_data"
+    LIVEDATA_ROI = "livedata_roi"
+    LIVEDATA_STATUS = "livedata_status"
+    RUN_CONTROL = "run_control"
+
+
+@dataclass(frozen=True, slots=True, kw_only=True)
+class StreamId:
+    kind: StreamKind = StreamKind.UNKNOWN
+    name: str
+
+
+COMMANDS_STREAM_ID = StreamId(kind=StreamKind.LIVEDATA_COMMANDS, name="")
+RESPONSES_STREAM_ID = StreamId(kind=StreamKind.LIVEDATA_RESPONSES, name="")
+STATUS_STREAM_ID = StreamId(kind=StreamKind.LIVEDATA_STATUS, name="")
+RUN_CONTROL_STREAM_ID = StreamId(kind=StreamKind.RUN_CONTROL, name="")
+
+
+@dataclass(frozen=True, slots=True)
+class RunStart:
+    """Run start event from the facility control system (pl72 wire schema)."""
+
+    run_name: str
+    start_time: Timestamp
+    stop_time: Timestamp | None = None
+
+
+@dataclass(frozen=True, slots=True)
+class RunStop:
+    """Run stop event from the facility control system (6s4t wire schema)."""
+
+    run_name: str
+    stop_time: Timestamp
+
+
+@dataclass(frozen=True, slots=True, kw_only=True)
+class Message(Generic[T]):
+    """A timestamped value on a stream. For data-plane messages ``timestamp``
+    is data time (when the data was produced at the source) and must be set
+    explicitly from the wire payload; the wall-clock default exists for
+    control-plane messages (commands, acks, statuses) created in-process,
+    matching the reference (core/message.py:70)."""
+
+    timestamp: Timestamp = field(default_factory=Timestamp.now)
+    stream: StreamId
+    value: T
+
+    def __lt__(self, other: "Message[T]") -> bool:
+        return self.timestamp < other.timestamp
+
+
+@runtime_checkable
+class MessageSource(Protocol, Generic[Tin]):
+    def get_messages(self) -> Sequence[Tin]: ...
+
+
+@runtime_checkable
+class MessageSink(Protocol, Generic[Tout]):
+    def publish_messages(self, messages: Sequence[Message[Tout]]) -> None: ...

@@ -1,0 +1,16 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual XLA devices so that every multi-chip
+sharding path (mesh/shard_map/psum) is exercised without TPU hardware —
+the same topology the driver's ``dryrun_multichip`` validates.
+This must happen before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,169 @@
+import math
+
+from esslivedata_tpu.core import Duration, Message, StreamId, StreamKind, Timestamp
+from esslivedata_tpu.core.constants import PULSE_PERIOD_NS_DEN, PULSE_PERIOD_NS_NUM
+from esslivedata_tpu.core.message_batcher import (
+    AdaptiveMessageBatcher,
+    NaiveMessageBatcher,
+    SimpleMessageBatcher,
+)
+
+STREAM = StreamId(kind=StreamKind.DETECTOR_EVENTS, name="bank0")
+
+
+def msg(pulse: int, offset_ns: int = 0) -> Message:
+    ts = Timestamp.from_pulse_index(pulse) + Duration.from_ns(offset_ns)
+    return Message(timestamp=ts, stream=STREAM, value=pulse)
+
+
+def pulses(window_s: float) -> int:
+    return round(window_s * PULSE_PERIOD_NS_DEN * 1e9 / PULSE_PERIOD_NS_NUM)
+
+
+class TestNaive:
+    def test_empty_returns_none(self):
+        assert NaiveMessageBatcher().batch([]) is None
+
+    def test_batch_bounds_quantized(self):
+        b = NaiveMessageBatcher().batch([msg(3, 5), msg(5, 2)])
+        assert b is not None
+        assert b.start == Timestamp.from_pulse_index(3)
+        assert b.end == Timestamp.from_pulse_index(6)
+        assert len(b) == 2
+
+    def test_on_grid_message_contained(self):
+        b = NaiveMessageBatcher().batch([msg(4)])
+        assert b.start <= msg(4).timestamp < b.end
+
+
+class TestSimple:
+    def test_no_emission_until_window_passed(self):
+        batcher = SimpleMessageBatcher(Duration.from_s(1.0))
+        assert batcher.batch([msg(0), msg(5)]) is None
+        assert batcher.batch([]) is None
+
+    def test_window_closed_by_next_window_message(self):
+        batcher = SimpleMessageBatcher(Duration.from_s(1.0))
+        w = 14  # 1 s = 14 pulses
+        assert batcher.batch([msg(0), msg(5)]) is None
+        batch = batcher.batch([msg(w)])  # first message of next window
+        assert batch is not None
+        assert [m.value for m in batch.messages] == [0, 5]
+        assert batch.start == Timestamp.from_pulse_index(0)
+        assert batch.end == Timestamp.from_pulse_index(w)
+
+    def test_trigger_message_stays_buffered(self):
+        batcher = SimpleMessageBatcher(Duration.from_s(1.0))
+        w = 14
+        batcher.batch([msg(0)])
+        batcher.batch([msg(w)])
+        batch = batcher.batch([msg(2 * w)])
+        assert [m.value for m in batch.messages] == [w]
+
+    def test_late_message_folded_into_next_batch(self):
+        batcher = SimpleMessageBatcher(Duration.from_s(1.0))
+        w = 14
+        batcher.batch([msg(0)])
+        first = batcher.batch([msg(w)])
+        assert [m.value for m in first.messages] == [0]
+        # late message from the already-closed first window
+        batcher.batch([msg(3)])
+        second = batcher.batch([msg(2 * w)])
+        assert sorted(m.value for m in second.messages) == [3, w]
+
+    def test_windows_stay_aligned_after_gap(self):
+        batcher = SimpleMessageBatcher(Duration.from_s(1.0))
+        w = 14
+        batcher.batch([msg(0)])
+        batcher.batch([msg(10 * w + 3)])  # long gap; closes window 0
+        batch = batcher.batch([msg(11 * w)])
+        assert batch.start == Timestamp.from_pulse_index(10 * w)
+        assert batch.end == Timestamp.from_pulse_index(11 * w)
+        assert [m.value for m in batch.messages] == [10 * w + 3]
+
+
+class TestAdaptive:
+    def make(self, **kw):
+        self.now = 0.0
+        kw.setdefault("clock", lambda: self.now)
+        return AdaptiveMessageBatcher(Duration.from_s(1.0), **kw)
+
+    def drive_windows(self, batcher, start_pulse, n, step=14):
+        """Feed one message per window to force closes; return batches."""
+        out = []
+        p = start_pulse
+        for _ in range(n):
+            p += step
+            b = batcher.batch([msg(p)])
+            if b:
+                out.append(b)
+        return out
+
+    def test_escalates_after_two_overloaded(self):
+        batcher = self.make()
+        assert batcher.scale == 1.0
+        batcher.report_processing_time(Duration.from_s(0.9))
+        assert batcher.scale == 1.0
+        batcher.report_processing_time(Duration.from_s(0.9))
+        assert batcher.scale == 2.0
+
+    def test_deescalates_after_three_underloaded(self):
+        batcher = self.make()
+        for _ in range(2):
+            batcher.report_processing_time(Duration.from_s(0.9))
+        assert batcher.scale == 2.0
+        # Window doubling happens on the *next* opened window; emulate that
+        # the wider window is now in effect before measuring load again.
+        batcher.batch([msg(0)])
+        self.drive_windows(batcher, 0, 3, step=28)
+        for _ in range(3):
+            batcher.report_processing_time(Duration.from_s(0.1))
+        assert batcher.scale < 2.0
+
+    def test_dead_zone_no_oscillation(self):
+        batcher = self.make()
+        for _ in range(2):
+            batcher.report_processing_time(Duration.from_s(0.9))
+        assert batcher.scale == 2.0
+        batcher.batch([msg(0)])
+        self.drive_windows(batcher, 0, 2, step=28)
+        # After doubling, the same data rate gives half the load: inside the
+        # dead zone, so the scale must hold.
+        for _ in range(6):
+            batcher.report_processing_time(Duration.from_s(0.9))
+        assert batcher.scale == 2.0
+
+    def test_max_scale_cap(self):
+        batcher = self.make(max_scale=4.0)
+        for _ in range(20):
+            batcher.report_processing_time(Duration.from_s(100.0))
+        assert batcher.scale <= 4.0
+
+    def test_idle_deescalation_wall_clock(self):
+        batcher = self.make(idle_timeout_s=5.0)
+        for _ in range(4):
+            batcher.report_processing_time(Duration.from_s(5.0))
+        assert batcher.scale > 1.0
+        before = batcher.scale
+        self.now = 100.0
+        batcher.batch([])  # idle poll past the timeout
+        assert batcher.scale < before
+
+    def test_floor_at_base(self):
+        batcher = self.make()
+        for _ in range(30):
+            batcher.report_processing_time(Duration.from_ns(1))
+        assert batcher.scale == 1.0
+
+    def test_emitted_window_tracks_escalation(self):
+        batcher = self.make()
+        batcher.batch([msg(0)])
+        b1 = batcher.batch([msg(14)])
+        assert math.isclose(b1.window.seconds, 1.0, rel_tol=0.01)
+        for _ in range(2):
+            batcher.report_processing_time(Duration.from_s(2.0))
+        b2 = batcher.batch([msg(3 * 14)])
+        assert b2 is not None
+        b3 = batcher.batch([msg(6 * 14)])
+        assert b3 is not None
+        assert math.isclose(b3.window.seconds, 2.0, rel_tol=0.01)

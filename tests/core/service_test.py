@@ -1,0 +1,85 @@
+import time
+
+from esslivedata_tpu.core import Message, StreamId, StreamKind, Timestamp
+from esslivedata_tpu.core.fakes import FakeMessageSink, FakeMessageSource
+from esslivedata_tpu.core.processor import IdentityProcessor
+from esslivedata_tpu.core.service import Service
+
+STREAM = StreamId(kind=StreamKind.LOG, name="temp")
+
+
+def make_messages(n):
+    return [
+        Message(timestamp=Timestamp.from_ns(i), stream=STREAM, value=i)
+        for i in range(n)
+    ]
+
+
+def test_step_single_steps_deterministically():
+    source = FakeMessageSource([make_messages(3), make_messages(2)])
+    sink = FakeMessageSink()
+    service = Service(processor=IdentityProcessor(source, sink), name="t")
+    service.step()
+    assert len(sink.messages) == 3
+    service.step()
+    assert len(sink.messages) == 5
+    service.step()  # exhausted source: no-op
+    assert len(sink.messages) == 5
+
+
+def test_threaded_start_stop():
+    source = FakeMessageSource([make_messages(1) for _ in range(10)])
+    sink = FakeMessageSink()
+    service = Service(
+        processor=IdentityProcessor(source, sink), name="t", poll_interval_s=0.001
+    )
+    service.start(blocking=False)
+    deadline = time.monotonic() + 2.0
+    while not source.exhausted and time.monotonic() < deadline:
+        time.sleep(0.01)
+    service.stop()
+    assert len(sink.messages) == 10
+    assert service.exit_code == 0
+
+
+def test_worker_error_sets_exit_code():
+    class Exploding:
+        def process(self):
+            raise RuntimeError("boom")
+
+        def finalize(self):
+            pass
+
+    service = Service(processor=Exploding(), name="t", poll_interval_s=0.001)
+    # Install a no-op SIGINT handler on the main thread so raise_signal from
+    # the worker does not kill pytest.
+    import signal
+
+    old = signal.signal(signal.SIGINT, lambda *a: None)
+    try:
+        service.start(blocking=False)
+        deadline = time.monotonic() + 2.0
+        while service.is_running and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert service.exit_code == 1
+        assert not service.is_running
+    finally:
+        service.stop()
+        signal.signal(signal.SIGINT, old)
+
+
+def test_finalize_called_on_stop():
+    calls = []
+
+    class P:
+        def process(self):
+            pass
+
+        def finalize(self):
+            calls.append(1)
+
+    service = Service(processor=P(), name="t", poll_interval_s=0.001)
+    service.start(blocking=False)
+    time.sleep(0.05)
+    service.stop()
+    assert calls == [1]

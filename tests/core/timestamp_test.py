@@ -1,0 +1,53 @@
+import pytest
+
+from esslivedata_tpu.core import Duration, Timestamp
+
+
+def test_duration_constructors():
+    assert Duration.from_s(1.5).ns == 1_500_000_000
+    assert Duration.from_ms(20).ns == 20_000_000
+    assert Duration.from_value(3, "us").ns == 3_000
+
+
+def test_timestamp_arithmetic():
+    t = Timestamp.from_ns(1_000)
+    d = Duration.from_ns(500)
+    assert (t + d).ns == 1_500
+    assert (t - d).ns == 500
+    assert ((t + d) - t) == d
+
+
+def test_timestamp_ordering():
+    assert Timestamp.from_ns(1) < Timestamp.from_ns(2)
+
+
+def test_timestamp_duration_type_safety():
+    t = Timestamp.from_ns(100)
+    with pytest.raises(TypeError):
+        t + t  # type: ignore[operator]
+    with pytest.raises(TypeError):
+        t + 5  # type: ignore[operator]
+
+
+def test_pulse_grid_roundtrip():
+    # Pulse period is 10^9/14 ns, not an integer: grid math must be exact.
+    for idx in (0, 1, 7, 14, 1_000_000, 10**12):
+        t = Timestamp.from_pulse_index(idx)
+        assert t.pulse_index() == idx
+        assert t.quantize() == t
+        assert t.quantize_up() == t
+
+
+def test_quantize_down_up():
+    t0 = Timestamp.from_pulse_index(42)
+    t = t0 + Duration.from_ns(1)
+    assert t.quantize() == t0
+    assert t.quantize_up() == Timestamp.from_pulse_index(43)
+
+
+def test_quantize_never_in_future():
+    t = Timestamp.from_ns(1_721_000_000_123_456_789)
+    q = t.quantize()
+    assert q <= t
+    assert t.quantize_up() >= t
+    assert (t.quantize_up().ns - q.ns) <= 10**9 // 14 + 1

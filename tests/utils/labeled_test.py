@@ -1,0 +1,135 @@
+import numpy as np
+import pytest
+
+from esslivedata_tpu.utils import DataArray, Variable, linspace, midpoints, scalar
+from esslivedata_tpu.utils.labeled import concat
+from esslivedata_tpu.utils.units import UnitError
+
+
+def make_hist():
+    data = Variable(np.arange(12.0).reshape(3, 4), ("y", "x"), "counts")
+    edges_x = linspace("x", 0.0, 4.0, 5, "mm")
+    edges_y = linspace("y", 0.0, 3.0, 4, "mm")
+    return DataArray(data, coords={"x": edges_x, "y": edges_y}, name="hist")
+
+
+def test_variable_basic():
+    v = Variable(np.zeros((2, 3)), ("a", "b"), "counts")
+    assert v.sizes == {"a": 2, "b": 3}
+    assert repr(v.unit) == "counts"
+
+
+def test_variable_dims_mismatch():
+    with pytest.raises(ValueError):
+        Variable(np.zeros((2, 3)), ("a",))
+
+
+def test_to_unit():
+    v = Variable(np.array([1000.0]), ("t",), "us")
+    w = v.to_unit("ms")
+    assert w.numpy[0] == pytest.approx(1.0)
+    assert repr(w.unit) == "ms"
+
+
+def test_add_unit_conversion():
+    a = Variable(np.array([1.0]), ("t",), "s")
+    b = Variable(np.array([500.0]), ("t",), "ms")
+    c = a + b
+    assert c.numpy[0] == pytest.approx(1.5)
+
+
+def test_add_incompatible_units():
+    a = Variable(np.array([1.0]), ("t",), "s")
+    b = Variable(np.array([1.0]), ("t",), "m")
+    with pytest.raises(UnitError):
+        a + b
+
+
+def test_broadcasting_by_dim_name():
+    spectra = Variable(np.ones((4, 8)), ("pixel", "toa"), "counts")
+    weights = Variable(np.arange(4.0), ("pixel",), "")
+    out = spectra * weights
+    assert out.dims == ("pixel", "toa")
+    assert out.numpy[2, 0] == pytest.approx(2.0)
+
+
+def test_broadcasting_transposed():
+    a = Variable(np.ones((2, 3)), ("x", "y"), "")
+    b = Variable(np.arange(6.0).reshape(3, 2), ("y", "x"), "")
+    out = a + b
+    assert out.dims == ("x", "y")
+    assert out.numpy[1, 2] == pytest.approx(1.0 + b.numpy[2, 1])
+
+
+def test_dataarray_slicing_edges():
+    da = make_hist()
+    s = da["x", 1:3]
+    assert s.shape == (3, 2)
+    assert s.coords["x"].shape == (3,)  # edges: n+1
+    assert s.coords["y"].shape == (4,)
+    np.testing.assert_allclose(s.coords["x"].numpy, [1.0, 2.0, 3.0])
+
+
+def test_dataarray_integer_slicing():
+    da = make_hist()
+    row = da["y", 1]
+    assert row.dims == ("x",)
+    np.testing.assert_allclose(row.data.numpy, [4, 5, 6, 7])
+
+
+def test_dataarray_division_units():
+    det = make_hist()
+    mon = scalar(2.0, "counts")
+    ratio = DataArray(det.data / mon, det.coords)
+    assert ratio.unit.is_dimensionless
+    assert ratio.values[0, 1] == pytest.approx(0.5)
+
+
+def test_same_structure():
+    a = make_hist()
+    b = make_hist()
+    assert a.same_structure(b)
+    c = b["x", 0:2]
+    assert not a.same_structure(c)
+
+
+def test_iadd():
+    a = make_hist()
+    b = make_hist()
+    a += b
+    assert a.values[2, 3] == pytest.approx(22.0)
+
+
+def test_concat_edges():
+    a = make_hist()
+    b = make_hist()
+    shift = 3.0
+    b.coords["y"] = Variable(b.coords["y"].numpy + shift, ("y",), "mm")
+    out = concat([a, b], "y")
+    assert out.shape == (6, 4)
+    assert out.coords["y"].shape == (7,)
+    np.testing.assert_allclose(out.coords["y"].numpy, [0, 1, 2, 3, 4, 5, 6])
+
+
+def test_midpoints():
+    e = linspace("x", 0.0, 4.0, 5, "mm")
+    m = midpoints(e)
+    np.testing.assert_allclose(m.numpy, [0.5, 1.5, 2.5, 3.5])
+
+
+def test_sum():
+    da = make_hist()
+    s = da.sum("x")
+    assert s.dims == ("y",)
+    assert "x" not in s.coords
+    np.testing.assert_allclose(s.data.numpy, [6, 22, 38])
+    total = da.sum()
+    assert total.data.value == pytest.approx(66.0)
+
+
+def test_jax_values_work():
+    import jax.numpy as jnp
+
+    v = Variable(jnp.ones((2, 3)), ("a", "b"), "counts")
+    w = v + v
+    assert float(np.asarray(w.values)[0, 0]) == 2.0

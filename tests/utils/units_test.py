@@ -1,0 +1,57 @@
+import math
+
+import pytest
+
+from esslivedata_tpu.utils import Unit, UnitError, unit
+
+
+def test_parse_atomic():
+    assert unit("ns").conversion_factor(unit("s")) == pytest.approx(1e-9)
+    assert unit("us").conversion_factor(unit("ms")) == pytest.approx(1e-3)
+    assert unit("angstrom").conversion_factor(unit("m")) == pytest.approx(1e-10)
+    assert unit("counts").is_dimensionless is False
+    assert unit("").is_dimensionless
+    assert unit(None).is_dimensionless
+
+
+def test_parse_compound():
+    assert unit("m/s") == unit("m") / unit("s")
+    assert unit("1/angstrom") == unit("angstrom") ** -1
+    assert unit("m/s**2") == unit("m") / unit("s") ** 2
+    assert unit("counts/s") == unit("counts") / unit("s")
+
+
+def test_algebra():
+    assert (unit("m") * unit("m")) == unit("m") ** 2
+    v = unit("mm") / unit("ms")
+    assert v.conversion_factor(unit("m/s")) == pytest.approx(1.0)
+
+
+def test_incompatible_conversion_raises():
+    with pytest.raises(UnitError):
+        unit("m").conversion_factor(unit("s"))
+    with pytest.raises(UnitError):
+        unit("counts").conversion_factor(unit(""))
+
+
+def test_unknown_unit_raises():
+    with pytest.raises(UnitError):
+        unit("florps")
+
+
+def test_energy():
+    assert unit("meV").conversion_factor(unit("eV")) == pytest.approx(1e-3)
+    assert unit("J").compatible(unit("meV"))
+
+
+def test_deg_rad():
+    assert unit("deg").conversion_factor(unit("rad")) == pytest.approx(math.pi / 180)
+
+
+def test_repr_roundtrip():
+    for name in ("ns", "counts", "m", "meV", "Hz"):
+        assert repr(unit(name)) == name
+
+
+def test_hashable():
+    assert len({unit("m"), unit("m"), unit("s")}) == 2
