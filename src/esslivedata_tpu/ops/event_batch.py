@@ -1,0 +1,137 @@
+"""Host-side staging of ragged event streams into fixed-shape device batches.
+
+XLA compiles one program per input shape, so ragged per-pulse event counts
+(reference handles them as scipp binned data, to_nxevent_data.py:131) become
+power-of-two *bucketed* batches here: a batch of N events is padded to the
+next bucket size, giving a handful of compiled kernels instead of one per N,
+and the padded tail is masked out inside the kernel via out-of-range indices
+(scatter mode='drop'). This mirrors the reference's zero-copy growable
+buffers (_ScippBackedBuffer, to_nxevent_data.py:76-114): the staging buffer
+doubles capacity and is reused across batches, so steady-state costs no
+allocation on the host side either.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["EventBatch", "StagingBuffer", "bucket_size"]
+
+MIN_BUCKET = 1 << 12  # 4096: below this, padding waste is irrelevant
+MAX_BUCKET = 1 << 26  # 64M events per device batch
+
+
+def bucket_size(n: int, min_bucket: int = MIN_BUCKET) -> int:
+    """Smallest power-of-two >= n (clamped to [min_bucket, MAX_BUCKET])."""
+    if n > MAX_BUCKET:
+        raise ValueError(f"Event batch of {n} exceeds MAX_BUCKET={MAX_BUCKET}")
+    b = min_bucket
+    while b < n:
+        b <<= 1
+    return b
+
+
+@dataclass(slots=True)
+class EventBatch:
+    """A padded, fixed-shape batch of detector/monitor events.
+
+    ``pixel_id`` and ``toa`` have length ``bucket_size(n_valid)``; entries at
+    index >= n_valid are padding with pixel_id == -1 (which every kernel
+    treats as out-of-range and drops).
+    """
+
+    pixel_id: np.ndarray  # int32 [B]
+    toa: np.ndarray  # float32 [B] time-of-arrival within pulse (ns)
+    n_valid: int
+
+    @property
+    def padded_size(self) -> int:
+        return int(self.pixel_id.shape[0])
+
+    @classmethod
+    def from_arrays(
+        cls,
+        pixel_id: np.ndarray,
+        toa: np.ndarray,
+        min_bucket: int = MIN_BUCKET,
+    ) -> EventBatch:
+        n = int(pixel_id.shape[0])
+        b = bucket_size(n, min_bucket)
+        pid = np.full(b, -1, dtype=np.int32)
+        t = np.zeros(b, dtype=np.float32)
+        pid[:n] = pixel_id
+        t[:n] = toa
+        return cls(pixel_id=pid, toa=t, n_valid=n)
+
+
+class StagingBuffer:
+    """Accumulates ev44 chunks on the host, emits one padded batch.
+
+    ``add`` appends; ``take`` pads to the bucket boundary and returns an
+    EventBatch backed by the internal arrays (zero-copy slice), then resets.
+    Capacity doubles on demand and is retained across cycles. The caller
+    must consume the batch before the next ``add`` cycle begins — same
+    release-buffers contract as the reference (to_nxevent_data.py:166-171),
+    enforced with an in-use guard.
+    """
+
+    def __init__(self, min_bucket: int = MIN_BUCKET) -> None:
+        self._min_bucket = min_bucket
+        self._capacity = min_bucket
+        self._pixel = np.full(self._capacity, -1, dtype=np.int32)
+        self._toa = np.zeros(self._capacity, dtype=np.float32)
+        self._n = 0
+        self._in_use = False
+
+    def __len__(self) -> int:
+        return self._n
+
+    def _grow(self, needed: int) -> None:
+        new_cap = self._capacity
+        while new_cap < needed:
+            new_cap <<= 1
+        pixel = np.full(new_cap, -1, dtype=np.int32)
+        toa = np.zeros(new_cap, dtype=np.float32)
+        pixel[: self._n] = self._pixel[: self._n]
+        toa[: self._n] = self._toa[: self._n]
+        self._pixel, self._toa = pixel, toa
+        self._capacity = new_cap
+
+    def add(self, pixel_id: np.ndarray, toa: np.ndarray) -> None:
+        if self._in_use:
+            raise RuntimeError(
+                "StagingBuffer.add called before release() of the last batch"
+            )
+        k = int(pixel_id.shape[0])
+        if k == 0:
+            return
+        if self._n + k > self._capacity:
+            self._grow(self._n + k)
+        self._pixel[self._n : self._n + k] = pixel_id
+        self._toa[self._n : self._n + k] = toa
+        self._n += k
+
+    def take(self) -> EventBatch:
+        """Pad to bucket boundary and hand out a zero-copy view batch."""
+        b = bucket_size(self._n, self._min_bucket)
+        if b > self._capacity:
+            self._grow(b)
+        # Clear the padded tail so stale events never leak into the kernel.
+        self._pixel[self._n : b] = -1
+        self._toa[self._n : b] = 0.0
+        batch = EventBatch(
+            pixel_id=self._pixel[:b], toa=self._toa[:b], n_valid=self._n
+        )
+        self._in_use = True
+        return batch
+
+    def release(self) -> None:
+        """Mark the last taken batch consumed; buffer may be reused."""
+        self._in_use = False
+        self._n = 0
+
+    def clear(self) -> None:
+        self._n = 0
+        self._in_use = False

@@ -1,0 +1,121 @@
+import numpy as np
+import pytest
+
+import jax
+
+from esslivedata_tpu.ops import EventBatch, EventHistogrammer
+from esslivedata_tpu.parallel import ShardedHistogrammer, make_mesh
+
+
+@pytest.fixture(scope="module")
+def devices():
+    d = jax.devices()
+    if len(d) < 8:
+        pytest.skip("needs 8 virtual devices (conftest sets CPU x8)")
+    return d
+
+
+def make_events(n, n_pixel, seed=0):
+    rng = np.random.default_rng(seed)
+    pid = rng.integers(0, n_pixel, n).astype(np.int32)
+    toa = rng.uniform(0, 71_000_000.0, n).astype(np.float32)
+    return pid, toa
+
+
+def test_make_mesh_shapes(devices):
+    m = make_mesh(8)
+    assert m.shape == {"data": 1, "bank": 8}
+    m2 = make_mesh(8, data=2)
+    assert m2.shape == {"data": 2, "bank": 4}
+    m3 = make_mesh(4, bank=2)
+    assert m3.shape == {"data": 2, "bank": 2}
+    with pytest.raises(ValueError):
+        make_mesh(8, data=3)
+
+
+def test_sharded_matches_single_device(devices):
+    edges = np.linspace(0.0, 71_000_000.0, 51)
+    n_screen = 64
+    pid, toa = make_events(8192, n_screen)
+
+    single = EventHistogrammer(toa_edges=edges, n_screen=n_screen)
+    s_state = single.init_state()
+    s_state = single.step(s_state, EventBatch.from_arrays(pid, toa))
+    expected = np.asarray(s_state.window)
+
+    for data, bank in ((1, 8), (2, 4), (4, 2)):
+        mesh = make_mesh(8, data=data, bank=bank)
+        sharded = ShardedHistogrammer(toa_edges=edges, n_screen=n_screen, mesh=mesh)
+        st = sharded.init_state()
+        batch = EventBatch.from_arrays(pid, toa)
+        st = sharded.step(st, batch.pixel_id, batch.toa)
+        got = np.asarray(st.window)
+        np.testing.assert_allclose(got, expected, rtol=1e-6, err_msg=f"{data}x{bank}")
+
+
+def test_sharded_with_lut(devices):
+    edges = np.linspace(0.0, 1000.0, 11)
+    n_pixel, n_screen = 100, 16
+    lut = (np.arange(n_pixel) % n_screen).astype(np.int32)
+    lut[7] = -1  # masked pixel
+    pid, toa = make_events(4096, n_pixel, seed=1)
+    toa = (toa % 1000.0).astype(np.float32)
+
+    single = EventHistogrammer(toa_edges=edges, n_screen=n_screen, pixel_lut=lut)
+    st1 = single.init_state()
+    st1 = single.step(st1, EventBatch.from_arrays(pid, toa))
+
+    mesh = make_mesh(8, data=2, bank=4)
+    sharded = ShardedHistogrammer(
+        toa_edges=edges, n_screen=n_screen, mesh=mesh, pixel_lut=lut
+    )
+    st2 = sharded.init_state()
+    b = EventBatch.from_arrays(pid, toa)
+    st2 = sharded.step(st2, b.pixel_id, b.toa)
+    np.testing.assert_allclose(
+        np.asarray(st2.window), np.asarray(st1.window), rtol=1e-6
+    )
+
+
+def test_cumulative_across_steps_and_decay(devices):
+    edges = np.linspace(0.0, 10.0, 2)
+    mesh = make_mesh(8, data=4, bank=2)
+    sharded = ShardedHistogrammer(
+        toa_edges=edges, n_screen=2, mesh=mesh, decay=0.5
+    )
+    st = sharded.init_state()
+    pid = np.zeros(4096, dtype=np.int32)
+    pid[4:] = -1  # 4 valid events on screen 0
+    toa = np.full(4096, 5.0, dtype=np.float32)
+    st = sharded.step(st, pid, toa)
+    st = sharded.step(st, pid, toa)
+    cum, win = sharded.to_host(st)
+    assert cum[0, 0] == pytest.approx(8.0)
+    assert win[0, 0] == pytest.approx(6.0)  # 4*0.5 + 4
+
+
+def test_monitor_normalization_psum(devices):
+    edges = np.linspace(0.0, 10.0, 2)
+    mesh = make_mesh(8, data=2, bank=4)
+    sharded = ShardedHistogrammer(toa_edges=edges, n_screen=4, mesh=mesh)
+    st = sharded.init_state()
+    pid = np.zeros(4096, dtype=np.int32)
+    toa = np.full(4096, 5.0, dtype=np.float32)
+    st = sharded.step(st, pid, toa)
+    monitor = np.full(8, 512.0, dtype=np.float32)  # global total 4096
+    norm = sharded.normalized(st.window, monitor)
+    got = np.asarray(norm)
+    assert got[0, 0] == pytest.approx(1.0)
+
+    state_sum = np.asarray(st.window).sum()
+    assert state_sum == pytest.approx(4096.0)
+
+
+def test_state_sharding_is_bank_distributed(devices):
+    edges = np.linspace(0.0, 10.0, 3)
+    mesh = make_mesh(8, bank=8)
+    sharded = ShardedHistogrammer(toa_edges=edges, n_screen=16, mesh=mesh)
+    st = sharded.init_state()
+    shards = st.cumulative.addressable_shards
+    assert len(shards) == 8
+    assert shards[0].data.shape == (2, 2)  # 16 rows / 8 banks
