@@ -1,0 +1,171 @@
+"""The command/identity vocabulary shared by services, dashboard and wire.
+
+Parity with reference ``config/workflow_spec.py`` (WorkflowSpec:312,
+WorkflowId:146, JobId:179, JobSchedule:519, WorkflowConfig:551,
+ResultKey:275, OutputView:43): pydantic models so that (a) commands
+round-trip JSON on the Kafka commands topic and (b) params models *are* the
+dashboard's auto-generated UI schema. Output templates are empty labeled
+DataArrays that drive plotter auto-selection (reference :366-383).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Callable, Literal
+
+from pydantic import BaseModel, ConfigDict, Field, field_validator
+
+from ..core.timestamp import Timestamp
+from ..utils.labeled import DataArray
+
+__all__ = [
+    "JobId",
+    "JobSchedule",
+    "OutputSpec",
+    "ResultKey",
+    "WorkflowConfig",
+    "WorkflowId",
+    "WorkflowSpec",
+]
+
+
+class WorkflowId(BaseModel):
+    """Identifies a workflow implementation (not an instance)."""
+
+    model_config = ConfigDict(frozen=True)
+
+    instrument: str
+    namespace: str = "default"
+    name: str
+    version: int = 1
+
+    def __str__(self) -> str:
+        return f"{self.instrument}/{self.namespace}/{self.name}/v{self.version}"
+
+    @classmethod
+    def parse(cls, s: str) -> WorkflowId:
+        instrument, namespace, name, v = s.split("/")
+        return cls(
+            instrument=instrument,
+            namespace=namespace,
+            name=name,
+            version=int(v.lstrip("v")),
+        )
+
+
+class JobId(BaseModel):
+    """One running workflow instance bound to one source."""
+
+    model_config = ConfigDict(frozen=True)
+
+    source_name: str
+    job_number: uuid.UUID = Field(default_factory=uuid.uuid4)
+
+    def __str__(self) -> str:
+        return f"{self.source_name}:{self.job_number}"
+
+
+class JobSchedule(BaseModel):
+    """Data-time activation window (ns epoch); None = immediately/forever.
+
+    Jobs activate when *data time* reaches start_time and finish when it
+    passes end_time — never wall clock (reference job_manager.py:357)."""
+
+    model_config = ConfigDict(frozen=True)
+
+    start_time_ns: int | None = None
+    end_time_ns: int | None = None
+
+    @property
+    def start(self) -> Timestamp | None:
+        return None if self.start_time_ns is None else Timestamp(self.start_time_ns)
+
+    @property
+    def end(self) -> Timestamp | None:
+        return None if self.end_time_ns is None else Timestamp(self.end_time_ns)
+
+
+class WorkflowConfig(BaseModel):
+    """The start-job command as it travels the commands topic."""
+
+    identifier: WorkflowId
+    job_id: JobId
+    params: dict[str, Any] = Field(default_factory=dict)
+    aux_source_names: dict[str, str] = Field(default_factory=dict)
+    schedule: JobSchedule = Field(default_factory=JobSchedule)
+
+
+class ResultKey(BaseModel):
+    """Routing key stamped on every published result."""
+
+    model_config = ConfigDict(frozen=True)
+
+    workflow_id: WorkflowId
+    job_id: JobId
+    output_name: str
+
+    def stream_name(self) -> str:
+        return f"{self.job_id.source_name}/{self.output_name}/{self.job_id.job_number}"
+
+
+class OutputSpec(BaseModel):
+    """Declares one named workflow output.
+
+    ``template`` produces an empty DataArray with the output's dims, units
+    and coords — the dashboard selects plotters from it without running the
+    workflow (reference workflow_spec.py:366-383). ``view`` distinguishes
+    per-update (window) from since-start (cumulative) outputs.
+    """
+
+    model_config = ConfigDict(arbitrary_types_allowed=True)
+
+    title: str = ""
+    description: str = ""
+    view: Literal["per_update", "since_start"] = "per_update"
+    template: Callable[[], DataArray] | None = None
+
+
+class WorkflowSpec(BaseModel):
+    """Declarative description of a workflow: what it consumes, its
+    parameter schema, and the outputs it produces."""
+
+    model_config = ConfigDict(arbitrary_types_allowed=True)
+
+    instrument: str
+    namespace: str = "default"
+    name: str
+    version: int = 1
+    title: str = ""
+    description: str = ""
+    source_names: list[str] = Field(default_factory=list)
+    aux_source_names: dict[str, list[str]] = Field(default_factory=dict)
+    params_model: type[BaseModel] | None = None
+    outputs: dict[str, OutputSpec] = Field(default_factory=dict)
+    context_keys: list[str] = Field(default_factory=list)
+    reset_on_run_transition: bool = True
+
+    @field_validator("source_names")
+    @classmethod
+    def _nonempty_names(cls, v: list[str]) -> list[str]:
+        if any(not s for s in v):
+            raise ValueError("source names must be non-empty")
+        return v
+
+    @property
+    def identifier(self) -> WorkflowId:
+        return WorkflowId(
+            instrument=self.instrument,
+            namespace=self.namespace,
+            name=self.name,
+            version=self.version,
+        )
+
+    def validate_params(self, params: dict[str, Any]) -> BaseModel | None:
+        """Parse raw command params through this spec's model."""
+        if self.params_model is None:
+            if params:
+                raise ValueError(
+                    f"Workflow {self.identifier} accepts no params, got {params}"
+                )
+            return None
+        return self.params_model.model_validate(params)

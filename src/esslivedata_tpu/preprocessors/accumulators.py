@@ -1,0 +1,118 @@
+"""Dense-data accumulators over labeled DataArrays.
+
+Parity with reference ``preprocessors/accumulators.py``: ``Cumulative``
+(+= with restart on structural mismatch, reference :238-261),
+``LatestValueAccumulator`` (context, :57), ``NullAccumulator`` (:46).
+The reference's NoCopyAccumulator exists to avoid deepcopying a 500 MB
+histogram on every read (:96-97); here large histograms are device state
+inside the kernel and are never copied, so ``Cumulative`` defaults to
+no-copy reads with the same reset-on-structure-change semantics.
+"""
+
+from __future__ import annotations
+
+from typing import ClassVar
+
+from ..core.timestamp import Timestamp
+from ..utils.labeled import DataArray
+
+__all__ = ["Cumulative", "LatestValueAccumulator", "NullAccumulator"]
+
+
+class NullAccumulator:
+    """Swallows everything; for streams a service must consume but ignore."""
+
+    is_context: ClassVar[bool] = False
+
+    def add(self, timestamp: Timestamp, data: object) -> None:
+        pass
+
+    def get(self) -> None:
+        return None
+
+    def clear(self) -> None:
+        pass
+
+    def release_buffers(self) -> None:
+        pass
+
+
+class LatestValueAccumulator:
+    """Keeps the most recent value — context streams (motor positions,
+    chopper settings) that parameterize workflows. is_context=True gates
+    job activation until a value exists (ADR 0002)."""
+
+    is_context: ClassVar[bool] = True
+
+    def __init__(self) -> None:
+        self._value = None
+        self._timestamp: Timestamp | None = None
+
+    def add(self, timestamp: Timestamp, data: object) -> None:
+        if self._timestamp is None or timestamp >= self._timestamp:
+            self._value = data
+            self._timestamp = timestamp
+
+    @property
+    def has_value(self) -> bool:
+        return self._value is not None
+
+    def get(self):
+        if self._value is None:
+            raise ValueError("LatestValueAccumulator is empty")
+        return self._value
+
+    def clear(self) -> None:
+        self._value = None
+        self._timestamp = None
+
+    def release_buffers(self) -> None:
+        pass
+
+
+class Cumulative:
+    """Running += of DataArrays, restarting when structure changes.
+
+    A structural mismatch (different dims/shape/unit/coords — e.g. the
+    upstream reconfigured its binning or an ad00 camera changed ROI) resets
+    the accumulation to the new value instead of erroring, matching the
+    reference's restart-on-mismatch behavior (accumulators.py:238-261).
+
+    ``clear_on_get`` gives window semantics (value since last read);
+    otherwise since-start. Reads are no-copy by default: callers must not
+    mutate the returned array (copy_on_get=True for defensive copies).
+    """
+
+    is_context: ClassVar[bool] = False
+
+    def __init__(self, *, clear_on_get: bool = False, copy_on_get: bool = False) -> None:
+        self._clear_on_get = clear_on_get
+        self._copy_on_get = copy_on_get
+        self._value: DataArray | None = None
+
+    def add(self, timestamp: Timestamp, data: DataArray) -> None:
+        if self._value is not None and self._value.same_structure(data):
+            self._value += data
+        else:
+            # restart: first value, or structure changed upstream
+            self._value = data.copy()
+
+    @property
+    def is_empty(self) -> bool:
+        return self._value is None
+
+    def get(self) -> DataArray:
+        if self._value is None:
+            raise ValueError("Cumulative accumulator is empty")
+        value = self._value
+        if self._copy_on_get:
+            value = value.copy()
+        if self._clear_on_get:
+            self._value = None
+        return value
+
+    def clear(self) -> None:
+        self._value = None
+
+    def release_buffers(self) -> None:
+        pass

@@ -1,0 +1,90 @@
+"""Area-detector (camera) view: ad00 images with current+cumulative outputs
+and an optional logical transform (reference: workflows/area_detector_view.py:22).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any
+
+import numpy as np
+from pydantic import BaseModel, ConfigDict
+
+from ..utils.labeled import DataArray, Variable
+
+__all__ = ["AreaDetectorParams", "AreaDetectorView"]
+
+
+class AreaDetectorParams(BaseModel):
+    model_config = ConfigDict(frozen=True)
+
+    transpose: bool = False
+    flip_y: bool = False
+    flip_x: bool = False
+
+
+class AreaDetectorView:
+    """Accumulates 2-D camera frames; cumulative restarts automatically on
+    shape change (camera ROI reconfigured upstream)."""
+
+    def __init__(self, *, params: AreaDetectorParams | None = None) -> None:
+        self._params = params or AreaDetectorParams()
+        self._window: np.ndarray | None = None
+        self._cumulative: np.ndarray | None = None
+        self._unit = None
+
+    def _transform(self, values: np.ndarray) -> np.ndarray:
+        p = self._params
+        if p.transpose:
+            values = values.T
+        if p.flip_y:
+            values = values[::-1, :]
+        if p.flip_x:
+            values = values[:, ::-1]
+        return values
+
+    def accumulate(self, data: Mapping[str, Any]) -> None:
+        for value in data.values():
+            if not isinstance(value, DataArray) or value.data.ndim != 2:
+                continue
+            frame = self._transform(np.asarray(value.values, dtype=np.float64))
+            self._unit = value.unit
+            if self._cumulative is None or self._cumulative.shape != frame.shape:
+                self._cumulative = frame.copy()
+                self._window = frame.copy()
+            else:
+                self._cumulative += frame
+                if self._window is None or self._window.shape != frame.shape:
+                    self._window = frame.copy()
+                else:
+                    self._window += frame
+
+    def finalize(self) -> dict[str, DataArray]:
+        if self._cumulative is None:
+            return {}
+        ny, nx = self._cumulative.shape
+        coords = {
+            "y": Variable(np.arange(ny, dtype=np.float64), ("y",), ""),
+            "x": Variable(np.arange(nx, dtype=np.float64), ("x",), ""),
+        }
+        window = self._window if self._window is not None else np.zeros_like(
+            self._cumulative
+        )
+        out = {
+            "current": DataArray(
+                Variable(window.copy(), ("y", "x"), self._unit),
+                coords=coords,
+                name="current",
+            ),
+            "cumulative": DataArray(
+                Variable(self._cumulative.copy(), ("y", "x"), self._unit),
+                coords=coords,
+                name="cumulative",
+            ),
+        }
+        self._window = np.zeros_like(self._cumulative)
+        return out
+
+    def clear(self) -> None:
+        self._window = None
+        self._cumulative = None

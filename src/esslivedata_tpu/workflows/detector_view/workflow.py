@@ -1,0 +1,198 @@
+"""The detector-view streaming workflow.
+
+Reference parity: workflows/detector_view/workflow.py:67 (pipeline),
+providers.py:169-328 (histogram, image, counts, spectrum, ROI spectra),
+roi.py:31-188 (ROI masks/spectra). The whole per-cycle pipeline is two
+jitted programs: ``step`` (scatter-add accumulate, ops/histogram.py) and
+``_finalize`` (image/spectrum/counts/ROI summaries computed on device and
+pulled to host as small dense outputs).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from pydantic import BaseModel, ConfigDict, Field
+
+from ...config.models import ROI, TOARange
+from ...ops.histogram import EventHistogrammer, HistogramState
+from ...preprocessors.event_data import StagedEvents
+from ...utils.labeled import DataArray, Variable
+from .projectors import ProjectionTable
+
+__all__ = ["DetectorViewParams", "DetectorViewWorkflow", "MAX_ROIS"]
+
+MAX_ROIS = 8
+"""ROI mask matrix rows are fixed at this size so ROI edits never trigger
+an XLA recompile — unused rows are zero."""
+
+
+class DetectorViewParams(BaseModel):
+    model_config = ConfigDict(frozen=True)
+
+    toa_bins: int = 100
+    toa_range: TOARange = Field(default_factory=TOARange)
+    pixel_weighting: bool = False
+
+
+def _density_weights(lut: np.ndarray) -> np.ndarray:
+    """Per-pixel 1/occupancy weights compensating projection density
+    (reference providers.py:98): screen bins fed by many pixels are
+    downweighted so the image reflects rate per screen area."""
+    valid = lut[0] >= 0
+    counts = np.bincount(lut[0][valid])
+    w = np.zeros(lut.shape[1], dtype=np.float32)
+    w[valid] = 1.0 / np.maximum(counts[lut[0][valid]], 1)
+    return w
+
+
+class DetectorViewWorkflow:
+    """Histogram events on a projected 2-D screen; emit image, spectrum,
+    total counts and ROI spectra in current (window) and cumulative views.
+    """
+
+    def __init__(
+        self,
+        *,
+        projection: ProjectionTable,
+        params: DetectorViewParams | None = None,
+        primary_stream: str | None = None,
+    ) -> None:
+        params = params or DetectorViewParams()
+        self._proj = projection
+        self._params = params
+        edges = np.linspace(
+            params.toa_range.low, params.toa_range.high, params.toa_bins + 1
+        )
+        weights = (
+            _density_weights(projection.lut) if params.pixel_weighting else None
+        )
+        self._hist = EventHistogrammer(
+            toa_edges=edges,
+            n_screen=projection.n_screen,
+            pixel_lut=projection.lut,
+            pixel_weights=weights,
+        )
+        self._state: HistogramState = self._hist.init_state()
+        self._primary_stream = primary_stream
+        self._roi_names: list[str] = []
+        self._roi_masks = jnp.zeros(
+            (MAX_ROIS, projection.n_screen), dtype=jnp.float32
+        )
+        ny, nx = projection.ny, projection.nx
+        n_toa = self._hist.n_toa
+
+        def summarize(cum, win, roi_masks):
+            return {
+                "image_current": win.sum(axis=1).reshape(ny, nx),
+                "image_cumulative": cum.sum(axis=1).reshape(ny, nx),
+                "spectrum_current": win.sum(axis=0),
+                "spectrum_cumulative": cum.sum(axis=0),
+                "counts_current": win.sum(),
+                "counts_cumulative": cum.sum(),
+                # [MAX_ROIS, n_toa] on the MXU; unused rows are zero.
+                "roi_spectra": roi_masks @ win,
+            }
+
+        self._summarize = jax.jit(summarize)
+        self._toa_edges_var = Variable(edges, ("toa",), "ns")
+        assert n_toa == edges.size - 1
+
+    # -- ROI management ----------------------------------------------------
+    def set_rois(self, rois: Mapping[str, ROI]) -> None:
+        """Install ROI masks (from the dashboard's ROI topic round trip,
+        reference roi.py:293). Limited to MAX_ROIS, extra ROIs rejected."""
+        if len(rois) > MAX_ROIS:
+            raise ValueError(f"At most {MAX_ROIS} ROIs supported, got {len(rois)}")
+        from ...utils.labeled import midpoints
+
+        xc = midpoints(self._proj.x_edges).numpy
+        yc = midpoints(self._proj.y_edges).numpy
+        masks = np.zeros((MAX_ROIS, self._proj.n_screen), dtype=np.float32)
+        names = []
+        for i, (name, roi) in enumerate(rois.items()):
+            masks[i] = roi.mask(xc, yc).reshape(-1).astype(np.float32)
+            names.append(name)
+        self._roi_names = names
+        self._roi_masks = jnp.asarray(masks)
+
+    @property
+    def roi_names(self) -> list[str]:
+        return list(self._roi_names)
+
+    # -- Workflow protocol -------------------------------------------------
+    def accumulate(self, data: Mapping[str, Any]) -> None:
+        for key, value in data.items():
+            if isinstance(value, StagedEvents):
+                if self._primary_stream is None or key == self._primary_stream:
+                    self._state = self._hist.step(self._state, value.batch)
+
+    def finalize(self) -> dict[str, DataArray]:
+        out = self._summarize(
+            self._state.cumulative, self._state.window, self._roi_masks
+        )
+        out = {k: np.asarray(v) for k, v in out.items()}
+        self._state = self._hist.clear_window(self._state)
+
+        img_coords = {
+            "x": self._proj.x_edges,
+            "y": self._proj.y_edges,
+        }
+        spec_coords = {"toa": self._toa_edges_var}
+        results: dict[str, DataArray] = {
+            "image_current": DataArray(
+                Variable(out["image_current"], ("y", "x"), "counts"),
+                coords=img_coords,
+                name="image_current",
+            ),
+            "image_cumulative": DataArray(
+                Variable(out["image_cumulative"], ("y", "x"), "counts"),
+                coords=img_coords,
+                name="image_cumulative",
+            ),
+            "spectrum_current": DataArray(
+                Variable(out["spectrum_current"], ("toa",), "counts"),
+                coords=spec_coords,
+                name="spectrum_current",
+            ),
+            "spectrum_cumulative": DataArray(
+                Variable(out["spectrum_cumulative"], ("toa",), "counts"),
+                coords=spec_coords,
+                name="spectrum_cumulative",
+            ),
+            "counts_current": DataArray(
+                Variable(np.asarray(out["counts_current"]), (), "counts"),
+                name="counts_current",
+            ),
+            "counts_cumulative": DataArray(
+                Variable(np.asarray(out["counts_cumulative"]), (), "counts"),
+                name="counts_cumulative",
+            ),
+        }
+        if self._roi_names:
+            spectra = out["roi_spectra"][: len(self._roi_names)]
+            results["roi_spectra"] = DataArray(
+                Variable(spectra, ("roi", "toa"), "counts"),
+                coords={
+                    "toa": self._toa_edges_var,
+                    "roi": Variable(np.arange(len(self._roi_names)), ("roi",), ""),
+                },
+                name="roi_spectra",
+            )
+        return results
+
+    def clear(self) -> None:
+        self._state = self._hist.clear(self._state)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def histogrammer(self) -> EventHistogrammer:
+        return self._hist
+
+    @property
+    def state(self) -> HistogramState:
+        return self._state

@@ -1,0 +1,127 @@
+"""Monitor histogram workflow (reference: workflows/monitor_workflow.py).
+
+Handles both monitor data modes like the reference (_histogram_monitor:65):
+event-mode (ev44 -> staged event batches -> 1-row device histogram) and
+histogram-mode (da00 dense histograms -> host rebin onto the target edges,
+accumulated with Cumulative). Outputs current/cumulative 1-D TOA spectra.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any
+
+import numpy as np
+from pydantic import BaseModel, ConfigDict, Field
+
+from ..config.models import TOARange
+from ..ops.histogram import EventHistogrammer, HistogramState
+from ..preprocessors.event_data import StagedEvents
+from ..utils.labeled import DataArray, Variable
+
+__all__ = ["MonitorWorkflow", "MonitorParams", "rebin_1d"]
+
+
+class MonitorParams(BaseModel):
+    model_config = ConfigDict(frozen=True)
+
+    toa_bins: int = 100
+    toa_range: TOARange = Field(default_factory=TOARange)
+
+
+def rebin_1d(
+    values: np.ndarray, src_edges: np.ndarray, dst_edges: np.ndarray
+) -> np.ndarray:
+    """Conservative rebin of a dense 1-D histogram onto new edges
+    (fractional-overlap weighting, the host-side analog of scipp's rebin
+    used by the reference for histogram-mode monitors)."""
+    src_edges = np.asarray(src_edges, dtype=np.float64)
+    dst_edges = np.asarray(dst_edges, dtype=np.float64)
+    out = np.zeros(dst_edges.size - 1)
+    # Overlap of each src bin [a,b) with each dst bin via interval clipping.
+    a = src_edges[:-1]
+    b = src_edges[1:]
+    widths = b - a
+    for j in range(dst_edges.size - 1):
+        lo, hi = dst_edges[j], dst_edges[j + 1]
+        overlap = np.clip(np.minimum(b, hi) - np.maximum(a, lo), 0.0, None)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            frac = np.where(widths > 0, overlap / widths, 0.0)
+        out[j] = float((values * frac).sum())
+    return out
+
+
+class MonitorWorkflow:
+    """1-D TOA histogram of a beam monitor, event- or histogram-mode."""
+
+    def __init__(self, *, params: MonitorParams | None = None) -> None:
+        params = params or MonitorParams()
+        self._params = params
+        self._edges = np.linspace(
+            params.toa_range.low, params.toa_range.high, params.toa_bins + 1
+        )
+        self._hist = EventHistogrammer(toa_edges=self._edges, n_screen=1)
+        self._state: HistogramState = self._hist.init_state()
+        # Dense-mode accumulation happens host-side (tiny arrays).
+        self._dense_cumulative = np.zeros(params.toa_bins)
+        self._dense_window = np.zeros(params.toa_bins)
+        self._edges_var = Variable(self._edges, ("toa",), "ns")
+
+    def accumulate(self, data: Mapping[str, Any]) -> None:
+        for value in data.values():
+            if isinstance(value, StagedEvents):
+                self._state = self._hist.step(self._state, value.batch)
+            elif isinstance(value, DataArray):
+                self._add_dense(value)
+
+    def _add_dense(self, da: DataArray) -> None:
+        coord_name = next(
+            (c for c in ("toa", "time_of_arrival", "tof") if c in da.coords), None
+        )
+        if coord_name is None or da.data.ndim != 1:
+            raise ValueError(
+                f"Histogram-mode monitor data needs a 1-D TOA coord, got {da!r}"
+            )
+        src_edges = da.coords[coord_name].to_unit("ns").numpy
+        values = np.asarray(da.values, dtype=np.float64)
+        if src_edges.size == values.size:  # midpoints: synthesize edges
+            mids = src_edges
+            steps = np.diff(mids)
+            edges = np.concatenate(
+                [
+                    [mids[0] - steps[0] / 2],
+                    mids[:-1] + steps / 2,
+                    [mids[-1] + steps[-1] / 2],
+                ]
+            )
+            src_edges = edges
+        rebinned = rebin_1d(values, src_edges, self._edges)
+        self._dense_window += rebinned
+        self._dense_cumulative += rebinned
+
+    def finalize(self) -> dict[str, DataArray]:
+        win = np.asarray(self._state.window)[0] + self._dense_window
+        cum = np.asarray(self._state.cumulative)[0] + self._dense_cumulative
+        self._state = self._hist.clear_window(self._state)
+        self._dense_window = np.zeros_like(self._dense_window)
+        coords = {"toa": self._edges_var}
+        return {
+            "current": DataArray(
+                Variable(win, ("toa",), "counts"), coords=coords, name="current"
+            ),
+            "cumulative": DataArray(
+                Variable(cum, ("toa",), "counts"), coords=coords, name="cumulative"
+            ),
+            "counts_current": DataArray(
+                Variable(np.asarray(win.sum()), (), "counts"), name="counts_current"
+            ),
+            "counts_cumulative": DataArray(
+                Variable(np.asarray(cum.sum()), (), "counts"),
+                name="counts_cumulative",
+            ),
+        }
+
+    def clear(self) -> None:
+        self._state = self._hist.clear(self._state)
+        self._dense_cumulative[:] = 0.0
+        self._dense_window[:] = 0.0

@@ -1,0 +1,152 @@
+import numpy as np
+import pytest
+
+from esslivedata_tpu.core import Timestamp
+from esslivedata_tpu.preprocessors import (
+    Cumulative,
+    DetectorEvents,
+    LatestValueAccumulator,
+    LogData,
+    MonitorEvents,
+    NullAccumulator,
+    ToEventBatch,
+    ToNXlog,
+)
+from esslivedata_tpu.utils import DataArray, Variable, linspace
+
+T0 = Timestamp.from_ns(1_000)
+T1 = Timestamp.from_ns(2_000)
+T2 = Timestamp.from_ns(3_000)
+
+
+class TestToEventBatch:
+    def test_detector_events_staged(self):
+        acc = ToEventBatch(min_bucket=8)
+        acc.add(T0, DetectorEvents(
+            pixel_id=np.array([1, 2]), time_of_arrival=np.array([10.0, 20.0])
+        ))
+        acc.add(T1, DetectorEvents(
+            pixel_id=np.array([3]), time_of_arrival=np.array([30.0])
+        ))
+        staged = acc.get()
+        assert staged.n_events == 3
+        assert staged.n_chunks == 2
+        assert staged.first_timestamp == T0
+        assert staged.last_timestamp == T1
+        np.testing.assert_array_equal(staged.batch.pixel_id[:3], [1, 2, 3])
+        assert (staged.batch.pixel_id[3:] == -1).all()
+        acc.release_buffers()
+        acc.add(T2, DetectorEvents(
+            pixel_id=np.array([5]), time_of_arrival=np.array([50.0])
+        ))
+        staged2 = acc.get()
+        assert staged2.n_events == 1
+
+    def test_monitor_events_pixel_zero(self):
+        acc = ToEventBatch(min_bucket=8)
+        acc.add(T0, MonitorEvents(time_of_arrival=np.array([10.0, 20.0])))
+        staged = acc.get()
+        np.testing.assert_array_equal(staged.batch.pixel_id[:2], [0, 0])
+
+    def test_add_after_get_without_release_raises(self):
+        acc = ToEventBatch(min_bucket=8)
+        acc.add(T0, MonitorEvents(time_of_arrival=np.array([1.0])))
+        acc.get()
+        with pytest.raises(RuntimeError):
+            acc.add(T1, MonitorEvents(time_of_arrival=np.array([2.0])))
+
+
+def make_da(values, unit="counts"):
+    v = np.asarray(values, dtype=np.float64)
+    return DataArray(
+        Variable(v, ("x",), unit),
+        coords={"x": linspace("x", 0.0, 1.0, len(v) + 1, "mm")},
+    )
+
+
+class TestCumulative:
+    def test_accumulates(self):
+        acc = Cumulative()
+        acc.add(T0, make_da([1, 2, 3]))
+        acc.add(T1, make_da([10, 20, 30]))
+        np.testing.assert_allclose(acc.get().values, [11, 22, 33])
+
+    def test_restart_on_structure_change(self):
+        acc = Cumulative()
+        acc.add(T0, make_da([1, 2, 3]))
+        acc.add(T1, make_da([1, 2]))  # different shape: restart
+        np.testing.assert_allclose(acc.get().values, [1, 2])
+
+    def test_restart_on_unit_change(self):
+        acc = Cumulative()
+        acc.add(T0, make_da([1, 2, 3], unit="counts"))
+        acc.add(T1, make_da([4, 5, 6], unit="m"))
+        np.testing.assert_allclose(acc.get().values, [4, 5, 6])
+
+    def test_window_semantics(self):
+        acc = Cumulative(clear_on_get=True)
+        acc.add(T0, make_da([1, 1, 1]))
+        acc.get()
+        assert acc.is_empty
+        acc.add(T1, make_da([2, 2, 2]))
+        np.testing.assert_allclose(acc.get().values, [2, 2, 2])
+
+    def test_does_not_mutate_input(self):
+        acc = Cumulative()
+        first = make_da([1, 2, 3])
+        acc.add(T0, first)
+        acc.add(T1, make_da([1, 1, 1]))
+        np.testing.assert_allclose(first.values, [1, 2, 3])
+
+    def test_empty_get_raises(self):
+        with pytest.raises(ValueError):
+            Cumulative().get()
+
+
+class TestLatestValue:
+    def test_keeps_latest_by_timestamp(self):
+        acc = LatestValueAccumulator()
+        acc.add(T1, "b")
+        acc.add(T0, "a")  # older: ignored
+        assert acc.get() == "b"
+        assert acc.is_context is True
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError):
+            LatestValueAccumulator().get()
+
+
+class TestToNXlog:
+    def test_accumulates_and_sorts(self):
+        acc = ToNXlog(value_unit="K", name="temp")
+        acc.add(T0, LogData(time=2_000, value=2.0))
+        acc.add(T0, LogData(time=1_000, value=1.0))  # out of order
+        acc.add(T0, LogData(time=3_000, value=3.0))
+        da = acc.get()
+        np.testing.assert_array_equal(da.coords["time"].numpy, [1000, 2000, 3000])
+        np.testing.assert_allclose(da.values, [1.0, 2.0, 3.0])
+        assert repr(da.unit) == "K"
+        assert acc.latest() == 3.0
+
+    def test_batch_samples_and_growth(self):
+        acc = ToNXlog()
+        for i in range(50):
+            acc.add(T0, LogData(time=np.arange(10) + i * 10, value=np.full(10, i)))
+        assert acc.n_samples == 500
+        da = acc.get()
+        assert da.sizes == {"time": 500}
+
+    def test_is_context(self):
+        assert ToNXlog.is_context is True
+
+    def test_clear(self):
+        acc = ToNXlog()
+        acc.add(T0, LogData(time=1, value=1.0))
+        acc.clear()
+        assert not acc.has_value
+
+
+def test_null_accumulator():
+    acc = NullAccumulator()
+    acc.add(T0, object())
+    assert acc.get() is None

@@ -1,0 +1,181 @@
+import numpy as np
+import pytest
+
+from esslivedata_tpu.config.models import PolygonROI, RectangleROI
+from esslivedata_tpu.core import Timestamp
+from esslivedata_tpu.preprocessors import DetectorEvents, ToEventBatch
+from esslivedata_tpu.workflows.detector_view import (
+    DetectorViewParams,
+    DetectorViewWorkflow,
+    LogicalView,
+    project_geometric,
+    project_logical,
+)
+
+T0 = Timestamp.from_ns(0)
+
+
+class TestProjectors:
+    def test_logical_identity(self):
+        det = np.arange(12).reshape(3, 4)
+        table = project_logical(det)
+        assert table.ny == 3 and table.nx == 4
+        # pixel k sits at flat position k
+        np.testing.assert_array_equal(table.lut[0], np.arange(12))
+
+    def test_logical_fold_and_flip(self):
+        det = np.arange(12)
+        table = project_logical(det, LogicalView(fold=(3, 4), flip_y=True))
+        assert table.lut[0][0] == 2 * 4 + 0  # pixel 0 now bottom row
+
+    def test_logical_noncontiguous_ids(self):
+        det = np.array([[10, 20], [30, 40]])
+        table = project_logical(det)
+        assert table.lut.shape == (1, 41)
+        assert table.lut[0][10] == 0
+        assert table.lut[0][40] == 3
+        assert table.lut[0][11] == -1  # unmapped id
+
+    def test_geometric_xy(self):
+        # 4 pixels in a 2x2 grid on the xy plane
+        positions = np.array(
+            [[-1.0, -1.0, 5.0], [1.0, -1.0, 5.0], [-1.0, 1.0, 5.0], [1.0, 1.0, 5.0]]
+        )
+        table = project_geometric(
+            positions, np.arange(4), resolution=(2, 2),
+            extent=(-2.0, 2.0, -2.0, 2.0),
+        )
+        np.testing.assert_array_equal(table.lut[0], [0, 1, 2, 3])
+
+    def test_geometric_replicas(self):
+        positions = np.zeros((5, 3))
+        table = project_geometric(
+            positions,
+            np.arange(5),
+            resolution=(4, 4),
+            noise_sigma=0.5,
+            n_replica=6,
+            extent=(-1, 1, -1, 1),
+        )
+        assert table.lut.shape == (6, 5)
+        assert table.n_replica == 6
+
+    def test_geometric_cylinder(self):
+        # pixels on a cylinder of radius 1 at two heights
+        phi = np.array([0.0, np.pi / 2])
+        positions = np.stack(
+            [np.cos(phi), np.sin(phi), np.array([0.0, 1.0])], axis=1
+        )
+        table = project_geometric(
+            positions, np.arange(2), mode="cylinder_mantle_z", resolution=(2, 4)
+        )
+        assert (table.lut[0] >= 0).all()
+
+
+def stage(pixel_id, toa):
+    acc = ToEventBatch(min_bucket=16)
+    acc.add(
+        T0,
+        DetectorEvents(
+            pixel_id=np.asarray(pixel_id, dtype=np.int32),
+            time_of_arrival=np.asarray(toa, dtype=np.float32),
+        ),
+    )
+    return acc.get()
+
+
+@pytest.fixture
+def view():
+    det = np.arange(16).reshape(4, 4)
+    table = project_logical(det)
+    params = DetectorViewParams(
+        toa_bins=10, toa_range={"low": 0.0, "high": 100.0}
+    )
+    return DetectorViewWorkflow(projection=table, params=params)
+
+
+class TestDetectorViewWorkflow:
+    def test_image_and_counts(self, view):
+        staged = stage([0, 5, 5, 15], [10.0, 20.0, 30.0, 99.0])
+        view.accumulate({"det": staged})
+        out = view.finalize()
+        img = out["image_current"]
+        assert img.dims == ("y", "x")
+        assert img.shape == (4, 4)
+        assert img.values[0, 0] == 1.0
+        assert img.values[1, 1] == 2.0
+        assert img.values[3, 3] == 1.0
+        assert float(out["counts_current"].values) == 4.0
+        assert out["image_current"].coords["x"].shape == (5,)
+
+    def test_window_clears_cumulative_persists(self, view):
+        staged = stage([0], [10.0])
+        view.accumulate({"det": staged})
+        view.finalize()
+        staged2 = stage([0], [10.0])
+        view.accumulate({"det": staged2})
+        out = view.finalize()
+        assert float(out["counts_current"].values) == 1.0
+        assert float(out["counts_cumulative"].values) == 2.0
+
+    def test_spectrum(self, view):
+        staged = stage([0, 1, 2], [5.0, 15.0, 15.0])
+        view.accumulate({"det": staged})
+        out = view.finalize()
+        spec = out["spectrum_current"]
+        assert spec.dims == ("toa",)
+        np.testing.assert_allclose(spec.values[:2], [1.0, 2.0])
+
+    def test_roi_spectra(self, view):
+        view.set_rois(
+            {
+                "left": RectangleROI(x_min=-0.5, x_max=1.5, y_min=-0.5, y_max=3.5),
+                "poly": PolygonROI(x=(1.6, 3.5, 3.5), y=(-0.5, -0.5, 3.5)),
+            }
+        )
+        # pixels 0 (x=0,y=0: left ROI) and 3 (x=3,y=0: poly ROI)
+        staged = stage([0, 3, 3], [5.0, 15.0, 25.0])
+        view.accumulate({"det": staged})
+        out = view.finalize()
+        roi = out["roi_spectra"]
+        assert roi.dims == ("roi", "toa")
+        assert roi.shape == (2, 10)
+        assert roi.values[0].sum() == 1.0  # left ROI got pixel 0
+        assert roi.values[1].sum() == 2.0  # poly ROI got pixel 3
+
+    def test_clear_resets_everything(self, view):
+        view.accumulate({"det": stage([0], [10.0])})
+        view.finalize()
+        view.clear()
+        out = view.finalize()
+        assert float(out["counts_cumulative"].values) == 0.0
+
+    def test_pixel_weighting(self):
+        # two pixels projected onto the same screen bin get half weight each
+        det = np.array([[0, 1]])  # 1x2 screen
+        table = project_logical(det)
+        lut = table.lut.copy()
+        lut[0, 1] = 0  # both pixels -> screen bin 0
+        from esslivedata_tpu.workflows.detector_view.projectors import ProjectionTable
+
+        table2 = ProjectionTable(
+            lut=lut, ny=1, nx=2, y_edges=table.y_edges, x_edges=table.x_edges
+        )
+        wf = DetectorViewWorkflow(
+            projection=table2,
+            params=DetectorViewParams(
+                toa_bins=2, toa_range={"low": 0.0, "high": 100.0},
+                pixel_weighting=True,
+            ),
+        )
+        wf.accumulate({"det": stage([0, 1], [10.0, 20.0])})
+        out = wf.finalize()
+        assert float(out["counts_current"].values) == pytest.approx(1.0)
+
+    def test_too_many_rois(self, view):
+        rois = {
+            f"r{i}": RectangleROI(x_min=0, x_max=1, y_min=0, y_max=1)
+            for i in range(9)
+        }
+        with pytest.raises(ValueError, match="At most"):
+            view.set_rois(rois)

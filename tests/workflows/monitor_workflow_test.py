@@ -1,0 +1,143 @@
+import numpy as np
+import pytest
+
+from esslivedata_tpu.core import Timestamp
+from esslivedata_tpu.preprocessors import MonitorEvents, ToEventBatch
+from esslivedata_tpu.utils import DataArray, Variable, linspace
+from esslivedata_tpu.workflows.area_detector_view import AreaDetectorView
+from esslivedata_tpu.workflows.monitor_workflow import (
+    MonitorParams,
+    MonitorWorkflow,
+    rebin_1d,
+)
+from esslivedata_tpu.workflows.timeseries import TimeseriesWorkflow
+
+T0 = Timestamp.from_ns(0)
+
+
+def stage_monitor(toa):
+    acc = ToEventBatch(min_bucket=16)
+    acc.add(T0, MonitorEvents(time_of_arrival=np.asarray(toa, dtype=np.float32)))
+    return acc.get()
+
+
+class TestRebin:
+    def test_identity(self):
+        e = np.array([0.0, 1.0, 2.0, 3.0])
+        v = np.array([1.0, 2.0, 3.0])
+        np.testing.assert_allclose(rebin_1d(v, e, e), v)
+
+    def test_coarsen_conserves_counts(self):
+        src = np.linspace(0, 10, 11)
+        v = np.ones(10)
+        dst = np.linspace(0, 10, 3)
+        out = rebin_1d(v, src, dst)
+        np.testing.assert_allclose(out, [5.0, 5.0])
+
+    def test_partial_overlap(self):
+        src = np.array([0.0, 2.0])
+        v = np.array([4.0])
+        dst = np.array([1.0, 3.0])
+        np.testing.assert_allclose(rebin_1d(v, src, dst), [2.0])
+
+
+class TestMonitorWorkflow:
+    def make(self):
+        return MonitorWorkflow(
+            params=MonitorParams(toa_bins=10, toa_range={"low": 0.0, "high": 100.0})
+        )
+
+    def test_event_mode(self):
+        wf = self.make()
+        wf.accumulate({"mon": stage_monitor([5.0, 15.0, 15.0, 99.0])})
+        out = wf.finalize()
+        np.testing.assert_allclose(out["current"].values[:2], [1.0, 2.0])
+        assert float(out["counts_current"].values) == 4.0
+
+    def test_histogram_mode(self):
+        wf = self.make()
+        da = DataArray(
+            Variable(np.ones(10), ("toa",), "counts"),
+            coords={"toa": linspace("toa", 0.0, 100.0, 11, "ns")},
+        )
+        wf.accumulate({"mon": da})
+        out = wf.finalize()
+        np.testing.assert_allclose(out["current"].values, np.ones(10))
+
+    def test_histogram_mode_unit_conversion(self):
+        wf = self.make()
+        # 0-0.1 ms == 0-100000 ns... use us: 0-0.1 us == 0-100 ns
+        da = DataArray(
+            Variable(np.ones(2), ("toa",), "counts"),
+            coords={"toa": linspace("toa", 0.0, 0.1, 3, "us")},
+        )
+        wf.accumulate({"mon": da})
+        out = wf.finalize()
+        assert float(out["counts_current"].values) == pytest.approx(2.0)
+
+    def test_mixed_modes_and_window_semantics(self):
+        wf = self.make()
+        wf.accumulate({"mon": stage_monitor([5.0])})
+        da = DataArray(
+            Variable(np.array([3.0]), ("toa",), "counts"),
+            coords={"toa": linspace("toa", 0.0, 100.0, 2, "ns")},
+        )
+        wf.accumulate({"mon2": da})
+        out = wf.finalize()
+        assert float(out["counts_current"].values) == pytest.approx(4.0)
+        out2 = wf.finalize()
+        assert float(out2["counts_current"].values) == 0.0
+        assert float(out2["counts_cumulative"].values) == pytest.approx(4.0)
+
+    def test_clear(self):
+        wf = self.make()
+        wf.accumulate({"mon": stage_monitor([5.0])})
+        wf.finalize()
+        wf.clear()
+        out = wf.finalize()
+        assert float(out["counts_cumulative"].values) == 0.0
+
+
+class TestTimeseries:
+    def test_pass_through_latest(self):
+        wf = TimeseriesWorkflow()
+        da1 = DataArray(Variable(np.array([1.0]), ("time",), "K"))
+        da2 = DataArray(Variable(np.array([1.0, 2.0]), ("time",), "K"))
+        wf.accumulate({"temp": da1})
+        wf.accumulate({"temp": da2})
+        out = wf.finalize()
+        assert out["temp"].shape == (2,)
+        wf.clear()
+        assert wf.finalize() == {}
+
+
+class TestAreaDetectorView:
+    def frame(self, fill):
+        return DataArray(Variable(np.full((2, 3), fill), ("y", "x"), "counts"))
+
+    def test_accumulates(self):
+        wf = AreaDetectorView()
+        wf.accumulate({"cam": self.frame(1.0)})
+        wf.accumulate({"cam": self.frame(2.0)})
+        out = wf.finalize()
+        np.testing.assert_allclose(out["current"].values, np.full((2, 3), 3.0))
+        wf.accumulate({"cam": self.frame(1.0)})
+        out2 = wf.finalize()
+        np.testing.assert_allclose(out2["current"].values, np.full((2, 3), 1.0))
+        np.testing.assert_allclose(out2["cumulative"].values, np.full((2, 3), 4.0))
+
+    def test_restart_on_shape_change(self):
+        wf = AreaDetectorView()
+        wf.accumulate({"cam": self.frame(1.0)})
+        bigger = DataArray(Variable(np.ones((4, 4)), ("y", "x"), "counts"))
+        wf.accumulate({"cam": bigger})
+        out = wf.finalize()
+        assert out["cumulative"].shape == (4, 4)
+
+    def test_transform(self):
+        from esslivedata_tpu.workflows.area_detector_view import AreaDetectorParams
+
+        wf = AreaDetectorView(params=AreaDetectorParams(transpose=True))
+        wf.accumulate({"cam": self.frame(1.0)})
+        out = wf.finalize()
+        assert out["current"].shape == (3, 2)

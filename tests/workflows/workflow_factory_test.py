@@ -1,0 +1,155 @@
+import pytest
+from pydantic import BaseModel
+
+from esslivedata_tpu.config import JobId, WorkflowConfig, WorkflowId, WorkflowSpec
+from esslivedata_tpu.workflows import WorkflowFactory
+
+
+class Params(BaseModel):
+    n_bins: int = 10
+
+
+class DummyWorkflow:
+    def __init__(self, source_name, params):
+        self.source_name = source_name
+        self.params = params
+
+    def accumulate(self, data):
+        pass
+
+    def finalize(self):
+        return {}
+
+    def clear(self):
+        pass
+
+
+@pytest.fixture
+def registry():
+    return WorkflowFactory()
+
+
+def make_spec(**kw):
+    defaults = dict(
+        instrument="dummy",
+        namespace="detector_view",
+        name="view",
+        version=1,
+        source_names=["bank0", "bank1"],
+        params_model=Params,
+    )
+    defaults.update(kw)
+    return WorkflowSpec(**defaults)
+
+
+def test_two_phase_registration(registry):
+    spec = make_spec()
+    handle = registry.register_spec(spec)
+    assert spec.identifier in registry
+    assert not registry.has_factory(spec.identifier)
+
+    @handle.attach_factory
+    def factory(*, source_name, params):
+        return DummyWorkflow(source_name, params)
+
+    assert registry.has_factory(spec.identifier)
+    config = WorkflowConfig(
+        identifier=spec.identifier,
+        job_id=JobId(source_name="bank0"),
+        params={"n_bins": 42},
+    )
+    wf = registry.create(config)
+    assert wf.source_name == "bank0"
+    assert wf.params.n_bins == 42
+
+
+def test_duplicate_spec_rejected(registry):
+    registry.register_spec(make_spec())
+    with pytest.raises(ValueError, match="Duplicate"):
+        registry.register_spec(make_spec())
+
+
+def test_create_without_factory_raises(registry):
+    spec = make_spec()
+    registry.register_spec(spec)
+    config = WorkflowConfig(
+        identifier=spec.identifier, job_id=JobId(source_name="bank0")
+    )
+    with pytest.raises(KeyError, match="no attached factory"):
+        registry.create(config)
+
+
+def test_unknown_workflow_raises(registry):
+    config = WorkflowConfig(
+        identifier=WorkflowId(instrument="x", name="y"),
+        job_id=JobId(source_name="s"),
+    )
+    with pytest.raises(KeyError, match="Unknown workflow"):
+        registry.create(config)
+
+
+def test_invalid_source_rejected(registry):
+    spec = make_spec()
+    h = registry.register_spec(spec)
+    h.attach_factory(lambda *, source_name, params: DummyWorkflow(source_name, params))
+    config = WorkflowConfig(
+        identifier=spec.identifier, job_id=JobId(source_name="nope")
+    )
+    with pytest.raises(ValueError, match="not valid"):
+        registry.create(config)
+
+
+def test_invalid_params_rejected(registry):
+    spec = make_spec()
+    h = registry.register_spec(spec)
+    h.attach_factory(lambda *, source_name, params: DummyWorkflow(source_name, params))
+    config = WorkflowConfig(
+        identifier=spec.identifier,
+        job_id=JobId(source_name="bank0"),
+        params={"n_bins": "not_an_int"},
+    )
+    with pytest.raises(Exception):
+        registry.create(config)
+
+
+def test_aux_source_validation(registry):
+    spec = make_spec(aux_source_names={"monitor": ["mon1", "mon2"]})
+    h = registry.register_spec(spec)
+    h.attach_factory(lambda *, source_name, params: DummyWorkflow(source_name, params))
+    ok = WorkflowConfig(
+        identifier=spec.identifier,
+        job_id=JobId(source_name="bank0"),
+        aux_source_names={"monitor": "mon1"},
+    )
+    registry.create(ok)
+    bad_key = WorkflowConfig(
+        identifier=spec.identifier,
+        job_id=JobId(source_name="bank0"),
+        aux_source_names={"nope": "mon1"},
+    )
+    with pytest.raises(ValueError, match="Unknown aux"):
+        registry.create(bad_key)
+    bad_source = WorkflowConfig(
+        identifier=spec.identifier,
+        job_id=JobId(source_name="bank0"),
+        aux_source_names={"monitor": "mon9"},
+    )
+    with pytest.raises(ValueError, match="invalid"):
+        registry.create(bad_source)
+
+
+def test_workflow_id_roundtrip():
+    wid = WorkflowId(instrument="loki", namespace="sans", name="iq", version=3)
+    assert WorkflowId.parse(str(wid)) == wid
+
+
+def test_workflow_config_json_roundtrip():
+    spec = make_spec()
+    config = WorkflowConfig(
+        identifier=spec.identifier,
+        job_id=JobId(source_name="bank0"),
+        params={"n_bins": 7},
+    )
+    blob = config.model_dump_json()
+    restored = WorkflowConfig.model_validate_json(blob)
+    assert restored == config
