@@ -1,0 +1,109 @@
+"""(topic, source_name) -> canonical stream name lookup tables.
+
+Parity with reference ``kafka/stream_mapping.py`` (InputStreamKey:11,
+StreamMapping:39, LivedataTopics:22): raw ECDC topics carry many named
+sources; services address streams by canonical names declared in the
+instrument config. The LUTs here are that translation, per stream kind.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from dataclasses import dataclass, field
+
+__all__ = ["InputStreamKey", "LivedataTopics", "StreamMapping"]
+
+
+@dataclass(frozen=True, slots=True)
+class InputStreamKey:
+    topic: str
+    source_name: str
+
+
+@dataclass(frozen=True, slots=True)
+class LivedataTopics:
+    """Our own output/control topics for one instrument."""
+
+    data: str
+    status: str
+    commands: str
+    responses: str
+    roi: str
+    nicos: str
+
+    @classmethod
+    def for_instrument(cls, instrument: str) -> "LivedataTopics":
+        return cls(
+            data=f"{instrument}_livedata_data",
+            status=f"{instrument}_livedata_status",
+            commands=f"{instrument}_livedata_commands",
+            responses=f"{instrument}_livedata_responses",
+            roi=f"{instrument}_livedata_roi",
+            nicos=f"{instrument}_livedata_nicos",
+        )
+
+
+@dataclass(frozen=True)
+class StreamMapping:
+    """All input routing knowledge for one instrument's services."""
+
+    instrument: str
+    detectors: Mapping[InputStreamKey, str] = field(default_factory=dict)
+    monitors: Mapping[InputStreamKey, str] = field(default_factory=dict)
+    area_detectors: Mapping[InputStreamKey, str] = field(default_factory=dict)
+    logs: Mapping[InputStreamKey, str] = field(default_factory=dict)
+    run_control_topics: tuple[str, ...] = ()
+    livedata: LivedataTopics | None = None
+
+    def __post_init__(self) -> None:
+        if self.livedata is None:
+            object.__setattr__(
+                self, "livedata", LivedataTopics.for_instrument(self.instrument)
+            )
+
+    @property
+    def detector_topics(self) -> set[str]:
+        return {k.topic for k in self.detectors}
+
+    @property
+    def monitor_topics(self) -> set[str]:
+        return {k.topic for k in self.monitors}
+
+    @property
+    def area_detector_topics(self) -> set[str]:
+        return {k.topic for k in self.area_detectors}
+
+    @property
+    def log_topics(self) -> set[str]:
+        return {k.topic for k in self.logs}
+
+    @property
+    def all_input_topics(self) -> set[str]:
+        return (
+            self.detector_topics
+            | self.monitor_topics
+            | self.area_detector_topics
+            | self.log_topics
+            | set(self.run_control_topics)
+            | {self.livedata.commands, self.livedata.roi}
+        )
+
+    def scoped(
+        self,
+        *,
+        detectors: bool = False,
+        monitors: bool = False,
+        area_detectors: bool = False,
+        logs: bool = False,
+    ) -> "StreamMapping":
+        """Restrict to the stream kinds a given service consumes
+        (reference: config/route_derivation.py scope_stream_mapping:109)."""
+        return StreamMapping(
+            instrument=self.instrument,
+            detectors=dict(self.detectors) if detectors else {},
+            monitors=dict(self.monitors) if monitors else {},
+            area_detectors=dict(self.area_detectors) if area_detectors else {},
+            logs=dict(self.logs) if logs else {},
+            run_control_topics=self.run_control_topics,
+            livedata=self.livedata,
+        )

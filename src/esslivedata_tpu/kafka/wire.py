@@ -1,0 +1,531 @@
+"""Clean-room FlatBuffers codecs for the ESS streaming schema family.
+
+The reference consumes/produces these schemas through the generated
+``ess-streaming-data-types`` package (reference: kafka/message_adapter.py:
+13-21); that package is not available here, so the same logical payloads are
+implemented directly on the flatbuffers runtime: a generic vtable reader for
+decode (zero-copy numpy views into the message buffer — the moral
+equivalent of the reference's fast-path partial decode,
+message_adapter.py:360) and low-level Builder slots for encode.
+
+Schemas carry the standard 4-byte file identifiers (ev44, f144, da00, ad00,
+x5f2, pl72, 6s4t) with field layouts documented per codec below. Producers
+and consumers of *this* framework round-trip losslessly; byte-level
+compatibility with ECDC's generated code is approximated, not verified
+(no schema registry in this environment).
+
+Payload field conventions:
+- ev44: source_name, message_id, reference_time[] (ns epoch pulse times),
+  reference_time_index[], time_of_flight[] (ns within pulse, int32),
+  pixel_id[] (int32; empty for monitors).
+- f144: source_name, value (float64 vector), timestamp (ns epoch).
+- da00: source_name, timestamp (ns), variables[] each with name, unit,
+  axes[], shape[], dtype enum, raw data bytes.
+- ad00: source_name, timestamp (ns), dtype enum, shape[], raw data.
+- x5f2: software_name/version, service_id, host_name, process_id,
+  update_interval (ms), status_json.
+- pl72 / 6s4t: run start/stop with run_name + times (ns).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+import flatbuffers
+import numpy as np
+
+__all__ = [
+    "Ad00Image",
+    "Da00Variable",
+    "Ev44Message",
+    "F144Message",
+    "RunStartMessage",
+    "RunStopMessage",
+    "X5f2Status",
+    "decode_6s4t",
+    "decode_ad00",
+    "decode_da00",
+    "decode_ev44",
+    "decode_f144",
+    "decode_pl72",
+    "decode_x5f2",
+    "encode_6s4t",
+    "encode_ad00",
+    "encode_da00",
+    "encode_ev44",
+    "encode_f144",
+    "encode_pl72",
+    "encode_x5f2",
+    "get_schema",
+]
+
+
+class WireError(ValueError):
+    """Malformed or wrong-schema buffer."""
+
+
+def get_schema(buf: bytes) -> str:
+    """4-char file identifier of a serialized message ('ev44', ...)."""
+    if len(buf) < 8:
+        raise WireError(f"Buffer too short for flatbuffer: {len(buf)} bytes")
+    try:
+        return buf[4:8].decode("ascii")
+    except UnicodeDecodeError as err:
+        raise WireError("Invalid file identifier") from err
+
+
+# ---------------------------------------------------------------------------
+# Generic vtable reader
+# ---------------------------------------------------------------------------
+
+
+class _Tbl:
+    """Minimal flatbuffers table reader (decode side only)."""
+
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes, pos: int) -> None:
+        if pos < 0 or pos + 4 > len(buf):
+            raise WireError("Table position out of range")
+        self.buf = buf
+        self.pos = pos
+
+    @classmethod
+    def root(cls, buf: bytes, expected_id: str | None = None) -> "_Tbl":
+        if len(buf) < 8:
+            raise WireError("Buffer too short")
+        if expected_id is not None and get_schema(buf) != expected_id:
+            raise WireError(
+                f"Expected schema {expected_id!r}, got {get_schema(buf)!r}"
+            )
+        (off,) = struct.unpack_from("<I", buf, 0)
+        return cls(buf, off)
+
+    def _slot(self, slot: int) -> int | None:
+        (soff,) = struct.unpack_from("<i", self.buf, self.pos)
+        vt = self.pos - soff
+        if vt < 0 or vt + 4 > len(self.buf):
+            raise WireError("Corrupt vtable offset")
+        (vt_len,) = struct.unpack_from("<H", self.buf, vt)
+        entry = 4 + slot * 2
+        if entry + 2 > vt_len:
+            return None
+        (foff,) = struct.unpack_from("<H", self.buf, vt + entry)
+        if foff == 0:
+            return None
+        return self.pos + foff
+
+    def scalar(self, slot: int, fmt: str, default=0):
+        p = self._slot(slot)
+        if p is None:
+            return default
+        return struct.unpack_from(fmt, self.buf, p)[0]
+
+    def _indirect(self, p: int) -> int:
+        (off,) = struct.unpack_from("<I", self.buf, p)
+        return p + off
+
+    def string(self, slot: int, default: str = "") -> str:
+        p = self._slot(slot)
+        if p is None:
+            return default
+        sp = self._indirect(p)
+        (n,) = struct.unpack_from("<I", self.buf, sp)
+        return bytes(self.buf[sp + 4 : sp + 4 + n]).decode("utf-8")
+
+    def vector_np(self, slot: int, dtype) -> np.ndarray:
+        p = self._slot(slot)
+        if p is None:
+            return np.empty(0, dtype=dtype)
+        vp = self._indirect(p)
+        (n,) = struct.unpack_from("<I", self.buf, vp)
+        itemsize = np.dtype(dtype).itemsize
+        end = vp + 4 + n * itemsize
+        if end > len(self.buf):
+            raise WireError("Vector extends past buffer end")
+        return np.frombuffer(self.buf, dtype=dtype, count=n, offset=vp + 4)
+
+    def table(self, slot: int) -> "_Tbl | None":
+        p = self._slot(slot)
+        if p is None:
+            return None
+        return _Tbl(self.buf, self._indirect(p))
+
+    def tables(self, slot: int) -> list["_Tbl"]:
+        p = self._slot(slot)
+        if p is None:
+            return []
+        vp = self._indirect(p)
+        (n,) = struct.unpack_from("<I", self.buf, vp)
+        out = []
+        for i in range(n):
+            ep = vp + 4 + i * 4
+            out.append(_Tbl(self.buf, self._indirect(ep)))
+        return out
+
+    def strings(self, slot: int) -> list[str]:
+        p = self._slot(slot)
+        if p is None:
+            return []
+        vp = self._indirect(p)
+        (n,) = struct.unpack_from("<I", self.buf, vp)
+        out = []
+        for i in range(n):
+            ep = vp + 4 + i * 4
+            sp = self._indirect(ep)
+            (sn,) = struct.unpack_from("<I", self.buf, sp)
+            out.append(bytes(self.buf[sp + 4 : sp + 4 + sn]).decode("utf-8"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# dtype enum shared by da00/ad00
+# ---------------------------------------------------------------------------
+
+_DTYPES: list[np.dtype] = [
+    np.dtype(np.int8),
+    np.dtype(np.int16),
+    np.dtype(np.int32),
+    np.dtype(np.int64),
+    np.dtype(np.uint8),
+    np.dtype(np.uint16),
+    np.dtype(np.uint32),
+    np.dtype(np.uint64),
+    np.dtype(np.float32),
+    np.dtype(np.float64),
+]
+_DTYPE_CODE = {dt: i for i, dt in enumerate(_DTYPES)}
+
+
+def _dtype_code(arr: np.ndarray) -> int:
+    try:
+        return _DTYPE_CODE[arr.dtype]
+    except KeyError as err:
+        raise WireError(f"Unsupported wire dtype {arr.dtype}") from err
+
+
+# ---------------------------------------------------------------------------
+# ev44 — event data
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True, slots=True)
+class Ev44Message:
+    source_name: str
+    message_id: int
+    reference_time: np.ndarray  # int64 ns epoch
+    reference_time_index: np.ndarray  # int32
+    time_of_flight: np.ndarray  # int32 ns within pulse
+    pixel_id: np.ndarray  # int32; empty for monitor events
+
+
+def encode_ev44(
+    source_name: str,
+    message_id: int,
+    reference_time: np.ndarray,
+    reference_time_index: np.ndarray,
+    time_of_flight: np.ndarray,
+    pixel_id: np.ndarray | None = None,
+) -> bytes:
+    b = flatbuffers.Builder(1024)
+    pid_off = None
+    if pixel_id is not None and len(pixel_id) > 0:
+        pid_off = b.CreateNumpyVector(np.ascontiguousarray(pixel_id, np.int32))
+    tof_off = b.CreateNumpyVector(np.ascontiguousarray(time_of_flight, np.int32))
+    rti_off = b.CreateNumpyVector(
+        np.ascontiguousarray(reference_time_index, np.int32)
+    )
+    rt_off = b.CreateNumpyVector(np.ascontiguousarray(reference_time, np.int64))
+    src_off = b.CreateString(source_name)
+    b.StartObject(6)
+    b.PrependUOffsetTRelativeSlot(0, src_off, 0)
+    b.PrependInt64Slot(1, message_id, 0)
+    b.PrependUOffsetTRelativeSlot(2, rt_off, 0)
+    b.PrependUOffsetTRelativeSlot(3, rti_off, 0)
+    b.PrependUOffsetTRelativeSlot(4, tof_off, 0)
+    if pid_off is not None:
+        b.PrependUOffsetTRelativeSlot(5, pid_off, 0)
+    b.Finish(b.EndObject(), file_identifier=b"ev44")
+    return bytes(b.Output())
+
+
+def decode_ev44(buf: bytes) -> Ev44Message:
+    t = _Tbl.root(buf, "ev44")
+    return Ev44Message(
+        source_name=t.string(0),
+        message_id=t.scalar(1, "<q"),
+        reference_time=t.vector_np(2, np.int64),
+        reference_time_index=t.vector_np(3, np.int32),
+        time_of_flight=t.vector_np(4, np.int32),
+        pixel_id=t.vector_np(5, np.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# f144 — log data
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True, slots=True)
+class F144Message:
+    source_name: str
+    value: np.ndarray  # float64
+    timestamp_ns: int
+
+
+def encode_f144(source_name: str, value, timestamp_ns: int) -> bytes:
+    b = flatbuffers.Builder(256)
+    val = np.atleast_1d(np.asarray(value, dtype=np.float64))
+    v_off = b.CreateNumpyVector(val)
+    src_off = b.CreateString(source_name)
+    b.StartObject(3)
+    b.PrependUOffsetTRelativeSlot(0, src_off, 0)
+    b.PrependUOffsetTRelativeSlot(1, v_off, 0)
+    b.PrependInt64Slot(2, timestamp_ns, 0)
+    b.Finish(b.EndObject(), file_identifier=b"f144")
+    return bytes(b.Output())
+
+
+def decode_f144(buf: bytes) -> F144Message:
+    t = _Tbl.root(buf, "f144")
+    return F144Message(
+        source_name=t.string(0),
+        value=t.vector_np(1, np.float64),
+        timestamp_ns=t.scalar(2, "<q"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# da00 — labeled data arrays
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True, slots=True)
+class Da00Variable:
+    name: str
+    unit: str
+    axes: tuple[str, ...]
+    data: np.ndarray  # shaped
+
+
+@dataclass(frozen=True, slots=True)
+class Da00Message:
+    source_name: str
+    timestamp_ns: int
+    variables: list[Da00Variable] = field(default_factory=list)
+
+
+def _encode_da00_variable(b: flatbuffers.Builder, var: Da00Variable) -> int:
+    data = np.ascontiguousarray(var.data)
+    code = _dtype_code(data)
+    data_off = b.CreateNumpyVector(data.reshape(-1).view(np.uint8))
+    shape_off = b.CreateNumpyVector(np.asarray(data.shape, dtype=np.int32))
+    axes_offs = [b.CreateString(a) for a in var.axes]
+    b.StartVector(4, len(axes_offs), 4)
+    for off in reversed(axes_offs):
+        b.PrependUOffsetTRelative(off)
+    axes_vec = b.EndVector()
+    unit_off = b.CreateString(var.unit)
+    name_off = b.CreateString(var.name)
+    b.StartObject(6)
+    b.PrependUOffsetTRelativeSlot(0, name_off, 0)
+    b.PrependUOffsetTRelativeSlot(1, unit_off, 0)
+    b.PrependUOffsetTRelativeSlot(2, axes_vec, 0)
+    b.PrependUOffsetTRelativeSlot(3, shape_off, 0)
+    b.PrependInt8Slot(4, code, 0)
+    b.PrependUOffsetTRelativeSlot(5, data_off, 0)
+    return b.EndObject()
+
+
+def encode_da00(
+    source_name: str, timestamp_ns: int, variables: list[Da00Variable]
+) -> bytes:
+    b = flatbuffers.Builder(4096)
+    var_offs = [_encode_da00_variable(b, v) for v in variables]
+    b.StartVector(4, len(var_offs), 4)
+    for off in reversed(var_offs):
+        b.PrependUOffsetTRelative(off)
+    vars_vec = b.EndVector()
+    src_off = b.CreateString(source_name)
+    b.StartObject(3)
+    b.PrependUOffsetTRelativeSlot(0, src_off, 0)
+    b.PrependInt64Slot(1, timestamp_ns, 0)
+    b.PrependUOffsetTRelativeSlot(2, vars_vec, 0)
+    b.Finish(b.EndObject(), file_identifier=b"da00")
+    return bytes(b.Output())
+
+
+def _decode_da00_variable(t: _Tbl) -> Da00Variable:
+    code = t.scalar(4, "<b")
+    if not 0 <= code < len(_DTYPES):
+        raise WireError(f"Bad dtype code {code}")
+    dtype = _DTYPES[code]
+    shape = tuple(int(s) for s in t.vector_np(3, np.int32))
+    raw = t.vector_np(5, np.uint8)
+    n_items = int(np.prod(shape)) if shape else raw.size // dtype.itemsize
+    data = raw.view(dtype)[:n_items].reshape(shape)
+    return Da00Variable(
+        name=t.string(0), unit=t.string(1), axes=tuple(t.strings(2)), data=data
+    )
+
+
+def decode_da00(buf: bytes) -> Da00Message:
+    t = _Tbl.root(buf, "da00")
+    return Da00Message(
+        source_name=t.string(0),
+        timestamp_ns=t.scalar(1, "<q"),
+        variables=[_decode_da00_variable(v) for v in t.tables(2)],
+    )
+
+
+# ---------------------------------------------------------------------------
+# ad00 — area detector images
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True, slots=True)
+class Ad00Image:
+    source_name: str
+    timestamp_ns: int
+    data: np.ndarray  # 2-D
+
+
+def encode_ad00(source_name: str, timestamp_ns: int, data: np.ndarray) -> bytes:
+    data = np.ascontiguousarray(data)
+    b = flatbuffers.Builder(4096)
+    code = _dtype_code(data)
+    data_off = b.CreateNumpyVector(data.reshape(-1).view(np.uint8))
+    shape_off = b.CreateNumpyVector(np.asarray(data.shape, dtype=np.int32))
+    src_off = b.CreateString(source_name)
+    b.StartObject(5)
+    b.PrependUOffsetTRelativeSlot(0, src_off, 0)
+    b.PrependInt64Slot(1, timestamp_ns, 0)
+    b.PrependInt8Slot(2, code, 0)
+    b.PrependUOffsetTRelativeSlot(3, shape_off, 0)
+    b.PrependUOffsetTRelativeSlot(4, data_off, 0)
+    b.Finish(b.EndObject(), file_identifier=b"ad00")
+    return bytes(b.Output())
+
+
+def decode_ad00(buf: bytes) -> Ad00Image:
+    t = _Tbl.root(buf, "ad00")
+    code = t.scalar(2, "<b")
+    if not 0 <= code < len(_DTYPES):
+        raise WireError(f"Bad dtype code {code}")
+    dtype = _DTYPES[code]
+    shape = tuple(int(s) for s in t.vector_np(3, np.int32))
+    raw = t.vector_np(4, np.uint8)
+    n_items = int(np.prod(shape)) if shape else 0
+    if raw.size < n_items * dtype.itemsize:
+        raise WireError("ad00 data shorter than shape implies")
+    return Ad00Image(
+        source_name=t.string(0),
+        timestamp_ns=t.scalar(1, "<q"),
+        data=raw.view(dtype)[:n_items].reshape(shape),
+    )
+
+
+# ---------------------------------------------------------------------------
+# x5f2 — status heartbeats
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True, slots=True)
+class X5f2Status:
+    software_name: str
+    software_version: str
+    service_id: str
+    host_name: str
+    process_id: int
+    update_interval_ms: int
+    status_json: str
+
+
+def encode_x5f2(status: X5f2Status) -> bytes:
+    b = flatbuffers.Builder(512)
+    js_off = b.CreateString(status.status_json)
+    host_off = b.CreateString(status.host_name)
+    sid_off = b.CreateString(status.service_id)
+    ver_off = b.CreateString(status.software_version)
+    name_off = b.CreateString(status.software_name)
+    b.StartObject(7)
+    b.PrependUOffsetTRelativeSlot(0, name_off, 0)
+    b.PrependUOffsetTRelativeSlot(1, ver_off, 0)
+    b.PrependUOffsetTRelativeSlot(2, sid_off, 0)
+    b.PrependUOffsetTRelativeSlot(3, host_off, 0)
+    b.PrependInt32Slot(4, status.process_id, 0)
+    b.PrependInt32Slot(5, status.update_interval_ms, 0)
+    b.PrependUOffsetTRelativeSlot(6, js_off, 0)
+    b.Finish(b.EndObject(), file_identifier=b"x5f2")
+    return bytes(b.Output())
+
+
+def decode_x5f2(buf: bytes) -> X5f2Status:
+    t = _Tbl.root(buf, "x5f2")
+    return X5f2Status(
+        software_name=t.string(0),
+        software_version=t.string(1),
+        service_id=t.string(2),
+        host_name=t.string(3),
+        process_id=t.scalar(4, "<i"),
+        update_interval_ms=t.scalar(5, "<i"),
+        status_json=t.string(6),
+    )
+
+
+# ---------------------------------------------------------------------------
+# pl72 / 6s4t — run start/stop
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True, slots=True)
+class RunStartMessage:
+    run_name: str
+    instrument_name: str
+    start_time_ns: int
+    stop_time_ns: int  # 0 = open-ended
+
+
+@dataclass(frozen=True, slots=True)
+class RunStopMessage:
+    run_name: str
+    stop_time_ns: int
+
+
+def encode_pl72(msg: RunStartMessage) -> bytes:
+    b = flatbuffers.Builder(256)
+    inst_off = b.CreateString(msg.instrument_name)
+    run_off = b.CreateString(msg.run_name)
+    b.StartObject(4)
+    b.PrependUOffsetTRelativeSlot(0, run_off, 0)
+    b.PrependUOffsetTRelativeSlot(1, inst_off, 0)
+    b.PrependInt64Slot(2, msg.start_time_ns, 0)
+    b.PrependInt64Slot(3, msg.stop_time_ns, 0)
+    b.Finish(b.EndObject(), file_identifier=b"pl72")
+    return bytes(b.Output())
+
+
+def decode_pl72(buf: bytes) -> RunStartMessage:
+    t = _Tbl.root(buf, "pl72")
+    return RunStartMessage(
+        run_name=t.string(0),
+        instrument_name=t.string(1),
+        start_time_ns=t.scalar(2, "<q"),
+        stop_time_ns=t.scalar(3, "<q"),
+    )
+
+
+def encode_6s4t(msg: RunStopMessage) -> bytes:
+    b = flatbuffers.Builder(128)
+    run_off = b.CreateString(msg.run_name)
+    b.StartObject(2)
+    b.PrependUOffsetTRelativeSlot(0, run_off, 0)
+    b.PrependInt64Slot(1, msg.stop_time_ns, 0)
+    b.Finish(b.EndObject(), file_identifier=b"6s4t")
+    return bytes(b.Output())
+
+
+def decode_6s4t(buf: bytes) -> RunStopMessage:
+    t = _Tbl.root(buf, "6s4t")
+    return RunStopMessage(run_name=t.string(0), stop_time_ns=t.scalar(1, "<q"))

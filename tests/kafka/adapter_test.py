@@ -1,0 +1,193 @@
+import json
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.config import JobId, WorkflowConfig, WorkflowId
+from esslivedata_tpu.core.message import StreamKind
+from esslivedata_tpu.kafka import wire
+from esslivedata_tpu.kafka.message_adapter import (
+    AdaptingMessageSource,
+    CommandsAdapter,
+    KafkaToAd00Adapter,
+    KafkaToDa00Adapter,
+    KafkaToDetectorEventsAdapter,
+    KafkaToF144Adapter,
+    KafkaToMonitorEventsAdapter,
+    KafkaToRunControlAdapter,
+    RouteBySchemaAdapter,
+    RouteByTopicAdapter,
+)
+from esslivedata_tpu.kafka.source import FakeConsumer, FakeKafkaMessage, KafkaMessageSource
+from esslivedata_tpu.kafka.stream_mapping import InputStreamKey, StreamMapping
+from esslivedata_tpu.preprocessors import DetectorEvents, MonitorEvents
+
+
+@pytest.fixture
+def mapping():
+    return StreamMapping(
+        instrument="dummy",
+        detectors={
+            InputStreamKey(topic="dummy_detector", source_name="panel_a"): "bank0"
+        },
+        monitors={
+            InputStreamKey(topic="dummy_monitor", source_name="mon_src"): "mon0"
+        },
+        area_detectors={
+            InputStreamKey(topic="dummy_camera", source_name="cam"): "camera0"
+        },
+        logs={InputStreamKey(topic="dummy_motion", source_name="mtr1"): "motor_x"},
+        run_control_topics=("dummy_runInfo",),
+    )
+
+
+def ev44_msg(topic="dummy_detector", source="panel_a", pixels=True):
+    buf = wire.encode_ev44(
+        source,
+        7,
+        reference_time=np.array([1_000_000], dtype=np.int64),
+        reference_time_index=np.array([0], dtype=np.int32),
+        time_of_flight=np.array([10, 20], dtype=np.int32),
+        pixel_id=np.array([1, 2], dtype=np.int32) if pixels else None,
+    )
+    return FakeKafkaMessage(buf, topic)
+
+
+class TestDetectorAdapter:
+    def test_adapt(self, mapping):
+        msg = KafkaToDetectorEventsAdapter(mapping).adapt(ev44_msg())
+        assert msg.stream.kind == StreamKind.DETECTOR_EVENTS
+        assert msg.stream.name == "bank0"
+        assert msg.timestamp.ns == 1_000_000
+        assert isinstance(msg.value, DetectorEvents)
+        assert msg.value.time_of_arrival.dtype == np.float32
+
+    def test_unmapped_source_dropped(self, mapping):
+        msg = KafkaToDetectorEventsAdapter(mapping).adapt(
+            ev44_msg(source="unknown_panel")
+        )
+        assert msg is None
+
+
+class TestMonitorAdapter:
+    def test_fast_path_no_pixels(self, mapping):
+        msg = KafkaToMonitorEventsAdapter(mapping).adapt(
+            ev44_msg(topic="dummy_monitor", source="mon_src", pixels=False)
+        )
+        assert msg.stream.name == "mon0"
+        assert isinstance(msg.value, MonitorEvents)
+
+
+class TestF144Adapter:
+    def test_mapped_log(self, mapping):
+        buf = wire.encode_f144("mtr1", 5.5, 42)
+        msg = KafkaToF144Adapter(mapping).adapt(FakeKafkaMessage(buf, "dummy_motion"))
+        assert msg.stream.name == "motor_x"
+        assert msg.value.value == 5.5
+        assert msg.timestamp.ns == 42
+
+    def test_unmapped_log_uses_source_name(self, mapping):
+        buf = wire.encode_f144("other_sensor", 1.0, 1)
+        msg = KafkaToF144Adapter(mapping).adapt(FakeKafkaMessage(buf, "dummy_motion"))
+        assert msg.stream.name == "other_sensor"
+
+
+class TestAd00Adapter:
+    def test_adapt(self, mapping):
+        buf = wire.encode_ad00("cam", 5, np.ones((2, 2), dtype=np.float32))
+        msg = KafkaToAd00Adapter(mapping).adapt(FakeKafkaMessage(buf, "dummy_camera"))
+        assert msg.stream.kind == StreamKind.AREA_DETECTOR
+        assert msg.value.shape == (2, 2)
+
+
+class TestRunControl:
+    def test_pl72(self):
+        buf = wire.encode_pl72(
+            wire.RunStartMessage(
+                run_name="r1", instrument_name="dummy", start_time_ns=5, stop_time_ns=0
+            )
+        )
+        msg = KafkaToRunControlAdapter().adapt(FakeKafkaMessage(buf, "dummy_runInfo"))
+        assert msg.value.run_name == "r1"
+        assert msg.value.stop_time is None
+
+    def test_6s4t(self):
+        buf = wire.encode_6s4t(wire.RunStopMessage(run_name="r1", stop_time_ns=9))
+        msg = KafkaToRunControlAdapter().adapt(FakeKafkaMessage(buf, "dummy_runInfo"))
+        assert msg.value.stop_time.ns == 9
+
+
+class TestCommandsAdapter:
+    def test_start_job(self):
+        config = WorkflowConfig(
+            identifier=WorkflowId(instrument="dummy", name="view"),
+            job_id=JobId(source_name="bank0"),
+        )
+        payload = json.dumps(
+            {"kind": "start_job", "config": config.model_dump(mode="json")}
+        ).encode()
+        msg = CommandsAdapter().adapt(FakeKafkaMessage(payload, "cmds"))
+        assert isinstance(msg.value, WorkflowConfig)
+        assert msg.value.job_id.source_name == "bank0"
+
+    def test_unknown_kind_raises(self):
+        payload = json.dumps({"kind": "frobnicate"}).encode()
+        with pytest.raises(ValueError):
+            CommandsAdapter().adapt(FakeKafkaMessage(payload, "cmds"))
+
+
+class TestRouting:
+    def make_routed(self, mapping):
+        by_schema = RouteBySchemaAdapter(
+            {
+                "ev44": KafkaToDetectorEventsAdapter(mapping),
+                "f144": KafkaToF144Adapter(mapping),
+            }
+        )
+        return RouteByTopicAdapter(
+            {
+                "dummy_detector": by_schema,
+                "dummy_motion": KafkaToF144Adapter(mapping),
+                "dummy_monitor": KafkaToMonitorEventsAdapter(mapping),
+            }
+        )
+
+    def test_routes(self, mapping):
+        router = self.make_routed(mapping)
+        out = router.adapt(ev44_msg())
+        assert out.stream.name == "bank0"
+        buf = wire.encode_f144("mtr1", 1.0, 1)
+        out2 = router.adapt(FakeKafkaMessage(buf, "dummy_motion"))
+        assert out2.stream.name == "motor_x"
+
+    def test_adapting_source_contains_errors(self, mapping):
+        router = self.make_routed(mapping)
+        consumer = FakeConsumer(
+            [
+                [
+                    ev44_msg(),
+                    FakeKafkaMessage(b"garbage!", "dummy_detector"),  # hostile
+                    FakeKafkaMessage(b"12345678", "unknown_topic"),  # unrouted
+                    ev44_msg(),
+                ]
+            ]
+        )
+        source = AdaptingMessageSource(KafkaMessageSource(consumer), router)
+        messages = source.get_messages()
+        assert len(messages) == 2
+        # b"garbage!" decodes to an unknown 4-char schema -> unrouted;
+        # the unknown topic is unrouted too. Both are contained drops.
+        assert source.error_count + source.unrouted_count == 2
+
+    def test_source_stays_alive_on_hostile_storm(self, mapping):
+        router = self.make_routed(mapping)
+        hostile = [
+            FakeKafkaMessage(bytes([i % 256] * (i % 64)), "dummy_detector")
+            for i in range(200)
+        ]
+        # two consume batches: KafkaMessageSource caps at 100 messages/poll
+        consumer = FakeConsumer([hostile[:100], hostile[100:]])
+        source = AdaptingMessageSource(KafkaMessageSource(consumer), router)
+        assert source.get_messages() == []
+        assert source.get_messages() == []
+        assert source.error_count + source.unrouted_count == 200

@@ -1,0 +1,173 @@
+import time
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.core.message import Message, StreamId, StreamKind
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.kafka import wire
+from esslivedata_tpu.kafka.sink import (
+    FakeProducer,
+    KafkaSink,
+    UnrollingSinkAdapter,
+    make_default_serializer,
+)
+from esslivedata_tpu.kafka.source import (
+    BackgroundMessageSource,
+    ConsumerHealth,
+    FakeConsumer,
+    FakeKafkaMessage,
+)
+from esslivedata_tpu.kafka.stream_mapping import LivedataTopics
+from esslivedata_tpu.utils import DataArray, Variable, linspace
+
+
+class FailingConsumer:
+    def __init__(self, fail_times: int, then: list) -> None:
+        self.fail_times = fail_times
+        self.then = list(then)
+
+    def consume(self, num_messages, timeout):
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise RuntimeError("broker down")
+        return self.then.pop(0) if self.then else []
+
+
+class TestBackgroundSource:
+    def test_drains_in_order(self):
+        msgs = [FakeKafkaMessage(b"x", "t") for _ in range(5)]
+        consumer = FakeConsumer([msgs[:2], msgs[2:]])
+        with BackgroundMessageSource(consumer, timeout_s=0.001) as source:
+            deadline = time.monotonic() + 2.0
+            got = []
+            while len(got) < 5 and time.monotonic() < deadline:
+                got.extend(source.get_messages())
+                time.sleep(0.01)
+        assert got == msgs
+
+    def test_circuit_breaker_opens(self):
+        consumer = FailingConsumer(fail_times=1000, then=[])
+        source = BackgroundMessageSource(
+            consumer, timeout_s=0.001, max_consecutive_errors=3
+        )
+        source.start()
+        deadline = time.monotonic() + 5.0
+        while source.health != ConsumerHealth.STOPPED and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert source.health == ConsumerHealth.STOPPED
+        with pytest.raises(RuntimeError, match="circuit breaker"):
+            source.get_messages()
+        source.stop()
+
+    def test_transient_errors_recover(self):
+        consumer = FailingConsumer(
+            fail_times=2, then=[[FakeKafkaMessage(b"ok", "t")]]
+        )
+        with BackgroundMessageSource(
+            consumer, timeout_s=0.001, max_consecutive_errors=10
+        ) as source:
+            deadline = time.monotonic() + 3.0
+            got = []
+            while not got and time.monotonic() < deadline:
+                got = source.get_messages()
+                time.sleep(0.01)
+        assert len(got) == 1
+
+    def test_queue_bounded_drop_oldest(self):
+        batches = [[FakeKafkaMessage(str(i).encode(), "t")] for i in range(20)]
+        consumer = FakeConsumer(batches)
+        source = BackgroundMessageSource(
+            consumer, timeout_s=0.0, max_queued_batches=5
+        )
+        source.start()
+        deadline = time.monotonic() + 2.0
+        while consumer._batches and time.monotonic() < deadline:
+            time.sleep(0.01)
+        source.stop()
+        remaining = source.get_messages()
+        assert len(remaining) <= 5
+        assert source.metrics["dropped_batches"] >= 15
+
+
+def hist_message(name="bank0/image_current"):
+    da = DataArray(
+        Variable(np.arange(4.0).reshape(2, 2), ("y", "x"), "counts"),
+        coords={"x": linspace("x", 0, 2, 3, "mm"), "y": linspace("y", 0, 2, 3, "mm")},
+    )
+    return Message(
+        timestamp=Timestamp.from_ns(123),
+        stream=StreamId(kind=StreamKind.LIVEDATA_DATA, name=name),
+        value=da,
+    )
+
+
+class TestKafkaSink:
+    def test_publishes_da00(self):
+        producer = FakeProducer()
+        topics = LivedataTopics.for_instrument("dummy")
+        sink = KafkaSink(producer, make_default_serializer(topics))
+        sink.publish_messages([hist_message()])
+        [sent] = producer.messages
+        assert sent.topic == "dummy_livedata_data"
+        da00 = wire.decode_da00(sent.value)
+        assert da00.source_name == "bank0/image_current"
+        assert wire.get_schema(sent.value) == "da00"
+
+    def test_drop_on_buffer_error(self):
+        producer = FakeProducer(buffer_errors=1)
+        topics = LivedataTopics.for_instrument("dummy")
+        sink = KafkaSink(producer, make_default_serializer(topics))
+        sink.publish_messages([hist_message(), hist_message()])
+        assert sink.dropped == 1
+        assert len(producer.messages) == 1
+
+    def test_serialize_error_contained(self):
+        producer = FakeProducer()
+        topics = LivedataTopics.for_instrument("dummy")
+        sink = KafkaSink(producer, make_default_serializer(topics))
+        bad = Message(
+            timestamp=Timestamp.from_ns(1),
+            stream=StreamId(kind=StreamKind.LIVEDATA_DATA, name="x"),
+            value=object(),  # unserializable
+        )
+        sink.publish_messages([bad, hist_message()])
+        assert sink.serialize_errors == 1
+        assert len(producer.messages) == 1
+
+    def test_unrolling_adapter(self):
+        producer = FakeProducer()
+        topics = LivedataTopics.for_instrument("dummy")
+        sink = UnrollingSinkAdapter(KafkaSink(producer, make_default_serializer(topics)))
+        da = hist_message().value
+        group = Message(
+            timestamp=Timestamp.from_ns(5),
+            stream=StreamId(kind=StreamKind.LIVEDATA_DATA, name="job1"),
+            value={"image": da, "counts": da},
+        )
+        sink.publish_messages([group])
+        names = {wire.decode_da00(m.value).source_name for m in producer.messages}
+        assert names == {"job1/image", "job1/counts"}
+
+    def test_status_x5f2(self):
+        from pydantic import BaseModel
+
+        class ServiceStatus(BaseModel):
+            state: str = "running"
+
+        producer = FakeProducer()
+        topics = LivedataTopics.for_instrument("dummy")
+        sink = KafkaSink(producer, make_default_serializer(topics, "svc1"))
+        sink.publish_messages(
+            [
+                Message(
+                    timestamp=Timestamp.from_ns(1),
+                    stream=StreamId(kind=StreamKind.LIVEDATA_STATUS, name=""),
+                    value=ServiceStatus(),
+                )
+            ]
+        )
+        [sent] = producer.messages
+        status = wire.decode_x5f2(sent.value)
+        assert status.service_id == "svc1"
+        assert '"running"' in status.status_json

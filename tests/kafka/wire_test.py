@@ -1,0 +1,197 @@
+import numpy as np
+import pytest
+
+from esslivedata_tpu.kafka import wire
+from esslivedata_tpu.kafka.da00_compat import da00_to_dataarray, dataarray_to_da00
+from esslivedata_tpu.utils import DataArray, Variable, linspace
+
+
+class TestEv44:
+    def test_roundtrip(self):
+        buf = wire.encode_ev44(
+            "bank0",
+            42,
+            reference_time=np.array([1_000, 2_000], dtype=np.int64),
+            reference_time_index=np.array([0, 3], dtype=np.int32),
+            time_of_flight=np.array([10, 20, 30, 40, 50], dtype=np.int32),
+            pixel_id=np.array([1, 2, 3, 4, 5], dtype=np.int32),
+        )
+        assert wire.get_schema(buf) == "ev44"
+        ev = wire.decode_ev44(buf)
+        assert ev.source_name == "bank0"
+        assert ev.message_id == 42
+        np.testing.assert_array_equal(ev.reference_time, [1000, 2000])
+        np.testing.assert_array_equal(ev.time_of_flight, [10, 20, 30, 40, 50])
+        np.testing.assert_array_equal(ev.pixel_id, [1, 2, 3, 4, 5])
+
+    def test_monitor_no_pixels(self):
+        buf = wire.encode_ev44(
+            "mon0",
+            1,
+            reference_time=np.array([5], dtype=np.int64),
+            reference_time_index=np.array([0], dtype=np.int32),
+            time_of_flight=np.array([7, 8], dtype=np.int32),
+        )
+        ev = wire.decode_ev44(buf)
+        assert ev.pixel_id.size == 0
+        assert ev.time_of_flight.size == 2
+
+    def test_decode_is_zero_copy(self):
+        buf = wire.encode_ev44(
+            "b",
+            1,
+            reference_time=np.array([5], dtype=np.int64),
+            reference_time_index=np.array([0], dtype=np.int32),
+            time_of_flight=np.arange(100, dtype=np.int32),
+        )
+        ev = wire.decode_ev44(buf)
+        assert ev.time_of_flight.base is not None  # view into the buffer
+
+    def test_wrong_schema_raises(self):
+        buf = wire.encode_f144("x", 1.0, 2)
+        with pytest.raises(wire.WireError):
+            wire.decode_ev44(buf)
+
+
+class TestF144:
+    def test_scalar_roundtrip(self):
+        buf = wire.encode_f144("temp_sensor", 273.5, 123456789)
+        f = wire.decode_f144(buf)
+        assert f.source_name == "temp_sensor"
+        assert f.timestamp_ns == 123456789
+        np.testing.assert_allclose(f.value, [273.5])
+
+    def test_array_roundtrip(self):
+        buf = wire.encode_f144("multi", np.array([1.0, 2.0, 3.0]), 1)
+        np.testing.assert_allclose(wire.decode_f144(buf).value, [1, 2, 3])
+
+
+class TestDa00:
+    def test_variable_roundtrip(self):
+        v = wire.Da00Variable(
+            name="signal",
+            unit="counts",
+            axes=("y", "x"),
+            data=np.arange(6, dtype=np.float32).reshape(2, 3),
+        )
+        buf = wire.encode_da00("result0", 999, [v])
+        da00 = wire.decode_da00(buf)
+        assert da00.source_name == "result0"
+        assert da00.timestamp_ns == 999
+        [got] = da00.variables
+        assert got.name == "signal"
+        assert got.axes == ("y", "x")
+        np.testing.assert_array_equal(got.data, v.data)
+
+    def test_dataarray_roundtrip_with_edges_and_masks(self):
+        da = DataArray(
+            Variable(np.arange(12.0).reshape(3, 4), ("y", "x"), "counts"),
+            coords={
+                "x": linspace("x", 0.0, 4.0, 5, "mm"),
+                "y": linspace("y", 0.0, 3.0, 4, "mm"),
+            },
+            masks={"bad": Variable(np.zeros((3, 4), dtype=bool), ("y", "x"), None)},
+            name="hist",
+        )
+        variables = dataarray_to_da00(da)
+        buf = wire.encode_da00("src", 5, variables)
+        restored = da00_to_dataarray(wire.decode_da00(buf).variables, name="hist")
+        assert restored.dims == da.dims
+        assert restored.unit == da.unit
+        np.testing.assert_array_equal(restored.values, da.values)
+        np.testing.assert_array_equal(
+            restored.coords["x"].numpy, da.coords["x"].numpy
+        )
+        assert repr(restored.coords["x"].unit) == "mm"
+        assert "bad" in restored.masks
+        assert restored.is_edges("x")
+
+    def test_unknown_unit_contained(self):
+        v = wire.Da00Variable(
+            name="signal", unit="banana", axes=("x",), data=np.ones(3)
+        )
+        da = da00_to_dataarray([v])
+        assert da.unit.is_dimensionless
+
+
+class TestAd00:
+    def test_roundtrip(self):
+        img = np.arange(12, dtype=np.uint16).reshape(3, 4)
+        buf = wire.encode_ad00("cam0", 777, img)
+        out = wire.decode_ad00(buf)
+        assert out.source_name == "cam0"
+        np.testing.assert_array_equal(out.data, img)
+        assert out.data.dtype == np.uint16
+
+
+class TestX5f2:
+    def test_roundtrip(self):
+        st = wire.X5f2Status(
+            software_name="esslivedata-tpu",
+            software_version="0.1.0",
+            service_id="loki_detector",
+            host_name="node1",
+            process_id=1234,
+            update_interval_ms=2000,
+            status_json='{"state": "running"}',
+        )
+        out = wire.decode_x5f2(wire.encode_x5f2(st))
+        assert out == st
+
+
+class TestRunControl:
+    def test_pl72_roundtrip(self):
+        msg = wire.RunStartMessage(
+            run_name="run7", instrument_name="loki", start_time_ns=10, stop_time_ns=0
+        )
+        assert wire.decode_pl72(wire.encode_pl72(msg)) == msg
+
+    def test_6s4t_roundtrip(self):
+        msg = wire.RunStopMessage(run_name="run7", stop_time_ns=99)
+        assert wire.decode_6s4t(wire.encode_6s4t(msg)) == msg
+
+
+def struct_error_types():
+    import struct
+
+    return struct.error
+
+
+class TestHostileWire:
+    """Adversarial payloads must raise WireError-ish, never crash the
+    process (reference: tests/helpers/hostile_wire.py corpus)."""
+
+    CORPUS = [
+        b"",
+        b"\x00",
+        b"1234567",
+        b"\xff" * 8,
+        b"\x00\x00\x00\x00ev44",
+        b"\xff\xff\xff\xffev44" + b"\x00" * 100,
+        b"\x10\x00\x00\x00ev44" + b"\xff" * 4,
+    ]
+
+    @pytest.mark.parametrize("buf", CORPUS)
+    def test_ev44_contained(self, buf):
+        # Garbage must either raise a normal exception (contained by the
+        # adapter layer) or decode benignly (empty defaults) — it must never
+        # kill the process or allocate unboundedly.
+        try:
+            ev = wire.decode_ev44(buf)
+            total = ev.time_of_flight.sum() + ev.pixel_id.sum()
+            assert np.isfinite(float(total))
+        except (wire.WireError, ValueError, struct_error_types()):
+            pass
+
+    def test_truncated_real_message(self):
+        buf = wire.encode_ev44(
+            "b",
+            1,
+            reference_time=np.array([5], dtype=np.int64),
+            reference_time_index=np.array([0], dtype=np.int32),
+            time_of_flight=np.arange(1000, dtype=np.int32),
+        )
+        for cut in (9, 20, len(buf) // 2):
+            with pytest.raises(Exception):
+                ev = wire.decode_ev44(bytes(buf[:cut]))
+                _ = ev.time_of_flight.sum()
