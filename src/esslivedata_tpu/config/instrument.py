@@ -1,0 +1,136 @@
+"""Instrument composition root + registry.
+
+Parity with reference ``config/instrument.py`` (Instrument:108,
+InstrumentRegistry:86): the per-instrument declaration of detectors (with
+detector_number layouts or 3-D positions), monitors, log/device streams and
+workflow specs, plus lazy ``load_factories`` so light spec metadata is
+importable everywhere while heavy factory construction (projection tables,
+kernel instantiation) happens only inside services that run them.
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = [
+    "DetectorConfig",
+    "Instrument",
+    "InstrumentRegistry",
+    "MonitorConfig",
+    "instrument_registry",
+]
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class DetectorConfig:
+    """One detector bank and how to view it."""
+
+    name: str  # canonical stream name, e.g. 'bank0'
+    source_name: str  # ECDC source name on the wire
+    detector_number: np.ndarray | None = None  # logical [ny, nx] grid
+    positions: np.ndarray | None = None  # geometric [n, 3]
+    pixel_ids: np.ndarray | None = None  # ids matching positions rows
+    projection: str = "logical"  # 'logical' | 'xy_plane' | 'cylinder_mantle_z'
+    resolution: tuple[int, int] = (128, 128)
+    noise_sigma: float = 0.0
+    n_replica: int = 1
+
+    def __post_init__(self) -> None:
+        if self.detector_number is None and self.positions is None:
+            raise ValueError(f"Detector {self.name}: need a layout or positions")
+
+
+@dataclass
+class MonitorConfig:
+    name: str
+    source_name: str
+
+
+@dataclass
+class Instrument:
+    name: str
+    detectors: dict[str, DetectorConfig] = field(default_factory=dict)
+    monitors: dict[str, MonitorConfig] = field(default_factory=dict)
+    log_sources: dict[str, str] = field(default_factory=dict)  # stream -> source
+    _factories_module: str | None = None
+    _specs_module: str | None = None
+    _loaded: bool = field(default=False, repr=False)
+
+    def add_detector(self, config: DetectorConfig) -> None:
+        self.detectors[config.name] = config
+
+    def add_monitor(self, config: MonitorConfig) -> None:
+        self.monitors[config.name] = config
+
+    def add_log(self, stream_name: str, source_name: str | None = None) -> None:
+        self.log_sources[stream_name] = source_name or stream_name
+
+    @property
+    def detector_names(self) -> list[str]:
+        return sorted(self.detectors)
+
+    @property
+    def monitor_names(self) -> list[str]:
+        return sorted(self.monitors)
+
+    def load_factories(self) -> None:
+        """Import the heavy factory module, attaching workflow factories to
+        the registry (reference instrument.py:654 lazy loading)."""
+        if self._loaded:
+            return
+        self._loaded = True
+        if self._factories_module:
+            importlib.import_module(self._factories_module)
+
+
+class InstrumentRegistry:
+    def __init__(self) -> None:
+        self._instruments: dict[str, Instrument] = {}
+        self._lock = threading.Lock()
+
+    def register(self, instrument: Instrument) -> Instrument:
+        with self._lock:
+            if instrument.name in self._instruments:
+                raise ValueError(f"Instrument {instrument.name} already registered")
+            self._instruments[instrument.name] = instrument
+        return instrument
+
+    def __getitem__(self, name: str) -> Instrument:
+        self._ensure_builtin(name)
+        return self._instruments[name]
+
+    def __contains__(self, name: str) -> bool:
+        self._ensure_builtin(name)
+        return name in self._instruments
+
+    def names(self) -> list[str]:
+        """All registered + built-in instrument names (built-ins are
+        discovered from the instruments package without importing them)."""
+        import pkgutil
+
+        from . import instruments as _pkg
+
+        builtin = {
+            m.name for m in pkgutil.iter_modules(_pkg.__path__) if m.ispkg
+        }
+        return sorted(set(self._instruments) | builtin)
+
+    def _ensure_builtin(self, name: str) -> None:
+        """Import built-in instrument packages on first access."""
+        if name in self._instruments:
+            return
+        try:
+            importlib.import_module(f"esslivedata_tpu.config.instruments.{name}")
+        except ModuleNotFoundError:
+            pass
+
+
+instrument_registry = InstrumentRegistry()
+"""Process-wide registry (reference: instrument.py:86)."""

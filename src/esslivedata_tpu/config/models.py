@@ -70,6 +70,14 @@ class PolygonROI(BaseModel):
 ROI = RectangleROI | PolygonROI
 
 
+from ..core.constants import PULSE_PERIOD_NS_DEN, PULSE_PERIOD_NS_NUM
+
+PULSE_PERIOD_NS = PULSE_PERIOD_NS_NUM / PULSE_PERIOD_NS_DEN
+"""Full ESS frame in ns (derived from the canonical constants) — the
+default TOA axis must cover the whole pulse or tail events silently vanish
+from histograms."""
+
+
 class TOARange(BaseModel):
     """Optional time-of-arrival filter window (ns within pulse)."""
 
@@ -77,7 +85,7 @@ class TOARange(BaseModel):
 
     enabled: bool = True
     low: float = 0.0
-    high: float = 71_000_000.0
+    high: float = PULSE_PERIOD_NS
 
     @model_validator(mode="after")
     def _ordered(self) -> TOARange:

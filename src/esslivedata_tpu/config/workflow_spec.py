@@ -29,6 +29,18 @@ __all__ = [
 ]
 
 
+_ID_FORBIDDEN = set("|/")
+
+
+def _check_id_field(value: str) -> str:
+    if not value or _ID_FORBIDDEN & set(value):
+        raise ValueError(
+            f"Identifier field {value!r} must be non-empty and contain no "
+            "'|' or '/' (reserved for the ResultKey wire encoding)"
+        )
+    return value
+
+
 class WorkflowId(BaseModel):
     """Identifies a workflow implementation (not an instance)."""
 
@@ -38,6 +50,11 @@ class WorkflowId(BaseModel):
     namespace: str = "default"
     name: str
     version: int = 1
+
+    @field_validator("instrument", "namespace", "name")
+    @classmethod
+    def _safe_fields(cls, v: str) -> str:
+        return _check_id_field(v)
 
     def __str__(self) -> str:
         return f"{self.instrument}/{self.namespace}/{self.name}/v{self.version}"
@@ -60,6 +77,11 @@ class JobId(BaseModel):
 
     source_name: str
     job_number: uuid.UUID = Field(default_factory=uuid.uuid4)
+
+    @field_validator("source_name")
+    @classmethod
+    def _safe_source(cls, v: str) -> str:
+        return _check_id_field(v)
 
     def __str__(self) -> str:
         return f"{self.source_name}:{self.job_number}"
@@ -96,7 +118,8 @@ class WorkflowConfig(BaseModel):
 
 
 class ResultKey(BaseModel):
-    """Routing key stamped on every published result."""
+    """Routing key stamped on every published result. Travels compactly as
+    the da00 source_name so the dashboard can route without extra headers."""
 
     model_config = ConfigDict(frozen=True)
 
@@ -104,8 +127,25 @@ class ResultKey(BaseModel):
     job_id: JobId
     output_name: str
 
-    def stream_name(self) -> str:
-        return f"{self.job_id.source_name}/{self.output_name}/{self.job_id.job_number}"
+    @field_validator("output_name")
+    @classmethod
+    def _safe_output(cls, v: str) -> str:
+        return _check_id_field(v)
+
+    def to_string(self) -> str:
+        return (
+            f"{self.workflow_id}|{self.job_id.source_name}"
+            f"|{self.job_id.job_number}|{self.output_name}"
+        )
+
+    @classmethod
+    def from_string(cls, s: str) -> ResultKey:
+        wid, source, job_number, output = s.split("|")
+        return cls(
+            workflow_id=WorkflowId.parse(wid),
+            job_id=JobId(source_name=source, job_number=uuid.UUID(job_number)),
+            output_name=output,
+        )
 
 
 class OutputSpec(BaseModel):

@@ -1,0 +1,225 @@
+"""Job = one workflow instance bound to one source, plus wire-status models.
+
+Parity with reference ``core/job.py``: Job:255 (add/process/get with time
+coords stamped on outputs :209), JobState:95 phases, JobStatus:59,
+ServiceStatus:193, stream-lag model :141-177 with WARN >= 2 s stale /
+ERROR > 0.1 s future thresholds (:132-138).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from collections.abc import Mapping
+from enum import StrEnum
+from typing import Any
+
+import numpy as np
+from pydantic import BaseModel, Field
+
+from ..config.workflow_spec import JobId, JobSchedule, ResultKey, WorkflowId
+from ..utils.labeled import DataArray, Variable
+from ..workflows.workflow_factory import Workflow
+from .timestamp import Duration, Timestamp
+
+__all__ = [
+    "Job",
+    "JobResult",
+    "JobState",
+    "JobStatus",
+    "ServiceStatus",
+    "StreamLag",
+    "StreamLagReport",
+]
+
+STALE_WARN_THRESHOLD = Duration.from_s(2.0)
+FUTURE_ERROR_THRESHOLD = Duration.from_s(0.1)
+
+
+class JobState(StrEnum):
+    SCHEDULED = "scheduled"
+    PENDING_CONTEXT = "pending_context"
+    ACTIVE = "active"
+    FINISHING = "finishing"
+    WARNING = "warning"
+    ERROR = "error"
+    STOPPED = "stopped"
+
+
+class JobStatus(BaseModel):
+    """Per-job status as published in heartbeats (x5f2 status_json)."""
+
+    source_name: str
+    job_number: uuid.UUID
+    workflow_id: str
+    state: JobState
+    message: str = ""
+    has_primary_data: bool = False
+
+
+class StreamLag(BaseModel):
+    """Data-time vs wall-clock skew of one stream at batch close."""
+
+    stream_name: str
+    lag_s: float  # positive = stale, negative = from the future
+
+    @property
+    def level(self) -> str:
+        if self.lag_s < -FUTURE_ERROR_THRESHOLD.seconds:
+            return "error"
+        if self.lag_s > STALE_WARN_THRESHOLD.seconds:
+            return "warning"
+        return "ok"
+
+
+class StreamLagReport(BaseModel):
+    lags: list[StreamLag] = Field(default_factory=list)
+
+    @property
+    def worst_level(self) -> str:
+        levels = {lag.level for lag in self.lags}
+        for level in ("error", "warning"):
+            if level in levels:
+                return level
+        return "ok"
+
+
+class ServiceStatus(BaseModel):
+    """Service heartbeat payload (2 s cadence)."""
+
+    service_name: str
+    instrument: str
+    state: str = "running"
+    jobs: list[JobStatus] = Field(default_factory=list)
+    last_batch_message_count: int = 0
+    stream_message_counts: dict[str, int] = Field(default_factory=dict)
+    uptime_s: float = 0.0
+
+
+class JobResult:
+    """Finalized outputs of one job for one window."""
+
+    __slots__ = ("job_id", "workflow_id", "outputs", "start", "end")
+
+    def __init__(
+        self,
+        *,
+        job_id: JobId,
+        workflow_id: WorkflowId,
+        outputs: dict[str, DataArray],
+        start: Timestamp | None,
+        end: Timestamp | None,
+    ) -> None:
+        self.job_id = job_id
+        self.workflow_id = workflow_id
+        self.outputs = outputs
+        self.start = start
+        self.end = end
+
+    def keys(self) -> list[ResultKey]:
+        return [
+            ResultKey(
+                workflow_id=self.workflow_id,
+                job_id=self.job_id,
+                output_name=name,
+            )
+            for name in self.outputs
+        ]
+
+
+class Job:
+    """Owns a workflow instance; maps window data in, stamped results out."""
+
+    def __init__(
+        self,
+        *,
+        job_id: JobId,
+        workflow_id: WorkflowId,
+        workflow: Workflow,
+        schedule: JobSchedule | None = None,
+        primary_streams: set[str] | None = None,
+        aux_streams: set[str] | None = None,
+        context_keys: set[str] | None = None,
+        reset_on_run_transition: bool = True,
+    ) -> None:
+        self.job_id = job_id
+        self.workflow_id = workflow_id
+        self.workflow = workflow
+        self.schedule = schedule or JobSchedule()
+        self.primary_streams = primary_streams or {job_id.source_name}
+        self.aux_streams = aux_streams or set()
+        self.context_keys = context_keys or set()
+        self.reset_on_run_transition = reset_on_run_transition
+        self._window_start: Timestamp | None = None
+        self._window_end: Timestamp | None = None
+        self._start_wall = time.time()
+
+    @property
+    def subscribed_streams(self) -> set[str]:
+        return self.primary_streams | self.aux_streams
+
+    def add(
+        self,
+        data: Mapping[str, Any],
+        *,
+        start: Timestamp | None = None,
+        end: Timestamp | None = None,
+    ) -> bool:
+        """Feed one window of stream-keyed data; returns True if any of it
+        was for this job."""
+        relevant = {k: v for k, v in data.items() if k in self.subscribed_streams}
+        if not relevant:
+            return False
+        if start is not None and (
+            self._window_start is None or start < self._window_start
+        ):
+            self._window_start = start
+        if end is not None:
+            self._window_end = end
+        self.workflow.accumulate(relevant)
+        return True
+
+    def set_context(self, context: Mapping[str, Any]) -> None:
+        relevant = {k: v for k, v in context.items() if k in self.context_keys}
+        if relevant and hasattr(self.workflow, "set_context"):
+            self.workflow.set_context(relevant)
+
+    def get(self) -> JobResult:
+        """Finalize the window into a JobResult, stamping start/end time
+        coords on every output (reference job.py:209)."""
+        outputs = self.workflow.finalize()
+        start, end = self._window_start, self._window_end
+        for da in outputs.values():
+            if start is not None:
+                da.coords.setdefault(
+                    "start_time",
+                    Variable(np.asarray(start.ns, dtype=np.int64), (), "ns"),
+                )
+            if end is not None:
+                da.coords["end_time"] = Variable(
+                    np.asarray(end.ns, dtype=np.int64), (), "ns"
+                )
+        result = JobResult(
+            job_id=self.job_id,
+            workflow_id=self.workflow_id,
+            outputs=outputs,
+            start=start,
+            end=end,
+        )
+        self._window_start = None
+        return result
+
+    def process(
+        self,
+        data: Mapping[str, Any],
+        *,
+        start: Timestamp | None = None,
+        end: Timestamp | None = None,
+    ) -> JobResult:
+        self.add(data, start=start, end=end)
+        return self.get()
+
+    def clear(self) -> None:
+        self.workflow.clear()
+        self._window_start = None
+        self._window_end = None

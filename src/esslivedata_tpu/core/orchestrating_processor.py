@@ -1,0 +1,292 @@
+"""The heart of a backend service: one processor cycle.
+
+Parity with reference ``core/orchestrating_processor.py`` (process:200):
+pull -> split commands/run-control/data (:212-218) -> dispatch commands ->
+batch -> preprocess per stream (MessagePreprocessor:55) -> context
+enrichment -> JobManager.process_jobs (:286) -> publish results -> release
+buffers (zero-copy contract :287) -> 2 s status heartbeats (:327) and 30 s
+metrics (:364-415) -> idempotent finalize (:417) publishing final stopped
+statuses. Per-batch processing time feeds the adaptive batcher — the
+implicit load profiler.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections.abc import Iterable
+from typing import Any
+
+from ..config.acknowledgement import CommandAcknowledgement
+from ..core.preprocessor import PreprocessorFactory
+from .command_dispatcher import CommandDispatcher
+from .job_manager import JobManager
+from .job import JobResult, ServiceStatus, StreamLag, StreamLagReport
+from .message import (
+    Message,
+    MessageSink,
+    MessageSource,
+    RunStart,
+    RunStop,
+    StreamId,
+    StreamKind,
+)
+from .message_batcher import MessageBatcher
+from .timestamp import Duration, Timestamp
+
+__all__ = ["MessagePreprocessor", "OrchestratingProcessor"]
+
+logger = logging.getLogger(__name__)
+
+HEARTBEAT_INTERVAL_S = 2.0
+METRICS_INTERVAL_S = 30.0
+
+
+class MessagePreprocessor:
+    """Routes batch messages into per-stream accumulators."""
+
+    def __init__(self, factory: PreprocessorFactory) -> None:
+        self._factory = factory
+        self._accumulators: dict[StreamId, Any] = {}
+        self._touched: set[StreamId] = set()
+        self._dropped_streams: set[StreamId] = set()
+        self.message_counts: dict[str, int] = {}
+
+    def _get(self, stream: StreamId):
+        if stream in self._accumulators:
+            return self._accumulators[stream]
+        if stream in self._dropped_streams:
+            return None
+        acc = self._factory.make_preprocessor(stream)
+        if acc is None:
+            self._dropped_streams.add(stream)
+            return None
+        self._accumulators[stream] = acc
+        return acc
+
+    def preprocess(self, messages: Iterable[Message]) -> None:
+        for msg in messages:
+            acc = self._get(msg.stream)
+            if acc is None:
+                continue
+            try:
+                acc.add(msg.timestamp, msg.value)
+            except Exception:
+                logger.exception("Accumulator failed for %s", msg.stream)
+                continue
+            self._touched.add(msg.stream)
+            self.message_counts[msg.stream.name] = (
+                self.message_counts.get(msg.stream.name, 0) + 1
+            )
+
+    def collect_window(self) -> dict[str, Any]:
+        """Primary (non-context) data accumulated since last collect."""
+        out: dict[str, Any] = {}
+        for stream in self._touched:
+            acc = self._accumulators[stream]
+            if getattr(acc, "is_context", False):
+                continue
+            try:
+                out[stream.name] = acc.get()
+            except Exception:
+                logger.exception("Accumulator get failed for %s", stream)
+        return out
+
+    def collect_context(self) -> dict[str, Any]:
+        """Latest value of every context accumulator that has one."""
+        out: dict[str, Any] = {}
+        for stream, acc in self._accumulators.items():
+            if not getattr(acc, "is_context", False):
+                continue
+            if hasattr(acc, "has_value") and not acc.has_value:
+                continue
+            try:
+                out[stream.name] = acc.get()
+            except ValueError:
+                continue
+        return out
+
+    def release(self) -> None:
+        for stream in self._touched:
+            self._accumulators[stream].release_buffers()
+        self._touched.clear()
+
+
+class OrchestratingProcessor:
+    """Processor implementation wiring source -> jobs -> sink."""
+
+    def __init__(
+        self,
+        *,
+        source: MessageSource,
+        sink: MessageSink,
+        preprocessor_factory: PreprocessorFactory,
+        job_manager: JobManager,
+        batcher: MessageBatcher,
+        instrument: str,
+        service_name: str,
+        registry=None,
+        clock=time.monotonic,
+    ) -> None:
+        self._source = source
+        self._sink = sink
+        self._preprocessor = MessagePreprocessor(preprocessor_factory)
+        self._job_manager = job_manager
+        self._batcher = batcher
+        self._dispatcher = CommandDispatcher(
+            job_manager=job_manager,
+            instrument=instrument,
+            service_name=service_name,
+            registry=registry,
+        )
+        self._instrument = instrument
+        self._service_name = service_name
+        self._clock = clock
+        self._start_wall = clock()
+        self._last_heartbeat = -float("inf")
+        self._last_metrics = clock()
+        self._last_batch_len = 0
+        self._finalized = False
+        self.last_lag_report = StreamLagReport()
+
+    # -- cycle ------------------------------------------------------------
+    def process(self) -> None:
+        messages = list(self._source.get_messages())
+
+        commands = [
+            m for m in messages if m.stream.kind == StreamKind.LIVEDATA_COMMANDS
+        ]
+        run_control = [
+            m for m in messages if m.stream.kind == StreamKind.RUN_CONTROL
+        ]
+        data = [
+            m
+            for m in messages
+            if m.stream.kind
+            not in (StreamKind.LIVEDATA_COMMANDS, StreamKind.RUN_CONTROL)
+        ]
+
+        if commands:
+            acks = self._dispatcher.process_messages(commands)
+            self._publish_acks(acks)
+        for msg in run_control:
+            if isinstance(msg.value, (RunStart, RunStop)):
+                self._job_manager.handle_run_transition(msg.value)
+
+        batch = self._batcher.batch(data)
+        if batch is not None:
+            t0 = self._clock()
+            self._process_batch(batch)
+            self._batcher.report_processing_time(
+                Duration.from_s(self._clock() - t0)
+            )
+
+        now = self._clock()
+        if now - self._last_heartbeat >= HEARTBEAT_INTERVAL_S:
+            self._last_heartbeat = now
+            self._publish_status()
+        if now - self._last_metrics >= METRICS_INTERVAL_S:
+            self._last_metrics = now
+            self._log_metrics()
+
+    def _process_batch(self, batch) -> None:
+        self._last_batch_len = len(batch.messages)
+        self._preprocessor.preprocess(batch.messages)
+        window = self._preprocessor.collect_window()
+        context = self._preprocessor.collect_context()
+        self._record_lag(batch)
+        results = self._job_manager.process_jobs(
+            window, context=context, start=batch.start, end=batch.end
+        )
+        try:
+            self._publish_results(results, batch.end)
+        finally:
+            self._preprocessor.release()
+
+    def _record_lag(self, batch) -> None:
+        now_ns = time.time_ns()
+        lags = [
+            StreamLag(
+                stream_name=name,
+                lag_s=(now_ns - batch.end.ns) / 1e9,
+            )
+            for name in {m.stream.name for m in batch.messages}
+        ]
+        self.last_lag_report = StreamLagReport(lags=lags)
+
+    # -- publishing -------------------------------------------------------
+    def _publish_results(
+        self, results: list[JobResult], timestamp: Timestamp
+    ) -> None:
+        messages: list[Message] = []
+        for result in results:
+            for key, da in zip(result.keys(), result.outputs.values(), strict=True):
+                messages.append(
+                    Message(
+                        timestamp=timestamp,
+                        stream=StreamId(
+                            kind=StreamKind.LIVEDATA_DATA, name=key.to_string()
+                        ),
+                        value=da,
+                    )
+                )
+        if messages:
+            self._sink.publish_messages(messages)
+
+    def _publish_acks(self, acks: list[CommandAcknowledgement]) -> None:
+        if not acks:
+            return
+        self._sink.publish_messages(
+            [
+                Message(
+                    timestamp=Timestamp.now(),
+                    stream=StreamId(kind=StreamKind.LIVEDATA_RESPONSES, name=""),
+                    value=ack,
+                )
+                for ack in acks
+            ]
+        )
+
+    def _service_status(self, state: str = "running") -> ServiceStatus:
+        return ServiceStatus(
+            service_name=self._service_name,
+            instrument=self._instrument,
+            state=state,
+            jobs=self._job_manager.job_statuses(),
+            last_batch_message_count=self._last_batch_len,
+            stream_message_counts=dict(self._preprocessor.message_counts),
+            uptime_s=self._clock() - self._start_wall,
+        )
+
+    def _publish_status(self, state: str = "running") -> None:
+        self._sink.publish_messages(
+            [
+                Message(
+                    timestamp=Timestamp.now(),
+                    stream=StreamId(kind=StreamKind.LIVEDATA_STATUS, name=""),
+                    value=self._service_status(state),
+                )
+            ]
+        )
+
+    def _log_metrics(self) -> None:
+        logger.info(
+            "processor_metrics",
+            extra={
+                "service": self._service_name,
+                "jobs": self._job_manager.n_jobs,
+                "stream_counts": dict(self._preprocessor.message_counts),
+                "lag_level": self.last_lag_report.worst_level,
+            },
+        )
+
+    def finalize(self) -> None:
+        """Publish final stopped statuses; idempotent (reference :417)."""
+        if self._finalized:
+            return
+        self._finalized = True
+        try:
+            self._publish_status(state="stopped")
+        except Exception:
+            logger.exception("Failed to publish final status")
+        self._job_manager.shutdown()

@@ -32,14 +32,15 @@ class LivedataTopics:
     nicos: str
 
     @classmethod
-    def for_instrument(cls, instrument: str) -> "LivedataTopics":
+    def for_instrument(cls, instrument: str, dev: bool = False) -> "LivedataTopics":
+        prefix = f"dev_{instrument}" if dev else instrument
         return cls(
-            data=f"{instrument}_livedata_data",
-            status=f"{instrument}_livedata_status",
-            commands=f"{instrument}_livedata_commands",
-            responses=f"{instrument}_livedata_responses",
-            roi=f"{instrument}_livedata_roi",
-            nicos=f"{instrument}_livedata_nicos",
+            data=f"{prefix}_livedata_data",
+            status=f"{prefix}_livedata_status",
+            commands=f"{prefix}_livedata_commands",
+            responses=f"{prefix}_livedata_responses",
+            roi=f"{prefix}_livedata_roi",
+            nicos=f"{prefix}_livedata_nicos",
         )
 
 
@@ -53,12 +54,15 @@ class StreamMapping:
     area_detectors: Mapping[InputStreamKey, str] = field(default_factory=dict)
     logs: Mapping[InputStreamKey, str] = field(default_factory=dict)
     run_control_topics: tuple[str, ...] = ()
+    dev: bool = False
     livedata: LivedataTopics | None = None
 
     def __post_init__(self) -> None:
         if self.livedata is None:
             object.__setattr__(
-                self, "livedata", LivedataTopics.for_instrument(self.instrument)
+                self,
+                "livedata",
+                LivedataTopics.for_instrument(self.instrument, self.dev),
             )
 
     @property

@@ -1,0 +1,166 @@
+"""Synthetic 14 Hz wire-level streams for tests, demos and benchmarks.
+
+Parity with reference ``services/fake_detectors.py`` (FakeDetectorSource:52)
+/ ``fake_monitors.py`` / ``fake_logdata.py``: generators producing
+serialized ev44/f144/da00 payloads at the pulse cadence, usable (a)
+in-process as a raw message source for broker-less end-to-end runs and (b)
+by the standalone fake-producer services feeding a real broker.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.constants import PULSE_PERIOD_NS_DEN, PULSE_PERIOD_NS_NUM
+from ..kafka import wire
+from ..kafka.source import FakeKafkaMessage
+
+__all__ = ["FakeDetectorStream", "FakeLogStream", "FakeMonitorStream"]
+
+
+def _pulse_time_ns(pulse: int) -> int:
+    return -((-pulse * PULSE_PERIOD_NS_NUM) // PULSE_PERIOD_NS_DEN)
+
+
+class FakeDetectorStream:
+    """ev44 detector events: gaussian blob drifting across the panel."""
+
+    def __init__(
+        self,
+        *,
+        topic: str,
+        source_name: str,
+        detector_ids: np.ndarray,
+        events_per_pulse: int = 1000,
+        start_pulse: int = 0,
+        seed: int = 0,
+    ) -> None:
+        self._topic = topic
+        self._source = source_name
+        self._ids = np.asarray(detector_ids).reshape(-1)
+        self._events_per_pulse = events_per_pulse
+        self._pulse = start_pulse
+        self._rng = np.random.default_rng(seed)
+        self._message_id = 0
+
+    def pulses(self, n: int) -> list[FakeKafkaMessage]:
+        out = []
+        for _ in range(n):
+            t_ns = _pulse_time_ns(self._pulse)
+            k = self._events_per_pulse
+            # drifting hot spot over the id space
+            center = (0.5 + 0.4 * np.sin(self._pulse / 50.0)) * self._ids.size
+            idx = np.clip(
+                self._rng.normal(center, self._ids.size / 8.0, k),
+                0,
+                self._ids.size - 1,
+            ).astype(np.int64)
+            pixel_id = self._ids[idx].astype(np.int32)
+            toa = self._rng.uniform(0, PULSE_PERIOD_NS_NUM / PULSE_PERIOD_NS_DEN, k)
+            buf = wire.encode_ev44(
+                self._source,
+                self._message_id,
+                reference_time=np.array([t_ns], dtype=np.int64),
+                reference_time_index=np.array([0], dtype=np.int32),
+                time_of_flight=toa.astype(np.int32),
+                pixel_id=pixel_id,
+            )
+            out.append(FakeKafkaMessage(buf, self._topic))
+            self._pulse += 1
+            self._message_id += 1
+        return out
+
+
+class FakeMonitorStream:
+    """ev44 monitor events with a double-peak TOA profile."""
+
+    def __init__(
+        self,
+        *,
+        topic: str,
+        source_name: str,
+        events_per_pulse: int = 200,
+        start_pulse: int = 0,
+        seed: int = 1,
+    ) -> None:
+        self._topic = topic
+        self._source = source_name
+        self._events_per_pulse = events_per_pulse
+        self._pulse = start_pulse
+        self._rng = np.random.default_rng(seed)
+        self._message_id = 0
+
+    def pulses(self, n: int) -> list[FakeKafkaMessage]:
+        out = []
+        period = PULSE_PERIOD_NS_NUM / PULSE_PERIOD_NS_DEN
+        for _ in range(n):
+            t_ns = _pulse_time_ns(self._pulse)
+            k = self._events_per_pulse
+            peak = self._rng.choice([0.3, 0.6], size=k)
+            toa = np.clip(
+                self._rng.normal(peak * period, period / 20.0, k), 0, period - 1
+            )
+            buf = wire.encode_ev44(
+                self._source,
+                self._message_id,
+                reference_time=np.array([t_ns], dtype=np.int64),
+                reference_time_index=np.array([0], dtype=np.int32),
+                time_of_flight=toa.astype(np.int32),
+            )
+            out.append(FakeKafkaMessage(buf, self._topic))
+            self._pulse += 1
+            self._message_id += 1
+        return out
+
+
+class FakeLogStream:
+    """f144 sinusoidal motor position at a fixed sample rate."""
+
+    def __init__(
+        self,
+        *,
+        topic: str,
+        source_name: str,
+        period_pulses: int = 14,
+        amplitude: float = 10.0,
+        start_pulse: int = 0,
+    ) -> None:
+        self._topic = topic
+        self._source = source_name
+        self._period = period_pulses
+        self._amplitude = amplitude
+        self._pulse = start_pulse
+
+    def pulses(self, n: int) -> list[FakeKafkaMessage]:
+        out = []
+        for _ in range(n):
+            if self._pulse % self._period == 0:
+                t_ns = _pulse_time_ns(self._pulse)
+                value = self._amplitude * np.sin(self._pulse / 100.0)
+                out.append(
+                    FakeKafkaMessage(
+                        wire.encode_f144(self._source, value, t_ns), self._topic
+                    )
+                )
+            self._pulse += 1
+        return out
+
+
+class PulsedRawSource:
+    """Raw message source yielding the next pulse's messages per poll —
+    drives a whole service deterministically without a broker."""
+
+    def __init__(self, streams, pulses_per_poll: int = 1) -> None:
+        self._streams = list(streams)
+        self._pulses_per_poll = pulses_per_poll
+        self._injected: list[FakeKafkaMessage] = []
+
+    def inject(self, message: FakeKafkaMessage) -> None:
+        """Queue a control-plane message (command JSON etc.)."""
+        self._injected.append(message)
+
+    def get_messages(self) -> list[FakeKafkaMessage]:
+        out, self._injected = self._injected, []
+        for stream in self._streams:
+            out.extend(stream.pulses(self._pulses_per_poll))
+        return out

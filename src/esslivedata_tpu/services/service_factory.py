@@ -1,0 +1,181 @@
+"""Service assembly: adapter + preprocessors + processor + sink -> Service.
+
+Parity with reference ``service_factory.py`` (DataServiceBuilder:58,
+DataServiceRunner:271): builders wire the full stack from an instrument
+name; the runner adds the CLI surface (--instrument --dev --batcher
+--job-threads --check, LIVEDATA_* env overrides) and broker config. The
+broker path needs confluent_kafka (optional dependency); everything else
+runs against in-memory fakes, which is also the test rig.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections.abc import Callable
+
+from ..core.job_manager import JobFactory, JobManager
+from ..core.message_batcher import (
+    AdaptiveMessageBatcher,
+    MessageBatcher,
+    NaiveMessageBatcher,
+    SimpleMessageBatcher,
+)
+from ..core.orchestrating_processor import OrchestratingProcessor
+from ..core.service import Service, get_env_defaults, setup_arg_parser
+from ..config.instrument import instrument_registry
+from ..config.streams import get_stream_mapping
+from ..kafka.message_adapter import AdaptingMessageSource, RouteByTopicAdapter
+from ..kafka.sink import KafkaSink, UnrollingSinkAdapter, make_default_serializer
+from ..kafka.source import BackgroundMessageSource
+from ..kafka.stream_mapping import StreamMapping
+
+__all__ = ["DataServiceBuilder", "DataServiceRunner", "make_batcher"]
+
+logger = logging.getLogger(__name__)
+
+
+def make_batcher(name: str) -> MessageBatcher:
+    if name == "naive":
+        return NaiveMessageBatcher()
+    if name == "simple":
+        return SimpleMessageBatcher()
+    if name == "adaptive":
+        return AdaptiveMessageBatcher()
+    raise ValueError(f"Unknown batcher {name!r}")
+
+
+class DataServiceBuilder:
+    """Builds one backend service for one instrument."""
+
+    def __init__(
+        self,
+        *,
+        instrument: str,
+        service_name: str,
+        preprocessor_factory,
+        route_builder: Callable[[StreamMapping], RouteByTopicAdapter],
+        batcher: MessageBatcher | None = None,
+        job_threads: int = 5,
+        dev: bool = False,
+    ) -> None:
+        self.instrument_name = instrument
+        self.service_name = service_name
+        self._preprocessor_factory = preprocessor_factory
+        self._route_builder = route_builder
+        self._batcher = batcher or AdaptiveMessageBatcher()
+        self._job_threads = job_threads
+        self._dev = dev
+        self._instrument = instrument_registry[instrument]
+        self._instrument.load_factories()
+        self.stream_mapping = get_stream_mapping(self._instrument, dev)
+
+    @property
+    def topics(self) -> list[str]:
+        """The service's actual subscription = the topics its route tree
+        handles (reference derives this by scoping the stream mapping to the
+        service, route_derivation.py:109)."""
+        return self._route_builder(self.stream_mapping).topics
+
+    def from_raw_source(self, raw_source, sink) -> Service:
+        """Assemble from anything yielding KafkaMessages + a MessageSink —
+        used by tests (fakes) and by the broker path alike."""
+        adapter = self._route_builder(self.stream_mapping)
+        source = AdaptingMessageSource(raw_source, adapter)
+        job_manager = JobManager(
+            job_factory=JobFactory(), job_threads=self._job_threads
+        )
+        processor = OrchestratingProcessor(
+            source=source,
+            sink=sink,
+            preprocessor_factory=self._preprocessor_factory,
+            job_manager=job_manager,
+            batcher=self._batcher,
+            instrument=self.instrument_name,
+            service_name=self.service_name,
+        )
+        return Service(
+            processor=processor,
+            name=f"{self.instrument_name}_{self.service_name}",
+        )
+
+    def from_consumer(self, consumer, producer) -> Service:
+        """Assemble over a real (or fake) Kafka consumer/producer pair."""
+        raw_source = BackgroundMessageSource(consumer)
+        raw_source.start()
+        sink = UnrollingSinkAdapter(
+            KafkaSink(
+                producer,
+                make_default_serializer(
+                    self.stream_mapping.livedata,
+                    f"{self.instrument_name}_{self.service_name}",
+                ),
+            )
+        )
+        return self.from_raw_source(raw_source, sink)
+
+
+class DataServiceRunner:
+    """CLI entry point shared by the four services."""
+
+    def __init__(self, *, service_name: str, make_builder) -> None:
+        self._service_name = service_name
+        self._make_builder = make_builder
+
+    def run(self, argv: list[str] | None = None) -> int:
+        parser = setup_arg_parser(f"esslivedata-tpu {self._service_name} service")
+        parser.add_argument(
+            "--batcher",
+            default="adaptive",
+            choices=["naive", "simple", "adaptive"],
+        )
+        parser.add_argument("--job-threads", type=int, default=5)
+        parser.add_argument("--kafka-bootstrap", default="localhost:9092")
+        parser.add_argument(
+            "--check",
+            action="store_true",
+            help="build everything, print topics, exit",
+        )
+        parser.set_defaults(**get_env_defaults(parser))
+        args = parser.parse_args(argv)
+        logging.basicConfig(level=args.log_level)
+
+        from ..config.instrument import instrument_registry as registry
+
+        if args.instrument not in registry:
+            parser.error(
+                f"Unknown instrument {args.instrument!r}; "
+                f"known: {', '.join(registry.names()) or '(none)'}"
+            )
+        builder = self._make_builder(
+            instrument=args.instrument,
+            dev=args.dev,
+            batcher=make_batcher(args.batcher),
+            job_threads=args.job_threads,
+        )
+        if args.check:
+            print(
+                f"{self._service_name}: instrument={args.instrument} "
+                f"topics={builder.topics}"
+            )
+            return 0
+        try:
+            from confluent_kafka import Consumer, Producer
+        except ImportError:
+            logger.error(
+                "confluent_kafka not installed; install extra [kafka] or use "
+                "the fake transport (tests/demos)"
+            )
+            return 2
+        consumer = Consumer(
+            {
+                "bootstrap.servers": args.kafka_bootstrap,
+                "group.id": f"{args.instrument}_{self._service_name}",
+                "auto.offset.reset": "latest",
+                "enable.auto.commit": False,
+            }
+        )
+        consumer.subscribe(builder.topics)
+        producer = Producer({"bootstrap.servers": args.kafka_bootstrap})
+        service = builder.from_consumer(consumer, producer)
+        service.start(blocking=True)
+        return service.exit_code

@@ -1,0 +1,44 @@
+"""Timeseries (logdata) service (reference: services/timeseries.py:20).
+Uses the naive batcher: log samples should flow immediately."""
+
+from __future__ import annotations
+
+from ..core.message_batcher import NaiveMessageBatcher
+from ..kafka.routes import RoutingAdapterBuilder
+from ..preprocessors.factories import TimeseriesPreprocessorFactory
+from .service_factory import DataServiceBuilder, DataServiceRunner
+
+__all__ = ["main", "make_timeseries_service_builder"]
+
+
+def make_timeseries_service_builder(
+    *, instrument: str, dev: bool = False, batcher=None, job_threads: int = 5
+) -> DataServiceBuilder:
+    def routes(mapping):
+        return (
+            RoutingAdapterBuilder(stream_mapping=mapping)
+            .with_logdata_route()
+            .with_run_control_route()
+            .with_commands_route()
+            .build()
+        )
+
+    return DataServiceBuilder(
+        instrument=instrument,
+        service_name="timeseries",
+        preprocessor_factory=TimeseriesPreprocessorFactory(),
+        route_builder=routes,
+        batcher=batcher or NaiveMessageBatcher(),
+        job_threads=job_threads,
+        dev=dev,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    return DataServiceRunner(
+        service_name="timeseries", make_builder=make_timeseries_service_builder
+    ).run(argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,223 @@
+import uuid
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.config import JobId, JobSchedule, WorkflowConfig, WorkflowSpec
+from esslivedata_tpu.core.job_manager import JobCommand, JobFactory, JobManager
+from esslivedata_tpu.core.job import JobState
+from esslivedata_tpu.core.message import RunStart
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.utils import DataArray, Variable
+from esslivedata_tpu.workflows import WorkflowFactory
+
+
+class CountingWorkflow:
+    """Accumulates floats per stream; counts lifecycle calls."""
+
+    def __init__(self):
+        self.total = 0.0
+        self.finalize_calls = 0
+        self.clear_calls = 0
+        self.context: dict = {}
+
+    def accumulate(self, data):
+        for v in data.values():
+            self.total += v
+
+    def finalize(self):
+        self.finalize_calls += 1
+        return {
+            "total": DataArray(
+                Variable(np.asarray(self.total), (), "counts"), name="total"
+            )
+        }
+
+    def clear(self):
+        self.clear_calls += 1
+        self.total = 0.0
+
+    def set_context(self, ctx):
+        self.context.update(ctx)
+
+
+@pytest.fixture
+def registry():
+    reg = WorkflowFactory()
+    spec = WorkflowSpec(
+        instrument="dummy", name="count", source_names=["bank0", "bank1"]
+    )
+    handle = reg.register_spec(spec)
+    handle.attach_factory(lambda *, source_name, params: CountingWorkflow())
+
+    gated_spec = WorkflowSpec(
+        instrument="dummy",
+        name="gated",
+        source_names=["bank0"],
+        context_keys=["motor_x"],
+    )
+    reg.register_spec(gated_spec).attach_factory(
+        lambda *, source_name, params: CountingWorkflow()
+    )
+    return reg
+
+
+@pytest.fixture
+def manager(registry):
+    return JobManager(job_factory=JobFactory(registry), job_threads=1)
+
+
+def start_config(registry, name="count", source="bank0", **schedule):
+    spec = next(s for s in registry.specs_for_instrument("dummy") if s.name == name)
+    return WorkflowConfig(
+        identifier=spec.identifier,
+        job_id=JobId(source_name=source),
+        schedule=JobSchedule(**schedule) if schedule else JobSchedule(),
+    )
+
+
+T = Timestamp.from_ns
+
+
+class TestScheduling:
+    def test_schedule_and_process(self, registry, manager):
+        manager.schedule_job(start_config(registry))
+        results = manager.process_jobs(
+            {"bank0": 5.0}, start=T(0), end=T(100)
+        )
+        assert len(results) == 1
+        assert float(results[0].outputs["total"].values) == 5.0
+        assert results[0].outputs["total"].coords["end_time"].value == 100
+
+    def test_duplicate_job_rejected(self, registry, manager):
+        config = start_config(registry)
+        manager.schedule_job(config)
+        with pytest.raises(ValueError, match="already exists"):
+            manager.schedule_job(config)
+
+    def test_data_time_activation(self, registry, manager):
+        manager.schedule_job(start_config(registry, start_time_ns=1000))
+        # window ends before start_time: job not yet active
+        assert manager.process_jobs({"bank0": 1.0}, start=T(0), end=T(500)) == []
+        results = manager.process_jobs({"bank0": 2.0}, start=T(900), end=T(1500))
+        assert len(results) == 1
+
+    def test_end_time_finishes_job(self, registry, manager):
+        manager.schedule_job(start_config(registry, end_time_ns=1000))
+        manager.process_jobs({"bank0": 1.0}, start=T(0), end=T(1500))
+        [status] = manager.job_statuses()
+        assert status.state == JobState.STOPPED
+        assert manager.process_jobs({"bank0": 1.0}, start=T(1500), end=T(2000)) == []
+
+    def test_no_result_without_primary_data(self, registry, manager):
+        manager.schedule_job(start_config(registry))
+        assert manager.process_jobs({"other": 1.0}, start=T(0), end=T(10)) == []
+
+
+class TestContextGating:
+    def test_gated_until_context_arrives(self, registry, manager):
+        manager.schedule_job(start_config(registry, name="gated"))
+        results = manager.process_jobs({"bank0": 1.0}, start=T(0), end=T(10))
+        assert results == []
+        [status] = manager.job_statuses()
+        assert status.state == JobState.PENDING_CONTEXT
+        assert manager.peek_pending_streams() == {"motor_x"}
+
+        results = manager.process_jobs(
+            {"bank0": 2.0}, context={"motor_x": 3.5}, start=T(10), end=T(20)
+        )
+        assert len(results) == 1
+        [status] = manager.job_statuses()
+        assert status.state == JobState.ACTIVE
+
+    def test_context_delivered_to_workflow(self, registry, manager):
+        manager.schedule_job(start_config(registry, name="gated"))
+        manager.process_jobs(
+            {"bank0": 1.0}, context={"motor_x": 7.0}, start=T(0), end=T(10)
+        )
+        rec = next(iter(manager._records.values()))
+        assert rec.job.workflow.context == {"motor_x": 7.0}
+
+
+class TestRunTransitions:
+    def test_run_start_resets(self, registry, manager):
+        manager.schedule_job(start_config(registry))
+        manager.process_jobs({"bank0": 5.0}, start=T(0), end=T(10))
+        manager.handle_run_transition(
+            RunStart(run_name="r2", start_time=T(20))
+        )
+        results = manager.process_jobs({"bank0": 1.0}, start=T(20), end=T(30))
+        assert float(results[0].outputs["total"].values) == 1.0  # reset happened
+        rec = next(iter(manager._records.values()))
+        assert rec.job.workflow.clear_calls == 1
+
+
+class TestCommands:
+    def test_stop(self, registry, manager):
+        config = start_config(registry)
+        manager.schedule_job(config)
+        manager.process_jobs({"bank0": 1.0}, start=T(0), end=T(10))
+        manager.handle_command(
+            JobCommand(
+                action="stop",
+                source_name="bank0",
+                job_number=config.job_id.job_number,
+            )
+        )
+        manager.process_jobs({"bank0": 1.0}, start=T(10), end=T(20))
+        [status] = manager.job_statuses()
+        assert status.state == JobState.STOPPED
+
+    def test_remove(self, registry, manager):
+        config = start_config(registry)
+        manager.schedule_job(config)
+        manager.handle_command(
+            JobCommand(
+                action="remove",
+                source_name="bank0",
+                job_number=config.job_id.job_number,
+            )
+        )
+        assert manager.n_jobs == 0
+
+    def test_unknown_job_raises(self, manager):
+        with pytest.raises(KeyError):
+            manager.handle_command(
+                JobCommand(
+                    action="stop", source_name="zz", job_number=uuid.uuid4()
+                )
+            )
+
+
+class TestErrorContainment:
+    def test_failing_job_does_not_kill_others(self, registry, manager):
+        class ExplodingWorkflow(CountingWorkflow):
+            def finalize(self):
+                raise RuntimeError("device OOM")
+
+        spec = WorkflowSpec(instrument="dummy", name="boom", source_names=["bank1"])
+        registry.register_spec(spec).attach_factory(
+            lambda *, source_name, params: ExplodingWorkflow()
+        )
+        manager.schedule_job(start_config(registry))
+        manager.schedule_job(start_config(registry, name="boom", source="bank1"))
+        results = manager.process_jobs(
+            {"bank0": 1.0, "bank1": 2.0}, start=T(0), end=T(10)
+        )
+        assert len(results) == 1  # healthy job still produced
+        states = {s.workflow_id: s.state for s in manager.job_statuses()}
+        assert JobState.ERROR in states.values()
+        assert JobState.ACTIVE in states.values()
+
+
+class TestThreadFanOut:
+    def test_parallel_results_match(self, registry):
+        manager = JobManager(job_factory=JobFactory(registry), job_threads=4)
+        for source in ("bank0", "bank1"):
+            manager.schedule_job(start_config(registry, source=source))
+        results = manager.process_jobs(
+            {"bank0": 1.0, "bank1": 2.0}, start=T(0), end=T(10)
+        )
+        totals = sorted(float(r.outputs["total"].values) for r in results)
+        assert totals == [1.0, 2.0]
+        manager.shutdown()
