@@ -127,6 +127,7 @@ class OrchestratingProcessor:
         service_name: str,
         registry=None,
         clock=time.monotonic,
+        heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S,
     ) -> None:
         self._source = source
         self._sink = sink
@@ -142,6 +143,7 @@ class OrchestratingProcessor:
         self._instrument = instrument
         self._service_name = service_name
         self._clock = clock
+        self._heartbeat_interval_s = heartbeat_interval_s
         self._start_wall = clock()
         self._last_heartbeat = -float("inf")
         self._last_metrics = clock()
@@ -182,7 +184,7 @@ class OrchestratingProcessor:
             )
 
         now = self._clock()
-        if now - self._last_heartbeat >= HEARTBEAT_INTERVAL_S:
+        if now - self._last_heartbeat >= self._heartbeat_interval_s:
             self._last_heartbeat = now
             self._publish_status()
         if now - self._last_metrics >= METRICS_INTERVAL_S:

@@ -1,0 +1,43 @@
+"""Composition root wiring the dashboard's backend-facing services
+(reference: dashboard/dashboard_services.py:42)."""
+
+from __future__ import annotations
+
+from .data_service import DataService
+from .job_orchestrator import JobOrchestrator
+from .job_service import JobService
+from .message_pump import MessagePump
+from .transport import Transport
+
+__all__ = ["DashboardServices"]
+
+
+class DashboardServices:
+    def __init__(self, *, transport: Transport, pump_interval_s: float = 0.05):
+        self.transport = transport
+        self.data_service = DataService()
+        self.job_service = JobService()
+        self.orchestrator = JobOrchestrator(
+            transport=transport, job_service=self.job_service
+        )
+        self.pump = MessagePump(
+            transport=transport,
+            data_service=self.data_service,
+            job_service=self.job_service,
+            interval_s=pump_interval_s,
+        )
+
+    def start(self) -> None:
+        self.transport.start()
+        self.pump.start()
+
+    def stop(self) -> None:
+        self.pump.stop()
+        self.transport.stop()
+
+    def __enter__(self) -> "DashboardServices":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

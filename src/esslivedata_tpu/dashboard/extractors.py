@@ -1,0 +1,86 @@
+"""Pull-based extractors over buffers (reference: dashboard/extractors.py —
+LatestValueExtractor:64, FullHistoryExtractor:90,
+WindowAggregatingExtractor:138). Subscribers are notified with *keys only*;
+extraction happens on pull (ADR 0007)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ..utils.labeled import DataArray, Variable
+from .temporal_buffers import Buffer, TemporalBuffer
+
+__all__ = [
+    "Extractor",
+    "FullHistoryExtractor",
+    "LatestValueExtractor",
+    "WindowAggregatingExtractor",
+]
+
+
+class Extractor:
+    wants_history = False
+
+    def extract(self, buffer: Buffer) -> Any:  # pragma: no cover - protocol
+        raise NotImplementedError
+
+
+class LatestValueExtractor(Extractor):
+    def extract(self, buffer: Buffer) -> Any:
+        return buffer.latest()
+
+
+class FullHistoryExtractor(Extractor):
+    """Concatenates scalar/0-d history into a 1-D time series DataArray;
+    for non-scalar entries returns the raw (timestamp, value) list."""
+
+    wants_history = True
+
+    def extract(self, buffer: Buffer) -> Any:
+        entries = buffer.history()
+        if not entries:
+            return None
+        first = entries[0][1]
+        if isinstance(first, DataArray) and first.data.ndim == 0:
+            times = np.array([t.ns for t, _ in entries], dtype=np.int64)
+            values = np.array([np.asarray(v.values) for _, v in entries])
+            return DataArray(
+                Variable(values, ("time",), first.unit),
+                coords={"time": Variable(times, ("time",), "ns")},
+                name=first.name,
+            )
+        return entries
+
+
+class WindowAggregatingExtractor(Extractor):
+    """Sum/mean over a trailing time window of structurally-equal entries."""
+
+    wants_history = True
+
+    def __init__(self, window_s: float, operation: str = "sum") -> None:
+        if operation not in ("sum", "mean"):
+            raise ValueError(f"Unknown aggregation {operation!r}")
+        self._window_s = window_s
+        self._operation = operation
+
+    def extract(self, buffer: Buffer) -> Any:
+        if isinstance(buffer, TemporalBuffer):
+            entries = buffer.window(self._window_s)
+        else:
+            entries = buffer.history()
+        if not entries:
+            return None
+        arrays = [v for _, v in entries if isinstance(v, DataArray)]
+        if not arrays:
+            return entries[-1][1]
+        result = arrays[0].copy()
+        for da in arrays[1:]:
+            if result.same_structure(da):
+                result += da
+            else:
+                result = da.copy()  # structure changed mid-window: restart
+        if self._operation == "mean" and len(arrays) > 1:
+            result.data = result.data * (1.0 / len(arrays))
+        return result

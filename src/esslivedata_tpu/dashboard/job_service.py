@@ -1,0 +1,132 @@
+"""Service + job tracking from heartbeats and acks.
+
+Parity with reference ``dashboard/job_service.py`` / ``service_registry.py``
+/ ``active_job_registry.py`` / ``pending_command_tracker.py``: services are
+known through their 2 s x5f2 heartbeats (stale after a timeout); jobs are
+known through those heartbeats too — including jobs this dashboard did not
+start, which are *adopted* (ADR 0008) so a dashboard restart recovers the
+fleet state; pending commands resolve on ack or expire.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+from ..core.job import JobStatus, ServiceStatus
+from .transport import AckMessage, StatusMessage
+
+__all__ = ["JobService", "PendingCommand", "TrackedService"]
+
+logger = logging.getLogger(__name__)
+
+SERVICE_STALE_S = 10.0
+COMMAND_EXPIRY_S = 10.0
+
+
+@dataclass
+class TrackedService:
+    service_id: str
+    status: ServiceStatus
+    last_seen_wall: float
+
+    @property
+    def is_stale(self) -> bool:
+        return time.monotonic() - self.last_seen_wall > SERVICE_STALE_S
+
+
+@dataclass
+class PendingCommand:
+    source_name: str
+    job_number: uuid.UUID
+    kind: str
+    issued_wall: float = field(default_factory=time.monotonic)
+    resolved: bool = False
+    error: str = ""
+
+    @property
+    def expired(self) -> bool:
+        return (
+            not self.resolved
+            and time.monotonic() - self.issued_wall > COMMAND_EXPIRY_S
+        )
+
+
+class JobService:
+    def __init__(self) -> None:
+        self._services: dict[str, TrackedService] = {}
+        self._jobs: dict[tuple[str, uuid.UUID], JobStatus] = {}
+        self._adopted: set[tuple[str, uuid.UUID]] = set()
+        self._known_started: set[tuple[str, uuid.UUID]] = set()
+        self._pending: list[PendingCommand] = []
+        self._lock = threading.Lock()
+
+    # -- ingestion callbacks ----------------------------------------------
+    def on_status(self, msg: StatusMessage) -> None:
+        with self._lock:
+            self._services[msg.service_id] = TrackedService(
+                service_id=msg.service_id,
+                status=msg.status,
+                last_seen_wall=time.monotonic(),
+            )
+            for job in msg.status.jobs:
+                key = (job.source_name, job.job_number)
+                if key not in self._jobs and key not in self._known_started:
+                    # heartbeat mentions a job we never started: adopt it
+                    self._adopted.add(key)
+                    logger.info("Adopted job %s/%s from heartbeat", *key)
+                self._jobs[key] = job
+
+    def on_ack(self, msg: AckMessage) -> None:
+        payload = msg.payload
+        try:
+            key = (payload["source_name"], uuid.UUID(payload["job_number"]))
+        except (KeyError, ValueError):
+            logger.warning("Malformed ack: %r", payload)
+            return
+        with self._lock:
+            for cmd in self._pending:
+                if (cmd.source_name, cmd.job_number) == key and not cmd.resolved:
+                    cmd.resolved = True
+                    if payload.get("status") == "error":
+                        cmd.error = payload.get("message", "error")
+                    break
+
+    # -- command tracking --------------------------------------------------
+    def track_command(
+        self, source_name: str, job_number: uuid.UUID, kind: str
+    ) -> PendingCommand:
+        cmd = PendingCommand(
+            source_name=source_name, job_number=job_number, kind=kind
+        )
+        with self._lock:
+            self._known_started.add((source_name, job_number))
+            self._pending.append(cmd)
+            self._pending = [
+                c for c in self._pending if not c.resolved or not c.expired
+            ][-100:]
+        return cmd
+
+    # -- views -------------------------------------------------------------
+    def services(self) -> list[TrackedService]:
+        with self._lock:
+            return list(self._services.values())
+
+    def jobs(self) -> list[JobStatus]:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def job(self, source_name: str, job_number: uuid.UUID) -> JobStatus | None:
+        with self._lock:
+            return self._jobs.get((source_name, job_number))
+
+    def is_adopted(self, source_name: str, job_number: uuid.UUID) -> bool:
+        with self._lock:
+            return (source_name, job_number) in self._adopted
+
+    def pending_commands(self) -> list[PendingCommand]:
+        with self._lock:
+            return [c for c in self._pending if not c.resolved]

@@ -1,0 +1,82 @@
+"""Kafka transport for the dashboard (reference: dashboard/kafka_transport.py:28).
+
+Consumes the per-instrument livedata data/status/responses topics and
+publishes commands. Requires confluent_kafka (optional [kafka] extra).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any
+
+from ..kafka.stream_mapping import LivedataTopics
+from .transport import DashboardMessage, decode_backend_message
+
+__all__ = ["DashboardKafkaTransport"]
+
+logger = logging.getLogger(__name__)
+
+
+class DashboardKafkaTransport:
+    def __init__(
+        self,
+        *,
+        instrument: str,
+        bootstrap: str = "localhost:9092",
+        dev: bool = False,
+        group_id: str | None = None,
+    ) -> None:
+        try:
+            from confluent_kafka import Consumer, Producer
+        except ImportError as err:  # pragma: no cover - env without kafka
+            raise RuntimeError(
+                "confluent_kafka is required for the Kafka transport; "
+                "install the [kafka] extra or use --transport fake"
+            ) from err
+        self._topics = LivedataTopics.for_instrument(instrument, dev)
+        self._kind_by_topic = {
+            self._topics.data: "data",
+            self._topics.status: "status",
+            self._topics.responses: "responses",
+        }
+        self._consumer = Consumer(
+            {
+                "bootstrap.servers": bootstrap,
+                "group.id": group_id or f"{instrument}_dashboard",
+                "auto.offset.reset": "latest",
+                "enable.auto.commit": False,
+            }
+        )
+        self._producer = Producer({"bootstrap.servers": bootstrap})
+
+    def start(self) -> None:
+        self._consumer.subscribe(list(self._kind_by_topic))
+
+    def stop(self) -> None:
+        self._consumer.close()
+        self._producer.flush(5)
+
+    def publish_command(self, payload: dict[str, Any]) -> None:
+        self._producer.produce(
+            self._topics.commands, json.dumps(payload).encode()
+        )
+        self._producer.poll(0)
+
+    def get_messages(self) -> list[DashboardMessage]:
+        out: list[DashboardMessage] = []
+        for raw in self._consumer.consume(100, 0.05) or []:
+            if raw.error() is not None:
+                logger.warning("Kafka error: %s", raw.error())
+                continue
+            kind = self._kind_by_topic.get(raw.topic())
+            if kind is None:
+                continue
+            try:
+                decoded = decode_backend_message(kind, raw.value())
+            except Exception:
+                logger.exception("Failed to decode message on %s", raw.topic())
+                continue
+            if decoded is not None:
+                out.append(decoded)
+        return out

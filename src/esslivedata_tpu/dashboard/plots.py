@@ -1,0 +1,165 @@
+"""Plotter registry + matplotlib rendering.
+
+Parity with reference ``dashboard/plotting_controller.py`` /
+``plotter_registry.py`` / ``plots.py`` at the architecture level: plotters
+are auto-selected from the *shape* of a DataArray (reference selects from
+template DataArrays, workflow_spec.py:366-383) and turn buffer contents
+into rendered artifacts. The reference emits HoloViews objects for Bokeh;
+here plotters render matplotlib (Agg) to PNG bytes for the web front end.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import threading
+from typing import Callable
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+
+from ..utils.labeled import DataArray, midpoints
+
+__all__ = ["PlotterRegistry", "plotter_registry", "render_png"]
+
+logger = logging.getLogger(__name__)
+
+# matplotlib's pyplot state is not thread-safe; the dashboard renders from
+# request handlers + ingestion threads.
+_render_lock = threading.Lock()
+
+
+def _coord_values(da: DataArray, dim: str) -> tuple[np.ndarray, str]:
+    if dim in da.coords:
+        coord = da.coords[dim]
+        vals = coord.numpy
+        if da.is_edges(dim, dim):
+            return vals, f"{dim} [{coord.unit!r}]"
+        return vals, f"{dim} [{coord.unit!r}]"
+    n = da.sizes[dim]
+    return np.arange(n + 1, dtype=float), dim
+
+
+class LinePlotter:
+    """1-D data: histogram steps (edge coords) or line (point coords)."""
+
+    def plot(self, ax, da: DataArray) -> None:
+        dim = da.dims[0]
+        x, label = _coord_values(da, dim)
+        y = np.asarray(da.values, dtype=np.float64)
+        if x.size == y.size + 1:
+            ax.stairs(y, x)
+        else:
+            ax.plot(x[: y.size], y)
+        ax.set_xlabel(label)
+        ax.set_ylabel(f"[{da.unit!r}]")
+
+
+class ImagePlotter:
+    """2-D data as pcolormesh with edge-aware axes."""
+
+    def plot(self, ax, da: DataArray) -> None:
+        ydim, xdim = da.dims
+        x, xlabel = _coord_values(da, xdim)
+        y, ylabel = _coord_values(da, ydim)
+        values = np.asarray(da.values, dtype=np.float64)
+        if x.size == values.shape[1]:
+            x = np.concatenate([x, [x[-1] + (x[-1] - x[-2] if x.size > 1 else 1)]])
+        if y.size == values.shape[0]:
+            y = np.concatenate([y, [y[-1] + (y[-1] - y[-2] if y.size > 1 else 1)]])
+        mesh = ax.pcolormesh(x, y, values, shading="flat")
+        ax.figure.colorbar(mesh, ax=ax, label=f"[{da.unit!r}]")
+        ax.set_xlabel(xlabel)
+        ax.set_ylabel(ylabel)
+
+
+class Overlay1DPlotter:
+    """2-D data where the leading dim is categorical (e.g. roi): one line
+    per category (reference Overlay1DPlotter:1343)."""
+
+    def plot(self, ax, da: DataArray) -> None:
+        cat_dim, dim = da.dims
+        x, label = _coord_values(da, dim)
+        values = np.asarray(da.values, dtype=np.float64)
+        for i in range(values.shape[0]):
+            y = values[i]
+            if x.size == y.size + 1:
+                ax.stairs(y, x, label=f"{cat_dim} {i}")
+            else:
+                ax.plot(x[: y.size], y, label=f"{cat_dim} {i}")
+        ax.legend(loc="upper right", fontsize="small")
+        ax.set_xlabel(label)
+        ax.set_ylabel(f"[{da.unit!r}]")
+
+
+class ScalarPlotter:
+    """0-d data: big number."""
+
+    def plot(self, ax, da: DataArray) -> None:
+        ax.axis("off")
+        ax.text(
+            0.5,
+            0.5,
+            f"{float(np.asarray(da.values)):.6g}\n[{da.unit!r}]",
+            ha="center",
+            va="center",
+            fontsize=22,
+            transform=ax.transAxes,
+        )
+
+
+class PlotterRegistry:
+    """Shape -> plotter selection, extensible (reference PlotterSpec:84)."""
+
+    CATEGORICAL_DIMS = {"roi", "channel", "bank"}
+
+    def __init__(self) -> None:
+        self._custom: list[tuple[Callable[[DataArray], bool], object]] = []
+
+    def register(self, predicate: Callable[[DataArray], bool], plotter) -> None:
+        self._custom.append((predicate, plotter))
+
+    def select(self, da: DataArray):
+        for predicate, plotter in self._custom:
+            try:
+                if predicate(da):
+                    return plotter
+            except Exception:
+                continue
+        ndim = da.data.ndim
+        if ndim == 0:
+            return ScalarPlotter()
+        if ndim == 1:
+            return LinePlotter()
+        if ndim == 2:
+            if da.dims[0] in self.CATEGORICAL_DIMS or (
+                da.shape[0] <= 8 and da.shape[1] >= 4 * da.shape[0]
+            ):
+                return Overlay1DPlotter()
+            return ImagePlotter()
+        raise ValueError(f"No plotter for {ndim}-d data")
+
+
+plotter_registry = PlotterRegistry()
+
+
+def render_png(
+    da: DataArray, *, title: str = "", figsize=(5.0, 3.6), dpi: int = 100
+) -> bytes:
+    """Render one DataArray to PNG bytes using the auto-selected plotter."""
+    with _render_lock:
+        fig, ax = plt.subplots(figsize=figsize, dpi=dpi)
+        try:
+            plotter = plotter_registry.select(da)
+            plotter.plot(ax, da)
+            if title:
+                ax.set_title(title, fontsize=9)
+            fig.tight_layout()
+            buf = io.BytesIO()
+            fig.savefig(buf, format="png")
+            return buf.getvalue()
+        finally:
+            plt.close(fig)

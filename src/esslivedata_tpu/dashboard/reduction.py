@@ -1,0 +1,75 @@
+"""Dashboard entry point (reference: dashboard/reduction.py ReductionApp:70).
+
+``--transport fake`` hosts the real backend services in-process over
+synthetic streams (full demo, zero infrastructure); ``--transport kafka``
+connects to a live broker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..config.instrument import instrument_registry
+from ..core.service import get_env_defaults, setup_arg_parser
+from .dashboard_services import DashboardServices
+from .web import make_app
+
+__all__ = ["main"]
+
+logger = logging.getLogger(__name__)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = setup_arg_parser("esslivedata-tpu dashboard")
+    parser.add_argument("--port", type=int, default=5007)
+    parser.add_argument("--transport", choices=["fake", "kafka"], default="fake")
+    parser.add_argument("--kafka-bootstrap", default="localhost:9092")
+    parser.add_argument("--events-per-pulse", type=int, default=2000)
+    parser.set_defaults(**get_env_defaults(parser))
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=args.log_level)
+
+    if args.instrument not in instrument_registry:
+        parser.error(
+            f"Unknown instrument {args.instrument!r}; "
+            f"known: {', '.join(instrument_registry.names())}"
+        )
+    instrument_registry[args.instrument].load_factories()
+
+    if args.transport == "fake":
+        from .fake_backend import InProcessBackendTransport
+
+        transport = InProcessBackendTransport(
+            args.instrument, events_per_pulse=args.events_per_pulse
+        )
+    else:
+        from .kafka_transport import DashboardKafkaTransport
+
+        transport = DashboardKafkaTransport(
+            instrument=args.instrument,
+            bootstrap=args.kafka_bootstrap,
+            dev=args.dev,
+        )
+
+    services = DashboardServices(transport=transport)
+    app = make_app(services, args.instrument)
+
+    async def serve() -> None:
+        services.start()
+        app.listen(args.port)
+        logger.info("Dashboard listening on http://localhost:%d", args.port)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            services.stop()
+
+    try:
+        asyncio.run(serve())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,108 @@
+"""Dashboard-side transport: typed messages in, commands out.
+
+Parity with reference ``dashboard/transport.py:15`` (Transport protocol
+with Kafka/Null/Fake impls). The dashboard never sees raw bytes above this
+seam — transports decode da00/x5f2/JSON into typed messages.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Any, Protocol, runtime_checkable
+
+from ..config.workflow_spec import ResultKey
+from ..core.job import ServiceStatus
+from ..core.timestamp import Timestamp
+from ..kafka import wire
+from ..kafka.da00_compat import da00_to_dataarray
+from ..utils.labeled import DataArray
+
+__all__ = [
+    "AckMessage",
+    "NullTransport",
+    "ResultMessage",
+    "StatusMessage",
+    "Transport",
+    "decode_backend_message",
+]
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True, slots=True)
+class ResultMessage:
+    key: ResultKey
+    timestamp: Timestamp
+    data: DataArray
+
+
+@dataclass(frozen=True, slots=True)
+class StatusMessage:
+    service_id: str
+    status: ServiceStatus
+
+
+@dataclass(frozen=True, slots=True)
+class AckMessage:
+    payload: dict
+
+
+DashboardMessage = ResultMessage | StatusMessage | AckMessage
+
+
+@runtime_checkable
+class Transport(Protocol):
+    def publish_command(self, payload: dict[str, Any]) -> None: ...
+
+    def get_messages(self) -> list[DashboardMessage]: ...
+
+    def start(self) -> None: ...
+
+    def stop(self) -> None: ...
+
+
+def decode_backend_message(
+    topic_kind: str, value: bytes
+) -> DashboardMessage | None:
+    """Decode one backend-produced payload. topic_kind is 'data',
+    'status' or 'responses' (derived from the topic name)."""
+    import json
+
+    if topic_kind == "data":
+        da00 = wire.decode_da00(value)
+        try:
+            key = ResultKey.from_string(da00.source_name)
+        except Exception:
+            logger.warning("Undecodable result key %r", da00.source_name)
+            return None
+        return ResultMessage(
+            key=key,
+            timestamp=Timestamp.from_ns(da00.timestamp_ns),
+            data=da00_to_dataarray(da00.variables, name=key.output_name),
+        )
+    if topic_kind == "status":
+        status = wire.decode_x5f2(value)
+        return StatusMessage(
+            service_id=status.service_id,
+            status=ServiceStatus.model_validate_json(status.status_json),
+        )
+    if topic_kind == "responses":
+        return AckMessage(payload=json.loads(value.decode("utf-8")))
+    return None
+
+
+class NullTransport:
+    """No backend at all (unit tests of pure-UI pieces)."""
+
+    def publish_command(self, payload: dict[str, Any]) -> None:
+        pass
+
+    def get_messages(self) -> list[DashboardMessage]:
+        return []
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass

@@ -1,0 +1,301 @@
+"""Web front end: tornado app serving live plots + workflow control.
+
+The reference serves a Panel/Bokeh app (dashboard/dashboard.py:32); Panel
+is unavailable here, so this is a deliberately small HTML front end over
+JSON + PNG endpoints with the same information architecture: a plot grid
+fed by keys-only change polling (the HTTP analog of ADR 0005's frame-gated
+session flush — clients repaint only when the data generation advances),
+a workflow-control sidebar, and service/job status.
+
+Endpoints:
+- GET  /                     HTML shell
+- GET  /api/state            generation + keys + services + jobs + specs
+- POST /api/workflow/start   {workflow_id, source_name, params}
+- POST /api/job/{action}     {source_name, job_number}   action: stop|reset|remove
+- POST /api/roi              {source_name, job_number, rois}
+- GET  /plot/{key}.png?gen=N rendered plot (key = urlsafe-b64 ResultKey)
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+
+import tornado.web
+
+from ..config.workflow_spec import ResultKey, WorkflowId
+from .dashboard_services import DashboardServices
+from .extractors import FullHistoryExtractor
+from .plots import render_png
+
+__all__ = ["make_app"]
+
+logger = logging.getLogger(__name__)
+
+
+def _key_to_id(key: ResultKey) -> str:
+    return base64.urlsafe_b64encode(key.to_string().encode()).decode()
+
+
+def _id_to_key(kid: str) -> ResultKey:
+    return ResultKey.from_string(base64.urlsafe_b64decode(kid.encode()).decode())
+
+
+class _Base(tornado.web.RequestHandler):
+    @property
+    def services(self) -> DashboardServices:
+        return self.application.settings["services"]
+
+    def write_json(self, payload) -> None:
+        self.set_header("Content-Type", "application/json")
+        self.write(json.dumps(payload))
+
+
+class StateHandler(_Base):
+    def get(self) -> None:
+        ds = self.services.data_service
+        js = self.services.job_service
+        orchestrator = self.services.orchestrator
+        instrument = self.application.settings["instrument"]
+        keys = [
+            {
+                "id": _key_to_id(k),
+                "source": k.job_id.source_name,
+                "output": k.output_name,
+                "workflow": str(k.workflow_id),
+                "job_number": str(k.job_id.job_number),
+            }
+            for k in ds.keys()
+        ]
+        self.write_json(
+            {
+                "generation": ds.generation,
+                "keys": keys,
+                "services": [
+                    {
+                        "service_id": s.service_id,
+                        "state": s.status.state,
+                        "stale": s.is_stale,
+                        "uptime_s": s.status.uptime_s,
+                    }
+                    for s in js.services()
+                ],
+                "jobs": [j.model_dump(mode="json") for j in js.jobs()],
+                "workflows": [
+                    {
+                        "workflow_id": str(spec.identifier),
+                        "title": spec.title or spec.name,
+                        "source_names": spec.source_names,
+                        "params_schema": (
+                            spec.params_model.model_json_schema()
+                            if spec.params_model
+                            else None
+                        ),
+                    }
+                    for spec in orchestrator.available_workflows(instrument)
+                ],
+                "pending_commands": [
+                    {
+                        "source_name": c.source_name,
+                        "job_number": str(c.job_number),
+                        "kind": c.kind,
+                        "error": c.error,
+                    }
+                    for c in js.pending_commands()
+                ],
+            }
+        )
+
+
+class StartWorkflowHandler(_Base):
+    def post(self) -> None:
+        body = json.loads(self.request.body or b"{}")
+        try:
+            wid = WorkflowId.parse(body["workflow_id"])
+            job_id, _ = self.services.orchestrator.start(
+                wid, body["source_name"], body.get("params") or {}
+            )
+        except Exception as err:
+            self.set_status(400)
+            self.write_json({"error": str(err)})
+            return
+        self.write_json({"job_number": str(job_id.job_number)})
+
+
+class JobActionHandler(_Base):
+    def post(self, action: str) -> None:
+        import uuid as _uuid
+
+        from ..config.workflow_spec import JobId
+
+        body = json.loads(self.request.body or b"{}")
+        try:
+            job_id = JobId(
+                source_name=body["source_name"],
+                job_number=_uuid.UUID(body["job_number"]),
+            )
+            method = {
+                "stop": self.services.orchestrator.stop,
+                "reset": self.services.orchestrator.reset,
+                "remove": self.services.orchestrator.remove,
+            }[action]
+        except Exception as err:
+            self.set_status(400)
+            self.write_json({"error": str(err)})
+            return
+        method(job_id)
+        self.write_json({"ok": True})
+
+
+class RoiHandler(_Base):
+    def post(self) -> None:
+        import uuid as _uuid
+
+        from ..config.workflow_spec import JobId
+
+        body = json.loads(self.request.body or b"{}")
+        try:
+            job_id = JobId(
+                source_name=body["source_name"],
+                job_number=_uuid.UUID(body["job_number"]),
+            )
+        except Exception as err:
+            self.set_status(400)
+            self.write_json({"error": str(err)})
+            return
+        self.services.orchestrator.set_rois(job_id, body.get("rois") or {})
+        self.write_json({"ok": True})
+
+
+class PlotHandler(_Base):
+    def get(self, kid: str) -> None:
+        try:
+            key = _id_to_key(kid)
+        except Exception:
+            self.set_status(404)
+            return
+        history = self.get_argument("history", "0") == "1"
+        extractor = FullHistoryExtractor() if history else None
+        data = self.services.data_service.get(key, extractor)
+        if data is None:
+            self.set_status(404)
+            return
+        title = f"{key.job_id.source_name} · {key.output_name}"
+        try:
+            png = render_png(data, title=title)
+        except Exception:
+            logger.exception("Plot render failed for %s", key)
+            self.set_status(500)
+            return
+        self.set_header("Content-Type", "image/png")
+        self.set_header("Cache-Control", "no-store")
+        self.write(png)
+
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>esslivedata-tpu · {instrument}</title>
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 0; background: #f4f5f7; }}
+ header {{ background: #1a2733; color: #fff; padding: 10px 16px; display: flex;
+           justify-content: space-between; align-items: baseline; }}
+ header small {{ color: #9fb3c8; }}
+ #layout {{ display: flex; }}
+ #side {{ width: 280px; padding: 12px; }}
+ #grid {{ flex: 1; display: flex; flex-wrap: wrap; gap: 10px; padding: 12px; }}
+ .card {{ background: #fff; border-radius: 6px; padding: 8px;
+          box-shadow: 0 1px 3px rgba(0,0,0,.15); }}
+ .card img {{ display: block; max-width: 520px; }}
+ button {{ margin: 2px; }}
+ .job {{ font-size: 12px; margin: 4px 0; }}
+ .state-active {{ color: #0a7d32; }} .state-error {{ color: #b00020; }}
+</style></head>
+<body>
+<header><div><b>esslivedata-tpu</b> — {instrument}</div>
+<small id="meta"></small></header>
+<div id="layout">
+ <div id="side" class="card">
+  <h3>Workflows</h3><div id="workflows"></div>
+  <h3>Jobs</h3><div id="jobs"></div>
+  <h3>Services</h3><div id="svcs"></div>
+ </div>
+ <div id="grid"></div>
+</div>
+<script>
+let gen = -1;
+async function refresh() {{
+  const r = await fetch('/api/state'); const s = await r.json();
+  document.getElementById('meta').textContent = 'generation ' + s.generation;
+  const wf = document.getElementById('workflows'); wf.innerHTML = '';
+  for (const w of s.workflows) {{
+    for (const src of w.source_names) {{
+      const b = document.createElement('button');
+      b.textContent = w.title + ' @ ' + src;
+      b.onclick = () => fetch('/api/workflow/start', {{method: 'POST',
+        body: JSON.stringify({{workflow_id: w.workflow_id, source_name: src}})}})
+        .then(refresh);
+      wf.appendChild(b); wf.appendChild(document.createElement('br'));
+    }}
+  }}
+  const jobs = document.getElementById('jobs'); jobs.innerHTML = '';
+  for (const j of s.jobs) {{
+    const d = document.createElement('div'); d.className = 'job';
+    d.innerHTML = `<span class="state-${{j.state}}">${{j.state}}</span>
+      ${{j.source_name}} <small>${{j.workflow_id}}</small>`;
+    const stop = document.createElement('button'); stop.textContent = 'stop';
+    stop.onclick = () => fetch('/api/job/stop', {{method: 'POST',
+      body: JSON.stringify({{source_name: j.source_name, job_number: j.job_number}})}});
+    d.appendChild(stop); jobs.appendChild(d);
+  }}
+  const svcs = document.getElementById('svcs'); svcs.innerHTML = '';
+  for (const sv of s.services) {{
+    const d = document.createElement('div'); d.className = 'job';
+    d.textContent = `${{sv.service_id}}: ${{sv.state}}` + (sv.stale ? ' (stale)' : '');
+    svcs.appendChild(d);
+  }}
+  if (s.generation !== gen) {{
+    gen = s.generation;
+    const grid = document.getElementById('grid');
+    const seen = new Set();
+    for (const k of s.keys) {{
+      seen.add(k.id);
+      let card = document.getElementById('card-' + k.id);
+      if (!card) {{
+        card = document.createElement('div'); card.className = 'card';
+        card.id = 'card-' + k.id;
+        const img = document.createElement('img'); img.id = 'img-' + k.id;
+        card.appendChild(img); grid.appendChild(card);
+      }}
+      document.getElementById('img-' + k.id).src =
+        '/plot/' + k.id + '.png?gen=' + gen;
+    }}
+    for (const card of [...grid.children]) {{
+      if (!seen.has(card.id.slice(5))) card.remove();
+    }}
+  }}
+}}
+setInterval(refresh, 1000); refresh();
+</script></body></html>
+"""
+
+
+class IndexHandler(_Base):
+    def get(self) -> None:
+        self.write(
+            _PAGE.format(instrument=self.application.settings["instrument"])
+        )
+
+
+def make_app(services: DashboardServices, instrument: str) -> tornado.web.Application:
+    return tornado.web.Application(
+        [
+            (r"/", IndexHandler),
+            (r"/api/state", StateHandler),
+            (r"/api/workflow/start", StartWorkflowHandler),
+            (r"/api/job/(stop|reset|remove)", JobActionHandler),
+            (r"/api/roi", RoiHandler),
+            (r"/plot/([A-Za-z0-9_\-=]+)\.png", PlotHandler),
+        ],
+        services=services,
+        instrument=instrument,
+    )

@@ -65,6 +65,25 @@ class WireError(ValueError):
     """Malformed or wrong-schema buffer."""
 
 
+def _np_vector(b: flatbuffers.Builder, arr: np.ndarray) -> int | None:
+    """CreateNumpyVector that is safe for empty arrays.
+
+    This flatbuffers runtime corrupts empty vectors written near
+    differently-aligned neighbors (the stored offset lands on adjacent
+    data), so empty arrays are not written at all — ``None`` means "omit
+    the slot"; an absent vector decodes as empty, which is semantically
+    identical in flatbuffers."""
+    arr = np.ascontiguousarray(arr)
+    if arr.size == 0:
+        return None
+    return b.CreateNumpyVector(arr)
+
+
+def _prepend_vec_slot(b: flatbuffers.Builder, slot: int, off: int | None) -> None:
+    if off is not None:
+        b.PrependUOffsetTRelativeSlot(slot, off, 0)
+
+
 def get_schema(buf: bytes) -> str:
     """4-char file identifier of a serialized message ('ev44', ...)."""
     if len(buf) < 8:
@@ -231,21 +250,20 @@ def encode_ev44(
     b = flatbuffers.Builder(1024)
     pid_off = None
     if pixel_id is not None and len(pixel_id) > 0:
-        pid_off = b.CreateNumpyVector(np.ascontiguousarray(pixel_id, np.int32))
-    tof_off = b.CreateNumpyVector(np.ascontiguousarray(time_of_flight, np.int32))
-    rti_off = b.CreateNumpyVector(
+        pid_off = _np_vector(b, np.ascontiguousarray(pixel_id, np.int32))
+    tof_off = _np_vector(b, np.ascontiguousarray(time_of_flight, np.int32))
+    rti_off = _np_vector(b, 
         np.ascontiguousarray(reference_time_index, np.int32)
     )
-    rt_off = b.CreateNumpyVector(np.ascontiguousarray(reference_time, np.int64))
+    rt_off = _np_vector(b, np.ascontiguousarray(reference_time, np.int64))
     src_off = b.CreateString(source_name)
     b.StartObject(6)
     b.PrependUOffsetTRelativeSlot(0, src_off, 0)
     b.PrependInt64Slot(1, message_id, 0)
-    b.PrependUOffsetTRelativeSlot(2, rt_off, 0)
-    b.PrependUOffsetTRelativeSlot(3, rti_off, 0)
-    b.PrependUOffsetTRelativeSlot(4, tof_off, 0)
-    if pid_off is not None:
-        b.PrependUOffsetTRelativeSlot(5, pid_off, 0)
+    _prepend_vec_slot(b, 2, rt_off)
+    _prepend_vec_slot(b, 3, rti_off)
+    _prepend_vec_slot(b, 4, tof_off)
+    _prepend_vec_slot(b, 5, pid_off)
     b.Finish(b.EndObject(), file_identifier=b"ev44")
     return bytes(b.Output())
 
@@ -277,11 +295,11 @@ class F144Message:
 def encode_f144(source_name: str, value, timestamp_ns: int) -> bytes:
     b = flatbuffers.Builder(256)
     val = np.atleast_1d(np.asarray(value, dtype=np.float64))
-    v_off = b.CreateNumpyVector(val)
+    v_off = _np_vector(b, val)
     src_off = b.CreateString(source_name)
     b.StartObject(3)
     b.PrependUOffsetTRelativeSlot(0, src_off, 0)
-    b.PrependUOffsetTRelativeSlot(1, v_off, 0)
+    _prepend_vec_slot(b, 1, v_off)
     b.PrependInt64Slot(2, timestamp_ns, 0)
     b.Finish(b.EndObject(), file_identifier=b"f144")
     return bytes(b.Output())
@@ -317,24 +335,29 @@ class Da00Message:
 
 
 def _encode_da00_variable(b: flatbuffers.Builder, var: Da00Variable) -> int:
+    # NB: np.ascontiguousarray promotes 0-d to 1-d — take the shape from
+    # the original array so scalars stay scalars on the wire.
+    shape = np.asarray(var.data).shape
     data = np.ascontiguousarray(var.data)
     code = _dtype_code(data)
-    data_off = b.CreateNumpyVector(data.reshape(-1).view(np.uint8))
-    shape_off = b.CreateNumpyVector(np.asarray(data.shape, dtype=np.int32))
-    axes_offs = [b.CreateString(a) for a in var.axes]
-    b.StartVector(4, len(axes_offs), 4)
-    for off in reversed(axes_offs):
-        b.PrependUOffsetTRelative(off)
-    axes_vec = b.EndVector()
+    data_off = _np_vector(b, data.reshape(-1).view(np.uint8))
+    shape_off = _np_vector(b, np.asarray(shape, dtype=np.int32))
+    axes_vec = None
+    if var.axes:
+        axes_offs = [b.CreateString(a) for a in var.axes]
+        b.StartVector(4, len(axes_offs), 4)
+        for off in reversed(axes_offs):
+            b.PrependUOffsetTRelative(off)
+        axes_vec = b.EndVector()
     unit_off = b.CreateString(var.unit)
     name_off = b.CreateString(var.name)
     b.StartObject(6)
     b.PrependUOffsetTRelativeSlot(0, name_off, 0)
     b.PrependUOffsetTRelativeSlot(1, unit_off, 0)
-    b.PrependUOffsetTRelativeSlot(2, axes_vec, 0)
-    b.PrependUOffsetTRelativeSlot(3, shape_off, 0)
+    _prepend_vec_slot(b, 2, axes_vec)
+    _prepend_vec_slot(b, 3, shape_off)
     b.PrependInt8Slot(4, code, 0)
-    b.PrependUOffsetTRelativeSlot(5, data_off, 0)
+    _prepend_vec_slot(b, 5, data_off)
     return b.EndObject()
 
 
@@ -363,11 +386,16 @@ def _decode_da00_variable(t: _Tbl) -> Da00Variable:
     dtype = _DTYPES[code]
     shape = tuple(int(s) for s in t.vector_np(3, np.int32))
     raw = t.vector_np(5, np.uint8)
-    n_items = int(np.prod(shape)) if shape else raw.size // dtype.itemsize
+    axes = tuple(t.strings(2))
+    if shape:
+        n_items = int(np.prod(shape))
+    else:
+        # Shape slot is omitted for 0-d (scalar) data; an absent shape with
+        # axes present means a 1-d vector whose length comes from the data.
+        n_items = raw.size // dtype.itemsize
+        shape = () if (not axes and n_items == 1) else (n_items,)
     data = raw.view(dtype)[:n_items].reshape(shape)
-    return Da00Variable(
-        name=t.string(0), unit=t.string(1), axes=tuple(t.strings(2)), data=data
-    )
+    return Da00Variable(name=t.string(0), unit=t.string(1), axes=axes, data=data)
 
 
 def decode_da00(buf: bytes) -> Da00Message:
@@ -395,15 +423,15 @@ def encode_ad00(source_name: str, timestamp_ns: int, data: np.ndarray) -> bytes:
     data = np.ascontiguousarray(data)
     b = flatbuffers.Builder(4096)
     code = _dtype_code(data)
-    data_off = b.CreateNumpyVector(data.reshape(-1).view(np.uint8))
-    shape_off = b.CreateNumpyVector(np.asarray(data.shape, dtype=np.int32))
+    data_off = _np_vector(b, data.reshape(-1).view(np.uint8))
+    shape_off = _np_vector(b, np.asarray(data.shape, dtype=np.int32))
     src_off = b.CreateString(source_name)
     b.StartObject(5)
     b.PrependUOffsetTRelativeSlot(0, src_off, 0)
     b.PrependInt64Slot(1, timestamp_ns, 0)
     b.PrependInt8Slot(2, code, 0)
-    b.PrependUOffsetTRelativeSlot(3, shape_off, 0)
-    b.PrependUOffsetTRelativeSlot(4, data_off, 0)
+    _prepend_vec_slot(b, 3, shape_off)
+    _prepend_vec_slot(b, 4, data_off)
     b.Finish(b.EndObject(), file_identifier=b"ad00")
     return bytes(b.Output())
 

@@ -11,7 +11,12 @@ __all__ = ["main", "make_reduction_service_builder"]
 
 
 def make_reduction_service_builder(
-    *, instrument: str, dev: bool = False, batcher=None, job_threads: int = 5
+    *,
+    instrument: str,
+    dev: bool = False,
+    batcher=None,
+    job_threads: int = 5,
+    heartbeat_interval_s: float = 2.0,
 ) -> DataServiceBuilder:
     def routes(mapping):
         return (
@@ -32,6 +37,7 @@ def make_reduction_service_builder(
         batcher=batcher,
         job_threads=job_threads,
         dev=dev,
+        heartbeat_interval_s=heartbeat_interval_s,
     )
 
 

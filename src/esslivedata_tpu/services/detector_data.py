@@ -10,7 +10,12 @@ __all__ = ["main", "make_detector_service_builder"]
 
 
 def make_detector_service_builder(
-    *, instrument: str, dev: bool = False, batcher=None, job_threads: int = 5
+    *,
+    instrument: str,
+    dev: bool = False,
+    batcher=None,
+    job_threads: int = 5,
+    heartbeat_interval_s: float = 2.0,
 ) -> DataServiceBuilder:
     def routes(mapping):
         return (
@@ -31,6 +36,7 @@ def make_detector_service_builder(
         batcher=batcher,
         job_threads=job_threads,
         dev=dev,
+        heartbeat_interval_s=heartbeat_interval_s,
     )
 
 

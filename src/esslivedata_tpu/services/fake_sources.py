@@ -50,11 +50,12 @@ class FakeDetectorStream:
             k = self._events_per_pulse
             # drifting hot spot over the id space
             center = (0.5 + 0.4 * np.sin(self._pulse / 50.0)) * self._ids.size
-            idx = np.clip(
-                self._rng.normal(center, self._ids.size / 8.0, k),
-                0,
-                self._ids.size - 1,
-            ).astype(np.int64)
+            # wrap, don't clip: clipping piles the gaussian tails onto the
+            # first/last pixel and dominates cumulative images
+            idx = (
+                self._rng.normal(center, self._ids.size / 8.0, k).astype(np.int64)
+                % self._ids.size
+            )
             pixel_id = self._ids[idx].astype(np.int32)
             toa = self._rng.uniform(0, PULSE_PERIOD_NS_NUM / PULSE_PERIOD_NS_DEN, k)
             buf = wire.encode_ev44(

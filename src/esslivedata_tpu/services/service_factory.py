@@ -57,6 +57,7 @@ class DataServiceBuilder:
         batcher: MessageBatcher | None = None,
         job_threads: int = 5,
         dev: bool = False,
+        heartbeat_interval_s: float = 2.0,
     ) -> None:
         self.instrument_name = instrument
         self.service_name = service_name
@@ -65,6 +66,7 @@ class DataServiceBuilder:
         self._batcher = batcher or AdaptiveMessageBatcher()
         self._job_threads = job_threads
         self._dev = dev
+        self._heartbeat_interval_s = heartbeat_interval_s
         self._instrument = instrument_registry[instrument]
         self._instrument.load_factories()
         self.stream_mapping = get_stream_mapping(self._instrument, dev)
@@ -92,6 +94,7 @@ class DataServiceBuilder:
             batcher=self._batcher,
             instrument=self.instrument_name,
             service_name=self.service_name,
+            heartbeat_interval_s=self._heartbeat_interval_s,
         )
         return Service(
             processor=processor,

@@ -1,0 +1,173 @@
+import threading
+import uuid
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.config.workflow_spec import JobId, ResultKey, WorkflowId
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.dashboard.data_service import DataService, DataSubscription
+from esslivedata_tpu.dashboard.extractors import (
+    FullHistoryExtractor,
+    LatestValueExtractor,
+    WindowAggregatingExtractor,
+)
+from esslivedata_tpu.dashboard.temporal_buffers import (
+    SingleValueBuffer,
+    TemporalBuffer,
+    TemporalBufferManager,
+)
+from esslivedata_tpu.utils import DataArray, Variable, linspace
+
+
+def key(output="image", source="bank0", job=None):
+    return ResultKey(
+        workflow_id=WorkflowId(instrument="dummy", name="view"),
+        job_id=JobId(source_name=source, job_number=job or uuid.uuid4()),
+        output_name=output,
+    )
+
+
+def da_1d(values, unit="counts"):
+    v = np.asarray(values, dtype=np.float64)
+    return DataArray(
+        Variable(v, ("toa",), unit),
+        coords={"toa": linspace("toa", 0, 10, len(v) + 1, "ns")},
+    )
+
+
+def scalar_da(value):
+    return DataArray(Variable(np.asarray(float(value)), (), "counts"))
+
+
+T = Timestamp.from_ns
+
+
+class TestBuffers:
+    def test_single_value_keeps_newest(self):
+        buf = SingleValueBuffer()
+        buf.put(T(10), "b")
+        buf.put(T(5), "a")  # older: ignored
+        assert buf.latest() == "b"
+
+    def test_temporal_buffer_budget_evicts_oldest(self):
+        buf = TemporalBuffer(max_bytes=3 * 8 * 4)  # room for ~3 4-float arrays
+        for i in range(10):
+            buf.put(T(i), da_1d(np.full(4, float(i))))
+        assert len(buf) < 10
+        assert float(buf.latest().values[0]) == 9.0
+
+    def test_temporal_window(self):
+        buf = TemporalBuffer()
+        for i in range(5):
+            buf.put(T(int(i * 1e9)), scalar_da(i))
+        recent = buf.window(2.0)
+        assert [float(v.values) for _, v in recent] == [2.0, 3.0, 4.0]
+
+    def test_manager_upgrades_to_history(self):
+        mgr = TemporalBufferManager()
+        k = key()
+        mgr.put(k, T(1), scalar_da(1))
+        assert isinstance(mgr.get(k), SingleValueBuffer)
+        mgr.require_history(k)
+        assert isinstance(mgr.get(k), TemporalBuffer)
+        mgr.put(k, T(2), scalar_da(2))
+        assert len(mgr.get(k).history()) == 2  # pre-upgrade value kept
+
+
+class TestDataService:
+    def test_put_get_latest(self):
+        ds = DataService()
+        k = key()
+        ds.put(k, T(1), da_1d([1, 2, 3]))
+        out = ds.get(k)
+        np.testing.assert_allclose(out.values, [1, 2, 3])
+
+    def test_transaction_single_notification(self):
+        ds = DataService()
+        k1, k2 = key("a"), key("b")
+        notifications = []
+        ds.subscribe(DataSubscription({k1, k2}, lambda ks: notifications.append(ks)))
+        with ds.transaction():
+            ds.put(k1, T(1), scalar_da(1))
+            ds.put(k2, T(1), scalar_da(2))
+        assert len(notifications) == 1
+        assert notifications[0] == {k1, k2}
+
+    def test_keys_only_notification_pull_extraction(self):
+        ds = DataService()
+        k = key()
+        seen = []
+
+        def on_updated(keys):
+            for kk in keys:
+                seen.append(ds.get(kk))
+
+        ds.subscribe(DataSubscription({k}, on_updated))
+        ds.put(k, T(1), scalar_da(42))
+        assert float(seen[0].values) == 42.0
+
+    def test_subscriber_failure_contained(self):
+        ds = DataService()
+        k = key()
+
+        def explode(keys):
+            raise RuntimeError("bad subscriber")
+
+        ds.subscribe(DataSubscription({k}, explode))
+        ds.put(k, T(1), scalar_da(1))  # must not raise
+
+    def test_history_subscription_enables_history(self):
+        ds = DataService()
+        k = key("counts")
+        ds.subscribe(DataSubscription({k}, lambda ks: None, FullHistoryExtractor()))
+        for i in range(5):
+            ds.put(k, T(int(i * 1e9)), scalar_da(i))
+        series = ds.get(k, FullHistoryExtractor())
+        assert series.sizes == {"time": 5}
+        np.testing.assert_allclose(series.values, [0, 1, 2, 3, 4])
+
+    def test_window_aggregation(self):
+        ds = DataService()
+        k = key("current")
+        ds.subscribe(
+            DataSubscription({k}, lambda ks: None, WindowAggregatingExtractor(10.0))
+        )
+        for i in range(3):
+            ds.put(k, T(int(i * 1e9)), da_1d([1.0, 1.0]))
+        agg = ds.get(k, WindowAggregatingExtractor(10.0))
+        np.testing.assert_allclose(agg.values, [3.0, 3.0])
+
+    def test_generation_advances(self):
+        ds = DataService()
+        g0 = ds.generation
+        with ds.transaction():
+            ds.put(key(), T(1), scalar_da(1))
+        assert ds.generation == g0 + 1
+
+    def test_concurrent_writers_readers(self):
+        ds = DataService()
+        k = key()
+        errors = []
+
+        def writer():
+            try:
+                for i in range(200):
+                    with ds.transaction():
+                        ds.put(k, T(i), scalar_da(i))
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        def reader():
+            try:
+                for _ in range(200):
+                    ds.get(k)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer), threading.Thread(target=reader)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors

@@ -1,0 +1,108 @@
+import json
+
+import numpy as np
+import pytest
+
+tornado = pytest.importorskip("tornado")
+
+from tornado.httpserver import HTTPServer
+from tornado.testing import AsyncHTTPTestCase
+
+from esslivedata_tpu.config.instruments.dummy.specs import DETECTOR_VIEW_HANDLE
+from esslivedata_tpu.dashboard.dashboard_services import DashboardServices
+from esslivedata_tpu.dashboard.fake_backend import InProcessBackendTransport
+from esslivedata_tpu.dashboard.plots import render_png
+from esslivedata_tpu.utils import DataArray, Variable, linspace
+
+
+class TestPlotRendering:
+    def test_line_plot(self):
+        da = DataArray(
+            Variable(np.arange(10.0), ("toa",), "counts"),
+            coords={"toa": linspace("toa", 0, 100, 11, "ns")},
+        )
+        png = render_png(da, title="spectrum")
+        assert png[:8] == b"\x89PNG\r\n\x1a\n"
+
+    def test_image_plot(self):
+        da = DataArray(
+            Variable(np.random.default_rng(0).random((16, 16)), ("y", "x"), "counts"),
+            coords={
+                "x": linspace("x", 0, 1, 17, "m"),
+                "y": linspace("y", 0, 1, 17, "m"),
+            },
+        )
+        assert render_png(da)[:4] == b"\x89PNG"
+
+    def test_scalar_plot(self):
+        da = DataArray(Variable(np.asarray(42.0), (), "counts"))
+        assert render_png(da)[:4] == b"\x89PNG"
+
+    def test_roi_overlay_plot(self):
+        da = DataArray(
+            Variable(np.ones((2, 20)), ("roi", "toa"), "counts"),
+            coords={"toa": linspace("toa", 0, 100, 21, "ns")},
+        )
+        assert render_png(da)[:4] == b"\x89PNG"
+
+
+class WebApiTest(AsyncHTTPTestCase):
+    def get_app(self):
+        from esslivedata_tpu.dashboard.web import make_app
+
+        self.transport = InProcessBackendTransport("dummy", events_per_pulse=100)
+        self.services = DashboardServices(transport=self.transport)
+        return make_app(self.services, "dummy")
+
+    def drive(self, n=10):
+        for _ in range(n):
+            self.transport.tick()
+            self.services.pump.pump_once()
+
+    def test_index_page(self):
+        response = self.fetch("/")
+        assert response.code == 200
+        assert b"esslivedata-tpu" in response.body
+
+    def test_state_and_plots(self):
+        import time
+
+        response = self.fetch("/api/state")
+        state = json.loads(response.body)
+        assert any(w["workflow_id"].endswith("panel_view/v1") for w in state["workflows"])
+
+        start = self.fetch(
+            "/api/workflow/start",
+            method="POST",
+            body=json.dumps(
+                {
+                    "workflow_id": str(DETECTOR_VIEW_HANDLE.workflow_id),
+                    "source_name": "panel_0",
+                }
+            ),
+        )
+        assert start.code == 200
+        time.sleep(0.1)  # allow heartbeat interval to elapse
+        self.drive(20)
+
+        state = json.loads(self.fetch("/api/state").body)
+        assert state["keys"]
+        assert state["generation"] > 0
+        assert any(j["state"] == "active" for j in state["jobs"])
+        key_id = next(
+            k["id"] for k in state["keys"] if k["output"] == "image_cumulative"
+        )
+        plot = self.fetch(f"/plot/{key_id}.png")
+        assert plot.code == 200
+        assert plot.body[:4] == b"\x89PNG"
+
+    def test_unknown_plot_404(self):
+        assert self.fetch("/plot/bm9wZQ==.png").code == 404
+
+    def test_bad_workflow_400(self):
+        response = self.fetch(
+            "/api/workflow/start",
+            method="POST",
+            body=json.dumps({"workflow_id": "dummy/x/nope/v1", "source_name": "s"}),
+        )
+        assert response.code == 400
