@@ -59,6 +59,9 @@ class Instrument:
     detectors: dict[str, DetectorConfig] = field(default_factory=dict)
     monitors: dict[str, MonitorConfig] = field(default_factory=dict)
     log_sources: dict[str, str] = field(default_factory=dict)  # stream -> source
+    merge_detectors: bool = False
+    """Adapt every detector bank onto one logical 'detector' stream
+    (BIFROST pattern, reference message_adapter.py:416)."""
     _factories_module: str | None = None
     _specs_module: str | None = None
     _loaded: bool = field(default=False, repr=False)

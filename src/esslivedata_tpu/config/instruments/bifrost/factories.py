@@ -1,0 +1,13 @@
+"""BIFROST factories."""
+
+from __future__ import annotations
+
+from ....workflows.multibank import MultiBankViewWorkflow
+from .specs import BANK_DETECTOR_NUMBERS, MULTIBANK_HANDLE
+
+
+@MULTIBANK_HANDLE.attach_factory
+def make_multibank(*, source_name: str, params) -> MultiBankViewWorkflow:
+    return MultiBankViewWorkflow(
+        bank_detector_numbers=BANK_DETECTOR_NUMBERS, params=params
+    )

@@ -1,0 +1,68 @@
+"""BIFROST declaration: 9 triplet banks, merged into one logical stream.
+
+The real instrument's banks come from its NeXus geometry; here each of the
+9 analyzer triplets is a 100x30 pixel bank with contiguous detector-number
+blocks — the right topology for the merged-stream + bank-sharded reduction
+path. Q-E per-analyzer rebinning maps (the full spectrometer physics)
+belong on top of the same per-bank kernel via a qmap (ops/qhistogram.py)
+and are a planned extension.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ....config.instrument import DetectorConfig, Instrument, instrument_registry
+from ....config.workflow_spec import OutputSpec, WorkflowSpec
+from ....workflows.multibank import MultiBankParams
+from ....workflows.workflow_factory import workflow_registry
+
+N_BANKS = 9
+BANK_NY, BANK_NX = 100, 30
+PIXELS_PER_BANK = BANK_NY * BANK_NX
+
+INSTRUMENT = Instrument(
+    name="bifrost",
+    merge_detectors=True,
+    _factories_module="esslivedata_tpu.config.instruments.bifrost.factories",
+)
+
+BANK_DETECTOR_NUMBERS: dict[str, np.ndarray] = {}
+for b in range(N_BANKS):
+    start = 1 + b * PIXELS_PER_BANK
+    det = np.arange(start, start + PIXELS_PER_BANK).reshape(BANK_NY, BANK_NX)
+    name = f"triplet_{b}"
+    BANK_DETECTOR_NUMBERS[name] = det
+    INSTRUMENT.add_detector(
+        DetectorConfig(
+            name=name,
+            source_name=f"bifrost_{name}",
+            detector_number=det,
+            projection="logical",
+        )
+    )
+instrument_registry.register(INSTRUMENT)
+
+# The merged stream name all banks adapt onto (merge_detectors routing).
+MERGED_STREAM = "detector"
+
+MULTIBANK_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="bifrost",
+        namespace="spectrometer",
+        name="bank_overview",
+        title="9-bank overview (mesh-shardable)",
+        source_names=[MERGED_STREAM],
+        params_model=MultiBankParams,
+        outputs={
+            "bank_spectra_current": OutputSpec(title="Per-bank TOA spectra"),
+            "bank_spectra_cumulative": OutputSpec(
+                title="Per-bank TOA spectra (since start)", view="since_start"
+            ),
+            "bank_counts_current": OutputSpec(title="Per-bank counts"),
+            "counts_cumulative": OutputSpec(
+                title="Total counts (since start)", view="since_start"
+            ),
+        },
+    )
+)

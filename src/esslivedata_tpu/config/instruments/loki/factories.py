@@ -1,0 +1,65 @@
+"""LOKI factories: projection tables + kernels built lazily."""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from ....workflows.detector_view.projectors import ProjectionTable, project_geometric
+from ....workflows.detector_view.workflow import DetectorViewWorkflow
+from ....workflows.monitor_workflow import MonitorWorkflow
+from ....workflows.sans import SansIQWorkflow
+from ....workflows.timeseries import TimeseriesWorkflow
+from .specs import (
+    DETECTOR_VIEW_HANDLE,
+    INSTRUMENT,
+    MONITOR_HANDLE,
+    SANS_IQ_HANDLE,
+    TIMESERIES_HANDLE,
+)
+
+
+@lru_cache(maxsize=None)
+def _projection_for(detector_name: str) -> ProjectionTable:
+    det = INSTRUMENT.detectors[detector_name]
+    return project_geometric(
+        det.positions,
+        det.pixel_ids,
+        mode=det.projection,
+        resolution=det.resolution,
+        noise_sigma=det.noise_sigma,
+        n_replica=det.n_replica,
+    )
+
+
+@DETECTOR_VIEW_HANDLE.attach_factory
+def make_detector_view(*, source_name: str, params) -> DetectorViewWorkflow:
+    return DetectorViewWorkflow(
+        projection=_projection_for(source_name), params=params
+    )
+
+
+@MONITOR_HANDLE.attach_factory
+def make_monitor(*, source_name: str, params) -> MonitorWorkflow:
+    return MonitorWorkflow(params=params)
+
+
+@SANS_IQ_HANDLE.attach_factory
+def make_sans_iq(*, source_name: str, params, aux_source_names=None) -> SansIQWorkflow:
+    det = INSTRUMENT.detectors[source_name]
+    monitors = (
+        {aux_source_names["monitor"]}
+        if aux_source_names and "monitor" in aux_source_names
+        else set(INSTRUMENT.monitor_names)
+    )
+    return SansIQWorkflow(
+        positions=det.positions,
+        pixel_ids=det.pixel_ids,
+        params=params,
+        primary_stream=source_name,
+        monitor_streams=monitors,
+    )
+
+
+@TIMESERIES_HANDLE.attach_factory
+def make_timeseries(*, source_name: str, params) -> TimeseriesWorkflow:
+    return TimeseriesWorkflow()

@@ -1,0 +1,21 @@
+"""Synthetic LOKI rear-bank geometry (see specs.py docstring)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+NY, NX = 256, 256
+EXTENT_M = 1.0  # 1 m x 1 m active area
+Z_M = 5.0  # sample->bank distance
+
+
+def rear_bank_geometry() -> tuple[np.ndarray, np.ndarray]:
+    """Returns ([n, 3] positions in m, [n] pixel ids starting at 1)."""
+    xs = np.linspace(-EXTENT_M / 2, EXTENT_M / 2, NX)
+    ys = np.linspace(-EXTENT_M / 2, EXTENT_M / 2, NY)
+    gx, gy = np.meshgrid(xs, ys)
+    positions = np.stack(
+        [gx.reshape(-1), gy.reshape(-1), np.full(NX * NY, Z_M)], axis=1
+    )
+    pixel_ids = np.arange(1, NX * NY + 1)
+    return positions, pixel_ids

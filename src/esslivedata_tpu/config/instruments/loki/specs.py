@@ -1,0 +1,121 @@
+"""LOKI instrument declaration + spec registration.
+
+Geometry note: the real LOKI loads bank positions from a NeXus geometry
+file (reference: preprocessors/detector_data.py geometry registry with
+pooch-fetched files). This environment has no geometry artifacts, so the
+rear SANS bank is synthesized analytically: a 256x256 pixel plane,
+1 m x 1 m, 5 m downstream of the sample — the right scale and topology for
+the detector-view and I(Q) paths; swap in NeXus-derived positions when
+artifacts are available (see loki/geometry.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ....config.instrument import (
+    DetectorConfig,
+    Instrument,
+    MonitorConfig,
+    instrument_registry,
+)
+from ....config.workflow_spec import OutputSpec, WorkflowSpec
+from ....workflows.detector_view.workflow import DetectorViewParams
+from ....workflows.monitor_workflow import MonitorParams
+from ....workflows.sans import SansIQParams
+from ....workflows.workflow_factory import workflow_registry
+from .geometry import rear_bank_geometry
+
+INSTRUMENT = Instrument(
+    name="loki",
+    _factories_module="esslivedata_tpu.config.instruments.loki.factories",
+)
+
+_positions, _pixel_ids = rear_bank_geometry()
+INSTRUMENT.add_detector(
+    DetectorConfig(
+        name="larmor_detector",
+        source_name="loki_rear_detector",
+        positions=_positions,
+        pixel_ids=_pixel_ids,
+        projection="xy_plane",
+        resolution=(256, 256),
+        noise_sigma=0.002,
+        n_replica=4,
+    )
+)
+INSTRUMENT.add_monitor(MonitorConfig(name="monitor_1", source_name="loki_mon_1"))
+INSTRUMENT.add_monitor(MonitorConfig(name="monitor_2", source_name="loki_mon_2"))
+INSTRUMENT.add_log("sample_stage_x", "loki_mtr_sx")
+INSTRUMENT.add_log("sample_temperature", "loki_temp_1")
+instrument_registry.register(INSTRUMENT)
+
+DETECTOR_VIEW_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="loki",
+        namespace="detector_view",
+        name="rear_view",
+        title="Rear bank 2-D view",
+        source_names=INSTRUMENT.detector_names,
+        params_model=DetectorViewParams,
+        outputs={
+            "image_current": OutputSpec(title="Image (window)"),
+            "image_cumulative": OutputSpec(
+                title="Image (since start)", view="since_start"
+            ),
+            "spectrum_current": OutputSpec(title="TOA spectrum"),
+            "spectrum_cumulative": OutputSpec(
+                title="TOA spectrum (since start)", view="since_start"
+            ),
+            "counts_current": OutputSpec(title="Counts (window)"),
+            "counts_cumulative": OutputSpec(
+                title="Counts (since start)", view="since_start"
+            ),
+            "roi_spectra": OutputSpec(title="ROI spectra"),
+        },
+    )
+)
+
+MONITOR_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="loki",
+        namespace="monitor_data",
+        name="histogram",
+        title="Monitor TOA histogram",
+        source_names=INSTRUMENT.monitor_names,
+        params_model=MonitorParams,
+        outputs={
+            "current": OutputSpec(title="Monitor (window)"),
+            "cumulative": OutputSpec(title="Monitor (since start)", view="since_start"),
+        },
+    )
+)
+
+SANS_IQ_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="loki",
+        namespace="sans",
+        name="iq",
+        title="Monitor-normalized I(Q)",
+        source_names=INSTRUMENT.detector_names,
+        aux_source_names={"monitor": INSTRUMENT.monitor_names},
+        params_model=SansIQParams,
+        outputs={
+            "iq_current": OutputSpec(title="I(Q) (window)"),
+            "iq_cumulative": OutputSpec(title="I(Q) (since start)", view="since_start"),
+            "counts_q_current": OutputSpec(title="Q counts (window)"),
+            "monitor_counts_current": OutputSpec(title="Monitor counts"),
+        },
+    )
+)
+
+TIMESERIES_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="loki",
+        namespace="timeseries",
+        name="log",
+        title="Log timeseries",
+        source_names=sorted(INSTRUMENT.log_sources),
+        reset_on_run_transition=False,
+    )
+)

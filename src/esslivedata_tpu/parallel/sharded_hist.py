@@ -107,6 +107,9 @@ class ShardedHistogrammer:
             out_specs=P("bank", None),
         )
         self._normalize = jax.jit(norm(self._normalize_local))
+        self._clear_window = jax.jit(
+            lambda cum, win: (cum, jnp.zeros_like(win)), donate_argnums=(0, 1)
+        )
 
     # -- local (per-shard) kernels ---------------------------------------
     def _step_local(self, cum, win, pixel_id, toa):
@@ -170,6 +173,10 @@ class ShardedHistogrammer:
         """Accumulate one padded global batch (host or device arrays)."""
         pid, t = self._shard_events(pixel_id, toa)
         cum, win = self._step(state.cumulative, state.window, pid, t)
+        return HistogramState(cumulative=cum, window=win)
+
+    def clear_window(self, state: HistogramState) -> HistogramState:
+        cum, win = self._clear_window(state.cumulative, state.window)
         return HistogramState(cumulative=cum, window=win)
 
     def normalized(self, hist: jax.Array, monitor_counts) -> jax.Array:

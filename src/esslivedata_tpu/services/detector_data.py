@@ -17,10 +17,14 @@ def make_detector_service_builder(
     job_threads: int = 5,
     heartbeat_interval_s: float = 2.0,
 ) -> DataServiceBuilder:
+    from ..config.instrument import instrument_registry
+
+    merge = instrument_registry[instrument].merge_detectors
+
     def routes(mapping):
         return (
             RoutingAdapterBuilder(stream_mapping=mapping)
-            .with_detector_route()
+            .with_detector_route(merge_detectors=merge)
             .with_area_detector_route()
             .with_logdata_route()
             .with_run_control_route()

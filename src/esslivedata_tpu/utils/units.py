@@ -97,6 +97,13 @@ class Unit:
         for name, u in _REGISTRY.items():
             if u.dims == self.dims and math.isclose(u.scale, self.scale, rel_tol=1e-12):
                 return name
+        # Well-known compound spellings (kept parseable for wire round trip).
+        for spec in _REPR_ALIASES:
+            u = unit(spec)
+            if u.dims == self.dims and math.isclose(
+                u.scale, self.scale, rel_tol=1e-12
+            ):
+                return spec
         num, den = [], []
         for d, e in zip(_DIMS, self.dims, strict=True):
             if e == 0:
@@ -185,6 +192,18 @@ _register("bar", 1e5, mass=1, length=-1, time=-2)
 _register("mbar", 1e2, mass=1, length=-1, time=-2)
 _register("W", 1.0, mass=1, length=2, time=-3)
 _register("MW", 1e6, mass=1, length=2, time=-3)
+
+
+_REPR_ALIASES = (
+    "1/angstrom",
+    "1/nm",
+    "1/m",
+    "counts/s",
+    "m/s",
+    "mm/s",
+    "deg/s",
+    "rad/s",
+)
 
 
 def _parse_token(tok: str) -> Unit:

@@ -1,0 +1,115 @@
+"""Monitor-normalized streaming SANS I(Q) workflow (BASELINE config 4).
+
+The reference's LOKI I(Q) runs esssans' sciline graph per cycle
+(reference: instruments/loki/factories.py:21-120); here the whole reduction
+is the precompiled Q-map scatter kernel (ops/qhistogram.py) plus a
+monitor-ratio at finalize. The monitor arrives as an aux stream of staged
+events (ADR-0002-style aux binding through WorkflowConfig.aux_source_names).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any
+
+import numpy as np
+from pydantic import BaseModel, ConfigDict, Field
+
+from ..config.models import PULSE_PERIOD_NS, TOARange
+from ..ops.event_batch import EventBatch
+from ..ops.qhistogram import QHistogrammer, build_sans_qmap
+from ..preprocessors.event_data import StagedEvents
+from ..utils.labeled import DataArray, Variable
+
+__all__ = ["SansIQParams", "SansIQWorkflow"]
+
+
+class SansIQParams(BaseModel):
+    model_config = ConfigDict(frozen=True)
+
+    q_bins: int = 100
+    q_min: float = 0.005  # 1/angstrom
+    q_max: float = 0.5
+    toa_bins: int = 200  # resolution of the TOF->lambda mapping
+    toa_range: TOARange = Field(default_factory=TOARange)
+    l1: float = 23.0  # m, source->sample
+
+
+class SansIQWorkflow:
+    """Detector events -> I(Q); aux monitor events -> normalization."""
+
+    def __init__(
+        self,
+        *,
+        positions: np.ndarray,
+        pixel_ids: np.ndarray,
+        params: SansIQParams | None = None,
+        primary_stream: str | None = None,
+        monitor_streams: set[str] | None = None,
+    ) -> None:
+        params = params or SansIQParams()
+        self._params = params
+        q_edges = np.linspace(params.q_min, params.q_max, params.q_bins + 1)
+        toa_edges = np.linspace(
+            params.toa_range.low, params.toa_range.high, params.toa_bins + 1
+        )
+        qmap = build_sans_qmap(
+            positions=positions,
+            pixel_ids=pixel_ids,
+            toa_edges=toa_edges,
+            q_edges=q_edges,
+            l1=params.l1,
+        )
+        self._hist = QHistogrammer(
+            qmap=qmap, toa_edges=toa_edges, n_q=params.q_bins
+        )
+        self._state = self._hist.init_state()
+        self._q_edges_var = Variable(q_edges, ("Q",), "1/angstrom")
+        self._primary_stream = primary_stream
+        self._monitor_streams = monitor_streams or set()
+
+    def accumulate(self, data: Mapping[str, Any]) -> None:
+        monitor_count = 0.0
+        detector: EventBatch | None = None
+        for key, value in data.items():
+            if not isinstance(value, StagedEvents):
+                continue
+            if key in self._monitor_streams:
+                monitor_count += float(value.n_events)
+            elif self._primary_stream is None or key == self._primary_stream:
+                detector = value.batch
+        if detector is not None or monitor_count:
+            if detector is None:
+                # monitor-only window: empty padded batch keeps shapes static
+                detector = EventBatch.from_arrays(
+                    np.empty(0, dtype=np.int32), np.empty(0, dtype=np.float32)
+                )
+            self._state = self._hist.step(self._state, detector, monitor_count)
+
+    def _iq(self, counts: np.ndarray, monitor: float) -> DataArray:
+        norm = counts / max(monitor, 1.0)
+        return DataArray(
+            Variable(norm, ("Q",), ""),
+            coords={"Q": self._q_edges_var},
+        )
+
+    def finalize(self) -> dict[str, DataArray]:
+        win = np.asarray(self._state.window)
+        cum = np.asarray(self._state.cumulative)
+        mon_win = float(np.asarray(self._state.monitor_window))
+        mon_cum = float(np.asarray(self._state.monitor_cumulative))
+        self._state = self._hist.clear_window(self._state)
+        coords = {"Q": self._q_edges_var}
+        return {
+            "iq_current": self._iq(win, mon_win),
+            "iq_cumulative": self._iq(cum, mon_cum),
+            "counts_q_current": DataArray(
+                Variable(win, ("Q",), "counts"), coords=coords
+            ),
+            "monitor_counts_current": DataArray(
+                Variable(np.asarray(mon_win), (), "counts")
+            ),
+        }
+
+    def clear(self) -> None:
+        self._state = self._hist.clear()

@@ -142,7 +142,16 @@ class WorkflowFactory(Mapping[WorkflowId, WorkflowSpec]):
                 f"Workflow {wid} has a spec but no attached factory — "
                 "did the instrument's factories module load?"
             ) from err
-        return factory(source_name=source, params=params)
+        # Factories may opt in to the resolved aux bindings by declaring an
+        # ``aux_source_names`` keyword (reference: workflow_factory.py
+        # introspects factory signatures, :387-401).
+        import inspect
+
+        kwargs: dict[str, Any] = {"source_name": source, "params": params}
+        sig = inspect.signature(factory)
+        if "aux_source_names" in sig.parameters:
+            kwargs["aux_source_names"] = dict(config.aux_source_names)
+        return factory(**kwargs)
 
     def clear(self) -> None:
         """Testing hook: drop all registrations."""

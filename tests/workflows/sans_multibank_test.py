@@ -1,0 +1,216 @@
+import numpy as np
+import pytest
+
+from esslivedata_tpu.core import Timestamp
+from esslivedata_tpu.ops.qhistogram import QHistogrammer, build_sans_qmap
+from esslivedata_tpu.preprocessors import DetectorEvents, MonitorEvents, ToEventBatch
+from esslivedata_tpu.workflows.multibank import MultiBankParams, MultiBankViewWorkflow
+from esslivedata_tpu.workflows.sans import SansIQParams, SansIQWorkflow
+
+T0 = Timestamp.from_ns(0)
+
+
+def stage(pixel_id, toa):
+    acc = ToEventBatch(min_bucket=16)
+    acc.add(
+        T0,
+        DetectorEvents(
+            pixel_id=np.asarray(pixel_id, dtype=np.int32),
+            time_of_arrival=np.asarray(toa, dtype=np.float32),
+        ),
+    )
+    return acc.get()
+
+
+def stage_monitor(n):
+    acc = ToEventBatch(min_bucket=16)
+    acc.add(
+        T0, MonitorEvents(time_of_arrival=np.linspace(1, 1000, n).astype(np.float32))
+    )
+    return acc.get()
+
+
+class TestQmap:
+    def make_geometry(self):
+        # 3 pixels: on-axis (theta=0 -> Q=0, outside q range), and two
+        # off-axis at different angles
+        positions = np.array(
+            [[0.0, 0.0, 5.0], [0.5, 0.0, 5.0], [2.0, 0.0, 5.0]]
+        )
+        pixel_ids = np.array([1, 2, 3])
+        return positions, pixel_ids
+
+    def test_qmap_physics(self):
+        positions, pixel_ids = self.make_geometry()
+        toa_edges = np.linspace(0.0, 71e6, 101)
+        q_edges = np.linspace(0.005, 0.5, 51)
+        qmap = build_sans_qmap(
+            positions=positions,
+            pixel_ids=pixel_ids,
+            toa_edges=toa_edges,
+            q_edges=q_edges,
+            l1=23.0,
+        )
+        assert qmap.shape == (4, 100)
+        assert (qmap[0] == -1).all()  # id 0 unused
+        assert (qmap[1] == -1).all()  # on-axis: Q=0 below q_min
+        # larger angle pixel -> larger Q at equal TOA
+        tb = 50
+        assert qmap[3, tb] >= qmap[2, tb] or qmap[3, tb] == -1
+        # later arrival (longer lambda) -> smaller Q for same pixel
+        valid = (qmap[2] >= 0).nonzero()[0]
+        if len(valid) > 2:
+            assert qmap[2, valid[0]] >= qmap[2, valid[-1]]
+
+    def test_qhistogrammer_counts_and_monitor(self):
+        positions, pixel_ids = self.make_geometry()
+        toa_edges = np.linspace(0.0, 71e6, 101)
+        q_edges = np.linspace(0.005, 0.5, 51)
+        qmap = build_sans_qmap(
+            positions=positions,
+            pixel_ids=pixel_ids,
+            toa_edges=toa_edges,
+            q_edges=q_edges,
+        )
+        h = QHistogrammer(qmap=qmap, toa_edges=toa_edges, n_q=50)
+        state = h.init_state()
+        batch = stage([2, 2, 3, 1], [1e6, 1e6, 2e6, 3e6]).batch
+        state = h.step(state, batch, monitor_count=100.0)
+        win = np.asarray(state.window)
+        # pixel 1 (on-axis) dropped; pixels 2,3 land if their q in range
+        expected = sum(
+            1
+            for p, t in [(2, 1e6), (2, 1e6), (3, 2e6)]
+            if qmap[p, int(t / 71e6 * 100)] >= 0
+        )
+        assert win.sum() == expected
+        assert float(np.asarray(state.monitor_window)) == 100.0
+        state = h.clear_window(state)
+        assert np.asarray(state.window).sum() == 0
+        assert np.asarray(state.cumulative).sum() == expected
+
+
+class TestSansWorkflow:
+    def make(self):
+        ny = nx = 8
+        xs = np.linspace(-0.5, 0.5, nx)
+        gx, gy = np.meshgrid(xs, xs)
+        positions = np.stack(
+            [gx.reshape(-1), gy.reshape(-1), np.full(ny * nx, 5.0)], axis=1
+        )
+        pixel_ids = np.arange(1, ny * nx + 1)
+        return SansIQWorkflow(
+            positions=positions,
+            pixel_ids=pixel_ids,
+            params=SansIQParams(q_bins=20),
+            primary_stream="larmor_detector",
+            monitor_streams={"monitor_1"},
+        )
+
+    def test_normalization(self):
+        wf = self.make()
+        rng = np.random.default_rng(0)
+        pid = rng.integers(1, 65, 1000).astype(np.int32)
+        toa = rng.uniform(1e6, 70e6, 1000).astype(np.float32)
+        wf.accumulate(
+            {"larmor_detector": stage(pid, toa), "monitor_1": stage_monitor(500)}
+        )
+        out = wf.finalize()
+        counts = out["counts_q_current"].values.sum()
+        assert counts > 0
+        np.testing.assert_allclose(
+            out["iq_current"].values.sum(), counts / 500.0, rtol=1e-5
+        )
+        assert float(out["monitor_counts_current"].values) == 500.0
+        assert repr(out["iq_current"].coords["Q"].unit) == "1/angstrom"
+
+    def test_monitor_only_window(self):
+        wf = self.make()
+        wf.accumulate({"monitor_1": stage_monitor(100)})
+        out = wf.finalize()
+        assert float(out["monitor_counts_current"].values) == 100.0
+        assert out["counts_q_current"].values.sum() == 0
+
+    def test_window_vs_cumulative(self):
+        wf = self.make()
+        rng = np.random.default_rng(1)
+        pid = rng.integers(1, 65, 100).astype(np.int32)
+        toa = rng.uniform(1e6, 70e6, 100).astype(np.float32)
+        wf.accumulate(
+            {"larmor_detector": stage(pid, toa), "monitor_1": stage_monitor(50)}
+        )
+        wf.finalize()
+        wf.accumulate({"monitor_1": stage_monitor(50)})
+        out = wf.finalize()
+        assert out["counts_q_current"].values.sum() == 0  # window cleared
+        assert float(out["monitor_counts_current"].values) == 50.0
+
+
+class TestMultiBank:
+    def make_banks(self, n_banks=3, ny=4, nx=4):
+        banks = {}
+        for b in range(n_banks):
+            start = 1 + b * ny * nx
+            banks[f"bank_{b}"] = np.arange(start, start + ny * nx).reshape(ny, nx)
+        return banks
+
+    def test_routes_events_to_banks(self):
+        banks = self.make_banks()
+        wf = MultiBankViewWorkflow(
+            bank_detector_numbers=banks,
+            params=MultiBankParams(
+                toa_bins=10, toa_range={"low": 0.0, "high": 100.0}, use_mesh=False
+            ),
+        )
+        # one event in bank 0 (id 1), two in bank 2 (id 33)
+        wf.accumulate({"detector": stage([1, 33, 33], [5.0, 15.0, 25.0])})
+        out = wf.finalize()
+        np.testing.assert_allclose(
+            out["bank_counts_current"].values, [1.0, 0.0, 2.0]
+        )
+        assert out["bank_spectra_current"].dims == ("bank", "toa")
+
+    def test_sharded_matches_unsharded(self):
+        import jax
+
+        if len(jax.devices()) < 3:
+            pytest.skip("needs multiple devices")
+        banks = self.make_banks(n_banks=6, ny=4, nx=4)
+        rng = np.random.default_rng(0)
+        pid = rng.integers(1, 97, 2000).astype(np.int32)
+        toa = rng.uniform(0, 100.0, 2000).astype(np.float32)
+        params = dict(toa_bins=10, toa_range={"low": 0.0, "high": 100.0})
+        wf_plain = MultiBankViewWorkflow(
+            bank_detector_numbers=banks,
+            params=MultiBankParams(**params, use_mesh=False),
+        )
+        wf_mesh = MultiBankViewWorkflow(
+            bank_detector_numbers=banks,
+            params=MultiBankParams(**params, use_mesh=True),
+        )
+        assert wf_mesh.is_sharded
+        staged = stage(pid, toa)
+        wf_plain.accumulate({"detector": staged})
+        out_plain = wf_plain.finalize()
+        staged2 = stage(pid, toa)
+        wf_mesh.accumulate({"detector": staged2})
+        out_mesh = wf_mesh.finalize()
+        np.testing.assert_allclose(
+            out_mesh["bank_spectra_current"].values,
+            out_plain["bank_spectra_current"].values,
+            rtol=1e-6,
+        )
+
+    def test_clear(self):
+        banks = self.make_banks()
+        wf = MultiBankViewWorkflow(
+            bank_detector_numbers=banks,
+            params=MultiBankParams(
+                toa_bins=10, toa_range={"low": 0.0, "high": 100.0}, use_mesh=False
+            ),
+        )
+        wf.accumulate({"detector": stage([1], [5.0])})
+        wf.finalize()
+        wf.clear()
+        out = wf.finalize()
+        assert float(out["counts_cumulative"].values) == 0.0
