@@ -75,6 +75,9 @@ MONITOR_HANDLE = workflow_registry.register_spec(
                 title="Counts (since start)", view="since_start"
             ),
         },
+        # Cumulative counts double as a NICOS derived device (ADR 0006):
+        # republished under a stable name on the nicos topic.
+        device_outputs={"counts_cumulative": "monitor_counts_{source_name}"},
     )
 )
 

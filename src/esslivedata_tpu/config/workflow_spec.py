@@ -181,6 +181,10 @@ class WorkflowSpec(BaseModel):
     aux_source_names: dict[str, list[str]] = Field(default_factory=dict)
     params_model: type[BaseModel] | None = None
     outputs: dict[str, OutputSpec] = Field(default_factory=dict)
+    # output_name -> NICOS device-name template; ``{source_name}`` is the
+    # only placeholder. Outputs listed here are republished on the stable
+    # NICOS device topic (reference workflow_spec.py device_outputs, ADR 0006).
+    device_outputs: dict[str, str] = Field(default_factory=dict)
     context_keys: list[str] = Field(default_factory=list)
     reset_on_run_transition: bool = True
 

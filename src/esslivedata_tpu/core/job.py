@@ -62,10 +62,16 @@ class StreamLag(BaseModel):
 
     stream_name: str
     lag_s: float  # positive = stale, negative = from the future
+    # Optional window aggregation (filled by kafka.stream_counter on the
+    # 30 s metrics rollover; single-sample reports leave them at defaults).
+    min_s: float | None = None
+    max_s: float | None = None
+    count: int = 1
 
     @property
     def level(self) -> str:
-        if self.lag_s < -FUTURE_ERROR_THRESHOLD.seconds:
+        future = self.min_s if self.min_s is not None else self.lag_s
+        if future < -FUTURE_ERROR_THRESHOLD.seconds:
             return "error"
         if self.lag_s > STALE_WARN_THRESHOLD.seconds:
             return "warning"
@@ -150,7 +156,13 @@ class Job:
         self.aux_streams = aux_streams or set()
         self.context_keys = context_keys or set()
         self.reset_on_run_transition = reset_on_run_transition
-        self._window_start: Timestamp | None = None
+        # Generation start: data time of the first message accumulated since
+        # job start or last reset. Stamped on outputs as ``start_time``, it
+        # is constant for the lifetime of a generation and changes on reset/
+        # reconfigure — NICOS uses the jump as a change-detector to tell a
+        # post-reset zero from a genuine low reading (reference job.py:111,
+        # ADR 0006).
+        self._generation_start: Timestamp | None = None
         self._window_end: Timestamp | None = None
         self._start_wall = time.time()
 
@@ -170,10 +182,8 @@ class Job:
         relevant = {k: v for k, v in data.items() if k in self.subscribed_streams}
         if not relevant:
             return False
-        if start is not None and (
-            self._window_start is None or start < self._window_start
-        ):
-            self._window_start = start
+        if start is not None and self._generation_start is None:
+            self._generation_start = start
         if end is not None:
             self._window_end = end
         self.workflow.accumulate(relevant)
@@ -185,11 +195,18 @@ class Job:
             self.workflow.set_context(relevant)
 
     def get(self) -> JobResult:
-        """Finalize the window into a JobResult, stamping start/end time
-        coords on every output (reference job.py:209)."""
+        """Finalize the window into a JobResult, stamping generation-start /
+        window-end time coords on every output (reference job.py:209-245).
+
+        Outputs that already carry ``start_time``/``end_time`` (a workflow
+        stamping window-local coords on a per-update view) or a ``time``
+        coord (timeseries data with its own timestamps) are left alone.
+        """
         outputs = self.workflow.finalize()
-        start, end = self._window_start, self._window_end
+        start, end = self._generation_start, self._window_end
         for da in outputs.values():
+            if "time" in da.coords or "end_time" in da.coords:
+                continue
             if start is not None:
                 da.coords.setdefault(
                     "start_time",
@@ -199,15 +216,13 @@ class Job:
                 da.coords["end_time"] = Variable(
                     np.asarray(end.ns, dtype=np.int64), (), "ns"
                 )
-        result = JobResult(
+        return JobResult(
             job_id=self.job_id,
             workflow_id=self.workflow_id,
             outputs=outputs,
             start=start,
             end=end,
         )
-        self._window_start = None
-        return result
 
     def process(
         self,
@@ -220,6 +235,7 @@ class Job:
         return self.get()
 
     def clear(self) -> None:
+        """Reset accumulation; starts a new generation (start_time jumps)."""
         self.workflow.clear()
-        self._window_start = None
+        self._generation_start = None
         self._window_end = None

@@ -126,6 +126,8 @@ class OrchestratingProcessor:
         instrument: str,
         service_name: str,
         registry=None,
+        device_extractor=None,
+        stream_counter=None,
         clock=time.monotonic,
         heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S,
     ) -> None:
@@ -142,6 +144,8 @@ class OrchestratingProcessor:
         )
         self._instrument = instrument
         self._service_name = service_name
+        self._device_extractor = device_extractor
+        self._stream_counter = stream_counter
         self._clock = clock
         self._heartbeat_interval_s = heartbeat_interval_s
         self._start_wall = clock()
@@ -232,6 +236,10 @@ class OrchestratingProcessor:
                         value=da,
                     )
                 )
+        if self._device_extractor is not None:
+            # Contracted outputs additionally ride the stable-identity NICOS
+            # device stream (ADR 0006, core/nicos_devices.py).
+            messages.extend(self._device_extractor.extract(results))
         if messages:
             self._sink.publish_messages(messages)
 
@@ -272,15 +280,25 @@ class OrchestratingProcessor:
         )
 
     def _log_metrics(self) -> None:
-        logger.info(
-            "processor_metrics",
-            extra={
-                "service": self._service_name,
-                "jobs": self._job_manager.n_jobs,
-                "stream_counts": dict(self._preprocessor.message_counts),
-                "lag_level": self.last_lag_report.worst_level,
-            },
-        )
+        extra = {
+            "service": self._service_name,
+            "jobs": self._job_manager.n_jobs,
+            "stream_counts": dict(self._preprocessor.message_counts),
+            "lag_level": self.last_lag_report.worst_level,
+        }
+        if self._stream_counter is not None:
+            # Adapter-layer per-(topic,source) counts + producer lag,
+            # accumulated since the last rollover (kafka/stream_counter.py).
+            stats = self._stream_counter.drain(METRICS_INTERVAL_S)
+            extra["input_counts"] = {
+                f"{s.topic}/{s.source_name}": s.count for s in stats.streams
+            }
+            extra["unmapped"] = [s.source_name for s in stats.unmapped]
+            lag_report = self._stream_counter.drain_lag()
+            if lag_report is not None:
+                self.last_lag_report = lag_report
+                extra["producer_lag_level"] = lag_report.worst_level
+        logger.info("processor_metrics", extra=extra)
 
     def finalize(self) -> None:
         """Publish final stopped statuses; idempotent (reference :417)."""

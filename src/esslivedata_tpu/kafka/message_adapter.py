@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import logging
+import time
 from collections.abc import Mapping, Sequence
 from typing import Any, Protocol, runtime_checkable
 
@@ -303,12 +304,35 @@ class AdaptingMessageSource:
         adapter: MessageAdapter,
         *,
         raise_on_error: bool = False,
+        stream_counter=None,
     ) -> None:
         self._source = source
         self._adapter = adapter
         self._raise = raise_on_error
+        self._counter = stream_counter
         self.error_count = 0
         self.unrouted_count = 0
+
+    def _count(self, raw, adapted) -> None:
+        """Fold one mapped/unmapped message into the StreamCounter (drained
+        by the processor on the 30 s metrics rollover)."""
+        topic = getattr(raw, "topic", lambda: "?")()
+        if adapted is None:
+            self._counter.record(topic, "?", None)
+            return
+        msgs = (
+            adapted
+            if isinstance(adapted, Sequence) and not isinstance(adapted, Message)
+            else [adapted]
+        )
+        for m in msgs:
+            self._counter.record(topic, m.stream.name, m.stream.name)
+            self._counter.record_lag(
+                topic,
+                m.stream.name,
+                m.stream.kind.value,
+                (time.time_ns() - m.timestamp.ns) / 1e9,
+            )
 
     def get_messages(self) -> list[Message]:
         out: list[Message] = []
@@ -317,6 +341,10 @@ class AdaptingMessageSource:
                 adapted = self._adapter.adapt(raw)
             except UnroutedError as err:
                 self.unrouted_count += 1
+                if self._counter is not None:
+                    self._counter.record(
+                        getattr(raw, "topic", lambda: "?")(), "?", None
+                    )
                 logger.debug("Unrouted message: %s", err)
                 continue
             except Exception:
@@ -330,6 +358,8 @@ class AdaptingMessageSource:
                 continue
             if adapted is None:
                 continue
+            if self._counter is not None:
+                self._count(raw, adapted)
             if isinstance(adapted, Sequence) and not isinstance(adapted, Message):
                 out.extend(adapted)
             else:

@@ -102,6 +102,15 @@ class DefaultSerializer:
                     value=wire.encode_f144(name, value.value, int(value.time[-1])),
                     key=name.encode(),
                 )
+            if isinstance(value, DataArray):
+                # Contracted device outputs (core/nicos_devices.py): da00
+                # keyed by stable device name; the start_time coord rides
+                # along as the generation change-detector.
+                return SerializedMessage(
+                    topic=self._topics.nicos,
+                    value=wire.encode_da00(name, ts, dataarray_to_da00(value)),
+                    key=name.encode(),
+                )
             return SerializedMessage(
                 topic=self._topics.nicos,
                 value=wire.encode_f144(name, np.asarray(value), ts),

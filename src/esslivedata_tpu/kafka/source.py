@@ -17,6 +17,8 @@ from collections import deque
 from collections.abc import Sequence
 from dataclasses import dataclass
 from enum import Enum
+
+from .errors import is_fatal
 from typing import Protocol, runtime_checkable
 
 __all__ = [
@@ -105,6 +107,9 @@ class KafkaMessageSource:
         for msg in messages:
             err = msg.error()
             if err is not None:
+                if is_fatal(err):
+                    # Auth/misconfiguration: crash, don't spin (kafka/errors.py).
+                    raise RuntimeError(f"Fatal Kafka error: {err}")
                 logger.warning("Kafka message error: %s", err)
                 continue
             good.append(msg)
@@ -194,6 +199,13 @@ class BackgroundMessageSource:
                 continue
             self._consecutive_errors = 0
             self._last_success = time.monotonic()
+            for m in batch:
+                err = m.error()
+                if err is not None and is_fatal(err):
+                    logger.error("Fatal Kafka error, opening circuit: %s", err)
+                    self._broken = True
+                    self._running.clear()
+                    return
             good = [m for m in batch if m.error() is None]
             if good:
                 with self._lock:

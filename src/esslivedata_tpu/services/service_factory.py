@@ -20,14 +20,19 @@ from ..core.message_batcher import (
     NaiveMessageBatcher,
     SimpleMessageBatcher,
 )
+from ..core.nicos_devices import DeviceExtractor
 from ..core.orchestrating_processor import OrchestratingProcessor
 from ..core.service import Service, get_env_defaults, setup_arg_parser
+from ..config.device_contract import DeviceContract
 from ..config.instrument import instrument_registry
 from ..config.streams import get_stream_mapping
 from ..kafka.message_adapter import AdaptingMessageSource, RouteByTopicAdapter
 from ..kafka.sink import KafkaSink, UnrollingSinkAdapter, make_default_serializer
 from ..kafka.source import BackgroundMessageSource
+from ..core.rate_aware_batcher import RateAwareMessageBatcher
+from ..kafka.stream_counter import StreamCounter
 from ..kafka.stream_mapping import StreamMapping
+from ..workflows.workflow_factory import workflow_registry
 
 __all__ = ["DataServiceBuilder", "DataServiceRunner", "make_batcher"]
 
@@ -41,6 +46,8 @@ def make_batcher(name: str) -> MessageBatcher:
         return SimpleMessageBatcher()
     if name == "adaptive":
         return AdaptiveMessageBatcher()
+    if name == "rate_aware":
+        return RateAwareMessageBatcher()
     raise ValueError(f"Unknown batcher {name!r}")
 
 
@@ -82,9 +89,15 @@ class DataServiceBuilder:
         """Assemble from anything yielding KafkaMessages + a MessageSink —
         used by tests (fakes) and by the broker path alike."""
         adapter = self._route_builder(self.stream_mapping)
-        source = AdaptingMessageSource(raw_source, adapter)
+        counter = StreamCounter()
+        source = AdaptingMessageSource(raw_source, adapter, stream_counter=counter)
         job_manager = JobManager(
             job_factory=JobFactory(), job_threads=self._job_threads
+        )
+        # Contract derived from this instrument's registered specs: outputs
+        # listed in ``device_outputs`` ride the stable NICOS device stream.
+        contract = DeviceContract.from_specs(
+            workflow_registry.specs_for_instrument(self.instrument_name)
         )
         processor = OrchestratingProcessor(
             source=source,
@@ -94,6 +107,8 @@ class DataServiceBuilder:
             batcher=self._batcher,
             instrument=self.instrument_name,
             service_name=self.service_name,
+            device_extractor=DeviceExtractor(device_contract=contract),
+            stream_counter=counter,
             heartbeat_interval_s=self._heartbeat_interval_s,
         )
         return Service(

@@ -1,0 +1,123 @@
+"""Tests for the registry-derived NICOS device contract and extractor."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.config.device_contract import (
+    DeviceContract,
+    DeviceContractEntry,
+    DeviceContractError,
+)
+from esslivedata_tpu.config.workflow_spec import JobId, WorkflowSpec
+from esslivedata_tpu.core.job import JobResult
+from esslivedata_tpu.core.message import StreamKind
+from esslivedata_tpu.core.nicos_devices import DeviceExtractor
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.utils.labeled import DataArray, Variable
+
+
+def _spec(**kwargs) -> WorkflowSpec:
+    defaults = dict(
+        instrument="dummy",
+        name="monitor_histogram",
+        source_names=["mon1", "mon2"],
+        device_outputs={"counts_total_cumulative": "mon_counts_{source_name}"},
+    )
+    defaults.update(kwargs)
+    return WorkflowSpec(**defaults)
+
+
+def _scalar(value: float) -> DataArray:
+    return DataArray(
+        data=Variable(np.asarray(value, dtype=np.float64), (), "counts")
+    )
+
+
+class TestDeviceContract:
+    def test_derived_from_specs(self):
+        contract = DeviceContract.from_specs([_spec()])
+        assert len(contract) == 2
+        names = {e.device_name for e in contract}
+        assert names == {"mon_counts_mon1", "mon_counts_mon2"}
+
+    def test_spec_without_device_outputs_contributes_nothing(self):
+        contract = DeviceContract.from_specs([_spec(device_outputs={})])
+        assert len(contract) == 0
+
+    def test_duplicate_device_name_fails_loud(self):
+        with pytest.raises(DeviceContractError):
+            DeviceContract.from_specs(
+                [_spec(device_outputs={"a": "fixed_name", "b": "fixed_name"})]
+            )
+
+    def test_bad_template_fails_loud(self):
+        with pytest.raises(DeviceContractError):
+            DeviceContract.from_specs(
+                [_spec(device_outputs={"a": "dev_{nope}"})]
+            )
+
+    def test_round_trip_export(self):
+        contract = DeviceContract.from_specs([_spec()])
+        rows = contract.to_mapping()
+        again = DeviceContract.from_mapping(rows)
+        assert again.to_mapping() == rows
+
+    def test_devices_for_filters_by_workflow_and_source(self):
+        spec = _spec()
+        contract = DeviceContract.from_specs([spec])
+        entries = contract.devices_for(spec.identifier, "mon1")
+        assert [e.device_name for e in entries] == ["mon_counts_mon1"]
+        assert contract.devices_for(spec.identifier, "elsewhere") == ()
+
+
+class TestDeviceExtractor:
+    def test_extracts_contracted_outputs(self):
+        spec = _spec()
+        contract = DeviceContract.from_specs([spec])
+        extractor = DeviceExtractor(device_contract=contract)
+        result = JobResult(
+            job_id=JobId(source_name="mon1"),
+            workflow_id=spec.identifier,
+            outputs={
+                "counts_total_cumulative": _scalar(42.0),
+                "histogram": _scalar(0.0),  # not contracted
+            },
+            start=Timestamp.from_ns(123),
+            end=Timestamp.from_ns(456),
+        )
+        messages = extractor.extract([result])
+        assert len(messages) == 1
+        (m,) = messages
+        assert m.stream.kind == StreamKind.LIVEDATA_NICOS_DATA
+        assert m.stream.name == "mon_counts_mon1"  # stable: no job_number
+        assert m.timestamp.ns == 123  # start_time = generation detector
+
+    def test_missing_output_skipped(self):
+        spec = _spec()
+        extractor = DeviceExtractor(
+            device_contract=DeviceContract.from_specs([spec])
+        )
+        result = JobResult(
+            job_id=JobId(source_name="mon1"),
+            workflow_id=spec.identifier,
+            outputs={"histogram": _scalar(0.0)},
+            start=None,
+            end=None,
+        )
+        assert extractor.extract([result]) == []
+
+    def test_uncontracted_source_skipped(self):
+        spec = _spec()
+        extractor = DeviceExtractor(
+            device_contract=DeviceContract.from_specs([spec])
+        )
+        result = JobResult(
+            job_id=JobId(source_name="det0"),
+            workflow_id=spec.identifier,
+            outputs={"counts_total_cumulative": _scalar(1.0)},
+            start=None,
+            end=None,
+        )
+        assert extractor.extract([result]) == []

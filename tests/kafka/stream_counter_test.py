@@ -1,0 +1,86 @@
+"""Tests for StreamCounter and fatal-error classification."""
+
+from __future__ import annotations
+
+from esslivedata_tpu.kafka.errors import is_fatal
+from esslivedata_tpu.kafka.stream_counter import StreamCounter
+from esslivedata_tpu.kafka.stream_mapping import InputStreamKey
+
+
+class _Err:
+    def __init__(self, *, fatal: bool = False, name: str = "SOME_ERROR"):
+        self._fatal = fatal
+        self._name = name
+
+    def fatal(self) -> bool:
+        return self._fatal
+
+    def name(self) -> str:
+        return self._name
+
+
+class TestIsFatal:
+    def test_library_flagged_fatal(self):
+        assert is_fatal(_Err(fatal=True))
+
+    def test_auth_code_fatal(self):
+        assert is_fatal(_Err(name="SASL_AUTHENTICATION_FAILED"))
+        assert is_fatal(_Err(name="TOPIC_AUTHORIZATION_FAILED"))
+
+    def test_ordinary_error_retriable(self):
+        assert not is_fatal(_Err(name="_TRANSPORT"))
+
+    def test_shapeless_object_retriable(self):
+        assert not is_fatal(object())
+
+
+class TestStreamCounter:
+    def test_counts_and_drain_reset(self):
+        c = StreamCounter()
+        c.record("loki_detector", "det0", "mantle")
+        c.record("loki_detector", "det0", "mantle")
+        c.record("loki_detector", "unknown_src", None)
+        stats = c.drain(window_seconds=30.0)
+        assert stats.window_seconds == 30.0
+        by_source = {s.source_name: s for s in stats.streams}
+        assert by_source["det0"].count == 2
+        assert by_source["det0"].stream == "mantle"
+        assert by_source["unknown_src"].stream is None
+        assert len(stats.unmapped) == 1
+        # Drained: next window starts fresh.
+        assert c.drain(30.0).streams == ()
+
+    def test_epics_noise_suffixes_dropped(self):
+        c = StreamCounter()
+        c.record("tp", "motor.VAL", None)
+        c.record("tp", "motor.DMOV", None)
+        c.record("tp", "motor.RBV", "motor")
+        stats = c.drain(1.0)
+        assert [s.source_name for s in stats.streams] == ["motor.RBV"]
+
+    def test_out_of_scope_dropped(self):
+        c = StreamCounter(
+            out_of_scope=(InputStreamKey(topic="tp", source_name="other"),)
+        )
+        c.record("tp", "other", None)
+        c.record("tp", "mine", "mine")
+        assert [s.source_name for s in c.drain(1.0).streams] == ["mine"]
+
+    def test_lag_aggregation(self):
+        c = StreamCounter()
+        for lag in (0.5, 2.5, -0.2):
+            c.record_lag("tp", "det0", "ev44", lag)
+        report = c.drain_lag()
+        assert report is not None
+        (lag,) = report.lags
+        assert lag.min_s == -0.2
+        assert lag.max_s == 2.5
+        assert lag.count == 3
+        assert lag.level == "error"  # min_s < -0.1 s future tolerance
+        assert c.drain_lag() is None  # reset
+
+    def test_lag_warn_on_stale(self):
+        c = StreamCounter()
+        c.record_lag("tp", "det0", "ev44", 3.0)
+        (lag,) = c.drain_lag().lags
+        assert lag.level == "warning"
