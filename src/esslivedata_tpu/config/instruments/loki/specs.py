@@ -71,7 +71,12 @@ DETECTOR_VIEW_HANDLE = workflow_registry.register_spec(
             "counts_cumulative": OutputSpec(
                 title="Counts (since start)", view="since_start"
             ),
-            "roi_spectra": OutputSpec(title="ROI spectra"),
+            "roi_spectra": OutputSpec(title="ROI spectra (window)"),
+            "roi_spectra_cumulative": OutputSpec(
+                title="ROI spectra (since start)", view="since_start"
+            ),
+            "roi_rectangle": OutputSpec(title="ROI rectangles (readback)"),
+            "roi_polygon": OutputSpec(title="ROI polygons (readback)"),
         },
     )
 )

@@ -345,18 +345,24 @@ class RateAwareMessageBatcher:
     def _close(self) -> MessageBatch:
         assert self._start is not None
         start = self._start
+        # The closing batch's window length: captured before the stream
+        # refresh, which may apply a pending set_window() — that takes
+        # effect at the *next* batch start, not on this one.
+        closing_window = self._window
         self._refresh_streams(start)
         messages = self._drain_all()
         if any(s.is_gating for s in self._streams.values()):
-            end = start + self._window
+            end = start + closing_window
         else:
             # Timeout-closed with nothing gating: include all held-back
             # traffic and cover its real time range, mirroring
             # SimpleMessageBatcher semantics (reference :593-610).
             messages += self._future + self._overflow
             self._future, self._overflow = [], []
-            end = max((m.timestamp for m in messages), default=start + self._window)
-            end = max(end, start + self._window)
+            end = max(
+                (m.timestamp for m in messages), default=start + closing_window
+            )
+            end = max(end, start + closing_window)
         batch = MessageBatch(start=start, end=end, messages=messages)
         self._start = end
         # Re-route held-back traffic into the new window; anything still past

@@ -26,6 +26,19 @@ from ..config.workflow_spec import WorkflowConfig
 from ..core.message import Message, RunStart, RunStop, StreamId, StreamKind
 from ..core.timestamp import Timestamp
 from ..preprocessors.event_data import DetectorEvents, MonitorEvents
+
+#: Stream kinds whose message timestamp is a production time, making
+#: wall-clock-minus-timestamp a meaningful producer lag.
+_LAG_TRACKED_KINDS = frozenset(
+    {
+        StreamKind.DETECTOR_EVENTS,
+        StreamKind.MONITOR_EVENTS,
+        StreamKind.MONITOR_COUNTS,
+        StreamKind.AREA_DETECTOR,
+        StreamKind.LOG,
+        StreamKind.DEVICE,
+    }
+)
 from ..preprocessors.to_nxlog import LogData
 from . import wire
 from .da00_compat import da00_to_dataarray
@@ -313,12 +326,26 @@ class AdaptingMessageSource:
         self.error_count = 0
         self.unrouted_count = 0
 
+    @staticmethod
+    def _raw_source_name(raw) -> str:
+        """Best-effort source identity of an unmapped raw message: the Kafka
+        key when present (ECDC keys messages by source), else unknown."""
+        key = getattr(raw, "key", None)
+        if callable(key):
+            k = key()
+            if k:
+                return k.decode(errors="replace") if isinstance(k, bytes) else str(k)
+        return "<unknown>"
+
     def _count(self, raw, adapted) -> None:
-        """Fold one mapped/unmapped message into the StreamCounter (drained
-        by the processor on the 30 s metrics rollover)."""
+        """Fold one mapped/unmapped/dropped message into the StreamCounter
+        (drained by the processor on the 30 s metrics rollover)."""
         topic = getattr(raw, "topic", lambda: "?")()
         if adapted is None:
-            self._counter.record(topic, "?", None)
+            # Deliberately dropped (e.g. unsubscribed source on a routed
+            # topic): counted under its raw source identity so the operator
+            # can see what is being filtered.
+            self._counter.record(topic, self._raw_source_name(raw), None)
             return
         msgs = (
             adapted
@@ -327,12 +354,16 @@ class AdaptingMessageSource:
         )
         for m in msgs:
             self._counter.record(topic, m.stream.name, m.stream.name)
-            self._counter.record_lag(
-                topic,
-                m.stream.name,
-                m.stream.kind.value,
-                (time.time_ns() - m.timestamp.ns) / 1e9,
-            )
+            # Producer lag only makes sense for data-plane payloads whose
+            # timestamp is a production time; run-control/command timestamps
+            # are schedule times, possibly far in the past by design.
+            if m.stream.kind in _LAG_TRACKED_KINDS:
+                self._counter.record_lag(
+                    topic,
+                    m.stream.name,
+                    m.stream.kind.value,
+                    (time.time_ns() - m.timestamp.ns) / 1e9,
+                )
 
     def get_messages(self) -> list[Message]:
         out: list[Message] = []
@@ -343,7 +374,9 @@ class AdaptingMessageSource:
                 self.unrouted_count += 1
                 if self._counter is not None:
                     self._counter.record(
-                        getattr(raw, "topic", lambda: "?")(), "?", None
+                        getattr(raw, "topic", lambda: "?")(),
+                        self._raw_source_name(raw),
+                        None,
                     )
                 logger.debug("Unrouted message: %s", err)
                 continue
@@ -356,10 +389,10 @@ class AdaptingMessageSource:
                 if self._raise:
                     raise
                 continue
-            if adapted is None:
-                continue
             if self._counter is not None:
                 self._count(raw, adapted)
+            if adapted is None:
+                continue
             if isinstance(adapted, Sequence) and not isinstance(adapted, Message):
                 out.extend(adapted)
             else:

@@ -199,20 +199,28 @@ class BackgroundMessageSource:
                 continue
             self._consecutive_errors = 0
             self._last_success = time.monotonic()
-            for m in batch:
-                err = m.error()
-                if err is not None and is_fatal(err):
-                    logger.error("Fatal Kafka error, opening circuit: %s", err)
-                    self._broken = True
-                    self._running.clear()
-                    return
+            fatal = next(
+                (
+                    m.error()
+                    for m in batch
+                    if m.error() is not None and is_fatal(m.error())
+                ),
+                None,
+            )
             good = [m for m in batch if m.error() is None]
             if good:
+                # Enqueue before opening the circuit: good messages consumed
+                # alongside a fatal error event must still reach the worker.
                 with self._lock:
                     if len(self._queue) == self._queue.maxlen:
                         self._dropped_batches += 1
                     self._queue.append(good)
                     self._consumed_messages += len(good)
+            if fatal is not None:
+                logger.error("Fatal Kafka error, opening circuit: %s", fatal)
+                self._broken = True
+                self._running.clear()
+                return
 
     # -- worker side ------------------------------------------------------
     def get_messages(self) -> list[KafkaMessage]:

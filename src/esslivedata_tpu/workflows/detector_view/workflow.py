@@ -18,7 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 from pydantic import BaseModel, ConfigDict, Field
 
-from ...config.models import ROI, TOARange
+from ...config.models import ROI, PolygonROI, RectangleROI, TOARange
+from ...config.roi_names import default_roi_mapper
 from ...ops.histogram import EventHistogrammer, HistogramState
 from ...preprocessors.event_data import StagedEvents
 from ...utils.labeled import DataArray, Variable
@@ -79,7 +80,10 @@ class DetectorViewWorkflow:
         )
         self._state: HistogramState = self._hist.init_state()
         self._primary_stream = primary_stream
+        self._roi_mapper = default_roi_mapper()
+        assert self._roi_mapper.total_rois <= MAX_ROIS
         self._roi_names: list[str] = []
+        self._rois_by_index: dict[int, tuple[str, ROI]] = {}
         self._roi_masks = jnp.zeros(
             (MAX_ROIS, projection.n_screen), dtype=jnp.float32
         )
@@ -96,6 +100,7 @@ class DetectorViewWorkflow:
                 "counts_cumulative": cum.sum(),
                 # [MAX_ROIS, n_toa] on the MXU; unused rows are zero.
                 "roi_spectra": roi_masks @ win,
+                "roi_spectra_cumulative": roi_masks @ cum,
             }
 
         self._summarize = jax.jit(summarize)
@@ -105,19 +110,40 @@ class DetectorViewWorkflow:
     # -- ROI management ----------------------------------------------------
     def set_rois(self, rois: Mapping[str, ROI]) -> None:
         """Install ROI masks (from the dashboard's ROI topic round trip,
-        reference roi.py:293). Limited to MAX_ROIS, extra ROIs rejected."""
-        if len(rois) > MAX_ROIS:
-            raise ValueError(f"At most {MAX_ROIS} ROIs supported, got {len(rois)}")
+        reference roi.py:293).
+
+        Each ROI is assigned a *global index* following the
+        ``config/roi_names.py`` partition (rectangles and polygons own
+        disjoint index ranges), which is also its mask-matrix row — so the
+        ``roi`` coord on the spectra outputs and the readback indices agree
+        with the naming convention the dashboard uses for labels. Per-type
+        capacity is bounded by the mapper so ROI edits never change array
+        shapes (no XLA recompile).
+        """
         from ...utils.labeled import midpoints
 
         xc = midpoints(self._proj.x_edges).numpy
         yc = midpoints(self._proj.y_edges).numpy
         masks = np.zeros((MAX_ROIS, self._proj.n_screen), dtype=np.float32)
-        names = []
-        for i, (name, roi) in enumerate(rois.items()):
-            masks[i] = roi.mask(xc, yc).reshape(-1).astype(np.float32)
-            names.append(name)
-        self._roi_names = names
+        counters = {g.geometry_type: iter(g.index_range) for g in self._roi_mapper.geometries}
+        indexed: dict[int, tuple[str, ROI]] = {}
+        for name, roi in rois.items():
+            gtype = "rectangle" if isinstance(roi, RectangleROI) else "polygon"
+            try:
+                index = next(counters[gtype])
+            except StopIteration:
+                limit = next(
+                    g.num_rois
+                    for g in self._roi_mapper.geometries
+                    if g.geometry_type == gtype
+                )
+                raise ValueError(
+                    f"At most {limit} {gtype} ROIs supported"
+                ) from None
+            masks[index] = roi.mask(xc, yc).reshape(-1).astype(np.float32)
+            indexed[index] = (name, roi)
+        self._rois_by_index = dict(sorted(indexed.items()))
+        self._roi_names = [name for name, _ in self._rois_by_index.values()]
         self._roi_masks = jnp.asarray(masks)
 
     @property
@@ -173,17 +199,78 @@ class DetectorViewWorkflow:
                 name="counts_cumulative",
             ),
         }
-        if self._roi_names:
-            spectra = out["roi_spectra"][: len(self._roi_names)]
-            results["roi_spectra"] = DataArray(
-                Variable(spectra, ("roi", "toa"), "counts"),
-                coords={
-                    "toa": self._toa_edges_var,
-                    "roi": Variable(np.arange(len(self._roi_names)), ("roi",), ""),
-                },
-                name="roi_spectra",
-            )
+        if self._rois_by_index:
+            indices = np.asarray(list(self._rois_by_index), dtype=np.int32)
+            roi_idx = Variable(indices, ("roi",), "")
+            for key in ("roi_spectra", "roi_spectra_cumulative"):
+                spectra = out[key][indices]
+                results[key] = DataArray(
+                    Variable(spectra, ("roi", "toa"), "counts"),
+                    coords={"toa": self._toa_edges_var, "roi": roi_idx},
+                    name=key,
+                )
+        results.update(self._roi_readbacks())
         return results
+
+    def _roi_readbacks(self) -> dict[str, DataArray]:
+        """Applied-ROI readback outputs (reference roi.py:293-355): the
+        dashboard renders what the backend actually applied, not what it
+        asked for. da00 is numeric-only, so shapes ride as index-keyed
+        coordinate arrays (config/roi_names.py convention): rectangles as
+        per-ROI bound coords, polygons as per-vertex coords with a roi
+        index. Always emitted — an empty readback tells the frontend the
+        coordinate units to use when creating ROIs."""
+        x_unit = self._proj.x_edges.unit
+        y_unit = self._proj.y_edges.unit
+        rects = [
+            (i, r)
+            for i, (_, r) in self._rois_by_index.items()
+            if isinstance(r, RectangleROI)
+        ]
+        polys = [
+            (i, r)
+            for i, (_, r) in self._rois_by_index.items()
+            if isinstance(r, PolygonROI)
+        ]
+        rect_idx = np.asarray([i for i, _ in rects], dtype=np.int32)
+        rect = DataArray(
+            Variable(rect_idx, ("roi",), ""),
+            coords={
+                "x_min": Variable(
+                    np.asarray([r.x_min for _, r in rects]), ("roi",), x_unit
+                ),
+                "x_max": Variable(
+                    np.asarray([r.x_max for _, r in rects]), ("roi",), x_unit
+                ),
+                "y_min": Variable(
+                    np.asarray([r.y_min for _, r in rects]), ("roi",), y_unit
+                ),
+                "y_max": Variable(
+                    np.asarray([r.y_max for _, r in rects]), ("roi",), y_unit
+                ),
+            },
+            name="roi_rectangle",
+        )
+        vert_roi = np.asarray(
+            [i for i, p in polys for _ in p.x], dtype=np.int32
+        )
+        poly = DataArray(
+            Variable(vert_roi, ("vertex",), ""),
+            coords={
+                "x": Variable(
+                    np.asarray([x for _, p in polys for x in p.x]),
+                    ("vertex",),
+                    x_unit,
+                ),
+                "y": Variable(
+                    np.asarray([y for _, p in polys for y in p.y]),
+                    ("vertex",),
+                    y_unit,
+                ),
+            },
+            name="roi_polygon",
+        )
+        return {"roi_rectangle": rect, "roi_polygon": poly}
 
     def clear(self) -> None:
         self._state = self._hist.clear(self._state)

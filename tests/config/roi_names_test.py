@@ -1,0 +1,55 @@
+"""Tests for the ROI index-space naming conventions."""
+
+import pytest
+
+from esslivedata_tpu.config.models import PolygonROI, RectangleROI
+from esslivedata_tpu.config.roi_names import (
+    ROIGeometry,
+    ROIStreamMapper,
+    default_roi_mapper,
+)
+
+
+class TestROIGeometry:
+    def test_readback_key(self):
+        g = ROIGeometry(geometry_type="rectangle", num_rois=4)
+        assert g.readback_key == "roi_rectangle"
+        assert g.roi_class is RectangleROI
+
+    def test_display_name_uses_local_index(self):
+        g = ROIGeometry(geometry_type="polygon", num_rois=4, index_offset=4)
+        assert g.display_name(4) == "polygon_0"
+        assert g.display_name(7) == "polygon_3"
+        with pytest.raises(IndexError):
+            g.display_name(3)
+
+    def test_polygon_class(self):
+        g = ROIGeometry(geometry_type="polygon", num_rois=1)
+        assert g.roi_class is PolygonROI
+
+
+class TestROIStreamMapper:
+    def test_default_partition(self):
+        m = default_roi_mapper()
+        assert m.total_rois == 8
+        assert m.geometry_for(0).geometry_type == "rectangle"
+        assert m.geometry_for(4).geometry_type == "polygon"
+        assert m.readback_keys() == ["roi_rectangle", "roi_polygon"]
+
+    def test_display_names_stable(self):
+        m = default_roi_mapper()
+        assert m.display_name(0) == "rectangle_0"
+        assert m.display_name(5) == "polygon_1"
+
+    def test_overlapping_ranges_rejected(self):
+        with pytest.raises(ValueError):
+            ROIStreamMapper(
+                (
+                    ROIGeometry(geometry_type="rectangle", num_rois=4),
+                    ROIGeometry(geometry_type="polygon", num_rois=4, index_offset=2),
+                )
+            )
+
+    def test_unowned_index(self):
+        with pytest.raises(IndexError):
+            default_roi_mapper().geometry_for(99)

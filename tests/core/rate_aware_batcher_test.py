@@ -211,3 +211,19 @@ def test_only_event_kinds_gate(kind):
     sid = StreamId(kind=kind, name="x")
     b.batch([msg(sid, i * NS // 14) for i in range(8)])
     assert not b.is_gating(sid)
+
+
+def test_set_window_does_not_shrink_closing_batch():
+    """A pending window change must not retroactively shorten the batch
+    being closed (its end stays start + the window it was opened with)."""
+    b = RateAwareMessageBatcher(Duration.from_s(1.0))
+    period = round(NS / 14)
+    b.batch(pulses(DET, 0, 8, period))
+    t0 = 7 * period
+    b.set_window(Duration.from_s(0.5))
+    out = None
+    t = t0 + period
+    while out is None:
+        out = b.batch(pulses(DET, t, 14, period))
+        t += 14 * period
+    assert (out.end - out.start).ns == NS  # closed with the 1 s window

@@ -224,3 +224,80 @@ class TestMonitorServiceEndToEnd:
         ]
         signal = next(v for v in cum[-1].variables if v.name == "signal")
         assert signal.data.sum() == 3 * 50
+
+
+class TestRoiRoundTrip:
+    """ROI command on the roi topic -> set_rois on the running job ->
+    readback + spectra in the published da00 stream (reference ROI round
+    trip, SURVEY.md section 4.5)."""
+
+    def test_roi_update_applies_and_reads_back(self):
+        det = INSTRUMENT.detectors["panel_0"]
+        stream = FakeDetectorStream(
+            topic="dummy_detector",
+            source_name="panel_a",
+            detector_ids=det.detector_number,
+            events_per_pulse=100,
+        )
+        service, raw, producer = make_detector_service([stream])
+        job_id = JobId(source_name="panel_0")
+        config = WorkflowConfig(
+            identifier=DETECTOR_VIEW_HANDLE.workflow_id, job_id=job_id, params={}
+        )
+        raw.inject(
+            FakeKafkaMessage(
+                json.dumps(
+                    {"kind": "start_job", "config": config.model_dump(mode="json")}
+                ).encode(),
+                COMMANDS_TOPIC,
+            )
+        )
+        service.step()
+        # ROI update arrives on the dedicated roi topic.
+        raw.inject(
+            FakeKafkaMessage(
+                json.dumps(
+                    {
+                        "kind": "roi_update",
+                        "source_name": "panel_0",
+                        "job_number": str(job_id.job_number),
+                        "rois": {
+                            "box": {
+                                "x_min": -1e9,
+                                "x_max": 1e9,
+                                "y_min": -1e9,
+                                "y_max": 1e9,
+                            }
+                        },
+                    }
+                ).encode(),
+                "dummy_livedata_roi",
+            )
+        )
+        for _ in range(4):
+            service.step()
+
+        outputs = set()
+        rect_readback = None
+        for m in producer.messages:
+            if m.topic != "dummy_livedata_data":
+                continue
+            da00 = wire.decode_da00(m.value)
+            key = da00.source_name.split("|")[-1]
+            outputs.add(key)
+            if key == "roi_rectangle":
+                rect_readback = da00
+        assert "roi_spectra" in outputs
+        assert "roi_spectra_cumulative" in outputs
+        assert rect_readback is not None
+        x_min = next(
+            v for v in rect_readback.variables if v.name == "x_min"
+        )
+        assert x_min.data.tolist() == [-1e9]
+        # The huge ROI covers the whole screen: its spectrum sums all counts.
+        acks = [
+            json.loads(m.value)
+            for m in producer.messages
+            if m.topic == "dummy_livedata_responses"
+        ]
+        assert any(a["status"] == "ack" for a in acks)

@@ -179,3 +179,59 @@ class TestDetectorViewWorkflow:
         }
         with pytest.raises(ValueError, match="At most"):
             view.set_rois(rois)
+
+
+class TestRoiReadbackAndCumulative:
+    """ROI readback outputs + cumulative spectra (reference roi.py:188-355)."""
+
+    def test_readback_reflects_applied_rois(self, view):
+        view.set_rois(
+            {
+                "left": RectangleROI(x_min=-0.5, x_max=1.5, y_min=-0.5, y_max=3.5),
+                "poly": PolygonROI(x=(1.6, 3.5, 3.5), y=(-0.5, -0.5, 3.5)),
+            }
+        )
+        out = view.finalize()
+        rect = out["roi_rectangle"]
+        assert rect.dims == ("roi",)
+        assert rect.values.tolist() == [0]  # global index of the rectangle
+        assert float(rect.coords["x_min"].values[0]) == -0.5
+        assert float(rect.coords["y_max"].values[0]) == 3.5
+        poly = out["roi_polygon"]
+        assert poly.dims == ("vertex",)
+        # Polygons own the index range starting at 4 (config/roi_names.py).
+        assert poly.values.tolist() == [4, 4, 4]
+        assert poly.coords["x"].values.tolist() == [1.6, 3.5, 3.5]
+
+    def test_empty_readback_carries_units(self, view):
+        out = view.finalize()
+        rect = out["roi_rectangle"]
+        assert rect.shape == (0,)
+        assert str(rect.coords["x_min"].unit) == str(view._proj.x_edges.unit)
+
+    def test_cumulative_roi_spectra_survive_window_clear(self, view):
+        view.set_rois(
+            {"left": RectangleROI(x_min=-0.5, x_max=1.5, y_min=-0.5, y_max=3.5)}
+        )
+        view.accumulate({"det": stage([0], [5.0])})
+        view.finalize()
+        view.accumulate({"det": stage([0], [5.0])})
+        out = view.finalize()
+        assert out["roi_spectra"].values.sum() == 1.0  # window: latest only
+        assert out["roi_spectra_cumulative"].values.sum() == 2.0
+
+    def test_spectra_roi_coord_follows_naming_convention(self, view):
+        """The 'roi' coord carries global indices per config/roi_names.py,
+        so the dashboard's display_name(index) labels the right rows."""
+        view.set_rois(
+            {
+                "poly": PolygonROI(x=(1.6, 3.5, 3.5), y=(-0.5, -0.5, 3.5)),
+                "left": RectangleROI(x_min=-0.5, x_max=1.5, y_min=-0.5, y_max=3.5),
+            }
+        )
+        view.accumulate({"det": stage([0, 3], [5.0, 15.0])})
+        out = view.finalize()
+        roi = out["roi_spectra"]
+        assert roi.coords["roi"].values.tolist() == [0, 4]  # rect row, poly row
+        assert roi.values[0].sum() == 1.0  # index 0 = rectangle (pixel 0)
+        assert roi.values[1].sum() == 1.0  # index 4 = polygon (pixel 3)
