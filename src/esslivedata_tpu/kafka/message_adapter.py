@@ -26,6 +26,11 @@ from ..config.workflow_spec import WorkflowConfig
 from ..core.message import Message, RunStart, RunStop, StreamId, StreamKind
 from ..core.timestamp import Timestamp
 from ..preprocessors.event_data import DetectorEvents, MonitorEvents
+from ..preprocessors.to_nxlog import LogData
+from . import wire
+from .da00_compat import da00_to_dataarray
+from .source import KafkaMessage
+from .stream_mapping import InputStreamKey, StreamMapping
 
 #: Stream kinds whose message timestamp is a production time, making
 #: wall-clock-minus-timestamp a meaningful producer lag.
@@ -39,11 +44,6 @@ _LAG_TRACKED_KINDS = frozenset(
         StreamKind.DEVICE,
     }
 )
-from ..preprocessors.to_nxlog import LogData
-from . import wire
-from .da00_compat import da00_to_dataarray
-from .source import KafkaMessage
-from .stream_mapping import InputStreamKey, StreamMapping
 
 __all__ = [
     "AdaptingMessageSource",

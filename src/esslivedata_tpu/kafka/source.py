@@ -224,14 +224,17 @@ class BackgroundMessageSource:
 
     # -- worker side ------------------------------------------------------
     def get_messages(self) -> list[KafkaMessage]:
-        if self._broken:
-            raise RuntimeError(
-                "Kafka consumer circuit breaker open (repeated consume errors)"
-            )
+        # Drain before checking the breaker: good messages enqueued alongside
+        # the fatal error event must still reach the worker; only once the
+        # queue is empty does the open circuit surface as an error.
         with self._lock:
             out: list[KafkaMessage] = []
             while self._queue:
                 out.extend(self._queue.popleft())
+        if not out and self._broken:
+            raise RuntimeError(
+                "Kafka consumer circuit breaker open (repeated consume errors)"
+            )
         return out
 
     @property

@@ -1,0 +1,250 @@
+"""ctypes binding for the native ingest shim (ingest.cpp).
+
+The library is compiled on demand with g++ into this package directory and
+cached; if no compiler is available the binding reports unavailable and
+callers fall back to the pure-Python path (kafka/wire.py decode +
+ops/event_batch.StagingBuffer) — identical semantics, same tests.
+
+Reference parity: this is our equivalent of the native machinery the
+reference's ingest path rests on (generated FlatBuffers decode in
+ess-streaming-data-types + scipp's C++ event buffers; see SURVEY §2.9 and
+reference kafka/message_adapter.py:360 for the partial-decode fast path).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+__all__ = [
+    "NativeStagingBuffer",
+    "available",
+    "ev44_info",
+    "load_library",
+]
+
+_HERE = Path(__file__).resolve().parent
+_SRC = _HERE / "ingest.cpp"
+_LIB = _HERE / "_ingest.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+_ERRORS = {
+    -1: "short or corrupt flatbuffer",
+    -2: "wrong schema (expected ev44)",
+    -3: "corrupt table",
+    -4: "corrupt vector",
+    -5: "time_of_flight/pixel_id length mismatch",
+    -6: "staging buffer in use (release() the last batch first)",
+    -7: "native allocation failure",
+}
+
+
+def _compile() -> bool:
+    cmd = [
+        "g++",
+        "-O3",
+        "-shared",
+        "-fPIC",
+        "-std=c++17",
+        str(_SRC),
+        "-o",
+        str(_LIB),
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    return proc.returncode == 0 and _LIB.exists()
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i64, vp = ctypes.c_int64, ctypes.c_void_p
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.ld_staging_new.restype = vp
+    lib.ld_staging_new.argtypes = [i64]
+    lib.ld_staging_free.restype = None
+    lib.ld_staging_free.argtypes = [vp]
+    lib.ld_staging_len.restype = i64
+    lib.ld_staging_len.argtypes = [vp]
+    lib.ld_staging_add_ev44.restype = i64
+    lib.ld_staging_add_ev44.argtypes = [vp, u8p, i64, ctypes.c_int]
+    lib.ld_staging_add_raw.restype = i64
+    lib.ld_staging_add_raw.argtypes = [
+        vp,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_float),
+        i64,
+    ]
+    lib.ld_staging_take.restype = i64
+    lib.ld_staging_take.argtypes = [
+        vp,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.POINTER(i64),
+        ctypes.POINTER(i64),
+    ]
+    lib.ld_staging_release.restype = None
+    lib.ld_staging_release.argtypes = [vp]
+    lib.ld_staging_clear.restype = None
+    lib.ld_staging_clear.argtypes = [vp]
+    lib.ld_ev44_info.restype = i64
+    lib.ld_ev44_info.argtypes = [
+        u8p,
+        i64,
+        ctypes.POINTER(i64),
+        ctypes.POINTER(i64),
+        ctypes.POINTER(i64),
+        ctypes.POINTER(i64),
+    ]
+    return lib
+
+
+def load_library() -> ctypes.CDLL | None:
+    """Load (compiling if needed) the native library; None if unavailable."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_failed:
+            return None
+        if not _LIB.exists() and not _compile():
+            _load_failed = True
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(str(_LIB)))
+        except OSError:
+            _load_failed = True
+            return None
+        return _lib
+
+
+def available() -> bool:
+    return load_library() is not None
+
+
+def _as_u8p(buf: bytes):
+    return ctypes.cast(ctypes.c_char_p(buf), ctypes.POINTER(ctypes.c_uint8))
+
+
+def ev44_info(buf: bytes) -> tuple[int, int, int, int]:
+    """(message_id, n_events, ref_time_first, ref_time_last) without a full
+    decode — the native analog of the reference's partial-decode fast path."""
+    lib = load_library()
+    if lib is None:
+        raise RuntimeError("native ingest library unavailable")
+    mid = ctypes.c_int64()
+    n = ctypes.c_int64()
+    first = ctypes.c_int64()
+    last = ctypes.c_int64()
+    rc = lib.ld_ev44_info(
+        _as_u8p(buf),
+        len(buf),
+        ctypes.byref(mid),
+        ctypes.byref(n),
+        ctypes.byref(first),
+        ctypes.byref(last),
+    )
+    if rc != 0:
+        raise ValueError(_ERRORS.get(int(rc), f"native error {rc}"))
+    return mid.value, n.value, first.value, last.value
+
+
+class NativeStagingBuffer:
+    """Drop-in native replacement for ops.event_batch.StagingBuffer, with an
+    extra ``add_ev44`` fast path that decodes and appends in one C call.
+
+    The arrays handed out by ``take`` are zero-copy views into C-owned
+    memory; per the staging contract (same as the reference's
+    to_nxevent_data.py:166-171) the caller must finish with them before
+    ``release``/``clear``/``add`` is called again. The returned EventBatch
+    holds a reference to this buffer (``owner``) so the C memory stays
+    alive as long as the batch does.
+    """
+
+    def __init__(self, min_bucket: int = 1 << 12) -> None:
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native ingest library unavailable")
+        self._lib = lib
+        self._min_bucket = min_bucket
+        self._h = lib.ld_staging_new(min_bucket)
+        if not self._h:
+            raise MemoryError("native staging allocation failed")
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.ld_staging_free(h)
+            self._h = None
+
+    def __len__(self) -> int:
+        return int(self._lib.ld_staging_len(self._h))
+
+    def _check(self, rc: int) -> int:
+        if rc < 0:
+            msg = _ERRORS.get(rc, f"native error {rc}")
+            if rc == -6:
+                raise RuntimeError(msg)
+            if rc == -7:
+                raise MemoryError(msg)
+            raise ValueError(msg)
+        return rc
+
+    def add_ev44(self, buf: bytes, monitor: bool = False) -> int:
+        """Decode an ev44 message and append its events. Returns the number
+        of events appended; raises ValueError on a malformed buffer."""
+        rc = self._lib.ld_staging_add_ev44(
+            self._h, _as_u8p(buf), len(buf), 1 if monitor else 0
+        )
+        return self._check(int(rc))
+
+    def add(self, pixel_id: np.ndarray, toa: np.ndarray) -> None:
+        pixel_id = np.ascontiguousarray(pixel_id, dtype=np.int32)
+        toa = np.ascontiguousarray(toa, dtype=np.float32)
+        n = int(pixel_id.shape[0])
+        if n == 0:
+            return
+        rc = self._lib.ld_staging_add_raw(
+            self._h,
+            pixel_id.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            toa.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n,
+        )
+        self._check(int(rc))
+
+    def take(self):
+        """Pad to the bucket boundary, return an EventBatch of zero-copy
+        views into native memory."""
+        from ..ops.event_batch import EventBatch
+
+        pixel_p = ctypes.POINTER(ctypes.c_int32)()
+        toa_p = ctypes.POINTER(ctypes.c_float)()
+        padded = ctypes.c_int64()
+        n_valid = ctypes.c_int64()
+        rc = self._lib.ld_staging_take(
+            self._h,
+            ctypes.byref(pixel_p),
+            ctypes.byref(toa_p),
+            ctypes.byref(padded),
+            ctypes.byref(n_valid),
+        )
+        self._check(int(rc))
+        b = int(padded.value)
+        pixel = np.ctypeslib.as_array(pixel_p, shape=(b,))
+        toa = np.ctypeslib.as_array(toa_p, shape=(b,))
+        return EventBatch(
+            pixel_id=pixel, toa=toa, n_valid=int(n_valid.value), owner=self
+        )
+
+    def release(self) -> None:
+        self._lib.ld_staging_release(self._h)
+
+    def clear(self) -> None:
+        self._lib.ld_staging_clear(self._h)

@@ -1,0 +1,320 @@
+// Native ingest shim: fast-path ev44 decode + host event staging.
+//
+// TPU-native equivalent of the native surface the reference leans on for its
+// hot ingest path: the generated FlatBuffers decoders of
+// ess-streaming-data-types (reference: kafka/message_adapter.py:13-21, and
+// the partial-decode fast path KafkaToMonitorEventsAdapter,
+// message_adapter.py:360) plus scipp's C++-backed growable event buffers
+// (_ScippBackedBuffer, to_nxevent_data.py:76-114).
+//
+// One call per Kafka message decodes the ev44 vtable and appends
+// (pixel_id:int32, toa:float32) straight into a reusable growable staging
+// buffer — no intermediate Python objects, no per-message numpy allocation.
+// `take` pads to the power-of-two bucket boundary (static XLA shapes) and
+// hands out raw pointers that Python wraps zero-copy as numpy arrays.
+//
+// Byte layout decoded here matches the clean-room Python codec
+// (esslivedata_tpu/kafka/wire.py): standard flatbuffers vtables, file
+// identifier "ev44", field slots: 0 source_name (string), 1 message_id
+// (int64), 2 reference_time ([int64]), 3 reference_time_index ([int32]),
+// 4 time_of_flight ([int32]), 5 pixel_id ([int32]).
+//
+// Every read is bounds-checked: malformed buffers return an error code, they
+// never crash the service (mirrors the reference's per-message containment,
+// message_adapter.py:592-624).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+struct View {
+  const uint8_t* buf;
+  int64_t len;
+};
+
+inline bool in_range(const View& v, int64_t pos, int64_t n) {
+  return pos >= 0 && n >= 0 && pos + n <= v.len;
+}
+
+inline bool read_u32(const View& v, int64_t pos, uint32_t* out) {
+  if (!in_range(v, pos, 4)) return false;
+  std::memcpy(out, v.buf + pos, 4);
+  return true;
+}
+
+inline bool read_i32(const View& v, int64_t pos, int32_t* out) {
+  if (!in_range(v, pos, 4)) return false;
+  std::memcpy(out, v.buf + pos, 4);
+  return true;
+}
+
+inline bool read_u16(const View& v, int64_t pos, uint16_t* out) {
+  if (!in_range(v, pos, 2)) return false;
+  std::memcpy(out, v.buf + pos, 2);
+  return true;
+}
+
+// Absolute position of table field `slot`, or 0 if absent, or -1 on corrupt.
+inline int64_t field_pos(const View& v, int64_t tpos, int slot) {
+  int32_t soff;
+  if (!read_i32(v, tpos, &soff)) return -1;
+  int64_t vt = tpos - static_cast<int64_t>(soff);
+  uint16_t vt_len;
+  if (!read_u16(v, vt, &vt_len)) return -1;
+  int64_t entry = 4 + slot * 2;
+  if (entry + 2 > vt_len) return 0;
+  uint16_t foff;
+  if (!read_u16(v, vt + entry, &foff)) return -1;
+  if (foff == 0) return 0;
+  return tpos + foff;
+}
+
+// Vector field: writes data pointer + element count. Returns 0 on absent
+// (n=0), 1 on present, -1 on corrupt.
+inline int vector_field(const View& v, int64_t tpos, int slot, int64_t elem_size,
+                        const uint8_t** data, int64_t* n) {
+  *data = nullptr;
+  *n = 0;
+  int64_t fp = field_pos(v, tpos, slot);
+  if (fp < 0) return -1;
+  if (fp == 0) return 0;
+  uint32_t off;
+  if (!read_u32(v, fp, &off)) return -1;
+  int64_t vp = fp + static_cast<int64_t>(off);
+  uint32_t count;
+  if (!read_u32(v, vp, &count)) return -1;
+  int64_t bytes = static_cast<int64_t>(count) * elem_size;
+  if (!in_range(v, vp + 4, bytes)) return -1;
+  *data = v.buf + vp + 4;
+  *n = count;
+  return 1;
+}
+
+struct Ev44View {
+  const int32_t* tof;
+  int64_t n_tof;
+  const int32_t* pixel;
+  int64_t n_pixel;
+  const int64_t* ref_time;
+  int64_t n_ref;
+  int64_t message_id;
+  const uint8_t* source;  // not NUL-terminated
+  int64_t source_len;
+};
+
+// Parse an ev44 message. Returns 0 on success, negative on error.
+int parse_ev44(const uint8_t* buf, int64_t len, Ev44View* out) {
+  View v{buf, len};
+  if (len < 8) return -1;
+  if (std::memcmp(buf + 4, "ev44", 4) != 0) return -2;
+  uint32_t root;
+  if (!read_u32(v, 0, &root)) return -1;
+  int64_t tpos = root;
+  if (!in_range(v, tpos, 4)) return -1;
+
+  const uint8_t* d;
+  int64_t n;
+  // source_name (slot 0, string)
+  out->source = nullptr;
+  out->source_len = 0;
+  int64_t fp = field_pos(v, tpos, 0);
+  if (fp < 0) return -3;
+  if (fp > 0) {
+    uint32_t off;
+    if (!read_u32(v, fp, &off)) return -3;
+    int64_t sp = fp + static_cast<int64_t>(off);
+    uint32_t slen;
+    if (!read_u32(v, sp, &slen)) return -3;
+    if (!in_range(v, sp + 4, slen)) return -3;
+    out->source = buf + sp + 4;
+    out->source_len = slen;
+  }
+  // message_id (slot 1, int64)
+  out->message_id = 0;
+  fp = field_pos(v, tpos, 1);
+  if (fp < 0) return -3;
+  if (fp > 0) {
+    if (!in_range(v, fp, 8)) return -3;
+    std::memcpy(&out->message_id, buf + fp, 8);
+  }
+  // reference_time (slot 2, [int64])
+  if (vector_field(v, tpos, 2, 8, &d, &n) < 0) return -4;
+  out->ref_time = reinterpret_cast<const int64_t*>(d);
+  out->n_ref = n;
+  // time_of_flight (slot 4, [int32])
+  if (vector_field(v, tpos, 4, 4, &d, &n) < 0) return -4;
+  out->tof = reinterpret_cast<const int32_t*>(d);
+  out->n_tof = n;
+  // pixel_id (slot 5, [int32])
+  if (vector_field(v, tpos, 5, 4, &d, &n) < 0) return -4;
+  out->pixel = reinterpret_cast<const int32_t*>(d);
+  out->n_pixel = n;
+  return 0;
+}
+
+struct Staging {
+  int32_t* pixel;
+  float* toa;
+  int64_t cap;
+  int64_t n;
+  int64_t min_bucket;
+  bool in_use;
+};
+
+bool grow(Staging* s, int64_t needed) {
+  int64_t cap = s->cap;
+  while (cap < needed) cap <<= 1;
+  auto* pixel = static_cast<int32_t*>(std::malloc(cap * sizeof(int32_t)));
+  auto* toa = static_cast<float*>(std::malloc(cap * sizeof(float)));
+  if (!pixel || !toa) {
+    std::free(pixel);
+    std::free(toa);
+    return false;
+  }
+  if (s->n > 0) {
+    std::memcpy(pixel, s->pixel, s->n * sizeof(int32_t));
+    std::memcpy(toa, s->toa, s->n * sizeof(float));
+  }
+  std::free(s->pixel);
+  std::free(s->toa);
+  s->pixel = pixel;
+  s->toa = toa;
+  s->cap = cap;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ld_staging_new(int64_t min_bucket) {
+  if (min_bucket < 1) min_bucket = 1;
+  auto* s = static_cast<Staging*>(std::malloc(sizeof(Staging)));
+  if (!s) return nullptr;
+  s->cap = min_bucket;
+  s->min_bucket = min_bucket;
+  s->n = 0;
+  s->in_use = false;
+  s->pixel = static_cast<int32_t*>(std::malloc(s->cap * sizeof(int32_t)));
+  s->toa = static_cast<float*>(std::malloc(s->cap * sizeof(float)));
+  if (!s->pixel || !s->toa) {
+    std::free(s->pixel);
+    std::free(s->toa);
+    std::free(s);
+    return nullptr;
+  }
+  return s;
+}
+
+void ld_staging_free(void* h) {
+  if (!h) return;
+  auto* s = static_cast<Staging*>(h);
+  std::free(s->pixel);
+  std::free(s->toa);
+  std::free(s);
+}
+
+int64_t ld_staging_len(void* h) { return static_cast<Staging*>(h)->n; }
+
+// Decode one ev44 message and append its events.
+// monitor_mode != 0: ignore pixel ids, append pixel_id=0 per event.
+// Returns number of events appended, or negative error:
+//   -1 short/corrupt buffer, -2 wrong schema, -3/-4 corrupt table,
+//   -5 tof/pixel length mismatch, -6 staging in use, -7 out of memory.
+int64_t ld_staging_add_ev44(void* h, const uint8_t* buf, int64_t len,
+                            int monitor_mode) {
+  auto* s = static_cast<Staging*>(h);
+  if (s->in_use) return -6;
+  Ev44View ev;
+  int rc = parse_ev44(buf, len, &ev);
+  if (rc != 0) return rc;
+  int64_t k = ev.n_tof;
+  if (k == 0) return 0;
+  bool with_pixel = !monitor_mode && ev.n_pixel > 0;
+  if (with_pixel && ev.n_pixel != ev.n_tof) return -5;
+  if (s->n + k > s->cap && !grow(s, s->n + k)) return -7;
+  int32_t* pd = s->pixel + s->n;
+  float* td = s->toa + s->n;
+  if (with_pixel) {
+    std::memcpy(pd, ev.pixel, k * sizeof(int32_t));
+  } else {
+    std::memset(pd, 0, k * sizeof(int32_t));
+  }
+  for (int64_t i = 0; i < k; ++i) td[i] = static_cast<float>(ev.tof[i]);
+  s->n += k;
+  return k;
+}
+
+// Append pre-decoded arrays (toa already float32). Returns n or negative.
+int64_t ld_staging_add_raw(void* h, const int32_t* pixel, const float* toa,
+                           int64_t n) {
+  auto* s = static_cast<Staging*>(h);
+  if (s->in_use) return -6;
+  if (n <= 0) return 0;
+  if (s->n + n > s->cap && !grow(s, s->n + n)) return -7;
+  std::memcpy(s->pixel + s->n, pixel, n * sizeof(int32_t));
+  std::memcpy(s->toa + s->n, toa, n * sizeof(float));
+  s->n += n;
+  return n;
+}
+
+// Pad to the power-of-two bucket boundary and expose the buffers.
+// Writes pointers + padded size + valid count; marks buffer in-use.
+// Returns 0, or -7 on allocation failure.
+int64_t ld_staging_take(void* h, int32_t** pixel_out, float** toa_out,
+                        int64_t* padded_out, int64_t* n_valid_out) {
+  auto* s = static_cast<Staging*>(h);
+  int64_t b = s->min_bucket;
+  while (b < s->n) b <<= 1;
+  if (b > s->cap && !grow(s, b)) return -7;
+  for (int64_t i = s->n; i < b; ++i) {
+    s->pixel[i] = -1;  // out-of-range: dropped by the device scatter
+    s->toa[i] = 0.0f;
+  }
+  s->in_use = true;
+  *pixel_out = s->pixel;
+  *toa_out = s->toa;
+  *padded_out = b;
+  *n_valid_out = s->n;
+  return 0;
+}
+
+void ld_staging_release(void* h) {
+  auto* s = static_cast<Staging*>(h);
+  s->in_use = false;
+  s->n = 0;
+}
+
+void ld_staging_clear(void* h) {
+  auto* s = static_cast<Staging*>(h);
+  s->in_use = false;
+  s->n = 0;
+}
+
+// Standalone metadata probe (no staging): extract message_id, event count,
+// and first/last reference_time from an ev44 buffer. Returns 0 or negative
+// parse error. Used for batching decisions without a full decode.
+int64_t ld_ev44_info(const uint8_t* buf, int64_t len, int64_t* message_id,
+                     int64_t* n_events, int64_t* ref_time_first,
+                     int64_t* ref_time_last) {
+  Ev44View ev;
+  int rc = parse_ev44(buf, len, &ev);
+  if (rc != 0) return rc;
+  *message_id = ev.message_id;
+  *n_events = ev.n_tof;
+  if (ev.n_ref > 0) {
+    int64_t first, last;
+    std::memcpy(&first, ev.ref_time, 8);
+    std::memcpy(&last, ev.ref_time + (ev.n_ref - 1), 8);
+    *ref_time_first = first;
+    *ref_time_last = last;
+  } else {
+    *ref_time_first = 0;
+    *ref_time_last = 0;
+  }
+  return 0;
+}
+
+}  // extern "C"

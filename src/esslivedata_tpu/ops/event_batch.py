@@ -17,7 +17,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-__all__ = ["EventBatch", "StagingBuffer", "bucket_size"]
+__all__ = ["EventBatch", "StagingBuffer", "bucket_size", "make_staging_buffer"]
 
 MIN_BUCKET = 1 << 12  # 4096: below this, padding waste is irrelevant
 MAX_BUCKET = 1 << 26  # 64M events per device batch
@@ -45,6 +45,9 @@ class EventBatch:
     pixel_id: np.ndarray  # int32 [B]
     toa: np.ndarray  # float32 [B] time-of-arrival within pulse (ns)
     n_valid: int
+    # Keeps the memory owner alive when pixel_id/toa are zero-copy views
+    # into a native staging buffer (numpy cannot track C-owned memory).
+    owner: object = None
 
     @property
     def padded_size(self) -> int:
@@ -135,3 +138,29 @@ class StagingBuffer:
     def clear(self) -> None:
         self._n = 0
         self._in_use = False
+
+
+def make_staging_buffer(min_bucket: int = MIN_BUCKET, prefer_native: bool = True):
+    """StagingBuffer factory: the native C++ buffer (native/ingest.cpp) when
+    the compiled shim is available, else the pure-Python one. Both satisfy
+    the same add/take/release contract and are covered by the same tests."""
+    if prefer_native:
+        try:
+            from ..native import NativeStagingBuffer, available
+        except ImportError as err:
+            _log_native_fallback(err)
+        else:
+            if available():
+                try:
+                    return NativeStagingBuffer(min_bucket=min_bucket)
+                except (OSError, MemoryError, RuntimeError) as err:
+                    _log_native_fallback(err)
+    return StagingBuffer(min_bucket=min_bucket)
+
+
+def _log_native_fallback(err: Exception) -> None:
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "Native staging buffer unavailable, using Python fallback: %s", err
+    )

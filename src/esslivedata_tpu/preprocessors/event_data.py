@@ -18,7 +18,7 @@ from typing import ClassVar
 import numpy as np
 
 from ..core.timestamp import Timestamp
-from ..ops.event_batch import EventBatch, StagingBuffer
+from ..ops.event_batch import EventBatch, StagingBuffer, make_staging_buffer
 
 __all__ = ["DetectorEvents", "MonitorEvents", "StagedEvents", "ToEventBatch"]
 
@@ -70,10 +70,13 @@ class ToEventBatch:
 
     is_context: ClassVar[bool] = False
 
-    def __init__(self, min_bucket: int | None = None) -> None:
-        self._buffer = (
-            StagingBuffer(min_bucket=min_bucket) if min_bucket else StagingBuffer()
-        )
+    def __init__(
+        self, min_bucket: int | None = None, prefer_native: bool = True
+    ) -> None:
+        if min_bucket:
+            self._buffer = make_staging_buffer(min_bucket, prefer_native)
+        else:
+            self._buffer = make_staging_buffer(prefer_native=prefer_native)
         self._first: Timestamp | None = None
         self._last: Timestamp | None = None
         self._n_chunks = 0

@@ -128,7 +128,18 @@ class DetectorViewWorkflow:
         counters = {g.geometry_type: iter(g.index_range) for g in self._roi_mapper.geometries}
         indexed: dict[int, tuple[str, ROI]] = {}
         for name, roi in rois.items():
-            gtype = "rectangle" if isinstance(roi, RectangleROI) else "polygon"
+            gtype = next(
+                (
+                    g.geometry_type
+                    for g in self._roi_mapper.geometries
+                    if isinstance(roi, g.roi_class)
+                ),
+                None,
+            )
+            if gtype is None:
+                raise ValueError(
+                    f"ROI {name!r} has unsupported type {type(roi).__name__}"
+                )
             try:
                 index = next(counters[gtype])
             except StopIteration:

@@ -1,0 +1,156 @@
+"""Native ingest shim vs. the pure-Python reference path.
+
+The C++ decoder (native/ingest.cpp) must agree byte-for-byte with the
+clean-room Python codec (kafka/wire.py) and the Python StagingBuffer
+(ops/event_batch.py) on every input, including malformed ones.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.kafka import wire
+from esslivedata_tpu.ops.event_batch import StagingBuffer, make_staging_buffer
+
+native = pytest.importorskip("esslivedata_tpu.native")
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native ingest library unavailable (no g++)"
+)
+
+
+def _ev44(n, n_pixel=1024, seed=0, source="det0", message_id=7):
+    rng = np.random.default_rng(seed)
+    pixel = rng.integers(0, n_pixel, n).astype(np.int32)
+    tof = rng.integers(0, 71_000_000, n).astype(np.int32)
+    ref = np.array([1_700_000_000_000_000_000 + seed], dtype=np.int64)
+    buf = wire.encode_ev44(
+        source_name=source,
+        message_id=message_id,
+        reference_time=ref,
+        reference_time_index=np.array([0], dtype=np.int32),
+        time_of_flight=tof,
+        pixel_id=pixel,
+    )
+    return buf, pixel, tof, int(ref[0])
+
+
+class TestEv44Info:
+    def test_matches_python_decode(self):
+        buf, _, tof, ref = _ev44(1000, seed=3, message_id=42)
+        mid, n, first, last = native.ev44_info(buf)
+        assert mid == 42
+        assert n == 1000
+        assert first == ref
+        assert last == ref
+
+    def test_garbage_raises(self):
+        with pytest.raises(ValueError):
+            native.ev44_info(b"\x00" * 64)
+
+    def test_short_raises(self):
+        with pytest.raises(ValueError):
+            native.ev44_info(b"ab")
+
+    def test_wrong_schema_raises(self):
+        buf = wire.encode_f144("s", np.array([1.0]), 123)
+        with pytest.raises(ValueError):
+            native.ev44_info(buf)
+
+
+class TestNativeStaging:
+    def test_add_ev44_matches_python_staging(self):
+        py = StagingBuffer(min_bucket=16)
+        nat = native.NativeStagingBuffer(min_bucket=16)
+        for seed in range(5):
+            buf, pixel, tof, _ = _ev44(100 + seed * 37, seed=seed)
+            ev = wire.decode_ev44(buf)
+            py.add(ev.pixel_id, ev.time_of_flight.astype(np.float32))
+            appended = nat.add_ev44(buf)
+            assert appended == 100 + seed * 37
+        bp, bn = py.take(), nat.take()
+        assert bp.n_valid == bn.n_valid
+        assert bp.padded_size == bn.padded_size
+        np.testing.assert_array_equal(bp.pixel_id, bn.pixel_id)
+        np.testing.assert_array_equal(bp.toa, bn.toa)
+
+    def test_monitor_mode_zero_pixels(self):
+        nat = native.NativeStagingBuffer(min_bucket=16)
+        buf, _, tof, _ = _ev44(50, seed=1)
+        nat.add_ev44(buf, monitor=True)
+        b = nat.take()
+        assert b.n_valid == 50
+        np.testing.assert_array_equal(b.pixel_id[:50], np.zeros(50, np.int32))
+        np.testing.assert_array_equal(b.toa[:50], tof.astype(np.float32))
+
+    def test_padding_tail_is_invalid(self):
+        nat = native.NativeStagingBuffer(min_bucket=16)
+        buf, *_ = _ev44(10, seed=2)
+        nat.add_ev44(buf)
+        b = nat.take()
+        assert b.padded_size == 16
+        np.testing.assert_array_equal(b.pixel_id[10:], np.full(6, -1, np.int32))
+
+    def test_in_use_guard(self):
+        nat = native.NativeStagingBuffer(min_bucket=16)
+        buf, *_ = _ev44(10)
+        nat.add_ev44(buf)
+        nat.take()
+        with pytest.raises(RuntimeError):
+            nat.add_ev44(buf)
+        nat.release()
+        assert nat.add_ev44(buf) == 10
+
+    def test_malformed_rejected_cleanly(self):
+        nat = native.NativeStagingBuffer(min_bucket=16)
+        with pytest.raises(ValueError):
+            nat.add_ev44(b"\xff" * 200)
+        # Buffer still usable after the rejected message.
+        buf, *_ = _ev44(5)
+        assert nat.add_ev44(buf) == 5
+
+    def test_truncated_flatbuffer_rejected(self):
+        buf, *_ = _ev44(1000)
+        nat = native.NativeStagingBuffer(min_bucket=16)
+        for cut in (9, 50, len(buf) // 2):
+            with pytest.raises(ValueError):
+                nat.add_ev44(buf[:cut])
+
+    def test_growth_across_many_messages(self):
+        nat = native.NativeStagingBuffer(min_bucket=16)
+        total = 0
+        for seed in range(20):
+            buf, *_ = _ev44(1000, seed=seed)
+            total += nat.add_ev44(buf)
+        assert len(nat) == total == 20_000
+        b = nat.take()
+        assert b.n_valid == 20_000
+        assert b.padded_size == 32_768
+
+    def test_add_raw_roundtrip(self):
+        nat = native.NativeStagingBuffer(min_bucket=16)
+        pixel = np.arange(100, dtype=np.int32)
+        toa = np.linspace(0, 1e6, 100).astype(np.float32)
+        nat.add(pixel, toa)
+        b = nat.take()
+        np.testing.assert_array_equal(b.pixel_id[:100], pixel)
+        np.testing.assert_array_equal(b.toa[:100], toa)
+
+    def test_release_resets(self):
+        nat = native.NativeStagingBuffer(min_bucket=16)
+        buf, *_ = _ev44(10)
+        nat.add_ev44(buf)
+        nat.take()
+        nat.release()
+        assert len(nat) == 0
+
+
+def test_factory_prefers_native():
+    buf = make_staging_buffer(min_bucket=16)
+    assert type(buf).__name__ == "NativeStagingBuffer"
+
+
+def test_factory_python_fallback():
+    buf = make_staging_buffer(min_bucket=16, prefer_native=False)
+    assert isinstance(buf, StagingBuffer)
