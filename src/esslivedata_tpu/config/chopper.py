@@ -1,0 +1,67 @@
+"""Chopper stream-name conventions (instrument-level data-model concern).
+
+Parity with reference ``config/chopper.py``: an instrument that declares
+choppers owns the streams they produce — a clean ``rotation_speed_setpoint``
+and a noisy ``delay`` readback per chopper (real upstream PVs), plus the
+synthetic ``delay_setpoint`` the ``ChopperSynthesizer`` derives by plateau
+detection. The wavelength-LUT workflow consumes these as context; it is not
+their owner.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+from .stream import F144Stream, Stream
+
+__all__ = [
+    "declare_chopper_setpoint_streams",
+    "delay_readback_stream",
+    "delay_setpoint_stream",
+    "speed_setpoint_stream",
+]
+
+
+def speed_setpoint_stream(chopper: str) -> str:
+    """Stream name of a chopper's clean rotation-speed setpoint f144 PV."""
+    return f"{chopper}/rotation_speed_setpoint"
+
+
+def delay_readback_stream(chopper: str) -> str:
+    """Stream name of a chopper's noisy delay readback f144 PV."""
+    return f"{chopper}/delay"
+
+
+def delay_setpoint_stream(chopper: str) -> str:
+    """Stream name of the synthesized (plateau-locked) delay setpoint.
+
+    Emitted in-process by ``ChopperSynthesizer``; not a Kafka topic.
+    """
+    return f"{chopper}/delay_setpoint"
+
+
+def declare_chopper_setpoint_streams(
+    streams: dict[str, Stream], choppers: Sequence[str]
+) -> None:
+    """Declare the synthetic ``delay_setpoint`` streams in-place.
+
+    The readback must carry unit 'ns': plateau detection and the delay
+    tolerance threshold assume nanosecond samples, so a differently-unitted
+    readback would silently mis-scale detection.
+    """
+    for chopper in choppers:
+        try:
+            readback = streams[delay_readback_stream(chopper)]
+        except KeyError:
+            raise ValueError(
+                f"Chopper {chopper!r} declared but its delay readback stream "
+                f"{delay_readback_stream(chopper)!r} is not in the stream "
+                f"catalog"
+            ) from None
+        units = getattr(readback, "units", None)
+        if units != "ns":
+            raise ValueError(
+                f"Chopper {chopper!r} delay readback declares units "
+                f"{units!r}, expected 'ns'"
+            )
+        streams[delay_setpoint_stream(chopper)] = F144Stream(units=units)

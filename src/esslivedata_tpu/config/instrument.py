@@ -14,8 +14,12 @@ import importlib
 import logging
 import threading
 from dataclasses import dataclass, field
+from typing import TYPE_CHECKING
 
 import numpy as np
+
+if TYPE_CHECKING:
+    from .stream import ContextBinding, Device, Stream
 
 __all__ = [
     "DetectorConfig",
@@ -59,12 +63,66 @@ class Instrument:
     detectors: dict[str, DetectorConfig] = field(default_factory=dict)
     monitors: dict[str, MonitorConfig] = field(default_factory=dict)
     log_sources: dict[str, str] = field(default_factory=dict)  # stream -> source
+    streams: dict[str, "Stream"] = field(default_factory=dict)
+    """Name-keyed stream catalog (f144 PVs, synthesised Device streams);
+    reference instrument.py streams + ADR 0009 generated registries."""
+    choppers: list[str] = field(default_factory=list)
+    """Chopper names; declaring any auto-declares the synthetic
+    delay_setpoint streams (config/chopper.py)."""
+    chopper_delay_atol_ns: float = 1000.0
+    context_bindings: list["ContextBinding"] = field(default_factory=list)
     merge_detectors: bool = False
     """Adapt every detector bank onto one logical 'detector' stream
     (BIFROST pattern, reference message_adapter.py:416)."""
     _factories_module: str | None = None
     _specs_module: str | None = None
     _loaded: bool = field(default=False, repr=False)
+
+    def __post_init__(self) -> None:
+        if self.choppers:
+            self.declare_choppers(self.choppers)
+
+    def declare_choppers(self, names: list[str]) -> None:
+        """Post-construction chopper declaration (builder-style specs.py
+        mutate the instrument after init, so ``__post_init__`` alone would
+        silently skip the synthetic delay_setpoint streams)."""
+        from .chopper import declare_chopper_setpoint_streams
+
+        self.choppers = list(names)
+        declare_chopper_setpoint_streams(self.streams, self.choppers)
+
+    @property
+    def devices(self) -> dict[str, "Device"]:
+        """Synthesised Device entries of the stream catalog."""
+        from .stream import Device
+
+        return {
+            name: s for name, s in self.streams.items() if isinstance(s, Device)
+        }
+
+    def add_context_binding(self, binding: "ContextBinding") -> None:
+        """Instrument-scope context declaration (reference :244): the value
+        of a stream routed as workflow context for dependent sources."""
+        self.context_bindings.append(binding)
+
+    def resolve_context_keys(self, source_name: str) -> dict[str, str]:
+        """context_key -> stream_name for bindings that apply to a source.
+
+        Two bindings resolving the same key to different streams for one
+        source is a misconfiguration and raises rather than silently
+        letting the later registration win."""
+        out: dict[str, str] = {}
+        for b in self.context_bindings:
+            if b.dependent_sources and source_name not in b.dependent_sources:
+                continue
+            if b.workflow_key in out and out[b.workflow_key] != b.stream_name:
+                raise ValueError(
+                    f"Context key {b.workflow_key!r} for source "
+                    f"{source_name!r} bound to both {out[b.workflow_key]!r} "
+                    f"and {b.stream_name!r}"
+                )
+            out[b.workflow_key] = b.stream_name
+        return out
 
     def add_detector(self, config: DetectorConfig) -> None:
         self.detectors[config.name] = config

@@ -1,0 +1,318 @@
+"""Stream catalog: canonical records describing streaming data declarations.
+
+Parity with reference ``config/stream.py`` (Stream:30, F144Stream:67,
+Device:76, ContextBinding:105, ChainPatchBinding:153, suggest_names:181,
+device detection :272, filter_authorized_streams:345, name_streams:376).
+
+A ``Stream`` describes one streaming group at the wire level — what it is,
+not what an instrument calls it. The instrument-facing name is the key into
+the instrument's stream dict and is the routing handle everywhere except the
+Kafka boundary (topic/source only matter where bytes arrive). Unlike the
+reference, workflow context keys here are plain strings (our workflows are
+jitted step functions parameterized by named context scalars, not sciline
+keys), so ``ContextBinding.workflow_key`` is ``str``.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Iterable, Mapping
+from dataclasses import dataclass
+
+__all__ = [
+    "ChainPatchBinding",
+    "ContextBinding",
+    "Device",
+    "F144Stream",
+    "Stream",
+    "filter_authorized_streams",
+    "name_streams",
+    "suggest_names",
+]
+
+
+@dataclass(frozen=True, slots=True, kw_only=True)
+class Stream:
+    """Any streaming group in NeXus (or synthesised in-process).
+
+    Synthesised streams have ``topic``, ``source`` and ``nexus_path`` all
+    None — they never traverse Kafka. Real Kafka streams must set topic and
+    source together; ``nexus_path`` may be None for hand-coded entries.
+    """
+
+    writer_module: str
+    nexus_path: str | None = None
+    topic: str | None = None
+    source: str | None = None
+    nx_class: str = ""
+
+    def __post_init__(self) -> None:
+        if self.topic is None and self.source is not None:
+            raise ValueError(
+                f"Stream {self.nexus_path!r}: source set but topic is None"
+            )
+        if self.source is None and self.topic is not None:
+            raise ValueError(
+                f"Stream {self.nexus_path!r}: topic set but source is None"
+            )
+
+
+@dataclass(frozen=True, slots=True, kw_only=True)
+class F144Stream(Stream):
+    """f144 NXlog stream — (time, value) samples."""
+
+    units: str | None = None
+    writer_module: str = "f144"
+    nx_class: str = "NXlog"
+
+
+@dataclass(frozen=True, slots=True, kw_only=True)
+class Device(Stream):
+    """Synthesised stream merging RBV/VAL/DMOV substreams of a motor device.
+
+    Materialised in-process by ``DeviceSynthesizer`` from the substreams
+    named by ``value`` (RBV, required), ``target`` (VAL) and ``idle`` (DMOV);
+    each is a key into the instrument's stream dict.
+    """
+
+    value: str
+    target: str | None = None
+    idle: str | None = None
+    units: str | None = None
+    writer_module: str = "device"
+    nx_class: str = "NXpositioner"
+
+    @property
+    def substream_names(self) -> tuple[str, ...]:
+        return tuple(
+            s for s in (self.value, self.target, self.idle) if s is not None
+        )
+
+
+@dataclass(frozen=True, slots=True, kw_only=True)
+class ContextBinding:
+    """Declaration of one context-stream input to a workflow.
+
+    Routes the value of ``stream_name`` into workflows wired for any source
+    in ``dependent_sources`` under the context key ``workflow_key``. Jobs
+    whose workflow declares the key gate on it (pending_context) until a
+    value is available. Kept in a list of its own, not on ``Stream``:
+    how a stream is used is not a property of the stream.
+    """
+
+    stream_name: str
+    workflow_key: str
+    dependent_sources: frozenset[str]
+
+
+@dataclass(frozen=True, slots=True, kw_only=True)
+class ChainPatchBinding:
+    """A geometry-patching :class:`ContextBinding` resolved for wiring.
+
+    Carries the pre-resolved NeXus transform path so the dynamic-transform
+    wiring (projection-LUT rebuild on motor motion) runs as a pure function
+    of this record without re-consulting the stream topology.
+    """
+
+    stream_name: str
+    transform_path: str
+    workflow_key: str
+    dependent_sources: frozenset[str]
+
+
+#: NeXus container groups with no entity-level meaning; dropped when deriving
+#: internal names so 'entry/instrument/wfm1/transformations/t1' -> 'wfm1/t1'.
+_GENERIC_GROUPS: frozenset[str] = frozenset(
+    {"entry", "instrument", "sample", "sample_environment", "transformations"}
+)
+
+
+def suggest_names(
+    paths: Iterable[str],
+    *,
+    min_depth: int = 2,
+    forbidden: Iterable[str] | None = None,
+) -> dict[str, str]:
+    """Suggest a unique internal name per NeXus group path.
+
+    Generic container groups are filtered out; the name is the shortest tail
+    (>= ``min_depth`` components) of the filtered path that is unique across
+    the set and not ``forbidden``. Remaining collisions escalate to longer
+    tails, then fall back to the full unfiltered path (unique in HDF5).
+    """
+    paths = list(paths)
+    forbidden_set = frozenset(forbidden or ())
+    full = {p: p.strip("/").split("/") for p in paths}
+    filtered = {
+        p: [c for c in full[p] if c not in _GENERIC_GROUPS] or full[p]
+        for p in paths
+    }
+
+    result: dict[str, str] = {}
+    pending = set(paths)
+    for parts in (filtered, full):
+        if not pending:
+            break
+        max_depth = max((len(parts[p]) for p in pending), default=1)
+        depth = min_depth
+        while pending and depth <= max(max_depth, min_depth):
+            candidate = {
+                p: "/".join(parts[p][-min(depth, len(parts[p])):])
+                for p in pending
+            }
+            counts: dict[str, int] = {}
+            for name in candidate.values():
+                counts[name] = counts.get(name, 0) + 1
+            still: set[str] = set()
+            for path, name in candidate.items():
+                if counts[name] == 1 and name not in forbidden_set:
+                    result[path] = name
+                else:
+                    still.add(path)
+            pending = still
+            depth += 1
+    return result
+
+
+#: EPICS motor-record source-attribute suffixes identifying substream roles.
+_ROLE_BY_SUFFIX: dict[str, str] = {
+    ".RBV": "value",
+    ".VAL": "target",
+    ".DMOV": "idle",
+}
+
+
+def _classify_source(source: str | None) -> str | None:
+    if source is None:
+        return None
+    for suffix, role in _ROLE_BY_SUFFIX.items():
+        if source.endswith(suffix):
+            return role
+    return None
+
+
+@dataclass(frozen=True, slots=True)
+class _DetectedDevice:
+    value: str
+    target: str | None
+    idle: str | None
+    units: str | None
+
+
+def _detect_devices(parsed: Mapping[str, Stream]) -> dict[str, _DetectedDevice]:
+    """Detect device groups by EPICS source-suffix classification.
+
+    f144 substreams co-located under one NeXus parent form a Device when a
+    classified RBV is present plus at least one of VAL/DMOV. Raises on two
+    children of one parent claiming the same role or RBV/VAL unit mismatch.
+    """
+    by_parent: dict[str, dict[str, str]] = {}
+    for path, stream in parsed.items():
+        if not isinstance(stream, F144Stream):
+            continue
+        role = _classify_source(stream.source)
+        if role is None:
+            continue
+        parent, _, _ = path.rpartition("/")
+        roles = by_parent.setdefault(parent, {})
+        if role in roles:
+            raise ValueError(
+                f"Device at {parent!r}: two children classify as {role!r} "
+                f"({roles[role]!r} and {path!r})"
+            )
+        roles[role] = path
+
+    devices: dict[str, _DetectedDevice] = {}
+    for parent, roles in by_parent.items():
+        if "value" not in roles:
+            continue
+        if "target" not in roles and "idle" not in roles:
+            continue
+        rbv = parsed[roles["value"]]
+        units = rbv.units if isinstance(rbv, F144Stream) else None
+        if "target" in roles:
+            val = parsed[roles["target"]]
+            if isinstance(val, F144Stream) and val.units != units:
+                raise ValueError(
+                    f"Device at {parent!r}: RBV units {units!r} != VAL "
+                    f"units {val.units!r}"
+                )
+        devices[parent] = _DetectedDevice(
+            value=roles["value"],
+            target=roles.get("target"),
+            idle=roles.get("idle"),
+            units=units,
+        )
+    return devices
+
+
+#: Topic suffixes with a PROD ACL grant for f144 streams (workaround for an
+#: incomplete PROD authorization list), plus tn_data_general outright.
+_AUTHORIZED_TOPIC_SUFFIXES: tuple[str, ...] = (
+    "_choppers",
+    "_motion",
+    "_sample_env",
+)
+_AUTHORIZED_TOPICS: frozenset[str] = frozenset({"tn_data_general"})
+
+
+def filter_authorized_streams(parsed: dict[str, Stream]) -> dict[str, Stream]:
+    """Drop streams whose Kafka topic lacks a PROD ACL grant."""
+    return {
+        path: stream
+        for path, stream in parsed.items()
+        if stream.topic in _AUTHORIZED_TOPICS
+        or (
+            stream.topic is not None
+            and stream.topic.endswith(_AUTHORIZED_TOPIC_SUFFIXES)
+        )
+    }
+
+
+def name_streams(
+    parsed: dict[str, Stream],
+    *,
+    rename: dict[str, str] | None = None,
+) -> dict[str, Stream]:
+    """Build a name-keyed stream dict from a path-keyed parsed dict.
+
+    Auto-suggests names via :func:`suggest_names` (substreams at
+    ``min_depth=2``, detected device parents at ``min_depth=1`` with
+    substream names forbidden, keeping the namespaces disjoint);
+    ``rename`` (keyed by nexus_path) overrides. Detected motor devices are
+    emitted as :class:`Device` entries pointing at their substream names.
+    """
+    rename = rename or {}
+    devices = _detect_devices(parsed)
+    valid = set(parsed) | set(devices)
+    if missing := set(rename) - valid:
+        raise ValueError(
+            f"rename keys not in parsed or detected device parents: "
+            f"{sorted(missing)}"
+        )
+    substream_names = suggest_names(parsed.keys())
+    device_names = suggest_names(
+        devices.keys(), min_depth=1, forbidden=substream_names.values()
+    )
+    suggested = {**substream_names, **device_names}
+
+    def resolve(path: str) -> str:
+        return rename.get(path, suggested[path])
+
+    result: dict[str, Stream] = {}
+    for path, stream in parsed.items():
+        name = resolve(path)
+        if name in result:
+            raise ValueError(f"name {name!r} for {path!r} collides")
+        result[name] = stream
+    for parent, info in devices.items():
+        name = resolve(parent)
+        if name in result:
+            raise ValueError(f"device name {name!r} for {parent!r} collides")
+        result[name] = Device(
+            nexus_path=parent,
+            value=resolve(info.value),
+            target=resolve(info.target) if info.target else None,
+            idle=resolve(info.idle) if info.idle else None,
+            units=info.units,
+        )
+    return result

@@ -52,9 +52,32 @@ def get_stream_mapping(instrument: Instrument, dev: bool = False) -> StreamMappi
             for m in instrument.monitors.values()
         },
         area_detectors={},
-        logs={
-            InputStreamKey(topic=log_topic, source_name=source): stream
-            for stream, source in instrument.log_sources.items()
-        },
+        logs=_build_logs_lut(instrument, log_topic, dev),
         run_control_topics=(run_topic,),
     )
+
+
+def _build_logs_lut(
+    instrument: Instrument, log_topic: str, dev: bool
+) -> dict[InputStreamKey, str]:
+    """Merge log_sources (convention topic) with catalog streams (declared
+    topics). Catalog topics get the same dev prefix as convention topics so
+    a dev broker never shadows or consumes production streams; synthesised
+    catalog entries (topic None) never ride Kafka and stay out of the LUT.
+    Duplicate (topic, source) keys are a misconfiguration and raise."""
+    lut: dict[InputStreamKey, str] = {
+        InputStreamKey(topic=log_topic, source_name=source): stream
+        for stream, source in instrument.log_sources.items()
+    }
+    for stream_name, s in instrument.streams.items():
+        if s.topic is None or s.source is None:
+            continue
+        topic = f"dev_{s.topic}" if dev else s.topic
+        key = InputStreamKey(topic=topic, source_name=s.source)
+        if key in lut:
+            raise ValueError(
+                f"Stream {stream_name!r} and {lut[key]!r} both claim "
+                f"(topic={key.topic!r}, source={key.source_name!r})"
+            )
+        lut[key] = stream_name
+    return lut

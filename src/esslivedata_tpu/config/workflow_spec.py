@@ -41,6 +41,18 @@ def _check_id_field(value: str) -> str:
     return value
 
 
+def _check_pipe_free(value: str) -> str:
+    """Source/output names ride as single '|'-separated ResultKey fields, so
+    only '|' is reserved; '/' is allowed (catalog stream names follow the
+    NeXus path convention, e.g. 'c1/delay_setpoint', 'motor/value')."""
+    if not value or "|" in value:
+        raise ValueError(
+            f"Name {value!r} must be non-empty and contain no '|' "
+            "(reserved for the ResultKey wire encoding)"
+        )
+    return value
+
+
 class WorkflowId(BaseModel):
     """Identifies a workflow implementation (not an instance)."""
 
@@ -81,7 +93,7 @@ class JobId(BaseModel):
     @field_validator("source_name")
     @classmethod
     def _safe_source(cls, v: str) -> str:
-        return _check_id_field(v)
+        return _check_pipe_free(v)
 
     def __str__(self) -> str:
         return f"{self.source_name}:{self.job_number}"
@@ -130,7 +142,7 @@ class ResultKey(BaseModel):
     @field_validator("output_name")
     @classmethod
     def _safe_output(cls, v: str) -> str:
-        return _check_id_field(v)
+        return _check_pipe_free(v)
 
     def to_string(self) -> str:
         return (

@@ -1,0 +1,206 @@
+"""Synthesize a chopper-cascade trigger from chopper PV streams.
+
+Parity with reference ``kafka/chopper_synthesizer.py:148``: a MessageSource
+decorator that forwards everything verbatim while
+
+- caching per-chopper ``<chopper>/rotation_speed_setpoint`` values,
+- plateau-detecting each chopper's noisy ``<chopper>/delay`` readback with a
+  rolling-window stability detector, emitting a synthetic
+  ``<chopper>/delay_setpoint`` f144 on each new lock,
+- emitting a synthetic primary tick on the ``chopper_cascade`` logical
+  stream when every configured chopper has both a cached speed setpoint and
+  a locked delay setpoint — only on cycles where an input actually changed.
+
+Chopperless instruments (empty ``chopper_names``) get exactly one vacuous
+cascade tick on the first ``get_messages`` call. The cascade tick is the
+wavelength-LUT job's primary dynamic stream: its arrival drives a LUT
+recompute (see workflows/wavelength_lut_workflow.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from collections.abc import Sequence
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..config.chopper import (
+    delay_readback_stream,
+    delay_setpoint_stream,
+    speed_setpoint_stream,
+)
+from ..core.message import Message, MessageSource, StreamId, StreamKind
+from ..core.timestamp import Timestamp
+from ..preprocessors.to_nxlog import LogData
+
+__all__ = ["CHOPPER_CASCADE_SOURCE", "CHOPPER_CASCADE_STREAM", "ChopperSynthesizer"]
+
+logger = logging.getLogger(__name__)
+
+#: Logical source name of the synthetic cascade trigger stream.
+CHOPPER_CASCADE_SOURCE = "chopper_cascade"
+CHOPPER_CASCADE_STREAM = StreamId(kind=StreamKind.LOG, name=CHOPPER_CASCADE_SOURCE)
+
+
+def _cascade_tick(time: Timestamp | None = None) -> Message[LogData]:
+    """The 'all choppers reached setpoints' tick; value unused downstream.
+
+    Timestamped with the data time of the triggering input so it rides the
+    system's data-time clock (batchers window on message timestamps, never
+    wall clock); the chopperless bootstrap tick has no input and falls back
+    to wall clock.
+    """
+    time = time if time is not None else Timestamp.now()
+    return Message(
+        timestamp=time,
+        stream=CHOPPER_CASCADE_STREAM,
+        value=LogData(time=time.ns, value=1),
+    )
+
+
+class _StabilityDetector:
+    """Rolling-window plateau detector.
+
+    Locks when the window's std dev drops below ``atol``; the locked value
+    is the window mean. The same ``atol`` decides whether a new mean has
+    drifted far enough from the previous lock to count as a new setpoint,
+    so noise rejection and change detection share one knob.
+    """
+
+    def __init__(self, *, window_size: int, atol: float) -> None:
+        self._buffer: deque[float] = deque(maxlen=window_size)
+        self._atol = atol
+        self._locked: float | None = None
+
+    def add(self, sample: float) -> float | None:
+        """Append a sample; return a newly locked value if it changed."""
+        self._buffer.append(sample)
+        if len(self._buffer) < self._buffer.maxlen:
+            return None
+        arr = np.fromiter(self._buffer, dtype=float)
+        if arr.std() >= self._atol:
+            return None
+        mean = float(arr.mean())
+        if self._locked is None or abs(mean - self._locked) > self._atol:
+            self._locked = mean
+            return mean
+        return None
+
+    @property
+    def locked(self) -> float | None:
+        return self._locked
+
+
+@dataclass(slots=True)
+class _ChopperState:
+    detector: _StabilityDetector
+    speed_setpoint: float | None = None
+    delay_setpoint: float | None = None
+
+    def is_locked(self) -> bool:
+        return self.speed_setpoint is not None and self.delay_setpoint is not None
+
+
+class ChopperSynthesizer:
+    """MessageSource decorator injecting synthetic chopper-cascade triggers."""
+
+    def __init__(
+        self,
+        wrapped: MessageSource[Message],
+        *,
+        chopper_names: Sequence[str] = (),
+        delay_window_size: int = 5,
+        delay_atol: float = 1000.0,
+    ) -> None:
+        self._wrapped = wrapped
+        self._chopper_names = tuple(chopper_names)
+        self._states = {
+            name: _ChopperState(
+                detector=_StabilityDetector(
+                    window_size=delay_window_size, atol=delay_atol
+                )
+            )
+            for name in self._chopper_names
+        }
+        self._delay_streams = {
+            delay_readback_stream(n): n for n in self._chopper_names
+        }
+        self._speed_streams = {
+            speed_setpoint_stream(n): n for n in self._chopper_names
+        }
+        self._emitted_initial_tick = False
+        self._was_all_locked = False
+
+    def get_messages(self) -> Sequence[Message]:
+        synthetic: list[Message] = []
+        forwarded: list[Message] = []
+
+        if not self._chopper_names and not self._emitted_initial_tick:
+            self._emitted_initial_tick = True
+            synthetic.append(_cascade_tick())
+            logger.info("chopper_cascade initial tick emitted (no choppers)")
+
+        any_changed = False
+        change_time: Timestamp | None = None
+        for msg in self._wrapped.get_messages():
+            forwarded.append(msg)
+            if self._handle(msg, synthetic):
+                any_changed = True
+                if change_time is None or msg.timestamp > change_time:
+                    change_time = msg.timestamp
+
+        if self._chopper_names:
+            all_locked = all(s.is_locked() for s in self._states.values())
+            if any_changed and all_locked:
+                synthetic.append(_cascade_tick(change_time))
+                if not self._was_all_locked:
+                    logger.info(
+                        "chopper_cascade all locked: %s",
+                        list(self._chopper_names),
+                    )
+            self._was_all_locked = all_locked
+
+        return [*synthetic, *forwarded]
+
+    def _handle(self, msg: Message, synthetic: list[Message]) -> bool:
+        """Update chopper state from ``msg``; True if an input changed."""
+        name = msg.stream.name
+        if (chopper := self._delay_streams.get(name)) is not None:
+            return self._handle_delay(chopper, msg, synthetic)
+        if (chopper := self._speed_streams.get(name)) is not None:
+            return self._handle_speed(chopper, msg)
+        return False
+
+    def _handle_delay(
+        self, chopper: str, msg: Message, synthetic: list[Message]
+    ) -> bool:
+        state = self._states[chopper]
+        new_setpoint = None
+        for sample in np.atleast_1d(msg.value.value):
+            if (locked := state.detector.add(float(sample))) is not None:
+                new_setpoint = locked
+        if new_setpoint is None:
+            return False
+        time_ns = int(msg.value.time[-1])
+        synthetic.append(
+            Message(
+                timestamp=Timestamp.from_ns(time_ns),
+                stream=StreamId(
+                    kind=StreamKind.LOG, name=delay_setpoint_stream(chopper)
+                ),
+                value=LogData(time=time_ns, value=new_setpoint),
+            )
+        )
+        state.delay_setpoint = new_setpoint
+        logger.info("chopper %s delay locked at %s", chopper, new_setpoint)
+        return True
+
+    def _handle_speed(self, chopper: str, msg: Message) -> bool:
+        new_speed = float(np.atleast_1d(msg.value.value)[-1])
+        state = self._states[chopper]
+        if state.speed_setpoint == new_speed:
+            return False
+        state.speed_setpoint = new_speed
+        return True

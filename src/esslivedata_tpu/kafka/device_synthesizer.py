@@ -1,0 +1,131 @@
+"""Merge per-device RBV/VAL/DMOV substreams into a single Device stream.
+
+Parity with reference ``kafka/device_synthesizer.py:87`` (ADR 0001): a
+``MessageSource`` decorator wrapping an already-adapted source. Substream
+messages owned by a configured device are suppressed; once every configured
+substream of a device has been seen, each further substream event emits one
+merged ``LogData`` sample (value + optional target/idle) on a synthetic
+``StreamKind.DEVICE`` stream, timestamped ``max`` over the substream times.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections.abc import Mapping, Sequence
+from dataclasses import dataclass
+from typing import Literal
+
+from ..config.stream import Device
+from ..core.message import Message, MessageSource, StreamId, StreamKind
+from ..core.timestamp import Timestamp
+from ..preprocessors.to_nxlog import LogData
+
+__all__ = ["DeviceSynthesizer"]
+
+logger = logging.getLogger(__name__)
+
+_Role = Literal["value", "target", "idle"]
+
+
+@dataclass(slots=True)
+class _Seen:
+    value: float
+    time: Timestamp
+
+
+@dataclass(slots=True)
+class _DeviceState:
+    device_name: str
+    has_target: bool
+    has_idle: bool
+    value: _Seen | None = None
+    target: _Seen | None = None
+    idle: _Seen | None = None
+
+    def push(self, role: _Role, log: LogData) -> Message[LogData] | None:
+        """Record one substream event; emit a merged sample once bootstrapped."""
+        time = Timestamp.from_ns(int(log.time[-1]))
+        seen = _Seen(value=float(log.value[-1]), time=time)
+        if role == "value":
+            self.value = seen
+        elif role == "target":
+            self.target = seen
+        else:
+            self.idle = seen
+        if self.value is None:
+            return None
+        if self.has_target and self.target is None:
+            return None
+        if self.has_idle and self.idle is None:
+            return None
+        sample_time = max(
+            s.time for s in (self.value, self.target, self.idle) if s is not None
+        )
+        return Message(
+            timestamp=sample_time,
+            stream=StreamId(kind=StreamKind.DEVICE, name=self.device_name),
+            value=LogData(
+                time=sample_time.ns,
+                value=self.value.value,
+                target=self.target.value if self.target is not None else None,
+                idle=bool(self.idle.value) if self.idle is not None else None,
+            ),
+        )
+
+
+class DeviceSynthesizer:
+    """MessageSource decorator synthesizing per-device merged streams.
+
+    Each substream may be owned by exactly one device; non-owned messages
+    pass through unchanged.
+    """
+
+    def __init__(
+        self,
+        wrapped: MessageSource[Message],
+        *,
+        devices: Mapping[str, Device],
+    ) -> None:
+        self._wrapped = wrapped
+        self._by_substream: dict[str, tuple[_DeviceState, _Role]] = {}
+        for name, device in devices.items():
+            state = _DeviceState(
+                device_name=name,
+                has_target=device.target is not None,
+                has_idle=device.idle is not None,
+            )
+            self._register(state, device.value, "value")
+            if device.target is not None:
+                self._register(state, device.target, "target")
+            if device.idle is not None:
+                self._register(state, device.idle, "idle")
+
+    def _register(self, state: _DeviceState, substream: str, role: _Role) -> None:
+        if substream in self._by_substream:
+            other = self._by_substream[substream][0].device_name
+            raise ValueError(
+                f"substream {substream!r} configured for both devices "
+                f"{other!r} and {state.device_name!r}"
+            )
+        self._by_substream[substream] = (state, role)
+
+    def get_messages(self) -> Sequence[Message]:
+        out: list[Message] = []
+        for msg in self._wrapped.get_messages():
+            owner = self._by_substream.get(msg.stream.name)
+            if owner is None:
+                out.append(msg)
+                continue
+            state, role = owner
+            if not isinstance(msg.value, LogData):
+                logger.warning(
+                    "device substream %s (%s/%s) carried unexpected payload %s",
+                    msg.stream.name,
+                    state.device_name,
+                    role,
+                    type(msg.value).__name__,
+                )
+                continue
+            if (sample := state.push(role, msg.value)) is not None:
+                out.append(sample)
+        return out

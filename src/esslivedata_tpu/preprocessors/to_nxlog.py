@@ -21,13 +21,26 @@ __all__ = ["LogData", "ToNXlog"]
 
 
 class LogData:
-    """One decoded f144 sample (or batch of samples)."""
+    """One decoded f144 sample (or batch of samples).
 
-    __slots__ = ("time", "value")
+    ``target``/``idle`` are populated only on synthesized Device samples
+    (DeviceSynthesizer merges a motor's RBV/VAL/DMOV substreams into one
+    stream; reference kafka/device_synthesizer.py).
+    """
 
-    def __init__(self, time: np.ndarray | int, value: np.ndarray) -> None:
+    __slots__ = ("idle", "target", "time", "value")
+
+    def __init__(
+        self,
+        time: np.ndarray | int,
+        value: np.ndarray,
+        target: float | None = None,
+        idle: bool | None = None,
+    ) -> None:
         self.time = np.atleast_1d(np.asarray(time, dtype=np.int64))  # ns epoch
         self.value = np.atleast_1d(np.asarray(value))
+        self.target = target
+        self.idle = idle
 
 
 class ToNXlog:

@@ -65,6 +65,7 @@ class DataServiceBuilder:
         job_threads: int = 5,
         dev: bool = False,
         heartbeat_interval_s: float = 2.0,
+        source_decorator: Callable | None = None,
     ) -> None:
         self.instrument_name = instrument
         self.service_name = service_name
@@ -74,6 +75,7 @@ class DataServiceBuilder:
         self._job_threads = job_threads
         self._dev = dev
         self._heartbeat_interval_s = heartbeat_interval_s
+        self._source_decorator = source_decorator
         self._instrument = instrument_registry[instrument]
         self._instrument.load_factories()
         self.stream_mapping = get_stream_mapping(self._instrument, dev)
@@ -91,6 +93,10 @@ class DataServiceBuilder:
         adapter = self._route_builder(self.stream_mapping)
         counter = StreamCounter()
         source = AdaptingMessageSource(raw_source, adapter, stream_counter=counter)
+        if self._source_decorator is not None:
+            # In-process stream synthesis (ADR 0001): device merge, chopper
+            # cascade — wraps the already-adapted source.
+            source = self._source_decorator(source, self._instrument)
         job_manager = JobManager(
             job_factory=JobFactory(), job_threads=self._job_threads
         )

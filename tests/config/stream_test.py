@@ -1,0 +1,185 @@
+"""Tests for the stream catalog model (config/stream.py)."""
+
+from __future__ import annotations
+
+import pytest
+
+from esslivedata_tpu.config.chopper import (
+    declare_chopper_setpoint_streams,
+    delay_setpoint_stream,
+)
+from esslivedata_tpu.config.stream import (
+    Device,
+    F144Stream,
+    Stream,
+    filter_authorized_streams,
+    name_streams,
+    suggest_names,
+)
+
+
+class TestStreamValidation:
+    def test_topic_without_source_rejected(self) -> None:
+        with pytest.raises(ValueError, match="topic set but source"):
+            Stream(writer_module="f144", topic="t")
+
+    def test_source_without_topic_rejected(self) -> None:
+        with pytest.raises(ValueError, match="source set but topic"):
+            Stream(writer_module="f144", source="s")
+
+    def test_synthesised_stream_ok(self) -> None:
+        s = F144Stream(units="mm")
+        assert s.topic is None and s.nexus_path is None
+
+    def test_device_substream_names(self) -> None:
+        d = Device(value="m/value", idle="m/idle")
+        assert d.substream_names == ("m/value", "m/idle")
+
+
+class TestSuggestNames:
+    def test_generic_groups_dropped(self) -> None:
+        names = suggest_names(["entry/instrument/wfm1/transformations/t1"])
+        assert names == {"entry/instrument/wfm1/transformations/t1": "wfm1/t1"}
+
+    def test_collision_escalates_depth(self) -> None:
+        paths = [
+            "entry/instrument/motor_a/value",
+            "entry/instrument/motor_b/value",
+        ]
+        names = suggest_names(paths)
+        assert names[paths[0]] == "motor_a/value"
+        assert names[paths[1]] == "motor_b/value"
+
+    def test_min_depth_one_names_parent(self) -> None:
+        names = suggest_names(["entry/instrument/mymotor"], min_depth=1)
+        assert names == {"entry/instrument/mymotor": "mymotor"}
+
+    def test_forbidden_escalates(self) -> None:
+        names = suggest_names(
+            ["entry/instrument/m1"], min_depth=1, forbidden=["m1"]
+        )
+        assert names["entry/instrument/m1"] != "m1"
+
+
+class TestNameStreams:
+    def _parsed(self) -> dict[str, Stream]:
+        return {
+            "entry/instrument/motor/value": F144Stream(
+                topic="tn_motion", source="MOTOR1.RBV", units="mm",
+                nexus_path="entry/instrument/motor/value",
+            ),
+            "entry/instrument/motor/target_value": F144Stream(
+                topic="tn_motion", source="MOTOR1.VAL", units="mm",
+                nexus_path="entry/instrument/motor/target_value",
+            ),
+            "entry/sample/temperature": F144Stream(
+                topic="tn_sample_env", source="TEMP1", units="K",
+                nexus_path="entry/sample/temperature",
+            ),
+        }
+
+    def test_device_detected_from_epics_suffixes(self) -> None:
+        named = name_streams(self._parsed())
+        devices = {k: v for k, v in named.items() if isinstance(v, Device)}
+        assert list(devices) == ["motor"]
+        dev = devices["motor"]
+        assert dev.value == "motor/value"
+        assert dev.target == "motor/target_value"
+        assert dev.idle is None
+        assert dev.units == "mm"
+
+    def test_rename_overrides(self) -> None:
+        named = name_streams(
+            self._parsed(), rename={"entry/sample/temperature": "T_sample"}
+        )
+        assert "T_sample" in named
+
+    def test_unknown_rename_key_rejected(self) -> None:
+        with pytest.raises(ValueError, match="rename keys"):
+            name_streams(self._parsed(), rename={"nope": "x"})
+
+    def test_unit_mismatch_rejected(self) -> None:
+        parsed = self._parsed()
+        parsed["entry/instrument/motor/target_value"] = F144Stream(
+            topic="tn_motion", source="MOTOR1.VAL", units="cm",
+            nexus_path="entry/instrument/motor/target_value",
+        )
+        with pytest.raises(ValueError, match="units"):
+            name_streams(parsed)
+
+    def test_rbv_alone_is_not_a_device(self) -> None:
+        parsed = {
+            "entry/instrument/motor/value": F144Stream(
+                topic="tn_motion", source="M.RBV", units="mm",
+                nexus_path="entry/instrument/motor/value",
+            )
+        }
+        named = name_streams(parsed)
+        assert not any(isinstance(v, Device) for v in named.values())
+
+
+class TestFilterAuthorizedStreams:
+    def test_only_authorized_topics_survive(self) -> None:
+        parsed = {
+            "a": F144Stream(topic="x_motion", source="s1"),
+            "b": F144Stream(topic="x_detector", source="s2"),
+            "c": F144Stream(topic="tn_data_general", source="s3"),
+            "d": F144Stream(),  # synthesised: no topic -> dropped
+        }
+        kept = filter_authorized_streams(parsed)
+        assert sorted(kept) == ["a", "c"]
+
+
+class TestChopperStreams:
+    def test_declare_setpoint_streams(self) -> None:
+        streams: dict[str, Stream] = {
+            "wfm1/delay": F144Stream(units="ns"),
+            "wfm1/rotation_speed_setpoint": F144Stream(units="Hz"),
+        }
+        declare_chopper_setpoint_streams(streams, ["wfm1"])
+        assert delay_setpoint_stream("wfm1") in streams
+        assert streams[delay_setpoint_stream("wfm1")].units == "ns"
+
+    def test_wrong_delay_units_rejected(self) -> None:
+        streams: dict[str, Stream] = {"c/delay": F144Stream(units="ms")}
+        with pytest.raises(ValueError, match="expected 'ns'"):
+            declare_chopper_setpoint_streams(streams, ["c"])
+
+
+class TestInstrumentStreamCatalog:
+    def test_catalog_streams_enter_stream_mapping_logs_lut(self) -> None:
+        from esslivedata_tpu.config.instrument import Instrument
+        from esslivedata_tpu.config.streams import get_stream_mapping
+        from esslivedata_tpu.kafka.stream_mapping import InputStreamKey
+
+        inst = Instrument(
+            name="cat_test",
+            streams={
+                "c1/delay": F144Stream(
+                    topic="cat_test_choppers", source="C1:Delay", units="ns"
+                ),
+                "c1/rotation_speed_setpoint": F144Stream(
+                    topic="cat_test_choppers", source="C1:Spd", units="Hz"
+                ),
+            },
+            choppers=["c1"],
+        )
+        mapping = get_stream_mapping(inst)
+        key = InputStreamKey(topic="cat_test_choppers", source_name="C1:Delay")
+        assert mapping.logs[key] == "c1/delay"
+        # Synthesised delay_setpoint has no Kafka identity: not in the LUT.
+        assert "c1/delay_setpoint" not in mapping.logs.values()
+
+    def test_declare_choppers_post_construction(self) -> None:
+        from esslivedata_tpu.config.instrument import Instrument
+
+        inst = Instrument(name="post_test")
+        inst.streams["c9/delay"] = F144Stream(units="ns")
+        inst.declare_choppers(["c9"])
+        assert delay_setpoint_stream("c9") in inst.streams
+
+    def test_missing_readback_is_diagnostic(self) -> None:
+        from esslivedata_tpu.config.instrument import Instrument
+
+        with pytest.raises(ValueError, match="not in the stream catalog"):
+            Instrument(name="bad_test", choppers=["nope"])

@@ -1,0 +1,208 @@
+"""Tests for DeviceSynthesizer and ChopperSynthesizer.
+
+Scenario coverage modeled on the reference's synthesizer behavior: bootstrap
+suppression, union-anchored emission, max-timestamp policy, passthrough;
+plateau locking, delay_setpoint synthesis, cascade tick gating.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.config.chopper import (
+    delay_readback_stream,
+    delay_setpoint_stream,
+    speed_setpoint_stream,
+)
+from esslivedata_tpu.config.stream import Device
+from esslivedata_tpu.core.message import Message, StreamId, StreamKind
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.kafka.chopper_synthesizer import (
+    CHOPPER_CASCADE_SOURCE,
+    ChopperSynthesizer,
+)
+from esslivedata_tpu.kafka.device_synthesizer import DeviceSynthesizer
+from esslivedata_tpu.preprocessors.to_nxlog import LogData
+
+
+class ListSource:
+    def __init__(self) -> None:
+        self.pending: list[Message] = []
+
+    def push(self, *messages: Message) -> None:
+        self.pending.extend(messages)
+
+    def get_messages(self):
+        out, self.pending = self.pending, []
+        return out
+
+
+def log_msg(name: str, time_ns: int, value: float) -> Message[LogData]:
+    return Message(
+        timestamp=Timestamp.from_ns(time_ns),
+        stream=StreamId(kind=StreamKind.LOG, name=name),
+        value=LogData(time=time_ns, value=value),
+    )
+
+
+def make_device(**kwargs) -> Device:
+    kwargs.setdefault("value", "motor/value")
+    return Device(**kwargs)
+
+
+class TestDeviceSynthesizer:
+    def test_bootstrap_suppressed_until_all_substreams_seen(self) -> None:
+        src = ListSource()
+        syn = DeviceSynthesizer(
+            src, devices={"motor": make_device(target="motor/target")}
+        )
+        src.push(log_msg("motor/value", 100, 1.0))
+        assert syn.get_messages() == []
+        src.push(log_msg("motor/target", 200, 2.0))
+        (out,) = syn.get_messages()
+        assert out.stream == StreamId(kind=StreamKind.DEVICE, name="motor")
+        assert out.value.value[0] == 1.0
+        assert out.value.target == 2.0
+        assert out.value.idle is None
+
+    def test_emit_timestamp_is_max_of_substreams(self) -> None:
+        src = ListSource()
+        syn = DeviceSynthesizer(
+            src,
+            devices={
+                "m": make_device(
+                    value="motor/value", target="motor/target", idle="motor/idle"
+                )
+            },
+        )
+        src.push(
+            log_msg("motor/value", 300, 1.5),
+            log_msg("motor/target", 100, 2.5),
+            log_msg("motor/idle", 200, 1.0),
+        )
+        out = syn.get_messages()
+        # Emission is union-anchored: one sample per event after bootstrap.
+        assert len(out) == 1
+        assert out[0].timestamp.ns == 300
+        assert out[0].value.idle is True
+
+    def test_value_only_device_emits_immediately(self) -> None:
+        src = ListSource()
+        syn = DeviceSynthesizer(src, devices={"m": make_device()})
+        src.push(log_msg("motor/value", 50, 7.0))
+        (out,) = syn.get_messages()
+        assert out.value.value[0] == 7.0
+        assert out.value.target is None
+
+    def test_unrelated_messages_pass_through(self) -> None:
+        src = ListSource()
+        syn = DeviceSynthesizer(src, devices={"m": make_device()})
+        msg = log_msg("temperature", 10, 300.0)
+        src.push(msg)
+        assert syn.get_messages() == [msg]
+
+    def test_substream_owned_by_two_devices_rejected(self) -> None:
+        with pytest.raises(ValueError, match="configured for both"):
+            DeviceSynthesizer(
+                ListSource(),
+                devices={"a": make_device(), "b": make_device()},
+            )
+
+    def test_substreams_are_suppressed(self) -> None:
+        src = ListSource()
+        syn = DeviceSynthesizer(
+            src, devices={"m": make_device(target="motor/target")}
+        )
+        src.push(log_msg("motor/value", 1, 0.0))
+        assert syn.get_messages() == []  # suppressed, not forwarded
+
+
+class TestChopperSynthesizer:
+    def test_chopperless_emits_single_initial_tick(self) -> None:
+        src = ListSource()
+        syn = ChopperSynthesizer(src)
+        (tick,) = syn.get_messages()
+        assert tick.stream.name == CHOPPER_CASCADE_SOURCE
+        assert syn.get_messages() == []
+
+    def test_forwards_everything_verbatim(self) -> None:
+        src = ListSource()
+        syn = ChopperSynthesizer(src, chopper_names=["c1"])
+        msg = log_msg("unrelated", 5, 1.0)
+        src.push(msg)
+        assert list(syn.get_messages()) == [msg]
+
+    def _lock_chopper(
+        self, src: ListSource, syn: ChopperSynthesizer, name: str, t0: int = 0
+    ) -> list[Message]:
+        """Push a speed setpoint and a stable delay plateau; drain output."""
+        out: list[Message] = []
+        src.push(log_msg(speed_setpoint_stream(name), t0, 14.0))
+        out.extend(syn.get_messages())
+        for i in range(5):
+            src.push(
+                log_msg(delay_readback_stream(name), t0 + 10 + i, 5000.0 + i)
+            )
+            out.extend(syn.get_messages())
+        return out
+
+    def test_plateau_lock_emits_delay_setpoint_and_cascade(self) -> None:
+        src = ListSource()
+        syn = ChopperSynthesizer(src, chopper_names=["c1"], delay_atol=100.0)
+        out = self._lock_chopper(src, syn, "c1")
+        setpoints = [
+            m for m in out if m.stream.name == delay_setpoint_stream("c1")
+        ]
+        cascades = [m for m in out if m.stream.name == CHOPPER_CASCADE_SOURCE]
+        assert len(setpoints) == 1
+        assert np.isclose(setpoints[0].value.value[0], 5002.0)
+        assert len(cascades) == 1
+
+    def test_no_cascade_until_all_choppers_locked(self) -> None:
+        src = ListSource()
+        syn = ChopperSynthesizer(
+            src, chopper_names=["c1", "c2"], delay_atol=100.0
+        )
+        out = self._lock_chopper(src, syn, "c1")
+        assert not any(
+            m.stream.name == CHOPPER_CASCADE_SOURCE for m in out
+        )
+        out = self._lock_chopper(src, syn, "c2", t0=1000)
+        assert any(m.stream.name == CHOPPER_CASCADE_SOURCE for m in out)
+
+    def test_unstable_delay_never_locks(self) -> None:
+        src = ListSource()
+        syn = ChopperSynthesizer(src, chopper_names=["c1"], delay_atol=1.0)
+        src.push(log_msg(speed_setpoint_stream("c1"), 0, 14.0))
+        syn.get_messages()
+        out: list[Message] = []
+        for i in range(10):
+            src.push(
+                log_msg(delay_readback_stream("c1"), 10 + i, float(i * 1000))
+            )
+            out.extend(syn.get_messages())
+        assert not any(
+            m.stream.name == delay_setpoint_stream("c1") for m in out
+        )
+
+    def test_cascade_reemitted_on_speed_change(self) -> None:
+        src = ListSource()
+        syn = ChopperSynthesizer(src, chopper_names=["c1"], delay_atol=100.0)
+        self._lock_chopper(src, syn, "c1")
+        # Steady state: unrelated traffic does not retrigger the cascade.
+        src.push(log_msg("unrelated", 999, 0.0))
+        assert not any(
+            m.stream.name == CHOPPER_CASCADE_SOURCE for m in syn.get_messages()
+        )
+        src.push(log_msg(speed_setpoint_stream("c1"), 2000, 7.0))
+        out = syn.get_messages()
+        assert any(m.stream.name == CHOPPER_CASCADE_SOURCE for m in out)
+
+    def test_repeated_identical_speed_is_not_a_change(self) -> None:
+        src = ListSource()
+        syn = ChopperSynthesizer(src, chopper_names=["c1"], delay_atol=100.0)
+        self._lock_chopper(src, syn, "c1")
+        src.push(log_msg(speed_setpoint_stream("c1"), 3000, 14.0))
+        out = syn.get_messages()
+        assert not any(m.stream.name == CHOPPER_CASCADE_SOURCE for m in out)
