@@ -15,11 +15,17 @@ from collections.abc import Sequence
 from .stream import F144Stream, Stream
 
 __all__ = [
+    "CHOPPER_CASCADE_SOURCE",
     "declare_chopper_setpoint_streams",
     "delay_readback_stream",
     "delay_setpoint_stream",
     "speed_setpoint_stream",
 ]
+
+#: Logical source name of the synthetic cascade trigger stream: emitted by
+#: ChopperSynthesizer once every chopper locks; consumed as the wavelength-
+#: LUT workflow's primary dynamic stream (its arrival drives a recompute).
+CHOPPER_CASCADE_SOURCE = "chopper_cascade"
 
 
 def speed_setpoint_stream(chopper: str) -> str:
@@ -64,4 +70,13 @@ def declare_chopper_setpoint_streams(
                 f"Chopper {chopper!r} delay readback declares units "
                 f"{units!r}, expected 'ns'"
             )
-        streams[delay_setpoint_stream(chopper)] = F144Stream(units=units)
+        name = delay_setpoint_stream(chopper)
+        if (existing := streams.get(name)) is not None:
+            if existing.topic is not None:
+                raise ValueError(
+                    f"Stream {name!r} already declared with a Kafka identity "
+                    f"(topic={existing.topic!r}); the synthesizer would "
+                    "shadow a real upstream PV"
+                )
+            continue  # idempotent re-declaration of the synthetic stream
+        streams[name] = F144Stream(units=units)

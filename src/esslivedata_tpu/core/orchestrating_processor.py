@@ -93,10 +93,19 @@ class MessagePreprocessor:
         return out
 
     def collect_context(self) -> dict[str, Any]:
-        """Latest value of every context accumulator that has one."""
+        """Latest value of every context accumulator that has one.
+
+        ``also_context`` marks primary accumulators whose value is
+        additionally exposed as context — e.g. timeseries logs that both
+        republish as data and gate/parameterize other jobs (the reference
+        routes the same f144 stream to republish and to spec-scope context
+        bindings)."""
         out: dict[str, Any] = {}
         for stream, acc in self._accumulators.items():
-            if not getattr(acc, "is_context", False):
+            if not (
+                getattr(acc, "is_context", False)
+                or getattr(acc, "also_context", False)
+            ):
                 continue
             if hasattr(acc, "has_value") and not acc.has_value:
                 continue

@@ -27,6 +27,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from ..config.chopper import (
+    CHOPPER_CASCADE_SOURCE,
     delay_readback_stream,
     delay_setpoint_stream,
     speed_setpoint_stream,
@@ -39,8 +40,6 @@ __all__ = ["CHOPPER_CASCADE_SOURCE", "CHOPPER_CASCADE_STREAM", "ChopperSynthesiz
 
 logger = logging.getLogger(__name__)
 
-#: Logical source name of the synthetic cascade trigger stream.
-CHOPPER_CASCADE_SOURCE = "chopper_cascade"
 CHOPPER_CASCADE_STREAM = StreamId(kind=StreamKind.LOG, name=CHOPPER_CASCADE_SOURCE)
 
 
@@ -113,9 +112,18 @@ class ChopperSynthesizer:
         chopper_names: Sequence[str] = (),
         delay_window_size: int = 5,
         delay_atol: float = 1000.0,
+        refresh_every: int = 256,
     ) -> None:
         self._wrapped = wrapped
         self._chopper_names = tuple(chopper_names)
+        # Re-emit the current tick every N cycles while locked so a LUT job
+        # started *after* the original tick still receives its primary
+        # trigger (jobs only see the current window; there is no replay).
+        # The LUT workflow dedupes on setpoint signature, so refresh ticks
+        # are cheap no-ops for already-computed jobs.
+        self._refresh_every = max(1, refresh_every)
+        self._cycle = 0
+        self._last_data_time: Timestamp | None = None
         self._states = {
             name: _ChopperState(
                 detector=_StabilityDetector(
@@ -136,6 +144,7 @@ class ChopperSynthesizer:
     def get_messages(self) -> Sequence[Message]:
         synthetic: list[Message] = []
         forwarded: list[Message] = []
+        self._cycle += 1
 
         if not self._chopper_names and not self._emitted_initial_tick:
             self._emitted_initial_tick = True
@@ -146,6 +155,11 @@ class ChopperSynthesizer:
         change_time: Timestamp | None = None
         for msg in self._wrapped.get_messages():
             forwarded.append(msg)
+            if (
+                self._last_data_time is None
+                or msg.timestamp > self._last_data_time
+            ):
+                self._last_data_time = msg.timestamp
             if self._handle(msg, synthetic):
                 any_changed = True
                 if change_time is None or msg.timestamp > change_time:
@@ -160,7 +174,16 @@ class ChopperSynthesizer:
                         "chopper_cascade all locked: %s",
                         list(self._chopper_names),
                     )
+            elif all_locked and self._cycle % self._refresh_every == 0:
+                # Periodic refresh, timestamped on the data clock (last seen
+                # data time) so replay never produces wall-clock windows.
+                synthetic.append(_cascade_tick(self._last_data_time))
             self._was_all_locked = all_locked
+        elif (
+            self._emitted_initial_tick
+            and self._cycle % self._refresh_every == 0
+        ):
+            synthetic.append(_cascade_tick(self._last_data_time))
 
         return [*synthetic, *forwarded]
 

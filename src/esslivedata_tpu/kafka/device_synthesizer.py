@@ -42,35 +42,49 @@ class _DeviceState:
     target: _Seen | None = None
     idle: _Seen | None = None
 
-    def push(self, role: _Role, log: LogData) -> Message[LogData] | None:
-        """Record one substream event; emit a merged sample once bootstrapped."""
-        time = Timestamp.from_ns(int(log.time[-1]))
-        seen = _Seen(value=float(log.value[-1]), time=time)
-        if role == "value":
-            self.value = seen
-        elif role == "target":
-            self.target = seen
-        else:
-            self.idle = seen
-        if self.value is None:
-            return None
-        if self.has_target and self.target is None:
-            return None
-        if self.has_idle and self.idle is None:
-            return None
-        sample_time = max(
-            s.time for s in (self.value, self.target, self.idle) if s is not None
-        )
-        return Message(
-            timestamp=sample_time,
-            stream=StreamId(kind=StreamKind.DEVICE, name=self.device_name),
-            value=LogData(
-                time=sample_time.ns,
-                value=self.value.value,
-                target=self.target.value if self.target is not None else None,
-                idle=bool(self.idle.value) if self.idle is not None else None,
-            ),
-        )
+    def push(self, role: _Role, log: LogData) -> list[Message[LogData]]:
+        """Record substream samples; emit one merged sample per input sample
+        once bootstrapped (LogData may batch several f144 records — each
+        intermediate motor position is retained, none collapsed away)."""
+        out: list[Message[LogData]] = []
+        for time_ns, value in zip(log.time, log.value, strict=True):
+            seen = _Seen(value=float(value), time=Timestamp.from_ns(int(time_ns)))
+            if role == "value":
+                self.value = seen
+            elif role == "target":
+                self.target = seen
+            else:
+                self.idle = seen
+            if self.value is None:
+                continue
+            if self.has_target and self.target is None:
+                continue
+            if self.has_idle and self.idle is None:
+                continue
+            sample_time = max(
+                s.time
+                for s in (self.value, self.target, self.idle)
+                if s is not None
+            )
+            out.append(
+                Message(
+                    timestamp=sample_time,
+                    stream=StreamId(
+                        kind=StreamKind.DEVICE, name=self.device_name
+                    ),
+                    value=LogData(
+                        time=sample_time.ns,
+                        value=self.value.value,
+                        target=self.target.value
+                        if self.target is not None
+                        else None,
+                        idle=bool(self.idle.value)
+                        if self.idle is not None
+                        else None,
+                    ),
+                )
+            )
+        return out
 
 
 class DeviceSynthesizer:
@@ -126,6 +140,5 @@ class DeviceSynthesizer:
                     type(msg.value).__name__,
                 )
                 continue
-            if (sample := state.push(role, msg.value)) is not None:
-                out.append(sample)
+            out.extend(state.push(role, msg.value))
         return out

@@ -140,6 +140,30 @@ class StagingBuffer:
         self._in_use = False
 
 
+_CPU_BACKEND: bool | None = None
+
+
+def dispatch_safe(x):
+    """Copy a numpy array before handing it to a jitted call on the CPU
+    backend.
+
+    XLA's CPU client aliases suitably-aligned numpy buffers into device
+    arrays zero-copy, and dispatch is asynchronous — so a staging buffer
+    reused (overwritten) after ``release()`` could still be read by the
+    in-flight step, corrupting the histogram. On accelerators the
+    host->device transfer is a real copy completed during dispatch, so the
+    zero-copy staging contract is safe there and we pass views through.
+    """
+    global _CPU_BACKEND
+    if _CPU_BACKEND is None:
+        import jax
+
+        _CPU_BACKEND = jax.default_backend() == "cpu"
+    if _CPU_BACKEND and isinstance(x, np.ndarray):
+        return x.copy()
+    return x
+
+
 def make_staging_buffer(min_bucket: int = MIN_BUCKET, prefer_native: bool = True):
     """StagingBuffer factory: the native C++ buffer (native/ingest.cpp) when
     the compiled shim is available, else the pure-Python one. Both satisfy

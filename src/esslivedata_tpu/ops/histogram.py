@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .event_batch import EventBatch
+from .event_batch import EventBatch, dispatch_safe
 
 __all__ = ["EventHistogrammer", "HistogramState"]
 
@@ -245,13 +245,15 @@ class EventHistogrammer:
     def step(self, state: HistogramState, batch: EventBatch) -> HistogramState:
         """Accumulate one padded batch. Donates ``state``: the caller's
         handle is invalidated, use the returned state."""
-        return self._step(state, batch.pixel_id, batch.toa)
+        return self._step(
+            state, dispatch_safe(batch.pixel_id), dispatch_safe(batch.toa)
+        )
 
     def step_arrays(
         self, state: HistogramState, pixel_id, toa
     ) -> HistogramState:
         """Accumulate from already-device-resident (or padded host) arrays."""
-        return self._step(state, pixel_id, toa)
+        return self._step(state, dispatch_safe(pixel_id), dispatch_safe(toa))
 
     def clear_window(self, state: HistogramState) -> HistogramState:
         return self._clear_window(state)

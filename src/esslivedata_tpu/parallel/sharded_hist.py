@@ -165,8 +165,12 @@ class ShardedHistogrammer:
             raise ValueError(
                 f"padded event count {n} must divide over data axis {self._n_data}"
             )
-        pid = jax.device_put(jnp.asarray(pixel_id), self._event_sharding)
-        t = jax.device_put(jnp.asarray(toa), self._event_sharding)
+        from ..ops.event_batch import dispatch_safe
+
+        pid = jax.device_put(
+            jnp.asarray(dispatch_safe(pixel_id)), self._event_sharding
+        )
+        t = jax.device_put(jnp.asarray(dispatch_safe(toa)), self._event_sharding)
         return pid, t
 
     def step(self, state: HistogramState, pixel_id, toa) -> HistogramState:

@@ -81,8 +81,12 @@ class TimeseriesPreprocessorFactory(_KindBasedFactory):
     def make_preprocessor(self, stream: StreamId):
         if stream.kind == StreamKind.LOG:
             acc = ToNXlog(name=stream.name)
-            # Logs are primary here: republish as data, don't gate jobs.
+            # Logs are primary here (republished as data) but additionally
+            # exposed as context so jobs may gate/parameterize on them —
+            # the wavelength-LUT job consumes chopper setpoint streams
+            # this way while the plain timeseries job republishes them.
             acc.is_context = False  # type: ignore[misc]
+            acc.also_context = True  # type: ignore[attr-defined]
             return acc
         if stream.kind == StreamKind.DEVICE:
             return LatestValueAccumulator()

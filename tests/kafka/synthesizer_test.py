@@ -206,3 +206,51 @@ class TestChopperSynthesizer:
         src.push(log_msg(speed_setpoint_stream("c1"), 3000, 14.0))
         out = syn.get_messages()
         assert not any(m.stream.name == CHOPPER_CASCADE_SOURCE for m in out)
+
+    def test_multi_sample_batch_emits_per_sample(self) -> None:
+        src = ListSource()
+        syn = DeviceSynthesizer(src, devices={"m": make_device()})
+        src.push(
+            Message(
+                timestamp=Timestamp.from_ns(30),
+                stream=StreamId(kind=StreamKind.LOG, name="motor/value"),
+                value=LogData(time=[10, 20, 30], value=[1.0, 2.0, 3.0]),
+            )
+        )
+        out = syn.get_messages()
+        assert [m.value.value[0] for m in out] == [1.0, 2.0, 3.0]
+        assert [m.timestamp.ns for m in out] == [10, 20, 30]
+
+
+class TestCascadeRefresh:
+    def test_locked_cascade_reemits_periodically(self) -> None:
+        src = ListSource()
+        syn = ChopperSynthesizer(
+            src, chopper_names=["c1"], delay_atol=100.0, refresh_every=4
+        )
+        src.push(log_msg(speed_setpoint_stream("c1"), 0, 14.0))
+        syn.get_messages()
+        for i in range(5):
+            src.push(log_msg(delay_readback_stream("c1"), 10 + i, 5000.0))
+            syn.get_messages()
+        # Locked; idle cycles now refresh the tick every 4th cycle.
+        ticks = 0
+        for _ in range(8):
+            ticks += sum(
+                1
+                for m in syn.get_messages()
+                if m.stream.name == CHOPPER_CASCADE_SOURCE
+            )
+        assert ticks == 2
+
+    def test_refresh_tick_rides_data_clock(self) -> None:
+        src = ListSource()
+        syn = ChopperSynthesizer(src, refresh_every=2)  # chopperless
+        syn.get_messages()  # bootstrap tick (wall clock: no data yet)
+        src.push(log_msg("x", 12345, 1.0))
+        out = []
+        for _ in range(3):
+            out.extend(syn.get_messages())
+        refresh = [m for m in out if m.stream.name == CHOPPER_CASCADE_SOURCE]
+        assert refresh
+        assert all(m.timestamp.ns == 12345 for m in refresh)

@@ -1,0 +1,197 @@
+"""Physics tests for the analytical chopper-cascade propagation."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.ops.chopper_cascade import (
+    ALPHA_NS_PER_M_A,
+    DiskChopper,
+    propagate_cascade,
+    wavelength_band_at,
+    wavelength_lut,
+)
+
+PULSE_PERIOD_NS = 1e9 / 14.0
+PULSE_LENGTH_NS = 2.86e6  # ESS ~2.86 ms proton pulse
+
+
+def free_flight(stride: int = 1) -> list[np.ndarray]:
+    return propagate_cascade(
+        [],
+        pulse_period_ns=PULSE_PERIOD_NS,
+        pulse_length_ns=PULSE_LENGTH_NS,
+        wavelength_min_a=0.5,
+        wavelength_max_a=20.0,
+        stride=stride,
+    )
+
+
+class TestFreeFlight:
+    def test_no_choppers_single_rectangle_per_pulse(self) -> None:
+        assert len(free_flight()) == 1
+        assert len(free_flight(stride=2)) == 2
+
+    def test_band_matches_kinematics(self) -> None:
+        """lambda(t_offset) ~= t_offset / (alpha * L) for a short pulse.
+
+        Distance chosen so the slowest neutron still arrives within one
+        frame period (no wrapping -> the map is single-valued)."""
+        distance = 5.0
+        subframes = free_flight()
+        edges = np.linspace(0.0, PULSE_PERIOD_NS, 201)
+        band = wavelength_band_at(
+            subframes,
+            distance,
+            frame_period_ns=PULSE_PERIOD_NS,
+            time_edges_ns=edges,
+        )
+        centers = 0.5 * (edges[:-1] + edges[1:])
+        expected = centers / (ALPHA_NS_PER_M_A * distance)
+        valid = ~np.isnan(band)
+        assert valid.sum() > 50
+        # Pulse length smears the estimate by dt=pulse_len -> dlam:
+        tol = PULSE_LENGTH_NS / (ALPHA_NS_PER_M_A * distance)
+        np.testing.assert_allclose(
+            band[valid], expected[valid], atol=1.05 * tol
+        )
+
+    def test_wrapping_folds_arrival_times(self) -> None:
+        """At long distance slow neutrons wrap: band still defined and the
+        unwrapped arrival time reproduces the wavelength."""
+        distance = 60.0
+        subframes = propagate_cascade(
+            [],
+            pulse_period_ns=PULSE_PERIOD_NS,
+            pulse_length_ns=1e3,  # nearly instantaneous pulse
+            wavelength_min_a=5.0,
+            wavelength_max_a=6.0,
+        )
+        edges = np.linspace(0.0, PULSE_PERIOD_NS, 1001)
+        band = wavelength_band_at(
+            subframes,
+            distance,
+            frame_period_ns=PULSE_PERIOD_NS,
+            time_edges_ns=edges,
+        )
+        valid = np.flatnonzero(~np.isnan(band))
+        assert valid.size > 0
+        centers = 0.5 * (edges[:-1] + edges[1:])
+        for i in valid[:: max(1, valid.size // 10)]:
+            lam = band[i]
+            arrival = ALPHA_NS_PER_M_A * distance * lam
+            assert arrival % PULSE_PERIOD_NS == pytest.approx(
+                centers[i], abs=2 * (edges[1] - edges[0])
+            )
+
+
+class TestChopperSelection:
+    def test_single_chopper_selects_band(self) -> None:
+        """Window [a, b] at L_c passes lambda in [a-pulse_len, b]/(alpha*L_c)."""
+        lc = 6.0
+        freq = 14.0
+        period = 1e9 / freq
+        # Slit open during [0.1, 0.2] of the period, delay 0.
+        chopper = DiskChopper(
+            name="c1",
+            distance_m=lc,
+            frequency_hz=freq,
+            delay_ns=0.0,
+            slit_edges_deg=((36.0, 72.0),),
+        )
+        subframes = propagate_cascade(
+            [chopper],
+            pulse_period_ns=PULSE_PERIOD_NS,
+            pulse_length_ns=PULSE_LENGTH_NS,
+            wavelength_min_a=0.5,
+            wavelength_max_a=20.0,
+        )
+        assert subframes
+        lam = np.concatenate([p[:, 1] for p in subframes])
+        a, b = 0.1 * period, 0.2 * period
+        lam_lo = (a - PULSE_LENGTH_NS) / (ALPHA_NS_PER_M_A * lc)
+        lam_hi = b / (ALPHA_NS_PER_M_A * lc)
+        assert lam.min() >= lam_lo - 1e-6
+        assert lam.max() <= lam_hi + 1e-6
+
+    def test_closed_cascade_blocks_beam(self) -> None:
+        """Two choppers with disjoint acceptance -> nothing survives."""
+        c1 = DiskChopper(
+            name="a", distance_m=6.0, frequency_hz=14.0,
+            slit_edges_deg=((0.0, 30.0),),
+        )
+        # Same distance band but open only much later: incompatible.
+        c2 = DiskChopper(
+            name="b", distance_m=6.001, frequency_hz=14.0,
+            delay_ns=0.5 * PULSE_PERIOD_NS,
+            slit_edges_deg=((0.0, 30.0),),
+        )
+        subframes = propagate_cascade(
+            [c1, c2],
+            pulse_period_ns=PULSE_PERIOD_NS,
+            pulse_length_ns=1e4,
+            wavelength_min_a=0.5,
+            wavelength_max_a=4.0,
+        )
+        assert subframes == []
+
+    def test_two_choppers_narrow_the_band(self) -> None:
+        common = dict(frequency_hz=14.0, slit_edges_deg=((0.0, 72.0),))
+        one = propagate_cascade(
+            [DiskChopper(name="a", distance_m=6.0, **common)],
+            pulse_period_ns=PULSE_PERIOD_NS,
+            pulse_length_ns=PULSE_LENGTH_NS,
+        )
+        two = propagate_cascade(
+            [
+                DiskChopper(name="a", distance_m=6.0, **common),
+                DiskChopper(name="b", distance_m=10.0, **common),
+            ],
+            pulse_period_ns=PULSE_PERIOD_NS,
+            pulse_length_ns=PULSE_LENGTH_NS,
+        )
+        area = lambda polys: sum(  # noqa: E731
+            abs(
+                np.sum(
+                    p[:, 0] * np.roll(p[:, 1], -1)
+                    - np.roll(p[:, 0], -1) * p[:, 1]
+                )
+            )
+            / 2
+            for p in polys
+        )
+        assert area(two) < area(one)
+
+
+class TestLut:
+    def test_lut_shape_and_monotonic_rows(self) -> None:
+        # Unwrapped regime: slowest neutron (20 A) at 8 m arrives ~40 ms,
+        # inside the 71.4 ms frame -> each row is single-valued in time.
+        subframes = free_flight()
+        distances = np.linspace(2.0, 8.0, 5)
+        table, edges = wavelength_lut(
+            subframes,
+            distances_m=distances,
+            frame_period_ns=PULSE_PERIOD_NS,
+            n_time_bins=128,
+        )
+        assert table.shape == (5, 128)
+        assert edges.shape == (129,)
+        # Within a row, wavelength grows with time offset (faster = earlier).
+        for row in table:
+            vals = row[~np.isnan(row)]
+            assert (np.diff(vals) > -1e-9).all()
+
+    def test_validation(self) -> None:
+        with pytest.raises(ValueError, match="frequency"):
+            DiskChopper(name="x", distance_m=1.0, frequency_hz=0.0)
+        with pytest.raises(ValueError, match="slit"):
+            DiskChopper(
+                name="x", distance_m=1.0, frequency_hz=14.0,
+                slit_edges_deg=((350.0, 370.0),),
+            )
+        with pytest.raises(ValueError, match="stride"):
+            propagate_cascade(
+                [], pulse_period_ns=1.0, pulse_length_ns=1.0, stride=0
+            )

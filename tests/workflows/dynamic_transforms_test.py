@@ -1,0 +1,178 @@
+"""Dynamic geometry tests: transform math, motion detection, rebuild+reset."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.preprocessors.event_data import DetectorEvents, ToEventBatch
+from esslivedata_tpu.workflows.detector_view.workflow import (
+    DetectorViewParams,
+    DetectorViewWorkflow,
+)
+from esslivedata_tpu.workflows.dynamic_transforms import (
+    DynamicGeometry,
+    DynamicGeometryWorkflow,
+    Transform,
+    TransformChain,
+)
+
+
+class TestTransformMath:
+    def test_translation(self) -> None:
+        t = Transform(kind="translation", vector=(1.0, 0.0, 0.0), value=2.0)
+        chain = TransformChain(transforms=(t,))
+        out = chain.apply(np.array([[0.0, 0.0, 0.0]]), {})
+        np.testing.assert_allclose(out, [[2.0, 0.0, 0.0]])
+
+    def test_rotation_90deg_about_z(self) -> None:
+        r = Transform(kind="rotation", vector=(0.0, 0.0, 1.0), value=90.0)
+        chain = TransformChain(transforms=(r,))
+        out = chain.apply(np.array([[1.0, 0.0, 0.0]]), {})
+        np.testing.assert_allclose(out, [[0.0, 1.0, 0.0]], atol=1e-12)
+
+    def test_stream_bound_value_overrides_static(self) -> None:
+        t = Transform(
+            kind="translation", vector=(0.0, 1.0, 0.0), value=1.0, stream="m"
+        )
+        chain = TransformChain(transforms=(t,))
+        np.testing.assert_allclose(
+            chain.apply(np.zeros((1, 3)), {"m": 5.0}), [[0.0, 5.0, 0.0]]
+        )
+        np.testing.assert_allclose(
+            chain.apply(np.zeros((1, 3)), {}), [[0.0, 1.0, 0.0]]
+        )
+
+    def test_chain_composes_root_first(self) -> None:
+        # Root translation then local rotation: rotate point, then translate.
+        chain = TransformChain(
+            transforms=(
+                Transform(kind="translation", vector=(1.0, 0.0, 0.0), value=3.0),
+                Transform(kind="rotation", vector=(0.0, 0.0, 1.0), value=90.0),
+            )
+        )
+        out = chain.apply(np.array([[1.0, 0.0, 0.0]]), {})
+        np.testing.assert_allclose(out, [[3.0, 1.0, 0.0]], atol=1e-12)
+
+    def test_zero_vector_rejected(self) -> None:
+        t = Transform(kind="translation", vector=(0.0, 0.0, 0.0))
+        with pytest.raises(ValueError, match="non-zero"):
+            t.matrix(1.0)
+
+
+def make_geometry(**kwargs) -> DynamicGeometry:
+    n = 16
+    xs, ys = np.meshgrid(np.arange(4, dtype=float), np.arange(4, dtype=float))
+    positions = np.column_stack(
+        [xs.ravel(), ys.ravel(), np.zeros(n)]
+    )
+    chain = TransformChain(
+        transforms=(
+            Transform(
+                kind="translation",
+                vector=(1.0, 0.0, 0.0),
+                value=0.0,
+                stream="motor/x",
+            ),
+        )
+    )
+    defaults = dict(
+        base_positions=positions,
+        pixel_ids=np.arange(1, n + 1),
+        chain=chain,
+        resolution=(4, 4),
+        extent=(-0.5, 7.5, -0.5, 3.5),
+        atol=1e-3,
+    )
+    defaults.update(kwargs)
+    return DynamicGeometry(**defaults)
+
+
+class TestMotionDetection:
+    def test_first_build_counts_as_moved(self) -> None:
+        geo = make_geometry()
+        assert geo.moved({})
+        geo.build_projection({})
+        assert not geo.moved({})
+
+    def test_below_atol_is_not_motion(self) -> None:
+        geo = make_geometry()
+        geo.build_projection({"motor/x": 1.0})
+        assert not geo.moved({"motor/x": 1.0005})
+        assert geo.moved({"motor/x": 1.1})
+
+
+def stage(pixel_ids, toas):
+    acc = ToEventBatch(min_bucket=16)
+    acc.add(
+        Timestamp.from_ns(0),
+        DetectorEvents(
+            pixel_id=np.asarray(pixel_ids, dtype=np.int32),
+            time_of_arrival=np.asarray(toas, dtype=np.float32),
+        ),
+    )
+    return acc.get()
+
+
+class TestDynamicGeometryWorkflow:
+    def _make(self):
+        geo = make_geometry()
+        params = DetectorViewParams(
+            toa_bins=4, toa_range={"low": 0.0, "high": 100.0}
+        )
+        return DynamicGeometryWorkflow(
+            geometry=geo,
+            make=lambda proj: DetectorViewWorkflow(
+                projection=proj, params=params, primary_stream="det"
+            ),
+        )
+
+    def test_motion_rebuilds_and_resets(self) -> None:
+        wf = self._make()
+        wf.accumulate({"det": stage([1, 2], [10.0, 20.0])})
+        out = wf.finalize()
+        assert float(out["counts_cumulative"].values) == 2.0
+
+        # Motor moves: projection rebuilt, cumulative state reset.
+        wf.set_context({"motor/x": 2.0})
+        wf.accumulate({"det": stage([1], [10.0])})
+        out = wf.finalize()
+        assert float(out["counts_cumulative"].values) == 1.0
+
+    def test_no_motion_keeps_state(self) -> None:
+        wf = self._make()
+        wf.set_context({"motor/x": 1.0})
+        wf.accumulate({"det": stage([1], [10.0])})
+        wf.finalize()
+        wf.set_context({"motor/x": 1.0})  # unchanged
+        wf.accumulate({"det": stage([2], [20.0])})
+        out = wf.finalize()
+        assert float(out["counts_cumulative"].values) == 2.0
+
+    def test_moved_geometry_shifts_image(self) -> None:
+        wf = self._make()
+        wf.set_context({"motor/x": 0.0})
+        wf.accumulate({"det": stage([1], [10.0])})
+        img0 = wf.finalize()["image_cumulative"].values
+        (y0,), (x0,) = np.nonzero(img0)
+
+        wf.set_context({"motor/x": 2.0})
+        wf.accumulate({"det": stage([1], [10.0])})
+        img1 = wf.finalize()["image_cumulative"].values
+        (y1,), (x1,) = np.nonzero(img1)
+        assert (y1, x1) != (y0, x0)
+        assert x1 > x0  # moved along +x
+
+    def test_rois_reapplied_after_rebuild(self) -> None:
+        from esslivedata_tpu.config.models import RectangleROI
+
+        wf = self._make()
+        wf.set_rois({"roi_0": RectangleROI(x_min=-0.5, x_max=7.5, y_min=-0.5, y_max=3.5)})
+        wf.set_context({"motor/x": 0.0})
+        wf.accumulate({"det": stage([1], [10.0])})
+        wf.finalize()
+        wf.set_context({"motor/x": 2.0})  # rebuild
+        wf.accumulate({"det": stage([1], [10.0])})
+        out = wf.finalize()
+        assert float(out["roi_spectra"].values.sum()) == 1.0

@@ -1,0 +1,287 @@
+"""Wavelength-LUT workflow tests: trigger/context semantics + end-to-end
+service flow (chopper PVs -> synthesizer -> gated LUT job -> published LUT).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.config.chopper import (
+    CHOPPER_CASCADE_SOURCE,
+    delay_setpoint_stream,
+    speed_setpoint_stream,
+)
+from esslivedata_tpu.utils.labeled import DataArray, Variable
+from esslivedata_tpu.workflows.wavelength_lut_workflow import (
+    ChopperGeometry,
+    WavelengthLutParams,
+    WavelengthLutWorkflow,
+    spec_context_keys,
+)
+
+GEOMETRY = [
+    ChopperGeometry(name="wfm1", distance_m=6.0, slit_edges_deg=((0.0, 72.0),)),
+    ChopperGeometry(name="wfm2", distance_m=7.0, slit_edges_deg=((0.0, 72.0),)),
+]
+
+PARAMS = WavelengthLutParams(
+    distance_start_m=5.0,
+    distance_stop_m=30.0,
+    distance_resolution_m=5.0,
+    n_time_bins=64,
+    cut_distances_m=[25.0],
+)
+
+
+def series(value: float) -> DataArray:
+    return DataArray(
+        Variable(np.array([value]), ("time",), None),
+        coords={"time": Variable(np.array([0]), ("time",), "ns")},
+    )
+
+
+def trigger_data() -> dict:
+    return {CHOPPER_CASCADE_SOURCE: series(1.0)}
+
+
+def full_context() -> dict:
+    return {
+        speed_setpoint_stream("wfm1"): series(14.0),
+        delay_setpoint_stream("wfm1"): series(0.0),
+        speed_setpoint_stream("wfm2"): series(14.0),
+        delay_setpoint_stream("wfm2"): series(1e6),
+    }
+
+
+class TestWavelengthLutWorkflow:
+    def test_no_trigger_no_output(self) -> None:
+        wf = WavelengthLutWorkflow(choppers=GEOMETRY, params=PARAMS)
+        wf.set_context(full_context())
+        assert wf.finalize() == {}
+
+    def test_trigger_without_context_defers(self) -> None:
+        wf = WavelengthLutWorkflow(choppers=GEOMETRY, params=PARAMS)
+        wf.accumulate(trigger_data())
+        assert wf.finalize() == {}
+        # Context arrives later: the pending trigger fires.
+        wf.set_context(full_context())
+        out = wf.finalize()
+        assert set(out) == {"wavelength_lut", "wavelength_bands"}
+
+    def test_lut_shape_and_coords(self) -> None:
+        wf = WavelengthLutWorkflow(choppers=GEOMETRY, params=PARAMS)
+        wf.set_context(full_context())
+        wf.accumulate(trigger_data())
+        out = wf.finalize()
+        lut = out["wavelength_lut"]
+        assert lut.dims == ("distance", "event_time_offset")
+        assert lut.sizes["distance"] == 6  # 5..30 m at 5 m resolution
+        assert lut.sizes["event_time_offset"] == 64
+        assert str(lut.unit) == "angstrom"
+        assert "pulse_period" in lut.coords
+        bands = out["wavelength_bands"]
+        # Rows: source 0 + two choppers + one cut distance.
+        np.testing.assert_allclose(
+            bands.coords["distance"].values, [0.0, 6.0, 7.0, 25.0]
+        )
+
+    def test_trigger_consumed_once(self) -> None:
+        wf = WavelengthLutWorkflow(choppers=GEOMETRY, params=PARAMS)
+        wf.set_context(full_context())
+        wf.accumulate(trigger_data())
+        assert wf.finalize() != {}
+        assert wf.finalize() == {}  # no new trigger -> no recompute
+
+    def test_chopperless_instrument(self) -> None:
+        wf = WavelengthLutWorkflow(choppers=[], params=PARAMS)
+        wf.accumulate(trigger_data())
+        out = wf.finalize()
+        lut = out["wavelength_lut"]
+        # Free flight: every distance row has transmitted wavelengths.
+        assert np.isfinite(lut.values).any(axis=1).all()
+
+    def test_lut_values_physical(self) -> None:
+        """The chopped LUT is a subset of the free-flight kinematic map."""
+        wf = WavelengthLutWorkflow(choppers=GEOMETRY, params=PARAMS)
+        wf.set_context(full_context())
+        wf.accumulate(trigger_data())
+        lut = wf.finalize()["wavelength_lut"]
+        values = lut.values
+        finite = np.isfinite(values)
+        assert finite.any()
+        assert np.nanmin(values) >= PARAMS.wavelength_min_a - 1e-9
+        assert np.nanmax(values) <= PARAMS.wavelength_max_a + 1e-9
+
+    def test_spec_context_keys(self) -> None:
+        keys = spec_context_keys(GEOMETRY)
+        assert speed_setpoint_stream("wfm1") in keys
+        assert delay_setpoint_stream("wfm2") in keys
+        assert len(keys) == 4
+
+
+class TestWavelengthLutServiceFlow:
+    """Chopper PV bytes -> timeseries service -> locked cascade -> LUT out."""
+
+    @pytest.fixture()
+    def service_setup(self):
+        from esslivedata_tpu.config import WorkflowSpec
+        from esslivedata_tpu.config.instrument import (
+            Instrument,
+            instrument_registry,
+        )
+        from esslivedata_tpu.config.stream import F144Stream
+        from esslivedata_tpu.kafka.sink import (
+            FakeProducer,
+            KafkaSink,
+            make_default_serializer,
+        )
+        from esslivedata_tpu.services.timeseries import (
+            make_timeseries_service_builder,
+        )
+        from esslivedata_tpu.workflows.wavelength_lut_workflow import (
+            attach_wavelength_lut_factory,
+        )
+        from esslivedata_tpu.workflows.workflow_factory import workflow_registry
+
+        name = "lutsvc"
+        if name not in instrument_registry:
+            geometry = [
+                ChopperGeometry(
+                    name="c1", distance_m=6.0, slit_edges_deg=((0.0, 72.0),)
+                )
+            ]
+            inst = Instrument(
+                name=name,
+                streams={
+                    "c1/delay": F144Stream(
+                        topic=f"{name}_choppers", source="C1:Dly", units="ns"
+                    ),
+                    "c1/rotation_speed_setpoint": F144Stream(
+                        topic=f"{name}_choppers", source="C1:Spd", units="Hz"
+                    ),
+                },
+                choppers=["c1"],
+            )
+            instrument_registry.register(inst)
+            handle = workflow_registry.register_spec(
+                WorkflowSpec(
+                    instrument=name,
+                    namespace="diagnostics",
+                    name="wavelength_lut",
+                    title="Wavelength LUT",
+                    source_names=[CHOPPER_CASCADE_SOURCE],
+                    params_model=WavelengthLutParams,
+                    context_keys=spec_context_keys(geometry),
+                    reset_on_run_transition=False,
+                )
+            )
+            attach_wavelength_lut_factory(handle, choppers=geometry)
+            type(self).handle = handle
+        builder = make_timeseries_service_builder(instrument=name, job_threads=1)
+
+        class ListRaw:
+            def __init__(self):
+                self.pending = []
+
+            def inject(self, *m):
+                self.pending.extend(m)
+
+            def get_messages(self):
+                out, self.pending = self.pending, []
+                return out
+
+        raw = ListRaw()
+        producer = FakeProducer()
+        sink = KafkaSink(
+            producer,
+            make_default_serializer(builder.stream_mapping.livedata, "lut_ts"),
+        )
+        service = builder.from_raw_source(raw, sink)
+        return service, raw, producer
+
+    def test_lut_published_after_cascade_locks(self, service_setup) -> None:
+        from esslivedata_tpu.config import JobId, WorkflowConfig
+        from esslivedata_tpu.kafka import wire
+        from esslivedata_tpu.kafka.source import FakeKafkaMessage
+
+        service, raw, producer = service_setup
+        cfg = WorkflowConfig(
+            identifier=type(self).handle.workflow_id,
+            job_id=JobId(source_name=CHOPPER_CASCADE_SOURCE),
+            params={
+                "distance_start_m": 5.0,
+                "distance_stop_m": 20.0,
+                "distance_resolution_m": 5.0,
+                "n_time_bins": 32,
+            },
+        )
+        raw.inject(
+            FakeKafkaMessage(
+                json.dumps(
+                    {"kind": "start_job", "config": cfg.model_dump(mode="json")}
+                ).encode(),
+                "lutsvc_livedata_commands",
+            )
+        )
+        service.step()
+
+        t0 = 1_700_000_000_000_000_000
+        raw.inject(
+            FakeKafkaMessage(
+                wire.encode_f144("C1:Spd", 14.0, t0), "lutsvc_choppers"
+            )
+        )
+        for i in range(6):
+            raw.inject(
+                FakeKafkaMessage(
+                    wire.encode_f144(
+                        "C1:Dly", 1000.0 + i, t0 + (i + 1) * 1_000_000
+                    ),
+                    "lutsvc_choppers",
+                )
+            )
+        for _ in range(10):
+            service.step()
+
+        data = [
+            m for m in producer.messages if m.topic == "lutsvc_livedata_data"
+        ]
+        assert data, "no LUT published"
+        outputs = {wire.decode_da00(m.value).source_name for m in data}
+        assert any("wavelength_lut" in s for s in outputs), outputs
+        assert any("wavelength_bands" in s for s in outputs), outputs
+
+
+class TestRecomputeDedupe:
+    def test_refresh_tick_with_unchanged_setpoints_is_noop(self) -> None:
+        wf = WavelengthLutWorkflow(choppers=GEOMETRY, params=PARAMS)
+        wf.set_context(full_context())
+        wf.accumulate(trigger_data())
+        assert wf.finalize() != {}
+        wf.accumulate(trigger_data())  # refresh tick, same setpoints
+        assert wf.finalize() == {}
+
+    def test_changed_setpoints_recompute(self) -> None:
+        wf = WavelengthLutWorkflow(choppers=GEOMETRY, params=PARAMS)
+        wf.set_context(full_context())
+        wf.accumulate(trigger_data())
+        assert wf.finalize() != {}
+        ctx = full_context()
+        ctx[delay_setpoint_stream("wfm1")] = series(2e6)
+        wf.set_context(ctx)
+        wf.accumulate(trigger_data())
+        assert wf.finalize() != {}
+
+    def test_parked_chopper_skips_not_errors(self) -> None:
+        wf = WavelengthLutWorkflow(choppers=GEOMETRY, params=PARAMS)
+        ctx = full_context()
+        ctx[speed_setpoint_stream("wfm1")] = series(0.0)
+        wf.set_context(ctx)
+        wf.accumulate(trigger_data())
+        assert wf.finalize() == {}  # skipped, no exception
+        # Speed recovers: the pending trigger fires.
+        wf.set_context(full_context())
+        assert wf.finalize() != {}
