@@ -53,6 +53,9 @@ MULTIBANK_HANDLE = workflow_registry.register_spec(
         name="bank_overview",
         title="9-bank overview (mesh-shardable)",
         source_names=[MERGED_STREAM],
+        # Consumes detector events: hosted by the detector service even
+        # though its display namespace is 'spectrometer'.
+        service="detector_data",
         params_model=MultiBankParams,
         outputs={
             "bank_spectra_current": OutputSpec(title="Per-bank TOA spectra"),

@@ -1,0 +1,38 @@
+"""Typed wrapper for NXlog context payloads (reference: config/value_log.py).
+
+The reference wraps each chain-patch binding's NXlog payload in a distinct
+``ValueLog`` sciline-key subclass so multiple dynamic transforms coexist on
+one pipeline. Our workflows route context by *stream name* (plain dict keys
+into ``set_context``), so no per-binding type is needed for routing — but
+the wrapper remains the declared contract for chain-patch bindings: a
+``ContextBinding`` whose ``workflow_key`` names a ValueLog-derived key is
+routed to geometry patching (workflows/dynamic_transforms.py) rather than
+consumed as a plain parameter, and carries the cumulative timeseries (not
+just the latest sample) so patch logic may inspect motion history.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..utils.labeled import DataArray
+
+__all__ = ["ValueLog"]
+
+
+@dataclass(frozen=True, slots=True)
+class ValueLog:
+    """Cumulative NXlog payload (value-over-time DataArray) of one stream.
+
+    ``values`` is non-empty by the time a workflow sees it: the JobManager
+    context gate (ADR 0002) holds the job pending_context until the
+    underlying f144 stream has produced a value.
+    """
+
+    values: DataArray
+
+    @property
+    def latest(self) -> float:
+        import numpy as np
+
+        return float(np.atleast_1d(np.asarray(self.values.data.values))[-1])

@@ -199,6 +199,11 @@ class WorkflowSpec(BaseModel):
     device_outputs: dict[str, str] = Field(default_factory=dict)
     context_keys: list[str] = Field(default_factory=list)
     reset_on_run_transition: bool = True
+    service: str | None = None
+    """Backend service hosting this spec (detector_data/monitor_data/
+    data_reduction/timeseries). None = derive from the namespace
+    (route_derivation.spec_service); display grouping and hosting service
+    are decoupled, as in the reference's per-registration service field."""
 
     @field_validator("source_names")
     @classmethod

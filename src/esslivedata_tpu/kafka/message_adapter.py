@@ -30,7 +30,11 @@ from ..preprocessors.to_nxlog import LogData
 from . import wire
 from .da00_compat import da00_to_dataarray
 from .source import KafkaMessage
-from .stream_mapping import InputStreamKey, StreamMapping
+from .stream_mapping import (
+    MERGED_DETECTOR_STREAM,
+    InputStreamKey,
+    StreamMapping,
+)
 
 #: Stream kinds whose message timestamp is a production time, making
 #: wall-clock-minus-timestamp a meaningful producer lag.
@@ -91,7 +95,8 @@ class KafkaToDetectorEventsAdapter:
         if name is None:
             return None
         if self._merge:
-            name = "detector"  # all banks into one logical stream (bifrost)
+            # All banks onto one logical stream (bifrost pattern).
+            name = MERGED_DETECTOR_STREAM
         ts = (
             Timestamp.from_ns(int(ev.reference_time[-1]))
             if ev.reference_time.size

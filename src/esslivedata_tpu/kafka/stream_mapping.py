@@ -11,7 +11,16 @@ from __future__ import annotations
 from collections.abc import Mapping
 from dataclasses import dataclass, field
 
-__all__ = ["InputStreamKey", "LivedataTopics", "StreamMapping"]
+__all__ = [
+    "InputStreamKey",
+    "LivedataTopics",
+    "MERGED_DETECTOR_STREAM",
+    "StreamMapping",
+]
+
+#: Logical stream name all banks adapt onto when an instrument sets
+#: merge_detectors (BIFROST pattern; message_adapter merges at the route).
+MERGED_DETECTOR_STREAM = "detector"
 
 
 @dataclass(frozen=True, slots=True)
@@ -92,22 +101,30 @@ class StreamMapping:
             | {self.livedata.commands, self.livedata.roi}
         )
 
-    def scoped(
-        self,
-        *,
-        detectors: bool = False,
-        monitors: bool = False,
-        area_detectors: bool = False,
-        logs: bool = False,
-    ) -> "StreamMapping":
-        """Restrict to the stream kinds a given service consumes
-        (reference: config/route_derivation.py scope_stream_mapping:109)."""
+    @property
+    def all_stream_names(self) -> set[str]:
+        """Every canonical stream name any LUT maps onto."""
+        return (
+            set(self.detectors.values())
+            | set(self.monitors.values())
+            | set(self.area_detectors.values())
+            | set(self.logs.values())
+        )
+
+    def filtered(self, names: set[str]) -> "StreamMapping":
+        """Restrict every LUT to entries whose canonical name is needed
+        (reference StreamMapping.filtered: the service subscribes only to
+        streams its hosted specs consume)."""
         return StreamMapping(
             instrument=self.instrument,
-            detectors=dict(self.detectors) if detectors else {},
-            monitors=dict(self.monitors) if monitors else {},
-            area_detectors=dict(self.area_detectors) if area_detectors else {},
-            logs=dict(self.logs) if logs else {},
+            detectors={k: v for k, v in self.detectors.items() if v in names},
+            monitors={k: v for k, v in self.monitors.items() if v in names},
+            area_detectors={
+                k: v for k, v in self.area_detectors.items() if v in names
+            },
+            logs={k: v for k, v in self.logs.items() if v in names},
             run_control_topics=self.run_control_topics,
+            dev=self.dev,
             livedata=self.livedata,
         )
+

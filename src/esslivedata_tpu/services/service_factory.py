@@ -78,7 +78,13 @@ class DataServiceBuilder:
         self._source_decorator = source_decorator
         self._instrument = instrument_registry[instrument]
         self._instrument.load_factories()
-        self.stream_mapping = get_stream_mapping(self._instrument, dev)
+        # Subscribe only to streams the hosted specs consume (reference
+        # route_derivation.scope_stream_mapping:109).
+        from ..config.route_derivation import scope_stream_mapping
+
+        self.stream_mapping = scope_stream_mapping(
+            self._instrument, get_stream_mapping(self._instrument, dev), service_name
+        )
 
     @property
     def topics(self) -> list[str]:

@@ -1,0 +1,186 @@
+"""Tests for route derivation, config loading, env, grid templates, ValueLog."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.config.config_loader import load_config
+from esslivedata_tpu.config.env import ENV_VAR, StreamingEnv, current_env
+from esslivedata_tpu.config.grid_template import (
+    CellGeometry,
+    GridSpec,
+    load_grid_templates,
+)
+from esslivedata_tpu.config.route_derivation import (
+    gather_source_names,
+    scope_stream_mapping,
+    spec_service,
+)
+from esslivedata_tpu.config.value_log import ValueLog
+from esslivedata_tpu.utils.labeled import DataArray, Variable
+
+
+class TestSpecService:
+    def test_namespace_mapping(self) -> None:
+        from esslivedata_tpu.config.workflow_spec import WorkflowSpec
+
+        def spec(namespace, service=None):
+            return WorkflowSpec(
+                instrument="x", namespace=namespace, name="n", service=service
+            )
+
+        assert spec_service(spec("detector_view")) == "detector_data"
+        assert spec_service(spec("monitor_data")) == "monitor_data"
+        assert spec_service(spec("timeseries")) == "timeseries"
+        assert spec_service(spec("diagnostics")) == "timeseries"
+        assert spec_service(spec("sans")) == "data_reduction"
+        assert spec_service(spec("sans", service="detector_data")) == "detector_data"
+
+
+class TestRouteDerivation:
+    def test_detector_service_scopes_to_detectors(self) -> None:
+        from esslivedata_tpu.config.instrument import instrument_registry
+        from esslivedata_tpu.config.streams import get_stream_mapping
+
+        inst = instrument_registry["dummy"]
+        full = get_stream_mapping(inst)
+        scoped = scope_stream_mapping(inst, full, "detector_data")
+        assert scoped.detectors  # detector specs keep their banks
+        assert not scoped.monitors  # monitor streams dropped
+
+    def test_gather_includes_chopper_synthesis_inputs(self) -> None:
+        from esslivedata_tpu.config.instrument import Instrument
+        from esslivedata_tpu.config.stream import F144Stream
+
+        inst = Instrument(
+            name="routegather",
+            streams={
+                "c1/delay": F144Stream(
+                    topic="t_choppers", source="D", units="ns"
+                ),
+                "c1/rotation_speed_setpoint": F144Stream(
+                    topic="t_choppers", source="S", units="Hz"
+                ),
+            },
+            choppers=["c1"],
+        )
+        names = gather_source_names(inst, "timeseries")
+        assert "c1/delay" in names
+        assert "c1/rotation_speed_setpoint" in names
+
+    def test_gather_expands_devices(self) -> None:
+        from esslivedata_tpu.config.instrument import Instrument
+        from esslivedata_tpu.config.stream import Device, F144Stream
+
+        inst = Instrument(
+            name="routedev",
+            streams={
+                "m/value": F144Stream(topic="t_motion", source="M.RBV"),
+                "m/target": F144Stream(topic="t_motion", source="M.VAL"),
+                "m": Device(value="m/value", target="m/target"),
+            },
+        )
+        names = gather_source_names(inst, "timeseries")
+        assert {"m/value", "m/target"} <= names
+        assert "m" not in names
+
+
+class TestConfigLoader:
+    def test_plain_yaml(self) -> None:
+        cfg = load_config(namespace="kafka", env="dev")
+        assert cfg["bootstrap_servers"] == "localhost:9092"
+
+    def test_template_requires_env_vars(self, monkeypatch) -> None:
+        for var in (
+            "LIVEDATA_KAFKA_BOOTSTRAP",
+            "LIVEDATA_KAFKA_USER",
+            "LIVEDATA_KAFKA_PASSWORD",
+        ):
+            monkeypatch.delenv(var, raising=False)
+        with pytest.raises(ValueError, match="LIVEDATA_KAFKA_"):
+            load_config(namespace="kafka", env="prod")
+
+    def test_template_renders_env_vars(self, monkeypatch) -> None:
+        monkeypatch.setenv("LIVEDATA_KAFKA_BOOTSTRAP", "broker:9093")
+        monkeypatch.setenv("LIVEDATA_KAFKA_USER", "svc")
+        monkeypatch.setenv("LIVEDATA_KAFKA_PASSWORD", "pw")
+        cfg = load_config(namespace="kafka", env="prod")
+        assert cfg["bootstrap_servers"] == "broker:9093"
+        assert cfg["sasl_username"] == "svc"
+
+    def test_missing_namespace_raises(self) -> None:
+        with pytest.raises(FileNotFoundError, match="nope_dev"):
+            load_config(namespace="nope", env="dev")
+
+
+class TestEnv:
+    def test_default_is_dev(self, monkeypatch) -> None:
+        monkeypatch.delenv(ENV_VAR, raising=False)
+        assert current_env() is StreamingEnv.DEV
+
+    def test_env_var_selects(self, monkeypatch) -> None:
+        monkeypatch.setenv(ENV_VAR, "prod")
+        assert current_env() is StreamingEnv.PROD
+
+    def test_invalid_env_rejected(self, monkeypatch) -> None:
+        monkeypatch.setenv(ENV_VAR, "staging")
+        with pytest.raises(ValueError, match="staging"):
+            current_env()
+
+
+class TestGridTemplates:
+    def test_dummy_overview_template_loads(self) -> None:
+        specs = load_grid_templates("dummy")
+        names = [s.name for s in specs]
+        assert "overview" in names
+        overview = next(s for s in specs if s.name == "overview")
+        assert overview.min_rows == 2
+        assert overview.min_cols == 2
+        assert len(overview.cells) == 3
+        assert overview.cells[0].output == "image_cumulative"
+
+    def test_unknown_instrument_is_empty(self) -> None:
+        assert load_grid_templates("not_an_instrument") == []
+
+    def test_geometry_validation(self) -> None:
+        with pytest.raises(ValueError, match="non-negative"):
+            CellGeometry(row=-1, col=0)
+        with pytest.raises(ValueError, match="span"):
+            CellGeometry(row=0, col=0, row_span=0)
+
+    def test_min_rows_from_spans(self) -> None:
+        from esslivedata_tpu.config.grid_template import GridCellSpec
+
+        spec = GridSpec(
+            name="g",
+            nrows=1,
+            ncols=1,
+            cells=(
+                GridCellSpec(geometry=CellGeometry(row=1, col=2, row_span=2)),
+            ),
+        )
+        assert spec.min_rows == 3
+        assert spec.min_cols == 3
+
+
+class TestValueLog:
+    def test_latest(self) -> None:
+        log = ValueLog(
+            values=DataArray(
+                Variable(np.array([1.0, 2.0, 3.5]), ("time",), "mm"),
+                coords={
+                    "time": Variable(np.array([1, 2, 3]), ("time",), "ns")
+                },
+            )
+        )
+        assert log.latest == 3.5
+
+
+class TestYamlSafeCredentials:
+    def test_credential_with_hash_survives(self, monkeypatch) -> None:
+        monkeypatch.setenv("LIVEDATA_KAFKA_BOOTSTRAP", "broker:9093")
+        monkeypatch.setenv("LIVEDATA_KAFKA_USER", "svc")
+        monkeypatch.setenv("LIVEDATA_KAFKA_PASSWORD", "abc#def: {x}")
+        cfg = load_config(namespace="kafka", env="prod")
+        assert cfg["sasl_password"] == "abc#def: {x}"
