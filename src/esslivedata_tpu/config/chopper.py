@@ -16,6 +16,7 @@ from .stream import F144Stream, Stream
 
 __all__ = [
     "CHOPPER_CASCADE_SOURCE",
+    "chopper_pv_streams",
     "declare_chopper_setpoint_streams",
     "delay_readback_stream",
     "delay_setpoint_stream",
@@ -44,6 +45,28 @@ def delay_setpoint_stream(chopper: str) -> str:
     Emitted in-process by ``ChopperSynthesizer``; not a Kafka topic.
     """
     return f"{chopper}/delay_setpoint"
+
+
+def chopper_pv_streams(
+    choppers: Sequence[str], *, topic: str, source_prefix: str = ""
+) -> dict[str, Stream]:
+    """Catalog entries for each chopper's real upstream PVs.
+
+    One speed-setpoint and one delay-readback F144Stream per chopper, named
+    by the same helpers route derivation subscribes through — instruments
+    use this instead of hand-building the names so declaration and
+    subscription can never desynchronize.
+    """
+    streams: dict[str, Stream] = {}
+    for chopper in choppers:
+        prefix = source_prefix or chopper
+        streams[speed_setpoint_stream(chopper)] = F144Stream(
+            topic=topic, source=f"{prefix}:SpdSet", units="Hz"
+        )
+        streams[delay_readback_stream(chopper)] = F144Stream(
+            topic=topic, source=f"{prefix}:Delay", units="ns"
+        )
+    return streams
 
 
 def declare_chopper_setpoint_streams(

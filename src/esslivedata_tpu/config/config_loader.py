@@ -47,7 +47,7 @@ def load_config(*, namespace: str, env: str | None = None) -> dict:
     ``env`` defaults from ``LIVEDATA_ENV``; pass an empty string for
     environment-independent files.
     """
-    env = env if env is not None else os.getenv(ENV_VAR, DEFAULT_ENV)
+    env = env if env is not None else os.getenv(ENV_VAR, DEFAULT_ENV).lower()
     suffix = f"_{env}" if env else ""
     config_file = f"{namespace}{suffix}.yaml"
     template_file = f"{namespace}{suffix}.yaml.jinja"

@@ -15,7 +15,13 @@ from typing import Any
 
 import yaml
 
-__all__ = ["CellGeometry", "GridCellSpec", "GridSpec", "load_raw_grid_templates"]
+__all__ = [
+    "CellGeometry",
+    "GridCellSpec",
+    "GridSpec",
+    "load_grid_templates",
+    "load_raw_grid_templates",
+]
 
 logger = logging.getLogger(__name__)
 

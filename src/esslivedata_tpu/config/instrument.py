@@ -22,6 +22,7 @@ if TYPE_CHECKING:
     from .stream import ContextBinding, Device, Stream
 
 __all__ = [
+    "CameraConfig",
     "DetectorConfig",
     "Instrument",
     "InstrumentRegistry",
@@ -58,10 +59,19 @@ class MonitorConfig:
 
 
 @dataclass
+class CameraConfig:
+    """One area detector (ad00 camera) stream."""
+
+    name: str
+    source_name: str
+
+
+@dataclass
 class Instrument:
     name: str
     detectors: dict[str, DetectorConfig] = field(default_factory=dict)
     monitors: dict[str, MonitorConfig] = field(default_factory=dict)
+    cameras: dict[str, CameraConfig] = field(default_factory=dict)
     log_sources: dict[str, str] = field(default_factory=dict)  # stream -> source
     streams: dict[str, "Stream"] = field(default_factory=dict)
     """Name-keyed stream catalog (f144 PVs, synthesised Device streams);
@@ -129,6 +139,9 @@ class Instrument:
 
     def add_monitor(self, config: MonitorConfig) -> None:
         self.monitors[config.name] = config
+
+    def add_camera(self, config: CameraConfig) -> None:
+        self.cameras[config.name] = config
 
     def add_log(self, stream_name: str, source_name: str | None = None) -> None:
         self.log_sources[stream_name] = source_name or stream_name

@@ -1,0 +1,89 @@
+"""Shared spec-registration helpers for instrument packages.
+
+Parity with the reference's per-workflow spec helper modules
+(workflows/monitor_workflow_specs.py, detector_view_specs.py,
+timeseries_workflow_specs.py): instruments declare *what* they expose,
+these helpers own the standard outputs/param models so every instrument's
+monitor histogram (etc.) looks the same to the dashboard.
+"""
+
+from __future__ import annotations
+
+from ...config.workflow_spec import OutputSpec, WorkflowSpec
+from ...workflows.monitor_workflow import MonitorParams
+from ...workflows.workflow_factory import SpecHandle, workflow_registry
+from .. import instrument as _instrument_mod
+
+__all__ = [
+    "detector_view_outputs",
+    "register_monitor_spec",
+    "register_timeseries_spec",
+]
+
+
+def detector_view_outputs() -> dict[str, OutputSpec]:
+    return {
+        "image_current": OutputSpec(title="Image (window)"),
+        "image_cumulative": OutputSpec(
+            title="Image (since start)", view="since_start"
+        ),
+        "spectrum_current": OutputSpec(title="TOA spectrum"),
+        "spectrum_cumulative": OutputSpec(
+            title="TOA spectrum (since start)", view="since_start"
+        ),
+        "counts_current": OutputSpec(title="Counts (window)"),
+        "counts_cumulative": OutputSpec(
+            title="Counts (since start)", view="since_start"
+        ),
+    }
+
+
+def register_monitor_spec(
+    instrument: "_instrument_mod.Instrument",
+) -> SpecHandle:
+    """Standard monitor TOA-histogram spec over all declared monitors,
+    with cumulative counts exposed as a NICOS derived device (ADR 0006)."""
+    return workflow_registry.register_spec(
+        WorkflowSpec(
+            instrument=instrument.name,
+            namespace="monitor_data",
+            name="histogram",
+            title="Monitor TOA histogram",
+            source_names=instrument.monitor_names,
+            params_model=MonitorParams,
+            outputs={
+                "current": OutputSpec(title="Monitor (window)"),
+                "cumulative": OutputSpec(
+                    title="Monitor (since start)", view="since_start"
+                ),
+                "counts_current": OutputSpec(title="Counts (window)"),
+                "counts_cumulative": OutputSpec(
+                    title="Counts (since start)", view="since_start"
+                ),
+            },
+            device_outputs={
+                "counts_cumulative": "monitor_counts_{source_name}"
+            },
+        )
+    )
+
+
+def register_timeseries_spec(
+    instrument: "_instrument_mod.Instrument",
+) -> SpecHandle:
+    """Standard per-log republish spec over all declared log streams."""
+    sources = sorted(instrument.log_sources) + sorted(
+        name
+        for name, s in instrument.streams.items()
+        if s.writer_module == "f144"
+    )
+    return workflow_registry.register_spec(
+        WorkflowSpec(
+            instrument=instrument.name,
+            namespace="timeseries",
+            name="log",
+            title="Log timeseries",
+            source_names=sources,
+            reset_on_run_transition=False,
+        )
+    )

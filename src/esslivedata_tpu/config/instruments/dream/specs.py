@@ -1,0 +1,210 @@
+"""DREAM instrument declaration + spec registration.
+
+Parity with reference ``config/instruments/dream/specs.py``: five voxel
+detector banks, bunker/cave monitors, five choppers (pulse-shaping pair,
+band, overlap, T0) feeding the wavelength-LUT workflow, and the three
+mantle logical views (front-layer, wire, strip; reference dream/views.py)
+expressed as N-d projection LUTs. Voxel layouts follow the published DREAM
+module structure; exact per-bank NeXus geometry plugs in when artifacts
+are available (same caveat as loki/specs.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ....config.instrument import (
+    DetectorConfig,
+    Instrument,
+    MonitorConfig,
+    instrument_registry,
+)
+from ....config.chopper import chopper_pv_streams
+from ....config.workflow_spec import OutputSpec, WorkflowSpec
+from ....workflows.detector_view.projectors import NdLogicalView
+from ....workflows.detector_view.workflow import DetectorViewParams
+from ....workflows.wavelength_lut_workflow import (
+    ChopperGeometry,
+    WavelengthLutParams,
+    spec_context_keys,
+)
+from ....workflows.workflow_factory import workflow_registry
+from .._common import (
+    detector_view_outputs,
+    register_monitor_spec,
+    register_timeseries_spec,
+)
+
+#: Voxel layout per bank (dim name -> size), C-order of detector_number.
+BANK_SIZES: dict[str, dict[str, int]] = {
+    "mantle_detector": {
+        "wire": 32,
+        "module": 5,
+        "segment": 6,
+        "strip": 256,
+        "counter": 2,
+    },
+    "endcap_backward_detector": {
+        "strip": 16,
+        "wire": 16,
+        "module": 11,
+        "segment": 28,
+        "counter": 2,
+    },
+    "endcap_forward_detector": {
+        "strip": 16,
+        "wire": 16,
+        "module": 5,
+        "segment": 28,
+        "counter": 2,
+    },
+    "high_resolution_detector": {
+        "strip": 32,
+        "wire": 16,
+        "module": 3,
+        "segment": 20,
+        "counter": 2,
+    },
+    "sans_detector": {
+        "strip": 32,
+        "wire": 16,
+        "module": 3,
+        "segment": 10,
+        "counter": 2,
+    },
+}
+
+#: The three mantle views of reference dream/views.py, as LUT specs.
+MANTLE_VIEWS: dict[str, NdLogicalView] = {
+    "mantle_front_layer": NdLogicalView(
+        sizes=BANK_SIZES["mantle_detector"],
+        select={"wire": 0},
+        y=("module", "segment", "counter"),
+        x=("strip",),
+    ),
+    "mantle_wire_view": NdLogicalView(
+        sizes=BANK_SIZES["mantle_detector"],
+        y=("wire",),
+        x=("module", "segment", "counter"),
+        # 'strip' omitted -> summed by the scatter.
+    ),
+    "mantle_strip_view": NdLogicalView(
+        sizes=BANK_SIZES["mantle_detector"],
+        y=("strip",),
+        # everything else summed.
+    ),
+}
+
+CHOPPERS = [
+    "pulse_shaping_chopper1",
+    "pulse_shaping_chopper2",
+    "band_chopper",
+    "overlap_chopper",
+    "T0_chopper",
+]
+
+#: Static chopper geometry (distances from moderator; slit spans chosen to
+#: approximate the high-flux configuration).
+CHOPPER_GEOMETRY = [
+    ChopperGeometry(
+        name="pulse_shaping_chopper1",
+        distance_m=6.145,
+        slit_edges_deg=((0.0, 72.0), (180.0, 252.0)),
+    ),
+    ChopperGeometry(
+        name="pulse_shaping_chopper2",
+        distance_m=6.155,
+        slit_edges_deg=((0.0, 72.0), (180.0, 252.0)),
+    ),
+    ChopperGeometry(
+        name="band_chopper", distance_m=9.3, slit_edges_deg=((0.0, 130.0),)
+    ),
+    ChopperGeometry(
+        name="overlap_chopper", distance_m=13.5, slit_edges_deg=((0.0, 150.0),)
+    ),
+    ChopperGeometry(
+        name="T0_chopper", distance_m=8.5, slit_edges_deg=((20.0, 340.0),)
+    ),
+]
+
+
+INSTRUMENT = Instrument(
+    name="dream",
+    streams=chopper_pv_streams(CHOPPERS, topic="dream_choppers"),
+    choppers=CHOPPERS,
+    _factories_module="esslivedata_tpu.config.instruments.dream.factories",
+)
+
+_offset = 1
+for _bank, _sizes in BANK_SIZES.items():
+    _n = int(np.prod(list(_sizes.values())))
+    INSTRUMENT.add_detector(
+        DetectorConfig(
+            name=_bank,
+            source_name=f"dream_{_bank}",
+            detector_number=np.arange(
+                _offset, _offset + _n, dtype=np.int32
+            ).reshape(tuple(_sizes.values())),
+            projection="logical",
+        )
+    )
+    _offset += _n
+
+INSTRUMENT.add_monitor(
+    MonitorConfig(name="monitor_bunker", source_name="dream_mon_bunker")
+)
+INSTRUMENT.add_monitor(
+    MonitorConfig(name="monitor_cave", source_name="dream_mon_cave")
+)
+INSTRUMENT.add_log("sample_temperature", "dream_temp_sample")
+instrument_registry.register(INSTRUMENT)
+
+
+#: One detector-view spec per mantle view, plus a generic per-bank view.
+MANTLE_VIEW_HANDLES = {
+    view_name: workflow_registry.register_spec(
+        WorkflowSpec(
+            instrument="dream",
+            namespace="detector_view",
+            name=view_name,
+            title=view_name.replace("_", " ").title(),
+            source_names=["mantle_detector"],
+            params_model=DetectorViewParams,
+            outputs=detector_view_outputs(),
+        )
+    )
+    for view_name in MANTLE_VIEWS
+}
+
+BANK_VIEW_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="dream",
+        namespace="detector_view",
+        name="bank_view",
+        title="Bank strip/position view",
+        source_names=sorted(set(BANK_SIZES) - {"mantle_detector"}),
+        params_model=DetectorViewParams,
+        outputs=detector_view_outputs(),
+    )
+)
+
+MONITOR_HANDLE = register_monitor_spec(INSTRUMENT)
+
+WAVELENGTH_LUT_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="dream",
+        namespace="diagnostics",
+        name="wavelength_lut",
+        title="TOF->wavelength lookup table",
+        source_names=["chopper_cascade"],
+        params_model=WavelengthLutParams,
+        context_keys=spec_context_keys(CHOPPER_GEOMETRY),
+        reset_on_run_transition=False,
+        outputs={
+            "wavelength_lut": OutputSpec(title="Wavelength LUT"),
+            "wavelength_bands": OutputSpec(title="Wavelength bands"),
+        },
+    )
+)
+
+TIMESERIES_HANDLE = register_timeseries_spec(INSTRUMENT)

@@ -1,0 +1,44 @@
+"""ESTIA factories."""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from ....workflows.detector_view.projectors import (
+    ProjectionTable,
+    project_logical_nd,
+)
+from ....workflows.detector_view.workflow import DetectorViewWorkflow
+from ....workflows.monitor_workflow import MonitorWorkflow
+from ....workflows.timeseries import TimeseriesWorkflow
+from .specs import (
+    INSTRUMENT,
+    MONITOR_HANDLE,
+    TIMESERIES_HANDLE,
+    VIEW_HANDLES,
+    VIEWS,
+)
+
+
+@lru_cache(maxsize=None)
+def _projection(view_name: str) -> ProjectionTable:
+    det = INSTRUMENT.detectors["multiblade_detector"]
+    return project_logical_nd(det.detector_number, VIEWS[view_name])
+
+
+for _view_name, _handle in VIEW_HANDLES.items():
+
+    def _make_view(*, source_name: str, params, _v=_view_name):  # noqa: ARG001
+        return DetectorViewWorkflow(projection=_projection(_v), params=params)
+
+    _handle.attach_factory(_make_view)
+
+
+@MONITOR_HANDLE.attach_factory
+def make_monitor(*, source_name: str, params) -> MonitorWorkflow:  # noqa: ARG001
+    return MonitorWorkflow(params=params)
+
+
+@TIMESERIES_HANDLE.attach_factory
+def make_timeseries(*, source_name: str, params) -> TimeseriesWorkflow:  # noqa: ARG001
+    return TimeseriesWorkflow()

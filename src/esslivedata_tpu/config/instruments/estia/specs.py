@@ -1,0 +1,76 @@
+"""ESTIA instrument declaration + spec registration.
+
+Parity with reference ``config/instruments/estia/specs.py``: the
+multiblade reflectometry detector (blade x wire x strip voxels), the cbm1
+beam monitor, and a blade-resolved detector view plus a specular
+reflectivity-style projection (wire vs strip summed over blades).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ....config.instrument import (
+    DetectorConfig,
+    Instrument,
+    MonitorConfig,
+    instrument_registry,
+)
+from ....config.workflow_spec import WorkflowSpec
+from ....workflows.detector_view.projectors import NdLogicalView
+from ....workflows.detector_view.workflow import DetectorViewParams
+from ....workflows.workflow_factory import workflow_registry
+from .._common import (
+    detector_view_outputs,
+    register_monitor_spec,
+    register_timeseries_spec,
+)
+
+#: Multiblade layout: 48 blades, 32 wires (depth), 64 strips (transverse).
+BLADE_SIZES = {"blade": 48, "wire": 32, "strip": 64}
+
+VIEWS: dict[str, NdLogicalView] = {
+    # Blade-resolved: one row per (blade, wire), strips across.
+    "blade_wire": NdLogicalView(
+        sizes=BLADE_SIZES, y=("blade", "wire"), x=("strip",)
+    ),
+    # Specular view: wire (scattering angle proxy) vs strip, blades summed.
+    "angle_strip": NdLogicalView(sizes=BLADE_SIZES, y=("wire",), x=("strip",)),
+}
+
+INSTRUMENT = Instrument(
+    name="estia",
+    _factories_module="esslivedata_tpu.config.instruments.estia.factories",
+)
+_n = int(np.prod(list(BLADE_SIZES.values())))
+INSTRUMENT.add_detector(
+    DetectorConfig(
+        name="multiblade_detector",
+        source_name="estia_multiblade",
+        detector_number=np.arange(1, _n + 1, dtype=np.int32).reshape(
+            tuple(BLADE_SIZES.values())
+        ),
+        projection="logical",
+    )
+)
+INSTRUMENT.add_monitor(MonitorConfig(name="cbm1", source_name="estia_cbm1"))
+INSTRUMENT.add_log("sample_angle", "estia_mtr_omega")
+instrument_registry.register(INSTRUMENT)
+
+VIEW_HANDLES = {
+    view_name: workflow_registry.register_spec(
+        WorkflowSpec(
+            instrument="estia",
+            namespace="detector_view",
+            name=view_name,
+            title=view_name.replace("_", " ").title(),
+            source_names=["multiblade_detector"],
+            params_model=DetectorViewParams,
+            outputs=detector_view_outputs(),
+        )
+    )
+    for view_name in VIEWS
+}
+
+MONITOR_HANDLE = register_monitor_spec(INSTRUMENT)
+TIMESERIES_HANDLE = register_timeseries_spec(INSTRUMENT)

@@ -1,0 +1,47 @@
+"""TBL factories."""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from ....workflows.detector_view.projectors import (
+    ProjectionTable,
+    project_logical,
+)
+from ....workflows.detector_view.workflow import DetectorViewWorkflow
+from ....workflows.monitor_workflow import MonitorWorkflow
+from ....workflows.timeseries import TimeseriesWorkflow
+from ....workflows.wavelength_lut_workflow import WavelengthLutWorkflow
+from .specs import (
+    CHOPPER_GEOMETRY,
+    INSTRUMENT,
+    MONITOR_HANDLE,
+    PANEL_VIEW_HANDLE,
+    TIMESERIES_HANDLE,
+    WAVELENGTH_LUT_HANDLE,
+)
+
+
+@lru_cache(maxsize=None)
+def _projection() -> ProjectionTable:
+    return project_logical(INSTRUMENT.detectors["panel"].detector_number)
+
+
+@PANEL_VIEW_HANDLE.attach_factory
+def make_panel_view(*, source_name: str, params) -> DetectorViewWorkflow:  # noqa: ARG001
+    return DetectorViewWorkflow(projection=_projection(), params=params)
+
+
+@WAVELENGTH_LUT_HANDLE.attach_factory
+def make_wavelength_lut(*, source_name: str, params) -> WavelengthLutWorkflow:  # noqa: ARG001
+    return WavelengthLutWorkflow(choppers=CHOPPER_GEOMETRY, params=params)
+
+
+@MONITOR_HANDLE.attach_factory
+def make_monitor(*, source_name: str, params) -> MonitorWorkflow:  # noqa: ARG001
+    return MonitorWorkflow(params=params)
+
+
+@TIMESERIES_HANDLE.attach_factory
+def make_timeseries(*, source_name: str, params) -> TimeseriesWorkflow:  # noqa: ARG001
+    return TimeseriesWorkflow()

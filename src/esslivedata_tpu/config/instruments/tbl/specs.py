@@ -1,0 +1,97 @@
+"""TBL (test beamline) instrument declaration + spec registration.
+
+Parity with reference ``config/instruments/tbl/specs.py``: a small 2-D
+panel, one monitor, sample-environment logs, and a WFM chopper pair whose
+setpoints feed the wavelength-LUT workflow — the beamline used to exercise
+the full chopper->LUT->wavelength chain end to end.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ....config.instrument import (
+    DetectorConfig,
+    Instrument,
+    MonitorConfig,
+    instrument_registry,
+)
+from ....config.chopper import chopper_pv_streams
+from ....config.workflow_spec import OutputSpec, WorkflowSpec
+from ....workflows.detector_view.workflow import DetectorViewParams
+from ....workflows.wavelength_lut_workflow import (
+    ChopperGeometry,
+    WavelengthLutParams,
+    spec_context_keys,
+)
+from ....workflows.workflow_factory import workflow_registry
+from .._common import (
+    detector_view_outputs,
+    register_monitor_spec,
+    register_timeseries_spec,
+)
+
+PANEL_SHAPE = (64, 64)
+CHOPPERS = ["wfm_chopper_1", "wfm_chopper_2"]
+CHOPPER_GEOMETRY = [
+    ChopperGeometry(
+        name="wfm_chopper_1", distance_m=8.0, slit_edges_deg=((0.0, 100.0),)
+    ),
+    ChopperGeometry(
+        name="wfm_chopper_2", distance_m=8.5, slit_edges_deg=((30.0, 140.0),)
+    ),
+]
+
+
+INSTRUMENT = Instrument(
+    name="tbl",
+    streams=chopper_pv_streams(CHOPPERS, topic="tbl_choppers"),
+    choppers=CHOPPERS,
+    _factories_module="esslivedata_tpu.config.instruments.tbl.factories",
+)
+_n = PANEL_SHAPE[0] * PANEL_SHAPE[1]
+INSTRUMENT.add_detector(
+    DetectorConfig(
+        name="panel",
+        source_name="tbl_panel",
+        detector_number=np.arange(1, _n + 1, dtype=np.int32).reshape(
+            PANEL_SHAPE
+        ),
+        projection="logical",
+    )
+)
+INSTRUMENT.add_monitor(MonitorConfig(name="monitor", source_name="tbl_mon_1"))
+INSTRUMENT.add_log("sample_temperature", "tbl_temp_1")
+instrument_registry.register(INSTRUMENT)
+
+PANEL_VIEW_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="tbl",
+        namespace="detector_view",
+        name="panel_view",
+        title="Panel view",
+        source_names=["panel"],
+        params_model=DetectorViewParams,
+        outputs=detector_view_outputs(),
+    )
+)
+
+WAVELENGTH_LUT_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="tbl",
+        namespace="diagnostics",
+        name="wavelength_lut",
+        title="TOF->wavelength lookup table",
+        source_names=["chopper_cascade"],
+        params_model=WavelengthLutParams,
+        context_keys=spec_context_keys(CHOPPER_GEOMETRY),
+        reset_on_run_transition=False,
+        outputs={
+            "wavelength_lut": OutputSpec(title="Wavelength LUT"),
+            "wavelength_bands": OutputSpec(title="Wavelength bands"),
+        },
+    )
+)
+
+MONITOR_HANDLE = register_monitor_spec(INSTRUMENT)
+TIMESERIES_HANDLE = register_timeseries_spec(INSTRUMENT)

@@ -51,7 +51,10 @@ def get_stream_mapping(instrument: Instrument, dev: bool = False) -> StreamMappi
             InputStreamKey(topic=mon_topic, source_name=m.source_name): m.name
             for m in instrument.monitors.values()
         },
-        area_detectors={},
+        area_detectors={
+            InputStreamKey(topic=cam_topic, source_name=c.source_name): c.name
+            for c in instrument.cameras.values()
+        },
         logs=_build_logs_lut(instrument, log_topic, dev),
         run_control_topics=(run_topic,),
     )

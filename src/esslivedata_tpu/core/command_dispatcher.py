@@ -41,11 +41,21 @@ class CommandDispatcher:
 
     def _owns(self, config: WorkflowConfig) -> bool:
         wid = config.identifier
-        return (
+        if not (
             wid.instrument == self._instrument
             and wid in self._registry
             and self._registry.has_factory(wid)
-        )
+        ):
+            return False
+        # All of an instrument's factories load in every service process, so
+        # a factory being attached is not ownership — the hosting service is
+        # (matching the subscription scoping: a non-hosting service has no
+        # data streams for the job and would ack then sit idle forever).
+        if self._service_name:
+            from ..config.route_derivation import spec_service
+
+            return spec_service(self._registry[wid]) == self._service_name
+        return True
 
     def process_messages(
         self, messages: Sequence[Message]

@@ -22,7 +22,14 @@ import numpy as np
 
 from ...utils.labeled import Variable
 
-__all__ = ["LogicalView", "ProjectionTable", "project_geometric", "project_logical"]
+__all__ = [
+    "LogicalView",
+    "NdLogicalView",
+    "ProjectionTable",
+    "project_geometric",
+    "project_logical",
+    "project_logical_nd",
+]
 
 
 @dataclass(frozen=True)
@@ -145,6 +152,81 @@ def project_geometric(
         nx=nx,
         y_edges=Variable(y_edges, ("y",), unit),
         x_edges=Variable(x_edges, ("x",), unit),
+    )
+
+
+@dataclass(frozen=True)
+class NdLogicalView:
+    """N-d fold -> slice -> display spec for voxel detectors (DREAM).
+
+    The reference expresses these as scipp fold/transpose/slice/flatten
+    transforms re-applied per cycle (dream/views.py); here the whole view
+    collapses into the pixel->screen LUT built once: ``sizes`` folds the
+    flat detector_number array, ``select`` slices dims to a fixed index
+    (other voxels drop out), ``y``/``x`` dims composite into screen
+    rows/cols, and any remaining dim is summed — many voxels landing on one
+    screen bin, which the scatter-add performs for free.
+    """
+
+    sizes: dict[str, int]
+    y: tuple[str, ...]
+    x: tuple[str, ...] = ()
+    select: dict[str, int] = None  # type: ignore[assignment]
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "select", dict(self.select or {}))
+        names = set(self.sizes)
+        for dim in (*self.y, *self.x, *self.select):
+            if dim not in names:
+                raise ValueError(f"view dim {dim!r} not in sizes {names}")
+        if set(self.y) & set(self.x):
+            raise ValueError("y and x dims must be disjoint")
+        for dim, index in self.select.items():
+            if not 0 <= index < self.sizes[dim]:
+                raise ValueError(
+                    f"select[{dim!r}]={index} out of range {self.sizes[dim]}"
+                )
+
+
+def project_logical_nd(
+    detector_numbers: np.ndarray, view: NdLogicalView
+) -> ProjectionTable:
+    """Build a projection table from an N-d voxel layout.
+
+    ``detector_numbers`` is flat (C-order over ``view.sizes``) or already
+    shaped to those sizes.
+    """
+    shape = tuple(view.sizes.values())
+    det = np.asarray(detector_numbers).reshape(shape)
+    dims = list(view.sizes)
+    index = np.indices(shape)
+    per_dim = {d: index[i] for i, d in enumerate(dims)}
+
+    keep = np.ones(shape, dtype=bool)
+    for dim, sel in view.select.items():
+        keep &= per_dim[dim] == sel
+
+    def composite(parts: tuple[str, ...]) -> tuple[np.ndarray, int]:
+        idx = np.zeros(shape, dtype=np.int64)
+        total = 1
+        for dim in parts:
+            idx = idx * view.sizes[dim] + per_dim[dim]
+            total *= view.sizes[dim]
+        return idx, total
+
+    row, ny = composite(view.y)
+    col, nx = composite(view.x)
+    screen = np.where(keep, row * nx + col, -1).astype(np.int32)
+
+    n_id_space = int(det.max()) + 1
+    lut = np.full((1, n_id_space), -1, dtype=np.int32)
+    lut[0, det.reshape(-1)] = screen.reshape(-1)
+    return ProjectionTable(
+        lut=lut,
+        ny=ny,
+        nx=nx,
+        y_edges=Variable(np.arange(ny + 1, dtype=np.float64) - 0.5, ("y",), ""),
+        x_edges=Variable(np.arange(nx + 1, dtype=np.float64) - 0.5, ("x",), ""),
     )
 
 

@@ -1,0 +1,43 @@
+"""Shared commands topic: only the hosting service acks a start command."""
+
+from esslivedata_tpu.config import JobId, WorkflowConfig
+from esslivedata_tpu.core.command_dispatcher import CommandDispatcher
+from esslivedata_tpu.core.job_manager import JobFactory, JobManager
+from esslivedata_tpu.core.message import COMMANDS_STREAM_ID, Message
+
+
+def dispatcher(service_name: str) -> CommandDispatcher:
+    return CommandDispatcher(
+        job_manager=JobManager(job_factory=JobFactory(), job_threads=1),
+        instrument="bifrost",
+        service_name=service_name,
+    )
+
+
+def start_msg() -> Message:
+    from esslivedata_tpu.config.instruments.bifrost.specs import (
+        MULTIBANK_HANDLE,
+    )
+    from esslivedata_tpu.config.instrument import instrument_registry
+
+    instrument_registry["bifrost"].load_factories()
+    return Message(
+        stream=COMMANDS_STREAM_ID,
+        value=WorkflowConfig(
+            identifier=MULTIBANK_HANDLE.workflow_id,
+            job_id=JobId(source_name="detector"),
+            params={},
+        ),
+    )
+
+
+class TestCommandOwnership:
+    def test_hosting_service_acks(self):
+        acks = dispatcher("detector_data").process_messages([start_msg()])
+        assert len(acks) == 1 and acks[0].status == "ack"
+
+    def test_non_hosting_service_stays_silent(self):
+        # Factories are attached process-wide, but data_reduction does not
+        # host this spec: it must not ack (exactly one reply fleet-wide).
+        acks = dispatcher("data_reduction").process_messages([start_msg()])
+        assert acks == []

@@ -1,0 +1,123 @@
+"""Tests for N-d logical views (voxel fold -> slice/sum -> screen LUT)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.workflows.detector_view.projectors import (
+    NdLogicalView,
+    project_logical_nd,
+)
+
+SIZES = {"wire": 2, "module": 3, "strip": 4}
+
+
+def det() -> np.ndarray:
+    n = 2 * 3 * 4
+    return np.arange(1, n + 1, dtype=np.int32).reshape(2, 3, 4)
+
+
+class TestNdLogicalView:
+    def test_validation(self) -> None:
+        with pytest.raises(ValueError, match="not in sizes"):
+            NdLogicalView(sizes=SIZES, y=("nope",))
+        with pytest.raises(ValueError, match="disjoint"):
+            NdLogicalView(sizes=SIZES, y=("wire",), x=("wire",))
+        with pytest.raises(ValueError, match="out of range"):
+            NdLogicalView(sizes=SIZES, y=("wire",), select={"module": 3})
+
+    def test_full_display_is_bijective(self) -> None:
+        view = NdLogicalView(sizes=SIZES, y=("wire", "module"), x=("strip",))
+        table = project_logical_nd(det(), view)
+        assert table.ny == 6 and table.nx == 4
+        screens = table.lut[0][det().reshape(-1)]
+        assert sorted(screens) == list(range(24))
+
+    def test_select_drops_other_layers(self) -> None:
+        view = NdLogicalView(
+            sizes=SIZES, select={"wire": 0}, y=("module",), x=("strip",)
+        )
+        table = project_logical_nd(det(), view)
+        d = det()
+        front = d[0].reshape(-1)
+        back = d[1].reshape(-1)
+        assert (table.lut[0][front] >= 0).all()
+        assert (table.lut[0][back] == -1).all()
+
+    def test_summed_dim_maps_many_to_one(self) -> None:
+        view = NdLogicalView(sizes=SIZES, y=("module",), x=("strip",))
+        table = project_logical_nd(det(), view)
+        d = det()
+        # Both wires of one (module, strip) cell share a screen bin.
+        assert table.lut[0][d[0, 1, 2]] == table.lut[0][d[1, 1, 2]]
+        assert table.ny == 3 and table.nx == 4
+
+    def test_row_col_ordering_matches_c_order(self) -> None:
+        view = NdLogicalView(sizes=SIZES, y=("wire", "module"), x=("strip",))
+        table = project_logical_nd(det(), view)
+        d = det()
+        # voxel (wire=1, module=2, strip=3) -> row = 1*3+2 = 5, col 3.
+        assert table.lut[0][d[1, 2, 3]] == 5 * 4 + 3
+
+    def test_1d_strip_view(self) -> None:
+        view = NdLogicalView(sizes=SIZES, y=("strip",))
+        table = project_logical_nd(det(), view)
+        assert table.ny == 4 and table.nx == 1
+
+
+class TestInstrumentPackages:
+    """Each new instrument loads, registers, and its factories build."""
+
+    @pytest.mark.parametrize(
+        "instrument", ["dream", "estia", "nmx", "odin", "tbl"]
+    )
+    def test_loads_and_factories_attach(self, instrument: str) -> None:
+        from esslivedata_tpu.config.instrument import instrument_registry
+        from esslivedata_tpu.workflows.workflow_factory import workflow_registry
+
+        inst = instrument_registry[instrument]
+        inst.load_factories()
+        specs = workflow_registry.specs_for_instrument(instrument)
+        assert specs, f"no specs registered for {instrument}"
+        for spec in specs:
+            assert workflow_registry.has_factory(spec.identifier), (
+                f"{spec.identifier} has no factory"
+            )
+
+    def test_dream_mantle_front_layer_builds(self) -> None:
+        from esslivedata_tpu.config.instruments.dream import factories
+
+        table = factories._mantle_projection("mantle_front_layer")
+        # wire=0 selected: 5*6*2=60 rows, 256 strips.
+        assert (table.ny, table.nx) == (60, 256)
+
+    def test_dream_wire_view_sums_strips(self) -> None:
+        from esslivedata_tpu.config.instruments.dream import factories
+
+        table = factories._mantle_projection("mantle_wire_view")
+        assert (table.ny, table.nx) == (32, 60)
+
+    def test_estia_views_build(self) -> None:
+        from esslivedata_tpu.config.instruments.estia import factories
+
+        assert factories._projection("blade_wire").ny == 48 * 32
+        assert factories._projection("angle_strip").ny == 32
+
+    def test_tbl_wavelength_lut_factory_builds(self) -> None:
+        from esslivedata_tpu.config.instruments.tbl.specs import (
+            WAVELENGTH_LUT_HANDLE,
+        )
+        from esslivedata_tpu.config.instrument import instrument_registry
+        from esslivedata_tpu.workflows.workflow_factory import workflow_registry
+        from esslivedata_tpu.config import JobId, WorkflowConfig
+
+        instrument_registry["tbl"].load_factories()
+        wf = workflow_registry.create(
+            WorkflowConfig(
+                identifier=WAVELENGTH_LUT_HANDLE.workflow_id,
+                job_id=JobId(source_name="chopper_cascade"),
+                params={},
+            )
+        )
+        assert hasattr(wf, "set_context")
