@@ -1,0 +1,131 @@
+"""Persisted UI state (reference: dashboard/config_store.py, 758 LoC).
+
+Namespaced key->JSON-document stores; the file-backed store survives
+dashboard restarts (grid layouts, staged workflow params, plot configs —
+reference tests/integration/config_persistence_test.py), the in-memory
+store backs tests and ephemeral sessions. Writes are atomic
+(write-to-temp + rename) so a crash mid-save never corrupts state.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from pathlib import Path
+from typing import Any, Protocol
+
+__all__ = [
+    "ConfigStore",
+    "ConfigStoreManager",
+    "FileConfigStore",
+    "MemoryConfigStore",
+]
+
+logger = logging.getLogger(__name__)
+
+
+class ConfigStore(Protocol):
+    def load(self, key: str) -> dict[str, Any] | None: ...
+
+    def save(self, key: str, value: dict[str, Any]) -> None: ...
+
+    def delete(self, key: str) -> None: ...
+
+    def keys(self) -> list[str]: ...
+
+
+class MemoryConfigStore:
+    def __init__(self) -> None:
+        self._data: dict[str, dict[str, Any]] = {}
+        self._lock = threading.Lock()
+
+    def load(self, key: str) -> dict[str, Any] | None:
+        with self._lock:
+            value = self._data.get(key)
+            return json.loads(json.dumps(value)) if value is not None else None
+
+    def save(self, key: str, value: dict[str, Any]) -> None:
+        with self._lock:
+            self._data[key] = json.loads(json.dumps(value))
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return sorted(self._data)
+
+
+class FileConfigStore:
+    """One JSON file per key under ``root`` (sanitized filenames)."""
+
+    def __init__(self, root: str | Path) -> None:
+        self._root = Path(root)
+        self._root.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _path(self, key: str) -> Path:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in key)
+        if not safe:
+            raise ValueError(f"Config key {key!r} sanitizes to empty")
+        return self._root / f"{safe}.json"
+
+    def load(self, key: str) -> dict[str, Any] | None:
+        path = self._path(key)
+        with self._lock:
+            try:
+                return json.loads(path.read_text())
+            except FileNotFoundError:
+                return None
+            except json.JSONDecodeError:
+                logger.warning("Corrupt config file %s ignored", path)
+                return None
+
+    def save(self, key: str, value: dict[str, Any]) -> None:
+        path = self._path(key)
+        with self._lock:
+            tmp = path.with_suffix(".json.tmp")
+            tmp.write_text(json.dumps(value, indent=2, sort_keys=True))
+            tmp.replace(path)
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._path(key).unlink(missing_ok=True)
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return sorted(p.stem for p in self._root.glob("*.json"))
+
+
+class ConfigStoreManager:
+    """Namespaced access onto one backing store (grids/, workflows/, ...)."""
+
+    def __init__(self, store: ConfigStore) -> None:
+        self._store = store
+
+    def namespaced(self, namespace: str) -> "_NamespacedStore":
+        return _NamespacedStore(self._store, namespace)
+
+
+class _NamespacedStore:
+    def __init__(self, store: ConfigStore, namespace: str) -> None:
+        self._store = store
+        self._prefix = f"{namespace}__"
+
+    def load(self, key: str) -> dict[str, Any] | None:
+        return self._store.load(self._prefix + key)
+
+    def save(self, key: str, value: dict[str, Any]) -> None:
+        self._store.save(self._prefix + key, value)
+
+    def delete(self, key: str) -> None:
+        self._store.delete(self._prefix + key)
+
+    def keys(self) -> list[str]:
+        return [
+            k[len(self._prefix):]
+            for k in self._store.keys()
+            if k.startswith(self._prefix)
+        ]

@@ -117,6 +117,7 @@ class InProcessBackendTransport:
             f"{prefix}_livedata_data": "data",
             f"{prefix}_livedata_status": "status",
             f"{prefix}_livedata_responses": "responses",
+            f"{prefix}_livedata_nicos": "nicos",
         }
 
     # -- Transport protocol ----------------------------------------------

@@ -39,6 +39,7 @@ class DashboardKafkaTransport:
             self._topics.data: "data",
             self._topics.status: "status",
             self._topics.responses: "responses",
+            self._topics.nicos: "nicos",
         }
         self._consumer = Consumer(
             {

@@ -13,8 +13,15 @@ import threading
 import time
 
 from .data_service import DataService
+from .derived_devices import DerivedDeviceRegistry
 from .job_service import JobService
-from .transport import AckMessage, ResultMessage, StatusMessage, Transport
+from .transport import (
+    AckMessage,
+    DeviceMessage,
+    ResultMessage,
+    StatusMessage,
+    Transport,
+)
 
 __all__ = ["MessagePump"]
 
@@ -28,11 +35,13 @@ class MessagePump:
         transport: Transport,
         data_service: DataService,
         job_service: JobService,
+        device_registry: DerivedDeviceRegistry | None = None,
         interval_s: float = 0.05,
     ) -> None:
         self._transport = transport
         self._data_service = data_service
         self._job_service = job_service
+        self._devices = device_registry
         self._interval_s = interval_s
         self._thread: threading.Thread | None = None
         self._running = threading.Event()
@@ -48,6 +57,13 @@ class MessagePump:
                 self._job_service.on_status(msg)
             elif isinstance(msg, AckMessage):
                 self._job_service.on_ack(msg)
+            elif isinstance(msg, DeviceMessage) and self._devices is not None:
+                self._devices.on_device_value(
+                    msg.name,
+                    msg.value,
+                    unit=msg.unit,
+                    timestamp_ns=msg.timestamp_ns,
+                )
         if data:
             with self._data_service.transaction():
                 for msg in data:

@@ -23,7 +23,14 @@ import numpy as np
 
 from ..utils.labeled import DataArray, midpoints
 
-__all__ = ["PlotterRegistry", "plotter_registry", "render_png"]
+__all__ = [
+    "PlotterRegistry",
+    "SlicerPlotter",
+    "TablePlotter",
+    "plotter_registry",
+    "render_correlation_png",
+    "render_png",
+]
 
 logger = logging.getLogger(__name__)
 
@@ -111,6 +118,99 @@ class ScalarPlotter:
         )
 
 
+class SlicerPlotter:
+    """3-D data: mid-slice along the leading dim plus its index in the
+    title (reference slicer_plotter.py renders a slice with a dim slider;
+    the HTTP front end picks the slice via the ``slice`` query param)."""
+
+    def __init__(self, index: int | None = None) -> None:
+        self._index = index
+
+    def plot(self, ax, da: DataArray) -> None:
+        lead = da.dims[0]
+        n = da.sizes[lead]
+        i = min(self._index if self._index is not None else n // 2, n - 1)
+        values = np.asarray(da.values, dtype=np.float64)[i]
+        ydim, xdim = da.dims[1], da.dims[2]
+        x, xlabel = _coord_values(da, xdim)
+        y, ylabel = _coord_values(da, ydim)
+        if x.size == values.shape[1]:
+            x = np.concatenate([x, [x[-1] + (x[-1] - x[-2] if x.size > 1 else 1)]])
+        if y.size == values.shape[0]:
+            y = np.concatenate([y, [y[-1] + (y[-1] - y[-2] if y.size > 1 else 1)]])
+        mesh = ax.pcolormesh(x, y, values, shading="flat")
+        ax.figure.colorbar(mesh, ax=ax, label=f"[{da.unit!r}]")
+        ax.set_xlabel(xlabel)
+        ax.set_ylabel(ylabel)
+        ax.set_title(f"{lead}={i}/{n}", fontsize=8)
+
+
+class TablePlotter:
+    """Small 1-D data as a name/value table (reference table_plotter.py)."""
+
+    MAX_ROWS = 16
+
+    def plot(self, ax, da: DataArray) -> None:
+        ax.axis("off")
+        values = np.atleast_1d(np.asarray(da.values))
+        dim = da.dims[0] if da.dims else ""
+        labels = (
+            np.asarray(da.coords[dim].values)
+            if dim in da.coords
+            and da.coords[dim].values.size == values.size
+            else np.arange(values.size)
+        )
+        rows = [
+            [str(label), f"{value:.6g}"]
+            for label, value in zip(
+                labels[: self.MAX_ROWS], values[: self.MAX_ROWS], strict=False
+            )
+        ]
+        table = ax.table(
+            cellText=rows,
+            colLabels=[dim or "index", f"value [{da.unit!r}]"],
+            loc="center",
+        )
+        table.auto_set_font_size(False)
+        table.set_fontsize(8)
+
+
+def render_correlation_png(
+    x_series: DataArray,
+    y_series: DataArray,
+    *,
+    title: str = "",
+    figsize=(5.0, 3.6),
+    dpi: int = 100,
+) -> bytes:
+    """Timeseries-vs-timeseries correlation (reference correlation_plotter):
+    the two series are aligned on the finer time axis by nearest-older
+    sample, then scattered against each other."""
+    tx = np.asarray(x_series.coords["time"].values, dtype=np.int64)
+    ty = np.asarray(y_series.coords["time"].values, dtype=np.int64)
+    vx = np.atleast_1d(np.asarray(x_series.values, dtype=np.float64))
+    vy = np.atleast_1d(np.asarray(y_series.values, dtype=np.float64))
+    if tx.size == 0 or ty.size == 0:
+        raise ValueError("correlation needs non-empty series")
+    # Align y onto x's timestamps: last y sample at-or-before each x time.
+    idx = np.clip(np.searchsorted(ty, tx, side="right") - 1, 0, ty.size - 1)
+    aligned_y = vy[idx]
+    with _render_lock:
+        fig, ax = plt.subplots(figsize=figsize, dpi=dpi)
+        try:
+            ax.scatter(vx, aligned_y, s=12, alpha=0.7)
+            ax.set_xlabel(f"{x_series.name} [{x_series.unit!r}]")
+            ax.set_ylabel(f"{y_series.name} [{y_series.unit!r}]")
+            if title:
+                ax.set_title(title, fontsize=9)
+            fig.tight_layout()
+            buf = io.BytesIO()
+            fig.savefig(buf, format="png")
+            return buf.getvalue()
+        finally:
+            plt.close(fig)
+
+
 class PlotterRegistry:
     """Shape -> plotter selection, extensible (reference PlotterSpec:84)."""
 
@@ -140,6 +240,8 @@ class PlotterRegistry:
             ):
                 return Overlay1DPlotter()
             return ImagePlotter()
+        if ndim == 3:
+            return SlicerPlotter()
         raise ValueError(f"No plotter for {ndim}-d data")
 
 
@@ -147,16 +249,25 @@ plotter_registry = PlotterRegistry()
 
 
 def render_png(
-    da: DataArray, *, title: str = "", figsize=(5.0, 3.6), dpi: int = 100
+    da: DataArray,
+    *,
+    title: str = "",
+    figsize=(5.0, 3.6),
+    dpi: int = 100,
+    plotter=None,
 ) -> bytes:
-    """Render one DataArray to PNG bytes using the auto-selected plotter."""
+    """Render one DataArray to PNG using ``plotter`` or the auto-selection.
+
+    The caller's title goes on the figure (suptitle) so plotters that use
+    the axes title themselves (SlicerPlotter's slice indicator) keep it.
+    """
     with _render_lock:
         fig, ax = plt.subplots(figsize=figsize, dpi=dpi)
         try:
-            plotter = plotter_registry.select(da)
+            plotter = plotter or plotter_registry.select(da)
             plotter.plot(ax, da)
             if title:
-                ax.set_title(title, fontsize=9)
+                fig.suptitle(title, fontsize=9)
             fig.tight_layout()
             buf = io.BytesIO()
             fig.savefig(buf, format="png")

@@ -26,6 +26,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--transport", choices=["fake", "kafka"], default="fake")
     parser.add_argument("--kafka-bootstrap", default="localhost:9092")
     parser.add_argument("--events-per-pulse", type=int, default=2000)
+    parser.add_argument(
+        "--config-dir",
+        default="",
+        help="Directory for persisted UI state (grid layouts); "
+        "default: in-memory only",
+    )
     parser.set_defaults(**get_env_defaults(parser))
     args = parser.parse_args(argv)
     logging.basicConfig(level=args.log_level)
@@ -52,7 +58,16 @@ def main(argv: list[str] | None = None) -> int:
             dev=args.dev,
         )
 
-    services = DashboardServices(transport=transport)
+    store = None
+    if args.config_dir:
+        from .config_store import FileConfigStore
+
+        store = FileConfigStore(args.config_dir)
+    services = DashboardServices(
+        transport=transport,
+        config_store=store,
+        instrument=args.instrument,
+    )
     app = make_app(services, args.instrument)
 
     async def serve() -> None:

@@ -11,6 +11,8 @@ import logging
 from dataclasses import dataclass
 from typing import Any, Protocol, runtime_checkable
 
+import numpy as np
+
 from ..config.workflow_spec import ResultKey
 from ..core.job import ServiceStatus
 from ..core.timestamp import Timestamp
@@ -20,6 +22,7 @@ from ..utils.labeled import DataArray
 
 __all__ = [
     "AckMessage",
+    "DeviceMessage",
     "NullTransport",
     "ResultMessage",
     "StatusMessage",
@@ -48,7 +51,17 @@ class AckMessage:
     payload: dict
 
 
-DashboardMessage = ResultMessage | StatusMessage | AckMessage
+@dataclass(frozen=True, slots=True)
+class DeviceMessage:
+    """One NICOS derived-device sample from the nicos topic (ADR 0006)."""
+
+    name: str
+    value: float
+    unit: str
+    timestamp_ns: int
+
+
+DashboardMessage = ResultMessage | StatusMessage | AckMessage | DeviceMessage
 
 
 @runtime_checkable
@@ -89,6 +102,32 @@ def decode_backend_message(
         )
     if topic_kind == "responses":
         return AckMessage(payload=json.loads(value.decode("utf-8")))
+    if topic_kind == "nicos":
+        # The nicos topic carries both f144 (LogData devices) and da00
+        # (contracted DataArray outputs, kafka/sink.py:99-113): dispatch on
+        # the embedded schema id.
+        schema = wire.get_schema(value)
+        if schema == "f144":
+            f144 = wire.decode_f144(value)
+            return DeviceMessage(
+                name=f144.source_name,
+                value=float(np.atleast_1d(f144.value)[-1]),
+                unit="",
+                timestamp_ns=f144.timestamp_ns,
+            )
+        da00 = wire.decode_da00(value)
+        signal = next(
+            (v for v in da00.variables if v.name == "signal"),
+            da00.variables[0] if da00.variables else None,
+        )
+        if signal is None:
+            return None
+        return DeviceMessage(
+            name=da00.source_name,
+            value=float(np.atleast_1d(signal.data).reshape(-1)[-1]),
+            unit=signal.unit or "",
+            timestamp_ns=da00.timestamp_ns,
+        )
     return None
 
 

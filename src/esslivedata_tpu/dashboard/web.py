@@ -27,7 +27,12 @@ import tornado.web
 from ..config.workflow_spec import ResultKey, WorkflowId
 from .dashboard_services import DashboardServices
 from .extractors import FullHistoryExtractor
-from .plots import render_png
+from .plots import (
+    SlicerPlotter,
+    TablePlotter,
+    render_correlation_png,
+    render_png,
+)
 
 __all__ = ["make_app"]
 
@@ -182,10 +187,51 @@ class PlotHandler(_Base):
             self.set_status(404)
             return
         title = f"{key.job_id.source_name} · {key.output_name}"
+        # ?slice=N picks the leading-dim slice of 3-D data (SlicerPlotter);
+        # ?plotter=table forces the tabular rendering of small 1-D data.
+        slice_arg = self.get_argument("slice", None)
+        plotter = None
+        if self.get_argument("plotter", "") == "table":
+            plotter = TablePlotter()
+        elif slice_arg is not None and data.data.ndim == 3:
+            plotter = SlicerPlotter(index=int(slice_arg))
         try:
-            png = render_png(data, title=title)
+            png = render_png(data, title=title, plotter=plotter)
         except Exception:
             logger.exception("Plot render failed for %s", key)
+            self.set_status(500)
+            return
+        self.set_header("Content-Type", "image/png")
+        self.set_header("Cache-Control", "no-store")
+        self.write(png)
+
+
+class CorrelationPlotHandler(_Base):
+    """?x=<kid>&y=<kid>: timeseries-vs-timeseries scatter, aligned on x's
+    timestamps (reference correlation_plotter.py)."""
+
+    def get(self) -> None:
+        try:
+            x_key = _id_to_key(self.get_argument("x"))
+            y_key = _id_to_key(self.get_argument("y"))
+        except Exception:
+            self.set_status(400)
+            return
+        # Latest value of a timeseries key IS the cumulative NXlog series
+        # (ToNXlog holds full history), so no history extraction needed.
+        x_series = self.services.data_service.get(x_key)
+        y_series = self.services.data_service.get(y_key)
+        if x_series is None or y_series is None:
+            self.set_status(404)
+            return
+        try:
+            png = render_correlation_png(
+                x_series,
+                y_series,
+                title=f"{x_key.output_name} vs {y_key.output_name}",
+            )
+        except Exception:
+            logger.exception("Correlation render failed")
             self.set_status(500)
             return
         self.set_header("Content-Type", "image/png")
@@ -279,6 +325,51 @@ setInterval(refresh, 1000); refresh();
 """
 
 
+class GridsHandler(_Base):
+    """Persisted plot grids + per-grid frame-clock generations (ADR 0005):
+    clients repaint a grid only when its generation advanced."""
+
+    def get(self) -> None:
+        grids = self.services.plot_orchestrator.snapshot()
+        for grid in grids:
+            for cell in grid["cells"]:
+                cell["keys"] = [_key_to_id(k) for k in cell["keys"]]
+        self.write_json({"grids": grids})
+
+
+class NotificationsHandler(_Base):
+    def get(self) -> None:
+        since = int(self.get_query_argument("since", "0"))
+        self.write_json(
+            {
+                "notifications": [
+                    {"seq": n.seq, "level": n.level, "message": n.message}
+                    for n in self.services.notifications.since(since)
+                ],
+                "latest": self.services.notifications.latest_seq,
+            }
+        )
+
+
+class DevicesHandler(_Base):
+    """NICOS derived-device overview (ADR 0006)."""
+
+    def get(self) -> None:
+        self.write_json(
+            {
+                "devices": [
+                    {
+                        "name": d.name,
+                        "value": d.value,
+                        "unit": d.unit,
+                        "stale": d.is_stale,
+                    }
+                    for d in self.services.devices.devices()
+                ]
+            }
+        )
+
+
 class IndexHandler(_Base):
     def get(self) -> None:
         self.write(
@@ -294,6 +385,10 @@ def make_app(services: DashboardServices, instrument: str) -> tornado.web.Applic
             (r"/api/workflow/start", StartWorkflowHandler),
             (r"/api/job/(stop|reset|remove)", JobActionHandler),
             (r"/api/roi", RoiHandler),
+            (r"/api/grids", GridsHandler),
+            (r"/api/notifications", NotificationsHandler),
+            (r"/api/devices", DevicesHandler),
+            (r"/plot/correlation\.png", CorrelationPlotHandler),
             (r"/plot/([A-Za-z0-9_\-=]+)\.png", PlotHandler),
         ],
         services=services,

@@ -1,0 +1,242 @@
+"""Tests: frame clock, config store, plot orchestrator, notifications,
+derived devices, stream manager, specialty plotters."""
+
+from __future__ import annotations
+
+import uuid
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.config.grid_template import (
+    CellGeometry,
+    GridCellSpec,
+    GridSpec,
+)
+from esslivedata_tpu.config.workflow_spec import JobId, ResultKey, WorkflowId
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.dashboard.config_store import (
+    FileConfigStore,
+    MemoryConfigStore,
+)
+from esslivedata_tpu.dashboard.data_service import DataService
+from esslivedata_tpu.dashboard.derived_devices import DerivedDeviceRegistry
+from esslivedata_tpu.dashboard.frame_clock import FrameClock
+from esslivedata_tpu.dashboard.notification_queue import NotificationQueue
+from esslivedata_tpu.dashboard.plot_orchestrator import PlotOrchestrator
+from esslivedata_tpu.dashboard.stream_manager import StreamManager
+from esslivedata_tpu.utils.labeled import DataArray, Variable
+
+
+def result_key(output="image", source="det", name="view") -> ResultKey:
+    return ResultKey(
+        workflow_id=WorkflowId(instrument="t", namespace="d", name=name),
+        job_id=JobId(source_name=source, job_number=uuid.uuid4()),
+        output_name=output,
+    )
+
+
+def array_1d(n=4) -> DataArray:
+    return DataArray(Variable(np.arange(n, dtype=float), ("x",), "counts"))
+
+
+class TestFrameClock:
+    def test_commit_advances_grid_and_global(self) -> None:
+        clock = FrameClock()
+        g1 = clock.commit("a")
+        assert clock.grid_generation("a") == g1
+        assert clock.grid_generation("b") == 0
+        assert clock.changed_since("a", 0)
+        assert not clock.changed_since("a", g1)
+
+    def test_commit_all(self) -> None:
+        clock = FrameClock()
+        clock.commit("a")
+        clock.commit("b")
+        gen = clock.commit_all()
+        assert clock.grid_generation("a") == gen
+        assert clock.grid_generation("b") == gen
+
+
+class TestConfigStore:
+    def test_memory_roundtrip_isolated(self) -> None:
+        store = MemoryConfigStore()
+        doc = {"a": [1, 2]}
+        store.save("k", doc)
+        doc["a"].append(3)  # caller mutation must not leak in
+        assert store.load("k") == {"a": [1, 2]}
+
+    def test_file_store_roundtrip(self, tmp_path) -> None:
+        store = FileConfigStore(tmp_path)
+        store.save("grid/main", {"x": 1})  # '/' sanitized
+        assert store.load("grid/main") == {"x": 1}
+        store2 = FileConfigStore(tmp_path)  # restart survives
+        assert store2.load("grid/main") == {"x": 1}
+        store2.delete("grid/main")
+        assert store2.load("grid/main") is None
+
+    def test_corrupt_file_ignored(self, tmp_path) -> None:
+        store = FileConfigStore(tmp_path)
+        (tmp_path / "bad.json").write_text("{nope")
+        assert store.load("bad") is None
+
+
+class TestPlotOrchestrator:
+    def _grid_spec(self) -> GridSpec:
+        return GridSpec(
+            name="main",
+            cells=(
+                GridCellSpec(
+                    geometry=CellGeometry(row=0, col=0), output="image"
+                ),
+            ),
+        )
+
+    def test_new_key_binds_and_commits_grid(self) -> None:
+        ds = DataService()
+        orch = PlotOrchestrator(data_service=ds)
+        orch.add_grid(self._grid_spec())
+        gen0 = orch.clock.grid_generation("main")
+        key = result_key(output="image")
+        ds.put(key, Timestamp.from_ns(0), array_1d())
+        assert orch.clock.grid_generation("main") > gen0
+        (cell,) = orch.grid("main").cells
+        assert key in cell.keys
+
+    def test_unmatched_key_does_not_commit(self) -> None:
+        ds = DataService()
+        orch = PlotOrchestrator(data_service=ds)
+        orch.add_grid(self._grid_spec())
+        gen0 = orch.clock.grid_generation("main")
+        ds.put(result_key(output="other"), Timestamp.from_ns(0), array_1d())
+        assert orch.clock.grid_generation("main") == gen0
+
+    def test_persistence_roundtrip(self, tmp_path) -> None:
+        store = FileConfigStore(tmp_path)
+        ds = DataService()
+        orch = PlotOrchestrator(data_service=ds, store=store)
+        orch.add_grid(self._grid_spec())
+        orch.add_cell(
+            "main",
+            GridCellSpec(geometry=CellGeometry(row=1, col=0), output="spec"),
+        )
+        # Fresh orchestrator on the same store: grids restored.
+        orch2 = PlotOrchestrator(data_service=DataService(), store=store)
+        grid = orch2.grid("main")
+        assert grid is not None
+        assert len(grid.cells) == 2
+        assert grid.cells[1].spec.output == "spec"
+
+    def test_pre_existing_data_binds_on_install(self) -> None:
+        ds = DataService()
+        key = result_key(output="image")
+        ds.put(key, Timestamp.from_ns(0), array_1d())
+        orch = PlotOrchestrator(data_service=ds)
+        grid = orch.add_grid(self._grid_spec())
+        assert key in grid.cells[0].keys
+
+    def test_remove_cell_persists(self, tmp_path) -> None:
+        store = FileConfigStore(tmp_path)
+        orch = PlotOrchestrator(data_service=DataService(), store=store)
+        orch.add_grid(self._grid_spec())
+        orch.remove_cell("main", 0)
+        orch2 = PlotOrchestrator(data_service=DataService(), store=store)
+        assert orch2.grid("main").cells == []
+
+    def test_template_seeding(self) -> None:
+        orch = PlotOrchestrator(
+            data_service=DataService(), instrument="dummy"
+        )
+        assert orch.grid("overview") is not None
+
+
+class TestNotificationQueue:
+    def test_cursor_semantics(self) -> None:
+        q = NotificationQueue()
+        q.info("one")
+        n2 = q.warning("two")
+        assert [n.message for n in q.since(0)] == ["one", "two"]
+        assert q.since(n2.seq) == []
+
+    def test_bounded(self) -> None:
+        q = NotificationQueue(max_items=3)
+        for i in range(10):
+            q.info(str(i))
+        assert [n.message for n in q.since(0)] == ["7", "8", "9"]
+
+
+class TestDerivedDevices:
+    def test_latest_value_wins(self) -> None:
+        reg = DerivedDeviceRegistry()
+        reg.on_device_value("mon_counts", 10.0, timestamp_ns=1)
+        reg.on_device_value("mon_counts", 20.0, timestamp_ns=2)
+        (dev,) = reg.devices()
+        assert dev.value == 20.0
+        assert not dev.is_stale
+
+
+class TestStreamManager:
+    def test_bind_pushes_extracted_values(self) -> None:
+        ds = DataService()
+        manager = StreamManager(data_service=ds)
+        key = result_key()
+        seen: list = []
+        manager.bind({key}, lambda k, v: seen.append((k, v)))
+        ds.put(key, Timestamp.from_ns(0), array_1d())
+        assert len(seen) == 1 and seen[0][0] == key
+
+    def test_close_unbinds(self) -> None:
+        ds = DataService()
+        manager = StreamManager(data_service=ds)
+        key = result_key()
+        seen: list = []
+        manager.bind({key}, lambda k, v: seen.append(v))
+        manager.close()
+        ds.put(key, Timestamp.from_ns(0), array_1d())
+        assert seen == []
+
+
+class TestSpecialtyPlotters:
+    def test_3d_selects_slicer_and_renders(self) -> None:
+        from esslivedata_tpu.dashboard.plots import (
+            SlicerPlotter,
+            plotter_registry,
+            render_png,
+        )
+
+        da = DataArray(
+            Variable(np.random.rand(4, 8, 8), ("z", "y", "x"), "counts")
+        )
+        assert isinstance(plotter_registry.select(da), SlicerPlotter)
+        png = render_png(da)
+        assert png[:8] == b"\x89PNG\r\n\x1a\n"
+
+    def test_correlation_render(self) -> None:
+        from esslivedata_tpu.dashboard.plots import render_correlation_png
+
+        def series(values, times):
+            return DataArray(
+                Variable(np.asarray(values, float), ("time",), "K"),
+                coords={
+                    "time": Variable(
+                        np.asarray(times, np.int64), ("time",), "ns"
+                    )
+                },
+                name="s",
+            )
+
+        png = render_correlation_png(
+            series([1, 2, 3], [10, 20, 30]), series([5, 6], [10, 25])
+        )
+        assert png[:8] == b"\x89PNG\r\n\x1a\n"
+
+    def test_table_render(self) -> None:
+        from esslivedata_tpu.dashboard.plots import TablePlotter, render_png
+        import matplotlib.pyplot as plt
+
+        da = DataArray(Variable(np.array([1.5, 2.5]), ("item",), "counts"))
+        fig, ax = plt.subplots()
+        try:
+            TablePlotter().plot(ax, da)
+        finally:
+            plt.close(fig)
