@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Strip a full NeXus file to a geometry-only artifact (reference:
+scripts/make_geometry_nexus.py): keeps instrument structure, detector
+geometry (detector_number, pixel offsets, transformations), choppers,
+source/moderator; drops event data and truncates every NXlog to length 0
+so dynamic transforms stay patchable but the file is small.
+
+Usage: python scripts/make_geometry_nexus.py input.nxs output.nxs
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+sys.path.insert(0, "src")
+
+import h5py
+import numpy as np
+
+#: Dataset names that are bulk event payloads, dropped outright.
+_EVENT_DATASETS = {
+    "event_id",
+    "event_index",
+    "event_time_offset",
+    "event_time_zero",
+}
+
+
+def _copy(src: h5py.Group, dst: h5py.Group) -> None:
+    for name, attr in src.attrs.items():
+        dst.attrs[name] = attr
+    nx_class = src.attrs.get("NX_class", b"")
+    nx_class = nx_class.decode() if isinstance(nx_class, bytes) else nx_class
+    for name, item in src.items():
+        if isinstance(item, h5py.Group):
+            child_class = item.attrs.get("NX_class", b"")
+            if isinstance(child_class, bytes):
+                child_class = child_class.decode()
+            if child_class == "NXevent_data":
+                continue  # bulk events: gone
+            sub = dst.create_group(name)
+            _copy(item, sub)
+        elif isinstance(item, h5py.Dataset):
+            if name in _EVENT_DATASETS:
+                continue
+            if nx_class == "NXlog" and name in ("time", "value"):
+                # Length-0 placeholder with preserved dtype+attrs so
+                # dynamic-transform patching still finds the field.
+                ds = dst.create_dataset(
+                    name,
+                    shape=(0,) + item.shape[1:],
+                    maxshape=(None,) + item.shape[1:],
+                    dtype=item.dtype,
+                )
+            else:
+                ds = dst.create_dataset(name, data=item[()])
+            for aname, attr in item.attrs.items():
+                ds.attrs[aname] = attr
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("input")
+    parser.add_argument("output")
+    args = parser.parse_args()
+    with h5py.File(args.input, "r") as src, h5py.File(args.output, "w") as dst:
+        _copy(src, dst)
+    print(f"geometry artifact written: {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

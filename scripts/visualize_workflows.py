@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Render the registered workflow specs as a Graphviz DOT graph
+(reference: scripts/visualize_workflows.py). Emits DOT text (stdout or
+--output); pipe through ``dot -Tsvg`` to render.
+
+Usage: python scripts/visualize_workflows.py --instrument dummy [-o out.dot]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+sys.path.insert(0, "src")
+
+
+def build_dot(instrument: str) -> str:
+    from esslivedata_tpu.config.instrument import instrument_registry
+    from esslivedata_tpu.config.route_derivation import spec_service
+    from esslivedata_tpu.workflows.workflow_factory import workflow_registry
+
+    inst = instrument_registry[instrument]
+    inst.load_factories()
+    lines = [
+        "digraph workflows {",
+        "  rankdir=LR;",
+        '  node [fontname="Helvetica", fontsize=10];',
+    ]
+    for spec in workflow_registry.specs_for_instrument(instrument):
+        wid = str(spec.identifier)
+        service = spec_service(spec)
+        lines.append(
+            f'  "{wid}" [shape=box, style=filled, fillcolor=lightblue, '
+            f'label="{spec.title or spec.name}\\n[{service}]"];'
+        )
+        for source in spec.source_names:
+            lines.append(f'  "src:{source}" [shape=ellipse, label="{source}"];')
+            lines.append(f'  "src:{source}" -> "{wid}";')
+        for key in spec.context_keys:
+            lines.append(
+                f'  "ctx:{key}" [shape=ellipse, style=dashed, label="{key}"];'
+            )
+            lines.append(f'  "ctx:{key}" -> "{wid}" [style=dashed];')
+        for output in spec.outputs or {"output": None}:
+            lines.append(
+                f'  "{wid}:{output}" [shape=note, label="{output}"];'
+            )
+            lines.append(f'  "{wid}" -> "{wid}:{output}";')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--instrument", "-i", required=True)
+    parser.add_argument("--output", "-o", default="")
+    args = parser.parse_args()
+    dot = build_dot(args.instrument)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(dot)
+    else:
+        print(dot)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

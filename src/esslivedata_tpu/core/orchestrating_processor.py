@@ -163,6 +163,9 @@ class OrchestratingProcessor:
         self._last_batch_len = 0
         self._finalized = False
         self.last_lag_report = StreamLagReport()
+        from ..utils.profiling import StageTimer
+
+        self.stage_timer = StageTimer()
 
     # -- cycle ------------------------------------------------------------
     def process(self) -> None:
@@ -206,15 +209,18 @@ class OrchestratingProcessor:
 
     def _process_batch(self, batch) -> None:
         self._last_batch_len = len(batch.messages)
-        self._preprocessor.preprocess(batch.messages)
-        window = self._preprocessor.collect_window()
-        context = self._preprocessor.collect_context()
+        with self.stage_timer.stage("preprocess"):
+            self._preprocessor.preprocess(batch.messages)
+            window = self._preprocessor.collect_window()
+            context = self._preprocessor.collect_context()
         self._record_lag(batch)
-        results = self._job_manager.process_jobs(
-            window, context=context, start=batch.start, end=batch.end
-        )
+        with self.stage_timer.stage("process_jobs"):
+            results = self._job_manager.process_jobs(
+                window, context=context, start=batch.start, end=batch.end
+            )
         try:
-            self._publish_results(results, batch.end)
+            with self.stage_timer.stage("publish"):
+                self._publish_results(results, batch.end)
         finally:
             self._preprocessor.release()
 
@@ -307,6 +313,8 @@ class OrchestratingProcessor:
             if lag_report is not None:
                 self.last_lag_report = lag_report
                 extra["producer_lag_level"] = lag_report.worst_level
+        if stages := self.stage_timer.drain():
+            extra["stages"] = stages
         logger.info("processor_metrics", extra=extra)
 
     def finalize(self) -> None:

@@ -59,7 +59,14 @@ class MemoryConfigStore:
 
 
 class FileConfigStore:
-    """One JSON file per key under ``root`` (sanitized filenames)."""
+    """One JSON file per key under ``root``.
+
+    Filenames are sanitized for the filesystem, but the *original* key is
+    persisted inside the document (``__key__`` envelope) so ``keys()``
+    returns exact keys after a restart and two distinct keys that sanitize
+    identically are detected as a collision rather than silently
+    clobbering each other.
+    """
 
     def __init__(self, root: str | Path) -> None:
         self._root = Path(root)
@@ -72,31 +79,55 @@ class FileConfigStore:
             raise ValueError(f"Config key {key!r} sanitizes to empty")
         return self._root / f"{safe}.json"
 
+    def _read(self, path: Path) -> tuple[str, dict[str, Any]] | None:
+        try:
+            envelope = json.loads(path.read_text())
+            return envelope["__key__"], envelope["doc"]
+        except FileNotFoundError:
+            return None
+        except (json.JSONDecodeError, KeyError, TypeError):
+            logger.warning("Corrupt config file %s ignored", path)
+            return None
+
     def load(self, key: str) -> dict[str, Any] | None:
-        path = self._path(key)
         with self._lock:
-            try:
-                return json.loads(path.read_text())
-            except FileNotFoundError:
+            entry = self._read(self._path(key))
+            if entry is None:
                 return None
-            except json.JSONDecodeError:
-                logger.warning("Corrupt config file %s ignored", path)
-                return None
+            stored_key, doc = entry
+            return doc if stored_key == key else None
 
     def save(self, key: str, value: dict[str, Any]) -> None:
         path = self._path(key)
         with self._lock:
+            existing = self._read(path)
+            if existing is not None and existing[0] != key:
+                raise ValueError(
+                    f"Config keys {existing[0]!r} and {key!r} collide on "
+                    f"file {path.name}"
+                )
             tmp = path.with_suffix(".json.tmp")
-            tmp.write_text(json.dumps(value, indent=2, sort_keys=True))
+            tmp.write_text(
+                json.dumps(
+                    {"__key__": key, "doc": value}, indent=2, sort_keys=True
+                )
+            )
             tmp.replace(path)
 
     def delete(self, key: str) -> None:
         with self._lock:
-            self._path(key).unlink(missing_ok=True)
+            entry = self._read(self._path(key))
+            if entry is not None and entry[0] == key:
+                self._path(key).unlink(missing_ok=True)
 
     def keys(self) -> list[str]:
         with self._lock:
-            return sorted(p.stem for p in self._root.glob("*.json"))
+            out = []
+            for path in self._root.glob("*.json"):
+                entry = self._read(path)
+                if entry is not None:
+                    out.append(entry[0])
+            return sorted(out)
 
 
 class ConfigStoreManager:

@@ -192,9 +192,13 @@ def render_correlation_png(
     vy = np.atleast_1d(np.asarray(y_series.values, dtype=np.float64))
     if tx.size == 0 or ty.size == 0:
         raise ValueError("correlation needs non-empty series")
-    # Align y onto x's timestamps: last y sample at-or-before each x time.
-    idx = np.clip(np.searchsorted(ty, tx, side="right") - 1, 0, ty.size - 1)
-    aligned_y = vy[idx]
+    # Align y onto x's timestamps: last y sample at-or-before each x time;
+    # x samples older than every y sample have no partner and are dropped
+    # (pairing them with a future y would fabricate correlation).
+    idx = np.searchsorted(ty, tx, side="right") - 1
+    has_partner = idx >= 0
+    vx = vx[has_partner]
+    aligned_y = vy[idx[has_partner]]
     with _render_lock:
         fig, ax = plt.subplots(figsize=figsize, dpi=dpi)
         try:

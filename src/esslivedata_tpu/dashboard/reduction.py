@@ -34,7 +34,14 @@ def main(argv: list[str] | None = None) -> int:
     )
     parser.set_defaults(**get_env_defaults(parser))
     args = parser.parse_args(argv)
-    logging.basicConfig(level=args.log_level)
+    from ..logging_config import configure_logging
+
+    configure_logging(
+        level=getattr(logging, str(args.log_level).upper(), logging.INFO)
+        if isinstance(args.log_level, str)
+        else args.log_level,
+        json_file=getattr(args, "log_json_file", None),
+    )
 
     if args.instrument not in instrument_registry:
         parser.error(

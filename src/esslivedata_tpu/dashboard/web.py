@@ -194,7 +194,17 @@ class PlotHandler(_Base):
         if self.get_argument("plotter", "") == "table":
             plotter = TablePlotter()
         elif slice_arg is not None and data.data.ndim == 3:
-            plotter = SlicerPlotter(index=int(slice_arg))
+            try:
+                index = int(slice_arg)
+                if not 0 <= index < data.shape[0]:
+                    raise ValueError(slice_arg)
+            except ValueError:
+                self.set_status(400)
+                self.write_json(
+                    {"error": f"slice must be in [0, {data.shape[0]})"}
+                )
+                return
+            plotter = SlicerPlotter(index=index)
         try:
             png = render_png(data, title=title, plotter=plotter)
         except Exception:
@@ -339,7 +349,12 @@ class GridsHandler(_Base):
 
 class NotificationsHandler(_Base):
     def get(self) -> None:
-        since = int(self.get_query_argument("since", "0"))
+        try:
+            since = int(self.get_query_argument("since", "0"))
+        except ValueError:
+            self.set_status(400)
+            self.write_json({"error": "since must be an integer"})
+            return
         self.write_json(
             {
                 "notifications": [

@@ -240,3 +240,41 @@ class TestSpecialtyPlotters:
             TablePlotter().plot(ax, da)
         finally:
             plt.close(fig)
+
+
+class TestFileStoreKeyFidelity:
+    def test_exact_keys_after_restart(self, tmp_path) -> None:
+        store = FileConfigStore(tmp_path)
+        store.save("detector view", {"x": 1})
+        store2 = FileConfigStore(tmp_path)
+        assert store2.keys() == ["detector view"]
+        assert store2.load("detector view") == {"x": 1}
+
+    def test_sanitization_collision_detected(self, tmp_path) -> None:
+        store = FileConfigStore(tmp_path)
+        store.save("a/b", {"x": 1})
+        with pytest.raises(ValueError, match="collide"):
+            store.save("a_b", {"x": 2})
+        assert store.load("a_b") is None  # distinct key, not a/b's doc
+
+
+class TestCorrelationAlignment:
+    def test_x_without_older_y_dropped(self) -> None:
+        import numpy as np
+        from esslivedata_tpu.dashboard.plots import render_correlation_png
+        from esslivedata_tpu.utils.labeled import DataArray, Variable
+
+        def series(values, times):
+            return DataArray(
+                Variable(np.asarray(values, float), ("time",), "K"),
+                coords={"time": Variable(np.asarray(times, np.int64), ("time",), "ns")},
+                name="s",
+            )
+
+        # y starts after x's first two samples: they must not fabricate
+        # pairs with future y values (just assert it renders; the masking
+        # logic is unit-visible through no exception with empty overlap).
+        png = render_correlation_png(
+            series([1, 2, 3], [5, 15, 25]), series([9], [20])
+        )
+        assert png[:8] == b"\x89PNG\r\n\x1a\n"

@@ -1,0 +1,167 @@
+"""Tests: logging config, parameter models, nexus helpers, profiling,
+workflow visualization."""
+
+from __future__ import annotations
+
+import json
+import logging
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.logging_config import configure_logging
+from esslivedata_tpu.parameter_models import (
+    Angle,
+    EdgesModel,
+    RangeModel,
+    Scale,
+    parse_number_list,
+)
+from esslivedata_tpu.utils.profiling import StageTimer
+
+
+class TestLoggingConfig:
+    def test_json_file_output_with_extras(self, tmp_path) -> None:
+        log_file = tmp_path / "svc.log"
+        configure_logging(json_file=str(log_file), disable_stdout=True)
+        try:
+            logging.getLogger("test.svc").info(
+                "batch_processed", extra={"n_events": 1234, "batch_s": 0.5}
+            )
+            for handler in logging.getLogger().handlers:
+                handler.flush()
+            (line,) = log_file.read_text().strip().splitlines()
+            payload = json.loads(line)
+            assert payload["event"] == "batch_processed"
+            assert payload["n_events"] == 1234
+            assert payload["level"] == "info"
+        finally:
+            configure_logging(disable_stdout=True)  # detach file handler
+
+    def test_console_keyvalue_format(self, capsys) -> None:
+        configure_logging()
+        try:
+            logging.getLogger("kv").warning("lagging", extra={"lag_s": 2.5})
+            out = capsys.readouterr().out
+            assert "lagging" in out and "lag_s=2.5" in out
+        finally:
+            configure_logging(disable_stdout=True)
+
+
+class TestParameterModels:
+    def test_parse_number_list(self) -> None:
+        assert parse_number_list("6.2, 9.8, 13") == [6.2, 9.8, 13.0]
+        assert parse_number_list("  ") == []
+        with pytest.raises(ValueError):
+            parse_number_list("1, x")
+        with pytest.raises(ValueError):
+            parse_number_list("true, 1")
+
+    def test_range_validation(self) -> None:
+        with pytest.raises(ValueError, match="greater than start"):
+            RangeModel(start=5.0, stop=1.0)
+
+    def test_edges_linear_and_log(self) -> None:
+        lin = EdgesModel(start=0.0, stop=10.0, num_bins=5)
+        np.testing.assert_allclose(lin.get_edges(), np.linspace(0, 10, 6))
+        log = EdgesModel(start=1.0, stop=100.0, num_bins=2, scale=Scale.LOG)
+        np.testing.assert_allclose(log.get_edges(), [1.0, 10.0, 100.0])
+        with pytest.raises(ValueError, match="positive"):
+            EdgesModel(start=0.0, stop=1.0, scale=Scale.LOG)
+
+    def test_angle_conversion(self) -> None:
+        assert Angle(value=np.pi, unit="rad").get_degrees() == pytest.approx(180.0)
+
+
+class TestNexusHelpers:
+    @pytest.fixture()
+    def nexus_file(self, tmp_path):
+        import h5py
+
+        path = tmp_path / "geom.nxs"
+        with h5py.File(path, "w") as f:
+            entry = f.create_group("entry")
+            entry.attrs["NX_class"] = "NXentry"
+            inst = entry.create_group("instrument")
+            inst.attrs["NX_class"] = "NXinstrument"
+            det = inst.create_group("panel")
+            det.attrs["NX_class"] = "NXdetector"
+            det.create_dataset(
+                "detector_number", data=np.arange(1, 5).reshape(2, 2)
+            )
+            det.create_dataset(
+                "x_pixel_offset", data=np.array([[0.0, 0.1], [0.0, 0.1]])
+            )
+            det.create_dataset(
+                "y_pixel_offset", data=np.array([[0.0, 0.0], [0.1, 0.1]])
+            )
+            trans = det.create_group("transformations")
+            trans.attrs["NX_class"] = "NXtransformations"
+            t1 = trans.create_dataset("t1", data=np.array([5.0]))
+            t1.attrs["transformation_type"] = "translation"
+            t1.attrs["vector"] = (0.0, 0.0, 1.0)
+            t1.attrs["depends_on"] = "."
+            det.create_dataset(
+                "depends_on",
+                data=b"/entry/instrument/panel/transformations/t1",
+            )
+            log = inst.create_group("motor_x")
+            log.attrs["NX_class"] = "NXlog"
+            log.attrs["topic"] = "inst_motion"
+            log.attrs["source"] = "MTR1.RBV"
+            value = log.create_dataset("value", data=np.zeros(1))
+            value.attrs["units"] = "mm"
+        return str(path)
+
+    def test_find_streamed_groups(self, nexus_file) -> None:
+        from esslivedata_tpu.nexus_helpers import find_streamed_groups
+
+        (group,) = find_streamed_groups(nexus_file)
+        assert group.nexus_path == "entry/instrument/motor_x"
+        assert group.topic == "inst_motion"
+        assert group.source == "MTR1.RBV"
+        assert group.units == "mm"
+
+    def test_load_detector_geometry_applies_chain(self, nexus_file) -> None:
+        from esslivedata_tpu.nexus_helpers import load_detector_geometry
+
+        positions, det = load_detector_geometry(
+            nexus_file, "entry/instrument/panel"
+        )
+        assert positions.shape == (4, 3)
+        np.testing.assert_array_equal(det, [1, 2, 3, 4])
+        # Translated 5 m along z by the depends_on chain.
+        np.testing.assert_allclose(positions[:, 2], 5.0)
+        np.testing.assert_allclose(positions[1], [0.1, 0.0, 5.0])
+
+
+class TestStageTimer:
+    def test_stage_accounting(self) -> None:
+        timer = StageTimer()
+        with timer.stage("decode"):
+            pass
+        with timer.stage("decode"):
+            pass
+        report = timer.drain()
+        assert report["decode"]["count"] == 2
+        assert report["decode"]["mean_ms"] >= 0
+        assert timer.drain() == {}  # reset after drain
+
+
+class TestVisualizeWorkflows:
+    def test_dot_output(self) -> None:
+        import importlib.util
+        from pathlib import Path
+
+        script = (
+            Path(__file__).resolve().parents[2]
+            / "scripts"
+            / "visualize_workflows.py"
+        )
+        spec = importlib.util.spec_from_file_location("vw", script)
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        dot = module.build_dot("dummy")
+        assert dot.startswith("digraph workflows")
+        assert "panel_view" in dot
+        assert "src:panel_0" in dot
