@@ -82,12 +82,22 @@ class FileConfigStore:
     def _read(self, path: Path) -> tuple[str, dict[str, Any]] | None:
         try:
             envelope = json.loads(path.read_text())
-            return envelope["__key__"], envelope["doc"]
         except FileNotFoundError:
             return None
-        except (json.JSONDecodeError, KeyError, TypeError):
+        except json.JSONDecodeError:
             logger.warning("Corrupt config file %s ignored", path)
             return None
+        if (
+            isinstance(envelope, dict)
+            and "__key__" in envelope
+            and "doc" in envelope
+        ):
+            return envelope["__key__"], envelope["doc"]
+        if isinstance(envelope, dict):
+            # Pre-envelope file: the sanitized stem is the best-known key.
+            return path.stem, envelope
+        logger.warning("Corrupt config file %s ignored", path)
+        return None
 
     def load(self, key: str) -> dict[str, Any] | None:
         with self._lock:
@@ -116,9 +126,12 @@ class FileConfigStore:
 
     def delete(self, key: str) -> None:
         with self._lock:
-            entry = self._read(self._path(key))
-            if entry is not None and entry[0] == key:
-                self._path(key).unlink(missing_ok=True)
+            path = self._path(key)
+            entry = self._read(path)
+            # Unlink unless the file verifiably belongs to a *different*
+            # key — corrupt/legacy files must stay deletable.
+            if entry is None or entry[0] == key:
+                path.unlink(missing_ok=True)
 
     def keys(self) -> list[str]:
         with self._lock:

@@ -37,10 +37,7 @@ def main(argv: list[str] | None = None) -> int:
     from ..logging_config import configure_logging
 
     configure_logging(
-        level=getattr(logging, str(args.log_level).upper(), logging.INFO)
-        if isinstance(args.log_level, str)
-        else args.log_level,
-        json_file=getattr(args, "log_json_file", None),
+        level=args.log_level, json_file=getattr(args, "log_json_file", None)
     )
 
     if args.instrument not in instrument_registry:

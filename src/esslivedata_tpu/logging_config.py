@@ -70,12 +70,20 @@ class JsonLinesFormatter(logging.Formatter):
 
 def configure_logging(
     *,
-    level: int = logging.INFO,
+    level: int | str = logging.INFO,
     json_file: str | None = None,
     disable_stdout: bool = False,
 ) -> None:
-    """Configure root logging: pretty console and/or rotating JSON file."""
+    """Configure root logging: pretty console and/or rotating JSON file.
+
+    ``level`` accepts a name ('info', 'DEBUG') or a numeric level — CLI
+    entry points pass their --log-level string straight through.
+    """
+    if isinstance(level, str):
+        level = getattr(logging, level.upper(), logging.INFO)
     root = logging.getLogger()
+    for handler in root.handlers:
+        handler.close()  # release file descriptors on reconfiguration
     root.handlers.clear()
     if not disable_stdout:
         console = logging.StreamHandler(sys.stdout)

@@ -86,18 +86,36 @@ def find_streamed_groups(filename: str) -> list[StreamedGroup]:
     return out
 
 
-def _transform_matrix(dataset) -> np.ndarray:
-    """4x4 matrix for one NXtransformations dataset (value + attrs)."""
-    value = float(np.atleast_1d(dataset[()])[-1])
-    kind = _attr(dataset, "transformation_type")
-    vector = np.asarray(
-        dataset.attrs.get("vector", (0.0, 0.0, 1.0)), dtype=float
-    )
+def _transform_matrix(node) -> np.ndarray:
+    """4x4 matrix for one NXtransformations entry (value + attrs).
+
+    ``node`` may be a dataset or an NXlog *group* (motion-controlled
+    transform): for a group the samples come from its ``value`` dataset
+    while transformation attrs are looked up on the group first, then the
+    dataset. An empty value — the length-0 placeholder written by
+    make_geometry_nexus.py — contributes magnitude 0 (identity modulo
+    offset) so geometry artifacts load before any live motor value.
+    """
+    if hasattr(node, "keys") and "value" in node:  # NXlog group
+        group, dataset = node, node["value"]
+    else:
+        group, dataset = None, node
+
+    def attr(name: str, default=None):
+        for host in (group, dataset):
+            if host is not None and name in host.attrs:
+                return host.attrs[name]
+        return default
+
+    raw = np.atleast_1d(dataset[()])
+    value = float(raw[-1]) if raw.size else 0.0
+    kind = attr("transformation_type")
+    if isinstance(kind, bytes):
+        kind = kind.decode()
+    vector = np.asarray(attr("vector", (0.0, 0.0, 1.0)), dtype=float)
     norm = np.linalg.norm(vector)
     vector = vector / norm if norm else vector
-    offset = np.asarray(
-        dataset.attrs.get("offset", (0.0, 0.0, 0.0)), dtype=float
-    )
+    offset = np.asarray(attr("offset", (0.0, 0.0, 0.0)), dtype=float)
     m = np.eye(4)
     if kind == "translation":
         m[:3, 3] = vector * value
@@ -117,10 +135,16 @@ def _transform_matrix(dataset) -> np.ndarray:
     return m
 
 
-def resolve_depends_on(f, start: str) -> np.ndarray:
-    """Compose the depends_on chain starting at dataset path ``start``
-    into one 4x4 matrix (root-most applied last, per the NeXus spec)."""
+def resolve_depends_on(f, start: str, *, base: str = "") -> np.ndarray:
+    """Compose the depends_on chain starting at ``start`` into one 4x4
+    matrix (root-most applied last, per the NeXus spec).
+
+    A relative ``start`` (no leading '/') resolves against ``base`` — the
+    group that declared it — matching the NeXus relative-target rule.
+    """
     m = np.eye(4)
+    if not start.startswith("/") and base:
+        start = f"{base.rstrip('/')}/{start}"
     path = start
     seen: set[str] = set()
     while path and path != ".":
@@ -170,6 +194,6 @@ def load_detector_geometry(
             target = depends[()]
             target = target.decode() if isinstance(target, bytes) else target
             if target and target != ".":
-                m = resolve_depends_on(f, target)
+                m = resolve_depends_on(f, target, base=detector_path)
                 local = local @ m[:3, :3].T + m[:3, 3]
     return local, det.reshape(-1)

@@ -169,12 +169,7 @@ class DataServiceRunner:
         args = parser.parse_args(argv)
         from ..logging_config import configure_logging
 
-        configure_logging(
-            level=getattr(logging, str(args.log_level).upper(), logging.INFO)
-            if isinstance(args.log_level, str)
-            else args.log_level,
-            json_file=args.log_json_file,
-        )
+        configure_logging(level=args.log_level, json_file=args.log_json_file)
 
         from ..config.instrument import instrument_registry as registry
 

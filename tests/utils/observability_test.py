@@ -165,3 +165,55 @@ class TestVisualizeWorkflows:
         assert dot.startswith("digraph workflows")
         assert "panel_view" in dot
         assert "src:panel_0" in dot
+
+
+class TestNexusHelpersEdgeCases:
+    def test_relative_depends_on_and_nxlog_transform(self, tmp_path) -> None:
+        import h5py
+        from esslivedata_tpu.nexus_helpers import load_detector_geometry
+
+        path = tmp_path / "rel.nxs"
+        with h5py.File(path, "w") as f:
+            det = f.create_group("entry/instrument/panel")
+            det.attrs["NX_class"] = "NXdetector"
+            det.create_dataset("detector_number", data=np.array([1, 2]))
+            det.create_dataset("x_pixel_offset", data=np.array([0.0, 0.1]))
+            trans = det.create_group("transformations")
+            # NXlog-style motion transform with EMPTY value (the
+            # make_geometry_nexus placeholder): contributes magnitude 0.
+            log = trans.create_group("height")
+            log.attrs["NX_class"] = "NXlog"
+            log.attrs["transformation_type"] = "translation"
+            log.attrs["vector"] = (0.0, 1.0, 0.0)
+            log.attrs["depends_on"] = "z_shift"
+            log.create_dataset("value", shape=(0,), maxshape=(None,))
+            z = trans.create_dataset("z_shift", data=np.array([3.0]))
+            z.attrs["transformation_type"] = "translation"
+            z.attrs["vector"] = (0.0, 0.0, 1.0)
+            z.attrs["depends_on"] = "."
+            # Relative depends_on target from the detector group.
+            det.create_dataset("depends_on", data=b"transformations/height")
+        positions, ids = load_detector_geometry(
+            str(path), "entry/instrument/panel"
+        )
+        np.testing.assert_allclose(positions[:, 2], 3.0)
+        np.testing.assert_allclose(positions[:, 1], 0.0)
+
+
+class TestConfigStoreLegacy:
+    def test_legacy_unenveloped_file_still_loads(self, tmp_path) -> None:
+        import json as _json
+        from esslivedata_tpu.dashboard.config_store import FileConfigStore
+
+        (tmp_path / "old_grid.json").write_text(_json.dumps({"nrows": 2}))
+        store = FileConfigStore(tmp_path)
+        assert store.load("old_grid") == {"nrows": 2}
+        assert "old_grid" in store.keys()
+
+    def test_corrupt_file_deletable(self, tmp_path) -> None:
+        from esslivedata_tpu.dashboard.config_store import FileConfigStore
+
+        (tmp_path / "bad.json").write_text("{nope")
+        store = FileConfigStore(tmp_path)
+        store.delete("bad")
+        assert not (tmp_path / "bad.json").exists()
