@@ -56,6 +56,110 @@ def bench_numpy_baseline(
     return n * reps / dt
 
 
+def bench_secondary_configs(args, edges, batches, method: str) -> None:
+    """BASELINE configs 1/3/4/5 (config 2 is the headline measurement).
+
+    1: dummy 1-D TOF monitor histogram; 3: 9-bank multibank (sharded when
+    >1 device, else bank-LUT single chip); 4: monitor-normalized output
+    per step; 5: exponential-decay rolling window. Reported on stderr.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from esslivedata_tpu.ops import EventHistogrammer
+
+    def timed(label: str, hist, step=None, post=None, **extra) -> None:
+        """One warmed, timed loop; ``step(state, batch)`` defaults to the
+        single-chip API, ``post(state)`` optionally adds per-step work
+        (e.g. monitor normalization) kept on device."""
+        step = step or (lambda s, b: hist.step(s, b))
+        state = hist.init_state()
+        state = step(state, batches[0])
+        state.window.block_until_ready()
+        start = time.perf_counter()
+        for i in range(args.batches):
+            state = step(state, batches[i % len(batches)])
+            if post is not None:
+                last = post(state)
+        state.window.block_until_ready()
+        if post is not None:
+            last.block_until_ready()
+        dt = time.perf_counter() - start
+        print(
+            json.dumps(
+                {
+                    "metric": label,
+                    "value": args.events * args.batches / dt,
+                    "unit": "events/s",
+                    **extra,
+                }
+            ),
+            file=sys.stderr,
+        )
+
+    # Config 1: 1-D monitor histogram (single screen row, 1000 bins).
+    edges_1d = np.linspace(0.0, 71_000_000.0, 1001)
+    timed(
+        "config1_monitor_1d_tof_histogram",
+        EventHistogrammer(toa_edges=edges_1d, n_screen=1, method=method),
+    )
+
+    # Config 3: 9-bank multibank view.
+    n_banks, per_bank = 9, 1 + (args.pixels - 1) // 9
+    bank_lut = (np.arange(args.pixels, dtype=np.int32) // per_bank).astype(
+        np.int32
+    )
+    if len(jax.devices()) > 1:
+        from esslivedata_tpu.parallel import ShardedHistogrammer, make_mesh
+
+        n_dev = len(jax.devices())
+        bank_axis = 3 if n_dev % 3 == 0 else 1
+        mesh = make_mesh(n_dev, data=n_dev // bank_axis, bank=bank_axis)
+        # Screen rows = banks, padded up to a multiple of the bank axis.
+        n_screen = -(-n_banks // bank_axis) * bank_axis
+        sharded = ShardedHistogrammer(
+            toa_edges=edges,
+            n_screen=n_screen,
+            mesh=mesh,
+            pixel_lut=bank_lut,
+        )
+        timed(
+            "config3_multibank_sharded",
+            sharded,
+            step=lambda s, b: sharded.step(s, b.pixel_id, b.toa),
+            devices=n_dev,
+        )
+    else:
+        timed(
+            "config3_multibank_single_chip",
+            EventHistogrammer(
+                toa_edges=edges,
+                n_screen=n_banks,
+                pixel_lut=bank_lut,
+                method=method,
+            ),
+        )
+
+    # Config 4: monitor-normalized output computed per step (on device —
+    # the normalized array is the job's published output, not a host read).
+    monitor_total = jnp.asarray(1.0e4)
+    timed(
+        "config4_monitor_normalized",
+        EventHistogrammer(
+            toa_edges=edges, n_screen=args.pixels, method=method
+        ),
+        post=lambda s: s.window / monitor_total,
+    )
+
+    # Config 5: exponential-decay rolling window.
+    timed(
+        "config5_decay_window",
+        EventHistogrammer(
+            toa_edges=edges, n_screen=args.pixels, decay=0.95, method=method
+        ),
+    )
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--events", type=int, default=1 << 22)  # 4M per batch
@@ -64,6 +168,12 @@ def main() -> None:
     parser.add_argument("--toa-bins", type=int, default=100)
     parser.add_argument(
         "--method", default="auto", choices=["auto", "scatter", "sort"]
+    )
+    parser.add_argument(
+        "--all",
+        action="store_true",
+        help="Also measure BASELINE configs 1/3/4/5 (reported on stderr; "
+        "stdout stays the single headline JSON line)",
     )
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args()
@@ -131,6 +241,9 @@ def main() -> None:
             f"WARNING: histogram total {total} != expected {expected}",
             file=sys.stderr,
         )
+
+    if args.all:
+        bench_secondary_configs(args, edges, batches, method)
 
     pid, toa = make_batch(args.events, args.pixels, seed=99)
     baseline = bench_numpy_baseline(pid, toa, args.pixels, args.toa_bins, lo, hi)

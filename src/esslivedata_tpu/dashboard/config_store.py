@@ -79,7 +79,11 @@ class FileConfigStore:
             raise ValueError(f"Config key {key!r} sanitizes to empty")
         return self._root / f"{safe}.json"
 
-    def _read(self, path: Path) -> tuple[str, dict[str, Any]] | None:
+    def _read(
+        self, path: Path
+    ) -> tuple[str, dict[str, Any], bool] | None:
+        """(key, doc, legacy). Legacy = pre-envelope file: its original key
+        is unknown, the sanitized stem is the best available name."""
         try:
             envelope = json.loads(path.read_text())
         except FileNotFoundError:
@@ -92,10 +96,9 @@ class FileConfigStore:
             and "__key__" in envelope
             and "doc" in envelope
         ):
-            return envelope["__key__"], envelope["doc"]
+            return envelope["__key__"], envelope["doc"], False
         if isinstance(envelope, dict):
-            # Pre-envelope file: the sanitized stem is the best-known key.
-            return path.stem, envelope
+            return path.stem, envelope, True
         logger.warning("Corrupt config file %s ignored", path)
         return None
 
@@ -104,14 +107,20 @@ class FileConfigStore:
             entry = self._read(self._path(key))
             if entry is None:
                 return None
-            stored_key, doc = entry
-            return doc if stored_key == key else None
+            stored_key, doc, legacy = entry
+            # A legacy file matches any key that sanitizes onto it (its
+            # true key is unknowable), an enveloped file only its own.
+            return doc if legacy or stored_key == key else None
 
     def save(self, key: str, value: dict[str, Any]) -> None:
         path = self._path(key)
         with self._lock:
             existing = self._read(path)
-            if existing is not None and existing[0] != key:
+            if (
+                existing is not None
+                and not existing[2]  # legacy files are overwritable
+                and existing[0] != key
+            ):
                 raise ValueError(
                     f"Config keys {existing[0]!r} and {key!r} collide on "
                     f"file {path.name}"
@@ -129,8 +138,8 @@ class FileConfigStore:
             path = self._path(key)
             entry = self._read(path)
             # Unlink unless the file verifiably belongs to a *different*
-            # key — corrupt/legacy files must stay deletable.
-            if entry is None or entry[0] == key:
+            # key — corrupt and legacy files must stay deletable.
+            if entry is None or entry[2] or entry[0] == key:
                 path.unlink(missing_ok=True)
 
     def keys(self) -> list[str]:

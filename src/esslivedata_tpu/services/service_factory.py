@@ -206,7 +206,12 @@ class DataServiceRunner:
                 "enable.auto.commit": False,
             }
         )
-        consumer.subscribe(builder.topics)
+        # Manual assignment pinned at the high watermark — never subscribe:
+        # no group rebalancing, no offset commits; a restarted service
+        # resumes at live data (kafka/consumer.py, reference consumer.py:31).
+        from ..kafka.consumer import assign_all_partitions
+
+        assign_all_partitions(consumer, builder.topics)
         producer = Producer({"bootstrap.servers": args.kafka_bootstrap})
         service = builder.from_consumer(consumer, producer)
         service.start(blocking=True)

@@ -1,0 +1,65 @@
+"""Tests for manual partition assignment (kafka/consumer.py)."""
+
+from __future__ import annotations
+
+import pytest
+
+from esslivedata_tpu.kafka.consumer import (
+    assign_all_partitions,
+    validate_topics_exist,
+)
+
+
+class FakeTopicMetadata:
+    def __init__(self, n_partitions: int) -> None:
+        self.partitions = dict.fromkeys(range(n_partitions))
+
+
+class FakeClusterMetadata:
+    def __init__(self, topics: dict[str, int]) -> None:
+        self.topics = {
+            name: FakeTopicMetadata(n) for name, n in topics.items()
+        }
+
+
+class FakeConsumer:
+    def __init__(self, topics: dict[str, int], high: int = 42) -> None:
+        self._metadata = FakeClusterMetadata(topics)
+        self._high = high
+        self.assigned: list | None = None
+
+    def list_topics(self, timeout: float):
+        return self._metadata
+
+    def get_watermark_offsets(self, partition, timeout: float):
+        return (0, self._high)
+
+    def assign(self, partitions) -> None:
+        self.assigned = partitions
+
+    def consume(self, num_messages: int, timeout: float):
+        return []
+
+    def close(self) -> None:
+        pass
+
+
+class TestAssignment:
+    def test_all_partitions_pinned_at_high_watermark(self) -> None:
+        consumer = FakeConsumer({"a_detector": 3, "a_motion": 1}, high=99)
+        n = assign_all_partitions(consumer, ["a_detector", "a_motion"])
+        assert n == 4
+        assert len(consumer.assigned) == 4
+        assert all(tp.offset == 99 for tp in consumer.assigned)
+        topics = {tp.topic for tp in consumer.assigned}
+        assert topics == {"a_detector", "a_motion"}
+
+    def test_missing_topic_fails_loudly(self) -> None:
+        consumer = FakeConsumer({"a_detector": 1})
+        with pytest.raises(ValueError, match="a_typo"):
+            assign_all_partitions(consumer, ["a_typo"])
+
+    def test_validate_names_all_missing(self) -> None:
+        consumer = FakeConsumer({"x": 1})
+        with pytest.raises(ValueError, match=r"\['a', 'b'\]"):
+            validate_topics_exist(consumer, ["a", "b", "x"])
