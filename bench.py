@@ -160,6 +160,111 @@ def bench_secondary_configs(args, edges, batches, method: str) -> None:
     )
 
 
+def bench_latency(args) -> None:
+    """p99 ingest->publish latency through a real detector service.
+
+    The BASELINE latency target (p99 Kafka->dashboard < 100 ms) minus the
+    broker hops, which this environment cannot include: per pulse, ev44
+    bytes are injected into a real service (adapters -> batcher -> staging
+    -> jitted step -> da00 serialization) and the wall time from inject to
+    published output is recorded. Reported on stderr.
+    """
+    from esslivedata_tpu.config import JobId, WorkflowConfig
+    from esslivedata_tpu.config.instruments.dummy.specs import (
+        DETECTOR_VIEW_HANDLE,
+        INSTRUMENT,
+    )
+    from esslivedata_tpu.core.message_batcher import NaiveMessageBatcher
+    from esslivedata_tpu.kafka import wire
+    from esslivedata_tpu.kafka.sink import (
+        FakeProducer,
+        KafkaSink,
+        make_default_serializer,
+    )
+    from esslivedata_tpu.kafka.source import FakeKafkaMessage
+    from esslivedata_tpu.services.detector_data import (
+        make_detector_service_builder,
+    )
+
+    from esslivedata_tpu.services.fake_sources import PulsedRawSource
+
+    builder = make_detector_service_builder(
+        instrument="dummy", batcher=NaiveMessageBatcher(), job_threads=1
+    )
+    raw = PulsedRawSource([])
+    producer = FakeProducer()
+    sink = KafkaSink(
+        producer,
+        make_default_serializer(builder.stream_mapping.livedata, "lat"),
+    )
+    service = builder.from_raw_source(raw, sink)
+    config = WorkflowConfig(
+        identifier=DETECTOR_VIEW_HANDLE.workflow_id,
+        job_id=JobId(source_name="panel_0"),
+        params={},
+    )
+    raw.inject(
+        FakeKafkaMessage(
+            json.dumps(
+                {"kind": "start_job", "config": config.model_dump(mode="json")}
+            ).encode(),
+            "dummy_livedata_commands",
+        )
+    )
+    service.step()
+
+    det = INSTRUMENT.detectors["panel_0"]
+    ids_space = det.detector_number.reshape(-1)
+    rng = np.random.default_rng(3)
+    events_per_pulse = max(1, args.events // 16)
+    pulse_period_ns = int(1e9 / 14)
+    n_pulses = 100
+    latencies = []
+    for pulse in range(n_pulses + 5):
+        t_pulse = 1_700_000_000_000_000_000 + pulse * pulse_period_ns
+        ids = rng.choice(ids_space, events_per_pulse).astype(np.int32)
+        toa = rng.uniform(0, 7.0e7, events_per_pulse).astype(np.int32)
+        payload = wire.encode_ev44(
+            det.source_name, pulse, np.array([t_pulse]), np.array([0]),
+            toa, pixel_id=ids,
+        )
+        n_before = len(producer.messages)
+        start = time.perf_counter()
+        raw.inject(FakeKafkaMessage(payload, "dummy_detector"))
+        service.step()
+        if len(producer.messages) > n_before and pulse >= 5:  # warmed
+            latencies.append(1e3 * (time.perf_counter() - start))
+    if not latencies:
+        print(
+            json.dumps(
+                {
+                    "metric": "ingest_to_publish_latency_ms",
+                    "error": "no output published — check job errors / "
+                    f"serialize drops (produced={len(producer.messages)})",
+                }
+            ),
+            file=sys.stderr,
+        )
+        return
+    latencies.sort()
+    p50 = latencies[len(latencies) // 2]
+    # Nearest-rank p99 (ceil(0.99*n)-1), NOT the max sample.
+    p99 = latencies[max(0, -(-99 * len(latencies) // 100) - 1)]
+    print(
+        json.dumps(
+            {
+                "metric": "ingest_to_publish_latency_ms",
+                "p50": p50,
+                "p99": p99,
+                "n": len(latencies),
+                "events_per_pulse": events_per_pulse,
+                "unit": "ms",
+            }
+        ),
+        file=sys.stderr,
+    )
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--events", type=int, default=1 << 22)  # 4M per batch
@@ -244,6 +349,7 @@ def main() -> None:
 
     if args.all:
         bench_secondary_configs(args, edges, batches, method)
+        bench_latency(args)
 
     pid, toa = make_batch(args.events, args.pixels, seed=99)
     baseline = bench_numpy_baseline(pid, toa, args.pixels, args.toa_bins, lo, hi)
