@@ -258,13 +258,27 @@ _PAGE = """<!DOCTYPE html>
  header small {{ color: #9fb3c8; }}
  #layout {{ display: flex; }}
  #side {{ width: 280px; padding: 12px; }}
- #grid {{ flex: 1; display: flex; flex-wrap: wrap; gap: 10px; padding: 12px; }}
+ #main {{ flex: 1; padding: 12px; }}
+ #tabs button.on {{ font-weight: bold; background: #dde4ea; }}
  .card {{ background: #fff; border-radius: 6px; padding: 8px;
           box-shadow: 0 1px 3px rgba(0,0,0,.15); }}
- .card img {{ display: block; max-width: 520px; }}
+ .card img {{ display: block; width: 100%; }}
+ #flat {{ display: flex; flex-wrap: wrap; gap: 10px; }}
+ #flat .card img {{ max-width: 520px; }}
+ .gridbox {{ display: grid; gap: 10px; margin-bottom: 18px; }}
+ .gridcell {{ min-height: 60px; }}
+ .gridcell h4 {{ margin: 2px 0 6px; font-size: 12px; color: #445; }}
  button {{ margin: 2px; }}
  .job {{ font-size: 12px; margin: 4px 0; }}
  .state-active {{ color: #0a7d32; }} .state-error {{ color: #b00020; }}
+ #toasts {{ position: fixed; bottom: 12px; right: 12px; width: 320px; }}
+ .toast {{ padding: 8px 12px; margin-top: 6px; border-radius: 6px; color: #fff;
+           font-size: 13px; opacity: .95; }}
+ .toast.info {{ background: #2b6cb0; }} .toast.warning {{ background: #b7791f; }}
+ .toast.error {{ background: #b00020; }}
+ table.devices {{ font-size: 12px; border-collapse: collapse; width: 100%; }}
+ table.devices td {{ padding: 2px 4px; border-bottom: 1px solid #eee; }}
+ td.stale {{ color: #999; }}
 </style></head>
 <body>
 <header><div><b>esslivedata-tpu</b> — {instrument}</div>
@@ -274,11 +288,73 @@ _PAGE = """<!DOCTYPE html>
   <h3>Workflows</h3><div id="workflows"></div>
   <h3>Jobs</h3><div id="jobs"></div>
   <h3>Services</h3><div id="svcs"></div>
+  <h3>Devices</h3><table class="devices" id="devices"></table>
  </div>
- <div id="grid"></div>
+ <div id="main">
+  <div id="tabs">
+   <button id="tab-grids" class="on" onclick="setTab('grids')">Grids</button>
+   <button id="tab-flat" onclick="setTab('flat')">All plots</button>
+  </div>
+  <div id="grids"></div>
+  <div id="flat" style="display:none"></div>
+ </div>
 </div>
+<div id="toasts"></div>
 <script>
-let gen = -1;
+let gen = -1, tab = 'grids', gridGens = {{}}, noteSeq = 0;
+function setTab(t) {{
+  tab = t; gen = -1; gridGens = {{}};
+  document.getElementById('grids').style.display = t === 'grids' ? '' : 'none';
+  document.getElementById('flat').style.display = t === 'flat' ? '' : 'none';
+  document.getElementById('tab-grids').className = t === 'grids' ? 'on' : '';
+  document.getElementById('tab-flat').className = t === 'flat' ? 'on' : '';
+  refresh();
+}}
+async function refreshGrids() {{
+  const r = await fetch('/api/grids'); const data = await r.json();
+  const root = document.getElementById('grids');
+  for (const g of data.grids) {{
+    let box = document.getElementById('grid-' + g.grid_id);
+    if (!box) {{
+      const wrap = document.createElement('div');
+      wrap.innerHTML = `<h3>${{g.title || g.grid_id}}</h3>`;
+      box = document.createElement('div');
+      box.className = 'gridbox'; box.id = 'grid-' + g.grid_id;
+      box.style.gridTemplateColumns = `repeat(${{g.ncols}}, 1fr)`;
+      wrap.appendChild(box); root.appendChild(wrap);
+    }}
+    // Frame-gated repaint: only when this grid's generation advanced.
+    if (gridGens[g.grid_id] === g.generation) continue;
+    gridGens[g.grid_id] = g.generation;
+    box.innerHTML = '';
+    g.cells.forEach((c, i) => {{
+      const cell = document.createElement('div');
+      cell.className = 'card gridcell';
+      cell.style.gridRow = `${{c.geometry.row + 1}} / span ${{c.geometry.row_span}}`;
+      cell.style.gridColumn = `${{c.geometry.col + 1}} / span ${{c.geometry.col_span}}`;
+      cell.innerHTML = `<h4>${{c.title || ('cell ' + i)}}</h4>`;
+      if (c.keys.length) {{
+        const img = document.createElement('img');
+        img.src = '/plot/' + c.keys[0] + '.png?gen=' + g.generation;
+        cell.appendChild(img);
+      }} else {{
+        cell.innerHTML += '<small>waiting for data…</small>';
+      }}
+      box.appendChild(cell);
+    }});
+  }}
+}}
+async function refreshNotes() {{
+  const r = await fetch('/api/notifications?since=' + noteSeq);
+  const data = await r.json();
+  noteSeq = data.latest;
+  for (const n of data.notifications) {{
+    const d = document.createElement('div');
+    d.className = 'toast ' + n.level; d.textContent = n.message;
+    document.getElementById('toasts').appendChild(d);
+    setTimeout(() => d.remove(), 6000);
+  }}
+}}
 async function refresh() {{
   const r = await fetch('/api/state'); const s = await r.json();
   document.getElementById('meta').textContent = 'generation ' + s.generation;
@@ -309,9 +385,19 @@ async function refresh() {{
     d.textContent = `${{sv.service_id}}: ${{sv.state}}` + (sv.stale ? ' (stale)' : '');
     svcs.appendChild(d);
   }}
-  if (s.generation !== gen) {{
+  const dr = await fetch('/api/devices'); const dd = await dr.json();
+  const dt = document.getElementById('devices'); dt.innerHTML = '';
+  for (const dev of dd.devices) {{
+    const row = document.createElement('tr');
+    row.innerHTML = `<td class="${{dev.stale ? 'stale' : ''}}">${{dev.name}}</td>
+      <td>${{Number(dev.value).toPrecision(6)}} ${{dev.unit}}</td>`;
+    dt.appendChild(row);
+  }}
+  if (tab === 'grids') {{
+    await refreshGrids();
+  }} else if (s.generation !== gen) {{
     gen = s.generation;
-    const grid = document.getElementById('grid');
+    const grid = document.getElementById('flat');
     const seen = new Set();
     for (const k of s.keys) {{
       seen.add(k.id);
@@ -329,6 +415,7 @@ async function refresh() {{
       if (!seen.has(card.id.slice(5))) card.remove();
     }}
   }}
+  refreshNotes();
 }}
 setInterval(refresh, 1000); refresh();
 </script></body></html>
