@@ -4,21 +4,15 @@ Tests run on CPU with 8 virtual XLA devices so that every multi-chip
 sharding path (mesh/shard_map/psum) is exercised without TPU hardware —
 the same topology the driver's ``dryrun_multichip`` validates.
 
-The ambient environment may pin JAX to a real accelerator platform via a
-sitecustomize hook that overrides JAX_PLATFORMS after env parsing, so the
-env var alone is not enough: we update jax.config directly, before any
-backend is initialized (safe as long as no fixture touched jax yet).
+The CPU pin must happen before any fixture touches a JAX backend; the
+rationale and mechanism live in esslivedata_tpu.utils.platform_pin.
 """
 
-import os
+import sys
+from pathlib import Path
 
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "src"))
 
-import jax  # noqa: E402
+from esslivedata_tpu.utils.platform_pin import pin_cpu
 
-jax.config.update("jax_platforms", "cpu")
+pin_cpu(8)
