@@ -12,9 +12,12 @@ stream of that category.
 
 from __future__ import annotations
 
+import logging
 from typing import TYPE_CHECKING
 
 from ..workflows.workflow_factory import workflow_registry
+
+logger = logging.getLogger(__name__)
 
 if TYPE_CHECKING:
     from ..kafka.stream_mapping import StreamMapping
@@ -119,6 +122,33 @@ def resolve_stream_names(
         resolved |= set(stream_mapping.detectors.values())
     if unknown & set(instrument.monitor_names):
         resolved |= set(stream_mapping.monitors.values())
+
+    # Anything still unexplained is neither a LUT entry, a logical
+    # detector/monitor name, nor a declared synthesised stream: almost
+    # certainly a typo'd source_name in a spec, whose job would otherwise
+    # wait for data forever with no diagnostic.
+    from .chopper import CHOPPER_CASCADE_SOURCE, delay_setpoint_stream
+
+    synthesized = {CHOPPER_CASCADE_SOURCE}
+    synthesized.update(
+        delay_setpoint_stream(chopper) for chopper in instrument.choppers
+    )
+    synthesized.update(instrument.devices)
+    unexplained = (
+        unknown
+        - set(instrument.detector_names)
+        - set(instrument.monitor_names)
+        - {MERGED_DETECTOR_STREAM}
+        - synthesized
+    )
+    if unexplained:
+        logger.warning(
+            "Source names %s for instrument %s match no stream LUT entry, "
+            "logical detector/monitor name, or synthesised stream; jobs "
+            "referencing them will never receive data (typo in a spec?)",
+            sorted(unexplained),
+            instrument.name,
+        )
     return resolved
 
 

@@ -7,10 +7,10 @@ below can be faked in tests exactly like the reference does.
 """
 
 from .message import (
-    COMMANDS_STREAM_ID,
-    RESPONSES_STREAM_ID,
-    RUN_CONTROL_STREAM_ID,
-    STATUS_STREAM_ID,
+    COMMAND_STREAM,
+    RESPONSE_STREAM,
+    RUN_CONTROL_STREAM,
+    STATUS_STREAM,
     Message,
     MessageSink,
     MessageSource,
@@ -22,16 +22,16 @@ from .message import (
 from .timestamp import Duration, Timestamp
 
 __all__ = [
-    "COMMANDS_STREAM_ID",
+    "COMMAND_STREAM",
     "Duration",
     "Message",
     "MessageSink",
     "MessageSource",
-    "RESPONSES_STREAM_ID",
-    "RUN_CONTROL_STREAM_ID",
+    "RESPONSE_STREAM",
+    "RUN_CONTROL_STREAM",
     "RunStart",
     "RunStop",
-    "STATUS_STREAM_ID",
+    "STATUS_STREAM",
     "StreamId",
     "StreamKind",
     "Timestamp",

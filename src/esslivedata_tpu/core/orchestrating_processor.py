@@ -23,6 +23,8 @@ from .command_dispatcher import CommandDispatcher
 from .job_manager import JobManager
 from .job import JobResult, ServiceStatus, StreamLag, StreamLagReport
 from .message import (
+    RESPONSE_STREAM,
+    STATUS_STREAM,
     Message,
     MessageSink,
     MessageSource,
@@ -171,18 +173,9 @@ class OrchestratingProcessor:
     def process(self) -> None:
         messages = list(self._source.get_messages())
 
-        commands = [
-            m for m in messages if m.stream.kind == StreamKind.LIVEDATA_COMMANDS
-        ]
-        run_control = [
-            m for m in messages if m.stream.kind == StreamKind.RUN_CONTROL
-        ]
-        data = [
-            m
-            for m in messages
-            if m.stream.kind
-            not in (StreamKind.LIVEDATA_COMMANDS, StreamKind.RUN_CONTROL)
-        ]
+        commands = [m for m in messages if m.stream.kind.is_command]
+        run_control = [m for m in messages if m.stream.kind.is_run_control]
+        data = [m for m in messages if m.stream.kind.is_data]
 
         if commands:
             acks = self._dispatcher.process_messages(commands)
@@ -265,7 +258,7 @@ class OrchestratingProcessor:
             [
                 Message(
                     timestamp=Timestamp.now(),
-                    stream=StreamId(kind=StreamKind.LIVEDATA_RESPONSES, name=""),
+                    stream=RESPONSE_STREAM,
                     value=ack,
                 )
                 for ack in acks
@@ -288,7 +281,7 @@ class OrchestratingProcessor:
             [
                 Message(
                     timestamp=Timestamp.now(),
-                    stream=StreamId(kind=StreamKind.LIVEDATA_STATUS, name=""),
+                    stream=STATUS_STREAM,
                     value=self._service_status(state),
                 )
             ]

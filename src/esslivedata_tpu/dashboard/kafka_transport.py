@@ -23,7 +23,7 @@ class DashboardKafkaTransport:
         self,
         *,
         instrument: str,
-        bootstrap: str = "localhost:9092",
+        bootstrap: str | None = None,
         dev: bool = False,
         group_id: str | None = None,
     ) -> None:
@@ -34,6 +34,8 @@ class DashboardKafkaTransport:
                 "confluent_kafka is required for the Kafka transport; "
                 "install the [kafka] extra or use --transport fake"
             ) from err
+        from ..kafka.consumer import kafka_client_config
+
         self._topics = LivedataTopics.for_instrument(instrument, dev)
         self._kind_by_topic = {
             self._topics.data: "data",
@@ -41,15 +43,18 @@ class DashboardKafkaTransport:
             self._topics.responses: "responses",
             self._topics.nicos: "nicos",
         }
+        # Full client config (incl. SASL/SSL in prod); ``bootstrap`` only
+        # overrides the broker address.
+        client_conf = kafka_client_config(bootstrap_override=bootstrap)
         self._consumer = Consumer(
             {
-                "bootstrap.servers": bootstrap,
+                **client_conf,
                 "group.id": group_id or f"{instrument}_dashboard",
                 "auto.offset.reset": "latest",
                 "enable.auto.commit": False,
             }
         )
-        self._producer = Producer({"bootstrap.servers": bootstrap})
+        self._producer = Producer(client_conf)
 
     def start(self) -> None:
         self._consumer.subscribe(list(self._kind_by_topic))

@@ -24,7 +24,7 @@ def main(argv: list[str] | None = None) -> int:
     parser = setup_arg_parser("esslivedata-tpu dashboard")
     parser.add_argument("--port", type=int, default=5007)
     parser.add_argument("--transport", choices=["fake", "kafka"], default="fake")
-    parser.add_argument("--kafka-bootstrap", default="localhost:9092")
+    parser.add_argument("--kafka-bootstrap", default=None, help="override the broker from the kafka config namespace")
     parser.add_argument("--events-per-pulse", type=int, default=2000)
     parser.add_argument(
         "--config-dir",

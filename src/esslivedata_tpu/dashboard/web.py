@@ -302,6 +302,16 @@ _PAGE = """<!DOCTYPE html>
 <div id="toasts"></div>
 <script>
 let gen = -1, tab = 'grids', gridGens = {{}}, noteSeq = 0;
+// All strings that originate outside this page (stream/device/source names
+// decoded from Kafka, user-editable titles) go through textContent — never
+// interpolated into innerHTML — so a crafted source_name cannot inject
+// markup into the operator's browser.
+function el(tag, cls, text) {{
+  const n = document.createElement(tag);
+  if (cls) n.className = cls;
+  if (text !== undefined) n.textContent = text;
+  return n;
+}}
 function setTab(t) {{
   tab = t; gen = -1; gridGens = {{}};
   document.getElementById('grids').style.display = t === 'grids' ? '' : 'none';
@@ -317,7 +327,7 @@ async function refreshGrids() {{
     let box = document.getElementById('grid-' + g.grid_id);
     if (!box) {{
       const wrap = document.createElement('div');
-      wrap.innerHTML = `<h3>${{g.title || g.grid_id}}</h3>`;
+      wrap.appendChild(el('h3', '', g.title || g.grid_id));
       box = document.createElement('div');
       box.className = 'gridbox'; box.id = 'grid-' + g.grid_id;
       box.style.gridTemplateColumns = `repeat(${{g.ncols}}, 1fr)`;
@@ -332,13 +342,13 @@ async function refreshGrids() {{
       cell.className = 'card gridcell';
       cell.style.gridRow = `${{c.geometry.row + 1}} / span ${{c.geometry.row_span}}`;
       cell.style.gridColumn = `${{c.geometry.col + 1}} / span ${{c.geometry.col_span}}`;
-      cell.innerHTML = `<h4>${{c.title || ('cell ' + i)}}</h4>`;
+      cell.appendChild(el('h4', '', c.title || ('cell ' + i)));
       if (c.keys.length) {{
         const img = document.createElement('img');
         img.src = '/plot/' + c.keys[0] + '.png?gen=' + g.generation;
         cell.appendChild(img);
       }} else {{
-        cell.innerHTML += '<small>waiting for data…</small>';
+        cell.appendChild(el('small', '', 'waiting for data…'));
       }}
       box.appendChild(cell);
     }});
@@ -372,8 +382,9 @@ async function refresh() {{
   const jobs = document.getElementById('jobs'); jobs.innerHTML = '';
   for (const j of s.jobs) {{
     const d = document.createElement('div'); d.className = 'job';
-    d.innerHTML = `<span class="state-${{j.state}}">${{j.state}}</span>
-      ${{j.source_name}} <small>${{j.workflow_id}}</small>`;
+    d.appendChild(el('span', 'state-' + j.state, j.state));
+    d.appendChild(document.createTextNode(' ' + j.source_name + ' '));
+    d.appendChild(el('small', '', j.workflow_id));
     const stop = document.createElement('button'); stop.textContent = 'stop';
     stop.onclick = () => fetch('/api/job/stop', {{method: 'POST',
       body: JSON.stringify({{source_name: j.source_name, job_number: j.job_number}})}});
@@ -389,8 +400,9 @@ async function refresh() {{
   const dt = document.getElementById('devices'); dt.innerHTML = '';
   for (const dev of dd.devices) {{
     const row = document.createElement('tr');
-    row.innerHTML = `<td class="${{dev.stale ? 'stale' : ''}}">${{dev.name}}</td>
-      <td>${{Number(dev.value).toPrecision(6)}} ${{dev.unit}}</td>`;
+    row.appendChild(el('td', dev.stale ? 'stale' : '', dev.name));
+    row.appendChild(
+      el('td', '', Number(dev.value).toPrecision(6) + ' ' + dev.unit));
     dt.appendChild(row);
   }}
   if (tab === 'grids') {{

@@ -1,20 +1,31 @@
-"""Synthesize a chopper-cascade trigger from chopper PV streams.
+"""Derive chopper-cascade readiness from raw chopper PV traffic.
 
-Parity with reference ``kafka/chopper_synthesizer.py:148``: a MessageSource
-decorator that forwards everything verbatim while
+Wavelength-LUT jobs need a primary trigger saying "every chopper in the
+cascade has reached its setpoints" — a signal no upstream producer emits
+(reference ``kafka/chopper_synthesizer.py``). This module derives it
+in-process, as a ``MessageSource`` decorator that forwards all wrapped
+traffic verbatim and injects two kinds of synthetic f144 streams:
 
-- caching per-chopper ``<chopper>/rotation_speed_setpoint`` values,
-- plateau-detecting each chopper's noisy ``<chopper>/delay`` readback with a
-  rolling-window stability detector, emitting a synthetic
-  ``<chopper>/delay_setpoint`` f144 on each new lock,
-- emitting a synthetic primary tick on the ``chopper_cascade`` logical
-  stream when every configured chopper has both a cached speed setpoint and
-  a locked delay setpoint — only on cycles where an input actually changed.
+- ``<chopper>/delay_setpoint``: the noisy ``<chopper>/delay`` readback is
+  plateau-detected; each newly locked level is published once, stamped
+  with the time of the raw sample that completed the plateau (not the
+  batch end — a batch can contain a lock followed by the start of the
+  next ramp, and the setpoint must not carry the ramp's time).
+- ``chopper_cascade``: one tick whenever an input changed while every
+  configured chopper holds both a cached ``rotation_speed_setpoint`` and
+  a locked delay. While locked and idle, the tick is re-emitted every
+  ``refresh_every``-th cycle so jobs started after the original lock
+  still receive their primary trigger (there is no replay; the LUT
+  workflow dedupes on setpoint signature, so refreshes are no-ops for
+  already-primed jobs).
 
-Chopperless instruments (empty ``chopper_names``) get exactly one vacuous
-cascade tick on the first ``get_messages`` call. The cascade tick is the
-wavelength-LUT job's primary dynamic stream: its arrival drives a LUT
-recompute (see workflows/wavelength_lut_workflow.py).
+Every synthetic message rides the *data clock*: timestamps come from
+observed input samples, never from the wall clock. Batchers window on
+message timestamps, so a wall-clock-stamped tick could land far outside
+any live window during replay and orphan the LUT trigger. Consequently a
+chopperless instrument's single vacuous bootstrap tick is deferred until
+the first forwarded message supplies a data time (before that, no batch
+can close, so nothing is lost by waiting).
 """
 
 from __future__ import annotations
@@ -22,7 +33,6 @@ from __future__ import annotations
 import logging
 from collections import deque
 from collections.abc import Sequence
-from dataclasses import dataclass
 
 import numpy as np
 
@@ -43,67 +53,59 @@ logger = logging.getLogger(__name__)
 CHOPPER_CASCADE_STREAM = StreamId(kind=StreamKind.LOG, name=CHOPPER_CASCADE_SOURCE)
 
 
-def _cascade_tick(time: Timestamp | None = None) -> Message[LogData]:
-    """The 'all choppers reached setpoints' tick; value unused downstream.
+class _ChopperTracker:
+    """Lock state for one chopper: cached speed setpoint + delay plateau.
 
-    Timestamped with the data time of the triggering input so it rides the
-    system's data-time clock (batchers window on message timestamps, never
-    wall clock); the chopperless bootstrap tick has no input and falls back
-    to wall clock.
-    """
-    time = time if time is not None else Timestamp.now()
-    return Message(
-        timestamp=time,
-        stream=CHOPPER_CASCADE_STREAM,
-        value=LogData(time=time.ns, value=1),
-    )
-
-
-class _StabilityDetector:
-    """Rolling-window plateau detector.
-
-    Locks when the window's std dev drops below ``atol``; the locked value
-    is the window mean. The same ``atol`` decides whether a new mean has
-    drifted far enough from the previous lock to count as a new setpoint,
-    so noise rejection and change detection share one knob.
+    The delay readback is noisy, so its setpoint is inferred: keep the
+    last ``window_size`` samples, and when their spread (std dev) falls
+    under ``atol`` the window mean becomes the locked level. The same
+    ``atol`` decides whether a later plateau differs enough from the
+    current lock to count as a *new* setpoint — one knob for both noise
+    rejection and change detection.
     """
 
-    def __init__(self, *, window_size: int, atol: float) -> None:
-        self._buffer: deque[float] = deque(maxlen=window_size)
+    __slots__ = ("_atol", "_delay_lock", "_recent", "_speed", "name")
+
+    def __init__(self, name: str, *, window_size: int, atol: float) -> None:
+        self.name = name
         self._atol = atol
-        self._locked: float | None = None
-
-    def add(self, sample: float) -> float | None:
-        """Append a sample; return a newly locked value if it changed."""
-        self._buffer.append(sample)
-        if len(self._buffer) < self._buffer.maxlen:
-            return None
-        arr = np.fromiter(self._buffer, dtype=float)
-        if arr.std() >= self._atol:
-            return None
-        mean = float(arr.mean())
-        if self._locked is None or abs(mean - self._locked) > self._atol:
-            self._locked = mean
-            return mean
-        return None
+        self._recent: deque[float] = deque(maxlen=window_size)
+        self._delay_lock: float | None = None
+        self._speed: float | None = None
 
     @property
-    def locked(self) -> float | None:
-        return self._locked
+    def ready(self) -> bool:
+        """Both quantities known — this chopper no longer blocks the tick."""
+        return self._speed is not None and self._delay_lock is not None
 
+    def feed_delay(self, log: LogData) -> list[tuple[int, float]]:
+        """Feed raw readback samples; return ``(time_ns, level)`` per new
+        lock, timestamped at the sample that completed the plateau."""
+        locks: list[tuple[int, float]] = []
+        for raw_ns, raw in log.samples():
+            self._recent.append(float(raw))
+            if len(self._recent) < self._recent.maxlen:
+                continue
+            plateau = np.fromiter(self._recent, dtype=float)
+            if plateau.std() >= self._atol:
+                continue
+            level = float(plateau.mean())
+            if self._delay_lock is None or abs(level - self._delay_lock) > self._atol:
+                self._delay_lock = level
+                locks.append((int(raw_ns), level))
+        return locks
 
-@dataclass(slots=True)
-class _ChopperState:
-    detector: _StabilityDetector
-    speed_setpoint: float | None = None
-    delay_setpoint: float | None = None
-
-    def is_locked(self) -> bool:
-        return self.speed_setpoint is not None and self.delay_setpoint is not None
+    def feed_speed(self, log: LogData) -> bool:
+        """Cache the clean speed setpoint; True if it actually changed."""
+        latest = float(log.value[-1])
+        if latest == self._speed:
+            return False
+        self._speed = latest
+        return True
 
 
 class ChopperSynthesizer:
-    """MessageSource decorator injecting synthetic chopper-cascade triggers."""
+    """MessageSource decorator injecting cascade-readiness streams."""
 
     def __init__(
         self,
@@ -115,115 +117,105 @@ class ChopperSynthesizer:
         refresh_every: int = 256,
     ) -> None:
         self._wrapped = wrapped
-        self._chopper_names = tuple(chopper_names)
-        # Re-emit the current tick every N cycles while locked so a LUT job
-        # started *after* the original tick still receives its primary
-        # trigger (jobs only see the current window; there is no replay).
-        # The LUT workflow dedupes on setpoint signature, so refresh ticks
-        # are cheap no-ops for already-computed jobs.
         self._refresh_every = max(1, refresh_every)
         self._cycle = 0
-        self._last_data_time: Timestamp | None = None
-        self._states = {
-            name: _ChopperState(
-                detector=_StabilityDetector(
-                    window_size=delay_window_size, atol=delay_atol
+        self._trackers = [
+            _ChopperTracker(name, window_size=delay_window_size, atol=delay_atol)
+            for name in chopper_names
+        ]
+        # Stream-name routing: which tracker and quantity a message feeds.
+        self._delay_of = {
+            delay_readback_stream(t.name): t for t in self._trackers
+        }
+        self._speed_of = {
+            speed_setpoint_stream(t.name): t for t in self._trackers
+        }
+        self._ticked_once = False
+        self._logged_lock = False
+        self._data_clock: Timestamp | None = None
+
+    # -- cycle ------------------------------------------------------------
+    def get_messages(self) -> Sequence[Message]:
+        self._cycle += 1
+        injected: list[Message] = []
+        passthrough: list[Message] = []
+        changed_at: Timestamp | None = None
+
+        for msg in self._wrapped.get_messages():
+            passthrough.append(msg)
+            if self._data_clock is None or msg.timestamp > self._data_clock:
+                self._data_clock = msg.timestamp
+            if self._observe(msg, injected):
+                if changed_at is None or msg.timestamp > changed_at:
+                    changed_at = msg.timestamp
+
+        tick_at = self._tick_due(changed_at)
+        if tick_at is not None:
+            self._ticked_once = True
+            injected.append(
+                Message(
+                    timestamp=tick_at,
+                    stream=CHOPPER_CASCADE_STREAM,
+                    value=LogData(time=tick_at.ns, value=1),
                 )
             )
-            for name in self._chopper_names
-        }
-        self._delay_streams = {
-            delay_readback_stream(n): n for n in self._chopper_names
-        }
-        self._speed_streams = {
-            speed_setpoint_stream(n): n for n in self._chopper_names
-        }
-        self._emitted_initial_tick = False
-        self._was_all_locked = False
+        return [*injected, *passthrough]
 
-    def get_messages(self) -> Sequence[Message]:
-        synthetic: list[Message] = []
-        forwarded: list[Message] = []
-        self._cycle += 1
-
-        if not self._chopper_names and not self._emitted_initial_tick:
-            self._emitted_initial_tick = True
-            synthetic.append(_cascade_tick())
-            logger.info("chopper_cascade initial tick emitted (no choppers)")
-
-        any_changed = False
-        change_time: Timestamp | None = None
-        for msg in self._wrapped.get_messages():
-            forwarded.append(msg)
-            if (
-                self._last_data_time is None
-                or msg.timestamp > self._last_data_time
-            ):
-                self._last_data_time = msg.timestamp
-            if self._handle(msg, synthetic):
-                any_changed = True
-                if change_time is None or msg.timestamp > change_time:
-                    change_time = msg.timestamp
-
-        if self._chopper_names:
-            all_locked = all(s.is_locked() for s in self._states.values())
-            if any_changed and all_locked:
-                synthetic.append(_cascade_tick(change_time))
-                if not self._was_all_locked:
-                    logger.info(
-                        "chopper_cascade all locked: %s",
-                        list(self._chopper_names),
+    def _observe(self, msg: Message, injected: list[Message]) -> bool:
+        """Feed one message into its tracker; True if an input changed."""
+        tracker = self._delay_of.get(msg.stream.name)
+        if tracker is not None:
+            locks = tracker.feed_delay(msg.value)
+            for lock_ns, level in locks:
+                injected.append(
+                    Message(
+                        timestamp=Timestamp.from_ns(lock_ns),
+                        stream=StreamId(
+                            kind=StreamKind.LOG,
+                            name=delay_setpoint_stream(tracker.name),
+                        ),
+                        value=LogData(time=lock_ns, value=level),
                     )
-            elif all_locked and self._cycle % self._refresh_every == 0:
-                # Periodic refresh, timestamped on the data clock (last seen
-                # data time) so replay never produces wall-clock windows.
-                synthetic.append(_cascade_tick(self._last_data_time))
-            self._was_all_locked = all_locked
-        elif (
-            self._emitted_initial_tick
-            and self._cycle % self._refresh_every == 0
-        ):
-            synthetic.append(_cascade_tick(self._last_data_time))
-
-        return [*synthetic, *forwarded]
-
-    def _handle(self, msg: Message, synthetic: list[Message]) -> bool:
-        """Update chopper state from ``msg``; True if an input changed."""
-        name = msg.stream.name
-        if (chopper := self._delay_streams.get(name)) is not None:
-            return self._handle_delay(chopper, msg, synthetic)
-        if (chopper := self._speed_streams.get(name)) is not None:
-            return self._handle_speed(chopper, msg)
+                )
+                logger.info(
+                    "chopper %s delay locked at %s", tracker.name, level
+                )
+            return bool(locks)
+        tracker = self._speed_of.get(msg.stream.name)
+        if tracker is not None:
+            return tracker.feed_speed(msg.value)
         return False
 
-    def _handle_delay(
-        self, chopper: str, msg: Message, synthetic: list[Message]
-    ) -> bool:
-        state = self._states[chopper]
-        new_setpoint = None
-        for sample in np.atleast_1d(msg.value.value):
-            if (locked := state.detector.add(float(sample))) is not None:
-                new_setpoint = locked
-        if new_setpoint is None:
-            return False
-        time_ns = int(msg.value.time[-1])
-        synthetic.append(
-            Message(
-                timestamp=Timestamp.from_ns(time_ns),
-                stream=StreamId(
-                    kind=StreamKind.LOG, name=delay_setpoint_stream(chopper)
-                ),
-                value=LogData(time=time_ns, value=new_setpoint),
-            )
-        )
-        state.delay_setpoint = new_setpoint
-        logger.info("chopper %s delay locked at %s", chopper, new_setpoint)
-        return True
+    def _tick_due(self, changed_at: Timestamp | None) -> Timestamp | None:
+        """When (in data time) to emit a cascade tick this cycle, if at all.
 
-    def _handle_speed(self, chopper: str, msg: Message) -> bool:
-        new_speed = float(np.atleast_1d(msg.value.value)[-1])
-        state = self._states[chopper]
-        if state.speed_setpoint == new_speed:
-            return False
-        state.speed_setpoint = new_speed
-        return True
+        The returned timestamp is always an observed data time — see the
+        module docstring for why wall clock is never used.
+        """
+        if not self._trackers:
+            # Chopperless: one vacuous bootstrap tick as soon as a data
+            # time exists, then periodic refreshes.
+            if self._data_clock is None:
+                return None
+            if not self._ticked_once:
+                logger.info("chopper_cascade bootstrap tick (no choppers)")
+                return self._data_clock
+            return self._refresh_tick()
+
+        if not all(t.ready for t in self._trackers):
+            self._logged_lock = False
+            return None
+        if not self._logged_lock:
+            self._logged_lock = True
+            logger.info(
+                "chopper_cascade all locked: %s",
+                [t.name for t in self._trackers],
+            )
+        if changed_at is not None:
+            return changed_at
+        return self._refresh_tick()
+
+    def _refresh_tick(self) -> Timestamp | None:
+        if self._cycle % self._refresh_every == 0:
+            return self._data_clock
+        return None

@@ -23,6 +23,8 @@ __all__ = [
     "AssignableConsumer",
     "assign_all_partitions",
     "consumer_from_config",
+    "kafka_client_config",
+    "librdkafka_config",
     "validate_topics_exist",
 ]
 
@@ -115,6 +117,67 @@ def assign_all_partitions(
     return len(assignments)
 
 
+# Loader-config keys -> librdkafka settings. Everything the defaults/
+# YAML files may declare must be translated here: a dropped key like
+# security_protocol makes the consumer silently attempt PLAINTEXT against
+# a SASL broker and hang.
+_LIBRDKAFKA_KEYS = {
+    "bootstrap_servers": "bootstrap.servers",
+    "security_protocol": "security.protocol",
+    "sasl_mechanism": "sasl.mechanism",
+    "sasl_username": "sasl.username",
+    "sasl_password": "sasl.password",
+    "ssl_ca_location": "ssl.ca.location",
+}
+
+#: App-level tuning keys (consumed by the source layer, not librdkafka) that
+#: may legitimately sit in the same loader config dicts.
+_APP_TUNING_KEYS = frozenset(
+    {"max_poll_records", "poll_timeout_ms", "queue_max_batches"}
+)
+
+
+def librdkafka_config(config: dict[str, Any]) -> dict[str, Any]:
+    """Translate a loader config dict into librdkafka settings.
+
+    App-level tuning keys (source-layer batch/queue sizes) are skipped;
+    anything else unknown is rejected rather than dropped, so adding a key
+    to the YAML defaults without teaching this translation fails loudly.
+    """
+    out: dict[str, Any] = {"bootstrap.servers": "localhost:9092"}
+    unknown = set(config) - set(_LIBRDKAFKA_KEYS) - _APP_TUNING_KEYS
+    if unknown:
+        raise ValueError(
+            f"Unrecognized kafka config keys {sorted(unknown)}; known: "
+            f"{sorted(_LIBRDKAFKA_KEYS)} + tuning {sorted(_APP_TUNING_KEYS)}"
+        )
+    for key, value in config.items():
+        if key in _LIBRDKAFKA_KEYS:
+            out[_LIBRDKAFKA_KEYS[key]] = value
+    return out
+
+
+def kafka_client_config(
+    *, bootstrap_override: str | None = None
+) -> dict[str, Any]:
+    """librdkafka settings for the current LIVEDATA_ENV.
+
+    Loads the ``kafka`` config namespace (YAML defaults incl. SASL/SSL
+    credentials in prod) and translates it; a CLI-provided bootstrap
+    override wins over the file. Used by the service runner, dashboard
+    transport, and tools so every client shares one authentication path.
+    """
+    from ..config.config_loader import load_config
+
+    try:
+        conf = librdkafka_config(load_config(namespace="kafka") or {})
+    except FileNotFoundError:
+        conf = librdkafka_config({})
+    if bootstrap_override is not None:
+        conf["bootstrap.servers"] = bootstrap_override
+    return conf
+
+
 @contextmanager
 def consumer_from_config(
     config: dict[str, Any], topics: Sequence[str], *, group_id: str
@@ -128,9 +191,7 @@ def consumer_from_config(
 
     consumer = Consumer(
         {
-            "bootstrap.servers": config.get(
-                "bootstrap_servers", "localhost:9092"
-            ),
+            **librdkafka_config(config),
             "group.id": group_id,
             "enable.auto.commit": False,
             "auto.offset.reset": "latest",

@@ -1,19 +1,29 @@
-"""Merge per-device RBV/VAL/DMOV substreams into a single Device stream.
+"""Synthesize merged per-device streams from f144 motor substreams.
 
-Parity with reference ``kafka/device_synthesizer.py:87`` (ADR 0001): a
-``MessageSource`` decorator wrapping an already-adapted source. Substream
-messages owned by a configured device are suppressed; once every configured
-substream of a device has been seen, each further substream event emits one
-merged ``LogData`` sample (value + optional target/idle) on a synthetic
-``StreamKind.DEVICE`` stream, timestamped ``max`` over the substream times.
+A NICOS-style device (ADR 0001; reference ``kafka/device_synthesizer.py``)
+is spread over up to three raw f144 substreams: readback (RBV), setpoint
+(VAL), and moving/idle flag (DMOV). Workflows and the dashboard want one
+coherent stream per device instead. This module provides that as a
+``MessageSource`` decorator sitting after adaptation: raw substream
+messages claimed by a device are absorbed, and once the device has been
+observed on every substream it is configured with, each further raw sample
+produces one merged ``LogData`` sample on a synthetic
+``StreamKind.DEVICE`` stream.
+
+Merge semantics (the wire contract, shared with the reference):
+
+- emission is *union-anchored*: any claimed substream event triggers an
+  output sample, carrying the latest known value of every other role;
+- the merged sample is stamped ``max`` over the constituent sample times,
+  so it never predates data it includes;
+- batched f144 payloads (multiple samples in one ``LogData``) emit one
+  merged sample per raw sample — intermediate motor positions survive.
 """
 
 from __future__ import annotations
 
 import logging
-from collections.abc import Mapping, Sequence
-from dataclasses import dataclass
-from typing import Literal
+from collections.abc import Iterator, Mapping, Sequence
 
 from ..config.stream import Device
 from ..core.message import Message, MessageSource, StreamId, StreamKind
@@ -24,74 +34,45 @@ __all__ = ["DeviceSynthesizer"]
 
 logger = logging.getLogger(__name__)
 
-_Role = Literal["value", "target", "idle"]
 
+class _Merger:
+    """Latest-known sample per role for one device, and the merge itself."""
 
-@dataclass(slots=True)
-class _Seen:
-    value: float
-    time: Timestamp
+    __slots__ = ("_latest", "_required", "stream")
 
+    def __init__(self, device_name: str, required_roles: frozenset[str]) -> None:
+        self.stream = StreamId(kind=StreamKind.DEVICE, name=device_name)
+        self._required = required_roles
+        self._latest: dict[str, tuple[Timestamp, float]] = {}
 
-@dataclass(slots=True)
-class _DeviceState:
-    device_name: str
-    has_target: bool
-    has_idle: bool
-    value: _Seen | None = None
-    target: _Seen | None = None
-    idle: _Seen | None = None
+    def ingest(self, role: str, log: LogData) -> Iterator[Message[LogData]]:
+        """Fold raw samples in; yield merged samples once bootstrapped."""
+        for raw_ns, raw_value in log.samples():
+            self._latest[role] = (Timestamp.from_ns(int(raw_ns)), float(raw_value))
+            if self._required <= self._latest.keys():
+                yield self._merged()
 
-    def push(self, role: _Role, log: LogData) -> list[Message[LogData]]:
-        """Record substream samples; emit one merged sample per input sample
-        once bootstrapped (LogData may batch several f144 records — each
-        intermediate motor position is retained, none collapsed away)."""
-        out: list[Message[LogData]] = []
-        for time_ns, value in zip(log.time, log.value, strict=True):
-            seen = _Seen(value=float(value), time=Timestamp.from_ns(int(time_ns)))
-            if role == "value":
-                self.value = seen
-            elif role == "target":
-                self.target = seen
-            else:
-                self.idle = seen
-            if self.value is None:
-                continue
-            if self.has_target and self.target is None:
-                continue
-            if self.has_idle and self.idle is None:
-                continue
-            sample_time = max(
-                s.time
-                for s in (self.value, self.target, self.idle)
-                if s is not None
-            )
-            out.append(
-                Message(
-                    timestamp=sample_time,
-                    stream=StreamId(
-                        kind=StreamKind.DEVICE, name=self.device_name
-                    ),
-                    value=LogData(
-                        time=sample_time.ns,
-                        value=self.value.value,
-                        target=self.target.value
-                        if self.target is not None
-                        else None,
-                        idle=bool(self.idle.value)
-                        if self.idle is not None
-                        else None,
-                    ),
-                )
-            )
-        return out
+    def _merged(self) -> Message[LogData]:
+        stamp = max(t for t, _ in self._latest.values())
+        target = self._latest.get("target")
+        idle = self._latest.get("idle")
+        merged = LogData(
+            time=stamp.ns,
+            value=self._latest["value"][1],
+            target=None if target is None else target[1],
+            idle=None if idle is None else bool(idle[1]),
+        )
+        return Message(timestamp=stamp, stream=self.stream, value=merged)
 
 
 class DeviceSynthesizer:
-    """MessageSource decorator synthesizing per-device merged streams.
+    """MessageSource decorator replacing raw substreams with device streams.
 
-    Each substream may be owned by exactly one device; non-owned messages
-    pass through unchanged.
+    ``devices`` maps device name to its substream configuration; the
+    ``value`` substream is mandatory, ``target`` and ``idle`` optional.
+    A raw substream may be claimed by at most one device — a conflicting
+    configuration is rejected at construction, since silently routing one
+    substream into two devices would corrupt both.
     """
 
     def __init__(
@@ -101,44 +82,41 @@ class DeviceSynthesizer:
         devices: Mapping[str, Device],
     ) -> None:
         self._wrapped = wrapped
-        self._by_substream: dict[str, tuple[_DeviceState, _Role]] = {}
-        for name, device in devices.items():
-            state = _DeviceState(
-                device_name=name,
-                has_target=device.target is not None,
-                has_idle=device.idle is not None,
-            )
-            self._register(state, device.value, "value")
-            if device.target is not None:
-                self._register(state, device.target, "target")
-            if device.idle is not None:
-                self._register(state, device.idle, "idle")
-
-    def _register(self, state: _DeviceState, substream: str, role: _Role) -> None:
-        if substream in self._by_substream:
-            other = self._by_substream[substream][0].device_name
-            raise ValueError(
-                f"substream {substream!r} configured for both devices "
-                f"{other!r} and {state.device_name!r}"
-            )
-        self._by_substream[substream] = (state, role)
+        # Routing: raw substream name -> (role, merger for the owning device).
+        self._claims: dict[str, tuple[str, _Merger]] = {}
+        for device_name, spec in devices.items():
+            roles = {"value": spec.value}
+            if spec.target is not None:
+                roles["target"] = spec.target
+            if spec.idle is not None:
+                roles["idle"] = spec.idle
+            merger = _Merger(device_name, frozenset(roles))
+            for role, substream in roles.items():
+                if substream in self._claims:
+                    rival = self._claims[substream][1].stream.name
+                    raise ValueError(
+                        f"devices {rival!r} and {device_name!r} both claim "
+                        f"substream {substream!r}; a raw substream may feed "
+                        "exactly one device"
+                    )
+                self._claims[substream] = (role, merger)
 
     def get_messages(self) -> Sequence[Message]:
         out: list[Message] = []
         for msg in self._wrapped.get_messages():
-            owner = self._by_substream.get(msg.stream.name)
-            if owner is None:
+            claim = self._claims.get(msg.stream.name)
+            if claim is None:
                 out.append(msg)
                 continue
-            state, role = owner
-            if not isinstance(msg.value, LogData):
+            role, merger = claim
+            if isinstance(msg.value, LogData):
+                out.extend(merger.ingest(role, msg.value))
+            else:
                 logger.warning(
                     "device substream %s (%s/%s) carried unexpected payload %s",
                     msg.stream.name,
-                    state.device_name,
+                    merger.stream.name,
                     role,
                     type(msg.value).__name__,
                 )
-                continue
-            out.extend(state.push(role, msg.value))
         return out

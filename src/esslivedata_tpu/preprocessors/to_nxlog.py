@@ -42,6 +42,19 @@ class LogData:
         self.target = target
         self.idle = idle
 
+    def samples(self) -> list[tuple[int, float]]:
+        """``(time_ns, value)`` pairs for consumers that walk sample-wise.
+
+        An f144 payload can carry an array value under a single timestamp
+        (the adapter keeps array values whole); the one timestamp then
+        applies to every element. Mismatched multi-element lengths raise.
+        """
+        if self.time.size == 1 and self.value.size != 1:
+            times: np.ndarray = np.broadcast_to(self.time, self.value.shape)
+        else:
+            times = self.time
+        return list(zip(times.tolist(), self.value.tolist(), strict=True))
+
 
 class ToNXlog:
     """Accumulates log samples into a growing time/value series."""

@@ -17,7 +17,7 @@ logger = logging.getLogger(__name__)
 
 def main(argv: list[str] | None = None) -> int:
     parser = setup_arg_parser("fake f144 log producer")
-    parser.add_argument("--kafka-bootstrap", default="localhost:9092")
+    parser.add_argument("--kafka-bootstrap", default=None, help="override the broker from the kafka config namespace")
     parser.add_argument("--pulses", type=int, default=0)
     parser.add_argument("--dry-run", action="store_true")
     parser.set_defaults(**get_env_defaults(parser))
@@ -35,7 +35,9 @@ def main(argv: list[str] | None = None) -> int:
         try:
             from confluent_kafka import Producer
 
-            producer = Producer({"bootstrap.servers": args.kafka_bootstrap})
+            from ..kafka.consumer import kafka_client_config
+
+            producer = Producer(kafka_client_config(bootstrap_override=args.kafka_bootstrap))
         except ImportError:
             logger.error("confluent_kafka not installed; use --dry-run")
             return 2

@@ -159,7 +159,11 @@ class DataServiceRunner:
             choices=["naive", "simple", "adaptive"],
         )
         parser.add_argument("--job-threads", type=int, default=5)
-        parser.add_argument("--kafka-bootstrap", default="localhost:9092")
+        parser.add_argument(
+            "--kafka-bootstrap",
+            default=None,
+            help="override the broker from the kafka config namespace",
+        )
         parser.add_argument(
             "--check",
             action="store_true",
@@ -198,9 +202,16 @@ class DataServiceRunner:
                 "the fake transport (tests/demos)"
             )
             return 2
+        from ..kafka.consumer import assign_all_partitions, kafka_client_config
+
+        # Full client config (incl. SASL/SSL in prod) from the kafka
+        # config namespace; --kafka-bootstrap only overrides the broker.
+        client_conf = kafka_client_config(
+            bootstrap_override=args.kafka_bootstrap
+        )
         consumer = Consumer(
             {
-                "bootstrap.servers": args.kafka_bootstrap,
+                **client_conf,
                 "group.id": f"{args.instrument}_{self._service_name}",
                 "auto.offset.reset": "latest",
                 "enable.auto.commit": False,
@@ -209,10 +220,8 @@ class DataServiceRunner:
         # Manual assignment pinned at the high watermark — never subscribe:
         # no group rebalancing, no offset commits; a restarted service
         # resumes at live data (kafka/consumer.py, reference consumer.py:31).
-        from ..kafka.consumer import assign_all_partitions
-
         assign_all_partitions(consumer, builder.topics)
-        producer = Producer({"bootstrap.servers": args.kafka_bootstrap})
+        producer = Producer(client_conf)
         service = builder.from_consumer(consumer, producer)
         service.start(blocking=True)
         return service.exit_code

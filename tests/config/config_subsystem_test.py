@@ -69,6 +69,60 @@ class TestRouteDerivation:
         assert "c1/delay" in names
         assert "c1/rotation_speed_setpoint" in names
 
+    def test_unresolvable_source_name_warns(self, caplog) -> None:
+        # A typo'd source_name yields a job waiting forever — the
+        # derivation must say so instead of silently dropping the name.
+        import logging
+
+        from esslivedata_tpu.config.instrument import Instrument
+        from esslivedata_tpu.config.route_derivation import (
+            resolve_stream_names,
+        )
+        from esslivedata_tpu.kafka.stream_mapping import StreamMapping
+
+        inst = Instrument(name="routetypo")
+        mapping = StreamMapping(instrument="routetypo")
+        with caplog.at_level(logging.WARNING):
+            resolved = resolve_stream_names({"panle_0"}, inst, mapping)
+        assert resolved == set()
+        assert any("panle_0" in rec.message for rec in caplog.records)
+
+    def test_synthesized_streams_do_not_warn(self, caplog) -> None:
+        import logging
+
+        from esslivedata_tpu.config.chopper import (
+            CHOPPER_CASCADE_SOURCE,
+            delay_setpoint_stream,
+        )
+        from esslivedata_tpu.config.instrument import Instrument
+        from esslivedata_tpu.config.route_derivation import (
+            resolve_stream_names,
+        )
+        from esslivedata_tpu.kafka.stream_mapping import StreamMapping
+
+        from esslivedata_tpu.config.stream import F144Stream
+
+        inst = Instrument(
+            name="routesynth",
+            streams={
+                "c1/delay": F144Stream(
+                    topic="t_choppers", source="D", units="ns"
+                ),
+                "c1/rotation_speed_setpoint": F144Stream(
+                    topic="t_choppers", source="S", units="Hz"
+                ),
+            },
+            choppers=["c1"],
+        )
+        mapping = StreamMapping(instrument="routesynth")
+        with caplog.at_level(logging.WARNING):
+            resolve_stream_names(
+                {CHOPPER_CASCADE_SOURCE, delay_setpoint_stream("c1")},
+                inst,
+                mapping,
+            )
+        assert not caplog.records
+
     def test_gather_expands_devices(self) -> None:
         from esslivedata_tpu.config.instrument import Instrument
         from esslivedata_tpu.config.stream import Device, F144Stream

@@ -3,7 +3,7 @@
 from esslivedata_tpu.config import JobId, WorkflowConfig
 from esslivedata_tpu.core.command_dispatcher import CommandDispatcher
 from esslivedata_tpu.core.job_manager import JobFactory, JobManager
-from esslivedata_tpu.core.message import COMMANDS_STREAM_ID, Message
+from esslivedata_tpu.core.message import COMMAND_STREAM, Message
 
 
 def dispatcher(service_name: str) -> CommandDispatcher:
@@ -22,7 +22,7 @@ def start_msg() -> Message:
 
     instrument_registry["bifrost"].load_factories()
     return Message(
-        stream=COMMANDS_STREAM_ID,
+        stream=COMMAND_STREAM,
         value=WorkflowConfig(
             identifier=MULTIBANK_HANDLE.workflow_id,
             job_id=JobId(source_name="detector"),

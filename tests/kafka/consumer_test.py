@@ -63,3 +63,60 @@ class TestAssignment:
         consumer = FakeConsumer({"x": 1})
         with pytest.raises(ValueError, match=r"\['a', 'b'\]"):
             validate_topics_exist(consumer, ["a", "b", "x"])
+
+
+class TestLibrdkafkaConfig:
+    def test_translates_all_loader_keys(self) -> None:
+        from esslivedata_tpu.kafka.consumer import librdkafka_config
+
+        conf = librdkafka_config(
+            {
+                "bootstrap_servers": "broker:9093",
+                "security_protocol": "SASL_SSL",
+                "sasl_mechanism": "SCRAM-SHA-256",
+                "sasl_username": "svc",
+                "sasl_password": "secret",
+            }
+        )
+        assert conf == {
+            "bootstrap.servers": "broker:9093",
+            "security.protocol": "SASL_SSL",
+            "sasl.mechanism": "SCRAM-SHA-256",
+            "sasl.username": "svc",
+            "sasl.password": "secret",
+        }
+
+    def test_empty_config_defaults_to_localhost(self) -> None:
+        from esslivedata_tpu.kafka.consumer import librdkafka_config
+
+        assert librdkafka_config({}) == {
+            "bootstrap.servers": "localhost:9092"
+        }
+
+    def test_unknown_key_rejected_not_dropped(self) -> None:
+        from esslivedata_tpu.kafka.consumer import librdkafka_config
+
+        with pytest.raises(ValueError, match="sasl_kerberos_principal"):
+            librdkafka_config({"sasl_kerberos_principal": "x"})
+
+    def test_prod_yaml_keys_all_translate(self, monkeypatch) -> None:
+        # Every key the shipped prod template declares must be accepted —
+        # a dropped security_protocol means a silent PLAINTEXT attempt
+        # against a SASL broker.
+        from esslivedata_tpu.config.config_loader import load_config
+        from esslivedata_tpu.kafka.consumer import librdkafka_config
+
+        monkeypatch.setenv("LIVEDATA_KAFKA_BOOTSTRAP", "b:9093")
+        monkeypatch.setenv("LIVEDATA_KAFKA_USER", "u")
+        monkeypatch.setenv("LIVEDATA_KAFKA_PASSWORD", "p")
+        conf = librdkafka_config(load_config(namespace="kafka", env="prod"))
+        assert conf["security.protocol"] == "SASL_SSL"
+        assert conf["sasl.username"] == "u"
+        assert conf["sasl.password"] == "p"
+        assert conf["bootstrap.servers"] == "b:9093"
+
+    def test_client_config_bootstrap_override_wins(self) -> None:
+        from esslivedata_tpu.kafka.consumer import kafka_client_config
+
+        conf = kafka_client_config(bootstrap_override="other:9092")
+        assert conf["bootstrap.servers"] == "other:9092"

@@ -103,7 +103,7 @@ class TestDeviceSynthesizer:
         assert syn.get_messages() == [msg]
 
     def test_substream_owned_by_two_devices_rejected(self) -> None:
-        with pytest.raises(ValueError, match="configured for both"):
+        with pytest.raises(ValueError, match="both claim"):
             DeviceSynthesizer(
                 ListSource(),
                 devices={"a": make_device(), "b": make_device()},
@@ -119,12 +119,21 @@ class TestDeviceSynthesizer:
 
 
 class TestChopperSynthesizer:
-    def test_chopperless_emits_single_initial_tick(self) -> None:
+    def test_chopperless_tick_deferred_until_first_data_time(self) -> None:
+        # The bootstrap tick rides the data clock: with no input yet there
+        # is no data time, so no tick (a wall-clock tick could land outside
+        # every batch window on replay and orphan the LUT trigger).
         src = ListSource()
         syn = ChopperSynthesizer(src)
-        (tick,) = syn.get_messages()
-        assert tick.stream.name == CHOPPER_CASCADE_SOURCE
         assert syn.get_messages() == []
+        msg = log_msg("anything", 777, 1.0)
+        src.push(msg)
+        out = list(syn.get_messages())
+        ticks = [m for m in out if m.stream.name == CHOPPER_CASCADE_SOURCE]
+        assert len(ticks) == 1
+        assert ticks[0].timestamp.ns == 777
+        assert msg in out
+        assert syn.get_messages() == []  # emitted exactly once
 
     def test_forwards_everything_verbatim(self) -> None:
         src = ListSource()
@@ -186,6 +195,33 @@ class TestChopperSynthesizer:
             m.stream.name == delay_setpoint_stream("c1") for m in out
         )
 
+    def test_setpoint_stamped_at_locking_sample_not_batch_end(self) -> None:
+        # A single batched f144 payload holds a plateau (locks at the 5th
+        # sample) followed by the start of a new ramp; the synthesized
+        # setpoint must carry the plateau-completing sample's time, not the
+        # newer ramp samples' time at the end of the batch.
+        src = ListSource()
+        syn = ChopperSynthesizer(src, chopper_names=["c1"], delay_atol=100.0)
+        src.push(log_msg(speed_setpoint_stream("c1"), 0, 14.0))
+        syn.get_messages()
+        times = [10, 20, 30, 40, 50, 60, 70]
+        values = [5000.0, 5001.0, 5002.0, 5003.0, 5004.0, 9000.0, 12000.0]
+        src.push(
+            Message(
+                timestamp=Timestamp.from_ns(times[-1]),
+                stream=StreamId(
+                    kind=StreamKind.LOG, name=delay_readback_stream("c1")
+                ),
+                value=LogData(time=times, value=values),
+            )
+        )
+        out = syn.get_messages()
+        (setpoint,) = [
+            m for m in out if m.stream.name == delay_setpoint_stream("c1")
+        ]
+        assert setpoint.timestamp.ns == 50
+        assert setpoint.value.time[0] == 50
+
     def test_cascade_reemitted_on_speed_change(self) -> None:
         src = ListSource()
         syn = ChopperSynthesizer(src, chopper_names=["c1"], delay_atol=100.0)
@@ -246,7 +282,7 @@ class TestCascadeRefresh:
     def test_refresh_tick_rides_data_clock(self) -> None:
         src = ListSource()
         syn = ChopperSynthesizer(src, refresh_every=2)  # chopperless
-        syn.get_messages()  # bootstrap tick (wall clock: no data yet)
+        syn.get_messages()  # no data time yet -> no tick
         src.push(log_msg("x", 12345, 1.0))
         out = []
         for _ in range(3):
@@ -254,3 +290,40 @@ class TestCascadeRefresh:
         refresh = [m for m in out if m.stream.name == CHOPPER_CASCADE_SOURCE]
         assert refresh
         assert all(m.timestamp.ns == 12345 for m in refresh)
+
+
+class TestArrayValuedF144:
+    """f144 array values arrive with a single timestamp (the adapter keeps
+    array values whole); sample-wise consumers broadcast, not crash."""
+
+    def _array_msg(self, stream: str, t_ns: int, values) -> Message:
+        return Message(
+            timestamp=Timestamp.from_ns(t_ns),
+            stream=StreamId(kind=StreamKind.LOG, name=stream),
+            value=LogData(time=t_ns, value=values),
+        )
+
+    def test_chopper_delay_accepts_array_value(self) -> None:
+        src = ListSource()
+        syn = ChopperSynthesizer(src, chopper_names=["c1"], delay_atol=100.0)
+        src.push(log_msg(speed_setpoint_stream("c1"), 0, 14.0))
+        syn.get_messages()
+        src.push(
+            self._array_msg(
+                delay_readback_stream("c1"), 50, [5000.0] * 5
+            )
+        )
+        out = syn.get_messages()
+        setpoints = [
+            m for m in out if m.stream.name == delay_setpoint_stream("c1")
+        ]
+        assert len(setpoints) == 1
+        assert setpoints[0].timestamp.ns == 50
+
+    def test_device_substream_accepts_array_value(self) -> None:
+        src = ListSource()
+        syn = DeviceSynthesizer(src, devices={"m": make_device()})
+        src.push(self._array_msg("motor/value", 10, [1.0, 2.0, 3.0]))
+        out = syn.get_messages()
+        assert [m.value.value[0] for m in out] == [1.0, 2.0, 3.0]
+        assert all(m.timestamp.ns == 10 for m in out)
