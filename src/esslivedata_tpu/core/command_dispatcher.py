@@ -105,10 +105,9 @@ class CommandDispatcher:
             logger.warning("Malformed job command: %r", payload)
             return None
         try:
-            self._job_manager.handle_command(command)
+            if self._job_manager.handle_command(command) == 0:
+                return None  # not our job: silent (another service owns it)
             status, message = "ack", ""
-        except KeyError:
-            return None  # not our job: silent (another service owns it)
         except Exception as err:
             status, message = "error", f"{type(err).__name__}: {err}"
         return CommandAcknowledgement(

@@ -179,7 +179,13 @@ class Job:
     ) -> bool:
         """Feed one window of stream-keyed data; returns True if any of it
         was for this job."""
-        relevant = {k: v for k, v in data.items() if k in self.subscribed_streams}
+        if all(k in self.subscribed_streams for k in data):
+            # Common case: the JobManager pre-filters per job — no copy.
+            relevant: Mapping[str, Any] = data
+        else:
+            relevant = {
+                k: v for k, v in data.items() if k in self.subscribed_streams
+            }
         if not relevant:
             return False
         if start is not None and self._generation_start is None:

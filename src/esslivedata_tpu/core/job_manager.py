@@ -15,6 +15,7 @@ default thread count stays modest (reference default 5).
 
 from __future__ import annotations
 
+import bisect
 import logging
 import threading
 import uuid
@@ -82,7 +83,12 @@ class _JobRecord:
     error: str = ""
     warning: str = ""
     has_primary_data: bool = False
-    pending_reset: bool = False
+    # Context streams whose latest cached value this job has not received
+    # yet. Persisted across windows so an update arriving while the job is
+    # idle (no data, nothing pending) is delivered before its next add —
+    # a fresh value is queued once and stays queued until a successful
+    # set_context.
+    stale_context: set[str] = field(default_factory=set)
 
     @property
     def state(self) -> JobState:
@@ -92,6 +98,10 @@ class _JobRecord:
             return JobState.STOPPED
         if self.finishing:
             return JobState.FINISHING
+        if self.phase == _Phase.PENDING_CONTEXT:
+            # More informative than WARNING; the missing-context warning
+            # still rides the status message field.
+            return JobState.PENDING_CONTEXT
         if self.warning:
             return JobState.WARNING
         return JobState(self.phase.value)
@@ -109,6 +119,11 @@ class JobManager:
         self._factory = job_factory or JobFactory()
         self._records: dict[JobId, _JobRecord] = {}
         self._lock = threading.RLock()
+        # Reset times scheduled by run transitions, sorted; each fires when
+        # DATA time reaches it (reference :486-501) — never on arrival
+        # order, so a run-start announced ahead of the data stream resets
+        # exactly at the boundary even if messages straddle it.
+        self._pending_reset_times: list[Timestamp] = []
         self._executor = (
             ThreadPoolExecutor(max_workers=job_threads, thread_name_prefix="job")
             if job_threads > 1
@@ -127,35 +142,70 @@ class JobManager:
             logger.info("Scheduled job %s (%s)", config.job_id, config.identifier)
             return config.job_id
 
-    def handle_command(self, command: JobCommand) -> None:
+    def handle_command(self, command: JobCommand) -> int:
+        """Apply ``command``; return how many jobs it acted on.
+
+        Zero for an unknown job is routine, not exceptional: every service
+        sees the shared commands topic but owns a disjoint job set, and a
+        non-owner must stay silent (the dispatcher acks only on count > 0).
+        """
         job_id = JobId(
             source_name=command.source_name, job_number=command.job_number
         )
         with self._lock:
             rec = self._records.get(job_id)
             if rec is None:
-                raise KeyError(f"Unknown job {job_id}")
+                return 0
             if command.action == "stop":
+                # Graceful: the job processes one more window and flushes a
+                # final result before leaving the active set.
                 rec.finishing = True
             elif command.action == "remove":
                 rec.phase = _Phase.STOPPED
                 del self._records[job_id]
             elif command.action == "reset":
-                rec.job.clear()
-                rec.has_primary_data = False
-                rec.error = ""
+                self._reset_record(rec)
+            return 1
 
     # -- run transitions ---------------------------------------------------
     def handle_run_transition(self, event: RunStart | RunStop) -> None:
-        """RunStart resets accumulated state of opted-in jobs (reference
-        deferred reset semantics :486-501 — here applied at the next batch
-        boundary via pending_reset, preserving the data-time ordering)."""
-        if isinstance(event, RunStart):
-            with self._lock:
-                for rec in self._records.values():
-                    if rec.job.reset_on_run_transition:
-                        rec.pending_reset = True
-            logger.info("Run start %r: queued resets", event.run_name)
+        """Schedule deferred resets at the run boundary's data time."""
+        with self._lock:
+            if isinstance(event, RunStart):
+                bisect.insort(self._pending_reset_times, event.start_time)
+                if event.stop_time is not None:
+                    bisect.insort(self._pending_reset_times, event.stop_time)
+                logger.info(
+                    "Run start %r: reset scheduled at %s",
+                    event.run_name,
+                    event.start_time,
+                )
+            else:
+                bisect.insort(self._pending_reset_times, event.stop_time)
+                logger.info(
+                    "Run stop %r: reset scheduled at %s",
+                    event.run_name,
+                    event.stop_time,
+                )
+
+    def _fire_pending_resets(self, data_time: Timestamp) -> None:
+        """Fire every scheduled reset that data time has now reached."""
+        due = bisect.bisect_right(self._pending_reset_times, data_time)
+        if not due:
+            return
+        del self._pending_reset_times[:due]
+        for rec in self._records.values():
+            if rec.job.reset_on_run_transition:
+                self._reset_record(rec)
+
+    def _reset_record(self, rec: _JobRecord) -> None:
+        """Clear accumulation and retry/error state; phase is unchanged
+        (context is sticky across run boundaries, so a gated job stays
+        gated)."""
+        rec.job.clear()
+        rec.has_primary_data = False
+        rec.error = ""
+        rec.warning = ""
 
     # -- phase machine -----------------------------------------------------
     def _advance_to_time(self, data_time: Timestamp) -> None:
@@ -169,20 +219,41 @@ class JobManager:
                         if job.context_keys
                         else _Phase.ACTIVE
                     )
-            if rec.phase == _Phase.ACTIVE:
+            if rec.phase in (_Phase.ACTIVE, _Phase.PENDING_CONTEXT):
+                # A job still gated on context can also reach its end time
+                # and must finish (reference :375-377).
                 end = job.schedule.end
                 if end is not None and data_time >= end:
                     rec.finishing = True
 
-    def _open_context_gates(self, context: Mapping[str, Any]) -> None:
+    def _open_context_gates(
+        self, context: Mapping[str, Any]
+    ) -> set[JobId]:
         """pending_context -> active once every needed context stream has a
-        value (ADR 0002)."""
-        for rec in self._records.values():
+        value (ADR 0002); still-gated jobs carry a warning naming what is
+        missing, so the dashboard shows why nothing is produced.
+
+        Returns the ids of jobs that graduated in this pass — they received
+        the full cached context here and must not get a second (partial)
+        delivery from the processing fan-out.
+        """
+        graduated: set[JobId] = set()
+        for job_id, rec in self._records.items():
             if rec.phase != _Phase.PENDING_CONTEXT:
                 continue
-            if all(k in context for k in rec.job.context_keys):
+            missing = {k for k in rec.job.context_keys if k not in context}
+            if missing:
+                rec.warning = (
+                    "Waiting for context streams: "
+                    + ", ".join(sorted(missing))
+                )
+            else:
                 rec.job.set_context(context)
                 rec.phase = _Phase.ACTIVE
+                rec.warning = ""
+                rec.stale_context.clear()
+                graduated.add(job_id)
+        return graduated
 
     def peek_pending_streams(self) -> set[str]:
         """Context streams still gating some job (the processor uses this
@@ -200,53 +271,114 @@ class JobManager:
         data: Mapping[str, Any],
         *,
         context: Mapping[str, Any] | None = None,
+        fresh_context: set[str] | None = None,
         start: Timestamp | None = None,
         end: Timestamp | None = None,
     ) -> list[JobResult]:
-        """One window: advance phases, open gates, fan per-job add+finalize
-        over the thread pool, contain per-job errors."""
+        """One window: fire due resets, advance phases, open gates, fan
+        per-job add+finalize over the thread pool, contain per-job errors.
+
+        ``fresh_context`` names the context streams that received data in
+        THIS batch; active jobs get ``set_context`` only for those, so an
+        unchanged cached motor position does not re-fire downstream
+        recompute every window (reference avoids steady-state context
+        refill for the same reason, :596-618). ``None`` means unknown —
+        deliver everything (test shims).
+
+        Per-job data is filtered to the streams the job subscribes to
+        (reference ``_filter_data_for_job:726``): a job never sees — and
+        never pays staging time for — another job's streams.
+        """
         context = context or {}
         with self._lock:
             if end is not None:
+                self._fire_pending_resets(end)
                 self._advance_to_time(end)
-            self._open_context_gates(context)
-            active = [
-                rec
-                for rec in self._records.values()
-                if rec.phase == _Phase.ACTIVE
-            ]
+            graduated = self._open_context_gates(context)
+            # Queue fresh context for later delivery. None = unknown
+            # freshness (test shims): queue everything, restoring
+            # every-window delivery.
+            queued = set(context) if fresh_context is None else fresh_context
+            if queued:
+                for job_id, rec in self._records.items():
+                    if rec.phase == _Phase.ACTIVE and job_id not in graduated:
+                        rec.stale_context |= queued & rec.job.context_keys
+            work: list[tuple[_JobRecord, dict[str, Any]]] = []
+            for rec in self._records.values():
+                if rec.phase != _Phase.ACTIVE:
+                    continue
+                job_data = {
+                    k: v
+                    for k, v in data.items()
+                    if k in rec.job.subscribed_streams
+                }
+                # Skip jobs with nothing to do: no fresh data and nothing
+                # pending finalize. A finishing job is still ACTIVE here —
+                # it leaves only after this pass — so the window that
+                # carried it past its end time is flushed before stopping.
+                # (Queued context survives the skip and is delivered before
+                # the job's next add.)
+                if job_data or rec.has_primary_data:
+                    work.append((rec, job_data))
 
-        def run_one(rec: _JobRecord) -> JobResult | None:
+        def run_one(item: tuple[_JobRecord, dict[str, Any]]) -> JobResult | None:
+            rec, job_data = item
             job = rec.job
+            # Deliver pending context in its own try: a failure keeps the
+            # names queued (retried next window) and does not block this
+            # window's accumulation.
+            context_warning = ""
+            if rec.stale_context:
+                try:
+                    job.set_context(
+                        {
+                            k: context[k]
+                            for k in rec.stale_context
+                            if k in context
+                        }
+                    )
+                    rec.stale_context.clear()
+                except Exception as err:
+                    context_warning = f"{type(err).__name__}: {err}"
+                    logger.exception(
+                        "Job %s failed applying context", job.job_id
+                    )
+            # Accumulate: a failure here is a warning — the job may still
+            # be able to finalize previously accumulated data. A successful
+            # add must not mask an unresolved context failure.
             try:
-                if rec.pending_reset:
-                    job.clear()
-                    rec.pending_reset = False
-                    rec.has_primary_data = False
-                job.set_context(context)
-                touched = job.add(data, start=start, end=end)
-                if touched and any(
-                    k in data for k in job.primary_streams
-                ):
+                touched = job.add(job_data, start=start, end=end)
+                if touched and any(k in job_data for k in job.primary_streams):
                     rec.has_primary_data = True
-                if not rec.has_primary_data:
-                    return None
+                rec.warning = context_warning
+            except Exception as err:
+                rec.warning = f"{type(err).__name__}: {err}"
+                logger.exception("Job %s failed accumulating", job.job_id)
+            if not rec.has_primary_data:
+                return None
+            # Finalize: a failure here is an error; has_primary_data stays
+            # set so the next window retries.
+            try:
                 result = job.get()
-                rec.warning = ""
+                rec.error = ""
+                rec.has_primary_data = False
                 return result
             except Exception as err:
                 rec.error = f"{type(err).__name__}: {err}"
-                logger.exception("Job %s failed", job.job_id)
+                logger.exception("Job %s failed finalizing", job.job_id)
                 return None
 
-        if self._executor is not None and len(active) > 1:
-            results = list(self._executor.map(run_one, active))
+        if self._executor is not None and len(work) > 1:
+            results = list(self._executor.map(run_one, work))
         else:
-            results = [run_one(rec) for rec in active]
+            results = [run_one(item) for item in work]
 
         with self._lock:
             for rec in list(self._records.values()):
-                if rec.finishing and rec.phase == _Phase.ACTIVE:
+                if rec.finishing and rec.phase in (
+                    _Phase.ACTIVE,
+                    _Phase.PENDING_CONTEXT,
+                ):
                     rec.phase = _Phase.STOPPED
         return [r for r in results if r is not None]
 

@@ -117,6 +117,24 @@ class MessagePreprocessor:
                 continue
         return out
 
+    def fresh_context_names(self) -> set[str]:
+        """Context streams that received data in this batch.
+
+        The JobManager delivers ``set_context`` to active jobs only for
+        these, so an unchanged cached value never re-fires downstream
+        recompute. Must be read before :meth:`release` clears the batch's
+        touched set.
+        """
+        out: set[str] = set()
+        for stream in self._touched:
+            acc = self._accumulators.get(stream)
+            if acc is not None and (
+                getattr(acc, "is_context", False)
+                or getattr(acc, "also_context", False)
+            ):
+                out.add(stream.name)
+        return out
+
     def release(self) -> None:
         for stream in self._touched:
             self._accumulators[stream].release_buffers()
@@ -206,10 +224,15 @@ class OrchestratingProcessor:
             self._preprocessor.preprocess(batch.messages)
             window = self._preprocessor.collect_window()
             context = self._preprocessor.collect_context()
+            fresh_context = self._preprocessor.fresh_context_names()
         self._record_lag(batch)
         with self.stage_timer.stage("process_jobs"):
             results = self._job_manager.process_jobs(
-                window, context=context, start=batch.start, end=batch.end
+                window,
+                context=context,
+                fresh_context=fresh_context,
+                start=batch.start,
+                end=batch.end,
             )
         try:
             with self.stage_timer.stage("publish"):

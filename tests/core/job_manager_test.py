@@ -180,13 +180,26 @@ class TestCommands:
         )
         assert manager.n_jobs == 0
 
-    def test_unknown_job_raises(self, manager):
-        with pytest.raises(KeyError):
-            manager.handle_command(
-                JobCommand(
-                    action="stop", source_name="zz", job_number=uuid.uuid4()
-                )
+    def test_unknown_job_is_tolerated(self, manager):
+        # Routine on the shared commands topic: another service owns the
+        # job. Zero acted-on jobs, no exception, and the caller (dispatcher)
+        # stays silent so exactly one service across the fleet replies.
+        count = manager.handle_command(
+            JobCommand(action="stop", source_name="zz", job_number=uuid.uuid4())
+        )
+        assert count == 0
+
+    def test_known_job_command_reports_one_acted_on(self, registry, manager):
+        config = start_config(registry)
+        manager.schedule_job(config)
+        count = manager.handle_command(
+            JobCommand(
+                action="stop",
+                source_name="bank0",
+                job_number=config.job_id.job_number,
             )
+        )
+        assert count == 1
 
 
 class TestErrorContainment:
@@ -221,3 +234,304 @@ class TestThreadFanOut:
         totals = sorted(float(r.outputs["total"].values) for r in results)
         assert totals == [1.0, 2.0]
         manager.shutdown()
+
+
+def get_workflow(manager, source="bank0"):
+    [rec] = [
+        r
+        for jid, r in manager._records.items()
+        if jid.source_name == source
+    ]
+    return rec.job.workflow
+
+
+class TestDeferredResets:
+    """Run-transition resets fire on DATA time, not arrival order
+    (reference run_transition_test.py scenario semantics)."""
+
+    def run_start(self, manager, at_ns, stop_ns=None):
+        manager.handle_run_transition(
+            RunStart(
+                run_name="r1",
+                start_time=T(at_ns),
+                stop_time=None if stop_ns is None else T(stop_ns),
+            )
+        )
+
+    def test_reset_does_not_fire_before_scheduled_time(
+        self, registry, manager
+    ):
+        manager.schedule_job(start_config(registry))
+        manager.process_jobs({"bank0": 5.0}, start=T(0), end=T(10))
+        self.run_start(manager, at_ns=1000)
+        manager.process_jobs({"bank0": 1.0}, start=T(10), end=T(20))
+        assert get_workflow(manager).clear_calls == 0
+        assert get_workflow(manager).total == 6.0
+
+    def test_reset_fires_when_data_reaches_scheduled_time(
+        self, registry, manager
+    ):
+        manager.schedule_job(start_config(registry))
+        manager.process_jobs({"bank0": 5.0}, start=T(0), end=T(10))
+        self.run_start(manager, at_ns=1000)
+        manager.process_jobs({"bank0": 1.0}, start=T(990), end=T(1100))
+        wf = get_workflow(manager)
+        assert wf.clear_calls == 1
+        # The reset applies before the window is accumulated.
+        assert wf.total == 1.0
+
+    def test_reset_fires_on_run_stop(self, registry, manager):
+        from esslivedata_tpu.core.message import RunStop
+
+        manager.schedule_job(start_config(registry))
+        manager.process_jobs({"bank0": 5.0}, start=T(0), end=T(10))
+        manager.handle_run_transition(
+            RunStop(run_name="r1", stop_time=T(500))
+        )
+        manager.process_jobs({"bank0": 2.0}, start=T(400), end=T(600))
+        assert get_workflow(manager).clear_calls == 1
+
+    def test_past_reset_time_fires_on_next_data(self, registry, manager):
+        manager.schedule_job(start_config(registry))
+        manager.process_jobs({"bank0": 5.0}, start=T(0), end=T(1000))
+        self.run_start(manager, at_ns=500)  # already in the data past
+        manager.process_jobs({"bank0": 1.0}, start=T(1000), end=T(1100))
+        assert get_workflow(manager).clear_calls == 1
+
+    def test_run_start_with_stop_time_schedules_two_resets(
+        self, registry, manager
+    ):
+        manager.schedule_job(start_config(registry))
+        self.run_start(manager, at_ns=100, stop_ns=1000)
+        manager.process_jobs({"bank0": 1.0}, start=T(50), end=T(200))
+        assert get_workflow(manager).clear_calls == 1
+        manager.process_jobs({"bank0": 1.0}, start=T(900), end=T(1100))
+        assert get_workflow(manager).clear_calls == 2
+
+    def test_multiple_pending_resets_collapse_within_batch(
+        self, registry, manager
+    ):
+        manager.schedule_job(start_config(registry))
+        self.run_start(manager, at_ns=100)
+        self.run_start(manager, at_ns=200)
+        self.run_start(manager, at_ns=300)
+        manager.process_jobs({"bank0": 1.0}, start=T(0), end=T(1000))
+        # All three were due in one window: one reset, not three.
+        assert get_workflow(manager).clear_calls == 1
+
+    def test_pending_resets_persist_without_data(self, registry, manager):
+        manager.schedule_job(start_config(registry))
+        self.run_start(manager, at_ns=500)
+        manager.process_jobs({}, start=None, end=None)  # no window closed
+        manager.process_jobs({"bank0": 1.0}, start=T(400), end=T(600))
+        assert get_workflow(manager).clear_calls == 1
+
+    def test_skips_jobs_with_flag_disabled(self, registry, manager):
+        spec = WorkflowSpec(
+            instrument="dummy",
+            name="sticky",
+            source_names=["bank1"],
+            reset_on_run_transition=False,
+        )
+        registry.register_spec(spec).attach_factory(
+            lambda *, source_name, params: CountingWorkflow()
+        )
+        manager.schedule_job(start_config(registry))
+        manager.schedule_job(
+            start_config(registry, name="sticky", source="bank1")
+        )
+        manager.process_jobs(
+            {"bank0": 1.0, "bank1": 2.0}, start=T(0), end=T(10)
+        )
+        self.run_start(manager, at_ns=100)
+        manager.process_jobs(
+            {"bank0": 1.0, "bank1": 2.0}, start=T(90), end=T(200)
+        )
+        assert get_workflow(manager, "bank0").clear_calls == 1
+        assert get_workflow(manager, "bank1").clear_calls == 0
+
+
+class TestPerJobFiltering:
+    def test_job_sees_only_subscribed_streams(self, registry, manager):
+        seen: dict[str, list] = {"streams": []}
+
+        class RecordingWorkflow(CountingWorkflow):
+            def accumulate(self, data):
+                seen["streams"].append(set(data))
+                super().accumulate(data)
+
+        spec = WorkflowSpec(
+            instrument="dummy", name="rec", source_names=["bank0"]
+        )
+        registry.register_spec(spec).attach_factory(
+            lambda *, source_name, params: RecordingWorkflow()
+        )
+        manager.schedule_job(start_config(registry, name="rec"))
+        manager.process_jobs(
+            {"bank0": 1.0, "bank1": 2.0, "unrelated": 3.0},
+            start=T(0),
+            end=T(10),
+        )
+        assert seen["streams"] == [{"bank0"}]
+
+    def test_idle_job_not_finalized_without_new_data(self, registry, manager):
+        manager.schedule_job(start_config(registry))
+        manager.process_jobs({"bank0": 1.0}, start=T(0), end=T(10))
+        wf = get_workflow(manager)
+        assert wf.finalize_calls == 1
+        # Window with data for OTHER streams only: no result, no finalize.
+        results = manager.process_jobs({"zz": 1.0}, start=T(10), end=T(20))
+        assert results == []
+        assert wf.finalize_calls == 1
+
+
+class TestErrorSplit:
+    def test_finalize_error_retries_next_window(self, registry, manager):
+        class FlakyWorkflow(CountingWorkflow):
+            def finalize(self):
+                if self.finalize_calls == 0:
+                    self.finalize_calls += 1
+                    raise RuntimeError("transient")
+                return super().finalize()
+
+        spec = WorkflowSpec(
+            instrument="dummy", name="flaky", source_names=["bank0"]
+        )
+        registry.register_spec(spec).attach_factory(
+            lambda *, source_name, params: FlakyWorkflow()
+        )
+        manager.schedule_job(start_config(registry, name="flaky"))
+        assert manager.process_jobs({"bank0": 1.0}, start=T(0), end=T(10)) == []
+        [status] = manager.job_statuses()
+        assert status.state == JobState.ERROR
+        # No new primary data, but has_primary_data is sticky after the
+        # failed finalize: the next window retries and recovers.
+        results = manager.process_jobs({}, start=T(10), end=T(20))
+        assert len(results) == 1
+        [status] = manager.job_statuses()
+        assert status.state == JobState.ACTIVE
+
+    def test_accumulate_error_is_warning_and_old_data_still_finalizes(
+        self, registry, manager
+    ):
+        class BadAddWorkflow(CountingWorkflow):
+            def accumulate(self, data):
+                if any(v < 0 for v in data.values()):
+                    raise ValueError("negative counts")
+                super().accumulate(data)
+
+        spec = WorkflowSpec(
+            instrument="dummy", name="badadd", source_names=["bank0"]
+        )
+        registry.register_spec(spec).attach_factory(
+            lambda *, source_name, params: BadAddWorkflow()
+        )
+        manager.schedule_job(start_config(registry, name="badadd"))
+        manager.process_jobs({"bank0": 1.0}, start=T(0), end=T(10))
+        # Poisoned window: add fails -> warning, not error; nothing pending
+        # so no result this window.
+        results = manager.process_jobs({"bank0": -1.0}, start=T(10), end=T(20))
+        assert results == []
+        [status] = manager.job_statuses()
+        assert status.state == JobState.WARNING
+        # Healthy data clears the warning.
+        results = manager.process_jobs({"bank0": 2.0}, start=T(20), end=T(30))
+        assert len(results) == 1
+        [status] = manager.job_statuses()
+        assert status.state == JobState.ACTIVE
+
+
+class TestFreshContextDelivery:
+    def test_unchanged_context_not_redelivered(self, registry, manager):
+        calls: list[dict] = []
+
+        class CtxWorkflow(CountingWorkflow):
+            def set_context(self, ctx):
+                calls.append(dict(ctx))
+                super().set_context(ctx)
+
+        spec = WorkflowSpec(
+            instrument="dummy",
+            name="ctx",
+            source_names=["bank0"],
+            context_keys=["motor_x"],
+        )
+        registry.register_spec(spec).attach_factory(
+            lambda *, source_name, params: CtxWorkflow()
+        )
+        manager.schedule_job(start_config(registry, name="ctx"))
+        # Gate opens: full context delivered once.
+        manager.process_jobs(
+            {"bank0": 1.0},
+            context={"motor_x": 5.0},
+            fresh_context={"motor_x"},
+            start=T(0),
+            end=T(10),
+        )
+        assert calls == [{"motor_x": 5.0}]
+        # Cached, unchanged context: not redelivered to the active job.
+        manager.process_jobs(
+            {"bank0": 1.0},
+            context={"motor_x": 5.0},
+            fresh_context=set(),
+            start=T(10),
+            end=T(20),
+        )
+        assert calls == [{"motor_x": 5.0}]
+        # A fresh sample is delivered.
+        manager.process_jobs(
+            {"bank0": 1.0},
+            context={"motor_x": 6.0},
+            fresh_context={"motor_x"},
+            start=T(20),
+            end=T(30),
+        )
+        assert calls == [{"motor_x": 5.0}, {"motor_x": 6.0}]
+
+    def test_context_delivered_after_idle_window(self, registry, manager):
+        # Beam-off gap: a window carries ONLY a context update; the idle job
+        # (no data, nothing pending) is skipped, but the update must not be
+        # lost — it is delivered before the job's next accumulate.
+        calls: list[dict] = []
+
+        class CtxWorkflow(CountingWorkflow):
+            def set_context(self, ctx):
+                calls.append(dict(ctx))
+                super().set_context(ctx)
+
+        spec = WorkflowSpec(
+            instrument="dummy",
+            name="ctx2",
+            source_names=["bank0"],
+            context_keys=["motor_x"],
+        )
+        registry.register_spec(spec).attach_factory(
+            lambda *, source_name, params: CtxWorkflow()
+        )
+        manager.schedule_job(start_config(registry, name="ctx2"))
+        manager.process_jobs(
+            {"bank0": 1.0},
+            context={"motor_x": 5.0},
+            fresh_context={"motor_x"},
+            start=T(0),
+            end=T(10),
+        )
+        assert calls == [{"motor_x": 5.0}]
+        # Context-only window: job idle, value queued.
+        manager.process_jobs(
+            {},
+            context={"motor_x": 7.0},
+            fresh_context={"motor_x"},
+            start=T(10),
+            end=T(20),
+        )
+        assert calls == [{"motor_x": 5.0}]
+        # Data resumes: the queued update arrives before the add.
+        manager.process_jobs(
+            {"bank0": 1.0},
+            context={"motor_x": 7.0},
+            fresh_context=set(),
+            start=T(20),
+            end=T(30),
+        )
+        assert calls == [{"motor_x": 5.0}, {"motor_x": 7.0}]
