@@ -83,6 +83,10 @@ class _JobRecord:
     error: str = ""
     warning: str = ""
     has_primary_data: bool = False
+    # A run-transition reset whose workflow.clear() failed; retried before
+    # the job may accumulate again, so data from the old and new run can
+    # never mix in a wedged workflow.
+    needs_reset: bool = False
     # Context streams whose latest cached value this job has not received
     # yet. Persisted across windows so an update arriving while the job is
     # idle (no data, nothing pending) is delivered before its next add —
@@ -201,8 +205,18 @@ class JobManager:
     def _reset_record(self, rec: _JobRecord) -> None:
         """Clear accumulation and retry/error state; phase is unchanged
         (context is sticky across run boundaries, so a gated job stays
-        gated)."""
-        rec.job.clear()
+        gated). A workflow whose clear() raises keeps its error recorded
+        and does not take the other jobs' resets down with it; the record
+        is flagged ``needs_reset`` and excluded from processing until a
+        retry succeeds, so old-run and new-run data cannot mix."""
+        try:
+            rec.job.clear()
+        except Exception as err:
+            rec.needs_reset = True
+            rec.error = f"Reset failed: {type(err).__name__}: {err}"
+            logger.exception("Job %s failed clearing on reset", rec.job.job_id)
+            return
+        rec.needs_reset = False
         rec.has_primary_data = False
         rec.error = ""
         rec.warning = ""
@@ -248,7 +262,18 @@ class JobManager:
                     + ", ".join(sorted(missing))
                 )
             else:
-                rec.job.set_context(context)
+                # Contained per job: one workflow rejecting its context
+                # must not abort the batch for every other job.
+                try:
+                    rec.job.set_context(context)
+                except Exception as err:
+                    rec.warning = (
+                        f"Applying context failed: {type(err).__name__}: {err}"
+                    )
+                    logger.exception(
+                        "Job %s failed applying gate context", job_id
+                    )
+                    continue
                 rec.phase = _Phase.ACTIVE
                 rec.warning = ""
                 rec.stale_context.clear()
@@ -307,6 +332,13 @@ class JobManager:
             for rec in self._records.values():
                 if rec.phase != _Phase.ACTIVE:
                     continue
+                if rec.needs_reset:
+                    # Retry the failed run-transition reset; until it
+                    # succeeds the job must not accumulate (old-run data
+                    # is still in the workflow).
+                    self._reset_record(rec)
+                    if rec.needs_reset:
+                        continue
                 job_data = {
                     k: v
                     for k, v in data.items()
@@ -329,15 +361,18 @@ class JobManager:
             # window's accumulation.
             context_warning = ""
             if rec.stale_context:
+                # Only the names actually present in this window's context
+                # are delivered (and de-queued on success); the rest stay
+                # queued for a later window rather than being dropped.
+                deliverable = {
+                    k for k in rec.stale_context if k in context
+                }
                 try:
-                    job.set_context(
-                        {
-                            k: context[k]
-                            for k in rec.stale_context
-                            if k in context
-                        }
-                    )
-                    rec.stale_context.clear()
+                    if deliverable:
+                        job.set_context(
+                            {k: context[k] for k in deliverable}
+                        )
+                    rec.stale_context -= deliverable
                 except Exception as err:
                     context_warning = f"{type(err).__name__}: {err}"
                     logger.exception(

@@ -91,14 +91,13 @@ class ShardedHistogrammer:
             jax.shard_map,
             mesh=mesh,
             in_specs=(
-                P("bank", None),  # cumulative
                 P("bank", None),  # window
                 P("data"),  # pixel_id
                 P("data"),  # toa
             ),
-            out_specs=(P("bank", None), P("bank", None)),
+            out_specs=P("bank", None),
         )
-        self._step = jax.jit(shard(self._step_local), donate_argnums=(0, 1))
+        self._step = jax.jit(shard(self._step_local), donate_argnums=(0,))
 
         norm = partial(
             jax.shard_map,
@@ -107,12 +106,16 @@ class ShardedHistogrammer:
             out_specs=P("bank", None),
         )
         self._normalize = jax.jit(norm(self._normalize_local))
+        # Fold semantics as in EventHistogrammer: steps touch only the
+        # window; the cumulative total is folded at publish rate.
         self._clear_window = jax.jit(
-            lambda cum, win: (cum, jnp.zeros_like(win)), donate_argnums=(0, 1)
+            lambda cum, win: (cum + win, jnp.zeros_like(win)),
+            donate_argnums=(0, 1),
         )
+        self._cum_view = jax.jit(lambda cum, win: cum + win)
 
     # -- local (per-shard) kernels ---------------------------------------
-    def _step_local(self, cum, win, pixel_id, toa):
+    def _step_local(self, win, pixel_id, toa):
         bank = jax.lax.axis_index("bank")
         row0 = bank * self._rows_per_bank
         tb = jnp.floor((toa - self._lo) * self._inv_width).astype(jnp.int32)
@@ -135,8 +138,7 @@ class ShardedHistogrammer:
         delta = delta.reshape(self._rows_per_bank, self._n_toa)
         # Merge event shards: every data-shard scattered into its own copy.
         delta = jax.lax.psum(delta, "data")
-        win_new = win * self._decay + delta if self._decay is not None else win + delta
-        return cum + delta, win_new
+        return win * self._decay + delta if self._decay is not None else win + delta
 
     def _normalize_local(self, hist, monitor_counts):
         # monitor_counts: per-event-shard scalar counts; global total via psum.
@@ -157,7 +159,7 @@ class ShardedHistogrammer:
             jnp.zeros((self._n_screen, self._n_toa), dtype=self._dtype),
             self._state_sharding,
         )
-        return HistogramState(cumulative=zeros, window=jnp.array(zeros))
+        return HistogramState(folded=zeros, window=jnp.array(zeros))
 
     def _shard_events(self, pixel_id, toa):
         n = pixel_id.shape[0]
@@ -176,12 +178,12 @@ class ShardedHistogrammer:
     def step(self, state: HistogramState, pixel_id, toa) -> HistogramState:
         """Accumulate one padded global batch (host or device arrays)."""
         pid, t = self._shard_events(pixel_id, toa)
-        cum, win = self._step(state.cumulative, state.window, pid, t)
-        return HistogramState(cumulative=cum, window=win)
+        win = self._step(state.window, pid, t)
+        return HistogramState(folded=state.folded, window=win)
 
     def clear_window(self, state: HistogramState) -> HistogramState:
-        cum, win = self._clear_window(state.cumulative, state.window)
-        return HistogramState(cumulative=cum, window=win)
+        cum, win = self._clear_window(state.folded, state.window)
+        return HistogramState(folded=cum, window=win)
 
     def normalized(self, hist: jax.Array, monitor_counts) -> jax.Array:
         """hist / global monitor total — the monitor-normalized I(Q)-style
@@ -191,5 +193,13 @@ class ShardedHistogrammer:
         )
         return self._normalize(hist, mc)
 
-    def to_host(self, state: HistogramState) -> tuple[np.ndarray, np.ndarray]:
-        return np.asarray(state.cumulative), np.asarray(state.window)
+    def read(self, state: HistogramState) -> tuple[np.ndarray, np.ndarray]:
+        """Host copies of the (cumulative, window) views — same contract as
+        ``EventHistogrammer.read``."""
+        cum, win = jax.device_get(
+            (self._cum_view(state.folded, state.window), state.window)
+        )
+        return np.asarray(cum), np.asarray(win)
+
+    # Backwards-compatible alias.
+    to_host = read

@@ -89,8 +89,18 @@ class DetectorViewWorkflow:
         )
         ny, nx = projection.ny, projection.nx
         n_toa = self._hist.n_toa
+        n_bins = projection.n_screen * n_toa
 
-        def summarize(cum, win, roi_masks):
+        def summarize(state, roi_masks):
+            # The histogrammer owns the state layout (flat, dump bin, lazy
+            # decay scale); compose its traceable view here so the fold
+            # into the cumulative fuses into the reductions below.
+            win = self._hist.physical_window(state)[:n_bins].reshape(
+                projection.n_screen, n_toa
+            )
+            cum = win + state.folded[:n_bins].reshape(
+                projection.n_screen, n_toa
+            )
             return {
                 "image_current": win.sum(axis=1).reshape(ny, nx),
                 "image_cumulative": cum.sum(axis=1).reshape(ny, nx),
@@ -169,10 +179,11 @@ class DetectorViewWorkflow:
                     self._state = self._hist.step(self._state, value.batch)
 
     def finalize(self) -> dict[str, DataArray]:
-        out = self._summarize(
-            self._state.cumulative, self._state.window, self._roi_masks
-        )
-        out = {k: np.asarray(v) for k, v in out.items()}
+        out = self._summarize(self._state, self._roi_masks)
+        # One bulk device->host fetch: per-array np.asarray would pay one
+        # blocking round trip per output, which dominates publish latency
+        # when the accelerator sits behind a network relay.
+        out = jax.device_get(out)
         self._state = self._hist.clear_window(self._state)
 
         img_coords = {

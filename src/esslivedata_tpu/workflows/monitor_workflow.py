@@ -100,8 +100,9 @@ class MonitorWorkflow:
         self._dense_cumulative += rebinned
 
     def finalize(self) -> dict[str, DataArray]:
-        win = np.asarray(self._state.window)[0] + self._dense_window
-        cum = np.asarray(self._state.cumulative)[0] + self._dense_cumulative
+        cum2, win2 = self._hist.read(self._state)
+        win = win2[0] + self._dense_window
+        cum = cum2[0] + self._dense_cumulative
         self._state = self._hist.clear_window(self._state)
         self._dense_window = np.zeros_like(self._dense_window)
         coords = {"toa": self._edges_var}

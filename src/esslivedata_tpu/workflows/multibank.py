@@ -105,12 +105,9 @@ class MultiBankViewWorkflow:
                     self._state = self._hist.step(self._state, value.batch)
 
     def finalize(self) -> dict[str, DataArray]:
-        win = np.asarray(self._state.window).reshape(
-            self._n_banks, self._pixels_per_bank, -1
-        )
-        cum = np.asarray(self._state.cumulative).reshape(
-            self._n_banks, self._pixels_per_bank, -1
-        )
+        cum, win = self._hist.read(self._state)
+        win = win.reshape(self._n_banks, self._pixels_per_bank, -1)
+        cum = cum.reshape(self._n_banks, self._pixels_per_bank, -1)
         self._state = self._hist.clear_window(self._state)
         bank_coord = Variable(
             np.arange(self._n_banks), ("bank",), ""

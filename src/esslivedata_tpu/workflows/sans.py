@@ -94,10 +94,18 @@ class SansIQWorkflow:
         )
 
     def finalize(self) -> dict[str, DataArray]:
-        win = np.asarray(self._state.window)
-        cum = np.asarray(self._state.cumulative)
-        mon_win = float(np.asarray(self._state.monitor_window))
-        mon_cum = float(np.asarray(self._state.monitor_cumulative))
+        import jax
+
+        win, cum, mon_win, mon_cum = jax.device_get(
+            (
+                self._state.window,
+                self._state.cumulative,
+                self._state.monitor_window,
+                self._state.monitor_cumulative,
+            )
+        )
+        win, cum = np.asarray(win), np.asarray(cum)
+        mon_win, mon_cum = float(mon_win), float(mon_cum)
         self._state = self._hist.clear_window(self._state)
         coords = {"Q": self._q_edges_var}
         return {

@@ -535,3 +535,96 @@ class TestFreshContextDelivery:
             end=T(30),
         )
         assert calls == [{"motor_x": 5.0}, {"motor_x": 7.0}]
+
+
+class TestFaultContainment:
+    """One misbehaving workflow must not take the batch (or other jobs)
+    down with it — gate-context, reset, and stale-context delivery paths."""
+
+    def test_failing_gate_set_context_contained(self, registry, manager):
+        class BadContextWorkflow(CountingWorkflow):
+            def set_context(self, ctx):
+                raise ValueError("bad motor value")
+
+        spec = WorkflowSpec(
+            instrument="dummy",
+            name="badctx",
+            source_names=["bank0"],
+            context_keys=["motor_x"],
+        )
+        registry.register_spec(spec).attach_factory(
+            lambda *, source_name, params: BadContextWorkflow()
+        )
+        manager.schedule_job(start_config(registry, name="badctx"))
+        manager.schedule_job(start_config(registry, name="count"))
+        results = manager.process_jobs(
+            {"bank0": 1.0}, context={"motor_x": 3.5}, start=T(0), end=T(10)
+        )
+        # The healthy job still produced output; the bad one stays gated
+        # with a warning naming the failure.
+        assert len(results) == 1
+        bad = next(
+            s for s in manager.job_statuses() if "badctx" in str(s.workflow_id)
+        )
+        assert bad.state == JobState.PENDING_CONTEXT
+        assert "bad motor value" in bad.message
+
+    def test_failing_clear_on_reset_contained(self, registry, manager):
+        class BadClearWorkflow(CountingWorkflow):
+            def clear(self):
+                raise RuntimeError("device wedged")
+
+        spec = WorkflowSpec(
+            instrument="dummy", name="badclear", source_names=["bank0"]
+        )
+        registry.register_spec(spec).attach_factory(
+            lambda *, source_name, params: BadClearWorkflow()
+        )
+        manager.schedule_job(start_config(registry, name="badclear"))
+        manager.schedule_job(start_config(registry, name="count"))
+        manager.process_jobs({"bank0": 5.0}, start=T(0), end=T(10))
+        manager.handle_run_transition(RunStart(run_name="r2", start_time=T(20)))
+        results = manager.process_jobs({"bank0": 1.0}, start=T(20), end=T(30))
+        # The healthy job was reset and reprocessed; the wedged job is
+        # excluded from processing (old-run data must not mix) and keeps
+        # retrying its reset.
+        count_rec = next(
+            r
+            for r in manager._records.values()
+            if type(r.job.workflow) is CountingWorkflow
+        )
+        assert count_rec.job.workflow.clear_calls == 1
+        assert len(results) == 1
+        bad = next(
+            s
+            for s in manager.job_statuses()
+            if "badclear" in str(s.workflow_id)
+        )
+        assert "Reset failed" in bad.message
+        # Once the workflow recovers, the retry succeeds and processing
+        # resumes with a clean state.
+        bad_rec = next(
+            r
+            for r in manager._records.values()
+            if type(r.job.workflow) is not CountingWorkflow
+        )
+        bad_rec.job.workflow.clear = lambda: None
+        results = manager.process_jobs({"bank0": 2.0}, start=T(30), end=T(40))
+        assert len(results) == 2
+
+    def test_undelivered_stale_context_stays_queued(self, registry, manager):
+        manager.schedule_job(start_config(registry, name="gated"))
+        # Graduate the job with initial context.
+        manager.process_jobs(
+            {"bank0": 1.0}, context={"motor_x": 1.0}, start=T(0), end=T(10)
+        )
+        rec = next(iter(manager._records.values()))
+        # Queue two names while the job is active; only motor_x will ever
+        # appear in a later window's context.
+        rec.stale_context |= {"motor_x", "motor_y"}
+        manager.process_jobs(
+            {"bank0": 1.0}, context={"motor_x": 2.0}, start=T(10), end=T(20)
+        )
+        assert rec.job.workflow.context["motor_x"] == 2.0
+        # motor_y was not deliverable and must remain queued, not dropped.
+        assert rec.stale_context == {"motor_y"}
