@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Regenerate per-instrument artifacts derived from the NeXus plans:
+
+- ``config/instruments/<name>/streams_parsed.py`` — the generated f144
+  stream registry (ADR 0009), scanned from the synthesized geometry file;
+- ``config/instruments/<name>/device_contract.yaml`` — the NICOS derived-
+  device contract exported from the workflow registry (ADR 0006).
+
+Run after changing ``config/nexus_plans.py`` or any spec's
+``device_outputs``. Tests assert the checked-in files match a fresh
+render, so drift fails CI rather than silently shipping.
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "src"))
+
+
+def main() -> int:
+    from esslivedata_tpu.config.device_contract import (
+        DeviceContract,
+        contract_to_yaml,
+    )
+    from esslivedata_tpu.config.instrument import instrument_registry
+    from esslivedata_tpu.config.nexus_plans import NEXUS_PLANS
+    from esslivedata_tpu.config.nexus_streams import generate_registry
+    from esslivedata_tpu.config.nexus_synthesis import write_nexus
+    from esslivedata_tpu.workflows.workflow_factory import workflow_registry
+
+    pkg_root = (
+        Path(__file__).resolve().parent.parent
+        / "src"
+        / "esslivedata_tpu"
+        / "config"
+        / "instruments"
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        for name, plan in sorted(NEXUS_PLANS.items()):
+            nxs = Path(tmp) / f"geometry-{name}.nxs"
+            write_nexus(plan, nxs)
+            out = pkg_root / name / "streams_parsed.py"
+            n = generate_registry(
+                nxs, out, source_file=f"geometry-{name}-<date>.nxs (synthesized)"
+            )
+            print(f"{out.relative_to(pkg_root.parent)}: {n} f144 streams")
+
+    # Device contracts need every instrument's specs registered.
+    for name in sorted(NEXUS_PLANS):
+        instrument_registry[name]  # triggers spec import
+    for name in sorted(NEXUS_PLANS):
+        contract = DeviceContract.from_specs(
+            workflow_registry.specs_for_instrument(name)
+        )
+        out = pkg_root / name / "device_contract.yaml"
+        out.write_text(contract_to_yaml(contract, instrument=name))
+        print(f"{out.relative_to(pkg_root.parent)}: {len(contract)} devices")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

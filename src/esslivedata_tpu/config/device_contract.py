@@ -127,3 +127,37 @@ class DeviceContract:
     @classmethod
     def from_mapping(cls, rows: Iterable[Mapping[str, str]]) -> DeviceContract:
         return cls(DeviceContractEntry.model_validate(row) for row in rows)
+
+
+def contract_to_yaml(contract: DeviceContract, *, instrument: str) -> str:
+    """The static git-tracked YAML export NICOS consumes (one file per
+    instrument package, regenerated by
+    ``scripts/generate_instrument_artifacts.py``)."""
+    import yaml
+
+    header = (
+        f"# GENERATED -- do not edit. NICOS derived-device list for "
+        f"{instrument}.\n"
+        "# Regenerate: python scripts/generate_instrument_artifacts.py\n"
+    )
+    return header + yaml.safe_dump(
+        {"devices": contract.to_mapping()}, sort_keys=False
+    )
+
+
+def contract_from_yaml(text: str) -> DeviceContract:
+    import yaml
+
+    data = yaml.safe_load(text) or {}
+    return DeviceContract.from_mapping(data.get("devices", []))
+
+
+def load_instrument_contract(instrument: str) -> DeviceContract:
+    """The checked-in contract of a built-in instrument package."""
+    from importlib import resources
+
+    pkg = f"esslivedata_tpu.config.instruments.{instrument}"
+    text = (
+        resources.files(pkg).joinpath("device_contract.yaml").read_text()
+    )
+    return contract_from_yaml(text)

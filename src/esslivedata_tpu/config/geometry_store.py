@@ -1,0 +1,149 @@
+"""Geometry-artifact registry: dated NeXus files, cached, date-resolved.
+
+Mirrors the reference's geometry pipeline
+(preprocessors/detector_data.py:66-127): every instrument has one or more
+geometry files named ``geometry-<instrument>-<YYYY-MM-DD>.nxs``, the date
+being the start of the file's validity window; the file applying at a
+given date is the newest one whose date is not after it. Files land in a
+cache directory, overridable with ``LIVEDATA_DATA_DIR`` (an operator can
+drop a hand-built artifact there and it wins over the registry).
+
+Where the reference *downloads* artifacts with pooch, this environment has
+no egress, so a cache miss *synthesizes* the file from the instrument's
+declarative NeXus plan (``nexus_plans.py``). The consumer contract is
+byte-for-byte the same — a real ESS file copied into the cache is used
+as-is.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import logging
+import os
+import re
+from pathlib import Path
+
+import numpy as np
+
+__all__ = [
+    "GEOMETRY_REGISTRY",
+    "geometry_filename",
+    "geometry_path",
+    "load_detector_geometry",
+    "load_logical_layout",
+]
+
+logger = logging.getLogger(__name__)
+
+#: filename -> None (synthesized) or an expected md5 of a pinned real
+#: artifact. Multiple dated entries per instrument express validity
+#: windows; files are never replaced in place (new date = new file).
+GEOMETRY_REGISTRY: dict[str, str | None] = {
+    "geometry-loki-2026-01-01.nxs": None,
+    "geometry-dream-2026-01-01.nxs": None,
+    "geometry-bifrost-2026-01-01.nxs": None,
+    "geometry-estia-2026-01-01.nxs": None,
+    "geometry-nmx-2026-01-01.nxs": None,
+    "geometry-odin-2026-01-01.nxs": None,
+    "geometry-tbl-2026-01-01.nxs": None,
+    "geometry-dummy-2026-01-01.nxs": None,
+}
+
+_DATE_RE = re.compile(r"-(\d{4}-\d{2}-\d{2})\.nxs$")
+
+
+def _cache_dir() -> Path:
+    override = os.environ.get("LIVEDATA_DATA_DIR")
+    if override:
+        return Path(override)
+    import tempfile
+
+    # World-scratch default keeps first-run behavior dependency-free;
+    # deployments set LIVEDATA_DATA_DIR to a persistent volume (same
+    # override the reference honors).
+    return Path(tempfile.gettempdir()) / "esslivedata-tpu" / "geometry"
+
+
+def geometry_filename(
+    instrument: str, date: _dt.date | None = None
+) -> str:
+    """The registry filename valid at ``date`` (default: today).
+
+    The newest entry whose embedded date is <= ``date`` wins — identical
+    date-LUT semantics to the reference's ``get_nexus_geometry_filename``.
+    """
+    date = date or _dt.date.today()
+    candidates: list[tuple[_dt.date, str]] = []
+    for name in GEOMETRY_REGISTRY:
+        if f"-{instrument}-" not in name:
+            continue
+        m = _DATE_RE.search(name)
+        if not m:
+            continue
+        candidates.append((_dt.date.fromisoformat(m.group(1)), name))
+    if not candidates:
+        raise ValueError(f"No geometry files registered for {instrument!r}")
+    candidates.sort()
+    valid = [name for d, name in candidates if d <= date]
+    if not valid:
+        raise ValueError(
+            f"No geometry file for {instrument!r} valid at {date} "
+            f"(earliest is {candidates[0][0]})"
+        )
+    return valid[-1]
+
+
+def geometry_path(
+    instrument: str, date: _dt.date | None = None
+) -> Path:
+    """Resolve (and materialize if needed) the geometry artifact path."""
+    name = geometry_filename(instrument, date)
+    path = _cache_dir() / name
+    if path.exists():
+        return path
+    import os as _os
+    import tempfile
+
+    from .nexus_plans import plan_for
+    from .nexus_synthesis import write_nexus
+
+    logger.info("Synthesizing geometry artifact %s", path)
+    # Unique temp file per writer: several services resolving the same
+    # missing artifact concurrently must not truncate each other mid-write;
+    # whichever finishes last atomically installs a *complete* file.
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp_name = tempfile.mkstemp(
+        dir=path.parent, prefix=path.name, suffix=".partial"
+    )
+    _os.close(fd)
+    tmp = Path(tmp_name)
+    try:
+        write_nexus(plan_for(instrument), tmp)
+        tmp.replace(path)
+    finally:
+        tmp.unlink(missing_ok=True)
+    return path
+
+
+def load_detector_geometry(
+    path: str | Path, bank: str
+) -> tuple[np.ndarray, np.ndarray]:
+    """(positions [n, 3] metres, pixel ids [n]) of a geometric bank."""
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        det = f[f"/entry/instrument/{bank}"]
+        ids = np.asarray(det["detector_number"]).reshape(-1)
+        xyz = [
+            np.asarray(det[k], dtype=np.float64).reshape(-1)
+            for k in ("x_pixel_offset", "y_pixel_offset", "z_pixel_offset")
+        ]
+    return np.stack(xyz, axis=1), ids
+
+
+def load_logical_layout(path: str | Path, bank: str) -> np.ndarray:
+    """The N-d ``detector_number`` layout of a logical bank."""
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        return np.asarray(f[f"/entry/instrument/{bank}/detector_number"])

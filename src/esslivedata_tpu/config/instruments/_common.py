@@ -17,8 +17,46 @@ from .. import instrument as _instrument_mod
 __all__ = [
     "detector_view_outputs",
     "register_monitor_spec",
+    "register_parsed_catalog",
     "register_timeseries_spec",
 ]
+
+
+def register_parsed_catalog(
+    instrument: "_instrument_mod.Instrument",
+    parsed: dict,
+) -> None:
+    """Merge a generated f144 registry (ADR 0009) into the instrument's
+    stream catalog: unauthorized topics dropped, entries auto-named,
+    motorised devices detected and merged (stream.name_streams).
+
+    Hand-declared streams are protected: a parsed entry may *refine* an
+    identical declaration (same topic/source/units — it contributes its
+    nexus_path, e.g. the chopper PVs instruments declare via
+    chopper_pv_streams), but a parsed entry that would silently repoint an
+    existing stream name at a different wire identity raises instead —
+    that is how chopper routing breaks (a renamed PV in the geometry file
+    must be reconciled in specs, not auto-shadowed).
+    """
+    from ...config.stream import filter_authorized_streams, name_streams
+
+    incoming = name_streams(filter_authorized_streams(parsed))
+    for name, stream in incoming.items():
+        existing = instrument.streams.get(name)
+        if existing is not None and (
+            existing.topic,
+            existing.source,
+            getattr(existing, "units", None),
+        ) != (stream.topic, stream.source, getattr(stream, "units", None)):
+            raise ValueError(
+                f"Parsed catalog entry {name!r} "
+                f"(topic={stream.topic!r}, source={stream.source!r}) "
+                f"conflicts with the declared stream "
+                f"(topic={existing.topic!r}, source={existing.source!r}); "
+                "reconcile the declaration in specs.py with the geometry "
+                "artifact instead of shadowing it"
+            )
+        instrument.streams[name] = stream
 
 
 def detector_view_outputs() -> dict[str, OutputSpec]:
@@ -71,11 +109,21 @@ def register_monitor_spec(
 def register_timeseries_spec(
     instrument: "_instrument_mod.Instrument",
 ) -> SpecHandle:
-    """Standard per-log republish spec over all declared log streams."""
+    """Standard per-log republish spec over all declared log streams.
+
+    Catalog sources are the *post-synthesis* stream set a job can actually
+    see: motorised-device substreams (RBV/VAL/DMOV) are claimed and merged
+    by the DeviceSynthesizer (ADR 0001), so the spec lists the synthesised
+    Device streams plus the f144 streams no device claims.
+    """
+    claimed: set[str] = set()
+    for dev in instrument.devices.values():
+        claimed.update(dev.substream_names)
     sources = sorted(instrument.log_sources) + sorted(
         name
         for name, s in instrument.streams.items()
-        if s.writer_module == "f144"
+        if (s.writer_module == "f144" and name not in claimed)
+        or s.writer_module == "device"
     )
     return workflow_registry.register_spec(
         WorkflowSpec(

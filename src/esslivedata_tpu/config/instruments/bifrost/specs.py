@@ -12,14 +12,22 @@ from __future__ import annotations
 
 import numpy as np
 
-from ....config.instrument import DetectorConfig, Instrument, instrument_registry
+from ....config.instrument import (
+    DetectorConfig,
+    Instrument,
+    MonitorConfig,
+    instrument_registry,
+)
 from ....config.workflow_spec import OutputSpec, WorkflowSpec
 from ....workflows.multibank import MultiBankParams
 from ....workflows.workflow_factory import workflow_registry
+from .._common import register_monitor_spec, register_parsed_catalog
 
 N_BANKS = 9
 BANK_NY, BANK_NX = 100, 30
 PIXELS_PER_BANK = BANK_NY * BANK_NX
+
+from .streams_parsed import PARSED_STREAMS
 
 INSTRUMENT = Instrument(
     name="bifrost",
@@ -41,6 +49,10 @@ for b in range(N_BANKS):
             projection="logical",
         )
     )
+register_parsed_catalog(INSTRUMENT, PARSED_STREAMS)
+INSTRUMENT.add_monitor(
+    MonitorConfig(name="monitor_1", source_name="bifrost_mon_1")
+)
 instrument_registry.register(INSTRUMENT)
 
 # The merged stream name all banks adapt onto (merge_detectors routing).
@@ -69,3 +81,5 @@ MULTIBANK_HANDLE = workflow_registry.register_spec(
         },
     )
 )
+
+MONITOR_HANDLE = register_monitor_spec(INSTRUMENT)

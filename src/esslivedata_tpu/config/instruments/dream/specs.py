@@ -30,6 +30,7 @@ from ....workflows.wavelength_lut_workflow import (
 )
 from ....workflows.workflow_factory import workflow_registry
 from .._common import (
+    register_parsed_catalog,
     detector_view_outputs,
     register_monitor_spec,
     register_timeseries_spec,
@@ -128,6 +129,8 @@ CHOPPER_GEOMETRY = [
 ]
 
 
+from .streams_parsed import PARSED_STREAMS
+
 INSTRUMENT = Instrument(
     name="dream",
     streams=chopper_pv_streams(CHOPPERS, topic="dream_choppers"),
@@ -135,20 +138,27 @@ INSTRUMENT = Instrument(
     _factories_module="esslivedata_tpu.config.instruments.dream.factories",
 )
 
-_offset = 1
+# Bank layouts come from the date-resolved NeXus geometry artifact; the
+# declared axis sizes must agree with the file or the spec fails at import
+# (a mismatched geometry file is a deployment error, not a runtime one).
+from ...geometry_store import geometry_path, load_logical_layout  # noqa: E402
+
+_geometry = geometry_path("dream")
 for _bank, _sizes in BANK_SIZES.items():
-    _n = int(np.prod(list(_sizes.values())))
+    _layout = load_logical_layout(_geometry, _bank)
+    if _layout.shape != tuple(_sizes.values()):
+        raise ValueError(
+            f"DREAM bank {_bank}: geometry file layout {_layout.shape} != "
+            f"declared axis sizes {tuple(_sizes.values())}"
+        )
     INSTRUMENT.add_detector(
         DetectorConfig(
             name=_bank,
             source_name=f"dream_{_bank}",
-            detector_number=np.arange(
-                _offset, _offset + _n, dtype=np.int32
-            ).reshape(tuple(_sizes.values())),
+            detector_number=_layout,
             projection="logical",
         )
     )
-    _offset += _n
 
 INSTRUMENT.add_monitor(
     MonitorConfig(name="monitor_bunker", source_name="dream_mon_bunker")
@@ -157,6 +167,7 @@ INSTRUMENT.add_monitor(
     MonitorConfig(name="monitor_cave", source_name="dream_mon_cave")
 )
 INSTRUMENT.add_log("sample_temperature", "dream_temp_sample")
+register_parsed_catalog(INSTRUMENT, PARSED_STREAMS)
 instrument_registry.register(INSTRUMENT)
 
 

@@ -17,6 +17,9 @@ from ....workflows.workflow_factory import workflow_registry
 
 NY, NX = 64, 64
 
+from .._common import register_parsed_catalog
+from .streams_parsed import PARSED_STREAMS
+
 INSTRUMENT = Instrument(
     name="dummy",
     _factories_module="esslivedata_tpu.config.instruments.dummy.factories",
@@ -31,6 +34,7 @@ INSTRUMENT.add_detector(
 )
 INSTRUMENT.add_monitor(MonitorConfig(name="monitor_1", source_name="mon_src"))
 INSTRUMENT.add_log("motor_x", "mtr1")
+register_parsed_catalog(INSTRUMENT, PARSED_STREAMS)
 instrument_registry.register(INSTRUMENT)
 
 _image_outputs = {

@@ -21,6 +21,7 @@ from ....workflows.detector_view.projectors import NdLogicalView
 from ....workflows.detector_view.workflow import DetectorViewParams
 from ....workflows.workflow_factory import workflow_registry
 from .._common import (
+    register_parsed_catalog,
     detector_view_outputs,
     register_monitor_spec,
     register_timeseries_spec,
@@ -37,6 +38,8 @@ VIEWS: dict[str, NdLogicalView] = {
     # Specular view: wire (scattering angle proxy) vs strip, blades summed.
     "angle_strip": NdLogicalView(sizes=BLADE_SIZES, y=("wire",), x=("strip",)),
 }
+
+from .streams_parsed import PARSED_STREAMS
 
 INSTRUMENT = Instrument(
     name="estia",
@@ -55,6 +58,7 @@ INSTRUMENT.add_detector(
 )
 INSTRUMENT.add_monitor(MonitorConfig(name="cbm1", source_name="estia_cbm1"))
 INSTRUMENT.add_log("sample_angle", "estia_mtr_omega")
+register_parsed_catalog(INSTRUMENT, PARSED_STREAMS)
 instrument_registry.register(INSTRUMENT)
 
 VIEW_HANDLES = {

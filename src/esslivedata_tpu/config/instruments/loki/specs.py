@@ -1,12 +1,10 @@
 """LOKI instrument declaration + spec registration.
 
-Geometry note: the real LOKI loads bank positions from a NeXus geometry
-file (reference: preprocessors/detector_data.py geometry registry with
-pooch-fetched files). This environment has no geometry artifacts, so the
-rear SANS bank is synthesized analytically: a 256x256 pixel plane,
-1 m x 1 m, 5 m downstream of the sample — the right scale and topology for
-the detector-view and I(Q) paths; swap in NeXus-derived positions when
-artifacts are available (see loki/geometry.py).
+Geometry comes from the date-resolved NeXus artifact
+(config/geometry_store.py; loki/geometry.py loads positions + pixel ids
+from the file), and the f144 stream catalog is the generated registry
+scanned from the same artifact (streams_parsed.py, ADR 0009) — the same
+two pipelines a real deployment feeds with downloaded ESS files.
 """
 
 from __future__ import annotations
@@ -24,7 +22,10 @@ from ....workflows.detector_view.workflow import DetectorViewParams
 from ....workflows.monitor_workflow import MonitorParams
 from ....workflows.sans import SansIQParams
 from ....workflows.workflow_factory import workflow_registry
+from .._common import register_parsed_catalog, register_timeseries_spec
 from .geometry import rear_bank_geometry
+
+from .streams_parsed import PARSED_STREAMS
 
 INSTRUMENT = Instrument(
     name="loki",
@@ -48,6 +49,7 @@ INSTRUMENT.add_monitor(MonitorConfig(name="monitor_1", source_name="loki_mon_1")
 INSTRUMENT.add_monitor(MonitorConfig(name="monitor_2", source_name="loki_mon_2"))
 INSTRUMENT.add_log("sample_stage_x", "loki_mtr_sx")
 INSTRUMENT.add_log("sample_temperature", "loki_temp_1")
+register_parsed_catalog(INSTRUMENT, PARSED_STREAMS)
 instrument_registry.register(INSTRUMENT)
 
 DETECTOR_VIEW_HANDLE = workflow_registry.register_spec(
@@ -92,7 +94,12 @@ MONITOR_HANDLE = workflow_registry.register_spec(
         outputs={
             "current": OutputSpec(title="Monitor (window)"),
             "cumulative": OutputSpec(title="Monitor (since start)", view="since_start"),
+            "counts_current": OutputSpec(title="Counts (window)"),
+            "counts_cumulative": OutputSpec(
+                title="Counts (since start)", view="since_start"
+            ),
         },
+        device_outputs={"counts_cumulative": "monitor_counts_{source_name}"},
     )
 )
 
@@ -114,13 +121,4 @@ SANS_IQ_HANDLE = workflow_registry.register_spec(
     )
 )
 
-TIMESERIES_HANDLE = workflow_registry.register_spec(
-    WorkflowSpec(
-        instrument="loki",
-        namespace="timeseries",
-        name="log",
-        title="Log timeseries",
-        source_names=sorted(INSTRUMENT.log_sources),
-        reset_on_run_transition=False,
-    )
-)
+TIMESERIES_HANDLE = register_timeseries_spec(INSTRUMENT)

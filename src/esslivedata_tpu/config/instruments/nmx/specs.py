@@ -19,6 +19,7 @@ from ....config.workflow_spec import WorkflowSpec
 from ....workflows.detector_view.workflow import DetectorViewParams
 from ....workflows.workflow_factory import workflow_registry
 from .._common import (
+    register_parsed_catalog,
     detector_view_outputs,
     register_monitor_spec,
     register_timeseries_spec,
@@ -26,6 +27,8 @@ from .._common import (
 
 PANEL_SHAPE = (1280, 1280)
 PANELS = ["detector_panel_0", "detector_panel_1", "detector_panel_2"]
+
+from .streams_parsed import PARSED_STREAMS
 
 INSTRUMENT = Instrument(
     name="nmx",
@@ -46,6 +49,7 @@ for _i, _panel in enumerate(PANELS):
     )
 INSTRUMENT.add_monitor(MonitorConfig(name="monitor1", source_name="nmx_mon_1"))
 INSTRUMENT.add_monitor(MonitorConfig(name="monitor2", source_name="nmx_mon_2"))
+register_parsed_catalog(INSTRUMENT, PARSED_STREAMS)
 instrument_registry.register(INSTRUMENT)
 
 PANEL_XY_HANDLE = workflow_registry.register_spec(

@@ -22,12 +22,15 @@ from ....workflows.area_detector_view import AreaDetectorParams
 from ....workflows.detector_view.workflow import DetectorViewParams
 from ....workflows.workflow_factory import workflow_registry
 from .._common import (
+    register_parsed_catalog,
     detector_view_outputs,
     register_monitor_spec,
     register_timeseries_spec,
 )
 
 TIMEPIX_SHAPE = (512, 512)
+
+from .streams_parsed import PARSED_STREAMS
 
 INSTRUMENT = Instrument(
     name="odin",
@@ -49,6 +52,7 @@ INSTRUMENT.add_monitor(MonitorConfig(name="monitor2", source_name="odin_mon_2"))
 INSTRUMENT.add_camera(
     CameraConfig(name="orca_camera", source_name="odin_orca")
 )
+register_parsed_catalog(INSTRUMENT, PARSED_STREAMS)
 instrument_registry.register(INSTRUMENT)
 
 DETECTOR_XY_HANDLE = workflow_registry.register_spec(

@@ -26,6 +26,7 @@ from ....workflows.wavelength_lut_workflow import (
 )
 from ....workflows.workflow_factory import workflow_registry
 from .._common import (
+    register_parsed_catalog,
     detector_view_outputs,
     register_monitor_spec,
     register_timeseries_spec,
@@ -42,6 +43,8 @@ CHOPPER_GEOMETRY = [
     ),
 ]
 
+
+from .streams_parsed import PARSED_STREAMS
 
 INSTRUMENT = Instrument(
     name="tbl",
@@ -62,6 +65,7 @@ INSTRUMENT.add_detector(
 )
 INSTRUMENT.add_monitor(MonitorConfig(name="monitor", source_name="tbl_mon_1"))
 INSTRUMENT.add_log("sample_temperature", "tbl_temp_1")
+register_parsed_catalog(INSTRUMENT, PARSED_STREAMS)
 instrument_registry.register(INSTRUMENT)
 
 PANEL_VIEW_HANDLE = workflow_registry.register_spec(

@@ -143,7 +143,13 @@ class ChopperSynthesizer:
 
         for msg in self._wrapped.get_messages():
             passthrough.append(msg)
-            if self._data_clock is None or msg.timestamp > self._data_clock:
+            # Only data streams advance the data clock: commands are
+            # wall-clock stamped, and a bootstrap tick at "now" would
+            # poison the batcher's data-time window for replayed or
+            # backlogged data arriving with older timestamps.
+            if msg.stream.kind.is_data and (
+                self._data_clock is None or msg.timestamp > self._data_clock
+            ):
                 self._data_clock = msg.timestamp
             if self._observe(msg, injected):
                 if changed_at is None or msg.timestamp > changed_at:

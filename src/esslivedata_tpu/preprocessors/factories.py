@@ -79,15 +79,16 @@ class TimeseriesPreprocessorFactory(_KindBasedFactory):
     log_kinds = frozenset()
 
     def make_preprocessor(self, stream: StreamId):
-        if stream.kind == StreamKind.LOG:
+        if stream.kind in (StreamKind.LOG, StreamKind.DEVICE):
+            # Logs and synthesised device streams are primary here
+            # (republished as data — the device case is the NICOS readback
+            # history) but additionally exposed as context so jobs may
+            # gate/parameterize on them — the wavelength-LUT job consumes
+            # chopper setpoint streams this way while the plain timeseries
+            # job republishes them. Other services consume both kinds as
+            # context only, via the kind-based default.
             acc = ToNXlog(name=stream.name)
-            # Logs are primary here (republished as data) but additionally
-            # exposed as context so jobs may gate/parameterize on them —
-            # the wavelength-LUT job consumes chopper setpoint streams
-            # this way while the plain timeseries job republishes them.
             acc.is_context = False  # type: ignore[misc]
             acc.also_context = True  # type: ignore[attr-defined]
             return acc
-        if stream.kind == StreamKind.DEVICE:
-            return LatestValueAccumulator()
         return None

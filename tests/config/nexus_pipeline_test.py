@@ -1,0 +1,300 @@
+"""NeXus artifact pipeline: synthesis -> stream scan -> registry codegen ->
+geometry loading, plus the drift guards that keep checked-in generated
+files in sync with the plans."""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.config import geometry_store
+from esslivedata_tpu.config.device_contract import (
+    DeviceContract,
+    contract_from_yaml,
+    contract_to_yaml,
+    load_instrument_contract,
+)
+from esslivedata_tpu.config.nexus_plans import NEXUS_PLANS, plan_for
+from esslivedata_tpu.config.nexus_streams import (
+    render_registry_module,
+    scan_stream_groups,
+)
+from esslivedata_tpu.config.nexus_synthesis import write_nexus
+from esslivedata_tpu.config.stream import (
+    Device,
+    filter_authorized_streams,
+    name_streams,
+)
+
+
+@pytest.fixture(scope="module")
+def loki_nexus(tmp_path_factory):
+    path = tmp_path_factory.mktemp("nxs") / "geometry-loki-test.nxs"
+    return write_nexus(plan_for("loki"), path)
+
+
+class TestSynthesisAndScan:
+    def test_plan_counts_match_scan(self, loki_nexus):
+        plan = plan_for("loki")
+        decls = scan_stream_groups(loki_nexus)
+        f144 = [d for d in decls if d.writer_module == "f144"]
+        assert len(f144) == plan.f144_stream_count()
+        ev44 = [d for d in decls if d.writer_module == "ev44"]
+        # one per bank + one per monitor
+        assert len(ev44) == len(plan.banks) + len(plan.monitors)
+
+    def test_scan_is_sorted_and_paths_absolute(self, loki_nexus):
+        decls = scan_stream_groups(loki_nexus)
+        paths = [d.nexus_path for d in decls]
+        assert paths == sorted(paths)
+        assert all(p.startswith("/entry") for p in paths)
+
+    def test_device_detection_on_scanned_registry(self, loki_nexus):
+        decls = {
+            d.nexus_path: _to_f144(d)
+            for d in scan_stream_groups(loki_nexus)
+            if d.writer_module == "f144"
+        }
+        named = name_streams(filter_authorized_streams(decls))
+        devices = {k: s for k, s in named.items() if isinstance(s, Device)}
+        # every slit axis, stage axis, monitor positioner is a device
+        plan = plan_for("loki")
+        expected = len(plan.devices) + sum(
+            1 for m in plan.monitors if m.positioner_pv is not None
+        )
+        assert len(devices) == expected
+        # device substreams resolve to present entries
+        for dev in devices.values():
+            assert dev.value in named
+            if dev.target:
+                assert named[dev.target].source.endswith(".VAL")
+
+    def test_unauthorized_topics_filtered(self, loki_nexus):
+        decls = {
+            d.nexus_path: _to_f144(d)
+            for d in scan_stream_groups(loki_nexus)
+            if d.writer_module == "f144"
+        }
+        kept = filter_authorized_streams(decls)
+        dropped = set(decls) - set(kept)
+        assert dropped  # the plan plants vacuum gauges on loki_vacuum
+        assert all("vacuum" in p for p in dropped)
+
+
+def _to_f144(decl):
+    from esslivedata_tpu.config.stream import F144Stream
+
+    return F144Stream(
+        nexus_path=decl.nexus_path,
+        source=decl.source,
+        topic=decl.topic,
+        units=decl.units,
+    )
+
+
+class TestRegistryDriftGuards:
+    """The checked-in generated files must match a fresh render — a changed
+    plan without regeneration fails here instead of shipping silently."""
+
+    @pytest.mark.parametrize("instrument", sorted(NEXUS_PLANS))
+    def test_streams_parsed_matches_plan(self, instrument, tmp_path):
+        import importlib
+
+        nxs = tmp_path / "g.nxs"
+        write_nexus(plan_for(instrument), nxs)
+        decls = [
+            d
+            for d in scan_stream_groups(nxs)
+            if d.writer_module == "f144"
+        ]
+        mod = importlib.import_module(
+            f"esslivedata_tpu.config.instruments.{instrument}.streams_parsed"
+        )
+        checked_in = mod.PARSED_STREAMS
+        assert len(checked_in) == len(decls)
+        for d in decls:
+            entry = checked_in[d.nexus_path]
+            assert entry.source == d.source
+            assert entry.topic == d.topic
+
+    def test_render_is_deterministic(self, loki_nexus):
+        decls = scan_stream_groups(loki_nexus)
+        assert render_registry_module(decls) == render_registry_module(decls)
+
+    @pytest.mark.parametrize("instrument", sorted(NEXUS_PLANS))
+    def test_device_contract_matches_specs(self, instrument):
+        from esslivedata_tpu.config.instrument import instrument_registry
+        from esslivedata_tpu.workflows.workflow_factory import workflow_registry
+
+        instrument_registry[instrument]  # import specs
+        fresh = DeviceContract.from_specs(
+            workflow_registry.specs_for_instrument(instrument)
+        )
+        checked_in = load_instrument_contract(instrument)
+        assert checked_in.to_mapping() == fresh.to_mapping()
+
+    def test_contract_yaml_round_trip(self):
+        checked_in = load_instrument_contract("loki")
+        text = contract_to_yaml(checked_in, instrument="loki")
+        assert contract_from_yaml(text).to_mapping() == checked_in.to_mapping()
+        assert len(checked_in) >= 2  # both LOKI monitors
+
+
+class TestGeometryStore:
+    def test_date_resolution_picks_newest_applicable(self, monkeypatch):
+        monkeypatch.setattr(
+            geometry_store,
+            "GEOMETRY_REGISTRY",
+            {
+                "geometry-loki-2026-01-01.nxs": None,
+                "geometry-loki-2026-06-01.nxs": None,
+            },
+        )
+        f = geometry_store.geometry_filename
+        assert f("loki", datetime.date(2026, 3, 1)).endswith("2026-01-01.nxs")
+        assert f("loki", datetime.date(2026, 7, 1)).endswith("2026-06-01.nxs")
+        with pytest.raises(ValueError, match="valid at"):
+            f("loki", datetime.date(2025, 1, 1))
+        with pytest.raises(ValueError, match="No geometry files"):
+            f("zeus")
+
+    def test_data_dir_override_and_synthesis_on_miss(
+        self, monkeypatch, tmp_path
+    ):
+        monkeypatch.setenv("LIVEDATA_DATA_DIR", str(tmp_path))
+        path = geometry_store.geometry_path("dummy")
+        assert path.parent == tmp_path
+        assert path.exists()
+        # second resolve reuses the cached artifact (same mtime)
+        mtime = path.stat().st_mtime_ns
+        assert geometry_store.geometry_path("dummy") == path
+        assert path.stat().st_mtime_ns == mtime
+
+    def test_operator_dropped_file_wins(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("LIVEDATA_DATA_DIR", str(tmp_path))
+        name = geometry_store.geometry_filename("dummy")
+        marker = tmp_path / name
+        write_nexus(plan_for("dummy"), marker)  # pre-seeded "real" artifact
+        mtime = marker.stat().st_mtime_ns
+        assert geometry_store.geometry_path("dummy") == marker
+        assert marker.stat().st_mtime_ns == mtime  # not re-synthesized
+
+    def test_detector_geometry_loads(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("LIVEDATA_DATA_DIR", str(tmp_path))
+        path = geometry_store.geometry_path("loki")
+        positions, ids = geometry_store.load_detector_geometry(
+            path, "larmor_detector"
+        )
+        assert positions.shape == (256 * 256, 3)
+        assert ids.shape == (256 * 256,)
+        assert ids[0] == 1
+        # 1 m x 1 m plane at z = 5 m
+        assert positions[:, 0].min() == pytest.approx(-0.5)
+        assert positions[:, 0].max() == pytest.approx(0.5)
+        np.testing.assert_allclose(positions[:, 2], 5.0)
+
+    def test_logical_layout_matches_dream_specs(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("LIVEDATA_DATA_DIR", str(tmp_path))
+        from esslivedata_tpu.config.instruments.dream.specs import BANK_SIZES
+
+        path = geometry_store.geometry_path("dream")
+        layout = geometry_store.load_logical_layout(path, "mantle_detector")
+        assert layout.shape == tuple(BANK_SIZES["mantle_detector"].values())
+        assert layout.dtype == np.int32
+
+
+class TestCatalogRouting:
+    def test_parsed_streams_reach_stream_mapping(self):
+        from esslivedata_tpu.config.instrument import instrument_registry
+        from esslivedata_tpu.config.streams import get_stream_mapping
+
+        mapping = get_stream_mapping(instrument_registry["loki"], dev=False)
+        by_topic = {}
+        for key in mapping.logs:
+            by_topic.setdefault(key.topic, []).append(key.source_name)
+        # catalog topics with their parsed sources are routed
+        assert "loki_motion" in by_topic
+        assert any(s.endswith(".RBV") for s in by_topic["loki_motion"])
+        assert "loki_choppers" in by_topic
+        assert "loki_sample_env" in by_topic
+        # unauthorized vacuum topic never reaches the LUT
+        assert not any("vacuum" in t for t in by_topic)
+
+    def test_timeseries_spec_covers_catalog(self):
+        from esslivedata_tpu.config.instrument import instrument_registry
+        from esslivedata_tpu.workflows.workflow_factory import workflow_registry
+
+        instrument_registry["loki"]
+        spec = next(
+            s
+            for s in workflow_registry.specs_for_instrument("loki")
+            if s.namespace == "timeseries"
+        )
+        # The catalog reaches the spec post-synthesis: unclaimed f144
+        # streams and merged Device streams are sources; substreams the
+        # DeviceSynthesizer claims are not (they never reach a job).
+        from esslivedata_tpu.config.stream import Device
+
+        inst = instrument_registry["loki"]
+        sources = set(spec.source_names)
+        assert len(sources) > 40
+        claimed = {
+            sub
+            for d in inst.streams.values()
+            if isinstance(d, Device)
+            for sub in d.substream_names
+        }
+        device_names = {
+            n for n, d in inst.streams.items() if isinstance(d, Device)
+        }
+        assert device_names <= sources
+        assert not (claimed & sources)
+
+
+class TestCatalogConflictGuard:
+    def test_conflicting_parsed_entry_raises(self):
+        from esslivedata_tpu.config.instrument import Instrument
+        from esslivedata_tpu.config.instruments._common import (
+            register_parsed_catalog,
+        )
+        from esslivedata_tpu.config.stream import F144Stream
+
+        inst = Instrument(name="guardtest")
+        inst.streams["band_chopper/delay"] = F144Stream(
+            topic="x_choppers", source="band_chopper:Delay", units="ns"
+        )
+        parsed = {
+            "/entry/instrument/band_chopper/delay": F144Stream(
+                nexus_path="/entry/instrument/band_chopper/delay",
+                topic="x_choppers",
+                source="band_chopper:RENAMED",
+                units="ns",
+            )
+        }
+        with pytest.raises(ValueError, match="conflicts with the declared"):
+            register_parsed_catalog(inst, parsed)
+
+    def test_identical_parsed_entry_refines_declaration(self):
+        from esslivedata_tpu.config.instrument import Instrument
+        from esslivedata_tpu.config.instruments._common import (
+            register_parsed_catalog,
+        )
+        from esslivedata_tpu.config.stream import F144Stream
+
+        inst = Instrument(name="guardtest2")
+        inst.streams["band_chopper/delay"] = F144Stream(
+            topic="x_choppers", source="band_chopper:Delay", units="ns"
+        )
+        parsed = {
+            "/entry/instrument/band_chopper/delay": F144Stream(
+                nexus_path="/entry/instrument/band_chopper/delay",
+                topic="x_choppers",
+                source="band_chopper:Delay",
+                units="ns",
+            )
+        }
+        register_parsed_catalog(inst, parsed)
+        assert (
+            inst.streams["band_chopper/delay"].nexus_path
+            == "/entry/instrument/band_chopper/delay"
+        )
