@@ -57,6 +57,18 @@ class GridCellSpec:
     source: str = ""
     plotter: str = ""
     title: str = ""
+    # Presentation parameters (dashboard.plots.PlotParams schema: scale,
+    # cmap, vmin, vmax) — carried opaquely here so templates/persistence
+    # stay decoupled from the rendering layer's knob set.
+    params: tuple[tuple[str, Any], ...] = ()
+
+    @property
+    def params_dict(self) -> dict[str, Any]:
+        return dict(self.params)
+
+    @staticmethod
+    def freeze_params(raw: dict[str, Any] | None) -> tuple[tuple[str, Any], ...]:
+        return tuple(sorted((raw or {}).items()))
 
 
 @dataclass(frozen=True)
@@ -93,6 +105,7 @@ class GridSpec:
                 source=cell.get("source", ""),
                 plotter=cell.get("plotter", ""),
                 title=cell.get("title", ""),
+                params=GridCellSpec.freeze_params(cell.get("params")),
             )
             for cell in raw.get("cells", [])
         )

@@ -12,6 +12,7 @@ from .job_service import JobService
 from .message_pump import MessagePump
 from .notification_queue import NotificationQueue
 from .plot_orchestrator import PlotOrchestrator
+from .session_registry import SessionRegistry
 from .stream_manager import StreamManager
 from .transport import Transport
 
@@ -29,9 +30,10 @@ class DashboardServices:
     ):
         self.transport = transport
         self.data_service = DataService()
-        self.job_service = JobService()
-        self.devices = DerivedDeviceRegistry()
         self.notifications = NotificationQueue()
+        self.sessions = SessionRegistry()
+        self.job_service = JobService(on_event=self.notifications.push)
+        self.devices = DerivedDeviceRegistry()
         self.frame_clock = FrameClock()
         self.config_store = config_store or MemoryConfigStore()
         self._store_manager = ConfigStoreManager(self.config_store)

@@ -56,29 +56,63 @@ class PendingCommand:
 
 
 class JobService:
-    def __init__(self) -> None:
+    def __init__(self, *, on_event=None) -> None:
         self._services: dict[str, TrackedService] = {}
         self._jobs: dict[tuple[str, uuid.UUID], JobStatus] = {}
         self._adopted: set[tuple[str, uuid.UUID]] = set()
         self._known_started: set[tuple[str, uuid.UUID]] = set()
         self._pending: list[PendingCommand] = []
+        # job key -> owning service, from the heartbeat that last listed it
+        # (reconciliation needs to know whose heartbeat to compare against).
+        self._job_owner: dict[tuple[str, uuid.UUID], str] = {}
         self._lock = threading.Lock()
+        # on_event(level, message): user-facing happenings (expired
+        # commands, vanished jobs) — wired to the NotificationQueue by the
+        # composition root; None = silent.
+        self._on_event = on_event or (lambda level, message: None)
 
     # -- ingestion callbacks ----------------------------------------------
     def on_status(self, msg: StatusMessage) -> None:
+        vanished: list[tuple[str, uuid.UUID]] = []
         with self._lock:
             self._services[msg.service_id] = TrackedService(
                 service_id=msg.service_id,
                 status=msg.status,
                 last_seen_wall=time.monotonic(),
             )
+            listed: set[tuple[str, uuid.UUID]] = set()
             for job in msg.status.jobs:
                 key = (job.source_name, job.job_number)
+                listed.add(key)
                 if key not in self._jobs and key not in self._known_started:
                     # heartbeat mentions a job we never started: adopt it
                     self._adopted.add(key)
                     logger.info("Adopted job %s/%s from heartbeat", *key)
                 self._jobs[key] = job
+                self._job_owner[key] = msg.service_id
+            # Reconcile: a job this service's previous heartbeat listed but
+            # this one does not has died between heartbeats (service-side
+            # crash/GC — a dashboard-issued stop/remove also delists it,
+            # but those resolve a pending command, so the notification
+            # names whichever happened).
+            for key, owner in list(self._job_owner.items()):
+                if owner == msg.service_id and key not in listed:
+                    vanished.append(key)
+                    self._jobs.pop(key, None)
+                    self._job_owner.pop(key, None)
+                    self._adopted.discard(key)
+        for source_name, job_number in vanished:
+            logger.warning(
+                "Job %s/%s disappeared from %s heartbeat",
+                source_name,
+                job_number,
+                msg.service_id,
+            )
+            self._on_event(
+                "warning",
+                f"Job {source_name}/{str(job_number)[:8]} is gone from "
+                f"{msg.service_id} (stopped or died)",
+            )
 
     def on_ack(self, msg: AckMessage) -> None:
         payload = msg.payload
@@ -130,3 +164,20 @@ class JobService:
     def pending_commands(self) -> list[PendingCommand]:
         with self._lock:
             return [c for c in self._pending if not c.resolved]
+
+    def sweep_expired(self) -> list[PendingCommand]:
+        """Drop commands that never got an ack within the expiry window,
+        emitting a user-facing notification for each (reference
+        pending_command_tracker.py expiry). Called periodically by the
+        message pump."""
+        with self._lock:
+            expired = [c for c in self._pending if c.expired]
+            self._pending = [c for c in self._pending if not c.expired]
+        for cmd in expired:
+            self._on_event(
+                "error",
+                f"Command {cmd.kind!r} for {cmd.source_name}/"
+                f"{str(cmd.job_number)[:8]} got no acknowledgement in "
+                f"{COMMAND_EXPIRY_S:.0f}s — service down or command lost",
+            )
+        return expired

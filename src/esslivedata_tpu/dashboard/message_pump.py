@@ -47,6 +47,9 @@ class MessagePump:
         self._running = threading.Event()
 
     def pump_once(self) -> int:
+        # Time-based upkeep first: command expiry does not depend on any
+        # message arriving (a dead broker is exactly when it must fire).
+        self._job_service.sweep_expired()
         messages = self._transport.get_messages()
         if not messages:
             return 0

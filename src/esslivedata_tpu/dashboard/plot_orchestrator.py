@@ -125,6 +125,7 @@ class PlotOrchestrator:
                         "source": c.spec.source,
                         "plotter": c.spec.plotter,
                         "title": c.spec.title,
+                        "params": c.spec.params_dict,
                     }
                     for c in grid.cells
                 ],
@@ -183,6 +184,32 @@ class PlotOrchestrator:
         self._persist(grid)
         self.clock.commit(grid_id)
 
+    def update_cell(
+        self, grid_id: str, index: int, **changes
+    ) -> PlotCell:
+        """Edit a cell's spec in place (the plot-config surface): stream
+        selection, plotter choice, title, presentation params. Selection
+        changes rebind the cell's matched keys; everything persists."""
+        from ..config.grid_template import GridCellSpec
+
+        if "params" in changes and isinstance(changes["params"], dict):
+            changes["params"] = GridCellSpec.freeze_params(changes["params"])
+        with self._lock:
+            grid = self._grids[grid_id]
+            cell = grid.cells[index]
+            new_spec = replace(cell.spec, **changes)
+            new_cell = PlotCell(spec=new_spec)
+            for key in self._data.keys():
+                if new_cell.matches(key):
+                    new_cell.keys.add(key)
+            grid.cells[index] = new_cell
+            cells = list(grid.spec.cells)
+            cells[index] = new_spec
+            grid.spec = replace(grid.spec, cells=tuple(cells))
+        self._persist(grid)
+        self.clock.commit(grid_id)
+        return new_cell
+
     # -- data binding --------------------------------------------------------
     def _on_data(self, keys: set[ResultKey]) -> None:
         """Ingestion-side: match new keys to cells, commit touched grids."""
@@ -220,6 +247,7 @@ class PlotOrchestrator:
                     "generation": self.clock.grid_generation(grid.grid_id),
                     "cells": [
                         {
+                            "index": i,
                             "geometry": {
                                 "row": c.spec.geometry.row,
                                 "col": c.spec.geometry.col,
@@ -227,11 +255,16 @@ class PlotOrchestrator:
                                 "col_span": c.spec.geometry.col_span,
                             },
                             "title": c.spec.title,
+                            "workflow": c.spec.workflow,
+                            "output": c.spec.output,
+                            "source": c.spec.source,
+                            "plotter": c.spec.plotter,
+                            "params": c.spec.params_dict,
                             "keys": sorted(
                                 c.keys, key=lambda k: k.to_string()
                             ),
                         }
-                        for c in grid.cells
+                        for i, c in enumerate(grid.cells)
                     ],
                 }
                 for grid in self._grids.values()

@@ -11,6 +11,7 @@ here plotters render matplotlib (Agg) to PNG bytes for the web front end.
 from __future__ import annotations
 
 import io
+from dataclasses import dataclass
 import logging
 import threading
 from typing import Callable
@@ -27,12 +28,93 @@ __all__ = [
     "PlotterRegistry",
     "SlicerPlotter",
     "TablePlotter",
+    "PlotParams",
     "plotter_registry",
     "render_correlation_png",
     "render_png",
 ]
 
 logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class PlotParams:
+    """Per-cell presentation knobs (the plot-config surface; reference
+    plot_config_modal.py exposes the same set per plotter).
+
+    ``scale`` applies to the y axis for 1-D plotters and to the color
+    normalization for 2-D ones; ``vmin``/``vmax`` bound the same axis.
+    """
+
+    scale: str = "linear"  # 'linear' | 'log'
+    cmap: str = "viridis"
+    vmin: float | None = None
+    vmax: float | None = None
+
+    @classmethod
+    def from_dict(cls, raw: dict | None) -> "PlotParams":
+        raw = raw or {}
+        scale = str(raw.get("scale", "linear"))
+        if scale not in ("linear", "log"):
+            raise ValueError(f"scale must be linear|log, got {scale!r}")
+
+        def _f(key):
+            v = raw.get(key)
+            if v in (None, "", "null"):
+                return None
+            return float(v)
+
+        params = cls(
+            scale=scale,
+            cmap=str(raw.get("cmap", "viridis")),
+            vmin=_f("vmin"),
+            vmax=_f("vmax"),
+        )
+        # Bounds that would blow up at render time are config errors:
+        # reject at validation so a bad edit 400s once instead of the
+        # cell 500ing on every refresh.
+        if (
+            params.vmin is not None
+            and params.vmax is not None
+            and params.vmin >= params.vmax
+        ):
+            raise ValueError("vmin must be < vmax")
+        if scale == "log" and params.vmax is not None and params.vmax <= 0:
+            raise ValueError("log scale needs vmax > 0")
+        return params
+
+    def to_dict(self) -> dict:
+        """Normalized persistence form: defaults and unset bounds omitted,
+        so round-tripping through storage and query strings is lossless
+        (None must never serialize as the string 'null')."""
+        out: dict = {}
+        if self.scale != "linear":
+            out["scale"] = self.scale
+        if self.cmap != "viridis":
+            out["cmap"] = self.cmap
+        if self.vmin is not None:
+            out["vmin"] = self.vmin
+        if self.vmax is not None:
+            out["vmax"] = self.vmax
+        return out
+
+    def _norm(self):
+        """Matplotlib color norm for 2-D plotters."""
+        from matplotlib.colors import LogNorm, Normalize
+
+        if self.scale == "log":
+            # LogNorm cannot take bounds <= 0; clamp to a positive floor
+            # (vmax <= 0 is rejected at validation).
+            vmin = self.vmin if self.vmin and self.vmin > 0 else None
+            vmax = self.vmax if self.vmax and self.vmax > 0 else None
+            return LogNorm(vmin=vmin, vmax=vmax)
+        return Normalize(vmin=self.vmin, vmax=self.vmax)
+
+    def _apply_y(self, ax) -> None:
+        if self.scale == "log":
+            ax.set_yscale("log")
+        if self.vmin is not None or self.vmax is not None:
+            ax.set_ylim(bottom=self.vmin, top=self.vmax)
 
 # matplotlib's pyplot state is not thread-safe; the dashboard renders from
 # request handlers + ingestion threads.
@@ -53,7 +135,7 @@ def _coord_values(da: DataArray, dim: str) -> tuple[np.ndarray, str]:
 class LinePlotter:
     """1-D data: histogram steps (edge coords) or line (point coords)."""
 
-    def plot(self, ax, da: DataArray) -> None:
+    def plot(self, ax, da: DataArray, params: PlotParams = PlotParams()) -> None:
         dim = da.dims[0]
         x, label = _coord_values(da, dim)
         y = np.asarray(da.values, dtype=np.float64)
@@ -61,6 +143,7 @@ class LinePlotter:
             ax.stairs(y, x)
         else:
             ax.plot(x[: y.size], y)
+        params._apply_y(ax)
         ax.set_xlabel(label)
         ax.set_ylabel(f"[{da.unit!r}]")
 
@@ -68,7 +151,7 @@ class LinePlotter:
 class ImagePlotter:
     """2-D data as pcolormesh with edge-aware axes."""
 
-    def plot(self, ax, da: DataArray) -> None:
+    def plot(self, ax, da: DataArray, params: PlotParams = PlotParams()) -> None:
         ydim, xdim = da.dims
         x, xlabel = _coord_values(da, xdim)
         y, ylabel = _coord_values(da, ydim)
@@ -77,7 +160,9 @@ class ImagePlotter:
             x = np.concatenate([x, [x[-1] + (x[-1] - x[-2] if x.size > 1 else 1)]])
         if y.size == values.shape[0]:
             y = np.concatenate([y, [y[-1] + (y[-1] - y[-2] if y.size > 1 else 1)]])
-        mesh = ax.pcolormesh(x, y, values, shading="flat")
+        mesh = ax.pcolormesh(
+            x, y, values, shading="flat", cmap=params.cmap, norm=params._norm()
+        )
         ax.figure.colorbar(mesh, ax=ax, label=f"[{da.unit!r}]")
         ax.set_xlabel(xlabel)
         ax.set_ylabel(ylabel)
@@ -87,7 +172,7 @@ class Overlay1DPlotter:
     """2-D data where the leading dim is categorical (e.g. roi): one line
     per category (reference Overlay1DPlotter:1343)."""
 
-    def plot(self, ax, da: DataArray) -> None:
+    def plot(self, ax, da: DataArray, params: PlotParams = PlotParams()) -> None:
         cat_dim, dim = da.dims
         x, label = _coord_values(da, dim)
         values = np.asarray(da.values, dtype=np.float64)
@@ -97,6 +182,7 @@ class Overlay1DPlotter:
                 ax.stairs(y, x, label=f"{cat_dim} {i}")
             else:
                 ax.plot(x[: y.size], y, label=f"{cat_dim} {i}")
+        params._apply_y(ax)
         ax.legend(loc="upper right", fontsize="small")
         ax.set_xlabel(label)
         ax.set_ylabel(f"[{da.unit!r}]")
@@ -105,7 +191,7 @@ class Overlay1DPlotter:
 class ScalarPlotter:
     """0-d data: big number."""
 
-    def plot(self, ax, da: DataArray) -> None:
+    def plot(self, ax, da: DataArray, params: PlotParams = PlotParams()) -> None:
         ax.axis("off")
         ax.text(
             0.5,
@@ -126,7 +212,7 @@ class SlicerPlotter:
     def __init__(self, index: int | None = None) -> None:
         self._index = index
 
-    def plot(self, ax, da: DataArray) -> None:
+    def plot(self, ax, da: DataArray, params: PlotParams = PlotParams()) -> None:
         lead = da.dims[0]
         n = da.sizes[lead]
         i = min(self._index if self._index is not None else n // 2, n - 1)
@@ -138,7 +224,9 @@ class SlicerPlotter:
             x = np.concatenate([x, [x[-1] + (x[-1] - x[-2] if x.size > 1 else 1)]])
         if y.size == values.shape[0]:
             y = np.concatenate([y, [y[-1] + (y[-1] - y[-2] if y.size > 1 else 1)]])
-        mesh = ax.pcolormesh(x, y, values, shading="flat")
+        mesh = ax.pcolormesh(
+            x, y, values, shading="flat", cmap=params.cmap, norm=params._norm()
+        )
         ax.figure.colorbar(mesh, ax=ax, label=f"[{da.unit!r}]")
         ax.set_xlabel(xlabel)
         ax.set_ylabel(ylabel)
@@ -150,7 +238,7 @@ class TablePlotter:
 
     MAX_ROWS = 16
 
-    def plot(self, ax, da: DataArray) -> None:
+    def plot(self, ax, da: DataArray, params: PlotParams = PlotParams()) -> None:
         ax.axis("off")
         values = np.atleast_1d(np.asarray(da.values))
         dim = da.dims[0] if da.dims else ""
@@ -259,6 +347,7 @@ def render_png(
     figsize=(5.0, 3.6),
     dpi: int = 100,
     plotter=None,
+    params: PlotParams | None = None,
 ) -> bytes:
     """Render one DataArray to PNG using ``plotter`` or the auto-selection.
 
@@ -269,7 +358,7 @@ def render_png(
         fig, ax = plt.subplots(figsize=figsize, dpi=dpi)
         try:
             plotter = plotter or plotter_registry.select(da)
-            plotter.plot(ax, da)
+            plotter.plot(ax, da, params or PlotParams())
             if title:
                 fig.suptitle(title, fontsize=9)
             fig.tight_layout()

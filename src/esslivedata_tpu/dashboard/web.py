@@ -128,6 +128,192 @@ class StartWorkflowHandler(_Base):
         self.write_json({"job_number": str(job_id.job_number)})
 
 
+class StageWorkflowHandler(_Base):
+    """Phase one of the two-phase start: validate + hold params. Validation
+    failures surface field-by-field so the UI can mark the offending
+    controls (reference: staged-config validation in job_orchestrator)."""
+
+    def post(self) -> None:
+        body = json.loads(self.request.body or b"{}")
+        try:
+            wid = WorkflowId.parse(body["workflow_id"])
+            source = body["source_name"]
+        except Exception as err:
+            self.set_status(400)
+            self.write_json({"error": str(err)})
+            return
+        try:
+            self.services.orchestrator.stage(
+                wid, source, body.get("params") or {}
+            )
+        except Exception as err:
+            details = []
+            # pydantic ValidationError carries per-field diagnostics.
+            if hasattr(err, "errors"):
+                try:
+                    details = [
+                        {
+                            "field": ".".join(str(p) for p in e["loc"]),
+                            "message": e["msg"],
+                        }
+                        for e in err.errors()
+                    ]
+                except Exception:
+                    details = []
+            self.set_status(400)
+            self.write_json({"error": str(err), "details": details})
+            return
+        self.write_json({"staged": True})
+
+
+class CommitWorkflowHandler(_Base):
+    """Phase two: publish the staged start command."""
+
+    def post(self) -> None:
+        body = json.loads(self.request.body or b"{}")
+        try:
+            wid = WorkflowId.parse(body["workflow_id"])
+            source = body["source_name"]
+        except Exception as err:
+            self.set_status(400)
+            self.write_json({"error": str(err)})
+            return
+        if self.services.orchestrator.staged_params(wid, source) is None:
+            # Nothing staged (or the stage call failed validation):
+            # committing would silently dispatch empty params, bypassing
+            # the stage phase's checks.
+            self.set_status(409)
+            self.write_json(
+                {"error": f"nothing staged for {wid}/{source}; stage first"}
+            )
+            return
+        try:
+            job_id, _ = self.services.orchestrator.commit(
+                wid,
+                source,
+                aux_source_names=body.get("aux_source_names") or None,
+            )
+        except Exception as err:
+            self.set_status(400)
+            self.write_json({"error": str(err)})
+            return
+        self.write_json({"job_number": str(job_id.job_number)})
+
+
+class SessionHandler(_Base):
+    """Per-client poll: registers the session, drains its notification
+    backlog, and reports whether the configuration plane changed since its
+    last acknowledgement (multi-client convergence)."""
+
+    def get(self) -> None:
+        session_id = self.get_query_argument("session", None)
+        self.write_json(
+            self.services.sessions.poll(
+                session_id, self.services.notifications
+            )
+        )
+
+
+class GridManageHandler(_Base):
+    """POST /api/grid {spec} adds a grid; DELETE /api/grid/{gid} removes."""
+
+    def post(self, grid_id: str = "") -> None:
+        from ..config.grid_template import GridSpec
+
+        if grid_id:
+            # Grids are immutable documents: replace = delete + add. A
+            # POST to /api/grid/{gid} is a client error, not a crash.
+            self.set_status(405)
+            self.write_json(
+                {"error": "grids are not updated in place; DELETE then POST"}
+            )
+            return
+        body = json.loads(self.request.body or b"{}")
+        try:
+            spec = GridSpec.from_dict(body)
+        except Exception as err:
+            self.set_status(400)
+            self.write_json({"error": str(err)})
+            return
+        grid = self.services.plot_orchestrator.add_grid(spec)
+        self.services.sessions.bump_config()
+        self.write_json({"grid_id": grid.grid_id})
+
+    def delete(self, grid_id: str = "") -> None:
+        if self.services.plot_orchestrator.grid(grid_id) is None:
+            self.set_status(404)
+            self.write_json({"error": f"no grid {grid_id!r}"})
+            return
+        self.services.plot_orchestrator.remove_grid(grid_id)
+        self.services.sessions.bump_config()
+        self.write_json({"ok": True})
+
+
+class CellManageHandler(_Base):
+    """POST /api/grid/{gid}/cell adds a cell; DELETE .../cell/{idx}
+    removes; POST .../cell/{idx}/config edits selection/plotter/title/
+    presentation params (the plot-config surface)."""
+
+    def post(self, grid_id: str, index: str = "", _config: str = "") -> None:
+        from ..config.grid_template import CellGeometry, GridCellSpec
+
+        orch = self.services.plot_orchestrator
+        if orch.grid(grid_id) is None:
+            self.set_status(404)
+            self.write_json({"error": f"no grid {grid_id!r}"})
+            return
+        body = json.loads(self.request.body or b"{}")
+        from .plots import PlotParams
+
+        try:
+            if index == "":
+                # add cell; params persist in validated, normalized form
+                params = PlotParams.from_dict(body.get("params")).to_dict()
+                spec = GridCellSpec(
+                    geometry=CellGeometry(
+                        **body.get("geometry", {"row": 0, "col": 0})
+                    ),
+                    workflow=body.get("workflow", ""),
+                    output=body.get("output", ""),
+                    source=body.get("source", ""),
+                    plotter=body.get("plotter", ""),
+                    title=body.get("title", ""),
+                    params=GridCellSpec.freeze_params(params),
+                )
+                orch.add_cell(grid_id, spec)
+            else:
+                changes = {
+                    k: body[k]
+                    for k in ("workflow", "output", "source", "plotter", "title")
+                    if k in body
+                }
+                if "params" in body:
+                    changes["params"] = PlotParams.from_dict(
+                        body["params"]
+                    ).to_dict()
+                orch.update_cell(grid_id, int(index), **changes)
+        except (KeyError, IndexError):
+            self.set_status(404)
+            self.write_json({"error": "no such cell"})
+            return
+        except Exception as err:
+            self.set_status(400)
+            self.write_json({"error": str(err)})
+            return
+        self.services.sessions.bump_config()
+        self.write_json({"ok": True})
+
+    def delete(self, grid_id: str, index: str = "", _config: str = "") -> None:
+        try:
+            self.services.plot_orchestrator.remove_cell(grid_id, int(index))
+        except (KeyError, IndexError, ValueError):
+            self.set_status(404)
+            self.write_json({"error": "no such cell"})
+            return
+        self.services.sessions.bump_config()
+        self.write_json({"ok": True})
+
+
 class JobActionHandler(_Base):
     def post(self, action: str) -> None:
         import uuid as _uuid
@@ -187,6 +373,22 @@ class PlotHandler(_Base):
             self.set_status(404)
             return
         title = f"{key.job_id.source_name} · {key.output_name}"
+        # Presentation params ride the query string (the UI builds plot
+        # URLs from the owning cell's persisted params).
+        from .plots import PlotParams
+
+        try:
+            params = PlotParams.from_dict(
+                {
+                    k: self.get_argument(k)
+                    for k in ("scale", "cmap", "vmin", "vmax")
+                    if self.get_argument(k, None) is not None
+                }
+            )
+        except ValueError as err:
+            self.set_status(400)
+            self.write_json({"error": str(err)})
+            return
         # ?slice=N picks the leading-dim slice of 3-D data (SlicerPlotter);
         # ?plotter=table forces the tabular rendering of small 1-D data.
         slice_arg = self.get_argument("slice", None)
@@ -206,7 +408,7 @@ class PlotHandler(_Base):
                 return
             plotter = SlicerPlotter(index=index)
         try:
-            png = render_png(data, title=title, plotter=plotter)
+            png = render_png(data, title=title, plotter=plotter, params=params)
         except Exception:
             logger.exception("Plot render failed for %s", key)
             self.set_status(500)
@@ -301,7 +503,7 @@ _PAGE = """<!DOCTYPE html>
 </div>
 <div id="toasts"></div>
 <script>
-let gen = -1, tab = 'grids', gridGens = {{}}, noteSeq = 0;
+let gen = -1, tab = 'grids', gridGens = {{}}, sessionId = null;
 // All strings that originate outside this page (stream/device/source names
 // decoded from Kafka, user-editable titles) go through textContent — never
 // interpolated into innerHTML — so a crafted source_name cannot inject
@@ -323,6 +525,11 @@ function setTab(t) {{
 async function refreshGrids() {{
   const r = await fetch('/api/grids'); const data = await r.json();
   const root = document.getElementById('grids');
+  // Prune grids deleted by any client (wrapper div holds title + box).
+  const live = new Set(data.grids.map(g => 'grid-' + g.grid_id));
+  for (const box of [...root.querySelectorAll('.gridbox')]) {{
+    if (!live.has(box.id)) box.parentElement.remove();
+  }}
   for (const g of data.grids) {{
     let box = document.getElementById('grid-' + g.grid_id);
     if (!box) {{
@@ -342,10 +549,17 @@ async function refreshGrids() {{
       cell.className = 'card gridcell';
       cell.style.gridRow = `${{c.geometry.row + 1}} / span ${{c.geometry.row_span}}`;
       cell.style.gridColumn = `${{c.geometry.col + 1}} / span ${{c.geometry.col_span}}`;
-      cell.appendChild(el('h4', '', c.title || ('cell ' + i)));
+      const head = el('h4', '', c.title || ('cell ' + i));
+      const cfg = el('button', '', '⚙');
+      cfg.title = 'Edit plot config';
+      cfg.onclick = () => editCell(g.grid_id, c.index, c.params);
+      head.appendChild(cfg);
+      cell.appendChild(head);
       if (c.keys.length) {{
         const img = document.createElement('img');
-        img.src = '/plot/' + c.keys[0] + '.png?gen=' + g.generation;
+        const p = new URLSearchParams(c.params || {{}});
+        p.set('gen', g.generation);
+        img.src = '/plot/' + c.keys[0] + '.png?' + p.toString();
         cell.appendChild(img);
       }} else {{
         cell.appendChild(el('small', '', 'waiting for data…'));
@@ -354,10 +568,23 @@ async function refreshGrids() {{
     }});
   }}
 }}
-async function refreshNotes() {{
-  const r = await fetch('/api/notifications?since=' + noteSeq);
-  const data = await r.json();
-  noteSeq = data.latest;
+async function editCell(gridId, index, params) {{
+  // Minimal plot-config surface: scale / cmap / bounds as JSON.
+  const raw = prompt(
+    'Plot params (scale: linear|log, cmap, vmin, vmax)',
+    JSON.stringify(params || {{scale: 'linear'}}));
+  if (raw === null) return;
+  let parsed;
+  try {{ parsed = JSON.parse(raw); }} catch (e) {{ alert('invalid JSON'); return; }}
+  const r = await fetch(`/api/grid/${{gridId}}/cell/${{index}}/config`, {{
+    method: 'POST', body: JSON.stringify({{params: parsed}})}});
+  if (!r.ok) alert((await r.json()).error);
+}}
+async function pollSession() {{
+  const q = sessionId ? '?session=' + sessionId : '';
+  const r = await fetch('/api/session' + q); const data = await r.json();
+  sessionId = data.session_id;
+  if (data.config_changed) {{ gridGens = {{}}; }}  // another client edited config
   for (const n of data.notifications) {{
     const d = document.createElement('div');
     d.className = 'toast ' + n.level; d.textContent = n.message;
@@ -405,6 +632,7 @@ async function refresh() {{
       el('td', '', Number(dev.value).toPrecision(6) + ' ' + dev.unit));
     dt.appendChild(row);
   }}
+  await pollSession();
   if (tab === 'grids') {{
     await refreshGrids();
   }} else if (s.generation !== gen) {{
@@ -427,7 +655,6 @@ async function refresh() {{
       if (!seen.has(card.id.slice(5))) card.remove();
     }}
   }}
-  refreshNotes();
 }}
 setInterval(refresh, 1000); refresh();
 </script></body></html>
@@ -496,10 +723,18 @@ def make_app(services: DashboardServices, instrument: str) -> tornado.web.Applic
         [
             (r"/", IndexHandler),
             (r"/api/state", StateHandler),
+            (r"/api/session", SessionHandler),
             (r"/api/workflow/start", StartWorkflowHandler),
+            (r"/api/workflow/stage", StageWorkflowHandler),
+            (r"/api/workflow/commit", CommitWorkflowHandler),
             (r"/api/job/(stop|reset|remove)", JobActionHandler),
             (r"/api/roi", RoiHandler),
             (r"/api/grids", GridsHandler),
+            (r"/api/grid", GridManageHandler),
+            (r"/api/grid/([^/]+)", GridManageHandler),
+            (r"/api/grid/([^/]+)/cell", CellManageHandler),
+            (r"/api/grid/([^/]+)/cell/(\d+)", CellManageHandler),
+            (r"/api/grid/([^/]+)/cell/(\d+)(/config)", CellManageHandler),
             (r"/api/notifications", NotificationsHandler),
             (r"/api/devices", DevicesHandler),
             (r"/plot/correlation\.png", CorrelationPlotHandler),

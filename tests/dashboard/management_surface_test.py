@@ -1,0 +1,391 @@
+"""Dashboard management surface, driven as a browserless scripted client:
+two-phase stage->commit with validation errors, grid/cell/plot-config
+editing persisted through the config store across a dashboard restart,
+multi-client session generations, pending-command expiry notifications,
+dead-job reconciliation, and the ROI draw->readback round trip."""
+
+import json
+import time
+import uuid
+
+import pytest
+
+tornado = pytest.importorskip("tornado")
+
+from tornado.testing import AsyncHTTPTestCase
+
+from esslivedata_tpu.config.instruments.dummy.specs import DETECTOR_VIEW_HANDLE
+from esslivedata_tpu.core.job import JobStatus, ServiceStatus
+from esslivedata_tpu.dashboard.config_store import MemoryConfigStore
+from esslivedata_tpu.dashboard.dashboard_services import DashboardServices
+from esslivedata_tpu.dashboard.fake_backend import InProcessBackendTransport
+from esslivedata_tpu.dashboard.job_service import JobService
+from esslivedata_tpu.dashboard.session_registry import SessionRegistry
+from esslivedata_tpu.dashboard.transport import StatusMessage
+
+
+class ManagementApiTest(AsyncHTTPTestCase):
+    def get_app(self):
+        from esslivedata_tpu.dashboard.web import make_app
+
+        self.transport = InProcessBackendTransport(
+            "dummy", events_per_pulse=200
+        )
+        self.store = MemoryConfigStore()
+        self.services = DashboardServices(
+            transport=self.transport, config_store=self.store
+        )
+        return make_app(self.services, "dummy")
+
+    def drive(self, n=10):
+        for _ in range(n):
+            self.transport.tick()
+            self.services.pump.pump_once()
+
+    def post_json(self, url, payload, method="POST"):
+        return self.fetch(url, method=method, body=json.dumps(payload))
+
+    # -- two-phase start + validation -------------------------------------
+    def test_stage_rejects_invalid_params_with_details(self):
+        r = self.post_json(
+            "/api/workflow/stage",
+            {
+                "workflow_id": str(DETECTOR_VIEW_HANDLE.workflow_id),
+                "source_name": "panel_0",
+                "params": {"toa_bins": "not-a-number"},
+            },
+        )
+        assert r.code == 400
+        body = json.loads(r.body)
+        assert body["details"], body
+        assert any("toa_bins" in d["field"] for d in body["details"])
+
+    def test_stage_then_commit_starts_job(self):
+        r = self.post_json(
+            "/api/workflow/stage",
+            {
+                "workflow_id": str(DETECTOR_VIEW_HANDLE.workflow_id),
+                "source_name": "panel_0",
+                "params": {"toa_bins": 32},
+            },
+        )
+        assert r.code == 200
+        r = self.post_json(
+            "/api/workflow/commit",
+            {
+                "workflow_id": str(DETECTOR_VIEW_HANDLE.workflow_id),
+                "source_name": "panel_0",
+            },
+        )
+        assert r.code == 200
+        self.drive(20)
+        state = json.loads(self.fetch("/api/state").body)
+        assert any(j["source_name"] == "panel_0" for j in state["jobs"])
+
+    # -- grid / cell / plot-config management ------------------------------
+    def test_grid_cell_config_round_trip_and_restart_recovery(self):
+        r = self.post_json(
+            "/api/grid",
+            {
+                "name": "custom",
+                "title": "Custom grid",
+                "nrows": 1,
+                "ncols": 2,
+                "cells": [],
+            },
+        )
+        assert r.code == 200
+        grid_id = json.loads(r.body)["grid_id"]
+
+        r = self.post_json(
+            f"/api/grid/{grid_id}/cell",
+            {
+                "geometry": {"row": 0, "col": 0},
+                "workflow": str(DETECTOR_VIEW_HANDLE.workflow_id),
+                "output": "image_cumulative",
+                "title": "Image",
+            },
+        )
+        assert r.code == 200
+
+        # plot-config edit: log color scale, custom colormap
+        r = self.post_json(
+            f"/api/grid/{grid_id}/cell/0/config",
+            {"params": {"scale": "log", "cmap": "magma"}, "title": "Image L"},
+        )
+        assert r.code == 200
+        # invalid scale rejected
+        r = self.post_json(
+            f"/api/grid/{grid_id}/cell/0/config",
+            {"params": {"scale": "sqrt"}},
+        )
+        assert r.code == 400
+
+        grids = json.loads(self.fetch("/api/grids").body)["grids"]
+        cell = next(g for g in grids if g["grid_id"] == grid_id)["cells"][0]
+        assert cell["params"] == {"scale": "log", "cmap": "magma"}
+        assert cell["title"] == "Image L"
+
+        # Restart: a new DashboardServices over the same store recovers the
+        # grid with its cell config (persist -> restore).
+        reborn = DashboardServices(
+            transport=InProcessBackendTransport("dummy", events_per_pulse=10),
+            config_store=self.store,
+        )
+        grid = reborn.plot_orchestrator.grid(grid_id)
+        assert grid is not None
+        assert grid.cells[0].spec.params_dict == {
+            "scale": "log",
+            "cmap": "magma",
+        }
+        assert grid.cells[0].spec.title == "Image L"
+
+        # Removal persists too.
+        r = self.fetch(f"/api/grid/{grid_id}", method="DELETE")
+        assert r.code == 200
+        assert self.store.load(f"grids/{grid_id}") is None or not any(
+            k.endswith(grid_id) for k in self.store.keys()
+        )
+
+    def test_plot_render_honors_params(self):
+        self.post_json(
+            "/api/workflow/start",
+            {
+                "workflow_id": str(DETECTOR_VIEW_HANDLE.workflow_id),
+                "source_name": "panel_0",
+            },
+        )
+        time.sleep(0.05)
+        self.drive(25)
+        state = json.loads(self.fetch("/api/state").body)
+        kid = next(
+            k["id"] for k in state["keys"] if k["output"] == "image_cumulative"
+        )
+        ok = self.fetch(f"/plot/{kid}.png?scale=log&cmap=magma")
+        assert ok.code == 200 and ok.body[:4] == b"\x89PNG"
+        bad = self.fetch(f"/plot/{kid}.png?scale=sqrt")
+        assert bad.code == 400
+
+    # -- sessions ----------------------------------------------------------
+    def test_session_config_generation_fans_out_to_other_clients(self):
+        a = json.loads(self.fetch("/api/session").body)
+        b = json.loads(self.fetch("/api/session").body)
+        assert a["session_id"] != b["session_id"]
+        # First poll always reports changed (fresh session must render).
+        assert a["config_changed"] and b["config_changed"]
+        a2 = json.loads(
+            self.fetch(f"/api/session?session={a['session_id']}").body
+        )
+        assert not a2["config_changed"]
+
+        # Client B edits config; client A's next poll sees the change.
+        r = self.post_json(
+            "/api/grid", {"name": "from-b", "nrows": 1, "ncols": 1}
+        )
+        assert r.code == 200
+        a3 = json.loads(
+            self.fetch(f"/api/session?session={a['session_id']}").body
+        )
+        assert a3["config_changed"]
+
+    # -- ROI round trip ----------------------------------------------------
+    def test_roi_draw_readback_round_trip(self):
+        start = self.post_json(
+            "/api/workflow/start",
+            {
+                "workflow_id": str(DETECTOR_VIEW_HANDLE.workflow_id),
+                "source_name": "panel_0",
+            },
+        )
+        job_number = json.loads(start.body)["job_number"]
+        time.sleep(0.05)
+        self.drive(10)
+        r = self.post_json(
+            "/api/roi",
+            {
+                "source_name": "panel_0",
+                "job_number": job_number,
+                "rois": {
+                    "beam": {
+                        "kind": "rectangle",
+                        "x_min": 10,
+                        "x_max": 30,
+                        "y_min": 5,
+                        "y_max": 25,
+                    }
+                },
+            },
+        )
+        assert r.code == 200
+        self.drive(10)
+        state = json.loads(self.fetch("/api/state").body)
+        readbacks = [
+            k for k in state["keys"] if k["output"] == "roi_rectangle"
+        ]
+        assert readbacks, "applied-ROI readback not republished"
+        table = self.fetch(f"/plot/{readbacks[0]['id']}.png?plotter=table")
+        assert table.code == 200 and table.body[:4] == b"\x89PNG"
+
+
+class TestCommandExpiryAndReconciliation:
+    def test_expired_command_produces_notification(self, monkeypatch):
+        events = []
+        js = JobService(on_event=lambda level, msg: events.append((level, msg)))
+        cmd = js.track_command("panel_0", uuid.uuid4(), "start_job")
+        assert js.pending_commands()
+        monkeypatch.setattr(
+            type(cmd), "expired", property(lambda self: not self.resolved)
+        )
+        expired = js.sweep_expired()
+        assert expired and not js.pending_commands()
+        assert events and events[0][0] == "error"
+        assert "no acknowledgement" in events[0][1]
+
+    def _status(self, service_id, jobs):
+        return StatusMessage(
+            service_id=service_id,
+            status=ServiceStatus(
+                service_name="detector_data",
+                instrument="dummy",
+                state="running",
+                uptime_s=1.0,
+                jobs=jobs,
+            ),
+        )
+
+    def test_job_vanishing_between_heartbeats_notifies_and_removes(self):
+        events = []
+        js = JobService(on_event=lambda level, msg: events.append((level, msg)))
+        number = uuid.uuid4()
+        job = JobStatus(
+            source_name="panel_0",
+            job_number=number,
+            workflow_id="dummy/detector_view/panel_view/v1",
+            state="active",
+        )
+        js.on_status(self._status("svc-1", [job]))
+        assert js.jobs()
+        # adopted (we never started it)
+        assert js.is_adopted("panel_0", number)
+        # next heartbeat no longer lists it -> removed + warned
+        js.on_status(self._status("svc-1", []))
+        assert not js.jobs()
+        assert any("gone" in msg for _, msg in events)
+
+    def test_job_owned_by_other_service_untouched(self):
+        js = JobService()
+        number = uuid.uuid4()
+        job = JobStatus(
+            source_name="panel_0",
+            job_number=number,
+            workflow_id="w/x/y/v1",
+            state="active",
+        )
+        js.on_status(self._status("svc-1", [job]))
+        # another service's heartbeat must not reconcile svc-1's jobs
+        js.on_status(self._status("svc-2", []))
+        assert js.jobs()
+
+
+class TestSessionRegistryUnit:
+    def test_idle_sessions_expire(self, monkeypatch):
+        from esslivedata_tpu.dashboard import session_registry as sr
+
+        reg = SessionRegistry()
+        s = reg.ensure()
+        assert reg.sessions()
+        now = time.monotonic()
+        monkeypatch.setattr(sr.time, "monotonic", lambda: now + 120.0)
+        assert not reg.sessions()
+
+    def test_bump_config_marks_all_sessions_stale(self):
+        from esslivedata_tpu.dashboard.notification_queue import (
+            NotificationQueue,
+        )
+
+        reg = SessionRegistry()
+        notes = NotificationQueue()
+        a = reg.poll(None, notes)
+        reg.poll(a["session_id"], notes)
+        reg.bump_config()
+        again = reg.poll(a["session_id"], notes)
+        assert again["config_changed"]
+
+
+class CommitGuardTest(AsyncHTTPTestCase):
+    def get_app(self):
+        from esslivedata_tpu.dashboard.web import make_app
+
+        self.transport = InProcessBackendTransport("dummy", events_per_pulse=10)
+        self.services = DashboardServices(transport=self.transport)
+        return make_app(self.services, "dummy")
+
+    def test_commit_without_stage_is_rejected(self):
+        r = self.fetch(
+            "/api/workflow/commit",
+            method="POST",
+            body=json.dumps(
+                {
+                    "workflow_id": str(DETECTOR_VIEW_HANDLE.workflow_id),
+                    "source_name": "panel_0",
+                }
+            ),
+        )
+        assert r.code == 409
+        assert "stage first" in json.loads(r.body)["error"]
+
+    def test_post_to_grid_id_is_405_not_500(self):
+        r = self.fetch("/api/grid/some-grid", method="POST", body="{}")
+        assert r.code == 405
+
+    def test_null_bounds_normalize_away(self):
+        r = self.fetch(
+            "/api/grid",
+            method="POST",
+            body=json.dumps({"name": "g", "nrows": 1, "ncols": 1}),
+        )
+        gid = json.loads(r.body)["grid_id"]
+        r = self.fetch(
+            f"/api/grid/{gid}/cell",
+            method="POST",
+            body=json.dumps(
+                {
+                    "geometry": {"row": 0, "col": 0},
+                    "output": "image_cumulative",
+                    "params": {"scale": "log", "vmin": None, "vmax": None},
+                }
+            ),
+        )
+        assert r.code == 200
+        grids = json.loads(self.fetch("/api/grids").body)["grids"]
+        cell = next(g for g in grids if g["grid_id"] == gid)["cells"][0]
+        # None bounds are dropped in the normalized form — they must never
+        # round-trip into plot URLs as the string 'null'.
+        assert cell["params"] == {"scale": "log"}
+
+    def test_invalid_log_bounds_rejected(self):
+        r = self.fetch(
+            "/api/grid",
+            method="POST",
+            body=json.dumps({"name": "g2", "nrows": 1, "ncols": 1}),
+        )
+        gid = json.loads(r.body)["grid_id"]
+        r = self.fetch(
+            f"/api/grid/{gid}/cell",
+            method="POST",
+            body=json.dumps(
+                {
+                    "geometry": {"row": 0, "col": 0},
+                    "params": {"scale": "log", "vmax": 0},
+                }
+            ),
+        )
+        assert r.code == 400
+        r = self.fetch(
+            f"/api/grid/{gid}/cell",
+            method="POST",
+            body=json.dumps(
+                {"geometry": {"row": 0, "col": 0}, "params": {"vmin": 5, "vmax": 1}}
+            ),
+        )
+        assert r.code == 400
