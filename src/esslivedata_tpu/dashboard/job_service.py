@@ -11,6 +11,7 @@ fleet state; pending commands resolve on ack or expire.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 import uuid
@@ -23,8 +24,8 @@ __all__ = ["JobService", "PendingCommand", "TrackedService"]
 
 logger = logging.getLogger(__name__)
 
-SERVICE_STALE_S = 10.0
-COMMAND_EXPIRY_S = 10.0
+SERVICE_STALE_S = float(os.environ.get("LIVEDATA_SERVICE_STALE_S", "10"))
+COMMAND_EXPIRY_S = float(os.environ.get("LIVEDATA_COMMAND_EXPIRY_S", "10"))
 
 
 @dataclass

@@ -13,12 +13,60 @@ from typing import Any
 from ..kafka.stream_mapping import LivedataTopics
 from .transport import DashboardMessage, decode_backend_message
 
-__all__ = ["DashboardKafkaTransport"]
+__all__ = ["DashboardBrokerTransport", "DashboardKafkaTransport", "DashboardFileBrokerTransport"]
 
 logger = logging.getLogger(__name__)
 
 
-class DashboardKafkaTransport:
+class DashboardBrokerTransport:
+    """Dashboard transport over any confluent-shaped consumer/producer
+    pair: the Kafka and file-broker variants below differ only in client
+    construction."""
+
+    def __init__(self, *, instrument: str, dev: bool, consumer, producer) -> None:
+        self._topics = LivedataTopics.for_instrument(instrument, dev)
+        self._kind_by_topic = {
+            self._topics.data: "data",
+            self._topics.status: "status",
+            self._topics.responses: "responses",
+            self._topics.nicos: "nicos",
+        }
+        self._consumer = consumer
+        self._producer = producer
+
+    def start(self) -> None:
+        self._consumer.subscribe(list(self._kind_by_topic))
+
+    def stop(self) -> None:
+        self._consumer.close()
+        self._producer.flush(5)
+
+    def publish_command(self, payload: dict[str, Any]) -> None:
+        self._producer.produce(
+            self._topics.commands, json.dumps(payload).encode()
+        )
+        self._producer.poll(0)
+
+    def get_messages(self) -> list[DashboardMessage]:  # noqa: C901
+        out: list[DashboardMessage] = []
+        for raw in self._consumer.consume(100, 0.05) or []:
+            if raw.error() is not None:
+                logger.warning("Kafka error: %s", raw.error())
+                continue
+            kind = self._kind_by_topic.get(raw.topic())
+            if kind is None:
+                continue
+            try:
+                decoded = decode_backend_message(kind, raw.value())
+            except Exception:
+                logger.exception("Failed to decode message on %s", raw.topic())
+                continue
+            if decoded is not None:
+                out.append(decoded)
+        return out
+
+
+class DashboardKafkaTransport(DashboardBrokerTransport):
     def __init__(
         self,
         *,
@@ -36,17 +84,10 @@ class DashboardKafkaTransport:
             ) from err
         from ..kafka.consumer import kafka_client_config
 
-        self._topics = LivedataTopics.for_instrument(instrument, dev)
-        self._kind_by_topic = {
-            self._topics.data: "data",
-            self._topics.status: "status",
-            self._topics.responses: "responses",
-            self._topics.nicos: "nicos",
-        }
         # Full client config (incl. SASL/SSL in prod); ``bootstrap`` only
         # overrides the broker address.
         client_conf = kafka_client_config(bootstrap_override=bootstrap)
-        self._consumer = Consumer(
+        consumer = Consumer(
             {
                 **client_conf,
                 "group.id": group_id or f"{instrument}_dashboard",
@@ -54,35 +95,37 @@ class DashboardKafkaTransport:
                 "enable.auto.commit": False,
             }
         )
-        self._producer = Producer(client_conf)
-
-    def start(self) -> None:
-        self._consumer.subscribe(list(self._kind_by_topic))
-
-    def stop(self) -> None:
-        self._consumer.close()
-        self._producer.flush(5)
-
-    def publish_command(self, payload: dict[str, Any]) -> None:
-        self._producer.produce(
-            self._topics.commands, json.dumps(payload).encode()
+        super().__init__(
+            instrument=instrument,
+            dev=dev,
+            consumer=consumer,
+            producer=Producer(client_conf),
         )
-        self._producer.poll(0)
 
-    def get_messages(self) -> list[DashboardMessage]:
-        out: list[DashboardMessage] = []
-        for raw in self._consumer.consume(100, 0.05) or []:
-            if raw.error() is not None:
-                logger.warning("Kafka error: %s", raw.error())
-                continue
-            kind = self._kind_by_topic.get(raw.topic())
-            if kind is None:
-                continue
-            try:
-                decoded = decode_backend_message(kind, raw.value())
-            except Exception:
-                logger.exception("Failed to decode message on %s", raw.topic())
-                continue
-            if decoded is not None:
-                out.append(decoded)
-        return out
+
+class DashboardFileBrokerTransport(DashboardBrokerTransport):
+    """Dashboard over the file-backed broker (multi-process integration
+    and broker-less multi-service dev runs)."""
+
+    def __init__(
+        self, *, instrument: str, broker_dir: str, dev: bool = False
+    ) -> None:
+        from ..kafka.file_broker import (
+            FileBrokerConsumer,
+            FileBrokerProducer,
+            ensure_topics,
+        )
+
+        topics = LivedataTopics.for_instrument(instrument, dev)
+        ensure_topics(
+            broker_dir,
+            [topics.data, topics.status, topics.responses, topics.nicos,
+             topics.commands],
+        )
+        super().__init__(
+            instrument=instrument,
+            dev=dev,
+            consumer=FileBrokerConsumer(broker_dir),
+            producer=FileBrokerProducer(broker_dir),
+        )
+

@@ -23,8 +23,15 @@ logger = logging.getLogger(__name__)
 def main(argv: list[str] | None = None) -> int:
     parser = setup_arg_parser("esslivedata-tpu dashboard")
     parser.add_argument("--port", type=int, default=5007)
-    parser.add_argument("--transport", choices=["fake", "kafka"], default="fake")
+    parser.add_argument(
+        "--transport", choices=["fake", "kafka", "file"], default="fake"
+    )
     parser.add_argument("--kafka-bootstrap", default=None, help="override the broker from the kafka config namespace")
+    parser.add_argument(
+        "--broker-dir",
+        default=None,
+        help="file-backed broker root (required with --transport file)",
+    )
     parser.add_argument("--events-per-pulse", type=int, default=2000)
     parser.add_argument(
         "--config-dir",
@@ -52,6 +59,16 @@ def main(argv: list[str] | None = None) -> int:
 
         transport = InProcessBackendTransport(
             args.instrument, events_per_pulse=args.events_per_pulse
+        )
+    elif args.transport == "file":
+        if not args.broker_dir:
+            parser.error("--transport file requires --broker-dir")
+        from .kafka_transport import DashboardFileBrokerTransport
+
+        transport = DashboardFileBrokerTransport(
+            instrument=args.instrument,
+            broker_dir=args.broker_dir,
+            dev=args.dev,
         )
     else:
         from .kafka_transport import DashboardKafkaTransport

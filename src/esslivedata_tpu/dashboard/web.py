@@ -86,7 +86,15 @@ class StateHandler(_Base):
                     }
                     for s in js.services()
                 ],
-                "jobs": [j.model_dump(mode="json") for j in js.jobs()],
+                "jobs": [
+                    {
+                        **j.model_dump(mode="json"),
+                        # ADR 0008: jobs learned from heartbeats that this
+                        # dashboard never started (restart recovery).
+                        "adopted": js.is_adopted(j.source_name, j.job_number),
+                    }
+                    for j in js.jobs()
+                ],
                 "workflows": [
                     {
                         "workflow_id": str(spec.identifier),

@@ -165,6 +165,12 @@ class DataServiceRunner:
             help="override the broker from the kafka config namespace",
         )
         parser.add_argument(
+            "--broker-dir",
+            default=None,
+            help="use the file-backed broker rooted at this directory "
+            "instead of Kafka (multi-process integration/dev runs)",
+        )
+        parser.add_argument(
             "--check",
             action="store_true",
             help="build everything, print topics, exit",
@@ -194,34 +200,49 @@ class DataServiceRunner:
                 f"topics={builder.topics}"
             )
             return 0
-        try:
-            from confluent_kafka import Consumer, Producer
-        except ImportError:
-            logger.error(
-                "confluent_kafka not installed; install extra [kafka] or use "
-                "the fake transport (tests/demos)"
-            )
-            return 2
-        from ..kafka.consumer import assign_all_partitions, kafka_client_config
+        from ..kafka.consumer import assign_all_partitions
 
-        # Full client config (incl. SASL/SSL in prod) from the kafka
-        # config namespace; --kafka-bootstrap only overrides the broker.
-        client_conf = kafka_client_config(
-            bootstrap_override=args.kafka_bootstrap
-        )
-        consumer = Consumer(
-            {
-                **client_conf,
-                "group.id": f"{args.instrument}_{self._service_name}",
-                "auto.offset.reset": "latest",
-                "enable.auto.commit": False,
-            }
-        )
+        if args.broker_dir:
+            from ..kafka.file_broker import (
+                FileBrokerConsumer,
+                FileBrokerProducer,
+                ensure_topics,
+            )
+
+            # Create this service's input topics (the admin op a Kafka
+            # deployment does out of band) so launch order doesn't matter.
+            ensure_topics(args.broker_dir, builder.topics)
+            consumer = FileBrokerConsumer(args.broker_dir)
+            producer = FileBrokerProducer(args.broker_dir)
+        else:
+            try:
+                from confluent_kafka import Consumer, Producer
+            except ImportError:
+                logger.error(
+                    "confluent_kafka not installed; install extra [kafka] "
+                    "or use the fake transport (tests/demos)"
+                )
+                return 2
+            from ..kafka.consumer import kafka_client_config
+
+            # Full client config (incl. SASL/SSL in prod) from the kafka
+            # config namespace; --kafka-bootstrap overrides the broker.
+            client_conf = kafka_client_config(
+                bootstrap_override=args.kafka_bootstrap
+            )
+            consumer = Consumer(
+                {
+                    **client_conf,
+                    "group.id": f"{args.instrument}_{self._service_name}",
+                    "auto.offset.reset": "latest",
+                    "enable.auto.commit": False,
+                }
+            )
+            producer = Producer(client_conf)
         # Manual assignment pinned at the high watermark — never subscribe:
         # no group rebalancing, no offset commits; a restarted service
         # resumes at live data (kafka/consumer.py, reference consumer.py:31).
         assign_all_partitions(consumer, builder.topics)
-        producer = Producer(client_conf)
         service = builder.from_consumer(consumer, producer)
         service.start(blocking=True)
         return service.exit_code

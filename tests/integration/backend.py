@@ -1,0 +1,242 @@
+"""Multi-process integration harness (reference tests/integration/backend.py).
+
+Spawns *real OS processes* — backend services via their CLI entry points
+and the dashboard via its tornado entry point — communicating through the
+file-backed broker (kafka/file_broker.py). No docker, no Kafka deployment:
+every byte still crosses process boundaries through the same
+consumer/producer protocols the confluent client implements, so crash,
+restart, adoption and persistence scenarios exercise the real code paths.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent.parent
+SRC = REPO / "src"
+
+#: Raw + control + livedata topics for the dummy instrument.
+DUMMY_TOPICS = [
+    "dummy_detector",
+    "dummy_monitor",
+    "dummy_motion",
+    "dummy_camera",
+    "dummy_runInfo",
+    "dummy_livedata_data",
+    "dummy_livedata_status",
+    "dummy_livedata_commands",
+    "dummy_livedata_responses",
+    "dummy_livedata_roi",
+    "dummy_livedata_nicos",
+]
+
+
+def _child_env(**extra: str) -> dict[str, str]:
+    env = {
+        **os.environ,
+        "PYTHONPATH": str(SRC),
+        # Children run single-device CPU: fast startup, no TPU contention,
+        # no virtual-mesh flags inherited from the test process.
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",
+        # Fast control-plane timings so scenarios finish in seconds.
+        "LIVEDATA_COMMAND_EXPIRY_S": "2",
+        "LIVEDATA_SERVICE_STALE_S": "4",
+        **extra,
+    }
+    return env
+
+
+class IntegrationBackend:
+    """One broker dir + managed child processes + client-side helpers."""
+
+    def __init__(self, broker_dir: Path) -> None:
+        self.broker_dir = Path(broker_dir)
+        from esslivedata_tpu.kafka.file_broker import (
+            FileBrokerConsumer,
+            FileBrokerProducer,
+            ensure_topics,
+        )
+
+        ensure_topics(self.broker_dir, DUMMY_TOPICS)
+        self.producer = FileBrokerProducer(self.broker_dir)
+        self._consumer_cls = FileBrokerConsumer
+        self._procs: list[subprocess.Popen] = []
+
+    # -- process management ------------------------------------------------
+    def spawn_service(
+        self, service: str = "detector_data", instrument: str = "dummy"
+    ) -> subprocess.Popen:
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                f"esslivedata_tpu.services.{service}",
+                "--instrument",
+                instrument,
+                "--broker-dir",
+                str(self.broker_dir),
+                "--batcher",
+                "naive",
+            ],
+            env=_child_env(),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        self._procs.append(proc)
+        return proc
+
+    def spawn_dashboard(
+        self, port: int, *, config_dir: Path | None = None
+    ) -> subprocess.Popen:
+        cmd = [
+            sys.executable,
+            "-m",
+            "esslivedata_tpu.dashboard.reduction",
+            "--instrument",
+            "dummy",
+            "--transport",
+            "file",
+            "--broker-dir",
+            str(self.broker_dir),
+            "--port",
+            str(port),
+        ]
+        if config_dir is not None:
+            cmd += ["--config-dir", str(config_dir)]
+        proc = subprocess.Popen(
+            cmd,
+            env=_child_env(),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        self._procs.append(proc)
+        return proc
+
+    @staticmethod
+    def kill(proc: subprocess.Popen, *, hard: bool = True) -> None:
+        """SIGKILL (default — simulating a crash) or SIGTERM."""
+        if proc.poll() is not None:
+            return
+        proc.send_signal(signal.SIGKILL if hard else signal.SIGTERM)
+        proc.wait(timeout=10)
+
+    def shutdown(self) -> None:
+        for proc in self._procs:
+            if proc.poll() is None:
+                proc.kill()
+        for proc in self._procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        self._procs.clear()
+
+    @staticmethod
+    def dump_output(proc: subprocess.Popen, label: str) -> str:
+        try:
+            out = proc.stdout.read() if proc.stdout else ""
+        except Exception:
+            out = "<unreadable>"
+        return f"--- {label} output ---\n{out[-4000:]}"
+
+    # -- broker-side helpers ----------------------------------------------
+    def consumer(self, topics: list[str]):
+        """A consumer positioned at the start of the given topics."""
+        c = self._consumer_cls(self.broker_dir)
+        c.assign(
+            [type("TP", (), {"topic": t, "offset": 0})() for t in topics]
+        )
+        return c
+
+    def produce_events(
+        self,
+        pulse: int,
+        n_events: int = 500,
+        *,
+        source_name: str = "panel_a",
+        topic: str = "dummy_detector",
+        t0_ns: int | None = None,
+        seed: int = 0,
+    ) -> int:
+        from esslivedata_tpu.kafka import wire
+
+        rng = np.random.default_rng(seed + pulse)
+        ids = rng.integers(1, 64 * 64 + 1, n_events).astype(np.int32)
+        toa = rng.uniform(0, 7.0e7, n_events).astype(np.int32)
+        t_pulse = (t0_ns or time.time_ns()) + pulse * (10**9 // 14)
+        payload = wire.encode_ev44(
+            source_name,
+            pulse,
+            np.array([t_pulse]),
+            np.array([0]),
+            toa,
+            pixel_id=ids,
+        )
+        self.producer.produce(topic, payload)
+        return n_events
+
+    # -- waiting -----------------------------------------------------------
+    @staticmethod
+    def wait_for(predicate, timeout_s: float, *, interval_s: float = 0.25):
+        """Poll ``predicate`` until truthy; returns its value or raises."""
+        deadline = time.monotonic() + timeout_s
+        last = None
+        while time.monotonic() < deadline:
+            last = predicate()
+            if last:
+                return last
+            time.sleep(interval_s)
+        raise TimeoutError(f"condition not met in {timeout_s}s (last={last!r})")
+
+    def wait_for_heartbeat(self, timeout_s: float = 60.0) -> dict:
+        """First x5f2 heartbeat on the status topic (service is up)."""
+        from esslivedata_tpu.kafka import wire
+
+        consumer = self.consumer(["dummy_livedata_status"])
+
+        def probe():
+            for msg in consumer.consume(50, 0.0):
+                status = wire.decode_x5f2(msg.value())
+                return json.loads(status.status_json)
+            return None
+
+        return self.wait_for(probe, timeout_s)
+
+
+# -- HTTP client (browserless dashboard driver) ----------------------------
+
+
+def http_json(
+    url: str, payload: dict | None = None, *, method: str | None = None
+) -> dict:
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data, method=method or ("POST" if data else "GET")
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def wait_for_http(url: str, timeout_s: float = 60.0) -> dict:
+    deadline = time.monotonic() + timeout_s
+    last_err: Exception | None = None
+    while time.monotonic() < deadline:
+        try:
+            return http_json(url)
+        except (urllib.error.URLError, ConnectionError, OSError) as err:
+            last_err = err
+            time.sleep(0.4)
+    raise TimeoutError(f"{url} unreachable in {timeout_s}s: {last_err}")

@@ -1,0 +1,271 @@
+"""Multi-process integration scenarios (reference tests/integration/):
+real service + dashboard OS processes over the file broker — end-to-end
+reduction, service crash -> restart, dashboard restart -> job adoption,
+command expiry, config persistence."""
+
+import json
+import time
+import uuid
+
+import pytest
+
+from .backend import (
+    IntegrationBackend,
+    http_json,
+    wait_for_http,
+)
+
+pytestmark = pytest.mark.integration
+
+PORT_A = 8931
+PORT_B = 8932
+
+
+@pytest.fixture(scope="module")
+def backend(tmp_path_factory):
+    b = IntegrationBackend(tmp_path_factory.mktemp("broker"))
+    yield b
+    b.shutdown()
+
+
+@pytest.fixture(scope="module")
+def detector(backend):
+    """One detector service process shared by the module (import + jit
+    startup costs ~10s; individual tests restart it only when the scenario
+    is about crashing it)."""
+    proc = backend.spawn_service("detector_data")
+    try:
+        backend.wait_for_heartbeat(timeout_s=90)
+    except TimeoutError:
+        raise AssertionError(backend.dump_output(proc, "detector"))
+    return proc
+
+
+def _start_job(base: str) -> str:
+    state = http_json(f"{base}/api/state")
+    wid = next(
+        w["workflow_id"]
+        for w in state["workflows"]
+        if "detector_view" in w["workflow_id"]
+    )
+    out = http_json(
+        f"{base}/api/workflow/start",
+        {"workflow_id": wid, "source_name": "panel_0"},
+    )
+    return out["job_number"]
+
+
+class TestEndToEndReduction:
+    def test_events_flow_to_dashboard(self, backend, detector):
+        dash = backend.spawn_dashboard(PORT_A)
+        base = f"http://localhost:{PORT_A}"
+        try:
+            wait_for_http(f"{base}/api/state", timeout_s=90)
+            job_number = _start_job(base)
+
+            def job_known():
+                state = http_json(f"{base}/api/state")
+                return any(
+                    j["job_number"] == job_number for j in state["jobs"]
+                )
+
+            backend.wait_for(job_known, 30)
+            # Activation is data-time-driven: the job leaves 'scheduled'
+            # once event data flows.
+            t0 = time.time_ns()
+            for pulse in range(8):
+                backend.produce_events(pulse, t0_ns=t0)
+
+            def job_active():
+                state = http_json(f"{base}/api/state")
+                return any(
+                    j["job_number"] == job_number and j["state"] == "active"
+                    for j in state["jobs"]
+                )
+
+            backend.wait_for(job_active, 30)
+
+            def has_keys():
+                state = http_json(f"{base}/api/state")
+                return [
+                    k
+                    for k in state["keys"]
+                    if k["output"] == "counts_cumulative"
+                ]
+
+            keys = backend.wait_for(has_keys, 30)
+            assert keys, "reduced output never reached the dashboard"
+        except (AssertionError, TimeoutError):
+            backend.kill(dash)
+            raise AssertionError(backend.dump_output(dash, "dashboard"))
+        finally:
+            backend.kill(dash)
+
+    def test_service_crash_restart_and_job_reconciliation(
+        self, backend, detector
+    ):
+        dash = backend.spawn_dashboard(PORT_A)
+        base = f"http://localhost:{PORT_A}"
+        try:
+            wait_for_http(f"{base}/api/state", timeout_s=90)
+            job_number = _start_job(base)
+            backend.wait_for(
+                lambda: any(
+                    j["job_number"] == job_number
+                    for j in http_json(f"{base}/api/state")["jobs"]
+                ),
+                30,
+            )
+
+            # Crash the service (SIGKILL: no finalize, state loss by design).
+            backend.kill(detector, hard=True)
+            replacement = backend.spawn_service("detector_data")
+            try:
+                # The restarted service heartbeats with no jobs; the
+                # dashboard reconciles the dead job away and notifies.
+                backend.wait_for(
+                    lambda: not any(
+                        j["job_number"] == job_number
+                        for j in http_json(f"{base}/api/state")["jobs"]
+                    ),
+                    90,
+                )
+                # A fresh job on the restarted service works.
+                new_job = _start_job(base)
+                backend.wait_for(
+                    lambda: any(
+                        j["job_number"] == new_job
+                        for j in http_json(f"{base}/api/state")["jobs"]
+                    ),
+                    60,
+                )
+                t1 = time.time_ns()
+                for pulse in range(4):
+                    backend.produce_events(pulse, t0_ns=t1, seed=77)
+                backend.wait_for(
+                    lambda: any(
+                        j["job_number"] == new_job and j["state"] == "active"
+                        for j in http_json(f"{base}/api/state")["jobs"]
+                    ),
+                    60,
+                )
+            finally:
+                backend.kill(replacement)
+        except (AssertionError, TimeoutError):
+            backend.kill(dash)
+            raise AssertionError(backend.dump_output(dash, "dashboard"))
+        finally:
+            backend.kill(dash)
+
+
+class TestDashboardScenarios:
+    def test_dashboard_restart_adopts_running_jobs(self, backend):
+        service = backend.spawn_service("detector_data")
+        try:
+            backend.wait_for_heartbeat(timeout_s=90)
+            dash_a = backend.spawn_dashboard(PORT_A)
+            base_a = f"http://localhost:{PORT_A}"
+            wait_for_http(f"{base_a}/api/state", timeout_s=90)
+            job_number = _start_job(base_a)
+            backend.wait_for(
+                lambda: any(
+                    j["job_number"] == job_number
+                    for j in http_json(f"{base_a}/api/state")["jobs"]
+                ),
+                30,
+            )
+            backend.kill(dash_a)  # dashboard dies; the job keeps running
+
+            dash_b = backend.spawn_dashboard(PORT_B)
+            base_b = f"http://localhost:{PORT_B}"
+            try:
+                wait_for_http(f"{base_b}/api/state", timeout_s=90)
+
+                def adopted():
+                    jobs = http_json(f"{base_b}/api/state")["jobs"]
+                    return [
+                        j
+                        for j in jobs
+                        if j["job_number"] == job_number and j["adopted"]
+                    ]
+
+                assert backend.wait_for(adopted, 30)
+            finally:
+                backend.kill(dash_b)
+        finally:
+            backend.kill(service)
+
+    def test_command_expiry_without_services(self, backend, tmp_path):
+        # No services are running in this broker dir slice of time? Other
+        # module tests may have one — use a fresh broker dir to guarantee
+        # silence on the status topic.
+        iso = IntegrationBackend(tmp_path / "broker")
+        dash = iso.spawn_dashboard(PORT_B)
+        base = f"http://localhost:{PORT_B}"
+        try:
+            wait_for_http(f"{base}/api/state", timeout_s=90)
+            state = http_json(f"{base}/api/state")
+            wid = next(
+                w["workflow_id"]
+                for w in state["workflows"]
+                if "detector_view" in w["workflow_id"]
+            )
+            http_json(
+                f"{base}/api/workflow/start",
+                {"workflow_id": wid, "source_name": "panel_0"},
+            )
+            assert http_json(f"{base}/api/state")["pending_commands"]
+
+            # LIVEDATA_COMMAND_EXPIRY_S=2 in the child: the unacked command
+            # expires and surfaces as an error notification.
+            def expired():
+                notes = http_json(f"{base}/api/notifications?since=0")
+                return [
+                    n
+                    for n in notes["notifications"]
+                    if "no acknowledgement" in n["message"]
+                ]
+
+            iso.wait_for(expired, 30)
+            assert not http_json(f"{base}/api/state")["pending_commands"]
+        except (AssertionError, TimeoutError):
+            iso.kill(dash)
+            raise AssertionError(iso.dump_output(dash, "dashboard"))
+        finally:
+            iso.shutdown()
+
+    def test_config_persists_across_dashboard_restart(self, backend, tmp_path):
+        config_dir = tmp_path / "config"
+        iso = IntegrationBackend(tmp_path / "broker2")
+        dash = iso.spawn_dashboard(PORT_B, config_dir=config_dir)
+        base = f"http://localhost:{PORT_B}"
+        grid_name = f"persisted-{uuid.uuid4().hex[:6]}"
+        try:
+            wait_for_http(f"{base}/api/state", timeout_s=90)
+            out = http_json(
+                f"{base}/api/grid",
+                {"name": grid_name, "nrows": 1, "ncols": 1},
+            )
+            gid = out["grid_id"]
+            http_json(
+                f"{base}/api/grid/{gid}/cell",
+                {
+                    "geometry": {"row": 0, "col": 0},
+                    "output": "image_cumulative",
+                    "params": {"scale": "log"},
+                },
+            )
+            iso.kill(dash, hard=True)
+
+            dash2 = iso.spawn_dashboard(PORT_B, config_dir=config_dir)
+            try:
+                wait_for_http(f"{base}/api/state", timeout_s=90)
+                grids = http_json(f"{base}/api/grids")["grids"]
+                grid = next(g for g in grids if g["grid_id"] == gid)
+                assert grid["cells"][0]["params"] == {"scale": "log"}
+            finally:
+                iso.kill(dash2)
+        except (AssertionError, TimeoutError):
+            raise AssertionError(iso.dump_output(dash, "dashboard"))
+        finally:
+            iso.shutdown()

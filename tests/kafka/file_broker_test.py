@@ -1,0 +1,105 @@
+"""File-backed broker unit coverage: framing, watermarks, rotation,
+partial-frame tolerance."""
+
+import threading
+
+import pytest
+
+from esslivedata_tpu.kafka.consumer import assign_all_partitions
+from esslivedata_tpu.kafka.file_broker import (
+    FileBrokerConsumer,
+    FileBrokerProducer,
+    ensure_topics,
+)
+
+
+@pytest.fixture
+def broker(tmp_path):
+    ensure_topics(tmp_path, ["alpha", "beta"])
+    return tmp_path
+
+
+def test_round_trip_with_keys(broker):
+    prod = FileBrokerProducer(broker)
+    prod.produce("alpha", b"v1", key=b"k1")
+    prod.produce("alpha", b"v2")
+    cons = FileBrokerConsumer(broker)
+    assign_all_partitions(cons, ["alpha"])  # at high watermark: sees nothing
+    assert cons.consume(10, 0.0) == []
+    prod.produce("alpha", b"v3", key="str-key")
+    msgs = cons.consume(10, 0.0)
+    assert [(m.value(), m.key()) for m in msgs] == [(b"v3", b"str-key")]
+    assert msgs[0].topic() == "alpha" and msgs[0].error() is None
+
+
+def test_assign_from_zero_reads_backlog(broker):
+    prod = FileBrokerProducer(broker)
+    for i in range(5):
+        prod.produce("beta", f"m{i}".encode())
+    cons = FileBrokerConsumer(broker)
+    cons.assign([type("TP", (), {"topic": "beta", "offset": 0})()])
+    assert [m.value() for m in cons.consume(10, 0.0)] == [
+        b"m0", b"m1", b"m2", b"m3", b"m4"
+    ]
+
+
+def test_missing_topic_fails_assignment(broker):
+    cons = FileBrokerConsumer(broker)
+    with pytest.raises(ValueError, match="not found"):
+        assign_all_partitions(cons, ["gamma"])
+
+
+def test_partial_frame_not_surfaced(broker):
+    prod = FileBrokerProducer(broker)
+    prod.produce("alpha", b"complete")
+    # Simulate a writer mid-append: torn frame at the tail.
+    with open(broker / "alpha.log", "ab") as f:
+        f.write(b"\x05\x00\x00\x00")  # half a header
+    cons = FileBrokerConsumer(broker)
+    cons.assign([type("TP", (), {"topic": "alpha", "offset": 0})()])
+    assert [m.value() for m in cons.consume(10, 0.0)] == [b"complete"]
+    # The torn tail stays pending; completing it surfaces the frame.
+
+
+def test_round_robin_prevents_topic_starvation(broker):
+    prod = FileBrokerProducer(broker)
+    for i in range(300):
+        prod.produce("alpha", b"bulk")
+    prod.produce("beta", b"control")
+    cons = FileBrokerConsumer(broker)
+    cons.assign(
+        [
+            type("TP", (), {"topic": "alpha", "offset": 0})(),
+            type("TP", (), {"topic": "beta", "offset": 0})(),
+        ]
+    )
+    seen_beta = False
+    for _ in range(4):  # alpha alone needs 3 calls at budget 100
+        for m in cons.consume(100, 0.0):
+            seen_beta = seen_beta or m.topic() == "beta"
+        if seen_beta:
+            break
+    assert seen_beta, "control topic starved behind bulk topic"
+
+
+def test_concurrent_producers_interleave_at_frame_boundaries(broker):
+    def writer(tag):
+        prod = FileBrokerProducer(broker)
+        for i in range(200):
+            prod.produce("alpha", f"{tag}-{i}".encode())
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in "ab"]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    cons = FileBrokerConsumer(broker)
+    cons.assign([type("TP", (), {"topic": "alpha", "offset": 0})()])
+    seen = []
+    while batch := cons.consume(100, 0.0):
+        seen.extend(m.value().decode() for m in batch)
+    assert len(seen) == 400
+    # per-producer order preserved
+    for tag in "ab":
+        mine = [s for s in seen if s.startswith(tag)]
+        assert mine == [f"{tag}-{i}" for i in range(200)]
