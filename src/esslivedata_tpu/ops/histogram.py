@@ -54,7 +54,139 @@ import numpy as np
 
 from .event_batch import EventBatch, dispatch_safe
 
-__all__ = ["EventHistogrammer", "HistogramState"]
+__all__ = ["EventHistogrammer", "EventProjection", "HistogramState"]
+
+
+class EventProjection:
+    """The traceable event -> flat-bin projection, shared by the single-
+    device and sharded histogrammers (one masking kernel, one set of
+    semantics: TOA binning incl. non-uniform edges, LUT routing with
+    replicas at 1/R weight, per-pixel weights, dump-bin for invalid).
+
+    ``row0``/``n_rows`` select a row window so a bank shard projects into
+    its local rows; the dump index is ``n_rows * n_toa``.
+    """
+
+    def __init__(
+        self,
+        *,
+        toa_edges: np.ndarray,
+        pixel_lut=None,
+        pixel_weights=None,
+        n_screen: int,
+    ) -> None:
+        toa_edges = np.asarray(toa_edges, dtype=np.float64)
+        if toa_edges.ndim != 1 or toa_edges.size < 2:
+            raise ValueError("toa_edges must be 1-D with at least 2 entries")
+        if not np.all(np.diff(toa_edges) > 0):
+            raise ValueError("toa_edges must be strictly increasing")
+        self.edges = toa_edges
+        self.n_toa = toa_edges.size - 1
+        self.n_screen = int(n_screen)
+        widths = np.diff(toa_edges)
+        self.uniform = bool(np.allclose(widths, widths[0], rtol=1e-9))
+        self.lo = float(toa_edges[0])
+        self.hi = float(toa_edges[-1])
+        self.inv_width = float(self.n_toa / (self.hi - self.lo))
+        self.nonuniform_edges = (
+            None if self.uniform else jnp.asarray(toa_edges, dtype=jnp.float32)
+        )
+        if pixel_lut is not None:
+            pixel_lut = np.asarray(pixel_lut, dtype=np.int32)
+            if pixel_lut.ndim == 1:
+                pixel_lut = pixel_lut[None, :]
+            if pixel_lut.ndim != 2:
+                raise ValueError("pixel_lut must be 1-D or 2-D")
+            if pixel_lut.max(initial=-1) >= n_screen:
+                raise ValueError("pixel_lut entries must be < n_screen")
+            self.lut_host = pixel_lut
+            self.lut = jnp.asarray(pixel_lut)
+        else:
+            self.lut_host = None
+            self.lut = None
+        self.weights = (
+            jnp.asarray(np.asarray(pixel_weights, dtype=np.float32))
+            if pixel_weights is not None
+            else None
+        )
+
+    def place_constants(self, device_put) -> None:
+        """Re-place the LUT/weights (e.g. replicated over a mesh)."""
+        if self.lut is not None:
+            self.lut = device_put(self.lut)
+        if self.weights is not None:
+            self.weights = device_put(self.weights)
+
+    def toa_bin(self, toa: jax.Array) -> tuple[jax.Array, jax.Array]:
+        if self.uniform:
+            tb = jnp.floor((toa - self.lo) * self.inv_width).astype(jnp.int32)
+            t_ok = (toa >= self.lo) & (toa < self.hi)
+        else:
+            tb = (
+                jnp.searchsorted(
+                    self.nonuniform_edges, toa, side="right"
+                ).astype(jnp.int32)
+                - 1
+            )
+            t_ok = (tb >= 0) & (tb < self.n_toa)
+        return jnp.clip(tb, 0, self.n_toa - 1), t_ok
+
+    def flat_and_weights(
+        self,
+        pixel_id: jax.Array,
+        toa: jax.Array,
+        *,
+        row0=0,
+        n_rows: int | None = None,
+    ) -> tuple[jax.Array, jax.Array | None]:
+        """Flat local bin index per event (dump = n_rows*n_toa = dropped)
+        and the event weight (None = unit weights); replicas folded in."""
+        n_rows = self.n_screen if n_rows is None else n_rows
+        n_local = n_rows * self.n_toa
+        tb, t_ok = self.toa_bin(toa)
+
+        if self.weights is not None:
+            n_pix = self.weights.shape[0]
+            p_in = (pixel_id >= 0) & (pixel_id < n_pix)
+            w = jnp.where(
+                p_in, self.weights[jnp.clip(pixel_id, 0, n_pix - 1)], 0.0
+            )
+        else:
+            w = None
+
+        if self.lut is not None:
+            n_rep, n_pix = self.lut.shape
+            p_ok = (pixel_id >= 0) & (pixel_id < n_pix)
+            pid = jnp.clip(pixel_id, 0, n_pix - 1)
+            screen = self.lut[:, pid]  # [R, N]
+            local_row = screen - row0
+            ok = (
+                p_ok[None, :]
+                & t_ok[None, :]
+                & (screen >= 0)
+                & (local_row >= 0)
+                & (local_row < n_rows)
+            )
+            flat = jnp.where(
+                ok, local_row * self.n_toa + tb[None, :], n_local
+            ).reshape(-1)
+            if w is None and n_rep > 1:
+                w = jnp.full(flat.shape, 1.0 / n_rep, dtype=jnp.float32)
+            elif w is not None:
+                w = jnp.broadcast_to(w[None, :] / n_rep, screen.shape).reshape(-1)
+        else:
+            local_row = pixel_id - row0
+            ok = (
+                (pixel_id >= 0)
+                & (pixel_id < self.n_screen)
+                & t_ok
+                & (local_row >= 0)
+                & (local_row < n_rows)
+            )
+            flat = jnp.where(ok, local_row * self.n_toa + tb, n_local)
+            if w is not None:
+                w = jnp.where(ok, w, 0.0)
+        return flat, w
 
 
 class HistogramState(NamedTuple):
@@ -126,46 +258,21 @@ class EventHistogrammer:
         method: str = "scatter",
         dtype=jnp.float32,
     ) -> None:
-        toa_edges = np.asarray(toa_edges, dtype=np.float64)
-        if toa_edges.ndim != 1 or toa_edges.size < 2:
-            raise ValueError("toa_edges must be 1-D with at least 2 entries")
-        if not np.all(np.diff(toa_edges) > 0):
-            raise ValueError("toa_edges must be strictly increasing")
         if method not in ("scatter", "sort"):
             raise ValueError(f"Unknown method {method!r}")
-        self._edges = toa_edges
-        self._n_toa = toa_edges.size - 1
-        self._n_screen = int(n_screen)
+        self._proj = EventProjection(
+            toa_edges=toa_edges,
+            pixel_lut=pixel_lut,
+            pixel_weights=pixel_weights,
+            n_screen=n_screen,
+        )
+        self._edges = self._proj.edges
+        self._n_toa = self._proj.n_toa
+        self._n_screen = self._proj.n_screen
         self._n_bins = self._n_screen * self._n_toa
         self._dtype = dtype
         self._method = method
         self._decay = decay
-        widths = np.diff(toa_edges)
-        self._uniform = bool(np.allclose(widths, widths[0], rtol=1e-9))
-        self._lo = float(toa_edges[0])
-        self._hi = float(toa_edges[-1])
-        self._inv_width = float(self._n_toa / (self._hi - self._lo))
-        if pixel_lut is not None:
-            pixel_lut = np.asarray(pixel_lut, dtype=np.int32)
-            if pixel_lut.ndim == 1:
-                pixel_lut = pixel_lut[None, :]
-            if pixel_lut.ndim != 2:
-                raise ValueError("pixel_lut must be 1-D or 2-D")
-            if pixel_lut.max(initial=-1) >= n_screen:
-                raise ValueError("pixel_lut entries must be < n_screen")
-            self._lut_host = pixel_lut
-            self._lut = jnp.asarray(pixel_lut)
-        else:
-            self._lut_host = None
-            self._lut = None
-        self._weights = (
-            jnp.asarray(np.asarray(pixel_weights, dtype=np.float32))
-            if pixel_weights is not None
-            else None
-        )
-        self._nonuniform_edges = (
-            None if self._uniform else jnp.asarray(toa_edges, dtype=jnp.float32)
-        )
         self._step = jax.jit(self._step_impl, donate_argnums=(0,))
         self._step_flat = jax.jit(self._step_flat_impl, donate_argnums=(0,))
         self._clear_window = jax.jit(self._clear_window_impl, donate_argnums=(0,))
@@ -200,56 +307,6 @@ class EventHistogrammer:
         return HistogramState(folded=zeros, window=jnp.array(zeros), scale=scale)
 
     # -- kernel -----------------------------------------------------------
-    def _toa_bin(self, toa: jax.Array) -> tuple[jax.Array, jax.Array]:
-        if self._uniform:
-            tb = jnp.floor((toa - self._lo) * self._inv_width).astype(jnp.int32)
-            t_ok = (toa >= self._lo) & (toa < self._hi)
-        else:
-            tb = (
-                jnp.searchsorted(
-                    self._nonuniform_edges, toa, side="right"
-                ).astype(jnp.int32)
-                - 1
-            )
-            t_ok = (tb >= 0) & (tb < self._n_toa)
-        return jnp.clip(tb, 0, self._n_toa - 1), t_ok
-
-    def _flat_indices_and_weights(
-        self, pixel_id: jax.Array, toa: jax.Array
-    ) -> tuple[jax.Array, jax.Array | None]:
-        """Flattened bin index per event (dump bin ``n_bins`` = dropped)
-        and the event weight (None = unit weights). Returns ([R*N], [R*N])
-        with R replicas folded in."""
-        tb, t_ok = self._toa_bin(toa)
-
-        if self._weights is not None:
-            n_pix = self._weights.shape[0]
-            p_in = (pixel_id >= 0) & (pixel_id < n_pix)
-            w = jnp.where(
-                p_in, self._weights[jnp.clip(pixel_id, 0, n_pix - 1)], 0.0
-            )
-        else:
-            w = None
-
-        if self._lut is not None:
-            n_rep, n_pix = self._lut.shape
-            p_ok = (pixel_id >= 0) & (pixel_id < n_pix)
-            pid = jnp.clip(pixel_id, 0, n_pix - 1)
-            screen = self._lut[:, pid]  # [R, N]
-            ok = p_ok[None, :] & t_ok[None, :] & (screen >= 0)
-            flat = screen * self._n_toa + tb[None, :]
-            flat = jnp.where(ok, flat, self._n_bins).reshape(-1)
-            if w is None and n_rep > 1:
-                w = jnp.full(flat.shape, 1.0 / n_rep, dtype=jnp.float32)
-            elif w is not None:
-                w = jnp.broadcast_to(w[None, :] / n_rep, screen.shape).reshape(-1)
-        else:
-            ok = (pixel_id >= 0) & (pixel_id < self._n_screen) & t_ok
-            flat = jnp.where(ok, pixel_id * self._n_toa + tb, self._n_bins)
-            if w is not None:
-                w = jnp.where(ok, w, 0.0)
-        return flat, w
-
     # Renormalize the lazy decay scale well before float32 underflow
     # (tiny floats start at ~1e-38; 1e-12 leaves update magnitudes 1/scale
     # no larger than 1e12, far inside float32 range).
@@ -302,7 +359,7 @@ class EventHistogrammer:
     def _step_impl(
         self, state: HistogramState, pixel_id: jax.Array, toa: jax.Array
     ) -> HistogramState:
-        flat, w = self._flat_indices_and_weights(pixel_id, toa)
+        flat, w = self._proj.flat_and_weights(pixel_id, toa)
         return self._advance(state, flat, w)
 
     def _step_flat_impl(
@@ -372,20 +429,22 @@ class EventHistogrammer:
         ingest thread per batch (the native shim folds the same math into
         ev44 decode), so every extra temporary costs real pipeline time.
         """
-        if self._weights is not None:
+        if self._proj.weights is not None:
             raise ValueError("flatten_host does not support pixel_weights")
-        if self._lut_host is not None and self._lut_host.shape[0] != 1:
+        lut_host = self._proj.lut_host
+        if lut_host is not None and lut_host.shape[0] != 1:
             raise ValueError("flatten_host does not support replica LUTs")
         if self._n_bins >= np.iinfo(np.int32).max:
             raise ValueError("bin space exceeds int32 flat indexing")
         pixel_id = np.asarray(pixel_id)
         toa = np.asarray(toa, dtype=np.float32)
-        if self._uniform:
-            tb = (toa - np.float32(self._lo)) * np.float32(self._inv_width)
+        proj = self._proj
+        if proj.uniform:
+            tb = (toa - np.float32(proj.lo)) * np.float32(proj.inv_width)
             tb = tb.astype(np.int32)
             # Range checks on toa itself (not tb): int32 truncation rounds
             # toward zero, so toa slightly below lo yields tb == 0.
-            t_ok = (toa >= np.float32(self._lo)) & (toa < np.float32(self._hi))
+            t_ok = (toa >= np.float32(proj.lo)) & (toa < np.float32(proj.hi))
             np.clip(tb, 0, self._n_toa - 1, out=tb)
         else:
             tb = np.searchsorted(self._edges, toa, side="right").astype(
@@ -393,8 +452,8 @@ class EventHistogrammer:
             ) - 1
             t_ok = (tb >= 0) & (tb < self._n_toa)
             np.clip(tb, 0, self._n_toa - 1, out=tb)
-        if self._lut_host is not None:
-            lut = self._lut_host[0]
+        if lut_host is not None:
+            lut = lut_host[0]
             p_ok = (pixel_id >= 0) & (pixel_id < lut.shape[0])
             screen = lut.take(pixel_id, mode="clip")
             ok = p_ok & t_ok & (screen >= 0)

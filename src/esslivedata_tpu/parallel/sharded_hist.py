@@ -3,20 +3,30 @@
 The multi-bank / long-axis scale-out path (BASELINE configs 3-4): screen
 rows (detector banks) are sharded over the mesh's ``bank`` axis so a
 histogram too large for one chip's HBM splits across chips, and the event
-stream is sharded over the ``data`` axis with a ``psum`` merging per-shard
-deltas over ICI. Monitor-normalized outputs use a second psum to form the
-global monitor total on every shard.
+stream is sharded over the ``data`` axis. Parity with the single-device
+``EventHistogrammer``: replica LUTs, per-pixel weights, decay, and the
+fold semantics (steps touch only the window; the cumulative total folds at
+publish rate).
 
-Communication pattern per step (all XLA collectives, no NCCL analog):
+Two exchange strategies merge the data shards (all XLA collectives over
+ICI, no NCCL analog):
 
-    events [E] --split 'data'--> local scatter into local bank rows
-    delta --psum('data')--> bank-replicated delta --add--> sharded state
-    monitor counts --psum('data')--> global monitor total (for ratios)
+- ``delta_psum``: every data shard scatters into its own dense copy of
+  its bank rows, then ``psum('data')`` merges. Per-step traffic is
+  O(rows_per_bank * n_toa) per device regardless of how sparse the batch
+  is — fine for small bin spaces (DREAM-size banks), ruinous at LOKI
+  scale (1.5M x 100 bins: ~150 MB per shard per step).
+- ``event_gather``: ``all_gather('data')`` the *event* shards instead —
+  every device then scatters the full batch into its own bank rows, and
+  the data-replicated window copies stay identical with no dense
+  reduction at all. Per-step traffic is O(n_events * (data-1)/data),
+  independent of bin-space size.
 
-Each bank shard sees the full event shard and drops events belonging to
-other banks' rows (gather-free routing). For heavily bank-imbalanced
-streams an all-to-all by destination bank would cut wasted work; measured
-flat for uniform streams, so deferred.
+``exchange='auto'`` picks event_gather once a bank shard exceeds 1M bins
+(the crossover is roughly where a dense delta outweighs a 4M-event
+gather). Events are also replicated across the ``bank`` axis by their
+P('data') sharding, so each bank shard routes gather-free: it scatters
+the events landing in its rows and drops the rest via the dump bin.
 """
 
 from __future__ import annotations
@@ -29,9 +39,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.histogram import HistogramState
+from ..ops.histogram import EventProjection, HistogramState
 
 __all__ = ["ShardedHistogrammer"]
+
+#: Bins per bank shard above which 'auto' switches the data-shard merge
+#: from a dense delta psum to an event all_gather.
+_EVENT_GATHER_BINS = 1 << 20
 
 
 class ShardedHistogrammer:
@@ -50,12 +64,13 @@ class ShardedHistogrammer:
         n_screen: int,
         mesh: Mesh,
         pixel_lut: np.ndarray | None = None,
+        pixel_weights: np.ndarray | None = None,
         decay: float | None = None,
+        exchange: str = "auto",
         dtype=jnp.float32,
     ) -> None:
-        toa_edges = np.asarray(toa_edges, dtype=np.float64)
-        if not np.all(np.diff(toa_edges) > 0):
-            raise ValueError("toa_edges must be strictly increasing")
+        if exchange not in ("auto", "delta_psum", "event_gather"):
+            raise ValueError(f"Unknown exchange {exchange!r}")
         self._mesh = mesh
         self._n_bank = mesh.shape["bank"]
         self._n_data = mesh.shape["data"]
@@ -63,25 +78,32 @@ class ShardedHistogrammer:
             raise ValueError(
                 f"n_screen={n_screen} must divide over bank axis {self._n_bank}"
             )
+        # One projection kernel shared with EventHistogrammer: identical
+        # TOA binning (incl. non-uniform edges), LUT/replica routing and
+        # weight semantics; only the row window differs per bank shard.
+        self._proj = EventProjection(
+            toa_edges=toa_edges,
+            pixel_lut=pixel_lut,
+            pixel_weights=pixel_weights,
+            n_screen=n_screen,
+        )
+        # LUT/weights replicated on every device: gathers stay local.
+        self._proj.place_constants(
+            lambda x: jax.device_put(x, NamedSharding(mesh, P()))
+        )
         self._rows_per_bank = n_screen // self._n_bank
         self._n_screen = n_screen
-        self._n_toa = toa_edges.size - 1
-        self._lo = float(toa_edges[0])
-        self._hi = float(toa_edges[-1])
-        self._inv_width = float(self._n_toa / (self._hi - self._lo))
-        self._edges = toa_edges
+        self._n_toa = self._proj.n_toa
+        self._edges = self._proj.edges
         self._decay = decay
         self._dtype = dtype
-        if pixel_lut is not None:
-            lut = np.asarray(pixel_lut, dtype=np.int32)
-            if lut.ndim != 1:
-                raise ValueError("sharded histogrammer supports 1-D pixel_lut")
-            # LUT replicated on every device: gather stays local.
-            self._lut = jax.device_put(
-                jnp.asarray(lut), NamedSharding(mesh, P())
+        if exchange == "auto":
+            exchange = (
+                "event_gather"
+                if self._rows_per_bank * self._n_toa > _EVENT_GATHER_BINS
+                else "delta_psum"
             )
-        else:
-            self._lut = None
+        self._exchange = exchange
 
         self._state_sharding = NamedSharding(mesh, P("bank", None))
         self._event_sharding = NamedSharding(mesh, P("data"))
@@ -94,8 +116,15 @@ class ShardedHistogrammer:
                 P("bank", None),  # window
                 P("data"),  # pixel_id
                 P("data"),  # toa
+                P(),  # inv_scale (replicated lazy-decay magnitude)
             ),
             out_specs=P("bank", None),
+            # event_gather keeps the window replicated over 'data' by
+            # construction (identical full-batch scatter on every copy
+            # after the all_gather); the static varying-mesh-axes check
+            # cannot infer that through the scatter, so only that mode
+            # disables it — delta_psum keeps the safety net.
+            check_vma=(self._exchange != "event_gather"),
         )
         self._step = jax.jit(shard(self._step_local), donate_argnums=(0,))
 
@@ -108,37 +137,65 @@ class ShardedHistogrammer:
         self._normalize = jax.jit(norm(self._normalize_local))
         # Fold semantics as in EventHistogrammer: steps touch only the
         # window; the cumulative total is folded at publish rate.
+        def _physical(win, scale):
+            return win if scale is None else win * scale
+
         self._clear_window = jax.jit(
-            lambda cum, win: (cum + win, jnp.zeros_like(win)),
+            lambda cum, win, scale: (
+                cum + _physical(win, scale),
+                jnp.zeros_like(win),
+            ),
             donate_argnums=(0, 1),
         )
-        self._cum_view = jax.jit(lambda cum, win: cum + win)
+        self._views = jax.jit(
+            lambda cum, win, scale: (
+                cum + _physical(win, scale),
+                _physical(win, scale),
+            )
+        )
 
     # -- local (per-shard) kernels ---------------------------------------
-    def _step_local(self, win, pixel_id, toa):
+    def _step_local(self, win, pixel_id, toa, inv_scale):
+        """One shard's step. ``inv_scale`` is the lazy-decay update
+        magnitude (1.0 without decay): the dense ``win * decay`` multiply
+        the naive formulation would pay per step is folded into the
+        scatter updates instead, exactly as in EventHistogrammer."""
         bank = jax.lax.axis_index("bank")
         row0 = bank * self._rows_per_bank
-        tb = jnp.floor((toa - self._lo) * self._inv_width).astype(jnp.int32)
-        t_ok = (toa >= self._lo) & (toa < self._hi)
-        tb = jnp.clip(tb, 0, self._n_toa - 1)
-        if self._lut is not None:
-            n_pix = self._lut.shape[0]
-            p_ok = (pixel_id >= 0) & (pixel_id < n_pix)
-            screen = self._lut[jnp.clip(pixel_id, 0, n_pix - 1)]
-            p_ok &= screen >= 0
-        else:
-            screen = pixel_id
-            p_ok = (pixel_id >= 0) & (pixel_id < self._n_screen)
-        local_row = screen - row0
-        ok = p_ok & t_ok & (local_row >= 0) & (local_row < self._rows_per_bank)
         n_local = self._rows_per_bank * self._n_toa
-        flat = jnp.where(ok, local_row * self._n_toa + tb, n_local)
-        delta = jnp.zeros((n_local,), dtype=self._dtype)
-        delta = delta.at[flat].add(1.0, mode="drop")
+
+        if self._exchange == "event_gather":
+            # Merge data shards by gathering the (small) event arrays;
+            # every data-replicated window copy then applies the identical
+            # full-batch scatter — no dense reduction. The dump index
+            # (n_local) is out of bounds of the window and dropped.
+            pixel_id = jax.lax.all_gather(
+                pixel_id, "data", axis=0, tiled=True
+            )
+            toa = jax.lax.all_gather(toa, "data", axis=0, tiled=True)
+            flat, w = self._proj.flat_and_weights(
+                pixel_id, toa, row0=row0, n_rows=self._rows_per_bank
+            )
+            updates = (
+                inv_scale if w is None else w.astype(self._dtype) * inv_scale
+            )
+            return (
+                win.reshape(-1)
+                .at[flat]
+                .add(updates, mode="drop")
+                .reshape(win.shape)
+            )
+
+        # delta_psum: scatter into a fresh local delta, merge over 'data'.
+        flat, w = self._proj.flat_and_weights(
+            pixel_id, toa, row0=row0, n_rows=self._rows_per_bank
+        )
+        updates = inv_scale if w is None else w.astype(self._dtype) * inv_scale
+        delta = jnp.zeros((n_local + 1,), dtype=self._dtype)
+        delta = delta.at[flat].add(updates, mode="drop")[:n_local]
         delta = delta.reshape(self._rows_per_bank, self._n_toa)
-        # Merge event shards: every data-shard scattered into its own copy.
         delta = jax.lax.psum(delta, "data")
-        return win * self._decay + delta if self._decay is not None else win + delta
+        return win + delta
 
     def _normalize_local(self, hist, monitor_counts):
         # monitor_counts: per-event-shard scalar counts; global total via psum.
@@ -151,6 +208,10 @@ class ShardedHistogrammer:
         return self._mesh
 
     @property
+    def exchange(self) -> str:
+        return self._exchange
+
+    @property
     def shape(self) -> tuple[int, int]:
         return (self._n_screen, self._n_toa)
 
@@ -159,7 +220,16 @@ class ShardedHistogrammer:
             jnp.zeros((self._n_screen, self._n_toa), dtype=self._dtype),
             self._state_sharding,
         )
-        return HistogramState(folded=zeros, window=jnp.array(zeros))
+        scale = (
+            jax.device_put(
+                jnp.ones((), dtype=self._dtype), self._scalar_sharding
+            )
+            if self._decay is not None
+            else None
+        )
+        return HistogramState(
+            folded=zeros, window=jnp.array(zeros), scale=scale
+        )
 
     def _shard_events(self, pixel_id, toa):
         n = pixel_id.shape[0]
@@ -178,12 +248,36 @@ class ShardedHistogrammer:
     def step(self, state: HistogramState, pixel_id, toa) -> HistogramState:
         """Accumulate one padded global batch (host or device arrays)."""
         pid, t = self._shard_events(pixel_id, toa)
-        win = self._step(state.window, pid, t)
-        return HistogramState(folded=state.folded, window=win)
+        if self._decay is None:
+            inv = jnp.asarray(1.0, self._dtype)
+            win = self._step(state.window, pid, t, inv)
+            return HistogramState(folded=state.folded, window=win)
+        scale = state.scale * self._decay
+        win = self._step(state.window, pid, t, 1.0 / scale)
+        win, scale = self._advance_scale_applied(win, scale)
+        return HistogramState(folded=state.folded, window=win, scale=scale)
+
+    def _advance_scale_applied(self, window, scale):
+        # _advance_scale multiplies decay again; here scale is already
+        # advanced, so only the renormalization cond applies.
+        from ..ops.histogram import EventHistogrammer as _EH
+
+        return jax.lax.cond(
+            scale < _EH._SCALE_FLOOR,
+            lambda w, sc: (w * sc, jnp.ones_like(sc)),
+            lambda w, sc: (w, sc),
+            window,
+            scale,
+        )
 
     def clear_window(self, state: HistogramState) -> HistogramState:
-        cum, win = self._clear_window(state.folded, state.window)
-        return HistogramState(folded=cum, window=win)
+        cum, win = self._clear_window(
+            state.folded, state.window, state.scale
+        )
+        scale = (
+            None if state.scale is None else jnp.ones_like(state.scale)
+        )
+        return HistogramState(folded=cum, window=win, scale=scale)
 
     def normalized(self, hist: jax.Array, monitor_counts) -> jax.Array:
         """hist / global monitor total — the monitor-normalized I(Q)-style
@@ -195,9 +289,9 @@ class ShardedHistogrammer:
 
     def read(self, state: HistogramState) -> tuple[np.ndarray, np.ndarray]:
         """Host copies of the (cumulative, window) views — same contract as
-        ``EventHistogrammer.read``."""
+        ``EventHistogrammer.read`` (applies the lazy decay scale)."""
         cum, win = jax.device_get(
-            (self._cum_view(state.folded, state.window), state.window)
+            self._views(state.folded, state.window, state.scale)
         )
         return np.asarray(cum), np.asarray(win)
 

@@ -121,3 +121,131 @@ def test_state_sharding_is_bank_distributed(devices):
     shards = st.folded.addressable_shards
     assert len(shards) == 8
     assert shards[0].data.shape == (2, 2)  # 16 rows / 8 banks
+
+
+def test_exchange_modes_equivalent(devices):
+    edges = np.linspace(0.0, 71_000_000.0, 21)
+    n_screen = 32
+    pid, toa = make_events(8192, n_screen, seed=3)
+    results = {}
+    for exchange in ("delta_psum", "event_gather"):
+        mesh = make_mesh(8, data=2, bank=4)
+        sharded = ShardedHistogrammer(
+            toa_edges=edges, n_screen=n_screen, mesh=mesh, exchange=exchange
+        )
+        st = sharded.init_state()
+        st = sharded.step(st, pid, toa)
+        st = sharded.step(st, pid, toa)
+        results[exchange] = sharded.read(st)[1]
+    np.testing.assert_allclose(
+        results["delta_psum"], results["event_gather"], rtol=1e-6
+    )
+
+
+def test_auto_exchange_picks_event_gather_for_large_bins(devices):
+    edges = np.linspace(0.0, 71_000_000.0, 101)
+    mesh = make_mesh(8, bank=8)
+    big = ShardedHistogrammer(
+        # 160k rows / 8 banks * 100 bins = 2M bins per shard > 1M threshold
+        toa_edges=edges, n_screen=2_000_000 // 100 * 8, mesh=mesh
+    )
+    assert big.exchange == "event_gather"
+    small = ShardedHistogrammer(
+        toa_edges=np.linspace(0.0, 71e6, 11), n_screen=64, mesh=mesh
+    )
+    assert small.exchange == "delta_psum"
+
+
+def test_sharded_replicas_and_weights_match_single(devices):
+    edges = np.linspace(0.0, 1000.0, 6)
+    n_pixel, n_screen = 64, 16
+    rng = np.random.default_rng(5)
+    lut = rng.integers(-1, n_screen, (3, n_pixel)).astype(np.int32)  # 3 replicas
+    weights = rng.uniform(0.5, 2.0, n_pixel).astype(np.float32)
+    pid, toa = make_events(4096, n_pixel, seed=6)
+    toa = (toa % 1000.0).astype(np.float32)
+
+    single = EventHistogrammer(
+        toa_edges=edges, n_screen=n_screen, pixel_lut=lut, pixel_weights=weights
+    )
+    st1 = single.step(single.init_state(), EventBatch.from_arrays(pid, toa))
+
+    for exchange in ("delta_psum", "event_gather"):
+        mesh = make_mesh(8, data=2, bank=4)
+        sharded = ShardedHistogrammer(
+            toa_edges=edges,
+            n_screen=n_screen,
+            mesh=mesh,
+            pixel_lut=lut,
+            pixel_weights=weights,
+            exchange=exchange,
+        )
+        st2 = sharded.init_state()
+        b = EventBatch.from_arrays(pid, toa)
+        st2 = sharded.step(st2, b.pixel_id, b.toa)
+        np.testing.assert_allclose(
+            sharded.read(st2)[1],
+            single.read(st1)[1],
+            rtol=1e-5,
+            err_msg=exchange,
+        )
+
+
+def test_event_gather_decay(devices):
+    edges = np.linspace(0.0, 10.0, 2)
+    mesh = make_mesh(8, data=4, bank=2)
+    sharded = ShardedHistogrammer(
+        toa_edges=edges,
+        n_screen=2,
+        mesh=mesh,
+        decay=0.5,
+        exchange="event_gather",
+    )
+    st = sharded.init_state()
+    pid = np.zeros(4096, dtype=np.int32)
+    pid[4:] = -1
+    toa = np.full(4096, 5.0, dtype=np.float32)
+    st = sharded.step(st, pid, toa)
+    st = sharded.step(st, pid, toa)
+    cum, win = sharded.read(st)
+    assert win[0, 0] == pytest.approx(6.0)  # 4*0.5 + 4
+
+
+def test_sharded_nonuniform_edges_match_single(devices):
+    edges = np.array([0.0, 1.0e6, 1.0e7, 3.0e7, 7.1e7])
+    n_screen = 16
+    pid, toa = make_events(4096, n_screen, seed=9)
+    single = EventHistogrammer(toa_edges=edges, n_screen=n_screen)
+    st1 = single.step(single.init_state(), EventBatch.from_arrays(pid, toa))
+    for exchange in ("delta_psum", "event_gather"):
+        mesh = make_mesh(8, data=2, bank=4)
+        sharded = ShardedHistogrammer(
+            toa_edges=edges, n_screen=n_screen, mesh=mesh, exchange=exchange
+        )
+        st2 = sharded.init_state()
+        b = EventBatch.from_arrays(pid, toa)
+        st2 = sharded.step(st2, b.pixel_id, b.toa)
+        np.testing.assert_allclose(
+            sharded.read(st2)[1], single.read(st1)[1], rtol=1e-6,
+            err_msg=exchange,
+        )
+
+
+def test_sharded_lazy_decay_long_run(devices):
+    # Crosses the renormalization threshold (0.5**40 < 1e-12), matching
+    # the single-device lazy-decay semantics.
+    edges = np.linspace(0.0, 10.0, 2)
+    mesh = make_mesh(4, data=2, bank=2)
+    sharded = ShardedHistogrammer(
+        toa_edges=edges, n_screen=2, mesh=mesh, decay=0.5
+    )
+    st = sharded.init_state()
+    pid = np.zeros(4096, dtype=np.int32)
+    pid[4:] = -1
+    toa = np.full(4096, 5.0, dtype=np.float32)
+    expected = 0.0
+    for _ in range(60):
+        st = sharded.step(st, pid, toa)
+        expected = expected * 0.5 + 4.0
+    cum, win = sharded.read(st)
+    assert win[0, 0] == pytest.approx(expected, rel=1e-5)
