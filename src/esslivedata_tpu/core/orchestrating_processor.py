@@ -300,13 +300,27 @@ class OrchestratingProcessor:
         )
 
     def _publish_status(self, state: str = "running") -> None:
+        status = self._service_status(state)
+        now = Timestamp.now()
+        # One service heartbeat plus one per-job heartbeat: NICOS monitors
+        # individual jobs by their source:job_number identity while the
+        # dashboard consumes the aggregated service document. On shutdown
+        # the per-job heartbeats must report STOPPED — a NICOS cache keyed
+        # on the job identity would otherwise latch the last live code
+        # (green) for jobs of a dead service.
+        jobs = status.jobs
+        if state in ("stopping", "stopped"):
+            from .job import JobState
+
+            jobs = [
+                job.model_copy(update={"state": JobState.STOPPED})
+                for job in jobs
+            ]
         self._sink.publish_messages(
-            [
-                Message(
-                    timestamp=Timestamp.now(),
-                    stream=STATUS_STREAM,
-                    value=self._service_status(state),
-                )
+            [Message(timestamp=now, stream=STATUS_STREAM, value=status)]
+            + [
+                Message(timestamp=now, stream=STATUS_STREAM, value=job)
+                for job in jobs
             ]
         )
 

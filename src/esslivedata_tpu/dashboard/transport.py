@@ -95,11 +95,14 @@ def decode_backend_message(
             data=da00_to_dataarray(da00.variables, name=key.output_name),
         )
     if topic_kind == "status":
-        status = wire.decode_x5f2(value)
-        return StatusMessage(
-            service_id=status.service_id,
-            status=ServiceStatus.model_validate_json(status.status_json),
-        )
+        from ..kafka.nicos_status import decode_status
+
+        _code, parsed, service_id = decode_status(value)
+        if not isinstance(parsed, ServiceStatus):
+            # Per-job heartbeats address NICOS consumers; the dashboard's
+            # job view comes from the aggregated service document.
+            return None
+        return StatusMessage(service_id=service_id, status=parsed)
     if topic_kind == "responses":
         return AckMessage(payload=json.loads(value.decode("utf-8")))
     if topic_kind == "nicos":

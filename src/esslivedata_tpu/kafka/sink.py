@@ -117,17 +117,42 @@ class DefaultSerializer:
                 key=name.encode(),
             )
         if kind == StreamKind.LIVEDATA_STATUS and isinstance(value, BaseModel):
-            status = wire.X5f2Status(
-                software_name="esslivedata-tpu",
-                software_version="0.1.0",
-                service_id=self._service_id,
-                host_name=socket.gethostname(),
-                process_id=os.getpid(),
-                update_interval_ms=2000,
-                status_json=value.model_dump_json(),
+            # NICOS wire contract (kafka/nicos_status.py): service and
+            # per-job heartbeats carry a NICOS status code + typed payload
+            # in status_json, addressed by the NICOS identity conventions.
+            from ..core.job import JobStatus, ServiceStatus
+            from .nicos_status import (
+                job_status_to_x5f2,
+                service_status_to_x5f2,
             )
+
+            if isinstance(value, ServiceStatus):
+                payload = service_status_to_x5f2(
+                    value,
+                    worker=self._service_id,
+                    host_name=socket.gethostname(),
+                    process_id=os.getpid(),
+                )
+            elif isinstance(value, JobStatus):
+                payload = job_status_to_x5f2(
+                    value,
+                    host_name=socket.gethostname(),
+                    process_id=os.getpid(),
+                )
+            else:
+                payload = wire.encode_x5f2(
+                    wire.X5f2Status(
+                        software_name="esslivedata-tpu",
+                        software_version="0.1.0",
+                        service_id=self._service_id,
+                        host_name=socket.gethostname(),
+                        process_id=os.getpid(),
+                        update_interval_ms=2000,
+                        status_json=value.model_dump_json(),
+                    )
+                )
             return SerializedMessage(
-                topic=self._topics.status, value=wire.encode_x5f2(status)
+                topic=self._topics.status, value=payload
             )
         if kind == StreamKind.LIVEDATA_RESPONSES:
             payload = (

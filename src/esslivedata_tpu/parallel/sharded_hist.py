@@ -126,7 +126,28 @@ class ShardedHistogrammer:
             # disables it — delta_psum keeps the safety net.
             check_vma=(self._exchange != "event_gather"),
         )
-        self._step = jax.jit(shard(self._step_local), donate_argnums=(0,))
+        sharded_step = shard(self._step_local)
+        self._step = jax.jit(sharded_step, donate_argnums=(0,))
+
+        if decay is not None:
+            from ..ops.histogram import EventHistogrammer as _EH
+
+            def _step_decay(win, pid, toa, scale):
+                # Lazy decay fused into the one jitted program (the
+                # single-device kernel does the same inside _advance):
+                # scale shrinks, updates grow by 1/scale, renormalize on
+                # underflow — no per-batch eager dispatches.
+                scale = scale * decay
+                win = sharded_step(win, pid, toa, 1.0 / scale)
+                return jax.lax.cond(
+                    scale < _EH._SCALE_FLOOR,
+                    lambda w, sc: (w * sc, jnp.ones_like(sc)),
+                    lambda w, sc: (w, sc),
+                    win,
+                    scale,
+                )
+
+            self._step_decay = jax.jit(_step_decay, donate_argnums=(0,))
 
         norm = partial(
             jax.shard_map,
@@ -249,26 +270,12 @@ class ShardedHistogrammer:
         """Accumulate one padded global batch (host or device arrays)."""
         pid, t = self._shard_events(pixel_id, toa)
         if self._decay is None:
-            inv = jnp.asarray(1.0, self._dtype)
-            win = self._step(state.window, pid, t, inv)
+            win = self._step(
+                state.window, pid, t, jnp.asarray(1.0, self._dtype)
+            )
             return HistogramState(folded=state.folded, window=win)
-        scale = state.scale * self._decay
-        win = self._step(state.window, pid, t, 1.0 / scale)
-        win, scale = self._advance_scale_applied(win, scale)
+        win, scale = self._step_decay(state.window, pid, t, state.scale)
         return HistogramState(folded=state.folded, window=win, scale=scale)
-
-    def _advance_scale_applied(self, window, scale):
-        # _advance_scale multiplies decay again; here scale is already
-        # advanced, so only the renormalization cond applies.
-        from ..ops.histogram import EventHistogrammer as _EH
-
-        return jax.lax.cond(
-            scale < _EH._SCALE_FLOOR,
-            lambda w, sc: (w * sc, jnp.ones_like(sc)),
-            lambda w, sc: (w, sc),
-            window,
-            scale,
-        )
 
     def clear_window(self, state: HistogramState) -> HistogramState:
         cum, win = self._clear_window(

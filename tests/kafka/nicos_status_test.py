@@ -1,0 +1,171 @@
+"""NICOS x5f2 status contract: codes, identities, envelopes, round trips,
+legacy fallback — the wire form a NICOS consumer accepts."""
+
+import json
+import uuid
+
+import pytest
+
+from esslivedata_tpu.core.job import JobState, JobStatus, ServiceStatus
+from esslivedata_tpu.kafka import wire
+from esslivedata_tpu.kafka.nicos_status import (
+    JobIdentity,
+    NicosStatus,
+    ServiceIdentity,
+    decode_status,
+    job_state_code,
+    job_status_to_x5f2,
+    service_state_code,
+    service_status_to_x5f2,
+    worst_status,
+)
+
+
+class TestCodes:
+    def test_every_job_state_maps(self):
+        for state in JobState:
+            assert job_state_code(state) in NicosStatus
+
+    @pytest.mark.parametrize(
+        "state,code",
+        [
+            (JobState.ACTIVE, NicosStatus.OK),
+            (JobState.FINISHING, NicosStatus.OK),
+            (JobState.SCHEDULED, NicosStatus.BUSY),
+            (JobState.PENDING_CONTEXT, NicosStatus.WARNING),
+            (JobState.WARNING, NicosStatus.WARNING),
+            (JobState.ERROR, NicosStatus.ERROR),
+            (JobState.STOPPED, NicosStatus.DISABLED),
+        ],
+    )
+    def test_job_state_codes(self, state, code):
+        assert job_state_code(state) == code
+
+    def test_service_state_codes(self):
+        assert service_state_code("running") == NicosStatus.OK
+        assert service_state_code("stopped") == NicosStatus.DISABLED
+        assert service_state_code("???") == NicosStatus.UNKNOWN
+
+    def test_worst_status_severity_order(self):
+        assert worst_status([]) == NicosStatus.OK
+        assert (
+            worst_status([NicosStatus.OK, NicosStatus.BUSY]) == NicosStatus.BUSY
+        )
+        assert (
+            worst_status([NicosStatus.WARNING, NicosStatus.DISABLED])
+            == NicosStatus.WARNING
+        )
+        assert (
+            worst_status([NicosStatus.ERROR, NicosStatus.UNKNOWN])
+            == NicosStatus.UNKNOWN
+        )
+
+
+class TestIdentities:
+    def test_service_identity_round_trip(self):
+        sid = ServiceIdentity(
+            instrument="loki", service_name="detector_data", worker="w1"
+        )
+        assert ServiceIdentity.parse(sid.render()) == sid
+
+    def test_job_identity_round_trip_with_colons_in_source(self):
+        jid = JobIdentity(
+            source_name="LOKI:Det:bank0", job_number=uuid.uuid4()
+        )
+        assert JobIdentity.parse(jid.render()) == jid
+
+    def test_malformed_identities_raise(self):
+        with pytest.raises(ValueError):
+            ServiceIdentity.parse("loki")
+        with pytest.raises(ValueError):
+            JobIdentity.parse("no-colon")
+
+
+def make_service_status(**kw):
+    defaults = dict(
+        service_name="detector_data",
+        instrument="loki",
+        state="running",
+        jobs=[],
+        uptime_s=12.0,
+    )
+    defaults.update(kw)
+    return ServiceStatus(**defaults)
+
+
+def make_job(state=JobState.ACTIVE, message=""):
+    return JobStatus(
+        source_name="larmor_detector",
+        job_number=uuid.uuid4(),
+        workflow_id="loki/detector_view/rear_view/v1",
+        state=state,
+        message=message,
+    )
+
+
+class TestEnvelopes:
+    def test_service_round_trip(self):
+        status = make_service_status(jobs=[make_job()])
+        payload = service_status_to_x5f2(status, worker="w7")
+        code, parsed, service_id = decode_status(payload)
+        assert code == NicosStatus.OK
+        assert parsed == status
+        assert service_id == "loki:detector_data:w7"
+
+    def test_service_code_aggregates_worst_job(self):
+        status = make_service_status(
+            jobs=[make_job(), make_job(JobState.ERROR, "boom")]
+        )
+        code, _, _ = decode_status(service_status_to_x5f2(status))
+        assert code == NicosStatus.ERROR
+
+    def test_job_round_trip(self):
+        job = make_job(JobState.WARNING, "late context")
+        payload = job_status_to_x5f2(job)
+        code, parsed, service_id = decode_status(payload)
+        assert code == NicosStatus.WARNING
+        assert parsed == job
+        assert service_id == f"larmor_detector:{job.job_number}"
+
+    def test_status_json_is_nicos_shaped(self):
+        # A NICOS consumer reads status_json["status"] as the numeric
+        # daemon code without knowing our payload models.
+        payload = service_status_to_x5f2(make_service_status())
+        doc = json.loads(wire.decode_x5f2(payload).status_json)
+        assert doc["status"] == 200
+        assert doc["message"]["message_type"] == "service"
+
+    def test_legacy_bare_service_status_accepted(self):
+        status = make_service_status(jobs=[make_job(JobState.ERROR)])
+        legacy = wire.encode_x5f2(
+            wire.X5f2Status(
+                software_name="esslivedata-tpu",
+                software_version="0.0.1",
+                service_id="legacy",
+                host_name="",
+                process_id=0,
+                update_interval_ms=2000,
+                status_json=status.model_dump_json(),
+            )
+        )
+        code, parsed, service_id = decode_status(legacy)
+        assert parsed == status
+        assert code == NicosStatus.ERROR  # derived from the worst job
+        assert service_id == "legacy"
+
+    def test_unknown_message_type_raises(self):
+        bad = wire.encode_x5f2(
+            wire.X5f2Status(
+                software_name="x",
+                software_version="0",
+                service_id="s",
+                host_name="",
+                process_id=0,
+                update_interval_ms=0,
+                status_json=json.dumps(
+                    {"status": 200, "message": {"message_type": "gizmo"}}
+                ),
+            )
+        )
+        with pytest.raises(ValueError, match="message_type"):
+            decode_status(bad)

@@ -89,8 +89,22 @@ class TestDetectorServiceEndToEnd:
             m for m in producer.messages if m.topic == "dummy_livedata_status"
         ]
         assert statuses
-        status_json = json.loads(wire.decode_x5f2(statuses[-1].value).status_json)
-        assert status_json["jobs"][0]["state"] in ("active", "scheduled")
+        from esslivedata_tpu.core.job import ServiceStatus
+        from esslivedata_tpu.kafka.nicos_status import decode_status
+
+        parsed = [decode_status(m.value) for m in statuses]
+        service_docs = [p for _, p, _ in parsed if isinstance(p, ServiceStatus)]
+        assert service_docs
+        assert service_docs[-1].jobs[0].state in ("active", "scheduled")
+        # Per-job NICOS heartbeats ride the same topic, addressed by
+        # source:job_number.
+        job_docs = [
+            (code, p, sid)
+            for code, p, sid in parsed
+            if not isinstance(p, ServiceStatus)
+        ]
+        assert job_docs
+        assert job_docs[-1][2].startswith("panel_0:")
 
         # da00 results: image counts must equal generated events
         data = [m for m in producer.messages if m.topic == "dummy_livedata_data"]
@@ -301,3 +315,39 @@ class TestRoiRoundTrip:
             if m.topic == "dummy_livedata_responses"
         ]
         assert any(a["status"] == "ack" for a in acks)
+
+
+class TestFinalStatusForNicos:
+    def test_finalize_publishes_stopped_job_heartbeats(self):
+        from esslivedata_tpu.core.job import ServiceStatus
+        from esslivedata_tpu.kafka.nicos_status import (
+            NicosStatus,
+            decode_status,
+        )
+
+        det = INSTRUMENT.detectors["panel_0"]
+        stream = FakeDetectorStream(
+            topic="dummy_detector",
+            source_name="panel_a",
+            detector_ids=det.detector_number,
+            events_per_pulse=100,
+        )
+        service, raw, producer = make_detector_service([stream])
+        raw.inject(start_command(DETECTOR_VIEW_HANDLE.workflow_id, "panel_0"))
+        for _ in range(3):
+            service.step()
+        n_before = len(producer.messages)
+        service._processor.finalize()
+        final = [
+            decode_status(m.value)
+            for m in producer.messages[n_before:]
+            if m.topic == "dummy_livedata_status"
+        ]
+        job_docs = [
+            (code, p) for code, p, _ in final if not isinstance(p, ServiceStatus)
+        ]
+        # A NICOS cache keyed on the job identity must see the job leave
+        # the green state when its service shuts down.
+        assert job_docs
+        assert all(code == NicosStatus.DISABLED for code, _ in job_docs)
+        assert all(p.state == "stopped" for _, p in job_docs)
