@@ -336,12 +336,23 @@ def run_benchmark(args, platform: str) -> dict:
         state = hist.step_flat(state, hist.flatten_host(b.pixel_id, b.toa))
     state.window.block_until_ready()
 
-    start = time.perf_counter()
-    for i in range(args.batches):
-        b = batches[i % n_distinct]
-        state = hist.step_flat(state, hist.flatten_host(b.pixel_id, b.toa))
-    state.window.block_until_ready()
-    dt = time.perf_counter() - start
+    from contextlib import nullcontext
+
+    if args.profile:
+        from esslivedata_tpu.utils.profiling import device_trace
+
+        trace = device_trace(args.profile)
+    else:
+        trace = nullcontext()
+    with trace:
+        start = time.perf_counter()
+        for i in range(args.batches):
+            b = batches[i % n_distinct]
+            state = hist.step_flat(
+                state, hist.flatten_host(b.pixel_id, b.toa)
+            )
+        state.window.block_until_ready()
+        dt = time.perf_counter() - start
     ev_per_s = args.events * args.batches / dt
 
     total = float(hist.read(state)[0].sum())
@@ -469,6 +480,12 @@ def _parse_args():
         "stdout stays the single headline JSON line)",
     )
     parser.add_argument("--verbose", action="store_true")
+    parser.add_argument(
+        "--profile",
+        default=None,
+        metavar="DIR",
+        help="write a JAX device trace of the timed headline loop to DIR",
+    )
     parser.add_argument(
         "--attempt-timeout",
         type=float,

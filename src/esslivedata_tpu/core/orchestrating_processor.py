@@ -331,6 +331,13 @@ class OrchestratingProcessor:
             "stream_counts": dict(self._preprocessor.message_counts),
             "lag_level": self.last_lag_report.worst_level,
         }
+        try:
+            from ..utils.profiling import device_memory_stats
+
+            if memory := device_memory_stats():
+                extra["device_memory"] = memory
+        except Exception:  # pragma: no cover - backend without stats
+            pass
         if self._stream_counter is not None:
             # Adapter-layer per-(topic,source) counts + producer lag,
             # accumulated since the last rollover (kafka/stream_counter.py).

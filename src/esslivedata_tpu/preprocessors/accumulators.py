@@ -3,10 +3,14 @@
 Parity with reference ``preprocessors/accumulators.py``: ``Cumulative``
 (+= with restart on structural mismatch, reference :238-261),
 ``LatestValueAccumulator`` (context, :57), ``NullAccumulator`` (:46).
-The reference's NoCopyAccumulator exists to avoid deepcopying a 500 MB
-histogram on every read (:96-97); here large histograms are device state
-inside the kernel and are never copied, so ``Cumulative`` defaults to
-no-copy reads with the same reset-on-structure-change semantics.
+The reference's NoCopyAccumulator and its paired window/cumulative
+variant exist to avoid deepcopying a 500 MB histogram on every read
+(:96-97). That problem does not arise here *by construction*: large
+histograms are device-resident kernel state with fold semantics
+(ops/histogram.py — window and cumulative share one scatter, reads are
+device views), and host-side accumulators only ever hold the small dense
+outputs. ``Cumulative`` therefore defaults to no-copy reads and there is
+deliberately no pair API to keep aliasing-safe.
 """
 
 from __future__ import annotations
@@ -78,6 +82,12 @@ class Cumulative:
     the accumulation to the new value instead of erroring, matching the
     reference's restart-on-mismatch behavior (accumulators.py:238-261).
 
+    This subsumes the reference's ``reset_coord`` knob
+    (NoCopyAccumulator:114-127): geometry is carried as coordinates
+    (monitor position, detector transform), and ``same_structure`` compares
+    coordinate *values* — so accumulation already restarts when the
+    geometry moves, without naming the coord up front.
+
     ``clear_on_get`` gives window semantics (value since last read);
     otherwise since-start. Reads are no-copy by default: callers must not
     mutate the returned array (copy_on_get=True for defensive copies).
@@ -85,7 +95,9 @@ class Cumulative:
 
     is_context: ClassVar[bool] = False
 
-    def __init__(self, *, clear_on_get: bool = False, copy_on_get: bool = False) -> None:
+    def __init__(
+        self, *, clear_on_get: bool = False, copy_on_get: bool = False
+    ) -> None:
         self._clear_on_get = clear_on_get
         self._copy_on_get = copy_on_get
         self._value: DataArray | None = None
@@ -94,7 +106,8 @@ class Cumulative:
         if self._value is not None and self._value.same_structure(data):
             self._value += data
         else:
-            # restart: first value, or structure changed upstream
+            # restart: first value, or structure changed upstream (incl.
+            # geometry coords — see class docstring)
             self._value = data.copy()
 
     @property
@@ -116,3 +129,4 @@ class Cumulative:
 
     def release_buffers(self) -> None:
         pass
+

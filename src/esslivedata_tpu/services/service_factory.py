@@ -165,6 +165,16 @@ class DataServiceRunner:
             help="override the broker from the kafka config namespace",
         )
         parser.add_argument(
+            "--profile",
+            default=None,
+            metavar="DIR",
+            help="capture a JAX device trace of the first "
+            "--profile-seconds into DIR (TensorBoard/Perfetto readable)",
+        )
+        parser.add_argument(
+            "--profile-seconds", type=float, default=30.0
+        )
+        parser.add_argument(
             "--broker-dir",
             default=None,
             help="use the file-backed broker rooted at this directory "
@@ -244,5 +254,9 @@ class DataServiceRunner:
         # resumes at live data (kafka/consumer.py, reference consumer.py:31).
         assign_all_partitions(consumer, builder.topics)
         service = builder.from_consumer(consumer, producer)
+        if args.profile:
+            from ..utils.profiling import bounded_device_trace
+
+            bounded_device_trace(args.profile, args.profile_seconds)
         service.start(blocking=True)
         return service.exit_code

@@ -15,10 +15,11 @@ from __future__ import annotations
 
 import threading
 import time
+import logging
 from collections import defaultdict
 from contextlib import contextmanager
 
-__all__ = ["StageTimer", "device_trace"]
+__all__ = ["StageTimer", "bounded_device_trace", "device_memory_stats", "device_trace"]
 
 
 @contextmanager
@@ -84,3 +85,48 @@ class StageTimer:
             self._count.clear()
             self._max_s.clear()
             return out
+
+
+def bounded_device_trace(log_dir: str, seconds: float) -> None:
+    """Capture a wall-clock-bounded device trace without blocking the
+    caller: starts the JAX profiler now and schedules the stop on a timer
+    thread. For long-running services (--profile): an unbounded trace
+    would grow without limit, so the capture window is explicit. The stop
+    also runs at interpreter exit — a service stopped before the window
+    elapses must still flush the trace, not lose it."""
+    import atexit
+
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    stopped = threading.Event()
+
+    def _stop() -> None:
+        if stopped.is_set():
+            return
+        stopped.set()
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # pragma: no cover - profiler teardown races
+            logging.getLogger(__name__).exception("stop_trace failed")
+
+    atexit.register(_stop)
+    timer = threading.Timer(seconds, _stop)
+    timer.daemon = True
+    timer.start()
+
+
+def device_memory_stats() -> dict[str, int]:
+    """Per-device HBM statistics for the metrics log (SURVEY §5: device
+    memory in the 30 s rollover). Backends without memory_stats (CPU)
+    yield an empty dict."""
+    import jax
+
+    out: dict[str, int] = {}
+    for device in jax.local_devices():
+        stats = device.memory_stats() or {}
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if key in stats:
+                out[f"{device.id}:{key}"] = int(stats[key])
+    return out
+

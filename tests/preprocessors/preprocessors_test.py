@@ -150,3 +150,26 @@ def test_null_accumulator():
     acc = NullAccumulator()
     acc.add(T0, object())
     assert acc.get() is None
+
+
+class TestGeometryChangeRestart:
+    """A moved geometry (coordinate value change) restarts accumulation —
+    the structural check covers what the reference's reset_coord knob does
+    explicitly, so no knob is needed."""
+
+    def _da(self, values, pos):
+        return DataArray(
+            Variable(np.asarray(values, dtype=np.float64), ("x",), "counts"),
+            coords={"position": Variable(np.asarray(pos), (), "m")},
+        )
+
+    def test_coordinate_value_change_restarts(self):
+        from esslivedata_tpu.preprocessors.accumulators import Cumulative
+
+        acc = Cumulative()
+        acc.add(Timestamp.from_ns(0), self._da([1.0, 2.0], 1.0))
+        acc.add(Timestamp.from_ns(1), self._da([1.0, 2.0], 1.0))
+        np.testing.assert_allclose(acc.get().values, [2.0, 4.0])
+        acc.add(Timestamp.from_ns(2), self._da([5.0, 5.0], 2.0))
+        np.testing.assert_allclose(acc.get().values, [5.0, 5.0])
+
