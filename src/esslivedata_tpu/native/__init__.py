@@ -68,6 +68,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     i64, vp = ctypes.c_int64, ctypes.c_void_p
     u8p = ctypes.POINTER(ctypes.c_uint8)
     lib.ld_staging_new.restype = vp
+    f32 = ctypes.c_float
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.ld_flatten.restype = None
+    lib.ld_flatten.argtypes = [
+        i32p, f32p, i64, i32p, i64,
+        ctypes.c_int32, ctypes.c_int32, f32, f32, f32, ctypes.c_int32, i32p,
+    ]
     lib.ld_staging_new.argtypes = [i64]
     lib.ld_staging_free.restype = None
     lib.ld_staging_free.argtypes = [vp]
@@ -114,12 +122,23 @@ def load_library() -> ctypes.CDLL | None:
             return _lib
         if _load_failed:
             return None
-        if not _LIB.exists() and not _compile():
+        # A cached .so older than the source misses newly added symbols
+        # (binding would raise AttributeError): rebuild it.
+        stale = (
+            _LIB.exists()
+            and _SRC.exists()
+            and _LIB.stat().st_mtime < _SRC.stat().st_mtime
+        )
+        if (not _LIB.exists() or stale) and not _compile():
             _load_failed = True
             return None
         try:
             _lib = _bind(ctypes.CDLL(str(_LIB)))
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: stale cached binary missing a symbol despite
+            # the mtime check (e.g. clock skew on a shared filesystem) —
+            # fall back to the pure-Python paths rather than crashing
+            # every native entry point.
             _load_failed = True
             return None
         return _lib
@@ -248,3 +267,58 @@ class NativeStagingBuffer:
 
     def clear(self) -> None:
         self._lib.ld_staging_clear(self._h)
+
+
+def flatten_events(
+    pixel_id,
+    toa,
+    *,
+    lut=None,
+    n_screen: int,
+    n_toa: int,
+    lo: float,
+    hi: float,
+    inv_width: float,
+    dump: int,
+):
+    """Native event -> flat-bin projection (see ingest.cpp ld_flatten).
+
+    Returns the int32 flat-index array, or None when the native library is
+    unavailable (caller falls back to the numpy path). Inputs must be
+    contiguous int32/float32 arrays; ``lut`` a contiguous 1-D int32 map or
+    None.
+    """
+    lib = load_library()
+    if lib is None:
+        return None
+    import numpy as np
+
+    pixel_id = np.ascontiguousarray(pixel_id, dtype=np.int32)
+    toa = np.ascontiguousarray(toa, dtype=np.float32)
+    n = pixel_id.shape[0]
+    out = np.empty(n, dtype=np.int32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    if lut is not None:
+        lut = np.ascontiguousarray(lut, dtype=np.int32)
+        lut_ptr = lut.ctypes.data_as(i32p)
+        n_pix = lut.shape[0]
+    else:
+        lut_ptr = None
+        n_pix = 0
+    lib.ld_flatten(
+        pixel_id.ctypes.data_as(i32p),
+        toa.ctypes.data_as(f32p),
+        n,
+        lut_ptr,
+        n_pix,
+        n_screen,
+        n_toa,
+        lo,
+        hi,
+        inv_width,
+        dump,
+        out.ctypes.data_as(i32p),
+    )
+    return out
+

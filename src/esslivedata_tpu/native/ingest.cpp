@@ -317,4 +317,35 @@ int64_t ld_ev44_info(const uint8_t* buf, int64_t len, int64_t* message_id,
   return 0;
 }
 
+// Project events into flat histogram-bin indices (the host half of the
+// ingest fast path: one int32 per event crosses to the device instead of
+// pixel_id+toa). Uniform TOA binning only; `lut` may be NULL (pixel_id is
+// the screen row). Out-of-range/masked events get `dump`.
+void ld_flatten(const int32_t* pixel, const float* toa, int64_t n,
+                const int32_t* lut, int64_t n_pix, int32_t n_screen,
+                int32_t n_toa, float lo, float hi, float inv_width,
+                int32_t dump, int32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    float t = toa[i];
+    int32_t p = pixel[i];
+    int32_t tb = static_cast<int32_t>((t - lo) * inv_width);
+    if (tb >= n_toa) tb = n_toa - 1;
+    if (tb < 0) tb = 0;
+    bool ok = (t >= lo) & (t < hi);
+    int32_t screen;
+    if (lut != nullptr) {
+      if (p >= 0 && p < n_pix) {
+        screen = lut[p];
+      } else {
+        screen = -1;
+      }
+      ok = ok & (screen >= 0);
+    } else {
+      screen = p;
+      ok = ok & (p >= 0) & (p < n_screen);
+    }
+    out[i] = ok ? screen * n_toa + tb : dump;
+  }
+}
+
 }  // extern "C"

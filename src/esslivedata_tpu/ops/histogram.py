@@ -412,22 +412,46 @@ class EventHistogrammer:
         """Accumulate from already-device-resident (or padded host) arrays."""
         return self._step(state, dispatch_safe(pixel_id), dispatch_safe(toa))
 
+    def step_batch(self, state: HistogramState, batch: EventBatch) -> HistogramState:
+        """One staged batch, taking the 4-byte/event ingest fast path
+        (host flatten + flat scatter) whenever the configuration allows it
+        — half the host->device bytes of the (pixel_id, toa) path
+        (PERF.md); replica/weighted configurations use the device path."""
+        if self.supports_host_flatten:
+            return self.step_flat(
+                state, self.flatten_host(batch.pixel_id, batch.toa)
+            )
+        return self.step(state, batch)
+
     def step_flat(self, state: HistogramState, flat) -> HistogramState:
         """Accumulate host-pre-flattened int32 bin indices (see
         ``flatten_host``): 4 bytes/event over the host->device link instead
         of 8. Out-of-range indices are dropped by the scatter."""
         return self._step_flat(state, dispatch_safe(flat))
 
+    @property
+    def supports_host_flatten(self) -> bool:
+        """True when this configuration can use the 4-byte/event ingest
+        fast path (``flatten_host`` + ``step_flat``): replica LUTs multiply
+        events and weighted configurations need float updates, so both
+        stay on the device path."""
+        return (
+            self._proj.weights is None
+            and (self._proj.lut_host is None or self._proj.lut_host.shape[0] == 1)
+            and self._n_bins < np.iinfo(np.int32).max
+        )
+
     def flatten_host(self, pixel_id: np.ndarray, toa: np.ndarray) -> np.ndarray:
-        """Vectorized host-side flat-index computation for ``step_flat``.
+        """Host-side flat-index computation for ``step_flat``.
 
         Supports the no-LUT and single-replica-LUT configurations (the
         replica path multiplies events and must stay on device). Weighted
         configurations also stay on the device path.
 
-        Kept to a handful of int32/float32 passes: this runs on the host
-        ingest thread per batch (the native shim folds the same math into
-        ev44 decode), so every extra temporary costs real pipeline time.
+        The native shim (ingest.cpp ld_flatten) does this in one C pass
+        when available; the numpy fallback is kept to a handful of
+        int32/float32 passes — this runs on the host ingest thread per
+        batch, so every extra temporary costs real pipeline time.
         """
         if self._proj.weights is not None:
             raise ValueError("flatten_host does not support pixel_weights")
@@ -438,6 +462,25 @@ class EventHistogrammer:
             raise ValueError("bin space exceeds int32 flat indexing")
         pixel_id = np.asarray(pixel_id)
         toa = np.asarray(toa, dtype=np.float32)
+        if self._proj.uniform:
+            try:
+                from ..native import flatten_events
+            except ImportError:
+                flatten_events = None
+            if flatten_events is not None:
+                out = flatten_events(
+                    pixel_id,
+                    toa,
+                    lut=None if lut_host is None else lut_host[0],
+                    n_screen=self._n_screen,
+                    n_toa=self._n_toa,
+                    lo=self._proj.lo,
+                    hi=self._proj.hi,
+                    inv_width=self._proj.inv_width,
+                    dump=self._n_bins,
+                )
+                if out is not None:
+                    return out
         proj = self._proj
         if proj.uniform:
             tb = (toa - np.float32(proj.lo)) * np.float32(proj.inv_width)

@@ -27,6 +27,8 @@ from .projectors import ProjectionTable
 
 __all__ = ["DetectorViewParams", "DetectorViewWorkflow", "MAX_ROIS"]
 
+
+
 MAX_ROIS = 8
 """ROI mask matrix rows are fixed at this size so ROI edits never trigger
 an XLA recompile — unused rows are zero."""
@@ -176,7 +178,9 @@ class DetectorViewWorkflow:
         for key, value in data.items():
             if isinstance(value, StagedEvents):
                 if self._primary_stream is None or key == self._primary_stream:
-                    self._state = self._hist.step(self._state, value.batch)
+                    self._state = self._hist.step_batch(
+                        self._state, value.batch
+                    )
 
     def finalize(self) -> dict[str, DataArray]:
         out = self._summarize(self._state, self._roi_masks)

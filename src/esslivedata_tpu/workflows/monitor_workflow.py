@@ -22,6 +22,8 @@ from ..utils.labeled import DataArray, Variable
 __all__ = ["MonitorWorkflow", "MonitorParams", "rebin_1d"]
 
 
+
+
 class MonitorParams(BaseModel):
     model_config = ConfigDict(frozen=True)
 
@@ -70,7 +72,7 @@ class MonitorWorkflow:
     def accumulate(self, data: Mapping[str, Any]) -> None:
         for value in data.values():
             if isinstance(value, StagedEvents):
-                self._state = self._hist.step(self._state, value.batch)
+                self._state = self._hist.step_batch(self._state, value.batch)
             elif isinstance(value, DataArray):
                 self._add_dense(value)
 

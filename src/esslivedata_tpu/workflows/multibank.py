@@ -29,6 +29,8 @@ from ..utils.labeled import DataArray, Variable
 __all__ = ["MultiBankParams", "MultiBankViewWorkflow"]
 
 
+
+
 class MultiBankParams(BaseModel):
     model_config = ConfigDict(frozen=True)
 
@@ -102,7 +104,9 @@ class MultiBankViewWorkflow:
                         self._state, value.batch.pixel_id, value.batch.toa
                     )
                 else:
-                    self._state = self._hist.step(self._state, value.batch)
+                    self._state = self._hist.step_batch(
+                        self._state, value.batch
+                    )
 
     def finalize(self) -> dict[str, DataArray]:
         cum, win = self._hist.read(self._state)

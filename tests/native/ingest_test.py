@@ -154,3 +154,50 @@ def test_factory_prefers_native():
 def test_factory_python_fallback():
     buf = make_staging_buffer(min_bucket=16, prefer_native=False)
     assert isinstance(buf, StagingBuffer)
+
+
+class TestNativeFlatten:
+    def test_native_matches_numpy_flatten(self):
+        from esslivedata_tpu.native import available
+        from esslivedata_tpu.ops import EventHistogrammer
+
+        if not available():
+            pytest.skip("native library unavailable")
+        edges = np.linspace(0.0, 71_000_000.0, 101)
+        lut = (np.arange(5000) % 64).astype(np.int32)
+        lut[7] = -1
+        h = EventHistogrammer(toa_edges=edges, n_screen=64, pixel_lut=lut)
+        rng = np.random.default_rng(0)
+        pid = rng.integers(-5, 5005, 100_000).astype(np.int32)
+        toa = rng.uniform(-1e6, 7.3e7, 100_000).astype(np.float32)
+        native = h.flatten_host(pid, toa)
+
+        # numpy fallback path: force it by hiding the native module.
+        import esslivedata_tpu.native as native_mod
+
+        real = native_mod.flatten_events
+        native_mod.flatten_events = lambda *a, **k: None
+        try:
+            fallback = h.flatten_host(pid, toa)
+        finally:
+            native_mod.flatten_events = real
+        np.testing.assert_array_equal(native, fallback)
+
+    def test_workflows_take_flat_path_when_supported(self):
+        from esslivedata_tpu.ops import EventHistogrammer
+
+        edges = np.linspace(0.0, 100.0, 11)
+        assert EventHistogrammer(toa_edges=edges, n_screen=4).supports_host_flatten
+        assert EventHistogrammer(
+            toa_edges=edges, n_screen=4, pixel_lut=np.array([0, 1], dtype=np.int32)
+        ).supports_host_flatten
+        assert not EventHistogrammer(
+            toa_edges=edges,
+            n_screen=4,
+            pixel_lut=np.array([[0, 1], [1, 1]], dtype=np.int32),
+        ).supports_host_flatten
+        assert not EventHistogrammer(
+            toa_edges=edges,
+            n_screen=4,
+            pixel_weights=np.array([1.0, 2.0], dtype=np.float32),
+        ).supports_host_flatten
