@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Operator tool: materialize instrument geometry artifacts into the data
+directory (the deployment analog of the reference's download_geometry.py /
+upload_geometry.py pooch tooling).
+
+- ``fetch``: resolve the artifact valid at a date (default today) through
+  the registry and ensure it exists in LIVEDATA_DATA_DIR (synthesizing
+  from the instrument's NeXus plan on miss — this environment has no
+  egress; a deployment with real ESS files simply pre-places them).
+- ``install``: register a hand-built NeXus file under the dated naming
+  convention so services pick it up from that validity date onward.
+
+Usage:
+  python scripts/fetch_geometry.py fetch loki [--date 2026-07-01]
+  python scripts/fetch_geometry.py fetch --all
+  python scripts/fetch_geometry.py install loki my_geometry.nxs --date 2026-08-01
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import shutil
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "src"))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    fetch = sub.add_parser("fetch")
+    fetch.add_argument("instrument", nargs="?")
+    fetch.add_argument("--all", action="store_true")
+    fetch.add_argument("--date", default=None)
+    install = sub.add_parser("install")
+    install.add_argument("instrument")
+    install.add_argument("nexus_file")
+    install.add_argument("--date", default=None)
+    args = parser.parse_args()
+
+    from esslivedata_tpu.config import geometry_store
+    from esslivedata_tpu.config.nexus_plans import NEXUS_PLANS
+
+    date = (
+        datetime.date.fromisoformat(args.date)
+        if args.date
+        else datetime.date.today()
+    )
+    if args.cmd == "fetch":
+        names = (
+            sorted(NEXUS_PLANS)
+            if args.all
+            else [args.instrument]
+            if args.instrument
+            else parser.error("instrument or --all required")
+        )
+        for name in names:
+            path = geometry_store.geometry_path(name, date)
+            print(f"{name}: {path} ({path.stat().st_size >> 10} KiB)")
+        return 0
+
+    # install: copy under the dated convention; services resolving at or
+    # after that date pick it up (newest-not-after-date wins).
+    target_name = f"geometry-{args.instrument}-{date.isoformat()}.nxs"
+    dest = geometry_store.data_dir() / target_name
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    shutil.copy2(args.nexus_file, dest)
+    resolved = geometry_store.geometry_filename(args.instrument, date)
+    print(f"installed {dest}")
+    print(f"resolves at {date}: {resolved}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -27,6 +27,7 @@ import numpy as np
 
 __all__ = [
     "GEOMETRY_REGISTRY",
+    "data_dir",
     "geometry_filename",
     "geometry_path",
     "load_detector_geometry",
@@ -52,6 +53,13 @@ GEOMETRY_REGISTRY: dict[str, str | None] = {
 _DATE_RE = re.compile(r"-(\d{4}-\d{2}-\d{2})\.nxs$")
 
 
+def data_dir() -> Path:
+    """The geometry data directory (LIVEDATA_DATA_DIR or the scratch
+    default) — where artifacts are cached, and where operators drop
+    hand-built dated files (they join date resolution automatically)."""
+    return _cache_dir()
+
+
 def _cache_dir() -> Path:
     override = os.environ.get("LIVEDATA_DATA_DIR")
     if override:
@@ -73,8 +81,16 @@ def geometry_filename(
     date-LUT semantics to the reference's ``get_nexus_geometry_filename``.
     """
     date = date or _dt.date.today()
+    # Registry entries plus any dated files an operator dropped into the
+    # data directory (scripts/fetch_geometry.py install): both join date
+    # resolution, so installing a new artifact needs no code change.
+    names = set(GEOMETRY_REGISTRY)
+    try:
+        names.update(p.name for p in _cache_dir().glob("geometry-*.nxs"))
+    except OSError:  # pragma: no cover - unreadable data dir
+        pass
     candidates: list[tuple[_dt.date, str]] = []
-    for name in GEOMETRY_REGISTRY:
+    for name in names:
         if f"-{instrument}-" not in name:
             continue
         m = _DATE_RE.search(name)

@@ -1,0 +1,16 @@
+"""Benchmarks-as-tests (reference tests/benchmarks/, pytest-benchmark with
+--benchmark-skip default): skipped unless --run-benchmarks is given, so the
+regular suite stays fast while perf harnesses live under test discipline.
+(The option itself is registered in tests/conftest.py — pytest only honors
+addoption hooks from the rootdir conftest.)"""
+
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--run-benchmarks"):
+        return
+    skip = pytest.mark.skip(reason="benchmarks skipped (use --run-benchmarks)")
+    for item in items:
+        if "benchmark" in item.keywords:
+            item.add_marker(skip)

@@ -1,0 +1,125 @@
+"""Perf harnesses under test discipline (reference
+tests/benchmarks/accumulator_bench.py, data_service_benchmark.py,
+plotter_compute_benchmark.py). Each measures a hot stage on the current
+backend, prints one rate line, and asserts a loose sanity floor — a 10x
+regression fails; backend-to-backend variance does not."""
+
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.benchmark
+
+
+def _rate(label, n, dt):
+    print(f"\n{label}: {n / dt:.3e} /s ({dt * 1e3:.1f} ms)")
+    return n / dt
+
+
+class TestIngestBench:
+    @pytest.mark.parametrize("n_events", [10_000, 1_000_000])
+    def test_staging_throughput(self, n_events):
+        from esslivedata_tpu.ops.event_batch import make_staging_buffer
+
+        rng = np.random.default_rng(0)
+        pid = rng.integers(0, 1 << 20, n_events).astype(np.int32)
+        toa = rng.uniform(0, 7e7, n_events).astype(np.float32)
+        buf = make_staging_buffer()
+        t0 = time.perf_counter()
+        reps = 5
+        for _ in range(reps):
+            buf.add(pid, toa)
+            buf.take()
+            buf.release()
+        rate = _rate("staging", n_events * reps, time.perf_counter() - t0)
+        assert rate > 1e6
+
+    def test_flatten_throughput(self):
+        from esslivedata_tpu.ops import EventHistogrammer
+
+        h = EventHistogrammer(
+            toa_edges=np.linspace(0, 7.1e7, 101), n_screen=1 << 20
+        )
+        rng = np.random.default_rng(0)
+        pid = rng.integers(0, 1 << 20, 1_000_000).astype(np.int32)
+        toa = rng.uniform(0, 7.1e7, 1_000_000).astype(np.float32)
+        h.flatten_host(pid, toa)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            h.flatten_host(pid, toa)
+        rate = _rate("flatten_host", 10_000_000, time.perf_counter() - t0)
+        assert rate > 1e7
+
+    def test_histogram_step_throughput(self):
+        from esslivedata_tpu.ops import EventBatch, EventHistogrammer
+
+        h = EventHistogrammer(
+            toa_edges=np.linspace(0, 7.1e7, 101), n_screen=1 << 16
+        )
+        rng = np.random.default_rng(0)
+        b = EventBatch.from_arrays(
+            rng.integers(0, 1 << 16, 1 << 20).astype(np.int32),
+            rng.uniform(0, 7.1e7, 1 << 20).astype(np.float32),
+        )
+        state = h.step_batch(h.init_state(), b)
+        h.read(state)
+        t0 = time.perf_counter()
+        reps = 10
+        for _ in range(reps):
+            state = h.step_batch(state, b)
+        total = h.read(state)[0].sum()  # forces completion
+        rate = _rate("histogram step", (1 << 20) * reps, time.perf_counter() - t0)
+        assert total > 0
+        assert rate > 1e6
+
+
+class TestDashboardBench:
+    def test_data_service_put_notify(self):
+        from esslivedata_tpu.config.workflow_spec import JobId, ResultKey, WorkflowId
+        from esslivedata_tpu.core.timestamp import Timestamp
+        from esslivedata_tpu.dashboard.data_service import (
+            DataService,
+            DataSubscription,
+        )
+        from esslivedata_tpu.utils import DataArray, Variable
+
+        ds = DataService()
+        hits = []
+        keys = [
+            ResultKey(
+                workflow_id=WorkflowId.parse("a/b/c/v1"),
+                job_id=JobId(source_name=f"s{i}"),
+                output_name="o",
+            )
+            for i in range(50)
+        ]
+        ds.subscribe(DataSubscription(keys=set(keys), on_updated=hits.append))
+        da = DataArray(Variable(np.zeros(1000), ("x",), "counts"))
+        t0 = time.perf_counter()
+        reps = 200
+        for r in range(reps):
+            with ds.transaction():
+                for key in keys:
+                    ds.put(key, Timestamp.from_ns(r), da)
+        rate = _rate("data_service put", reps * len(keys), time.perf_counter() - t0)
+        assert len(hits) == reps  # one keys-only notification per batch
+        assert rate > 1e3
+
+    def test_plot_render(self):
+        from esslivedata_tpu.dashboard.plots import render_png
+        from esslivedata_tpu.utils import DataArray, Variable, linspace
+
+        da = DataArray(
+            Variable(np.random.default_rng(0).random((256, 256)), ("y", "x"), "counts"),
+            coords={
+                "x": linspace("x", 0, 1, 257, "m"),
+                "y": linspace("y", 0, 1, 257, "m"),
+            },
+        )
+        render_png(da)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            render_png(da)
+        rate = _rate("render_png 256x256", 10, time.perf_counter() - t0)
+        assert rate > 1

@@ -298,3 +298,21 @@ class TestCatalogConflictGuard:
             inst.streams["band_chopper/delay"].nexus_path
             == "/entry/instrument/band_chopper/delay"
         )
+
+
+class TestOperatorInstalledArtifacts:
+    def test_dropped_dated_file_joins_date_resolution(
+        self, monkeypatch, tmp_path
+    ):
+        monkeypatch.setenv("LIVEDATA_DATA_DIR", str(tmp_path))
+        # Operator installs a newer artifact under the dated convention —
+        # resolution picks it up with no registry edit.
+        newer = tmp_path / "geometry-dummy-2026-06-01.nxs"
+        write_nexus(plan_for("dummy"), newer)
+        assert geometry_store.geometry_filename(
+            "dummy", datetime.date(2026, 7, 1)
+        ) == newer.name
+        # Before its validity date the registry entry still wins.
+        assert geometry_store.geometry_filename(
+            "dummy", datetime.date(2026, 3, 1)
+        ).endswith("2026-01-01.nxs")

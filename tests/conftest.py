@@ -16,3 +16,15 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "src"))
 from esslivedata_tpu.utils.platform_pin import pin_cpu
 
 pin_cpu(8)
+
+
+def pytest_addoption(parser):
+    # Benchmarks-as-tests (tests/benchmarks/): registered here because
+    # pytest only collects addoption hooks from the rootdir conftest.
+    parser.addoption(
+        "--run-benchmarks",
+        action="store_true",
+        default=False,
+        help="run the benchmark harnesses (skipped by default)",
+    )
+
