@@ -50,7 +50,12 @@ GEOMETRY_REGISTRY: dict[str, str | None] = {
     "geometry-dummy-2026-01-01.nxs": None,
 }
 
-_DATE_RE = re.compile(r"-(\d{4}-\d{2}-\d{2})\.nxs$")
+def _name_pattern(instrument: str) -> re.Pattern:
+    """Exact-match pattern for one instrument's dated artifacts: anchored,
+    so 'dummy' never matches an operator-installed 'dummy-hr' file."""
+    return re.compile(
+        rf"^geometry-{re.escape(instrument)}-(\d{{4}}-\d{{2}}-\d{{2}})\.nxs$"
+    )
 
 
 def data_dir() -> Path:
@@ -89,11 +94,10 @@ def geometry_filename(
         names.update(p.name for p in _cache_dir().glob("geometry-*.nxs"))
     except OSError:  # pragma: no cover - unreadable data dir
         pass
+    pattern = _name_pattern(instrument)
     candidates: list[tuple[_dt.date, str]] = []
     for name in names:
-        if f"-{instrument}-" not in name:
-            continue
-        m = _DATE_RE.search(name)
+        m = pattern.match(name)
         if not m:
             continue
         candidates.append((_dt.date.fromisoformat(m.group(1)), name))

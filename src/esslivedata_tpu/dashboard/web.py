@@ -588,6 +588,87 @@ async function editCell(gridId, index, params) {{
     method: 'POST', body: JSON.stringify({{params: parsed}})}});
   if (!r.ok) alert((await r.json()).error);
 }}
+// -- workflow wizard: schema-driven params form, two-phase stage->commit.
+function openWizard(w, src) {{
+  const old = document.getElementById('wizard');
+  if (old) old.remove();
+  const box = el('div', 'card'); box.id = 'wizard';
+  box.style.cssText =
+    'position:fixed;top:80px;left:50%;transform:translateX(-50%);' +
+    'z-index:10;min-width:320px;box-shadow:0 4px 24px rgba(0,0,0,.35)';
+  box.appendChild(el('h3', '', 'Start ' + (w.title || w.workflow_id)));
+  box.appendChild(el('small', '', w.workflow_id + ' @ ' + src));
+  const form = el('div'); box.appendChild(form);
+  const fields = {{}};
+  const props = (w.params_schema && w.params_schema.properties) || {{}};
+  for (const [name, prop] of Object.entries(props)) {{
+    const row = el('div');
+    const label = el('label', '', name + ' ');
+    label.title = prop.description || '';
+    const input = document.createElement('input');
+    if (prop.type === 'boolean') {{
+      input.type = 'checkbox';
+      input.checked = !!prop.default;
+    }} else {{
+      input.type = (prop.type === 'number' || prop.type === 'integer')
+        ? 'number' : 'text';
+      if (prop.type === 'number') input.step = 'any';
+      // Nested models ride as JSON (the schema shows an object/$ref).
+      input.value = prop.default !== undefined
+        ? (typeof prop.default === 'object'
+            ? JSON.stringify(prop.default) : prop.default)
+        : '';
+    }}
+    const err = el('small', 'field-error'); err.style.color = '#b00020';
+    row.appendChild(label); row.appendChild(input); row.appendChild(err);
+    form.appendChild(row);
+    fields[name] = {{input, err, prop}};
+  }}
+  const status = el('small', '', ''); status.style.color = '#b00020';
+  const go = el('button', '', 'Stage + start');
+  const cancel = el('button', '', 'Cancel');
+  cancel.onclick = () => box.remove();
+  go.onclick = async () => {{
+    const params = {{}};
+    for (const [name, f] of Object.entries(fields)) {{
+      f.err.textContent = '';
+      if (f.prop.type === 'boolean') {{ params[name] = f.input.checked; continue; }}
+      const raw = f.input.value;
+      if (raw === '') continue;  // omitted -> server default
+      if (f.prop.type === 'integer' || f.prop.type === 'number') {{
+        params[name] = Number(raw);
+      }} else if (f.prop.type === 'string') {{
+        params[name] = raw;  // never JSON.parse: 'true'/'123' stay text
+      }} else {{
+        // object/array ($ref) props ride as JSON
+        try {{ params[name] = JSON.parse(raw); }}
+        catch (e) {{ params[name] = raw; }}
+      }}
+    }}
+    const payload = JSON.stringify(
+      {{workflow_id: w.workflow_id, source_name: src, params}});
+    const staged = await fetch('/api/workflow/stage',
+      {{method: 'POST', body: payload}});
+    if (!staged.ok) {{
+      const body = await staged.json();
+      status.textContent = body.error || 'validation failed';
+      for (const d of body.details || []) {{
+        const f = fields[d.field.split('.')[0]];
+        if (f) f.err.textContent = ' ' + d.message;
+      }}
+      return;  // staged-config validation errors stay in the form
+    }}
+    const committed = await fetch('/api/workflow/commit',
+      {{method: 'POST', body: payload}});
+    if (!committed.ok) {{
+      status.textContent = (await committed.json()).error || 'commit failed';
+      return;
+    }}
+    box.remove(); refresh();
+  }};
+  box.appendChild(go); box.appendChild(cancel); box.appendChild(status);
+  document.body.appendChild(box);
+}}
 async function pollSession() {{
   const q = sessionId ? '?session=' + sessionId : '';
   const r = await fetch('/api/session' + q); const data = await r.json();
@@ -603,15 +684,21 @@ async function pollSession() {{
 async function refresh() {{
   const r = await fetch('/api/state'); const s = await r.json();
   document.getElementById('meta').textContent = 'generation ' + s.generation;
-  const wf = document.getElementById('workflows'); wf.innerHTML = '';
-  for (const w of s.workflows) {{
-    for (const src of w.source_names) {{
-      const b = document.createElement('button');
-      b.textContent = w.title + ' @ ' + src;
-      b.onclick = () => fetch('/api/workflow/start', {{method: 'POST',
-        body: JSON.stringify({{workflow_id: w.workflow_id, source_name: src}})}})
-        .then(refresh);
-      wf.appendChild(b); wf.appendChild(document.createElement('br'));
+  const wf = document.getElementById('workflows');
+  // Re-render when the workflow/source set changes (fingerprint, not
+  // count: a same-count replacement must refresh captured schemas too).
+  const wfFp = JSON.stringify(
+    s.workflows.map(w => [w.workflow_id, w.source_names]));
+  if (wf.dataset.fp !== wfFp) {{
+    wf.dataset.fp = wfFp;
+    wf.innerHTML = '';
+    for (const w of s.workflows) {{
+      for (const src of w.source_names) {{
+        const b = document.createElement('button');
+        b.textContent = w.title + ' @ ' + src;
+        b.onclick = () => openWizard(w, src);
+        wf.appendChild(b); wf.appendChild(document.createElement('br'));
+      }}
     }}
   }}
   const jobs = document.getElementById('jobs'); jobs.innerHTML = '';

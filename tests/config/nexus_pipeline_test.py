@@ -316,3 +316,15 @@ class TestOperatorInstalledArtifacts:
         assert geometry_store.geometry_filename(
             "dummy", datetime.date(2026, 3, 1)
         ).endswith("2026-01-01.nxs")
+
+    def test_hyphen_extended_names_do_not_cross_match(
+        self, monkeypatch, tmp_path
+    ):
+        monkeypatch.setenv("LIVEDATA_DATA_DIR", str(tmp_path))
+        # An installed artifact for a hyphen-extended instrument name must
+        # never win resolution for the base name.
+        rogue = tmp_path / "geometry-dummy-hr-2026-09-01.nxs"
+        write_nexus(plan_for("dummy"), rogue)
+        assert geometry_store.geometry_filename(
+            "dummy", datetime.date(2026, 10, 1)
+        ).endswith("geometry-dummy-2026-01-01.nxs")
