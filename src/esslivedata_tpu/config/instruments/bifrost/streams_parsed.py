@@ -6,365 +6,71 @@ Source artifact: geometry-bifrost-<date>.nxs (synthesized)
 
 from esslivedata_tpu.config.stream import F144Stream
 
+# (nexus_path, source, topic, units)
+_ROWS: tuple[tuple[str, str, str, str | None], ...] = (
+    ('/entry/analyzer_env/temperature_1', 'BIFR-Ana:Tmp-TIC-001', 'bifrost_sample_env', 'K'),
+    ('/entry/analyzer_env/temperature_2', 'BIFR-Ana:Tmp-TIC-002', 'bifrost_sample_env', 'K'),
+    ('/entry/analyzer_env/temperature_3', 'BIFR-Ana:Tmp-TIC-003', 'bifrost_sample_env', 'K'),
+    ('/entry/analyzer_env/temperature_4', 'BIFR-Ana:Tmp-TIC-004', 'bifrost_sample_env', 'K'),
+    ('/entry/analyzer_env/temperature_5', 'BIFR-Ana:Tmp-TIC-005', 'bifrost_sample_env', 'K'),
+    ('/entry/analyzer_env/temperature_6', 'BIFR-Ana:Tmp-TIC-006', 'bifrost_sample_env', 'K'),
+    ('/entry/analyzer_env/temperature_7', 'BIFR-Ana:Tmp-TIC-007', 'bifrost_sample_env', 'K'),
+    ('/entry/analyzer_env/temperature_8', 'BIFR-Ana:Tmp-TIC-008', 'bifrost_sample_env', 'K'),
+    ('/entry/analyzer_env/temperature_9', 'BIFR-Ana:Tmp-TIC-009', 'bifrost_sample_env', 'K'),
+    ('/entry/instrument/analyzer_1/goniometer/idle_flag', 'BIFR-Ana1:MC-RotX-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/analyzer_1/goniometer/target_value', 'BIFR-Ana1:MC-RotX-01:Mtr.VAL', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_1/goniometer/value', 'BIFR-Ana1:MC-RotX-01:Mtr.RBV', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_2/goniometer/idle_flag', 'BIFR-Ana2:MC-RotX-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/analyzer_2/goniometer/target_value', 'BIFR-Ana2:MC-RotX-01:Mtr.VAL', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_2/goniometer/value', 'BIFR-Ana2:MC-RotX-01:Mtr.RBV', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_3/goniometer/idle_flag', 'BIFR-Ana3:MC-RotX-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/analyzer_3/goniometer/target_value', 'BIFR-Ana3:MC-RotX-01:Mtr.VAL', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_3/goniometer/value', 'BIFR-Ana3:MC-RotX-01:Mtr.RBV', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_4/goniometer/idle_flag', 'BIFR-Ana4:MC-RotX-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/analyzer_4/goniometer/target_value', 'BIFR-Ana4:MC-RotX-01:Mtr.VAL', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_4/goniometer/value', 'BIFR-Ana4:MC-RotX-01:Mtr.RBV', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_5/goniometer/idle_flag', 'BIFR-Ana5:MC-RotX-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/analyzer_5/goniometer/target_value', 'BIFR-Ana5:MC-RotX-01:Mtr.VAL', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_5/goniometer/value', 'BIFR-Ana5:MC-RotX-01:Mtr.RBV', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_6/goniometer/idle_flag', 'BIFR-Ana6:MC-RotX-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/analyzer_6/goniometer/target_value', 'BIFR-Ana6:MC-RotX-01:Mtr.VAL', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_6/goniometer/value', 'BIFR-Ana6:MC-RotX-01:Mtr.RBV', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_7/goniometer/idle_flag', 'BIFR-Ana7:MC-RotX-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/analyzer_7/goniometer/target_value', 'BIFR-Ana7:MC-RotX-01:Mtr.VAL', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_7/goniometer/value', 'BIFR-Ana7:MC-RotX-01:Mtr.RBV', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_8/goniometer/idle_flag', 'BIFR-Ana8:MC-RotX-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/analyzer_8/goniometer/target_value', 'BIFR-Ana8:MC-RotX-01:Mtr.VAL', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_8/goniometer/value', 'BIFR-Ana8:MC-RotX-01:Mtr.RBV', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_9/goniometer/idle_flag', 'BIFR-Ana9:MC-RotX-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/analyzer_9/goniometer/target_value', 'BIFR-Ana9:MC-RotX-01:Mtr.VAL', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_9/goniometer/value', 'BIFR-Ana9:MC-RotX-01:Mtr.RBV', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/frame_overlap_chopper/delay', 'BIFR-Chop:FOC-01:Delay', 'bifrost_choppers', 'ns'),
+    ('/entry/instrument/frame_overlap_chopper/phase', 'BIFR-Chop:FOC-01:Phs', 'bifrost_choppers', 'deg'),
+    ('/entry/instrument/frame_overlap_chopper/rotation_speed', 'BIFR-Chop:FOC-01:Spd', 'bifrost_choppers', 'Hz'),
+    ('/entry/instrument/frame_overlap_chopper/rotation_speed_setpoint', 'BIFR-Chop:FOC-01:SpdSet', 'bifrost_choppers', 'Hz'),
+    ('/entry/instrument/pulse_shaping_chopper/delay', 'BIFR-Chop:PSC-01:Delay', 'bifrost_choppers', 'ns'),
+    ('/entry/instrument/pulse_shaping_chopper/phase', 'BIFR-Chop:PSC-01:Phs', 'bifrost_choppers', 'deg'),
+    ('/entry/instrument/pulse_shaping_chopper/rotation_speed', 'BIFR-Chop:PSC-01:Spd', 'bifrost_choppers', 'Hz'),
+    ('/entry/instrument/pulse_shaping_chopper/rotation_speed_setpoint', 'BIFR-Chop:PSC-01:SpdSet', 'bifrost_choppers', 'Hz'),
+    ('/entry/instrument/sample_stage/omega/idle_flag', 'BIFR-Smpl:MC-RotZ-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/omega/target_value', 'BIFR-Smpl:MC-RotZ-01:Mtr.VAL', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/sample_stage/omega/value', 'BIFR-Smpl:MC-RotZ-01:Mtr.RBV', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/sample_stage/x/idle_flag', 'BIFR-Smpl:MC-LinX-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/x/target_value', 'BIFR-Smpl:MC-LinX-01:Mtr.VAL', 'bifrost_motion', 'mm'),
+    ('/entry/instrument/sample_stage/x/value', 'BIFR-Smpl:MC-LinX-01:Mtr.RBV', 'bifrost_motion', 'mm'),
+    ('/entry/instrument/sample_stage/y/idle_flag', 'BIFR-Smpl:MC-LinY-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/y/target_value', 'BIFR-Smpl:MC-LinY-01:Mtr.VAL', 'bifrost_motion', 'mm'),
+    ('/entry/instrument/sample_stage/y/value', 'BIFR-Smpl:MC-LinY-01:Mtr.RBV', 'bifrost_motion', 'mm'),
+    ('/entry/instrument/sample_stage/z/idle_flag', 'BIFR-Smpl:MC-LinZ-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/z/target_value', 'BIFR-Smpl:MC-LinZ-01:Mtr.VAL', 'bifrost_motion', 'mm'),
+    ('/entry/instrument/sample_stage/z/value', 'BIFR-Smpl:MC-LinZ-01:Mtr.RBV', 'bifrost_motion', 'mm'),
+    ('/entry/sample/magnetic_field', 'BIFROST-SE:Mag-PSU-101', 'bifrost_sample_env', 'T'),
+    ('/entry/sample/pressure', 'BIFROST-SE:Prs-PIC-101', 'bifrost_sample_env', 'bar'),
+    ('/entry/sample/temperature_1', 'BIFROST-SE:Tmp-TIC-101', 'bifrost_sample_env', 'K'),
+    ('/entry/sample/temperature_2', 'BIFROST-SE:Tmp-TIC-102', 'bifrost_sample_env', 'K'),
+)
+
 PARSED_STREAMS: dict[str, F144Stream] = {
-    '/entry/analyzer_env/temperature_1': F144Stream(
-        nexus_path='/entry/analyzer_env/temperature_1',
-        source='BIFR-Ana:Tmp-TIC-001',
-        topic='bifrost_sample_env',
-        units='K',
-    ),
-    '/entry/analyzer_env/temperature_2': F144Stream(
-        nexus_path='/entry/analyzer_env/temperature_2',
-        source='BIFR-Ana:Tmp-TIC-002',
-        topic='bifrost_sample_env',
-        units='K',
-    ),
-    '/entry/analyzer_env/temperature_3': F144Stream(
-        nexus_path='/entry/analyzer_env/temperature_3',
-        source='BIFR-Ana:Tmp-TIC-003',
-        topic='bifrost_sample_env',
-        units='K',
-    ),
-    '/entry/analyzer_env/temperature_4': F144Stream(
-        nexus_path='/entry/analyzer_env/temperature_4',
-        source='BIFR-Ana:Tmp-TIC-004',
-        topic='bifrost_sample_env',
-        units='K',
-    ),
-    '/entry/analyzer_env/temperature_5': F144Stream(
-        nexus_path='/entry/analyzer_env/temperature_5',
-        source='BIFR-Ana:Tmp-TIC-005',
-        topic='bifrost_sample_env',
-        units='K',
-    ),
-    '/entry/analyzer_env/temperature_6': F144Stream(
-        nexus_path='/entry/analyzer_env/temperature_6',
-        source='BIFR-Ana:Tmp-TIC-006',
-        topic='bifrost_sample_env',
-        units='K',
-    ),
-    '/entry/analyzer_env/temperature_7': F144Stream(
-        nexus_path='/entry/analyzer_env/temperature_7',
-        source='BIFR-Ana:Tmp-TIC-007',
-        topic='bifrost_sample_env',
-        units='K',
-    ),
-    '/entry/analyzer_env/temperature_8': F144Stream(
-        nexus_path='/entry/analyzer_env/temperature_8',
-        source='BIFR-Ana:Tmp-TIC-008',
-        topic='bifrost_sample_env',
-        units='K',
-    ),
-    '/entry/analyzer_env/temperature_9': F144Stream(
-        nexus_path='/entry/analyzer_env/temperature_9',
-        source='BIFR-Ana:Tmp-TIC-009',
-        topic='bifrost_sample_env',
-        units='K',
-    ),
-    '/entry/instrument/analyzer_1/goniometer/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/analyzer_1/goniometer/idle_flag',
-        source='BIFR-Ana1:MC-RotX-01:Mtr.DMOV',
-        topic='bifrost_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/analyzer_1/goniometer/target_value': F144Stream(
-        nexus_path='/entry/instrument/analyzer_1/goniometer/target_value',
-        source='BIFR-Ana1:MC-RotX-01:Mtr.VAL',
-        topic='bifrost_motion',
-        units='deg',
-    ),
-    '/entry/instrument/analyzer_1/goniometer/value': F144Stream(
-        nexus_path='/entry/instrument/analyzer_1/goniometer/value',
-        source='BIFR-Ana1:MC-RotX-01:Mtr.RBV',
-        topic='bifrost_motion',
-        units='deg',
-    ),
-    '/entry/instrument/analyzer_2/goniometer/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/analyzer_2/goniometer/idle_flag',
-        source='BIFR-Ana2:MC-RotX-01:Mtr.DMOV',
-        topic='bifrost_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/analyzer_2/goniometer/target_value': F144Stream(
-        nexus_path='/entry/instrument/analyzer_2/goniometer/target_value',
-        source='BIFR-Ana2:MC-RotX-01:Mtr.VAL',
-        topic='bifrost_motion',
-        units='deg',
-    ),
-    '/entry/instrument/analyzer_2/goniometer/value': F144Stream(
-        nexus_path='/entry/instrument/analyzer_2/goniometer/value',
-        source='BIFR-Ana2:MC-RotX-01:Mtr.RBV',
-        topic='bifrost_motion',
-        units='deg',
-    ),
-    '/entry/instrument/analyzer_3/goniometer/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/analyzer_3/goniometer/idle_flag',
-        source='BIFR-Ana3:MC-RotX-01:Mtr.DMOV',
-        topic='bifrost_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/analyzer_3/goniometer/target_value': F144Stream(
-        nexus_path='/entry/instrument/analyzer_3/goniometer/target_value',
-        source='BIFR-Ana3:MC-RotX-01:Mtr.VAL',
-        topic='bifrost_motion',
-        units='deg',
-    ),
-    '/entry/instrument/analyzer_3/goniometer/value': F144Stream(
-        nexus_path='/entry/instrument/analyzer_3/goniometer/value',
-        source='BIFR-Ana3:MC-RotX-01:Mtr.RBV',
-        topic='bifrost_motion',
-        units='deg',
-    ),
-    '/entry/instrument/analyzer_4/goniometer/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/analyzer_4/goniometer/idle_flag',
-        source='BIFR-Ana4:MC-RotX-01:Mtr.DMOV',
-        topic='bifrost_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/analyzer_4/goniometer/target_value': F144Stream(
-        nexus_path='/entry/instrument/analyzer_4/goniometer/target_value',
-        source='BIFR-Ana4:MC-RotX-01:Mtr.VAL',
-        topic='bifrost_motion',
-        units='deg',
-    ),
-    '/entry/instrument/analyzer_4/goniometer/value': F144Stream(
-        nexus_path='/entry/instrument/analyzer_4/goniometer/value',
-        source='BIFR-Ana4:MC-RotX-01:Mtr.RBV',
-        topic='bifrost_motion',
-        units='deg',
-    ),
-    '/entry/instrument/analyzer_5/goniometer/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/analyzer_5/goniometer/idle_flag',
-        source='BIFR-Ana5:MC-RotX-01:Mtr.DMOV',
-        topic='bifrost_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/analyzer_5/goniometer/target_value': F144Stream(
-        nexus_path='/entry/instrument/analyzer_5/goniometer/target_value',
-        source='BIFR-Ana5:MC-RotX-01:Mtr.VAL',
-        topic='bifrost_motion',
-        units='deg',
-    ),
-    '/entry/instrument/analyzer_5/goniometer/value': F144Stream(
-        nexus_path='/entry/instrument/analyzer_5/goniometer/value',
-        source='BIFR-Ana5:MC-RotX-01:Mtr.RBV',
-        topic='bifrost_motion',
-        units='deg',
-    ),
-    '/entry/instrument/analyzer_6/goniometer/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/analyzer_6/goniometer/idle_flag',
-        source='BIFR-Ana6:MC-RotX-01:Mtr.DMOV',
-        topic='bifrost_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/analyzer_6/goniometer/target_value': F144Stream(
-        nexus_path='/entry/instrument/analyzer_6/goniometer/target_value',
-        source='BIFR-Ana6:MC-RotX-01:Mtr.VAL',
-        topic='bifrost_motion',
-        units='deg',
-    ),
-    '/entry/instrument/analyzer_6/goniometer/value': F144Stream(
-        nexus_path='/entry/instrument/analyzer_6/goniometer/value',
-        source='BIFR-Ana6:MC-RotX-01:Mtr.RBV',
-        topic='bifrost_motion',
-        units='deg',
-    ),
-    '/entry/instrument/analyzer_7/goniometer/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/analyzer_7/goniometer/idle_flag',
-        source='BIFR-Ana7:MC-RotX-01:Mtr.DMOV',
-        topic='bifrost_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/analyzer_7/goniometer/target_value': F144Stream(
-        nexus_path='/entry/instrument/analyzer_7/goniometer/target_value',
-        source='BIFR-Ana7:MC-RotX-01:Mtr.VAL',
-        topic='bifrost_motion',
-        units='deg',
-    ),
-    '/entry/instrument/analyzer_7/goniometer/value': F144Stream(
-        nexus_path='/entry/instrument/analyzer_7/goniometer/value',
-        source='BIFR-Ana7:MC-RotX-01:Mtr.RBV',
-        topic='bifrost_motion',
-        units='deg',
-    ),
-    '/entry/instrument/analyzer_8/goniometer/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/analyzer_8/goniometer/idle_flag',
-        source='BIFR-Ana8:MC-RotX-01:Mtr.DMOV',
-        topic='bifrost_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/analyzer_8/goniometer/target_value': F144Stream(
-        nexus_path='/entry/instrument/analyzer_8/goniometer/target_value',
-        source='BIFR-Ana8:MC-RotX-01:Mtr.VAL',
-        topic='bifrost_motion',
-        units='deg',
-    ),
-    '/entry/instrument/analyzer_8/goniometer/value': F144Stream(
-        nexus_path='/entry/instrument/analyzer_8/goniometer/value',
-        source='BIFR-Ana8:MC-RotX-01:Mtr.RBV',
-        topic='bifrost_motion',
-        units='deg',
-    ),
-    '/entry/instrument/analyzer_9/goniometer/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/analyzer_9/goniometer/idle_flag',
-        source='BIFR-Ana9:MC-RotX-01:Mtr.DMOV',
-        topic='bifrost_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/analyzer_9/goniometer/target_value': F144Stream(
-        nexus_path='/entry/instrument/analyzer_9/goniometer/target_value',
-        source='BIFR-Ana9:MC-RotX-01:Mtr.VAL',
-        topic='bifrost_motion',
-        units='deg',
-    ),
-    '/entry/instrument/analyzer_9/goniometer/value': F144Stream(
-        nexus_path='/entry/instrument/analyzer_9/goniometer/value',
-        source='BIFR-Ana9:MC-RotX-01:Mtr.RBV',
-        topic='bifrost_motion',
-        units='deg',
-    ),
-    '/entry/instrument/frame_overlap_chopper/delay': F144Stream(
-        nexus_path='/entry/instrument/frame_overlap_chopper/delay',
-        source='BIFR-Chop:FOC-01:Delay',
-        topic='bifrost_choppers',
-        units='ns',
-    ),
-    '/entry/instrument/frame_overlap_chopper/phase': F144Stream(
-        nexus_path='/entry/instrument/frame_overlap_chopper/phase',
-        source='BIFR-Chop:FOC-01:Phs',
-        topic='bifrost_choppers',
-        units='deg',
-    ),
-    '/entry/instrument/frame_overlap_chopper/rotation_speed': F144Stream(
-        nexus_path='/entry/instrument/frame_overlap_chopper/rotation_speed',
-        source='BIFR-Chop:FOC-01:Spd',
-        topic='bifrost_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/frame_overlap_chopper/rotation_speed_setpoint': F144Stream(
-        nexus_path='/entry/instrument/frame_overlap_chopper/rotation_speed_setpoint',
-        source='BIFR-Chop:FOC-01:SpdSet',
-        topic='bifrost_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/pulse_shaping_chopper/delay': F144Stream(
-        nexus_path='/entry/instrument/pulse_shaping_chopper/delay',
-        source='BIFR-Chop:PSC-01:Delay',
-        topic='bifrost_choppers',
-        units='ns',
-    ),
-    '/entry/instrument/pulse_shaping_chopper/phase': F144Stream(
-        nexus_path='/entry/instrument/pulse_shaping_chopper/phase',
-        source='BIFR-Chop:PSC-01:Phs',
-        topic='bifrost_choppers',
-        units='deg',
-    ),
-    '/entry/instrument/pulse_shaping_chopper/rotation_speed': F144Stream(
-        nexus_path='/entry/instrument/pulse_shaping_chopper/rotation_speed',
-        source='BIFR-Chop:PSC-01:Spd',
-        topic='bifrost_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/pulse_shaping_chopper/rotation_speed_setpoint': F144Stream(
-        nexus_path='/entry/instrument/pulse_shaping_chopper/rotation_speed_setpoint',
-        source='BIFR-Chop:PSC-01:SpdSet',
-        topic='bifrost_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/sample_stage/omega/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/omega/idle_flag',
-        source='BIFR-Smpl:MC-RotZ-01:Mtr.DMOV',
-        topic='bifrost_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/sample_stage/omega/target_value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/omega/target_value',
-        source='BIFR-Smpl:MC-RotZ-01:Mtr.VAL',
-        topic='bifrost_motion',
-        units='deg',
-    ),
-    '/entry/instrument/sample_stage/omega/value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/omega/value',
-        source='BIFR-Smpl:MC-RotZ-01:Mtr.RBV',
-        topic='bifrost_motion',
-        units='deg',
-    ),
-    '/entry/instrument/sample_stage/x/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/x/idle_flag',
-        source='BIFR-Smpl:MC-LinX-01:Mtr.DMOV',
-        topic='bifrost_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/sample_stage/x/target_value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/x/target_value',
-        source='BIFR-Smpl:MC-LinX-01:Mtr.VAL',
-        topic='bifrost_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/x/value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/x/value',
-        source='BIFR-Smpl:MC-LinX-01:Mtr.RBV',
-        topic='bifrost_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/y/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/y/idle_flag',
-        source='BIFR-Smpl:MC-LinY-01:Mtr.DMOV',
-        topic='bifrost_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/sample_stage/y/target_value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/y/target_value',
-        source='BIFR-Smpl:MC-LinY-01:Mtr.VAL',
-        topic='bifrost_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/y/value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/y/value',
-        source='BIFR-Smpl:MC-LinY-01:Mtr.RBV',
-        topic='bifrost_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/z/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/z/idle_flag',
-        source='BIFR-Smpl:MC-LinZ-01:Mtr.DMOV',
-        topic='bifrost_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/sample_stage/z/target_value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/z/target_value',
-        source='BIFR-Smpl:MC-LinZ-01:Mtr.VAL',
-        topic='bifrost_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/z/value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/z/value',
-        source='BIFR-Smpl:MC-LinZ-01:Mtr.RBV',
-        topic='bifrost_motion',
-        units='mm',
-    ),
-    '/entry/sample/magnetic_field': F144Stream(
-        nexus_path='/entry/sample/magnetic_field',
-        source='BIFROST-SE:Mag-PSU-101',
-        topic='bifrost_sample_env',
-        units='T',
-    ),
-    '/entry/sample/pressure': F144Stream(
-        nexus_path='/entry/sample/pressure',
-        source='BIFROST-SE:Prs-PIC-101',
-        topic='bifrost_sample_env',
-        units='bar',
-    ),
-    '/entry/sample/temperature_1': F144Stream(
-        nexus_path='/entry/sample/temperature_1',
-        source='BIFROST-SE:Tmp-TIC-101',
-        topic='bifrost_sample_env',
-        units='K',
-    ),
-    '/entry/sample/temperature_2': F144Stream(
-        nexus_path='/entry/sample/temperature_2',
-        source='BIFROST-SE:Tmp-TIC-102',
-        topic='bifrost_sample_env',
-        units='K',
-    ),
+    path: F144Stream(nexus_path=path, source=source, topic=topic, units=units)
+    for path, source, topic, units in _ROWS
 }

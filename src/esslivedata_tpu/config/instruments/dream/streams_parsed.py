@@ -6,389 +6,75 @@ Source artifact: geometry-dream-<date>.nxs (synthesized)
 
 from esslivedata_tpu.config.stream import F144Stream
 
+# (nexus_path, source, topic, units)
+_ROWS: tuple[tuple[str, str, str, str | None], ...] = (
+    ('/entry/instrument/T0_chopper/delay', 'T0_chopper:Delay', 'dream_choppers', 'ns'),
+    ('/entry/instrument/T0_chopper/phase', 'T0_chopper:Phs', 'dream_choppers', 'deg'),
+    ('/entry/instrument/T0_chopper/rotation_speed', 'T0_chopper:Spd', 'dream_choppers', 'Hz'),
+    ('/entry/instrument/T0_chopper/rotation_speed_setpoint', 'T0_chopper:SpdSet', 'dream_choppers', 'Hz'),
+    ('/entry/instrument/band_chopper/delay', 'band_chopper:Delay', 'dream_choppers', 'ns'),
+    ('/entry/instrument/band_chopper/phase', 'band_chopper:Phs', 'dream_choppers', 'deg'),
+    ('/entry/instrument/band_chopper/rotation_speed', 'band_chopper:Spd', 'dream_choppers', 'Hz'),
+    ('/entry/instrument/band_chopper/rotation_speed_setpoint', 'band_chopper:SpdSet', 'dream_choppers', 'Hz'),
+    ('/entry/instrument/collimator/rotation/idle_flag', 'DREAM-Coll:MC-RotZ-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/collimator/rotation/target_value', 'DREAM-Coll:MC-RotZ-01:Mtr.VAL', 'dream_motion', 'deg'),
+    ('/entry/instrument/collimator/rotation/value', 'DREAM-Coll:MC-RotZ-01:Mtr.RBV', 'dream_motion', 'deg'),
+    ('/entry/instrument/collimator/z/idle_flag', 'DREAM-Coll:MC-LinZ-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/collimator/z/target_value', 'DREAM-Coll:MC-LinZ-01:Mtr.VAL', 'dream_motion', 'mm'),
+    ('/entry/instrument/collimator/z/value', 'DREAM-Coll:MC-LinZ-01:Mtr.RBV', 'dream_motion', 'mm'),
+    ('/entry/instrument/divergence_slit/x_center/idle_flag', 'DREAM-DivSl:MC-SlCenX-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/divergence_slit/x_center/target_value', 'DREAM-DivSl:MC-SlCenX-01:Mtr.VAL', 'dream_motion', 'mm'),
+    ('/entry/instrument/divergence_slit/x_center/value', 'DREAM-DivSl:MC-SlCenX-01:Mtr.RBV', 'dream_motion', 'mm'),
+    ('/entry/instrument/divergence_slit/x_gap/idle_flag', 'DREAM-DivSl:MC-SlGapX-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/divergence_slit/x_gap/target_value', 'DREAM-DivSl:MC-SlGapX-01:Mtr.VAL', 'dream_motion', 'mm'),
+    ('/entry/instrument/divergence_slit/x_gap/value', 'DREAM-DivSl:MC-SlGapX-01:Mtr.RBV', 'dream_motion', 'mm'),
+    ('/entry/instrument/divergence_slit/y_center/idle_flag', 'DREAM-DivSl:MC-SlCenY-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/divergence_slit/y_center/target_value', 'DREAM-DivSl:MC-SlCenY-01:Mtr.VAL', 'dream_motion', 'mm'),
+    ('/entry/instrument/divergence_slit/y_center/value', 'DREAM-DivSl:MC-SlCenY-01:Mtr.RBV', 'dream_motion', 'mm'),
+    ('/entry/instrument/divergence_slit/y_gap/idle_flag', 'DREAM-DivSl:MC-SlGapY-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/divergence_slit/y_gap/target_value', 'DREAM-DivSl:MC-SlGapY-01:Mtr.VAL', 'dream_motion', 'mm'),
+    ('/entry/instrument/divergence_slit/y_gap/value', 'DREAM-DivSl:MC-SlGapY-01:Mtr.RBV', 'dream_motion', 'mm'),
+    ('/entry/instrument/monitor_cave/monitor_positioner/idle_flag', 'DREAM-MonC:MC-LinZ-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/monitor_cave/monitor_positioner/target_value', 'DREAM-MonC:MC-LinZ-01:Mtr.VAL', 'dream_motion', 'mm'),
+    ('/entry/instrument/monitor_cave/monitor_positioner/value', 'DREAM-MonC:MC-LinZ-01:Mtr.RBV', 'dream_motion', 'mm'),
+    ('/entry/instrument/overlap_chopper/delay', 'overlap_chopper:Delay', 'dream_choppers', 'ns'),
+    ('/entry/instrument/overlap_chopper/phase', 'overlap_chopper:Phs', 'dream_choppers', 'deg'),
+    ('/entry/instrument/overlap_chopper/rotation_speed', 'overlap_chopper:Spd', 'dream_choppers', 'Hz'),
+    ('/entry/instrument/overlap_chopper/rotation_speed_setpoint', 'overlap_chopper:SpdSet', 'dream_choppers', 'Hz'),
+    ('/entry/instrument/polarizer/state/idle_flag', 'DREAM-Pol:MC-LinX-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/polarizer/state/target_value', 'DREAM-Pol:MC-LinX-01:Mtr.VAL', 'dream_motion', 'mm'),
+    ('/entry/instrument/polarizer/state/value', 'DREAM-Pol:MC-LinX-01:Mtr.RBV', 'dream_motion', 'mm'),
+    ('/entry/instrument/pulse_shaping_chopper1/delay', 'pulse_shaping_chopper1:Delay', 'dream_choppers', 'ns'),
+    ('/entry/instrument/pulse_shaping_chopper1/phase', 'pulse_shaping_chopper1:Phs', 'dream_choppers', 'deg'),
+    ('/entry/instrument/pulse_shaping_chopper1/rotation_speed', 'pulse_shaping_chopper1:Spd', 'dream_choppers', 'Hz'),
+    ('/entry/instrument/pulse_shaping_chopper1/rotation_speed_setpoint', 'pulse_shaping_chopper1:SpdSet', 'dream_choppers', 'Hz'),
+    ('/entry/instrument/pulse_shaping_chopper2/delay', 'pulse_shaping_chopper2:Delay', 'dream_choppers', 'ns'),
+    ('/entry/instrument/pulse_shaping_chopper2/phase', 'pulse_shaping_chopper2:Phs', 'dream_choppers', 'deg'),
+    ('/entry/instrument/pulse_shaping_chopper2/rotation_speed', 'pulse_shaping_chopper2:Spd', 'dream_choppers', 'Hz'),
+    ('/entry/instrument/pulse_shaping_chopper2/rotation_speed_setpoint', 'pulse_shaping_chopper2:SpdSet', 'dream_choppers', 'Hz'),
+    ('/entry/instrument/sample_stage/omega/idle_flag', 'DREAM-Smpl:MC-RotZ-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/omega/target_value', 'DREAM-Smpl:MC-RotZ-01:Mtr.VAL', 'dream_motion', 'deg'),
+    ('/entry/instrument/sample_stage/omega/value', 'DREAM-Smpl:MC-RotZ-01:Mtr.RBV', 'dream_motion', 'deg'),
+    ('/entry/instrument/sample_stage/x/idle_flag', 'DREAM-Smpl:MC-LinX-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/x/target_value', 'DREAM-Smpl:MC-LinX-01:Mtr.VAL', 'dream_motion', 'mm'),
+    ('/entry/instrument/sample_stage/x/value', 'DREAM-Smpl:MC-LinX-01:Mtr.RBV', 'dream_motion', 'mm'),
+    ('/entry/instrument/sample_stage/y/idle_flag', 'DREAM-Smpl:MC-LinY-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/y/target_value', 'DREAM-Smpl:MC-LinY-01:Mtr.VAL', 'dream_motion', 'mm'),
+    ('/entry/instrument/sample_stage/y/value', 'DREAM-Smpl:MC-LinY-01:Mtr.RBV', 'dream_motion', 'mm'),
+    ('/entry/instrument/sample_stage/z/idle_flag', 'DREAM-Smpl:MC-LinZ-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/z/target_value', 'DREAM-Smpl:MC-LinZ-01:Mtr.VAL', 'dream_motion', 'mm'),
+    ('/entry/instrument/sample_stage/z/value', 'DREAM-Smpl:MC-LinZ-01:Mtr.RBV', 'dream_motion', 'mm'),
+    ('/entry/sample/magnetic_field', 'DREAM-SE:Mag-PSU-101', 'dream_sample_env', 'T'),
+    ('/entry/sample/pressure', 'DREAM-SE:Prs-PIC-101', 'dream_sample_env', 'bar'),
+    ('/entry/sample/temperature_1', 'DREAM-SE:Tmp-TIC-101', 'dream_sample_env', 'K'),
+    ('/entry/sample/temperature_2', 'DREAM-SE:Tmp-TIC-102', 'dream_sample_env', 'K'),
+    ('/entry/sample/temperature_3', 'DREAM-SE:Tmp-TIC-103', 'dream_sample_env', 'K'),
+    ('/entry/vacuum/gauge_1', 'DREAM-Vac:VGP-001', 'dream_vacuum', 'mbar'),
+    ('/entry/vacuum/gauge_2', 'DREAM-Vac:VGP-002', 'dream_vacuum', 'mbar'),
+    ('/entry/vacuum/gauge_3', 'DREAM-Vac:VGP-003', 'dream_vacuum', 'mbar'),
+)
+
 PARSED_STREAMS: dict[str, F144Stream] = {
-    '/entry/instrument/T0_chopper/delay': F144Stream(
-        nexus_path='/entry/instrument/T0_chopper/delay',
-        source='T0_chopper:Delay',
-        topic='dream_choppers',
-        units='ns',
-    ),
-    '/entry/instrument/T0_chopper/phase': F144Stream(
-        nexus_path='/entry/instrument/T0_chopper/phase',
-        source='T0_chopper:Phs',
-        topic='dream_choppers',
-        units='deg',
-    ),
-    '/entry/instrument/T0_chopper/rotation_speed': F144Stream(
-        nexus_path='/entry/instrument/T0_chopper/rotation_speed',
-        source='T0_chopper:Spd',
-        topic='dream_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/T0_chopper/rotation_speed_setpoint': F144Stream(
-        nexus_path='/entry/instrument/T0_chopper/rotation_speed_setpoint',
-        source='T0_chopper:SpdSet',
-        topic='dream_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/band_chopper/delay': F144Stream(
-        nexus_path='/entry/instrument/band_chopper/delay',
-        source='band_chopper:Delay',
-        topic='dream_choppers',
-        units='ns',
-    ),
-    '/entry/instrument/band_chopper/phase': F144Stream(
-        nexus_path='/entry/instrument/band_chopper/phase',
-        source='band_chopper:Phs',
-        topic='dream_choppers',
-        units='deg',
-    ),
-    '/entry/instrument/band_chopper/rotation_speed': F144Stream(
-        nexus_path='/entry/instrument/band_chopper/rotation_speed',
-        source='band_chopper:Spd',
-        topic='dream_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/band_chopper/rotation_speed_setpoint': F144Stream(
-        nexus_path='/entry/instrument/band_chopper/rotation_speed_setpoint',
-        source='band_chopper:SpdSet',
-        topic='dream_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/collimator/rotation/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/collimator/rotation/idle_flag',
-        source='DREAM-Coll:MC-RotZ-01:Mtr.DMOV',
-        topic='dream_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/collimator/rotation/target_value': F144Stream(
-        nexus_path='/entry/instrument/collimator/rotation/target_value',
-        source='DREAM-Coll:MC-RotZ-01:Mtr.VAL',
-        topic='dream_motion',
-        units='deg',
-    ),
-    '/entry/instrument/collimator/rotation/value': F144Stream(
-        nexus_path='/entry/instrument/collimator/rotation/value',
-        source='DREAM-Coll:MC-RotZ-01:Mtr.RBV',
-        topic='dream_motion',
-        units='deg',
-    ),
-    '/entry/instrument/collimator/z/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/collimator/z/idle_flag',
-        source='DREAM-Coll:MC-LinZ-01:Mtr.DMOV',
-        topic='dream_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/collimator/z/target_value': F144Stream(
-        nexus_path='/entry/instrument/collimator/z/target_value',
-        source='DREAM-Coll:MC-LinZ-01:Mtr.VAL',
-        topic='dream_motion',
-        units='mm',
-    ),
-    '/entry/instrument/collimator/z/value': F144Stream(
-        nexus_path='/entry/instrument/collimator/z/value',
-        source='DREAM-Coll:MC-LinZ-01:Mtr.RBV',
-        topic='dream_motion',
-        units='mm',
-    ),
-    '/entry/instrument/divergence_slit/x_center/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/divergence_slit/x_center/idle_flag',
-        source='DREAM-DivSl:MC-SlCenX-01:Mtr.DMOV',
-        topic='dream_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/divergence_slit/x_center/target_value': F144Stream(
-        nexus_path='/entry/instrument/divergence_slit/x_center/target_value',
-        source='DREAM-DivSl:MC-SlCenX-01:Mtr.VAL',
-        topic='dream_motion',
-        units='mm',
-    ),
-    '/entry/instrument/divergence_slit/x_center/value': F144Stream(
-        nexus_path='/entry/instrument/divergence_slit/x_center/value',
-        source='DREAM-DivSl:MC-SlCenX-01:Mtr.RBV',
-        topic='dream_motion',
-        units='mm',
-    ),
-    '/entry/instrument/divergence_slit/x_gap/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/divergence_slit/x_gap/idle_flag',
-        source='DREAM-DivSl:MC-SlGapX-01:Mtr.DMOV',
-        topic='dream_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/divergence_slit/x_gap/target_value': F144Stream(
-        nexus_path='/entry/instrument/divergence_slit/x_gap/target_value',
-        source='DREAM-DivSl:MC-SlGapX-01:Mtr.VAL',
-        topic='dream_motion',
-        units='mm',
-    ),
-    '/entry/instrument/divergence_slit/x_gap/value': F144Stream(
-        nexus_path='/entry/instrument/divergence_slit/x_gap/value',
-        source='DREAM-DivSl:MC-SlGapX-01:Mtr.RBV',
-        topic='dream_motion',
-        units='mm',
-    ),
-    '/entry/instrument/divergence_slit/y_center/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/divergence_slit/y_center/idle_flag',
-        source='DREAM-DivSl:MC-SlCenY-01:Mtr.DMOV',
-        topic='dream_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/divergence_slit/y_center/target_value': F144Stream(
-        nexus_path='/entry/instrument/divergence_slit/y_center/target_value',
-        source='DREAM-DivSl:MC-SlCenY-01:Mtr.VAL',
-        topic='dream_motion',
-        units='mm',
-    ),
-    '/entry/instrument/divergence_slit/y_center/value': F144Stream(
-        nexus_path='/entry/instrument/divergence_slit/y_center/value',
-        source='DREAM-DivSl:MC-SlCenY-01:Mtr.RBV',
-        topic='dream_motion',
-        units='mm',
-    ),
-    '/entry/instrument/divergence_slit/y_gap/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/divergence_slit/y_gap/idle_flag',
-        source='DREAM-DivSl:MC-SlGapY-01:Mtr.DMOV',
-        topic='dream_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/divergence_slit/y_gap/target_value': F144Stream(
-        nexus_path='/entry/instrument/divergence_slit/y_gap/target_value',
-        source='DREAM-DivSl:MC-SlGapY-01:Mtr.VAL',
-        topic='dream_motion',
-        units='mm',
-    ),
-    '/entry/instrument/divergence_slit/y_gap/value': F144Stream(
-        nexus_path='/entry/instrument/divergence_slit/y_gap/value',
-        source='DREAM-DivSl:MC-SlGapY-01:Mtr.RBV',
-        topic='dream_motion',
-        units='mm',
-    ),
-    '/entry/instrument/monitor_cave/monitor_positioner/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/monitor_cave/monitor_positioner/idle_flag',
-        source='DREAM-MonC:MC-LinZ-01:Mtr.DMOV',
-        topic='dream_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/monitor_cave/monitor_positioner/target_value': F144Stream(
-        nexus_path='/entry/instrument/monitor_cave/monitor_positioner/target_value',
-        source='DREAM-MonC:MC-LinZ-01:Mtr.VAL',
-        topic='dream_motion',
-        units='mm',
-    ),
-    '/entry/instrument/monitor_cave/monitor_positioner/value': F144Stream(
-        nexus_path='/entry/instrument/monitor_cave/monitor_positioner/value',
-        source='DREAM-MonC:MC-LinZ-01:Mtr.RBV',
-        topic='dream_motion',
-        units='mm',
-    ),
-    '/entry/instrument/overlap_chopper/delay': F144Stream(
-        nexus_path='/entry/instrument/overlap_chopper/delay',
-        source='overlap_chopper:Delay',
-        topic='dream_choppers',
-        units='ns',
-    ),
-    '/entry/instrument/overlap_chopper/phase': F144Stream(
-        nexus_path='/entry/instrument/overlap_chopper/phase',
-        source='overlap_chopper:Phs',
-        topic='dream_choppers',
-        units='deg',
-    ),
-    '/entry/instrument/overlap_chopper/rotation_speed': F144Stream(
-        nexus_path='/entry/instrument/overlap_chopper/rotation_speed',
-        source='overlap_chopper:Spd',
-        topic='dream_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/overlap_chopper/rotation_speed_setpoint': F144Stream(
-        nexus_path='/entry/instrument/overlap_chopper/rotation_speed_setpoint',
-        source='overlap_chopper:SpdSet',
-        topic='dream_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/polarizer/state/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/polarizer/state/idle_flag',
-        source='DREAM-Pol:MC-LinX-01:Mtr.DMOV',
-        topic='dream_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/polarizer/state/target_value': F144Stream(
-        nexus_path='/entry/instrument/polarizer/state/target_value',
-        source='DREAM-Pol:MC-LinX-01:Mtr.VAL',
-        topic='dream_motion',
-        units='mm',
-    ),
-    '/entry/instrument/polarizer/state/value': F144Stream(
-        nexus_path='/entry/instrument/polarizer/state/value',
-        source='DREAM-Pol:MC-LinX-01:Mtr.RBV',
-        topic='dream_motion',
-        units='mm',
-    ),
-    '/entry/instrument/pulse_shaping_chopper1/delay': F144Stream(
-        nexus_path='/entry/instrument/pulse_shaping_chopper1/delay',
-        source='pulse_shaping_chopper1:Delay',
-        topic='dream_choppers',
-        units='ns',
-    ),
-    '/entry/instrument/pulse_shaping_chopper1/phase': F144Stream(
-        nexus_path='/entry/instrument/pulse_shaping_chopper1/phase',
-        source='pulse_shaping_chopper1:Phs',
-        topic='dream_choppers',
-        units='deg',
-    ),
-    '/entry/instrument/pulse_shaping_chopper1/rotation_speed': F144Stream(
-        nexus_path='/entry/instrument/pulse_shaping_chopper1/rotation_speed',
-        source='pulse_shaping_chopper1:Spd',
-        topic='dream_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/pulse_shaping_chopper1/rotation_speed_setpoint': F144Stream(
-        nexus_path='/entry/instrument/pulse_shaping_chopper1/rotation_speed_setpoint',
-        source='pulse_shaping_chopper1:SpdSet',
-        topic='dream_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/pulse_shaping_chopper2/delay': F144Stream(
-        nexus_path='/entry/instrument/pulse_shaping_chopper2/delay',
-        source='pulse_shaping_chopper2:Delay',
-        topic='dream_choppers',
-        units='ns',
-    ),
-    '/entry/instrument/pulse_shaping_chopper2/phase': F144Stream(
-        nexus_path='/entry/instrument/pulse_shaping_chopper2/phase',
-        source='pulse_shaping_chopper2:Phs',
-        topic='dream_choppers',
-        units='deg',
-    ),
-    '/entry/instrument/pulse_shaping_chopper2/rotation_speed': F144Stream(
-        nexus_path='/entry/instrument/pulse_shaping_chopper2/rotation_speed',
-        source='pulse_shaping_chopper2:Spd',
-        topic='dream_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/pulse_shaping_chopper2/rotation_speed_setpoint': F144Stream(
-        nexus_path='/entry/instrument/pulse_shaping_chopper2/rotation_speed_setpoint',
-        source='pulse_shaping_chopper2:SpdSet',
-        topic='dream_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/sample_stage/omega/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/omega/idle_flag',
-        source='DREAM-Smpl:MC-RotZ-01:Mtr.DMOV',
-        topic='dream_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/sample_stage/omega/target_value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/omega/target_value',
-        source='DREAM-Smpl:MC-RotZ-01:Mtr.VAL',
-        topic='dream_motion',
-        units='deg',
-    ),
-    '/entry/instrument/sample_stage/omega/value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/omega/value',
-        source='DREAM-Smpl:MC-RotZ-01:Mtr.RBV',
-        topic='dream_motion',
-        units='deg',
-    ),
-    '/entry/instrument/sample_stage/x/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/x/idle_flag',
-        source='DREAM-Smpl:MC-LinX-01:Mtr.DMOV',
-        topic='dream_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/sample_stage/x/target_value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/x/target_value',
-        source='DREAM-Smpl:MC-LinX-01:Mtr.VAL',
-        topic='dream_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/x/value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/x/value',
-        source='DREAM-Smpl:MC-LinX-01:Mtr.RBV',
-        topic='dream_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/y/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/y/idle_flag',
-        source='DREAM-Smpl:MC-LinY-01:Mtr.DMOV',
-        topic='dream_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/sample_stage/y/target_value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/y/target_value',
-        source='DREAM-Smpl:MC-LinY-01:Mtr.VAL',
-        topic='dream_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/y/value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/y/value',
-        source='DREAM-Smpl:MC-LinY-01:Mtr.RBV',
-        topic='dream_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/z/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/z/idle_flag',
-        source='DREAM-Smpl:MC-LinZ-01:Mtr.DMOV',
-        topic='dream_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/sample_stage/z/target_value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/z/target_value',
-        source='DREAM-Smpl:MC-LinZ-01:Mtr.VAL',
-        topic='dream_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/z/value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/z/value',
-        source='DREAM-Smpl:MC-LinZ-01:Mtr.RBV',
-        topic='dream_motion',
-        units='mm',
-    ),
-    '/entry/sample/magnetic_field': F144Stream(
-        nexus_path='/entry/sample/magnetic_field',
-        source='DREAM-SE:Mag-PSU-101',
-        topic='dream_sample_env',
-        units='T',
-    ),
-    '/entry/sample/pressure': F144Stream(
-        nexus_path='/entry/sample/pressure',
-        source='DREAM-SE:Prs-PIC-101',
-        topic='dream_sample_env',
-        units='bar',
-    ),
-    '/entry/sample/temperature_1': F144Stream(
-        nexus_path='/entry/sample/temperature_1',
-        source='DREAM-SE:Tmp-TIC-101',
-        topic='dream_sample_env',
-        units='K',
-    ),
-    '/entry/sample/temperature_2': F144Stream(
-        nexus_path='/entry/sample/temperature_2',
-        source='DREAM-SE:Tmp-TIC-102',
-        topic='dream_sample_env',
-        units='K',
-    ),
-    '/entry/sample/temperature_3': F144Stream(
-        nexus_path='/entry/sample/temperature_3',
-        source='DREAM-SE:Tmp-TIC-103',
-        topic='dream_sample_env',
-        units='K',
-    ),
-    '/entry/vacuum/gauge_1': F144Stream(
-        nexus_path='/entry/vacuum/gauge_1',
-        source='DREAM-Vac:VGP-001',
-        topic='dream_vacuum',
-        units='mbar',
-    ),
-    '/entry/vacuum/gauge_2': F144Stream(
-        nexus_path='/entry/vacuum/gauge_2',
-        source='DREAM-Vac:VGP-002',
-        topic='dream_vacuum',
-        units='mbar',
-    ),
-    '/entry/vacuum/gauge_3': F144Stream(
-        nexus_path='/entry/vacuum/gauge_3',
-        source='DREAM-Vac:VGP-003',
-        topic='dream_vacuum',
-        units='mbar',
-    ),
+    path: F144Stream(nexus_path=path, source=source, topic=topic, units=units)
+    for path, source, topic, units in _ROWS
 }

@@ -6,329 +6,65 @@ Source artifact: geometry-estia-<date>.nxs (synthesized)
 
 from esslivedata_tpu.config.stream import F144Stream
 
+# (nexus_path, source, topic, units)
+_ROWS: tuple[tuple[str, str, str, str | None], ...] = (
+    ('/entry/instrument/chopper_1/delay', 'ESTIA-Chop:C1:Delay', 'estia_choppers', 'ns'),
+    ('/entry/instrument/chopper_1/phase', 'ESTIA-Chop:C1:Phs', 'estia_choppers', 'deg'),
+    ('/entry/instrument/chopper_1/rotation_speed', 'ESTIA-Chop:C1:Spd', 'estia_choppers', 'Hz'),
+    ('/entry/instrument/chopper_1/rotation_speed_setpoint', 'ESTIA-Chop:C1:SpdSet', 'estia_choppers', 'Hz'),
+    ('/entry/instrument/chopper_2/delay', 'ESTIA-Chop:C2:Delay', 'estia_choppers', 'ns'),
+    ('/entry/instrument/chopper_2/phase', 'ESTIA-Chop:C2:Phs', 'estia_choppers', 'deg'),
+    ('/entry/instrument/chopper_2/rotation_speed', 'ESTIA-Chop:C2:Spd', 'estia_choppers', 'Hz'),
+    ('/entry/instrument/chopper_2/rotation_speed_setpoint', 'ESTIA-Chop:C2:SpdSet', 'estia_choppers', 'Hz'),
+    ('/entry/instrument/detector_arm/two_theta/idle_flag', 'ESTIA-DetArm:MC-RotZ-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/detector_arm/two_theta/target_value', 'ESTIA-DetArm:MC-RotZ-01:Mtr.VAL', 'estia_motion', 'deg'),
+    ('/entry/instrument/detector_arm/two_theta/value', 'ESTIA-DetArm:MC-RotZ-01:Mtr.RBV', 'estia_motion', 'deg'),
+    ('/entry/instrument/sample_stage/chi/idle_flag', 'ESTIA-Smpl:MC-RotX-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/chi/target_value', 'ESTIA-Smpl:MC-RotX-01:Mtr.VAL', 'estia_motion', 'deg'),
+    ('/entry/instrument/sample_stage/chi/value', 'ESTIA-Smpl:MC-RotX-01:Mtr.RBV', 'estia_motion', 'deg'),
+    ('/entry/instrument/sample_stage/omega/idle_flag', 'ESTIA-Smpl:MC-RotZ-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/omega/target_value', 'ESTIA-Smpl:MC-RotZ-01:Mtr.VAL', 'estia_motion', 'deg'),
+    ('/entry/instrument/sample_stage/omega/value', 'ESTIA-Smpl:MC-RotZ-01:Mtr.RBV', 'estia_motion', 'deg'),
+    ('/entry/instrument/sample_stage/x/idle_flag', 'ESTIA-Smpl:MC-LinX-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/x/target_value', 'ESTIA-Smpl:MC-LinX-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/sample_stage/x/value', 'ESTIA-Smpl:MC-LinX-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/instrument/sample_stage/y/idle_flag', 'ESTIA-Smpl:MC-LinY-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/y/target_value', 'ESTIA-Smpl:MC-LinY-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/sample_stage/y/value', 'ESTIA-Smpl:MC-LinY-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/instrument/sample_stage/z/idle_flag', 'ESTIA-Smpl:MC-LinZ-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/z/target_value', 'ESTIA-Smpl:MC-LinZ-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/sample_stage/z/value', 'ESTIA-Smpl:MC-LinZ-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_1/x_center/idle_flag', 'ESTIA-Sl1:MC-SlCenX-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/slit_1/x_center/target_value', 'ESTIA-Sl1:MC-SlCenX-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_1/x_center/value', 'ESTIA-Sl1:MC-SlCenX-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_1/x_gap/idle_flag', 'ESTIA-Sl1:MC-SlGapX-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/slit_1/x_gap/target_value', 'ESTIA-Sl1:MC-SlGapX-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_1/x_gap/value', 'ESTIA-Sl1:MC-SlGapX-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_1/y_center/idle_flag', 'ESTIA-Sl1:MC-SlCenY-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/slit_1/y_center/target_value', 'ESTIA-Sl1:MC-SlCenY-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_1/y_center/value', 'ESTIA-Sl1:MC-SlCenY-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_1/y_gap/idle_flag', 'ESTIA-Sl1:MC-SlGapY-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/slit_1/y_gap/target_value', 'ESTIA-Sl1:MC-SlGapY-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_1/y_gap/value', 'ESTIA-Sl1:MC-SlGapY-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_2/x_center/idle_flag', 'ESTIA-Sl2:MC-SlCenX-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/slit_2/x_center/target_value', 'ESTIA-Sl2:MC-SlCenX-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_2/x_center/value', 'ESTIA-Sl2:MC-SlCenX-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_2/x_gap/idle_flag', 'ESTIA-Sl2:MC-SlGapX-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/slit_2/x_gap/target_value', 'ESTIA-Sl2:MC-SlGapX-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_2/x_gap/value', 'ESTIA-Sl2:MC-SlGapX-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_2/y_center/idle_flag', 'ESTIA-Sl2:MC-SlCenY-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/slit_2/y_center/target_value', 'ESTIA-Sl2:MC-SlCenY-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_2/y_center/value', 'ESTIA-Sl2:MC-SlCenY-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_2/y_gap/idle_flag', 'ESTIA-Sl2:MC-SlGapY-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/slit_2/y_gap/target_value', 'ESTIA-Sl2:MC-SlGapY-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_2/y_gap/value', 'ESTIA-Sl2:MC-SlGapY-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/sample/magnetic_field', 'ESTIA-SE:Mag-PSU-101', 'estia_sample_env', 'T'),
+    ('/entry/sample/pressure', 'ESTIA-SE:Prs-PIC-101', 'estia_sample_env', 'bar'),
+    ('/entry/sample/temperature_1', 'ESTIA-SE:Tmp-TIC-101', 'estia_sample_env', 'K'),
+    ('/entry/sample/temperature_2', 'ESTIA-SE:Tmp-TIC-102', 'estia_sample_env', 'K'),
+)
+
 PARSED_STREAMS: dict[str, F144Stream] = {
-    '/entry/instrument/chopper_1/delay': F144Stream(
-        nexus_path='/entry/instrument/chopper_1/delay',
-        source='ESTIA-Chop:C1:Delay',
-        topic='estia_choppers',
-        units='ns',
-    ),
-    '/entry/instrument/chopper_1/phase': F144Stream(
-        nexus_path='/entry/instrument/chopper_1/phase',
-        source='ESTIA-Chop:C1:Phs',
-        topic='estia_choppers',
-        units='deg',
-    ),
-    '/entry/instrument/chopper_1/rotation_speed': F144Stream(
-        nexus_path='/entry/instrument/chopper_1/rotation_speed',
-        source='ESTIA-Chop:C1:Spd',
-        topic='estia_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/chopper_1/rotation_speed_setpoint': F144Stream(
-        nexus_path='/entry/instrument/chopper_1/rotation_speed_setpoint',
-        source='ESTIA-Chop:C1:SpdSet',
-        topic='estia_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/chopper_2/delay': F144Stream(
-        nexus_path='/entry/instrument/chopper_2/delay',
-        source='ESTIA-Chop:C2:Delay',
-        topic='estia_choppers',
-        units='ns',
-    ),
-    '/entry/instrument/chopper_2/phase': F144Stream(
-        nexus_path='/entry/instrument/chopper_2/phase',
-        source='ESTIA-Chop:C2:Phs',
-        topic='estia_choppers',
-        units='deg',
-    ),
-    '/entry/instrument/chopper_2/rotation_speed': F144Stream(
-        nexus_path='/entry/instrument/chopper_2/rotation_speed',
-        source='ESTIA-Chop:C2:Spd',
-        topic='estia_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/chopper_2/rotation_speed_setpoint': F144Stream(
-        nexus_path='/entry/instrument/chopper_2/rotation_speed_setpoint',
-        source='ESTIA-Chop:C2:SpdSet',
-        topic='estia_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/detector_arm/two_theta/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/detector_arm/two_theta/idle_flag',
-        source='ESTIA-DetArm:MC-RotZ-01:Mtr.DMOV',
-        topic='estia_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/detector_arm/two_theta/target_value': F144Stream(
-        nexus_path='/entry/instrument/detector_arm/two_theta/target_value',
-        source='ESTIA-DetArm:MC-RotZ-01:Mtr.VAL',
-        topic='estia_motion',
-        units='deg',
-    ),
-    '/entry/instrument/detector_arm/two_theta/value': F144Stream(
-        nexus_path='/entry/instrument/detector_arm/two_theta/value',
-        source='ESTIA-DetArm:MC-RotZ-01:Mtr.RBV',
-        topic='estia_motion',
-        units='deg',
-    ),
-    '/entry/instrument/sample_stage/chi/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/chi/idle_flag',
-        source='ESTIA-Smpl:MC-RotX-01:Mtr.DMOV',
-        topic='estia_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/sample_stage/chi/target_value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/chi/target_value',
-        source='ESTIA-Smpl:MC-RotX-01:Mtr.VAL',
-        topic='estia_motion',
-        units='deg',
-    ),
-    '/entry/instrument/sample_stage/chi/value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/chi/value',
-        source='ESTIA-Smpl:MC-RotX-01:Mtr.RBV',
-        topic='estia_motion',
-        units='deg',
-    ),
-    '/entry/instrument/sample_stage/omega/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/omega/idle_flag',
-        source='ESTIA-Smpl:MC-RotZ-01:Mtr.DMOV',
-        topic='estia_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/sample_stage/omega/target_value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/omega/target_value',
-        source='ESTIA-Smpl:MC-RotZ-01:Mtr.VAL',
-        topic='estia_motion',
-        units='deg',
-    ),
-    '/entry/instrument/sample_stage/omega/value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/omega/value',
-        source='ESTIA-Smpl:MC-RotZ-01:Mtr.RBV',
-        topic='estia_motion',
-        units='deg',
-    ),
-    '/entry/instrument/sample_stage/x/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/x/idle_flag',
-        source='ESTIA-Smpl:MC-LinX-01:Mtr.DMOV',
-        topic='estia_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/sample_stage/x/target_value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/x/target_value',
-        source='ESTIA-Smpl:MC-LinX-01:Mtr.VAL',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/x/value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/x/value',
-        source='ESTIA-Smpl:MC-LinX-01:Mtr.RBV',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/y/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/y/idle_flag',
-        source='ESTIA-Smpl:MC-LinY-01:Mtr.DMOV',
-        topic='estia_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/sample_stage/y/target_value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/y/target_value',
-        source='ESTIA-Smpl:MC-LinY-01:Mtr.VAL',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/y/value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/y/value',
-        source='ESTIA-Smpl:MC-LinY-01:Mtr.RBV',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/z/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/z/idle_flag',
-        source='ESTIA-Smpl:MC-LinZ-01:Mtr.DMOV',
-        topic='estia_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/sample_stage/z/target_value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/z/target_value',
-        source='ESTIA-Smpl:MC-LinZ-01:Mtr.VAL',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/z/value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/z/value',
-        source='ESTIA-Smpl:MC-LinZ-01:Mtr.RBV',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/slit_1/x_center/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/slit_1/x_center/idle_flag',
-        source='ESTIA-Sl1:MC-SlCenX-01:Mtr.DMOV',
-        topic='estia_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/slit_1/x_center/target_value': F144Stream(
-        nexus_path='/entry/instrument/slit_1/x_center/target_value',
-        source='ESTIA-Sl1:MC-SlCenX-01:Mtr.VAL',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/slit_1/x_center/value': F144Stream(
-        nexus_path='/entry/instrument/slit_1/x_center/value',
-        source='ESTIA-Sl1:MC-SlCenX-01:Mtr.RBV',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/slit_1/x_gap/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/slit_1/x_gap/idle_flag',
-        source='ESTIA-Sl1:MC-SlGapX-01:Mtr.DMOV',
-        topic='estia_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/slit_1/x_gap/target_value': F144Stream(
-        nexus_path='/entry/instrument/slit_1/x_gap/target_value',
-        source='ESTIA-Sl1:MC-SlGapX-01:Mtr.VAL',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/slit_1/x_gap/value': F144Stream(
-        nexus_path='/entry/instrument/slit_1/x_gap/value',
-        source='ESTIA-Sl1:MC-SlGapX-01:Mtr.RBV',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/slit_1/y_center/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/slit_1/y_center/idle_flag',
-        source='ESTIA-Sl1:MC-SlCenY-01:Mtr.DMOV',
-        topic='estia_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/slit_1/y_center/target_value': F144Stream(
-        nexus_path='/entry/instrument/slit_1/y_center/target_value',
-        source='ESTIA-Sl1:MC-SlCenY-01:Mtr.VAL',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/slit_1/y_center/value': F144Stream(
-        nexus_path='/entry/instrument/slit_1/y_center/value',
-        source='ESTIA-Sl1:MC-SlCenY-01:Mtr.RBV',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/slit_1/y_gap/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/slit_1/y_gap/idle_flag',
-        source='ESTIA-Sl1:MC-SlGapY-01:Mtr.DMOV',
-        topic='estia_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/slit_1/y_gap/target_value': F144Stream(
-        nexus_path='/entry/instrument/slit_1/y_gap/target_value',
-        source='ESTIA-Sl1:MC-SlGapY-01:Mtr.VAL',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/slit_1/y_gap/value': F144Stream(
-        nexus_path='/entry/instrument/slit_1/y_gap/value',
-        source='ESTIA-Sl1:MC-SlGapY-01:Mtr.RBV',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/slit_2/x_center/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/slit_2/x_center/idle_flag',
-        source='ESTIA-Sl2:MC-SlCenX-01:Mtr.DMOV',
-        topic='estia_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/slit_2/x_center/target_value': F144Stream(
-        nexus_path='/entry/instrument/slit_2/x_center/target_value',
-        source='ESTIA-Sl2:MC-SlCenX-01:Mtr.VAL',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/slit_2/x_center/value': F144Stream(
-        nexus_path='/entry/instrument/slit_2/x_center/value',
-        source='ESTIA-Sl2:MC-SlCenX-01:Mtr.RBV',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/slit_2/x_gap/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/slit_2/x_gap/idle_flag',
-        source='ESTIA-Sl2:MC-SlGapX-01:Mtr.DMOV',
-        topic='estia_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/slit_2/x_gap/target_value': F144Stream(
-        nexus_path='/entry/instrument/slit_2/x_gap/target_value',
-        source='ESTIA-Sl2:MC-SlGapX-01:Mtr.VAL',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/slit_2/x_gap/value': F144Stream(
-        nexus_path='/entry/instrument/slit_2/x_gap/value',
-        source='ESTIA-Sl2:MC-SlGapX-01:Mtr.RBV',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/slit_2/y_center/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/slit_2/y_center/idle_flag',
-        source='ESTIA-Sl2:MC-SlCenY-01:Mtr.DMOV',
-        topic='estia_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/slit_2/y_center/target_value': F144Stream(
-        nexus_path='/entry/instrument/slit_2/y_center/target_value',
-        source='ESTIA-Sl2:MC-SlCenY-01:Mtr.VAL',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/slit_2/y_center/value': F144Stream(
-        nexus_path='/entry/instrument/slit_2/y_center/value',
-        source='ESTIA-Sl2:MC-SlCenY-01:Mtr.RBV',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/slit_2/y_gap/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/slit_2/y_gap/idle_flag',
-        source='ESTIA-Sl2:MC-SlGapY-01:Mtr.DMOV',
-        topic='estia_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/slit_2/y_gap/target_value': F144Stream(
-        nexus_path='/entry/instrument/slit_2/y_gap/target_value',
-        source='ESTIA-Sl2:MC-SlGapY-01:Mtr.VAL',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/instrument/slit_2/y_gap/value': F144Stream(
-        nexus_path='/entry/instrument/slit_2/y_gap/value',
-        source='ESTIA-Sl2:MC-SlGapY-01:Mtr.RBV',
-        topic='estia_motion',
-        units='mm',
-    ),
-    '/entry/sample/magnetic_field': F144Stream(
-        nexus_path='/entry/sample/magnetic_field',
-        source='ESTIA-SE:Mag-PSU-101',
-        topic='estia_sample_env',
-        units='T',
-    ),
-    '/entry/sample/pressure': F144Stream(
-        nexus_path='/entry/sample/pressure',
-        source='ESTIA-SE:Prs-PIC-101',
-        topic='estia_sample_env',
-        units='bar',
-    ),
-    '/entry/sample/temperature_1': F144Stream(
-        nexus_path='/entry/sample/temperature_1',
-        source='ESTIA-SE:Tmp-TIC-101',
-        topic='estia_sample_env',
-        units='K',
-    ),
-    '/entry/sample/temperature_2': F144Stream(
-        nexus_path='/entry/sample/temperature_2',
-        source='ESTIA-SE:Tmp-TIC-102',
-        topic='estia_sample_env',
-        units='K',
-    ),
+    path: F144Stream(nexus_path=path, source=source, topic=topic, units=units)
+    for path, source, topic, units in _ROWS
 }

@@ -6,347 +6,68 @@ Source artifact: geometry-odin-<date>.nxs (synthesized)
 
 from esslivedata_tpu.config.stream import F144Stream
 
+# (nexus_path, source, topic, units)
+_ROWS: tuple[tuple[str, str, str, str | None], ...] = (
+    ('/entry/instrument/camera_stage/focus/idle_flag', 'ODIN-Cam:MC-LinF-01:Mtr.DMOV', 'odin_motion', 'dimensionless'),
+    ('/entry/instrument/camera_stage/focus/target_value', 'ODIN-Cam:MC-LinF-01:Mtr.VAL', 'odin_motion', 'mm'),
+    ('/entry/instrument/camera_stage/focus/value', 'ODIN-Cam:MC-LinF-01:Mtr.RBV', 'odin_motion', 'mm'),
+    ('/entry/instrument/camera_stage/z/idle_flag', 'ODIN-Cam:MC-LinZ-01:Mtr.DMOV', 'odin_motion', 'dimensionless'),
+    ('/entry/instrument/camera_stage/z/target_value', 'ODIN-Cam:MC-LinZ-01:Mtr.VAL', 'odin_motion', 'mm'),
+    ('/entry/instrument/camera_stage/z/value', 'ODIN-Cam:MC-LinZ-01:Mtr.RBV', 'odin_motion', 'mm'),
+    ('/entry/instrument/pinhole_selector/x_center/idle_flag', 'ODIN-PinH:MC-SlCenX-01:Mtr.DMOV', 'odin_motion', 'dimensionless'),
+    ('/entry/instrument/pinhole_selector/x_center/target_value', 'ODIN-PinH:MC-SlCenX-01:Mtr.VAL', 'odin_motion', 'mm'),
+    ('/entry/instrument/pinhole_selector/x_center/value', 'ODIN-PinH:MC-SlCenX-01:Mtr.RBV', 'odin_motion', 'mm'),
+    ('/entry/instrument/pinhole_selector/x_gap/idle_flag', 'ODIN-PinH:MC-SlGapX-01:Mtr.DMOV', 'odin_motion', 'dimensionless'),
+    ('/entry/instrument/pinhole_selector/x_gap/target_value', 'ODIN-PinH:MC-SlGapX-01:Mtr.VAL', 'odin_motion', 'mm'),
+    ('/entry/instrument/pinhole_selector/x_gap/value', 'ODIN-PinH:MC-SlGapX-01:Mtr.RBV', 'odin_motion', 'mm'),
+    ('/entry/instrument/pinhole_selector/y_center/idle_flag', 'ODIN-PinH:MC-SlCenY-01:Mtr.DMOV', 'odin_motion', 'dimensionless'),
+    ('/entry/instrument/pinhole_selector/y_center/target_value', 'ODIN-PinH:MC-SlCenY-01:Mtr.VAL', 'odin_motion', 'mm'),
+    ('/entry/instrument/pinhole_selector/y_center/value', 'ODIN-PinH:MC-SlCenY-01:Mtr.RBV', 'odin_motion', 'mm'),
+    ('/entry/instrument/pinhole_selector/y_gap/idle_flag', 'ODIN-PinH:MC-SlGapY-01:Mtr.DMOV', 'odin_motion', 'dimensionless'),
+    ('/entry/instrument/pinhole_selector/y_gap/target_value', 'ODIN-PinH:MC-SlGapY-01:Mtr.VAL', 'odin_motion', 'mm'),
+    ('/entry/instrument/pinhole_selector/y_gap/value', 'ODIN-PinH:MC-SlGapY-01:Mtr.RBV', 'odin_motion', 'mm'),
+    ('/entry/instrument/sample_stage/omega/idle_flag', 'ODIN-Smpl:MC-RotZ-01:Mtr.DMOV', 'odin_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/omega/target_value', 'ODIN-Smpl:MC-RotZ-01:Mtr.VAL', 'odin_motion', 'deg'),
+    ('/entry/instrument/sample_stage/omega/value', 'ODIN-Smpl:MC-RotZ-01:Mtr.RBV', 'odin_motion', 'deg'),
+    ('/entry/instrument/sample_stage/phi/idle_flag', 'ODIN-Smpl:MC-RotX-01:Mtr.DMOV', 'odin_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/phi/target_value', 'ODIN-Smpl:MC-RotX-01:Mtr.VAL', 'odin_motion', 'deg'),
+    ('/entry/instrument/sample_stage/phi/value', 'ODIN-Smpl:MC-RotX-01:Mtr.RBV', 'odin_motion', 'deg'),
+    ('/entry/instrument/sample_stage/x/idle_flag', 'ODIN-Smpl:MC-LinX-01:Mtr.DMOV', 'odin_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/x/target_value', 'ODIN-Smpl:MC-LinX-01:Mtr.VAL', 'odin_motion', 'mm'),
+    ('/entry/instrument/sample_stage/x/value', 'ODIN-Smpl:MC-LinX-01:Mtr.RBV', 'odin_motion', 'mm'),
+    ('/entry/instrument/sample_stage/y/idle_flag', 'ODIN-Smpl:MC-LinY-01:Mtr.DMOV', 'odin_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/y/target_value', 'ODIN-Smpl:MC-LinY-01:Mtr.VAL', 'odin_motion', 'mm'),
+    ('/entry/instrument/sample_stage/y/value', 'ODIN-Smpl:MC-LinY-01:Mtr.RBV', 'odin_motion', 'mm'),
+    ('/entry/instrument/sample_stage/z/idle_flag', 'ODIN-Smpl:MC-LinZ-01:Mtr.DMOV', 'odin_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/z/target_value', 'ODIN-Smpl:MC-LinZ-01:Mtr.VAL', 'odin_motion', 'mm'),
+    ('/entry/instrument/sample_stage/z/value', 'ODIN-Smpl:MC-LinZ-01:Mtr.RBV', 'odin_motion', 'mm'),
+    ('/entry/instrument/wfm_chopper_1/delay', 'ODIN-Chop:WFM-01:Delay', 'odin_choppers', 'ns'),
+    ('/entry/instrument/wfm_chopper_1/phase', 'ODIN-Chop:WFM-01:Phs', 'odin_choppers', 'deg'),
+    ('/entry/instrument/wfm_chopper_1/rotation_speed', 'ODIN-Chop:WFM-01:Spd', 'odin_choppers', 'Hz'),
+    ('/entry/instrument/wfm_chopper_1/rotation_speed_setpoint', 'ODIN-Chop:WFM-01:SpdSet', 'odin_choppers', 'Hz'),
+    ('/entry/instrument/wfm_chopper_2/delay', 'ODIN-Chop:WFM-02:Delay', 'odin_choppers', 'ns'),
+    ('/entry/instrument/wfm_chopper_2/phase', 'ODIN-Chop:WFM-02:Phs', 'odin_choppers', 'deg'),
+    ('/entry/instrument/wfm_chopper_2/rotation_speed', 'ODIN-Chop:WFM-02:Spd', 'odin_choppers', 'Hz'),
+    ('/entry/instrument/wfm_chopper_2/rotation_speed_setpoint', 'ODIN-Chop:WFM-02:SpdSet', 'odin_choppers', 'Hz'),
+    ('/entry/instrument/wfm_chopper_3/delay', 'ODIN-Chop:WFM-03:Delay', 'odin_choppers', 'ns'),
+    ('/entry/instrument/wfm_chopper_3/phase', 'ODIN-Chop:WFM-03:Phs', 'odin_choppers', 'deg'),
+    ('/entry/instrument/wfm_chopper_3/rotation_speed', 'ODIN-Chop:WFM-03:Spd', 'odin_choppers', 'Hz'),
+    ('/entry/instrument/wfm_chopper_3/rotation_speed_setpoint', 'ODIN-Chop:WFM-03:SpdSet', 'odin_choppers', 'Hz'),
+    ('/entry/instrument/wfm_chopper_4/delay', 'ODIN-Chop:WFM-04:Delay', 'odin_choppers', 'ns'),
+    ('/entry/instrument/wfm_chopper_4/phase', 'ODIN-Chop:WFM-04:Phs', 'odin_choppers', 'deg'),
+    ('/entry/instrument/wfm_chopper_4/rotation_speed', 'ODIN-Chop:WFM-04:Spd', 'odin_choppers', 'Hz'),
+    ('/entry/instrument/wfm_chopper_4/rotation_speed_setpoint', 'ODIN-Chop:WFM-04:SpdSet', 'odin_choppers', 'Hz'),
+    ('/entry/sample/magnetic_field', 'ODIN-SE:Mag-PSU-101', 'odin_sample_env', 'T'),
+    ('/entry/sample/pressure', 'ODIN-SE:Prs-PIC-101', 'odin_sample_env', 'bar'),
+    ('/entry/sample/temperature_1', 'ODIN-SE:Tmp-TIC-101', 'odin_sample_env', 'K'),
+    ('/entry/sample/temperature_2', 'ODIN-SE:Tmp-TIC-102', 'odin_sample_env', 'K'),
+    ('/entry/vacuum/gauge_1', 'ODIN-Vac:VGP-001', 'odin_vacuum', 'mbar'),
+    ('/entry/vacuum/gauge_2', 'ODIN-Vac:VGP-002', 'odin_vacuum', 'mbar'),
+    ('/entry/vacuum/gauge_3', 'ODIN-Vac:VGP-003', 'odin_vacuum', 'mbar'),
+    ('/entry/vacuum/gauge_4', 'ODIN-Vac:VGP-004', 'odin_vacuum', 'mbar'),
+)
+
 PARSED_STREAMS: dict[str, F144Stream] = {
-    '/entry/instrument/camera_stage/focus/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/camera_stage/focus/idle_flag',
-        source='ODIN-Cam:MC-LinF-01:Mtr.DMOV',
-        topic='odin_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/camera_stage/focus/target_value': F144Stream(
-        nexus_path='/entry/instrument/camera_stage/focus/target_value',
-        source='ODIN-Cam:MC-LinF-01:Mtr.VAL',
-        topic='odin_motion',
-        units='mm',
-    ),
-    '/entry/instrument/camera_stage/focus/value': F144Stream(
-        nexus_path='/entry/instrument/camera_stage/focus/value',
-        source='ODIN-Cam:MC-LinF-01:Mtr.RBV',
-        topic='odin_motion',
-        units='mm',
-    ),
-    '/entry/instrument/camera_stage/z/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/camera_stage/z/idle_flag',
-        source='ODIN-Cam:MC-LinZ-01:Mtr.DMOV',
-        topic='odin_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/camera_stage/z/target_value': F144Stream(
-        nexus_path='/entry/instrument/camera_stage/z/target_value',
-        source='ODIN-Cam:MC-LinZ-01:Mtr.VAL',
-        topic='odin_motion',
-        units='mm',
-    ),
-    '/entry/instrument/camera_stage/z/value': F144Stream(
-        nexus_path='/entry/instrument/camera_stage/z/value',
-        source='ODIN-Cam:MC-LinZ-01:Mtr.RBV',
-        topic='odin_motion',
-        units='mm',
-    ),
-    '/entry/instrument/pinhole_selector/x_center/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/pinhole_selector/x_center/idle_flag',
-        source='ODIN-PinH:MC-SlCenX-01:Mtr.DMOV',
-        topic='odin_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/pinhole_selector/x_center/target_value': F144Stream(
-        nexus_path='/entry/instrument/pinhole_selector/x_center/target_value',
-        source='ODIN-PinH:MC-SlCenX-01:Mtr.VAL',
-        topic='odin_motion',
-        units='mm',
-    ),
-    '/entry/instrument/pinhole_selector/x_center/value': F144Stream(
-        nexus_path='/entry/instrument/pinhole_selector/x_center/value',
-        source='ODIN-PinH:MC-SlCenX-01:Mtr.RBV',
-        topic='odin_motion',
-        units='mm',
-    ),
-    '/entry/instrument/pinhole_selector/x_gap/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/pinhole_selector/x_gap/idle_flag',
-        source='ODIN-PinH:MC-SlGapX-01:Mtr.DMOV',
-        topic='odin_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/pinhole_selector/x_gap/target_value': F144Stream(
-        nexus_path='/entry/instrument/pinhole_selector/x_gap/target_value',
-        source='ODIN-PinH:MC-SlGapX-01:Mtr.VAL',
-        topic='odin_motion',
-        units='mm',
-    ),
-    '/entry/instrument/pinhole_selector/x_gap/value': F144Stream(
-        nexus_path='/entry/instrument/pinhole_selector/x_gap/value',
-        source='ODIN-PinH:MC-SlGapX-01:Mtr.RBV',
-        topic='odin_motion',
-        units='mm',
-    ),
-    '/entry/instrument/pinhole_selector/y_center/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/pinhole_selector/y_center/idle_flag',
-        source='ODIN-PinH:MC-SlCenY-01:Mtr.DMOV',
-        topic='odin_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/pinhole_selector/y_center/target_value': F144Stream(
-        nexus_path='/entry/instrument/pinhole_selector/y_center/target_value',
-        source='ODIN-PinH:MC-SlCenY-01:Mtr.VAL',
-        topic='odin_motion',
-        units='mm',
-    ),
-    '/entry/instrument/pinhole_selector/y_center/value': F144Stream(
-        nexus_path='/entry/instrument/pinhole_selector/y_center/value',
-        source='ODIN-PinH:MC-SlCenY-01:Mtr.RBV',
-        topic='odin_motion',
-        units='mm',
-    ),
-    '/entry/instrument/pinhole_selector/y_gap/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/pinhole_selector/y_gap/idle_flag',
-        source='ODIN-PinH:MC-SlGapY-01:Mtr.DMOV',
-        topic='odin_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/pinhole_selector/y_gap/target_value': F144Stream(
-        nexus_path='/entry/instrument/pinhole_selector/y_gap/target_value',
-        source='ODIN-PinH:MC-SlGapY-01:Mtr.VAL',
-        topic='odin_motion',
-        units='mm',
-    ),
-    '/entry/instrument/pinhole_selector/y_gap/value': F144Stream(
-        nexus_path='/entry/instrument/pinhole_selector/y_gap/value',
-        source='ODIN-PinH:MC-SlGapY-01:Mtr.RBV',
-        topic='odin_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/omega/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/omega/idle_flag',
-        source='ODIN-Smpl:MC-RotZ-01:Mtr.DMOV',
-        topic='odin_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/sample_stage/omega/target_value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/omega/target_value',
-        source='ODIN-Smpl:MC-RotZ-01:Mtr.VAL',
-        topic='odin_motion',
-        units='deg',
-    ),
-    '/entry/instrument/sample_stage/omega/value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/omega/value',
-        source='ODIN-Smpl:MC-RotZ-01:Mtr.RBV',
-        topic='odin_motion',
-        units='deg',
-    ),
-    '/entry/instrument/sample_stage/phi/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/phi/idle_flag',
-        source='ODIN-Smpl:MC-RotX-01:Mtr.DMOV',
-        topic='odin_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/sample_stage/phi/target_value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/phi/target_value',
-        source='ODIN-Smpl:MC-RotX-01:Mtr.VAL',
-        topic='odin_motion',
-        units='deg',
-    ),
-    '/entry/instrument/sample_stage/phi/value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/phi/value',
-        source='ODIN-Smpl:MC-RotX-01:Mtr.RBV',
-        topic='odin_motion',
-        units='deg',
-    ),
-    '/entry/instrument/sample_stage/x/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/x/idle_flag',
-        source='ODIN-Smpl:MC-LinX-01:Mtr.DMOV',
-        topic='odin_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/sample_stage/x/target_value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/x/target_value',
-        source='ODIN-Smpl:MC-LinX-01:Mtr.VAL',
-        topic='odin_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/x/value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/x/value',
-        source='ODIN-Smpl:MC-LinX-01:Mtr.RBV',
-        topic='odin_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/y/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/y/idle_flag',
-        source='ODIN-Smpl:MC-LinY-01:Mtr.DMOV',
-        topic='odin_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/sample_stage/y/target_value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/y/target_value',
-        source='ODIN-Smpl:MC-LinY-01:Mtr.VAL',
-        topic='odin_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/y/value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/y/value',
-        source='ODIN-Smpl:MC-LinY-01:Mtr.RBV',
-        topic='odin_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/z/idle_flag': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/z/idle_flag',
-        source='ODIN-Smpl:MC-LinZ-01:Mtr.DMOV',
-        topic='odin_motion',
-        units='dimensionless',
-    ),
-    '/entry/instrument/sample_stage/z/target_value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/z/target_value',
-        source='ODIN-Smpl:MC-LinZ-01:Mtr.VAL',
-        topic='odin_motion',
-        units='mm',
-    ),
-    '/entry/instrument/sample_stage/z/value': F144Stream(
-        nexus_path='/entry/instrument/sample_stage/z/value',
-        source='ODIN-Smpl:MC-LinZ-01:Mtr.RBV',
-        topic='odin_motion',
-        units='mm',
-    ),
-    '/entry/instrument/wfm_chopper_1/delay': F144Stream(
-        nexus_path='/entry/instrument/wfm_chopper_1/delay',
-        source='ODIN-Chop:WFM-01:Delay',
-        topic='odin_choppers',
-        units='ns',
-    ),
-    '/entry/instrument/wfm_chopper_1/phase': F144Stream(
-        nexus_path='/entry/instrument/wfm_chopper_1/phase',
-        source='ODIN-Chop:WFM-01:Phs',
-        topic='odin_choppers',
-        units='deg',
-    ),
-    '/entry/instrument/wfm_chopper_1/rotation_speed': F144Stream(
-        nexus_path='/entry/instrument/wfm_chopper_1/rotation_speed',
-        source='ODIN-Chop:WFM-01:Spd',
-        topic='odin_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/wfm_chopper_1/rotation_speed_setpoint': F144Stream(
-        nexus_path='/entry/instrument/wfm_chopper_1/rotation_speed_setpoint',
-        source='ODIN-Chop:WFM-01:SpdSet',
-        topic='odin_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/wfm_chopper_2/delay': F144Stream(
-        nexus_path='/entry/instrument/wfm_chopper_2/delay',
-        source='ODIN-Chop:WFM-02:Delay',
-        topic='odin_choppers',
-        units='ns',
-    ),
-    '/entry/instrument/wfm_chopper_2/phase': F144Stream(
-        nexus_path='/entry/instrument/wfm_chopper_2/phase',
-        source='ODIN-Chop:WFM-02:Phs',
-        topic='odin_choppers',
-        units='deg',
-    ),
-    '/entry/instrument/wfm_chopper_2/rotation_speed': F144Stream(
-        nexus_path='/entry/instrument/wfm_chopper_2/rotation_speed',
-        source='ODIN-Chop:WFM-02:Spd',
-        topic='odin_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/wfm_chopper_2/rotation_speed_setpoint': F144Stream(
-        nexus_path='/entry/instrument/wfm_chopper_2/rotation_speed_setpoint',
-        source='ODIN-Chop:WFM-02:SpdSet',
-        topic='odin_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/wfm_chopper_3/delay': F144Stream(
-        nexus_path='/entry/instrument/wfm_chopper_3/delay',
-        source='ODIN-Chop:WFM-03:Delay',
-        topic='odin_choppers',
-        units='ns',
-    ),
-    '/entry/instrument/wfm_chopper_3/phase': F144Stream(
-        nexus_path='/entry/instrument/wfm_chopper_3/phase',
-        source='ODIN-Chop:WFM-03:Phs',
-        topic='odin_choppers',
-        units='deg',
-    ),
-    '/entry/instrument/wfm_chopper_3/rotation_speed': F144Stream(
-        nexus_path='/entry/instrument/wfm_chopper_3/rotation_speed',
-        source='ODIN-Chop:WFM-03:Spd',
-        topic='odin_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/wfm_chopper_3/rotation_speed_setpoint': F144Stream(
-        nexus_path='/entry/instrument/wfm_chopper_3/rotation_speed_setpoint',
-        source='ODIN-Chop:WFM-03:SpdSet',
-        topic='odin_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/wfm_chopper_4/delay': F144Stream(
-        nexus_path='/entry/instrument/wfm_chopper_4/delay',
-        source='ODIN-Chop:WFM-04:Delay',
-        topic='odin_choppers',
-        units='ns',
-    ),
-    '/entry/instrument/wfm_chopper_4/phase': F144Stream(
-        nexus_path='/entry/instrument/wfm_chopper_4/phase',
-        source='ODIN-Chop:WFM-04:Phs',
-        topic='odin_choppers',
-        units='deg',
-    ),
-    '/entry/instrument/wfm_chopper_4/rotation_speed': F144Stream(
-        nexus_path='/entry/instrument/wfm_chopper_4/rotation_speed',
-        source='ODIN-Chop:WFM-04:Spd',
-        topic='odin_choppers',
-        units='Hz',
-    ),
-    '/entry/instrument/wfm_chopper_4/rotation_speed_setpoint': F144Stream(
-        nexus_path='/entry/instrument/wfm_chopper_4/rotation_speed_setpoint',
-        source='ODIN-Chop:WFM-04:SpdSet',
-        topic='odin_choppers',
-        units='Hz',
-    ),
-    '/entry/sample/magnetic_field': F144Stream(
-        nexus_path='/entry/sample/magnetic_field',
-        source='ODIN-SE:Mag-PSU-101',
-        topic='odin_sample_env',
-        units='T',
-    ),
-    '/entry/sample/pressure': F144Stream(
-        nexus_path='/entry/sample/pressure',
-        source='ODIN-SE:Prs-PIC-101',
-        topic='odin_sample_env',
-        units='bar',
-    ),
-    '/entry/sample/temperature_1': F144Stream(
-        nexus_path='/entry/sample/temperature_1',
-        source='ODIN-SE:Tmp-TIC-101',
-        topic='odin_sample_env',
-        units='K',
-    ),
-    '/entry/sample/temperature_2': F144Stream(
-        nexus_path='/entry/sample/temperature_2',
-        source='ODIN-SE:Tmp-TIC-102',
-        topic='odin_sample_env',
-        units='K',
-    ),
-    '/entry/vacuum/gauge_1': F144Stream(
-        nexus_path='/entry/vacuum/gauge_1',
-        source='ODIN-Vac:VGP-001',
-        topic='odin_vacuum',
-        units='mbar',
-    ),
-    '/entry/vacuum/gauge_2': F144Stream(
-        nexus_path='/entry/vacuum/gauge_2',
-        source='ODIN-Vac:VGP-002',
-        topic='odin_vacuum',
-        units='mbar',
-    ),
-    '/entry/vacuum/gauge_3': F144Stream(
-        nexus_path='/entry/vacuum/gauge_3',
-        source='ODIN-Vac:VGP-003',
-        topic='odin_vacuum',
-        units='mbar',
-    ),
-    '/entry/vacuum/gauge_4': F144Stream(
-        nexus_path='/entry/vacuum/gauge_4',
-        source='ODIN-Vac:VGP-004',
-        topic='odin_vacuum',
-        units='mbar',
-    ),
+    path: F144Stream(nexus_path=path, source=source, topic=topic, units=units)
+    for path, source, topic, units in _ROWS
 }
