@@ -489,8 +489,11 @@ def _parse_args():
     parser.add_argument(
         "--attempt-timeout",
         type=float,
-        default=600.0,
-        help="Watchdog per measurement attempt (ambient, then CPU retry)",
+        default=240.0,
+        help="Watchdog per measurement attempt (ambient, then CPU retry). "
+        "A healthy-TPU headline run finishes in ~90s incl. compile; a dead "
+        "relay must fall back to the CPU line well before any outer driver "
+        "timeout can expire.",
     )
     return parser.parse_args()
 
