@@ -504,9 +504,19 @@ _PAGE = """<!DOCTYPE html>
   <div id="tabs">
    <button id="tab-grids" class="on" onclick="setTab('grids')">Grids</button>
    <button id="tab-flat" onclick="setTab('flat')">All plots</button>
+   <button id="tab-corr" onclick="setTab('corr')">Correlation</button>
   </div>
   <div id="grids"></div>
   <div id="flat" style="display:none"></div>
+  <div id="corr" style="display:none">
+   <div class="card">
+    <label>x: <select id="corr-x"></select></label>
+    <label>y: <select id="corr-y"></select></label>
+    <button onclick="drawCorrelation()">Plot</button>
+    <small>timeseries-vs-timeseries, aligned on x's timestamps</small>
+   </div>
+   <div class="card" style="margin-top:10px"><img id="corr-img" style="display:none"></div>
+  </div>
  </div>
 </div>
 <div id="toasts"></div>
@@ -524,11 +534,49 @@ function el(tag, cls, text) {{
 }}
 function setTab(t) {{
   tab = t; gen = -1; gridGens = {{}};
-  document.getElementById('grids').style.display = t === 'grids' ? '' : 'none';
-  document.getElementById('flat').style.display = t === 'flat' ? '' : 'none';
-  document.getElementById('tab-grids').className = t === 'grids' ? 'on' : '';
-  document.getElementById('tab-flat').className = t === 'flat' ? 'on' : '';
+  for (const name of ['grids', 'flat', 'corr']) {{
+    document.getElementById(name).style.display = t === name ? '' : 'none';
+    document.getElementById('tab-' + name).className = t === name ? 'on' : '';
+  }}
   refresh();
+}}
+function refreshCorrChoices(s) {{
+  // Timeseries outputs are the correlatable series (NXlog history).
+  const series = s.keys.filter(k => k.workflow.includes('/timeseries/'));
+  const fp = JSON.stringify(series.map(k => k.id));
+  for (const id of ['corr-x', 'corr-y']) {{
+    const sel = document.getElementById(id);
+    // Rebuild only when the series set changes: a rebuild on every poll
+    // tick would close the dropdown under the operator's cursor.
+    if (sel.dataset.fp === fp) continue;
+    sel.dataset.fp = fp;
+    const current = sel.value;
+    sel.innerHTML = '';
+    for (const k of series) {{
+      const opt = document.createElement('option');
+      opt.value = k.id; opt.textContent = k.source + ' · ' + k.output;
+      sel.appendChild(opt);
+    }}
+    sel.value = current;
+    // Previous selection gone (job restarted -> new key id): fall back
+    // to the first option instead of a silently blank select.
+    if (sel.selectedIndex < 0 && series.length) sel.selectedIndex = 0;
+  }}
+}}
+function drawCorrelation() {{
+  const x = document.getElementById('corr-x').value;
+  const y = document.getElementById('corr-y').value;
+  if (!x || !y) return;
+  const img = document.getElementById('corr-img');
+  img.onerror = () => {{
+    img.style.display = 'none';
+    const d = el('div', 'toast error',
+      'Correlation render failed — series gone or not alignable');
+    document.getElementById('toasts').appendChild(d);
+    setTimeout(() => d.remove(), 6000);
+  }};
+  img.style.display = '';
+  img.src = `/plot/correlation.png?x=${{x}}&y=${{y}}&t=${{Date.now()}}`;
 }}
 async function refreshGrids() {{
   const r = await fetch('/api/grids'); const data = await r.json();
@@ -728,9 +776,10 @@ async function refresh() {{
     dt.appendChild(row);
   }}
   await pollSession();
+  if (tab === 'corr') refreshCorrChoices(s);
   if (tab === 'grids') {{
     await refreshGrids();
-  }} else if (s.generation !== gen) {{
+  }} else if (tab === 'flat' && s.generation !== gen) {{
     gen = s.generation;
     const grid = document.getElementById('flat');
     const seen = new Set();
