@@ -328,3 +328,33 @@ class TestOperatorInstalledArtifacts:
         assert geometry_store.geometry_filename(
             "dummy", datetime.date(2026, 10, 1)
         ).endswith("geometry-dummy-2026-01-01.nxs")
+
+
+class TestGridTemplatesAllInstruments:
+    @pytest.mark.parametrize("instrument", sorted(NEXUS_PLANS))
+    def test_every_instrument_has_valid_templates(self, instrument):
+        from esslivedata_tpu.config.grid_template import load_grid_templates
+        from esslivedata_tpu.config.instrument import instrument_registry
+        from esslivedata_tpu.workflows.workflow_factory import workflow_registry
+
+        instrument_registry[instrument]
+        specs = load_grid_templates(instrument)
+        assert specs, f"{instrument} ships no grid template"
+        by_id = {
+            str(s.identifier): s
+            for s in workflow_registry.specs_for_instrument(instrument)
+        }
+        for grid in specs:
+            for cell in grid.cells:
+                if not cell.workflow:
+                    continue
+                spec = by_id.get(cell.workflow)
+                assert spec is not None, (
+                    f"{instrument}/{grid.name}: unknown workflow "
+                    f"{cell.workflow}"
+                )
+                if cell.output:
+                    assert cell.output in spec.outputs, (
+                        f"{instrument}/{grid.name}: {cell.workflow} has no "
+                        f"output {cell.output}"
+                    )
