@@ -1,0 +1,10 @@
+#!/bin/bash
+# Periodic headline-bench sampler: captures relay-bandwidth variability
+# across the round. Appends one timestamped JSON line per attempt.
+cd /root/repo
+while true; do
+  ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  line=$(timeout 400 python bench.py 2>/dev/null | tail -1)
+  echo "{\"ts\": \"$ts\", \"result\": ${line:-null}}" >> bench_log.jsonl
+  sleep 1500
+done

@@ -69,12 +69,27 @@ def _cache_dir() -> Path:
     override = os.environ.get("LIVEDATA_DATA_DIR")
     if override:
         return Path(override)
-    import tempfile
+    # Per-user cache (XDG), mode 0o700: a world-scratch default would let
+    # another local user pre-plant artifacts the loader silently trusts.
+    try:
+        xdg = os.environ.get("XDG_CACHE_HOME")
+        # Path.home() raises RuntimeError for a UID with no passwd entry
+        # (common in containers) — that case takes the fallback too.
+        base = Path(xdg) if xdg else Path.home() / ".cache"
+        target = base / "esslivedata-tpu" / "geometry"
+        target.mkdir(parents=True, exist_ok=True, mode=0o700)
+        return target
+    except (OSError, RuntimeError):
+        import tempfile
 
-    # World-scratch default keeps first-run behavior dependency-free;
-    # deployments set LIVEDATA_DATA_DIR to a persistent volume (same
-    # override the reference honors).
-    return Path(tempfile.gettempdir()) / "esslivedata-tpu" / "geometry"
+        fallback = Path(tempfile.gettempdir()) / "esslivedata-tpu" / "geometry"
+        logger.warning(
+            "No usable per-user cache; falling back to world scratch %s "
+            "— set LIVEDATA_DATA_DIR for a trusted location",
+            fallback,
+        )
+        fallback.mkdir(parents=True, exist_ok=True, mode=0o700)
+        return fallback
 
 
 def geometry_filename(
@@ -120,7 +135,17 @@ def geometry_path(
     name = geometry_filename(instrument, date)
     path = _cache_dir() / name
     if path.exists():
+        _verify_pin(path, name)
         return path
+    if GEOMETRY_REGISTRY.get(name) is not None:
+        # A pinned entry names a specific real artifact; synthesizing a
+        # local stand-in under that name would hand the consumer wrong
+        # geometry once and then fail the pin check forever after.
+        raise ValueError(
+            f"Geometry artifact {name} is pinned in the registry but not "
+            f"present in {path.parent}; install it with "
+            f"scripts/fetch_geometry.py"
+        )
     import os as _os
     import tempfile
 
@@ -143,6 +168,27 @@ def geometry_path(
     finally:
         tmp.unlink(missing_ok=True)
     return path
+
+
+def _verify_pin(path: Path, name: str) -> None:
+    """Check a cached file against its registry md5 pin, when one exists.
+
+    Synthesized entries (pin None) and operator-dropped files outside the
+    registry are trusted as-is — the pin protects exactly the case where a
+    known real artifact could have been swapped in the cache.
+    """
+    expected = GEOMETRY_REGISTRY.get(name)
+    if expected is None:
+        return
+    import hashlib
+
+    digest = hashlib.md5(path.read_bytes()).hexdigest()
+    if digest != expected:
+        raise ValueError(
+            f"Geometry artifact {path} fails its registry pin "
+            f"(md5 {digest} != {expected}); delete the cached file or fix "
+            f"the registry entry"
+        )
 
 
 def load_detector_geometry(

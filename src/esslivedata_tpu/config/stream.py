@@ -1,16 +1,22 @@
-"""Stream catalog: canonical records describing streaming data declarations.
+"""Stream records: what flows on the wire, independent of what it's called.
 
-Parity with reference ``config/stream.py`` (Stream:30, F144Stream:67,
-Device:76, ContextBinding:105, ChainPatchBinding:153, suggest_names:181,
-device detection :272, filter_authorized_streams:345, name_streams:376).
+Every piece of live data a service can consume — detector event streams,
+monitor streams, f144 sample-environment logs, synthesized motor devices —
+is declared as one record here. Records carry wire identity only (schema,
+Kafka coordinates, NeXus origin). Instrument-facing *names* are assigned
+separately by :func:`name_streams`, and those names are what the rest of
+the system routes on; Kafka topic/source matter solely at the byte
+boundary where messages arrive.
 
-A ``Stream`` describes one streaming group at the wire level — what it is,
-not what an instrument calls it. The instrument-facing name is the key into
-the instrument's stream dict and is the routing handle everywhere except the
-Kafka boundary (topic/source only matter where bytes arrive). Unlike the
-reference, workflow context keys here are plain strings (our workflows are
-jitted step functions parameterized by named context scalars, not sciline
-keys), so ``ContextBinding.workflow_key`` is ``str``.
+Field names (``writer_module``/``nexus_path``/``topic``/``source``/
+``nx_class``; ``value``/``target``/``idle`` for devices) are the shared
+domain vocabulary of the ESS streaming stack (cf. reference
+``config/stream.py``) and are kept so generated registries read the same;
+everything else — validation, naming, device detection — is this
+codebase's own design.
+
+Construction is fail-fast: a malformed record or a name collision raises
+while the instrument module imports, never at message time.
 """
 
 from __future__ import annotations
@@ -32,11 +38,17 @@ __all__ = [
 
 @dataclass(frozen=True, slots=True, kw_only=True)
 class Stream:
-    """Any streaming group in NeXus (or synthesised in-process).
+    """One streaming data declaration at the wire level.
 
-    Synthesised streams have ``topic``, ``source`` and ``nexus_path`` all
-    None — they never traverse Kafka. Real Kafka streams must set topic and
-    source together; ``nexus_path`` may be None for hand-coded entries.
+    Three shapes are legal:
+
+    * **Kafka-borne** — ``topic`` and ``source`` both set; ``nexus_path``
+      optional (hand-written registry rows may predate a geometry file).
+    * **In-process** — all three None. Produced by synthesizers; bytes for
+      these never exist on a broker.
+    * Anything with exactly one of ``topic``/``source`` set is a broken
+      declaration and is rejected here rather than surfacing later as an
+      unroutable message.
     """
 
     writer_module: str
@@ -46,19 +58,17 @@ class Stream:
     nx_class: str = ""
 
     def __post_init__(self) -> None:
-        if self.topic is None and self.source is not None:
+        if (self.topic is None) != (self.source is None):
+            where = self.nexus_path or "<in-process>"
             raise ValueError(
-                f"Stream {self.nexus_path!r}: source set but topic is None"
-            )
-        if self.source is None and self.topic is not None:
-            raise ValueError(
-                f"Stream {self.nexus_path!r}: topic set but source is None"
+                f"stream at {where}: kafka identity is all-or-nothing, got "
+                f"topic={self.topic!r} with source={self.source!r}"
             )
 
 
 @dataclass(frozen=True, slots=True, kw_only=True)
 class F144Stream(Stream):
-    """f144 NXlog stream — (time, value) samples."""
+    """Scalar log stream (f144 schema): timestamped numeric samples."""
 
     units: str | None = None
     writer_module: str = "f144"
@@ -67,11 +77,14 @@ class F144Stream(Stream):
 
 @dataclass(frozen=True, slots=True, kw_only=True)
 class Device(Stream):
-    """Synthesised stream merging RBV/VAL/DMOV substreams of a motor device.
+    """A motor-like device assembled in-process from its EPICS log streams.
 
-    Materialised in-process by ``DeviceSynthesizer`` from the substreams
-    named by ``value`` (RBV, required), ``target`` (VAL) and ``idle`` (DMOV);
-    each is a key into the instrument's stream dict.
+    ``DeviceSynthesizer`` watches the named substreams and emits a merged
+    per-device record stream: ``value`` names the readback substream
+    (required), ``target`` the setpoint, ``idle`` the motion-done flag.
+    All three are *names* (keys produced by :func:`name_streams`), not
+    paths — a Device is wired after naming, so it survives renames of the
+    underlying NeXus groups.
     """
 
     value: str
@@ -84,19 +97,21 @@ class Device(Stream):
     @property
     def substream_names(self) -> tuple[str, ...]:
         return tuple(
-            s for s in (self.value, self.target, self.idle) if s is not None
+            n for n in (self.value, self.target, self.idle) if n is not None
         )
 
 
 @dataclass(frozen=True, slots=True, kw_only=True)
 class ContextBinding:
-    """Declaration of one context-stream input to a workflow.
+    """Routes one stream's latest value into workflows as named context.
 
-    Routes the value of ``stream_name`` into workflows wired for any source
-    in ``dependent_sources`` under the context key ``workflow_key``. Jobs
-    whose workflow declares the key gate on it (pending_context) until a
-    value is available. Kept in a list of its own, not on ``Stream``:
-    how a stream is used is not a property of the stream.
+    Workflows in this framework are jitted step functions taking named
+    context scalars, so ``workflow_key`` is a plain string (the reference
+    binds sciline graph keys here instead). Jobs for any source in
+    ``dependent_sources`` hold in ``pending_context`` until the stream has
+    delivered at least one value. Bindings live in their own list on the
+    instrument — usage of a stream is deliberately not a field of the
+    stream itself.
     """
 
     stream_name: str
@@ -106,11 +121,12 @@ class ContextBinding:
 
 @dataclass(frozen=True, slots=True, kw_only=True)
 class ChainPatchBinding:
-    """A geometry-patching :class:`ContextBinding` resolved for wiring.
+    """Context binding specialized for live-geometry patching.
 
-    Carries the pre-resolved NeXus transform path so the dynamic-transform
-    wiring (projection-LUT rebuild on motor motion) runs as a pure function
-    of this record without re-consulting the stream topology.
+    When a motor moves, the projection LUT must be rebuilt against the
+    updated transform chain. This record carries the resolved NeXus
+    ``transform_path`` alongside the binding so the rebuild is a pure
+    function of (record, new value) — no topology lookups at motion time.
     """
 
     stream_name: str
@@ -119,8 +135,9 @@ class ChainPatchBinding:
     dependent_sources: frozenset[str]
 
 
-#: NeXus container groups with no entity-level meaning; dropped when deriving
-#: internal names so 'entry/instrument/wfm1/transformations/t1' -> 'wfm1/t1'.
+#: Structural NeXus groups that carry no identity of their own; stripped
+#: before deriving names so 'entry/instrument/wfm1/transformations/t1'
+#: names as 'wfm1/t1'.
 _GENERIC_GROUPS: frozenset[str] = frozenset(
     {"entry", "instrument", "sample", "sample_environment", "transformations"}
 )
@@ -132,12 +149,13 @@ def suggest_names(
     min_depth: int = 2,
     forbidden: Iterable[str] | None = None,
 ) -> dict[str, str]:
-    """Suggest a unique internal name per NeXus group path.
+    """Derive a unique short name for each NeXus group path.
 
-    Generic container groups are filtered out; the name is the shortest tail
-    (>= ``min_depth`` components) of the filtered path that is unique across
-    the set and not ``forbidden``. Remaining collisions escalate to longer
-    tails, then fall back to the full unfiltered path (unique in HDF5).
+    The name is the shortest tail (at least ``min_depth`` components) of
+    the path with generic container groups removed, provided it is unique
+    within the set and not in ``forbidden``. Ambiguous paths escalate to
+    longer tails; as a last resort the full unfiltered path (unique by
+    HDF5 construction) is used.
     """
     paths = list(paths)
     forbidden_set = frozenset(forbidden or ())
@@ -173,99 +191,93 @@ def suggest_names(
     return result
 
 
-#: EPICS motor-record source-attribute suffixes identifying substream roles.
-_ROLE_BY_SUFFIX: dict[str, str] = {
-    ".RBV": "value",
-    ".VAL": "target",
-    ".DMOV": "idle",
-}
+@dataclass(slots=True)
+class _MotorParts:
+    """Role slots accumulated while scanning one NeXus parent group.
 
-
-def _classify_source(source: str | None) -> str | None:
-    if source is None:
-        return None
-    for suffix, role in _ROLE_BY_SUFFIX.items():
-        if source.endswith(suffix):
-            return role
-    return None
-
-
-@dataclass(frozen=True, slots=True)
-class _DetectedDevice:
-    value: str
-    target: str | None
-    idle: str | None
-    units: str | None
-
-
-def _detect_devices(parsed: Mapping[str, Stream]) -> dict[str, _DetectedDevice]:
-    """Detect device groups by EPICS source-suffix classification.
-
-    f144 substreams co-located under one NeXus parent form a Device when a
-    classified RBV is present plus at least one of VAL/DMOV. Raises on two
-    children of one parent claiming the same role or RBV/VAL unit mismatch.
+    EPICS motor records expose their state as separate PVs whose names end
+    in a role-identifying suffix; an f144 stream is slotted by that suffix
+    of its Kafka source. A parent qualifies as a device once the readback
+    slot is filled plus at least one of setpoint / motion-done.
     """
-    by_parent: dict[str, dict[str, str]] = {}
-    for path, stream in parsed.items():
-        if not isinstance(stream, F144Stream):
-            continue
-        role = _classify_source(stream.source)
-        if role is None:
-            continue
-        parent, _, _ = path.rpartition("/")
-        roles = by_parent.setdefault(parent, {})
-        if role in roles:
-            raise ValueError(
-                f"Device at {parent!r}: two children classify as {role!r} "
-                f"({roles[role]!r} and {path!r})"
-            )
-        roles[role] = path
 
-    devices: dict[str, _DetectedDevice] = {}
-    for parent, roles in by_parent.items():
-        if "value" not in roles:
-            continue
-        if "target" not in roles and "idle" not in roles:
-            continue
-        rbv = parsed[roles["value"]]
-        units = rbv.units if isinstance(rbv, F144Stream) else None
-        if "target" in roles:
-            val = parsed[roles["target"]]
-            if isinstance(val, F144Stream) and val.units != units:
+    readback: str | None = None  # <pv>.RBV
+    setpoint: str | None = None  # <pv>.VAL
+    moving_done: str | None = None  # <pv>.DMOV
+
+    _SUFFIXES = (
+        (".RBV", "readback"),
+        (".VAL", "setpoint"),
+        (".DMOV", "moving_done"),
+    )
+
+    def take(self, parent: str, path: str, source: str) -> None:
+        for suffix, slot in self._SUFFIXES:
+            if not source.endswith(suffix):
+                continue
+            if getattr(self, slot) is not None:
                 raise ValueError(
-                    f"Device at {parent!r}: RBV units {units!r} != VAL "
-                    f"units {val.units!r}"
+                    f"motor group {parent!r}: {getattr(self, slot)!r} and "
+                    f"{path!r} both end in {suffix} — ambiguous device"
                 )
-        devices[parent] = _DetectedDevice(
-            value=roles["value"],
-            target=roles.get("target"),
-            idle=roles.get("idle"),
-            units=units,
+            setattr(self, slot, path)
+            return
+
+    @property
+    def is_device(self) -> bool:
+        return self.readback is not None and (
+            self.setpoint is not None or self.moving_done is not None
         )
+
+
+def _detect_devices(parsed: Mapping[str, Stream]) -> dict[str, _MotorParts]:
+    """Find motor devices among the parsed f144 streams.
+
+    Sibling f144 streams under one NeXus parent whose EPICS sources carry
+    motor-record suffixes are grouped; qualifying groups become Devices in
+    :func:`name_streams`. Readback/setpoint unit disagreement is a
+    registry bug and raises.
+    """
+    groups: dict[str, _MotorParts] = {}
+    for path, stream in parsed.items():
+        if isinstance(stream, F144Stream) and stream.source is not None:
+            parent = path.rsplit("/", 1)[0] if "/" in path else ""
+            parts = groups.setdefault(parent, _MotorParts())
+            parts.take(parent, path, stream.source)
+
+    devices: dict[str, _MotorParts] = {}
+    for parent, parts in groups.items():
+        if not parts.is_device:
+            continue
+        if parts.setpoint is not None:
+            rbv, val = parsed[parts.readback], parsed[parts.setpoint]
+            ru = rbv.units if isinstance(rbv, F144Stream) else None
+            vu = val.units if isinstance(val, F144Stream) else None
+            if ru != vu:
+                raise ValueError(
+                    f"motor group {parent!r}: readback reports units {ru!r} "
+                    f"but setpoint reports {vu!r}"
+                )
+        devices[parent] = parts
     return devices
 
 
-#: Topic suffixes with a PROD ACL grant for f144 streams (workaround for an
-#: incomplete PROD authorization list), plus tn_data_general outright.
-_AUTHORIZED_TOPIC_SUFFIXES: tuple[str, ...] = (
-    "_choppers",
-    "_motion",
-    "_sample_env",
-)
-_AUTHORIZED_TOPICS: frozenset[str] = frozenset({"tn_data_general"})
+#: f144 topics our PROD credentials may read. The facility ACL list is
+#: incomplete, so authorization is granted per topic-family suffix, plus
+#: the general data topic.
+_READABLE_SUFFIXES: tuple[str, ...] = ("_choppers", "_motion", "_sample_env")
+_READABLE_TOPICS: frozenset[str] = frozenset({"tn_data_general"})
 
 
 def filter_authorized_streams(parsed: dict[str, Stream]) -> dict[str, Stream]:
-    """Drop streams whose Kafka topic lacks a PROD ACL grant."""
-    return {
-        path: stream
-        for path, stream in parsed.items()
-        if stream.topic in _AUTHORIZED_TOPICS
-        or (
-            stream.topic is not None
-            and stream.topic.endswith(_AUTHORIZED_TOPIC_SUFFIXES)
+    """Keep only streams readable under the production ACL grants."""
+
+    def readable(s: Stream) -> bool:
+        return s.topic is not None and (
+            s.topic in _READABLE_TOPICS or s.topic.endswith(_READABLE_SUFFIXES)
         )
-    }
+
+    return {path: s for path, s in parsed.items() if readable(s)}
 
 
 def name_streams(
@@ -273,46 +285,51 @@ def name_streams(
     *,
     rename: dict[str, str] | None = None,
 ) -> dict[str, Stream]:
-    """Build a name-keyed stream dict from a path-keyed parsed dict.
+    """Turn a path-keyed parse result into the name-keyed stream registry.
 
-    Auto-suggests names via :func:`suggest_names` (substreams at
-    ``min_depth=2``, detected device parents at ``min_depth=1`` with
-    substream names forbidden, keeping the namespaces disjoint);
-    ``rename`` (keyed by nexus_path) overrides. Detected motor devices are
-    emitted as :class:`Device` entries pointing at their substream names.
+    Names come from :func:`suggest_names` — substreams first (tails of at
+    least two components), then detected device parents (one component,
+    with all substream names forbidden so the two namespaces cannot
+    collide). Entries in ``rename`` (keyed by NeXus path) win over
+    suggestions. Detected motor groups are emitted as :class:`Device`
+    records whose slots hold the *names* of their substreams.
     """
     rename = rename or {}
     devices = _detect_devices(parsed)
-    valid = set(parsed) | set(devices)
-    if missing := set(rename) - valid:
+    nameable = set(parsed) | set(devices)
+    if unknown := set(rename) - nameable:
         raise ValueError(
-            f"rename keys not in parsed or detected device parents: "
-            f"{sorted(missing)}"
+            f"rename targets nothing parsed or detected: {sorted(unknown)}"
         )
-    substream_names = suggest_names(parsed.keys())
-    device_names = suggest_names(
-        devices.keys(), min_depth=1, forbidden=substream_names.values()
+    sub_names = suggest_names(parsed.keys())
+    parent_names = suggest_names(
+        devices.keys(), min_depth=1, forbidden=sub_names.values()
     )
-    suggested = {**substream_names, **device_names}
-
-    def resolve(path: str) -> str:
-        return rename.get(path, suggested[path])
+    chosen = {**sub_names, **parent_names, **rename}
 
     result: dict[str, Stream] = {}
-    for path, stream in parsed.items():
-        name = resolve(path)
+
+    def place(path: str, stream: Stream) -> None:
+        name = chosen[path]
         if name in result:
-            raise ValueError(f"name {name!r} for {path!r} collides")
+            raise ValueError(
+                f"two streams both want the name {name!r} "
+                f"(second is {path!r}) — disambiguate via rename"
+            )
         result[name] = stream
-    for parent, info in devices.items():
-        name = resolve(parent)
-        if name in result:
-            raise ValueError(f"device name {name!r} for {parent!r} collides")
-        result[name] = Device(
-            nexus_path=parent,
-            value=resolve(info.value),
-            target=resolve(info.target) if info.target else None,
-            idle=resolve(info.idle) if info.idle else None,
-            units=info.units,
+
+    for path, stream in parsed.items():
+        place(path, stream)
+    for parent, parts in devices.items():
+        rbv = parsed[parts.readback]
+        place(
+            parent,
+            Device(
+                nexus_path=parent,
+                value=chosen[parts.readback],
+                target=chosen[parts.setpoint] if parts.setpoint else None,
+                idle=chosen[parts.moving_done] if parts.moving_done else None,
+                units=rbv.units if isinstance(rbv, F144Stream) else None,
+            ),
         )
     return result

@@ -102,7 +102,32 @@ class JobService:
                     self._jobs.pop(key, None)
                     self._job_owner.pop(key, None)
                     self._adopted.discard(key)
+            # A job delisted because *we* just stopped/removed it is routine,
+            # not an incident: downgrade its notification to info.
+            now = time.monotonic()
+            # Unresolved commands count too: acks and heartbeats ride
+            # independent transport paths, so the delisting heartbeat may
+            # well be processed before the stop's ack.
+            operator_stopped = {
+                (c.source_name, c.job_number)
+                for c in self._pending
+                if c.kind in ("stop", "remove")
+                and not c.error
+                and now - c.issued_wall <= COMMAND_EXPIRY_S
+            }
         for source_name, job_number in vanished:
+            key = (source_name, job_number)
+            if key in operator_stopped:
+                logger.info(
+                    "Job %s/%s delisted after operator stop/remove",
+                    source_name,
+                    job_number,
+                )
+                self._on_event(
+                    "info",
+                    f"Job {source_name}/{str(job_number)[:8]} stopped",
+                )
+                continue
             logger.warning(
                 "Job %s/%s disappeared from %s heartbeat",
                 source_name,

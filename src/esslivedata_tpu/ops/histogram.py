@@ -267,6 +267,7 @@ class EventHistogrammer:
             n_screen=n_screen,
         )
         self._edges = self._proj.edges
+        self._edges_f32 = self._edges.astype(np.float32)
         self._n_toa = self._proj.n_toa
         self._n_screen = self._proj.n_screen
         self._n_bins = self._n_screen * self._n_toa
@@ -365,6 +366,10 @@ class EventHistogrammer:
     def _step_flat_impl(
         self, state: HistogramState, flat: jax.Array
     ) -> HistogramState:
+        # Externally produced indices: scatter mode='drop' bounds-checks
+        # AFTER one negative wrap, so -1 is dropped but -2..-n_bins would
+        # wrap into real bins. Route all negatives to the dump bin first.
+        flat = jnp.where(flat < 0, self._n_bins, flat)
         return self._advance(state, flat, None)
 
     def physical_window(self, state: HistogramState) -> jax.Array:
@@ -490,9 +495,12 @@ class EventHistogrammer:
             t_ok = (toa >= np.float32(proj.lo)) & (toa < np.float32(proj.hi))
             np.clip(tb, 0, self._n_toa - 1, out=tb)
         else:
-            tb = np.searchsorted(self._edges, toa, side="right").astype(
-                np.int32
-            ) - 1
+            # float32 edges, matching the device path's dtype exactly —
+            # boundary-adjacent events must land in the same bin whichever
+            # ingest path (host flatten vs device projection) a config takes.
+            tb = np.searchsorted(
+                self._edges_f32, toa, side="right"
+            ).astype(np.int32) - 1
             t_ok = (tb >= 0) & (tb < self._n_toa)
             np.clip(tb, 0, self._n_toa - 1, out=tb)
         if lut_host is not None:

@@ -272,6 +272,30 @@ class TestCommandExpiryAndReconciliation:
         assert not js.jobs()
         assert any("gone" in msg for _, msg in events)
 
+    def test_operator_stop_suppresses_vanish_warning(self):
+        # A job the dashboard itself just stopped delists on the next
+        # heartbeat — that is routine, and must arrive as info, not as a
+        # "stopped or died" warning toast.
+        events = []
+        js = JobService(on_event=lambda level, msg: events.append((level, msg)))
+        number = uuid.uuid4()
+        job = JobStatus(
+            source_name="panel_0",
+            job_number=number,
+            workflow_id="dummy/detector_view/panel_view/v1",
+            state="active",
+        )
+        js.on_status(self._status("svc-1", [job]))
+        cmd = js.track_command("panel_0", number, "stop")
+        cmd.resolved = True  # acked by the service
+        js.on_status(self._status("svc-1", []))
+        assert not js.jobs()
+        levels = [level for level, _ in events]
+        assert "warning" not in levels
+        assert any(
+            level == "info" and "stopped" in msg for level, msg in events
+        )
+
     def test_job_owned_by_other_service_untouched(self):
         js = JobService()
         number = uuid.uuid4()

@@ -299,6 +299,33 @@ class TestFlatFastPath:
         cum, win = h.read(state)
         assert win.sum() == 2.0  # only bins 0 and 1 land
 
+    def test_small_negative_flat_indices_do_not_wrap(self):
+        # JAX scatter bounds-checks after one negative wrap: with 3 bins of
+        # state (2 screen rows + dump), flat=-2 would wrap to bin 1 and
+        # silently corrupt a real count. The kernel must route every
+        # negative index to the dump bin instead.
+        edges = np.linspace(0.0, 10.0, 2)
+        h = EventHistogrammer(toa_edges=edges, n_screen=2)
+        bad = np.array([0, -1, -2, -3], dtype=np.int32)
+        state = h.step_flat(h.init_state(), bad)
+        cum, win = h.read(state)
+        np.testing.assert_array_equal(win, [[1.0], [0.0]])
+
+    def test_nonuniform_edges_host_device_bit_identical(self):
+        # Host flatten must bin with the same float32 edges the device
+        # projection uses, or boundary-adjacent events land one bin apart
+        # between the two ingest paths.
+        edges = np.array([0.0, 1e7 + 0.3, 2.5e7, 7.1e7])
+        h = EventHistogrammer(toa_edges=edges, n_screen=8)
+        rng = np.random.default_rng(5)
+        pid = rng.integers(0, 8, 20_000).astype(np.int32)
+        toa = rng.uniform(0, 7.1e7, 20_000).astype(np.float32)
+        # Salt with exact float32 edge values — the adversarial case.
+        toa[:3] = np.float32(edges[1])
+        s_dev = h.step(h.init_state(), EventBatch.from_arrays(pid, toa))
+        s_host = h.step_flat(h.init_state(), h.flatten_host(pid, toa))
+        np.testing.assert_array_equal(h.read(s_dev)[1], h.read(s_host)[1])
+
 
 
 class TestLazyDecay:
