@@ -147,13 +147,25 @@ class JobService:
         except (KeyError, ValueError):
             logger.warning("Malformed ack: %r", payload)
             return
+        rejected: PendingCommand | None = None
         with self._lock:
             for cmd in self._pending:
                 if (cmd.source_name, cmd.job_number) == key and not cmd.resolved:
                     cmd.resolved = True
                     if payload.get("status") == "error":
                         cmd.error = payload.get("message", "error")
+                        rejected = cmd
                     break
+        if rejected is not None:
+            # A rejection travels in the async ack — the HTTP POST that
+            # issued the command already returned ok, so this toast is the
+            # only way the operator learns the update was discarded (e.g.
+            # an ROI set over the per-geometry capacity).
+            self._on_event(
+                "error",
+                f"Command {rejected.kind!r} for {rejected.source_name}/"
+                f"{str(rejected.job_number)[:8]} rejected: {rejected.error}",
+            )
 
     # -- command tracking --------------------------------------------------
     def track_command(

@@ -32,6 +32,7 @@ __all__ = [
     "plotter_registry",
     "render_correlation_png",
     "render_png",
+    "render_png_with_meta",
 ]
 
 logger = logging.getLogger(__name__)
@@ -354,6 +355,36 @@ def render_png(
     The caller's title goes on the figure (suptitle) so plotters that use
     the axes title themselves (SlicerPlotter's slice indicator) keep it.
     """
+    return render_png_with_meta(
+        da,
+        title=title,
+        figsize=figsize,
+        dpi=dpi,
+        plotter=plotter,
+        params=params,
+    )[0]
+
+
+def render_png_with_meta(
+    da: DataArray,
+    *,
+    title: str = "",
+    figsize=(5.0, 3.6),
+    dpi: int = 100,
+    plotter=None,
+    params: PlotParams | None = None,
+) -> tuple[bytes, dict]:
+    """``render_png`` plus the pixel->data mapping the ROI overlay needs.
+
+    The meta dict locates the axes inside the PNG (``axes_px``, top-left
+    pixel origin) and its data limits (``xlim``/``ylim``), letting the
+    client translate a mouse drag on the image into detector coordinates:
+
+        data_x = xlim[0] + (px - x0) / (x1 - x0) * (xlim[1] - xlim[0])
+        data_y = ylim[0] + (y1 - py) / (y1 - y0) * (ylim[1] - ylim[0])
+
+    (y flips: PNG rows grow downward, axes values grow upward.)
+    """
     with _render_lock:
         fig, ax = plt.subplots(figsize=figsize, dpi=dpi)
         try:
@@ -364,6 +395,22 @@ def render_png(
             fig.tight_layout()
             buf = io.BytesIO()
             fig.savefig(buf, format="png")
-            return buf.getvalue()
+            # Window extents are only valid after a draw; savefig drew.
+            width_px = int(round(fig.get_figwidth() * fig.dpi))
+            height_px = int(round(fig.get_figheight() * fig.dpi))
+            bbox = ax.get_window_extent()
+            meta = {
+                "width": width_px,
+                "height": height_px,
+                "axes_px": {
+                    "x0": bbox.x0,
+                    "y0": height_px - bbox.y1,  # flip to top-left origin
+                    "x1": bbox.x1,
+                    "y1": height_px - bbox.y0,
+                },
+                "xlim": list(ax.get_xlim()),
+                "ylim": list(ax.get_ylim()),
+            }
+            return buf.getvalue(), meta
         finally:
             plt.close(fig)

@@ -22,6 +22,7 @@ import base64
 import json
 import logging
 
+import numpy as np
 import tornado.web
 
 from ..config.workflow_spec import ResultKey, WorkflowId
@@ -31,7 +32,7 @@ from .plots import (
     SlicerPlotter,
     TablePlotter,
     render_correlation_png,
-    render_png,
+    render_png_with_meta,
 )
 
 __all__ = ["make_app"]
@@ -366,20 +367,78 @@ class RoiHandler(_Base):
         self.services.orchestrator.set_rois(job_id, body.get("rois") or {})
         self.write_json({"ok": True})
 
+    def get(self) -> None:
+        """Applied-ROI readback for one job, decoded from the workflow's
+        ``roi_rectangle``/``roi_polygon`` outputs (the backend's answer,
+        not the client's request — reference roi_readback_plots.py). The
+        drawing overlay renders these and seeds edits from them."""
+        source = self.get_query_argument("source_name", "")
+        job_number = self.get_query_argument("job_number", "")
+        rectangles: list[dict] = []
+        polygons: list[dict] = []
+        spectra_keys: list[str] = []
+        for key in self.services.data_service.keys():
+            if (
+                key.job_id.source_name != source
+                or str(key.job_id.job_number) != job_number
+            ):
+                continue
+            data = self.services.data_service.get(key)
+            if data is None:
+                continue
+            if key.output_name == "roi_rectangle":
+                idx = np.asarray(data.values).ravel()
+                for j, roi_index in enumerate(idx):
+                    rectangles.append(
+                        {
+                            "index": int(roi_index),
+                            **{
+                                side: float(
+                                    np.asarray(data.coords[side].numpy).ravel()[j]
+                                )
+                                for side in ("x_min", "x_max", "y_min", "y_max")
+                            },
+                        }
+                    )
+            elif key.output_name == "roi_polygon":
+                vert_roi = np.asarray(data.values).ravel()
+                xs = np.asarray(data.coords["x"].numpy).ravel()
+                ys = np.asarray(data.coords["y"].numpy).ravel()
+                for roi_index in sorted(set(vert_roi.tolist())):
+                    mask = vert_roi == roi_index
+                    polygons.append(
+                        {
+                            "index": int(roi_index),
+                            "x": xs[mask].tolist(),
+                            "y": ys[mask].tolist(),
+                        }
+                    )
+            elif key.output_name.startswith("roi_spectra"):
+                spectra_keys.append(_key_to_id(key))
+        self.write_json(
+            {
+                "rectangles": rectangles,
+                "polygons": polygons,
+                "spectra_keys": spectra_keys,
+            }
+        )
+
 
 class PlotHandler(_Base):
-    def get(self, kid: str) -> None:
+    def _resolve(self, kid: str):
+        """Shared resolution for the .png and .meta endpoints: key ->
+        (data, title, plotter, params), or None with the error written."""
         try:
             key = _id_to_key(kid)
         except Exception:
             self.set_status(404)
-            return
+            return None
         history = self.get_argument("history", "0") == "1"
         extractor = FullHistoryExtractor() if history else None
         data = self.services.data_service.get(key, extractor)
         if data is None:
             self.set_status(404)
-            return
+            return None
         title = f"{key.job_id.source_name} · {key.output_name}"
         # Presentation params ride the query string (the UI builds plot
         # URLs from the owning cell's persisted params).
@@ -396,7 +455,7 @@ class PlotHandler(_Base):
         except ValueError as err:
             self.set_status(400)
             self.write_json({"error": str(err)})
-            return
+            return None
         # ?slice=N picks the leading-dim slice of 3-D data (SlicerPlotter);
         # ?plotter=table forces the tabular rendering of small 1-D data.
         slice_arg = self.get_argument("slice", None)
@@ -413,13 +472,26 @@ class PlotHandler(_Base):
                 self.write_json(
                     {"error": f"slice must be in [0, {data.shape[0]})"}
                 )
-                return
+                return None
             plotter = SlicerPlotter(index=index)
+        return key, data, title, plotter, params
+
+    def get(self, kid: str, suffix: str = ".png") -> None:
+        resolved = self._resolve(kid)
+        if resolved is None:
+            return
+        key, data, title, plotter, params = resolved
         try:
-            png = render_png(data, title=title, plotter=plotter, params=params)
+            png, meta = render_png_with_meta(
+                data, title=title, plotter=plotter, params=params
+            )
         except Exception:
             logger.exception("Plot render failed for %s", key)
             self.set_status(500)
+            return
+        if suffix == ".meta":
+            # Pixel->data mapping for the ROI drawing overlay.
+            self.write_json(meta)
             return
         self.set_header("Content-Type", "image/png")
         self.set_header("Cache-Control", "no-store")
@@ -489,6 +561,9 @@ _PAGE = """<!DOCTYPE html>
  table.devices {{ font-size: 12px; border-collapse: collapse; width: 100%; }}
  table.devices td {{ padding: 2px 4px; border-bottom: 1px solid #eee; }}
  td.stale {{ color: #999; }}
+ .imgwrap {{ position: relative; }}
+ .roi-canvas {{ position: absolute; top: 0; left: 0; cursor: crosshair; }}
+ .roi-bar {{ font-size: 11px; background: #eef2f6; padding: 2px 4px; }}
 </style></head>
 <body>
 <header><div><b>esslivedata-tpu</b> — {instrument}</div>
@@ -612,11 +687,25 @@ async function refreshGrids() {{
       head.appendChild(cfg);
       cell.appendChild(head);
       if (c.keys.length) {{
+        const kid = c.keys[0];
+        const wrap = el('div', 'imgwrap');
         const img = document.createElement('img');
         const p = new URLSearchParams(c.params || {{}});
         p.set('gen', g.generation);
-        img.src = '/plot/' + c.keys[0] + '.png?' + p.toString();
-        cell.appendChild(img);
+        img.src = '/plot/' + kid + '.png?' + p.toString();
+        wrap.appendChild(img);
+        cell.appendChild(wrap);
+        const info = keyInfo(kid);
+        if (info && info.output.startsWith('image')) {{
+          const rb = el('button', '', roiEdit && roiEdit.kid === kid
+            ? 'Done' : 'ROI');
+          rb.title = 'Draw regions of interest on this image';
+          rb.onclick = () => toggleRoiEdit(kid, g.grid_id, c.index, c.params);
+          head.appendChild(rb);
+          if (roiEdit && roiEdit.kid === kid) {{
+            attachRoiOverlay(wrap, img);
+          }}
+        }}
       }} else {{
         cell.appendChild(el('small', '', 'waiting for data…'));
       }}
@@ -635,6 +724,225 @@ async function editCell(gridId, index, params) {{
   const r = await fetch(`/api/grid/${{gridId}}/cell/${{index}}/config`, {{
     method: 'POST', body: JSON.stringify({{params: parsed}})}});
   if (!r.ok) alert((await r.json()).error);
+}}
+// -- ROI drawing: rectangle/polygon overlay on detector images --------
+// Coordinate math mirrors /plot/{{kid}}.meta: the axes' pixel bbox plus
+// its data limits turn a mouse drag into detector coordinates. The
+// backend's roi_rectangle/roi_polygon readbacks seed the editable state,
+// so the overlay shows what is APPLIED, not what was last requested.
+let roiEdit = null, lastState = null;
+function keyInfo(kid) {{
+  if (!lastState) return null;
+  return lastState.keys.find(k => k.id === kid) || null;
+}}
+function pxToData(meta, px, py) {{
+  const a = meta.axes_px;
+  const fx = (px - a.x0) / (a.x1 - a.x0);
+  const fy = (a.y1 - py) / (a.y1 - a.y0);  // PNG rows grow downward
+  return [meta.xlim[0] + fx * (meta.xlim[1] - meta.xlim[0]),
+          meta.ylim[0] + fy * (meta.ylim[1] - meta.ylim[0])];
+}}
+function dataToPx(meta, x, y) {{
+  const a = meta.axes_px;
+  const fx = (x - meta.xlim[0]) / (meta.xlim[1] - meta.xlim[0]);
+  const fy = (y - meta.ylim[0]) / (meta.ylim[1] - meta.ylim[0]);
+  return [a.x0 + fx * (a.x1 - a.x0), a.y1 - fy * (a.y1 - a.y0)];
+}}
+const MAX_ROIS_PER_TYPE = 4;  // backend ROIStreamMapper capacity per geometry
+async function toggleRoiEdit(kid, gridId, cellIndex, cellParams) {{
+  if (roiEdit && roiEdit.kid === kid) {{
+    roiEdit = null; gridGens = {{}}; refreshGrids(); return;
+  }}
+  const info = keyInfo(kid);
+  if (!info) return;
+  const rb = await (await fetch('/api/roi?source_name=' +
+    encodeURIComponent(info.source) + '&job_number=' +
+    encodeURIComponent(info.job_number))).json();
+  roiEdit = {{
+    kid, gridId, cellIndex, mode: 'rect', polyPts: [],
+    params: cellParams || {{}},  // .meta must render with the cell's params
+    job: {{source_name: info.source, job_number: info.job_number}},
+    rects: rb.rectangles.map(r => ({{x_min: r.x_min, x_max: r.x_max,
+                                     y_min: r.y_min, y_max: r.y_max}})),
+    polys: rb.polygons.map(p => ({{x: p.x, y: p.y}})),
+  }};
+  gridGens = {{}};  // force grid repaint so the overlay attaches
+  refreshGrids();
+}}
+async function postRois() {{
+  const rois = {{}};
+  roiEdit.rects.forEach((r, i) => rois['rect' + i] = r);
+  roiEdit.polys.forEach((p, i) => rois['poly' + i] = p);
+  const r = await fetch('/api/roi', {{method: 'POST', body: JSON.stringify(
+    {{...roiEdit.job, rois}})}});
+  if (!r.ok) alert((await r.json()).error || 'ROI update failed');
+}}
+async function attachRoiOverlay(wrap, img) {{
+  // Fresh meta per attach: the axes bbox moves between repaints (tick
+  // label widths follow live data through tight_layout), so a meta
+  // captured at toggle time would skew the pixel->data mapping. Render
+  // with the cell's own params — scale/cmap change the layout too.
+  const mp = new URLSearchParams(roiEdit.params);
+  roiEdit.meta = await (await fetch(
+    '/plot/' + roiEdit.kid + '.meta?' + mp.toString())).json();
+  const build = () => {{
+    const canvas = document.createElement('canvas');
+    canvas.className = 'roi-canvas';
+    canvas.width = img.clientWidth; canvas.height = img.clientHeight;
+    wrap.appendChild(canvas);
+    const bar = el('div', 'roi-bar');
+    const modeBtn = el('button', '', 'mode: rect');
+    modeBtn.onclick = () => {{
+      roiEdit.mode = roiEdit.mode === 'rect' ? 'poly' : 'rect';
+      roiEdit.polyPts = [];
+      modeBtn.textContent = 'mode: ' + roiEdit.mode;
+      paint();
+    }};
+    bar.appendChild(modeBtn);
+    bar.appendChild(el('small', '',
+      ' drag=new/move · corner-drag=resize · dblclick=delete · ' +
+      'poly: click vertices, dblclick closes'));
+    wrap.appendChild(bar);
+    // Displayed size != PNG size (CSS width 100%): scale factor per axis.
+    const sx = img.clientWidth / roiEdit.meta.width;
+    const sy = img.clientHeight / roiEdit.meta.height;
+    const toPng = e => {{
+      const r = canvas.getBoundingClientRect();
+      return [(e.clientX - r.left) / sx, (e.clientY - r.top) / sy];
+    }};
+    const ctx = canvas.getContext('2d');
+    const paint = (draft) => {{
+      ctx.clearRect(0, 0, canvas.width, canvas.height);
+      ctx.lineWidth = 2;
+      roiEdit.rects.forEach((q, i) => {{
+        const [px0, py0] = dataToPx(roiEdit.meta, q.x_min, q.y_max);
+        const [px1, py1] = dataToPx(roiEdit.meta, q.x_max, q.y_min);
+        ctx.strokeStyle = '#ff5722';
+        ctx.strokeRect(px0 * sx, py0 * sy, (px1 - px0) * sx, (py1 - py0) * sy);
+        ctx.fillStyle = '#ff5722';
+        ctx.fillText('rect' + i, px0 * sx + 3, py0 * sy + 12);
+      }});
+      roiEdit.polys.forEach((p, i) => {{
+        ctx.strokeStyle = '#7b1fa2'; ctx.beginPath();
+        p.x.forEach((x, j) => {{
+          const [px, py] = dataToPx(roiEdit.meta, x, p.y[j]);
+          j ? ctx.lineTo(px * sx, py * sy) : ctx.moveTo(px * sx, py * sy);
+        }});
+        ctx.closePath(); ctx.stroke();
+      }});
+      if (roiEdit.polyPts.length) {{
+        ctx.strokeStyle = '#7b1fa2'; ctx.setLineDash([4, 3]); ctx.beginPath();
+        roiEdit.polyPts.forEach(([x, y], j) => {{
+          const [px, py] = dataToPx(roiEdit.meta, x, y);
+          j ? ctx.lineTo(px * sx, py * sy) : ctx.moveTo(px * sx, py * sy);
+        }});
+        ctx.stroke(); ctx.setLineDash([]);
+      }}
+      if (draft) {{
+        ctx.strokeStyle = '#ff5722'; ctx.setLineDash([4, 3]);
+        const [px0, py0] = dataToPx(roiEdit.meta, draft.x_min, draft.y_max);
+        const [px1, py1] = dataToPx(roiEdit.meta, draft.x_max, draft.y_min);
+        ctx.strokeRect(px0 * sx, py0 * sy, (px1 - px0) * sx, (py1 - py0) * sy);
+        ctx.setLineDash([]);
+      }}
+    }};
+    const hitRect = (x, y) => {{
+      for (let i = roiEdit.rects.length - 1; i >= 0; i--) {{
+        const q = roiEdit.rects[i];
+        if (x >= q.x_min && x <= q.x_max && y >= q.y_min && y <= q.y_max)
+          return i;
+      }}
+      return -1;
+    }};
+    const nearCorner = (q, x, y) => {{
+      // Corner tolerance: 5% of the data span.
+      const tx = 0.05 * Math.abs(roiEdit.meta.xlim[1] - roiEdit.meta.xlim[0]);
+      const ty = 0.05 * Math.abs(roiEdit.meta.ylim[1] - roiEdit.meta.ylim[0]);
+      for (const [cx, cy, h] of [[q.x_min, q.y_min, 'll'], [q.x_max, q.y_min, 'lr'],
+                                 [q.x_min, q.y_max, 'ul'], [q.x_max, q.y_max, 'ur']])
+        if (Math.abs(x - cx) < tx && Math.abs(y - cy) < ty) return h;
+      return null;
+    }};
+    let drag = null;
+    canvas.onmousedown = e => {{
+      const [px, py] = toPng(e);
+      const [x, y] = pxToData(roiEdit.meta, px, py);
+      if (roiEdit.mode === 'poly') {{ roiEdit.polyPts.push([x, y]); paint(); return; }}
+      const i = hitRect(x, y);
+      if (i >= 0) {{
+        const corner = nearCorner(roiEdit.rects[i], x, y);
+        drag = corner ? {{kind: 'resize', i, corner}}
+                      : {{kind: 'move', i, x0: x, y0: y,
+                          orig: {{...roiEdit.rects[i]}}}};
+      }} else {{
+        drag = {{kind: 'new', x0: x, y0: y}};
+      }}
+    }};
+    canvas.onmousemove = e => {{
+      if (!drag) return;
+      const [px, py] = toPng(e);
+      const [x, y] = pxToData(roiEdit.meta, px, py);
+      if (drag.kind === 'new') {{
+        drag.draft = {{x_min: Math.min(drag.x0, x), x_max: Math.max(drag.x0, x),
+                       y_min: Math.min(drag.y0, y), y_max: Math.max(drag.y0, y)}};
+        paint(drag.draft);
+      }} else if (drag.kind === 'move') {{
+        const q = roiEdit.rects[drag.i], o = drag.orig;
+        const dx = x - drag.x0, dy = y - drag.y0;
+        q.x_min = o.x_min + dx; q.x_max = o.x_max + dx;
+        q.y_min = o.y_min + dy; q.y_max = o.y_max + dy;
+        paint();
+      }} else {{
+        const q = roiEdit.rects[drag.i];
+        if (drag.corner[1] === 'l') q.x_min = x;
+        if (drag.corner[1] === 'r') q.x_max = x;
+        if (drag.corner[0] === 'l') q.y_min = y;
+        if (drag.corner[0] === 'u') q.y_max = y;
+        paint();
+      }}
+    }};
+    canvas.onmouseup = async () => {{
+      if (!drag) return;
+      const d = drag; drag = null;
+      if (d.kind === 'new' && d.draft
+          && d.draft.x_max > d.draft.x_min && d.draft.y_max > d.draft.y_min) {{
+        if (roiEdit.rects.length >= MAX_ROIS_PER_TYPE) {{
+          alert('At most ' + MAX_ROIS_PER_TYPE + ' rectangle ROIs');
+          paint(); return;
+        }}
+        roiEdit.rects.push(d.draft);
+      }}
+      if (d.kind === 'resize') {{
+        const q = roiEdit.rects[d.i];  // normalize flipped bounds
+        [q.x_min, q.x_max] = [Math.min(q.x_min, q.x_max), Math.max(q.x_min, q.x_max)];
+        [q.y_min, q.y_max] = [Math.min(q.y_min, q.y_max), Math.max(q.y_min, q.y_max)];
+      }}
+      paint();
+      await postRois();
+    }};
+    canvas.ondblclick = async e => {{
+      const [px, py] = toPng(e);
+      const [x, y] = pxToData(roiEdit.meta, px, py);
+      if (roiEdit.mode === 'poly') {{
+        if (roiEdit.polyPts.length >= 3) {{
+          if (roiEdit.polys.length >= MAX_ROIS_PER_TYPE) {{
+            alert('At most ' + MAX_ROIS_PER_TYPE + ' polygon ROIs');
+            roiEdit.polyPts = []; paint(); return;
+          }}
+          roiEdit.polys.push({{x: roiEdit.polyPts.map(p => p[0]),
+                               y: roiEdit.polyPts.map(p => p[1])}});
+          roiEdit.polyPts = [];
+          paint(); await postRois();
+        }}
+        return;
+      }}
+      const i = hitRect(x, y);
+      if (i >= 0) {{ roiEdit.rects.splice(i, 1); paint(); await postRois(); }}
+    }};
+    paint();
+  }};
+  if (img.complete && img.clientWidth) build();
+  else img.onload = build;
 }}
 // -- workflow wizard: schema-driven params form, two-phase stage->commit.
 function openWizard(w, src) {{
@@ -731,6 +1039,7 @@ async function pollSession() {{
 }}
 async function refresh() {{
   const r = await fetch('/api/state'); const s = await r.json();
+  lastState = s;
   document.getElementById('meta').textContent = 'generation ' + s.generation;
   const wf = document.getElementById('workflows');
   // Re-render when the workflow/source set changes (fingerprint, not
@@ -882,7 +1191,7 @@ def make_app(services: DashboardServices, instrument: str) -> tornado.web.Applic
             (r"/api/notifications", NotificationsHandler),
             (r"/api/devices", DevicesHandler),
             (r"/plot/correlation\.png", CorrelationPlotHandler),
-            (r"/plot/([A-Za-z0-9_\-=]+)\.png", PlotHandler),
+            (r"/plot/([A-Za-z0-9_\-=]+)(\.png|\.meta)", PlotHandler),
         ],
         services=services,
         instrument=instrument,

@@ -380,6 +380,18 @@ class EventHistogrammer:
             return state.window
         return state.window * state.scale
 
+    def fold_window(self, state: HistogramState) -> HistogramState:
+        """Traceable window fold: the cumulative absorbs the window, which
+        zeroes. Workflows compose this into their fused publish programs
+        (ops/publish.py) so summaries and the fold ride one execute call;
+        ``clear_window`` is the standalone jitted equivalent."""
+        return self._clear_window_impl(state)
+
+    def views_of(self, state: HistogramState) -> tuple[jax.Array, jax.Array]:
+        """Traceable (cumulative, window) views, ``[n_screen, n_toa]`` —
+        the composition counterpart of the jitted ``views``."""
+        return self._views_impl(state)
+
     def _clear_window_impl(self, state: HistogramState) -> HistogramState:
         return HistogramState(
             folded=state.folded + self.physical_window(state),

@@ -146,6 +146,11 @@ class QHistogrammer:
     ) -> QState:
         return self._step(state, batch.pixel_id, batch.toa, monitor_count)
 
+    def fold_window(self, state: QState) -> QState:
+        """Traceable window fold, for composition into fused publish
+        programs (ops/publish.py); ``clear_window`` is the jitted one."""
+        return self._clear_window_impl(state)
+
     def clear_window(self, state: QState) -> QState:
         return self._clear_window(state)
 

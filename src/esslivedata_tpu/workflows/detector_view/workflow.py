@@ -93,17 +93,19 @@ class DetectorViewWorkflow:
         n_toa = self._hist.n_toa
         n_bins = projection.n_screen * n_toa
 
-        def summarize(state, roi_masks):
+        def publish_program(state, roi_masks):
             # The histogrammer owns the state layout (flat, dump bin, lazy
             # decay scale); compose its traceable view here so the fold
-            # into the cumulative fuses into the reductions below.
+            # into the cumulative fuses into the reductions below, and the
+            # window fold into the same program — publish is ONE execute
+            # plus ONE packed fetch (ops/publish.py).
             win = self._hist.physical_window(state)[:n_bins].reshape(
                 projection.n_screen, n_toa
             )
             cum = win + state.folded[:n_bins].reshape(
                 projection.n_screen, n_toa
             )
-            return {
+            outputs = {
                 "image_current": win.sum(axis=1).reshape(ny, nx),
                 "image_cumulative": cum.sum(axis=1).reshape(ny, nx),
                 "spectrum_current": win.sum(axis=0),
@@ -114,8 +116,11 @@ class DetectorViewWorkflow:
                 "roi_spectra": roi_masks @ win,
                 "roi_spectra_cumulative": roi_masks @ cum,
             }
+            return outputs, self._hist.fold_window(state)
 
-        self._summarize = jax.jit(summarize)
+        from ...ops.publish import PackedPublisher
+
+        self._publish = PackedPublisher(publish_program)
         self._toa_edges_var = Variable(edges, ("toa",), "ns")
         assert n_toa == edges.size - 1
 
@@ -183,12 +188,7 @@ class DetectorViewWorkflow:
                     )
 
     def finalize(self) -> dict[str, DataArray]:
-        out = self._summarize(self._state, self._roi_masks)
-        # One bulk device->host fetch: per-array np.asarray would pay one
-        # blocking round trip per output, which dominates publish latency
-        # when the accelerator sits behind a network relay.
-        out = jax.device_get(out)
-        self._state = self._hist.clear_window(self._state)
+        out, self._state = self._publish(self._state, self._roi_masks)
 
         img_coords = {
             "x": self._proj.x_edges,

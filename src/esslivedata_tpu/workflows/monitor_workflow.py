@@ -64,6 +64,18 @@ class MonitorWorkflow:
         )
         self._hist = EventHistogrammer(toa_edges=self._edges, n_screen=1)
         self._state: HistogramState = self._hist.init_state()
+
+        def publish_program(state):
+            cum, win = self._hist.views_of(state)
+            return (
+                {"cum": cum[0], "win": win[0]},
+                self._hist.fold_window(state),
+            )
+
+        from ..ops.publish import PackedPublisher
+
+        # One execute + one fetch per publish (see ops/publish.py).
+        self._publish = PackedPublisher(publish_program)
         # Dense-mode accumulation happens host-side (tiny arrays).
         self._dense_cumulative = np.zeros(params.toa_bins)
         self._dense_window = np.zeros(params.toa_bins)
@@ -102,10 +114,9 @@ class MonitorWorkflow:
         self._dense_cumulative += rebinned
 
     def finalize(self) -> dict[str, DataArray]:
-        cum2, win2 = self._hist.read(self._state)
-        win = win2[0] + self._dense_window
-        cum = cum2[0] + self._dense_cumulative
-        self._state = self._hist.clear_window(self._state)
+        out, self._state = self._publish(self._state)
+        win = out["win"] + self._dense_window
+        cum = out["cum"] + self._dense_cumulative
         self._dense_window = np.zeros_like(self._dense_window)
         coords = {"toa": self._edges_var}
         return {

@@ -91,6 +91,7 @@ class MultiBankViewWorkflow:
         self._state = self._hist.init_state()
         self._edges_var = Variable(edges, ("toa",), "ns")
         self._n_banks = n_banks
+        self._publish = None
 
     @property
     def is_sharded(self) -> bool:
@@ -108,33 +109,80 @@ class MultiBankViewWorkflow:
                         self._state, value.batch
                     )
 
+    def _publisher(self):
+        """Lazy fused publish program (single-chip path): bank reductions
+        on device, one execute + one packed fetch, window fold included
+        (ops/publish.py). The sharded path keeps its collective read —
+        its state spans the mesh and publishes via the exchange kernels."""
+        if self._publish is None:
+            from ..ops.publish import PackedPublisher
+
+            def program(state):
+                cum, win = self._hist.views_of(state)
+                shape = (self._n_banks, self._pixels_per_bank, -1)
+                win3 = win.reshape(shape)
+                cum3 = cum.reshape(shape)
+                outputs = {
+                    "bank_spectra_current": win3.sum(axis=1),
+                    "bank_spectra_cumulative": cum3.sum(axis=1),
+                    "bank_counts_current": win3.sum(axis=(1, 2)),
+                    "bank_counts_cumulative": cum3.sum(axis=(1, 2)),
+                    "counts_current": win3.sum(),
+                    "counts_cumulative": cum3.sum(),
+                }
+                return outputs, self._hist.fold_window(state)
+
+            self._publish = PackedPublisher(program)
+        return self._publish
+
     def finalize(self) -> dict[str, DataArray]:
-        cum, win = self._hist.read(self._state)
-        win = win.reshape(self._n_banks, self._pixels_per_bank, -1)
-        cum = cum.reshape(self._n_banks, self._pixels_per_bank, -1)
-        self._state = self._hist.clear_window(self._state)
+        if self._sharded is None:
+            out, self._state = self._publisher()(self._state)
+            win_spectra = out["bank_spectra_current"]
+            cum_spectra = out["bank_spectra_cumulative"]
+            win_counts = out["bank_counts_current"]
+            cum_counts = out["bank_counts_cumulative"]
+            total_win = out["counts_current"]
+            total_cum = out["counts_cumulative"]
+        else:
+            cum, win = self._hist.read(self._state)
+            win = win.reshape(self._n_banks, self._pixels_per_bank, -1)
+            cum = cum.reshape(self._n_banks, self._pixels_per_bank, -1)
+            self._state = self._hist.clear_window(self._state)
+            win_spectra, cum_spectra = win.sum(axis=1), cum.sum(axis=1)
+            win_counts, cum_counts = win.sum(axis=(1, 2)), cum.sum(axis=(1, 2))
+            total_win, total_cum = win.sum(), cum.sum()
         bank_coord = Variable(
             np.arange(self._n_banks), ("bank",), ""
         )
         coords = {"toa": self._edges_var, "bank": bank_coord}
         return {
             "bank_spectra_current": DataArray(
-                Variable(win.sum(axis=1), ("bank", "toa"), "counts"),
+                Variable(win_spectra, ("bank", "toa"), "counts"),
                 coords=coords,
                 name="bank_spectra_current",
             ),
             "bank_spectra_cumulative": DataArray(
-                Variable(cum.sum(axis=1), ("bank", "toa"), "counts"),
+                Variable(cum_spectra, ("bank", "toa"), "counts"),
                 coords=coords,
                 name="bank_spectra_cumulative",
             ),
             "bank_counts_current": DataArray(
-                Variable(win.sum(axis=(1, 2)), ("bank",), "counts"),
+                Variable(win_counts, ("bank",), "counts"),
                 coords={"bank": bank_coord},
                 name="bank_counts_current",
             ),
+            "bank_counts_cumulative": DataArray(
+                Variable(cum_counts, ("bank",), "counts"),
+                coords={"bank": bank_coord},
+                name="bank_counts_cumulative",
+            ),
+            "counts_current": DataArray(
+                Variable(np.asarray(total_win), (), "counts"),
+                name="counts_current",
+            ),
             "counts_cumulative": DataArray(
-                Variable(np.asarray(cum.sum()), (), "counts"),
+                Variable(np.asarray(total_cum), (), "counts"),
                 name="counts_cumulative",
             ),
         }

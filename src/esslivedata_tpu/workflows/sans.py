@@ -67,6 +67,7 @@ class SansIQWorkflow:
         self._q_edges_var = Variable(q_edges, ("Q",), "1/angstrom")
         self._primary_stream = primary_stream
         self._monitor_streams = monitor_streams or set()
+        self._publish = None
 
     def accumulate(self, data: Mapping[str, Any]) -> None:
         monitor_count = 0.0
@@ -94,19 +95,23 @@ class SansIQWorkflow:
         )
 
     def finalize(self) -> dict[str, DataArray]:
-        import jax
+        if self._publish is None:
+            from ..ops.publish import PackedPublisher
 
-        win, cum, mon_win, mon_cum = jax.device_get(
-            (
-                self._state.window,
-                self._state.cumulative,
-                self._state.monitor_window,
-                self._state.monitor_cumulative,
-            )
-        )
-        win, cum = np.asarray(win), np.asarray(cum)
-        mon_win, mon_cum = float(mon_win), float(mon_cum)
-        self._state = self._hist.clear_window(self._state)
+            def program(state):
+                outputs = {
+                    "win": state.window,
+                    "cum": state.cumulative,
+                    "mon_win": state.monitor_window,
+                    "mon_cum": state.monitor_cumulative,
+                }
+                return outputs, self._hist.fold_window(state)
+
+            # One execute + one packed fetch per publish (ops/publish.py).
+            self._publish = PackedPublisher(program)
+        out, self._state = self._publish(self._state)
+        win, cum = out["win"], out["cum"]
+        mon_win, mon_cum = float(out["mon_win"]), float(out["mon_cum"])
         coords = {"Q": self._q_edges_var}
         return {
             "iq_current": self._iq(win, mon_win),

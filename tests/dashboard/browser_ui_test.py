@@ -1,0 +1,143 @@
+"""Browser-driven SPA tests (reference: tests/dashboard/browser_ui_test.py).
+
+Runs only where Playwright + a browser are installed (the CI ui-test job;
+the base image has no JS runtime). Everything here drives the real
+in-page JS — wizard schema form, grid CRUD, ROI canvas drawing — against
+a live dashboard process with the fake backend. The same flows are
+covered at the HTTP-contract level (same math, no browser) in
+roi_ui_test.py and management_surface_test.py, which run everywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+playwright_sync = pytest.importorskip(
+    "playwright.sync_api", reason="playwright not installed (CI-only test)"
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def dashboard_url():
+    port = _free_port()
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "esslivedata_tpu.dashboard.reduction",
+            "--instrument",
+            "dummy",
+            "--transport",
+            "fake",
+            "--port",
+            str(port),
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    url = f"http://127.0.0.1:{port}"
+    try:
+        for _ in range(100):
+            try:
+                urllib.request.urlopen(url + "/api/state", timeout=1)
+                break
+            except Exception:
+                time.sleep(0.2)
+        else:
+            raise RuntimeError("dashboard did not come up")
+        yield url
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+@pytest.fixture(scope="module")
+def page(dashboard_url):
+    with playwright_sync.sync_playwright() as p:
+        browser = p.chromium.launch()
+        page = browser.new_page()
+        page.goto(dashboard_url)
+        yield page
+        browser.close()
+
+
+def test_wizard_stage_commit_starts_job(page, dashboard_url):
+    # The sidebar lists one button per (workflow, source).
+    page.wait_for_selector("#workflows button", timeout=15_000)
+    button = page.locator("#workflows button", has_text="panel_0").first
+    button.click()
+    # buildWizard rendered the schema form with one input per param.
+    page.wait_for_selector("#wizard")
+    inputs = page.locator("#wizard input")
+    assert inputs.count() >= 1, "schema form rendered no fields"
+    page.locator("#wizard button", has_text="Stage + start").click()
+    # The wizard closes on successful stage+commit and a job appears.
+    page.wait_for_selector("#wizard", state="detached", timeout=10_000)
+    page.wait_for_selector("#jobs .job", timeout=15_000)
+
+
+def test_wizard_surfaces_validation_errors(page):
+    page.wait_for_selector("#workflows button", timeout=15_000)
+    page.locator("#workflows button", has_text="panel_0").first.click()
+    page.wait_for_selector("#wizard")
+    field = page.locator("#wizard input[type=number]").first
+    if field.count():
+        field.fill("-3")  # toa_bins must be positive
+        page.locator("#wizard button", has_text="Stage + start").click()
+        # Validation failure keeps the wizard open with a field error.
+        page.wait_for_timeout(500)
+        assert page.locator("#wizard").count() == 1
+    page.locator("#wizard button", has_text="Cancel").click()
+
+
+def test_roi_canvas_draw_posts_and_readback_renders(page):
+    # Wait for the grid to show a live image cell with an ROI button.
+    page.wait_for_selector(".gridcell img", timeout=30_000)
+    roi_btn = page.locator(".gridcell button", has_text="ROI").first
+    roi_btn.wait_for(timeout=15_000)
+    roi_btn.click()
+    canvas = page.locator(".roi-canvas").first
+    canvas.wait_for(timeout=10_000)
+    box = canvas.bounding_box()
+    # Drag a rectangle across the middle of the axes area.
+    x0 = box["x"] + box["width"] * 0.35
+    y0 = box["y"] + box["height"] * 0.35
+    x1 = box["x"] + box["width"] * 0.6
+    y1 = box["y"] + box["height"] * 0.6
+    page.mouse.move(x0, y0)
+    page.mouse.down()
+    page.mouse.move(x1, y1, steps=5)
+    page.mouse.up()
+    # The overlay posts the full ROI set; the backend readback must show
+    # one rectangle shortly after.
+    url = page.url.rstrip("/")
+    state = json.loads(
+        page.evaluate("async () => JSON.stringify(lastState)")
+    )
+    job = state["jobs"][0]
+    for _ in range(50):
+        readback = json.loads(
+            page.evaluate(
+                "async ([s, j]) => JSON.stringify(await (await fetch("
+                "`/api/roi?source_name=${s}&job_number=${j}`)).json())",
+                [job["source_name"], job["job_number"]],
+            )
+        )
+        if readback["rectangles"]:
+            break
+        page.wait_for_timeout(200)
+    assert readback["rectangles"], "drawn rectangle never applied"
+    assert readback["spectra_keys"], "roi_spectra outputs missing"

@@ -228,6 +228,30 @@ class ManagementApiTest(AsyncHTTPTestCase):
 
 
 class TestCommandExpiryAndReconciliation:
+    def test_error_ack_produces_notification(self):
+        # The HTTP POST that issued a command returns ok immediately; a
+        # backend rejection arrives in the async ack and must surface as
+        # an error toast (e.g. an ROI set over the per-geometry cap).
+        from esslivedata_tpu.dashboard.transport import AckMessage
+
+        events = []
+        js = JobService(on_event=lambda level, msg: events.append((level, msg)))
+        number = uuid.uuid4()
+        js.track_command("panel_0", number, "roi_update")
+        js.on_ack(
+            AckMessage(
+                payload={
+                    "source_name": "panel_0",
+                    "job_number": str(number),
+                    "status": "error",
+                    "message": "At most 4 rectangle ROIs supported",
+                }
+            )
+        )
+        assert events and events[0][0] == "error"
+        assert "rejected" in events[0][1]
+        assert "At most 4" in events[0][1]
+
     def test_expired_command_produces_notification(self, monkeypatch):
         events = []
         js = JobService(on_event=lambda level, msg: events.append((level, msg)))

@@ -1,0 +1,239 @@
+"""ROI drawing round trip through the UI contract.
+
+Drives exactly what the in-page overlay does (web.py attachRoiOverlay),
+with the same coordinate math in Python: fetch the image cell's pixel->
+data mapping from /plot/{kid}.meta, convert a simulated mouse drag into
+detector coordinates, post the rectangle, and watch the applied-ROI
+readback and roi_spectra outputs appear and track edits. Mirrors the
+reference's browser ROI tests (roi_request_plots / roi_readback_plots)
+at the protocol level; tests/dashboard/browser_ui_test.py runs the same
+flow through a real browser where Playwright is available.
+"""
+
+import json
+import time
+
+import pytest
+
+tornado = pytest.importorskip("tornado")
+
+from tornado.testing import AsyncHTTPTestCase
+
+from esslivedata_tpu.config.instruments.dummy.specs import DETECTOR_VIEW_HANDLE
+from esslivedata_tpu.dashboard.config_store import MemoryConfigStore
+from esslivedata_tpu.dashboard.dashboard_services import DashboardServices
+from esslivedata_tpu.dashboard.fake_backend import InProcessBackendTransport
+
+
+def px_to_data(meta, px, py):
+    """The JS pxToData, verbatim math (web.py)."""
+    a = meta["axes_px"]
+    fx = (px - a["x0"]) / (a["x1"] - a["x0"])
+    fy = (a["y1"] - py) / (a["y1"] - a["y0"])
+    return (
+        meta["xlim"][0] + fx * (meta["xlim"][1] - meta["xlim"][0]),
+        meta["ylim"][0] + fy * (meta["ylim"][1] - meta["ylim"][0]),
+    )
+
+
+def data_to_px(meta, x, y):
+    """The JS dataToPx, verbatim math (web.py)."""
+    a = meta["axes_px"]
+    fx = (x - meta["xlim"][0]) / (meta["xlim"][1] - meta["xlim"][0])
+    fy = (y - meta["ylim"][0]) / (meta["ylim"][1] - meta["ylim"][0])
+    return (
+        a["x0"] + fx * (a["x1"] - a["x0"]),
+        a["y1"] - fy * (a["y1"] - a["y0"]),
+    )
+
+
+class RoiUiTest(AsyncHTTPTestCase):
+    def get_app(self):
+        from esslivedata_tpu.dashboard.web import make_app
+
+        self.transport = InProcessBackendTransport(
+            "dummy", events_per_pulse=500
+        )
+        self.store = MemoryConfigStore()
+        self.services = DashboardServices(
+            transport=self.transport, config_store=self.store
+        )
+        return make_app(self.services, "dummy")
+
+    def drive(self, n=10):
+        for _ in range(n):
+            self.transport.tick()
+            self.services.pump.pump_once()
+
+    def post_json(self, url, payload):
+        return self.fetch(url, method="POST", body=json.dumps(payload))
+
+    def _start_job(self):
+        start = self.post_json(
+            "/api/workflow/start",
+            {
+                "workflow_id": str(DETECTOR_VIEW_HANDLE.workflow_id),
+                "source_name": "panel_0",
+            },
+        )
+        job_number = json.loads(start.body)["job_number"]
+        # Publish cadence is wall-clock gated in the fake backend: tick
+        # until the first outputs land (bounded).
+        for _ in range(20):
+            time.sleep(0.05)
+            self.drive(10)
+            state = json.loads(self.fetch("/api/state").body)
+            if state["keys"]:
+                break
+        return job_number
+
+    def _image_kid(self):
+        state = json.loads(self.fetch("/api/state").body)
+        for k in state["keys"]:
+            if k["output"] == "image_current":
+                return k["id"]
+        raise AssertionError("no image_current key published")
+
+    def _readback(self, job_number):
+        r = self.fetch(
+            f"/api/roi?source_name=panel_0&job_number={job_number}"
+        )
+        assert r.code == 200
+        return json.loads(r.body)
+
+    def _readback_when(self, job_number, pred):
+        """Publishing is wall-clock gated: tick until the readback shows
+        ``pred`` (bounded), then return it."""
+        rb = self._readback(job_number)
+        for _ in range(40):
+            if pred(rb):
+                break
+            time.sleep(0.05)
+            self.drive(5)
+            rb = self._readback(job_number)
+        return rb
+
+    def test_draw_edit_delete_rectangle_via_meta_mapping(self):
+        job_number = self._start_job()
+        kid = self._image_kid()
+
+        meta = json.loads(self.fetch(f"/plot/{kid}.meta").body)
+        a = meta["axes_px"]
+        assert a["x1"] > a["x0"] and a["y1"] > a["y0"]
+        # The mapping must invert exactly — the overlay relies on it to
+        # redraw readbacks where the operator dropped them.
+        x, y = px_to_data(meta, a["x0"] + 10.0, a["y0"] + 10.0)
+        px, py = data_to_px(meta, x, y)
+        assert abs(px - (a["x0"] + 10.0)) < 1e-6
+        assert abs(py - (a["y0"] + 10.0)) < 1e-6
+
+        # Simulated drag: from 20%..60% of the axes width, middle band.
+        def frac(fx, fy):
+            return px_to_data(
+                meta,
+                a["x0"] + fx * (a["x1"] - a["x0"]),
+                a["y0"] + fy * (a["y1"] - a["y0"]),
+            )
+
+        x0, y0 = frac(0.2, 0.7)
+        x1, y1 = frac(0.6, 0.3)
+        rect = {
+            "x_min": min(x0, x1),
+            "x_max": max(x0, x1),
+            "y_min": min(y0, y1),
+            "y_max": max(y0, y1),
+        }
+        r = self.post_json(
+            "/api/roi",
+            {
+                "source_name": "panel_0",
+                "job_number": job_number,
+                "rois": {"rect0": rect},
+            },
+        )
+        assert r.code == 200
+        rb = self._readback_when(job_number, lambda rb: rb["rectangles"])
+        assert len(rb["rectangles"]) == 1
+        applied = rb["rectangles"][0]
+        assert applied["x_min"] == pytest.approx(rect["x_min"])
+        assert applied["y_max"] == pytest.approx(rect["y_max"])
+        assert rb["spectra_keys"], "roi_spectra outputs missing"
+        state = json.loads(self.fetch("/api/state").body)
+        assert any(k["output"] == "roi_spectra" for k in state["keys"])
+
+        # Edit: move the rectangle right by a quarter of its width; the
+        # readback must track the move.
+        dx = (rect["x_max"] - rect["x_min"]) / 4
+        moved = {
+            "x_min": rect["x_min"] + dx,
+            "x_max": rect["x_max"] + dx,
+            "y_min": rect["y_min"],
+            "y_max": rect["y_max"],
+        }
+        self.post_json(
+            "/api/roi",
+            {
+                "source_name": "panel_0",
+                "job_number": job_number,
+                "rois": {"rect0": moved},
+            },
+        )
+        rb = self._readback_when(
+            job_number,
+            lambda rb: rb["rectangles"]
+            and rb["rectangles"][0]["x_min"] > rect["x_min"] + dx / 2,
+        )
+        assert rb["rectangles"][0]["x_min"] == pytest.approx(moved["x_min"])
+
+        # Delete (dblclick posts the remaining set = empty).
+        self.post_json(
+            "/api/roi",
+            {
+                "source_name": "panel_0",
+                "job_number": job_number,
+                "rois": {},
+            },
+        )
+        rb = self._readback_when(
+            job_number, lambda rb: not rb["rectangles"]
+        )
+        assert rb["rectangles"] == []
+
+    def test_polygon_draw_and_readback(self):
+        job_number = self._start_job()
+        kid = self._image_kid()
+        meta = json.loads(self.fetch(f"/plot/{kid}.meta").body)
+        a = meta["axes_px"]
+        pts = [
+            px_to_data(
+                meta,
+                a["x0"] + f * (a["x1"] - a["x0"]),
+                a["y0"] + g * (a["y1"] - a["y0"]),
+            )
+            for f, g in ((0.3, 0.3), (0.7, 0.35), (0.5, 0.8))
+        ]
+        poly = {"x": [p[0] for p in pts], "y": [p[1] for p in pts]}
+        r = self.post_json(
+            "/api/roi",
+            {
+                "source_name": "panel_0",
+                "job_number": job_number,
+                "rois": {"poly0": poly},
+            },
+        )
+        assert r.code == 200
+        rb = self._readback_when(job_number, lambda rb: rb["polygons"])
+        assert len(rb["polygons"]) == 1
+        assert rb["polygons"][0]["x"] == pytest.approx(poly["x"])
+
+    def test_meta_matches_png_dimensions(self):
+        self._start_job()
+        kid = self._image_kid()
+        meta = json.loads(self.fetch(f"/plot/{kid}.meta").body)
+        png = self.fetch(f"/plot/{kid}.png").body
+        # PNG IHDR: width/height as big-endian u32 at offsets 16/20.
+        width = int.from_bytes(png[16:20], "big")
+        height = int.from_bytes(png[20:24], "big")
+        assert (meta["width"], meta["height"]) == (width, height)
+        assert 0 <= meta["axes_px"]["x0"] < meta["axes_px"]["x1"] <= width
+        assert 0 <= meta["axes_px"]["y0"] < meta["axes_px"]["y1"] <= height
