@@ -175,6 +175,13 @@ def bench_latency(args) -> None:
     bytes are injected into a real service (adapters -> batcher -> staging
     -> jitted step -> da00 serialization) and the wall time from inject to
     published output is recorded. Reported on stderr.
+
+    A publish is one execute + one device->host fetch (the fused
+    PackedPublisher path), i.e. ONE accelerator round trip. Behind the
+    network relay that round trip is tens of ms where host-attached PCIe
+    would pay <1 ms, so alongside the totals this reports an interleaved
+    round-trip probe (execute+fetch of a tiny fresh array) and the
+    residual = latency - rtt, which is the framework's own cost.
     """
     from esslivedata_tpu.config import JobId, WorkflowConfig
     from esslivedata_tpu.config.instruments.dummy.specs import (
@@ -220,6 +227,19 @@ def bench_latency(args) -> None:
     )
     service.step()
 
+    import jax
+    import jax.numpy as jnp
+
+    probe = jax.jit(lambda x: x * 1.0000001)
+    probe_x = jnp.arange(16, dtype=jnp.float32)
+
+    def rtt_ms() -> float:
+        t0 = time.perf_counter()
+        np.asarray(probe(probe_x))
+        return 1e3 * (time.perf_counter() - t0)
+
+    rtt_ms()  # compile outside the timed region
+
     det = INSTRUMENT.detectors["panel_0"]
     ids_space = det.detector_number.reshape(-1)
     rng = np.random.default_rng(3)
@@ -227,6 +247,7 @@ def bench_latency(args) -> None:
     pulse_period_ns = int(1e9 / 14)
     n_pulses = 100
     latencies = []
+    rtts = []
     for pulse in range(n_pulses + 5):
         t_pulse = 1_700_000_000_000_000_000 + pulse * pulse_period_ns
         ids = rng.choice(ids_space, events_per_pulse).astype(np.int32)
@@ -241,6 +262,8 @@ def bench_latency(args) -> None:
         service.step()
         if len(producer.messages) > n_before and pulse >= 5:  # warmed
             latencies.append(1e3 * (time.perf_counter() - start))
+        if pulse >= 5 and pulse % 10 == 0:
+            rtts.append(rtt_ms())
     if not latencies:
         print(
             json.dumps(
@@ -254,9 +277,11 @@ def bench_latency(args) -> None:
         )
         return
     latencies.sort()
+    rtts.sort()
     p50 = latencies[len(latencies) // 2]
     # Nearest-rank p99 (ceil(0.99*n)-1), NOT the max sample.
     p99 = latencies[max(0, -(-99 * len(latencies) // 100) - 1)]
+    rtt50 = rtts[len(rtts) // 2] if rtts else 0.0
     print(
         json.dumps(
             {
@@ -266,6 +291,11 @@ def bench_latency(args) -> None:
                 "n": len(latencies),
                 "events_per_pulse": events_per_pulse,
                 "unit": "ms",
+                # One publish = one accelerator round trip; the residual
+                # is the framework's own cost once the link is removed.
+                "device_roundtrip_p50": rtt50,
+                "residual_p50": p50 - rtt50,
+                "residual_p99": p99 - rtt50,
             }
         ),
         file=sys.stderr,

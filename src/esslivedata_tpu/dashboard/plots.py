@@ -31,6 +31,7 @@ __all__ = [
     "PlotParams",
     "plotter_registry",
     "render_correlation_png",
+    "render_layers_png",
     "render_png",
     "render_png_with_meta",
 ]
@@ -38,19 +39,39 @@ __all__ = [
 logger = logging.getLogger(__name__)
 
 
+#: Extractor selections the cell config may name (reference exposes the
+#: same choice in its plot config modal as "data source" per plot).
+EXTRACTOR_CHOICES = ("latest", "full_history", "window_sum", "window_mean")
+
+#: Plotter forcing: '' = auto-select from shape.
+PLOTTER_CHOICES = ("", "table", "slicer")
+
+
 @dataclass(frozen=True)
 class PlotParams:
-    """Per-cell presentation knobs (the plot-config surface; reference
+    """Per-cell plot configuration (the plot-config surface; reference
     plot_config_modal.py exposes the same set per plotter).
 
-    ``scale`` applies to the y axis for 1-D plotters and to the color
-    normalization for 2-D ones; ``vmin``/``vmax`` bound the same axis.
+    Presentation: ``scale`` applies to the y axis for 1-D plotters and to
+    the color normalization for 2-D ones; ``vmin``/``vmax`` bound the
+    same axis; ``cmap`` names the colormap.
+
+    Data selection: ``extractor`` picks how the temporal buffer turns
+    into the plotted value (latest frame, full history series, or a
+    trailing ``window_s``-second sum/mean); ``plotter`` forces table or
+    slicer rendering (``slice`` = leading-dim index); ``overlay`` draws
+    every key of a multi-output cell into one axes (1-D data).
     """
 
     scale: str = "linear"  # 'linear' | 'log'
     cmap: str = "viridis"
     vmin: float | None = None
     vmax: float | None = None
+    extractor: str = "latest"
+    window_s: float | None = None
+    plotter: str = ""  # '' (auto) | 'table' | 'slicer'
+    slice: int | None = None
+    overlay: bool = False
 
     @classmethod
     def from_dict(cls, raw: dict | None) -> "PlotParams":
@@ -58,6 +79,20 @@ class PlotParams:
         scale = str(raw.get("scale", "linear"))
         if scale not in ("linear", "log"):
             raise ValueError(f"scale must be linear|log, got {scale!r}")
+        extractor = str(raw.get("extractor", "latest"))
+        # Back-compat: the pre-config-surface query flag.
+        if raw.get("history") in ("1", 1, True):
+            extractor = "full_history"
+        if extractor not in EXTRACTOR_CHOICES:
+            raise ValueError(
+                f"extractor must be one of {EXTRACTOR_CHOICES}, "
+                f"got {extractor!r}"
+            )
+        plotter = str(raw.get("plotter", ""))
+        if plotter not in PLOTTER_CHOICES:
+            raise ValueError(
+                f"plotter must be one of {PLOTTER_CHOICES}, got {plotter!r}"
+            )
 
         def _f(key):
             v = raw.get(key)
@@ -65,11 +100,18 @@ class PlotParams:
                 return None
             return float(v)
 
+        slice_raw = raw.get("slice")
+        overlay = raw.get("overlay") in (True, "1", 1, "true")
         params = cls(
             scale=scale,
             cmap=str(raw.get("cmap", "viridis")),
             vmin=_f("vmin"),
             vmax=_f("vmax"),
+            extractor=extractor,
+            window_s=_f("window_s"),
+            plotter=plotter,
+            slice=None if slice_raw in (None, "", "null") else int(slice_raw),
+            overlay=overlay,
         )
         # Bounds that would blow up at render time are config errors:
         # reject at validation so a bad edit 400s once instead of the
@@ -82,6 +124,13 @@ class PlotParams:
             raise ValueError("vmin must be < vmax")
         if scale == "log" and params.vmax is not None and params.vmax <= 0:
             raise ValueError("log scale needs vmax > 0")
+        if params.extractor.startswith("window"):
+            if params.window_s is None or params.window_s <= 0:
+                raise ValueError(
+                    f"extractor {params.extractor!r} needs window_s > 0"
+                )
+        if params.slice is not None and params.slice < 0:
+            raise ValueError("slice must be >= 0")
         return params
 
     def to_dict(self) -> dict:
@@ -97,7 +146,32 @@ class PlotParams:
             out["vmin"] = self.vmin
         if self.vmax is not None:
             out["vmax"] = self.vmax
+        if self.extractor != "latest":
+            out["extractor"] = self.extractor
+        if self.window_s is not None:
+            out["window_s"] = self.window_s
+        if self.plotter:
+            out["plotter"] = self.plotter
+        if self.slice is not None:
+            out["slice"] = self.slice
+        if self.overlay:
+            out["overlay"] = "1"
         return out
+
+    def make_extractor(self):
+        """The configured extractor instance (None = latest value)."""
+        from .extractors import (
+            FullHistoryExtractor,
+            WindowAggregatingExtractor,
+        )
+
+        if self.extractor == "full_history":
+            return FullHistoryExtractor()
+        if self.extractor == "window_sum":
+            return WindowAggregatingExtractor(self.window_s, "sum")
+        if self.extractor == "window_mean":
+            return WindowAggregatingExtractor(self.window_s, "mean")
+        return None
 
     def _norm(self):
         """Matplotlib color norm for 2-D plotters."""
@@ -262,6 +336,51 @@ class TablePlotter:
         )
         table.auto_set_font_size(False)
         table.set_fontsize(8)
+
+
+def render_layers_png(
+    layers: list[DataArray],
+    *,
+    title: str = "",
+    figsize=(5.0, 3.6),
+    dpi: int = 100,
+    params: PlotParams | None = None,
+) -> bytes:
+    """Overlay several 1-D DataArrays as labeled lines in one axes (the
+    cell 'overlay' config; reference layers multiple outputs per plot).
+    Non-1-D layers are skipped — mixing an image into a line overlay is
+    a config mistake, not a render crash."""
+    params = params or PlotParams()
+    with _render_lock:
+        fig, ax = plt.subplots(figsize=figsize, dpi=dpi)
+        try:
+            drawn = 0
+            for da in layers:
+                if np.asarray(da.values).ndim != 1:
+                    continue
+                dim = da.dims[0]
+                x, label = _coord_values(da, dim)
+                y = np.asarray(da.values, dtype=np.float64)
+                if x.size == y.size + 1:  # bin edges -> step outline
+                    ax.stairs(y, x, label=da.name or f"layer {drawn}")
+                else:
+                    ax.plot(
+                        x[: y.size], y, label=da.name or f"layer {drawn}"
+                    )
+                if drawn == 0:
+                    ax.set_xlabel(label)
+                drawn += 1
+            if drawn:
+                ax.legend(fontsize=7)
+            params._apply_y(ax)
+            if title:
+                fig.suptitle(title, fontsize=9)
+            fig.tight_layout()
+            buf = io.BytesIO()
+            fig.savefig(buf, format="png")
+            return buf.getvalue()
+        finally:
+            plt.close(fig)
 
 
 def render_correlation_png(

@@ -27,7 +27,6 @@ import tornado.web
 
 from ..config.workflow_spec import ResultKey, WorkflowId
 from .dashboard_services import DashboardServices
-from .extractors import FullHistoryExtractor
 from .plots import (
     SlicerPlotter,
     TablePlotter,
@@ -427,28 +426,36 @@ class RoiHandler(_Base):
 class PlotHandler(_Base):
     def _resolve(self, kid: str):
         """Shared resolution for the .png and .meta endpoints: key ->
-        (data, title, plotter, params), or None with the error written."""
+        (data, title, plotter, params), or None with the error written.
+
+        The whole cell configuration rides the query string — scale /
+        cmap / vmin / vmax (presentation), extractor / window_s (data
+        selection), plotter / slice (rendering) — built by the UI from
+        the owning cell's persisted params.
+        """
         try:
             key = _id_to_key(kid)
         except Exception:
             self.set_status(404)
             return None
-        history = self.get_argument("history", "0") == "1"
-        extractor = FullHistoryExtractor() if history else None
-        data = self.services.data_service.get(key, extractor)
-        if data is None:
-            self.set_status(404)
-            return None
-        title = f"{key.job_id.source_name} · {key.output_name}"
-        # Presentation params ride the query string (the UI builds plot
-        # URLs from the owning cell's persisted params).
         from .plots import PlotParams
 
         try:
             params = PlotParams.from_dict(
                 {
                     k: self.get_argument(k)
-                    for k in ("scale", "cmap", "vmin", "vmax")
+                    for k in (
+                        "scale",
+                        "cmap",
+                        "vmin",
+                        "vmax",
+                        "extractor",
+                        "window_s",
+                        "plotter",
+                        "slice",
+                        "overlay",
+                        "history",  # back-compat alias for full_history
+                    )
                     if self.get_argument(k, None) is not None
                 }
             )
@@ -456,18 +463,31 @@ class PlotHandler(_Base):
             self.set_status(400)
             self.write_json({"error": str(err)})
             return None
-        # ?slice=N picks the leading-dim slice of 3-D data (SlicerPlotter);
-        # ?plotter=table forces the tabular rendering of small 1-D data.
-        slice_arg = self.get_argument("slice", None)
+        data = self.services.data_service.get(key, params.make_extractor())
+        if data is None:
+            self.set_status(404)
+            return None
+        title = f"{key.job_id.source_name} · {key.output_name}"
         plotter = None
-        if self.get_argument("plotter", "") == "table":
+        if params.plotter == "table":
             plotter = TablePlotter()
-        elif slice_arg is not None and data.data.ndim == 3:
-            try:
-                index = int(slice_arg)
-                if not 0 <= index < data.shape[0]:
-                    raise ValueError(slice_arg)
-            except ValueError:
+        elif params.plotter == "slicer" or (
+            params.slice is not None and data.data.ndim == 3
+        ):
+            # Config-time validation cannot know the data's rank; reject
+            # here with a 400 so a misconfigured cell shows one clear
+            # error instead of 500ing on every poll.
+            if data.data.ndim != 3:
+                self.set_status(400)
+                self.write_json(
+                    {
+                        "error": "plotter 'slicer' needs 3-D data, got "
+                        f"{data.data.ndim}-D"
+                    }
+                )
+                return None
+            index = params.slice or 0
+            if not index < data.shape[0]:
                 self.set_status(400)
                 self.write_json(
                     {"error": f"slice must be in [0, {data.shape[0]})"}
@@ -481,15 +501,39 @@ class PlotHandler(_Base):
         if resolved is None:
             return
         key, data, title, plotter, params = resolved
+        # ?overlay=1&extra=<kid>...: layer every named output into one
+        # axes (1-D line overlay; the cell lists its other keys).
+        extras = self.get_arguments("extra")
         try:
-            png, meta = render_png_with_meta(
-                data, title=title, plotter=plotter, params=params
-            )
+            if params.overlay and extras:
+                from .plots import render_layers_png
+
+                layers = [data]
+                extractor = params.make_extractor()
+                for ekid in extras:
+                    try:
+                        extra = self.services.data_service.get(
+                            _id_to_key(ekid), extractor
+                        )
+                    except Exception:
+                        continue
+                    if extra is not None:
+                        layers.append(extra)
+                png = render_layers_png(layers, title=title, params=params)
+                meta = None
+            else:
+                png, meta = render_png_with_meta(
+                    data, title=title, plotter=plotter, params=params
+                )
         except Exception:
             logger.exception("Plot render failed for %s", key)
             self.set_status(500)
             return
         if suffix == ".meta":
+            if meta is None:
+                self.set_status(404)
+                self.write_json({"error": "no meta for overlay renders"})
+                return
             # Pixel->data mapping for the ROI drawing overlay.
             self.write_json(meta)
             return
@@ -673,6 +717,12 @@ async function refreshGrids() {{
     }}
     // Frame-gated repaint: only when this grid's generation advanced.
     if (gridGens[g.grid_id] === g.generation) continue;
+    // Never repaint under an active ROI edit: rebuilding the cell would
+    // destroy the canvas mid-drag (losing the mouseup that posts the
+    // edit) and re-fetch .meta every second. The image freezes while
+    // editing; it catches up when the operator hits Done.
+    if (roiEdit && roiEdit.gridId === g.grid_id
+        && box.querySelector('.roi-canvas')) continue;
     gridGens[g.grid_id] = g.generation;
     box.innerHTML = '';
     g.cells.forEach((c, i) => {{
@@ -692,6 +742,9 @@ async function refreshGrids() {{
         const img = document.createElement('img');
         const p = new URLSearchParams(c.params || {{}});
         p.set('gen', g.generation);
+        if ((c.params || {{}}).overlay) {{
+          for (const extra of c.keys.slice(1)) p.append('extra', extra);
+        }}
         img.src = '/plot/' + kid + '.png?' + p.toString();
         wrap.appendChild(img);
         cell.appendChild(wrap);
@@ -713,17 +766,73 @@ async function refreshGrids() {{
     }});
   }}
 }}
-async function editCell(gridId, index, params) {{
-  // Minimal plot-config surface: scale / cmap / bounds as JSON.
-  const raw = prompt(
-    'Plot params (scale: linear|log, cmap, vmin, vmax)',
-    JSON.stringify(params || {{scale: 'linear'}}));
-  if (raw === null) return;
-  let parsed;
-  try {{ parsed = JSON.parse(raw); }} catch (e) {{ alert('invalid JSON'); return; }}
-  const r = await fetch(`/api/grid/${{gridId}}/cell/${{index}}/config`, {{
-    method: 'POST', body: JSON.stringify({{params: parsed}})}});
-  if (!r.ok) alert((await r.json()).error);
+// Per-cell plot configuration modal: presentation (scale/cmap/bounds),
+// data selection (extractor/window), rendering (plotter/slice/overlay).
+// Persists through the config store, so every client's cell follows.
+const CELL_CONFIG_FIELDS = [
+  {{key: 'scale', kind: 'select', choices: ['linear', 'log']}},
+  {{key: 'cmap', kind: 'text', hint: 'matplotlib colormap'}},
+  {{key: 'vmin', kind: 'number', hint: 'lower bound'}},
+  {{key: 'vmax', kind: 'number', hint: 'upper bound'}},
+  {{key: 'extractor', kind: 'select',
+    choices: ['latest', 'full_history', 'window_sum', 'window_mean']}},
+  {{key: 'window_s', kind: 'number', hint: 'seconds (window_* extractors)'}},
+  {{key: 'plotter', kind: 'select', choices: ['', 'table', 'slicer']}},
+  {{key: 'slice', kind: 'number', hint: 'leading-dim index (slicer)'}},
+  {{key: 'overlay', kind: 'checkbox', hint: 'layer all outputs in one axes'}},
+];
+function editCell(gridId, index, params) {{
+  const old = document.getElementById('cellcfg');
+  if (old) old.remove();
+  params = params || {{}};
+  const box = el('div', 'card'); box.id = 'cellcfg';
+  box.style.cssText =
+    'position:fixed;top:80px;left:50%;transform:translateX(-50%);' +
+    'z-index:10;min-width:300px;box-shadow:0 4px 24px rgba(0,0,0,.35)';
+  box.appendChild(el('h3', '', 'Plot config'));
+  const inputs = {{}};
+  for (const f of CELL_CONFIG_FIELDS) {{
+    const row = el('div');
+    const label = el('label', '', f.key + ' ');
+    if (f.hint) label.title = f.hint;
+    let input;
+    if (f.kind === 'select') {{
+      input = document.createElement('select');
+      for (const c of f.choices) {{
+        const o = document.createElement('option');
+        o.value = c; o.textContent = c === '' ? '(auto)' : c;
+        input.appendChild(o);
+      }}
+      input.value = params[f.key] !== undefined ? String(params[f.key]) : f.choices[0];
+    }} else if (f.kind === 'checkbox') {{
+      input = document.createElement('input'); input.type = 'checkbox';
+      input.checked = params[f.key] === '1' || params[f.key] === true;
+    }} else {{
+      input = document.createElement('input');
+      input.type = f.kind; if (f.kind === 'number') input.step = 'any';
+      input.value = params[f.key] !== undefined ? params[f.key] : '';
+    }}
+    row.appendChild(label); row.appendChild(input);
+    box.appendChild(row);
+    inputs[f.key] = {{input, f}};
+  }}
+  const status = el('small', ''); status.style.color = '#b00020';
+  const save = el('button', '', 'Save');
+  const cancel = el('button', '', 'Cancel');
+  cancel.onclick = () => box.remove();
+  save.onclick = async () => {{
+    const out = {{}};
+    for (const [key, {{input, f}}] of Object.entries(inputs)) {{
+      if (f.kind === 'checkbox') {{ if (input.checked) out[key] = '1'; continue; }}
+      if (input.value !== '') out[key] = input.value;
+    }}
+    const r = await fetch(`/api/grid/${{gridId}}/cell/${{index}}/config`, {{
+      method: 'POST', body: JSON.stringify({{params: out}})}});
+    if (!r.ok) {{ status.textContent = (await r.json()).error; return; }}
+    box.remove(); gridGens = {{}}; refreshGrids();
+  }};
+  box.appendChild(save); box.appendChild(cancel); box.appendChild(status);
+  document.body.appendChild(box);
 }}
 // -- ROI drawing: rectangle/polygon overlay on detector images --------
 // Coordinate math mirrors /plot/{{kid}}.meta: the axes' pixel bbox plus

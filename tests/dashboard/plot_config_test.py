@@ -1,0 +1,201 @@
+"""Per-cell plot configuration depth: extractor choice, window
+aggregation, plotter forcing, overlay layers — round-tripped through the
+config store and honored by the PNG endpoint (reference scope:
+plot_config_modal.py's config model, not its Panel widgetry)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+tornado = pytest.importorskip("tornado")
+
+from tornado.testing import AsyncHTTPTestCase
+
+from esslivedata_tpu.config.instruments.dummy.specs import DETECTOR_VIEW_HANDLE
+from esslivedata_tpu.dashboard.config_store import MemoryConfigStore
+from esslivedata_tpu.dashboard.dashboard_services import DashboardServices
+from esslivedata_tpu.dashboard.fake_backend import InProcessBackendTransport
+from esslivedata_tpu.dashboard.plots import PlotParams
+
+
+class TestPlotParamsModel:
+    def test_defaults_serialize_empty(self):
+        assert PlotParams().to_dict() == {}
+
+    def test_full_round_trip(self):
+        raw = {
+            "scale": "log",
+            "cmap": "magma",
+            "vmin": 0.1,
+            "vmax": 10.0,
+            "extractor": "window_mean",
+            "window_s": 5.0,
+            "plotter": "table",
+            "overlay": "1",
+        }
+        params = PlotParams.from_dict(raw)
+        assert params.extractor == "window_mean"
+        assert params.window_s == 5.0
+        assert params.overlay is True
+        # Normalized form re-parses identically (store -> URL -> render).
+        assert PlotParams.from_dict(params.to_dict()) == params
+
+    def test_unknown_extractor_rejected(self):
+        with pytest.raises(ValueError, match="extractor"):
+            PlotParams.from_dict({"extractor": "psychic"})
+
+    def test_window_extractor_requires_window(self):
+        with pytest.raises(ValueError, match="window_s"):
+            PlotParams.from_dict({"extractor": "window_sum"})
+
+    def test_history_flag_back_compat(self):
+        assert (
+            PlotParams.from_dict({"history": "1"}).extractor == "full_history"
+        )
+
+    def test_make_extractor_kinds(self):
+        from esslivedata_tpu.dashboard.extractors import (
+            FullHistoryExtractor,
+            WindowAggregatingExtractor,
+        )
+
+        assert PlotParams().make_extractor() is None
+        assert isinstance(
+            PlotParams.from_dict({"extractor": "full_history"}).make_extractor(),
+            FullHistoryExtractor,
+        )
+        ext = PlotParams.from_dict(
+            {"extractor": "window_sum", "window_s": 3}
+        ).make_extractor()
+        assert isinstance(ext, WindowAggregatingExtractor)
+
+
+class PlotConfigHttpTest(AsyncHTTPTestCase):
+    def get_app(self):
+        from esslivedata_tpu.dashboard.web import make_app
+
+        self.transport = InProcessBackendTransport(
+            "dummy", events_per_pulse=300
+        )
+        self.services = DashboardServices(
+            transport=self.transport, config_store=MemoryConfigStore()
+        )
+        return make_app(self.services, "dummy")
+
+    def drive(self, n=10):
+        for _ in range(n):
+            self.transport.tick()
+            self.services.pump.pump_once()
+
+    def post_json(self, url, payload):
+        return self.fetch(url, method="POST", body=json.dumps(payload))
+
+    def _start_and_wait(self):
+        self.post_json(
+            "/api/workflow/start",
+            {
+                "workflow_id": str(DETECTOR_VIEW_HANDLE.workflow_id),
+                "source_name": "panel_0",
+            },
+        )
+        for _ in range(20):
+            time.sleep(0.05)
+            self.drive(10)
+            state = json.loads(self.fetch("/api/state").body)
+            if state["keys"]:
+                return state
+        raise AssertionError("no outputs published")
+
+    def _kid(self, state, output):
+        return next(k["id"] for k in state["keys"] if k["output"] == output)
+
+    def test_cell_config_round_trips_and_renders(self):
+        state = self._start_and_wait()
+        r = self.post_json(
+            "/api/grid", {"name": "cfg", "nrows": 1, "ncols": 1}
+        )
+        gid = json.loads(r.body)["grid_id"]
+        r = self.post_json(
+            f"/api/grid/{gid}/cell",
+            {
+                "geometry": {"row": 0, "col": 0},
+                "output": "spectrum_current",
+                "params": {
+                    "scale": "log",
+                    "extractor": "window_sum",
+                    "window_s": 10,
+                },
+            },
+        )
+        assert r.code == 200
+        grids = json.loads(self.fetch("/api/grids").body)["grids"]
+        cell = next(g for g in grids if g["grid_id"] == gid)["cells"][0]
+        assert cell["params"]["extractor"] == "window_sum"
+        assert cell["params"]["window_s"] == 10.0
+
+        # The persisted params drive the render exactly as the UI does:
+        # params -> query string -> PNG.
+        kid = self._kid(state, "spectrum_current")
+        from urllib.parse import urlencode
+
+        png = self.fetch(f"/plot/{kid}.png?{urlencode(cell['params'])}")
+        assert png.code == 200 and png.body[:4] == b"\x89PNG"
+
+    def test_window_sum_extractor_accumulates(self):
+        state = self._start_and_wait()
+        key_obj = next(
+            k
+            for k in self.services.data_service.keys()
+            if k.output_name == "counts_current"
+        )
+        latest = self.services.data_service.get(key_obj)
+        params = PlotParams.from_dict(
+            {"extractor": "window_sum", "window_s": 3600}
+        )
+        summed = self.services.data_service.get(
+            key_obj, params.make_extractor()
+        )
+        # Several publishes happened; the trailing-window sum must exceed
+        # any single frame (counts are strictly positive here).
+        assert float(np.asarray(summed.values)) >= float(
+            np.asarray(latest.values)
+        )
+
+    def test_bad_cell_config_rejected_with_400(self):
+        r = self.post_json("/api/grid", {"name": "bad", "nrows": 1, "ncols": 1})
+        gid = json.loads(r.body)["grid_id"]
+        r = self.post_json(
+            f"/api/grid/{gid}/cell",
+            {
+                "geometry": {"row": 0, "col": 0},
+                "output": "x",
+                "params": {"extractor": "window_sum"},  # missing window_s
+            },
+        )
+        assert r.code == 400
+        assert "window_s" in json.loads(r.body)["error"]
+
+    def test_overlay_renders_layers(self):
+        state = self._start_and_wait()
+        kid = self._kid(state, "spectrum_current")
+        extra = self._kid(state, "spectrum_cumulative")
+        png = self.fetch(f"/plot/{kid}.png?overlay=1&extra={extra}")
+        assert png.code == 200 and png.body[:4] == b"\x89PNG"
+        # Overlay renders have no single-axes meta mapping.
+        meta = self.fetch(f"/plot/{kid}.meta?overlay=1&extra={extra}")
+        assert meta.code == 404
+
+    def test_plotter_forcing_table(self):
+        state = self._start_and_wait()
+        kid = self._kid(state, "counts_current")
+        png = self.fetch(f"/plot/{kid}.png?plotter=table")
+        assert png.code == 200 and png.body[:4] == b"\x89PNG"
+
+    def test_slicer_on_non_3d_rejected_with_400(self):
+        state = self._start_and_wait()
+        kid = self._kid(state, "spectrum_current")
+        r = self.fetch(f"/plot/{kid}.png?plotter=slicer")
+        assert r.code == 400
+        assert "3-D" in json.loads(r.body)["error"]
