@@ -50,9 +50,13 @@ MIN_DIFFS = 4
 DIFF_BUFFER = 32
 #: Batches a stream may be silent before its state is dropped.
 EVICT_AFTER_ABSENT = 5
-#: Integer-Hz snap tolerance: relative and absolute-floor (see module doc).
-_SNAP_REL = 0.1
-_SNAP_ABS_HZ = 0.1
+#: Integer-Hz snap tolerance: relative and absolute-floor. Tight on
+#: purpose — a genuinely non-integer rate (e.g. 14.5 Hz) must be REJECTED
+#: rather than snapped, because a grid built on the wrong integer rate
+#: drifts phase within a batch and turns every close into a timeout.
+#: Jittered-but-integer rates land well inside 1% after the median.
+_SNAP_REL = 0.01
+_SNAP_ABS_HZ = 0.02
 #: Allowed integer-Hz rounding drift when mapping timestamps to slots (ns).
 _DRIFT_NS = 1_000_000
 #: A grid origin further than this many windows from the batch start means the

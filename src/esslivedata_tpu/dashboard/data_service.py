@@ -104,6 +104,17 @@ class DataService:
             if subscription in self._subscriptions:
                 self._subscriptions.remove(subscription)
 
+    def require_history(self, key: ResultKey) -> None:
+        """Retain history for ``key`` even without a subscription.
+
+        The pull path (plot cells configured with a history-wanting
+        extractor) has no subscription to announce demand through;
+        whoever installs such a cell calls this, upgrading the key's
+        buffer in place (the current latest value is carried over).
+        """
+        with self._lock:
+            self._buffers.require_history(key)
+
     # -- reads -------------------------------------------------------------
     def get(self, key: ResultKey, extractor: Extractor | None = None) -> Any:
         extractor = extractor or LatestValueExtractor()

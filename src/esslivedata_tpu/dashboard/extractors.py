@@ -54,8 +54,41 @@ class FullHistoryExtractor(Extractor):
         return entries
 
 
+#: Per-window provenance stamps Job.get puts on every output (0-d); they
+#: differ between every two publishes by construction and must not count
+#: as a structure change when aggregating across windows. A coord that
+#: indexes a data dim (e.g. an NXlog's 1-D 'time' axis) is NOT a stamp —
+#: different axis values mean different data and must restart.
+_STAMP_COORDS = frozenset({"start_time", "end_time"})
+
+
+def _aggregation_compatible(a: DataArray, b: DataArray) -> bool:
+    """Structure equality ignoring the per-window stamp coords.
+
+    Unit equality is exact: a compatible-but-rescaled unit would need a
+    conversion the raw-value summation below does not perform, so a unit
+    change restarts the aggregate instead.
+    """
+    if a.dims != b.dims or a.shape != b.shape:
+        return False
+    if a.unit != b.unit:
+        return False
+    keys_a = set(a.coords) - _STAMP_COORDS
+    keys_b = set(b.coords) - _STAMP_COORDS
+    if keys_a != keys_b:
+        return False
+    return all(a.coords[c].identical(b.coords[c]) for c in keys_a)
+
+
 class WindowAggregatingExtractor(Extractor):
-    """Sum/mean over a trailing time window of structurally-equal entries."""
+    """Sum/mean over a trailing time window of structurally-equal entries.
+
+    "Structurally equal" ignores the per-window ``start_time``/``end_time``
+    stamps (they change every publish); a genuine structure change (shape,
+    binning coords, unit) restarts the aggregate at that entry. The result
+    carries the aggregated span: ``start_time`` of the first entry in the
+    group, everything else from the last.
+    """
 
     wants_history = True
 
@@ -75,12 +108,28 @@ class WindowAggregatingExtractor(Extractor):
         arrays = [v for _, v in entries if isinstance(v, DataArray)]
         if not arrays:
             return entries[-1][1]
-        result = arrays[0].copy()
-        for da in arrays[1:]:
-            if result.same_structure(da):
-                result += da
+        total: np.ndarray | None = None
+        first = template = arrays[0]
+        count = 0
+        for da in arrays:
+            if total is None or not _aggregation_compatible(template, da):
+                first = da  # structure changed mid-window: restart
+                total = np.array(da.values, dtype=np.float64, copy=True)
+                count = 1
             else:
-                result = da.copy()  # structure changed mid-window: restart
-        if self._operation == "mean" and len(arrays) > 1:
-            result.data = result.data * (1.0 / len(arrays))
+                total = total + np.asarray(da.values, dtype=np.float64)
+                count += 1
+            template = da
+        if self._operation == "mean":
+            # Means stay float64: casting back to an integer count dtype
+            # would silently floor non-integer averages.
+            values = total / count if count > 1 else total
+        else:
+            values = total.astype(
+                np.asarray(template.values).dtype, copy=False
+            )
+        result = template.copy()
+        result.data = Variable(values, template.dims, template.unit)
+        if "start_time" in first.coords:
+            result.coords["start_time"] = first.coords["start_time"]
         return result

@@ -50,6 +50,23 @@ class PlotCell:
             return False
         return bool(s.workflow or s.output or s.source)
 
+    @property
+    def wants_history(self) -> bool:
+        """True when this cell's configured extractor aggregates over the
+        key's past values — the data service must then retain history for
+        the cell's keys (pull path has no subscription to announce it).
+        Derived from the extractor itself so a new history-wanting
+        extractor cannot silently miss the buffer upgrade."""
+        from .plots import PlotParams
+
+        try:
+            extractor = PlotParams.from_dict(
+                dict(self.spec.params or {})
+            ).make_extractor()
+        except ValueError:
+            return False
+        return extractor is not None and extractor.wants_history
+
 
 @dataclass
 class PlotGrid:
@@ -146,6 +163,8 @@ class PlotOrchestrator:
                 for cell in grid.cells:
                     if cell.matches(key):
                         cell.keys.add(key)
+        for cell in grid.cells:
+            self._sync_history_demand(cell)
         if persist:
             self._persist(grid)
         self.clock.commit(grid_id)
@@ -170,6 +189,7 @@ class PlotOrchestrator:
             grid.spec = replace(
                 grid.spec, cells=(*grid.spec.cells, cell_spec)
             )
+        self._sync_history_demand(cell)
         self._persist(grid)
         self.clock.commit(grid_id)
         return cell
@@ -206,21 +226,42 @@ class PlotOrchestrator:
             cells = list(grid.spec.cells)
             cells[index] = new_spec
             grid.spec = replace(grid.spec, cells=tuple(cells))
+        self._sync_history_demand(new_cell)
         self._persist(grid)
         self.clock.commit(grid_id)
         return new_cell
+
+    def _sync_history_demand(self, cell: PlotCell) -> None:
+        """Upgrade the buffers of a history-wanting cell's keys.
+
+        The render pull path carries no subscription, so demand is
+        announced here — at every point a cell gains keys or its config
+        changes. Idempotent; never downgrades (another consumer may still
+        want the history).
+        """
+        if not cell.wants_history:
+            return
+        with self._lock:
+            keys = set(cell.keys)
+        for key in keys:
+            self._data.require_history(key)
 
     # -- data binding --------------------------------------------------------
     def _on_data(self, keys: set[ResultKey]) -> None:
         """Ingestion-side: match new keys to cells, commit touched grids."""
         touched: set[str] = set()
+        bound: list[PlotCell] = []
         with self._lock:
             for grid in self._grids.values():
                 for cell in grid.cells:
                     for key in keys:
                         if key in cell.keys or cell.matches(key):
-                            cell.keys.add(key)
+                            if key not in cell.keys:
+                                cell.keys.add(key)
+                                bound.append(cell)
                             touched.add(grid.grid_id)
+        for cell in bound:
+            self._sync_history_demand(cell)
         for grid_id in touched:
             self.clock.commit(grid_id)
 

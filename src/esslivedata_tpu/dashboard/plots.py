@@ -207,6 +207,14 @@ def _coord_values(da: DataArray, dim: str) -> tuple[np.ndarray, str]:
     return np.arange(n + 1, dtype=float), dim
 
 
+def _draw_1d(ax, x: np.ndarray, y: np.ndarray, label: str | None = None):
+    """One 1-D series: histogram steps for edge coords, line otherwise.
+    The single place the edges-vs-points decision lives."""
+    if x.size == y.size + 1:
+        return ax.stairs(y, x, label=label)
+    return ax.plot(x[: y.size], y, label=label)
+
+
 class LinePlotter:
     """1-D data: histogram steps (edge coords) or line (point coords)."""
 
@@ -214,10 +222,7 @@ class LinePlotter:
         dim = da.dims[0]
         x, label = _coord_values(da, dim)
         y = np.asarray(da.values, dtype=np.float64)
-        if x.size == y.size + 1:
-            ax.stairs(y, x)
-        else:
-            ax.plot(x[: y.size], y)
+        _draw_1d(ax, x, y)
         params._apply_y(ax)
         ax.set_xlabel(label)
         ax.set_ylabel(f"[{da.unit!r}]")
@@ -252,11 +257,7 @@ class Overlay1DPlotter:
         x, label = _coord_values(da, dim)
         values = np.asarray(da.values, dtype=np.float64)
         for i in range(values.shape[0]):
-            y = values[i]
-            if x.size == y.size + 1:
-                ax.stairs(y, x, label=f"{cat_dim} {i}")
-            else:
-                ax.plot(x[: y.size], y, label=f"{cat_dim} {i}")
+            _draw_1d(ax, x, values[i], label=f"{cat_dim} {i}")
         params._apply_y(ax)
         ax.legend(loc="upper right", fontsize="small")
         ax.set_xlabel(label)
@@ -361,12 +362,7 @@ def render_layers_png(
                 dim = da.dims[0]
                 x, label = _coord_values(da, dim)
                 y = np.asarray(da.values, dtype=np.float64)
-                if x.size == y.size + 1:  # bin edges -> step outline
-                    ax.stairs(y, x, label=da.name or f"layer {drawn}")
-                else:
-                    ax.plot(
-                        x[: y.size], y, label=da.name or f"layer {drawn}"
-                    )
+                _draw_1d(ax, x, y, label=da.name or f"layer {drawn}")
                 if drawn == 0:
                     ax.set_xlabel(label)
                 drawn += 1

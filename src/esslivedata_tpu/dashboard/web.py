@@ -504,6 +504,12 @@ class PlotHandler(_Base):
         # ?overlay=1&extra=<kid>...: layer every named output into one
         # axes (1-D line overlay; the cell lists its other keys).
         extras = self.get_arguments("extra")
+        if params.overlay and extras and suffix == ".meta":
+            # Overlay renders have no single-axes mapping; answer before
+            # paying a full render under the shared matplotlib lock.
+            self.set_status(404)
+            self.write_json({"error": "no meta for overlay renders"})
+            return
         try:
             if params.overlay and extras:
                 from .plots import render_layers_png

@@ -1,0 +1,228 @@
+"""Gated-stream scenario matrix (reference gated_stream_test.py).
+
+The basics (estimator convergence, slot gating, timeouts) live in
+rate_aware_batcher_test.py; this file ports the reference's scenario
+depth: jitter robustness at realistic rates, sub-rate handling across
+window changes, origin stability across grid rebuilds, and rate changes.
+All scenarios run against the internal ``_StreamState``/``SlotGrid``
+machinery plus the whole batcher where the behavior is cross-stream.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.core import Duration, Message, StreamId, StreamKind, Timestamp
+from esslivedata_tpu.core.rate_aware_batcher import (
+    PeriodEstimator,
+    RateAwareMessageBatcher,
+    _StreamState,
+)
+
+DET = StreamId(kind=StreamKind.DETECTOR_EVENTS, name="det0")
+PULSE_NS = round(1e9 / 14)
+
+
+def msg(ts_ns: int, stream=DET) -> Message:
+    return Message(timestamp=Timestamp.from_ns(ts_ns), stream=stream, value=0)
+
+
+def feed(state: _StreamState, times_ns, window_start_ns=0) -> None:
+    for t in times_ns:
+        state.route(msg(t), Timestamp.from_ns(window_start_ns))
+
+
+class TestEstimatorJitterScenarios:
+    """Realistic timing noise must not defeat integer-rate recovery."""
+
+    def test_14hz_with_100us_jitter_snaps(self):
+        rng = np.random.default_rng(0)
+        est = PeriodEstimator()
+        for i in range(40):
+            est.observe(i * PULSE_NS + int(rng.normal(0, 100_000)))
+        assert est.integer_rate_hz == 14
+
+    def test_14hz_with_jitter_and_missing_pulses(self):
+        rng = np.random.default_rng(1)
+        est = PeriodEstimator()
+        for i in range(60):
+            if i % 7 == 3:  # every 7th pulse missing
+                continue
+            est.observe(i * PULSE_NS + int(rng.normal(0, 50_000)))
+        assert est.integer_rate_hz == 14
+
+    def test_1hz_with_jitter_snaps(self):
+        rng = np.random.default_rng(2)
+        est = PeriodEstimator()
+        for i in range(30):
+            est.observe(i * 1_000_000_000 + int(rng.normal(0, 2_000_000)))
+        assert est.integer_rate_hz == 1
+
+    def test_high_rate_with_small_jitter(self):
+        rng = np.random.default_rng(3)
+        est = PeriodEstimator()
+        for i in range(50):
+            est.observe(i * 10_000_000 + int(rng.normal(0, 10_000)))
+        assert est.integer_rate_hz == 100
+
+    def test_sub_hz_rate_unconverged(self):
+        est = PeriodEstimator()
+        for i in range(40):
+            est.observe(i * 2_500_000_000)  # 0.4 Hz
+        assert est.integer_rate_hz is None
+
+    def test_rate_between_integers_rejected(self):
+        est = PeriodEstimator()
+        for i in range(40):
+            est.observe(round(i * 1e9 / 14.5))
+        assert est.integer_rate_hz is None
+
+    def test_split_message_bursts_filtered(self):
+        # A producer splitting each pulse into 3 messages emits zero/tiny
+        # diffs; the estimator must still see 14 Hz, not 42.
+        est = PeriodEstimator()
+        for i in range(40):
+            base = i * PULSE_NS
+            for _ in range(3):
+                est.observe(base)
+        assert est.integer_rate_hz == 14
+
+
+class TestGridLifecycle:
+    def _converged(self, rate_hz=14, n=40) -> _StreamState:
+        state = _StreamState()
+        period = round(1e9 / rate_hz)
+        feed(state, [i * period for i in range(n)])
+        return state
+
+    def test_no_grid_before_convergence(self):
+        state = _StreamState()
+        feed(state, [0, PULSE_NS])
+        state.refresh_grid(Timestamp.from_ns(0), Duration.from_s(1.0))
+        assert not state.is_gating
+
+    def test_integer_rate_builds_grid(self):
+        state = self._converged()
+        state.refresh_grid(Timestamp.from_ns(40 * PULSE_NS), Duration.from_s(1.0))
+        assert state.is_gating
+        assert state.grid.slots_per_batch == 14
+
+    def test_sub_rate_stream_never_gates(self):
+        # 1 Hz stream against a 0.5 s window: less than one slot per
+        # batch — gating it would deadlock every batch.
+        state = _StreamState()
+        feed(state, [i * 1_000_000_000 for i in range(30)])
+        state.refresh_grid(
+            Timestamp.from_ns(30_000_000_000), Duration.from_s(0.5)
+        )
+        assert not state.is_gating
+
+    def test_window_shrink_drops_now_subrate_grid(self):
+        state = _StreamState()
+        feed(state, [i * 1_000_000_000 for i in range(30)])
+        state.refresh_grid(Timestamp.from_ns(30_000_000_000), Duration.from_s(2.0))
+        assert state.is_gating  # 2 slots per batch at 1 Hz
+        state.refresh_grid(Timestamp.from_ns(32_000_000_000), Duration.from_s(0.5))
+        assert not state.is_gating
+
+    def test_window_grow_regates_subrate_stream(self):
+        state = _StreamState()
+        feed(state, [i * 1_000_000_000 for i in range(30)])
+        state.refresh_grid(Timestamp.from_ns(30_000_000_000), Duration.from_s(0.5))
+        assert not state.is_gating
+        state.refresh_grid(Timestamp.from_ns(30_000_000_000), Duration.from_s(4.0))
+        assert state.is_gating
+        assert state.grid.slots_per_batch == 4
+
+
+class TestOriginStability:
+    """The grid origin anchors slot phase; rebuilds must not walk it."""
+
+    def _gating_state(self) -> _StreamState:
+        state = _StreamState()
+        feed(state, [i * PULSE_NS for i in range(40)])
+        state.refresh_grid(Timestamp.from_ns(40 * PULSE_NS), Duration.from_s(1.0))
+        assert state.is_gating
+        return state
+
+    def test_origin_preserved_on_rebuild(self):
+        state = self._gating_state()
+        origin = state.grid.origin_ns
+        state.refresh_grid(Timestamp.from_ns(41 * PULSE_NS), Duration.from_s(1.0))
+        assert state.grid.origin_ns == origin
+
+    def test_origin_preserved_across_window_change(self):
+        state = self._gating_state()
+        origin = state.grid.origin_ns
+        state.refresh_grid(Timestamp.from_ns(42 * PULSE_NS), Duration.from_s(2.0))
+        assert state.grid.origin_ns == origin
+        assert state.grid.slots_per_batch == 28
+
+    def test_implausibly_stale_origin_replaced(self):
+        state = self._gating_state()
+        # Jump the stream epoch far beyond the plausibility bound (1000
+        # windows): the old origin must be abandoned, and with a bucketed
+        # in-window message available, re-anchored on it.
+        far = 10_000 * 1_000_000_000
+        state.bucket.append(msg(far + 3 * PULSE_NS))
+        state.refresh_grid(Timestamp.from_ns(far), Duration.from_s(1.0))
+        assert state.is_gating
+        assert state.grid.origin_ns == far + 3 * PULSE_NS
+
+    def test_stale_origin_without_candidate_drops_grid(self):
+        state = self._gating_state()
+        state.bucket.clear()
+        state.estimator.last_ns = 39 * PULSE_NS  # also stale
+        far = 10_000 * 1_000_000_000
+        state.refresh_grid(Timestamp.from_ns(far), Duration.from_s(1.0))
+        assert not state.is_gating
+
+    def test_in_window_bucket_message_preferred_over_older(self):
+        state = _StreamState()
+        feed(state, [i * PULSE_NS for i in range(40)])
+        window_start = 50 * PULSE_NS
+        state.bucket.clear()
+        state.bucket.append(msg(45 * PULSE_NS))  # before the window
+        state.bucket.append(msg(window_start + PULSE_NS))  # inside
+        state.refresh_grid(Timestamp.from_ns(window_start), Duration.from_s(1.0))
+        assert state.grid.origin_ns == window_start + PULSE_NS
+
+
+class TestRateChange:
+    def test_rate_change_rebuilds_slot_count(self):
+        state = _StreamState()
+        feed(state, [i * PULSE_NS for i in range(40)])
+        state.refresh_grid(Timestamp.from_ns(40 * PULSE_NS), Duration.from_s(1.0))
+        assert state.grid.slots_per_batch == 14
+        # The source reconfigures to 7 Hz; the estimator's window rolls
+        # over to the new period and the next refresh follows it.
+        t0 = 40 * PULSE_NS
+        feed(state, [t0 + i * round(1e9 / 7) for i in range(1, 41)])
+        state.refresh_grid(
+            Timestamp.from_ns(t0 + 41 * round(1e9 / 7)), Duration.from_s(1.0)
+        )
+        assert state.grid.slots_per_batch == 7
+
+
+class TestWholeBatcherGating:
+    def test_gated_and_opportunistic_streams_one_batch(self):
+        batcher = RateAwareMessageBatcher(Duration.from_s(1.0))
+        log = StreamId(kind=StreamKind.LOG, name="temp")
+        # Converge the detector stream at 14 Hz (bootstrap flushes first).
+        batcher.batch([msg(i * PULSE_NS) for i in range(40)])
+        batches = []
+        t0 = 40 * PULSE_NS
+        for i in range(28):
+            out = batcher.batch(
+                [
+                    msg(t0 + i * PULSE_NS),
+                    msg(t0 + i * PULSE_NS + 1000, stream=log),
+                ]
+            )
+            if out is not None:
+                batches.append(out)
+        assert batches, "gated stream never closed a batch"
+        # Log messages ride the same batches without gating them.
+        kinds = {m.stream.kind for b in batches for m in b.messages}
+        assert kinds == {StreamKind.DETECTOR_EVENTS, StreamKind.LOG}

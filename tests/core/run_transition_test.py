@@ -1,0 +1,183 @@
+"""Run-transition ordering scenarios (reference run_transition_test.py).
+
+The basics (scheduled resets firing at data time, collapse, persistence)
+live in job_manager_test.py; this file covers the ordering-sensitive
+scenarios: boundaries announced behind the data stream, batches
+straddling the boundary, selective resets across mixed job flags, and
+reset consumption with no active jobs.
+"""
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.config import JobId, JobSchedule, WorkflowConfig, WorkflowSpec
+from esslivedata_tpu.core.job_manager import JobFactory, JobManager
+from esslivedata_tpu.core.message import RunStart, RunStop
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.utils import DataArray, Variable
+from esslivedata_tpu.workflows import WorkflowFactory
+
+T = Timestamp.from_ns
+
+
+class CountingWorkflow:
+    def __init__(self):
+        self.total = 0.0
+        self.clear_calls = 0
+
+    def accumulate(self, data):
+        for v in data.values():
+            self.total += v
+
+    def finalize(self):
+        return {
+            "total": DataArray(
+                Variable(np.asarray(self.total), (), "counts"), name="total"
+            )
+        }
+
+    def clear(self):
+        self.clear_calls += 1
+        self.total = 0.0
+
+
+@pytest.fixture
+def registry():
+    reg = WorkflowFactory()
+    for name, flag in (("count", True), ("survivor", False)):
+        spec = WorkflowSpec(
+            instrument="dummy",
+            name=name,
+            source_names=["bank0"],
+            reset_on_run_transition=flag,
+        )
+        reg.register_spec(spec).attach_factory(
+            lambda *, source_name, params: CountingWorkflow()
+        )
+    return reg
+
+
+@pytest.fixture
+def manager(registry):
+    return JobManager(job_factory=JobFactory(registry), job_threads=1)
+
+
+def start(manager, registry, name="count", source="bank0"):
+    spec = next(
+        s for s in registry.specs_for_instrument("dummy") if s.name == name
+    )
+    config = WorkflowConfig(
+        identifier=spec.identifier,
+        job_id=JobId(source_name=source),
+        schedule=JobSchedule(),
+    )
+    manager.schedule_job(config)
+    return config.job_id
+
+
+def push(manager, value=1.0, *, start_ns, end_ns):
+    return manager.process_jobs(
+        {"bank0": value}, start=T(start_ns), end=T(end_ns)
+    )
+
+
+def workflow_of(manager, job_id):
+    return manager._records[job_id].job.workflow  # noqa: SLF001 - test probe
+
+
+class TestBoundaryBehindData:
+    def test_boundary_already_passed_fires_on_next_push(self, registry, manager):
+        """A RunStart whose boundary the data stream already passed must
+        still reset — on the very next processed window, whatever its
+        end time."""
+        jid = start(manager, registry)
+        push(manager, 5.0, start_ns=0, end_ns=5_000)
+        assert workflow_of(manager, jid).total == 5.0
+        # Announcement arrives late: boundary at 3000, data is at 5000.
+        manager.handle_run_transition(RunStart(run_name="r", start_time=T(3_000)))
+        push(manager, 2.0, start_ns=5_000, end_ns=6_000)
+        wf = workflow_of(manager, jid)
+        assert wf.clear_calls == 1
+        # Old-run counts are gone; only the post-reset window remains.
+        assert wf.total == 2.0
+
+    def test_counts_never_leak_across_runs(self, registry, manager):
+        """The published totals on either side of a boundary must come
+        from disjoint data — the observable contract behind resets."""
+        jid = start(manager, registry)
+        for i in range(3):
+            push(manager, 1.0, start_ns=i * 1_000, end_ns=(i + 1) * 1_000)
+        results = push(manager, 1.0, start_ns=3_000, end_ns=4_000)
+        before = float(np.asarray(results[0].outputs["total"].values))
+        manager.handle_run_transition(
+            RunStart(run_name="next", start_time=T(4_000))
+        )
+        results = push(manager, 1.0, start_ns=4_000, end_ns=5_000)
+        after = float(np.asarray(results[0].outputs["total"].values))
+        assert before == 4.0
+        assert after == 1.0  # new run starts from zero
+
+
+class TestStraddlingBatches:
+    def test_boundary_inside_batch_resets_before_that_batch(
+        self, registry, manager
+    ):
+        """A batch whose window contains the boundary processes after the
+        reset: its counts belong to the new run (boundary granularity is
+        the batch, matching the data-time contract)."""
+        jid = start(manager, registry)
+        push(manager, 3.0, start_ns=0, end_ns=2_000)
+        manager.handle_run_transition(RunStart(run_name="r", start_time=T(2_500)))
+        # Window [2000, 3000) straddles the 2500 boundary.
+        push(manager, 7.0, start_ns=2_000, end_ns=3_000)
+        wf = workflow_of(manager, jid)
+        assert wf.clear_calls == 1
+        assert wf.total == 7.0
+
+    def test_two_boundaries_inside_one_batch_reset_once(self, registry, manager):
+        jid = start(manager, registry)
+        push(manager, 3.0, start_ns=0, end_ns=1_000)
+        manager.handle_run_transition(
+            RunStart(run_name="a", start_time=T(1_200), stop_time=T(1_800))
+        )
+        push(manager, 2.0, start_ns=1_000, end_ns=2_000)
+        # Both scheduled resets were due in one window: one clear, not two.
+        assert workflow_of(manager, jid).clear_calls == 1
+
+
+class TestSelectiveResets:
+    def test_mixed_jobs_only_flagged_ones_reset(self, registry, manager):
+        resetting = start(manager, registry, name="count")
+        surviving = start(manager, registry, name="survivor")
+        push(manager, 5.0, start_ns=0, end_ns=1_000)
+        manager.handle_run_transition(RunStop(run_name="r", stop_time=T(1_500)))
+        push(manager, 1.0, start_ns=1_500, end_ns=2_500)
+        assert workflow_of(manager, resetting).clear_calls == 1
+        assert workflow_of(manager, resetting).total == 1.0
+        survivor = workflow_of(manager, surviving)
+        assert survivor.clear_calls == 0
+        assert survivor.total == 6.0  # accumulated across the boundary
+
+    def test_job_started_after_boundary_not_reset(self, registry, manager):
+        manager.handle_run_transition(RunStart(run_name="r", start_time=T(500)))
+        # Reset consumed by this empty-table push...
+        manager.process_jobs({}, start=T(0), end=T(1_000))
+        jid = start(manager, registry)
+        push(manager, 4.0, start_ns=1_000, end_ns=2_000)
+        # ...so the job scheduled afterwards never sees it.
+        assert workflow_of(manager, jid).clear_calls == 0
+        assert workflow_of(manager, jid).total == 4.0
+
+
+class TestEmptyTable:
+    def test_reset_consumed_with_no_active_jobs(self, registry, manager):
+        manager.handle_run_transition(RunStart(run_name="r", start_time=T(500)))
+        manager.process_jobs({}, start=T(0), end=T(1_000))
+        assert manager._pending_reset_times == []  # noqa: SLF001
+
+    def test_undue_reset_survives_empty_pushes(self, registry, manager):
+        manager.handle_run_transition(
+            RunStart(run_name="r", start_time=T(10_000))
+        )
+        manager.process_jobs({}, start=T(0), end=T(1_000))
+        assert manager._pending_reset_times == [T(10_000)]  # noqa: SLF001

@@ -1,0 +1,183 @@
+"""Multi-session smoke: every session sees plots and keeps receiving data.
+
+HTTP analog of the reference's two-browser smoke test
+(tests/dashboard/multisession_smoke_test.py): dashboard state (data
+service, orchestrators, grids) is process-global while sessions are
+per-client, so the classic regression class is asymmetry — a late
+joiner seeing stale or missing data, or one session's activity stalling
+another's delivery. Two scripted clients walk the manual checklist: the
+late joiner sees the same grids and populated plots, both observe the
+generation advancing, and a config edit in one session reaches the
+other through its own poll.
+"""
+
+import json
+import time
+
+import pytest
+
+tornado = pytest.importorskip("tornado")
+
+from tornado.testing import AsyncHTTPTestCase
+
+from esslivedata_tpu.config.instruments.dummy.specs import DETECTOR_VIEW_HANDLE
+from esslivedata_tpu.dashboard.config_store import MemoryConfigStore
+from esslivedata_tpu.dashboard.dashboard_services import DashboardServices
+from esslivedata_tpu.dashboard.fake_backend import InProcessBackendTransport
+
+
+class _Client:
+    """One scripted dashboard session (the browser's fetch loop)."""
+
+    def __init__(self, test: "MultiSessionSmokeTest") -> None:
+        self._test = test
+        self.session_id: str | None = None
+        self.notifications: list[dict] = []
+        self.config_changes = 0
+
+    def poll(self) -> dict:
+        q = f"?session={self.session_id}" if self.session_id else ""
+        data = json.loads(self._test.fetch(f"/api/session{q}").body)
+        self.session_id = data["session_id"]
+        self.notifications.extend(data["notifications"])
+        if data["config_changed"]:
+            self.config_changes += 1
+        return data
+
+    def state(self) -> dict:
+        return json.loads(self._test.fetch("/api/state").body)
+
+    def grids(self) -> dict:
+        return json.loads(self._test.fetch("/api/grids").body)
+
+    def plot_png(self, kid: str) -> bytes:
+        return self._test.fetch(f"/plot/{kid}.png").body
+
+
+class MultiSessionSmokeTest(AsyncHTTPTestCase):
+    def get_app(self):
+        from esslivedata_tpu.dashboard.web import make_app
+
+        self.transport = InProcessBackendTransport(
+            "dummy", events_per_pulse=300
+        )
+        self.services = DashboardServices(
+            transport=self.transport, config_store=MemoryConfigStore()
+        )
+        return make_app(self.services, "dummy")
+
+    def drive(self, n=10):
+        for _ in range(n):
+            self.transport.tick()
+            self.services.pump.pump_once()
+
+    def post_json(self, url, payload):
+        return self.fetch(url, method="POST", body=json.dumps(payload))
+
+    def test_two_sessions_see_data_and_keep_updating(self):
+        first = _Client(self)
+        first.poll()
+
+        # First session starts a workflow and waits for data.
+        self.post_json(
+            "/api/workflow/start",
+            {
+                "workflow_id": str(DETECTOR_VIEW_HANDLE.workflow_id),
+                "source_name": "panel_0",
+            },
+        )
+        for _ in range(30):
+            time.sleep(0.05)
+            self.drive(10)
+            if first.state()["keys"]:
+                break
+        state1 = first.state()
+        assert state1["keys"], "first session never saw data"
+
+        # A LATE JOINER must see the same keys, jobs, and grids.
+        second = _Client(self)
+        second.poll()
+        assert second.session_id != first.session_id
+        state2 = second.state()
+        assert {k["id"] for k in state2["keys"]} == {
+            k["id"] for k in state1["keys"]
+        }
+        assert len(state2["jobs"]) == len(state1["jobs"]) == 1
+        assert second.grids() == first.grids()
+
+        # The late joiner renders populated plots (not 404s or blanks).
+        image_kid = next(
+            k["id"] for k in state2["keys"] if k["output"] == "image_current"
+        )
+        png = second.plot_png(image_kid)
+        assert png[:4] == b"\x89PNG"
+
+        # Both sessions observe the data generation advancing.
+        gens1, gens2 = [state1["generation"]], [state2["generation"]]
+        for _ in range(30):
+            time.sleep(0.05)
+            self.drive(10)
+            gens1.append(first.state()["generation"])
+            gens2.append(second.state()["generation"])
+            if gens1[-1] > gens1[0] and gens2[-1] > gens2[0]:
+                break
+        assert gens1[-1] > gens1[0], "first session stopped receiving updates"
+        assert gens2[-1] > gens2[0], "second session stopped receiving updates"
+
+        # One session hammering other endpoints (the tab-switch analog)
+        # must not stall the other's delivery.
+        for _ in range(5):
+            first.grids()
+            first.state()
+        before = second.state()["generation"]
+        for _ in range(20):
+            time.sleep(0.05)
+            self.drive(10)
+            if second.state()["generation"] > before:
+                break
+        assert second.state()["generation"] > before
+
+    def test_config_edit_in_one_session_reaches_the_other(self):
+        first, second = _Client(self), _Client(self)
+        first.poll()
+        second.poll()
+
+        r = self.post_json(
+            "/api/grid", {"name": "shared", "nrows": 1, "ncols": 2}
+        )
+        gid = json.loads(r.body)["grid_id"]
+
+        # Both sessions' next poll reports the config change...
+        assert first.poll()["config_changed"]
+        assert second.poll()["config_changed"]
+        # ...and both see the new grid with identical content.
+        grids1 = first.grids()["grids"]
+        grids2 = second.grids()["grids"]
+        assert any(g["grid_id"] == gid for g in grids2)
+        assert grids1 == grids2
+
+        # A second edit keeps propagating (the flag is per-session and
+        # re-arms; a one-shot latch would strand later edits).
+        self.post_json(
+            f"/api/grid/{gid}/cell",
+            {
+                "geometry": {"row": 0, "col": 0},
+                "output": "image_current",
+                "params": {},
+            },
+        )
+        assert first.poll()["config_changed"]
+        assert second.poll()["config_changed"]
+
+    def test_sessions_do_not_leak_each_others_notifications(self):
+        first, second = _Client(self), _Client(self)
+        first.poll()
+        second.poll()
+        self.services.notifications.push("info", "broadcast")
+        # Both get the broadcast exactly once (their own cursor each).
+        first.poll()
+        second.poll()
+        first.poll()
+        second.poll()
+        assert [n["message"] for n in first.notifications] == ["broadcast"]
+        assert [n["message"] for n in second.notifications] == ["broadcast"]

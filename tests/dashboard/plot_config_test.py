@@ -143,25 +143,54 @@ class PlotConfigHttpTest(AsyncHTTPTestCase):
         png = self.fetch(f"/plot/{kid}.png?{urlencode(cell['params'])}")
         assert png.code == 200 and png.body[:4] == b"\x89PNG"
 
-    def test_window_sum_extractor_accumulates(self):
+    def test_window_sum_cell_actually_accumulates(self):
+        # Installing a history-wanting cell must upgrade the key's buffer
+        # (require_history through the orchestrator) so the window sum is
+        # a real multi-frame aggregate — not the latest frame in
+        # disguise. Strictly greater: anything else means the pull path
+        # silently degraded to latest-value.
         state = self._start_and_wait()
+        r = self.post_json("/api/grid", {"name": "h", "nrows": 1, "ncols": 1})
+        gid = json.loads(r.body)["grid_id"]
+        r = self.post_json(
+            f"/api/grid/{gid}/cell",
+            {
+                "geometry": {"row": 0, "col": 0},
+                "output": "counts_current",
+                "params": {"extractor": "window_sum", "window_s": 3600},
+            },
+        )
+        assert r.code == 200
+        # Accumulate several more publishes AFTER the upgrade.
         key_obj = next(
             k
             for k in self.services.data_service.keys()
             if k.output_name == "counts_current"
         )
-        latest = self.services.data_service.get(key_obj)
         params = PlotParams.from_dict(
             {"extractor": "window_sum", "window_s": 3600}
         )
-        summed = self.services.data_service.get(
-            key_obj, params.make_extractor()
-        )
-        # Several publishes happened; the trailing-window sum must exceed
-        # any single frame (counts are strictly positive here).
-        assert float(np.asarray(summed.values)) >= float(
-            np.asarray(latest.values)
-        )
+
+        def read():
+            latest = self.services.data_service.get(key_obj)
+            summed = self.services.data_service.get(
+                key_obj, params.make_extractor()
+            )
+            return (
+                float(np.asarray(latest.values)),
+                float(np.asarray(summed.values)),
+            )
+
+        # Wait on the key's own aggregate, not the global generation —
+        # other outputs' publishes advance that too.
+        for _ in range(60):
+            time.sleep(0.05)
+            self.drive(10)
+            latest, summed = read()
+            if summed > latest:
+                break
+        latest, summed = read()
+        assert summed > latest
 
     def test_bad_cell_config_rejected_with_400(self):
         r = self.post_json("/api/grid", {"name": "bad", "nrows": 1, "ncols": 1})
@@ -199,3 +228,98 @@ class PlotConfigHttpTest(AsyncHTTPTestCase):
         r = self.fetch(f"/plot/{kid}.png?plotter=slicer")
         assert r.code == 400
         assert "3-D" in json.loads(r.body)["error"]
+
+
+class TestWindowAggregationSemantics:
+    """Aggregate-vs-restart decisions of the window extractor."""
+
+    def _buf(self):
+        from esslivedata_tpu.core.timestamp import Timestamp
+        from esslivedata_tpu.dashboard.temporal_buffers import TemporalBuffer
+
+        return TemporalBuffer(1 << 20), Timestamp.from_ns
+
+    def test_stamp_coords_do_not_restart_aggregation(self):
+        from esslivedata_tpu.dashboard.extractors import (
+            WindowAggregatingExtractor,
+        )
+        from esslivedata_tpu.utils import DataArray, Variable
+
+        buf, T = self._buf()
+        for i in range(3):
+            buf.put(
+                T(i * 10**9),
+                DataArray(
+                    Variable(np.asarray(10.0), (), "counts"),
+                    coords={
+                        "start_time": Variable(
+                            np.asarray(i * 10**9), (), "ns"
+                        ),
+                        "end_time": Variable(
+                            np.asarray((i + 1) * 10**9), (), "ns"
+                        ),
+                    },
+                    name="c",
+                ),
+            )
+        out = WindowAggregatingExtractor(3600, "sum").extract(buf)
+        assert float(np.asarray(out.values)) == 30.0
+        # The aggregate spans first start to last end.
+        assert int(np.asarray(out.coords["start_time"].numpy)) == 0
+        assert int(np.asarray(out.coords["end_time"].numpy)) == 3 * 10**9
+
+    def test_mean_of_integer_counts_is_not_floored(self):
+        from esslivedata_tpu.dashboard.extractors import (
+            WindowAggregatingExtractor,
+        )
+        from esslivedata_tpu.utils import DataArray, Variable
+
+        buf, T = self._buf()
+        for i, v in enumerate((1, 2)):
+            buf.put(
+                T(i * 10**9),
+                DataArray(Variable(np.asarray(v), (), "counts"), name="c"),
+            )
+        out = WindowAggregatingExtractor(3600, "mean").extract(buf)
+        assert float(np.asarray(out.values)) == 1.5
+
+    def test_time_axis_chunks_restart_not_sum(self):
+        # An NXlog-style (time,) axis coord differing between entries is
+        # different data, not a provenance stamp: the aggregate restarts.
+        from esslivedata_tpu.dashboard.extractors import (
+            WindowAggregatingExtractor,
+        )
+        from esslivedata_tpu.utils import DataArray, Variable
+
+        buf, T = self._buf()
+        for i in range(3):
+            t = np.arange(4) + 100 * i
+            buf.put(
+                T(i * 10**9),
+                DataArray(
+                    Variable(np.ones(4), ("time",), "K"),
+                    coords={"time": Variable(t, ("time",), "ns")},
+                    name="log",
+                ),
+            )
+        out = WindowAggregatingExtractor(3600, "sum").extract(buf)
+        assert float(np.asarray(out.values).sum()) == 4.0
+
+    def test_unit_change_restarts(self):
+        from esslivedata_tpu.dashboard.extractors import (
+            WindowAggregatingExtractor,
+        )
+        from esslivedata_tpu.utils import DataArray, Variable
+
+        buf, T = self._buf()
+        buf.put(
+            T(0), DataArray(Variable(np.asarray(5.0), (), "mm"), name="x")
+        )
+        buf.put(
+            T(10**9), DataArray(Variable(np.asarray(2.0), (), "m"), name="x")
+        )
+        out = WindowAggregatingExtractor(3600, "sum").extract(buf)
+        # Raw summation across a rescaled unit would be off by 1000x;
+        # the aggregate must restart at the unit change instead.
+        assert float(np.asarray(out.values)) == 2.0
+        assert str(out.unit) == "m"
