@@ -11,7 +11,6 @@ are available (same caveat as loki/specs.py).
 
 from __future__ import annotations
 
-import numpy as np
 
 from ....config.instrument import (
     DetectorConfig,

@@ -9,7 +9,6 @@ two pipelines a real deployment feeds with downloaded ESS files.
 
 from __future__ import annotations
 
-import numpy as np
 
 from ....config.instrument import (
     DetectorConfig,

@@ -7,7 +7,7 @@ the commands topic as JSON and drive the dashboard's auto-generated forms.
 from __future__ import annotations
 
 import numpy as np
-from pydantic import BaseModel, ConfigDict, Field, model_validator
+from pydantic import BaseModel, ConfigDict, model_validator
 
 __all__ = ["PolygonROI", "RectangleROI", "ROI", "TOARange", "WeightingMethod"]
 

@@ -73,8 +73,19 @@ def _aggregation_compatible(a: DataArray, b: DataArray) -> bool:
         return False
     if a.unit != b.unit:
         return False
-    keys_a = set(a.coords) - _STAMP_COORDS
-    keys_b = set(b.coords) - _STAMP_COORDS
+
+    def is_stamp(name: str) -> bool:
+        # Stamp exemption is by name AND rank: a 1-D coord that happens
+        # to be called start_time indexes data and must still compare.
+        return (
+            name in _STAMP_COORDS
+            and np.asarray(a.coords[name].numpy).ndim == 0
+            and name in b.coords
+            and np.asarray(b.coords[name].numpy).ndim == 0
+        )
+
+    keys_a = {c for c in a.coords if not is_stamp(c)}
+    keys_b = {c for c in b.coords if not is_stamp(c)}
     if keys_a != keys_b:
         return False
     return all(a.coords[c].identical(b.coords[c]) for c in keys_a)

@@ -63,7 +63,9 @@ class PlotCell:
             extractor = PlotParams.from_dict(
                 dict(self.spec.params or {})
             ).make_extractor()
-        except ValueError:
+        except (ValueError, TypeError):
+            # Corrupt persisted params must not take the orchestrator
+            # down during _restore; the render path 400s them instead.
             return False
         return extractor is not None and extractor.wants_history
 

@@ -22,7 +22,7 @@ matplotlib.use("Agg")
 import matplotlib.pyplot as plt
 import numpy as np
 
-from ..utils.labeled import DataArray, midpoints
+from ..utils.labeled import DataArray
 
 __all__ = [
     "PlotterRegistry",

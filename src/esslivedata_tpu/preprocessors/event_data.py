@@ -18,7 +18,7 @@ from typing import ClassVar
 import numpy as np
 
 from ..core.timestamp import Timestamp
-from ..ops.event_batch import EventBatch, StagingBuffer, make_staging_buffer
+from ..ops.event_batch import EventBatch, make_staging_buffer
 
 __all__ = ["DetectorEvents", "MonitorEvents", "StagedEvents", "ToEventBatch"]
 

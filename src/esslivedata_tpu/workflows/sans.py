@@ -15,7 +15,7 @@ from typing import Any
 import numpy as np
 from pydantic import BaseModel, ConfigDict, Field
 
-from ..config.models import PULSE_PERIOD_NS, TOARange
+from ..config.models import TOARange
 from ..ops.event_batch import EventBatch
 from ..ops.qhistogram import QHistogrammer, build_sans_qmap
 from ..preprocessors.event_data import StagedEvents
