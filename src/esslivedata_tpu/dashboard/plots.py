@@ -25,6 +25,7 @@ import numpy as np
 from ..utils.labeled import DataArray
 
 __all__ = [
+    "FlattenPlotter",
     "PlotterRegistry",
     "SlicerPlotter",
     "TablePlotter",
@@ -44,7 +45,7 @@ logger = logging.getLogger(__name__)
 EXTRACTOR_CHOICES = ("latest", "full_history", "window_sum", "window_mean")
 
 #: Plotter forcing: '' = auto-select from shape.
-PLOTTER_CHOICES = ("", "table", "slicer")
+PLOTTER_CHOICES = ("", "table", "slicer", "flatten")
 
 
 @dataclass(frozen=True)
@@ -69,9 +70,11 @@ class PlotParams:
     vmax: float | None = None
     extractor: str = "latest"
     window_s: float | None = None
-    plotter: str = ""  # '' (auto) | 'table' | 'slicer'
+    plotter: str = ""  # '' (auto) | 'table' | 'slicer' | 'flatten'
     slice: int | None = None
     overlay: bool = False
+    robust: bool = False  # percentile color scaling (hot-pixel clip)
+    flatten_split: int = 1  # leading dims -> Y for the flatten plotter
 
     @classmethod
     def from_dict(cls, raw: dict | None) -> "PlotParams":
@@ -102,6 +105,8 @@ class PlotParams:
 
         slice_raw = raw.get("slice")
         overlay = raw.get("overlay") in (True, "1", 1, "true")
+        robust = raw.get("robust") in (True, "1", 1, "true")
+        split_raw = raw.get("flatten_split")
         params = cls(
             scale=scale,
             cmap=str(raw.get("cmap", "viridis")),
@@ -112,6 +117,8 @@ class PlotParams:
             plotter=plotter,
             slice=None if slice_raw in (None, "", "null") else int(slice_raw),
             overlay=overlay,
+            robust=robust,
+            flatten_split=1 if split_raw in (None, "", "null") else int(split_raw),
         )
         # Bounds that would blow up at render time are config errors:
         # reject at validation so a bad edit 400s once instead of the
@@ -131,6 +138,8 @@ class PlotParams:
                 )
         if params.slice is not None and params.slice < 0:
             raise ValueError("slice must be >= 0")
+        if params.flatten_split < 1:
+            raise ValueError("flatten_split must be >= 1")
         return params
 
     def to_dict(self) -> dict:
@@ -156,6 +165,10 @@ class PlotParams:
             out["slice"] = self.slice
         if self.overlay:
             out["overlay"] = "1"
+        if self.robust:
+            out["robust"] = "1"
+        if self.flatten_split != 1:
+            out["flatten_split"] = self.flatten_split
         return out
 
     def make_extractor(self):
@@ -173,17 +186,41 @@ class PlotParams:
             return WindowAggregatingExtractor(self.window_s, "mean")
         return None
 
-    def _norm(self):
-        """Matplotlib color norm for 2-D plotters."""
+    def _norm(self, data: "np.ndarray | None" = None):
+        """Matplotlib color norm for 2-D plotters.
+
+        With ``robust`` and no explicit bounds, the color range clips to
+        the data's [1, 99.5] percentiles so a few hot pixels cannot wash
+        out the whole image (the stateless-render analog of the
+        reference's autoscale toggles).
+        """
         from matplotlib.colors import LogNorm, Normalize
 
+        vmin, vmax = self.vmin, self.vmax
+        if (
+            self.robust
+            and data is not None
+            and data.size
+            and (vmin is None or vmax is None)
+        ):
+            # Fill only the MISSING bounds: vmin=0 + robust is the natural
+            # count-data config and must still clip the hot-pixel vmax.
+            finite = data[np.isfinite(data)]
+            if finite.size:
+                lo = float(np.percentile(finite, 1.0))
+                hi = float(np.percentile(finite, 99.5))
+                if lo < hi:
+                    if vmin is None and (vmax is None or lo < vmax):
+                        vmin = lo
+                    if vmax is None and (vmin is None or hi > vmin):
+                        vmax = hi
         if self.scale == "log":
             # LogNorm cannot take bounds <= 0; clamp to a positive floor
             # (vmax <= 0 is rejected at validation).
-            vmin = self.vmin if self.vmin and self.vmin > 0 else None
-            vmax = self.vmax if self.vmax and self.vmax > 0 else None
+            vmin = vmin if vmin and vmin > 0 else None
+            vmax = vmax if vmax and vmax > 0 else None
             return LogNorm(vmin=vmin, vmax=vmax)
-        return Normalize(vmin=self.vmin, vmax=self.vmax)
+        return Normalize(vmin=vmin, vmax=vmax)
 
     def _apply_y(self, ax) -> None:
         if self.scale == "log":
@@ -228,24 +265,107 @@ class LinePlotter:
         ax.set_ylabel(f"[{da.unit!r}]")
 
 
+#: Above this side length a pcolormesh dominates render time; images are
+#: block-reduced (sum-preserving) to at most this many rows/cols first.
+_DOWNSAMPLE_MAX_SIDE = 512
+
+
+def _downsample_2d(
+    values: np.ndarray, x: np.ndarray, y: np.ndarray
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Sum-preserving block reduction of an oversized image.
+
+    Count data stays count data: blocks SUM (a 4x4 block of counts is
+    their total, not their mean), and the edge arrays keep every
+    block-boundary coordinate so the rendered axes remain exact.
+    """
+    out = values
+    ex, ey = x, y
+    for axis, n in ((0, values.shape[0]), (1, values.shape[1])):
+        if n <= _DOWNSAMPLE_MAX_SIDE:
+            continue
+        factor = -(-n // _DOWNSAMPLE_MAX_SIDE)  # ceil
+        pad = (-n) % factor
+        padded = np.pad(
+            out,
+            [(0, pad) if a == axis else (0, 0) for a in range(2)],
+        )
+        shape = list(padded.shape)
+        shape[axis : axis + 1] = [padded.shape[axis] // factor, factor]
+        out = padded.reshape(shape).sum(axis=axis + 1)
+        edges = ey if axis == 0 else ex
+        if edges.size == n + 1:
+            reduced = edges[::factor]
+            if reduced[-1] != edges[-1]:
+                reduced = np.concatenate([reduced, edges[-1:]])
+        else:  # point coords: take block starts
+            reduced = edges[::factor]
+        if axis == 0:
+            ey = reduced
+        else:
+            ex = reduced
+    return out, ex, ey
+
+
+def _draw_mesh(ax, x, y, values, params, unit) -> None:
+    """The single 2-D draw: downsample guard, edge synthesis for point
+    coords, pcolormesh with the params norm, colorbar. Every image-like
+    plotter delegates here so norm/downsample changes happen once."""
+    if (
+        values.shape[0] > _DOWNSAMPLE_MAX_SIDE
+        or values.shape[1] > _DOWNSAMPLE_MAX_SIDE
+    ):
+        values, x, y = _downsample_2d(values, x, y)
+    if x.size == values.shape[1]:
+        x = np.concatenate([x, [x[-1] + (x[-1] - x[-2] if x.size > 1 else 1)]])
+    if y.size == values.shape[0]:
+        y = np.concatenate([y, [y[-1] + (y[-1] - y[-2] if y.size > 1 else 1)]])
+    mesh = ax.pcolormesh(
+        x, y, values, shading="flat", cmap=params.cmap,
+        norm=params._norm(values),
+    )
+    ax.figure.colorbar(mesh, ax=ax, label=f"[{unit!r}]")
+
+
 class ImagePlotter:
-    """2-D data as pcolormesh with edge-aware axes."""
+    """2-D data as pcolormesh with edge-aware axes.
+
+    Oversized images (LOKI-scale banks reach millions of cells, far
+    beyond the PNG's pixel budget) are block-summed server-side before
+    rendering — the reference downsamples in its plotting layer for the
+    same reason.
+    """
 
     def plot(self, ax, da: DataArray, params: PlotParams = PlotParams()) -> None:
         ydim, xdim = da.dims
         x, xlabel = _coord_values(da, xdim)
         y, ylabel = _coord_values(da, ydim)
         values = np.asarray(da.values, dtype=np.float64)
-        if x.size == values.shape[1]:
-            x = np.concatenate([x, [x[-1] + (x[-1] - x[-2] if x.size > 1 else 1)]])
-        if y.size == values.shape[0]:
-            y = np.concatenate([y, [y[-1] + (y[-1] - y[-2] if y.size > 1 else 1)]])
-        mesh = ax.pcolormesh(
-            x, y, values, shading="flat", cmap=params.cmap, norm=params._norm()
-        )
-        ax.figure.colorbar(mesh, ax=ax, label=f"[{da.unit!r}]")
+        _draw_mesh(ax, x, y, values, params, da.unit)
         ax.set_xlabel(xlabel)
         ax.set_ylabel(ylabel)
+
+
+class FlattenPlotter:
+    """N-D data flattened to one image: leading dims collapse onto Y,
+    trailing dims onto X, split at ``split`` (reference flatten_plotter
+    partitions dims into two groups the same way; axes here are flat
+    indices, decomposable because the split is config-time static)."""
+
+    def __init__(self, split: int = 1) -> None:
+        self._split = split
+
+    def plot(self, ax, da: DataArray, params: PlotParams = PlotParams()) -> None:
+        values = np.asarray(da.values, dtype=np.float64)
+        k = min(max(self._split, 1), values.ndim - 1)
+        ny = int(np.prod(values.shape[:k]))
+        nx = int(np.prod(values.shape[k:]))
+        flat = values.reshape(ny, nx)
+        x = np.arange(nx + 1, dtype=float)
+        y = np.arange(ny + 1, dtype=float)
+        _draw_mesh(ax, x, y, flat, params, da.unit)
+        ax.set_xlabel(" × ".join(da.dims[k:]))
+        ax.set_ylabel(" × ".join(da.dims[:k]))
 
 
 class Overlay1DPlotter:
@@ -296,14 +416,7 @@ class SlicerPlotter:
         ydim, xdim = da.dims[1], da.dims[2]
         x, xlabel = _coord_values(da, xdim)
         y, ylabel = _coord_values(da, ydim)
-        if x.size == values.shape[1]:
-            x = np.concatenate([x, [x[-1] + (x[-1] - x[-2] if x.size > 1 else 1)]])
-        if y.size == values.shape[0]:
-            y = np.concatenate([y, [y[-1] + (y[-1] - y[-2] if y.size > 1 else 1)]])
-        mesh = ax.pcolormesh(
-            x, y, values, shading="flat", cmap=params.cmap, norm=params._norm()
-        )
-        ax.figure.colorbar(mesh, ax=ax, label=f"[{da.unit!r}]")
+        _draw_mesh(ax, x, y, values, params, da.unit)
         ax.set_xlabel(xlabel)
         ax.set_ylabel(ylabel)
         ax.set_title(f"{lead}={i}/{n}", fontsize=8)

@@ -454,6 +454,8 @@ class PlotHandler(_Base):
                         "plotter",
                         "slice",
                         "overlay",
+                        "robust",
+                        "flatten_split",
                         "history",  # back-compat alias for full_history
                     )
                     if self.get_argument(k, None) is not None
@@ -471,6 +473,16 @@ class PlotHandler(_Base):
         plotter = None
         if params.plotter == "table":
             plotter = TablePlotter()
+        elif params.plotter == "flatten":
+            from .plots import FlattenPlotter
+
+            if data.data.ndim < 2:
+                self.set_status(400)
+                self.write_json(
+                    {"error": "plotter 'flatten' needs >= 2-D data"}
+                )
+                return None
+            plotter = FlattenPlotter(split=params.flatten_split)
         elif params.plotter == "slicer" or (
             params.slice is not None and data.data.ndim == 3
         ):
@@ -783,9 +795,11 @@ const CELL_CONFIG_FIELDS = [
   {{key: 'extractor', kind: 'select',
     choices: ['latest', 'full_history', 'window_sum', 'window_mean']}},
   {{key: 'window_s', kind: 'number', hint: 'seconds (window_* extractors)'}},
-  {{key: 'plotter', kind: 'select', choices: ['', 'table', 'slicer']}},
+  {{key: 'plotter', kind: 'select', choices: ['', 'table', 'slicer', 'flatten']}},
   {{key: 'slice', kind: 'number', hint: 'leading-dim index (slicer)'}},
   {{key: 'overlay', kind: 'checkbox', hint: 'layer all outputs in one axes'}},
+  {{key: 'robust', kind: 'checkbox', hint: 'percentile color range (clip hot pixels)'}},
+  {{key: 'flatten_split', kind: 'number', hint: 'leading dims onto Y (flatten plotter)'}},
 ];
 function editCell(gridId, index, params) {{
   const old = document.getElementById('cellcfg');

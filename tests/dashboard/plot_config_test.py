@@ -229,6 +229,19 @@ class PlotConfigHttpTest(AsyncHTTPTestCase):
         assert r.code == 400
         assert "3-D" in json.loads(r.body)["error"]
 
+    def test_flatten_on_1d_data_is_400_not_500(self):
+        state = self._start_and_wait()
+        kid = self._kid(state, "spectrum_current")
+        r = self.fetch(f"/plot/{kid}.png?plotter=flatten")
+        assert r.code == 400
+        assert "2-D" in json.loads(r.body)["error"]
+
+    def test_flatten_on_2d_image_renders(self):
+        state = self._start_and_wait()
+        kid = self._kid(state, "image_current")
+        r = self.fetch(f"/plot/{kid}.png?plotter=flatten&robust=1")
+        assert r.code == 200 and r.body[:4] == b"\x89PNG"
+
 
 class TestWindowAggregationSemantics:
     """Aggregate-vs-restart decisions of the window extractor."""
@@ -323,3 +336,76 @@ class TestWindowAggregationSemantics:
         # the aggregate must restart at the unit change instead.
         assert float(np.asarray(out.values)) == 2.0
         assert str(out.unit) == "m"
+
+
+class TestSpecialtyPlotters:
+    def test_oversized_image_downsamples_sum_preserving(self):
+        from esslivedata_tpu.dashboard.plots import _downsample_2d
+
+        rng = np.random.default_rng(0)
+        values = rng.poisson(3.0, size=(2048, 1536)).astype(np.float64)
+        x = np.arange(1537, dtype=float)
+        y = np.arange(2049, dtype=float)
+        out, ex, ey = _downsample_2d(values, x, y)
+        assert out.shape[0] <= 512 and out.shape[1] <= 512
+        # Counts are conserved exactly (blocks sum, never average).
+        assert out.sum() == pytest.approx(values.sum())
+        assert ex[0] == x[0] and ex[-1] == x[-1]
+        assert ey[0] == y[0] and ey[-1] == y[-1]
+
+    def test_oversized_image_renders(self):
+        from esslivedata_tpu.dashboard.plots import render_png
+        from esslivedata_tpu.utils import DataArray, Variable
+
+        da = DataArray(
+            Variable(np.ones((1200, 900)), ("y", "x"), "counts"),
+            name="big",
+        )
+        png = render_png(da)
+        assert png[:4] == b"\x89PNG"
+
+    def test_flatten_plotter_renders_3d(self):
+        from esslivedata_tpu.dashboard.plots import FlattenPlotter, render_png
+        from esslivedata_tpu.utils import DataArray, Variable
+
+        da = DataArray(
+            Variable(np.arange(2 * 8 * 16, dtype=float).reshape(2, 8, 16),
+                     ("bank", "y", "x"), "counts"),
+            name="banks",
+        )
+        png = render_png(da, plotter=FlattenPlotter(split=2))
+        assert png[:4] == b"\x89PNG"
+
+    def test_flatten_params_round_trip(self):
+        params = PlotParams.from_dict({"plotter": "flatten", "flatten_split": 2})
+        assert params.flatten_split == 2
+        assert PlotParams.from_dict(params.to_dict()) == params
+        with pytest.raises(ValueError, match="flatten_split"):
+            PlotParams.from_dict({"plotter": "flatten", "flatten_split": 0})
+
+    def test_robust_norm_clips_hot_pixels(self):
+        params = PlotParams.from_dict({"robust": "1"})
+        rng = np.random.default_rng(0)
+        data = rng.poisson(100.0, 10_000).astype(float)
+        data[0] = 1e9  # hot pixel
+        norm = params._norm(data)
+        assert norm.vmax is not None and norm.vmax < 1e3
+        # Explicit bounds always win over robust.
+        fixed = PlotParams.from_dict({"robust": "1", "vmin": 0, "vmax": 5})
+        norm2 = fixed._norm(data)
+        assert norm2.vmax == 5
+
+
+class FlattenHttpTest(PlotConfigHttpTest):
+    def test_flatten_on_1d_data_is_400_not_500(self):
+        state = self._start_and_wait()
+        kid = self._kid(state, "spectrum_current")
+        r = self.fetch(f"/plot/{kid}.png?plotter=flatten")
+        assert r.code == 400
+        assert "2-D" in json.loads(r.body)["error"]
+
+    def test_flatten_on_2d_image_renders(self):
+        state = self._start_and_wait()
+        kid = self._kid(state, "image_current")
+        r = self.fetch(f"/plot/{kid}.png?plotter=flatten&robust=1")
+        assert r.code == 200 and r.body[:4] == b"\x89PNG"
