@@ -199,6 +199,11 @@ class JobService:
         with self._lock:
             return (source_name, job_number) in self._adopted
 
+    def owner_of(self, source_name: str, job_number: uuid.UUID) -> str:
+        """The service whose heartbeat last listed this job ('' unknown)."""
+        with self._lock:
+            return self._job_owner.get((source_name, job_number), "")
+
     def pending_commands(self) -> list[PendingCommand]:
         with self._lock:
             return [c for c in self._pending if not c.resolved]

@@ -83,6 +83,12 @@ class StateHandler(_Base):
                         "state": s.status.state,
                         "stale": s.is_stale,
                         "uptime_s": s.status.uptime_s,
+                        "last_batch_message_count": (
+                            s.status.last_batch_message_count
+                        ),
+                        "stream_message_counts": (
+                            s.status.stream_message_counts
+                        ),
                     }
                     for s in js.services()
                 ],
@@ -92,6 +98,7 @@ class StateHandler(_Base):
                         # ADR 0008: jobs learned from heartbeats that this
                         # dashboard never started (restart recovery).
                         "adopted": js.is_adopted(j.source_name, j.job_number),
+                        "service": js.owner_of(j.source_name, j.job_number),
                     }
                     for j in js.jobs()
                 ],
@@ -641,10 +648,12 @@ _PAGE = """<!DOCTYPE html>
   <div id="tabs">
    <button id="tab-grids" class="on" onclick="setTab('grids')">Grids</button>
    <button id="tab-flat" onclick="setTab('flat')">All plots</button>
+   <button id="tab-jobsview" onclick="setTab('jobsview')">Jobs</button>
    <button id="tab-corr" onclick="setTab('corr')">Correlation</button>
   </div>
   <div id="grids"></div>
   <div id="flat" style="display:none"></div>
+  <div id="jobsview" style="display:none"></div>
   <div id="corr" style="display:none">
    <div class="card">
     <label>x: <select id="corr-x"></select></label>
@@ -671,7 +680,7 @@ function el(tag, cls, text) {{
 }}
 function setTab(t) {{
   tab = t; gen = -1; gridGens = {{}};
-  for (const name of ['grids', 'flat', 'corr']) {{
+  for (const name of ['grids', 'flat', 'jobsview', 'corr']) {{
     document.getElementById(name).style.display = t === name ? '' : 'none';
     document.getElementById('tab-' + name).className = t === name ? 'on' : '';
   }}
@@ -1073,6 +1082,113 @@ async function attachRoiOverlay(wrap, img) {{
   if (img.complete && img.clientWidth) build();
   else img.onload = build;
 }}
+// -- workflow status browser: per-job detail table with lifecycle
+// actions, output links, pending commands and the owning service's
+// heartbeat telemetry (reference workflow_status_widget, redesigned as
+// an expandable table over /api/state).
+let jobsOpen = {{}};  // job_number -> expanded?
+function jobAction(action, j) {{
+  return fetch('/api/job/' + action, {{method: 'POST', body: JSON.stringify(
+    {{source_name: j.source_name, job_number: j.job_number}})}});
+}}
+function renderJobsView(s) {{
+  const root = document.getElementById('jobsview');
+  // Rebuild only when the rendered facts change: a rebuild per poll tick
+  // would swallow clicks on buttons replaced mid-press (same gating the
+  // workflows sidebar and correlation pickers use).
+  const fp = JSON.stringify([
+    s.jobs, s.pending_commands, jobsOpen,
+    s.services.map(sv => [sv.service_id, sv.last_batch_message_count]),
+    s.keys.map(k => k.id),
+  ]);
+  if (root.dataset.fp === fp) return;
+  root.dataset.fp = fp;
+  root.innerHTML = '';
+  const card = el('div', 'card');
+  if (!s.jobs.length) {{
+    card.appendChild(el('small', '', 'No jobs running — start one from ' +
+      'the Workflows sidebar.'));
+    root.appendChild(card); return;
+  }}
+  const pendingByJob = {{}};
+  for (const c of s.pending_commands) {{
+    (pendingByJob[c.job_number] = pendingByJob[c.job_number] || []).push(c);
+  }}
+  const svcById = {{}};
+  for (const sv of s.services) svcById[sv.service_id] = sv;
+  const table = document.createElement('table');
+  table.className = 'devices';
+  for (const j of s.jobs) {{
+    const row = document.createElement('tr');
+    const stBtn = el('td');
+    stBtn.appendChild(el('span', 'state-' + j.state, j.state));
+    if (j.adopted) {{
+      const b = el('small', '', ' adopted');
+      b.title = 'learned from a heartbeat after a dashboard restart';
+      stBtn.appendChild(b);
+    }}
+    row.appendChild(stBtn);
+    row.appendChild(el('td', '', j.source_name));
+    row.appendChild(el('td', '', j.workflow_id));
+    row.appendChild(el('td', '', j.job_number.slice(0, 8)));
+    const act = el('td');
+    const detail = el('button', '', jobsOpen[j.job_number] ? '▾' : '▸');
+    detail.onclick = () => {{
+      jobsOpen[j.job_number] = !jobsOpen[j.job_number];
+      root.dataset.fp = '';
+      renderJobsView(lastState);
+    }};
+    act.appendChild(detail);
+    for (const a of ['stop', 'reset', 'remove']) {{
+      const b = el('button', '', a);
+      b.onclick = async () => {{ await jobAction(a, j); refresh(); }};
+      act.appendChild(b);
+    }}
+    row.appendChild(act);
+    table.appendChild(row);
+    if (jobsOpen[j.job_number]) {{
+      const dr = document.createElement('tr');
+      const td = el('td'); td.colSpan = 5;
+      const box = el('div', 'card');
+      if (j.message) {{
+        box.appendChild(el('div', 'state-' + j.state, j.message));
+      }}
+      const svc = svcById[j.service];
+      box.appendChild(el('div', '',
+        'service: ' + (j.service || 'unknown') +
+        (svc ? ` · uptime ${{Math.round(svc.uptime_s)}}s · last batch ` +
+               `${{svc.last_batch_message_count}} msgs` : '')));
+      if (svc && svc.stream_message_counts) {{
+        const counts = Object.entries(svc.stream_message_counts)
+          .map(([k, v]) => k + ': ' + v).join(' · ');
+        if (counts) box.appendChild(el('small', '', counts));
+      }}
+      const outs = s.keys.filter(k => k.job_number === j.job_number);
+      if (outs.length) {{
+        const links = el('div');
+        links.appendChild(el('b', '', 'outputs: '));
+        for (const k of outs) {{
+          const a = document.createElement('a');
+          a.href = '/plot/' + k.id + '.png';
+          a.target = '_blank';
+          a.textContent = k.output;
+          a.style.marginRight = '8px';
+          links.appendChild(a);
+        }}
+        box.appendChild(links);
+      }} else {{
+        box.appendChild(el('small', '', 'no outputs published yet'));
+      }}
+      for (const c of pendingByJob[j.job_number] || []) {{
+        box.appendChild(el('div', c.error ? 'state-error' : '',
+          `pending ${{c.kind}}` + (c.error ? ': ' + c.error : '')));
+      }}
+      td.appendChild(box); dr.appendChild(td); table.appendChild(dr);
+    }}
+  }}
+  card.appendChild(table);
+  root.appendChild(card);
+}}
 // -- workflow wizard: schema-driven params form, two-phase stage->commit.
 function openWizard(w, src) {{
   const old = document.getElementById('wizard');
@@ -1194,8 +1310,7 @@ async function refresh() {{
     d.appendChild(document.createTextNode(' ' + j.source_name + ' '));
     d.appendChild(el('small', '', j.workflow_id));
     const stop = document.createElement('button'); stop.textContent = 'stop';
-    stop.onclick = () => fetch('/api/job/stop', {{method: 'POST',
-      body: JSON.stringify({{source_name: j.source_name, job_number: j.job_number}})}});
+    stop.onclick = () => jobAction('stop', j);
     d.appendChild(stop); jobs.appendChild(d);
   }}
   const svcs = document.getElementById('svcs'); svcs.innerHTML = '';
@@ -1215,6 +1330,7 @@ async function refresh() {{
   }}
   await pollSession();
   if (tab === 'corr') refreshCorrChoices(s);
+  if (tab === 'jobsview') renderJobsView(s);
   if (tab === 'grids') {{
     await refreshGrids();
   }} else if (tab === 'flat' && s.generation !== gen) {{

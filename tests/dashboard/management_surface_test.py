@@ -437,3 +437,51 @@ class CommitGuardTest(AsyncHTTPTestCase):
             ),
         )
         assert r.code == 400
+
+
+class JobsBrowserStateTest(AsyncHTTPTestCase):
+    """The jobs-view tab is driven entirely by /api/state: its payload
+    must carry the per-job owning service and the service telemetry the
+    detail panel renders."""
+
+    def get_app(self):
+        from esslivedata_tpu.dashboard.web import make_app
+
+        self.transport = InProcessBackendTransport(
+            "dummy", events_per_pulse=200
+        )
+        self.services = DashboardServices(transport=self.transport)
+        return make_app(self.services, "dummy")
+
+    def drive(self, n=10):
+        for _ in range(n):
+            self.transport.tick()
+            self.services.pump.pump_once()
+
+    def test_state_carries_job_owner_and_service_telemetry(self):
+        r = self.fetch(
+            "/api/workflow/start",
+            method="POST",
+            body=json.dumps(
+                {
+                    "workflow_id": str(DETECTOR_VIEW_HANDLE.workflow_id),
+                    "source_name": "panel_0",
+                }
+            ),
+        )
+        assert r.code == 200
+        for _ in range(30):
+            time.sleep(0.05)
+            self.drive(10)
+            state = json.loads(self.fetch("/api/state").body)
+            if state["jobs"] and state["jobs"][0].get("service"):
+                break
+        job = state["jobs"][0]
+        assert job["service"], "job owner service missing from state"
+        svc = next(
+            s
+            for s in state["services"]
+            if s["service_id"] == job["service"]
+        )
+        assert "last_batch_message_count" in svc
+        assert "stream_message_counts" in svc
