@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Release-manager tool: publish geometry artifacts with integrity pins.
+
+The deployment analog of the reference's ``upload_geometry.py`` (which
+pushes artifacts to object storage and appends md5 pins to a pooch
+registry). This environment has no egress, so the release target is a
+directory — a network share, a bind-mounted bucket, or a local staging
+tree — and the "registry" is the md5 pin table that
+``config/geometry_store.py`` enforces on cache hits.
+
+- ``publish``: copy dated artifacts from the data directory into the
+  release tree, compute md5s, and write/update ``registry.json`` there.
+- ``pins``: render the ``GEOMETRY_REGISTRY`` pin entries for the
+  published artifacts — paste into ``config/geometry_store.py`` (or ship
+  as a config overlay) so every consumer verifies what it loads.
+- ``verify``: re-hash a release tree against its registry.json.
+
+Usage:
+  python scripts/release_geometry.py publish /mnt/releases/geometry --all
+  python scripts/release_geometry.py publish /mnt/releases/geometry loki
+  python scripts/release_geometry.py pins /mnt/releases/geometry
+  python scripts/release_geometry.py verify /mnt/releases/geometry
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import shutil
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "src"))
+
+
+def _md5(path: Path) -> str:
+    digest = hashlib.md5()
+    with path.open("rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            digest.update(chunk)
+    return digest.hexdigest()
+
+
+def _load_registry(release_dir: Path) -> dict[str, str]:
+    reg = release_dir / "registry.json"
+    if reg.exists():
+        return json.loads(reg.read_text())
+    return {}
+
+
+def publish(release_dir: Path, instrument: str | None, all_: bool) -> int:
+    from esslivedata_tpu.config import geometry_store
+
+    data_dir = geometry_store.data_dir()
+    release_dir.mkdir(parents=True, exist_ok=True)
+    registry = _load_registry(release_dir)
+    pattern = (
+        "geometry-*.nxs" if all_ or not instrument
+        else f"geometry-{instrument}-*.nxs"
+    )
+    published = 0
+    for artifact in sorted(data_dir.glob(pattern)):
+        target = release_dir / artifact.name
+        digest = _md5(artifact)
+        if registry.get(artifact.name) == digest and target.exists():
+            continue
+        if artifact.name in registry and registry[artifact.name] != digest:
+            # Released artifacts are immutable: a new validity date is a
+            # new file. Refusing here is what makes the pins meaningful.
+            print(
+                f"REFUSED: {artifact.name} already released with md5 "
+                f"{registry[artifact.name]}; publish under a new date",
+                file=sys.stderr,
+            )
+            return 1
+        shutil.copy2(artifact, target)
+        registry[artifact.name] = digest
+        published += 1
+        print(f"published {artifact.name}  md5={digest}")
+    (release_dir / "registry.json").write_text(
+        json.dumps(registry, indent=2, sort_keys=True) + "\n"
+    )
+    print(f"{published} artifact(s) published -> {release_dir}")
+    return 0
+
+
+def pins(release_dir: Path) -> int:
+    registry = _load_registry(release_dir)
+    if not registry:
+        print("no registry.json in release dir", file=sys.stderr)
+        return 1
+    print("# GEOMETRY_REGISTRY pin entries (config/geometry_store.py):")
+    for name, digest in sorted(registry.items()):
+        print(f'    "{name}": "{digest}",')
+    return 0
+
+
+def verify(release_dir: Path) -> int:
+    registry = _load_registry(release_dir)
+    bad = 0
+    for name, digest in sorted(registry.items()):
+        path = release_dir / name
+        if not path.exists():
+            print(f"MISSING  {name}")
+            bad += 1
+        elif _md5(path) != digest:
+            print(f"CORRUPT  {name}")
+            bad += 1
+        else:
+            print(f"ok       {name}")
+    return 1 if bad else 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    pub = sub.add_parser("publish")
+    pub.add_argument("release_dir", type=Path)
+    pub.add_argument("instrument", nargs="?")
+    pub.add_argument("--all", action="store_true")
+    for name in ("pins", "verify"):
+        p = sub.add_parser(name)
+        p.add_argument("release_dir", type=Path)
+    args = parser.parse_args()
+    if args.cmd == "publish":
+        return publish(args.release_dir, args.instrument, args.all)
+    if args.cmd == "pins":
+        return pins(args.release_dir)
+    return verify(args.release_dir)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

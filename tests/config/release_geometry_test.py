@@ -1,0 +1,76 @@
+"""Geometry release tool: publish/pins/verify with integrity enforcement
+(reference upload_geometry.py scope, directory-target redesign)."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+import pytest
+
+_SCRIPT = (
+    Path(__file__).resolve().parents[2] / "scripts" / "release_geometry.py"
+)
+spec = importlib.util.spec_from_file_location("release_geometry", _SCRIPT)
+release_geometry = importlib.util.module_from_spec(spec)
+sys.modules["release_geometry"] = release_geometry
+spec.loader.exec_module(release_geometry)
+
+
+@pytest.fixture
+def data_dir(tmp_path, monkeypatch):
+    d = tmp_path / "data"
+    d.mkdir()
+    monkeypatch.setenv("LIVEDATA_DATA_DIR", str(d))
+    (d / "geometry-loki-2026-01-01.nxs").write_bytes(b"fake geometry v1")
+    return d
+
+
+def test_publish_pins_verify_round_trip(data_dir, tmp_path, capsys):
+    release = tmp_path / "release"
+    assert release_geometry.publish(release, "loki", all_=False) == 0
+    assert (release / "geometry-loki-2026-01-01.nxs").exists()
+    assert release_geometry.pins(release) == 0
+    out = capsys.readouterr().out
+    assert 'geometry-loki-2026-01-01.nxs"' in out
+    assert release_geometry.verify(release) == 0
+
+
+def test_republishing_changed_artifact_refused(data_dir, tmp_path):
+    release = tmp_path / "release"
+    assert release_geometry.publish(release, "loki", all_=False) == 0
+    (data_dir / "geometry-loki-2026-01-01.nxs").write_bytes(b"TAMPERED")
+    # Released artifacts are immutable: same name + new content = error.
+    assert release_geometry.publish(release, "loki", all_=False) == 1
+
+
+def test_verify_detects_corruption(data_dir, tmp_path, capsys):
+    release = tmp_path / "release"
+    release_geometry.publish(release, "loki", all_=False)
+    (release / "geometry-loki-2026-01-01.nxs").write_bytes(b"bitrot")
+    assert release_geometry.verify(release) == 1
+    assert "CORRUPT" in capsys.readouterr().out
+
+
+def test_pins_feed_geometry_store_verification(data_dir, tmp_path):
+    # The published md5 is exactly what geometry_store._verify_pin
+    # enforces: a pinned cached file with other bytes must be rejected.
+    release = tmp_path / "release"
+    release_geometry.publish(release, "loki", all_=False)
+    import json
+
+    registry = json.loads((release / "registry.json").read_text())
+    name = "geometry-loki-2026-01-01.nxs"
+    from esslivedata_tpu.config import geometry_store
+
+    monkey_registry = dict(geometry_store.GEOMETRY_REGISTRY)
+    try:
+        geometry_store.GEOMETRY_REGISTRY[name] = registry[name]
+        # Matching bytes pass...
+        geometry_store._verify_pin(data_dir / name, name)
+        # ...tampered bytes raise.
+        (data_dir / name).write_bytes(b"evil")
+        with pytest.raises(ValueError, match="fails its registry pin"):
+            geometry_store._verify_pin(data_dir / name, name)
+    finally:
+        geometry_store.GEOMETRY_REGISTRY.clear()
+        geometry_store.GEOMETRY_REGISTRY.update(monkey_registry)
