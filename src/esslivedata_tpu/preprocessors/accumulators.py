@@ -3,24 +3,33 @@
 Parity with reference ``preprocessors/accumulators.py``: ``Cumulative``
 (+= with restart on structural mismatch, reference :238-261),
 ``LatestValueAccumulator`` (context, :57), ``NullAccumulator`` (:46).
-The reference's NoCopyAccumulator and its paired window/cumulative
-variant exist to avoid deepcopying a 500 MB histogram on every read
-(:96-97). That problem does not arise here *by construction*: large
-histograms are device-resident kernel state with fold semantics
-(ops/histogram.py — window and cumulative share one scatter, reads are
-device views), and host-side accumulators only ever hold the small dense
-outputs. ``Cumulative`` therefore defaults to no-copy reads and there is
-deliberately no pair API to keep aliasing-safe.
+The reference's NoCopyAccumulator exists to avoid deepcopying a 500 MB
+histogram on every read (:96-97). That problem does not arise here *by
+construction*: large histograms are device-resident kernel state with
+fold semantics (ops/histogram.py — window and cumulative share one
+scatter, reads are device views), and host-side accumulators only ever
+hold the small dense outputs. ``Cumulative`` therefore defaults to
+no-copy reads; ``WindowedCumulative`` provides the paired
+window/cumulative semantics for dense streams that never touch the
+accelerator, staying aliasing-safe by transferring window ownership on
+``take`` and copying the cumulative.
 """
 
 from __future__ import annotations
 
 from typing import ClassVar
 
-from ..core.timestamp import Timestamp
-from ..utils.labeled import DataArray
+import numpy as np
 
-__all__ = ["Cumulative", "LatestValueAccumulator", "NullAccumulator"]
+from ..core.timestamp import Timestamp
+from ..utils.labeled import DataArray, Variable
+
+__all__ = [
+    "Cumulative",
+    "LatestValueAccumulator",
+    "NullAccumulator",
+    "WindowedCumulative",
+]
 
 
 class NullAccumulator:
@@ -114,6 +123,12 @@ class Cumulative:
     def is_empty(self) -> bool:
         return self._value is None
 
+    @property
+    def current(self) -> DataArray | None:
+        """No-copy peek at the accumulated value (None when empty);
+        callers must not mutate it."""
+        return self._value
+
     def get(self) -> DataArray:
         if self._value is None:
             raise ValueError("Cumulative accumulator is empty")
@@ -126,6 +141,67 @@ class Cumulative:
 
     def clear(self) -> None:
         self._value = None
+
+    def release_buffers(self) -> None:
+        pass
+
+
+def _zero_like(da: DataArray) -> DataArray:
+    out = da.copy()
+    out.data = Variable(
+        np.zeros_like(np.asarray(da.values)), da.dims, da.unit
+    )
+    return out
+
+
+class WindowedCumulative:
+    """Paired window/cumulative views of one dense stream.
+
+    One ``add`` feeds both views; ``take`` returns ``(window,
+    cumulative)`` and resets the window while the cumulative persists —
+    the host-side analog of the device kernel's fold semantics
+    (docs/design/fold-semantics.md), for the non-event streams that
+    never touch the accelerator: da00 camera frames, rebinned monitor
+    histograms, dense log aggregates.
+
+    Composed from two :class:`Cumulative` instances so restart-on-
+    mismatch semantics live in exactly one place. Incoming samples are
+    unit-aligned to the cumulative before feeding the window: a window
+    restarting just after ``take`` must not adopt a new compatible unit
+    while the cumulative keeps converting into its original one — both
+    views of one stream always share a unit.
+    """
+
+    is_context: ClassVar[bool] = False
+
+    def __init__(self) -> None:
+        self._cumulative = Cumulative(copy_on_get=True)
+        self._window = Cumulative(clear_on_get=True)
+
+    def add(self, timestamp: Timestamp, data: DataArray) -> None:
+        self._cumulative.add(timestamp, data)
+        anchor = self._cumulative.current
+        if anchor is not None and anchor.unit != data.unit:
+            data = data.to_unit(anchor.unit)
+        self._window.add(timestamp, data)
+
+    @property
+    def is_empty(self) -> bool:
+        return self._cumulative.is_empty
+
+    def take(self) -> tuple[DataArray, DataArray]:
+        """(window, cumulative); the window transfers ownership and
+        resets, the cumulative is a defensive copy."""
+        cumulative = self._cumulative.get()
+        if self._window.is_empty:
+            window = _zero_like(cumulative)
+        else:
+            window = self._window.get()
+        return window, cumulative
+
+    def clear(self) -> None:
+        self._window.clear()
+        self._cumulative.clear()
 
     def release_buffers(self) -> None:
         pass

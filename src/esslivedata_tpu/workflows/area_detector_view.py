@@ -10,6 +10,8 @@ from typing import Any
 import numpy as np
 from pydantic import BaseModel, ConfigDict
 
+from ..core.timestamp import Timestamp
+from ..preprocessors.accumulators import WindowedCumulative
 from ..utils.labeled import DataArray, Variable
 
 __all__ = ["AreaDetectorParams", "AreaDetectorView"]
@@ -24,14 +26,13 @@ class AreaDetectorParams(BaseModel):
 
 
 class AreaDetectorView:
-    """Accumulates 2-D camera frames; cumulative restarts automatically on
-    shape change (camera ROI reconfigured upstream)."""
+    """Accumulates 2-D camera frames through the paired window/cumulative
+    accumulator: both views restart automatically when the frame's
+    structure changes (camera ROI reconfigured upstream, unit change)."""
 
     def __init__(self, *, params: AreaDetectorParams | None = None) -> None:
         self._params = params or AreaDetectorParams()
-        self._window: np.ndarray | None = None
-        self._cumulative: np.ndarray | None = None
-        self._unit = None
+        self._acc = WindowedCumulative()
 
     def _transform(self, values: np.ndarray) -> np.ndarray:
         p = self._params
@@ -47,44 +48,33 @@ class AreaDetectorView:
         for value in data.values():
             if not isinstance(value, DataArray) or value.data.ndim != 2:
                 continue
-            frame = self._transform(np.asarray(value.values, dtype=np.float64))
-            self._unit = value.unit
-            if self._cumulative is None or self._cumulative.shape != frame.shape:
-                self._cumulative = frame.copy()
-                self._window = frame.copy()
-            else:
-                self._cumulative += frame
-                if self._window is None or self._window.shape != frame.shape:
-                    self._window = frame.copy()
-                else:
-                    self._window += frame
+            frame = self._transform(
+                np.asarray(value.values, dtype=np.float64)
+            )
+            ny, nx = frame.shape
+            self._acc.add(
+                Timestamp.from_ns(0),
+                DataArray(
+                    Variable(frame, ("y", "x"), value.unit),
+                    coords={
+                        "y": Variable(
+                            np.arange(ny, dtype=np.float64), ("y",), ""
+                        ),
+                        "x": Variable(
+                            np.arange(nx, dtype=np.float64), ("x",), ""
+                        ),
+                    },
+                    name="frame",
+                ),
+            )
 
     def finalize(self) -> dict[str, DataArray]:
-        if self._cumulative is None:
+        if self._acc.is_empty:
             return {}
-        ny, nx = self._cumulative.shape
-        coords = {
-            "y": Variable(np.arange(ny, dtype=np.float64), ("y",), ""),
-            "x": Variable(np.arange(nx, dtype=np.float64), ("x",), ""),
-        }
-        window = self._window if self._window is not None else np.zeros_like(
-            self._cumulative
-        )
-        out = {
-            "current": DataArray(
-                Variable(window.copy(), ("y", "x"), self._unit),
-                coords=coords,
-                name="current",
-            ),
-            "cumulative": DataArray(
-                Variable(self._cumulative.copy(), ("y", "x"), self._unit),
-                coords=coords,
-                name="cumulative",
-            ),
-        }
-        self._window = np.zeros_like(self._cumulative)
-        return out
+        window, cumulative = self._acc.take()
+        window.name = "current"
+        cumulative.name = "cumulative"
+        return {"current": window, "cumulative": cumulative}
 
     def clear(self) -> None:
-        self._window = None
-        self._cumulative = None
+        self._acc.clear()

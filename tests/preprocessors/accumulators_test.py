@@ -1,0 +1,100 @@
+"""Paired window/cumulative accumulator semantics (host-side analog of
+the device fold semantics, for non-event dense streams)."""
+
+import numpy as np
+
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.utils import DataArray, Variable
+
+T = Timestamp.from_ns
+
+class TestWindowedCumulative:
+    def _da(self, value, unit="counts", n=4):
+        return DataArray(
+            Variable(np.full(n, float(value)), ("x",), unit), name="d"
+        )
+
+    def test_window_clears_cumulative_persists(self):
+        from esslivedata_tpu.preprocessors.accumulators import (
+            WindowedCumulative,
+        )
+
+        acc = WindowedCumulative()
+        acc.add(T(0), self._da(1.0))
+        acc.add(T(1), self._da(2.0))
+        window, cumulative = acc.take()
+        assert np.asarray(window.values).sum() == 12.0
+        assert np.asarray(cumulative.values).sum() == 12.0
+        acc.add(T(2), self._da(5.0))
+        window, cumulative = acc.take()
+        # Window holds only the post-take frame; cumulative everything.
+        assert np.asarray(window.values).sum() == 20.0
+        assert np.asarray(cumulative.values).sum() == 32.0
+
+    def test_take_without_new_data_returns_zero_window(self):
+        from esslivedata_tpu.preprocessors.accumulators import (
+            WindowedCumulative,
+        )
+
+        acc = WindowedCumulative()
+        acc.add(T(0), self._da(3.0))
+        acc.take()
+        window, cumulative = acc.take()
+        assert np.asarray(window.values).sum() == 0.0
+        assert np.asarray(cumulative.values).sum() == 12.0
+
+    def test_structure_change_restarts_both_views(self):
+        from esslivedata_tpu.preprocessors.accumulators import (
+            WindowedCumulative,
+        )
+
+        acc = WindowedCumulative()
+        acc.add(T(0), self._da(1.0))
+        acc.add(T(1), self._da(1.0, n=8))  # camera ROI changed
+        window, cumulative = acc.take()
+        assert np.asarray(window.values).shape == (8,)
+        assert np.asarray(cumulative.values).sum() == 8.0
+
+    def test_compatible_unit_change_converts_not_restarts(self):
+        # mm and m share dimensions: same_structure treats them as one
+        # stream and += converts, so the cumulative keeps its first unit
+        # with the new samples rescaled into it.
+        from esslivedata_tpu.preprocessors.accumulators import (
+            WindowedCumulative,
+        )
+
+        acc = WindowedCumulative()
+        acc.add(T(0), self._da(1.0, unit="mm"))
+        acc.add(T(1), self._da(1.0, unit="m"))
+        _, cumulative = acc.take()
+        assert str(cumulative.unit) == "mm"
+        assert np.asarray(cumulative.values).sum() == 4.0 + 4000.0
+
+    def test_views_share_a_unit_after_take_then_unit_change(self):
+        # Window restarting right after take() must not adopt a new
+        # compatible unit while the cumulative keeps converting into its
+        # original one — the two views of one stream share a unit.
+        from esslivedata_tpu.preprocessors.accumulators import (
+            WindowedCumulative,
+        )
+
+        acc = WindowedCumulative()
+        acc.add(T(0), self._da(1.0, unit="mm"))
+        acc.take()
+        acc.add(T(1), self._da(1.0, unit="m"))
+        window, cumulative = acc.take()
+        assert str(window.unit) == str(cumulative.unit) == "mm"
+        assert np.asarray(window.values).sum() == 4000.0
+        assert np.asarray(cumulative.values).sum() == 4004.0
+
+    def test_incompatible_unit_change_restarts(self):
+        from esslivedata_tpu.preprocessors.accumulators import (
+            WindowedCumulative,
+        )
+
+        acc = WindowedCumulative()
+        acc.add(T(0), self._da(1.0, unit="K"))
+        acc.add(T(1), self._da(2.0, unit="mm"))
+        _, cumulative = acc.take()
+        assert str(cumulative.unit) == "mm"
+        assert np.asarray(cumulative.values).sum() == 8.0
