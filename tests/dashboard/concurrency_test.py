@@ -1,0 +1,192 @@
+"""Concurrency-sensitive dashboard paths under real thread contention.
+
+The management surface's stores are mutated by the ingestion pump thread
+while HTTP handlers read and edit them; this file hammers the seams the
+scenario suites exercise only sequentially: session cursor races under
+parallel polls, config fan-out racing ingestion, data-service
+transactions racing readers, and the plot orchestrator binding keys
+while cells are edited.
+"""
+
+import json
+import threading
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+tornado = pytest.importorskip("tornado")
+
+from esslivedata_tpu.config.workflow_spec import JobId, ResultKey, WorkflowId
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.dashboard.config_store import MemoryConfigStore
+from esslivedata_tpu.dashboard.data_service import DataService
+from esslivedata_tpu.dashboard.notification_queue import NotificationQueue
+from esslivedata_tpu.dashboard.plot_orchestrator import PlotOrchestrator
+from esslivedata_tpu.dashboard.session_registry import SessionRegistry
+from esslivedata_tpu.utils import DataArray, Variable
+
+
+def _key(output: str, source: str = "panel_0") -> ResultKey:
+    return ResultKey(
+        workflow_id=WorkflowId.parse("dummy/detector_view/panel_view/v1"),
+        job_id=JobId(source_name=source, job_number=uuid.uuid4()),
+        output_name=output,
+    )
+
+
+def _da(value: float) -> DataArray:
+    return DataArray(
+        Variable(np.full(8, value), ("x",), "counts"), name="d"
+    )
+
+
+def _run_threads(workers, iterations=200):
+    errors: list[BaseException] = []
+
+    def wrap(fn):
+        def run():
+            try:
+                for _ in range(iterations):
+                    fn()
+            except BaseException as err:  # noqa: BLE001 - surface to main
+                errors.append(err)
+
+        return run
+
+    threads = [threading.Thread(target=wrap(w)) for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return errors
+
+
+class TestSessionCursorRaces:
+    def test_parallel_polls_never_lose_or_duplicate_notifications(self):
+        reg = SessionRegistry()
+        notes = NotificationQueue()
+        session_id = reg.ensure().session_id
+        received: list[int] = []
+        lock = threading.Lock()
+        pushed = {"n": 0}
+
+        def poll():
+            out = reg.poll(session_id, notes)
+            with lock:
+                received.extend(n["seq"] for n in out["notifications"])
+
+        def push():
+            with lock:
+                pushed["n"] += 1
+            notes.push("info", "tick")
+
+        errors = _run_threads([poll, poll, push], iterations=300)
+        assert not errors
+        # Drain the tail.
+        out = reg.poll(session_id, notes)
+        received.extend(n["seq"] for n in out["notifications"])
+        # The queue is a bounded backlog (oldest evicted under overload —
+        # by design), so the guarantees under racing polls are: exactly
+        # once per seq, in order, with no gaps except eviction at the
+        # head — i.e. the union of all drains is one contiguous run
+        # ending at the final sequence number.
+        # (Arrival order in `received` is a property of our test threads'
+        # interleaving, not of the queue — assert on the set.)
+        assert len(received) == len(set(received))
+        seqs = sorted(received)
+        assert seqs[-1] == pushed["n"]
+        assert seqs == list(range(seqs[0], pushed["n"] + 1))
+
+    def test_racing_config_bumps_never_lost(self):
+        reg = SessionRegistry()
+        notes = NotificationQueue()
+        session_id = reg.ensure().session_id
+        reg.poll(session_id, notes)  # swallow the fresh-session flag
+        seen = {"changed": 0}
+        bumped = {"n": 0}
+        lock = threading.Lock()
+
+        def bump():
+            with lock:
+                bumped["n"] += 1
+            reg.bump_config()
+
+        def poll():
+            if reg.poll(session_id, notes)["config_changed"]:
+                with lock:
+                    seen["changed"] += 1
+
+        errors = _run_threads([bump, poll], iterations=300)
+        assert not errors
+        final = reg.poll(session_id, notes)
+        # The session must observe at least one change report after the
+        # last bump (coalescing many bumps into one report is correct;
+        # losing the final state is not).
+        assert seen["changed"] >= 1 or final["config_changed"]
+        # And the generation converges: one more poll reports clean.
+        assert not reg.poll(session_id, notes)["config_changed"]
+
+
+class TestDataServiceUnderContention:
+    def test_transactions_and_readers_race_cleanly(self):
+        ds = DataService()
+        keys = [_key(f"out_{i}") for i in range(4)]
+        reads: list[float] = []
+
+        def ingest():
+            with ds.transaction():
+                for k in keys:
+                    ds.put(k, Timestamp.from_ns(0), _da(1.0))
+
+        def read():
+            for k in keys:
+                value = ds.get(k)
+                if value is not None:
+                    reads.append(float(np.asarray(value.values).sum()))
+
+        errors = _run_threads([ingest, read, read], iterations=200)
+        assert not errors
+        assert ds.generation > 0
+
+    def test_orchestrator_binds_keys_while_cells_edited(self):
+        from esslivedata_tpu.config.grid_template import (
+            CellGeometry,
+            GridCellSpec,
+            GridSpec,
+        )
+
+        ds = DataService()
+        orch = PlotOrchestrator(
+            data_service=ds, store=MemoryConfigStore(), instrument=""
+        )
+        grid = orch.add_grid(
+            GridSpec.from_dict({"name": "g", "nrows": 1, "ncols": 1})
+        )
+
+        def ingest():
+            ds.put(_key("image_current"), Timestamp.from_ns(0), _da(1.0))
+
+        counter = {"i": 0}
+
+        def edit():
+            counter["i"] += 1
+            idx = counter["i"]
+            orch.add_cell(
+                grid.grid_id,
+                GridCellSpec(
+                    geometry=CellGeometry(row=0, col=0),
+                    output="image_current",
+                    params=GridCellSpec.freeze_params(
+                        {"extractor": "window_sum", "window_s": 5}
+                    ),
+                ),
+            )
+            orch.remove_cell(grid.grid_id, 0)
+
+        errors = _run_threads([ingest, edit], iterations=150)
+        assert not errors
+        # The grid survived the churn structurally intact.
+        snapshot = orch.snapshot()
+        assert any(g["grid_id"] == grid.grid_id for g in snapshot)
