@@ -99,8 +99,19 @@ def get_schema(buf: bytes) -> str:
 # ---------------------------------------------------------------------------
 
 
+#: Precompiled struct formats for the decode hot path (populated lazily;
+#: the working set is the handful of scalar formats the schemas use).
+_STRUCTS: dict[str, struct.Struct] = {}
+
+
 class _Tbl:
-    """Minimal flatbuffers table reader (decode side only)."""
+    """Minimal flatbuffers table reader (decode side only).
+
+    Every offset read is bounds-checked through :meth:`_read`: a hostile
+    buffer steering an offset out of range raises :class:`WireError`
+    (the per-message containment contract), never ``struct.error`` or a
+    wild slice.
+    """
 
     __slots__ = ("buf", "pos")
 
@@ -109,6 +120,16 @@ class _Tbl:
             raise WireError("Table position out of range")
         self.buf = buf
         self.pos = pos
+
+    def _read(self, fmt: str, offset: int):
+        """Bounds-checked struct read; corrupt offsets become WireError.
+        Hot path: format structs are precompiled (size lookup is free)."""
+        st = _STRUCTS.get(fmt)
+        if st is None:
+            st = _STRUCTS[fmt] = struct.Struct(fmt)
+        if offset < 0 or offset + st.size > len(self.buf):
+            raise WireError("Offset out of range")
+        return st.unpack_from(self.buf, offset)[0]
 
     @classmethod
     def root(cls, buf: bytes, expected_id: str | None = None) -> "_Tbl":
@@ -122,15 +143,15 @@ class _Tbl:
         return cls(buf, off)
 
     def _slot(self, slot: int) -> int | None:
-        (soff,) = struct.unpack_from("<i", self.buf, self.pos)
+        soff = self._read("<i", self.pos)
         vt = self.pos - soff
         if vt < 0 or vt + 4 > len(self.buf):
             raise WireError("Corrupt vtable offset")
-        (vt_len,) = struct.unpack_from("<H", self.buf, vt)
+        vt_len = self._read("<H", vt)
         entry = 4 + slot * 2
         if entry + 2 > vt_len:
             return None
-        (foff,) = struct.unpack_from("<H", self.buf, vt + entry)
+        foff = self._read("<H", vt + entry)
         if foff == 0:
             return None
         return self.pos + foff
@@ -139,26 +160,36 @@ class _Tbl:
         p = self._slot(slot)
         if p is None:
             return default
-        return struct.unpack_from(fmt, self.buf, p)[0]
+        return self._read(fmt, p)
 
     def _indirect(self, p: int) -> int:
-        (off,) = struct.unpack_from("<I", self.buf, p)
-        return p + off
+        off = self._read("<I", p)
+        target = p + off
+        if target < 0 or target + 4 > len(self.buf):
+            raise WireError("Indirect offset out of range")
+        return target
+
+    def _string_at(self, sp: int) -> str:
+        n = self._read("<I", sp)
+        if sp + 4 + n > len(self.buf):
+            raise WireError("String extends past buffer end")
+        try:
+            return bytes(self.buf[sp + 4 : sp + 4 + n]).decode("utf-8")
+        except UnicodeDecodeError as err:
+            raise WireError(f"Invalid UTF-8 string: {err}") from err
 
     def string(self, slot: int, default: str = "") -> str:
         p = self._slot(slot)
         if p is None:
             return default
-        sp = self._indirect(p)
-        (n,) = struct.unpack_from("<I", self.buf, sp)
-        return bytes(self.buf[sp + 4 : sp + 4 + n]).decode("utf-8")
+        return self._string_at(self._indirect(p))
 
     def vector_np(self, slot: int, dtype) -> np.ndarray:
         p = self._slot(slot)
         if p is None:
             return np.empty(0, dtype=dtype)
         vp = self._indirect(p)
-        (n,) = struct.unpack_from("<I", self.buf, vp)
+        n = self._read("<I", vp)
         itemsize = np.dtype(dtype).itemsize
         end = vp + 4 + n * itemsize
         if end > len(self.buf):
@@ -176,7 +207,9 @@ class _Tbl:
         if p is None:
             return []
         vp = self._indirect(p)
-        (n,) = struct.unpack_from("<I", self.buf, vp)
+        n = self._read("<I", vp)
+        if vp + 4 + n * 4 > len(self.buf):
+            raise WireError("Table vector extends past buffer end")
         out = []
         for i in range(n):
             ep = vp + 4 + i * 4
@@ -188,13 +221,13 @@ class _Tbl:
         if p is None:
             return []
         vp = self._indirect(p)
-        (n,) = struct.unpack_from("<I", self.buf, vp)
+        n = self._read("<I", vp)
+        if vp + 4 + n * 4 > len(self.buf):
+            raise WireError("String vector extends past buffer end")
         out = []
         for i in range(n):
             ep = vp + 4 + i * 4
-            sp = self._indirect(ep)
-            (sn,) = struct.unpack_from("<I", self.buf, sp)
-            out.append(bytes(self.buf[sp + 4 : sp + 4 + sn]).decode("utf-8"))
+            out.append(self._string_at(self._indirect(ep)))
         return out
 
 
@@ -388,13 +421,24 @@ def _decode_da00_variable(t: _Tbl) -> Da00Variable:
     raw = t.vector_np(5, np.uint8)
     axes = tuple(t.strings(2))
     if shape:
+        if any(s < 0 for s in shape):
+            raise WireError(f"Negative dimension in da00 shape {shape}")
         n_items = int(np.prod(shape))
     else:
         # Shape slot is omitted for 0-d (scalar) data; an absent shape with
         # axes present means a 1-d vector whose length comes from the data.
         n_items = raw.size // dtype.itemsize
         shape = () if (not axes and n_items == 1) else (n_items,)
-    data = raw.view(dtype)[:n_items].reshape(shape)
+    if n_items * dtype.itemsize > raw.size:
+        # A hostile shape vector must fail the containment contract's
+        # way, not as a numpy reshape ValueError.
+        raise WireError(
+            f"da00 shape {shape} needs {n_items} items but payload "
+            f"holds {raw.size // max(dtype.itemsize, 1)}"
+        )
+    # Slice to the exact byte count first: view() on a length not divisible
+    # by the itemsize would raise numpy's own error instead of WireError.
+    data = raw[: n_items * dtype.itemsize].view(dtype).reshape(shape)
     return Da00Variable(name=t.string(0), unit=t.string(1), axes=axes, data=data)
 
 
