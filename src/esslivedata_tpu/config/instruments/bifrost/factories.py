@@ -3,11 +3,35 @@
 from __future__ import annotations
 
 from ....workflows.multibank import MultiBankViewWorkflow
-from .specs import BANK_DETECTOR_NUMBERS, MULTIBANK_HANDLE
+from ....workflows.qe_spectroscopy import QESpectroscopyWorkflow
+from .specs import (
+    BANK_DETECTOR_NUMBERS,
+    MULTIBANK_HANDLE,
+    QE_HANDLE,
+    analyzer_geometry,
+)
 
 
 @MULTIBANK_HANDLE.attach_factory
 def make_multibank(*, source_name: str, params) -> MultiBankViewWorkflow:
     return MultiBankViewWorkflow(
         bank_detector_numbers=BANK_DETECTOR_NUMBERS, params=params
+    )
+
+
+@QE_HANDLE.attach_factory
+def make_qe_map(
+    *, source_name: str, params, aux_source_names=None
+) -> QESpectroscopyWorkflow:
+    geometry = analyzer_geometry()
+    monitors = (
+        {aux_source_names["monitor"]}
+        if aux_source_names and "monitor" in aux_source_names
+        else set()
+    )
+    return QESpectroscopyWorkflow(
+        **geometry,
+        params=params,
+        primary_stream=source_name,
+        monitor_streams=monitors,
     )

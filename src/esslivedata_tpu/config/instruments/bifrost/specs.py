@@ -3,9 +3,10 @@
 The real instrument's banks come from its NeXus geometry; here each of the
 9 analyzer triplets is a 100x30 pixel bank with contiguous detector-number
 blocks — the right topology for the merged-stream + bank-sharded reduction
-path. Q-E per-analyzer rebinning maps (the full spectrometer physics)
-belong on top of the same per-bank kernel via a qmap (ops/qhistogram.py)
-and are a planned extension.
+path. Q-E per-analyzer rebinning (the full
+spectrometer physics) runs on the same kernel family via a precompiled
+(pixel, toa) -> (Q, E)-bin map — see QE_HANDLE below and
+workflows/qe_spectroscopy.py.
 """
 
 from __future__ import annotations
@@ -20,6 +21,7 @@ from ....config.instrument import (
 )
 from ....config.workflow_spec import OutputSpec, WorkflowSpec
 from ....workflows.multibank import MultiBankParams
+from ....workflows.qe_spectroscopy import QESpectroscopyParams
 from ....workflows.workflow_factory import workflow_registry
 from .._common import register_monitor_spec, register_parsed_catalog
 
@@ -83,3 +85,69 @@ MULTIBANK_HANDLE = workflow_registry.register_spec(
 )
 
 MONITOR_HANDLE = register_monitor_spec(INSTRUMENT)
+
+
+def analyzer_geometry() -> dict[str, np.ndarray]:
+    """Synthetic per-pixel analyzer geometry for the 9-triplet layout.
+
+    Placeholder physics in the spirit of the instrument (real
+    deployments regenerate from the facility geometry file): the nine
+    wedges fan over scattering angles 15°-150° with the 30 detector
+    columns spreading ±4° inside each wedge, and the 100 rows split
+    into BIFROST's five analyzer energies (2.7-5.0 meV) with the
+    secondary flight path growing with the analyzer radius.
+    """
+    ef_levels = np.array([2.7, 3.2, 3.8, 4.4, 5.0])
+    rows_per_ef = BANK_NY // len(ef_levels)
+    two_theta = np.empty(N_BANKS * PIXELS_PER_BANK)
+    ef = np.empty_like(two_theta)
+    l2 = np.empty_like(two_theta)
+    pixel_ids = np.empty(two_theta.shape, dtype=np.int64)
+    for b in range(N_BANKS):
+        bank_center = np.deg2rad(15.0 + b * (135.0 / (N_BANKS - 1)))
+        col_offset = np.deg2rad(np.linspace(-4.0, 4.0, BANK_NX))
+        row_ef = ef_levels[
+            np.minimum(np.arange(BANK_NY) // rows_per_ef, len(ef_levels) - 1)
+        ]
+        sl = slice(b * PIXELS_PER_BANK, (b + 1) * PIXELS_PER_BANK)
+        two_theta[sl] = np.repeat(
+            bank_center + col_offset[None, :], BANK_NY, axis=0
+        ).reshape(-1)
+        ef[sl] = np.repeat(row_ef[:, None], BANK_NX, axis=1).reshape(-1)
+        l2[sl] = 1.2 + 0.25 * np.repeat(
+            np.minimum(np.arange(BANK_NY) // rows_per_ef, 4)[:, None],
+            BANK_NX,
+            axis=1,
+        ).reshape(-1)
+        pixel_ids[sl] = BANK_DETECTOR_NUMBERS[f"triplet_{b}"].reshape(-1)
+    return {
+        "two_theta": two_theta,
+        "ef_mev": ef,
+        "l2": l2,
+        "pixel_ids": pixel_ids,
+    }
+
+
+QE_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="bifrost",
+        namespace="spectrometer",
+        name="qe_map",
+        title="S(Q, E) map (indirect-geometry rebinning)",
+        source_names=[MERGED_STREAM],
+        service="data_reduction",
+        aux_source_names={"monitor": ["monitor_1"]},
+        params_model=QESpectroscopyParams,
+        outputs={
+            "sqw_current": OutputSpec(title="S(Q, E) — window"),
+            "sqw_cumulative": OutputSpec(
+                title="S(Q, E) — since start", view="since_start"
+            ),
+            "sqw_normalized": OutputSpec(
+                title="S(Q, E) / monitor", view="since_start"
+            ),
+            "counts_current": OutputSpec(title="Events binned"),
+            "monitor_counts_current": OutputSpec(title="Monitor counts"),
+        },
+    )
+)

@@ -20,7 +20,12 @@ import numpy as np
 
 from .event_batch import EventBatch
 
-__all__ = ["QHistogrammer", "QState", "build_sans_qmap"]
+__all__ = ["QHistogrammer", "QState", "build_qe_map", "build_sans_qmap"]
+
+#: meV per (m/s)^2 — E = 1/2 m_n v^2 in neutron units.
+E_FROM_V2 = 5.227037e-6
+#: 1/angstrom per (m/s) — k = m_n v / hbar in neutron units.
+K_FROM_V = 1.58825e-3
 
 
 class QState(NamedTuple):
@@ -68,6 +73,75 @@ def build_sans_qmap(
     qmap = np.full((n_id_space, len(toa_edges) - 1), -1, dtype=np.int32)
     qmap[np.asarray(pixel_ids)] = q_bin.astype(np.int32)
     return qmap
+
+
+def build_qe_map(
+    *,
+    two_theta: np.ndarray,  # [n_pixel] scattering angle (rad)
+    ef_mev: np.ndarray,  # [n_pixel] analyzer-selected final energy
+    l2: np.ndarray,  # [n_pixel] sample->analyzer->detector path (m)
+    pixel_ids: np.ndarray,
+    toa_edges: np.ndarray,  # ns since pulse
+    q_edges: np.ndarray,  # 1/angstrom
+    e_edges: np.ndarray,  # meV energy transfer (Ei - Ef)
+    l1: float = 162.0,  # ESS source->sample for BIFROST
+    toa_offset_ns: float = 0.0,
+) -> np.ndarray:
+    """Precompile indirect-geometry spectrometer physics into
+    ``map[pixel, toa_bin] -> flat (Q, E) bin`` (row-major, ``n_e`` fast).
+
+    The analyzer crystal fixes the final energy per pixel, so the final
+    leg's flight time is a per-pixel constant: ``t2 = l2 / v(Ef)``.
+    Subtracting it from the arrival time gives the incident velocity
+    ``vi = l1 / (t - t2)``, hence ``Ei``, the energy transfer
+    ``dE = Ei - Ef`` and the momentum transfer
+    ``|Q|^2 = ki^2 + kf^2 - 2 ki kf cos(2theta)``. Events whose (Q, E)
+    falls outside the edges — or that arrive before the final leg alone
+    could deliver them — map to -1 (dropped by the kernel). Like the
+    SANS map, a geometry/calibration change rebuilds on host and swaps
+    in without touching the stream.
+    """
+    two_theta = np.asarray(two_theta, dtype=np.float64)
+    ef = np.asarray(ef_mev, dtype=np.float64)
+    l2 = np.asarray(l2, dtype=np.float64)
+    vf = np.sqrt(ef / E_FROM_V2)  # [n_pixel]
+    t2 = l2 / vf  # s, per-pixel constant final leg
+    toa_centers_s = (
+        (np.asarray(toa_edges[:-1]) + np.asarray(toa_edges[1:])) / 2.0
+        + toa_offset_ns
+    ) * 1e-9
+    t1 = toa_centers_s[None, :] - t2[:, None]  # [n_pixel, n_toa]
+    with np.errstate(divide="ignore", invalid="ignore"):
+        vi = l1 / t1
+        ei = E_FROM_V2 * vi * vi
+        de = ei - ef[:, None]
+        ki = K_FROM_V * vi
+        kf = (K_FROM_V * vf)[:, None]
+        q = np.sqrt(
+            np.maximum(
+                ki * ki + kf * kf - 2.0 * ki * kf * np.cos(two_theta)[:, None],
+                0.0,
+            )
+        )
+    n_e = len(e_edges) - 1
+    qb = np.searchsorted(q_edges, q, side="right") - 1
+    eb = np.searchsorted(e_edges, de, side="right") - 1
+    ok = (
+        (t1 > 0)
+        & np.isfinite(q)
+        & np.isfinite(de)
+        & (qb >= 0)
+        & (q < q_edges[-1])
+        & (eb >= 0)
+        & (de < e_edges[-1])
+    )
+    flat = qb * n_e + eb
+    flat[~ok] = -1
+
+    n_id_space = int(np.asarray(pixel_ids).max()) + 1
+    qe_map = np.full((n_id_space, len(toa_edges) - 1), -1, dtype=np.int32)
+    qe_map[np.asarray(pixel_ids)] = flat.astype(np.int32)
+    return qe_map
 
 
 class QHistogrammer:

@@ -3,6 +3,7 @@ hosts full reductions consuming detectors + monitors + logs."""
 
 from __future__ import annotations
 
+from ..config.instrument import instrument_registry
 from ..kafka.routes import RoutingAdapterBuilder
 from ..preprocessors.factories import ReductionPreprocessorFactory
 from .service_factory import DataServiceBuilder, DataServiceRunner
@@ -18,10 +19,16 @@ def make_reduction_service_builder(
     job_threads: int = 5,
     heartbeat_interval_s: float = 2.0,
 ) -> DataServiceBuilder:
+    # Merged-detector instruments (BIFROST) address reductions at the
+    # single logical stream; the reduction service must apply the same
+    # adaptation the detector service does or jobs subscribed to the
+    # merged name never see events.
+    merge = instrument_registry[instrument].merge_detectors
+
     def routes(mapping):
         return (
             RoutingAdapterBuilder(stream_mapping=mapping)
-            .with_detector_route()
+            .with_detector_route(merge_detectors=merge)
             .with_monitor_route()
             .with_logdata_route()
             .with_run_control_route()

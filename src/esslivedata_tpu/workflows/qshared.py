@@ -1,0 +1,70 @@
+"""Shared streaming plumbing for QHistogrammer-backed reductions.
+
+SANS I(Q) and the Q-E spectrometer map differ only in the precompiled
+(pixel, toa-bin) -> bin map and the output formatting; everything
+between — aux-monitor counting, monitor-only windows via an empty
+padded batch, and the fused single-round-trip publish of the QState —
+lives here once.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any
+
+import numpy as np
+
+from ..ops.event_batch import EventBatch
+from ..preprocessors.event_data import StagedEvents
+
+__all__ = ["QStreamingMixin"]
+
+
+class QStreamingMixin:
+    """Requires ``_hist`` (QHistogrammer), ``_state``, ``_primary_stream``,
+    ``_monitor_streams`` and ``_publish = None`` set by the subclass."""
+
+    def accumulate(self, data: Mapping[str, Any]) -> None:
+        monitor_count = 0.0
+        detector: EventBatch | None = None
+        for key, value in data.items():
+            if not isinstance(value, StagedEvents):
+                continue
+            if key in self._monitor_streams:
+                monitor_count += float(value.n_events)
+            elif self._primary_stream is None or key == self._primary_stream:
+                detector = value.batch
+        if detector is not None or monitor_count:
+            if detector is None:
+                # monitor-only window: empty padded batch keeps shapes static
+                detector = EventBatch.from_arrays(
+                    np.empty(0, dtype=np.int32), np.empty(0, dtype=np.float32)
+                )
+            self._state = self._hist.step(self._state, detector, monitor_count)
+
+    def _take_publish(self) -> tuple[np.ndarray, np.ndarray, float, float]:
+        """One fused publish: (window, cumulative, monitor_window,
+        monitor_cumulative) on host; the window folds."""
+        if self._publish is None:
+            from ..ops.publish import PackedPublisher
+
+            def program(state):
+                outputs = {
+                    "win": state.window,
+                    "cum": state.cumulative,
+                    "mon_win": state.monitor_window,
+                    "mon_cum": state.monitor_cumulative,
+                }
+                return outputs, self._hist.fold_window(state)
+
+            self._publish = PackedPublisher(program)
+        out, self._state = self._publish(self._state)
+        return (
+            out["win"],
+            out["cum"],
+            float(out["mon_win"]),
+            float(out["mon_cum"]),
+        )
+
+    def clear(self) -> None:
+        self._state = self._hist.clear()

@@ -9,17 +9,13 @@ events (ADR-0002-style aux binding through WorkflowConfig.aux_source_names).
 
 from __future__ import annotations
 
-from collections.abc import Mapping
-from typing import Any
-
 import numpy as np
 from pydantic import BaseModel, ConfigDict, Field
 
 from ..config.models import TOARange
-from ..ops.event_batch import EventBatch
 from ..ops.qhistogram import QHistogrammer, build_sans_qmap
-from ..preprocessors.event_data import StagedEvents
 from ..utils.labeled import DataArray, Variable
+from .qshared import QStreamingMixin
 
 __all__ = ["SansIQParams", "SansIQWorkflow"]
 
@@ -35,7 +31,7 @@ class SansIQParams(BaseModel):
     l1: float = 23.0  # m, source->sample
 
 
-class SansIQWorkflow:
+class SansIQWorkflow(QStreamingMixin):
     """Detector events -> I(Q); aux monitor events -> normalization."""
 
     def __init__(
@@ -69,24 +65,6 @@ class SansIQWorkflow:
         self._monitor_streams = monitor_streams or set()
         self._publish = None
 
-    def accumulate(self, data: Mapping[str, Any]) -> None:
-        monitor_count = 0.0
-        detector: EventBatch | None = None
-        for key, value in data.items():
-            if not isinstance(value, StagedEvents):
-                continue
-            if key in self._monitor_streams:
-                monitor_count += float(value.n_events)
-            elif self._primary_stream is None or key == self._primary_stream:
-                detector = value.batch
-        if detector is not None or monitor_count:
-            if detector is None:
-                # monitor-only window: empty padded batch keeps shapes static
-                detector = EventBatch.from_arrays(
-                    np.empty(0, dtype=np.int32), np.empty(0, dtype=np.float32)
-                )
-            self._state = self._hist.step(self._state, detector, monitor_count)
-
     def _iq(self, counts: np.ndarray, monitor: float) -> DataArray:
         norm = counts / max(monitor, 1.0)
         return DataArray(
@@ -95,23 +73,7 @@ class SansIQWorkflow:
         )
 
     def finalize(self) -> dict[str, DataArray]:
-        if self._publish is None:
-            from ..ops.publish import PackedPublisher
-
-            def program(state):
-                outputs = {
-                    "win": state.window,
-                    "cum": state.cumulative,
-                    "mon_win": state.monitor_window,
-                    "mon_cum": state.monitor_cumulative,
-                }
-                return outputs, self._hist.fold_window(state)
-
-            # One execute + one packed fetch per publish (ops/publish.py).
-            self._publish = PackedPublisher(program)
-        out, self._state = self._publish(self._state)
-        win, cum = out["win"], out["cum"]
-        mon_win, mon_cum = float(out["mon_win"]), float(out["mon_cum"])
+        win, cum, mon_win, mon_cum = self._take_publish()
         coords = {"Q": self._q_edges_var}
         return {
             "iq_current": self._iq(win, mon_win),
@@ -124,5 +86,4 @@ class SansIQWorkflow:
             ),
         }
 
-    def clear(self) -> None:
-        self._state = self._hist.clear()
+

@@ -288,3 +288,66 @@ class TestLokiParsedCatalogTimeseries:
         out = decoded_outputs(producer, "loki_livedata_data")
         assert any(name in key for key in out), sorted(out)
 
+
+
+class TestBifrostQEReduction:
+    def test_qe_map_on_merged_stream_with_elastic_line(self):
+        # Regression: the reduction service must apply the merged-detector
+        # adaptation (it didn't — jobs at 'detector' saw no events).
+        import numpy as np
+
+        from esslivedata_tpu.config.instruments.bifrost.specs import (
+            MERGED_STREAM,
+            QE_HANDLE,
+        )
+        from esslivedata_tpu.ops.qhistogram import E_FROM_V2
+
+        builder = make_reduction_service_builder(
+            instrument="bifrost", batcher=NaiveMessageBatcher(), job_threads=1
+        )
+        raw = PulsedRawSource([])
+        producer = FakeProducer()
+        sink = KafkaSink(
+            producer, make_default_serializer(builder.stream_mapping.livedata, "qe")
+        )
+        service = builder.from_raw_source(raw, sink)
+        raw.inject(
+            start_command(
+                QE_HANDLE.workflow_id,
+                MERGED_STREAM,
+                "bifrost_livedata_commands",
+                aux={"monitor": "monitor_1"},
+            )
+        )
+        service.step()
+        # Elastic arrivals for the first analyzer block (Ef=2.7, l2=1.2).
+        v = np.sqrt(2.7 / E_FROM_V2)
+        t_arr = (162.0 + 1.2) / v * 1e9
+        rng = np.random.default_rng(0)
+        for pulse in range(3):
+            t_pulse = 1_700_000_000_000_000_000 + pulse * int(1e9 / 14)
+            ids = rng.integers(1, 600, 1000).astype(np.int32)
+            toa = np.full(1000, t_arr, dtype=np.int32)
+            raw.inject(
+                FakeKafkaMessage(
+                    wire.encode_ev44(
+                        "bifrost_triplet_0",
+                        pulse,
+                        np.array([t_pulse]),
+                        np.array([0]),
+                        toa,
+                        pixel_id=ids,
+                    ),
+                    "bifrost_detector",
+                )
+            )
+            service.step()
+        outputs = decoded_outputs(producer, "bifrost_livedata_data")
+        sqw = next(
+            var
+            for var in outputs["sqw_cumulative"].variables
+            if var.name == "signal"
+        )
+        assert float(np.asarray(sqw.data, np.float64).sum()) == 3000.0
+        # Elastic events concentrate in few (Q, E) bins around dE=0.
+        assert (np.asarray(sqw.data) > 0).sum() < 40
