@@ -11,10 +11,12 @@ from ....workflows.detector_view.projectors import (
 )
 from ....workflows.detector_view.workflow import DetectorViewWorkflow
 from ....workflows.monitor_workflow import MonitorWorkflow
+from ....workflows.powder import PowderDiffractionWorkflow
 from ....workflows.timeseries import TimeseriesWorkflow
 from ....workflows.wavelength_lut_workflow import WavelengthLutWorkflow
 from .specs import (
     BANK_SIZES,
+    POWDER_HANDLE,
     BANK_VIEW_HANDLE,
     CHOPPER_GEOMETRY,
     INSTRUMENT,
@@ -23,6 +25,7 @@ from .specs import (
     MONITOR_HANDLE,
     TIMESERIES_HANDLE,
     WAVELENGTH_LUT_HANDLE,
+    powder_geometry,
 )
 
 
@@ -73,3 +76,21 @@ def make_wavelength_lut(*, source_name: str, params) -> WavelengthLutWorkflow:  
 @TIMESERIES_HANDLE.attach_factory
 def make_timeseries(*, source_name: str, params) -> TimeseriesWorkflow:  # noqa: ARG001
     return TimeseriesWorkflow()
+
+
+@POWDER_HANDLE.attach_factory
+def make_powder(
+    *, source_name: str, params, aux_source_names=None
+) -> PowderDiffractionWorkflow:
+    geometry = powder_geometry(source_name)
+    monitors = (
+        {aux_source_names["monitor"]}
+        if aux_source_names and "monitor" in aux_source_names
+        else set()
+    )
+    return PowderDiffractionWorkflow(
+        **geometry,
+        params=params,
+        primary_stream=source_name,
+        monitor_streams=monitors,
+    )

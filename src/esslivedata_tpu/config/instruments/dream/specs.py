@@ -11,6 +11,7 @@ are available (same caveat as loki/specs.py).
 
 from __future__ import annotations
 
+import numpy as np
 
 from ....config.instrument import (
     DetectorConfig,
@@ -27,6 +28,7 @@ from ....workflows.wavelength_lut_workflow import (
     WavelengthLutParams,
     spec_context_keys,
 )
+from ....workflows.powder import PowderDiffractionParams
 from ....workflows.workflow_factory import workflow_registry
 from .._common import (
     register_parsed_catalog,
@@ -218,3 +220,66 @@ WAVELENGTH_LUT_HANDLE = workflow_registry.register_spec(
 )
 
 TIMESERIES_HANDLE = register_timeseries_spec(INSTRUMENT)
+
+
+def powder_geometry(bank: str) -> dict[str, np.ndarray]:
+    """Synthetic per-pixel diffraction geometry for one bank.
+
+    Placeholder in the spirit of the instrument (real deployments read
+    pixel positions from the facility geometry file): the mantle wraps
+    scattering angles 32°-148° along its strip axis; endcap banks sit
+    forward/backward of the sample. Flight path = 76.55 m moderator->
+    sample plus a secondary path growing modestly across the bank.
+    """
+    layout = INSTRUMENT.detectors[bank].detector_number
+    ids = layout.reshape(-1)
+    n = ids.size
+    # The scattering angle varies along the STRIP axis (the mantle's
+    # cylinder axis direction); wire depth and module/segment position
+    # leave it nearly unchanged. Use each pixel's strip coordinate, not
+    # the flattened index (which walks the wire/depth axis first).
+    sizes = BANK_SIZES[bank]
+    shape = tuple(sizes.values())
+    strip_axis = list(sizes).index("strip")
+    strip_idx = np.unravel_index(np.arange(n), shape)[strip_axis]
+    frac = strip_idx / max(shape[strip_axis] - 1, 1)
+    if bank == "mantle_detector":
+        two_theta = np.deg2rad(32.0 + 116.0 * frac)
+    elif "backward" in bank:
+        two_theta = np.deg2rad(130.0 + 40.0 * frac)
+    else:
+        two_theta = np.deg2rad(10.0 + 35.0 * frac)
+    # Secondary flight path grows modestly with wire depth.
+    wire_axis = list(sizes).index("wire")
+    wire_idx = np.unravel_index(np.arange(n), shape)[wire_axis]
+    l_total = 76.55 + 1.1 + 0.02 * wire_idx
+    return {
+        "two_theta": two_theta,
+        "l_total": l_total,
+        "pixel_ids": ids.astype(np.int64),
+    }
+
+
+POWDER_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="dream",
+        namespace="powder",
+        name="dspacing",
+        title="I(d) powder pattern (Bragg rebinning)",
+        source_names=list(BANK_SIZES),
+        service="data_reduction",
+        aux_source_names={"monitor": ["monitor_bunker", "monitor_cave"]},
+        params_model=PowderDiffractionParams,
+        outputs={
+            "dspacing_current": OutputSpec(title="I(d) — window"),
+            "dspacing_cumulative": OutputSpec(
+                title="I(d) — since start", view="since_start"
+            ),
+            "dspacing_normalized": OutputSpec(
+                title="I(d) / monitor", view="since_start"
+            ),
+            "counts_current": OutputSpec(title="Events binned"),
+            "monitor_counts_current": OutputSpec(title="Monitor counts"),
+        },
+    )
+)

@@ -20,12 +20,25 @@ import numpy as np
 
 from .event_batch import EventBatch
 
-__all__ = ["QHistogrammer", "QState", "build_qe_map", "build_sans_qmap"]
+__all__ = [
+    "QHistogrammer",
+    "QState",
+    "PixelBinMap",
+    "build_dspacing_map",
+    "build_qe_map",
+    "build_sans_qmap",
+]
 
 #: meV per (m/s)^2 — E = 1/2 m_n v^2 in neutron units.
 E_FROM_V2 = 5.227037e-6
 #: 1/angstrom per (m/s) — k = m_n v / hbar in neutron units.
 K_FROM_V = 1.58825e-3
+#: h / m_n in neutron units: lambda[angstrom] = H_OVER_MN * t[s] / L[m].
+H_OVER_MN = 3956.034
+
+#: Pixels per chunk in the host map builders: bounds peak intermediate
+#: memory to chunk * n_toa floats regardless of bank size.
+_MAP_CHUNK = 65536
 
 
 class QState(NamedTuple):
@@ -33,6 +46,39 @@ class QState(NamedTuple):
     window: jax.Array  # [n_q]
     monitor_cumulative: jax.Array  # scalar
     monitor_window: jax.Array  # scalar
+
+
+class PixelBinMap(NamedTuple):
+    """A (pixel, toa-bin) -> bin table over the bank's own id range.
+
+    ``table`` rows cover ``[id_base, id_base + n_rows)`` — NOT the global
+    pixel-id space; the kernel subtracts ``id_base`` before the lookup.
+    DREAM's banks sit hundreds of thousands of ids into a shared
+    sequential space, and a globally-indexed table would be ~95% dead
+    rows of device memory. ``table`` is int16 when the bin count fits
+    (halving HBM for LOKI/DREAM-scale maps), int32 otherwise; -1 = drop.
+    """
+
+    table: np.ndarray
+    id_base: int
+
+
+def _toa_centers_s(toa_edges: np.ndarray, toa_offset_ns: float) -> np.ndarray:
+    edges = np.asarray(toa_edges, dtype=np.float64)
+    return ((edges[:-1] + edges[1:]) / 2.0 + toa_offset_ns) * 1e-9
+
+
+def _assemble_map(
+    pixel_ids: np.ndarray, row_bins: np.ndarray, n_bins: int
+) -> PixelBinMap:
+    """Scatter per-declared-pixel rows into the bank-local id table."""
+    ids = np.asarray(pixel_ids)
+    id_base = int(ids.min())
+    n_rows = int(ids.max()) - id_base + 1
+    dtype = np.int16 if n_bins < np.iinfo(np.int16).max else np.int32
+    table = np.full((n_rows, row_bins.shape[1]), -1, dtype=dtype)
+    table[ids - id_base] = row_bins.astype(dtype)
+    return PixelBinMap(table=table, id_base=id_base)
 
 
 def build_sans_qmap(
@@ -52,27 +98,58 @@ def build_sans_qmap(
     ``q_edges`` are -1 (dropped by the kernel).
     """
     positions = np.asarray(positions, dtype=np.float64)
-    h_over_mn = 3956.034  # m * angstrom / s  (h/m_n in neutron units)
     l2 = np.linalg.norm(positions, axis=1)  # sample->pixel (m)
     r_perp = np.hypot(positions[:, 0], positions[:, 1])
     theta = np.arctan2(r_perp, positions[:, 2])  # scattering angle
     k_factor = 4.0 * np.pi * np.sin(theta / 2.0)  # [n_pixel]
 
-    toa_centers_s = (
-        (np.asarray(toa_edges[:-1]) + np.asarray(toa_edges[1:])) / 2.0
-        + toa_offset_ns
-    ) * 1e-9
+    toa_centers_s = _toa_centers_s(toa_edges, toa_offset_ns)
     L = l1 + l2  # [n_pixel]
-    lam = h_over_mn * toa_centers_s[None, :] / L[:, None]  # [n_pixel, n_toa]
-    with np.errstate(divide="ignore", invalid="ignore"):
-        q = k_factor[:, None] / lam  # 1/angstrom
-    q_bin = np.searchsorted(q_edges, q, side="right") - 1
-    q_bin[(q < q_edges[0]) | (q >= q_edges[-1]) | ~np.isfinite(q)] = -1
+    n_pixel = L.size
+    q_bin = np.empty((n_pixel, toa_centers_s.size), dtype=np.int32)
+    for lo in range(0, n_pixel, _MAP_CHUNK):
+        sl = slice(lo, min(lo + _MAP_CHUNK, n_pixel))
+        lam = H_OVER_MN * toa_centers_s[None, :] / L[sl, None]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            q = k_factor[sl, None] / lam  # 1/angstrom
+        qb = np.searchsorted(q_edges, q, side="right") - 1
+        qb[(q < q_edges[0]) | (q >= q_edges[-1]) | ~np.isfinite(q)] = -1
+        q_bin[sl] = qb
+    return _assemble_map(pixel_ids, q_bin, len(q_edges) - 1)
 
-    n_id_space = int(np.asarray(pixel_ids).max()) + 1
-    qmap = np.full((n_id_space, len(toa_edges) - 1), -1, dtype=np.int32)
-    qmap[np.asarray(pixel_ids)] = q_bin.astype(np.int32)
-    return qmap
+
+def build_dspacing_map(
+    *,
+    two_theta: np.ndarray,  # [n_pixel] scattering angle (rad)
+    l_total: np.ndarray,  # [n_pixel] moderator->sample->pixel path (m)
+    pixel_ids: np.ndarray,
+    toa_edges: np.ndarray,  # ns since pulse
+    d_edges: np.ndarray,  # angstrom
+    toa_offset_ns: float = 0.0,
+) -> np.ndarray:
+    """Precompile powder-diffraction physics into
+    ``map[pixel, toa_bin] -> d bin``.
+
+    Bragg: ``lambda = (h / m_n) t / L`` and ``d = lambda / (2 sin
+    theta)`` with ``theta`` half the scattering angle — each pixel's TOF
+    axis is a fixed d-spacing axis, so the whole conversion is a table.
+    Out-of-range or unphysical entries map to -1 (dropped).
+    """
+    two_theta = np.asarray(two_theta, dtype=np.float64)
+    l_total = np.asarray(l_total, dtype=np.float64)
+    toa_centers_s = _toa_centers_s(toa_edges, toa_offset_ns)
+    inv_2sin = 1.0 / (2.0 * np.sin(two_theta / 2.0))
+    n_pixel = l_total.size
+    d_bin = np.empty((n_pixel, toa_centers_s.size), dtype=np.int32)
+    for lo in range(0, n_pixel, _MAP_CHUNK):
+        sl = slice(lo, min(lo + _MAP_CHUNK, n_pixel))
+        with np.errstate(divide="ignore", invalid="ignore"):
+            lam = H_OVER_MN * toa_centers_s[None, :] / l_total[sl, None]
+            d = lam * inv_2sin[sl, None]
+        db = np.searchsorted(d_edges, d, side="right") - 1
+        db[~(np.isfinite(d) & (db >= 0) & (d < d_edges[-1]))] = -1
+        d_bin[sl] = db
+    return _assemble_map(pixel_ids, d_bin, len(d_edges) - 1)
 
 
 def build_qe_map(
@@ -106,42 +183,44 @@ def build_qe_map(
     l2 = np.asarray(l2, dtype=np.float64)
     vf = np.sqrt(ef / E_FROM_V2)  # [n_pixel]
     t2 = l2 / vf  # s, per-pixel constant final leg
-    toa_centers_s = (
-        (np.asarray(toa_edges[:-1]) + np.asarray(toa_edges[1:])) / 2.0
-        + toa_offset_ns
-    ) * 1e-9
-    t1 = toa_centers_s[None, :] - t2[:, None]  # [n_pixel, n_toa]
-    with np.errstate(divide="ignore", invalid="ignore"):
-        vi = l1 / t1
-        ei = E_FROM_V2 * vi * vi
-        de = ei - ef[:, None]
-        ki = K_FROM_V * vi
-        kf = (K_FROM_V * vf)[:, None]
-        q = np.sqrt(
-            np.maximum(
-                ki * ki + kf * kf - 2.0 * ki * kf * np.cos(two_theta)[:, None],
-                0.0,
-            )
-        )
+    toa_centers_s = _toa_centers_s(toa_edges, toa_offset_ns)
     n_e = len(e_edges) - 1
-    qb = np.searchsorted(q_edges, q, side="right") - 1
-    eb = np.searchsorted(e_edges, de, side="right") - 1
-    ok = (
-        (t1 > 0)
-        & np.isfinite(q)
-        & np.isfinite(de)
-        & (qb >= 0)
-        & (q < q_edges[-1])
-        & (eb >= 0)
-        & (de < e_edges[-1])
+    n_pixel = l2.size
+    flat_bin = np.empty((n_pixel, toa_centers_s.size), dtype=np.int32)
+    for lo in range(0, n_pixel, _MAP_CHUNK):
+        sl = slice(lo, min(lo + _MAP_CHUNK, n_pixel))
+        t1 = toa_centers_s[None, :] - t2[sl, None]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            vi = l1 / t1
+            ei = E_FROM_V2 * vi * vi
+            de = ei - ef[sl, None]
+            ki = K_FROM_V * vi
+            kf = (K_FROM_V * vf)[sl, None]
+            q = np.sqrt(
+                np.maximum(
+                    ki * ki
+                    + kf * kf
+                    - 2.0 * ki * kf * np.cos(two_theta)[sl, None],
+                    0.0,
+                )
+            )
+        qb = np.searchsorted(q_edges, q, side="right") - 1
+        eb = np.searchsorted(e_edges, de, side="right") - 1
+        ok = (
+            (t1 > 0)
+            & np.isfinite(q)
+            & np.isfinite(de)
+            & (qb >= 0)
+            & (q < q_edges[-1])
+            & (eb >= 0)
+            & (de < e_edges[-1])
+        )
+        flat = qb * n_e + eb
+        flat[~ok] = -1
+        flat_bin[sl] = flat
+    return _assemble_map(
+        pixel_ids, flat_bin, (len(q_edges) - 1) * n_e
     )
-    flat = qb * n_e + eb
-    flat[~ok] = -1
-
-    n_id_space = int(np.asarray(pixel_ids).max()) + 1
-    qe_map = np.full((n_id_space, len(toa_edges) - 1), -1, dtype=np.int32)
-    qe_map[np.asarray(pixel_ids)] = flat.astype(np.int32)
-    return qe_map
 
 
 class QHistogrammer:
@@ -151,17 +230,22 @@ class QHistogrammer:
     def __init__(
         self,
         *,
-        qmap: np.ndarray,  # [n_pixel_id_space, n_toa_map] -> q bin or -1
+        qmap: "np.ndarray | PixelBinMap",  # (pixel, toa_bin) -> bin or -1
         toa_edges: np.ndarray,
         n_q: int,
         dtype=jnp.float32,
     ) -> None:
+        if isinstance(qmap, PixelBinMap):
+            table, id_base = qmap.table, qmap.id_base
+        else:
+            table, id_base = np.asarray(qmap), 0
         toa_edges = np.asarray(toa_edges, dtype=np.float64)
-        if qmap.shape[1] != toa_edges.size - 1:
+        if table.shape[1] != toa_edges.size - 1:
             raise ValueError("qmap toa axis must match toa_edges")
-        if qmap.max(initial=-1) >= n_q:
+        if table.max(initial=-1) >= n_q:
             raise ValueError("qmap entries must be < n_q")
-        self._qmap = jnp.asarray(qmap)
+        self._qmap = jnp.asarray(table)
+        self._id_base = int(id_base)
         self._n_q = int(n_q)
         self._lo = float(toa_edges[0])
         self._hi = float(toa_edges[-1])
@@ -190,9 +274,11 @@ class QHistogrammer:
         tb = jnp.floor((toa - self._lo) * self._inv_width).astype(jnp.int32)
         t_ok = (toa >= self._lo) & (toa < self._hi)
         tb = jnp.clip(tb, 0, n_toa - 1)
-        p_ok = (pixel_id >= 0) & (pixel_id < n_pix)
-        pid = jnp.clip(pixel_id, 0, n_pix - 1)
-        qb = self._qmap[pid, tb]
+        # Bank-local table: shift global ids onto its rows first.
+        local = pixel_id - self._id_base
+        p_ok = (local >= 0) & (local < n_pix)
+        pid = jnp.clip(local, 0, n_pix - 1)
+        qb = self._qmap[pid, tb].astype(jnp.int32)
         ok = p_ok & t_ok & (qb >= 0)
         qb = jnp.where(ok, qb, self._n_q)  # OOB-high: dropped
         delta = jnp.zeros((self._n_q,), dtype=self._dtype)

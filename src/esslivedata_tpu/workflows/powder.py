@@ -1,0 +1,96 @@
+"""Powder-diffraction d-spacing workflow (DREAM).
+
+The reference reduces DREAM through ess.powder's sciline graph
+(reference: instruments/dream/factories.py — CorrectedDspacing with
+proton-charge run normalization). The TPU-native shape matches the
+other reductions: Bragg physics precompiles into a host-built
+(pixel, toa-bin) -> d-bin map (ops/qhistogram.build_dspacing_map), the
+streaming work is one gather+scatter per batch into fold-semantics
+state, and normalization divides by the aux-monitor counts (this
+framework's stand-in for accumulated proton charge).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from pydantic import BaseModel, ConfigDict, Field
+
+from ..config.models import TOARange
+from ..ops.qhistogram import QHistogrammer, build_dspacing_map
+from ..utils.labeled import DataArray, Variable
+from .qshared import QStreamingMixin
+
+__all__ = ["PowderDiffractionParams", "PowderDiffractionWorkflow"]
+
+
+class PowderDiffractionParams(BaseModel):
+    model_config = ConfigDict(frozen=True)
+
+    d_bins: int = 400
+    d_min: float = 0.4  # angstrom
+    d_max: float = 2.8
+    toa_bins: int = 500
+    toa_range: TOARange = Field(default_factory=TOARange)
+
+
+class PowderDiffractionWorkflow(QStreamingMixin):
+    """Detector events -> I(d); aux monitor events -> normalization."""
+
+    def __init__(
+        self,
+        *,
+        two_theta: np.ndarray,
+        l_total: np.ndarray,
+        pixel_ids: np.ndarray,
+        params: PowderDiffractionParams | None = None,
+        primary_stream: str | None = None,
+        monitor_streams: set[str] | None = None,
+    ) -> None:
+        params = params or PowderDiffractionParams()
+        self._params = params
+        d_edges = np.linspace(params.d_min, params.d_max, params.d_bins + 1)
+        toa_edges = np.linspace(
+            params.toa_range.low, params.toa_range.high, params.toa_bins + 1
+        )
+        dmap = build_dspacing_map(
+            two_theta=two_theta,
+            l_total=l_total,
+            pixel_ids=pixel_ids,
+            toa_edges=toa_edges,
+            d_edges=d_edges,
+        )
+        self._hist = QHistogrammer(
+            qmap=dmap, toa_edges=toa_edges, n_q=params.d_bins
+        )
+        self._state = self._hist.init_state()
+        self._d_var = Variable(d_edges, ("dspacing",), "angstrom")
+        self._primary_stream = primary_stream
+        self._monitor_streams = monitor_streams or set()
+        self._publish = None
+
+    def _spectrum(self, values: np.ndarray, name: str, unit="counts"):
+        return DataArray(
+            Variable(values, ("dspacing",), unit),
+            coords={"dspacing": self._d_var},
+            name=name,
+        )
+
+    def finalize(self) -> dict[str, DataArray]:
+        win, cum, mon_win, mon_cum = self._take_publish()
+        return {
+            "dspacing_current": self._spectrum(win, "dspacing_current"),
+            "dspacing_cumulative": self._spectrum(
+                cum, "dspacing_cumulative"
+            ),
+            "dspacing_normalized": self._spectrum(
+                cum / max(mon_cum, 1.0), "dspacing_normalized", unit=""
+            ),
+            "counts_current": DataArray(
+                Variable(np.asarray(win.sum()), (), "counts"),
+                name="counts_current",
+            ),
+            "monitor_counts_current": DataArray(
+                Variable(np.asarray(mon_win), (), "counts"),
+                name="monitor_counts_current",
+            ),
+        }

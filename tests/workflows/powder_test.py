@@ -1,0 +1,115 @@
+"""Powder d-spacing rebinning: Bragg map physics + registry wiring."""
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.ops.event_batch import EventBatch
+from esslivedata_tpu.ops.qhistogram import build_dspacing_map
+from esslivedata_tpu.preprocessors.event_data import StagedEvents
+from esslivedata_tpu.workflows.powder import (
+    PowderDiffractionParams,
+    PowderDiffractionWorkflow,
+)
+
+H_OVER_MN = 3956.034  # m * angstrom / s
+
+
+def staged(pid, toa):
+    return StagedEvents(
+        batch=EventBatch.from_arrays(
+            np.asarray(pid, np.int32), np.asarray(toa, np.float32)
+        ),
+        first_timestamp=None,
+        last_timestamp=None,
+        n_chunks=1,
+    )
+
+
+class TestDspacingMapPhysics:
+    def test_known_wavelength_lands_in_bragg_bin(self):
+        # theta = 45 deg (two_theta 90), lambda = 2 A -> d = 2/(2 sin 45)
+        # = sqrt(2) A. Time for lambda=2 A over L=80 m: t = lambda L / C.
+        L = 80.0
+        lam = 2.0
+        t_ns = lam * L / H_OVER_MN * 1e9
+        toa_edges = np.linspace(0.0, 7.1e7, 7101)  # 10 us bins
+        d_edges = np.linspace(0.5, 2.5, 401)  # 5 mA bins
+        dmap = build_dspacing_map(
+            two_theta=np.array([np.pi / 2]),
+            l_total=np.array([L]),
+            pixel_ids=np.array([0]),
+            toa_edges=toa_edges,
+            d_edges=d_edges,
+        )
+        tb = np.searchsorted(toa_edges, t_ns) - 1
+        db = dmap.table[0, tb]
+        assert db >= 0
+        d_expected = np.sqrt(2.0)
+        assert d_edges[db] <= d_expected <= d_edges[db + 1]
+
+    def test_out_of_range_d_dropped(self):
+        toa_edges = np.linspace(0.0, 7.1e7, 101)
+        d_edges = np.linspace(1.0, 1.2, 21)  # narrow window
+        dmap = build_dspacing_map(
+            two_theta=np.array([np.pi / 2]),
+            l_total=np.array([80.0]),
+            pixel_ids=np.array([0]),
+            toa_edges=toa_edges,
+            d_edges=d_edges,
+        )
+        # Most arrival times map far outside the narrow d window.
+        assert (dmap.table[0] == -1).sum() > 90
+
+
+class TestWorkflowAndRegistry:
+    def test_conservation_and_normalization(self):
+        n_pix = 8
+        wf = PowderDiffractionWorkflow(
+            two_theta=np.full(n_pix, np.pi / 2),
+            l_total=np.full(n_pix, 80.0),
+            pixel_ids=np.arange(n_pix),
+            params=PowderDiffractionParams(d_bins=50, d_min=0.5, d_max=2.5),
+            monitor_streams={"monitor_bunker"},
+        )
+        t_ns = 2.0 * 80.0 / H_OVER_MN * 1e9
+        wf.accumulate(
+            {
+                "det": staged(
+                    np.zeros(400, np.int32), np.full(400, t_ns)
+                ),
+                "monitor_bunker": staged(
+                    np.zeros(100, np.int32), np.full(100, 1e6)
+                ),
+            }
+        )
+        out = wf.finalize()
+        assert float(np.asarray(out["dspacing_current"].values).sum()) == 400.0
+        assert (
+            float(np.asarray(out["dspacing_normalized"].values).sum())
+            == pytest.approx(400.0 / 100.0)
+        )
+        # The Bragg peak concentrates in one bin.
+        assert (np.asarray(out["dspacing_current"].values) > 0).sum() == 1
+
+    def test_dream_registry_wiring(self):
+        from esslivedata_tpu.config import JobId, WorkflowConfig
+        from esslivedata_tpu.config.instrument import instrument_registry
+        from esslivedata_tpu.workflows.workflow_factory import (
+            workflow_registry,
+        )
+
+        instrument_registry["dream"].load_factories()
+        from esslivedata_tpu.config.instruments.dream.specs import (
+            POWDER_HANDLE,
+        )
+
+        config = WorkflowConfig(
+            identifier=POWDER_HANDLE.workflow_id,
+            job_id=JobId(source_name="mantle_detector"),
+            params={"d_bins": 30},
+            aux_source_names={"monitor": "monitor_bunker"},
+        )
+        wf = workflow_registry.create(config)
+        assert isinstance(wf, PowderDiffractionWorkflow)
+        out = wf.finalize()
+        assert np.asarray(out["dspacing_cumulative"].values).shape == (30,)

@@ -55,7 +55,7 @@ class TestQEMapPhysics:
         v = np.sqrt(self.EF / E_FROM_V2)
         t_elastic_ns = (self.L1 + self.L2) / v * 1e9
         tb = np.searchsorted(toa_edges, t_elastic_ns) - 1
-        flat = qe_map[0, tb]
+        flat = qe_map.table[0, tb]
         assert flat >= 0
         n_e = len(e_edges) - 1
         qb, eb = divmod(int(flat), n_e)
@@ -74,7 +74,7 @@ class TestQEMapPhysics:
 
         def de_of(toa_ns):
             tb = np.searchsorted(toa_edges, toa_ns) - 1
-            flat = qe_map[0, tb]
+            flat = qe_map.table[0, tb]
             if flat < 0:
                 return None
             eb = int(flat) % n_e
@@ -96,12 +96,12 @@ class TestQEMapPhysics:
         # leg is ~1.5 ms, far below the window start, so instead check
         # out-of-range energies: the very first bins (extremely fast ->
         # huge Ei -> dE above e_max) are dropped.
-        assert qe_map[0, 0] == -1
+        assert qe_map.table[0, 0] == -1
 
     def test_map_is_total_over_declared_pixels(self):
         qe_map, _, _, _ = self._map()
         # Undeclared pixel-id rows are all -1 (dropped).
-        assert qe_map.shape[0] == 1
+        assert qe_map.table.shape[0] == 1
 
 
 class TestWorkflowIntegration:

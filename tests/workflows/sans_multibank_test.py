@@ -51,16 +51,21 @@ class TestQmap:
             q_edges=q_edges,
             l1=23.0,
         )
-        assert qmap.shape == (4, 100)
-        assert (qmap[0] == -1).all()  # id 0 unused
-        assert (qmap[1] == -1).all()  # on-axis: Q=0 below q_min
+        # Bank-local table: rows cover exactly [min_id, max_id].
+        assert qmap.id_base == 1
+        assert qmap.table.shape == (3, 100)
+
+        def row(pid):
+            return qmap.table[pid - qmap.id_base]
+
+        assert (row(1) == -1).all()  # on-axis: Q=0 below q_min
         # larger angle pixel -> larger Q at equal TOA
         tb = 50
-        assert qmap[3, tb] >= qmap[2, tb] or qmap[3, tb] == -1
+        assert row(3)[tb] >= row(2)[tb] or row(3)[tb] == -1
         # later arrival (longer lambda) -> smaller Q for same pixel
-        valid = (qmap[2] >= 0).nonzero()[0]
+        valid = (row(2) >= 0).nonzero()[0]
         if len(valid) > 2:
-            assert qmap[2, valid[0]] >= qmap[2, valid[-1]]
+            assert row(2)[valid[0]] >= row(2)[valid[-1]]
 
     def test_qhistogrammer_counts_and_monitor(self):
         positions, pixel_ids = self.make_geometry()
@@ -81,7 +86,7 @@ class TestQmap:
         expected = sum(
             1
             for p, t in [(2, 1e6), (2, 1e6), (3, 2e6)]
-            if qmap[p, int(t / 71e6 * 100)] >= 0
+            if qmap.table[p - qmap.id_base, int(t / 71e6 * 100)] >= 0
         )
         assert win.sum() == expected
         assert float(np.asarray(state.monitor_window)) == 100.0
