@@ -135,3 +135,12 @@ def register_timeseries_spec(
             reset_on_run_transition=False,
         )
     )
+
+
+def monitor_streams_from_aux(aux_source_names) -> set[str]:
+    """The monitor-stream set a reduction factory feeds its workflow:
+    the job's resolved 'monitor' aux binding, or empty when the start
+    command omitted it (normalization then divides by 1)."""
+    if aux_source_names and "monitor" in aux_source_names:
+        return {aux_source_names["monitor"]}
+    return set()

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from ....workflows.multibank import MultiBankViewWorkflow
 from ....workflows.qe_spectroscopy import QESpectroscopyWorkflow
+from .._common import monitor_streams_from_aux
 from .specs import (
     BANK_DETECTOR_NUMBERS,
     MULTIBANK_HANDLE,
@@ -24,14 +25,9 @@ def make_qe_map(
     *, source_name: str, params, aux_source_names=None
 ) -> QESpectroscopyWorkflow:
     geometry = analyzer_geometry()
-    monitors = (
-        {aux_source_names["monitor"]}
-        if aux_source_names and "monitor" in aux_source_names
-        else set()
-    )
     return QESpectroscopyWorkflow(
         **geometry,
         params=params,
         primary_stream=source_name,
-        monitor_streams=monitors,
+        monitor_streams=monitor_streams_from_aux(aux_source_names),
     )

@@ -14,6 +14,7 @@ from ....workflows.monitor_workflow import MonitorWorkflow
 from ....workflows.powder import PowderDiffractionWorkflow
 from ....workflows.timeseries import TimeseriesWorkflow
 from ....workflows.wavelength_lut_workflow import WavelengthLutWorkflow
+from .._common import monitor_streams_from_aux
 from .specs import (
     BANK_SIZES,
     POWDER_HANDLE,
@@ -83,14 +84,9 @@ def make_powder(
     *, source_name: str, params, aux_source_names=None
 ) -> PowderDiffractionWorkflow:
     geometry = powder_geometry(source_name)
-    monitors = (
-        {aux_source_names["monitor"]}
-        if aux_source_names and "monitor" in aux_source_names
-        else set()
-    )
     return PowderDiffractionWorkflow(
         **geometry,
         params=params,
         primary_stream=source_name,
-        monitor_streams=monitors,
+        monitor_streams=monitor_streams_from_aux(aux_source_names),
     )

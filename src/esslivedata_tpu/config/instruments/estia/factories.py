@@ -10,13 +10,17 @@ from ....workflows.detector_view.projectors import (
 )
 from ....workflows.detector_view.workflow import DetectorViewWorkflow
 from ....workflows.monitor_workflow import MonitorWorkflow
+from ....workflows.reflectometry import ReflectometryWorkflow
 from ....workflows.timeseries import TimeseriesWorkflow
+from .._common import monitor_streams_from_aux
 from .specs import (
     INSTRUMENT,
     MONITOR_HANDLE,
+    REFLECTOMETRY_HANDLE,
     TIMESERIES_HANDLE,
     VIEW_HANDLES,
     VIEWS,
+    reflectometry_geometry,
 )
 
 
@@ -42,3 +46,15 @@ def make_monitor(*, source_name: str, params) -> MonitorWorkflow:  # noqa: ARG00
 @TIMESERIES_HANDLE.attach_factory
 def make_timeseries(*, source_name: str, params) -> TimeseriesWorkflow:  # noqa: ARG001
     return TimeseriesWorkflow()
+
+
+@REFLECTOMETRY_HANDLE.attach_factory
+def make_reflectometry(
+    *, source_name: str, params, aux_source_names=None
+) -> ReflectometryWorkflow:
+    return ReflectometryWorkflow(
+        **reflectometry_geometry(),
+        params=params,
+        primary_stream=source_name,
+        monitor_streams=monitor_streams_from_aux(aux_source_names),
+    )

@@ -16,9 +16,10 @@ from ....config.instrument import (
     MonitorConfig,
     instrument_registry,
 )
-from ....config.workflow_spec import WorkflowSpec
+from ....config.workflow_spec import OutputSpec, WorkflowSpec
 from ....workflows.detector_view.projectors import NdLogicalView
 from ....workflows.detector_view.workflow import DetectorViewParams
+from ....workflows.reflectometry import ReflectometryParams
 from ....workflows.workflow_factory import workflow_registry
 from .._common import (
     register_parsed_catalog,
@@ -78,3 +79,53 @@ VIEW_HANDLES = {
 
 MONITOR_HANDLE = register_monitor_spec(INSTRUMENT)
 TIMESERIES_HANDLE = register_timeseries_spec(INSTRUMENT)
+
+
+def reflectometry_geometry() -> dict[str, np.ndarray]:
+    """Synthetic per-pixel reflectometry geometry (placeholder pending
+    the facility geometry file): each multiblade wire sits a small angle
+    above the horizon (the Selene guide's ~1.5 deg span across the 32
+    wires, identical for every blade and strip), with the secondary
+    flight path ~4 m growing slightly with wire depth."""
+    shape = tuple(BLADE_SIZES.values())
+    n = int(np.prod(shape))
+    wire_axis = list(BLADE_SIZES).index("wire")
+    wire_idx = np.unravel_index(np.arange(n), shape)[wire_axis]
+    wire_frac = wire_idx / (BLADE_SIZES["wire"] - 1)
+    pixel_offset_rad = np.deg2rad(0.1 + 1.5 * wire_frac)
+    l2 = 4.0 + 0.05 * wire_idx / BLADE_SIZES["wire"]
+    ids = INSTRUMENT.detectors["multiblade_detector"].detector_number.reshape(-1)
+    return {
+        "pixel_offset_rad": pixel_offset_rad,
+        "l2": l2,
+        "pixel_ids": ids.astype(np.int64),
+    }
+
+
+REFLECTOMETRY_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="estia",
+        namespace="reflectometry",
+        name="r_qz",
+        title="R(Qz) specular reflectivity",
+        source_names=["multiblade_detector"],
+        service="data_reduction",
+        aux_source_names={"monitor": ["cbm1"]},
+        # Gate on the live sample rotation: R(Qz) is undefined until the
+        # angle is known, and the Qz table rebuilds when it moves.
+        context_keys=["sample_angle"],
+        params_model=ReflectometryParams,
+        outputs={
+            "r_qz_current": OutputSpec(title="R(Qz) — window"),
+            "r_qz_cumulative": OutputSpec(
+                title="R(Qz) — since start", view="since_start"
+            ),
+            "r_qz_normalized": OutputSpec(
+                title="R(Qz) / monitor", view="since_start"
+            ),
+            "counts_current": OutputSpec(title="Events binned"),
+            "monitor_counts_current": OutputSpec(title="Monitor counts"),
+            "sample_angle_deg": OutputSpec(title="Sample angle in use"),
+        },
+    )
+)

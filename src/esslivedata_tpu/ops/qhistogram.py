@@ -26,6 +26,7 @@ __all__ = [
     "PixelBinMap",
     "build_dspacing_map",
     "build_qe_map",
+    "build_qz_map",
     "build_sans_qmap",
 ]
 
@@ -152,6 +153,48 @@ def build_dspacing_map(
     return _assemble_map(pixel_ids, d_bin, len(d_edges) - 1)
 
 
+def build_qz_map(
+    *,
+    grazing_angle: np.ndarray,  # [n_pixel] incidence+reflection angle (rad)
+    l_total: np.ndarray,  # [n_pixel] moderator->sample->pixel path (m)
+    pixel_ids: np.ndarray,
+    toa_edges: np.ndarray,  # ns since pulse
+    qz_edges: np.ndarray,  # 1/angstrom
+    toa_offset_ns: float = 0.0,
+) -> PixelBinMap:
+    """Precompile specular-reflectometry physics into
+    ``map[pixel, toa_bin] -> Qz bin``.
+
+    ``Q_z = 4 pi sin(theta) / lambda`` with ``theta`` the grazing angle
+    the pixel observes for the CURRENT sample rotation — unlike the
+    other maps this one depends on a motor position, so the workflow
+    rebuilds it when the sample angle moves (the stream is untouched;
+    a rebuild swaps tables between batches). Non-reflecting pixels
+    (theta <= 0) and out-of-range Qz map to -1.
+    """
+    grazing_angle = np.asarray(grazing_angle, dtype=np.float64)
+    l_total = np.asarray(l_total, dtype=np.float64)
+    toa_centers_s = _toa_centers_s(toa_edges, toa_offset_ns)
+    k_factor = 4.0 * np.pi * np.sin(grazing_angle)  # [n_pixel]
+    n_pixel = l_total.size
+    qz_bin = np.empty((n_pixel, toa_centers_s.size), dtype=np.int32)
+    for lo in range(0, n_pixel, _MAP_CHUNK):
+        sl = slice(lo, min(lo + _MAP_CHUNK, n_pixel))
+        with np.errstate(divide="ignore", invalid="ignore"):
+            lam = H_OVER_MN * toa_centers_s[None, :] / l_total[sl, None]
+            qz = k_factor[sl, None] / lam
+        qb = np.searchsorted(qz_edges, qz, side="right") - 1
+        ok = (
+            np.isfinite(qz)
+            & (grazing_angle[sl, None] > 0)
+            & (qb >= 0)
+            & (qz < qz_edges[-1])
+        )
+        qb[~ok] = -1
+        qz_bin[sl] = qb
+    return _assemble_map(pixel_ids, qz_bin, len(qz_edges) - 1)
+
+
 def build_qe_map(
     *,
     two_theta: np.ndarray,  # [n_pixel] scattering angle (rad)
@@ -246,6 +289,7 @@ class QHistogrammer:
             raise ValueError("qmap entries must be < n_q")
         self._qmap = jnp.asarray(table)
         self._id_base = int(id_base)
+        self._table_shape = table.shape
         self._n_q = int(n_q)
         self._lo = float(toa_edges[0])
         self._hi = float(toa_edges[-1])
@@ -269,8 +313,8 @@ class QHistogrammer:
             monitor_window=jnp.array(scalar),
         )
 
-    def _step_impl(self, state: QState, pixel_id, toa, monitor_count):
-        n_pix, n_toa = self._qmap.shape
+    def _step_impl(self, state: QState, qmap, pixel_id, toa, monitor_count):
+        n_pix, n_toa = qmap.shape
         tb = jnp.floor((toa - self._lo) * self._inv_width).astype(jnp.int32)
         t_ok = (toa >= self._lo) & (toa < self._hi)
         tb = jnp.clip(tb, 0, n_toa - 1)
@@ -278,7 +322,7 @@ class QHistogrammer:
         local = pixel_id - self._id_base
         p_ok = (local >= 0) & (local < n_pix)
         pid = jnp.clip(local, 0, n_pix - 1)
-        qb = self._qmap[pid, tb].astype(jnp.int32)
+        qb = qmap[pid, tb].astype(jnp.int32)
         ok = p_ok & t_ok & (qb >= 0)
         qb = jnp.where(ok, qb, self._n_q)  # OOB-high: dropped
         delta = jnp.zeros((self._n_q,), dtype=self._dtype)
@@ -304,7 +348,30 @@ class QHistogrammer:
     def step(
         self, state: QState, batch: EventBatch, monitor_count: float = 0.0
     ) -> QState:
-        return self._step(state, batch.pixel_id, batch.toa, monitor_count)
+        return self._step(
+            state, self._qmap, batch.pixel_id, batch.toa, monitor_count
+        )
+
+    def swap_table(self, qmap: "np.ndarray | PixelBinMap") -> None:
+        """Replace the bin table WITHOUT recompiling the step.
+
+        The table rides the jitted step as an argument, so a same-shape
+        swap (a live-geometry rebuild: sample-angle move, calibration
+        update) is one device transfer between batches. ``id_base`` is
+        compiled in (it is static per bank) and must not change.
+        """
+        if isinstance(qmap, PixelBinMap):
+            table, id_base = qmap.table, qmap.id_base
+        else:
+            table, id_base = np.asarray(qmap), 0
+        if int(id_base) != self._id_base:
+            raise ValueError(
+                f"swap_table id_base {id_base} != compiled {self._id_base}"
+            )
+        if table.max(initial=-1) >= self._n_q:
+            raise ValueError("qmap entries must be < n_q")
+        self._qmap = jnp.asarray(table)
+        self._table_shape = table.shape
 
     def fold_window(self, state: QState) -> QState:
         """Traceable window fold, for composition into fused publish

@@ -1,0 +1,164 @@
+"""Reflectometry R(Qz): map physics, sample-angle gating and rebuilds."""
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.ops.event_batch import EventBatch
+from esslivedata_tpu.ops.qhistogram import H_OVER_MN, build_qz_map
+from esslivedata_tpu.preprocessors.event_data import StagedEvents
+from esslivedata_tpu.workflows.reflectometry import (
+    ReflectometryParams,
+    ReflectometryWorkflow,
+)
+
+
+def staged(pid, toa):
+    return StagedEvents(
+        batch=EventBatch.from_arrays(
+            np.asarray(pid, np.int32), np.asarray(toa, np.float32)
+        ),
+        first_timestamp=None,
+        last_timestamp=None,
+        n_chunks=1,
+    )
+
+
+class TestQzMapPhysics:
+    def test_known_angle_and_wavelength_land_in_expected_bin(self):
+        # theta = 1 deg, lambda = 5 A -> Qz = 4 pi sin(1 deg) / 5.
+        L = 39.0
+        lam = 5.0
+        t_ns = lam * L / H_OVER_MN * 1e9
+        toa_edges = np.linspace(0.0, 7.1e7, 7101)
+        qz_edges = np.linspace(0.005, 0.3, 591)  # 5e-4 bins
+        qz_map = build_qz_map(
+            grazing_angle=np.array([np.deg2rad(1.0)]),
+            l_total=np.array([L]),
+            pixel_ids=np.array([0]),
+            toa_edges=toa_edges,
+            qz_edges=qz_edges,
+        )
+        tb = np.searchsorted(toa_edges, t_ns) - 1
+        qb = qz_map.table[0, tb]
+        assert qb >= 0
+        qz_expected = 4.0 * np.pi * np.sin(np.deg2rad(1.0)) / lam
+        assert qz_edges[qb] <= qz_expected <= qz_edges[qb + 1]
+
+    def test_negative_grazing_angle_dropped(self):
+        qz_map = build_qz_map(
+            grazing_angle=np.array([-0.01]),
+            l_total=np.array([39.0]),
+            pixel_ids=np.array([0]),
+            toa_edges=np.linspace(0.0, 7.1e7, 101),
+            qz_edges=np.linspace(0.005, 0.3, 51),
+        )
+        assert (qz_map.table[0] == -1).all()
+
+
+class TestAngleGatingAndRebuild:
+    def _workflow(self, **kw):
+        n_pix = 8
+        return ReflectometryWorkflow(
+            pixel_offset_rad=np.full(n_pix, np.deg2rad(0.5)),
+            l2=np.full(n_pix, 4.0),
+            pixel_ids=np.arange(n_pix),
+            params=ReflectometryParams(qz_bins=100),
+            **kw,
+        )
+
+    def test_no_accumulation_until_angle_known(self):
+        wf = self._workflow()
+        wf.accumulate(
+            {"det": staged(np.zeros(100, np.int32), np.full(100, 3e7))}
+        )
+        assert wf.finalize() == {}
+        wf.set_context({"sample_angle": 0.7})
+        wf.accumulate(
+            {"det": staged(np.zeros(100, np.int32), np.full(100, 3e7))}
+        )
+        out = wf.finalize()
+        assert float(np.asarray(out["r_qz_cumulative"].values).sum()) == 100.0
+        assert float(np.asarray(out["sample_angle_deg"].values)) == 0.7
+
+    def test_angle_move_shifts_qz_of_identical_arrivals(self):
+        wf = self._workflow()
+        toa = np.full(200, 3e7, dtype=np.float32)
+
+        def peak_bin():
+            out = wf.finalize()
+            values = np.asarray(out["r_qz_current"].values)
+            return int(values.argmax()) if values.sum() else None
+
+        wf.set_context({"sample_angle": 0.5})
+        wf.accumulate({"det": staged(np.zeros(200, np.int32), toa)})
+        bin_low = peak_bin()
+        # The sample rotates: same arrival time now means larger Qz.
+        wf.set_context({"sample_angle": 1.5})
+        wf.accumulate({"det": staged(np.zeros(200, np.int32), toa)})
+        bin_high = peak_bin()
+        assert bin_low is not None and bin_high is not None
+        assert bin_high > bin_low
+        # Counts from both angles accumulated (bin space is unchanged).
+        out = wf.finalize()
+        assert (
+            float(np.asarray(out["r_qz_cumulative"].values).sum()) == 400.0
+        )
+
+    def test_noise_moves_do_not_rebuild(self):
+        wf = self._workflow()
+        wf.set_context({"sample_angle": 0.5})
+        wf.accumulate(
+            {"det": staged(np.zeros(10, np.int32), np.full(10, 3e7))}
+        )
+        hist_before = wf._hist
+        table_before = wf._hist._qmap
+        wf.set_context({"sample_angle": 0.5001})  # below tolerance
+        wf.accumulate(
+            {"det": staged(np.zeros(10, np.int32), np.full(10, 3e7))}
+        )
+        assert wf._hist is hist_before
+        assert wf._hist._qmap is table_before  # no rebuild, no swap
+
+    def test_tolerance_move_swaps_without_new_kernel(self):
+        wf = self._workflow()
+        wf.set_context({"sample_angle": 0.5})
+        wf.accumulate(
+            {"det": staged(np.zeros(10, np.int32), np.full(10, 3e7))}
+        )
+        hist_before = wf._hist
+        table_before = wf._hist._qmap
+        wf.set_context({"sample_angle": 1.2})
+        wf.accumulate(
+            {"det": staged(np.zeros(10, np.int32), np.full(10, 3e7))}
+        )
+        # Same kernel instance (no recompile), different table.
+        assert wf._hist is hist_before
+        assert wf._hist._qmap is not table_before
+
+
+class TestRegistryWiring:
+    def test_estia_reflectometry_through_registry(self):
+        from esslivedata_tpu.config import JobId, WorkflowConfig
+        from esslivedata_tpu.config.instrument import instrument_registry
+        from esslivedata_tpu.workflows.workflow_factory import (
+            workflow_registry,
+        )
+
+        instrument_registry["estia"].load_factories()
+        from esslivedata_tpu.config.instruments.estia.specs import (
+            REFLECTOMETRY_HANDLE,
+        )
+
+        config = WorkflowConfig(
+            identifier=REFLECTOMETRY_HANDLE.workflow_id,
+            job_id=JobId(source_name="multiblade_detector"),
+            params={"qz_bins": 50},
+            aux_source_names={"monitor": "cbm1"},
+        )
+        wf = workflow_registry.create(config)
+        assert isinstance(wf, ReflectometryWorkflow)
+        # Gated: no outputs until the sample angle arrives.
+        assert wf.finalize() == {}
+        wf.set_context({"sample_angle": 1.0})
+        out = wf.finalize()
+        assert np.asarray(out["r_qz_cumulative"].values).shape == (50,)
