@@ -25,6 +25,7 @@ import numpy as np
 from ..utils.labeled import DataArray
 
 __all__ = [
+    "BarsPlotter",
     "FlattenPlotter",
     "PlotterRegistry",
     "SlicerPlotter",
@@ -384,6 +385,27 @@ class Overlay1DPlotter:
         ax.set_ylabel(f"[{da.unit!r}]")
 
 
+class BarsPlotter:
+    """1-D data over a categorical axis (bank/roi/channel): bars, one per
+    category (reference BarsPlotter:1263) — a step line over category
+    indices reads as a spectrum, which these are not."""
+
+    def plot(self, ax, da: DataArray, params: PlotParams = PlotParams()) -> None:
+        dim = da.dims[0]
+        y = np.asarray(da.values, dtype=np.float64)
+        x = np.arange(y.size)
+        ax.bar(x, y)
+        ax.set_xticks(x)
+        if dim in da.coords:
+            labels = np.asarray(da.coords[dim].numpy).reshape(-1)
+            ax.set_xticklabels(
+                [str(v) for v in labels[: y.size]], fontsize=7
+            )
+        params._apply_y(ax)
+        ax.set_xlabel(dim)
+        ax.set_ylabel(f"[{da.unit!r}]")
+
+
 class ScalarPlotter:
     """0-d data: big number."""
 
@@ -554,6 +576,10 @@ class PlotterRegistry:
         if ndim == 0:
             return ScalarPlotter()
         if ndim == 1:
+            # Categorical axes (per-bank counts, per-roi totals) read as
+            # bars, not as a spectrum line.
+            if da.dims[0] in self.CATEGORICAL_DIMS and da.shape[0] <= 32:
+                return BarsPlotter()
             return LinePlotter()
         if ndim == 2:
             if da.dims[0] in self.CATEGORICAL_DIMS or (

@@ -31,6 +31,9 @@ class PowderDiffractionParams(BaseModel):
     d_max: float = 2.8
     toa_bins: int = 500
     toa_range: TOARange = Field(default_factory=TOARange)
+    #: Emission-time correction (e.g. WFM subframe T0 from the chopper
+    #: cascade); a live recalibration rebuilds + swaps the table.
+    toa_offset_ns: float = 0.0
 
 
 class PowderDiffractionWorkflow(QStreamingMixin):
@@ -58,6 +61,7 @@ class PowderDiffractionWorkflow(QStreamingMixin):
             pixel_ids=pixel_ids,
             toa_edges=toa_edges,
             d_edges=d_edges,
+            toa_offset_ns=params.toa_offset_ns,
         )
         self._hist = QHistogrammer(
             qmap=dmap, toa_edges=toa_edges, n_q=params.d_bins

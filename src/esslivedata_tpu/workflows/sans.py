@@ -28,6 +28,7 @@ class SansIQParams(BaseModel):
     q_max: float = 0.5
     toa_bins: int = 200  # resolution of the TOF->lambda mapping
     toa_range: TOARange = Field(default_factory=TOARange)
+    toa_offset_ns: float = 0.0  # emission-time correction
     l1: float = 23.0  # m, source->sample
 
 
@@ -55,6 +56,7 @@ class SansIQWorkflow(QStreamingMixin):
             toa_edges=toa_edges,
             q_edges=q_edges,
             l1=params.l1,
+            toa_offset_ns=params.toa_offset_ns,
         )
         self._hist = QHistogrammer(
             qmap=qmap, toa_edges=toa_edges, n_q=params.q_bins

@@ -409,3 +409,27 @@ class FlattenHttpTest(PlotConfigHttpTest):
         kid = self._kid(state, "image_current")
         r = self.fetch(f"/plot/{kid}.png?plotter=flatten&robust=1")
         assert r.code == 200 and r.body[:4] == b"\x89PNG"
+
+    def test_bars_plotter_for_categorical_axis(self):
+        from esslivedata_tpu.dashboard.plots import (
+            BarsPlotter,
+            plotter_registry,
+            render_png,
+        )
+        from esslivedata_tpu.utils import DataArray, Variable
+
+        da = DataArray(
+            Variable(np.arange(9, dtype=float), ("bank",), "counts"),
+            coords={"bank": Variable(np.arange(9), ("bank",), "")},
+            name="bank_counts",
+        )
+        assert isinstance(plotter_registry.select(da), BarsPlotter)
+        assert render_png(da)[:4] == b"\x89PNG"
+        # A long 1-D spectrum stays a line even if someone names its dim
+        # 'channel'.
+        long = DataArray(
+            Variable(np.ones(200), ("channel",), "counts"), name="s"
+        )
+        from esslivedata_tpu.dashboard.plots import LinePlotter
+
+        assert isinstance(plotter_registry.select(long), LinePlotter)
