@@ -100,20 +100,28 @@ class EventProjection:
             if pixel_lut.max(initial=-1) >= n_screen:
                 raise ValueError("pixel_lut entries must be < n_screen")
             self.lut_host = pixel_lut
-            self.lut = jnp.asarray(pixel_lut)
+            self._lut_dev = None  # device copy materializes on first use
         else:
             self.lut_host = None
-            self.lut = None
+            self._lut_dev = None
         self.weights = (
             jnp.asarray(np.asarray(pixel_weights, dtype=np.float32))
             if pixel_weights is not None
             else None
         )
 
+    @property
+    def lut(self):
+        """Device LUT, materialized lazily: host-flatten configurations
+        never read it, so swaps/construction stay host-only there."""
+        if self._lut_dev is None and self.lut_host is not None:
+            self._lut_dev = jnp.asarray(self.lut_host)
+        return self._lut_dev
+
     def place_constants(self, device_put) -> None:
         """Re-place the LUT/weights (e.g. replicated over a mesh)."""
         if self.lut is not None:
-            self.lut = device_put(self.lut)
+            self._lut_dev = device_put(self.lut)
         if self.weights is not None:
             self.weights = device_put(self.weights)
 
@@ -379,6 +387,35 @@ class EventHistogrammer:
         if state.scale is None:
             return state.window
         return state.window * state.scale
+
+    def swap_projection(self, pixel_lut) -> bool:
+        """Replace the pixel LUT without touching the compiled hot path.
+
+        Returns True when the new LUT is drop-in compatible (same shape
+        after replica normalization): the host-flatten fast path
+        (``step_flat``) reads the LUT on the host per batch, so the swap
+        costs nothing on device; the device-projection jit is recreated
+        so a later ``step`` retraces with the new table instead of using
+        the stale capture. Returns False — caller does a full rebuild —
+        for shape changes or LUT-less configurations. This is the single
+        validity gate for live-geometry swaps.
+        """
+        old = self._proj
+        new_lut = np.atleast_2d(np.asarray(pixel_lut))
+        old_lut = old.lut_host
+        if old_lut is None or new_lut.shape != old_lut.shape:
+            return False
+        self._proj = EventProjection(
+            toa_edges=old.edges,
+            pixel_lut=new_lut,
+            pixel_weights=old.weights,
+            n_screen=old.n_screen,
+        )
+        # Device-path jits captured the old projection at trace time;
+        # fresh wrappers retrace (only) if that path is ever used. The
+        # new device LUT materializes lazily at that same point.
+        self._step = jax.jit(self._step_impl, donate_argnums=(0,))
+        return True
 
     def fold_window(self, state: HistogramState) -> HistogramState:
         """Traceable window fold: the cumulative absorbs the window, which

@@ -123,6 +123,36 @@ class DetectorViewWorkflow:
         self._toa_edges_var = Variable(edges, ("toa",), "ns")
         assert n_toa == edges.size - 1
 
+    def swap_projection(self, projection: ProjectionTable) -> bool:
+        """Adopt a rebuilt projection WITHOUT recompiling anything.
+
+        Live-geometry moves (motor-driven LUT rebuilds) land here first:
+        when the new table has the same screen shape and this
+        configuration runs the host-flatten fast path, the swap is a
+        host-side LUT replacement — the jitted step, fold and publish
+        programs are untouched. State resets (moved-geometry counts must
+        not blend) and installed ROI masks recompute against the new
+        screen edges. Returns False when only a full rebuild is correct
+        (shape change, per-pixel weighting, device-projection configs).
+        """
+        if (
+            projection.n_screen != self._proj.n_screen
+            or projection.ny != self._proj.ny
+            or projection.nx != self._proj.nx
+            or self._params.pixel_weighting
+            or not self._hist.supports_host_flatten
+        ):
+            return False
+        if not self._hist.swap_projection(projection.lut):
+            return False  # LUT shape mismatch: full rebuild
+        self._proj = projection
+        self._state = self._hist.clear(self._state)
+        if self._rois_by_index:
+            self.set_rois(
+                {name: roi for name, roi in self._rois_by_index.values()}
+            )
+        return True
+
     # -- ROI management ----------------------------------------------------
     def set_rois(self, rois: Mapping[str, ROI]) -> None:
         """Install ROI masks (from the dashboard's ROI topic round trip,

@@ -190,11 +190,19 @@ class DynamicGeometryWorkflow:
             if (value := _latest_value(context.get(stream))) is not None:
                 self._values[stream] = value
         if self._geometry.moved(self._values):
-            self._inner = self._make(
-                self._geometry.build_projection(self._values)
-            )
-            if self._rois is not None and hasattr(self._inner, "set_rois"):
-                self._inner.set_rois(self._rois)
+            projection = self._geometry.build_projection(self._values)
+            # Same-shape rebuilds swap the LUT into the running kernel
+            # (no recompile — see DetectorViewWorkflow.swap_projection);
+            # anything else falls back to a full rebuild.
+            if not (
+                hasattr(self._inner, "swap_projection")
+                and self._inner.swap_projection(projection)
+            ):
+                self._inner = self._make(projection)
+                # The swap branch re-installs its own ROI masks; only a
+                # fresh inner needs them applied here.
+                if self._rois is not None and hasattr(self._inner, "set_rois"):
+                    self._inner.set_rois(self._rois)
         if hasattr(self._inner, "set_context"):
             self._inner.set_context(context)
 

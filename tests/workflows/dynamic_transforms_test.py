@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.utils import Variable
 from esslivedata_tpu.preprocessors.event_data import DetectorEvents, ToEventBatch
 from esslivedata_tpu.workflows.detector_view.workflow import (
     DetectorViewParams,
@@ -176,3 +177,76 @@ class TestDynamicGeometryWorkflow:
         wf.accumulate({"det": stage([1], [10.0])})
         out = wf.finalize()
         assert float(out["roi_spectra"].values.sum()) == 1.0
+
+
+class TestProjectionSwap:
+    """Same-shape geometry moves swap the LUT into the running kernel."""
+
+    def _view(self, shift=0):
+        from esslivedata_tpu.workflows.detector_view.projectors import (
+            ProjectionTable,
+        )
+        from esslivedata_tpu.workflows.detector_view.workflow import (
+            DetectorViewWorkflow,
+        )
+
+        n_pix = 16
+        # Identity-ish LUT; `shift` rolls pixels across screen bins (the
+        # effect of a motor move on a geometric projection).
+        lut = ((np.arange(n_pix) + shift) % n_pix).astype(np.int32)[None, :]
+        proj = ProjectionTable(
+            lut=lut,
+            ny=4,
+            nx=4,
+            x_edges=Variable(np.arange(5, dtype=float), ("x",), ""),
+            y_edges=Variable(np.arange(5, dtype=float), ("y",), ""),
+        )
+        return DetectorViewWorkflow(projection=proj), proj
+
+    def test_swap_keeps_kernel_and_rebins_correctly(self):
+        from esslivedata_tpu.preprocessors.event_data import StagedEvents
+        from esslivedata_tpu.ops.event_batch import EventBatch
+
+        wf, _ = self._view()
+        staged = StagedEvents(
+            batch=EventBatch.from_arrays(
+                np.zeros(50, np.int32), np.full(50, 3e7, np.float32)
+            ),
+            first_timestamp=None,
+            last_timestamp=None,
+            n_chunks=1,
+        )
+        wf.accumulate({"det": staged})
+        out = wf.finalize()
+        img = np.asarray(out["image_cumulative"].values)
+        assert img.reshape(-1)[0] == 50.0  # pixel 0 -> screen bin 0
+
+        hist_before = wf._hist
+        publish_before = wf._publish
+        _, shifted = self._view(shift=1)
+        assert wf.swap_projection(shifted)
+        # Kernel and fused publish program untouched; state reset.
+        assert wf._hist is hist_before
+        assert wf._publish is publish_before
+        wf.accumulate({"det": staged})
+        out = wf.finalize()
+        img = np.asarray(out["image_cumulative"].values)
+        assert img.reshape(-1)[0] == 0.0
+        assert img.reshape(-1)[1] == 50.0  # pixel 0 now -> screen bin 1
+        # Cumulative does NOT include pre-move counts (reset by design).
+        assert img.sum() == 50.0
+
+    def test_shape_change_refuses_swap(self):
+        from esslivedata_tpu.workflows.detector_view.projectors import (
+            ProjectionTable,
+        )
+
+        wf, _ = self._view()
+        bigger = ProjectionTable(
+            lut=np.zeros((1, 32), np.int32),
+            ny=4,
+            nx=4,
+            x_edges=Variable(np.arange(5, dtype=float), ("x",), ""),
+            y_edges=Variable(np.arange(5, dtype=float), ("y",), ""),
+        )
+        assert not wf.swap_projection(bigger)
