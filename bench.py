@@ -137,14 +137,65 @@ def bench_secondary_configs(args, edges, batches, method: str) -> None:
             devices=n_dev,
         )
     else:
-        timed(
-            "config3_multibank_single_chip",
-            EventHistogrammer(
-                toa_edges=edges,
-                n_screen=n_banks,
-                pixel_lut=bank_lut,
-                method=method,
+        # Single chip: the REAL Q-E rebinning over BIFROST's 9-triplet
+        # analyzer geometry (BASELINE wording: "multi-analyzer Q-E
+        # rebinning across 9 detector banks") — per-event physics rides
+        # the precompiled (pixel, toa-bin) -> (Q, E) table, so the
+        # streaming cost is the same gather+scatter as the histogram.
+        from esslivedata_tpu.config.instrument import instrument_registry
+
+        instrument_registry["bifrost"].load_factories()
+        from esslivedata_tpu.config.instruments.bifrost.specs import (
+            analyzer_geometry,
+        )
+        from esslivedata_tpu.ops import EventBatch as _EB
+        from esslivedata_tpu.ops.qhistogram import (
+            QHistogrammer,
+            build_qe_map,
+        )
+
+        geometry = analyzer_geometry()
+        qe_toa = np.linspace(8.0e7, 4.0e8, 321)
+        qe_map = build_qe_map(
+            two_theta=geometry["two_theta"],
+            ef_mev=geometry["ef_mev"],
+            l2=geometry["l2"],
+            pixel_ids=geometry["pixel_ids"],
+            toa_edges=qe_toa,
+            q_edges=np.linspace(0.2, 2.6, 81),
+            e_edges=np.linspace(-3.0, 6.0, 61),
+        )
+        qe_hist = QHistogrammer(qmap=qe_map, toa_edges=qe_toa, n_q=80 * 60)
+        rng = np.random.default_rng(7)
+        id_lo = int(geometry["pixel_ids"].min())
+        id_hi = int(geometry["pixel_ids"].max()) + 1
+        qe_batches = [
+            _EB.from_arrays(
+                rng.integers(id_lo, id_hi, args.events).astype(np.int32),
+                rng.uniform(8.0e7, 4.0e8, args.events).astype(np.float32),
+            )
+            for _ in range(4)
+        ]
+        qe_state = qe_hist.init_state()
+        qe_state = qe_hist.step(qe_state, qe_batches[0], 100.0)
+        qe_state.window.block_until_ready()
+        start = time.perf_counter()
+        for i in range(args.batches):
+            qe_state = qe_hist.step(
+                qe_state, qe_batches[i % len(qe_batches)], 100.0
+            )
+        qe_state.window.block_until_ready()
+        dt = time.perf_counter() - start
+        print(
+            json.dumps(
+                {
+                    "metric": "config3_bifrost_qe_rebinning",
+                    "value": args.events * args.batches / dt,
+                    "unit": "events/s",
+                    "banks": 9,
+                }
             ),
+            file=sys.stderr,
         )
 
     # Config 4: monitor-normalized output computed per step (on device —
