@@ -25,20 +25,27 @@ import numpy as np
 REPO = Path(__file__).resolve().parent.parent.parent
 SRC = REPO / "src"
 
-#: Raw + control + livedata topics for the dummy instrument.
-DUMMY_TOPICS = [
-    "dummy_detector",
-    "dummy_monitor",
-    "dummy_motion",
-    "dummy_camera",
-    "dummy_runInfo",
-    "dummy_livedata_data",
-    "dummy_livedata_status",
-    "dummy_livedata_commands",
-    "dummy_livedata_responses",
-    "dummy_livedata_roi",
-    "dummy_livedata_nicos",
-]
+def instrument_topics(instrument: str) -> list[str]:
+    """Raw + control + livedata topics for one instrument's file broker."""
+    return [
+        f"{instrument}_detector",
+        f"{instrument}_monitor",
+        f"{instrument}_motion",
+        f"{instrument}_camera",
+        f"{instrument}_choppers",
+        f"{instrument}_sample_env",
+        f"{instrument}_runInfo",
+        f"{instrument}_livedata_data",
+        f"{instrument}_livedata_status",
+        f"{instrument}_livedata_commands",
+        f"{instrument}_livedata_responses",
+        f"{instrument}_livedata_roi",
+        f"{instrument}_livedata_nicos",
+    ]
+
+
+#: Kept for the existing dummy-instrument scenarios.
+DUMMY_TOPICS = instrument_topics("dummy")
 
 
 def _child_env(**extra: str) -> dict[str, str]:
@@ -60,23 +67,25 @@ def _child_env(**extra: str) -> dict[str, str]:
 class IntegrationBackend:
     """One broker dir + managed child processes + client-side helpers."""
 
-    def __init__(self, broker_dir: Path) -> None:
+    def __init__(self, broker_dir: Path, instrument: str = "dummy") -> None:
         self.broker_dir = Path(broker_dir)
+        self.instrument = instrument
         from esslivedata_tpu.kafka.file_broker import (
             FileBrokerConsumer,
             FileBrokerProducer,
             ensure_topics,
         )
 
-        ensure_topics(self.broker_dir, DUMMY_TOPICS)
+        ensure_topics(self.broker_dir, instrument_topics(instrument))
         self.producer = FileBrokerProducer(self.broker_dir)
         self._consumer_cls = FileBrokerConsumer
         self._procs: list[subprocess.Popen] = []
 
     # -- process management ------------------------------------------------
     def spawn_service(
-        self, service: str = "detector_data", instrument: str = "dummy"
+        self, service: str = "detector_data", instrument: str | None = None
     ) -> subprocess.Popen:
+        instrument = instrument or self.instrument
         proc = subprocess.Popen(
             [
                 sys.executable,
@@ -105,7 +114,7 @@ class IntegrationBackend:
             "-m",
             "esslivedata_tpu.dashboard.reduction",
             "--instrument",
-            "dummy",
+            self.instrument,
             "--transport",
             "file",
             "--broker-dir",
@@ -205,7 +214,7 @@ class IntegrationBackend:
         """First x5f2 heartbeat on the status topic (service is up)."""
         from esslivedata_tpu.kafka import wire
 
-        consumer = self.consumer(["dummy_livedata_status"])
+        consumer = self.consumer([f"{self.instrument}_livedata_status"])
 
         def probe():
             for msg in consumer.consume(50, 0.0):
