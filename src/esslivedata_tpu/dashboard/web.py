@@ -760,7 +760,7 @@ async function refreshGrids() {{
       const head = el('h4', '', c.title || ('cell ' + i));
       const cfg = el('button', '', '⚙');
       cfg.title = 'Edit plot config';
-      cfg.onclick = () => editCell(g.grid_id, c.index, c.params);
+      cfg.onclick = () => editCell(g.grid_id, c.index, c.params, c.title);
       head.appendChild(cfg);
       cell.appendChild(head);
       if (c.keys.length) {{
@@ -810,7 +810,7 @@ const CELL_CONFIG_FIELDS = [
   {{key: 'robust', kind: 'checkbox', hint: 'percentile color range (clip hot pixels)'}},
   {{key: 'flatten_split', kind: 'number', hint: 'leading dims onto Y (flatten plotter)'}},
 ];
-function editCell(gridId, index, params) {{
+function editCell(gridId, index, params, currentTitle) {{
   const old = document.getElementById('cellcfg');
   if (old) old.remove();
   params = params || {{}};
@@ -819,6 +819,13 @@ function editCell(gridId, index, params) {{
     'position:fixed;top:80px;left:50%;transform:translateX(-50%);' +
     'z-index:10;min-width:300px;box-shadow:0 4px 24px rgba(0,0,0,.35)';
   box.appendChild(el('h3', '', 'Plot config'));
+  const titleRow = el('div');
+  titleRow.appendChild(el('label', '', 'title '));
+  const titleInput = document.createElement('input');
+  titleInput.type = 'text';
+  titleInput.value = currentTitle || '';
+  titleRow.appendChild(titleInput);
+  box.appendChild(titleRow);
   const inputs = {{}};
   for (const f of CELL_CONFIG_FIELDS) {{
     const row = el('div');
@@ -855,8 +862,10 @@ function editCell(gridId, index, params) {{
       if (f.kind === 'checkbox') {{ if (input.checked) out[key] = '1'; continue; }}
       if (input.value !== '') out[key] = input.value;
     }}
+    const body = {{params: out}};
+    if (titleInput.value !== (currentTitle || '')) body.title = titleInput.value;
     const r = await fetch(`/api/grid/${{gridId}}/cell/${{index}}/config`, {{
-      method: 'POST', body: JSON.stringify({{params: out}})}});
+      method: 'POST', body: JSON.stringify(body)}});
     if (!r.ok) {{ status.textContent = (await r.json()).error; return; }}
     box.remove(); gridGens = {{}}; refreshGrids();
   }};

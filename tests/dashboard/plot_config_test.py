@@ -433,3 +433,24 @@ class FlattenHttpTest(PlotConfigHttpTest):
         from esslivedata_tpu.dashboard.plots import LinePlotter
 
         assert isinstance(plotter_registry.select(long), LinePlotter)
+
+    def test_cell_title_edit_round_trips(self):
+        r = self.post_json("/api/grid", {"name": "t", "nrows": 1, "ncols": 1})
+        gid = json.loads(r.body)["grid_id"]
+        self.post_json(
+            f"/api/grid/{gid}/cell",
+            {
+                "geometry": {"row": 0, "col": 0},
+                "output": "image_current",
+                "title": "before",
+            },
+        )
+        r = self.post_json(
+            f"/api/grid/{gid}/cell/0/config",
+            {"params": {"scale": "log"}, "title": "after"},
+        )
+        assert r.code == 200
+        grids = json.loads(self.fetch("/api/grids").body)["grids"]
+        cell = next(g for g in grids if g["grid_id"] == gid)["cells"][0]
+        assert cell["title"] == "after"
+        assert cell["params"] == {"scale": "log"}
