@@ -146,12 +146,18 @@ class EventProjection:
         *,
         row0=0,
         n_rows: int | None = None,
+        lut=None,
     ) -> tuple[jax.Array, jax.Array | None]:
         """Flat local bin index per event (dump = n_rows*n_toa = dropped)
-        and the event weight (None = unit weights); replicas folded in."""
+        and the event weight (None = unit weights); replicas folded in.
+
+        ``lut`` optionally overrides the captured device LUT so callers
+        can thread it through jit as an ARGUMENT (ADR 0105: live
+        LUT swaps without recompiles)."""
         n_rows = self.n_screen if n_rows is None else n_rows
         n_local = n_rows * self.n_toa
         tb, t_ok = self.toa_bin(toa)
+        lut = lut if lut is not None else self.lut
 
         if self.weights is not None:
             n_pix = self.weights.shape[0]
@@ -162,11 +168,11 @@ class EventProjection:
         else:
             w = None
 
-        if self.lut is not None:
-            n_rep, n_pix = self.lut.shape
+        if lut is not None:
+            n_rep, n_pix = lut.shape
             p_ok = (pixel_id >= 0) & (pixel_id < n_pix)
             pid = jnp.clip(pixel_id, 0, n_pix - 1)
-            screen = self.lut[:, pid]  # [R, N]
+            screen = lut[:, pid]  # [R, N]
             local_row = screen - row0
             ok = (
                 p_ok[None, :]
@@ -397,8 +403,8 @@ class EventHistogrammer:
         costs nothing on device; the device-projection jit is recreated
         so a later ``step`` retraces with the new table instead of using
         the stale capture. Returns False — caller does a full rebuild —
-        for shape changes or LUT-less configurations. This is the single
-        validity gate for live-geometry swaps.
+        for shape changes or LUT-less configurations — each kernel owns
+        its own gate (the sharded twin mirrors this one).
         """
         old = self._proj
         new_lut = np.atleast_2d(np.asarray(pixel_lut))

@@ -87,9 +87,20 @@ class ShardedHistogrammer:
             pixel_weights=pixel_weights,
             n_screen=n_screen,
         )
-        # LUT/weights replicated on every device: gathers stay local.
-        self._proj.place_constants(
-            lambda x: jax.device_put(x, NamedSharding(mesh, P()))
+        # Weights replicated on every device: gathers stay local. The
+        # LUT rides the jitted step as an ARGUMENT (ADR 0105) so a
+        # live-geometry rebuild swaps tables without recompiling; it is
+        # replicated explicitly below.
+        self._has_lut = self._proj.lut_host is not None
+        self._replicate = lambda x: jax.device_put(
+            x, NamedSharding(mesh, P())
+        )
+        if self._proj.weights is not None:
+            self._proj.weights = self._replicate(self._proj.weights)
+        self._lut_rep = (
+            self._replicate(jnp.asarray(self._proj.lut_host))
+            if self._has_lut
+            else None
         )
         self._rows_per_bank = n_screen // self._n_bank
         self._n_screen = n_screen
@@ -109,11 +120,13 @@ class ShardedHistogrammer:
         self._event_sharding = NamedSharding(mesh, P("data"))
         self._scalar_sharding = NamedSharding(mesh, P())
 
+        lut_specs = (P(),) if self._has_lut else ()  # replicated LUT arg
         shard = partial(
             jax.shard_map,
             mesh=mesh,
             in_specs=(
                 P("bank", None),  # window
+                *lut_specs,
                 P("data"),  # pixel_id
                 P("data"),  # toa
                 P(),  # inv_scale (replicated lazy-decay magnitude)
@@ -126,19 +139,30 @@ class ShardedHistogrammer:
             # disables it — delta_psum keeps the safety net.
             check_vma=(self._exchange != "event_gather"),
         )
-        sharded_step = shard(self._step_local)
+        if self._has_lut:
+
+            def _local(win, lut, pid, toa, inv_scale):
+                return self._step_local(win, pid, toa, inv_scale, lut=lut)
+
+        else:
+
+            def _local(win, pid, toa, inv_scale):
+                return self._step_local(win, pid, toa, inv_scale)
+
+        sharded_step = shard(_local)
         self._step = jax.jit(sharded_step, donate_argnums=(0,))
 
         if decay is not None:
             from ..ops.histogram import EventHistogrammer as _EH
 
-            def _step_decay(win, pid, toa, scale):
+            def _step_decay(win, *args):
                 # Lazy decay fused into the one jitted program (the
                 # single-device kernel does the same inside _advance):
                 # scale shrinks, updates grow by 1/scale, renormalize on
                 # underflow — no per-batch eager dispatches.
+                *rest, scale = args
                 scale = scale * decay
-                win = sharded_step(win, pid, toa, 1.0 / scale)
+                win = sharded_step(win, *rest, 1.0 / scale)
                 return jax.lax.cond(
                     scale < _EH._SCALE_FLOOR,
                     lambda w, sc: (w * sc, jnp.ones_like(sc)),
@@ -176,7 +200,7 @@ class ShardedHistogrammer:
         )
 
     # -- local (per-shard) kernels ---------------------------------------
-    def _step_local(self, win, pixel_id, toa, inv_scale):
+    def _step_local(self, win, pixel_id, toa, inv_scale, lut=None):
         """One shard's step. ``inv_scale`` is the lazy-decay update
         magnitude (1.0 without decay): the dense ``win * decay`` multiply
         the naive formulation would pay per step is folded into the
@@ -195,7 +219,7 @@ class ShardedHistogrammer:
             )
             toa = jax.lax.all_gather(toa, "data", axis=0, tiled=True)
             flat, w = self._proj.flat_and_weights(
-                pixel_id, toa, row0=row0, n_rows=self._rows_per_bank
+                pixel_id, toa, row0=row0, n_rows=self._rows_per_bank, lut=lut
             )
             updates = (
                 inv_scale if w is None else w.astype(self._dtype) * inv_scale
@@ -209,7 +233,7 @@ class ShardedHistogrammer:
 
         # delta_psum: scatter into a fresh local delta, merge over 'data'.
         flat, w = self._proj.flat_and_weights(
-            pixel_id, toa, row0=row0, n_rows=self._rows_per_bank
+            pixel_id, toa, row0=row0, n_rows=self._rows_per_bank, lut=lut
         )
         updates = inv_scale if w is None else w.astype(self._dtype) * inv_scale
         delta = jnp.zeros((n_local + 1,), dtype=self._dtype)
@@ -269,13 +293,43 @@ class ShardedHistogrammer:
     def step(self, state: HistogramState, pixel_id, toa) -> HistogramState:
         """Accumulate one padded global batch (host or device arrays)."""
         pid, t = self._shard_events(pixel_id, toa)
+        lut_args = (self._lut_rep,) if self._has_lut else ()
         if self._decay is None:
             win = self._step(
-                state.window, pid, t, jnp.asarray(1.0, self._dtype)
+                state.window, *lut_args, pid, t,
+                jnp.asarray(1.0, self._dtype),
             )
             return HistogramState(folded=state.folded, window=win)
-        win, scale = self._step_decay(state.window, pid, t, state.scale)
+        win, scale = self._step_decay(
+            state.window, *lut_args, pid, t, state.scale
+        )
         return HistogramState(folded=state.folded, window=win, scale=scale)
+
+    def swap_projection(self, pixel_lut) -> bool:
+        """Replace the pixel LUT on the running mesh without recompiling
+        (ADR 0105): the table is a replicated jit argument, so a
+        same-shape swap is one broadcast placement. Returns False for
+        shape changes or LUT-less configurations (full rebuild); this is
+        the sharded kernel's validity gate, mirroring the single-device
+        ``EventHistogrammer.swap_projection``."""
+        new = np.atleast_2d(np.asarray(pixel_lut, np.int32))
+        if (
+            self._proj.lut_host is None
+            or new.shape != self._proj.lut_host.shape
+        ):
+            return False
+        old_weights = self._proj.weights  # already mesh-replicated
+        self._proj = EventProjection(
+            toa_edges=self._edges,
+            pixel_lut=new,
+            n_screen=self._n_screen,
+        )
+        # Carry the replicated device array over: round-tripping it
+        # through numpy would block on a d2h copy and lose the mesh
+        # placement established in __init__.
+        self._proj.weights = old_weights
+        self._lut_rep = self._replicate(jnp.asarray(new))
+        return True
 
     def clear_window(self, state: HistogramState) -> HistogramState:
         cum, win = self._clear_window(

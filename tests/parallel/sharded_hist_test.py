@@ -249,3 +249,47 @@ def test_sharded_lazy_decay_long_run(devices):
         expected = expected * 0.5 + 4.0
     cum, win = sharded.read(st)
     assert win[0, 0] == pytest.approx(expected, rel=1e-5)
+
+
+class TestShardedLutSwap:
+    def test_swap_changes_routing_without_new_kernel(self, devices):
+        from esslivedata_tpu.parallel import ShardedHistogrammer, make_mesh
+
+        mesh = make_mesh(8, data=2, bank=4)
+        n_pix = 32
+        lut = np.arange(n_pix, dtype=np.int32) % 8  # 8 screen rows
+        h = ShardedHistogrammer(
+            toa_edges=np.linspace(0.0, 10.0, 5),
+            n_screen=8,
+            mesh=mesh,
+            pixel_lut=lut,
+        )
+        state = h.init_state()
+        pid = np.zeros(16, dtype=np.int32)  # pixel 0 -> row 0
+        toa = np.full(16, 5.0, dtype=np.float32)
+        state = h.step(state, pid, toa)
+        cum, win = h.read(state)
+        assert win[0].sum() == 16.0
+        compiled_before = h._step._cache_size()
+
+        # Rotate the LUT: pixel 0 now routes to row 1.
+        assert h.swap_projection((lut + 1) % 8)
+        state = h.step(state, pid, toa)
+        # The headline ADR 0105 property: the swapped table hits the
+        # existing compiled program — no new cache entry.
+        assert h._step._cache_size() == compiled_before
+        cum, win = h.read(state)
+        assert win[0].sum() == 16.0  # old counts stay where they were
+        assert win[1].sum() == 16.0  # new counts follow the new LUT
+
+    def test_shape_change_refused(self, devices):
+        from esslivedata_tpu.parallel import ShardedHistogrammer, make_mesh
+
+        mesh = make_mesh(8, data=2, bank=4)
+        h = ShardedHistogrammer(
+            toa_edges=np.linspace(0.0, 10.0, 5),
+            n_screen=8,
+            mesh=mesh,
+            pixel_lut=np.zeros(32, dtype=np.int32),
+        )
+        assert not h.swap_projection(np.zeros(64, dtype=np.int32))
