@@ -430,6 +430,71 @@ class RoiHandler(_Base):
         )
 
 
+
+class DataExportHandler(_Base):
+    """GET /data/{kid}.json|.npz — the underlying numbers of any plot,
+    with the same extractor query params the PNG endpoint honors.
+    Operators pull exact values out of the live display (the reference's
+    Panel tables allow copy-out; here it is one curlable URL)."""
+
+    def get(self, kid: str, suffix: str) -> None:
+        try:
+            key = _id_to_key(kid)
+        except Exception:
+            self.set_status(404)
+            return
+        from .plots import PlotParams
+
+        try:
+            params = PlotParams.from_dict(
+                {
+                    k: self.get_argument(k)
+                    for k in ("extractor", "window_s", "history")
+                    if self.get_argument(k, None) is not None
+                }
+            )
+        except ValueError as err:
+            self.set_status(400)
+            self.write_json({"error": str(err)})
+            return
+        data = self.services.data_service.get(key, params.make_extractor())
+        if data is None:
+            self.set_status(404)
+            return
+        coords = {
+            name: np.asarray(var.numpy)
+            for name, var in data.coords.items()
+        }
+        if suffix == ".json":
+            self.write_json(
+                {
+                    "name": data.name,
+                    "dims": list(data.dims),
+                    "unit": str(data.unit),
+                    "values": np.asarray(data.values).tolist(),
+                    "coords": {
+                        name: values.tolist()
+                        for name, values in coords.items()
+                    },
+                }
+            )
+            return
+        import io
+
+        buf = io.BytesIO()
+        np.savez_compressed(
+            buf,
+            values=np.asarray(data.values),
+            **{f"coord_{name}": values for name, values in coords.items()},
+        )
+        self.set_header("Content-Type", "application/octet-stream")
+        self.set_header(
+            "Content-Disposition",
+            f'attachment; filename="{key.output_name}.npz"',
+        )
+        self.write(buf.getvalue())
+
+
 class PlotHandler(_Base):
     def _resolve(self, kid: str):
         """Shared resolution for the .png and .meta endpoints: key ->
@@ -775,6 +840,11 @@ async function refreshGrids() {{
         img.src = '/plot/' + kid + '.png?' + p.toString();
         wrap.appendChild(img);
         cell.appendChild(wrap);
+        const dl = document.createElement('a');
+        dl.href = '/data/' + kid + '.npz';
+        dl.textContent = '⤓';
+        dl.title = 'Download this plot\'s data (.npz; .json also served)';
+        head.appendChild(dl);
         const info = keyInfo(kid);
         if (info && info.output.startsWith('image')) {{
           const rb = el('button', '', roiEdit && roiEdit.kid === kid
@@ -1444,6 +1514,7 @@ def make_app(services: DashboardServices, instrument: str) -> tornado.web.Applic
             (r"/api/grid/([^/]+)/cell/(\d+)(/config)", CellManageHandler),
             (r"/api/notifications", NotificationsHandler),
             (r"/api/devices", DevicesHandler),
+            (r"/data/([A-Za-z0-9_\-=]+)(\.json|\.npz)", DataExportHandler),
             (r"/plot/correlation\.png", CorrelationPlotHandler),
             (r"/plot/([A-Za-z0-9_\-=]+)(\.png|\.meta)", PlotHandler),
         ],

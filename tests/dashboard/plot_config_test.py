@@ -454,3 +454,25 @@ class FlattenHttpTest(PlotConfigHttpTest):
         cell = next(g for g in grids if g["grid_id"] == gid)["cells"][0]
         assert cell["title"] == "after"
         assert cell["params"] == {"scale": "log"}
+
+    def test_data_export_json_and_npz(self):
+        import io as _io
+
+        state = self._start_and_wait()
+        kid = self._kid(state, "spectrum_current")
+        r = self.fetch(f"/data/{kid}.json")
+        assert r.code == 200
+        payload = json.loads(r.body)
+        assert payload["dims"] == ["toa"]
+        assert len(payload["values"]) == 100
+        assert "toa" in payload["coords"]
+        assert len(payload["coords"]["toa"]) == 101  # bin edges
+
+        r = self.fetch(f"/data/{kid}.npz")
+        assert r.code == 200
+        archive = np.load(_io.BytesIO(r.body))
+        assert archive["values"].shape == (100,)
+        assert archive["coord_toa"].shape == (101,)
+        # Export honors the extractor params like the PNG endpoint.
+        r = self.fetch(f"/data/{kid}.json?extractor=window_sum")
+        assert r.code == 400  # window_s missing -> validated like plots
