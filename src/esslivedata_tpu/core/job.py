@@ -55,6 +55,9 @@ class JobStatus(BaseModel):
     state: JobState
     message: str = ""
     has_primary_data: bool = False
+    #: The start command's validated params — lets the dashboard offer
+    #: "restart with edited params" with the real current values.
+    params: dict = {}
 
 
 class StreamLag(BaseModel):
@@ -147,10 +150,12 @@ class Job:
         aux_streams: set[str] | None = None,
         context_keys: set[str] | None = None,
         reset_on_run_transition: bool = True,
+        params: dict | None = None,
     ) -> None:
         self.job_id = job_id
         self.workflow_id = workflow_id
         self.workflow = workflow
+        self.params = dict(params or {})
         self.schedule = schedule or JobSchedule()
         self.primary_streams = primary_streams or {job_id.source_name}
         self.aux_streams = aux_streams or set()

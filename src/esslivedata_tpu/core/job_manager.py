@@ -65,6 +65,7 @@ class JobFactory:
             aux_streams=aux,
             context_keys=set(spec.context_keys),
             reset_on_run_transition=spec.reset_on_run_transition,
+            params=dict(config.params),
         )
 
 
@@ -428,6 +429,7 @@ class JobManager:
                     state=rec.state,
                     message=rec.error or rec.warning,
                     has_primary_data=rec.has_primary_data,
+                    params=rec.job.params,
                 )
                 for jid, rec in self._records.items()
             ]

@@ -56,6 +56,35 @@ class _Base(tornado.web.RequestHandler):
         self.set_header("Content-Type", "application/json")
         self.write(json.dumps(payload))
 
+    def resolve_data(self, kid: str, param_keys: tuple[str, ...]):
+        """Shared kid -> (key, params, data) resolution for the plot,
+        meta and export endpoints: 404 for unknown keys/empty buffers,
+        400 for invalid params — one copy of the contract."""
+        from .plots import PlotParams
+
+        try:
+            key = _id_to_key(kid)
+        except Exception:
+            self.set_status(404)
+            return None
+        try:
+            params = PlotParams.from_dict(
+                {
+                    k: self.get_argument(k)
+                    for k in param_keys
+                    if self.get_argument(k, None) is not None
+                }
+            )
+        except ValueError as err:
+            self.set_status(400)
+            self.write_json({"error": str(err)})
+            return None
+        data = self.services.data_service.get(key, params.make_extractor())
+        if data is None:
+            self.set_status(404)
+            return None
+        return key, params, data
+
 
 class StateHandler(_Base):
     def get(self) -> None:
@@ -438,42 +467,34 @@ class DataExportHandler(_Base):
     Panel tables allow copy-out; here it is one curlable URL)."""
 
     def get(self, kid: str, suffix: str) -> None:
-        try:
-            key = _id_to_key(kid)
-        except Exception:
-            self.set_status(404)
+        resolved = self.resolve_data(
+            kid, ("extractor", "window_s", "history")
+        )
+        if resolved is None:
             return
-        from .plots import PlotParams
-
-        try:
-            params = PlotParams.from_dict(
-                {
-                    k: self.get_argument(k)
-                    for k in ("extractor", "window_s", "history")
-                    if self.get_argument(k, None) is not None
-                }
-            )
-        except ValueError as err:
-            self.set_status(400)
-            self.write_json({"error": str(err)})
-            return
-        data = self.services.data_service.get(key, params.make_extractor())
-        if data is None:
-            self.set_status(404)
-            return
+        key, _params, data = resolved
         coords = {
             name: np.asarray(var.numpy)
             for name, var in data.coords.items()
         }
         if suffix == ".json":
+            def clean(arr):
+                # RFC 8259 has no NaN/Infinity tokens; non-finite values
+                # (beam-blocked LUT rows are all-NaN by design) become
+                # null so every strict parser accepts the export.
+                a = np.asarray(arr, dtype=np.float64)
+                out = a.astype(object)
+                out[~np.isfinite(a)] = None
+                return out.tolist()
+
             self.write_json(
                 {
                     "name": data.name,
                     "dims": list(data.dims),
                     "unit": str(data.unit),
-                    "values": np.asarray(data.values).tolist(),
+                    "values": clean(data.values),
                     "coords": {
-                        name: values.tolist()
+                        name: clean(values)
                         for name, values in coords.items()
                     },
                 }
@@ -505,42 +526,26 @@ class PlotHandler(_Base):
         selection), plotter / slice (rendering) — built by the UI from
         the owning cell's persisted params.
         """
-        try:
-            key = _id_to_key(kid)
-        except Exception:
-            self.set_status(404)
+        resolved = self.resolve_data(
+            kid,
+            (
+                "scale",
+                "cmap",
+                "vmin",
+                "vmax",
+                "extractor",
+                "window_s",
+                "plotter",
+                "slice",
+                "overlay",
+                "robust",
+                "flatten_split",
+                "history",  # back-compat alias for full_history
+            ),
+        )
+        if resolved is None:
             return None
-        from .plots import PlotParams
-
-        try:
-            params = PlotParams.from_dict(
-                {
-                    k: self.get_argument(k)
-                    for k in (
-                        "scale",
-                        "cmap",
-                        "vmin",
-                        "vmax",
-                        "extractor",
-                        "window_s",
-                        "plotter",
-                        "slice",
-                        "overlay",
-                        "robust",
-                        "flatten_split",
-                        "history",  # back-compat alias for full_history
-                    )
-                    if self.get_argument(k, None) is not None
-                }
-            )
-        except ValueError as err:
-            self.set_status(400)
-            self.write_json({"error": str(err)})
-            return None
-        data = self.services.data_service.get(key, params.make_extractor())
-        if data is None:
-            self.set_status(404)
-            return None
+        key, params, data = resolved
         title = f"{key.job_id.source_name} · {key.output_name}"
         plotter = None
         if params.plotter == "table":
@@ -841,9 +846,13 @@ async function refreshGrids() {{
         wrap.appendChild(img);
         cell.appendChild(wrap);
         const dl = document.createElement('a');
-        dl.href = '/data/' + kid + '.npz';
+        const dq = new URLSearchParams();
+        for (const k of ['extractor', 'window_s', 'history']) {{
+          if ((c.params || {{}})[k] !== undefined) dq.set(k, c.params[k]);
+        }}
+        dl.href = '/data/' + kid + '.npz?' + dq.toString();
         dl.textContent = '⤓';
-        dl.title = 'Download this plot\'s data (.npz; .json also served)';
+        dl.title = "Download this plot's data (.npz; .json also served)";
         head.appendChild(dl);
         const info = keyInfo(kid);
         if (info && info.output.startsWith('image')) {{
@@ -1223,6 +1232,15 @@ function renderJobsView(s) {{
       b.onclick = async () => {{ await jobAction(a, j); refresh(); }};
       act.appendChild(b);
     }}
+    const rs = el('button', '', 'restart…');
+    rs.title = 'Start a replacement with edited params, then stop this job';
+    rs.onclick = () => {{
+      const w = (lastState.workflows || []).find(
+        x => x.workflow_id === j.workflow_id);
+      if (w) openWizard(w, j.source_name,
+        {{initialParams: j.params || {{}}, replace: j}});
+    }};
+    act.appendChild(rs);
     row.appendChild(act);
     table.appendChild(row);
     if (jobsOpen[j.job_number]) {{
@@ -1269,7 +1287,8 @@ function renderJobsView(s) {{
   root.appendChild(card);
 }}
 // -- workflow wizard: schema-driven params form, two-phase stage->commit.
-function openWizard(w, src) {{
+function openWizard(w, src, opts) {{
+  opts = opts || {{}};
   const old = document.getElementById('wizard');
   if (old) old.remove();
   const box = el('div', 'card'); box.id = 'wizard';
@@ -1281,22 +1300,23 @@ function openWizard(w, src) {{
   const form = el('div'); box.appendChild(form);
   const fields = {{}};
   const props = (w.params_schema && w.params_schema.properties) || {{}};
+  const initial = opts.initialParams || {{}};
   for (const [name, prop] of Object.entries(props)) {{
     const row = el('div');
     const label = el('label', '', name + ' ');
     label.title = prop.description || '';
     const input = document.createElement('input');
+    const seed = initial[name] !== undefined ? initial[name] : prop.default;
     if (prop.type === 'boolean') {{
       input.type = 'checkbox';
-      input.checked = !!prop.default;
+      input.checked = !!seed;
     }} else {{
       input.type = (prop.type === 'number' || prop.type === 'integer')
         ? 'number' : 'text';
       if (prop.type === 'number') input.step = 'any';
       // Nested models ride as JSON (the schema shows an object/$ref).
-      input.value = prop.default !== undefined
-        ? (typeof prop.default === 'object'
-            ? JSON.stringify(prop.default) : prop.default)
+      input.value = seed !== undefined
+        ? (typeof seed === 'object' ? JSON.stringify(seed) : seed)
         : '';
     }}
     const err = el('small', 'field-error'); err.style.color = '#b00020';
@@ -1343,6 +1363,10 @@ function openWizard(w, src) {{
     if (!committed.ok) {{
       status.textContent = (await committed.json()).error || 'commit failed';
       return;
+    }}
+    if (opts.replace) {{
+      // Restart-with-params: the new job is running; retire the old one.
+      await jobAction('stop', opts.replace);
     }}
     box.remove(); refresh();
   }};

@@ -485,3 +485,84 @@ class JobsBrowserStateTest(AsyncHTTPTestCase):
         )
         assert "last_batch_message_count" in svc
         assert "stream_message_counts" in svc
+
+
+class RestartWithParamsTest(AsyncHTTPTestCase):
+    """The restart-with-params flow the jobs browser drives: heartbeats
+    carry the job's actual start params, and stage+commit+stop replaces
+    the job with edited binning."""
+
+    def get_app(self):
+        from esslivedata_tpu.dashboard.web import make_app
+
+        self.transport = InProcessBackendTransport(
+            "dummy", events_per_pulse=100
+        )
+        self.services = DashboardServices(transport=self.transport)
+        return make_app(self.services, "dummy")
+
+    def drive(self, n=10):
+        for _ in range(n):
+            self.transport.tick()
+            self.services.pump.pump_once()
+
+    def post_json(self, url, payload):
+        return self.fetch(url, method="POST", body=json.dumps(payload))
+
+    def test_heartbeat_params_round_trip_into_replacement(self):
+        r = self.post_json(
+            "/api/workflow/start",
+            {
+                "workflow_id": str(DETECTOR_VIEW_HANDLE.workflow_id),
+                "source_name": "panel_0",
+                "params": {"toa_bins": 64},
+            },
+        )
+        assert r.code == 200
+        old_number = json.loads(r.body)["job_number"]
+        for _ in range(30):
+            time.sleep(0.05)
+            self.drive(10)
+            state = json.loads(self.fetch("/api/state").body)
+            if state["jobs"]:
+                break
+        job = next(j for j in state["jobs"] if j["job_number"] == old_number)
+        # The heartbeat exposes the validated start params.
+        assert job["params"] == {"toa_bins": 64}
+
+        # The wizard flow: stage+commit with edited params, stop the old.
+        self.post_json(
+            "/api/workflow/stage",
+            {
+                "workflow_id": job["workflow_id"],
+                "source_name": "panel_0",
+                "params": {"toa_bins": 32},
+            },
+        )
+        r = self.post_json(
+            "/api/workflow/commit",
+            {"workflow_id": job["workflow_id"], "source_name": "panel_0"},
+        )
+        assert r.code == 200
+        new_number = json.loads(r.body)["job_number"]
+        self.post_json(
+            "/api/job/stop",
+            {"source_name": "panel_0", "job_number": old_number},
+        )
+        def old_retired(numbers):
+            # Graceful stop: the old job either flushed its final window
+            # and left the table, or sits parked in 'stopped'.
+            return old_number not in numbers or numbers[old_number][
+                "state"
+            ] in ("stopped", "finishing")
+
+        for _ in range(40):
+            time.sleep(0.05)
+            self.drive(10)
+            state = json.loads(self.fetch("/api/state").body)
+            numbers = {j["job_number"]: j for j in state["jobs"]}
+            if new_number in numbers and old_retired(numbers):
+                break
+        assert new_number in numbers
+        assert numbers[new_number]["params"] == {"toa_bins": 32}
+        assert old_retired(numbers)

@@ -476,3 +476,31 @@ class FlattenHttpTest(PlotConfigHttpTest):
         # Export honors the extractor params like the PNG endpoint.
         r = self.fetch(f"/data/{kid}.json?extractor=window_sum")
         assert r.code == 400  # window_s missing -> validated like plots
+
+    def test_json_export_handles_nan(self):
+        # Non-finite values (beam-blocked LUT rows are all-NaN by design)
+        # must export as null, not as RFC-invalid NaN tokens.
+        from esslivedata_tpu.config.workflow_spec import (
+            JobId as _JobId,
+            ResultKey,
+            WorkflowId,
+        )
+        from esslivedata_tpu.core.timestamp import Timestamp
+        from esslivedata_tpu.dashboard.web import _key_to_id
+        from esslivedata_tpu.utils import DataArray, Variable
+
+        key = ResultKey(
+            workflow_id=WorkflowId.parse("dummy/detector_view/panel_view/v1"),
+            job_id=_JobId(source_name="panel_0"),
+            output_name="lut",
+        )
+        values = np.array([1.0, np.nan, np.inf, 4.0])
+        self.services.data_service.put(
+            key,
+            Timestamp.from_ns(0),
+            DataArray(Variable(values, ("x",), ""), name="lut"),
+        )
+        r = self.fetch(f"/data/{_key_to_id(key)}.json")
+        assert r.code == 200
+        payload = json.loads(r.body)  # strict parse must succeed
+        assert payload["values"] == [1.0, None, None, 4.0]
