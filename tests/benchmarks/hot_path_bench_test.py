@@ -123,3 +123,87 @@ class TestDashboardBench:
             render_png(da)
         rate = _rate("render_png 256x256", 10, time.perf_counter() - t0)
         assert rate > 1
+
+
+class TestDashboardBench:
+    """Reference data_service_benchmark.py / plotter_compute_benchmark.py
+    counterparts: ingestion+extraction through the DataService and PNG
+    render cost per plotter family."""
+
+    def test_data_service_put_get_throughput(self):
+        import uuid
+
+        from esslivedata_tpu.config.workflow_spec import (
+            JobId,
+            ResultKey,
+            WorkflowId,
+        )
+        from esslivedata_tpu.core.timestamp import Timestamp
+        from esslivedata_tpu.dashboard.data_service import DataService
+        from esslivedata_tpu.utils import DataArray, Variable
+
+        ds = DataService()
+        keys = [
+            ResultKey(
+                workflow_id=WorkflowId.parse(
+                    "dummy/detector_view/panel_view/v1"
+                ),
+                job_id=JobId(source_name=f"p{i}", job_number=uuid.uuid4()),
+                output_name="image_current",
+            )
+            for i in range(8)
+        ]
+        da = DataArray(
+            Variable(np.zeros((128, 128)), ("y", "x"), "counts"), name="img"
+        )
+        notifications = []
+        from esslivedata_tpu.dashboard.data_service import DataSubscription
+
+        ds.subscribe(
+            DataSubscription(keys=set(keys), on_updated=notifications.append)
+        )
+        reps = 200
+        t0 = time.perf_counter()
+        for r in range(reps):
+            with ds.transaction():
+                for key in keys:
+                    ds.put(key, Timestamp.from_ns(r), da)
+        dt = time.perf_counter() - t0
+        rate = _rate("data_service put (8 keys/txn)", reps * len(keys), dt)
+        assert rate > 1_000  # 10x floor vs ~10k+/s observed
+        assert len(notifications) == reps  # one batched notify per txn
+
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            for key in keys:
+                assert ds.get(key) is not None
+        dt = time.perf_counter() - t0
+        rate = _rate("data_service get", reps * len(keys), dt)
+        assert rate > 5_000
+
+    @pytest.mark.parametrize(
+        "shape", [(100,), (128, 128), (8, 100)], ids=["line", "image", "overlay"]
+    )
+    def test_plotter_render_cost(self, shape):
+        from esslivedata_tpu.dashboard.plots import render_png
+        from esslivedata_tpu.utils import DataArray, Variable
+
+        rng = np.random.default_rng(0)
+        if len(shape) == 1:
+            dims = ("toa",)
+        elif shape[0] == 8:
+            dims = ("roi", "toa")  # categorical lead dim -> overlay
+        else:
+            dims = ("y", "x")
+        da = DataArray(
+            Variable(rng.poisson(5.0, shape).astype(float), dims, "counts"),
+            name="bench",
+        )
+        render_png(da)  # warm matplotlib caches
+        reps = 10
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            render_png(da)
+        dt = time.perf_counter() - t0
+        rate = _rate(f"render {shape}", reps, dt)
+        assert rate > 1  # >1 frame/s: a 1 Hz dashboard stays feasible
