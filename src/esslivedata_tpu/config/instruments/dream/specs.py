@@ -168,6 +168,9 @@ INSTRUMENT.add_monitor(
     MonitorConfig(name="monitor_cave", source_name="dream_mon_cave")
 )
 INSTRUMENT.add_log("sample_temperature", "dream_temp_sample")
+# WFM subframe emission-time calibration (ns), published by the chopper
+# control layer; the powder workflow consumes it as OPTIONAL context.
+INSTRUMENT.add_log("emission_offset", "dream_wfm_t0")
 register_parsed_catalog(INSTRUMENT, PARSED_STREAMS)
 instrument_registry.register(INSTRUMENT)
 
@@ -269,6 +272,9 @@ POWDER_HANDLE = workflow_registry.register_spec(
         source_names=list(BANK_SIZES),
         service="data_reduction",
         aux_source_names={"monitor": ["monitor_bunker", "monitor_cave"]},
+        # Delivered when the facility publishes it; never gated on — the
+        # static toa_offset_ns param is the fallback.
+        optional_context_keys=["emission_offset"],
         params_model=PowderDiffractionParams,
         outputs={
             "dspacing_current": OutputSpec(title="I(d) — window"),

@@ -68,6 +68,10 @@ def gather_source_names(instrument: Instrument, service: str) -> set[str]:
         for choices in spec.aux_source_names.values():
             names.update(choices)
         names.update(spec.context_keys)
+        # Optional context is routed like gating context — the service
+        # must consume the stream to deliver it — the difference is
+        # purely that jobs do not hold for it.
+        names.update(spec.optional_context_keys)
     for binding in instrument.context_bindings:
         if not binding.dependent_sources or any(
             set(spec.source_names) & binding.dependent_sources for spec in specs

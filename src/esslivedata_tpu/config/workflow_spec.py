@@ -198,6 +198,12 @@ class WorkflowSpec(BaseModel):
     # NICOS device topic (reference workflow_spec.py device_outputs, ADR 0006).
     device_outputs: dict[str, str] = Field(default_factory=dict)
     context_keys: list[str] = Field(default_factory=list)
+    #: Context streams delivered WHEN AVAILABLE but never gated on —
+    #: live calibrations with a static-param fallback (e.g. the powder
+    #: emission offset). Gating keys above hold the job until a value
+    #: exists; optional keys must not strand jobs in deployments where
+    #: the stream is not produced.
+    optional_context_keys: list[str] = Field(default_factory=list)
     reset_on_run_transition: bool = True
     service: str | None = None
     """Backend service hosting this spec (detector_data/monitor_data/

@@ -149,6 +149,7 @@ class Job:
         primary_streams: set[str] | None = None,
         aux_streams: set[str] | None = None,
         context_keys: set[str] | None = None,
+        optional_context_keys: set[str] | None = None,
         reset_on_run_transition: bool = True,
         params: dict | None = None,
     ) -> None:
@@ -160,6 +161,7 @@ class Job:
         self.primary_streams = primary_streams or {job_id.source_name}
         self.aux_streams = aux_streams or set()
         self.context_keys = context_keys or set()
+        self.optional_context_keys = optional_context_keys or set()
         self.reset_on_run_transition = reset_on_run_transition
         # Generation start: data time of the first message accumulated since
         # job start or last reset. Stamped on outputs as ``start_time``, it
@@ -201,7 +203,8 @@ class Job:
         return True
 
     def set_context(self, context: Mapping[str, Any]) -> None:
-        relevant = {k: v for k, v in context.items() if k in self.context_keys}
+        deliverable = self.context_keys | self.optional_context_keys
+        relevant = {k: v for k, v in context.items() if k in deliverable}
         if relevant and hasattr(self.workflow, "set_context"):
             self.workflow.set_context(relevant)
 

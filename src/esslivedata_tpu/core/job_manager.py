@@ -64,6 +64,7 @@ class JobFactory:
             primary_streams={config.job_id.source_name},
             aux_streams=aux,
             context_keys=set(spec.context_keys),
+            optional_context_keys=set(spec.optional_context_keys),
             reset_on_run_transition=spec.reset_on_run_transition,
             params=dict(config.params),
         )
@@ -289,6 +290,7 @@ class JobManager:
             for rec in self._records.values():
                 if rec.phase in (_Phase.SCHEDULED, _Phase.PENDING_CONTEXT):
                     out |= rec.job.context_keys
+                    out |= rec.job.optional_context_keys
             return out
 
     # -- processing --------------------------------------------------------
@@ -328,7 +330,10 @@ class JobManager:
             if queued:
                 for job_id, rec in self._records.items():
                     if rec.phase == _Phase.ACTIVE and job_id not in graduated:
-                        rec.stale_context |= queued & rec.job.context_keys
+                        rec.stale_context |= queued & (
+                            rec.job.context_keys
+                            | rec.job.optional_context_keys
+                        )
             work: list[tuple[_JobRecord, dict[str, Any]]] = []
             for rec in self._records.values():
                 if rec.phase != _Phase.ACTIVE:

@@ -8,9 +8,18 @@ other reductions: Bragg physics precompiles into a host-built
 streaming work is one gather+scatter per batch into fold-semantics
 state, and normalization divides by the aux-monitor counts (this
 framework's stand-in for accumulated proton charge).
+
+The emission-time correction (a WFM subframe T0 from the chopper
+cascade) is LIVE: when an ``emission_offset`` context stream is bound,
+its value overrides the static ``toa_offset_ns`` param and changes
+rebuild + swap the Bragg table into the running kernel (ADR 0105) —
+counts persist because the d bin space is unchanged.
 """
 
 from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any
 
 import numpy as np
 from pydantic import BaseModel, ConfigDict, Field
@@ -18,7 +27,7 @@ from pydantic import BaseModel, ConfigDict, Field
 from ..config.models import TOARange
 from ..ops.qhistogram import QHistogrammer, build_dspacing_map
 from ..utils.labeled import DataArray, Variable
-from .qshared import QStreamingMixin
+from .qshared import QStreamingMixin, latest_sample_value
 
 __all__ = ["PowderDiffractionParams", "PowderDiffractionWorkflow"]
 
@@ -34,6 +43,8 @@ class PowderDiffractionParams(BaseModel):
     #: Emission-time correction (e.g. WFM subframe T0 from the chopper
     #: cascade); a live recalibration rebuilds + swaps the table.
     toa_offset_ns: float = 0.0
+    #: Offset moves below this are jitter, not a recalibration.
+    offset_tolerance_ns: float = 1000.0
 
 
 class PowderDiffractionWorkflow(QStreamingMixin):
@@ -48,6 +59,7 @@ class PowderDiffractionWorkflow(QStreamingMixin):
         params: PowderDiffractionParams | None = None,
         primary_stream: str | None = None,
         monitor_streams: set[str] | None = None,
+        offset_stream: str = "emission_offset",
     ) -> None:
         params = params or PowderDiffractionParams()
         self._params = params
@@ -55,14 +67,17 @@ class PowderDiffractionWorkflow(QStreamingMixin):
         toa_edges = np.linspace(
             params.toa_range.low, params.toa_range.high, params.toa_bins + 1
         )
-        dmap = build_dspacing_map(
-            two_theta=two_theta,
-            l_total=l_total,
-            pixel_ids=pixel_ids,
-            toa_edges=toa_edges,
-            d_edges=d_edges,
-            toa_offset_ns=params.toa_offset_ns,
-        )
+        self._geometry = {
+            "two_theta": np.asarray(two_theta, dtype=np.float64),
+            "l_total": np.asarray(l_total, dtype=np.float64),
+            "pixel_ids": np.asarray(pixel_ids),
+        }
+        self._d_edges = d_edges
+        self._toa_edges = toa_edges
+        self._offset_stream = offset_stream
+        self._offset_ns = float(params.toa_offset_ns)
+        self._built_offset_ns = self._offset_ns
+        dmap = self._build_table()
         self._hist = QHistogrammer(
             qmap=dmap, toa_edges=toa_edges, n_q=params.d_bins
         )
@@ -71,6 +86,32 @@ class PowderDiffractionWorkflow(QStreamingMixin):
         self._primary_stream = primary_stream
         self._monitor_streams = monitor_streams or set()
         self._publish = None
+
+    def _build_table(self):
+        return build_dspacing_map(
+            **self._geometry,
+            toa_edges=self._toa_edges,
+            d_edges=self._d_edges,
+            toa_offset_ns=self._offset_ns,
+        )
+
+    def set_context(self, context: Mapping[str, Any]) -> None:
+        """A live emission-time calibration (WFM subframe T0) arrives as
+        context; moves beyond the tolerance swap a rebuilt Bragg table
+        into the running kernel — no recompile, counts persist."""
+        if (
+            value := latest_sample_value(context.get(self._offset_stream))
+        ) is not None:
+            self._offset_ns = value
+
+    def accumulate(self, data: Mapping[str, Any]) -> None:
+        if (
+            abs(self._offset_ns - self._built_offset_ns)
+            >= self._params.offset_tolerance_ns
+        ):
+            self._hist.swap_table(self._build_table())
+            self._built_offset_ns = self._offset_ns
+        super().accumulate(data)
 
     def _spectrum(self, values: np.ndarray, name: str, unit="counts"):
         return DataArray(

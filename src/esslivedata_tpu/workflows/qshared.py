@@ -17,7 +17,18 @@ import numpy as np
 from ..ops.event_batch import EventBatch
 from ..preprocessors.event_data import StagedEvents
 
-__all__ = ["QStreamingMixin"]
+__all__ = ["QStreamingMixin", "latest_sample_value"]
+
+
+def latest_sample_value(sample: Any) -> float | None:
+    """Latest numeric value of a context sample (NXlog DataArray latest,
+    LogData, or plain scalar) — the one idiom every live-calibration
+    consumer shares."""
+    if sample is None:
+        return None
+    values = getattr(sample, "values", sample)
+    arr = np.asarray(values).reshape(-1)
+    return float(arr[-1]) if arr.size else None
 
 
 class QStreamingMixin:

@@ -21,18 +21,9 @@ from pydantic import BaseModel, ConfigDict, Field
 from ..config.models import TOARange
 from ..ops.qhistogram import QHistogrammer, build_qz_map
 from ..utils.labeled import DataArray, Variable
-from .qshared import QStreamingMixin
+from .qshared import QStreamingMixin, latest_sample_value
 
 __all__ = ["ReflectometryParams", "ReflectometryWorkflow"]
-
-
-def _latest_value(sample: Any) -> float | None:
-    """Context samples arrive as DataArrays (NXlog latest) or scalars."""
-    if sample is None:
-        return None
-    values = getattr(sample, "values", sample)
-    arr = np.asarray(values).reshape(-1)
-    return float(arr[-1]) if arr.size else None
 
 
 class ReflectometryParams(BaseModel):
@@ -87,7 +78,9 @@ class ReflectometryWorkflow(QStreamingMixin):
 
     # -- context -----------------------------------------------------------
     def set_context(self, context: Mapping[str, Any]) -> None:
-        if (value := _latest_value(context.get(self._angle_stream))) is not None:
+        if (
+            value := latest_sample_value(context.get(self._angle_stream))
+        ) is not None:
             self._omega_deg = value
 
     def _ensure_table(self) -> bool:

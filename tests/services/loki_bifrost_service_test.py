@@ -351,3 +351,90 @@ class TestBifrostQEReduction:
         assert float(np.asarray(sqw.data, np.float64).sum()) == 3000.0
         # Elastic events concentrate in few (Q, E) bins around dE=0.
         assert (np.asarray(sqw.data) > 0).sum() < 40
+
+
+class TestDreamLiveEmissionOffset:
+    def test_f144_wfm_offset_swaps_the_running_bragg_table(self):
+        # Optional context end to end: the WFM T0 arrives as a real f144
+        # log, the job is NOT gated on it, and identical arrivals bin to
+        # a shifted d-spacing afterwards (table swapped, no restart).
+        import numpy as np
+
+        from esslivedata_tpu.config.instrument import instrument_registry
+
+        instrument_registry["dream"].load_factories()
+        from esslivedata_tpu.config.instruments.dream.specs import (
+            POWDER_HANDLE,
+        )
+
+        builder = make_reduction_service_builder(
+            instrument="dream", batcher=NaiveMessageBatcher(), job_threads=1
+        )
+        raw = PulsedRawSource([])
+        producer = FakeProducer()
+        sink = KafkaSink(
+            producer,
+            make_default_serializer(builder.stream_mapping.livedata, "wfm"),
+        )
+        service = builder.from_raw_source(raw, sink)
+        raw.inject(
+            start_command(
+                POWDER_HANDLE.workflow_id,
+                "mantle_detector",
+                "dream_livedata_commands",
+                aux={"monitor": "monitor_bunker"},
+            )
+        )
+        service.step()
+
+        h_over_mn = 3956.034
+        t_ns = 2.0 * 77.7 / h_over_mn * 1e9
+        t0 = 1_700_000_000_000_000_000
+        rng = np.random.default_rng(0)
+
+        def inject(pulse):
+            ids = rng.integers(1, 491521, 1000).astype(np.int32)
+            toa = np.full(1000, t_ns, dtype=np.int32)
+            raw.inject(
+                FakeKafkaMessage(
+                    wire.encode_ev44(
+                        "dream_mantle_detector",
+                        pulse,
+                        np.array([t0 + pulse * int(1e9 / 14)]),
+                        np.array([0]),
+                        toa,
+                        pixel_id=ids,
+                    ),
+                    "dream_detector",
+                )
+            )
+            service.step()
+
+        def peak():
+            for m in reversed(producer.messages):
+                if m.topic != "dream_livedata_data":
+                    continue
+                da = wire.decode_da00(m.value)
+                if "dspacing_current" in da.source_name:
+                    for var in da.variables:
+                        if var.name == "signal" and np.asarray(var.data).sum():
+                            return int(np.asarray(var.data).argmax())
+            return None
+
+        inject(0)
+        inject(1)
+        p_before = peak()
+        assert p_before is not None  # not gated: optional context
+        raw.inject(
+            FakeKafkaMessage(
+                wire.encode_f144(
+                    "dream_wfm_t0", -3.0e6, t0 + int(1.5e9 / 14)
+                ),
+                "dream_motion",
+            )
+        )
+        service.step()
+        inject(2)
+        inject(3)
+        p_after = peak()
+        assert p_after is not None and p_after < p_before

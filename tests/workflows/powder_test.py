@@ -113,3 +113,60 @@ class TestWorkflowAndRegistry:
         assert isinstance(wf, PowderDiffractionWorkflow)
         out = wf.finalize()
         assert np.asarray(out["dspacing_cumulative"].values).shape == (30,)
+
+
+class TestLiveEmissionOffset:
+    def _workflow(self):
+        n_pix = 4
+        return PowderDiffractionWorkflow(
+            two_theta=np.full(n_pix, np.pi / 2),
+            l_total=np.full(n_pix, 80.0),
+            pixel_ids=np.arange(n_pix),
+            params=PowderDiffractionParams(
+                d_bins=200, d_min=0.5, d_max=2.5
+            ),
+        )
+
+    def test_offset_change_shifts_bragg_bin_without_new_kernel(self):
+        wf = self._workflow()
+        t_ns = 2.0 * 80.0 / H_OVER_MN * 1e9
+
+        def peak():
+            out = wf.finalize()
+            values = np.asarray(out["dspacing_current"].values)
+            return int(values.argmax()) if values.sum() else None
+
+        wf.accumulate(
+            {"det": staged(np.zeros(50, np.int32), np.full(50, t_ns))}
+        )
+        bin_before = peak()
+        hist = wf._hist
+
+        # The chopper cascade reports a 2 ms emission offset: identical
+        # arrivals now correspond to a shorter true flight time.
+        wf.set_context({"emission_offset": -2.0e6})
+        wf.accumulate(
+            {"det": staged(np.zeros(50, np.int32), np.full(50, t_ns))}
+        )
+        bin_after = peak()
+        assert wf._hist is hist  # swapped, not rebuilt
+        assert bin_before is not None and bin_after is not None
+        assert bin_after < bin_before  # shorter flight -> smaller lambda/d
+        # Counts from both calibrations persist (same d bin space).
+        out = wf.finalize()
+        assert (
+            float(np.asarray(out["dspacing_cumulative"].values).sum()) == 100.0
+        )
+
+    def test_jitter_below_tolerance_does_not_swap(self):
+        wf = self._workflow()
+        t_ns = 2.0 * 80.0 / H_OVER_MN * 1e9
+        wf.accumulate(
+            {"det": staged(np.zeros(10, np.int32), np.full(10, t_ns))}
+        )
+        table = wf._hist._qmap
+        wf.set_context({"emission_offset": 500.0})  # < 1000 ns tolerance
+        wf.accumulate(
+            {"det": staged(np.zeros(10, np.int32), np.full(10, t_ns))}
+        )
+        assert wf._hist._qmap is table
