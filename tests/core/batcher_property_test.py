@@ -1,0 +1,109 @@
+"""Property-based batcher invariants.
+
+Whatever the arrival pattern — bursts, gaps, late messages, arbitrary
+poll chunking — the batchers must conserve messages (each emitted
+exactly once) and emit monotone, non-overlapping pulse-aligned windows.
+The scenario suites check dynamics; these properties check the
+bookkeeping that everything else stands on.
+"""
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from esslivedata_tpu.core import Duration, Message, StreamId, StreamKind, Timestamp
+from esslivedata_tpu.core.message_batcher import (
+    AdaptiveMessageBatcher,
+    NaiveMessageBatcher,
+    SimpleMessageBatcher,
+)
+
+STREAM = StreamId(kind=StreamKind.DETECTOR_EVENTS, name="s")
+
+
+def _messages(pulses):
+    return [
+        Message(
+            timestamp=Timestamp.from_pulse_index(p), stream=STREAM, value=i
+        )
+        for i, p in enumerate(pulses)
+    ]
+
+
+def _chunks(messages, cuts):
+    """Split the message list at the (sorted, deduped) cut positions."""
+    positions = sorted({c % (len(messages) + 1) for c in cuts})
+    out = []
+    last = 0
+    for pos in positions:
+        out.append(messages[last:pos])
+        last = pos
+    out.append(messages[last:])
+    return out
+
+# Mostly-ordered pulse streams with occasional disorder and gaps —
+# the realistic Kafka arrival shape.
+_pulse_lists = st.lists(
+    st.integers(min_value=0, max_value=400), min_size=1, max_size=120
+).map(sorted).flatmap(
+    lambda ps: st.permutations(ps[-8:]).map(lambda tail: ps[:-8] + list(tail))
+    if len(ps) > 8
+    else st.just(ps)
+)
+
+
+class TestConservation:
+    @settings(max_examples=150, deadline=None)
+    @given(
+        pulses=_pulse_lists,
+        cuts=st.lists(st.integers(0, 1000), max_size=10),
+        batcher_kind=st.sampled_from(["naive", "simple", "adaptive"]),
+    )
+    def test_every_message_emitted_exactly_once(
+        self, pulses, cuts, batcher_kind
+    ):
+        batcher = {
+            "naive": NaiveMessageBatcher,
+            "simple": lambda: SimpleMessageBatcher(Duration.from_s(1.0)),
+            "adaptive": lambda: AdaptiveMessageBatcher(
+                Duration.from_s(1.0), clock=lambda: 0.0
+            ),
+        }[batcher_kind]()
+        messages = _messages(pulses)
+        seen: list[int] = []
+        batches = []
+        for chunk in _chunks(messages, cuts):
+            out = batcher.batch(chunk)
+            if out is not None:
+                batches.append(out)
+                seen.extend(m.value for m in out.messages)
+        # Drain: push far-future closers until nothing is buffered.
+        for i in range(20):
+            closer = Message(
+                timestamp=Timestamp.from_pulse_index(10_000 + i * 100),
+                stream=STREAM,
+                value=-1,
+            )
+            out = batcher.batch([closer])
+            if out is not None:
+                batches.append(out)
+                seen.extend(
+                    m.value for m in out.messages if m.value != -1
+                )
+        assert sorted(seen) == sorted(m.value for m in messages)
+
+        # Windows are pulse-aligned, ordered, non-overlapping.
+        for b in batches:
+            assert b.start.ns % 1 == 0
+            assert b.end > b.start
+        for a, b in zip(batches, batches[1:]):
+            assert a.end <= b.start or batcher_kind == "naive"
+
+    @settings(max_examples=100, deadline=None)
+    @given(pulses=st.lists(st.integers(0, 100), min_size=1, max_size=60))
+    def test_naive_batch_contains_all_its_input(self, pulses):
+        batcher = NaiveMessageBatcher()
+        messages = _messages(sorted(pulses))
+        out = batcher.batch(messages)
+        assert out is not None and len(out) == len(messages)
+        for m in messages:
+            assert out.start <= m.timestamp < out.end
