@@ -33,6 +33,7 @@ from .timestamp import Duration, Timestamp
 
 __all__ = [
     "AdaptiveMessageBatcher",
+    "LoadGovernor",
     "MessageBatch",
     "MessageBatcher",
     "NaiveMessageBatcher",
@@ -141,6 +142,64 @@ class SimpleMessageBatcher:
         pass
 
 
+class LoadGovernor:
+    """The load->window-scale state machine shared by the adaptive and
+    rate-aware batchers: above ``high_load`` for ``escalate_after``
+    consecutive batches the scale doubles (cap ``max_scale``); below
+    ``high_load / (2*sqrt 2)`` for ``deescalate_after`` batches it
+    shrinks by 1/sqrt 2 (floor 1). The gap between thresholds is the
+    dead zone preventing oscillation after a doubling halves the load.
+    """
+
+    def __init__(
+        self,
+        *,
+        max_scale: float = 8.0,
+        high_load: float = 0.8,
+        escalate_after: int = 2,
+        deescalate_after: int = 3,
+    ) -> None:
+        self.scale = 1.0
+        self._max_scale = max_scale
+        self._high = high_load
+        self._low = high_load / (2.0 * math.sqrt(2.0))
+        self._escalate_after = escalate_after
+        self._deescalate_after = deescalate_after
+        self._over = 0
+        self._under = 0
+
+    def observe(self, load: float) -> bool:
+        """Feed one batch's load; returns True when the scale changed."""
+        if load > self._high:
+            self._over += 1
+            self._under = 0
+        elif load < self._low:
+            self._under += 1
+            self._over = 0
+        else:
+            self._over = 0
+            self._under = 0
+        if self._over >= self._escalate_after:
+            self._over = 0
+            return self.escalate()
+        if self._under >= self._deescalate_after:
+            self._under = 0
+            return self.relax()
+        return False
+
+    def escalate(self) -> bool:
+        new = min(self._max_scale, self.scale * 2.0)
+        changed = new != self.scale
+        self.scale = new
+        return changed
+
+    def relax(self) -> bool:
+        new = max(1.0, self.scale / math.sqrt(2.0))
+        changed = new != self.scale
+        self.scale = new
+        return changed
+
+
 class AdaptiveMessageBatcher(SimpleMessageBatcher):
     """Load-adaptive windows.
 
@@ -166,13 +225,12 @@ class AdaptiveMessageBatcher(SimpleMessageBatcher):
     ) -> None:
         super().__init__(window)
         self._base_pulses = self._window_pulses
-        self._max_pulses = max(1, round(self._base_pulses * max_scale))
-        self._high_load = high_load
-        self._low_load = high_load / (2.0 * math.sqrt(2.0))
-        self._escalate_after = escalate_after
-        self._deescalate_after = deescalate_after
-        self._overloaded_count = 0
-        self._underloaded_count = 0
+        self._governor = LoadGovernor(
+            max_scale=max_scale,
+            high_load=high_load,
+            escalate_after=escalate_after,
+            deescalate_after=deescalate_after,
+        )
         self._pending_pulses = self._window_pulses
         self._idle_timeout_s = idle_timeout_s
         self._clock = clock
@@ -203,27 +261,15 @@ class AdaptiveMessageBatcher(SimpleMessageBatcher):
         window_ns = (
             self._last_emitted_pulses * PULSE_PERIOD_NS_NUM / PULSE_PERIOD_NS_DEN
         )
-        load = duration.ns / window_ns
-        if load > self._high_load:
-            self._overloaded_count += 1
-            self._underloaded_count = 0
-        elif load < self._low_load:
-            self._underloaded_count += 1
-            self._overloaded_count = 0
-        else:
-            self._overloaded_count = 0
-            self._underloaded_count = 0
-        if self._overloaded_count >= self._escalate_after:
-            self._escalate()
-            self._overloaded_count = 0
-        elif self._underloaded_count >= self._deescalate_after:
-            self._deescalate()
-            self._underloaded_count = 0
-
-    def _escalate(self) -> None:
-        self._pending_pulses = min(self._max_pulses, self._pending_pulses * 2)
+        if self._governor.observe(duration.ns / window_ns):
+            self._apply_scale()
 
     def _deescalate(self) -> None:
+        """Idle relaxation path (wall-clock driven)."""
+        self._governor.relax()
+        self._apply_scale()
+
+    def _apply_scale(self) -> None:
         self._pending_pulses = max(
-            self._base_pulses, round(self._pending_pulses / math.sqrt(2.0))
+            1, round(self._base_pulses * self._governor.scale)
         )

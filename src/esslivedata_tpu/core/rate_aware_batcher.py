@@ -30,7 +30,7 @@ from collections import defaultdict, deque
 from dataclasses import dataclass, field
 
 from .message import Message, StreamId, StreamKind
-from .message_batcher import MessageBatch
+from .message_batcher import LoadGovernor, MessageBatch
 from .timestamp import Duration, Timestamp
 
 __all__ = ["PeriodEstimator", "RateAwareMessageBatcher", "SlotGrid"]
@@ -219,6 +219,7 @@ class RateAwareMessageBatcher:
     def __init__(self, window: Duration = Duration.from_s(1.0), *,
                  timeout_factor: float = 1.2) -> None:
         self._window = window
+        self._base_window = window
         self.timeout_factor = timeout_factor
         self._streams: defaultdict[StreamId, _StreamState] = defaultdict(_StreamState)
         self._start: Timestamp | None = None
@@ -227,6 +228,11 @@ class RateAwareMessageBatcher:
         self._overflow: list[Message] = []
         self._future: list[Message] = []
         self._pending_window: Duration | None = None
+        # Load-adaptive windows share the adaptive batcher's governor:
+        # overload doubles the gated window (streams regate to the new
+        # slot count at the next refresh), underload shrinks it back.
+        self._governor = LoadGovernor()
+        self._last_emitted_window: Duration = window
 
     @property
     def window(self) -> Duration:
@@ -245,7 +251,13 @@ class RateAwareMessageBatcher:
         return set(self._streams)
 
     def report_processing_time(self, duration: Duration) -> None:
-        pass
+        load = duration.ns / max(self._last_emitted_window.ns, 1)
+        if self._governor.observe(load):
+            self.set_window(
+                Duration(
+                    max(1, round(self._base_window.ns * self._governor.scale))
+                )
+            )
 
     def batch(self, messages: list[Message]) -> MessageBatch | None:
         if messages:
@@ -368,6 +380,11 @@ class RateAwareMessageBatcher:
             )
             end = max(end, start + closing_window)
         batch = MessageBatch(start=start, end=end, messages=messages)
+        # Load feedback divides by the batch's REAL span: timeout-closed
+        # batches can cover several windows of drained traffic, and
+        # measuring that work against the nominal window would read ~3x
+        # the true load and ratchet the governor to max scale.
+        self._last_emitted_window = Duration(max(end.ns - start.ns, 1))
         self._start = end
         # Re-route held-back traffic into the new window; anything still past
         # its last slot lands back in overflow and waits for the next close.

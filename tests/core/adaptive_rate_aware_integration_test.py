@@ -1,0 +1,96 @@
+"""Adaptive load scaling x rate-aware gating (reference
+adaptive_rate_aware_integration_test.py): overload feedback must reach
+the GATED window — streams regate to the escalated slot count — and
+scale oscillation must never lose or duplicate messages."""
+
+import numpy as np
+
+from esslivedata_tpu.core import Duration, Message, StreamId, StreamKind, Timestamp
+from esslivedata_tpu.core.rate_aware_batcher import RateAwareMessageBatcher
+
+DET = StreamId(kind=StreamKind.DETECTOR_EVENTS, name="det0")
+PULSE_NS = round(1e9 / 14)
+
+
+def msg(ts_ns: int, value=0) -> Message:
+    return Message(timestamp=Timestamp.from_ns(ts_ns), stream=DET, value=value)
+
+
+def converge(batcher: RateAwareMessageBatcher, n=40) -> int:
+    """Bootstrap + converge the estimator at 14 Hz; returns next pulse."""
+    batcher.batch([msg(i * PULSE_NS) for i in range(n)])
+    return n
+
+
+class TestEscalationPropagates:
+    def test_overload_doubles_the_gated_slot_count(self):
+        batcher = RateAwareMessageBatcher(Duration.from_s(1.0))
+        pulse = converge(batcher)
+        # Drive batches and report 1.5x-window processing each time.
+        slots_seen = []
+        for _ in range(120):
+            out = batcher.batch([msg(pulse * PULSE_NS)])
+            pulse += 1
+            if out is not None:
+                batcher.report_processing_time(
+                    Duration(round(out.window.ns * 1.5))
+                )
+                state = batcher._streams[DET]
+                if state.grid is not None:
+                    slots_seen.append(state.grid.slots_per_batch)
+        assert slots_seen, "stream never gated"
+        # Escalation reached the gate: slot count grew beyond the base 14.
+        assert max(slots_seen) >= 28
+        assert slots_seen[-1] >= 28
+
+    def test_underload_relaxes_back(self):
+        batcher = RateAwareMessageBatcher(Duration.from_s(1.0))
+        pulse = converge(batcher)
+        for _ in range(60):
+            out = batcher.batch([msg(pulse * PULSE_NS)])
+            pulse += 1
+            if out is not None:
+                batcher.report_processing_time(
+                    Duration(round(out.window.ns * 1.5))
+                )
+        assert batcher.window.ns > Duration.from_s(1.0).ns
+        for _ in range(400):
+            out = batcher.batch([msg(pulse * PULSE_NS)])
+            pulse += 1
+            if out is not None:
+                batcher.report_processing_time(
+                    Duration(round(out.window.ns * 0.05))
+                )
+        assert batcher.window.ns == Duration.from_s(1.0).ns
+
+
+class TestOscillationConservation:
+    def test_no_message_lost_across_scale_changes(self):
+        rng = np.random.default_rng(0)
+        batcher = RateAwareMessageBatcher(Duration.from_s(1.0))
+        sent: list[int] = []
+        received: list[int] = []
+        value = 0
+        pulse = 0
+        # Alternate between overload and idle reports so the window
+        # escalates and relaxes repeatedly while messages keep flowing.
+        for cycle in range(300):
+            m = msg(pulse * PULSE_NS, value=value)
+            sent.append(value)
+            value += 1
+            pulse += 1
+            out = batcher.batch([m])
+            if out is not None:
+                received.extend(x.value for x in out.messages)
+                factor = 1.5 if (cycle // 40) % 2 == 0 else 0.05
+                batcher.report_processing_time(
+                    Duration(round(out.window.ns * factor))
+                )
+        # Drain with far-future traffic.
+        for i in range(10):
+            out = batcher.batch([msg((pulse + 200 + i * 100) * PULSE_NS, value=-1)])
+            if out is not None:
+                received.extend(
+                    x.value for x in out.messages if x.value != -1
+                )
+        assert sorted(received) == sent
