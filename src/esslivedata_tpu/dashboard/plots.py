@@ -76,6 +76,11 @@ class PlotParams:
     overlay: bool = False
     robust: bool = False  # percentile color scaling (hot-pixel clip)
     flatten_split: int = 1  # leading dims -> Y for the flatten plotter
+    #: Static marker overlays (reference static_plots.py): draw a
+    #: vertical/horizontal reference line at this data coordinate —
+    #: an elastic line, a threshold, a Bragg position.
+    vline: float | None = None
+    hline: float | None = None
 
     @classmethod
     def from_dict(cls, raw: dict | None) -> "PlotParams":
@@ -113,6 +118,8 @@ class PlotParams:
             cmap=str(raw.get("cmap", "viridis")),
             vmin=_f("vmin"),
             vmax=_f("vmax"),
+            vline=_f("vline"),
+            hline=_f("hline"),
             extractor=extractor,
             window_s=_f("window_s"),
             plotter=plotter,
@@ -166,6 +173,10 @@ class PlotParams:
             out["slice"] = self.slice
         if self.overlay:
             out["overlay"] = "1"
+        if self.vline is not None:
+            out["vline"] = self.vline
+        if self.hline is not None:
+            out["hline"] = self.hline
         if self.robust:
             out["robust"] = "1"
         if self.flatten_split != 1:
@@ -228,6 +239,13 @@ class PlotParams:
             ax.set_yscale("log")
         if self.vmin is not None or self.vmax is not None:
             ax.set_ylim(bottom=self.vmin, top=self.vmax)
+
+    def _apply_markers(self, ax) -> None:
+        """Static reference-line overlays, drawn over ANY plotter."""
+        if self.vline is not None:
+            ax.axvline(self.vline, color="#d32f2f", lw=1.0, ls="--")
+        if self.hline is not None:
+            ax.axhline(self.hline, color="#d32f2f", lw=1.0, ls="--")
 
 # matplotlib's pyplot state is not thread-safe; the dashboard renders from
 # request handlers + ingestion threads.
@@ -643,7 +661,9 @@ def render_png_with_meta(
         fig, ax = plt.subplots(figsize=figsize, dpi=dpi)
         try:
             plotter = plotter or plotter_registry.select(da)
-            plotter.plot(ax, da, params or PlotParams())
+            effective = params or PlotParams()
+            plotter.plot(ax, da, effective)
+            effective._apply_markers(ax)
             if title:
                 fig.suptitle(title, fontsize=9)
             fig.tight_layout()

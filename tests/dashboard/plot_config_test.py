@@ -504,3 +504,11 @@ class FlattenHttpTest(PlotConfigHttpTest):
         assert r.code == 200
         payload = json.loads(r.body)  # strict parse must succeed
         assert payload["values"] == [1.0, None, None, 4.0]
+
+    def test_reference_line_markers(self):
+        state = self._start_and_wait()
+        kid = self._kid(state, "spectrum_current")
+        r = self.fetch(f"/plot/{kid}.png?vline=3.5e7&hline=10")
+        assert r.code == 200 and r.body[:4] == b"\x89PNG"
+        params = PlotParams.from_dict({"vline": "3.5e7", "hline": 10})
+        assert PlotParams.from_dict(params.to_dict()) == params
