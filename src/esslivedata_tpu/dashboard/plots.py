@@ -81,6 +81,10 @@ class PlotParams:
     #: an elastic line, a threshold, a Bragg position.
     vline: float | None = None
     hline: float | None = None
+    #: Poisson error bars (sqrt N) on 1-D count spectra — the streaming
+    #: stand-in for scipp's carried variances: counts are Poisson, so
+    #: the statistical uncertainty is derivable at render time.
+    errorbars: bool = False
 
     @classmethod
     def from_dict(cls, raw: dict | None) -> "PlotParams":
@@ -112,6 +116,7 @@ class PlotParams:
         slice_raw = raw.get("slice")
         overlay = raw.get("overlay") in (True, "1", 1, "true")
         robust = raw.get("robust") in (True, "1", 1, "true")
+        errorbars = raw.get("errorbars") in (True, "1", 1, "true")
         split_raw = raw.get("flatten_split")
         params = cls(
             scale=scale,
@@ -126,6 +131,7 @@ class PlotParams:
             slice=None if slice_raw in (None, "", "null") else int(slice_raw),
             overlay=overlay,
             robust=robust,
+            errorbars=errorbars,
             flatten_split=1 if split_raw in (None, "", "null") else int(split_raw),
         )
         # Bounds that would blow up at render time are config errors:
@@ -179,6 +185,8 @@ class PlotParams:
             out["hline"] = self.hline
         if self.robust:
             out["robust"] = "1"
+        if self.errorbars:
+            out["errorbars"] = "1"
         if self.flatten_split != 1:
             out["flatten_split"] = self.flatten_split
         return out
@@ -279,6 +287,17 @@ class LinePlotter:
         x, label = _coord_values(da, dim)
         y = np.asarray(da.values, dtype=np.float64)
         _draw_1d(ax, x, y)
+        if params.errorbars and str(da.unit) == "counts":
+            # Poisson: sigma = sqrt(N), drawn at bin centers.
+            centers = (x[:-1] + x[1:]) / 2.0 if x.size == y.size + 1 else x[: y.size]
+            ax.errorbar(
+                centers,
+                y,
+                yerr=np.sqrt(np.maximum(y, 0.0)),
+                fmt="none",
+                ecolor="#00000055",
+                elinewidth=0.8,
+            )
         params._apply_y(ax)
         ax.set_xlabel(label)
         ax.set_ylabel(f"[{da.unit!r}]")

@@ -887,6 +887,7 @@ const CELL_CONFIG_FIELDS = [
   {{key: 'slice', kind: 'number', hint: 'leading-dim index (slicer)'}},
   {{key: 'overlay', kind: 'checkbox', hint: 'layer all outputs in one axes'}},
   {{key: 'robust', kind: 'checkbox', hint: 'percentile color range (clip hot pixels)'}},
+  {{key: 'errorbars', kind: 'checkbox', hint: 'Poisson sqrt(N) error bars (count spectra)'}},
   {{key: 'vline', kind: 'number', hint: 'vertical reference line (data x)'}},
   {{key: 'hline', kind: 'number', hint: 'horizontal reference line (data y)'}},
   {{key: 'flatten_split', kind: 'number', hint: 'leading dims onto Y (flatten plotter)'}},

@@ -512,3 +512,11 @@ class FlattenHttpTest(PlotConfigHttpTest):
         assert r.code == 200 and r.body[:4] == b"\x89PNG"
         params = PlotParams.from_dict({"vline": "3.5e7", "hline": 10})
         assert PlotParams.from_dict(params.to_dict()) == params
+
+    def test_poisson_errorbars_render(self):
+        state = self._start_and_wait()
+        kid = self._kid(state, "spectrum_current")
+        r = self.fetch(f"/plot/{kid}.png?errorbars=1")
+        assert r.code == 200 and r.body[:4] == b"\x89PNG"
+        params = PlotParams.from_dict({"errorbars": "1"})
+        assert params.errorbars and params.to_dict()["errorbars"] == "1"
