@@ -103,6 +103,11 @@ class ServiceStatus(BaseModel):
     last_batch_message_count: int = 0
     stream_message_counts: dict[str, int] = Field(default_factory=dict)
     uptime_s: float = 0.0
+    #: Worst stream-lag level at the last batch ('ok'/'warning'/'error')
+    #: and the worst data-time lag in seconds — the operator's first
+    #: clue that a service is falling behind its streams.
+    lag_level: str = "ok"
+    worst_lag_s: float = 0.0
 
 
 class JobResult:

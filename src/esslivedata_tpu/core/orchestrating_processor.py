@@ -183,6 +183,7 @@ class OrchestratingProcessor:
         self._last_batch_len = 0
         self._finalized = False
         self.last_lag_report = StreamLagReport()
+        self._lag_report_wall_ns = time.time_ns()
         from ..utils.profiling import StageTimer
 
         self.stage_timer = StageTimer()
@@ -250,6 +251,33 @@ class OrchestratingProcessor:
             for name in {m.stream.name for m in batch.messages}
         ]
         self.last_lag_report = StreamLagReport(lags=lags)
+        self._lag_report_wall_ns = now_ns
+
+    def _current_lag_report(self) -> StreamLagReport:
+        """The last report AGED to now: a stream that stopped producing
+        has its staleness grow with the silence (a frozen snapshot would
+        report 'ok' forever on a fully stalled stream — the worst case),
+        and a future-timestamped error relaxes as the wall clock catches
+        up with the data."""
+        if not self.last_lag_report.lags:
+            return self.last_lag_report
+        age_s = (time.time_ns() - self._lag_report_wall_ns) / 1e9
+        return StreamLagReport(
+            lags=[
+                StreamLag(
+                    stream_name=lag.stream_name,
+                    lag_s=lag.lag_s + age_s,
+                    min_s=(
+                        None if lag.min_s is None else lag.min_s + age_s
+                    ),
+                    max_s=(
+                        None if lag.max_s is None else lag.max_s + age_s
+                    ),
+                    count=lag.count,
+                )
+                for lag in self.last_lag_report.lags
+            ]
+        )
 
     # -- publishing -------------------------------------------------------
     def _publish_results(
@@ -297,6 +325,17 @@ class OrchestratingProcessor:
             last_batch_message_count=self._last_batch_len,
             stream_message_counts=dict(self._preprocessor.message_counts),
             uptime_s=self._clock() - self._start_wall,
+            lag_level=(report := self._current_lag_report()).worst_level,
+            # The badge number must describe the lag that SET the level,
+            # not an unrelated healthy stream's.
+            worst_lag_s=max(
+                (
+                    abs(lag.lag_s)
+                    for lag in report.lags
+                    if lag.level != "ok"
+                ),
+                default=0.0,
+            ),
         )
 
     def _publish_status(self, state: str = "running") -> None:
@@ -329,7 +368,7 @@ class OrchestratingProcessor:
             "service": self._service_name,
             "jobs": self._job_manager.n_jobs,
             "stream_counts": dict(self._preprocessor.message_counts),
-            "lag_level": self.last_lag_report.worst_level,
+            "lag_level": self._current_lag_report().worst_level,
         }
         try:
             from ..utils.profiling import device_memory_stats

@@ -118,6 +118,8 @@ class StateHandler(_Base):
                         "stream_message_counts": (
                             s.status.stream_message_counts
                         ),
+                        "lag_level": s.status.lag_level,
+                        "worst_lag_s": s.status.worst_lag_s,
                     }
                     for s in js.services()
                 ],
@@ -692,6 +694,7 @@ _PAGE = """<!DOCTYPE html>
  button {{ margin: 2px; }}
  .job {{ font-size: 12px; margin: 4px 0; }}
  .state-active {{ color: #0a7d32; }} .state-error {{ color: #b00020; }}
+ .state-warning {{ color: #b7791f; }}
  #toasts {{ position: fixed; bottom: 12px; right: 12px; width: 320px; }}
  .toast {{ padding: 8px 12px; margin-top: 6px; border-radius: 6px; color: #fff;
            font-size: 13px; opacity: .95; }}
@@ -1423,6 +1426,12 @@ async function refresh() {{
   for (const sv of s.services) {{
     const d = document.createElement('div'); d.className = 'job';
     d.textContent = `${{sv.service_id}}: ${{sv.state}}` + (sv.stale ? ' (stale)' : '');
+    if (sv.lag_level && sv.lag_level !== 'ok') {{
+      d.appendChild(el(
+        'span',
+        sv.lag_level === 'warning' ? 'state-warning' : 'state-error',
+        ` lag ${{sv.lag_level}} (${{Number(sv.worst_lag_s).toFixed(1)}}s)`));
+    }}
     svcs.appendChild(d);
   }}
   const dr = await fetch('/api/devices'); const dd = await dr.json();

@@ -351,3 +351,70 @@ class TestFinalStatusForNicos:
         assert job_docs
         assert all(code == NicosStatus.DISABLED for code, _ in job_docs)
         assert all(p.state == "stopped" for _, p in job_docs)
+
+
+class TestLagInHeartbeat:
+    def test_stale_stream_raises_lag_level_in_status(self):
+        # Data timestamped far in the past reads as stale at batch close:
+        # the heartbeat must carry lag_level for the dashboard badge.
+        builder = make_detector_service_builder(
+            instrument="dummy",
+            batcher=NaiveMessageBatcher(),
+            job_threads=1,
+            heartbeat_interval_s=0.0,  # publish a heartbeat every step
+        )
+        raw = PulsedRawSource([])
+        producer = FakeProducer()
+        sink = KafkaSink(
+            producer,
+            make_default_serializer(builder.stream_mapping.livedata, "lg"),
+        )
+        service = builder.from_raw_source(raw, sink)
+        config = WorkflowConfig(
+            identifier=DETECTOR_VIEW_HANDLE.workflow_id,
+            job_id=JobId(source_name="panel_0"),
+        )
+        raw.inject(
+            FakeKafkaMessage(
+                json.dumps(
+                    {
+                        "kind": "start_job",
+                        "config": config.model_dump(mode="json"),
+                    }
+                ).encode(),
+                "dummy_livedata_commands",
+            )
+        )
+        service.step()
+        det = INSTRUMENT.detectors["panel_0"]
+        ids = det.detector_number.reshape(-1)[:100].astype(np.int32)
+        # One hour stale: well past the 2 s WARN threshold.
+        import time
+
+        t_stale = time.time_ns() - 3_600 * 10**9
+        payload = wire.encode_ev44(
+            det.source_name,
+            0,
+            np.array([t_stale]),
+            np.array([0]),
+            np.arange(100, dtype=np.int32),
+            pixel_id=ids,
+        )
+        raw.inject(FakeKafkaMessage(payload, "dummy_detector"))
+        service.step()
+        service.step()
+        from esslivedata_tpu.core.job import ServiceStatus
+        from esslivedata_tpu.kafka.nicos_status import decode_status
+
+        service_docs = []
+        for m in producer.messages:
+            if not m.topic.endswith("_status"):
+                continue
+            _code, payload, _sid = decode_status(m.value)
+            if isinstance(payload, ServiceStatus):
+                service_docs.append(payload)
+        assert service_docs, "no service heartbeat decoded"
+        assert any(
+            p.lag_level in ("warning", "error") for p in service_docs
+        )
+        assert max(p.worst_lag_s for p in service_docs) > 100.0
