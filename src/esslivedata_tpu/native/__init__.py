@@ -76,6 +76,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         i32p, f32p, i64, i32p, i64,
         ctypes.c_int32, ctypes.c_int32, f32, f32, f32, ctypes.c_int32, i32p,
     ]
+    lib.ld_flatten_nonuniform.restype = None
+    lib.ld_flatten_nonuniform.argtypes = [
+        i32p, f32p, i64, i32p, i64,
+        ctypes.c_int32, ctypes.c_int32, f32p, ctypes.c_int32, i32p,
+    ]
     lib.ld_staging_new.argtypes = [i64]
     lib.ld_staging_free.restype = None
     lib.ld_staging_free.argtypes = [vp]
@@ -280,13 +285,16 @@ def flatten_events(
     hi: float,
     inv_width: float,
     dump: int,
+    edges=None,
 ):
     """Native event -> flat-bin projection (see ingest.cpp ld_flatten).
 
     Returns the int32 flat-index array, or None when the native library is
     unavailable (caller falls back to the numpy path). Inputs must be
     contiguous int32/float32 arrays; ``lut`` a contiguous 1-D int32 map or
-    None.
+    None. Passing ``edges`` (float32, n_toa + 1 entries) selects the
+    non-uniform binning kernel (binary search, same float32 edges the
+    device path bins with).
     """
     lib = load_library()
     if lib is None:
@@ -306,6 +314,23 @@ def flatten_events(
     else:
         lut_ptr = None
         n_pix = 0
+    if edges is not None:
+        edges = np.ascontiguousarray(edges, dtype=np.float32)
+        if edges.shape[0] != n_toa + 1:
+            raise ValueError("edges must have n_toa + 1 entries")
+        lib.ld_flatten_nonuniform(
+            pixel_id.ctypes.data_as(i32p),
+            toa.ctypes.data_as(f32p),
+            n,
+            lut_ptr,
+            n_pix,
+            n_screen,
+            n_toa,
+            edges.ctypes.data_as(f32p),
+            dump,
+            out.ctypes.data_as(i32p),
+        )
+        return out
     lib.ld_flatten(
         pixel_id.ctypes.data_as(i32p),
         toa.ctypes.data_as(f32p),

@@ -348,4 +348,49 @@ void ld_flatten(const int32_t* pixel, const float* toa, int64_t n,
   }
 }
 
+// Non-uniform TOA edges: branch-light binary search over float32 edges
+// (the SAME dtype the device path bins with — host and device must be
+// bit-identical at bin boundaries). edges has n_toa + 1 entries,
+// strictly increasing; bin semantics mirror np.searchsorted(side
+// "right") - 1 as used by flatten_host's numpy fallback.
+void ld_flatten_nonuniform(const int32_t* pixel, const float* toa,
+                           int64_t n, const int32_t* lut, int64_t n_pix,
+                           int32_t n_screen, int32_t n_toa,
+                           const float* edges, int32_t dump,
+                           int32_t* out) {
+  const float lo = edges[0];
+  const float hi = edges[n_toa];
+  for (int64_t i = 0; i < n; ++i) {
+    float t = toa[i];
+    int32_t p = pixel[i];
+    // upper_bound(edges, t) - 1
+    int32_t left = 0, right = n_toa + 1;
+    while (left < right) {
+      int32_t mid = (left + right) >> 1;
+      if (edges[mid] <= t) {
+        left = mid + 1;
+      } else {
+        right = mid;
+      }
+    }
+    int32_t tb = left - 1;
+    bool ok = (t >= lo) & (t < hi) & (tb >= 0) & (tb < n_toa);
+    if (tb >= n_toa) tb = n_toa - 1;
+    if (tb < 0) tb = 0;
+    int32_t screen;
+    if (lut != nullptr) {
+      if (p >= 0 && p < n_pix) {
+        screen = lut[p];
+      } else {
+        screen = -1;
+      }
+      ok = ok & (screen >= 0);
+    } else {
+      screen = p;
+      ok = ok & (p >= 0) & (p < n_screen);
+    }
+    out[i] = ok ? screen * n_toa + tb : dump;
+  }
+}
+
 }  // extern "C"

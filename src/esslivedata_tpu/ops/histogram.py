@@ -522,25 +522,25 @@ class EventHistogrammer:
             raise ValueError("bin space exceeds int32 flat indexing")
         pixel_id = np.asarray(pixel_id)
         toa = np.asarray(toa, dtype=np.float32)
-        if self._proj.uniform:
-            try:
-                from ..native import flatten_events
-            except ImportError:
-                flatten_events = None
-            if flatten_events is not None:
-                out = flatten_events(
-                    pixel_id,
-                    toa,
-                    lut=None if lut_host is None else lut_host[0],
-                    n_screen=self._n_screen,
-                    n_toa=self._n_toa,
-                    lo=self._proj.lo,
-                    hi=self._proj.hi,
-                    inv_width=self._proj.inv_width,
-                    dump=self._n_bins,
-                )
-                if out is not None:
-                    return out
+        try:
+            from ..native import flatten_events
+        except ImportError:
+            flatten_events = None
+        if flatten_events is not None:
+            out = flatten_events(
+                pixel_id,
+                toa,
+                lut=None if lut_host is None else lut_host[0],
+                n_screen=self._n_screen,
+                n_toa=self._n_toa,
+                lo=self._proj.lo,
+                hi=self._proj.hi,
+                inv_width=self._proj.inv_width,
+                dump=self._n_bins,
+                edges=None if self._proj.uniform else self._edges_f32,
+            )
+            if out is not None:
+                return out
         proj = self._proj
         if proj.uniform:
             tb = (toa - np.float32(proj.lo)) * np.float32(proj.inv_width)

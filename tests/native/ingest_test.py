@@ -201,3 +201,50 @@ class TestNativeFlatten:
             n_screen=4,
             pixel_weights=np.array([1.0, 2.0], dtype=np.float32),
         ).supports_host_flatten
+
+
+class TestNonUniformFlatten:
+    def test_matches_numpy_searchsorted_bit_exact(self):
+        from esslivedata_tpu.native import flatten_events
+
+        if flatten_events is None:
+            pytest.skip("native library unavailable")
+        rng = np.random.default_rng(0)
+        # Irregular edges incl. a fractional boundary (the adversarial
+        # float32 case host/device parity hinges on).
+        edges64 = np.array([0.0, 1e7 + 0.3, 2.5e7, 4.1e7, 7.1e7])
+        edges32 = edges64.astype(np.float32)
+        n_toa = 4
+        n = 20_000
+        pid = rng.integers(0, 16, n).astype(np.int32)
+        toa = rng.uniform(-1e6, 7.3e7, n).astype(np.float32)
+        toa[:3] = edges32[1]  # exact-boundary salt
+        out = flatten_events(
+            pid, toa, lut=None, n_screen=16, n_toa=n_toa,
+            lo=float(edges64[0]), hi=float(edges64[-1]),
+            inv_width=0.0, dump=16 * n_toa, edges=edges32,
+        )
+        # Reference: numpy float32 searchsorted, identical to the jitted
+        # device path's binning.
+        tb = np.searchsorted(edges32, toa, side="right").astype(np.int32) - 1
+        ok = (
+            (toa >= edges32[0]) & (toa < edges32[-1])
+            & (tb >= 0) & (tb < n_toa) & (pid >= 0) & (pid < 16)
+        )
+        expected = np.where(
+            ok, pid * n_toa + np.clip(tb, 0, n_toa - 1), 16 * n_toa
+        ).astype(np.int32)
+        np.testing.assert_array_equal(out, expected)
+
+    def test_histogrammer_uses_native_for_nonuniform(self):
+        from esslivedata_tpu.ops import EventBatch
+        from esslivedata_tpu.ops.histogram import EventHistogrammer
+
+        edges = np.array([0.0, 1e7, 2.5e7, 7.1e7])
+        h = EventHistogrammer(toa_edges=edges, n_screen=8)
+        rng = np.random.default_rng(1)
+        pid = rng.integers(0, 8, 5000).astype(np.int32)
+        toa = rng.uniform(0, 7.1e7, 5000).astype(np.float32)
+        s_dev = h.step(h.init_state(), EventBatch.from_arrays(pid, toa))
+        s_host = h.step_flat(h.init_state(), h.flatten_host(pid, toa))
+        np.testing.assert_array_equal(h.read(s_dev)[1], h.read(s_host)[1])
