@@ -521,6 +521,17 @@ class EventHistogrammer:
         if self._n_bins >= np.iinfo(np.int32).max:
             raise ValueError("bin space exceeds int32 flat indexing")
         pixel_id = np.asarray(pixel_id)
+        if pixel_id.dtype != np.int32:
+            # A wider dtype can hold ids beyond int32; the native path
+            # (and the device path) work in int32, so map anything
+            # unrepresentable to -1 (dump) BEFORE the cast — a silent
+            # wrap would count an invalid id into a real bin.
+            info = np.iinfo(np.int32)
+            pixel_id = np.where(
+                (pixel_id >= info.min) & (pixel_id <= info.max),
+                pixel_id,
+                -1,
+            ).astype(np.int32)
         toa = np.asarray(toa, dtype=np.float32)
         try:
             from ..native import flatten_events

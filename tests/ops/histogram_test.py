@@ -363,3 +363,15 @@ class TestLazyDecay:
         cum, win = h.read(state)
         assert float(win.sum()) == pytest.approx(2.0)
         assert float(cum.sum()) == pytest.approx(4.0)  # folded EMA + new window
+
+
+def test_wide_pixel_ids_beyond_int32_are_dumped():
+    # int64 ids outside int32 must dump, not wrap into real bins.
+    edges = np.linspace(0.0, 10.0, 3)
+    h = EventHistogrammer(toa_edges=edges, n_screen=8)
+    pid = np.array([3, 2**32 + 5, -(2**33)], dtype=np.int64)
+    toa = np.full(3, 5.0, dtype=np.float32)
+    state = h.step_flat(h.init_state(), h.flatten_host(pid, toa))
+    cum, win = h.read(state)
+    assert win.sum() == 1.0  # only the genuine id lands
+    assert win[3].sum() == 1.0
