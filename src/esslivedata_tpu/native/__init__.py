@@ -230,7 +230,9 @@ class NativeStagingBuffer:
         return self._check(int(rc))
 
     def add(self, pixel_id: np.ndarray, toa: np.ndarray) -> None:
-        pixel_id = np.ascontiguousarray(pixel_id, dtype=np.int32)
+        from ..ops.event_batch import sanitize_pixel_id
+
+        pixel_id = np.ascontiguousarray(sanitize_pixel_id(pixel_id), dtype=np.int32)
         toa = np.ascontiguousarray(toa, dtype=np.float32)
         n = int(pixel_id.shape[0])
         if n == 0:
@@ -301,7 +303,9 @@ def flatten_events(
         return None
     import numpy as np
 
-    pixel_id = np.ascontiguousarray(pixel_id, dtype=np.int32)
+    from ..ops.event_batch import sanitize_pixel_id
+
+    pixel_id = np.ascontiguousarray(sanitize_pixel_id(pixel_id), dtype=np.int32)
     toa = np.ascontiguousarray(toa, dtype=np.float32)
     n = pixel_id.shape[0]
     out = np.empty(n, dtype=np.int32)

@@ -17,10 +17,37 @@ from dataclasses import dataclass
 
 import numpy as np
 
-__all__ = ["EventBatch", "StagingBuffer", "bucket_size", "make_staging_buffer"]
+__all__ = [
+    "EventBatch",
+    "StagingBuffer",
+    "bucket_size",
+    "make_staging_buffer",
+    "sanitize_pixel_id",
+]
 
 MIN_BUCKET = 1 << 12  # 4096: below this, padding waste is irrelevant
 MAX_BUCKET = 1 << 26  # 64M events per device batch
+
+
+def sanitize_pixel_id(pixel_id: np.ndarray) -> np.ndarray:
+    """Map ids unrepresentable in int32 to -1 before any int32 cast.
+
+    Every downstream consumer — the device kernel (JAX canonicalizes to
+    int32 with x64 disabled), the native C shims, and the numpy staging
+    arrays — works in int32 (ev44 pixel ids are already int32 on the
+    wire; wide dtypes come from non-ev44 callers passing int64/uint64
+    host arrays). A value outside int32 range would silently wrap
+    under those casts and count an invalid event into a real bin;
+    -1 is the universal out-of-range/dump marker instead. No copy for
+    inputs already safely castable.
+    """
+    pixel_id = np.asarray(pixel_id)
+    if np.can_cast(pixel_id.dtype, np.int32):
+        return pixel_id
+    info = np.iinfo(np.int32)
+    return np.where(
+        (pixel_id >= info.min) & (pixel_id <= info.max), pixel_id, -1
+    ).astype(np.int32)
 
 
 def bucket_size(n: int, min_bucket: int = MIN_BUCKET) -> int:
@@ -60,6 +87,7 @@ class EventBatch:
         toa: np.ndarray,
         min_bucket: int = MIN_BUCKET,
     ) -> EventBatch:
+        pixel_id = sanitize_pixel_id(pixel_id)
         n = int(pixel_id.shape[0])
         b = bucket_size(n, min_bucket)
         pid = np.full(b, -1, dtype=np.int32)
@@ -107,6 +135,7 @@ class StagingBuffer:
             raise RuntimeError(
                 "StagingBuffer.add called before release() of the last batch"
             )
+        pixel_id = sanitize_pixel_id(pixel_id)
         k = int(pixel_id.shape[0])
         if k == 0:
             return

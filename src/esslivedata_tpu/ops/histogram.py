@@ -52,7 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .event_batch import EventBatch, dispatch_safe
+from .event_batch import EventBatch, dispatch_safe, sanitize_pixel_id
 
 __all__ = ["EventHistogrammer", "EventProjection", "HistogramState"]
 
@@ -470,6 +470,10 @@ class EventHistogrammer:
         self, state: HistogramState, pixel_id, toa
     ) -> HistogramState:
         """Accumulate from already-device-resident (or padded host) arrays."""
+        if isinstance(pixel_id, np.ndarray):
+            # Host arrays may carry wire dtypes (int64 ev44 ids); device
+            # arrays are already int32 by construction.
+            pixel_id = sanitize_pixel_id(pixel_id)
         return self._step(state, dispatch_safe(pixel_id), dispatch_safe(toa))
 
     def step_batch(self, state: HistogramState, batch: EventBatch) -> HistogramState:
@@ -520,18 +524,7 @@ class EventHistogrammer:
             raise ValueError("flatten_host does not support replica LUTs")
         if self._n_bins >= np.iinfo(np.int32).max:
             raise ValueError("bin space exceeds int32 flat indexing")
-        pixel_id = np.asarray(pixel_id)
-        if pixel_id.dtype != np.int32:
-            # A wider dtype can hold ids beyond int32; the native path
-            # (and the device path) work in int32, so map anything
-            # unrepresentable to -1 (dump) BEFORE the cast — a silent
-            # wrap would count an invalid id into a real bin.
-            info = np.iinfo(np.int32)
-            pixel_id = np.where(
-                (pixel_id >= info.min) & (pixel_id <= info.max),
-                pixel_id,
-                -1,
-            ).astype(np.int32)
+        pixel_id = sanitize_pixel_id(pixel_id)
         toa = np.asarray(toa, dtype=np.float32)
         try:
             from ..native import flatten_events

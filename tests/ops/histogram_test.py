@@ -375,3 +375,25 @@ def test_wide_pixel_ids_beyond_int32_are_dumped():
     cum, win = h.read(state)
     assert win.sum() == 1.0  # only the genuine id lands
     assert win[3].sum() == 1.0
+
+
+def test_wide_pixel_ids_dump_on_every_ingest_path():
+    # The device path (weighted config: host flatten unsupported) and the
+    # staging paths must dump out-of-int32 ids, not wrap them.
+    edges = np.linspace(0.0, 10.0, 2)
+    weights = np.ones(8, dtype=np.float32)
+    h = EventHistogrammer(toa_edges=edges, n_screen=8, pixel_weights=weights)
+    assert not h.supports_host_flatten
+    pid = np.array([3, 2**32 + 5], dtype=np.int64)
+    toa = np.full(2, 5.0, dtype=np.float32)
+    state = h.step(h.init_state(), EventBatch.from_arrays(pid, toa, min_bucket=8))
+    assert float(h.read(state)[1].sum()) == 1.0
+    state = h.step_arrays(
+        h.init_state(),
+        np.where(pid > 2**31, pid, -1),  # padless raw-array path
+        toa,
+    )
+    assert float(h.read(state)[1].sum()) == 0.0
+    buf = StagingBuffer(min_bucket=8)
+    buf.add(pid, toa)
+    assert (buf.take().pixel_id[:2] == [3, -1]).all()
