@@ -46,10 +46,19 @@ def make_monitor(*, source_name: str, params) -> MonitorWorkflow:
 @SANS_IQ_HANDLE.attach_factory
 def make_sans_iq(*, source_name: str, params, aux_source_names=None) -> SansIQWorkflow:
     det = INSTRUMENT.detectors[source_name]
+    aux = aux_source_names or {}
+    # Transmission only runs when the aux slot is bound: with no binding
+    # there is no second monitor to ratio against, fraction stays 1.
+    transmission = (
+        {aux["transmission_monitor"]} if "transmission_monitor" in aux else None
+    )
+    # An unbound incident slot falls back to all monitors MINUS the
+    # transmission stream — counting it on both channels would inflate
+    # the incident denominator and skew T.
     monitors = (
-        {aux_source_names["monitor"]}
-        if aux_source_names and "monitor" in aux_source_names
-        else set(INSTRUMENT.monitor_names)
+        {aux["monitor"]}
+        if "monitor" in aux
+        else set(INSTRUMENT.monitor_names) - (transmission or set())
     )
     return SansIQWorkflow(
         positions=det.positions,
@@ -57,6 +66,7 @@ def make_sans_iq(*, source_name: str, params, aux_source_names=None) -> SansIQWo
         params=params,
         primary_stream=source_name,
         monitor_streams=monitors,
+        transmission_streams=transmission,
     )
 
 

@@ -109,13 +109,17 @@ SANS_IQ_HANDLE = workflow_registry.register_spec(
         name="iq",
         title="Monitor-normalized I(Q)",
         source_names=INSTRUMENT.detector_names,
-        aux_source_names={"monitor": INSTRUMENT.monitor_names},
+        aux_source_names={
+            "monitor": INSTRUMENT.monitor_names,
+            "transmission_monitor": INSTRUMENT.monitor_names,
+        },
         params_model=SansIQParams,
         outputs={
             "iq_current": OutputSpec(title="I(Q) (window)"),
             "iq_cumulative": OutputSpec(title="I(Q) (since start)", view="since_start"),
             "counts_q_current": OutputSpec(title="Q counts (window)"),
             "monitor_counts_current": OutputSpec(title="Monitor counts"),
+            "transmission_current": OutputSpec(title="Transmission fraction"),
         },
     )
 )

@@ -33,7 +33,20 @@ def latest_sample_value(sample: Any) -> float | None:
 
 class QStreamingMixin:
     """Requires ``_hist`` (QHistogrammer), ``_state``, ``_primary_stream``,
-    ``_monitor_streams`` and ``_publish = None`` set by the subclass."""
+    ``_monitor_streams`` and ``_publish = None`` set by the subclass.
+
+    An optional second monitor channel (``_transmission_streams``, e.g.
+    the SANS transmission monitor, reference loki/specs.py:96) is counted
+    host-side: event *counts* are already host data before staging, so a
+    scalar channel needs no device round trip. The counters mirror the
+    device monitor channel's fold semantics exactly — window zeroed at
+    each publish fold, cumulative monotone — so the two channels stay
+    comparable across windows.
+    """
+
+    _transmission_streams: frozenset[str] = frozenset()
+    _trans_win: float = 0.0
+    _trans_cum: float = 0.0
 
     def accumulate(self, data: Mapping[str, Any]) -> None:
         monitor_count = 0.0
@@ -41,9 +54,15 @@ class QStreamingMixin:
         for key, value in data.items():
             if not isinstance(value, StagedEvents):
                 continue
+            is_trans = key in self._transmission_streams
+            if is_trans:
+                self._trans_win += float(value.n_events)
+                self._trans_cum += float(value.n_events)
             if key in self._monitor_streams:
                 monitor_count += float(value.n_events)
-            elif self._primary_stream is None or key == self._primary_stream:
+            elif not is_trans and (
+                self._primary_stream is None or key == self._primary_stream
+            ):
                 detector = value.batch
         if detector is not None or monitor_count:
             if detector is None:
@@ -77,5 +96,14 @@ class QStreamingMixin:
             float(out["mon_cum"]),
         )
 
+    def _take_transmission(self) -> tuple[float, float]:
+        """(window, cumulative) transmission-monitor counts; folds the
+        window (zeroes it) like ``_take_publish`` folds the device state."""
+        win = self._trans_win
+        self._trans_win = 0.0
+        return win, self._trans_cum
+
     def clear(self) -> None:
         self._state = self._hist.clear()
+        self._trans_win = 0.0
+        self._trans_cum = 0.0

@@ -9,6 +9,8 @@ events (ADR-0002-style aux binding through WorkflowConfig.aux_source_names).
 
 from __future__ import annotations
 
+import enum
+
 import numpy as np
 from pydantic import BaseModel, ConfigDict, Field
 
@@ -17,7 +19,20 @@ from ..ops.qhistogram import QHistogrammer, build_sans_qmap
 from ..utils.labeled import DataArray, Variable
 from .qshared import QStreamingMixin
 
-__all__ = ["SansIQParams", "SansIQWorkflow"]
+__all__ = ["SansIQParams", "SansIQWorkflow", "TransmissionMode"]
+
+
+class TransmissionMode(str, enum.Enum):
+    """Live transmission correction (reference: loki/specs.py:38-61).
+
+    Only modes that need no separate empty-beam run are available live:
+    ``constant`` applies no correction (fraction = 1); ``current_run``
+    estimates the fraction as transmission-monitor / incident-monitor
+    counts within the current run.
+    """
+
+    constant = "constant"
+    current_run = "current_run"
 
 
 class SansIQParams(BaseModel):
@@ -30,6 +45,7 @@ class SansIQParams(BaseModel):
     toa_range: TOARange = Field(default_factory=TOARange)
     toa_offset_ns: float = 0.0  # emission-time correction
     l1: float = 23.0  # m, source->sample
+    transmission_mode: TransmissionMode = TransmissionMode.current_run
 
 
 class SansIQWorkflow(QStreamingMixin):
@@ -43,6 +59,7 @@ class SansIQWorkflow(QStreamingMixin):
         params: SansIQParams | None = None,
         primary_stream: str | None = None,
         monitor_streams: set[str] | None = None,
+        transmission_streams: set[str] | None = None,
     ) -> None:
         params = params or SansIQParams()
         self._params = params
@@ -65,10 +82,28 @@ class SansIQWorkflow(QStreamingMixin):
         self._q_edges_var = Variable(q_edges, ("Q",), "1/angstrom")
         self._primary_stream = primary_stream
         self._monitor_streams = monitor_streams or set()
+        self._transmission_streams = frozenset(transmission_streams or ())
         self._publish = None
 
-    def _iq(self, counts: np.ndarray, monitor: float) -> DataArray:
-        norm = counts / max(monitor, 1.0)
+    def _transmission_fraction(self, trans: float, incident: float) -> float:
+        """current_run estimate: raw transmission/incident monitor ratio.
+
+        Falls back to 1 (no correction) when either channel is empty.
+        The ratio is deliberately NOT clamped to 1: a value above 1
+        signals monitor efficiency/rate mismatch, which should be
+        visible in the published fraction rather than silently hidden.
+        """
+        if (
+            self._params.transmission_mode is not TransmissionMode.current_run
+            or not self._transmission_streams
+            or trans <= 0.0
+            or incident <= 0.0
+        ):
+            return 1.0
+        return trans / incident
+
+    def _iq(self, counts: np.ndarray, monitor: float, fraction: float) -> DataArray:
+        norm = counts / (max(monitor, 1.0) * fraction)
         return DataArray(
             Variable(norm, ("Q",), ""),
             coords={"Q": self._q_edges_var},
@@ -76,15 +111,21 @@ class SansIQWorkflow(QStreamingMixin):
 
     def finalize(self) -> dict[str, DataArray]:
         win, cum, mon_win, mon_cum = self._take_publish()
+        trans_win, trans_cum = self._take_transmission()
+        t_win = self._transmission_fraction(trans_win, mon_win)
+        t_cum = self._transmission_fraction(trans_cum, mon_cum)
         coords = {"Q": self._q_edges_var}
         return {
-            "iq_current": self._iq(win, mon_win),
-            "iq_cumulative": self._iq(cum, mon_cum),
+            "iq_current": self._iq(win, mon_win, t_win),
+            "iq_cumulative": self._iq(cum, mon_cum, t_cum),
             "counts_q_current": DataArray(
                 Variable(win, ("Q",), "counts"), coords=coords
             ),
             "monitor_counts_current": DataArray(
                 Variable(np.asarray(mon_win), (), "counts")
+            ),
+            "transmission_current": DataArray(
+                Variable(np.asarray(t_win), (), "")
             ),
         }
 

@@ -151,6 +151,98 @@ class TestSansWorkflow:
         assert float(out["monitor_counts_current"].values) == 50.0
 
 
+class TestTransmission:
+    def make(self, mode="current_run"):
+        ny = nx = 8
+        xs = np.linspace(-0.5, 0.5, nx)
+        gx, gy = np.meshgrid(xs, xs)
+        positions = np.stack(
+            [gx.reshape(-1), gy.reshape(-1), np.full(ny * nx, 5.0)], axis=1
+        )
+        return SansIQWorkflow(
+            positions=positions,
+            pixel_ids=np.arange(1, ny * nx + 1),
+            params=SansIQParams(q_bins=20, transmission_mode=mode),
+            primary_stream="larmor_detector",
+            monitor_streams={"monitor_1"},
+            transmission_streams={"monitor_2"},
+        )
+
+    def feed(self, wf, n_det=200, n_inc=400, n_trans=100):
+        rng = np.random.default_rng(2)
+        pid = rng.integers(1, 65, n_det).astype(np.int32)
+        toa = rng.uniform(1e6, 70e6, n_det).astype(np.float32)
+        data = {"larmor_detector": stage(pid, toa), "monitor_1": stage_monitor(n_inc)}
+        if n_trans:
+            data["monitor_2"] = stage_monitor(n_trans)
+        wf.accumulate(data)
+
+    def test_current_run_divides_by_fraction(self):
+        wf = self.make()
+        self.feed(wf, n_inc=400, n_trans=100)
+        out = wf.finalize()
+        assert float(out["transmission_current"].values) == pytest.approx(0.25)
+        counts = out["counts_q_current"].values.sum()
+        # I(Q) = counts / (incident * T) = counts / (400 * 0.25)
+        np.testing.assert_allclose(
+            out["iq_current"].values.sum(), counts / 100.0, rtol=1e-5
+        )
+
+    def test_constant_mode_is_uncorrected(self):
+        wf = self.make(mode="constant")
+        self.feed(wf)
+        out = wf.finalize()
+        assert float(out["transmission_current"].values) == 1.0
+        counts = out["counts_q_current"].values.sum()
+        np.testing.assert_allclose(
+            out["iq_current"].values.sum(), counts / 400.0, rtol=1e-5
+        )
+
+    def test_missing_transmission_stream_means_no_correction(self):
+        wf = self.make()
+        self.feed(wf, n_trans=0)
+        out = wf.finalize()
+        assert float(out["transmission_current"].values) == 1.0
+
+    def test_window_folds_but_cumulative_holds(self):
+        wf = self.make()
+        self.feed(wf, n_inc=400, n_trans=100)
+        wf.finalize()
+        # Second window: transmission monitor silent; window fraction
+        # falls back to 1 while the cumulative ratio is unchanged.
+        self.feed(wf, n_inc=400, n_trans=0)
+        out = wf.finalize()
+        assert float(out["transmission_current"].values) == 1.0
+        assert wf._take_transmission() == (0.0, 100.0)
+
+    def test_clear_resets_transmission(self):
+        wf = self.make()
+        self.feed(wf)
+        wf.clear()
+        assert wf._take_transmission() == (0.0, 0.0)
+
+    def test_transmission_stream_never_feeds_detector(self):
+        # A workflow with no primary stream must still not histogram the
+        # transmission monitor's events as detector events.
+        ny = nx = 8
+        xs = np.linspace(-0.5, 0.5, nx)
+        gx, gy = np.meshgrid(xs, xs)
+        positions = np.stack(
+            [gx.reshape(-1), gy.reshape(-1), np.full(ny * nx, 5.0)], axis=1
+        )
+        wf = SansIQWorkflow(
+            positions=positions,
+            pixel_ids=np.arange(1, ny * nx + 1),
+            params=SansIQParams(q_bins=20),
+            primary_stream=None,
+            monitor_streams={"monitor_1"},
+            transmission_streams={"monitor_2"},
+        )
+        wf.accumulate({"monitor_2": stage_monitor(100)})
+        out = wf.finalize()
+        assert out["counts_q_current"].values.sum() == 0
+
+
 class TestMultiBank:
     def make_banks(self, n_banks=3, ny=4, nx=4):
         banks = {}
@@ -219,3 +311,17 @@ class TestMultiBank:
         wf.clear()
         out = wf.finalize()
         assert float(out["counts_cumulative"].values) == 0.0
+
+
+def test_factory_default_monitors_exclude_transmission():
+    from esslivedata_tpu.config.instruments.loki.factories import make_sans_iq
+    from esslivedata_tpu.config.instruments.loki.specs import INSTRUMENT
+
+    det = next(iter(INSTRUMENT.detector_names))
+    wf = make_sans_iq(
+        source_name=det,
+        params=SansIQParams(q_bins=10),
+        aux_source_names={"transmission_monitor": "monitor_2"},
+    )
+    assert wf._monitor_streams == {"monitor_1"}
+    assert wf._transmission_streams == {"monitor_2"}
