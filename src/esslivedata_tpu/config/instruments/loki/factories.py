@@ -60,6 +60,13 @@ def make_sans_iq(*, source_name: str, params, aux_source_names=None) -> SansIQWo
         if "monitor" in aux
         else set(INSTRUMENT.monitor_names) - (transmission or set())
     )
+    if transmission and monitors & transmission:
+        # Same stream on both channels would make T identically 1 —
+        # vacuous but plausible-looking; refuse instead.
+        raise ValueError(
+            "incident and transmission monitor must be different streams; "
+            f"both bound to {sorted(monitors & transmission)}"
+        )
     return SansIQWorkflow(
         positions=det.positions,
         pixel_ids=det.pixel_ids,

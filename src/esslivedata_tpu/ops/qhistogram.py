@@ -90,15 +90,24 @@ def build_sans_qmap(
     q_edges: np.ndarray,  # 1/angstrom
     l1: float = 23.0,  # source->sample flight path (m)
     toa_offset_ns: float = 0.0,
+    beam_center: tuple[float, float] = (0.0, 0.0),  # (x, y) in m
 ) -> np.ndarray:
     """Precompile per-event physics into ``qmap[pixel, toa_bin]``.
 
     lambda[angstrom] = (h / m_n) * t / L  with t the time of flight and
     L = l1 + l2(pixel); Q = 4 pi sin(theta/2) / lambda with theta the
-    scattering angle off the +z beam axis. Entries mapping outside
-    ``q_edges`` are -1 (dropped by the kernel).
+    scattering angle off the +z beam axis. ``beam_center`` shifts the
+    full pixel position vector (the reference's BeamCenterXY,
+    loki/specs.py:63-85) so the beam axis passes through the measured
+    center — this moves both the scattering angle AND the l2 flight
+    path (hence the wavelength mapping), matching the convention of
+    reducing against beam-center-corrected positions. Entries mapping
+    outside ``q_edges`` are -1 (dropped by the kernel).
     """
     positions = np.asarray(positions, dtype=np.float64)
+    bx, by = beam_center
+    if bx or by:
+        positions = positions - np.array([bx, by, 0.0])
     l2 = np.linalg.norm(positions, axis=1)  # sample->pixel (m)
     r_perp = np.hypot(positions[:, 0], positions[:, 1])
     theta = np.arctan2(r_perp, positions[:, 2])  # scattering angle

@@ -46,6 +46,10 @@ class SansIQParams(BaseModel):
     toa_offset_ns: float = 0.0  # emission-time correction
     l1: float = 23.0  # m, source->sample
     transmission_mode: TransmissionMode = TransmissionMode.current_run
+    # Beam-center position on the detector (m); shifts the scattering-angle
+    # origin (reference: loki/specs.py BeamCenterXY).
+    beam_center_x: float = 0.0
+    beam_center_y: float = 0.0
 
 
 class SansIQWorkflow(QStreamingMixin):
@@ -74,6 +78,7 @@ class SansIQWorkflow(QStreamingMixin):
             q_edges=q_edges,
             l1=params.l1,
             toa_offset_ns=params.toa_offset_ns,
+            beam_center=(params.beam_center_x, params.beam_center_y),
         )
         self._hist = QHistogrammer(
             qmap=qmap, toa_edges=toa_edges, n_q=params.q_bins

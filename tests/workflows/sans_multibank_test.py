@@ -325,3 +325,57 @@ def test_factory_default_monitors_exclude_transmission():
     )
     assert wf._monitor_streams == {"monitor_1"}
     assert wf._transmission_streams == {"monitor_2"}
+
+
+class TestBeamCenter:
+    def test_shifted_center_restores_symmetry(self):
+        # Pixels at x = c +/- d are asymmetric about the origin but
+        # symmetric about the beam center: with the center supplied, both
+        # land in the same Q bin at every TOA.
+        c, d = 0.3, 0.1
+        positions = np.array([[c - d, 0.0, 5.0], [c + d, 0.0, 5.0]])
+        pixel_ids = np.array([1, 2])
+        toa_edges = np.linspace(0.0, 71e6, 51)
+        q_edges = np.linspace(0.005, 0.5, 101)
+        kw = dict(
+            positions=positions,
+            pixel_ids=pixel_ids,
+            toa_edges=toa_edges,
+            q_edges=q_edges,
+        )
+        off = build_sans_qmap(**kw)
+        on = build_sans_qmap(**kw, beam_center=(c, 0.0))
+        assert (on.table[0] == on.table[1]).all()
+        assert not (off.table[0] == off.table[1]).all()
+
+    def test_workflow_param_plumbs_through(self):
+        positions = np.array([[0.2, 0.0, 5.0]])
+        base = SansIQWorkflow(
+            positions=positions,
+            pixel_ids=np.array([1]),
+            params=SansIQParams(q_bins=50),
+        )
+        shifted = SansIQWorkflow(
+            positions=positions,
+            pixel_ids=np.array([1]),
+            params=SansIQParams(q_bins=50, beam_center_x=0.2),
+        )
+        # At the beam center theta=0 -> Q below q_min -> everything dumped.
+        assert (np.asarray(shifted._hist._qmap) == -1).all()
+        assert not (np.asarray(base._hist._qmap) == -1).all()
+
+
+def test_factory_rejects_same_stream_for_both_monitors():
+    from esslivedata_tpu.config.instruments.loki.factories import make_sans_iq
+    from esslivedata_tpu.config.instruments.loki.specs import INSTRUMENT
+
+    det = next(iter(INSTRUMENT.detector_names))
+    with pytest.raises(ValueError, match="different streams"):
+        make_sans_iq(
+            source_name=det,
+            params=SansIQParams(q_bins=10),
+            aux_source_names={
+                "monitor": "monitor_2",
+                "transmission_monitor": "monitor_2",
+            },
+        )
