@@ -60,6 +60,7 @@ __all__ = [
     "KafkaToMonitorEventsAdapter",
     "KafkaToRunControlAdapter",
     "MessageAdapter",
+    "NullAdapter",
     "RouteBySchemaAdapter",
     "RouteByTopicAdapter",
 ]
@@ -74,6 +75,19 @@ class MessageAdapter(Protocol):
 
 class UnroutedError(KeyError):
     """No route/stream mapping for a message."""
+
+
+class NullAdapter:
+    """Deliberate drop: the schema is known, expected on the topic, and
+    carries nothing we consume (reference: kafka/message_adapter.py:130).
+
+    Returning None (instead of raising UnroutedError) keeps expected
+    traffic — e.g. EPICS alarm/connection chatter interleaved with f144
+    on forwarder log topics — out of the unrouted-anomaly counter.
+    """
+
+    def adapt(self, message: KafkaMessage) -> None:
+        return None
 
 
 def _resolve(

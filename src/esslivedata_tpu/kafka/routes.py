@@ -13,6 +13,7 @@ from .message_adapter import (
     KafkaToMonitorEventsAdapter,
     KafkaToRunControlAdapter,
     MessageAdapter,
+    NullAdapter,
     RouteBySchemaAdapter,
     RouteByTopicAdapter,
 )
@@ -66,9 +67,19 @@ class RoutingAdapterBuilder:
         return self
 
     def with_logdata_route(self):
+        # Forwarder log topics interleave f144 numeric data with al00
+        # (alarm) and ep01 (connection status) for the same PVs
+        # (reference: kafka/routes.py:103-121); those are expected
+        # traffic, dropped deliberately rather than counted unrouted.
         self._add_topics(
             self._mapping.log_topics,
-            RouteBySchemaAdapter({"f144": KafkaToF144Adapter(self._mapping)}),
+            RouteBySchemaAdapter(
+                {
+                    "f144": KafkaToF144Adapter(self._mapping),
+                    "al00": NullAdapter(),
+                    "ep01": NullAdapter(),
+                }
+            ),
         )
         return self
 

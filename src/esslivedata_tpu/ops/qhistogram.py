@@ -91,8 +91,9 @@ def build_sans_qmap(
     l1: float = 23.0,  # source->sample flight path (m)
     toa_offset_ns: float = 0.0,
     beam_center: tuple[float, float] = (0.0, 0.0),  # (x, y) in m
-) -> np.ndarray:
-    """Precompile per-event physics into ``qmap[pixel, toa_bin]``.
+) -> PixelBinMap:
+    """Precompile per-event physics into a bank-local ``PixelBinMap``
+    (``table[pixel_id - id_base, toa_bin]``).
 
     lambda[angstrom] = (h / m_n) * t / L  with t the time of flight and
     L = l1 + l2(pixel); Q = 4 pi sin(theta/2) / lambda with theta the
@@ -136,7 +137,7 @@ def build_dspacing_map(
     toa_edges: np.ndarray,  # ns since pulse
     d_edges: np.ndarray,  # angstrom
     toa_offset_ns: float = 0.0,
-) -> np.ndarray:
+) -> PixelBinMap:
     """Precompile powder-diffraction physics into
     ``map[pixel, toa_bin] -> d bin``.
 
@@ -215,7 +216,7 @@ def build_qe_map(
     e_edges: np.ndarray,  # meV energy transfer (Ei - Ef)
     l1: float = 162.0,  # ESS source->sample for BIFROST
     toa_offset_ns: float = 0.0,
-) -> np.ndarray:
+) -> PixelBinMap:
     """Precompile indirect-geometry spectrometer physics into
     ``map[pixel, toa_bin] -> flat (Q, E) bin`` (row-major, ``n_e`` fast).
 

@@ -191,3 +191,42 @@ class TestRouting:
         assert source.get_messages() == []
         assert source.get_messages() == []
         assert source.error_count + source.unrouted_count == 200
+
+
+class TestNullAdapter:
+    def test_expected_epics_chatter_drops_without_counting(self, mapping):
+        """al00/ep01 interleave with f144 on forwarder log topics
+        (reference routes.py:103-121): known traffic, not an anomaly."""
+        from esslivedata_tpu.kafka.message_adapter import NullAdapter
+
+        router = RouteByTopicAdapter(
+            {
+                "dummy_motion": RouteBySchemaAdapter(
+                    {
+                        "f144": KafkaToF144Adapter(mapping),
+                        "al00": NullAdapter(),
+                        "ep01": NullAdapter(),
+                    }
+                )
+            }
+        )
+        # Hand-rolled minimal flatbuffer-framed payloads: only the schema
+        # identifier at bytes 4:8 matters for routing.
+        al00 = b"\x00\x00\x00\x00al00" + b"\x00" * 8
+        ep01 = b"\x00\x00\x00\x00ep01" + b"\x00" * 8
+        consumer = FakeConsumer(
+            [
+                [
+                    FakeKafkaMessage(al00, "dummy_motion"),
+                    FakeKafkaMessage(
+                        wire.encode_f144("mtr1", 1.0, 1), "dummy_motion"
+                    ),
+                    FakeKafkaMessage(ep01, "dummy_motion"),
+                ]
+            ]
+        )
+        source = AdaptingMessageSource(KafkaMessageSource(consumer), router)
+        messages = source.get_messages()
+        assert [m.stream.name for m in messages] == ["motor_x"]
+        assert source.unrouted_count == 0
+        assert source.error_count == 0
