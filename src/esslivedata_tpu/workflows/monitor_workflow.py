@@ -3,16 +3,18 @@
 Handles both monitor data modes like the reference (_histogram_monitor:65):
 event-mode (ev44 -> staged event batches -> 1-row device histogram) and
 histogram-mode (da00 dense histograms -> host rebin onto the target edges,
-accumulated with Cumulative). Outputs current/cumulative 1-D TOA spectra.
+accumulated with Cumulative). Outputs current/cumulative 1-D spectra on
+the configured coordinate: TOA (ns) or wavelength (angstrom) — the
+latter via the same device kernel over lambda-derived edges.
 """
 
 from __future__ import annotations
 
 from collections.abc import Mapping
-from typing import Any
+from typing import Any, Literal
 
 import numpy as np
-from pydantic import BaseModel, ConfigDict, Field
+from pydantic import BaseModel, ConfigDict, Field, model_validator
 
 from ..config.models import TOARange
 from ..ops.histogram import EventHistogrammer, HistogramState
@@ -29,6 +31,36 @@ class MonitorParams(BaseModel):
 
     toa_bins: int = 100
     toa_range: TOARange = Field(default_factory=TOARange)
+    # Coordinate mode (reference: monitor_workflow.py:169 coordinate_mode):
+    # "toa" histograms time-of-arrival; "wavelength" histograms
+    # lambda = (h/m_n) * t / L. lambda is linear in t for a fixed flight
+    # path, so wavelength mode is the SAME device kernel over transformed
+    # edges — no per-event conversion, no second code path on device.
+    coordinate: Literal["toa", "wavelength"] = "toa"
+    wavelength_min: float = 0.5  # angstrom (wavelength mode)
+    wavelength_max: float = 12.0
+    distance_m: float = 25.0  # source->monitor flight path (m)
+    toa_offset_ns: float = 0.0  # emission-time / frame offset correction
+
+    @model_validator(mode="after")
+    def _wavelength_mode_consistent(self) -> MonitorParams:
+        if self.wavelength_max <= self.wavelength_min:
+            raise ValueError("wavelength range must satisfy min < max")
+        if self.distance_m <= 0:
+            raise ValueError("distance_m must be positive")
+        if self.coordinate == "wavelength":
+            default = TOARange()
+            narrowed = self.toa_range.enabled and (
+                self.toa_range.low != default.low
+                or self.toa_range.high != default.high
+            )
+            if narrowed:
+                raise ValueError(
+                    "toa_range does not apply in wavelength mode — the "
+                    "spectrum is windowed by wavelength_min/max instead; "
+                    "reset toa_range or switch coordinate back to 'toa'"
+                )
+        return self
 
 
 def rebin_1d(
@@ -54,14 +86,32 @@ def rebin_1d(
 
 
 class MonitorWorkflow:
-    """1-D TOA histogram of a beam monitor, event- or histogram-mode."""
+    """1-D monitor spectrum (TOA or wavelength axis), event- or
+    histogram-mode."""
 
     def __init__(self, *, params: MonitorParams | None = None) -> None:
         params = params or MonitorParams()
         self._params = params
-        self._edges = np.linspace(
-            params.toa_range.low, params.toa_range.high, params.toa_bins + 1
-        )
+        if params.coordinate == "wavelength":
+            from ..ops.chopper_cascade import ALPHA_NS_PER_M_A
+
+            lam_edges = np.linspace(
+                params.wavelength_min, params.wavelength_max, params.toa_bins + 1
+            )
+            # t[ns] = ALPHA * L * lambda, shifted back by the emission
+            # offset so event TOA (not true TOF) bins correctly.
+            self._edges = (
+                lam_edges * params.distance_m * ALPHA_NS_PER_M_A
+                - params.toa_offset_ns
+            )
+            self._axis = "wavelength"
+            self._axis_var = Variable(lam_edges, ("wavelength",), "angstrom")
+        else:
+            self._edges = np.linspace(
+                params.toa_range.low, params.toa_range.high, params.toa_bins + 1
+            )
+            self._axis = "toa"
+            self._axis_var = Variable(self._edges, ("toa",), "ns")
         self._hist = EventHistogrammer(toa_edges=self._edges, n_screen=1)
         self._state: HistogramState = self._hist.init_state()
 
@@ -79,7 +129,6 @@ class MonitorWorkflow:
         # Dense-mode accumulation happens host-side (tiny arrays).
         self._dense_cumulative = np.zeros(params.toa_bins)
         self._dense_window = np.zeros(params.toa_bins)
-        self._edges_var = Variable(self._edges, ("toa",), "ns")
 
     def accumulate(self, data: Mapping[str, Any]) -> None:
         for value in data.values():
@@ -97,6 +146,11 @@ class MonitorWorkflow:
                 f"Histogram-mode monitor data needs a 1-D TOA coord, got {da!r}"
             )
         src_edges = da.coords[coord_name].to_unit("ns").numpy
+        if coord_name == "tof" and self._params.toa_offset_ns:
+            # True time-of-flight -> event-TOA space (our edges' frame):
+            # toa = tof - offset. Without this a nonzero offset would be
+            # applied twice for tof-coord dense data in wavelength mode.
+            src_edges = src_edges - self._params.toa_offset_ns
         values = np.asarray(da.values, dtype=np.float64)
         if src_edges.size == values.size:  # midpoints: synthesize edges
             mids = src_edges
@@ -118,13 +172,14 @@ class MonitorWorkflow:
         win = out["win"] + self._dense_window
         cum = out["cum"] + self._dense_cumulative
         self._dense_window = np.zeros_like(self._dense_window)
-        coords = {"toa": self._edges_var}
+        axis = self._axis
+        coords = {axis: self._axis_var}
         return {
             "current": DataArray(
-                Variable(win, ("toa",), "counts"), coords=coords, name="current"
+                Variable(win, (axis,), "counts"), coords=coords, name="current"
             ),
             "cumulative": DataArray(
-                Variable(cum, ("toa",), "counts"), coords=coords, name="cumulative"
+                Variable(cum, (axis,), "counts"), coords=coords, name="cumulative"
             ),
             "counts_current": DataArray(
                 Variable(np.asarray(win.sum()), (), "counts"), name="counts_current"

@@ -141,3 +141,131 @@ class TestAreaDetectorView:
         wf.accumulate({"cam": self.frame(1.0)})
         out = wf.finalize()
         assert out["current"].shape == (3, 2)
+
+
+class TestWavelengthMode:
+    def test_event_mode_bins_by_wavelength(self):
+        from esslivedata_tpu.ops.qhistogram import H_OVER_MN
+
+        L = 25.0
+        params = MonitorParams(
+            coordinate="wavelength",
+            toa_bins=10,
+            wavelength_min=1.0,
+            wavelength_max=11.0,
+            distance_m=L,
+        )
+        wf = MonitorWorkflow(params=params)
+        # One event per target wavelength-bin center.
+        lam = np.arange(1.5, 11.0, 1.0)  # 10 centers
+        toa_ns = lam * L / H_OVER_MN * 1e9
+        wf.accumulate({"monitor_1": stage_monitor(toa_ns)})
+        out = wf.finalize()
+        cur = out["current"]
+        assert cur.dims == ("wavelength",)
+        np.testing.assert_allclose(cur.values, np.ones(10))
+        edges = cur.coords["wavelength"]
+        np.testing.assert_allclose(edges.numpy, np.linspace(1.0, 11.0, 11))
+        assert repr(edges.unit) == "angstrom"
+
+    def test_toa_offset_shifts_binning(self):
+        from esslivedata_tpu.ops.qhistogram import H_OVER_MN
+
+        L = 25.0
+        offset = 5e5  # ns
+        params = MonitorParams(
+            coordinate="wavelength",
+            toa_bins=2,
+            wavelength_min=1.0,
+            wavelength_max=3.0,
+            distance_m=L,
+            toa_offset_ns=offset,
+        )
+        wf = MonitorWorkflow(params=params)
+        # An event whose TRUE tof corresponds to lambda=1.5 arrives
+        # offset earlier in TOA; with the correction it must land in
+        # the first bin.
+        toa = 1.5 * L / H_OVER_MN * 1e9 - offset
+        wf.accumulate({"monitor_1": stage_monitor([toa])})
+        out = wf.finalize()
+        np.testing.assert_allclose(out["current"].values, [1.0, 0.0])
+
+    def test_dense_mode_rebins_into_wavelength(self):
+        from esslivedata_tpu.ops.qhistogram import H_OVER_MN
+
+        L = 25.0
+        params = MonitorParams(
+            coordinate="wavelength",
+            toa_bins=4,
+            wavelength_min=0.0,
+            wavelength_max=8.0,
+            distance_m=L,
+        )
+        wf = MonitorWorkflow(params=params)
+        # Dense da00 covering exactly the target toa span: counts conserved.
+        toa_hi = 8.0 * L / H_OVER_MN * 1e9
+        src_edges = np.linspace(0.0, toa_hi, 9)
+        da = DataArray(
+            Variable(np.ones(8), ("toa",), "counts"),
+            coords={"toa": Variable(src_edges, ("toa",), "ns")},
+        )
+        wf.accumulate({"monitor_1": da})
+        out = wf.finalize()
+        assert out["cumulative"].dims == ("wavelength",)
+        np.testing.assert_allclose(out["cumulative"].values.sum(), 8.0)
+
+    def test_toa_mode_unchanged(self):
+        wf = MonitorWorkflow(params=MonitorParams(toa_bins=5))
+        wf.accumulate({"monitor_1": stage_monitor([1e6, 2e6])})
+        out = wf.finalize()
+        assert out["current"].dims == ("toa",)
+        assert out["current"].values.sum() == 2.0
+
+
+class TestWavelengthModeValidation:
+    def test_rejects_inverted_wavelength_range(self):
+        with pytest.raises(ValueError, match="min < max"):
+            MonitorParams(
+                coordinate="wavelength", wavelength_min=5.0, wavelength_max=1.0
+            )
+
+    def test_rejects_nonpositive_distance(self):
+        with pytest.raises(ValueError, match="distance_m"):
+            MonitorParams(coordinate="wavelength", distance_m=0.0)
+
+    def test_rejects_narrowed_toa_range_in_wavelength_mode(self):
+        from esslivedata_tpu.config.models import TOARange
+
+        with pytest.raises(ValueError, match="does not apply"):
+            MonitorParams(
+                coordinate="wavelength",
+                toa_range=TOARange(low=1e6, high=2e6),
+            )
+
+    def test_default_toa_range_fine_in_wavelength_mode(self):
+        MonitorParams(coordinate="wavelength")
+
+    def test_dense_tof_coord_not_double_corrected(self):
+        from esslivedata_tpu.ops.chopper_cascade import ALPHA_NS_PER_M_A
+
+        L, offset = 25.0, 5e5
+        params = MonitorParams(
+            coordinate="wavelength",
+            toa_bins=2,
+            wavelength_min=1.0,
+            wavelength_max=3.0,
+            distance_m=L,
+            toa_offset_ns=offset,
+        )
+        wf = MonitorWorkflow(params=params)
+        # Dense histogram with a TRUE-TOF coord: one count centred on
+        # lambda=1.5 must land in the first bin despite the offset.
+        t0 = 1.4 * L * ALPHA_NS_PER_M_A
+        t1 = 1.6 * L * ALPHA_NS_PER_M_A
+        da = DataArray(
+            Variable(np.ones(1), ("tof",), "counts"),
+            coords={"tof": Variable(np.array([t0, t1]), ("tof",), "ns")},
+        )
+        wf.accumulate({"monitor_1": da})
+        out = wf.finalize()
+        np.testing.assert_allclose(out["current"].values, [1.0, 0.0])
