@@ -73,6 +73,10 @@ def detector_view_outputs() -> dict[str, OutputSpec]:
         "counts_cumulative": OutputSpec(
             title="Counts (since start)", view="since_start"
         ),
+        "counts_in_range_current": OutputSpec(title="Counts in range (window)"),
+        "counts_in_range_cumulative": OutputSpec(
+            title="Counts in range (since start)", view="since_start"
+        ),
     }
 
 

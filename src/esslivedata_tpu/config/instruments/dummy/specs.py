@@ -17,7 +17,7 @@ from ....workflows.workflow_factory import workflow_registry
 
 NY, NX = 64, 64
 
-from .._common import register_parsed_catalog
+from .._common import detector_view_outputs, register_parsed_catalog
 from .streams_parsed import PARSED_STREAMS
 
 INSTRUMENT = Instrument(
@@ -38,16 +38,7 @@ register_parsed_catalog(INSTRUMENT, PARSED_STREAMS)
 instrument_registry.register(INSTRUMENT)
 
 _image_outputs = {
-    "image_current": OutputSpec(title="Detector image (window)", view="per_update"),
-    "image_cumulative": OutputSpec(
-        title="Detector image (since start)", view="since_start"
-    ),
-    "spectrum_current": OutputSpec(title="TOA spectrum (window)"),
-    "spectrum_cumulative": OutputSpec(
-        title="TOA spectrum (since start)", view="since_start"
-    ),
-    "counts_current": OutputSpec(title="Counts (window)"),
-    "counts_cumulative": OutputSpec(title="Counts (since start)", view="since_start"),
+    **detector_view_outputs(),
     "roi_spectra": OutputSpec(title="ROI spectra (window)"),
     "roi_spectra_cumulative": OutputSpec(
         title="ROI spectra (since start)", view="since_start"

@@ -21,7 +21,11 @@ from ....workflows.detector_view.workflow import DetectorViewParams
 from ....workflows.monitor_workflow import MonitorParams
 from ....workflows.sans import SansIQParams
 from ....workflows.workflow_factory import workflow_registry
-from .._common import register_parsed_catalog, register_timeseries_spec
+from .._common import (
+    detector_view_outputs,
+    register_parsed_catalog,
+    register_timeseries_spec,
+)
 from .geometry import rear_bank_geometry
 
 from .streams_parsed import PARSED_STREAMS
@@ -60,18 +64,7 @@ DETECTOR_VIEW_HANDLE = workflow_registry.register_spec(
         source_names=INSTRUMENT.detector_names,
         params_model=DetectorViewParams,
         outputs={
-            "image_current": OutputSpec(title="Image (window)"),
-            "image_cumulative": OutputSpec(
-                title="Image (since start)", view="since_start"
-            ),
-            "spectrum_current": OutputSpec(title="TOA spectrum"),
-            "spectrum_cumulative": OutputSpec(
-                title="TOA spectrum (since start)", view="since_start"
-            ),
-            "counts_current": OutputSpec(title="Counts (window)"),
-            "counts_cumulative": OutputSpec(
-                title="Counts (since start)", view="since_start"
-            ),
+            **detector_view_outputs(),
             "roi_spectra": OutputSpec(title="ROI spectra (window)"),
             "roi_spectra_cumulative": OutputSpec(
                 title="ROI spectra (since start)", view="since_start"

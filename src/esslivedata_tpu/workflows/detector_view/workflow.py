@@ -39,6 +39,11 @@ class DetectorViewParams(BaseModel):
     toa_bins: int = 100
     toa_range: TOARange = Field(default_factory=TOARange)
     pixel_weighting: bool = False
+    # Optional TOA sub-range restricting the IMAGE sums (reference:
+    # providers.py:236-255 HistogramSlice / counts_in_range:328). The
+    # spectrum keeps the full axis. Bin edges are static under jit, so
+    # the slice compiles to a static index range — zero runtime cost.
+    image_toa_slice: TOARange | None = None
 
 
 def _density_weights(lut: np.ndarray) -> np.ndarray:
@@ -91,6 +96,19 @@ class DetectorViewWorkflow:
         ny, nx = projection.ny, projection.nx
         n_toa = self._hist.n_toa
         n_bins = projection.n_screen * n_toa
+        # Static slice bounds for the image sums: full axis when the
+        # param is absent/disabled. Any bin OVERLAPPING [low, high) is
+        # included, so the realized range always covers the request.
+        sl = params.image_toa_slice
+        if sl is not None and sl.enabled:
+            a = max(int(np.searchsorted(edges, sl.low, side="right")) - 1, 0)
+            b = min(int(np.searchsorted(edges, sl.high, side="left")), n_toa)
+            if a >= b:
+                raise ValueError(
+                    "image_toa_slice selects no bins within toa_range"
+                )
+        else:
+            a, b = 0, n_toa
 
         def publish_program(state, roi_masks):
             # The histogrammer owns the state layout (flat, dump bin, lazy
@@ -104,13 +122,17 @@ class DetectorViewWorkflow:
             cum = win + state.folded[:n_bins].reshape(
                 projection.n_screen, n_toa
             )
+            win_img = win[:, a:b]
+            cum_img = cum[:, a:b]
             outputs = {
-                "image_current": win.sum(axis=1).reshape(ny, nx),
-                "image_cumulative": cum.sum(axis=1).reshape(ny, nx),
+                "image_current": win_img.sum(axis=1).reshape(ny, nx),
+                "image_cumulative": cum_img.sum(axis=1).reshape(ny, nx),
                 "spectrum_current": win.sum(axis=0),
                 "spectrum_cumulative": cum.sum(axis=0),
                 "counts_current": win.sum(),
                 "counts_cumulative": cum.sum(),
+                "counts_in_range_current": win_img.sum(),
+                "counts_in_range_cumulative": cum_img.sum(),
                 # [MAX_ROIS, n_toa] on the MXU; unused rows are zero.
                 "roi_spectra": roi_masks @ win,
                 "roi_spectra_cumulative": roi_masks @ cum,
@@ -245,14 +267,17 @@ class DetectorViewWorkflow:
                 coords=spec_coords,
                 name="spectrum_cumulative",
             ),
-            "counts_current": DataArray(
-                Variable(np.asarray(out["counts_current"]), (), "counts"),
-                name="counts_current",
-            ),
-            "counts_cumulative": DataArray(
-                Variable(np.asarray(out["counts_cumulative"]), (), "counts"),
-                name="counts_cumulative",
-            ),
+            **{
+                k: DataArray(
+                    Variable(np.asarray(out[k]), (), "counts"), name=k
+                )
+                for k in (
+                    "counts_current",
+                    "counts_cumulative",
+                    "counts_in_range_current",
+                    "counts_in_range_cumulative",
+                )
+            },
         }
         if self._rois_by_index:
             indices = np.asarray(list(self._rois_by_index), dtype=np.int32)

@@ -235,3 +235,79 @@ class TestRoiReadbackAndCumulative:
         assert roi.coords["roi"].values.tolist() == [0, 4]  # rect row, poly row
         assert roi.values[0].sum() == 1.0  # index 0 = rectangle (pixel 0)
         assert roi.values[1].sum() == 1.0  # index 4 = polygon (pixel 3)
+
+
+class TestImageToaSlice:
+    def make(self, **kw):
+        from esslivedata_tpu.utils.labeled import Variable
+        from esslivedata_tpu.workflows.detector_view.projectors import (
+            ProjectionTable,
+        )
+
+        lut = np.arange(4, dtype=np.int32).reshape(1, 4)
+        proj = ProjectionTable(
+            lut=lut,
+            ny=2,
+            nx=2,
+            x_edges=Variable(np.arange(3.0), ("x",), ""),
+            y_edges=Variable(np.arange(3.0), ("y",), ""),
+        )
+        from esslivedata_tpu.config.models import TOARange
+
+        params = DetectorViewParams(
+            toa_bins=10,
+            toa_range=TOARange(low=0.0, high=100.0),
+            **kw,
+        )
+        return DetectorViewWorkflow(projection=proj, params=params)
+
+    def stage(self, pid, toa):
+        acc = ToEventBatch(min_bucket=16)
+        acc.add(
+            T0,
+            DetectorEvents(
+                pixel_id=np.asarray(pid, dtype=np.int32),
+                time_of_arrival=np.asarray(toa, dtype=np.float32),
+            ),
+        )
+        return acc.get()
+
+    def test_slice_restricts_image_but_not_spectrum(self):
+        from esslivedata_tpu.config.models import TOARange
+
+        wf = self.make(image_toa_slice=TOARange(low=20.0, high=50.0))
+        # Events at toa 5 (outside slice) and 25, 35 (inside).
+        wf.accumulate({"det": self.stage([0, 1, 2], [5.0, 25.0, 35.0])})
+        out = wf.finalize()
+        assert float(out["image_current"].values.sum()) == 2.0
+        assert float(out["spectrum_current"].values.sum()) == 3.0
+        assert float(out["counts_current"].values) == 3.0
+        assert float(out["counts_in_range_current"].values) == 2.0
+
+    def test_no_slice_counts_in_range_equals_counts(self):
+        wf = self.make()
+        wf.accumulate({"det": self.stage([0, 1], [5.0, 95.0])})
+        out = wf.finalize()
+        assert float(out["counts_in_range_current"].values) == float(
+            out["counts_current"].values
+        )
+
+    def test_empty_slice_rejected(self):
+        from esslivedata_tpu.config.models import TOARange
+
+        with pytest.raises(ValueError, match="no bins"):
+            self.make(image_toa_slice=TOARange(low=200.0, high=300.0))
+
+
+def test_slice_includes_partially_covered_bins():
+    # Bounds mid-bin: bins [20,30) and [40,50) partially overlap the
+    # request (25, 45) and must be included.
+    from esslivedata_tpu.config.models import TOARange
+
+    t = TestImageToaSlice()
+    wf = t.make(image_toa_slice=TOARange(low=25.0, high=45.0))
+    wf.accumulate({"det": t.stage([0, 1, 2, 3], [26.0, 47.0, 15.0, 35.0])})
+    out = wf.finalize()
+    # 26 (bin [20,30)) and 35 in; 47 in bin [40,50) which overlaps 45 -> in;
+    # 15 out.
+    assert float(out["counts_in_range_current"].values) == 3.0
