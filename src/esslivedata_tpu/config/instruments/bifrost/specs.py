@@ -20,8 +20,10 @@ from ....config.instrument import (
     instrument_registry,
 )
 from ....config.workflow_spec import OutputSpec, WorkflowSpec
+from ....workflows.elastic_qmap import ElasticQMapParams
 from ....workflows.multibank import MultiBankParams
 from ....workflows.qe_spectroscopy import QESpectroscopyParams
+from ....workflows.ratemeter import RatemeterParams
 from ....workflows.workflow_factory import workflow_registry
 from .._common import register_monitor_spec, register_parsed_catalog
 
@@ -100,6 +102,7 @@ def analyzer_geometry() -> dict[str, np.ndarray]:
     ef_levels = np.array([2.7, 3.2, 3.8, 4.4, 5.0])
     rows_per_ef = BANK_NY // len(ef_levels)
     two_theta = np.empty(N_BANKS * PIXELS_PER_BANK)
+    azimuth = np.empty_like(two_theta)
     ef = np.empty_like(two_theta)
     l2 = np.empty_like(two_theta)
     pixel_ids = np.empty(two_theta.shape, dtype=np.int64)
@@ -113,6 +116,14 @@ def analyzer_geometry() -> dict[str, np.ndarray]:
         two_theta[sl] = np.repeat(
             bank_center + col_offset[None, :], BANK_NY, axis=0
         ).reshape(-1)
+        # Small out-of-plane fan across the rows of each triplet: the
+        # tubes have vertical extent, giving the elastic Qy axis
+        # structure (rows near the arc midplane sit near phi = 0).
+        azimuth[sl] = np.repeat(
+            np.deg2rad(np.linspace(-2.0, 2.0, BANK_NY))[:, None],
+            BANK_NX,
+            axis=1,
+        ).reshape(-1)
         ef[sl] = np.repeat(row_ef[:, None], BANK_NX, axis=1).reshape(-1)
         l2[sl] = 1.2 + 0.25 * np.repeat(
             np.minimum(np.arange(BANK_NY) // rows_per_ef, 4)[:, None],
@@ -122,6 +133,7 @@ def analyzer_geometry() -> dict[str, np.ndarray]:
         pixel_ids[sl] = BANK_DETECTOR_NUMBERS[f"triplet_{b}"].reshape(-1)
     return {
         "two_theta": two_theta,
+        "azimuth": azimuth,
         "ef_mev": ef,
         "l2": l2,
         "pixel_ids": pixel_ids,
@@ -148,6 +160,51 @@ QE_HANDLE = workflow_registry.register_spec(
             ),
             "counts_current": OutputSpec(title="Events binned"),
             "monitor_counts_current": OutputSpec(title="Monitor counts"),
+        },
+    )
+)
+
+
+ELASTIC_QMAP_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="bifrost",
+        namespace="spectrometer",
+        name="elastic_qmap",
+        title="Elastic Q map",
+        source_names=[MERGED_STREAM],
+        service="data_reduction",
+        aux_source_names={"monitor": ["monitor_1"]},
+        params_model=ElasticQMapParams,
+        outputs={
+            "qmap_current": OutputSpec(title="Elastic Q map — window"),
+            "qmap_cumulative": OutputSpec(
+                title="Elastic Q map — since start", view="since_start"
+            ),
+            "qmap_normalized": OutputSpec(
+                title="Elastic Q map / monitor", view="since_start"
+            ),
+            "counts_current": OutputSpec(title="Elastic events binned"),
+        },
+    )
+)
+
+RATEMETER_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="bifrost",
+        namespace="spectrometer",
+        name="detector_ratemeter",
+        title="Detector ratemeter",
+        source_names=[MERGED_STREAM],
+        service="detector_data",
+        params_model=RatemeterParams,
+        outputs={
+            "detector_region_counts": OutputSpec(
+                title="Detector region counts (window)"
+            ),
+            "detector_region_counts_cumulative": OutputSpec(
+                title="Detector region counts (since start)",
+                view="since_start",
+            ),
         },
     )
 )

@@ -25,6 +25,7 @@ __all__ = [
     "QState",
     "PixelBinMap",
     "build_dspacing_map",
+    "build_elastic_q2d_map",
     "build_qe_map",
     "build_qz_map",
     "build_sans_qmap",
@@ -273,6 +274,92 @@ def build_qe_map(
         flat_bin[sl] = flat
     return _assemble_map(
         pixel_ids, flat_bin, (len(q_edges) - 1) * n_e
+    )
+
+
+def build_elastic_q2d_map(
+    *,
+    two_theta: np.ndarray,  # [n_pixel] scattering angle (rad)
+    azimuth: np.ndarray,  # [n_pixel] out-of-plane azimuth (rad)
+    ef_mev: np.ndarray,  # [n_pixel] analyzer-selected final energy
+    l2: np.ndarray,  # [n_pixel] sample->analyzer->detector path (m)
+    pixel_ids: np.ndarray,
+    toa_edges: np.ndarray,  # ns since pulse
+    axis1: str,  # "Qx" | "Qy" | "Qz"
+    axis1_edges: np.ndarray,  # 1/angstrom
+    axis2: str,
+    axis2_edges: np.ndarray,
+    l1: float = 162.0,
+    e_window_mev: float = 0.25,
+    toa_offset_ns: float = 0.0,
+) -> PixelBinMap:
+    """Precompile the elastic-line Q-space map (reference: bifrost
+    specs.py:376 elastic_qmap) into ``map[pixel, toa_bin] -> flat
+    (axis1, axis2) bin`` (row-major, axis2 fast).
+
+    With ki along +z and kf along the pixel's direction
+    ``(sin 2theta cos phi, sin 2theta sin phi, cos 2theta)``,
+    ``Q = k_i - k_f`` componentwise:
+    ``Qx = -kf sin(2theta) cos(phi)``, ``Qy = -kf sin(2theta) sin(phi)``,
+    ``Qz = ki - kf cos(2theta)``. Only quasi-elastic entries
+    (|Ei - Ef| <= e_window_mev) map to a bin — each TOA bin has a
+    definite Ei via the indirect-geometry timing, so the elastic cut is
+    part of the precompiled table, not a per-event branch.
+    """
+    if axis1 == axis2:
+        raise ValueError("axis1 and axis2 must differ")
+    two_theta = np.asarray(two_theta, dtype=np.float64)
+    azimuth = np.asarray(azimuth, dtype=np.float64)
+    ef = np.asarray(ef_mev, dtype=np.float64)
+    l2 = np.asarray(l2, dtype=np.float64)
+    vf = np.sqrt(ef / E_FROM_V2)
+    t2 = l2 / vf
+    kf = K_FROM_V * vf
+    toa_centers_s = _toa_centers_s(toa_edges, toa_offset_ns)
+    n2 = len(axis2_edges) - 1
+    n_pixel = l2.size
+    flat_bin = np.empty((n_pixel, toa_centers_s.size), dtype=np.int32)
+    for lo in range(0, n_pixel, _MAP_CHUNK):
+        sl = slice(lo, min(lo + _MAP_CHUNK, n_pixel))
+        t1 = toa_centers_s[None, :] - t2[sl, None]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            vi = l1 / t1
+            ei = E_FROM_V2 * vi * vi
+            de = ei - ef[sl, None]
+            ki = K_FROM_V * vi
+        shape = t1.shape
+
+        def component(name: str) -> np.ndarray:
+            # Qx/Qy depend only on kf (per-pixel constants, broadcast to
+            # the TOA axis); only Qz involves ki.
+            if name == "Qx":
+                col = -kf[sl] * np.sin(two_theta[sl]) * np.cos(azimuth[sl])
+                return np.broadcast_to(col[:, None], shape)
+            if name == "Qy":
+                col = -kf[sl] * np.sin(two_theta[sl]) * np.sin(azimuth[sl])
+                return np.broadcast_to(col[:, None], shape)
+            return ki - kf[sl, None] * np.cos(two_theta[sl, None])
+
+        c1 = component(axis1)
+        c2 = component(axis2)
+        b1 = np.searchsorted(axis1_edges, c1, side="right") - 1
+        b2 = np.searchsorted(axis2_edges, c2, side="right") - 1
+        ok = (
+            (t1 > 0)
+            & np.isfinite(de)
+            & (np.abs(de) <= e_window_mev)
+            & np.isfinite(c1)
+            & (b1 >= 0)
+            & (c1 < axis1_edges[-1])
+            & np.isfinite(c2)
+            & (b2 >= 0)
+            & (c2 < axis2_edges[-1])
+        )
+        flat = b1 * n2 + b2
+        flat[~ok] = -1
+        flat_bin[sl] = flat
+    return _assemble_map(
+        pixel_ids, flat_bin, (len(axis1_edges) - 1) * n2
     )
 
 
