@@ -11,13 +11,17 @@ from ....workflows.detector_view.projectors import (
 )
 from ....workflows.detector_view.workflow import DetectorViewWorkflow
 from ....workflows.monitor_workflow import MonitorWorkflow
-from ....workflows.powder import PowderDiffractionWorkflow
+from ....workflows.powder import (
+    PowderDiffractionWorkflow,
+    PowderVanadiumWorkflow,
+)
 from ....workflows.timeseries import TimeseriesWorkflow
 from ....workflows.wavelength_lut_workflow import WavelengthLutWorkflow
 from .._common import monitor_streams_from_aux
 from .specs import (
     BANK_SIZES,
     POWDER_HANDLE,
+    POWDER_VANADIUM_HANDLE,
     BANK_VIEW_HANDLE,
     CHOPPER_GEOMETRY,
     INSTRUMENT,
@@ -79,14 +83,21 @@ def make_timeseries(*, source_name: str, params) -> TimeseriesWorkflow:  # noqa:
     return TimeseriesWorkflow()
 
 
-@POWDER_HANDLE.attach_factory
-def make_powder(
-    *, source_name: str, params, aux_source_names=None
-) -> PowderDiffractionWorkflow:
-    geometry = powder_geometry(source_name)
-    return PowderDiffractionWorkflow(
-        **geometry,
-        params=params,
-        primary_stream=source_name,
-        monitor_streams=monitor_streams_from_aux(aux_source_names),
-    )
+def _make_powder_factory(workflow_cls):
+    def factory(*, source_name: str, params, aux_source_names=None):
+        return workflow_cls(
+            **powder_geometry(source_name),
+            params=params,
+            primary_stream=source_name,
+            monitor_streams=monitor_streams_from_aux(aux_source_names),
+        )
+
+    return factory
+
+
+make_powder = POWDER_HANDLE.attach_factory(
+    _make_powder_factory(PowderDiffractionWorkflow)
+)
+make_powder_vanadium = POWDER_VANADIUM_HANDLE.attach_factory(
+    _make_powder_factory(PowderVanadiumWorkflow)
+)

@@ -289,3 +289,32 @@ POWDER_HANDLE = workflow_registry.register_spec(
         },
     )
 )
+
+
+POWDER_VANADIUM_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="dream",
+        namespace="powder",
+        name="dspacing_vanadium",
+        title="I(d) with vanadium normalization",
+        source_names=list(BANK_SIZES),
+        service="data_reduction",
+        aux_source_names={"monitor": ["monitor_bunker", "monitor_cave"]},
+        optional_context_keys=["emission_offset"],
+        params_model=PowderDiffractionParams,
+        outputs={
+            "dspacing_current": OutputSpec(title="I(d) — window"),
+            "dspacing_cumulative": OutputSpec(
+                title="I(d) — since start", view="since_start"
+            ),
+            "dspacing_normalized": OutputSpec(
+                title="I(d) / monitor", view="since_start"
+            ),
+            "intensity_dspacing": OutputSpec(
+                title="I(d) vanadium-corrected", view="since_start"
+            ),
+            "counts_current": OutputSpec(title="Events binned"),
+            "monitor_counts_current": OutputSpec(title="Monitor counts"),
+        },
+    )
+)

@@ -29,7 +29,12 @@ from ..ops.qhistogram import QHistogrammer, build_dspacing_map
 from ..utils.labeled import DataArray, Variable
 from .qshared import QStreamingMixin, latest_sample_value
 
-__all__ = ["PowderDiffractionParams", "PowderDiffractionWorkflow"]
+__all__ = [
+    "PowderDiffractionParams",
+    "PowderDiffractionWorkflow",
+    "PowderVanadiumWorkflow",
+    "vanadium_acceptance",
+]
 
 
 class PowderDiffractionParams(BaseModel):
@@ -45,6 +50,29 @@ class PowderDiffractionParams(BaseModel):
     toa_offset_ns: float = 0.0
     #: Offset moves below this are jitter, not a recalibration.
     offset_tolerance_ns: float = 1000.0
+
+
+def vanadium_acceptance(table: np.ndarray, n_bins: int) -> np.ndarray:
+    """Per-d-bin instrument acceptance from the Bragg table itself.
+
+    A vanadium run measures the incoherent (flat-in-d) response of the
+    instrument: how many (pixel, TOF-bin) cells feed each d bin. That
+    count IS readable off the precompiled table — ``bincount`` of its
+    valid entries — giving the live-mode analog of the reference's
+    vanadium normalization (reference: dream/factories.py:267, which
+    divides by a recorded vanadium run). The result is scaled to mean 1
+    over the populated bins so normalized intensities keep the
+    magnitude of the monitor-normalized spectrum; bins with zero
+    acceptance stay 0 and are masked at division time. A measured
+    vanadium spectrum can replace this via
+    ``PowderVanadiumWorkflow.set_vanadium``.
+    """
+    flat = np.asarray(table).reshape(-1)
+    counts = np.bincount(flat[flat >= 0], minlength=n_bins).astype(np.float64)
+    populated = counts > 0
+    if populated.any():
+        counts[populated] /= counts[populated].mean()
+    return counts
 
 
 class PowderDiffractionWorkflow(QStreamingMixin):
@@ -139,3 +167,51 @@ class PowderDiffractionWorkflow(QStreamingMixin):
                 name="monitor_counts_current",
             ),
         }
+
+
+class PowderVanadiumWorkflow(PowderDiffractionWorkflow):
+    """I(d) with vanadium normalization (reference:
+    dream/specs.py:356 powder_reduction_with_vanadium).
+
+    Divides the monitor-normalized spectrum per d bin by a vanadium
+    response — by default the acceptance correction derived from the
+    Bragg table (``vanadium_acceptance``), replaceable with a measured
+    spectrum. The table-derived default recomputes automatically when a
+    live emission-offset recalibration swaps the table.
+    """
+
+    _measured_vanadium: np.ndarray | None = None
+
+    def _build_table(self):
+        # Derive the acceptance as the table passes through — both the
+        # initial build and live emission-offset swaps land here, so the
+        # correction always matches the active table without retaining a
+        # host copy of the (large) table anywhere.
+        table = super()._build_table()
+        if self._measured_vanadium is None:
+            self._vanadium = vanadium_acceptance(
+                table.table, self._params.d_bins
+            )
+        return table
+
+    def set_vanadium(self, spectrum: np.ndarray) -> None:
+        """Install a measured vanadium d-spectrum (same d binning)."""
+        spectrum = np.asarray(spectrum, dtype=np.float64)
+        if spectrum.shape != (self._params.d_bins,):
+            raise ValueError(
+                f"vanadium spectrum must have {self._params.d_bins} bins"
+            )
+        self._measured_vanadium = spectrum
+        self._vanadium = spectrum
+
+    def finalize(self) -> dict[str, DataArray]:
+        results = super().finalize()
+        norm = results["dspacing_normalized"].values
+        with np.errstate(divide="ignore", invalid="ignore"):
+            intensity = np.where(
+                self._vanadium > 0, norm / self._vanadium, 0.0
+            )
+        results["intensity_dspacing"] = self._spectrum(
+            intensity, "intensity_dspacing", unit=""
+        )
+        return results

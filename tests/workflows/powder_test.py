@@ -170,3 +170,71 @@ class TestLiveEmissionOffset:
             {"det": staged(np.zeros(10, np.int32), np.full(10, t_ns))}
         )
         assert wf._hist._qmap is table
+
+
+class TestVanadium:
+    def geometry(self):
+        return dict(
+            two_theta=np.deg2rad(np.array([60.0, 90.0, 120.0])),
+            l_total=np.array([80.0, 80.5, 81.0]),
+            pixel_ids=np.array([1, 2, 3]),
+        )
+
+    def make(self, **kw):
+        from esslivedata_tpu.workflows.powder import PowderVanadiumWorkflow
+
+        return PowderVanadiumWorkflow(
+            **self.geometry(),
+            params=PowderDiffractionParams(**kw) if kw else None,
+            primary_stream="detector",
+            monitor_streams={"monitor_cave"},
+        )
+
+    def test_acceptance_from_table(self):
+        from esslivedata_tpu.workflows.powder import vanadium_acceptance
+
+        table = np.array([[0, 0, 1, -1], [1, 1, 1, -1]])
+        v = vanadium_acceptance(table, 3)
+        # bin0 fed by 2 cells, bin1 by 4, bin2 by none; mean over populated=3
+        np.testing.assert_allclose(v, [2 / 3, 4 / 3, 0.0])
+
+    def test_flat_in_d_source_flattens(self):
+        # Feed events uniformly over (pixel, toa): the vanadium-corrected
+        # intensity should be ~flat across populated d bins even though
+        # raw I(d) follows the acceptance profile.
+        wf = self.make(d_bins=50)
+        rng = np.random.default_rng(0)
+        pid = rng.integers(1, 4, 20000).astype(np.int32)
+        toa = rng.uniform(0.0, 71e6, 20000).astype(np.float32)
+        wf.accumulate(
+            {
+                "detector": staged(pid, toa),
+                "monitor_cave": staged(np.zeros(100, np.int32), np.ones(100)),
+            }
+        )
+        out = wf.finalize()
+        raw = out["dspacing_cumulative"].values
+        corrected = out["intensity_dspacing"].values
+        pop = raw > 20  # well-populated bins only (counting noise)
+        assert pop.sum() > 5
+        rel_raw = raw[pop].std() / raw[pop].mean()
+        rel_cor = corrected[pop].std() / corrected[pop].mean()
+        assert rel_cor < 0.6 * rel_raw  # correction flattens the response
+
+    def test_zero_acceptance_bins_masked(self):
+        wf = self.make(d_bins=400)
+        wf.accumulate({"detector": staged([1], [5e6])})
+        out = wf.finalize()
+        assert np.isfinite(out["intensity_dspacing"].values).all()
+
+    def test_measured_vanadium_overrides(self):
+        wf = self.make(d_bins=10)
+        with pytest.raises(ValueError, match="10 bins"):
+            wf.set_vanadium(np.ones(5))
+        wf.set_vanadium(np.full(10, 2.0))
+        wf.accumulate({"detector": staged([1, 2], [5e6, 6e6])})
+        out = wf.finalize()
+        np.testing.assert_allclose(
+            out["intensity_dspacing"].values,
+            out["dspacing_normalized"].values / 2.0,
+        )
