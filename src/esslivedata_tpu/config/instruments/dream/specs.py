@@ -263,12 +263,32 @@ def powder_geometry(bank: str) -> dict[str, np.ndarray]:
     }
 
 
-POWDER_HANDLE = workflow_registry.register_spec(
-    WorkflowSpec(
+def _powder_outputs() -> dict[str, OutputSpec]:
+    return {
+        "dspacing_current": OutputSpec(title="I(d) — window"),
+        "dspacing_cumulative": OutputSpec(
+            title="I(d) — since start", view="since_start"
+        ),
+        "dspacing_normalized": OutputSpec(
+            title="I(d) / monitor", view="since_start"
+        ),
+        "dspacing_two_theta": OutputSpec(
+            title="I(d, 2theta)", view="since_start"
+        ),
+        "focussed_tof": OutputSpec(
+            title="Focussed spectrum (TOF axis)", view="since_start"
+        ),
+        "counts_current": OutputSpec(title="Events binned"),
+        "monitor_counts_current": OutputSpec(title="Monitor counts"),
+    }
+
+
+def _powder_spec(name: str, title: str, outputs: dict) -> WorkflowSpec:
+    return WorkflowSpec(
         instrument="dream",
         namespace="powder",
-        name="dspacing",
-        title="I(d) powder pattern (Bragg rebinning)",
+        name=name,
+        title=title,
         source_names=list(BANK_SIZES),
         service="data_reduction",
         aux_source_names={"monitor": ["monitor_bunker", "monitor_cave"]},
@@ -276,45 +296,26 @@ POWDER_HANDLE = workflow_registry.register_spec(
         # static toa_offset_ns param is the fallback.
         optional_context_keys=["emission_offset"],
         params_model=PowderDiffractionParams,
-        outputs={
-            "dspacing_current": OutputSpec(title="I(d) — window"),
-            "dspacing_cumulative": OutputSpec(
-                title="I(d) — since start", view="since_start"
-            ),
-            "dspacing_normalized": OutputSpec(
-                title="I(d) / monitor", view="since_start"
-            ),
-            "counts_current": OutputSpec(title="Events binned"),
-            "monitor_counts_current": OutputSpec(title="Monitor counts"),
-        },
+        outputs=outputs,
+    )
+
+
+POWDER_HANDLE = workflow_registry.register_spec(
+    _powder_spec(
+        "dspacing", "I(d) powder pattern (Bragg rebinning)", _powder_outputs()
     )
 )
 
 
 POWDER_VANADIUM_HANDLE = workflow_registry.register_spec(
-    WorkflowSpec(
-        instrument="dream",
-        namespace="powder",
-        name="dspacing_vanadium",
-        title="I(d) with vanadium normalization",
-        source_names=list(BANK_SIZES),
-        service="data_reduction",
-        aux_source_names={"monitor": ["monitor_bunker", "monitor_cave"]},
-        optional_context_keys=["emission_offset"],
-        params_model=PowderDiffractionParams,
-        outputs={
-            "dspacing_current": OutputSpec(title="I(d) — window"),
-            "dspacing_cumulative": OutputSpec(
-                title="I(d) — since start", view="since_start"
-            ),
-            "dspacing_normalized": OutputSpec(
-                title="I(d) / monitor", view="since_start"
-            ),
+    _powder_spec(
+        "dspacing_vanadium",
+        "I(d) with vanadium normalization",
+        {
+            **_powder_outputs(),
             "intensity_dspacing": OutputSpec(
                 title="I(d) vanadium-corrected", view="since_start"
             ),
-            "counts_current": OutputSpec(title="Events binned"),
-            "monitor_counts_current": OutputSpec(title="Monitor counts"),
         },
     )
 )

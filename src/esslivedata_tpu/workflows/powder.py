@@ -25,7 +25,8 @@ import numpy as np
 from pydantic import BaseModel, ConfigDict, Field
 
 from ..config.models import TOARange
-from ..ops.qhistogram import QHistogrammer, build_dspacing_map
+from ..ops.chopper_cascade import ALPHA_NS_PER_M_A
+from ..ops.qhistogram import PixelBinMap, QHistogrammer, build_dspacing_map
 from ..utils.labeled import DataArray, Variable
 from .qshared import QStreamingMixin, latest_sample_value
 
@@ -50,6 +51,10 @@ class PowderDiffractionParams(BaseModel):
     toa_offset_ns: float = 0.0
     #: Offset moves below this are jitter, not a recalibration.
     offset_tolerance_ns: float = 1000.0
+    #: 2-theta resolution of the d-2theta map (reference:
+    #: FocussedDataDspacingTwoTheta, dream/factories.py:249). The 1-D
+    #: I(d) is the marginal of this map, so one kernel feeds both.
+    two_theta_bins: int = Field(default=8, ge=1)
 
 
 def vanadium_acceptance(table: np.ndarray, n_bins: int) -> np.ndarray:
@@ -105,23 +110,69 @@ class PowderDiffractionWorkflow(QStreamingMixin):
         self._offset_stream = offset_stream
         self._offset_ns = float(params.toa_offset_ns)
         self._built_offset_ns = self._offset_ns
+        # Per-pixel 2-theta band for the (d, 2theta) map; the composite
+        # flat bin is d_bin * n_bands + band.
+        tt = self._geometry["two_theta"]
+        self._n_bands = int(params.two_theta_bins)
+        self._tt_edges = np.linspace(
+            float(tt.min()), float(np.nextafter(tt.max(), np.inf)),
+            self._n_bands + 1,
+        )
+        self._band = np.clip(
+            np.searchsorted(self._tt_edges, tt, side="right") - 1,
+            0,
+            self._n_bands - 1,
+        )
         dmap = self._build_table()
         self._hist = QHistogrammer(
-            qmap=dmap, toa_edges=toa_edges, n_q=params.d_bins
+            qmap=dmap,
+            toa_edges=toa_edges,
+            n_q=params.d_bins * self._n_bands,
         )
         self._state = self._hist.init_state()
         self._d_var = Variable(d_edges, ("dspacing",), "angstrom")
+        self._tt_var = Variable(self._tt_edges, ("two_theta",), "rad")
+        # DIFC from the mean geometry: tof = ALPHA * L * 2 sin(theta) * d
+        # (the reference's d -> TOF conversion for the focussed spectrum,
+        # dream/factories.py:180).
+        difc = (
+            ALPHA_NS_PER_M_A
+            * float(self._geometry["l_total"].mean())
+            * 2.0
+            * np.sin(float(tt.mean()) / 2.0)
+        )
+        self._tof_var = Variable(d_edges * difc, ("tof",), "ns")
         self._primary_stream = primary_stream
         self._monitor_streams = monitor_streams or set()
         self._publish = None
 
-    def _build_table(self):
-        return build_dspacing_map(
+    def _build_table(self) -> PixelBinMap:
+        dmap = build_dspacing_map(
             **self._geometry,
             toa_edges=self._toa_edges,
             d_edges=self._d_edges,
             toa_offset_ns=self._offset_ns,
         )
+        # Compose the per-pixel 2-theta band into the flat bin. Band is
+        # indexed by table row (bank-local ids), widening to int32 when
+        # the composite bin space outgrows int16. Chunked over rows to
+        # keep peak host memory at the same chunk-bound the map builders
+        # guarantee (mantle-scale tables are ~GB as int32).
+        from ..ops.qhistogram import _MAP_CHUNK
+
+        ids = self._geometry["pixel_ids"]
+        band_by_row = np.zeros(dmap.table.shape[0], dtype=np.int32)
+        band_by_row[np.asarray(ids) - dmap.id_base] = self._band
+        n_flat = (len(self._d_edges) - 1) * self._n_bands
+        dtype = np.int16 if n_flat < np.iinfo(np.int16).max else np.int32
+        composite = np.empty(dmap.table.shape, dtype=dtype)
+        for lo in range(0, dmap.table.shape[0], _MAP_CHUNK):
+            sl = slice(lo, min(lo + _MAP_CHUNK, dmap.table.shape[0]))
+            t = dmap.table[sl].astype(np.int32)
+            composite[sl] = np.where(
+                t >= 0, t * self._n_bands + band_by_row[sl, None], -1
+            ).astype(dtype)
+        return PixelBinMap(table=composite, id_base=dmap.id_base)
 
     def set_context(self, context: Mapping[str, Any]) -> None:
         """A live emission-time calibration (WFM subframe T0) arrives as
@@ -149,7 +200,12 @@ class PowderDiffractionWorkflow(QStreamingMixin):
         )
 
     def finalize(self) -> dict[str, DataArray]:
-        win, cum, mon_win, mon_cum = self._take_publish()
+        win2d, cum2d, mon_win, mon_cum = self._take_publish()
+        shape = (self._params.d_bins, self._n_bands)
+        win2d = win2d.reshape(shape)
+        cum2d = cum2d.reshape(shape)
+        win = win2d.sum(axis=1)
+        cum = cum2d.sum(axis=1)
         return {
             "dspacing_current": self._spectrum(win, "dspacing_current"),
             "dspacing_cumulative": self._spectrum(
@@ -157,6 +213,16 @@ class PowderDiffractionWorkflow(QStreamingMixin):
             ),
             "dspacing_normalized": self._spectrum(
                 cum / max(mon_cum, 1.0), "dspacing_normalized", unit=""
+            ),
+            "dspacing_two_theta": DataArray(
+                Variable(cum2d, ("dspacing", "two_theta"), "counts"),
+                coords={"dspacing": self._d_var, "two_theta": self._tt_var},
+                name="dspacing_two_theta",
+            ),
+            "focussed_tof": DataArray(
+                Variable(cum, ("tof",), "counts"),
+                coords={"tof": self._tof_var},
+                name="focussed_tof",
             ),
             "counts_current": DataArray(
                 Variable(np.asarray(win.sum()), (), "counts"),
@@ -189,9 +255,18 @@ class PowderVanadiumWorkflow(PowderDiffractionWorkflow):
         # host copy of the (large) table anywhere.
         table = super()._build_table()
         if self._measured_vanadium is None:
-            self._vanadium = vanadium_acceptance(
-                table.table, self._params.d_bins
-            )
+            from ..ops.qhistogram import _MAP_CHUNK
+
+            # Chunked bincount of the d marginal: no full-table temporary.
+            counts = np.zeros(self._params.d_bins, dtype=np.float64)
+            for lo in range(0, table.table.shape[0], _MAP_CHUNK):
+                sl = table.table[lo : lo + _MAP_CHUNK]
+                valid = sl[sl >= 0].astype(np.int32) // self._n_bands
+                counts += np.bincount(valid, minlength=self._params.d_bins)
+            populated = counts > 0
+            if populated.any():
+                counts[populated] /= counts[populated].mean()
+            self._vanadium = counts
         return table
 
     def set_vanadium(self, spectrum: np.ndarray) -> None:

@@ -238,3 +238,55 @@ class TestVanadium:
             out["intensity_dspacing"].values,
             out["dspacing_normalized"].values / 2.0,
         )
+
+
+class TestTwoThetaResolved:
+    def make(self, **kw):
+        return PowderDiffractionWorkflow(
+            two_theta=np.deg2rad(np.array([60.0, 90.0, 120.0])),
+            l_total=np.array([80.0, 80.0, 80.0]),
+            pixel_ids=np.array([1, 2, 3]),
+            params=PowderDiffractionParams(**kw),
+            primary_stream="detector",
+        )
+
+    def test_marginal_matches_1d(self):
+        wf = self.make(two_theta_bins=4, d_bins=100)
+        rng = np.random.default_rng(3)
+        pid = rng.integers(1, 4, 5000).astype(np.int32)
+        toa = rng.uniform(0, 7.1e7, 5000).astype(np.float32)
+        wf.accumulate({"detector": staged(pid, toa)})
+        out = wf.finalize()
+        map2d = out["dspacing_two_theta"].values
+        assert map2d.shape == (100, 4)
+        np.testing.assert_allclose(
+            map2d.sum(axis=1), out["dspacing_cumulative"].values
+        )
+
+    def test_bands_separate_pixels(self):
+        wf = self.make(two_theta_bins=3, d_bins=100)
+        # One event per pixel at the same toa: three distinct 2theta
+        # bands must each receive exactly one count.
+        wf.accumulate({"detector": staged([1, 2, 3], [3e7] * 3)})
+        out = wf.finalize()
+        per_band = out["dspacing_two_theta"].values.sum(axis=0)
+        binned = int(out["dspacing_cumulative"].values.sum())
+        assert binned == per_band.sum()
+        assert (per_band <= 1).all()  # distinct angles -> distinct bands
+
+    def test_focussed_tof_coords(self):
+        from esslivedata_tpu.ops.chopper_cascade import ALPHA_NS_PER_M_A
+
+        wf = self.make(two_theta_bins=2, d_bins=10)
+        out = wf.finalize()
+        tof = out["focussed_tof"].coords["tof"].numpy
+        # DIFC for the mean geometry: L=80, mean 2theta=90 deg.
+        difc = ALPHA_NS_PER_M_A * 80.0 * 2.0 * np.sin(np.deg2rad(45.0))
+        d_edges = np.linspace(0.4, 2.8, 11)
+        np.testing.assert_allclose(tof, d_edges * difc)
+        assert repr(out["focussed_tof"].coords["tof"].unit) == "ns"
+
+
+def test_two_theta_bins_validated():
+    with pytest.raises(ValueError):
+        PowderDiffractionParams(two_theta_bins=0)
