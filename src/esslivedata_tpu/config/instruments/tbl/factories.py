@@ -4,9 +4,11 @@ from __future__ import annotations
 
 from functools import lru_cache
 
+from ....workflows.area_detector_view import AreaDetectorView
 from ....workflows.detector_view.projectors import (
     ProjectionTable,
     project_logical,
+    project_logical_nd,
 )
 from ....workflows.detector_view.workflow import DetectorViewWorkflow
 from ....workflows.monitor_workflow import MonitorWorkflow
@@ -14,22 +16,62 @@ from ....workflows.timeseries import TimeseriesWorkflow
 from ....workflows.wavelength_lut_workflow import WavelengthLutWorkflow
 from .specs import (
     CHOPPER_GEOMETRY,
+    HE3_VIEW_HANDLE,
     INSTRUMENT,
     MONITOR_HANDLE,
+    MULTIBLADE_VIEW,
+    MULTIBLADE_VIEW_HANDLE,
+    NGEM_VIEW_HANDLE,
+    ORCA_VIEW_HANDLE,
     PANEL_VIEW_HANDLE,
+    TIMEPIX3_VIEW_HANDLE,
     TIMESERIES_HANDLE,
     WAVELENGTH_LUT_HANDLE,
 )
 
 
 @lru_cache(maxsize=None)
-def _projection() -> ProjectionTable:
-    return project_logical(INSTRUMENT.detectors["panel"].detector_number)
+def _logical_projection(name: str) -> ProjectionTable:
+    return project_logical(INSTRUMENT.detectors[name].detector_number)
 
 
-@PANEL_VIEW_HANDLE.attach_factory
-def make_panel_view(*, source_name: str, params) -> DetectorViewWorkflow:  # noqa: ARG001
-    return DetectorViewWorkflow(projection=_projection(), params=params)
+def _logical_view_factory():
+    def factory(*, source_name: str, params) -> DetectorViewWorkflow:
+        return DetectorViewWorkflow(
+            projection=_logical_projection(source_name),
+            params=params,
+            primary_stream=source_name,
+        )
+
+    return factory
+
+
+make_panel_view = PANEL_VIEW_HANDLE.attach_factory(_logical_view_factory())
+make_timepix3_view = TIMEPIX3_VIEW_HANDLE.attach_factory(
+    _logical_view_factory()
+)
+make_he3_view = HE3_VIEW_HANDLE.attach_factory(_logical_view_factory())
+make_ngem_view = NGEM_VIEW_HANDLE.attach_factory(_logical_view_factory())
+
+
+@lru_cache(maxsize=None)
+def _multiblade_projection() -> ProjectionTable:
+    return project_logical_nd(
+        INSTRUMENT.detectors["multiblade_detector"].detector_number,
+        MULTIBLADE_VIEW,
+    )
+
+
+@MULTIBLADE_VIEW_HANDLE.attach_factory
+def make_multiblade_view(*, source_name: str, params) -> DetectorViewWorkflow:  # noqa: ARG001
+    return DetectorViewWorkflow(
+        projection=_multiblade_projection(), params=params
+    )
+
+
+@ORCA_VIEW_HANDLE.attach_factory
+def make_orca_view(*, source_name: str, params) -> AreaDetectorView:  # noqa: ARG001
+    return AreaDetectorView(params=params)
 
 
 @WAVELENGTH_LUT_HANDLE.attach_factory
