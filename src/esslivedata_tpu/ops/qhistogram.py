@@ -29,6 +29,7 @@ __all__ = [
     "build_qe_map",
     "build_qz_map",
     "build_sans_qmap",
+    "table_scatter_delta",
 ]
 
 #: meV per (m/s)^2 — E = 1/2 m_n v^2 in neutron units.
@@ -363,6 +364,37 @@ def build_elastic_q2d_map(
     )
 
 
+def table_scatter_delta(
+    table,
+    pixel_id,
+    toa,
+    *,
+    id_base,
+    lo: float,
+    inv_width: float,
+    n_bins: int,
+    dtype,
+):
+    """Traceable event -> bin-delta core shared by the single-device and
+    table-sharded kernels: TOA binning, bank-local id shift, table
+    lookup, OOB-high drop, scatter-add into a dense [n_bins] delta.
+    ``id_base`` may be a traced value (the sharded kernel derives it
+    from the shard index)."""
+    n_pix, n_toa = table.shape
+    tb = jnp.floor((toa - lo) * inv_width).astype(jnp.int32)
+    hi = lo + n_toa / inv_width
+    t_ok = (toa >= lo) & (toa < hi)
+    tb = jnp.clip(tb, 0, n_toa - 1)
+    local = pixel_id - id_base
+    p_ok = (local >= 0) & (local < n_pix)
+    pid = jnp.clip(local, 0, n_pix - 1)
+    qb = table[pid, tb].astype(jnp.int32)
+    ok = p_ok & t_ok & (qb >= 0)
+    qb = jnp.where(ok, qb, n_bins)  # OOB-high: dropped
+    delta = jnp.zeros((n_bins,), dtype=dtype)
+    return delta.at[qb].add(1.0, mode="drop")
+
+
 class QHistogrammer:
     """Scatter-add into Q bins via a precompiled (pixel, toa_bin) map,
     with monitor counts accumulated on device for normalization."""
@@ -411,19 +443,16 @@ class QHistogrammer:
         )
 
     def _step_impl(self, state: QState, qmap, pixel_id, toa, monitor_count):
-        n_pix, n_toa = qmap.shape
-        tb = jnp.floor((toa - self._lo) * self._inv_width).astype(jnp.int32)
-        t_ok = (toa >= self._lo) & (toa < self._hi)
-        tb = jnp.clip(tb, 0, n_toa - 1)
-        # Bank-local table: shift global ids onto its rows first.
-        local = pixel_id - self._id_base
-        p_ok = (local >= 0) & (local < n_pix)
-        pid = jnp.clip(local, 0, n_pix - 1)
-        qb = qmap[pid, tb].astype(jnp.int32)
-        ok = p_ok & t_ok & (qb >= 0)
-        qb = jnp.where(ok, qb, self._n_q)  # OOB-high: dropped
-        delta = jnp.zeros((self._n_q,), dtype=self._dtype)
-        delta = delta.at[qb].add(1.0, mode="drop")
+        delta = table_scatter_delta(
+            qmap,
+            pixel_id,
+            toa,
+            id_base=self._id_base,
+            lo=self._lo,
+            inv_width=self._inv_width,
+            n_bins=self._n_q,
+            dtype=self._dtype,
+        )
         mc = jnp.asarray(monitor_count, dtype=self._dtype)
         return QState(
             cumulative=state.cumulative + delta,

@@ -13,5 +13,6 @@ system bus, unchanged.
 
 from .mesh import make_mesh
 from .sharded_hist import ShardedHistogrammer
+from .sharded_qhist import ShardedQHistogrammer
 
-__all__ = ["ShardedHistogrammer", "make_mesh"]
+__all__ = ["ShardedHistogrammer", "ShardedQHistogrammer", "make_mesh"]

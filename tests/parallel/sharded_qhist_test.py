@@ -1,0 +1,131 @@
+"""Table-row-sharded Q-family histogrammer: parity with the
+single-device QHistogrammer on an 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from esslivedata_tpu.ops.qhistogram import (
+    PixelBinMap,
+    QHistogrammer,
+    build_dspacing_map,
+)
+from esslivedata_tpu.parallel import ShardedQHistogrammer, make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the 8-device CPU mesh (conftest sets XLA_FLAGS)")
+    return make_mesh(4, bank=4)
+
+
+def make_map(n_pixel=37, id_base=100, n_toa=50, n_d=40):
+    rng = np.random.default_rng(0)
+    two_theta = rng.uniform(0.3, 2.4, n_pixel)
+    l_total = rng.uniform(60.0, 90.0, n_pixel)
+    ids = np.arange(id_base, id_base + n_pixel)
+    toa_edges = np.linspace(0.0, 7.1e7, n_toa + 1)
+    d_edges = np.linspace(0.4, 2.8, n_d + 1)
+    dmap = build_dspacing_map(
+        two_theta=two_theta,
+        l_total=l_total,
+        pixel_ids=ids,
+        toa_edges=toa_edges,
+        d_edges=d_edges,
+    )
+    return dmap, toa_edges, n_d, ids
+
+
+class TestParity:
+    def test_matches_unsharded(self, mesh):
+        dmap, toa_edges, n_d, ids = make_map()
+        ref = QHistogrammer(qmap=dmap, toa_edges=toa_edges, n_q=n_d)
+        sharded = ShardedQHistogrammer(
+            qmap=dmap, toa_edges=toa_edges, n_q=n_d, mesh=mesh
+        )
+        rng = np.random.default_rng(1)
+        pid = rng.choice(ids, 5000).astype(np.int32)
+        # include invalid ids on both sides of the bank range
+        pid[:10] = 5
+        pid[10:20] = ids[-1] + 1000
+        toa = rng.uniform(-1e6, 7.3e7, 5000).astype(np.float32)
+
+        from esslivedata_tpu.ops.event_batch import EventBatch
+
+        ref_state = ref.step(
+            ref.init_state(), EventBatch.from_arrays(pid, toa), 42.0
+        )
+        sh_state = sharded.step(sharded.init_state(), pid, toa, 42.0)
+        cum, win, mon_cum, mon_win = sharded.read(sh_state)
+        np.testing.assert_allclose(cum, np.asarray(ref_state.cumulative))
+        np.testing.assert_allclose(win, np.asarray(ref_state.window))
+        assert mon_cum == 42.0 and mon_win == 42.0
+
+    def test_row_padding_to_shard_boundary(self, mesh):
+        dmap, toa_edges, n_d, ids = make_map(n_pixel=37)
+        sharded = ShardedQHistogrammer(
+            qmap=dmap, toa_edges=toa_edges, n_q=n_d, mesh=mesh
+        )
+        # 37 rows over 4 shards -> padded to 40, 10 rows per shard.
+        assert sharded.rows_per_shard == 10
+
+    def test_swap_table_no_recompile(self, mesh):
+        dmap, toa_edges, n_d, ids = make_map()
+        sharded = ShardedQHistogrammer(
+            qmap=dmap, toa_edges=toa_edges, n_q=n_d, mesh=mesh
+        )
+        pid = np.resize(ids, 100).astype(np.int32)
+        toa = np.full(100, 3e7, dtype=np.float32)
+        state = sharded.step(sharded.init_state(), pid, toa)
+        before = sharded._step._cache_size()
+        # Rebuild with a different emission offset and swap.
+        rng = np.random.default_rng(0)
+        dmap2 = build_dspacing_map(
+            two_theta=rng.uniform(0.3, 2.4, 37),
+            l_total=rng.uniform(60.0, 90.0, 37),
+            pixel_ids=ids,
+            toa_edges=np.linspace(0.0, 7.1e7, 51),
+            d_edges=np.linspace(0.4, 2.8, 41),
+            toa_offset_ns=5e5,
+        )
+        sharded.swap_table(dmap2)
+        state = sharded.step(state, pid, toa)
+        assert sharded._step._cache_size() == before
+        cum, _, _, _ = sharded.read(state)
+        assert cum.sum() > 0
+
+    def test_swap_table_rejects_changed_base(self, mesh):
+        dmap, toa_edges, n_d, ids = make_map()
+        sharded = ShardedQHistogrammer(
+            qmap=dmap, toa_edges=toa_edges, n_q=n_d, mesh=mesh
+        )
+        bad = PixelBinMap(table=dmap.table, id_base=dmap.id_base + 1)
+        with pytest.raises(ValueError, match="id_base"):
+            sharded.swap_table(bad)
+
+    def test_window_fold(self, mesh):
+        dmap, toa_edges, n_d, ids = make_map()
+        sharded = ShardedQHistogrammer(
+            qmap=dmap, toa_edges=toa_edges, n_q=n_d, mesh=mesh
+        )
+        pid = np.resize(ids, 50).astype(np.int32)
+        toa = np.full(50, 3e7, dtype=np.float32)
+        state = sharded.step(sharded.init_state(), pid, toa)
+        state = sharded.clear_window(state)
+        cum, win, _, _ = sharded.read(state)
+        assert win.sum() == 0 and cum.sum() > 0
+
+
+def test_swap_table_rejects_changed_toa_binning(mesh_or_none=None):
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the multi-device CPU mesh")
+    mesh = make_mesh(4, bank=4)
+    dmap, toa_edges, n_d, ids = make_map(n_toa=50)
+    sharded = ShardedQHistogrammer(
+        qmap=dmap, toa_edges=toa_edges, n_q=n_d, mesh=mesh
+    )
+    dmap2, _, _, _ = make_map(n_toa=64)
+    with pytest.raises(ValueError, match="toa binning"):
+        sharded.swap_table(dmap2)
