@@ -371,6 +371,7 @@ def table_scatter_delta(
     *,
     id_base,
     lo: float,
+    hi: float,
     inv_width: float,
     n_bins: int,
     dtype,
@@ -382,7 +383,6 @@ def table_scatter_delta(
     from the shard index)."""
     n_pix, n_toa = table.shape
     tb = jnp.floor((toa - lo) * inv_width).astype(jnp.int32)
-    hi = lo + n_toa / inv_width
     t_ok = (toa >= lo) & (toa < hi)
     tb = jnp.clip(tb, 0, n_toa - 1)
     local = pixel_id - id_base
@@ -449,6 +449,7 @@ class QHistogrammer:
             toa,
             id_base=self._id_base,
             lo=self._lo,
+            hi=self._hi,
             inv_width=self._inv_width,
             n_bins=self._n_q,
             dtype=self._dtype,

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.event_batch import dispatch_safe, sanitize_pixel_id
 from ..ops.qhistogram import PixelBinMap, QState, table_scatter_delta
 
 __all__ = ["ShardedQHistogrammer"]
@@ -100,6 +101,7 @@ class ShardedQHistogrammer:
                 toa,
                 id_base=self._id_base + shard * rows,
                 lo=self._lo,
+                hi=self._hi,
                 inv_width=self._inv_width,
                 n_bins=self._n_q,
                 dtype=dtype,
@@ -154,8 +156,17 @@ class ShardedQHistogrammer:
     def step(
         self, state: QState, pixel_id, toa, monitor_count: float = 0.0
     ) -> QState:
-        pixel_id = self._replicate(jnp.asarray(pixel_id, dtype=jnp.int32))
-        toa = self._replicate(jnp.asarray(toa, dtype=jnp.float32))
+        # Same ingest-boundary guards as every other path: wide dtypes
+        # sanitize (no int32 wrap) and staging copies decouple reused
+        # host buffers from the async dispatch (event_batch.py).
+        if isinstance(pixel_id, np.ndarray):
+            pixel_id = sanitize_pixel_id(pixel_id)
+        pixel_id = self._replicate(
+            jnp.asarray(dispatch_safe(pixel_id), dtype=jnp.int32)
+        )
+        toa = self._replicate(
+            jnp.asarray(dispatch_safe(np.asarray(toa)), dtype=jnp.float32)
+        )
         return self._step(
             state,
             self._table,

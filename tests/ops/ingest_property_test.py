@@ -1,0 +1,112 @@
+"""Property tests for the round-3 ingest/reduction surface.
+
+Hypothesis sweeps over the places a hand-written example can miss: the
+pixel-id sanitize boundary (any integer dtype, any value), conservative
+rebinning (counts conserved under any edge refinement), and the
+vanadium acceptance invariants.
+"""
+
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+from hypothesis.extra.numpy import arrays, integer_dtypes
+
+from esslivedata_tpu.ops.event_batch import EventBatch, sanitize_pixel_id
+from esslivedata_tpu.workflows.monitor_workflow import rebin_1d
+from esslivedata_tpu.workflows.powder import vanadium_acceptance
+
+I32 = np.iinfo(np.int32)
+
+
+class TestSanitize:
+    @settings(max_examples=200, deadline=None)
+    @given(
+        arrays(
+            dtype=integer_dtypes(sizes=(8, 16, 32, 64)),
+            shape=st.integers(0, 50),
+        )
+    )
+    def test_every_output_fits_int32_and_in_range_values_survive(self, pid):
+        out = np.asarray(sanitize_pixel_id(pid))
+        # Every output value must be exactly representable in int32.
+        assert np.can_cast(out.dtype, np.int32) or (
+            (out >= I32.min) & (out <= I32.max)
+        ).all()
+        # tolist() yields exact Python ints for every integer dtype,
+        # including uint64 beyond 2^63.
+        for orig, o in zip(pid.tolist(), out.tolist(), strict=True):
+            if I32.min <= orig <= I32.max:
+                assert o == orig  # in-range ids never change
+            else:
+                assert o == -1  # out-of-range ids dump, never wrap
+
+    @settings(max_examples=100, deadline=None)
+    @given(
+        st.lists(
+            st.integers(-(2**40), 2**40), min_size=0, max_size=30
+        )
+    )
+    def test_from_arrays_never_wraps(self, ids):
+        pid = np.asarray(ids, dtype=np.int64)
+        batch = EventBatch.from_arrays(
+            pid, np.zeros(len(ids), dtype=np.float32), min_bucket=32
+        )
+        valid = batch.pixel_id[: batch.n_valid]
+        for orig, got in zip(ids, valid.tolist(), strict=True):
+            expected = orig if I32.min <= orig <= I32.max else -1
+            assert got == expected
+
+
+class TestRebinConservation:
+    @settings(max_examples=100, deadline=None)
+    @given(
+        values=st.lists(
+            st.floats(0.0, 1e6, allow_nan=False), min_size=1, max_size=20
+        ),
+        n_dst=st.integers(1, 40),
+        data=st.data(),
+    )
+    def test_counts_conserved_when_dst_covers_src(self, values, n_dst, data):
+        v = np.asarray(values)
+        src = np.linspace(0.0, 100.0, v.size + 1)
+        # Destination edges strictly cover the source span.
+        dst = np.linspace(-10.0, 110.0, n_dst + 1)
+        out = rebin_1d(v, src, dst)
+        np.testing.assert_allclose(out.sum(), v.sum(), rtol=1e-9)
+        assert (out >= -1e-9).all()
+
+    @settings(max_examples=50, deadline=None)
+    @given(
+        values=st.lists(
+            st.floats(0.0, 1e3, allow_nan=False), min_size=2, max_size=12
+        )
+    )
+    def test_identity_rebin(self, values):
+        v = np.asarray(values)
+        edges = np.linspace(0.0, 1.0, v.size + 1)
+        np.testing.assert_allclose(rebin_1d(v, edges, edges), v, rtol=1e-9)
+
+
+class TestVanadiumAcceptance:
+    @settings(max_examples=100, deadline=None)
+    @given(
+        arrays(
+            dtype=np.int32,
+            shape=st.tuples(st.integers(1, 8), st.integers(1, 8)),
+            elements=st.integers(-1, 9),
+        ),
+        st.integers(10, 12),
+    )
+    def test_mean_one_over_populated_and_zero_elsewhere(self, table, n_bins):
+        v = vanadium_acceptance(table, n_bins)
+        assert v.shape == (n_bins,)
+        assert (v >= 0).all()
+        populated = v > 0
+        if populated.any():
+            np.testing.assert_allclose(v[populated].mean(), 1.0, rtol=1e-9)
+        # Bins never referenced by the table must be exactly zero.
+        flat = table.reshape(-1)
+        referenced = set(flat[flat >= 0].tolist())
+        for b in range(n_bins):
+            if b not in referenced:
+                assert v[b] == 0.0

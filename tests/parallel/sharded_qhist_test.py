@@ -105,6 +105,15 @@ class TestParity:
         with pytest.raises(ValueError, match="id_base"):
             sharded.swap_table(bad)
 
+    def test_swap_table_rejects_changed_toa_binning(self, mesh):
+        dmap, toa_edges, n_d, ids = make_map(n_toa=50)
+        sharded = ShardedQHistogrammer(
+            qmap=dmap, toa_edges=toa_edges, n_q=n_d, mesh=mesh
+        )
+        dmap2, _, _, _ = make_map(n_toa=64)
+        with pytest.raises(ValueError, match="toa binning"):
+            sharded.swap_table(dmap2)
+
     def test_window_fold(self, mesh):
         dmap, toa_edges, n_d, ids = make_map()
         sharded = ShardedQHistogrammer(
@@ -116,16 +125,3 @@ class TestParity:
         state = sharded.clear_window(state)
         cum, win, _, _ = sharded.read(state)
         assert win.sum() == 0 and cum.sum() > 0
-
-
-def test_swap_table_rejects_changed_toa_binning(mesh_or_none=None):
-    if len(jax.devices()) < 4:
-        pytest.skip("needs the multi-device CPU mesh")
-    mesh = make_mesh(4, bank=4)
-    dmap, toa_edges, n_d, ids = make_map(n_toa=50)
-    sharded = ShardedQHistogrammer(
-        qmap=dmap, toa_edges=toa_edges, n_q=n_d, mesh=mesh
-    )
-    dmap2, _, _, _ = make_map(n_toa=64)
-    with pytest.raises(ValueError, match="toa binning"):
-        sharded.swap_table(dmap2)
