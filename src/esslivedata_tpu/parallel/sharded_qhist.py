@@ -158,14 +158,15 @@ class ShardedQHistogrammer:
     ) -> QState:
         # Same ingest-boundary guards as every other path: wide dtypes
         # sanitize (no int32 wrap) and staging copies decouple reused
-        # host buffers from the async dispatch (event_batch.py).
-        if isinstance(pixel_id, np.ndarray):
-            pixel_id = sanitize_pixel_id(pixel_id)
+        # host buffers from the async dispatch (event_batch.py). Device
+        # arrays pass through untouched (already int32/float32, no sync).
+        if not isinstance(pixel_id, jax.Array):
+            pixel_id = sanitize_pixel_id(np.asarray(pixel_id))
         pixel_id = self._replicate(
             jnp.asarray(dispatch_safe(pixel_id), dtype=jnp.int32)
         )
         toa = self._replicate(
-            jnp.asarray(dispatch_safe(np.asarray(toa)), dtype=jnp.float32)
+            jnp.asarray(dispatch_safe(toa), dtype=jnp.float32)
         )
         return self._step(
             state,

@@ -294,3 +294,25 @@ class TestTblDetectorZoo:
         b0 = INSTRUMENT.detectors["he3_detector_bank0"].detector_number
         b1 = INSTRUMENT.detectors["he3_detector_bank1"].detector_number
         assert set(b0.ravel()).isdisjoint(b1.ravel())
+
+
+def test_all_instrument_grid_templates_reference_real_outputs():
+    """Every template cell must name a registered workflow id and one of
+    its declared outputs — a renamed output must fail here, not render
+    an empty dashboard cell."""
+    from esslivedata_tpu.config.grid_template import load_grid_templates
+    from esslivedata_tpu.config.instrument import instrument_registry
+    from esslivedata_tpu.config.workflow_spec import WorkflowId
+    from esslivedata_tpu.workflows.workflow_factory import workflow_registry
+
+    checked = 0
+    for name in instrument_registry.names():
+        instrument_registry[name]  # import the package: registers specs
+        for spec in load_grid_templates(name):
+            for cell in spec.cells:
+                wid = WorkflowId.parse(cell.workflow)
+                assert wid in workflow_registry, (name, cell.workflow)
+                outputs = workflow_registry[wid].outputs
+                assert cell.output in outputs, (name, cell.workflow, cell.output)
+                checked += 1
+    assert checked > 20

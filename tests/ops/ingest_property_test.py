@@ -9,7 +9,11 @@ vanadium acceptance invariants.
 import numpy as np
 from hypothesis import given, settings
 from hypothesis import strategies as st
-from hypothesis.extra.numpy import arrays, integer_dtypes
+from hypothesis.extra.numpy import (
+    arrays,
+    integer_dtypes,
+    unsigned_integer_dtypes,
+)
 
 from esslivedata_tpu.ops.event_batch import EventBatch, sanitize_pixel_id
 from esslivedata_tpu.workflows.monitor_workflow import rebin_1d
@@ -22,7 +26,10 @@ class TestSanitize:
     @settings(max_examples=200, deadline=None)
     @given(
         arrays(
-            dtype=integer_dtypes(sizes=(8, 16, 32, 64)),
+            dtype=st.one_of(
+                integer_dtypes(sizes=(8, 16, 32, 64)),
+                unsigned_integer_dtypes(sizes=(8, 16, 32, 64)),
+            ),
             shape=st.integers(0, 50),
         )
     )
@@ -72,7 +79,9 @@ class TestRebinConservation:
         # Destination edges strictly cover the source span.
         dst = np.linspace(-10.0, 110.0, n_dst + 1)
         out = rebin_1d(v, src, dst)
-        np.testing.assert_allclose(out.sum(), v.sum(), rtol=1e-9)
+        # atol floor: subnormal inputs (hypothesis found 5e-324) underflow
+        # in the fractional-overlap multiply — not a conservation defect.
+        np.testing.assert_allclose(out.sum(), v.sum(), rtol=1e-9, atol=1e-290)
         assert (out >= -1e-9).all()
 
     @settings(max_examples=50, deadline=None)

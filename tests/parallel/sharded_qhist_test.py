@@ -125,3 +125,19 @@ class TestParity:
         state = sharded.clear_window(state)
         cum, win, _, _ = sharded.read(state)
         assert win.sum() == 0 and cum.sum() > 0
+
+
+def test_step_accepts_plain_lists_without_wrap():
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the multi-device CPU mesh")
+    mesh = make_mesh(4, bank=4)
+    dmap, toa_edges, n_d, ids = make_map()
+    sharded = ShardedQHistogrammer(
+        qmap=dmap, toa_edges=toa_edges, n_q=n_d, mesh=mesh
+    )
+    # A Python-list id beyond int32 must dump, not raise or wrap.
+    state = sharded.step(
+        sharded.init_state(), [int(ids[0]), 2**40], [3e7, 3e7]
+    )
+    cum, _, _, _ = sharded.read(state)
+    assert cum.sum() <= 1.0
