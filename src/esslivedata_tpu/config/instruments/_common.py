@@ -93,6 +93,10 @@ def register_monitor_spec(
             title="Monitor TOA histogram",
             source_names=instrument.monitor_names,
             params_model=MonitorParams,
+            # Per-monitor position logs ("{monitor}_position"), only for
+            # monitors whose instrument actually declares one — fixed
+            # monitors contribute nothing, so no dead routing entries.
+            optional_context_keys=monitor_position_streams(instrument),
             outputs={
                 "current": OutputSpec(title="Monitor (window)"),
                 "cumulative": OutputSpec(
@@ -139,6 +143,18 @@ def register_timeseries_spec(
             reset_on_run_transition=False,
         )
     )
+
+
+def monitor_position_streams(
+    instrument: "_instrument_mod.Instrument",
+) -> list[str]:
+    """Streams named ``{monitor}_position`` that the instrument declares
+    (reference geometry-signal reset-on-move, monitor_workflow.py:36)."""
+    return [
+        f"{m}_position"
+        for m in instrument.monitor_names
+        if f"{m}_position" in instrument.log_sources
+    ]
 
 
 def monitor_streams_from_aux(aux_source_names) -> set[str]:

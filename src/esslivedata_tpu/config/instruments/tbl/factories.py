@@ -80,8 +80,10 @@ def make_wavelength_lut(*, source_name: str, params) -> WavelengthLutWorkflow:  
 
 
 @MONITOR_HANDLE.attach_factory
-def make_monitor(*, source_name: str, params) -> MonitorWorkflow:  # noqa: ARG001
-    return MonitorWorkflow(params=params)
+def make_monitor(*, source_name: str, params) -> MonitorWorkflow:
+    return MonitorWorkflow(
+        params=params, position_stream=f"{source_name}_position"
+    )
 
 
 @TIMESERIES_HANDLE.attach_factory

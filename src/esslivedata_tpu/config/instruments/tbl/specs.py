@@ -125,6 +125,9 @@ INSTRUMENT.add_camera(
 )
 INSTRUMENT.add_monitor(MonitorConfig(name="monitor", source_name="tbl_mon_1"))
 INSTRUMENT.add_log("sample_temperature", "tbl_temp_1")
+# The TBL monitor rides a translation stage: its position log drives
+# the reset-on-move behavior of the monitor workflow.
+INSTRUMENT.add_log("monitor_position", "tbl_mon_pos")
 register_parsed_catalog(INSTRUMENT, PARSED_STREAMS)
 instrument_registry.register(INSTRUMENT)
 

@@ -41,6 +41,12 @@ class MonitorParams(BaseModel):
     wavelength_max: float = 12.0
     distance_m: float = 25.0  # source->monitor flight path (m)
     toa_offset_ns: float = 0.0  # emission-time / frame offset correction
+    # Position moves beyond this clear accumulation (reference:
+    # monitor_workflow.py:36 MONITOR_TRANSFORM geometry-signal coord —
+    # a moved monitor samples a different beam, so stale counts lie).
+    # In the position log's NATIVE units — set it per instrument to
+    # match what the positioner publishes (mm at ESS beamlines).
+    position_tolerance: float = 1.0
 
     @model_validator(mode="after")
     def _wavelength_mode_consistent(self) -> MonitorParams:
@@ -89,7 +95,12 @@ class MonitorWorkflow:
     """1-D monitor spectrum (TOA or wavelength axis), event- or
     histogram-mode."""
 
-    def __init__(self, *, params: MonitorParams | None = None) -> None:
+    def __init__(
+        self,
+        *,
+        params: MonitorParams | None = None,
+        position_stream: str | None = None,
+    ) -> None:
         params = params or MonitorParams()
         self._params = params
         if params.coordinate == "wavelength":
@@ -129,6 +140,31 @@ class MonitorWorkflow:
         # Dense-mode accumulation happens host-side (tiny arrays).
         self._dense_cumulative = np.zeros(params.toa_bins)
         self._dense_window = np.zeros(params.toa_bins)
+        # Which context stream carries this monitor's position, injected
+        # by the instrument factory (same pattern as the powder/
+        # reflectometry workflows' stream-name injection); None = fixed
+        # monitor, feature off. _position anchors at the last CLEAR (or
+        # first sample) — comparing against the last sample instead
+        # would let a slow scan creep arbitrarily far without reset.
+        self._position_stream = position_stream
+        self._position: float | None = None
+
+    def set_context(self, context: Mapping[str, Any]) -> None:
+        """Track the monitor's position (optional context stream): a move
+        beyond the tolerance clears accumulated spectra — a moved monitor
+        samples a different beam."""
+        from .qshared import latest_sample_value
+
+        if self._position_stream is None:
+            return
+        value = latest_sample_value(context.get(self._position_stream))
+        if value is None:
+            return
+        if self._position is None:
+            self._position = value
+        elif abs(value - self._position) > self._params.position_tolerance:
+            self.clear()
+            self._position = value
 
     def accumulate(self, data: Mapping[str, Any]) -> None:
         for value in data.values():

@@ -269,3 +269,59 @@ class TestWavelengthModeValidation:
         wf.accumulate({"monitor_1": da})
         out = wf.finalize()
         np.testing.assert_allclose(out["current"].values, [1.0, 0.0])
+
+
+class TestResetOnMove:
+    def log_sample(self, value):
+        return DataArray(
+            Variable(np.array([value]), ("time",), "mm"),
+            coords={"time": Variable(np.array([0]), ("time",), "ns")},
+        )
+
+    def make(self, tolerance=1.0):
+        return MonitorWorkflow(
+            params=MonitorParams(toa_bins=5, position_tolerance=tolerance),
+            position_stream="monitor_position",
+        )
+
+    def test_move_clears_accumulation(self):
+        wf = self.make()
+        wf.set_context({"monitor_position": self.log_sample(10.0)})
+        wf.accumulate({"monitor_1": stage_monitor([1e6, 2e6])})
+        wf.set_context({"monitor_position": self.log_sample(15.0)})
+        out = wf.finalize()
+        assert out["cumulative"].values.sum() == 0.0
+
+    def test_jitter_within_tolerance_keeps_counts(self):
+        wf = self.make()
+        wf.set_context({"monitor_position": self.log_sample(10.0)})
+        wf.accumulate({"monitor_1": stage_monitor([1e6])})
+        wf.set_context({"monitor_position": self.log_sample(10.5)})
+        out = wf.finalize()
+        assert out["cumulative"].values.sum() == 1.0
+
+    def test_slow_scan_cannot_creep_past_tolerance(self):
+        # Sub-tolerance steps must NOT re-anchor the baseline: the total
+        # excursion is what matters.
+        wf = self.make(tolerance=1.0)
+        wf.set_context({"monitor_position": self.log_sample(0.0)})
+        wf.accumulate({"monitor_1": stage_monitor([1e6])})
+        for pos in (0.4, 0.8, 1.2):  # each step 0.4 < tolerance
+            wf.set_context({"monitor_position": self.log_sample(pos)})
+        out = wf.finalize()
+        assert out["cumulative"].values.sum() == 0.0  # 1.2 > 1.0 cleared
+
+    def test_first_position_sample_never_clears(self):
+        wf = self.make()
+        wf.accumulate({"monitor_1": stage_monitor([1e6])})
+        wf.set_context({"monitor_position": self.log_sample(7.0)})
+        out = wf.finalize()
+        assert out["cumulative"].values.sum() == 1.0
+
+    def test_disabled_without_position_stream(self):
+        wf = MonitorWorkflow(params=MonitorParams(toa_bins=5))
+        wf.accumulate({"monitor_1": stage_monitor([1e6])})
+        wf.set_context({"monitor_position": self.log_sample(99.0)})
+        wf.set_context({"monitor_position": self.log_sample(0.0)})
+        out = wf.finalize()
+        assert out["cumulative"].values.sum() == 1.0
