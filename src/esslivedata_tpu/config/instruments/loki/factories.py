@@ -8,6 +8,7 @@ from ....workflows.detector_view.projectors import ProjectionTable, project_geom
 from ....workflows.detector_view.workflow import DetectorViewWorkflow
 from ....workflows.monitor_workflow import MonitorWorkflow
 from ....workflows.sans import SansIQWorkflow
+from ....workflows.wavelength_spectrum import WavelengthSpectrumWorkflow
 from ....workflows.timeseries import TimeseriesWorkflow
 from .specs import (
     DETECTOR_VIEW_HANDLE,
@@ -15,6 +16,7 @@ from .specs import (
     MONITOR_HANDLE,
     SANS_IQ_HANDLE,
     TIMESERIES_HANDLE,
+    WAVELENGTH_SPECTRUM_HANDLE,
 )
 
 
@@ -80,3 +82,21 @@ def make_sans_iq(*, source_name: str, params, aux_source_names=None) -> SansIQWo
 @TIMESERIES_HANDLE.attach_factory
 def make_timeseries(*, source_name: str, params) -> TimeseriesWorkflow:
     return TimeseriesWorkflow()
+
+
+@WAVELENGTH_SPECTRUM_HANDLE.attach_factory
+def make_wavelength_spectrum(
+    *, source_name: str, params, aux_source_names=None
+) -> WavelengthSpectrumWorkflow:
+    det = INSTRUMENT.detectors[source_name]
+    aux = aux_source_names or {}
+    monitors = (
+        {aux["monitor"]} if "monitor" in aux else set(INSTRUMENT.monitor_names)
+    )
+    return WavelengthSpectrumWorkflow(
+        positions=det.positions,
+        pixel_ids=det.pixel_ids,
+        params=params,
+        primary_stream=source_name,
+        monitor_streams=monitors,
+    )

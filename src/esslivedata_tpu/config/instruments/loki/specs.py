@@ -20,6 +20,7 @@ from ....config.workflow_spec import OutputSpec, WorkflowSpec
 from ....workflows.detector_view.workflow import DetectorViewParams
 from ....workflows.monitor_workflow import MonitorParams
 from ....workflows.sans import SansIQParams
+from ....workflows.wavelength_spectrum import WavelengthSpectrumParams
 from ....workflows.workflow_factory import workflow_registry
 from .._common import (
     detector_view_outputs,
@@ -113,6 +114,29 @@ SANS_IQ_HANDLE = workflow_registry.register_spec(
             "counts_q_current": OutputSpec(title="Q counts (window)"),
             "monitor_counts_current": OutputSpec(title="Monitor counts"),
             "transmission_current": OutputSpec(title="Transmission fraction"),
+        },
+    )
+)
+
+WAVELENGTH_SPECTRUM_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="loki",
+        namespace="sans",
+        name="wavelength_spectrum",
+        title="Detector wavelength spectrum",
+        source_names=INSTRUMENT.detector_names,
+        service="data_reduction",
+        aux_source_names={"monitor": INSTRUMENT.monitor_names},
+        params_model=WavelengthSpectrumParams,
+        outputs={
+            "wavelength_current": OutputSpec(title="I(lambda) (window)"),
+            "wavelength_cumulative": OutputSpec(
+                title="I(lambda) (since start)", view="since_start"
+            ),
+            "wavelength_normalized": OutputSpec(
+                title="I(lambda) / monitor", view="since_start"
+            ),
+            "counts_current": OutputSpec(title="Events binned"),
         },
     )
 )

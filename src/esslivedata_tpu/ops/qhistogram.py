@@ -29,6 +29,7 @@ __all__ = [
     "build_qe_map",
     "build_qz_map",
     "build_sans_qmap",
+    "build_wavelength_map",
     "table_scatter_delta",
 ]
 
@@ -276,6 +277,43 @@ def build_qe_map(
     return _assemble_map(
         pixel_ids, flat_bin, (len(q_edges) - 1) * n_e
     )
+
+
+def build_wavelength_map(
+    *,
+    l_total: np.ndarray,  # [n_pixel] moderator->sample->pixel path (m)
+    pixel_ids: np.ndarray,
+    toa_edges: np.ndarray,  # ns since pulse
+    wavelength_edges: np.ndarray,  # angstrom
+    toa_offset_ns: float = 0.0,
+) -> PixelBinMap:
+    """Precompile the per-pixel TOF->wavelength conversion into
+    ``map[pixel, toa_bin] -> wavelength bin``.
+
+    The monitor workflow can relabel its axis because one flight path
+    serves all events; a position-resolved detector has a different L
+    per pixel, so the same arrival time means a different wavelength in
+    every pixel — exactly the (pixel, toa) -> bin shape of this family
+    (the reference reaches wavelength via its unwrap LUT providers,
+    monitor_workflow.py:169 / detector_view providers).
+    """
+    l_total = np.asarray(l_total, dtype=np.float64)
+    toa_centers_s = _toa_centers_s(toa_edges, toa_offset_ns)
+    n_pixel = l_total.size
+    w_bin = np.empty((n_pixel, toa_centers_s.size), dtype=np.int32)
+    for lo in range(0, n_pixel, _MAP_CHUNK):
+        sl = slice(lo, min(lo + _MAP_CHUNK, n_pixel))
+        with np.errstate(divide="ignore", invalid="ignore"):
+            lam = H_OVER_MN * toa_centers_s[None, :] / l_total[sl, None]
+        wb = np.searchsorted(wavelength_edges, lam, side="right") - 1
+        ok = (
+            np.isfinite(lam)
+            & (wb >= 0)
+            & (lam < wavelength_edges[-1])
+        )
+        wb[~ok] = -1
+        w_bin[sl] = wb
+    return _assemble_map(pixel_ids, w_bin, len(wavelength_edges) - 1)
 
 
 def build_elastic_q2d_map(

@@ -1,0 +1,93 @@
+"""Per-pixel wavelength spectra (reference wavelength coordinate mode)."""
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.ops.event_batch import EventBatch
+from esslivedata_tpu.ops.qhistogram import build_wavelength_map
+from esslivedata_tpu.ops.chopper_cascade import ALPHA_NS_PER_M_A
+from esslivedata_tpu.preprocessors.event_data import StagedEvents
+from esslivedata_tpu.workflows.wavelength_spectrum import (
+    WavelengthSpectrumParams,
+    WavelengthSpectrumWorkflow,
+)
+
+
+def staged(pid, toa):
+    return StagedEvents(
+        batch=EventBatch.from_arrays(
+            np.asarray(pid, np.int32), np.asarray(toa, np.float32)
+        ),
+        first_timestamp=None,
+        last_timestamp=None,
+        n_chunks=1,
+    )
+
+
+class TestWavelengthMap:
+    def test_same_arrival_different_pixels_different_bins(self):
+        # Two pixels with different flight paths: one arrival time means
+        # two different wavelengths — this is why the monitor-style edge
+        # relabeling cannot work for detectors.
+        toa_edges = np.linspace(0.0, 7.1e7, 501)
+        lam_edges = np.linspace(0.5, 12.0, 116)  # 0.1 A bins
+        wmap = build_wavelength_map(
+            l_total=np.array([24.0, 28.0]),
+            pixel_ids=np.array([1, 2]),
+            toa_edges=toa_edges,
+            wavelength_edges=lam_edges,
+        )
+        t = 5.0 * 24.0 * ALPHA_NS_PER_M_A  # lambda=5.0 A at L=24
+        tb = int(np.searchsorted(toa_edges, t, "right")) - 1
+        b24, b28 = int(wmap.table[0, tb]), int(wmap.table[1, tb])
+        assert b24 >= 0 and b28 >= 0 and b24 != b28
+        # L=24 pixel sees exactly lambda 5.0.
+        assert abs((lam_edges[b24] + 0.05) - 5.0) < 0.11
+        # L=28 pixel sees 5.0 * 24/28.
+        assert abs((lam_edges[b28] + 0.05) - 5.0 * 24 / 28) < 0.11
+
+
+class TestWorkflow:
+    def make(self):
+        positions = np.array([[0.0, 0.0, 1.0], [0.0, 0.0, 5.0]])
+        return WavelengthSpectrumWorkflow(
+            positions=positions,
+            pixel_ids=np.array([1, 2]),
+            params=WavelengthSpectrumParams(wavelength_bins=50, l1=23.0),
+            primary_stream="det",
+            monitor_streams={"monitor_1"},
+        )
+
+    def test_events_bin_and_normalize(self):
+        wf = self.make()
+        t1 = 4.0 * 24.0 * ALPHA_NS_PER_M_A  # lambda=4 at L=23+1
+        t2 = 4.0 * 28.0 * ALPHA_NS_PER_M_A  # lambda=4 at L=23+5
+        wf.accumulate(
+            {
+                "det": staged([1, 2], [t1, t2]),
+                "monitor_1": staged(np.zeros(10, np.int32), np.ones(10)),
+            }
+        )
+        out = wf.finalize()
+        spec = out["wavelength_cumulative"].values
+        assert spec.sum() == 2.0
+        # Both events are lambda=4: one bin holds both counts.
+        assert spec.max() == 2.0
+        np.testing.assert_allclose(
+            out["wavelength_normalized"].values.sum(), 2.0 / 10.0
+        )
+
+    def test_params_validated(self):
+        with pytest.raises(ValueError, match="min < max"):
+            WavelengthSpectrumParams(wavelength_min=5.0, wavelength_max=1.0)
+
+
+def test_loki_registry_wiring():
+    from esslivedata_tpu.config.instrument import instrument_registry
+    from esslivedata_tpu.config.instruments.loki.specs import (
+        WAVELENGTH_SPECTRUM_HANDLE,
+    )
+    from esslivedata_tpu.workflows.workflow_factory import workflow_registry
+
+    instrument_registry["loki"].load_factories()
+    assert WAVELENGTH_SPECTRUM_HANDLE.workflow_id in workflow_registry
