@@ -464,7 +464,11 @@ def run_benchmark(args, platform: str) -> dict:
         )
 
     pid, toa = make_batch(args.events, args.pixels, seed=99)
-    baseline = bench_numpy_baseline(pid, toa, args.pixels, args.toa_bins, lo, hi)
+    fresh = bench_numpy_baseline(pid, toa, args.pixels, args.toa_bins, lo, hi)
+    # vs_baseline uses the PINNED constant from BASELINE.json when present
+    # so the ratio is comparable across rounds (the shared host's fresh
+    # measurement swings ~40% run to run); the fresh number rides along.
+    baseline = _pinned_baseline() or fresh
 
     if args.verbose:
         import jax
@@ -481,6 +485,8 @@ def run_benchmark(args, platform: str) -> dict:
         "value": ev_per_s,
         "unit": "events/s",
         "vs_baseline": ev_per_s / baseline,
+        "baseline_ev_s": baseline,
+        "baseline_fresh_ev_s": fresh,
         "platform": platform,
         "method": method,
         "window": "best-of-3",
@@ -525,6 +531,37 @@ def _child_main(args) -> int:
     return 0
 
 
+# The one in-flight subprocess (probe or measurement child): the SIGTERM
+# fail-open handler must kill it before exiting, or a driver-kill would
+# orphan it against the single-client relay with the flock released.
+_inflight: subprocess.Popen | None = None
+
+
+def _tracked_run(
+    cmd: list[str], env: dict, timeout_s: float, quiet_stderr: bool
+) -> tuple[int, str]:
+    """subprocess.run equivalent that records the child in ``_inflight``
+    and kills it on timeout; returns (rc, stdout). rc -1 = timeout."""
+    global _inflight
+    proc = subprocess.Popen(
+        cmd,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL if quiet_stderr else None,
+        text=True,
+    )
+    _inflight = proc
+    try:
+        stdout, _ = proc.communicate(timeout=timeout_s)
+        return proc.returncode, stdout or ""
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        stdout, _ = proc.communicate()
+        return -1, stdout or ""
+    finally:
+        _inflight = None
+
+
 def _run_child(timeout_s: float, force_cpu: bool) -> dict | None:
     """Re-exec this script as a measurement child; parse its JSON line.
 
@@ -536,27 +573,20 @@ def _run_child(timeout_s: float, force_cpu: bool) -> dict | None:
     env = {**os.environ, "_BENCH_CHILD": "1"}
     if force_cpu:
         env["_BENCH_FORCE_CPU"] = "1"
-    stdout = ""
     try:
-        out = subprocess.run(
+        rc, stdout = _tracked_run(
             [sys.executable, __file__, *sys.argv[1:]],
-            env=env,
-            stdout=subprocess.PIPE,
-            timeout=timeout_s,
-            text=True,
+            env,
+            timeout_s,
+            quiet_stderr=False,
         )
-        stdout = out.stdout or ""
-        rc = out.returncode
-    except subprocess.TimeoutExpired as exc:
-        # The child may have printed the graded line before hanging in a
-        # later section — salvage it from the captured output.
-        print(f"bench child timed out after {timeout_s}s", file=sys.stderr)
-        raw = exc.stdout or b""
-        stdout = raw.decode(errors="replace") if isinstance(raw, bytes) else raw
-        rc = -1
     except OSError as exc:
         print(f"bench child failed to start: {exc!r}", file=sys.stderr)
         return None
+    if rc == -1:
+        # The child may have printed the graded line before hanging in a
+        # later section — salvage it from the captured output.
+        print(f"bench child timed out after {timeout_s}s", file=sys.stderr)
     for line in reversed(stdout.strip().splitlines()):
         try:
             parsed = json.loads(line)
@@ -566,6 +596,124 @@ def _run_child(timeout_s: float, force_cpu: bool) -> dict | None:
             return parsed
     print(f"bench child rc={rc}, no JSON line", file=sys.stderr)
     return None
+
+
+def _pinned_baseline() -> float | None:
+    """The pinned single-threaded numpy baseline from BASELINE.json.
+
+    Pinned (with provenance) so ``vs_baseline`` is comparable across
+    rounds; the shared host's fresh measurement swings ~40%.
+    """
+    try:
+        doc = json.loads(
+            (Path(__file__).resolve().parent / "BASELINE.json").read_text()
+        )
+        return float(doc["pinned_baseline"]["events_per_sec"])
+    except (OSError, KeyError, ValueError, TypeError):
+        return None
+
+
+def _probe_main() -> int:
+    """Cheap TPU liveness probe (run as a subprocess under a watchdog).
+
+    ~10 s when the relay is healthy: backend init, a 1 MB device_put and
+    one tiny jitted execute — enough to prove init, transfer, compile and
+    run all work, without committing to the 90 s full measurement.
+    """
+    t0 = time.perf_counter()
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    x = jax.device_put(np.ones((262_144,), np.float32))  # 1 MB
+    y = jax.jit(lambda a: a * 2.0 + 1.0)(x)
+    float(jnp.sum(y))  # forces execute + device->host fetch
+    print(
+        json.dumps(
+            {
+                "probe": True,
+                "platform": dev.platform,
+                "init_s": round(time.perf_counter() - t0, 2),
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+def _run_probe(timeout_s: float = 60.0) -> dict:
+    """One probe attempt; returns {"ok", "platform"|"error", "t"}."""
+    t0 = time.time()
+    try:
+        rc, stdout = _tracked_run(
+            [sys.executable, __file__],
+            {**os.environ, "_BENCH_PROBE": "1"},
+            timeout_s,
+            quiet_stderr=True,
+        )
+    except OSError as exc:
+        return {"t": round(t0), "ok": False, "error": repr(exc)}
+    if rc == -1:
+        return {"t": round(t0), "ok": False, "error": f"timeout {timeout_s}s"}
+    parsed = None
+    for line in reversed(stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    if parsed and parsed.get("probe"):
+        platform = parsed.get("platform", "?")
+        return {
+            "t": round(t0),
+            "ok": platform not in ("cpu", "?"),
+            "platform": platform,
+            "init_s": parsed.get("init_s"),
+        }
+    return {"t": round(t0), "ok": False, "error": f"rc={rc}"}
+
+
+class _BenchLock:
+    """Exclusive cross-process lock on the TPU relay.
+
+    The relay serves ONE client at a time; the periodic sampler
+    (scripts/bench_loop.sh) and the driver's graded run both go through
+    bench.py, so an flock here is enough to keep them from colliding —
+    the graded run waits for an in-flight sample instead of failing
+    backend init.
+    """
+
+    def __init__(self, path: Path, wait_s: float):
+        self.path, self.wait_s, self._fh = path, wait_s, None
+
+    def __enter__(self):
+        import fcntl
+
+        try:
+            self._fh = open(self.path, "w")
+        except OSError as exc:
+            # Fail-open: an unwritable lock path must not take the graded
+            # line down — lockless is the pre-lock behavior anyway.
+            print(f"bench lock unavailable ({exc!r}); proceeding",
+                  file=sys.stderr)
+            return self
+        deadline = time.time() + self.wait_s
+        while True:
+            try:
+                fcntl.flock(self._fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                return self
+            except OSError:
+                if time.time() >= deadline:
+                    print(
+                        f"bench lock busy after {self.wait_s}s; proceeding",
+                        file=sys.stderr,
+                    )
+                    return self
+                time.sleep(5.0)
+
+    def __exit__(self, *exc):
+        if self._fh is not None:
+            self._fh.close()
 
 
 def _parse_args():
@@ -606,25 +754,99 @@ def _parse_args():
         "relay must fall back to the CPU line well before any outer driver "
         "timeout can expire.",
     )
+    parser.add_argument(
+        "--probe-budget",
+        type=float,
+        default=float(os.environ.get("BENCH_PROBE_BUDGET_S", 420.0)),
+        help="Total seconds to keep re-probing a dead relay before "
+        "committing to the CPU fallback. The sampler passes a small "
+        "value; the driver's graded run keeps the persistent default.",
+    )
+    parser.add_argument(
+        "--lock-wait",
+        type=float,
+        default=240.0,
+        help="Seconds to wait for the cross-process relay lock "
+        "(an in-flight sampler run) before proceeding anyway.",
+    )
     return parser.parse_args()
 
 
 def main() -> None:
     args = _parse_args()
+    if os.environ.get("_BENCH_PROBE") == "1":
+        sys.exit(_probe_main())
     if os.environ.get("_BENCH_CHILD") == "1":
         sys.exit(_child_main(args))
 
-    # Attempt 1: ambient platform (TPU when the relay is healthy).
-    result = _run_child(args.attempt_timeout, force_cpu=False)
+    # Fail-open on driver kill: if SIGTERM arrives mid-ladder, emit the
+    # best line we can (a held result, else a labeled stub with the
+    # pinned baseline) so the graded artifact is never empty.
+    import signal
+
+    held: dict = {
+        "metric": "loki_2d_pixel_tof_histogram_events_per_sec",
+        "value": _pinned_baseline() or 0.0,
+        "unit": "events/s",
+        "vs_baseline": 1.0,
+        "platform": "numpy-fallback",
+        "error": "killed before any measurement attempt completed",
+    }
+
+    def _on_term(signum, frame):
+        # Reap the in-flight subprocess first: orphaning it would hold the
+        # single-client relay with the flock already released. os.write is
+        # re-entrancy-safe where print() on a buffered stream is not.
+        proc = _inflight
+        if proc is not None:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+        os.write(1, (json.dumps(held) + "\n").encode())
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _on_term)
+
+    probe_history: list[dict] = []
+    result = None
+    with _BenchLock(Path(__file__).resolve().parent / ".bench_lock",
+                    args.lock_wait):
+        # Phase 1: cheap probes gate the expensive full run. On a dead
+        # relay each probe fails in <=60 s; keep retrying on a timer for
+        # --probe-budget so a relay that recovers mid-window is caught.
+        deadline = time.time() + args.probe_budget
+        while result is None:
+            probe = _run_probe()
+            probe_history.append(probe)
+            print(f"probe: {probe}", file=sys.stderr)
+            if probe["ok"]:
+                result = _run_child(args.attempt_timeout, force_cpu=False)
+                if result is not None:
+                    result["probe_history"] = probe_history[-40:]
+                    held = result  # fail-open now emits the real line
+                else:
+                    print(
+                        "full run failed after healthy probe; re-probing",
+                        file=sys.stderr,
+                    )
+            if result is None:
+                if time.time() >= deadline:
+                    break
+                time.sleep(20.0)
+
     if result is None:
-        # Attempt 2: CPU fallback, clearly labeled.
+        # Phase 2: CPU fallback, clearly labeled.
         print(
-            "ambient attempt failed or hung; retrying pinned to cpu",
+            f"no TPU within probe budget ({args.probe_budget:.0f}s); "
+            "measuring pinned to cpu",
             file=sys.stderr,
         )
         result = _run_child(args.attempt_timeout, force_cpu=True)
         if result is not None:
-            result["fallback"] = "ambient backend failed or hung; pinned cpu"
+            result["fallback"] = "relay down through probe window; pinned cpu"
+            result["probe_history"] = probe_history[-40:]
+            held = result
     if result is None:
         # Last-ditch fail-open: the graded line must still appear, labeled
         # as the numpy stand-in (vs_baseline 1.0 by construction).
@@ -642,6 +864,8 @@ def main() -> None:
             "platform": "numpy-fallback",
             "error": "both ambient and cpu measurement attempts failed",
         }
+    result.setdefault("probe_history", probe_history[-40:])
+    held = result
     print(json.dumps(result))
 
 

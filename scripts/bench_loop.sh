@@ -1,10 +1,19 @@
 #!/bin/bash
 # Periodic headline-bench sampler: captures relay-bandwidth variability
 # across the round. Appends one timestamped JSON line per attempt.
+#
+# Uses a SHORT probe budget so a dead relay costs one quick probe, and
+# rides bench.py's internal flock (.bench_lock) so a sample in flight
+# never collides with the driver's graded run — the graded run waits on
+# the lock instead of failing backend init.
 cd /root/repo
 while true; do
+  [ -e .stop_bench_loop ] && exit 0
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
-  line=$(timeout 400 python bench.py 2>/dev/null | tail -1)
+  line=$(timeout 650 python bench.py --probe-budget 30 --lock-wait 30 2>/dev/null | tail -1)
   echo "{\"ts\": \"$ts\", \"result\": ${line:-null}}" >> bench_log.jsonl
-  sleep 1500
+  for i in $(seq 150); do
+    [ -e .stop_bench_loop ] && exit 0
+    sleep 10
+  done
 done

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Lightweight relay liveness logger: one cheap probe every 3 minutes.
+# Appends "TIMESTAMP up|down" to relay_probe.log. Stop: touch .stop_bench_loop
+cd /root/repo
+while true; do
+  [ -e .stop_bench_loop ] && exit 0
+  ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  out=$(_BENCH_PROBE=1 timeout 60 python bench.py 2>/dev/null | tail -1)
+  if echo "$out" | grep -q '"platform": "tpu"'; then
+    echo "$ts up $out" >> relay_probe.log
+  else
+    echo "$ts down" >> relay_probe.log
+  fi
+  for i in $(seq 18); do
+    [ -e .stop_bench_loop ] && exit 0
+    sleep 10
+  done
+done
