@@ -77,8 +77,13 @@ def _aggregation_compatible(a: DataArray, b: DataArray) -> bool:
     def is_stamp(name: str) -> bool:
         # Stamp exemption is by name AND rank: a 1-D coord that happens
         # to be called start_time indexes data and must still compare.
+        # Membership checks FIRST: this is called for names from either
+        # side, and an entry carrying a stamp the other side lacks must
+        # fall through to the normal coord comparison (restarting the
+        # aggregate), not KeyError.
         return (
             name in _STAMP_COORDS
+            and name in a.coords
             and np.asarray(a.coords[name].numpy).ndim == 0
             and name in b.coords
             and np.asarray(b.coords[name].numpy).ndim == 0

@@ -21,6 +21,7 @@ from __future__ import annotations
 import base64
 import json
 import logging
+import re
 
 import numpy as np
 import tornado.web
@@ -511,9 +512,13 @@ class DataExportHandler(_Base):
             **{f"coord_{name}": values for name, values in coords.items()},
         )
         self.set_header("Content-Type", "application/octet-stream")
+        # Header-safe filename: quotes/control/non-ASCII in an output name
+        # would malform the quoted-string (RFC 6266) and break the parse
+        # in some clients.
+        safe = re.sub(r"[^A-Za-z0-9._-]+", "_", key.output_name) or "output"
         self.set_header(
             "Content-Disposition",
-            f'attachment; filename="{key.output_name}.npz"',
+            f'attachment; filename="{safe}.npz"',
         )
         self.write(buf.getvalue())
 

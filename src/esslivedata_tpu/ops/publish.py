@@ -44,15 +44,42 @@ class PackedPublisher:
         donate: tuple[int, ...] = (0,),
     ) -> None:
         self._program = program
-        # key -> shape, recorded while tracing (static for a given jit
-        # signature; retracing overwrites consistently with the cache
-        # entry being executed because shapes are part of the signature).
-        self._spec: list[tuple[str, tuple[int, ...]]] = []
+        # Output spec (key -> shape) PER input signature: a jit cache can
+        # hold several entries (state rebuilt with different bins, a new
+        # batch shape), and a cached entry executes without retracing — a
+        # single mutable spec would then unpack with whatever the *latest*
+        # trace recorded, silently mislabeling every output. ``__call__``
+        # stamps the signature being dispatched before invoking the jit so
+        # the trace-time hook files its spec under the right key.
+        self._spec_by_sig: dict[tuple, list[tuple[str, tuple[int, ...]]]] = {}
+        self._pending_sig: tuple | None = None
         self._jit = jax.jit(self._packed, donate_argnums=donate)
+
+    @staticmethod
+    def _signature(args) -> tuple:
+        # Leaves AND treedef: jit keys its cache on both, so two arg
+        # structures with identical flattened leaves must not share a
+        # spec entry.
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        return (
+            treedef,
+            tuple(
+                (tuple(getattr(leaf, "shape", ())),
+                 str(getattr(leaf, "dtype", type(leaf).__name__)))
+                for leaf in leaves
+            ),
+        )
+
+    def _trace_spec(self, args) -> list[tuple[str, tuple[int, ...]]]:
+        """Output spec for ``args`` via abstract evaluation (no compile)."""
+        out = jax.eval_shape(lambda *a: self._program(*a)[0], *args)
+        return [(k, tuple(v.shape)) for k, v in out.items()]
 
     def _packed(self, *args):
         outputs, *carry = self._program(*args)
-        self._spec = [(k, tuple(v.shape)) for k, v in outputs.items()]
+        spec = [(k, tuple(v.shape)) for k, v in outputs.items()]
+        if self._pending_sig is not None:
+            self._spec_by_sig[self._pending_sig] = spec
         if outputs:
             packed = jnp.concatenate(
                 [jnp.ravel(v).astype(jnp.float32) for v in outputs.values()]
@@ -62,11 +89,19 @@ class PackedPublisher:
         return (packed, *carry)
 
     def __call__(self, *args):
+        sig = self._signature(args)
+        self._pending_sig = sig
         packed, *carry = self._jit(*args)
+        spec = self._spec_by_sig.get(sig)
+        if spec is None:
+            # A cache hit under a host signature we have not seen (e.g. a
+            # python float where a np scalar was traced): derive the spec
+            # with an abstract eval of the program at this signature.
+            spec = self._spec_by_sig[sig] = self._trace_spec(args)
         flat = np.asarray(jax.device_get(packed))
         outputs: dict[str, np.ndarray] = {}
         offset = 0
-        for key, shape in self._spec:
+        for key, shape in spec:
             size = int(np.prod(shape)) if shape else 1
             view = flat[offset : offset + size]
             outputs[key] = view.reshape(shape) if shape else view[0]

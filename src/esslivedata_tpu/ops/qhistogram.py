@@ -535,8 +535,16 @@ class QHistogrammer:
             )
         if table.max(initial=-1) >= self._n_q:
             raise ValueError("qmap entries must be < n_q")
+        if table.shape != self._table_shape:
+            # Same check as ShardedQHistogrammer.swap_table: a table
+            # rebuilt against different TOA edges (or row count) would
+            # silently retrace and bin with the stale compiled lo/hi.
+            raise ValueError(
+                f"swap_table shape {table.shape} != compiled "
+                f"{self._table_shape}; rebuild the histogrammer for a "
+                "TOA-binning change"
+            )
         self._qmap = jnp.asarray(table)
-        self._table_shape = table.shape
 
     def fold_window(self, state: QState) -> QState:
         """Traceable window fold, for composition into fused publish

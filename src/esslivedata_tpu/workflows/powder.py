@@ -57,7 +57,9 @@ class PowderDiffractionParams(BaseModel):
     two_theta_bins: int = Field(default=8, ge=1)
 
 
-def vanadium_acceptance(table: np.ndarray, n_bins: int) -> np.ndarray:
+def vanadium_acceptance(
+    table: np.ndarray, n_bins: int, *, n_bands: int = 1
+) -> np.ndarray:
     """Per-d-bin instrument acceptance from the Bragg table itself.
 
     A vanadium run measures the incoherent (flat-in-d) response of the
@@ -71,9 +73,26 @@ def vanadium_acceptance(table: np.ndarray, n_bins: int) -> np.ndarray:
     acceptance stay 0 and are masked at division time. A measured
     vanadium spectrum can replace this via
     ``PowderVanadiumWorkflow.set_vanadium``.
+
+    ``n_bands``: the tables :class:`PowderDiffractionWorkflow` builds are
+    composite — entry ``d_bin * n_bands + band`` — so pass the workflow's
+    2-theta band count to decompose them back to d bins. The default 1
+    accepts raw ``build_dspacing_map`` tables whose entries are plain
+    d bins.
     """
-    flat = np.asarray(table).reshape(-1)
-    counts = np.bincount(flat[flat >= 0], minlength=n_bins).astype(np.float64)
+    from ..ops.qhistogram import _MAP_CHUNK
+
+    # Chunk over leading-axis rows (a same-shape reshape never copies,
+    # unlike reshape(-1) on a non-contiguous table).
+    arr = np.asarray(table)
+    rows = arr.reshape(1, -1) if arr.ndim == 1 else arr.reshape(arr.shape[0], -1)
+    rows_per_chunk = max(1, _MAP_CHUNK // rows.shape[1]) if rows.shape[1] else 1
+    counts = np.zeros(n_bins, dtype=np.float64)
+    # Chunked: no full-table boolean/quotient temporary.
+    for lo in range(0, rows.shape[0], rows_per_chunk):
+        sl = np.ravel(rows[lo : lo + rows_per_chunk])
+        valid = sl[sl >= 0].astype(np.int64) // n_bands
+        counts += np.bincount(valid, minlength=n_bins)
     populated = counts > 0
     if populated.any():
         counts[populated] /= counts[populated].mean()
@@ -255,18 +274,9 @@ class PowderVanadiumWorkflow(PowderDiffractionWorkflow):
         # host copy of the (large) table anywhere.
         table = super()._build_table()
         if self._measured_vanadium is None:
-            from ..ops.qhistogram import _MAP_CHUNK
-
-            # Chunked bincount of the d marginal: no full-table temporary.
-            counts = np.zeros(self._params.d_bins, dtype=np.float64)
-            for lo in range(0, table.table.shape[0], _MAP_CHUNK):
-                sl = table.table[lo : lo + _MAP_CHUNK]
-                valid = sl[sl >= 0].astype(np.int32) // self._n_bands
-                counts += np.bincount(valid, minlength=self._params.d_bins)
-            populated = counts > 0
-            if populated.any():
-                counts[populated] /= counts[populated].mean()
-            self._vanadium = counts
+            self._vanadium = vanadium_acceptance(
+                table.table, self._params.d_bins, n_bands=self._n_bands
+            )
         return table
 
     def set_vanadium(self, spectrum: np.ndarray) -> None:

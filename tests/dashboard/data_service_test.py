@@ -138,6 +138,29 @@ class TestDataService:
         agg = ds.get(k, WindowAggregatingExtractor(10.0))
         np.testing.assert_allclose(agg.values, [3.0, 3.0])
 
+    def test_window_aggregation_mixed_stamped_unstamped(self):
+        # An unstamped entry followed by stamped ones (or vice versa)
+        # must restart the aggregate, not KeyError inside the stamp
+        # exemption (round-3 advisor: is_stamp read a.coords[name]
+        # before checking membership).
+        from esslivedata_tpu.utils import Variable as V
+
+        ds = DataService()
+        k = key("current")
+        ds.subscribe(
+            DataSubscription({k}, lambda ks: None, WindowAggregatingExtractor(10.0))
+        )
+        plain = da_1d([1.0, 1.0])
+        stamped = da_1d([1.0, 1.0])
+        stamped.coords["start_time"] = V(np.asarray(5.0), (), "ns")
+        stamped.coords["end_time"] = V(np.asarray(6.0), (), "ns")
+        ds.put(k, T(int(1e9)), plain)
+        ds.put(k, T(int(2e9)), stamped)
+        agg = ds.get(k, WindowAggregatingExtractor(10.0))
+        # Structure changed at the stamped entry -> aggregate restarts
+        # there instead of crashing; only the stamped entry contributes.
+        np.testing.assert_allclose(agg.values, [1.0, 1.0])
+
     def test_generation_advances(self):
         ds = DataService()
         g0 = ds.generation

@@ -1,0 +1,69 @@
+"""PackedPublisher unit tests.
+
+The publisher compiles ``program(*args) -> (outputs, *carry)`` into one
+jitted execute + one device->host fetch; the host unpacks by an output
+spec recorded at trace time. The spec must be tracked PER input
+signature: a jit cache holds one entry per signature, cached entries
+execute without retracing, and unpacking a small-state execution with a
+large-state spec would silently mislabel every output (round-3 advisor,
+severity medium).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from esslivedata_tpu.ops.publish import PackedPublisher
+
+
+def _program(state, gain):
+    outputs = {
+        "image": state * gain,
+        "total": jnp.sum(state),
+    }
+    return outputs, state + 1.0
+
+
+class TestPackedPublisher:
+    def test_round_trip_shapes_and_values(self):
+        pub = PackedPublisher(_program)
+        state = jnp.ones((4, 3))
+        outputs, carry = pub(state, 2.0)
+        assert outputs["image"].shape == (4, 3)
+        np.testing.assert_allclose(outputs["image"], 2.0)
+        np.testing.assert_allclose(outputs["total"], 12.0)
+        np.testing.assert_allclose(np.asarray(carry), 2.0)
+
+    def test_empty_outputs(self):
+        pub = PackedPublisher(lambda s: ({}, s * 2.0))
+        outputs, carry = pub(jnp.ones((3,)))
+        assert outputs == {}
+        np.testing.assert_allclose(np.asarray(carry), 2.0)
+
+    def test_alternating_signatures_unpack_with_their_own_spec(self):
+        # Two cache entries (different state shapes) alternating: each
+        # call must unpack with the spec of ITS signature, not the most
+        # recently traced one.
+        pub = PackedPublisher(_program)
+        small = jnp.ones((2, 2))
+        big = jnp.full((5, 4), 3.0)
+        out_small, _ = pub(small, 1.0)   # trace 1
+        out_big, _ = pub(big, 1.0)       # trace 2 (spec overwrite hazard)
+        out_small2, _ = pub(jnp.ones((2, 2)), 1.0)  # cache hit on trace 1
+        assert out_small["image"].shape == (2, 2)
+        assert out_big["image"].shape == (5, 4)
+        assert out_small2["image"].shape == (2, 2)
+        np.testing.assert_allclose(out_small2["image"], 1.0)
+        np.testing.assert_allclose(out_small2["total"], 4.0)
+        np.testing.assert_allclose(out_big["total"], 60.0)
+
+    def test_unseen_host_signature_derives_spec_abstractly(self):
+        # A signature never dispatched through __call__ has no recorded
+        # spec; the publisher must derive one (eval_shape) rather than
+        # unpack with another signature's layout.
+        pub = PackedPublisher(_program)
+        pub(jnp.ones((2, 2)), 1.0)
+        # Forge the cache-hit-without-spec condition directly.
+        pub._spec_by_sig.clear()
+        outputs, _ = pub(jnp.ones((2, 2)), 1.0)
+        assert outputs["image"].shape == (2, 2)
+        np.testing.assert_allclose(outputs["total"], 4.0)

@@ -1,0 +1,60 @@
+"""Single-chip QHistogrammer.swap_table validation.
+
+Mirrors the sharded kernel's checks: a live table swap must keep the
+compiled geometry (id_base, TOA binning, row count) — a table rebuilt
+against different toa_edges would silently retrace the jitted step and
+bin events with the stale compiled lo/hi/inv_width (round-3 advisor).
+"""
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.ops.qhistogram import (
+    PixelBinMap,
+    QHistogrammer,
+    build_dspacing_map,
+)
+
+
+def make_map(n_pixel=17, id_base=40, n_toa=30, n_d=20):
+    rng = np.random.default_rng(1)
+    return build_dspacing_map(
+        two_theta=rng.uniform(0.3, 2.4, n_pixel),
+        l_total=rng.uniform(60.0, 90.0, n_pixel),
+        pixel_ids=np.arange(id_base, id_base + n_pixel),
+        toa_edges=np.linspace(0.0, 7.1e7, n_toa + 1),
+        d_edges=np.linspace(0.4, 2.8, n_d + 1),
+    )
+
+
+class TestSwapTableValidation:
+    def setup_method(self):
+        self.dmap = make_map()
+        self.hist = QHistogrammer(
+            qmap=self.dmap,
+            toa_edges=np.linspace(0.0, 7.1e7, 31),
+            n_q=20,
+        )
+
+    def test_same_shape_swap_accepted(self):
+        self.hist.swap_table(
+            PixelBinMap(table=self.dmap.table.copy(), id_base=self.dmap.id_base)
+        )
+
+    def test_changed_toa_binning_rejected(self):
+        bad = make_map(n_toa=44)
+        with pytest.raises(ValueError, match="shape"):
+            self.hist.swap_table(bad)
+
+    def test_changed_row_count_rejected(self):
+        bad = make_map(n_pixel=23)
+        with pytest.raises(ValueError, match="shape"):
+            self.hist.swap_table(
+                PixelBinMap(table=bad.table, id_base=self.dmap.id_base)
+            )
+
+    def test_changed_id_base_rejected(self):
+        with pytest.raises(ValueError, match="id_base"):
+            self.hist.swap_table(
+                PixelBinMap(table=self.dmap.table, id_base=99)
+            )
