@@ -25,7 +25,6 @@ from collections.abc import Iterable, Mapping
 from dataclasses import dataclass
 
 __all__ = [
-    "ChainPatchBinding",
     "ContextBinding",
     "Device",
     "F144Stream",
@@ -115,22 +114,6 @@ class ContextBinding:
     """
 
     stream_name: str
-    workflow_key: str
-    dependent_sources: frozenset[str]
-
-
-@dataclass(frozen=True, slots=True, kw_only=True)
-class ChainPatchBinding:
-    """Context binding specialized for live-geometry patching.
-
-    When a motor moves, the projection LUT must be rebuilt against the
-    updated transform chain. This record carries the resolved NeXus
-    ``transform_path`` alongside the binding so the rebuild is a pure
-    function of (record, new value) — no topology lookups at motion time.
-    """
-
-    stream_name: str
-    transform_path: str
     workflow_key: str
     dependent_sources: frozenset[str]
 

@@ -1,8 +1,7 @@
-"""Tests for route derivation, config loading, env, grid templates, ValueLog."""
+"""Tests for route derivation, config loading, env, grid templates."""
 
 from __future__ import annotations
 
-import numpy as np
 import pytest
 
 from esslivedata_tpu.config.config_loader import load_config
@@ -17,8 +16,6 @@ from esslivedata_tpu.config.route_derivation import (
     scope_stream_mapping,
     spec_service,
 )
-from esslivedata_tpu.config.value_log import ValueLog
-from esslivedata_tpu.utils.labeled import DataArray, Variable
 
 
 class TestSpecService:
@@ -216,19 +213,6 @@ class TestGridTemplates:
         )
         assert spec.min_rows == 3
         assert spec.min_cols == 3
-
-
-class TestValueLog:
-    def test_latest(self) -> None:
-        log = ValueLog(
-            values=DataArray(
-                Variable(np.array([1.0, 2.0, 3.5]), ("time",), "mm"),
-                coords={
-                    "time": Variable(np.array([1, 2, 3]), ("time",), "ns")
-                },
-            )
-        )
-        assert log.latest == 3.5
 
 
 class TestYamlSafeCredentials:
