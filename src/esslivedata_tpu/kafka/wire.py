@@ -9,22 +9,33 @@ equivalent of the reference's fast-path partial decode,
 message_adapter.py:360) and low-level Builder slots for encode.
 
 Schemas carry the standard 4-byte file identifiers (ev44, f144, da00, ad00,
-x5f2, pl72, 6s4t) with field layouts documented per codec below. Producers
-and consumers of *this* framework round-trip losslessly; byte-level
-compatibility with ECDC's generated code is approximated, not verified
-(no schema registry in this environment).
+x5f2, pl72, 6s4t). Field layouts (vtable slot ids, scalar widths, union
+tags, enum orderings) follow the vendored schema contract in
+``schemas/*.fbs`` and are VERIFIED against it by
+``tests/kafka/golden_wire_test.py``: an independent mini-.fbs parser +
+generic buffer walker checks every encoder's bytes field by field, and
+golden byte fixtures pin the exact serialization against drift. The
+schemas themselves are reconstructions of the public ECDC family (see
+schemas/README.md for the provenance caveat).
 
-Payload field conventions:
+Payload field conventions (wire layout per schemas/*.fbs; the Python
+dataclasses normalize where noted):
 - ev44: source_name, message_id, reference_time[] (ns epoch pulse times),
   reference_time_index[], time_of_flight[] (ns within pulse, int32),
-  pixel_id[] (int32; empty for monitors).
-- f144: source_name, value (float64 vector), timestamp (ns epoch).
+  pixel_id[] (int32; zero-length vector for monitors).
+- f144: source_name, value as a 20-member typed union (scalar and array
+  forms of i8..u64/f32/f64 with a hidden value_type tag), timestamp (ns
+  epoch). Decode normalizes every member to a float64 vector.
 - da00: source_name, timestamp (ns), variables[] each with name, unit,
-  axes[], shape[], dtype enum, raw data bytes.
-- ad00: source_name, timestamp (ns), dtype enum, shape[], raw data.
-- x5f2: software_name/version, service_id, host_name, process_id,
-  update_interval (ms), status_json.
-- pl72 / 6s4t: run start/stop with run_name + times (ns).
+  label, source, dtype enum (none..c_string), axes[], shape[] (int64),
+  raw data bytes.
+- ad00: source_name, frame id, timestamp (ns), dtype enum,
+  dimensions[] (int64), raw data.
+- x5f2: software_name/version, service_id, host_name, process_id (u32),
+  update_interval (ms, u32), status_json.
+- pl72: start/stop times (u64 ns), run_name, instrument_name, plus
+  nexus_structure/job_id/service_id when set. 6s4t: stop_time (u64 ns),
+  run_name, job_id/service_id/command_id when set.
 """
 
 from __future__ import annotations
@@ -76,6 +87,19 @@ def _np_vector(b: flatbuffers.Builder, arr: np.ndarray) -> int | None:
     arr = np.ascontiguousarray(arr)
     if arr.size == 0:
         return None
+    return b.CreateNumpyVector(arr)
+
+
+def _np_vector_required(b: flatbuffers.Builder, arr: np.ndarray) -> int:
+    """Vector for a schema slot marked ``(required)``: an empty input
+    writes an explicit zero-length vector (StartVector/EndVector — safe,
+    unlike this runtime's CreateNumpyVector on empty arrays) so the slot
+    is always present, as generated readers/verifiers expect."""
+    arr = np.ascontiguousarray(arr)
+    if arr.size == 0:
+        itemsize = max(arr.dtype.itemsize, 1)
+        b.StartVector(itemsize, 0, itemsize)
+        return b.EndVector()
     return b.CreateNumpyVector(arr)
 
 
@@ -232,27 +256,46 @@ class _Tbl:
 
 
 # ---------------------------------------------------------------------------
-# dtype enum shared by da00/ad00
+# dtype enums (per schema: da00 and ad00 declare DIFFERENT orderings)
 # ---------------------------------------------------------------------------
 
-_DTYPES: list[np.dtype] = [
+#: da00_dtype (schemas/da00_dataarray.fbs): none=0, then int8..float64,
+#: c_string=11. Index 0 and 11 have no numpy dtype (None sentinels).
+_DA00_DTYPES: list[np.dtype | None] = [
+    None,
     np.dtype(np.int8),
-    np.dtype(np.int16),
-    np.dtype(np.int32),
-    np.dtype(np.int64),
     np.dtype(np.uint8),
+    np.dtype(np.int16),
     np.dtype(np.uint16),
+    np.dtype(np.int32),
     np.dtype(np.uint32),
+    np.dtype(np.int64),
+    np.dtype(np.uint64),
+    np.dtype(np.float32),
+    np.dtype(np.float64),
+    None,  # c_string
+]
+_DA00_CODE = {dt: i for i, dt in enumerate(_DA00_DTYPES) if dt is not None}
+
+#: ad00 DType (schemas/ad00_area_detector_array.fbs): int8=0..float64=9.
+_AD00_DTYPES: list[np.dtype] = [
+    np.dtype(np.int8),
+    np.dtype(np.uint8),
+    np.dtype(np.int16),
+    np.dtype(np.uint16),
+    np.dtype(np.int32),
+    np.dtype(np.uint32),
+    np.dtype(np.int64),
     np.dtype(np.uint64),
     np.dtype(np.float32),
     np.dtype(np.float64),
 ]
-_DTYPE_CODE = {dt: i for i, dt in enumerate(_DTYPES)}
+_AD00_CODE = {dt: i for i, dt in enumerate(_AD00_DTYPES)}
 
 
-def _dtype_code(arr: np.ndarray) -> int:
+def _dtype_code(arr: np.ndarray, table: dict) -> int:
     try:
-        return _DTYPE_CODE[arr.dtype]
+        return table[arr.dtype]
     except KeyError as err:
         raise WireError(f"Unsupported wire dtype {arr.dtype}") from err
 
@@ -281,22 +324,30 @@ def encode_ev44(
     pixel_id: np.ndarray | None = None,
 ) -> bytes:
     b = flatbuffers.Builder(1024)
-    pid_off = None
-    if pixel_id is not None and len(pixel_id) > 0:
-        pid_off = _np_vector(b, np.ascontiguousarray(pixel_id, np.int32))
-    tof_off = _np_vector(b, np.ascontiguousarray(time_of_flight, np.int32))
-    rti_off = _np_vector(b, 
-        np.ascontiguousarray(reference_time_index, np.int32)
+    # All four vectors are (required) in the schema: empty inputs (e.g.
+    # pixel_id for monitor events) still write a zero-length vector.
+    if pixel_id is None:
+        pixel_id = np.empty(0, np.int32)
+    pid_off = _np_vector_required(
+        b, np.ascontiguousarray(pixel_id, np.int32)
     )
-    rt_off = _np_vector(b, np.ascontiguousarray(reference_time, np.int64))
+    tof_off = _np_vector_required(
+        b, np.ascontiguousarray(time_of_flight, np.int32)
+    )
+    rti_off = _np_vector_required(
+        b, np.ascontiguousarray(reference_time_index, np.int32)
+    )
+    rt_off = _np_vector_required(
+        b, np.ascontiguousarray(reference_time, np.int64)
+    )
     src_off = b.CreateString(source_name)
     b.StartObject(6)
     b.PrependUOffsetTRelativeSlot(0, src_off, 0)
     b.PrependInt64Slot(1, message_id, 0)
-    _prepend_vec_slot(b, 2, rt_off)
-    _prepend_vec_slot(b, 3, rti_off)
-    _prepend_vec_slot(b, 4, tof_off)
-    _prepend_vec_slot(b, 5, pid_off)
+    b.PrependUOffsetTRelativeSlot(2, rt_off, 0)
+    b.PrependUOffsetTRelativeSlot(3, rti_off, 0)
+    b.PrependUOffsetTRelativeSlot(4, tof_off, 0)
+    b.PrependUOffsetTRelativeSlot(5, pid_off, 0)
     b.Finish(b.EndObject(), file_identifier=b"ev44")
     return bytes(b.Output())
 
@@ -321,29 +372,82 @@ def decode_ev44(buf: bytes) -> Ev44Message:
 @dataclass(frozen=True, slots=True)
 class F144Message:
     source_name: str
-    value: np.ndarray  # float64
+    value: np.ndarray  # float64 (normalized; wire carries a typed union)
     timestamp_ns: int
 
 
+#: The f144 ``Value`` union, in declaration order (schemas/f144_logdata.fbs):
+#: tag 0 is NONE; 1-10 are scalar member tables, 11-20 array member tables.
+#: Every member table holds one ``value`` field at slot 0.
+_F144_SCALAR_MEMBERS: list[tuple[np.dtype, str]] = [
+    (np.dtype(np.int8), "<b"),
+    (np.dtype(np.uint8), "<B"),
+    (np.dtype(np.int16), "<h"),
+    (np.dtype(np.uint16), "<H"),
+    (np.dtype(np.int32), "<i"),
+    (np.dtype(np.uint32), "<I"),
+    (np.dtype(np.int64), "<q"),
+    (np.dtype(np.uint64), "<Q"),
+    (np.dtype(np.float32), "<f"),
+    (np.dtype(np.float64), "<d"),
+]
+_F144_TAG_DOUBLE = 10  # scalar Double
+_F144_TAG_ARRAY_DOUBLE = 20  # ArrayDouble
+
+
 def encode_f144(source_name: str, value, timestamp_ns: int) -> bytes:
+    """Scalar input -> a ``Double`` union member; array input ->
+    ``ArrayDouble``. The union adds the hidden ``value_type`` tag at the
+    slot before ``value`` — the layout ECDC's generated reader expects.
+    """
     b = flatbuffers.Builder(256)
-    val = np.atleast_1d(np.asarray(value, dtype=np.float64))
-    v_off = _np_vector(b, val)
+    val = np.asarray(value, dtype=np.float64)
+    scalar = val.ndim == 0
+    if scalar:
+        b.StartObject(1)
+        b.PrependFloat64Slot(0, float(val), 0.0)
+        member_off = b.EndObject()
+        tag = _F144_TAG_DOUBLE
+    else:
+        v_off = _np_vector(b, np.atleast_1d(val))
+        b.StartObject(1)
+        _prepend_vec_slot(b, 0, v_off)
+        member_off = b.EndObject()
+        tag = _F144_TAG_ARRAY_DOUBLE
     src_off = b.CreateString(source_name)
-    b.StartObject(3)
+    b.StartObject(4)
     b.PrependUOffsetTRelativeSlot(0, src_off, 0)
-    _prepend_vec_slot(b, 1, v_off)
-    b.PrependInt64Slot(2, timestamp_ns, 0)
+    b.PrependUint8Slot(1, tag, 0)
+    b.PrependUOffsetTRelativeSlot(2, member_off, 0)
+    b.PrependInt64Slot(3, timestamp_ns, 0)
     b.Finish(b.EndObject(), file_identifier=b"f144")
     return bytes(b.Output())
 
 
 def decode_f144(buf: bytes) -> F144Message:
+    """Accepts every ``Value`` union member, normalized to float64.
+
+    (u)int64 values above 2**53 lose precision in the normalization —
+    acceptable for the log-data domain this feeds (motor positions,
+    temperatures, chopper phases).
+    """
     t = _Tbl.root(buf, "f144")
+    tag = t.scalar(1, "<B")
+    member = t.table(2)
+    if member is None or not 1 <= tag <= 20:
+        raise WireError(f"f144 value union missing or bad tag {tag}")
+    if tag <= 10:
+        _, fmt = _F144_SCALAR_MEMBERS[tag - 1]
+        value = np.atleast_1d(
+            np.asarray(member.scalar(0, fmt), dtype=np.float64)
+        )
+    else:
+        dtype, _ = _F144_SCALAR_MEMBERS[tag - 11]
+        value = member.vector_np(0, dtype).astype(np.float64)
     return F144Message(
         source_name=t.string(0),
-        value=t.vector_np(1, np.float64),
-        timestamp_ns=t.scalar(2, "<q"),
+        value=value,
+        timestamp_ns=t.scalar(3, "<q"),
     )
 
 
@@ -358,6 +462,8 @@ class Da00Variable:
     unit: str
     axes: tuple[str, ...]
     data: np.ndarray  # shaped
+    label: str = ""
+    source: str = ""
 
 
 @dataclass(frozen=True, slots=True)
@@ -368,13 +474,15 @@ class Da00Message:
 
 
 def _encode_da00_variable(b: flatbuffers.Builder, var: Da00Variable) -> int:
+    # Slot layout per schemas/da00_dataarray.fbs: name=0, unit=1,
+    # label=2, source=3, data_type=4, axes=5, shape=6, data=7.
     # NB: np.ascontiguousarray promotes 0-d to 1-d — take the shape from
     # the original array so scalars stay scalars on the wire.
     shape = np.asarray(var.data).shape
     data = np.ascontiguousarray(var.data)
-    code = _dtype_code(data)
-    data_off = _np_vector(b, data.reshape(-1).view(np.uint8))
-    shape_off = _np_vector(b, np.asarray(shape, dtype=np.int32))
+    code = _dtype_code(data, _DA00_CODE)
+    data_off = _np_vector_required(b, data.reshape(-1).view(np.uint8))
+    shape_off = _np_vector(b, np.asarray(shape, dtype=np.int64))
     axes_vec = None
     if var.axes:
         axes_offs = [b.CreateString(a) for a in var.axes]
@@ -382,15 +490,21 @@ def _encode_da00_variable(b: flatbuffers.Builder, var: Da00Variable) -> int:
         for off in reversed(axes_offs):
             b.PrependUOffsetTRelative(off)
         axes_vec = b.EndVector()
+    source_off = b.CreateString(var.source) if var.source else None
+    label_off = b.CreateString(var.label) if var.label else None
     unit_off = b.CreateString(var.unit)
     name_off = b.CreateString(var.name)
-    b.StartObject(6)
+    b.StartObject(8)
     b.PrependUOffsetTRelativeSlot(0, name_off, 0)
     b.PrependUOffsetTRelativeSlot(1, unit_off, 0)
-    _prepend_vec_slot(b, 2, axes_vec)
-    _prepend_vec_slot(b, 3, shape_off)
+    if label_off is not None:
+        b.PrependUOffsetTRelativeSlot(2, label_off, 0)
+    if source_off is not None:
+        b.PrependUOffsetTRelativeSlot(3, source_off, 0)
     b.PrependInt8Slot(4, code, 0)
-    _prepend_vec_slot(b, 5, data_off)
+    _prepend_vec_slot(b, 5, axes_vec)
+    _prepend_vec_slot(b, 6, shape_off)
+    b.PrependUOffsetTRelativeSlot(7, data_off, 0)
     return b.EndObject()
 
 
@@ -414,16 +528,22 @@ def encode_da00(
 
 def _decode_da00_variable(t: _Tbl) -> Da00Variable:
     code = t.scalar(4, "<b")
-    if not 0 <= code < len(_DTYPES):
-        raise WireError(f"Bad dtype code {code}")
-    dtype = _DTYPES[code]
-    shape = tuple(int(s) for s in t.vector_np(3, np.int32))
-    raw = t.vector_np(5, np.uint8)
-    axes = tuple(t.strings(2))
+    dtype = (
+        _DA00_DTYPES[code] if 0 <= code < len(_DA00_DTYPES) else None
+    )
+    if dtype is None:
+        raise WireError(f"Bad or unsupported da00 dtype code {code}")
+    shape = tuple(int(s) for s in t.vector_np(6, np.int64))
+    raw = t.vector_np(7, np.uint8)
+    axes = tuple(t.strings(5))
     if shape:
         if any(s < 0 for s in shape):
             raise WireError(f"Negative dimension in da00 shape {shape}")
-        n_items = int(np.prod(shape))
+        # Python-int product: np.prod wraps in int64, so a hostile shape
+        # like [2**32, 2**32] would pass the size check as 0.
+        n_items = 1
+        for s in shape:
+            n_items *= s
     else:
         # Shape slot is omitted for 0-d (scalar) data; an absent shape with
         # axes present means a 1-d vector whose length comes from the data.
@@ -439,7 +559,14 @@ def _decode_da00_variable(t: _Tbl) -> Da00Variable:
     # Slice to the exact byte count first: view() on a length not divisible
     # by the itemsize would raise numpy's own error instead of WireError.
     data = raw[: n_items * dtype.itemsize].view(dtype).reshape(shape)
-    return Da00Variable(name=t.string(0), unit=t.string(1), axes=axes, data=data)
+    return Da00Variable(
+        name=t.string(0),
+        unit=t.string(1),
+        axes=axes,
+        data=data,
+        label=t.string(2),
+        source=t.string(3),
+    )
 
 
 def decode_da00(buf: bytes) -> Da00Message:
@@ -463,38 +590,57 @@ class Ad00Image:
     data: np.ndarray  # 2-D
 
 
-def encode_ad00(source_name: str, timestamp_ns: int, data: np.ndarray) -> bytes:
+def encode_ad00(
+    source_name: str,
+    timestamp_ns: int,
+    data: np.ndarray,
+    *,
+    frame_id: int = 0,
+) -> bytes:
+    # Slot layout per schemas/ad00_area_detector_array.fbs: source_name=0,
+    # id=1, timestamp=2, data_type=3, dimensions=4 (int64), data=5.
     data = np.ascontiguousarray(data)
     b = flatbuffers.Builder(4096)
-    code = _dtype_code(data)
-    data_off = _np_vector(b, data.reshape(-1).view(np.uint8))
-    shape_off = _np_vector(b, np.asarray(data.shape, dtype=np.int32))
+    code = _dtype_code(data, _AD00_CODE)
+    data_off = _np_vector_required(b, data.reshape(-1).view(np.uint8))
+    shape_off = _np_vector_required(
+        b, np.asarray(data.shape, dtype=np.int64)
+    )
     src_off = b.CreateString(source_name)
-    b.StartObject(5)
+    b.StartObject(6)
     b.PrependUOffsetTRelativeSlot(0, src_off, 0)
-    b.PrependInt64Slot(1, timestamp_ns, 0)
-    b.PrependInt8Slot(2, code, 0)
-    _prepend_vec_slot(b, 3, shape_off)
-    _prepend_vec_slot(b, 4, data_off)
+    b.PrependInt64Slot(1, frame_id, 0)
+    b.PrependInt64Slot(2, timestamp_ns, 0)
+    b.PrependInt8Slot(3, code, 0)
+    b.PrependUOffsetTRelativeSlot(4, shape_off, 0)
+    b.PrependUOffsetTRelativeSlot(5, data_off, 0)
     b.Finish(b.EndObject(), file_identifier=b"ad00")
     return bytes(b.Output())
 
 
 def decode_ad00(buf: bytes) -> Ad00Image:
     t = _Tbl.root(buf, "ad00")
-    code = t.scalar(2, "<b")
-    if not 0 <= code < len(_DTYPES):
+    code = t.scalar(3, "<b")
+    if not 0 <= code < len(_AD00_DTYPES):
         raise WireError(f"Bad dtype code {code}")
-    dtype = _DTYPES[code]
-    shape = tuple(int(s) for s in t.vector_np(3, np.int32))
-    raw = t.vector_np(4, np.uint8)
-    n_items = int(np.prod(shape)) if shape else 0
+    dtype = _AD00_DTYPES[code]
+    shape = tuple(int(s) for s in t.vector_np(4, np.int64))
+    if any(s < 0 for s in shape):
+        raise WireError(f"Negative dimension in ad00 shape {shape}")
+    raw = t.vector_np(5, np.uint8)
+    # Python-int product (np.prod wraps in int64 for hostile shapes).
+    n_items = 1 if shape else 0
+    for s in shape:
+        n_items *= s
     if raw.size < n_items * dtype.itemsize:
         raise WireError("ad00 data shorter than shape implies")
+    # Slice to the exact byte count BEFORE view(): a data vector whose
+    # length is not a multiple of the itemsize must fail the containment
+    # contract's way (WireError path above), not as numpy's ValueError.
     return Ad00Image(
         source_name=t.string(0),
-        timestamp_ns=t.scalar(1, "<q"),
-        data=raw.view(dtype)[:n_items].reshape(shape),
+        timestamp_ns=t.scalar(2, "<q"),
+        data=raw[: n_items * dtype.itemsize].view(dtype).reshape(shape),
     )
 
 
@@ -526,8 +672,8 @@ def encode_x5f2(status: X5f2Status) -> bytes:
     b.PrependUOffsetTRelativeSlot(1, ver_off, 0)
     b.PrependUOffsetTRelativeSlot(2, sid_off, 0)
     b.PrependUOffsetTRelativeSlot(3, host_off, 0)
-    b.PrependInt32Slot(4, status.process_id, 0)
-    b.PrependInt32Slot(5, status.update_interval_ms, 0)
+    b.PrependUint32Slot(4, status.process_id, 0)
+    b.PrependUint32Slot(5, status.update_interval_ms, 0)
     b.PrependUOffsetTRelativeSlot(6, js_off, 0)
     b.Finish(b.EndObject(), file_identifier=b"x5f2")
     return bytes(b.Output())
@@ -540,8 +686,8 @@ def decode_x5f2(buf: bytes) -> X5f2Status:
         software_version=t.string(1),
         service_id=t.string(2),
         host_name=t.string(3),
-        process_id=t.scalar(4, "<i"),
-        update_interval_ms=t.scalar(5, "<i"),
+        process_id=t.scalar(4, "<I"),
+        update_interval_ms=t.scalar(5, "<I"),
         status_json=t.string(6),
     )
 
@@ -557,23 +703,45 @@ class RunStartMessage:
     instrument_name: str
     start_time_ns: int
     stop_time_ns: int  # 0 = open-ended
+    job_id: str = ""
+    nexus_structure: str = ""
+    service_id: str = ""
 
 
 @dataclass(frozen=True, slots=True)
 class RunStopMessage:
     run_name: str
     stop_time_ns: int
+    job_id: str = ""
+    service_id: str = ""
+    command_id: str = ""
 
 
 def encode_pl72(msg: RunStartMessage) -> bytes:
+    # Slot layout per schemas/pl72_run_start.fbs: start_time=0,
+    # stop_time=1, run_name=2, instrument_name=3, nexus_structure=4,
+    # job_id=5, broker=6, service_id=7, filename=8, metadata=9,
+    # detector_spectrum_map=10, control_topic=11. Slots this framework
+    # does not populate are omitted (flatbuffers default semantics).
     b = flatbuffers.Builder(256)
+    sid_off = b.CreateString(msg.service_id) if msg.service_id else None
+    job_off = b.CreateString(msg.job_id) if msg.job_id else None
+    nx_off = (
+        b.CreateString(msg.nexus_structure) if msg.nexus_structure else None
+    )
     inst_off = b.CreateString(msg.instrument_name)
     run_off = b.CreateString(msg.run_name)
-    b.StartObject(4)
-    b.PrependUOffsetTRelativeSlot(0, run_off, 0)
-    b.PrependUOffsetTRelativeSlot(1, inst_off, 0)
-    b.PrependInt64Slot(2, msg.start_time_ns, 0)
-    b.PrependInt64Slot(3, msg.stop_time_ns, 0)
+    b.StartObject(12)
+    b.PrependUint64Slot(0, msg.start_time_ns, 0)
+    b.PrependUint64Slot(1, msg.stop_time_ns, 0)
+    b.PrependUOffsetTRelativeSlot(2, run_off, 0)
+    b.PrependUOffsetTRelativeSlot(3, inst_off, 0)
+    if nx_off is not None:
+        b.PrependUOffsetTRelativeSlot(4, nx_off, 0)
+    if job_off is not None:
+        b.PrependUOffsetTRelativeSlot(5, job_off, 0)
+    if sid_off is not None:
+        b.PrependUOffsetTRelativeSlot(7, sid_off, 0)
     b.Finish(b.EndObject(), file_identifier=b"pl72")
     return bytes(b.Output())
 
@@ -581,23 +749,43 @@ def encode_pl72(msg: RunStartMessage) -> bytes:
 def decode_pl72(buf: bytes) -> RunStartMessage:
     t = _Tbl.root(buf, "pl72")
     return RunStartMessage(
-        run_name=t.string(0),
-        instrument_name=t.string(1),
-        start_time_ns=t.scalar(2, "<q"),
-        stop_time_ns=t.scalar(3, "<q"),
+        run_name=t.string(2),
+        instrument_name=t.string(3),
+        start_time_ns=t.scalar(0, "<Q"),
+        stop_time_ns=t.scalar(1, "<Q"),
+        job_id=t.string(5),
+        nexus_structure=t.string(4),
+        service_id=t.string(7),
     )
 
 
 def encode_6s4t(msg: RunStopMessage) -> bytes:
+    # Slot layout per schemas/6s4t_run_stop.fbs: stop_time=0, run_name=1,
+    # job_id=2, service_id=3, command_id=4.
     b = flatbuffers.Builder(128)
+    cmd_off = b.CreateString(msg.command_id) if msg.command_id else None
+    sid_off = b.CreateString(msg.service_id) if msg.service_id else None
+    job_off = b.CreateString(msg.job_id) if msg.job_id else None
     run_off = b.CreateString(msg.run_name)
-    b.StartObject(2)
-    b.PrependUOffsetTRelativeSlot(0, run_off, 0)
-    b.PrependInt64Slot(1, msg.stop_time_ns, 0)
+    b.StartObject(5)
+    b.PrependUint64Slot(0, msg.stop_time_ns, 0)
+    b.PrependUOffsetTRelativeSlot(1, run_off, 0)
+    if job_off is not None:
+        b.PrependUOffsetTRelativeSlot(2, job_off, 0)
+    if sid_off is not None:
+        b.PrependUOffsetTRelativeSlot(3, sid_off, 0)
+    if cmd_off is not None:
+        b.PrependUOffsetTRelativeSlot(4, cmd_off, 0)
     b.Finish(b.EndObject(), file_identifier=b"6s4t")
     return bytes(b.Output())
 
 
 def decode_6s4t(buf: bytes) -> RunStopMessage:
     t = _Tbl.root(buf, "6s4t")
-    return RunStopMessage(run_name=t.string(0), stop_time_ns=t.scalar(1, "<q"))
+    return RunStopMessage(
+        run_name=t.string(1),
+        stop_time_ns=t.scalar(0, "<Q"),
+        job_id=t.string(2),
+        service_id=t.string(3),
+        command_id=t.string(4),
+    )
