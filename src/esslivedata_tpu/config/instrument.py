@@ -56,6 +56,15 @@ class DetectorConfig:
 class MonitorConfig:
     name: str
     source_name: str
+    #: Per-pixel event-id grid for PIXELLATED monitors (reference
+    #: instrument.py:401 configure_pixellated_monitor): monitors whose
+    #: ev44 stream carries meaningful pixel ids keep them through the
+    #: adapter (DetectorEvents payload) and can feed a 2-D monitor view.
+    detector_number: np.ndarray | None = None
+
+    @property
+    def pixellated(self) -> bool:
+        return self.detector_number is not None
 
 
 @dataclass
@@ -139,6 +148,25 @@ class Instrument:
 
     def add_monitor(self, config: MonitorConfig) -> None:
         self.monitors[config.name] = config
+
+    def configure_pixellated_monitor(
+        self, name: str, detector_number: np.ndarray
+    ) -> None:
+        """Mark a declared monitor as pixellated (reference
+        instrument.py:401): its ev44 pixel ids are preserved through the
+        adapter so a 2-D monitor view can consume them."""
+        if name not in self.monitors:
+            raise ValueError(
+                f"Source {name!r} not in declared monitors "
+                f"{sorted(self.monitors)}"
+            )
+        self.monitors[name].detector_number = np.asarray(detector_number)
+
+    @property
+    def pixellated_monitor_names(self) -> list[str]:
+        return sorted(
+            n for n, m in self.monitors.items() if m.pixellated
+        )
 
     def add_camera(self, config: CameraConfig) -> None:
         self.cameras[config.name] = config

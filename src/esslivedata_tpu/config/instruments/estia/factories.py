@@ -6,6 +6,7 @@ from functools import lru_cache
 
 from ....workflows.detector_view.projectors import (
     ProjectionTable,
+    project_logical,
     project_logical_nd,
 )
 from ....workflows.detector_view.workflow import DetectorViewWorkflow
@@ -16,6 +17,7 @@ from .._common import monitor_streams_from_aux
 from .specs import (
     INSTRUMENT,
     MONITOR_HANDLE,
+    PIXEL_MONITOR_VIEW_HANDLE,
     REFLECTOMETRY_HANDLE,
     TIMESERIES_HANDLE,
     VIEW_HANDLES,
@@ -41,6 +43,19 @@ for _view_name, _handle in VIEW_HANDLES.items():
 @MONITOR_HANDLE.attach_factory
 def make_monitor(*, source_name: str, params) -> MonitorWorkflow:  # noqa: ARG001
     return MonitorWorkflow(params=params)
+
+
+@lru_cache(maxsize=None)
+def _pixel_monitor_projection(name: str) -> ProjectionTable:
+    # The pixellated monitor's [ny, nx] grid IS the screen layout.
+    return project_logical(INSTRUMENT.monitors[name].detector_number)
+
+
+@PIXEL_MONITOR_VIEW_HANDLE.attach_factory
+def make_pixel_monitor_view(*, source_name: str, params) -> DetectorViewWorkflow:
+    return DetectorViewWorkflow(
+        projection=_pixel_monitor_projection(source_name), params=params
+    )
 
 
 @TIMESERIES_HANDLE.attach_factory

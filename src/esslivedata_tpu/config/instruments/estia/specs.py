@@ -58,6 +58,16 @@ INSTRUMENT.add_detector(
     )
 )
 INSTRUMENT.add_monitor(MonitorConfig(name="cbm1", source_name="estia_cbm1"))
+# cbm1 is a pixellated beam monitor (a small camera-style grid with
+# meaningful per-pixel event ids — reference instrument.py:401): pixel
+# ids survive the adapter and feed the 2-D monitor view below.
+PIXEL_MONITOR_SHAPE = (32, 32)
+INSTRUMENT.configure_pixellated_monitor(
+    "cbm1",
+    detector_number=np.arange(
+        1, PIXEL_MONITOR_SHAPE[0] * PIXEL_MONITOR_SHAPE[1] + 1, dtype=np.int32
+    ).reshape(PIXEL_MONITOR_SHAPE),
+)
 INSTRUMENT.add_log("sample_angle", "estia_mtr_omega")
 register_parsed_catalog(INSTRUMENT, PARSED_STREAMS)
 instrument_registry.register(INSTRUMENT)
@@ -79,6 +89,20 @@ VIEW_HANDLES = {
 
 MONITOR_HANDLE = register_monitor_spec(INSTRUMENT)
 TIMESERIES_HANDLE = register_timeseries_spec(INSTRUMENT)
+
+#: 2-D view over the pixellated beam monitor: same detector-view engine,
+#: projected through the monitor's logical pixel grid.
+PIXEL_MONITOR_VIEW_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="estia",
+        namespace="monitor_data",
+        name="pixel_view",
+        title="Beam monitor image",
+        source_names=INSTRUMENT.pixellated_monitor_names,
+        params_model=DetectorViewParams,
+        outputs=detector_view_outputs(),
+    )
+)
 
 
 def reflectometry_geometry() -> dict[str, np.ndarray]:

@@ -51,6 +51,7 @@ def get_stream_mapping(instrument: Instrument, dev: bool = False) -> StreamMappi
             InputStreamKey(topic=mon_topic, source_name=m.source_name): m.name
             for m in instrument.monitors.values()
         },
+        pixellated_monitors=frozenset(instrument.pixellated_monitor_names),
         area_detectors={
             InputStreamKey(topic=cam_topic, source_name=c.source_name): c.name
             for c in instrument.cameras.values()

@@ -128,7 +128,12 @@ class KafkaToDetectorEventsAdapter:
 
 class KafkaToMonitorEventsAdapter:
     """ev44 fast path for monitors: skips the pixel_id field entirely
-    (reference message_adapter.py:360)."""
+    (reference message_adapter.py:360) — EXCEPT for monitors registered
+    as pixellated (reference instrument.py:401), whose per-pixel event
+    ids are meaningful and ride through as a DetectorEvents payload so a
+    2-D monitor view can consume them. The stream kind stays
+    MONITOR_EVENTS either way (routing and job dispatch are by kind +
+    name; the payload type carries the pixel ids)."""
 
     def __init__(self, mapping: StreamMapping):
         self._mapping = mapping
@@ -143,12 +148,28 @@ class KafkaToMonitorEventsAdapter:
             if ev.reference_time.size
             else Timestamp.now()
         )
+        if (
+            name in self._mapping.pixellated_monitors
+            and ev.pixel_id.size == ev.time_of_flight.size
+            and ev.pixel_id.size > 0
+        ):
+            value = DetectorEvents(
+                pixel_id=ev.pixel_id,
+                time_of_arrival=ev.time_of_flight.astype(np.float32),
+            )
+        else:
+            # Plain monitors — and pixellated ones whose producer omitted
+            # ids (standard monitor ev44 carries an empty pixel_id
+            # vector): the id-skipping fast path. An empty-id message
+            # must NOT become DetectorEvents, or staging would size the
+            # append by len(pixel_id)=0 and silently drop every event.
+            value = MonitorEvents(
+                time_of_arrival=ev.time_of_flight.astype(np.float32)
+            )
         return Message(
             timestamp=ts,
             stream=StreamId(kind=StreamKind.MONITOR_EVENTS, name=name),
-            value=MonitorEvents(
-                time_of_arrival=ev.time_of_flight.astype(np.float32)
-            ),
+            value=value,
         )
 
 

@@ -62,6 +62,10 @@ class StreamMapping:
     monitors: Mapping[InputStreamKey, str] = field(default_factory=dict)
     area_detectors: Mapping[InputStreamKey, str] = field(default_factory=dict)
     logs: Mapping[InputStreamKey, str] = field(default_factory=dict)
+    #: Canonical monitor stream names whose ev44 pixel ids are meaningful:
+    #: the monitor adapter preserves them (DetectorEvents payload) instead
+    #: of taking the pixel-skipping fast path.
+    pixellated_monitors: frozenset[str] = frozenset()
     run_control_topics: tuple[str, ...] = ()
     dev: bool = False
     livedata: LivedataTopics | None = None
@@ -123,6 +127,7 @@ class StreamMapping:
                 k: v for k, v in self.area_detectors.items() if v in names
             },
             logs={k: v for k, v in self.logs.items() if v in names},
+            pixellated_monitors=self.pixellated_monitors & names,
             run_control_topics=self.run_control_topics,
             dev=self.dev,
             livedata=self.livedata,

@@ -169,7 +169,25 @@ class MonitorWorkflow:
     def accumulate(self, data: Mapping[str, Any]) -> None:
         for value in data.values():
             if isinstance(value, StagedEvents):
-                self._state = self._hist.step_batch(self._state, value.batch)
+                batch = value.batch
+                if batch.pixel_id.size and batch.pixel_id.max() > 0:
+                    # A pixellated monitor's staged events carry real
+                    # pixel ids; this 1-D TOA histogram is id-agnostic,
+                    # so fold every valid event onto screen row 0 (the
+                    # -1 padding sentinel stays excluded). Without the
+                    # clamp the n_screen=1 kernel would mask ids >= 1
+                    # and silently zero the spectrum.
+                    from ..ops import EventBatch
+
+                    batch = EventBatch(
+                        pixel_id=np.where(
+                            batch.pixel_id >= 0, 0, -1
+                        ).astype(np.int32),
+                        toa=batch.toa,
+                        n_valid=batch.n_valid,
+                        owner=batch.owner,
+                    )
+                self._state = self._hist.step_batch(self._state, batch)
             elif isinstance(value, DataArray):
                 self._add_dense(value)
 

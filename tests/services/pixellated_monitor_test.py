@@ -1,0 +1,213 @@
+"""Pixellated monitor: per-pixel ev44 ids survive the adapter and feed a
+2-D monitor view (reference instrument.py:401 configure_pixellated_monitor,
+message_adapter DetectorEvents emission for pixellated sources)."""
+
+import json
+
+import numpy as np
+
+from esslivedata_tpu.config import JobId, WorkflowConfig
+from esslivedata_tpu.core.message import StreamKind
+from esslivedata_tpu.core.message_batcher import NaiveMessageBatcher
+from esslivedata_tpu.kafka import wire
+from esslivedata_tpu.kafka.message_adapter import KafkaToMonitorEventsAdapter
+from esslivedata_tpu.kafka.sink import (
+    FakeProducer,
+    KafkaSink,
+    make_default_serializer,
+)
+from esslivedata_tpu.kafka.source import FakeKafkaMessage
+from esslivedata_tpu.preprocessors.event_data import (
+    DetectorEvents,
+    MonitorEvents,
+)
+from esslivedata_tpu.services.monitor_data import make_monitor_service_builder
+from esslivedata_tpu.services.fake_sources import PulsedRawSource
+
+
+def _ev44(source, pulse, ids, toa):
+    return wire.encode_ev44(
+        source,
+        pulse,
+        np.array([1_700_000_000_000_000_000 + pulse * 71_428_571], np.int64),
+        np.array([0], np.int32),
+        np.asarray(toa, np.int32),
+        pixel_id=np.asarray(ids, np.int32) if ids is not None else None,
+    )
+
+
+class TestAdapterPayloadSelection:
+    def _mapping(self):
+        from esslivedata_tpu.config.instruments.estia import INSTRUMENT
+        from esslivedata_tpu.config.streams import get_stream_mapping
+
+        return get_stream_mapping(INSTRUMENT)
+
+    def test_pixellated_monitor_keeps_pixel_ids(self):
+        adapter = KafkaToMonitorEventsAdapter(self._mapping())
+        msg = adapter.adapt(
+            FakeKafkaMessage(
+                _ev44("estia_cbm1", 1, [5, 6, 7], [10, 20, 30]),
+                "estia_monitor",
+            )
+        )
+        assert msg.stream.kind == StreamKind.MONITOR_EVENTS
+        assert isinstance(msg.value, DetectorEvents)
+        np.testing.assert_array_equal(msg.value.pixel_id, [5, 6, 7])
+
+    def test_pixellated_monitor_without_ids_falls_back(self):
+        # Standard monitor ev44 (empty pixel_id vector, the convention
+        # FakeMonitorStream and many real producers follow) must stay on
+        # the MonitorEvents fast path even for a pixellated monitor:
+        # DetectorEvents with 0 ids vs N toas would be silently dropped
+        # by staging (sized by len(pixel_id)).
+        adapter = KafkaToMonitorEventsAdapter(self._mapping())
+        msg = adapter.adapt(
+            FakeKafkaMessage(
+                _ev44("estia_cbm1", 1, None, [10, 20, 30]), "estia_monitor"
+            )
+        )
+        assert isinstance(msg.value, MonitorEvents)
+        assert msg.value.time_of_arrival.size == 3
+
+    def test_plain_monitor_takes_fast_path(self):
+        from esslivedata_tpu.config.instruments.loki import INSTRUMENT
+        from esslivedata_tpu.config.streams import get_stream_mapping
+
+        adapter = KafkaToMonitorEventsAdapter(get_stream_mapping(INSTRUMENT))
+        msg = adapter.adapt(
+            FakeKafkaMessage(
+                _ev44("loki_mon_1", 1, None, [10, 20, 30]), "loki_monitor"
+            )
+        )
+        assert isinstance(msg.value, MonitorEvents)
+
+
+class TestPixellatedMonitorService:
+    def test_monitor_view_produces_2d_image(self):
+        from esslivedata_tpu.config.instruments.estia import INSTRUMENT
+        from esslivedata_tpu.config.instruments.estia.specs import (
+            PIXEL_MONITOR_SHAPE,
+            PIXEL_MONITOR_VIEW_HANDLE,
+        )
+
+        builder = make_monitor_service_builder(
+            instrument="estia", batcher=NaiveMessageBatcher(), job_threads=1
+        )
+        raw = PulsedRawSource([])
+        producer = FakeProducer()
+        sink = KafkaSink(
+            producer,
+            make_default_serializer(builder.stream_mapping.livedata, "t"),
+        )
+        service = builder.from_raw_source(raw, sink)
+        config = WorkflowConfig(
+            identifier=PIXEL_MONITOR_VIEW_HANDLE.workflow_id,
+            job_id=JobId(source_name="cbm1"),
+            params={},
+        )
+        raw.inject(
+            FakeKafkaMessage(
+                json.dumps(
+                    {
+                        "kind": "start_job",
+                        "config": config.model_dump(mode="json"),
+                    }
+                ).encode(),
+                builder.stream_mapping.livedata.commands,
+            )
+        )
+        service.step()
+
+        grid = INSTRUMENT.monitors["cbm1"].detector_number
+        rng = np.random.default_rng(0)
+        ids = rng.choice(grid.reshape(-1), 3000)
+        toa = rng.integers(0, 70_000_000, 3000)
+        raw.inject(
+            FakeKafkaMessage(_ev44("estia_cbm1", 1, ids, toa), "estia_monitor")
+        )
+        service.step()
+
+        images = [
+            wire.decode_da00(m.value)
+            for m in producer.messages
+            if m.topic.endswith("_data")
+            and "image_current" in wire.decode_da00(m.value).source_name
+        ]
+        assert images, "no image output published"
+        signal = next(
+            v for v in images[-1].variables if v.name == "signal"
+        )
+        assert signal.data.shape == PIXEL_MONITOR_SHAPE
+        assert signal.data.sum() == 3000
+
+    def test_plain_histogram_job_still_counts_pixellated_events(self):
+        # The pre-existing 1-D monitor TOA histogram (and its
+        # monitor_counts NICOS device) must keep counting when its
+        # source's payload became DetectorEvents: the workflow folds all
+        # valid ids onto its single screen row instead of masking them.
+        from esslivedata_tpu.config.instruments.estia import INSTRUMENT
+        from esslivedata_tpu.config.instruments.estia.specs import (
+            MONITOR_HANDLE,
+        )
+
+        builder = make_monitor_service_builder(
+            instrument="estia", batcher=NaiveMessageBatcher(), job_threads=1
+        )
+        raw = PulsedRawSource([])
+        producer = FakeProducer()
+        sink = KafkaSink(
+            producer,
+            make_default_serializer(builder.stream_mapping.livedata, "t"),
+        )
+        service = builder.from_raw_source(raw, sink)
+        config = WorkflowConfig(
+            identifier=MONITOR_HANDLE.workflow_id,
+            job_id=JobId(source_name="cbm1"),
+            params={},
+        )
+        raw.inject(
+            FakeKafkaMessage(
+                json.dumps(
+                    {
+                        "kind": "start_job",
+                        "config": config.model_dump(mode="json"),
+                    }
+                ).encode(),
+                builder.stream_mapping.livedata.commands,
+            )
+        )
+        service.step()
+        grid = INSTRUMENT.monitors["cbm1"].detector_number
+        rng = np.random.default_rng(1)
+        # One message WITH pixel ids, one without (both real conventions).
+        raw.inject(
+            FakeKafkaMessage(
+                _ev44(
+                    "estia_cbm1",
+                    1,
+                    rng.choice(grid.reshape(-1), 500),
+                    rng.integers(0, 70_000_000, 500),
+                ),
+                "estia_monitor",
+            )
+        )
+        raw.inject(
+            FakeKafkaMessage(
+                _ev44("estia_cbm1", 2, None, rng.integers(0, 70_000_000, 250)),
+                "estia_monitor",
+            )
+        )
+        service.step()
+        service.step()
+        counts = [
+            wire.decode_da00(m.value)
+            for m in producer.messages
+            if m.topic.endswith("_data")
+            and "counts_cumulative" in wire.decode_da00(m.value).source_name
+        ]
+        assert counts, "no counts output published"
+        total = float(
+            np.asarray(counts[-1].variables[0].data, np.float64).sum()
+        )
+        assert total == 750.0
