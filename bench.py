@@ -40,6 +40,35 @@ def make_batch(n_events: int, n_pixel: int, seed: int) -> tuple[np.ndarray, np.n
     return pid, toa
 
 
+def make_replay_batches(
+    path: str, n_events: int, n_distinct: int, n_pixel: int
+):
+    """Batches drawn from a recorded NeXus event file (bench config 2
+    with a REAL pixel/TOF distribution instead of uniform random —
+    scripts/make_replay_nexus.py synthesizes one; any ESS recording with
+    NXevent_data works)."""
+    from esslivedata_tpu.ops import EventBatch
+    from esslivedata_tpu.services.fake_sources import load_nexus_events
+
+    recordings = load_nexus_events(path)
+    if not recordings:
+        raise SystemExit(f"--replay {path}: no recorded NXevent_data found")
+    rec = next(iter(recordings.values()))
+    ids = rec.event_id.astype(np.int32) % n_pixel
+    toa = rec.event_time_offset.astype(np.float32)
+    need = n_events * n_distinct
+    reps = -(-need // ids.size)
+    ids = np.tile(ids, reps)[:need]
+    toa = np.tile(toa, reps)[:need]
+    return [
+        EventBatch.from_arrays(
+            ids[i * n_events : (i + 1) * n_events],
+            toa[i * n_events : (i + 1) * n_events],
+        )
+        for i in range(n_distinct)
+    ]
+
+
 def bench_numpy_baseline(
     pid: np.ndarray, toa: np.ndarray, n_pixel: int, n_toa: int, lo: float, hi: float
 ) -> float:
@@ -371,10 +400,15 @@ def run_benchmark(args, platform: str) -> dict:
 
     # Pre-stage a few distinct batches so the device never sees cached inputs.
     n_distinct = 4
-    batches = [
-        EventBatch.from_arrays(*make_batch(args.events, args.pixels, seed=s))
-        for s in range(n_distinct)
-    ]
+    if args.replay:
+        batches = make_replay_batches(
+            args.replay, args.events, n_distinct, args.pixels
+        )
+    else:
+        batches = [
+            EventBatch.from_arrays(*make_batch(args.events, args.pixels, seed=s))
+            for s in range(n_distinct)
+        ]
 
     def calibrate(method: str) -> float:
         """Short timed run; returns events/s for one method."""
@@ -491,6 +525,8 @@ def run_benchmark(args, platform: str) -> dict:
         "method": method,
         "window": "best-of-3",
     }
+    if args.replay:
+        result["distribution"] = f"replayed:{Path(args.replay).name}"
     # The graded line goes out BEFORE the optional secondary sections: a
     # hang in those (e.g. a relay dying mid-run) must not discard a
     # completed headline measurement.
@@ -753,6 +789,13 @@ def _parse_args():
         "A healthy-TPU headline run finishes in ~90s incl. compile; a dead "
         "relay must fall back to the CPU line well before any outer driver "
         "timeout can expire.",
+    )
+    parser.add_argument(
+        "--replay",
+        default=None,
+        metavar="NEXUS_FILE",
+        help="draw headline batches from a recorded NeXus event file "
+        "(pixel ids wrapped into --pixels) instead of uniform random",
     )
     parser.add_argument(
         "--probe-budget",

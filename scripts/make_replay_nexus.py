@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Synthesize a NeXus event recording for the replay fakes.
+
+Produces an NXevent_data recording (event_id / event_time_offset /
+event_index / event_time_zero) with the statistical structure real
+recordings have and the synthetic gaussian fakes lack:
+
+- per-pulse raggedness: event counts are Poisson around the mean, so
+  replayed pulses vary in size exactly like beam data;
+- a structured pixel distribution: several bright Bragg-like spots over
+  a smooth background, not one drifting blob;
+- a multi-peak TOF spectrum (frame substructure) instead of uniform.
+
+Usage: python scripts/make_replay_nexus.py OUT.nxs
+         [--instrument dummy] [--detector NAME] [--pulses 200]
+         [--mean-events 1000] [--seed 7]
+
+The file replays through services.fake_sources.ReplayDetectorStream
+(--replay on the fake producer CLI) and bench.py --replay.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "src"))
+
+
+def synthesize_events(
+    ids: np.ndarray, n_pulses: int, mean_events: int, seed: int
+) -> dict[str, np.ndarray]:
+    """Recording arrays with ragged pulses + structured distributions."""
+    rng = np.random.default_rng(seed)
+    ids = np.asarray(ids).reshape(-1)
+    counts = rng.poisson(mean_events, n_pulses)
+    total = int(counts.sum())
+    event_index = np.concatenate([[0], np.cumsum(counts)[:-1]]).astype(
+        np.int64
+    )
+
+    # Pixel distribution: 5 hot spots (gaussian in id space) on a flat
+    # background.
+    spots = rng.uniform(0.1, 0.9, 5) * ids.size
+    widths = rng.uniform(0.01, 0.05, 5) * ids.size
+    weights = rng.uniform(0.5, 2.0, 5)
+    n_bg = int(total * 0.3)
+    n_spot = total - n_bg
+    per_spot = (weights / weights.sum() * n_spot).astype(int)
+    per_spot[0] += n_spot - per_spot.sum()
+    idx = np.concatenate(
+        [rng.integers(0, ids.size, n_bg)]
+        + [
+            rng.normal(c, w, k).astype(np.int64) % ids.size
+            for c, w, k in zip(spots, widths, per_spot)
+        ]
+    )
+    rng.shuffle(idx)
+    event_id = ids[idx].astype(np.int64)
+
+    # TOF: three frame peaks of different widths + flat tail. The flat
+    # part absorbs the per-peak int() truncation so the concatenation is
+    # EXACTLY total long (a short tof array would desynchronize the last
+    # pulse's vector lengths on the wire).
+    peaks = np.array([12e6, 31e6, 52e6])
+    sigma = np.array([2.5e6, 4e6, 1.5e6])
+    share = np.array([0.35, 0.4, 0.15])  # rest flat
+    n_peak = [int(total * f) for f in share]
+    n_flat = total - sum(n_peak)
+    parts = [rng.uniform(0, 71e6, n_flat)]
+    for p, s, k in zip(peaks, sigma, n_peak):
+        parts.append(rng.normal(p, s, k))
+    tof = np.concatenate(parts)
+    assert tof.size == total
+    rng.shuffle(tof)
+    event_time_offset = np.clip(tof, 0, 70_999_999).astype(np.int64)
+
+    pulse_period = int(1e9 / 14)
+    event_time_zero = (
+        1_700_000_000_000_000_000
+        + np.arange(n_pulses, dtype=np.int64) * pulse_period
+    )
+    return {
+        "event_id": event_id,
+        "event_time_offset": event_time_offset,
+        "event_index": event_index,
+        "event_time_zero": event_time_zero,
+    }
+
+
+def write_recording(
+    path: Path, name: str, arrays: dict[str, np.ndarray]
+) -> None:
+    import h5py
+
+    with h5py.File(path, "w") as f:
+        entry = f.create_group("entry")
+        entry.attrs["NX_class"] = "NXentry"
+        instr = entry.create_group("instrument")
+        instr.attrs["NX_class"] = "NXinstrument"
+        det = instr.create_group(name)
+        det.attrs["NX_class"] = "NXdetector"
+        ev = det.create_group(f"{name}_events")
+        ev.attrs["NX_class"] = "NXevent_data"
+        for key, arr in arrays.items():
+            ev.create_dataset(key, data=arr)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("out", type=Path)
+    parser.add_argument("--instrument", default="dummy")
+    parser.add_argument("--detector", default=None)
+    parser.add_argument("--pulses", type=int, default=200)
+    parser.add_argument("--mean-events", type=int, default=1000)
+    parser.add_argument("--seed", type=int, default=7)
+    args = parser.parse_args(argv)
+
+    from esslivedata_tpu.config.instrument import instrument_registry
+
+    instrument = instrument_registry[args.instrument]
+    det_name = args.detector or next(iter(instrument.detectors))
+    det = instrument.detectors[det_name]
+    ids = (
+        det.detector_number if det.detector_number is not None else det.pixel_ids
+    )
+    arrays = synthesize_events(ids, args.pulses, args.mean_events, args.seed)
+    write_recording(args.out, det_name, arrays)
+    print(
+        f"{args.out}: {det_name} {arrays['event_id'].size} events / "
+        f"{args.pulses} pulses"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -10,7 +10,11 @@ import time
 from ..config.instrument import instrument_registry
 from ..core.constants import PULSE_RATE_HZ
 from ..core.service import get_env_defaults, setup_arg_parser
-from .fake_sources import FakeDetectorStream
+from .fake_sources import (
+    FakeDetectorStream,
+    ReplayDetectorStream,
+    load_nexus_events,
+)
 
 __all__ = ["main"]
 
@@ -20,6 +24,15 @@ logger = logging.getLogger(__name__)
 def main(argv: list[str] | None = None) -> int:
     parser = setup_arg_parser("fake ev44 detector producer")
     parser.add_argument("--events-per-pulse", type=int, default=1000)
+    parser.add_argument(
+        "--replay",
+        default=None,
+        metavar="NEXUS_FILE",
+        help="replay recorded NXevent_data instead of synthesizing "
+        "(reference FakeDetectorSource nexus_file); banks present in the "
+        "recording replay with their recorded pixel/TOF distributions "
+        "and per-pulse raggedness, others stay synthetic",
+    )
     parser.add_argument("--kafka-bootstrap", default=None, help="override the broker from the kafka config namespace")
     parser.add_argument("--pulses", type=int, default=0, help="0 = run forever")
     parser.add_argument("--dry-run", action="store_true")
@@ -29,20 +42,39 @@ def main(argv: list[str] | None = None) -> int:
 
     instrument = instrument_registry[args.instrument]
     prefix = f"dev_{args.instrument}" if args.dev else args.instrument
-    streams = [
-        FakeDetectorStream(
-            topic=f"{prefix}_detector",
-            source_name=det.source_name,
-            detector_ids=(
-                det.detector_number
-                if det.detector_number is not None
-                else det.pixel_ids
-            ),
-            events_per_pulse=args.events_per_pulse,
-            seed=i,
+    recorded = {}
+    if args.replay:
+        recorded = load_nexus_events(args.replay)
+        logger.info(
+            "replaying %s: %s",
+            args.replay,
+            {k: v.n_events for k, v in recorded.items()},
         )
-        for i, det in enumerate(instrument.detectors.values())
-    ]
+    streams = []
+    for i, (name, det) in enumerate(instrument.detectors.items()):
+        if name in recorded:
+            streams.append(
+                ReplayDetectorStream(
+                    topic=f"{prefix}_detector",
+                    source_name=det.source_name,
+                    recorded=recorded[name],
+                    events_per_pulse=args.events_per_pulse,
+                )
+            )
+        else:
+            streams.append(
+                FakeDetectorStream(
+                    topic=f"{prefix}_detector",
+                    source_name=det.source_name,
+                    detector_ids=(
+                        det.detector_number
+                        if det.detector_number is not None
+                        else det.pixel_ids
+                    ),
+                    events_per_pulse=args.events_per_pulse,
+                    seed=i,
+                )
+            )
 
     producer = None
     if not args.dry_run:
