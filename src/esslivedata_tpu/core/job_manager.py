@@ -31,6 +31,7 @@ from ..config.workflow_spec import JobId, WorkflowConfig
 from ..workflows.workflow_factory import WorkflowFactory, workflow_registry
 from .job import Job, JobResult, JobState, JobStatus
 from .message import RunStart, RunStop
+from .state_snapshot import supports_snapshot
 from .timestamp import Timestamp
 
 __all__ = ["JobCommand", "JobFactory", "JobManager"]
@@ -121,8 +122,14 @@ class JobManager:
         *,
         job_factory: JobFactory | None = None,
         job_threads: int = 5,
+        snapshot_store=None,
     ) -> None:
         self._factory = job_factory or JobFactory()
+        #: Optional core.state_snapshot.SnapshotStore: device-resident
+        #: accumulation is dumped at run boundaries + shutdown and
+        #: restored when an identically-configured job is scheduled
+        #: (SURVEY §5 checkpoint note).
+        self._snapshot_store = snapshot_store
         self._records: dict[JobId, _JobRecord] = {}
         self._lock = threading.RLock()
         # Reset times scheduled by run transitions, sorted; each fires when
@@ -146,7 +153,58 @@ class JobManager:
             job = self._factory.create(config)
             self._records[config.job_id] = _JobRecord(job=job)
             logger.info("Scheduled job %s (%s)", config.job_id, config.identifier)
+            self._maybe_restore(job)
             return config.job_id
+
+    def _maybe_restore(self, job: Job) -> None:
+        """Adopt a prior process's accumulation for this configuration."""
+        store, wf = self._snapshot_store, job.workflow
+        if store is None or not supports_snapshot(wf):
+            return
+        try:
+            arrays = store.load(
+                workflow_id=str(job.workflow_id),
+                source_name=job.job_id.source_name,
+                fingerprint=wf.state_fingerprint(),
+            )
+            if arrays is not None and wf.restore_state(arrays):
+                logger.info(
+                    "Restored snapshot state for %s/%s",
+                    job.workflow_id,
+                    job.job_id.source_name,
+                )
+        except Exception:
+            logger.exception(
+                "Snapshot restore failed for %s; starting fresh", job.job_id
+            )
+
+    def _dump_snapshot(
+        self, rec: _JobRecord, reason: str, archive: bool = False
+    ) -> None:
+        store, wf = self._snapshot_store, rec.job.workflow
+        if store is None or not supports_snapshot(wf):
+            return
+        try:
+            store.save(
+                workflow_id=str(rec.job.workflow_id),
+                source_name=rec.job.job_id.source_name,
+                fingerprint=wf.state_fingerprint(),
+                arrays=wf.dump_state(),
+                reason=reason,
+                archive=archive,
+            )
+        except Exception:
+            logger.exception("Snapshot dump failed for %s", rec.job.job_id)
+
+    def dump_snapshots(self, reason: str = "shutdown") -> None:
+        # Every non-stopped job, INCLUDING still-scheduled ones: a job
+        # that restored a snapshot but never activated holds that
+        # accumulation only in its workflow — skipping it here would
+        # destroy it (the restore consumed the file).
+        with self._lock:
+            for rec in self._records.values():
+                if rec.phase != _Phase.STOPPED:
+                    self._dump_snapshot(rec, reason)
 
     def handle_command(self, command: JobCommand) -> int:
         """Apply ``command``; return how many jobs it acted on.
@@ -202,6 +260,14 @@ class JobManager:
         del self._pending_reset_times[:due]
         for rec in self._records.values():
             if rec.job.reset_on_run_transition:
+                # The run's final accumulation, captured before the reset
+                # wipes it (SURVEY §5: snapshot at run boundaries). Goes
+                # to the ARCHIVE key — restore never reads it, so a
+                # finished run can't be resurrected into a later job.
+                if rec.phase in (_Phase.ACTIVE, _Phase.PENDING_CONTEXT):
+                    self._dump_snapshot(
+                        rec, reason="run_boundary", archive=True
+                    )
                 self._reset_record(rec)
 
     def _reset_record(self, rec: _JobRecord) -> None:
@@ -452,5 +518,8 @@ class JobManager:
             return out
 
     def shutdown(self) -> None:
+        # Crash-recovery dump: a restarted service restores mid-run
+        # accumulation instead of starting from zero.
+        self.dump_snapshots(reason="shutdown")
         if self._executor is not None:
             self._executor.shutdown(wait=False)

@@ -18,6 +18,7 @@ def make_reduction_service_builder(
     batcher=None,
     job_threads: int = 5,
     heartbeat_interval_s: float = 2.0,
+    snapshot_dir: str | None = None,
 ) -> DataServiceBuilder:
     # Merged-detector instruments (BIFROST) address reductions at the
     # single logical stream; the reduction service must apply the same
@@ -45,6 +46,7 @@ def make_reduction_service_builder(
         job_threads=job_threads,
         dev=dev,
         heartbeat_interval_s=heartbeat_interval_s,
+        snapshot_dir=snapshot_dir,
     )
 
 

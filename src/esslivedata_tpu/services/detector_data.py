@@ -16,6 +16,7 @@ def make_detector_service_builder(
     batcher=None,
     job_threads: int = 5,
     heartbeat_interval_s: float = 2.0,
+    snapshot_dir: str | None = None,
 ) -> DataServiceBuilder:
     from ..config.instrument import instrument_registry
 
@@ -41,6 +42,7 @@ def make_detector_service_builder(
         job_threads=job_threads,
         dev=dev,
         heartbeat_interval_s=heartbeat_interval_s,
+        snapshot_dir=snapshot_dir,
     )
 
 

@@ -16,6 +16,7 @@ def make_monitor_service_builder(
     batcher=None,
     job_threads: int = 5,
     heartbeat_interval_s: float = 2.0,
+    snapshot_dir: str | None = None,
 ) -> DataServiceBuilder:
     def routes(mapping):
         return (
@@ -36,6 +37,7 @@ def make_monitor_service_builder(
         job_threads=job_threads,
         dev=dev,
         heartbeat_interval_s=heartbeat_interval_s,
+        snapshot_dir=snapshot_dir,
     )
 
 

@@ -66,6 +66,7 @@ class DataServiceBuilder:
         dev: bool = False,
         heartbeat_interval_s: float = 2.0,
         source_decorator: Callable | None = None,
+        snapshot_dir: str | None = None,
     ) -> None:
         self.instrument_name = instrument
         self.service_name = service_name
@@ -76,6 +77,16 @@ class DataServiceBuilder:
         self._dev = dev
         self._heartbeat_interval_s = heartbeat_interval_s
         self._source_decorator = source_decorator
+        # Histogram-state snapshots at run boundaries/shutdown (SURVEY §5):
+        # explicit argument wins; LIVEDATA_SNAPSHOT_DIR enables it for
+        # deployed services; unset = disabled.
+        import os as _os
+
+        self._snapshot_dir = (
+            snapshot_dir
+            if snapshot_dir is not None
+            else _os.environ.get("LIVEDATA_SNAPSHOT_DIR")
+        )
         self._instrument = instrument_registry[instrument]
         self._instrument.load_factories()
         # Subscribe only to streams the hosted specs consume (reference
@@ -103,8 +114,15 @@ class DataServiceBuilder:
             # In-process stream synthesis (ADR 0001): device merge, chopper
             # cascade — wraps the already-adapted source.
             source = self._source_decorator(source, self._instrument)
+        snapshot_store = None
+        if self._snapshot_dir:
+            from ..core.state_snapshot import SnapshotStore
+
+            snapshot_store = SnapshotStore(self._snapshot_dir)
         job_manager = JobManager(
-            job_factory=JobFactory(), job_threads=self._job_threads
+            job_factory=JobFactory(),
+            job_threads=self._job_threads,
+            snapshot_store=snapshot_store,
         )
         # Contract derived from this instrument's registered specs: outputs
         # listed in ``device_outputs`` ride the stable NICOS device stream.

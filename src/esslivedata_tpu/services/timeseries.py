@@ -38,6 +38,7 @@ def make_timeseries_service_builder(
     batcher=None,
     job_threads: int = 5,
     heartbeat_interval_s: float = 2.0,
+    snapshot_dir: str | None = None,
 ) -> DataServiceBuilder:
     def routes(mapping):
         return (
@@ -58,6 +59,7 @@ def make_timeseries_service_builder(
         dev=dev,
         heartbeat_interval_s=heartbeat_interval_s,
         source_decorator=_synthesizing_source,
+        snapshot_dir=snapshot_dir,
     )
 
 

@@ -355,6 +355,65 @@ class DetectorViewWorkflow:
     def clear(self) -> None:
         self._state = self._hist.clear(self._state)
 
+    # -- state snapshots (core/state_snapshot.py) --------------------------
+    def state_fingerprint(self) -> str:
+        """Hash over everything that gives the accumulated bins physical
+        meaning; a restored state with a different fingerprint would put
+        counts in bins that mean something else."""
+        import hashlib
+
+        h = hashlib.sha1()
+        h.update(np.ascontiguousarray(self._proj.lut).tobytes())
+        h.update(self._toa_edges_var.numpy.tobytes())
+        h.update(
+            f"{self._proj.ny}x{self._proj.nx}:{self._hist.n_toa}:".encode()
+        )
+        # Full params: two jobs differing in ANY parameter must not
+        # exchange state (they still share one snapshot file per
+        # workflow/source — last dump wins — but a mismatched restore is
+        # refused rather than silently adopted).
+        h.update(self._params.model_dump_json().encode())
+        return h.hexdigest()
+
+    def dump_state(self) -> dict[str, np.ndarray]:
+        """Host copy of the device accumulation (folded, window, scale)."""
+        out = {
+            "folded": np.asarray(self._state.folded),
+            "window": np.asarray(self._state.window),
+        }
+        if self._state.scale is not None:
+            out["scale"] = np.asarray(self._state.scale)
+        return out
+
+    def restore_state(self, arrays: dict[str, np.ndarray]) -> bool:
+        """Adopt a dumped accumulation; shape-checked against the current
+        kernel (fingerprint matching happens in the store, but a corrupt
+        file must not poison the device state)."""
+        folded = np.asarray(arrays.get("folded"))
+        window = np.asarray(arrays.get("window"))
+        want = self._state.folded.shape
+        if folded.shape != want or window.shape != want:
+            return False
+        has_scale = self._state.scale is not None
+        if has_scale != ("scale" in arrays):
+            return False
+        if has_scale and np.asarray(arrays["scale"]).shape != (
+            self._state.scale.shape
+        ):
+            return False
+        import jax.numpy as jnp
+
+        self._state = HistogramState(
+            folded=jnp.asarray(folded, dtype=self._state.folded.dtype),
+            window=jnp.asarray(window, dtype=self._state.window.dtype),
+            scale=(
+                jnp.asarray(arrays["scale"], dtype=self._state.scale.dtype)
+                if has_scale
+                else None
+            ),
+        )
+        return True
+
     # -- introspection -----------------------------------------------------
     @property
     def histogrammer(self) -> EventHistogrammer:

@@ -1,0 +1,297 @@
+"""HistogramState snapshot/restore across process restarts (SURVEY §5
+checkpoint note: device-resident histograms dumped at run boundaries and
+shutdown, restored when an identically-configured job is scheduled)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.config import JobId, WorkflowConfig
+from esslivedata_tpu.core.message_batcher import NaiveMessageBatcher
+from esslivedata_tpu.core.state_snapshot import SnapshotStore
+from esslivedata_tpu.kafka import wire
+from esslivedata_tpu.kafka.sink import (
+    FakeProducer,
+    KafkaSink,
+    make_default_serializer,
+)
+from esslivedata_tpu.kafka.source import FakeKafkaMessage
+from esslivedata_tpu.services.detector_data import make_detector_service_builder
+
+
+def _ev44(source, pulse, ids, toa):
+    t = 1_700_000_000_000_000_000 + pulse * 71_428_571
+    return wire.encode_ev44(
+        source,
+        pulse,
+        np.array([t], np.int64),
+        np.array([0], np.int32),
+        np.asarray(toa, np.int32),
+        pixel_id=np.asarray(ids, np.int32),
+    )
+
+
+class TestSnapshotStore:
+    def test_round_trip_and_one_shot(self, tmp_path):
+        store = SnapshotStore(tmp_path)
+        arrays = {"folded": np.arange(5.0), "window": np.zeros(5)}
+        store.save(
+            workflow_id="w/v1",
+            source_name="s",
+            fingerprint="f1",
+            arrays=arrays,
+            reason="test",
+        )
+        out = store.load(workflow_id="w/v1", source_name="s", fingerprint="f1")
+        np.testing.assert_array_equal(out["folded"], arrays["folded"])
+        # One-shot: consumed on successful restore.
+        assert (
+            store.load(workflow_id="w/v1", source_name="s", fingerprint="f1")
+            is None
+        )
+
+    def test_fingerprint_mismatch_keeps_file(self, tmp_path):
+        store = SnapshotStore(tmp_path)
+        store.save(
+            workflow_id="w/v1",
+            source_name="s",
+            fingerprint="f1",
+            arrays={"folded": np.ones(3)},
+        )
+        assert (
+            store.load(workflow_id="w/v1", source_name="s", fingerprint="OTHER")
+            is None
+        )
+        # Kept: a rollback to the old configuration can still restore.
+        assert (
+            store.load(workflow_id="w/v1", source_name="s", fingerprint="f1")
+            is not None
+        )
+
+
+class TestWorkflowDumpRestore:
+    def _workflow(self):
+        from esslivedata_tpu.workflows.detector_view.projectors import (
+            project_logical,
+        )
+        from esslivedata_tpu.workflows.detector_view.workflow import (
+            DetectorViewWorkflow,
+        )
+
+        grid = np.arange(1, 65, dtype=np.int32).reshape(8, 8)
+        return DetectorViewWorkflow(projection=project_logical(grid))
+
+    def test_round_trip(self):
+        from esslivedata_tpu.ops import EventBatch
+        from esslivedata_tpu.preprocessors.event_data import (
+            DetectorEvents,
+            ToEventBatch,
+        )
+        from esslivedata_tpu.core.timestamp import Timestamp
+
+        wf = self._workflow()
+        stage = ToEventBatch()
+        stage.add(
+            Timestamp.from_ns(1),
+            DetectorEvents(
+                pixel_id=np.arange(1, 33, dtype=np.int32),
+                time_of_arrival=np.full(32, 1e6, np.float32),
+            ),
+        )
+        wf.accumulate({"x": stage.get()})
+        dump = wf.dump_state()
+        wf2 = self._workflow()
+        assert wf2.state_fingerprint() == wf.state_fingerprint()
+        assert wf2.restore_state(dump)
+        out = wf2.finalize()
+        assert float(np.asarray(out["counts_cumulative"].data.values)) == 32.0
+
+    def test_restore_rejects_wrong_shape(self):
+        wf = self._workflow()
+        assert not wf.restore_state(
+            {"folded": np.zeros(3), "window": np.zeros(3)}
+        )
+
+
+class TestServiceRestart:
+    def test_kill_and_restart_carries_state_over(self, tmp_path):
+        from esslivedata_tpu.config.instruments.dummy.specs import (
+            DETECTOR_VIEW_HANDLE,
+            INSTRUMENT,
+        )
+
+        det = INSTRUMENT.detectors["panel_0"]
+        ids_space = det.detector_number.reshape(-1)
+
+        def run_service(pulse0, n_events, job_number):
+            builder = make_detector_service_builder(
+                instrument="dummy",
+                batcher=NaiveMessageBatcher(),
+                job_threads=1,
+                snapshot_dir=str(tmp_path),
+            )
+            from esslivedata_tpu.services.fake_sources import PulsedRawSource
+
+            raw = PulsedRawSource([])
+            producer = FakeProducer()
+            sink = KafkaSink(
+                producer,
+                make_default_serializer(builder.stream_mapping.livedata, "t"),
+            )
+            service = builder.from_raw_source(raw, sink)
+            config = WorkflowConfig(
+                identifier=DETECTOR_VIEW_HANDLE.workflow_id,
+                job_id=JobId(
+                    source_name="panel_0", job_number=job_number
+                ),
+                params={},
+            )
+            raw.inject(
+                FakeKafkaMessage(
+                    json.dumps(
+                        {
+                            "kind": "start_job",
+                            "config": config.model_dump(mode="json"),
+                        }
+                    ).encode(),
+                    builder.stream_mapping.livedata.commands,
+                )
+            )
+            service.step()
+            raw.inject(
+                FakeKafkaMessage(
+                    _ev44(
+                        det.source_name,
+                        pulse0,
+                        np.random.default_rng(pulse0)
+                        .choice(ids_space, n_events)
+                        .astype(np.int32),
+                        np.linspace(0, 7e7, n_events),
+                    ),
+                    "dummy_detector",
+                )
+            )
+            service.step()
+            return service, producer
+
+        import uuid
+
+        # First process: accumulate 1000 events, then die (finalize dumps).
+        service1, _ = run_service(1, 1000, uuid.uuid4())
+        service1._processor.finalize()
+        files = list(tmp_path.glob("*.npz"))
+        assert files, "shutdown did not dump a snapshot"
+
+        # Second process, new job number, same configuration: restores,
+        # then adds 100 more events -> cumulative carries the 1000 over.
+        _, producer2 = run_service(2, 100, uuid.uuid4())
+        cum = [
+            wire.decode_da00(m.value)
+            for m in producer2.messages
+            if m.topic.endswith("_data")
+            and "counts_cumulative" in wire.decode_da00(m.value).source_name
+        ]
+        assert cum, "no cumulative output from the restarted service"
+        total = float(np.asarray(cum[-1].variables[0].data, np.float64).sum())
+        assert total == 1100.0
+        # One-shot: the snapshot was consumed by the restore.
+        assert not list(tmp_path.glob("*.npz"))
+
+    def test_run_boundary_dumps_before_reset(self, tmp_path):
+        from esslivedata_tpu.config.instruments.dummy.specs import (
+            DETECTOR_VIEW_HANDLE,
+            INSTRUMENT,
+        )
+        from esslivedata_tpu.services.fake_sources import PulsedRawSource
+
+        det = INSTRUMENT.detectors["panel_0"]
+        ids_space = det.detector_number.reshape(-1)
+        builder = make_detector_service_builder(
+            instrument="dummy",
+            batcher=NaiveMessageBatcher(),
+            job_threads=1,
+            snapshot_dir=str(tmp_path),
+        )
+        raw = PulsedRawSource([])
+        producer = FakeProducer()
+        sink = KafkaSink(
+            producer,
+            make_default_serializer(builder.stream_mapping.livedata, "t"),
+        )
+        service = builder.from_raw_source(raw, sink)
+        config = WorkflowConfig(
+            identifier=DETECTOR_VIEW_HANDLE.workflow_id,
+            job_id=JobId(source_name="panel_0"),
+            params={},
+        )
+        raw.inject(
+            FakeKafkaMessage(
+                json.dumps(
+                    {
+                        "kind": "start_job",
+                        "config": config.model_dump(mode="json"),
+                    }
+                ).encode(),
+                builder.stream_mapping.livedata.commands,
+            )
+        )
+        service.step()
+        rng = np.random.default_rng(5)
+        raw.inject(
+            FakeKafkaMessage(
+                _ev44(
+                    det.source_name,
+                    1,
+                    rng.choice(ids_space, 200).astype(np.int32),
+                    np.linspace(0, 7e7, 200),
+                ),
+                "dummy_detector",
+            )
+        )
+        service.step()
+        # Run stop at a data time between pulse 1 and pulse 10: the reset
+        # fires when data reaches it, dumping the run's accumulation first.
+        stop_ns = 1_700_000_000_000_000_000 + 5 * 71_428_571
+        raw.inject(
+            FakeKafkaMessage(
+                wire.encode_6s4t(
+                    wire.RunStopMessage(
+                        run_name="r1", stop_time_ns=stop_ns
+                    )
+                ),
+                "dummy_runInfo",
+            )
+        )
+        raw.inject(
+            FakeKafkaMessage(
+                _ev44(
+                    det.source_name,
+                    10,
+                    rng.choice(ids_space, 10).astype(np.int32),
+                    np.linspace(0, 7e7, 10),
+                ),
+                "dummy_detector",
+            )
+        )
+        service.step()
+        # The run's final accumulation goes to the ARCHIVE key: kept for
+        # inspection, never read back by restore (a finished run must not
+        # resurrect into a later job).
+        assert list(tmp_path.glob("*.runfinal.npz")), (
+            "run-boundary reset did not dump a snapshot"
+        )
+        assert not [
+            p
+            for p in tmp_path.glob("*.npz")
+            if not p.name.endswith(".runfinal.npz")
+        ]
+        store = SnapshotStore(tmp_path)
+        assert (
+            store.load(
+                workflow_id="anything",
+                source_name="panel_0",
+                fingerprint="any",
+            )
+            is None
+        )
