@@ -108,6 +108,10 @@ class ServiceStatus(BaseModel):
     #: clue that a service is falling behind its streams.
     lag_level: str = "ok"
     worst_lag_s: float = 0.0
+    #: Per-stream lag detail for the dashboard drill-down (reference
+    #: workflow_status_widget surfaces per-source staleness): stream
+    #: name -> (lag seconds, level).
+    stream_lags: dict[str, tuple[float, str]] = Field(default_factory=dict)
 
 
 class JobResult:

@@ -336,6 +336,10 @@ class OrchestratingProcessor:
                 ),
                 default=0.0,
             ),
+            stream_lags={
+                lag.stream_name: (round(lag.lag_s, 3), lag.level)
+                for lag in report.lags
+            },
         )
 
     def _publish_status(self, state: str = "running") -> None:

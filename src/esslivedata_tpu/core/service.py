@@ -47,11 +47,43 @@ def get_env_defaults(parser: argparse.ArgumentParser, prefix: str = ENV_PREFIX) 
     return defaults
 
 
+class _ServiceArgumentParser(argparse.ArgumentParser):
+    """parse_args applies the CPU pin BEFORE returning: every service
+    main parses first and builds (touching JAX) after, so pinning here
+    covers --cpu, LIVEDATA_FORCE_CPU, and programmatic argv lists alike.
+    """
+
+    def parse_args(self, *args, **kwargs):  # type: ignore[override]
+        parsed = super().parse_args(*args, **kwargs)
+        force_env = os.environ.get("LIVEDATA_FORCE_CPU", "").lower() in (
+            "1",
+            "true",
+            "yes",
+        )
+        if getattr(parsed, "cpu", False) or force_env:
+            from ..utils.platform_pin import pin_cpu
+
+            pin_cpu()
+        return parsed
+
+
 def setup_arg_parser(description: str = "") -> argparse.ArgumentParser:
-    """Common CLI surface shared by all services (reference service.py:194)."""
-    parser = argparse.ArgumentParser(description=description)
+    """Common CLI surface shared by all services (reference service.py:194).
+
+    ``LIVEDATA_FORCE_CPU`` (1/true/yes) or ``--cpu`` pins JAX to the CPU
+    backend before anything initializes one — the dev/demo escape hatch
+    for machines where the ambient accelerator platform is configured but
+    unreachable (backend init would otherwise hang or fail every job).
+    """
+    parser = _ServiceArgumentParser(description=description)
     parser.add_argument("--instrument", required=False, default="dummy")
     parser.add_argument("--dev", action="store_true", default=False)
+    parser.add_argument(
+        "--cpu",
+        action="store_true",
+        default=False,
+        help="pin JAX to the CPU backend (see LIVEDATA_FORCE_CPU)",
+    )
     parser.add_argument("--log-level", default="INFO")
     parser.add_argument("--log-json-file", default=None)
     return parser

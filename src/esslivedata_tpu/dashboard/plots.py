@@ -14,7 +14,7 @@ import io
 from dataclasses import dataclass
 import logging
 import threading
-from typing import Callable
+from typing import Callable, ClassVar
 
 import matplotlib
 
@@ -85,6 +85,33 @@ class PlotParams:
     #: stand-in for scipp's carried variances: counts are Poisson, so
     #: the statistical uncertainty is derivable at render time.
     errorbars: bool = False
+    #: Explicit x-axis data range (1-D plotters): zoom to a TOA window,
+    #: a Q range, a d-spacing region. None = full extent.
+    xmin: float | None = None
+    xmax: float | None = None
+
+    #: Every query-string key ``from_dict`` understands — THE list for
+    #: HTTP handlers to whitelist, so a new param cannot be silently
+    #: dropped at the endpoint (vline/hline/errorbars once were).
+    QUERY_KEYS: ClassVar[tuple[str, ...]] = (
+        "scale",
+        "cmap",
+        "vmin",
+        "vmax",
+        "extractor",
+        "window_s",
+        "plotter",
+        "slice",
+        "overlay",
+        "robust",
+        "errorbars",
+        "vline",
+        "hline",
+        "xmin",
+        "xmax",
+        "flatten_split",
+        "history",  # back-compat alias for extractor=full_history
+    )
 
     @classmethod
     def from_dict(cls, raw: dict | None) -> "PlotParams":
@@ -125,6 +152,8 @@ class PlotParams:
             vmax=_f("vmax"),
             vline=_f("vline"),
             hline=_f("hline"),
+            xmin=_f("xmin"),
+            xmax=_f("xmax"),
             extractor=extractor,
             window_s=_f("window_s"),
             plotter=plotter,
@@ -143,6 +172,12 @@ class PlotParams:
             and params.vmin >= params.vmax
         ):
             raise ValueError("vmin must be < vmax")
+        if (
+            params.xmin is not None
+            and params.xmax is not None
+            and params.xmin >= params.xmax
+        ):
+            raise ValueError("xmin must be < xmax")
         if scale == "log" and params.vmax is not None and params.vmax <= 0:
             raise ValueError("log scale needs vmax > 0")
         if params.extractor.startswith("window"):
@@ -183,6 +218,10 @@ class PlotParams:
             out["vline"] = self.vline
         if self.hline is not None:
             out["hline"] = self.hline
+        if self.xmin is not None:
+            out["xmin"] = self.xmin
+        if self.xmax is not None:
+            out["xmax"] = self.xmax
         if self.robust:
             out["robust"] = "1"
         if self.errorbars:
@@ -247,6 +286,8 @@ class PlotParams:
             ax.set_yscale("log")
         if self.vmin is not None or self.vmax is not None:
             ax.set_ylim(bottom=self.vmin, top=self.vmax)
+        if self.xmin is not None or self.xmax is not None:
+            ax.set_xlim(left=self.xmin, right=self.xmax)
 
     def _apply_markers(self, ax) -> None:
         """Static reference-line overlays, drawn over ANY plotter."""

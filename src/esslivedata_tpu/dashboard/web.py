@@ -29,6 +29,7 @@ import tornado.web
 from ..config.workflow_spec import ResultKey, WorkflowId
 from .dashboard_services import DashboardServices
 from .plots import (
+    PlotParams,
     SlicerPlotter,
     TablePlotter,
     render_correlation_png,
@@ -49,6 +50,60 @@ def _id_to_key(kid: str) -> ResultKey:
 
 
 class _Base(tornado.web.RequestHandler):
+    """Shared services access, JSON helpers and the auth gate.
+
+    Auth (reference dashboard.py:32 takes an auth config): when the app
+    is built with a token (``make_app(auth_token=...)`` /
+    ``LIVEDATA_DASHBOARD_TOKEN``), every request must present it — as a
+    ``Bearer`` header (API clients), a ``?token=`` query parameter
+    (first visit), or the session cookie that a token-bearing page view
+    sets. No token configured = open dashboard (beamline-console mode).
+    """
+
+    _COOKIE = "livedata_auth"
+
+    def prepare(self) -> None:
+        token = self.application.settings.get("auth_token")
+        if not token:
+            return
+        header = self.request.headers.get("Authorization", "")
+        presented = None
+        if header.startswith("Bearer "):
+            presented = header[len("Bearer ") :]
+        if presented is None:
+            presented = self.get_argument("token", None)
+            from_query = presented is not None
+        else:
+            from_query = False
+        if presented is None:
+            cookie = self.get_signed_cookie(self._COOKIE)
+            presented = cookie.decode() if cookie else None
+        import hmac
+
+        # Bytes comparison: compare_digest raises TypeError on non-ASCII
+        # str input (a pasted token with a stray unicode char must 401,
+        # not 500).
+        if presented is None or not hmac.compare_digest(
+            presented.encode("utf-8"), token.encode("utf-8")
+        ):
+            self.set_status(401)
+            self.set_header("WWW-Authenticate", "Bearer")
+            self.finish(json.dumps({"error": "authentication required"}))
+            return
+        if from_query:
+            # Browser flow: the ?token= visit mints the session cookie so
+            # subsequent asset/API requests authenticate silently.
+            # SameSite=Strict: the cookie authorizes state-changing POSTs
+            # (job stop/reset, workflow start), so it must never ride a
+            # cross-site request.
+            self.set_signed_cookie(
+                self._COOKIE,
+                token,
+                expires_days=1,
+                httponly=True,
+                samesite="Strict",
+            )
+
     @property
     def services(self) -> DashboardServices:
         return self.application.settings["services"]
@@ -121,6 +176,7 @@ class StateHandler(_Base):
                         ),
                         "lag_level": s.status.lag_level,
                         "worst_lag_s": s.status.worst_lag_s,
+                        "stream_lags": s.status.stream_lags,
                     }
                     for s in js.services()
                 ],
@@ -533,23 +589,7 @@ class PlotHandler(_Base):
         selection), plotter / slice (rendering) — built by the UI from
         the owning cell's persisted params.
         """
-        resolved = self.resolve_data(
-            kid,
-            (
-                "scale",
-                "cmap",
-                "vmin",
-                "vmax",
-                "extractor",
-                "window_s",
-                "plotter",
-                "slice",
-                "overlay",
-                "robust",
-                "flatten_split",
-                "history",  # back-compat alias for full_history
-            ),
-        )
+        resolved = self.resolve_data(kid, PlotParams.QUERY_KEYS)
         if resolved is None:
             return None
         key, params, data = resolved
@@ -898,6 +938,8 @@ const CELL_CONFIG_FIELDS = [
   {{key: 'errorbars', kind: 'checkbox', hint: 'Poisson sqrt(N) error bars (count spectra)'}},
   {{key: 'vline', kind: 'number', hint: 'vertical reference line (data x)'}},
   {{key: 'hline', kind: 'number', hint: 'horizontal reference line (data y)'}},
+  {{key: 'xmin', kind: 'number', hint: 'x-axis lower bound (1-D plots)'}},
+  {{key: 'xmax', kind: 'number', hint: 'x-axis upper bound (1-D plots)'}},
   {{key: 'flatten_split', kind: 'number', hint: 'leading dims onto Y (flatten plotter)'}},
 ];
 function editCell(gridId, index, params, currentTitle) {{
@@ -1262,14 +1304,46 @@ function renderJobsView(s) {{
         box.appendChild(el('div', 'state-' + j.state, j.message));
       }}
       const svc = svcById[j.service];
-      box.appendChild(el('div', '',
+      const svcLine = el('div', '',
         'service: ' + (j.service || 'unknown') +
         (svc ? ` · uptime ${{Math.round(svc.uptime_s)}}s · last batch ` +
-               `${{svc.last_batch_message_count}} msgs` : '')));
+               `${{svc.last_batch_message_count}} msgs` : ''));
+      if (svc && svc.lag_level && svc.lag_level !== 'ok') {{
+        const badge = el('span', 'state-' + (svc.lag_level === 'error' ?
+          'error' : 'warning'),
+          ` lag ${{svc.lag_level}} (${{svc.worst_lag_s.toFixed(1)}}s)`);
+        svcLine.appendChild(badge);
+      }}
+      box.appendChild(svcLine);
+      // Per-stream staleness drill-down (reference
+      // workflow_status_widget surfaces per-source status): message
+      // counts + data-time lag with warn/error coloring per stream.
       if (svc && svc.stream_message_counts) {{
-        const counts = Object.entries(svc.stream_message_counts)
-          .map(([k, v]) => k + ': ' + v).join(' · ');
-        if (counts) box.appendChild(el('small', '', counts));
+        const lags = svc.stream_lags || {{}};
+        const names = new Set([
+          ...Object.keys(svc.stream_message_counts), ...Object.keys(lags)]);
+        if (names.size) {{
+          const st = document.createElement('table');
+          st.className = 'devices';
+          for (const name of [...names].sort()) {{
+            const r = document.createElement('tr');
+            r.appendChild(el('td', '', name));
+            r.appendChild(el('td', '',
+              String(svc.stream_message_counts[name] ?? 0) + ' msgs'));
+            const lag = lags[name];
+            const lagTd = el('td');
+            if (lag) {{
+              const [lagS, level] = lag;
+              lagTd.appendChild(el('span',
+                level === 'ok' ? '' : 'state-' +
+                  (level === 'error' ? 'error' : 'warning'),
+                `${{lagS.toFixed(1)}}s behind`));
+            }}
+            r.appendChild(lagTd);
+            st.appendChild(r);
+          }}
+          box.appendChild(st);
+        }}
       }}
       const outs = s.keys.filter(k => k.job_number === j.job_number);
       if (outs.length) {{
@@ -1536,7 +1610,17 @@ class IndexHandler(_Base):
         )
 
 
-def make_app(services: DashboardServices, instrument: str) -> tornado.web.Application:
+def make_app(
+    services: DashboardServices,
+    instrument: str,
+    *,
+    auth_token: str | None = None,
+) -> tornado.web.Application:
+    import os
+    import secrets
+
+    if auth_token is None:
+        auth_token = os.environ.get("LIVEDATA_DASHBOARD_TOKEN")
     return tornado.web.Application(
         [
             (r"/", IndexHandler),
@@ -1561,4 +1645,8 @@ def make_app(services: DashboardServices, instrument: str) -> tornado.web.Applic
         ],
         services=services,
         instrument=instrument,
+        auth_token=auth_token,
+        # Signed-cookie secret: per-process random is fine (a dashboard
+        # restart just re-prompts for the token).
+        cookie_secret=secrets.token_hex(32),
     )

@@ -1,0 +1,75 @@
+"""Dashboard auth gate (reference dashboard.py:32 takes an auth config):
+token-configured apps reject unauthenticated requests; Bearer header,
+?token= query (which mints the session cookie), and cookie all work."""
+
+import json
+
+import pytest
+
+tornado = pytest.importorskip("tornado")
+
+from tornado.testing import AsyncHTTPTestCase
+
+from esslivedata_tpu.dashboard.dashboard_services import DashboardServices
+from esslivedata_tpu.dashboard.fake_backend import InProcessBackendTransport
+
+
+class AuthWebTest(AsyncHTTPTestCase):
+    TOKEN = "sekrit-token"
+
+    def get_app(self):
+        from esslivedata_tpu.dashboard.web import make_app
+
+        self.transport = InProcessBackendTransport("dummy", events_per_pulse=10)
+        self.services = DashboardServices(transport=self.transport)
+        return make_app(self.services, "dummy", auth_token=self.TOKEN)
+
+    def test_unauthenticated_request_401s(self):
+        r = self.fetch("/api/state")
+        assert r.code == 401
+        assert json.loads(r.body)["error"] == "authentication required"
+        assert self.fetch("/").code == 401
+
+    def test_wrong_token_401s(self):
+        r = self.fetch(
+            "/api/state", headers={"Authorization": "Bearer WRONG"}
+        )
+        assert r.code == 401
+
+    def test_bearer_header_accepted(self):
+        r = self.fetch(
+            "/api/state",
+            headers={"Authorization": f"Bearer {self.TOKEN}"},
+        )
+        assert r.code == 200
+        assert "generation" in json.loads(r.body)
+
+    def test_query_token_mints_session_cookie(self):
+        r = self.fetch(f"/?token={self.TOKEN}")
+        assert r.code == 200
+        cookie = r.headers.get("Set-Cookie", "")
+        assert "livedata_auth" in cookie
+        # The minted cookie authenticates subsequent requests alone.
+        session = cookie.split(";")[0]
+        r2 = self.fetch("/api/state", headers={"Cookie": session})
+        assert r2.code == 200
+
+    def test_post_endpoints_also_gated(self):
+        r = self.fetch(
+            "/api/workflow/start",
+            method="POST",
+            body=json.dumps({"workflow_id": "x", "source_name": "y"}),
+        )
+        assert r.code == 401
+
+
+class OpenWebTest(AsyncHTTPTestCase):
+    def get_app(self):
+        from esslivedata_tpu.dashboard.web import make_app
+
+        self.transport = InProcessBackendTransport("dummy", events_per_pulse=10)
+        self.services = DashboardServices(transport=self.transport)
+        return make_app(self.services, "dummy")  # no token configured
+
+    def test_open_mode_needs_no_token(self):
+        assert self.fetch("/api/state").code == 200

@@ -141,3 +141,28 @@ def test_roi_canvas_draw_posts_and_readback_renders(page):
         page.wait_for_timeout(200)
     assert readback["rectangles"], "drawn rectangle never applied"
     assert readback["spectra_keys"], "roi_spectra outputs missing"
+
+
+def test_jobs_drilldown_shows_stream_detail(page):
+    # Open the Jobs tab and expand the first job's detail row: it must
+    # list per-stream message counts (and lag coloring when present).
+    page.locator("#tab-jobsview").click()
+    page.wait_for_selector("#jobsview table", timeout=15_000)
+    page.locator("#jobsview button", has_text="▸").first.click()
+    page.wait_for_selector("#jobsview table table", timeout=10_000)
+    detail = page.locator("#jobsview table table").first
+    assert "msgs" in detail.inner_text()
+
+
+def test_cell_config_exposes_display_controls(page):
+    # The per-cell config modal carries the display controls the
+    # reference's plot_config_modal exposes: scale/log, colormap,
+    # color bounds, x-axis range.
+    page.locator("#tab-grids").click()
+    page.wait_for_selector(".gridcell", timeout=30_000)
+    page.locator(".gridcell button", has_text="⚙").first.click()
+    page.wait_for_selector("#cellcfg", timeout=10_000)
+    text = page.locator("#cellcfg").inner_text()
+    for control in ("scale", "cmap", "vmin", "vmax", "xmin", "xmax"):
+        assert control in text
+    page.locator("#cellcfg button", has_text="Cancel").click()

@@ -508,9 +508,24 @@ class FlattenHttpTest(PlotConfigHttpTest):
     def test_reference_line_markers(self):
         state = self._start_and_wait()
         kid = self._kid(state, "spectrum_current")
+        plain = self.fetch(f"/plot/{kid}.png")
         r = self.fetch(f"/plot/{kid}.png?vline=3.5e7&hline=10")
         assert r.code == 200 and r.body[:4] == b"\x89PNG"
+        # The markers must actually reach the renderer (they were once
+        # silently dropped by the endpoint's param whitelist).
+        assert r.body != plain.body
         params = PlotParams.from_dict({"vline": "3.5e7", "hline": 10})
+        assert PlotParams.from_dict(params.to_dict()) == params
+
+    def test_x_axis_range(self):
+        state = self._start_and_wait()
+        kid = self._kid(state, "spectrum_current")
+        plain = self.fetch(f"/plot/{kid}.png")
+        r = self.fetch(f"/plot/{kid}.png?xmin=1e7&xmax=3e7")
+        assert r.code == 200 and r.body[:4] == b"\x89PNG"
+        assert r.body != plain.body  # the zoom reaches the axes
+        assert self.fetch(f"/plot/{kid}.png?xmin=5&xmax=1").code == 400
+        params = PlotParams.from_dict({"xmin": "1e7", "xmax": 3e7})
         assert PlotParams.from_dict(params.to_dict()) == params
 
     def test_poisson_errorbars_render(self):

@@ -96,6 +96,33 @@ class WebApiTest(AsyncHTTPTestCase):
         assert plot.code == 200
         assert plot.body[:4] == b"\x89PNG"
 
+    def test_state_services_carry_stream_lag_detail(self):
+        # The jobs drill-down renders per-stream staleness (reference
+        # workflow_status_widget info content): the state payload must
+        # carry stream_lags as {stream: [lag_s, level]}.
+        import time
+
+        self.fetch(
+            "/api/workflow/start",
+            method="POST",
+            body=json.dumps(
+                {
+                    "workflow_id": str(DETECTOR_VIEW_HANDLE.workflow_id),
+                    "source_name": "panel_0",
+                }
+            ),
+        )
+        time.sleep(0.1)
+        self.drive(20)
+        state = json.loads(self.fetch("/api/state").body)
+        assert state["services"], "no tracked services in state"
+        svc = state["services"][0]
+        assert "stream_lags" in svc
+        assert "lag_level" in svc
+        for lag_s, level in svc["stream_lags"].values():
+            assert isinstance(lag_s, float)
+            assert level in ("ok", "warning", "error")
+
     def test_unknown_plot_404(self):
         assert self.fetch("/plot/bm9wZQ==.png").code == 404
 
