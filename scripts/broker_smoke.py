@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Real-broker smoke: fake producer -> detector service -> results.
+
+The file-backed broker covers the integration scenarios everywhere; THIS
+script is the one place the confluent_kafka/librdkafka code paths
+(kafka/consumer.py manual assignment, service_factory's Kafka wiring,
+sink producer) run against a real broker. CI brings up a KRaft Kafka and
+runs it (job ``broker-smoke``).
+
+Flow:
+1. wait for the broker, pre-create the service's input topics (the
+   consumer's manual assignment validates topic existence and refuses to
+   start otherwise — the admin op a deployment does out of band);
+2. start the detector service (subprocess) against the broker;
+3. publish a start_job command for the dummy detector view;
+4. run the fake ev44 producer for a few pulses;
+5. consume the service's output topics and assert that (a) at least one
+   decodable da00 result and (b) at least one x5f2 heartbeat arrive.
+
+Exit 0 on success, 1 with a diagnostic on timeout/crash.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import uuid
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "src"))
+
+BOOTSTRAP = os.environ.get("LIVEDATA_KAFKA_BOOTSTRAP", "localhost:9092")
+TIMEOUT_S = float(os.environ.get("BROKER_SMOKE_TIMEOUT_S", "90"))
+
+#: The detector service's input topics for the dummy instrument plus its
+#: output family: pre-created because manual partition assignment
+#: validates existence (kafka/consumer.py) and metadata listing does NOT
+#: auto-create topics even with auto.create enabled.
+TOPICS = [
+    "dummy_detector",
+    "dummy_camera",
+    "dummy_motion",
+    "dummy_runInfo",
+    "dummy_livedata_commands",
+    "dummy_livedata_roi",
+    "dummy_livedata_data",
+    "dummy_livedata_status",
+    "dummy_livedata_responses",
+]
+
+
+def wait_for_broker_and_topics(deadline: float) -> None:
+    from confluent_kafka.admin import AdminClient, NewTopic
+
+    admin = AdminClient({"bootstrap.servers": BOOTSTRAP})
+    # Readiness: KRaft accepts connections several seconds after the
+    # container process starts, and Actions does not health-gate images
+    # without a HEALTHCHECK — retry metadata until the broker answers.
+    while True:
+        try:
+            existing = set(admin.list_topics(timeout=5).topics)
+            break
+        except Exception:
+            if time.time() > deadline:
+                raise RuntimeError(f"broker at {BOOTSTRAP} never came up")
+            time.sleep(2.0)
+    missing = [t for t in TOPICS if t not in existing]
+    if missing:
+        futures = admin.create_topics(
+            [NewTopic(t, num_partitions=1, replication_factor=1) for t in missing]
+        )
+        for topic, future in futures.items():
+            try:
+                future.result(30)
+            except Exception as exc:  # TopicExistsError is fine
+                if "exists" not in str(exc).lower():
+                    raise
+    while time.time() < deadline:
+        if all(t in admin.list_topics(timeout=5).topics for t in TOPICS):
+            return
+        time.sleep(1.0)
+    raise RuntimeError(f"topics never appeared: {missing}")
+
+
+def main() -> int:
+    from confluent_kafka import Consumer, Producer
+
+    from esslivedata_tpu.config import JobId, WorkflowConfig
+    from esslivedata_tpu.config.instruments.dummy.specs import (
+        DETECTOR_VIEW_HANDLE,
+    )
+    from esslivedata_tpu.kafka import wire
+
+    deadline = time.time() + TIMEOUT_S
+    wait_for_broker_and_topics(deadline)
+
+    env = {
+        **os.environ,
+        "LIVEDATA_KAFKA_BOOTSTRAP": BOOTSTRAP,
+        "PYTHONPATH": str(Path(__file__).resolve().parent.parent / "src"),
+    }
+    service = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "esslivedata_tpu.services.detector_data",
+            "--instrument",
+            "dummy",
+            "--batcher",
+            "naive",
+        ],
+        env=env,
+    )
+    fake = None
+    consumer = None
+    try:
+        producer = Producer({"bootstrap.servers": BOOTSTRAP})
+        config = WorkflowConfig(
+            identifier=DETECTOR_VIEW_HANDLE.workflow_id,
+            job_id=JobId(source_name="panel_0"),
+            params={},
+        )
+        command = json.dumps(
+            {"kind": "start_job", "config": config.model_dump(mode="json")}
+        ).encode()
+        consumer = Consumer(
+            {
+                "bootstrap.servers": BOOTSTRAP,
+                "group.id": f"smoke-{uuid.uuid4()}",
+                "auto.offset.reset": "earliest",
+            }
+        )
+        consumer.subscribe(["dummy_livedata_data", "dummy_livedata_status"])
+        got_da00 = got_x5f2 = False
+        last_cmd = 0.0
+        while time.time() < deadline and not (got_da00 and got_x5f2):
+            # Fail FAST on a dead child: a startup crash must surface its
+            # exit code, not burn the timeout as da00=False x5f2=False.
+            if service.poll() is not None:
+                print(f"detector service died rc={service.returncode}")
+                return 1
+            if fake is not None and fake.poll() not in (None, 0):
+                print(f"fake producer died rc={fake.returncode}")
+                return 1
+            if time.time() - last_cmd > 5.0:
+                # The service subscribes shortly after start; re-send the
+                # command periodically so timing cannot miss it.
+                producer.produce("dummy_livedata_commands", command)
+                producer.flush(5)
+                last_cmd = time.time()
+                if fake is None:
+                    fake = subprocess.Popen(
+                        [
+                            sys.executable,
+                            "-m",
+                            "esslivedata_tpu.services.fake_detectors",
+                            "--instrument",
+                            "dummy",
+                            "--pulses",
+                            "2000",
+                            "--kafka-bootstrap",
+                            BOOTSTRAP,
+                        ],
+                        env=env,
+                    )
+            msg = consumer.poll(1.0)
+            if msg is None or msg.error():
+                continue
+            try:
+                schema = wire.get_schema(msg.value())
+            except wire.WireError:
+                continue
+            if msg.topic() == "dummy_livedata_data" and schema == "da00":
+                decoded = wire.decode_da00(msg.value())
+                if decoded.variables:
+                    got_da00 = True
+                    print(f"da00 OK: {decoded.source_name}")
+            elif msg.topic() == "dummy_livedata_status" and schema == "x5f2":
+                status = wire.decode_x5f2(msg.value())
+                got_x5f2 = True
+                print(f"x5f2 OK: {status.service_id}")
+        if got_da00 and got_x5f2:
+            print("broker smoke PASSED")
+            return 0
+        print(
+            f"broker smoke FAILED after {TIMEOUT_S}s: "
+            f"da00={got_da00} x5f2={got_x5f2}"
+        )
+        return 1
+    finally:
+        for proc in (service, fake):
+            if proc is None:
+                continue
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if consumer is not None:
+            consumer.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
