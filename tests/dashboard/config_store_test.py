@@ -1,0 +1,73 @@
+"""Config store unit tests (reference granularity: config-adapter
+tests): file round-trips, key sanitization collisions, legacy files,
+namespacing."""
+
+import json
+
+import pytest
+
+from esslivedata_tpu.dashboard.config_store import (
+    ConfigStoreManager,
+    FileConfigStore,
+    MemoryConfigStore,
+)
+
+
+class TestMemoryStore:
+    def test_round_trip_and_isolation(self):
+        store = MemoryConfigStore()
+        store.save("a", {"x": 1})
+        doc = store.load("a")
+        assert doc == {"x": 1}
+        doc["x"] = 999  # caller mutation must not corrupt the store
+        assert store.load("a") == {"x": 1}
+
+    def test_delete_and_keys(self):
+        store = MemoryConfigStore()
+        store.save("a", {})
+        store.save("b", {})
+        store.delete("a")
+        assert store.keys() == ["b"]
+        store.delete("missing")  # idempotent
+
+
+class TestFileStore:
+    def test_round_trip_preserves_exact_key(self, tmp_path):
+        store = FileConfigStore(tmp_path)
+        store.save("grid one/两", {"n": 2})
+        assert store.load("grid one/两") == {"n": 2}
+        assert store.keys() == ["grid one/两"]
+        # Survives a "restart" (fresh instance over the same root).
+        assert FileConfigStore(tmp_path).load("grid one/两") == {"n": 2}
+
+    def test_sanitization_collision_detected(self, tmp_path):
+        store = FileConfigStore(tmp_path)
+        store.save("a/b", {"v": 1})
+        # 'a b' sanitizes to the same filename as 'a/b'; the envelope's
+        # original key must prevent silent clobbering.
+        with pytest.raises(ValueError, match="collision|exists|sanitiz"):
+            store.save("a b", {"v": 2})
+
+    def test_legacy_file_without_envelope_is_readable(self, tmp_path):
+        (tmp_path / "old.json").write_text(json.dumps({"x": 5}))
+        store = FileConfigStore(tmp_path)
+        assert store.load("old") == {"x": 5}
+        assert "old" in store.keys()
+
+    def test_corrupt_file_is_skipped(self, tmp_path):
+        (tmp_path / "bad.json").write_text("{not json")
+        store = FileConfigStore(tmp_path)
+        assert store.load("bad") is None
+        assert store.keys() == []
+
+
+class TestNamespacing:
+    def test_namespaces_do_not_collide(self):
+        manager = ConfigStoreManager(MemoryConfigStore())
+        grids = manager.namespaced("grids")
+        session = manager.namespaced("session")
+        grids.save("main", {"kind": "grid"})
+        session.save("main", {"kind": "session"})
+        assert grids.load("main") == {"kind": "grid"}
+        assert session.load("main") == {"kind": "session"}
+        assert grids.keys() == ["main"]

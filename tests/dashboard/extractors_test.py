@@ -1,0 +1,142 @@
+"""Extractor unit tests (reference granularity: per-module extractor
+tests): window-edge semantics, stamp exemption, restart-on-structure-
+change, sum/mean dtype rules."""
+
+import numpy as np
+
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.dashboard.extractors import (
+    FullHistoryExtractor,
+    LatestValueExtractor,
+    WindowAggregatingExtractor,
+)
+from esslivedata_tpu.dashboard.temporal_buffers import (
+    SingleValueBuffer,
+    TemporalBuffer,
+)
+from esslivedata_tpu.utils import DataArray, Variable, linspace
+
+T = Timestamp.from_ns
+
+
+def spectrum(values, unit="counts", stamp_ns=None, edges=(0.0, 10.0)):
+    v = np.asarray(values, dtype=np.float64)
+    coords = {
+        "toa": linspace("toa", edges[0], edges[1], v.size + 1, "ns")
+    }
+    if stamp_ns is not None:
+        coords["start_time"] = Variable(np.asarray(float(stamp_ns)), (), "ns")
+        coords["end_time"] = Variable(
+            np.asarray(float(stamp_ns) + 1.0), (), "ns"
+        )
+    return DataArray(Variable(v, ("toa",), unit), coords=coords)
+
+
+def scalar(value):
+    return DataArray(Variable(np.asarray(float(value)), (), "counts"))
+
+
+class TestLatestAndHistory:
+    def test_latest(self):
+        buf = SingleValueBuffer()
+        buf.put(T(1), "x")
+        assert LatestValueExtractor().extract(buf) == "x"
+
+    def test_full_history_builds_time_series_from_scalars(self):
+        buf = TemporalBuffer()
+        for i in range(4):
+            buf.put(T(int(i * 1e9)), scalar(i * 10))
+        series = FullHistoryExtractor().extract(buf)
+        assert series.dims == ("time",)
+        np.testing.assert_array_equal(series.values, [0, 10, 20, 30])
+        np.testing.assert_array_equal(
+            series.coords["time"].numpy, [0, 1e9, 2e9, 3e9]
+        )
+
+    def test_full_history_nonscalar_returns_raw_entries(self):
+        buf = TemporalBuffer()
+        buf.put(T(1), spectrum([1, 2]))
+        out = FullHistoryExtractor().extract(buf)
+        assert isinstance(out, list) and len(out) == 1
+
+    def test_empty_buffer_returns_none(self):
+        assert FullHistoryExtractor().extract(TemporalBuffer()) is None
+
+
+class TestWindowAggregation:
+    def _buffer(self, n=5, period_s=1.0):
+        buf = TemporalBuffer()
+        for i in range(n):
+            buf.put(
+                T(int(i * period_s * 1e9)),
+                spectrum([1.0, 2.0], stamp_ns=i),
+            )
+        return buf
+
+    def test_window_edge_is_inclusive_of_cutoff_entry(self):
+        buf = self._buffer(n=5)
+        # Newest at 4 s; 2 s window -> entries at 2, 3, 4 s (cutoff
+        # INCLUSIVE — the entry exactly at the edge participates).
+        agg = WindowAggregatingExtractor(2.0).extract(buf)
+        np.testing.assert_array_equal(agg.values, [3.0, 6.0])
+
+    def test_stamps_do_not_restart_aggregation(self):
+        # Every entry carries different start/end stamps; aggregation
+        # must still run across them (the stamp exemption).
+        agg = WindowAggregatingExtractor(100.0).extract(self._buffer())
+        np.testing.assert_array_equal(agg.values, [5.0, 10.0])
+
+    def test_aggregated_span_is_first_start_last_end(self):
+        buf = self._buffer(n=3)
+        agg = WindowAggregatingExtractor(100.0).extract(buf)
+        assert float(agg.coords["start_time"].numpy) == 0.0
+        assert float(agg.coords["end_time"].numpy) == 3.0  # last stamp + 1
+
+    def test_binning_change_restarts_at_that_entry(self):
+        buf = TemporalBuffer()
+        buf.put(T(int(1e9)), spectrum([1.0, 1.0], edges=(0, 10)))
+        buf.put(T(int(2e9)), spectrum([1.0, 1.0], edges=(0, 20)))  # rebin!
+        buf.put(T(int(3e9)), spectrum([1.0, 1.0], edges=(0, 20)))
+        agg = WindowAggregatingExtractor(100.0).extract(buf)
+        # Only the two post-rebin entries aggregate.
+        np.testing.assert_array_equal(agg.values, [2.0, 2.0])
+
+    def test_unit_change_restarts(self):
+        buf = TemporalBuffer()
+        buf.put(T(int(1e9)), spectrum([5.0, 5.0], unit="counts"))
+        buf.put(T(int(2e9)), spectrum([1.0, 1.0], unit="1/s"))
+        agg = WindowAggregatingExtractor(100.0).extract(buf)
+        np.testing.assert_array_equal(agg.values, [1.0, 1.0])
+
+    def test_mean_stays_float(self):
+        buf = TemporalBuffer()
+        for i in range(2):
+            v = np.array([1, 2], dtype=np.int64)
+            buf.put(
+                T(int((i + 1) * 1e9)),
+                DataArray(Variable(v + i, ("x",), "counts")),
+            )
+        agg = WindowAggregatingExtractor(100.0, operation="mean").extract(buf)
+        # (1+2)/2 = 1.5 must not floor back to the int64 input dtype.
+        np.testing.assert_allclose(agg.values, [1.5, 2.5])
+
+    def test_sum_restores_integer_dtype(self):
+        buf = TemporalBuffer()
+        for i in range(2):
+            v = np.array([1, 2], dtype=np.int32)
+            buf.put(T(int((i + 1) * 1e9)), DataArray(Variable(v, ("x",), "")))
+        agg = WindowAggregatingExtractor(100.0).extract(buf)
+        assert np.asarray(agg.values).dtype == np.int32
+        np.testing.assert_array_equal(agg.values, [2, 4])
+
+    def test_non_dataarray_entries_fall_back_to_latest(self):
+        buf = TemporalBuffer()
+        buf.put(T(1), {"not": "a dataarray"})
+        out = WindowAggregatingExtractor(1.0).extract(buf)
+        assert out == {"not": "a dataarray"}
+
+    def test_single_value_buffer_aggregates_its_one_entry(self):
+        buf = SingleValueBuffer()
+        buf.put(T(1), spectrum([2.0, 4.0]))
+        agg = WindowAggregatingExtractor(1.0).extract(buf)
+        np.testing.assert_array_equal(agg.values, [2.0, 4.0])

@@ -1,0 +1,122 @@
+"""MessagePump unit tests (reference granularity: message pump tests):
+control/data separation, one transaction per drain (ADR 0005/0007),
+command expiry independent of traffic."""
+
+import uuid
+
+import numpy as np
+
+from esslivedata_tpu.config.workflow_spec import JobId, ResultKey, WorkflowId
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.dashboard.data_service import DataService
+from esslivedata_tpu.dashboard.job_service import JobService
+from esslivedata_tpu.dashboard.message_pump import MessagePump
+from esslivedata_tpu.dashboard.transport import AckMessage, ResultMessage
+from esslivedata_tpu.utils import DataArray, Variable
+
+
+class ScriptedTransport:
+    """Hands out one pre-scripted batch per get_messages call."""
+
+    def __init__(self, batches):
+        self._batches = list(batches)
+
+    def publish_command(self, payload):
+        pass
+
+    def get_messages(self):
+        return self._batches.pop(0) if self._batches else []
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+
+def key(output: str) -> ResultKey:
+    return ResultKey(
+        workflow_id=WorkflowId(instrument="dummy", name="view"),
+        job_id=JobId(source_name="panel_0", job_number=uuid.uuid4()),
+        output_name=output,
+    )
+
+
+def result(k: ResultKey, t_ns: int) -> ResultMessage:
+    return ResultMessage(
+        key=k,
+        timestamp=Timestamp.from_ns(t_ns),
+        data=DataArray(Variable(np.asarray(1.0), (), "counts")),
+    )
+
+
+class TestPumpBatching:
+    def test_one_generation_and_notification_per_drain(self):
+        ds = DataService()
+        k1, k2 = key("a"), key("b")
+        pump = MessagePump(
+            transport=ScriptedTransport([[result(k1, 1), result(k2, 2)]]),
+            data_service=ds,
+            job_service=JobService(),
+        )
+        batches = []
+        from esslivedata_tpu.dashboard.data_service import DataSubscription
+
+        ds.subscribe(
+            DataSubscription({k1, k2}, lambda ks: batches.append(set(ks)))
+        )
+        g0 = ds.generation
+        assert pump.pump_once() == 2
+        # ADR 0005/0007: ONE transaction -> one generation bump, one
+        # keys-only notification covering the whole batch.
+        assert ds.generation == g0 + 1
+        assert batches == [{k1, k2}]
+
+    def test_empty_drain_costs_nothing(self):
+        ds = DataService()
+        pump = MessagePump(
+            transport=ScriptedTransport([]),
+            data_service=ds,
+            job_service=JobService(),
+        )
+        g0 = ds.generation
+        assert pump.pump_once() == 0
+        assert ds.generation == g0
+
+    def test_acks_are_handled_outside_the_data_transaction(self):
+        ds = DataService()
+        js = JobService()
+        # An ack for a command nobody tracked is routine (another
+        # dashboard's command) and must not disturb the data plane.
+        pump = MessagePump(
+            transport=ScriptedTransport(
+                [[AckMessage(payload={"kind": "ack", "command_id": "x"})]]
+            ),
+            data_service=ds,
+            job_service=js,
+        )
+        g0 = ds.generation
+        assert pump.pump_once() == 1
+        assert ds.generation == g0  # no data transaction happened
+
+    def test_command_expiry_fires_on_quiet_transport(self):
+        from esslivedata_tpu.dashboard.job_service import COMMAND_EXPIRY_S
+
+        events = []
+        js = JobService(on_event=lambda level, msg: events.append(level))
+        cmd = js.track_command(
+            kind="start_job", source_name="s", job_number=uuid.uuid4()
+        )
+        assert len(js.pending_commands()) == 1
+        # Age the command past its deadline, then pump with NO traffic:
+        # expiry is time-based upkeep, not message-driven (a dead broker
+        # is exactly when it must fire).
+        cmd.issued_wall -= COMMAND_EXPIRY_S + 1
+        pump = MessagePump(
+            transport=ScriptedTransport([]),
+            data_service=DataService(),
+            job_service=js,
+        )
+        pump.pump_once()
+        assert js.pending_commands() == []
+        assert events == ["error"]

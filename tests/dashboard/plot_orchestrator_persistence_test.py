@@ -1,0 +1,133 @@
+"""PlotOrchestrator persistence round-trips (reference granularity:
+plot_grid_manager/config-adapter tests): grids survive a dashboard
+restart byte-for-byte through the config store, including per-cell
+params; history demand follows cell extractors."""
+
+import numpy as np
+
+from esslivedata_tpu.config.grid_template import (
+    CellGeometry,
+    GridCellSpec,
+    GridSpec,
+)
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.dashboard.config_store import MemoryConfigStore
+from esslivedata_tpu.dashboard.data_service import DataService
+from esslivedata_tpu.dashboard.plot_orchestrator import PlotOrchestrator
+from esslivedata_tpu.dashboard.temporal_buffers import (
+    SingleValueBuffer,
+    TemporalBuffer,
+)
+
+
+def spec(params=(), output="image_current") -> GridSpec:
+    return GridSpec(
+        name="main",
+        title="Main",
+        nrows=2,
+        ncols=2,
+        cells=(
+            GridCellSpec(
+                geometry=CellGeometry(row=0, col=1, row_span=2),
+                workflow="dummy/detector_view/panel_view/v1",
+                output=output,
+                source="panel_0",
+                title="Panel",
+                params=params,
+            ),
+        ),
+    )
+
+
+def orchestrator(store, ds=None) -> PlotOrchestrator:
+    return PlotOrchestrator(
+        data_service=ds or DataService(), store=store
+    )
+
+
+class TestPersistenceRoundTrip:
+    def test_grid_survives_restart_exactly(self):
+        store = MemoryConfigStore()
+        orch = orchestrator(store)
+        params = GridCellSpec.freeze_params(
+            {"scale": "log", "cmap": "magma", "xmin": 1.5}
+        )
+        grid = orch.add_grid(spec(params=params))
+
+        # "Restart": a fresh orchestrator over the same store.
+        orch2 = orchestrator(store)
+        restored = orch2.grid(grid.grid_id)
+        assert restored is not None
+        assert restored.spec.title == "Main"
+        cell = restored.cells[0].spec
+        assert cell.geometry.row_span == 2
+        assert cell.params_dict == {
+            "scale": "log",
+            "cmap": "magma",
+            "xmin": 1.5,
+        }
+        assert cell.workflow == "dummy/detector_view/panel_view/v1"
+
+    def test_remove_grid_removes_persisted_copy(self):
+        store = MemoryConfigStore()
+        orch = orchestrator(store)
+        grid = orch.add_grid(spec())
+        orch.remove_grid(grid.grid_id)
+        assert orchestrator(store).grids() == []
+
+    def test_cell_update_persists(self):
+        store = MemoryConfigStore()
+        orch = orchestrator(store)
+        grid = orch.add_grid(spec())
+        orch.update_cell(
+            grid.grid_id,
+            0,
+            params={"scale": "log"},
+            title="Renamed",
+        )
+        restored = orchestrator(store).grid(grid.grid_id)
+        assert restored.cells[0].spec.title == "Renamed"
+        assert restored.cells[0].spec.params_dict == {"scale": "log"}
+
+    def test_corrupt_persisted_grid_is_skipped_not_fatal(self):
+        store = MemoryConfigStore()
+        orch = orchestrator(store)
+        orch.add_grid(spec())
+        store.save("broken", {"cells": "not-a-list"})
+        orch2 = orchestrator(store)  # must not raise
+        assert len(orch2.grids()) == 1
+
+
+class TestHistoryDemand:
+    def test_window_params_upgrade_buffer_to_temporal(self):
+        import uuid
+
+        from esslivedata_tpu.config.workflow_spec import (
+            JobId,
+            ResultKey,
+            WorkflowId,
+        )
+        from esslivedata_tpu.utils import DataArray, Variable
+
+        ds = DataService()
+        store = MemoryConfigStore()
+        orch = orchestrator(store, ds)
+        key = ResultKey(
+            workflow_id=WorkflowId.parse("dummy/detector_view/panel_view/v1"),
+            job_id=JobId(source_name="panel_0", job_number=uuid.uuid4()),
+            output_name="counts_current",
+        )
+        ds.put(
+            key,
+            Timestamp.from_ns(1),
+            DataArray(Variable(np.asarray(1.0), (), "counts")),
+        )
+        # A plain cell leaves the single-value buffer in place...
+        orch.add_grid(spec(output="counts_current"))
+        assert isinstance(ds._buffers.get(key), SingleValueBuffer)
+        # ...a windowed cell demands history and upgrades it.
+        params = GridCellSpec.freeze_params(
+            {"extractor": "window_sum", "window_s": 10}
+        )
+        orch.add_grid(spec(params=params, output="counts_current"))
+        assert isinstance(ds._buffers.get(key), TemporalBuffer)

@@ -1,0 +1,91 @@
+"""SessionRegistry generation arithmetic (reference granularity:
+session_registry/session_updater tests): cursor math, config-change
+acknowledgement, idle expiry re-registration."""
+
+import pytest
+
+from esslivedata_tpu.dashboard import session_registry as sr
+from esslivedata_tpu.dashboard.notification_queue import NotificationQueue
+
+
+@pytest.fixture()
+def notifications():
+    return NotificationQueue()
+
+
+class TestConfigGeneration:
+    def test_first_poll_always_reports_changed(self, notifications):
+        reg = sr.SessionRegistry()
+        out = reg.poll(None, notifications)
+        assert out["config_changed"] is True
+        # Acknowledged: the same session's next poll is clean.
+        again = reg.poll(out["session_id"], notifications)
+        assert again["config_changed"] is False
+
+    def test_bump_marks_every_session_stale_once(self, notifications):
+        reg = sr.SessionRegistry()
+        a = reg.poll(None, notifications)["session_id"]
+        b = reg.poll(None, notifications)["session_id"]
+        reg.poll(a, notifications)
+        reg.poll(b, notifications)
+        reg.bump_config()
+        assert reg.poll(a, notifications)["config_changed"] is True
+        assert reg.poll(b, notifications)["config_changed"] is True
+        assert reg.poll(a, notifications)["config_changed"] is False
+
+    def test_two_bumps_between_polls_collapse_to_one_change(
+        self, notifications
+    ):
+        reg = sr.SessionRegistry()
+        sid = reg.poll(None, notifications)["session_id"]
+        reg.bump_config()
+        reg.bump_config()
+        out = reg.poll(sid, notifications)
+        assert out["config_changed"] is True
+        assert out["config_generation"] == 2
+        assert reg.poll(sid, notifications)["config_changed"] is False
+
+
+class TestNotificationCursor:
+    def test_backlog_drains_once_per_session(self, notifications):
+        reg = sr.SessionRegistry()
+        sid = reg.poll(None, notifications)["session_id"]
+        notifications.warning("first")
+        notifications.error("second")
+        out = reg.poll(sid, notifications)
+        assert [n["message"] for n in out["notifications"]] == [
+            "first",
+            "second",
+        ]
+        assert reg.poll(sid, notifications)["notifications"] == []
+
+    def test_fresh_session_skips_preexisting_backlog(self, notifications):
+        notifications.warning("old news")
+        reg = sr.SessionRegistry()
+        out = reg.poll(None, notifications)
+        # A new tab starts at the current head: only future notifications.
+        assert out["notifications"] == []
+        notifications.error("new")
+        assert [
+            n["message"]
+            for n in reg.poll(out["session_id"], notifications)[
+                "notifications"
+            ]
+        ] == ["new"]
+
+
+class TestIdleExpiry:
+    def test_idle_session_is_dropped_and_rejoins_fresh(
+        self, notifications, monkeypatch
+    ):
+        reg = sr.SessionRegistry()
+        sid = reg.poll(None, notifications)["session_id"]
+        assert len(reg.sessions()) == 1
+        # Age the session past the idle window.
+        session = reg._sessions[sid]
+        session.last_seen_wall -= sr.SESSION_IDLE_S + 1
+        assert reg.sessions() == []
+        # The same id polling again re-registers with a fresh cursor:
+        # first poll reports config changed like any new session.
+        out = reg.poll(sid, notifications)
+        assert out["config_changed"] is True

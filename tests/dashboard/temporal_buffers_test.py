@@ -1,0 +1,135 @@
+"""TemporalBuffer/SingleValueBuffer/TemporalBufferManager unit tests
+(reference granularity: tests/dashboard/temporal_buffer*_test.py):
+byte-budget eviction, history upgrade, window-edge arithmetic."""
+
+import numpy as np
+
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.dashboard.temporal_buffers import (
+    SingleValueBuffer,
+    TemporalBuffer,
+    TemporalBufferManager,
+)
+from esslivedata_tpu.utils import DataArray, Variable
+
+T = Timestamp.from_ns
+
+
+def da(n, fill=1.0):
+    return DataArray(Variable(np.full(n, fill), ("x",), "counts"))
+
+
+class TestSingleValueBuffer:
+    def test_keeps_newest_only(self):
+        buf = SingleValueBuffer()
+        buf.put(T(10), "a")
+        buf.put(T(20), "b")
+        assert buf.latest() == "b"
+        assert buf.history() == [(T(20), "b")]
+
+    def test_out_of_order_put_is_ignored(self):
+        buf = SingleValueBuffer()
+        buf.put(T(20), "new")
+        buf.put(T(10), "stale")  # late replay must not regress the value
+        assert buf.latest() == "new"
+
+    def test_equal_timestamp_takes_latest_write(self):
+        buf = SingleValueBuffer()
+        buf.put(T(10), "first")
+        buf.put(T(10), "second")  # same stamp: writer order wins
+        assert buf.latest() == "second"
+
+    def test_clear(self):
+        buf = SingleValueBuffer()
+        buf.put(T(1), "x")
+        buf.clear()
+        assert buf.is_empty
+        assert buf.history() == []
+
+
+class TestTemporalBufferBudget:
+    def test_evicts_oldest_beyond_byte_budget(self):
+        entry_bytes = da(100).data.values.nbytes  # 800
+        buf = TemporalBuffer(max_bytes=3 * entry_bytes)
+        for i in range(5):
+            buf.put(T(i), da(100, fill=i))
+        assert len(buf) == 3
+        kept = [float(np.asarray(v.values)[0]) for _, v in buf.history()]
+        assert kept == [2.0, 3.0, 4.0]  # oldest two evicted
+
+    def test_single_oversized_entry_is_kept(self):
+        # Drop-oldest must never evict the only (newest) entry, even when
+        # it alone exceeds the budget.
+        buf = TemporalBuffer(max_bytes=8)
+        buf.put(T(1), da(1000))
+        assert len(buf) == 1
+        assert buf.latest() is not None
+
+    def test_clear_resets_byte_accounting(self):
+        entry_bytes = da(10).data.values.nbytes
+        buf = TemporalBuffer(max_bytes=2 * entry_bytes)
+        buf.put(T(1), da(10))
+        buf.clear()
+        for i in range(2):
+            buf.put(T(i + 2), da(10))
+        # If clear() leaked the byte count, the second put would evict.
+        assert len(buf) == 2
+
+    def test_scalar_entries_use_fallback_size(self):
+        buf = TemporalBuffer(max_bytes=64 * 3)
+        for i in range(5):
+            buf.put(T(i), object())  # no .values -> 64-byte estimate
+        assert len(buf) == 3
+
+
+class TestTemporalBufferWindow:
+    def test_window_is_anchored_to_newest_entry(self):
+        buf = TemporalBuffer()
+        for i in range(5):
+            buf.put(T(int(i * 1e9)), i)
+        # 2 s window from t=4 s -> cutoff at exactly 2 s, INCLUSIVE.
+        got = [v for _, v in buf.window(2.0)]
+        assert got == [2, 3, 4]
+
+    def test_window_wider_than_history_returns_all(self):
+        buf = TemporalBuffer()
+        buf.put(T(0), "a")
+        buf.put(T(int(1e9)), "b")
+        assert len(buf.window(100.0)) == 2
+
+    def test_window_on_empty_buffer(self):
+        assert TemporalBuffer().window(1.0) == []
+
+
+class TestTemporalBufferManager:
+    def test_default_buffer_is_single_value(self):
+        mgr = TemporalBufferManager()
+        mgr.put("k", T(1), da(4))
+        assert isinstance(mgr.get("k"), SingleValueBuffer)
+
+    def test_history_demand_upgrades_preserving_latest(self):
+        mgr = TemporalBufferManager()
+        mgr.put("k", T(1), da(4, fill=7.0))
+        mgr.require_history("k")
+        buf = mgr.get("k")
+        assert isinstance(buf, TemporalBuffer)
+        # The pre-upgrade value is carried into the history buffer.
+        np.testing.assert_array_equal(
+            np.asarray(buf.latest().values), np.full(4, 7.0)
+        )
+        mgr.put("k", T(2), da(4, fill=8.0))
+        assert len(buf) == 2
+
+    def test_history_demand_before_first_put(self):
+        mgr = TemporalBufferManager()
+        mgr.require_history("k")
+        mgr.put("k", T(1), da(2))
+        assert isinstance(mgr.get("k"), TemporalBuffer)
+
+    def test_budget_is_passed_through(self):
+        entry = da(100).data.values.nbytes
+        mgr = TemporalBufferManager(history_max_bytes=2 * entry)
+        mgr.require_history("k")
+        for i in range(4):
+            mgr.put("k", T(i), da(100))
+        assert len(mgr.get("k")) == 2

@@ -1,0 +1,109 @@
+"""decode_backend_message unit tests (reference granularity:
+tests/dashboard per-module coverage): each topic kind decodes to its
+dashboard message type, with the documented drop rules."""
+
+import json
+import uuid
+
+import numpy as np
+
+from esslivedata_tpu.config.workflow_spec import JobId, ResultKey, WorkflowId
+from esslivedata_tpu.dashboard.transport import (
+    AckMessage,
+    DeviceMessage,
+    ResultMessage,
+    StatusMessage,
+    decode_backend_message,
+)
+from esslivedata_tpu.kafka import wire
+
+
+def result_key() -> ResultKey:
+    return ResultKey(
+        workflow_id=WorkflowId(instrument="dummy", name="view"),
+        job_id=JobId(source_name="panel_0", job_number=uuid.uuid4()),
+        output_name="image_current",
+    )
+
+
+class TestDataKind:
+    def test_decodes_result_message(self):
+        key = result_key()
+        image = np.arange(6.0).reshape(2, 3)
+        buf = wire.encode_da00(
+            key.to_string(),
+            1234,
+            [
+                wire.Da00Variable(
+                    name="signal", unit="counts", axes=("y", "x"), data=image
+                )
+            ],
+        )
+        msg = decode_backend_message("data", buf)
+        assert isinstance(msg, ResultMessage)
+        assert msg.key == key
+        assert msg.timestamp.ns == 1234
+        np.testing.assert_array_equal(np.asarray(msg.data.values), image)
+
+    def test_undecodable_key_is_dropped_not_raised(self):
+        buf = wire.encode_da00(
+            "not-a-result-key",
+            1,
+            [wire.Da00Variable(name="signal", unit="", axes=(), data=np.ones(2))],
+        )
+        assert decode_backend_message("data", buf) is None
+
+
+class TestStatusKind:
+    def test_service_status_decodes(self):
+        from esslivedata_tpu.core.job import ServiceStatus
+        from esslivedata_tpu.kafka.nicos_status import service_status_to_x5f2
+
+        status = ServiceStatus(
+            service_name="detector_data",
+            instrument="dummy",
+            stream_lags={"panel_0": (1.5, "warning")},
+        )
+        buf = service_status_to_x5f2(status)
+        msg = decode_backend_message("status", buf)
+        assert isinstance(msg, StatusMessage)
+        assert msg.service_id  # derived from the x5f2 service_id field
+        assert msg.status.stream_lags["panel_0"] == (1.5, "warning")
+
+
+class TestResponsesKind:
+    def test_ack_payload(self):
+        msg = decode_backend_message(
+            "responses", json.dumps({"kind": "ack", "ok": True}).encode()
+        )
+        assert isinstance(msg, AckMessage)
+        assert msg.payload["ok"] is True
+
+
+class TestNicosKind:
+    def test_f144_sample(self):
+        buf = wire.encode_f144("motor_x", 4.25, 777)
+        msg = decode_backend_message("nicos", buf)
+        assert isinstance(msg, DeviceMessage)
+        assert msg.name == "motor_x" and msg.value == 4.25
+        assert msg.timestamp_ns == 777
+
+    def test_da00_contracted_device_uses_signal_variable(self):
+        buf = wire.encode_da00(
+            "monitor_counts_m1",
+            9,
+            [
+                wire.Da00Variable(
+                    name="other", unit="", axes=(), data=np.array([1.0])
+                ),
+                wire.Da00Variable(
+                    name="signal", unit="counts", axes=(), data=np.array([42.0])
+                ),
+            ],
+        )
+        msg = decode_backend_message("nicos", buf)
+        assert isinstance(msg, DeviceMessage)
+        assert msg.value == 42.0 and msg.unit == "counts"
+
+    def test_unknown_kind_returns_none(self):
+        assert decode_backend_message("whatever", b"x" * 16) is None
