@@ -328,6 +328,13 @@ def bench_latency(args) -> None:
     n_pulses = 100
     latencies = []
     rtts = []
+    # Mirror the production worker's GC policy (core/service.py
+    # _run_loop): the cycle collector runs BETWEEN pulses, never inside
+    # the measured ingest->publish window.
+    import gc
+
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
     for pulse in range(n_pulses + 5):
         t_pulse = 1_700_000_000_000_000_000 + pulse * pulse_period_ns
         ids = rng.choice(ids_space, events_per_pulse).astype(np.int32)
@@ -344,6 +351,10 @@ def bench_latency(args) -> None:
             latencies.append(1e3 * (time.perf_counter() - start))
         if pulse >= 5 and pulse % 10 == 0:
             rtts.append(rtt_ms())
+        if pulse % 20 == 0:
+            gc.collect()
+    if gc_was_enabled:
+        gc.enable()
     if not latencies:
         print(
             json.dumps(

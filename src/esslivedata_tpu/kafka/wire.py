@@ -508,7 +508,98 @@ def _encode_da00_variable(b: flatbuffers.Builder, var: Da00Variable) -> int:
     return b.EndObject()
 
 
+def _encode_da00_native(
+    source_name: str, timestamp_ns: int, variables: list[Da00Variable]
+) -> bytes | None:
+    """Marshal to the native serializer (native/da00_encode.cpp); None =
+    library unavailable (callers fall back to the Python builder). The
+    native output is byte-identical to the Python path — asserted by
+    tests/kafka/native_da00_test.py — so golden fixtures hold for both.
+    """
+    try:
+        from ..native import available, da00_encode_raw
+    except Exception:  # pragma: no cover - import cycle/packaging issue
+        return None
+    if not available():
+        return None
+    if any(len(v.axes) > 16 for v in variables):
+        # Beyond the native writer's fixed axis capacity: fall back to
+        # the Python builder rather than surfacing a capacity error.
+        return None
+    strings: list[bytes] = []
+    offs = [0]
+
+    def intern(s: str) -> int:
+        raw = s.encode("utf8")
+        strings.append(raw)
+        offs.append(offs[-1] + len(raw))
+        return len(strings) - 1
+
+    src_idx = intern(source_name)
+    n = len(variables)
+    name_idx = np.empty(n, np.int32)
+    unit_idx = np.empty(n, np.int32)
+    label_idx = np.empty(n, np.int32)
+    source_idx = np.empty(n, np.int32)
+    codes = np.empty(n, np.int8)
+    axes_start = np.empty(n, np.int32)
+    axes_count = np.empty(n, np.int32)
+    dims_start = np.empty(n, np.int32)
+    dims_count = np.empty(n, np.int32)
+    axes_flat: list[int] = []
+    shapes_flat: list[int] = []
+    data_parts: list[bytes] = []
+    data_offs = np.empty(n + 1, np.int64)
+    data_offs[0] = 0
+    for i, var in enumerate(variables):
+        shape = np.asarray(var.data).shape
+        data = np.ascontiguousarray(var.data)
+        codes[i] = _dtype_code(data, _DA00_CODE)
+        name_idx[i] = intern(var.name)
+        unit_idx[i] = intern(var.unit)
+        label_idx[i] = intern(var.label) if var.label else -1
+        source_idx[i] = intern(var.source) if var.source else -1
+        axes_start[i] = len(axes_flat)
+        axes_count[i] = len(var.axes)
+        for axis in var.axes:
+            axes_flat.append(intern(axis))
+        dims_start[i] = len(shapes_flat)
+        dims_count[i] = len(shape)
+        shapes_flat.extend(int(s) for s in shape)
+        raw = data.tobytes()
+        data_parts.append(raw)
+        data_offs[i + 1] = data_offs[i] + len(raw)
+    return da00_encode_raw(
+        b"".join(strings),
+        np.asarray(offs, np.int64),
+        src_idx,
+        timestamp_ns,
+        name_idx,
+        unit_idx,
+        label_idx,
+        source_idx,
+        codes,
+        axes_start,
+        axes_count,
+        np.asarray(axes_flat, np.int32),
+        dims_start,
+        dims_count,
+        np.asarray(shapes_flat, np.int64),
+        data_offs,
+        b"".join(data_parts),
+    )
+
+
 def encode_da00(
+    source_name: str, timestamp_ns: int, variables: list[Da00Variable]
+) -> bytes:
+    encoded = _encode_da00_native(source_name, timestamp_ns, variables)
+    if encoded is not None:
+        return encoded
+    return _encode_da00_python(source_name, timestamp_ns, variables)
+
+
+def _encode_da00_python(
     source_name: str, timestamp_ns: int, variables: list[Da00Variable]
 ) -> bytes:
     b = flatbuffers.Builder(4096)

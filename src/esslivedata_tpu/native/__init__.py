@@ -28,7 +28,7 @@ __all__ = [
 ]
 
 _HERE = Path(__file__).resolve().parent
-_SRC = _HERE / "ingest.cpp"
+_SOURCES = [_HERE / "ingest.cpp", _HERE / "da00_encode.cpp"]
 _LIB = _HERE / "_ingest.so"
 
 _lock = threading.Lock()
@@ -53,7 +53,7 @@ def _compile() -> bool:
         "-shared",
         "-fPIC",
         "-std=c++17",
-        str(_SRC),
+        *[str(s) for s in _SOURCES],
         "-o",
         str(_LIB),
     ]
@@ -116,6 +116,20 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(i64),
         ctypes.POINTER(i64),
     ]
+    i64p = ctypes.POINTER(i64)
+    i8p = ctypes.POINTER(ctypes.c_int8)
+    i32 = ctypes.c_int32
+    lib.ld_da00_encode.restype = i64
+    lib.ld_da00_encode.argtypes = [
+        u8p, i64p, i32,            # strings blob, offsets, n_strs
+        i32, i64, i32,             # source idx, timestamp, n_vars
+        i32p, i32p, i32p, i32p,    # name/unit/label/source idx
+        i8p,                       # dtype codes
+        i32p, i32p, i32p,          # axes start/count/flat idx
+        i32p, i32p, i64p,          # dims start/count, shapes flat
+        i64p, u8p,                 # data offsets, data blob
+        u8p, i64,                  # out, cap
+    ]
     return lib
 
 
@@ -129,10 +143,9 @@ def load_library() -> ctypes.CDLL | None:
             return None
         # A cached .so older than the source misses newly added symbols
         # (binding would raise AttributeError): rebuild it.
-        stale = (
-            _LIB.exists()
-            and _SRC.exists()
-            and _LIB.stat().st_mtime < _SRC.stat().st_mtime
+        stale = _LIB.exists() and any(
+            s.exists() and _LIB.stat().st_mtime < s.stat().st_mtime
+            for s in _SOURCES
         )
         if (not _LIB.exists() or stale) and not _compile():
             _load_failed = True
@@ -151,6 +164,75 @@ def load_library() -> ctypes.CDLL | None:
 
 def available() -> bool:
     return load_library() is not None
+
+
+def da00_encode_raw(
+    strings_blob: bytes,
+    str_offs: np.ndarray,
+    source_name_idx: int,
+    timestamp_ns: int,
+    name_idx: np.ndarray,
+    unit_idx: np.ndarray,
+    label_idx: np.ndarray,
+    source_idx: np.ndarray,
+    dtype_codes: np.ndarray,
+    axes_start: np.ndarray,
+    axes_count: np.ndarray,
+    axes_idx_flat: np.ndarray,
+    dims_start: np.ndarray,
+    dims_count: np.ndarray,
+    shapes_flat: np.ndarray,
+    data_offs: np.ndarray,
+    data_blob: bytes,
+) -> bytes | None:
+    """Raw interface to the native da00 serializer (da00_encode.cpp);
+    marshalling from Da00Variable lives in kafka/wire.py which owns the
+    dtype table. None = library unavailable; raises on invalid input."""
+    lib = load_library()
+    if lib is None:
+        return None
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i8p = ctypes.POINTER(ctypes.c_int8)
+
+    def p(arr, ptr_type):
+        return arr.ctypes.data_as(ptr_type)
+
+    n_vars = int(name_idx.size)
+    cap = len(data_blob) + len(strings_blob) + 4096 + 160 * max(n_vars, 1)
+    u8p_t = ctypes.POINTER(ctypes.c_uint8)
+    for _ in range(3):
+        out = np.empty(cap, np.uint8)  # no zero fill (create_string_buffer's)
+        rc = lib.ld_da00_encode(
+            _as_u8p(strings_blob),
+            p(str_offs, i64p),
+            int(str_offs.size - 1),
+            int(source_name_idx),
+            int(timestamp_ns),
+            n_vars,
+            p(name_idx, i32p),
+            p(unit_idx, i32p),
+            p(label_idx, i32p),
+            p(source_idx, i32p),
+            p(dtype_codes, i8p),
+            p(axes_start, i32p),
+            p(axes_count, i32p),
+            p(axes_idx_flat, i32p),
+            p(dims_start, i32p),
+            p(dims_count, i32p),
+            p(shapes_flat, i64p),
+            p(data_offs, i64p),
+            _as_u8p(data_blob),
+            out.ctypes.data_as(u8p_t),
+            cap,
+        )
+        if rc >= 0:
+            return out[: int(rc)].tobytes()
+        if rc == -1:
+            cap *= 4
+            continue
+        raise ValueError(f"native da00 encode failed rc={rc}")
+    raise ValueError("native da00 encode: output did not fit")
 
 
 def _as_u8p(buf: bytes):
