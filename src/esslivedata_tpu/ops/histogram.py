@@ -372,9 +372,17 @@ class EventHistogrammer:
         return HistogramState(folded=state.folded, window=window, scale=scale)
 
     def _step_impl(
-        self, state: HistogramState, pixel_id: jax.Array, toa: jax.Array
+        self,
+        state: HistogramState,
+        lut: jax.Array | None,
+        pixel_id: jax.Array,
+        toa: jax.Array,
     ) -> HistogramState:
-        flat, w = self._proj.flat_and_weights(pixel_id, toa)
+        # The LUT rides as an ARGUMENT (ADR 0105, same mechanism as the
+        # Q-table kernels): a live-geometry swap is one device transfer,
+        # never a retrace. ``None`` (LUT-less configurations) is an empty
+        # pytree leaf — its cache entry projects without a LUT.
+        flat, w = self._proj.flat_and_weights(pixel_id, toa, lut=lut)
         return self._advance(state, flat, w)
 
     def _step_flat_impl(
@@ -400,9 +408,9 @@ class EventHistogrammer:
         Returns True when the new LUT is drop-in compatible (same shape
         after replica normalization): the host-flatten fast path
         (``step_flat``) reads the LUT on the host per batch, so the swap
-        costs nothing on device; the device-projection jit is recreated
-        so a later ``step`` retraces with the new table instead of using
-        the stale capture. Returns False — caller does a full rebuild —
+        costs nothing on device, and the device path threads the LUT
+        through jit as an argument (ADR 0105) so it keeps its compiled
+        step too. Returns False — caller does a full rebuild —
         for shape changes or LUT-less configurations — each kernel owns
         its own gate (the sharded twin mirrors this one).
         """
@@ -414,13 +422,18 @@ class EventHistogrammer:
         self._proj = EventProjection(
             toa_edges=old.edges,
             pixel_lut=new_lut,
-            pixel_weights=old.weights,
+            pixel_weights=None,  # carried over below
             n_screen=old.n_screen,
         )
-        # Device-path jits captured the old projection at trace time;
-        # fresh wrappers retrace (only) if that path is ever used. The
-        # new device LUT materializes lazily at that same point.
-        self._step = jax.jit(self._step_impl, donate_argnums=(0,))
+        # Carry the DEVICE weights array over directly: re-threading it
+        # through __init__ would round-trip device->host->device on every
+        # swap (the sharded twin documents the same hazard).
+        self._proj.weights = old.weights
+        # No re-jit: the device path takes the LUT as a jit argument
+        # (ADR 0105), so the swap costs one lazy device transfer on the
+        # next step — never a retrace, even for per-batch geometry flaps.
+        # TOA binning constants captured at trace time are unchanged by
+        # construction (same edges object, shape-gated LUT).
         return True
 
     def fold_window(self, state: HistogramState) -> HistogramState:
@@ -463,7 +476,10 @@ class EventHistogrammer:
         """Accumulate one padded batch. Donates ``state``: the caller's
         handle is invalidated, use the returned state."""
         return self._step(
-            state, dispatch_safe(batch.pixel_id), dispatch_safe(batch.toa)
+            state,
+            self._proj.lut,
+            dispatch_safe(batch.pixel_id),
+            dispatch_safe(batch.toa),
         )
 
     def step_arrays(
@@ -474,7 +490,12 @@ class EventHistogrammer:
             # Host arrays may carry wire dtypes (int64 ev44 ids); device
             # arrays are already int32 by construction.
             pixel_id = sanitize_pixel_id(pixel_id)
-        return self._step(state, dispatch_safe(pixel_id), dispatch_safe(toa))
+        return self._step(
+            state,
+            self._proj.lut,
+            dispatch_safe(pixel_id),
+            dispatch_safe(toa),
+        )
 
     def step_batch(self, state: HistogramState, batch: EventBatch) -> HistogramState:
         """One staged batch, taking the 4-byte/event ingest fast path

@@ -397,3 +397,43 @@ def test_wide_pixel_ids_dump_on_every_ingest_path():
     buf = StagingBuffer(min_bucket=8)
     buf.add(pid, toa)
     assert (buf.take().pixel_id[:2] == [3, -1]).all()
+
+
+def test_swap_projection_device_path_never_retraces():
+    # ADR 0105 uniformly: the device step threads the LUT through jit as
+    # an argument, so a live-geometry swap (same-shape LUT) costs one
+    # transfer — never a retrace, even if geometry flaps per batch
+    # (round-3 advisor weak item: swap_projection used to recreate the
+    # jit wrapper).
+    edges = np.linspace(0.0, 10.0, 5)
+    lut_a = np.array([0, 1, 2, 3], dtype=np.int32)
+    lut_b = np.array([0, 0, 0, 0], dtype=np.int32)  # collapse to row 0
+    h = EventHistogrammer(toa_edges=edges, n_screen=4, pixel_lut=lut_a)
+    traces = 0
+    orig = h._step_impl
+
+    def counting(*args, **kw):
+        nonlocal traces
+        traces += 1
+        return orig(*args, **kw)
+
+    import jax
+
+    h._step = jax.jit(counting, donate_argnums=(0,))
+    batch = EventBatch.from_arrays(
+        np.array([0, 1, 2, 3], np.int64),
+        np.full(4, 5.0, np.float32),
+        min_bucket=4,
+    )
+    state = h.step(h.init_state(), batch)
+    assert traces == 1
+    for flip in (lut_b, lut_a, lut_b):  # geometry flapping per batch
+        assert h.swap_projection(flip)
+        state = h.step(state, batch)
+    assert traces == 1, "LUT swap retraced the device step"
+    # And the swaps actually took effect: two batches ran under the
+    # collapsed LUT (all pixels -> row 0), two under the identity one.
+    img = h.read(state)[0].reshape(4, 4)
+    assert img.sum() == 16.0
+    row_counts = np.asarray(img).sum(axis=1)
+    np.testing.assert_array_equal(row_counts, [10.0, 2.0, 2.0, 2.0])
