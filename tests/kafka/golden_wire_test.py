@@ -518,6 +518,96 @@ GOLDEN = {
 }
 
 
+class TestWalkerDecoderCrossValidation:
+    """Randomized cross-check: every encoder's output decoded BOTH ways
+    (schema-driven walker vs wire.py decoder) must agree on every field.
+    Catches a codec and its decoder drifting together away from the
+    schema (round-trip tests alone cannot see that)."""
+
+    def test_ev44_fuzz(self, schemas):
+        rng = np.random.default_rng(7)
+        for _ in range(25):
+            n = int(rng.integers(0, 50))
+            monitor = rng.random() < 0.3
+            ids = None if monitor else rng.integers(0, 1000, n).astype(np.int32)
+            buf = wire.encode_ev44(
+                f"src{int(rng.integers(0, 10))}",
+                int(rng.integers(0, 2**31)),
+                rng.integers(0, 2**40, 2).astype(np.int64),
+                np.array([0, max(n // 2, 0)], np.int32),
+                rng.integers(0, 71_000_000, n).astype(np.int32),
+                pixel_id=ids,
+            )
+            walked = walk_root(buf, schemas["ev44"])
+            decoded = wire.decode_ev44(buf)
+            assert walked["source_name"] == decoded.source_name
+            assert walked["message_id"] == decoded.message_id
+            np.testing.assert_array_equal(
+                walked["time_of_flight"], decoded.time_of_flight
+            )
+            np.testing.assert_array_equal(
+                walked["pixel_id"], decoded.pixel_id
+            )
+            np.testing.assert_array_equal(
+                walked["reference_time"], decoded.reference_time
+            )
+            np.testing.assert_array_equal(
+                walked["reference_time_index"],
+                decoded.reference_time_index,
+            )
+
+    def test_f144_fuzz(self, schemas):
+        rng = np.random.default_rng(8)
+        for _ in range(25):
+            scalar = rng.random() < 0.5
+            value = (
+                float(rng.normal())
+                if scalar
+                else rng.normal(size=int(rng.integers(1, 8)))
+            )
+            buf = wire.encode_f144("pv", value, int(rng.integers(0, 2**60)))
+            walked = walk_root(buf, schemas["f144"])
+            decoded = wire.decode_f144(buf)
+            member, payload = walked["value"]
+            walked_values = (
+                [payload["value"]] if member == "Double" else payload["value"]
+            )
+            np.testing.assert_allclose(walked_values, decoded.value)
+            assert walked["timestamp"] == decoded.timestamp_ns
+
+    def test_da00_fuzz(self, schemas):
+        rng = np.random.default_rng(9)
+        dtypes = [np.int32, np.float64, np.uint16, np.float32, np.uint8]
+        for _ in range(25):
+            variables = []
+            for i in range(int(rng.integers(1, 5))):
+                ndim = int(rng.integers(0, 3))
+                shape = tuple(int(rng.integers(1, 6)) for _ in range(ndim))
+                dt = dtypes[int(rng.integers(0, len(dtypes)))]
+                variables.append(
+                    wire.Da00Variable(
+                        name=f"v{i}",
+                        unit=["counts", "", "m"][int(rng.integers(0, 3))],
+                        axes=tuple(f"d{k}" for k in range(ndim)),
+                        data=(rng.random(shape) * 50).astype(dt),
+                        label="lbl" if rng.random() < 0.4 else "",
+                        source="src" if rng.random() < 0.4 else "",
+                    )
+                )
+            buf = wire.encode_da00("key", int(rng.integers(0, 2**60)), variables)
+            walked = walk_root(buf, schemas["da00"])
+            decoded = wire.decode_da00(buf)
+            assert len(walked["data"]) == len(decoded.variables)
+            for wv, dv in zip(walked["data"], decoded.variables):
+                assert wv["name"] == dv.name
+                assert wv["unit"] == dv.unit  # "" is written, not omitted
+                assert (wv["label"] or "") == dv.label
+                assert (wv["source"] or "") == dv.source
+                assert bytes(wv["data"]) == np.ascontiguousarray(
+                    dv.data
+                ).tobytes()
+
+
 class TestGoldenBytes:
     """Encoder output must match the pinned bytes EXACTLY, and the
     decoders must accept the pinned bytes — so a layout change in either
