@@ -745,6 +745,29 @@ def render_png_with_meta(
                 "xlim": list(ax.get_xlim()),
                 "ylim": list(ax.get_ylim()),
             }
+            # The rendered color range: what a "freeze scale" control
+            # writes into the cell's vmin/vmax (reference
+            # cell_autoscale.py holds ranges the same way). Images render
+            # as pcolormesh (a collection) or imshow depending on size.
+            mappable = next(
+                (
+                    m
+                    for m in (*ax.images, *ax.collections)
+                    if hasattr(m, "get_clim")
+                    and m.get_clim() != (None, None)
+                ),
+                None,
+            )
+            if mappable is not None:
+                lo, hi = mappable.get_clim()
+                if lo is not None and hi is not None:
+                    meta["clim"] = [float(lo), float(hi)]
+            # Scalar/table axes carry no value ranges: their 0..1
+            # axes-fraction ylim must never be frozen into cell params.
+            meta["freezable"] = type(plotter).__name__ not in (
+                "ScalarPlotter",
+                "TablePlotter",
+            )
             return buf.getvalue(), meta
         finally:
             plt.close(fig)

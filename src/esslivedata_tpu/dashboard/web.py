@@ -880,6 +880,64 @@ async function refreshGrids() {{
       cfg.title = 'Edit plot config';
       cfg.onclick = () => editCell(g.grid_id, c.index, c.params, c.title);
       head.appendChild(cfg);
+      // Scale freeze/fit (reference cell_autoscale semantics): lock
+      // writes the CURRENTLY RENDERED ranges into the persisted cell
+      // params; fit clears them back to per-render autoscale.
+      const lock = el('button', '', '🔒');
+      lock.title = 'Freeze the current axis/color ranges into this cell';
+      lock.onclick = async () => {{
+        const flash = (msg) => {{
+          lock.textContent = '!'; lock.title = msg;
+          setTimeout(() => {{ lock.textContent = '🔒'; }}, 2500);
+        }};
+        if (!c.keys.length) return flash('no data bound to this cell');
+        if ((c.params || {{}}).overlay) {{
+          // Overlay renders have no single-axes meta; a first-layer
+          // freeze would clip the other layers.
+          return flash('freeze is not supported for overlay cells');
+        }}
+        const mq = new URLSearchParams(c.params || {{}});
+        let meta;
+        try {{
+          const mr = await fetch(
+            '/plot/' + c.keys[0] + '.meta?' + mq.toString());
+          if (!mr.ok) return flash('no rendered plot yet (' + mr.status + ')');
+          meta = await mr.json();
+        }} catch (e) {{ return flash('meta fetch failed'); }}
+        if (meta.freezable === false) {{
+          return flash('nothing to freeze for this plotter');
+        }}
+        const out = Object.assign({{}}, c.params || {{}});
+        // A constant image renders with a degenerate range; widen so
+        // the freeze stays valid (vmin must be < vmax server-side).
+        const span = (lo, hi) => hi > lo ? [lo, hi] : [lo - 0.5, lo + 0.5];
+        if (meta.clim) {{
+          [out.vmin, out.vmax] = span(meta.clim[0], meta.clim[1]);
+        }} else if (meta.ylim) {{
+          [out.vmin, out.vmax] = span(meta.ylim[0], meta.ylim[1]);
+        }}
+        if (meta.xlim) {{
+          [out.xmin, out.xmax] = span(meta.xlim[0], meta.xlim[1]);
+        }}
+        const r = await fetch(
+          `/api/grid/${{g.grid_id}}/cell/${{c.index}}/config`, {{
+            method: 'POST', body: JSON.stringify({{params: out}})}});
+        if (!r.ok) {{
+          return flash((await r.json()).error || 'freeze rejected');
+        }}
+        gridGens = {{}}; refreshGrids();
+      }};
+      head.appendChild(lock);
+      const fit = el('button', '', 'fit');
+      fit.title = 'Re-fit: clear frozen ranges, autoscale every render';
+      fit.onclick = async () => {{
+        const out = Object.assign({{}}, c.params || {{}});
+        for (const k of ['vmin', 'vmax', 'xmin', 'xmax']) delete out[k];
+        await fetch(`/api/grid/${{g.grid_id}}/cell/${{c.index}}/config`, {{
+          method: 'POST', body: JSON.stringify({{params: out}})}});
+        gridGens = {{}}; refreshGrids();
+      }};
+      head.appendChild(fit);
       cell.appendChild(head);
       if (c.keys.length) {{
         const kid = c.keys[0];

@@ -111,6 +111,48 @@ class PlotConfigHttpTest(AsyncHTTPTestCase):
     def _kid(self, state, output):
         return next(k["id"] for k in state["keys"] if k["output"] == output)
 
+    def test_scale_freeze_flow(self):
+        # The SPA's lock/fit buttons at the HTTP-contract level
+        # (reference cell_autoscale semantics): .meta exposes the
+        # rendered ranges (clim for images), writing them into the cell
+        # params freezes the scale; clearing them re-fits.
+        state = self._start_and_wait()
+        kid = self._kid(state, "image_current")
+        meta = json.loads(self.fetch(f"/plot/{kid}.meta").body)
+        assert "clim" in meta and meta["clim"][0] <= meta["clim"][1]
+        assert "xlim" in meta and "ylim" in meta
+        spec_kid = self._kid(state, "spectrum_current")
+        spec_meta = json.loads(self.fetch(f"/plot/{spec_kid}.meta").body)
+        assert "clim" not in spec_meta  # 1-D: ylim is the value range
+
+        r = self.post_json("/api/grid", {"name": "fz", "nrows": 1, "ncols": 1})
+        gid = json.loads(r.body)["grid_id"]
+        self.post_json(
+            f"/api/grid/{gid}/cell",
+            {
+                "geometry": {"row": 0, "col": 0},
+                "workflow": "",
+                "output": "image_current",
+            },
+        )
+        frozen = {
+            "vmin": meta["clim"][0],
+            "vmax": meta["clim"][1] + 1.0,
+            "xmin": meta["xlim"][0],
+            "xmax": meta["xlim"][1],
+        }
+        r = self.post_json(f"/api/grid/{gid}/cell/0/config", {"params": frozen})
+        assert r.code == 200
+        grids = json.loads(self.fetch("/api/grids").body)["grids"]
+        cell = next(g for g in grids if g["grid_id"] == gid)["cells"][0]
+        assert float(cell["params"]["vmax"]) == meta["clim"][1] + 1.0
+        # Fit: clearing the params removes the freeze.
+        r = self.post_json(f"/api/grid/{gid}/cell/0/config", {"params": {}})
+        assert r.code == 200
+        grids = json.loads(self.fetch("/api/grids").body)["grids"]
+        cell = next(g for g in grids if g["grid_id"] == gid)["cells"][0]
+        assert "vmax" not in cell["params"]
+
     def test_cell_config_round_trips_and_renders(self):
         state = self._start_and_wait()
         r = self.post_json(
