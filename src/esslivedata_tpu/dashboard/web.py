@@ -842,9 +842,106 @@ function drawCorrelation() {{
   img.style.display = '';
   img.src = `/plot/correlation.png?x=${{x}}&y=${{y}}&t=${{Date.now()}}`;
 }}
+// Multi-grid session management (reference plot_grid_manager /
+// plot_grid_tabs): a tab strip selects the visible grid; grids can be
+// created, renamed and deleted from the UI; cells can be added to a
+// grid from the live output list.
+let activeGrid = 'all';
+function renderGridTabs(grids) {{
+  let strip = document.getElementById('gridtabs');
+  const root = document.getElementById('grids');
+  if (!strip) {{
+    strip = el('div'); strip.id = 'gridtabs';
+    strip.style.margin = '4px 0';
+    root.parentElement.insertBefore(strip, root);
+  }}
+  const fp = JSON.stringify([grids.map(g => [g.grid_id, g.title]), activeGrid]);
+  if (strip.dataset.fp === fp) return;
+  strip.dataset.fp = fp;
+  strip.innerHTML = '';
+  const tab = (label, id) => {{
+    const b = el('button', activeGrid === id ? 'on' : '', label);
+    b.onclick = () => {{ activeGrid = id; gridGens = {{}}; refreshGrids(); }};
+    strip.appendChild(b);
+  }};
+  tab('All', 'all');
+  for (const g of grids) tab(g.title || g.grid_id, g.grid_id);
+  const add = el('button', '', '+ grid');
+  add.title = 'Create a new empty grid';
+  add.onclick = async () => {{
+    const name = prompt('Grid name:');
+    if (!name) return;
+    const r = await fetch('/api/grid', {{method: 'POST', body: JSON.stringify(
+      {{name: name, title: name, nrows: 2, ncols: 2}})}});
+    if (r.ok) {{ activeGrid = (await r.json()).grid_id; }}
+    gridGens = {{}}; refreshGrids();
+  }};
+  strip.appendChild(add);
+}}
+async function renameGrid(g) {{
+  const name = prompt('New grid title:', g.title || g.grid_id);
+  if (!name || name === g.title) return;
+  // Grids are immutable in place (DELETE then POST re-creates with the
+  // same cells; keys rebind on install).
+  await fetch('/api/grid/' + g.grid_id, {{method: 'DELETE'}});
+  const r = await fetch('/api/grid', {{method: 'POST', body: JSON.stringify({{
+    name: name, title: name, nrows: g.nrows, ncols: g.ncols,
+    cells: g.cells.map(c => ({{geometry: c.geometry, workflow: c.workflow,
+      output: c.output, source: c.source, plotter: c.plotter,
+      title: c.title, params: c.params}})),
+  }})}});
+  if (r.ok) activeGrid = (await r.json()).grid_id;
+  gridGens = {{}}; refreshGrids();
+}}
+function addCellDialog(g) {{
+  const old = document.getElementById('cellcfg');
+  if (old) old.remove();
+  const box = el('div', 'card'); box.id = 'cellcfg';
+  box.style.cssText =
+    'position:fixed;top:80px;left:50%;transform:translateX(-50%);' +
+    'z-index:10;min-width:320px;box-shadow:0 4px 24px rgba(0,0,0,.35)';
+  box.appendChild(el('h3', '', 'Add cell to ' + (g.title || g.grid_id)));
+  const sel = document.createElement('select');
+  const outputs = new Map();
+  for (const k of (lastState ? lastState.keys : [])) {{
+    const tag = `${{k.workflow}} · ${{k.source}} · ${{k.output}}`;
+    if (!outputs.has(tag)) outputs.set(tag, k);
+  }}
+  for (const [tag] of outputs) {{
+    const o = document.createElement('option');
+    o.value = tag; o.textContent = tag; sel.appendChild(o);
+  }}
+  box.appendChild(sel);
+  const rowIn = document.createElement('input');
+  rowIn.type = 'number'; rowIn.value = '0'; rowIn.style.width = '4em';
+  const colIn = document.createElement('input');
+  colIn.type = 'number'; colIn.value = '0'; colIn.style.width = '4em';
+  const geo = el('div');
+  geo.appendChild(el('label', '', 'row ')); geo.appendChild(rowIn);
+  geo.appendChild(el('label', '', ' col ')); geo.appendChild(colIn);
+  box.appendChild(geo);
+  const status = el('small', ''); status.style.color = '#b00020';
+  const save = el('button', '', 'Add');
+  save.onclick = async () => {{
+    const k = outputs.get(sel.value);
+    if (!k) {{ status.textContent = 'no output selected'; return; }}
+    const r = await fetch(`/api/grid/${{g.grid_id}}/cell`, {{
+      method: 'POST', body: JSON.stringify({{
+        geometry: {{row: Number(rowIn.value), col: Number(colIn.value)}},
+        workflow: k.workflow, output: k.output, source: k.source,
+      }})}});
+    if (!r.ok) {{ status.textContent = (await r.json()).error; return; }}
+    box.remove(); gridGens = {{}}; refreshGrids();
+  }};
+  const cancel = el('button', '', 'Cancel');
+  cancel.onclick = () => box.remove();
+  box.appendChild(save); box.appendChild(cancel); box.appendChild(status);
+  document.body.appendChild(box);
+}}
 async function refreshGrids() {{
   const r = await fetch('/api/grids'); const data = await r.json();
   const root = document.getElementById('grids');
+  renderGridTabs(data.grids);
   // Prune grids deleted by any client (wrapper div holds title + box).
   const live = new Set(data.grids.map(g => 'grid-' + g.grid_id));
   for (const box of [...root.querySelectorAll('.gridbox')]) {{
@@ -854,12 +951,34 @@ async function refreshGrids() {{
     let box = document.getElementById('grid-' + g.grid_id);
     if (!box) {{
       const wrap = document.createElement('div');
-      wrap.appendChild(el('h3', '', g.title || g.grid_id));
+      wrap.dataset.gridId = g.grid_id;
+      const h = el('h3', '', g.title || g.grid_id);
+      const ren = el('button', '', '✎');
+      ren.title = 'Rename this grid';
+      ren.onclick = () => renameGrid(g);
+      h.appendChild(ren);
+      const addc = el('button', '', '+ cell');
+      addc.title = 'Add a plot cell from the live outputs';
+      addc.onclick = () => addCellDialog(g);
+      h.appendChild(addc);
+      const del = el('button', '', '✕');
+      del.title = 'Delete this grid';
+      del.onclick = async () => {{
+        if (!confirm('Delete grid "' + (g.title || g.grid_id) + '"?')) return;
+        await fetch('/api/grid/' + g.grid_id, {{method: 'DELETE'}});
+        if (activeGrid === g.grid_id) activeGrid = 'all';
+        gridGens = {{}}; refreshGrids();
+      }};
+      h.appendChild(del);
+      wrap.appendChild(h);
       box = document.createElement('div');
       box.className = 'gridbox'; box.id = 'grid-' + g.grid_id;
       box.style.gridTemplateColumns = `repeat(${{g.ncols}}, 1fr)`;
       wrap.appendChild(box); root.appendChild(wrap);
     }}
+    // Tab selection: only the active grid (or all) is visible.
+    box.parentElement.style.display =
+      (activeGrid === 'all' || activeGrid === g.grid_id) ? '' : 'none';
     // Frame-gated repaint: only when this grid's generation advanced.
     if (gridGens[g.grid_id] === g.generation) continue;
     // Never repaint under an active ROI edit: rebuilding the cell would

@@ -154,6 +154,25 @@ def test_jobs_drilldown_shows_stream_detail(page):
     assert "msgs" in detail.inner_text()
 
 
+def test_grid_tabs_and_management(page):
+    # The tab strip lists every grid plus All and + grid; creating a
+    # grid through the prompt adds a tab and selects it; deleting
+    # removes it (reference plot_grid_tabs/plot_grid_manager flows).
+    page.locator("#tab-grids").click()
+    page.wait_for_selector("#gridtabs button", timeout=15_000)
+    n_before = page.locator("#gridtabs button").count()
+    page.on("dialog", lambda d: d.accept("browser-made"))
+    page.locator("#gridtabs button", has_text="+ grid").click()
+    page.wait_for_timeout(1000)
+    assert page.locator("#gridtabs button").count() == n_before + 1
+    tab = page.locator("#gridtabs button", has_text="browser-made")
+    assert tab.count() == 1
+    # Delete it again via its header ✕ (confirm auto-accepted).
+    page.locator("div[data-grid-id] h3 button", has_text="✕").last.click()
+    page.wait_for_timeout(1000)
+    assert page.locator("#gridtabs button", has_text="browser-made").count() == 0
+
+
 def test_cell_config_exposes_display_controls(page):
     # The per-cell config modal carries the display controls the
     # reference's plot_config_modal exposes: scale/log, colormap,
