@@ -338,6 +338,21 @@ class GridManageHandler(_Base):
             self.set_status(400)
             self.write_json({"error": str(err)})
             return
+        if "/" in spec.name:
+            # The grid id (= name) travels in URL path segments
+            # (r"/api/grid/([^/]+)"): a slash would make the grid
+            # unreachable for delete/rename/cell edits.
+            self.set_status(400)
+            self.write_json({"error": "grid names must not contain '/'"})
+            return
+        if self.services.plot_orchestrator.grid(spec.name) is not None:
+            # grid_id = name: installing over an existing id would
+            # silently destroy that grid's cells.
+            self.set_status(409)
+            self.write_json(
+                {"error": f"grid {spec.name!r} already exists"}
+            )
+            return
         grid = self.services.plot_orchestrator.add_grid(spec)
         self.services.sessions.bump_config()
         self.write_json({"grid_id": grid.grid_id})
@@ -847,6 +862,11 @@ function drawCorrelation() {{
 // created, renamed and deleted from the UI; cells can be added to a
 // grid from the live output list.
 let activeGrid = 'all';
+// Latest grid documents by id: header-button closures capture only the
+// ID and look the CURRENT document up here, so rename/add-cell never
+// act on a stale snapshot from the poll that built the header.
+let gridById = {{}};
+const gurl = (gid) => '/api/grid/' + encodeURIComponent(gid);
 function renderGridTabs(grids) {{
   let strip = document.getElementById('gridtabs');
   const root = document.getElementById('grids');
@@ -874,26 +894,36 @@ function renderGridTabs(grids) {{
     const r = await fetch('/api/grid', {{method: 'POST', body: JSON.stringify(
       {{name: name, title: name, nrows: 2, ncols: 2}})}});
     if (r.ok) {{ activeGrid = (await r.json()).grid_id; }}
+    else {{ alert('Grid not created: ' + ((await r.json()).error || r.status)); }}
     gridGens = {{}}; refreshGrids();
   }};
   strip.appendChild(add);
 }}
-async function renameGrid(g) {{
+async function renameGrid(gid) {{
+  const g = gridById[gid];
+  if (!g) return;
   const name = prompt('New grid title:', g.title || g.grid_id);
   if (!name || name === g.title) return;
-  // Grids are immutable in place (DELETE then POST re-creates with the
-  // same cells; keys rebind on install).
-  await fetch('/api/grid/' + g.grid_id, {{method: 'DELETE'}});
+  // Grids are immutable in place: CREATE the renamed copy first (the
+  // new name is a distinct id), and only delete the original once the
+  // copy exists — a failed create must never lose the grid.
   const r = await fetch('/api/grid', {{method: 'POST', body: JSON.stringify({{
     name: name, title: name, nrows: g.nrows, ncols: g.ncols,
     cells: g.cells.map(c => ({{geometry: c.geometry, workflow: c.workflow,
       output: c.output, source: c.source, plotter: c.plotter,
       title: c.title, params: c.params}})),
   }})}});
-  if (r.ok) activeGrid = (await r.json()).grid_id;
+  if (!r.ok) {{
+    alert('Rename failed: ' + ((await r.json()).error || r.status));
+    return;
+  }}
+  activeGrid = (await r.json()).grid_id;
+  await fetch(gurl(gid), {{method: 'DELETE'}});
   gridGens = {{}}; refreshGrids();
 }}
-function addCellDialog(g) {{
+function addCellDialog(gid) {{
+  const g = gridById[gid];
+  if (!g) return;
   const old = document.getElementById('cellcfg');
   if (old) old.remove();
   const box = el('div', 'card'); box.id = 'cellcfg';
@@ -925,7 +955,7 @@ function addCellDialog(g) {{
   save.onclick = async () => {{
     const k = outputs.get(sel.value);
     if (!k) {{ status.textContent = 'no output selected'; return; }}
-    const r = await fetch(`/api/grid/${{g.grid_id}}/cell`, {{
+    const r = await fetch(gurl(g.grid_id) + '/cell', {{
       method: 'POST', body: JSON.stringify({{
         geometry: {{row: Number(rowIn.value), col: Number(colIn.value)}},
         workflow: k.workflow, output: k.output, source: k.source,
@@ -941,6 +971,11 @@ function addCellDialog(g) {{
 async function refreshGrids() {{
   const r = await fetch('/api/grids'); const data = await r.json();
   const root = document.getElementById('grids');
+  gridById = {{}};
+  for (const g of data.grids) gridById[g.grid_id] = g;
+  // A remotely deleted selection falls back to All (otherwise every
+  // grid would be display:none with no tab to escape).
+  if (activeGrid !== 'all' && !gridById[activeGrid]) activeGrid = 'all';
   renderGridTabs(data.grids);
   // Prune grids deleted by any client (wrapper div holds title + box).
   const live = new Set(data.grids.map(g => 'grid-' + g.grid_id));
@@ -952,21 +987,23 @@ async function refreshGrids() {{
     if (!box) {{
       const wrap = document.createElement('div');
       wrap.dataset.gridId = g.grid_id;
+      const gid = g.grid_id;  // closures resolve the LIVE doc by id
       const h = el('h3', '', g.title || g.grid_id);
       const ren = el('button', '', '✎');
       ren.title = 'Rename this grid';
-      ren.onclick = () => renameGrid(g);
+      ren.onclick = () => renameGrid(gid);
       h.appendChild(ren);
       const addc = el('button', '', '+ cell');
       addc.title = 'Add a plot cell from the live outputs';
-      addc.onclick = () => addCellDialog(g);
+      addc.onclick = () => addCellDialog(gid);
       h.appendChild(addc);
       const del = el('button', '', '✕');
       del.title = 'Delete this grid';
       del.onclick = async () => {{
-        if (!confirm('Delete grid "' + (g.title || g.grid_id) + '"?')) return;
-        await fetch('/api/grid/' + g.grid_id, {{method: 'DELETE'}});
-        if (activeGrid === g.grid_id) activeGrid = 'all';
+        const doc = gridById[gid] || g;
+        if (!confirm('Delete grid "' + (doc.title || gid) + '"?')) return;
+        await fetch(gurl(gid), {{method: 'DELETE'}});
+        if (activeGrid === gid) activeGrid = 'all';
         gridGens = {{}}; refreshGrids();
       }};
       h.appendChild(del);
@@ -976,9 +1013,12 @@ async function refreshGrids() {{
       box.style.gridTemplateColumns = `repeat(${{g.ncols}}, 1fr)`;
       wrap.appendChild(box); root.appendChild(wrap);
     }}
-    // Tab selection: only the active grid (or all) is visible.
-    box.parentElement.style.display =
-      (activeGrid === 'all' || activeGrid === g.grid_id) ? '' : 'none';
+    // Tab selection: only the active grid (or all) is visible. Hidden
+    // grids also SKIP repainting (no PNG fetches for invisible cells);
+    // gridGens stays stale so they paint when their tab is selected.
+    const visible = activeGrid === 'all' || activeGrid === g.grid_id;
+    box.parentElement.style.display = visible ? '' : 'none';
+    if (!visible) continue;
     // Frame-gated repaint: only when this grid's generation advanced.
     if (gridGens[g.grid_id] === g.generation) continue;
     // Never repaint under an active ROI edit: rebuilding the cell would
@@ -1039,7 +1079,7 @@ async function refreshGrids() {{
           [out.xmin, out.xmax] = span(meta.xlim[0], meta.xlim[1]);
         }}
         const r = await fetch(
-          `/api/grid/${{g.grid_id}}/cell/${{c.index}}/config`, {{
+          gurl(g.grid_id) + `/cell/${{c.index}}/config`, {{
             method: 'POST', body: JSON.stringify({{params: out}})}});
         if (!r.ok) {{
           return flash((await r.json()).error || 'freeze rejected');
@@ -1052,7 +1092,7 @@ async function refreshGrids() {{
       fit.onclick = async () => {{
         const out = Object.assign({{}}, c.params || {{}});
         for (const k of ['vmin', 'vmax', 'xmin', 'xmax']) delete out[k];
-        await fetch(`/api/grid/${{g.grid_id}}/cell/${{c.index}}/config`, {{
+        await fetch(gurl(g.grid_id) + `/cell/${{c.index}}/config`, {{
           method: 'POST', body: JSON.stringify({{params: out}})}});
         gridGens = {{}}; refreshGrids();
       }};
@@ -1173,7 +1213,7 @@ function editCell(gridId, index, params, currentTitle) {{
     }}
     const body = {{params: out}};
     if (titleInput.value !== (currentTitle || '')) body.title = titleInput.value;
-    const r = await fetch(`/api/grid/${{gridId}}/cell/${{index}}/config`, {{
+    const r = await fetch(gurl(gridId) + `/cell/${{index}}/config`, {{
       method: 'POST', body: JSON.stringify(body)}});
     if (!r.ok) {{ status.textContent = (await r.json()).error; return; }}
     box.remove(); gridGens = {{}}; refreshGrids();

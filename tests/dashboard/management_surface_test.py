@@ -45,6 +45,20 @@ class ManagementApiTest(AsyncHTTPTestCase):
     def post_json(self, url, payload, method="POST"):
         return self.fetch(url, method=method, body=json.dumps(payload))
 
+    # -- grid name guards ---------------------------------------------------
+    def test_duplicate_grid_name_409s(self):
+        r = self.post_json("/api/grid", {"name": "dup", "nrows": 1, "ncols": 1})
+        assert r.code == 200
+        r = self.post_json("/api/grid", {"name": "dup", "nrows": 2, "ncols": 2})
+        assert r.code == 409
+        assert "exists" in json.loads(r.body)["error"]
+
+    def test_grid_name_with_slash_400s(self):
+        # grid_id = name rides URL path segments; a slash would make the
+        # grid unreachable for delete/rename/cell edits.
+        r = self.post_json("/api/grid", {"name": "det/mon", "nrows": 1, "ncols": 1})
+        assert r.code == 400
+
     # -- two-phase start + validation -------------------------------------
     def test_stage_rejects_invalid_params_with_details(self):
         r = self.post_json(
