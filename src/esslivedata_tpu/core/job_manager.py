@@ -162,12 +162,20 @@ class JobManager:
         if store is None or not supports_snapshot(wf):
             return
         try:
+            # Non-consuming load: a workflow that refuses the arrays
+            # (device state not built yet) keeps the file for a later
+            # schedule instead of losing it.
             arrays = store.load(
                 workflow_id=str(job.workflow_id),
                 source_name=job.job_id.source_name,
                 fingerprint=wf.state_fingerprint(),
+                consume=False,
             )
             if arrays is not None and wf.restore_state(arrays):
+                store.discard(
+                    workflow_id=str(job.workflow_id),
+                    source_name=job.job_id.source_name,
+                )
                 logger.info(
                     "Restored snapshot state for %s/%s",
                     job.workflow_id,
@@ -185,11 +193,16 @@ class JobManager:
         if store is None or not supports_snapshot(wf):
             return
         try:
+            arrays = wf.dump_state()
+            if not arrays:
+                # Nothing accumulated yet (context-gated workflow before
+                # its first table): don't overwrite a prior snapshot.
+                return
             store.save(
                 workflow_id=str(rec.job.workflow_id),
                 source_name=rec.job.job_id.source_name,
                 fingerprint=wf.state_fingerprint(),
-                arrays=wf.dump_state(),
+                arrays=arrays,
                 reason=reason,
                 archive=archive,
             )

@@ -104,11 +104,19 @@ class SnapshotStore:
         return path
 
     def load(
-        self, *, workflow_id: str, source_name: str, fingerprint: str
+        self,
+        *,
+        workflow_id: str,
+        source_name: str,
+        fingerprint: str,
+        consume: bool = True,
     ) -> dict[str, np.ndarray] | None:
-        """Arrays if a snapshot exists AND its fingerprint matches; the
-        file is consumed (deleted) on a hit, kept on a mismatch (a
-        rollback to the old configuration can still use it)."""
+        """Arrays if a snapshot exists AND its fingerprint matches; with
+        ``consume`` the file is deleted on a hit (kept on a mismatch — a
+        rollback to the old configuration can still use it). Callers
+        that might REFUSE the arrays after loading (a workflow whose
+        device state is not built yet) pass ``consume=False`` and call
+        :meth:`discard` only once the restore actually succeeded."""
         path = self._path(workflow_id, source_name, archive=False)
         try:
             with np.load(path) as archive:
@@ -128,8 +136,12 @@ class SnapshotStore:
         except Exception:
             logger.exception("Snapshot for %s/%s unreadable", workflow_id, source_name)
             return None
+        if consume:
+            self.discard(workflow_id=workflow_id, source_name=source_name)
+        return arrays
+
+    def discard(self, *, workflow_id: str, source_name: str) -> None:
         try:
-            path.unlink()
+            self._path(workflow_id, source_name, archive=False).unlink()
         except OSError:
             pass
-        return arrays

@@ -72,6 +72,66 @@ class QStreamingMixin:
                 )
             self._state = self._hist.step(self._state, detector, monitor_count)
 
+    # -- state snapshots (core/state_snapshot.py, ADR 0107) ----------------
+    def state_fingerprint(self) -> str:
+        """The BIN SPACE's identity, deliberately NOT the table bytes:
+        accumulated counts mean "events in bin k of this binning" — a
+        live table recalibration (powder emission offset, reflectometry
+        omega move) changes where FUTURE events land but not what the
+        accumulated bins mean, and these workflows preserve state across
+        swaps by design. The bin space is fully determined by the
+        workflow class and its params, both available even before a
+        context-gated workflow builds its first table."""
+        import hashlib
+
+        h = hashlib.sha1()
+        h.update(type(self).__name__.encode())
+        params = getattr(self, "_params", None)
+        if params is not None and hasattr(params, "model_dump_json"):
+            h.update(params.model_dump_json().encode())
+        return h.hexdigest()
+
+    def dump_state(self) -> dict[str, np.ndarray]:
+        if getattr(self, "_state", None) is None:
+            # Context-gated workflows (reflectometry before the first
+            # sample angle) have nothing to dump yet; an empty dict is
+            # skipped by the snapshot writer rather than overwriting a
+            # prior useful snapshot.
+            return {}
+        out = {
+            field: np.asarray(getattr(self._state, field))
+            for field in self._state._fields
+        }
+        # The host-side transmission counters share the fold semantics
+        # of the device channels and must travel with them.
+        out["trans_win"] = np.asarray(self._trans_win)
+        out["trans_cum"] = np.asarray(self._trans_cum)
+        return out
+
+    def restore_state(self, arrays: dict[str, np.ndarray]) -> bool:
+        if getattr(self, "_state", None) is None:
+            # No device state to adopt into yet (schedule-time restore of
+            # a context-gated workflow). Refusing here is safe: the
+            # caller keeps the snapshot file for a later attempt.
+            return False
+        import jax.numpy as jnp
+
+        from ..ops.qhistogram import QState
+
+        restored = {}
+        for field in QState._fields:
+            if field not in arrays:
+                return False
+            value = np.asarray(arrays[field])
+            current = getattr(self._state, field)
+            if value.shape != current.shape:
+                return False
+            restored[field] = jnp.asarray(value, dtype=current.dtype)
+        self._state = QState(**restored)
+        self._trans_win = float(arrays.get("trans_win", 0.0))
+        self._trans_cum = float(arrays.get("trans_cum", 0.0))
+        return True
+
     def _take_publish(self) -> tuple[np.ndarray, np.ndarray, float, float]:
         """One fused publish: (window, cumulative, monitor_window,
         monitor_cumulative) on host; the window folds."""

@@ -295,3 +295,106 @@ class TestServiceRestart:
             )
             is None
         )
+
+
+class TestQWorkflowDumpRestore:
+    """ADR 0107 for the reduction families: the Q-streaming mixin dumps
+    and restores QState + the host transmission counters, gated by a
+    table-content fingerprint."""
+
+    def _workflow(self, **kw):
+        from esslivedata_tpu.workflows.sans import (
+            SansIQParams,
+            SansIQWorkflow,
+        )
+
+        rng = np.random.default_rng(0)
+        n = 64
+        positions = np.column_stack(
+            [
+                rng.uniform(-0.3, 0.3, n),
+                rng.uniform(-0.3, 0.3, n),
+                np.full(n, 5.0),
+            ]
+        )
+        return SansIQWorkflow(
+            positions=positions,
+            pixel_ids=np.arange(10, 10 + n),
+            params=SansIQParams(**kw) if kw else None,
+            primary_stream="det",
+            monitor_streams={"mon"},
+        )
+
+    def _staged(self, n=500, seed=1):
+        from esslivedata_tpu.core.timestamp import Timestamp
+        from esslivedata_tpu.preprocessors.event_data import (
+            DetectorEvents,
+            ToEventBatch,
+        )
+
+        rng = np.random.default_rng(seed)
+        stage = ToEventBatch()
+        stage.add(
+            Timestamp.from_ns(1),
+            DetectorEvents(
+                pixel_id=rng.integers(10, 74, n).astype(np.int32),
+                time_of_arrival=rng.uniform(1e6, 6e7, n).astype(np.float32),
+            ),
+        )
+        return stage.get()
+
+    def test_round_trip_carries_counts_and_monitors(self):
+        wf = self._workflow()
+        wf.accumulate({"det": self._staged(), "mon": self._staged(100, 2)})
+        dump = wf.dump_state()
+        wf2 = self._workflow()
+        assert wf2.state_fingerprint() == wf.state_fingerprint()
+        assert wf2.restore_state(dump)
+        out = wf2.finalize()
+        total = float(np.asarray(out["iq_cumulative"].data.values).sum())
+        assert total > 0
+
+    def test_fingerprint_is_the_bin_space(self):
+        # Params change the bin space -> different fingerprint; a live
+        # table swap does NOT (counts keep their meaning across
+        # recalibrations, which these workflows preserve by design).
+        wf = self._workflow()
+        wf_zoomed = self._workflow(q_max=2.0)
+        assert wf.state_fingerprint() != wf_zoomed.state_fingerprint()
+        from esslivedata_tpu.ops.qhistogram import PixelBinMap
+
+        before = wf.state_fingerprint()
+        wf._hist.swap_table(
+            PixelBinMap(
+                table=np.asarray(wf._hist._qmap).copy(),
+                id_base=wf._hist._id_base,
+            )
+        )
+        assert wf.state_fingerprint() == before
+
+    def test_context_gated_workflow_is_snapshot_safe(self):
+        # Reflectometry builds its table only when the sample angle
+        # arrives: before that, dumps are empty (not written) and
+        # restores are refused WITHOUT consuming the snapshot.
+        from esslivedata_tpu.workflows.reflectometry import (
+            ReflectometryWorkflow,
+        )
+
+        n = 16
+        wf = ReflectometryWorkflow(
+            pixel_offset_rad=np.linspace(0.001, 0.03, n),
+            l2=np.full(n, 4.0),
+            pixel_ids=np.arange(1, n + 1),
+            primary_stream="det",
+            monitor_streams=set(),
+        )
+        assert wf.state_fingerprint()  # computable without a table
+        assert wf.dump_state() == {}
+        assert not wf.restore_state({"cumulative": np.zeros(4)})
+
+    def test_restore_rejects_missing_or_misshapen(self):
+        wf = self._workflow()
+        assert not wf.restore_state({"cumulative": np.zeros(3)})
+        dump = wf.dump_state()
+        dump["window"] = np.zeros(7)
+        assert not wf.restore_state(dump)
