@@ -443,6 +443,48 @@ class EventHistogrammer:
         ``clear_window`` is the standalone jitted equivalent."""
         return self._clear_window_impl(state)
 
+    # -- state snapshot codec (core/state_snapshot.py, ADR 0107) -----------
+    # The ONE place that knows how a HistogramState serializes; workflow
+    # dump_state/restore_state implementations layer their extras on top
+    # instead of hand-rolling (and drifting) per-workflow copies.
+    @staticmethod
+    def dump_state_arrays(state: HistogramState) -> dict[str, np.ndarray]:
+        out = {
+            "folded": np.asarray(state.folded),
+            "window": np.asarray(state.window),
+        }
+        if state.scale is not None:
+            out["scale"] = np.asarray(state.scale)
+        return out
+
+    @staticmethod
+    def restore_state_arrays(
+        current: HistogramState, arrays: dict
+    ) -> HistogramState | None:
+        """A restored state shaped like ``current``, or None if the
+        arrays don't fit (shape-checked; never partially adopts)."""
+        folded = np.asarray(arrays.get("folded"))
+        window = np.asarray(arrays.get("window"))
+        want = current.folded.shape
+        if folded.shape != want or window.shape != want:
+            return None
+        has_scale = current.scale is not None
+        if has_scale != ("scale" in arrays):
+            return None
+        if has_scale and np.asarray(arrays["scale"]).shape != (
+            current.scale.shape
+        ):
+            return None
+        return HistogramState(
+            folded=jnp.asarray(folded, dtype=current.folded.dtype),
+            window=jnp.asarray(window, dtype=current.window.dtype),
+            scale=(
+                jnp.asarray(arrays["scale"], dtype=current.scale.dtype)
+                if has_scale
+                else None
+            ),
+        )
+
     def views_of(self, state: HistogramState) -> tuple[jax.Array, jax.Array]:
         """Traceable (cumulative, window) views, ``[n_screen, n_toa]`` —
         the composition counterpart of the jitted ``views``."""

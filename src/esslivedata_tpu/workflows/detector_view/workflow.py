@@ -377,41 +377,16 @@ class DetectorViewWorkflow:
 
     def dump_state(self) -> dict[str, np.ndarray]:
         """Host copy of the device accumulation (folded, window, scale)."""
-        out = {
-            "folded": np.asarray(self._state.folded),
-            "window": np.asarray(self._state.window),
-        }
-        if self._state.scale is not None:
-            out["scale"] = np.asarray(self._state.scale)
-        return out
+        return EventHistogrammer.dump_state_arrays(self._state)
 
     def restore_state(self, arrays: dict[str, np.ndarray]) -> bool:
         """Adopt a dumped accumulation; shape-checked against the current
         kernel (fingerprint matching happens in the store, but a corrupt
         file must not poison the device state)."""
-        folded = np.asarray(arrays.get("folded"))
-        window = np.asarray(arrays.get("window"))
-        want = self._state.folded.shape
-        if folded.shape != want or window.shape != want:
+        restored = EventHistogrammer.restore_state_arrays(self._state, arrays)
+        if restored is None:
             return False
-        has_scale = self._state.scale is not None
-        if has_scale != ("scale" in arrays):
-            return False
-        if has_scale and np.asarray(arrays["scale"]).shape != (
-            self._state.scale.shape
-        ):
-            return False
-        import jax.numpy as jnp
-
-        self._state = HistogramState(
-            folded=jnp.asarray(folded, dtype=self._state.folded.dtype),
-            window=jnp.asarray(window, dtype=self._state.window.dtype),
-            scale=(
-                jnp.asarray(arrays["scale"], dtype=self._state.scale.dtype)
-                if has_scale
-                else None
-            ),
-        )
+        self._state = restored
         return True
 
     # -- introspection -----------------------------------------------------

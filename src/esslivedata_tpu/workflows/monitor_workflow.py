@@ -248,3 +248,45 @@ class MonitorWorkflow:
         self._state = self._hist.clear(self._state)
         self._dense_cumulative[:] = 0.0
         self._dense_window[:] = 0.0
+
+    # -- state snapshots (core/state_snapshot.py, ADR 0107) ----------------
+    def state_fingerprint(self) -> str:
+        """Axis edges + full params: everything that gives the spectrum
+        bins physical meaning (a position move resets accumulation
+        in-process, so the anchor position itself is not part of the
+        bins' meaning and travels with the dump instead)."""
+        import hashlib
+
+        h = hashlib.sha1()
+        h.update(self._edges.tobytes())
+        h.update(self._params.model_dump_json().encode())
+        return h.hexdigest()
+
+    def dump_state(self) -> dict[str, np.ndarray]:
+        out = EventHistogrammer.dump_state_arrays(self._state)
+        out["dense_window"] = self._dense_window.copy()
+        out["dense_cumulative"] = self._dense_cumulative.copy()
+        if self._position is not None:
+            # The reset-on-move anchor: without it, a restart during a
+            # slow scan would re-anchor at the next sample and blend
+            # pre-move counts with post-move ones.
+            out["position"] = np.asarray(float(self._position))
+        return out
+
+    def restore_state(self, arrays: dict[str, np.ndarray]) -> bool:
+        dense_w = np.asarray(arrays.get("dense_window"))
+        dense_c = np.asarray(arrays.get("dense_cumulative"))
+        if (
+            dense_w.shape != self._dense_window.shape
+            or dense_c.shape != self._dense_cumulative.shape
+        ):
+            return False
+        restored = EventHistogrammer.restore_state_arrays(self._state, arrays)
+        if restored is None:
+            return False
+        self._state = restored
+        self._dense_window = dense_w.astype(self._dense_window.dtype)
+        self._dense_cumulative = dense_c.astype(self._dense_cumulative.dtype)
+        if "position" in arrays:
+            self._position = float(arrays["position"])
+        return True

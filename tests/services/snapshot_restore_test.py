@@ -398,3 +398,61 @@ class TestQWorkflowDumpRestore:
         dump = wf.dump_state()
         dump["window"] = np.zeros(7)
         assert not wf.restore_state(dump)
+
+
+class TestMonitorWorkflowDumpRestore:
+    def _workflow(self, **kw):
+        from esslivedata_tpu.workflows.monitor_workflow import (
+            MonitorParams,
+            MonitorWorkflow,
+        )
+
+        return MonitorWorkflow(
+            params=MonitorParams(**kw) if kw else None,
+            position_stream="mon_position",
+        )
+
+    def test_round_trip_carries_events_dense_and_anchor(self):
+        from esslivedata_tpu.core.timestamp import Timestamp
+        from esslivedata_tpu.preprocessors.event_data import (
+            MonitorEvents,
+            ToEventBatch,
+        )
+        from esslivedata_tpu.utils import DataArray, Variable, linspace
+
+        wf = self._workflow()
+        stage = ToEventBatch()
+        stage.add(
+            Timestamp.from_ns(1),
+            MonitorEvents(
+                time_of_arrival=np.linspace(1e6, 6e7, 300).astype(np.float32)
+            ),
+        )
+        wf.accumulate({"m": stage.get()})
+        # Histogram-mode (dense) contribution + a position anchor.
+        dense = DataArray(
+            Variable(np.full(10, 2.0), ("toa",), "counts"),
+            coords={"toa": linspace("toa", 0, 7.1e7, 11, "ns")},
+        )
+        wf.accumulate({"m": dense})
+        wf.set_context({"mon_position": 4.5})
+        dump = wf.dump_state()
+
+        wf2 = self._workflow()
+        assert wf2.state_fingerprint() == wf.state_fingerprint()
+        assert wf2.restore_state(dump)
+        out = wf2.finalize()
+        total = float(np.asarray(out["counts_cumulative"].data.values))
+        assert total == 300.0 + 20.0
+        # The reset-on-move anchor traveled: a sample at the same
+        # position does NOT reset the restored accumulation.
+        wf2.set_context({"mon_position": 4.5})
+        out2 = wf2.finalize()
+        assert float(np.asarray(out2["counts_cumulative"].data.values)) >= 320.0
+
+    def test_fingerprint_separates_axis_modes(self):
+        toa = self._workflow()
+        lam = self._workflow(
+            coordinate="wavelength", distance_m=25.0
+        )
+        assert toa.state_fingerprint() != lam.state_fingerprint()
