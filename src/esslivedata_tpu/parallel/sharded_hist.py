@@ -356,5 +356,48 @@ class ShardedHistogrammer:
         )
         return np.asarray(cum), np.asarray(win)
 
+    # -- state snapshot codec (ADR 0107, multichip shape) ------------------
+    def dump_state_arrays(self, state: HistogramState) -> dict[str, np.ndarray]:
+        """Gathered host copy of the sharded accumulation: snapshots are
+        mesh-layout-independent, so a state dumped on one mesh restores
+        onto a service with a different device count."""
+        out = {
+            "folded": np.asarray(jax.device_get(state.folded)),
+            "window": np.asarray(jax.device_get(state.window)),
+        }
+        if state.scale is not None:
+            out["scale"] = np.asarray(jax.device_get(state.scale))
+        return out
+
+    def restore_state_arrays(
+        self, current: HistogramState, arrays: dict
+    ) -> HistogramState | None:
+        """Re-place dumped host arrays over THIS mesh's shardings, or
+        None if they don't fit (shape-checked, never partially adopts)."""
+        folded = np.asarray(arrays.get("folded"))
+        window = np.asarray(arrays.get("window"))
+        want = (self._n_screen, self._n_toa)
+        if folded.shape != want or window.shape != want:
+            return None
+        has_scale = self._decay is not None
+        if has_scale != ("scale" in arrays):
+            return None
+        return HistogramState(
+            folded=jax.device_put(
+                jnp.asarray(folded, dtype=self._dtype), self._state_sharding
+            ),
+            window=jax.device_put(
+                jnp.asarray(window, dtype=self._dtype), self._state_sharding
+            ),
+            scale=(
+                jax.device_put(
+                    jnp.asarray(arrays["scale"], dtype=self._dtype),
+                    self._scalar_sharding,
+                )
+                if has_scale
+                else None
+            ),
+        )
+
     # Backwards-compatible alias.
     to_host = read
