@@ -83,7 +83,11 @@ class IntegrationBackend:
 
     # -- process management ------------------------------------------------
     def spawn_service(
-        self, service: str = "detector_data", instrument: str | None = None
+        self,
+        service: str = "detector_data",
+        instrument: str | None = None,
+        *,
+        extra_env: dict[str, str] | None = None,
     ) -> subprocess.Popen:
         instrument = instrument or self.instrument
         proc = subprocess.Popen(
@@ -98,7 +102,7 @@ class IntegrationBackend:
                 "--batcher",
                 "naive",
             ],
-            env=_child_env(),
+            env=_child_env(**(extra_env or {})),
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
