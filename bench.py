@@ -139,6 +139,19 @@ def bench_secondary_configs(args, edges, batches, method: str) -> None:
         "config1_monitor_1d_tof_histogram",
         EventHistogrammer(toa_edges=edges_1d, n_screen=1, method=method),
     )
+    # The VMEM-sized bin space is where the pallas one-hot kernel can
+    # beat the serial scatter: measure it alongside for the record
+    # (interpret mode off-TPU is meaninglessly slow — TPU only).
+    if jax.default_backend() == "tpu" and method != "pallas":
+        try:
+            timed(
+                "config1_monitor_1d_pallas",
+                EventHistogrammer(
+                    toa_edges=edges_1d, n_screen=1, method="pallas"
+                ),
+            )
+        except Exception:
+            traceback.print_exc()
 
     # Config 3: 9-bank multibank view.
     n_banks, per_bank = 9, 1 + (args.pixels - 1) // 9
@@ -438,6 +451,17 @@ def run_benchmark(args, platform: str) -> dict:
         return args.events * reps / (time.perf_counter() - t0)
 
     method = args.method
+    if method == "pallas":
+        # The headline 1.5Mx100 bin space is far beyond the pallas
+        # kernel's VMEM bound: measure the headline on the scatter and
+        # let the secondary configs (--all) measure pallas where it
+        # fits (config1's 1-D monitor histogram).
+        print(
+            "--method pallas: headline uses scatter (bin space exceeds "
+            "the pallas VMEM bound); config1 measures pallas under --all",
+            file=sys.stderr,
+        )
+        method = "scatter"
     if method == "auto":
         # Scatter vs sort is hardware-dependent (random-index scatter is
         # memory-bound on TPU; sorted scatter trades an argsort for
@@ -814,10 +838,13 @@ def _parse_args():
     parser.add_argument(
         "--method",
         default="scatter",
-        choices=["auto", "scatter", "sort"],
+        choices=["auto", "scatter", "sort", "pallas"],
         help="scatter wins on every TPU measured (sort adds an argsort "
         "for no scatter gain); 'auto' re-measures both, but its short "
-        "calibration is vulnerable to relay-bandwidth noise",
+        "calibration is vulnerable to relay-bandwidth noise. 'pallas' "
+        "(ops/pallas_hist.py one-hot reduction) only fits VMEM-sized "
+        "bin spaces — the headline 1.5Mx100 config rejects it, but "
+        "config1's 1-D monitor histogram measures it (see --all)",
     )
     parser.add_argument(
         "--all",
