@@ -413,12 +413,15 @@ def table_scatter_delta(
     inv_width: float,
     n_bins: int,
     dtype,
+    method: str = "scatter",
 ):
     """Traceable event -> bin-delta core shared by the single-device and
     table-sharded kernels: TOA binning, bank-local id shift, table
     lookup, OOB-high drop, scatter-add into a dense [n_bins] delta.
     ``id_base`` may be a traced value (the sharded kernel derives it
-    from the shard index)."""
+    from the shard index). ``method='pallas'`` accumulates the delta
+    with the VMEM one-hot kernel (ops/pallas_hist.py) instead of the
+    serial scatter — every Q-family bin space fits its bound."""
     n_pix, n_toa = table.shape
     tb = jnp.floor((toa - lo) * inv_width).astype(jnp.int32)
     t_ok = (toa >= lo) & (toa < hi)
@@ -429,6 +432,10 @@ def table_scatter_delta(
     qb = table[pid, tb].astype(jnp.int32)
     ok = p_ok & t_ok & (qb >= 0)
     qb = jnp.where(ok, qb, n_bins)  # OOB-high: dropped
+    if method == "pallas":
+        from .pallas_hist import bincount_pallas
+
+        return bincount_pallas(qb, n_bins).astype(dtype)
     delta = jnp.zeros((n_bins,), dtype=dtype)
     return delta.at[qb].add(1.0, mode="drop")
 
@@ -444,7 +451,18 @@ class QHistogrammer:
         toa_edges: np.ndarray,
         n_q: int,
         dtype=jnp.float32,
+        method: str = "scatter",
     ) -> None:
+        if method not in ("scatter", "pallas"):
+            raise ValueError(f"Unknown method {method!r}")
+        if method == "pallas":
+            from .pallas_hist import MAX_PALLAS_BINS
+
+            if n_q + 1 > MAX_PALLAS_BINS:
+                raise ValueError(
+                    f"method='pallas' supports at most "
+                    f"{MAX_PALLAS_BINS - 1} bins; this map has {n_q}"
+                )
         if isinstance(qmap, PixelBinMap):
             table, id_base = qmap.table, qmap.id_base
         else:
@@ -463,6 +481,7 @@ class QHistogrammer:
         self._n_toa = toa_edges.size - 1
         self._inv_width = float(self._n_toa / (self._hi - self._lo))
         self._dtype = dtype
+        self._method = method
         self._step = jax.jit(self._step_impl, donate_argnums=(0,))
         self._clear_window = jax.jit(self._clear_window_impl, donate_argnums=(0,))
 
@@ -491,6 +510,7 @@ class QHistogrammer:
             inv_width=self._inv_width,
             n_bins=self._n_q,
             dtype=self._dtype,
+            method=self._method,
         )
         mc = jnp.asarray(monitor_count, dtype=self._dtype)
         return QState(

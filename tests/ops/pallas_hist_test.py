@@ -99,3 +99,51 @@ class TestHistogrammerPallasMethod:
                 n_screen=1000,  # 100k bins: far beyond VMEM
                 method="pallas",
             )
+
+
+class TestQHistogrammerPallasMethod:
+    def test_parity_with_scatter(self):
+        from esslivedata_tpu.ops.qhistogram import (
+            QHistogrammer,
+            build_dspacing_map,
+        )
+
+        rng = np.random.default_rng(4)
+        n_pixel = 25
+        dmap = build_dspacing_map(
+            two_theta=rng.uniform(0.3, 2.4, n_pixel),
+            l_total=rng.uniform(60.0, 90.0, n_pixel),
+            pixel_ids=np.arange(30, 30 + n_pixel),
+            toa_edges=np.linspace(0.0, 7.1e7, 41),
+            d_edges=np.linspace(0.4, 2.8, 33),
+        )
+        kw = dict(qmap=dmap, toa_edges=np.linspace(0.0, 7.1e7, 41), n_q=32)
+        ref = QHistogrammer(method="scatter", **kw)
+        pal = QHistogrammer(method="pallas", **kw)
+        s_ref, s_pal = ref.init_state(), pal.init_state()
+        for seed in range(3):
+            r = np.random.default_rng(seed)
+            batch = EventBatch.from_arrays(
+                r.integers(20, 70, 2000).astype(np.int64),
+                r.uniform(-1e6, 7.5e7, 2000).astype(np.float32),
+            )
+            s_ref = ref.step(s_ref, batch, 10.0)
+            s_pal = pal.step(s_pal, batch, 10.0)
+        np.testing.assert_array_equal(
+            np.asarray(s_ref.window), np.asarray(s_pal.window)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(s_ref.cumulative), np.asarray(s_pal.cumulative)
+        )
+
+    def test_bin_bound_enforced(self):
+        from esslivedata_tpu.ops.qhistogram import PixelBinMap, QHistogrammer
+
+        table = np.zeros((4, 10), np.int32)
+        with pytest.raises(ValueError, match="pallas"):
+            QHistogrammer(
+                qmap=PixelBinMap(table=table, id_base=0),
+                toa_edges=np.linspace(0, 1e6, 11),
+                n_q=MAX_PALLAS_BINS + 5,
+                method="pallas",
+            )
