@@ -218,27 +218,45 @@ def bench_secondary_configs(args, edges, batches, method: str) -> None:
             )
             for _ in range(4)
         ]
-        qe_state = qe_hist.init_state()
-        qe_state = qe_hist.step(qe_state, qe_batches[0], 100.0)
-        qe_state.window.block_until_ready()
-        start = time.perf_counter()
-        for i in range(args.batches):
-            qe_state = qe_hist.step(
-                qe_state, qe_batches[i % len(qe_batches)], 100.0
+        def timed_qe(label: str, hist) -> None:
+            state = hist.init_state()
+            state = hist.step(state, qe_batches[0], 100.0)
+            state.window.block_until_ready()
+            start = time.perf_counter()
+            for i in range(args.batches):
+                state = hist.step(
+                    state, qe_batches[i % len(qe_batches)], 100.0
+                )
+            state.window.block_until_ready()
+            dt = time.perf_counter() - start
+            print(
+                json.dumps(
+                    {
+                        "metric": label,
+                        "value": args.events * args.batches / dt,
+                        "unit": "events/s",
+                        "banks": 9,
+                    }
+                ),
+                file=sys.stderr,
             )
-        qe_state.window.block_until_ready()
-        dt = time.perf_counter() - start
-        print(
-            json.dumps(
-                {
-                    "metric": "config3_bifrost_qe_rebinning",
-                    "value": args.events * args.batches / dt,
-                    "unit": "events/s",
-                    "banks": 9,
-                }
-            ),
-            file=sys.stderr,
-        )
+
+        timed_qe("config3_bifrost_qe_rebinning", qe_hist)
+        # The Q-E bin space (80x60) fits the pallas kernel: measure the
+        # one-hot variant alongside on real hardware.
+        if jax.default_backend() == "tpu":
+            try:
+                timed_qe(
+                    "config3_bifrost_qe_pallas",
+                    QHistogrammer(
+                        qmap=qe_map,
+                        toa_edges=qe_toa,
+                        n_q=80 * 60,
+                        method="pallas",
+                    ),
+                )
+            except Exception:
+                traceback.print_exc()
 
     # Config 4: monitor-normalized output computed per step (on device —
     # the normalized array is the job's published output, not a host read).
