@@ -783,6 +783,7 @@ _PAGE = """<!DOCTYPE html>
    <button id="tab-flat" onclick="setTab('flat')">All plots</button>
    <button id="tab-jobsview" onclick="setTab('jobsview')">Jobs</button>
    <button id="tab-corr" onclick="setTab('corr')">Correlation</button>
+   <button id="tab-log" onclick="setTab('log')">Log</button>
   </div>
   <div id="grids"></div>
   <div id="flat" style="display:none"></div>
@@ -796,6 +797,7 @@ _PAGE = """<!DOCTYPE html>
    </div>
    <div class="card" style="margin-top:10px"><img id="corr-img" style="display:none"></div>
   </div>
+  <div id="log" style="display:none"></div>
  </div>
 </div>
 <div id="toasts"></div>
@@ -813,7 +815,7 @@ function el(tag, cls, text) {{
 }}
 function setTab(t) {{
   tab = t; gen = -1; gridGens = {{}};
-  for (const name of ['grids', 'flat', 'jobsview', 'corr']) {{
+  for (const name of ['grids', 'flat', 'jobsview', 'corr', 'log']) {{
     document.getElementById(name).style.display = t === name ? '' : 'none';
     document.getElementById('tab-' + name).className = t === name ? 'on' : '';
   }}
@@ -1449,6 +1451,36 @@ function jobAction(action, j) {{
   return fetch('/api/job/' + action, {{method: 'POST', body: JSON.stringify(
     {{source_name: j.source_name, job_number: j.job_number}})}});
 }}
+async function renderLogView() {{
+  // Persistent notification history (reference notification_log_widget):
+  // toasts are transient; this tab keeps the full retained queue.
+  const root = document.getElementById('log');
+  const data = await (await fetch('/api/notifications')).json();
+  const fp = String(data.latest);
+  if (root.dataset.fp === fp) return;
+  root.dataset.fp = fp;
+  root.innerHTML = '';
+  const card = el('div', 'card');
+  card.appendChild(el('h3', '', 'Notification log'));
+  if (!data.notifications.length) {{
+    card.appendChild(el('small', '', 'Nothing logged yet.'));
+  }} else {{
+    const table = document.createElement('table');
+    table.className = 'devices';
+    for (const n of data.notifications.slice().reverse()) {{
+      const row = document.createElement('tr');
+      row.appendChild(el('td', '', '#' + n.seq));
+      row.appendChild(el('td',
+        n.level === 'ok' || n.level === 'info' ? '' :
+          'state-' + (n.level === 'error' ? 'error' : 'warning'),
+        n.level));
+      row.appendChild(el('td', '', n.message));
+      table.appendChild(row);
+    }}
+    card.appendChild(table);
+  }}
+  root.appendChild(card);
+}}
 function renderJobsView(s) {{
   const root = document.getElementById('jobsview');
   // Rebuild only when the rendered facts change: a rebuild per poll tick
@@ -1742,6 +1774,7 @@ async function refresh() {{
   await pollSession();
   if (tab === 'corr') refreshCorrChoices(s);
   if (tab === 'jobsview') renderJobsView(s);
+  if (tab === 'log') renderLogView();
   if (tab === 'grids') {{
     await refreshGrids();
   }} else if (tab === 'flat' && s.generation !== gen) {{
