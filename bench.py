@@ -153,6 +153,90 @@ def bench_secondary_configs(args, edges, batches, method: str) -> None:
         except Exception:
             traceback.print_exc()
 
+    # Headline-space pallas2d A/B (VERDICT r4 item 2): the MXU-tiled
+    # kernel against the serial scatter on the SAME 1.5Mx100 bin space.
+    # Device-resident rates (inputs pre-staged on device, donated state
+    # stepped back-to-back) isolate the kernel from host flatten/
+    # partition and link bandwidth; the e2e line includes them. TPU
+    # only: interpret mode is meaninglessly slow.
+    if jax.default_backend() == "tpu":
+        try:
+            reps = min(args.batches, 16)
+
+            def timed_device(label, h, inputs, step, **extra):
+                state = h.init_state()
+                # Warm every distinct input SHAPE (chunk-bucket sizes
+                # differ across batches): a compile inside the short
+                # timed loop would skew the A/B.
+                shapes = set()
+                for inp in inputs:
+                    key = jax.tree.map(lambda a: a.shape, inp)
+                    if (k := str(key)) not in shapes:
+                        shapes.add(k)
+                        state = step(state, inp)
+                state.window.block_until_ready()
+                start = time.perf_counter()
+                for i in range(reps):
+                    state = step(state, inputs[i % len(inputs)])
+                state.window.block_until_ready()
+                dt = time.perf_counter() - start
+                print(
+                    json.dumps(
+                        {
+                            "metric": label,
+                            "value": args.events * reps / dt,
+                            "unit": "events/s",
+                            **extra,
+                        }
+                    ),
+                    file=sys.stderr,
+                )
+
+            h_sc = EventHistogrammer(
+                toa_edges=edges, n_screen=args.pixels, method="scatter"
+            )
+            flats = [
+                jax.device_put(
+                    h_sc.flatten_host(b.pixel_id, b.toa)
+                ).block_until_ready()
+                for b in batches
+            ]
+            timed_device(
+                "headline_scatter_device_resident",
+                h_sc,
+                flats,
+                lambda s, f: h_sc._step_flat(s, f),
+            )
+            h_p2 = EventHistogrammer(
+                toa_edges=edges, n_screen=args.pixels, method="pallas2d"
+            )
+            parts = []
+            for b in batches:
+                ev, cm = h_p2.flatten_partition_host(b.pixel_id, b.toa)
+                parts.append(
+                    (
+                        jax.device_put(ev).block_until_ready(),
+                        jax.device_put(cm).block_until_ready(),
+                    )
+                )
+            timed_device(
+                "headline_pallas2d_device_resident",
+                h_p2,
+                parts,
+                lambda s, p: h_p2._step_part(s, *p),
+                bpb=h_p2._bpb,
+            )
+            if method != "pallas2d":
+                # End-to-end (host partition + link + kernel), only when
+                # the graded headline didn't already measure it.
+                timed(
+                    "headline_pallas2d_e2e",
+                    h_p2,
+                    step=h_p2.step_batch,
+                )
+        except Exception:
+            traceback.print_exc()
+
     # Config 3: 9-bank multibank view.
     n_banks, per_bank = 9, 1 + (args.pixels - 1) // 9
     bank_lut = (np.arange(args.pixels, dtype=np.int32) // per_bank).astype(
@@ -452,19 +536,30 @@ def run_benchmark(args, platform: str) -> dict:
             for s in range(n_distinct)
         ]
 
+    def make_step(h):
+        """Per-batch ingest for the timed loops: pallas2d takes the
+        fused flatten+partition path (step_batch); everything else the
+        host-flatten + flat-scatter path — each method's production
+        ingest, not a common denominator."""
+        if h._method == "pallas2d":
+            return h.step_batch
+        return lambda s, b: h.step_flat(
+            s, h.flatten_host(b.pixel_id, b.toa)
+        )
+
     def calibrate(method: str) -> float:
         """Short timed run; returns events/s for one method."""
         h = EventHistogrammer(
             toa_edges=edges, n_screen=args.pixels, method=method
         )
+        step = make_step(h)
         s = h.init_state()
-        s = h.step_flat(s, h.flatten_host(batches[0].pixel_id, batches[0].toa))
+        s = step(s, batches[0])
         s.window.block_until_ready()
         reps = 4
         t0 = time.perf_counter()
         for i in range(reps):
-            b = batches[i % n_distinct]
-            s = h.step_flat(s, h.flatten_host(b.pixel_id, b.toa))
+            s = step(s, batches[i % n_distinct])
         s.window.block_until_ready()
         return args.events * reps / (time.perf_counter() - t0)
 
@@ -495,13 +590,13 @@ def run_benchmark(args, platform: str) -> dict:
     hist = EventHistogrammer(
         toa_edges=edges, n_screen=args.pixels, method=method
     )
+    step_fn = make_step(hist)
     state = hist.init_state()
 
     # Warm-up: compile + first transfers, plus a few steps to let the
     # host->device link reach steady state before the timed window.
     for i in range(4):
-        b = batches[i % n_distinct]
-        state = hist.step_flat(state, hist.flatten_host(b.pixel_id, b.toa))
+        state = step_fn(state, batches[i % n_distinct])
     state.window.block_until_ready()
 
     from contextlib import nullcontext
@@ -525,10 +620,7 @@ def run_benchmark(args, platform: str) -> dict:
         for _ in range(n_windows):
             start = time.perf_counter()
             for _ in range(per_window):
-                b = batches[step % n_distinct]
-                state = hist.step_flat(
-                    state, hist.flatten_host(b.pixel_id, b.toa)
-                )
+                state = step_fn(state, batches[step % n_distinct])
                 step += 1
             state.window.block_until_ready()
             dt = time.perf_counter() - start
@@ -856,13 +948,16 @@ def _parse_args():
     parser.add_argument(
         "--method",
         default="scatter",
-        choices=["auto", "scatter", "sort", "pallas"],
+        choices=["auto", "scatter", "sort", "pallas", "pallas2d"],
         help="scatter wins on every TPU measured (sort adds an argsort "
         "for no scatter gain); 'auto' re-measures both, but its short "
         "calibration is vulnerable to relay-bandwidth noise. 'pallas' "
         "(ops/pallas_hist.py one-hot reduction) only fits VMEM-sized "
         "bin spaces — the headline 1.5Mx100 config rejects it, but "
-        "config1's 1-D monitor histogram measures it (see --all)",
+        "config1's 1-D monitor histogram measures it (see --all). "
+        "'pallas2d' (ops/pallas_hist2d.py MXU-tiled kernel) covers the "
+        "full headline bin space; --all also reports its device-resident "
+        "A/B against the scatter",
     )
     parser.add_argument(
         "--all",

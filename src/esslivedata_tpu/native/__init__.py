@@ -52,6 +52,7 @@ def _compile() -> bool:
         "-O3",
         "-shared",
         "-fPIC",
+        "-pthread",
         "-std=c++17",
         *[str(s) for s in _SOURCES],
         "-o",
@@ -80,6 +81,17 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.ld_flatten_nonuniform.argtypes = [
         i32p, f32p, i64, i32p, i64,
         ctypes.c_int32, ctypes.c_int32, f32p, ctypes.c_int32, i32p,
+    ]
+    lib.ld_partition.restype = i64
+    lib.ld_partition.argtypes = [
+        i32p, i32p, i64, i64, i64,
+        ctypes.c_int32, ctypes.c_int32, i32p, i32p, i64,
+    ]
+    lib.ld_flatten_partition.restype = i64
+    lib.ld_flatten_partition.argtypes = [
+        i32p, f32p, i64, i32p, i64,
+        ctypes.c_int32, ctypes.c_int32, f32, f32, f32,
+        ctypes.c_int32, ctypes.c_int32, i32p, i32p, i64,
     ]
     lib.ld_staging_new.argtypes = [i64]
     lib.ld_staging_free.restype = None
@@ -237,6 +249,113 @@ def da00_encode_raw(
 
 def _as_u8p(buf: bytes):
     return ctypes.cast(ctypes.c_char_p(buf), ctypes.POINTER(ctypes.c_uint8))
+
+
+def flatten_partition(
+    pixel_id: np.ndarray,
+    toa: np.ndarray,
+    *,
+    lut: np.ndarray | None,
+    n_screen: int,
+    n_toa: int,
+    lo: float,
+    hi: float,
+    inv_width: float,
+    ppb_shift: int,
+    chunk: int,
+    cap_chunks: int,
+) -> tuple[np.ndarray, np.ndarray, int] | None:
+    """Fused native flatten + block partition (ld_flatten_partition) for
+    the pallas2d ingest path — uniform TOA edges, pixel-aligned blocks
+    (``bpb = 2**ppb_shift * n_toa``). Returns ``(events, chunk_map,
+    n_chunks_used)`` or None when the native library is unavailable."""
+    lib = load_library()
+    if lib is None:
+        return None
+    from ..ops.event_batch import sanitize_pixel_id
+
+    pixel_id = np.ascontiguousarray(sanitize_pixel_id(pixel_id), np.int32)
+    toa = np.ascontiguousarray(toa, dtype=np.float32)
+    events = np.empty(cap_chunks * chunk, np.int32)
+    chunk_map = np.empty(cap_chunks, np.int32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    if lut is not None:
+        lut = np.ascontiguousarray(lut, dtype=np.int32)
+        lut_ptr = lut.ctypes.data_as(i32p)
+        n_pix = lut.shape[0]
+    else:
+        lut_ptr = None
+        n_pix = 0
+    used = lib.ld_flatten_partition(
+        pixel_id.ctypes.data_as(i32p),
+        toa.ctypes.data_as(f32p),
+        int(pixel_id.shape[0]),
+        lut_ptr,
+        n_pix,
+        int(n_screen),
+        int(n_toa),
+        float(lo),
+        float(hi),
+        float(inv_width),
+        int(ppb_shift),
+        int(chunk),
+        events.ctypes.data_as(i32p),
+        chunk_map.ctypes.data_as(i32p),
+        int(cap_chunks),
+    )
+    if used < 0:
+        raise ValueError("ld_flatten_partition: cap_chunks too small")
+    return events, chunk_map, int(used)
+
+
+def partition_events(
+    flat: np.ndarray,
+    n_bins_incl_dump: int,
+    *,
+    shift: int = 0,
+    chunk: int,
+    cap_chunks: int,
+    blk: np.ndarray | None = None,
+    n_blocks: int = 0,
+) -> tuple[np.ndarray, np.ndarray, int] | None:
+    """Native block partition for the pallas2d kernel (ld_partition).
+
+    Power-of-two bins-per-block pass ``shift``; non-power-of-two pass a
+    precomputed per-event ``blk`` array (with ``n_blocks``) and
+    already-routed ``flat``. Returns ``(events, chunk_map,
+    n_chunks_used)`` with the full ``cap_chunks`` capacity filled
+    (callers slice a rounded-up prefix), or None when the native library
+    is unavailable. Raises ValueError if ``cap_chunks`` is too small (a
+    caller bug: the bound is static).
+    """
+    lib = load_library()
+    if lib is None:
+        return None
+    flat = np.ascontiguousarray(flat, dtype=np.int32)
+    events = np.empty(cap_chunks * chunk, np.int32)
+    chunk_map = np.empty(cap_chunks, np.int32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    if blk is not None:
+        blk = np.ascontiguousarray(blk, dtype=np.int32)
+        blk_ptr = blk.ctypes.data_as(i32p)
+    else:
+        blk_ptr = None
+    used = lib.ld_partition(
+        flat.ctypes.data_as(i32p),
+        blk_ptr,
+        int(flat.shape[0]),
+        int(n_bins_incl_dump),
+        int(n_blocks),
+        int(shift),
+        int(chunk),
+        events.ctypes.data_as(i32p),
+        chunk_map.ctypes.data_as(i32p),
+        int(cap_chunks),
+    )
+    if used < 0:
+        raise ValueError("ld_partition: cap_chunks too small")
+    return events, chunk_map, int(used)
 
 
 def ev44_info(buf: bytes) -> tuple[int, int, int, int]:

@@ -27,6 +27,10 @@
 #include <cstdlib>
 #include <cstring>
 
+#include <algorithm>
+#include <thread>
+#include <vector>
+
 namespace {
 
 struct View {
@@ -391,6 +395,206 @@ void ld_flatten_nonuniform(const int32_t* pixel, const float* toa,
     }
     out[i] = ok ? screen * n_toa + tb : dump;
   }
+}
+
+// Event partition for the pallas2d tiled histogram kernel
+// (ops/pallas_hist2d.py): group flat bin indices by block
+// (flat >> shift), padding each used block's events to whole chunks
+// with -1 and emitting the non-decreasing chunk -> block map.
+//
+// Parallel counting sort: threads count per (thread, block) over their
+// input segment, an exclusive scan turns the counts into per-thread
+// write cursors, and each thread places its segment — two linear passes
+// over the input, no comparison sort. Out-of-range indices route to the
+// dump bin (n_bins_incl_dump - 1), matching step_flat.
+//
+// The caller allocates out_events[cap_chunks * chunk] and
+// out_map[cap_chunks] with cap_chunks >= ceil(n/chunk) + n_blocks (the
+// worst case: every used block ends in a partial chunk). Returns the
+// number of chunks actually used, or -1 if cap_chunks is too small.
+// The tail up to cap_chunks is filled (-1 events, last-block map) so
+// the caller can hand any rounded-up prefix straight to the kernel.
+//
+// blk_in: optional precomputed per-event block ids (for non-power-of-two
+// bpb, where no shift exists — the caller vectorizes the division). With
+// blk_in, flat must already be routed in-range, n_blocks_in gives the
+// block count, and shift is ignored.
+int64_t ld_partition(const int32_t* flat, const int32_t* blk_in,
+                     int64_t n, int64_t n_bins_incl_dump,
+                     int64_t n_blocks_in, int32_t shift, int32_t chunk,
+                     int32_t* out_events, int32_t* out_map,
+                     int64_t cap_chunks) {
+  const int32_t dump = static_cast<int32_t>(n_bins_incl_dump - 1);
+  const int64_t n_blocks =
+      blk_in != nullptr
+          ? n_blocks_in
+          : (n_bins_incl_dump + (int64_t(1) << shift) - 1) >> shift;
+  int n_threads = static_cast<int>(std::thread::hardware_concurrency());
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > 8) n_threads = 8;
+  if (n < (int64_t(1) << 16)) n_threads = 1;
+  const int64_t seg = (n + n_threads - 1) / n_threads;
+
+  // counts[t * n_blocks + b]
+  std::vector<int64_t> counts(
+      static_cast<size_t>(n_threads) * n_blocks, 0);
+  auto route = [&](int32_t v) -> int32_t {
+    return (v < 0 || v >= n_bins_incl_dump) ? dump : v;
+  };
+  auto count_seg = [&](int t) {
+    const int64_t lo = t * seg;
+    const int64_t hi = std::min(n, lo + seg);
+    int64_t* c = counts.data() + static_cast<size_t>(t) * n_blocks;
+    if (blk_in != nullptr) {
+      for (int64_t i = lo; i < hi; ++i) c[blk_in[i]]++;
+    } else {
+      for (int64_t i = lo; i < hi; ++i) c[route(flat[i]) >> shift]++;
+    }
+  };
+  {
+    std::vector<std::thread> ts;
+    for (int t = 1; t < n_threads; ++t) ts.emplace_back(count_seg, t);
+    count_seg(0);
+    for (auto& th : ts) th.join();
+  }
+
+  // Per-block totals -> chunk-padded block starts + per-thread cursors.
+  std::vector<int64_t> cursor(
+      static_cast<size_t>(n_threads) * n_blocks, 0);
+  std::vector<int64_t> bstart(n_blocks + 1, 0);
+  int64_t n_chunks = 0;
+  for (int64_t b = 0; b < n_blocks; ++b) {
+    bstart[b] = n_chunks * chunk;
+    int64_t total = 0;
+    for (int t = 0; t < n_threads; ++t) {
+      cursor[static_cast<size_t>(t) * n_blocks + b] =
+          bstart[b] + total;
+      total += counts[static_cast<size_t>(t) * n_blocks + b];
+    }
+    const int64_t k = (total + chunk - 1) / chunk;
+    if (n_chunks + k > cap_chunks) return -1;
+    for (int64_t c = 0; c < k; ++c)
+      out_map[n_chunks + c] = static_cast<int32_t>(b);
+    // Pad tail of this block's region.
+    for (int64_t i = bstart[b] + total; i < (n_chunks + k) * chunk; ++i)
+      out_events[i] = -1;
+    n_chunks += k;
+  }
+  bstart[n_blocks] = n_chunks * chunk;
+
+  auto place_seg = [&](int t) {
+    const int64_t lo = t * seg;
+    const int64_t hi = std::min(n, lo + seg);
+    int64_t* cur = cursor.data() + static_cast<size_t>(t) * n_blocks;
+    if (blk_in != nullptr) {
+      for (int64_t i = lo; i < hi; ++i)
+        out_events[cur[blk_in[i]]++] = flat[i];
+    } else {
+      for (int64_t i = lo; i < hi; ++i) {
+        const int32_t v = route(flat[i]);
+        out_events[cur[v >> shift]++] = v;
+      }
+    }
+  };
+  {
+    std::vector<std::thread> ts;
+    for (int t = 1; t < n_threads; ++t) ts.emplace_back(place_seg, t);
+    place_seg(0);
+    for (auto& th : ts) th.join();
+  }
+
+  // Fill the caller's whole tail so any rounded-up prefix is valid.
+  const int32_t last = static_cast<int32_t>(n_blocks - 1);
+  for (int64_t c = n_chunks; c < cap_chunks; ++c) out_map[c] = last;
+  if (cap_chunks > n_chunks)
+    memset(out_events + n_chunks * chunk, 0xFF,
+           static_cast<size_t>((cap_chunks - n_chunks) * chunk) *
+               sizeof(int32_t));
+  return n_chunks;
+}
+
+// Fused flatten + partition: the pallas2d ingest fast path
+// (histogram.py flatten_partition_host). One call turns raw
+// (pixel_id, toa) into block-partitioned flat indices, with blocks
+// aligned to pixel ranges (bpb = ppb * n_toa, ppb a power of two), so
+// the counting pass derives the block from the screen pixel with one
+// shift — no division, no intermediate flat array, no separate count
+// pass. Pass 2 recomputes the flat index (ALU is cheap next to the
+// memory traffic on the single-core ingest host) and places it.
+//
+// Uniform TOA edges only (the non-uniform path goes flatten ->
+// ld_partition). Semantics match ld_flatten + ld_partition exactly,
+// including dump routing of invalid pixel/toa.
+int64_t ld_flatten_partition(
+    const int32_t* pixel, const float* toa, int64_t n, const int32_t* lut,
+    int64_t n_pix, int32_t n_screen, int32_t n_toa, float lo, float hi,
+    float inv_width, int32_t ppb_shift, int32_t chunk, int32_t* out_events,
+    int32_t* out_map, int64_t cap_chunks) {
+  const int64_t n_toa64 = n_toa;
+  const int64_t n_bins = static_cast<int64_t>(n_screen) * n_toa64;
+  const int32_t dump = static_cast<int32_t>(n_bins);
+  const int64_t bpb = (int64_t(1) << ppb_shift) * n_toa64;
+  const int64_t n_blocks = (n_bins + 1 + bpb - 1) / bpb;
+  const int32_t dump_blk = static_cast<int32_t>(n_bins / bpb);
+
+  // flat index + block for one event; invalid -> (dump, dump_blk).
+  auto project = [&](int64_t i, int32_t* blk) -> int32_t {
+    const float t = toa[i];
+    const int32_t p = pixel[i];
+    int32_t tb = static_cast<int32_t>((t - lo) * inv_width);
+    bool ok = (t >= lo) & (t < hi);
+    if (tb >= n_toa) tb = n_toa - 1;
+    if (tb < 0) tb = 0;
+    int32_t screen;
+    if (lut != nullptr) {
+      screen = (p >= 0 && p < n_pix) ? lut[p] : -1;
+      ok = ok & (screen >= 0);
+    } else {
+      screen = p;
+      ok = ok & (p >= 0) & (p < n_screen);
+    }
+    if (!ok) {
+      *blk = dump_blk;
+      return dump;
+    }
+    *blk = screen >> ppb_shift;
+    return screen * n_toa + tb;
+  };
+
+  std::vector<int64_t> counts(n_blocks, 0);
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t blk;
+    (void)project(i, &blk);
+    counts[blk]++;
+  }
+
+  std::vector<int64_t> cursor(n_blocks, 0);
+  int64_t n_chunks = 0;
+  for (int64_t b = 0; b < n_blocks; ++b) {
+    cursor[b] = n_chunks * chunk;
+    const int64_t total = counts[b];
+    const int64_t k = (total + chunk - 1) / chunk;
+    if (n_chunks + k > cap_chunks) return -1;
+    for (int64_t c = 0; c < k; ++c)
+      out_map[n_chunks + c] = static_cast<int32_t>(b);
+    for (int64_t i = cursor[b] + total; i < (n_chunks + k) * chunk; ++i)
+      out_events[i] = -1;
+    n_chunks += k;
+  }
+
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t blk;
+    const int32_t v = project(i, &blk);
+    out_events[cursor[blk]++] = v;
+  }
+
+  const int32_t last = static_cast<int32_t>(n_blocks - 1);
+  for (int64_t c = n_chunks; c < cap_chunks; ++c) out_map[c] = last;
+  if (cap_chunks > n_chunks)
+    memset(out_events + n_chunks * chunk, 0xFF,
+           static_cast<size_t>((cap_chunks - n_chunks) * chunk) *
+               sizeof(int32_t));
+  return n_chunks;
 }
 
 }  // extern "C"

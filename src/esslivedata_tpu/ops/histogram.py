@@ -204,7 +204,9 @@ class EventProjection:
 
 
 class HistogramState(NamedTuple):
-    """Device-resident accumulator pair, flat ``[n_screen*n_toa + 1]``.
+    """Device-resident accumulator pair, flat ``[n_screen*n_toa + 1]``
+    (``method='pallas2d'`` pads further, to whole bin blocks — the owning
+    histogrammer knows the layout; views always slice padding away).
 
     ``window`` receives the scatters; ``folded`` holds counts folded out of
     the window by ``clear_window``. The trailing element of each array is
@@ -226,10 +228,6 @@ class HistogramState(NamedTuple):
     folded: jax.Array
     window: jax.Array
     scale: jax.Array | None = None
-
-    @property
-    def n_bins(self) -> int:
-        return int(self.window.shape[0]) - 1
 
 
 class EventHistogrammer:
@@ -264,6 +262,16 @@ class EventHistogrammer:
         spaces that fit VMEM (monitor spectra, Q-family sizes; bound
         enforced at construction) and unit/scalar event weights
         (per-event weight arrays fall back to the scatter).
+        'pallas2d' tiles arbitrarily large bin spaces over VMEM-sized
+        blocks with MXU accumulation (ops/pallas_hist2d.py): the host
+        ingest partitions events by bin block (native ``ld_partition``
+        or numpy), and the flat-index fast path (``step_flat`` /
+        ``step_batch``) feeds the tiled kernel; the (pixel_id, toa)
+        device path keeps the scatter (its indices are device-resident,
+        and the partition is a host pass). Requires a host-flattenable
+        configuration (no per-pixel weights, no replica LUTs). State
+        arrays are padded to whole blocks; all views slice the padding
+        (and the dump bin) away.
     """
 
     def __init__(
@@ -277,7 +285,7 @@ class EventHistogrammer:
         method: str = "scatter",
         dtype=jnp.float32,
     ) -> None:
-        if method not in ("scatter", "sort", "pallas"):
+        if method not in ("scatter", "sort", "pallas", "pallas2d"):
             raise ValueError(f"Unknown method {method!r}")
         self._proj = EventProjection(
             toa_edges=toa_edges,
@@ -302,6 +310,34 @@ class EventHistogrammer:
                     f"{MAX_PALLAS_BINS - 1} bins (VMEM bound); this "
                     f"configuration has {self._n_bins}"
                 )
+        self._n_state = self._n_bins + 1
+        self._ppb_shift = None
+        if method == "pallas2d":
+            from .pallas_hist2d import DEFAULT_BPB, padded_bins
+
+            if not self.supports_host_flatten:
+                raise ValueError(
+                    "method='pallas2d' requires a host-flattenable "
+                    "configuration (no per-pixel weights or replica "
+                    "LUTs): the tiled kernel consumes host-partitioned "
+                    "flat indices"
+                )
+            # Prefer pixel-aligned blocks (bpb = 2**k * n_toa): the fused
+            # native ingest derives the block from the screen pixel with
+            # one shift. Falls back to generic power-of-two blocks when
+            # no 2**k * n_toa fits the VMEM budget as a lane multiple.
+            for k in range(16, -1, -1):
+                bpb = (1 << k) * self._n_toa
+                if bpb <= DEFAULT_BPB and bpb % 128 == 0:
+                    self._ppb_shift = k
+                    self._bpb = bpb
+                    break
+            if self._ppb_shift is None:
+                self._bpb = DEFAULT_BPB
+            self._n_state = padded_bins(self._n_bins + 1, self._bpb)
+            self._step_part = jax.jit(
+                self._step_part_impl, donate_argnums=(0,)
+            )
         self._step = jax.jit(self._step_impl, donate_argnums=(0,))
         self._step_flat = jax.jit(self._step_flat_impl, donate_argnums=(0,))
         self._clear_window = jax.jit(self._clear_window_impl, donate_argnums=(0,))
@@ -327,7 +363,7 @@ class EventHistogrammer:
 
     # -- state ------------------------------------------------------------
     def init_state(self, device=None) -> HistogramState:
-        zeros = jnp.zeros(self._n_bins + 1, dtype=self._dtype)
+        zeros = jnp.zeros(self._n_state, dtype=self._dtype)
         if device is not None:
             zeros = jax.device_put(zeros, device)
         scale = (
@@ -371,19 +407,31 @@ class EventHistogrammer:
         self, state: HistogramState, flat: jax.Array, w
     ) -> HistogramState:
         """One scatter into the window; decay handled via the lazy scale."""
+        return self._advance_core(
+            state, lambda win, upd: self._scatter_into(win, flat, upd), w
+        )
+
+    def _advance_core(
+        self, state: HistogramState, apply_updates, w
+    ) -> HistogramState:
+        """The ONE copy of the lazy-decay protocol, shared by every
+        kernel variant: ``apply_updates(window, updates) -> window``
+        accumulates the batch (scatter or pallas2d), ``updates`` being a
+        scalar magnitude or a per-event weight array scaled by
+        ``1/scale`` in decay mode."""
         if self._decay is None:
             updates = (
                 jnp.asarray(1.0, self._dtype) if w is None else w.astype(self._dtype)
             )
             return HistogramState(
                 folded=state.folded,
-                window=self._scatter_into(state.window, flat, updates),
+                window=apply_updates(state.window, updates),
                 scale=None,
             )
         scale = state.scale * self._decay
         inv = 1.0 / scale
         updates = inv if w is None else w.astype(self._dtype) * inv
-        window = self._scatter_into(state.window, flat, updates)
+        window = apply_updates(state.window, updates)
         window, scale = jax.lax.cond(
             scale < self._SCALE_FLOOR,
             lambda win, s: (win * s, jnp.ones_like(s)),
@@ -413,8 +461,26 @@ class EventHistogrammer:
         # Externally produced indices: scatter mode='drop' bounds-checks
         # AFTER one negative wrap, so -1 is dropped but -2..-n_bins would
         # wrap into real bins. Route all negatives to the dump bin first.
-        flat = jnp.where(flat < 0, self._n_bins, flat)
+        # (pallas2d state is block-padded: indices in the padding tail
+        # would be memory-safe but miscounted as real bins — dump them.)
+        flat = jnp.where(
+            (flat < 0) | (flat > self._n_bins), self._n_bins, flat
+        )
         return self._advance(state, flat, None)
+
+    def _step_part_impl(
+        self, state: HistogramState, events: jax.Array, chunk_map: jax.Array
+    ) -> HistogramState:
+        """pallas2d step over host-partitioned events (ops/pallas_hist2d)."""
+        from .pallas_hist2d import scatter_add_pallas2d
+
+        return self._advance_core(
+            state,
+            lambda win, upd: scatter_add_pallas2d(
+                win, events, chunk_map, bpb=self._bpb, upd=upd
+            ),
+            None,
+        )
 
     def physical_window(self, state: HistogramState) -> jax.Array:
         """The window in physical counts, flat incl. dump bin — applies the
@@ -565,17 +631,89 @@ class EventHistogrammer:
         """One staged batch, taking the 4-byte/event ingest fast path
         (host flatten + flat scatter) whenever the configuration allows it
         — half the host->device bytes of the (pixel_id, toa) path
-        (PERF.md); replica/weighted configurations use the device path."""
+        (PERF.md); replica/weighted configurations use the device path.
+        ``method='pallas2d'`` fuses flatten + block partition into one
+        native pass feeding the MXU-tiled kernel."""
+        if self._method == "pallas2d":
+            events, chunk_map = self.flatten_partition_host(
+                batch.pixel_id, batch.toa
+            )
+            return self._step_part(
+                state, dispatch_safe(events), dispatch_safe(chunk_map)
+            )
         if self.supports_host_flatten:
             return self.step_flat(
                 state, self.flatten_host(batch.pixel_id, batch.toa)
             )
         return self.step(state, batch)
 
+    def flatten_partition_host(
+        self, pixel_id: np.ndarray, toa: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Host ingest for ``method='pallas2d'``: raw (pixel_id, toa) to
+        block-partitioned ``(events, chunk_map)`` for the tiled kernel.
+
+        One fused native pass (``ld_flatten_partition``) when the
+        configuration is uniform-edged and pixel-block-aligned; otherwise
+        ``flatten_host`` + ``partition_events_host``.
+        """
+        from .pallas_hist2d import (
+            DEFAULT_CHUNK,
+            bucketed_chunks,
+            chunk_capacity,
+            partition_events_host,
+        )
+
+        if self._ppb_shift is not None and self._proj.uniform:
+            try:
+                from ..native import flatten_partition
+            except ImportError:
+                flatten_partition = None
+            if flatten_partition is not None:
+                pixel_id = sanitize_pixel_id(pixel_id)
+                chunk = DEFAULT_CHUNK
+                n_blocks = self._n_state // self._bpb
+                cap = chunk_capacity(pixel_id.shape[0], n_blocks, chunk)
+                lut_host = self._proj.lut_host
+                res = flatten_partition(
+                    pixel_id,
+                    toa,
+                    lut=None if lut_host is None else lut_host[0],
+                    n_screen=self._n_screen,
+                    n_toa=self._n_toa,
+                    lo=self._proj.lo,
+                    hi=self._proj.hi,
+                    inv_width=self._proj.inv_width,
+                    ppb_shift=self._ppb_shift,
+                    chunk=chunk,
+                    cap_chunks=cap,
+                )
+                if res is not None:
+                    events, chunk_map, used = res
+                    n_padded = bucketed_chunks(used)
+                    return events[: n_padded * chunk], chunk_map[:n_padded]
+        flat = self.flatten_host(pixel_id, toa)
+        return partition_events_host(
+            flat, self._n_bins + 1, bpb=self._bpb
+        )
+
     def step_flat(self, state: HistogramState, flat) -> HistogramState:
         """Accumulate host-pre-flattened int32 bin indices (see
         ``flatten_host``): 4 bytes/event over the host->device link instead
-        of 8. Out-of-range indices are dropped by the scatter."""
+        of 8. Out-of-range indices are dropped by the scatter.
+
+        With ``method='pallas2d'`` the indices are partitioned by bin
+        block on the host (native ``ld_partition`` when available) and
+        fed to the MXU-tiled kernel instead of the serial scatter."""
+        if self._method == "pallas2d":
+            from .pallas_hist2d import partition_events_host
+
+            events, chunk_map = partition_events_host(
+                np.asarray(flat), self._n_bins + 1, bpb=self._bpb
+            )
+            return self._step_part(
+                state, dispatch_safe(events), dispatch_safe(chunk_map)
+            )
         return self._step_flat(state, dispatch_safe(flat))
 
     @property

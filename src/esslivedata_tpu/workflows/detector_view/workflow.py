@@ -11,7 +11,7 @@ pulled to host as small dense outputs).
 from __future__ import annotations
 
 from collections.abc import Mapping
-from typing import Any
+from typing import Any, Literal
 
 import jax.numpy as jnp
 import numpy as np
@@ -44,6 +44,12 @@ class DetectorViewParams(BaseModel):
     # spectrum keeps the full axis. Bin edges are static under jit, so
     # the slice compiles to a static index range — zero runtime cost.
     image_toa_slice: TOARange | None = None
+    # Histogram kernel selection (ops/histogram.py): 'scatter' (XLA
+    # scatter-add, the safe default), or 'pallas2d' (MXU-tiled kernel,
+    # ops/pallas_hist2d.py) for host-flattenable configurations — falls
+    # back to 'scatter' when the configuration can't take it
+    # (pixel weighting, replica LUTs).
+    histogram_method: Literal["scatter", "pallas2d"] = "scatter"
 
 
 def _density_weights(lut: np.ndarray) -> np.ndarray:
@@ -78,11 +84,20 @@ class DetectorViewWorkflow:
         weights = (
             _density_weights(projection.lut) if params.pixel_weighting else None
         )
+        method = params.histogram_method
+        if method == "pallas2d" and (
+            weights is not None
+            or (projection.lut is not None and projection.lut.shape[0] > 1)
+        ):
+            # pallas2d consumes host-partitioned flat indices; weighted
+            # and replica configurations stay on the scatter.
+            method = "scatter"
         self._hist = EventHistogrammer(
             toa_edges=edges,
             n_screen=projection.n_screen,
             pixel_lut=projection.lut,
             pixel_weights=weights,
+            method=method,
         )
         self._state: HistogramState = self._hist.init_state()
         self._primary_stream = primary_stream

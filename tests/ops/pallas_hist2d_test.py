@@ -1,0 +1,260 @@
+"""pallas2d tiled histogram kernel: parity with the XLA scatter path.
+
+Runs in interpret mode on the CPU test mesh; the compiled path is what
+bench.py --all (headline_pallas2d) measures on real TPU hardware. The
+partition fast paths (native ld_partition / ld_flatten_partition) and
+the numpy fallback are each pinned against the scatter result.
+"""
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.ops import EventBatch, EventHistogrammer
+from esslivedata_tpu.ops import pallas_hist2d as p2
+from esslivedata_tpu.ops.pallas_hist2d import (
+    DEFAULT_BPB,
+    padded_bins,
+    partition_events_host,
+    scatter_add_pallas2d,
+)
+
+
+class TestPartition:
+    def _check_partition(self, flat, n_incl, events, chunk_map, chunk):
+        """Structural invariants + content parity with a plain bincount."""
+        n_blocks = -(-n_incl // DEFAULT_BPB)
+        assert events.shape[0] == chunk_map.shape[0] * chunk
+        assert np.all(np.diff(chunk_map) >= 0), "map must be non-decreasing"
+        assert chunk_map.min() >= 0 and chunk_map.max() < n_blocks
+        rows = events.reshape(-1, chunk)
+        # Every non-pad event sits in its mapped block.
+        blk = rows // np.int32(DEFAULT_BPB)
+        pad = rows < 0
+        assert np.array_equal(rows[pad], np.full(pad.sum(), -1))
+        assert np.all(blk[~pad] == np.broadcast_to(chunk_map[:, None], rows.shape)[~pad])
+        # Multiset of events == routed input.
+        dump = n_incl - 1
+        routed = np.where((flat < 0) | (flat >= n_incl), dump, flat)
+        np.testing.assert_array_equal(
+            np.sort(events[events >= 0]), np.sort(routed)
+        )
+
+    @pytest.mark.parametrize("n_events", [0, 17, 4096, 50_000])
+    def test_native_partition(self, n_events):
+        rng = np.random.default_rng(n_events)
+        n_incl = 300_001
+        flat = rng.integers(-4, n_incl + 3, n_events).astype(np.int32)
+        events, chunk_map = partition_events_host(flat, n_incl)
+        self._check_partition(flat, n_incl, events, chunk_map, p2.DEFAULT_CHUNK)
+
+    def test_numpy_fallback_matches_native(self, monkeypatch):
+        rng = np.random.default_rng(7)
+        n_incl = 300_001
+        flat = rng.integers(-4, n_incl + 3, 20_000).astype(np.int32)
+        ev_n, cm_n = partition_events_host(flat, n_incl)
+        import esslivedata_tpu.native as native
+
+        monkeypatch.setattr(native, "partition_events", lambda *a, **k: None)
+        ev_p, cm_p = partition_events_host(flat, n_incl)
+        assert np.array_equal(cm_n, cm_p)
+        c = p2.DEFAULT_CHUNK
+        np.testing.assert_array_equal(
+            np.sort(ev_n.reshape(-1, c), axis=1),
+            np.sort(ev_p.reshape(-1, c), axis=1),
+        )
+
+    def test_skewed_distribution(self):
+        # All events in one block: padding stays bounded, map collapses.
+        flat = np.full(10_000, 42, np.int32)
+        events, chunk_map = partition_events_host(flat, 300_001)
+        assert (events == 42).sum() == 10_000
+        self._check_partition(flat, 300_001, events, chunk_map, p2.DEFAULT_CHUNK)
+
+    def test_non_pow2_bpb_numpy_path(self):
+        rng = np.random.default_rng(11)
+        n_incl = 200_001
+        flat = rng.integers(0, n_incl, 5000).astype(np.int32)
+        bpb = 51200  # pixel-aligned 512 * 100, not a power of two
+        events, chunk_map = partition_events_host(flat, n_incl, bpb=bpb)
+        rows = events.reshape(-1, p2.DEFAULT_CHUNK)
+        pad = rows < 0
+        blk = rows // np.int32(bpb)
+        assert np.all(
+            blk[~pad] == np.broadcast_to(chunk_map[:, None], rows.shape)[~pad]
+        )
+        np.testing.assert_array_equal(np.sort(events[events >= 0]), np.sort(flat))
+
+    def test_bad_bpb_rejected(self):
+        with pytest.raises(ValueError, match="128"):
+            partition_events_host(np.zeros(4, np.int32), 1000, bpb=100)
+
+
+class TestKernel:
+    def test_parity_and_unvisited_blocks_preserved(self):
+        rng = np.random.default_rng(3)
+        n_incl = 4 * DEFAULT_BPB + 17
+        padded = padded_bins(n_incl)
+        # Events only touch the first two blocks: the rest must keep
+        # their prior contents bit-for-bit (in-place aliasing).
+        flat = rng.integers(0, 2 * DEFAULT_BPB, 9000).astype(np.int32)
+        events, chunk_map = partition_events_host(flat, n_incl)
+        base = rng.random(padded).astype(np.float32)
+        out = np.asarray(
+            scatter_add_pallas2d(np.array(base), events, chunk_map)
+        )
+        # Visited blocks: counts accumulate chunk-wise, so a float base
+        # differs from any single-order reference at the ULP level only.
+        ref = base + np.bincount(flat, minlength=padded).astype(np.float32)
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+        # Unvisited blocks are preserved bit-for-bit (in-place aliasing).
+        np.testing.assert_array_equal(
+            out[2 * DEFAULT_BPB :], base[2 * DEFAULT_BPB :]
+        )
+
+    def test_counts_exact_on_integer_state(self):
+        # The real accumulator holds counts: integer-valued float32, where
+        # every partial sum is exact regardless of accumulation order.
+        rng = np.random.default_rng(4)
+        n_incl = 3 * DEFAULT_BPB + 1
+        padded = padded_bins(n_incl)
+        flat = rng.integers(0, n_incl, 40_000).astype(np.int32)
+        events, chunk_map = partition_events_host(flat, n_incl)
+        base = rng.integers(0, 1000, padded).astype(np.float32)
+        out = np.asarray(
+            scatter_add_pallas2d(np.array(base), events, chunk_map)
+        )
+        ref = base + np.bincount(flat, minlength=padded).astype(np.float32)
+        np.testing.assert_array_equal(out, ref)
+
+    def test_update_scale(self):
+        flat = np.array([0, 0, 5, DEFAULT_BPB + 3], np.int32)
+        n_incl = 2 * DEFAULT_BPB
+        events, chunk_map = partition_events_host(flat, n_incl)
+        out = np.asarray(
+            scatter_add_pallas2d(
+                np.zeros(padded_bins(n_incl), np.float32),
+                events,
+                chunk_map,
+                upd=2.5,
+            )
+        )
+        assert out[0] == 5.0 and out[5] == 2.5 and out[DEFAULT_BPB + 3] == 2.5
+        assert out.sum() == 10.0
+
+
+class TestHistogrammerPallas2d:
+    def _run(self, method, batches, toa_edges=None, **kw):
+        if toa_edges is None:
+            toa_edges = np.linspace(0.0, 71.0, 101)
+        h = EventHistogrammer(toa_edges=toa_edges, **kw, method=method)
+        s = h.init_state()
+        for b in batches:
+            s = h.step_batch(s, b)
+        return h, s
+
+    def _batches(self, n_screen, n=20_000, k=3):
+        rng = np.random.default_rng(n_screen)
+        return [
+            EventBatch.from_arrays(
+                rng.integers(-2, n_screen + 2, n).astype(np.int32),
+                rng.uniform(-1.0, 73.0, n).astype(np.float32),
+            )
+            for _ in range(k)
+        ]
+
+    @pytest.mark.parametrize("n_screen", [700, 5000])
+    def test_views_parity_with_scatter(self, n_screen):
+        batches = self._batches(n_screen)
+        hs, ss = self._run("scatter", batches, n_screen=n_screen)
+        hp, sp = self._run("pallas2d", batches, n_screen=n_screen)
+        np.testing.assert_allclose(hs.read(ss)[0], hp.read(sp)[0])
+        np.testing.assert_allclose(hs.read(ss)[1], hp.read(sp)[1])
+
+    def test_dump_bin_parity(self):
+        n_screen = 700
+        batches = self._batches(n_screen)
+        hs, ss = self._run("scatter", batches, n_screen=n_screen)
+        hp, sp = self._run("pallas2d", batches, n_screen=n_screen)
+        dump = n_screen * 100
+        assert float(np.asarray(ss.window)[-1]) == float(
+            np.asarray(sp.window)[dump]
+        )
+
+    def test_decay_mode_parity(self):
+        n_screen = 700
+        batches = self._batches(n_screen)
+        hs, ss = self._run("scatter", batches, n_screen=n_screen, decay=0.9)
+        hp, sp = self._run("pallas2d", batches, n_screen=n_screen, decay=0.9)
+        np.testing.assert_allclose(
+            hs.read(ss)[1], hp.read(sp)[1], rtol=1e-6
+        )
+
+    def test_fold_and_clear(self):
+        n_screen = 700
+        batches = self._batches(n_screen)
+        hp, sp = self._run("pallas2d", batches, n_screen=n_screen)
+        cum_before = hp.read(sp)[0]
+        folded = hp.clear_window(sp)  # donates sp
+        cum, win = hp.read(folded)
+        assert win.sum() == 0
+        np.testing.assert_allclose(cum, cum_before)
+        assert hp.read(hp.clear(folded))[0].sum() == 0
+
+    def test_step_flat_path(self):
+        # step_flat partitions internally (non-fused path).
+        n_screen = 700
+        rng = np.random.default_rng(0)
+        flat = rng.integers(-3, n_screen * 100 + 5, 10_000).astype(np.int32)
+        hs = EventHistogrammer(
+            toa_edges=np.linspace(0, 71.0, 101), n_screen=n_screen
+        )
+        hp = EventHistogrammer(
+            toa_edges=np.linspace(0, 71.0, 101),
+            n_screen=n_screen,
+            method="pallas2d",
+        )
+        ss = hs.step_flat(hs.init_state(), flat)
+        sp = hp.step_flat(hp.init_state(), flat)
+        np.testing.assert_allclose(hs.read(ss)[0], hp.read(sp)[0])
+
+    def test_single_replica_lut(self):
+        n_screen, n_pix = 64, 200
+        rng = np.random.default_rng(1)
+        lut = rng.integers(-1, n_screen, n_pix).astype(np.int32)
+        batches = [
+            EventBatch.from_arrays(
+                rng.integers(-2, n_pix + 2, 5000).astype(np.int32),
+                rng.uniform(0, 71.0, 5000).astype(np.float32),
+            )
+        ]
+        hs, ss = self._run(
+            "scatter", batches, n_screen=n_screen, pixel_lut=lut
+        )
+        hp, sp = self._run(
+            "pallas2d", batches, n_screen=n_screen, pixel_lut=lut
+        )
+        np.testing.assert_allclose(hs.read(ss)[0], hp.read(sp)[0])
+
+    def test_weighted_config_rejected(self):
+        with pytest.raises(ValueError, match="host-flattenable"):
+            EventHistogrammer(
+                toa_edges=np.linspace(0, 71.0, 101),
+                n_screen=16,
+                pixel_weights=np.ones(16, np.float32),
+                method="pallas2d",
+            )
+
+    def test_nonuniform_edges(self):
+        # Non-uniform edges skip the fused native pass but keep parity.
+        edges = np.concatenate([[0.0], np.cumsum(np.linspace(0.5, 2.0, 50))])
+        n_screen = 300
+        rng = np.random.default_rng(9)
+        batches = [
+            EventBatch.from_arrays(
+                rng.integers(0, n_screen, 8000).astype(np.int32),
+                rng.uniform(0, edges[-1] + 1, 8000).astype(np.float32),
+            )
+        ]
+        hs, ss = self._run("scatter", batches, toa_edges=edges, n_screen=n_screen)
+        hp, sp = self._run("pallas2d", batches, toa_edges=edges, n_screen=n_screen)
+        np.testing.assert_allclose(hs.read(ss)[0], hp.read(sp)[0])
