@@ -1,0 +1,58 @@
+# Task runner: one documented command per environment, the counterpart
+# of the reference's tox.ini (reference: tox.ini:1 — py312/integration/
+# docs/static envs). No tox dependency: plain make + the baked-in
+# toolchain. Every target runs from a clean checkout with no install
+# step (pytest picks up src/ via pyproject pythonpath).
+
+PY ?= python
+
+.PHONY: test unit integration browser benchmarks bench bench-all multichip native docs lint all
+
+# Default quick gate: everything CI runs per-commit.
+test: unit
+
+# Unit + fast integration (the repo's default pytest selection).
+unit:
+	$(PY) -m pytest tests/ -x -q
+
+# Multi-process integration scenarios only (slower: real subprocesses
+# over the file broker).
+integration:
+	$(PY) -m pytest tests/integration/ -q -m "integration or not integration"
+
+# Browser-level UI suite (needs playwright; CI-only by default, mirrors
+# the reference's excluded-by-default browser marker).
+browser:
+	$(PY) -m pytest tests/dashboard/browser_ui_test.py -q
+
+# In-repo perf harnesses (excluded from the default run).
+benchmarks:
+	$(PY) -m pytest tests/benchmarks/ -q --run-benchmarks
+
+# The graded headline bench (one JSON line on stdout).
+bench:
+	$(PY) bench.py
+
+# Full bench: headline + BASELINE configs + latency decomposition.
+bench-all:
+	$(PY) bench.py --all
+
+# 8-virtual-device sharding dryrun (what the driver gate runs).
+multichip:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# Force-rebuild the native ingest shim (normally compile-on-demand).
+native:
+	rm -f src/esslivedata_tpu/native/_ingest.so
+	$(PY) -c "import sys; sys.path.insert(0, 'src'); \
+		from esslivedata_tpu import native; assert native.available()"
+
+# Docs are plain markdown; this validates internal links resolve.
+docs:
+	$(PY) scripts/check_docs_links.py
+
+lint:
+	$(PY) -m compileall -q src/ tests/ bench.py __graft_entry__.py
+
+all: lint unit integration docs

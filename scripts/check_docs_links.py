@@ -1,0 +1,39 @@
+"""Validate that relative markdown links in docs/ and README.md resolve.
+
+The docs build check (Makefile `docs` target, CI docs job): docs are
+plain markdown, so the failure mode worth gating is a broken relative
+link or a dangling ADR cross-reference — the analog of the reference's
+docs CI build (reference: .github/workflows/docs.yml:1).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+LINK = re.compile(r"\[[^\]]*\]\(([^)#\s]+)(?:#[^)]*)?\)")
+
+
+def main() -> int:
+    bad: list[str] = []
+    files = [ROOT / "README.md", *sorted((ROOT / "docs").rglob("*.md"))]
+    for f in files:
+        text = f.read_text(encoding="utf-8")
+        for m in LINK.finditer(text):
+            target = m.group(1)
+            if "://" in target or target.startswith("mailto:"):
+                continue
+            resolved = (f.parent / target).resolve()
+            if not resolved.exists():
+                bad.append(f"{f.relative_to(ROOT)}: broken link -> {target}")
+    if bad:
+        print("\n".join(bad))
+        return 1
+    print(f"docs links ok ({len(files)} files)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

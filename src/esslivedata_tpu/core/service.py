@@ -24,6 +24,41 @@ __all__ = ["Service", "ServiceBase", "get_env_defaults", "setup_arg_parser"]
 
 logger = logging.getLogger(__name__)
 
+# GC pinning is interpreter-global state: with several Service loops in
+# one process (tests, combined deployments) the collector must stay
+# disabled until the LAST pinned loop exits, and be restored only if it
+# was enabled when the FIRST loop pinned it.
+_gc_pin_lock = threading.Lock()
+_gc_pin_count = 0
+_gc_was_enabled = False
+
+
+def _gc_pin() -> bool:
+    """Pin the cycle collector off (process-wide refcount). Returns True
+    iff the caller must balance with ``_gc_unpin``."""
+    import gc
+
+    global _gc_pin_count, _gc_was_enabled
+    with _gc_pin_lock:
+        _gc_pin_count += 1
+        if _gc_pin_count == 1:
+            _gc_was_enabled = gc.isenabled()
+            gc.freeze()  # startup objects: off the collector's plate
+            gc.disable()
+    return True
+
+
+def _gc_unpin() -> None:
+    import gc
+
+    global _gc_pin_count
+    with _gc_pin_lock:
+        _gc_pin_count -= 1
+        if _gc_pin_count == 0:
+            gc.unfreeze()
+            if _gc_was_enabled:
+                gc.enable()
+
 ENV_PREFIX = "LIVEDATA_"
 
 
@@ -184,14 +219,10 @@ class Service(ServiceBase):
         # frees the numpy temporaries either way; the cycle collector is
         # only needed for cycles, so run it explicitly BETWEEN process()
         # calls where the 71 ms pulse budget absorbs it.
-        import gc
-
         pin_gc = os.environ.get("LIVEDATA_GC_PINNING", "1") != "0"
         did_disable = False
-        if pin_gc and gc.isenabled():
-            gc.freeze()  # startup objects: off the collector's plate
-            gc.disable()
-            did_disable = True
+        if pin_gc:
+            did_disable = _gc_pin()
         iterations = 0
         try:
             while self._running.is_set():
@@ -199,6 +230,8 @@ class Service(ServiceBase):
                 self._processor.process()
                 iterations += 1
                 if pin_gc and iterations % self.GC_COLLECT_EVERY == 0:
+                    import gc
+
                     gc.collect()
                 elapsed = time.monotonic() - start
                 sleep = self._poll_interval_s - elapsed
@@ -216,9 +249,7 @@ class Service(ServiceBase):
                 pass
         finally:
             if did_disable:
-                # Restore only what THIS loop disabled: another component
-                # (or a sibling service) may own the collector's state.
-                gc.enable()
+                _gc_unpin()
             try:
                 self._processor.finalize()
             except Exception:

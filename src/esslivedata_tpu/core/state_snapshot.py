@@ -23,6 +23,7 @@ bool``.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -59,6 +60,26 @@ class SnapshotStore:
     def _path(
         self, workflow_id: str, source_name: str, archive: bool
     ) -> Path:
+        suffix = ".runfinal.npz" if archive else ".npz"
+        # _slug output may itself contain '_', so the '__' join alone is
+        # ambiguous ('a' + 'b__c' vs 'a__b' + 'c'): a short digest of the
+        # unambiguous pair keeps distinct jobs on distinct files (the
+        # fingerprint check would refuse a wrong restore, but last-dump-
+        # wins on one shared file would silently destroy the other
+        # job's snapshot).
+        pair = hashlib.sha256(
+            f"{workflow_id}\x00{source_name}".encode()
+        ).hexdigest()[:8]
+        return self._dir / (
+            f"{_slug(workflow_id)}__{_slug(source_name)}__{pair}{suffix}"
+        )
+
+    def _legacy_path(
+        self, workflow_id: str, source_name: str, archive: bool
+    ) -> Path:
+        """Pre-digest filename (no pair hash): snapshots written by an
+        older service must survive the upgrade, so load() falls back to
+        this name and migrates on hit."""
         suffix = ".runfinal.npz" if archive else ".npz"
         return self._dir / (
             f"{_slug(workflow_id)}__{_slug(source_name)}{suffix}"
@@ -118,6 +139,16 @@ class SnapshotStore:
         device state is not built yet) pass ``consume=False`` and call
         :meth:`discard` only once the restore actually succeeded."""
         path = self._path(workflow_id, source_name, archive=False)
+        if not path.exists():
+            # Upgrade path: adopt a snapshot written under the pre-digest
+            # filename so a restart across the version change still
+            # restores (the fingerprint check below stays the gate).
+            legacy = self._legacy_path(workflow_id, source_name, archive=False)
+            if legacy.exists():
+                try:
+                    legacy.rename(path)
+                except OSError:
+                    path = legacy
         try:
             with np.load(path) as archive:
                 meta = json.loads(bytes(archive["__meta__"]).decode())

@@ -49,15 +49,32 @@ def _id_to_key(kid: str) -> ResultKey:
     return ResultKey.from_string(base64.urlsafe_b64decode(kid.encode()).decode())
 
 
+def _token_matches(presented: str | None, token: str) -> bool:
+    """Constant-time token check. Bytes comparison: compare_digest
+    raises TypeError on non-ASCII str input (a pasted token with a
+    stray unicode char must 401, not 500)."""
+    import hmac
+
+    # isinstance: a JSON login body can carry any type ({"token": 123})
+    # — anything but str must 401, not 500.
+    return isinstance(presented, str) and hmac.compare_digest(
+        presented.encode("utf-8"), token.encode("utf-8")
+    )
+
+
 class _Base(tornado.web.RequestHandler):
     """Shared services access, JSON helpers and the auth gate.
 
     Auth (reference dashboard.py:32 takes an auth config): when the app
     is built with a token (``make_app(auth_token=...)`` /
     ``LIVEDATA_DASHBOARD_TOKEN``), every request must present it — as a
-    ``Bearer`` header (API clients), a ``?token=`` query parameter
-    (first visit), or the session cookie that a token-bearing page view
-    sets. No token configured = open dashboard (beamline-console mode).
+    ``Bearer`` header (API clients) or the session cookie minted by the
+    POST ``/login`` form. The token deliberately never rides a URL:
+    query strings land in access logs, browser history and Referer
+    headers, so a leaked log must not leak the secret. Unauthenticated
+    browser page loads are redirected to the login form; API requests
+    get a JSON 401. No token configured = open dashboard
+    (beamline-console mode).
     """
 
     _COOKIE = "livedata_auth"
@@ -71,38 +88,19 @@ class _Base(tornado.web.RequestHandler):
         if header.startswith("Bearer "):
             presented = header[len("Bearer ") :]
         if presented is None:
-            presented = self.get_argument("token", None)
-            from_query = presented is not None
-        else:
-            from_query = False
-        if presented is None:
             cookie = self.get_signed_cookie(self._COOKIE)
             presented = cookie.decode() if cookie else None
-        import hmac
-
-        # Bytes comparison: compare_digest raises TypeError on non-ASCII
-        # str input (a pasted token with a stray unicode char must 401,
-        # not 500).
-        if presented is None or not hmac.compare_digest(
-            presented.encode("utf-8"), token.encode("utf-8")
-        ):
+        if not _token_matches(presented, token):
+            wants_html = (
+                self.request.method == "GET"
+                and "text/html" in self.request.headers.get("Accept", "")
+            )
+            if wants_html:
+                self.redirect("/login")
+                return
             self.set_status(401)
             self.set_header("WWW-Authenticate", "Bearer")
             self.finish(json.dumps({"error": "authentication required"}))
-            return
-        if from_query:
-            # Browser flow: the ?token= visit mints the session cookie so
-            # subsequent asset/API requests authenticate silently.
-            # SameSite=Strict: the cookie authorizes state-changing POSTs
-            # (job stop/reset, workflow start), so it must never ride a
-            # cross-site request.
-            self.set_signed_cookie(
-                self._COOKIE,
-                token,
-                expires_days=1,
-                httponly=True,
-                samesite="Strict",
-            )
 
     @property
     def services(self) -> DashboardServices:
@@ -140,6 +138,73 @@ class _Base(tornado.web.RequestHandler):
             self.set_status(404)
             return None
         return key, params, data
+
+
+_LOGIN_PAGE = """<!DOCTYPE html>
+<html><head><title>esslivedata — login</title><style>
+body { font-family: system-ui, sans-serif; background: #111; color: #ddd;
+       display: flex; justify-content: center; align-items: center;
+       height: 100vh; margin: 0; }
+form { background: #1c1c1c; padding: 2rem; border-radius: 8px; }
+input { padding: 0.5rem; margin-right: 0.5rem; background: #2a2a2a;
+        color: #eee; border: 1px solid #444; border-radius: 4px; }
+button { padding: 0.5rem 1rem; }
+.err { color: #e66; margin-top: 0.75rem; }
+</style></head><body>
+<form method="post" action="/login">
+  <label>Dashboard token
+    <input type="password" name="token" autofocus autocomplete="off">
+  </label>
+  <button type="submit">Sign in</button>
+  {err}
+</form></body></html>"""
+
+
+class LoginHandler(tornado.web.RequestHandler):
+    """POST login: the token travels in the request BODY, never a URL.
+
+    Mints the signed session cookie on success. SameSite=Strict: the
+    cookie authorizes state-changing POSTs (job stop/reset, workflow
+    start), so it must never ride a cross-site request.
+    """
+
+    def get(self) -> None:
+        if not self.application.settings.get("auth_token"):
+            self.redirect("/")
+            return
+        self.set_header("Content-Type", "text/html; charset=utf-8")
+        self.write(_LOGIN_PAGE.replace("{err}", ""))
+
+    def post(self) -> None:
+        token = self.application.settings.get("auth_token")
+        if not token:
+            self.redirect("/")
+            return
+        presented = self.get_body_argument("token", None)
+        if presented is None and self.request.headers.get(
+            "Content-Type", ""
+        ).startswith("application/json"):
+            try:
+                presented = json.loads(self.request.body).get("token")
+            except (ValueError, AttributeError):
+                presented = None
+        if not _token_matches(presented, token):
+            self.set_status(401)
+            self.set_header("Content-Type", "text/html; charset=utf-8")
+            self.write(
+                _LOGIN_PAGE.replace(
+                    "{err}", '<div class="err">Invalid token</div>'
+                )
+            )
+            return
+        self.set_signed_cookie(
+            _Base._COOKIE,
+            token,
+            expires_days=1,
+            httponly=True,
+            samesite="Strict",
+        )
+        self.redirect("/")
 
 
 class StateHandler(_Base):
@@ -1874,6 +1939,7 @@ def make_app(
     return tornado.web.Application(
         [
             (r"/", IndexHandler),
+            (r"/login", LoginHandler),
             (r"/api/state", StateHandler),
             (r"/api/session", SessionHandler),
             (r"/api/workflow/start", StartWorkflowHandler),

@@ -816,10 +816,11 @@ def encode_pl72(msg: RunStartMessage) -> bytes:
     # does not populate are omitted (flatbuffers default semantics).
     b = flatbuffers.Builder(256)
     sid_off = b.CreateString(msg.service_id) if msg.service_id else None
-    job_off = b.CreateString(msg.job_id) if msg.job_id else None
-    nx_off = (
-        b.CreateString(msg.nexus_structure) if msg.nexus_structure else None
-    )
+    # nexus_structure and job_id are (required) in the upstream ECDC
+    # schema: always write the slot (empty string when unset) so a
+    # consumer running the flatbuffers verifier accepts our buffers.
+    job_off = b.CreateString(msg.job_id)
+    nx_off = b.CreateString(msg.nexus_structure)
     inst_off = b.CreateString(msg.instrument_name)
     run_off = b.CreateString(msg.run_name)
     b.StartObject(12)
@@ -827,10 +828,8 @@ def encode_pl72(msg: RunStartMessage) -> bytes:
     b.PrependUint64Slot(1, msg.stop_time_ns, 0)
     b.PrependUOffsetTRelativeSlot(2, run_off, 0)
     b.PrependUOffsetTRelativeSlot(3, inst_off, 0)
-    if nx_off is not None:
-        b.PrependUOffsetTRelativeSlot(4, nx_off, 0)
-    if job_off is not None:
-        b.PrependUOffsetTRelativeSlot(5, job_off, 0)
+    b.PrependUOffsetTRelativeSlot(4, nx_off, 0)
+    b.PrependUOffsetTRelativeSlot(5, job_off, 0)
     if sid_off is not None:
         b.PrependUOffsetTRelativeSlot(7, sid_off, 0)
     b.Finish(b.EndObject(), file_identifier=b"pl72")
@@ -856,13 +855,13 @@ def encode_6s4t(msg: RunStopMessage) -> bytes:
     b = flatbuffers.Builder(128)
     cmd_off = b.CreateString(msg.command_id) if msg.command_id else None
     sid_off = b.CreateString(msg.service_id) if msg.service_id else None
-    job_off = b.CreateString(msg.job_id) if msg.job_id else None
+    # job_id is (required) upstream: always write the slot (see pl72).
+    job_off = b.CreateString(msg.job_id)
     run_off = b.CreateString(msg.run_name)
     b.StartObject(5)
     b.PrependUint64Slot(0, msg.stop_time_ns, 0)
     b.PrependUOffsetTRelativeSlot(1, run_off, 0)
-    if job_off is not None:
-        b.PrependUOffsetTRelativeSlot(2, job_off, 0)
+    b.PrependUOffsetTRelativeSlot(2, job_off, 0)
     if sid_off is not None:
         b.PrependUOffsetTRelativeSlot(3, sid_off, 0)
     if cmd_off is not None:

@@ -1,6 +1,8 @@
 """Dashboard auth gate (reference dashboard.py:32 takes an auth config):
-token-configured apps reject unauthenticated requests; Bearer header,
-?token= query (which mints the session cookie), and cookie all work."""
+token-configured apps reject unauthenticated requests; Bearer header and
+the POST /login form (which mints the session cookie) both work. The
+token never travels in a URL (query strings leak via access logs,
+history and Referer)."""
 
 import json
 
@@ -44,15 +46,71 @@ class AuthWebTest(AsyncHTTPTestCase):
         assert r.code == 200
         assert "generation" in json.loads(r.body)
 
-    def test_query_token_mints_session_cookie(self):
-        r = self.fetch(f"/?token={self.TOKEN}")
-        assert r.code == 200
+    def test_login_post_mints_session_cookie(self):
+        r = self.fetch(
+            "/login",
+            method="POST",
+            body=f"token={self.TOKEN}",
+            headers={"Content-Type": "application/x-www-form-urlencoded"},
+            follow_redirects=False,
+        )
+        assert r.code == 302
         cookie = r.headers.get("Set-Cookie", "")
         assert "livedata_auth" in cookie
+        assert "SameSite=Strict" in cookie or "samesite=strict" in cookie.lower()
         # The minted cookie authenticates subsequent requests alone.
         session = cookie.split(";")[0]
         r2 = self.fetch("/api/state", headers={"Cookie": session})
         assert r2.code == 200
+
+    def test_login_post_json_body(self):
+        r = self.fetch(
+            "/login",
+            method="POST",
+            body=json.dumps({"token": self.TOKEN}),
+            headers={"Content-Type": "application/json"},
+            follow_redirects=False,
+        )
+        assert r.code == 302
+        assert "livedata_auth" in r.headers.get("Set-Cookie", "")
+
+    def test_login_json_non_string_token_401s(self):
+        # Any JSON type must 401, never 500 (the module contract).
+        for payload in ({"token": 123}, {"token": None}, {"token": ["x"]}, {}):
+            r = self.fetch(
+                "/login",
+                method="POST",
+                body=json.dumps(payload),
+                headers={"Content-Type": "application/json"},
+            )
+            assert r.code == 401, payload
+
+    def test_login_wrong_token_401s_with_form(self):
+        r = self.fetch(
+            "/login",
+            method="POST",
+            body="token=WRONG",
+            headers={"Content-Type": "application/x-www-form-urlencoded"},
+        )
+        assert r.code == 401
+        assert b"Invalid token" in r.body
+        assert "Set-Cookie" not in r.headers
+
+    def test_token_in_query_is_not_accepted(self):
+        # The old ?token= path must stay dead: URLs leak via logs.
+        r = self.fetch(f"/api/state?token={self.TOKEN}")
+        assert r.code == 401
+
+    def test_browser_page_load_redirects_to_login(self):
+        r = self.fetch(
+            "/", headers={"Accept": "text/html"}, follow_redirects=False
+        )
+        assert r.code == 302
+        assert r.headers["Location"] == "/login"
+        # The login form itself is reachable unauthenticated.
+        r2 = self.fetch("/login", headers={"Accept": "text/html"})
+        assert r2.code == 200
+        assert b"form" in r2.body
 
     def test_post_endpoints_also_gated(self):
         r = self.fetch(

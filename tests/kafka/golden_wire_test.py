@@ -505,6 +505,15 @@ GOLDEN = {
         "140000007b227374617465223a202272756e6e696e67227d00000000"
     ),
     "pl72": (
+        "20000000706c3732000000001400240018000000140010000c00080000000400"
+        "14000000480000003c0000003000000020000000100000000068e5cf8b010000"
+        "000000000700000072756e5f30343200040000006c6f6b690000000000000000"
+        "00000000030000006a2d31000400000066772d3100000000"
+    ),
+    # Pre-r5 layout: nexus_structure/job_id slots omitted when empty
+    # (upstream marks them required; encoders now always write them).
+    # Decoders must keep accepting the old buffers.
+    "pl72_legacy_optional": (
         "1c000000706c3732140020001400000010000c00000008000000040014000000"
         "3c0000003000000020000000100000000068e5cf8b0100000000000007000000"
         "72756e5f30343200040000006c6f6b6900000000030000006a2d310004000000"
@@ -700,6 +709,12 @@ class TestGoldenBytes:
         )
         assert wire.encode_pl72(msg).hex() == GOLDEN["pl72"]
         assert wire.decode_pl72(bytes.fromhex(GOLDEN["pl72"])) == msg
+        # Backward compat: buffers from encoders that omitted the
+        # (upstream-required) empty slots still decode identically.
+        assert (
+            wire.decode_pl72(bytes.fromhex(GOLDEN["pl72_legacy_optional"]))
+            == msg
+        )
 
     def test_6s4t(self):
         msg = wire.RunStopMessage(

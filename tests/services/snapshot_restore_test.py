@@ -51,6 +51,55 @@ class TestSnapshotStore:
             is None
         )
 
+    def test_distinct_pairs_never_share_a_file(self, tmp_path):
+        # _slug output can contain '_', so the '__' join alone would
+        # collide ('a' + 'b__c' vs 'a__b' + 'c'); the pair digest keeps
+        # both snapshots alive.
+        store = SnapshotStore(tmp_path)
+        store.save(
+            workflow_id="a",
+            source_name="b__c",
+            fingerprint="f1",
+            arrays={"x": np.ones(2)},
+        )
+        store.save(
+            workflow_id="a__b",
+            source_name="c",
+            fingerprint="f2",
+            arrays={"x": np.zeros(2)},
+        )
+        first = store.load(
+            workflow_id="a", source_name="b__c", fingerprint="f1"
+        )
+        second = store.load(
+            workflow_id="a__b", source_name="c", fingerprint="f2"
+        )
+        assert first is not None and second is not None
+        np.testing.assert_array_equal(first["x"], np.ones(2))
+        np.testing.assert_array_equal(second["x"], np.zeros(2))
+
+    def test_legacy_filename_adopted_on_load(self, tmp_path):
+        # A snapshot written under the pre-digest name (older service)
+        # must restore after the upgrade.
+        store = SnapshotStore(tmp_path)
+        store.save(
+            workflow_id="w/v1",
+            source_name="s",
+            fingerprint="f1",
+            arrays={"folded": np.arange(3.0)},
+        )
+        new_path = store._path("w/v1", "s", archive=False)
+        new_path.rename(store._legacy_path("w/v1", "s", archive=False))
+        out = store.load(workflow_id="w/v1", source_name="s", fingerprint="f1")
+        assert out is not None
+        np.testing.assert_array_equal(out["folded"], np.arange(3.0))
+        # Consumed one-shot like any other snapshot; legacy file gone.
+        assert not store._legacy_path("w/v1", "s", archive=False).exists()
+        assert (
+            store.load(workflow_id="w/v1", source_name="s", fingerprint="f1")
+            is None
+        )
+
     def test_fingerprint_mismatch_keeps_file(self, tmp_path):
         store = SnapshotStore(tmp_path)
         store.save(
