@@ -1,0 +1,26 @@
+"""TPU-native live neutron-data reduction & visualization framework.
+
+Re-implements the capabilities of scipp/esslivedata (see /root/repo/SURVEY.md)
+with a JAX/XLA-first compute path: event batches are staged into fixed-shape
+device buffers, histogrammed with scatter-add over pixel x TOF bins, rolling
+accumulators live in HBM, and multi-bank / monitor-normalized reductions fan
+out over TPU meshes with shard_map + psum.
+
+Package layout (bottom to top, mirroring SURVEY.md section 1's layer map):
+
+- ``utils/``   labeled-array + unit veneer over numpy/jnp (replaces scipp's
+               C++ array layer for wire data and workflow outputs)
+- ``ops/``     jitted TPU kernels: event histogrammers, rolling accumulators,
+               projection tables (replaces scipp's bin/hist C++ kernels)
+- ``parallel/`` device-mesh sharding: multi-bank shard_map fan-out, psum
+               normalization (replaces process-level scale-out for compute)
+- ``core/``    runtime: timestamps, messages, batchers, service loop, jobs
+- ``preprocessors/`` per-stream accumulators (ev44 -> device batches, NXlog)
+- ``workflows/`` registry-driven streaming workflows (detector view, monitor)
+- ``kafka/``   transport: wire codecs, adapters, sources, sinks
+- ``config/``  instrument registry, workflow specs, stream mappings
+- ``services/`` entry points and service assembly
+- ``dashboard/`` data service, extractors, fake backend
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,27 @@
+"""Declarative configuration: instruments, workflow specs, stream mappings.
+
+Parity with reference ``src/ess/livedata/config/`` (SURVEY.md section 2.6).
+Everything above and below reads this layer; it has no dependencies on the
+runtime. Workflow parameter models are pydantic and double as the dashboard
+UI schema, exactly like the reference (workflow_spec.py:312-398).
+"""
+
+from .workflow_spec import (
+    JobId,
+    JobSchedule,
+    OutputSpec,
+    ResultKey,
+    WorkflowConfig,
+    WorkflowId,
+    WorkflowSpec,
+)
+
+__all__ = [
+    "JobId",
+    "JobSchedule",
+    "OutputSpec",
+    "ResultKey",
+    "WorkflowConfig",
+    "WorkflowId",
+    "WorkflowSpec",
+]

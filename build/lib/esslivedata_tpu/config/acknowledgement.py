@@ -1,0 +1,22 @@
+"""Command acknowledgement wire model (reference: config/acknowledgement.py).
+
+Published as JSON on the responses topic; the dashboard's pending-command
+tracker correlates by (source_name, job_number).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Literal
+
+from pydantic import BaseModel
+
+__all__ = ["CommandAcknowledgement"]
+
+
+class CommandAcknowledgement(BaseModel):
+    source_name: str
+    job_number: uuid.UUID
+    status: Literal["ack", "error"]
+    message: str = ""
+    service: str = ""

@@ -1,0 +1,105 @@
+"""Chopper stream-name conventions (instrument-level data-model concern).
+
+Parity with reference ``config/chopper.py``: an instrument that declares
+choppers owns the streams they produce — a clean ``rotation_speed_setpoint``
+and a noisy ``delay`` readback per chopper (real upstream PVs), plus the
+synthetic ``delay_setpoint`` the ``ChopperSynthesizer`` derives by plateau
+detection. The wavelength-LUT workflow consumes these as context; it is not
+their owner.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+from .stream import F144Stream, Stream
+
+__all__ = [
+    "CHOPPER_CASCADE_SOURCE",
+    "chopper_pv_streams",
+    "declare_chopper_setpoint_streams",
+    "delay_readback_stream",
+    "delay_setpoint_stream",
+    "speed_setpoint_stream",
+]
+
+#: Logical source name of the synthetic cascade trigger stream: emitted by
+#: ChopperSynthesizer once every chopper locks; consumed as the wavelength-
+#: LUT workflow's primary dynamic stream (its arrival drives a recompute).
+CHOPPER_CASCADE_SOURCE = "chopper_cascade"
+
+
+def speed_setpoint_stream(chopper: str) -> str:
+    """Stream name of a chopper's clean rotation-speed setpoint f144 PV."""
+    return f"{chopper}/rotation_speed_setpoint"
+
+
+def delay_readback_stream(chopper: str) -> str:
+    """Stream name of a chopper's noisy delay readback f144 PV."""
+    return f"{chopper}/delay"
+
+
+def delay_setpoint_stream(chopper: str) -> str:
+    """Stream name of the synthesized (plateau-locked) delay setpoint.
+
+    Emitted in-process by ``ChopperSynthesizer``; not a Kafka topic.
+    """
+    return f"{chopper}/delay_setpoint"
+
+
+def chopper_pv_streams(
+    choppers: Sequence[str], *, topic: str, source_prefix: str = ""
+) -> dict[str, Stream]:
+    """Catalog entries for each chopper's real upstream PVs.
+
+    One speed-setpoint and one delay-readback F144Stream per chopper, named
+    by the same helpers route derivation subscribes through — instruments
+    use this instead of hand-building the names so declaration and
+    subscription can never desynchronize.
+    """
+    streams: dict[str, Stream] = {}
+    for chopper in choppers:
+        prefix = source_prefix or chopper
+        streams[speed_setpoint_stream(chopper)] = F144Stream(
+            topic=topic, source=f"{prefix}:SpdSet", units="Hz"
+        )
+        streams[delay_readback_stream(chopper)] = F144Stream(
+            topic=topic, source=f"{prefix}:Delay", units="ns"
+        )
+    return streams
+
+
+def declare_chopper_setpoint_streams(
+    streams: dict[str, Stream], choppers: Sequence[str]
+) -> None:
+    """Declare the synthetic ``delay_setpoint`` streams in-place.
+
+    The readback must carry unit 'ns': plateau detection and the delay
+    tolerance threshold assume nanosecond samples, so a differently-unitted
+    readback would silently mis-scale detection.
+    """
+    for chopper in choppers:
+        try:
+            readback = streams[delay_readback_stream(chopper)]
+        except KeyError:
+            raise ValueError(
+                f"Chopper {chopper!r} declared but its delay readback stream "
+                f"{delay_readback_stream(chopper)!r} is not in the stream "
+                f"catalog"
+            ) from None
+        units = getattr(readback, "units", None)
+        if units != "ns":
+            raise ValueError(
+                f"Chopper {chopper!r} delay readback declares units "
+                f"{units!r}, expected 'ns'"
+            )
+        name = delay_setpoint_stream(chopper)
+        if (existing := streams.get(name)) is not None:
+            if existing.topic is not None:
+                raise ValueError(
+                    f"Stream {name!r} already declared with a Kafka identity "
+                    f"(topic={existing.topic!r}); the synthesizer would "
+                    "shadow a real upstream PV"
+                )
+            continue  # idempotent re-declaration of the synthetic stream
+        streams[name] = F144Stream(units=units)

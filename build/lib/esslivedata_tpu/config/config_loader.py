@@ -1,0 +1,74 @@
+"""Infrastructure YAML config loading (reference: config/config_loader.py:26).
+
+Config files live in ``config/defaults/`` and are selected by namespace +
+environment: ``<namespace>_<env>.yaml`` (plain YAML) or
+``<namespace>_<env>.yaml.jinja`` (Jinja2 template whose undeclared
+variables are filled from environment variables — missing ones raise).
+"""
+
+from __future__ import annotations
+
+import os
+from importlib import resources
+
+import yaml
+
+from .env import DEFAULT_ENV, ENV_VAR
+
+__all__ = ["load_config"]
+
+
+def _template_env_vars(template_content: str) -> dict[str, str]:
+    import json
+
+    from jinja2 import Environment
+    from jinja2.meta import find_undeclared_variables
+
+    env = Environment(autoescape=True)
+    variables = find_undeclared_variables(env.parse(template_content))
+    values: dict[str, str] = {}
+    for var in variables:
+        value = os.getenv(var)
+        if value is None:
+            raise ValueError(
+                f"Environment variable {var} required by config template "
+                "is not set"
+            )
+        # YAML-safe: substituted unquoted, a credential containing '#',
+        # ': ' or leading flow characters would corrupt the parse (or be
+        # silently truncated at a comment). JSON strings are valid YAML.
+        values[var] = json.dumps(value)
+    return values
+
+
+def load_config(*, namespace: str, env: str | None = None) -> dict:
+    """Load the config dict for ``namespace`` in ``env``.
+
+    ``env`` defaults from ``LIVEDATA_ENV``; pass an empty string for
+    environment-independent files.
+    """
+    env = env if env is not None else os.getenv(ENV_VAR, DEFAULT_ENV).lower()
+    suffix = f"_{env}" if env else ""
+    config_file = f"{namespace}{suffix}.yaml"
+    template_file = f"{namespace}{suffix}.yaml.jinja"
+    root = resources.files("esslivedata_tpu.config.defaults")
+
+    try:
+        with root.joinpath(config_file).open() as f:
+            return yaml.safe_load(f)
+    except FileNotFoundError:
+        pass
+    try:
+        with root.joinpath(template_file).open() as f:
+            template_content = f.read()
+    except FileNotFoundError:
+        raise FileNotFoundError(
+            f"Neither {config_file} nor {template_file} found in "
+            "config defaults"
+        ) from None
+    from jinja2 import Template
+
+    rendered = Template(template_content).render(
+        **_template_env_vars(template_content)
+    )
+    return yaml.safe_load(rendered)

@@ -1,0 +1,1 @@
+"""Default infrastructure configs (reference: config/defaults/)."""

@@ -1,0 +1,163 @@
+"""Per-instrument NICOS derived-device contract, derived from the registry.
+
+Parity with reference ``config/device_contract.py`` (ADR 0006): a NICOS
+*derived device* is a scalar cumulative workflow output exposed under a
+stable device name. The mapping
+
+    (workflow_id, source_name, output_name) -> device_name
+
+is derived from the workflow registry: an output is a device iff its
+``WorkflowSpec`` lists it in ``device_outputs``, with the device name
+rendered from the declared template per source. Because the contract is
+generated from the registry it cannot drift from it; the one remaining
+failure mode — a template rendering the same device name twice — fails loud
+at construction. ``to_yaml``/``from_yaml`` provide the static git-tracked
+export NICOS consumes.
+
+NICOS resets a device by producing a ``{"kind": "job_command", "action":
+"reset", "workflow_id": ...}`` command; the reset is confirmed by the
+``start_time`` generation jump on the device topic, not by an ack.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Iterable, Iterator, Mapping
+from typing import TYPE_CHECKING
+
+from pydantic import BaseModel
+
+from .workflow_spec import WorkflowSpec
+
+if TYPE_CHECKING:
+    from .workflow_spec import WorkflowId
+
+__all__ = ["DeviceContract", "DeviceContractEntry", "DeviceContractError"]
+
+
+class DeviceContractError(ValueError):
+    """Raised when a contract renders duplicate or invalid device names."""
+
+
+class DeviceContractEntry(BaseModel, frozen=True):
+    """One ``(workflow_id, source_name, output_name) -> device_name`` row."""
+
+    workflow_id: str
+    source_name: str
+    output_name: str
+    device_name: str
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.workflow_id, self.source_name, self.output_name)
+
+
+def _render(template: str, source_name: str) -> str:
+    try:
+        return template.format(source_name=source_name)
+    except (KeyError, IndexError) as exc:
+        raise DeviceContractError(
+            f"Invalid device_name template {template!r}: {exc}"
+        ) from exc
+
+
+class DeviceContract:
+    """Validated, immutable set of device-contract entries."""
+
+    def __init__(self, entries: Iterable[DeviceContractEntry]) -> None:
+        self._entries = tuple(entries)
+        seen_keys: set[tuple[str, str, str]] = set()
+        seen_names: dict[str, DeviceContractEntry] = {}
+        # (workflow_id, source_name) -> entries: the per-result lookup the
+        # extractor does every batch, precomputed so it is O(1).
+        self._by_job: dict[tuple[str, str], list[DeviceContractEntry]] = {}
+        for entry in self._entries:
+            if entry.key in seen_keys:
+                raise DeviceContractError(
+                    f"Duplicate device-contract key {entry.key}"
+                )
+            if entry.device_name in seen_names:
+                other = seen_names[entry.device_name]
+                raise DeviceContractError(
+                    f"Device name {entry.device_name!r} rendered by both "
+                    f"{other.key} and {entry.key}"
+                )
+            seen_keys.add(entry.key)
+            seen_names[entry.device_name] = entry
+            self._by_job.setdefault(
+                (entry.workflow_id, entry.source_name), []
+            ).append(entry)
+
+    @classmethod
+    def from_specs(cls, specs: Iterable[WorkflowSpec]) -> DeviceContract:
+        """Derive the contract from workflow specs (the single source of
+        truth); specs without ``device_outputs`` contribute nothing."""
+        entries = []
+        for spec in specs:
+            wid = str(spec.identifier)
+            for output_name, template in spec.device_outputs.items():
+                for source in spec.source_names:
+                    entries.append(
+                        DeviceContractEntry(
+                            workflow_id=wid,
+                            source_name=source,
+                            output_name=output_name,
+                            device_name=_render(template, source),
+                        )
+                    )
+        return cls(entries)
+
+    def __iter__(self) -> Iterator[DeviceContractEntry]:
+        return iter(self._entries)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def devices_for(
+        self, workflow_id: WorkflowId | str, source_name: str
+    ) -> tuple[DeviceContractEntry, ...]:
+        """Entries for one (workflow, source) pair."""
+        return tuple(self._by_job.get((str(workflow_id), source_name), ()))
+
+    def to_mapping(self) -> list[dict[str, str]]:
+        """JSON/YAML-ready export, sorted for stable diffs."""
+        return [
+            e.model_dump() for e in sorted(self._entries, key=lambda e: e.key)
+        ]
+
+    @classmethod
+    def from_mapping(cls, rows: Iterable[Mapping[str, str]]) -> DeviceContract:
+        return cls(DeviceContractEntry.model_validate(row) for row in rows)
+
+
+def contract_to_yaml(contract: DeviceContract, *, instrument: str) -> str:
+    """The static git-tracked YAML export NICOS consumes (one file per
+    instrument package, regenerated by
+    ``scripts/generate_instrument_artifacts.py``)."""
+    import yaml
+
+    header = (
+        f"# GENERATED -- do not edit. NICOS derived-device list for "
+        f"{instrument}.\n"
+        "# Regenerate: python scripts/generate_instrument_artifacts.py\n"
+    )
+    return header + yaml.safe_dump(
+        {"devices": contract.to_mapping()}, sort_keys=False
+    )
+
+
+def contract_from_yaml(text: str) -> DeviceContract:
+    import yaml
+
+    data = yaml.safe_load(text) or {}
+    return DeviceContract.from_mapping(data.get("devices", []))
+
+
+def load_instrument_contract(instrument: str) -> DeviceContract:
+    """The checked-in contract of a built-in instrument package."""
+    from importlib import resources
+
+    pkg = f"esslivedata_tpu.config.instruments.{instrument}"
+    text = (
+        resources.files(pkg).joinpath("device_contract.yaml").read_text()
+    )
+    return contract_from_yaml(text)

@@ -1,0 +1,32 @@
+"""Streaming environment selector (reference: config/env.py).
+
+DEV prefixes topics (``dev_<instrument>_*``) so a development broker can
+coexist with production; PROD uses the facility topic names directly. The
+active environment defaults from the ``LIVEDATA_ENV`` variable.
+"""
+
+from __future__ import annotations
+
+import os
+from enum import Enum
+
+__all__ = ["DEFAULT_ENV", "ENV_VAR", "StreamingEnv", "current_env"]
+
+ENV_VAR = "LIVEDATA_ENV"
+DEFAULT_ENV = "dev"
+
+
+class StreamingEnv(Enum):
+    DEV = "dev"
+    PROD = "prod"
+
+
+def current_env() -> StreamingEnv:
+    value = os.getenv(ENV_VAR, DEFAULT_ENV).lower()
+    try:
+        return StreamingEnv(value)
+    except ValueError as err:
+        raise ValueError(
+            f"{ENV_VAR}={value!r} is not a valid environment; "
+            f"expected one of {[e.value for e in StreamingEnv]}"
+        ) from err

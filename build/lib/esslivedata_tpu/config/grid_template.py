@@ -1,0 +1,157 @@
+"""Dashboard grid templates: data model + raw YAML loading.
+
+Parity with reference ``config/grid_template.py`` (GridSpec, raw template
+loading from each instrument package's ``grid_templates/`` directory). The
+dashboard's plot orchestrator materialises these specs into live grids;
+persisting a configured grid round-trips through the same model.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from importlib import resources
+from typing import Any
+
+import yaml
+
+__all__ = [
+    "CellGeometry",
+    "GridCellSpec",
+    "GridSpec",
+    "load_grid_templates",
+    "load_raw_grid_templates",
+]
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class CellGeometry:
+    """Placement of one cell on the grid."""
+
+    row: int
+    col: int
+    row_span: int = 1
+    col_span: int = 1
+
+    def __post_init__(self) -> None:
+        if self.row < 0 or self.col < 0:
+            raise ValueError("cell position must be non-negative")
+        if self.row_span < 1 or self.col_span < 1:
+            raise ValueError("cell span must be >= 1")
+
+
+@dataclass(frozen=True)
+class GridCellSpec:
+    """One plot cell: where it sits and what it shows.
+
+    ``workflow``/``output`` select the result stream (matched against
+    ResultKeys); ``plotter`` optionally forces a plotter kind, else the
+    registry auto-selects from the output's template array.
+    """
+
+    geometry: CellGeometry
+    workflow: str = ""
+    output: str = ""
+    source: str = ""
+    plotter: str = ""
+    title: str = ""
+    # Presentation parameters (dashboard.plots.PlotParams schema: scale,
+    # cmap, vmin, vmax) — carried opaquely here so templates/persistence
+    # stay decoupled from the rendering layer's knob set.
+    params: tuple[tuple[str, Any], ...] = ()
+
+    @property
+    def params_dict(self) -> dict[str, Any]:
+        return dict(self.params)
+
+    @staticmethod
+    def freeze_params(raw: dict[str, Any] | None) -> tuple[tuple[str, Any], ...]:
+        return tuple(sorted((raw or {}).items()))
+
+
+@dataclass(frozen=True)
+class GridSpec:
+    """Grid configuration without runtime state (templates, persistence)."""
+
+    name: str
+    title: str = ""
+    description: str = ""
+    nrows: int = 2
+    ncols: int = 2
+    cells: tuple[GridCellSpec, ...] = field(default_factory=tuple)
+    enabled: bool = True
+
+    @property
+    def min_rows(self) -> int:
+        if not self.cells:
+            return self.nrows
+        return max(c.geometry.row + c.geometry.row_span for c in self.cells)
+
+    @property
+    def min_cols(self) -> int:
+        if not self.cells:
+            return self.ncols
+        return max(c.geometry.col + c.geometry.col_span for c in self.cells)
+
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "GridSpec":
+        cells = tuple(
+            GridCellSpec(
+                geometry=CellGeometry(**cell.get("geometry", {"row": 0, "col": 0})),
+                workflow=cell.get("workflow", ""),
+                output=cell.get("output", ""),
+                source=cell.get("source", ""),
+                plotter=cell.get("plotter", ""),
+                title=cell.get("title", ""),
+                params=GridCellSpec.freeze_params(cell.get("params")),
+            )
+            for cell in raw.get("cells", [])
+        )
+        return cls(
+            name=raw["name"],
+            title=raw.get("title", raw["name"]),
+            description=raw.get("description", ""),
+            nrows=raw.get("nrows", 2),
+            ncols=raw.get("ncols", 2),
+            cells=cells,
+            enabled=raw.get("enabled", True),
+        )
+
+
+def load_raw_grid_templates(instrument: str) -> list[dict[str, Any]]:
+    """Raw grid template dicts from the instrument package, unvalidated."""
+    templates: list[dict[str, Any]] = []
+    try:
+        package = f"esslivedata_tpu.config.instruments.{instrument}"
+        templates_dir = resources.files(package).joinpath("grid_templates")
+        if not templates_dir.is_dir():
+            return templates
+        for item in templates_dir.iterdir():
+            if item.is_file() and item.name.endswith(".yaml"):
+                try:
+                    raw = yaml.safe_load(item.read_text())
+                except Exception:
+                    logger.exception("Failed to load template %s", item.name)
+                    continue
+                if isinstance(raw, dict):
+                    templates.append(raw)
+                else:
+                    logger.warning("Template %s is not a dict", item.name)
+    except ModuleNotFoundError:
+        logger.warning("Instrument package not found: %s", instrument)
+    return templates
+
+
+def load_grid_templates(instrument: str) -> list[GridSpec]:
+    """Validated GridSpecs for an instrument; malformed templates skipped."""
+    specs: list[GridSpec] = []
+    for raw in load_raw_grid_templates(instrument):
+        try:
+            specs.append(GridSpec.from_dict(raw))
+        except Exception:
+            logger.exception(
+                "Malformed grid template %r for %s", raw.get("name"), instrument
+            )
+    return specs

@@ -1,0 +1,4 @@
+"""Built-in instrument packages (reference: config/instruments/{dummy,loki,
+dream,bifrost,...}). Each package registers its Instrument + workflow specs
+at import; heavy factories attach via ``Instrument.load_factories()``.
+"""

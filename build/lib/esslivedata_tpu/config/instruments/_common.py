@@ -1,0 +1,166 @@
+"""Shared spec-registration helpers for instrument packages.
+
+Parity with the reference's per-workflow spec helper modules
+(workflows/monitor_workflow_specs.py, detector_view_specs.py,
+timeseries_workflow_specs.py): instruments declare *what* they expose,
+these helpers own the standard outputs/param models so every instrument's
+monitor histogram (etc.) looks the same to the dashboard.
+"""
+
+from __future__ import annotations
+
+from ...config.workflow_spec import OutputSpec, WorkflowSpec
+from ...workflows.monitor_workflow import MonitorParams
+from ...workflows.workflow_factory import SpecHandle, workflow_registry
+from .. import instrument as _instrument_mod
+
+__all__ = [
+    "detector_view_outputs",
+    "register_monitor_spec",
+    "register_parsed_catalog",
+    "register_timeseries_spec",
+]
+
+
+def register_parsed_catalog(
+    instrument: "_instrument_mod.Instrument",
+    parsed: dict,
+) -> None:
+    """Merge a generated f144 registry (ADR 0009) into the instrument's
+    stream catalog: unauthorized topics dropped, entries auto-named,
+    motorised devices detected and merged (stream.name_streams).
+
+    Hand-declared streams are protected: a parsed entry may *refine* an
+    identical declaration (same topic/source/units — it contributes its
+    nexus_path, e.g. the chopper PVs instruments declare via
+    chopper_pv_streams), but a parsed entry that would silently repoint an
+    existing stream name at a different wire identity raises instead —
+    that is how chopper routing breaks (a renamed PV in the geometry file
+    must be reconciled in specs, not auto-shadowed).
+    """
+    from ...config.stream import filter_authorized_streams, name_streams
+
+    incoming = name_streams(filter_authorized_streams(parsed))
+    for name, stream in incoming.items():
+        existing = instrument.streams.get(name)
+        if existing is not None and (
+            existing.topic,
+            existing.source,
+            getattr(existing, "units", None),
+        ) != (stream.topic, stream.source, getattr(stream, "units", None)):
+            raise ValueError(
+                f"Parsed catalog entry {name!r} "
+                f"(topic={stream.topic!r}, source={stream.source!r}) "
+                f"conflicts with the declared stream "
+                f"(topic={existing.topic!r}, source={existing.source!r}); "
+                "reconcile the declaration in specs.py with the geometry "
+                "artifact instead of shadowing it"
+            )
+        instrument.streams[name] = stream
+
+
+def detector_view_outputs() -> dict[str, OutputSpec]:
+    return {
+        "image_current": OutputSpec(title="Image (window)"),
+        "image_cumulative": OutputSpec(
+            title="Image (since start)", view="since_start"
+        ),
+        "spectrum_current": OutputSpec(title="TOA spectrum"),
+        "spectrum_cumulative": OutputSpec(
+            title="TOA spectrum (since start)", view="since_start"
+        ),
+        "counts_current": OutputSpec(title="Counts (window)"),
+        "counts_cumulative": OutputSpec(
+            title="Counts (since start)", view="since_start"
+        ),
+        "counts_in_range_current": OutputSpec(title="Counts in range (window)"),
+        "counts_in_range_cumulative": OutputSpec(
+            title="Counts in range (since start)", view="since_start"
+        ),
+    }
+
+
+def register_monitor_spec(
+    instrument: "_instrument_mod.Instrument",
+) -> SpecHandle:
+    """Standard monitor TOA-histogram spec over all declared monitors,
+    with cumulative counts exposed as a NICOS derived device (ADR 0006)."""
+    return workflow_registry.register_spec(
+        WorkflowSpec(
+            instrument=instrument.name,
+            namespace="monitor_data",
+            name="histogram",
+            title="Monitor TOA histogram",
+            source_names=instrument.monitor_names,
+            params_model=MonitorParams,
+            # Per-monitor position logs ("{monitor}_position"), only for
+            # monitors whose instrument actually declares one — fixed
+            # monitors contribute nothing, so no dead routing entries.
+            optional_context_keys=monitor_position_streams(instrument),
+            outputs={
+                "current": OutputSpec(title="Monitor (window)"),
+                "cumulative": OutputSpec(
+                    title="Monitor (since start)", view="since_start"
+                ),
+                "counts_current": OutputSpec(title="Counts (window)"),
+                "counts_cumulative": OutputSpec(
+                    title="Counts (since start)", view="since_start"
+                ),
+            },
+            device_outputs={
+                "counts_cumulative": "monitor_counts_{source_name}"
+            },
+        )
+    )
+
+
+def register_timeseries_spec(
+    instrument: "_instrument_mod.Instrument",
+) -> SpecHandle:
+    """Standard per-log republish spec over all declared log streams.
+
+    Catalog sources are the *post-synthesis* stream set a job can actually
+    see: motorised-device substreams (RBV/VAL/DMOV) are claimed and merged
+    by the DeviceSynthesizer (ADR 0001), so the spec lists the synthesised
+    Device streams plus the f144 streams no device claims.
+    """
+    claimed: set[str] = set()
+    for dev in instrument.devices.values():
+        claimed.update(dev.substream_names)
+    sources = sorted(instrument.log_sources) + sorted(
+        name
+        for name, s in instrument.streams.items()
+        if (s.writer_module == "f144" and name not in claimed)
+        or s.writer_module == "device"
+    )
+    return workflow_registry.register_spec(
+        WorkflowSpec(
+            instrument=instrument.name,
+            namespace="timeseries",
+            name="log",
+            title="Log timeseries",
+            source_names=sources,
+            reset_on_run_transition=False,
+        )
+    )
+
+
+def monitor_position_streams(
+    instrument: "_instrument_mod.Instrument",
+) -> list[str]:
+    """Streams named ``{monitor}_position`` that the instrument declares
+    (reference geometry-signal reset-on-move, monitor_workflow.py:36)."""
+    return [
+        f"{m}_position"
+        for m in instrument.monitor_names
+        if f"{m}_position" in instrument.log_sources
+    ]
+
+
+def monitor_streams_from_aux(aux_source_names) -> set[str]:
+    """The monitor-stream set a reduction factory feeds its workflow:
+    the job's resolved 'monitor' aux binding, or empty when the start
+    command omitted it (normalization then divides by 1)."""
+    if aux_source_names and "monitor" in aux_source_names:
+        return {aux_source_names["monitor"]}
+    return set()

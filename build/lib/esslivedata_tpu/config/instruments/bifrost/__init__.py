@@ -1,0 +1,8 @@
+"""BIFROST (indirect-geometry spectrometer): 9 analyzer-triplet banks with
+a merged detector stream and mesh-shardable multi-bank reduction
+(reference: config/instruments/bifrost; BASELINE config 3)."""
+
+from . import specs  # noqa: F401
+from .specs import INSTRUMENT
+
+__all__ = ["INSTRUMENT"]

@@ -1,0 +1,64 @@
+"""BIFROST factories."""
+
+from __future__ import annotations
+
+from ....workflows.elastic_qmap import ElasticQMapWorkflow
+from ....workflows.multibank import MultiBankViewWorkflow
+from ....workflows.qe_spectroscopy import QESpectroscopyWorkflow
+from ....workflows.ratemeter import RatemeterWorkflow
+from .._common import monitor_streams_from_aux
+from .specs import (
+    BANK_DETECTOR_NUMBERS,
+    ELASTIC_QMAP_HANDLE,
+    MULTIBANK_HANDLE,
+    QE_HANDLE,
+    RATEMETER_HANDLE,
+    analyzer_geometry,
+)
+
+
+@MULTIBANK_HANDLE.attach_factory
+def make_multibank(*, source_name: str, params) -> MultiBankViewWorkflow:
+    return MultiBankViewWorkflow(
+        bank_detector_numbers=BANK_DETECTOR_NUMBERS, params=params
+    )
+
+
+@QE_HANDLE.attach_factory
+def make_qe_map(
+    *, source_name: str, params, aux_source_names=None
+) -> QESpectroscopyWorkflow:
+    geometry = analyzer_geometry()
+    # |Q| needs no azimuth; the elastic component map does.
+    geometry.pop("azimuth")
+    return QESpectroscopyWorkflow(
+        **geometry,
+        params=params,
+        primary_stream=source_name,
+        monitor_streams=monitor_streams_from_aux(aux_source_names),
+    )
+
+
+@ELASTIC_QMAP_HANDLE.attach_factory
+def make_elastic_qmap(
+    *, source_name: str, params, aux_source_names=None
+) -> ElasticQMapWorkflow:
+    geometry = analyzer_geometry()
+    return ElasticQMapWorkflow(
+        **geometry,
+        params=params,
+        primary_stream=source_name,
+        monitor_streams=monitor_streams_from_aux(aux_source_names),
+    )
+
+
+@RATEMETER_HANDLE.attach_factory
+def make_ratemeter(*, source_name: str, params) -> RatemeterWorkflow:
+    geometry = analyzer_geometry()
+    return RatemeterWorkflow(
+        two_theta=geometry["two_theta"],
+        ef_mev=geometry["ef_mev"],
+        pixel_ids=geometry["pixel_ids"],
+        params=params,
+        primary_stream=source_name,
+    )

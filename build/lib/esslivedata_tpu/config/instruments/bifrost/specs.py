@@ -1,0 +1,210 @@
+"""BIFROST declaration: 9 triplet banks, merged into one logical stream.
+
+The real instrument's banks come from its NeXus geometry; here each of the
+9 analyzer triplets is a 100x30 pixel bank with contiguous detector-number
+blocks — the right topology for the merged-stream + bank-sharded reduction
+path. Q-E per-analyzer rebinning (the full
+spectrometer physics) runs on the same kernel family via a precompiled
+(pixel, toa) -> (Q, E)-bin map — see QE_HANDLE below and
+workflows/qe_spectroscopy.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ....config.instrument import (
+    DetectorConfig,
+    Instrument,
+    MonitorConfig,
+    instrument_registry,
+)
+from ....config.workflow_spec import OutputSpec, WorkflowSpec
+from ....workflows.elastic_qmap import ElasticQMapParams
+from ....workflows.multibank import MultiBankParams
+from ....workflows.qe_spectroscopy import QESpectroscopyParams
+from ....workflows.ratemeter import RatemeterParams
+from ....workflows.workflow_factory import workflow_registry
+from .._common import register_monitor_spec, register_parsed_catalog
+
+N_BANKS = 9
+BANK_NY, BANK_NX = 100, 30
+PIXELS_PER_BANK = BANK_NY * BANK_NX
+
+from .streams_parsed import PARSED_STREAMS
+
+INSTRUMENT = Instrument(
+    name="bifrost",
+    merge_detectors=True,
+    _factories_module="esslivedata_tpu.config.instruments.bifrost.factories",
+)
+
+BANK_DETECTOR_NUMBERS: dict[str, np.ndarray] = {}
+for b in range(N_BANKS):
+    start = 1 + b * PIXELS_PER_BANK
+    det = np.arange(start, start + PIXELS_PER_BANK).reshape(BANK_NY, BANK_NX)
+    name = f"triplet_{b}"
+    BANK_DETECTOR_NUMBERS[name] = det
+    INSTRUMENT.add_detector(
+        DetectorConfig(
+            name=name,
+            source_name=f"bifrost_{name}",
+            detector_number=det,
+            projection="logical",
+        )
+    )
+register_parsed_catalog(INSTRUMENT, PARSED_STREAMS)
+INSTRUMENT.add_monitor(
+    MonitorConfig(name="monitor_1", source_name="bifrost_mon_1")
+)
+instrument_registry.register(INSTRUMENT)
+
+# The merged stream name all banks adapt onto (merge_detectors routing).
+MERGED_STREAM = "detector"
+
+MULTIBANK_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="bifrost",
+        namespace="spectrometer",
+        name="bank_overview",
+        title="9-bank overview (mesh-shardable)",
+        source_names=[MERGED_STREAM],
+        # Consumes detector events: hosted by the detector service even
+        # though its display namespace is 'spectrometer'.
+        service="detector_data",
+        params_model=MultiBankParams,
+        outputs={
+            "bank_spectra_current": OutputSpec(title="Per-bank TOA spectra"),
+            "bank_spectra_cumulative": OutputSpec(
+                title="Per-bank TOA spectra (since start)", view="since_start"
+            ),
+            "bank_counts_current": OutputSpec(title="Per-bank counts"),
+            "counts_cumulative": OutputSpec(
+                title="Total counts (since start)", view="since_start"
+            ),
+        },
+    )
+)
+
+MONITOR_HANDLE = register_monitor_spec(INSTRUMENT)
+
+
+def analyzer_geometry() -> dict[str, np.ndarray]:
+    """Synthetic per-pixel analyzer geometry for the 9-triplet layout.
+
+    Placeholder physics in the spirit of the instrument (real
+    deployments regenerate from the facility geometry file): the nine
+    wedges fan over scattering angles 15°-150° with the 30 detector
+    columns spreading ±4° inside each wedge, and the 100 rows split
+    into BIFROST's five analyzer energies (2.7-5.0 meV) with the
+    secondary flight path growing with the analyzer radius.
+    """
+    ef_levels = np.array([2.7, 3.2, 3.8, 4.4, 5.0])
+    rows_per_ef = BANK_NY // len(ef_levels)
+    two_theta = np.empty(N_BANKS * PIXELS_PER_BANK)
+    azimuth = np.empty_like(two_theta)
+    ef = np.empty_like(two_theta)
+    l2 = np.empty_like(two_theta)
+    pixel_ids = np.empty(two_theta.shape, dtype=np.int64)
+    for b in range(N_BANKS):
+        bank_center = np.deg2rad(15.0 + b * (135.0 / (N_BANKS - 1)))
+        col_offset = np.deg2rad(np.linspace(-4.0, 4.0, BANK_NX))
+        row_ef = ef_levels[
+            np.minimum(np.arange(BANK_NY) // rows_per_ef, len(ef_levels) - 1)
+        ]
+        sl = slice(b * PIXELS_PER_BANK, (b + 1) * PIXELS_PER_BANK)
+        two_theta[sl] = np.repeat(
+            bank_center + col_offset[None, :], BANK_NY, axis=0
+        ).reshape(-1)
+        # Small out-of-plane fan across the rows of each triplet: the
+        # tubes have vertical extent, giving the elastic Qy axis
+        # structure (rows near the arc midplane sit near phi = 0).
+        azimuth[sl] = np.repeat(
+            np.deg2rad(np.linspace(-2.0, 2.0, BANK_NY))[:, None],
+            BANK_NX,
+            axis=1,
+        ).reshape(-1)
+        ef[sl] = np.repeat(row_ef[:, None], BANK_NX, axis=1).reshape(-1)
+        l2[sl] = 1.2 + 0.25 * np.repeat(
+            np.minimum(np.arange(BANK_NY) // rows_per_ef, 4)[:, None],
+            BANK_NX,
+            axis=1,
+        ).reshape(-1)
+        pixel_ids[sl] = BANK_DETECTOR_NUMBERS[f"triplet_{b}"].reshape(-1)
+    return {
+        "two_theta": two_theta,
+        "azimuth": azimuth,
+        "ef_mev": ef,
+        "l2": l2,
+        "pixel_ids": pixel_ids,
+    }
+
+
+QE_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="bifrost",
+        namespace="spectrometer",
+        name="qe_map",
+        title="S(Q, E) map (indirect-geometry rebinning)",
+        source_names=[MERGED_STREAM],
+        service="data_reduction",
+        aux_source_names={"monitor": ["monitor_1"]},
+        params_model=QESpectroscopyParams,
+        outputs={
+            "sqw_current": OutputSpec(title="S(Q, E) — window"),
+            "sqw_cumulative": OutputSpec(
+                title="S(Q, E) — since start", view="since_start"
+            ),
+            "sqw_normalized": OutputSpec(
+                title="S(Q, E) / monitor", view="since_start"
+            ),
+            "counts_current": OutputSpec(title="Events binned"),
+            "monitor_counts_current": OutputSpec(title="Monitor counts"),
+        },
+    )
+)
+
+
+ELASTIC_QMAP_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="bifrost",
+        namespace="spectrometer",
+        name="elastic_qmap",
+        title="Elastic Q map",
+        source_names=[MERGED_STREAM],
+        service="data_reduction",
+        aux_source_names={"monitor": ["monitor_1"]},
+        params_model=ElasticQMapParams,
+        outputs={
+            "qmap_current": OutputSpec(title="Elastic Q map — window"),
+            "qmap_cumulative": OutputSpec(
+                title="Elastic Q map — since start", view="since_start"
+            ),
+            "qmap_normalized": OutputSpec(
+                title="Elastic Q map / monitor", view="since_start"
+            ),
+            "counts_current": OutputSpec(title="Elastic events binned"),
+        },
+    )
+)
+
+RATEMETER_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="bifrost",
+        namespace="spectrometer",
+        name="detector_ratemeter",
+        title="Detector ratemeter",
+        source_names=[MERGED_STREAM],
+        service="detector_data",
+        params_model=RatemeterParams,
+        outputs={
+            "detector_region_counts": OutputSpec(
+                title="Detector region counts (window)"
+            ),
+            "detector_region_counts_cumulative": OutputSpec(
+                title="Detector region counts (since start)",
+                view="since_start",
+            ),
+        },
+    )
+)

@@ -1,0 +1,76 @@
+"""Generated f144 stream registry — do not edit.
+
+Regenerate: python scripts/generate_instrument_artifacts.py
+Source artifact: geometry-bifrost-<date>.nxs (synthesized)
+"""
+
+from esslivedata_tpu.config.stream import F144Stream
+
+# (nexus_path, source, topic, units)
+_ROWS: tuple[tuple[str, str, str, str | None], ...] = (
+    ('/entry/analyzer_env/temperature_1', 'BIFR-Ana:Tmp-TIC-001', 'bifrost_sample_env', 'K'),
+    ('/entry/analyzer_env/temperature_2', 'BIFR-Ana:Tmp-TIC-002', 'bifrost_sample_env', 'K'),
+    ('/entry/analyzer_env/temperature_3', 'BIFR-Ana:Tmp-TIC-003', 'bifrost_sample_env', 'K'),
+    ('/entry/analyzer_env/temperature_4', 'BIFR-Ana:Tmp-TIC-004', 'bifrost_sample_env', 'K'),
+    ('/entry/analyzer_env/temperature_5', 'BIFR-Ana:Tmp-TIC-005', 'bifrost_sample_env', 'K'),
+    ('/entry/analyzer_env/temperature_6', 'BIFR-Ana:Tmp-TIC-006', 'bifrost_sample_env', 'K'),
+    ('/entry/analyzer_env/temperature_7', 'BIFR-Ana:Tmp-TIC-007', 'bifrost_sample_env', 'K'),
+    ('/entry/analyzer_env/temperature_8', 'BIFR-Ana:Tmp-TIC-008', 'bifrost_sample_env', 'K'),
+    ('/entry/analyzer_env/temperature_9', 'BIFR-Ana:Tmp-TIC-009', 'bifrost_sample_env', 'K'),
+    ('/entry/instrument/analyzer_1/goniometer/idle_flag', 'BIFR-Ana1:MC-RotX-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/analyzer_1/goniometer/target_value', 'BIFR-Ana1:MC-RotX-01:Mtr.VAL', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_1/goniometer/value', 'BIFR-Ana1:MC-RotX-01:Mtr.RBV', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_2/goniometer/idle_flag', 'BIFR-Ana2:MC-RotX-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/analyzer_2/goniometer/target_value', 'BIFR-Ana2:MC-RotX-01:Mtr.VAL', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_2/goniometer/value', 'BIFR-Ana2:MC-RotX-01:Mtr.RBV', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_3/goniometer/idle_flag', 'BIFR-Ana3:MC-RotX-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/analyzer_3/goniometer/target_value', 'BIFR-Ana3:MC-RotX-01:Mtr.VAL', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_3/goniometer/value', 'BIFR-Ana3:MC-RotX-01:Mtr.RBV', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_4/goniometer/idle_flag', 'BIFR-Ana4:MC-RotX-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/analyzer_4/goniometer/target_value', 'BIFR-Ana4:MC-RotX-01:Mtr.VAL', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_4/goniometer/value', 'BIFR-Ana4:MC-RotX-01:Mtr.RBV', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_5/goniometer/idle_flag', 'BIFR-Ana5:MC-RotX-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/analyzer_5/goniometer/target_value', 'BIFR-Ana5:MC-RotX-01:Mtr.VAL', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_5/goniometer/value', 'BIFR-Ana5:MC-RotX-01:Mtr.RBV', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_6/goniometer/idle_flag', 'BIFR-Ana6:MC-RotX-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/analyzer_6/goniometer/target_value', 'BIFR-Ana6:MC-RotX-01:Mtr.VAL', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_6/goniometer/value', 'BIFR-Ana6:MC-RotX-01:Mtr.RBV', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_7/goniometer/idle_flag', 'BIFR-Ana7:MC-RotX-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/analyzer_7/goniometer/target_value', 'BIFR-Ana7:MC-RotX-01:Mtr.VAL', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_7/goniometer/value', 'BIFR-Ana7:MC-RotX-01:Mtr.RBV', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_8/goniometer/idle_flag', 'BIFR-Ana8:MC-RotX-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/analyzer_8/goniometer/target_value', 'BIFR-Ana8:MC-RotX-01:Mtr.VAL', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_8/goniometer/value', 'BIFR-Ana8:MC-RotX-01:Mtr.RBV', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_9/goniometer/idle_flag', 'BIFR-Ana9:MC-RotX-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/analyzer_9/goniometer/target_value', 'BIFR-Ana9:MC-RotX-01:Mtr.VAL', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/analyzer_9/goniometer/value', 'BIFR-Ana9:MC-RotX-01:Mtr.RBV', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/frame_overlap_chopper/delay', 'BIFR-Chop:FOC-01:Delay', 'bifrost_choppers', 'ns'),
+    ('/entry/instrument/frame_overlap_chopper/phase', 'BIFR-Chop:FOC-01:Phs', 'bifrost_choppers', 'deg'),
+    ('/entry/instrument/frame_overlap_chopper/rotation_speed', 'BIFR-Chop:FOC-01:Spd', 'bifrost_choppers', 'Hz'),
+    ('/entry/instrument/frame_overlap_chopper/rotation_speed_setpoint', 'BIFR-Chop:FOC-01:SpdSet', 'bifrost_choppers', 'Hz'),
+    ('/entry/instrument/pulse_shaping_chopper/delay', 'BIFR-Chop:PSC-01:Delay', 'bifrost_choppers', 'ns'),
+    ('/entry/instrument/pulse_shaping_chopper/phase', 'BIFR-Chop:PSC-01:Phs', 'bifrost_choppers', 'deg'),
+    ('/entry/instrument/pulse_shaping_chopper/rotation_speed', 'BIFR-Chop:PSC-01:Spd', 'bifrost_choppers', 'Hz'),
+    ('/entry/instrument/pulse_shaping_chopper/rotation_speed_setpoint', 'BIFR-Chop:PSC-01:SpdSet', 'bifrost_choppers', 'Hz'),
+    ('/entry/instrument/sample_stage/omega/idle_flag', 'BIFR-Smpl:MC-RotZ-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/omega/target_value', 'BIFR-Smpl:MC-RotZ-01:Mtr.VAL', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/sample_stage/omega/value', 'BIFR-Smpl:MC-RotZ-01:Mtr.RBV', 'bifrost_motion', 'deg'),
+    ('/entry/instrument/sample_stage/x/idle_flag', 'BIFR-Smpl:MC-LinX-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/x/target_value', 'BIFR-Smpl:MC-LinX-01:Mtr.VAL', 'bifrost_motion', 'mm'),
+    ('/entry/instrument/sample_stage/x/value', 'BIFR-Smpl:MC-LinX-01:Mtr.RBV', 'bifrost_motion', 'mm'),
+    ('/entry/instrument/sample_stage/y/idle_flag', 'BIFR-Smpl:MC-LinY-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/y/target_value', 'BIFR-Smpl:MC-LinY-01:Mtr.VAL', 'bifrost_motion', 'mm'),
+    ('/entry/instrument/sample_stage/y/value', 'BIFR-Smpl:MC-LinY-01:Mtr.RBV', 'bifrost_motion', 'mm'),
+    ('/entry/instrument/sample_stage/z/idle_flag', 'BIFR-Smpl:MC-LinZ-01:Mtr.DMOV', 'bifrost_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/z/target_value', 'BIFR-Smpl:MC-LinZ-01:Mtr.VAL', 'bifrost_motion', 'mm'),
+    ('/entry/instrument/sample_stage/z/value', 'BIFR-Smpl:MC-LinZ-01:Mtr.RBV', 'bifrost_motion', 'mm'),
+    ('/entry/sample/magnetic_field', 'BIFROST-SE:Mag-PSU-101', 'bifrost_sample_env', 'T'),
+    ('/entry/sample/pressure', 'BIFROST-SE:Prs-PIC-101', 'bifrost_sample_env', 'bar'),
+    ('/entry/sample/temperature_1', 'BIFROST-SE:Tmp-TIC-101', 'bifrost_sample_env', 'K'),
+    ('/entry/sample/temperature_2', 'BIFROST-SE:Tmp-TIC-102', 'bifrost_sample_env', 'K'),
+)
+
+PARSED_STREAMS: dict[str, F144Stream] = {
+    path: F144Stream(nexus_path=path, source=source, topic=topic, units=units)
+    for path, source, topic, units in _ROWS
+}

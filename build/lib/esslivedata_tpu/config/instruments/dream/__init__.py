@@ -1,0 +1,8 @@
+"""DREAM: powder diffractometer with voxel (wire/module/segment/strip/
+counter) detectors and N-d logical views (reference:
+config/instruments/dream)."""
+
+from . import specs  # noqa: F401  (registers instrument + specs on import)
+from .specs import INSTRUMENT
+
+__all__ = ["INSTRUMENT"]

@@ -1,0 +1,103 @@
+"""DREAM factories: N-d view projection tables + kernels, built lazily."""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from ....workflows.detector_view.projectors import (
+    NdLogicalView,
+    ProjectionTable,
+    project_logical_nd,
+)
+from ....workflows.detector_view.workflow import DetectorViewWorkflow
+from ....workflows.monitor_workflow import MonitorWorkflow
+from ....workflows.powder import (
+    PowderDiffractionWorkflow,
+    PowderVanadiumWorkflow,
+)
+from ....workflows.timeseries import TimeseriesWorkflow
+from ....workflows.wavelength_lut_workflow import WavelengthLutWorkflow
+from .._common import monitor_streams_from_aux
+from .specs import (
+    BANK_SIZES,
+    POWDER_HANDLE,
+    POWDER_VANADIUM_HANDLE,
+    BANK_VIEW_HANDLE,
+    CHOPPER_GEOMETRY,
+    INSTRUMENT,
+    MANTLE_VIEW_HANDLES,
+    MANTLE_VIEWS,
+    MONITOR_HANDLE,
+    TIMESERIES_HANDLE,
+    WAVELENGTH_LUT_HANDLE,
+    powder_geometry,
+)
+
+
+@lru_cache(maxsize=None)
+def _mantle_projection(view_name: str) -> ProjectionTable:
+    det = INSTRUMENT.detectors["mantle_detector"]
+    return project_logical_nd(det.detector_number, MANTLE_VIEWS[view_name])
+
+
+@lru_cache(maxsize=None)
+def _bank_projection(bank: str) -> ProjectionTable:
+    """Generic strip-vs-rest view for the non-mantle banks."""
+    sizes = BANK_SIZES[bank]
+    others = tuple(d for d in sizes if d != "strip")
+    view = NdLogicalView(sizes=sizes, y=("strip",), x=others)
+    return project_logical_nd(
+        INSTRUMENT.detectors[bank].detector_number, view
+    )
+
+
+for _view_name, _handle in MANTLE_VIEW_HANDLES.items():
+
+    def _make_mantle(*, source_name: str, params, _v=_view_name):  # noqa: ARG001
+        return DetectorViewWorkflow(
+            projection=_mantle_projection(_v), params=params
+        )
+
+    _handle.attach_factory(_make_mantle)
+
+
+@BANK_VIEW_HANDLE.attach_factory
+def make_bank_view(*, source_name: str, params) -> DetectorViewWorkflow:
+    return DetectorViewWorkflow(
+        projection=_bank_projection(source_name), params=params
+    )
+
+
+@MONITOR_HANDLE.attach_factory
+def make_monitor(*, source_name: str, params) -> MonitorWorkflow:  # noqa: ARG001
+    return MonitorWorkflow(params=params)
+
+
+@WAVELENGTH_LUT_HANDLE.attach_factory
+def make_wavelength_lut(*, source_name: str, params) -> WavelengthLutWorkflow:  # noqa: ARG001
+    return WavelengthLutWorkflow(choppers=CHOPPER_GEOMETRY, params=params)
+
+
+@TIMESERIES_HANDLE.attach_factory
+def make_timeseries(*, source_name: str, params) -> TimeseriesWorkflow:  # noqa: ARG001
+    return TimeseriesWorkflow()
+
+
+def _make_powder_factory(workflow_cls):
+    def factory(*, source_name: str, params, aux_source_names=None):
+        return workflow_cls(
+            **powder_geometry(source_name),
+            params=params,
+            primary_stream=source_name,
+            monitor_streams=monitor_streams_from_aux(aux_source_names),
+        )
+
+    return factory
+
+
+make_powder = POWDER_HANDLE.attach_factory(
+    _make_powder_factory(PowderDiffractionWorkflow)
+)
+make_powder_vanadium = POWDER_VANADIUM_HANDLE.attach_factory(
+    _make_powder_factory(PowderVanadiumWorkflow)
+)

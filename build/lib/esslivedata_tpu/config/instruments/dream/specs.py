@@ -1,0 +1,321 @@
+"""DREAM instrument declaration + spec registration.
+
+Parity with reference ``config/instruments/dream/specs.py``: five voxel
+detector banks, bunker/cave monitors, five choppers (pulse-shaping pair,
+band, overlap, T0) feeding the wavelength-LUT workflow, and the three
+mantle logical views (front-layer, wire, strip; reference dream/views.py)
+expressed as N-d projection LUTs. Voxel layouts follow the published DREAM
+module structure; exact per-bank NeXus geometry plugs in when artifacts
+are available (same caveat as loki/specs.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ....config.instrument import (
+    DetectorConfig,
+    Instrument,
+    MonitorConfig,
+    instrument_registry,
+)
+from ....config.chopper import chopper_pv_streams
+from ....config.workflow_spec import OutputSpec, WorkflowSpec
+from ....workflows.detector_view.projectors import NdLogicalView
+from ....workflows.detector_view.workflow import DetectorViewParams
+from ....workflows.wavelength_lut_workflow import (
+    ChopperGeometry,
+    WavelengthLutParams,
+    spec_context_keys,
+)
+from ....workflows.powder import PowderDiffractionParams
+from ....workflows.workflow_factory import workflow_registry
+from .._common import (
+    register_parsed_catalog,
+    detector_view_outputs,
+    register_monitor_spec,
+    register_timeseries_spec,
+)
+
+#: Voxel layout per bank (dim name -> size), C-order of detector_number.
+BANK_SIZES: dict[str, dict[str, int]] = {
+    "mantle_detector": {
+        "wire": 32,
+        "module": 5,
+        "segment": 6,
+        "strip": 256,
+        "counter": 2,
+    },
+    "endcap_backward_detector": {
+        "strip": 16,
+        "wire": 16,
+        "module": 11,
+        "segment": 28,
+        "counter": 2,
+    },
+    "endcap_forward_detector": {
+        "strip": 16,
+        "wire": 16,
+        "module": 5,
+        "segment": 28,
+        "counter": 2,
+    },
+    "high_resolution_detector": {
+        "strip": 32,
+        "wire": 16,
+        "module": 3,
+        "segment": 20,
+        "counter": 2,
+    },
+    "sans_detector": {
+        "strip": 32,
+        "wire": 16,
+        "module": 3,
+        "segment": 10,
+        "counter": 2,
+    },
+}
+
+#: The three mantle views of reference dream/views.py, as LUT specs.
+MANTLE_VIEWS: dict[str, NdLogicalView] = {
+    "mantle_front_layer": NdLogicalView(
+        sizes=BANK_SIZES["mantle_detector"],
+        select={"wire": 0},
+        y=("module", "segment", "counter"),
+        x=("strip",),
+    ),
+    "mantle_wire_view": NdLogicalView(
+        sizes=BANK_SIZES["mantle_detector"],
+        y=("wire",),
+        x=("module", "segment", "counter"),
+        # 'strip' omitted -> summed by the scatter.
+    ),
+    "mantle_strip_view": NdLogicalView(
+        sizes=BANK_SIZES["mantle_detector"],
+        y=("strip",),
+        # everything else summed.
+    ),
+}
+
+CHOPPERS = [
+    "pulse_shaping_chopper1",
+    "pulse_shaping_chopper2",
+    "band_chopper",
+    "overlap_chopper",
+    "T0_chopper",
+]
+
+#: Static chopper geometry (distances from moderator; slit spans chosen to
+#: approximate the high-flux configuration).
+CHOPPER_GEOMETRY = [
+    ChopperGeometry(
+        name="pulse_shaping_chopper1",
+        distance_m=6.145,
+        slit_edges_deg=((0.0, 72.0), (180.0, 252.0)),
+    ),
+    ChopperGeometry(
+        name="pulse_shaping_chopper2",
+        distance_m=6.155,
+        slit_edges_deg=((0.0, 72.0), (180.0, 252.0)),
+    ),
+    ChopperGeometry(
+        name="band_chopper", distance_m=9.3, slit_edges_deg=((0.0, 130.0),)
+    ),
+    ChopperGeometry(
+        name="overlap_chopper", distance_m=13.5, slit_edges_deg=((0.0, 150.0),)
+    ),
+    ChopperGeometry(
+        name="T0_chopper", distance_m=8.5, slit_edges_deg=((20.0, 340.0),)
+    ),
+]
+
+
+from .streams_parsed import PARSED_STREAMS
+
+INSTRUMENT = Instrument(
+    name="dream",
+    streams=chopper_pv_streams(CHOPPERS, topic="dream_choppers"),
+    choppers=CHOPPERS,
+    _factories_module="esslivedata_tpu.config.instruments.dream.factories",
+)
+
+# Bank layouts come from the date-resolved NeXus geometry artifact; the
+# declared axis sizes must agree with the file or the spec fails at import
+# (a mismatched geometry file is a deployment error, not a runtime one).
+from ...geometry_store import geometry_path, load_logical_layout  # noqa: E402
+
+_geometry = geometry_path("dream")
+for _bank, _sizes in BANK_SIZES.items():
+    _layout = load_logical_layout(_geometry, _bank)
+    if _layout.shape != tuple(_sizes.values()):
+        raise ValueError(
+            f"DREAM bank {_bank}: geometry file layout {_layout.shape} != "
+            f"declared axis sizes {tuple(_sizes.values())}"
+        )
+    INSTRUMENT.add_detector(
+        DetectorConfig(
+            name=_bank,
+            source_name=f"dream_{_bank}",
+            detector_number=_layout,
+            projection="logical",
+        )
+    )
+
+INSTRUMENT.add_monitor(
+    MonitorConfig(name="monitor_bunker", source_name="dream_mon_bunker")
+)
+INSTRUMENT.add_monitor(
+    MonitorConfig(name="monitor_cave", source_name="dream_mon_cave")
+)
+INSTRUMENT.add_log("sample_temperature", "dream_temp_sample")
+# WFM subframe emission-time calibration (ns), published by the chopper
+# control layer; the powder workflow consumes it as OPTIONAL context.
+INSTRUMENT.add_log("emission_offset", "dream_wfm_t0")
+register_parsed_catalog(INSTRUMENT, PARSED_STREAMS)
+instrument_registry.register(INSTRUMENT)
+
+
+#: One detector-view spec per mantle view, plus a generic per-bank view.
+MANTLE_VIEW_HANDLES = {
+    view_name: workflow_registry.register_spec(
+        WorkflowSpec(
+            instrument="dream",
+            namespace="detector_view",
+            name=view_name,
+            title=view_name.replace("_", " ").title(),
+            source_names=["mantle_detector"],
+            params_model=DetectorViewParams,
+            outputs=detector_view_outputs(),
+        )
+    )
+    for view_name in MANTLE_VIEWS
+}
+
+BANK_VIEW_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="dream",
+        namespace="detector_view",
+        name="bank_view",
+        title="Bank strip/position view",
+        source_names=sorted(set(BANK_SIZES) - {"mantle_detector"}),
+        params_model=DetectorViewParams,
+        outputs=detector_view_outputs(),
+    )
+)
+
+MONITOR_HANDLE = register_monitor_spec(INSTRUMENT)
+
+WAVELENGTH_LUT_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="dream",
+        namespace="diagnostics",
+        name="wavelength_lut",
+        title="TOF->wavelength lookup table",
+        source_names=["chopper_cascade"],
+        params_model=WavelengthLutParams,
+        context_keys=spec_context_keys(CHOPPER_GEOMETRY),
+        reset_on_run_transition=False,
+        outputs={
+            "wavelength_lut": OutputSpec(title="Wavelength LUT"),
+            "wavelength_bands": OutputSpec(title="Wavelength bands"),
+        },
+    )
+)
+
+TIMESERIES_HANDLE = register_timeseries_spec(INSTRUMENT)
+
+
+def powder_geometry(bank: str) -> dict[str, np.ndarray]:
+    """Synthetic per-pixel diffraction geometry for one bank.
+
+    Placeholder in the spirit of the instrument (real deployments read
+    pixel positions from the facility geometry file): the mantle wraps
+    scattering angles 32°-148° along its strip axis; endcap banks sit
+    forward/backward of the sample. Flight path = 76.55 m moderator->
+    sample plus a secondary path growing modestly across the bank.
+    """
+    layout = INSTRUMENT.detectors[bank].detector_number
+    ids = layout.reshape(-1)
+    n = ids.size
+    # The scattering angle varies along the STRIP axis (the mantle's
+    # cylinder axis direction); wire depth and module/segment position
+    # leave it nearly unchanged. Use each pixel's strip coordinate, not
+    # the flattened index (which walks the wire/depth axis first).
+    sizes = BANK_SIZES[bank]
+    shape = tuple(sizes.values())
+    strip_axis = list(sizes).index("strip")
+    strip_idx = np.unravel_index(np.arange(n), shape)[strip_axis]
+    frac = strip_idx / max(shape[strip_axis] - 1, 1)
+    if bank == "mantle_detector":
+        two_theta = np.deg2rad(32.0 + 116.0 * frac)
+    elif "backward" in bank:
+        two_theta = np.deg2rad(130.0 + 40.0 * frac)
+    else:
+        two_theta = np.deg2rad(10.0 + 35.0 * frac)
+    # Secondary flight path grows modestly with wire depth.
+    wire_axis = list(sizes).index("wire")
+    wire_idx = np.unravel_index(np.arange(n), shape)[wire_axis]
+    l_total = 76.55 + 1.1 + 0.02 * wire_idx
+    return {
+        "two_theta": two_theta,
+        "l_total": l_total,
+        "pixel_ids": ids.astype(np.int64),
+    }
+
+
+def _powder_outputs() -> dict[str, OutputSpec]:
+    return {
+        "dspacing_current": OutputSpec(title="I(d) — window"),
+        "dspacing_cumulative": OutputSpec(
+            title="I(d) — since start", view="since_start"
+        ),
+        "dspacing_normalized": OutputSpec(
+            title="I(d) / monitor", view="since_start"
+        ),
+        "dspacing_two_theta": OutputSpec(
+            title="I(d, 2theta)", view="since_start"
+        ),
+        "focussed_tof": OutputSpec(
+            title="Focussed spectrum (TOF axis)", view="since_start"
+        ),
+        "counts_current": OutputSpec(title="Events binned"),
+        "monitor_counts_current": OutputSpec(title="Monitor counts"),
+    }
+
+
+def _powder_spec(name: str, title: str, outputs: dict) -> WorkflowSpec:
+    return WorkflowSpec(
+        instrument="dream",
+        namespace="powder",
+        name=name,
+        title=title,
+        source_names=list(BANK_SIZES),
+        service="data_reduction",
+        aux_source_names={"monitor": ["monitor_bunker", "monitor_cave"]},
+        # Delivered when the facility publishes it; never gated on — the
+        # static toa_offset_ns param is the fallback.
+        optional_context_keys=["emission_offset"],
+        params_model=PowderDiffractionParams,
+        outputs=outputs,
+    )
+
+
+POWDER_HANDLE = workflow_registry.register_spec(
+    _powder_spec(
+        "dspacing", "I(d) powder pattern (Bragg rebinning)", _powder_outputs()
+    )
+)
+
+
+POWDER_VANADIUM_HANDLE = workflow_registry.register_spec(
+    _powder_spec(
+        "dspacing_vanadium",
+        "I(d) with vanadium normalization",
+        {
+            **_powder_outputs(),
+            "intensity_dspacing": OutputSpec(
+                title="I(d) vanadium-corrected", view="since_start"
+            ),
+        },
+    )
+)

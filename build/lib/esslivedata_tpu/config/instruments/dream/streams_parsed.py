@@ -1,0 +1,80 @@
+"""Generated f144 stream registry — do not edit.
+
+Regenerate: python scripts/generate_instrument_artifacts.py
+Source artifact: geometry-dream-<date>.nxs (synthesized)
+"""
+
+from esslivedata_tpu.config.stream import F144Stream
+
+# (nexus_path, source, topic, units)
+_ROWS: tuple[tuple[str, str, str, str | None], ...] = (
+    ('/entry/instrument/T0_chopper/delay', 'T0_chopper:Delay', 'dream_choppers', 'ns'),
+    ('/entry/instrument/T0_chopper/phase', 'T0_chopper:Phs', 'dream_choppers', 'deg'),
+    ('/entry/instrument/T0_chopper/rotation_speed', 'T0_chopper:Spd', 'dream_choppers', 'Hz'),
+    ('/entry/instrument/T0_chopper/rotation_speed_setpoint', 'T0_chopper:SpdSet', 'dream_choppers', 'Hz'),
+    ('/entry/instrument/band_chopper/delay', 'band_chopper:Delay', 'dream_choppers', 'ns'),
+    ('/entry/instrument/band_chopper/phase', 'band_chopper:Phs', 'dream_choppers', 'deg'),
+    ('/entry/instrument/band_chopper/rotation_speed', 'band_chopper:Spd', 'dream_choppers', 'Hz'),
+    ('/entry/instrument/band_chopper/rotation_speed_setpoint', 'band_chopper:SpdSet', 'dream_choppers', 'Hz'),
+    ('/entry/instrument/collimator/rotation/idle_flag', 'DREAM-Coll:MC-RotZ-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/collimator/rotation/target_value', 'DREAM-Coll:MC-RotZ-01:Mtr.VAL', 'dream_motion', 'deg'),
+    ('/entry/instrument/collimator/rotation/value', 'DREAM-Coll:MC-RotZ-01:Mtr.RBV', 'dream_motion', 'deg'),
+    ('/entry/instrument/collimator/z/idle_flag', 'DREAM-Coll:MC-LinZ-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/collimator/z/target_value', 'DREAM-Coll:MC-LinZ-01:Mtr.VAL', 'dream_motion', 'mm'),
+    ('/entry/instrument/collimator/z/value', 'DREAM-Coll:MC-LinZ-01:Mtr.RBV', 'dream_motion', 'mm'),
+    ('/entry/instrument/divergence_slit/x_center/idle_flag', 'DREAM-DivSl:MC-SlCenX-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/divergence_slit/x_center/target_value', 'DREAM-DivSl:MC-SlCenX-01:Mtr.VAL', 'dream_motion', 'mm'),
+    ('/entry/instrument/divergence_slit/x_center/value', 'DREAM-DivSl:MC-SlCenX-01:Mtr.RBV', 'dream_motion', 'mm'),
+    ('/entry/instrument/divergence_slit/x_gap/idle_flag', 'DREAM-DivSl:MC-SlGapX-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/divergence_slit/x_gap/target_value', 'DREAM-DivSl:MC-SlGapX-01:Mtr.VAL', 'dream_motion', 'mm'),
+    ('/entry/instrument/divergence_slit/x_gap/value', 'DREAM-DivSl:MC-SlGapX-01:Mtr.RBV', 'dream_motion', 'mm'),
+    ('/entry/instrument/divergence_slit/y_center/idle_flag', 'DREAM-DivSl:MC-SlCenY-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/divergence_slit/y_center/target_value', 'DREAM-DivSl:MC-SlCenY-01:Mtr.VAL', 'dream_motion', 'mm'),
+    ('/entry/instrument/divergence_slit/y_center/value', 'DREAM-DivSl:MC-SlCenY-01:Mtr.RBV', 'dream_motion', 'mm'),
+    ('/entry/instrument/divergence_slit/y_gap/idle_flag', 'DREAM-DivSl:MC-SlGapY-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/divergence_slit/y_gap/target_value', 'DREAM-DivSl:MC-SlGapY-01:Mtr.VAL', 'dream_motion', 'mm'),
+    ('/entry/instrument/divergence_slit/y_gap/value', 'DREAM-DivSl:MC-SlGapY-01:Mtr.RBV', 'dream_motion', 'mm'),
+    ('/entry/instrument/monitor_cave/monitor_positioner/idle_flag', 'DREAM-MonC:MC-LinZ-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/monitor_cave/monitor_positioner/target_value', 'DREAM-MonC:MC-LinZ-01:Mtr.VAL', 'dream_motion', 'mm'),
+    ('/entry/instrument/monitor_cave/monitor_positioner/value', 'DREAM-MonC:MC-LinZ-01:Mtr.RBV', 'dream_motion', 'mm'),
+    ('/entry/instrument/overlap_chopper/delay', 'overlap_chopper:Delay', 'dream_choppers', 'ns'),
+    ('/entry/instrument/overlap_chopper/phase', 'overlap_chopper:Phs', 'dream_choppers', 'deg'),
+    ('/entry/instrument/overlap_chopper/rotation_speed', 'overlap_chopper:Spd', 'dream_choppers', 'Hz'),
+    ('/entry/instrument/overlap_chopper/rotation_speed_setpoint', 'overlap_chopper:SpdSet', 'dream_choppers', 'Hz'),
+    ('/entry/instrument/polarizer/state/idle_flag', 'DREAM-Pol:MC-LinX-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/polarizer/state/target_value', 'DREAM-Pol:MC-LinX-01:Mtr.VAL', 'dream_motion', 'mm'),
+    ('/entry/instrument/polarizer/state/value', 'DREAM-Pol:MC-LinX-01:Mtr.RBV', 'dream_motion', 'mm'),
+    ('/entry/instrument/pulse_shaping_chopper1/delay', 'pulse_shaping_chopper1:Delay', 'dream_choppers', 'ns'),
+    ('/entry/instrument/pulse_shaping_chopper1/phase', 'pulse_shaping_chopper1:Phs', 'dream_choppers', 'deg'),
+    ('/entry/instrument/pulse_shaping_chopper1/rotation_speed', 'pulse_shaping_chopper1:Spd', 'dream_choppers', 'Hz'),
+    ('/entry/instrument/pulse_shaping_chopper1/rotation_speed_setpoint', 'pulse_shaping_chopper1:SpdSet', 'dream_choppers', 'Hz'),
+    ('/entry/instrument/pulse_shaping_chopper2/delay', 'pulse_shaping_chopper2:Delay', 'dream_choppers', 'ns'),
+    ('/entry/instrument/pulse_shaping_chopper2/phase', 'pulse_shaping_chopper2:Phs', 'dream_choppers', 'deg'),
+    ('/entry/instrument/pulse_shaping_chopper2/rotation_speed', 'pulse_shaping_chopper2:Spd', 'dream_choppers', 'Hz'),
+    ('/entry/instrument/pulse_shaping_chopper2/rotation_speed_setpoint', 'pulse_shaping_chopper2:SpdSet', 'dream_choppers', 'Hz'),
+    ('/entry/instrument/sample_stage/omega/idle_flag', 'DREAM-Smpl:MC-RotZ-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/omega/target_value', 'DREAM-Smpl:MC-RotZ-01:Mtr.VAL', 'dream_motion', 'deg'),
+    ('/entry/instrument/sample_stage/omega/value', 'DREAM-Smpl:MC-RotZ-01:Mtr.RBV', 'dream_motion', 'deg'),
+    ('/entry/instrument/sample_stage/x/idle_flag', 'DREAM-Smpl:MC-LinX-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/x/target_value', 'DREAM-Smpl:MC-LinX-01:Mtr.VAL', 'dream_motion', 'mm'),
+    ('/entry/instrument/sample_stage/x/value', 'DREAM-Smpl:MC-LinX-01:Mtr.RBV', 'dream_motion', 'mm'),
+    ('/entry/instrument/sample_stage/y/idle_flag', 'DREAM-Smpl:MC-LinY-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/y/target_value', 'DREAM-Smpl:MC-LinY-01:Mtr.VAL', 'dream_motion', 'mm'),
+    ('/entry/instrument/sample_stage/y/value', 'DREAM-Smpl:MC-LinY-01:Mtr.RBV', 'dream_motion', 'mm'),
+    ('/entry/instrument/sample_stage/z/idle_flag', 'DREAM-Smpl:MC-LinZ-01:Mtr.DMOV', 'dream_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/z/target_value', 'DREAM-Smpl:MC-LinZ-01:Mtr.VAL', 'dream_motion', 'mm'),
+    ('/entry/instrument/sample_stage/z/value', 'DREAM-Smpl:MC-LinZ-01:Mtr.RBV', 'dream_motion', 'mm'),
+    ('/entry/sample/magnetic_field', 'DREAM-SE:Mag-PSU-101', 'dream_sample_env', 'T'),
+    ('/entry/sample/pressure', 'DREAM-SE:Prs-PIC-101', 'dream_sample_env', 'bar'),
+    ('/entry/sample/temperature_1', 'DREAM-SE:Tmp-TIC-101', 'dream_sample_env', 'K'),
+    ('/entry/sample/temperature_2', 'DREAM-SE:Tmp-TIC-102', 'dream_sample_env', 'K'),
+    ('/entry/sample/temperature_3', 'DREAM-SE:Tmp-TIC-103', 'dream_sample_env', 'K'),
+    ('/entry/vacuum/gauge_1', 'DREAM-Vac:VGP-001', 'dream_vacuum', 'mbar'),
+    ('/entry/vacuum/gauge_2', 'DREAM-Vac:VGP-002', 'dream_vacuum', 'mbar'),
+    ('/entry/vacuum/gauge_3', 'DREAM-Vac:VGP-003', 'dream_vacuum', 'mbar'),
+)
+
+PARSED_STREAMS: dict[str, F144Stream] = {
+    path: F144Stream(nexus_path=path, source=source, topic=topic, units=units)
+    for path, source, topic, units in _ROWS
+}

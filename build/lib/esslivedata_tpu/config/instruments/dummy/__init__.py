@@ -1,0 +1,7 @@
+"""Dummy instrument: small logical-panel detector for tests, demos and
+benchmark config 1 (reference: config/instruments/dummy)."""
+
+from . import specs  # noqa: F401  (registers instrument + specs on import)
+from .specs import INSTRUMENT
+
+__all__ = ["INSTRUMENT"]

@@ -1,0 +1,40 @@
+"""Dummy instrument factories (heavy imports; loaded lazily by
+``Instrument.load_factories``, reference: instruments/*/factories.py)."""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from ....workflows.detector_view.projectors import ProjectionTable, project_logical
+from ....workflows.detector_view.workflow import DetectorViewWorkflow
+from ....workflows.monitor_workflow import MonitorWorkflow
+from ....workflows.timeseries import TimeseriesWorkflow
+from .specs import (
+    DETECTOR_VIEW_HANDLE,
+    INSTRUMENT,
+    MONITOR_HANDLE,
+    TIMESERIES_HANDLE,
+)
+
+
+@lru_cache(maxsize=None)
+def _projection_for(detector_name: str) -> ProjectionTable:
+    det = INSTRUMENT.detectors[detector_name]
+    return project_logical(det.detector_number)
+
+
+@DETECTOR_VIEW_HANDLE.attach_factory
+def make_detector_view(*, source_name: str, params) -> DetectorViewWorkflow:
+    return DetectorViewWorkflow(
+        projection=_projection_for(source_name), params=params
+    )
+
+
+@MONITOR_HANDLE.attach_factory
+def make_monitor(*, source_name: str, params) -> MonitorWorkflow:
+    return MonitorWorkflow(params=params)
+
+
+@TIMESERIES_HANDLE.attach_factory
+def make_timeseries(*, source_name: str, params) -> TimeseriesWorkflow:
+    return TimeseriesWorkflow()

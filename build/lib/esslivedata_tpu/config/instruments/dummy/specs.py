@@ -1,0 +1,93 @@
+"""Dummy instrument declaration + workflow spec registration."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ....config.instrument import (
+    DetectorConfig,
+    Instrument,
+    MonitorConfig,
+    instrument_registry,
+)
+from ....config.workflow_spec import OutputSpec, WorkflowSpec
+from ....workflows.detector_view.workflow import DetectorViewParams
+from ....workflows.monitor_workflow import MonitorParams
+from ....workflows.workflow_factory import workflow_registry
+
+NY, NX = 64, 64
+
+from .._common import detector_view_outputs, register_parsed_catalog
+from .streams_parsed import PARSED_STREAMS
+
+INSTRUMENT = Instrument(
+    name="dummy",
+    _factories_module="esslivedata_tpu.config.instruments.dummy.factories",
+)
+INSTRUMENT.add_detector(
+    DetectorConfig(
+        name="panel_0",
+        source_name="panel_a",
+        detector_number=np.arange(1, NY * NX + 1).reshape(NY, NX),
+        projection="logical",
+    )
+)
+INSTRUMENT.add_monitor(MonitorConfig(name="monitor_1", source_name="mon_src"))
+INSTRUMENT.add_log("motor_x", "mtr1")
+register_parsed_catalog(INSTRUMENT, PARSED_STREAMS)
+instrument_registry.register(INSTRUMENT)
+
+_image_outputs = {
+    **detector_view_outputs(),
+    "roi_spectra": OutputSpec(title="ROI spectra (window)"),
+    "roi_spectra_cumulative": OutputSpec(
+        title="ROI spectra (since start)", view="since_start"
+    ),
+    "roi_rectangle": OutputSpec(title="ROI rectangles (readback)"),
+    "roi_polygon": OutputSpec(title="ROI polygons (readback)"),
+}
+
+DETECTOR_VIEW_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="dummy",
+        namespace="detector_view",
+        name="panel_view",
+        title="2-D panel view",
+        source_names=INSTRUMENT.detector_names,
+        params_model=DetectorViewParams,
+        outputs=_image_outputs,
+    )
+)
+
+MONITOR_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="dummy",
+        namespace="monitor_data",
+        name="histogram",
+        title="Monitor TOA histogram",
+        source_names=INSTRUMENT.monitor_names,
+        params_model=MonitorParams,
+        outputs={
+            "current": OutputSpec(title="Monitor (window)"),
+            "cumulative": OutputSpec(title="Monitor (since start)", view="since_start"),
+            "counts_current": OutputSpec(title="Counts (window)"),
+            "counts_cumulative": OutputSpec(
+                title="Counts (since start)", view="since_start"
+            ),
+        },
+        # Cumulative counts double as a NICOS derived device (ADR 0006):
+        # republished under a stable name on the nicos topic.
+        device_outputs={"counts_cumulative": "monitor_counts_{source_name}"},
+    )
+)
+
+TIMESERIES_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="dummy",
+        namespace="timeseries",
+        name="log",
+        title="Log timeseries",
+        source_names=sorted(INSTRUMENT.log_sources),
+        reset_on_run_transition=False,
+    )
+)

@@ -1,0 +1,22 @@
+"""Generated f144 stream registry — do not edit.
+
+Regenerate: python scripts/generate_instrument_artifacts.py
+Source artifact: geometry-dummy-<date>.nxs (synthesized)
+"""
+
+from esslivedata_tpu.config.stream import F144Stream
+
+# (nexus_path, source, topic, units)
+_ROWS: tuple[tuple[str, str, str, str | None], ...] = (
+    ('/entry/instrument/sample_changer/position/idle_flag', 'DMY-MC:SmplPos.DMOV', 'dummy_motion', 'dimensionless'),
+    ('/entry/instrument/sample_changer/position/target_value', 'DMY-MC:SmplPos.VAL', 'dummy_motion', 'mm'),
+    ('/entry/instrument/sample_changer/position/value', 'DMY-MC:SmplPos.RBV', 'dummy_motion', 'mm'),
+    ('/entry/sample/magnetic_field', 'DUMMY-SE:Mag-PSU-101', 'dummy_sample_env', 'T'),
+    ('/entry/sample/pressure', 'DUMMY-SE:Prs-PIC-101', 'dummy_sample_env', 'bar'),
+    ('/entry/sample/temperature_1', 'DUMMY-SE:Tmp-TIC-101', 'dummy_sample_env', 'K'),
+)
+
+PARSED_STREAMS: dict[str, F144Stream] = {
+    path: F144Stream(nexus_path=path, source=source, topic=topic, units=units)
+    for path, source, topic, units in _ROWS
+}

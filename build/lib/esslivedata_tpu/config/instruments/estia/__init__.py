@@ -1,0 +1,7 @@
+"""ESTIA: reflectometer with a multiblade detector (reference:
+config/instruments/estia)."""
+
+from . import specs  # noqa: F401
+from .specs import INSTRUMENT
+
+__all__ = ["INSTRUMENT"]

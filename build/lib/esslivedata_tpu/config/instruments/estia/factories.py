@@ -1,0 +1,75 @@
+"""ESTIA factories."""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from ....workflows.detector_view.projectors import (
+    ProjectionTable,
+    project_logical,
+    project_logical_nd,
+)
+from ....workflows.detector_view.workflow import DetectorViewWorkflow
+from ....workflows.monitor_workflow import MonitorWorkflow
+from ....workflows.reflectometry import ReflectometryWorkflow
+from ....workflows.timeseries import TimeseriesWorkflow
+from .._common import monitor_streams_from_aux
+from .specs import (
+    INSTRUMENT,
+    MONITOR_HANDLE,
+    PIXEL_MONITOR_VIEW_HANDLE,
+    REFLECTOMETRY_HANDLE,
+    TIMESERIES_HANDLE,
+    VIEW_HANDLES,
+    VIEWS,
+    reflectometry_geometry,
+)
+
+
+@lru_cache(maxsize=None)
+def _projection(view_name: str) -> ProjectionTable:
+    det = INSTRUMENT.detectors["multiblade_detector"]
+    return project_logical_nd(det.detector_number, VIEWS[view_name])
+
+
+for _view_name, _handle in VIEW_HANDLES.items():
+
+    def _make_view(*, source_name: str, params, _v=_view_name):  # noqa: ARG001
+        return DetectorViewWorkflow(projection=_projection(_v), params=params)
+
+    _handle.attach_factory(_make_view)
+
+
+@MONITOR_HANDLE.attach_factory
+def make_monitor(*, source_name: str, params) -> MonitorWorkflow:  # noqa: ARG001
+    return MonitorWorkflow(params=params)
+
+
+@lru_cache(maxsize=None)
+def _pixel_monitor_projection(name: str) -> ProjectionTable:
+    # The pixellated monitor's [ny, nx] grid IS the screen layout.
+    return project_logical(INSTRUMENT.monitors[name].detector_number)
+
+
+@PIXEL_MONITOR_VIEW_HANDLE.attach_factory
+def make_pixel_monitor_view(*, source_name: str, params) -> DetectorViewWorkflow:
+    return DetectorViewWorkflow(
+        projection=_pixel_monitor_projection(source_name), params=params
+    )
+
+
+@TIMESERIES_HANDLE.attach_factory
+def make_timeseries(*, source_name: str, params) -> TimeseriesWorkflow:  # noqa: ARG001
+    return TimeseriesWorkflow()
+
+
+@REFLECTOMETRY_HANDLE.attach_factory
+def make_reflectometry(
+    *, source_name: str, params, aux_source_names=None
+) -> ReflectometryWorkflow:
+    return ReflectometryWorkflow(
+        **reflectometry_geometry(),
+        params=params,
+        primary_stream=source_name,
+        monitor_streams=monitor_streams_from_aux(aux_source_names),
+    )

@@ -1,0 +1,70 @@
+"""Generated f144 stream registry — do not edit.
+
+Regenerate: python scripts/generate_instrument_artifacts.py
+Source artifact: geometry-estia-<date>.nxs (synthesized)
+"""
+
+from esslivedata_tpu.config.stream import F144Stream
+
+# (nexus_path, source, topic, units)
+_ROWS: tuple[tuple[str, str, str, str | None], ...] = (
+    ('/entry/instrument/chopper_1/delay', 'ESTIA-Chop:C1:Delay', 'estia_choppers', 'ns'),
+    ('/entry/instrument/chopper_1/phase', 'ESTIA-Chop:C1:Phs', 'estia_choppers', 'deg'),
+    ('/entry/instrument/chopper_1/rotation_speed', 'ESTIA-Chop:C1:Spd', 'estia_choppers', 'Hz'),
+    ('/entry/instrument/chopper_1/rotation_speed_setpoint', 'ESTIA-Chop:C1:SpdSet', 'estia_choppers', 'Hz'),
+    ('/entry/instrument/chopper_2/delay', 'ESTIA-Chop:C2:Delay', 'estia_choppers', 'ns'),
+    ('/entry/instrument/chopper_2/phase', 'ESTIA-Chop:C2:Phs', 'estia_choppers', 'deg'),
+    ('/entry/instrument/chopper_2/rotation_speed', 'ESTIA-Chop:C2:Spd', 'estia_choppers', 'Hz'),
+    ('/entry/instrument/chopper_2/rotation_speed_setpoint', 'ESTIA-Chop:C2:SpdSet', 'estia_choppers', 'Hz'),
+    ('/entry/instrument/detector_arm/two_theta/idle_flag', 'ESTIA-DetArm:MC-RotZ-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/detector_arm/two_theta/target_value', 'ESTIA-DetArm:MC-RotZ-01:Mtr.VAL', 'estia_motion', 'deg'),
+    ('/entry/instrument/detector_arm/two_theta/value', 'ESTIA-DetArm:MC-RotZ-01:Mtr.RBV', 'estia_motion', 'deg'),
+    ('/entry/instrument/sample_stage/chi/idle_flag', 'ESTIA-Smpl:MC-RotX-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/chi/target_value', 'ESTIA-Smpl:MC-RotX-01:Mtr.VAL', 'estia_motion', 'deg'),
+    ('/entry/instrument/sample_stage/chi/value', 'ESTIA-Smpl:MC-RotX-01:Mtr.RBV', 'estia_motion', 'deg'),
+    ('/entry/instrument/sample_stage/omega/idle_flag', 'ESTIA-Smpl:MC-RotZ-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/omega/target_value', 'ESTIA-Smpl:MC-RotZ-01:Mtr.VAL', 'estia_motion', 'deg'),
+    ('/entry/instrument/sample_stage/omega/value', 'ESTIA-Smpl:MC-RotZ-01:Mtr.RBV', 'estia_motion', 'deg'),
+    ('/entry/instrument/sample_stage/x/idle_flag', 'ESTIA-Smpl:MC-LinX-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/x/target_value', 'ESTIA-Smpl:MC-LinX-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/sample_stage/x/value', 'ESTIA-Smpl:MC-LinX-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/instrument/sample_stage/y/idle_flag', 'ESTIA-Smpl:MC-LinY-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/y/target_value', 'ESTIA-Smpl:MC-LinY-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/sample_stage/y/value', 'ESTIA-Smpl:MC-LinY-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/instrument/sample_stage/z/idle_flag', 'ESTIA-Smpl:MC-LinZ-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/z/target_value', 'ESTIA-Smpl:MC-LinZ-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/sample_stage/z/value', 'ESTIA-Smpl:MC-LinZ-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_1/x_center/idle_flag', 'ESTIA-Sl1:MC-SlCenX-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/slit_1/x_center/target_value', 'ESTIA-Sl1:MC-SlCenX-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_1/x_center/value', 'ESTIA-Sl1:MC-SlCenX-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_1/x_gap/idle_flag', 'ESTIA-Sl1:MC-SlGapX-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/slit_1/x_gap/target_value', 'ESTIA-Sl1:MC-SlGapX-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_1/x_gap/value', 'ESTIA-Sl1:MC-SlGapX-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_1/y_center/idle_flag', 'ESTIA-Sl1:MC-SlCenY-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/slit_1/y_center/target_value', 'ESTIA-Sl1:MC-SlCenY-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_1/y_center/value', 'ESTIA-Sl1:MC-SlCenY-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_1/y_gap/idle_flag', 'ESTIA-Sl1:MC-SlGapY-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/slit_1/y_gap/target_value', 'ESTIA-Sl1:MC-SlGapY-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_1/y_gap/value', 'ESTIA-Sl1:MC-SlGapY-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_2/x_center/idle_flag', 'ESTIA-Sl2:MC-SlCenX-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/slit_2/x_center/target_value', 'ESTIA-Sl2:MC-SlCenX-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_2/x_center/value', 'ESTIA-Sl2:MC-SlCenX-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_2/x_gap/idle_flag', 'ESTIA-Sl2:MC-SlGapX-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/slit_2/x_gap/target_value', 'ESTIA-Sl2:MC-SlGapX-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_2/x_gap/value', 'ESTIA-Sl2:MC-SlGapX-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_2/y_center/idle_flag', 'ESTIA-Sl2:MC-SlCenY-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/slit_2/y_center/target_value', 'ESTIA-Sl2:MC-SlCenY-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_2/y_center/value', 'ESTIA-Sl2:MC-SlCenY-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_2/y_gap/idle_flag', 'ESTIA-Sl2:MC-SlGapY-01:Mtr.DMOV', 'estia_motion', 'dimensionless'),
+    ('/entry/instrument/slit_2/y_gap/target_value', 'ESTIA-Sl2:MC-SlGapY-01:Mtr.VAL', 'estia_motion', 'mm'),
+    ('/entry/instrument/slit_2/y_gap/value', 'ESTIA-Sl2:MC-SlGapY-01:Mtr.RBV', 'estia_motion', 'mm'),
+    ('/entry/sample/magnetic_field', 'ESTIA-SE:Mag-PSU-101', 'estia_sample_env', 'T'),
+    ('/entry/sample/pressure', 'ESTIA-SE:Prs-PIC-101', 'estia_sample_env', 'bar'),
+    ('/entry/sample/temperature_1', 'ESTIA-SE:Tmp-TIC-101', 'estia_sample_env', 'K'),
+    ('/entry/sample/temperature_2', 'ESTIA-SE:Tmp-TIC-102', 'estia_sample_env', 'K'),
+)
+
+PARSED_STREAMS: dict[str, F144Stream] = {
+    path: F144Stream(nexus_path=path, source=source, topic=topic, units=units)
+    for path, source, topic, units in _ROWS
+}

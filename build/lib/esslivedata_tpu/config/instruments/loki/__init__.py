@@ -1,0 +1,7 @@
+"""LOKI (SANS) instrument: geometric detector banks + monitor-normalized
+I(Q) reduction (reference: config/instruments/loki; BASELINE configs 2+4)."""
+
+from . import specs  # noqa: F401
+from .specs import INSTRUMENT
+
+__all__ = ["INSTRUMENT"]

@@ -1,0 +1,31 @@
+"""LOKI rear-bank geometry, loaded from the NeXus geometry artifact.
+
+The positions and pixel ids come from the date-resolved geometry file
+(``config/geometry_store.py`` — reference parity:
+preprocessors/detector_data.py:66-127, where real deployments fetch the
+artifact with pooch and ``LIVEDATA_DATA_DIR`` overrides the cache). The
+synthesized artifact carries a 256x256 pixel plane, 1 m x 1 m, 5 m
+downstream of the sample — the right scale and topology for the
+detector-view and I(Q) paths; a real ESS file dropped into the cache is
+picked up with no code change.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+NY, NX = 256, 256
+
+
+def rear_bank_geometry() -> tuple[np.ndarray, np.ndarray]:
+    """Returns ([n, 3] positions in m, [n] pixel ids starting at 1)."""
+    from ...geometry_store import geometry_path, load_detector_geometry
+
+    path = geometry_path("loki")
+    positions, pixel_ids = load_detector_geometry(path, "larmor_detector")
+    if pixel_ids.size != NY * NX:
+        raise ValueError(
+            f"LOKI geometry file {path} has {pixel_ids.size} pixels; the "
+            f"declared rear-bank layout expects {NY}x{NX}"
+        )
+    return positions, pixel_ids

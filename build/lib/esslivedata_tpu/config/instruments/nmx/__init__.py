@@ -1,0 +1,7 @@
+"""NMX: macromolecular crystallography with three large area panels
+(reference: config/instruments/nmx)."""
+
+from . import specs  # noqa: F401
+from .specs import INSTRUMENT
+
+__all__ = ["INSTRUMENT"]

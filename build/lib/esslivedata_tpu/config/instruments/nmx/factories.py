@@ -1,0 +1,36 @@
+"""NMX factories: per-panel identity projections (1280x1280 grids)."""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from ....workflows.detector_view.projectors import (
+    ProjectionTable,
+    project_logical,
+)
+from ....workflows.detector_view.workflow import DetectorViewWorkflow
+from ....workflows.monitor_workflow import MonitorWorkflow
+from ....workflows.timeseries import TimeseriesWorkflow
+from .specs import INSTRUMENT, MONITOR_HANDLE, PANEL_XY_HANDLE, TIMESERIES_HANDLE
+
+
+@lru_cache(maxsize=None)
+def _projection(panel: str) -> ProjectionTable:
+    return project_logical(INSTRUMENT.detectors[panel].detector_number)
+
+
+@PANEL_XY_HANDLE.attach_factory
+def make_panel_xy(*, source_name: str, params) -> DetectorViewWorkflow:
+    return DetectorViewWorkflow(
+        projection=_projection(source_name), params=params
+    )
+
+
+@MONITOR_HANDLE.attach_factory
+def make_monitor(*, source_name: str, params) -> MonitorWorkflow:  # noqa: ARG001
+    return MonitorWorkflow(params=params)
+
+
+@TIMESERIES_HANDLE.attach_factory
+def make_timeseries(*, source_name: str, params) -> TimeseriesWorkflow:  # noqa: ARG001
+    return TimeseriesWorkflow()

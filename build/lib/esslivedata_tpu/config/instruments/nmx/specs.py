@@ -1,0 +1,68 @@
+"""NMX instrument declaration + spec registration.
+
+Parity with reference ``config/instruments/nmx/specs.py``: three
+1280x1280-pixel detector panels with a panel_xy view (TOA-only monitors —
+NMX registers no TOF lookup tables).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ....config.instrument import (
+    DetectorConfig,
+    Instrument,
+    MonitorConfig,
+    instrument_registry,
+)
+from ....config.workflow_spec import WorkflowSpec
+from ....workflows.detector_view.workflow import DetectorViewParams
+from ....workflows.workflow_factory import workflow_registry
+from .._common import (
+    register_parsed_catalog,
+    detector_view_outputs,
+    register_monitor_spec,
+    register_timeseries_spec,
+)
+
+PANEL_SHAPE = (1280, 1280)
+PANELS = ["detector_panel_0", "detector_panel_1", "detector_panel_2"]
+
+from .streams_parsed import PARSED_STREAMS
+
+INSTRUMENT = Instrument(
+    name="nmx",
+    _factories_module="esslivedata_tpu.config.instruments.nmx.factories",
+)
+_panel_pixels = PANEL_SHAPE[0] * PANEL_SHAPE[1]
+for _i, _panel in enumerate(PANELS):
+    _start = 1 + _i * _panel_pixels
+    INSTRUMENT.add_detector(
+        DetectorConfig(
+            name=_panel,
+            source_name=f"nmx_{_panel}",
+            detector_number=np.arange(
+                _start, _start + _panel_pixels, dtype=np.int32
+            ).reshape(PANEL_SHAPE),
+            projection="logical",
+        )
+    )
+INSTRUMENT.add_monitor(MonitorConfig(name="monitor1", source_name="nmx_mon_1"))
+INSTRUMENT.add_monitor(MonitorConfig(name="monitor2", source_name="nmx_mon_2"))
+register_parsed_catalog(INSTRUMENT, PARSED_STREAMS)
+instrument_registry.register(INSTRUMENT)
+
+PANEL_XY_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="nmx",
+        namespace="detector_view",
+        name="panel_xy",
+        title="Detector counts",
+        description="Detector counts per pixel.",
+        source_names=PANELS,
+        params_model=DetectorViewParams,
+        outputs=detector_view_outputs(),
+    )
+)
+MONITOR_HANDLE = register_monitor_spec(INSTRUMENT)
+TIMESERIES_HANDLE = register_timeseries_spec(INSTRUMENT)

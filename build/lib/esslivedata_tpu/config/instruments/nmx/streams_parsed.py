@@ -1,0 +1,54 @@
+"""Generated f144 stream registry — do not edit.
+
+Regenerate: python scripts/generate_instrument_artifacts.py
+Source artifact: geometry-nmx-<date>.nxs (synthesized)
+"""
+
+from esslivedata_tpu.config.stream import F144Stream
+
+# (nexus_path, source, topic, units)
+_ROWS: tuple[tuple[str, str, str, str | None], ...] = (
+    ('/entry/instrument/chopper_1/delay', 'NMX-Chop:C1:Delay', 'nmx_choppers', 'ns'),
+    ('/entry/instrument/chopper_1/phase', 'NMX-Chop:C1:Phs', 'nmx_choppers', 'deg'),
+    ('/entry/instrument/chopper_1/rotation_speed', 'NMX-Chop:C1:Spd', 'nmx_choppers', 'Hz'),
+    ('/entry/instrument/chopper_1/rotation_speed_setpoint', 'NMX-Chop:C1:SpdSet', 'nmx_choppers', 'Hz'),
+    ('/entry/instrument/detector_panel_0/distance/idle_flag', 'NMX-Det0:MC-LinZ-01:Mtr.DMOV', 'nmx_motion', 'dimensionless'),
+    ('/entry/instrument/detector_panel_0/distance/target_value', 'NMX-Det0:MC-LinZ-01:Mtr.VAL', 'nmx_motion', 'm'),
+    ('/entry/instrument/detector_panel_0/distance/value', 'NMX-Det0:MC-LinZ-01:Mtr.RBV', 'nmx_motion', 'm'),
+    ('/entry/instrument/detector_panel_0/rotation/idle_flag', 'NMX-Det0:MC-RotZ-01:Mtr.DMOV', 'nmx_motion', 'dimensionless'),
+    ('/entry/instrument/detector_panel_0/rotation/target_value', 'NMX-Det0:MC-RotZ-01:Mtr.VAL', 'nmx_motion', 'deg'),
+    ('/entry/instrument/detector_panel_0/rotation/value', 'NMX-Det0:MC-RotZ-01:Mtr.RBV', 'nmx_motion', 'deg'),
+    ('/entry/instrument/detector_panel_1/distance/idle_flag', 'NMX-Det1:MC-LinZ-01:Mtr.DMOV', 'nmx_motion', 'dimensionless'),
+    ('/entry/instrument/detector_panel_1/distance/target_value', 'NMX-Det1:MC-LinZ-01:Mtr.VAL', 'nmx_motion', 'm'),
+    ('/entry/instrument/detector_panel_1/distance/value', 'NMX-Det1:MC-LinZ-01:Mtr.RBV', 'nmx_motion', 'm'),
+    ('/entry/instrument/detector_panel_1/rotation/idle_flag', 'NMX-Det1:MC-RotZ-01:Mtr.DMOV', 'nmx_motion', 'dimensionless'),
+    ('/entry/instrument/detector_panel_1/rotation/target_value', 'NMX-Det1:MC-RotZ-01:Mtr.VAL', 'nmx_motion', 'deg'),
+    ('/entry/instrument/detector_panel_1/rotation/value', 'NMX-Det1:MC-RotZ-01:Mtr.RBV', 'nmx_motion', 'deg'),
+    ('/entry/instrument/detector_panel_2/distance/idle_flag', 'NMX-Det2:MC-LinZ-01:Mtr.DMOV', 'nmx_motion', 'dimensionless'),
+    ('/entry/instrument/detector_panel_2/distance/target_value', 'NMX-Det2:MC-LinZ-01:Mtr.VAL', 'nmx_motion', 'm'),
+    ('/entry/instrument/detector_panel_2/distance/value', 'NMX-Det2:MC-LinZ-01:Mtr.RBV', 'nmx_motion', 'm'),
+    ('/entry/instrument/detector_panel_2/rotation/idle_flag', 'NMX-Det2:MC-RotZ-01:Mtr.DMOV', 'nmx_motion', 'dimensionless'),
+    ('/entry/instrument/detector_panel_2/rotation/target_value', 'NMX-Det2:MC-RotZ-01:Mtr.VAL', 'nmx_motion', 'deg'),
+    ('/entry/instrument/detector_panel_2/rotation/value', 'NMX-Det2:MC-RotZ-01:Mtr.RBV', 'nmx_motion', 'deg'),
+    ('/entry/instrument/sample_stage/omega/idle_flag', 'NMX-Smpl:MC-RotZ-01:Mtr.DMOV', 'nmx_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/omega/target_value', 'NMX-Smpl:MC-RotZ-01:Mtr.VAL', 'nmx_motion', 'deg'),
+    ('/entry/instrument/sample_stage/omega/value', 'NMX-Smpl:MC-RotZ-01:Mtr.RBV', 'nmx_motion', 'deg'),
+    ('/entry/instrument/sample_stage/x/idle_flag', 'NMX-Smpl:MC-LinX-01:Mtr.DMOV', 'nmx_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/x/target_value', 'NMX-Smpl:MC-LinX-01:Mtr.VAL', 'nmx_motion', 'mm'),
+    ('/entry/instrument/sample_stage/x/value', 'NMX-Smpl:MC-LinX-01:Mtr.RBV', 'nmx_motion', 'mm'),
+    ('/entry/instrument/sample_stage/y/idle_flag', 'NMX-Smpl:MC-LinY-01:Mtr.DMOV', 'nmx_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/y/target_value', 'NMX-Smpl:MC-LinY-01:Mtr.VAL', 'nmx_motion', 'mm'),
+    ('/entry/instrument/sample_stage/y/value', 'NMX-Smpl:MC-LinY-01:Mtr.RBV', 'nmx_motion', 'mm'),
+    ('/entry/instrument/sample_stage/z/idle_flag', 'NMX-Smpl:MC-LinZ-01:Mtr.DMOV', 'nmx_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/z/target_value', 'NMX-Smpl:MC-LinZ-01:Mtr.VAL', 'nmx_motion', 'mm'),
+    ('/entry/instrument/sample_stage/z/value', 'NMX-Smpl:MC-LinZ-01:Mtr.RBV', 'nmx_motion', 'mm'),
+    ('/entry/sample/magnetic_field', 'NMX-SE:Mag-PSU-101', 'nmx_sample_env', 'T'),
+    ('/entry/sample/pressure', 'NMX-SE:Prs-PIC-101', 'nmx_sample_env', 'bar'),
+    ('/entry/sample/temperature_1', 'NMX-SE:Tmp-TIC-101', 'nmx_sample_env', 'K'),
+    ('/entry/sample/temperature_2', 'NMX-SE:Tmp-TIC-102', 'nmx_sample_env', 'K'),
+)
+
+PARSED_STREAMS: dict[str, F144Stream] = {
+    path: F144Stream(nexus_path=path, source=source, topic=topic, units=units)
+    for path, source, topic, units in _ROWS
+}

@@ -1,0 +1,7 @@
+"""ODIN: neutron imaging with a Timepix3 event detector and an ad00 camera
+(reference: config/instruments/odin)."""
+
+from . import specs  # noqa: F401
+from .specs import INSTRUMENT
+
+__all__ = ["INSTRUMENT"]

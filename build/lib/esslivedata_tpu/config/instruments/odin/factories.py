@@ -1,0 +1,46 @@
+"""ODIN factories."""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from ....workflows.area_detector_view import AreaDetectorView
+from ....workflows.detector_view.projectors import (
+    ProjectionTable,
+    project_logical,
+)
+from ....workflows.detector_view.workflow import DetectorViewWorkflow
+from ....workflows.monitor_workflow import MonitorWorkflow
+from ....workflows.timeseries import TimeseriesWorkflow
+from .specs import (
+    CAMERA_HANDLE,
+    DETECTOR_XY_HANDLE,
+    INSTRUMENT,
+    MONITOR_HANDLE,
+    TIMESERIES_HANDLE,
+)
+
+
+@lru_cache(maxsize=None)
+def _projection() -> ProjectionTable:
+    return project_logical(INSTRUMENT.detectors["timepix3"].detector_number)
+
+
+@DETECTOR_XY_HANDLE.attach_factory
+def make_detector_xy(*, source_name: str, params) -> DetectorViewWorkflow:  # noqa: ARG001
+    return DetectorViewWorkflow(projection=_projection(), params=params)
+
+
+@CAMERA_HANDLE.attach_factory
+def make_camera_view(*, source_name: str, params) -> AreaDetectorView:  # noqa: ARG001
+    return AreaDetectorView(params=params)
+
+
+@MONITOR_HANDLE.attach_factory
+def make_monitor(*, source_name: str, params) -> MonitorWorkflow:  # noqa: ARG001
+    return MonitorWorkflow(params=params)
+
+
+@TIMESERIES_HANDLE.attach_factory
+def make_timeseries(*, source_name: str, params) -> TimeseriesWorkflow:  # noqa: ARG001
+    return TimeseriesWorkflow()

@@ -1,0 +1,89 @@
+"""ODIN instrument declaration + spec registration.
+
+Parity with reference ``config/instruments/odin/specs.py``: the Timepix3
+event-mode imaging detector with an XY fold view (reference odin/views.py
+fold_image), TOA-only monitors, plus an ad00 camera stream feeding the
+area-detector workflow.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ....config.instrument import (
+    CameraConfig,
+    DetectorConfig,
+    Instrument,
+    MonitorConfig,
+    instrument_registry,
+)
+from ....config.workflow_spec import OutputSpec, WorkflowSpec
+from ....workflows.area_detector_view import AreaDetectorParams
+from ....workflows.detector_view.workflow import DetectorViewParams
+from ....workflows.workflow_factory import workflow_registry
+from .._common import (
+    register_parsed_catalog,
+    detector_view_outputs,
+    register_monitor_spec,
+    register_timeseries_spec,
+)
+
+TIMEPIX_SHAPE = (512, 512)
+
+from .streams_parsed import PARSED_STREAMS
+
+INSTRUMENT = Instrument(
+    name="odin",
+    _factories_module="esslivedata_tpu.config.instruments.odin.factories",
+)
+_n = TIMEPIX_SHAPE[0] * TIMEPIX_SHAPE[1]
+INSTRUMENT.add_detector(
+    DetectorConfig(
+        name="timepix3",
+        source_name="odin_timepix3",
+        detector_number=np.arange(1, _n + 1, dtype=np.int32).reshape(
+            TIMEPIX_SHAPE
+        ),
+        projection="logical",
+    )
+)
+INSTRUMENT.add_monitor(MonitorConfig(name="monitor1", source_name="odin_mon_1"))
+INSTRUMENT.add_monitor(MonitorConfig(name="monitor2", source_name="odin_mon_2"))
+INSTRUMENT.add_camera(
+    CameraConfig(name="orca_camera", source_name="odin_orca")
+)
+register_parsed_catalog(INSTRUMENT, PARSED_STREAMS)
+instrument_registry.register(INSTRUMENT)
+
+DETECTOR_XY_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="odin",
+        namespace="detector_view",
+        name="odin_detector_xy",
+        title="Timepix3 XY Detector Counts",
+        description="2D view of the Timepix3 detector counts",
+        source_names=["timepix3"],
+        params_model=DetectorViewParams,
+        outputs=detector_view_outputs(),
+    )
+)
+
+CAMERA_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="odin",
+        namespace="detector_view",
+        name="camera_view",
+        title="Camera image",
+        source_names=["orca_camera"],
+        params_model=AreaDetectorParams,
+        outputs={
+            "current": OutputSpec(title="Frame (window)"),
+            "cumulative": OutputSpec(
+                title="Integrated image", view="since_start"
+            ),
+        },
+    )
+)
+
+MONITOR_HANDLE = register_monitor_spec(INSTRUMENT)
+TIMESERIES_HANDLE = register_timeseries_spec(INSTRUMENT)

@@ -1,0 +1,7 @@
+"""TBL: ESS test beamline — small panel, monitor, choppers (reference:
+config/instruments/tbl)."""
+
+from . import specs  # noqa: F401
+from .specs import INSTRUMENT
+
+__all__ = ["INSTRUMENT"]

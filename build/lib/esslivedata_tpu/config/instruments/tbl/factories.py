@@ -1,0 +1,91 @@
+"""TBL factories."""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from ....workflows.area_detector_view import AreaDetectorView
+from ....workflows.detector_view.projectors import (
+    ProjectionTable,
+    project_logical,
+    project_logical_nd,
+)
+from ....workflows.detector_view.workflow import DetectorViewWorkflow
+from ....workflows.monitor_workflow import MonitorWorkflow
+from ....workflows.timeseries import TimeseriesWorkflow
+from ....workflows.wavelength_lut_workflow import WavelengthLutWorkflow
+from .specs import (
+    CHOPPER_GEOMETRY,
+    HE3_VIEW_HANDLE,
+    INSTRUMENT,
+    MONITOR_HANDLE,
+    MULTIBLADE_VIEW,
+    MULTIBLADE_VIEW_HANDLE,
+    NGEM_VIEW_HANDLE,
+    ORCA_VIEW_HANDLE,
+    PANEL_VIEW_HANDLE,
+    TIMEPIX3_VIEW_HANDLE,
+    TIMESERIES_HANDLE,
+    WAVELENGTH_LUT_HANDLE,
+)
+
+
+@lru_cache(maxsize=None)
+def _logical_projection(name: str) -> ProjectionTable:
+    return project_logical(INSTRUMENT.detectors[name].detector_number)
+
+
+def _logical_view_factory():
+    def factory(*, source_name: str, params) -> DetectorViewWorkflow:
+        return DetectorViewWorkflow(
+            projection=_logical_projection(source_name),
+            params=params,
+            primary_stream=source_name,
+        )
+
+    return factory
+
+
+make_panel_view = PANEL_VIEW_HANDLE.attach_factory(_logical_view_factory())
+make_timepix3_view = TIMEPIX3_VIEW_HANDLE.attach_factory(
+    _logical_view_factory()
+)
+make_he3_view = HE3_VIEW_HANDLE.attach_factory(_logical_view_factory())
+make_ngem_view = NGEM_VIEW_HANDLE.attach_factory(_logical_view_factory())
+
+
+@lru_cache(maxsize=None)
+def _multiblade_projection() -> ProjectionTable:
+    return project_logical_nd(
+        INSTRUMENT.detectors["multiblade_detector"].detector_number,
+        MULTIBLADE_VIEW,
+    )
+
+
+@MULTIBLADE_VIEW_HANDLE.attach_factory
+def make_multiblade_view(*, source_name: str, params) -> DetectorViewWorkflow:  # noqa: ARG001
+    return DetectorViewWorkflow(
+        projection=_multiblade_projection(), params=params
+    )
+
+
+@ORCA_VIEW_HANDLE.attach_factory
+def make_orca_view(*, source_name: str, params) -> AreaDetectorView:  # noqa: ARG001
+    return AreaDetectorView(params=params)
+
+
+@WAVELENGTH_LUT_HANDLE.attach_factory
+def make_wavelength_lut(*, source_name: str, params) -> WavelengthLutWorkflow:  # noqa: ARG001
+    return WavelengthLutWorkflow(choppers=CHOPPER_GEOMETRY, params=params)
+
+
+@MONITOR_HANDLE.attach_factory
+def make_monitor(*, source_name: str, params) -> MonitorWorkflow:
+    return MonitorWorkflow(
+        params=params, position_stream=f"{source_name}_position"
+    )
+
+
+@TIMESERIES_HANDLE.attach_factory
+def make_timeseries(*, source_name: str, params) -> TimeseriesWorkflow:  # noqa: ARG001
+    return TimeseriesWorkflow()

@@ -1,0 +1,215 @@
+"""TBL (test beamline) instrument declaration + spec registration.
+
+Parity with reference ``config/instruments/tbl/specs.py``: the detector
+ZOO the test beamline hosts — Timepix3, Multiblade (blade/wire/strip
+fold, views.py:24), two He3 tube banks (tube/pixel axes, views.py:28),
+nGEM, and the ORCA area camera (ad00) — plus a small 2-D panel, one
+monitor, sample-environment logs, and a WFM chopper pair whose setpoints
+feed the wavelength-LUT workflow.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ....config.instrument import (
+    CameraConfig,
+    DetectorConfig,
+    Instrument,
+    MonitorConfig,
+    instrument_registry,
+)
+from ....config.chopper import chopper_pv_streams
+from ....config.workflow_spec import OutputSpec, WorkflowSpec
+from ....workflows.area_detector_view import AreaDetectorParams
+from ....workflows.detector_view.projectors import NdLogicalView
+from ....workflows.detector_view.workflow import DetectorViewParams
+from ....workflows.wavelength_lut_workflow import (
+    ChopperGeometry,
+    WavelengthLutParams,
+    spec_context_keys,
+)
+from ....workflows.workflow_factory import workflow_registry
+from .._common import (
+    register_parsed_catalog,
+    detector_view_outputs,
+    register_monitor_spec,
+    register_timeseries_spec,
+)
+
+PANEL_SHAPE = (64, 64)
+CHOPPERS = ["wfm_chopper_1", "wfm_chopper_2"]
+CHOPPER_GEOMETRY = [
+    ChopperGeometry(
+        name="wfm_chopper_1", distance_m=8.0, slit_edges_deg=((0.0, 100.0),)
+    ),
+    ChopperGeometry(
+        name="wfm_chopper_2", distance_m=8.5, slit_edges_deg=((30.0, 140.0),)
+    ),
+]
+
+
+from .streams_parsed import PARSED_STREAMS
+
+INSTRUMENT = Instrument(
+    name="tbl",
+    streams=chopper_pv_streams(CHOPPERS, topic="tbl_choppers"),
+    choppers=CHOPPERS,
+    _factories_module="esslivedata_tpu.config.instruments.tbl.factories",
+)
+_n = PANEL_SHAPE[0] * PANEL_SHAPE[1]
+INSTRUMENT.add_detector(
+    DetectorConfig(
+        name="panel",
+        source_name="tbl_panel",
+        detector_number=np.arange(1, _n + 1, dtype=np.int32).reshape(
+            PANEL_SHAPE
+        ),
+        projection="logical",
+    )
+)
+# --- The TBL detector zoo (reference specs.py:24-49) ------------------
+# Timepix3: large panel folded logically to a displayable grid.
+INSTRUMENT.add_detector(
+    DetectorConfig(
+        name="timepix3_detector",
+        source_name="tbl_timepix3",
+        detector_number=np.arange(1, 256 * 256 + 1, dtype=np.int32).reshape(
+            256, 256
+        ),
+        projection="logical",
+    )
+)
+# Multiblade: 14 blades x 32 wires x 64 strips, flat id space.
+MULTIBLADE_SIZES = {"blade": 14, "wire": 32, "strip": 64}
+_mb_shape = tuple(MULTIBLADE_SIZES.values())
+_mb_n = int(np.prod(_mb_shape))
+INSTRUMENT.add_detector(
+    DetectorConfig(
+        name="multiblade_detector",
+        source_name="tbl_multiblade",
+        detector_number=np.arange(1, _mb_n + 1, dtype=np.int32).reshape(
+            _mb_shape[0], _mb_shape[1] * _mb_shape[2]
+        ),
+        projection="logical",
+    )
+)
+# Two He3 tube banks: (tube, pixel) layout, disjoint id blocks.
+HE3_SHAPE = (8, 512)
+_he3_n = HE3_SHAPE[0] * HE3_SHAPE[1]
+for _b in range(2):
+    INSTRUMENT.add_detector(
+        DetectorConfig(
+            name=f"he3_detector_bank{_b}",
+            source_name=f"tbl_he3_bank{_b}",
+            detector_number=np.arange(
+                1 + _b * _he3_n, 1 + (_b + 1) * _he3_n, dtype=np.int32
+            ).reshape(HE3_SHAPE),
+            projection="logical",
+        )
+    )
+# nGEM: plain 2-D counts view.
+INSTRUMENT.add_detector(
+    DetectorConfig(
+        name="ngem_detector",
+        source_name="tbl_ngem",
+        detector_number=np.arange(1, 128 * 128 + 1, dtype=np.int32).reshape(
+            128, 128
+        ),
+        projection="logical",
+    )
+)
+# ORCA camera: ad00 frames, no detector numbers (reference specs.py:30).
+INSTRUMENT.add_camera(
+    CameraConfig(name="orca_detector", source_name="tbl_orca")
+)
+INSTRUMENT.add_monitor(MonitorConfig(name="monitor", source_name="tbl_mon_1"))
+INSTRUMENT.add_log("sample_temperature", "tbl_temp_1")
+# The TBL monitor rides a translation stage: its position log drives
+# the reset-on-move behavior of the monitor workflow.
+INSTRUMENT.add_log("monitor_position", "tbl_mon_pos")
+register_parsed_catalog(INSTRUMENT, PARSED_STREAMS)
+instrument_registry.register(INSTRUMENT)
+
+#: Multiblade view folds (blade, wire, strip) -> blade rows vs strip
+#: columns, wires summed by the scatter (reference views.py:24).
+MULTIBLADE_VIEW = NdLogicalView(
+    sizes=MULTIBLADE_SIZES, y=("blade",), x=("strip",)
+)
+
+
+def _zoo_view_spec(name: str, title: str, sources: list[str]) -> WorkflowSpec:
+    return WorkflowSpec(
+        instrument="tbl",
+        namespace="detector_view",
+        name=name,
+        title=title,
+        source_names=sources,
+        params_model=DetectorViewParams,
+        outputs=detector_view_outputs(),
+    )
+
+
+PANEL_VIEW_HANDLE = workflow_registry.register_spec(
+    _zoo_view_spec("panel_view", "Panel view", ["panel"])
+)
+TIMEPIX3_VIEW_HANDLE = workflow_registry.register_spec(
+    _zoo_view_spec(
+        "tbl_detector_timepix3", "Timepix3 XY counts", ["timepix3_detector"]
+    )
+)
+MULTIBLADE_VIEW_HANDLE = workflow_registry.register_spec(
+    _zoo_view_spec(
+        "multiblade_detector_view",
+        "Multiblade blade/strip view",
+        ["multiblade_detector"],
+    )
+)
+HE3_VIEW_HANDLE = workflow_registry.register_spec(
+    _zoo_view_spec(
+        "he3_detector_view",
+        "He3 tube/pixel view",
+        ["he3_detector_bank0", "he3_detector_bank1"],
+    )
+)
+NGEM_VIEW_HANDLE = workflow_registry.register_spec(
+    _zoo_view_spec(
+        "ngem_detector_view", "nGEM 2-D counts", ["ngem_detector"]
+    )
+)
+ORCA_VIEW_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="tbl",
+        namespace="detector_view",
+        name="tbl_area_detector_orca",
+        title="ORCA camera image",
+        source_names=["orca_detector"],
+        params_model=AreaDetectorParams,
+        outputs={
+            "current": OutputSpec(title="Frame (window)"),
+            "cumulative": OutputSpec(
+                title="Integrated image", view="since_start"
+            ),
+        },
+    )
+)
+
+WAVELENGTH_LUT_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="tbl",
+        namespace="diagnostics",
+        name="wavelength_lut",
+        title="TOF->wavelength lookup table",
+        source_names=["chopper_cascade"],
+        params_model=WavelengthLutParams,
+        context_keys=spec_context_keys(CHOPPER_GEOMETRY),
+        reset_on_run_transition=False,
+        outputs={
+            "wavelength_lut": OutputSpec(title="Wavelength LUT"),
+            "wavelength_bands": OutputSpec(title="Wavelength bands"),
+        },
+    )
+)
+
+MONITOR_HANDLE = register_monitor_spec(INSTRUMENT)
+TIMESERIES_HANDLE = register_timeseries_spec(INSTRUMENT)

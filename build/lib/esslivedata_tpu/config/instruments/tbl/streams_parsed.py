@@ -1,0 +1,29 @@
+"""Generated f144 stream registry — do not edit.
+
+Regenerate: python scripts/generate_instrument_artifacts.py
+Source artifact: geometry-tbl-<date>.nxs (synthesized)
+"""
+
+from esslivedata_tpu.config.stream import F144Stream
+
+# (nexus_path, source, topic, units)
+_ROWS: tuple[tuple[str, str, str, str | None], ...] = (
+    ('/entry/instrument/chopper/delay', 'chopper:Delay', 'tbl_choppers', 'ns'),
+    ('/entry/instrument/chopper/phase', 'chopper:Phs', 'tbl_choppers', 'deg'),
+    ('/entry/instrument/chopper/rotation_speed', 'chopper:Spd', 'tbl_choppers', 'Hz'),
+    ('/entry/instrument/chopper/rotation_speed_setpoint', 'chopper:SpdSet', 'tbl_choppers', 'Hz'),
+    ('/entry/instrument/sample_stage/x/idle_flag', 'TBL-Smpl:MC-LinX-01:Mtr.DMOV', 'tbl_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/x/target_value', 'TBL-Smpl:MC-LinX-01:Mtr.VAL', 'tbl_motion', 'mm'),
+    ('/entry/instrument/sample_stage/x/value', 'TBL-Smpl:MC-LinX-01:Mtr.RBV', 'tbl_motion', 'mm'),
+    ('/entry/instrument/sample_stage/z/idle_flag', 'TBL-Smpl:MC-LinZ-01:Mtr.DMOV', 'tbl_motion', 'dimensionless'),
+    ('/entry/instrument/sample_stage/z/target_value', 'TBL-Smpl:MC-LinZ-01:Mtr.VAL', 'tbl_motion', 'mm'),
+    ('/entry/instrument/sample_stage/z/value', 'TBL-Smpl:MC-LinZ-01:Mtr.RBV', 'tbl_motion', 'mm'),
+    ('/entry/sample/magnetic_field', 'TBL-SE:Mag-PSU-101', 'tbl_sample_env', 'T'),
+    ('/entry/sample/pressure', 'TBL-SE:Prs-PIC-101', 'tbl_sample_env', 'bar'),
+    ('/entry/sample/temperature_1', 'TBL-SE:Tmp-TIC-101', 'tbl_sample_env', 'K'),
+)
+
+PARSED_STREAMS: dict[str, F144Stream] = {
+    path: F144Stream(nexus_path=path, source=source, topic=topic, units=units)
+    for path, source, topic, units in _ROWS
+}

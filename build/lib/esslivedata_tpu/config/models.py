@@ -1,0 +1,103 @@
+"""Shared pydantic parameter models (reference: config/models.py, 500 LoC).
+
+ROI shapes, axis ranges and common workflow parameters. These models ride
+the commands topic as JSON and drive the dashboard's auto-generated forms.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from pydantic import BaseModel, ConfigDict, model_validator
+
+__all__ = ["PolygonROI", "RectangleROI", "ROI", "TOARange", "WeightingMethod"]
+
+
+class RectangleROI(BaseModel):
+    """Axis-aligned rectangle in screen coordinates (bin units are the
+    screen's coordinate units, not indices)."""
+
+    model_config = ConfigDict(frozen=True)
+
+    x_min: float
+    x_max: float
+    y_min: float
+    y_max: float
+
+    @model_validator(mode="after")
+    def _ordered(self) -> RectangleROI:
+        if self.x_max <= self.x_min or self.y_max <= self.y_min:
+            raise ValueError("ROI bounds must satisfy min < max")
+        return self
+
+    def mask(self, x_centers: np.ndarray, y_centers: np.ndarray) -> np.ndarray:
+        """Boolean [ny, nx] mask of screen bins inside the rectangle."""
+        in_x = (x_centers >= self.x_min) & (x_centers <= self.x_max)
+        in_y = (y_centers >= self.y_min) & (y_centers <= self.y_max)
+        return in_y[:, None] & in_x[None, :]
+
+
+class PolygonROI(BaseModel):
+    """Closed polygon in screen coordinates."""
+
+    model_config = ConfigDict(frozen=True)
+
+    x: tuple[float, ...]
+    y: tuple[float, ...]
+
+    @model_validator(mode="after")
+    def _enough_points(self) -> PolygonROI:
+        if len(self.x) != len(self.y) or len(self.x) < 3:
+            raise ValueError("Polygon needs >= 3 (x, y) points")
+        return self
+
+    def mask(self, x_centers: np.ndarray, y_centers: np.ndarray) -> np.ndarray:
+        """Boolean [ny, nx] mask via even-odd ray casting (vectorized)."""
+        xs = np.asarray(self.x)
+        ys = np.asarray(self.y)
+        gx, gy = np.meshgrid(x_centers, y_centers)  # [ny, nx]
+        inside = np.zeros(gx.shape, dtype=bool)
+        n = len(xs)
+        for i in range(n):
+            x0, y0 = xs[i], ys[i]
+            x1, y1 = xs[(i + 1) % n], ys[(i + 1) % n]
+            crosses = (y0 > gy) != (y1 > gy)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                x_at = x0 + (gy - y0) * (x1 - x0) / (y1 - y0)
+            inside ^= crosses & (gx < x_at)
+        return inside
+
+
+ROI = RectangleROI | PolygonROI
+
+
+from ..core.constants import PULSE_PERIOD_NS_DEN, PULSE_PERIOD_NS_NUM
+
+PULSE_PERIOD_NS = PULSE_PERIOD_NS_NUM / PULSE_PERIOD_NS_DEN
+"""Full ESS frame in ns (derived from the canonical constants) — the
+default TOA axis must cover the whole pulse or tail events silently vanish
+from histograms."""
+
+
+class TOARange(BaseModel):
+    """Optional time-of-arrival filter window (ns within pulse)."""
+
+    model_config = ConfigDict(frozen=True)
+
+    enabled: bool = True
+    low: float = 0.0
+    high: float = PULSE_PERIOD_NS
+
+    @model_validator(mode="after")
+    def _ordered(self) -> TOARange:
+        if self.high <= self.low:
+            raise ValueError("TOA range must satisfy low < high")
+        return self
+
+
+class WeightingMethod(BaseModel):
+    """Pixel-weighting toggle (reference: detector_view providers.py:98 —
+    compensates solid-angle/projection density)."""
+
+    model_config = ConfigDict(frozen=True)
+
+    enabled: bool = False

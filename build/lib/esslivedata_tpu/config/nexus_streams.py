@@ -1,0 +1,157 @@
+"""Extract stream declarations from a NeXus file; generate registries.
+
+ESS NeXus files double as the instrument's streaming manifest: any group
+written by the file-writer carries ``topic``/``source``/``writer_module``
+attributes naming the Kafka stream that fed it (reference:
+nexus_helpers.py:68 walks the same convention). This module scans a file
+for those declarations and renders the f144 subset into an importable
+``streams_parsed.py`` registry module (ADR 0009: instruments ship O(100)
+generated f144 declarations; hand-written specs only *name* and route
+them).
+
+Regenerate all instrument registries with::
+
+    python scripts/generate_instrument_artifacts.py
+
+or one file ad hoc::
+
+    python -m esslivedata_tpu.config.nexus_streams geometry.nxs --out streams_parsed.py
+"""
+
+from __future__ import annotations
+
+import io
+from dataclasses import dataclass
+from pathlib import Path
+
+__all__ = [
+    "StreamDecl",
+    "scan_stream_groups",
+    "render_registry_module",
+    "generate_registry",
+]
+
+
+@dataclass(frozen=True)
+class StreamDecl:
+    """One stream-declaration group found in a NeXus file."""
+
+    nexus_path: str
+    topic: str
+    source: str
+    writer_module: str
+    units: str | None = None
+    nx_class: str = ""
+
+
+def _attr_str(attrs, name: str) -> str | None:
+    v = attrs.get(name)
+    if v is None:
+        return None
+    if isinstance(v, bytes):
+        return v.decode()
+    return str(v)
+
+
+def scan_stream_groups(path) -> list[StreamDecl]:
+    """All groups carrying both ``topic`` and ``source`` attributes.
+
+    Accepts a filesystem path or an open ``h5py.File``/``Group``.
+    """
+    import h5py
+
+    decls: list[StreamDecl] = []
+
+    def visit(name: str, node) -> None:
+        if not isinstance(node, h5py.Group):
+            return
+        topic = _attr_str(node.attrs, "topic")
+        source = _attr_str(node.attrs, "source")
+        if topic is None or source is None:
+            return
+        decls.append(
+            StreamDecl(
+                nexus_path="/" + name.lstrip("/"),
+                topic=topic,
+                source=source,
+                writer_module=_attr_str(node.attrs, "writer_module") or "",
+                units=_attr_str(node.attrs, "units"),
+                nx_class=_attr_str(node.attrs, "NX_class") or "",
+            )
+        )
+
+    if isinstance(path, (str, Path)):
+        with h5py.File(path, "r") as f:
+            f.visititems(visit)
+    else:
+        path.visititems(visit)
+    decls.sort(key=lambda d: d.nexus_path)
+    return decls
+
+
+def render_registry_module(
+    decls: list[StreamDecl],
+    *,
+    source_file: str | None = None,
+    writer_modules: tuple[str, ...] = ("f144",),
+) -> str:
+    """Render the registry module source for the selected writer modules."""
+    out = io.StringIO()
+    out.write('"""Generated f144 stream registry — do not edit.\n\n')
+    out.write(
+        "Regenerate: python scripts/generate_instrument_artifacts.py\n"
+    )
+    if source_file:
+        out.write(f"Source artifact: {source_file}\n")
+    out.write('"""\n\n')
+    out.write("from esslivedata_tpu.config.stream import F144Stream\n\n")
+    # Compact row form, expanded by a comprehension: one line per stream
+    # keeps multi-hundred-entry registries reviewable in diffs.
+    out.write("# (nexus_path, source, topic, units)\n")
+    out.write("_ROWS: tuple[tuple[str, str, str, str | None], ...] = (\n")
+    for d in decls:
+        if d.writer_module not in writer_modules:
+            continue
+        out.write(
+            f"    ({d.nexus_path!r}, {d.source!r}, {d.topic!r}, {d.units!r}),\n"
+        )
+    out.write(")\n\n")
+    out.write(
+        "PARSED_STREAMS: dict[str, F144Stream] = {\n"
+        "    path: F144Stream(nexus_path=path, source=source, topic=topic, "
+        "units=units)\n"
+        "    for path, source, topic, units in _ROWS\n"
+        "}\n"
+    )
+    return out.getvalue()
+
+
+def generate_registry(
+    nexus_path, out_path, *, source_file: str | None = None
+) -> int:
+    """Scan ``nexus_path`` and write the registry module to ``out_path``.
+    Returns the number of f144 streams emitted."""
+    decls = [
+        d for d in scan_stream_groups(nexus_path) if d.writer_module == "f144"
+    ]
+    text = render_registry_module(decls, source_file=source_file)
+    Path(out_path).write_text(text)
+    return len(decls)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("nexus_file")
+    parser.add_argument("--out", required=True)
+    args = parser.parse_args(argv)
+    n = generate_registry(
+        args.nexus_file, args.out, source_file=Path(args.nexus_file).name
+    )
+    print(f"{args.out}: {n} f144 streams")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,89 @@
+"""ROI stream naming conventions shared by backend and dashboard.
+
+Parity with reference ``config/roi_names.py``: ROIs are identified by a
+global integer index (da00 carries no strings), partitioned by geometry
+type. The mapper renders the stable readback/spectra output keys and the
+per-index display names both sides agree on, so the dashboard can label
+``roi_spectra`` rows and match readbacks to the shapes the user drew.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Literal
+
+from .models import PolygonROI, RectangleROI
+
+__all__ = ["ROIGeometry", "ROIStreamMapper", "default_roi_mapper"]
+
+ROIGeometryType = Literal["rectangle", "polygon"]
+
+
+@dataclass(frozen=True, slots=True)
+class ROIGeometry:
+    """One geometry type's slice of the global ROI index space."""
+
+    geometry_type: ROIGeometryType
+    num_rois: int
+    index_offset: int = 0
+
+    @property
+    def readback_key(self) -> str:
+        """Output name carrying applied-ROI readback for this geometry."""
+        return f"roi_{self.geometry_type}"
+
+    @property
+    def index_range(self) -> range:
+        return range(self.index_offset, self.index_offset + self.num_rois)
+
+    @property
+    def roi_class(self) -> type[RectangleROI] | type[PolygonROI]:
+        if self.geometry_type == "rectangle":
+            return RectangleROI
+        if self.geometry_type == "polygon":
+            return PolygonROI
+        raise ValueError(f"Unknown geometry type: {self.geometry_type}")
+
+    def display_name(self, index: int) -> str:
+        """Stable user-facing label for a global ROI index of this type."""
+        if index not in self.index_range:
+            raise IndexError(f"index {index} outside {self.index_range}")
+        return f"{self.geometry_type}_{index - self.index_offset}"
+
+
+class ROIStreamMapper:
+    """Allocates the global ROI index space across geometry types."""
+
+    def __init__(self, geometries: tuple[ROIGeometry, ...] | None = None) -> None:
+        if geometries is None:
+            geometries = (
+                ROIGeometry(geometry_type="rectangle", num_rois=4, index_offset=0),
+                ROIGeometry(geometry_type="polygon", num_rois=4, index_offset=4),
+            )
+        self.geometries = geometries
+        offsets = sorted(
+            (g.index_offset, g.index_offset + g.num_rois) for g in geometries
+        )
+        for (_, prev_end), (start, _) in zip(offsets, offsets[1:], strict=False):
+            if start < prev_end:
+                raise ValueError("ROI index ranges overlap")
+
+    @property
+    def total_rois(self) -> int:
+        return sum(g.num_rois for g in self.geometries)
+
+    def geometry_for(self, index: int) -> ROIGeometry:
+        for g in self.geometries:
+            if index in g.index_range:
+                return g
+        raise IndexError(f"No geometry owns ROI index {index}")
+
+    def display_name(self, index: int) -> str:
+        return self.geometry_for(index).display_name(index)
+
+    def readback_keys(self) -> list[str]:
+        return [g.readback_key for g in self.geometries]
+
+
+def default_roi_mapper() -> ROIStreamMapper:
+    return ROIStreamMapper()

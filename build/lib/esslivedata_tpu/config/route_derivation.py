@@ -1,0 +1,167 @@
+"""Derive a service's Kafka subscription from the specs it hosts.
+
+Parity with reference ``config/route_derivation.py`` (gather_source_names:14,
+resolve_stream_names:66, scope_stream_mapping:109): a backend service
+subscribes only to the streams its hosted workflow specs actually consume —
+source names, aux sources, spec/instrument context bindings — with Device
+references expanded to their substreams (devices are synthesised in-process;
+the subscription needs the substream topics) and logical detector/monitor
+names (BIFROST's merged ``unified_detector``) expanded to every physical
+stream of that category.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING
+
+from ..workflows.workflow_factory import workflow_registry
+
+logger = logging.getLogger(__name__)
+
+if TYPE_CHECKING:
+    from ..kafka.stream_mapping import StreamMapping
+    from .instrument import Instrument
+    from .workflow_spec import WorkflowSpec
+
+__all__ = [
+    "gather_source_names",
+    "resolve_stream_names",
+    "scope_stream_mapping",
+    "spec_service",
+]
+
+#: Namespace prefix -> hosting service, for specs that don't set ``service``.
+_NAMESPACE_SERVICE: tuple[tuple[str, str], ...] = (
+    ("detector", "detector_data"),
+    ("monitor", "monitor_data"),
+    ("timeseries", "timeseries"),
+    ("diagnostics", "timeseries"),
+)
+
+
+def spec_service(spec: WorkflowSpec) -> str:
+    """The backend service hosting a spec (explicit field or namespace)."""
+    if spec.service:
+        return spec.service
+    for prefix, service in _NAMESPACE_SERVICE:
+        if spec.namespace.startswith(prefix):
+            return service
+    return "data_reduction"
+
+
+def gather_source_names(instrument: Instrument, service: str) -> set[str]:
+    """Stream names the specs hosted by ``service`` depend on.
+
+    Device references expand into their substream names. Instrument-level
+    context bindings apply when any hosted spec shares a source with the
+    binding's dependent_sources (or the binding is unscoped).
+    """
+    specs = [
+        s
+        for s in workflow_registry.specs_for_instrument(instrument.name)
+        if spec_service(s) == service
+    ]
+    names: set[str] = set()
+    for spec in specs:
+        names.update(spec.source_names)
+        for choices in spec.aux_source_names.values():
+            names.update(choices)
+        names.update(spec.context_keys)
+        # Optional context is routed like gating context — the service
+        # must consume the stream to deliver it — the difference is
+        # purely that jobs do not hold for it.
+        names.update(spec.optional_context_keys)
+    for binding in instrument.context_bindings:
+        if not binding.dependent_sources or any(
+            set(spec.source_names) & binding.dependent_sources for spec in specs
+        ):
+            names.add(binding.stream_name)
+    if service == "timeseries":
+        # Synthesis inputs owned by the timeseries service: the chopper
+        # synthesizer consumes each chopper's speed-setpoint and delay
+        # readback PVs; the device synthesizer consumes every device's
+        # substreams. These are inputs of the synthesis layer, not of any
+        # one spec, so they are added here rather than via spec sources.
+        from .chopper import delay_readback_stream, speed_setpoint_stream
+
+        for chopper in instrument.choppers:
+            names.add(speed_setpoint_stream(chopper))
+            names.add(delay_readback_stream(chopper))
+        for device in instrument.devices.values():
+            names.update(device.substream_names)
+    devices = instrument.devices
+    for name in list(names):
+        if (device := devices.get(name)) is not None:
+            names.discard(name)
+            names.update(device.substream_names)
+    return names
+
+
+def resolve_stream_names(
+    needed: set[str],
+    instrument: Instrument,
+    stream_mapping: StreamMapping,
+) -> set[str]:
+    """Expand logical source names to the physical names in the LUTs.
+
+    A logical name absent from every LUT (BIFROST's merged detector) pulls
+    in all physical names of its category. Synthesised streams (cascade
+    trigger, delay setpoints, Device merges) have no LUT entry and simply
+    drop out — they never ride Kafka.
+    """
+    known = stream_mapping.all_stream_names
+    resolved = needed & known
+    unknown = needed - known
+    if not unknown:
+        return resolved
+    from ..kafka.stream_mapping import MERGED_DETECTOR_STREAM
+
+    if unknown & set(instrument.detector_names) or (
+        instrument.merge_detectors and MERGED_DETECTOR_STREAM in unknown
+    ):
+        # merge_detectors: specs address the single merged logical stream,
+        # which appears in no LUT — the subscription needs every physical
+        # bank. Other unknown names (synthesised streams) still drop out.
+        resolved |= set(stream_mapping.detectors.values())
+    if unknown & set(instrument.monitor_names):
+        resolved |= set(stream_mapping.monitors.values())
+
+    # Anything still unexplained is neither a LUT entry, a logical
+    # detector/monitor name, nor a declared synthesised stream: almost
+    # certainly a typo'd source_name in a spec, whose job would otherwise
+    # wait for data forever with no diagnostic.
+    from .chopper import CHOPPER_CASCADE_SOURCE, delay_setpoint_stream
+
+    synthesized = {CHOPPER_CASCADE_SOURCE}
+    synthesized.update(
+        delay_setpoint_stream(chopper) for chopper in instrument.choppers
+    )
+    synthesized.update(instrument.devices)
+    unexplained = (
+        unknown
+        - set(instrument.detector_names)
+        - set(instrument.monitor_names)
+        - {MERGED_DETECTOR_STREAM}
+        - synthesized
+    )
+    if unexplained:
+        logger.warning(
+            "Source names %s for instrument %s match no stream LUT entry, "
+            "logical detector/monitor name, or synthesised stream; jobs "
+            "referencing them will never receive data (typo in a spec?)",
+            sorted(unexplained),
+            instrument.name,
+        )
+    return resolved
+
+
+def scope_stream_mapping(
+    instrument: Instrument,
+    stream_mapping: StreamMapping,
+    service: str,
+) -> StreamMapping:
+    """gather + resolve + filter in one call (the service-builder entry)."""
+    needed = gather_source_names(instrument, service)
+    needed = resolve_stream_names(needed, instrument, stream_mapping)
+    return stream_mapping.filtered(needed)

@@ -1,0 +1,318 @@
+"""Stream records: what flows on the wire, independent of what it's called.
+
+Every piece of live data a service can consume — detector event streams,
+monitor streams, f144 sample-environment logs, synthesized motor devices —
+is declared as one record here. Records carry wire identity only (schema,
+Kafka coordinates, NeXus origin). Instrument-facing *names* are assigned
+separately by :func:`name_streams`, and those names are what the rest of
+the system routes on; Kafka topic/source matter solely at the byte
+boundary where messages arrive.
+
+Field names (``writer_module``/``nexus_path``/``topic``/``source``/
+``nx_class``; ``value``/``target``/``idle`` for devices) are the shared
+domain vocabulary of the ESS streaming stack (cf. reference
+``config/stream.py``) and are kept so generated registries read the same;
+everything else — validation, naming, device detection — is this
+codebase's own design.
+
+Construction is fail-fast: a malformed record or a name collision raises
+while the instrument module imports, never at message time.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Iterable, Mapping
+from dataclasses import dataclass
+
+__all__ = [
+    "ContextBinding",
+    "Device",
+    "F144Stream",
+    "Stream",
+    "filter_authorized_streams",
+    "name_streams",
+    "suggest_names",
+]
+
+
+@dataclass(frozen=True, slots=True, kw_only=True)
+class Stream:
+    """One streaming data declaration at the wire level.
+
+    Three shapes are legal:
+
+    * **Kafka-borne** — ``topic`` and ``source`` both set; ``nexus_path``
+      optional (hand-written registry rows may predate a geometry file).
+    * **In-process** — all three None. Produced by synthesizers; bytes for
+      these never exist on a broker.
+    * Anything with exactly one of ``topic``/``source`` set is a broken
+      declaration and is rejected here rather than surfacing later as an
+      unroutable message.
+    """
+
+    writer_module: str
+    nexus_path: str | None = None
+    topic: str | None = None
+    source: str | None = None
+    nx_class: str = ""
+
+    def __post_init__(self) -> None:
+        if (self.topic is None) != (self.source is None):
+            where = self.nexus_path or "<in-process>"
+            raise ValueError(
+                f"stream at {where}: kafka identity is all-or-nothing, got "
+                f"topic={self.topic!r} with source={self.source!r}"
+            )
+
+
+@dataclass(frozen=True, slots=True, kw_only=True)
+class F144Stream(Stream):
+    """Scalar log stream (f144 schema): timestamped numeric samples."""
+
+    units: str | None = None
+    writer_module: str = "f144"
+    nx_class: str = "NXlog"
+
+
+@dataclass(frozen=True, slots=True, kw_only=True)
+class Device(Stream):
+    """A motor-like device assembled in-process from its EPICS log streams.
+
+    ``DeviceSynthesizer`` watches the named substreams and emits a merged
+    per-device record stream: ``value`` names the readback substream
+    (required), ``target`` the setpoint, ``idle`` the motion-done flag.
+    All three are *names* (keys produced by :func:`name_streams`), not
+    paths — a Device is wired after naming, so it survives renames of the
+    underlying NeXus groups.
+    """
+
+    value: str
+    target: str | None = None
+    idle: str | None = None
+    units: str | None = None
+    writer_module: str = "device"
+    nx_class: str = "NXpositioner"
+
+    @property
+    def substream_names(self) -> tuple[str, ...]:
+        return tuple(
+            n for n in (self.value, self.target, self.idle) if n is not None
+        )
+
+
+@dataclass(frozen=True, slots=True, kw_only=True)
+class ContextBinding:
+    """Routes one stream's latest value into workflows as named context.
+
+    Workflows in this framework are jitted step functions taking named
+    context scalars, so ``workflow_key`` is a plain string (the reference
+    binds sciline graph keys here instead). Jobs for any source in
+    ``dependent_sources`` hold in ``pending_context`` until the stream has
+    delivered at least one value. Bindings live in their own list on the
+    instrument — usage of a stream is deliberately not a field of the
+    stream itself.
+    """
+
+    stream_name: str
+    workflow_key: str
+    dependent_sources: frozenset[str]
+
+
+#: Structural NeXus groups that carry no identity of their own; stripped
+#: before deriving names so 'entry/instrument/wfm1/transformations/t1'
+#: names as 'wfm1/t1'.
+_GENERIC_GROUPS: frozenset[str] = frozenset(
+    {"entry", "instrument", "sample", "sample_environment", "transformations"}
+)
+
+
+def suggest_names(
+    paths: Iterable[str],
+    *,
+    min_depth: int = 2,
+    forbidden: Iterable[str] | None = None,
+) -> dict[str, str]:
+    """Derive a unique short name for each NeXus group path.
+
+    The name is the shortest tail (at least ``min_depth`` components) of
+    the path with generic container groups removed, provided it is unique
+    within the set and not in ``forbidden``. Ambiguous paths escalate to
+    longer tails; as a last resort the full unfiltered path (unique by
+    HDF5 construction) is used.
+    """
+    paths = list(paths)
+    forbidden_set = frozenset(forbidden or ())
+    full = {p: p.strip("/").split("/") for p in paths}
+    filtered = {
+        p: [c for c in full[p] if c not in _GENERIC_GROUPS] or full[p]
+        for p in paths
+    }
+
+    result: dict[str, str] = {}
+    pending = set(paths)
+    for parts in (filtered, full):
+        if not pending:
+            break
+        max_depth = max((len(parts[p]) for p in pending), default=1)
+        depth = min_depth
+        while pending and depth <= max(max_depth, min_depth):
+            candidate = {
+                p: "/".join(parts[p][-min(depth, len(parts[p])):])
+                for p in pending
+            }
+            counts: dict[str, int] = {}
+            for name in candidate.values():
+                counts[name] = counts.get(name, 0) + 1
+            still: set[str] = set()
+            for path, name in candidate.items():
+                if counts[name] == 1 and name not in forbidden_set:
+                    result[path] = name
+                else:
+                    still.add(path)
+            pending = still
+            depth += 1
+    return result
+
+
+@dataclass(slots=True)
+class _MotorParts:
+    """Role slots accumulated while scanning one NeXus parent group.
+
+    EPICS motor records expose their state as separate PVs whose names end
+    in a role-identifying suffix; an f144 stream is slotted by that suffix
+    of its Kafka source. A parent qualifies as a device once the readback
+    slot is filled plus at least one of setpoint / motion-done.
+    """
+
+    readback: str | None = None  # <pv>.RBV
+    setpoint: str | None = None  # <pv>.VAL
+    moving_done: str | None = None  # <pv>.DMOV
+
+    _SUFFIXES = (
+        (".RBV", "readback"),
+        (".VAL", "setpoint"),
+        (".DMOV", "moving_done"),
+    )
+
+    def take(self, parent: str, path: str, source: str) -> None:
+        for suffix, slot in self._SUFFIXES:
+            if not source.endswith(suffix):
+                continue
+            if getattr(self, slot) is not None:
+                raise ValueError(
+                    f"motor group {parent!r}: {getattr(self, slot)!r} and "
+                    f"{path!r} both end in {suffix} — ambiguous device"
+                )
+            setattr(self, slot, path)
+            return
+
+    @property
+    def is_device(self) -> bool:
+        return self.readback is not None and (
+            self.setpoint is not None or self.moving_done is not None
+        )
+
+
+def _detect_devices(parsed: Mapping[str, Stream]) -> dict[str, _MotorParts]:
+    """Find motor devices among the parsed f144 streams.
+
+    Sibling f144 streams under one NeXus parent whose EPICS sources carry
+    motor-record suffixes are grouped; qualifying groups become Devices in
+    :func:`name_streams`. Readback/setpoint unit disagreement is a
+    registry bug and raises.
+    """
+    groups: dict[str, _MotorParts] = {}
+    for path, stream in parsed.items():
+        if isinstance(stream, F144Stream) and stream.source is not None:
+            parent = path.rsplit("/", 1)[0] if "/" in path else ""
+            parts = groups.setdefault(parent, _MotorParts())
+            parts.take(parent, path, stream.source)
+
+    devices: dict[str, _MotorParts] = {}
+    for parent, parts in groups.items():
+        if not parts.is_device:
+            continue
+        if parts.setpoint is not None:
+            rbv, val = parsed[parts.readback], parsed[parts.setpoint]
+            ru = rbv.units if isinstance(rbv, F144Stream) else None
+            vu = val.units if isinstance(val, F144Stream) else None
+            if ru != vu:
+                raise ValueError(
+                    f"motor group {parent!r}: readback reports units {ru!r} "
+                    f"but setpoint reports {vu!r}"
+                )
+        devices[parent] = parts
+    return devices
+
+
+#: f144 topics our PROD credentials may read. The facility ACL list is
+#: incomplete, so authorization is granted per topic-family suffix, plus
+#: the general data topic.
+_READABLE_SUFFIXES: tuple[str, ...] = ("_choppers", "_motion", "_sample_env")
+_READABLE_TOPICS: frozenset[str] = frozenset({"tn_data_general"})
+
+
+def filter_authorized_streams(parsed: dict[str, Stream]) -> dict[str, Stream]:
+    """Keep only streams readable under the production ACL grants."""
+
+    def readable(s: Stream) -> bool:
+        return s.topic is not None and (
+            s.topic in _READABLE_TOPICS or s.topic.endswith(_READABLE_SUFFIXES)
+        )
+
+    return {path: s for path, s in parsed.items() if readable(s)}
+
+
+def name_streams(
+    parsed: dict[str, Stream],
+    *,
+    rename: dict[str, str] | None = None,
+) -> dict[str, Stream]:
+    """Turn a path-keyed parse result into the name-keyed stream registry.
+
+    Names come from :func:`suggest_names` — substreams first (tails of at
+    least two components), then detected device parents (one component,
+    with all substream names forbidden so the two namespaces cannot
+    collide). Entries in ``rename`` (keyed by NeXus path) win over
+    suggestions. Detected motor groups are emitted as :class:`Device`
+    records whose slots hold the *names* of their substreams.
+    """
+    rename = rename or {}
+    devices = _detect_devices(parsed)
+    nameable = set(parsed) | set(devices)
+    if unknown := set(rename) - nameable:
+        raise ValueError(
+            f"rename targets nothing parsed or detected: {sorted(unknown)}"
+        )
+    sub_names = suggest_names(parsed.keys())
+    parent_names = suggest_names(
+        devices.keys(), min_depth=1, forbidden=sub_names.values()
+    )
+    chosen = {**sub_names, **parent_names, **rename}
+
+    result: dict[str, Stream] = {}
+
+    def place(path: str, stream: Stream) -> None:
+        name = chosen[path]
+        if name in result:
+            raise ValueError(
+                f"two streams both want the name {name!r} "
+                f"(second is {path!r}) — disambiguate via rename"
+            )
+        result[name] = stream
+
+    for path, stream in parsed.items():
+        place(path, stream)
+    for parent, parts in devices.items():
+        rbv = parsed[parts.readback]
+        place(
+            parent,
+            Device(
+                nexus_path=parent,
+                value=chosen[parts.readback],
+                target=chosen[parts.setpoint] if parts.setpoint else None,
+                idle=chosen[parts.moving_done] if parts.moving_done else None,
+                units=rbv.units if isinstance(rbv, F144Stream) else None,
+            ),
+        )
+    return result

@@ -1,0 +1,87 @@
+"""Topic naming + per-instrument stream mapping construction.
+
+Parity with reference ``config/streams.py`` (stream_kind_to_topic:20,
+get_stream_mapping:54): raw topics follow the facility convention
+``{instrument}_detector|_monitor|_camera|_motion|_runInfo``; our own
+output/control topics are the ``{instrument}_livedata_*`` family
+(kafka/stream_mapping.LivedataTopics). DEV mode prefixes topics so a dev
+broker can coexist with production.
+"""
+
+from __future__ import annotations
+
+from ..core.message import StreamKind
+from ..kafka.stream_mapping import InputStreamKey, StreamMapping
+from .instrument import Instrument
+
+__all__ = ["get_stream_mapping", "stream_kind_to_topic"]
+
+
+def stream_kind_to_topic(instrument: str, kind: StreamKind, dev: bool = False) -> str:
+    prefix = f"dev_{instrument}" if dev else instrument
+    suffix = {
+        StreamKind.DETECTOR_EVENTS: "detector",
+        StreamKind.MONITOR_EVENTS: "monitor",
+        StreamKind.MONITOR_COUNTS: "monitor",
+        StreamKind.AREA_DETECTOR: "camera",
+        StreamKind.LOG: "motion",
+        StreamKind.DEVICE: "motion",
+        StreamKind.RUN_CONTROL: "runInfo",
+    }.get(kind)
+    if suffix is None:
+        raise ValueError(f"No raw topic for stream kind {kind}")
+    return f"{prefix}_{suffix}"
+
+
+def get_stream_mapping(instrument: Instrument, dev: bool = False) -> StreamMapping:
+    name = instrument.name
+    det_topic = stream_kind_to_topic(name, StreamKind.DETECTOR_EVENTS, dev)
+    mon_topic = stream_kind_to_topic(name, StreamKind.MONITOR_EVENTS, dev)
+    cam_topic = stream_kind_to_topic(name, StreamKind.AREA_DETECTOR, dev)
+    log_topic = stream_kind_to_topic(name, StreamKind.LOG, dev)
+    run_topic = stream_kind_to_topic(name, StreamKind.RUN_CONTROL, dev)
+    return StreamMapping(
+        dev=dev,
+        instrument=name,
+        detectors={
+            InputStreamKey(topic=det_topic, source_name=d.source_name): d.name
+            for d in instrument.detectors.values()
+        },
+        monitors={
+            InputStreamKey(topic=mon_topic, source_name=m.source_name): m.name
+            for m in instrument.monitors.values()
+        },
+        pixellated_monitors=frozenset(instrument.pixellated_monitor_names),
+        area_detectors={
+            InputStreamKey(topic=cam_topic, source_name=c.source_name): c.name
+            for c in instrument.cameras.values()
+        },
+        logs=_build_logs_lut(instrument, log_topic, dev),
+        run_control_topics=(run_topic,),
+    )
+
+
+def _build_logs_lut(
+    instrument: Instrument, log_topic: str, dev: bool
+) -> dict[InputStreamKey, str]:
+    """Merge log_sources (convention topic) with catalog streams (declared
+    topics). Catalog topics get the same dev prefix as convention topics so
+    a dev broker never shadows or consumes production streams; synthesised
+    catalog entries (topic None) never ride Kafka and stay out of the LUT.
+    Duplicate (topic, source) keys are a misconfiguration and raise."""
+    lut: dict[InputStreamKey, str] = {
+        InputStreamKey(topic=log_topic, source_name=source): stream
+        for stream, source in instrument.log_sources.items()
+    }
+    for stream_name, s in instrument.streams.items():
+        if s.topic is None or s.source is None:
+            continue
+        topic = f"dev_{s.topic}" if dev else s.topic
+        key = InputStreamKey(topic=topic, source_name=s.source)
+        if key in lut:
+            raise ValueError(
+                f"Stream {stream_name!r} and {lut[key]!r} both claim "
+                f"(topic={key.topic!r}, source={key.source_name!r})"
+            )
+        lut[key] = stream_name
+    return lut

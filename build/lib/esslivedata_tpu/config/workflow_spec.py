@@ -1,0 +1,238 @@
+"""The command/identity vocabulary shared by services, dashboard and wire.
+
+Parity with reference ``config/workflow_spec.py`` (WorkflowSpec:312,
+WorkflowId:146, JobId:179, JobSchedule:519, WorkflowConfig:551,
+ResultKey:275, OutputView:43): pydantic models so that (a) commands
+round-trip JSON on the Kafka commands topic and (b) params models *are* the
+dashboard's auto-generated UI schema. Output templates are empty labeled
+DataArrays that drive plotter auto-selection (reference :366-383).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Callable, Literal
+
+from pydantic import BaseModel, ConfigDict, Field, field_validator
+
+from ..core.timestamp import Timestamp
+from ..utils.labeled import DataArray
+
+__all__ = [
+    "JobId",
+    "JobSchedule",
+    "OutputSpec",
+    "ResultKey",
+    "WorkflowConfig",
+    "WorkflowId",
+    "WorkflowSpec",
+]
+
+
+_ID_FORBIDDEN = set("|/")
+
+
+def _check_id_field(value: str) -> str:
+    if not value or _ID_FORBIDDEN & set(value):
+        raise ValueError(
+            f"Identifier field {value!r} must be non-empty and contain no "
+            "'|' or '/' (reserved for the ResultKey wire encoding)"
+        )
+    return value
+
+
+def _check_pipe_free(value: str) -> str:
+    """Source/output names ride as single '|'-separated ResultKey fields, so
+    only '|' is reserved; '/' is allowed (catalog stream names follow the
+    NeXus path convention, e.g. 'c1/delay_setpoint', 'motor/value')."""
+    if not value or "|" in value:
+        raise ValueError(
+            f"Name {value!r} must be non-empty and contain no '|' "
+            "(reserved for the ResultKey wire encoding)"
+        )
+    return value
+
+
+class WorkflowId(BaseModel):
+    """Identifies a workflow implementation (not an instance)."""
+
+    model_config = ConfigDict(frozen=True)
+
+    instrument: str
+    namespace: str = "default"
+    name: str
+    version: int = 1
+
+    @field_validator("instrument", "namespace", "name")
+    @classmethod
+    def _safe_fields(cls, v: str) -> str:
+        return _check_id_field(v)
+
+    def __str__(self) -> str:
+        return f"{self.instrument}/{self.namespace}/{self.name}/v{self.version}"
+
+    @classmethod
+    def parse(cls, s: str) -> WorkflowId:
+        instrument, namespace, name, v = s.split("/")
+        return cls(
+            instrument=instrument,
+            namespace=namespace,
+            name=name,
+            version=int(v.lstrip("v")),
+        )
+
+
+class JobId(BaseModel):
+    """One running workflow instance bound to one source."""
+
+    model_config = ConfigDict(frozen=True)
+
+    source_name: str
+    job_number: uuid.UUID = Field(default_factory=uuid.uuid4)
+
+    @field_validator("source_name")
+    @classmethod
+    def _safe_source(cls, v: str) -> str:
+        return _check_pipe_free(v)
+
+    def __str__(self) -> str:
+        return f"{self.source_name}:{self.job_number}"
+
+
+class JobSchedule(BaseModel):
+    """Data-time activation window (ns epoch); None = immediately/forever.
+
+    Jobs activate when *data time* reaches start_time and finish when it
+    passes end_time — never wall clock (reference job_manager.py:357)."""
+
+    model_config = ConfigDict(frozen=True)
+
+    start_time_ns: int | None = None
+    end_time_ns: int | None = None
+
+    @property
+    def start(self) -> Timestamp | None:
+        return None if self.start_time_ns is None else Timestamp(self.start_time_ns)
+
+    @property
+    def end(self) -> Timestamp | None:
+        return None if self.end_time_ns is None else Timestamp(self.end_time_ns)
+
+
+class WorkflowConfig(BaseModel):
+    """The start-job command as it travels the commands topic."""
+
+    identifier: WorkflowId
+    job_id: JobId
+    params: dict[str, Any] = Field(default_factory=dict)
+    aux_source_names: dict[str, str] = Field(default_factory=dict)
+    schedule: JobSchedule = Field(default_factory=JobSchedule)
+
+
+class ResultKey(BaseModel):
+    """Routing key stamped on every published result. Travels compactly as
+    the da00 source_name so the dashboard can route without extra headers."""
+
+    model_config = ConfigDict(frozen=True)
+
+    workflow_id: WorkflowId
+    job_id: JobId
+    output_name: str
+
+    @field_validator("output_name")
+    @classmethod
+    def _safe_output(cls, v: str) -> str:
+        return _check_pipe_free(v)
+
+    def to_string(self) -> str:
+        return (
+            f"{self.workflow_id}|{self.job_id.source_name}"
+            f"|{self.job_id.job_number}|{self.output_name}"
+        )
+
+    @classmethod
+    def from_string(cls, s: str) -> ResultKey:
+        wid, source, job_number, output = s.split("|")
+        return cls(
+            workflow_id=WorkflowId.parse(wid),
+            job_id=JobId(source_name=source, job_number=uuid.UUID(job_number)),
+            output_name=output,
+        )
+
+
+class OutputSpec(BaseModel):
+    """Declares one named workflow output.
+
+    ``template`` produces an empty DataArray with the output's dims, units
+    and coords — the dashboard selects plotters from it without running the
+    workflow (reference workflow_spec.py:366-383). ``view`` distinguishes
+    per-update (window) from since-start (cumulative) outputs.
+    """
+
+    model_config = ConfigDict(arbitrary_types_allowed=True)
+
+    title: str = ""
+    description: str = ""
+    view: Literal["per_update", "since_start"] = "per_update"
+    template: Callable[[], DataArray] | None = None
+
+
+class WorkflowSpec(BaseModel):
+    """Declarative description of a workflow: what it consumes, its
+    parameter schema, and the outputs it produces."""
+
+    model_config = ConfigDict(arbitrary_types_allowed=True)
+
+    instrument: str
+    namespace: str = "default"
+    name: str
+    version: int = 1
+    title: str = ""
+    description: str = ""
+    source_names: list[str] = Field(default_factory=list)
+    aux_source_names: dict[str, list[str]] = Field(default_factory=dict)
+    params_model: type[BaseModel] | None = None
+    outputs: dict[str, OutputSpec] = Field(default_factory=dict)
+    # output_name -> NICOS device-name template; ``{source_name}`` is the
+    # only placeholder. Outputs listed here are republished on the stable
+    # NICOS device topic (reference workflow_spec.py device_outputs, ADR 0006).
+    device_outputs: dict[str, str] = Field(default_factory=dict)
+    context_keys: list[str] = Field(default_factory=list)
+    #: Context streams delivered WHEN AVAILABLE but never gated on —
+    #: live calibrations with a static-param fallback (e.g. the powder
+    #: emission offset). Gating keys above hold the job until a value
+    #: exists; optional keys must not strand jobs in deployments where
+    #: the stream is not produced.
+    optional_context_keys: list[str] = Field(default_factory=list)
+    reset_on_run_transition: bool = True
+    service: str | None = None
+    """Backend service hosting this spec (detector_data/monitor_data/
+    data_reduction/timeseries). None = derive from the namespace
+    (route_derivation.spec_service); display grouping and hosting service
+    are decoupled, as in the reference's per-registration service field."""
+
+    @field_validator("source_names")
+    @classmethod
+    def _nonempty_names(cls, v: list[str]) -> list[str]:
+        if any(not s for s in v):
+            raise ValueError("source names must be non-empty")
+        return v
+
+    @property
+    def identifier(self) -> WorkflowId:
+        return WorkflowId(
+            instrument=self.instrument,
+            namespace=self.namespace,
+            name=self.name,
+            version=self.version,
+        )
+
+    def validate_params(self, params: dict[str, Any]) -> BaseModel | None:
+        """Parse raw command params through this spec's model."""
+        if self.params_model is None:
+            if params:
+                raise ValueError(
+                    f"Workflow {self.identifier} accepts no params, got {params}"
+                )
+            return None
+        return self.params_model.model_validate(params)

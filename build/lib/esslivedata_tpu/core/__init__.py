@@ -1,0 +1,38 @@
+"""Core runtime: domain types, batchers, service loop, jobs, control plane.
+
+Mirrors the responsibilities of the reference's ``src/ess/livedata/core/``
+(SURVEY.md section 2.1) with the same protocol seams — MessageSource /
+MessageSink / Processor / Accumulator / Workflow — so every layer above and
+below can be faked in tests exactly like the reference does.
+"""
+
+from .message import (
+    COMMAND_STREAM,
+    RESPONSE_STREAM,
+    RUN_CONTROL_STREAM,
+    STATUS_STREAM,
+    Message,
+    MessageSink,
+    MessageSource,
+    RunStart,
+    RunStop,
+    StreamId,
+    StreamKind,
+)
+from .timestamp import Duration, Timestamp
+
+__all__ = [
+    "COMMAND_STREAM",
+    "Duration",
+    "Message",
+    "MessageSink",
+    "MessageSource",
+    "RESPONSE_STREAM",
+    "RUN_CONTROL_STREAM",
+    "RunStart",
+    "RunStop",
+    "STATUS_STREAM",
+    "StreamId",
+    "StreamKind",
+    "Timestamp",
+]

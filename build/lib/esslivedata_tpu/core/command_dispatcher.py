@@ -1,0 +1,160 @@
+"""Command routing with shared-topic ownership semantics.
+
+Parity with reference ``core/job_manager_adapter.py`` (:14, silence-if-not-
+owner :26-56) and ``core/command_dispatcher.py`` (:18): all services share
+one commands topic; a service acks/errs only commands for workflows *it*
+hosts and stays silent otherwise, so each command gets exactly one reply
+across the fleet.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections.abc import Sequence
+
+from pydantic import ValidationError
+
+from ..config.acknowledgement import CommandAcknowledgement
+from ..config.workflow_spec import WorkflowConfig
+from ..workflows.workflow_factory import WorkflowFactory, workflow_registry
+from .job_manager import JobCommand, JobManager
+from .message import Message
+
+__all__ = ["CommandDispatcher"]
+
+logger = logging.getLogger(__name__)
+
+
+class CommandDispatcher:
+    def __init__(
+        self,
+        *,
+        job_manager: JobManager,
+        instrument: str,
+        service_name: str = "",
+        registry: WorkflowFactory | None = None,
+    ) -> None:
+        self._job_manager = job_manager
+        self._instrument = instrument
+        self._service_name = service_name
+        self._registry = registry if registry is not None else workflow_registry
+
+    def _owns(self, config: WorkflowConfig) -> bool:
+        wid = config.identifier
+        if not (
+            wid.instrument == self._instrument
+            and wid in self._registry
+            and self._registry.has_factory(wid)
+        ):
+            return False
+        # All of an instrument's factories load in every service process, so
+        # a factory being attached is not ownership — the hosting service is
+        # (matching the subscription scoping: a non-hosting service has no
+        # data streams for the job and would ack then sit idle forever).
+        if self._service_name:
+            from ..config.route_derivation import spec_service
+
+            return spec_service(self._registry[wid]) == self._service_name
+        return True
+
+    def process_messages(
+        self, messages: Sequence[Message]
+    ) -> list[CommandAcknowledgement]:
+        acks: list[CommandAcknowledgement] = []
+        for msg in messages:
+            value = msg.value
+            if isinstance(value, WorkflowConfig):
+                if not self._owns(value):
+                    continue  # another service's workflow: stay silent
+                acks.append(self._start_job(value))
+            elif isinstance(value, dict) and value.get("kind") == "job_command":
+                ack = self._job_command(value)
+                if ack is not None:
+                    acks.append(ack)
+            elif isinstance(value, dict) and value.get("kind") == "roi_update":
+                ack = self._roi_update(value)
+                if ack is not None:
+                    acks.append(ack)
+            else:
+                logger.warning("Unrecognized command payload: %r", type(value))
+        return acks
+
+    def _start_job(self, config: WorkflowConfig) -> CommandAcknowledgement:
+        try:
+            self._job_manager.schedule_job(config)
+            return CommandAcknowledgement(
+                source_name=config.job_id.source_name,
+                job_number=config.job_id.job_number,
+                status="ack",
+                service=self._service_name,
+            )
+        except Exception as err:
+            logger.exception("Failed to schedule job %s", config.job_id)
+            return CommandAcknowledgement(
+                source_name=config.job_id.source_name,
+                job_number=config.job_id.job_number,
+                status="error",
+                message=f"{type(err).__name__}: {err}",
+                service=self._service_name,
+            )
+
+    def _job_command(self, payload: dict) -> CommandAcknowledgement | None:
+        try:
+            command = JobCommand.model_validate(payload)
+        except ValidationError:
+            logger.warning("Malformed job command: %r", payload)
+            return None
+        try:
+            if self._job_manager.handle_command(command) == 0:
+                return None  # not our job: silent (another service owns it)
+            status, message = "ack", ""
+        except Exception as err:
+            status, message = "error", f"{type(err).__name__}: {err}"
+        return CommandAcknowledgement(
+            source_name=command.source_name,
+            job_number=command.job_number,
+            status=status,
+            message=message,
+            service=self._service_name,
+        )
+
+    def _roi_update(self, payload: dict) -> CommandAcknowledgement | None:
+        """ROI updates route to the job's workflow if it supports set_rois
+        (the detector-view round trip, reference roi readbacks)."""
+        try:
+            command = JobCommand.model_validate({**payload, "action": "reset"})
+        except ValidationError:
+            logger.warning("Malformed roi update: %r", payload)
+            return None
+        rois = payload.get("rois", {})
+        with self._job_manager._lock:  # noqa: SLF001
+            for jid, rec in self._job_manager._records.items():  # noqa: SLF001
+                if (
+                    jid.source_name == command.source_name
+                    and jid.job_number == command.job_number
+                ):
+                    wf = rec.job.workflow
+                    if hasattr(wf, "set_rois"):
+                        try:
+                            from ..config.models import PolygonROI, RectangleROI
+
+                            parsed = {
+                                name: (
+                                    RectangleROI.model_validate(r)
+                                    if "x_min" in r
+                                    else PolygonROI.model_validate(r)
+                                )
+                                for name, r in rois.items()
+                            }
+                            wf.set_rois(parsed)
+                            status, message = "ack", ""
+                        except Exception as err:
+                            status, message = "error", str(err)
+                        return CommandAcknowledgement(
+                            source_name=command.source_name,
+                            job_number=command.job_number,
+                            status=status,
+                            message=message,
+                            service=self._service_name,
+                        )
+        return None

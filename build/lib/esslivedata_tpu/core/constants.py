@@ -1,0 +1,8 @@
+"""Shared physical/operational constants (reference: core/constants.py:4)."""
+
+PULSE_RATE_HZ = 14.0
+"""ESS source pulse rate; the data-time grid all batching quantizes to."""
+
+PULSE_PERIOD_NS_NUM = 10**9
+PULSE_PERIOD_NS_DEN = 14
+"""Pulse period as an exact rational (ns) to keep grid math integer-exact."""

@@ -1,0 +1,42 @@
+"""In-memory message source/sink doubles (reference: fakes.py:11,28)."""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+from .message import Message
+
+__all__ = ["FakeMessageSink", "FakeMessageSource"]
+
+
+class FakeMessageSource:
+    """Yields pre-loaded message batches, one batch per ``get_messages``."""
+
+    def __init__(self, messages: Sequence[Sequence[Message]] = ()) -> None:
+        self._batches: list[list[Message]] = [list(b) for b in messages]
+        self._index = 0
+
+    def append(self, batch: Sequence[Message]) -> None:
+        self._batches.append(list(batch))
+
+    def get_messages(self) -> list[Message]:
+        if self._index >= len(self._batches):
+            return []
+        batch = self._batches[self._index]
+        self._index += 1
+        return batch
+
+    @property
+    def exhausted(self) -> bool:
+        return self._index >= len(self._batches)
+
+
+class FakeMessageSink:
+    def __init__(self) -> None:
+        self.messages: list[Message] = []
+
+    def publish_messages(self, messages: Sequence[Message]) -> None:
+        self.messages.extend(messages)
+
+    def clear(self) -> None:
+        self.messages.clear()

@@ -1,0 +1,264 @@
+"""Job = one workflow instance bound to one source, plus wire-status models.
+
+Parity with reference ``core/job.py``: Job:255 (add/process/get with time
+coords stamped on outputs :209), JobState:95 phases, JobStatus:59,
+ServiceStatus:193, stream-lag model :141-177 with WARN >= 2 s stale /
+ERROR > 0.1 s future thresholds (:132-138).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from collections.abc import Mapping
+from enum import StrEnum
+from typing import Any
+
+import numpy as np
+from pydantic import BaseModel, Field
+
+from ..config.workflow_spec import JobId, JobSchedule, ResultKey, WorkflowId
+from ..utils.labeled import DataArray, Variable
+from ..workflows.workflow_factory import Workflow
+from .timestamp import Duration, Timestamp
+
+__all__ = [
+    "Job",
+    "JobResult",
+    "JobState",
+    "JobStatus",
+    "ServiceStatus",
+    "StreamLag",
+    "StreamLagReport",
+]
+
+STALE_WARN_THRESHOLD = Duration.from_s(2.0)
+FUTURE_ERROR_THRESHOLD = Duration.from_s(0.1)
+
+
+class JobState(StrEnum):
+    SCHEDULED = "scheduled"
+    PENDING_CONTEXT = "pending_context"
+    ACTIVE = "active"
+    FINISHING = "finishing"
+    WARNING = "warning"
+    ERROR = "error"
+    STOPPED = "stopped"
+
+
+class JobStatus(BaseModel):
+    """Per-job status as published in heartbeats (x5f2 status_json)."""
+
+    source_name: str
+    job_number: uuid.UUID
+    workflow_id: str
+    state: JobState
+    message: str = ""
+    has_primary_data: bool = False
+    #: The start command's validated params — lets the dashboard offer
+    #: "restart with edited params" with the real current values.
+    params: dict = {}
+
+
+class StreamLag(BaseModel):
+    """Data-time vs wall-clock skew of one stream at batch close."""
+
+    stream_name: str
+    lag_s: float  # positive = stale, negative = from the future
+    # Optional window aggregation (filled by kafka.stream_counter on the
+    # 30 s metrics rollover; single-sample reports leave them at defaults).
+    min_s: float | None = None
+    max_s: float | None = None
+    count: int = 1
+
+    @property
+    def level(self) -> str:
+        future = self.min_s if self.min_s is not None else self.lag_s
+        if future < -FUTURE_ERROR_THRESHOLD.seconds:
+            return "error"
+        if self.lag_s > STALE_WARN_THRESHOLD.seconds:
+            return "warning"
+        return "ok"
+
+
+class StreamLagReport(BaseModel):
+    lags: list[StreamLag] = Field(default_factory=list)
+
+    @property
+    def worst_level(self) -> str:
+        levels = {lag.level for lag in self.lags}
+        for level in ("error", "warning"):
+            if level in levels:
+                return level
+        return "ok"
+
+
+class ServiceStatus(BaseModel):
+    """Service heartbeat payload (2 s cadence)."""
+
+    service_name: str
+    instrument: str
+    state: str = "running"
+    jobs: list[JobStatus] = Field(default_factory=list)
+    last_batch_message_count: int = 0
+    stream_message_counts: dict[str, int] = Field(default_factory=dict)
+    uptime_s: float = 0.0
+    #: Worst stream-lag level at the last batch ('ok'/'warning'/'error')
+    #: and the worst data-time lag in seconds — the operator's first
+    #: clue that a service is falling behind its streams.
+    lag_level: str = "ok"
+    worst_lag_s: float = 0.0
+    #: Per-stream lag detail for the dashboard drill-down (reference
+    #: workflow_status_widget surfaces per-source staleness): stream
+    #: name -> (lag seconds, level).
+    stream_lags: dict[str, tuple[float, str]] = Field(default_factory=dict)
+
+
+class JobResult:
+    """Finalized outputs of one job for one window."""
+
+    __slots__ = ("job_id", "workflow_id", "outputs", "start", "end")
+
+    def __init__(
+        self,
+        *,
+        job_id: JobId,
+        workflow_id: WorkflowId,
+        outputs: dict[str, DataArray],
+        start: Timestamp | None,
+        end: Timestamp | None,
+    ) -> None:
+        self.job_id = job_id
+        self.workflow_id = workflow_id
+        self.outputs = outputs
+        self.start = start
+        self.end = end
+
+    def keys(self) -> list[ResultKey]:
+        return [
+            ResultKey(
+                workflow_id=self.workflow_id,
+                job_id=self.job_id,
+                output_name=name,
+            )
+            for name in self.outputs
+        ]
+
+
+class Job:
+    """Owns a workflow instance; maps window data in, stamped results out."""
+
+    def __init__(
+        self,
+        *,
+        job_id: JobId,
+        workflow_id: WorkflowId,
+        workflow: Workflow,
+        schedule: JobSchedule | None = None,
+        primary_streams: set[str] | None = None,
+        aux_streams: set[str] | None = None,
+        context_keys: set[str] | None = None,
+        optional_context_keys: set[str] | None = None,
+        reset_on_run_transition: bool = True,
+        params: dict | None = None,
+    ) -> None:
+        self.job_id = job_id
+        self.workflow_id = workflow_id
+        self.workflow = workflow
+        self.params = dict(params or {})
+        self.schedule = schedule or JobSchedule()
+        self.primary_streams = primary_streams or {job_id.source_name}
+        self.aux_streams = aux_streams or set()
+        self.context_keys = context_keys or set()
+        self.optional_context_keys = optional_context_keys or set()
+        self.reset_on_run_transition = reset_on_run_transition
+        # Generation start: data time of the first message accumulated since
+        # job start or last reset. Stamped on outputs as ``start_time``, it
+        # is constant for the lifetime of a generation and changes on reset/
+        # reconfigure — NICOS uses the jump as a change-detector to tell a
+        # post-reset zero from a genuine low reading (reference job.py:111,
+        # ADR 0006).
+        self._generation_start: Timestamp | None = None
+        self._window_end: Timestamp | None = None
+        self._start_wall = time.time()
+
+    @property
+    def subscribed_streams(self) -> set[str]:
+        return self.primary_streams | self.aux_streams
+
+    def add(
+        self,
+        data: Mapping[str, Any],
+        *,
+        start: Timestamp | None = None,
+        end: Timestamp | None = None,
+    ) -> bool:
+        """Feed one window of stream-keyed data; returns True if any of it
+        was for this job."""
+        if all(k in self.subscribed_streams for k in data):
+            # Common case: the JobManager pre-filters per job — no copy.
+            relevant: Mapping[str, Any] = data
+        else:
+            relevant = {
+                k: v for k, v in data.items() if k in self.subscribed_streams
+            }
+        if not relevant:
+            return False
+        if start is not None and self._generation_start is None:
+            self._generation_start = start
+        if end is not None:
+            self._window_end = end
+        self.workflow.accumulate(relevant)
+        return True
+
+    def set_context(self, context: Mapping[str, Any]) -> None:
+        deliverable = self.context_keys | self.optional_context_keys
+        relevant = {k: v for k, v in context.items() if k in deliverable}
+        if relevant and hasattr(self.workflow, "set_context"):
+            self.workflow.set_context(relevant)
+
+    def get(self) -> JobResult:
+        """Finalize the window into a JobResult, stamping generation-start /
+        window-end time coords on every output (reference job.py:209-245).
+
+        Outputs that already carry ``start_time``/``end_time`` (a workflow
+        stamping window-local coords on a per-update view) or a ``time``
+        coord (timeseries data with its own timestamps) are left alone.
+        """
+        outputs = self.workflow.finalize()
+        start, end = self._generation_start, self._window_end
+        for da in outputs.values():
+            if "time" in da.coords or "end_time" in da.coords:
+                continue
+            if start is not None:
+                da.coords.setdefault(
+                    "start_time",
+                    Variable(np.asarray(start.ns, dtype=np.int64), (), "ns"),
+                )
+            if end is not None:
+                da.coords["end_time"] = Variable(
+                    np.asarray(end.ns, dtype=np.int64), (), "ns"
+                )
+        return JobResult(
+            job_id=self.job_id,
+            workflow_id=self.workflow_id,
+            outputs=outputs,
+            start=start,
+            end=end,
+        )
+
+    def process(
+        self,
+        data: Mapping[str, Any],
+        *,
+        start: Timestamp | None = None,
+        end: Timestamp | None = None,
+    ) -> JobResult:
+        self.add(data, start=start, end=end)
+        return self.get()
+
+    def clear(self) -> None:
+        """Reset accumulation; starts a new generation (start_time jumps)."""
+        self.workflow.clear()
+        self._generation_start = None
+        self._window_end = None

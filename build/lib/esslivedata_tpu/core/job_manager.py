@@ -1,0 +1,538 @@
+"""Job lifecycle and scheduling.
+
+Parity with reference ``core/job_manager.py``: JobFactory.create:140 (eager
+workflow build at schedule time — startup cost paid at the command, not in
+the hot loop), phase machine scheduled -> pending_context -> active with a
+finishing overlay (:223), data-time-driven activation (_advance_to_time:357),
+context gating per ADR 0002 (_open_context_gates:599), run-transition resets
+(:486-501), thread-pool fan-out of per-job work (:560,690) and per-job
+error/warning containment instead of service death (:640-682).
+
+TPU note on the fan-out: device kernels serialize on the chip anyway, so
+threads only overlap the *host-side* staging/finalize portions — the
+default thread count stays modest (reference default 5).
+"""
+
+from __future__ import annotations
+
+import bisect
+import logging
+import threading
+import uuid
+from collections.abc import Mapping
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from enum import StrEnum
+from typing import Any, Literal
+
+from pydantic import BaseModel
+
+from ..config.workflow_spec import JobId, WorkflowConfig
+from ..workflows.workflow_factory import WorkflowFactory, workflow_registry
+from .job import Job, JobResult, JobState, JobStatus
+from .message import RunStart, RunStop
+from .state_snapshot import supports_snapshot
+from .timestamp import Timestamp
+
+__all__ = ["JobCommand", "JobFactory", "JobManager"]
+
+logger = logging.getLogger(__name__)
+
+
+class JobCommand(BaseModel):
+    """stop/remove/reset command from the dashboard (reference :67)."""
+
+    action: Literal["stop", "remove", "reset"]
+    source_name: str
+    job_number: uuid.UUID
+
+
+class JobFactory:
+    """Builds Jobs from start commands via the workflow registry."""
+
+    def __init__(self, registry: WorkflowFactory | None = None) -> None:
+        self._registry = registry if registry is not None else workflow_registry
+
+    def create(self, config: WorkflowConfig) -> Job:
+        spec = self._registry[config.identifier]
+        workflow = self._registry.create(config)
+        aux = set(config.aux_source_names.values())
+        return Job(
+            job_id=config.job_id,
+            workflow_id=config.identifier,
+            workflow=workflow,
+            schedule=config.schedule,
+            primary_streams={config.job_id.source_name},
+            aux_streams=aux,
+            context_keys=set(spec.context_keys),
+            optional_context_keys=set(spec.optional_context_keys),
+            reset_on_run_transition=spec.reset_on_run_transition,
+            params=dict(config.params),
+        )
+
+
+class _Phase(StrEnum):
+    SCHEDULED = "scheduled"
+    PENDING_CONTEXT = "pending_context"
+    ACTIVE = "active"
+    STOPPED = "stopped"
+
+
+@dataclass
+class _JobRecord:
+    job: Job
+    phase: _Phase = _Phase.SCHEDULED
+    finishing: bool = False
+    error: str = ""
+    warning: str = ""
+    has_primary_data: bool = False
+    # A run-transition reset whose workflow.clear() failed; retried before
+    # the job may accumulate again, so data from the old and new run can
+    # never mix in a wedged workflow.
+    needs_reset: bool = False
+    # Context streams whose latest cached value this job has not received
+    # yet. Persisted across windows so an update arriving while the job is
+    # idle (no data, nothing pending) is delivered before its next add —
+    # a fresh value is queued once and stays queued until a successful
+    # set_context.
+    stale_context: set[str] = field(default_factory=set)
+
+    @property
+    def state(self) -> JobState:
+        if self.error:
+            return JobState.ERROR
+        if self.phase == _Phase.STOPPED:
+            return JobState.STOPPED
+        if self.finishing:
+            return JobState.FINISHING
+        if self.phase == _Phase.PENDING_CONTEXT:
+            # More informative than WARNING; the missing-context warning
+            # still rides the status message field.
+            return JobState.PENDING_CONTEXT
+        if self.warning:
+            return JobState.WARNING
+        return JobState(self.phase.value)
+
+
+class JobManager:
+    """Keeps the job table; drives activation, gating, processing, resets."""
+
+    def __init__(
+        self,
+        *,
+        job_factory: JobFactory | None = None,
+        job_threads: int = 5,
+        snapshot_store=None,
+    ) -> None:
+        self._factory = job_factory or JobFactory()
+        #: Optional core.state_snapshot.SnapshotStore: device-resident
+        #: accumulation is dumped at run boundaries + shutdown and
+        #: restored when an identically-configured job is scheduled
+        #: (SURVEY §5 checkpoint note).
+        self._snapshot_store = snapshot_store
+        self._records: dict[JobId, _JobRecord] = {}
+        self._lock = threading.RLock()
+        # Reset times scheduled by run transitions, sorted; each fires when
+        # DATA time reaches it (reference :486-501) — never on arrival
+        # order, so a run-start announced ahead of the data stream resets
+        # exactly at the boundary even if messages straddle it.
+        self._pending_reset_times: list[Timestamp] = []
+        self._executor = (
+            ThreadPoolExecutor(max_workers=job_threads, thread_name_prefix="job")
+            if job_threads > 1
+            else None
+        )
+
+    # -- scheduling --------------------------------------------------------
+    def schedule_job(self, config: WorkflowConfig) -> JobId:
+        """Create + register a job. The workflow builds eagerly here so
+        compile/LUT cost lands at command time, not in the data path."""
+        with self._lock:
+            if config.job_id in self._records:
+                raise ValueError(f"Job {config.job_id} already exists")
+            job = self._factory.create(config)
+            self._records[config.job_id] = _JobRecord(job=job)
+            logger.info("Scheduled job %s (%s)", config.job_id, config.identifier)
+            self._maybe_restore(job)
+            return config.job_id
+
+    def _maybe_restore(self, job: Job) -> None:
+        """Adopt a prior process's accumulation for this configuration."""
+        store, wf = self._snapshot_store, job.workflow
+        if store is None or not supports_snapshot(wf):
+            return
+        try:
+            # Non-consuming load: a workflow that refuses the arrays
+            # (device state not built yet) keeps the file for a later
+            # schedule instead of losing it.
+            arrays = store.load(
+                workflow_id=str(job.workflow_id),
+                source_name=job.job_id.source_name,
+                fingerprint=wf.state_fingerprint(),
+                consume=False,
+            )
+            if arrays is not None and wf.restore_state(arrays):
+                store.discard(
+                    workflow_id=str(job.workflow_id),
+                    source_name=job.job_id.source_name,
+                )
+                logger.info(
+                    "Restored snapshot state for %s/%s",
+                    job.workflow_id,
+                    job.job_id.source_name,
+                )
+        except Exception:
+            logger.exception(
+                "Snapshot restore failed for %s; starting fresh", job.job_id
+            )
+
+    def _dump_snapshot(
+        self, rec: _JobRecord, reason: str, archive: bool = False
+    ) -> None:
+        store, wf = self._snapshot_store, rec.job.workflow
+        if store is None or not supports_snapshot(wf):
+            return
+        try:
+            arrays = wf.dump_state()
+            if not arrays:
+                # Nothing accumulated yet (context-gated workflow before
+                # its first table): don't overwrite a prior snapshot.
+                return
+            store.save(
+                workflow_id=str(rec.job.workflow_id),
+                source_name=rec.job.job_id.source_name,
+                fingerprint=wf.state_fingerprint(),
+                arrays=arrays,
+                reason=reason,
+                archive=archive,
+            )
+        except Exception:
+            logger.exception("Snapshot dump failed for %s", rec.job.job_id)
+
+    def dump_snapshots(self, reason: str = "shutdown") -> None:
+        # Every non-stopped job, INCLUDING still-scheduled ones: a job
+        # that restored a snapshot but never activated holds that
+        # accumulation only in its workflow — skipping it here would
+        # destroy it (the restore consumed the file).
+        with self._lock:
+            for rec in self._records.values():
+                if rec.phase != _Phase.STOPPED:
+                    self._dump_snapshot(rec, reason)
+
+    def handle_command(self, command: JobCommand) -> int:
+        """Apply ``command``; return how many jobs it acted on.
+
+        Zero for an unknown job is routine, not exceptional: every service
+        sees the shared commands topic but owns a disjoint job set, and a
+        non-owner must stay silent (the dispatcher acks only on count > 0).
+        """
+        job_id = JobId(
+            source_name=command.source_name, job_number=command.job_number
+        )
+        with self._lock:
+            rec = self._records.get(job_id)
+            if rec is None:
+                return 0
+            if command.action == "stop":
+                # Graceful: the job processes one more window and flushes a
+                # final result before leaving the active set.
+                rec.finishing = True
+            elif command.action == "remove":
+                rec.phase = _Phase.STOPPED
+                del self._records[job_id]
+            elif command.action == "reset":
+                self._reset_record(rec)
+            return 1
+
+    # -- run transitions ---------------------------------------------------
+    def handle_run_transition(self, event: RunStart | RunStop) -> None:
+        """Schedule deferred resets at the run boundary's data time."""
+        with self._lock:
+            if isinstance(event, RunStart):
+                bisect.insort(self._pending_reset_times, event.start_time)
+                if event.stop_time is not None:
+                    bisect.insort(self._pending_reset_times, event.stop_time)
+                logger.info(
+                    "Run start %r: reset scheduled at %s",
+                    event.run_name,
+                    event.start_time,
+                )
+            else:
+                bisect.insort(self._pending_reset_times, event.stop_time)
+                logger.info(
+                    "Run stop %r: reset scheduled at %s",
+                    event.run_name,
+                    event.stop_time,
+                )
+
+    def _fire_pending_resets(self, data_time: Timestamp) -> None:
+        """Fire every scheduled reset that data time has now reached."""
+        due = bisect.bisect_right(self._pending_reset_times, data_time)
+        if not due:
+            return
+        del self._pending_reset_times[:due]
+        for rec in self._records.values():
+            if rec.job.reset_on_run_transition:
+                # The run's final accumulation, captured before the reset
+                # wipes it (SURVEY §5: snapshot at run boundaries). Goes
+                # to the ARCHIVE key — restore never reads it, so a
+                # finished run can't be resurrected into a later job.
+                if rec.phase in (_Phase.ACTIVE, _Phase.PENDING_CONTEXT):
+                    self._dump_snapshot(
+                        rec, reason="run_boundary", archive=True
+                    )
+                self._reset_record(rec)
+
+    def _reset_record(self, rec: _JobRecord) -> None:
+        """Clear accumulation and retry/error state; phase is unchanged
+        (context is sticky across run boundaries, so a gated job stays
+        gated). A workflow whose clear() raises keeps its error recorded
+        and does not take the other jobs' resets down with it; the record
+        is flagged ``needs_reset`` and excluded from processing until a
+        retry succeeds, so old-run and new-run data cannot mix."""
+        try:
+            rec.job.clear()
+        except Exception as err:
+            rec.needs_reset = True
+            rec.error = f"Reset failed: {type(err).__name__}: {err}"
+            logger.exception("Job %s failed clearing on reset", rec.job.job_id)
+            return
+        rec.needs_reset = False
+        rec.has_primary_data = False
+        rec.error = ""
+        rec.warning = ""
+
+    # -- phase machine -----------------------------------------------------
+    def _advance_to_time(self, data_time: Timestamp) -> None:
+        for rec in self._records.values():
+            job = rec.job
+            if rec.phase == _Phase.SCHEDULED:
+                start = job.schedule.start
+                if start is None or data_time >= start:
+                    rec.phase = (
+                        _Phase.PENDING_CONTEXT
+                        if job.context_keys
+                        else _Phase.ACTIVE
+                    )
+            if rec.phase in (_Phase.ACTIVE, _Phase.PENDING_CONTEXT):
+                # A job still gated on context can also reach its end time
+                # and must finish (reference :375-377).
+                end = job.schedule.end
+                if end is not None and data_time >= end:
+                    rec.finishing = True
+
+    def _open_context_gates(
+        self, context: Mapping[str, Any]
+    ) -> set[JobId]:
+        """pending_context -> active once every needed context stream has a
+        value (ADR 0002); still-gated jobs carry a warning naming what is
+        missing, so the dashboard shows why nothing is produced.
+
+        Returns the ids of jobs that graduated in this pass — they received
+        the full cached context here and must not get a second (partial)
+        delivery from the processing fan-out.
+        """
+        graduated: set[JobId] = set()
+        for job_id, rec in self._records.items():
+            if rec.phase != _Phase.PENDING_CONTEXT:
+                continue
+            missing = {k for k in rec.job.context_keys if k not in context}
+            if missing:
+                rec.warning = (
+                    "Waiting for context streams: "
+                    + ", ".join(sorted(missing))
+                )
+            else:
+                # Contained per job: one workflow rejecting its context
+                # must not abort the batch for every other job.
+                try:
+                    rec.job.set_context(context)
+                except Exception as err:
+                    rec.warning = (
+                        f"Applying context failed: {type(err).__name__}: {err}"
+                    )
+                    logger.exception(
+                        "Job %s failed applying gate context", job_id
+                    )
+                    continue
+                rec.phase = _Phase.ACTIVE
+                rec.warning = ""
+                rec.stale_context.clear()
+                graduated.add(job_id)
+        return graduated
+
+    def peek_pending_streams(self) -> set[str]:
+        """Context streams still gating some job (the processor uses this
+        to know which context to enrich; reference :503)."""
+        with self._lock:
+            out: set[str] = set()
+            for rec in self._records.values():
+                if rec.phase in (_Phase.SCHEDULED, _Phase.PENDING_CONTEXT):
+                    out |= rec.job.context_keys
+                    out |= rec.job.optional_context_keys
+            return out
+
+    # -- processing --------------------------------------------------------
+    def process_jobs(
+        self,
+        data: Mapping[str, Any],
+        *,
+        context: Mapping[str, Any] | None = None,
+        fresh_context: set[str] | None = None,
+        start: Timestamp | None = None,
+        end: Timestamp | None = None,
+    ) -> list[JobResult]:
+        """One window: fire due resets, advance phases, open gates, fan
+        per-job add+finalize over the thread pool, contain per-job errors.
+
+        ``fresh_context`` names the context streams that received data in
+        THIS batch; active jobs get ``set_context`` only for those, so an
+        unchanged cached motor position does not re-fire downstream
+        recompute every window (reference avoids steady-state context
+        refill for the same reason, :596-618). ``None`` means unknown —
+        deliver everything (test shims).
+
+        Per-job data is filtered to the streams the job subscribes to
+        (reference ``_filter_data_for_job:726``): a job never sees — and
+        never pays staging time for — another job's streams.
+        """
+        context = context or {}
+        with self._lock:
+            if end is not None:
+                self._fire_pending_resets(end)
+                self._advance_to_time(end)
+            graduated = self._open_context_gates(context)
+            # Queue fresh context for later delivery. None = unknown
+            # freshness (test shims): queue everything, restoring
+            # every-window delivery.
+            queued = set(context) if fresh_context is None else fresh_context
+            if queued:
+                for job_id, rec in self._records.items():
+                    if rec.phase == _Phase.ACTIVE and job_id not in graduated:
+                        rec.stale_context |= queued & (
+                            rec.job.context_keys
+                            | rec.job.optional_context_keys
+                        )
+            work: list[tuple[_JobRecord, dict[str, Any]]] = []
+            for rec in self._records.values():
+                if rec.phase != _Phase.ACTIVE:
+                    continue
+                if rec.needs_reset:
+                    # Retry the failed run-transition reset; until it
+                    # succeeds the job must not accumulate (old-run data
+                    # is still in the workflow).
+                    self._reset_record(rec)
+                    if rec.needs_reset:
+                        continue
+                job_data = {
+                    k: v
+                    for k, v in data.items()
+                    if k in rec.job.subscribed_streams
+                }
+                # Skip jobs with nothing to do: no fresh data and nothing
+                # pending finalize. A finishing job is still ACTIVE here —
+                # it leaves only after this pass — so the window that
+                # carried it past its end time is flushed before stopping.
+                # (Queued context survives the skip and is delivered before
+                # the job's next add.)
+                if job_data or rec.has_primary_data:
+                    work.append((rec, job_data))
+
+        def run_one(item: tuple[_JobRecord, dict[str, Any]]) -> JobResult | None:
+            rec, job_data = item
+            job = rec.job
+            # Deliver pending context in its own try: a failure keeps the
+            # names queued (retried next window) and does not block this
+            # window's accumulation.
+            context_warning = ""
+            if rec.stale_context:
+                # Only the names actually present in this window's context
+                # are delivered (and de-queued on success); the rest stay
+                # queued for a later window rather than being dropped.
+                deliverable = {
+                    k for k in rec.stale_context if k in context
+                }
+                try:
+                    if deliverable:
+                        job.set_context(
+                            {k: context[k] for k in deliverable}
+                        )
+                    rec.stale_context -= deliverable
+                except Exception as err:
+                    context_warning = f"{type(err).__name__}: {err}"
+                    logger.exception(
+                        "Job %s failed applying context", job.job_id
+                    )
+            # Accumulate: a failure here is a warning — the job may still
+            # be able to finalize previously accumulated data. A successful
+            # add must not mask an unresolved context failure.
+            try:
+                touched = job.add(job_data, start=start, end=end)
+                if touched and any(k in job_data for k in job.primary_streams):
+                    rec.has_primary_data = True
+                rec.warning = context_warning
+            except Exception as err:
+                rec.warning = f"{type(err).__name__}: {err}"
+                logger.exception("Job %s failed accumulating", job.job_id)
+            if not rec.has_primary_data:
+                return None
+            # Finalize: a failure here is an error; has_primary_data stays
+            # set so the next window retries.
+            try:
+                result = job.get()
+                rec.error = ""
+                rec.has_primary_data = False
+                return result
+            except Exception as err:
+                rec.error = f"{type(err).__name__}: {err}"
+                logger.exception("Job %s failed finalizing", job.job_id)
+                return None
+
+        if self._executor is not None and len(work) > 1:
+            results = list(self._executor.map(run_one, work))
+        else:
+            results = [run_one(item) for item in work]
+
+        with self._lock:
+            for rec in list(self._records.values()):
+                if rec.finishing and rec.phase in (
+                    _Phase.ACTIVE,
+                    _Phase.PENDING_CONTEXT,
+                ):
+                    rec.phase = _Phase.STOPPED
+        return [r for r in results if r is not None]
+
+    # -- introspection -----------------------------------------------------
+    def job_statuses(self) -> list[JobStatus]:
+        with self._lock:
+            return [
+                JobStatus(
+                    source_name=jid.source_name,
+                    job_number=jid.job_number,
+                    workflow_id=str(rec.job.workflow_id),
+                    state=rec.state,
+                    message=rec.error or rec.warning,
+                    has_primary_data=rec.has_primary_data,
+                    params=rec.job.params,
+                )
+                for jid, rec in self._records.items()
+            ]
+
+    @property
+    def n_jobs(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def subscribed_streams(self) -> set[str]:
+        with self._lock:
+            out: set[str] = set()
+            for rec in self._records.values():
+                out |= rec.job.subscribed_streams
+            return out
+
+    def shutdown(self) -> None:
+        # Crash-recovery dump: a restarted service restores mid-run
+        # accumulation instead of starting from zero.
+        self.dump_snapshots(reason="shutdown")
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)

@@ -1,0 +1,75 @@
+"""Extraction of contracted workflow outputs onto the NICOS device topic.
+
+Parity with reference ``core/nicos_devices.py`` (ADR 0006): outputs that the
+per-instrument :class:`~esslivedata_tpu.config.device_contract.DeviceContract`
+designates are republished on a dedicated low-volume stream keyed by a stable
+*device name* — free of the job_number carried by the main data path — so
+NICOS sees a stable device identity across reconfigurations. The output's
+``start_time`` coordinate (stamped by the job layer) rides along as a
+generation change-detector: it changes on reset/reconfigure, letting NICOS
+distinguish a post-reset zero from a genuine low reading.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..config.device_contract import DeviceContract
+from ..utils.labeled import DataArray
+from .job import JobResult
+from .message import Message, StreamId, StreamKind
+from .timestamp import Timestamp
+
+__all__ = ["DeviceExtractor"]
+
+logger = logging.getLogger(__name__)
+
+
+class DeviceExtractor:
+    """Builds NICOS device messages from finalized job results."""
+
+    def __init__(self, *, device_contract: DeviceContract) -> None:
+        self._contract = device_contract
+        self._warned_names: set[str] = set()
+
+    def extract(self, results: list[JobResult]) -> list[Message[DataArray]]:
+        """One message per contracted output present in ``results``, keyed by
+        device name on the ``LIVEDATA_NICOS_DATA`` stream.
+
+        Device names drop the job_number on purpose (stable identity), so two
+        concurrent jobs of the same (workflow, source) would write the same
+        device. First result wins within a cycle; the collision is logged
+        once — running duplicates is an operator error the main data path
+        tolerates but the device path cannot express.
+        """
+        messages: list[Message[DataArray]] = []
+        emitted: set[str] = set()
+        for result in results:
+            entries = self._contract.devices_for(
+                result.workflow_id, result.job_id.source_name
+            )
+            for entry in entries:
+                da = result.outputs.get(entry.output_name)
+                if da is None:
+                    continue
+                if entry.device_name in emitted:
+                    if entry.device_name not in self._warned_names:
+                        self._warned_names.add(entry.device_name)
+                        logger.warning(
+                            "Multiple jobs write NICOS device %r; "
+                            "keeping the first per cycle",
+                            entry.device_name,
+                        )
+                    continue
+                emitted.add(entry.device_name)
+                messages.append(
+                    Message(
+                        timestamp=result.start or Timestamp.from_ns(0),
+                        stream=StreamId(
+                            kind=StreamKind.LIVEDATA_NICOS_DATA,
+                            name=entry.device_name,
+                        ),
+                        value=da,
+                    )
+                )
+        return messages

@@ -1,0 +1,409 @@
+"""The heart of a backend service: one processor cycle.
+
+Parity with reference ``core/orchestrating_processor.py`` (process:200):
+pull -> split commands/run-control/data (:212-218) -> dispatch commands ->
+batch -> preprocess per stream (MessagePreprocessor:55) -> context
+enrichment -> JobManager.process_jobs (:286) -> publish results -> release
+buffers (zero-copy contract :287) -> 2 s status heartbeats (:327) and 30 s
+metrics (:364-415) -> idempotent finalize (:417) publishing final stopped
+statuses. Per-batch processing time feeds the adaptive batcher — the
+implicit load profiler.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections.abc import Iterable
+from typing import Any
+
+from ..config.acknowledgement import CommandAcknowledgement
+from ..core.preprocessor import PreprocessorFactory
+from .command_dispatcher import CommandDispatcher
+from .job_manager import JobManager
+from .job import JobResult, ServiceStatus, StreamLag, StreamLagReport
+from .message import (
+    RESPONSE_STREAM,
+    STATUS_STREAM,
+    Message,
+    MessageSink,
+    MessageSource,
+    RunStart,
+    RunStop,
+    StreamId,
+    StreamKind,
+)
+from .message_batcher import MessageBatcher
+from .timestamp import Duration, Timestamp
+
+__all__ = ["MessagePreprocessor", "OrchestratingProcessor"]
+
+logger = logging.getLogger(__name__)
+
+HEARTBEAT_INTERVAL_S = 2.0
+METRICS_INTERVAL_S = 30.0
+
+
+class MessagePreprocessor:
+    """Routes batch messages into per-stream accumulators."""
+
+    def __init__(self, factory: PreprocessorFactory) -> None:
+        self._factory = factory
+        self._accumulators: dict[StreamId, Any] = {}
+        self._touched: set[StreamId] = set()
+        self._dropped_streams: set[StreamId] = set()
+        self.message_counts: dict[str, int] = {}
+
+    def _get(self, stream: StreamId):
+        if stream in self._accumulators:
+            return self._accumulators[stream]
+        if stream in self._dropped_streams:
+            return None
+        acc = self._factory.make_preprocessor(stream)
+        if acc is None:
+            self._dropped_streams.add(stream)
+            return None
+        self._accumulators[stream] = acc
+        return acc
+
+    def preprocess(self, messages: Iterable[Message]) -> None:
+        for msg in messages:
+            acc = self._get(msg.stream)
+            if acc is None:
+                continue
+            try:
+                acc.add(msg.timestamp, msg.value)
+            except Exception:
+                logger.exception("Accumulator failed for %s", msg.stream)
+                continue
+            self._touched.add(msg.stream)
+            self.message_counts[msg.stream.name] = (
+                self.message_counts.get(msg.stream.name, 0) + 1
+            )
+
+    def collect_window(self) -> dict[str, Any]:
+        """Primary (non-context) data accumulated since last collect."""
+        out: dict[str, Any] = {}
+        for stream in self._touched:
+            acc = self._accumulators[stream]
+            if getattr(acc, "is_context", False):
+                continue
+            try:
+                out[stream.name] = acc.get()
+            except Exception:
+                logger.exception("Accumulator get failed for %s", stream)
+        return out
+
+    def collect_context(self) -> dict[str, Any]:
+        """Latest value of every context accumulator that has one.
+
+        ``also_context`` marks primary accumulators whose value is
+        additionally exposed as context — e.g. timeseries logs that both
+        republish as data and gate/parameterize other jobs (the reference
+        routes the same f144 stream to republish and to spec-scope context
+        bindings)."""
+        out: dict[str, Any] = {}
+        for stream, acc in self._accumulators.items():
+            if not (
+                getattr(acc, "is_context", False)
+                or getattr(acc, "also_context", False)
+            ):
+                continue
+            if hasattr(acc, "has_value") and not acc.has_value:
+                continue
+            try:
+                out[stream.name] = acc.get()
+            except ValueError:
+                continue
+        return out
+
+    def fresh_context_names(self) -> set[str]:
+        """Context streams that received data in this batch.
+
+        The JobManager delivers ``set_context`` to active jobs only for
+        these, so an unchanged cached value never re-fires downstream
+        recompute. Must be read before :meth:`release` clears the batch's
+        touched set.
+        """
+        out: set[str] = set()
+        for stream in self._touched:
+            acc = self._accumulators.get(stream)
+            if acc is not None and (
+                getattr(acc, "is_context", False)
+                or getattr(acc, "also_context", False)
+            ):
+                out.add(stream.name)
+        return out
+
+    def release(self) -> None:
+        for stream in self._touched:
+            self._accumulators[stream].release_buffers()
+        self._touched.clear()
+
+
+class OrchestratingProcessor:
+    """Processor implementation wiring source -> jobs -> sink."""
+
+    def __init__(
+        self,
+        *,
+        source: MessageSource,
+        sink: MessageSink,
+        preprocessor_factory: PreprocessorFactory,
+        job_manager: JobManager,
+        batcher: MessageBatcher,
+        instrument: str,
+        service_name: str,
+        registry=None,
+        device_extractor=None,
+        stream_counter=None,
+        clock=time.monotonic,
+        heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S,
+    ) -> None:
+        self._source = source
+        self._sink = sink
+        self._preprocessor = MessagePreprocessor(preprocessor_factory)
+        self._job_manager = job_manager
+        self._batcher = batcher
+        self._dispatcher = CommandDispatcher(
+            job_manager=job_manager,
+            instrument=instrument,
+            service_name=service_name,
+            registry=registry,
+        )
+        self._instrument = instrument
+        self._service_name = service_name
+        self._device_extractor = device_extractor
+        self._stream_counter = stream_counter
+        self._clock = clock
+        self._heartbeat_interval_s = heartbeat_interval_s
+        self._start_wall = clock()
+        self._last_heartbeat = -float("inf")
+        self._last_metrics = clock()
+        self._last_batch_len = 0
+        self._finalized = False
+        self.last_lag_report = StreamLagReport()
+        self._lag_report_wall_ns = time.time_ns()
+        from ..utils.profiling import StageTimer
+
+        self.stage_timer = StageTimer()
+
+    # -- cycle ------------------------------------------------------------
+    def process(self) -> None:
+        messages = list(self._source.get_messages())
+
+        commands = [m for m in messages if m.stream.kind.is_command]
+        run_control = [m for m in messages if m.stream.kind.is_run_control]
+        data = [m for m in messages if m.stream.kind.is_data]
+
+        if commands:
+            acks = self._dispatcher.process_messages(commands)
+            self._publish_acks(acks)
+        for msg in run_control:
+            if isinstance(msg.value, (RunStart, RunStop)):
+                self._job_manager.handle_run_transition(msg.value)
+
+        batch = self._batcher.batch(data)
+        if batch is not None:
+            t0 = self._clock()
+            self._process_batch(batch)
+            self._batcher.report_processing_time(
+                Duration.from_s(self._clock() - t0)
+            )
+
+        now = self._clock()
+        if now - self._last_heartbeat >= self._heartbeat_interval_s:
+            self._last_heartbeat = now
+            self._publish_status()
+        if now - self._last_metrics >= METRICS_INTERVAL_S:
+            self._last_metrics = now
+            self._log_metrics()
+
+    def _process_batch(self, batch) -> None:
+        self._last_batch_len = len(batch.messages)
+        with self.stage_timer.stage("preprocess"):
+            self._preprocessor.preprocess(batch.messages)
+            window = self._preprocessor.collect_window()
+            context = self._preprocessor.collect_context()
+            fresh_context = self._preprocessor.fresh_context_names()
+        self._record_lag(batch)
+        with self.stage_timer.stage("process_jobs"):
+            results = self._job_manager.process_jobs(
+                window,
+                context=context,
+                fresh_context=fresh_context,
+                start=batch.start,
+                end=batch.end,
+            )
+        try:
+            with self.stage_timer.stage("publish"):
+                self._publish_results(results, batch.end)
+        finally:
+            self._preprocessor.release()
+
+    def _record_lag(self, batch) -> None:
+        now_ns = time.time_ns()
+        lags = [
+            StreamLag(
+                stream_name=name,
+                lag_s=(now_ns - batch.end.ns) / 1e9,
+            )
+            for name in {m.stream.name for m in batch.messages}
+        ]
+        self.last_lag_report = StreamLagReport(lags=lags)
+        self._lag_report_wall_ns = now_ns
+
+    def _current_lag_report(self) -> StreamLagReport:
+        """The last report AGED to now: a stream that stopped producing
+        has its staleness grow with the silence (a frozen snapshot would
+        report 'ok' forever on a fully stalled stream — the worst case),
+        and a future-timestamped error relaxes as the wall clock catches
+        up with the data."""
+        if not self.last_lag_report.lags:
+            return self.last_lag_report
+        age_s = (time.time_ns() - self._lag_report_wall_ns) / 1e9
+        return StreamLagReport(
+            lags=[
+                StreamLag(
+                    stream_name=lag.stream_name,
+                    lag_s=lag.lag_s + age_s,
+                    min_s=(
+                        None if lag.min_s is None else lag.min_s + age_s
+                    ),
+                    max_s=(
+                        None if lag.max_s is None else lag.max_s + age_s
+                    ),
+                    count=lag.count,
+                )
+                for lag in self.last_lag_report.lags
+            ]
+        )
+
+    # -- publishing -------------------------------------------------------
+    def _publish_results(
+        self, results: list[JobResult], timestamp: Timestamp
+    ) -> None:
+        messages: list[Message] = []
+        for result in results:
+            for key, da in zip(result.keys(), result.outputs.values(), strict=True):
+                messages.append(
+                    Message(
+                        timestamp=timestamp,
+                        stream=StreamId(
+                            kind=StreamKind.LIVEDATA_DATA, name=key.to_string()
+                        ),
+                        value=da,
+                    )
+                )
+        if self._device_extractor is not None:
+            # Contracted outputs additionally ride the stable-identity NICOS
+            # device stream (ADR 0006, core/nicos_devices.py).
+            messages.extend(self._device_extractor.extract(results))
+        if messages:
+            self._sink.publish_messages(messages)
+
+    def _publish_acks(self, acks: list[CommandAcknowledgement]) -> None:
+        if not acks:
+            return
+        self._sink.publish_messages(
+            [
+                Message(
+                    timestamp=Timestamp.now(),
+                    stream=RESPONSE_STREAM,
+                    value=ack,
+                )
+                for ack in acks
+            ]
+        )
+
+    def _service_status(self, state: str = "running") -> ServiceStatus:
+        return ServiceStatus(
+            service_name=self._service_name,
+            instrument=self._instrument,
+            state=state,
+            jobs=self._job_manager.job_statuses(),
+            last_batch_message_count=self._last_batch_len,
+            stream_message_counts=dict(self._preprocessor.message_counts),
+            uptime_s=self._clock() - self._start_wall,
+            lag_level=(report := self._current_lag_report()).worst_level,
+            # The badge number must describe the lag that SET the level,
+            # not an unrelated healthy stream's.
+            worst_lag_s=max(
+                (
+                    abs(lag.lag_s)
+                    for lag in report.lags
+                    if lag.level != "ok"
+                ),
+                default=0.0,
+            ),
+            stream_lags={
+                lag.stream_name: (round(lag.lag_s, 3), lag.level)
+                for lag in report.lags
+            },
+        )
+
+    def _publish_status(self, state: str = "running") -> None:
+        status = self._service_status(state)
+        now = Timestamp.now()
+        # One service heartbeat plus one per-job heartbeat: NICOS monitors
+        # individual jobs by their source:job_number identity while the
+        # dashboard consumes the aggregated service document. On shutdown
+        # the per-job heartbeats must report STOPPED — a NICOS cache keyed
+        # on the job identity would otherwise latch the last live code
+        # (green) for jobs of a dead service.
+        jobs = status.jobs
+        if state in ("stopping", "stopped"):
+            from .job import JobState
+
+            jobs = [
+                job.model_copy(update={"state": JobState.STOPPED})
+                for job in jobs
+            ]
+        self._sink.publish_messages(
+            [Message(timestamp=now, stream=STATUS_STREAM, value=status)]
+            + [
+                Message(timestamp=now, stream=STATUS_STREAM, value=job)
+                for job in jobs
+            ]
+        )
+
+    def _log_metrics(self) -> None:
+        extra = {
+            "service": self._service_name,
+            "jobs": self._job_manager.n_jobs,
+            "stream_counts": dict(self._preprocessor.message_counts),
+            "lag_level": self._current_lag_report().worst_level,
+        }
+        try:
+            from ..utils.profiling import device_memory_stats
+
+            if memory := device_memory_stats():
+                extra["device_memory"] = memory
+        except Exception:  # pragma: no cover - backend without stats
+            pass
+        if self._stream_counter is not None:
+            # Adapter-layer per-(topic,source) counts + producer lag,
+            # accumulated since the last rollover (kafka/stream_counter.py).
+            stats = self._stream_counter.drain(METRICS_INTERVAL_S)
+            extra["input_counts"] = {
+                f"{s.topic}/{s.source_name}": s.count for s in stats.streams
+            }
+            extra["unmapped"] = [s.source_name for s in stats.unmapped]
+            lag_report = self._stream_counter.drain_lag()
+            if lag_report is not None:
+                self.last_lag_report = lag_report
+                extra["producer_lag_level"] = lag_report.worst_level
+        if stages := self.stage_timer.drain():
+            extra["stages"] = stages
+        logger.info("processor_metrics", extra=extra)
+
+    def finalize(self) -> None:
+        """Publish final stopped statuses; idempotent (reference :417)."""
+        if self._finalized:
+            return
+        self._finalized = True
+        try:
+            self._publish_status(state="stopped")
+        except Exception:
+            logger.exception("Failed to publish final status")
+        self._job_manager.shutdown()

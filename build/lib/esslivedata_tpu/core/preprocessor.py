@@ -1,0 +1,42 @@
+"""Accumulator + PreprocessorFactory protocols (reference: core/preprocessor.py:16,60).
+
+An Accumulator ingests per-stream wire payloads between batch boundaries and
+hands the accumulated value to workflows at window close. ``is_context``
+marks accumulators whose value parameterizes workflows (motor positions,
+chopper settings) rather than flowing as primary data (ADR 0002 semantics).
+``release_buffers`` is the zero-copy contract: accumulators may hand out
+views into internal buffers from ``get``; the runtime promises to call
+``release_buffers`` after all jobs consumed them, before the next add cycle.
+"""
+
+from __future__ import annotations
+
+from typing import Any, ClassVar, Protocol, TypeVar, runtime_checkable
+
+from .message import StreamId
+from .timestamp import Timestamp
+
+T = TypeVar("T")
+U = TypeVar("U")
+
+__all__ = ["Accumulator", "PreprocessorFactory"]
+
+
+@runtime_checkable
+class Accumulator(Protocol[T, U]):
+    is_context: ClassVar[bool] = False
+
+    def add(self, timestamp: Timestamp, data: T) -> None: ...
+
+    def get(self) -> U: ...
+
+    def clear(self) -> None: ...
+
+    def release_buffers(self) -> None: ...
+
+
+@runtime_checkable
+class PreprocessorFactory(Protocol):
+    """Creates the right accumulator for a stream, or None to drop it."""
+
+    def make_preprocessor(self, stream: StreamId) -> Any | None: ...

@@ -1,0 +1,37 @@
+"""Processor protocol (reference: core/processor.py:14,30).
+
+A processor owns its source and sink; ``process()`` runs one cycle of the
+service loop. ``finalize()`` is called once at shutdown to flush state.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+from .message import MessageSink, MessageSource
+
+__all__ = ["IdentityProcessor", "Processor"]
+
+
+@runtime_checkable
+class Processor(Protocol):
+    def process(self) -> None: ...
+
+    def finalize(self) -> None: ...
+
+
+class IdentityProcessor:
+    """Pass messages straight from source to sink — used by fake producers
+    (reference: core/processor.py:30)."""
+
+    def __init__(self, source: MessageSource, sink: MessageSink) -> None:
+        self._source = source
+        self._sink = sink
+
+    def process(self) -> None:
+        messages = self._source.get_messages()
+        if messages:
+            self._sink.publish_messages(list(messages))
+
+    def finalize(self) -> None:
+        pass

@@ -1,0 +1,178 @@
+"""Device-state snapshots at run boundaries (SURVEY §5 checkpoint note).
+
+The reference accepts that accumulated histograms die with the process;
+this build's HBM-resident :class:`~esslivedata_tpu.ops.histogram
+.HistogramState` makes a cheap dump/restore worth having: on RunStop
+(and on graceful service shutdown) each job's device state is fetched to
+host and written as an ``.npz``; a restarted service restores it when a
+job with the SAME configuration is scheduled again.
+
+Safety model — a snapshot is only ever restored when:
+
+- the workflow's **fingerprint** matches (a hash over everything that
+  gives bins physical meaning: projection LUT bytes, TOA edges, decay,
+  screen geometry). A changed geometry or binning invalidates the
+  snapshot rather than blending counts with different meaning.
+- it is **one-shot**: the file is deleted on successful restore, so a
+  stale snapshot cannot resurrect twice.
+
+Workflows opt in structurally (duck-typed): ``state_fingerprint()``,
+``dump_state() -> dict[str, np.ndarray]``, ``restore_state(dict) ->
+bool``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import re
+import time
+from pathlib import Path
+
+import numpy as np
+
+__all__ = ["SnapshotStore", "supports_snapshot"]
+
+logger = logging.getLogger(__name__)
+
+
+def supports_snapshot(workflow) -> bool:
+    return (
+        hasattr(workflow, "state_fingerprint")
+        and hasattr(workflow, "dump_state")
+        and hasattr(workflow, "restore_state")
+    )
+
+
+def _slug(text: str) -> str:
+    return re.sub(r"[^A-Za-z0-9._-]+", "_", text)
+
+
+class SnapshotStore:
+    """npz-per-job snapshot directory with atomic writes."""
+
+    def __init__(self, directory: str | os.PathLike) -> None:
+        self._dir = Path(directory)
+        self._dir.mkdir(parents=True, exist_ok=True)
+
+    def _path(
+        self, workflow_id: str, source_name: str, archive: bool
+    ) -> Path:
+        suffix = ".runfinal.npz" if archive else ".npz"
+        # _slug output may itself contain '_', so the '__' join alone is
+        # ambiguous ('a' + 'b__c' vs 'a__b' + 'c'): a short digest of the
+        # unambiguous pair keeps distinct jobs on distinct files (the
+        # fingerprint check would refuse a wrong restore, but last-dump-
+        # wins on one shared file would silently destroy the other
+        # job's snapshot).
+        pair = hashlib.sha256(
+            f"{workflow_id}\x00{source_name}".encode()
+        ).hexdigest()[:8]
+        return self._dir / (
+            f"{_slug(workflow_id)}__{_slug(source_name)}__{pair}{suffix}"
+        )
+
+    def _legacy_path(
+        self, workflow_id: str, source_name: str, archive: bool
+    ) -> Path:
+        """Pre-digest filename (no pair hash): snapshots written by an
+        older service must survive the upgrade, so load() falls back to
+        this name and migrates on hit."""
+        suffix = ".runfinal.npz" if archive else ".npz"
+        return self._dir / (
+            f"{_slug(workflow_id)}__{_slug(source_name)}{suffix}"
+        )
+
+    def save(
+        self,
+        *,
+        workflow_id: str,
+        source_name: str,
+        fingerprint: str,
+        arrays: dict[str, np.ndarray],
+        reason: str = "",
+        archive: bool = False,
+    ) -> Path:
+        """``archive=True`` writes to a separate ``.runfinal`` key that
+        :meth:`load` never reads: a finished run's final accumulation is
+        preserved for inspection/explicit recovery WITHOUT ever being
+        resurrected into a later job (which would mix runs). The main
+        key is the crash/shutdown-recovery channel only."""
+        path = self._path(workflow_id, source_name, archive)
+        tmp = path.with_suffix(".tmp")
+        meta = json.dumps(
+            {
+                "fingerprint": fingerprint,
+                "workflow_id": workflow_id,
+                "source_name": source_name,
+                "saved_at": time.time(),
+                "reason": reason,
+            }
+        )
+        # Uncompressed: this may run at a run boundary in the processing
+        # path; the state is the projected screen (a few MB), and raw
+        # write speed beats compression there.
+        with open(tmp, "wb") as fh:
+            np.savez(
+                fh, __meta__=np.frombuffer(meta.encode(), np.uint8), **arrays
+            )
+        os.replace(tmp, path)  # atomic: a reader never sees a torn file
+        logger.info(
+            "Snapshot saved for %s/%s (%s)", workflow_id, source_name, reason
+        )
+        return path
+
+    def load(
+        self,
+        *,
+        workflow_id: str,
+        source_name: str,
+        fingerprint: str,
+        consume: bool = True,
+    ) -> dict[str, np.ndarray] | None:
+        """Arrays if a snapshot exists AND its fingerprint matches; with
+        ``consume`` the file is deleted on a hit (kept on a mismatch — a
+        rollback to the old configuration can still use it). Callers
+        that might REFUSE the arrays after loading (a workflow whose
+        device state is not built yet) pass ``consume=False`` and call
+        :meth:`discard` only once the restore actually succeeded."""
+        path = self._path(workflow_id, source_name, archive=False)
+        if not path.exists():
+            # Upgrade path: adopt a snapshot written under the pre-digest
+            # filename so a restart across the version change still
+            # restores (the fingerprint check below stays the gate).
+            legacy = self._legacy_path(workflow_id, source_name, archive=False)
+            if legacy.exists():
+                try:
+                    legacy.rename(path)
+                except OSError:
+                    path = legacy
+        try:
+            with np.load(path) as archive:
+                meta = json.loads(bytes(archive["__meta__"]).decode())
+                if meta.get("fingerprint") != fingerprint:
+                    logger.info(
+                        "Snapshot for %s/%s ignored: fingerprint mismatch",
+                        workflow_id,
+                        source_name,
+                    )
+                    return None
+                arrays = {
+                    k: archive[k] for k in archive.files if k != "__meta__"
+                }
+        except FileNotFoundError:
+            return None
+        except Exception:
+            logger.exception("Snapshot for %s/%s unreadable", workflow_id, source_name)
+            return None
+        if consume:
+            self.discard(workflow_id=workflow_id, source_name=source_name)
+        return arrays
+
+    def discard(self, *, workflow_id: str, source_name: str) -> None:
+        try:
+            self._path(workflow_id, source_name, archive=False).unlink()
+        except OSError:
+            pass

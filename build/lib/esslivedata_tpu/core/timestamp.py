@@ -1,0 +1,151 @@
+"""Typed nanosecond-epoch timestamps and durations.
+
+Semantics follow the reference's ``core/timestamp.py`` (unit-checked
+arithmetic, pulse-grid quantization, reference: timestamp.py:140,224-232) but
+the implementation is pure-integer: the reference converts through scipp unit
+machinery; here a Timestamp is an int64-range ns count and the 14 Hz pulse
+grid is handled with exact rational arithmetic (period = 10^9/14 ns), so
+``quantize``/``quantize_up`` are reproducible and drift-free over any epoch.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any
+
+from .constants import PULSE_PERIOD_NS_DEN, PULSE_PERIOD_NS_NUM
+
+__all__ = ["Duration", "Timestamp"]
+
+_UNIT_NS: dict[str, int] = {"ns": 1, "us": 1_000, "ms": 1_000_000, "s": 1_000_000_000}
+
+
+def _to_ns(value: float | int, unit: str) -> int:
+    try:
+        factor = _UNIT_NS[unit]
+    except KeyError as err:
+        raise ValueError(f"Unsupported time unit {unit!r}") from err
+    return round(value * factor)
+
+
+@dataclass(frozen=True, slots=True, order=True)
+class Duration:
+    """A length of time in integer nanoseconds."""
+
+    ns: int
+
+    @classmethod
+    def from_value(cls, value: float, unit: str = "s") -> Duration:
+        return cls(_to_ns(value, unit))
+
+    @classmethod
+    def from_s(cls, seconds: float) -> Duration:
+        return cls(_to_ns(seconds, "s"))
+
+    @classmethod
+    def from_ms(cls, ms: float) -> Duration:
+        return cls(_to_ns(ms, "ms"))
+
+    @classmethod
+    def from_ns(cls, ns: int) -> Duration:
+        return cls(int(ns))
+
+    @property
+    def seconds(self) -> float:
+        return self.ns / 1e9
+
+    def __add__(self, other: Duration) -> Duration:
+        if not isinstance(other, Duration):
+            return NotImplemented
+        return Duration(self.ns + other.ns)
+
+    def __sub__(self, other: Duration) -> Duration:
+        if not isinstance(other, Duration):
+            return NotImplemented
+        return Duration(self.ns - other.ns)
+
+    def __mul__(self, factor: float) -> Duration:
+        return Duration(round(self.ns * factor))
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other: Duration | float) -> float | Duration:
+        if isinstance(other, Duration):
+            return self.ns / other.ns
+        return Duration(round(self.ns / other))
+
+    def __neg__(self) -> Duration:
+        return Duration(-self.ns)
+
+    def __bool__(self) -> bool:
+        return self.ns != 0
+
+    def __str__(self) -> str:
+        return f"{self.seconds:g}s"
+
+
+@dataclass(frozen=True, slots=True, order=True)
+class Timestamp:
+    """Nanoseconds since the Unix epoch (UTC). The data-time clock of the
+    whole system: batching windows and job schedules compare these, never
+    wall-clock (reference: core/message_batcher.py data-derived clock)."""
+
+    ns: int
+
+    @classmethod
+    def from_ns(cls, ns: int) -> Timestamp:
+        return cls(int(ns))
+
+    @classmethod
+    def from_value(cls, value: float, unit: str = "s") -> Timestamp:
+        return cls(_to_ns(value, unit))
+
+    @classmethod
+    def now(cls) -> Timestamp:
+        return cls(time.time_ns())
+
+    @property
+    def seconds(self) -> float:
+        return self.ns / 1e9
+
+    # -- arithmetic (unit-checked by type) --------------------------------
+    def __add__(self, other: Duration) -> Timestamp:
+        if not isinstance(other, Duration):
+            return NotImplemented
+        return Timestamp(self.ns + other.ns)
+
+    def __radd__(self, other: Duration) -> Timestamp:
+        return self.__add__(other)
+
+    def __sub__(self, other: Timestamp | Duration) -> Any:
+        if isinstance(other, Timestamp):
+            return Duration(self.ns - other.ns)
+        if isinstance(other, Duration):
+            return Timestamp(self.ns - other.ns)
+        return NotImplemented
+
+    # -- pulse grid -------------------------------------------------------
+    def pulse_index(self) -> int:
+        """Index of the source pulse containing this time (floor)."""
+        return (self.ns * PULSE_PERIOD_NS_DEN) // PULSE_PERIOD_NS_NUM
+
+    @classmethod
+    def from_pulse_index(cls, index: int) -> Timestamp:
+        # Ceiling division: the smallest ns time whose pulse_index is
+        # ``index``, so pulse_index(from_pulse_index(i)) == i exactly.
+        return cls(-((-index * PULSE_PERIOD_NS_NUM) // PULSE_PERIOD_NS_DEN))
+
+    def quantize(self) -> Timestamp:
+        """Round down onto the pulse grid (reference timestamp.py:224)."""
+        return Timestamp.from_pulse_index(self.pulse_index())
+
+    def quantize_up(self) -> Timestamp:
+        """Round up onto the pulse grid (reference timestamp.py:232)."""
+        q = self.quantize()
+        if q == self:
+            return q
+        return Timestamp.from_pulse_index(self.pulse_index() + 1)
+
+    def __str__(self) -> str:
+        return f"t={self.seconds:.6f}s"

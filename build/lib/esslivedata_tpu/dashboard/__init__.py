@@ -1,0 +1,13 @@
+"""Live web dashboard: data plane, orchestration, plotting, web UI.
+
+Parity with reference ``src/ess/livedata/dashboard/`` (SURVEY.md section
+2.7) with the same architecture decisions — single-writer ingestion with
+keys-only notifications and pull-based extraction (ADR 0007), frame-gated
+session flushes (ADR 0005), job adoption from heartbeats (ADR 0008), and an
+in-process fake backend that makes the full UI work without Kafka (the dev
+demo + test rig). The widget substrate differs by necessity: the reference
+renders Panel/HoloViews; this build renders matplotlib to PNG behind a
+small tornado app with a polling HTML front end (Panel is not available in
+this environment, and the dashboard is the cold path — the architecture,
+not the widget toolkit, is what carries over).
+"""

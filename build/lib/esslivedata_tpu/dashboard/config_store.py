@@ -1,0 +1,184 @@
+"""Persisted UI state (reference: dashboard/config_store.py, 758 LoC).
+
+Namespaced key->JSON-document stores; the file-backed store survives
+dashboard restarts (grid layouts, staged workflow params, plot configs —
+reference tests/integration/config_persistence_test.py), the in-memory
+store backs tests and ephemeral sessions. Writes are atomic
+(write-to-temp + rename) so a crash mid-save never corrupts state.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from pathlib import Path
+from typing import Any, Protocol
+
+__all__ = [
+    "ConfigStore",
+    "ConfigStoreManager",
+    "FileConfigStore",
+    "MemoryConfigStore",
+]
+
+logger = logging.getLogger(__name__)
+
+
+class ConfigStore(Protocol):
+    def load(self, key: str) -> dict[str, Any] | None: ...
+
+    def save(self, key: str, value: dict[str, Any]) -> None: ...
+
+    def delete(self, key: str) -> None: ...
+
+    def keys(self) -> list[str]: ...
+
+
+class MemoryConfigStore:
+    def __init__(self) -> None:
+        self._data: dict[str, dict[str, Any]] = {}
+        self._lock = threading.Lock()
+
+    def load(self, key: str) -> dict[str, Any] | None:
+        with self._lock:
+            value = self._data.get(key)
+            return json.loads(json.dumps(value)) if value is not None else None
+
+    def save(self, key: str, value: dict[str, Any]) -> None:
+        with self._lock:
+            self._data[key] = json.loads(json.dumps(value))
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return sorted(self._data)
+
+
+class FileConfigStore:
+    """One JSON file per key under ``root``.
+
+    Filenames are sanitized for the filesystem, but the *original* key is
+    persisted inside the document (``__key__`` envelope) so ``keys()``
+    returns exact keys after a restart and two distinct keys that sanitize
+    identically are detected as a collision rather than silently
+    clobbering each other.
+    """
+
+    def __init__(self, root: str | Path) -> None:
+        self._root = Path(root)
+        self._root.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _path(self, key: str) -> Path:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in key)
+        if not safe:
+            raise ValueError(f"Config key {key!r} sanitizes to empty")
+        return self._root / f"{safe}.json"
+
+    def _read(
+        self, path: Path
+    ) -> tuple[str, dict[str, Any], bool] | None:
+        """(key, doc, legacy). Legacy = pre-envelope file: its original key
+        is unknown, the sanitized stem is the best available name."""
+        try:
+            envelope = json.loads(path.read_text())
+        except FileNotFoundError:
+            return None
+        except json.JSONDecodeError:
+            logger.warning("Corrupt config file %s ignored", path)
+            return None
+        if (
+            isinstance(envelope, dict)
+            and "__key__" in envelope
+            and "doc" in envelope
+        ):
+            return envelope["__key__"], envelope["doc"], False
+        if isinstance(envelope, dict):
+            return path.stem, envelope, True
+        logger.warning("Corrupt config file %s ignored", path)
+        return None
+
+    def load(self, key: str) -> dict[str, Any] | None:
+        with self._lock:
+            entry = self._read(self._path(key))
+            if entry is None:
+                return None
+            stored_key, doc, legacy = entry
+            # A legacy file matches any key that sanitizes onto it (its
+            # true key is unknowable), an enveloped file only its own.
+            return doc if legacy or stored_key == key else None
+
+    def save(self, key: str, value: dict[str, Any]) -> None:
+        path = self._path(key)
+        with self._lock:
+            existing = self._read(path)
+            if (
+                existing is not None
+                and not existing[2]  # legacy files are overwritable
+                and existing[0] != key
+            ):
+                raise ValueError(
+                    f"Config keys {existing[0]!r} and {key!r} collide on "
+                    f"file {path.name}"
+                )
+            tmp = path.with_suffix(".json.tmp")
+            tmp.write_text(
+                json.dumps(
+                    {"__key__": key, "doc": value}, indent=2, sort_keys=True
+                )
+            )
+            tmp.replace(path)
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            path = self._path(key)
+            entry = self._read(path)
+            # Unlink unless the file verifiably belongs to a *different*
+            # key — corrupt and legacy files must stay deletable.
+            if entry is None or entry[2] or entry[0] == key:
+                path.unlink(missing_ok=True)
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            out = []
+            for path in self._root.glob("*.json"):
+                entry = self._read(path)
+                if entry is not None:
+                    out.append(entry[0])
+            return sorted(out)
+
+
+class ConfigStoreManager:
+    """Namespaced access onto one backing store (grids/, workflows/, ...)."""
+
+    def __init__(self, store: ConfigStore) -> None:
+        self._store = store
+
+    def namespaced(self, namespace: str) -> "_NamespacedStore":
+        return _NamespacedStore(self._store, namespace)
+
+
+class _NamespacedStore:
+    def __init__(self, store: ConfigStore, namespace: str) -> None:
+        self._store = store
+        self._prefix = f"{namespace}__"
+
+    def load(self, key: str) -> dict[str, Any] | None:
+        return self._store.load(self._prefix + key)
+
+    def save(self, key: str, value: dict[str, Any]) -> None:
+        self._store.save(self._prefix + key, value)
+
+    def delete(self, key: str) -> None:
+        self._store.delete(self._prefix + key)
+
+    def keys(self) -> list[str]:
+        return [
+            k[len(self._prefix):]
+            for k in self._store.keys()
+            if k.startswith(self._prefix)
+        ]

@@ -1,0 +1,73 @@
+"""Composition root wiring the dashboard's backend-facing services
+(reference: dashboard/dashboard_services.py:42)."""
+
+from __future__ import annotations
+
+from .config_store import ConfigStore, ConfigStoreManager, MemoryConfigStore
+from .data_service import DataService
+from .derived_devices import DerivedDeviceRegistry
+from .frame_clock import FrameClock
+from .job_orchestrator import JobOrchestrator
+from .job_service import JobService
+from .message_pump import MessagePump
+from .notification_queue import NotificationQueue
+from .plot_orchestrator import PlotOrchestrator
+from .session_registry import SessionRegistry
+from .stream_manager import StreamManager
+from .transport import Transport
+
+__all__ = ["DashboardServices"]
+
+
+class DashboardServices:
+    def __init__(
+        self,
+        *,
+        transport: Transport,
+        pump_interval_s: float = 0.05,
+        config_store: ConfigStore | None = None,
+        instrument: str = "",
+    ):
+        self.transport = transport
+        self.data_service = DataService()
+        self.notifications = NotificationQueue()
+        self.sessions = SessionRegistry()
+        self.job_service = JobService(on_event=self.notifications.push)
+        self.devices = DerivedDeviceRegistry()
+        self.frame_clock = FrameClock()
+        self.config_store = config_store or MemoryConfigStore()
+        self._store_manager = ConfigStoreManager(self.config_store)
+        self.orchestrator = JobOrchestrator(
+            transport=transport, job_service=self.job_service
+        )
+        self.plot_orchestrator = PlotOrchestrator(
+            data_service=self.data_service,
+            frame_clock=self.frame_clock,
+            # Namespaced: other consumers (workflow params, plot configs)
+            # share the backing store without colliding with grid docs.
+            store=self._store_manager.namespaced("grids"),
+            instrument=instrument,
+        )
+        self.stream_manager = StreamManager(data_service=self.data_service)
+        self.pump = MessagePump(
+            transport=transport,
+            data_service=self.data_service,
+            job_service=self.job_service,
+            device_registry=self.devices,
+            interval_s=pump_interval_s,
+        )
+
+    def start(self) -> None:
+        self.transport.start()
+        self.pump.start()
+
+    def stop(self) -> None:
+        self.pump.stop()
+        self.transport.stop()
+
+    def __enter__(self) -> "DashboardServices":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

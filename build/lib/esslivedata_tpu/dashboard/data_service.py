@@ -1,0 +1,137 @@
+"""DataService: the dashboard's single source of truth for results.
+
+Parity with reference ``dashboard/data_service.py:71`` and ADR 0007's
+concurrency model: ONE writer (the ingestion thread) commits batches of
+ResultKey-keyed values inside transactions; subscribers are notified with
+*keys only* after commit; readers (sessions) pull through extractors at
+their own pace under the lock. RLock + thread-local transaction depth
+allows nested transactions from the same thread.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections.abc import Callable, Iterable
+from contextlib import contextmanager
+from typing import Any
+
+from ..config.workflow_spec import ResultKey
+from ..core.timestamp import Timestamp
+from .extractors import Extractor, LatestValueExtractor
+from .temporal_buffers import TemporalBufferManager
+
+__all__ = ["DataService", "DataSubscription"]
+
+logger = logging.getLogger(__name__)
+
+
+class DataSubscription:
+    """Binds a set of keys to an extractor + callback."""
+
+    def __init__(
+        self,
+        keys: Iterable[ResultKey],
+        on_updated: Callable[[set[ResultKey]], None],
+        extractor: Extractor | None = None,
+    ) -> None:
+        self.keys = set(keys)
+        self.on_updated = on_updated
+        self.extractor = extractor or LatestValueExtractor()
+
+
+class DataService:
+    def __init__(
+        self, *, buffer_manager: TemporalBufferManager | None = None
+    ) -> None:
+        self._buffers = buffer_manager or TemporalBufferManager()
+        self._lock = threading.RLock()
+        self._local = threading.local()
+        self._subscriptions: list[DataSubscription] = []
+        self._pending_keys: set[ResultKey] = set()
+        self.generation = 0
+
+    # -- transactions ------------------------------------------------------
+    @contextmanager
+    def transaction(self):
+        depth = getattr(self._local, "depth", 0)
+        self._local.depth = depth + 1
+        self._lock.acquire()
+        try:
+            yield self
+        finally:
+            self._local.depth = depth
+            if depth == 0:
+                pending, self._pending_keys = self._pending_keys, set()
+                self.generation += 1
+                self._lock.release()
+                self._notify(pending)
+            else:
+                self._lock.release()
+
+    def put(self, key: ResultKey, timestamp: Timestamp, value: Any) -> None:
+        with self._lock:
+            self._buffers.put(key, timestamp, value)
+            if getattr(self._local, "depth", 0) > 0:
+                self._pending_keys.add(key)
+            else:
+                self.generation += 1
+        if getattr(self._local, "depth", 0) == 0:
+            self._notify({key})
+
+    def _notify(self, keys: set[ResultKey]) -> None:
+        if not keys:
+            return
+        for sub in list(self._subscriptions):
+            hit = keys & sub.keys if sub.keys else keys
+            if hit:
+                try:
+                    sub.on_updated(hit)
+                except Exception:
+                    logger.exception("Subscriber callback failed")
+
+    # -- subscriptions -----------------------------------------------------
+    def subscribe(self, subscription: DataSubscription) -> DataSubscription:
+        with self._lock:
+            self._subscriptions.append(subscription)
+            if subscription.extractor.wants_history:
+                for key in subscription.keys:
+                    self._buffers.require_history(key)
+        return subscription
+
+    def unsubscribe(self, subscription: DataSubscription) -> None:
+        with self._lock:
+            if subscription in self._subscriptions:
+                self._subscriptions.remove(subscription)
+
+    def require_history(self, key: ResultKey) -> None:
+        """Retain history for ``key`` even without a subscription.
+
+        The pull path (plot cells configured with a history-wanting
+        extractor) has no subscription to announce demand through;
+        whoever installs such a cell calls this, upgrading the key's
+        buffer in place (the current latest value is carried over).
+        """
+        with self._lock:
+            self._buffers.require_history(key)
+
+    # -- reads -------------------------------------------------------------
+    def get(self, key: ResultKey, extractor: Extractor | None = None) -> Any:
+        extractor = extractor or LatestValueExtractor()
+        with self._lock:
+            buf = self._buffers.get(key)
+            if buf is None:
+                return None
+            return extractor.extract(buf)
+
+    def keys(self) -> list[ResultKey]:
+        with self._lock:
+            return list(self._buffers.keys())
+
+    def __contains__(self, key: ResultKey) -> bool:
+        with self._lock:
+            return self._buffers.get(key) is not None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buffers.clear()

@@ -1,0 +1,58 @@
+"""NICOS derived-device overview (reference: dashboard/derived_devices.py).
+
+Backend services republish contracted workflow outputs on the stable
+NICOS device topic (ADR 0006, core/nicos_devices.py); this registry tracks
+the latest value per device name so the dashboard (and NICOS-facing
+tooling) sees a flat name->value table with staleness, independent of
+which job produced it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any
+
+__all__ = ["DerivedDevice", "DerivedDeviceRegistry"]
+
+STALE_AFTER_S = 30.0
+
+
+@dataclass
+class DerivedDevice:
+    name: str
+    value: Any
+    unit: str = ""
+    timestamp_ns: int = 0
+    last_seen_wall: float = 0.0
+
+    @property
+    def is_stale(self) -> bool:
+        return time.monotonic() - self.last_seen_wall > STALE_AFTER_S
+
+
+class DerivedDeviceRegistry:
+    def __init__(self) -> None:
+        self._devices: dict[str, DerivedDevice] = {}
+        self._lock = threading.Lock()
+
+    def on_device_value(
+        self, name: str, value: Any, *, unit: str = "", timestamp_ns: int = 0
+    ) -> None:
+        with self._lock:
+            self._devices[name] = DerivedDevice(
+                name=name,
+                value=value,
+                unit=unit,
+                timestamp_ns=timestamp_ns,
+                last_seen_wall=time.monotonic(),
+            )
+
+    def devices(self) -> list[DerivedDevice]:
+        with self._lock:
+            return sorted(self._devices.values(), key=lambda d: d.name)
+
+    def get(self, name: str) -> DerivedDevice | None:
+        with self._lock:
+            return self._devices.get(name)

@@ -1,0 +1,151 @@
+"""Pull-based extractors over buffers (reference: dashboard/extractors.py —
+LatestValueExtractor:64, FullHistoryExtractor:90,
+WindowAggregatingExtractor:138). Subscribers are notified with *keys only*;
+extraction happens on pull (ADR 0007)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ..utils.labeled import DataArray, Variable
+from .temporal_buffers import Buffer, TemporalBuffer
+
+__all__ = [
+    "Extractor",
+    "FullHistoryExtractor",
+    "LatestValueExtractor",
+    "WindowAggregatingExtractor",
+]
+
+
+class Extractor:
+    wants_history = False
+
+    def extract(self, buffer: Buffer) -> Any:  # pragma: no cover - protocol
+        raise NotImplementedError
+
+
+class LatestValueExtractor(Extractor):
+    def extract(self, buffer: Buffer) -> Any:
+        return buffer.latest()
+
+
+class FullHistoryExtractor(Extractor):
+    """Concatenates scalar/0-d history into a 1-D time series DataArray;
+    for non-scalar entries returns the raw (timestamp, value) list."""
+
+    wants_history = True
+
+    def extract(self, buffer: Buffer) -> Any:
+        entries = buffer.history()
+        if not entries:
+            return None
+        first = entries[0][1]
+        if isinstance(first, DataArray) and first.data.ndim == 0:
+            times = np.array([t.ns for t, _ in entries], dtype=np.int64)
+            values = np.array([np.asarray(v.values) for _, v in entries])
+            return DataArray(
+                Variable(values, ("time",), first.unit),
+                coords={"time": Variable(times, ("time",), "ns")},
+                name=first.name,
+            )
+        return entries
+
+
+#: Per-window provenance stamps Job.get puts on every output (0-d); they
+#: differ between every two publishes by construction and must not count
+#: as a structure change when aggregating across windows. A coord that
+#: indexes a data dim (e.g. an NXlog's 1-D 'time' axis) is NOT a stamp —
+#: different axis values mean different data and must restart.
+_STAMP_COORDS = frozenset({"start_time", "end_time"})
+
+
+def _aggregation_compatible(a: DataArray, b: DataArray) -> bool:
+    """Structure equality ignoring the per-window stamp coords.
+
+    Unit equality is exact: a compatible-but-rescaled unit would need a
+    conversion the raw-value summation below does not perform, so a unit
+    change restarts the aggregate instead.
+    """
+    if a.dims != b.dims or a.shape != b.shape:
+        return False
+    if a.unit != b.unit:
+        return False
+
+    def is_stamp(name: str) -> bool:
+        # Stamp exemption is by name AND rank: a 1-D coord that happens
+        # to be called start_time indexes data and must still compare.
+        # Membership checks FIRST: this is called for names from either
+        # side, and an entry carrying a stamp the other side lacks must
+        # fall through to the normal coord comparison (restarting the
+        # aggregate), not KeyError.
+        return (
+            name in _STAMP_COORDS
+            and name in a.coords
+            and np.asarray(a.coords[name].numpy).ndim == 0
+            and name in b.coords
+            and np.asarray(b.coords[name].numpy).ndim == 0
+        )
+
+    keys_a = {c for c in a.coords if not is_stamp(c)}
+    keys_b = {c for c in b.coords if not is_stamp(c)}
+    if keys_a != keys_b:
+        return False
+    return all(a.coords[c].identical(b.coords[c]) for c in keys_a)
+
+
+class WindowAggregatingExtractor(Extractor):
+    """Sum/mean over a trailing time window of structurally-equal entries.
+
+    "Structurally equal" ignores the per-window ``start_time``/``end_time``
+    stamps (they change every publish); a genuine structure change (shape,
+    binning coords, unit) restarts the aggregate at that entry. The result
+    carries the aggregated span: ``start_time`` of the first entry in the
+    group, everything else from the last.
+    """
+
+    wants_history = True
+
+    def __init__(self, window_s: float, operation: str = "sum") -> None:
+        if operation not in ("sum", "mean"):
+            raise ValueError(f"Unknown aggregation {operation!r}")
+        self._window_s = window_s
+        self._operation = operation
+
+    def extract(self, buffer: Buffer) -> Any:
+        if isinstance(buffer, TemporalBuffer):
+            entries = buffer.window(self._window_s)
+        else:
+            entries = buffer.history()
+        if not entries:
+            return None
+        arrays = [v for _, v in entries if isinstance(v, DataArray)]
+        if not arrays:
+            return entries[-1][1]
+        total: np.ndarray | None = None
+        first = template = arrays[0]
+        count = 0
+        for da in arrays:
+            if total is None or not _aggregation_compatible(template, da):
+                first = da  # structure changed mid-window: restart
+                total = np.array(da.values, dtype=np.float64, copy=True)
+                count = 1
+            else:
+                total = total + np.asarray(da.values, dtype=np.float64)
+                count += 1
+            template = da
+        if self._operation == "mean":
+            # Means stay float64: casting back to an integer count dtype
+            # would silently floor non-integer averages.
+            values = total / count if count > 1 else total
+        else:
+            values = total.astype(
+                np.asarray(template.values).dtype, copy=False
+            )
+        result = template.copy()
+        result.data = Variable(values, template.dims, template.unit)
+        if "start_time" in first.coords:
+            result.coords["start_time"] = first.coords["start_time"]
+        return result

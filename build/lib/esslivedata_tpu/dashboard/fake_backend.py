@@ -1,0 +1,184 @@
+"""In-process backend: the dashboard's dev-demo and test transport.
+
+Analog of reference ``dashboard/fake_backend.py:1-16`` but *stronger*: the
+reference synthesizes plausible data from output templates; here the fake
+transport hosts the real backend services (detector/monitor/timeseries)
+in-process over synthetic 14 Hz wire streams — real adapters, real jitted
+kernels, real serializers — so the full dashboard runs standalone with
+genuine physics-shaped data and true command round-trips.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Any
+
+from ..config.instrument import instrument_registry
+from ..core.message_batcher import SimpleMessageBatcher
+from ..kafka.sink import FakeProducer, KafkaSink, make_default_serializer
+from ..kafka.source import FakeKafkaMessage
+from ..services.detector_data import make_detector_service_builder
+from ..services.monitor_data import make_monitor_service_builder
+from ..services.timeseries import make_timeseries_service_builder
+from ..services.fake_sources import (
+    FakeDetectorStream,
+    FakeLogStream,
+    FakeMonitorStream,
+    PulsedRawSource,
+)
+from .transport import DashboardMessage, decode_backend_message
+
+__all__ = ["InProcessBackendTransport"]
+
+logger = logging.getLogger(__name__)
+
+
+class InProcessBackendTransport:
+    """Real backend services in this process, no broker.
+
+    ``tick()`` advances every service one step (one pulse of synthetic
+    data); ``start()`` instead runs a thread ticking at the requested rate.
+    """
+
+    def __init__(
+        self,
+        instrument: str = "dummy",
+        *,
+        events_per_pulse: int = 2000,
+        tick_interval_s: float = 1.0 / 14.0,
+    ) -> None:
+        self._instrument_name = instrument
+        self._tick_interval_s = tick_interval_s
+        instrument_obj = instrument_registry[instrument]
+        self._producer = FakeProducer()
+        self._services = []
+        self._raw_sources: list[PulsedRawSource] = []
+        self._lock = threading.Lock()
+        self._drained = 0
+        self._thread: threading.Thread | None = None
+        self._running = threading.Event()
+
+        prefix = instrument
+
+        det_streams = [
+            FakeDetectorStream(
+                topic=f"{prefix}_detector",
+                source_name=det.source_name,
+                detector_ids=(
+                    det.detector_number
+                    if det.detector_number is not None
+                    else det.pixel_ids
+                ),
+                events_per_pulse=events_per_pulse,
+                seed=i,
+            )
+            for i, det in enumerate(instrument_obj.detectors.values())
+        ]
+        mon_streams = [
+            FakeMonitorStream(
+                topic=f"{prefix}_monitor",
+                source_name=mon.source_name,
+                events_per_pulse=max(10, events_per_pulse // 10),
+                seed=i,
+            )
+            for i, mon in enumerate(instrument_obj.monitors.values())
+        ]
+        log_streams = [
+            FakeLogStream(topic=f"{prefix}_motion", source_name=source)
+            for source in instrument_obj.log_sources.values()
+        ]
+
+        for make_builder, streams, svc in (
+            (make_detector_service_builder, det_streams, "detector_data"),
+            (make_monitor_service_builder, mon_streams, "monitor_data"),
+            (make_timeseries_service_builder, log_streams, "timeseries"),
+        ):
+            # Snappy heartbeats: tick-driven tests and the demo UI should
+            # not wait 2 s wall time to observe job-state changes.
+            builder = make_builder(
+                instrument=instrument,
+                batcher=SimpleMessageBatcher(),
+                job_threads=1,
+                heartbeat_interval_s=0.05,
+            )
+            raw = PulsedRawSource(streams)
+            sink = KafkaSink(
+                self._producer,
+                make_default_serializer(
+                    builder.stream_mapping.livedata, f"{instrument}_{svc}"
+                ),
+            )
+            self._raw_sources.append(raw)
+            self._services.append(builder.from_raw_source(raw, sink))
+        self._topics = {
+            f"{prefix}_livedata_data": "data",
+            f"{prefix}_livedata_status": "status",
+            f"{prefix}_livedata_responses": "responses",
+            f"{prefix}_livedata_nicos": "nicos",
+        }
+
+    # -- Transport protocol ----------------------------------------------
+    def publish_command(self, payload: dict[str, Any]) -> None:
+        raw = FakeKafkaMessage(
+            json.dumps(payload).encode(),
+            f"{self._instrument_name}_livedata_commands",
+        )
+        with self._lock:
+            for source in self._raw_sources:
+                source.inject(raw)
+
+    def get_messages(self) -> list[DashboardMessage]:
+        with self._lock:
+            fresh = self._producer.messages[self._drained :]
+            self._drained = len(self._producer.messages)
+        out: list[DashboardMessage] = []
+        for sm in fresh:
+            kind = self._topics.get(sm.topic)
+            if kind is None:
+                continue
+            try:
+                decoded = decode_backend_message(kind, sm.value)
+            except Exception:
+                logger.exception("Failed to decode backend message")
+                continue
+            if decoded is not None:
+                out.append(decoded)
+        return out
+
+    def tick(self, n: int = 1) -> None:
+        """Advance every in-process service n steps (deterministic mode)."""
+        for _ in range(n):
+            with self._lock:
+                for service in self._services:
+                    service.step()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._running.set()
+
+        def loop():
+            while self._running.is_set():
+                t0 = time.monotonic()
+                try:
+                    self.tick()
+                except Exception:
+                    logger.exception("Backend tick failed")
+                dt = time.monotonic() - t0
+                time.sleep(max(0.0, self._tick_interval_s - dt))
+
+        self._thread = threading.Thread(
+            target=loop, name="fake-backend", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running.clear()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        for service in self._services:
+            service.processor.finalize()

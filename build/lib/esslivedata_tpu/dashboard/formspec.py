@@ -1,0 +1,115 @@
+"""Schema -> wizard form-field derivation, server-side.
+
+The workflow wizard used to derive input kinds from the raw JSON schema
+in browser JS — logic that was untestable here (no JS runtime in the
+environment). The derivation now lives in Python where pytest covers
+it; the client renders the precomputed field list mechanically and only
+keeps a per-kind raw->value coercion (static/applogic.js).
+
+Reference parity: this is the TPU-repo answer to the reference's
+pydantic-model-driven parameter widgets
+(dashboard/widgets/configuration_widget.py:1 builds Panel widgets from
+the params model; here the server ships a widget-agnostic spec).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+__all__ = ["schema_to_formspec"]
+
+
+def _resolve_ref(schema: dict, root: dict) -> dict:
+    """Follow a local $ref (pydantic emits '#/$defs/Name')."""
+    ref = schema.get("$ref")
+    if not ref or not ref.startswith("#/"):
+        return schema
+    node: Any = root
+    for part in ref[2:].split("/"):
+        node = node.get(part, {}) if isinstance(node, dict) else {}
+    return node if isinstance(node, dict) else {}
+
+
+def _field_kind(prop: dict, root: dict) -> str:
+    """'boolean' | 'integer' | 'number' | 'text' | 'json'.
+
+    anyOf with null (Optional[...]) unwraps to the non-null variant;
+    $ref / object / array land on 'json' (the input rides as a JSON
+    string, the server parses).
+    """
+    if "$ref" in prop:
+        prop = _resolve_ref(prop, root)
+    variants = prop.get("anyOf")
+    if variants:
+        non_null = [v for v in variants if v.get("type") != "null"]
+        if len(non_null) == 1:
+            return _field_kind(non_null[0], root)
+        return "json"
+    t = prop.get("type")
+    if t == "boolean":
+        return "boolean"
+    if t == "integer":
+        return "integer"
+    if t == "number":
+        return "number"
+    if t == "string":
+        return "text"
+    return "json"
+
+
+def _default_text(prop: dict, kind: str) -> str | None:
+    """The default rendered the way the matching input expects it."""
+    if "default" not in prop:
+        return None
+    d = prop["default"]
+    if d is None:
+        return None
+    if kind == "boolean":
+        return "true" if d else "false"
+    if isinstance(d, (dict, list)):
+        return json.dumps(d)
+    return str(d)
+
+
+def schema_to_formspec(schema: dict | None) -> list[dict] | None:
+    """Flat field descriptors for the wizard, or None without a model.
+
+    Each entry: ``{name, kind, default_text, description, enum}`` —
+    everything the client needs to build an input without touching the
+    schema. ``enum`` (list of string options) is set for Literal/enum
+    string fields so the client can render a select.
+    """
+    if not schema:
+        return None
+    fields = []
+    for name, prop in (schema.get("properties") or {}).items():
+        resolved = _resolve_ref(prop, schema) if "$ref" in prop else prop
+        kind = _field_kind(prop, schema)
+        enum = None
+        enum_src = resolved
+        if "anyOf" in resolved:
+            non_null = [
+                v for v in resolved["anyOf"] if v.get("type") != "null"
+            ]
+            if len(non_null) == 1:
+                enum_src = non_null[0]
+                if "$ref" in enum_src:
+                    enum_src = _resolve_ref(enum_src, schema)
+        if isinstance(enum_src.get("enum"), list) and all(
+            isinstance(v, str) for v in enum_src["enum"]
+        ):
+            enum = list(enum_src["enum"])
+            kind = "text"
+        fields.append(
+            {
+                "name": name,
+                "kind": kind,
+                "default_text": _default_text(prop, kind),
+                "description": prop.get("description")
+                or resolved.get("description")
+                or "",
+                "enum": enum,
+            }
+        )
+    return fields

@@ -1,0 +1,49 @@
+"""Frame-gated repaint clock (ADR 0005, reference dashboard/frame_clock.py).
+
+The ingestion thread commits a *generation* per grid after writing a batch;
+sessions poll at their own cadence and repaint a grid only when its
+generation advanced since the last paint. This decouples ingest rate from
+paint rate — a slow browser never backs up ingestion, a fast poller never
+repaints unchanged grids.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["FrameClock"]
+
+
+class FrameClock:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._generations: dict[str, int] = {}
+        self._global = 0
+
+    def commit(self, grid_id: str) -> int:
+        """Ingestion finished writing data visible in ``grid_id``."""
+        with self._lock:
+            self._global += 1
+            self._generations[grid_id] = self._global
+            return self._global
+
+    def commit_all(self) -> int:
+        """Data arrived that may affect every grid (e.g. unassigned keys)."""
+        with self._lock:
+            self._global += 1
+            for grid_id in self._generations:
+                self._generations[grid_id] = self._global
+            return self._global
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._global
+
+    def grid_generation(self, grid_id: str) -> int:
+        with self._lock:
+            return self._generations.get(grid_id, 0)
+
+    def changed_since(self, grid_id: str, seen: int) -> bool:
+        """Session-side check: repaint needed?"""
+        return self.grid_generation(grid_id) > seen

@@ -1,0 +1,125 @@
+"""Workflow lifecycle from the dashboard side.
+
+Parity with reference ``dashboard/job_orchestrator.py`` (1367 LoC) at the
+architectural level: staged-config -> commit two-phase start (stage params,
+then commit publishes the command), job numbers generated dashboard-side,
+stop/remove/reset commands, ROI pushes, reconciliation with heartbeats via
+JobService (adoption is handled there).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any
+
+from ..config.workflow_spec import JobId, WorkflowConfig, WorkflowId
+from ..workflows.workflow_factory import WorkflowFactory, workflow_registry
+from .job_service import JobService, PendingCommand
+from .transport import Transport
+
+__all__ = ["JobOrchestrator"]
+
+
+class JobOrchestrator:
+    def __init__(
+        self,
+        *,
+        transport: Transport,
+        job_service: JobService,
+        registry: WorkflowFactory | None = None,
+    ) -> None:
+        self._transport = transport
+        self._job_service = job_service
+        self._registry = registry if registry is not None else workflow_registry
+        self._staged: dict[tuple[str, str], dict[str, Any]] = {}
+
+    # -- two-phase start ---------------------------------------------------
+    def stage(
+        self, workflow_id: WorkflowId, source_name: str, params: dict[str, Any]
+    ) -> None:
+        """Stage params for (workflow, source); validated against the spec
+        immediately so the UI gets early feedback."""
+        spec = self._registry[workflow_id]
+        spec.validate_params(params)
+        self._staged[(str(workflow_id), source_name)] = params
+
+    def staged_params(
+        self, workflow_id: WorkflowId, source_name: str
+    ) -> dict[str, Any] | None:
+        return self._staged.get((str(workflow_id), source_name))
+
+    def commit(
+        self,
+        workflow_id: WorkflowId,
+        source_name: str,
+        *,
+        aux_source_names: dict[str, str] | None = None,
+    ) -> tuple[JobId, PendingCommand]:
+        """Publish the start command with a fresh job number."""
+        params = self._staged.pop((str(workflow_id), source_name), {})
+        job_id = JobId(source_name=source_name, job_number=uuid.uuid4())
+        config = WorkflowConfig(
+            identifier=workflow_id,
+            job_id=job_id,
+            params=params,
+            aux_source_names=aux_source_names or {},
+        )
+        self._transport.publish_command(
+            {"kind": "start_job", "config": config.model_dump(mode="json")}
+        )
+        pending = self._job_service.track_command(
+            source_name, job_id.job_number, "start_job"
+        )
+        return job_id, pending
+
+    def start(
+        self,
+        workflow_id: WorkflowId,
+        source_name: str,
+        params: dict[str, Any] | None = None,
+    ) -> tuple[JobId, PendingCommand]:
+        """stage+commit in one call (programmatic use)."""
+        self.stage(workflow_id, source_name, params or {})
+        return self.commit(workflow_id, source_name)
+
+    # -- lifecycle commands ------------------------------------------------
+    def _job_command(self, action: str, job_id: JobId) -> PendingCommand:
+        self._transport.publish_command(
+            {
+                "kind": "job_command",
+                "action": action,
+                "source_name": job_id.source_name,
+                "job_number": str(job_id.job_number),
+            }
+        )
+        return self._job_service.track_command(
+            job_id.source_name, job_id.job_number, action
+        )
+
+    def stop(self, job_id: JobId) -> PendingCommand:
+        return self._job_command("stop", job_id)
+
+    def remove(self, job_id: JobId) -> PendingCommand:
+        return self._job_command("remove", job_id)
+
+    def reset(self, job_id: JobId) -> PendingCommand:
+        return self._job_command("reset", job_id)
+
+    def set_rois(self, job_id: JobId, rois: dict[str, Any]) -> PendingCommand:
+        """Publish ROI definitions for a running detector-view job (the ROI
+        round trip, reference roi_request_plots)."""
+        self._transport.publish_command(
+            {
+                "kind": "roi_update",
+                "source_name": job_id.source_name,
+                "job_number": str(job_id.job_number),
+                "rois": rois,
+            }
+        )
+        return self._job_service.track_command(
+            job_id.source_name, job_id.job_number, "roi_update"
+        )
+
+    # -- catalog -----------------------------------------------------------
+    def available_workflows(self, instrument: str):
+        return self._registry.specs_for_instrument(instrument)

@@ -1,0 +1,226 @@
+"""Service + job tracking from heartbeats and acks.
+
+Parity with reference ``dashboard/job_service.py`` / ``service_registry.py``
+/ ``active_job_registry.py`` / ``pending_command_tracker.py``: services are
+known through their 2 s x5f2 heartbeats (stale after a timeout); jobs are
+known through those heartbeats too — including jobs this dashboard did not
+start, which are *adopted* (ADR 0008) so a dashboard restart recovers the
+fleet state; pending commands resolve on ack or expire.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+from ..core.job import JobStatus, ServiceStatus
+from .transport import AckMessage, StatusMessage
+
+__all__ = ["JobService", "PendingCommand", "TrackedService"]
+
+logger = logging.getLogger(__name__)
+
+SERVICE_STALE_S = float(os.environ.get("LIVEDATA_SERVICE_STALE_S", "10"))
+COMMAND_EXPIRY_S = float(os.environ.get("LIVEDATA_COMMAND_EXPIRY_S", "10"))
+
+
+@dataclass
+class TrackedService:
+    service_id: str
+    status: ServiceStatus
+    last_seen_wall: float
+
+    @property
+    def is_stale(self) -> bool:
+        return time.monotonic() - self.last_seen_wall > SERVICE_STALE_S
+
+
+@dataclass
+class PendingCommand:
+    source_name: str
+    job_number: uuid.UUID
+    kind: str
+    issued_wall: float = field(default_factory=time.monotonic)
+    resolved: bool = False
+    error: str = ""
+
+    @property
+    def expired(self) -> bool:
+        return (
+            not self.resolved
+            and time.monotonic() - self.issued_wall > COMMAND_EXPIRY_S
+        )
+
+
+class JobService:
+    def __init__(self, *, on_event=None) -> None:
+        self._services: dict[str, TrackedService] = {}
+        self._jobs: dict[tuple[str, uuid.UUID], JobStatus] = {}
+        self._adopted: set[tuple[str, uuid.UUID]] = set()
+        self._known_started: set[tuple[str, uuid.UUID]] = set()
+        self._pending: list[PendingCommand] = []
+        # job key -> owning service, from the heartbeat that last listed it
+        # (reconciliation needs to know whose heartbeat to compare against).
+        self._job_owner: dict[tuple[str, uuid.UUID], str] = {}
+        self._lock = threading.Lock()
+        # on_event(level, message): user-facing happenings (expired
+        # commands, vanished jobs) — wired to the NotificationQueue by the
+        # composition root; None = silent.
+        self._on_event = on_event or (lambda level, message: None)
+
+    # -- ingestion callbacks ----------------------------------------------
+    def on_status(self, msg: StatusMessage) -> None:
+        vanished: list[tuple[str, uuid.UUID]] = []
+        with self._lock:
+            self._services[msg.service_id] = TrackedService(
+                service_id=msg.service_id,
+                status=msg.status,
+                last_seen_wall=time.monotonic(),
+            )
+            listed: set[tuple[str, uuid.UUID]] = set()
+            for job in msg.status.jobs:
+                key = (job.source_name, job.job_number)
+                listed.add(key)
+                if key not in self._jobs and key not in self._known_started:
+                    # heartbeat mentions a job we never started: adopt it
+                    self._adopted.add(key)
+                    logger.info("Adopted job %s/%s from heartbeat", *key)
+                self._jobs[key] = job
+                self._job_owner[key] = msg.service_id
+            # Reconcile: a job this service's previous heartbeat listed but
+            # this one does not has died between heartbeats (service-side
+            # crash/GC — a dashboard-issued stop/remove also delists it,
+            # but those resolve a pending command, so the notification
+            # names whichever happened).
+            for key, owner in list(self._job_owner.items()):
+                if owner == msg.service_id and key not in listed:
+                    vanished.append(key)
+                    self._jobs.pop(key, None)
+                    self._job_owner.pop(key, None)
+                    self._adopted.discard(key)
+            # A job delisted because *we* just stopped/removed it is routine,
+            # not an incident: downgrade its notification to info.
+            now = time.monotonic()
+            # Unresolved commands count too: acks and heartbeats ride
+            # independent transport paths, so the delisting heartbeat may
+            # well be processed before the stop's ack.
+            operator_stopped = {
+                (c.source_name, c.job_number)
+                for c in self._pending
+                if c.kind in ("stop", "remove")
+                and not c.error
+                and now - c.issued_wall <= COMMAND_EXPIRY_S
+            }
+        for source_name, job_number in vanished:
+            key = (source_name, job_number)
+            if key in operator_stopped:
+                logger.info(
+                    "Job %s/%s delisted after operator stop/remove",
+                    source_name,
+                    job_number,
+                )
+                self._on_event(
+                    "info",
+                    f"Job {source_name}/{str(job_number)[:8]} stopped",
+                )
+                continue
+            logger.warning(
+                "Job %s/%s disappeared from %s heartbeat",
+                source_name,
+                job_number,
+                msg.service_id,
+            )
+            self._on_event(
+                "warning",
+                f"Job {source_name}/{str(job_number)[:8]} is gone from "
+                f"{msg.service_id} (stopped or died)",
+            )
+
+    def on_ack(self, msg: AckMessage) -> None:
+        payload = msg.payload
+        try:
+            key = (payload["source_name"], uuid.UUID(payload["job_number"]))
+        except (KeyError, ValueError):
+            logger.warning("Malformed ack: %r", payload)
+            return
+        rejected: PendingCommand | None = None
+        with self._lock:
+            for cmd in self._pending:
+                if (cmd.source_name, cmd.job_number) == key and not cmd.resolved:
+                    cmd.resolved = True
+                    if payload.get("status") == "error":
+                        cmd.error = payload.get("message", "error")
+                        rejected = cmd
+                    break
+        if rejected is not None:
+            # A rejection travels in the async ack — the HTTP POST that
+            # issued the command already returned ok, so this toast is the
+            # only way the operator learns the update was discarded (e.g.
+            # an ROI set over the per-geometry capacity).
+            self._on_event(
+                "error",
+                f"Command {rejected.kind!r} for {rejected.source_name}/"
+                f"{str(rejected.job_number)[:8]} rejected: {rejected.error}",
+            )
+
+    # -- command tracking --------------------------------------------------
+    def track_command(
+        self, source_name: str, job_number: uuid.UUID, kind: str
+    ) -> PendingCommand:
+        cmd = PendingCommand(
+            source_name=source_name, job_number=job_number, kind=kind
+        )
+        with self._lock:
+            self._known_started.add((source_name, job_number))
+            self._pending.append(cmd)
+            self._pending = [
+                c for c in self._pending if not c.resolved or not c.expired
+            ][-100:]
+        return cmd
+
+    # -- views -------------------------------------------------------------
+    def services(self) -> list[TrackedService]:
+        with self._lock:
+            return list(self._services.values())
+
+    def jobs(self) -> list[JobStatus]:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def job(self, source_name: str, job_number: uuid.UUID) -> JobStatus | None:
+        with self._lock:
+            return self._jobs.get((source_name, job_number))
+
+    def is_adopted(self, source_name: str, job_number: uuid.UUID) -> bool:
+        with self._lock:
+            return (source_name, job_number) in self._adopted
+
+    def owner_of(self, source_name: str, job_number: uuid.UUID) -> str:
+        """The service whose heartbeat last listed this job ('' unknown)."""
+        with self._lock:
+            return self._job_owner.get((source_name, job_number), "")
+
+    def pending_commands(self) -> list[PendingCommand]:
+        with self._lock:
+            return [c for c in self._pending if not c.resolved]
+
+    def sweep_expired(self) -> list[PendingCommand]:
+        """Drop commands that never got an ack within the expiry window,
+        emitting a user-facing notification for each (reference
+        pending_command_tracker.py expiry). Called periodically by the
+        message pump."""
+        with self._lock:
+            expired = [c for c in self._pending if c.expired]
+            self._pending = [c for c in self._pending if not c.expired]
+        for cmd in expired:
+            self._on_event(
+                "error",
+                f"Command {cmd.kind!r} for {cmd.source_name}/"
+                f"{str(cmd.job_number)[:8]} got no acknowledgement in "
+                f"{COMMAND_EXPIRY_S:.0f}s — service down or command lost",
+            )
+        return expired

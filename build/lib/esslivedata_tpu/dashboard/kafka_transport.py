@@ -1,0 +1,131 @@
+"""Kafka transport for the dashboard (reference: dashboard/kafka_transport.py:28).
+
+Consumes the per-instrument livedata data/status/responses topics and
+publishes commands. Requires confluent_kafka (optional [kafka] extra).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any
+
+from ..kafka.stream_mapping import LivedataTopics
+from .transport import DashboardMessage, decode_backend_message
+
+__all__ = ["DashboardBrokerTransport", "DashboardKafkaTransport", "DashboardFileBrokerTransport"]
+
+logger = logging.getLogger(__name__)
+
+
+class DashboardBrokerTransport:
+    """Dashboard transport over any confluent-shaped consumer/producer
+    pair: the Kafka and file-broker variants below differ only in client
+    construction."""
+
+    def __init__(self, *, instrument: str, dev: bool, consumer, producer) -> None:
+        self._topics = LivedataTopics.for_instrument(instrument, dev)
+        self._kind_by_topic = {
+            self._topics.data: "data",
+            self._topics.status: "status",
+            self._topics.responses: "responses",
+            self._topics.nicos: "nicos",
+        }
+        self._consumer = consumer
+        self._producer = producer
+
+    def start(self) -> None:
+        self._consumer.subscribe(list(self._kind_by_topic))
+
+    def stop(self) -> None:
+        self._consumer.close()
+        self._producer.flush(5)
+
+    def publish_command(self, payload: dict[str, Any]) -> None:
+        self._producer.produce(
+            self._topics.commands, json.dumps(payload).encode()
+        )
+        self._producer.poll(0)
+
+    def get_messages(self) -> list[DashboardMessage]:  # noqa: C901
+        out: list[DashboardMessage] = []
+        for raw in self._consumer.consume(100, 0.05) or []:
+            if raw.error() is not None:
+                logger.warning("Kafka error: %s", raw.error())
+                continue
+            kind = self._kind_by_topic.get(raw.topic())
+            if kind is None:
+                continue
+            try:
+                decoded = decode_backend_message(kind, raw.value())
+            except Exception:
+                logger.exception("Failed to decode message on %s", raw.topic())
+                continue
+            if decoded is not None:
+                out.append(decoded)
+        return out
+
+
+class DashboardKafkaTransport(DashboardBrokerTransport):
+    def __init__(
+        self,
+        *,
+        instrument: str,
+        bootstrap: str | None = None,
+        dev: bool = False,
+        group_id: str | None = None,
+    ) -> None:
+        try:
+            from confluent_kafka import Consumer, Producer
+        except ImportError as err:  # pragma: no cover - env without kafka
+            raise RuntimeError(
+                "confluent_kafka is required for the Kafka transport; "
+                "install the [kafka] extra or use --transport fake"
+            ) from err
+        from ..kafka.consumer import kafka_client_config
+
+        # Full client config (incl. SASL/SSL in prod); ``bootstrap`` only
+        # overrides the broker address.
+        client_conf = kafka_client_config(bootstrap_override=bootstrap)
+        consumer = Consumer(
+            {
+                **client_conf,
+                "group.id": group_id or f"{instrument}_dashboard",
+                "auto.offset.reset": "latest",
+                "enable.auto.commit": False,
+            }
+        )
+        super().__init__(
+            instrument=instrument,
+            dev=dev,
+            consumer=consumer,
+            producer=Producer(client_conf),
+        )
+
+
+class DashboardFileBrokerTransport(DashboardBrokerTransport):
+    """Dashboard over the file-backed broker (multi-process integration
+    and broker-less multi-service dev runs)."""
+
+    def __init__(
+        self, *, instrument: str, broker_dir: str, dev: bool = False
+    ) -> None:
+        from ..kafka.file_broker import (
+            FileBrokerConsumer,
+            FileBrokerProducer,
+            ensure_topics,
+        )
+
+        topics = LivedataTopics.for_instrument(instrument, dev)
+        ensure_topics(
+            broker_dir,
+            [topics.data, topics.status, topics.responses, topics.nicos,
+             topics.commands],
+        )
+        super().__init__(
+            instrument=instrument,
+            dev=dev,
+            consumer=FileBrokerConsumer(broker_dir),
+            producer=FileBrokerProducer(broker_dir),
+        )
+

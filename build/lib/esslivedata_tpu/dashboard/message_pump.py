@@ -1,0 +1,96 @@
+"""Transport -> DataService/JobService ingestion pump.
+
+Parity with reference ``dashboard/message_pump.py:28``: control messages
+(status/acks) are handled outside the data transaction; data messages
+commit inside one transaction per drain so subscribers see one keys-only
+notification per batch (ADR 0005/0007).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from .data_service import DataService
+from .derived_devices import DerivedDeviceRegistry
+from .job_service import JobService
+from .transport import (
+    AckMessage,
+    DeviceMessage,
+    ResultMessage,
+    StatusMessage,
+    Transport,
+)
+
+__all__ = ["MessagePump"]
+
+logger = logging.getLogger(__name__)
+
+
+class MessagePump:
+    def __init__(
+        self,
+        *,
+        transport: Transport,
+        data_service: DataService,
+        job_service: JobService,
+        device_registry: DerivedDeviceRegistry | None = None,
+        interval_s: float = 0.05,
+    ) -> None:
+        self._transport = transport
+        self._data_service = data_service
+        self._job_service = job_service
+        self._devices = device_registry
+        self._interval_s = interval_s
+        self._thread: threading.Thread | None = None
+        self._running = threading.Event()
+
+    def pump_once(self) -> int:
+        # Time-based upkeep first: command expiry does not depend on any
+        # message arriving (a dead broker is exactly when it must fire).
+        self._job_service.sweep_expired()
+        messages = self._transport.get_messages()
+        if not messages:
+            return 0
+        control = [m for m in messages if not isinstance(m, ResultMessage)]
+        data = [m for m in messages if isinstance(m, ResultMessage)]
+        for msg in control:
+            if isinstance(msg, StatusMessage):
+                self._job_service.on_status(msg)
+            elif isinstance(msg, AckMessage):
+                self._job_service.on_ack(msg)
+            elif isinstance(msg, DeviceMessage) and self._devices is not None:
+                self._devices.on_device_value(
+                    msg.name,
+                    msg.value,
+                    unit=msg.unit,
+                    timestamp_ns=msg.timestamp_ns,
+                )
+        if data:
+            with self._data_service.transaction():
+                for msg in data:
+                    self._data_service.put(msg.key, msg.timestamp, msg.data)
+        return len(messages)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._running.set()
+
+        def loop():
+            while self._running.is_set():
+                try:
+                    self.pump_once()
+                except Exception:
+                    logger.exception("Message pump iteration failed")
+                time.sleep(self._interval_s)
+
+        self._thread = threading.Thread(target=loop, name="ingestion", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running.clear()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

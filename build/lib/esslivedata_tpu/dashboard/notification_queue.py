@@ -1,0 +1,61 @@
+"""User-facing notifications (reference: dashboard/notification_queue.py).
+
+Producers (ingestion, orchestrators, command tracking) push; sessions
+drain at their own pace with a per-session cursor, so one slow browser
+never blocks another and late-joining sessions see recent history.
+Bounded: old notifications fall off.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Literal
+
+__all__ = ["Notification", "NotificationQueue"]
+
+Level = Literal["info", "warning", "error"]
+
+
+@dataclass(frozen=True)
+class Notification:
+    seq: int
+    level: Level
+    message: str
+    created_wall: float = field(default_factory=time.time)
+
+
+class NotificationQueue:
+    def __init__(self, *, max_items: int = 200) -> None:
+        self._items: list[Notification] = []
+        self._seq = 0
+        self._max = max_items
+        self._lock = threading.Lock()
+
+    def push(self, level: Level, message: str) -> Notification:
+        with self._lock:
+            self._seq += 1
+            note = Notification(seq=self._seq, level=level, message=message)
+            self._items.append(note)
+            del self._items[: -self._max]
+            return note
+
+    def info(self, message: str) -> Notification:
+        return self.push("info", message)
+
+    def warning(self, message: str) -> Notification:
+        return self.push("warning", message)
+
+    def error(self, message: str) -> Notification:
+        return self.push("error", message)
+
+    def since(self, seq: int) -> list[Notification]:
+        """All notifications newer than ``seq`` (the session cursor)."""
+        with self._lock:
+            return [n for n in self._items if n.seq > seq]
+
+    @property
+    def latest_seq(self) -> int:
+        with self._lock:
+            return self._seq

@@ -1,0 +1,324 @@
+"""Plot grids/cells lifecycle + persistence.
+
+Parity with reference ``dashboard/plot_orchestrator.py`` (1 798 LoC) at the
+architectural level: the orchestrator owns the set of plot grids, each a
+named arrangement of cells; a cell selects result streams by
+(workflow, output, source) pattern and renders via the plotter registry.
+Cells match ResultKeys as data arrives (keys-only notifications from
+DataService); matches commit the owning grid's FrameClock generation so
+sessions repaint exactly the grids whose data moved (ADR 0005).
+Grid configurations persist across restarts through a ConfigStore and can
+be seeded from per-instrument YAML templates (config/grid_template.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field, replace
+
+from ..config.grid_template import (
+    CellGeometry,
+    GridCellSpec,
+    GridSpec,
+    load_grid_templates,
+)
+from ..config.workflow_spec import ResultKey
+from .config_store import ConfigStore, MemoryConfigStore
+from .data_service import DataService, DataSubscription
+from .frame_clock import FrameClock
+
+__all__ = ["PlotCell", "PlotGrid", "PlotOrchestrator"]
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class PlotCell:
+    """A live cell: its spec plus the ResultKeys currently matched to it."""
+
+    spec: GridCellSpec
+    keys: set[ResultKey] = field(default_factory=set)
+
+    def matches(self, key: ResultKey) -> bool:
+        s = self.spec
+        if s.workflow and str(key.workflow_id) != s.workflow:
+            return False
+        if s.output and key.output_name != s.output:
+            return False
+        if s.source and key.job_id.source_name != s.source:
+            return False
+        return bool(s.workflow or s.output or s.source)
+
+    @property
+    def wants_history(self) -> bool:
+        """True when this cell's configured extractor aggregates over the
+        key's past values — the data service must then retain history for
+        the cell's keys (pull path has no subscription to announce it).
+        Derived from the extractor itself so a new history-wanting
+        extractor cannot silently miss the buffer upgrade."""
+        from .plots import PlotParams
+
+        try:
+            extractor = PlotParams.from_dict(
+                dict(self.spec.params or {})
+            ).make_extractor()
+        except (ValueError, TypeError):
+            # Corrupt persisted params must not take the orchestrator
+            # down during _restore; the render path 400s them instead.
+            return False
+        return extractor is not None and extractor.wants_history
+
+
+@dataclass
+class PlotGrid:
+    grid_id: str
+    spec: GridSpec
+    cells: list[PlotCell] = field(default_factory=list)
+
+
+class PlotOrchestrator:
+    """Owns grids; binds cells to data; drives the frame clock."""
+
+    def __init__(
+        self,
+        *,
+        data_service: DataService,
+        frame_clock: FrameClock | None = None,
+        store: ConfigStore | None = None,
+        instrument: str = "",
+    ) -> None:
+        self._data = data_service
+        self.clock = frame_clock or FrameClock()
+        self._store = store or MemoryConfigStore()
+        self._instrument = instrument
+        self._grids: dict[str, PlotGrid] = {}
+        self._lock = threading.RLock()
+        self._subscription = DataSubscription(
+            keys=set(), on_updated=self._on_data
+        )
+        data_service.subscribe(self._subscription)
+        self._restore()
+        if not self._grids and instrument:
+            self._seed_from_templates(instrument)
+
+    # -- persistence --------------------------------------------------------
+    def _restore(self) -> None:
+        for grid_id in self._store.keys():
+            raw = self._store.load(grid_id)
+            if raw is None:
+                continue
+            try:
+                spec = GridSpec.from_dict(raw)
+            except Exception:
+                logger.exception("Corrupt grid config %r ignored", grid_id)
+                continue
+            self._install(grid_id, spec, persist=False)
+
+    def _seed_from_templates(self, instrument: str) -> None:
+        for spec in load_grid_templates(instrument):
+            if spec.enabled:
+                self._install(spec.name, spec, persist=True)
+
+    def _persist(self, grid: PlotGrid) -> None:
+        spec = grid.spec
+        self._store.save(
+            grid.grid_id,
+            {
+                "name": spec.name,
+                "title": spec.title,
+                "description": spec.description,
+                "nrows": spec.nrows,
+                "ncols": spec.ncols,
+                "enabled": spec.enabled,
+                "cells": [
+                    {
+                        "geometry": {
+                            "row": c.spec.geometry.row,
+                            "col": c.spec.geometry.col,
+                            "row_span": c.spec.geometry.row_span,
+                            "col_span": c.spec.geometry.col_span,
+                        },
+                        "workflow": c.spec.workflow,
+                        "output": c.spec.output,
+                        "source": c.spec.source,
+                        "plotter": c.spec.plotter,
+                        "title": c.spec.title,
+                        "params": c.spec.params_dict,
+                    }
+                    for c in grid.cells
+                ],
+            },
+        )
+
+    # -- grid lifecycle ------------------------------------------------------
+    def _install(self, grid_id: str, spec: GridSpec, *, persist: bool) -> PlotGrid:
+        grid = PlotGrid(
+            grid_id=grid_id,
+            spec=spec,
+            cells=[PlotCell(spec=c) for c in spec.cells],
+        )
+        with self._lock:
+            self._grids[grid_id] = grid
+            # Bind any already-present data.
+            for key in self._data.keys():
+                for cell in grid.cells:
+                    if cell.matches(key):
+                        cell.keys.add(key)
+        for cell in grid.cells:
+            self._sync_history_demand(cell)
+        if persist:
+            self._persist(grid)
+        self.clock.commit(grid_id)
+        return grid
+
+    def add_grid(self, spec: GridSpec, grid_id: str | None = None) -> PlotGrid:
+        return self._install(grid_id or spec.name, spec, persist=True)
+
+    def remove_grid(self, grid_id: str) -> None:
+        with self._lock:
+            self._grids.pop(grid_id, None)
+        self._store.delete(grid_id)
+
+    def add_cell(self, grid_id: str, cell_spec: GridCellSpec) -> PlotCell:
+        with self._lock:
+            grid = self._grids[grid_id]
+            cell = PlotCell(spec=cell_spec)
+            for key in self._data.keys():
+                if cell.matches(key):
+                    cell.keys.add(key)
+            grid.cells.append(cell)
+            grid.spec = replace(
+                grid.spec, cells=(*grid.spec.cells, cell_spec)
+            )
+        self._sync_history_demand(cell)
+        self._persist(grid)
+        self.clock.commit(grid_id)
+        return cell
+
+    def remove_cell(self, grid_id: str, index: int) -> None:
+        with self._lock:
+            grid = self._grids[grid_id]
+            del grid.cells[index]
+            cells = list(grid.spec.cells)
+            del cells[index]
+            grid.spec = replace(grid.spec, cells=tuple(cells))
+        self._persist(grid)
+        self.clock.commit(grid_id)
+
+    def update_cell(
+        self, grid_id: str, index: int, **changes
+    ) -> PlotCell:
+        """Edit a cell's spec in place (the plot-config surface): stream
+        selection, plotter choice, title, presentation params. Selection
+        changes rebind the cell's matched keys; everything persists."""
+        from ..config.grid_template import GridCellSpec
+
+        if "params" in changes and isinstance(changes["params"], dict):
+            changes["params"] = GridCellSpec.freeze_params(changes["params"])
+        with self._lock:
+            grid = self._grids[grid_id]
+            cell = grid.cells[index]
+            new_spec = replace(cell.spec, **changes)
+            new_cell = PlotCell(spec=new_spec)
+            for key in self._data.keys():
+                if new_cell.matches(key):
+                    new_cell.keys.add(key)
+            grid.cells[index] = new_cell
+            cells = list(grid.spec.cells)
+            cells[index] = new_spec
+            grid.spec = replace(grid.spec, cells=tuple(cells))
+        self._sync_history_demand(new_cell)
+        self._persist(grid)
+        self.clock.commit(grid_id)
+        return new_cell
+
+    def _sync_history_demand(self, cell: PlotCell) -> None:
+        """Upgrade the buffers of a history-wanting cell's keys.
+
+        The render pull path carries no subscription, so demand is
+        announced here — at every point a cell gains keys or its config
+        changes. Idempotent; never downgrades (another consumer may still
+        want the history).
+        """
+        if not cell.wants_history:
+            return
+        with self._lock:
+            keys = set(cell.keys)
+        for key in keys:
+            self._data.require_history(key)
+
+    # -- data binding --------------------------------------------------------
+    def _on_data(self, keys: set[ResultKey]) -> None:
+        """Ingestion-side: match new keys to cells, commit touched grids."""
+        touched: set[str] = set()
+        bound: list[PlotCell] = []
+        with self._lock:
+            for grid in self._grids.values():
+                for cell in grid.cells:
+                    for key in keys:
+                        if key in cell.keys or cell.matches(key):
+                            if key not in cell.keys:
+                                cell.keys.add(key)
+                                bound.append(cell)
+                            touched.add(grid.grid_id)
+        for cell in bound:
+            self._sync_history_demand(cell)
+        for grid_id in touched:
+            self.clock.commit(grid_id)
+
+    # -- views ---------------------------------------------------------------
+    def grids(self) -> list[PlotGrid]:
+        with self._lock:
+            return list(self._grids.values())
+
+    def grid(self, grid_id: str) -> PlotGrid | None:
+        with self._lock:
+            return self._grids.get(grid_id)
+
+    def snapshot(self) -> list[dict]:
+        """Plain-data view for other threads (HTTP handlers): cells' live
+        key sets are copied under the lock — the ingestion thread mutates
+        them concurrently and iterating them unlocked races."""
+        with self._lock:
+            return [
+                {
+                    "grid_id": grid.grid_id,
+                    "title": grid.spec.title,
+                    "nrows": grid.spec.nrows,
+                    "ncols": grid.spec.ncols,
+                    "generation": self.clock.grid_generation(grid.grid_id),
+                    "cells": [
+                        {
+                            "index": i,
+                            "geometry": {
+                                "row": c.spec.geometry.row,
+                                "col": c.spec.geometry.col,
+                                "row_span": c.spec.geometry.row_span,
+                                "col_span": c.spec.geometry.col_span,
+                            },
+                            "title": c.spec.title,
+                            "workflow": c.spec.workflow,
+                            "output": c.spec.output,
+                            "source": c.spec.source,
+                            "plotter": c.spec.plotter,
+                            "params": c.spec.params_dict,
+                            "keys": sorted(
+                                c.keys, key=lambda k: k.to_string()
+                            ),
+                        }
+                        for i, c in enumerate(grid.cells)
+                    ],
+                }
+                for grid in self._grids.values()
+            ]
+
+
+def default_cell(workflow: str = "", output: str = "", source: str = "") -> GridCellSpec:
+    """Convenience for tests/UI: a 1x1 cell at the next free slot (0,0)."""
+    return GridCellSpec(
+        geometry=CellGeometry(row=0, col=0),
+        workflow=workflow,
+        output=output,
+        source=source,
+    )

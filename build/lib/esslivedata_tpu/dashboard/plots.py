@@ -1,0 +1,773 @@
+"""Plotter registry + matplotlib rendering.
+
+Parity with reference ``dashboard/plotting_controller.py`` /
+``plotter_registry.py`` / ``plots.py`` at the architecture level: plotters
+are auto-selected from the *shape* of a DataArray (reference selects from
+template DataArrays, workflow_spec.py:366-383) and turn buffer contents
+into rendered artifacts. The reference emits HoloViews objects for Bokeh;
+here plotters render matplotlib (Agg) to PNG bytes for the web front end.
+"""
+
+from __future__ import annotations
+
+import io
+from dataclasses import dataclass
+import logging
+import threading
+from typing import Callable, ClassVar
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+
+from ..utils.labeled import DataArray
+
+__all__ = [
+    "BarsPlotter",
+    "FlattenPlotter",
+    "PlotterRegistry",
+    "SlicerPlotter",
+    "TablePlotter",
+    "PlotParams",
+    "plotter_registry",
+    "render_correlation_png",
+    "render_layers_png",
+    "render_png",
+    "render_png_with_meta",
+]
+
+logger = logging.getLogger(__name__)
+
+
+#: Extractor selections the cell config may name (reference exposes the
+#: same choice in its plot config modal as "data source" per plot).
+EXTRACTOR_CHOICES = ("latest", "full_history", "window_sum", "window_mean")
+
+#: Plotter forcing: '' = auto-select from shape.
+PLOTTER_CHOICES = ("", "table", "slicer", "flatten")
+
+
+@dataclass(frozen=True)
+class PlotParams:
+    """Per-cell plot configuration (the plot-config surface; reference
+    plot_config_modal.py exposes the same set per plotter).
+
+    Presentation: ``scale`` applies to the y axis for 1-D plotters and to
+    the color normalization for 2-D ones; ``vmin``/``vmax`` bound the
+    same axis; ``cmap`` names the colormap.
+
+    Data selection: ``extractor`` picks how the temporal buffer turns
+    into the plotted value (latest frame, full history series, or a
+    trailing ``window_s``-second sum/mean); ``plotter`` forces table or
+    slicer rendering (``slice`` = leading-dim index); ``overlay`` draws
+    every key of a multi-output cell into one axes (1-D data).
+    """
+
+    scale: str = "linear"  # 'linear' | 'log'
+    cmap: str = "viridis"
+    vmin: float | None = None
+    vmax: float | None = None
+    extractor: str = "latest"
+    window_s: float | None = None
+    plotter: str = ""  # '' (auto) | 'table' | 'slicer' | 'flatten'
+    slice: int | None = None
+    overlay: bool = False
+    robust: bool = False  # percentile color scaling (hot-pixel clip)
+    flatten_split: int = 1  # leading dims -> Y for the flatten plotter
+    #: Static marker overlays (reference static_plots.py): draw a
+    #: vertical/horizontal reference line at this data coordinate —
+    #: an elastic line, a threshold, a Bragg position.
+    vline: float | None = None
+    hline: float | None = None
+    #: Poisson error bars (sqrt N) on 1-D count spectra — the streaming
+    #: stand-in for scipp's carried variances: counts are Poisson, so
+    #: the statistical uncertainty is derivable at render time.
+    errorbars: bool = False
+    #: Explicit x-axis data range (1-D plotters): zoom to a TOA window,
+    #: a Q range, a d-spacing region. None = full extent.
+    xmin: float | None = None
+    xmax: float | None = None
+
+    #: Every query-string key ``from_dict`` understands — THE list for
+    #: HTTP handlers to whitelist, so a new param cannot be silently
+    #: dropped at the endpoint (vline/hline/errorbars once were).
+    QUERY_KEYS: ClassVar[tuple[str, ...]] = (
+        "scale",
+        "cmap",
+        "vmin",
+        "vmax",
+        "extractor",
+        "window_s",
+        "plotter",
+        "slice",
+        "overlay",
+        "robust",
+        "errorbars",
+        "vline",
+        "hline",
+        "xmin",
+        "xmax",
+        "flatten_split",
+        "history",  # back-compat alias for extractor=full_history
+    )
+
+    @classmethod
+    def from_dict(cls, raw: dict | None) -> "PlotParams":
+        raw = raw or {}
+        scale = str(raw.get("scale", "linear"))
+        if scale not in ("linear", "log"):
+            raise ValueError(f"scale must be linear|log, got {scale!r}")
+        extractor = str(raw.get("extractor", "latest"))
+        # Back-compat: the pre-config-surface query flag.
+        if raw.get("history") in ("1", 1, True):
+            extractor = "full_history"
+        if extractor not in EXTRACTOR_CHOICES:
+            raise ValueError(
+                f"extractor must be one of {EXTRACTOR_CHOICES}, "
+                f"got {extractor!r}"
+            )
+        plotter = str(raw.get("plotter", ""))
+        if plotter not in PLOTTER_CHOICES:
+            raise ValueError(
+                f"plotter must be one of {PLOTTER_CHOICES}, got {plotter!r}"
+            )
+
+        def _f(key):
+            v = raw.get(key)
+            if v in (None, "", "null"):
+                return None
+            return float(v)
+
+        slice_raw = raw.get("slice")
+        overlay = raw.get("overlay") in (True, "1", 1, "true")
+        robust = raw.get("robust") in (True, "1", 1, "true")
+        errorbars = raw.get("errorbars") in (True, "1", 1, "true")
+        split_raw = raw.get("flatten_split")
+        params = cls(
+            scale=scale,
+            cmap=str(raw.get("cmap", "viridis")),
+            vmin=_f("vmin"),
+            vmax=_f("vmax"),
+            vline=_f("vline"),
+            hline=_f("hline"),
+            xmin=_f("xmin"),
+            xmax=_f("xmax"),
+            extractor=extractor,
+            window_s=_f("window_s"),
+            plotter=plotter,
+            slice=None if slice_raw in (None, "", "null") else int(slice_raw),
+            overlay=overlay,
+            robust=robust,
+            errorbars=errorbars,
+            flatten_split=1 if split_raw in (None, "", "null") else int(split_raw),
+        )
+        # Bounds that would blow up at render time are config errors:
+        # reject at validation so a bad edit 400s once instead of the
+        # cell 500ing on every refresh.
+        if (
+            params.vmin is not None
+            and params.vmax is not None
+            and params.vmin >= params.vmax
+        ):
+            raise ValueError("vmin must be < vmax")
+        if (
+            params.xmin is not None
+            and params.xmax is not None
+            and params.xmin >= params.xmax
+        ):
+            raise ValueError("xmin must be < xmax")
+        if scale == "log" and params.vmax is not None and params.vmax <= 0:
+            raise ValueError("log scale needs vmax > 0")
+        if params.extractor.startswith("window"):
+            if params.window_s is None or params.window_s <= 0:
+                raise ValueError(
+                    f"extractor {params.extractor!r} needs window_s > 0"
+                )
+        if params.slice is not None and params.slice < 0:
+            raise ValueError("slice must be >= 0")
+        if params.flatten_split < 1:
+            raise ValueError("flatten_split must be >= 1")
+        return params
+
+    def to_dict(self) -> dict:
+        """Normalized persistence form: defaults and unset bounds omitted,
+        so round-tripping through storage and query strings is lossless
+        (None must never serialize as the string 'null')."""
+        out: dict = {}
+        if self.scale != "linear":
+            out["scale"] = self.scale
+        if self.cmap != "viridis":
+            out["cmap"] = self.cmap
+        if self.vmin is not None:
+            out["vmin"] = self.vmin
+        if self.vmax is not None:
+            out["vmax"] = self.vmax
+        if self.extractor != "latest":
+            out["extractor"] = self.extractor
+        if self.window_s is not None:
+            out["window_s"] = self.window_s
+        if self.plotter:
+            out["plotter"] = self.plotter
+        if self.slice is not None:
+            out["slice"] = self.slice
+        if self.overlay:
+            out["overlay"] = "1"
+        if self.vline is not None:
+            out["vline"] = self.vline
+        if self.hline is not None:
+            out["hline"] = self.hline
+        if self.xmin is not None:
+            out["xmin"] = self.xmin
+        if self.xmax is not None:
+            out["xmax"] = self.xmax
+        if self.robust:
+            out["robust"] = "1"
+        if self.errorbars:
+            out["errorbars"] = "1"
+        if self.flatten_split != 1:
+            out["flatten_split"] = self.flatten_split
+        return out
+
+    def make_extractor(self):
+        """The configured extractor instance (None = latest value)."""
+        from .extractors import (
+            FullHistoryExtractor,
+            WindowAggregatingExtractor,
+        )
+
+        if self.extractor == "full_history":
+            return FullHistoryExtractor()
+        if self.extractor == "window_sum":
+            return WindowAggregatingExtractor(self.window_s, "sum")
+        if self.extractor == "window_mean":
+            return WindowAggregatingExtractor(self.window_s, "mean")
+        return None
+
+    def _norm(self, data: "np.ndarray | None" = None):
+        """Matplotlib color norm for 2-D plotters.
+
+        With ``robust`` and no explicit bounds, the color range clips to
+        the data's [1, 99.5] percentiles so a few hot pixels cannot wash
+        out the whole image (the stateless-render analog of the
+        reference's autoscale toggles).
+        """
+        from matplotlib.colors import LogNorm, Normalize
+
+        vmin, vmax = self.vmin, self.vmax
+        if (
+            self.robust
+            and data is not None
+            and data.size
+            and (vmin is None or vmax is None)
+        ):
+            # Fill only the MISSING bounds: vmin=0 + robust is the natural
+            # count-data config and must still clip the hot-pixel vmax.
+            finite = data[np.isfinite(data)]
+            if finite.size:
+                lo = float(np.percentile(finite, 1.0))
+                hi = float(np.percentile(finite, 99.5))
+                if lo < hi:
+                    if vmin is None and (vmax is None or lo < vmax):
+                        vmin = lo
+                    if vmax is None and (vmin is None or hi > vmin):
+                        vmax = hi
+        if self.scale == "log":
+            # LogNorm cannot take bounds <= 0; clamp to a positive floor
+            # (vmax <= 0 is rejected at validation).
+            vmin = vmin if vmin and vmin > 0 else None
+            vmax = vmax if vmax and vmax > 0 else None
+            return LogNorm(vmin=vmin, vmax=vmax)
+        return Normalize(vmin=vmin, vmax=vmax)
+
+    def _apply_y(self, ax) -> None:
+        if self.scale == "log":
+            ax.set_yscale("log")
+        if self.vmin is not None or self.vmax is not None:
+            ax.set_ylim(bottom=self.vmin, top=self.vmax)
+        if self.xmin is not None or self.xmax is not None:
+            ax.set_xlim(left=self.xmin, right=self.xmax)
+
+    def _apply_markers(self, ax) -> None:
+        """Static reference-line overlays, drawn over ANY plotter."""
+        if self.vline is not None:
+            ax.axvline(self.vline, color="#d32f2f", lw=1.0, ls="--")
+        if self.hline is not None:
+            ax.axhline(self.hline, color="#d32f2f", lw=1.0, ls="--")
+
+# matplotlib's pyplot state is not thread-safe; the dashboard renders from
+# request handlers + ingestion threads.
+_render_lock = threading.Lock()
+
+
+def _coord_values(da: DataArray, dim: str) -> tuple[np.ndarray, str]:
+    if dim in da.coords:
+        coord = da.coords[dim]
+        vals = coord.numpy
+        if da.is_edges(dim, dim):
+            return vals, f"{dim} [{coord.unit!r}]"
+        return vals, f"{dim} [{coord.unit!r}]"
+    n = da.sizes[dim]
+    return np.arange(n + 1, dtype=float), dim
+
+
+def _draw_1d(ax, x: np.ndarray, y: np.ndarray, label: str | None = None):
+    """One 1-D series: histogram steps for edge coords, line otherwise.
+    The single place the edges-vs-points decision lives."""
+    if x.size == y.size + 1:
+        return ax.stairs(y, x, label=label)
+    return ax.plot(x[: y.size], y, label=label)
+
+
+class LinePlotter:
+    """1-D data: histogram steps (edge coords) or line (point coords)."""
+
+    def plot(self, ax, da: DataArray, params: PlotParams = PlotParams()) -> None:
+        dim = da.dims[0]
+        x, label = _coord_values(da, dim)
+        y = np.asarray(da.values, dtype=np.float64)
+        _draw_1d(ax, x, y)
+        if params.errorbars and str(da.unit) == "counts":
+            # Poisson: sigma = sqrt(N), drawn at bin centers.
+            centers = (x[:-1] + x[1:]) / 2.0 if x.size == y.size + 1 else x[: y.size]
+            ax.errorbar(
+                centers,
+                y,
+                yerr=np.sqrt(np.maximum(y, 0.0)),
+                fmt="none",
+                ecolor="#00000055",
+                elinewidth=0.8,
+            )
+        params._apply_y(ax)
+        ax.set_xlabel(label)
+        ax.set_ylabel(f"[{da.unit!r}]")
+
+
+#: Above this side length a pcolormesh dominates render time; images are
+#: block-reduced (sum-preserving) to at most this many rows/cols first.
+_DOWNSAMPLE_MAX_SIDE = 512
+
+
+def _downsample_2d(
+    values: np.ndarray, x: np.ndarray, y: np.ndarray
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Sum-preserving block reduction of an oversized image.
+
+    Count data stays count data: blocks SUM (a 4x4 block of counts is
+    their total, not their mean), and the edge arrays keep every
+    block-boundary coordinate so the rendered axes remain exact.
+    """
+    out = values
+    ex, ey = x, y
+    for axis, n in ((0, values.shape[0]), (1, values.shape[1])):
+        if n <= _DOWNSAMPLE_MAX_SIDE:
+            continue
+        factor = -(-n // _DOWNSAMPLE_MAX_SIDE)  # ceil
+        pad = (-n) % factor
+        padded = np.pad(
+            out,
+            [(0, pad) if a == axis else (0, 0) for a in range(2)],
+        )
+        shape = list(padded.shape)
+        shape[axis : axis + 1] = [padded.shape[axis] // factor, factor]
+        out = padded.reshape(shape).sum(axis=axis + 1)
+        edges = ey if axis == 0 else ex
+        if edges.size == n + 1:
+            reduced = edges[::factor]
+            if reduced[-1] != edges[-1]:
+                reduced = np.concatenate([reduced, edges[-1:]])
+        else:  # point coords: take block starts
+            reduced = edges[::factor]
+        if axis == 0:
+            ey = reduced
+        else:
+            ex = reduced
+    return out, ex, ey
+
+
+def _draw_mesh(ax, x, y, values, params, unit) -> None:
+    """The single 2-D draw: downsample guard, edge synthesis for point
+    coords, pcolormesh with the params norm, colorbar. Every image-like
+    plotter delegates here so norm/downsample changes happen once."""
+    if (
+        values.shape[0] > _DOWNSAMPLE_MAX_SIDE
+        or values.shape[1] > _DOWNSAMPLE_MAX_SIDE
+    ):
+        values, x, y = _downsample_2d(values, x, y)
+    if x.size == values.shape[1]:
+        x = np.concatenate([x, [x[-1] + (x[-1] - x[-2] if x.size > 1 else 1)]])
+    if y.size == values.shape[0]:
+        y = np.concatenate([y, [y[-1] + (y[-1] - y[-2] if y.size > 1 else 1)]])
+    mesh = ax.pcolormesh(
+        x, y, values, shading="flat", cmap=params.cmap,
+        norm=params._norm(values),
+    )
+    ax.figure.colorbar(mesh, ax=ax, label=f"[{unit!r}]")
+
+
+class ImagePlotter:
+    """2-D data as pcolormesh with edge-aware axes.
+
+    Oversized images (LOKI-scale banks reach millions of cells, far
+    beyond the PNG's pixel budget) are block-summed server-side before
+    rendering — the reference downsamples in its plotting layer for the
+    same reason.
+    """
+
+    def plot(self, ax, da: DataArray, params: PlotParams = PlotParams()) -> None:
+        ydim, xdim = da.dims
+        x, xlabel = _coord_values(da, xdim)
+        y, ylabel = _coord_values(da, ydim)
+        values = np.asarray(da.values, dtype=np.float64)
+        _draw_mesh(ax, x, y, values, params, da.unit)
+        ax.set_xlabel(xlabel)
+        ax.set_ylabel(ylabel)
+
+
+class FlattenPlotter:
+    """N-D data flattened to one image: leading dims collapse onto Y,
+    trailing dims onto X, split at ``split`` (reference flatten_plotter
+    partitions dims into two groups the same way; axes here are flat
+    indices, decomposable because the split is config-time static)."""
+
+    def __init__(self, split: int = 1) -> None:
+        self._split = split
+
+    def plot(self, ax, da: DataArray, params: PlotParams = PlotParams()) -> None:
+        values = np.asarray(da.values, dtype=np.float64)
+        k = min(max(self._split, 1), values.ndim - 1)
+        ny = int(np.prod(values.shape[:k]))
+        nx = int(np.prod(values.shape[k:]))
+        flat = values.reshape(ny, nx)
+        x = np.arange(nx + 1, dtype=float)
+        y = np.arange(ny + 1, dtype=float)
+        _draw_mesh(ax, x, y, flat, params, da.unit)
+        ax.set_xlabel(" × ".join(da.dims[k:]))
+        ax.set_ylabel(" × ".join(da.dims[:k]))
+
+
+class Overlay1DPlotter:
+    """2-D data where the leading dim is categorical (e.g. roi): one line
+    per category (reference Overlay1DPlotter:1343)."""
+
+    def plot(self, ax, da: DataArray, params: PlotParams = PlotParams()) -> None:
+        cat_dim, dim = da.dims
+        x, label = _coord_values(da, dim)
+        values = np.asarray(da.values, dtype=np.float64)
+        for i in range(values.shape[0]):
+            _draw_1d(ax, x, values[i], label=f"{cat_dim} {i}")
+        params._apply_y(ax)
+        ax.legend(loc="upper right", fontsize="small")
+        ax.set_xlabel(label)
+        ax.set_ylabel(f"[{da.unit!r}]")
+
+
+class BarsPlotter:
+    """1-D data over a categorical axis (bank/roi/channel): bars, one per
+    category (reference BarsPlotter:1263) — a step line over category
+    indices reads as a spectrum, which these are not."""
+
+    def plot(self, ax, da: DataArray, params: PlotParams = PlotParams()) -> None:
+        dim = da.dims[0]
+        y = np.asarray(da.values, dtype=np.float64)
+        x = np.arange(y.size)
+        ax.bar(x, y)
+        ax.set_xticks(x)
+        if dim in da.coords:
+            labels = np.asarray(da.coords[dim].numpy).reshape(-1)
+            ax.set_xticklabels(
+                [str(v) for v in labels[: y.size]], fontsize=7
+            )
+        params._apply_y(ax)
+        ax.set_xlabel(dim)
+        ax.set_ylabel(f"[{da.unit!r}]")
+
+
+class ScalarPlotter:
+    """0-d data: big number."""
+
+    def plot(self, ax, da: DataArray, params: PlotParams = PlotParams()) -> None:
+        ax.axis("off")
+        ax.text(
+            0.5,
+            0.5,
+            f"{float(np.asarray(da.values)):.6g}\n[{da.unit!r}]",
+            ha="center",
+            va="center",
+            fontsize=22,
+            transform=ax.transAxes,
+        )
+
+
+class SlicerPlotter:
+    """3-D data: mid-slice along the leading dim plus its index in the
+    title (reference slicer_plotter.py renders a slice with a dim slider;
+    the HTTP front end picks the slice via the ``slice`` query param)."""
+
+    def __init__(self, index: int | None = None) -> None:
+        self._index = index
+
+    def plot(self, ax, da: DataArray, params: PlotParams = PlotParams()) -> None:
+        lead = da.dims[0]
+        n = da.sizes[lead]
+        i = min(self._index if self._index is not None else n // 2, n - 1)
+        values = np.asarray(da.values, dtype=np.float64)[i]
+        ydim, xdim = da.dims[1], da.dims[2]
+        x, xlabel = _coord_values(da, xdim)
+        y, ylabel = _coord_values(da, ydim)
+        _draw_mesh(ax, x, y, values, params, da.unit)
+        ax.set_xlabel(xlabel)
+        ax.set_ylabel(ylabel)
+        ax.set_title(f"{lead}={i}/{n}", fontsize=8)
+
+
+class TablePlotter:
+    """Small 1-D data as a name/value table (reference table_plotter.py)."""
+
+    MAX_ROWS = 16
+
+    def plot(self, ax, da: DataArray, params: PlotParams = PlotParams()) -> None:
+        ax.axis("off")
+        values = np.atleast_1d(np.asarray(da.values))
+        dim = da.dims[0] if da.dims else ""
+        labels = (
+            np.asarray(da.coords[dim].values)
+            if dim in da.coords
+            and da.coords[dim].values.size == values.size
+            else np.arange(values.size)
+        )
+        rows = [
+            [str(label), f"{value:.6g}"]
+            for label, value in zip(
+                labels[: self.MAX_ROWS], values[: self.MAX_ROWS], strict=False
+            )
+        ]
+        table = ax.table(
+            cellText=rows,
+            colLabels=[dim or "index", f"value [{da.unit!r}]"],
+            loc="center",
+        )
+        table.auto_set_font_size(False)
+        table.set_fontsize(8)
+
+
+def render_layers_png(
+    layers: list[DataArray],
+    *,
+    title: str = "",
+    figsize=(5.0, 3.6),
+    dpi: int = 100,
+    params: PlotParams | None = None,
+) -> bytes:
+    """Overlay several 1-D DataArrays as labeled lines in one axes (the
+    cell 'overlay' config; reference layers multiple outputs per plot).
+    Non-1-D layers are skipped — mixing an image into a line overlay is
+    a config mistake, not a render crash."""
+    params = params or PlotParams()
+    with _render_lock:
+        fig, ax = plt.subplots(figsize=figsize, dpi=dpi)
+        try:
+            drawn = 0
+            for da in layers:
+                if np.asarray(da.values).ndim != 1:
+                    continue
+                dim = da.dims[0]
+                x, label = _coord_values(da, dim)
+                y = np.asarray(da.values, dtype=np.float64)
+                _draw_1d(ax, x, y, label=da.name or f"layer {drawn}")
+                if drawn == 0:
+                    ax.set_xlabel(label)
+                drawn += 1
+            if drawn:
+                ax.legend(fontsize=7)
+            params._apply_y(ax)
+            if title:
+                fig.suptitle(title, fontsize=9)
+            fig.tight_layout()
+            buf = io.BytesIO()
+            fig.savefig(buf, format="png")
+            return buf.getvalue()
+        finally:
+            plt.close(fig)
+
+
+def render_correlation_png(
+    x_series: DataArray,
+    y_series: DataArray,
+    *,
+    title: str = "",
+    figsize=(5.0, 3.6),
+    dpi: int = 100,
+) -> bytes:
+    """Timeseries-vs-timeseries correlation (reference correlation_plotter):
+    the two series are aligned on the finer time axis by nearest-older
+    sample, then scattered against each other."""
+    tx = np.asarray(x_series.coords["time"].values, dtype=np.int64)
+    ty = np.asarray(y_series.coords["time"].values, dtype=np.int64)
+    vx = np.atleast_1d(np.asarray(x_series.values, dtype=np.float64))
+    vy = np.atleast_1d(np.asarray(y_series.values, dtype=np.float64))
+    if tx.size == 0 or ty.size == 0:
+        raise ValueError("correlation needs non-empty series")
+    # Align y onto x's timestamps: last y sample at-or-before each x time;
+    # x samples older than every y sample have no partner and are dropped
+    # (pairing them with a future y would fabricate correlation).
+    idx = np.searchsorted(ty, tx, side="right") - 1
+    has_partner = idx >= 0
+    vx = vx[has_partner]
+    aligned_y = vy[idx[has_partner]]
+    with _render_lock:
+        fig, ax = plt.subplots(figsize=figsize, dpi=dpi)
+        try:
+            ax.scatter(vx, aligned_y, s=12, alpha=0.7)
+            ax.set_xlabel(f"{x_series.name} [{x_series.unit!r}]")
+            ax.set_ylabel(f"{y_series.name} [{y_series.unit!r}]")
+            if title:
+                ax.set_title(title, fontsize=9)
+            fig.tight_layout()
+            buf = io.BytesIO()
+            fig.savefig(buf, format="png")
+            return buf.getvalue()
+        finally:
+            plt.close(fig)
+
+
+class PlotterRegistry:
+    """Shape -> plotter selection, extensible (reference PlotterSpec:84)."""
+
+    CATEGORICAL_DIMS = {"roi", "channel", "bank"}
+
+    def __init__(self) -> None:
+        self._custom: list[tuple[Callable[[DataArray], bool], object]] = []
+
+    def register(self, predicate: Callable[[DataArray], bool], plotter) -> None:
+        self._custom.append((predicate, plotter))
+
+    def select(self, da: DataArray):
+        for predicate, plotter in self._custom:
+            try:
+                if predicate(da):
+                    return plotter
+            except Exception:
+                continue
+        ndim = da.data.ndim
+        if ndim == 0:
+            return ScalarPlotter()
+        if ndim == 1:
+            # Categorical axes (per-bank counts, per-roi totals) read as
+            # bars, not as a spectrum line.
+            if da.dims[0] in self.CATEGORICAL_DIMS and da.shape[0] <= 32:
+                return BarsPlotter()
+            return LinePlotter()
+        if ndim == 2:
+            if da.dims[0] in self.CATEGORICAL_DIMS or (
+                da.shape[0] <= 8 and da.shape[1] >= 4 * da.shape[0]
+            ):
+                return Overlay1DPlotter()
+            return ImagePlotter()
+        if ndim == 3:
+            return SlicerPlotter()
+        raise ValueError(f"No plotter for {ndim}-d data")
+
+
+plotter_registry = PlotterRegistry()
+
+
+def render_png(
+    da: DataArray,
+    *,
+    title: str = "",
+    figsize=(5.0, 3.6),
+    dpi: int = 100,
+    plotter=None,
+    params: PlotParams | None = None,
+) -> bytes:
+    """Render one DataArray to PNG using ``plotter`` or the auto-selection.
+
+    The caller's title goes on the figure (suptitle) so plotters that use
+    the axes title themselves (SlicerPlotter's slice indicator) keep it.
+    """
+    return render_png_with_meta(
+        da,
+        title=title,
+        figsize=figsize,
+        dpi=dpi,
+        plotter=plotter,
+        params=params,
+    )[0]
+
+
+def render_png_with_meta(
+    da: DataArray,
+    *,
+    title: str = "",
+    figsize=(5.0, 3.6),
+    dpi: int = 100,
+    plotter=None,
+    params: PlotParams | None = None,
+) -> tuple[bytes, dict]:
+    """``render_png`` plus the pixel->data mapping the ROI overlay needs.
+
+    The meta dict locates the axes inside the PNG (``axes_px``, top-left
+    pixel origin) and its data limits (``xlim``/``ylim``), letting the
+    client translate a mouse drag on the image into detector coordinates:
+
+        data_x = xlim[0] + (px - x0) / (x1 - x0) * (xlim[1] - xlim[0])
+        data_y = ylim[0] + (y1 - py) / (y1 - y0) * (ylim[1] - ylim[0])
+
+    (y flips: PNG rows grow downward, axes values grow upward.)
+    """
+    with _render_lock:
+        fig, ax = plt.subplots(figsize=figsize, dpi=dpi)
+        try:
+            plotter = plotter or plotter_registry.select(da)
+            effective = params or PlotParams()
+            plotter.plot(ax, da, effective)
+            effective._apply_markers(ax)
+            if title:
+                fig.suptitle(title, fontsize=9)
+            fig.tight_layout()
+            buf = io.BytesIO()
+            fig.savefig(buf, format="png")
+            # Window extents are only valid after a draw; savefig drew.
+            width_px = int(round(fig.get_figwidth() * fig.dpi))
+            height_px = int(round(fig.get_figheight() * fig.dpi))
+            bbox = ax.get_window_extent()
+            meta = {
+                "width": width_px,
+                "height": height_px,
+                "axes_px": {
+                    "x0": bbox.x0,
+                    "y0": height_px - bbox.y1,  # flip to top-left origin
+                    "x1": bbox.x1,
+                    "y1": height_px - bbox.y0,
+                },
+                "xlim": list(ax.get_xlim()),
+                "ylim": list(ax.get_ylim()),
+            }
+            # The rendered color range: what a "freeze scale" control
+            # writes into the cell's vmin/vmax (reference
+            # cell_autoscale.py holds ranges the same way). Images render
+            # as pcolormesh (a collection) or imshow depending on size.
+            mappable = next(
+                (
+                    m
+                    for m in (*ax.images, *ax.collections)
+                    if hasattr(m, "get_clim")
+                    and m.get_clim() != (None, None)
+                ),
+                None,
+            )
+            if mappable is not None:
+                lo, hi = mappable.get_clim()
+                if lo is not None and hi is not None:
+                    meta["clim"] = [float(lo), float(hi)]
+            # Scalar/table axes carry no value ranges: their 0..1
+            # axes-fraction ylim must never be frozen into cell params.
+            meta["freezable"] = type(plotter).__name__ not in (
+                "ScalarPlotter",
+                "TablePlotter",
+            )
+            return buf.getvalue(), meta
+        finally:
+            plt.close(fig)

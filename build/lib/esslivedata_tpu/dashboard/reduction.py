@@ -1,0 +1,111 @@
+"""Dashboard entry point (reference: dashboard/reduction.py ReductionApp:70).
+
+``--transport fake`` hosts the real backend services in-process over
+synthetic streams (full demo, zero infrastructure); ``--transport kafka``
+connects to a live broker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..config.instrument import instrument_registry
+from ..core.service import get_env_defaults, setup_arg_parser
+from .dashboard_services import DashboardServices
+from .web import make_app
+
+__all__ = ["main"]
+
+logger = logging.getLogger(__name__)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = setup_arg_parser("esslivedata-tpu dashboard")
+    parser.add_argument("--port", type=int, default=5007)
+    parser.add_argument(
+        "--transport", choices=["fake", "kafka", "file"], default="fake"
+    )
+    parser.add_argument("--kafka-bootstrap", default=None, help="override the broker from the kafka config namespace")
+    parser.add_argument(
+        "--broker-dir",
+        default=None,
+        help="file-backed broker root (required with --transport file)",
+    )
+    parser.add_argument("--events-per-pulse", type=int, default=2000)
+    parser.add_argument(
+        "--config-dir",
+        default="",
+        help="Directory for persisted UI state (grid layouts); "
+        "default: in-memory only",
+    )
+    parser.set_defaults(**get_env_defaults(parser))
+    args = parser.parse_args(argv)
+    from ..logging_config import configure_logging
+
+    configure_logging(
+        level=args.log_level, json_file=getattr(args, "log_json_file", None)
+    )
+
+    if args.instrument not in instrument_registry:
+        parser.error(
+            f"Unknown instrument {args.instrument!r}; "
+            f"known: {', '.join(instrument_registry.names())}"
+        )
+    instrument_registry[args.instrument].load_factories()
+
+    if args.transport == "fake":
+        from .fake_backend import InProcessBackendTransport
+
+        transport = InProcessBackendTransport(
+            args.instrument, events_per_pulse=args.events_per_pulse
+        )
+    elif args.transport == "file":
+        if not args.broker_dir:
+            parser.error("--transport file requires --broker-dir")
+        from .kafka_transport import DashboardFileBrokerTransport
+
+        transport = DashboardFileBrokerTransport(
+            instrument=args.instrument,
+            broker_dir=args.broker_dir,
+            dev=args.dev,
+        )
+    else:
+        from .kafka_transport import DashboardKafkaTransport
+
+        transport = DashboardKafkaTransport(
+            instrument=args.instrument,
+            bootstrap=args.kafka_bootstrap,
+            dev=args.dev,
+        )
+
+    store = None
+    if args.config_dir:
+        from .config_store import FileConfigStore
+
+        store = FileConfigStore(args.config_dir)
+    services = DashboardServices(
+        transport=transport,
+        config_store=store,
+        instrument=args.instrument,
+    )
+    app = make_app(services, args.instrument)
+
+    async def serve() -> None:
+        services.start()
+        app.listen(args.port)
+        logger.info("Dashboard listening on http://localhost:%d", args.port)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            services.stop()
+
+    try:
+        asyncio.run(serve())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

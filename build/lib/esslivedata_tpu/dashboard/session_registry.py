@@ -1,0 +1,120 @@
+"""Multi-client session tracking + config-change fan-out.
+
+Parity with reference ``dashboard/session_registry.py`` /
+``session_updater.py`` at the architecture level: every browser client is
+a *session* with its own notification cursor; configuration mutations
+(grids, cells, plot params) bump a global *config generation*, and each
+session discovers on its next poll that its view of the configuration is
+stale and re-renders. Data freshness is separate (the FrameClock per-grid
+generations); this registry covers the *configuration* plane, so two
+operators editing the layout converge without refreshes stepping on each
+other.
+
+Sessions are expired after an idle timeout; an expired session that polls
+again is simply re-registered (its cursor restarts at the current head, so
+it sees only new notifications — same as a fresh browser tab).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+__all__ = ["Session", "SessionRegistry"]
+
+SESSION_IDLE_S = 60.0
+
+
+@dataclass
+class Session:
+    session_id: str
+    notification_cursor: int = 0
+    config_generation_seen: int = 0
+    last_seen_wall: float = field(default_factory=time.monotonic)
+
+    @property
+    def is_idle(self) -> bool:
+        return time.monotonic() - self.last_seen_wall > SESSION_IDLE_S
+
+
+class SessionRegistry:
+    def __init__(self) -> None:
+        self._sessions: dict[str, Session] = {}
+        self._config_generation = 0
+        self._lock = threading.Lock()
+
+    # -- config plane ------------------------------------------------------
+    @property
+    def config_generation(self) -> int:
+        with self._lock:
+            return self._config_generation
+
+    def bump_config(self) -> int:
+        """Record a configuration mutation; every session's next poll sees
+        ``config_changed`` until it acknowledges the new generation."""
+        with self._lock:
+            self._config_generation += 1
+            return self._config_generation
+
+    # -- session lifecycle -------------------------------------------------
+    def _touch(
+        self, session_id: str | None, notification_cursor: int = 0
+    ) -> Session:
+        """Sweep idle sessions, then fetch-or-register + refresh one.
+        Caller holds the lock. A fresh session starts with
+        ``config_generation_seen=-1`` so its first poll always reports the
+        configuration as changed (it has rendered nothing yet)."""
+        self._sessions = {
+            sid: s for sid, s in self._sessions.items() if not s.is_idle
+        }
+        if session_id is None or session_id not in self._sessions:
+            session = Session(
+                session_id=session_id or uuid.uuid4().hex,
+                config_generation_seen=-1,
+                notification_cursor=notification_cursor,
+            )
+            self._sessions[session.session_id] = session
+        else:
+            session = self._sessions[session_id]
+        session.last_seen_wall = time.monotonic()
+        return session
+
+    def ensure(self, session_id: str | None = None) -> Session:
+        """Register (or refresh) a session; expired sessions are dropped."""
+        with self._lock:
+            return self._touch(session_id)
+
+    def poll(
+        self, session_id: str | None, notifications
+    ) -> dict:
+        """One client poll: registers/refreshes the session, drains its
+        notification backlog, and reports whether configuration changed
+        since the session last acknowledged it."""
+        with self._lock:
+            session = self._touch(
+                session_id, notification_cursor=notifications.latest_seq
+            )
+            fresh = notifications.since(session.notification_cursor)
+            if fresh:
+                session.notification_cursor = fresh[-1].seq
+            changed = session.config_generation_seen != self._config_generation
+            session.config_generation_seen = self._config_generation
+            return {
+                "session_id": session.session_id,
+                "config_generation": self._config_generation,
+                "config_changed": changed,
+                "notifications": [
+                    {
+                        "seq": n.seq,
+                        "level": n.level,
+                        "message": n.message,
+                    }
+                    for n in fresh
+                ],
+            }
+
+    def sessions(self) -> list[Session]:
+        with self._lock:
+            return [s for s in self._sessions.values() if not s.is_idle]

@@ -1,0 +1,990 @@
+let gen = -1, tab = 'grids', gridGens = {}, sessionId = null;
+// All strings that originate outside this page (stream/device/source names
+// decoded from Kafka, user-editable titles) go through textContent — never
+// interpolated into innerHTML — so a crafted source_name cannot inject
+// markup into the operator's browser.
+function el(tag, cls, text) {
+  const n = document.createElement(tag);
+  if (cls) n.className = cls;
+  if (text !== undefined) n.textContent = text;
+  return n;
+}
+function setTab(t) {
+  tab = t; gen = -1; gridGens = {};
+  for (const name of ['grids', 'flat', 'jobsview', 'corr', 'log']) {
+    document.getElementById(name).style.display = t === name ? '' : 'none';
+    document.getElementById('tab-' + name).className = t === name ? 'on' : '';
+  }
+  refresh();
+}
+function refreshCorrChoices(s) {
+  // Timeseries outputs are the correlatable series (NXlog history).
+  const series = s.keys.filter(k => k.workflow.includes('/timeseries/'));
+  const fp = JSON.stringify(series.map(k => k.id));
+  for (const id of ['corr-x', 'corr-y']) {
+    const sel = document.getElementById(id);
+    // Rebuild only when the series set changes: a rebuild on every poll
+    // tick would close the dropdown under the operator's cursor.
+    if (sel.dataset.fp === fp) continue;
+    sel.dataset.fp = fp;
+    const current = sel.value;
+    sel.innerHTML = '';
+    for (const k of series) {
+      const opt = document.createElement('option');
+      opt.value = k.id; opt.textContent = k.source + ' · ' + k.output;
+      sel.appendChild(opt);
+    }
+    sel.value = current;
+    // Previous selection gone (job restarted -> new key id): fall back
+    // to the first option instead of a silently blank select.
+    if (sel.selectedIndex < 0 && series.length) sel.selectedIndex = 0;
+  }
+}
+function drawCorrelation() {
+  const x = document.getElementById('corr-x').value;
+  const y = document.getElementById('corr-y').value;
+  if (!x || !y) return;
+  const img = document.getElementById('corr-img');
+  img.onerror = () => {
+    img.style.display = 'none';
+    const d = el('div', 'toast error',
+      'Correlation render failed — series gone or not alignable');
+    document.getElementById('toasts').appendChild(d);
+    setTimeout(() => d.remove(), 6000);
+  };
+  img.style.display = '';
+  img.src = `/plot/correlation.png?x=${x}&y=${y}&t=${Date.now()}`;
+}
+// Multi-grid session management (reference plot_grid_manager /
+// plot_grid_tabs): a tab strip selects the visible grid; grids can be
+// created, renamed and deleted from the UI; cells can be added to a
+// grid from the live output list.
+let activeGrid = 'all';
+// Latest grid documents by id: header-button closures capture only the
+// ID and look the CURRENT document up here, so rename/add-cell never
+// act on a stale snapshot from the poll that built the header.
+let gridById = {};
+const gurl = (gid) => '/api/grid/' + encodeURIComponent(gid);
+function renderGridTabs(grids) {
+  let strip = document.getElementById('gridtabs');
+  const root = document.getElementById('grids');
+  if (!strip) {
+    strip = el('div'); strip.id = 'gridtabs';
+    strip.style.margin = '4px 0';
+    root.parentElement.insertBefore(strip, root);
+  }
+  const fp = JSON.stringify([grids.map(g => [g.grid_id, g.title]), activeGrid]);
+  if (strip.dataset.fp === fp) return;
+  strip.dataset.fp = fp;
+  strip.innerHTML = '';
+  const tab = (label, id) => {
+    const b = el('button', activeGrid === id ? 'on' : '', label);
+    b.onclick = () => { activeGrid = id; gridGens = {}; refreshGrids(); };
+    strip.appendChild(b);
+  };
+  tab('All', 'all');
+  for (const g of grids) tab(g.title || g.grid_id, g.grid_id);
+  const add = el('button', '', '+ grid');
+  add.title = 'Create a new empty grid';
+  add.onclick = async () => {
+    const name = prompt('Grid name:');
+    if (!name) return;
+    const r = await fetch('/api/grid', {method: 'POST', body: JSON.stringify(
+      {name: name, title: name, nrows: 2, ncols: 2})});
+    if (r.ok) { activeGrid = (await r.json()).grid_id; }
+    else { alert('Grid not created: ' + ((await r.json()).error || r.status)); }
+    gridGens = {}; refreshGrids();
+  };
+  strip.appendChild(add);
+}
+async function renameGrid(gid) {
+  const g = gridById[gid];
+  if (!g) return;
+  const name = prompt('New grid title:', g.title || g.grid_id);
+  if (!name || name === g.title) return;
+  // Grids are immutable in place: CREATE the renamed copy first (the
+  // new name is a distinct id), and only delete the original once the
+  // copy exists — a failed create must never lose the grid.
+  const r = await fetch('/api/grid', {method: 'POST', body: JSON.stringify({
+    name: name, title: name, nrows: g.nrows, ncols: g.ncols,
+    cells: g.cells.map(c => ({geometry: c.geometry, workflow: c.workflow,
+      output: c.output, source: c.source, plotter: c.plotter,
+      title: c.title, params: c.params})),
+  })});
+  if (!r.ok) {
+    alert('Rename failed: ' + ((await r.json()).error || r.status));
+    return;
+  }
+  activeGrid = (await r.json()).grid_id;
+  await fetch(gurl(gid), {method: 'DELETE'});
+  gridGens = {}; refreshGrids();
+}
+function addCellDialog(gid) {
+  const g = gridById[gid];
+  if (!g) return;
+  const old = document.getElementById('cellcfg');
+  if (old) old.remove();
+  const box = el('div', 'card'); box.id = 'cellcfg';
+  box.style.cssText =
+    'position:fixed;top:80px;left:50%;transform:translateX(-50%);' +
+    'z-index:10;min-width:320px;box-shadow:0 4px 24px rgba(0,0,0,.35)';
+  box.appendChild(el('h3', '', 'Add cell to ' + (g.title || g.grid_id)));
+  const sel = document.createElement('select');
+  const outputs = new Map();
+  for (const k of (lastState ? lastState.keys : [])) {
+    const tag = `${k.workflow} · ${k.source} · ${k.output}`;
+    if (!outputs.has(tag)) outputs.set(tag, k);
+  }
+  for (const [tag] of outputs) {
+    const o = document.createElement('option');
+    o.value = tag; o.textContent = tag; sel.appendChild(o);
+  }
+  box.appendChild(sel);
+  const rowIn = document.createElement('input');
+  rowIn.type = 'number'; rowIn.value = '0'; rowIn.style.width = '4em';
+  const colIn = document.createElement('input');
+  colIn.type = 'number'; colIn.value = '0'; colIn.style.width = '4em';
+  const geo = el('div');
+  geo.appendChild(el('label', '', 'row ')); geo.appendChild(rowIn);
+  geo.appendChild(el('label', '', ' col ')); geo.appendChild(colIn);
+  box.appendChild(geo);
+  const status = el('small', ''); status.style.color = '#b00020';
+  const save = el('button', '', 'Add');
+  save.onclick = async () => {
+    const k = outputs.get(sel.value);
+    if (!k) { status.textContent = 'no output selected'; return; }
+    const r = await fetch(gurl(g.grid_id) + '/cell', {
+      method: 'POST', body: JSON.stringify({
+        geometry: {row: Number(rowIn.value), col: Number(colIn.value)},
+        workflow: k.workflow, output: k.output, source: k.source,
+      })});
+    if (!r.ok) { status.textContent = (await r.json()).error; return; }
+    box.remove(); gridGens = {}; refreshGrids();
+  };
+  const cancel = el('button', '', 'Cancel');
+  cancel.onclick = () => box.remove();
+  box.appendChild(save); box.appendChild(cancel); box.appendChild(status);
+  document.body.appendChild(box);
+}
+async function refreshGrids() {
+  const r = await fetch('/api/grids'); const data = await r.json();
+  const root = document.getElementById('grids');
+  gridById = {};
+  for (const g of data.grids) gridById[g.grid_id] = g;
+  // A remotely deleted selection falls back to All (otherwise every
+  // grid would be display:none with no tab to escape).
+  if (activeGrid !== 'all' && !gridById[activeGrid]) activeGrid = 'all';
+  renderGridTabs(data.grids);
+  // Prune grids deleted by any client (wrapper div holds title + box).
+  const live = new Set(data.grids.map(g => 'grid-' + g.grid_id));
+  for (const box of [...root.querySelectorAll('.gridbox')]) {
+    if (!live.has(box.id)) box.parentElement.remove();
+  }
+  for (const g of data.grids) {
+    let box = document.getElementById('grid-' + g.grid_id);
+    if (!box) {
+      const wrap = document.createElement('div');
+      wrap.dataset.gridId = g.grid_id;
+      const gid = g.grid_id;  // closures resolve the LIVE doc by id
+      const h = el('h3', '', g.title || g.grid_id);
+      const ren = el('button', '', '✎');
+      ren.title = 'Rename this grid';
+      ren.onclick = () => renameGrid(gid);
+      h.appendChild(ren);
+      const addc = el('button', '', '+ cell');
+      addc.title = 'Add a plot cell from the live outputs';
+      addc.onclick = () => addCellDialog(gid);
+      h.appendChild(addc);
+      const del = el('button', '', '✕');
+      del.title = 'Delete this grid';
+      del.onclick = async () => {
+        const doc = gridById[gid] || g;
+        if (!confirm('Delete grid "' + (doc.title || gid) + '"?')) return;
+        await fetch(gurl(gid), {method: 'DELETE'});
+        if (activeGrid === gid) activeGrid = 'all';
+        gridGens = {}; refreshGrids();
+      };
+      h.appendChild(del);
+      wrap.appendChild(h);
+      box = document.createElement('div');
+      box.className = 'gridbox'; box.id = 'grid-' + g.grid_id;
+      box.style.gridTemplateColumns = `repeat(${g.ncols}, 1fr)`;
+      wrap.appendChild(box); root.appendChild(wrap);
+    }
+    // Tab selection: only the active grid (or all) is visible. Hidden
+    // grids also SKIP repainting (no PNG fetches for invisible cells);
+    // gridGens stays stale so they paint when their tab is selected.
+    const visible = activeGrid === 'all' || activeGrid === g.grid_id;
+    box.parentElement.style.display = visible ? '' : 'none';
+    if (!visible) continue;
+    // Frame-gated repaint: only when this grid's generation advanced.
+    if (gridGens[g.grid_id] === g.generation) continue;
+    // Never repaint under an active ROI edit: rebuilding the cell would
+    // destroy the canvas mid-drag (losing the mouseup that posts the
+    // edit) and re-fetch .meta every second. The image freezes while
+    // editing; it catches up when the operator hits Done.
+    if (roiEdit && roiEdit.gridId === g.grid_id
+        && box.querySelector('.roi-canvas')) continue;
+    gridGens[g.grid_id] = g.generation;
+    box.innerHTML = '';
+    g.cells.forEach((c, i) => {
+      const cell = document.createElement('div');
+      cell.className = 'card gridcell';
+      cell.style.gridRow = `${c.geometry.row + 1} / span ${c.geometry.row_span}`;
+      cell.style.gridColumn = `${c.geometry.col + 1} / span ${c.geometry.col_span}`;
+      const head = el('h4', '', c.title || ('cell ' + i));
+      const cfg = el('button', '', '⚙');
+      cfg.title = 'Edit plot config';
+      cfg.onclick = () => editCell(g.grid_id, c.index, c.params, c.title);
+      head.appendChild(cfg);
+      // Scale freeze/fit (reference cell_autoscale semantics): lock
+      // writes the CURRENTLY RENDERED ranges into the persisted cell
+      // params; fit clears them back to per-render autoscale.
+      const lock = el('button', '', '🔒');
+      lock.title = 'Freeze the current axis/color ranges into this cell';
+      lock.onclick = async () => {
+        const flash = (msg) => {
+          lock.textContent = '!'; lock.title = msg;
+          setTimeout(() => { lock.textContent = '🔒'; }, 2500);
+        };
+        if (!c.keys.length) return flash('no data bound to this cell');
+        if ((c.params || {}).overlay) {
+          // Overlay renders have no single-axes meta; a first-layer
+          // freeze would clip the other layers.
+          return flash('freeze is not supported for overlay cells');
+        }
+        const mq = new URLSearchParams(c.params || {});
+        let meta;
+        try {
+          const mr = await fetch(
+            '/plot/' + c.keys[0] + '.meta?' + mq.toString());
+          if (!mr.ok) return flash('no rendered plot yet (' + mr.status + ')');
+          meta = await mr.json();
+        } catch (e) { return flash('meta fetch failed'); }
+        if (meta.freezable === false) {
+          return flash('nothing to freeze for this plotter');
+        }
+        const out = Object.assign({}, c.params || {});
+        const span = AppLogic.span;  // degenerate-range widening
+        if (meta.clim) {
+          [out.vmin, out.vmax] = span(meta.clim[0], meta.clim[1]);
+        } else if (meta.ylim) {
+          [out.vmin, out.vmax] = span(meta.ylim[0], meta.ylim[1]);
+        }
+        if (meta.xlim) {
+          [out.xmin, out.xmax] = span(meta.xlim[0], meta.xlim[1]);
+        }
+        const r = await fetch(
+          gurl(g.grid_id) + `/cell/${c.index}/config`, {
+            method: 'POST', body: JSON.stringify({params: out})});
+        if (!r.ok) {
+          return flash((await r.json()).error || 'freeze rejected');
+        }
+        gridGens = {}; refreshGrids();
+      };
+      head.appendChild(lock);
+      const fit = el('button', '', 'fit');
+      fit.title = 'Re-fit: clear frozen ranges, autoscale every render';
+      fit.onclick = async () => {
+        const out = Object.assign({}, c.params || {});
+        for (const k of ['vmin', 'vmax', 'xmin', 'xmax']) delete out[k];
+        await fetch(gurl(g.grid_id) + `/cell/${c.index}/config`, {
+          method: 'POST', body: JSON.stringify({params: out})});
+        gridGens = {}; refreshGrids();
+      };
+      head.appendChild(fit);
+      cell.appendChild(head);
+      if (c.keys.length) {
+        const kid = c.keys[0];
+        const wrap = el('div', 'imgwrap');
+        const img = document.createElement('img');
+        const p = new URLSearchParams(c.params || {});
+        p.set('gen', g.generation);
+        if ((c.params || {}).overlay) {
+          for (const extra of c.keys.slice(1)) p.append('extra', extra);
+        }
+        img.src = '/plot/' + kid + '.png?' + p.toString();
+        wrap.appendChild(img);
+        cell.appendChild(wrap);
+        const dl = document.createElement('a');
+        const dq = new URLSearchParams();
+        for (const k of ['extractor', 'window_s', 'history']) {
+          if ((c.params || {})[k] !== undefined) dq.set(k, c.params[k]);
+        }
+        dl.href = '/data/' + kid + '.npz?' + dq.toString();
+        dl.textContent = '⤓';
+        dl.title = "Download this plot's data (.npz; .json also served)";
+        head.appendChild(dl);
+        const info = keyInfo(kid);
+        if (info && info.output.startsWith('image')) {
+          const rb = el('button', '', roiEdit && roiEdit.kid === kid
+            ? 'Done' : 'ROI');
+          rb.title = 'Draw regions of interest on this image';
+          rb.onclick = () => toggleRoiEdit(kid, g.grid_id, c.index, c.params);
+          head.appendChild(rb);
+          if (roiEdit && roiEdit.kid === kid) {
+            attachRoiOverlay(wrap, img);
+          }
+        }
+      } else {
+        cell.appendChild(el('small', '', 'waiting for data…'));
+      }
+      box.appendChild(cell);
+    });
+  }
+}
+// Per-cell plot configuration modal: presentation (scale/cmap/bounds),
+// data selection (extractor/window), rendering (plotter/slice/overlay).
+// Persists through the config store, so every client's cell follows.
+const CELL_CONFIG_FIELDS = [
+  {key: 'scale', kind: 'select', choices: ['linear', 'log']},
+  {key: 'cmap', kind: 'text', hint: 'matplotlib colormap'},
+  {key: 'vmin', kind: 'number', hint: 'lower bound'},
+  {key: 'vmax', kind: 'number', hint: 'upper bound'},
+  {key: 'extractor', kind: 'select',
+    choices: ['latest', 'full_history', 'window_sum', 'window_mean']},
+  {key: 'window_s', kind: 'number', hint: 'seconds (window_* extractors)'},
+  {key: 'plotter', kind: 'select', choices: ['', 'table', 'slicer', 'flatten']},
+  {key: 'slice', kind: 'number', hint: 'leading-dim index (slicer)'},
+  {key: 'overlay', kind: 'checkbox', hint: 'layer all outputs in one axes'},
+  {key: 'robust', kind: 'checkbox', hint: 'percentile color range (clip hot pixels)'},
+  {key: 'errorbars', kind: 'checkbox', hint: 'Poisson sqrt(N) error bars (count spectra)'},
+  {key: 'vline', kind: 'number', hint: 'vertical reference line (data x)'},
+  {key: 'hline', kind: 'number', hint: 'horizontal reference line (data y)'},
+  {key: 'xmin', kind: 'number', hint: 'x-axis lower bound (1-D plots)'},
+  {key: 'xmax', kind: 'number', hint: 'x-axis upper bound (1-D plots)'},
+  {key: 'flatten_split', kind: 'number', hint: 'leading dims onto Y (flatten plotter)'},
+];
+function editCell(gridId, index, params, currentTitle) {
+  const old = document.getElementById('cellcfg');
+  if (old) old.remove();
+  params = params || {};
+  const box = el('div', 'card'); box.id = 'cellcfg';
+  box.style.cssText =
+    'position:fixed;top:80px;left:50%;transform:translateX(-50%);' +
+    'z-index:10;min-width:300px;box-shadow:0 4px 24px rgba(0,0,0,.35)';
+  box.appendChild(el('h3', '', 'Plot config'));
+  const titleRow = el('div');
+  titleRow.appendChild(el('label', '', 'title '));
+  const titleInput = document.createElement('input');
+  titleInput.type = 'text';
+  titleInput.value = currentTitle || '';
+  titleRow.appendChild(titleInput);
+  box.appendChild(titleRow);
+  const inputs = {};
+  for (const f of CELL_CONFIG_FIELDS) {
+    const row = el('div');
+    const label = el('label', '', f.key + ' ');
+    if (f.hint) label.title = f.hint;
+    let input;
+    if (f.kind === 'select') {
+      input = document.createElement('select');
+      for (const c of f.choices) {
+        const o = document.createElement('option');
+        o.value = c; o.textContent = c === '' ? '(auto)' : c;
+        input.appendChild(o);
+      }
+      input.value = params[f.key] !== undefined ? String(params[f.key]) : f.choices[0];
+    } else if (f.kind === 'checkbox') {
+      input = document.createElement('input'); input.type = 'checkbox';
+      input.checked = params[f.key] === '1' || params[f.key] === true;
+    } else {
+      input = document.createElement('input');
+      input.type = f.kind; if (f.kind === 'number') input.step = 'any';
+      input.value = params[f.key] !== undefined ? params[f.key] : '';
+    }
+    row.appendChild(label); row.appendChild(input);
+    box.appendChild(row);
+    inputs[f.key] = {input, f};
+  }
+  const status = el('small', ''); status.style.color = '#b00020';
+  const save = el('button', '', 'Save');
+  const cancel = el('button', '', 'Cancel');
+  cancel.onclick = () => box.remove();
+  save.onclick = async () => {
+    const out = {};
+    for (const [key, {input, f}] of Object.entries(inputs)) {
+      if (f.kind === 'checkbox') { if (input.checked) out[key] = '1'; continue; }
+      if (input.value !== '') out[key] = input.value;
+    }
+    const body = {params: out};
+    if (titleInput.value !== (currentTitle || '')) body.title = titleInput.value;
+    const r = await fetch(gurl(gridId) + `/cell/${index}/config`, {
+      method: 'POST', body: JSON.stringify(body)});
+    if (!r.ok) { status.textContent = (await r.json()).error; return; }
+    box.remove(); gridGens = {}; refreshGrids();
+  };
+  box.appendChild(save); box.appendChild(cancel); box.appendChild(status);
+  document.body.appendChild(box);
+}
+// -- ROI drawing: rectangle/polygon overlay on detector images --------
+// Coordinate math mirrors /plot/{kid}.meta: the axes' pixel bbox plus
+// its data limits turn a mouse drag into detector coordinates. The
+// backend's roi_rectangle/roi_polygon readbacks seed the editable state,
+// so the overlay shows what is APPLIED, not what was last requested.
+let roiEdit = null, lastState = null;
+function keyInfo(kid) {
+  if (!lastState) return null;
+  return lastState.keys.find(k => k.id === kid) || null;
+}
+const pxToData = AppLogic.pxToData;   // pure transforms: applogic.js
+const dataToPx = AppLogic.dataToPx;
+const MAX_ROIS_PER_TYPE = 4;  // backend ROIStreamMapper capacity per geometry
+async function toggleRoiEdit(kid, gridId, cellIndex, cellParams) {
+  if (roiEdit && roiEdit.kid === kid) {
+    roiEdit = null; gridGens = {}; refreshGrids(); return;
+  }
+  const info = keyInfo(kid);
+  if (!info) return;
+  const rb = await (await fetch('/api/roi?source_name=' +
+    encodeURIComponent(info.source) + '&job_number=' +
+    encodeURIComponent(info.job_number))).json();
+  roiEdit = {
+    kid, gridId, cellIndex, mode: 'rect', polyPts: [],
+    params: cellParams || {},  // .meta must render with the cell's params
+    job: {source_name: info.source, job_number: info.job_number},
+    rects: rb.rectangles.map(r => ({x_min: r.x_min, x_max: r.x_max,
+                                     y_min: r.y_min, y_max: r.y_max})),
+    polys: rb.polygons.map(p => ({x: p.x, y: p.y})),
+  };
+  gridGens = {};  // force grid repaint so the overlay attaches
+  refreshGrids();
+}
+async function postRois() {
+  const rois = {};
+  roiEdit.rects.forEach((r, i) => rois['rect' + i] = r);
+  roiEdit.polys.forEach((p, i) => rois['poly' + i] = p);
+  const r = await fetch('/api/roi', {method: 'POST', body: JSON.stringify(
+    {...roiEdit.job, rois})});
+  if (!r.ok) alert((await r.json()).error || 'ROI update failed');
+}
+async function attachRoiOverlay(wrap, img) {
+  // Fresh meta per attach: the axes bbox moves between repaints (tick
+  // label widths follow live data through tight_layout), so a meta
+  // captured at toggle time would skew the pixel->data mapping. Render
+  // with the cell's own params — scale/cmap change the layout too.
+  const mp = new URLSearchParams(roiEdit.params);
+  roiEdit.meta = await (await fetch(
+    '/plot/' + roiEdit.kid + '.meta?' + mp.toString())).json();
+  const build = () => {
+    const canvas = document.createElement('canvas');
+    canvas.className = 'roi-canvas';
+    canvas.width = img.clientWidth; canvas.height = img.clientHeight;
+    wrap.appendChild(canvas);
+    const bar = el('div', 'roi-bar');
+    const modeBtn = el('button', '', 'mode: rect');
+    modeBtn.onclick = () => {
+      roiEdit.mode = roiEdit.mode === 'rect' ? 'poly' : 'rect';
+      roiEdit.polyPts = [];
+      modeBtn.textContent = 'mode: ' + roiEdit.mode;
+      paint();
+    };
+    bar.appendChild(modeBtn);
+    bar.appendChild(el('small', '',
+      ' drag=new/move · corner-drag=resize · dblclick=delete · ' +
+      'poly: click vertices, dblclick closes'));
+    wrap.appendChild(bar);
+    // Displayed size != PNG size (CSS width 100%): scale factor per axis.
+    const sx = img.clientWidth / roiEdit.meta.width;
+    const sy = img.clientHeight / roiEdit.meta.height;
+    const toPng = e => {
+      const r = canvas.getBoundingClientRect();
+      return [(e.clientX - r.left) / sx, (e.clientY - r.top) / sy];
+    };
+    const ctx = canvas.getContext('2d');
+    const paint = (draft) => {
+      ctx.clearRect(0, 0, canvas.width, canvas.height);
+      ctx.lineWidth = 2;
+      roiEdit.rects.forEach((q, i) => {
+        const [px0, py0] = dataToPx(roiEdit.meta, q.x_min, q.y_max);
+        const [px1, py1] = dataToPx(roiEdit.meta, q.x_max, q.y_min);
+        ctx.strokeStyle = '#ff5722';
+        ctx.strokeRect(px0 * sx, py0 * sy, (px1 - px0) * sx, (py1 - py0) * sy);
+        ctx.fillStyle = '#ff5722';
+        ctx.fillText('rect' + i, px0 * sx + 3, py0 * sy + 12);
+      });
+      roiEdit.polys.forEach((p, i) => {
+        ctx.strokeStyle = '#7b1fa2'; ctx.beginPath();
+        p.x.forEach((x, j) => {
+          const [px, py] = dataToPx(roiEdit.meta, x, p.y[j]);
+          j ? ctx.lineTo(px * sx, py * sy) : ctx.moveTo(px * sx, py * sy);
+        });
+        ctx.closePath(); ctx.stroke();
+      });
+      if (roiEdit.polyPts.length) {
+        ctx.strokeStyle = '#7b1fa2'; ctx.setLineDash([4, 3]); ctx.beginPath();
+        roiEdit.polyPts.forEach(([x, y], j) => {
+          const [px, py] = dataToPx(roiEdit.meta, x, y);
+          j ? ctx.lineTo(px * sx, py * sy) : ctx.moveTo(px * sx, py * sy);
+        });
+        ctx.stroke(); ctx.setLineDash([]);
+      }
+      if (draft) {
+        ctx.strokeStyle = '#ff5722'; ctx.setLineDash([4, 3]);
+        const [px0, py0] = dataToPx(roiEdit.meta, draft.x_min, draft.y_max);
+        const [px1, py1] = dataToPx(roiEdit.meta, draft.x_max, draft.y_min);
+        ctx.strokeRect(px0 * sx, py0 * sy, (px1 - px0) * sx, (py1 - py0) * sy);
+        ctx.setLineDash([]);
+      }
+    };
+    const hitRect = (x, y) => {
+      for (let i = roiEdit.rects.length - 1; i >= 0; i--) {
+        const q = roiEdit.rects[i];
+        if (x >= q.x_min && x <= q.x_max && y >= q.y_min && y <= q.y_max)
+          return i;
+      }
+      return -1;
+    };
+    const nearCorner = (q, x, y) => {
+      // Corner tolerance: 5% of the data span.
+      const tx = 0.05 * Math.abs(roiEdit.meta.xlim[1] - roiEdit.meta.xlim[0]);
+      const ty = 0.05 * Math.abs(roiEdit.meta.ylim[1] - roiEdit.meta.ylim[0]);
+      for (const [cx, cy, h] of [[q.x_min, q.y_min, 'll'], [q.x_max, q.y_min, 'lr'],
+                                 [q.x_min, q.y_max, 'ul'], [q.x_max, q.y_max, 'ur']])
+        if (Math.abs(x - cx) < tx && Math.abs(y - cy) < ty) return h;
+      return null;
+    };
+    let drag = null;
+    canvas.onmousedown = e => {
+      const [px, py] = toPng(e);
+      const [x, y] = pxToData(roiEdit.meta, px, py);
+      if (roiEdit.mode === 'poly') { roiEdit.polyPts.push([x, y]); paint(); return; }
+      const i = hitRect(x, y);
+      if (i >= 0) {
+        const corner = nearCorner(roiEdit.rects[i], x, y);
+        drag = corner ? {kind: 'resize', i, corner}
+                      : {kind: 'move', i, x0: x, y0: y,
+                          orig: {...roiEdit.rects[i]}};
+      } else {
+        drag = {kind: 'new', x0: x, y0: y};
+      }
+    };
+    canvas.onmousemove = e => {
+      if (!drag) return;
+      const [px, py] = toPng(e);
+      const [x, y] = pxToData(roiEdit.meta, px, py);
+      if (drag.kind === 'new') {
+        drag.draft = {x_min: Math.min(drag.x0, x), x_max: Math.max(drag.x0, x),
+                       y_min: Math.min(drag.y0, y), y_max: Math.max(drag.y0, y)};
+        paint(drag.draft);
+      } else if (drag.kind === 'move') {
+        const q = roiEdit.rects[drag.i], o = drag.orig;
+        const dx = x - drag.x0, dy = y - drag.y0;
+        q.x_min = o.x_min + dx; q.x_max = o.x_max + dx;
+        q.y_min = o.y_min + dy; q.y_max = o.y_max + dy;
+        paint();
+      } else {
+        const q = roiEdit.rects[drag.i];
+        if (drag.corner[1] === 'l') q.x_min = x;
+        if (drag.corner[1] === 'r') q.x_max = x;
+        if (drag.corner[0] === 'l') q.y_min = y;
+        if (drag.corner[0] === 'u') q.y_max = y;
+        paint();
+      }
+    };
+    canvas.onmouseup = async () => {
+      if (!drag) return;
+      const d = drag; drag = null;
+      if (d.kind === 'new' && d.draft
+          && d.draft.x_max > d.draft.x_min && d.draft.y_max > d.draft.y_min) {
+        if (roiEdit.rects.length >= MAX_ROIS_PER_TYPE) {
+          alert('At most ' + MAX_ROIS_PER_TYPE + ' rectangle ROIs');
+          paint(); return;
+        }
+        roiEdit.rects.push(d.draft);
+      }
+      if (d.kind === 'resize') {
+        const q = roiEdit.rects[d.i];  // normalize flipped bounds
+        [q.x_min, q.x_max] = [Math.min(q.x_min, q.x_max), Math.max(q.x_min, q.x_max)];
+        [q.y_min, q.y_max] = [Math.min(q.y_min, q.y_max), Math.max(q.y_min, q.y_max)];
+      }
+      paint();
+      await postRois();
+    };
+    canvas.ondblclick = async e => {
+      const [px, py] = toPng(e);
+      const [x, y] = pxToData(roiEdit.meta, px, py);
+      if (roiEdit.mode === 'poly') {
+        if (roiEdit.polyPts.length >= 3) {
+          if (roiEdit.polys.length >= MAX_ROIS_PER_TYPE) {
+            alert('At most ' + MAX_ROIS_PER_TYPE + ' polygon ROIs');
+            roiEdit.polyPts = []; paint(); return;
+          }
+          roiEdit.polys.push({x: roiEdit.polyPts.map(p => p[0]),
+                               y: roiEdit.polyPts.map(p => p[1])});
+          roiEdit.polyPts = [];
+          paint(); await postRois();
+        }
+        return;
+      }
+      const i = hitRect(x, y);
+      if (i >= 0) { roiEdit.rects.splice(i, 1); paint(); await postRois(); }
+    };
+    paint();
+  };
+  if (img.complete && img.clientWidth) build();
+  else img.onload = build;
+}
+// -- workflow status browser: per-job detail table with lifecycle
+// actions, output links, pending commands and the owning service's
+// heartbeat telemetry (reference workflow_status_widget, redesigned as
+// an expandable table over /api/state).
+let jobsOpen = {};  // job_number -> expanded?
+function jobAction(action, j) {
+  return fetch('/api/job/' + action, {method: 'POST', body: JSON.stringify(
+    {source_name: j.source_name, job_number: j.job_number})});
+}
+async function renderLogView() {
+  // Persistent notification history (reference notification_log_widget):
+  // toasts are transient; this tab keeps the full retained queue.
+  const root = document.getElementById('log');
+  const data = await (await fetch('/api/notifications')).json();
+  const fp = String(data.latest);
+  if (root.dataset.fp === fp) return;
+  root.dataset.fp = fp;
+  root.innerHTML = '';
+  const card = el('div', 'card');
+  card.appendChild(el('h3', '', 'Notification log'));
+  if (!data.notifications.length) {
+    card.appendChild(el('small', '', 'Nothing logged yet.'));
+  } else {
+    const table = document.createElement('table');
+    table.className = 'devices';
+    for (const n of data.notifications.slice().reverse()) {
+      const row = document.createElement('tr');
+      row.appendChild(el('td', '', '#' + n.seq));
+      row.appendChild(el('td',
+        n.level === 'ok' || n.level === 'info' ? '' :
+          'state-' + (n.level === 'error' ? 'error' : 'warning'),
+        n.level));
+      row.appendChild(el('td', '', n.message));
+      table.appendChild(row);
+    }
+    card.appendChild(table);
+  }
+  root.appendChild(card);
+}
+function renderJobsView(s) {
+  const root = document.getElementById('jobsview');
+  // Rebuild only when the rendered facts change: a rebuild per poll tick
+  // would swallow clicks on buttons replaced mid-press (same gating the
+  // workflows sidebar and correlation pickers use).
+  const fp = JSON.stringify([
+    s.jobs, s.pending_commands, jobsOpen,
+    s.services.map(sv => [sv.service_id, sv.last_batch_message_count]),
+    s.keys.map(k => k.id),
+  ]);
+  if (root.dataset.fp === fp) return;
+  root.dataset.fp = fp;
+  root.innerHTML = '';
+  const card = el('div', 'card');
+  if (!s.jobs.length) {
+    card.appendChild(el('small', '', 'No jobs running — start one from ' +
+      'the Workflows sidebar.'));
+    root.appendChild(card); return;
+  }
+  const pendingByJob = {};
+  for (const c of s.pending_commands) {
+    (pendingByJob[c.job_number] = pendingByJob[c.job_number] || []).push(c);
+  }
+  const svcById = {};
+  for (const sv of s.services) svcById[sv.service_id] = sv;
+  const table = document.createElement('table');
+  table.className = 'devices';
+  for (const j of s.jobs) {
+    const row = document.createElement('tr');
+    const stBtn = el('td');
+    stBtn.appendChild(el('span', 'state-' + j.state, j.state));
+    if (j.adopted) {
+      const b = el('small', '', ' adopted');
+      b.title = 'learned from a heartbeat after a dashboard restart';
+      stBtn.appendChild(b);
+    }
+    row.appendChild(stBtn);
+    row.appendChild(el('td', '', j.source_name));
+    row.appendChild(el('td', '', j.workflow_id));
+    row.appendChild(el('td', '', j.job_number.slice(0, 8)));
+    const act = el('td');
+    const detail = el('button', '', jobsOpen[j.job_number] ? '▾' : '▸');
+    detail.onclick = () => {
+      jobsOpen[j.job_number] = !jobsOpen[j.job_number];
+      root.dataset.fp = '';
+      renderJobsView(lastState);
+    };
+    act.appendChild(detail);
+    for (const a of ['stop', 'reset', 'remove']) {
+      const b = el('button', '', a);
+      b.onclick = async () => { await jobAction(a, j); refresh(); };
+      act.appendChild(b);
+    }
+    const rs = el('button', '', 'restart…');
+    rs.title = 'Start a replacement with edited params, then stop this job';
+    rs.onclick = () => {
+      const w = (lastState.workflows || []).find(
+        x => x.workflow_id === j.workflow_id);
+      if (w) openWizard(w, j.source_name,
+        {initialParams: j.params || {}, replace: j});
+    };
+    act.appendChild(rs);
+    row.appendChild(act);
+    table.appendChild(row);
+    if (jobsOpen[j.job_number]) {
+      const dr = document.createElement('tr');
+      const td = el('td'); td.colSpan = 5;
+      const box = el('div', 'card');
+      if (j.message) {
+        box.appendChild(el('div', 'state-' + j.state, j.message));
+      }
+      const svc = svcById[j.service];
+      const svcLine = el('div', '',
+        'service: ' + (j.service || 'unknown') +
+        (svc ? ` · uptime ${Math.round(svc.uptime_s)}s · last batch ` +
+               `${svc.last_batch_message_count} msgs` : ''));
+      if (svc && svc.lag_level && svc.lag_level !== 'ok') {
+        const badge = el('span', 'state-' + (svc.lag_level === 'error' ?
+          'error' : 'warning'),
+          ` lag ${svc.lag_level} (${svc.worst_lag_s.toFixed(1)}s)`);
+        svcLine.appendChild(badge);
+      }
+      box.appendChild(svcLine);
+      // Per-stream staleness drill-down (reference
+      // workflow_status_widget surfaces per-source status): message
+      // counts + data-time lag with warn/error coloring per stream.
+      if (svc && svc.stream_message_counts) {
+        const lags = svc.stream_lags || {};
+        const names = new Set([
+          ...Object.keys(svc.stream_message_counts), ...Object.keys(lags)]);
+        if (names.size) {
+          const st = document.createElement('table');
+          st.className = 'devices';
+          for (const name of [...names].sort()) {
+            const r = document.createElement('tr');
+            r.appendChild(el('td', '', name));
+            r.appendChild(el('td', '',
+              String(svc.stream_message_counts[name] ?? 0) + ' msgs'));
+            const lag = lags[name];
+            const lagTd = el('td');
+            if (lag) {
+              const [lagS, level] = lag;
+              lagTd.appendChild(el('span',
+                level === 'ok' ? '' : 'state-' +
+                  (level === 'error' ? 'error' : 'warning'),
+                `${lagS.toFixed(1)}s behind`));
+            }
+            r.appendChild(lagTd);
+            st.appendChild(r);
+          }
+          box.appendChild(st);
+        }
+      }
+      const outs = s.keys.filter(k => k.job_number === j.job_number);
+      if (outs.length) {
+        const links = el('div');
+        links.appendChild(el('b', '', 'outputs: '));
+        for (const k of outs) {
+          const a = document.createElement('a');
+          a.href = '/plot/' + k.id + '.png';
+          a.target = '_blank';
+          a.textContent = k.output;
+          a.style.marginRight = '8px';
+          links.appendChild(a);
+        }
+        box.appendChild(links);
+      } else {
+        box.appendChild(el('small', '', 'no outputs published yet'));
+      }
+      for (const c of pendingByJob[j.job_number] || []) {
+        box.appendChild(el('div', c.error ? 'state-error' : '',
+          `pending ${c.kind}` + (c.error ? ': ' + c.error : '')));
+      }
+      td.appendChild(box); dr.appendChild(td); table.appendChild(dr);
+    }
+  }
+  card.appendChild(table);
+  root.appendChild(card);
+}
+// -- workflow wizard: schema-driven params form, two-phase stage->commit.
+function openWizard(w, src, opts) {
+  opts = opts || {};
+  const old = document.getElementById('wizard');
+  if (old) old.remove();
+  const box = el('div', 'card'); box.id = 'wizard';
+  box.style.cssText =
+    'position:fixed;top:80px;left:50%;transform:translateX(-50%);' +
+    'z-index:10;min-width:320px;box-shadow:0 4px 24px rgba(0,0,0,.35)';
+  box.appendChild(el('h3', '', 'Start ' + (w.title || w.workflow_id)));
+  box.appendChild(el('small', '', w.workflow_id + ' @ ' + src));
+  const form = el('div'); box.appendChild(form);
+  // Fields come precomputed from the server (formspec.py): the client
+  // renders descriptors, it does not interpret the schema.
+  const specFields = w.form_fields || [];
+  const fields = {};
+  const initial = opts.initialParams || {};
+  for (const f of specFields) {
+    const row = el('div');
+    const label = el('label', '', f.name + ' ');
+    label.title = f.description || '';
+    let input;
+    const seedRaw = initial[f.name] !== undefined
+      ? (typeof initial[f.name] === 'object'
+          ? JSON.stringify(initial[f.name]) : String(initial[f.name]))
+      : f.default_text;
+    if (f.kind === 'boolean') {
+      input = document.createElement('input');
+      input.type = 'checkbox';
+      input.checked = seedRaw === 'true';
+    } else if (f.enum) {
+      input = document.createElement('select');
+      if (seedRaw === null || seedRaw === undefined) {
+        // No default: an empty choice keeps the field omittable so the
+        // server default applies (collectParams drops '').
+        const o = el('option', '', '(server default)'); o.value = '';
+        input.appendChild(o);
+      }
+      for (const opt of f.enum) {
+        const o = el('option', '', opt); o.value = opt;
+        input.appendChild(o);
+      }
+      if (seedRaw !== null && seedRaw !== undefined) input.value = seedRaw;
+    } else {
+      input = document.createElement('input');
+      input.type = (f.kind === 'number' || f.kind === 'integer')
+        ? 'number' : 'text';
+      if (f.kind === 'number') input.step = 'any';
+      input.value = seedRaw !== null && seedRaw !== undefined ? seedRaw : '';
+    }
+    const err = el('small', 'field-error'); err.style.color = '#b00020';
+    row.appendChild(label); row.appendChild(input); row.appendChild(err);
+    form.appendChild(row);
+    fields[f.name] = {input, err, kind: f.kind};
+  }
+  const status = el('small', '', ''); status.style.color = '#b00020';
+  const go = el('button', '', 'Stage + start');
+  const cancel = el('button', '', 'Cancel');
+  cancel.onclick = () => box.remove();
+  go.onclick = async () => {
+    for (const f of Object.values(fields)) f.err.textContent = '';
+    const params = AppLogic.collectParams(specFields, (name) => ({
+      raw: fields[name].input.value,
+      checked: fields[name].input.checked,
+    }));
+    const payload = JSON.stringify(
+      {workflow_id: w.workflow_id, source_name: src, params});
+    const staged = await fetch('/api/workflow/stage',
+      {method: 'POST', body: payload});
+    if (!staged.ok) {
+      const body = await staged.json();
+      status.textContent = body.error || 'validation failed';
+      for (const d of body.details || []) {
+        const f = fields[d.field.split('.')[0]];
+        if (f) f.err.textContent = ' ' + d.message;
+      }
+      return;  // staged-config validation errors stay in the form
+    }
+    const committed = await fetch('/api/workflow/commit',
+      {method: 'POST', body: payload});
+    if (!committed.ok) {
+      status.textContent = (await committed.json()).error || 'commit failed';
+      return;
+    }
+    if (opts.replace) {
+      // Restart-with-params: the new job is running; retire the old one.
+      await jobAction('stop', opts.replace);
+    }
+    box.remove(); refresh();
+  };
+  box.appendChild(go); box.appendChild(cancel); box.appendChild(status);
+  document.body.appendChild(box);
+}
+async function pollSession() {
+  const q = sessionId ? '?session=' + sessionId : '';
+  const r = await fetch('/api/session' + q); const data = await r.json();
+  sessionId = data.session_id;
+  if (data.config_changed) { gridGens = {}; }  // another client edited config
+  for (const n of data.notifications) {
+    const d = document.createElement('div');
+    d.className = 'toast ' + n.level; d.textContent = n.message;
+    document.getElementById('toasts').appendChild(d);
+    setTimeout(() => d.remove(), 6000);
+  }
+}
+async function refresh() {
+  const r = await fetch('/api/state'); const s = await r.json();
+  lastState = s;
+  document.getElementById('meta').textContent = 'generation ' + s.generation;
+  const wf = document.getElementById('workflows');
+  // Re-render when the workflow/source set changes (fingerprint, not
+  // count: a same-count replacement must refresh captured schemas too).
+  const wfFp = JSON.stringify(
+    s.workflows.map(w => [w.workflow_id, w.source_names]));
+  if (wf.dataset.fp !== wfFp) {
+    wf.dataset.fp = wfFp;
+    wf.innerHTML = '';
+    for (const w of s.workflows) {
+      for (const src of w.source_names) {
+        const b = document.createElement('button');
+        b.textContent = w.title + ' @ ' + src;
+        b.onclick = () => openWizard(w, src);
+        wf.appendChild(b); wf.appendChild(document.createElement('br'));
+      }
+    }
+  }
+  const jobs = document.getElementById('jobs'); jobs.innerHTML = '';
+  for (const j of s.jobs) {
+    const d = document.createElement('div'); d.className = 'job';
+    d.appendChild(el('span', 'state-' + j.state, j.state));
+    d.appendChild(document.createTextNode(' ' + j.source_name + ' '));
+    d.appendChild(el('small', '', j.workflow_id));
+    const stop = document.createElement('button'); stop.textContent = 'stop';
+    stop.onclick = () => jobAction('stop', j);
+    d.appendChild(stop); jobs.appendChild(d);
+  }
+  const svcs = document.getElementById('svcs'); svcs.innerHTML = '';
+  for (const sv of s.services) {
+    const d = document.createElement('div'); d.className = 'job';
+    d.textContent = `${sv.service_id}: ${sv.state}` + (sv.stale ? ' (stale)' : '');
+    if (sv.lag_level && sv.lag_level !== 'ok') {
+      d.appendChild(el(
+        'span',
+        sv.lag_level === 'warning' ? 'state-warning' : 'state-error',
+        ` lag ${sv.lag_level} (${Number(sv.worst_lag_s).toFixed(1)}s)`));
+    }
+    svcs.appendChild(d);
+  }
+  const dr = await fetch('/api/devices'); const dd = await dr.json();
+  const dt = document.getElementById('devices'); dt.innerHTML = '';
+  for (const dev of dd.devices) {
+    const row = document.createElement('tr');
+    row.appendChild(el('td', dev.stale ? 'stale' : '', dev.name));
+    row.appendChild(
+      el('td', '', Number(dev.value).toPrecision(6) + ' ' + dev.unit));
+    dt.appendChild(row);
+  }
+  await pollSession();
+  if (tab === 'corr') refreshCorrChoices(s);
+  if (tab === 'jobsview') renderJobsView(s);
+  if (tab === 'log') renderLogView();
+  if (tab === 'grids') {
+    await refreshGrids();
+  } else if (tab === 'flat' && s.generation !== gen) {
+    gen = s.generation;
+    const grid = document.getElementById('flat');
+    const seen = new Set();
+    for (const k of s.keys) {
+      seen.add(k.id);
+      let card = document.getElementById('card-' + k.id);
+      if (!card) {
+        card = document.createElement('div'); card.className = 'card';
+        card.id = 'card-' + k.id;
+        const img = document.createElement('img'); img.id = 'img-' + k.id;
+        card.appendChild(img); grid.appendChild(card);
+      }
+      document.getElementById('img-' + k.id).src =
+        '/plot/' + k.id + '.png?gen=' + gen;
+    }
+    for (const card of [...grid.children]) {
+      if (!seen.has(card.id.slice(5))) card.remove();
+    }
+  }
+}
+setInterval(refresh, 1000); refresh();

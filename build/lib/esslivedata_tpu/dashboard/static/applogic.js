@@ -1,0 +1,50 @@
+// Pure client-side logic — no DOM, no fetch, no globals. Everything here
+// is a plain (args) -> value function so the DOM-wiring layer (app.js)
+// stays thin. Schema interpretation lives SERVER-side (formspec.py,
+// pytest-covered); this file keeps only what must run in the browser:
+// per-kind input coercion and plot-pixel <-> data-coordinate transforms.
+// Structural contracts (endpoint references, brace balance, no inline-JS
+// residue) are pinned by tests/dashboard/static_assets_test.py.
+'use strict';
+const AppLogic = {
+  // Raw input string -> typed param value per formspec kind.
+  // undefined = omit the field (server default applies).
+  coerceFieldValue(kind, raw, checked) {
+    if (kind === 'boolean') return !!checked;
+    if (raw === '' || raw === undefined || raw === null) return undefined;
+    if (kind === 'integer' || kind === 'number') return Number(raw);
+    if (kind === 'text') return raw;  // never JSON.parse: '123' stays text
+    try { return JSON.parse(raw); } catch (e) { return raw; }
+  },
+
+  // Collect a params object from [{name, kind}] + a raw-value lookup.
+  collectParams(fields, rawOf) {
+    const params = {};
+    for (const f of fields) {
+      const {raw, checked} = rawOf(f.name);
+      const v = AppLogic.coerceFieldValue(f.kind, raw, checked);
+      if (v !== undefined) params[f.name] = v;
+    }
+    return params;
+  },
+
+  // PNG pixel <-> data coordinates via the plot meta (axes_px box +
+  // xlim/ylim). PNG rows grow downward.
+  pxToData(meta, px, py) {
+    const a = meta.axes_px;
+    const fx = (px - a.x0) / (a.x1 - a.x0);
+    const fy = (a.y1 - py) / (a.y1 - a.y0);
+    return [meta.xlim[0] + fx * (meta.xlim[1] - meta.xlim[0]),
+            meta.ylim[0] + fy * (meta.ylim[1] - meta.ylim[0])];
+  },
+  dataToPx(meta, x, y) {
+    const a = meta.axes_px;
+    const fx = (x - meta.xlim[0]) / (meta.xlim[1] - meta.xlim[0]);
+    const fy = (y - meta.ylim[0]) / (meta.ylim[1] - meta.ylim[0]);
+    return [a.x0 + fx * (a.x1 - a.x0), a.y1 - fy * (a.y1 - a.y0)];
+  },
+
+  // Widen a degenerate [lo, hi] range (freeze of a constant image must
+  // keep vmin < vmax server-side).
+  span(lo, hi) { return hi > lo ? [lo, hi] : [lo - 0.5, lo + 0.5]; },
+};

@@ -1,0 +1,57 @@
+"""Binds result streams to consumer pipes (reference: dashboard/stream_manager.py).
+
+A thin factory over DataService subscriptions: given a key-selection
+predicate and an extractor, it creates a subscription whose callback
+pushes extracted values into a consumer (a plot cell's pipe, a table, a
+test probe). Owns its subscriptions so a departing session tears down
+everything it wired.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+from typing import Any
+
+from ..config.workflow_spec import ResultKey
+from .data_service import DataService, DataSubscription
+from .extractors import Extractor, LatestValueExtractor
+
+__all__ = ["StreamManager"]
+
+
+class StreamManager:
+    def __init__(self, *, data_service: DataService) -> None:
+        self._data = data_service
+        self._subscriptions: list[DataSubscription] = []
+
+    def bind(
+        self,
+        keys: set[ResultKey],
+        consumer: Callable[[ResultKey, Any], None],
+        *,
+        extractor: Extractor | None = None,
+    ) -> DataSubscription:
+        """On update of any key, extract its current value into ``consumer``."""
+        extractor = extractor or LatestValueExtractor()
+
+        def on_updated(updated: set[ResultKey]) -> None:
+            for key in updated:
+                value = self._data.get(key, extractor)
+                if value is not None:
+                    consumer(key, value)
+
+        sub = DataSubscription(
+            keys=keys, on_updated=on_updated, extractor=extractor
+        )
+        self._data.subscribe(sub)
+        self._subscriptions.append(sub)
+        return sub
+
+    def unbind(self, subscription: DataSubscription) -> None:
+        self._data.unsubscribe(subscription)
+        if subscription in self._subscriptions:
+            self._subscriptions.remove(subscription)
+
+    def close(self) -> None:
+        for sub in list(self._subscriptions):
+            self.unbind(sub)

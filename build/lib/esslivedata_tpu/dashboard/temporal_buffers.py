@@ -1,0 +1,144 @@
+"""Per-key result buffers with bounded temporal history.
+
+Parity with reference ``dashboard/temporal_buffers.py`` (SingleValueBuffer:
+92, TemporalBuffer:304) + ``temporal_buffer_manager.py``: each ResultKey's
+stream lands in a buffer; extractors decide whether history is retained.
+``TemporalBuffer`` keeps a time-ordered deque under a size budget,
+evicting oldest entries.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Protocol, runtime_checkable
+
+from ..core.timestamp import Timestamp
+
+__all__ = ["Buffer", "SingleValueBuffer", "TemporalBuffer", "TemporalBufferManager"]
+
+
+@runtime_checkable
+class Buffer(Protocol):
+    def put(self, timestamp: Timestamp, value: Any) -> None: ...
+
+    def latest(self) -> Any: ...
+
+    def history(self) -> list[tuple[Timestamp, Any]]: ...
+
+    def clear(self) -> None: ...
+
+
+class SingleValueBuffer:
+    """Keeps only the newest value — the default for image-sized results."""
+
+    def __init__(self) -> None:
+        self._value: Any = None
+        self._timestamp: Timestamp | None = None
+
+    def put(self, timestamp: Timestamp, value: Any) -> None:
+        if self._timestamp is None or timestamp >= self._timestamp:
+            self._value = value
+            self._timestamp = timestamp
+
+    @property
+    def is_empty(self) -> bool:
+        return self._timestamp is None
+
+    def latest(self) -> Any:
+        return self._value
+
+    def history(self) -> list[tuple[Timestamp, Any]]:
+        if self._timestamp is None:
+            return []
+        return [(self._timestamp, self._value)]
+
+    def clear(self) -> None:
+        self._value = None
+        self._timestamp = None
+
+
+def _nbytes(value: Any) -> int:
+    values = getattr(value, "values", None)
+    nbytes = getattr(values, "nbytes", None)
+    if nbytes is not None:
+        return int(nbytes)
+    return 64  # scalars / small objects
+
+
+class TemporalBuffer:
+    """Time-ordered history under a byte budget (drop-oldest)."""
+
+    def __init__(self, max_bytes: int = 64 * 1024 * 1024) -> None:
+        self._entries: deque[tuple[Timestamp, Any]] = deque()
+        self._max_bytes = max_bytes
+        self._bytes = 0
+
+    def put(self, timestamp: Timestamp, value: Any) -> None:
+        self._entries.append((timestamp, value))
+        self._bytes += _nbytes(value)
+        while self._bytes > self._max_bytes and len(self._entries) > 1:
+            _, old = self._entries.popleft()
+            self._bytes -= _nbytes(old)
+
+    @property
+    def is_empty(self) -> bool:
+        return not self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def latest(self) -> Any:
+        return self._entries[-1][1] if self._entries else None
+
+    def history(self) -> list[tuple[Timestamp, Any]]:
+        return list(self._entries)
+
+    def window(self, duration_s: float) -> list[tuple[Timestamp, Any]]:
+        if not self._entries:
+            return []
+        cutoff = self._entries[-1][0].ns - int(duration_s * 1e9)
+        return [(t, v) for t, v in self._entries if t.ns >= cutoff]
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self._bytes = 0
+
+
+class TemporalBufferManager:
+    """Chooses/creates the buffer per key based on extractor demand
+    (reference: temporal_buffer_manager.py): history is only retained for
+    keys some extractor wants history for."""
+
+    def __init__(self, *, history_max_bytes: int = 64 * 1024 * 1024) -> None:
+        self._buffers: dict[Any, Buffer] = {}
+        self._wants_history: set[Any] = set()
+        self._history_max_bytes = history_max_bytes
+
+    def require_history(self, key: Any) -> None:
+        self._wants_history.add(key)
+        existing = self._buffers.get(key)
+        if isinstance(existing, SingleValueBuffer):
+            upgraded = TemporalBuffer(self._history_max_bytes)
+            for t, v in existing.history():
+                upgraded.put(t, v)
+            self._buffers[key] = upgraded
+
+    def get(self, key: Any) -> Buffer | None:
+        return self._buffers.get(key)
+
+    def put(self, key: Any, timestamp: Timestamp, value: Any) -> None:
+        buf = self._buffers.get(key)
+        if buf is None:
+            buf = (
+                TemporalBuffer(self._history_max_bytes)
+                if key in self._wants_history
+                else SingleValueBuffer()
+            )
+            self._buffers[key] = buf
+        buf.put(timestamp, value)
+
+    def keys(self):
+        return self._buffers.keys()
+
+    def clear(self) -> None:
+        self._buffers.clear()

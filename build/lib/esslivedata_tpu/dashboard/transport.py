@@ -1,0 +1,150 @@
+"""Dashboard-side transport: typed messages in, commands out.
+
+Parity with reference ``dashboard/transport.py:15`` (Transport protocol
+with Kafka/Null/Fake impls). The dashboard never sees raw bytes above this
+seam — transports decode da00/x5f2/JSON into typed messages.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Any, Protocol, runtime_checkable
+
+import numpy as np
+
+from ..config.workflow_spec import ResultKey
+from ..core.job import ServiceStatus
+from ..core.timestamp import Timestamp
+from ..kafka import wire
+from ..kafka.da00_compat import da00_to_dataarray
+from ..utils.labeled import DataArray
+
+__all__ = [
+    "AckMessage",
+    "DeviceMessage",
+    "NullTransport",
+    "ResultMessage",
+    "StatusMessage",
+    "Transport",
+    "decode_backend_message",
+]
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True, slots=True)
+class ResultMessage:
+    key: ResultKey
+    timestamp: Timestamp
+    data: DataArray
+
+
+@dataclass(frozen=True, slots=True)
+class StatusMessage:
+    service_id: str
+    status: ServiceStatus
+
+
+@dataclass(frozen=True, slots=True)
+class AckMessage:
+    payload: dict
+
+
+@dataclass(frozen=True, slots=True)
+class DeviceMessage:
+    """One NICOS derived-device sample from the nicos topic (ADR 0006)."""
+
+    name: str
+    value: float
+    unit: str
+    timestamp_ns: int
+
+
+DashboardMessage = ResultMessage | StatusMessage | AckMessage | DeviceMessage
+
+
+@runtime_checkable
+class Transport(Protocol):
+    def publish_command(self, payload: dict[str, Any]) -> None: ...
+
+    def get_messages(self) -> list[DashboardMessage]: ...
+
+    def start(self) -> None: ...
+
+    def stop(self) -> None: ...
+
+
+def decode_backend_message(
+    topic_kind: str, value: bytes
+) -> DashboardMessage | None:
+    """Decode one backend-produced payload. topic_kind is 'data',
+    'status' or 'responses' (derived from the topic name)."""
+    import json
+
+    if topic_kind == "data":
+        da00 = wire.decode_da00(value)
+        try:
+            key = ResultKey.from_string(da00.source_name)
+        except Exception:
+            logger.warning("Undecodable result key %r", da00.source_name)
+            return None
+        return ResultMessage(
+            key=key,
+            timestamp=Timestamp.from_ns(da00.timestamp_ns),
+            data=da00_to_dataarray(da00.variables, name=key.output_name),
+        )
+    if topic_kind == "status":
+        from ..kafka.nicos_status import decode_status
+
+        _code, parsed, service_id = decode_status(value)
+        if not isinstance(parsed, ServiceStatus):
+            # Per-job heartbeats address NICOS consumers; the dashboard's
+            # job view comes from the aggregated service document.
+            return None
+        return StatusMessage(service_id=service_id, status=parsed)
+    if topic_kind == "responses":
+        return AckMessage(payload=json.loads(value.decode("utf-8")))
+    if topic_kind == "nicos":
+        # The nicos topic carries both f144 (LogData devices) and da00
+        # (contracted DataArray outputs, kafka/sink.py:99-113): dispatch on
+        # the embedded schema id.
+        schema = wire.get_schema(value)
+        if schema == "f144":
+            f144 = wire.decode_f144(value)
+            return DeviceMessage(
+                name=f144.source_name,
+                value=float(np.atleast_1d(f144.value)[-1]),
+                unit="",
+                timestamp_ns=f144.timestamp_ns,
+            )
+        da00 = wire.decode_da00(value)
+        signal = next(
+            (v for v in da00.variables if v.name == "signal"),
+            da00.variables[0] if da00.variables else None,
+        )
+        if signal is None:
+            return None
+        return DeviceMessage(
+            name=da00.source_name,
+            value=float(np.atleast_1d(signal.data).reshape(-1)[-1]),
+            unit=signal.unit or "",
+            timestamp_ns=da00.timestamp_ns,
+        )
+    return None
+
+
+class NullTransport:
+    """No backend at all (unit tests of pure-UI pieces)."""
+
+    def publish_command(self, payload: dict[str, Any]) -> None:
+        pass
+
+    def get_messages(self) -> list[DashboardMessage]:
+        return []
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass

@@ -1,0 +1,62 @@
+"""Transport layer: wire codecs, consumers, adapters, sinks.
+
+Parity with reference ``src/ess/livedata/kafka/`` (SURVEY.md section 2.2).
+The reference decodes FlatBuffers through the ess-streaming-data-types
+package and talks to brokers through librdkafka/confluent_kafka; here the
+codecs are implemented clean-room on the flatbuffers runtime (zero-copy
+numpy views into message buffers on decode — the ev44 fast path feeds the
+device staging buffer directly), and the consumer protocol is narrow so
+tests and fakes plug in without a broker (the reference's central test
+pattern, SURVEY.md section 4.2). confluent_kafka is optional: the real
+consumer/producer live behind the same protocols.
+"""
+
+from .wire import (
+    Ad00Image,
+    Da00Variable,
+    Ev44Message,
+    F144Message,
+    RunStartMessage,
+    RunStopMessage,
+    X5f2Status,
+    decode_ad00,
+    decode_da00,
+    decode_ev44,
+    decode_f144,
+    decode_pl72,
+    decode_6s4t,
+    decode_x5f2,
+    encode_ad00,
+    encode_da00,
+    encode_ev44,
+    encode_f144,
+    encode_pl72,
+    encode_6s4t,
+    encode_x5f2,
+    get_schema,
+)
+
+__all__ = [
+    "Ad00Image",
+    "Da00Variable",
+    "Ev44Message",
+    "F144Message",
+    "RunStartMessage",
+    "RunStopMessage",
+    "X5f2Status",
+    "decode_6s4t",
+    "decode_ad00",
+    "decode_da00",
+    "decode_ev44",
+    "decode_f144",
+    "decode_pl72",
+    "decode_x5f2",
+    "encode_6s4t",
+    "encode_ad00",
+    "encode_da00",
+    "encode_ev44",
+    "encode_f144",
+    "encode_pl72",
+    "encode_x5f2",
+    "get_schema",
+]

@@ -1,0 +1,227 @@
+"""Derive chopper-cascade readiness from raw chopper PV traffic.
+
+Wavelength-LUT jobs need a primary trigger saying "every chopper in the
+cascade has reached its setpoints" — a signal no upstream producer emits
+(reference ``kafka/chopper_synthesizer.py``). This module derives it
+in-process, as a ``MessageSource`` decorator that forwards all wrapped
+traffic verbatim and injects two kinds of synthetic f144 streams:
+
+- ``<chopper>/delay_setpoint``: the noisy ``<chopper>/delay`` readback is
+  plateau-detected; each newly locked level is published once, stamped
+  with the time of the raw sample that completed the plateau (not the
+  batch end — a batch can contain a lock followed by the start of the
+  next ramp, and the setpoint must not carry the ramp's time).
+- ``chopper_cascade``: one tick whenever an input changed while every
+  configured chopper holds both a cached ``rotation_speed_setpoint`` and
+  a locked delay. While locked and idle, the tick is re-emitted every
+  ``refresh_every``-th cycle so jobs started after the original lock
+  still receive their primary trigger (there is no replay; the LUT
+  workflow dedupes on setpoint signature, so refreshes are no-ops for
+  already-primed jobs).
+
+Every synthetic message rides the *data clock*: timestamps come from
+observed input samples, never from the wall clock. Batchers window on
+message timestamps, so a wall-clock-stamped tick could land far outside
+any live window during replay and orphan the LUT trigger. Consequently a
+chopperless instrument's single vacuous bootstrap tick is deferred until
+the first forwarded message supplies a data time (before that, no batch
+can close, so nothing is lost by waiting).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from collections.abc import Sequence
+
+import numpy as np
+
+from ..config.chopper import (
+    CHOPPER_CASCADE_SOURCE,
+    delay_readback_stream,
+    delay_setpoint_stream,
+    speed_setpoint_stream,
+)
+from ..core.message import Message, MessageSource, StreamId, StreamKind
+from ..core.timestamp import Timestamp
+from ..preprocessors.to_nxlog import LogData
+
+__all__ = ["CHOPPER_CASCADE_SOURCE", "CHOPPER_CASCADE_STREAM", "ChopperSynthesizer"]
+
+logger = logging.getLogger(__name__)
+
+CHOPPER_CASCADE_STREAM = StreamId(kind=StreamKind.LOG, name=CHOPPER_CASCADE_SOURCE)
+
+
+class _ChopperTracker:
+    """Lock state for one chopper: cached speed setpoint + delay plateau.
+
+    The delay readback is noisy, so its setpoint is inferred: keep the
+    last ``window_size`` samples, and when their spread (std dev) falls
+    under ``atol`` the window mean becomes the locked level. The same
+    ``atol`` decides whether a later plateau differs enough from the
+    current lock to count as a *new* setpoint — one knob for both noise
+    rejection and change detection.
+    """
+
+    __slots__ = ("_atol", "_delay_lock", "_recent", "_speed", "name")
+
+    def __init__(self, name: str, *, window_size: int, atol: float) -> None:
+        self.name = name
+        self._atol = atol
+        self._recent: deque[float] = deque(maxlen=window_size)
+        self._delay_lock: float | None = None
+        self._speed: float | None = None
+
+    @property
+    def ready(self) -> bool:
+        """Both quantities known — this chopper no longer blocks the tick."""
+        return self._speed is not None and self._delay_lock is not None
+
+    def feed_delay(self, log: LogData) -> list[tuple[int, float]]:
+        """Feed raw readback samples; return ``(time_ns, level)`` per new
+        lock, timestamped at the sample that completed the plateau."""
+        locks: list[tuple[int, float]] = []
+        for raw_ns, raw in log.samples():
+            self._recent.append(float(raw))
+            if len(self._recent) < self._recent.maxlen:
+                continue
+            plateau = np.fromiter(self._recent, dtype=float)
+            if plateau.std() >= self._atol:
+                continue
+            level = float(plateau.mean())
+            if self._delay_lock is None or abs(level - self._delay_lock) > self._atol:
+                self._delay_lock = level
+                locks.append((int(raw_ns), level))
+        return locks
+
+    def feed_speed(self, log: LogData) -> bool:
+        """Cache the clean speed setpoint; True if it actually changed."""
+        latest = float(log.value[-1])
+        if latest == self._speed:
+            return False
+        self._speed = latest
+        return True
+
+
+class ChopperSynthesizer:
+    """MessageSource decorator injecting cascade-readiness streams."""
+
+    def __init__(
+        self,
+        wrapped: MessageSource[Message],
+        *,
+        chopper_names: Sequence[str] = (),
+        delay_window_size: int = 5,
+        delay_atol: float = 1000.0,
+        refresh_every: int = 256,
+    ) -> None:
+        self._wrapped = wrapped
+        self._refresh_every = max(1, refresh_every)
+        self._cycle = 0
+        self._trackers = [
+            _ChopperTracker(name, window_size=delay_window_size, atol=delay_atol)
+            for name in chopper_names
+        ]
+        # Stream-name routing: which tracker and quantity a message feeds.
+        self._delay_of = {
+            delay_readback_stream(t.name): t for t in self._trackers
+        }
+        self._speed_of = {
+            speed_setpoint_stream(t.name): t for t in self._trackers
+        }
+        self._ticked_once = False
+        self._logged_lock = False
+        self._data_clock: Timestamp | None = None
+
+    # -- cycle ------------------------------------------------------------
+    def get_messages(self) -> Sequence[Message]:
+        self._cycle += 1
+        injected: list[Message] = []
+        passthrough: list[Message] = []
+        changed_at: Timestamp | None = None
+
+        for msg in self._wrapped.get_messages():
+            passthrough.append(msg)
+            # Only data streams advance the data clock: commands are
+            # wall-clock stamped, and a bootstrap tick at "now" would
+            # poison the batcher's data-time window for replayed or
+            # backlogged data arriving with older timestamps.
+            if msg.stream.kind.is_data and (
+                self._data_clock is None or msg.timestamp > self._data_clock
+            ):
+                self._data_clock = msg.timestamp
+            if self._observe(msg, injected):
+                if changed_at is None or msg.timestamp > changed_at:
+                    changed_at = msg.timestamp
+
+        tick_at = self._tick_due(changed_at)
+        if tick_at is not None:
+            self._ticked_once = True
+            injected.append(
+                Message(
+                    timestamp=tick_at,
+                    stream=CHOPPER_CASCADE_STREAM,
+                    value=LogData(time=tick_at.ns, value=1),
+                )
+            )
+        return [*injected, *passthrough]
+
+    def _observe(self, msg: Message, injected: list[Message]) -> bool:
+        """Feed one message into its tracker; True if an input changed."""
+        tracker = self._delay_of.get(msg.stream.name)
+        if tracker is not None:
+            locks = tracker.feed_delay(msg.value)
+            for lock_ns, level in locks:
+                injected.append(
+                    Message(
+                        timestamp=Timestamp.from_ns(lock_ns),
+                        stream=StreamId(
+                            kind=StreamKind.LOG,
+                            name=delay_setpoint_stream(tracker.name),
+                        ),
+                        value=LogData(time=lock_ns, value=level),
+                    )
+                )
+                logger.info(
+                    "chopper %s delay locked at %s", tracker.name, level
+                )
+            return bool(locks)
+        tracker = self._speed_of.get(msg.stream.name)
+        if tracker is not None:
+            return tracker.feed_speed(msg.value)
+        return False
+
+    def _tick_due(self, changed_at: Timestamp | None) -> Timestamp | None:
+        """When (in data time) to emit a cascade tick this cycle, if at all.
+
+        The returned timestamp is always an observed data time — see the
+        module docstring for why wall clock is never used.
+        """
+        if not self._trackers:
+            # Chopperless: one vacuous bootstrap tick as soon as a data
+            # time exists, then periodic refreshes.
+            if self._data_clock is None:
+                return None
+            if not self._ticked_once:
+                logger.info("chopper_cascade bootstrap tick (no choppers)")
+                return self._data_clock
+            return self._refresh_tick()
+
+        if not all(t.ready for t in self._trackers):
+            self._logged_lock = False
+            return None
+        if not self._logged_lock:
+            self._logged_lock = True
+            logger.info(
+                "chopper_cascade all locked: %s",
+                [t.name for t in self._trackers],
+            )
+        if changed_at is not None:
+            return changed_at
+        return self._refresh_tick()
+
+    def _refresh_tick(self) -> Timestamp | None:
+        if self._cycle % self._refresh_every == 0:
+            return self._data_clock
+        return None

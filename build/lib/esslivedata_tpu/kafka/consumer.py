@@ -1,0 +1,204 @@
+"""Manual partition assignment pinned at the high watermark.
+
+Parity with reference ``kafka/consumer.py`` (assign_all_partitions:31,
+topic validation :15, context-managed factories :88): services never
+``subscribe`` (no consumer-group rebalancing, no offset commits — restart
+semantics are "resume at live data", SURVEY.md §5 elastic recovery).
+Instead every partition of every input topic is assigned explicitly with
+the offset pinned at the *current high watermark*, so exactly the data
+produced after assignment is consumed, deterministically.
+
+Works against the confluent_kafka Consumer API shape; a structural
+protocol keeps it testable (and usable) without the library.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections.abc import Sequence
+from contextlib import contextmanager
+from typing import Any, Protocol
+
+__all__ = [
+    "AssignableConsumer",
+    "assign_all_partitions",
+    "consumer_from_config",
+    "kafka_client_config",
+    "librdkafka_config",
+    "validate_topics_exist",
+]
+
+logger = logging.getLogger(__name__)
+
+_METADATA_TIMEOUT_S = 10.0
+
+
+class AssignableConsumer(Protocol):
+    """The metadata/assignment surface we rely on (confluent_kafka-shaped).
+
+    Distinct from ``kafka.source.KafkaConsumer`` (the consume-side
+    protocol): this one covers only the startup assignment handshake.
+    """
+
+    def list_topics(self, timeout: float) -> Any: ...
+
+    def get_watermark_offsets(
+        self, partition: Any, timeout: float
+    ) -> tuple[int, int]: ...
+
+    def assign(self, partitions: list[Any]) -> None: ...
+
+
+def _validate(metadata, topics: Sequence[str]) -> None:
+    known = set(metadata.topics)
+    if missing := sorted(set(topics) - known):
+        raise ValueError(
+            f"Topics not found on broker: {missing}; available: "
+            f"{sorted(known)[:20]}"
+        )
+
+
+def validate_topics_exist(
+    consumer: AssignableConsumer, topics: Sequence[str]
+) -> None:
+    """Raise ValueError naming every requested topic the broker lacks."""
+    _validate(consumer.list_topics(timeout=_METADATA_TIMEOUT_S), topics)
+
+
+class _TopicPartition:
+    """Stand-in when confluent_kafka is absent (tests, fake brokers)."""
+
+    def __init__(self, topic: str, partition: int, offset: int = -1) -> None:
+        self.topic = topic
+        self.partition = partition
+        self.offset = offset
+
+    def __repr__(self) -> str:
+        return f"TP({self.topic}[{self.partition}]@{self.offset})"
+
+
+def _topic_partition_type():
+    try:
+        from confluent_kafka import TopicPartition
+
+        return TopicPartition
+    except ImportError:
+        return _TopicPartition
+
+
+def assign_all_partitions(
+    consumer: AssignableConsumer, topics: Sequence[str]
+) -> int:
+    """Assign every partition of ``topics``, offsets at the high watermark.
+
+    Returns the number of partitions assigned. Topics are validated (from
+    the same single metadata fetch) so a typo fails loudly instead of
+    consuming nothing forever.
+    """
+    TopicPartition = _topic_partition_type()
+
+    metadata = consumer.list_topics(timeout=_METADATA_TIMEOUT_S)
+    _validate(metadata, topics)
+    assignments: list[Any] = []
+    for topic in topics:
+        for partition_id in metadata.topics[topic].partitions:
+            tp = TopicPartition(topic, partition_id)
+            _, high = consumer.get_watermark_offsets(
+                tp, timeout=_METADATA_TIMEOUT_S
+            )
+            tp.offset = high
+            assignments.append(tp)
+    consumer.assign(assignments)
+    logger.info(
+        "Assigned %d partitions across %d topics at high watermark",
+        len(assignments),
+        len(topics),
+    )
+    return len(assignments)
+
+
+# Loader-config keys -> librdkafka settings. Everything the defaults/
+# YAML files may declare must be translated here: a dropped key like
+# security_protocol makes the consumer silently attempt PLAINTEXT against
+# a SASL broker and hang.
+_LIBRDKAFKA_KEYS = {
+    "bootstrap_servers": "bootstrap.servers",
+    "security_protocol": "security.protocol",
+    "sasl_mechanism": "sasl.mechanism",
+    "sasl_username": "sasl.username",
+    "sasl_password": "sasl.password",
+    "ssl_ca_location": "ssl.ca.location",
+}
+
+#: App-level tuning keys (consumed by the source layer, not librdkafka) that
+#: may legitimately sit in the same loader config dicts.
+_APP_TUNING_KEYS = frozenset(
+    {"max_poll_records", "poll_timeout_ms", "queue_max_batches"}
+)
+
+
+def librdkafka_config(config: dict[str, Any]) -> dict[str, Any]:
+    """Translate a loader config dict into librdkafka settings.
+
+    App-level tuning keys (source-layer batch/queue sizes) are skipped;
+    anything else unknown is rejected rather than dropped, so adding a key
+    to the YAML defaults without teaching this translation fails loudly.
+    """
+    out: dict[str, Any] = {"bootstrap.servers": "localhost:9092"}
+    unknown = set(config) - set(_LIBRDKAFKA_KEYS) - _APP_TUNING_KEYS
+    if unknown:
+        raise ValueError(
+            f"Unrecognized kafka config keys {sorted(unknown)}; known: "
+            f"{sorted(_LIBRDKAFKA_KEYS)} + tuning {sorted(_APP_TUNING_KEYS)}"
+        )
+    for key, value in config.items():
+        if key in _LIBRDKAFKA_KEYS:
+            out[_LIBRDKAFKA_KEYS[key]] = value
+    return out
+
+
+def kafka_client_config(
+    *, bootstrap_override: str | None = None
+) -> dict[str, Any]:
+    """librdkafka settings for the current LIVEDATA_ENV.
+
+    Loads the ``kafka`` config namespace (YAML defaults incl. SASL/SSL
+    credentials in prod) and translates it; a CLI-provided bootstrap
+    override wins over the file. Used by the service runner, dashboard
+    transport, and tools so every client shares one authentication path.
+    """
+    from ..config.config_loader import load_config
+
+    try:
+        conf = librdkafka_config(load_config(namespace="kafka") or {})
+    except FileNotFoundError:
+        conf = librdkafka_config({})
+    if bootstrap_override is not None:
+        conf["bootstrap.servers"] = bootstrap_override
+    return conf
+
+
+@contextmanager
+def consumer_from_config(
+    config: dict[str, Any], topics: Sequence[str], *, group_id: str
+):
+    """Build a confluent_kafka Consumer from a loader config dict, assign
+    all partitions, close on exit. ``group_id`` is required so callers
+    (scripts, tools) never silently share a group with services — the
+    service path builds its own consumer with its instrument-scoped id
+    (services/service_factory.py)."""
+    from confluent_kafka import Consumer
+
+    consumer = Consumer(
+        {
+            **librdkafka_config(config),
+            "group.id": group_id,
+            "enable.auto.commit": False,
+            "auto.offset.reset": "latest",
+        }
+    )
+    try:
+        assign_all_partitions(consumer, topics)
+        yield consumer
+    finally:
+        consumer.close()

@@ -1,0 +1,84 @@
+"""DataArray <-> da00 variable-list conversion.
+
+Parity with reference ``kafka/scipp_da00_compat.py``: the data variable is
+named ``signal``; coords ride as additional variables named by coord name
+(edge coords are naturally length N+1 along their axis); masks as boolean
+variables prefixed ``mask:``. Units travel as strings through the unit
+parser, falling back to dimensionless on unknown strings (the wire must
+never kill the service; reference behavior is per-message error
+containment, message_adapter.py:592-624).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ..utils.labeled import DataArray, Variable
+from ..utils.units import UnitError, unit as parse_unit
+from .wire import Da00Variable
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["dataarray_to_da00", "da00_to_dataarray"]
+
+_SIGNAL = "signal"
+_MASK_PREFIX = "mask:"
+
+
+def _safe_unit(s: str):
+    try:
+        return parse_unit(s)
+    except UnitError:
+        logger.warning("Unknown unit %r on wire; treating as dimensionless", s)
+        return parse_unit(None)
+
+
+def dataarray_to_da00(da: DataArray) -> list[Da00Variable]:
+    out = [
+        Da00Variable(
+            name=_SIGNAL,
+            unit=repr(da.unit),
+            axes=da.dims,
+            data=np.asarray(da.values),
+        )
+    ]
+    for name, coord in da.coords.items():
+        out.append(
+            Da00Variable(
+                name=name,
+                unit=repr(coord.unit),
+                axes=coord.dims,
+                data=coord.numpy,
+            )
+        )
+    for name, mask in da.masks.items():
+        out.append(
+            Da00Variable(
+                name=_MASK_PREFIX + name,
+                unit="",
+                axes=mask.dims,
+                data=mask.numpy.astype(np.uint8),
+            )
+        )
+    return out
+
+
+def da00_to_dataarray(variables: list[Da00Variable], name: str = "") -> DataArray:
+    signal = next((v for v in variables if v.name == _SIGNAL), None)
+    if signal is None:
+        raise ValueError("da00 payload has no 'signal' variable")
+    data = Variable(signal.data, signal.axes, _safe_unit(signal.unit))
+    coords = {}
+    masks = {}
+    for v in variables:
+        if v.name == _SIGNAL:
+            continue
+        if v.name.startswith(_MASK_PREFIX):
+            masks[v.name[len(_MASK_PREFIX) :]] = Variable(
+                v.data.astype(bool), v.axes, None
+            )
+        else:
+            coords[v.name] = Variable(v.data, v.axes, _safe_unit(v.unit))
+    return DataArray(data, coords=coords, masks=masks, name=name)

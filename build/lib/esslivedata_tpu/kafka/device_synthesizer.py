@@ -1,0 +1,122 @@
+"""Synthesize merged per-device streams from f144 motor substreams.
+
+A NICOS-style device (ADR 0001; reference ``kafka/device_synthesizer.py``)
+is spread over up to three raw f144 substreams: readback (RBV), setpoint
+(VAL), and moving/idle flag (DMOV). Workflows and the dashboard want one
+coherent stream per device instead. This module provides that as a
+``MessageSource`` decorator sitting after adaptation: raw substream
+messages claimed by a device are absorbed, and once the device has been
+observed on every substream it is configured with, each further raw sample
+produces one merged ``LogData`` sample on a synthetic
+``StreamKind.DEVICE`` stream.
+
+Merge semantics (the wire contract, shared with the reference):
+
+- emission is *union-anchored*: any claimed substream event triggers an
+  output sample, carrying the latest known value of every other role;
+- the merged sample is stamped ``max`` over the constituent sample times,
+  so it never predates data it includes;
+- batched f144 payloads (multiple samples in one ``LogData``) emit one
+  merged sample per raw sample — intermediate motor positions survive.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections.abc import Iterator, Mapping, Sequence
+
+from ..config.stream import Device
+from ..core.message import Message, MessageSource, StreamId, StreamKind
+from ..core.timestamp import Timestamp
+from ..preprocessors.to_nxlog import LogData
+
+__all__ = ["DeviceSynthesizer"]
+
+logger = logging.getLogger(__name__)
+
+
+class _Merger:
+    """Latest-known sample per role for one device, and the merge itself."""
+
+    __slots__ = ("_latest", "_required", "stream")
+
+    def __init__(self, device_name: str, required_roles: frozenset[str]) -> None:
+        self.stream = StreamId(kind=StreamKind.DEVICE, name=device_name)
+        self._required = required_roles
+        self._latest: dict[str, tuple[Timestamp, float]] = {}
+
+    def ingest(self, role: str, log: LogData) -> Iterator[Message[LogData]]:
+        """Fold raw samples in; yield merged samples once bootstrapped."""
+        for raw_ns, raw_value in log.samples():
+            self._latest[role] = (Timestamp.from_ns(int(raw_ns)), float(raw_value))
+            if self._required <= self._latest.keys():
+                yield self._merged()
+
+    def _merged(self) -> Message[LogData]:
+        stamp = max(t for t, _ in self._latest.values())
+        target = self._latest.get("target")
+        idle = self._latest.get("idle")
+        merged = LogData(
+            time=stamp.ns,
+            value=self._latest["value"][1],
+            target=None if target is None else target[1],
+            idle=None if idle is None else bool(idle[1]),
+        )
+        return Message(timestamp=stamp, stream=self.stream, value=merged)
+
+
+class DeviceSynthesizer:
+    """MessageSource decorator replacing raw substreams with device streams.
+
+    ``devices`` maps device name to its substream configuration; the
+    ``value`` substream is mandatory, ``target`` and ``idle`` optional.
+    A raw substream may be claimed by at most one device — a conflicting
+    configuration is rejected at construction, since silently routing one
+    substream into two devices would corrupt both.
+    """
+
+    def __init__(
+        self,
+        wrapped: MessageSource[Message],
+        *,
+        devices: Mapping[str, Device],
+    ) -> None:
+        self._wrapped = wrapped
+        # Routing: raw substream name -> (role, merger for the owning device).
+        self._claims: dict[str, tuple[str, _Merger]] = {}
+        for device_name, spec in devices.items():
+            roles = {"value": spec.value}
+            if spec.target is not None:
+                roles["target"] = spec.target
+            if spec.idle is not None:
+                roles["idle"] = spec.idle
+            merger = _Merger(device_name, frozenset(roles))
+            for role, substream in roles.items():
+                if substream in self._claims:
+                    rival = self._claims[substream][1].stream.name
+                    raise ValueError(
+                        f"devices {rival!r} and {device_name!r} both claim "
+                        f"substream {substream!r}; a raw substream may feed "
+                        "exactly one device"
+                    )
+                self._claims[substream] = (role, merger)
+
+    def get_messages(self) -> Sequence[Message]:
+        out: list[Message] = []
+        for msg in self._wrapped.get_messages():
+            claim = self._claims.get(msg.stream.name)
+            if claim is None:
+                out.append(msg)
+                continue
+            role, merger = claim
+            if isinstance(msg.value, LogData):
+                out.extend(merger.ingest(role, msg.value))
+            else:
+                logger.warning(
+                    "device substream %s (%s/%s) carried unexpected payload %s",
+                    msg.stream.name,
+                    merger.stream.name,
+                    role,
+                    type(msg.value).__name__,
+                )
+        return out

@@ -1,0 +1,40 @@
+"""Fatal-vs-retriable classification of transport errors.
+
+Parity with reference ``kafka/errors.py``: librdkafka-flagged fatal errors
+plus auth/misconfiguration codes crash the service (surfacing the problem to
+the operator) instead of silently retrying forever. Duck-typed so it works
+against real ``confluent_kafka.KafkaError`` objects and the fake error
+objects used in broker-free tests.
+"""
+
+from __future__ import annotations
+
+__all__ = ["FATAL_ERROR_NAMES", "is_fatal"]
+
+# librdkafka error-name strings treated as fatal in addition to errors the
+# library itself flags fatal. Auth failures retried in a loop just spam the
+# broker; crashing lets the supervisor (and the operator) see them.
+FATAL_ERROR_NAMES = frozenset(
+    {
+        "TOPIC_AUTHORIZATION_FAILED",
+        "GROUP_AUTHORIZATION_FAILED",
+        "CLUSTER_AUTHORIZATION_FAILED",
+        "SASL_AUTHENTICATION_FAILED",
+        "TRANSACTIONAL_ID_AUTHORIZATION_FAILED",
+    }
+)
+
+
+def is_fatal(err: object) -> bool:
+    """True if the error should crash the service rather than be retried.
+
+    Accepts any object with librdkafka's ``KafkaError`` shape (``fatal()``,
+    ``name()``); objects without that shape are treated as retriable.
+    """
+    fatal = getattr(err, "fatal", None)
+    if callable(fatal) and fatal():
+        return True
+    name = getattr(err, "name", None)
+    if callable(name):
+        return name() in FATAL_ERROR_NAMES
+    return False

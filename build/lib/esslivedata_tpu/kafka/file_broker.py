@@ -1,0 +1,223 @@
+"""File-backed broker: multi-process pub/sub without a Kafka deployment.
+
+The integration test layer (tests/integration/, reference
+tests/integration/backend.py) spawns real service subprocesses and a real
+dashboard process and needs a broker they can all reach. Docker is not
+available in every environment this runs in, so topics are append-only
+files in a shared directory:
+
+    <root>/<topic>.log     frames of [key_len u32][value_len u32][key][value]
+
+Appends happen under an exclusive ``flock`` and as a single ``write`` so
+concurrent producers interleave only at frame boundaries; consumers track
+a *byte* offset per topic and only surface complete frames, so a reader
+racing a writer sees the prefix. Offsets double as Kafka watermarks
+(low = 0, high = file size), which lets ``assign_all_partitions`` pin a
+restarted service at live data exactly as it does against a real broker.
+
+This is a test/dev transport: single partition per topic, no retention,
+no replication. The point is that every byte still crosses a process
+boundary through the same consumer/producer protocols the confluent
+client implements, so crash/restart/adoption scenarios exercise the real
+code paths.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import logging
+import struct
+import time
+from pathlib import Path
+
+__all__ = [
+    "FileBrokerConsumer",
+    "FileBrokerProducer",
+    "ensure_topics",
+]
+
+logger = logging.getLogger(__name__)
+
+_HEADER = struct.Struct("<II")
+
+
+def _topic_path(root: Path, topic: str) -> Path:
+    if "/" in topic or topic.startswith("."):
+        raise ValueError(f"Invalid topic name {topic!r}")
+    return root / f"{topic}.log"
+
+
+def ensure_topics(root: str | Path, topics) -> None:
+    """Create empty topic files (the broker-side 'create topics' admin op;
+    consumers validate topic existence at startup)."""
+    root = Path(root)
+    root.mkdir(parents=True, exist_ok=True)
+    for topic in topics:
+        _topic_path(root, topic).touch()
+
+
+class FileMessage:
+    """confluent_kafka.Message-shaped record."""
+
+    __slots__ = ("_topic", "_value", "_key")
+
+    def __init__(self, topic: str, value: bytes, key: bytes | None) -> None:
+        self._topic = topic
+        self._value = value
+        self._key = key
+
+    def topic(self) -> str:
+        return self._topic
+
+    def value(self) -> bytes:
+        return self._value
+
+    def key(self) -> bytes | None:
+        return self._key
+
+    def error(self):
+        return None
+
+
+class FileBrokerProducer:
+    def __init__(self, root: str | Path) -> None:
+        self._root = Path(root)
+        self._root.mkdir(parents=True, exist_ok=True)
+
+    def produce(self, topic: str, value: bytes, key=None) -> None:
+        if isinstance(key, str):
+            key = key.encode()
+        frame = (
+            _HEADER.pack(len(key or b""), len(value))
+            + (key or b"")
+            + value
+        )
+        path = _topic_path(self._root, topic)
+        with open(path, "ab") as f:
+            fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+            try:
+                f.write(frame)
+                f.flush()
+            finally:
+                fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+
+    def poll(self, timeout: float = 0.0) -> int:
+        return 0
+
+    def flush(self, timeout: float = 0.0) -> int:
+        return 0
+
+
+class _TopicMeta:
+    def __init__(self) -> None:
+        self.partitions = {0: object()}
+
+
+class _Metadata:
+    def __init__(self, names) -> None:
+        self.topics = {name: _TopicMeta() for name in names}
+
+
+class FileBrokerConsumer:
+    """Both halves of the consumer surface: the assignment handshake
+    (list_topics/get_watermark_offsets/assign) and the consume loop."""
+
+    def __init__(self, root: str | Path) -> None:
+        self._root = Path(root)
+        # topic -> next byte offset to read
+        self._offsets: dict[str, int] = {}
+        # round-robin cursor over topics (see _consume_once)
+        self._rr = 0
+
+    # -- assignment surface ------------------------------------------------
+    def list_topics(self, timeout: float = 0.0) -> _Metadata:
+        return _Metadata(
+            p.stem for p in sorted(self._root.glob("*.log"))
+        )
+
+    def get_watermark_offsets(
+        self, partition, timeout: float = 0.0
+    ) -> tuple[int, int]:
+        path = _topic_path(self._root, partition.topic)
+        return (0, path.stat().st_size if path.exists() else 0)
+
+    def assign(self, partitions) -> None:
+        for tp in partitions:
+            offset = getattr(tp, "offset", -1)
+            if offset is None or offset < 0:
+                offset = 0
+            self._offsets[tp.topic] = offset
+
+    def subscribe(self, topics) -> None:
+        """Subscribe-at-end (the dashboard's live-data semantics)."""
+        for topic in topics:
+            path = _topic_path(self._root, topic)
+            self._offsets[topic] = (
+                path.stat().st_size if path.exists() else 0
+            )
+
+    # -- consume loop ------------------------------------------------------
+    def consume(self, num_messages: int, timeout: float = 0.0):
+        out = self._consume_once(num_messages)
+        if not out and timeout > 0:
+            # Honor the blocking contract the confluent client has: the
+            # service consume thread loops on consume() with no sleep of
+            # its own, so returning instantly on empty would busy-spin a
+            # core per service doing stat() calls.
+            time.sleep(timeout)
+            out = self._consume_once(num_messages)
+        return out
+
+    def _consume_once(self, num_messages: int) -> list[FileMessage]:
+        out: list[FileMessage] = []
+        # Rotate the starting topic across calls: with a fixed order, a
+        # sustained high-volume first topic (detector data) would fill the
+        # whole budget every call and starve status/command topics.
+        topics = list(self._offsets)
+        if not topics:
+            return out
+        self._rr %= len(topics)
+        order = topics[self._rr:] + topics[: self._rr]
+        self._rr = (self._rr + 1) % len(topics)
+        for topic in order:
+            if len(out) >= num_messages:
+                break
+            out.extend(
+                self._read_topic(topic, num_messages - len(out))
+            )
+        return out
+
+    def _read_topic(self, topic: str, limit: int) -> list[FileMessage]:
+        path = _topic_path(self._root, topic)
+        try:
+            size = path.stat().st_size
+        except FileNotFoundError:
+            return []
+        offset = self._offsets.get(topic, 0)
+        if size <= offset:
+            return []
+        out: list[FileMessage] = []
+        with open(path, "rb") as f:
+            f.seek(offset)
+            while len(out) < limit:
+                header = f.read(_HEADER.size)
+                if len(header) < _HEADER.size:
+                    break
+                key_len, value_len = _HEADER.unpack(header)
+                payload = f.read(key_len + value_len)
+                if len(payload) < key_len + value_len:
+                    # Partial frame: a writer is mid-append; retry later.
+                    break
+                out.append(
+                    FileMessage(
+                        topic,
+                        payload[key_len:],
+                        payload[:key_len] or None,
+                    )
+                )
+                offset = f.tell()
+        self._offsets[topic] = offset
+        return out
+
+    def close(self) -> None:
+        self._offsets.clear()

@@ -1,0 +1,440 @@
+"""Wire -> domain adapters with routing combinators.
+
+Parity with reference ``kafka/message_adapter.py``: one adapter per wire
+schema (KafkaToEv44Adapter:196, KafkaToDa00Adapter:238, KafkaToF144Adapter:
+255, KafkaToAd00Adapter:457, monitor fast path:360, run-control:325,
+commands:484), combinators (ChainedAdapter:503, RouteBySchemaAdapter:516,
+RouteByTopicAdapter:539) and ``AdaptingMessageSource`` (:562) with
+*per-message* error containment — one hostile payload must never kill the
+service (exercised by the hostile-wire tests, SURVEY.md section 4.3).
+
+Message timestamps follow the reference convention: ev44 uses
+``reference_time[-1]``; f144/da00/ad00 use their payload timestamp.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from collections.abc import Mapping, Sequence
+from typing import Any, Protocol, runtime_checkable
+
+import numpy as np
+
+from ..config.workflow_spec import WorkflowConfig
+from ..core.message import Message, RunStart, RunStop, StreamId, StreamKind
+from ..core.timestamp import Timestamp
+from ..preprocessors.event_data import DetectorEvents, MonitorEvents
+from ..preprocessors.to_nxlog import LogData
+from . import wire
+from .da00_compat import da00_to_dataarray
+from .source import KafkaMessage
+from .stream_mapping import (
+    MERGED_DETECTOR_STREAM,
+    InputStreamKey,
+    StreamMapping,
+)
+
+#: Stream kinds whose message timestamp is a production time, making
+#: wall-clock-minus-timestamp a meaningful producer lag.
+_LAG_TRACKED_KINDS = frozenset(
+    {
+        StreamKind.DETECTOR_EVENTS,
+        StreamKind.MONITOR_EVENTS,
+        StreamKind.MONITOR_COUNTS,
+        StreamKind.AREA_DETECTOR,
+        StreamKind.LOG,
+        StreamKind.DEVICE,
+    }
+)
+
+__all__ = [
+    "AdaptingMessageSource",
+    "ChainedAdapter",
+    "CommandsAdapter",
+    "KafkaToAd00Adapter",
+    "KafkaToDa00Adapter",
+    "KafkaToDetectorEventsAdapter",
+    "KafkaToF144Adapter",
+    "KafkaToMonitorEventsAdapter",
+    "KafkaToRunControlAdapter",
+    "MessageAdapter",
+    "NullAdapter",
+    "RouteBySchemaAdapter",
+    "RouteByTopicAdapter",
+]
+
+logger = logging.getLogger(__name__)
+
+
+@runtime_checkable
+class MessageAdapter(Protocol):
+    def adapt(self, message: KafkaMessage) -> Message | list[Message] | None: ...
+
+
+class UnroutedError(KeyError):
+    """No route/stream mapping for a message."""
+
+
+class NullAdapter:
+    """Deliberate drop: the schema is known, expected on the topic, and
+    carries nothing we consume (reference: kafka/message_adapter.py:130).
+
+    Returning None (instead of raising UnroutedError) keeps expected
+    traffic — e.g. EPICS alarm/connection chatter interleaved with f144
+    on forwarder log topics — out of the unrouted-anomaly counter.
+    """
+
+    def adapt(self, message: KafkaMessage) -> None:
+        return None
+
+
+def _resolve(
+    lut: Mapping[InputStreamKey, str], topic: str, source: str
+) -> str | None:
+    return lut.get(InputStreamKey(topic=topic, source_name=source))
+
+
+class KafkaToDetectorEventsAdapter:
+    """ev44 -> Message[DetectorEvents] with (topic, source) -> stream name."""
+
+    def __init__(self, mapping: StreamMapping, *, merge_detectors: bool = False):
+        self._mapping = mapping
+        self._merge = merge_detectors
+
+    def adapt(self, message: KafkaMessage) -> Message | None:
+        ev = wire.decode_ev44(message.value())
+        name = _resolve(self._mapping.detectors, message.topic(), ev.source_name)
+        if name is None:
+            return None
+        if self._merge:
+            # All banks onto one logical stream (bifrost pattern).
+            name = MERGED_DETECTOR_STREAM
+        ts = (
+            Timestamp.from_ns(int(ev.reference_time[-1]))
+            if ev.reference_time.size
+            else Timestamp.now()
+        )
+        return Message(
+            timestamp=ts,
+            stream=StreamId(kind=StreamKind.DETECTOR_EVENTS, name=name),
+            value=DetectorEvents(
+                pixel_id=ev.pixel_id,
+                time_of_arrival=ev.time_of_flight.astype(np.float32),
+            ),
+        )
+
+
+class KafkaToMonitorEventsAdapter:
+    """ev44 fast path for monitors: skips the pixel_id field entirely
+    (reference message_adapter.py:360) — EXCEPT for monitors registered
+    as pixellated (reference instrument.py:401), whose per-pixel event
+    ids are meaningful and ride through as a DetectorEvents payload so a
+    2-D monitor view can consume them. The stream kind stays
+    MONITOR_EVENTS either way (routing and job dispatch are by kind +
+    name; the payload type carries the pixel ids)."""
+
+    def __init__(self, mapping: StreamMapping):
+        self._mapping = mapping
+
+    def adapt(self, message: KafkaMessage) -> Message | None:
+        ev = wire.decode_ev44(message.value())
+        name = _resolve(self._mapping.monitors, message.topic(), ev.source_name)
+        if name is None:
+            return None
+        ts = (
+            Timestamp.from_ns(int(ev.reference_time[-1]))
+            if ev.reference_time.size
+            else Timestamp.now()
+        )
+        if (
+            name in self._mapping.pixellated_monitors
+            and ev.pixel_id.size == ev.time_of_flight.size
+            and ev.pixel_id.size > 0
+        ):
+            value = DetectorEvents(
+                pixel_id=ev.pixel_id,
+                time_of_arrival=ev.time_of_flight.astype(np.float32),
+            )
+        else:
+            # Plain monitors — and pixellated ones whose producer omitted
+            # ids (standard monitor ev44 carries an empty pixel_id
+            # vector): the id-skipping fast path. An empty-id message
+            # must NOT become DetectorEvents, or staging would size the
+            # append by len(pixel_id)=0 and silently drop every event.
+            value = MonitorEvents(
+                time_of_arrival=ev.time_of_flight.astype(np.float32)
+            )
+        return Message(
+            timestamp=ts,
+            stream=StreamId(kind=StreamKind.MONITOR_EVENTS, name=name),
+            value=value,
+        )
+
+
+class KafkaToDa00Adapter:
+    """da00 -> Message[DataArray]; also used for histogram-mode monitors."""
+
+    def __init__(
+        self,
+        mapping: StreamMapping,
+        *,
+        lut: str = "monitors",
+        kind: StreamKind = StreamKind.MONITOR_COUNTS,
+    ):
+        self._mapping = mapping
+        self._lut_name = lut
+        self._kind = kind
+
+    def adapt(self, message: KafkaMessage) -> Message | None:
+        da00 = wire.decode_da00(message.value())
+        lut = getattr(self._mapping, self._lut_name)
+        name = _resolve(lut, message.topic(), da00.source_name)
+        if name is None:
+            return None
+        da = da00_to_dataarray(da00.variables, name=da00.source_name)
+        return Message(
+            timestamp=Timestamp.from_ns(da00.timestamp_ns),
+            stream=StreamId(kind=self._kind, name=name),
+            value=da,
+        )
+
+
+class KafkaToF144Adapter:
+    """f144 -> Message[LogData]."""
+
+    def __init__(self, mapping: StreamMapping):
+        self._mapping = mapping
+
+    def adapt(self, message: KafkaMessage) -> Message | None:
+        f = wire.decode_f144(message.value())
+        name = _resolve(self._mapping.logs, message.topic(), f.source_name)
+        if name is None:
+            name = f.source_name  # logs default to source name (open set)
+        value = f.value if f.value.size != 1 else f.value[0]
+        return Message(
+            timestamp=Timestamp.from_ns(f.timestamp_ns),
+            stream=StreamId(kind=StreamKind.LOG, name=name),
+            value=LogData(time=f.timestamp_ns, value=value),
+        )
+
+
+class KafkaToAd00Adapter:
+    """ad00 -> Message[DataArray] (2-D camera frame)."""
+
+    def __init__(self, mapping: StreamMapping):
+        self._mapping = mapping
+
+    def adapt(self, message: KafkaMessage) -> Message | None:
+        img = wire.decode_ad00(message.value())
+        name = _resolve(
+            self._mapping.area_detectors, message.topic(), img.source_name
+        )
+        if name is None:
+            return None
+        from ..utils.labeled import DataArray, Variable
+
+        if img.data.ndim != 2:
+            raise wire.WireError(f"ad00 image must be 2-D, got {img.data.shape}")
+        da = DataArray(
+            Variable(img.data, ("y", "x"), "counts"), name=img.source_name
+        )
+        return Message(
+            timestamp=Timestamp.from_ns(img.timestamp_ns),
+            stream=StreamId(kind=StreamKind.AREA_DETECTOR, name=name),
+            value=da,
+        )
+
+
+class KafkaToRunControlAdapter:
+    """pl72/6s4t -> Message[RunStart|RunStop]."""
+
+    def adapt(self, message: KafkaMessage) -> Message | None:
+        buf = message.value()
+        schema = wire.get_schema(buf)
+        if schema == "pl72":
+            start = wire.decode_pl72(buf)
+            return Message(
+                timestamp=Timestamp.from_ns(start.start_time_ns),
+                stream=StreamId(kind=StreamKind.RUN_CONTROL, name=""),
+                value=RunStart(
+                    run_name=start.run_name,
+                    start_time=Timestamp.from_ns(start.start_time_ns),
+                    stop_time=(
+                        Timestamp.from_ns(start.stop_time_ns)
+                        if start.stop_time_ns
+                        else None
+                    ),
+                ),
+            )
+        if schema == "6s4t":
+            stop = wire.decode_6s4t(buf)
+            return Message(
+                timestamp=Timestamp.from_ns(stop.stop_time_ns),
+                stream=StreamId(kind=StreamKind.RUN_CONTROL, name=""),
+                value=RunStop(
+                    run_name=stop.run_name,
+                    stop_time=Timestamp.from_ns(stop.stop_time_ns),
+                ),
+            )
+        raise wire.WireError(f"Unexpected run-control schema {schema!r}")
+
+
+class CommandsAdapter:
+    """JSON commands topic -> Message[WorkflowConfig | dict].
+
+    Payload: {"kind": "start_job", "config": {...WorkflowConfig...}} or
+    {"kind": "job_command", "command": "stop"|"remove"|"reset", "job_id":
+    {...}} (the job-command model lives in core/job_manager)."""
+
+    def adapt(self, message: KafkaMessage) -> Message | None:
+        payload = json.loads(message.value().decode("utf-8"))
+        kind = payload.get("kind")
+        if kind == "start_job":
+            value: Any = WorkflowConfig.model_validate(payload["config"])
+        elif kind in ("job_command", "roi_update"):
+            value = payload
+        else:
+            raise ValueError(f"Unknown command kind {kind!r}")
+        return Message(
+            timestamp=Timestamp.now(),
+            stream=StreamId(kind=StreamKind.LIVEDATA_COMMANDS, name=""),
+            value=value,
+        )
+
+
+class ChainedAdapter:
+    def __init__(self, first: MessageAdapter, second: MessageAdapter) -> None:
+        self._first = first
+        self._second = second
+
+    def adapt(self, message):
+        mid = self._first.adapt(message)
+        if mid is None:
+            return None
+        return self._second.adapt(mid)
+
+
+class RouteBySchemaAdapter:
+    """Dispatch on the flatbuffer file identifier."""
+
+    def __init__(self, routes: Mapping[str, MessageAdapter]) -> None:
+        self._routes = dict(routes)
+
+    def adapt(self, message: KafkaMessage):
+        schema = wire.get_schema(message.value())
+        adapter = self._routes.get(schema)
+        if adapter is None:
+            raise UnroutedError(f"No adapter for schema {schema!r}")
+        return adapter.adapt(message)
+
+
+class RouteByTopicAdapter:
+    """Dispatch on the Kafka topic."""
+
+    def __init__(self, routes: Mapping[str, MessageAdapter]) -> None:
+        self._routes = dict(routes)
+
+    @property
+    def topics(self) -> list[str]:
+        return sorted(self._routes)
+
+    def adapt(self, message: KafkaMessage):
+        adapter = self._routes.get(message.topic())
+        if adapter is None:
+            raise UnroutedError(f"No adapter for topic {message.topic()!r}")
+        return adapter.adapt(message)
+
+
+class AdaptingMessageSource:
+    """Source combinator: raw KafkaMessages -> domain Messages with
+    per-message error containment and drop accounting."""
+
+    def __init__(
+        self,
+        source,
+        adapter: MessageAdapter,
+        *,
+        raise_on_error: bool = False,
+        stream_counter=None,
+    ) -> None:
+        self._source = source
+        self._adapter = adapter
+        self._raise = raise_on_error
+        self._counter = stream_counter
+        self.error_count = 0
+        self.unrouted_count = 0
+
+    @staticmethod
+    def _raw_source_name(raw) -> str:
+        """Best-effort source identity of an unmapped raw message: the Kafka
+        key when present (ECDC keys messages by source), else unknown."""
+        key = getattr(raw, "key", None)
+        if callable(key):
+            k = key()
+            if k:
+                return k.decode(errors="replace") if isinstance(k, bytes) else str(k)
+        return "<unknown>"
+
+    def _count(self, raw, adapted) -> None:
+        """Fold one mapped/unmapped/dropped message into the StreamCounter
+        (drained by the processor on the 30 s metrics rollover)."""
+        topic = getattr(raw, "topic", lambda: "?")()
+        if adapted is None:
+            # Deliberately dropped (e.g. unsubscribed source on a routed
+            # topic): counted under its raw source identity so the operator
+            # can see what is being filtered.
+            self._counter.record(topic, self._raw_source_name(raw), None)
+            return
+        msgs = (
+            adapted
+            if isinstance(adapted, Sequence) and not isinstance(adapted, Message)
+            else [adapted]
+        )
+        for m in msgs:
+            self._counter.record(topic, m.stream.name, m.stream.name)
+            # Producer lag only makes sense for data-plane payloads whose
+            # timestamp is a production time; run-control/command timestamps
+            # are schedule times, possibly far in the past by design.
+            if m.stream.kind in _LAG_TRACKED_KINDS:
+                self._counter.record_lag(
+                    topic,
+                    m.stream.name,
+                    m.stream.kind.value,
+                    (time.time_ns() - m.timestamp.ns) / 1e9,
+                )
+
+    def get_messages(self) -> list[Message]:
+        out: list[Message] = []
+        for raw in self._source.get_messages():
+            try:
+                adapted = self._adapter.adapt(raw)
+            except UnroutedError as err:
+                self.unrouted_count += 1
+                if self._counter is not None:
+                    self._counter.record(
+                        getattr(raw, "topic", lambda: "?")(),
+                        self._raw_source_name(raw),
+                        None,
+                    )
+                logger.debug("Unrouted message: %s", err)
+                continue
+            except Exception:
+                self.error_count += 1
+                logger.exception(
+                    "Failed to adapt message on topic %s",
+                    getattr(raw, "topic", lambda: "?")(),
+                )
+                if self._raise:
+                    raise
+                continue
+            if self._counter is not None:
+                self._count(raw, adapted)
+            if adapted is None:
+                continue
+            if isinstance(adapted, Sequence) and not isinstance(adapted, Message):
+                out.extend(adapted)
+            else:
+                out.append(adapted)
+        return out

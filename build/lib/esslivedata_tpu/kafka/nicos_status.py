@@ -1,0 +1,277 @@
+"""NICOS-compatible x5f2 status envelopes.
+
+NICOS (the ESS instrument-control system) monitors livedata services and
+jobs through x5f2 status messages whose ``status_json`` carries a NICOS
+daemon status *code* plus a typed payload, and whose ``service_id``
+encodes what is being monitored (contract studied from the reference
+repo scipp/esslivedata, src/ess/livedata/kafka/x5f2_compat.py:93-487;
+SURVEY §2.10 names NICOS interop a wire-compatibility requirement). This module owns that mapping for both
+directions:
+
+- **Codes**: the NICOS daemon status constants (OK=200 ... UNKNOWN=999),
+  derived from our service/job states so a NICOS panel colors a livedata
+  job exactly like any beamline device.
+- **Identities**: ``instrument:service_name:worker`` for services,
+  ``source_name:job_number`` for jobs — stable addressing a NICOS cache
+  can key on.
+- **Envelopes**: ``status_json = {"status": <code>, "message": {...}}``
+  with a ``message_type`` discriminator (``service`` | ``job``) so one
+  topic carries both kinds; payloads are our own status models.
+
+Decoding accepts the enveloped form and the legacy bare-``ServiceStatus``
+JSON, so an upgraded dashboard keeps working against not-yet-upgraded
+services. (The reverse — an old dashboard against new services — needs
+the dashboard upgraded first; the envelope is what NICOS consumes, so
+producers cannot stay on the bare form.)
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from enum import IntEnum
+
+from pydantic import BaseModel, Field
+
+from ..core.job import JobState, JobStatus, ServiceStatus
+from . import wire
+
+__all__ = [
+    "JobIdentity",
+    "NicosStatus",
+    "ServiceIdentity",
+    "decode_status",
+    "job_state_code",
+    "job_status_to_x5f2",
+    "service_code",
+    "service_state_code",
+    "service_status_envelope",
+    "service_status_to_x5f2",
+    "worst_status",
+]
+
+
+class NicosStatus(IntEnum):
+    """NICOS daemon status constants (the public NICOS device states)."""
+
+    OK = 200
+    WARNING = 210
+    BUSY = 220
+    NOTREACHED = 230
+    DISABLED = 235
+    ERROR = 240
+    UNKNOWN = 999
+
+
+_JOB_STATE_CODES: dict[JobState, NicosStatus] = {
+    # A scheduled job is "moving into position": BUSY, not an error.
+    JobState.SCHEDULED: NicosStatus.BUSY,
+    # Gated on context: operable but degraded until the context arrives.
+    JobState.PENDING_CONTEXT: NicosStatus.WARNING,
+    JobState.ACTIVE: NicosStatus.OK,
+    JobState.FINISHING: NicosStatus.OK,
+    JobState.WARNING: NicosStatus.WARNING,
+    JobState.ERROR: NicosStatus.ERROR,
+    JobState.STOPPED: NicosStatus.DISABLED,
+}
+
+
+def job_state_code(state: JobState) -> NicosStatus:
+    return _JOB_STATE_CODES.get(state, NicosStatus.UNKNOWN)
+
+
+_SERVICE_STATE_CODES: dict[str, NicosStatus] = {
+    "starting": NicosStatus.BUSY,
+    "running": NicosStatus.OK,
+    "stopping": NicosStatus.DISABLED,
+    "stopped": NicosStatus.DISABLED,
+    "error": NicosStatus.ERROR,
+}
+
+
+def service_state_code(state: str) -> NicosStatus:
+    return _SERVICE_STATE_CODES.get(state, NicosStatus.UNKNOWN)
+
+
+#: Severity order for aggregation (a service heartbeat reports the worst
+#: of its own state and its jobs' states — one glance tells NICOS whether
+#: anything under this service needs attention).
+_SEVERITY = [
+    NicosStatus.OK,
+    NicosStatus.BUSY,
+    NicosStatus.NOTREACHED,
+    NicosStatus.DISABLED,
+    NicosStatus.WARNING,
+    NicosStatus.ERROR,
+    NicosStatus.UNKNOWN,
+]
+
+
+def worst_status(codes) -> NicosStatus:
+    codes = list(codes)
+    if not codes:
+        return NicosStatus.OK
+    return max(codes, key=_SEVERITY.index)
+
+
+# -- identities --------------------------------------------------------------
+
+
+class ServiceIdentity(BaseModel, frozen=True):
+    """``instrument:service_name:worker`` — one running service process."""
+
+    instrument: str
+    service_name: str
+    worker: str = ""
+
+    @classmethod
+    def parse(cls, raw: str) -> "ServiceIdentity":
+        parts = raw.split(":")
+        if len(parts) < 2:
+            raise ValueError(
+                f"service identity {raw!r} is not instrument:service[:worker]"
+            )
+        return cls(
+            instrument=parts[0],
+            service_name=parts[1],
+            worker=":".join(parts[2:]),
+        )
+
+    def render(self) -> str:
+        return f"{self.instrument}:{self.service_name}:{self.worker}"
+
+
+class JobIdentity(BaseModel, frozen=True):
+    """``source_name:job_number`` — one job, across restarts of anything."""
+
+    source_name: str
+    job_number: uuid.UUID
+
+    @classmethod
+    def parse(cls, raw: str) -> "JobIdentity":
+        source, sep, number = raw.rpartition(":")
+        if not sep:
+            raise ValueError(f"job identity {raw!r} is not source:job_number")
+        return cls(source_name=source, job_number=uuid.UUID(number))
+
+    def render(self) -> str:
+        return f"{self.source_name}:{self.job_number}"
+
+
+# -- envelopes ---------------------------------------------------------------
+
+
+class ServicePayload(BaseModel):
+    message_type: str = "service"
+    status: ServiceStatus
+
+
+class JobPayload(BaseModel):
+    message_type: str = "job"
+    status: JobStatus
+
+
+class StatusEnvelope(BaseModel):
+    """The ``status_json`` document: NICOS code + typed payload."""
+
+    status: NicosStatus
+    message: dict = Field(default_factory=dict)
+
+
+def service_code(status: ServiceStatus) -> NicosStatus:
+    """Aggregate code of a service document: worst of its own state and
+    its jobs' states (shared by encoding and legacy decoding)."""
+    return worst_status(
+        [service_state_code(status.state)]
+        + [job_state_code(j.state) for j in status.jobs]
+    )
+
+
+def service_status_envelope(status: ServiceStatus) -> str:
+    code = service_code(status)
+    return StatusEnvelope(
+        status=code,
+        message=ServicePayload(status=status).model_dump(mode="json"),
+    ).model_dump_json()
+
+
+def _job_envelope(status: JobStatus) -> str:
+    return StatusEnvelope(
+        status=job_state_code(status.state),
+        message=JobPayload(status=status).model_dump(mode="json"),
+    ).model_dump_json()
+
+
+def service_status_to_x5f2(
+    status: ServiceStatus,
+    *,
+    worker: str = "",
+    software_version: str = "0.1.0",
+    host_name: str = "",
+    process_id: int = 0,
+    update_interval_ms: int = 2000,
+) -> bytes:
+    """Full wire form of a service heartbeat a NICOS consumer accepts."""
+    return wire.encode_x5f2(
+        wire.X5f2Status(
+            software_name="esslivedata-tpu",
+            software_version=software_version,
+            service_id=ServiceIdentity(
+                instrument=status.instrument,
+                service_name=status.service_name,
+                worker=worker,
+            ).render(),
+            host_name=host_name,
+            process_id=process_id,
+            update_interval_ms=update_interval_ms,
+            status_json=service_status_envelope(status),
+        )
+    )
+
+
+def job_status_to_x5f2(
+    status: JobStatus,
+    *,
+    software_version: str = "0.1.0",
+    host_name: str = "",
+    process_id: int = 0,
+    update_interval_ms: int = 2000,
+) -> bytes:
+    """Per-job heartbeat: service_id addresses the job itself."""
+    return wire.encode_x5f2(
+        wire.X5f2Status(
+            software_name="esslivedata-tpu",
+            software_version=software_version,
+            service_id=JobIdentity(
+                source_name=status.source_name,
+                job_number=status.job_number,
+            ).render(),
+            host_name=host_name,
+            process_id=process_id,
+            update_interval_ms=update_interval_ms,
+            status_json=_job_envelope(status),
+        )
+    )
+
+
+def decode_status(payload: bytes):
+    """Decode an x5f2 status message.
+
+    Returns ``(code, ServiceStatus | JobStatus, service_id)``. Accepts the
+    enveloped form (``message_type`` discriminated) and the legacy bare
+    ``ServiceStatus`` JSON (code derived from its state).
+    """
+    status = wire.decode_x5f2(payload)
+    doc = json.loads(status.status_json)
+    if "message" in doc and isinstance(doc.get("message"), dict):
+        message = doc["message"]
+        kind = message.get("message_type")
+        code = NicosStatus(doc.get("status", NicosStatus.UNKNOWN))
+        if kind == "service":
+            return code, ServiceStatus.model_validate(message["status"]), status.service_id
+        if kind == "job":
+            return code, JobStatus.model_validate(message["status"]), status.service_id
+        raise ValueError(f"Unknown status message_type {kind!r}")
+    # Legacy bare ServiceStatus heartbeat.
+    parsed = ServiceStatus.model_validate(doc)
+    return service_code(parsed), parsed, status.service_id

@@ -1,0 +1,104 @@
+"""Per-service routing assembly (reference: kafka/routes.py:33
+RoutingAdapterBuilder): fluent construction of the topic -> schema ->
+adapter tree each service consumes."""
+
+from __future__ import annotations
+
+from .message_adapter import (
+    CommandsAdapter,
+    KafkaToAd00Adapter,
+    KafkaToDa00Adapter,
+    KafkaToDetectorEventsAdapter,
+    KafkaToF144Adapter,
+    KafkaToMonitorEventsAdapter,
+    KafkaToRunControlAdapter,
+    MessageAdapter,
+    NullAdapter,
+    RouteBySchemaAdapter,
+    RouteByTopicAdapter,
+)
+from .stream_mapping import StreamMapping
+
+__all__ = ["RoutingAdapterBuilder"]
+
+
+class RoutingAdapterBuilder:
+    def __init__(self, *, stream_mapping: StreamMapping) -> None:
+        self._mapping = stream_mapping
+        self._routes: dict[str, MessageAdapter] = {}
+
+    def _add_topics(self, topics, adapter: MessageAdapter) -> None:
+        for topic in topics:
+            existing = self._routes.get(topic)
+            if isinstance(existing, RouteBySchemaAdapter):
+                raise ValueError(f"Topic {topic} already routed")
+            self._routes[topic] = adapter
+
+    def with_detector_route(self, *, merge_detectors: bool = False):
+        self._add_topics(
+            self._mapping.detector_topics,
+            RouteBySchemaAdapter(
+                {
+                    "ev44": KafkaToDetectorEventsAdapter(
+                        self._mapping, merge_detectors=merge_detectors
+                    )
+                }
+            ),
+        )
+        return self
+
+    def with_monitor_route(self):
+        self._add_topics(
+            self._mapping.monitor_topics,
+            RouteBySchemaAdapter(
+                {
+                    "ev44": KafkaToMonitorEventsAdapter(self._mapping),
+                    "da00": KafkaToDa00Adapter(self._mapping),
+                }
+            ),
+        )
+        return self
+
+    def with_area_detector_route(self):
+        self._add_topics(
+            self._mapping.area_detector_topics,
+            RouteBySchemaAdapter({"ad00": KafkaToAd00Adapter(self._mapping)}),
+        )
+        return self
+
+    def with_logdata_route(self):
+        # Forwarder log topics interleave f144 numeric data with al00
+        # (alarm) and ep01 (connection status) for the same PVs
+        # (reference: kafka/routes.py:103-121); those are expected
+        # traffic, dropped deliberately rather than counted unrouted.
+        self._add_topics(
+            self._mapping.log_topics,
+            RouteBySchemaAdapter(
+                {
+                    "f144": KafkaToF144Adapter(self._mapping),
+                    "al00": NullAdapter(),
+                    "ep01": NullAdapter(),
+                }
+            ),
+        )
+        return self
+
+    def with_run_control_route(self):
+        self._add_topics(
+            self._mapping.run_control_topics,
+            RouteBySchemaAdapter(
+                {
+                    "pl72": KafkaToRunControlAdapter(),
+                    "6s4t": KafkaToRunControlAdapter(),
+                }
+            ),
+        )
+        return self
+
+    def with_commands_route(self):
+        self._routes[self._mapping.livedata.commands] = CommandsAdapter()
+        self._routes[self._mapping.livedata.roi] = CommandsAdapter()
+        return self
+
+    def build(self) -> RouteByTopicAdapter:
+        return RouteByTopicAdapter(self._routes)

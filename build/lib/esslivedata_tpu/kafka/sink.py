@@ -1,0 +1,239 @@
+"""Sinks + per-type serializers.
+
+Parity with reference ``kafka/sink.py`` (KafkaSink:53, MessageSerializer:40,
+drop-on-BufferError backpressure :110-118, UnrollingSinkAdapter:179) and
+``kafka/sink_serializers.py`` (results->da00:78, logs->f144:95,
+status->x5f2:108, commands/acks->JSON:160-182). Serialization errors are
+contained per message; producer buffer-full drops the message rather than
+blocking the hot loop.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+from collections.abc import Sequence
+from dataclasses import dataclass
+from typing import Protocol, runtime_checkable
+
+import numpy as np
+from pydantic import BaseModel
+
+from ..core.message import Message, StreamKind
+from ..preprocessors.to_nxlog import LogData
+from ..utils.labeled import DataArray
+from . import wire
+from .da00_compat import dataarray_to_da00
+from .stream_mapping import LivedataTopics
+
+__all__ = [
+    "FakeProducer",
+    "KafkaProducer",
+    "KafkaSink",
+    "MessageSerializer",
+    "SerializedMessage",
+    "UnrollingSinkAdapter",
+    "make_default_serializer",
+]
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True, slots=True)
+class SerializedMessage:
+    topic: str
+    value: bytes
+    key: bytes | None = None
+
+
+@runtime_checkable
+class MessageSerializer(Protocol):
+    def serialize(self, message: Message) -> SerializedMessage: ...
+
+
+@runtime_checkable
+class KafkaProducer(Protocol):
+    def produce(self, topic: str, value: bytes, key: bytes | None = None) -> None: ...
+
+    def flush(self, timeout: float = 0.0) -> None: ...
+
+
+class FakeProducer:
+    """In-memory producer double; can simulate a full buffer."""
+
+    def __init__(self, *, buffer_errors: int = 0) -> None:
+        self.messages: list[SerializedMessage] = []
+        self._buffer_errors = buffer_errors
+
+    def produce(self, topic: str, value: bytes, key: bytes | None = None) -> None:
+        if self._buffer_errors > 0:
+            self._buffer_errors -= 1
+            raise BufferError("queue full")
+        self.messages.append(SerializedMessage(topic=topic, value=value, key=key))
+
+    def flush(self, timeout: float = 0.0) -> None:
+        pass
+
+
+class DefaultSerializer:
+    """Routes by StreamKind + payload type to the right wire format."""
+
+    def __init__(self, topics: LivedataTopics, service_id: str = "") -> None:
+        self._topics = topics
+        self._service_id = service_id
+
+    def serialize(self, message: Message) -> SerializedMessage:
+        kind = message.stream.kind
+        value = message.value
+        ts = message.timestamp.ns
+        name = message.stream.name
+        if kind in (StreamKind.LIVEDATA_DATA,) and isinstance(value, DataArray):
+            return SerializedMessage(
+                topic=self._topics.data,
+                value=wire.encode_da00(name, ts, dataarray_to_da00(value)),
+                key=name.encode(),
+            )
+        if kind == StreamKind.LIVEDATA_NICOS_DATA:
+            if isinstance(value, LogData):
+                return SerializedMessage(
+                    topic=self._topics.nicos,
+                    value=wire.encode_f144(name, value.value, int(value.time[-1])),
+                    key=name.encode(),
+                )
+            if isinstance(value, DataArray):
+                # Contracted device outputs (core/nicos_devices.py): da00
+                # keyed by stable device name; the start_time coord rides
+                # along as the generation change-detector.
+                return SerializedMessage(
+                    topic=self._topics.nicos,
+                    value=wire.encode_da00(name, ts, dataarray_to_da00(value)),
+                    key=name.encode(),
+                )
+            return SerializedMessage(
+                topic=self._topics.nicos,
+                value=wire.encode_f144(name, np.asarray(value), ts),
+                key=name.encode(),
+            )
+        if kind == StreamKind.LIVEDATA_STATUS and isinstance(value, BaseModel):
+            # NICOS wire contract (kafka/nicos_status.py): service and
+            # per-job heartbeats carry a NICOS status code + typed payload
+            # in status_json, addressed by the NICOS identity conventions.
+            from ..core.job import JobStatus, ServiceStatus
+            from .nicos_status import (
+                job_status_to_x5f2,
+                service_status_to_x5f2,
+            )
+
+            if isinstance(value, ServiceStatus):
+                payload = service_status_to_x5f2(
+                    value,
+                    worker=self._service_id,
+                    host_name=socket.gethostname(),
+                    process_id=os.getpid(),
+                )
+            elif isinstance(value, JobStatus):
+                payload = job_status_to_x5f2(
+                    value,
+                    host_name=socket.gethostname(),
+                    process_id=os.getpid(),
+                )
+            else:
+                payload = wire.encode_x5f2(
+                    wire.X5f2Status(
+                        software_name="esslivedata-tpu",
+                        software_version="0.1.0",
+                        service_id=self._service_id,
+                        host_name=socket.gethostname(),
+                        process_id=os.getpid(),
+                        update_interval_ms=2000,
+                        status_json=value.model_dump_json(),
+                    )
+                )
+            return SerializedMessage(
+                topic=self._topics.status, value=payload
+            )
+        if kind == StreamKind.LIVEDATA_RESPONSES:
+            payload = (
+                value.model_dump(mode="json")
+                if isinstance(value, BaseModel)
+                else value
+            )
+            return SerializedMessage(
+                topic=self._topics.responses,
+                value=json.dumps(payload).encode(),
+            )
+        if kind == StreamKind.LIVEDATA_COMMANDS:
+            payload = (
+                value.model_dump(mode="json")
+                if isinstance(value, BaseModel)
+                else value
+            )
+            return SerializedMessage(
+                topic=self._topics.commands,
+                value=json.dumps(payload).encode(),
+            )
+        raise ValueError(
+            f"No serializer for kind={kind} value type {type(value).__name__}"
+        )
+
+
+def make_default_serializer(
+    topics: LivedataTopics, service_id: str = ""
+) -> DefaultSerializer:
+    return DefaultSerializer(topics, service_id)
+
+
+class KafkaSink:
+    """MessageSink publishing through a producer with drop-on-full."""
+
+    def __init__(self, producer: KafkaProducer, serializer: MessageSerializer):
+        self._producer = producer
+        self._serializer = serializer
+        self.dropped = 0
+        self.serialize_errors = 0
+
+    def publish_messages(self, messages: Sequence[Message]) -> None:
+        for msg in messages:
+            try:
+                sm = self._serializer.serialize(msg)
+            except Exception:
+                self.serialize_errors += 1
+                logger.exception("Failed to serialize %s", msg.stream)
+                continue
+            try:
+                self._producer.produce(sm.topic, sm.value, sm.key)
+            except BufferError:
+                # Producer queue full: drop rather than stall the hot loop
+                # (reference sink.py:110-118).
+                self.dropped += 1
+                logger.warning("Producer buffer full; dropped message")
+        self._producer.flush(0)
+
+
+class UnrollingSinkAdapter:
+    """Unpacks Message[dict[str, DataArray]] (a job's result group) into one
+    message per output (reference sink.py:179)."""
+
+    def __init__(self, sink) -> None:
+        self._sink = sink
+
+    def publish_messages(self, messages: Sequence[Message]) -> None:
+        flat: list[Message] = []
+        for msg in messages:
+            if isinstance(msg.value, dict):
+                for out_name, da in msg.value.items():
+                    flat.append(
+                        Message(
+                            timestamp=msg.timestamp,
+                            stream=msg.stream.__class__(
+                                kind=msg.stream.kind,
+                                name=f"{msg.stream.name}/{out_name}",
+                            ),
+                            value=da,
+                        )
+                    )
+            else:
+                flat.append(msg)
+        self._sink.publish_messages(flat)

@@ -1,0 +1,125 @@
+"""Thread-safe per-stream message counts and producer-lag accumulation.
+
+Parity with reference ``kafka/stream_counter.py``: the adapter layer calls
+``record`` each time a wire message is mapped (or fails to map) to a stream
+and ``record_lag`` when a payload timestamp is available; the processor
+drains both on the 30 s metrics rollover. EPICS noise suffixes (``.VAL``,
+``.DMOV`` — only ``.RBV`` carries the readback) and streams known to belong
+to another service (``out_of_scope``) are dropped so the status display is
+not polluted by unmapped-but-expected traffic.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from ..core.job import StreamLag, StreamLagReport
+from .stream_mapping import InputStreamKey
+
+__all__ = ["StreamCounter", "StreamStat", "StreamStats"]
+
+_IGNORED_SOURCE_SUFFIXES = (".DMOV", ".VAL")
+
+
+@dataclass(frozen=True, slots=True)
+class StreamStat:
+    """Message count for one (topic, source) over a metrics window."""
+
+    topic: str
+    source_name: str
+    stream: str | None  # resolved stream name, None if unmapped
+    count: int
+
+
+@dataclass(frozen=True, slots=True)
+class StreamStats:
+    window_seconds: float
+    streams: tuple[StreamStat, ...]
+
+    @property
+    def unmapped(self) -> tuple[StreamStat, ...]:
+        return tuple(s for s in self.streams if s.stream is None)
+
+
+@dataclass(slots=True)
+class _LagAgg:
+    min_s: float
+    max_s: float
+    count: int
+
+
+class StreamCounter:
+    """Counts messages per (topic, source) and folds per-message producer lag.
+
+    Producer lag is ``kafka_create_time - payload_timestamp`` in seconds:
+    how far behind real time the producer published. Aggregated as
+    (min, max, count) per (topic, source, schema) so one insane timestamp is
+    visible without storing every sample.
+    """
+
+    def __init__(self, *, out_of_scope: tuple[InputStreamKey, ...] = ()) -> None:
+        self._lock = threading.Lock()
+        self._counts: dict[tuple[str, str], tuple[str | None, int]] = {}
+        self._lag: dict[tuple[str, str, str], _LagAgg] = {}
+        self._out_of_scope = {(k.topic, k.source_name) for k in out_of_scope}
+
+    def record(self, topic: str, source_name: str, stream: str | None) -> None:
+        if source_name.endswith(_IGNORED_SOURCE_SUFFIXES):
+            return
+        key = (topic, source_name)
+        if key in self._out_of_scope:
+            return
+        with self._lock:
+            _, count = self._counts.get(key, (None, 0))
+            self._counts[key] = (stream, count + 1)
+
+    def record_lag(
+        self, topic: str, source_name: str, schema: str, lag_s: float
+    ) -> None:
+        if source_name.endswith(_IGNORED_SOURCE_SUFFIXES):
+            return
+        key = (topic, source_name, schema)
+        with self._lock:
+            agg = self._lag.get(key)
+            if agg is None:
+                self._lag[key] = _LagAgg(min_s=lag_s, max_s=lag_s, count=1)
+            else:
+                agg.min_s = min(agg.min_s, lag_s)
+                agg.max_s = max(agg.max_s, lag_s)
+                agg.count += 1
+
+    def drain(self, window_seconds: float) -> StreamStats:
+        """Return accumulated counts and reset."""
+        with self._lock:
+            counts, self._counts = self._counts, {}
+        return StreamStats(
+            window_seconds=window_seconds,
+            streams=tuple(
+                StreamStat(topic=t, source_name=s, stream=stream, count=n)
+                for (t, s), (stream, n) in sorted(counts.items())
+            ),
+        )
+
+    def drain_lag(self) -> StreamLagReport | None:
+        """Return accumulated per-stream lag and reset; None if empty.
+
+        Ordered by key so successive windows list streams in stable
+        positions for line-by-line comparison.
+        """
+        with self._lock:
+            lag, self._lag = self._lag, {}
+        if not lag:
+            return None
+        return StreamLagReport(
+            lags=[
+                StreamLag(
+                    stream_name=f"{topic}/{source}[{schema}]",
+                    lag_s=agg.max_s,
+                    min_s=agg.min_s,
+                    max_s=agg.max_s,
+                    count=agg.count,
+                )
+                for (topic, source, schema), agg in sorted(lag.items())
+            ]
+        )

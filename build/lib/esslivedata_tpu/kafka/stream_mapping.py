@@ -1,0 +1,135 @@
+"""(topic, source_name) -> canonical stream name lookup tables.
+
+Parity with reference ``kafka/stream_mapping.py`` (InputStreamKey:11,
+StreamMapping:39, LivedataTopics:22): raw ECDC topics carry many named
+sources; services address streams by canonical names declared in the
+instrument config. The LUTs here are that translation, per stream kind.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from dataclasses import dataclass, field
+
+__all__ = [
+    "InputStreamKey",
+    "LivedataTopics",
+    "MERGED_DETECTOR_STREAM",
+    "StreamMapping",
+]
+
+#: Logical stream name all banks adapt onto when an instrument sets
+#: merge_detectors (BIFROST pattern; message_adapter merges at the route).
+MERGED_DETECTOR_STREAM = "detector"
+
+
+@dataclass(frozen=True, slots=True)
+class InputStreamKey:
+    topic: str
+    source_name: str
+
+
+@dataclass(frozen=True, slots=True)
+class LivedataTopics:
+    """Our own output/control topics for one instrument."""
+
+    data: str
+    status: str
+    commands: str
+    responses: str
+    roi: str
+    nicos: str
+
+    @classmethod
+    def for_instrument(cls, instrument: str, dev: bool = False) -> "LivedataTopics":
+        prefix = f"dev_{instrument}" if dev else instrument
+        return cls(
+            data=f"{prefix}_livedata_data",
+            status=f"{prefix}_livedata_status",
+            commands=f"{prefix}_livedata_commands",
+            responses=f"{prefix}_livedata_responses",
+            roi=f"{prefix}_livedata_roi",
+            nicos=f"{prefix}_livedata_nicos",
+        )
+
+
+@dataclass(frozen=True)
+class StreamMapping:
+    """All input routing knowledge for one instrument's services."""
+
+    instrument: str
+    detectors: Mapping[InputStreamKey, str] = field(default_factory=dict)
+    monitors: Mapping[InputStreamKey, str] = field(default_factory=dict)
+    area_detectors: Mapping[InputStreamKey, str] = field(default_factory=dict)
+    logs: Mapping[InputStreamKey, str] = field(default_factory=dict)
+    #: Canonical monitor stream names whose ev44 pixel ids are meaningful:
+    #: the monitor adapter preserves them (DetectorEvents payload) instead
+    #: of taking the pixel-skipping fast path.
+    pixellated_monitors: frozenset[str] = frozenset()
+    run_control_topics: tuple[str, ...] = ()
+    dev: bool = False
+    livedata: LivedataTopics | None = None
+
+    def __post_init__(self) -> None:
+        if self.livedata is None:
+            object.__setattr__(
+                self,
+                "livedata",
+                LivedataTopics.for_instrument(self.instrument, self.dev),
+            )
+
+    @property
+    def detector_topics(self) -> set[str]:
+        return {k.topic for k in self.detectors}
+
+    @property
+    def monitor_topics(self) -> set[str]:
+        return {k.topic for k in self.monitors}
+
+    @property
+    def area_detector_topics(self) -> set[str]:
+        return {k.topic for k in self.area_detectors}
+
+    @property
+    def log_topics(self) -> set[str]:
+        return {k.topic for k in self.logs}
+
+    @property
+    def all_input_topics(self) -> set[str]:
+        return (
+            self.detector_topics
+            | self.monitor_topics
+            | self.area_detector_topics
+            | self.log_topics
+            | set(self.run_control_topics)
+            | {self.livedata.commands, self.livedata.roi}
+        )
+
+    @property
+    def all_stream_names(self) -> set[str]:
+        """Every canonical stream name any LUT maps onto."""
+        return (
+            set(self.detectors.values())
+            | set(self.monitors.values())
+            | set(self.area_detectors.values())
+            | set(self.logs.values())
+        )
+
+    def filtered(self, names: set[str]) -> "StreamMapping":
+        """Restrict every LUT to entries whose canonical name is needed
+        (reference StreamMapping.filtered: the service subscribes only to
+        streams its hosted specs consume)."""
+        return StreamMapping(
+            instrument=self.instrument,
+            detectors={k: v for k, v in self.detectors.items() if v in names},
+            monitors={k: v for k, v in self.monitors.items() if v in names},
+            area_detectors={
+                k: v for k, v in self.area_detectors.items() if v in names
+            },
+            logs={k: v for k, v in self.logs.items() if v in names},
+            pixellated_monitors=self.pixellated_monitors & names,
+            run_control_topics=self.run_control_topics,
+            dev=self.dev,
+            livedata=self.livedata,
+        )
+

@@ -1,0 +1,881 @@
+"""Clean-room FlatBuffers codecs for the ESS streaming schema family.
+
+The reference consumes/produces these schemas through the generated
+``ess-streaming-data-types`` package (reference: kafka/message_adapter.py:
+13-21); that package is not available here, so the same logical payloads are
+implemented directly on the flatbuffers runtime: a generic vtable reader for
+decode (zero-copy numpy views into the message buffer — the moral
+equivalent of the reference's fast-path partial decode,
+message_adapter.py:360) and low-level Builder slots for encode.
+
+Schemas carry the standard 4-byte file identifiers (ev44, f144, da00, ad00,
+x5f2, pl72, 6s4t). Field layouts (vtable slot ids, scalar widths, union
+tags, enum orderings) follow the vendored schema contract in
+``schemas/*.fbs`` and are VERIFIED against it by
+``tests/kafka/golden_wire_test.py``: an independent mini-.fbs parser +
+generic buffer walker checks every encoder's bytes field by field, and
+golden byte fixtures pin the exact serialization against drift. The
+schemas themselves are reconstructions of the public ECDC family (see
+schemas/README.md for the provenance caveat).
+
+Payload field conventions (wire layout per schemas/*.fbs; the Python
+dataclasses normalize where noted):
+- ev44: source_name, message_id, reference_time[] (ns epoch pulse times),
+  reference_time_index[], time_of_flight[] (ns within pulse, int32),
+  pixel_id[] (int32; zero-length vector for monitors).
+- f144: source_name, value as a 20-member typed union (scalar and array
+  forms of i8..u64/f32/f64 with a hidden value_type tag), timestamp (ns
+  epoch). Decode normalizes every member to a float64 vector.
+- da00: source_name, timestamp (ns), variables[] each with name, unit,
+  label, source, dtype enum (none..c_string), axes[], shape[] (int64),
+  raw data bytes.
+- ad00: source_name, frame id, timestamp (ns), dtype enum,
+  dimensions[] (int64), raw data.
+- x5f2: software_name/version, service_id, host_name, process_id (u32),
+  update_interval (ms, u32), status_json.
+- pl72: start/stop times (u64 ns), run_name, instrument_name, plus
+  nexus_structure/job_id/service_id when set. 6s4t: stop_time (u64 ns),
+  run_name, job_id/service_id/command_id when set.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+import flatbuffers
+import numpy as np
+
+__all__ = [
+    "Ad00Image",
+    "Da00Variable",
+    "Ev44Message",
+    "F144Message",
+    "RunStartMessage",
+    "RunStopMessage",
+    "X5f2Status",
+    "decode_6s4t",
+    "decode_ad00",
+    "decode_da00",
+    "decode_ev44",
+    "decode_f144",
+    "decode_pl72",
+    "decode_x5f2",
+    "encode_6s4t",
+    "encode_ad00",
+    "encode_da00",
+    "encode_ev44",
+    "encode_f144",
+    "encode_pl72",
+    "encode_x5f2",
+    "get_schema",
+]
+
+
+class WireError(ValueError):
+    """Malformed or wrong-schema buffer."""
+
+
+def _np_vector(b: flatbuffers.Builder, arr: np.ndarray) -> int | None:
+    """CreateNumpyVector that is safe for empty arrays.
+
+    This flatbuffers runtime corrupts empty vectors written near
+    differently-aligned neighbors (the stored offset lands on adjacent
+    data), so empty arrays are not written at all — ``None`` means "omit
+    the slot"; an absent vector decodes as empty, which is semantically
+    identical in flatbuffers."""
+    arr = np.ascontiguousarray(arr)
+    if arr.size == 0:
+        return None
+    return b.CreateNumpyVector(arr)
+
+
+def _np_vector_required(b: flatbuffers.Builder, arr: np.ndarray) -> int:
+    """Vector for a schema slot marked ``(required)``: an empty input
+    writes an explicit zero-length vector (StartVector/EndVector — safe,
+    unlike this runtime's CreateNumpyVector on empty arrays) so the slot
+    is always present, as generated readers/verifiers expect."""
+    arr = np.ascontiguousarray(arr)
+    if arr.size == 0:
+        itemsize = max(arr.dtype.itemsize, 1)
+        b.StartVector(itemsize, 0, itemsize)
+        return b.EndVector()
+    return b.CreateNumpyVector(arr)
+
+
+def _prepend_vec_slot(b: flatbuffers.Builder, slot: int, off: int | None) -> None:
+    if off is not None:
+        b.PrependUOffsetTRelativeSlot(slot, off, 0)
+
+
+def get_schema(buf: bytes) -> str:
+    """4-char file identifier of a serialized message ('ev44', ...)."""
+    if len(buf) < 8:
+        raise WireError(f"Buffer too short for flatbuffer: {len(buf)} bytes")
+    try:
+        return buf[4:8].decode("ascii")
+    except UnicodeDecodeError as err:
+        raise WireError("Invalid file identifier") from err
+
+
+# ---------------------------------------------------------------------------
+# Generic vtable reader
+# ---------------------------------------------------------------------------
+
+
+#: Precompiled struct formats for the decode hot path (populated lazily;
+#: the working set is the handful of scalar formats the schemas use).
+_STRUCTS: dict[str, struct.Struct] = {}
+
+
+class _Tbl:
+    """Minimal flatbuffers table reader (decode side only).
+
+    Every offset read is bounds-checked through :meth:`_read`: a hostile
+    buffer steering an offset out of range raises :class:`WireError`
+    (the per-message containment contract), never ``struct.error`` or a
+    wild slice.
+    """
+
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes, pos: int) -> None:
+        if pos < 0 or pos + 4 > len(buf):
+            raise WireError("Table position out of range")
+        self.buf = buf
+        self.pos = pos
+
+    def _read(self, fmt: str, offset: int):
+        """Bounds-checked struct read; corrupt offsets become WireError.
+        Hot path: format structs are precompiled (size lookup is free)."""
+        st = _STRUCTS.get(fmt)
+        if st is None:
+            st = _STRUCTS[fmt] = struct.Struct(fmt)
+        if offset < 0 or offset + st.size > len(self.buf):
+            raise WireError("Offset out of range")
+        return st.unpack_from(self.buf, offset)[0]
+
+    @classmethod
+    def root(cls, buf: bytes, expected_id: str | None = None) -> "_Tbl":
+        if len(buf) < 8:
+            raise WireError("Buffer too short")
+        if expected_id is not None and get_schema(buf) != expected_id:
+            raise WireError(
+                f"Expected schema {expected_id!r}, got {get_schema(buf)!r}"
+            )
+        (off,) = struct.unpack_from("<I", buf, 0)
+        return cls(buf, off)
+
+    def _slot(self, slot: int) -> int | None:
+        soff = self._read("<i", self.pos)
+        vt = self.pos - soff
+        if vt < 0 or vt + 4 > len(self.buf):
+            raise WireError("Corrupt vtable offset")
+        vt_len = self._read("<H", vt)
+        entry = 4 + slot * 2
+        if entry + 2 > vt_len:
+            return None
+        foff = self._read("<H", vt + entry)
+        if foff == 0:
+            return None
+        return self.pos + foff
+
+    def scalar(self, slot: int, fmt: str, default=0):
+        p = self._slot(slot)
+        if p is None:
+            return default
+        return self._read(fmt, p)
+
+    def _indirect(self, p: int) -> int:
+        off = self._read("<I", p)
+        target = p + off
+        if target < 0 or target + 4 > len(self.buf):
+            raise WireError("Indirect offset out of range")
+        return target
+
+    def _string_at(self, sp: int) -> str:
+        n = self._read("<I", sp)
+        if sp + 4 + n > len(self.buf):
+            raise WireError("String extends past buffer end")
+        try:
+            return bytes(self.buf[sp + 4 : sp + 4 + n]).decode("utf-8")
+        except UnicodeDecodeError as err:
+            raise WireError(f"Invalid UTF-8 string: {err}") from err
+
+    def string(self, slot: int, default: str = "") -> str:
+        p = self._slot(slot)
+        if p is None:
+            return default
+        return self._string_at(self._indirect(p))
+
+    def vector_np(self, slot: int, dtype) -> np.ndarray:
+        p = self._slot(slot)
+        if p is None:
+            return np.empty(0, dtype=dtype)
+        vp = self._indirect(p)
+        n = self._read("<I", vp)
+        itemsize = np.dtype(dtype).itemsize
+        end = vp + 4 + n * itemsize
+        if end > len(self.buf):
+            raise WireError("Vector extends past buffer end")
+        return np.frombuffer(self.buf, dtype=dtype, count=n, offset=vp + 4)
+
+    def table(self, slot: int) -> "_Tbl | None":
+        p = self._slot(slot)
+        if p is None:
+            return None
+        return _Tbl(self.buf, self._indirect(p))
+
+    def tables(self, slot: int) -> list["_Tbl"]:
+        p = self._slot(slot)
+        if p is None:
+            return []
+        vp = self._indirect(p)
+        n = self._read("<I", vp)
+        if vp + 4 + n * 4 > len(self.buf):
+            raise WireError("Table vector extends past buffer end")
+        out = []
+        for i in range(n):
+            ep = vp + 4 + i * 4
+            out.append(_Tbl(self.buf, self._indirect(ep)))
+        return out
+
+    def strings(self, slot: int) -> list[str]:
+        p = self._slot(slot)
+        if p is None:
+            return []
+        vp = self._indirect(p)
+        n = self._read("<I", vp)
+        if vp + 4 + n * 4 > len(self.buf):
+            raise WireError("String vector extends past buffer end")
+        out = []
+        for i in range(n):
+            ep = vp + 4 + i * 4
+            out.append(self._string_at(self._indirect(ep)))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# dtype enums (per schema: da00 and ad00 declare DIFFERENT orderings)
+# ---------------------------------------------------------------------------
+
+#: da00_dtype (schemas/da00_dataarray.fbs): none=0, then int8..float64,
+#: c_string=11. Index 0 and 11 have no numpy dtype (None sentinels).
+_DA00_DTYPES: list[np.dtype | None] = [
+    None,
+    np.dtype(np.int8),
+    np.dtype(np.uint8),
+    np.dtype(np.int16),
+    np.dtype(np.uint16),
+    np.dtype(np.int32),
+    np.dtype(np.uint32),
+    np.dtype(np.int64),
+    np.dtype(np.uint64),
+    np.dtype(np.float32),
+    np.dtype(np.float64),
+    None,  # c_string
+]
+_DA00_CODE = {dt: i for i, dt in enumerate(_DA00_DTYPES) if dt is not None}
+
+#: ad00 DType (schemas/ad00_area_detector_array.fbs): int8=0..float64=9.
+_AD00_DTYPES: list[np.dtype] = [
+    np.dtype(np.int8),
+    np.dtype(np.uint8),
+    np.dtype(np.int16),
+    np.dtype(np.uint16),
+    np.dtype(np.int32),
+    np.dtype(np.uint32),
+    np.dtype(np.int64),
+    np.dtype(np.uint64),
+    np.dtype(np.float32),
+    np.dtype(np.float64),
+]
+_AD00_CODE = {dt: i for i, dt in enumerate(_AD00_DTYPES)}
+
+
+def _dtype_code(arr: np.ndarray, table: dict) -> int:
+    try:
+        return table[arr.dtype]
+    except KeyError as err:
+        raise WireError(f"Unsupported wire dtype {arr.dtype}") from err
+
+
+# ---------------------------------------------------------------------------
+# ev44 — event data
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True, slots=True)
+class Ev44Message:
+    source_name: str
+    message_id: int
+    reference_time: np.ndarray  # int64 ns epoch
+    reference_time_index: np.ndarray  # int32
+    time_of_flight: np.ndarray  # int32 ns within pulse
+    pixel_id: np.ndarray  # int32; empty for monitor events
+
+
+def encode_ev44(
+    source_name: str,
+    message_id: int,
+    reference_time: np.ndarray,
+    reference_time_index: np.ndarray,
+    time_of_flight: np.ndarray,
+    pixel_id: np.ndarray | None = None,
+) -> bytes:
+    b = flatbuffers.Builder(1024)
+    # All four vectors are (required) in the schema: empty inputs (e.g.
+    # pixel_id for monitor events) still write a zero-length vector.
+    if pixel_id is None:
+        pixel_id = np.empty(0, np.int32)
+    pid_off = _np_vector_required(
+        b, np.ascontiguousarray(pixel_id, np.int32)
+    )
+    tof_off = _np_vector_required(
+        b, np.ascontiguousarray(time_of_flight, np.int32)
+    )
+    rti_off = _np_vector_required(
+        b, np.ascontiguousarray(reference_time_index, np.int32)
+    )
+    rt_off = _np_vector_required(
+        b, np.ascontiguousarray(reference_time, np.int64)
+    )
+    src_off = b.CreateString(source_name)
+    b.StartObject(6)
+    b.PrependUOffsetTRelativeSlot(0, src_off, 0)
+    b.PrependInt64Slot(1, message_id, 0)
+    b.PrependUOffsetTRelativeSlot(2, rt_off, 0)
+    b.PrependUOffsetTRelativeSlot(3, rti_off, 0)
+    b.PrependUOffsetTRelativeSlot(4, tof_off, 0)
+    b.PrependUOffsetTRelativeSlot(5, pid_off, 0)
+    b.Finish(b.EndObject(), file_identifier=b"ev44")
+    return bytes(b.Output())
+
+
+def decode_ev44(buf: bytes) -> Ev44Message:
+    t = _Tbl.root(buf, "ev44")
+    return Ev44Message(
+        source_name=t.string(0),
+        message_id=t.scalar(1, "<q"),
+        reference_time=t.vector_np(2, np.int64),
+        reference_time_index=t.vector_np(3, np.int32),
+        time_of_flight=t.vector_np(4, np.int32),
+        pixel_id=t.vector_np(5, np.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# f144 — log data
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True, slots=True)
+class F144Message:
+    source_name: str
+    value: np.ndarray  # float64 (normalized; wire carries a typed union)
+    timestamp_ns: int
+
+
+#: The f144 ``Value`` union, in declaration order (schemas/f144_logdata.fbs):
+#: tag 0 is NONE; 1-10 are scalar member tables, 11-20 array member tables.
+#: Every member table holds one ``value`` field at slot 0.
+_F144_SCALAR_MEMBERS: list[tuple[np.dtype, str]] = [
+    (np.dtype(np.int8), "<b"),
+    (np.dtype(np.uint8), "<B"),
+    (np.dtype(np.int16), "<h"),
+    (np.dtype(np.uint16), "<H"),
+    (np.dtype(np.int32), "<i"),
+    (np.dtype(np.uint32), "<I"),
+    (np.dtype(np.int64), "<q"),
+    (np.dtype(np.uint64), "<Q"),
+    (np.dtype(np.float32), "<f"),
+    (np.dtype(np.float64), "<d"),
+]
+_F144_TAG_DOUBLE = 10  # scalar Double
+_F144_TAG_ARRAY_DOUBLE = 20  # ArrayDouble
+
+
+def encode_f144(source_name: str, value, timestamp_ns: int) -> bytes:
+    """Scalar input -> a ``Double`` union member; array input ->
+    ``ArrayDouble``. The union adds the hidden ``value_type`` tag at the
+    slot before ``value`` — the layout ECDC's generated reader expects.
+    """
+    b = flatbuffers.Builder(256)
+    val = np.asarray(value, dtype=np.float64)
+    scalar = val.ndim == 0
+    if scalar:
+        b.StartObject(1)
+        b.PrependFloat64Slot(0, float(val), 0.0)
+        member_off = b.EndObject()
+        tag = _F144_TAG_DOUBLE
+    else:
+        v_off = _np_vector(b, np.atleast_1d(val))
+        b.StartObject(1)
+        _prepend_vec_slot(b, 0, v_off)
+        member_off = b.EndObject()
+        tag = _F144_TAG_ARRAY_DOUBLE
+    src_off = b.CreateString(source_name)
+    b.StartObject(4)
+    b.PrependUOffsetTRelativeSlot(0, src_off, 0)
+    b.PrependUint8Slot(1, tag, 0)
+    b.PrependUOffsetTRelativeSlot(2, member_off, 0)
+    b.PrependInt64Slot(3, timestamp_ns, 0)
+    b.Finish(b.EndObject(), file_identifier=b"f144")
+    return bytes(b.Output())
+
+
+def decode_f144(buf: bytes) -> F144Message:
+    """Accepts every ``Value`` union member, normalized to float64.
+
+    (u)int64 values above 2**53 lose precision in the normalization —
+    acceptable for the log-data domain this feeds (motor positions,
+    temperatures, chopper phases).
+    """
+    t = _Tbl.root(buf, "f144")
+    tag = t.scalar(1, "<B")
+    member = t.table(2)
+    if member is None or not 1 <= tag <= 20:
+        raise WireError(f"f144 value union missing or bad tag {tag}")
+    if tag <= 10:
+        _, fmt = _F144_SCALAR_MEMBERS[tag - 1]
+        value = np.atleast_1d(
+            np.asarray(member.scalar(0, fmt), dtype=np.float64)
+        )
+    else:
+        dtype, _ = _F144_SCALAR_MEMBERS[tag - 11]
+        value = member.vector_np(0, dtype).astype(np.float64)
+    return F144Message(
+        source_name=t.string(0),
+        value=value,
+        timestamp_ns=t.scalar(3, "<q"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# da00 — labeled data arrays
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True, slots=True)
+class Da00Variable:
+    name: str
+    unit: str
+    axes: tuple[str, ...]
+    data: np.ndarray  # shaped
+    label: str = ""
+    source: str = ""
+
+
+@dataclass(frozen=True, slots=True)
+class Da00Message:
+    source_name: str
+    timestamp_ns: int
+    variables: list[Da00Variable] = field(default_factory=list)
+
+
+def _encode_da00_variable(b: flatbuffers.Builder, var: Da00Variable) -> int:
+    # Slot layout per schemas/da00_dataarray.fbs: name=0, unit=1,
+    # label=2, source=3, data_type=4, axes=5, shape=6, data=7.
+    # NB: np.ascontiguousarray promotes 0-d to 1-d — take the shape from
+    # the original array so scalars stay scalars on the wire.
+    shape = np.asarray(var.data).shape
+    data = np.ascontiguousarray(var.data)
+    code = _dtype_code(data, _DA00_CODE)
+    data_off = _np_vector_required(b, data.reshape(-1).view(np.uint8))
+    shape_off = _np_vector(b, np.asarray(shape, dtype=np.int64))
+    axes_vec = None
+    if var.axes:
+        axes_offs = [b.CreateString(a) for a in var.axes]
+        b.StartVector(4, len(axes_offs), 4)
+        for off in reversed(axes_offs):
+            b.PrependUOffsetTRelative(off)
+        axes_vec = b.EndVector()
+    source_off = b.CreateString(var.source) if var.source else None
+    label_off = b.CreateString(var.label) if var.label else None
+    unit_off = b.CreateString(var.unit)
+    name_off = b.CreateString(var.name)
+    b.StartObject(8)
+    b.PrependUOffsetTRelativeSlot(0, name_off, 0)
+    b.PrependUOffsetTRelativeSlot(1, unit_off, 0)
+    if label_off is not None:
+        b.PrependUOffsetTRelativeSlot(2, label_off, 0)
+    if source_off is not None:
+        b.PrependUOffsetTRelativeSlot(3, source_off, 0)
+    b.PrependInt8Slot(4, code, 0)
+    _prepend_vec_slot(b, 5, axes_vec)
+    _prepend_vec_slot(b, 6, shape_off)
+    b.PrependUOffsetTRelativeSlot(7, data_off, 0)
+    return b.EndObject()
+
+
+def _encode_da00_native(
+    source_name: str, timestamp_ns: int, variables: list[Da00Variable]
+) -> bytes | None:
+    """Marshal to the native serializer (native/da00_encode.cpp); None =
+    library unavailable (callers fall back to the Python builder). The
+    native output is byte-identical to the Python path — asserted by
+    tests/kafka/native_da00_test.py — so golden fixtures hold for both.
+    """
+    try:
+        from ..native import available, da00_encode_raw
+    except Exception:  # pragma: no cover - import cycle/packaging issue
+        return None
+    if not available():
+        return None
+    if any(len(v.axes) > 16 for v in variables):
+        # Beyond the native writer's fixed axis capacity: fall back to
+        # the Python builder rather than surfacing a capacity error.
+        return None
+    strings: list[bytes] = []
+    offs = [0]
+
+    def intern(s: str) -> int:
+        raw = s.encode("utf8")
+        strings.append(raw)
+        offs.append(offs[-1] + len(raw))
+        return len(strings) - 1
+
+    src_idx = intern(source_name)
+    n = len(variables)
+    name_idx = np.empty(n, np.int32)
+    unit_idx = np.empty(n, np.int32)
+    label_idx = np.empty(n, np.int32)
+    source_idx = np.empty(n, np.int32)
+    codes = np.empty(n, np.int8)
+    axes_start = np.empty(n, np.int32)
+    axes_count = np.empty(n, np.int32)
+    dims_start = np.empty(n, np.int32)
+    dims_count = np.empty(n, np.int32)
+    axes_flat: list[int] = []
+    shapes_flat: list[int] = []
+    data_parts: list[bytes] = []
+    data_offs = np.empty(n + 1, np.int64)
+    data_offs[0] = 0
+    for i, var in enumerate(variables):
+        shape = np.asarray(var.data).shape
+        data = np.ascontiguousarray(var.data)
+        codes[i] = _dtype_code(data, _DA00_CODE)
+        name_idx[i] = intern(var.name)
+        unit_idx[i] = intern(var.unit)
+        label_idx[i] = intern(var.label) if var.label else -1
+        source_idx[i] = intern(var.source) if var.source else -1
+        axes_start[i] = len(axes_flat)
+        axes_count[i] = len(var.axes)
+        for axis in var.axes:
+            axes_flat.append(intern(axis))
+        dims_start[i] = len(shapes_flat)
+        dims_count[i] = len(shape)
+        shapes_flat.extend(int(s) for s in shape)
+        raw = data.tobytes()
+        data_parts.append(raw)
+        data_offs[i + 1] = data_offs[i] + len(raw)
+    return da00_encode_raw(
+        b"".join(strings),
+        np.asarray(offs, np.int64),
+        src_idx,
+        timestamp_ns,
+        name_idx,
+        unit_idx,
+        label_idx,
+        source_idx,
+        codes,
+        axes_start,
+        axes_count,
+        np.asarray(axes_flat, np.int32),
+        dims_start,
+        dims_count,
+        np.asarray(shapes_flat, np.int64),
+        data_offs,
+        b"".join(data_parts),
+    )
+
+
+def encode_da00(
+    source_name: str, timestamp_ns: int, variables: list[Da00Variable]
+) -> bytes:
+    encoded = _encode_da00_native(source_name, timestamp_ns, variables)
+    if encoded is not None:
+        return encoded
+    return _encode_da00_python(source_name, timestamp_ns, variables)
+
+
+def _encode_da00_python(
+    source_name: str, timestamp_ns: int, variables: list[Da00Variable]
+) -> bytes:
+    b = flatbuffers.Builder(4096)
+    var_offs = [_encode_da00_variable(b, v) for v in variables]
+    b.StartVector(4, len(var_offs), 4)
+    for off in reversed(var_offs):
+        b.PrependUOffsetTRelative(off)
+    vars_vec = b.EndVector()
+    src_off = b.CreateString(source_name)
+    b.StartObject(3)
+    b.PrependUOffsetTRelativeSlot(0, src_off, 0)
+    b.PrependInt64Slot(1, timestamp_ns, 0)
+    b.PrependUOffsetTRelativeSlot(2, vars_vec, 0)
+    b.Finish(b.EndObject(), file_identifier=b"da00")
+    return bytes(b.Output())
+
+
+def _decode_da00_variable(t: _Tbl) -> Da00Variable:
+    code = t.scalar(4, "<b")
+    dtype = (
+        _DA00_DTYPES[code] if 0 <= code < len(_DA00_DTYPES) else None
+    )
+    if dtype is None:
+        raise WireError(f"Bad or unsupported da00 dtype code {code}")
+    shape = tuple(int(s) for s in t.vector_np(6, np.int64))
+    raw = t.vector_np(7, np.uint8)
+    axes = tuple(t.strings(5))
+    if shape:
+        if any(s < 0 for s in shape):
+            raise WireError(f"Negative dimension in da00 shape {shape}")
+        # Python-int product: np.prod wraps in int64, so a hostile shape
+        # like [2**32, 2**32] would pass the size check as 0.
+        n_items = 1
+        for s in shape:
+            n_items *= s
+    else:
+        # Shape slot is omitted for 0-d (scalar) data; an absent shape with
+        # axes present means a 1-d vector whose length comes from the data.
+        n_items = raw.size // dtype.itemsize
+        shape = () if (not axes and n_items == 1) else (n_items,)
+    if n_items * dtype.itemsize > raw.size:
+        # A hostile shape vector must fail the containment contract's
+        # way, not as a numpy reshape ValueError.
+        raise WireError(
+            f"da00 shape {shape} needs {n_items} items but payload "
+            f"holds {raw.size // max(dtype.itemsize, 1)}"
+        )
+    # Slice to the exact byte count first: view() on a length not divisible
+    # by the itemsize would raise numpy's own error instead of WireError.
+    data = raw[: n_items * dtype.itemsize].view(dtype).reshape(shape)
+    return Da00Variable(
+        name=t.string(0),
+        unit=t.string(1),
+        axes=axes,
+        data=data,
+        label=t.string(2),
+        source=t.string(3),
+    )
+
+
+def decode_da00(buf: bytes) -> Da00Message:
+    t = _Tbl.root(buf, "da00")
+    return Da00Message(
+        source_name=t.string(0),
+        timestamp_ns=t.scalar(1, "<q"),
+        variables=[_decode_da00_variable(v) for v in t.tables(2)],
+    )
+
+
+# ---------------------------------------------------------------------------
+# ad00 — area detector images
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True, slots=True)
+class Ad00Image:
+    source_name: str
+    timestamp_ns: int
+    data: np.ndarray  # 2-D
+
+
+def encode_ad00(
+    source_name: str,
+    timestamp_ns: int,
+    data: np.ndarray,
+    *,
+    frame_id: int = 0,
+) -> bytes:
+    # Slot layout per schemas/ad00_area_detector_array.fbs: source_name=0,
+    # id=1, timestamp=2, data_type=3, dimensions=4 (int64), data=5.
+    data = np.ascontiguousarray(data)
+    b = flatbuffers.Builder(4096)
+    code = _dtype_code(data, _AD00_CODE)
+    data_off = _np_vector_required(b, data.reshape(-1).view(np.uint8))
+    shape_off = _np_vector_required(
+        b, np.asarray(data.shape, dtype=np.int64)
+    )
+    src_off = b.CreateString(source_name)
+    b.StartObject(6)
+    b.PrependUOffsetTRelativeSlot(0, src_off, 0)
+    b.PrependInt64Slot(1, frame_id, 0)
+    b.PrependInt64Slot(2, timestamp_ns, 0)
+    b.PrependInt8Slot(3, code, 0)
+    b.PrependUOffsetTRelativeSlot(4, shape_off, 0)
+    b.PrependUOffsetTRelativeSlot(5, data_off, 0)
+    b.Finish(b.EndObject(), file_identifier=b"ad00")
+    return bytes(b.Output())
+
+
+def decode_ad00(buf: bytes) -> Ad00Image:
+    t = _Tbl.root(buf, "ad00")
+    code = t.scalar(3, "<b")
+    if not 0 <= code < len(_AD00_DTYPES):
+        raise WireError(f"Bad dtype code {code}")
+    dtype = _AD00_DTYPES[code]
+    shape = tuple(int(s) for s in t.vector_np(4, np.int64))
+    if any(s < 0 for s in shape):
+        raise WireError(f"Negative dimension in ad00 shape {shape}")
+    raw = t.vector_np(5, np.uint8)
+    # Python-int product (np.prod wraps in int64 for hostile shapes).
+    n_items = 1 if shape else 0
+    for s in shape:
+        n_items *= s
+    if raw.size < n_items * dtype.itemsize:
+        raise WireError("ad00 data shorter than shape implies")
+    # Slice to the exact byte count BEFORE view(): a data vector whose
+    # length is not a multiple of the itemsize must fail the containment
+    # contract's way (WireError path above), not as numpy's ValueError.
+    return Ad00Image(
+        source_name=t.string(0),
+        timestamp_ns=t.scalar(2, "<q"),
+        data=raw[: n_items * dtype.itemsize].view(dtype).reshape(shape),
+    )
+
+
+# ---------------------------------------------------------------------------
+# x5f2 — status heartbeats
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True, slots=True)
+class X5f2Status:
+    software_name: str
+    software_version: str
+    service_id: str
+    host_name: str
+    process_id: int
+    update_interval_ms: int
+    status_json: str
+
+
+def encode_x5f2(status: X5f2Status) -> bytes:
+    b = flatbuffers.Builder(512)
+    js_off = b.CreateString(status.status_json)
+    host_off = b.CreateString(status.host_name)
+    sid_off = b.CreateString(status.service_id)
+    ver_off = b.CreateString(status.software_version)
+    name_off = b.CreateString(status.software_name)
+    b.StartObject(7)
+    b.PrependUOffsetTRelativeSlot(0, name_off, 0)
+    b.PrependUOffsetTRelativeSlot(1, ver_off, 0)
+    b.PrependUOffsetTRelativeSlot(2, sid_off, 0)
+    b.PrependUOffsetTRelativeSlot(3, host_off, 0)
+    b.PrependUint32Slot(4, status.process_id, 0)
+    b.PrependUint32Slot(5, status.update_interval_ms, 0)
+    b.PrependUOffsetTRelativeSlot(6, js_off, 0)
+    b.Finish(b.EndObject(), file_identifier=b"x5f2")
+    return bytes(b.Output())
+
+
+def decode_x5f2(buf: bytes) -> X5f2Status:
+    t = _Tbl.root(buf, "x5f2")
+    return X5f2Status(
+        software_name=t.string(0),
+        software_version=t.string(1),
+        service_id=t.string(2),
+        host_name=t.string(3),
+        process_id=t.scalar(4, "<I"),
+        update_interval_ms=t.scalar(5, "<I"),
+        status_json=t.string(6),
+    )
+
+
+# ---------------------------------------------------------------------------
+# pl72 / 6s4t — run start/stop
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True, slots=True)
+class RunStartMessage:
+    run_name: str
+    instrument_name: str
+    start_time_ns: int
+    stop_time_ns: int  # 0 = open-ended
+    job_id: str = ""
+    nexus_structure: str = ""
+    service_id: str = ""
+
+
+@dataclass(frozen=True, slots=True)
+class RunStopMessage:
+    run_name: str
+    stop_time_ns: int
+    job_id: str = ""
+    service_id: str = ""
+    command_id: str = ""
+
+
+def encode_pl72(msg: RunStartMessage) -> bytes:
+    # Slot layout per schemas/pl72_run_start.fbs: start_time=0,
+    # stop_time=1, run_name=2, instrument_name=3, nexus_structure=4,
+    # job_id=5, broker=6, service_id=7, filename=8, metadata=9,
+    # detector_spectrum_map=10, control_topic=11. Slots this framework
+    # does not populate are omitted (flatbuffers default semantics).
+    b = flatbuffers.Builder(256)
+    sid_off = b.CreateString(msg.service_id) if msg.service_id else None
+    # nexus_structure and job_id are (required) in the upstream ECDC
+    # schema: always write the slot (empty string when unset) so a
+    # consumer running the flatbuffers verifier accepts our buffers.
+    job_off = b.CreateString(msg.job_id)
+    nx_off = b.CreateString(msg.nexus_structure)
+    inst_off = b.CreateString(msg.instrument_name)
+    run_off = b.CreateString(msg.run_name)
+    b.StartObject(12)
+    b.PrependUint64Slot(0, msg.start_time_ns, 0)
+    b.PrependUint64Slot(1, msg.stop_time_ns, 0)
+    b.PrependUOffsetTRelativeSlot(2, run_off, 0)
+    b.PrependUOffsetTRelativeSlot(3, inst_off, 0)
+    b.PrependUOffsetTRelativeSlot(4, nx_off, 0)
+    b.PrependUOffsetTRelativeSlot(5, job_off, 0)
+    if sid_off is not None:
+        b.PrependUOffsetTRelativeSlot(7, sid_off, 0)
+    b.Finish(b.EndObject(), file_identifier=b"pl72")
+    return bytes(b.Output())
+
+
+def decode_pl72(buf: bytes) -> RunStartMessage:
+    t = _Tbl.root(buf, "pl72")
+    return RunStartMessage(
+        run_name=t.string(2),
+        instrument_name=t.string(3),
+        start_time_ns=t.scalar(0, "<Q"),
+        stop_time_ns=t.scalar(1, "<Q"),
+        job_id=t.string(5),
+        nexus_structure=t.string(4),
+        service_id=t.string(7),
+    )
+
+
+def encode_6s4t(msg: RunStopMessage) -> bytes:
+    # Slot layout per schemas/6s4t_run_stop.fbs: stop_time=0, run_name=1,
+    # job_id=2, service_id=3, command_id=4.
+    b = flatbuffers.Builder(128)
+    cmd_off = b.CreateString(msg.command_id) if msg.command_id else None
+    sid_off = b.CreateString(msg.service_id) if msg.service_id else None
+    # job_id is (required) upstream: always write the slot (see pl72).
+    job_off = b.CreateString(msg.job_id)
+    run_off = b.CreateString(msg.run_name)
+    b.StartObject(5)
+    b.PrependUint64Slot(0, msg.stop_time_ns, 0)
+    b.PrependUOffsetTRelativeSlot(1, run_off, 0)
+    b.PrependUOffsetTRelativeSlot(2, job_off, 0)
+    if sid_off is not None:
+        b.PrependUOffsetTRelativeSlot(3, sid_off, 0)
+    if cmd_off is not None:
+        b.PrependUOffsetTRelativeSlot(4, cmd_off, 0)
+    b.Finish(b.EndObject(), file_identifier=b"6s4t")
+    return bytes(b.Output())
+
+
+def decode_6s4t(buf: bytes) -> RunStopMessage:
+    t = _Tbl.root(buf, "6s4t")
+    return RunStopMessage(
+        run_name=t.string(1),
+        stop_time_ns=t.scalar(0, "<Q"),
+        job_id=t.string(2),
+        service_id=t.string(3),
+        command_id=t.string(4),
+    )

@@ -1,0 +1,98 @@
+"""Structured logging configuration (reference: logging_config.py).
+
+The reference uses structlog (console or JSON-file output); structlog is
+not available here, so this builds the same surface on stdlib logging: a
+key=value console formatter and a rotating JSON-lines file handler (10 MB
+x 5 backups), both rendering ``extra``-style structured fields. Services
+pass ``--log-json-file`` through to ``json_file``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from logging.handlers import RotatingFileHandler
+
+__all__ = ["configure_logging"]
+
+#: LogRecord attributes that are stdlib plumbing, not user fields.
+_RESERVED = frozenset(
+    logging.LogRecord("", 0, "", 0, "", (), None).__dict__
+) | {"message", "asctime", "taskName"}
+
+
+def _extra_fields(record: logging.LogRecord) -> dict:
+    return {
+        k: v for k, v in record.__dict__.items() if k not in _RESERVED
+    }
+
+
+class KeyValueFormatter(logging.Formatter):
+    """``2026-07-29T12:00:00 INFO  name  message key=value ...``"""
+
+    def format(self, record: logging.LogRecord) -> str:
+        stamp = time.strftime(
+            "%Y-%m-%dT%H:%M:%S", time.localtime(record.created)
+        )
+        base = (
+            f"{stamp} {record.levelname:<7} {record.name}  "
+            f"{record.getMessage()}"
+        )
+        fields = _extra_fields(record)
+        if fields:
+            base += "  " + " ".join(
+                f"{k}={v!r}" for k, v in sorted(fields.items())
+            )
+        if record.exc_info:
+            base += "\n" + self.formatException(record.exc_info)
+        return base
+
+
+class JsonLinesFormatter(logging.Formatter):
+    """One JSON object per line: machine-ingestible service logs."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        payload = {
+            "timestamp": time.strftime(
+                "%Y-%m-%dT%H:%M:%S%z", time.localtime(record.created)
+            ),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "event": record.getMessage(),
+            **_extra_fields(record),
+        }
+        if record.exc_info:
+            payload["exception"] = self.formatException(record.exc_info)
+        return json.dumps(payload, default=repr)
+
+
+def configure_logging(
+    *,
+    level: int | str = logging.INFO,
+    json_file: str | None = None,
+    disable_stdout: bool = False,
+) -> None:
+    """Configure root logging: pretty console and/or rotating JSON file.
+
+    ``level`` accepts a name ('info', 'DEBUG') or a numeric level — CLI
+    entry points pass their --log-level string straight through.
+    """
+    if isinstance(level, str):
+        level = getattr(logging, level.upper(), logging.INFO)
+    root = logging.getLogger()
+    for handler in root.handlers:
+        handler.close()  # release file descriptors on reconfiguration
+    root.handlers.clear()
+    if not disable_stdout:
+        console = logging.StreamHandler(sys.stdout)
+        console.setFormatter(KeyValueFormatter())
+        root.addHandler(console)
+    if json_file is not None:
+        file_handler = RotatingFileHandler(
+            json_file, maxBytes=10 * 1024 * 1024, backupCount=5
+        )
+        file_handler.setFormatter(JsonLinesFormatter())
+        root.addHandler(file_handler)
+    root.setLevel(level)

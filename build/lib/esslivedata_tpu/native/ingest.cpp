@@ -1,0 +1,600 @@
+// Native ingest shim: fast-path ev44 decode + host event staging.
+//
+// TPU-native equivalent of the native surface the reference leans on for its
+// hot ingest path: the generated FlatBuffers decoders of
+// ess-streaming-data-types (reference: kafka/message_adapter.py:13-21, and
+// the partial-decode fast path KafkaToMonitorEventsAdapter,
+// message_adapter.py:360) plus scipp's C++-backed growable event buffers
+// (_ScippBackedBuffer, to_nxevent_data.py:76-114).
+//
+// One call per Kafka message decodes the ev44 vtable and appends
+// (pixel_id:int32, toa:float32) straight into a reusable growable staging
+// buffer — no intermediate Python objects, no per-message numpy allocation.
+// `take` pads to the power-of-two bucket boundary (static XLA shapes) and
+// hands out raw pointers that Python wraps zero-copy as numpy arrays.
+//
+// Byte layout decoded here matches the clean-room Python codec
+// (esslivedata_tpu/kafka/wire.py): standard flatbuffers vtables, file
+// identifier "ev44", field slots: 0 source_name (string), 1 message_id
+// (int64), 2 reference_time ([int64]), 3 reference_time_index ([int32]),
+// 4 time_of_flight ([int32]), 5 pixel_id ([int32]).
+//
+// Every read is bounds-checked: malformed buffers return an error code, they
+// never crash the service (mirrors the reference's per-message containment,
+// message_adapter.py:592-624).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct View {
+  const uint8_t* buf;
+  int64_t len;
+};
+
+inline bool in_range(const View& v, int64_t pos, int64_t n) {
+  return pos >= 0 && n >= 0 && pos + n <= v.len;
+}
+
+inline bool read_u32(const View& v, int64_t pos, uint32_t* out) {
+  if (!in_range(v, pos, 4)) return false;
+  std::memcpy(out, v.buf + pos, 4);
+  return true;
+}
+
+inline bool read_i32(const View& v, int64_t pos, int32_t* out) {
+  if (!in_range(v, pos, 4)) return false;
+  std::memcpy(out, v.buf + pos, 4);
+  return true;
+}
+
+inline bool read_u16(const View& v, int64_t pos, uint16_t* out) {
+  if (!in_range(v, pos, 2)) return false;
+  std::memcpy(out, v.buf + pos, 2);
+  return true;
+}
+
+// Absolute position of table field `slot`, or 0 if absent, or -1 on corrupt.
+inline int64_t field_pos(const View& v, int64_t tpos, int slot) {
+  int32_t soff;
+  if (!read_i32(v, tpos, &soff)) return -1;
+  int64_t vt = tpos - static_cast<int64_t>(soff);
+  uint16_t vt_len;
+  if (!read_u16(v, vt, &vt_len)) return -1;
+  int64_t entry = 4 + slot * 2;
+  if (entry + 2 > vt_len) return 0;
+  uint16_t foff;
+  if (!read_u16(v, vt + entry, &foff)) return -1;
+  if (foff == 0) return 0;
+  return tpos + foff;
+}
+
+// Vector field: writes data pointer + element count. Returns 0 on absent
+// (n=0), 1 on present, -1 on corrupt.
+inline int vector_field(const View& v, int64_t tpos, int slot, int64_t elem_size,
+                        const uint8_t** data, int64_t* n) {
+  *data = nullptr;
+  *n = 0;
+  int64_t fp = field_pos(v, tpos, slot);
+  if (fp < 0) return -1;
+  if (fp == 0) return 0;
+  uint32_t off;
+  if (!read_u32(v, fp, &off)) return -1;
+  int64_t vp = fp + static_cast<int64_t>(off);
+  uint32_t count;
+  if (!read_u32(v, vp, &count)) return -1;
+  int64_t bytes = static_cast<int64_t>(count) * elem_size;
+  if (!in_range(v, vp + 4, bytes)) return -1;
+  *data = v.buf + vp + 4;
+  *n = count;
+  return 1;
+}
+
+struct Ev44View {
+  const int32_t* tof;
+  int64_t n_tof;
+  const int32_t* pixel;
+  int64_t n_pixel;
+  const int64_t* ref_time;
+  int64_t n_ref;
+  int64_t message_id;
+  const uint8_t* source;  // not NUL-terminated
+  int64_t source_len;
+};
+
+// Parse an ev44 message. Returns 0 on success, negative on error.
+int parse_ev44(const uint8_t* buf, int64_t len, Ev44View* out) {
+  View v{buf, len};
+  if (len < 8) return -1;
+  if (std::memcmp(buf + 4, "ev44", 4) != 0) return -2;
+  uint32_t root;
+  if (!read_u32(v, 0, &root)) return -1;
+  int64_t tpos = root;
+  if (!in_range(v, tpos, 4)) return -1;
+
+  const uint8_t* d;
+  int64_t n;
+  // source_name (slot 0, string)
+  out->source = nullptr;
+  out->source_len = 0;
+  int64_t fp = field_pos(v, tpos, 0);
+  if (fp < 0) return -3;
+  if (fp > 0) {
+    uint32_t off;
+    if (!read_u32(v, fp, &off)) return -3;
+    int64_t sp = fp + static_cast<int64_t>(off);
+    uint32_t slen;
+    if (!read_u32(v, sp, &slen)) return -3;
+    if (!in_range(v, sp + 4, slen)) return -3;
+    out->source = buf + sp + 4;
+    out->source_len = slen;
+  }
+  // message_id (slot 1, int64)
+  out->message_id = 0;
+  fp = field_pos(v, tpos, 1);
+  if (fp < 0) return -3;
+  if (fp > 0) {
+    if (!in_range(v, fp, 8)) return -3;
+    std::memcpy(&out->message_id, buf + fp, 8);
+  }
+  // reference_time (slot 2, [int64])
+  if (vector_field(v, tpos, 2, 8, &d, &n) < 0) return -4;
+  out->ref_time = reinterpret_cast<const int64_t*>(d);
+  out->n_ref = n;
+  // time_of_flight (slot 4, [int32])
+  if (vector_field(v, tpos, 4, 4, &d, &n) < 0) return -4;
+  out->tof = reinterpret_cast<const int32_t*>(d);
+  out->n_tof = n;
+  // pixel_id (slot 5, [int32])
+  if (vector_field(v, tpos, 5, 4, &d, &n) < 0) return -4;
+  out->pixel = reinterpret_cast<const int32_t*>(d);
+  out->n_pixel = n;
+  return 0;
+}
+
+struct Staging {
+  int32_t* pixel;
+  float* toa;
+  int64_t cap;
+  int64_t n;
+  int64_t min_bucket;
+  bool in_use;
+};
+
+bool grow(Staging* s, int64_t needed) {
+  int64_t cap = s->cap;
+  while (cap < needed) cap <<= 1;
+  auto* pixel = static_cast<int32_t*>(std::malloc(cap * sizeof(int32_t)));
+  auto* toa = static_cast<float*>(std::malloc(cap * sizeof(float)));
+  if (!pixel || !toa) {
+    std::free(pixel);
+    std::free(toa);
+    return false;
+  }
+  if (s->n > 0) {
+    std::memcpy(pixel, s->pixel, s->n * sizeof(int32_t));
+    std::memcpy(toa, s->toa, s->n * sizeof(float));
+  }
+  std::free(s->pixel);
+  std::free(s->toa);
+  s->pixel = pixel;
+  s->toa = toa;
+  s->cap = cap;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ld_staging_new(int64_t min_bucket) {
+  if (min_bucket < 1) min_bucket = 1;
+  auto* s = static_cast<Staging*>(std::malloc(sizeof(Staging)));
+  if (!s) return nullptr;
+  s->cap = min_bucket;
+  s->min_bucket = min_bucket;
+  s->n = 0;
+  s->in_use = false;
+  s->pixel = static_cast<int32_t*>(std::malloc(s->cap * sizeof(int32_t)));
+  s->toa = static_cast<float*>(std::malloc(s->cap * sizeof(float)));
+  if (!s->pixel || !s->toa) {
+    std::free(s->pixel);
+    std::free(s->toa);
+    std::free(s);
+    return nullptr;
+  }
+  return s;
+}
+
+void ld_staging_free(void* h) {
+  if (!h) return;
+  auto* s = static_cast<Staging*>(h);
+  std::free(s->pixel);
+  std::free(s->toa);
+  std::free(s);
+}
+
+int64_t ld_staging_len(void* h) { return static_cast<Staging*>(h)->n; }
+
+// Decode one ev44 message and append its events.
+// monitor_mode != 0: ignore pixel ids, append pixel_id=0 per event.
+// Returns number of events appended, or negative error:
+//   -1 short/corrupt buffer, -2 wrong schema, -3/-4 corrupt table,
+//   -5 tof/pixel length mismatch, -6 staging in use, -7 out of memory.
+int64_t ld_staging_add_ev44(void* h, const uint8_t* buf, int64_t len,
+                            int monitor_mode) {
+  auto* s = static_cast<Staging*>(h);
+  if (s->in_use) return -6;
+  Ev44View ev;
+  int rc = parse_ev44(buf, len, &ev);
+  if (rc != 0) return rc;
+  int64_t k = ev.n_tof;
+  if (k == 0) return 0;
+  bool with_pixel = !monitor_mode && ev.n_pixel > 0;
+  if (with_pixel && ev.n_pixel != ev.n_tof) return -5;
+  if (s->n + k > s->cap && !grow(s, s->n + k)) return -7;
+  int32_t* pd = s->pixel + s->n;
+  float* td = s->toa + s->n;
+  if (with_pixel) {
+    std::memcpy(pd, ev.pixel, k * sizeof(int32_t));
+  } else {
+    std::memset(pd, 0, k * sizeof(int32_t));
+  }
+  for (int64_t i = 0; i < k; ++i) td[i] = static_cast<float>(ev.tof[i]);
+  s->n += k;
+  return k;
+}
+
+// Append pre-decoded arrays (toa already float32). Returns n or negative.
+int64_t ld_staging_add_raw(void* h, const int32_t* pixel, const float* toa,
+                           int64_t n) {
+  auto* s = static_cast<Staging*>(h);
+  if (s->in_use) return -6;
+  if (n <= 0) return 0;
+  if (s->n + n > s->cap && !grow(s, s->n + n)) return -7;
+  std::memcpy(s->pixel + s->n, pixel, n * sizeof(int32_t));
+  std::memcpy(s->toa + s->n, toa, n * sizeof(float));
+  s->n += n;
+  return n;
+}
+
+// Pad to the power-of-two bucket boundary and expose the buffers.
+// Writes pointers + padded size + valid count; marks buffer in-use.
+// Returns 0, or -7 on allocation failure.
+int64_t ld_staging_take(void* h, int32_t** pixel_out, float** toa_out,
+                        int64_t* padded_out, int64_t* n_valid_out) {
+  auto* s = static_cast<Staging*>(h);
+  int64_t b = s->min_bucket;
+  while (b < s->n) b <<= 1;
+  if (b > s->cap && !grow(s, b)) return -7;
+  for (int64_t i = s->n; i < b; ++i) {
+    s->pixel[i] = -1;  // out-of-range: dropped by the device scatter
+    s->toa[i] = 0.0f;
+  }
+  s->in_use = true;
+  *pixel_out = s->pixel;
+  *toa_out = s->toa;
+  *padded_out = b;
+  *n_valid_out = s->n;
+  return 0;
+}
+
+void ld_staging_release(void* h) {
+  auto* s = static_cast<Staging*>(h);
+  s->in_use = false;
+  s->n = 0;
+}
+
+void ld_staging_clear(void* h) {
+  auto* s = static_cast<Staging*>(h);
+  s->in_use = false;
+  s->n = 0;
+}
+
+// Standalone metadata probe (no staging): extract message_id, event count,
+// and first/last reference_time from an ev44 buffer. Returns 0 or negative
+// parse error. Used for batching decisions without a full decode.
+int64_t ld_ev44_info(const uint8_t* buf, int64_t len, int64_t* message_id,
+                     int64_t* n_events, int64_t* ref_time_first,
+                     int64_t* ref_time_last) {
+  Ev44View ev;
+  int rc = parse_ev44(buf, len, &ev);
+  if (rc != 0) return rc;
+  *message_id = ev.message_id;
+  *n_events = ev.n_tof;
+  if (ev.n_ref > 0) {
+    int64_t first, last;
+    std::memcpy(&first, ev.ref_time, 8);
+    std::memcpy(&last, ev.ref_time + (ev.n_ref - 1), 8);
+    *ref_time_first = first;
+    *ref_time_last = last;
+  } else {
+    *ref_time_first = 0;
+    *ref_time_last = 0;
+  }
+  return 0;
+}
+
+// Project events into flat histogram-bin indices (the host half of the
+// ingest fast path: one int32 per event crosses to the device instead of
+// pixel_id+toa). Uniform TOA binning only; `lut` may be NULL (pixel_id is
+// the screen row). Out-of-range/masked events get `dump`.
+void ld_flatten(const int32_t* pixel, const float* toa, int64_t n,
+                const int32_t* lut, int64_t n_pix, int32_t n_screen,
+                int32_t n_toa, float lo, float hi, float inv_width,
+                int32_t dump, int32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    float t = toa[i];
+    int32_t p = pixel[i];
+    int32_t tb = static_cast<int32_t>((t - lo) * inv_width);
+    if (tb >= n_toa) tb = n_toa - 1;
+    if (tb < 0) tb = 0;
+    bool ok = (t >= lo) & (t < hi);
+    int32_t screen;
+    if (lut != nullptr) {
+      if (p >= 0 && p < n_pix) {
+        screen = lut[p];
+      } else {
+        screen = -1;
+      }
+      ok = ok & (screen >= 0);
+    } else {
+      screen = p;
+      ok = ok & (p >= 0) & (p < n_screen);
+    }
+    out[i] = ok ? screen * n_toa + tb : dump;
+  }
+}
+
+// Non-uniform TOA edges: branch-light binary search over float32 edges
+// (the SAME dtype the device path bins with — host and device must be
+// bit-identical at bin boundaries). edges has n_toa + 1 entries,
+// strictly increasing; bin semantics mirror np.searchsorted(side
+// "right") - 1 as used by flatten_host's numpy fallback.
+void ld_flatten_nonuniform(const int32_t* pixel, const float* toa,
+                           int64_t n, const int32_t* lut, int64_t n_pix,
+                           int32_t n_screen, int32_t n_toa,
+                           const float* edges, int32_t dump,
+                           int32_t* out) {
+  const float lo = edges[0];
+  const float hi = edges[n_toa];
+  for (int64_t i = 0; i < n; ++i) {
+    float t = toa[i];
+    int32_t p = pixel[i];
+    // upper_bound(edges, t) - 1
+    int32_t left = 0, right = n_toa + 1;
+    while (left < right) {
+      int32_t mid = (left + right) >> 1;
+      if (edges[mid] <= t) {
+        left = mid + 1;
+      } else {
+        right = mid;
+      }
+    }
+    int32_t tb = left - 1;
+    bool ok = (t >= lo) & (t < hi) & (tb >= 0) & (tb < n_toa);
+    if (tb >= n_toa) tb = n_toa - 1;
+    if (tb < 0) tb = 0;
+    int32_t screen;
+    if (lut != nullptr) {
+      if (p >= 0 && p < n_pix) {
+        screen = lut[p];
+      } else {
+        screen = -1;
+      }
+      ok = ok & (screen >= 0);
+    } else {
+      screen = p;
+      ok = ok & (p >= 0) & (p < n_screen);
+    }
+    out[i] = ok ? screen * n_toa + tb : dump;
+  }
+}
+
+// Event partition for the pallas2d tiled histogram kernel
+// (ops/pallas_hist2d.py): group flat bin indices by block
+// (flat >> shift), padding each used block's events to whole chunks
+// with -1 and emitting the non-decreasing chunk -> block map.
+//
+// Parallel counting sort: threads count per (thread, block) over their
+// input segment, an exclusive scan turns the counts into per-thread
+// write cursors, and each thread places its segment — two linear passes
+// over the input, no comparison sort. Out-of-range indices route to the
+// dump bin (n_bins_incl_dump - 1), matching step_flat.
+//
+// The caller allocates out_events[cap_chunks * chunk] and
+// out_map[cap_chunks] with cap_chunks >= ceil(n/chunk) + n_blocks (the
+// worst case: every used block ends in a partial chunk). Returns the
+// number of chunks actually used, or -1 if cap_chunks is too small.
+// The tail up to cap_chunks is filled (-1 events, last-block map) so
+// the caller can hand any rounded-up prefix straight to the kernel.
+//
+// blk_in: optional precomputed per-event block ids (for non-power-of-two
+// bpb, where no shift exists — the caller vectorizes the division). With
+// blk_in, flat must already be routed in-range, n_blocks_in gives the
+// block count, and shift is ignored.
+int64_t ld_partition(const int32_t* flat, const int32_t* blk_in,
+                     int64_t n, int64_t n_bins_incl_dump,
+                     int64_t n_blocks_in, int32_t shift, int32_t chunk,
+                     int32_t* out_events, int32_t* out_map,
+                     int64_t cap_chunks) {
+  const int32_t dump = static_cast<int32_t>(n_bins_incl_dump - 1);
+  const int64_t n_blocks =
+      blk_in != nullptr
+          ? n_blocks_in
+          : (n_bins_incl_dump + (int64_t(1) << shift) - 1) >> shift;
+  int n_threads = static_cast<int>(std::thread::hardware_concurrency());
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > 8) n_threads = 8;
+  if (n < (int64_t(1) << 16)) n_threads = 1;
+  const int64_t seg = (n + n_threads - 1) / n_threads;
+
+  // counts[t * n_blocks + b]
+  std::vector<int64_t> counts(
+      static_cast<size_t>(n_threads) * n_blocks, 0);
+  auto route = [&](int32_t v) -> int32_t {
+    return (v < 0 || v >= n_bins_incl_dump) ? dump : v;
+  };
+  auto count_seg = [&](int t) {
+    const int64_t lo = t * seg;
+    const int64_t hi = std::min(n, lo + seg);
+    int64_t* c = counts.data() + static_cast<size_t>(t) * n_blocks;
+    if (blk_in != nullptr) {
+      for (int64_t i = lo; i < hi; ++i) c[blk_in[i]]++;
+    } else {
+      for (int64_t i = lo; i < hi; ++i) c[route(flat[i]) >> shift]++;
+    }
+  };
+  {
+    std::vector<std::thread> ts;
+    for (int t = 1; t < n_threads; ++t) ts.emplace_back(count_seg, t);
+    count_seg(0);
+    for (auto& th : ts) th.join();
+  }
+
+  // Per-block totals -> chunk-padded block starts + per-thread cursors.
+  std::vector<int64_t> cursor(
+      static_cast<size_t>(n_threads) * n_blocks, 0);
+  std::vector<int64_t> bstart(n_blocks + 1, 0);
+  int64_t n_chunks = 0;
+  for (int64_t b = 0; b < n_blocks; ++b) {
+    bstart[b] = n_chunks * chunk;
+    int64_t total = 0;
+    for (int t = 0; t < n_threads; ++t) {
+      cursor[static_cast<size_t>(t) * n_blocks + b] =
+          bstart[b] + total;
+      total += counts[static_cast<size_t>(t) * n_blocks + b];
+    }
+    const int64_t k = (total + chunk - 1) / chunk;
+    if (n_chunks + k > cap_chunks) return -1;
+    for (int64_t c = 0; c < k; ++c)
+      out_map[n_chunks + c] = static_cast<int32_t>(b);
+    // Pad tail of this block's region.
+    for (int64_t i = bstart[b] + total; i < (n_chunks + k) * chunk; ++i)
+      out_events[i] = -1;
+    n_chunks += k;
+  }
+  bstart[n_blocks] = n_chunks * chunk;
+
+  auto place_seg = [&](int t) {
+    const int64_t lo = t * seg;
+    const int64_t hi = std::min(n, lo + seg);
+    int64_t* cur = cursor.data() + static_cast<size_t>(t) * n_blocks;
+    if (blk_in != nullptr) {
+      for (int64_t i = lo; i < hi; ++i)
+        out_events[cur[blk_in[i]]++] = flat[i];
+    } else {
+      for (int64_t i = lo; i < hi; ++i) {
+        const int32_t v = route(flat[i]);
+        out_events[cur[v >> shift]++] = v;
+      }
+    }
+  };
+  {
+    std::vector<std::thread> ts;
+    for (int t = 1; t < n_threads; ++t) ts.emplace_back(place_seg, t);
+    place_seg(0);
+    for (auto& th : ts) th.join();
+  }
+
+  // Fill the caller's whole tail so any rounded-up prefix is valid.
+  const int32_t last = static_cast<int32_t>(n_blocks - 1);
+  for (int64_t c = n_chunks; c < cap_chunks; ++c) out_map[c] = last;
+  if (cap_chunks > n_chunks)
+    memset(out_events + n_chunks * chunk, 0xFF,
+           static_cast<size_t>((cap_chunks - n_chunks) * chunk) *
+               sizeof(int32_t));
+  return n_chunks;
+}
+
+// Fused flatten + partition: the pallas2d ingest fast path
+// (histogram.py flatten_partition_host). One call turns raw
+// (pixel_id, toa) into block-partitioned flat indices, with blocks
+// aligned to pixel ranges (bpb = ppb * n_toa, ppb a power of two), so
+// the counting pass derives the block from the screen pixel with one
+// shift — no division, no intermediate flat array, no separate count
+// pass. Pass 2 recomputes the flat index (ALU is cheap next to the
+// memory traffic on the single-core ingest host) and places it.
+//
+// Uniform TOA edges only (the non-uniform path goes flatten ->
+// ld_partition). Semantics match ld_flatten + ld_partition exactly,
+// including dump routing of invalid pixel/toa.
+int64_t ld_flatten_partition(
+    const int32_t* pixel, const float* toa, int64_t n, const int32_t* lut,
+    int64_t n_pix, int32_t n_screen, int32_t n_toa, float lo, float hi,
+    float inv_width, int32_t ppb_shift, int32_t chunk, int32_t* out_events,
+    int32_t* out_map, int64_t cap_chunks) {
+  const int64_t n_toa64 = n_toa;
+  const int64_t n_bins = static_cast<int64_t>(n_screen) * n_toa64;
+  const int32_t dump = static_cast<int32_t>(n_bins);
+  const int64_t bpb = (int64_t(1) << ppb_shift) * n_toa64;
+  const int64_t n_blocks = (n_bins + 1 + bpb - 1) / bpb;
+  const int32_t dump_blk = static_cast<int32_t>(n_bins / bpb);
+
+  // flat index + block for one event; invalid -> (dump, dump_blk).
+  auto project = [&](int64_t i, int32_t* blk) -> int32_t {
+    const float t = toa[i];
+    const int32_t p = pixel[i];
+    int32_t tb = static_cast<int32_t>((t - lo) * inv_width);
+    bool ok = (t >= lo) & (t < hi);
+    if (tb >= n_toa) tb = n_toa - 1;
+    if (tb < 0) tb = 0;
+    int32_t screen;
+    if (lut != nullptr) {
+      screen = (p >= 0 && p < n_pix) ? lut[p] : -1;
+      ok = ok & (screen >= 0);
+    } else {
+      screen = p;
+      ok = ok & (p >= 0) & (p < n_screen);
+    }
+    if (!ok) {
+      *blk = dump_blk;
+      return dump;
+    }
+    *blk = screen >> ppb_shift;
+    return screen * n_toa + tb;
+  };
+
+  std::vector<int64_t> counts(n_blocks, 0);
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t blk;
+    (void)project(i, &blk);
+    counts[blk]++;
+  }
+
+  std::vector<int64_t> cursor(n_blocks, 0);
+  int64_t n_chunks = 0;
+  for (int64_t b = 0; b < n_blocks; ++b) {
+    cursor[b] = n_chunks * chunk;
+    const int64_t total = counts[b];
+    const int64_t k = (total + chunk - 1) / chunk;
+    if (n_chunks + k > cap_chunks) return -1;
+    for (int64_t c = 0; c < k; ++c)
+      out_map[n_chunks + c] = static_cast<int32_t>(b);
+    for (int64_t i = cursor[b] + total; i < (n_chunks + k) * chunk; ++i)
+      out_events[i] = -1;
+    n_chunks += k;
+  }
+
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t blk;
+    const int32_t v = project(i, &blk);
+    out_events[cursor[blk]++] = v;
+  }
+
+  const int32_t last = static_cast<int32_t>(n_blocks - 1);
+  for (int64_t c = n_chunks; c < cap_chunks; ++c) out_map[c] = last;
+  if (cap_chunks > n_chunks)
+    memset(out_events + n_chunks * chunk, 0xFF,
+           static_cast<size_t>((cap_chunks - n_chunks) * chunk) *
+               sizeof(int32_t));
+  return n_chunks;
+}
+
+}  // extern "C"

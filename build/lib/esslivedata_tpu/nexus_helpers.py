@@ -1,0 +1,199 @@
+"""NeXus file introspection helpers (reference: nexus_helpers.py).
+
+Host-side, cold-path utilities over h5py: discover streamed groups (the
+input to the generated stream registries, ADR 0009), and load detector
+geometry — pixel positions resolved through ``depends_on`` transformation
+chains — for building projection tables.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = [
+    "StreamedGroup",
+    "find_streamed_groups",
+    "load_detector_geometry",
+    "resolve_depends_on",
+]
+
+
+@dataclass(frozen=True)
+class StreamedGroup:
+    """One group a filewriter streams from Kafka (NXlog / NXevent_data)."""
+
+    nexus_path: str
+    nx_class: str
+    topic: str | None
+    source: str | None
+    units: str | None
+
+
+def _attr(obj, name: str) -> str | None:
+    value = obj.attrs.get(name)
+    if value is None:
+        return None
+    if isinstance(value, bytes):
+        return value.decode()
+    return str(value)
+
+
+def find_streamed_groups(filename: str) -> list[StreamedGroup]:
+    """All NXlog/NXevent_data groups with their stream identity.
+
+    Topic/source conventions follow the ESS filewriter: groups carry
+    ``topic``/``source`` attrs (or a child dataset of those names holding
+    the strings).
+    """
+    import h5py
+
+    out: list[StreamedGroup] = []
+
+    def visit(path: str, obj) -> None:
+        if not isinstance(obj, h5py.Group):
+            return
+        nx_class = _attr(obj, "NX_class")
+        if nx_class not in ("NXlog", "NXevent_data"):
+            return
+
+        def get(name: str) -> str | None:
+            if (value := _attr(obj, name)) is not None:
+                return value
+            child = obj.get(name)
+            if isinstance(child, h5py.Dataset):
+                raw = child[()]
+                return raw.decode() if isinstance(raw, bytes) else str(raw)
+            return None
+
+        units = None
+        value_ds = obj.get("value")
+        if isinstance(value_ds, h5py.Dataset):
+            units = _attr(value_ds, "units")
+        out.append(
+            StreamedGroup(
+                nexus_path=path,
+                nx_class=nx_class,
+                topic=get("topic"),
+                source=get("source"),
+                units=units,
+            )
+        )
+
+    with h5py.File(filename, "r") as f:
+        f.visititems(visit)
+    return out
+
+
+def _transform_matrix(node) -> np.ndarray:
+    """4x4 matrix for one NXtransformations entry (value + attrs).
+
+    ``node`` may be a dataset or an NXlog *group* (motion-controlled
+    transform): for a group the samples come from its ``value`` dataset
+    while transformation attrs are looked up on the group first, then the
+    dataset. An empty value — the length-0 placeholder written by
+    make_geometry_nexus.py — contributes magnitude 0 (identity modulo
+    offset) so geometry artifacts load before any live motor value.
+    """
+    if hasattr(node, "keys") and "value" in node:  # NXlog group
+        group, dataset = node, node["value"]
+    else:
+        group, dataset = None, node
+
+    def attr(name: str, default=None):
+        for host in (group, dataset):
+            if host is not None and name in host.attrs:
+                return host.attrs[name]
+        return default
+
+    raw = np.atleast_1d(dataset[()])
+    value = float(raw[-1]) if raw.size else 0.0
+    kind = attr("transformation_type")
+    if isinstance(kind, bytes):
+        kind = kind.decode()
+    vector = np.asarray(attr("vector", (0.0, 0.0, 1.0)), dtype=float)
+    norm = np.linalg.norm(vector)
+    vector = vector / norm if norm else vector
+    offset = np.asarray(attr("offset", (0.0, 0.0, 0.0)), dtype=float)
+    m = np.eye(4)
+    if kind == "translation":
+        m[:3, 3] = vector * value
+    elif kind == "rotation":
+        theta = np.deg2rad(value)
+        k = np.array(
+            [
+                [0, -vector[2], vector[1]],
+                [vector[2], 0, -vector[0]],
+                [-vector[1], vector[0], 0],
+            ]
+        )
+        m[:3, :3] = (
+            np.eye(3) + np.sin(theta) * k + (1 - np.cos(theta)) * (k @ k)
+        )
+    m[:3, 3] += offset
+    return m
+
+
+def resolve_depends_on(f, start: str, *, base: str = "") -> np.ndarray:
+    """Compose the depends_on chain starting at ``start`` into one 4x4
+    matrix (root-most applied last, per the NeXus spec).
+
+    A relative ``start`` (no leading '/') resolves against ``base`` — the
+    group that declared it — matching the NeXus relative-target rule.
+    """
+    m = np.eye(4)
+    if not start.startswith("/") and base:
+        start = f"{base.rstrip('/')}/{start}"
+    path = start
+    seen: set[str] = set()
+    while path and path != ".":
+        if path in seen:
+            raise ValueError(f"depends_on cycle at {path!r}")
+        seen.add(path)
+        dataset = f[path]
+        m = _transform_matrix(dataset) @ m
+        nxt = _attr(dataset, "depends_on")
+        if nxt is None or nxt == ".":
+            break
+        path = nxt if nxt.startswith("/") else f"{path.rsplit('/', 1)[0]}/{nxt}"
+    return m
+
+
+def load_detector_geometry(
+    filename: str, detector_path: str
+) -> tuple[np.ndarray, np.ndarray]:
+    """(positions [n,3], detector_number [n]) for one NXdetector group.
+
+    Pixel offsets (x/y/z_pixel_offset) are broadcast to the detector_number
+    shape and pushed through the group's depends_on chain.
+    """
+    import h5py
+
+    with h5py.File(filename, "r") as f:
+        group = f[detector_path]
+        det = np.asarray(group["detector_number"][()])
+        shape = det.shape
+
+        def offsets(name: str) -> np.ndarray:
+            if name in group:
+                return np.broadcast_to(
+                    np.asarray(group[name][()], dtype=float), shape
+                ).reshape(-1)
+            return np.zeros(det.size)
+
+        local = np.column_stack(
+            [
+                offsets("x_pixel_offset"),
+                offsets("y_pixel_offset"),
+                offsets("z_pixel_offset"),
+            ]
+        )
+        depends = group.get("depends_on")
+        if isinstance(depends, h5py.Dataset):
+            target = depends[()]
+            target = target.decode() if isinstance(target, bytes) else target
+            if target and target != ".":
+                m = resolve_depends_on(f, target, base=detector_path)
+                local = local @ m[:3, :3].T + m[:3, 3]
+    return local, det.reshape(-1)

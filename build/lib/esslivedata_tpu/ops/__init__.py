@@ -1,0 +1,19 @@
+"""Jitted TPU kernels: the compute substrate replacing scipp's C++ kernels.
+
+Where the reference histogramms events with scipp's threaded C++ ``bin``/
+``hist`` on CPU (reference: workflows/monitor_workflow.py:98,
+workflows/detector_view/providers.py:169), this package stages events into
+fixed-shape device batches and runs jitted scatter-add histogram kernels with
+state resident in HBM across pulses. Design notes in SURVEY.md section 7.
+"""
+
+from .event_batch import EventBatch, StagingBuffer, bucket_size
+from .histogram import EventHistogrammer, HistogramState
+
+__all__ = [
+    "EventBatch",
+    "EventHistogrammer",
+    "HistogramState",
+    "StagingBuffer",
+    "bucket_size",
+]

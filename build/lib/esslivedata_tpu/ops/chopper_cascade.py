@@ -1,0 +1,257 @@
+"""Analytical chopper-cascade propagation: TOF -> wavelength lookup tables.
+
+Clean-room equivalent of the reference's analytical unwrap mode (reference
+workflows/wavelength_lut_workflow.py builds on essreduce's polygon-based
+``ess.reduce.unwrap.lut``): the set of neutrons transmitted by a disk-chopper
+cascade is represented as polygons in (emission time, wavelength) space and
+clipped against each chopper's open windows. From the surviving "subframes"
+we evaluate, at any flight distance, the mean transmitted wavelength per
+event_time_offset bin — the wavelength lookup table used by monitor and
+detector workflows to convert TOF to wavelength.
+
+Geometry/time model
+-------------------
+A neutron of wavelength ``lambda`` [angstrom] travels 1 m in
+``ALPHA_NS_PER_M_A * lambda`` ns. A polygon vertex is ``(t0, lam)`` with
+``t0`` the emission time at the source [ns]; its arrival time at distance
+``L`` [m] is the *linear* map ``t0 + ALPHA * L * lam``, so chopper windows
+(time intervals at the chopper's distance) are half-plane constraints and
+Sutherland-Hodgman clipping applies exactly. All computation is host-side
+numpy: the cascade is recomputed only when chopper setpoints change (cold
+path); the hot path merely gathers from the resulting table on device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+
+__all__ = [
+    "ALPHA_NS_PER_M_A",
+    "DiskChopper",
+    "propagate_cascade",
+    "wavelength_band_at",
+    "wavelength_lut",
+]
+
+#: Time [ns] for a 1-angstrom neutron to travel 1 m:  m_n / h in ns/(m*A).
+#: v = h/(m*lambda) = 3956.034 m/s per 1/angstrom  =>  t = L*lambda/3956.034 s.
+ALPHA_NS_PER_M_A = 1e9 / 3956.034
+
+
+@dataclass(frozen=True)
+class DiskChopper:
+    """One disk chopper: rotation frequency, beam-crossing delay, slits.
+
+    ``slit_edges_deg`` lists (open, close) angle pairs in the rotation
+    direction; a slit's open window crosses the beam during
+    ``[delay + open/360/f, delay + close/360/f]`` each period. ``delay_ns``
+    is the time the zero angle crosses the beam (the synthesized
+    delay_setpoint stream; reference chopper_synthesizer.py).
+    """
+
+    name: str
+    distance_m: float
+    frequency_hz: float
+    delay_ns: float = 0.0
+    slit_edges_deg: tuple[tuple[float, float], ...] = ((0.0, 180.0),)
+
+    def __post_init__(self) -> None:
+        if self.frequency_hz <= 0:
+            raise ValueError(f"Chopper {self.name}: frequency must be > 0")
+        for open_deg, close_deg in self.slit_edges_deg:
+            if not 0 <= open_deg < close_deg <= 360:
+                raise ValueError(
+                    f"Chopper {self.name}: slit ({open_deg}, {close_deg}) "
+                    "must satisfy 0 <= open < close <= 360"
+                )
+
+    @property
+    def period_ns(self) -> float:
+        return 1e9 / self.frequency_hz
+
+    def open_windows(self, t_lo_ns: float, t_hi_ns: float) -> list[tuple[float, float]]:
+        """All open intervals [a, b] overlapping [t_lo, t_hi]."""
+        period = self.period_ns
+        windows: list[tuple[float, float]] = []
+        n_lo = int(np.floor((t_lo_ns - self.delay_ns) / period)) - 1
+        n_hi = int(np.ceil((t_hi_ns - self.delay_ns) / period)) + 1
+        for n in range(n_lo, n_hi + 1):
+            base = self.delay_ns + n * period
+            for open_deg, close_deg in self.slit_edges_deg:
+                a = base + open_deg / 360.0 * period
+                b = base + close_deg / 360.0 * period
+                if b >= t_lo_ns and a <= t_hi_ns:
+                    windows.append((a, b))
+        return sorted(windows)
+
+
+def _clip_halfplane(poly: np.ndarray, coeffs: tuple[float, float, float]) -> np.ndarray:
+    """Sutherland-Hodgman clip of polygon [n,2] against c0 + c1*t + c2*lam >= 0."""
+    c0, c1, c2 = coeffs
+    if len(poly) == 0:
+        return poly
+    d = c0 + c1 * poly[:, 0] + c2 * poly[:, 1]
+    out: list[np.ndarray] = []
+    n = len(poly)
+    for i in range(n):
+        j = (i + 1) % n
+        vi, vj = poly[i], poly[j]
+        di, dj = d[i], d[j]
+        if di >= 0:
+            out.append(vi)
+            if dj < 0:
+                out.append(vi + (vj - vi) * (di / (di - dj)))
+        elif dj >= 0:
+            out.append(vi + (vj - vi) * (di / (di - dj)))
+    if len(out) < 3:
+        return np.empty((0, 2))
+    return np.asarray(out)
+
+
+def _clip_time_window(
+    poly: np.ndarray, distance_m: float, a_ns: float, b_ns: float
+) -> np.ndarray:
+    """Clip to ``a <= t0 + ALPHA*L*lam <= b`` (arrival inside the window)."""
+    shear = ALPHA_NS_PER_M_A * distance_m
+    poly = _clip_halfplane(poly, (-a_ns, 1.0, shear))  # t0 + s*lam - a >= 0
+    return _clip_halfplane(poly, (b_ns, -1.0, -shear))  # b - t0 - s*lam >= 0
+
+
+def _polygon_area_centroid(poly: np.ndarray) -> tuple[float, float]:
+    """(area, centroid wavelength) by the shoelace formula."""
+    if len(poly) < 3:
+        return 0.0, np.nan
+    x, y = poly[:, 0], poly[:, 1]
+    xn, yn = np.roll(x, -1), np.roll(y, -1)
+    cross = x * yn - xn * y
+    area = cross.sum() / 2.0
+    if abs(area) < 1e-30:
+        return 0.0, float(y.mean())
+    cy = ((y + yn) * cross).sum() / (6.0 * area)
+    return abs(area), float(cy)
+
+
+def _arrival_times(poly: np.ndarray, distance_m: float) -> np.ndarray:
+    return poly[:, 0] + ALPHA_NS_PER_M_A * distance_m * poly[:, 1]
+
+
+def propagate_cascade(
+    choppers: Sequence[DiskChopper],
+    *,
+    pulse_period_ns: float,
+    pulse_length_ns: float,
+    wavelength_min_a: float = 0.1,
+    wavelength_max_a: float = 25.0,
+    stride: int = 1,
+) -> list[np.ndarray]:
+    """Clip the source pulse(s) through every chopper; return subframes.
+
+    One rectangle per source pulse in the frame period (``stride`` pulses,
+    frame period = stride * pulse period), clipped at each chopper (sorted
+    by distance) against its open windows. Returns the surviving polygons as
+    [n, 2] (emission time ns, wavelength angstrom) arrays. An empty list
+    means the cascade blocks the beam entirely.
+    """
+    if stride < 1:
+        raise ValueError("stride must be >= 1")
+    lam_lo, lam_hi = float(wavelength_min_a), float(wavelength_max_a)
+    polygons: list[np.ndarray] = [
+        np.array(
+            [
+                [k * pulse_period_ns, lam_lo],
+                [k * pulse_period_ns + pulse_length_ns, lam_lo],
+                [k * pulse_period_ns + pulse_length_ns, lam_hi],
+                [k * pulse_period_ns, lam_hi],
+            ]
+        )
+        for k in range(stride)
+    ]
+    for chopper in sorted(choppers, key=lambda c: c.distance_m):
+        next_polys: list[np.ndarray] = []
+        for poly in polygons:
+            t = _arrival_times(poly, chopper.distance_m)
+            for a, b in chopper.open_windows(float(t.min()), float(t.max())):
+                clipped = _clip_time_window(poly, chopper.distance_m, a, b)
+                if len(clipped) >= 3:
+                    next_polys.append(clipped)
+        polygons = next_polys
+        if not polygons:
+            break
+    return polygons
+
+
+def wavelength_band_at(
+    subframes: Sequence[np.ndarray],
+    distance_m: float,
+    *,
+    frame_period_ns: float,
+    time_edges_ns: np.ndarray,
+) -> np.ndarray:
+    """Mean transmitted wavelength per event_time_offset bin at one distance.
+
+    Arrival times are folded modulo the frame period (event_time_offset is
+    the wrapped TOF the wire carries); a polygon straddling the wrap
+    boundary contributes to both ends. Bins with no coverage are NaN —
+    downstream treats NaN as "beam blocked here" (reference
+    make_wavelength_bands_from_frames: all-NaN row = chopper blocks beam).
+    """
+    n_bins = len(time_edges_ns) - 1
+    weight = np.zeros(n_bins)
+    weighted_lam = np.zeros(n_bins)
+    for poly in subframes:
+        t = _arrival_times(poly, distance_m)
+        # One shifted copy per frame period the polygon's arrival span
+        # touches (physical cascades produce subframes narrower than one
+        # period — two copies for a wrap straddle; the unchopped source
+        # rectangle can span several).
+        k_lo = int(np.floor(t.min() / frame_period_ns))
+        k_hi = int(np.floor(t.max() / frame_period_ns)) + 1
+        for offset in (k * frame_period_ns for k in range(k_lo, k_hi + 1)):
+            shifted = poly.copy()
+            # Shift emission time so arrival-time-at-distance is wrapped.
+            shifted[:, 0] -= offset
+            t_s = _arrival_times(shifted, distance_m)
+            lo, hi = float(t_s.min()), float(t_s.max())
+            if hi <= 0 or lo >= frame_period_ns:
+                continue
+            first = max(0, int(np.searchsorted(time_edges_ns, lo) - 1))
+            last = min(n_bins, int(np.searchsorted(time_edges_ns, hi) + 1))
+            for i in range(first, last):
+                piece = _clip_time_window(
+                    shifted, distance_m, time_edges_ns[i], time_edges_ns[i + 1]
+                )
+                area, lam = _polygon_area_centroid(piece)
+                if area > 0:
+                    weight[i] += area
+                    weighted_lam[i] += area * lam
+    with np.errstate(invalid="ignore"):
+        return np.where(weight > 0, weighted_lam / np.maximum(weight, 1e-300), np.nan)
+
+
+def wavelength_lut(
+    subframes: Sequence[np.ndarray],
+    *,
+    distances_m: np.ndarray,
+    frame_period_ns: float,
+    n_time_bins: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """(table [n_distance, n_time], time_edges_ns [n_time+1]).
+
+    The published LUT: mean transmitted wavelength vs (flight distance,
+    event_time_offset). The hot path converts events by a 2-D gather into
+    this table (device-side), so its size — not event count — bounds the
+    recompute cost.
+    """
+    time_edges = np.linspace(0.0, frame_period_ns, n_time_bins + 1)
+    table = np.full((len(distances_m), n_time_bins), np.nan)
+    for i, distance in enumerate(np.asarray(distances_m, dtype=float)):
+        table[i] = wavelength_band_at(
+            subframes,
+            distance,
+            frame_period_ns=frame_period_ns,
+            time_edges_ns=time_edges,
+        )
+    return table, time_edges

@@ -1,0 +1,282 @@
+"""Pallas tiled 2-D histogram kernel — MXU accumulation for bin spaces
+far beyond VMEM (the LOKI-scale 1.5M-pixel x 100-TOA headline space).
+
+Why
+---
+XLA's TPU ``scatter_add`` runs on the scalar core, serially: ~11 ns/event
+measured at LOKI scale, which pins the device-resident histogram step at
+~93M events/s (PERF.md "Where the time goes"). ``ops/pallas_hist.py``
+breaks that ceiling only for bin spaces that fit VMEM in one tile. This
+kernel handles the other regime: the output lives in HBM, tiled into
+VMEM-sized *blocks* of ``bpb`` bins, and events are pre-partitioned by
+block on the host so each output block is visited exactly once, by a
+consecutive run of grid steps.
+
+How
+---
+1. **Host partition** (``partition_events_host`` / native
+   ``ld_partition``): a counting sort groups flat bin indices by
+   ``block = flat >> log2(bpb)`` and pads each used block's events up to a
+   multiple of the chunk size ``C`` with ``-1``. Emits the padded event
+   array plus a non-decreasing int32 ``chunk -> block`` map.
+2. **Pallas grid over chunks** with the map scalar-prefetched: the output
+   BlockSpec indexes ``window[map[j]]``, so consecutive chunks of one
+   block accumulate in VMEM and the block is flushed to HBM once when the
+   map advances (TPU revisiting semantics). ``input_output_aliases``
+   makes the kernel accumulate **in place** into the donated window
+   state: blocks with no events are never touched.
+3. **MXU accumulation**: within a chunk the local offset decomposes as
+   ``local = hi * 128 + lo``; one-hot matrices over ``hi`` ([C, bpb/128])
+   and ``lo`` ([C, 128]) are built with two VPU compares and contracted
+   over the chunk axis on the MXU (bf16 one-hots — 0/1 are exact — with
+   float32 accumulation): ``counts[hi, lo] += onehot_hi^T @ onehot_lo``.
+   The serial 11 ns/event scatter becomes ~2*bpb MXU FLOPs/event, which
+   at bpb=65536 is ~1.3e5 FLOPs — well under 1 ns/event at v5e bf16
+   rates, leaving the host partition and HBM traffic as the new bounds.
+
+Out-of-range/padded events (``flat = -1`` after block-local shift) have a
+negative ``hi`` and match no one-hot row, so they are dropped for free —
+the same semantics as the scatter path's dump-bin routing.
+
+The state arrays for ``method='pallas2d'`` are padded to ``n_blocks*bpb``
+(the dump bin and the padding tail are excluded from all views, exactly
+like the existing dump-bin slot).
+
+Reference parity: this replaces the same scipp CPU ``hist`` call as the
+scatter path (reference preprocessors/to_nxevent_data.py:180-199); it is
+a pure performance variant with bit-identical counts (asserted against
+the scatter in tests/ops/pallas_hist2d_test.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "DEFAULT_BPB",
+    "DEFAULT_CHUNK",
+    "bucketed_chunks",
+    "chunk_capacity",
+    "partition_events_host",
+    "scatter_add_pallas2d",
+    "padded_bins",
+]
+
+#: Default bins-per-block: 64Ki f32 = 256 KiB VMEM per output tile.
+DEFAULT_BPB = 65536
+#: Default events per grid step (chunk).
+DEFAULT_CHUNK = 512
+#: Chunk-count bucket: the padded chunk count rounds up to a multiple of
+#: this so the jit cache sees a handful of shapes, not one per batch.
+_CHUNK_BUCKET = 512
+
+_LANES = 128
+
+
+def padded_bins(n_bins_incl_dump: int, bpb: int = DEFAULT_BPB) -> int:
+    """State size for pallas2d: bins (incl. dump) padded to whole blocks."""
+    n_blocks = -(-n_bins_incl_dump // bpb)
+    return n_blocks * bpb
+
+
+def chunk_capacity(
+    n_items: int, n_blocks: int, chunk: int = DEFAULT_CHUNK
+) -> int:
+    """Worst-case chunk count for a partition of ``n_items`` events
+    (every used block ends in a partial chunk), bucket-rounded — the ONE
+    bound both native partition entry points allocate against."""
+    cap = n_items // chunk + n_blocks + 1
+    return max(_CHUNK_BUCKET, -(-cap // _CHUNK_BUCKET) * _CHUNK_BUCKET)
+
+
+def bucketed_chunks(used: int) -> int:
+    """Round a used-chunk count up to the jit-cache shape bucket."""
+    return max(_CHUNK_BUCKET, -(-used // _CHUNK_BUCKET) * _CHUNK_BUCKET)
+
+
+def partition_events_host(
+    flat: np.ndarray,
+    n_bins_incl_dump: int,
+    *,
+    bpb: int = DEFAULT_BPB,
+    chunk: int = DEFAULT_CHUNK,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Group flat indices by bin block, pad each block to whole chunks.
+
+    Returns ``(events, chunk_map)``: ``events`` is int32
+    ``[n_chunks * chunk]`` with ``-1`` padding, ``chunk_map`` is int32
+    ``[n_chunks]``, non-decreasing. Out-of-range indices (negative or
+    ``>= n_bins_incl_dump``) are routed to the dump bin
+    (``n_bins_incl_dump - 1``) first — same policy as ``step_flat``.
+
+    The native shim (``ld_partition``) does the counting sort in two C
+    passes — for power-of-two ``bpb`` it derives blocks with a shift;
+    otherwise numpy vectorizes the division and the C pass takes the
+    precomputed block ids. The pure-numpy fallback (no compiler) is a
+    stable argsort + a short fill loop over used blocks.
+    """
+    if bpb % _LANES:
+        raise ValueError("bpb must be a multiple of 128")
+    flat = np.asarray(flat, np.int32)
+    n_blocks = -(-n_bins_incl_dump // bpb)
+
+    try:
+        from ..native import partition_events
+    except ImportError:
+        partition_events = None
+    if partition_events is not None:
+        cap = chunk_capacity(flat.shape[0], n_blocks, chunk)
+        if not (bpb & (bpb - 1)):
+            res = partition_events(
+                flat,
+                n_bins_incl_dump,
+                shift=bpb.bit_length() - 1,
+                chunk=chunk,
+                cap_chunks=cap,
+            )
+        else:
+            dump = n_bins_incl_dump - 1
+            bad = (flat < 0) | (flat >= n_bins_incl_dump)
+            routed = np.where(bad, np.int32(dump), flat) if bad.any() else flat
+            res = partition_events(
+                routed,
+                n_bins_incl_dump,
+                chunk=chunk,
+                cap_chunks=cap,
+                blk=routed // np.int32(bpb),
+                n_blocks=n_blocks,
+            )
+        if res is not None:
+            events, chunk_map, used = res
+            n_padded = bucketed_chunks(used)
+            return events[: n_padded * chunk], chunk_map[:n_padded]
+
+    dump = n_bins_incl_dump - 1
+    bad = (flat < 0) | (flat >= n_bins_incl_dump)
+    if bad.any():
+        flat = np.where(bad, np.int32(dump), flat)
+    blk = flat // np.int32(bpb)
+    counts = np.bincount(blk, minlength=n_blocks)
+    order = np.argsort(blk, kind="stable")
+    s = flat[order]
+    chunks_per_block = -(-counts // chunk)  # 0 for empty blocks
+    n_chunks = int(chunks_per_block.sum())
+    n_padded = bucketed_chunks(n_chunks)
+    events = np.full(n_padded * chunk, -1, np.int32)
+    chunk_map = np.full(n_padded, n_blocks - 1, np.int32)
+    src = 0
+    dst = 0
+    for b in np.nonzero(counts)[0]:
+        c = int(counts[b])
+        k = int(chunks_per_block[b])
+        events[dst * chunk : dst * chunk + c] = s[src : src + c]
+        chunk_map[dst : dst + k] = b
+        src += c
+        dst += k
+    return events, chunk_map
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5), donate_argnums=(0,))
+def _pallas2d_call(
+    window: jax.Array,  # [n_blocks * bpb] float32, donated
+    events: jax.Array,  # [n_chunks * chunk] int32, -1 padded
+    chunk_map: jax.Array,  # [n_chunks] int32, non-decreasing
+    upd,  # traced float32 scalar (1.0 for counts; 1/scale for decay)
+    bpb: int,
+    interpret: bool,
+):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_chunks = chunk_map.shape[0]
+    chunk = events.shape[0] // n_chunks
+    n_blocks = window.shape[0] // bpb
+    h = bpb // _LANES
+    win3 = window.reshape(n_blocks, h, _LANES)
+    rows = events.reshape(n_chunks, chunk)
+    upd_arr = jnp.full((1,), upd, jnp.float32)
+
+    def kernel(map_ref, upd_ref, win_ref, rows_ref, out_ref):
+        j = pl.program_id(0)
+        blk = map_ref[j]
+        prev = map_ref[jnp.maximum(j - 1, 0)]
+        first = (j == 0) | (blk != prev)
+
+        @pl.when(first)
+        def _load():
+            out_ref[...] = win_ref[...]
+
+        local = rows_ref[0, :] - blk * bpb  # [chunk] int32
+        hi = local >> 7  # arithmetic shift: floor div, negatives stay <0
+        lo = local & (_LANES - 1)
+        oh_hi = (
+            hi[:, None]
+            == jax.lax.broadcasted_iota(jnp.int32, (chunk, h), 1)
+        ).astype(jnp.bfloat16)
+        oh_lo = (
+            lo[:, None]
+            == jax.lax.broadcasted_iota(jnp.int32, (chunk, _LANES), 1)
+        ).astype(jnp.bfloat16)
+        contrib = jax.lax.dot_general(
+            oh_hi,
+            oh_lo,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [h, 128]
+        out_ref[0, :, :] += contrib * upd_ref[0]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((1, h, _LANES), lambda j, m, u: (m[j], 0, 0)),
+            pl.BlockSpec((1, chunk), lambda j, m, u: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, _LANES), lambda j, m, u: (m[j], 0, 0)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(win3.shape, jnp.float32),
+        input_output_aliases={2: 0},  # window (after the 2 scalar args)
+        interpret=interpret,
+    )(chunk_map, upd_arr, win3, rows)
+    return out.reshape(n_blocks * bpb)
+
+
+def scatter_add_pallas2d(
+    window: jax.Array,
+    events,
+    chunk_map,
+    *,
+    bpb: int = DEFAULT_BPB,
+    upd: float = 1.0,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Accumulate partitioned events into the padded flat window in place.
+
+    ``window`` must have ``padded_bins(...)`` elements and is donated.
+    ``events``/``chunk_map`` come from ``partition_events_host`` (or the
+    native ``ld_partition``). ``upd`` scales every hit (1.0 for counts;
+    the lazy-decay path passes ``1/scale``).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if bpb % _LANES:
+        raise ValueError("bpb must be a multiple of 128")
+    if window.shape[0] % bpb:
+        raise ValueError(
+            f"window size {window.shape[0]} is not a multiple of bpb={bpb}"
+        )
+    return _pallas2d_call(
+        window,
+        jnp.asarray(events, jnp.int32),
+        jnp.asarray(chunk_map, jnp.int32),
+        jnp.asarray(upd, jnp.float32),
+        bpb,
+        bool(interpret),
+    )

@@ -1,0 +1,109 @@
+"""Single-round-trip publish programs.
+
+A workflow's finalize used to cost three relay round trips: dispatch the
+summary program, fetch its output tree (one transfer per leaf on some
+transports), then dispatch the window fold. Behind a network-attached
+accelerator each round trip is 10-30 ms — at a ~1 Hz publish rate across
+many jobs this dominated ingest->publish p99 (PERF.md round 2).
+
+:class:`PackedPublisher` compiles the whole publish step into ONE jitted
+program that returns the new (donated) state plus every output flattened
+into a single float32 vector, so a publish is exactly one execute call
+and one single-array device->host fetch. The host unpacks by precomputed
+offsets; output keys, shapes and order are recorded at trace time.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["PackedPublisher"]
+
+
+class PackedPublisher:
+    """Wrap ``program(*args) -> (outputs, *carry)`` for one-fetch publish.
+
+    ``program`` must be traceable; ``outputs`` is a dict of arrays (any
+    shapes/dtypes — packed as float32) and ``carry`` is whatever device
+    state flows to the next cycle (e.g. the cleared histogram state).
+    Calling the publisher returns ``(outputs_on_host, *carry)`` where
+    outputs are numpy arrays of the traced shapes.
+
+    ``donate`` names positional args whose buffers the program may reuse
+    (pass the old state's index; defaults to arg 0).
+    """
+
+    def __init__(
+        self,
+        program: Callable,
+        *,
+        donate: tuple[int, ...] = (0,),
+    ) -> None:
+        self._program = program
+        # Output spec (key -> shape) PER input signature: a jit cache can
+        # hold several entries (state rebuilt with different bins, a new
+        # batch shape), and a cached entry executes without retracing — a
+        # single mutable spec would then unpack with whatever the *latest*
+        # trace recorded, silently mislabeling every output. ``__call__``
+        # stamps the signature being dispatched before invoking the jit so
+        # the trace-time hook files its spec under the right key.
+        self._spec_by_sig: dict[tuple, list[tuple[str, tuple[int, ...]]]] = {}
+        self._pending_sig: tuple | None = None
+        self._jit = jax.jit(self._packed, donate_argnums=donate)
+
+    @staticmethod
+    def _signature(args) -> tuple:
+        # Leaves AND treedef: jit keys its cache on both, so two arg
+        # structures with identical flattened leaves must not share a
+        # spec entry.
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        return (
+            treedef,
+            tuple(
+                (tuple(getattr(leaf, "shape", ())),
+                 str(getattr(leaf, "dtype", type(leaf).__name__)))
+                for leaf in leaves
+            ),
+        )
+
+    def _trace_spec(self, args) -> list[tuple[str, tuple[int, ...]]]:
+        """Output spec for ``args`` via abstract evaluation (no compile)."""
+        out = jax.eval_shape(lambda *a: self._program(*a)[0], *args)
+        return [(k, tuple(v.shape)) for k, v in out.items()]
+
+    def _packed(self, *args):
+        outputs, *carry = self._program(*args)
+        spec = [(k, tuple(v.shape)) for k, v in outputs.items()]
+        if self._pending_sig is not None:
+            self._spec_by_sig[self._pending_sig] = spec
+        if outputs:
+            packed = jnp.concatenate(
+                [jnp.ravel(v).astype(jnp.float32) for v in outputs.values()]
+            )
+        else:
+            packed = jnp.zeros((0,), jnp.float32)
+        return (packed, *carry)
+
+    def __call__(self, *args):
+        sig = self._signature(args)
+        self._pending_sig = sig
+        packed, *carry = self._jit(*args)
+        spec = self._spec_by_sig.get(sig)
+        if spec is None:
+            # A cache hit under a host signature we have not seen (e.g. a
+            # python float where a np scalar was traced): derive the spec
+            # with an abstract eval of the program at this signature.
+            spec = self._spec_by_sig[sig] = self._trace_spec(args)
+        flat = np.asarray(jax.device_get(packed))
+        outputs: dict[str, np.ndarray] = {}
+        offset = 0
+        for key, shape in spec:
+            size = int(np.prod(shape)) if shape else 1
+            view = flat[offset : offset + size]
+            outputs[key] = view.reshape(shape) if shape else view[0]
+            offset += size
+        return (outputs, *carry)

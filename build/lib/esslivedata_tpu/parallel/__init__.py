@@ -1,0 +1,18 @@
+"""Device-mesh parallelism: sharded histogramming and collective reductions.
+
+The reference scales out with OS processes partitioned by Kafka topic
+(SURVEY.md section 2.10) and has no collective backend at all; compute-level
+scale-out here is TPU-native instead: a ``jax.sharding.Mesh`` with a
+``data`` axis (event-stream shards, the DP analog) and a ``bank`` axis
+(bin-space shards over detector banks/screen rows — the TP/SP analog for a
+histogramming workload, cf. SURVEY.md section 5 "long-context" note), with
+XLA collectives (psum) riding ICI for cross-shard merges and
+monitor/detector normalization. Kafka over DCN remains the inter-host
+system bus, unchanged.
+"""
+
+from .mesh import make_mesh
+from .sharded_hist import ShardedHistogrammer
+from .sharded_qhist import ShardedQHistogrammer
+
+__all__ = ["ShardedHistogrammer", "ShardedQHistogrammer", "make_mesh"]

@@ -1,0 +1,49 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh"]
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    *,
+    data: int | None = None,
+    bank: int | None = None,
+    devices=None,
+) -> Mesh:
+    """Build a 2-D ('data', 'bank') mesh over the first ``n_devices`` devices.
+
+    ``data`` shards the event stream (DP analog); ``bank`` shards bin space
+    (TP/SP analog). If only one of data/bank is given the other is inferred;
+    if neither, devices all go to ``bank`` (bin-space sharding is the
+    memory-relieving axis, which is the usual reason to shard).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = devices[:n_devices]
+    if len(devices) < n_devices:
+        raise ValueError(
+            f"Requested {n_devices} devices, only {len(devices)} available"
+        )
+    if data is None and bank is None:
+        data, bank = 1, n_devices
+    elif data is None:
+        if n_devices % bank:
+            raise ValueError(f"{n_devices} devices not divisible by bank={bank}")
+        data = n_devices // bank
+    elif bank is None:
+        if n_devices % data:
+            raise ValueError(f"{n_devices} devices not divisible by data={data}")
+        bank = n_devices // data
+    if data * bank != n_devices:
+        raise ValueError(f"data*bank = {data * bank} != n_devices = {n_devices}")
+    arr = np.asarray(devices).reshape(data, bank)
+    return Mesh(arr, ("data", "bank"))

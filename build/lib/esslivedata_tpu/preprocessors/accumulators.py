@@ -1,0 +1,208 @@
+"""Dense-data accumulators over labeled DataArrays.
+
+Parity with reference ``preprocessors/accumulators.py``: ``Cumulative``
+(+= with restart on structural mismatch, reference :238-261),
+``LatestValueAccumulator`` (context, :57), ``NullAccumulator`` (:46).
+The reference's NoCopyAccumulator exists to avoid deepcopying a 500 MB
+histogram on every read (:96-97). That problem does not arise here *by
+construction*: large histograms are device-resident kernel state with
+fold semantics (ops/histogram.py — window and cumulative share one
+scatter, reads are device views), and host-side accumulators only ever
+hold the small dense outputs. ``Cumulative`` therefore defaults to
+no-copy reads; ``WindowedCumulative`` provides the paired
+window/cumulative semantics for dense streams that never touch the
+accelerator, staying aliasing-safe by transferring window ownership on
+``take`` and copying the cumulative.
+"""
+
+from __future__ import annotations
+
+from typing import ClassVar
+
+import numpy as np
+
+from ..core.timestamp import Timestamp
+from ..utils.labeled import DataArray, Variable
+
+__all__ = [
+    "Cumulative",
+    "LatestValueAccumulator",
+    "NullAccumulator",
+    "WindowedCumulative",
+]
+
+
+class NullAccumulator:
+    """Swallows everything; for streams a service must consume but ignore."""
+
+    is_context: ClassVar[bool] = False
+
+    def add(self, timestamp: Timestamp, data: object) -> None:
+        pass
+
+    def get(self) -> None:
+        return None
+
+    def clear(self) -> None:
+        pass
+
+    def release_buffers(self) -> None:
+        pass
+
+
+class LatestValueAccumulator:
+    """Keeps the most recent value — context streams (motor positions,
+    chopper settings) that parameterize workflows. is_context=True gates
+    job activation until a value exists (ADR 0002)."""
+
+    is_context: ClassVar[bool] = True
+
+    def __init__(self) -> None:
+        self._value = None
+        self._timestamp: Timestamp | None = None
+
+    def add(self, timestamp: Timestamp, data: object) -> None:
+        if self._timestamp is None or timestamp >= self._timestamp:
+            self._value = data
+            self._timestamp = timestamp
+
+    @property
+    def has_value(self) -> bool:
+        return self._value is not None
+
+    def get(self):
+        if self._value is None:
+            raise ValueError("LatestValueAccumulator is empty")
+        return self._value
+
+    def clear(self) -> None:
+        self._value = None
+        self._timestamp = None
+
+    def release_buffers(self) -> None:
+        pass
+
+
+class Cumulative:
+    """Running += of DataArrays, restarting when structure changes.
+
+    A structural mismatch (different dims/shape/unit/coords — e.g. the
+    upstream reconfigured its binning or an ad00 camera changed ROI) resets
+    the accumulation to the new value instead of erroring, matching the
+    reference's restart-on-mismatch behavior (accumulators.py:238-261).
+
+    This subsumes the reference's ``reset_coord`` knob
+    (NoCopyAccumulator:114-127): geometry is carried as coordinates
+    (monitor position, detector transform), and ``same_structure`` compares
+    coordinate *values* — so accumulation already restarts when the
+    geometry moves, without naming the coord up front.
+
+    ``clear_on_get`` gives window semantics (value since last read);
+    otherwise since-start. Reads are no-copy by default: callers must not
+    mutate the returned array (copy_on_get=True for defensive copies).
+    """
+
+    is_context: ClassVar[bool] = False
+
+    def __init__(
+        self, *, clear_on_get: bool = False, copy_on_get: bool = False
+    ) -> None:
+        self._clear_on_get = clear_on_get
+        self._copy_on_get = copy_on_get
+        self._value: DataArray | None = None
+
+    def add(self, timestamp: Timestamp, data: DataArray) -> None:
+        if self._value is not None and self._value.same_structure(data):
+            self._value += data
+        else:
+            # restart: first value, or structure changed upstream (incl.
+            # geometry coords — see class docstring)
+            self._value = data.copy()
+
+    @property
+    def is_empty(self) -> bool:
+        return self._value is None
+
+    @property
+    def current(self) -> DataArray | None:
+        """No-copy peek at the accumulated value (None when empty);
+        callers must not mutate it."""
+        return self._value
+
+    def get(self) -> DataArray:
+        if self._value is None:
+            raise ValueError("Cumulative accumulator is empty")
+        value = self._value
+        if self._copy_on_get:
+            value = value.copy()
+        if self._clear_on_get:
+            self._value = None
+        return value
+
+    def clear(self) -> None:
+        self._value = None
+
+    def release_buffers(self) -> None:
+        pass
+
+
+def _zero_like(da: DataArray) -> DataArray:
+    out = da.copy()
+    out.data = Variable(
+        np.zeros_like(np.asarray(da.values)), da.dims, da.unit
+    )
+    return out
+
+
+class WindowedCumulative:
+    """Paired window/cumulative views of one dense stream.
+
+    One ``add`` feeds both views; ``take`` returns ``(window,
+    cumulative)`` and resets the window while the cumulative persists —
+    the host-side analog of the device kernel's fold semantics
+    (docs/design/fold-semantics.md), for the non-event streams that
+    never touch the accelerator: da00 camera frames, rebinned monitor
+    histograms, dense log aggregates.
+
+    Composed from two :class:`Cumulative` instances so restart-on-
+    mismatch semantics live in exactly one place. Incoming samples are
+    unit-aligned to the cumulative before feeding the window: a window
+    restarting just after ``take`` must not adopt a new compatible unit
+    while the cumulative keeps converting into its original one — both
+    views of one stream always share a unit.
+    """
+
+    is_context: ClassVar[bool] = False
+
+    def __init__(self) -> None:
+        self._cumulative = Cumulative(copy_on_get=True)
+        self._window = Cumulative(clear_on_get=True)
+
+    def add(self, timestamp: Timestamp, data: DataArray) -> None:
+        self._cumulative.add(timestamp, data)
+        anchor = self._cumulative.current
+        if anchor is not None and anchor.unit != data.unit:
+            data = data.to_unit(anchor.unit)
+        self._window.add(timestamp, data)
+
+    @property
+    def is_empty(self) -> bool:
+        return self._cumulative.is_empty
+
+    def take(self) -> tuple[DataArray, DataArray]:
+        """(window, cumulative); the window transfers ownership and
+        resets, the cumulative is a defensive copy."""
+        cumulative = self._cumulative.get()
+        if self._window.is_empty:
+            window = _zero_like(cumulative)
+        else:
+            window = self._window.get()
+        return window, cumulative
+
+    def clear(self) -> None:
+        self._window.clear()
+        self._cumulative.clear()
+
+    def release_buffers(self) -> None:
+        pass
+

@@ -1,0 +1,119 @@
+"""Event staging: ev44 chunks -> fixed-shape padded device batches.
+
+TPU-native equivalent of the reference's ``to_nxevent_data.py`` +
+``group_by_pixel.py``: the reference builds a scipp binned array (events
+binned by pulse) and then groups by detector_number so workflows can
+histogram; here the accumulator only *stages* raw event arrays into a
+reusable padded host buffer (ops/event_batch.StagingBuffer) — the jitted
+scatter kernel does projection+grouping+binning in one pass on device. The
+zero-copy / release_buffers contract is the same as the reference's
+(_buffers_in_use guard, to_nxevent_data.py:166-171).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import ClassVar
+
+import numpy as np
+
+from ..core.timestamp import Timestamp
+from ..ops.event_batch import EventBatch, make_staging_buffer
+
+__all__ = ["DetectorEvents", "MonitorEvents", "StagedEvents", "ToEventBatch"]
+
+
+@dataclass(frozen=True, slots=True)
+class MonitorEvents:
+    """Decoded ev44 monitor chunk: times of arrival only (the fast-path
+    adapter skips pixel ids, reference message_adapter.py:360)."""
+
+    time_of_arrival: np.ndarray  # ns within pulse
+
+    @property
+    def n_events(self) -> int:
+        return int(self.time_of_arrival.shape[0])
+
+
+@dataclass(frozen=True, slots=True)
+class DetectorEvents:
+    """Decoded ev44 detector chunk: pixel ids + times of arrival."""
+
+    pixel_id: np.ndarray
+    time_of_arrival: np.ndarray
+
+    @property
+    def n_events(self) -> int:
+        return int(self.pixel_id.shape[0])
+
+
+@dataclass(slots=True)
+class StagedEvents:
+    """One window's worth of staged events, ready for the device kernel."""
+
+    batch: EventBatch
+    first_timestamp: Timestamp | None
+    last_timestamp: Timestamp | None
+    n_chunks: int
+
+    @property
+    def n_events(self) -> int:
+        return self.batch.n_valid
+
+
+class ToEventBatch:
+    """Accumulator staging event chunks into one padded device batch.
+
+    Accepts DetectorEvents or MonitorEvents (monitor events get pixel_id 0,
+    so a monitor is screen row 0 of a 1-row histogram).
+    """
+
+    is_context: ClassVar[bool] = False
+
+    def __init__(
+        self, min_bucket: int | None = None, prefer_native: bool = True
+    ) -> None:
+        if min_bucket:
+            self._buffer = make_staging_buffer(min_bucket, prefer_native)
+        else:
+            self._buffer = make_staging_buffer(prefer_native=prefer_native)
+        self._first: Timestamp | None = None
+        self._last: Timestamp | None = None
+        self._n_chunks = 0
+
+    def add(self, timestamp: Timestamp, data: DetectorEvents | MonitorEvents) -> None:
+        toa = np.asarray(data.time_of_arrival)
+        if isinstance(data, MonitorEvents) or not hasattr(data, "pixel_id"):
+            pixel_id = np.zeros(toa.shape[0], dtype=np.int32)
+        else:
+            pixel_id = np.asarray(data.pixel_id)
+        self._buffer.add(
+            pixel_id.astype(np.int32, copy=False),
+            toa.astype(np.float32, copy=False),
+        )
+        if self._first is None or timestamp < self._first:
+            self._first = timestamp
+        if self._last is None or timestamp > self._last:
+            self._last = timestamp
+        self._n_chunks += 1
+
+    def get(self) -> StagedEvents:
+        staged = StagedEvents(
+            batch=self._buffer.take(),
+            first_timestamp=self._first,
+            last_timestamp=self._last,
+            n_chunks=self._n_chunks,
+        )
+        return staged
+
+    def clear(self) -> None:
+        self._buffer.clear()
+        self._first = None
+        self._last = None
+        self._n_chunks = 0
+
+    def release_buffers(self) -> None:
+        self._buffer.release()
+        self._first = None
+        self._last = None
+        self._n_chunks = 0

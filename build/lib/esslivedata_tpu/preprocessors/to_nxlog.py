@@ -1,0 +1,137 @@
+"""f144 log chunks -> growing NXlog-style time/value DataArray.
+
+Parity with reference ``preprocessors/to_nxlog.py:15``: accumulates
+(time, value) samples into a time-sorted DataArray with a ns-epoch time
+coord. Context accumulator (is_context=True): log values parameterize
+workflows. Backed by doubling host arrays like the reference's growable
+buffers, with no-copy reads of the filled slice.
+"""
+
+from __future__ import annotations
+
+from typing import ClassVar
+
+import numpy as np
+
+from ..core.timestamp import Timestamp
+from ..utils.labeled import DataArray, Variable
+from ..utils.units import Unit, unit as parse_unit
+
+__all__ = ["LogData", "ToNXlog"]
+
+
+class LogData:
+    """One decoded f144 sample (or batch of samples).
+
+    ``target``/``idle`` are populated only on synthesized Device samples
+    (DeviceSynthesizer merges a motor's RBV/VAL/DMOV substreams into one
+    stream; reference kafka/device_synthesizer.py).
+    """
+
+    __slots__ = ("idle", "target", "time", "value")
+
+    def __init__(
+        self,
+        time: np.ndarray | int,
+        value: np.ndarray,
+        target: float | None = None,
+        idle: bool | None = None,
+    ) -> None:
+        self.time = np.atleast_1d(np.asarray(time, dtype=np.int64))  # ns epoch
+        self.value = np.atleast_1d(np.asarray(value))
+        self.target = target
+        self.idle = idle
+
+    def samples(self) -> list[tuple[int, float]]:
+        """``(time_ns, value)`` pairs for consumers that walk sample-wise.
+
+        An f144 payload can carry an array value under a single timestamp
+        (the adapter keeps array values whole); the one timestamp then
+        applies to every element. Mismatched multi-element lengths raise.
+        """
+        if self.time.size == 1 and self.value.size != 1:
+            times: np.ndarray = np.broadcast_to(self.time, self.value.shape)
+        else:
+            times = self.time
+        return list(zip(times.tolist(), self.value.tolist(), strict=True))
+
+
+class ToNXlog:
+    """Accumulates log samples into a growing time/value series."""
+
+    is_context: ClassVar[bool] = True
+
+    def __init__(self, value_unit: str | Unit | None = None, name: str = "") -> None:
+        self._unit = parse_unit(value_unit)
+        self._name = name
+        self._capacity = 64
+        self._times = np.zeros(self._capacity, dtype=np.int64)
+        self._values: np.ndarray | None = None
+        self._n = 0
+        self._sorted = True
+
+    def _grow(self, needed: int) -> None:
+        cap = self._capacity
+        while cap < needed:
+            cap *= 2
+        times = np.zeros(cap, dtype=np.int64)
+        times[: self._n] = self._times[: self._n]
+        self._times = times
+        if self._values is not None:
+            values = np.zeros((cap,) + self._values.shape[1:], self._values.dtype)
+            values[: self._n] = self._values[: self._n]
+            self._values = values
+        self._capacity = cap
+
+    def add(self, timestamp: Timestamp, data: LogData) -> None:  # noqa: ARG002
+        k = data.time.shape[0]
+        if k == 0:
+            return
+        if self._values is None:
+            self._values = np.zeros(
+                (self._capacity,) + data.value.shape[1:], data.value.dtype
+            )
+        if self._n + k > self._capacity:
+            self._grow(self._n + k)
+        self._times[self._n : self._n + k] = data.time
+        self._values[self._n : self._n + k] = data.value
+        if self._n > 0 and data.time[0] < self._times[self._n - 1]:
+            self._sorted = False
+        self._n += k
+
+    @property
+    def n_samples(self) -> int:
+        return self._n
+
+    @property
+    def has_value(self) -> bool:
+        return self._n > 0
+
+    def get(self) -> DataArray:
+        if self._n == 0:
+            raise ValueError("ToNXlog is empty")
+        if not self._sorted:
+            order = np.argsort(self._times[: self._n], kind="stable")
+            self._times[: self._n] = self._times[: self._n][order]
+            self._values[: self._n] = self._values[: self._n][order]
+            self._sorted = True
+        dims = ("time",) + tuple(
+            f"dim_{i}" for i in range(1, self._values.ndim)
+        )
+        return DataArray(
+            Variable(self._values[: self._n], dims, self._unit),
+            coords={"time": Variable(self._times[: self._n], ("time",), "ns")},
+            name=self._name,
+        )
+
+    def latest(self):
+        if self._n == 0:
+            raise ValueError("ToNXlog is empty")
+        return self._values[self._n - 1]
+
+    def clear(self) -> None:
+        self._n = 0
+        self._sorted = True
+
+    def release_buffers(self) -> None:
+        pass

@@ -1,0 +1,2 @@
+"""Service entry points and assembly (reference: services/ +
+service_factory.py; SURVEY.md section 2.5)."""

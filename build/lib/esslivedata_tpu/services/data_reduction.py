@@ -1,0 +1,60 @@
+"""Data-reduction service (reference: services/data_reduction.py:18):
+hosts full reductions consuming detectors + monitors + logs."""
+
+from __future__ import annotations
+
+from ..config.instrument import instrument_registry
+from ..kafka.routes import RoutingAdapterBuilder
+from ..preprocessors.factories import ReductionPreprocessorFactory
+from .service_factory import DataServiceBuilder, DataServiceRunner
+
+__all__ = ["main", "make_reduction_service_builder"]
+
+
+def make_reduction_service_builder(
+    *,
+    instrument: str,
+    dev: bool = False,
+    batcher=None,
+    job_threads: int = 5,
+    heartbeat_interval_s: float = 2.0,
+    snapshot_dir: str | None = None,
+) -> DataServiceBuilder:
+    # Merged-detector instruments (BIFROST) address reductions at the
+    # single logical stream; the reduction service must apply the same
+    # adaptation the detector service does or jobs subscribed to the
+    # merged name never see events.
+    merge = instrument_registry[instrument].merge_detectors
+
+    def routes(mapping):
+        return (
+            RoutingAdapterBuilder(stream_mapping=mapping)
+            .with_detector_route(merge_detectors=merge)
+            .with_monitor_route()
+            .with_logdata_route()
+            .with_run_control_route()
+            .with_commands_route()
+            .build()
+        )
+
+    return DataServiceBuilder(
+        instrument=instrument,
+        service_name="data_reduction",
+        preprocessor_factory=ReductionPreprocessorFactory(),
+        route_builder=routes,
+        batcher=batcher,
+        job_threads=job_threads,
+        dev=dev,
+        heartbeat_interval_s=heartbeat_interval_s,
+        snapshot_dir=snapshot_dir,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    return DataServiceRunner(
+        service_name="data_reduction", make_builder=make_reduction_service_builder
+    ).run(argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

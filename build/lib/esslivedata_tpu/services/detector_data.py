@@ -1,0 +1,56 @@
+"""Detector-data service (reference: services/detector_data.py:18)."""
+
+from __future__ import annotations
+
+from ..kafka.routes import RoutingAdapterBuilder
+from ..preprocessors.factories import DetectorPreprocessorFactory
+from .service_factory import DataServiceBuilder, DataServiceRunner
+
+__all__ = ["main", "make_detector_service_builder"]
+
+
+def make_detector_service_builder(
+    *,
+    instrument: str,
+    dev: bool = False,
+    batcher=None,
+    job_threads: int = 5,
+    heartbeat_interval_s: float = 2.0,
+    snapshot_dir: str | None = None,
+) -> DataServiceBuilder:
+    from ..config.instrument import instrument_registry
+
+    merge = instrument_registry[instrument].merge_detectors
+
+    def routes(mapping):
+        return (
+            RoutingAdapterBuilder(stream_mapping=mapping)
+            .with_detector_route(merge_detectors=merge)
+            .with_area_detector_route()
+            .with_logdata_route()
+            .with_run_control_route()
+            .with_commands_route()
+            .build()
+        )
+
+    return DataServiceBuilder(
+        instrument=instrument,
+        service_name="detector_data",
+        preprocessor_factory=DetectorPreprocessorFactory(),
+        route_builder=routes,
+        batcher=batcher,
+        job_threads=job_threads,
+        dev=dev,
+        heartbeat_interval_s=heartbeat_interval_s,
+        snapshot_dir=snapshot_dir,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    return DataServiceRunner(
+        service_name="detector_data", make_builder=make_detector_service_builder
+    ).run(argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

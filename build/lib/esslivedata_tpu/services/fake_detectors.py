@@ -1,0 +1,119 @@
+"""Standalone fake detector producer: synthesizes 14 Hz ev44 streams onto a
+real broker (reference: services/fake_detectors.py FakeDetectorSource:52).
+Without confluent_kafka it can print-to-stdout for smoke checks."""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from ..config.instrument import instrument_registry
+from ..core.constants import PULSE_RATE_HZ
+from ..core.service import get_env_defaults, setup_arg_parser
+from .fake_sources import (
+    FakeDetectorStream,
+    ReplayDetectorStream,
+    load_nexus_events,
+)
+
+__all__ = ["main"]
+
+logger = logging.getLogger(__name__)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = setup_arg_parser("fake ev44 detector producer")
+    parser.add_argument("--events-per-pulse", type=int, default=1000)
+    parser.add_argument(
+        "--replay",
+        default=None,
+        metavar="NEXUS_FILE",
+        help="replay recorded NXevent_data instead of synthesizing "
+        "(reference FakeDetectorSource nexus_file); banks present in the "
+        "recording replay with their recorded pixel/TOF distributions "
+        "and per-pulse raggedness, others stay synthetic",
+    )
+    parser.add_argument("--kafka-bootstrap", default=None, help="override the broker from the kafka config namespace")
+    parser.add_argument("--pulses", type=int, default=0, help="0 = run forever")
+    parser.add_argument("--dry-run", action="store_true")
+    parser.set_defaults(**get_env_defaults(parser))
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=args.log_level)
+
+    instrument = instrument_registry[args.instrument]
+    prefix = f"dev_{args.instrument}" if args.dev else args.instrument
+    recorded = {}
+    if args.replay:
+        recorded = load_nexus_events(args.replay)
+        logger.info(
+            "replaying %s: %s",
+            args.replay,
+            {k: v.n_events for k, v in recorded.items()},
+        )
+    streams = []
+    for i, (name, det) in enumerate(instrument.detectors.items()):
+        if name in recorded:
+            streams.append(
+                ReplayDetectorStream(
+                    topic=f"{prefix}_detector",
+                    source_name=det.source_name,
+                    recorded=recorded[name],
+                    events_per_pulse=args.events_per_pulse,
+                )
+            )
+        else:
+            streams.append(
+                FakeDetectorStream(
+                    topic=f"{prefix}_detector",
+                    source_name=det.source_name,
+                    detector_ids=(
+                        det.detector_number
+                        if det.detector_number is not None
+                        else det.pixel_ids
+                    ),
+                    events_per_pulse=args.events_per_pulse,
+                    seed=i,
+                )
+            )
+
+    producer = None
+    if not args.dry_run:
+        try:
+            from confluent_kafka import Producer
+
+            from ..kafka.consumer import kafka_client_config
+
+            producer = Producer(kafka_client_config(bootstrap_override=args.kafka_bootstrap))
+        except ImportError:
+            logger.error("confluent_kafka not installed; use --dry-run")
+            return 2
+
+    period = 1.0 / PULSE_RATE_HZ
+    produced = 0
+    try:
+        while args.pulses == 0 or produced < args.pulses:
+            t0 = time.monotonic()
+            for stream in streams:
+                for msg in stream.pulses(1):
+                    if producer is None:
+                        logger.info(
+                            "pulse %d: %d bytes -> %s",
+                            produced,
+                            len(msg.value()),
+                            msg.topic(),
+                        )
+                    else:
+                        producer.produce(msg.topic(), msg.value())
+            if producer is not None:
+                producer.poll(0)
+            produced += 1
+            time.sleep(max(0.0, period - (time.monotonic() - t0)))
+    except KeyboardInterrupt:
+        pass
+    if producer is not None:
+        producer.flush(5)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

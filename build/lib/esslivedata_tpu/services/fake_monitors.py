@@ -1,0 +1,73 @@
+"""Standalone fake monitor producer (reference: services/fake_monitors.py)."""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from ..config.instrument import instrument_registry
+from ..core.constants import PULSE_RATE_HZ
+from ..core.service import get_env_defaults, setup_arg_parser
+from .fake_sources import FakeMonitorStream
+
+__all__ = ["main"]
+
+logger = logging.getLogger(__name__)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = setup_arg_parser("fake ev44 monitor producer")
+    parser.add_argument("--events-per-pulse", type=int, default=200)
+    parser.add_argument("--kafka-bootstrap", default=None, help="override the broker from the kafka config namespace")
+    parser.add_argument("--pulses", type=int, default=0)
+    parser.add_argument("--dry-run", action="store_true")
+    parser.set_defaults(**get_env_defaults(parser))
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=args.log_level)
+
+    instrument = instrument_registry[args.instrument]
+    prefix = f"dev_{args.instrument}" if args.dev else args.instrument
+    streams = [
+        FakeMonitorStream(
+            topic=f"{prefix}_monitor",
+            source_name=mon.source_name,
+            events_per_pulse=args.events_per_pulse,
+            seed=i,
+        )
+        for i, mon in enumerate(instrument.monitors.values())
+    ]
+    producer = None
+    if not args.dry_run:
+        try:
+            from confluent_kafka import Producer
+
+            from ..kafka.consumer import kafka_client_config
+
+            producer = Producer(kafka_client_config(bootstrap_override=args.kafka_bootstrap))
+        except ImportError:
+            logger.error("confluent_kafka not installed; use --dry-run")
+            return 2
+    period = 1.0 / PULSE_RATE_HZ
+    produced = 0
+    try:
+        while args.pulses == 0 or produced < args.pulses:
+            t0 = time.monotonic()
+            for stream in streams:
+                for msg in stream.pulses(1):
+                    if producer is None:
+                        logger.info("pulse %d -> %s", produced, msg.topic())
+                    else:
+                        producer.produce(msg.topic(), msg.value())
+            if producer is not None:
+                producer.poll(0)
+            produced += 1
+            time.sleep(max(0.0, period - (time.monotonic() - t0)))
+    except KeyboardInterrupt:
+        pass
+    if producer is not None:
+        producer.flush(5)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,329 @@
+"""Synthetic 14 Hz wire-level streams for tests, demos and benchmarks.
+
+Parity with reference ``services/fake_detectors.py`` (FakeDetectorSource:52)
+/ ``fake_monitors.py`` / ``fake_logdata.py``: generators producing
+serialized ev44/f144/da00 payloads at the pulse cadence, usable (a)
+in-process as a raw message source for broker-less end-to-end runs and (b)
+by the standalone fake-producer services feeding a real broker.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.constants import PULSE_PERIOD_NS_DEN, PULSE_PERIOD_NS_NUM
+from ..kafka import wire
+from ..kafka.source import FakeKafkaMessage
+
+__all__ = [
+    "FakeDetectorStream",
+    "FakeLogStream",
+    "FakeMonitorStream",
+    "RecordedEvents",
+    "ReplayDetectorStream",
+    "load_nexus_events",
+]
+
+
+def _pulse_time_ns(pulse: int) -> int:
+    return -((-pulse * PULSE_PERIOD_NS_NUM) // PULSE_PERIOD_NS_DEN)
+
+
+class FakeDetectorStream:
+    """ev44 detector events: gaussian blob drifting across the panel."""
+
+    def __init__(
+        self,
+        *,
+        topic: str,
+        source_name: str,
+        detector_ids: np.ndarray,
+        events_per_pulse: int = 1000,
+        start_pulse: int = 0,
+        seed: int = 0,
+    ) -> None:
+        self._topic = topic
+        self._source = source_name
+        self._ids = np.asarray(detector_ids).reshape(-1)
+        self._events_per_pulse = events_per_pulse
+        self._pulse = start_pulse
+        self._rng = np.random.default_rng(seed)
+        self._message_id = 0
+
+    def pulses(self, n: int) -> list[FakeKafkaMessage]:
+        out = []
+        for _ in range(n):
+            t_ns = _pulse_time_ns(self._pulse)
+            k = self._events_per_pulse
+            # drifting hot spot over the id space
+            center = (0.5 + 0.4 * np.sin(self._pulse / 50.0)) * self._ids.size
+            # wrap, don't clip: clipping piles the gaussian tails onto the
+            # first/last pixel and dominates cumulative images
+            idx = (
+                self._rng.normal(center, self._ids.size / 8.0, k).astype(np.int64)
+                % self._ids.size
+            )
+            pixel_id = self._ids[idx].astype(np.int32)
+            toa = self._rng.uniform(0, PULSE_PERIOD_NS_NUM / PULSE_PERIOD_NS_DEN, k)
+            buf = wire.encode_ev44(
+                self._source,
+                self._message_id,
+                reference_time=np.array([t_ns], dtype=np.int64),
+                reference_time_index=np.array([0], dtype=np.int32),
+                time_of_flight=toa.astype(np.int32),
+                pixel_id=pixel_id,
+            )
+            out.append(FakeKafkaMessage(buf, self._topic))
+            self._pulse += 1
+            self._message_id += 1
+        return out
+
+
+class RecordedEvents:
+    """One detector bank's recorded NXevent_data, ready for replay.
+
+    ``event_index`` (when the file carries it) marks each recorded
+    pulse's first event, so replay reproduces the file's per-pulse
+    raggedness exactly; without it, pulses are fixed-size slices.
+    """
+
+    __slots__ = ("event_id", "event_time_offset", "event_index")
+
+    def __init__(
+        self,
+        event_id: np.ndarray,
+        event_time_offset: np.ndarray,
+        event_index: np.ndarray | None = None,
+    ) -> None:
+        self.event_id = np.asarray(event_id)
+        self.event_time_offset = np.asarray(event_time_offset)
+        self.event_index = (
+            None if event_index is None else np.asarray(event_index)
+        )
+
+    @property
+    def n_events(self) -> int:
+        return int(self.event_id.size)
+
+    @property
+    def n_pulses(self) -> int | None:
+        return None if self.event_index is None else int(self.event_index.size)
+
+    def pulse_slice(self, pulse: int, fallback_size: int) -> slice:
+        """Events of recorded pulse ``pulse`` (cycled)."""
+        if self.event_index is None or self.event_index.size == 0:
+            n = max(1, fallback_size)
+            start = (pulse * n) % max(self.n_events, 1)
+            return slice(start, start + n)
+        k = pulse % self.event_index.size
+        start = int(self.event_index[k])
+        end = (
+            int(self.event_index[k + 1])
+            if k + 1 < self.event_index.size
+            else self.n_events
+        )
+        return slice(start, end)
+
+
+def load_nexus_events(path) -> dict[str, RecordedEvents]:
+    """Recorded events per detector from a NeXus file (reference
+    fake_detectors.py:33 events_from_nexus).
+
+    Walks every ``NXevent_data`` group that actually carries recorded
+    ``event_id``/``event_time_offset`` datasets (stream-placeholder
+    groups written for the file writer carry none) and keys the result
+    by the parent group name (the detector/bank name).
+    """
+    import h5py
+
+    groups: list[tuple[str, "h5py.Group"]] = []
+
+    def visit(name: str, obj) -> None:
+        if not isinstance(obj, h5py.Group):
+            return
+        nx_class = obj.attrs.get("NX_class")
+        if isinstance(nx_class, bytes):
+            nx_class = nx_class.decode()
+        if nx_class != "NXevent_data":
+            return
+        # Presence AND non-emptiness: a file-writer output opened mid-run
+        # (or a stream placeholder) can carry zero-length event datasets;
+        # replaying such a bank would crash both consumers.
+        if "event_id" not in obj or "event_time_offset" not in obj:
+            return
+        if obj["event_id"].shape[0] == 0:
+            return
+        groups.append((name, obj))
+
+    out: dict[str, RecordedEvents] = {}
+    with h5py.File(path, "r") as f:
+        f.visititems(visit)
+        # Key by the parent group (the NXdetector name) when the parent
+        # holds exactly one recording; multiple NXevent_data children
+        # under one parent (SNS-style entry/bankN_events) are keyed by
+        # their own name with the '_events' suffix stripped, so no bank
+        # silently shadows another.
+        parents = [n.rsplit("/", 1)[0] for n, _ in groups]
+        for name, obj in groups:
+            parent_path = name.rsplit("/", 1)[0]
+            own = name.rsplit("/", 1)[-1]
+            if own.endswith("_events"):
+                own = own[: -len("_events")]
+            parent = parent_path.rsplit("/", 1)[-1]
+            key = parent if parents.count(parent_path) == 1 else own
+            if key in out:
+                key = name  # full path as the last-resort unique key
+            out[key] = RecordedEvents(
+                event_id=obj["event_id"][...],
+                event_time_offset=obj["event_time_offset"][...],
+                event_index=(
+                    obj["event_index"][...] if "event_index" in obj else None
+                ),
+            )
+    return out
+
+
+class ReplayDetectorStream:
+    """ev44 events replayed from recorded NeXus data (reference
+    FakeDetectorSource nexus branch, fake_detectors.py:52-160).
+
+    Preserves the recording's pixel distribution AND — when the file
+    carries ``event_index`` — its per-pulse raggedness: pulse k of the
+    replay is exactly pulse k of the recording (cycled). Pulse
+    timestamps are regenerated on the live 14 Hz grid so downstream
+    batching sees current data times.
+    """
+
+    def __init__(
+        self,
+        *,
+        topic: str,
+        source_name: str,
+        recorded: RecordedEvents,
+        events_per_pulse: int = 1000,
+        start_pulse: int = 0,
+    ) -> None:
+        if recorded.n_events == 0:
+            raise ValueError(f"{source_name}: recording holds no events")
+        self._topic = topic
+        self._source = source_name
+        self._recorded = recorded
+        self._events_per_pulse = events_per_pulse
+        self._pulse = start_pulse
+        self._message_id = 0
+
+    def pulses(self, n: int) -> list[FakeKafkaMessage]:
+        out = []
+        rec = self._recorded
+        for _ in range(n):
+            t_ns = _pulse_time_ns(self._pulse)
+            sl = rec.pulse_slice(self._pulse, self._events_per_pulse)
+            pixel_id = rec.event_id[sl].astype(np.int32)
+            toa = rec.event_time_offset[sl]
+            buf = wire.encode_ev44(
+                self._source,
+                self._message_id,
+                reference_time=np.array([t_ns], dtype=np.int64),
+                reference_time_index=np.array([0], dtype=np.int32),
+                time_of_flight=np.asarray(toa).astype(np.int32),
+                pixel_id=pixel_id,
+            )
+            out.append(FakeKafkaMessage(buf, self._topic))
+            self._pulse += 1
+            self._message_id += 1
+        return out
+
+
+class FakeMonitorStream:
+    """ev44 monitor events with a double-peak TOA profile."""
+
+    def __init__(
+        self,
+        *,
+        topic: str,
+        source_name: str,
+        events_per_pulse: int = 200,
+        start_pulse: int = 0,
+        seed: int = 1,
+    ) -> None:
+        self._topic = topic
+        self._source = source_name
+        self._events_per_pulse = events_per_pulse
+        self._pulse = start_pulse
+        self._rng = np.random.default_rng(seed)
+        self._message_id = 0
+
+    def pulses(self, n: int) -> list[FakeKafkaMessage]:
+        out = []
+        period = PULSE_PERIOD_NS_NUM / PULSE_PERIOD_NS_DEN
+        for _ in range(n):
+            t_ns = _pulse_time_ns(self._pulse)
+            k = self._events_per_pulse
+            peak = self._rng.choice([0.3, 0.6], size=k)
+            toa = np.clip(
+                self._rng.normal(peak * period, period / 20.0, k), 0, period - 1
+            )
+            buf = wire.encode_ev44(
+                self._source,
+                self._message_id,
+                reference_time=np.array([t_ns], dtype=np.int64),
+                reference_time_index=np.array([0], dtype=np.int32),
+                time_of_flight=toa.astype(np.int32),
+            )
+            out.append(FakeKafkaMessage(buf, self._topic))
+            self._pulse += 1
+            self._message_id += 1
+        return out
+
+
+class FakeLogStream:
+    """f144 sinusoidal motor position at a fixed sample rate."""
+
+    def __init__(
+        self,
+        *,
+        topic: str,
+        source_name: str,
+        period_pulses: int = 14,
+        amplitude: float = 10.0,
+        start_pulse: int = 0,
+    ) -> None:
+        self._topic = topic
+        self._source = source_name
+        self._period = period_pulses
+        self._amplitude = amplitude
+        self._pulse = start_pulse
+
+    def pulses(self, n: int) -> list[FakeKafkaMessage]:
+        out = []
+        for _ in range(n):
+            if self._pulse % self._period == 0:
+                t_ns = _pulse_time_ns(self._pulse)
+                value = self._amplitude * np.sin(self._pulse / 100.0)
+                out.append(
+                    FakeKafkaMessage(
+                        wire.encode_f144(self._source, value, t_ns), self._topic
+                    )
+                )
+            self._pulse += 1
+        return out
+
+
+class PulsedRawSource:
+    """Raw message source yielding the next pulse's messages per poll —
+    drives a whole service deterministically without a broker."""
+
+    def __init__(self, streams, pulses_per_poll: int = 1) -> None:
+        self._streams = list(streams)
+        self._pulses_per_poll = pulses_per_poll
+        self._injected: list[FakeKafkaMessage] = []
+
+    def inject(self, message: FakeKafkaMessage) -> None:
+        """Queue a control-plane message (command JSON etc.)."""
+        self._injected.append(message)
+
+    def get_messages(self) -> list[FakeKafkaMessage]:
+        out, self._injected = self._injected, []
+        for stream in self._streams:
+            out.extend(stream.pulses(self._pulses_per_poll))
+        return out

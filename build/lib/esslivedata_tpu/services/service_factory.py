@@ -1,0 +1,280 @@
+"""Service assembly: adapter + preprocessors + processor + sink -> Service.
+
+Parity with reference ``service_factory.py`` (DataServiceBuilder:58,
+DataServiceRunner:271): builders wire the full stack from an instrument
+name; the runner adds the CLI surface (--instrument --dev --batcher
+--job-threads --check, LIVEDATA_* env overrides) and broker config. The
+broker path needs confluent_kafka (optional dependency); everything else
+runs against in-memory fakes, which is also the test rig.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections.abc import Callable
+
+from ..core.job_manager import JobFactory, JobManager
+from ..core.message_batcher import (
+    AdaptiveMessageBatcher,
+    MessageBatcher,
+    NaiveMessageBatcher,
+    SimpleMessageBatcher,
+)
+from ..core.nicos_devices import DeviceExtractor
+from ..core.orchestrating_processor import OrchestratingProcessor
+from ..core.service import Service, get_env_defaults, setup_arg_parser
+from ..config.device_contract import DeviceContract
+from ..config.instrument import instrument_registry
+from ..config.streams import get_stream_mapping
+from ..kafka.message_adapter import AdaptingMessageSource, RouteByTopicAdapter
+from ..kafka.sink import KafkaSink, UnrollingSinkAdapter, make_default_serializer
+from ..kafka.source import BackgroundMessageSource
+from ..core.rate_aware_batcher import RateAwareMessageBatcher
+from ..kafka.stream_counter import StreamCounter
+from ..kafka.stream_mapping import StreamMapping
+from ..workflows.workflow_factory import workflow_registry
+
+__all__ = ["DataServiceBuilder", "DataServiceRunner", "make_batcher"]
+
+logger = logging.getLogger(__name__)
+
+
+def make_batcher(name: str) -> MessageBatcher:
+    if name == "naive":
+        return NaiveMessageBatcher()
+    if name == "simple":
+        return SimpleMessageBatcher()
+    if name == "adaptive":
+        return AdaptiveMessageBatcher()
+    if name == "rate_aware":
+        return RateAwareMessageBatcher()
+    raise ValueError(f"Unknown batcher {name!r}")
+
+
+class DataServiceBuilder:
+    """Builds one backend service for one instrument."""
+
+    def __init__(
+        self,
+        *,
+        instrument: str,
+        service_name: str,
+        preprocessor_factory,
+        route_builder: Callable[[StreamMapping], RouteByTopicAdapter],
+        batcher: MessageBatcher | None = None,
+        job_threads: int = 5,
+        dev: bool = False,
+        heartbeat_interval_s: float = 2.0,
+        source_decorator: Callable | None = None,
+        snapshot_dir: str | None = None,
+    ) -> None:
+        self.instrument_name = instrument
+        self.service_name = service_name
+        self._preprocessor_factory = preprocessor_factory
+        self._route_builder = route_builder
+        self._batcher = batcher or AdaptiveMessageBatcher()
+        self._job_threads = job_threads
+        self._dev = dev
+        self._heartbeat_interval_s = heartbeat_interval_s
+        self._source_decorator = source_decorator
+        # Histogram-state snapshots at run boundaries/shutdown (SURVEY §5):
+        # explicit argument wins; LIVEDATA_SNAPSHOT_DIR enables it for
+        # deployed services; unset = disabled.
+        import os as _os
+
+        self._snapshot_dir = (
+            snapshot_dir
+            if snapshot_dir is not None
+            else _os.environ.get("LIVEDATA_SNAPSHOT_DIR")
+        )
+        self._instrument = instrument_registry[instrument]
+        self._instrument.load_factories()
+        # Subscribe only to streams the hosted specs consume (reference
+        # route_derivation.scope_stream_mapping:109).
+        from ..config.route_derivation import scope_stream_mapping
+
+        self.stream_mapping = scope_stream_mapping(
+            self._instrument, get_stream_mapping(self._instrument, dev), service_name
+        )
+
+    @property
+    def topics(self) -> list[str]:
+        """The service's actual subscription = the topics its route tree
+        handles (reference derives this by scoping the stream mapping to the
+        service, route_derivation.py:109)."""
+        return self._route_builder(self.stream_mapping).topics
+
+    def from_raw_source(self, raw_source, sink) -> Service:
+        """Assemble from anything yielding KafkaMessages + a MessageSink —
+        used by tests (fakes) and by the broker path alike."""
+        adapter = self._route_builder(self.stream_mapping)
+        counter = StreamCounter()
+        source = AdaptingMessageSource(raw_source, adapter, stream_counter=counter)
+        if self._source_decorator is not None:
+            # In-process stream synthesis (ADR 0001): device merge, chopper
+            # cascade — wraps the already-adapted source.
+            source = self._source_decorator(source, self._instrument)
+        snapshot_store = None
+        if self._snapshot_dir:
+            from ..core.state_snapshot import SnapshotStore
+
+            snapshot_store = SnapshotStore(self._snapshot_dir)
+        job_manager = JobManager(
+            job_factory=JobFactory(),
+            job_threads=self._job_threads,
+            snapshot_store=snapshot_store,
+        )
+        # Contract derived from this instrument's registered specs: outputs
+        # listed in ``device_outputs`` ride the stable NICOS device stream.
+        contract = DeviceContract.from_specs(
+            workflow_registry.specs_for_instrument(self.instrument_name)
+        )
+        processor = OrchestratingProcessor(
+            source=source,
+            sink=sink,
+            preprocessor_factory=self._preprocessor_factory,
+            job_manager=job_manager,
+            batcher=self._batcher,
+            instrument=self.instrument_name,
+            service_name=self.service_name,
+            device_extractor=DeviceExtractor(device_contract=contract),
+            stream_counter=counter,
+            heartbeat_interval_s=self._heartbeat_interval_s,
+        )
+        return Service(
+            processor=processor,
+            name=f"{self.instrument_name}_{self.service_name}",
+        )
+
+    def from_consumer(self, consumer, producer) -> Service:
+        """Assemble over a real (or fake) Kafka consumer/producer pair."""
+        raw_source = BackgroundMessageSource(consumer)
+        raw_source.start()
+        sink = UnrollingSinkAdapter(
+            KafkaSink(
+                producer,
+                make_default_serializer(
+                    self.stream_mapping.livedata,
+                    f"{self.instrument_name}_{self.service_name}",
+                ),
+            )
+        )
+        return self.from_raw_source(raw_source, sink)
+
+
+class DataServiceRunner:
+    """CLI entry point shared by the four services."""
+
+    def __init__(self, *, service_name: str, make_builder) -> None:
+        self._service_name = service_name
+        self._make_builder = make_builder
+
+    def run(self, argv: list[str] | None = None) -> int:
+        parser = setup_arg_parser(f"esslivedata-tpu {self._service_name} service")
+        parser.add_argument(
+            "--batcher",
+            default="adaptive",
+            choices=["naive", "simple", "adaptive"],
+        )
+        parser.add_argument("--job-threads", type=int, default=5)
+        parser.add_argument(
+            "--kafka-bootstrap",
+            default=None,
+            help="override the broker from the kafka config namespace",
+        )
+        parser.add_argument(
+            "--profile",
+            default=None,
+            metavar="DIR",
+            help="capture a JAX device trace of the first "
+            "--profile-seconds into DIR (TensorBoard/Perfetto readable)",
+        )
+        parser.add_argument(
+            "--profile-seconds", type=float, default=30.0
+        )
+        parser.add_argument(
+            "--broker-dir",
+            default=None,
+            help="use the file-backed broker rooted at this directory "
+            "instead of Kafka (multi-process integration/dev runs)",
+        )
+        parser.add_argument(
+            "--check",
+            action="store_true",
+            help="build everything, print topics, exit",
+        )
+        parser.set_defaults(**get_env_defaults(parser))
+        args = parser.parse_args(argv)
+        from ..logging_config import configure_logging
+
+        configure_logging(level=args.log_level, json_file=args.log_json_file)
+
+        from ..config.instrument import instrument_registry as registry
+
+        if args.instrument not in registry:
+            parser.error(
+                f"Unknown instrument {args.instrument!r}; "
+                f"known: {', '.join(registry.names()) or '(none)'}"
+            )
+        builder = self._make_builder(
+            instrument=args.instrument,
+            dev=args.dev,
+            batcher=make_batcher(args.batcher),
+            job_threads=args.job_threads,
+        )
+        if args.check:
+            print(
+                f"{self._service_name}: instrument={args.instrument} "
+                f"topics={builder.topics}"
+            )
+            return 0
+        from ..kafka.consumer import assign_all_partitions
+
+        if args.broker_dir:
+            from ..kafka.file_broker import (
+                FileBrokerConsumer,
+                FileBrokerProducer,
+                ensure_topics,
+            )
+
+            # Create this service's input topics (the admin op a Kafka
+            # deployment does out of band) so launch order doesn't matter.
+            ensure_topics(args.broker_dir, builder.topics)
+            consumer = FileBrokerConsumer(args.broker_dir)
+            producer = FileBrokerProducer(args.broker_dir)
+        else:
+            try:
+                from confluent_kafka import Consumer, Producer
+            except ImportError:
+                logger.error(
+                    "confluent_kafka not installed; install extra [kafka] "
+                    "or use the fake transport (tests/demos)"
+                )
+                return 2
+            from ..kafka.consumer import kafka_client_config
+
+            # Full client config (incl. SASL/SSL in prod) from the kafka
+            # config namespace; --kafka-bootstrap overrides the broker.
+            client_conf = kafka_client_config(
+                bootstrap_override=args.kafka_bootstrap
+            )
+            consumer = Consumer(
+                {
+                    **client_conf,
+                    "group.id": f"{args.instrument}_{self._service_name}",
+                    "auto.offset.reset": "latest",
+                    "enable.auto.commit": False,
+                }
+            )
+            producer = Producer(client_conf)
+        # Manual assignment pinned at the high watermark — never subscribe:
+        # no group rebalancing, no offset commits; a restarted service
+        # resumes at live data (kafka/consumer.py, reference consumer.py:31).
+        assign_all_partitions(consumer, builder.topics)
+        service = builder.from_consumer(consumer, producer)
+        if args.profile:
+            from ..utils.profiling import bounded_device_trace
+
+            bounded_device_trace(args.profile, args.profile_seconds)
+        service.start(blocking=True)
+        return service.exit_code

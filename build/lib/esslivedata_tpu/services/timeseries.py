@@ -1,0 +1,73 @@
+"""Timeseries (logdata) service (reference: services/timeseries.py:20).
+Uses the naive batcher: log samples should flow immediately. Wraps the
+adapted source with DeviceSynthesizer + ChopperSynthesizer (reference
+services/timeseries.py:44-51)."""
+
+from __future__ import annotations
+
+from ..core.message_batcher import NaiveMessageBatcher
+from ..kafka.chopper_synthesizer import ChopperSynthesizer
+from ..kafka.device_synthesizer import DeviceSynthesizer
+from ..kafka.routes import RoutingAdapterBuilder
+from ..preprocessors.factories import TimeseriesPreprocessorFactory
+from .service_factory import DataServiceBuilder, DataServiceRunner
+
+__all__ = ["main", "make_timeseries_service_builder"]
+
+
+def _synthesizing_source(source, instrument):
+    """Chain device merge + chopper-cascade synthesis over the adapted source.
+
+    DeviceSynthesizer is skipped when the instrument declares no devices;
+    ChopperSynthesizer always wraps — its chopperless bootstrap tick is the
+    wavelength-LUT workflow's recompute trigger on instruments without
+    choppers (reference chopper_synthesizer.py:199-202)."""
+    if devices := instrument.devices:
+        source = DeviceSynthesizer(source, devices=devices)
+    return ChopperSynthesizer(
+        source,
+        chopper_names=instrument.choppers,
+        delay_atol=instrument.chopper_delay_atol_ns,
+    )
+
+
+def make_timeseries_service_builder(
+    *,
+    instrument: str,
+    dev: bool = False,
+    batcher=None,
+    job_threads: int = 5,
+    heartbeat_interval_s: float = 2.0,
+    snapshot_dir: str | None = None,
+) -> DataServiceBuilder:
+    def routes(mapping):
+        return (
+            RoutingAdapterBuilder(stream_mapping=mapping)
+            .with_logdata_route()
+            .with_run_control_route()
+            .with_commands_route()
+            .build()
+        )
+
+    return DataServiceBuilder(
+        instrument=instrument,
+        service_name="timeseries",
+        preprocessor_factory=TimeseriesPreprocessorFactory(),
+        route_builder=routes,
+        batcher=batcher or NaiveMessageBatcher(),
+        job_threads=job_threads,
+        dev=dev,
+        heartbeat_interval_s=heartbeat_interval_s,
+        source_decorator=_synthesizing_source,
+        snapshot_dir=snapshot_dir,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    return DataServiceRunner(
+        service_name="timeseries", make_builder=make_timeseries_service_builder
+    ).run(argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,24 @@
+"""Labeled arrays, units, and small shared utilities.
+
+This is the build's replacement for the slice of scipp's C++ array layer that
+the reference actually uses on the wire and in workflow outputs: labeled
+dims, coords, units, arithmetic, and slicing (reference:
+src/ess/livedata/preprocessors/accumulators.py, kafka/scipp_da00_compat.py).
+Event data ("binned" arrays in scipp) intentionally has NO equivalent here —
+events are staged as fixed-shape device batches instead (see ops/).
+"""
+
+from .units import Unit, UnitError, unit
+from .labeled import DataArray, Variable, array, linspace, midpoints, scalar
+
+__all__ = [
+    "DataArray",
+    "Unit",
+    "UnitError",
+    "Variable",
+    "array",
+    "linspace",
+    "midpoints",
+    "scalar",
+    "unit",
+]

@@ -1,0 +1,491 @@
+"""Light labeled-array veneer over numpy/jax arrays.
+
+The reference's workflow outputs and wire payloads are scipp DataArrays:
+dims + coords (often bin edges) + units + masks (reference:
+src/ess/livedata/kafka/scipp_da00_compat.py, workflows/detector_view/
+providers.py:169-299). This module provides the minimal equivalent —
+``Variable`` (values, dims, unit) and ``DataArray`` (data, coords, masks) —
+with dim-name-aware broadcasting arithmetic, edge-aware slicing, and unit
+conversion. Dense data only: event data never appears in this form (events
+are fixed-shape device batches, see ops/event_batch.py).
+
+Values may be numpy or jax arrays; arithmetic preserves the array namespace
+of the left operand. ``.numpy`` materializes to host.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping, Sequence
+from typing import Any
+
+import numpy as np
+
+from .units import Unit, UnitError, unit as parse_unit
+
+__all__ = ["Variable", "DataArray", "array", "scalar", "linspace", "midpoints", "concat"]
+
+
+def _as_array(values: Any) -> Any:
+    if isinstance(values, (list, tuple, int, float, bool, np.number)):
+        return np.asarray(values)
+    return values
+
+
+class Variable:
+    """An array with named dimensions and a physical unit."""
+
+    __slots__ = ("_values", "_dims", "_unit")
+
+    def __init__(
+        self,
+        values: Any,
+        dims: Sequence[str] | None = None,
+        unit: str | Unit | None = None,
+    ) -> None:
+        values = _as_array(values)
+        if dims is None:
+            if values.ndim != 0:
+                raise ValueError("dims required for non-scalar Variable")
+            dims = ()
+        dims = tuple(dims)
+        if len(dims) != values.ndim:
+            raise ValueError(
+                f"dims {dims} do not match array of ndim {values.ndim}"
+            )
+        self._values = values
+        self._dims = dims
+        self._unit = parse_unit(unit)
+
+    # -- basic properties -------------------------------------------------
+    @property
+    def values(self) -> Any:
+        return self._values
+
+    @property
+    def value(self) -> Any:
+        """Scalar value (python object) — requires a 0-d variable."""
+        if self._values.ndim != 0:
+            raise ValueError("value only valid for 0-d Variable")
+        return np.asarray(self._values)[()]
+
+    @property
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._values)
+
+    @property
+    def dims(self) -> tuple[str, ...]:
+        return self._dims
+
+    @property
+    def unit(self) -> Unit:
+        return self._unit
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return tuple(self._values.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._values.ndim
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def sizes(self) -> dict[str, int]:
+        return dict(zip(self._dims, self._values.shape, strict=True))
+
+    def __len__(self) -> int:
+        if self.ndim == 0:
+            raise TypeError("len() of 0-d Variable")
+        return self.shape[0]
+
+    def __repr__(self) -> str:
+        return (
+            f"Variable(dims={self._dims}, shape={self.shape}, "
+            f"unit={self._unit!r}, dtype={self.dtype})"
+        )
+
+    # -- conversion -------------------------------------------------------
+    def to_unit(self, target: str | Unit) -> Variable:
+        target = parse_unit(target)
+        factor = self._unit.conversion_factor(target)
+        if factor == 1.0:
+            return Variable(self._values, self._dims, target)
+        values = self._values * factor
+        return Variable(values, self._dims, target)
+
+    def astype(self, dtype) -> Variable:
+        return Variable(self._values.astype(dtype), self._dims, self._unit)
+
+    def copy(self) -> Variable:
+        return Variable(np.array(self.numpy, copy=True), self._dims, self._unit)
+
+    # -- slicing ----------------------------------------------------------
+    def __getitem__(self, key) -> Variable:
+        dim, idx = key
+        axis = self._dims.index(dim)
+        slicer: list[Any] = [slice(None)] * self.ndim
+        slicer[axis] = idx
+        values = self._values[tuple(slicer)]
+        dims = (
+            self._dims
+            if isinstance(idx, slice)
+            else self._dims[:axis] + self._dims[axis + 1 :]
+        )
+        return Variable(values, dims, self._unit)
+
+    def transpose(self, dims: Sequence[str]) -> Variable:
+        dims = tuple(dims)
+        if set(dims) != set(self._dims):
+            raise ValueError(f"transpose dims {dims} != {self._dims}")
+        order = [self._dims.index(d) for d in dims]
+        return Variable(self._values.transpose(order), dims, self._unit)
+
+    # -- broadcasting arithmetic ------------------------------------------
+    def _aligned(self, other: Variable) -> tuple[Any, Any, tuple[str, ...]]:
+        out_dims = self._dims + tuple(d for d in other._dims if d not in self._dims)
+        sizes = self.sizes
+        for d, n in other.sizes.items():
+            if d in sizes and sizes[d] != n:
+                raise ValueError(f"Size mismatch along {d!r}: {sizes[d]} vs {n}")
+            sizes[d] = n
+
+        def align(v: Variable) -> Any:
+            present = [d for d in out_dims if d in v._dims]
+            vv = v.transpose(present)._values if present != list(v._dims) else v._values
+            shape = tuple(sizes[d] if d in v._dims else 1 for d in out_dims)
+            return vv.reshape(shape)
+
+        return align(self), align(other), out_dims
+
+    def _binop(self, other, op: str, unit_rule: str) -> Variable:
+        if isinstance(other, (int, float, np.number)):
+            other = Variable(np.asarray(other), (), self._unit if unit_rule == "same" else None)
+        if not isinstance(other, Variable):
+            return NotImplemented
+        if unit_rule == "same":
+            if not self._unit.compatible(other._unit):
+                raise UnitError(f"Incompatible units: {self._unit} and {other._unit}")
+            other = other.to_unit(self._unit)
+            out_unit = self._unit
+        elif unit_rule == "mul":
+            out_unit = self._unit * other._unit
+        elif unit_rule == "div":
+            out_unit = self._unit / other._unit
+        else:  # pragma: no cover
+            raise AssertionError(unit_rule)
+        a, b, dims = self._aligned(other)
+        if op == "add":
+            out = a + b
+        elif op == "sub":
+            out = a - b
+        elif op == "mul":
+            out = a * b
+        elif op == "div":
+            out = a / b
+        else:  # pragma: no cover
+            raise AssertionError(op)
+        return Variable(out, dims, out_unit)
+
+    def __add__(self, other) -> Variable:
+        return self._binop(other, "add", "same")
+
+    def __sub__(self, other) -> Variable:
+        return self._binop(other, "sub", "same")
+
+    def __mul__(self, other) -> Variable:
+        return self._binop(other, "mul", "mul")
+
+    def __truediv__(self, other) -> Variable:
+        return self._binop(other, "div", "div")
+
+    def __radd__(self, other) -> Variable:
+        return self._binop(other, "add", "same")
+
+    def __rmul__(self, other) -> Variable:
+        return self._binop(other, "mul", "mul")
+
+    def __rsub__(self, other) -> Variable:
+        return (-self)._binop(other, "add", "same")
+
+    def __rtruediv__(self, other) -> Variable:
+        if isinstance(other, (int, float, np.number)):
+            other = Variable(np.asarray(other), (), None)
+        if not isinstance(other, Variable):
+            return NotImplemented
+        return other._binop(self, "div", "div")
+
+    def __neg__(self) -> Variable:
+        return Variable(-self._values, self._dims, self._unit)
+
+    def __iadd__(self, other) -> Variable:
+        out = self._binop(other, "add", "same")
+        if out.dims != self._dims:
+            raise ValueError("in-place add must not broadcast new dims")
+        self._values = out._values
+        return self
+
+    # -- reductions -------------------------------------------------------
+    def sum(self, dim: str | None = None) -> Variable:
+        if dim is None:
+            return Variable(self._values.sum(), (), self._unit)
+        axis = self._dims.index(dim)
+        dims = self._dims[:axis] + self._dims[axis + 1 :]
+        return Variable(self._values.sum(axis=axis), dims, self._unit)
+
+    def max(self) -> Variable:
+        return Variable(self._values.max(), (), self._unit)
+
+    def min(self) -> Variable:
+        return Variable(self._values.min(), (), self._unit)
+
+    def allclose(self, other: Variable, rtol: float = 1e-6, atol: float = 0.0) -> bool:
+        if self._dims != other._dims or not self._unit.compatible(other._unit):
+            return False
+        o = other.to_unit(self._unit)
+        return bool(
+            np.allclose(self.numpy, o.numpy, rtol=rtol, atol=atol)
+            if self.shape == o.shape
+            else False
+        )
+
+    def identical(self, other: Variable) -> bool:
+        return (
+            self._dims == other._dims
+            and self._unit == other._unit
+            and self.shape == other.shape
+            and bool(np.array_equal(self.numpy, other.numpy))
+        )
+
+
+class DataArray:
+    """Data variable + coordinates + masks, scipp-DataArray-like.
+
+    Coords may be bin edges: a coord of length N+1 along a data dim of
+    length N is treated as edges by slicing and concatenation.
+    """
+
+    __slots__ = ("data", "coords", "masks", "name")
+
+    def __init__(
+        self,
+        data: Variable,
+        coords: Mapping[str, Variable] | None = None,
+        masks: Mapping[str, Variable] | None = None,
+        name: str = "",
+    ) -> None:
+        self.data = data
+        self.coords: dict[str, Variable] = dict(coords or {})
+        self.masks: dict[str, Variable] = dict(masks or {})
+        self.name = name
+
+    # -- properties -------------------------------------------------------
+    @property
+    def dims(self) -> tuple[str, ...]:
+        return self.data.dims
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return self.data.shape
+
+    @property
+    def sizes(self) -> dict[str, int]:
+        return self.data.sizes
+
+    @property
+    def unit(self) -> Unit:
+        return self.data.unit
+
+    @property
+    def values(self) -> Any:
+        return self.data.values
+
+    def __repr__(self) -> str:
+        return (
+            f"DataArray(name={self.name!r}, dims={self.dims}, shape={self.shape}, "
+            f"unit={self.unit!r}, coords={list(self.coords)}, masks={list(self.masks)})"
+        )
+
+    def is_edges(self, coord: str, dim: str | None = None) -> bool:
+        c = self.coords[coord]
+        dim = dim or (c.dims[-1] if c.dims else coord)
+        if dim not in c.dims or dim not in self.dims:
+            return False
+        return c.sizes[dim] == self.sizes[dim] + 1
+
+    # -- slicing ----------------------------------------------------------
+    def __getitem__(self, key) -> DataArray:
+        dim, idx = key
+        data = self.data[dim, idx]
+        coords = {}
+        for cname, c in self.coords.items():
+            if dim in c.dims:
+                if isinstance(idx, slice) and self.is_edges(cname, dim):
+                    start, stop, step = idx.indices(self.sizes[dim])
+                    if step != 1:
+                        raise ValueError("strided slicing of edge coords unsupported")
+                    coords[cname] = c[dim, start : stop + 1]
+                else:
+                    coords[cname] = c[dim, idx]
+            else:
+                coords[cname] = c
+        masks = {
+            mname: (m[dim, idx] if dim in m.dims else m)
+            for mname, m in self.masks.items()
+        }
+        return DataArray(data, coords, masks, self.name)
+
+    # -- arithmetic -------------------------------------------------------
+    def _binop(self, other, op) -> DataArray:
+        if isinstance(other, DataArray):
+            # Coords shared by both operands must agree — adding counts from
+            # histograms with different bin edges is scientifically wrong and
+            # must fail loudly (the reference relies on scipp for this).
+            for cname in self.coords.keys() & other.coords.keys():
+                if not self.coords[cname].identical(other.coords[cname]):
+                    raise ValueError(
+                        f"Mismatched coord {cname!r} in DataArray arithmetic"
+                    )
+            coords = dict(other.coords)
+            coords.update(self.coords)
+            rhs = other.data
+            masks = dict(other.masks)
+            masks.update(self.masks)
+        else:
+            coords = dict(self.coords)
+            rhs = other
+            masks = dict(self.masks)
+        data = getattr(self.data, op)(rhs)
+        return DataArray(data, coords, masks, self.name)
+
+    def __add__(self, other) -> DataArray:
+        return self._binop(other, "__add__")
+
+    def __sub__(self, other) -> DataArray:
+        return self._binop(other, "__sub__")
+
+    def __mul__(self, other) -> DataArray:
+        return self._binop(other, "__mul__")
+
+    def __truediv__(self, other) -> DataArray:
+        return self._binop(other, "__truediv__")
+
+    def __iadd__(self, other) -> DataArray:
+        rhs = other.data if isinstance(other, DataArray) else other
+        self.data += rhs
+        return self
+
+    def sum(self, dim: str | None = None) -> DataArray:
+        if dim is None:
+            return DataArray(self.data.sum(), {}, {}, self.name)
+        coords = {
+            cname: c for cname, c in self.coords.items() if dim not in c.dims
+        }
+        masks = {m: v for m, v in self.masks.items() if dim not in v.dims}
+        return DataArray(self.data.sum(dim), coords, masks, self.name)
+
+    def to_unit(self, target) -> DataArray:
+        return DataArray(self.data.to_unit(target), self.coords, self.masks, self.name)
+
+    def copy(self) -> DataArray:
+        return DataArray(
+            self.data.copy(),
+            {k: v.copy() for k, v in self.coords.items()},
+            {k: v.copy() for k, v in self.masks.items()},
+            self.name,
+        )
+
+    def same_structure(self, other: DataArray) -> bool:
+        """True when dims/shape/unit/coords match — the reference uses this to
+        decide accumulate-vs-restart (accumulators.py:238-261)."""
+        if not isinstance(other, DataArray):
+            return False
+        if self.dims != other.dims or self.shape != other.shape:
+            return False
+        if not self.unit.compatible(other.unit):
+            return False
+        if set(self.coords) != set(other.coords):
+            return False
+        return all(
+            self.coords[c].identical(other.coords[c]) for c in self.coords
+        )
+
+
+# -- constructors ---------------------------------------------------------
+
+
+def array(
+    values: Any,
+    dims: Sequence[str],
+    unit: str | Unit | None = None,
+    coords: Mapping[str, Variable] | None = None,
+    name: str = "",
+) -> DataArray:
+    return DataArray(Variable(values, dims, unit), coords, name=name)
+
+
+def scalar(value: Any, unit: str | Unit | None = None) -> Variable:
+    return Variable(np.asarray(value), (), unit)
+
+
+def linspace(
+    dim: str, start: float, stop: float, num: int, unit: str | Unit | None = None
+) -> Variable:
+    return Variable(np.linspace(start, stop, num), (dim,), unit)
+
+
+def midpoints(var: Variable, dim: str | None = None) -> Variable:
+    dim = dim or var.dims[-1]
+    axis = var.dims.index(dim)
+    sl_lo: list[Any] = [slice(None)] * var.ndim
+    sl_hi: list[Any] = [slice(None)] * var.ndim
+    sl_lo[axis] = slice(None, -1)
+    sl_hi[axis] = slice(1, None)
+    vals = 0.5 * (var.values[tuple(sl_lo)] + var.values[tuple(sl_hi)])
+    return Variable(vals, var.dims, var.unit)
+
+
+def concat(arrays: Sequence[DataArray], dim: str) -> DataArray:
+    """Concatenate along ``dim``; edge coords are merged (shared boundary)."""
+    if not arrays:
+        raise ValueError("concat of empty sequence")
+    first = arrays[0]
+    axis = first.dims.index(dim)
+    data_vals = np.concatenate([np.asarray(a.data.values) for a in arrays], axis=axis)
+    data = Variable(data_vals, first.dims, first.unit)
+    coords: dict[str, Variable] = {}
+    for cname, c in first.coords.items():
+        if dim not in c.dims:
+            coords[cname] = c
+            continue
+        caxis = c.dims.index(dim)
+        if first.is_edges(cname, dim):
+            pieces = [np.asarray(arrays[0].coords[cname].values)]
+            for a in arrays[1:]:
+                nxt = np.asarray(a.coords[cname].values)
+                pieces.append(np.take(nxt, np.arange(1, nxt.shape[caxis]), axis=caxis))
+            coords[cname] = Variable(np.concatenate(pieces, axis=caxis), c.dims, c.unit)
+        else:
+            coords[cname] = Variable(
+                np.concatenate(
+                    [np.asarray(a.coords[cname].values) for a in arrays], axis=caxis
+                ),
+                c.dims,
+                c.unit,
+            )
+    masks: dict[str, Variable] = {}
+    for mname, m in first.masks.items():
+        if dim in m.dims:
+            maxis = m.dims.index(dim)
+            masks[mname] = Variable(
+                np.concatenate(
+                    [np.asarray(a.masks[mname].values) for a in arrays], axis=maxis
+                ),
+                m.dims,
+                m.unit,
+            )
+        else:
+            masks[mname] = m
+    return DataArray(data, coords, masks, first.name)

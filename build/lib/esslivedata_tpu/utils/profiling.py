@@ -1,0 +1,132 @@
+"""Device + host profiling subsystem.
+
+SURVEY.md §5 build note: the reference has no dedicated tracer (timings
+come from per-batch processing_time_s + 30 s metrics); here device-level
+profiling is first-class. Two tools:
+
+- :func:`device_trace`: context manager around ``jax.profiler`` writing a
+  TensorBoard-loadable trace of XLA execution for the wrapped region.
+- :class:`StageTimer`: cheap wall-clock stage accounting for the service
+  hot loop (decode / stage / device step / publish), drained into the 30 s
+  metrics report the same way consumer metrics are.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import logging
+from collections import defaultdict
+from contextlib import contextmanager
+
+__all__ = ["StageTimer", "bounded_device_trace", "device_memory_stats", "device_trace"]
+
+
+@contextmanager
+def device_trace(log_dir: str):
+    """Profile XLA device execution of the wrapped region.
+
+    Writes a trace under ``log_dir`` (TensorBoard 'profile' plugin /
+    Perfetto readable). Usage::
+
+        with device_trace("/tmp/prof"):
+            state = hist.step(state, batch)
+            state.window.block_until_ready()
+    """
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StageTimer:
+    """Accumulates wall time per named stage; thread-safe; drain-and-reset.
+
+    ``with timer.stage("device_step"): ...`` around hot-loop phases; the
+    metrics reporter drains a summary every interval.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._total_s: dict[str, float] = defaultdict(float)
+        self._count: dict[str, int] = defaultdict(int)
+        self._max_s: dict[str, float] = defaultdict(float)
+
+    @contextmanager
+    def stage(self, name: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - start
+            with self._lock:
+                self._total_s[name] += dt
+                self._count[name] += 1
+                if dt > self._max_s[name]:
+                    self._max_s[name] = dt
+
+    def drain(self) -> dict[str, dict[str, float]]:
+        """Per-stage {total_s, count, mean_ms, max_ms}; resets counters."""
+        with self._lock:
+            out = {
+                name: {
+                    "total_s": self._total_s[name],
+                    "count": self._count[name],
+                    "mean_ms": 1e3 * self._total_s[name] / self._count[name],
+                    "max_ms": 1e3 * self._max_s[name],
+                }
+                for name in self._total_s
+                if self._count[name]
+            }
+            self._total_s.clear()
+            self._count.clear()
+            self._max_s.clear()
+            return out
+
+
+def bounded_device_trace(log_dir: str, seconds: float) -> None:
+    """Capture a wall-clock-bounded device trace without blocking the
+    caller: starts the JAX profiler now and schedules the stop on a timer
+    thread. For long-running services (--profile): an unbounded trace
+    would grow without limit, so the capture window is explicit. The stop
+    also runs at interpreter exit — a service stopped before the window
+    elapses must still flush the trace, not lose it."""
+    import atexit
+
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    stopped = threading.Event()
+
+    def _stop() -> None:
+        if stopped.is_set():
+            return
+        stopped.set()
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # pragma: no cover - profiler teardown races
+            logging.getLogger(__name__).exception("stop_trace failed")
+
+    atexit.register(_stop)
+    timer = threading.Timer(seconds, _stop)
+    timer.daemon = True
+    timer.start()
+
+
+def device_memory_stats() -> dict[str, int]:
+    """Per-device HBM statistics for the metrics log (SURVEY §5: device
+    memory in the 30 s rollover). Backends without memory_stats (CPU)
+    yield an empty dict."""
+    import jax
+
+    out: dict[str, int] = {}
+    for device in jax.local_devices():
+        stats = device.memory_stats() or {}
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if key in stats:
+                out[f"{device.id}:{key}"] = int(stats[key])
+    return out
+

@@ -1,0 +1,256 @@
+"""Minimal physical-unit algebra.
+
+The reference leans on scipp's C++ unit system (e.g. unit-checked Timestamp
+arithmetic, reference: src/ess/livedata/core/timestamp.py:169-190, and da00
+variables carrying units on the wire). We only need a small, fast subset:
+parse the unit strings that occur in neutron live-data (time, length, angle,
+energy, counts, frequency), multiply/divide/power them, and compute scale
+factors for conversions. Implemented as a frozen dataclass over a dimension
+exponent vector + scale; no external dependencies.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from fractions import Fraction
+from functools import lru_cache
+
+__all__ = ["Unit", "UnitError", "unit"]
+
+
+class UnitError(ValueError):
+    """Raised on incompatible-unit operations or unparseable unit strings."""
+
+
+# Base dimensions. 'count' is its own dimension (like scipp's counts) so that
+# e.g. counts + meters is an error and counts/s is a rate.
+_DIMS = ("time", "length", "mass", "angle", "count", "temperature", "current")
+
+_Vec = tuple[Fraction, ...]
+_ZERO: _Vec = tuple(Fraction(0) for _ in _DIMS)
+
+
+def _vec(**exps: int) -> _Vec:
+    return tuple(Fraction(exps.get(d, 0)) for d in _DIMS)
+
+
+@dataclass(frozen=True, slots=True)
+class Unit:
+    """A physical unit: scale factor to coherent base units + dimension vector."""
+
+    scale: float
+    dims: _Vec
+    _name: str | None = None
+
+    # -- algebra ---------------------------------------------------------
+    def __mul__(self, other: Unit) -> Unit:
+        if not isinstance(other, Unit):
+            return NotImplemented
+        return Unit(
+            self.scale * other.scale,
+            tuple(a + b for a, b in zip(self.dims, other.dims, strict=True)),
+        )
+
+    def __truediv__(self, other: Unit) -> Unit:
+        if not isinstance(other, Unit):
+            return NotImplemented
+        return Unit(
+            self.scale / other.scale,
+            tuple(a - b for a, b in zip(self.dims, other.dims, strict=True)),
+        )
+
+    def __pow__(self, exp: int | float | Fraction) -> Unit:
+        f = Fraction(exp).limit_denominator(1000)
+        return Unit(self.scale ** float(f), tuple(d * f for d in self.dims))
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, Unit):
+            return NotImplemented
+        return self.dims == other.dims and math.isclose(
+            self.scale, other.scale, rel_tol=1e-12
+        )
+
+    def __hash__(self) -> int:
+        return hash((round(math.log10(self.scale), 9) if self.scale > 0 else 0, self.dims))
+
+    # -- conversions -----------------------------------------------------
+    @property
+    def is_dimensionless(self) -> bool:
+        return self.dims == _ZERO
+
+    def compatible(self, other: Unit) -> bool:
+        return self.dims == other.dims
+
+    def conversion_factor(self, to: Unit) -> float:
+        """Multiplicative factor converting values in ``self`` to ``to``."""
+        if self.dims != to.dims:
+            raise UnitError(f"Incompatible units: {self} -> {to}")
+        return self.scale / to.scale
+
+    # -- repr ------------------------------------------------------------
+    def __repr__(self) -> str:
+        return self._name or self._derived_name()
+
+    def _derived_name(self) -> str:
+        # Find a registered atomic name with identical scale+dims.
+        for name, u in _REGISTRY.items():
+            if u.dims == self.dims and math.isclose(u.scale, self.scale, rel_tol=1e-12):
+                return name
+        # Well-known compound spellings (kept parseable for wire round trip).
+        for spec in _REPR_ALIASES:
+            u = unit(spec)
+            if u.dims == self.dims and math.isclose(
+                u.scale, self.scale, rel_tol=1e-12
+            ):
+                return spec
+        num, den = [], []
+        for d, e in zip(_DIMS, self.dims, strict=True):
+            if e == 0:
+                continue
+            base = _BASE_NAME[d]
+            part = base if abs(e) == 1 else f"{base}**{abs(e)}"
+            (num if e > 0 else den).append(part)
+        s = "*".join(num) or "1"
+        if den:
+            s += "/" + "/".join(den)
+        if not math.isclose(self.scale, 1.0, rel_tol=1e-12):
+            s = f"{self.scale:g}*{s}"
+        return s
+
+
+_BASE_NAME = {
+    "time": "s",
+    "length": "m",
+    "mass": "kg",
+    "angle": "rad",
+    "count": "counts",
+    "temperature": "K",
+    "current": "A",
+}
+
+_DEG = math.pi / 180.0
+_EV = 1.602176634e-19  # J
+
+_REGISTRY: dict[str, Unit] = {}
+
+
+def _register(name: str, scale: float, **exps: int) -> None:
+    _REGISTRY[name] = Unit(scale, _vec(**exps), name)
+
+
+# Dimensionless
+_register("dimensionless", 1.0)
+_register("one", 1.0)
+_register("", 1.0)
+_register("%", 0.01)
+# Counts
+_register("counts", 1.0, count=1)
+_register("count", 1.0, count=1)
+# Time
+_register("s", 1.0, time=1)
+_register("ms", 1e-3, time=1)
+_register("us", 1e-6, time=1)
+_register("µs", 1e-6, time=1)
+_register("ns", 1e-9, time=1)
+_register("ps", 1e-12, time=1)
+_register("min", 60.0, time=1)
+_register("h", 3600.0, time=1)
+# Frequency
+_register("Hz", 1.0, time=-1)
+_register("kHz", 1e3, time=-1)
+_register("MHz", 1e6, time=-1)
+# Length
+_register("m", 1.0, length=1)
+_register("cm", 1e-2, length=1)
+_register("mm", 1e-3, length=1)
+_register("um", 1e-6, length=1)
+_register("nm", 1e-9, length=1)
+_register("angstrom", 1e-10, length=1)
+_register("Angstrom", 1e-10, length=1)
+_register("Å", 1e-10, length=1)
+# Mass
+_register("kg", 1.0, mass=1)
+_register("g", 1e-3, mass=1)
+# Angle
+_register("rad", 1.0, angle=1)
+_register("deg", _DEG, angle=1)
+# Temperature (scale-only; no offset support — fine for kelvin streams)
+_register("K", 1.0, temperature=1)
+# Energy: J = kg m^2 / s^2
+_register("J", 1.0, mass=1, length=2, time=-2)
+_register("eV", _EV, mass=1, length=2, time=-2)
+_register("meV", _EV * 1e-3, mass=1, length=2, time=-2)
+_register("ueV", _EV * 1e-6, mass=1, length=2, time=-2)
+# Current / voltage-ish extras occasionally seen in f144 logs
+_register("A", 1.0, current=1)
+_register("mA", 1e-3, current=1)
+_register("V", 1.0, mass=1, length=2, time=-3, current=-1)
+_register("mV", 1e-3, mass=1, length=2, time=-3, current=-1)
+_register("T", 1.0, mass=1, time=-2, current=-1)
+_register("bar", 1e5, mass=1, length=-1, time=-2)
+_register("mbar", 1e2, mass=1, length=-1, time=-2)
+_register("W", 1.0, mass=1, length=2, time=-3)
+_register("MW", 1e6, mass=1, length=2, time=-3)
+
+
+_REPR_ALIASES = (
+    "1/angstrom",
+    "1/nm",
+    "1/m",
+    "counts/s",
+    "m/s",
+    "mm/s",
+    "deg/s",
+    "rad/s",
+)
+
+
+def _parse_token(tok: str) -> Unit:
+    tok = tok.strip()
+    exp = 1
+    for sep in ("**", "^"):
+        if sep in tok:
+            tok, e = tok.split(sep, 1)
+            tok = tok.strip()
+            try:
+                exp = int(e.strip())
+            except ValueError as err:
+                raise UnitError(f"Bad exponent in unit token {tok!r}{sep}{e!r}") from err
+            break
+    if tok in ("1", ""):
+        return _REGISTRY["dimensionless"]
+    try:
+        u = _REGISTRY[tok]
+    except KeyError as err:
+        raise UnitError(f"Unknown unit {tok!r}") from err
+    return u if exp == 1 else u**exp
+
+
+@lru_cache(maxsize=1024)
+def unit(spec: str | Unit | None) -> Unit:
+    """Parse a unit string like ``'us'``, ``'counts'``, ``'m/s'``, ``'1/angstrom'``.
+
+    Grammar: ``tok ('*' tok)* ('/' tok)*`` with per-token ``**n`` exponents.
+    ``None`` and ``''`` parse as dimensionless.
+    """
+    if isinstance(spec, Unit):
+        return spec
+    if spec is None:
+        return _REGISTRY["dimensionless"]
+    s = spec.strip()
+    if s in _REGISTRY:
+        return _REGISTRY[s]
+    # Normalize '**' to '^' so splitting on '*' means multiplication only.
+    s = s.replace("**", "^")
+    parts = s.split("/")
+    result = _REGISTRY["dimensionless"]
+    for mult in parts[0].split("*"):
+        if mult.strip():
+            result = result * _parse_token(mult)
+    for div in parts[1:]:
+        for i, mult in enumerate(div.split("*")):
+            if mult.strip():
+                tok = _parse_token(mult)
+                result = result / tok if i == 0 else result * tok
+    return result

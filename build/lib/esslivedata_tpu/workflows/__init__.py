@@ -1,0 +1,14 @@
+"""Streaming workflows: registry + concrete reductions.
+
+Parity with reference ``src/ess/livedata/workflows/`` (SURVEY.md section
+2.4), with the compute substrate swapped: where the reference wraps sciline
+task graphs in ``StreamProcessorWorkflow`` and computes with scipp on CPU,
+workflows here compose jitted JAX kernels with device-resident state —
+"the pipeline" is a traced XLA program, not a Python DAG walked per cycle
+(the reference itself found DAG-scheduler overhead significant,
+core/sciline_scheduler.py:16-18; trace-once/execute-many removes it).
+"""
+
+from .workflow_factory import SpecHandle, Workflow, WorkflowFactory, workflow_registry
+
+__all__ = ["SpecHandle", "Workflow", "WorkflowFactory", "workflow_registry"]

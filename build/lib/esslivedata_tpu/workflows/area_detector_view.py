@@ -1,0 +1,80 @@
+"""Area-detector (camera) view: ad00 images with current+cumulative outputs
+and an optional logical transform (reference: workflows/area_detector_view.py:22).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any
+
+import numpy as np
+from pydantic import BaseModel, ConfigDict
+
+from ..core.timestamp import Timestamp
+from ..preprocessors.accumulators import WindowedCumulative
+from ..utils.labeled import DataArray, Variable
+
+__all__ = ["AreaDetectorParams", "AreaDetectorView"]
+
+
+class AreaDetectorParams(BaseModel):
+    model_config = ConfigDict(frozen=True)
+
+    transpose: bool = False
+    flip_y: bool = False
+    flip_x: bool = False
+
+
+class AreaDetectorView:
+    """Accumulates 2-D camera frames through the paired window/cumulative
+    accumulator: both views restart automatically when the frame's
+    structure changes (camera ROI reconfigured upstream, unit change)."""
+
+    def __init__(self, *, params: AreaDetectorParams | None = None) -> None:
+        self._params = params or AreaDetectorParams()
+        self._acc = WindowedCumulative()
+
+    def _transform(self, values: np.ndarray) -> np.ndarray:
+        p = self._params
+        if p.transpose:
+            values = values.T
+        if p.flip_y:
+            values = values[::-1, :]
+        if p.flip_x:
+            values = values[:, ::-1]
+        return values
+
+    def accumulate(self, data: Mapping[str, Any]) -> None:
+        for value in data.values():
+            if not isinstance(value, DataArray) or value.data.ndim != 2:
+                continue
+            frame = self._transform(
+                np.asarray(value.values, dtype=np.float64)
+            )
+            ny, nx = frame.shape
+            self._acc.add(
+                Timestamp.from_ns(0),
+                DataArray(
+                    Variable(frame, ("y", "x"), value.unit),
+                    coords={
+                        "y": Variable(
+                            np.arange(ny, dtype=np.float64), ("y",), ""
+                        ),
+                        "x": Variable(
+                            np.arange(nx, dtype=np.float64), ("x",), ""
+                        ),
+                    },
+                    name="frame",
+                ),
+            )
+
+    def finalize(self) -> dict[str, DataArray]:
+        if self._acc.is_empty:
+            return {}
+        window, cumulative = self._acc.take()
+        window.name = "current"
+        cumulative.name = "cumulative"
+        return {"current": window, "cumulative": cumulative}
+
+    def clear(self) -> None:
+        self._acc.clear()

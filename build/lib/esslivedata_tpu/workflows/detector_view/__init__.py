@@ -1,0 +1,27 @@
+"""2-D detector view — the flagship workflow (reference: workflows/
+detector_view/, 2100 LoC; SURVEY.md section 2.4).
+
+Projects physical detector pixels onto a 2-D screen, histograms events over
+screen x TOA on device, and derives image / spectrum / counts / ROI-spectra
+outputs. TPU shape of the reference design:
+
+- GeometricProjector's per-pixel screen coords with gaussian position-noise
+  replicas (projectors.py:47-95) become a precomputed [replica, pixel] ->
+  screen-bin int32 gather table built once per geometry (host, numpy).
+- The event projection + per-pixel grouping + histogramming chain is one
+  jitted scatter-add into a [screen, toa] HBM-resident state pair.
+- ROI spectra (roi.py:188) are a mask matmul [n_roi, screen] @ [screen,
+  toa] on the MXU, recomputed every finalize at negligible cost.
+"""
+
+from .projectors import LogicalView, ProjectionTable, project_geometric, project_logical
+from .workflow import DetectorViewParams, DetectorViewWorkflow
+
+__all__ = [
+    "DetectorViewParams",
+    "DetectorViewWorkflow",
+    "LogicalView",
+    "ProjectionTable",
+    "project_geometric",
+    "project_logical",
+]

@@ -1,0 +1,221 @@
+"""f144-driven dynamic geometry: motor motion -> projection-table rebuild.
+
+Parity with reference ``workflows/dynamic_transforms.py`` (synthesised
+providers patching live motor values into NeXus ``depends_on`` transform
+chains) re-expressed for the TPU design: the projection is a precomputed
+pixel->screen LUT (detector_view/projectors.py), so live geometry means
+*rebuilding that LUT on the host* when a bound motor value moves, without
+stalling the stream, and resetting accumulated histograms — moved-geometry
+counts must not blend with old-geometry counts (the reference's
+reset-on-geometry-change semantics, accumulators.py NoCopyAccumulator and
+monitor geometry_signal).
+
+A ``TransformChain`` is the NeXus ``NXtransformations`` model: an ordered
+sequence of axis transforms (translation along / rotation about a vector),
+each either static or bound to a context stream (a motor's synthesized
+Device stream or plain f144 log). Chains apply depends_on-style: the last
+entry is applied first, base positions are in the component's local frame.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from dataclasses import dataclass, field
+from typing import Any, Callable, Literal
+
+import numpy as np
+
+from ..utils.labeled import DataArray
+from .detector_view.projectors import ProjectionTable, project_geometric
+
+__all__ = [
+    "DynamicGeometry",
+    "DynamicGeometryWorkflow",
+    "Transform",
+    "TransformChain",
+]
+
+
+@dataclass(frozen=True)
+class Transform:
+    """One NXtransformations axis: translate along or rotate about ``vector``.
+
+    ``value`` is the static magnitude (translation in ``unit``, rotation in
+    degrees); ``stream`` optionally binds it to a context stream whose
+    latest sample replaces the static value at evaluation time.
+    """
+
+    kind: Literal["translation", "rotation"]
+    vector: tuple[float, float, float]
+    value: float = 0.0
+    stream: str | None = None
+    offset: tuple[float, float, float] = (0.0, 0.0, 0.0)
+
+    def resolve(self, values: Mapping[str, float]) -> float:
+        if self.stream is not None and self.stream in values:
+            return float(values[self.stream])
+        return self.value
+
+    def matrix(self, value: float) -> np.ndarray:
+        """4x4 homogeneous matrix for this axis at ``value``."""
+        m = np.eye(4)
+        v = np.asarray(self.vector, dtype=float)
+        norm = np.linalg.norm(v)
+        if norm == 0:
+            raise ValueError("Transform vector must be non-zero")
+        v = v / norm
+        if self.kind == "translation":
+            m[:3, 3] = v * value
+        else:  # rotation by `value` degrees about v (Rodrigues)
+            theta = np.deg2rad(value)
+            k = np.array(
+                [[0, -v[2], v[1]], [v[2], 0, -v[0]], [-v[1], v[0], 0]]
+            )
+            m[:3, :3] = (
+                np.eye(3) + np.sin(theta) * k + (1 - np.cos(theta)) * (k @ k)
+            )
+        m[:3, 3] += np.asarray(self.offset, dtype=float)
+        return m
+
+
+@dataclass(frozen=True)
+class TransformChain:
+    """Ordered depends_on chain; ``transforms[0]`` is closest to the root."""
+
+    transforms: tuple[Transform, ...] = ()
+
+    def bound_streams(self) -> list[str]:
+        return [t.stream for t in self.transforms if t.stream is not None]
+
+    def apply(
+        self, positions: np.ndarray, values: Mapping[str, float]
+    ) -> np.ndarray:
+        """Transform [n, 3] positions through the chain with live values."""
+        m = np.eye(4)
+        for t in self.transforms:
+            m = m @ t.matrix(t.resolve(values))
+        out = positions @ m[:3, :3].T + m[:3, 3]
+        return out
+
+    def signature(self, values: Mapping[str, float]) -> tuple[float, ...]:
+        """The live values actually in effect — the geometry signal."""
+        return tuple(t.resolve(values) for t in self.transforms)
+
+
+@dataclass
+class DynamicGeometry:
+    """A detector bank whose position depends on live motor values."""
+
+    base_positions: np.ndarray  # [n, 3] in the component frame
+    pixel_ids: np.ndarray
+    chain: TransformChain
+    projection: str = "xy_plane"
+    resolution: tuple[int, int] = (128, 128)
+    noise_sigma: float = 0.0
+    n_replica: int = 1
+    atol: float = 1e-6
+    """Geometry-signal change below this does not count as motion."""
+    extent: tuple[float, float, float, float] | None = None
+    _last_signature: tuple[float, ...] | None = field(default=None, repr=False)
+
+    def moved(self, values: Mapping[str, float]) -> bool:
+        """True when bound values moved since the last build (or never built)."""
+        sig = self.chain.signature(values)
+        if self._last_signature is None:
+            return True
+        return any(
+            abs(a - b) > self.atol
+            for a, b in zip(sig, self._last_signature, strict=True)
+        )
+
+    def build_projection(self, values: Mapping[str, float]) -> ProjectionTable:
+        self._last_signature = self.chain.signature(values)
+        positions = self.chain.apply(self.base_positions, values)
+        return project_geometric(
+            positions,
+            self.pixel_ids,
+            mode=self.projection,
+            resolution=self.resolution,
+            noise_sigma=self.noise_sigma,
+            n_replica=self.n_replica,
+            extent=self.extent,
+        )
+
+
+def _latest_value(sample: Any) -> float | None:
+    """Latest numeric sample from an NXlog series / LogData / scalar."""
+    if sample is None:
+        return None
+    if isinstance(sample, DataArray):
+        values = np.atleast_1d(np.asarray(sample.data.values))
+        return float(values[-1]) if values.size else None
+    if hasattr(sample, "value"):
+        values = np.atleast_1d(np.asarray(sample.value))
+        return float(values[-1]) if values.size else None
+    try:
+        return float(sample)
+    except (TypeError, ValueError):
+        return None
+
+
+class DynamicGeometryWorkflow:
+    """Workflow decorator rebuilding the projection when geometry moves.
+
+    Wraps a factory ``make(projection) -> Workflow`` (e.g. a
+    DetectorViewWorkflow closure). ``set_context`` extracts the latest
+    value of every chain-bound stream; when the geometry signal moves the
+    inner workflow is rebuilt from a fresh projection table — accumulated
+    state intentionally resets (moved-geometry counts must not blend) and
+    installed ROIs are re-applied.
+    """
+
+    def __init__(
+        self,
+        *,
+        geometry: DynamicGeometry,
+        make: Callable[[ProjectionTable], Any],
+    ) -> None:
+        self._geometry = geometry
+        self._make = make
+        self._values: dict[str, float] = {}
+        self._rois: Mapping[str, Any] | None = None
+        self._inner = make(geometry.build_projection({}))
+
+    @property
+    def inner(self) -> Any:
+        return self._inner
+
+    def set_context(self, context: Mapping[str, Any]) -> None:
+        for stream in self._geometry.chain.bound_streams():
+            if (value := _latest_value(context.get(stream))) is not None:
+                self._values[stream] = value
+        if self._geometry.moved(self._values):
+            projection = self._geometry.build_projection(self._values)
+            # Same-shape rebuilds swap the LUT into the running kernel
+            # (no recompile — see DetectorViewWorkflow.swap_projection);
+            # anything else falls back to a full rebuild.
+            if not (
+                hasattr(self._inner, "swap_projection")
+                and self._inner.swap_projection(projection)
+            ):
+                self._inner = self._make(projection)
+                # The swap branch re-installs its own ROI masks; only a
+                # fresh inner needs them applied here.
+                if self._rois is not None and hasattr(self._inner, "set_rois"):
+                    self._inner.set_rois(self._rois)
+        if hasattr(self._inner, "set_context"):
+            self._inner.set_context(context)
+
+    def set_rois(self, rois: Mapping[str, Any]) -> None:
+        self._rois = rois
+        if hasattr(self._inner, "set_rois"):
+            self._inner.set_rois(rois)
+
+    def accumulate(self, data: Mapping[str, Any]) -> None:
+        self._inner.accumulate(data)
+
+    def finalize(self) -> dict[str, DataArray]:
+        return self._inner.finalize()
+
+    def clear(self) -> None:
+        self._inner.clear()

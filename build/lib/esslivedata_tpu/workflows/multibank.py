@@ -1,0 +1,194 @@
+"""Multi-bank detector workflow: one kernel over all banks, mesh-shardable.
+
+BIFROST-style instruments have many detector banks (9 analyzer triplets)
+merged into one logical stream (reference: Ev44ToDetectorEventsAdapter
+merge-detectors, message_adapter.py:416). TPU-native shape: the screen
+space is the *concatenation of all banks* — one [n_banks*rows, toa] state,
+one scatter per window — and when the process owns a multi-device mesh the
+same workflow shards that bank axis over devices via ShardedHistogrammer
+(BASELINE config 3). Per-bank outputs are slices of the global state.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any
+
+import numpy as np
+from pydantic import BaseModel, ConfigDict, Field
+
+import jax
+
+from ..config.models import TOARange
+from ..ops.histogram import EventHistogrammer
+from ..parallel.mesh import make_mesh
+from ..parallel.sharded_hist import ShardedHistogrammer
+from ..preprocessors.event_data import StagedEvents
+from ..utils.labeled import DataArray, Variable
+
+__all__ = ["MultiBankParams", "MultiBankViewWorkflow"]
+
+
+
+
+class MultiBankParams(BaseModel):
+    model_config = ConfigDict(frozen=True)
+
+    toa_bins: int = 100
+    toa_range: TOARange = Field(default_factory=TOARange)
+    use_mesh: bool = True
+    """Shard the bank axis over all visible devices when more than one."""
+
+
+class MultiBankViewWorkflow:
+    """Per-bank TOA histograms from a merged multi-bank event stream."""
+
+    def __init__(
+        self,
+        *,
+        bank_detector_numbers: Mapping[str, np.ndarray],
+        params: MultiBankParams | None = None,
+    ) -> None:
+        params = params or MultiBankParams()
+        self._params = params
+        self._bank_names = list(bank_detector_numbers)
+        n_banks = len(self._bank_names)
+        sizes = [np.asarray(d).size for d in bank_detector_numbers.values()]
+        if len(set(sizes)) != 1:
+            raise ValueError("All banks must have equal pixel counts")
+        self._pixels_per_bank = sizes[0]
+        n_screen = n_banks * self._pixels_per_bank
+
+        # Global LUT: detector_number -> bank*pixels_per_bank + local index
+        max_id = max(int(np.asarray(d).max()) for d in bank_detector_numbers.values())
+        lut = np.full(max_id + 1, -1, dtype=np.int32)
+        for b, det in enumerate(bank_detector_numbers.values()):
+            ids = np.asarray(det).reshape(-1)
+            lut[ids] = b * self._pixels_per_bank + np.arange(ids.size)
+
+        edges = np.linspace(
+            params.toa_range.low, params.toa_range.high, params.toa_bins + 1
+        )
+        n_devices = len(jax.devices())
+        # The bank axis shards only in whole banks; use the largest device
+        # count that divides n_screen bank-wise.
+        self._sharded = None
+        if params.use_mesh and n_devices > 1:
+            bank_axis = n_devices
+            while bank_axis > 1 and n_banks % bank_axis:
+                bank_axis -= 1
+            if bank_axis > 1:
+                mesh = make_mesh(bank_axis, bank=bank_axis)
+                self._sharded = ShardedHistogrammer(
+                    toa_edges=edges, n_screen=n_screen, mesh=mesh, pixel_lut=lut
+                )
+        if self._sharded is not None:
+            self._hist = self._sharded
+        else:
+            self._hist = EventHistogrammer(
+                toa_edges=edges, n_screen=n_screen, pixel_lut=lut
+            )
+        self._state = self._hist.init_state()
+        self._edges_var = Variable(edges, ("toa",), "ns")
+        self._n_banks = n_banks
+        self._publish = None
+
+    @property
+    def is_sharded(self) -> bool:
+        return self._sharded is not None
+
+    def accumulate(self, data: Mapping[str, Any]) -> None:
+        for value in data.values():
+            if isinstance(value, StagedEvents):
+                if self._sharded is not None:
+                    self._state = self._sharded.step(
+                        self._state, value.batch.pixel_id, value.batch.toa
+                    )
+                else:
+                    self._state = self._hist.step_batch(
+                        self._state, value.batch
+                    )
+
+    def _publisher(self):
+        """Lazy fused publish program (single-chip path): bank reductions
+        on device, one execute + one packed fetch, window fold included
+        (ops/publish.py). The sharded path keeps its collective read —
+        its state spans the mesh and publishes via the exchange kernels."""
+        if self._publish is None:
+            from ..ops.publish import PackedPublisher
+
+            def program(state):
+                cum, win = self._hist.views_of(state)
+                shape = (self._n_banks, self._pixels_per_bank, -1)
+                win3 = win.reshape(shape)
+                cum3 = cum.reshape(shape)
+                outputs = {
+                    "bank_spectra_current": win3.sum(axis=1),
+                    "bank_spectra_cumulative": cum3.sum(axis=1),
+                    "bank_counts_current": win3.sum(axis=(1, 2)),
+                    "bank_counts_cumulative": cum3.sum(axis=(1, 2)),
+                    "counts_current": win3.sum(),
+                    "counts_cumulative": cum3.sum(),
+                }
+                return outputs, self._hist.fold_window(state)
+
+            self._publish = PackedPublisher(program)
+        return self._publish
+
+    def finalize(self) -> dict[str, DataArray]:
+        if self._sharded is None:
+            out, self._state = self._publisher()(self._state)
+            win_spectra = out["bank_spectra_current"]
+            cum_spectra = out["bank_spectra_cumulative"]
+            win_counts = out["bank_counts_current"]
+            cum_counts = out["bank_counts_cumulative"]
+            total_win = out["counts_current"]
+            total_cum = out["counts_cumulative"]
+        else:
+            cum, win = self._hist.read(self._state)
+            win = win.reshape(self._n_banks, self._pixels_per_bank, -1)
+            cum = cum.reshape(self._n_banks, self._pixels_per_bank, -1)
+            self._state = self._hist.clear_window(self._state)
+            win_spectra, cum_spectra = win.sum(axis=1), cum.sum(axis=1)
+            win_counts, cum_counts = win.sum(axis=(1, 2)), cum.sum(axis=(1, 2))
+            total_win, total_cum = win.sum(), cum.sum()
+        bank_coord = Variable(
+            np.arange(self._n_banks), ("bank",), ""
+        )
+        coords = {"toa": self._edges_var, "bank": bank_coord}
+        return {
+            "bank_spectra_current": DataArray(
+                Variable(win_spectra, ("bank", "toa"), "counts"),
+                coords=coords,
+                name="bank_spectra_current",
+            ),
+            "bank_spectra_cumulative": DataArray(
+                Variable(cum_spectra, ("bank", "toa"), "counts"),
+                coords=coords,
+                name="bank_spectra_cumulative",
+            ),
+            "bank_counts_current": DataArray(
+                Variable(win_counts, ("bank",), "counts"),
+                coords={"bank": bank_coord},
+                name="bank_counts_current",
+            ),
+            "bank_counts_cumulative": DataArray(
+                Variable(cum_counts, ("bank",), "counts"),
+                coords={"bank": bank_coord},
+                name="bank_counts_cumulative",
+            ),
+            "counts_current": DataArray(
+                Variable(np.asarray(total_win), (), "counts"),
+                name="counts_current",
+            ),
+            "counts_cumulative": DataArray(
+                Variable(np.asarray(total_cum), (), "counts"),
+                name="counts_cumulative",
+            ),
+        }
+
+    def clear(self) -> None:
+        if self._sharded is not None:
+            self._state = self._sharded.init_state()
+        else:
+            self._state = self._hist.clear(self._state)

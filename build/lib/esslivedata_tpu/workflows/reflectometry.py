@@ -1,0 +1,160 @@
+"""Specular reflectometry R(Qz) workflow (ESTIA).
+
+The reference reduces ESTIA through ess.estia's sciline workflow; the
+TPU-native shape matches the other reductions with one twist: the
+(pixel, toa-bin) -> Qz-bin table depends on the SAMPLE ANGLE, which is
+a live motor position. The workflow therefore gates on the
+``sample_angle`` context stream (jobs hold until the angle is known)
+and rebuilds the table when the angle moves beyond a tolerance —
+between batches, on the host, without touching the stream; the fold
+state carries over because bin shapes never change.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any
+
+import numpy as np
+from pydantic import BaseModel, ConfigDict, Field
+
+from ..config.models import TOARange
+from ..ops.qhistogram import QHistogrammer, build_qz_map
+from ..utils.labeled import DataArray, Variable
+from .qshared import QStreamingMixin, latest_sample_value
+
+__all__ = ["ReflectometryParams", "ReflectometryWorkflow"]
+
+
+class ReflectometryParams(BaseModel):
+    model_config = ConfigDict(frozen=True)
+
+    qz_bins: int = 200
+    qz_min: float = 0.005  # 1/angstrom
+    qz_max: float = 0.3
+    toa_bins: int = 400
+    toa_range: TOARange = Field(default_factory=TOARange)
+    l1: float = 35.0  # m, moderator->sample
+    #: Sample-angle moves below this are measurement noise, not a
+    #: reconfiguration — no table rebuild. Above it the host rebuilds
+    #: the table and swaps it into the running kernel (no recompile).
+    rebuild_tolerance_deg: float = 0.02
+
+
+class ReflectometryWorkflow(QStreamingMixin):
+    """Detector events -> R(Qz); gates on the live sample angle."""
+
+    def __init__(
+        self,
+        *,
+        pixel_offset_rad: np.ndarray,  # per-pixel angle above the horizon
+        l2: np.ndarray,  # sample->pixel path (m); l1 comes from params
+        pixel_ids: np.ndarray,
+        params: ReflectometryParams | None = None,
+        primary_stream: str | None = None,
+        monitor_streams: set[str] | None = None,
+        angle_stream: str = "sample_angle",
+    ) -> None:
+        params = params or ReflectometryParams()
+        self._params = params
+        self._offsets = np.asarray(pixel_offset_rad, dtype=np.float64)
+        self._l_total = params.l1 + np.asarray(l2, dtype=np.float64)
+        self._pixel_ids = np.asarray(pixel_ids)
+        self._qz_edges = np.linspace(
+            params.qz_min, params.qz_max, params.qz_bins + 1
+        )
+        self._toa_edges = np.linspace(
+            params.toa_range.low, params.toa_range.high, params.toa_bins + 1
+        )
+        self._angle_stream = angle_stream
+        self._omega_deg: float | None = None
+        self._built_omega_deg: float | None = None
+        self._primary_stream = primary_stream
+        self._monitor_streams = monitor_streams or set()
+        self._hist: QHistogrammer | None = None
+        self._state = None
+        self._publish = None
+        self._qz_var = Variable(self._qz_edges, ("Qz",), "1/angstrom")
+
+    # -- context -----------------------------------------------------------
+    def set_context(self, context: Mapping[str, Any]) -> None:
+        if (
+            value := latest_sample_value(context.get(self._angle_stream))
+        ) is not None:
+            self._omega_deg = value
+
+    def _ensure_table(self) -> bool:
+        """(Re)build the Qz table for the current sample angle; returns
+        False while the angle is unknown (no accumulation possible)."""
+        if self._omega_deg is None:
+            return False
+        if (
+            self._built_omega_deg is not None
+            and abs(self._omega_deg - self._built_omega_deg)
+            < self._params.rebuild_tolerance_deg
+        ):
+            return True
+        grazing = np.deg2rad(self._omega_deg) + self._offsets
+        qz_map = build_qz_map(
+            grazing_angle=grazing,
+            l_total=self._l_total,
+            pixel_ids=self._pixel_ids,
+            toa_edges=self._toa_edges,
+            qz_edges=self._qz_edges,
+        )
+        if self._hist is None:
+            self._hist = QHistogrammer(
+                qmap=qz_map,
+                toa_edges=self._toa_edges,
+                n_q=self._params.qz_bins,
+            )
+            self._state = self._hist.init_state()
+        else:
+            # Continuous omega scans cross the tolerance every few
+            # batches: the table rides the jitted step as an argument,
+            # so a move costs one device transfer — no recompile, and
+            # the accumulated state stays (bin space is unchanged).
+            self._hist.swap_table(qz_map)
+        self._built_omega_deg = self._omega_deg
+        return True
+
+    # -- Workflow protocol -------------------------------------------------
+    def accumulate(self, data: Mapping[str, Any]) -> None:
+        if not self._ensure_table():
+            return  # gated: angle not yet known
+        super().accumulate(data)
+
+    def finalize(self) -> dict[str, DataArray]:
+        if not self._ensure_table():
+            return {}
+        win, cum, mon_win, mon_cum = self._take_publish()
+        coords = {"Qz": self._qz_var}
+
+        def spectrum(values, name, unit="counts"):
+            return DataArray(
+                Variable(values, ("Qz",), unit), coords=coords, name=name
+            )
+
+        return {
+            "r_qz_current": spectrum(win, "r_qz_current"),
+            "r_qz_cumulative": spectrum(cum, "r_qz_cumulative"),
+            "r_qz_normalized": spectrum(
+                cum / max(mon_cum, 1.0), "r_qz_normalized", unit=""
+            ),
+            "counts_current": DataArray(
+                Variable(np.asarray(win.sum()), (), "counts"),
+                name="counts_current",
+            ),
+            "monitor_counts_current": DataArray(
+                Variable(np.asarray(mon_win), (), "counts"),
+                name="monitor_counts_current",
+            ),
+            "sample_angle_deg": DataArray(
+                Variable(np.asarray(self._built_omega_deg), (), "deg"),
+                name="sample_angle_deg",
+            ),
+        }
+
+    def clear(self) -> None:
+        if self._hist is not None:
+            self._state = self._hist.clear()

@@ -1,0 +1,137 @@
+"""Monitor-normalized streaming SANS I(Q) workflow (BASELINE config 4).
+
+The reference's LOKI I(Q) runs esssans' sciline graph per cycle
+(reference: instruments/loki/factories.py:21-120); here the whole reduction
+is the precompiled Q-map scatter kernel (ops/qhistogram.py) plus a
+monitor-ratio at finalize. The monitor arrives as an aux stream of staged
+events (ADR-0002-style aux binding through WorkflowConfig.aux_source_names).
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+from pydantic import BaseModel, ConfigDict, Field
+
+from ..config.models import TOARange
+from ..ops.qhistogram import QHistogrammer, build_sans_qmap
+from ..utils.labeled import DataArray, Variable
+from .qshared import QStreamingMixin
+
+__all__ = ["SansIQParams", "SansIQWorkflow", "TransmissionMode"]
+
+
+class TransmissionMode(str, enum.Enum):
+    """Live transmission correction (reference: loki/specs.py:38-61).
+
+    Only modes that need no separate empty-beam run are available live:
+    ``constant`` applies no correction (fraction = 1); ``current_run``
+    estimates the fraction as transmission-monitor / incident-monitor
+    counts within the current run.
+    """
+
+    constant = "constant"
+    current_run = "current_run"
+
+
+class SansIQParams(BaseModel):
+    model_config = ConfigDict(frozen=True)
+
+    q_bins: int = 100
+    q_min: float = 0.005  # 1/angstrom
+    q_max: float = 0.5
+    toa_bins: int = 200  # resolution of the TOF->lambda mapping
+    toa_range: TOARange = Field(default_factory=TOARange)
+    toa_offset_ns: float = 0.0  # emission-time correction
+    l1: float = 23.0  # m, source->sample
+    transmission_mode: TransmissionMode = TransmissionMode.current_run
+    # Beam-center position on the detector (m); shifts the scattering-angle
+    # origin (reference: loki/specs.py BeamCenterXY).
+    beam_center_x: float = 0.0
+    beam_center_y: float = 0.0
+
+
+class SansIQWorkflow(QStreamingMixin):
+    """Detector events -> I(Q); aux monitor events -> normalization."""
+
+    def __init__(
+        self,
+        *,
+        positions: np.ndarray,
+        pixel_ids: np.ndarray,
+        params: SansIQParams | None = None,
+        primary_stream: str | None = None,
+        monitor_streams: set[str] | None = None,
+        transmission_streams: set[str] | None = None,
+    ) -> None:
+        params = params or SansIQParams()
+        self._params = params
+        q_edges = np.linspace(params.q_min, params.q_max, params.q_bins + 1)
+        toa_edges = np.linspace(
+            params.toa_range.low, params.toa_range.high, params.toa_bins + 1
+        )
+        qmap = build_sans_qmap(
+            positions=positions,
+            pixel_ids=pixel_ids,
+            toa_edges=toa_edges,
+            q_edges=q_edges,
+            l1=params.l1,
+            toa_offset_ns=params.toa_offset_ns,
+            beam_center=(params.beam_center_x, params.beam_center_y),
+        )
+        self._hist = QHistogrammer(
+            qmap=qmap, toa_edges=toa_edges, n_q=params.q_bins
+        )
+        self._state = self._hist.init_state()
+        self._q_edges_var = Variable(q_edges, ("Q",), "1/angstrom")
+        self._primary_stream = primary_stream
+        self._monitor_streams = monitor_streams or set()
+        self._transmission_streams = frozenset(transmission_streams or ())
+        self._publish = None
+
+    def _transmission_fraction(self, trans: float, incident: float) -> float:
+        """current_run estimate: raw transmission/incident monitor ratio.
+
+        Falls back to 1 (no correction) when either channel is empty.
+        The ratio is deliberately NOT clamped to 1: a value above 1
+        signals monitor efficiency/rate mismatch, which should be
+        visible in the published fraction rather than silently hidden.
+        """
+        if (
+            self._params.transmission_mode is not TransmissionMode.current_run
+            or not self._transmission_streams
+            or trans <= 0.0
+            or incident <= 0.0
+        ):
+            return 1.0
+        return trans / incident
+
+    def _iq(self, counts: np.ndarray, monitor: float, fraction: float) -> DataArray:
+        norm = counts / (max(monitor, 1.0) * fraction)
+        return DataArray(
+            Variable(norm, ("Q",), ""),
+            coords={"Q": self._q_edges_var},
+        )
+
+    def finalize(self) -> dict[str, DataArray]:
+        win, cum, mon_win, mon_cum = self._take_publish()
+        trans_win, trans_cum = self._take_transmission()
+        t_win = self._transmission_fraction(trans_win, mon_win)
+        t_cum = self._transmission_fraction(trans_cum, mon_cum)
+        coords = {"Q": self._q_edges_var}
+        return {
+            "iq_current": self._iq(win, mon_win, t_win),
+            "iq_cumulative": self._iq(cum, mon_cum, t_cum),
+            "counts_q_current": DataArray(
+                Variable(win, ("Q",), "counts"), coords=coords
+            ),
+            "monitor_counts_current": DataArray(
+                Variable(np.asarray(mon_win), (), "counts")
+            ),
+            "transmission_current": DataArray(
+                Variable(np.asarray(t_win), (), "")
+            ),
+        }
+
+

@@ -1,0 +1,30 @@
+"""Timeseries workflow: republish the accumulated NXlog series
+(reference: workflows/timeseries.py:12 TimeseriesStreamProcessor)."""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any
+
+from ..utils.labeled import DataArray
+
+__all__ = ["TimeseriesWorkflow"]
+
+
+class TimeseriesWorkflow:
+    """Passes through the latest accumulated log DataArray per stream."""
+
+    def __init__(self) -> None:
+        self._latest: dict[str, DataArray] = {}
+
+    def accumulate(self, data: Mapping[str, Any]) -> None:
+        for key, value in data.items():
+            if isinstance(value, DataArray):
+                self._latest[key] = value
+
+    def finalize(self) -> dict[str, DataArray]:
+        out = dict(self._latest)
+        return out
+
+    def clear(self) -> None:
+        self._latest.clear()

@@ -1,0 +1,288 @@
+"""Wavelength lookup-table workflow.
+
+Parity with reference ``workflows/wavelength_lut_workflow.py`` (which wraps
+essreduce's analytical unwrap pipeline): recompute the TOF->wavelength
+lookup table whenever the chopper cascade reaches new setpoints. The
+synthetic ``chopper_cascade`` trigger (kafka/chopper_synthesizer.py) is the
+primary dynamic stream — its *arrival* drives the recompute, its value is
+ignored. Per-chopper rotation-speed and delay setpoints arrive as gated
+context (ADR 0002: the job stays pending_context until every setpoint
+stream has a value), so the table is only ever built from a complete,
+locked cascade.
+
+Outputs:
+
+- ``wavelength_lut`` [distance, event_time_offset]: mean transmitted
+  wavelength, with provenance coords (pulse period, stride, resolutions)
+  making the published da00 self-describing.
+- ``wavelength_bands`` [distance, event_time_offset]: the same estimate
+  evaluated at the *exact* distances of source + each chopper + configured
+  cut points (monitor/detector positions), so closely-spaced choppers stay
+  individually resolved; an all-NaN row means that element blocks the beam.
+
+The cascade propagation itself is host-side numpy (ops/chopper_cascade.py)
+— a cold path that runs only on setpoint changes. The hot path consumes the
+table as a device-side gather (monitor/detector wavelength modes).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections.abc import Mapping, Sequence
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+from pydantic import BaseModel, Field
+
+from ..config.chopper import (
+    CHOPPER_CASCADE_SOURCE,
+    delay_setpoint_stream,
+    speed_setpoint_stream,
+)
+from ..core.constants import PULSE_PERIOD_NS_DEN, PULSE_PERIOD_NS_NUM
+from ..ops.chopper_cascade import (
+    DiskChopper,
+    propagate_cascade,
+    wavelength_band_at,
+    wavelength_lut,
+)
+from ..utils.labeled import DataArray, Variable
+from .workflow_factory import SpecHandle
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ChopperGeometry",
+    "WavelengthLutParams",
+    "WavelengthLutWorkflow",
+    "attach_wavelength_lut_factory",
+]
+
+
+@dataclass(frozen=True)
+class ChopperGeometry:
+    """Static per-chopper geometry (from the instrument's NeXus artifact);
+    the live quantities (rotation speed, delay) arrive as context streams."""
+
+    name: str
+    distance_m: float
+    slit_edges_deg: tuple[tuple[float, float], ...] = ((0.0, 180.0),)
+
+
+class WavelengthLutParams(BaseModel):
+    """User-facing parameters of the LUT computation (the UI schema)."""
+
+    pulse_period_ns: float = PULSE_PERIOD_NS_NUM / PULSE_PERIOD_NS_DEN
+    pulse_length_ns: float = 2.86e6
+    stride: int = Field(default=1, ge=1)
+    distance_start_m: float = 1.0
+    distance_stop_m: float = 50.0
+    distance_resolution_m: float = Field(default=1.0, gt=0)
+    n_time_bins: int = Field(default=500, ge=2)
+    wavelength_min_a: float = Field(default=0.1, gt=0)
+    wavelength_max_a: float = 25.0
+    cut_distances_m: list[float] = Field(default_factory=list)
+    """Extra exact-distance rows for the bands output (typically monitor
+    and detector positions)."""
+
+
+class WavelengthLutWorkflow:
+    """Workflow: cascade trigger + setpoint context -> LUT DataArrays."""
+
+    def __init__(
+        self,
+        *,
+        choppers: Sequence[ChopperGeometry],
+        params: WavelengthLutParams | None = None,
+    ) -> None:
+        self._choppers = list(choppers)
+        self._params = params or WavelengthLutParams()
+        self._speed: dict[str, float] = {}
+        self._delay: dict[str, float] = {}
+        self._triggered = False
+        self._computed_signature: tuple[float, ...] | None = None
+
+    # -- context ----------------------------------------------------------
+    def set_context(self, context: Mapping[str, Any]) -> None:
+        """Latest sample of each setpoint NXlog series wins."""
+        for geo in self._choppers:
+            if (value := _latest(context.get(speed_setpoint_stream(geo.name)))) is not None:
+                self._speed[geo.name] = value
+            if (value := _latest(context.get(delay_setpoint_stream(geo.name)))) is not None:
+                self._delay[geo.name] = value
+
+    # -- Workflow protocol -------------------------------------------------
+    def accumulate(self, data: Mapping[str, Any]) -> None:
+        if CHOPPER_CASCADE_SOURCE in data:
+            self._triggered = True
+
+    def finalize(self) -> dict[str, DataArray]:
+        if not self._triggered:
+            return {}
+        missing = [
+            g.name
+            for g in self._choppers
+            if g.name not in self._speed or g.name not in self._delay
+        ]
+        if missing:
+            # Trigger arrived before context (gating should prevent this);
+            # stay triggered so the next finalize retries.
+            return {}
+        parked = [g.name for g in self._choppers if self._speed[g.name] <= 0]
+        if parked:
+            # A parked chopper (speed setpoint 0) has no well-defined open
+            # windows; skip the recompute — stay triggered so an updated
+            # speed retries — rather than erroring the job permanently.
+            logger.warning(
+                "wavelength LUT recompute skipped: chopper(s) %s parked "
+                "(speed setpoint <= 0)",
+                parked,
+            )
+            return {}
+        signature = self._signature()
+        if signature == self._computed_signature:
+            # Refresh tick with unchanged setpoints (the synthesizer
+            # re-emits periodically so late-started jobs prime): no-op.
+            self._triggered = False
+            return {}
+        self._triggered = False
+        out = self._compute()
+        self._computed_signature = signature
+        return out
+
+    def _signature(self) -> tuple[float, ...]:
+        return tuple(
+            v
+            for g in self._choppers
+            for v in (self._speed[g.name], self._delay[g.name])
+        )
+
+    def clear(self) -> None:
+        self._triggered = False
+        self._computed_signature = None
+
+    # -- computation -------------------------------------------------------
+    def _disk_choppers(self) -> list[DiskChopper]:
+        return [
+            DiskChopper(
+                name=g.name,
+                distance_m=g.distance_m,
+                frequency_hz=self._speed[g.name],
+                delay_ns=self._delay[g.name],
+                slit_edges_deg=g.slit_edges_deg,
+            )
+            for g in self._choppers
+        ]
+
+    def _compute(self) -> dict[str, DataArray]:
+        p = self._params
+        frame_period = p.pulse_period_ns * p.stride
+        subframes = propagate_cascade(
+            self._disk_choppers(),
+            pulse_period_ns=p.pulse_period_ns,
+            pulse_length_ns=p.pulse_length_ns,
+            wavelength_min_a=p.wavelength_min_a,
+            wavelength_max_a=p.wavelength_max_a,
+            stride=p.stride,
+        )
+        n_distance = (
+            int(
+                np.ceil(
+                    (p.distance_stop_m - p.distance_start_m)
+                    / p.distance_resolution_m
+                )
+            )
+            + 1
+        )
+        distances = np.linspace(p.distance_start_m, p.distance_stop_m, n_distance)
+        table, time_edges = wavelength_lut(
+            subframes,
+            distances_m=distances,
+            frame_period_ns=frame_period,
+            n_time_bins=p.n_time_bins,
+        )
+        # Exact-distance diagnostic rows: source + choppers + cut points.
+        band_distances = np.array(
+            sorted(
+                {0.0}
+                | {g.distance_m for g in self._choppers}
+                | set(p.cut_distances_m)
+            )
+        )
+        bands = np.stack(
+            [
+                wavelength_band_at(
+                    subframes,
+                    d,
+                    frame_period_ns=frame_period,
+                    time_edges_ns=time_edges,
+                )
+                for d in band_distances
+            ]
+        )
+        provenance = {
+            "pulse_period": Variable(np.asarray(p.pulse_period_ns), (), "ns"),
+            "pulse_stride": Variable(np.asarray(p.stride), (), None),
+            "distance_resolution": Variable(
+                np.asarray(p.distance_resolution_m), (), "m"
+            ),
+        }
+        time_coord = Variable(time_edges, ("event_time_offset",), "ns")
+        return {
+            "wavelength_lut": DataArray(
+                Variable(table, ("distance", "event_time_offset"), "angstrom"),
+                coords={
+                    "distance": Variable(distances, ("distance",), "m"),
+                    "event_time_offset": time_coord,
+                    **provenance,
+                },
+                name="wavelength_lut",
+            ),
+            "wavelength_bands": DataArray(
+                Variable(bands, ("distance", "event_time_offset"), "angstrom"),
+                coords={
+                    "distance": Variable(band_distances, ("distance",), "m"),
+                    "event_time_offset": time_coord,
+                    **provenance,
+                },
+                name="wavelength_bands",
+            ),
+        }
+
+
+def _latest(series: Any) -> float | None:
+    """Latest sample of an accumulated NXlog series (or scalar), or None."""
+    if series is None:
+        return None
+    if isinstance(series, DataArray):
+        values = np.atleast_1d(np.asarray(series.data.values))
+        return float(values[-1]) if values.size else None
+    values = np.atleast_1d(np.asarray(getattr(series, "value", series)))
+    return float(values[-1]) if values.size else None
+
+
+def attach_wavelength_lut_factory(
+    handle: SpecHandle, *, choppers: Sequence[ChopperGeometry]
+) -> None:
+    """Attach the LUT factory to a registered spec handle.
+
+    The spec must declare ``context_keys`` covering every chopper's
+    speed/delay setpoint stream (``spec_context_keys`` builds that list) so
+    the JobManager gates the job pending_context until the cascade is fully
+    locked — the reference enforces the same invariant by sharing sciline
+    key objects between bindings and provider (its :382-391).
+    """
+
+    @handle.attach_factory
+    def _factory(*, source_name: str, params) -> WavelengthLutWorkflow:  # noqa: ARG001
+        return WavelengthLutWorkflow(choppers=choppers, params=params)
+
+
+def spec_context_keys(choppers: Sequence[ChopperGeometry]) -> list[str]:
+    """The context streams a LUT spec must gate on for these choppers."""
+    keys: list[str] = []
+    for g in choppers:
+        keys.append(speed_setpoint_stream(g.name))
+        keys.append(delay_setpoint_stream(g.name))
+    return keys

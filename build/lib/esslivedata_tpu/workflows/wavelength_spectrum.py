@@ -1,0 +1,104 @@
+"""Per-pixel wavelength spectrum for position-resolved detectors.
+
+The reference offers a wavelength coordinate mode on its detector
+histograms via the unwrap LUT providers (monitor_workflow.py:169,
+detector_view providers); here the per-pixel TOF->wavelength conversion
+precompiles into the standard (pixel, toa-bin) -> bin table
+(ops/qhistogram.build_wavelength_map) — a detector-wide lambda spectrum
+at the same streaming cost as every other reduction family, with
+monitor normalization through the shared mixin.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from pydantic import BaseModel, ConfigDict, Field, model_validator
+
+from ..config.models import TOARange
+from ..ops.qhistogram import QHistogrammer, build_wavelength_map
+from ..utils.labeled import DataArray, Variable
+from .qshared import QStreamingMixin
+
+__all__ = ["WavelengthSpectrumParams", "WavelengthSpectrumWorkflow"]
+
+
+class WavelengthSpectrumParams(BaseModel):
+    model_config = ConfigDict(frozen=True)
+
+    wavelength_bins: int = 200
+    wavelength_min: float = 0.5  # angstrom
+    wavelength_max: float = 12.0
+    toa_bins: int = 300
+    toa_range: TOARange = Field(default_factory=TOARange)
+    toa_offset_ns: float = 0.0
+    l1: float = 23.0  # m, source->sample
+
+    @model_validator(mode="after")
+    def _ordered(self) -> WavelengthSpectrumParams:
+        if self.wavelength_max <= self.wavelength_min:
+            raise ValueError("wavelength range must satisfy min < max")
+        return self
+
+
+class WavelengthSpectrumWorkflow(QStreamingMixin):
+    """Detector events -> I(lambda); aux monitor -> normalization."""
+
+    def __init__(
+        self,
+        *,
+        positions: np.ndarray,
+        pixel_ids: np.ndarray,
+        params: WavelengthSpectrumParams | None = None,
+        primary_stream: str | None = None,
+        monitor_streams: set[str] | None = None,
+    ) -> None:
+        params = params or WavelengthSpectrumParams()
+        self._params = params
+        lam_edges = np.linspace(
+            params.wavelength_min,
+            params.wavelength_max,
+            params.wavelength_bins + 1,
+        )
+        toa_edges = np.linspace(
+            params.toa_range.low, params.toa_range.high, params.toa_bins + 1
+        )
+        positions = np.asarray(positions, dtype=np.float64)
+        l_total = params.l1 + np.linalg.norm(positions, axis=1)
+        wmap = build_wavelength_map(
+            l_total=l_total,
+            pixel_ids=pixel_ids,
+            toa_edges=toa_edges,
+            wavelength_edges=lam_edges,
+            toa_offset_ns=params.toa_offset_ns,
+        )
+        self._hist = QHistogrammer(
+            qmap=wmap, toa_edges=toa_edges, n_q=params.wavelength_bins
+        )
+        self._state = self._hist.init_state()
+        self._lam_var = Variable(lam_edges, ("wavelength",), "angstrom")
+        self._primary_stream = primary_stream
+        self._monitor_streams = monitor_streams or set()
+        self._publish = None
+
+    def _spectrum(self, values: np.ndarray, name: str, unit="counts"):
+        return DataArray(
+            Variable(values, ("wavelength",), unit),
+            coords={"wavelength": self._lam_var},
+            name=name,
+        )
+
+    def finalize(self) -> dict[str, DataArray]:
+        win, cum, mon_win, mon_cum = self._take_publish()
+        return {
+            "wavelength_current": self._spectrum(win, "wavelength_current"),
+            "wavelength_cumulative": self._spectrum(
+                cum, "wavelength_cumulative"
+            ),
+            "wavelength_normalized": self._spectrum(
+                cum / max(mon_cum, 1.0), "wavelength_normalized", unit=""
+            ),
+            "counts_current": DataArray(
+                Variable(np.asarray(win.sum()), (), "counts"),
+                name="counts_current",
+            ),
+        }

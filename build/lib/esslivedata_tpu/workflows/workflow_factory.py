@@ -1,0 +1,164 @@
+"""Workflow protocol + two-phase spec/factory registry.
+
+Parity with reference ``workflows/workflow_factory.py``: the ``Workflow``
+protocol (accumulate/finalize/clear, :21) and a registry with *two-phase*
+registration (:178-268) — specs register at import time (cheap, declarative;
+the dashboard needs them without heavy imports), factories attach later via
+the ``SpecHandle`` when an instrument's compute modules load
+(``Instrument.load_factories`` pattern, config/instrument.py:654).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections.abc import Iterator, Mapping
+from typing import Any, Callable, Protocol, runtime_checkable
+
+from pydantic import BaseModel
+
+from ..config.workflow_spec import WorkflowConfig, WorkflowId, WorkflowSpec
+from ..utils.labeled import DataArray
+
+__all__ = ["SpecHandle", "Workflow", "WorkflowFactory", "workflow_registry"]
+
+
+@runtime_checkable
+class Workflow(Protocol):
+    """A streaming reduction: repeatedly fed per-window data, periodically
+    finalized into named outputs."""
+
+    def accumulate(self, data: Mapping[str, Any]) -> None:
+        """Add one window of stream-name-keyed preprocessed data."""
+        ...
+
+    def finalize(self) -> dict[str, DataArray]:
+        """Compute and return output-name-keyed results; clears window
+        state but not cumulative state."""
+        ...
+
+    def clear(self) -> None:
+        """Reset all state (run transition)."""
+        ...
+
+
+class SupportsContext(Protocol):
+    def set_context(self, context: Mapping[str, Any]) -> None: ...
+
+
+WorkflowFactoryFn = Callable[..., Workflow]
+
+
+class SpecHandle:
+    """Returned by register_spec; the hook for attaching the heavy factory
+    later (two-phase registration, reference workflow_factory.py:80)."""
+
+    def __init__(self, registry: WorkflowFactory, workflow_id: WorkflowId) -> None:
+        self._registry = registry
+        self._id = workflow_id
+
+    @property
+    def workflow_id(self) -> WorkflowId:
+        return self._id
+
+    def attach_factory(self, factory: WorkflowFactoryFn) -> WorkflowFactoryFn:
+        """Attach the factory; usable as a decorator."""
+        self._registry._attach(self._id, factory)
+        return factory
+
+
+class WorkflowFactory(Mapping[WorkflowId, WorkflowSpec]):
+    """Registry of WorkflowSpecs + their factories.
+
+    A factory is ``fn(*, source_name, params) -> Workflow`` (params is the
+    validated pydantic model instance or None). Factories for specs with
+    context_keys may accept a ``context`` kwarg delivering initial context.
+    """
+
+    def __init__(self) -> None:
+        self._specs: dict[WorkflowId, WorkflowSpec] = {}
+        self._factories: dict[WorkflowId, WorkflowFactoryFn] = {}
+        self._lock = threading.RLock()
+
+    # -- Mapping interface -------------------------------------------------
+    def __getitem__(self, key: WorkflowId) -> WorkflowSpec:
+        return self._specs[key]
+
+    def __iter__(self) -> Iterator[WorkflowId]:
+        return iter(self._specs)
+
+    def __len__(self) -> int:
+        return len(self._specs)
+
+    # -- registration ------------------------------------------------------
+    def register_spec(self, spec: WorkflowSpec) -> SpecHandle:
+        with self._lock:
+            wid = spec.identifier
+            if wid in self._specs:
+                raise ValueError(f"Duplicate workflow spec {wid}")
+            self._specs[wid] = spec
+            return SpecHandle(self, wid)
+
+    def _attach(self, wid: WorkflowId, factory: WorkflowFactoryFn) -> None:
+        with self._lock:
+            if wid not in self._specs:
+                raise ValueError(f"No spec registered for {wid}")
+            if wid in self._factories:
+                raise ValueError(f"Factory already attached for {wid}")
+            self._factories[wid] = factory
+
+    def has_factory(self, wid: WorkflowId) -> bool:
+        return wid in self._factories
+
+    def specs_for_instrument(self, instrument: str) -> list[WorkflowSpec]:
+        return [s for s in self._specs.values() if s.instrument == instrument]
+
+    # -- creation ----------------------------------------------------------
+    def create(self, config: WorkflowConfig) -> Workflow:
+        """Validate a start command against the spec and build the workflow."""
+        wid = config.identifier
+        try:
+            spec = self._specs[wid]
+        except KeyError as err:
+            raise KeyError(f"Unknown workflow {wid}") from err
+        source = config.job_id.source_name
+        if spec.source_names and source not in spec.source_names:
+            raise ValueError(
+                f"Source {source!r} not valid for {wid}; expected one of "
+                f"{spec.source_names}"
+            )
+        for aux_key, aux_source in config.aux_source_names.items():
+            allowed = spec.aux_source_names.get(aux_key)
+            if allowed is None:
+                raise ValueError(f"Unknown aux source key {aux_key!r} for {wid}")
+            if allowed and aux_source not in allowed:
+                raise ValueError(
+                    f"Aux source {aux_source!r} invalid for {aux_key!r} of {wid}"
+                )
+        params: BaseModel | None = spec.validate_params(config.params)
+        try:
+            factory = self._factories[wid]
+        except KeyError as err:
+            raise KeyError(
+                f"Workflow {wid} has a spec but no attached factory — "
+                "did the instrument's factories module load?"
+            ) from err
+        # Factories may opt in to the resolved aux bindings by declaring an
+        # ``aux_source_names`` keyword (reference: workflow_factory.py
+        # introspects factory signatures, :387-401).
+        import inspect
+
+        kwargs: dict[str, Any] = {"source_name": source, "params": params}
+        sig = inspect.signature(factory)
+        if "aux_source_names" in sig.parameters:
+            kwargs["aux_source_names"] = dict(config.aux_source_names)
+        return factory(**kwargs)
+
+    def clear(self) -> None:
+        """Testing hook: drop all registrations."""
+        with self._lock:
+            self._specs.clear()
+            self._factories.clear()
+
+
+workflow_registry = WorkflowFactory()
+"""Process-wide default registry (reference: workflow_factory.py:157)."""

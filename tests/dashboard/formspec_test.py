@@ -1,0 +1,104 @@
+"""Server-side wizard field derivation (dashboard/formspec.py): the
+schema->input-kind logic that used to live in browser JS, now pytest-
+covered (reference counterpart: configuration_widget.py builds Panel
+widgets from the params model)."""
+
+from typing import Literal
+
+import pytest
+from pydantic import BaseModel, Field
+
+from esslivedata_tpu.dashboard.formspec import schema_to_formspec
+
+
+class Nested(BaseModel):
+    low: float = 0.0
+    high: float = 1.0
+
+
+class Params(BaseModel):
+    count: int = 7
+    rate: float = 1.5
+    label: str = "abc"
+    enabled: bool = True
+    # Instance default (not default_factory): pydantic serializes it
+    # into the schema, so the wizard can seed the JSON input.
+    window: Nested = Nested()
+    mode: Literal["linear", "log"] = "log"
+    note: str | None = None
+    maybe_num: float | None = 2.5
+
+
+def _by_name(fields):
+    return {f["name"]: f for f in fields}
+
+
+class TestSchemaToFormspec:
+    def test_none_schema(self):
+        assert schema_to_formspec(None) is None
+        assert schema_to_formspec({}) is None
+
+    def test_kinds_and_defaults(self):
+        fields = _by_name(schema_to_formspec(Params.model_json_schema()))
+        assert fields["count"]["kind"] == "integer"
+        assert fields["count"]["default_text"] == "7"
+        assert fields["rate"]["kind"] == "number"
+        assert fields["rate"]["default_text"] == "1.5"
+        assert fields["label"]["kind"] == "text"
+        assert fields["label"]["default_text"] == "abc"
+        assert fields["enabled"]["kind"] == "boolean"
+        assert fields["enabled"]["default_text"] == "true"
+
+    def test_nested_model_is_json_kind_with_json_default(self):
+        fields = _by_name(schema_to_formspec(Params.model_json_schema()))
+        assert fields["window"]["kind"] == "json"
+        import json
+
+        assert json.loads(fields["window"]["default_text"]) == {
+            "low": 0.0,
+            "high": 1.0,
+        }
+
+    def test_literal_becomes_enum_select(self):
+        fields = _by_name(schema_to_formspec(Params.model_json_schema()))
+        assert fields["mode"]["enum"] == ["linear", "log"]
+        assert fields["mode"]["kind"] == "text"
+        assert fields["mode"]["default_text"] == "log"
+
+    def test_optional_unwraps_to_inner_kind(self):
+        fields = _by_name(schema_to_formspec(Params.model_json_schema()))
+        assert fields["note"]["kind"] == "text"
+        assert fields["note"]["default_text"] is None  # None default -> empty
+        assert fields["maybe_num"]["kind"] == "number"
+        assert fields["maybe_num"]["default_text"] == "2.5"
+
+    def test_descriptions_carried(self):
+        class P(BaseModel):
+            x: int = Field(0, description="pixels along x")
+
+        fields = _by_name(schema_to_formspec(P.model_json_schema()))
+        assert fields["x"]["description"] == "pixels along x"
+
+    def test_every_registered_workflow_model_derives(self):
+        """The real instrument registry: every params model must produce
+        a formspec without error and with only known kinds."""
+        from esslivedata_tpu.config.instrument import instrument_registry
+        from esslivedata_tpu.workflows.workflow_factory import (
+            workflow_registry,
+        )
+
+        kinds = {"boolean", "integer", "number", "text", "json"}
+        checked = 0
+        for name in ("dummy", "loki", "bifrost"):
+            instrument_registry[name].load_factories()
+            for spec in workflow_registry.specs_for_instrument(name):
+                if spec.params_model is None:
+                    continue
+                fields = schema_to_formspec(
+                    spec.params_model.model_json_schema()
+                )
+                assert fields is not None
+                for f in fields:
+                    assert f["kind"] in kinds, (name, spec.name, f)
+                checked += 1
+        assert checked > 0

@@ -1,0 +1,185 @@
+"""Front-end asset contracts, runnable WITHOUT a JS engine.
+
+The SPA's behavior tests live in the CI-only browser suite
+(browser_ui_test.py); these tests pin what can break silently from the
+Python side after the JS moved out of web.py into static files:
+
+- the app serves the assets and the shell references them;
+- the extracted JS carries no Python-format residue (``{{``);
+- delimiters stay balanced outside strings/comments (a merge artifact
+  or truncated write fails loudly here instead of as a blank page);
+- every ``/api/...``/``/plot/``/``/data/`` path mentioned in JS matches
+  a route actually registered in make_app (endpoint drift);
+- every ``AppLogic.*`` call in app.js exists in applogic.js.
+"""
+
+import re
+from pathlib import Path
+
+import pytest
+
+tornado = pytest.importorskip("tornado")
+from tornado.testing import AsyncHTTPTestCase
+
+from esslivedata_tpu.dashboard.dashboard_services import DashboardServices
+from esslivedata_tpu.dashboard.fake_backend import InProcessBackendTransport
+
+STATIC = (
+    Path(__file__).resolve().parents[2]
+    / "src/esslivedata_tpu/dashboard/static"
+)
+
+
+def _strip_strings_and_comments(js: str) -> str:
+    """Remove string/template literals, regex literals stay (rare), and
+    comments, so delimiter balance can be checked structurally."""
+    out = []
+    i, n = 0, len(js)
+    while i < n:
+        c = js[i]
+        if c in "'\"`":
+            q = c
+            i += 1
+            while i < n:
+                if js[i] == "\\":
+                    i += 2
+                    continue
+                if js[i] == q:
+                    i += 1
+                    break
+                i += 1
+            out.append('""')
+            continue
+        if c == "/" and i + 1 < n and js[i + 1] == "/":
+            while i < n and js[i] != "\n":
+                i += 1
+            continue
+        if c == "/" and i + 1 < n and js[i + 1] == "*":
+            i += 2
+            while i + 1 < n and not (js[i] == "*" and js[i + 1] == "/"):
+                i += 1
+            i += 2
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+class TestStaticAssetFiles:
+    @pytest.mark.parametrize("name", ["app.js", "applogic.js"])
+    def test_no_python_format_residue(self, name):
+        js = (STATIC / name).read_text()
+        assert "{{" not in js, "unescaped .format residue in extracted JS"
+
+    @pytest.mark.parametrize("name", ["app.js", "applogic.js"])
+    def test_delimiters_balanced(self, name):
+        js = _strip_strings_and_comments((STATIC / name).read_text())
+        for open_c, close_c in ("{}", "()", "[]"):
+            depth = 0
+            for ch in js:
+                if ch == open_c:
+                    depth += 1
+                elif ch == close_c:
+                    depth -= 1
+                assert depth >= 0, f"unbalanced {open_c}{close_c} in {name}"
+            assert depth == 0, f"unbalanced {open_c}{close_c} in {name}"
+
+    def test_applogic_has_no_dom_or_network_access(self):
+        js = (STATIC / "applogic.js").read_text()
+        for forbidden in ("document.", "window.", "fetch(", "localStorage"):
+            assert forbidden not in js, (
+                f"applogic.js must stay pure (found {forbidden!r})"
+            )
+
+    def test_app_js_applogic_references_exist(self):
+        app = (STATIC / "app.js").read_text()
+        logic = (STATIC / "applogic.js").read_text()
+        used = set(re.findall(r"AppLogic\.(\w+)", app))
+        assert used, "app.js should use the pure-logic module"
+        defined = set(re.findall(r"^\s{2}(\w+)\s*[:(]", logic, re.M))
+        missing = used - defined
+        assert not missing, f"AppLogic members missing: {missing}"
+
+    def test_js_endpoints_match_registered_routes(self):
+        from esslivedata_tpu.dashboard.web import make_app
+
+        transport = InProcessBackendTransport("dummy", events_per_pulse=1)
+        services = DashboardServices(transport=transport)
+        app = make_app(services, "dummy")
+        patterns = [
+            rule.matcher.regex
+            for rule in app.default_router.rules[0].target.rules
+        ]
+        js = (STATIC / "app.js").read_text()
+        # String literals that look like app endpoints. Concatenated
+        # dynamic tails ('/api/grid/' + id) are checked as prefixes.
+        hits = {
+            h.split("?")[0]
+            for h in re.findall(r"'(/(?:api|plot|data)/[^']*)'", js)
+            + re.findall(r'"(/(?:api|plot|data)/[^"]*)"', js)
+        }
+        assert hits, "expected endpoint references in app.js"
+
+        def matches(path: str) -> bool:
+            # Dynamic tails are concatenated in JS ('/api/grid/' + id):
+            # probe with representative suffixes for each route family.
+            probe_tails = (
+                "", "x", "x/cell", "x/cell/0", "x/cell/0/config",
+                "stop", "x.png", "x.meta", "x.json", "x.npz",
+            )
+            for p in patterns:
+                for tail in probe_tails:
+                    if p.match(path + tail):
+                        return True
+            return False
+
+        unmatched = [h for h in hits if not matches(h)]
+        assert not unmatched, f"JS references unregistered endpoints: {unmatched}"
+
+
+class StaticServingTest(AsyncHTTPTestCase):
+    def get_app(self):
+        from esslivedata_tpu.dashboard.web import make_app
+
+        self.transport = InProcessBackendTransport("dummy", events_per_pulse=1)
+        self.services = DashboardServices(transport=self.transport)
+        return make_app(self.services, "dummy")
+
+    def test_assets_served_and_referenced(self):
+        page = self.fetch("/")
+        assert page.code == 200
+        body = page.body.decode()
+        for name in ("applogic.js", "app.js"):
+            assert f"/static/{name}" in body
+            r = self.fetch(f"/static/{name}")
+            assert r.code == 200
+            assert len(r.body) > 100
+            assert "javascript" in r.headers.get("Content-Type", "")
+
+    def test_no_inline_script_left_in_shell(self):
+        body = self.fetch("/").body.decode()
+        # The shell may keep tiny glue only; the SPA body must be external.
+        inline = re.findall(r"<script>(.*?)</script>", body, re.S)
+        for block in inline:
+            assert len(block.strip()) == 0, "inline JS crept back into web.py"
+
+    def test_state_payload_carries_form_fields(self):
+        import json as j
+
+        r = self.fetch("/api/state")
+        assert r.code == 200
+        state = j.loads(r.body)
+        wfs = state["workflows"]
+        assert wfs, "dummy instrument should expose workflows"
+        with_model = [w for w in wfs if w["params_schema"]]
+        assert with_model, "expected at least one workflow with params"
+        for w in with_model:
+            assert isinstance(w["form_fields"], list) and w["form_fields"]
+            for f in w["form_fields"]:
+                assert set(f) == {
+                    "name",
+                    "kind",
+                    "default_text",
+                    "description",
+                    "enum",
+                }
