@@ -112,6 +112,12 @@ class ServiceStatus(BaseModel):
     #: workflow_status_widget surfaces per-source staleness): stream
     #: name -> (lag seconds, level).
     stream_lags: dict[str, tuple[float, str]] = Field(default_factory=dict)
+    #: Transport-source health: 'ok' | 'stale' | 'stopped' ('stopped' =
+    #: the consume thread's circuit breaker opened — reference
+    #: system_status_widget surfaces consumer health per service).
+    source_health: str = "ok"
+    #: Source counters (queued/dropped batches, consumed messages).
+    source_metrics: dict[str, int] = Field(default_factory=dict)
 
 
 class JobResult:

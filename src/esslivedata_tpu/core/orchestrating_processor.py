@@ -44,6 +44,23 @@ HEARTBEAT_INTERVAL_S = 2.0
 METRICS_INTERVAL_S = 30.0
 
 
+def _transport_of(source, max_depth: int = 8):
+    """Innermost transport exposing health+metrics, or None.
+
+    The processor's source is a decorator chain (AdaptingMessageSource
+    holds ``_source``; the synthesizers hold ``_wrapped``); the
+    circuit-breaker state lives on the raw transport at the bottom
+    (kafka/source.py BackgroundMessageSource.health)."""
+    s = source
+    for _ in range(max_depth):
+        if s is None:
+            return None
+        if hasattr(s, "health") and hasattr(s, "metrics"):
+            return s
+        s = getattr(s, "_source", None) or getattr(s, "_wrapped", None)
+    return None
+
+
 class MessagePreprocessor:
     """Routes batch messages into per-stream accumulators."""
 
@@ -340,6 +357,19 @@ class OrchestratingProcessor:
                 lag.stream_name: (round(lag.lag_s, 3), lag.level)
                 for lag in report.lags
             },
+            # Duck-typed: Kafka-backed transports expose circuit-breaker
+            # health + counters; in-memory fakes simply don't. The
+            # transport sits under decorator layers (AdaptingMessageSource,
+            # synthesizers), so walk the chain to the innermost source.
+            source_health=(
+                h.value
+                if (t := _transport_of(self._source)) is not None
+                and hasattr(h := t.health, "value")
+                else "ok"
+            ),
+            source_metrics=dict(
+                t.metrics if t is not None else {}
+            ),
         )
 
     def _publish_status(self, state: str = "running") -> None:

@@ -9,9 +9,42 @@ function el(tag, cls, text) {
   if (text !== undefined) n.textContent = text;
   return n;
 }
+function toast(msg, level) {
+  const d = el('div', 'toast ' + (level || 'info'), msg);
+  document.getElementById('toasts').appendChild(d);
+  setTimeout(() => d.remove(), 6000);
+}
+// Destructive-action gate (reference confirmation_modal.py): a promise-
+// based modal so call sites read `if (!await confirmDialog(...)) return`.
+function confirmDialog(message, detail) {
+  return new Promise((resolve) => {
+    const old = document.getElementById('confirm-modal');
+    if (old) {
+      // Displacing an unanswered dialog answers it with Cancel: the
+      // earlier caller's await must settle, never leak.
+      if (old._resolve) old._resolve(false);
+      old.remove();
+    }
+    const box = el('div', 'card'); box.id = 'confirm-modal';
+    box._resolve = resolve;
+    box.style.cssText =
+      'position:fixed;top:120px;left:50%;transform:translateX(-50%);' +
+      'z-index:20;min-width:300px;box-shadow:0 4px 24px rgba(0,0,0,.35)';
+    box.appendChild(el('h3', '', message));
+    if (detail) box.appendChild(el('div', '', detail));
+    const yes = el('button', '', 'Confirm');
+    const no = el('button', '', 'Cancel');
+    const done = (v) => { box.remove(); resolve(v); };
+    yes.onclick = () => done(true);
+    no.onclick = () => done(false);
+    box.appendChild(yes); box.appendChild(no);
+    document.body.appendChild(box);
+    no.focus();
+  });
+}
 function setTab(t) {
   tab = t; gen = -1; gridGens = {};
-  for (const name of ['grids', 'flat', 'jobsview', 'corr', 'log']) {
+  for (const name of ['grids', 'flat', 'jobsview', 'system', 'corr', 'log']) {
     document.getElementById(name).style.display = t === name ? '' : 'none';
     document.getElementById('tab-' + name).className = t === name ? 'on' : '';
   }
@@ -199,7 +232,8 @@ async function refreshGrids() {
       del.title = 'Delete this grid';
       del.onclick = async () => {
         const doc = gridById[gid] || g;
-        if (!confirm('Delete grid "' + (doc.title || gid) + '"?')) return;
+        if (!await confirmDialog(
+          'Delete grid?', doc.title || gid)) return;
         await fetch(gurl(gid), {method: 'DELETE'});
         if (activeGrid === gid) activeGrid = 'all';
         gridGens = {}; refreshGrids();
@@ -630,9 +664,113 @@ async function attachRoiOverlay(wrap, img) {
 // heartbeat telemetry (reference workflow_status_widget, redesigned as
 // an expandable table over /api/state).
 let jobsOpen = {};  // job_number -> expanded?
+// Bulk-selection state lives OUTSIDE renderJobsView: the view rebuilds
+// on every data change (per-batch counters tick each poll on a live
+// system), and a rebuild must not wipe an operator's in-progress
+// selection.
+const jobsSelected = new Set();
 function jobAction(action, j) {
   return fetch('/api/job/' + action, {method: 'POST', body: JSON.stringify(
     {source_name: j.source_name, job_number: j.job_number})});
+}
+// stop/remove discard accumulated state: gate behind the confirm modal.
+async function jobActionConfirmed(action, j) {
+  if (action === 'stop' || action === 'remove') {
+    const ok = await confirmDialog(
+      action + ' job?',
+      j.source_name + ' · ' + j.workflow_id + ' · ' +
+      j.job_number.slice(0, 8));
+    if (!ok) return false;
+  }
+  await jobAction(action, j);
+  return true;
+}
+async function jobBulk(action, jobs) {
+  if (!jobs.length) return;
+  if (action === 'stop' || action === 'remove') {
+    const ok = await confirmDialog(
+      action + ' ' + jobs.length + ' job(s)?',
+      jobs.map(j => j.source_name).join(', '));
+    if (!ok) return;
+  }
+  const r = await fetch('/api/job/bulk', {method: 'POST',
+    body: JSON.stringify({action, jobs: jobs.map(j => (
+      {source_name: j.source_name, job_number: j.job_number}))})});
+  let body = {};
+  try { body = await r.json(); } catch (e) { /* non-JSON error page */ }
+  if (!r.ok) {
+    toast('bulk ' + action + ' failed: ' +
+      (body.error || r.status), 'error');
+    return;
+  }
+  for (const res of (body.results || [])) {
+    if (!res.ok) toast('bulk ' + action + ' failed for ' +
+      String(res.job_number).slice(0, 8) + ': ' + res.error, 'error');
+  }
+  refresh();
+}
+// -- System tab: whole-system health (reference system_status_widget) --
+function renderSystemView(s) {
+  const root = document.getElementById('system');
+  const fp = JSON.stringify([s.services, s.jobs.length, s.keys.length]);
+  if (root.dataset.fp === fp) return;
+  root.dataset.fp = fp;
+  root.innerHTML = '';
+  const card = el('div', 'card');
+  card.appendChild(el('h3', '', 'Services'));
+  if (!s.services.length) {
+    card.appendChild(el('small', '',
+      'No service heartbeats received yet.'));
+  }
+  const t = document.createElement('table'); t.className = 'devices';
+  const head = document.createElement('tr');
+  for (const h of ['service', 'state', 'uptime', 'last batch',
+                   'consumer', 'queue', 'dropped', 'lag']) {
+    head.appendChild(el('td', '', h)).style.fontWeight = 'bold';
+  }
+  t.appendChild(head);
+  for (const sv of s.services) {
+    const r = document.createElement('tr');
+    r.appendChild(el('td', '', sv.service_id));
+    const st = el('td');
+    st.appendChild(el('span',
+      sv.stale || sv.state === 'error' ? 'state-error' :
+        (sv.state === 'running' ? 'state-active' : 'state-warning'),
+      sv.state + (sv.stale ? ' (stale)' : '')));
+    r.appendChild(st);
+    r.appendChild(el('td', '', Math.round(sv.uptime_s) + 's'));
+    r.appendChild(el('td', '', sv.last_batch_message_count + ' msgs'));
+    // Transport-source health: 'stopped' = the consume thread's
+    // circuit breaker opened.
+    const src = el('td');
+    const health = sv.source_health || 'ok';
+    src.appendChild(el('span',
+      health === 'ok' ? 'state-active' :
+        (health === 'stopped' ? 'state-error' : 'state-warning'),
+      health === 'stopped' ? 'breaker open' : health));
+    r.appendChild(src);
+    const m = sv.source_metrics || {};
+    r.appendChild(el('td', '', String(m.queued_batches ?? '—')));
+    r.appendChild(el('td',
+      (m.dropped_batches || 0) > 0 ? 'state-warning' : '',
+      String(m.dropped_batches ?? '—')));
+    const lag = el('td');
+    lag.appendChild(el('span',
+      sv.lag_level === 'ok' ? '' :
+        (sv.lag_level === 'error' ? 'state-error' : 'state-warning'),
+      sv.lag_level === 'ok' ? 'ok'
+        : sv.lag_level + ' ' + Number(sv.worst_lag_s).toFixed(1) + 's'));
+    r.appendChild(lag);
+    t.appendChild(r);
+  }
+  card.appendChild(t);
+  const totals = el('div');
+  totals.style.marginTop = '8px';
+  totals.appendChild(el('small', '',
+    s.jobs.length + ' job(s) · ' + s.keys.length +
+    ' published output(s) · generation ' + s.generation));
+  card.appendChild(totals);
+  root.appendChild(card);
 }
 async function renderLogView() {
   // Persistent notification history (reference notification_log_widget):
@@ -689,10 +827,51 @@ function renderJobsView(s) {
   }
   const svcById = {};
   for (const sv of s.services) svcById[sv.service_id] = sv;
+  // Bulk-action bar (reference workflow_status_widget grouping + bulk
+  // stop): row checkboxes feed the persistent jobsSelected set; the
+  // buttons confirm once for the whole batch and hit /api/job/bulk.
+  const live = new Set(s.jobs.map(j => j.job_number));
+  for (const n of [...jobsSelected]) {
+    if (!live.has(n)) jobsSelected.delete(n);  // prune finished jobs
+  }
+  const byNumber = {};
+  const bulkBar = el('div', 'roi-bar');
+  const bulkLabel = el('small', '', 'select jobs for bulk actions');
+  const syncBulk = () => {
+    bulkLabel.textContent = jobsSelected.size
+      ? jobsSelected.size + ' selected' : 'select jobs for bulk actions';
+  };
+  bulkBar.appendChild(bulkLabel);
+  for (const a of ['stop', 'reset', 'remove']) {
+    const b = el('button', '', a + ' selected');
+    b.onclick = () => jobBulk(a, [...jobsSelected].map(n => byNumber[n]));
+    bulkBar.appendChild(b);
+  }
+  const selAll = el('button', '', 'all');
+  selAll.onclick = () => {
+    const boxes = table.querySelectorAll('input[type=checkbox]');
+    const allOn = jobsSelected.size === s.jobs.length;
+    boxes.forEach(cb => { cb.checked = !allOn; cb.onchange(); });
+  };
+  bulkBar.appendChild(selAll);
+  card.appendChild(bulkBar);
+  syncBulk();
   const table = document.createElement('table');
   table.className = 'devices';
   for (const j of s.jobs) {
+    byNumber[j.job_number] = j;
     const row = document.createElement('tr');
+    const selTd = el('td');
+    const cb = document.createElement('input');
+    cb.type = 'checkbox';
+    cb.checked = jobsSelected.has(j.job_number);
+    cb.onchange = () => {
+      if (cb.checked) jobsSelected.add(j.job_number);
+      else jobsSelected.delete(j.job_number);
+      syncBulk();
+    };
+    selTd.appendChild(cb);
+    row.appendChild(selTd);
     const stBtn = el('td');
     stBtn.appendChild(el('span', 'state-' + j.state, j.state));
     if (j.adopted) {
@@ -714,7 +893,9 @@ function renderJobsView(s) {
     act.appendChild(detail);
     for (const a of ['stop', 'reset', 'remove']) {
       const b = el('button', '', a);
-      b.onclick = async () => { await jobAction(a, j); refresh(); };
+      b.onclick = async () => {
+        if (await jobActionConfirmed(a, j)) refresh();
+      };
       act.appendChild(b);
     }
     const rs = el('button', '', 'restart…');
@@ -730,7 +911,7 @@ function renderJobsView(s) {
     table.appendChild(row);
     if (jobsOpen[j.job_number]) {
       const dr = document.createElement('tr');
-      const td = el('td'); td.colSpan = 5;
+      const td = el('td'); td.colSpan = 6;
       const box = el('div', 'card');
       if (j.message) {
         box.appendChild(el('div', 'state-' + j.state, j.message));
@@ -936,7 +1117,9 @@ async function refresh() {
     d.appendChild(document.createTextNode(' ' + j.source_name + ' '));
     d.appendChild(el('small', '', j.workflow_id));
     const stop = document.createElement('button'); stop.textContent = 'stop';
-    stop.onclick = () => jobAction('stop', j);
+    stop.onclick = async () => {
+      if (await jobActionConfirmed('stop', j)) refresh();
+    };
     d.appendChild(stop); jobs.appendChild(d);
   }
   const svcs = document.getElementById('svcs'); svcs.innerHTML = '';
@@ -963,6 +1146,7 @@ async function refresh() {
   await pollSession();
   if (tab === 'corr') refreshCorrChoices(s);
   if (tab === 'jobsview') renderJobsView(s);
+  if (tab === 'system') renderSystemView(s);
   if (tab === 'log') renderLogView();
   if (tab === 'grids') {
     await refreshGrids();

@@ -274,6 +274,9 @@ class StateHandler(_Base):
                         "lag_level": s.status.lag_level,
                         "worst_lag_s": s.status.worst_lag_s,
                         "stream_lags": s.status.stream_lags,
+                        "source_health": s.status.source_health,
+                        "source_metrics": s.status.source_metrics,
+                        "instrument": s.status.instrument,
                     }
                     for s in js.services()
                 ],
@@ -543,6 +546,59 @@ class JobActionHandler(_Base):
             return
         method(job_id)
         self.write_json({"ok": True})
+
+
+class JobBulkActionHandler(_Base):
+    """One POST for a multi-job stop/reset/remove (reference
+    workflow_status_widget.py offers grouped bulk actions). Per-job
+    outcomes report individually: one bad job id must not abort the
+    rest of an operator's bulk stop."""
+
+    def post(self) -> None:
+        import uuid as _uuid
+
+        from ..config.workflow_spec import JobId
+
+        body = json.loads(self.request.body or b"{}")
+        action = body.get("action")
+        jobs = body.get("jobs")
+        methods = {
+            "stop": self.services.orchestrator.stop,
+            "reset": self.services.orchestrator.reset,
+            "remove": self.services.orchestrator.remove,
+        }
+        if action not in methods or not isinstance(jobs, list) or not jobs:
+            self.set_status(400)
+            self.write_json(
+                {"error": "need action in stop|reset|remove and jobs[]"}
+            )
+            return
+        results = []
+        for j in jobs:
+            entry = j if isinstance(j, dict) else {}
+            try:
+                job_id = JobId(
+                    source_name=entry["source_name"],
+                    job_number=_uuid.UUID(entry["job_number"]),
+                )
+                methods[action](job_id)
+                results.append(
+                    {"job_number": entry["job_number"], "ok": True}
+                )
+            except Exception as err:
+                results.append(
+                    {
+                        "job_number": str(entry.get("job_number")),
+                        "ok": False,
+                        "error": str(err) or repr(err),
+                    }
+                )
+        self.write_json(
+            {
+                "ok": all(r["ok"] for r in results),
+                "results": results,
+            }
+        )
 
 
 class RoiHandler(_Base):
@@ -870,12 +926,14 @@ _PAGE = """<!DOCTYPE html>
    <button id="tab-grids" class="on" onclick="setTab('grids')">Grids</button>
    <button id="tab-flat" onclick="setTab('flat')">All plots</button>
    <button id="tab-jobsview" onclick="setTab('jobsview')">Jobs</button>
+   <button id="tab-system" onclick="setTab('system')">System</button>
    <button id="tab-corr" onclick="setTab('corr')">Correlation</button>
    <button id="tab-log" onclick="setTab('log')">Log</button>
   </div>
   <div id="grids"></div>
   <div id="flat" style="display:none"></div>
   <div id="jobsview" style="display:none"></div>
+  <div id="system" style="display:none"></div>
   <div id="corr" style="display:none">
    <div class="card">
     <label>x: <select id="corr-x"></select></label>
@@ -973,6 +1031,7 @@ def make_app(
             (r"/api/workflow/stage", StageWorkflowHandler),
             (r"/api/workflow/commit", CommitWorkflowHandler),
             (r"/api/job/(stop|reset|remove)", JobActionHandler),
+            (r"/api/job/bulk", JobBulkActionHandler),
             (r"/api/roi", RoiHandler),
             (r"/api/grids", GridsHandler),
             (r"/api/grid", GridManageHandler),
