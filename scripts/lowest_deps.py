@@ -1,0 +1,35 @@
+"""Emit a requirements list pinning every declared dependency floor.
+
+The nightly `lower-bound` CI job installs exactly the minimum versions
+pyproject.toml claims to support and runs the full suite against them —
+the reference's lower-bound dependency matrix (SURVEY §4) as one job.
+Floors without a `>=` (none today) are skipped: nothing to pin.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+import tomllib
+from pathlib import Path
+
+
+def main() -> int:
+    data = tomllib.loads(
+        (Path(__file__).resolve().parent.parent / "pyproject.toml").read_text()
+    )
+    deps = list(data["project"]["dependencies"])
+    for extra in ("test", "dashboard", "geometry"):
+        deps += data["project"]["optional-dependencies"].get(extra, [])
+    pins = {}
+    for dep in deps:
+        m = re.match(r"^([A-Za-z0-9_.\-]+)\s*>=\s*([0-9][0-9a-zA-Z.\-]*)", dep)
+        if m:
+            pins[m.group(1)] = m.group(2)
+    for name, floor in sorted(pins.items()):
+        print(f"{name}=={floor}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
