@@ -1,9 +1,10 @@
 """Emit a requirements list pinning every declared dependency floor.
 
 The nightly `lower-bound` CI job installs exactly the minimum versions
-pyproject.toml claims to support and runs the full suite against them —
-the reference's lower-bound dependency matrix (SURVEY §4) as one job.
-Floors without a `>=` (none today) are skipped: nothing to pin.
+pyproject.toml claims to support (core dependencies plus every extra)
+and runs the full suite against them — the reference's lower-bound
+dependency matrix (SURVEY §4) as one job. Requirements without a `>=`
+floor (none today) are skipped: nothing to pin.
 """
 
 from __future__ import annotations
@@ -19,8 +20,10 @@ def main() -> int:
         (Path(__file__).resolve().parent.parent / "pyproject.toml").read_text()
     )
     deps = list(data["project"]["dependencies"])
-    for extra in ("test", "dashboard", "geometry"):
-        deps += data["project"]["optional-dependencies"].get(extra, [])
+    # EVERY extra, not a hardcoded subset: a floor that never installs
+    # is a floor that never gets validated.
+    for extra_deps in data["project"]["optional-dependencies"].values():
+        deps += extra_deps
     pins = {}
     for dep in deps:
         m = re.match(r"^([A-Za-z0-9_.\-]+)\s*>=\s*([0-9][0-9a-zA-Z.\-]*)", dep)

@@ -77,6 +77,11 @@ def detector_view_outputs() -> dict[str, OutputSpec]:
         "counts_in_range_cumulative": OutputSpec(
             title="Counts in range (since start)", view="since_start"
         ),
+        # The detector-view workflow always publishes the ROI readbacks
+        # (empty until ROIs are installed) — the declaration must match
+        # what finalize() emits (pinned by workflow_matrix_test).
+        "roi_rectangle": OutputSpec(title="ROI rectangles (readback)"),
+        "roi_polygon": OutputSpec(title="ROI polygons (readback)"),
     }
 
 

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 from ....workflows.elastic_qmap import ElasticQMapWorkflow
+from ....workflows.monitor_workflow import MonitorWorkflow
 from ....workflows.multibank import MultiBankViewWorkflow
 from ....workflows.qe_spectroscopy import QESpectroscopyWorkflow
 from ....workflows.ratemeter import RatemeterWorkflow
@@ -10,11 +11,17 @@ from .._common import monitor_streams_from_aux
 from .specs import (
     BANK_DETECTOR_NUMBERS,
     ELASTIC_QMAP_HANDLE,
+    MONITOR_HANDLE,
     MULTIBANK_HANDLE,
     QE_HANDLE,
     RATEMETER_HANDLE,
     analyzer_geometry,
 )
+
+
+@MONITOR_HANDLE.attach_factory
+def make_monitor(*, source_name: str, params) -> MonitorWorkflow:  # noqa: ARG001
+    return MonitorWorkflow(params=params)
 
 
 @MULTIBANK_HANDLE.attach_factory

@@ -79,6 +79,10 @@ MULTIBANK_HANDLE = workflow_registry.register_spec(
                 title="Per-bank TOA spectra (since start)", view="since_start"
             ),
             "bank_counts_current": OutputSpec(title="Per-bank counts"),
+            "bank_counts_cumulative": OutputSpec(
+                title="Per-bank counts (since start)", view="since_start"
+            ),
+            "counts_current": OutputSpec(title="Total counts (window)"),
             "counts_cumulative": OutputSpec(
                 title="Total counts (since start)", view="since_start"
             ),

@@ -38,13 +38,11 @@ register_parsed_catalog(INSTRUMENT, PARSED_STREAMS)
 instrument_registry.register(INSTRUMENT)
 
 _image_outputs = {
-    **detector_view_outputs(),
+    **detector_view_outputs(),  # incl. the ROI readbacks
     "roi_spectra": OutputSpec(title="ROI spectra (window)"),
     "roi_spectra_cumulative": OutputSpec(
         title="ROI spectra (since start)", view="since_start"
     ),
-    "roi_rectangle": OutputSpec(title="ROI rectangles (readback)"),
-    "roi_polygon": OutputSpec(title="ROI polygons (readback)"),
 }
 
 DETECTOR_VIEW_HANDLE = workflow_registry.register_spec(

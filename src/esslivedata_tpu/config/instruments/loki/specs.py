@@ -65,13 +65,11 @@ DETECTOR_VIEW_HANDLE = workflow_registry.register_spec(
         source_names=INSTRUMENT.detector_names,
         params_model=DetectorViewParams,
         outputs={
-            **detector_view_outputs(),
+            **detector_view_outputs(),  # incl. the ROI readbacks
             "roi_spectra": OutputSpec(title="ROI spectra (window)"),
             "roi_spectra_cumulative": OutputSpec(
                 title="ROI spectra (since start)", view="since_start"
             ),
-            "roi_rectangle": OutputSpec(title="ROI rectangles (readback)"),
-            "roi_polygon": OutputSpec(title="ROI polygons (readback)"),
         },
     )
 )

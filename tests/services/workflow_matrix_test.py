@@ -1,0 +1,173 @@
+"""Instrument-zoo validation matrix: every registered spec of every
+instrument must go from `create` to decodable, PLOTTABLE output.
+
+The assembly matrix (assembly_matrix_test.py) proves each service
+builds; this matrix proves each WORKFLOW runs: built with default
+params, fed one window of synthetic input on every declared source
+(staged events for event workflows, a 2-D frame for camera views — a
+workflow ignores payload types it does not handle), given scalar
+context for every declared context key, finalized, and every produced
+output rendered through the dashboard's auto-selected plotter. This is
+the breadth the reference keeps in per-instrument validation
+(reference config/instrument.py:759-857).
+"""
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.config.instrument import instrument_registry
+from esslivedata_tpu.config.workflow_spec import JobId, WorkflowConfig
+from esslivedata_tpu.ops import EventBatch
+from esslivedata_tpu.preprocessors.event_data import StagedEvents
+from esslivedata_tpu.utils.labeled import DataArray, Variable, linspace
+from esslivedata_tpu.workflows.workflow_factory import workflow_registry
+
+
+def _all_specs():
+    pairs = []
+    for name in sorted(instrument_registry.names()):
+        instrument_registry[name].load_factories()
+        for spec in workflow_registry.specs_for_instrument(name):
+            pairs.append(
+                pytest.param(
+                    name,
+                    str(spec.identifier),
+                    id=f"{name}-{spec.namespace}/{spec.name}",
+                )
+            )
+    return pairs
+
+
+def _staged_events(rng, n=4000, n_pixel=200_000):
+    return StagedEvents(
+        batch=EventBatch.from_arrays(
+            rng.integers(0, n_pixel, n).astype(np.int32),
+            rng.uniform(0.0, 70e6, n).astype(np.float32),
+        ),
+        first_timestamp=None,
+        last_timestamp=None,
+        n_chunks=1,
+    )
+
+
+def _frame(rng):
+    img = rng.random((32, 48))
+    return DataArray(
+        Variable(img, ("y", "x"), "counts"),
+        coords={
+            "x": linspace("x", 0.0, 1.0, 49, "m"),
+            "y": linspace("y", 0.0, 1.0, 33, "m"),
+        },
+    )
+
+
+@pytest.mark.parametrize(("instrument", "workflow_id"), _all_specs())
+def test_context_keys_resolve_to_real_streams(instrument, workflow_id):
+    """ADR 0003's safety net: a context key a spec gates on (or reads
+    optionally) must name a stream the instrument actually produces —
+    otherwise the gate strands jobs (required keys never arrive) or a
+    live calibration silently never updates (optional keys)."""
+    from esslivedata_tpu.config.workflow_spec import WorkflowId
+
+    inst = instrument_registry[instrument]
+    spec = next(
+        s
+        for s in workflow_registry.specs_for_instrument(instrument)
+        if s.identifier == WorkflowId.parse(workflow_id)
+    )
+    # Producible context: the stream catalog (incl. synthesized Device
+    # and chopper setpoint streams, ADR 0001), declared f144 logs, and
+    # anything explicitly bound. Optional keys are held to the same bar
+    # for in-repo instruments: a calibration stream nothing can produce
+    # is a dead declaration, even if jobs would not strand on it.
+    known = set(inst.streams) | set(inst.log_sources) | set(inst.devices)
+    bound = {b.stream_name for b in inst.context_bindings}
+    unresolved = (
+        set(spec.context_keys) | set(spec.optional_context_keys)
+    ) - known - bound
+    assert not unresolved, (
+        f"{workflow_id} reads context streams {sorted(unresolved)} that "
+        f"{instrument} neither catalogs nor binds"
+    )
+
+
+@pytest.mark.parametrize(("instrument", "workflow_id"), _all_specs())
+def test_spec_runs_end_to_end(instrument, workflow_id):
+    from esslivedata_tpu.config.workflow_spec import WorkflowId
+    from esslivedata_tpu.dashboard.plots import render_png
+
+    instrument_registry[instrument].load_factories()
+    wid = WorkflowId.parse(workflow_id)
+    spec = next(
+        s
+        for s in workflow_registry.specs_for_instrument(instrument)
+        if s.identifier == wid
+    )
+    assert spec.source_names, f"{workflow_id}: spec declares no sources"
+
+    # 1. Build with default params: every spec must be startable from
+    # the wizard without typing anything.
+    primary = spec.source_names[0]
+    workflow = workflow_registry.create(
+        WorkflowConfig(
+            identifier=wid, job_id=JobId(source_name=primary), params={}
+        )
+    )
+
+    # 2. Context: scalar samples for every declared key (the
+    # latest-sample idiom accepts plain scalars). Chopper setpoints get
+    # pulse-plausible values.
+    if spec.context_keys or spec.optional_context_keys:
+        ctx = {}
+        for key in [*spec.context_keys, *spec.optional_context_keys]:
+            if "speed" in key:
+                ctx[key] = 14.0
+            elif "delay" in key:
+                ctx[key] = 0.0
+            else:
+                ctx[key] = 0.5
+        workflow.set_context(ctx)
+
+    # 3. One window of input on EVERY source. Event payloads go to
+    # everything (non-event workflows ignore them); 2-D frames only to
+    # the workflows that consume arbitrary DataArrays (camera views,
+    # timeseries) — the monitor histogram-mode path validates DataArray
+    # inputs strictly and must not see an image.
+    from esslivedata_tpu.workflows.area_detector_view import (
+        AreaDetectorView,
+    )
+    from esslivedata_tpu.workflows.timeseries import TimeseriesWorkflow
+
+    rng = np.random.default_rng(7)
+    workflow.accumulate(
+        {src: _staged_events(rng) for src in spec.source_names}
+    )
+    if isinstance(workflow, (AreaDetectorView, TimeseriesWorkflow)):
+        workflow.accumulate({src: _frame(rng) for src in spec.source_names})
+
+    outputs = workflow.finalize()
+    assert isinstance(outputs, dict)
+
+    # 4. Published names stay inside the declared output vocabulary
+    # (timeseries declares none: its outputs are dynamic per stream).
+    if spec.outputs:
+        undeclared = set(outputs) - set(spec.outputs)
+        assert not undeclared, (
+            f"{workflow_id} published undeclared outputs: {undeclared}"
+        )
+        assert outputs, f"{workflow_id} produced no output from one window"
+
+    # 5. Every produced output is a plottable DataArray: the dashboard's
+    # auto-selected plotter must render it.
+    for name, da in outputs.items():
+        assert isinstance(da, DataArray), (workflow_id, name, type(da))
+        png = render_png(da, title=name)
+        assert png[:4] == b"\x89PNG", (workflow_id, name)
+
+    # 6. A second window must also work (state carried, not consumed).
+    workflow.accumulate(
+        {src: _staged_events(rng) for src in spec.source_names}
+    )
+    second = workflow.finalize()
+    if spec.outputs:
+        assert set(second) <= set(spec.outputs)
