@@ -498,11 +498,26 @@ class JobManager:
                 if rec.finishing and rec.phase in (
                     _Phase.ACTIVE,
                     _Phase.PENDING_CONTEXT,
+                    # A job stopped before it ever activated (beam-off:
+                    # nothing advanced it out of SCHEDULED) has nothing
+                    # to flush — it must still complete its stop.
+                    _Phase.SCHEDULED,
                 ):
                     rec.phase = _Phase.STOPPED
         return [r for r in results if r is not None]
 
     # -- introspection -----------------------------------------------------
+    def has_finishing_jobs(self) -> bool:
+        """True while any job awaits its final flush — the processor runs
+        an empty window on idle ticks so stops complete without beam.
+        Already-stopped records keep their ``finishing`` flag but need
+        nothing further."""
+        with self._lock:
+            return any(
+                rec.finishing and rec.phase is not _Phase.STOPPED
+                for rec in self._records.values()
+            )
+
     def job_statuses(self) -> list[JobStatus]:
         with self._lock:
             return [

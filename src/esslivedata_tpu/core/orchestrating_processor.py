@@ -227,6 +227,15 @@ class OrchestratingProcessor:
             self._batcher.report_processing_time(
                 Duration.from_s(self._clock() - t0)
             )
+        elif self._job_manager.has_finishing_jobs():
+            # A stop must complete even when no beam data flows: run an
+            # empty window so finishing jobs flush any pending
+            # accumulation and leave the active set (otherwise a job
+            # stopped during a beam-off period stays 'finishing'
+            # forever and its delisting heartbeat never happens).
+            results = self._job_manager.process_jobs({})
+            if results:
+                self._publish_results(results, Timestamp.now())
 
         now = self._clock()
         if now - self._last_heartbeat >= self._heartbeat_interval_s:

@@ -38,7 +38,15 @@ class DashboardServices:
         self.config_store = config_store or MemoryConfigStore()
         self._store_manager = ConfigStoreManager(self.config_store)
         self.orchestrator = JobOrchestrator(
-            transport=transport, job_service=self.job_service
+            transport=transport,
+            job_service=self.job_service,
+            store=self._store_manager.namespaced("active_jobs"),
+        )
+        # A job delisted by heartbeats (died, stopped elsewhere, run
+        # ended) must drop out of the persisted active-config view too —
+        # the desired-state record must not outlive every observation.
+        self.job_service.add_job_gone_listener(
+            self.orchestrator.discard_active
         )
         self.plot_orchestrator = PlotOrchestrator(
             data_service=self.data_service,
@@ -55,6 +63,7 @@ class DashboardServices:
             job_service=self.job_service,
             device_registry=self.devices,
             interval_s=pump_interval_s,
+            reconciler=self.orchestrator.reconcile_stops,
         )
 
     def start(self) -> None:

@@ -9,6 +9,7 @@ JobService (adoption is handled there).
 
 from __future__ import annotations
 
+import os
 import uuid
 from typing import Any
 
@@ -17,7 +18,13 @@ from ..workflows.workflow_factory import WorkflowFactory, workflow_registry
 from .job_service import JobService, PendingCommand
 from .transport import Transport
 
-__all__ = ["JobOrchestrator"]
+__all__ = ["STOP_REISSUE_INTERVAL_S", "JobOrchestrator"]
+
+#: How long an unacted stop/remove may contradict a fresh running
+#: observation before reconciliation re-publishes it.
+STOP_REISSUE_INTERVAL_S = float(
+    os.environ.get("LIVEDATA_STOP_REISSUE_S", "5")
+)
 
 
 class JobOrchestrator:
@@ -27,11 +34,24 @@ class JobOrchestrator:
         transport: Transport,
         job_service: JobService,
         registry: WorkflowFactory | None = None,
+        store=None,
     ) -> None:
         self._transport = transport
         self._job_service = job_service
         self._registry = registry if registry is not None else workflow_registry
         self._staged: dict[tuple[str, str], dict[str, Any]] = {}
+        # Active-job persistence (reference job_state_persistence): a
+        # commit records (params, job_number) per (workflow, source) in
+        # the config store; a restarted dashboard restores the desired
+        # state while ADR 0008 adoption gates the data admission. None =
+        # in-memory only (tests, --config-dir unset).
+        self._store = store
+        self._active: dict[str, dict[str, dict[str, Any]]] = {}
+        if self._store is not None:
+            for key in self._store.keys():
+                doc = self._store.load(key)
+                if doc:
+                    self._active[key] = doc
 
     # -- two-phase start ---------------------------------------------------
     def stage(
@@ -64,13 +84,78 @@ class JobOrchestrator:
             params=params,
             aux_source_names=aux_source_names or {},
         )
+        prev = self._active.get(str(workflow_id), {}).get(source_name)
         self._transport.publish_command(
             {"kind": "start_job", "config": config.model_dump(mode="json")}
         )
         pending = self._job_service.track_command(
             source_name, job_id.job_number, "start_job"
         )
+        self._record_active(
+            str(workflow_id), source_name, params, job_id.job_number
+        )
+        if prev:
+            # Clear-at-commit (reference semantics): recommitting a
+            # (workflow, source) supersedes its previous job — the new
+            # job accumulates fresh and the old one is retired. Jobs of
+            # OTHER workflows on the same source are untouched
+            # (multi-job stays a feature). Only a job still observed
+            # alive gets the stop: commanding a dead one would never be
+            # acked and would raise a spurious expiry alarm.
+            try:
+                prev_number = uuid.UUID(prev["job_number"])
+            except (ValueError, KeyError, TypeError):
+                prev_number = None  # malformed restored record
+            if (
+                prev_number is not None
+                and self._job_service.job(source_name, prev_number)
+                is not None
+            ):
+                self._job_command(
+                    "stop",
+                    JobId(source_name=source_name, job_number=prev_number),
+                )
         return job_id, pending
+
+    # -- active-config persistence ----------------------------------------
+    def _record_active(
+        self, wid: str, source_name: str, params: dict, job_number: uuid.UUID
+    ) -> None:
+        doc = self._active.setdefault(wid, {})
+        doc[source_name] = {
+            "params": params,
+            "job_number": str(job_number),
+        }
+        if self._store is not None:
+            self._store.save(wid, doc)
+
+    def discard_active(self, source_name: str, job_number: uuid.UUID) -> None:
+        """Public hook for the job-gone listener (dashboard_services):
+        heartbeat delisting retires the persisted active record."""
+        self._discard_active(source_name, job_number)
+
+    def _discard_active(self, source_name: str, job_number: uuid.UUID) -> None:
+        num = str(job_number)
+        for wid, doc in list(self._active.items()):
+            entry = doc.get(source_name)
+            if entry and entry.get("job_number") == num:
+                del doc[source_name]
+                if self._store is not None:
+                    if doc:
+                        self._store.save(wid, doc)
+                    else:
+                        self._store.delete(wid)
+                if not doc:
+                    self._active.pop(wid, None)
+
+    def active_config(self, workflow_id: WorkflowId | str) -> dict[str, dict]:
+        """source_name -> {params, job_number} for committed (possibly
+        restored) jobs of one workflow — what the reference's
+        get_active_config answers after a dashboard restart."""
+        return dict(self._active.get(str(workflow_id), {}))
+
+    def active_configs(self) -> dict[str, dict[str, dict]]:
+        return {k: dict(v) for k, v in self._active.items()}
 
     def start(
         self,
@@ -83,23 +168,50 @@ class JobOrchestrator:
         return self.commit(workflow_id, source_name)
 
     # -- lifecycle commands ------------------------------------------------
-    def _job_command(self, action: str, job_id: JobId) -> PendingCommand:
+    def _publish_job_command(
+        self, action: str, source_name: str, job_number: uuid.UUID
+    ) -> None:
+        """The ONE place the job_command wire format is built: first
+        issue and reconciliation re-issue must never diverge."""
         self._transport.publish_command(
             {
                 "kind": "job_command",
                 "action": action,
-                "source_name": job_id.source_name,
-                "job_number": str(job_id.job_number),
+                "source_name": source_name,
+                "job_number": str(job_number),
             }
+        )
+
+    def _job_command(self, action: str, job_id: JobId) -> PendingCommand:
+        self._publish_job_command(
+            action, job_id.source_name, job_id.job_number
         )
         return self._job_service.track_command(
             job_id.source_name, job_id.job_number, action
         )
 
     def stop(self, job_id: JobId) -> PendingCommand:
+        self._discard_active(job_id.source_name, job_id.job_number)
         return self._job_command("stop", job_id)
 
+    def reconcile_stops(self) -> int:
+        """Re-publish stop/remove commands the backend has not acted on
+        while the job is still observed running (fresh heartbeat) —
+        desired state keeps winning over lost messages (ADR 0008). The
+        pump calls this every tick; the per-command re-issue rate is
+        limited by STOP_REISSUE_INTERVAL_S via the job service's
+        re-arming."""
+        stale = self._job_service.stops_needing_reissue(
+            STOP_REISSUE_INTERVAL_S
+        )
+        for cmd in stale:
+            self._publish_job_command(
+                cmd.kind, cmd.source_name, cmd.job_number
+            )
+        return len(stale)
+
     def remove(self, job_id: JobId) -> PendingCommand:
+        self._discard_active(job_id.source_name, job_id.job_number)
         return self._job_command("remove", job_id)
 
     def reset(self, job_id: JobId) -> PendingCommand:

@@ -71,6 +71,13 @@ class JobService:
         # commands, vanished jobs) — wired to the NotificationQueue by the
         # composition root; None = silent.
         self._on_event = on_event or (lambda level, message: None)
+        # (source_name, job_number) callbacks fired when a heartbeat
+        # delists a job — desired-state owners (the orchestrator's
+        # active-config records) reconcile off this.
+        self._job_gone_listeners: list = []
+
+    def add_job_gone_listener(self, fn) -> None:
+        self._job_gone_listeners.append(fn)
 
     # -- ingestion callbacks ----------------------------------------------
     def on_status(self, msg: StatusMessage) -> None:
@@ -116,6 +123,11 @@ class JobService:
                 and now - c.issued_wall <= COMMAND_EXPIRY_S
             }
         for source_name, job_number in vanished:
+            for listener in self._job_gone_listeners:
+                try:
+                    listener(source_name, job_number)
+                except Exception:
+                    logger.exception("job-gone listener failed")
             key = (source_name, job_number)
             if key in operator_stopped:
                 logger.info(
@@ -207,6 +219,47 @@ class JobService:
     def pending_commands(self) -> list[PendingCommand]:
         with self._lock:
             return [c for c in self._pending if not c.resolved]
+
+    def stops_needing_reissue(
+        self, interval_s: float
+    ) -> list[PendingCommand]:
+        """Unacted stop/remove commands contradicted by observation.
+
+        A stop the backend has not acted on (command unresolved past
+        ``interval_s``) while the job is STILL listed by a fresh
+        heartbeat is a desired-vs-observed contradiction: the command
+        was lost or the service is wedged, and reconciliation must
+        re-issue it (reference reconciliation_restop scenario, ADR
+        0008). Returned commands are re-armed (``issued_wall`` reset) so
+        each contradiction re-issues once per interval rather than once
+        per pump tick — and so the command cannot expire while the
+        contradiction persists.
+        """
+        now = time.monotonic()
+        out: list[PendingCommand] = []
+        with self._lock:
+            for c in self._pending:
+                if c.resolved or c.error or c.kind not in ("stop", "remove"):
+                    continue
+                if now - c.issued_wall <= interval_s:
+                    continue
+                key = (c.source_name, c.job_number)
+                if key not in self._jobs:
+                    continue  # gone: the stop worked (ack may still ride)
+                owner = self._services.get(self._job_owner.get(key, ""))
+                if owner is None or owner.is_stale:
+                    # No fresh observation: nothing contradicts the stop;
+                    # expiry (sweep_expired) owns this case.
+                    continue
+                c.issued_wall = now
+                out.append(c)
+        for c in out:
+            self._on_event(
+                "warning",
+                f"re-issuing unacted {c.kind} for {c.source_name} "
+                f"(job {str(c.job_number)[:8]})",
+            )
+        return out
 
     def sweep_expired(self) -> list[PendingCommand]:
         """Drop commands that never got an ack within the expiry window,

@@ -37,12 +37,17 @@ class MessagePump:
         job_service: JobService,
         device_registry: DerivedDeviceRegistry | None = None,
         interval_s: float = 0.05,
+        reconciler=None,
     ) -> None:
         self._transport = transport
         self._data_service = data_service
         self._job_service = job_service
         self._devices = device_registry
         self._interval_s = interval_s
+        # Zero-arg callable run each tick (the orchestrator's
+        # reconcile_stops): desired-state enforcement is time-based, like
+        # expiry — it must not wait for a message.
+        self._reconciler = reconciler or (lambda: 0)
         self._thread: threading.Thread | None = None
         self._running = threading.Event()
 
@@ -50,6 +55,7 @@ class MessagePump:
         # Time-based upkeep first: command expiry does not depend on any
         # message arriving (a dead broker is exactly when it must fire).
         self._job_service.sweep_expired()
+        self._reconciler()
         messages = self._transport.get_messages()
         if not messages:
             return 0

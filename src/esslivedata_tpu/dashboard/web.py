@@ -294,6 +294,9 @@ class StateHandler(_Base):
                     _workflow_entry(spec)
                     for spec in orchestrator.available_workflows(instrument)
                 ],
+                # Committed (possibly restart-restored) per-workflow
+                # configs: workflow_id -> source -> {params, job_number}.
+                "active_configs": orchestrator.active_configs(),
                 "pending_commands": [
                     {
                         "source_name": c.source_name,

@@ -54,11 +54,22 @@ class TestFakeBackendRoundTrip:
             transport.tick()
             services.pump.pump_once()
         pending = services.orchestrator.stop(job_id)
-        for _ in range(40):
+        # The stop completes service-side immediately (even before the
+        # job activates), but the dashboard learns of it from the next
+        # HEARTBEAT — poll across the 0.05 s heartbeat interval instead
+        # of counting ticks.
+        import time
+
+        deadline = time.monotonic() + 10.0
+        job = None
+        while time.monotonic() < deadline:
             transport.tick()
             services.pump.pump_once()
+            job = services.job_service.job("monitor_1", job_id.job_number)
+            if job is not None and job.state == "stopped":
+                break
+            time.sleep(0.02)
         assert pending.resolved
-        job = services.job_service.job("monitor_1", job_id.job_number)
         assert job is not None and job.state == "stopped"
 
     def test_error_ack_for_bad_workflow(self, dash):

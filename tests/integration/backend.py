@@ -111,7 +111,11 @@ class IntegrationBackend:
         return proc
 
     def spawn_dashboard(
-        self, port: int, *, config_dir: Path | None = None
+        self,
+        port: int,
+        *,
+        config_dir: Path | None = None,
+        extra_env: dict[str, str] | None = None,
     ) -> subprocess.Popen:
         cmd = [
             sys.executable,
@@ -130,7 +134,7 @@ class IntegrationBackend:
             cmd += ["--config-dir", str(config_dir)]
         proc = subprocess.Popen(
             cmd,
-            env=_child_env(),
+            env=_child_env(**(extra_env or {})),
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,

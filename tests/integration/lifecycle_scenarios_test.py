@@ -1,0 +1,321 @@
+"""Lifecycle scenarios over real OS processes (reference
+tests/integration/: clear_at_commit_test.py, reconciliation_restop_test.py,
+job_state_persistence_test.py, roi_spectra_test.py) — each against the
+file broker with real detector-service and dashboard subprocesses.
+"""
+
+import json
+import os
+import signal
+import time
+
+import pytest
+
+from .backend import IntegrationBackend, http_json, wait_for_http
+
+pytestmark = pytest.mark.integration
+
+PORT = 8941
+
+
+@pytest.fixture(scope="module")
+def backend(tmp_path_factory):
+    b = IntegrationBackend(tmp_path_factory.mktemp("broker"))
+    yield b
+    b.shutdown()
+
+
+@pytest.fixture(scope="module")
+def detector(backend):
+    proc = backend.spawn_service("detector_data")
+    try:
+        backend.wait_for_heartbeat(timeout_s=90)
+    except TimeoutError:
+        raise AssertionError(backend.dump_output(proc, "detector"))
+    return proc
+
+
+@pytest.fixture(scope="module")
+def dash(backend, detector, tmp_path_factory):
+    config_dir = tmp_path_factory.mktemp("dashcfg")
+    proc = backend.spawn_dashboard(
+        PORT,
+        config_dir=config_dir,
+        extra_env={
+            # Reconciliation timings for the restop scenario: re-issue
+            # fast, never let the stop expire mid-scenario, and keep the
+            # frozen service's last heartbeat 'fresh' long enough for the
+            # contradiction to be observable.
+            "LIVEDATA_STOP_REISSUE_S": "1.5",
+            "LIVEDATA_COMMAND_EXPIRY_S": "60",
+            "LIVEDATA_SERVICE_STALE_S": "30",
+        },
+    )
+    base = f"http://localhost:{PORT}"
+    try:
+        wait_for_http(f"{base}/api/state", timeout_s=90)
+    except TimeoutError:
+        raise AssertionError(backend.dump_output(proc, "dashboard"))
+    return base, config_dir, proc
+
+
+def _detector_workflow(base):
+    state = http_json(f"{base}/api/state")
+    return next(
+        w["workflow_id"]
+        for w in state["workflows"]
+        if "detector_view" in w["workflow_id"]
+    )
+
+
+def _stage_commit(base, wid, source, params=None):
+    payload = {
+        "workflow_id": wid,
+        "source_name": source,
+        "params": params or {},
+    }
+    http_json(f"{base}/api/workflow/stage", payload)
+    return http_json(f"{base}/api/workflow/commit", payload)["job_number"]
+
+
+def _cumulative(base, job_number) -> float:
+    state = http_json(f"{base}/api/state")
+    kids = [
+        k["id"]
+        for k in state["keys"]
+        if k["output"] == "counts_cumulative"
+        and k["job_number"] == job_number
+    ]
+    if not kids:
+        return -1.0
+    return float(http_json(f"{base}/data/{kids[0]}.json")["values"])
+
+
+class TestClearAtCommit:
+    def test_recommit_clears_accumulated_data(self, backend, dash):
+        """Recommitting a running workflow resets its accumulation: the
+        replacement job's cumulative starts fresh instead of continuing
+        the old total (reference clear_at_commit_test.py)."""
+        base, _, _ = dash
+        wid = _detector_workflow(base)
+        first_job = _stage_commit(base, wid, "panel_0")
+        t0 = time.time_ns()
+        for pulse in range(6):
+            backend.produce_events(pulse, t0_ns=t0, seed=31)
+        backend.wait_for(lambda: _cumulative(base, first_job) >= 3000, 120)
+        pre_commit = _cumulative(base, first_job)
+
+        # Recommit with identical params, as the UI's Start does.
+        second_job = _stage_commit(base, wid, "panel_0")
+        assert second_job != first_job
+        # Fresh accumulation: feed a couple more pulses and read the NEW
+        # job's cumulative — it must sit well below the pre-commit total.
+        t1 = time.time_ns()
+        for pulse in range(2):
+            backend.produce_events(pulse, t0_ns=t1, seed=37)
+        backend.wait_for(lambda: _cumulative(base, second_job) >= 0, 90)
+        post_commit = _cumulative(base, second_job)
+        assert post_commit < pre_commit, (
+            f"recommit did not clear: {post_commit} >= {pre_commit}"
+        )
+        # The superseded job left the active set (it stays visible as
+        # 'stopped' until an operator removes it — deliberate UX delta
+        # from the reference, which delists immediately).
+        backend.wait_for(
+            lambda: any(
+                j["job_number"] == first_job
+                and j["state"] in ("stopped", "finishing")
+                for j in http_json(f"{base}/api/state")["jobs"]
+            ),
+            60,
+        )
+
+
+class TestStopReissueReconciliation:
+    def test_unacted_stop_is_reissued(self, backend, detector, dash):
+        """A stop the backend has not acted on is re-published by the
+        dashboard's reconciliation (reference reconciliation_restop):
+        SIGSTOP freezes the service so the stop is not consumed while
+        the job's observed status stays fresh; desired (stopped) then
+        contradicts observed (running), and extra stop commands that no
+        user issued appear on the commands topic. On SIGCONT the service
+        consumes them and the job goes away."""
+        base, _, _ = dash
+        wid = _detector_workflow(base)
+        job = _stage_commit(base, wid, "panel_0")
+        t0 = time.time_ns()
+        for pulse in range(4):
+            backend.produce_events(pulse, t0_ns=t0, seed=41)
+        backend.wait_for(
+            lambda: any(
+                j["job_number"] == job and j["state"] == "active"
+                for j in http_json(f"{base}/api/state")["jobs"]
+            ),
+            90,
+        )
+
+        topic = f"{backend.instrument}_livedata_commands"
+        watcher = backend.consumer([topic])
+
+        def stop_count() -> int:
+            n = 0
+            for msg in watcher.consume(500, 0.0):
+                try:
+                    body = json.loads(msg.value())
+                except ValueError:
+                    continue
+                if (
+                    body.get("kind") == "job_command"
+                    and body.get("action") == "stop"
+                    and body.get("job_number") == job
+                ):
+                    n += 1
+            return n
+
+        os.kill(detector.pid, signal.SIGSTOP)
+        try:
+            seen = stop_count()  # drain history (none for this job yet)
+            assert seen == 0
+            http_json(
+                f"{base}/api/job/stop",
+                {"source_name": "panel_0", "job_number": job},
+            )
+            total = {"n": 0}
+
+            def reissued() -> bool:
+                total["n"] += stop_count()
+                return total["n"] >= 2  # the user's stop + >=1 reissue
+
+            backend.wait_for(reissued, 30)
+        finally:
+            os.kill(detector.pid, signal.SIGCONT)
+        # Resumed service consumes the (re-issued) stops: job leaves the
+        # active set.
+        backend.wait_for(
+            lambda: all(
+                j["state"] in ("stopped", "finishing")
+                for j in http_json(f"{base}/api/state")["jobs"]
+                if j["job_number"] == job
+            ),
+            60,
+        )
+
+
+class TestJobStatePersistence:
+    def test_active_config_survives_dashboard_restart(
+        self, backend, detector, dash
+    ):
+        """Committed per-(workflow, source) params are persisted and
+        restored across a dashboard restart (reference
+        job_state_persistence_test.py); the running job itself is
+        re-admitted by adoption (ADR 0008)."""
+        base, config_dir, proc = dash
+        wid = _detector_workflow(base)
+        params = {"toa_bins": 64}
+        job = _stage_commit(base, wid, "panel_0", params)
+
+        def active_recorded():
+            cfgs = http_json(f"{base}/api/state")["active_configs"]
+            entry = cfgs.get(wid, {}).get("panel_0")
+            return entry if entry and entry["job_number"] == job else None
+
+        entry = backend.wait_for(active_recorded, 30)
+        assert entry["params"] == params
+
+        backend.kill(proc, hard=True)  # crash, not graceful
+        dash2 = backend.spawn_dashboard(PORT, config_dir=config_dir)
+        try:
+            wait_for_http(f"{base}/api/state", timeout_s=90)
+            cfgs = http_json(f"{base}/api/state")["active_configs"]
+            entry = cfgs.get(wid, {}).get("panel_0")
+            assert entry is not None, "active config lost on restart"
+            assert entry["params"] == params
+            assert entry["job_number"] == job
+            # The still-running job is adopted back into view.
+            backend.wait_for(
+                lambda: any(
+                    j["job_number"] == job
+                    for j in http_json(f"{base}/api/state")["jobs"]
+                ),
+                60,
+            )
+        finally:
+            backend.kill(dash2)
+            # Leave a dashboard running for any scenario added after this
+            # one (module fixtures are shared).
+
+
+class TestRoiSpectra:
+    def test_roi_spectra_follow_published_rois(self, backend, detector):
+        """ROI spectra outputs appear for published ROIs and track ROI
+        set changes end to end (reference roi_spectra_test.py): the
+        dashboard POST publishes to the ROI path, the service installs
+        masks, and the published roi_spectra output's roi axis follows."""
+        dash2 = backend.spawn_dashboard(PORT + 1)
+        base = f"http://localhost:{PORT + 1}"
+        try:
+            wait_for_http(f"{base}/api/state", timeout_s=90)
+            wid = _detector_workflow(base)
+            job = _stage_commit(base, wid, "panel_0")
+            t0 = time.time_ns()
+            for pulse in range(4):
+                backend.produce_events(pulse, t0_ns=t0, seed=51)
+
+            def roi_dim() -> int:
+                state = http_json(f"{base}/api/state")
+                kids = [
+                    k["id"]
+                    for k in state["keys"]
+                    if k["output"] == "roi_spectra_cumulative"
+                    and k["job_number"] == job
+                ]
+                if not kids:
+                    return -1
+                data = http_json(f"{base}/data/{kids[0]}.json")
+                if not data["dims"] or data["dims"][0] != "roi":
+                    return 0
+                return len(data["values"])
+
+            # Screen-coordinate rectangles (the wire format the service
+            # installs: x_min/x_max/y_min/y_max).
+            roi_a = {
+                "x_min": -1e9,
+                "x_max": 1e9,
+                "y_min": -1e9,
+                "y_max": 1e9,
+            }
+            roi_b = {
+                "x_min": -1e9,
+                "x_max": 0.0,
+                "y_min": -1e9,
+                "y_max": 0.0,
+            }
+            http_json(
+                f"{base}/api/roi",
+                {
+                    "source_name": "panel_0",
+                    "job_number": job,
+                    "rois": {"a": roi_a},
+                },
+            )
+            for pulse in range(3):
+                backend.produce_events(100 + pulse, t0_ns=t0, seed=52)
+            backend.wait_for(lambda: roi_dim() == 1, 60)
+
+            # Publish a second ROI: the spectra axis follows the set.
+            http_json(
+                f"{base}/api/roi",
+                {
+                    "source_name": "panel_0",
+                    "job_number": job,
+                    "rois": {"a": roi_a, "b": roi_b},
+                },
+            )
+            for pulse in range(3):
+                backend.produce_events(200 + pulse, t0_ns=t0, seed=53)
+            backend.wait_for(lambda: roi_dim() == 2, 60)
+        except (AssertionError, TimeoutError):
+            backend.kill(dash2)
+            raise AssertionError(backend.dump_output(dash2, "dashboard"))
+        finally:
+            backend.kill(dash2)
