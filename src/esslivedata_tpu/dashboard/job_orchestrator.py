@@ -10,6 +10,8 @@ JobService (adoption is handled there).
 from __future__ import annotations
 
 import os
+import threading
+import time
 import uuid
 from typing import Any
 
@@ -24,6 +26,12 @@ __all__ = ["STOP_REISSUE_INTERVAL_S", "JobOrchestrator"]
 #: observation before reconciliation re-publishes it.
 STOP_REISSUE_INTERVAL_S = float(
     os.environ.get("LIVEDATA_STOP_REISSUE_S", "5")
+)
+
+#: How long a RESTORED active-config record may go unobserved (while
+#: fresh heartbeats flow) before it is retired as dead.
+ACTIVE_RESTORE_GRACE_S = float(
+    os.environ.get("LIVEDATA_ACTIVE_GRACE_S", "15")
 )
 
 
@@ -46,12 +54,25 @@ class JobOrchestrator:
         # state while ADR 0008 adoption gates the data admission. None =
         # in-memory only (tests, --config-dir unset).
         self._store = store
+        # _active is touched from the web thread (commit/stop/state) AND
+        # the pump thread (reconcile, job-gone listener): every access
+        # goes through _active_lock.
+        self._active_lock = threading.Lock()
         self._active: dict[str, dict[str, dict[str, Any]]] = {}
+        # Restored records carry a retirement deadline: if, once fresh
+        # heartbeats flow, the job is never observed within the grace
+        # period, it died while the dashboard was down — the record must
+        # not outlive every observation (checked in reconcile_stops).
+        self._restored_pending: dict[tuple[str, str], float] = {}
         if self._store is not None:
             for key in self._store.keys():
                 doc = self._store.load(key)
                 if doc:
                     self._active[key] = doc
+                    for source in doc:
+                        self._restored_pending[(key, source)] = (
+                            time.monotonic()
+                        )
 
     # -- two-phase start ---------------------------------------------------
     def stage(
@@ -84,7 +105,7 @@ class JobOrchestrator:
             params=params,
             aux_source_names=aux_source_names or {},
         )
-        prev = self._active.get(str(workflow_id), {}).get(source_name)
+        prev = self.active_config(workflow_id).get(source_name)
         self._transport.publish_command(
             {"kind": "start_job", "config": config.model_dump(mode="json")}
         )
@@ -121,41 +142,85 @@ class JobOrchestrator:
     def _record_active(
         self, wid: str, source_name: str, params: dict, job_number: uuid.UUID
     ) -> None:
-        doc = self._active.setdefault(wid, {})
-        doc[source_name] = {
-            "params": params,
-            "job_number": str(job_number),
-        }
-        if self._store is not None:
-            self._store.save(wid, doc)
+        with self._active_lock:
+            doc = self._active.setdefault(wid, {})
+            doc[source_name] = {
+                "params": params,
+                "job_number": str(job_number),
+            }
+            self._restored_pending.pop((wid, source_name), None)
+            if self._store is not None:
+                self._store.save(wid, dict(doc))
 
     def discard_active(self, source_name: str, job_number: uuid.UUID) -> None:
-        """Public hook for the job-gone listener (dashboard_services):
-        heartbeat delisting retires the persisted active record."""
-        self._discard_active(source_name, job_number)
-
-    def _discard_active(self, source_name: str, job_number: uuid.UUID) -> None:
+        """Retire the active record for one job. Called from stop/remove
+        on the web thread AND as the job-gone listener on the pump
+        thread — hence the lock."""
         num = str(job_number)
-        for wid, doc in list(self._active.items()):
-            entry = doc.get(source_name)
-            if entry and entry.get("job_number") == num:
-                del doc[source_name]
-                if self._store is not None:
-                    if doc:
-                        self._store.save(wid, doc)
-                    else:
-                        self._store.delete(wid)
-                if not doc:
-                    self._active.pop(wid, None)
+        with self._active_lock:
+            for wid, doc in list(self._active.items()):
+                entry = doc.get(source_name)
+                if entry and entry.get("job_number") == num:
+                    del doc[source_name]
+                    self._restored_pending.pop((wid, source_name), None)
+                    if self._store is not None:
+                        if doc:
+                            self._store.save(wid, dict(doc))
+                        else:
+                            self._store.delete(wid)
+                    if not doc:
+                        self._active.pop(wid, None)
 
     def active_config(self, workflow_id: WorkflowId | str) -> dict[str, dict]:
         """source_name -> {params, job_number} for committed (possibly
         restored) jobs of one workflow — what the reference's
         get_active_config answers after a dashboard restart."""
-        return dict(self._active.get(str(workflow_id), {}))
+        with self._active_lock:
+            return dict(self._active.get(str(workflow_id), {}))
 
     def active_configs(self) -> dict[str, dict[str, dict]]:
-        return {k: dict(v) for k, v in self._active.items()}
+        with self._active_lock:
+            return {k: dict(v) for k, v in self._active.items()}
+
+    def _retire_unobserved_restores(self) -> None:
+        """Restored records whose job no fresh heartbeat ever listed
+        within the grace period died while the dashboard was down —
+        retire them (a record miss degrades, it must not lie forever).
+        Only runs once observations exist: absence of heartbeats proves
+        nothing (ADR 0008)."""
+        if not any(
+            not s.is_stale for s in self._job_service.services()
+        ):
+            return
+        now = time.monotonic()
+        with self._active_lock:
+            stale = [
+                (wid, source)
+                for (wid, source), t0 in self._restored_pending.items()
+                if now - t0 > ACTIVE_RESTORE_GRACE_S
+            ]
+        for wid, source in stale:
+            entry = self.active_config(wid).get(source)
+            if entry is None:
+                with self._active_lock:
+                    self._restored_pending.pop((wid, source), None)
+                continue
+            try:
+                number = uuid.UUID(entry["job_number"])
+            except (ValueError, KeyError, TypeError):
+                number = None
+            if number is not None and self._job_service.job(
+                source, number
+            ) is not None:
+                # Observed alive: the restore is vindicated.
+                with self._active_lock:
+                    self._restored_pending.pop((wid, source), None)
+                continue
+            if number is not None:
+                self.discard_active(source, number)
+            else:
+                with self._active_lock:
+                    self._restored_pending.pop((wid, source), None)
 
     def start(
         self,
@@ -191,7 +256,7 @@ class JobOrchestrator:
         )
 
     def stop(self, job_id: JobId) -> PendingCommand:
-        self._discard_active(job_id.source_name, job_id.job_number)
+        self.discard_active(job_id.source_name, job_id.job_number)
         return self._job_command("stop", job_id)
 
     def reconcile_stops(self) -> int:
@@ -208,10 +273,11 @@ class JobOrchestrator:
             self._publish_job_command(
                 cmd.kind, cmd.source_name, cmd.job_number
             )
+        self._retire_unobserved_restores()
         return len(stale)
 
     def remove(self, job_id: JobId) -> PendingCommand:
-        self._discard_active(job_id.source_name, job_id.job_number)
+        self.discard_active(job_id.source_name, job_id.job_number)
         return self._job_command("remove", job_id)
 
     def reset(self, job_id: JobId) -> PendingCommand:

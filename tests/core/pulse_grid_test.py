@@ -1,0 +1,198 @@
+"""PeriodEstimator + SlotGrid scenario breadth (reference
+tests/core/pulse_grid_test.py): the components under the rate-aware
+batcher, pinned one behavior per test — duplicate/retrograde
+timestamps, convergence thresholds, missed-pulse robustness, integer
+snapping and its rejection limits, slot math under jitter, rounding
+drift and phase offsets."""
+
+import pytest
+
+from esslivedata_tpu.core.rate_aware_batcher import (
+    DIFF_BUFFER,
+    MIN_DIFFS,
+    PeriodEstimator,
+    SlotGrid,
+)
+from esslivedata_tpu.core.timestamp import Timestamp
+
+PERIOD_14HZ = round(1e9 / 14)
+
+
+def _observe(est: PeriodEstimator, times_ns) -> None:
+    for t in times_ns:
+        est.observe(t)
+
+
+class TestPeriodEstimator:
+    def test_initial_state(self):
+        est = PeriodEstimator()
+        assert est.last_ns is None
+        assert est.integer_rate_hz is None
+
+    def test_first_observation_sets_last(self):
+        est = PeriodEstimator()
+        est.observe(1_000_000_000)
+        assert est.last_ns == 1_000_000_000
+        assert est.integer_rate_hz is None
+
+    def test_duplicate_timestamp_produces_no_diff(self):
+        # Split messages (same pulse, two Kafka messages) must not feed
+        # zero-diffs into the estimate.
+        est = PeriodEstimator()
+        _observe(est, [0, 0, PERIOD_14HZ, PERIOD_14HZ])
+        assert est.last_ns == PERIOD_14HZ
+        assert est.integer_rate_hz is None  # only one usable diff
+
+    def test_retrograde_timestamp_does_not_corrupt(self):
+        # A late arrival neither rewinds last_ns nor records a negative
+        # diff.
+        est = PeriodEstimator()
+        _observe(est, [0, 100, 50, 200])
+        assert est.last_ns == 200
+
+    def test_not_converged_below_min_diffs(self):
+        est = PeriodEstimator()
+        _observe(est, [i * PERIOD_14HZ for i in range(MIN_DIFFS)])
+        assert est.integer_rate_hz is None
+
+    def test_converged_at_min_diffs(self):
+        est = PeriodEstimator()
+        _observe(est, [i * PERIOD_14HZ for i in range(MIN_DIFFS + 1)])
+        assert est.integer_rate_hz == 14
+
+    def test_missing_pulse_tolerated(self):
+        # A diff spanning a skipped pulse contributes diff/k, not an
+        # outlier: the estimate stays 14 Hz.
+        times = [0, 1, 2, 4, 5, 6, 7]
+        est = PeriodEstimator()
+        _observe(est, [i * PERIOD_14HZ for i in times])
+        assert est.integer_rate_hz == 14
+
+    def test_integer_rate_snap_from_near_integer(self):
+        period = round(1e9 / 13.995)  # inside the 1% snap band
+        est = PeriodEstimator()
+        _observe(est, [i * period for i in range(MIN_DIFFS + 1)])
+        assert est.integer_rate_hz == 14
+
+    def test_genuinely_non_integer_rate_rejected(self):
+        # 14.5 Hz must NOT snap: a grid on the wrong integer rate
+        # drifts phase within a batch and every close times out.
+        period = round(1e9 / 14.5)
+        est = PeriodEstimator()
+        _observe(est, [i * period for i in range(MIN_DIFFS + 1)])
+        assert est.integer_rate_hz is None
+
+    def test_sub_hz_rate_returns_none(self):
+        est = PeriodEstimator()
+        _observe(est, [i * 2_000_000_000 for i in range(MIN_DIFFS + 1)])
+        assert est.integer_rate_hz is None
+
+    def test_diff_buffer_bounded(self):
+        est = PeriodEstimator()
+        _observe(est, [i * PERIOD_14HZ for i in range(DIFF_BUFFER * 3)])
+        assert len(est._diffs) == DIFF_BUFFER
+
+    def test_jittered_integer_rate_still_snaps(self):
+        import random
+
+        rng = random.Random(3)
+        times = [
+            i * PERIOD_14HZ + rng.randint(-200_000, 200_000)
+            for i in range(20)
+        ]
+        est = PeriodEstimator()
+        _observe(est, times)
+        assert est.integer_rate_hz == 14
+
+
+class TestSlotGrid:
+    def _grid(self, origin_ns=0, period_ns=PERIOD_14HZ, slots=14):
+        return SlotGrid(
+            origin_ns=origin_ns, period_ns=period_ns, slots_per_batch=slots
+        )
+
+    def test_slot_at_window_start(self):
+        grid = self._grid()
+        start = Timestamp.from_ns(100 * PERIOD_14HZ)
+        assert grid.slot(Timestamp.from_ns(100 * PERIOD_14HZ), start) == 0
+
+    def test_last_slot_of_14hz_window(self):
+        grid = self._grid()
+        start = Timestamp.from_ns(100 * PERIOD_14HZ)
+        assert grid.slot(Timestamp.from_ns(113 * PERIOD_14HZ), start) == 13
+
+    def test_late_arrival_maps_negative(self):
+        grid = self._grid()
+        start = Timestamp.from_ns(100 * PERIOD_14HZ)
+        assert grid.slot(Timestamp.from_ns(99 * PERIOD_14HZ), start) == -1
+
+    def test_jitter_rounds_to_nearest_pulse(self):
+        grid = self._grid()
+        start = Timestamp.from_ns(0)
+        jitter = PERIOD_14HZ // 4
+        assert grid.slot(Timestamp.from_ns(5 * PERIOD_14HZ + jitter), start) == 5
+        assert grid.slot(Timestamp.from_ns(5 * PERIOD_14HZ - jitter), start) == 5
+
+    def test_jitter_tolerance_to_half_period(self):
+        grid = self._grid()
+        start = Timestamp.from_ns(0)
+        max_jitter = PERIOD_14HZ // 2 - 1
+        for pulse in range(14):
+            base = pulse * PERIOD_14HZ
+            assert grid.slot(Timestamp.from_ns(base + max_jitter), start) == pulse
+            assert grid.slot(Timestamp.from_ns(base - max_jitter), start) == pulse
+
+    def test_omitted_pulses_do_not_shift_indices(self):
+        # Slots are absolute positions on the grid: a gap at pulses 3-5
+        # leaves pulse 6 at slot 6.
+        grid = self._grid()
+        start = Timestamp.from_ns(0)
+        assert grid.slot(Timestamp.from_ns(6 * PERIOD_14HZ), start) == 6
+
+    def test_split_messages_same_slot(self):
+        grid = self._grid()
+        start = Timestamp.from_ns(0)
+        t = Timestamp.from_ns(5 * PERIOD_14HZ)
+        assert grid.slot(t, start) == grid.slot(t, start) == 5
+
+    def test_rounding_drift_absorbed(self):
+        # 14 * period = 999_999_994 ns but the window advances by 1e9:
+        # a few ns of drift past the pulse must stay at that pulse, not
+        # skip to the next (every close would otherwise time out).
+        grid = self._grid()
+        start = Timestamp.from_ns(14 * PERIOD_14HZ + 6)
+        t = Timestamp.from_ns(14 * PERIOD_14HZ)
+        assert grid.slot(t, start) == 0
+
+    def test_genuine_phase_offset_not_misclassified(self):
+        # A window starting 40% into a period: slot 0 is the NEXT pulse.
+        grid = self._grid()
+        start = Timestamp.from_ns(PERIOD_14HZ * 4 // 10)
+        assert grid.slot(Timestamp.from_ns(PERIOD_14HZ), start) == 0
+        assert grid.slot(Timestamp.from_ns(0), start) == -1
+
+    def test_consistent_across_batches(self):
+        # The property that kills per-batch phase drift: one grid gives
+        # stable slots for every (batch, pulse) combination.
+        grid = self._grid()
+        for batch in range(10):
+            start = Timestamp.from_ns(batch * 14 * PERIOD_14HZ)
+            for pulse in range(14):
+                t = Timestamp.from_ns((batch * 14 + pulse) * PERIOD_14HZ)
+                assert grid.slot(t, start) == pulse
+
+    def test_consistent_with_offset_origin(self):
+        offset = PERIOD_14HZ * 4 // 10
+        grid = self._grid(origin_ns=offset)
+        for batch in range(10):
+            start = Timestamp.from_ns(batch * 14 * PERIOD_14HZ)
+            for pulse in range(14):
+                t = Timestamp.from_ns(
+                    offset + (batch * 14 + pulse) * PERIOD_14HZ
+                )
+                assert grid.slot(t, start) == pulse
+
+    def test_frozen(self):
+        grid = self._grid()
+        with pytest.raises(AttributeError):
+            grid.origin_ns = 1  # type: ignore[misc]

@@ -1,0 +1,238 @@
+"""Unit coverage for the round-5 desired-state machinery: stop-reissue
+reconciliation (JobService.stops_needing_reissue + JobOrchestrator.
+reconcile_stops) and active-config persistence (record on commit,
+discard on stop/remove/job-gone, restore from the store, supersede
+gating). The multi-process scenario lives in
+tests/integration/lifecycle_scenarios_test.py; these pin each rule in
+isolation."""
+
+from __future__ import annotations
+
+import time
+import uuid
+
+from esslivedata_tpu.config.workflow_spec import JobId
+from esslivedata_tpu.core.job import JobStatus, ServiceStatus
+from esslivedata_tpu.dashboard.config_store import MemoryConfigStore
+from esslivedata_tpu.dashboard.job_orchestrator import JobOrchestrator
+from esslivedata_tpu.dashboard.job_service import JobService
+from esslivedata_tpu.dashboard.transport import StatusMessage
+
+
+class RecordingTransport:
+    def __init__(self) -> None:
+        self.commands: list[dict] = []
+
+    def publish_command(self, payload: dict) -> None:
+        self.commands.append(payload)
+
+    def get_messages(self):
+        return []
+
+    def start(self) -> None: ...
+
+    def stop(self) -> None: ...
+
+
+def heartbeat(service_id: str, jobs: list[tuple[str, uuid.UUID, str]]):
+    return StatusMessage(
+        service_id=service_id,
+        status=ServiceStatus(
+            service_name=service_id.split(":")[1] if ":" in service_id else service_id,
+            instrument="dummy",
+            jobs=[
+                JobStatus(
+                    source_name=s, job_number=n, workflow_id="w", state=st
+                )
+                for s, n, st in jobs
+            ],
+        ),
+    )
+
+
+def make_pair(store=None):
+    js = JobService()
+    transport = RecordingTransport()
+    orch = JobOrchestrator(
+        transport=transport, job_service=js, store=store
+    )
+    js.add_job_gone_listener(orch.discard_active)
+    return js, orch, transport
+
+
+class TestStopsNeedingReissue:
+    def _stop_tracked(self, js, source="s", number=None):
+        number = number or uuid.uuid4()
+        cmd = js.track_command(source, number, "stop")
+        return number, cmd
+
+    def test_unacted_stop_with_fresh_observation_reissues(self):
+        js = JobService()
+        number, cmd = self._stop_tracked(js)
+        js.on_status(heartbeat("svc", [("s", number, "active")]))
+        cmd.issued_wall = time.monotonic() - 10.0
+        out = js.stops_needing_reissue(5.0)
+        assert out == [cmd]
+        # Re-armed: immediately asking again yields nothing.
+        assert js.stops_needing_reissue(5.0) == []
+
+    def test_young_command_not_reissued(self):
+        js = JobService()
+        number, _ = self._stop_tracked(js)
+        js.on_status(heartbeat("svc", [("s", number, "active")]))
+        assert js.stops_needing_reissue(5.0) == []
+
+    def test_resolved_command_not_reissued(self):
+        js = JobService()
+        number, cmd = self._stop_tracked(js)
+        js.on_status(heartbeat("svc", [("s", number, "active")]))
+        cmd.resolved = True
+        cmd.issued_wall = time.monotonic() - 10.0
+        assert js.stops_needing_reissue(5.0) == []
+
+    def test_job_gone_means_stop_worked(self):
+        js = JobService()
+        number, cmd = self._stop_tracked(js)
+        cmd.issued_wall = time.monotonic() - 10.0
+        # Job never (or no longer) observed: nothing contradicts the stop.
+        assert js.stops_needing_reissue(5.0) == []
+
+    def test_stale_service_defers_to_expiry(self):
+        js = JobService()
+        number, cmd = self._stop_tracked(js)
+        js.on_status(heartbeat("svc", [("s", number, "active")]))
+        svc = js.services()[0]
+        svc.last_seen_wall = time.monotonic() - 1e6  # stale
+        cmd.issued_wall = time.monotonic() - 10.0
+        assert js.stops_needing_reissue(5.0) == []
+
+    def test_start_commands_never_reissued(self):
+        js = JobService()
+        number = uuid.uuid4()
+        cmd = js.track_command("s", number, "start_job")
+        js.on_status(heartbeat("svc", [("s", number, "active")]))
+        cmd.issued_wall = time.monotonic() - 10.0
+        assert js.stops_needing_reissue(5.0) == []
+
+
+class TestReconcileStops:
+    def test_republishes_identical_wire_format(self):
+        js, orch, transport = make_pair()
+        number = uuid.uuid4()
+        js.on_status(heartbeat("svc", [("s", number, "active")]))
+        cmd = orch.stop(JobId(source_name="s", job_number=number))
+        first = transport.commands[-1]
+        cmd.issued_wall = time.monotonic() - 100.0
+        assert orch.reconcile_stops() == 1
+        assert transport.commands[-1] == first  # byte-for-byte same payload
+
+    def test_noop_without_contradiction(self):
+        js, orch, transport = make_pair()
+        assert orch.reconcile_stops() == 0
+
+
+class TestActiveConfigPersistence:
+    WID = "dummy/monitor_data/histogram/v1"
+
+    def _commit(self, orch, source="mon", params=None):
+        from esslivedata_tpu.config.instrument import instrument_registry
+
+        instrument_registry["dummy"].load_factories()
+        from esslivedata_tpu.config.workflow_spec import WorkflowId
+
+        orch.stage(WorkflowId.parse(self.WID), source, params or {})
+        job_id, _ = orch.commit(WorkflowId.parse(self.WID), source)
+        return job_id
+
+    def test_commit_records_and_stop_discards(self):
+        store = MemoryConfigStore()
+        js, orch, transport = make_pair(store)
+        job_id = self._commit(orch, params={"toa_bins": 32})
+        entry = orch.active_config(self.WID)["mon"]
+        assert entry["params"] == {"toa_bins": 32}
+        assert entry["job_number"] == str(job_id.job_number)
+        assert store.load(self.WID)  # persisted
+
+        orch.stop(job_id)
+        assert orch.active_config(self.WID) == {}
+        assert store.load(self.WID) is None
+
+    def test_restore_from_store(self):
+        store = MemoryConfigStore()
+        js, orch, _ = make_pair(store)
+        job_id = self._commit(orch, params={"toa_bins": 32})
+        # New orchestrator over the same store = dashboard restart.
+        js2, orch2, _ = make_pair(store)
+        entry = orch2.active_config(self.WID)["mon"]
+        assert entry["params"] == {"toa_bins": 32}
+        assert entry["job_number"] == str(job_id.job_number)
+
+    def test_job_gone_listener_discards(self):
+        store = MemoryConfigStore()
+        js, orch, _ = make_pair(store)
+        job_id = self._commit(orch)
+        # Heartbeat lists the job, then a later heartbeat delists it
+        # (died service-side): the active record must follow.
+        js.on_status(
+            heartbeat("svc", [("mon", job_id.job_number, "active")])
+        )
+        assert orch.active_config(self.WID)
+        js.on_status(heartbeat("svc", []))
+        assert orch.active_config(self.WID) == {}
+        assert store.load(self.WID) is None
+
+    def test_restored_record_for_dead_job_retired_after_grace(self, monkeypatch):
+        """A job that died while the dashboard was down: the restored
+        record is retired once fresh heartbeats flow and the grace
+        period passes without the job being observed."""
+        import esslivedata_tpu.dashboard.job_orchestrator as jo
+
+        monkeypatch.setattr(jo, "ACTIVE_RESTORE_GRACE_S", 0.0)
+        store = MemoryConfigStore()
+        js, orch, _ = make_pair(store)
+        self._commit(orch)
+        # Restart over the same store; the job never heartbeats again.
+        js2, orch2, _ = make_pair(store)
+        # No observations at all: retirement must NOT fire (absence of
+        # heartbeats proves nothing, ADR 0008).
+        orch2.reconcile_stops()
+        assert orch2.active_config(self.WID)
+        # A fresh heartbeat that does not list the job: retired.
+        js2.on_status(heartbeat("svc", []))
+        orch2.reconcile_stops()
+        assert orch2.active_config(self.WID) == {}
+        assert store.load(self.WID) is None
+
+    def test_restored_record_for_live_job_vindicated(self, monkeypatch):
+        import esslivedata_tpu.dashboard.job_orchestrator as jo
+
+        monkeypatch.setattr(jo, "ACTIVE_RESTORE_GRACE_S", 0.0)
+        store = MemoryConfigStore()
+        js, orch, _ = make_pair(store)
+        job_id = self._commit(orch)
+        js2, orch2, _ = make_pair(store)
+        js2.on_status(
+            heartbeat("svc", [("mon", job_id.job_number, "active")])
+        )
+        orch2.reconcile_stops()
+        assert orch2.active_config(self.WID)["mon"]["job_number"] == str(
+            job_id.job_number
+        )
+
+    def test_recommit_supersedes_only_live_previous_job(self):
+        js, orch, transport = make_pair(MemoryConfigStore())
+        first = self._commit(orch)
+        # Previous job NOT observed alive: no retirement stop published.
+        self._commit(orch)
+        stops = [c for c in transport.commands if c.get("action") == "stop"]
+        assert stops == []
+        # Now with the (new) job observed alive, a further recommit
+        # retires it.
+        current = orch.active_config(self.WID)["mon"]["job_number"]
+        js.on_status(
+            heartbeat("svc", [("mon", uuid.UUID(current), "active")])
+        )
+        self._commit(orch)
+        stops = [c for c in transport.commands if c.get("action") == "stop"]
+        assert len(stops) == 1
+        assert stops[0]["job_number"] == current

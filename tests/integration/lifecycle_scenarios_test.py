@@ -102,7 +102,10 @@ class TestClearAtCommit:
         t0 = time.time_ns()
         for pulse in range(6):
             backend.produce_events(pulse, t0_ns=t0, seed=31)
-        backend.wait_for(lambda: _cumulative(base, first_job) >= 3000, 120)
+        # >= 5 of 6 pulses: the first pulse's data time can precede the
+        # job's activation boundary (data-time-driven), so requiring all
+        # 3000 events is timing-sensitive under load.
+        backend.wait_for(lambda: _cumulative(base, first_job) >= 2500, 120)
         pre_commit = _cumulative(base, first_job)
 
         # Recommit with identical params, as the UI's Start does.
@@ -241,8 +244,9 @@ class TestJobStatePersistence:
             )
         finally:
             backend.kill(dash2)
-            # Leave a dashboard running for any scenario added after this
-            # one (module fixtures are shared).
+            # NOTE: after this test NO dashboard from the module-scoped
+            # `dash` fixture is alive (its process was hard-killed above);
+            # later scenarios must spawn their own (TestRoiSpectra does).
 
 
 class TestRoiSpectra:
