@@ -130,6 +130,35 @@ class InProcessBackendTransport:
             for source in self._raw_sources:
                 source.inject(raw)
 
+    def publish_logdata(self, stream_name: str, value: float) -> bool:
+        """In-process counterpart of the broker transports' operator log
+        production: inject one f144 sample onto the motion topic. The
+        sample rides the FAKE data clock (pulse-index time, like every
+        fake stream) — a wall-clock stamp would sit decades in this
+        synthetic timeline's future and be rejected as insane."""
+        from ..config.instrument import instrument_registry
+        from ..kafka import wire
+        from ..services.fake_sources import _pulse_time_ns
+
+        inst = instrument_registry[self._instrument_name]
+        source = inst.log_sources.get(stream_name)
+        if source is None:
+            return False
+        with self._lock:
+            pulse = max(
+                (src.current_pulse() for src in self._raw_sources),
+                default=0,
+            )
+            raw = FakeKafkaMessage(
+                wire.encode_f144(
+                    source, float(value), _pulse_time_ns(pulse)
+                ),
+                f"{self._instrument_name}_motion",
+            )
+            for src in self._raw_sources:
+                src.inject(raw)
+        return True
+
     def get_messages(self) -> list[DashboardMessage]:
         with self._lock:
             fresh = self._producer.messages[self._drained :]

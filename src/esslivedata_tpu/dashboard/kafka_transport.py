@@ -33,6 +33,8 @@ class DashboardBrokerTransport:
         }
         self._consumer = consumer
         self._producer = producer
+        self._instrument_name = instrument
+        self._dev = dev
 
     def start(self) -> None:
         self._consumer.subscribe(list(self._kind_by_topic))
@@ -46,6 +48,35 @@ class DashboardBrokerTransport:
             self._topics.commands, json.dumps(payload).encode()
         )
         self._producer.poll(0)
+
+    def publish_logdata(self, stream_name: str, value: float) -> bool:
+        """Operator-triggered f144 sample onto the raw log topic
+        (reference log_producer_widget: the dashboard as a log
+        producer, for annotations and dev-time device driving).
+        Returns False for a stream the instrument does not declare."""
+        import time as _time
+
+        from ..config.instrument import instrument_registry
+        from ..config.streams import stream_kind_to_topic
+        from ..core.message import StreamKind
+        from ..kafka import wire
+
+        try:
+            inst = instrument_registry[self._instrument_name]
+        except KeyError:
+            return False
+        source = inst.log_sources.get(stream_name)
+        if source is None:
+            return False
+        topic = stream_kind_to_topic(
+            self._instrument_name, StreamKind.LOG, self._dev
+        )
+        self._producer.produce(
+            topic,
+            wire.encode_f144(source, float(value), _time.time_ns()),
+        )
+        self._producer.poll(0)
+        return True
 
     def get_messages(self) -> list[DashboardMessage]:  # noqa: C901
         out: list[DashboardMessage] = []

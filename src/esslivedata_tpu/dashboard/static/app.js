@@ -712,8 +712,22 @@ async function jobBulk(action, jobs) {
 // -- System tab: whole-system health (reference system_status_widget) --
 function renderSystemView(s) {
   const root = document.getElementById('system');
-  const fp = JSON.stringify([s.services, s.jobs.length, s.keys.length]);
-  if (root.dataset.fp === fp) return;
+  // Fingerprint only STABLE facts: sessions' idle_s ticks every poll,
+  // so including it would rebuild the tab each second and wipe the
+  // log-producer form mid-typing; idle labels update in place instead.
+  const fp = JSON.stringify([
+    s.services, s.jobs.length, s.keys.length, s.log_streams,
+    (s.sessions || []).map(
+      x => [x.session_id, x.config_generation_seen]),
+  ]);
+  if (root.dataset.fp === fp) {
+    for (const x of (s.sessions || [])) {
+      const cell = root.querySelector(
+        `[data-session-idle="${x.session_id}"]`);
+      if (cell) cell.textContent = 'idle ' + x.idle_s + 's';
+    }
+    return;
+  }
   root.dataset.fp = fp;
   root.innerHTML = '';
   const card = el('div', 'card');
@@ -764,6 +778,54 @@ function renderSystemView(s) {
     t.appendChild(r);
   }
   card.appendChild(t);
+  // Connected UI sessions (reference session_status_widget).
+  const sess = s.sessions || [];
+  card.appendChild(el('h3', '', 'Sessions'));
+  if (!sess.length) {
+    card.appendChild(el('small', '', 'no active UI sessions'));
+  } else {
+    const st = document.createElement('table'); st.className = 'devices';
+    for (const x of sess) {
+      const r = document.createElement('tr');
+      r.appendChild(el('td', '', x.session_id.slice(0, 8)));
+      const idle = el('td', '', 'idle ' + x.idle_s + 's');
+      idle.dataset.sessionIdle = x.session_id;
+      r.appendChild(idle);
+      r.appendChild(el('td', '',
+        'config gen ' + x.config_generation_seen));
+      st.appendChild(r);
+    }
+    card.appendChild(st);
+  }
+  // Operator log production (reference log_producer_widget): one f144
+  // sample onto the raw log topic — annotations, dev-time device values.
+  if ((s.log_streams || []).length) {
+    card.appendChild(el('h3', '', 'Produce log value'));
+    const form = el('div', 'roi-bar');
+    const sel = document.createElement('select');
+    for (const name of s.log_streams) {
+      const o = el('option', '', name); o.value = name;
+      sel.appendChild(o);
+    }
+    const val = document.createElement('input');
+    val.type = 'number'; val.step = 'any'; val.placeholder = 'value';
+    const go = el('button', '', 'Publish');
+    go.onclick = async () => {
+      if (val.value === '') return;
+      const r = await fetch('/api/logdata', {method: 'POST',
+        body: JSON.stringify(
+          {stream: sel.value, value: Number(val.value)})});
+      if (!r.ok) {
+        let body = {};
+        try { body = await r.json(); } catch (e) { /* non-JSON */ }
+        toast('log publish failed: ' + (body.error || r.status), 'error');
+        return;
+      }
+      toast('published ' + sel.value + ' = ' + val.value, 'info');
+    };
+    form.appendChild(sel); form.appendChild(val); form.appendChild(go);
+    card.appendChild(form);
+  }
   const totals = el('div');
   totals.style.marginTop = '8px';
   totals.appendChild(el('small', '',

@@ -23,6 +23,7 @@ import json
 import logging
 import re
 from pathlib import Path
+from time import monotonic as _monotonic
 
 import numpy as np
 import tornado.web
@@ -52,6 +53,21 @@ def _id_to_key(kid: str) -> ResultKey:
 
 
 _WF_ENTRY_CACHE: dict[str, dict] = {}
+_LOG_STREAMS_CACHE: dict[str, list[str]] = {}
+
+
+def _log_streams(instrument: str) -> list[str]:
+    """Declared f144 log streams (static per instrument; cached)."""
+    streams = _LOG_STREAMS_CACHE.get(instrument)
+    if streams is None:
+        from ..config.instrument import instrument_registry
+
+        try:
+            streams = sorted(instrument_registry[instrument].log_sources)
+        except KeyError:
+            streams = []
+        _LOG_STREAMS_CACHE[instrument] = streams
+    return streams
 
 
 def _workflow_entry(spec) -> dict:
@@ -297,6 +313,25 @@ class StateHandler(_Base):
                 # Committed (possibly restart-restored) per-workflow
                 # configs: workflow_id -> source -> {params, job_number}.
                 "active_configs": orchestrator.active_configs(),
+                # Producible log streams (System tab's log-producer form,
+                # reference log_producer_widget).
+                "log_streams": _log_streams(instrument),
+                # Connected UI sessions (reference session_status_widget):
+                # who else is looking at / driving this dashboard.
+                "sessions": [
+                    {
+                        "session_id": s.session_id,
+                        "idle_s": round(
+                            max(
+                                0.0,
+                                _monotonic() - s.last_seen_wall,
+                            ),
+                            1,
+                        ),
+                        "config_generation_seen": s.config_generation_seen,
+                    }
+                    for s in self.services.sessions.sessions()
+                ],
                 "pending_commands": [
                     {
                         "source_name": c.source_name,
@@ -602,6 +637,42 @@ class JobBulkActionHandler(_Base):
                 "results": results,
             }
         )
+
+
+class LogdataHandler(_Base):
+    """POST /api/logdata {stream, value}: operator-produced f144 sample
+    (reference log_producer_widget — annotations, dev-time device
+    driving). The transport resolves the stream to its raw topic and
+    source; transports without a producer report 501."""
+
+    def post(self) -> None:
+        body = json.loads(self.request.body or b"{}")
+        stream = body.get("stream")
+        value = body.get("value")
+        # bool is an int subclass: {"value": true} must 400, not
+        # silently publish 1.0.
+        if (
+            not isinstance(stream, str)
+            or isinstance(value, bool)
+            or not isinstance(value, (int, float))
+        ):
+            self.set_status(400)
+            self.write_json({"error": "need stream (str) and value (number)"})
+            return
+        publish = getattr(
+            self.services.transport, "publish_logdata", None
+        )
+        if publish is None:
+            self.set_status(501)
+            self.write_json(
+                {"error": "transport cannot produce log data"}
+            )
+            return
+        if not publish(stream, float(value)):
+            self.set_status(404)
+            self.write_json({"error": f"unknown log stream {stream!r}"})
+            return
+        self.write_json({"ok": True})
 
 
 class RoiHandler(_Base):
@@ -1036,6 +1107,7 @@ def make_app(
             (r"/api/job/(stop|reset|remove)", JobActionHandler),
             (r"/api/job/bulk", JobBulkActionHandler),
             (r"/api/roi", RoiHandler),
+            (r"/api/logdata", LogdataHandler),
             (r"/api/grids", GridsHandler),
             (r"/api/grid", GridManageHandler),
             (r"/api/grid/([^/]+)", GridManageHandler),

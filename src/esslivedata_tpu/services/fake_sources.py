@@ -322,6 +322,14 @@ class PulsedRawSource:
         """Queue a control-plane message (command JSON etc.)."""
         self._injected.append(message)
 
+    def current_pulse(self) -> int:
+        """Highest pulse index any driven stream has reached — the data
+        clock an externally injected message should stamp itself with
+        (dashboard fake_backend's operator log production)."""
+        return max(
+            (getattr(s, "_pulse", 0) for s in self._streams), default=0
+        )
+
     def get_messages(self) -> list[FakeKafkaMessage]:
         out, self._injected = self._injected, []
         for stream in self._streams:

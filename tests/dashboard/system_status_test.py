@@ -57,6 +57,65 @@ class SystemStatusTest(AsyncHTTPTestCase):
         assert isinstance(svc["source_metrics"], dict)
         assert "instrument" in svc
 
+    def test_state_lists_ui_sessions(self):
+        # A /api/session poll registers the session; /api/state then
+        # lists it for the System tab (reference session_status_widget).
+        poll = json.loads(self.fetch("/api/session").body)
+        sid = poll["session_id"]
+        sessions = self.state()["sessions"]
+        mine = next(s for s in sessions if s["session_id"] == sid)
+        assert mine["idle_s"] >= 0.0
+        assert "config_generation_seen" in mine
+
+    def test_operator_log_production_end_to_end(self):
+        """POST /api/logdata publishes an f144 sample that the real
+        timeseries service consumes: the started log job's output
+        reflects the operator's value (reference log_producer_widget)."""
+        state = self.state()
+        assert "motor_x" in state["log_streams"]
+        wid = next(
+            w["workflow_id"]
+            for w in state["workflows"]
+            if "timeseries" in w["workflow_id"]
+        )
+        for path, payload in (
+            ("/api/workflow/stage", {"workflow_id": wid, "source_name": "motor_x", "params": {}}),
+            ("/api/workflow/commit", {"workflow_id": wid, "source_name": "motor_x", "params": {}}),
+        ):
+            r = self.fetch(path, method="POST", body=json.dumps(payload))
+            assert r.code == 200, r.body
+        r = self.fetch(
+            "/api/logdata",
+            method="POST",
+            body=json.dumps({"stream": "motor_x", "value": 42.5}),
+        )
+        assert r.code == 200, r.body
+        time.sleep(0.1)
+        self.drive(15)
+        keys = self.state()["keys"]
+        kid = next(
+            (k["id"] for k in keys if k["source"] == "motor_x"), None
+        )
+        assert kid is not None, f"no timeseries output: {keys}"
+        data = json.loads(self.fetch(f"/data/{kid}.json").body)
+        values = data["values"]
+        flat = values if isinstance(values, list) else [values]
+        assert 42.5 in flat, flat
+
+    def test_logdata_validation(self):
+        for payload, code in (
+            ({}, 400),
+            ({"stream": "motor_x"}, 400),
+            ({"stream": "motor_x", "value": "x"}, 400),
+            # bool is an int subclass: must 400, never publish 1.0.
+            ({"stream": "motor_x", "value": True}, 400),
+            ({"stream": "nope", "value": 1.0}, 404),
+        ):
+            r = self.fetch(
+                "/api/logdata", method="POST", body=json.dumps(payload)
+            )
+            assert r.code == code, (payload, r.code)
+
     def test_bulk_stop(self):
         self.start_job("panel_0")
         jobs = self.state()["jobs"]
