@@ -73,6 +73,31 @@ class TestIngestBench:
         assert total > 0
         assert rate > 1e6
 
+    def test_partition_throughput(self):
+        """The pallas2d ingest stage: fused native flatten+partition
+        must beat the numpy fallback and stay within an order of the
+        plain flatten (PERF.md round 5)."""
+        from esslivedata_tpu.ops import EventHistogrammer
+
+        h = EventHistogrammer(
+            toa_edges=np.linspace(0, 7.1e7, 101),
+            n_screen=1 << 20,
+            method="pallas2d",
+        )
+        rng = np.random.default_rng(0)
+        pid = rng.integers(0, 1 << 20, 1_000_000).astype(np.int32)
+        toa = rng.uniform(0, 7.1e7, 1_000_000).astype(np.float32)
+        h.flatten_partition_host(pid, toa)
+        t0 = time.perf_counter()
+        reps = 5
+        for _ in range(reps):
+            events, chunk_map = h.flatten_partition_host(pid, toa)
+        rate = _rate(
+            "flatten+partition", 1_000_000 * reps, time.perf_counter() - t0
+        )
+        assert events.shape[0] == chunk_map.shape[0] * 512
+        assert rate > 2e6  # generous floor: shared CI hosts vary widely
+
 
 class TestDashboardBench:
     def test_data_service_put_notify(self):
