@@ -33,6 +33,14 @@ def main(argv: list[str] | None = None) -> int:
         help="file-backed broker root (required with --transport file)",
     )
     parser.add_argument("--events-per-pulse", type=int, default=2000)
+    # Reference parity: dashboard.py auto_start (demo/UI-test launches).
+    parser.add_argument(
+        "--auto-start",
+        action="store_true",
+        help="Commit every registered workflow on its first source with "
+        "default params at launch (fake transport only): plots come to "
+        "life with zero UI interaction — demo/screenshot/UI-test runs",
+    )
     parser.add_argument(
         "--config-dir",
         default="",
@@ -51,6 +59,14 @@ def main(argv: list[str] | None = None) -> int:
         parser.error(
             f"Unknown instrument {args.instrument!r}; "
             f"known: {', '.join(instrument_registry.names())}"
+        )
+    if args.auto_start and args.transport != "fake":
+        # Reference guard (dashboard.py:48): with real transports
+        # auto-start would issue real start commands (or strand PENDING
+        # jobs with no backend).
+        parser.error(
+            "--auto-start requires --transport fake; with other "
+            "transports it would issue real start commands"
         )
     instrument_registry[args.instrument].load_factories()
 
@@ -93,6 +109,8 @@ def main(argv: list[str] | None = None) -> int:
 
     async def serve() -> None:
         services.start()
+        if args.auto_start:
+            auto_start_workflows(services, args.instrument)
         app.listen(args.port)
         logger.info("Dashboard listening on http://localhost:%d", args.port)
         try:
@@ -105,6 +123,22 @@ def main(argv: list[str] | None = None) -> int:
     except KeyboardInterrupt:
         pass
     return 0
+
+
+def auto_start_workflows(services: DashboardServices, instrument: str) -> None:
+    """Commit every registered workflow on its first source with default
+    params — the demo/UI-test launch mode (reference
+    dashboard.py:_auto_start_workflows drives the same commit path the
+    play button does)."""
+    orchestrator = services.orchestrator
+    for spec in orchestrator.available_workflows(instrument):
+        if not spec.source_names:
+            continue
+        try:
+            orchestrator.start(spec.identifier, spec.source_names[0])
+            logger.info("auto-started %s @ %s", spec.identifier, spec.source_names[0])
+        except Exception:
+            logger.exception("auto-start failed for %s", spec.identifier)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,49 @@
+"""--auto-start launch mode (reference auto_start_test.py +
+dashboard.py:_auto_start_workflows): fake-transport-only guard, and
+every registered workflow committed on launch."""
+
+import pytest
+
+
+class TestAutoStartGuard:
+    @pytest.mark.parametrize("transport_args", [
+        ["--transport", "file", "--broker-dir", "/tmp/nope"],
+        ["--transport", "kafka"],
+    ])
+    def test_requires_fake_transport(self, transport_args, capsys):
+        from esslivedata_tpu.dashboard.reduction import main
+
+        # The guard fires before any transport/broker is contacted, via
+        # parser.error (usage message + exit code 2, like the sibling
+        # CLI validations).
+        with pytest.raises(SystemExit) as exc:
+            main(["--instrument", "dummy", "--auto-start", *transport_args])
+        assert exc.value.code == 2
+        assert "auto-start requires" in capsys.readouterr().err
+
+
+class TestAutoStartCommits:
+    def test_every_workflow_committed(self):
+        from esslivedata_tpu.config.instrument import instrument_registry
+        from esslivedata_tpu.dashboard.dashboard_services import (
+            DashboardServices,
+        )
+        from esslivedata_tpu.dashboard.fake_backend import (
+            InProcessBackendTransport,
+        )
+        from esslivedata_tpu.dashboard.reduction import auto_start_workflows
+
+        instrument_registry["dummy"].load_factories()
+        transport = InProcessBackendTransport("dummy", events_per_pulse=10)
+        services = DashboardServices(transport=transport)
+        auto_start_workflows(services, "dummy")
+        for _ in range(10):
+            transport.tick()
+            services.pump.pump_once()
+        started = {j.source_name for j in services.job_service.jobs()}
+        specs = services.orchestrator.available_workflows("dummy")
+        expected = {s.source_names[0] for s in specs if s.source_names}
+        assert expected <= started, (expected, started)
+        # Active configs recorded for each auto-started workflow.
+        active = services.orchestrator.active_configs()
+        assert len(active) == len([s for s in specs if s.source_names])
