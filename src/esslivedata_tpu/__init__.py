@@ -24,3 +24,20 @@ Package layout (bottom to top, mirroring SURVEY.md section 1's layer map):
 """
 
 __version__ = "0.1.0"
+
+import re as _re
+
+_DEV_VERSION_RE = _re.compile(
+    r"^(?P<base>\d+(?:\.\d+)*)\.dev\d+\+g(?P<hash>[0-9a-f]+)(?:\..*)?$"
+)
+
+
+def format_version(version: str) -> str:
+    """Version string for display, shortening dev versions (reference
+    __init__.py format_version): releases pass through; a setuptools-scm
+    dev version like ``0.2.0.dev3+gabcdef012.d20260101`` renders as
+    ``0.2.0-dev (abcdef01)``."""
+    m = _DEV_VERSION_RE.match(version)
+    if m is None:
+        return version
+    return f"{m['base']}-dev ({m['hash'][:8]})"

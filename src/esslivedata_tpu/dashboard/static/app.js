@@ -1154,7 +1154,9 @@ async function pollSession() {
 async function refresh() {
   const r = await fetch('/api/state'); const s = await r.json();
   lastState = s;
-  document.getElementById('meta').textContent = 'generation ' + s.generation;
+  document.getElementById('meta').textContent =
+    (s.version ? 'v' + s.version + ' · ' : '') +
+    'generation ' + s.generation;
   const wf = document.getElementById('workflows');
   // Re-render when the workflow/source set changes (fingerprint, not
   // count: a same-count replacement must refresh captured schemas too).

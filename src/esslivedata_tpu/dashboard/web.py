@@ -271,9 +271,12 @@ class StateHandler(_Base):
             }
             for k in ds.keys()
         ]
+        from .. import __version__, format_version
+
         self.write_json(
             {
                 "generation": ds.generation,
+                "version": format_version(__version__),
                 "keys": keys,
                 "services": [
                     {
