@@ -6,11 +6,10 @@ histograms with different bin edges is scientifically wrong)."""
 
 import numpy as np
 import pytest
-from hypothesis import given, settings
+from hypothesis import assume, given, settings
 from hypothesis import strategies as st
 
 from esslivedata_tpu.utils import DataArray, Variable, linspace
-from esslivedata_tpu.utils.units import UnitError
 
 DIMS = ("x", "y", "z")
 
@@ -58,7 +57,7 @@ class TestVariableLaws:
         right = b + a
         # Dim ORDER is self-first by contract; the sets and totals agree.
         assert set(left.dims) == set(right.dims)
-        assert left.sizes == {d: n for d, n in right.sizes.items()}
+        assert left.sizes == right.sizes
         np.testing.assert_allclose(
             left.transpose(right.dims).numpy, right.numpy
         )
@@ -73,10 +72,9 @@ class TestVariableLaws:
         assert out.sizes == want
 
     @settings(max_examples=40, deadline=None)
-    @given(variables())
+    @given(variables(max_dims=3))
     def test_transpose_roundtrip_identical(self, v):
-        if v.ndim < 2:
-            return
+        assume(v.ndim >= 2)  # visible discard, not a silent pass
         rev = tuple(reversed(v.dims))
         assert v.transpose(rev).transpose(v.dims).identical(v)
 
@@ -98,23 +96,17 @@ class TestVariableLaws:
         assert float(v.sum().value) == pytest.approx(total, rel=1e-9)
 
     @settings(max_examples=40, deadline=None)
-    @given(variables(unit="m"), variables(unit="s"))
-    def test_unit_algebra(self, a, b):
-        try:
-            prod = a * b
-            quot = a / b
-        except ValueError:
-            return  # shared-dim size mismatch: not the law under test
+    @given(aligned_pairs())
+    def test_unit_algebra(self, pair):
+        # aligned_pairs guarantees broadcastable operands: every example
+        # exercises the law (no silent discards).
         from esslivedata_tpu.utils.units import unit
 
-        assert prod.unit == unit("m") * unit("s")
-        assert quot.unit == unit("m") / unit("s")
-
-    def test_incompatible_units_raise(self):
-        a = Variable(np.ones(3), ("x",), "m")
-        b = Variable(np.ones(3), ("x",), "s")
-        with pytest.raises(UnitError):
-            a + b
+        a, b = pair
+        a = Variable(a.numpy, a.dims, "m")
+        b = Variable(b.numpy, b.dims, "s")
+        assert (a * b).unit == unit("m") * unit("s")
+        assert (a / b).unit == unit("m") / unit("s")
 
     def test_shared_dim_size_mismatch_raises(self):
         a = Variable(np.ones(3), ("x",), "counts")
@@ -139,8 +131,7 @@ class TestVariableLaws:
     @settings(max_examples=30, deadline=None)
     @given(variables())
     def test_slice_matches_numpy(self, v):
-        if not v.ndim:
-            return
+        assume(v.ndim)  # visible discard, not a silent pass
         d = v.dims[0]
         s = v[d, 1:]
         np.testing.assert_array_equal(s.numpy, v.numpy[1:])
