@@ -119,6 +119,17 @@ class TestEndToEndReduction:
 
             # Crash the service (SIGKILL: no finalize, state loss by design).
             backend.kill(detector, hard=True)
+            # Heartbeats stop: the dashboard flags the service STALE
+            # within LIVEDATA_SERVICE_STALE_S (reference
+            # service_crash_test: crashed worker -> stale flag) before
+            # the replacement arrives.
+            backend.wait_for(
+                lambda: any(
+                    s["stale"]
+                    for s in http_json(f"{base}/api/state")["services"]
+                ),
+                30,
+            )
             replacement = backend.spawn_service("detector_data")
             try:
                 # The restarted service heartbeats with no jobs; the
