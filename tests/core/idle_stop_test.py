@@ -1,0 +1,114 @@
+"""Stops complete without beam (round-5 fix): a stop commanded while no
+data flows — including before the job ever activated — must still leave
+the active set via the processor's idle empty-window sweep, and the
+sweep must stop firing once nothing is finishing."""
+
+import json
+
+import numpy as np
+
+from esslivedata_tpu.config.instruments.dummy.specs import (
+    DETECTOR_VIEW_HANDLE,
+)
+from esslivedata_tpu.config.workflow_spec import JobId, WorkflowConfig
+from esslivedata_tpu.core.message_batcher import NaiveMessageBatcher
+from esslivedata_tpu.kafka.sink import (
+    FakeProducer,
+    KafkaSink,
+    make_default_serializer,
+)
+from esslivedata_tpu.kafka.source import FakeKafkaMessage
+from esslivedata_tpu.services.detector_data import (
+    make_detector_service_builder,
+)
+from esslivedata_tpu.services.fake_sources import (
+    FakeDetectorStream,
+    PulsedRawSource,
+)
+
+COMMANDS_TOPIC = "dummy_livedata_commands"
+
+
+def _command(kind_payload: dict) -> FakeKafkaMessage:
+    return FakeKafkaMessage(json.dumps(kind_payload).encode(), COMMANDS_TOPIC)
+
+
+def _service(streams):
+    builder = make_detector_service_builder(
+        instrument="dummy", batcher=NaiveMessageBatcher(), job_threads=1
+    )
+    raw = PulsedRawSource(streams)
+    producer = FakeProducer()
+    sink = KafkaSink(
+        producer,
+        make_default_serializer(builder.stream_mapping.livedata, "t"),
+    )
+    return builder.from_raw_source(raw, sink), raw
+
+
+def _start(raw, job_id):
+    config = WorkflowConfig(
+        identifier=DETECTOR_VIEW_HANDLE.workflow_id,
+        job_id=job_id,
+        params={},
+    )
+    raw.inject(
+        _command(
+            {"kind": "start_job", "config": config.model_dump(mode="json")}
+        )
+    )
+
+
+def _stop(raw, job_id):
+    raw.inject(
+        _command(
+            {
+                "kind": "job_command",
+                "action": "stop",
+                "source_name": job_id.source_name,
+                "job_number": str(job_id.job_number),
+            }
+        )
+    )
+
+
+class TestIdleStopCompletion:
+    def test_stop_before_activation_completes_without_data(self):
+        # NO event stream at all: the job never leaves SCHEDULED.
+        service, raw = _service([])
+        jm = service.processor._job_manager
+        job_id = JobId(source_name="panel_0")
+        _start(raw, job_id)
+        service.step()
+        assert [j.state for j in jm.job_statuses()] == ["scheduled"]
+        _stop(raw, job_id)
+        service.step()  # consumes the stop -> finishing
+        service.step()  # idle sweep runs the empty window
+        states = [str(j.state) for j in jm.job_statuses()]
+        assert states == ["stopped"], states
+        # Flag stays set but nothing is finishing anymore: the sweep
+        # must not keep running empty windows forever.
+        assert not jm.has_finishing_jobs()
+
+    def test_stop_of_active_job_flushes_then_completes_when_beam_stops(self):
+        det = FakeDetectorStream(
+            topic="dummy_detector",
+            source_name="panel_a",
+            detector_ids=np.arange(1, 4096, dtype=np.int32),
+            events_per_pulse=200,
+        )
+        service, raw = _service([det])
+        jm = service.processor._job_manager
+        job_id = JobId(source_name="panel_0")
+        _start(raw, job_id)
+        for _ in range(4):
+            service.step()
+        assert [str(j.state) for j in jm.job_statuses()] == ["active"]
+        # Beam OFF (stream exhausted by replacing the source's streams),
+        # then stop: completion must not need another batch.
+        raw._streams.clear()
+        _stop(raw, job_id)
+        service.step()
+        service.step()
+        assert [str(j.state) for j in jm.job_statuses()] == ["stopped"]
+        assert not jm.has_finishing_jobs()
