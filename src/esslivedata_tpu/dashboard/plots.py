@@ -321,10 +321,27 @@ def _draw_1d(ax, x: np.ndarray, y: np.ndarray, label: str | None = None):
 
 
 class LinePlotter:
-    """1-D data: histogram steps (edge coords) or line (point coords)."""
+    """1-D data: histogram steps (edge coords) or line (point coords).
+
+    Long-running timeseries (ns-epoch ``time`` coord) are reduced to a
+    fine-recent + coarse-older display budget before drawing
+    (timeseries_downsample.py) — a day of 14 Hz samples is far past any
+    screen's resolution and matplotlib's per-point cost is real.
+    """
 
     def plot(self, ax, da: DataArray, params: PlotParams = PlotParams()) -> None:
         dim = da.dims[0]
+        if (
+            dim == "time"
+            and dim in da.coords
+            and repr(da.coords[dim].unit) == "ns"
+            # Point coords only: a ns bin-EDGE coord is a histogram, not
+            # a growing strip chart (and coord/data lengths differ).
+            and da.coords[dim].sizes[dim] == da.sizes[dim]
+        ):
+            from .timeseries_downsample import auto_downsample
+
+            da = auto_downsample(da)
         x, label = _coord_values(da, dim)
         y = np.asarray(da.values, dtype=np.float64)
         _draw_1d(ax, x, y)

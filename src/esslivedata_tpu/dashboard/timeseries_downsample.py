@@ -1,0 +1,130 @@
+"""Two-band timeseries downsampling for display (reference
+dashboard/timeseries_downsample.py, issue #940).
+
+A long-running NXlog series grows without bound; rendering every sample
+per poll tick is wasted work past screen resolution. ``downsample_
+timeseries`` reduces a series to a FINE recent band and a COARSE older
+band. Bucket boundaries are anchored at the epoch so kept samples sit on
+a stable absolute grid — consecutive renders keep the same points
+instead of shimmering as the window slides. Within each bucket the LAST
+sample wins (the very latest sample is always present: it is the live
+reading).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.labeled import DataArray, Variable
+
+__all__ = ["auto_downsample", "downsample_timeseries"]
+
+
+def _last_per_bucket(times_ns: np.ndarray, period_ns: int) -> np.ndarray:
+    """Boolean keep-mask: the last sample of each epoch-anchored bucket."""
+    if period_ns <= 0 or times_ns.size == 0:
+        return np.ones(times_ns.shape, dtype=bool)
+    buckets = times_ns // period_ns
+    return np.r_[buckets[1:] != buckets[:-1], True]
+
+
+def downsample_timeseries(
+    da: DataArray,
+    *,
+    fine_period_s: float,
+    recent_s: float,
+    coarse_period_s: float,
+    dim: str = "time",
+) -> DataArray:
+    """Fine recent band + coarse older band, epoch-anchored buckets.
+
+    The recent-band cutoff is quantized DOWN to the coarse grid, so the
+    actual recent length is between ``recent_s`` and ``recent_s +
+    coarse_period_s``. ``coarse_period_s == 0`` drops older data
+    entirely and quantizes the cutoff to the fine grid instead.
+    Time coords are int64 ns epoch (the NXlog accumulator's layout).
+    """
+    if fine_period_s <= 0:
+        raise ValueError("fine_period_s must be > 0")
+    if coarse_period_s < 0:
+        raise ValueError("coarse_period_s must be >= 0")
+    times = np.asarray(da.coords[dim].numpy, dtype=np.int64)
+    n = times.shape[0]
+    if n == 0:
+        return da
+    if n != da.sizes.get(dim):
+        raise ValueError(
+            "downsample_timeseries needs a point time coord (one sample "
+            f"per value); got {n} coord entries for {da.sizes.get(dim)} "
+            "values (bin edges?)"
+        )
+    fine_ns = max(int(fine_period_s * 1e9), 1)
+    coarse_ns = int(coarse_period_s * 1e9)
+    if coarse_period_s > 0 and coarse_ns == 0:
+        # A sub-ns coarse period would silently flip into the
+        # drop-older mode; reject it instead.
+        raise ValueError("coarse_period_s must be 0 or >= 1 ns")
+    latest = int(times[-1])
+    cutoff = latest - int(recent_s * 1e9)
+    grid = coarse_ns if coarse_ns > 0 else fine_ns
+    cutoff = (cutoff // grid) * grid  # quantize to a stable boundary
+
+    recent = times >= cutoff
+    keep = np.zeros(n, dtype=bool)
+    keep[recent] = _last_per_bucket(times[recent], fine_ns)
+    if coarse_ns > 0:
+        keep[~recent] = _last_per_bucket(times[~recent], coarse_ns)
+    keep[-1] = True  # the live reading always survives
+
+    idx = np.nonzero(keep)[0]
+    data = Variable(
+        np.asarray(da.values)[idx], da.data.dims, da.data.unit
+    )
+
+    def _filtered(v: Variable) -> Variable:
+        if dim not in v.dims:
+            return v
+        return Variable(np.asarray(v.numpy)[idx], v.dims, v.unit)
+
+    return DataArray(
+        data,
+        coords={name: _filtered(c) for name, c in da.coords.items()},
+        masks={name: _filtered(m) for name, m in da.masks.items()},
+        name=da.name,
+    )
+
+
+#: Above this many samples a 1-D time-series render is past any screen's
+#: resolution; the plotter downsamples to roughly this budget.
+MAX_TIMESERIES_POINTS = 4000
+
+
+def auto_downsample(
+    da: DataArray, *, max_points: int = MAX_TIMESERIES_POINTS, dim: str = "time"
+) -> DataArray:
+    """Display-budget policy over :func:`downsample_timeseries`.
+
+    Series at or under ``max_points`` pass through untouched. Oversized
+    series keep the most recent quarter of the span at fine resolution
+    (~3/4 of the budget) and the older span coarse (~1/4 of the budget)
+    — the operator's eye lives at the right edge of a strip chart.
+    """
+    times = np.asarray(da.coords[dim].numpy, dtype=np.int64)
+    n = times.shape[0]
+    if n <= max_points:
+        return da
+    span_s = max((int(times[-1]) - int(times[0])) / 1e9, 1e-9)
+    recent_s = span_s / 4.0
+    # 10% headroom: the quantized cutoff extends the fine band by up to
+    # one coarse period, so aim below the budget to land within it.
+    # Floor 4: both band divisors below must stay nonzero.
+    budget = max(int(max_points * 0.9), 4)
+    fine_period_s = max(recent_s / (budget * 3 // 4), 1e-9)
+    coarse_period_s = max((span_s - recent_s) / (budget // 4), 1e-9)
+    return downsample_timeseries(
+        da,
+        fine_period_s=fine_period_s,
+        recent_s=recent_s,
+        coarse_period_s=coarse_period_s,
+        dim=dim,
+    )

@@ -1,0 +1,218 @@
+"""Two-band timeseries downsampling (reference
+timeseries_downsample_test.py, issue #940): epoch-anchored stable grids,
+last-sample-per-bucket, quantized recent cutoff, coarse=0 drop mode, and
+the auto display-budget policy the line plotter applies."""
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.dashboard.timeseries_downsample import (
+    MAX_TIMESERIES_POINTS,
+    auto_downsample,
+    downsample_timeseries,
+)
+from esslivedata_tpu.utils.labeled import DataArray, Variable
+
+
+def series(n: int, period_s: float = 1.0, t0_s: float = 0.0) -> DataArray:
+    times = (np.arange(n) * period_s + t0_s) * 1e9
+    return DataArray(
+        Variable(np.arange(n, dtype=np.float64), ("time",), "K"),
+        coords={"time": Variable(times.astype(np.int64), ("time",), "ns")},
+        name="temperature",
+    )
+
+
+def times_s(da: DataArray) -> np.ndarray:
+    return np.asarray(da.coords["time"].numpy) / 1e9
+
+
+class TestDownsampleTimeseries:
+    def test_short_series_fully_kept_when_periods_fine(self):
+        da = series(10)
+        out = downsample_timeseries(
+            da, fine_period_s=0.5, recent_s=100.0, coarse_period_s=10.0
+        )
+        assert out.sizes["time"] == 10
+
+    def test_latest_sample_always_present(self):
+        da = series(100)
+        out = downsample_timeseries(
+            da, fine_period_s=7.0, recent_s=20.0, coarse_period_s=13.0
+        )
+        assert times_s(out)[-1] == times_s(da)[-1]
+        assert np.asarray(out.values)[-1] == np.asarray(da.values)[-1]
+
+    def test_last_sample_of_each_coarse_bucket_kept(self):
+        # 1 Hz samples, 10 s coarse buckets, recent_s=0 (the quantized
+        # cutoff still leaves the final partial coarse period fine): the
+        # OLDER band keeps the bucket maxima t = 9, 19, ... on the
+        # absolute epoch grid, values matching their times.
+        da = series(100)
+        out = downsample_timeseries(
+            da, fine_period_s=1.0, recent_s=0.0, coarse_period_s=10.0
+        )
+        kept = times_s(out)
+        older = kept[kept < 90.0]  # cutoff = 99 quantized down to 90
+        np.testing.assert_array_equal(older, np.arange(9.0, 90.0, 10.0))
+        np.testing.assert_array_equal(np.asarray(out.values), kept)
+
+    def test_coarse_grid_is_epoch_anchored_and_stable(self):
+        # Appending samples must not move previously kept COARSE points:
+        # bucket boundaries are absolute, not window-relative. (Points
+        # in the earlier render's fine band legitimately coarsen later.)
+        da1 = series(100)
+        da2 = series(130)
+        kw = dict(fine_period_s=1.0, recent_s=0.0, coarse_period_s=10.0)
+        t1 = times_s(downsample_timeseries(da1, **kw))
+        t2 = times_s(downsample_timeseries(da2, **kw))
+        coarse1 = set(t1[t1 < 90.0])  # da1's quantized cutoff
+        assert coarse1 <= set(t2)
+
+    def test_recent_band_stays_fine(self):
+        # 10 Hz for 100 s; the recent band (cutoff QUANTIZED to the
+        # coarse grid: 99.9 - 20 -> 70.0) keeps full 10 Hz resolution
+        # while the older span coarsens to 10 s buckets.
+        da = series(1000, period_s=0.1)
+        out = downsample_timeseries(
+            da, fine_period_s=0.1, recent_s=20.0, coarse_period_s=10.0
+        )
+        t = times_s(out)
+        recent = t[t >= 70.0]
+        older = t[t < 70.0]
+        assert recent.size >= 295  # ~30 s at 10 Hz after quantization
+        assert older.size <= 7  # ~70 s at one sample per 10 s
+
+    def test_recent_cutoff_quantized_to_coarse_grid(self):
+        # Actual recent length lands in [recent, recent + coarse]:
+        # latest 199, recent 33 -> raw cutoff 166, quantized to 160.
+        da = series(200)
+        out = downsample_timeseries(
+            da, fine_period_s=1.0, recent_s=33.0, coarse_period_s=10.0
+        )
+        t = times_s(out)
+        assert set(np.arange(160.0, 200.0)) <= set(t)  # fine from 160
+        assert 159.0 in t and 158.0 not in t  # coarse below the cutoff
+
+    def test_coarse_zero_drops_older(self):
+        da = series(100)
+        out = downsample_timeseries(
+            da, fine_period_s=1.0, recent_s=10.0, coarse_period_s=0.0
+        )
+        t = times_s(out)
+        assert t.min() >= 99.0 - 10.0 - 1.0
+        assert t[-1] == 99.0
+
+    def test_extra_dims_preserved(self):
+        n = 50
+        da = DataArray(
+            Variable(
+                np.arange(n * 3, dtype=np.float64).reshape(n, 3),
+                ("time", "dim_1"),
+                "K",
+            ),
+            coords={
+                "time": Variable(
+                    (np.arange(n) * 1e9).astype(np.int64), ("time",), "ns"
+                )
+            },
+        )
+        out = downsample_timeseries(
+            da, fine_period_s=1.0, recent_s=0.0, coarse_period_s=10.0
+        )
+        assert out.dims == ("time", "dim_1")
+        assert out.sizes["dim_1"] == 3
+
+    def test_masks_filtered_alongside_data(self):
+        da = series(100)
+        da = DataArray(
+            da.data,
+            coords=dict(da.coords),
+            masks={
+                "bad": Variable(
+                    np.arange(100) % 7 == 0, ("time",), None
+                )
+            },
+        )
+        out = downsample_timeseries(
+            da, fine_period_s=1.0, recent_s=0.0, coarse_period_s=10.0
+        )
+        assert "bad" in out.masks
+        kept = times_s(out).astype(int)
+        np.testing.assert_array_equal(
+            np.asarray(out.masks["bad"].numpy), kept % 7 == 0
+        )
+
+    def test_invalid_periods_rejected(self):
+        da = series(10)
+        with pytest.raises(ValueError):
+            downsample_timeseries(
+                da, fine_period_s=0.0, recent_s=1.0, coarse_period_s=1.0
+            )
+        with pytest.raises(ValueError):
+            downsample_timeseries(
+                da, fine_period_s=1.0, recent_s=1.0, coarse_period_s=-1.0
+            )
+        # Sub-ns coarse period would silently become drop-older mode.
+        with pytest.raises(ValueError, match="1 ns"):
+            downsample_timeseries(
+                da, fine_period_s=1.0, recent_s=1.0, coarse_period_s=5e-10
+            )
+
+    def test_edge_coord_rejected(self):
+        da = DataArray(
+            Variable(np.ones(5), ("time",), "counts"),
+            coords={
+                "time": Variable(
+                    np.arange(6, dtype=np.int64), ("time",), "ns"
+                )
+            },
+        )
+        with pytest.raises(ValueError, match="point time coord"):
+            downsample_timeseries(
+                da, fine_period_s=1.0, recent_s=1.0, coarse_period_s=1.0
+            )
+
+
+class TestAutoDownsample:
+    def test_small_series_untouched(self):
+        da = series(100)
+        assert auto_downsample(da) is da
+
+    def test_oversized_series_bounded(self):
+        da = series(50_000, period_s=0.071)  # ~1 h at 14 Hz
+        out = auto_downsample(da)
+        assert out.sizes["time"] <= MAX_TIMESERIES_POINTS
+        # The latest reading survives and ordering holds.
+        t = times_s(out)
+        assert t[-1] == times_s(da)[-1]
+        assert np.all(np.diff(t) > 0)
+
+    def test_tiny_max_points_does_not_crash(self):
+        da = series(10)
+        out = auto_downsample(da, max_points=3)
+        assert out.sizes["time"] <= 10
+
+    def test_line_plotter_applies_budget(self):
+        from esslivedata_tpu.dashboard.plots import render_png
+
+        da = series(30_000, period_s=0.071)
+        png = render_png(da, title="long log")
+        assert png[:4] == b"\x89PNG"
+
+    def test_line_plotter_skips_non_strip_charts(self):
+        from esslivedata_tpu.dashboard.plots import render_png
+
+        # time dim WITHOUT a time coord: _coord_values' arange fallback.
+        bare = DataArray(Variable(np.arange(5.0), ("time",), "K"))
+        assert render_png(bare)[:4] == b"\x89PNG"
+        # ns bin-EDGE time coord: a histogram, drawn as steps untouched.
+        hist = DataArray(
+            Variable(np.ones(5), ("time",), "counts"),
+            coords={
+                "time": Variable(
+                    np.arange(6, dtype=np.int64), ("time",), "ns"
+                )
+            },
+        )
+        assert render_png(hist)[:4] == b"\x89PNG"
