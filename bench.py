@@ -90,6 +90,12 @@ def bench_numpy_baseline(
 
 
 def bench_secondary_configs(args, edges, batches, method: str) -> None:
+    # pallas2d tuning knobs apply to EVERY histogrammer built with the
+    # swept method — otherwise a sweep silently measures defaults.
+    p2 = {
+        "pallas2d_budget": args.pallas2d_budget,
+        "pallas2d_chunk": args.pallas2d_chunk,
+    }
     """BASELINE configs 1/3/4/5 (config 2 is the headline measurement).
 
     1: dummy 1-D TOF monitor histogram; 3: 9-bank multibank (sharded when
@@ -137,7 +143,7 @@ def bench_secondary_configs(args, edges, batches, method: str) -> None:
     edges_1d = np.linspace(0.0, 71_000_000.0, 1001)
     timed(
         "config1_monitor_1d_tof_histogram",
-        EventHistogrammer(toa_edges=edges_1d, n_screen=1, method=method),
+        EventHistogrammer(toa_edges=edges_1d, n_screen=1, method=method, **p2),
     )
     # The VMEM-sized bin space is where the pallas one-hot kernel can
     # beat the serial scatter: measure it alongside for the record
@@ -208,7 +214,11 @@ def bench_secondary_configs(args, edges, batches, method: str) -> None:
                 lambda s, f: h_sc._step_flat(s, f),
             )
             h_p2 = EventHistogrammer(
-                toa_edges=edges, n_screen=args.pixels, method="pallas2d"
+                toa_edges=edges,
+                n_screen=args.pixels,
+                method="pallas2d",
+                pallas2d_budget=args.pallas2d_budget,
+                pallas2d_chunk=args.pallas2d_chunk,
             )
             parts = []
             for b in batches:
@@ -348,7 +358,7 @@ def bench_secondary_configs(args, edges, batches, method: str) -> None:
     timed(
         "config4_monitor_normalized",
         EventHistogrammer(
-            toa_edges=edges, n_screen=args.pixels, method=method
+            toa_edges=edges, n_screen=args.pixels, method=method, **p2
         ),
         post=lambda s: s.window / monitor_total,
     )
@@ -357,7 +367,7 @@ def bench_secondary_configs(args, edges, batches, method: str) -> None:
     timed(
         "config5_decay_window",
         EventHistogrammer(
-            toa_edges=edges, n_screen=args.pixels, decay=0.95, method=method
+            toa_edges=edges, n_screen=args.pixels, decay=0.95, method=method, **p2
         ),
     )
 
@@ -550,7 +560,11 @@ def run_benchmark(args, platform: str) -> dict:
     def calibrate(method: str) -> float:
         """Short timed run; returns events/s for one method."""
         h = EventHistogrammer(
-            toa_edges=edges, n_screen=args.pixels, method=method
+            toa_edges=edges,
+            n_screen=args.pixels,
+            method=method,
+            pallas2d_budget=args.pallas2d_budget,
+            pallas2d_chunk=args.pallas2d_chunk,
         )
         step = make_step(h)
         s = h.init_state()
@@ -588,7 +602,11 @@ def run_benchmark(args, platform: str) -> dict:
             )
 
     hist = EventHistogrammer(
-        toa_edges=edges, n_screen=args.pixels, method=method
+        toa_edges=edges,
+        n_screen=args.pixels,
+        method=method,
+        pallas2d_budget=args.pallas2d_budget,
+        pallas2d_chunk=args.pallas2d_chunk,
     )
     step_fn = make_step(hist)
     state = hist.init_state()
@@ -945,6 +963,12 @@ def _parse_args():
     parser.add_argument("--batches", type=int, default=None)
     parser.add_argument("--pixels", type=int, default=1_500_000)  # LOKI scale
     parser.add_argument("--toa-bins", type=int, default=100)
+    # pallas2d hardware-tuning knobs: block-size budget (bins/VMEM tile)
+    # and events per grid step. Sweep on real TPU, e.g.
+    #   for b in 32768 65536 131072; do
+    #     python bench.py --method pallas2d --pallas2d-budget $b; done
+    parser.add_argument("--pallas2d-budget", type=int, default=None)
+    parser.add_argument("--pallas2d-chunk", type=int, default=None)
     parser.add_argument(
         "--method",
         default="scatter",

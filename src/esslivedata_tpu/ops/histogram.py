@@ -284,6 +284,8 @@ class EventHistogrammer:
         decay: float | None = None,
         method: str = "scatter",
         dtype=jnp.float32,
+        pallas2d_budget: int | None = None,
+        pallas2d_chunk: int | None = None,
     ) -> None:
         if method not in ("scatter", "sort", "pallas", "pallas2d"):
             raise ValueError(f"Unknown method {method!r}")
@@ -326,14 +328,34 @@ class EventHistogrammer:
             # native ingest derives the block from the screen pixel with
             # one shift. Falls back to generic power-of-two blocks when
             # no 2**k * n_toa fits the VMEM budget as a lane multiple.
+            # ``pallas2d_budget``/``pallas2d_chunk`` are hardware-tuning
+            # knobs (bench.py --pallas2d-budget/--pallas2d-chunk): block
+            # size trades MXU FLOPs/event against partition padding and
+            # grid-step count.
+            from .pallas_hist2d import DEFAULT_CHUNK
+
+            budget = pallas2d_budget or DEFAULT_BPB
+            self._p2_chunk = (
+                DEFAULT_CHUNK if pallas2d_chunk is None else pallas2d_chunk
+            )
+            if self._p2_chunk <= 0 or self._p2_chunk % 128:
+                raise ValueError(
+                    "pallas2d_chunk must be a positive multiple of 128 "
+                    "(the event-row block's lane dimension)"
+                )
             for k in range(16, -1, -1):
                 bpb = (1 << k) * self._n_toa
-                if bpb <= DEFAULT_BPB and bpb % 128 == 0:
+                if bpb <= budget and bpb % 128 == 0:
                     self._ppb_shift = k
                     self._bpb = bpb
                     break
             if self._ppb_shift is None:
-                self._bpb = DEFAULT_BPB
+                self._bpb = budget
+                if self._bpb % 128 or (self._bpb & (self._bpb - 1)):
+                    raise ValueError(
+                        "pallas2d_budget must be a power-of-two multiple "
+                        "of 128 when no pixel-aligned block fits"
+                    )
             self._n_state = padded_bins(self._n_bins + 1, self._bpb)
             self._step_part = jax.jit(
                 self._step_part_impl, donate_argnums=(0,)
@@ -658,7 +680,6 @@ class EventHistogrammer:
         ``flatten_host`` + ``partition_events_host``.
         """
         from .pallas_hist2d import (
-            DEFAULT_CHUNK,
             bucketed_chunks,
             chunk_capacity,
             partition_events_host,
@@ -671,7 +692,7 @@ class EventHistogrammer:
                 flatten_partition = None
             if flatten_partition is not None:
                 pixel_id = sanitize_pixel_id(pixel_id)
-                chunk = DEFAULT_CHUNK
+                chunk = self._p2_chunk
                 n_blocks = self._n_state // self._bpb
                 cap = chunk_capacity(pixel_id.shape[0], n_blocks, chunk)
                 lut_host = self._proj.lut_host
@@ -694,7 +715,7 @@ class EventHistogrammer:
                     return events[: n_padded * chunk], chunk_map[:n_padded]
         flat = self.flatten_host(pixel_id, toa)
         return partition_events_host(
-            flat, self._n_bins + 1, bpb=self._bpb
+            flat, self._n_bins + 1, bpb=self._bpb, chunk=self._p2_chunk
         )
 
     def step_flat(self, state: HistogramState, flat) -> HistogramState:
@@ -709,7 +730,10 @@ class EventHistogrammer:
             from .pallas_hist2d import partition_events_host
 
             events, chunk_map = partition_events_host(
-                np.asarray(flat), self._n_bins + 1, bpb=self._bpb
+                np.asarray(flat),
+                self._n_bins + 1,
+                bpb=self._bpb,
+                chunk=self._p2_chunk,
             )
             return self._step_part(
                 state, dispatch_safe(events), dispatch_safe(chunk_map)

@@ -244,6 +244,47 @@ class TestHistogrammerPallas2d:
                 method="pallas2d",
             )
 
+    @pytest.mark.parametrize(
+        ("budget", "chunk"), [(32768, 256), (16384, 1024)]
+    )
+    def test_tuning_knobs_keep_parity(self, budget, chunk):
+        # The hardware-tuning knobs (bench --pallas2d-budget/-chunk)
+        # change layout only, never counts.
+        n_screen = 700
+        batches = self._batches(n_screen)
+        hs, ss = self._run("scatter", batches, n_screen=n_screen)
+        hp = EventHistogrammer(
+            toa_edges=np.linspace(0.0, 71.0, 101),
+            n_screen=n_screen,
+            method="pallas2d",
+            pallas2d_budget=budget,
+            pallas2d_chunk=chunk,
+        )
+        assert hp._bpb <= budget
+        sp = hp.init_state()
+        for b in batches:
+            sp = hp.step_batch(sp, b)
+        np.testing.assert_allclose(hs.read(ss)[0], hp.read(sp)[0])
+
+    def test_invalid_tuning_knobs_rejected(self):
+        edges = np.linspace(0.0, 71.0, 101)  # n_toa=100
+        # budget 96: no 2**k * 100 fits and 96 is not a 128 multiple.
+        with pytest.raises(ValueError, match="power-of-two"):
+            EventHistogrammer(
+                toa_edges=edges,
+                n_screen=16,
+                method="pallas2d",
+                pallas2d_budget=96,
+            )
+        for chunk in (0, -100, 200):
+            with pytest.raises(ValueError, match="multiple of 128"):
+                EventHistogrammer(
+                    toa_edges=edges,
+                    n_screen=16,
+                    method="pallas2d",
+                    pallas2d_chunk=chunk,
+                )
+
     def test_nonuniform_edges(self):
         # Non-uniform edges skip the fused native pass but keep parity.
         edges = np.concatenate([[0.0], np.cumsum(np.linspace(0.5, 2.0, 50))])
