@@ -1,0 +1,122 @@
+"""DeviceExtractor (ADR 0006, reference tests/core/nicos_devices_test.py):
+contracted outputs ride the stable-identity NICOS stream with the
+result's timestamp and generation-detecting start_time coord; everything
+else stays off it."""
+
+import logging
+import uuid
+
+import numpy as np
+
+from esslivedata_tpu.config.device_contract import (
+    DeviceContract,
+    DeviceContractEntry,
+)
+from esslivedata_tpu.config.workflow_spec import JobId, WorkflowId
+from esslivedata_tpu.core.job import JobResult
+from esslivedata_tpu.core.message import StreamKind
+from esslivedata_tpu.core.nicos_devices import DeviceExtractor
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.utils.labeled import DataArray, Variable
+
+WID = WorkflowId.parse("dummy/monitor_data/histogram/v1")
+
+
+def _contract(**over) -> DeviceContract:
+    row = {
+        "workflow_id": str(WID),
+        "source_name": "monitor_1",
+        "output_name": "counts_cumulative",
+        "device_name": "mon1_counts",
+    }
+    row.update(over)
+    return DeviceContract([DeviceContractEntry(**row)])
+
+
+def _result(outputs=None, source="monitor_1", start_ns=1_000) -> JobResult:
+    if outputs is None:
+        outputs = {
+            "counts_cumulative": _da(42.0, start_ns),
+            "uncontracted": _da(7.0, start_ns),
+        }
+    return JobResult(
+        job_id=JobId(source_name=source, job_number=uuid.uuid4()),
+        workflow_id=WID,
+        outputs=outputs,
+        start=Timestamp.from_ns(start_ns),
+        end=Timestamp.from_ns(start_ns + 10),
+    )
+
+
+def _da(value: float, start_ns: int) -> DataArray:
+    return DataArray(
+        Variable(np.asarray(value), (), "counts"),
+        coords={
+            "start_time": Variable(np.asarray(float(start_ns)), (), "ns")
+        },
+    )
+
+
+class TestDeviceExtractor:
+    def test_extracts_only_contracted_output(self):
+        out = DeviceExtractor(device_contract=_contract()).extract(
+            [_result()]
+        )
+        assert len(out) == 1
+        msg = out[0]
+        assert msg.stream.kind == StreamKind.LIVEDATA_NICOS_DATA
+        assert msg.stream.name == "mon1_counts"
+        assert float(np.asarray(msg.value.values)) == 42.0
+
+    def test_device_name_carries_no_job_number(self):
+        # Two runs of the same (workflow, source) map to the SAME device
+        # identity — that is the point of the contract.
+        ex = DeviceExtractor(device_contract=_contract())
+        names = {
+            ex.extract([_result()])[0].stream.name for _ in range(2)
+        }
+        assert names == {"mon1_counts"}
+
+    def test_extraction_uses_result_timestamp(self):
+        out = DeviceExtractor(device_contract=_contract()).extract(
+            [_result(start_ns=123_456)]
+        )
+        assert out[0].timestamp == Timestamp.from_ns(123_456)
+
+    def test_start_time_coord_rides_along(self):
+        # The generation change-detector: NICOS tells a post-reset zero
+        # from a genuine low reading by the start_time flip.
+        out = DeviceExtractor(device_contract=_contract()).extract(
+            [_result(start_ns=999)]
+        )
+        assert float(out[0].value.coords["start_time"].numpy) == 999.0
+
+    def test_result_without_contracted_output_skipped(self):
+        result = _result(outputs={"uncontracted": _da(7.0, 1)})
+        out = DeviceExtractor(device_contract=_contract()).extract([result])
+        assert out == []
+
+    def test_empty_contract_extracts_nothing(self):
+        out = DeviceExtractor(
+            device_contract=DeviceContract([])
+        ).extract([_result()])
+        assert out == []
+
+    def test_other_source_not_matched(self):
+        out = DeviceExtractor(device_contract=_contract()).extract(
+            [_result(source="monitor_2")]
+        )
+        assert out == []
+
+    def test_duplicate_device_first_wins_and_warns_once(self, caplog):
+        ex = DeviceExtractor(device_contract=_contract())
+        a, b = _result(start_ns=1), _result(start_ns=2)
+        with caplog.at_level(logging.WARNING):
+            out = ex.extract([a, b])
+            out2 = ex.extract([a, b])
+        assert len(out) == len(out2) == 1
+        assert float(out[0].value.coords["start_time"].numpy) == 1.0
+        warnings = [
+            r for r in caplog.records if "Multiple jobs" in r.message
+        ]
+        assert len(warnings) == 1  # once, not per cycle
