@@ -95,6 +95,7 @@ def bench_secondary_configs(args, edges, batches, method: str) -> None:
     p2 = {
         "pallas2d_budget": args.pallas2d_budget,
         "pallas2d_chunk": args.pallas2d_chunk,
+        "pallas2d_precision": args.pallas2d_precision,
     }
     """BASELINE configs 1/3/4/5 (config 2 is the headline measurement).
 
@@ -219,6 +220,7 @@ def bench_secondary_configs(args, edges, batches, method: str) -> None:
                 method="pallas2d",
                 pallas2d_budget=args.pallas2d_budget,
                 pallas2d_chunk=args.pallas2d_chunk,
+                pallas2d_precision=args.pallas2d_precision,
             )
             parts = []
             for b in batches:
@@ -565,6 +567,7 @@ def run_benchmark(args, platform: str) -> dict:
             method=method,
             pallas2d_budget=args.pallas2d_budget,
             pallas2d_chunk=args.pallas2d_chunk,
+            pallas2d_precision=args.pallas2d_precision,
         )
         step = make_step(h)
         s = h.init_state()
@@ -607,6 +610,7 @@ def run_benchmark(args, platform: str) -> dict:
         method=method,
         pallas2d_budget=args.pallas2d_budget,
         pallas2d_chunk=args.pallas2d_chunk,
+        pallas2d_precision=args.pallas2d_precision,
     )
     step_fn = make_step(hist)
     state = hist.init_state()
@@ -969,6 +973,10 @@ def _parse_args():
     #     python bench.py --method pallas2d --pallas2d-budget $b; done
     parser.add_argument("--pallas2d-budget", type=int, default=None)
     parser.add_argument("--pallas2d-chunk", type=int, default=None)
+    parser.add_argument(
+        "--pallas2d-precision", choices=["bf16", "int8"], default="bf16",
+        help="one-hot MXU dtype; int8 doubles the v5e MXU rate, both exact"
+    )
     parser.add_argument(
         "--method",
         default="scatter",

@@ -286,6 +286,7 @@ class EventHistogrammer:
         dtype=jnp.float32,
         pallas2d_budget: int | None = None,
         pallas2d_chunk: int | None = None,
+        pallas2d_precision: str = "bf16",
     ) -> None:
         if method not in ("scatter", "sort", "pallas", "pallas2d"):
             raise ValueError(f"Unknown method {method!r}")
@@ -343,6 +344,11 @@ class EventHistogrammer:
                     "pallas2d_chunk must be a positive multiple of 128 "
                     "(the event-row block's lane dimension)"
                 )
+            if pallas2d_precision not in ("bf16", "int8"):
+                raise ValueError(
+                    "pallas2d_precision must be 'bf16' or 'int8'"
+                )
+            self._p2_precision = pallas2d_precision
             for k in range(16, -1, -1):
                 bpb = (1 << k) * self._n_toa
                 if bpb <= budget and bpb % 128 == 0:
@@ -499,7 +505,12 @@ class EventHistogrammer:
         return self._advance_core(
             state,
             lambda win, upd: scatter_add_pallas2d(
-                win, events, chunk_map, bpb=self._bpb, upd=upd
+                win,
+                events,
+                chunk_map,
+                bpb=self._bpb,
+                upd=upd,
+                precision=self._p2_precision,
             ),
             None,
         )

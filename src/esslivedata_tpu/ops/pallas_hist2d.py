@@ -180,7 +180,7 @@ def partition_events_host(
     return events, chunk_map
 
 
-@functools.partial(jax.jit, static_argnums=(4, 5), donate_argnums=(0,))
+@functools.partial(jax.jit, static_argnums=(4, 5, 6), donate_argnums=(0,))
 def _pallas2d_call(
     window: jax.Array,  # [n_blocks * bpb] float32, donated
     events: jax.Array,  # [n_chunks * chunk] int32, -1 padded
@@ -188,6 +188,7 @@ def _pallas2d_call(
     upd,  # traced float32 scalar (1.0 for counts; 1/scale for decay)
     bpb: int,
     interpret: bool,
+    precision: str = "bf16",
 ):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -199,6 +200,12 @@ def _pallas2d_call(
     win3 = window.reshape(n_blocks, h, _LANES)
     rows = events.reshape(n_chunks, chunk)
     upd_arr = jnp.full((1,), upd, jnp.float32)
+    # One-hot operand dtype for the MXU contraction. 0/1 are exact in
+    # both; int8 runs at ~2x the bf16 MXU rate on v5e with exact int32
+    # accumulation (a chunk sums at most `chunk` ones per bin, far
+    # inside int32).
+    oh_dtype = jnp.int8 if precision == "int8" else jnp.bfloat16
+    acc_dtype = jnp.int32 if precision == "int8" else jnp.float32
 
     def kernel(map_ref, upd_ref, win_ref, rows_ref, out_ref):
         j = pl.program_id(0)
@@ -216,18 +223,18 @@ def _pallas2d_call(
         oh_hi = (
             hi[:, None]
             == jax.lax.broadcasted_iota(jnp.int32, (chunk, h), 1)
-        ).astype(jnp.bfloat16)
+        ).astype(oh_dtype)
         oh_lo = (
             lo[:, None]
             == jax.lax.broadcasted_iota(jnp.int32, (chunk, _LANES), 1)
-        ).astype(jnp.bfloat16)
+        ).astype(oh_dtype)
         contrib = jax.lax.dot_general(
             oh_hi,
             oh_lo,
             (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=acc_dtype,
         )  # [h, 128]
-        out_ref[0, :, :] += contrib * upd_ref[0]
+        out_ref[0, :, :] += contrib.astype(jnp.float32) * upd_ref[0]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -256,13 +263,16 @@ def scatter_add_pallas2d(
     bpb: int = DEFAULT_BPB,
     upd: float = 1.0,
     interpret: bool | None = None,
+    precision: str = "bf16",
 ) -> jax.Array:
     """Accumulate partitioned events into the padded flat window in place.
 
     ``window`` must have ``padded_bins(...)`` elements and is donated.
     ``events``/``chunk_map`` come from ``partition_events_host`` (or the
     native ``ld_partition``). ``upd`` scales every hit (1.0 for counts;
-    the lazy-decay path passes ``1/scale``).
+    the lazy-decay path passes ``1/scale``). ``precision`` selects the
+    one-hot MXU dtype: 'bf16' or 'int8' (both exact for counts; int8
+    doubles the v5e MXU rate).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -272,6 +282,8 @@ def scatter_add_pallas2d(
         raise ValueError(
             f"window size {window.shape[0]} is not a multiple of bpb={bpb}"
         )
+    if precision not in ("bf16", "int8"):
+        raise ValueError("precision must be 'bf16' or 'int8'")
     return _pallas2d_call(
         window,
         jnp.asarray(events, jnp.int32),
@@ -279,4 +291,5 @@ def scatter_add_pallas2d(
         jnp.asarray(upd, jnp.float32),
         bpb,
         bool(interpret),
+        precision,
     )
