@@ -109,6 +109,41 @@ def test_malformed_is_contained_and_next_message_unaffected(case, mapping):
     assert source.error_count + source.unrouted_count == 1
 
 
+def test_ev44_without_event_vectors_handled(mapping):
+    """An ev44 carrying only source_name + message_id (no event or
+    reference-time vectors): the reference DROPS these deep in its
+    adapter (its #1038 xfail); here the codec decodes them as empty
+    arrays and the pipeline must stay alive either way — pinned as
+    either a clean zero-event adaptation or a contained drop, never an
+    escaping exception, and the next good message unharmed."""
+    import flatbuffers
+
+    b = flatbuffers.Builder(64)
+    src = b.CreateString("panel_a")
+    b.StartObject(6)
+    b.PrependUOffsetTRelativeSlot(0, src, 0)
+    b.PrependInt64Slot(1, 42, 0)
+    b.Finish(b.EndObject(), file_identifier=b"ev44")
+    bare = bytes(b.Output())
+
+    m = wire.decode_ev44(bare)  # codec level: graceful empties
+    assert (len(m.time_of_flight), len(m.pixel_id)) == (0, 0)
+
+    router = detector_route_builder(mapping)
+    source = AdaptingMessageSource(
+        _list_source(
+            [
+                FakeKafkaMessage(bare, "dummy_detector"),
+                FakeKafkaMessage(good_ev44(), "dummy_detector"),
+            ]
+        ),
+        router,
+    )
+    out = source.get_messages()
+    assert 1 <= len(out) <= 2
+    assert out[-1].timestamp.ns == GOOD_TIME_NS
+
+
 def test_mismatched_event_vectors_pin(mapping):
     """Pins current behavior: disagreeing toa/pixel vector lengths decode
     (each vector keeps its own length); the staging layer is what
