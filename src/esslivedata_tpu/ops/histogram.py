@@ -578,16 +578,46 @@ class EventHistogrammer:
             out["scale"] = np.asarray(state.scale)
         return out
 
-    @staticmethod
+    def _fit_flat(self, arr: np.ndarray, want: int) -> np.ndarray | None:
+        """Adapt a flat accumulator across block-padding layouts.
+
+        The scatter layout is ``[n_bins + 1]``; pallas2d pads to whole
+        blocks with a zero tail. Under the snapshot fingerprint gate
+        (same workflow config = same logical bins) the layouts differ
+        only by that padding, so: an array covering the logical prefix
+        (``n_bins + 1``) adapts — a longer tail must be all zeros
+        (counts there would mean it was not padding), a shorter array
+        is rejected (wrong configuration, not a layout).
+        """
+        n = arr.shape[0]
+        logical = self._n_bins + 1
+        if n == want:
+            return arr
+        if n < logical or np.any(arr[logical:]):
+            return None
+        if n >= want:
+            return arr[:want]
+        out = np.zeros(want, dtype=arr.dtype)
+        out[:n] = arr
+        return out
+
     def restore_state_arrays(
-        current: HistogramState, arrays: dict
+        self, current: HistogramState, arrays: dict
     ) -> HistogramState | None:
         """A restored state shaped like ``current``, or None if the
-        arrays don't fit (shape-checked; never partially adopts)."""
+        arrays don't fit (never partially adopts). Arrays from the other
+        histogram method's layout (block padding, ``method='pallas2d'``)
+        adapt — an operator switching kernels between runs must not lose
+        a recovery snapshot."""
         folded = np.asarray(arrays.get("folded"))
         window = np.asarray(arrays.get("window"))
-        want = current.folded.shape
-        if folded.shape != want or window.shape != want:
+        want_shape = current.folded.shape
+        if folded.ndim != 1 or window.ndim != 1 or len(want_shape) != 1:
+            return None
+        want = want_shape[0]
+        folded = self._fit_flat(folded, want)
+        window = self._fit_flat(window, want)
+        if folded is None or window is None:
             return None
         has_scale = current.scale is not None
         if has_scale != ("scale" in arrays):

@@ -13,6 +13,8 @@ from __future__ import annotations
 from collections.abc import Mapping
 from typing import Any, Literal
 
+import json
+
 import jax.numpy as jnp
 import numpy as np
 from pydantic import BaseModel, ConfigDict, Field
@@ -383,11 +385,17 @@ class DetectorViewWorkflow:
         h.update(
             f"{self._proj.ny}x{self._proj.nx}:{self._hist.n_toa}:".encode()
         )
-        # Full params: two jobs differing in ANY parameter must not
-        # exchange state (they still share one snapshot file per
-        # workflow/source — last dump wins — but a mismatched restore is
-        # refused rather than silently adopted).
-        h.update(self._params.model_dump_json().encode())
+        # Full params EXCEPT the kernel choice: two jobs differing in any
+        # physically meaningful parameter must not exchange state, but
+        # histogram_method only selects HOW the same bins accumulate —
+        # the snapshot codec adapts the layouts (restore_state_arrays),
+        # so a kernel switch between runs keeps its recovery snapshot.
+        h.update(
+            json.dumps(
+                self._params.model_dump(exclude={"histogram_method"}),
+                sort_keys=True,
+            ).encode()
+        )
         return h.hexdigest()
 
     def dump_state(self) -> dict[str, np.ndarray]:
@@ -398,7 +406,7 @@ class DetectorViewWorkflow:
         """Adopt a dumped accumulation; shape-checked against the current
         kernel (fingerprint matching happens in the store, but a corrupt
         file must not poison the device state)."""
-        restored = EventHistogrammer.restore_state_arrays(self._state, arrays)
+        restored = self._hist.restore_state_arrays(self._state, arrays)
         if restored is None:
             return False
         self._state = restored

@@ -281,7 +281,7 @@ class MonitorWorkflow:
             or dense_c.shape != self._dense_cumulative.shape
         ):
             return False
-        restored = EventHistogrammer.restore_state_arrays(self._state, arrays)
+        restored = self._hist.restore_state_arrays(self._state, arrays)
         if restored is None:
             return False
         self._state = restored

@@ -308,6 +308,48 @@ class TestHistogrammerPallas2d:
                     pallas2d_chunk=chunk,
                 )
 
+    @pytest.mark.parametrize(
+        ("dump_method", "restore_method"),
+        [("scatter", "pallas2d"), ("pallas2d", "scatter")],
+    )
+    def test_snapshot_restores_across_method_switch(
+        self, dump_method, restore_method
+    ):
+        """An operator switching histogram kernels between runs must not
+        lose a recovery snapshot: the codec adapts the block-padding
+        layout difference (ADR 0107 + round-5 pallas2d)."""
+        n_screen = 700
+        batches = self._batches(n_screen)
+        hd, sd = self._run(dump_method, batches, n_screen=n_screen)
+        cum_before = hd.read(sd)[0]
+        arrays = EventHistogrammer.dump_state_arrays(sd)
+
+        hr = EventHistogrammer(
+            toa_edges=np.linspace(0.0, 71.0, 101),
+            n_screen=n_screen,
+            method=restore_method,
+        )
+        restored = hr.restore_state_arrays(hr.init_state(), arrays)
+        assert restored is not None, "cross-layout snapshot discarded"
+        np.testing.assert_allclose(hr.read(restored)[0], cum_before)
+        # And the restored state keeps accumulating on the new kernel.
+        after = hr.step_batch(restored, batches[0])
+        assert hr.read(after)[0].sum() > cum_before.sum()
+
+    def test_snapshot_with_counts_in_tail_rejected(self):
+        # A longer array whose tail carries counts is NOT padding —
+        # adopting it would silently drop data.
+        h = EventHistogrammer(
+            toa_edges=np.linspace(0.0, 71.0, 101), n_screen=700
+        )
+        want = h.init_state().folded.shape[0]
+        bad = {
+            "folded": np.zeros(want + 128, np.float32),
+            "window": np.zeros(want + 128, np.float32),
+        }
+        bad["folded"][-1] = 5.0
+        assert h.restore_state_arrays(h.init_state(), bad) is None
+
     def test_nonuniform_edges(self):
         # Non-uniform edges skip the fused native pass but keep parity.
         edges = np.concatenate([[0.0], np.cumsum(np.linspace(0.5, 2.0, 50))])

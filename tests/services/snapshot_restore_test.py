@@ -156,6 +156,58 @@ class TestWorkflowDumpRestore:
         out = wf2.finalize()
         assert float(np.asarray(out["counts_cumulative"].data.values)) == 32.0
 
+    def test_kernel_switch_keeps_snapshot(self):
+        """The production path of the cross-layout adaptation: a scatter
+        run's snapshot restores into a pallas2d run (and back) — the
+        fingerprint excludes the kernel choice, the codec adapts the
+        block padding."""
+        from esslivedata_tpu.core.timestamp import Timestamp
+        from esslivedata_tpu.preprocessors.event_data import (
+            DetectorEvents,
+            ToEventBatch,
+        )
+        from esslivedata_tpu.workflows.detector_view.projectors import (
+            project_logical,
+        )
+        from esslivedata_tpu.workflows.detector_view.workflow import (
+            DetectorViewParams,
+            DetectorViewWorkflow,
+        )
+
+        grid = np.arange(1, 65, dtype=np.int32).reshape(8, 8)
+        wf = DetectorViewWorkflow(
+            projection=project_logical(grid),
+            params=DetectorViewParams(histogram_method="scatter"),
+        )
+        stage = ToEventBatch()
+        stage.add(
+            Timestamp.from_ns(1),
+            DetectorEvents(
+                pixel_id=np.arange(1, 33, dtype=np.int32),
+                time_of_arrival=np.full(32, 1e6, np.float32),
+            ),
+        )
+        wf.accumulate({"x": stage.get()})
+        dump = wf.dump_state()
+        wf2 = DetectorViewWorkflow(
+            projection=project_logical(grid),
+            params=DetectorViewParams(histogram_method="pallas2d"),
+        )
+        # Same physical meaning -> same fingerprint despite the kernel.
+        assert wf2.state_fingerprint() == wf.state_fingerprint()
+        assert wf2.restore_state(dump)
+        out = wf2.finalize()
+        assert float(np.asarray(out["counts_cumulative"].data.values)) == 32.0
+        # And back: pallas2d dump -> scatter restore.
+        dump2 = wf2.dump_state()
+        wf3 = DetectorViewWorkflow(
+            projection=project_logical(grid),
+            params=DetectorViewParams(histogram_method="scatter"),
+        )
+        assert wf3.restore_state(dump2)
+        out3 = wf3.finalize()
+        assert float(np.asarray(out3["counts_cumulative"].data.values)) == 32.0
+
     def test_restore_rejects_wrong_shape(self):
         wf = self._workflow()
         assert not wf.restore_state(
