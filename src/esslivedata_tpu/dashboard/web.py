@@ -97,6 +97,23 @@ def _workflow_entry(spec) -> dict:
     return entry
 
 
+def _export_filename(instrument: str, key: ResultKey, suffix: str) -> str:
+    """Filesystem-safe descriptive export name: INSTRUMENT_output_source.
+
+    Timestamps are omitted on purpose (file creation time serves that);
+    every component is sanitized to [A-Za-z0-9-] with '-' for runs of
+    anything else, mirroring the reference's save-filename policy."""
+
+    def clean(text: str) -> str:
+        out = re.sub(r"[^A-Za-z0-9]+", "-", text).strip("-")
+        return out or "x"
+
+    return (
+        f"{clean(instrument).upper()}_{clean(key.output_name)}"
+        f"_{clean(key.job_id.source_name)}{suffix}"
+    )
+
+
 def _token_matches(presented: str | None, token: str) -> bool:
     """Constant-time token check. Bytes comparison: compare_digest
     raises TypeError on non-ASCII str input (a pasted token with a
@@ -768,6 +785,16 @@ class DataExportHandler(_Base):
         if resolved is None:
             return
         key, _params, data = resolved
+        # Descriptive download name (reference save_filename.py:
+        # "DREAM_I-Q_Mantle"): instrument + output + source, filesystem-
+        # safe — the opaque b64 kid would otherwise name the file.
+        self.set_header(
+            "Content-Disposition",
+            "attachment; filename="
+            + _export_filename(
+                self.application.settings["instrument"], key, suffix
+            ),
+        )
         coords = {
             name: np.asarray(var.numpy)
             for name, var in data.coords.items()

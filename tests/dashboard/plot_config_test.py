@@ -504,6 +504,12 @@ class FlattenHttpTest(PlotConfigHttpTest):
         kid = self._kid(state, "spectrum_current")
         r = self.fetch(f"/data/{kid}.json")
         assert r.code == 200
+        # Descriptive download name (reference save_filename policy):
+        # INSTRUMENT_output_source, filesystem-safe, never the b64 kid.
+        disposition = r.headers.get("Content-Disposition", "")
+        assert disposition == (
+            "attachment; filename=DUMMY_spectrum-current_panel-0.json"
+        ), disposition
         payload = json.loads(r.body)
         assert payload["dims"] == ["toa"]
         assert len(payload["values"]) == 100
