@@ -831,14 +831,8 @@ class DataExportHandler(_Base):
             **{f"coord_{name}": values for name, values in coords.items()},
         )
         self.set_header("Content-Type", "application/octet-stream")
-        # Header-safe filename: quotes/control/non-ASCII in an output name
-        # would malform the quoted-string (RFC 6266) and break the parse
-        # in some clients.
-        safe = re.sub(r"[^A-Za-z0-9._-]+", "_", key.output_name) or "output"
-        self.set_header(
-            "Content-Disposition",
-            f'attachment; filename="{safe}.npz"',
-        )
+        # Content-Disposition already carries the descriptive sanitized
+        # name (set once above for both suffixes).
         self.write(buf.getvalue())
 
 

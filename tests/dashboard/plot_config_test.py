@@ -518,6 +518,9 @@ class FlattenHttpTest(PlotConfigHttpTest):
 
         r = self.fetch(f"/data/{kid}.npz")
         assert r.code == 200
+        assert r.headers.get("Content-Disposition") == (
+            "attachment; filename=DUMMY_spectrum-current_panel-0.npz"
+        )
         archive = np.load(_io.BytesIO(r.body))
         assert archive["values"].shape == (100,)
         assert archive["coord_toa"].shape == (101,)
