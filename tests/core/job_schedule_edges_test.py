@@ -1,0 +1,127 @@
+"""Schedule-boundary edge cases for the JobManager phase machine
+(reference tests/core/job_manager_test.py's schedule matrix): exact
+start/end boundaries, zero-duration windows, no-end jobs never
+auto-finishing, staggered multi-job end times, and the carrying window
+being flushed into a final result at the end boundary."""
+
+import pytest
+
+from esslivedata_tpu.core.job import JobState
+from esslivedata_tpu.core.timestamp import Timestamp
+
+# Shared harness (tests/ is not a package: import by module name, the
+# rootdir-relative form pytest's own collection uses).
+from job_manager_test import (  # noqa: E402
+    manager,  # noqa: F401  (fixture)
+    registry,  # noqa: F401  (fixture)
+    start_config,
+)
+
+T = Timestamp.from_ns
+
+
+class TestScheduleBoundaries:
+    def test_start_equals_window_end_activates(self, registry, manager):
+        # Activation is >= start: a window whose END lands exactly on
+        # the start boundary admits the job.
+        manager.schedule_job(start_config(registry, start_time_ns=1000))
+        results = manager.process_jobs(
+            {"bank0": 1.0}, start=T(0), end=T(1000)
+        )
+        assert len(results) == 1
+
+    def test_end_equals_window_end_finishes_after_flush(
+        self, registry, manager
+    ):
+        # >= end finishes, but the window that carried the job past its
+        # end is flushed first: the final result exists.
+        manager.schedule_job(start_config(registry, end_time_ns=1000))
+        results = manager.process_jobs(
+            {"bank0": 3.0}, start=T(900), end=T(1000)
+        )
+        assert len(results) == 1
+        assert float(results[0].outputs["total"].values) == 3.0
+        [status] = manager.job_statuses()
+        assert status.state == JobState.STOPPED
+
+    def test_zero_duration_window(self, registry, manager):
+        # start == end: the job activates AND finishes within the one
+        # window that reaches the boundary, flushing its data.
+        manager.schedule_job(
+            start_config(registry, start_time_ns=500, end_time_ns=500)
+        )
+        results = manager.process_jobs(
+            {"bank0": 2.0}, start=T(400), end=T(600)
+        )
+        assert len(results) == 1
+        [status] = manager.job_statuses()
+        assert status.state == JobState.STOPPED
+
+    def test_no_end_never_auto_finishes(self, registry, manager):
+        manager.schedule_job(start_config(registry))
+        for i in range(5):
+            results = manager.process_jobs(
+                {"bank0": 1.0},
+                start=T(i * 1000),
+                end=T((i + 1) * 1000),
+            )
+            assert len(results) == 1
+        [status] = manager.job_statuses()
+        assert status.state == JobState.ACTIVE
+
+    def test_staggered_end_times(self, registry, manager):
+        manager.schedule_job(
+            start_config(registry, source="bank0", end_time_ns=1000)
+        )
+        manager.schedule_job(
+            start_config(registry, source="bank1", end_time_ns=3000)
+        )
+        data = {"bank0": 1.0, "bank1": 1.0}
+        results = manager.process_jobs(data, start=T(0), end=T(500))
+        assert len(results) == 2
+        # First boundary: bank0 flushes its final window and stops.
+        results = manager.process_jobs(data, start=T(500), end=T(1500))
+        assert len(results) == 2
+        states = {
+            s.source_name: s.state for s in manager.job_statuses()
+        }
+        assert states["bank0"] == JobState.STOPPED
+        assert states["bank1"] == JobState.ACTIVE
+        # Past the first boundary only bank1 produces.
+        results = manager.process_jobs(data, start=T(1500), end=T(2500))
+        assert [r.job_id.source_name for r in results] == ["bank1"]
+        # Second boundary stops bank1 too.
+        manager.process_jobs(data, start=T(2500), end=T(3500))
+        states = {
+            s.source_name: s.state for s in manager.job_statuses()
+        }
+        assert states["bank1"] == JobState.STOPPED
+
+    def test_window_fully_before_start_keeps_job_scheduled(
+        self, registry, manager
+    ):
+        manager.schedule_job(start_config(registry, start_time_ns=10_000))
+        for i in range(3):
+            assert (
+                manager.process_jobs(
+                    {"bank0": 1.0},
+                    start=T(i * 100),
+                    end=T((i + 1) * 100),
+                )
+                == []
+            )
+        [status] = manager.job_statuses()
+        assert status.state == JobState.SCHEDULED
+
+    def test_finished_job_ignores_further_data(self, registry, manager):
+        manager.schedule_job(start_config(registry, end_time_ns=100))
+        manager.process_jobs({"bank0": 1.0}, start=T(0), end=T(200))
+        for i in range(3):
+            assert (
+                manager.process_jobs(
+                    {"bank0": 9.0},
+                    start=T(200 + i * 100),
+                    end=T(300 + i * 100),
+                )
+                == []
+            )
