@@ -181,3 +181,27 @@ class TestEmptyTable:
         )
         manager.process_jobs({}, start=T(0), end=T(1_000))
         assert manager._pending_reset_times == [T(10_000)]  # noqa: SLF001
+
+
+class TestRunStartWithStopTime:
+    def test_schedules_resets_at_both_boundaries(self, registry, manager):
+        """A pl72 carrying stop_time announces the whole run up front:
+        accumulation resets at the run START and again at the run END
+        (reference run_transition_test.py: two resets from one event)."""
+        job_id = start(manager, registry)
+        push(manager, 1.0, start_ns=0, end_ns=100)
+        wf = workflow_of(manager, job_id)
+        manager.handle_run_transition(
+            RunStart(
+                run_name="r7", start_time=T(200), stop_time=T(1000)
+            )
+        )
+        # Crossing the start boundary: first reset.
+        push(manager, 2.0, start_ns=150, end_ns=300)
+        assert wf.clear_calls == 1
+        # Inside the run: no further reset.
+        push(manager, 3.0, start_ns=300, end_ns=900)
+        assert wf.clear_calls == 1
+        # Crossing the stop boundary: second reset from the SAME event.
+        push(manager, 4.0, start_ns=900, end_ns=1100)
+        assert wf.clear_calls == 2
