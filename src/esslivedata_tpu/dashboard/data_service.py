@@ -80,15 +80,48 @@ class DataService:
             self._notify({key})
 
     def _notify(self, keys: set[ResultKey]) -> None:
+        """Notify subscribers, with cascade semantics: a subscriber may
+        write DERIVED keys during its callback — each wave notifies in a
+        new round, so linear derivation chains of any depth complete.
+        A key re-written within one cascade is a CYCLE (a subscriber
+        feeding its own trigger) and is dropped with a warning instead
+        of recursing forever (reference data_service cascade +
+        circular-dependency protection). Its value is still committed;
+        only the re-notification is suppressed.
+        """
         if not keys:
             return
-        for sub in list(self._subscriptions):
-            hit = keys & sub.keys if sub.keys else keys
-            if hit:
-                try:
-                    sub.on_updated(hit)
-                except Exception:
-                    logger.exception("Subscriber callback failed")
+        local = self._local
+        if getattr(local, "notifying", False):
+            # put() from inside a subscriber callback: queue for the
+            # next round instead of recursing.
+            local.cascade.update(keys)
+            return
+        local.notifying = True
+        local.cascade = set()
+        seen = set(keys)
+        try:
+            while keys:
+                for sub in list(self._subscriptions):
+                    hit = keys & sub.keys if sub.keys else keys
+                    if hit:
+                        try:
+                            sub.on_updated(hit)
+                        except Exception:
+                            logger.exception("Subscriber callback failed")
+                cascade, local.cascade = local.cascade, set()
+                cyclic = cascade & seen
+                if cyclic:
+                    logger.warning(
+                        "Circular subscriber updates on %d key(s) "
+                        "(e.g. %s); suppressing re-notification",
+                        len(cyclic),
+                        next(iter(cyclic)),
+                    )
+                keys = cascade - seen
+                seen |= keys
+        finally:
+            local.notifying = False
 
     # -- subscriptions -----------------------------------------------------
     def subscribe(self, subscription: DataSubscription) -> DataSubscription:

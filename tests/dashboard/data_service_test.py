@@ -194,3 +194,133 @@ class TestDataService:
         for t in threads:
             t.join()
         assert not errors
+
+
+class TestTransactionContracts:
+    """Transaction/notification contracts (reference data_service_test
+    breadth): nested commits, exception paths, cascades and cycles."""
+
+    def _sub(self, ds, keys=None):
+        hits = []
+        sub = DataSubscription(
+            keys=set(keys or []),
+            extractor=LatestValueExtractor(),
+            on_updated=lambda ks: hits.append(set(ks)),
+        )
+        ds.subscribe(sub)
+        return hits, sub
+
+    def test_nested_transactions_notify_once_at_outer_commit(self):
+        ds = DataService()
+        k = key("a")
+        hits, _ = self._sub(ds)
+        gen0 = ds.generation
+        with ds.transaction():
+            ds.put(k, T(1), da_1d([1.0, 2.0]))
+            with ds.transaction():
+                ds.put(key("b"), T(2), da_1d([1.0, 2.0]))
+            assert hits == []  # inner commit must not flush
+        assert len(hits) == 1 and len(hits[0]) == 2
+        assert ds.generation == gen0 + 1  # one generation, not two
+
+    def test_exception_inside_transaction_still_notifies_written_keys(self):
+        ds = DataService()
+        k = key("a")
+        hits, _ = self._sub(ds)
+        with pytest.raises(RuntimeError, match="boom"):
+            with ds.transaction():
+                ds.put(k, T(1), da_1d([1.0, 2.0]))
+                raise RuntimeError("boom")
+        # The write happened; subscribers must learn about it (the
+        # buffer state and the notification stream cannot diverge).
+        assert hits == [{k}]
+
+    def test_cascading_subscriber_write_notifies_downstream(self):
+        ds = DataService()
+        ka, kb = key("a"), key("b")
+        # A: on ka, derive kb. B: observe kb.
+        ds.subscribe(
+            DataSubscription(
+                keys={ka},
+                extractor=LatestValueExtractor(),
+                on_updated=lambda ks: ds.put(
+                    kb, T(99), da_1d([1.0, 2.0])
+                ),
+            )
+        )
+        b_hits, _ = self._sub(ds, keys=[kb])
+        ds.put(ka, T(1), da_1d([1.0, 2.0]))
+        assert b_hits == [{kb}]
+
+    def test_circular_subscriber_updates_bounded(self):
+        ds = DataService()
+        k = key("a")
+        calls = []
+
+        def rewrite(ks):
+            calls.append(1)
+            ds.put(k, T(len(calls)), da_1d([1.0, 2.0]))
+
+        ds.subscribe(
+            DataSubscription(
+                keys={k},
+                extractor=LatestValueExtractor(),
+                on_updated=rewrite,
+            )
+        )
+        ds.put(k, T(0), da_1d([1.0, 2.0]))  # must terminate
+        # The first delivery runs; the re-write of the SAME key within
+        # the cascade is a cycle: suppressed, not re-delivered.
+        assert len(calls) == 1
+
+    def test_deep_linear_chain_completes(self):
+        # A 25-stage derivation chain is NOT a cycle: every stage must
+        # be delivered (only re-seen keys are suppressed).
+        ds = DataService()
+        keys = [key(f"k{i}") for i in range(25)]
+        delivered = []
+        for i in range(24):
+            def make(i):
+                def cb(ks):
+                    delivered.append(i)
+                    ds.put(keys[i + 1], T(i), da_1d([1.0]))
+                return cb
+            ds.subscribe(
+                DataSubscription(
+                    keys={keys[i]},
+                    extractor=LatestValueExtractor(),
+                    on_updated=make(i),
+                )
+            )
+        tail_hits, _ = self._sub(ds, keys=[keys[-1]])
+        ds.put(keys[0], T(0), da_1d([1.0]))
+        assert delivered == list(range(24))
+        assert tail_hits == [{keys[-1]}]
+
+    def test_unsubscribe_during_notification_keeps_others(self):
+        ds = DataService()
+        k = key("a")
+        order = []
+        subs = []
+
+        def make(name):
+            def cb(ks):
+                order.append(name)
+                if name == "first":
+                    ds.unsubscribe(subs[0])
+
+            return cb
+
+        for name in ("first", "second"):
+            sub = DataSubscription(
+                keys={k},
+                extractor=LatestValueExtractor(),
+                on_updated=make(name),
+            )
+            subs.append(sub)
+            ds.subscribe(sub)
+        ds.put(k, T(1), da_1d([1.0, 2.0]))
+        assert order == ["first", "second"]
+        # And the unsubscribed one stays gone next time.
+        ds.put(k, T(2), da_1d([1.0, 2.0]))
+        assert order == ["first", "second", "second"]
