@@ -609,6 +609,21 @@ def render_layers_png(
             plt.close(fig)
 
 
+def align_nearest_older(
+    tx: np.ndarray, vx: np.ndarray, ty: np.ndarray, vy: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Pair each x sample with the LAST y sample at-or-before its time.
+
+    x samples older than every y sample have no partner and are dropped
+    — pairing them with a future y would fabricate correlation
+    (reference correlation_plotter's 'previous' alignment mode). Exact
+    timestamp matches pair with that sample.
+    """
+    idx = np.searchsorted(ty, tx, side="right") - 1
+    has_partner = idx >= 0
+    return vx[has_partner], vy[idx[has_partner]]
+
+
 def render_correlation_png(
     x_series: DataArray,
     y_series: DataArray,
@@ -626,13 +641,7 @@ def render_correlation_png(
     vy = np.atleast_1d(np.asarray(y_series.values, dtype=np.float64))
     if tx.size == 0 or ty.size == 0:
         raise ValueError("correlation needs non-empty series")
-    # Align y onto x's timestamps: last y sample at-or-before each x time;
-    # x samples older than every y sample have no partner and are dropped
-    # (pairing them with a future y would fabricate correlation).
-    idx = np.searchsorted(ty, tx, side="right") - 1
-    has_partner = idx >= 0
-    vx = vx[has_partner]
-    aligned_y = vy[idx[has_partner]]
+    vx, aligned_y = align_nearest_older(tx, vx, ty, vy)
     with _render_lock:
         fig, ax = plt.subplots(figsize=figsize, dpi=dpi)
         try:

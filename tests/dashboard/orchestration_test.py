@@ -278,3 +278,49 @@ class TestCorrelationAlignment:
             series([1, 2, 3], [5, 15, 25]), series([9], [20])
         )
         assert png[:8] == b"\x89PNG\r\n\x1a\n"
+
+
+class TestCorrelationAlignment:
+    """The correlation pairing rule in isolation (reference
+    correlation_plotter_test's previous-mode cases): last y at-or-before
+    each x time; x samples with no older partner are dropped."""
+
+    def _align(self, tx, vx, ty, vy):
+        from esslivedata_tpu.dashboard.plots import align_nearest_older
+
+        return align_nearest_older(
+            np.asarray(tx, np.int64),
+            np.asarray(vx, float),
+            np.asarray(ty, np.int64),
+            np.asarray(vy, float),
+        )
+
+    def test_previous_sample_pairs(self):
+        ax, ay = self._align(
+            [10, 20, 30], [1.0, 2.0, 3.0], [5, 15, 25], [0.5, 1.5, 2.5]
+        )
+        np.testing.assert_array_equal(ax, [1.0, 2.0, 3.0])
+        np.testing.assert_array_equal(ay, [0.5, 1.5, 2.5])
+
+    def test_exact_timestamp_pairs_with_that_sample(self):
+        ax, ay = self._align([10, 20], [1.0, 2.0], [10, 20], [7.0, 8.0])
+        np.testing.assert_array_equal(ay, [7.0, 8.0])
+
+    def test_x_before_all_y_dropped(self):
+        # Pairing with a FUTURE y would fabricate correlation.
+        ax, ay = self._align(
+            [1, 2, 50], [1.0, 2.0, 3.0], [10, 40], [7.0, 8.0]
+        )
+        np.testing.assert_array_equal(ax, [3.0])
+        np.testing.assert_array_equal(ay, [8.0])
+
+    def test_all_x_before_y_yields_empty(self):
+        ax, ay = self._align([1, 2], [1.0, 2.0], [10], [7.0])
+        assert ax.size == 0 and ay.size == 0
+
+    def test_stale_y_holds_until_next_sample(self):
+        # y updates slowly: every x in between pairs with the held value.
+        ax, ay = self._align(
+            [10, 11, 12, 13], [1, 2, 3, 4], [9, 12], [5.0, 6.0]
+        )
+        np.testing.assert_array_equal(ay, [5.0, 5.0, 6.0, 6.0])
