@@ -109,10 +109,18 @@ class WindowAggregatingExtractor(Extractor):
     wants_history = True
 
     def __init__(self, window_s: float, operation: str = "sum") -> None:
-        if operation not in ("sum", "mean"):
+        if operation not in ("sum", "mean", "auto"):
             raise ValueError(f"Unknown aggregation {operation!r}")
         self._window_s = window_s
         self._operation = operation
+
+    def _resolve_operation(self, template: DataArray) -> str:
+        """'auto' is unit-aware (reference extractors: counts use nansum,
+        everything else nanmean): counts over a window ADD; intensive
+        quantities (temperatures, positions) AVERAGE."""
+        if self._operation != "auto":
+            return self._operation
+        return "sum" if repr(template.unit) == "counts" else "mean"
 
     def extract(self, buffer: Buffer) -> Any:
         if isinstance(buffer, TemporalBuffer):
@@ -136,7 +144,7 @@ class WindowAggregatingExtractor(Extractor):
                 total = total + np.asarray(da.values, dtype=np.float64)
                 count += 1
             template = da
-        if self._operation == "mean":
+        if self._resolve_operation(template) == "mean":
             # Means stay float64: casting back to an integer count dtype
             # would silently floor non-integer averages.
             values = total / count if count > 1 else total

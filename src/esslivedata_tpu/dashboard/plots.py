@@ -43,7 +43,15 @@ logger = logging.getLogger(__name__)
 
 #: Extractor selections the cell config may name (reference exposes the
 #: same choice in its plot config modal as "data source" per plot).
-EXTRACTOR_CHOICES = ("latest", "full_history", "window_sum", "window_mean")
+EXTRACTOR_CHOICES = (
+    "latest",
+    "full_history",
+    "window_sum",
+    "window_mean",
+    # Unit-aware: counts sum (missing frames mean missing counts),
+    # everything else averages (a temperature does not add).
+    "window_auto",
+)
 
 #: Plotter forcing: '' = auto-select from shape.
 PLOTTER_CHOICES = ("", "table", "slicer", "flatten")
@@ -243,6 +251,8 @@ class PlotParams:
             return WindowAggregatingExtractor(self.window_s, "sum")
         if self.extractor == "window_mean":
             return WindowAggregatingExtractor(self.window_s, "mean")
+        if self.extractor == "window_auto":
+            return WindowAggregatingExtractor(self.window_s, "auto")
         return None
 
     def _norm(self, data: "np.ndarray | None" = None):

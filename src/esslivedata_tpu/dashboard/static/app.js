@@ -376,7 +376,8 @@ const CELL_CONFIG_FIELDS = [
   {key: 'vmin', kind: 'number', hint: 'lower bound'},
   {key: 'vmax', kind: 'number', hint: 'upper bound'},
   {key: 'extractor', kind: 'select',
-    choices: ['latest', 'full_history', 'window_sum', 'window_mean']},
+    choices: ['latest', 'full_history', 'window_sum', 'window_mean',
+              'window_auto']},
   {key: 'window_s', kind: 'number', hint: 'seconds (window_* extractors)'},
   {key: 'plotter', kind: 'select', choices: ['', 'table', 'slicer', 'flatten']},
   {key: 'slice', kind: 'number', hint: 'leading-dim index (slicer)'},

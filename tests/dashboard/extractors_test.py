@@ -3,6 +3,7 @@ tests): window-edge semantics, stamp exemption, restart-on-structure-
 change, sum/mean dtype rules."""
 
 import numpy as np
+import pytest
 
 from esslivedata_tpu.core.timestamp import Timestamp
 from esslivedata_tpu.dashboard.extractors import (
@@ -140,3 +141,28 @@ class TestWindowAggregation:
         buf.put(T(1), spectrum([2.0, 4.0]))
         agg = WindowAggregatingExtractor(1.0).extract(buf)
         np.testing.assert_array_equal(agg.values, [2.0, 4.0])
+
+
+class TestAutoAggregation:
+    """Unit-aware 'auto' operation (reference extractors_test): counts
+    SUM over a window; intensive quantities (temperature) AVERAGE."""
+
+    def _buffer_with(self, unit, values):
+        buf = TemporalBuffer()
+        for i, v in enumerate(values):
+            buf.put(T(int(i * 1e9)), spectrum([float(v)], unit=unit))
+        return buf
+
+    def test_counts_auto_sums(self):
+        buf = self._buffer_with("counts", [1.0, 2.0, 3.0])
+        out = WindowAggregatingExtractor(100.0, "auto").extract(buf)
+        assert float(np.asarray(out.values).sum()) == 6.0
+
+    def test_non_counts_auto_means(self):
+        buf = self._buffer_with("K", [1.0, 2.0, 3.0])
+        out = WindowAggregatingExtractor(100.0, "auto").extract(buf)
+        assert float(np.asarray(out.values).sum()) == pytest.approx(2.0)
+
+    def test_invalid_operation_rejected(self):
+        with pytest.raises(ValueError, match="aggregation"):
+            WindowAggregatingExtractor(1.0, "median")
