@@ -117,10 +117,14 @@ class WindowAggregatingExtractor(Extractor):
     def _resolve_operation(self, template: DataArray) -> str:
         """'auto' is unit-aware (reference extractors: counts use nansum,
         everything else nanmean): counts over a window ADD; intensive
-        quantities (temperatures, positions) AVERAGE."""
+        quantities (temperatures, positions) AVERAGE. Structural unit
+        comparison: 'count' and 'counts' are both registered spellings
+        of the same unit and must both sum."""
         if self._operation != "auto":
             return self._operation
-        return "sum" if repr(template.unit) == "counts" else "mean"
+        from ..utils.units import unit as parse_unit
+
+        return "sum" if template.unit == parse_unit("counts") else "mean"
 
     def extract(self, buffer: Buffer) -> Any:
         if isinstance(buffer, TemporalBuffer):

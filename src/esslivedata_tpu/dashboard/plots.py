@@ -247,12 +247,13 @@ class PlotParams:
 
         if self.extractor == "full_history":
             return FullHistoryExtractor()
-        if self.extractor == "window_sum":
-            return WindowAggregatingExtractor(self.window_s, "sum")
-        if self.extractor == "window_mean":
-            return WindowAggregatingExtractor(self.window_s, "mean")
-        if self.extractor == "window_auto":
-            return WindowAggregatingExtractor(self.window_s, "auto")
+        if self.extractor.startswith("window_"):
+            # The operation IS the suffix (window_sum/mean/auto) — one
+            # branch for all, validated against EXTRACTOR_CHOICES
+            # upstream.
+            return WindowAggregatingExtractor(
+                self.window_s, self.extractor.removeprefix("window_")
+            )
         return None
 
     def _norm(self, data: "np.ndarray | None" = None):

@@ -158,6 +158,13 @@ class TestAutoAggregation:
         out = WindowAggregatingExtractor(100.0, "auto").extract(buf)
         assert float(np.asarray(out.values).sum()) == 6.0
 
+    def test_count_spelling_also_sums(self):
+        # 'count' and 'counts' are registered spellings of one unit:
+        # structural comparison must treat both as summing.
+        buf = self._buffer_with("count", [1.0, 2.0, 3.0])
+        out = WindowAggregatingExtractor(100.0, "auto").extract(buf)
+        assert float(np.asarray(out.values).sum()) == 6.0
+
     def test_non_counts_auto_means(self):
         buf = self._buffer_with("K", [1.0, 2.0, 3.0])
         out = WindowAggregatingExtractor(100.0, "auto").extract(buf)
