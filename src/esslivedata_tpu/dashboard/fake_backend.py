@@ -63,39 +63,74 @@ class InProcessBackendTransport:
 
         prefix = instrument
 
-        det_streams = [
-            FakeDetectorStream(
-                topic=f"{prefix}_detector",
-                source_name=det.source_name,
-                detector_ids=(
-                    det.detector_number
-                    if det.detector_number is not None
-                    else det.pixel_ids
-                ),
-                events_per_pulse=events_per_pulse,
-                seed=i,
-            )
-            for i, det in enumerate(instrument_obj.detectors.values())
-        ]
-        mon_streams = [
-            FakeMonitorStream(
-                topic=f"{prefix}_monitor",
-                source_name=mon.source_name,
-                events_per_pulse=max(10, events_per_pulse // 10),
-                seed=i,
-            )
-            for i, mon in enumerate(instrument_obj.monitors.values())
-        ]
-        log_streams = [
-            FakeLogStream(topic=f"{prefix}_motion", source_name=source)
-            for source in instrument_obj.log_sources.values()
-        ]
+        def make_streams():
+            """Fresh stream INSTANCES with fixed per-stream seeds: every
+            service consuming a topic sees the identical event sequence
+            (as production consumers of one topic do) while keeping its
+            own pulse counters. Seed offsets per kind keep detector and
+            monitor RNG streams uncorrelated."""
+            det = [
+                FakeDetectorStream(
+                    topic=f"{prefix}_detector",
+                    source_name=d.source_name,
+                    detector_ids=(
+                        d.detector_number
+                        if d.detector_number is not None
+                        else d.pixel_ids
+                    ),
+                    events_per_pulse=events_per_pulse,
+                    seed=i,
+                )
+                for i, d in enumerate(instrument_obj.detectors.values())
+            ]
+            mon = [
+                FakeMonitorStream(
+                    topic=f"{prefix}_monitor",
+                    source_name=m.source_name,
+                    events_per_pulse=max(10, events_per_pulse // 10),
+                    seed=500 + i,
+                )
+                for i, m in enumerate(instrument_obj.monitors.values())
+            ]
+            log = [
+                FakeLogStream(topic=f"{prefix}_motion", source_name=source)
+                for source in instrument_obj.log_sources.values()
+            ]
+            return det, mon, log
 
-        for make_builder, streams, svc in (
+        det_streams, mon_streams, log_streams = make_streams()
+
+        service_plan = [
             (make_detector_service_builder, det_streams, "detector_data"),
             (make_monitor_service_builder, mon_streams, "monitor_data"),
             (make_timeseries_service_builder, log_streams, "timeseries"),
+        ]
+        # Reduction workflows (SANS/powder/Q-E/reflectometry) live on
+        # their own service; without it the demo UI could not start any
+        # data_reduction spec. Only spun up when the instrument has one.
+        # Its streams are fresh INSTANCES with the SAME seeds: identical
+        # bytes per topic, independent pulse counters.
+        from ..config.route_derivation import spec_service
+        from ..workflows.workflow_factory import workflow_registry
+
+        if any(
+            spec_service(sp) == "data_reduction"
+            for sp in workflow_registry.specs_for_instrument(instrument)
         ):
+            from ..services.data_reduction import (
+                make_reduction_service_builder,
+            )
+
+            rdet, rmon, rlog = make_streams()
+            service_plan.append(
+                (
+                    make_reduction_service_builder,
+                    rdet + rmon + rlog,
+                    "data_reduction",
+                )
+            )
+
+        for make_builder, streams, svc in service_plan:
             # Snappy heartbeats: tick-driven tests and the demo UI should
             # not wait 2 s wall time to observe job-state changes.
             builder = make_builder(

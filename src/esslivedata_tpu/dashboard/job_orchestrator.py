@@ -113,7 +113,11 @@ class JobOrchestrator:
             source_name, job_id.job_number, "start_job"
         )
         self._record_active(
-            str(workflow_id), source_name, params, job_id.job_number
+            str(workflow_id),
+            source_name,
+            params,
+            job_id.job_number,
+            aux_source_names or {},
         )
         if prev:
             # Clear-at-commit (reference semantics): recommitting a
@@ -140,13 +144,22 @@ class JobOrchestrator:
 
     # -- active-config persistence ----------------------------------------
     def _record_active(
-        self, wid: str, source_name: str, params: dict, job_number: uuid.UUID
+        self,
+        wid: str,
+        source_name: str,
+        params: dict,
+        job_number: uuid.UUID,
+        aux_source_names: dict | None = None,
     ) -> None:
         with self._active_lock:
             doc = self._active.setdefault(wid, {})
             doc[source_name] = {
                 "params": params,
                 "job_number": str(job_number),
+                # The full desired state: restart-with-params must not
+                # silently drop the aux binding (e.g. which monitor
+                # normalizes a SANS reduction).
+                "aux_source_names": aux_source_names or {},
             }
             self._restored_pending.pop((wid, source_name), None)
             if self._store is not None:

@@ -966,8 +966,15 @@ function renderJobsView(s) {
     rs.onclick = () => {
       const w = (lastState.workflows || []).find(
         x => x.workflow_id === j.workflow_id);
-      if (w) openWizard(w, j.source_name,
-        {initialParams: j.params || {}, replace: j});
+      if (w) {
+        const active = ((lastState.active_configs || {})[j.workflow_id]
+          || {})[j.source_name] || {};
+        openWizard(w, j.source_name, {
+          initialParams: j.params || {},
+          initialAux: active.aux_source_names || {},
+          replace: j,
+        });
+      }
     };
     act.appendChild(rs);
     row.appendChild(act);
@@ -1102,6 +1109,25 @@ function openWizard(w, src, opts) {
     form.appendChild(row);
     fields[f.name] = {input, err, kind: f.kind};
   }
+  // Aux-source binding (reference configuration_widget): one select per
+  // declared role; '(default)' leaves the role to the factory fallback.
+  const auxSelects = {};
+  const initialAux = opts.initialAux || {};
+  for (const [role, choices] of Object.entries(w.aux_source_names || {})) {
+    const row = el('div');
+    row.appendChild(el('label', '', role + ' '));
+    const sel = document.createElement('select');
+    const dflt = el('option', '', '(default)'); dflt.value = '';
+    sel.appendChild(dflt);
+    for (const c of choices) {
+      const o = el('option', '', c); o.value = c;
+      sel.appendChild(o);
+    }
+    if (initialAux[role]) sel.value = initialAux[role];
+    row.appendChild(sel);
+    form.appendChild(row);
+    auxSelects[role] = sel;
+  }
   const status = el('small', '', ''); status.style.color = '#b00020';
   const go = el('button', '', 'Stage + start');
   const cancel = el('button', '', 'Cancel');
@@ -1112,8 +1138,14 @@ function openWizard(w, src, opts) {
       raw: fields[name].input.value,
       checked: fields[name].input.checked,
     }));
-    const payload = JSON.stringify(
-      {workflow_id: w.workflow_id, source_name: src, params});
+    const aux = {};
+    for (const [role, sel] of Object.entries(auxSelects)) {
+      if (sel.value) aux[role] = sel.value;
+    }
+    const payload = JSON.stringify({
+      workflow_id: w.workflow_id, source_name: src, params,
+      ...(Object.keys(aux).length ? {aux_source_names: aux} : {}),
+    });
     const staged = await fetch('/api/workflow/stage',
       {method: 'POST', body: payload});
     if (!staged.ok) {

@@ -87,6 +87,9 @@ def _workflow_entry(spec) -> dict:
             "workflow_id": key,
             "title": spec.title or spec.name,
             "source_names": spec.source_names,
+            # role -> candidate streams; the wizard renders a select per
+            # role (reference configuration_widget aux selection).
+            "aux_source_names": spec.aux_source_names,
             "params_schema": schema,
             # Server-derived wizard fields (formspec.py): the client
             # renders these mechanically instead of interpreting the

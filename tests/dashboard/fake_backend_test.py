@@ -115,3 +115,52 @@ class TestJobAdoption:
                 "panel_0", job_id.job_number
             )
         assert adopted
+
+
+class TestReductionServiceInFakeBackend:
+    def test_aux_bound_sans_workflow_runs(self):
+        """The demo backend hosts the data_reduction service for
+        instruments that declare reduction specs: an aux-bound SANS
+        start (transmission_monitor select in the wizard) goes active
+        and publishes I(Q) + transmission outputs."""
+        from esslivedata_tpu.config.instrument import instrument_registry
+        from esslivedata_tpu.config.workflow_spec import WorkflowId
+
+        instrument_registry["loki"].load_factories()
+        transport = InProcessBackendTransport("loki", events_per_pulse=200)
+        services = DashboardServices(transport=transport, instrument="loki")
+        wid = WorkflowId.parse("loki/sans/iq/v1")
+        services.orchestrator.stage(wid, "larmor_detector", {})
+        job_id, pending = services.orchestrator.commit(
+            wid,
+            "larmor_detector",
+            aux_source_names={"transmission_monitor": "monitor_2"},
+        )
+        for _ in range(50):
+            transport.tick()
+            services.pump.pump_once()
+        assert pending.resolved
+        assert any(
+            j.job_number == job_id.job_number and j.state == "active"
+            for j in services.job_service.jobs()
+        )
+        outputs = {
+            k.output_name
+            for k in services.data_service.keys()
+            if k.job_id.job_number == job_id.job_number
+        }
+        assert {"iq_current", "transmission_current"} <= outputs
+
+    def test_dummy_has_no_reduction_service(self):
+        # dummy declares no data_reduction specs: the demo backend must
+        # not spin an idle fourth service for it.
+        transport = InProcessBackendTransport("dummy", events_per_pulse=10)
+        services = DashboardServices(transport=transport)
+        for _ in range(8):
+            transport.tick()
+            services.pump.pump_once()
+        kinds = {
+            s.service_id.split(":")[1]
+            for s in services.job_service.services()
+        }
+        assert kinds == {"detector_data", "monitor_data", "timeseries"}

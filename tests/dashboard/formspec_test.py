@@ -102,3 +102,27 @@ class TestSchemaToFormspec:
                     assert f["kind"] in kinds, (name, spec.name, f)
                 checked += 1
         assert checked > 0
+
+
+class TestWorkflowEntry:
+    def test_carries_aux_source_names(self):
+        """The wizard renders one select per aux role (reference
+        configuration_widget): the state entry must carry the role ->
+        choices mapping."""
+        from esslivedata_tpu.config.instrument import instrument_registry
+        from esslivedata_tpu.dashboard.web import _workflow_entry
+        from esslivedata_tpu.workflows.workflow_factory import (
+            workflow_registry,
+        )
+
+        instrument_registry["loki"].load_factories()
+        spec = next(
+            s
+            for s in workflow_registry.specs_for_instrument("loki")
+            if s.name == "iq"
+        )
+        entry = _workflow_entry(spec)
+        assert entry["aux_source_names"] == {
+            "monitor": ["monitor_1", "monitor_2"],
+            "transmission_monitor": ["monitor_1", "monitor_2"],
+        }

@@ -236,3 +236,33 @@ class TestActiveConfigPersistence:
         stops = [c for c in transport.commands if c.get("action") == "stop"]
         assert len(stops) == 1
         assert stops[0]["job_number"] == current
+
+
+class TestActiveConfigAux:
+    def test_aux_binding_recorded_and_restored(self):
+        """The active record carries the FULL desired state incl. aux
+        bindings, so restart-with-params can re-offer them (reference
+        configuration_widget restores aux selections)."""
+        from esslivedata_tpu.config.instrument import instrument_registry
+        from esslivedata_tpu.config.workflow_spec import WorkflowId
+
+        instrument_registry["loki"].load_factories()
+        store = MemoryConfigStore()
+        js, orch, _ = make_pair(store)
+        wid = WorkflowId.parse("loki/sans/iq/v1")
+        orch.stage(wid, "larmor_detector", {})
+        orch.commit(
+            wid,
+            "larmor_detector",
+            aux_source_names={"transmission_monitor": "monitor_2"},
+        )
+        entry = orch.active_config(wid)["larmor_detector"]
+        assert entry["aux_source_names"] == {
+            "transmission_monitor": "monitor_2"
+        }
+        # Survives a restart through the store.
+        js2, orch2, _ = make_pair(store)
+        entry2 = orch2.active_config(wid)["larmor_detector"]
+        assert entry2["aux_source_names"] == {
+            "transmission_monitor": "monitor_2"
+        }
