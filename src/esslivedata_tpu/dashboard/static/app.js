@@ -42,6 +42,21 @@ function confirmDialog(message, detail) {
     no.focus();
   });
 }
+// Escape closes the topmost modal (reference modal_escape_closer):
+// confirm dialogs settle as Cancel, editors just close.
+document.addEventListener('keydown', (ev) => {
+  if (ev.key !== 'Escape') return;
+  const confirm = document.getElementById('confirm-modal');
+  if (confirm) {
+    if (confirm._resolve) confirm._resolve(false);
+    confirm.remove();
+    return;
+  }
+  for (const id of ['wizard', 'cellcfg']) {
+    const box = document.getElementById(id);
+    if (box) { box.remove(); return; }
+  }
+});
 function setTab(t) {
   tab = t; gen = -1; gridGens = {};
   for (const name of ['grids', 'flat', 'jobsview', 'system', 'corr', 'log']) {
