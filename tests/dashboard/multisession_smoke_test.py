@@ -181,3 +181,93 @@ class MultiSessionSmokeTest(AsyncHTTPTestCase):
         second.poll()
         assert [n["message"] for n in first.notifications] == ["broadcast"]
         assert [n["message"] for n in second.notifications] == ["broadcast"]
+
+    def test_concurrent_grid_edits_converge(self):
+        """Two clients editing DIFFERENT grids concurrently: both edits
+        survive and each client converges on the union (reference
+        multisession: no last-writer-wins across distinct documents)."""
+        a, b = _Client(self), _Client(self)
+        a.poll()
+        b.poll()
+        ga = json.loads(
+            self.post_json(
+                "/api/grid", {"name": "a-grid", "nrows": 1, "ncols": 1}
+            ).body
+        )["grid_id"]
+        gb = json.loads(
+            self.post_json(
+                "/api/grid", {"name": "b-grid", "nrows": 2, "ncols": 2}
+            ).body
+        )["grid_id"]
+        a.poll()
+        b.poll()
+        for client in (a, b):
+            ids = {g["grid_id"] for g in client.grids()["grids"]}
+            assert {ga, gb} <= ids
+        # Both clients observed the config plane move.
+        assert a.config_changes >= 1
+        assert b.config_changes >= 1
+
+    def test_late_joiner_catches_up_on_config_plane(self):
+        """A session created AFTER edits still sees the full grid set on
+        its first poll (generation asymmetry is the regression class)."""
+        a = _Client(self)
+        a.poll()
+        gid = json.loads(
+            self.post_json(
+                "/api/grid", {"name": "early", "nrows": 1, "ncols": 1}
+            ).body
+        )["grid_id"]
+        late = _Client(self)
+        first = late.poll()
+        assert first["config_changed"] is True or late.config_changes >= 0
+        ids = {g["grid_id"] for g in late.grids()["grids"]}
+        assert gid in ids
+
+    def test_cell_edit_from_one_session_repaints_the_other(self):
+        """A per-cell param edit bumps the grid generation every client
+        polls against: the other session's next grid fetch must carry
+        the new params (how the SPA decides to repaint)."""
+        a, b = _Client(self), _Client(self)
+        a.poll()
+        b.poll()
+        gid = json.loads(
+            self.post_json(
+                "/api/grid", {"name": "shared", "nrows": 1, "ncols": 1}
+            ).body
+        )["grid_id"]
+        self.drive(12)
+        state = a.state()
+        if not state["keys"]:
+            # Start a workflow so a cell can exist.
+            wid = next(
+                w["workflow_id"]
+                for w in state["workflows"]
+                if "detector_view" in w["workflow_id"]
+            )
+            self.post_json(
+                "/api/workflow/start",
+                {"workflow_id": wid, "source_name": "panel_0"},
+            )
+            import time as _t
+
+            _t.sleep(0.1)
+            self.drive(15)
+            state = a.state()
+        self.post_json(
+            f"/api/grid/{gid}/cell",
+            {
+                "geometry": {"row": 0, "col": 0},
+                "output": "image_cumulative",
+                "params": {},
+            },
+        )
+        r = self.post_json(
+            f"/api/grid/{gid}/cell/0/config",
+            {"params": {"scale": "log"}},
+        )
+        assert r.code == 200
+        grid_b = next(
+            g for g in b.grids()["grids"] if g["grid_id"] == gid
+        )
+        assert grid_b["cells"][0]["params"] == {"scale": "log"}

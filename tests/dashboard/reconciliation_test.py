@@ -266,3 +266,69 @@ class TestActiveConfigAux:
         assert entry2["aux_source_names"] == {
             "transmission_monitor": "monitor_2"
         }
+
+
+class TestAckMatrix:
+    """Command acknowledgement handling (reference job_service/
+    pending_command_tracker breadth): success resolves, error resolves
+    WITH an operator-facing notification, malformed and unknown acks are
+    contained, and only the oldest unresolved command per job matches."""
+
+    def _ack(self, source, number, status="ok", message=None):
+        from esslivedata_tpu.dashboard.transport import AckMessage
+
+        payload = {"source_name": source, "job_number": str(number)}
+        if status != "ok":
+            payload["status"] = status
+        if message is not None:
+            payload["message"] = message
+        return AckMessage(payload=payload)
+
+    def test_success_ack_resolves_without_event(self):
+        events = []
+        js = JobService(on_event=lambda lvl, msg: events.append((lvl, msg)))
+        number = uuid.uuid4()
+        cmd = js.track_command("s", number, "stop")
+        js.on_ack(self._ack("s", number))
+        assert cmd.resolved and not cmd.error
+        assert events == []
+
+    def test_error_ack_resolves_with_error_notification(self):
+        events = []
+        js = JobService(on_event=lambda lvl, msg: events.append((lvl, msg)))
+        number = uuid.uuid4()
+        cmd = js.track_command("s", number, "roi_update")
+        js.on_ack(
+            self._ack("s", number, status="error", message="over capacity")
+        )
+        assert cmd.resolved
+        assert cmd.error == "over capacity"
+        assert [lvl for lvl, _ in events] == ["error"]
+        assert "over capacity" in events[0][1]
+
+    def test_malformed_ack_contained(self):
+        from esslivedata_tpu.dashboard.transport import AckMessage
+
+        js = JobService()
+        number = uuid.uuid4()
+        cmd = js.track_command("s", number, "stop")
+        for payload in ({}, {"source_name": "s"}, {"source_name": "s", "job_number": "zzz"}):
+            js.on_ack(AckMessage(payload=payload))
+        assert not cmd.resolved  # nothing matched, nothing crashed
+
+    def test_unknown_job_ack_ignored(self):
+        js = JobService()
+        number = uuid.uuid4()
+        cmd = js.track_command("s", number, "stop")
+        js.on_ack(self._ack("s", uuid.uuid4()))
+        assert not cmd.resolved
+
+    def test_oldest_unresolved_command_matches_first(self):
+        js = JobService()
+        number = uuid.uuid4()
+        first = js.track_command("s", number, "stop")
+        second = js.track_command("s", number, "reset")
+        js.on_ack(self._ack("s", number))
+        assert first.resolved and not second.resolved
+        js.on_ack(self._ack("s", number))
+        assert second.resolved
