@@ -1,0 +1,91 @@
+"""PlotParams validation matrix (reference plot_params_test): every
+rejection rule of the per-cell config surface, parametrized — a bad
+edit must 400 once at validation, never 500 per refresh — plus the
+persistence round trip being lossless."""
+
+import pytest
+
+from esslivedata_tpu.dashboard.plots import (
+    EXTRACTOR_CHOICES,
+    PLOTTER_CHOICES,
+    PlotParams,
+)
+
+
+class TestValidationMatrix:
+    @pytest.mark.parametrize(
+        ("raw", "match"),
+        [
+            ({"scale": "cubic"}, "scale"),
+            ({"extractor": "nope"}, "extractor"),
+            ({"plotter": "holo"}, "plotter"),
+            ({"vmin": "5", "vmax": "5"}, "vmin must be < vmax"),
+            ({"vmin": "9", "vmax": "2"}, "vmin must be < vmax"),
+            ({"xmin": "3", "xmax": "3"}, "xmin must be < xmax"),
+            ({"scale": "log", "vmax": "0"}, "log scale"),
+            ({"scale": "log", "vmax": "-5"}, "log scale"),
+            ({"extractor": "window_sum"}, "window_s"),
+            ({"extractor": "window_mean", "window_s": "0"}, "window_s"),
+            ({"extractor": "window_auto", "window_s": "-2"}, "window_s"),
+            ({"slice": "-1"}, "slice"),
+            ({"flatten_split": "0"}, "flatten_split"),
+        ],
+    )
+    def test_rejections(self, raw, match):
+        with pytest.raises(ValueError, match=match):
+            PlotParams.from_dict(raw)
+
+    @pytest.mark.parametrize(
+        "raw",
+        [
+            {},
+            None,
+            {"scale": "log", "vmin": "0.1", "vmax": "10"},
+            {"vmin": "", "vmax": "null"},  # unset spellings
+            {"extractor": "window_auto", "window_s": "5"},
+            {"history": "1"},  # back-compat flag upgrades the extractor
+            {"slice": "3", "flatten_split": "2"},
+        ],
+    )
+    def test_accepted(self, raw):
+        PlotParams.from_dict(raw)
+
+    def test_history_flag_upgrades_extractor(self):
+        assert PlotParams.from_dict({"history": "1"}).extractor == (
+            "full_history"
+        )
+
+    def test_every_choice_constant_is_valid(self):
+        for e in EXTRACTOR_CHOICES:
+            raw = {"extractor": e}
+            if e.startswith("window"):
+                raw["window_s"] = "5"
+            PlotParams.from_dict(raw)
+        for p in PLOTTER_CHOICES:
+            PlotParams.from_dict({"plotter": p})
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize(
+        "raw",
+        [
+            {},
+            {"scale": "log", "cmap": "magma", "vmin": "0.5", "vmax": "9"},
+            {"extractor": "window_sum", "window_s": "3.5"},
+            {"plotter": "slicer", "slice": "2"},
+            {"overlay": "1", "robust": "1", "errorbars": "1"},
+            {"vline": "4.5", "hline": "-1", "xmin": "0", "xmax": "10"},
+            {"flatten_split": "3"},
+        ],
+    )
+    def test_to_dict_from_dict_is_lossless(self, raw):
+        first = PlotParams.from_dict(raw)
+        again = PlotParams.from_dict(first.to_dict())
+        assert again == first
+
+    def test_defaults_omitted_from_persistence(self):
+        d = PlotParams.from_dict({}).to_dict()
+        assert d == {}, d
+        # And unset bounds never serialize as the string 'null'.
+        d = PlotParams.from_dict({"vmin": "", "vmax": "null"}).to_dict()
+        assert "vmin" not in d and "vmax" not in d
