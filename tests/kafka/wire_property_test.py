@@ -146,3 +146,77 @@ class TestTimestampProperties:
         ts = Timestamp.from_pulse_index(pulse) + Duration.from_ns(offset)
         assert ts.quantize() == Timestamp.from_pulse_index(pulse)
         assert ts.pulse_index() == pulse
+
+
+class TestControlPlaneRoundTrips:
+    """pl72/6s4t/x5f2 under generated inputs: the run-control and status
+    envelopes must round-trip any names/times the facility can produce
+    (incl. unicode run names and extreme uint64 times)."""
+
+    @settings(max_examples=50, deadline=None)
+    @given(
+        run=_SOURCE,
+        inst=_SOURCE,
+        start=st.integers(0, 2**63 - 1),
+        stop=st.integers(0, 2**63 - 1),
+        job=_SOURCE,
+        nexus=_SOURCE,
+        sid=_SOURCE,
+    )
+    def test_pl72_round_trip(self, run, inst, start, stop, job, nexus, sid):
+        msg = wire.RunStartMessage(
+            run_name=run,
+            instrument_name=inst,
+            start_time_ns=start,
+            stop_time_ns=stop,
+            job_id=job,
+            nexus_structure=nexus,
+            service_id=sid,
+        )
+        assert wire.decode_pl72(wire.encode_pl72(msg)) == msg
+
+    @settings(max_examples=50, deadline=None)
+    @given(
+        run=_SOURCE,
+        stop=st.integers(0, 2**63 - 1),
+        job=_SOURCE,
+        sid=_SOURCE,
+        cmd=_SOURCE,
+    )
+    def test_6s4t_round_trip(self, run, stop, job, sid, cmd):
+        msg = wire.RunStopMessage(
+            run_name=run,
+            stop_time_ns=stop,
+            job_id=job,
+            service_id=sid,
+            command_id=cmd,
+        )
+        assert wire.decode_6s4t(wire.encode_6s4t(msg)) == msg
+
+    @settings(max_examples=50, deadline=None)
+    @given(
+        name=_SOURCE,
+        status=st.sampled_from([0, 1, 2, 3, 4]),
+        update=st.integers(0, 2**31 - 1),
+        payload=st.dictionaries(
+            st.text(min_size=1, max_size=10),
+            st.one_of(st.integers(-1000, 1000), st.floats(allow_nan=False, allow_infinity=False), st.text(max_size=20)),
+            max_size=5,
+        ),
+    )
+    def test_x5f2_round_trip(self, name, status, update, payload):
+        import json as _json
+
+        env = wire.X5f2Status(
+            software_name=name,
+            software_version="1",
+            service_id="svc",
+            host_name="host",
+            process_id=1234,
+            update_interval_ms=update,
+            status_json=_json.dumps(payload),
+        )
+        out = wire.decode_x5f2(wire.encode_x5f2(env))
+        assert out.software_name == name
+        assert out.update_interval_ms == update
+        assert _json.loads(out.status_json) == payload
