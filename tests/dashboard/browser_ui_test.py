@@ -167,8 +167,11 @@ def test_grid_tabs_and_management(page):
     assert page.locator("#gridtabs button").count() == n_before + 1
     tab = page.locator("#gridtabs button", has_text="browser-made")
     assert tab.count() == 1
-    # Delete it again via its header ✕ (confirm auto-accepted).
+    # Delete it again via its header ✕. Destructive actions now gate
+    # behind the custom confirm modal (round 5), not window.confirm.
     page.locator("div[data-grid-id] h3 button", has_text="✕").last.click()
+    page.wait_for_selector("#confirm-modal", timeout=5_000)
+    page.locator("#confirm-modal button", has_text="Confirm").click()
     page.wait_for_timeout(1000)
     assert page.locator("#gridtabs button", has_text="browser-made").count() == 0
 
@@ -185,3 +188,35 @@ def test_cell_config_exposes_display_controls(page):
     for control in ("scale", "cmap", "vmin", "vmax", "xmin", "xmax"):
         assert control in text
     page.locator("#cellcfg button", has_text="Cancel").click()
+
+
+def test_system_tab_surfaces(page):
+    # Round 5: whole-fleet view + operator log production.
+    page.locator("#tab-system").click()
+    page.wait_for_selector("#system table", timeout=15_000)
+    text = page.locator("#system").inner_text()
+    for heading in ("Services", "Sessions", "Produce log value"):
+        assert heading in text, f"System tab missing {heading!r}"
+    # The log-producer select lists the instrument's declared log stream.
+    assert page.locator("#system select option", has_text="motor_x").count()
+
+
+def test_job_stop_gated_by_confirm_modal(page):
+    page.wait_for_selector("#jobs .job button", timeout=15_000)
+    n_jobs = page.locator("#jobs .job").count()
+    page.locator("#jobs .job button", has_text="stop").first.click()
+    page.wait_for_selector("#confirm-modal", timeout=5_000)
+    # Cancel: nothing happens, the job stays.
+    page.locator("#confirm-modal button", has_text="Cancel").click()
+    page.wait_for_timeout(500)
+    assert page.locator("#confirm-modal").count() == 0
+    assert page.locator("#jobs .job").count() == n_jobs
+
+
+def test_escape_closes_wizard(page):
+    page.wait_for_selector("#workflows button", timeout=15_000)
+    page.locator("#workflows button", has_text="panel_0").first.click()
+    page.wait_for_selector("#wizard")
+    page.keyboard.press("Escape")
+    page.wait_for_timeout(300)
+    assert page.locator("#wizard").count() == 0
