@@ -24,7 +24,9 @@ def main(argv: list[str] | None = None) -> int:
     parser = setup_arg_parser("esslivedata-tpu dashboard")
     parser.add_argument("--port", type=int, default=5007)
     parser.add_argument(
-        "--transport", choices=["fake", "kafka", "file"], default="fake"
+        "--transport",
+        choices=["fake", "kafka", "file", "none"],
+        default="fake",
     )
     parser.add_argument("--kafka-bootstrap", default=None, help="override the broker from the kafka config namespace")
     parser.add_argument(
@@ -76,6 +78,12 @@ def main(argv: list[str] | None = None) -> int:
         transport = InProcessBackendTransport(
             args.instrument, events_per_pulse=args.events_per_pulse
         )
+    elif args.transport == "none":
+        # UI-only mode (reference transport='none'): no backend at all —
+        # grid/layout editing and screenshots without data or brokers.
+        from .transport import NullTransport
+
+        transport = NullTransport()
     elif args.transport == "file":
         if not args.broker_dir:
             parser.error("--transport file requires --broker-dir")

@@ -164,3 +164,46 @@ class TestReductionServiceInFakeBackend:
             for s in services.job_service.services()
         }
         assert kinds == {"detector_data", "monitor_data", "timeseries"}
+
+
+class TestNullTransportMode:
+    def test_dashboard_serves_ui_only(self):
+        """transport='none' (reference dashboard_null_transport): the
+        full web surface works with no backend — state is empty but
+        valid, grids are editable, and no command can leak anywhere."""
+        import json as _json
+
+        from esslivedata_tpu.dashboard.web import make_app
+        from tornado.testing import AsyncHTTPTestCase
+
+        outer = self
+
+        class _T(AsyncHTTPTestCase):
+            def get_app(self):
+                return make_app(
+                    DashboardServices(transport=NullTransport()), "dummy"
+                )
+
+            def runTest(self):
+                state = _json.loads(self.fetch("/api/state").body)
+                assert state["keys"] == []
+                assert state["services"] == []
+                assert state["jobs"] == []
+                assert state["workflows"]  # registry still lists specs
+                r = self.fetch(
+                    "/api/grid",
+                    method="POST",
+                    body=_json.dumps(
+                        {"name": "layout", "nrows": 1, "ncols": 1}
+                    ),
+                )
+                assert r.code == 200
+                grids = _json.loads(self.fetch("/api/grids").body)["grids"]
+                assert any(g["title"] == "layout" for g in grids)
+
+        case = _T()
+        case.setUp()
+        try:
+            case.runTest()
+        finally:
+            case.tearDown()
