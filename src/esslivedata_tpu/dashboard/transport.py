@@ -135,6 +135,10 @@ def decode_backend_message(
 
 
 class NullTransport:
+    #: UI-only mode: no backend exists, so command-issuing endpoints
+    #: must 501 instead of silently stranding forever-PENDING jobs.
+    can_command = False
+
     """No backend at all (unit tests of pure-UI pieces)."""
 
     def publish_command(self, payload: dict[str, Any]) -> None:

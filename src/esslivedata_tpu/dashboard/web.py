@@ -178,6 +178,18 @@ class _Base(tornado.web.RequestHandler):
         self.set_header("Content-Type", "application/json")
         self.write(json.dumps(payload))
 
+    def require_command_plane(self) -> bool:
+        """False (+501 response) when the transport cannot carry
+        commands (UI-only --transport none): issuing one would strand a
+        forever-PENDING job with no hint why."""
+        if getattr(self.services.transport, "can_command", True):
+            return True
+        self.set_status(501)
+        self.write_json(
+            {"error": "UI-only mode (--transport none): no backend to command"}
+        )
+        return False
+
     def resolve_data(self, kid: str, param_keys: tuple[str, ...]):
         """Shared kid -> (key, params, data) resolution for the plot,
         meta and export endpoints: 404 for unknown keys/empty buffers,
@@ -370,6 +382,8 @@ class StateHandler(_Base):
 
 class StartWorkflowHandler(_Base):
     def post(self) -> None:
+        if not self.require_command_plane():
+            return
         body = json.loads(self.request.body or b"{}")
         try:
             wid = WorkflowId.parse(body["workflow_id"])
@@ -425,6 +439,8 @@ class CommitWorkflowHandler(_Base):
     """Phase two: publish the staged start command."""
 
     def post(self) -> None:
+        if not self.require_command_plane():
+            return
         body = json.loads(self.request.body or b"{}")
         try:
             wid = WorkflowId.parse(body["workflow_id"])
@@ -586,6 +602,8 @@ class CellManageHandler(_Base):
 
 class JobActionHandler(_Base):
     def post(self, action: str) -> None:
+        if not self.require_command_plane():
+            return
         import uuid as _uuid
 
         from ..config.workflow_spec import JobId
@@ -620,6 +638,8 @@ class JobBulkActionHandler(_Base):
 
         from ..config.workflow_spec import JobId
 
+        if not self.require_command_plane():
+            return
         body = json.loads(self.request.body or b"{}")
         action = body.get("action")
         jobs = body.get("jobs")
@@ -700,6 +720,8 @@ class LogdataHandler(_Base):
 
 class RoiHandler(_Base):
     def post(self) -> None:
+        if not self.require_command_plane():
+            return
         import uuid as _uuid
 
         from ..config.workflow_spec import JobId

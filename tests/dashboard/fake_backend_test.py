@@ -1,8 +1,11 @@
 """Dashboard <-> in-process backend round trip: the reference's
 FakeBackendTransport pattern, here with real services behind it."""
 
+import json
+
 import numpy as np
 import pytest
+from tornado.testing import AsyncHTTPTestCase
 
 from esslivedata_tpu.config.instruments.dummy.specs import (
     DETECTOR_VIEW_HANDLE,
@@ -166,44 +169,53 @@ class TestReductionServiceInFakeBackend:
         assert kinds == {"detector_data", "monitor_data", "timeseries"}
 
 
-class TestNullTransportMode:
-    def test_dashboard_serves_ui_only(self):
-        """transport='none' (reference dashboard_null_transport): the
-        full web surface works with no backend — state is empty but
-        valid, grids are editable, and no command can leak anywhere."""
-        import json as _json
+class TestNullUI(AsyncHTTPTestCase):
+    """transport='none' (reference dashboard_null_transport): the full
+    web surface works with no backend — state is empty but valid, grids
+    are editable, and command endpoints 501 instead of stranding
+    forever-PENDING jobs."""
 
+    def get_app(self):
         from esslivedata_tpu.dashboard.web import make_app
-        from tornado.testing import AsyncHTTPTestCase
 
-        outer = self
+        return make_app(
+            DashboardServices(transport=NullTransport()), "dummy"
+        )
 
-        class _T(AsyncHTTPTestCase):
-            def get_app(self):
-                return make_app(
-                    DashboardServices(transport=NullTransport()), "dummy"
-                )
+    def test_state_empty_but_valid(self):
+        state = json.loads(self.fetch("/api/state").body)
+        assert state["keys"] == []
+        assert state["services"] == []
+        assert state["jobs"] == []
+        assert state["workflows"]  # registry still lists specs
 
-            def runTest(self):
-                state = _json.loads(self.fetch("/api/state").body)
-                assert state["keys"] == []
-                assert state["services"] == []
-                assert state["jobs"] == []
-                assert state["workflows"]  # registry still lists specs
-                r = self.fetch(
-                    "/api/grid",
-                    method="POST",
-                    body=_json.dumps(
-                        {"name": "layout", "nrows": 1, "ncols": 1}
-                    ),
-                )
-                assert r.code == 200
-                grids = _json.loads(self.fetch("/api/grids").body)["grids"]
-                assert any(g["title"] == "layout" for g in grids)
+    def test_grids_editable(self):
+        r = self.fetch(
+            "/api/grid",
+            method="POST",
+            body=json.dumps({"name": "layout", "nrows": 1, "ncols": 1}),
+        )
+        assert r.code == 200
+        grids = json.loads(self.fetch("/api/grids").body)["grids"]
+        assert any(g["title"] == "layout" for g in grids)
 
-        case = _T()
-        case.setUp()
-        try:
-            case.runTest()
-        finally:
-            case.tearDown()
+    def test_command_endpoints_501(self):
+        for path, payload in (
+            (
+                "/api/workflow/start",
+                {"workflow_id": "x", "source_name": "y"},
+            ),
+            (
+                "/api/workflow/commit",
+                {"workflow_id": "x", "source_name": "y"},
+            ),
+            (
+                "/api/job/stop",
+                {"source_name": "y", "job_number": "0" * 32},
+            ),
+            ("/api/job/bulk", {"action": "stop", "jobs": [{}]}),
+            ("/api/roi", {"source_name": "y", "job_number": "0" * 32}),
+        ):
+            r = self.fetch(path, method="POST", body=json.dumps(payload))
+            assert r.code == 501, (path, r.code)
+            assert "UI-only" in json.loads(r.body)["error"]
