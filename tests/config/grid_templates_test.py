@@ -1,0 +1,84 @@
+"""Checked-in grid templates stay consistent with the workflow registry
+(reference plot_orchestrator/grid_template validation): every template
+of every instrument loads, every cell references a REGISTERED workflow
+and one of its DECLARED outputs (a spec rename must fail here, not as a
+silently-empty dashboard cell), geometries fit the grid, and the plot
+orchestrator seeds them."""
+
+import pytest
+
+from esslivedata_tpu.config.grid_template import load_grid_templates
+from esslivedata_tpu.config.instrument import instrument_registry
+from esslivedata_tpu.config.workflow_spec import WorkflowId
+from esslivedata_tpu.workflows.workflow_factory import workflow_registry
+
+INSTRUMENTS = sorted(instrument_registry.names())
+
+
+def _templates(instrument):
+    instrument_registry[instrument].load_factories()
+    return load_grid_templates(instrument)
+
+
+@pytest.mark.parametrize("instrument", INSTRUMENTS)
+def test_templates_reference_registered_outputs(instrument):
+    templates = _templates(instrument)  # loads the registry first
+    specs_by_id = {
+        str(s.identifier): s
+        for s in workflow_registry.specs_for_instrument(instrument)
+    }
+    for grid in templates:
+        for cell in grid.cells:
+            wid = cell.workflow
+            assert wid in specs_by_id, (
+                f"{instrument}/{grid.name}: cell references unregistered "
+                f"workflow {wid!r}"
+            )
+            spec = specs_by_id[wid]
+            # timeseries declares no static outputs (dynamic per stream).
+            if spec.outputs:
+                assert cell.output in spec.outputs, (
+                    f"{instrument}/{grid.name}: cell output "
+                    f"{cell.output!r} not declared by {wid}"
+                )
+
+
+@pytest.mark.parametrize("instrument", INSTRUMENTS)
+def test_template_geometries_fit_the_grid(instrument):
+    for grid in _templates(instrument):
+        occupied = set()
+        for cell in grid.cells:
+            g = cell.geometry
+            assert 0 <= g.row < grid.nrows, (instrument, grid.name)
+            assert 0 <= g.col < grid.ncols, (instrument, grid.name)
+            assert g.row + g.row_span <= grid.nrows, (instrument, grid.name)
+            assert g.col + g.col_span <= grid.ncols, (instrument, grid.name)
+            for r in range(g.row, g.row + g.row_span):
+                for c in range(g.col, g.col + g.col_span):
+                    assert (r, c) not in occupied, (
+                        f"{instrument}/{grid.name}: overlapping cells "
+                        f"at {(r, c)}"
+                    )
+                    occupied.add((r, c))
+
+
+@pytest.mark.parametrize("instrument", INSTRUMENTS)
+def test_orchestrator_seeds_enabled_templates(instrument):
+    from esslivedata_tpu.config.grid_template import GridSpec  # noqa: F401
+    from esslivedata_tpu.dashboard.data_service import DataService
+    from esslivedata_tpu.dashboard.frame_clock import FrameClock
+    from esslivedata_tpu.dashboard.config_store import MemoryConfigStore
+    from esslivedata_tpu.dashboard.plot_orchestrator import PlotOrchestrator
+
+    templates = [t for t in _templates(instrument) if t.enabled]
+    orch = PlotOrchestrator(
+        data_service=DataService(),
+        frame_clock=FrameClock(),
+        store=MemoryConfigStore(),
+        instrument=instrument,
+    )
+    seeded = {g.spec.name for g in orch.grids()}
+    for t in templates:
+        assert t.name in seeded, (
+            f"{instrument}: enabled template {t.name!r} not seeded"
+        )
