@@ -418,3 +418,50 @@ class TestLagInHeartbeat:
             p.lag_level in ("warning", "error") for p in service_docs
         )
         assert max(p.worst_lag_s for p in service_docs) > 100.0
+
+
+class TestHistogramMethodParam:
+    def test_pallas2d_service_publishes_identical_wire_bytes(self):
+        """histogram_method rides the start command into the factory:
+        two services, one per kernel, fed the SAME pulses, publish
+        byte-identical da00 images (the kernel is invisible on the
+        wire)."""
+        det = INSTRUMENT.detectors["panel_0"]
+
+        def run(method):
+            stream = FakeDetectorStream(
+                topic="dummy_detector",
+                source_name="panel_a",
+                detector_ids=det.detector_number,
+                events_per_pulse=300,
+                seed=9,
+            )
+            service, raw, producer = make_detector_service([stream])
+            raw.inject(
+                start_command(
+                    DETECTOR_VIEW_HANDLE.workflow_id,
+                    "panel_0",
+                    params={"histogram_method": method},
+                )
+            )
+            for _ in range(4):
+                service.step()
+            out = {}
+            for m in producer.messages:
+                if m.topic != "dummy_livedata_data":
+                    continue
+                da00 = wire.decode_da00(m.value)
+                key = da00.source_name.split("|")[-1]
+                if key in ("image_cumulative", "spectrum_cumulative"):
+                    signal = next(
+                        v for v in da00.variables if v.name == "signal"
+                    )
+                    out[key] = signal.data
+            return out
+
+    
+        a = run("scatter")
+        b = run("pallas2d")
+        assert a.keys() == b.keys() and a
+        for key in a:
+            np.testing.assert_array_equal(a[key], b[key])
