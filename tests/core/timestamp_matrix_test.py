@@ -1,0 +1,130 @@
+"""Timestamp/Duration algebra matrix (reference timestamp_test breadth):
+the exact-integer time core every batching/windowing decision rides on —
+constructors, the closure table of arithmetic types, type-safety raises,
+comparisons/hash, and pulse-grid exactness at large indices."""
+
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from esslivedata_tpu.core.constants import (
+    PULSE_PERIOD_NS_DEN,
+    PULSE_PERIOD_NS_NUM,
+)
+from esslivedata_tpu.core.timestamp import Duration, Timestamp
+
+
+class TestConstructors:
+    def test_duration_units(self):
+        assert Duration.from_s(1.5).ns == 1_500_000_000
+        assert Duration.from_ms(2.0).ns == 2_000_000
+        assert Duration.from_ns(7).ns == 7
+        assert Duration.from_value(3, "s").ns == 3_000_000_000
+
+    def test_timestamp_units(self):
+        assert Timestamp.from_value(1.5, "s").ns == 1_500_000_000
+        assert Timestamp.from_ns(42).ns == 42
+
+    def test_seconds_round_trip(self):
+        assert Duration.from_s(0.25).seconds == 0.25
+        assert Timestamp.from_value(2.5, "s").seconds == 2.5
+
+    def test_now_is_recent(self):
+        import time
+
+        assert abs(Timestamp.now().ns - time.time_ns()) < 5e9
+
+
+class TestAlgebraClosure:
+    T = Timestamp.from_ns
+    D = Duration.from_ns
+
+    def test_timestamp_plus_duration_is_timestamp(self):
+        out = self.T(100) + self.D(20)
+        assert isinstance(out, Timestamp) and out.ns == 120
+
+    def test_duration_plus_timestamp_is_timestamp(self):
+        out = self.D(20) + self.T(100)
+        assert isinstance(out, Timestamp) and out.ns == 120
+
+    def test_timestamp_minus_timestamp_is_duration(self):
+        out = self.T(150) - self.T(100)
+        assert isinstance(out, Duration) and out.ns == 50
+
+    def test_timestamp_minus_duration_is_timestamp(self):
+        out = self.T(150) - self.D(100)
+        assert isinstance(out, Timestamp) and out.ns == 50
+
+    def test_duration_algebra(self):
+        assert (self.D(10) + self.D(5)).ns == 15
+        assert (self.D(10) - self.D(5)).ns == 5
+        assert (self.D(10) * 2.5).ns == 25
+        assert (-self.D(10)).ns == -10
+        assert self.D(10) / self.D(4) == 2.5
+        half = self.D(10) / 2
+        assert isinstance(half, Duration) and half.ns == 5
+
+    def test_duration_bool(self):
+        assert not self.D(0)
+        assert self.D(1)
+
+    @pytest.mark.parametrize(
+        "op",
+        [
+            lambda: TestAlgebraClosure.T(1) + TestAlgebraClosure.T(2),
+            lambda: TestAlgebraClosure.T(1) + 5,
+            lambda: TestAlgebraClosure.T(1) - 5,
+            lambda: TestAlgebraClosure.D(1) + 5,
+            lambda: TestAlgebraClosure.D(1) - 5,
+        ],
+    )
+    def test_type_safety_raises(self, op):
+        with pytest.raises(TypeError):
+            op()
+
+
+class TestComparisonAndHash:
+    T = Timestamp.from_ns
+
+    def test_ordering(self):
+        assert self.T(1) < self.T(2) <= self.T(2)
+        assert self.T(3) > self.T(2) >= self.T(2)
+        assert self.T(2) == self.T(2)
+        assert self.T(2) != self.T(3)
+
+    def test_hash_follows_eq(self):
+        assert hash(self.T(5)) == hash(self.T(5))
+        assert len({self.T(5), self.T(5), self.T(6)}) == 2
+
+    def test_compare_with_int_raises(self):
+        with pytest.raises(TypeError):
+            _ = self.T(1) < 5
+
+
+class TestPulseGridExactness:
+    @settings(max_examples=100, deadline=None)
+    @given(st.integers(0, 10**12))
+    def test_pulse_index_round_trips_exactly(self, index):
+        # 1e9/14 ns is NOT an integer: the grid uses exact rational
+        # arithmetic so index -> time -> index never drifts, even at
+        # indices far beyond facility uptime.
+        assert Timestamp.from_pulse_index(index).pulse_index() == index
+
+    @settings(max_examples=100, deadline=None)
+    @given(st.integers(0, 10**12), st.integers(0, 10**6))
+    def test_quantize_is_idempotent_and_at_or_before(self, index, jitter):
+        t = Timestamp.from_ns(
+            Timestamp.from_pulse_index(index).ns + jitter
+        )
+        q = t.quantize()
+        assert q.ns <= t.ns
+        assert q.quantize() == q
+
+    def test_grid_spacing_matches_rational_period(self):
+        # 14 pulses must span exactly 1e9 ns (the rational period's
+        # whole-second closure), not 14 * round(1e9/14).
+        assert PULSE_PERIOD_NS_DEN == 14
+        assert PULSE_PERIOD_NS_NUM == 10**9
+        t0 = Timestamp.from_pulse_index(0)
+        t14 = Timestamp.from_pulse_index(14)
+        assert (t14 - t0).ns == 10**9
