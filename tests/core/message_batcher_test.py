@@ -167,3 +167,83 @@ class TestAdaptive:
         b3 = batcher.batch([msg(6 * 14)])
         assert b3 is not None
         assert math.isclose(b3.window.seconds, 2.0, rel_tol=0.01)
+
+
+class TestMessagePreservationAcrossResize:
+    """No message may be lost when the adaptive window resizes
+    (reference message_batcher_test's escalation/deescalation
+    preservation cluster): buffered active messages, future messages,
+    and everything in flight must come out in SOME batch exactly once."""
+
+    def make(self):
+        return AdaptiveMessageBatcher(Duration.from_s(1.0))
+
+    def _drain(self, batcher, feed, total_pulses):
+        """Feed pulses one at a time; collect every emitted batch."""
+        seen = []
+        for p in range(total_pulses):
+            out = batcher.batch([msg(p)] if p in feed else [])
+            if out:
+                seen.extend(m.value for m in out.messages)
+        return seen
+
+    def test_escalation_preserves_buffered_messages(self):
+        batcher = self.make()
+        feed = set(range(0, 70))
+        collected = []
+        for p in range(70):
+            out = batcher.batch([msg(p)])
+            if out:
+                collected.extend(m.value for m in out.messages)
+            if p == 20:
+                # Overload mid-stream: the window doubles underneath
+                # already-buffered messages.
+                batcher.report_processing_time(Duration.from_s(0.9))
+                batcher.report_processing_time(Duration.from_s(0.9))
+        # Flush what remains with far-future pulses.
+        for p in range(70, 140):
+            out = batcher.batch([msg(p)])
+            if out:
+                collected.extend(m.value for m in out.messages)
+        emitted = [v for v in collected if v < 70]
+        assert sorted(emitted) == list(range(70)), (
+            f"lost {set(range(70)) - set(emitted)} / "
+            f"dup {[v for v in emitted if emitted.count(v) > 1]}"
+        )
+
+    def test_deescalation_preserves_buffered_messages(self):
+        batcher = self.make()
+        for _ in range(2):
+            batcher.report_processing_time(Duration.from_s(0.9))
+        assert batcher.scale == 2.0
+        collected = []
+        for p in range(90):
+            out = batcher.batch([msg(p)])
+            if out:
+                collected.extend(m.value for m in out.messages)
+            if p == 40:
+                for _ in range(4):
+                    batcher.report_processing_time(Duration.from_s(0.05))
+        for p in range(90, 160):
+            out = batcher.batch([msg(p)])
+            if out:
+                collected.extend(m.value for m in out.messages)
+        emitted = [v for v in collected if v < 90]
+        assert sorted(emitted) == list(range(90))
+
+    def test_batches_never_overlap_and_stay_ordered(self):
+        batcher = self.make()
+        bounds = []
+        for p in range(120):
+            out = batcher.batch([msg(p)])
+            if out:
+                bounds.append((out.start.ns, out.end.ns))
+            if p == 30:
+                batcher.report_processing_time(Duration.from_s(0.9))
+                batcher.report_processing_time(Duration.from_s(0.9))
+            if p == 80:
+                for _ in range(4):
+                    batcher.report_processing_time(Duration.from_s(0.05))
+        for (s0, e0), (s1, e1) in zip(bounds, bounds[1:]):
+            assert e0 <= s1, f"windows overlap: {(s0, e0)} then {(s1, e1)}"
+            assert s0 < e0 and s1 < e1
