@@ -1,0 +1,65 @@
+"""StreamLag threshold model (reference job.py:132-138 / job_test):
+WARN at > 2 s stale, ERROR at > 0.1 s into the future, boundary
+behavior pinned exactly — operators tune runs against these colors."""
+
+import pytest
+
+from esslivedata_tpu.core.job import (
+    FUTURE_ERROR_THRESHOLD,
+    STALE_WARN_THRESHOLD,
+    StreamLag,
+    StreamLagReport,
+)
+
+
+def lag(lag_s, min_s=None):
+    return StreamLag(stream_name="s", lag_s=lag_s, min_s=min_s)
+
+
+class TestThresholds:
+    @pytest.mark.parametrize(
+        ("lag_s", "level"),
+        [
+            (0.0, "ok"),
+            (1.9, "ok"),
+            (2.0, "ok"),  # boundary: strictly greater warns
+            (2.0001, "warning"),
+            (60.0, "warning"),
+            (-0.05, "ok"),  # slight future: inside tolerance
+            (-0.1, "ok"),  # boundary: strictly beyond errors
+            (-0.11, "error"),
+            (-5.0, "error"),
+        ],
+    )
+    def test_levels(self, lag_s, level):
+        assert lag(lag_s).level == level
+
+    def test_future_error_beats_stale_warning(self):
+        # A window whose MIN went into the future errors even if the
+        # representative lag is stale: broken clocks must not hide
+        # behind backlog.
+        assert lag(5.0, min_s=-1.0).level == "error"
+
+    def test_window_min_drives_future_detection(self):
+        assert lag(0.0, min_s=-0.2).level == "error"
+        assert lag(0.0, min_s=0.0).level == "ok"
+
+    def test_constants_are_the_documented_contract(self):
+        assert STALE_WARN_THRESHOLD.seconds == 2.0
+        assert FUTURE_ERROR_THRESHOLD.seconds == 0.1
+
+
+class TestReportAggregation:
+    def test_worst_level_orders_error_over_warning(self):
+        report = StreamLagReport(
+            lags=[lag(3.0), lag(-1.0), lag(0.0)]
+        )
+        assert report.worst_level == "error"
+
+    def test_warning_when_no_error(self):
+        assert StreamLagReport(lags=[lag(3.0), lag(0.0)]).worst_level == (
+            "warning"
+        )
+
+    def test_empty_report_is_ok(self):
+        assert StreamLagReport().worst_level == "ok"
