@@ -324,3 +324,46 @@ class TestCorrelationAlignment:
             [10, 11, 12, 13], [1, 2, 3, 4], [9, 12], [5.0, 6.0]
         )
         np.testing.assert_array_equal(ay, [5.0, 5.0, 6.0, 6.0])
+
+
+class TestDerivedDeviceRegistryBreadth:
+    """Registry behaviors beyond latest-wins (reference
+    derived_devices_test breadth, adapted to the value-driven design:
+    devices exist exactly when their NICOS stream delivered a value)."""
+
+    def test_devices_sorted_by_name(self):
+        reg = DerivedDeviceRegistry()
+        for name in ("zeta", "alpha", "mid"):
+            reg.on_device_value(name, 1.0, timestamp_ns=1)
+        assert [d.name for d in reg.devices()] == ["alpha", "mid", "zeta"]
+
+    def test_unit_and_timestamp_carried(self):
+        reg = DerivedDeviceRegistry()
+        reg.on_device_value("t", 3.5, unit="K", timestamp_ns=42)
+        dev = reg.get("t")
+        assert dev.unit == "K" and dev.timestamp_ns == 42
+
+    def test_unknown_device_is_none(self):
+        assert DerivedDeviceRegistry().get("nope") is None
+
+    def test_staleness_after_silence(self, monkeypatch):
+        import esslivedata_tpu.dashboard.derived_devices as dd
+
+        reg = DerivedDeviceRegistry()
+        reg.on_device_value("m", 1.0, timestamp_ns=1)
+        assert not reg.get("m").is_stale
+        # Silence past the threshold: the sidebar greys it out.
+        monkeypatch.setattr(
+            dd.time, "monotonic", lambda: dd.time.time() + dd.STALE_AFTER_S + 60
+        )
+        assert reg.get("m").is_stale
+
+    def test_fresh_value_clears_staleness(self):
+        reg = DerivedDeviceRegistry()
+        reg.on_device_value("m", 1.0, timestamp_ns=1)
+        dev = reg.get("m")
+        dev.last_seen_wall -= 10_000  # force stale
+        assert dev.is_stale
+        reg.on_device_value("m", 2.0, timestamp_ns=2)
+        assert not reg.get("m").is_stale
+        assert reg.get("m").value == 2.0
