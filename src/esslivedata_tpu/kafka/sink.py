@@ -186,13 +186,46 @@ def make_default_serializer(
 
 
 class KafkaSink:
-    """MessageSink publishing through a producer with drop-on-full."""
+    """MessageSink publishing through a producer with drop-on-full.
+
+    Error policy mirrors the consume side's circuit breaker: transient
+    produce/flush exceptions are contained (counted, logged) — a broker
+    hiccup must not crash the service worker per message — but after
+    ``MAX_CONSECUTIVE_ERRORS`` in a row the breaker opens and the error
+    propagates, handing the supervisor a restart instead of a silent
+    black hole.
+    """
+
+    #: Consecutive produce failures before the breaker opens.
+    MAX_CONSECUTIVE_ERRORS = 10
 
     def __init__(self, producer: KafkaProducer, serializer: MessageSerializer):
         self._producer = producer
         self._serializer = serializer
         self.dropped = 0
         self.serialize_errors = 0
+        self.produce_errors = 0
+        self.flush_errors = 0
+        # Per-path failure continuity: a healthy flush must not mask a
+        # persistently failing produce (and vice versa), so each path
+        # trips its own breaker.
+        self._consecutive_produce = 0
+        self._consecutive_flush = 0
+
+    def _trip_or_warn(
+        self, consecutive: int, what: str, exc: BaseException
+    ) -> None:
+        if consecutive >= self.MAX_CONSECUTIVE_ERRORS:
+            logger.error(
+                "Producer circuit breaker open after %d consecutive "
+                "%s failures",
+                consecutive,
+                what,
+            )
+            raise exc
+        logger.warning(
+            "%s failed (%d consecutive); message dropped", what, consecutive
+        )
 
     def publish_messages(self, messages: Sequence[Message]) -> None:
         for msg in messages:
@@ -204,12 +237,32 @@ class KafkaSink:
                 continue
             try:
                 self._producer.produce(sm.topic, sm.value, sm.key)
-            except BufferError:
-                # Producer queue full: drop rather than stall the hot loop
-                # (reference sink.py:110-118).
+            except BufferError as err:
+                # Producer queue full: drop rather than stall the hot
+                # loop (reference sink.py:110-118) — but during an
+                # extended broker outage an async producer fails
+                # EXACTLY this way (the local queue never drains), so
+                # sustained drops must trip the breaker too instead of
+                # black-holing every message behind per-drop warnings.
                 self.dropped += 1
-                logger.warning("Producer buffer full; dropped message")
-        self._producer.flush(0)
+                self._consecutive_produce += 1
+                self._trip_or_warn(
+                    self._consecutive_produce, "produce (queue full)", err
+                )
+            except Exception as err:
+                self.produce_errors += 1
+                self._consecutive_produce += 1
+                self._trip_or_warn(self._consecutive_produce, "produce", err)
+            else:
+                self._consecutive_produce = 0
+        try:
+            self._producer.flush(0)
+        except Exception as err:
+            self.flush_errors += 1
+            self._consecutive_flush += 1
+            self._trip_or_warn(self._consecutive_flush, "flush", err)
+        else:
+            self._consecutive_flush = 0
 
 
 class UnrollingSinkAdapter:

@@ -171,3 +171,108 @@ class TestKafkaSink:
         status = wire.decode_x5f2(sent.value)
         assert status.service_id == "svc1"
         assert '"running"' in status.status_json
+
+
+class TestSinkProduceBreaker:
+    """Transient produce/flush exceptions are contained (a broker hiccup
+    must not crash the service worker per message); the breaker opens
+    after MAX_CONSECUTIVE_ERRORS and propagates for a supervisor
+    restart (reference kafka_sink_test's fatal/non-fatal split)."""
+
+    class _FlakyProducer:
+        def __init__(self, fail_times):
+            self.fail_times = fail_times
+            self.produced = []
+
+        def produce(self, topic, value, key=None):
+            if self.fail_times > 0:
+                self.fail_times -= 1
+                raise RuntimeError("transient broker error")
+            self.produced.append((topic, value))
+
+        def flush(self, timeout):
+            return 0
+
+    def _msg(self):
+        from esslivedata_tpu.core.message import Message, StreamId, StreamKind
+        from esslivedata_tpu.core.timestamp import Timestamp
+        from esslivedata_tpu.utils.labeled import DataArray, Variable
+        import numpy as np
+
+        return Message(
+            timestamp=Timestamp.from_ns(1),
+            stream=StreamId(kind=StreamKind.LIVEDATA_DATA, name="w/j|out"),
+            value=DataArray(Variable(np.ones(3), ("x",), "counts")),
+        )
+
+    def _sink(self, producer):
+        from esslivedata_tpu.kafka.sink import KafkaSink, make_default_serializer
+        from esslivedata_tpu.kafka.stream_mapping import LivedataTopics
+
+        return KafkaSink(
+            producer,
+            make_default_serializer(
+                LivedataTopics.for_instrument("dummy", False), "t"
+            ),
+        )
+
+    def test_transient_error_contained_and_next_message_flows(self):
+        producer = self._FlakyProducer(fail_times=2)
+        sink = self._sink(producer)
+        for _ in range(3):
+            sink.publish_messages([self._msg()])
+        assert sink.produce_errors == 2
+        assert sink.flush_errors == 0  # metrics stay split by path
+        assert len(producer.produced) == 1  # the third one made it
+
+    def test_breaker_opens_after_consecutive_failures(self):
+        from esslivedata_tpu.kafka.sink import KafkaSink
+
+        producer = self._FlakyProducer(fail_times=10**6)
+        sink = self._sink(producer)
+        with pytest.raises(RuntimeError, match="transient broker error"):
+            for _ in range(KafkaSink.MAX_CONSECUTIVE_ERRORS + 1):
+                sink.publish_messages([self._msg()])
+        assert sink.produce_errors == KafkaSink.MAX_CONSECUTIVE_ERRORS
+
+    def test_sustained_buffer_full_trips_the_breaker(self):
+        # An extended broker outage surfaces as BufferError from the
+        # async producer's full local queue: sustained drops must open
+        # the breaker, not black-hole messages forever.
+        from esslivedata_tpu.kafka.sink import KafkaSink
+
+        class _FullQueueProducer:
+            def produce(self, topic, value, key=None):
+                raise BufferError("queue full")
+
+            def flush(self, timeout):
+                return 1
+
+        sink = self._sink(_FullQueueProducer())
+        with pytest.raises(BufferError):
+            for _ in range(KafkaSink.MAX_CONSECUTIVE_ERRORS + 1):
+                sink.publish_messages([self._msg()])
+        assert sink.dropped == KafkaSink.MAX_CONSECUTIVE_ERRORS
+
+    def test_flush_success_does_not_mask_produce_failures(self):
+        # Per-path continuity: every produce fails while flush succeeds;
+        # the produce breaker must still open.
+        from esslivedata_tpu.kafka.sink import KafkaSink
+
+        producer = self._FlakyProducer(fail_times=10**6)
+        sink = self._sink(producer)
+        with pytest.raises(RuntimeError):
+            for _ in range(KafkaSink.MAX_CONSECUTIVE_ERRORS + 1):
+                sink.publish_messages([self._msg()])
+
+    def test_success_resets_the_breaker(self):
+        producer = self._FlakyProducer(fail_times=5)
+        sink = self._sink(producer)
+        for _ in range(6):
+            sink.publish_messages([self._msg()])
+        assert len(producer.produced) == 1
+        # Another burst below the threshold: still contained.
+        producer.fail_times = 5
+        for _ in range(6):
+            sink.publish_messages([self._msg()])
+        assert len(producer.produced) == 2
