@@ -134,6 +134,17 @@ class CommandDispatcher:
                     and jid.job_number == command.job_number
                 ):
                     wf = rec.job.workflow
+                    if wf is None:
+                        # Released on stop: the job is ours, so stay
+                        # audible — an error ack beats a silent timeout
+                        # on the dashboard side.
+                        return CommandAcknowledgement(
+                            source_name=command.source_name,
+                            job_number=command.job_number,
+                            status="error",
+                            message="job is stopped; ROI update ignored",
+                            service=self._service_name,
+                        )
                     if hasattr(wf, "set_rois"):
                         try:
                             from ..config.models import PolygonROI, RectangleROI

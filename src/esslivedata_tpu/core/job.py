@@ -170,7 +170,8 @@ class Job:
     ) -> None:
         self.job_id = job_id
         self.workflow_id = workflow_id
-        self.workflow = workflow
+        #: None after release(): a stopped job keeps metadata only.
+        self.workflow: Workflow | None = workflow
         self.params = dict(params or {})
         self.schedule = schedule or JobSchedule()
         self.primary_streams = primary_streams or {job_id.source_name}
@@ -210,6 +211,8 @@ class Job:
             }
         if not relevant:
             return False
+        if self.workflow is None:
+            raise RuntimeError(f"Job {self.job_id} is released (stopped)")
         if start is not None and self._generation_start is None:
             self._generation_start = start
         if end is not None:
@@ -231,6 +234,8 @@ class Job:
         stamping window-local coords on a per-update view) or a ``time``
         coord (timeseries data with its own timestamps) are left alone.
         """
+        if self.workflow is None:
+            raise RuntimeError(f"Job {self.job_id} is released (stopped)")
         outputs = self.workflow.finalize()
         start, end = self._generation_start, self._window_end
         for da in outputs.values():
@@ -265,6 +270,17 @@ class Job:
 
     def clear(self) -> None:
         """Reset accumulation; starts a new generation (start_time jumps)."""
-        self.workflow.clear()
+        if self.workflow is not None:
+            self.workflow.clear()
         self._generation_start = None
         self._window_end = None
+
+    def release(self) -> None:
+        """Drop the workflow instance (and with it the device-resident
+        accumulator state). Called when the job reaches STOPPED: the
+        record stays visible for status/removal, but a stopped
+        detector-view job must not pin hundreds of MB of HBM until an
+        operator clicks remove — under clear-at-commit every recommit
+        retires a predecessor, so leaked predecessors would accumulate
+        per recommit."""
+        self.workflow = None

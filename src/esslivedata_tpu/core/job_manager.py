@@ -504,6 +504,10 @@ class JobManager:
                     _Phase.SCHEDULED,
                 ):
                     rec.phase = _Phase.STOPPED
+                    # The final window just flushed above: free the
+                    # device-resident accumulator now instead of pinning
+                    # it until an operator removes the stopped record.
+                    rec.job.release()
         return [r for r in results if r is not None]
 
     # -- introspection -----------------------------------------------------

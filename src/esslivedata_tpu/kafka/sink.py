@@ -223,9 +223,9 @@ class KafkaSink:
                 what,
             )
             raise exc
-        logger.warning(
-            "%s failed (%d consecutive); message dropped", what, consecutive
-        )
+        # (Only a produce failure drops a message; a failed flush(0)
+        # leaves the batch queued in the producer.)
+        logger.warning("%s failed (%d consecutive)", what, consecutive)
 
     def publish_messages(self, messages: Sequence[Message]) -> None:
         for msg in messages:

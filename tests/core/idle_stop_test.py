@@ -112,3 +112,43 @@ class TestIdleStopCompletion:
         service.step()
         assert [str(j.state) for j in jm.job_statuses()] == ["stopped"]
         assert not jm.has_finishing_jobs()
+
+
+class TestStoppedJobReleasesDeviceState:
+    def test_workflow_released_on_stop_completion(self):
+        """A stopped job stays VISIBLE (status/remove) but must not pin
+        its device-resident accumulator: under clear-at-commit every
+        recommit retires a predecessor, so leaked predecessors would
+        accumulate HBM per recommit."""
+        det = FakeDetectorStream(
+            topic="dummy_detector",
+            source_name="panel_a",
+            detector_ids=np.arange(1, 4096, dtype=np.int32),
+            events_per_pulse=100,
+        )
+        service, raw = _service([det])
+        jm = service.processor._job_manager
+        job_id = JobId(source_name="panel_0")
+        _start(raw, job_id)
+        for _ in range(3):
+            service.step()
+        (rec,) = jm._records.values()
+        assert rec.job.workflow is not None
+        _stop(raw, job_id)
+        service.step()
+        service.step()
+        assert [str(j.state) for j in jm.job_statuses()] == ["stopped"]
+        assert rec.job.workflow is None  # device state freed
+        # Status metadata still serves (workflow_id/params ride the Job).
+        (status,) = jm.job_statuses()
+        assert status.workflow_id.endswith("panel_view/v1")
+        # And a reset command on the stopped record is a harmless no-op.
+        _cmd = {
+            "kind": "job_command",
+            "action": "reset",
+            "source_name": "panel_0",
+            "job_number": str(job_id.job_number),
+        }
+        raw.inject(_command(_cmd))
+        service.step()
+        assert [str(j.state) for j in jm.job_statuses()] == ["stopped"]
