@@ -10,7 +10,9 @@ cd /root/repo
 while true; do
   [ -e .stop_bench_loop ] && exit 0
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
-  line=$(timeout 650 python bench.py --probe-budget 30 --lock-wait 30 2>/dev/null | tail -1)
+  # probe budget 90: a recovering relay has shown healthy-but-slow init
+  # (44 s observed r5) — a 30 s budget misclassifies it as down.
+  line=$(timeout 650 python bench.py --probe-budget 90 --lock-wait 30 2>/dev/null | tail -1)
   echo "{\"ts\": \"$ts\", \"result\": ${line:-null}}" >> bench_log.jsonl
   for i in $(seq 150); do
     [ -e .stop_bench_loop ] && exit 0

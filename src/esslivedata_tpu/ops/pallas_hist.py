@@ -54,25 +54,39 @@ def _bincount_call(flat, n_bins_padded: int, block: int, interpret: bool):
 
     n = flat.shape[0]
     grid = n // block
-    rows = flat.reshape(grid, block)
+    # Mosaic requires the last two dims of a block shape to be divisible
+    # by (8, 128) or equal the array dims: a flat (grid, block) layout
+    # with (1, block) blocks violates the sublane rule, so the event
+    # stream is staged as (grid, 8, w) — the (8, w) tail covers the full
+    # trailing dims and is always legal.
+    w = block // 8
+    rows = flat.reshape(grid, 8, w)
 
     def kernel(flat_ref, out_ref):
         @pl.when(pl.program_id(0) == 0)
         def _init():
             out_ref[...] = jnp.zeros_like(out_ref)
 
-        idx = flat_ref[0, :]  # [block] int32
         bins = jax.lax.broadcasted_iota(
-            jnp.int32, (block, n_bins_padded), 1
+            jnp.int32, (w, n_bins_padded), 1
         )
-        hits = (idx[:, None] == bins).astype(jnp.float32)
-        out_ref[0, :] += hits.sum(axis=0)
+        # Static unroll over the 8 sublane rows keeps every one-hot tile
+        # 2-D (w x bins) — shapes Mosaic lowers well — instead of one
+        # (block x bins) tile. Rows are loaded straight from the ref
+        # (vector loads); slicing the loaded (8, w) value would lower to
+        # a gather Mosaic rejects.
+        acc = jnp.zeros((1, n_bins_padded), jnp.float32)
+        for s in range(8):
+            idx = flat_ref[0, s, :]  # [w] int32
+            hits = (idx[:, None] == bins).astype(jnp.float32)
+            acc = acc + hits.sum(axis=0, keepdims=True)
+        out_ref[...] += acc
 
     return pl.pallas_call(
         kernel,
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((1, block), lambda i: (i, 0)),
+            pl.BlockSpec((1, 8, w), lambda i: (i, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, n_bins_padded), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((1, n_bins_padded), jnp.float32),
@@ -104,6 +118,8 @@ def bincount_pallas(
     n_bins_padded = -(-n_bins // 128) * 128
     if block is None:
         block = _pick_block(n_bins_padded)
+    if block % 8:
+        raise ValueError("block must be a multiple of 8 (sublane staging)")
     flat = jnp.asarray(flat, jnp.int32)
     pad = (-flat.shape[0]) % block
     if pad:

@@ -198,7 +198,13 @@ def _pallas2d_call(
     n_blocks = window.shape[0] // bpb
     h = bpb // _LANES
     win3 = window.reshape(n_blocks, h, _LANES)
-    rows = events.reshape(n_chunks, chunk)
+    # (n_chunks, 8, chunk/8): Mosaic needs the last two block dims
+    # divisible by (8, 128) or equal to the array dims — a (1, chunk)
+    # block over (n_chunks, chunk) breaks the sublane rule, while the
+    # (8, cw) tail here covers the full trailing dims and is always
+    # legal.
+    cw = chunk // 8
+    rows = events.reshape(n_chunks, 8, cw)
     upd_arr = jnp.full((1,), upd, jnp.float32)
     # One-hot operand dtype for the MXU contraction. 0/1 are exact in
     # both; int8 runs at ~2x the bf16 MXU rate on v5e with exact int32
@@ -217,23 +223,25 @@ def _pallas2d_call(
         def _load():
             out_ref[...] = win_ref[...]
 
-        local = rows_ref[0, :] - blk * bpb  # [chunk] int32
-        hi = local >> 7  # arithmetic shift: floor div, negatives stay <0
-        lo = local & (_LANES - 1)
-        oh_hi = (
-            hi[:, None]
-            == jax.lax.broadcasted_iota(jnp.int32, (chunk, h), 1)
-        ).astype(oh_dtype)
-        oh_lo = (
-            lo[:, None]
-            == jax.lax.broadcasted_iota(jnp.int32, (chunk, _LANES), 1)
-        ).astype(oh_dtype)
-        contrib = jax.lax.dot_general(
-            oh_hi,
-            oh_lo,
-            (((0,), (0,)), ((), ())),
-            preferred_element_type=acc_dtype,
-        )  # [h, 128]
+        iota_h = jax.lax.broadcasted_iota(jnp.int32, (cw, h), 1)
+        iota_l = jax.lax.broadcasted_iota(jnp.int32, (cw, _LANES), 1)
+        # Static unroll over the 8 sublane rows: each row is loaded
+        # straight from the ref (slicing a loaded (8, cw) value lowers
+        # to a gather Mosaic rejects) and contributes one
+        # (cw x h)^T @ (cw x lanes) MXU contraction into the block tile.
+        contrib = jnp.zeros((h, _LANES), acc_dtype)
+        for s in range(8):
+            local = rows_ref[0, s, :] - blk * bpb  # [cw] int32
+            hi = local >> 7  # arithmetic shift: negatives stay <0
+            lo = local & (_LANES - 1)
+            oh_hi = (hi[:, None] == iota_h).astype(oh_dtype)
+            oh_lo = (lo[:, None] == iota_l).astype(oh_dtype)
+            contrib = contrib + jax.lax.dot_general(
+                oh_hi,
+                oh_lo,
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=acc_dtype,
+            )  # [h, 128]
         out_ref[0, :, :] += contrib.astype(jnp.float32) * upd_ref[0]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -241,7 +249,7 @@ def _pallas2d_call(
         grid=(n_chunks,),
         in_specs=[
             pl.BlockSpec((1, h, _LANES), lambda j, m, u: (m[j], 0, 0)),
-            pl.BlockSpec((1, chunk), lambda j, m, u: (j, 0)),
+            pl.BlockSpec((1, 8, cw), lambda j, m, u: (j, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, h, _LANES), lambda j, m, u: (m[j], 0, 0)),
     )
@@ -278,6 +286,9 @@ def scatter_add_pallas2d(
         interpret = jax.default_backend() != "tpu"
     if bpb % _LANES:
         raise ValueError("bpb must be a multiple of 128")
+    n_chunks = len(chunk_map)
+    if n_chunks and (events.shape[0] // n_chunks) % 8:
+        raise ValueError("chunk must be a multiple of 8 (sublane staging)")
     if window.shape[0] % bpb:
         raise ValueError(
             f"window size {window.shape[0]} is not a multiple of bpb={bpb}"

@@ -123,7 +123,9 @@ class MonitorWorkflow:
             )
             self._axis = "toa"
             self._axis_var = Variable(self._edges, ("toa",), "ns")
-        self._hist = EventHistogrammer(toa_edges=self._edges, n_screen=1)
+        self._hist = EventHistogrammer(
+            toa_edges=self._edges, n_screen=1, method="auto"
+        )
         self._state: HistogramState = self._hist.init_state()
 
         def publish_program(state):

@@ -1,0 +1,117 @@
+"""FrameClock and StreamManager unit coverage (ADR 0005; reference
+dashboard/frame_clock.py + dashboard/stream_manager.py behaviors).
+"""
+
+import threading
+import uuid
+
+from esslivedata_tpu.config.workflow_spec import JobId, ResultKey, WorkflowId
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.dashboard.data_service import DataService
+from esslivedata_tpu.dashboard.frame_clock import FrameClock
+from esslivedata_tpu.dashboard.stream_manager import StreamManager
+
+
+def key(output: str = "out", source: str = "s0") -> ResultKey:
+    return ResultKey(
+        workflow_id=WorkflowId(instrument="dummy", name="view"),
+        job_id=JobId(source_name=source, job_number=uuid.uuid4()),
+        output_name=output,
+    )
+
+
+class TestFrameClock:
+    def test_initial_generations_are_zero(self):
+        clock = FrameClock()
+        assert clock.generation == 0
+        assert clock.grid_generation("g1") == 0
+        assert not clock.changed_since("g1", 0)
+
+    def test_commit_advances_only_that_grid(self):
+        clock = FrameClock()
+        g = clock.commit("g1")
+        assert g == 1
+        assert clock.changed_since("g1", 0)
+        assert not clock.changed_since("g2", 0)
+
+    def test_session_paint_cycle(self):
+        """Poll -> paint -> record seen -> unchanged until next commit."""
+        clock = FrameClock()
+        clock.commit("g1")
+        seen = clock.grid_generation("g1")
+        assert not clock.changed_since("g1", seen)
+        clock.commit("g1")
+        assert clock.changed_since("g1", seen)
+
+    def test_commit_all_touches_every_known_grid(self):
+        clock = FrameClock()
+        clock.commit("g1")
+        seen1 = clock.grid_generation("g1")
+        clock.commit("g2")
+        seen2 = clock.grid_generation("g2")
+        clock.commit_all()
+        assert clock.changed_since("g1", seen1)
+        assert clock.changed_since("g2", seen2)
+
+    def test_generations_are_globally_monotonic(self):
+        clock = FrameClock()
+        a = clock.commit("g1")
+        b = clock.commit("g2")
+        c = clock.commit_all()
+        assert a < b < c == clock.generation
+
+    def test_thread_safety_no_lost_increments(self):
+        clock = FrameClock()
+        n, threads = 200, []
+        for grid in ("g1", "g2", "g3", "g4"):
+            t = threading.Thread(
+                target=lambda g=grid: [clock.commit(g) for _ in range(n)]
+            )
+            threads.append(t)
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert clock.generation == 4 * n
+
+
+class TestStreamManager:
+    def test_bind_pushes_updates_for_bound_keys_only(self):
+        data = DataService()
+        manager = StreamManager(data_service=data)
+        bound, other = key("a"), key("b")
+        seen: list[tuple[ResultKey, object]] = []
+        manager.bind({bound}, lambda k, v: seen.append((k, v)))
+
+        data.put(bound, Timestamp.from_ns(1), 11.0)
+        data.put(other, Timestamp.from_ns(1), 22.0)
+        assert seen == [(bound, 11.0)]
+
+    def test_unbind_stops_delivery(self):
+        data = DataService()
+        manager = StreamManager(data_service=data)
+        k = key()
+        seen: list = []
+        sub = manager.bind({k}, lambda *a: seen.append(a))
+        manager.unbind(sub)
+        data.put(k, Timestamp.from_ns(1), 1.0)
+        assert seen == []
+
+    def test_close_tears_down_all_subscriptions(self):
+        data = DataService()
+        manager = StreamManager(data_service=data)
+        k1, k2 = key("a"), key("b")
+        seen: list = []
+        manager.bind({k1}, lambda *a: seen.append(a))
+        manager.bind({k2}, lambda *a: seen.append(a))
+        manager.close()
+        data.put(k1, Timestamp.from_ns(1), 1.0)
+        data.put(k2, Timestamp.from_ns(1), 2.0)
+        assert seen == []
+
+    def test_double_unbind_is_harmless(self):
+        data = DataService()
+        manager = StreamManager(data_service=data)
+        sub = manager.bind({key()}, lambda *a: None)
+        manager.unbind(sub)
+        manager.unbind(sub)  # already gone: no raise
