@@ -93,6 +93,18 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int32, ctypes.c_int32, f32, f32, f32,
         ctypes.c_int32, ctypes.c_int32, i32p, i32p, i64,
     ]
+    u16p = ctypes.POINTER(ctypes.c_uint16)
+    lib.ld_partition_u16.restype = i64
+    lib.ld_partition_u16.argtypes = [
+        i32p, i32p, i64, i64, i64,
+        ctypes.c_int32, i64, ctypes.c_int32, u16p, i32p, i64,
+    ]
+    lib.ld_flatten_partition_u16.restype = i64
+    lib.ld_flatten_partition_u16.argtypes = [
+        i32p, f32p, i64, i32p, i64,
+        ctypes.c_int32, ctypes.c_int32, f32, f32, f32,
+        ctypes.c_int32, ctypes.c_int32, u16p, i32p, i64,
+    ]
     lib.ld_staging_new.argtypes = [i64]
     lib.ld_staging_free.restype = None
     lib.ld_staging_free.argtypes = [vp]
@@ -264,19 +276,26 @@ def flatten_partition(
     ppb_shift: int,
     chunk: int,
     cap_chunks: int,
+    compact: bool = False,
 ) -> tuple[np.ndarray, np.ndarray, int] | None:
     """Fused native flatten + block partition (ld_flatten_partition) for
     the pallas2d ingest path — uniform TOA edges, pixel-aligned blocks
     (``bpb = 2**ppb_shift * n_toa``). Returns ``(events, chunk_map,
-    n_chunks_used)`` or None when the native library is unavailable."""
+    n_chunks_used)`` or None when the native library is unavailable.
+
+    ``compact=True`` emits uint16 block-LOCAL offsets (0xFFFF padding) —
+    half the host->device wire bytes; requires ``bpb <= 0xFFFF``."""
     lib = load_library()
     if lib is None:
         return None
     from ..ops.event_batch import sanitize_pixel_id
 
+    if compact and (1 << ppb_shift) * n_toa > 0xFFFF:
+        raise ValueError("compact partition requires bpb <= 0xFFFF")
     pixel_id = np.ascontiguousarray(sanitize_pixel_id(pixel_id), np.int32)
     toa = np.ascontiguousarray(toa, dtype=np.float32)
-    events = np.empty(cap_chunks * chunk, np.int32)
+    out_dtype = np.uint16 if compact else np.int32
+    events = np.empty(cap_chunks * chunk, out_dtype)
     chunk_map = np.empty(cap_chunks, np.int32)
     i32p = ctypes.POINTER(ctypes.c_int32)
     f32p = ctypes.POINTER(ctypes.c_float)
@@ -287,7 +306,11 @@ def flatten_partition(
     else:
         lut_ptr = None
         n_pix = 0
-    used = lib.ld_flatten_partition(
+    fn = lib.ld_flatten_partition_u16 if compact else lib.ld_flatten_partition
+    out_ptr = events.ctypes.data_as(
+        ctypes.POINTER(ctypes.c_uint16) if compact else i32p
+    )
+    used = fn(
         pixel_id.ctypes.data_as(i32p),
         toa.ctypes.data_as(f32p),
         int(pixel_id.shape[0]),
@@ -300,7 +323,7 @@ def flatten_partition(
         float(inv_width),
         int(ppb_shift),
         int(chunk),
-        events.ctypes.data_as(i32p),
+        out_ptr,
         chunk_map.ctypes.data_as(i32p),
         int(cap_chunks),
     )
@@ -318,6 +341,7 @@ def partition_events(
     cap_chunks: int,
     blk: np.ndarray | None = None,
     n_blocks: int = 0,
+    compact_bpb: int = 0,
 ) -> tuple[np.ndarray, np.ndarray, int] | None:
     """Native block partition for the pallas2d kernel (ld_partition).
 
@@ -328,12 +352,18 @@ def partition_events(
     (callers slice a rounded-up prefix), or None when the native library
     is unavailable. Raises ValueError if ``cap_chunks`` is too small (a
     caller bug: the bound is static).
+
+    ``compact_bpb`` (a bins-per-block value <= 0xFFFF) switches to the
+    uint16 block-LOCAL output (0xFFFF padding) — half the wire bytes.
     """
     lib = load_library()
     if lib is None:
         return None
+    compact = bool(compact_bpb)
+    if compact and compact_bpb > 0xFFFF:
+        raise ValueError("compact partition requires bpb <= 0xFFFF")
     flat = np.ascontiguousarray(flat, dtype=np.int32)
-    events = np.empty(cap_chunks * chunk, np.int32)
+    events = np.empty(cap_chunks * chunk, np.uint16 if compact else np.int32)
     chunk_map = np.empty(cap_chunks, np.int32)
     i32p = ctypes.POINTER(ctypes.c_int32)
     if blk is not None:
@@ -341,18 +371,33 @@ def partition_events(
         blk_ptr = blk.ctypes.data_as(i32p)
     else:
         blk_ptr = None
-    used = lib.ld_partition(
-        flat.ctypes.data_as(i32p),
-        blk_ptr,
-        int(flat.shape[0]),
-        int(n_bins_incl_dump),
-        int(n_blocks),
-        int(shift),
-        int(chunk),
-        events.ctypes.data_as(i32p),
-        chunk_map.ctypes.data_as(i32p),
-        int(cap_chunks),
-    )
+    if compact:
+        used = lib.ld_partition_u16(
+            flat.ctypes.data_as(i32p),
+            blk_ptr,
+            int(flat.shape[0]),
+            int(n_bins_incl_dump),
+            int(n_blocks),
+            int(shift),
+            int(compact_bpb),
+            int(chunk),
+            events.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+            chunk_map.ctypes.data_as(i32p),
+            int(cap_chunks),
+        )
+    else:
+        used = lib.ld_partition(
+            flat.ctypes.data_as(i32p),
+            blk_ptr,
+            int(flat.shape[0]),
+            int(n_bins_incl_dump),
+            int(n_blocks),
+            int(shift),
+            int(chunk),
+            events.ctypes.data_as(i32p),
+            chunk_map.ctypes.data_as(i32p),
+            int(cap_chunks),
+        )
     if used < 0:
         raise ValueError("ld_partition: cap_chunks too small")
     return events, chunk_map, int(used)

@@ -419,11 +419,22 @@ void ld_flatten_nonuniform(const int32_t* pixel, const float* toa,
 // bpb, where no shift exists — the caller vectorizes the division). With
 // blk_in, flat must already be routed in-range, n_blocks_in gives the
 // block count, and shift is ignored.
-int64_t ld_partition(const int32_t* flat, const int32_t* blk_in,
-                     int64_t n, int64_t n_bins_incl_dump,
-                     int64_t n_blocks_in, int32_t shift, int32_t chunk,
-                     int32_t* out_events, int32_t* out_map,
-                     int64_t cap_chunks) {
+// OutT=int32_t, LOCAL=false: global flat indices, -1 padding (the
+// pallas2d int32 wire). OutT=uint16_t, LOCAL=true: block-LOCAL offsets
+// (v - blk * bpb), 0xFFFF padding — 2 bytes/event over the
+// host->device link instead of 4 (requires bpb <= 0xFFFF so the
+// sentinel can never be a valid offset; the Python callers enforce it).
+// Templates cannot carry C linkage: close the extern block around them
+// and reopen it for the exported wrappers.
+}  // extern "C"
+
+template <typename OutT, bool LOCAL>
+static int64_t partition_core(const int32_t* flat, const int32_t* blk_in,
+                              int64_t n, int64_t n_bins_incl_dump,
+                              int64_t n_blocks_in, int32_t shift,
+                              int64_t bpb, int32_t chunk,
+                              OutT* out_events, int32_t* out_map,
+                              int64_t cap_chunks) {
   const int32_t dump = static_cast<int32_t>(n_bins_incl_dump - 1);
   const int64_t n_blocks =
       blk_in != nullptr
@@ -475,9 +486,10 @@ int64_t ld_partition(const int32_t* flat, const int32_t* blk_in,
     if (n_chunks + k > cap_chunks) return -1;
     for (int64_t c = 0; c < k; ++c)
       out_map[n_chunks + c] = static_cast<int32_t>(b);
-    // Pad tail of this block's region.
+    // Pad tail of this block's region (static_cast<OutT>(-1) is 0xFFFF
+    // for uint16_t — the LOCAL sentinel).
     for (int64_t i = bstart[b] + total; i < (n_chunks + k) * chunk; ++i)
-      out_events[i] = -1;
+      out_events[i] = static_cast<OutT>(-1);
     n_chunks += k;
   }
   bstart[n_blocks] = n_chunks * chunk;
@@ -487,12 +499,17 @@ int64_t ld_partition(const int32_t* flat, const int32_t* blk_in,
     const int64_t hi = std::min(n, lo + seg);
     int64_t* cur = cursor.data() + static_cast<size_t>(t) * n_blocks;
     if (blk_in != nullptr) {
-      for (int64_t i = lo; i < hi; ++i)
-        out_events[cur[blk_in[i]]++] = flat[i];
+      for (int64_t i = lo; i < hi; ++i) {
+        const int64_t b = blk_in[i];
+        out_events[cur[b]++] =
+            static_cast<OutT>(LOCAL ? flat[i] - b * bpb : flat[i]);
+      }
     } else {
       for (int64_t i = lo; i < hi; ++i) {
         const int32_t v = route(flat[i]);
-        out_events[cur[v >> shift]++] = v;
+        const int64_t b = v >> shift;
+        out_events[cur[b]++] =
+            static_cast<OutT>(LOCAL ? v - b * bpb : v);
       }
     }
   };
@@ -509,8 +526,32 @@ int64_t ld_partition(const int32_t* flat, const int32_t* blk_in,
   if (cap_chunks > n_chunks)
     memset(out_events + n_chunks * chunk, 0xFF,
            static_cast<size_t>((cap_chunks - n_chunks) * chunk) *
-               sizeof(int32_t));
+               sizeof(OutT));
   return n_chunks;
+}
+
+extern "C" {
+
+int64_t ld_partition(const int32_t* flat, const int32_t* blk_in,
+                     int64_t n, int64_t n_bins_incl_dump,
+                     int64_t n_blocks_in, int32_t shift, int32_t chunk,
+                     int32_t* out_events, int32_t* out_map,
+                     int64_t cap_chunks) {
+  return partition_core<int32_t, false>(
+      flat, blk_in, n, n_bins_incl_dump, n_blocks_in, shift, 0, chunk,
+      out_events, out_map, cap_chunks);
+}
+
+// uint16 block-local variant; bpb must be <= 0xFFFF and equal
+// 1 << shift when blk_in is null.
+int64_t ld_partition_u16(const int32_t* flat, const int32_t* blk_in,
+                         int64_t n, int64_t n_bins_incl_dump,
+                         int64_t n_blocks_in, int32_t shift, int64_t bpb,
+                         int32_t chunk, uint16_t* out_events,
+                         int32_t* out_map, int64_t cap_chunks) {
+  return partition_core<uint16_t, true>(
+      flat, blk_in, n, n_bins_incl_dump, n_blocks_in, shift, bpb, chunk,
+      out_events, out_map, cap_chunks);
 }
 
 // Fused flatten + partition: the pallas2d ingest fast path
@@ -525,10 +566,13 @@ int64_t ld_partition(const int32_t* flat, const int32_t* blk_in,
 // Uniform TOA edges only (the non-uniform path goes flatten ->
 // ld_partition). Semantics match ld_flatten + ld_partition exactly,
 // including dump routing of invalid pixel/toa.
-int64_t ld_flatten_partition(
+}  // extern "C"
+
+template <typename OutT, bool LOCAL>
+static int64_t flatten_partition_core(
     const int32_t* pixel, const float* toa, int64_t n, const int32_t* lut,
     int64_t n_pix, int32_t n_screen, int32_t n_toa, float lo, float hi,
-    float inv_width, int32_t ppb_shift, int32_t chunk, int32_t* out_events,
+    float inv_width, int32_t ppb_shift, int32_t chunk, OutT* out_events,
     int32_t* out_map, int64_t cap_chunks) {
   const int64_t n_toa64 = n_toa;
   const int64_t n_bins = static_cast<int64_t>(n_screen) * n_toa64;
@@ -578,14 +622,15 @@ int64_t ld_flatten_partition(
     for (int64_t c = 0; c < k; ++c)
       out_map[n_chunks + c] = static_cast<int32_t>(b);
     for (int64_t i = cursor[b] + total; i < (n_chunks + k) * chunk; ++i)
-      out_events[i] = -1;
+      out_events[i] = static_cast<OutT>(-1);
     n_chunks += k;
   }
 
   for (int64_t i = 0; i < n; ++i) {
     int32_t blk;
     const int32_t v = project(i, &blk);
-    out_events[cursor[blk]++] = v;
+    out_events[cursor[blk]++] =
+        static_cast<OutT>(LOCAL ? v - int64_t(blk) * bpb : v);
   }
 
   const int32_t last = static_cast<int32_t>(n_blocks - 1);
@@ -593,8 +638,32 @@ int64_t ld_flatten_partition(
   if (cap_chunks > n_chunks)
     memset(out_events + n_chunks * chunk, 0xFF,
            static_cast<size_t>((cap_chunks - n_chunks) * chunk) *
-               sizeof(int32_t));
+               sizeof(OutT));
   return n_chunks;
+}
+
+extern "C" {
+
+int64_t ld_flatten_partition(
+    const int32_t* pixel, const float* toa, int64_t n, const int32_t* lut,
+    int64_t n_pix, int32_t n_screen, int32_t n_toa, float lo, float hi,
+    float inv_width, int32_t ppb_shift, int32_t chunk, int32_t* out_events,
+    int32_t* out_map, int64_t cap_chunks) {
+  return flatten_partition_core<int32_t, false>(
+      pixel, toa, n, lut, n_pix, n_screen, n_toa, lo, hi, inv_width,
+      ppb_shift, chunk, out_events, out_map, cap_chunks);
+}
+
+// uint16 block-local variant (2 bytes/event on the wire); requires
+// bpb = (1 << ppb_shift) * n_toa <= 0xFFFF (Python caller enforces).
+int64_t ld_flatten_partition_u16(
+    const int32_t* pixel, const float* toa, int64_t n, const int32_t* lut,
+    int64_t n_pix, int32_t n_screen, int32_t n_toa, float lo, float hi,
+    float inv_width, int32_t ppb_shift, int32_t chunk,
+    uint16_t* out_events, int32_t* out_map, int64_t cap_chunks) {
+  return flatten_partition_core<uint16_t, true>(
+      pixel, toa, n, lut, n_pix, n_screen, n_toa, lo, hi, inv_width,
+      ppb_shift, chunk, out_events, out_map, cap_chunks);
 }
 
 }  // extern "C"

@@ -388,6 +388,10 @@ class EventHistogrammer:
                         "of 128 when no pixel-aligned block fits"
                     )
             self._n_state = padded_bins(self._n_bins + 1, self._bpb)
+            # Compact uint16 wire whenever block-local offsets fit: same
+            # partition, half the host->device bytes per event (the
+            # ingest link is the measured bottleneck on degraded relays).
+            self._p2_compact = self._bpb <= 0xFFFF
             self._step_part = jax.jit(
                 self._step_part_impl, donate_argnums=(0,)
             )
@@ -774,6 +778,7 @@ class EventHistogrammer:
                     ppb_shift=self._ppb_shift,
                     chunk=chunk,
                     cap_chunks=cap,
+                    compact=self._p2_compact,
                 )
                 if res is not None:
                     events, chunk_map, used = res
@@ -781,7 +786,11 @@ class EventHistogrammer:
                     return events[: n_padded * chunk], chunk_map[:n_padded]
         flat = self.flatten_host(pixel_id, toa)
         return partition_events_host(
-            flat, self._n_bins + 1, bpb=self._bpb, chunk=self._p2_chunk
+            flat,
+            self._n_bins + 1,
+            bpb=self._bpb,
+            chunk=self._p2_chunk,
+            compact=self._p2_compact,
         )
 
     def step_flat(self, state: HistogramState, flat) -> HistogramState:
@@ -800,6 +809,7 @@ class EventHistogrammer:
                 self._n_bins + 1,
                 bpb=self._bpb,
                 chunk=self._p2_chunk,
+                compact=self._p2_compact,
             )
             return self._step_part(
                 state, dispatch_safe(events), dispatch_safe(chunk_map)

@@ -104,6 +104,7 @@ def partition_events_host(
     *,
     bpb: int = DEFAULT_BPB,
     chunk: int = DEFAULT_CHUNK,
+    compact: bool = False,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Group flat indices by bin block, pad each block to whole chunks.
 
@@ -113,14 +114,22 @@ def partition_events_host(
     ``>= n_bins_incl_dump``) are routed to the dump bin
     (``n_bins_incl_dump - 1``) first — same policy as ``step_flat``.
 
-    The native shim (``ld_partition``) does the counting sort in two C
-    passes — for power-of-two ``bpb`` it derives blocks with a shift;
-    otherwise numpy vectorizes the division and the C pass takes the
-    precomputed block ids. The pure-numpy fallback (no compiler) is a
-    stable argsort + a short fill loop over used blocks.
+    ``compact=True`` (requires ``bpb <= 0xFFFF``) emits ``events`` as
+    uint16 block-LOCAL offsets with ``0xFFFF`` padding — the same
+    partition at half the host->device wire bytes. The sentinel can
+    never collide with a real offset (``0xFFFF >= bpb``), and the
+    kernel drops it exactly like the int32 path's ``-1``.
+
+    The native shim (``ld_partition``/``ld_partition_u16``) does the
+    counting sort in two C passes — for power-of-two ``bpb`` it derives
+    blocks with a shift; otherwise numpy vectorizes the division and the
+    C pass takes the precomputed block ids. The pure-numpy fallback (no
+    compiler) is a stable argsort + a short fill loop over used blocks.
     """
     if bpb % _LANES:
         raise ValueError("bpb must be a multiple of 128")
+    if compact and bpb > 0xFFFF:
+        raise ValueError("compact partition requires bpb <= 0xFFFF")
     flat = np.asarray(flat, np.int32)
     n_blocks = -(-n_bins_incl_dump // bpb)
 
@@ -130,6 +139,7 @@ def partition_events_host(
         partition_events = None
     if partition_events is not None:
         cap = chunk_capacity(flat.shape[0], n_blocks, chunk)
+        compact_bpb = bpb if compact else 0
         if not (bpb & (bpb - 1)):
             res = partition_events(
                 flat,
@@ -137,6 +147,7 @@ def partition_events_host(
                 shift=bpb.bit_length() - 1,
                 chunk=chunk,
                 cap_chunks=cap,
+                compact_bpb=compact_bpb,
             )
         else:
             dump = n_bins_incl_dump - 1
@@ -149,6 +160,7 @@ def partition_events_host(
                 cap_chunks=cap,
                 blk=routed // np.int32(bpb),
                 n_blocks=n_blocks,
+                compact_bpb=compact_bpb,
             )
         if res is not None:
             events, chunk_map, used = res
@@ -163,10 +175,15 @@ def partition_events_host(
     counts = np.bincount(blk, minlength=n_blocks)
     order = np.argsort(blk, kind="stable")
     s = flat[order]
+    if compact:
+        s = s - blk[order] * np.int32(bpb)
     chunks_per_block = -(-counts // chunk)  # 0 for empty blocks
     n_chunks = int(chunks_per_block.sum())
     n_padded = bucketed_chunks(n_chunks)
-    events = np.full(n_padded * chunk, -1, np.int32)
+    if compact:
+        events = np.full(n_padded * chunk, 0xFFFF, np.uint16)
+    else:
+        events = np.full(n_padded * chunk, -1, np.int32)
     chunk_map = np.full(n_padded, n_blocks - 1, np.int32)
     src = 0
     dst = 0
@@ -180,15 +197,19 @@ def partition_events_host(
     return events, chunk_map
 
 
-@functools.partial(jax.jit, static_argnums=(4, 5, 6), donate_argnums=(0,))
+@functools.partial(
+    jax.jit, static_argnums=(4, 5, 6, 7), donate_argnums=(0,)
+)
 def _pallas2d_call(
     window: jax.Array,  # [n_blocks * bpb] float32, donated
-    events: jax.Array,  # [n_chunks * chunk] int32, -1 padded
+    events: jax.Array,  # [n_chunks * chunk]: int32 flat (-1 padded) or
+    #                     uint16 block-local (0xFFFF padded, `local`)
     chunk_map: jax.Array,  # [n_chunks] int32, non-decreasing
     upd,  # traced float32 scalar (1.0 for counts; 1/scale for decay)
     bpb: int,
     interpret: bool,
     precision: str = "bf16",
+    local: bool = False,
 ):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -198,6 +219,12 @@ def _pallas2d_call(
     n_blocks = window.shape[0] // bpb
     h = bpb // _LANES
     win3 = window.reshape(n_blocks, h, _LANES)
+    if local:
+        # Compact uint16 wire (2 B/event over the link): widen on device
+        # — one cheap HBM pass — so the kernel never needs 16-bit tiles.
+        # The 0xFFFF sentinel widens to 65535 >= bpb and drops in the
+        # one-hot exactly like the int32 path's -1.
+        events = events.astype(jnp.int32)
     # (n_chunks, 8, chunk/8): Mosaic needs the last two block dims
     # divisible by (8, 128) or equal to the array dims — a (1, chunk)
     # block over (n_chunks, chunk) breaks the sublane rule, while the
@@ -231,9 +258,12 @@ def _pallas2d_call(
         # (cw x h)^T @ (cw x lanes) MXU contraction into the block tile.
         contrib = jnp.zeros((h, _LANES), acc_dtype)
         for s in range(8):
-            local = rows_ref[0, s, :] - blk * bpb  # [cw] int32
-            hi = local >> 7  # arithmetic shift: negatives stay <0
-            lo = local & (_LANES - 1)
+            row = rows_ref[0, s, :]  # [cw] int32
+            # `local` events arrive block-local already; flat events
+            # subtract the block base (padding/-1 stays negative).
+            off = row if local else row - blk * bpb
+            hi = off >> 7  # arithmetic shift: negatives stay <0
+            lo = off & (_LANES - 1)
             oh_hi = (hi[:, None] == iota_h).astype(oh_dtype)
             oh_lo = (lo[:, None] == iota_l).astype(oh_dtype)
             contrib = contrib + jax.lax.dot_general(
@@ -277,8 +307,10 @@ def scatter_add_pallas2d(
 
     ``window`` must have ``padded_bins(...)`` elements and is donated.
     ``events``/``chunk_map`` come from ``partition_events_host`` (or the
-    native ``ld_partition``). ``upd`` scales every hit (1.0 for counts;
-    the lazy-decay path passes ``1/scale``). ``precision`` selects the
+    native ``ld_partition``). uint16 ``events`` are the compact wire:
+    block-LOCAL offsets, 0xFFFF padding (``partition_events_host(...,
+    compact=True)``). ``upd`` scales every hit (1.0 for counts; the
+    lazy-decay path passes ``1/scale``). ``precision`` selects the
     one-hot MXU dtype: 'bf16' or 'int8' (both exact for counts; int8
     doubles the v5e MXU rate).
     """
@@ -295,12 +327,16 @@ def scatter_add_pallas2d(
         )
     if precision not in ("bf16", "int8"):
         raise ValueError("precision must be 'bf16' or 'int8'")
+    local = np.dtype(getattr(events, "dtype", np.int32)) == np.uint16
+    if local and bpb > 0xFFFF:
+        raise ValueError("uint16 compact events require bpb <= 0xFFFF")
     return _pallas2d_call(
         window,
-        jnp.asarray(events, jnp.int32),
+        jnp.asarray(events) if local else jnp.asarray(events, jnp.int32),
         jnp.asarray(chunk_map, jnp.int32),
         jnp.asarray(upd, jnp.float32),
         bpb,
         bool(interpret),
         precision,
+        local,
     )

@@ -364,3 +364,118 @@ class TestHistogrammerPallas2d:
         hs, ss = self._run("scatter", batches, toa_edges=edges, n_screen=n_screen)
         hp, sp = self._run("pallas2d", batches, toa_edges=edges, n_screen=n_screen)
         np.testing.assert_allclose(hs.read(ss)[0], hp.read(sp)[0])
+
+
+class TestCompactWire:
+    """uint16 block-local wire (2 B/event): same partition + kernel
+    semantics at half the host->device bytes."""
+
+    N_INCL = 300_001
+    BPB = 51_200  # headline-style pixel-aligned block, < 0xFFFF
+
+    def _events(self, n=40_000, seed=3):
+        rng = np.random.default_rng(seed)
+        # Includes out-of-range negatives and overshoots: dump-routed.
+        return rng.integers(-50, self.N_INCL + 50, n).astype(np.int32)
+
+    def test_compact_partition_matches_int32_partition(self):
+        flat = self._events()
+        e32, m32 = partition_events_host(
+            flat, self.N_INCL, bpb=self.BPB, chunk=512
+        )
+        e16, m16 = partition_events_host(
+            flat, self.N_INCL, bpb=self.BPB, chunk=512, compact=True
+        )
+        assert e16.dtype == np.uint16
+        np.testing.assert_array_equal(m16, m32)
+        # Reconstruct global indices from the local wire; padding maps
+        # -1 <-> 0xFFFF.
+        blk = np.repeat(m16, 512).astype(np.int64)
+        pad16 = e16 == 0xFFFF
+        np.testing.assert_array_equal(pad16, e32 < 0)
+        recon = e16.astype(np.int64) + blk * self.BPB
+        np.testing.assert_array_equal(recon[~pad16], e32[~pad16])
+
+    def test_numpy_fallback_compact_matches_native(self, monkeypatch):
+        flat = self._events(seed=4)
+        native = partition_events_host(
+            flat, self.N_INCL, bpb=self.BPB, chunk=512, compact=True
+        )
+        import esslivedata_tpu.native as nat
+
+        monkeypatch.setattr(nat, "partition_events", None)
+        fallback = partition_events_host(
+            flat, self.N_INCL, bpb=self.BPB, chunk=512, compact=True
+        )
+        assert fallback[0].dtype == np.uint16
+        # Same chunk map; same multiset of (block, local) events.
+        np.testing.assert_array_equal(native[1], fallback[1])
+
+        def multiset(ev, mp):
+            blk = np.repeat(mp, 512).astype(np.int64)
+            keep = ev != 0xFFFF
+            return np.sort(ev[keep].astype(np.int64) + blk[keep] * self.BPB)
+        np.testing.assert_array_equal(
+            multiset(*native), multiset(*fallback)
+        )
+
+    def test_kernel_parity_compact_vs_int32(self):
+        import jax.numpy as jnp
+
+        flat = self._events(seed=5)
+        pb = padded_bins(self.N_INCL, self.BPB)
+        e32, m32 = partition_events_host(
+            flat, self.N_INCL, bpb=self.BPB, chunk=512
+        )
+        e16, m16 = partition_events_host(
+            flat, self.N_INCL, bpb=self.BPB, chunk=512, compact=True
+        )
+        out32 = scatter_add_pallas2d(
+            jnp.zeros(pb, jnp.float32), e32, m32, bpb=self.BPB
+        )
+        out16 = scatter_add_pallas2d(
+            jnp.zeros(pb, jnp.float32), e16, m16, bpb=self.BPB
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out32), np.asarray(out16)
+        )
+
+    def test_compact_rejected_for_oversize_bpb(self):
+        with pytest.raises(ValueError, match="0xFFFF|65535|<="):
+            partition_events_host(
+                self._events(), self.N_INCL, bpb=65536, compact=True
+            )
+
+    def test_histogrammer_autocompacts_when_blocks_fit(self):
+        h = EventHistogrammer(
+            toa_edges=np.linspace(0.0, 71.0, 101),
+            n_screen=3000,
+            method="pallas2d",
+        )
+        assert h._p2_compact is (h._bpb <= 0xFFFF)
+        events, _ = h.flatten_partition_host(
+            np.zeros(64, np.int32), np.full(64, 5.0, np.float32)
+        )
+        if h._p2_compact:
+            assert events.dtype == np.uint16
+
+    def test_histogrammer_compact_parity_with_scatter(self):
+        rng = np.random.default_rng(11)
+        n_screen = 3000
+        edges = np.linspace(0.0, 71.0, 101)
+        batch = EventBatch.from_arrays(
+            rng.integers(0, n_screen, 30_000).astype(np.int32),
+            rng.uniform(0.0, 72.0, 30_000).astype(np.float32),
+        )
+        hs = EventHistogrammer(
+            toa_edges=edges, n_screen=n_screen, method="scatter"
+        )
+        hp = EventHistogrammer(
+            toa_edges=edges, n_screen=n_screen, method="pallas2d"
+        )
+        assert hp._p2_compact
+        ss = hs.step_batch(hs.init_state(), batch)
+        sp = hp.step_batch(hp.init_state(), batch)
+        np.testing.assert_array_equal(
+            np.asarray(hs.read(ss)[0]), np.asarray(hp.read(sp)[0])
+        )
