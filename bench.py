@@ -595,8 +595,10 @@ def run_benchmark(args, platform: str) -> dict:
     if method == "auto":
         # Scatter vs sort is hardware-dependent (random-index scatter is
         # memory-bound on TPU; sorted scatter trades an argsort for
-        # locality) — measure both briefly and keep the winner.
-        rates = {m: calibrate(m) for m in ("scatter", "sort")}
+        # locality), and pallas2d's compact uint16 wire halves the
+        # host->device bytes (the binding constraint on degraded links)
+        # — measure each briefly and keep the winner.
+        rates = {m: calibrate(m) for m in ("scatter", "sort", "pallas2d")}
         method = max(rates, key=rates.get)
         if args.verbose:
             print(

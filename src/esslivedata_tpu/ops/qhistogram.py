@@ -453,8 +453,22 @@ class QHistogrammer:
         dtype=jnp.float32,
         method: str = "scatter",
     ) -> None:
-        if method not in ("scatter", "pallas"):
+        if method not in ("auto", "scatter", "pallas"):
             raise ValueError(f"Unknown method {method!r}")
+        if method == "auto":
+            # Q-family bin spaces all fit the VMEM one-hot kernel, which
+            # measured 6x the serial scatter on v5e (PERF.md r5): take it
+            # whenever the bound holds on a TPU backend.
+            from .pallas_hist import MAX_PALLAS_BINS
+
+            method = (
+                "pallas"
+                if (
+                    n_q + 1 <= MAX_PALLAS_BINS
+                    and jax.default_backend() == "tpu"
+                )
+                else "scatter"
+            )
         if method == "pallas":
             from .pallas_hist import MAX_PALLAS_BINS
 

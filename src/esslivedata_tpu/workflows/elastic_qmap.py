@@ -107,7 +107,7 @@ class ElasticQMapWorkflow(QStreamingMixin):
         )
         self._n1, self._n2 = a1.bins, a2.bins
         self._hist = QHistogrammer(
-            qmap=table, toa_edges=toa_edges, n_q=a1.bins * a2.bins
+            qmap=table, toa_edges=toa_edges, n_q=a1.bins * a2.bins, method="auto"
         )
         self._state = self._hist.init_state()
         self._a1_var = Variable(e1, (a1.component,), "1/angstrom")

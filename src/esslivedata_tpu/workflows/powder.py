@@ -147,6 +147,7 @@ class PowderDiffractionWorkflow(QStreamingMixin):
             qmap=dmap,
             toa_edges=toa_edges,
             n_q=params.d_bins * self._n_bands,
+            method="auto",
         )
         self._state = self._hist.init_state()
         self._d_var = Variable(d_edges, ("dspacing",), "angstrom")

@@ -78,6 +78,7 @@ class QESpectroscopyWorkflow(QStreamingMixin):
             qmap=qe_map,
             toa_edges=toa_edges,
             n_q=params.q_bins * params.e_bins,
+            method="auto",
         )
         self._state = self._hist.init_state()
         self._q_var = Variable(q_edges, ("Q",), "1/angstrom")

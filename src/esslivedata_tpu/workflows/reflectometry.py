@@ -107,6 +107,7 @@ class ReflectometryWorkflow(QStreamingMixin):
                 qmap=qz_map,
                 toa_edges=self._toa_edges,
                 n_q=self._params.qz_bins,
+                method="auto",
             )
             self._state = self._hist.init_state()
         else:

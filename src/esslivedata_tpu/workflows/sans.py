@@ -81,7 +81,7 @@ class SansIQWorkflow(QStreamingMixin):
             beam_center=(params.beam_center_x, params.beam_center_y),
         )
         self._hist = QHistogrammer(
-            qmap=qmap, toa_edges=toa_edges, n_q=params.q_bins
+            qmap=qmap, toa_edges=toa_edges, n_q=params.q_bins, method="auto"
         )
         self._state = self._hist.init_state()
         self._q_edges_var = Variable(q_edges, ("Q",), "1/angstrom")

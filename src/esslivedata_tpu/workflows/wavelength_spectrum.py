@@ -72,7 +72,7 @@ class WavelengthSpectrumWorkflow(QStreamingMixin):
             toa_offset_ns=params.toa_offset_ns,
         )
         self._hist = QHistogrammer(
-            qmap=wmap, toa_edges=toa_edges, n_q=params.wavelength_bins
+            qmap=wmap, toa_edges=toa_edges, n_q=params.wavelength_bins, method="auto"
         )
         self._state = self._hist.init_state()
         self._lam_var = Variable(lam_edges, ("wavelength",), "angstrom")
