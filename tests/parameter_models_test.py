@@ -1,0 +1,102 @@
+"""Shared parameter-model vocabulary (reference granularity:
+tests/parameter_models_test.py): the free-text list parser, range/edge
+validation, log-edge materialization, angle conversion.
+"""
+
+import numpy as np
+import pytest
+from pydantic import ValidationError
+
+from esslivedata_tpu.parameter_models import (
+    Angle,
+    AngleUnit,
+    EdgesModel,
+    RangeModel,
+    Scale,
+    parse_number_list,
+)
+
+
+class TestParseNumberList:
+    def test_plain_list(self):
+        assert parse_number_list("1, 2.5, -3") == [1.0, 2.5, -3.0]
+
+    def test_blank_is_empty(self):
+        assert parse_number_list("") == []
+        assert parse_number_list("   ") == []
+
+    def test_scientific_notation(self):
+        assert parse_number_list("1e3, 2.5e-2") == [1000.0, 0.025]
+
+    @pytest.mark.parametrize(
+        "bad", ["a, b", "1; 2", "1, , 2", "true, 1", '"x"', "[1], 2"]
+    )
+    def test_non_numbers_rejected(self, bad):
+        with pytest.raises(ValueError):
+            parse_number_list(bad)
+
+    def test_backs_pydantic_validator(self):
+        """The documented use: free-text list input on a model field."""
+        from pydantic import BaseModel, field_validator
+
+        class M(BaseModel):
+            values: list[float] = []
+
+            @field_validator("values", mode="before")
+            @classmethod
+            def _parse(cls, v):
+                return parse_number_list(v) if isinstance(v, str) else v
+
+        assert M(values="3, 4").values == [3.0, 4.0]
+        with pytest.raises(ValidationError):
+            M(values="3, x")
+
+
+class TestRangeModel:
+    def test_defaults_valid(self):
+        r = RangeModel()
+        assert r.stop > r.start
+
+    def test_inverted_rejected(self):
+        with pytest.raises(ValidationError, match="greater than start"):
+            RangeModel(start=5.0, stop=5.0)
+        with pytest.raises(ValidationError):
+            RangeModel(start=5.0, stop=1.0)
+
+
+class TestEdgesModel:
+    def test_linear_edges(self):
+        m = EdgesModel(start=0.0, stop=10.0, num_bins=5)
+        np.testing.assert_allclose(
+            m.get_edges(), np.linspace(0.0, 10.0, 6)
+        )
+
+    def test_log_edges_geometric(self):
+        m = EdgesModel(start=1.0, stop=1000.0, num_bins=3, scale=Scale.LOG)
+        np.testing.assert_allclose(m.get_edges(), [1.0, 10.0, 100.0, 1000.0])
+
+    def test_log_requires_positive_start(self):
+        with pytest.raises(ValidationError, match="positive"):
+            EdgesModel(start=0.0, stop=10.0, scale=Scale.LOG)
+        # The same start is fine on a linear scale.
+        EdgesModel(start=0.0, stop=10.0, scale=Scale.LINEAR)
+
+    def test_bin_count_bounds(self):
+        with pytest.raises(ValidationError):
+            EdgesModel(num_bins=0)
+        with pytest.raises(ValidationError):
+            EdgesModel(num_bins=10_001)
+        assert EdgesModel(num_bins=10_000).get_edges().size == 10_001
+
+    def test_inverted_rejected(self):
+        with pytest.raises(ValidationError):
+            EdgesModel(start=2.0, stop=2.0)
+
+
+class TestAngle:
+    def test_degrees_passthrough(self):
+        assert Angle(value=45.0).get_degrees() == 45.0
+
+    def test_radians_converted(self):
+        a = Angle(value=np.pi / 2, unit=AngleUnit.RADIAN)
+        assert a.get_degrees() == pytest.approx(90.0)
