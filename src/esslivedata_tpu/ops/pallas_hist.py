@@ -89,7 +89,12 @@ def _bincount_call(flat, n_bins_padded: int, block: int, interpret: bool):
             pl.BlockSpec((1, 8, w), lambda i: (i, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, n_bins_padded), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((1, n_bins_padded), jnp.float32),
+        # vma propagation: inside shard_map (the sharded Q kernels) the
+        # per-shard delta varies over the mesh axes the events vary over;
+        # check_vma requires the out_shape to say so.
+        out_shape=jax.ShapeDtypeStruct(
+            (1, n_bins_padded), jnp.float32, vma=jax.typeof(flat).vma
+        ),
         interpret=interpret,
     )(rows)[0]
 

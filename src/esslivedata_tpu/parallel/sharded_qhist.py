@@ -65,7 +65,25 @@ class ShardedQHistogrammer:
         mesh: Mesh,
         axis: str = "bank",
         dtype=jnp.float32,
+        method: str = "scatter",
     ) -> None:
+        if method not in ("auto", "scatter", "pallas"):
+            raise ValueError(f"Unknown method {method!r}")
+        if method == "auto":
+            # Same resolution as the single-device QHistogrammer: the
+            # per-shard delta is a full [n_q] vector either way, so the
+            # VMEM bound is the global one.
+            from ..ops.pallas_hist import MAX_PALLAS_BINS
+
+            method = (
+                "pallas"
+                if (
+                    n_q + 1 <= MAX_PALLAS_BINS
+                    and jax.default_backend() == "tpu"
+                )
+                else "scatter"
+            )
+        self._method = method
         table, id_base = qmap.table, int(qmap.id_base)
         toa_edges = np.asarray(toa_edges, dtype=np.float64)
         if table.shape[1] != toa_edges.size - 1:
@@ -105,6 +123,7 @@ class ShardedQHistogrammer:
                 inv_width=self._inv_width,
                 n_bins=self._n_q,
                 dtype=dtype,
+                method=self._method,
             )
             # The ONLY collective: O(n_q) regardless of table size.
             delta = jax.lax.psum(delta, axis)
@@ -126,6 +145,11 @@ class ShardedQHistogrammer:
                 mesh=mesh,
                 in_specs=(state_specs, P(axis, None), P(), P(), P()),
                 out_specs=state_specs,
+                # Interpret-mode pallas inside shard_map trips a JAX vma
+                # propagation gap (dynamic_slice with mixed varying
+                # axes); the error message itself prescribes this
+                # workaround. Scatter keeps full vma checking.
+                check_vma=(method != "pallas"),
             ),
             donate_argnums=(0,),
         )

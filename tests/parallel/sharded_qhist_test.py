@@ -141,3 +141,34 @@ def test_step_accepts_plain_lists_without_wrap():
     )
     cum, _, _, _ = sharded.read(state)
     assert cum.sum() <= 1.0
+
+
+class TestPallasInShardMap:
+    def test_pallas_delta_matches_scatter_on_mesh(self, mesh):
+        """The one-hot kernel composes with shard_map (interpret mode on
+        the CPU test mesh): per-shard pallas deltas + psum must equal the
+        sharded scatter exactly."""
+        dmap, toa_edges, n_d, ids = make_map()
+        scatter = ShardedQHistogrammer(
+            qmap=dmap, toa_edges=toa_edges, n_q=n_d, mesh=mesh
+        )
+        pallas = ShardedQHistogrammer(
+            qmap=dmap, toa_edges=toa_edges, n_q=n_d, mesh=mesh,
+            method="pallas",
+        )
+        rng = np.random.default_rng(7)
+        pid = rng.choice(ids, 4000).astype(np.int32)
+        toa = rng.uniform(0.0, 7.1e7, 4000).astype(np.float32)
+        s_sc = scatter.step(scatter.init_state(), pid, toa, 1.0)
+        s_pl = pallas.step(pallas.init_state(), pid, toa, 1.0)
+        np.testing.assert_array_equal(
+            np.asarray(s_sc.window), np.asarray(s_pl.window)
+        )
+
+    def test_auto_resolves_scatter_off_tpu(self, mesh):
+        dmap, toa_edges, n_d, _ = make_map()
+        h = ShardedQHistogrammer(
+            qmap=dmap, toa_edges=toa_edges, n_q=n_d, mesh=mesh,
+            method="auto",
+        )
+        assert h._method == "scatter"  # CPU test mesh
