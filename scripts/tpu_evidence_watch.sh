@@ -1,0 +1,29 @@
+#!/bin/bash
+# One-shot TPU evidence capture: probe until the relay serves, then run
+# the round's full hardware checklist exactly once and exit.
+#   1. scripts/tpu_kernel_check.py   (kernel lowering + parity + A/B)
+#   2. bench.py --method pallas2d    (compact-wire graded line)
+#   3. bench.py --all                (full graded artifact)
+# Output: tpu_evidence_r05.log (+ one line per result in bench_log.jsonl
+# via the bench's own flock-serialized runs). Stop: touch .stop_bench_loop.
+cd /root/repo
+while true; do
+  [ -e .stop_bench_loop ] && exit 0
+  out=$(_BENCH_PROBE=1 timeout 120 python bench.py 2>/dev/null | tail -1)
+  if echo "$out" | grep -q '"platform": "tpu"'; then
+    break
+  fi
+  sleep 100
+done
+{
+  echo "=== relay healthy at $(date -u +%Y-%m-%dT%H:%M:%SZ): $out"
+  echo "=== kernel check"
+  timeout 1200 python scripts/tpu_kernel_check.py 2>&1
+  echo "=== graded line: pallas2d (compact wire)"
+  timeout 900 python bench.py --method pallas2d --verbose --lock-wait 120 2>&1 | tail -6
+  echo "=== graded line: scatter"
+  timeout 900 python bench.py --method scatter --verbose --lock-wait 120 2>&1 | tail -5
+  echo "=== full --all"
+  timeout 1800 python bench.py --all --verbose --attempt-timeout 1500 --lock-wait 120 2>&1 | tail -40
+  echo "=== done at $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+} >> tpu_evidence_r05.log 2>&1
