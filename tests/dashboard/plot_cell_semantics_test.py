@@ -1,0 +1,157 @@
+"""PlotOrchestrator cell semantics (reference granularity:
+tests/dashboard/plot_orchestrator_test.py): match rules, cell CRUD
+rebinding, history-demand upgrades, frame-clock commits.
+"""
+
+import uuid
+
+from esslivedata_tpu.config.grid_template import (
+    CellGeometry,
+    GridCellSpec,
+    GridSpec,
+)
+from esslivedata_tpu.config.workflow_spec import JobId, ResultKey, WorkflowId
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.dashboard.data_service import DataService
+from esslivedata_tpu.dashboard.plot_orchestrator import (
+    PlotCell,
+    PlotOrchestrator,
+)
+
+GEOM = CellGeometry(row=0, col=0)
+
+
+def key(
+    workflow: str = "dummy/ns/view/v1",
+    output: str = "image_current",
+    source: str = "panel_0",
+) -> ResultKey:
+    return ResultKey(
+        workflow_id=WorkflowId.parse(workflow),
+        job_id=JobId(source_name=source, job_number=uuid.uuid4()),
+        output_name=output,
+    )
+
+
+def cell(**kw) -> PlotCell:
+    return PlotCell(spec=GridCellSpec(geometry=GEOM, **kw))
+
+
+class TestCellMatching:
+    def test_empty_spec_matches_nothing(self):
+        """A cell with no selection must not hoover up every stream."""
+        assert not cell().matches(key())
+
+    def test_workflow_filter(self):
+        c = cell(workflow="dummy/ns/view/v1")
+        assert c.matches(key())
+        assert not c.matches(key(workflow="dummy/ns/other/v1"))
+
+    def test_output_filter(self):
+        c = cell(output="image_current")
+        assert c.matches(key())
+        assert not c.matches(key(output="spectrum_current"))
+
+    def test_source_filter(self):
+        c = cell(source="panel_0")
+        assert c.matches(key())
+        assert not c.matches(key(source="panel_1"))
+
+    def test_conjunction_of_filters(self):
+        c = cell(workflow="dummy/ns/view/v1", output="image_current")
+        assert c.matches(key())
+        assert not c.matches(key(output="spectrum_current"))
+
+    def test_corrupt_params_do_not_break_wants_history(self):
+        c = cell(output="x", params=(("extractor", "nonsense_mode"),))
+        assert c.wants_history is False
+
+
+def make_orchestrator():
+    data = DataService()
+    orch = PlotOrchestrator(data_service=data)
+    grid = orch.add_grid(GridSpec(name="g"))
+    return data, orch, grid.grid_id
+
+
+class TestCellCrud:
+    def test_add_cell_binds_existing_keys(self):
+        data, orch, gid = make_orchestrator()
+        k = key()
+        data.put(k, Timestamp.from_ns(1), 1.0)
+        c = orch.add_cell(gid, GridCellSpec(geometry=GEOM, output="image_current"))
+        assert k in c.keys
+
+    def test_new_data_binds_later(self):
+        data, orch, gid = make_orchestrator()
+        c = orch.add_cell(gid, GridCellSpec(geometry=GEOM, output="image_current"))
+        assert c.keys == set()
+        k = key()
+        data.put(k, Timestamp.from_ns(1), 1.0)
+        assert k in c.keys
+
+    def test_update_cell_rebinds_selection(self):
+        data, orch, gid = make_orchestrator()
+        k_img, k_spec = key(output="image_current"), key(output="spectrum_current")
+        data.put(k_img, Timestamp.from_ns(1), 1.0)
+        data.put(k_spec, Timestamp.from_ns(1), 2.0)
+        orch.add_cell(gid, GridCellSpec(geometry=GEOM, output="image_current"))
+        updated = orch.update_cell(gid, 0, output="spectrum_current")
+        assert k_spec in updated.keys and k_img not in updated.keys
+        # The grid SPEC followed (what persistence serializes).
+        assert orch.grid(gid).spec.cells[0].output == "spectrum_current"
+
+    def test_remove_cell_updates_spec(self):
+        _, orch, gid = make_orchestrator()
+        orch.add_cell(gid, GridCellSpec(geometry=GEOM, output="a"))
+        orch.add_cell(gid, GridCellSpec(geometry=GEOM, output="b"))
+        orch.remove_cell(gid, 0)
+        grid = orch.grid(gid)
+        assert [c.spec.output for c in grid.cells] == ["b"]
+        assert [s.output for s in grid.spec.cells] == ["b"]
+
+    def test_mutations_commit_frame_clock(self):
+        _, orch, gid = make_orchestrator()
+        g0 = orch.clock.grid_generation(gid)
+        orch.add_cell(gid, GridCellSpec(geometry=GEOM, output="a"))
+        g1 = orch.clock.grid_generation(gid)
+        assert g1 > g0
+        orch.update_cell(gid, 0, title="t")
+        g2 = orch.clock.grid_generation(gid)
+        assert g2 > g1
+        orch.remove_cell(gid, 0)
+        assert orch.clock.grid_generation(gid) > g2
+
+
+class TestHistoryDemand:
+    def test_history_extractor_upgrades_buffers(self):
+        data, orch, gid = make_orchestrator()
+        k = key()
+        data.put(k, Timestamp.from_ns(1), 1.0)
+        upgraded: list[ResultKey] = []
+        original = data.require_history
+
+        def spy(key_):
+            upgraded.append(key_)
+            return original(key_)
+
+        data.require_history = spy
+        orch.add_cell(
+            gid,
+            GridCellSpec(
+                geometry=GEOM,
+                output="image_current",
+                params=(("extractor", "window_sum"), ("window_s", 5.0)),
+            ),
+        )
+        assert k in upgraded
+
+    def test_latest_extractor_does_not_demand_history(self):
+        data, orch, gid = make_orchestrator()
+        data.put(key(), Timestamp.from_ns(1), 1.0)
+        upgraded: list[ResultKey] = []
+        data.require_history = lambda k_: upgraded.append(k_)
+        orch.add_cell(
+            gid, GridCellSpec(geometry=GEOM, output="image_current")
+        )
+        assert upgraded == []
