@@ -479,3 +479,22 @@ class TestCompactWire:
         np.testing.assert_array_equal(
             np.asarray(hs.read(ss)[0]), np.asarray(hp.read(sp)[0])
         )
+
+    def test_compact_power_of_two_bpb_shift_path(self):
+        """Power-of-two bpb takes the native shift path; compact output
+        must agree with the int32 wire there too."""
+        bpb = 32_768  # pow2, <= 0xFFFF, multiple of 128
+        flat = self._events(seed=6)
+        e32, m32 = partition_events_host(
+            flat, self.N_INCL, bpb=bpb, chunk=512
+        )
+        e16, m16 = partition_events_host(
+            flat, self.N_INCL, bpb=bpb, chunk=512, compact=True
+        )
+        np.testing.assert_array_equal(m16, m32)
+        blk = np.repeat(m16, 512).astype(np.int64)
+        pad = e16 == 0xFFFF
+        np.testing.assert_array_equal(pad, e32 < 0)
+        np.testing.assert_array_equal(
+            e16.astype(np.int64)[~pad] + blk[~pad] * bpb, e32[~pad]
+        )
