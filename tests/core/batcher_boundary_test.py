@@ -1,0 +1,146 @@
+"""SimpleMessageBatcher boundary conditions + LoadGovernor counter
+semantics (reference granularity: tests/core/message_batcher_test.py —
+exact boundaries, hostile timestamps, gap progression, counter resets).
+"""
+
+from __future__ import annotations
+
+from esslivedata_tpu.core.constants import (
+    PULSE_PERIOD_NS_DEN,
+    PULSE_PERIOD_NS_NUM,
+)
+from esslivedata_tpu.core.message import Message, StreamId, StreamKind
+from esslivedata_tpu.core.message_batcher import (
+    LoadGovernor,
+    SimpleMessageBatcher,
+)
+from esslivedata_tpu.core.timestamp import Duration, Timestamp
+
+DET = StreamId(kind=StreamKind.DETECTOR_EVENTS, name="det0")
+PULSE_NS = PULSE_PERIOD_NS_NUM // PULSE_PERIOD_NS_DEN  # ~71.4 ms
+
+
+def msg(t_ns: int) -> Message:
+    return Message(timestamp=Timestamp.from_ns(t_ns), stream=DET, value=t_ns)
+
+
+def pulse_ts(i: int) -> int:
+    return Timestamp.from_pulse_index(i).ns
+
+
+class TestExactBoundaries:
+    def test_message_exactly_on_window_end_goes_to_next_batch(self):
+        b = SimpleMessageBatcher(Duration.from_s(1.0))
+        # Window = 14 pulses starting at pulse 0.
+        first = msg(pulse_ts(0))
+        boundary = msg(pulse_ts(14))  # exactly the window end
+        assert b.batch([first]) is None
+        out = b.batch([boundary])
+        assert out is not None
+        assert [m.value for m in out.messages] == [first.value]
+        assert out.end.ns == pulse_ts(14)
+        # The boundary message opens (and later closes into) the next window.
+        out2 = b.batch([msg(pulse_ts(28))])
+        assert out2 is not None
+        assert [m.value for m in out2.messages] == [boundary.value]
+        assert out2.start.ns == pulse_ts(14)
+
+    def test_one_tick_before_boundary_stays_in_window(self):
+        b = SimpleMessageBatcher(Duration.from_s(1.0))
+        inside = msg(pulse_ts(14) - 1)
+        assert b.batch([msg(pulse_ts(0)), inside]) is None
+        out = b.batch([msg(pulse_ts(14))])
+        assert inside.value in [m.value for m in out.messages]
+
+    def test_zero_timestamp(self):
+        b = SimpleMessageBatcher(Duration.from_s(1.0))
+        assert b.batch([msg(0)]) is None
+        out = b.batch([msg(pulse_ts(20))])
+        assert out is not None and out.start.ns == 0
+
+    def test_very_small_window_floors_at_one_pulse(self):
+        b = SimpleMessageBatcher(Duration.from_ns(1))
+        assert b.window.ns == PULSE_NS or b.window.ns == PULSE_NS + 1
+        b.batch([msg(pulse_ts(0))])
+        out = b.batch([msg(pulse_ts(1))])
+        assert out is not None
+        assert out.end.ns - out.start.ns <= PULSE_NS + 1
+
+
+class TestGapProgression:
+    def test_large_gap_skips_to_aligned_window(self):
+        b = SimpleMessageBatcher(Duration.from_s(1.0))
+        b.batch([msg(pulse_ts(0))])
+        # A message 100 windows later closes window 0 and the NEXT open
+        # window must be the aligned one containing it — not 99 empties.
+        far = msg(pulse_ts(14 * 100 + 3))
+        out = b.batch([far])
+        assert out is not None and len(out.messages) == 1
+        closer = msg(pulse_ts(14 * 101 + 1))
+        out2 = b.batch([closer])
+        assert out2 is not None
+        assert [m.value for m in out2.messages] == [far.value]
+        # Window alignment preserved: start is a multiple of 14 pulses
+        # from the original grid.
+        assert (out2.start.pulse_index() - 14) % 14 == 0
+
+    def test_multiple_batches_progress_without_overlap(self):
+        b = SimpleMessageBatcher(Duration.from_s(1.0))
+        batches = []
+        for i in range(14 * 6):
+            out = b.batch([msg(pulse_ts(i))])
+            if out is not None:
+                batches.append(out)
+        assert len(batches) >= 4
+        for a, c in zip(batches, batches[1:]):
+            assert a.end.ns <= c.start.ns, "windows overlap"
+        seen = [m.value for b_ in batches for m in b_.messages]
+        assert len(seen) == len(set(seen))
+
+
+class TestGovernorCounters:
+    def test_underload_resets_overload_streak(self):
+        g = LoadGovernor()
+        assert g.observe(0.9) is False  # over x1
+        assert g.observe(0.1) is False  # under x1 (resets over)
+        assert g.observe(0.9) is False  # over x1 again: no escalation yet
+        assert g.observe(0.9) is True  # over x2: escalates
+        assert g.scale == 2.0
+
+    def test_overload_resets_underload_streak(self):
+        g = LoadGovernor()
+        g.escalate()  # scale 2 so relax() has room
+        assert g.observe(0.1) is False
+        assert g.observe(0.1) is False
+        assert g.observe(0.9) is False  # resets under streak
+        assert g.observe(0.1) is False
+        assert g.observe(0.1) is False
+        assert g.observe(0.1) is True  # three consecutive: relaxes
+        assert g.scale < 2.0
+
+    def test_dead_zone_resets_both_streaks(self):
+        g = LoadGovernor()
+        assert g.observe(0.9) is False
+        assert g.observe(0.5) is False  # dead zone: between low and high
+        assert g.observe(0.9) is False  # streak restarted
+        assert g.observe(0.9) is True
+
+    def test_relax_floors_at_one(self):
+        g = LoadGovernor()
+        for _ in range(10):
+            g.relax()
+        assert g.scale == 1.0
+
+    def test_escalate_caps_at_max(self):
+        g = LoadGovernor(max_scale=4.0)
+        assert g.escalate() and g.escalate()
+        assert g.scale == 4.0
+        assert g.escalate() is False  # capped: no change
+        assert g.scale == 4.0
+
+    def test_barely_keeping_up_never_oscillates(self):
+        """Load hovering just under the high threshold: no changes at
+        all — the dead zone absorbs it."""
+        g = LoadGovernor()
+        assert all(not g.observe(0.75) for _ in range(50))
+        assert g.scale == 1.0
