@@ -693,6 +693,13 @@ def run_benchmark(args, platform: str) -> dict:
         "platform": platform,
         "method": method,
         "window": "best-of-3",
+        # Ingest bytes/event over the host->device link: 4 for the
+        # flat-int32 wire, 2 when pallas2d's compact uint16 wire engages
+        # (ADR 0108) — the binding constraint on degraded relay days.
+        "wire_bytes_per_event": (
+            2 if method == "pallas2d" and getattr(hist, "_p2_compact", False)
+            else 4
+        ),
     }
     if args.replay:
         result["distribution"] = f"replayed:{Path(args.replay).name}"
