@@ -10,6 +10,7 @@ across the fleet.
 from __future__ import annotations
 
 import logging
+import uuid
 from collections.abc import Sequence
 
 from pydantic import ValidationError
@@ -105,14 +106,18 @@ class CommandDispatcher:
             logger.warning("Malformed job command: %r", payload)
             return None
         try:
-            if self._job_manager.handle_command(command) == 0:
+            acted = self._job_manager.handle_command(command)
+            if acted == 0:
                 return None  # not our job: silent (another service owns it)
-            status, message = "ack", ""
+            status, message = "ack", f"acted_on={acted}" if acted > 1 else ""
         except Exception as err:
             status, message = "error", f"{type(err).__name__}: {err}"
+        # Scoped/broadcast selectors have no single job identity: the ack
+        # echoes the selector with a nil job number (dashboards track
+        # per-job commands only and ignore unknown-job acks by contract).
         return CommandAcknowledgement(
-            source_name=command.source_name,
-            job_number=command.job_number,
+            source_name=command.source_name or command.workflow_id or "*",
+            job_number=command.job_number or uuid.UUID(int=0),
             status=status,
             message=message,
             service=self._service_name,

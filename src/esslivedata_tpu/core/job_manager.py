@@ -25,7 +25,7 @@ from dataclasses import dataclass, field
 from enum import StrEnum
 from typing import Any, Literal
 
-from pydantic import BaseModel
+from pydantic import BaseModel, model_validator
 
 from ..config.workflow_spec import JobId, WorkflowConfig
 from ..workflows.workflow_factory import WorkflowFactory, workflow_registry
@@ -40,11 +40,39 @@ logger = logging.getLogger(__name__)
 
 
 class JobCommand(BaseModel):
-    """stop/remove/reset command from the dashboard (reference :67)."""
+    """stop/remove/reset command from the dashboard (reference :67).
+
+    Selector forms (reference job_manager broadcast/by-workflow actions):
+
+    - exact: ``source_name`` + ``job_number`` — one job;
+    - by source: ``source_name`` alone — every job on that source;
+    - by workflow: ``workflow_id`` (optionally + ``source_name``) —
+      every job of that workflow;
+    - broadcast: no selector — every job this service hosts.
+    """
 
     action: Literal["stop", "remove", "reset"]
-    source_name: str
-    job_number: uuid.UUID
+    source_name: str | None = None
+    job_number: uuid.UUID | None = None
+    workflow_id: str | None = None
+
+    @model_validator(mode="after")
+    def _job_number_needs_source(self):
+        if self.job_number is not None and self.source_name is None:
+            raise ValueError("job_number requires source_name")
+        return self
+
+    def matches(self, job_id: JobId, workflow_id) -> bool:
+        if self.job_number is not None:
+            return (
+                job_id.source_name == self.source_name
+                and job_id.job_number == self.job_number
+            )
+        if self.source_name is not None and job_id.source_name != self.source_name:
+            return False
+        if self.workflow_id is not None and str(workflow_id) != self.workflow_id:
+            return False
+        return True
 
 
 class JobFactory:
@@ -226,23 +254,23 @@ class JobManager:
         sees the shared commands topic but owns a disjoint job set, and a
         non-owner must stay silent (the dispatcher acks only on count > 0).
         """
-        job_id = JobId(
-            source_name=command.source_name, job_number=command.job_number
-        )
         with self._lock:
-            rec = self._records.get(job_id)
-            if rec is None:
-                return 0
-            if command.action == "stop":
-                # Graceful: the job processes one more window and flushes a
-                # final result before leaving the active set.
-                rec.finishing = True
-            elif command.action == "remove":
-                rec.phase = _Phase.STOPPED
-                del self._records[job_id]
-            elif command.action == "reset":
-                self._reset_record(rec)
-            return 1
+            matched = [
+                (jid, rec)
+                for jid, rec in self._records.items()
+                if command.matches(jid, rec.job.workflow_id)
+            ]
+            for jid, rec in matched:
+                if command.action == "stop":
+                    # Graceful: the job processes one more window and
+                    # flushes a final result before leaving the active set.
+                    rec.finishing = True
+                elif command.action == "remove":
+                    rec.phase = _Phase.STOPPED
+                    del self._records[jid]
+                elif command.action == "reset":
+                    self._reset_record(rec)
+            return len(matched)
 
     # -- run transitions ---------------------------------------------------
     def handle_run_transition(self, event: RunStart | RunStop) -> None:

@@ -1,0 +1,168 @@
+"""Scoped and broadcast job commands (reference job_manager breadth:
+reset-by-workflow resets all its sources only, broadcast stop reaches
+scheduled jobs, per-source scoping).
+"""
+
+import uuid
+
+import numpy as np
+import pytest
+from pydantic import ValidationError
+
+from esslivedata_tpu.config import JobId, WorkflowConfig, WorkflowSpec
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.core.job_manager import JobCommand, JobFactory, JobManager
+from esslivedata_tpu.utils import DataArray, Variable
+from esslivedata_tpu.workflows import WorkflowFactory
+
+
+class SummingWorkflow:
+    def __init__(self):
+        self.total = 0.0
+        self.clear_calls = 0
+
+    def accumulate(self, data):
+        for v in data.values():
+            self.total += v
+
+    def finalize(self):
+        return {
+            "total": DataArray(
+                Variable(np.asarray(self.total), (), "counts"), name="total"
+            )
+        }
+
+    def clear(self):
+        self.clear_calls += 1
+        self.total = 0.0
+
+
+@pytest.fixture
+def manager():
+    reg = WorkflowFactory()
+    for name in ("viewa", "viewb"):
+        handle = reg.register_spec(
+            WorkflowSpec(
+                instrument="dummy",
+                name=name,
+                source_names=["bank0", "bank1"],
+            )
+        )
+        handle.attach_factory(lambda *, source_name, params: SummingWorkflow())
+    jm = JobManager(job_factory=JobFactory(reg), job_threads=1)
+    jobs = {}
+    for name in ("viewa", "viewb"):
+        wid = next(
+            s.identifier for s in reg.specs_for_instrument("dummy")
+            if s.name == name
+        )
+        for source in ("bank0", "bank1"):
+            jid = JobId(source_name=source, job_number=uuid.uuid4())
+            jm.schedule_job(
+                WorkflowConfig(identifier=wid, job_id=jid, params={})
+            )
+            jobs[(name, source)] = (wid, jid)
+    # Activate everything with one window of data.
+    jm.process_jobs(
+        {"bank0": 1.0, "bank1": 1.0},
+        start=Timestamp.from_ns(0),
+        end=Timestamp.from_ns(1_000),
+    )
+    return jm, jobs
+
+
+def alive(jm):
+    return {(s.workflow_id.split("/")[2], s.source_name) for s in jm.job_statuses()}
+
+
+class TestSelectorValidation:
+    def test_job_number_requires_source(self):
+        with pytest.raises(ValidationError):
+            JobCommand(action="stop", job_number=uuid.uuid4())
+
+    def test_bare_action_is_broadcast(self):
+        cmd = JobCommand(action="reset")
+        assert cmd.source_name is None and cmd.workflow_id is None
+
+
+class TestScopedCommands:
+    def test_exact_selector_touches_one_job(self, manager):
+        jm, jobs = manager
+        wid, jid = jobs[("viewa", "bank0")]
+        n = jm.handle_command(
+            JobCommand(
+                action="remove",
+                source_name=jid.source_name,
+                job_number=jid.job_number,
+            )
+        )
+        assert n == 1
+        assert ("viewa", "bank0") not in alive(jm)
+        assert len(alive(jm)) == 3
+
+    def test_workflow_selector_touches_all_its_sources_only(self, manager):
+        jm, jobs = manager
+        wid, _ = jobs[("viewa", "bank0")]
+        n = jm.handle_command(
+            JobCommand(action="remove", workflow_id=str(wid))
+        )
+        assert n == 2
+        assert alive(jm) == {("viewb", "bank0"), ("viewb", "bank1")}
+
+    def test_workflow_plus_source_narrows(self, manager):
+        jm, jobs = manager
+        wid, _ = jobs[("viewa", "bank0")]
+        n = jm.handle_command(
+            JobCommand(
+                action="remove", workflow_id=str(wid), source_name="bank1"
+            )
+        )
+        assert n == 1
+        assert ("viewa", "bank1") not in alive(jm)
+        assert ("viewa", "bank0") in alive(jm)
+
+    def test_source_selector_spans_workflows(self, manager):
+        jm, jobs = manager
+        n = jm.handle_command(
+            JobCommand(action="remove", source_name="bank0")
+        )
+        assert n == 2
+        assert alive(jm) == {("viewa", "bank1"), ("viewb", "bank1")}
+
+    def test_broadcast_reaches_everything(self, manager):
+        jm, _ = manager
+        n = jm.handle_command(JobCommand(action="remove"))
+        assert n == 4
+        assert jm.job_statuses() == []
+
+    def test_unmatched_workflow_returns_zero(self, manager):
+        jm, _ = manager
+        n = jm.handle_command(
+            JobCommand(action="stop", workflow_id="dummy/default/nope/v1")
+        )
+        assert n == 0
+
+    def test_scoped_reset_clears_accumulation(self, manager):
+        jm, jobs = manager
+        wid, _ = jobs[("viewa", "bank0")]
+        n = jm.handle_command(
+            JobCommand(action="reset", workflow_id=str(wid))
+        )
+        assert n == 2
+        results = jm.process_jobs(
+            {"bank0": 5.0, "bank1": 5.0},
+            start=Timestamp.from_ns(1_000),
+            end=Timestamp.from_ns(2_000),
+        )
+        by_job = {
+            (r.workflow_id.name, r.job_id.source_name): float(
+                np.asarray(next(iter(r.outputs.values())).values)
+            )
+            for r in results
+        }
+        # viewa accumulators restarted at 0 (+5); viewb kept the first
+        # window's 1 (+5).
+        for name, source in jobs:
+            assert by_job[(name, source)] == (
+                5.0 if name == "viewa" else 6.0
+            )
