@@ -188,6 +188,8 @@ class Job:
         self._generation_start: Timestamp | None = None
         self._window_end: Timestamp | None = None
         self._start_wall = time.time()
+        #: Output names whose last finalize returned None (warning surface).
+        self.none_outputs: tuple[str, ...] = ()
 
     @property
     def subscribed_streams(self) -> set[str]:
@@ -236,7 +238,12 @@ class Job:
         """
         if self.workflow is None:
             raise RuntimeError(f"Job {self.job_id} is released (stopped)")
-        outputs = self.workflow.finalize()
+        raw = self.workflow.finalize()
+        # None-valued outputs degrade to a per-job WARNING, publishing the
+        # rest (reference: warning_from_none_values propagates to the job
+        # status) — one absent output must not error the whole job.
+        outputs = {k: v for k, v in raw.items() if v is not None}
+        self.none_outputs = tuple(k for k, v in raw.items() if v is None)
         start, end = self._generation_start, self._window_end
         for da in outputs.values():
             if "time" in da.coords or "end_time" in da.coords:

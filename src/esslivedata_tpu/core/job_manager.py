@@ -510,6 +510,11 @@ class JobManager:
                 result = job.get()
                 rec.error = ""
                 rec.has_primary_data = False
+                if job.none_outputs:
+                    rec.warning = (
+                        "outputs returned None: "
+                        + ", ".join(job.none_outputs)
+                    )
                 return result
             except Exception as err:
                 rec.error = f"{type(err).__name__}: {err}"

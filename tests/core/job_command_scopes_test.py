@@ -166,3 +166,40 @@ class TestScopedCommands:
             assert by_job[(name, source)] == (
                 5.0 if name == "viewa" else 6.0
             )
+
+
+class TestNoneOutputs:
+    def test_none_output_warns_and_publishes_the_rest(self):
+        class PartialWorkflow(SummingWorkflow):
+            def finalize(self):
+                out = super().finalize()
+                out["missing"] = None
+                return out
+
+        reg = WorkflowFactory()
+        h = reg.register_spec(
+            WorkflowSpec(
+                instrument="dummy", name="partial", source_names=["bank0"]
+            )
+        )
+        h.attach_factory(lambda *, source_name, params: PartialWorkflow())
+        jm = JobManager(job_factory=JobFactory(reg), job_threads=1)
+        wid = next(s.identifier for s in reg.specs_for_instrument("dummy"))
+        jm.schedule_job(
+            WorkflowConfig(
+                identifier=wid,
+                job_id=JobId(source_name="bank0", job_number=uuid.uuid4()),
+                params={},
+            )
+        )
+        results = jm.process_jobs(
+            {"bank0": 2.0},
+            start=Timestamp.from_ns(0),
+            end=Timestamp.from_ns(1_000),
+        )
+        assert len(results) == 1
+        # The good output published; the None one was dropped.
+        assert set(results[0].outputs) == {"total"}
+        [status] = jm.job_statuses()
+        assert "missing" in status.message
+        assert status.state.value != "error"
