@@ -62,9 +62,15 @@ class DeviceExtractor:
                         )
                     continue
                 emitted.add(entry.device_name)
+                # The message timestamp is the RESULT time (window end):
+                # it advances every update, so timestamp-keyed NICOS
+                # caches see fresh values. The generation marker rides
+                # the start_time coord, not the envelope.
                 messages.append(
                     Message(
-                        timestamp=result.start or Timestamp.from_ns(0),
+                        timestamp=result.end
+                        or result.start
+                        or Timestamp.from_ns(0),
                         stream=StreamId(
                             kind=StreamKind.LIVEDATA_NICOS_DATA,
                             name=entry.device_name,

@@ -89,3 +89,54 @@ class TestDeviceExtractor:
             r for r in caplog.records if "Multiple jobs" in r.message
         ]
         assert len(warnings) == 1  # once, not per cycle
+
+
+def test_message_timestamp_advances_with_windows(contract_extractor=None):
+    """The envelope timestamp is the window END: it must advance every
+    update (a timestamp-keyed NICOS cache treats a constant timestamp as
+    stale), while the generation marker rides the start_time coord."""
+    import uuid
+
+    import numpy as np
+
+    from esslivedata_tpu.config.device_contract import (
+        DeviceContract,
+        DeviceContractEntry,
+    )
+    from esslivedata_tpu.config.workflow_spec import JobId, WorkflowId
+    from esslivedata_tpu.core.job import JobResult
+    from esslivedata_tpu.core.nicos_devices import DeviceExtractor
+    from esslivedata_tpu.core.timestamp import Timestamp
+    from esslivedata_tpu.utils import DataArray, Variable
+
+    wid = WorkflowId(instrument="dummy", name="view")
+    contract = DeviceContract(
+        [
+            DeviceContractEntry(
+                workflow_id=str(wid),
+                source_name="bank0",
+                output_name="total",
+                device_name="det_total",
+            )
+        ]
+    )
+    extractor = DeviceExtractor(device_contract=contract)
+    jid = JobId(source_name="bank0", job_number=uuid.uuid4())
+
+    def result(end_ns: int) -> JobResult:
+        return JobResult(
+            job_id=jid,
+            workflow_id=wid,
+            outputs={
+                "total": DataArray(
+                    Variable(np.asarray(1.0), (), "counts"), name="total"
+                )
+            },
+            start=Timestamp.from_ns(100),  # generation start: constant
+            end=Timestamp.from_ns(end_ns),
+        )
+
+    [m1] = extractor.extract([result(1_000)])
+    [m2] = extractor.extract([result(2_000)])
+    assert m1.timestamp.ns == 1_000
+    assert m2.timestamp.ns == 2_000
