@@ -92,7 +92,9 @@ class TestDeviceExtractor:
         (m,) = messages
         assert m.stream.kind == StreamKind.LIVEDATA_NICOS_DATA
         assert m.stream.name == "mon_counts_mon1"  # stable: no job_number
-        assert m.timestamp.ns == 123  # start_time = generation detector
+        # Envelope stamps the window END (advances every update); the
+        # generation detector rides the start_time coord instead.
+        assert m.timestamp.ns == 456
 
     def test_missing_output_skipped(self):
         spec = _spec()
