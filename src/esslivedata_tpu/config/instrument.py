@@ -184,12 +184,69 @@ class Instrument:
 
     def load_factories(self) -> None:
         """Import the heavy factory module, attaching workflow factories to
-        the registry (reference instrument.py:654 lazy loading)."""
+        the registry (reference instrument.py:654 lazy loading), then check
+        registration-time invariants (reference instrument.py:759 validate)."""
         if self._loaded:
             return
         self._loaded = True
         if self._factories_module:
             importlib.import_module(self._factories_module)
+            self.validate()
+
+    # -- registration-time invariants (reference instrument.py:759-857) ----
+    def _known_stream_names(self) -> set[str]:
+        """Every stream name a service could subscribe to for this
+        instrument: catalog streams (f144 PVs, synthesized devices +
+        their substreams), log sources, chopper synthesis streams."""
+        names: set[str] = set(self.streams) | set(self.log_sources)
+        for device in self.devices.values():
+            names.update(device.substream_names)
+        if self.choppers:
+            from .chopper import delay_readback_stream, speed_setpoint_stream
+
+            for chopper in self.choppers:
+                names.add(speed_setpoint_stream(chopper))
+                names.add(delay_readback_stream(chopper))
+        return names
+
+    def validate(self) -> None:
+        """Raise ValueError on misconfigurations that would otherwise fail
+        silently at runtime (a gated job waiting forever on a typo'd
+        stream, a binding scoped to sources nothing advertises, colliding
+        NICOS device names). Runs at the end of ``load_factories``;
+        exposed separately so synthetic instruments in tests can check
+        without the package machinery."""
+        from ..workflows.workflow_factory import workflow_registry
+
+        specs = workflow_registry.specs_for_instrument(self.name)
+        known_sources: set[str] = set()
+        for spec in specs:
+            known_sources.update(spec.source_names)
+        known_streams = self._known_stream_names()
+
+        for binding in self.context_bindings:
+            unknown = set(binding.dependent_sources) - known_sources
+            if unknown:
+                raise ValueError(
+                    f"{self.name}: ContextBinding for "
+                    f"{binding.stream_name!r} lists dependent_sources "
+                    f"{sorted(unknown)} that no registered spec advertises"
+                )
+            if binding.stream_name not in known_streams:
+                raise ValueError(
+                    f"{self.name}: ContextBinding targets undeclared "
+                    f"stream {binding.stream_name!r} — a job gated on it "
+                    f"would wait forever"
+                )
+        # Same context key bound to different streams for one source.
+        for source in sorted(known_sources):
+            self.resolve_context_keys(source)
+        # Colliding NICOS device names across specs raise here instead of
+        # at service assembly.
+        if specs:
+            from .device_contract import DeviceContract
+
+            DeviceContract.from_specs(specs)
 
 
 class InstrumentRegistry:

@@ -1,0 +1,142 @@
+"""Registration-time instrument validation (reference
+config/instrument.py:759-857): misconfigurations raise at load time
+instead of failing silently at runtime.
+"""
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.config.instrument import (
+    DetectorConfig,
+    Instrument,
+    instrument_registry,
+)
+from esslivedata_tpu.config.stream import ContextBinding
+from esslivedata_tpu.config.workflow_spec import WorkflowSpec
+from esslivedata_tpu.workflows.workflow_factory import (
+    WorkflowFactory,
+    workflow_registry,
+)
+
+
+@pytest.mark.parametrize("name", sorted(instrument_registry.names()))
+def test_every_builtin_instrument_validates(name):
+    inst = instrument_registry[name]
+    inst.load_factories()
+    inst.validate()  # idempotent re-check
+
+
+def synth_instrument(monkeypatch, *, specs, bindings=(), logs=()):
+    """A synthetic instrument checked against a private registry."""
+    reg = WorkflowFactory()
+    for spec in specs:
+        reg.register_spec(spec)
+    monkeypatch.setattr(
+        workflow_registry,
+        "specs_for_instrument",
+        reg.specs_for_instrument,
+    )
+    inst = Instrument(name="synth")
+    inst.add_detector(
+        DetectorConfig(
+            name="bank0",
+            source_name="bank0",
+            detector_number=np.arange(4).reshape(2, 2) + 1,
+        )
+    )
+    for stream in logs:
+        inst.add_log(stream)
+    for b in bindings:
+        inst.add_context_binding(b)
+    return inst
+
+
+SPEC = WorkflowSpec(instrument="synth", name="view", source_names=["bank0"])
+
+
+class TestValidationFailures:
+    def test_unknown_dependent_source_rejected(self, monkeypatch):
+        inst = synth_instrument(
+            monkeypatch,
+            specs=[SPEC],
+            logs=["motor_x"],
+            bindings=[
+                ContextBinding(
+                    stream_name="motor_x",
+                    workflow_key="x",
+                    dependent_sources=frozenset({"ghost_bank"}),
+                )
+            ],
+        )
+        with pytest.raises(ValueError, match="ghost_bank"):
+            inst.validate()
+
+    def test_undeclared_binding_stream_rejected(self, monkeypatch):
+        inst = synth_instrument(
+            monkeypatch,
+            specs=[SPEC],
+            bindings=[
+                ContextBinding(
+                    stream_name="no_such_pv",
+                    workflow_key="x",
+                    dependent_sources=frozenset({"bank0"}),
+                )
+            ],
+        )
+        with pytest.raises(ValueError, match="no_such_pv"):
+            inst.validate()
+
+    def test_conflicting_context_key_rejected(self, monkeypatch):
+        inst = synth_instrument(
+            monkeypatch,
+            specs=[SPEC],
+            logs=["motor_x", "motor_y"],
+            bindings=[
+                ContextBinding(
+                    stream_name="motor_x",
+                    workflow_key="pos",
+                    dependent_sources=frozenset({"bank0"}),
+                ),
+                ContextBinding(
+                    stream_name="motor_y",
+                    workflow_key="pos",
+                    dependent_sources=frozenset({"bank0"}),
+                ),
+            ],
+        )
+        with pytest.raises(ValueError, match="pos"):
+            inst.validate()
+
+    def test_colliding_device_names_rejected(self, monkeypatch):
+        a = WorkflowSpec(
+            instrument="synth",
+            name="viewa",
+            source_names=["bank0"],
+            device_outputs={"total": "det_{source_name}"},
+            outputs={},
+        )
+        b = WorkflowSpec(
+            instrument="synth",
+            name="viewb",
+            source_names=["bank0"],
+            device_outputs={"total": "det_{source_name}"},
+            outputs={},
+        )
+        inst = synth_instrument(monkeypatch, specs=[a, b])
+        with pytest.raises(ValueError):
+            inst.validate()
+
+    def test_clean_instrument_passes(self, monkeypatch):
+        inst = synth_instrument(
+            monkeypatch,
+            specs=[SPEC],
+            logs=["motor_x"],
+            bindings=[
+                ContextBinding(
+                    stream_name="motor_x",
+                    workflow_key="x",
+                    dependent_sources=frozenset({"bank0"}),
+                )
+            ],
+        )
+        inst.validate()
