@@ -498,3 +498,32 @@ class TestCompactWire:
         np.testing.assert_array_equal(
             e16.astype(np.int64)[~pad] + blk[~pad] * bpb, e32[~pad]
         )
+
+
+class TestCompactWireProperty:
+    """Randomized partition sweep: the compact wire reconstructs the
+    int32 wire exactly for every (bpb, chunk, distribution) combination
+    the constructor accepts."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_configs_reconstruct(self, seed):
+        rng = np.random.default_rng(seed)
+        bpb = int(rng.choice([128, 1024, 12800, 32768, 51200, 65408]))
+        chunk = int(rng.choice([128, 256, 512, 1024]))
+        n_incl = int(rng.integers(1, 8) * bpb + rng.integers(1, bpb))
+        n = int(rng.integers(0, 30_000))
+        flat = rng.integers(-10, n_incl + 10, n).astype(np.int32)
+        e32, m32 = partition_events_host(
+            flat, n_incl, bpb=bpb, chunk=chunk
+        )
+        e16, m16 = partition_events_host(
+            flat, n_incl, bpb=bpb, chunk=chunk, compact=True
+        )
+        assert e16.dtype == np.uint16
+        np.testing.assert_array_equal(m16, m32)
+        blk = np.repeat(m16, chunk).astype(np.int64)
+        pad = e16 == 0xFFFF
+        np.testing.assert_array_equal(pad, e32 < 0)
+        np.testing.assert_array_equal(
+            e16.astype(np.int64)[~pad] + blk[~pad] * bpb, e32[~pad]
+        )
