@@ -7,7 +7,12 @@
 # never collides with the driver's graded run — the graded run waits on
 # the lock instead of failing backend init.
 cd /root/repo
+# Self-terminate well before round end: a sampler holding the relay or
+# burning the single CPU core during the judged test/bench runs would
+# corrupt the very evidence these loops exist to collect.
+LOOP_DEADLINE=${LOOP_DEADLINE:-$(date -u -d '2026-07-31 14:45' +%s 2>/dev/null || echo 1785509100)}
 while true; do
+  [ "$(date +%s)" -gt "$LOOP_DEADLINE" ] && exit 0
   [ -e .stop_bench_loop ] && exit 0
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
   # probe budget 90: a recovering relay has shown healthy-but-slow init
