@@ -1,0 +1,116 @@
+"""JobService unit coverage (reference granularity:
+tests/dashboard/job_service_test.py + job_adoption_test.py): adoption vs
+known-started, ownership views, staleness, pending-command bounds.
+"""
+
+import time
+import uuid
+
+from esslivedata_tpu.core.job import JobState, JobStatus, ServiceStatus
+from esslivedata_tpu.dashboard.job_service import (
+    SERVICE_STALE_S,
+    JobService,
+    TrackedService,
+)
+from esslivedata_tpu.dashboard.transport import StatusMessage
+
+
+def job_status(source="panel_0", number=None, workflow="dummy/ns/view/v1"):
+    return JobStatus(
+        source_name=source,
+        job_number=number or uuid.uuid4(),
+        workflow_id=workflow,
+        state=JobState.ACTIVE,
+    )
+
+
+def heartbeat(service_id="detector_data", jobs=()):
+    return StatusMessage(
+        service_id=service_id,
+        status=ServiceStatus(
+            service_name=service_id, instrument="dummy", jobs=list(jobs)
+        ),
+    )
+
+
+class TestAdoption:
+    def test_unknown_job_in_heartbeat_is_adopted(self):
+        svc = JobService()
+        j = job_status()
+        svc.on_status(heartbeat(jobs=[j]))
+        assert svc.is_adopted(j.source_name, j.job_number)
+
+    def test_tracked_start_is_not_adoption(self):
+        svc = JobService()
+        j = job_status()
+        svc.track_command(j.source_name, j.job_number, "start_job")
+        svc.on_status(heartbeat(jobs=[j]))
+        assert not svc.is_adopted(j.source_name, j.job_number)
+
+    def test_owner_recorded_from_heartbeat(self):
+        svc = JobService()
+        j = job_status()
+        svc.on_status(heartbeat("monitor_data", jobs=[j]))
+        assert svc.owner_of(j.source_name, j.job_number) == "monitor_data"
+
+
+class TestDelisting:
+    def test_vanished_job_removed_and_listeners_fire(self):
+        svc = JobService()
+        gone: list = []
+        svc.add_job_gone_listener(lambda s, n: gone.append((s, n)))
+        j = job_status()
+        svc.on_status(heartbeat(jobs=[j]))
+        svc.on_status(heartbeat(jobs=[]))  # same service delists it
+        assert svc.job(j.source_name, j.job_number) is None
+        assert gone == [(j.source_name, j.job_number)]
+
+    def test_other_services_jobs_untouched(self):
+        """A heartbeat only reconciles jobs ITS previous heartbeat
+        listed — another service going quiet must not delist ours."""
+        svc = JobService()
+        ours = job_status(source="a")
+        theirs = job_status(source="b")
+        svc.on_status(heartbeat("detector_data", jobs=[ours]))
+        svc.on_status(heartbeat("monitor_data", jobs=[theirs]))
+        # detector_data heartbeats again without changes to monitor's job.
+        svc.on_status(heartbeat("detector_data", jobs=[ours]))
+        assert svc.job("b", theirs.job_number) is not None
+
+    def test_failing_listener_contained(self):
+        svc = JobService()
+
+        def bad(s, n):
+            raise RuntimeError("boom")
+
+        seen: list = []
+        svc.add_job_gone_listener(bad)
+        svc.add_job_gone_listener(lambda s, n: seen.append(s))
+        j = job_status()
+        svc.on_status(heartbeat(jobs=[j]))
+        svc.on_status(heartbeat(jobs=[]))
+        assert seen == [j.source_name]  # later listener still ran
+
+
+class TestStaleness:
+    def test_fresh_service_not_stale(self):
+        svc = JobService()
+        svc.on_status(heartbeat())
+        [tracked] = svc.services()
+        assert not tracked.is_stale
+
+    def test_old_heartbeat_goes_stale(self):
+        tracked = TrackedService(
+            service_id="x",
+            status=ServiceStatus(service_name="x", instrument="dummy"),
+            last_seen_wall=time.monotonic() - SERVICE_STALE_S - 1,
+        )
+        assert tracked.is_stale
+
+
+class TestPendingBounds:
+    def test_pending_list_bounded(self):
+        svc = JobService()
+        for i in range(250):
+            svc.track_command("s", uuid.uuid4(), "start_job")
+        assert len(svc.pending_commands()) <= 100
