@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: test unit integration browser benchmarks bench bench-all multichip native docs lint all
+.PHONY: test unit integration browser benchmarks bench bench-all multichip native docs lint lint-fix all
 
 # Default quick gate: everything CI runs per-commit.
 test: unit
@@ -52,7 +52,26 @@ native:
 docs:
 	$(PY) scripts/check_docs_links.py
 
+# Static gates, cheapest first: syntax (compileall), style/bug families
+# (ruff, when installed — the container image does not bake it in), then
+# the JAX-hazard/concurrency pass (tools/graftlint, docs/graftlint.md).
 lint:
-	$(PY) -m compileall -q src/ tests/ bench.py __graft_entry__.py
+	$(PY) -m compileall -q src/ tests/ tools/ bench.py __graft_entry__.py
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check src/ tests/ tools/ bench.py __graft_entry__.py; \
+	else \
+		echo "lint: ruff not installed, skipping (config in pyproject.toml)"; \
+	fi
+	$(PY) -m tools.graftlint src/
+
+# Apply ruff autofixes, then report what graftlint still sees (graftlint
+# never rewrites code — its fixes are reviewed hunks by design).
+lint-fix:
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check --fix src/ tests/ tools/ bench.py __graft_entry__.py; \
+	else \
+		echo "lint-fix: ruff not installed, nothing to autofix"; \
+	fi
+	$(PY) -m tools.graftlint src/
 
 all: lint unit integration docs

@@ -24,6 +24,7 @@ All window arithmetic is exact-integer in pulse indices (see
 from __future__ import annotations
 
 import math
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Protocol, runtime_checkable
@@ -99,18 +100,33 @@ class SimpleMessageBatcher:
         # measured against the window the work actually covered, not a
         # freshly escalated width.
         self._last_emitted_pulses: int = self._window_pulses
+        # Reentrant: the adaptive subclass wraps batch() and re-enters the
+        # base implementation under the same lock. Today's in-repo callers
+        # drive batch()/report_processing_time() from the one service
+        # worker thread, so this is a defensive guarantee, not a fix for
+        # an observed race: batchers are protocol objects handed to
+        # multi-threaded transports, and an unguarded cross-thread
+        # ``window`` read could observe a half-advanced (start_pulse,
+        # window_pulses) pair mid-update. Uncontended RLock acquisition
+        # is tens of ns against a >=71 ms batch window.
+        self._lock = threading.RLock()
 
     @property
     def window(self) -> Duration:
-        return Duration(
-            self._window_pulses * PULSE_PERIOD_NS_NUM // PULSE_PERIOD_NS_DEN
-        )
+        with self._lock:
+            return Duration(
+                self._window_pulses * PULSE_PERIOD_NS_NUM // PULSE_PERIOD_NS_DEN
+            )
 
     def _window_pulses_next(self) -> int:
         """Hook for adaptive subclass: pulses for the next opened window."""
         return self._window_pulses
 
     def batch(self, messages: list[Message]) -> MessageBatch | None:
+        with self._lock:
+            return self._batch_locked(messages)
+
+    def _batch_locked(self, messages: list[Message]) -> MessageBatch | None:
         self._buffer.extend(messages)
         if not self._buffer:
             return None
@@ -167,37 +183,48 @@ class LoadGovernor:
         self._deescalate_after = deescalate_after
         self._over = 0
         self._under = 0
+        # The consecutive-batch counters are read-modify-write sequences.
+        # The governor is shared infrastructure (adaptive AND rate-aware
+        # batchers); in-repo callers feed it from one worker thread, so —
+        # as with the batcher lock above — this makes the class safe to
+        # drive from any thread rather than fixing an observed race: a
+        # lost increment would silently defer an escalation. RLock:
+        # observe() re-enters escalate()/relax().
+        self._lock = threading.RLock()
 
     def observe(self, load: float) -> bool:
         """Feed one batch's load; returns True when the scale changed."""
-        if load > self._high:
-            self._over += 1
-            self._under = 0
-        elif load < self._low:
-            self._under += 1
-            self._over = 0
-        else:
-            self._over = 0
-            self._under = 0
-        if self._over >= self._escalate_after:
-            self._over = 0
-            return self.escalate()
-        if self._under >= self._deescalate_after:
-            self._under = 0
-            return self.relax()
-        return False
+        with self._lock:
+            if load > self._high:
+                self._over += 1
+                self._under = 0
+            elif load < self._low:
+                self._under += 1
+                self._over = 0
+            else:
+                self._over = 0
+                self._under = 0
+            if self._over >= self._escalate_after:
+                self._over = 0
+                return self.escalate()
+            if self._under >= self._deescalate_after:
+                self._under = 0
+                return self.relax()
+            return False
 
     def escalate(self) -> bool:
-        new = min(self._max_scale, self.scale * 2.0)
-        changed = new != self.scale
-        self.scale = new
-        return changed
+        with self._lock:
+            new = min(self._max_scale, self.scale * 2.0)
+            changed = new != self.scale
+            self.scale = new
+            return changed
 
     def relax(self) -> bool:
-        new = max(1.0, self.scale / math.sqrt(2.0))
-        changed = new != self.scale
-        self.scale = new
-        return changed
+        with self._lock:
+            new = max(1.0, self.scale / math.sqrt(2.0))
+            changed = new != self.scale
+            self.scale = new
+            return changed
 
 
 class AdaptiveMessageBatcher(SimpleMessageBatcher):
@@ -238,34 +265,39 @@ class AdaptiveMessageBatcher(SimpleMessageBatcher):
 
     @property
     def scale(self) -> float:
-        return self._pending_pulses / self._base_pulses
+        with self._lock:
+            return self._pending_pulses / self._base_pulses
 
     def _window_pulses_next(self) -> int:
         return self._pending_pulses
 
     def batch(self, messages: list[Message]) -> MessageBatch | None:
-        now = self._clock()
-        if messages:
-            self._last_activity = now
-        elif (
-            now - self._last_activity > self._idle_timeout_s
-            and self._pending_pulses > self._base_pulses
-        ):
-            # Data stopped: relax toward the base window so the next burst
-            # is not stuck behind a huge escalated window.
-            self._deescalate()
-            self._last_activity = now
-        return super().batch(messages)
+        with self._lock:
+            now = self._clock()
+            if messages:
+                self._last_activity = now
+            elif (
+                now - self._last_activity > self._idle_timeout_s
+                and self._pending_pulses > self._base_pulses
+            ):
+                # Data stopped: relax toward the base window so the next
+                # burst is not stuck behind a huge escalated window.
+                self._deescalate()
+                self._last_activity = now
+            return self._batch_locked(messages)
 
     def report_processing_time(self, duration: Duration) -> None:
-        window_ns = (
-            self._last_emitted_pulses * PULSE_PERIOD_NS_NUM / PULSE_PERIOD_NS_DEN
-        )
-        if self._governor.observe(duration.ns / window_ns):
-            self._apply_scale()
+        with self._lock:
+            window_ns = (
+                self._last_emitted_pulses
+                * PULSE_PERIOD_NS_NUM
+                / PULSE_PERIOD_NS_DEN
+            )
+            if self._governor.observe(duration.ns / window_ns):
+                self._apply_scale()
 
     def _deescalate(self) -> None:
-        """Idle relaxation path (wall-clock driven)."""
+        """Idle relaxation path (wall-clock driven); caller holds the lock."""
         self._governor.relax()
         self._apply_scale()
 

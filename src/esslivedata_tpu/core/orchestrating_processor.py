@@ -419,7 +419,10 @@ class OrchestratingProcessor:
             if memory := device_memory_stats():
                 extra["device_memory"] = memory
         except Exception:  # pragma: no cover - backend without stats
-            pass
+            # Memory stats are best-effort, but a permanently failing
+            # backend query should at least be visible at debug level
+            # (graftlint JGL007: no silent swallows in the service loop).
+            logger.debug("device_memory_stats unavailable", exc_info=True)
         if self._stream_counter is not None:
             # Adapter-layer per-(topic,source) counts + producer lag,
             # accumulated since the last rollover (kafka/stream_counter.py).

@@ -231,6 +231,10 @@ class RateAwareMessageBatcher:
         # Load-adaptive windows share the adaptive batcher's governor:
         # overload doubles the gated window (streams regate to the new
         # slot count at the next refresh), underload shrinks it back.
+        # The governor locks its own counters; the rest of this batcher's
+        # mutable state is deliberately unlocked — it is owned by the one
+        # service worker thread that calls batch()/report_processing_time()
+        # (unlike the protocol-level guarantee SimpleMessageBatcher makes).
         self._governor = LoadGovernor()
         self._last_emitted_window: Duration = window
 

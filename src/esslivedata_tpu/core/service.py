@@ -245,7 +245,10 @@ class Service(ServiceBase):
             # supervisor restarts it (reference service.py:166-180).
             try:
                 signal.raise_signal(signal.SIGINT)
-            except Exception:  # pragma: no cover
+            # Intentional swallow: the wakeup is best-effort during crash
+            # teardown, and any error here (exotic platform, interpreter
+            # shutdown) must not mask the worker failure logged above.
+            except Exception:  # pragma: no cover  # graftlint: disable=JGL007
                 pass
         finally:
             if did_disable:

@@ -686,6 +686,11 @@ class PlotterRegistry:
                 if predicate(da):
                     return plotter
             except Exception:
+                # A predicate that always raises would otherwise make its
+                # plotter silently unreachable (graftlint JGL007).
+                logger.debug(
+                    "plotter predicate raised; skipping", exc_info=True
+                )
                 continue
         ndim = da.data.ndim
         if ndim == 0:

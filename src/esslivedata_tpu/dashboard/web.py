@@ -940,6 +940,13 @@ class PlotHandler(_Base):
                             _id_to_key(ekid), extractor
                         )
                     except Exception:
+                        # Unresolvable overlay layers degrade to the base
+                        # render, but not silently (graftlint JGL007).
+                        logger.debug(
+                            "overlay layer %s failed; skipping",
+                            ekid,
+                            exc_info=True,
+                        )
                         continue
                     if extra is not None:
                         layers.append(extra)

@@ -203,6 +203,28 @@ def dispatch_safe(x):
     return x
 
 
+def stage_for(arr, sharding, *, dtype=None):
+    """Stage a batch onto ``sharding`` in ONE placement hop.
+
+    The sharded kernels' counterpart of ``dispatch_safe`` — same two
+    guarantees (a defensive host copy so the async transfer never reads
+    a staging buffer the caller has already reused, and an asynchronous
+    ``device_put`` so batch i+1's transfer overlaps batch i's kernel),
+    but placed directly onto the target sharding: routing a host array
+    through ``dispatch_safe`` first would commit it to the DEFAULT
+    device and pay a second device->device copy on the resharded
+    placement. ``dtype`` optionally normalizes wire dtypes on the host
+    (one pass, fused with the copy); device arrays cast on device.
+    """
+    import jax
+
+    if isinstance(arr, jax.Array):
+        if dtype is not None and arr.dtype != np.dtype(dtype):
+            arr = arr.astype(dtype)
+        return jax.device_put(arr, sharding)
+    return jax.device_put(np.array(arr, dtype=dtype, copy=True), sharding)
+
+
 def make_staging_buffer(min_bucket: int = MIN_BUCKET, prefer_native: bool = True):
     """StagingBuffer factory: the native C++ buffer (native/ingest.cpp) when
     the compiled shim is available, else the pure-Python one. Both satisfy

@@ -119,9 +119,15 @@ class EventProjection:
         return self._lut_dev
 
     def place_constants(self, device_put) -> None:
-        """Re-place the LUT/weights (e.g. replicated over a mesh)."""
-        if self.lut is not None:
-            self._lut_dev = device_put(self.lut)
+        """Re-place the LUT/weights (e.g. replicated over a mesh).
+
+        Places from the HOST copy: going through the ``lut`` property
+        would first materialize the table on the default device and pay
+        an extra device->device copy on the re-placement (the same
+        double-staging hazard fixed in ShardedHistogrammer._shard_events).
+        """
+        if self.lut_host is not None:
+            self._lut_dev = device_put(self.lut_host)
         if self.weights is not None:
             self.weights = device_put(self.weights)
 

@@ -51,7 +51,13 @@ class PackedPublisher:
         # trace recorded, silently mislabeling every output. ``__call__``
         # stamps the signature being dispatched before invoking the jit so
         # the trace-time hook files its spec under the right key.
-        self._spec_by_sig: dict[tuple, list[tuple[str, tuple[int, ...]]]] = {}
+        # Spec entries are (key, shape, size) with the element count
+        # precomputed at trace time: the unpack below runs once per
+        # publish per output key, and re-deriving sizes there (np.prod
+        # per key) is avoidable host work in the publish path.
+        self._spec_by_sig: dict[
+            tuple, list[tuple[str, tuple[int, ...], int]]
+        ] = {}
         self._pending_sig: tuple | None = None
         self._jit = jax.jit(self._packed, donate_argnums=donate)
 
@@ -70,19 +76,36 @@ class PackedPublisher:
             ),
         )
 
-    def _trace_spec(self, args) -> list[tuple[str, tuple[int, ...]]]:
+    @staticmethod
+    def _spec_of(outputs) -> list[tuple[str, tuple[int, ...], int]]:
+        # SORTED key order — the one canonical pack order. jax.eval_shape
+        # (the cache-miss fallback in __call__) rebuilds dicts through
+        # pytree flattening, which sorts keys; if _packed concatenated in
+        # insertion order instead, a fallback-derived spec would silently
+        # unpack wrong data under wrong keys for non-alphabetical
+        # programs.
+        return [
+            (k, shape := tuple(v.shape), int(np.prod(shape)) if shape else 1)
+            for k, v in sorted(outputs.items())
+        ]
+
+    def _trace_spec(self, args) -> list[tuple[str, tuple[int, ...], int]]:
         """Output spec for ``args`` via abstract evaluation (no compile)."""
         out = jax.eval_shape(lambda *a: self._program(*a)[0], *args)
-        return [(k, tuple(v.shape)) for k, v in out.items()]
+        return self._spec_of(out)
 
     def _packed(self, *args):
         outputs, *carry = self._program(*args)
-        spec = [(k, tuple(v.shape)) for k, v in outputs.items()]
+        spec = self._spec_of(outputs)
         if self._pending_sig is not None:
             self._spec_by_sig[self._pending_sig] = spec
         if outputs:
+            # Same sorted order as _spec_of (see the comment there).
             packed = jnp.concatenate(
-                [jnp.ravel(v).astype(jnp.float32) for v in outputs.values()]
+                [
+                    jnp.ravel(v).astype(jnp.float32)
+                    for _, v in sorted(outputs.items())
+                ]
             )
         else:
             packed = jnp.zeros((0,), jnp.float32)
@@ -98,11 +121,12 @@ class PackedPublisher:
             # python float where a np scalar was traced): derive the spec
             # with an abstract eval of the program at this signature.
             spec = self._spec_by_sig[sig] = self._trace_spec(args)
-        flat = np.asarray(jax.device_get(packed))
+        # device_get already lands a numpy array: one bulk fetch, no
+        # second host copy.
+        flat = jax.device_get(packed)
         outputs: dict[str, np.ndarray] = {}
         offset = 0
-        for key, shape in spec:
-            size = int(np.prod(shape)) if shape else 1
+        for key, shape, size in spec:
             view = flat[offset : offset + size]
             outputs[key] = view.reshape(shape) if shape else view[0]
             offset += size

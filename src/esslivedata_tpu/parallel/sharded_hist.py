@@ -95,13 +95,12 @@ class ShardedHistogrammer:
         self._replicate = lambda x: jax.device_put(
             x, NamedSharding(mesh, P())
         )
-        if self._proj.weights is not None:
-            self._proj.weights = self._replicate(self._proj.weights)
-        self._lut_rep = (
-            self._replicate(jnp.asarray(self._proj.lut_host))
-            if self._has_lut
-            else None
-        )
+        # place_constants replicates the LUT straight from its HOST copy
+        # (one placement, no default-device staging hop) and re-places
+        # the weights; the replicated LUT then rides the jitted step as
+        # an argument (ADR 0105).
+        self._proj.place_constants(self._replicate)
+        self._lut_rep = self._proj.lut if self._has_lut else None
         self._rows_per_bank = n_screen // self._n_bank
         self._n_screen = n_screen
         self._n_toa = self._proj.n_toa
@@ -119,6 +118,12 @@ class ShardedHistogrammer:
         self._state_sharding = NamedSharding(mesh, P("bank", None))
         self._event_sharding = NamedSharding(mesh, P("data"))
         self._scalar_sharding = NamedSharding(mesh, P())
+        # The no-decay step's unit update magnitude, staged once: building
+        # it per step would dispatch a host->device scalar transfer on
+        # every batch (graftlint JGL006).
+        self._unit_scale = jax.device_put(
+            jnp.asarray(1.0, self._dtype), self._scalar_sharding
+        )
 
         lut_specs = (P(),) if self._has_lut else ()  # replicated LUT arg
         shard = partial(
@@ -282,13 +287,15 @@ class ShardedHistogrammer:
             raise ValueError(
                 f"padded event count {n} must divide over data axis {self._n_data}"
             )
-        from ..ops.event_batch import dispatch_safe
+        from ..ops.event_batch import stage_for
 
-        pid = jax.device_put(
-            jnp.asarray(dispatch_safe(pixel_id)), self._event_sharding
+        # One hop host->mesh (stage_for): dispatch_safe would commit the
+        # batch to the DEFAULT device first and pay a second copy on the
+        # resharded placement.
+        return (
+            stage_for(pixel_id, self._event_sharding),
+            stage_for(toa, self._event_sharding),
         )
-        t = jax.device_put(jnp.asarray(dispatch_safe(toa)), self._event_sharding)
-        return pid, t
 
     def step(self, state: HistogramState, pixel_id, toa) -> HistogramState:
         """Accumulate one padded global batch (host or device arrays)."""
@@ -296,8 +303,7 @@ class ShardedHistogrammer:
         lut_args = (self._lut_rep,) if self._has_lut else ()
         if self._decay is None:
             win = self._step(
-                state.window, *lut_args, pid, t,
-                jnp.asarray(1.0, self._dtype),
+                state.window, *lut_args, pid, t, self._unit_scale
             )
             return HistogramState(folded=state.folded, window=win)
         win, scale = self._step_decay(
@@ -326,9 +332,12 @@ class ShardedHistogrammer:
         )
         # Carry the replicated device array over: round-tripping it
         # through numpy would block on a d2h copy and lose the mesh
-        # placement established in __init__.
+        # placement established in __init__. The new LUT is placed from
+        # the host array directly — this is the per-swap live-geometry
+        # path, so the default-device staging hop a jnp.asarray would add
+        # is paid on every swap, not once.
         self._proj.weights = old_weights
-        self._lut_rep = self._replicate(jnp.asarray(new))
+        self._lut_rep = self._replicate(new)
         return True
 
     def clear_window(self, state: HistogramState) -> HistogramState:
@@ -342,11 +351,13 @@ class ShardedHistogrammer:
 
     def normalized(self, hist: jax.Array, monitor_counts) -> jax.Array:
         """hist / global monitor total — the monitor-normalized I(Q)-style
-        output (BASELINE config 4)."""
-        mc = jax.device_put(
-            jnp.asarray(monitor_counts, dtype=self._dtype), self._event_sharding
+        output (BASELINE config 4). One-hop staging (stage_for), as in
+        ``_shard_events``."""
+        from ..ops.event_batch import stage_for
+
+        return self._normalize(
+            hist, stage_for(monitor_counts, self._event_sharding, dtype=self._dtype)
         )
-        return self._normalize(hist, mc)
 
     def read(self, state: HistogramState) -> tuple[np.ndarray, np.ndarray]:
         """Host copies of the (cumulative, window) views — same contract as

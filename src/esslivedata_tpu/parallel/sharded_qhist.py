@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.event_batch import dispatch_safe, sanitize_pixel_id
+from ..ops.event_batch import sanitize_pixel_id, stage_for
 from ..ops.qhistogram import PixelBinMap, QState, table_scatter_delta
 
 __all__ = ["ShardedQHistogrammer"]
@@ -153,7 +153,10 @@ class ShardedQHistogrammer:
             ),
             donate_argnums=(0,),
         )
-        self._replicate = lambda x: jax.device_put(x, NamedSharding(mesh, P()))
+        self._replicated_sharding = NamedSharding(mesh, P())
+        self._replicate = lambda x: jax.device_put(
+            x, self._replicated_sharding
+        )
 
     @property
     def mesh(self) -> Mesh:
@@ -186,18 +189,17 @@ class ShardedQHistogrammer:
         # arrays pass through untouched (already int32/float32, no sync).
         if not isinstance(pixel_id, jax.Array):
             pixel_id = sanitize_pixel_id(np.asarray(pixel_id))
-        pixel_id = self._replicate(
-            jnp.asarray(dispatch_safe(pixel_id), dtype=jnp.int32)
-        )
-        toa = self._replicate(
-            jnp.asarray(dispatch_safe(toa), dtype=jnp.float32)
-        )
+
+        # One hop host->mesh (stage_for): dispatch_safe would commit the
+        # batch to the DEFAULT device and pay a second device->device
+        # copy on the replicated placement.
+        sharding = self._replicated_sharding
         return self._step(
             state,
             self._table,
-            pixel_id,
-            toa,
-            self._replicate(jnp.asarray(monitor_count, dtype=self._dtype)),
+            stage_for(pixel_id, sharding, dtype=jnp.int32),
+            stage_for(toa, sharding, dtype=jnp.float32),
+            stage_for(monitor_count, sharding, dtype=self._dtype),
         )
 
     def swap_table(self, qmap: PixelBinMap) -> None:

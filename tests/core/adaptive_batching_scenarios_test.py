@@ -72,7 +72,7 @@ class Trajectory:
     def direction_changes(self, after: float = 0.0) -> int:
         scales = [s for w, s in self.samples if w >= after]
         changes = 0
-        for a, b, c in zip(scales, scales[1:], scales[2:]):
+        for a, b, c in zip(scales, scales[1:], scales[2:], strict=False):
             if (b - a) * (c - b) < 0:
                 changes += 1
         return changes
@@ -334,7 +334,7 @@ class TestDataGaps:
             clock.advance(0.05)
             batcher.report_processing_time(Duration.from_s(0.05))
         # Batches never overlap and remain ordered across the gap.
-        for (s0, e0), (s1, e1) in zip(emitted, emitted[1:]):
+        for (s0, e0), (s1, e1) in zip(emitted, emitted[1:], strict=False):
             assert e0 <= s1, f"windows overlap: {(s0, e0)} then {(s1, e1)}"
 
 

@@ -92,7 +92,7 @@ class TestGapProgression:
             if out is not None:
                 batches.append(out)
         assert len(batches) >= 4
-        for a, c in zip(batches, batches[1:]):
+        for a, c in zip(batches, batches[1:], strict=False):
             assert a.end.ns <= c.start.ns, "windows overlap"
         seen = [m.value for b_ in batches for m in b_.messages]
         assert len(seen) == len(set(seen))

@@ -95,7 +95,7 @@ class TestConservation:
         for b in batches:
             assert b.start.ns % 1 == 0
             assert b.end > b.start
-        for a, b in zip(batches, batches[1:]):
+        for a, b in zip(batches, batches[1:], strict=False):
             assert a.end <= b.start or batcher_kind == "naive"
 
     @settings(max_examples=100, deadline=None)

@@ -244,6 +244,6 @@ class TestMessagePreservationAcrossResize:
             if p == 80:
                 for _ in range(4):
                     batcher.report_processing_time(Duration.from_s(0.05))
-        for (s0, e0), (s1, e1) in zip(bounds, bounds[1:]):
+        for (s0, e0), (s1, e1) in zip(bounds, bounds[1:], strict=False):
             assert e0 <= s1, f"windows overlap: {(s0, e0)} then {(s1, e1)}"
             assert s0 < e0 and s1 < e1

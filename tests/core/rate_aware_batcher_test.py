@@ -173,7 +173,7 @@ class TestEviction:
         # Monitor goes silent; detector keeps closing batches via timeout
         # (monitor gate blocks slot-closes, HWM advances with det traffic).
         t = t0
-        for i in range(EVICT_AFTER_ABSENT + 6):
+        for _ in range(EVICT_AFTER_ABSENT + 6):
             t += 2 * NS
             b.batch(pulses(DET, t, 14, det_p))
         assert MON not in b.tracked_streams

@@ -111,6 +111,6 @@ class TestStaleness:
 class TestPendingBounds:
     def test_pending_list_bounded(self):
         svc = JobService()
-        for i in range(250):
+        for _ in range(250):
             svc.track_command("s", uuid.uuid4(), "start_job")
         assert len(svc.pending_commands()) <= 100

@@ -30,8 +30,10 @@ def detector(backend):
     proc = backend.spawn_service("detector_data")
     try:
         backend.wait_for_heartbeat(timeout_s=90)
-    except TimeoutError:
-        raise AssertionError(backend.dump_output(proc, "detector"))
+    except TimeoutError as err:
+        raise AssertionError(
+            backend.dump_output(proc, "detector")
+        ) from err
     return proc
 
 
@@ -54,8 +56,10 @@ def dash(backend, detector, tmp_path_factory):
     base = f"http://localhost:{PORT}"
     try:
         wait_for_http(f"{base}/api/state", timeout_s=90)
-    except TimeoutError:
-        raise AssertionError(backend.dump_output(proc, "dashboard"))
+    except TimeoutError as err:
+        raise AssertionError(
+            backend.dump_output(proc, "dashboard")
+        ) from err
     return base, config_dir, proc
 
 
@@ -320,8 +324,10 @@ class TestRoiSpectra:
             for pulse in range(3):
                 backend.produce_events(200 + pulse, t0_ns=t0, seed=53)
             backend.wait_for(lambda: roi_dim() == 2, 60)
-        except (AssertionError, TimeoutError):
+        except (AssertionError, TimeoutError) as err:
             backend.kill(dash2)
-            raise AssertionError(backend.dump_output(dash2, "dashboard"))
+            raise AssertionError(
+                backend.dump_output(dash2, "dashboard")
+            ) from err
         finally:
             backend.kill(dash2)

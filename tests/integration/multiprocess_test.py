@@ -36,8 +36,10 @@ def detector(backend):
     proc = backend.spawn_service("detector_data")
     try:
         backend.wait_for_heartbeat(timeout_s=90)
-    except TimeoutError:
-        raise AssertionError(backend.dump_output(proc, "detector"))
+    except TimeoutError as err:
+        raise AssertionError(
+            backend.dump_output(proc, "detector")
+        ) from err
     return proc
 
 
@@ -95,9 +97,11 @@ class TestEndToEndReduction:
 
             keys = backend.wait_for(has_keys, 30)
             assert keys, "reduced output never reached the dashboard"
-        except (AssertionError, TimeoutError):
+        except (AssertionError, TimeoutError) as err:
             backend.kill(dash)
-            raise AssertionError(backend.dump_output(dash, "dashboard"))
+            raise AssertionError(
+                backend.dump_output(dash, "dashboard")
+            ) from err
         finally:
             backend.kill(dash)
 
@@ -162,9 +166,11 @@ class TestEndToEndReduction:
                 )
             finally:
                 backend.kill(replacement)
-        except (AssertionError, TimeoutError):
+        except (AssertionError, TimeoutError) as err:
             backend.kill(dash)
-            raise AssertionError(backend.dump_output(dash, "dashboard"))
+            raise AssertionError(
+                backend.dump_output(dash, "dashboard")
+            ) from err
         finally:
             backend.kill(dash)
 
@@ -239,9 +245,11 @@ class TestDashboardScenarios:
 
             iso.wait_for(expired, 30)
             assert not http_json(f"{base}/api/state")["pending_commands"]
-        except (AssertionError, TimeoutError):
+        except (AssertionError, TimeoutError) as err:
             iso.kill(dash)
-            raise AssertionError(iso.dump_output(dash, "dashboard"))
+            raise AssertionError(
+                iso.dump_output(dash, "dashboard")
+            ) from err
         finally:
             iso.shutdown()
 
@@ -276,8 +284,10 @@ class TestDashboardScenarios:
                 assert grid["cells"][0]["params"] == {"scale": "log"}
             finally:
                 iso.kill(dash2)
-        except (AssertionError, TimeoutError):
-            raise AssertionError(iso.dump_output(dash, "dashboard"))
+        except (AssertionError, TimeoutError) as err:
+            raise AssertionError(
+                iso.dump_output(dash, "dashboard")
+            ) from err
         finally:
             iso.shutdown()
 

@@ -101,12 +101,12 @@ class TestPowderReduction:
                 f"{base}/plot/{keys[0]['id']}.png", timeout=30
             ).read()
             assert png[:4] == b"\x89PNG"
-        except (AssertionError, TimeoutError):
+        except (AssertionError, TimeoutError) as err:
             backend.kill(dash)
             raise AssertionError(
                 backend.dump_output(reduction, "reduction")
                 + backend.dump_output(dash, "dashboard")
-            )
+            ) from err
         finally:
             backend.kill(dash)
             backend.kill(reduction)

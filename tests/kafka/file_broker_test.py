@@ -63,7 +63,7 @@ def test_partial_frame_not_surfaced(broker):
 
 def test_round_robin_prevents_topic_starvation(broker):
     prod = FileBrokerProducer(broker)
-    for i in range(300):
+    for _ in range(300):
         prod.produce("alpha", b"bulk")
     prod.produce("beta", b"control")
     cons = FileBrokerConsumer(broker)

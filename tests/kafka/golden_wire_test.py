@@ -607,7 +607,7 @@ class TestWalkerDecoderCrossValidation:
             walked = walk_root(buf, schemas["da00"])
             decoded = wire.decode_da00(buf)
             assert len(walked["data"]) == len(decoded.variables)
-            for wv, dv in zip(walked["data"], decoded.variables):
+            for wv, dv in zip(walked["data"], decoded.variables, strict=True):
                 assert wv["name"] == dv.name
                 assert wv["unit"] == dv.unit  # "" is written, not omitted
                 assert (wv["label"] or "") == dv.label

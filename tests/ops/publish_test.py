@@ -56,6 +56,22 @@ class TestPackedPublisher:
         np.testing.assert_allclose(out_small2["total"], 4.0)
         np.testing.assert_allclose(out_big["total"], 60.0)
 
+    def test_abstract_spec_matches_pack_order_for_unsorted_keys(self):
+        # The eval_shape fallback rebuilds dicts through pytree
+        # flattening, which SORTS keys; the pack must use the same
+        # canonical order or a fallback-derived spec unpacks wrong data
+        # under wrong keys for programs whose outputs are not declared
+        # alphabetically.
+        def program(state):
+            return {"zz": state * 2.0, "aa": jnp.zeros(3) + 7.0}, state
+
+        pub = PackedPublisher(program, donate=())
+        pub(jnp.ones((2,)))
+        pub._spec_by_sig.clear()  # forge the cache-hit-without-spec path
+        outputs, _ = pub(jnp.ones((2,)))
+        np.testing.assert_allclose(outputs["aa"], [7.0, 7.0, 7.0])
+        np.testing.assert_allclose(outputs["zz"], [2.0, 2.0])
+
     def test_unseen_host_signature_derives_spec_abstractly(self):
         # A signature never dispatched through __call__ has no recorded
         # spec; the publisher must derive one (eval_shape) rather than

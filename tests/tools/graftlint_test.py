@@ -1,0 +1,417 @@
+"""graftlint fixture suite: one minimal positive and one minimal
+negative snippet per JGL rule, suppression-comment behavior, and a
+tree-clean guard that keeps ``make lint`` green by construction.
+
+The snippets are the rules' contract: if a rule's heuristic is tuned,
+these pin what must still fire and what must stay quiet.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+# tools.graftlint resolves via pythonpath = ["src", "."] in pyproject.
+from tools.graftlint import RULES, run_paths, run_source
+from tools.graftlint.cli import main as cli_main
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+# -- per-rule fixtures -----------------------------------------------------
+# fmt: off
+POSITIVE = {
+    "JGL001": '''
+import jax
+import numpy as np
+
+@jax.jit
+def step(state, batch):
+    return state + np.asarray(batch)
+''',
+    "JGL002": '''
+import jax
+
+@jax.jit
+def fold(events):
+    total = 0
+    for e in events:
+        total += e
+    return total
+''',
+    "JGL003": '''
+import jax
+
+class HistogramState:
+    pass
+
+def _step_impl(state, flat):
+    return HistogramState()
+
+class Hist:
+    def __init__(self):
+        self._step = jax.jit(_step_impl)
+''',
+    "JGL004": '''
+import threading
+
+class Counter:
+    def __init__(self):
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def on_message(self):
+        self.count += 1
+
+    def snapshot(self):
+        return self.count
+''',
+    "JGL005": '''
+import time
+
+async def pump():
+    time.sleep(0.1)
+''',
+    "JGL006": '''
+import jax.numpy as jnp
+
+class Hist:
+    def step(self, state):
+        return self._step(state, jnp.asarray(1.0, self._dtype))
+''',
+    "JGL007": '''
+def process(msgs):
+    for m in msgs:
+        try:
+            decode(m)
+        except Exception:
+            pass
+''',
+    "JGL008": '''
+import jax
+from functools import partial
+
+@jax.jit
+def step(state, bins):
+    return state
+
+stepper = partial(step, bins=[0.0, 1.0])
+''',
+}
+
+NEGATIVE = {
+    # np on a non-traced (construction-time) value outside the jit region.
+    "JGL001": '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+class Hist:
+    def __init__(self, edges):
+        self._edges = np.asarray(edges)
+        self._step = jax.jit(self._step_impl, donate_argnums=(0,))
+
+    def _step_impl(self, state, batch):
+        return state + jnp.sum(batch)
+''',
+    # Loop over a static literal unrolls a known, fixed amount.
+    "JGL002": '''
+import jax
+
+@jax.jit
+def fold(state):
+    for axis in (0, 1):
+        state = state.sum(axis=0)
+    return state
+''',
+    # Donated update and a non-donated read-only views program.
+    "JGL003": '''
+import jax
+
+class HistogramState:
+    pass
+
+class Hist:
+    def __init__(self):
+        self._step = jax.jit(self._step_impl, donate_argnums=(0,))
+        self._views = jax.jit(self._views_impl)
+
+    def _step_impl(self, state, flat):
+        return HistogramState()
+
+    def _views_impl(self, state):
+        return (state, state)
+''',
+    # The same read-modify-write, but under the lock.
+    "JGL004": '''
+import threading
+
+class Counter:
+    def __init__(self):
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def on_message(self):
+        with self._lock:
+            self.count += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self.count
+''',
+    "JGL005": '''
+import asyncio
+
+async def pump():
+    await asyncio.sleep(0.1)
+''',
+    # Constant staged once at construction, not per step.
+    "JGL006": '''
+import jax.numpy as jnp
+
+class Hist:
+    def __init__(self):
+        self._one = jnp.asarray(1.0)
+
+    def step(self, state):
+        return self._step(state, self._one)
+''',
+    # Narrow type + logged broad handler are both fine.
+    "JGL007": '''
+import logging
+
+logger = logging.getLogger(__name__)
+
+def process(msgs):
+    for m in msgs:
+        try:
+            decode(m)
+        except ValueError:
+            pass
+        except Exception:
+            logger.warning("poison message", exc_info=True)
+''',
+    # Hashable (tuple) static arg, and mutable partial of a plain function.
+    "JGL008": '''
+import jax
+from functools import partial
+
+@jax.jit
+def step(state, bins):
+    return state
+
+stepper = partial(step, bins=(0.0, 1.0))
+
+def host_helper(xs):
+    return xs
+
+helper = partial(host_helper, [1, 2])
+''',
+}
+# fmt: on
+
+
+@pytest.mark.parametrize("rule_id", sorted(POSITIVE))
+def test_positive_fires(rule_id):
+    findings = run_source(POSITIVE[rule_id], path="pos.py")
+    assert rule_id in {f.rule for f in findings}, (
+        f"{rule_id} did not fire on its positive fixture: {findings}"
+    )
+
+
+@pytest.mark.parametrize("rule_id", sorted(NEGATIVE))
+def test_negative_quiet(rule_id):
+    findings = [
+        f
+        for f in run_source(NEGATIVE[rule_id], path="neg.py")
+        if f.rule == rule_id
+    ]
+    assert not findings, f"{rule_id} false-positive: {findings}"
+
+
+def test_every_rule_has_fixtures():
+    assert set(POSITIVE) == set(RULES)
+    assert set(NEGATIVE) == set(RULES)
+
+
+def test_findings_carry_location_and_render():
+    findings = run_source(POSITIVE["JGL007"], path="svc.py")
+    f = next(f for f in findings if f.rule == "JGL007")
+    assert f.path == "svc.py" and f.line > 0
+    assert f.render().startswith("svc.py:")
+    assert "JGL007" in f.render()
+
+
+# -- suppressions ----------------------------------------------------------
+
+def test_same_line_suppression():
+    src = POSITIVE["JGL007"].replace(
+        "except Exception:", "except Exception:  # graftlint: disable=JGL007"
+    )
+    assert not run_source(src)
+
+
+def test_suppression_with_trailing_justification_prose():
+    # The documented style puts the justification beside the disable;
+    # prose after the id list must not break the match.
+    src = POSITIVE["JGL007"].replace(
+        "except Exception:",
+        "except Exception:  # graftlint: disable=JGL007 best-effort wakeup",
+    )
+    assert not run_source(src)
+
+
+def test_preceding_line_suppression():
+    src = '''
+try:
+    x = 1
+# graftlint: disable=JGL007
+except Exception:
+    pass
+'''
+    assert not run_source(src)
+
+
+def test_file_level_suppression():
+    src = "# graftlint: disable-file=JGL007\n" + POSITIVE["JGL007"]
+    assert not run_source(src)
+
+
+def test_suppression_is_rule_specific():
+    # Suppressing an unrelated rule must not silence the finding.
+    src = POSITIVE["JGL007"].replace(
+        "except Exception:", "except Exception:  # graftlint: disable=JGL001"
+    )
+    assert any(f.rule == "JGL007" for f in run_source(src))
+
+
+def test_disable_all_wildcard():
+    src = "# graftlint: disable-file=all\n" + POSITIVE["JGL001"]
+    assert not run_source(src)
+
+
+def test_directive_inside_string_literal_has_no_effect():
+    # Documentation ABOUT the directive (docstrings, string literals)
+    # must not suppress anything — only real comment tokens count.
+    src = '''
+"""Intentional swallows carry a `# graftlint: disable-file=JGL007` marker."""
+
+try:
+    x = 1
+except Exception:
+    pass
+'''
+    assert any(f.rule == "JGL007" for f in run_source(src))
+
+
+def test_null_byte_file_reported_not_crashing(tmp_path):
+    bad = tmp_path / "nul.py"
+    bad.write_bytes(b"x = 1\x00\n")
+    good = tmp_path / "ok_hazard.py"
+    good.write_text(POSITIVE["JGL007"])
+    findings, errors = run_paths([str(tmp_path)])
+    # The poisoned file lands in the error channel; the rest still lints.
+    assert len(errors) == 1 and "nul.py" in errors[0]
+    assert any(f.rule == "JGL007" for f in findings)
+
+
+# -- engine plumbing -------------------------------------------------------
+
+def test_select_filters_rules():
+    both = POSITIVE["JGL007"] + "\nimport time\nasync def f():\n    time.sleep(1)\n"
+    only = run_source(both, select=frozenset({"JGL005"}))
+    assert {f.rule for f in only} == {"JGL005"}
+
+
+def test_root_under_dotted_directory_is_still_linted(tmp_path):
+    # The hidden-dir filter must apply below the given root only: a
+    # checkout living under a dotted ancestor (CI caches, pre-commit
+    # clones) must not silently lint nothing.
+    root = tmp_path / ".cache" / "proj"
+    root.mkdir(parents=True)
+    (root / "dirty.py").write_text(POSITIVE["JGL007"])
+    (root / ".venv").mkdir()
+    (root / ".venv" / "vendored.py").write_text(POSITIVE["JGL007"])
+    findings, errors = run_paths([str(root)])
+    assert not errors
+    assert [Path(f.path).name for f in findings] == ["dirty.py"]
+
+
+def test_nonexistent_path_fails_the_gate(tmp_path):
+    # A typo'd path in CI/Makefile must not become a green no-op.
+    findings, errors = run_paths([str(tmp_path / "no_such_tree")])
+    assert not findings
+    assert len(errors) == 1 and "no such file" in errors[0]
+    assert cli_main([str(tmp_path / "no_such_tree")]) == 1
+
+
+def test_existing_non_python_path_fails_the_gate(tmp_path):
+    # Same invariant for an existing-but-unlintable argument.
+    readme = tmp_path / "README.md"
+    readme.write_text("# not python\n")
+    findings, errors = run_paths([str(readme)])
+    assert not findings
+    assert len(errors) == 1 and "not a directory or .py file" in errors[0]
+    assert cli_main([str(readme)]) == 1
+
+
+def test_syntax_error_reported_not_raised(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    findings, errors = run_paths([str(tmp_path)])
+    assert not findings
+    assert len(errors) == 1 and "bad.py" in errors[0]
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(POSITIVE["JGL007"])
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert cli_main([str(clean)]) == 0
+    assert cli_main([str(dirty)]) == 1
+    out = capsys.readouterr().out
+    assert "JGL007" in out and "dirty.py" in out
+
+
+def test_cli_list_rules(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in RULES:
+        assert rule_id in out
+
+
+def test_jit_closure_reaches_helpers():
+    # A helper called from a jit-wrapped method is traced: host syncs
+    # inside it must be flagged even though it carries no decorator.
+    src = '''
+import jax
+import numpy as np
+
+class H:
+    def __init__(self):
+        self._step = jax.jit(self._step_impl, donate_argnums=(0,))
+
+    def _step_impl(self, state, x):
+        return self._helper(state, x)
+
+    def _helper(self, state, x):
+        return state + np.asarray(x)
+'''
+    assert any(f.rule == "JGL001" for f in run_source(src))
+
+
+# -- the acceptance gate ---------------------------------------------------
+
+def test_src_tree_is_clean():
+    """`python -m tools.graftlint src/esslivedata_tpu/` must stay at zero
+    unsuppressed findings (the make-lint gate, ISSUE 1 acceptance)."""
+    findings, errors = run_paths([str(REPO / "src" / "esslivedata_tpu")])
+    assert not errors, errors
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_tools_tree_is_clean():
+    findings, errors = run_paths([str(REPO / "tools")])
+    assert not errors, errors
+    assert not findings, "\n".join(f.render() for f in findings)

@@ -1,0 +1,5 @@
+"""In-repo developer tooling (not shipped in the wheel).
+
+``tools.graftlint`` is the JAX-hazard / concurrency static-analysis pass
+run by ``make lint`` (see docs/graftlint.md).
+"""

@@ -1,0 +1,83 @@
+"""graftlint — JAX-hazard and concurrency static analysis for the
+streaming hot path (docs/graftlint.md).
+
+Programmatic API::
+
+    from tools.graftlint import run_source, run_paths
+    findings = run_source(code, path="snippet.py")
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from . import rules  # noqa: F401  (registers all rules)
+from .context import FileContext
+from .findings import Finding
+from .registry import RULES
+from .suppress import Suppressions
+
+__all__ = ["Finding", "RULES", "run_paths", "run_source"]
+
+
+def run_source(
+    source: str,
+    *,
+    path: str = "<string>",
+    select: frozenset[str] | None = None,
+) -> list[Finding]:
+    """Lint one source string; returns unsuppressed findings, sorted."""
+    ctx = FileContext(path, source)
+    findings: set[Finding] = set()
+    for rule_id, rule in RULES.items():
+        if select is not None and rule_id not in select:
+            continue
+        findings.update(rule.check(ctx))
+    return sorted(Suppressions(source).filter(sorted(findings)))
+
+
+def iter_python_files(paths: list[str]):
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            # Hidden-dir filter applies BELOW the given root only: a
+            # checkout that itself lives under a dotted directory (CI
+            # caches, pre-commit clones) must still be linted, not
+            # silently skipped.
+            yield from sorted(
+                f
+                for f in p.rglob("*.py")
+                if not any(
+                    part.startswith(".") for part in f.relative_to(p).parts
+                )
+            )
+        elif p.suffix == ".py":
+            yield p
+
+
+def run_paths(
+    paths: list[str], *, select: frozenset[str] | None = None
+) -> tuple[list[Finding], list[str]]:
+    """Lint files/trees; returns (findings, path/parse errors)."""
+    findings: list[Finding] = []
+    errors: list[str] = []
+    # A bad path argument must fail the gate, not turn it into a
+    # permanent green no-op that checks nothing: nonexistent paths and
+    # existing-but-unlintable arguments (non-.py files) both error.
+    for raw in paths:
+        p = Path(raw)
+        if not p.exists():
+            errors.append(f"{raw}: no such file or directory")
+        elif not p.is_dir() and p.suffix != ".py":
+            errors.append(f"{raw}: not a directory or .py file")
+    for file in iter_python_files(paths):
+        try:
+            source = file.read_text(encoding="utf-8")
+            findings.extend(
+                run_source(source, path=str(file), select=select)
+            )
+        except (OSError, SyntaxError, ValueError) as exc:
+            # ValueError: ast.parse on null bytes (py <= 3.11) — one
+            # pathological file must not abort the whole run.
+            errors.append(f"{file}: {exc}")
+    return findings, errors

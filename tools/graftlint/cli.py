@@ -1,0 +1,59 @@
+"""``python -m tools.graftlint [paths...]`` — exits nonzero on findings."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import RULES, run_paths
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint",
+        description=(
+            "JAX-hazard and concurrency static analysis for the "
+            "streaming hot path (rules: docs/graftlint.md)"
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["src/"], help="files or trees to lint"
+    )
+    parser.add_argument(
+        "--select",
+        default=None,
+        metavar="JGL001,JGL004",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print rule ids and exit"
+    )
+    parser.add_argument(
+        "-q", "--quiet", action="store_true", help="suppress the summary line"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id, rule in sorted(RULES.items()):
+            print(f"{rule_id}  {rule.summary}")
+        return 0
+
+    select = (
+        frozenset(s.strip() for s in args.select.split(",") if s.strip())
+        if args.select
+        else None
+    )
+    if select is not None and (unknown := select - set(RULES)):
+        parser.error(f"unknown rule ids: {sorted(unknown)}")
+
+    findings, errors = run_paths(args.paths, select=select)
+    for finding in findings:
+        print(finding.render())
+    for error in errors:
+        print(f"graftlint: cannot analyze {error}", file=sys.stderr)
+    if not args.quiet:
+        print(
+            f"graftlint: {len(findings)} finding(s)"
+            + (f", {len(errors)} file error(s)" if errors else "")
+        )
+    return 1 if findings or errors else 0

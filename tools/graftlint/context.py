@@ -1,0 +1,261 @@
+"""Per-file analysis context shared by all rules.
+
+Everything here is a *static over-approximation* tuned against this
+codebase (see docs/graftlint.md "Precision"): jit regions are discovered
+from decorators AND from ``jax.jit(fn, ...)`` wrapping sites (the
+dominant idiom here: ``self._step = jax.jit(self._step_impl, ...)``),
+then closed transitively over the intra-file call graph — a helper
+called from a jitted function is traced, so host-sync rules must apply
+to it while eager-dispatch rules must not.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+
+#: Callables whose first argument becomes a traced/staged program.
+JIT_WRAPPERS = frozenset(
+    {
+        "jax.jit",
+        "jax.pjit",
+        "jax.experimental.pjit.pjit",
+        "jax.shard_map",
+        "jax.experimental.shard_map.shard_map",
+    }
+)
+
+FuncNode = ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda
+
+
+class FileContext:
+    def __init__(self, path: str, source: str) -> None:
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source)
+        self._parent: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parent[child] = node
+        self._names: dict[str, str] = {}
+        self._collect_imports()
+        #: name -> function/method defs with that name (methods flattened:
+        #: cross-class calls like ``self._proj.flat_and_weights`` resolve
+        #: by attribute name alone, conservatively to every same-named def).
+        self.defs_by_name: dict[str, list[ast.AST]] = defaultdict(list)
+        self.functions: list[FuncNode] = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs_by_name[node.name].append(node)
+                self.functions.append(node)
+            elif isinstance(node, ast.Lambda):
+                self.functions.append(node)
+        self._partial_wrappers = self._collect_partial_wrappers()
+        self.jit_calls: list[ast.Call] = []
+        self.jit_regions: set[ast.AST] = set()
+        self._collect_jit_regions()
+        self._close_over_calls()
+
+    # -- imports / name resolution ----------------------------------------
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self._names[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name if alias.asname else alias.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    self._names[alias.asname or alias.name] = (
+                        f"{node.module}.{alias.name}"
+                    )
+
+    def qualname(self, node: ast.AST) -> str | None:
+        """Dotted name with import aliases resolved (``np.asarray`` ->
+        ``numpy.asarray``); None for non-name expressions."""
+        if isinstance(node, ast.Name):
+            return self._names.get(node.id, node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.qualname(node.value)
+            return None if base is None else f"{base}.{node.attr}"
+        return None
+
+    # -- jit region discovery ---------------------------------------------
+    def _collect_partial_wrappers(self) -> frozenset[str]:
+        """Local names bound to ``partial(jax.jit, ...)``-style wrappers
+        (the shard_map staging idiom in parallel/)."""
+        out = set()
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and self.qualname(node.value.func) == "functools.partial"
+                and node.value.args
+                and self.qualname(node.value.args[0]) in JIT_WRAPPERS
+            ):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        out.add(target.id)
+        return frozenset(out)
+
+    def is_jit_wrapper(self, func: ast.AST) -> bool:
+        qual = self.qualname(func)
+        if qual in JIT_WRAPPERS:
+            return True
+        return isinstance(func, ast.Name) and func.id in self._partial_wrappers
+
+    def _seed(self, target: ast.AST) -> None:
+        if isinstance(target, ast.Lambda):
+            self.jit_regions.add(target)
+        elif isinstance(target, ast.Name):
+            self.jit_regions.update(self.defs_by_name.get(target.id, ()))
+        elif isinstance(target, ast.Attribute):
+            self.jit_regions.update(self.defs_by_name.get(target.attr, ()))
+        elif isinstance(target, ast.Call):
+            # jax.jit(partial(f, ...)) — seed through one partial layer.
+            if self.qualname(target.func) == "functools.partial" and target.args:
+                self._seed(target.args[0])
+
+    def _collect_jit_regions(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self.is_jit_wrapper(dec):
+                        self.jit_regions.add(node)
+                    elif isinstance(dec, ast.Call) and (
+                        self.is_jit_wrapper(dec.func)
+                        or (
+                            self.qualname(dec.func) == "functools.partial"
+                            and dec.args
+                            and self.qualname(dec.args[0]) in JIT_WRAPPERS
+                        )
+                    ):
+                        self.jit_regions.add(node)
+            elif isinstance(node, ast.Call) and self.is_jit_wrapper(node.func):
+                self.jit_calls.append(node)
+                if node.args:
+                    self._seed(node.args[0])
+
+    def _close_over_calls(self) -> None:
+        """Propagate jit membership over the intra-file call graph: a
+        helper invoked (by name) from a traced function is itself traced."""
+        edges: dict[ast.AST, set[ast.AST]] = defaultdict(set)
+        for fn in self.functions:
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                name = None
+                if isinstance(call.func, ast.Name):
+                    name = call.func.id
+                elif isinstance(call.func, ast.Attribute):
+                    name = call.func.attr
+                if name:
+                    for target in self.defs_by_name.get(name, ()):
+                        if target is not fn:
+                            edges[fn].add(target)
+        frontier = list(self.jit_regions)
+        while frontier:
+            fn = frontier.pop()
+            for target in edges.get(fn, ()):
+                if target not in self.jit_regions:
+                    self.jit_regions.add(target)
+                    frontier.append(target)
+
+    # -- generic helpers ---------------------------------------------------
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return self._parent.get(node)
+
+    def ancestors(self, node: ast.AST):
+        cur = self._parent.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parent.get(cur)
+
+    def enclosing_function(self, node: ast.AST) -> FuncNode | None:
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return anc
+        return None
+
+    def enclosing_class(self, node: ast.AST) -> ast.ClassDef | None:
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                return anc
+        return None
+
+    @staticmethod
+    def params(fn: FuncNode) -> frozenset[str]:
+        args = fn.args
+        names = [
+            a.arg
+            for a in (*args.posonlyargs, *args.args, *args.kwonlyargs)
+        ]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        if args.kwarg:
+            names.append(args.kwarg.arg)
+        return frozenset(n for n in names if n not in ("self", "cls"))
+
+    def mentions_any(self, node: ast.AST, names: frozenset[str]) -> bool:
+        return any(
+            isinstance(sub, ast.Name) and sub.id in names
+            for sub in ast.walk(node)
+        )
+
+    @staticmethod
+    def walk_shallow(fn: ast.AST):
+        """Walk ``fn``'s body without descending into nested callables
+        (their execution context differs from the enclosing one)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                stack.extend(ast.iter_child_nodes(node))
+
+    # -- concurrency helpers -----------------------------------------------
+    @staticmethod
+    def _lockish(expr: ast.AST) -> bool:
+        for sub in ast.walk(expr):
+            name = None
+            if isinstance(sub, ast.Name):
+                name = sub.id
+            elif isinstance(sub, ast.Attribute):
+                name = sub.attr
+            if name is not None and (
+                "lock" in name.lower() or "mutex" in name.lower()
+            ):
+                return True
+        return False
+
+    def under_lock(self, node: ast.AST) -> bool:
+        """True when ``node`` sits lexically inside a ``with <lock>:``
+        block, or its enclosing function calls ``.acquire()`` anywhere
+        (the manual-protocol escape hatch — coarse, documented)."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.With) and any(
+                self._lockish(item.context_expr) for item in anc.items
+            ):
+                return True
+        fn = self.enclosing_function(node)
+        if fn is not None:
+            for sub in ast.walk(fn):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "acquire"
+                ):
+                    return True
+        return False
+
+    @property
+    def is_threaded_module(self) -> bool:
+        """Heuristic for JGL004 scope: the module imports ``threading``
+        (spawns or synchronizes threads itself) — single-threaded modules
+        have no data races to find."""
+        return any(
+            qual == "threading" or qual.startswith("threading.")
+            for qual in self._names.values()
+        )

@@ -1,0 +1,23 @@
+"""The finding record every rule emits."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation at one source location.
+
+    Ordered (path, line, rule) so reports are stable across runs and the
+    suppression layer can dedupe rules that flag the same node twice via
+    different traversal paths.
+    """
+
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"

@@ -1,0 +1,40 @@
+"""Rule registry.
+
+A rule is a function ``check(ctx: FileContext) -> Iterable[Finding]``
+registered under a stable ``JGLxxx`` id. Registration order is the
+report order for same-line findings, so register in id order.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable, Iterable
+from dataclasses import dataclass
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from .context import FileContext
+    from .findings import Finding
+
+Check = Callable[["FileContext"], Iterable["Finding"]]
+
+
+@dataclass(frozen=True)
+class Rule:
+    rule_id: str
+    summary: str
+    check: Check
+
+
+RULES: dict[str, Rule] = {}
+
+
+def rule(rule_id: str, summary: str) -> Callable[[Check], Check]:
+    """Register ``check`` under ``rule_id``; duplicate ids are a bug."""
+
+    def register(check: Check) -> Check:
+        if rule_id in RULES:
+            raise ValueError(f"duplicate rule id {rule_id}")
+        RULES[rule_id] = Rule(rule_id=rule_id, summary=summary, check=check)
+        return check
+
+    return register

@@ -1,0 +1,3 @@
+"""Importing this package registers every rule with the registry."""
+
+from . import concurrency_rules, jax_rules, robustness_rules  # noqa: F401

@@ -1,0 +1,141 @@
+"""Thread-safety hazards: JGL004 (unlocked shared mutation) and JGL005
+(blocking calls in async bodies).
+
+JGL004 is a lightweight race detector scoped to modules that import
+``threading`` (the Kafka consume thread / service worker split is this
+codebase's thread boundary): it flags read-modify-write updates
+(``self.x += 1``, writes to ``global`` names) reachable from more than
+one method when the write is not lexically under a ``with <lock>:``
+block. Plain stores (``self._broken = True``) are not flagged — a GIL
+store is atomic; it is the lost-update pattern that corrupts counters.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+
+from ..context import FileContext
+from ..findings import Finding
+from ..registry import rule
+
+#: Call names that block the event loop when not awaited.
+_BLOCKING_ATTRS = frozenset({"poll", "consume"})
+
+
+@rule("JGL004", "unlocked shared-state mutation in a threaded module")
+def unlocked_shared_mutation(ctx: FileContext):
+    if not ctx.is_threaded_module:
+        return
+
+    # Writes to module-level names declared `global` inside functions.
+    for fn in ctx.functions:
+        if isinstance(fn, ast.Lambda):
+            continue
+        global_names: set[str] = set()
+        for node in ctx.walk_shallow(fn):
+            if isinstance(node, ast.Global):
+                global_names.update(node.names)
+        if not global_names:
+            continue
+        for node in ctx.walk_shallow(fn):
+            targets: list[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            for target in targets:
+                if (
+                    isinstance(target, ast.Name)
+                    and target.id in global_names
+                    and not ctx.under_lock(node)
+                ):
+                    yield Finding(
+                        ctx.path,
+                        node.lineno,
+                        "JGL004",
+                        f"write to module-global '{target.id}' in "
+                        f"'{fn.name}' without holding a lock, in a "
+                        "module that runs threads; guard it or make it "
+                        "thread-local",
+                    )
+
+    # self.<attr> read-modify-write shared across methods of one class.
+    for cls in (
+        n for n in ast.walk(ctx.tree) if isinstance(n, ast.ClassDef)
+    ):
+        access: dict[str, set[str]] = defaultdict(set)
+        methods = [
+            n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for method in methods:
+            for node in ast.walk(method):
+                if (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                ):
+                    access[node.attr].add(method.name)
+        for method in methods:
+            if method.name == "__init__":
+                continue
+            for node in ast.walk(method):
+                if not isinstance(node, ast.AugAssign):
+                    continue
+                target = node.target
+                if not (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    continue
+                sharers = access[target.attr] - {"__init__"}
+                if len(sharers) < 2 or ctx.under_lock(node):
+                    continue
+                yield Finding(
+                    ctx.path,
+                    node.lineno,
+                    "JGL004",
+                    f"read-modify-write of self.{target.attr} in "
+                    f"'{cls.name}.{method.name}' without holding a "
+                    "lock; the attribute is also touched by "
+                    f"{sorted(sharers - {method.name}) or '[other threads]'}"
+                    " — a concurrent update loses increments",
+                )
+
+
+@rule("JGL005", "blocking call inside an async function body")
+def blocking_in_async(ctx: FileContext):
+    for fn in (
+        n for n in ast.walk(ctx.tree) if isinstance(n, ast.AsyncFunctionDef)
+    ):
+        for node in ctx.walk_shallow(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            qual = ctx.qualname(node.func)
+            awaited = isinstance(ctx.parent(node), ast.Await)
+            if qual == "time.sleep":
+                yield Finding(
+                    ctx.path,
+                    node.lineno,
+                    "JGL005",
+                    f"time.sleep() inside 'async def {fn.name}' stalls "
+                    "the whole event loop (every dashboard session, not "
+                    "one); use 'await asyncio.sleep(...)'",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BLOCKING_ATTRS
+                and not awaited
+            ):
+                yield Finding(
+                    ctx.path,
+                    node.lineno,
+                    "JGL005",
+                    f"sync '.{node.func.attr}()' inside 'async def "
+                    f"{fn.name}' blocks the event loop on broker I/O; "
+                    "run it in an executor (loop.run_in_executor) or "
+                    "use the async client",
+                )

@@ -1,0 +1,291 @@
+"""JAX jit-boundary hazards: JGL001/002/003/006/008.
+
+All of these erase TPU throughput without failing a test — host syncs
+serialize the pipeline behind a device round trip, retraces recompile
+the hot kernel mid-stream, a missing donation doubles rolling-state HBM
+traffic, and per-scalar ``jnp`` dispatch pays a device transfer per
+event batch. Rationale and bad/good pairs: docs/graftlint.md.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..context import FileContext
+from ..findings import Finding
+from ..registry import rule
+
+#: Calls that force a device->host sync (or host compute on a traced
+#: value) when they appear inside a traced region.
+_HOST_SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+_HOST_SYNC_BUILTINS = frozenset({"float", "int", "bool"})
+
+#: First-parameter names that mark a jitted program as a rolling-state
+#: update (the donate_argnums audience).
+_STATE_PARAMS = frozenset({"state", "hist", "carry", "window", "win", "acc"})
+
+
+def _is_constant(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(
+        node.op, (ast.USub, ast.UAdd)
+    ):
+        return _is_constant(node.operand)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_constant(e) for e in node.elts)
+    return False
+
+
+def _jit_label(ctx: FileContext, fn) -> str:
+    name = getattr(fn, "name", "<lambda>")
+    return f"in jit-traced function '{name}'"
+
+
+@rule("JGL001", "host-sync call inside a jit-traced region")
+def host_sync_in_jit(ctx: FileContext):
+    for fn in ctx.jit_regions:
+        params = ctx.params(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            qual = ctx.qualname(node.func)
+            hit = None
+            if qual == "jax.device_get":
+                # Never legitimate under trace, traced operand or not.
+                hit = "jax.device_get"
+            elif qual is not None and qual.startswith("numpy.") and any(
+                ctx.mentions_any(arg, params) for arg in node.args
+            ):
+                hit = qual.replace("numpy.", "np.", 1)
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HOST_SYNC_METHODS
+                and ctx.mentions_any(node.func.value, params)
+            ):
+                hit = f".{node.func.attr}()"
+            elif (
+                isinstance(node.func, ast.Name)
+                and node.func.id in _HOST_SYNC_BUILTINS
+                and node.func.id not in ctx._names
+                and len(node.args) == 1
+                and not _is_constant(node.args[0])
+                and ctx.mentions_any(node.args[0], params)
+            ):
+                hit = f"{node.func.id}()"
+            if hit:
+                yield Finding(
+                    ctx.path,
+                    node.lineno,
+                    "JGL001",
+                    f"{hit} on a traced value {_jit_label(ctx, fn)} forces "
+                    "a host round trip per dispatch (or a trace-time "
+                    "ConcretizationError); keep the value on device or "
+                    "hoist the conversion outside the jit boundary",
+                )
+
+
+@rule("JGL002", "Python loop over traced values inside a jit region")
+def python_loop_in_jit(ctx: FileContext):
+    for fn in ctx.jit_regions:
+        if isinstance(fn, ast.Lambda):
+            continue
+        params = ctx.params(fn)
+        for node in ctx.walk_shallow(fn):
+            if isinstance(node, ast.For) and ctx.mentions_any(
+                node.iter, params
+            ):
+                yield Finding(
+                    ctx.path,
+                    node.lineno,
+                    "JGL002",
+                    f"Python 'for' over argument-derived data "
+                    f"{_jit_label(ctx, fn)} unrolls at trace time and "
+                    "retraces when lengths change; use jax.lax.scan / "
+                    "fori_loop or vectorize",
+                )
+            elif isinstance(node, ast.While) and ctx.mentions_any(
+                node.test, params
+            ):
+                yield Finding(
+                    ctx.path,
+                    node.lineno,
+                    "JGL002",
+                    f"Python 'while' conditioned on an argument "
+                    f"{_jit_label(ctx, fn)} cannot trace (or unrolls "
+                    "unboundedly); use jax.lax.while_loop",
+                )
+
+
+def _returns_state(fn: ast.AST, first_param: str) -> bool:
+    """Does the wrapped program hand back a new version of its state?
+
+    Returning a ``*State`` constructor call is the strong signal; a bare
+    ``return state`` counts only when the body reassigns the name (a
+    pass-through read like a views program does not want donation — the
+    caller keeps using its handle).
+    """
+    reassigned = False
+    if not isinstance(fn, ast.Lambda):
+        for node in FileContext.walk_shallow(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                if any(
+                    isinstance(t, ast.Name) and t.id == first_param
+                    for t in targets
+                ):
+                    reassigned = True
+                    break
+
+    def state_expr(expr: ast.AST | None) -> bool:
+        if expr is None:
+            return False
+        if isinstance(expr, ast.Call):
+            name = None
+            if isinstance(expr.func, ast.Name):
+                name = expr.func.id
+            elif isinstance(expr.func, ast.Attribute):
+                name = expr.func.attr
+            if name is not None and name.endswith("State"):
+                return True
+        if isinstance(expr, ast.Name) and expr.id == first_param:
+            return reassigned
+        if isinstance(expr, ast.Tuple):
+            return any(state_expr(e) for e in expr.elts)
+        return False
+
+    if isinstance(fn, ast.Lambda):
+        return state_expr(fn.body)
+    return any(
+        state_expr(node.value)
+        for node in FileContext.walk_shallow(fn)
+        if isinstance(node, ast.Return)
+    )
+
+
+@rule("JGL003", "rolling-state jit without buffer donation")
+def missing_donation(ctx: FileContext):
+    for call in ctx.jit_calls:
+        if ctx.qualname(call.func) not in ("jax.jit", "jax.pjit"):
+            continue
+        if any(
+            kw.arg in ("donate_argnums", "donate_argnames")
+            for kw in call.keywords
+        ):
+            continue
+        if not call.args:
+            continue
+        target = call.args[0]
+        fns: list[ast.AST] = []
+        if isinstance(target, ast.Lambda):
+            fns = [target]
+        elif isinstance(target, ast.Name):
+            fns = list(ctx.defs_by_name.get(target.id, ()))
+        elif isinstance(target, ast.Attribute):
+            fns = list(ctx.defs_by_name.get(target.attr, ()))
+        for fn in fns:
+            args = fn.args
+            names = [
+                a.arg
+                for a in (*args.posonlyargs, *args.args)
+                if a.arg not in ("self", "cls")
+            ]
+            if not names:
+                continue
+            first = names[0]
+            annotated_state = False
+            for a in (*args.posonlyargs, *args.args):
+                if a.arg == first and a.annotation is not None:
+                    ann = a.annotation
+                    ann_name = getattr(ann, "id", getattr(ann, "attr", ""))
+                    annotated_state = str(ann_name).endswith("State")
+                    break
+            if (
+                first in _STATE_PARAMS or annotated_state
+            ) and _returns_state(fn, first):
+                yield Finding(
+                    ctx.path,
+                    call.lineno,
+                    "JGL003",
+                    f"jax.jit of rolling-state update "
+                    f"'{getattr(fn, 'name', '<lambda>')}' without "
+                    "donate_argnums: XLA must copy the state buffer in "
+                    "HBM every step instead of updating it in place "
+                    "(donate_argnums=(0,) makes the update zero-copy)",
+                )
+                break
+
+
+@rule("JGL006", "per-call jnp dispatch of a Python scalar constant")
+def scalar_jnp_dispatch(ctx: FileContext):
+    exempt = ("__init__", "init_state")
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        qual = ctx.qualname(node.func)
+        if qual is None or not qual.startswith("jax.numpy."):
+            continue
+        if not node.args or not _is_constant(node.args[0]):
+            continue
+        fn = ctx.enclosing_function(node)
+        if fn is None or fn in ctx.jit_regions:
+            # Module level / __init__-time: one-off. Inside jit: the
+            # constant folds into the trace. Both fine.
+            continue
+        name = getattr(fn, "name", "<lambda>")
+        if name in exempt or name.startswith(
+            # Construction-time staging is one-off; test bodies are not
+            # per-message paths (keeps runs over tests/ usable).
+            ("build", "_build", "make_", "test")
+        ):
+            continue
+        yield Finding(
+            ctx.path,
+            node.lineno,
+            "JGL006",
+            f"{qual.replace('jax.numpy.', 'jnp.', 1)} of a Python scalar "
+            f"constant in '{name}' dispatches a device transfer on every "
+            "call; hoist the constant to construction time (or let the "
+            "jitted callee fold it)",
+        )
+
+
+@rule("JGL008", "unhashable argument baked into a jitted partial")
+def unhashable_partial_arg(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if ctx.qualname(node.func) != "functools.partial":
+            continue
+        if not node.args:
+            continue
+        target = node.args[0]
+        target_fns: set[ast.AST] = set()
+        wrapped_in_jit = ctx.qualname(target) in ("jax.jit", "jax.pjit")
+        if isinstance(target, ast.Name):
+            target_fns = set(ctx.defs_by_name.get(target.id, ()))
+        elif isinstance(target, ast.Attribute):
+            target_fns = set(ctx.defs_by_name.get(target.attr, ()))
+        if not wrapped_in_jit and not (target_fns & ctx.jit_regions):
+            continue
+        bad = [
+            arg
+            for arg in (*node.args[1:], *(kw.value for kw in node.keywords))
+            if isinstance(arg, (ast.List, ast.Dict, ast.Set))
+        ]
+        for arg in bad:
+            kind = type(arg).__name__.lower()
+            yield Finding(
+                ctx.path,
+                arg.lineno,
+                "JGL008",
+                f"{kind} literal baked into a partial of a jitted "
+                "function: unhashable static args defeat the jit cache "
+                "(TypeError under static_argnums, silent retrace storm "
+                "otherwise); pass a tuple or hoist to a hashable "
+                "constant",
+            )

@@ -1,0 +1,56 @@
+"""JGL007: silently swallowed exceptions.
+
+A ``except Exception: pass`` in the service loop turns a poison message
+(malformed flatbuffer, schema drift) into an invisible data gap: the
+stream keeps flowing, the histogram silently stops filling. Handlers
+must at least log; truly-intentional swallows carry a suppression with
+the justification next to it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..context import FileContext
+from ..findings import Finding
+from ..registry import rule
+
+_BROAD = frozenset({"Exception", "BaseException"})
+
+
+def _is_silent(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(
+            stmt.value, ast.Constant
+        ):
+            continue  # docstring / Ellipsis
+        if isinstance(stmt, ast.Continue):
+            continue
+        return False
+    return True
+
+
+@rule("JGL007", "broad exception handler that swallows errors silently")
+def silent_broad_except(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            kind = "bare 'except:'"
+        else:
+            qual = ctx.qualname(node.type)
+            if qual not in _BROAD:
+                continue
+            kind = f"'except {qual}:'"
+        if _is_silent(node.body):
+            yield Finding(
+                ctx.path,
+                node.lineno,
+                "JGL007",
+                f"{kind} with a silent body can swallow poison-message "
+                "errors in the streaming loop — the pipeline keeps "
+                "running while data silently stops; log the exception "
+                "(logger.debug at minimum) or narrow the type",
+            )

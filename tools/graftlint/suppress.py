@@ -1,0 +1,77 @@
+"""Suppression comments.
+
+Two scopes, both carrying an explicit rule list (never a bare disable —
+a suppression that does not name what it silences rots silently):
+
+- line:  ``# graftlint: disable=JGL001[,JGL004]`` on the flagged line or
+  the line directly above it suppresses those rules for that line.
+- file:  ``# graftlint: disable-file=JGL007`` anywhere in the file
+  suppresses the named rules for the whole file.
+
+``all`` is accepted in place of a rule list (``disable=all``) for
+generated files. Directives are read from COMMENT tokens only — the
+same text inside a docstring or string literal (e.g. documentation
+*about* the directive, like this docstring) has no effect.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+
+from .findings import Finding
+
+# The id list stops at the first non-id token so trailing prose on the
+# same comment ("# graftlint: disable=JGL007 best-effort wakeup") — the
+# justification style the docs recommend — does not break the match.
+_IDS = r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+_LINE_RE = re.compile(r"#\s*graftlint:\s*disable=" + _IDS)
+_FILE_RE = re.compile(r"#\s*graftlint:\s*disable-file=" + _IDS)
+
+
+def _rules(spec: str) -> frozenset[str]:
+    return frozenset(r.strip() for r in spec.split(",") if r.strip())
+
+
+def _iter_comments(source: str):
+    """(lineno, text) for every comment token; tolerant of tokenize
+    errors on pathological files (the directives collected so far are
+    kept — the AST pass has its own, stricter error channel)."""
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.string
+    except (tokenize.TokenError, IndentationError):
+        return
+
+
+class Suppressions:
+    """Parsed suppression comments for one file."""
+
+    def __init__(self, source: str) -> None:
+        self.by_line: dict[int, frozenset[str]] = {}
+        self.file_wide: frozenset[str] = frozenset()
+        for lineno, comment in _iter_comments(source):
+            if m := _LINE_RE.search(comment):
+                self.by_line[lineno] = self.by_line.get(
+                    lineno, frozenset()
+                ) | _rules(m.group(1))
+            if m := _FILE_RE.search(comment):
+                self.file_wide = self.file_wide | _rules(m.group(1))
+
+    def _match(self, rules: frozenset[str], rule: str) -> bool:
+        return rule in rules or "all" in rules
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        if self._match(self.file_wide, finding.rule):
+            return True
+        for lineno in (finding.line, finding.line - 1):
+            if self._match(
+                self.by_line.get(lineno, frozenset()), finding.rule
+            ):
+                return True
+        return False
+
+    def filter(self, findings: list[Finding]) -> list[Finding]:
+        return [f for f in findings if not self.is_suppressed(f)]
