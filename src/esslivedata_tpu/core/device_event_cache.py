@@ -1,0 +1,240 @@
+"""Stage-once device event cache: one transfer per (stream, layout), not per job.
+
+Before this cache, every job subscribed to a detector stream staged the
+window's event batch privately — K jobs on one stream meant K host
+flatten/partition passes and K host→device transfers of identical bytes
+(``Job.add`` → per-workflow ``accumulate`` → ``dispatch_safe``). The
+relay link is the measured bottleneck (PERF.md: 4 B/event of wire
+traffic, 6× bandwidth volatility), so per-job staging scaled the binding
+constraint by K for no information gain. This module inverts the
+ownership: staging belongs to the *stream*, jobs consume device-resident
+arrays by reference — the same share-the-staged-input move inference
+serving stacks use to amortize transfer cost across consumers (ADR 0110).
+
+Lifecycle (all driven by ``JobManager.process_jobs``):
+
+- ``begin_window()`` opens a new window generation; per-stream
+  :class:`StreamStageSlot` handles are attached to the window's
+  ``StagedEvents`` values.
+- Consumers (workflow kernels) call ``slot.get_or_stage(key, fn)``:
+  the first caller under a key runs ``fn`` (host decode→flatten→
+  ``dispatch_safe``) and every later caller — any job, any thread —
+  gets the same staged object back.
+- ``end_window()`` drops every staged reference. Entries never outlive
+  a window (each window carries new events), which also makes job
+  attach/detach trivially safe: a job added or removed between windows
+  can never observe another generation's arrays.
+
+Keys must capture *everything* that changes the staged bytes: the
+staging flavor ("raw"/"flat"/"part"/"shard"), a caller-chosen
+``batch_tag`` for pre-staging transforms (e.g. the monitor workflow's
+pixel-id clamp), and the projection-layout fingerprint
+(``EventHistogrammer.stage_key`` — LUT digest, bin edges, block/chunk
+shape). A projection-layout change therefore invalidates by *keying*,
+not by flushing: the swapped layout simply misses and stages fresh.
+
+Thread-safety: ``process_jobs`` fans consumers over a thread pool, so a
+slot serializes staging per key under its lock — the second job *waits*
+for the first transfer instead of duplicating it.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Hashable
+
+__all__ = ["DeviceEventCache", "EventIngest", "StreamStageSlot"]
+
+
+@dataclass(frozen=True)
+class EventIngest:
+    """A workflow's offer to have one staged-events value ingested by the
+    fused stepping layer instead of its own ``accumulate``.
+
+    Workflows that step a shared :class:`~..ops.histogram.EventHistogrammer`
+    state from a ``StagedEvents`` value expose ``event_ingest(stream,
+    staged) -> EventIngest | None`` (duck-typed, like ``supports_snapshot``).
+    The JobManager groups offers by ``(stream, key)`` and advances every
+    group member's state in ONE jitted dispatch (``step_many``) from ONE
+    cached staging — then tells the job to skip that stream in
+    ``accumulate`` so nothing double-counts.
+
+    ``key`` must be the histogrammer's ``fuse_key`` extended with the
+    ``batch_tag``: equal keys promise both identical staged input and an
+    identical step program.
+    """
+
+    key: tuple
+    hist: Any  # EventHistogrammer (duck-typed: step_many)
+    batch: Any  # EventBatch, possibly transformed (must match batch_tag)
+    batch_tag: str
+    get_state: Callable[[], Any]
+    set_state: Callable[[Any], None]
+
+
+def _staged_nbytes(obj: Any) -> int:
+    """Approximate wire bytes of a staged object (array or tuple of
+    arrays): jax and numpy arrays both expose ``nbytes``."""
+    if isinstance(obj, tuple):
+        return sum(_staged_nbytes(o) for o in obj)
+    return int(getattr(obj, "nbytes", 0))
+
+
+class _StageEntry:
+    """Per-key staging latch: the first claimant stages, later claimants
+    wait on the event instead of duplicating the work — while *other*
+    keys on the same stream stage concurrently (two projection layouts
+    must not serialize each other's host flattens)."""
+
+    __slots__ = ("event", "value", "error")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.value: Any = None
+        self.error: BaseException | None = None
+
+
+class StreamStageSlot:
+    """One stream's staging table for the current window."""
+
+    __slots__ = ("_cache", "stream", "_entries", "_lock", "_closed")
+
+    def __init__(self, cache: DeviceEventCache, stream: str) -> None:
+        self._cache = cache
+        self.stream = stream
+        self._entries: dict[Hashable, _StageEntry] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def get_or_stage(self, key: Hashable, stage: Callable[[], Any]) -> Any:
+        """The staged object for ``key``; runs ``stage`` exactly once per
+        window per key (concurrent same-key callers wait; distinct keys
+        stage in parallel). After ``end_window`` the slot degrades to a
+        passthrough (stage, don't retain) so a late consumer — a
+        finishing job flushed on an idle tick — can never pin or read a
+        stale generation."""
+        with self._lock:
+            if self._closed:
+                owner, entry = True, None
+            else:
+                entry = self._entries.get(key)
+                owner = entry is None
+                if owner:
+                    entry = _StageEntry()
+                    self._entries[key] = entry
+        if entry is None:  # closed slot: pure passthrough
+            return stage()
+        if owner:
+            try:
+                entry.value = stage()
+            except BaseException as err:
+                entry.error = err
+                # Drop the poisoned entry so a later caller may retry
+                # (the private fallback path re-stages after a fused
+                # failure, and must not inherit the dead latch).
+                with self._lock:
+                    if self._entries.get(key) is entry:
+                        del self._entries[key]
+                raise
+            finally:
+                entry.event.set()
+            self._cache._record_miss(_staged_nbytes(entry.value))
+            return entry.value
+        entry.event.wait()
+        if entry.error is not None:
+            raise entry.error
+        self._cache._record_hit()
+        return entry.value
+
+    def __contains__(self, key: Hashable) -> bool:
+        with self._lock:
+            entry = self._entries.get(key)
+            return entry is not None and entry.event.is_set()
+
+    def _close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._entries.clear()
+
+
+class DeviceEventCache:
+    """Per-stream stage-once cache for one service's event streams."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._slots: dict[str, StreamStageSlot] = {}
+        # Cumulative stats since construction / last drain: the bench's
+        # wire_bytes_per_event and the 30 s metrics line read these.
+        # Leaf-level lock: _record_* run while a slot lock is held, so
+        # they must never reach back for the slots lock above.
+        self._stats_lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+        self._bytes_staged = 0
+
+    # -- window lifecycle -------------------------------------------------
+    def begin_window(self) -> None:
+        """Open a new window generation: previous slots close (their
+        staged references drop) and fresh slots hand out on demand."""
+        with self._lock:
+            for slot in self._slots.values():
+                slot._close()
+            self._slots = {}
+
+    def slot(self, stream: str) -> StreamStageSlot:
+        with self._lock:
+            try:
+                return self._slots[stream]
+            except KeyError:
+                s = StreamStageSlot(self, stream)
+                self._slots[stream] = s
+                return s
+
+    def end_window(self) -> None:
+        """Drop every staged reference. Device memory frees once the last
+        in-flight kernel consuming an array completes (JAX refcounts);
+        the cache never pins a batch past its window."""
+        self.begin_window()
+
+    def invalidate(self) -> None:
+        """Flush all slots immediately (job attach/detach hook). With
+        window-scoped entries this is belt-and-braces — entries cannot
+        cross windows anyway — but it keeps the invalidation rule
+        explicit at the call sites that change the consumer set."""
+        self.begin_window()
+
+    # -- stats ------------------------------------------------------------
+    def _record_miss(self, nbytes: int) -> None:
+        with self._stats_lock:
+            self._misses += 1
+            self._bytes_staged += nbytes
+
+    def _record_hit(self) -> None:
+        with self._stats_lock:
+            self._hits += 1
+
+    def stats(self) -> dict[str, int | float]:
+        """{hits, misses, bytes_staged, hit_rate} since the last drain."""
+        with self._stats_lock:
+            total = self._hits + self._misses
+            return {
+                "hits": self._hits,
+                "misses": self._misses,
+                "bytes_staged": self._bytes_staged,
+                "hit_rate": (self._hits / total) if total else 0.0,
+            }
+
+    def drain_stats(self) -> dict[str, int | float]:
+        with self._stats_lock:
+            total = self._hits + self._misses
+            out = {
+                "hits": self._hits,
+                "misses": self._misses,
+                "bytes_staged": self._bytes_staged,
+                "hit_rate": (self._hits / total) if total else 0.0,
+            }
+            self._hits = 0
+            self._misses = 0
+            self._bytes_staged = 0
+        return out

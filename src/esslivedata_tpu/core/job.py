@@ -11,13 +11,13 @@ from __future__ import annotations
 import time
 import uuid
 from collections.abc import Mapping
-from enum import StrEnum
 from typing import Any
 
 import numpy as np
 from pydantic import BaseModel, Field
 
 from ..config.workflow_spec import JobId, JobSchedule, ResultKey, WorkflowId
+from ..utils.compat import StrEnum
 from ..utils.labeled import DataArray, Variable
 from ..workflows.workflow_factory import Workflow
 from .timestamp import Duration, Timestamp
@@ -201,9 +201,16 @@ class Job:
         *,
         start: Timestamp | None = None,
         end: Timestamp | None = None,
+        skip_accumulate: frozenset[str] | set[str] = frozenset(),
     ) -> bool:
         """Feed one window of stream-keyed data; returns True if any of it
-        was for this job."""
+        was for this job.
+
+        ``skip_accumulate`` names streams whose values were already
+        accumulated out-of-band by the JobManager's fused stepping layer:
+        they still count as delivered data (window stamps, primary-data
+        bookkeeping) but must not reach ``workflow.accumulate`` a second
+        time."""
         if all(k in self.subscribed_streams for k in data):
             # Common case: the JobManager pre-filters per job — no copy.
             relevant: Mapping[str, Any] = data
@@ -219,7 +226,14 @@ class Job:
             self._generation_start = start
         if end is not None:
             self._window_end = end
-        self.workflow.accumulate(relevant)
+        if skip_accumulate:
+            to_accumulate = {
+                k: v for k, v in relevant.items() if k not in skip_accumulate
+            }
+            if to_accumulate:
+                self.workflow.accumulate(to_accumulate)
+        else:
+            self.workflow.accumulate(relevant)
         return True
 
     def set_context(self, context: Mapping[str, Any]) -> None:

@@ -22,13 +22,15 @@ import uuid
 from collections.abc import Mapping
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
-from enum import StrEnum
 from typing import Any, Literal
 
 from pydantic import BaseModel, model_validator
 
 from ..config.workflow_spec import JobId, WorkflowConfig
+from ..preprocessors.event_data import StagedEvents
+from ..utils.compat import StrEnum
 from ..workflows.workflow_factory import WorkflowFactory, workflow_registry
+from .device_event_cache import DeviceEventCache
 from .job import Job, JobResult, JobState, JobStatus
 from .message import RunStart, RunStop
 from .state_snapshot import supports_snapshot
@@ -159,6 +161,11 @@ class JobManager:
         #: (SURVEY §5 checkpoint note).
         self._snapshot_store = snapshot_store
         self._records: dict[JobId, _JobRecord] = {}
+        #: Stage-once staging per stream (ADR 0110): every window's event
+        #: batches decode/flatten/transfer ONCE per (stream, layout) no
+        #: matter how many jobs subscribe; slots are attached to the
+        #: window's StagedEvents values in process_jobs.
+        self._event_cache = DeviceEventCache()
         self._lock = threading.RLock()
         # Reset times scheduled by run transitions, sorted; each fires when
         # DATA time reaches it (reference :486-501) — never on arrival
@@ -181,6 +188,9 @@ class JobManager:
             job = self._factory.create(config)
             self._records[config.job_id] = _JobRecord(job=job)
             logger.info("Scheduled job %s (%s)", config.job_id, config.identifier)
+            # Consumer-set change: flush staged slots (ADR 0110). Entries
+            # are window-scoped anyway; this keeps the rule explicit.
+            self._event_cache.invalidate()
             self._maybe_restore(job)
             return config.job_id
 
@@ -268,6 +278,8 @@ class JobManager:
                 elif command.action == "remove":
                     rec.phase = _Phase.STOPPED
                     del self._records[jid]
+                    # Consumer detach: flush staged slots (ADR 0110).
+                    self._event_cache.invalidate()
                 elif command.action == "reset":
                     self._reset_record(rec)
             return len(matched)
@@ -426,6 +438,14 @@ class JobManager:
         """
         context = context or {}
         with self._lock:
+            # New window generation: previous staged slots drop, and this
+            # window's event batches get stream slots so every consumer —
+            # workflow-private stepping and the fused layer alike — stages
+            # each batch once per (stream, layout).
+            self._event_cache.begin_window()
+            for name, value in data.items():
+                if isinstance(value, StagedEvents):
+                    value.cache = self._event_cache.slot(name)
             if end is not None:
                 self._fire_pending_resets(end)
                 self._advance_to_time(end)
@@ -465,9 +485,16 @@ class JobManager:
                 # the job's next add.)
                 if job_data or rec.has_primary_data:
                     work.append((rec, job_data))
+            fuse_groups = self._plan_fused_steps(work)
+
+        # Fused stepping (outside the lock, same as the fan-out): each
+        # group of >= 2 jobs sharing a (stream, fuse-key) advances all
+        # its states in ONE jitted dispatch from ONE cached staging.
+        fused_streams = self._run_fused_steps(fuse_groups)
 
         def run_one(item: tuple[_JobRecord, dict[str, Any]]) -> JobResult | None:
             rec, job_data = item
+            skip_streams = fused_streams.get(rec.job.job_id, frozenset())
             job = rec.job
             # Deliver pending context in its own try: a failure keeps the
             # names queued (retried next window) and does not block this
@@ -495,7 +522,12 @@ class JobManager:
             # be able to finalize previously accumulated data. A successful
             # add must not mask an unresolved context failure.
             try:
-                touched = job.add(job_data, start=start, end=end)
+                touched = job.add(
+                    job_data,
+                    start=start,
+                    end=end,
+                    skip_accumulate=skip_streams,
+                )
                 if touched and any(k in job_data for k in job.primary_streams):
                     rec.has_primary_data = True
                 rec.warning = context_warning
@@ -541,7 +573,129 @@ class JobManager:
                     # device-resident accumulator now instead of pinning
                     # it until an operator removes the stopped record.
                     rec.job.release()
+        # Drop this window's staged references: device memory frees once
+        # the last in-flight kernel completes, and next window's batches
+        # can never alias a stale generation.
+        self._event_cache.end_window()
         return [r for r in results if r is not None]
+
+    def _plan_fused_steps(
+        self, work: list[tuple[_JobRecord, dict[str, Any]]]
+    ) -> dict[tuple, list]:
+        """Group fusable (job, stream, staged) offers by (stream, fuse key).
+
+        A job is eligible when it has no queued context (fused stepping
+        runs before the per-job context delivery in ``run_one``, so a
+        pending position/geometry update must keep the job on the private
+        path this window to preserve context-before-accumulate ordering)
+        and its workflow offers an ``event_ingest`` for the value. At most
+        one stream fuses per job per window — a second StagedEvents value
+        on the same workflow would race its own state capture.
+        """
+        groups: dict[tuple, list] = {}
+        for rec, job_data in work:
+            if rec.stale_context:
+                continue
+            ingest_fn = getattr(rec.job.workflow, "event_ingest", None)
+            if ingest_fn is None:
+                continue
+            for stream, value in job_data.items():
+                if not isinstance(value, StagedEvents):
+                    continue
+                try:
+                    offer = ingest_fn(stream, value)
+                except Exception:
+                    logger.exception(
+                        "event_ingest failed for %s", rec.job.job_id
+                    )
+                    offer = None
+                if offer is None:
+                    continue
+                groups.setdefault((stream, offer.key), []).append(
+                    (rec, stream, value, offer)
+                )
+                break
+        return groups
+
+    def _run_fused_steps(
+        self, groups: dict[tuple, list]
+    ) -> dict[JobId, set[str]]:
+        """Execute every group of >= 2 offers with one fused dispatch.
+
+        Returns job_id -> streams accumulated out-of-band (``Job.add``
+        skips them). Failure containment: a group whose fused step raises
+        at TRACE time (buffers untouched) is logged and left to the
+        private per-job path — state setters only run after a successful
+        dispatch, so nothing half-applies and the fallback cannot
+        double-count. A RUNTIME failure (e.g. HBM OOM allocating the K
+        fused outputs) is harder: ``step_many`` donates every state, so
+        the old buffers may already be invalidated — each member whose
+        state was consumed gets a fresh zeroed state and a visible
+        warning instead of stepping a deleted array forever. Singleton
+        groups stay private: a K=1 fused program would compile a second
+        identical kernel for no dispatch saving.
+        """
+        fused: dict[JobId, set[str]] = {}
+        for (stream, _key), members in groups.items():
+            if len(members) < 2:
+                continue
+            rec0, _stream0, value0, offer0 = members[0]
+            states = tuple(m[3].get_state() for m in members)
+            try:
+                new_states = offer0.hist.step_many(
+                    states,
+                    offer0.batch,
+                    cache=value0.cache,
+                    batch_tag=offer0.batch_tag,
+                )
+            except Exception:
+                logger.exception(
+                    "Fused step failed for stream %r (%d jobs); "
+                    "falling back to per-job accumulation",
+                    stream,
+                    len(members),
+                )
+                for (rec, _strm, _value, offer), state in zip(
+                    members, states, strict=True
+                ):
+                    if self._state_consumed(state):
+                        # Donation already invalidated the buffers: the
+                        # pre-step accumulation is unrecoverable in
+                        # place. Reset to a fresh state (the private
+                        # fallback then re-adds THIS window's batch) and
+                        # surface the loss instead of erroring on a
+                        # deleted array every window from here on.
+                        offer.set_state(offer.hist.init_state())
+                        rec.warning = (
+                            "fused step failed after buffer donation; "
+                            "accumulation reset (see service log)"
+                        )
+                continue
+            for (rec, strm, _value, offer), new_state in zip(
+                members, new_states, strict=True
+            ):
+                offer.set_state(new_state)
+                fused.setdefault(rec.job.job_id, set()).add(strm)
+        return fused
+
+    @staticmethod
+    def _state_consumed(state) -> bool:
+        """True when any leaf buffer of a (donated) state pytree has been
+        invalidated by a dispatch that subsequently failed."""
+        for leaf in state:
+            deleted = getattr(leaf, "is_deleted", None)
+            try:
+                if deleted is not None and deleted():
+                    return True
+            except Exception:  # pragma: no cover - defensive
+                return True
+        return False
+
+    def event_cache_stats(self) -> dict[str, int | float]:
+        """Stage-once cache counters since the last metrics drain
+        (hits/misses/bytes_staged/hit_rate) — the 30 s metrics line and
+        the multi-job bench read these."""
+        return self._event_cache.drain_stats()
 
     # -- introspection -----------------------------------------------------
     def has_finishing_jobs(self) -> bool:

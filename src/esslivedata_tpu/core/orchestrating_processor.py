@@ -413,6 +413,15 @@ class OrchestratingProcessor:
             "stream_counts": dict(self._preprocessor.message_counts),
             "lag_level": self._current_lag_report().worst_level,
         }
+        # Stage-once cache counters (ADR 0110). The engagement signal is
+        # misses ~= one per (stream, window) INDEPENDENT of job count —
+        # not hit_rate: a fused group touches the cache exactly once, so
+        # hit_rate legitimately reads 0 when sharing works best (hits
+        # only appear when jobs stage privately against a warm slot).
+        # bytes_staged over the interval is the actual wire traffic.
+        cache_stats = getattr(self._job_manager, "event_cache_stats", None)
+        if cache_stats is not None:
+            extra["event_cache"] = cache_stats()
         try:
             from ..utils.profiling import device_memory_stats
 

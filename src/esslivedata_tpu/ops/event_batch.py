@@ -23,6 +23,7 @@ __all__ = [
     "bucket_size",
     "make_staging_buffer",
     "sanitize_pixel_id",
+    "stage_raw",
 ]
 
 MIN_BUCKET = 1 << 12  # 4096: below this, padding waste is irrelevant
@@ -201,6 +202,25 @@ def dispatch_safe(x):
 
         return jax.device_put(x.copy())
     return x
+
+
+def stage_raw(batch: EventBatch, cache=None, tag: str = ""):
+    """Stage a batch's raw ``(pixel_id, toa)`` pair for the device path.
+
+    With a window's stream cache (``core/device_event_cache.py``) the
+    8 B/event transfer happens ONCE per (stream, tag) and every
+    device-path consumer — weighted/replica detector views, Q-family
+    kernels — shares the staged arrays by reference. The raw wire does
+    not depend on any projection layout, so the key needs no layout
+    fingerprint; ``tag`` distinguishes pre-staging content transforms
+    (e.g. the monitor workflow's pixel-id clamp).
+    """
+    if cache is None:
+        return dispatch_safe(batch.pixel_id), dispatch_safe(batch.toa)
+    return cache.get_or_stage(
+        ("raw", tag, batch.padded_size),
+        lambda: (dispatch_safe(batch.pixel_id), dispatch_safe(batch.toa)),
+    )
 
 
 def stage_for(arr, sharding, *, dtype=None):

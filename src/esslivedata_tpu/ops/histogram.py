@@ -52,7 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .event_batch import EventBatch, dispatch_safe, sanitize_pixel_id
+from .event_batch import EventBatch, dispatch_safe, sanitize_pixel_id, stage_raw
 
 __all__ = ["EventHistogrammer", "EventProjection", "HistogramState"]
 
@@ -104,11 +104,36 @@ class EventProjection:
         else:
             self.lut_host = None
             self._lut_dev = None
-        self.weights = (
-            jnp.asarray(np.asarray(pixel_weights, dtype=np.float32))
-            if pixel_weights is not None
-            else None
-        )
+        if pixel_weights is not None:
+            self._weights_host = np.asarray(pixel_weights, dtype=np.float32)
+            self.weights = jnp.asarray(self._weights_host)
+        else:
+            self._weights_host = None
+            self.weights = None
+        self._layout_digest: str | None = None
+
+    @property
+    def layout_digest(self) -> str:
+        """Content fingerprint of everything that determines where an
+        event lands: bin edges, screen size, LUT and weight tables. Two
+        projections with equal digests flatten identically, so staged
+        flat/partitioned arrays may be shared across their consumers
+        (core/device_event_cache.py keys on this). Computed lazily and
+        cached per projection object — a live LUT swap builds a new
+        projection, so the swapped layout re-fingerprints by
+        construction (the cache-invalidation rule of ADR 0110)."""
+        if self._layout_digest is None:
+            import hashlib
+
+            h = hashlib.sha1()
+            h.update(self.edges.tobytes())
+            h.update(np.int64(self.n_screen).tobytes())
+            if self.lut_host is not None:
+                h.update(np.ascontiguousarray(self.lut_host).tobytes())
+            if self._weights_host is not None:
+                h.update(np.ascontiguousarray(self._weights_host).tobytes())
+            self._layout_digest = h.hexdigest()
+        return self._layout_digest
 
     @property
     def lut(self):
@@ -406,6 +431,18 @@ class EventHistogrammer:
         self._clear_window = jax.jit(self._clear_window_impl, donate_argnums=(0,))
         self._clear_all = jax.jit(self._clear_all_impl, donate_argnums=(0,))
         self._views = jax.jit(self._views_impl)
+        # Fused K-job variants (one dispatch advances K independent donated
+        # states from ONE staged batch; jit caches one program per K). The
+        # per-state ops match the single-state programs exactly, so fused
+        # and private stepping are bit-identical (asserted in tests).
+        self._step_fused = jax.jit(self._step_fused_impl, donate_argnums=(0,))
+        self._step_flat_fused = jax.jit(
+            self._step_flat_fused_impl, donate_argnums=(0,)
+        )
+        if method == "pallas2d":
+            self._step_part_fused = jax.jit(
+                self._step_part_fused_impl, donate_argnums=(0,)
+            )
 
     # -- properties -------------------------------------------------------
     @property
@@ -550,6 +587,42 @@ class EventHistogrammer:
             None,
         )
 
+    # -- fused K-job variants (one dispatch, K donated states) -------------
+    # Each fused impl applies the SAME per-state program as its single
+    # counterpart, trace-unrolled over the states tuple: the shared
+    # routing/one-hot work folds into one program, the K scatters ride
+    # one dispatch instead of K (at a relay RTT per dispatch, the saving
+    # is the point), and per-state float op order is unchanged — fused
+    # results are bit-identical to K private steps.
+    def _step_fused_impl(self, states, lut, pixel_id, toa):
+        flat, w = self._proj.flat_and_weights(pixel_id, toa, lut=lut)
+        return tuple(self._advance(s, flat, w) for s in states)
+
+    def _step_flat_fused_impl(self, states, flat):
+        flat = jnp.where(
+            (flat < 0) | (flat > self._n_bins), self._n_bins, flat
+        )
+        return tuple(self._advance(s, flat, None) for s in states)
+
+    def _step_part_fused_impl(self, states, events, chunk_map):
+        from .pallas_hist2d import scatter_add_pallas2d
+
+        return tuple(
+            self._advance_core(
+                s,
+                lambda win, upd: scatter_add_pallas2d(
+                    win,
+                    events,
+                    chunk_map,
+                    bpb=self._bpb,
+                    upd=upd,
+                    precision=self._p2_precision,
+                ),
+                None,
+            )
+            for s in states
+        )
+
     def physical_window(self, state: HistogramState) -> jax.Array:
         """The window in physical counts, flat incl. dump bin — applies the
         lazy decay scale. Traceable: workflows compose this inside their
@@ -583,8 +656,10 @@ class EventHistogrammer:
         )
         # Carry the DEVICE weights array over directly: re-threading it
         # through __init__ would round-trip device->host->device on every
-        # swap (the sharded twin documents the same hazard).
+        # swap (the sharded twin documents the same hazard). The host
+        # copy rides along so the layout fingerprint still covers it.
         self._proj.weights = old.weights
+        self._proj._weights_host = old._weights_host
         # No re-jit: the device path takes the LUT as a jit argument
         # (ADR 0105), so the swap costs one lazy device transfer on the
         # next step — never a retrace, even for per-batch geometry flaps.
@@ -699,6 +774,68 @@ class EventHistogrammer:
         cum = win + state.folded[: self._n_bins].reshape(shape)
         return cum, win
 
+    # -- stage-once staging (core/device_event_cache.py) -------------------
+    @property
+    def stage_key(self) -> tuple:
+        """Cache key for this configuration's host-flattened wire: flat
+        indices depend only on the projection layout, so any two
+        histogrammers with equal keys may share one staged array."""
+        return ("flat", self._proj.layout_digest)
+
+    @property
+    def partition_key(self) -> tuple:
+        """Cache key for the pallas2d partitioned wire: the partition
+        additionally depends on the block/chunk geometry and compaction."""
+        return (
+            "part",
+            self._proj.layout_digest,
+            self._bpb,
+            self._p2_chunk,
+            self._p2_compact,
+        )
+
+    @property
+    def fuse_key(self) -> tuple:
+        """Grouping key for fused stepping (core/job_manager.py): two
+        histogrammers with equal fuse keys run the same step program
+        over the same staged input, so their jobs' states may advance in
+        one fused dispatch. Strictly finer than the stage keys — it adds
+        the accumulation semantics (method, decay, dtype, state size)."""
+        base = (
+            "fuse1",
+            self._method,
+            self._decay,
+            np.dtype(self._dtype).str,
+            self._proj.layout_digest,
+            self._n_state,
+        )
+        if self._method == "pallas2d":
+            base += (self._bpb, self._p2_chunk, self._p2_compact,
+                     self._p2_precision)
+        return base
+
+    def _staged_flat(self, pixel_id, toa, cache, tag: str):
+        """Host-flattened indices staged for dispatch — once per window
+        per (stream, tag, layout) when a cache slot is provided."""
+        if cache is None:
+            return dispatch_safe(self.flatten_host(pixel_id, toa))
+        return cache.get_or_stage(
+            (tag,) + self.stage_key,
+            lambda: dispatch_safe(self.flatten_host(pixel_id, toa)),
+        )
+
+    def _staged_partition(self, pixel_id, toa, cache, tag: str):
+        """Block-partitioned (events, chunk_map) staged for the pallas2d
+        kernel — once per window per (stream, tag, partition layout)."""
+
+        def stage():
+            events, chunk_map = self.flatten_partition_host(pixel_id, toa)
+            return dispatch_safe(events), dispatch_safe(chunk_map)
+
+        if cache is None:
+            return stage()
+        return cache.get_or_stage((tag,) + self.partition_key, stage)
+
     # -- public API -------------------------------------------------------
     def step(self, state: HistogramState, batch: EventBatch) -> HistogramState:
         """Accumulate one padded batch. Donates ``state``: the caller's
@@ -725,25 +862,69 @@ class EventHistogrammer:
             dispatch_safe(toa),
         )
 
-    def step_batch(self, state: HistogramState, batch: EventBatch) -> HistogramState:
+    def step_batch(
+        self,
+        state: HistogramState,
+        batch: EventBatch,
+        *,
+        cache=None,
+        batch_tag: str = "",
+    ) -> HistogramState:
         """One staged batch, taking the 4-byte/event ingest fast path
         (host flatten + flat scatter) whenever the configuration allows it
         — half the host->device bytes of the (pixel_id, toa) path
         (PERF.md); replica/weighted configurations use the device path.
         ``method='pallas2d'`` fuses flatten + block partition into one
-        native pass feeding the MXU-tiled kernel."""
+        native pass feeding the MXU-tiled kernel.
+
+        ``cache`` (a ``StreamStageSlot`` from core/device_event_cache.py)
+        makes the host flatten/partition and the device transfer run once
+        per window per (stream, layout) no matter how many jobs step from
+        the same batch; ``batch_tag`` marks pre-staging content
+        transforms so transformed batches never collide with the raw
+        stream under the same layout key."""
         if self._method == "pallas2d":
-            events, chunk_map = self.flatten_partition_host(
-                batch.pixel_id, batch.toa
+            events, chunk_map = self._staged_partition(
+                batch.pixel_id, batch.toa, cache, batch_tag
             )
-            return self._step_part(
-                state, dispatch_safe(events), dispatch_safe(chunk_map)
-            )
+            return self._step_part(state, events, chunk_map)
         if self.supports_host_flatten:
-            return self.step_flat(
-                state, self.flatten_host(batch.pixel_id, batch.toa)
+            return self._step_flat(
+                state,
+                self._staged_flat(batch.pixel_id, batch.toa, cache, batch_tag),
             )
-        return self.step(state, batch)
+        pid, toa = stage_raw(batch, cache, batch_tag)
+        return self._step(state, self._proj.lut, pid, toa)
+
+    def step_many(
+        self,
+        states,
+        batch: EventBatch,
+        *,
+        cache=None,
+        batch_tag: str = "",
+    ) -> tuple[HistogramState, ...]:
+        """Advance K independent states from ONE staged batch in ONE
+        jitted dispatch (the fused-stepping layer's kernel entry,
+        core/job_manager.py). All states are donated; per-state results
+        are bit-identical to K private ``step_batch`` calls. The jit
+        cache holds one program per K — group sizes are expected to be
+        few and stable (the number of co-subscribed jobs)."""
+        states = tuple(states)
+        if not states:
+            return ()
+        if self._method == "pallas2d":
+            events, chunk_map = self._staged_partition(
+                batch.pixel_id, batch.toa, cache, batch_tag
+            )
+            return self._step_part_fused(states, events, chunk_map)
+        if self.supports_host_flatten:
+            return self._step_flat_fused(
+                states,
+                self._staged_flat(batch.pixel_id, batch.toa, cache, batch_tag),
+            )
+        pid, toa = stage_raw(batch, cache, batch_tag)
+        return self._step_fused(states, self._proj.lut, pid, toa)
 
     def flatten_partition_host(
         self, pixel_id: np.ndarray, toa: np.ndarray

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .event_batch import EventBatch
+from .event_batch import EventBatch, stage_raw
 
 __all__ = [
     "QHistogrammer",
@@ -545,11 +545,21 @@ class QHistogrammer:
 
     # -- public API -------------------------------------------------------
     def step(
-        self, state: QState, batch: EventBatch, monitor_count: float = 0.0
+        self,
+        state: QState,
+        batch: EventBatch,
+        monitor_count: float = 0.0,
+        *,
+        cache=None,
+        batch_tag: str = "",
     ) -> QState:
-        return self._step(
-            state, self._qmap, batch.pixel_id, batch.toa, monitor_count
-        )
+        """Accumulate one batch; with a window stream-cache slot
+        (core/device_event_cache.py) the raw (pixel_id, toa) transfer is
+        shared with every other device-path consumer of the stream —
+        the Q-map itself rides as a jit argument, so the staged wire is
+        layout-independent."""
+        pixel_id, toa = stage_raw(batch, cache, batch_tag)
+        return self._step(state, self._qmap, pixel_id, toa, monitor_count)
 
     def swap_table(self, qmap: "np.ndarray | PixelBinMap") -> None:
         """Replace the bin table WITHOUT recompiling the step.

@@ -297,8 +297,26 @@ class ShardedHistogrammer:
             stage_for(toa, self._event_sharding),
         )
 
+    @property
+    def stage_key(self) -> tuple:
+        """Cache key for pre-staged event shards (stage-once, ADR 0110):
+        the placement depends only on the event sharding — mesh devices
+        and data-axis extent — never on the projection layout, so every
+        kernel sharing the mesh shares the staged shards."""
+        devices = tuple(int(d.id) for d in self._mesh.devices.flat)
+        return ("shard1", devices, self._n_data)
+
+    def stage_events(self, pixel_id, toa):
+        """Place one padded global batch onto the event sharding (one
+        hop). ``step`` accepts the returned device arrays — already-placed
+        arrays pass through ``stage_for`` untouched — so K jobs sharing a
+        mesh stage each window's batch once via the window stream-cache
+        (core/device_event_cache.py)."""
+        return self._shard_events(pixel_id, toa)
+
     def step(self, state: HistogramState, pixel_id, toa) -> HistogramState:
-        """Accumulate one padded global batch (host or device arrays)."""
+        """Accumulate one padded global batch (host or pre-staged device
+        arrays — see ``stage_events``)."""
         pid, t = self._shard_events(pixel_id, toa)
         lut_args = (self._lut_rep,) if self._has_lut else ()
         if self._decay is None:

@@ -55,6 +55,11 @@ class StagedEvents:
     first_timestamp: Timestamp | None
     last_timestamp: Timestamp | None
     n_chunks: int
+    #: Window stream-cache slot (core/device_event_cache.StreamStageSlot),
+    #: attached by the JobManager before fan-out: workflows thread it into
+    #: their kernels so K jobs sharing this stream stage the batch once.
+    #: None outside the managed path (tests, direct workflow use).
+    cache: object | None = None
 
     @property
     def n_events(self) -> int:

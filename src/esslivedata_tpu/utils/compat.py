@@ -1,0 +1,28 @@
+"""Small stdlib compatibility shims.
+
+``StrEnum`` landed in Python 3.11; the tier-1 container runs 3.10. The
+fallback derives ``(str, Enum)`` with ``auto()`` producing the
+lower-cased member name — the two behaviors code here relies on
+(``str(Member) == Member.value``, pydantic/JSON round-tripping as plain
+strings). Import it from here everywhere instead of ``enum`` so the
+whole tree keeps one 3.10-safe definition.
+"""
+
+from __future__ import annotations
+
+__all__ = ["StrEnum"]
+
+try:  # Python >= 3.11
+    from enum import StrEnum
+except ImportError:  # Python 3.10
+    from enum import Enum
+
+    class StrEnum(str, Enum):  # type: ignore[no-redef]
+        """3.10 stand-in for :class:`enum.StrEnum`."""
+
+        def __str__(self) -> str:  # StrEnum: str(x) is the value
+            return str(self.value)
+
+        @staticmethod
+        def _generate_next_value_(name, start, count, last_values):
+            return name.lower()

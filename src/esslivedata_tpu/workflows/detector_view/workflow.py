@@ -251,9 +251,33 @@ class DetectorViewWorkflow:
         for key, value in data.items():
             if isinstance(value, StagedEvents):
                 if self._primary_stream is None or key == self._primary_stream:
+                    # value.cache (the window's stream slot, attached by
+                    # the JobManager) makes flatten + transfer run once
+                    # per (stream, layout) across every subscribed job.
                     self._state = self._hist.step_batch(
-                        self._state, value.batch
+                        self._state, value.batch, cache=value.cache
                     )
+
+    def event_ingest(self, stream: str, staged: StagedEvents):
+        """Fused-stepping offer (core/job_manager.py): ingesting a
+        primary-stream batch is exactly one histogrammer step over
+        this job's private state, so K same-layout detector views can
+        advance in one dispatch from one staged batch."""
+        if self._primary_stream is not None and stream != self._primary_stream:
+            return None
+        from ...core.device_event_cache import EventIngest
+
+        def set_state(state) -> None:
+            self._state = state
+
+        return EventIngest(
+            key=self._hist.fuse_key + ("",),
+            hist=self._hist,
+            batch=staged.batch,
+            batch_tag="",
+            get_state=lambda: self._state,
+            set_state=set_state,
+        )
 
     def finalize(self) -> dict[str, DataArray]:
         out, self._state = self._publish(self._state, self._roi_masks)

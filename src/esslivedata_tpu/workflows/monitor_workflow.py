@@ -168,30 +168,74 @@ class MonitorWorkflow:
             self.clear()
             self._position = value
 
+    @staticmethod
+    def _row0_impl(batch):
+        if batch.pixel_id.size and batch.pixel_id.max() > 0:
+            from ..ops import EventBatch
+
+            return (
+                EventBatch(
+                    pixel_id=np.where(
+                        batch.pixel_id >= 0, 0, -1
+                    ).astype(np.int32),
+                    toa=batch.toa,
+                    n_valid=batch.n_valid,
+                    owner=batch.owner,
+                ),
+                "mon-row0",
+            )
+        return batch, ""
+
+    @classmethod
+    def _row0_batch(cls, batch, cache=None):
+        """(batch, batch_tag) with pixel ids folded onto screen row 0.
+
+        A pixellated monitor's staged events carry real pixel ids; this
+        1-D TOA histogram is id-agnostic, so every valid event folds onto
+        screen row 0 (the -1 padding sentinel stays excluded). Without
+        the clamp the n_screen=1 kernel would mask ids >= 1 and silently
+        zero the spectrum. The non-empty tag keeps the clamped wire from
+        ever colliding with the raw stream in the window stream-cache —
+        and lets every monitor job SHARE the clamped staging. The clamp
+        itself (a full-array scan + rewrite) memoizes through the same
+        slot, so K monitor jobs pay it once per window, not K times."""
+        if cache is None:
+            return cls._row0_impl(batch)
+        return cache.get_or_stage(
+            ("mon-row0-host", batch.padded_size),
+            lambda: cls._row0_impl(batch),
+        )
+
     def accumulate(self, data: Mapping[str, Any]) -> None:
         for value in data.values():
             if isinstance(value, StagedEvents):
-                batch = value.batch
-                if batch.pixel_id.size and batch.pixel_id.max() > 0:
-                    # A pixellated monitor's staged events carry real
-                    # pixel ids; this 1-D TOA histogram is id-agnostic,
-                    # so fold every valid event onto screen row 0 (the
-                    # -1 padding sentinel stays excluded). Without the
-                    # clamp the n_screen=1 kernel would mask ids >= 1
-                    # and silently zero the spectrum.
-                    from ..ops import EventBatch
-
-                    batch = EventBatch(
-                        pixel_id=np.where(
-                            batch.pixel_id >= 0, 0, -1
-                        ).astype(np.int32),
-                        toa=batch.toa,
-                        n_valid=batch.n_valid,
-                        owner=batch.owner,
-                    )
-                self._state = self._hist.step_batch(self._state, batch)
+                batch, tag = self._row0_batch(value.batch, value.cache)
+                self._state = self._hist.step_batch(
+                    self._state, batch, cache=value.cache, batch_tag=tag
+                )
             elif isinstance(value, DataArray):
                 self._add_dense(value)
+
+    def event_ingest(self, stream: str, staged: StagedEvents):
+        """Fused-stepping offer (core/job_manager.py): K same-axis
+        monitor jobs on one stream advance in a single dispatch from one
+        (possibly row0-clamped) staged batch. Dense histogram-mode data
+        never arrives as StagedEvents, so it keeps the private path."""
+        from ..core.device_event_cache import EventIngest
+
+        batch, tag = self._row0_batch(staged.batch, staged.cache)
+
+        def set_state(state) -> None:
+            self._state = state
+
+        return EventIngest(
+            key=self._hist.fuse_key + (tag,),
+            hist=self._hist,
+            batch=batch,
+            batch_tag=tag,
+            get_state=lambda: self._state,
+            set_state=set_state,
+        )
 
     def _add_dense(self, da: DataArray) -> None:
         coord_name = next(

@@ -101,13 +101,44 @@ class MultiBankViewWorkflow:
         for value in data.values():
             if isinstance(value, StagedEvents):
                 if self._sharded is not None:
-                    self._state = self._sharded.step(
-                        self._state, value.batch.pixel_id, value.batch.toa
-                    )
+                    # Pre-stage the shards through the window stream-cache
+                    # so K mesh-sharing jobs place the batch onto the
+                    # event sharding once; step() passes already-placed
+                    # device arrays through (parallel/sharded_hist.py).
+                    batch = value.batch
+                    if value.cache is not None:
+                        pid, toa = value.cache.get_or_stage(
+                            ("shard",) + self._sharded.stage_key,
+                            lambda: self._sharded.stage_events(
+                                batch.pixel_id, batch.toa
+                            ),
+                        )
+                    else:
+                        pid, toa = batch.pixel_id, batch.toa
+                    self._state = self._sharded.step(self._state, pid, toa)
                 else:
                     self._state = self._hist.step_batch(
-                        self._state, value.batch
+                        self._state, value.batch, cache=value.cache
                     )
+
+    def event_ingest(self, stream: str, staged: StagedEvents):
+        """Fused-stepping offer for the single-chip path (the sharded
+        path keeps its collective dispatch — its state spans the mesh)."""
+        if self._sharded is not None:
+            return None
+        from ..core.device_event_cache import EventIngest
+
+        def set_state(state) -> None:
+            self._state = state
+
+        return EventIngest(
+            key=self._hist.fuse_key + ("",),
+            hist=self._hist,
+            batch=staged.batch,
+            batch_tag="",
+            get_state=lambda: self._state,
+            set_state=set_state,
+        )
 
     def _publisher(self):
         """Lazy fused publish program (single-chip path): bank reductions

@@ -51,6 +51,7 @@ class QStreamingMixin:
     def accumulate(self, data: Mapping[str, Any]) -> None:
         monitor_count = 0.0
         detector: EventBatch | None = None
+        det_cache = None
         for key, value in data.items():
             if not isinstance(value, StagedEvents):
                 continue
@@ -64,13 +65,20 @@ class QStreamingMixin:
                 self._primary_stream is None or key == self._primary_stream
             ):
                 detector = value.batch
+                # Window stream-cache slot: the raw (pixel_id, toa) wire
+                # is layout-independent, so K Q-family jobs — and any
+                # device-path histogram job — share ONE transfer.
+                det_cache = value.cache
         if detector is not None or monitor_count:
             if detector is None:
                 # monitor-only window: empty padded batch keeps shapes static
                 detector = EventBatch.from_arrays(
                     np.empty(0, dtype=np.int32), np.empty(0, dtype=np.float32)
                 )
-            self._state = self._hist.step(self._state, detector, monitor_count)
+                det_cache = None
+            self._state = self._hist.step(
+                self._state, detector, monitor_count, cache=det_cache
+            )
 
     # -- state snapshots (core/state_snapshot.py, ADR 0107) ----------------
     def state_fingerprint(self) -> str:

@@ -104,9 +104,30 @@ class RatemeterWorkflow:
         for key, value in data.items():
             if isinstance(value, StagedEvents):
                 if self._primary_stream is None or key == self._primary_stream:
+                    # Stage-once (ADR 0110): K ratemeters on one stream
+                    # share the window's staged batch by reference.
                     self._state = self._hist.step_batch(
-                        self._state, value.batch
+                        self._state, value.batch, cache=value.cache
                     )
+
+    def event_ingest(self, stream: str, staged: StagedEvents):
+        """Fused-stepping offer (core/job_manager.py): same shape as the
+        detector view — one histogrammer step per primary-stream batch."""
+        if self._primary_stream is not None and stream != self._primary_stream:
+            return None
+        from ..core.device_event_cache import EventIngest
+
+        def set_state(state) -> None:
+            self._state = state
+
+        return EventIngest(
+            key=self._hist.fuse_key + ("",),
+            hist=self._hist,
+            batch=staged.batch,
+            batch_tag="",
+            get_state=lambda: self._state,
+            set_state=set_state,
+        )
 
     def finalize(self) -> dict[str, DataArray]:
         cum, win = self._hist.read(self._state)

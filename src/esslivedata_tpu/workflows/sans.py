@@ -5,6 +5,9 @@ The reference's LOKI I(Q) runs esssans' sciline graph per cycle
 is the precompiled Q-map scatter kernel (ops/qhistogram.py) plus a
 monitor-ratio at finalize. The monitor arrives as an aux stream of staged
 events (ADR-0002-style aux binding through WorkflowConfig.aux_source_names).
+Detector staging rides the window stream-cache (ADR 0110, via
+QStreamingMixin.accumulate): the raw (pixel_id, toa) wire is shared with
+every other device-path consumer of the stream.
 """
 
 from __future__ import annotations

@@ -88,6 +88,98 @@ class TestPartition:
         with pytest.raises(ValueError, match="128"):
             partition_events_host(np.zeros(4, np.int32), 1000, bpb=100)
 
+    # -- edge cases: empty / all-overflow / uint16 boundary / rollover ----
+    def test_empty_batch(self):
+        """Zero events still emit a kernel-legal partition: the chunk
+        count buckets up to the minimum shape and every slot is padding."""
+        n_incl = 300_001
+        events, chunk_map = partition_events_host(
+            np.empty(0, np.int32), n_incl
+        )
+        assert chunk_map.shape[0] == p2._CHUNK_BUCKET
+        assert events.shape[0] == p2._CHUNK_BUCKET * p2.DEFAULT_CHUNK
+        assert np.all(events == -1)
+        n_blocks = -(-n_incl // DEFAULT_BPB)
+        # Padding chunks map to the last block (dump's home) — in range,
+        # non-decreasing, so the kernel grid stays legal.
+        assert np.all(chunk_map == n_blocks - 1)
+
+    def test_all_events_overflow_routed_to_dump(self):
+        """Every out-of-range index — negative or past the bin space —
+        lands in the dump bin, none are dropped or wrapped."""
+        n_incl = 2 * DEFAULT_BPB + 5
+        dump = n_incl - 1
+        flat = np.concatenate(
+            [
+                np.full(1000, -7, np.int32),
+                np.full(1000, np.iinfo(np.int32).min, np.int32),
+                np.full(1000, n_incl, np.int32),
+                np.full(1000, np.iinfo(np.int32).max, np.int32),
+            ]
+        )
+        events, chunk_map = partition_events_host(flat, n_incl)
+        real = events[events >= 0]
+        assert real.shape[0] == flat.shape[0]
+        assert np.all(real == dump)
+        # All in the dump's block, by construction of the routing.
+        assert np.all(chunk_map == dump // DEFAULT_BPB)
+
+    def test_uint16_wire_padding_boundary(self):
+        """Compact events at the top of the largest legal block: a real
+        local offset of bpb-1 must survive next to the 0xFFFF padding
+        sentinel (the collision the bpb <= 0xFFFF bound exists to
+        prevent)."""
+        bpb = 0xFF80  # 65408 = 511 * 128: largest 128-multiple < 0xFFFF
+        n_incl = 3 * bpb
+        # Top offset of block 1 plus a handful of low offsets: the padded
+        # tail of the same chunk then carries 0xFFFF right beside 0xFF7F.
+        flat = np.asarray(
+            [bpb + bpb - 1] * 3 + [bpb] * 2 + [2 * bpb + 1], np.int32
+        )
+        events, chunk_map = partition_events_host(
+            flat, n_incl, bpb=bpb, compact=True
+        )
+        assert events.dtype == np.uint16
+        real = events[events != 0xFFFF]
+        # Reconstruct globals from block base + local offset.
+        rows = events.reshape(-1, p2.DEFAULT_CHUNK)
+        mask = rows != 0xFFFF
+        blocks = np.broadcast_to(chunk_map[:, None], rows.shape)
+        globals_ = rows.astype(np.int64) + blocks.astype(np.int64) * bpb
+        np.testing.assert_array_equal(
+            np.sort(globals_[mask]), np.sort(flat.astype(np.int64))
+        )
+        assert real.max() == bpb - 1  # boundary offset intact, not padding
+
+    @pytest.mark.parametrize("extra_blocks", [0, 1])
+    def test_chunk_bucket_rollover(self, extra_blocks):
+        """Used-chunk counts at exactly _CHUNK_BUCKET and one past it:
+        the padded chunk count must step to the next bucket multiple,
+        never truncate a used chunk."""
+        bpb = 128
+        chunk = 8
+        n_used_blocks = p2._CHUNK_BUCKET + extra_blocks
+        n_blocks = n_used_blocks + 3
+        n_incl = n_blocks * bpb
+        # One event per used block -> one (partial) chunk per used block.
+        flat = (np.arange(n_used_blocks, dtype=np.int32) * bpb).astype(
+            np.int32
+        )
+        events, chunk_map = partition_events_host(
+            flat, n_incl, bpb=bpb, chunk=chunk
+        )
+        used = n_used_blocks
+        expected_padded = p2.bucketed_chunks(used)
+        assert expected_padded == (
+            p2._CHUNK_BUCKET if extra_blocks == 0 else 2 * p2._CHUNK_BUCKET
+        )
+        assert chunk_map.shape[0] == expected_padded
+        assert events.shape[0] == expected_padded * chunk
+        # Every real event survived the rollover.
+        np.testing.assert_array_equal(
+            np.sort(events[events >= 0]), np.sort(flat)
+        )
+
 
 class TestKernel:
     def test_parity_and_unvisited_blocks_preserved(self):

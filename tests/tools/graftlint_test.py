@@ -97,6 +97,13 @@ def step(state, bins):
 
 stepper = partial(step, bins=[0.0, 1.0])
 ''',
+    "JGL009": '''
+import jax
+
+def fan_out(jobs, batch, states):
+    for job in jobs:
+        states[job] = step(states[job], jax.device_put(batch))
+''',
 }
 
 NEGATIVE = {
@@ -206,6 +213,26 @@ def host_helper(xs):
     return xs
 
 helper = partial(host_helper, [1, 2])
+''',
+    # Staging hoisted above the loop, per-iteration values staged inside
+    # it, values derived from the loop variable, and nested-loop /
+    # comprehension targets all stay quiet.
+    "JGL009": '''
+import jax
+
+def fan_out(jobs, batches, state):
+    staged = jax.device_put(batches[0])
+    for b in batches:
+        state = step(state, jax.device_put(b))
+    for i in range(4):
+        x = batches[i]
+        state = step(state, jax.device_put(x))
+    for job in jobs:
+        for b in batches:
+            state = step(state, jax.device_put(b))
+    for job in jobs:
+        parts = [jax.device_put(b) for b in batches]
+    return step(state, staged)
 ''',
 }
 # fmt: on

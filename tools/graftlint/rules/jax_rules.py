@@ -1,10 +1,12 @@
-"""JAX jit-boundary hazards: JGL001/002/003/006/008.
+"""JAX jit-boundary hazards: JGL001/002/003/006/008/009.
 
 All of these erase TPU throughput without failing a test — host syncs
 serialize the pipeline behind a device round trip, retraces recompile
 the hot kernel mid-stream, a missing donation doubles rolling-state HBM
-traffic, and per-scalar ``jnp`` dispatch pays a device transfer per
-event batch. Rationale and bad/good pairs: docs/graftlint.md.
+traffic, per-scalar ``jnp`` dispatch pays a device transfer per event
+batch, and re-staging a shared batch inside a per-job loop multiplies
+wire traffic by the job count. Rationale and bad/good pairs:
+docs/graftlint.md.
 """
 
 from __future__ import annotations
@@ -288,4 +290,82 @@ def unhashable_partial_arg(ctx: FileContext):
                 "(TypeError under static_argnums, silent retrace storm "
                 "otherwise); pass a tuple or hoist to a hashable "
                 "constant",
+            )
+
+
+#: Host->device staging entry points whose output is identical for an
+#: identical input: re-invoking one per loop iteration on a value the
+#: loop never changes re-transfers the same bytes each pass.
+_STAGING_QUALNAMES = frozenset({"jax.device_put"})
+_STAGING_NAMES = frozenset({"dispatch_safe", "stage_for"})
+
+
+def _loop_varying_names(ctx, loop: ast.For) -> frozenset[str]:
+    """Names that (may) change per iteration: the loop target plus
+    anything assigned inside the body — a staged value derived from
+    either is genuinely per-iteration data, not a duplicate."""
+    names: set[str] = set()
+
+    def add_target(target: ast.AST) -> None:
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                names.add(n.id)
+
+    add_target(loop.target)
+    for sub in ctx.walk_shallow(loop):
+        if isinstance(sub, ast.Assign):
+            for t in sub.targets:
+                add_target(t)
+        elif isinstance(sub, (ast.AugAssign, ast.AnnAssign, ast.NamedExpr)):
+            add_target(sub.target)
+        elif isinstance(sub, (ast.For, ast.AsyncFor)):
+            # A nested loop's target varies per (inner) iteration too —
+            # without this, `for job in jobs: for b in batches:
+            # device_put(b)` would flag b as invariant of the outer loop.
+            add_target(sub.target)
+        elif isinstance(sub, ast.comprehension):
+            add_target(sub.target)
+        elif isinstance(sub, ast.withitem) and sub.optional_vars is not None:
+            add_target(sub.optional_vars)
+    return frozenset(names)
+
+
+@rule("JGL009", "loop-invariant batch re-staged inside a per-job loop")
+def duplicate_staging_in_loop(ctx: FileContext):
+    """``device_put``/``dispatch_safe``/``stage_for`` of a value the loop
+    never changes — the K-jobs duplicate-staging hazard: every iteration
+    (typically one per subscribed job) re-flattens/re-transfers identical
+    bytes over the host->device link, scaling the measured ingest
+    bottleneck by K. Stage once before the loop, or route consumers
+    through the per-stream DeviceEventCache (ADR 0110)."""
+    for loop in ast.walk(ctx.tree):
+        if not isinstance(loop, ast.For):
+            continue
+        varying = None  # computed lazily: most loops stage nothing
+        for node in ctx.walk_shallow(loop):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            qual = ctx.qualname(node.func)
+            name = (
+                node.func.id
+                if isinstance(node.func, ast.Name)
+                else getattr(node.func, "attr", None)
+            )
+            if qual not in _STAGING_QUALNAMES and name not in _STAGING_NAMES:
+                continue
+            if varying is None:
+                varying = _loop_varying_names(ctx, loop)
+            staged = node.args[0]
+            if _is_constant(staged) or ctx.mentions_any(staged, varying):
+                continue
+            label = qual or name
+            yield Finding(
+                ctx.path,
+                node.lineno,
+                "JGL009",
+                f"{label}() of a loop-invariant value inside a 'for' "
+                "loop re-stages identical bytes every iteration (K "
+                "subscribed jobs = K transfers of one batch); hoist the "
+                "staging above the loop or share it through the "
+                "per-stream DeviceEventCache (ADR 0110)",
             )
