@@ -516,6 +516,167 @@ def bench_multijob(args) -> None:
     )
 
 
+def bench_pipeline(args) -> dict:
+    """Pipelined vs serial ingest through the REAL JobManager path
+    (ADR 0111).
+
+    Feeds identical windows of staged events through (a) the serial
+    loop — prestage+step+publish back to back, paying sum(stages) — and
+    (b) the bounded IngestPipeline, where decode | prestage | step
+    overlap across windows. Reports per-stage utilization (stage busy
+    seconds / pipeline wall seconds), the slowest stage's mean, and
+    ``e2e_vs_max_stage`` — steady-state wall per batch over the slowest
+    single stage, the pipelining figure of merit (1.0 = perfect
+    overlap; the serial loop sits at sum/max). Ordering and output
+    parity of the two paths are asserted, so a regression in either is
+    loud here AND in --smoke/CI. One JSON line on stderr.
+    """
+    from esslivedata_tpu.config import JobId, WorkflowConfig, WorkflowSpec
+    from esslivedata_tpu.core.ingest_pipeline import IngestPipeline
+    from esslivedata_tpu.core.job_manager import JobFactory, JobManager
+    from esslivedata_tpu.core.timestamp import Timestamp
+    from esslivedata_tpu.ops import EventBatch
+    from esslivedata_tpu.preprocessors.event_data import StagedEvents
+    from esslivedata_tpu.workflows import WorkflowFactory
+    from esslivedata_tpu.workflows.detector_view import (
+        DetectorViewParams,
+        DetectorViewWorkflow,
+        project_logical,
+    )
+
+    side = int(np.sqrt(min(args.pixels, 1 << 16)))
+    det = np.arange(side * side).reshape(side, side)
+    n_events = args.events
+    n_windows = max(8, args.batches)
+    n_distinct = 4
+    batches = []
+    for s in range(n_distinct):
+        pid, toa = make_batch(n_events, side * side, seed=200 + s)
+        batches.append(EventBatch.from_arrays(pid, toa))
+
+    def staged(i: int) -> StagedEvents:
+        return StagedEvents(
+            batch=batches[i % n_distinct],
+            first_timestamp=None,
+            last_timestamp=None,
+            n_chunks=1,
+        )
+
+    method = args.method if args.method in ("scatter", "sort") else "scatter"
+
+    def make_mgr() -> JobManager:
+        reg = WorkflowFactory()
+        spec = WorkflowSpec(
+            instrument="bench", name="dv_pipe", source_names=["det0"]
+        )
+        reg.register_spec(spec).attach_factory(
+            lambda *, source_name, params: DetectorViewWorkflow(
+                projection=project_logical(det),
+                params=DetectorViewParams(histogram_method=method),
+            )
+        )
+        mgr = JobManager(job_factory=JobFactory(reg), job_threads=2)
+        for _ in range(2):  # K=2: exercises prestage + fused stepping
+            mgr.schedule_job(
+                WorkflowConfig(
+                    identifier=spec.identifier,
+                    job_id=JobId(source_name="det0"),
+                )
+            )
+        return mgr
+
+    t0, results_serial = Timestamp.from_ns(0), []
+    mgr_s = make_mgr()
+    mgr_s.process_jobs(
+        {"det0": staged(0)}, start=t0, end=Timestamp.from_ns(1)
+    )  # warm/compile
+    start = time.perf_counter()
+    for i in range(n_windows):
+        results_serial.append(
+            mgr_s.process_jobs(
+                {"det0": staged(i)}, start=t0, end=Timestamp.from_ns(2 + i)
+            )
+        )
+    serial_wall = time.perf_counter() - start
+    mgr_s.shutdown()
+
+    mgr_p = make_mgr()
+    published: list = []
+    pipe = IngestPipeline(
+        job_manager=mgr_p,
+        decode=lambda payload: (payload, {}, None),
+        publish=lambda results, end: published.append(results),
+        depth=2,
+        flatten_workers=2,
+        name="bench",
+    )
+    pipe.submit(
+        {"det0": staged(0)}, start=t0, end=Timestamp.from_ns(1)
+    )  # warm
+    assert pipe.flush(timeout=120), "pipeline warm-up did not drain"
+    pipe.stats()  # reset timers: compile cost stays out of utilization
+    published.clear()
+    start = time.perf_counter()
+    for i in range(n_windows):
+        pipe.submit(
+            {"det0": staged(i)}, start=t0, end=Timestamp.from_ns(2 + i)
+        )
+    assert pipe.flush(timeout=300), "pipeline did not drain"
+    pipelined_wall = time.perf_counter() - start
+    stats = pipe.stats()
+    pipe.stop(drain=True)
+    mgr_p.shutdown()
+
+    assert len(published) == n_windows, (
+        f"dropped batches: published {len(published)} of {n_windows}"
+    )
+    for w, (res_p, res_s) in enumerate(zip(published, results_serial)):
+        assert len(res_p) == len(res_s), f"window {w}: result count differs"
+        for rp, rs in zip(res_p, res_s):
+            for (kp, vp), (ks, vs) in zip(
+                rp.outputs.items(), rs.outputs.items()
+            ):
+                assert kp == ks
+                if not np.array_equal(
+                    np.asarray(vp.values), np.asarray(vs.values)
+                ):
+                    raise AssertionError(
+                        f"window {w} output {kp!r}: pipelined != serial"
+                    )
+
+    stage_mean_ms = {
+        name: entry["mean_ms"] for name, entry in stats["stages"].items()
+    }
+    max_stage_ms = max(stage_mean_ms.values()) if stage_mean_ms else 0.0
+    per_batch_ms = 1e3 * pipelined_wall / n_windows
+    line = {
+        "metric": "pipeline_ingest",
+        "unit": "events/s",
+        "value": n_events * n_windows / pipelined_wall,
+        "serial_events_per_sec": n_events * n_windows / serial_wall,
+        "pipelined_vs_serial_speedup": serial_wall / pipelined_wall,
+        "stage_mean_ms": {
+            k: round(v, 3) for k, v in stage_mean_ms.items()
+        },
+        "stage_utilization": {
+            k: round(v, 4) for k, v in stats["utilization"].items()
+        },
+        "per_batch_ms": round(per_batch_ms, 3),
+        # Steady-state wall per batch over the slowest stage: 1.0 is a
+        # perfect pipeline; the acceptance bound is <= 1.25 on the CPU
+        # control (sum-of-stages sits well above it).
+        "e2e_vs_max_stage": (
+            round(per_batch_ms / max_stage_ms, 4) if max_stage_ms else None
+        ),
+        "windows": n_windows,
+        "events_per_window": n_events,
+        "jobs": 2,
+        "parity": "bit-identical",
+    }
+    print(json.dumps(line), file=sys.stderr)
+    return line
+
+
 def bench_latency(args) -> None:
     """p99 ingest->publish latency through a real detector service.
 
@@ -927,6 +1088,7 @@ def run_benchmark(args, platform: str) -> dict:
         for section in (
             lambda: bench_secondary_configs(args, edges, batches, method),
             lambda: bench_multijob(args),
+            lambda: bench_pipeline(args),
             lambda: bench_latency(args),
         ):
             try:
@@ -1231,6 +1393,14 @@ def _parse_args():
         "the relay lock — don't race it against a graded TPU run)",
     )
     parser.add_argument(
+        "--pipeline",
+        action="store_true",
+        help="Run ONLY the pipelined-vs-serial ingest scenario "
+        "(ADR 0111) on the ambient backend and exit: stage overlap, "
+        "per-stage utilization, bit-identical parity (dev flag, like "
+        "--multijob; also runs under --all and --smoke)",
+    )
+    parser.add_argument(
         "--smoke",
         action="store_true",
         help="CI smoke: tiny CPU-pinned headline run; asserts the graded "
@@ -1263,10 +1433,21 @@ def _parse_args():
     parser.add_argument(
         "--probe-budget",
         type=float,
-        default=float(os.environ.get("BENCH_PROBE_BUDGET_S", 420.0)),
+        # LIVEDATA_PROBE_BUDGET_S is the supported knob (matches the
+        # LIVEDATA_* env surface every service uses); the legacy
+        # BENCH_PROBE_BUDGET_S name keeps working for the sampler
+        # scripts already deployed. CI smoke runs set it small so a
+        # relay that isn't there never costs 420 s of probing.
+        default=float(
+            os.environ.get(
+                "LIVEDATA_PROBE_BUDGET_S",
+                os.environ.get("BENCH_PROBE_BUDGET_S", 420.0),
+            )
+        ),
         help="Total seconds to keep re-probing a dead relay before "
-        "committing to the CPU fallback. The sampler passes a small "
-        "value; the driver's graded run keeps the persistent default.",
+        "committing to the CPU fallback (env: LIVEDATA_PROBE_BUDGET_S). "
+        "The sampler passes a small value; the driver's graded run "
+        "keeps the persistent default.",
     )
     parser.add_argument(
         "--lock-wait",
@@ -1299,11 +1480,34 @@ def _smoke_main(args) -> int:
     for name in ("decode", "flatten_partition", "transfer", "step", "publish"):
         if name not in stages:
             problems.append(f"missing stage {name!r}")
+    # Pipelined-ingest control (ADR 0111): tiny run through the real
+    # JobManager + IngestPipeline; the scenario itself asserts parity,
+    # ordering and drain, and this guards the report's structure — a
+    # hot-path regression in the pipeline fails CI loudly.
+    try:
+        pipe_line = bench_pipeline(args)
+    except Exception:
+        traceback.print_exc()
+        problems.append("pipeline scenario raised")
+    else:
+        for field in (
+            "value",
+            "pipelined_vs_serial_speedup",
+            "stage_utilization",
+            "e2e_vs_max_stage",
+        ):
+            if pipe_line.get(field) is None:
+                problems.append(f"pipeline line missing {field!r}")
+        if not pipe_line.get("value", 0) > 0:
+            problems.append("pipeline throughput non-positive")
     if problems:
         print("SMOKE FAIL: " + "; ".join(problems), file=sys.stderr)
         return 1
-    print("SMOKE OK: metric line parses, stage breakdown present",
-          file=sys.stderr)
+    print(
+        "SMOKE OK: metric line parses, stage breakdown present, "
+        "pipelined ingest drained with parity",
+        file=sys.stderr,
+    )
     return 0
 
 
@@ -1321,6 +1525,13 @@ def main() -> None:
         if args.batches is None:
             args.batches = 16
         bench_multijob(args)
+        sys.exit(0)
+    if args.pipeline:
+        if args.events is None:
+            args.events = 1 << 18
+        if args.batches is None:
+            args.batches = 16
+        bench_pipeline(args)
         sys.exit(0)
 
     # Fail-open on driver kill: if SIGTERM arrives mid-ladder, emit the

@@ -11,7 +11,7 @@ ownership: staging belongs to the *stream*, jobs consume device-resident
 arrays by reference — the same share-the-staged-input move inference
 serving stacks use to amortize transfer cost across consumers (ADR 0110).
 
-Lifecycle (all driven by ``JobManager.process_jobs``):
+Lifecycle (serial path, driven by ``JobManager.process_jobs``):
 
 - ``begin_window()`` opens a new window generation; per-stream
   :class:`StreamStageSlot` handles are attached to the window's
@@ -24,6 +24,13 @@ Lifecycle (all driven by ``JobManager.process_jobs``):
   a window (each window carries new events), which also makes job
   attach/detach trivially safe: a job added or removed between windows
   can never observe another generation's arrays.
+
+The pipelined ingest (``core/ingest_pipeline.py``, ADR 0111) overlaps
+windows — window i+1 prestages while window i still steps — so a single
+"current" generation is not enough there. ``new_generation()`` hands out
+an independent, caller-owned :class:`WindowGeneration` whose slots and
+lifetime the pipeline controls explicitly; the begin/end window pair
+above remains a thin wrapper over the cache-owned current generation.
 
 Keys must capture *everything* that changes the staged bytes: the
 staging flavor ("raw"/"flat"/"part"/"shard"), a caller-chosen
@@ -40,11 +47,20 @@ for the first transfer instead of duplicating it.
 
 from __future__ import annotations
 
+import logging
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Hashable
 
-__all__ = ["DeviceEventCache", "EventIngest", "StreamStageSlot"]
+__all__ = [
+    "DeviceEventCache",
+    "EventIngest",
+    "StreamStageSlot",
+    "WindowGeneration",
+]
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass(frozen=True)
@@ -126,6 +142,7 @@ class StreamStageSlot:
         if entry is None:  # closed slot: pure passthrough
             return stage()
         if owner:
+            t0 = time.perf_counter()
             try:
                 entry.value = stage()
             except BaseException as err:
@@ -139,7 +156,12 @@ class StreamStageSlot:
                 raise
             finally:
                 entry.event.set()
-            self._cache._record_miss(_staged_nbytes(entry.value))
+            # Real staging timings are the link monitor's only probe
+            # (ADR 0111): wall time of the flatten+dispatch against the
+            # bytes it moved, measured where the work actually happens.
+            self._cache._record_miss(
+                _staged_nbytes(entry.value), time.perf_counter() - t0
+            )
             return entry.value
         entry.event.wait()
         if entry.error is not None:
@@ -158,38 +180,81 @@ class StreamStageSlot:
             self._entries.clear()
 
 
-class DeviceEventCache:
-    """Per-stream stage-once cache for one service's event streams."""
+class WindowGeneration:
+    """One window's staging slots, as an explicit caller-owned handle.
 
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
+    The serial path never sees this class (the cache keeps a private
+    current generation behind ``begin_window``/``end_window``); the
+    pipelined ingest opens one generation per in-flight window and
+    closes it after that window's publish, so two overlapped windows
+    can never alias each other's staged arrays."""
+
+    __slots__ = ("_cache", "_slots", "_lock", "_closed")
+
+    def __init__(self, cache: DeviceEventCache) -> None:
+        self._cache = cache
         self._slots: dict[str, StreamStageSlot] = {}
-        # Cumulative stats since construction / last drain: the bench's
-        # wire_bytes_per_event and the 30 s metrics line read these.
-        # Leaf-level lock: _record_* run while a slot lock is held, so
-        # they must never reach back for the slots lock above.
-        self._stats_lock = threading.Lock()
-        self._hits = 0
-        self._misses = 0
-        self._bytes_staged = 0
-
-    # -- window lifecycle -------------------------------------------------
-    def begin_window(self) -> None:
-        """Open a new window generation: previous slots close (their
-        staged references drop) and fresh slots hand out on demand."""
-        with self._lock:
-            for slot in self._slots.values():
-                slot._close()
-            self._slots = {}
+        self._lock = threading.Lock()
+        self._closed = False
 
     def slot(self, stream: str) -> StreamStageSlot:
         with self._lock:
             try:
                 return self._slots[stream]
             except KeyError:
-                s = StreamStageSlot(self, stream)
+                s = StreamStageSlot(self._cache, stream)
+                if self._closed:
+                    # A slot requested after close degrades to the same
+                    # passthrough as a closed slot: never retain.
+                    s._close()
                 self._slots[stream] = s
                 return s
+
+    def close(self) -> None:
+        """Drop every staged reference; later consumers pass through."""
+        with self._lock:
+            self._closed = True
+            for slot in self._slots.values():
+                slot._close()
+            self._slots = {}
+
+
+class DeviceEventCache:
+    """Per-stream stage-once cache for one service's event streams."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._current = WindowGeneration(self)
+        # Cumulative stats since construction / last drain: the bench's
+        # wire_bytes_per_event and the 30 s metrics line read these.
+        # Leaf-level lock: _record_* run while a slot lock is held, so
+        # they must never reach back for the generation lock above.
+        self._stats_lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+        self._bytes_staged = 0
+        self._staging_s = 0.0
+        #: Optional core.link_monitor.LinkMonitor (duck-typed:
+        #: ``observe_staging(nbytes, seconds)``) fed from real staging
+        #: timings — the pipelined ingest attaches it (ADR 0111).
+        self.link_observer: Any = None
+
+    # -- window lifecycle -------------------------------------------------
+    def new_generation(self) -> WindowGeneration:
+        """An independent window generation the caller owns and closes —
+        the pipelined ingest's per-in-flight-window handle."""
+        return WindowGeneration(self)
+
+    def begin_window(self) -> None:
+        """Open a new window generation: previous slots close (their
+        staged references drop) and fresh slots hand out on demand."""
+        with self._lock:
+            self._current.close()
+            self._current = WindowGeneration(self)
+
+    def slot(self, stream: str) -> StreamStageSlot:
+        with self._lock:
+            return self._current.slot(stream)
 
     def end_window(self) -> None:
         """Drop every staged reference. Device memory frees once the last
@@ -205,23 +270,34 @@ class DeviceEventCache:
         self.begin_window()
 
     # -- stats ------------------------------------------------------------
-    def _record_miss(self, nbytes: int) -> None:
+    def _record_miss(self, nbytes: int, seconds: float = 0.0) -> None:
         with self._stats_lock:
             self._misses += 1
             self._bytes_staged += nbytes
+            self._staging_s += seconds
+        observer = self.link_observer
+        if observer is not None:
+            try:
+                observer.observe_staging(nbytes, seconds)
+            except Exception:
+                # The estimate is advisory; a broken observer must not
+                # take staging down — but it should be visible.
+                logger.debug("link observer failed", exc_info=True)
 
     def _record_hit(self) -> None:
         with self._stats_lock:
             self._hits += 1
 
     def stats(self) -> dict[str, int | float]:
-        """{hits, misses, bytes_staged, hit_rate} since the last drain."""
+        """{hits, misses, bytes_staged, staging_s, hit_rate} since the
+        last drain."""
         with self._stats_lock:
             total = self._hits + self._misses
             return {
                 "hits": self._hits,
                 "misses": self._misses,
                 "bytes_staged": self._bytes_staged,
+                "staging_s": self._staging_s,
                 "hit_rate": (self._hits / total) if total else 0.0,
             }
 
@@ -232,9 +308,11 @@ class DeviceEventCache:
                 "hits": self._hits,
                 "misses": self._misses,
                 "bytes_staged": self._bytes_staged,
+                "staging_s": self._staging_s,
                 "hit_rate": (self._hits / total) if total else 0.0,
             }
             self._hits = 0
             self._misses = 0
             self._bytes_staged = 0
+            self._staging_s = 0.0
         return out

@@ -1,0 +1,465 @@
+"""Pipelined host ingest executor: decode | prestage | step/publish.
+
+Why
+---
+PERF.md's stage table says steady-state throughput should be
+``max(stage)``, but the serial service loop pays ``sum(stages)``: only
+the device transfer overlaps compute (``dispatch_safe``'s async
+``device_put``), while ev44 accumulate/collect (decode), the host
+flatten/partition (~32 ms per 4M events — the measured host bound once
+pallas2d beats the 93M ev/s scatter ceiling) and the fused step/publish
+all run back to back on the one service-loop thread. This module turns
+the loop into a bounded three-stage pipeline (ADR 0111):
+
+- **decode** — ``MessagePreprocessor`` accumulate + collect, then the
+  window's staged events are *detached* (owned copies) so the service
+  thread can release and refill the staging buffers for the next batch
+  while this one is still in flight.
+- **stage** — a fresh cache generation is attached
+  (``JobManager.open_window``) and every subscribed consumer's wire is
+  prestaged (``prestage_window``: host flatten/partition — optionally
+  chunked over a thread pool — plus the async device transfer), warming
+  the stage-once slots the step stage will hit.
+- **step** — ``JobManager.process_jobs(prestaged=True)`` + publish, the
+  only stage that touches job state, in submission order.
+
+Ordering and parity
+-------------------
+One worker per stage and FIFO bounded queues give a strict global order:
+window i's step always precedes window i+1's step, and publishes leave
+in submission order (asserted: a reordering is a bug, not a mode). The
+work each stage runs is byte-for-byte the work the serial path runs —
+prestaging uses the same keys and staging functions ``step_batch``/
+``step_many`` would use, and per-state op order is unchanged — so
+outputs are bit-identical to serial ingest (pinned by
+tests/workflows/cache_parity_test.py).
+
+Backpressure and shutdown
+-------------------------
+Queues are bounded and every put/get carries a timeout (graftlint
+JGL010: an unbounded hand-off turns a slow stage into unbounded memory;
+a timeout-less block turns shutdown into a hang). ``submit`` blocks when
+the in-flight window count reaches the pipeline depth — a slow stage
+throttles the service thread, which the adaptive batcher then sees as
+processing time and answers with bigger windows. ``stop(drain=True)``
+refuses new work, drains every queued window through all stages (no
+drops, no reorders — pinned by tests/core/ingest_pipeline_test.py), and
+joins the workers. A worker failure latches the exception and re-raises
+it on the service thread at the next submit, preserving the serial
+loop's fail-fast supervisor contract (core/service.py).
+
+The pipeline depth adapts to the link (``core/link_monitor.py``): a
+degraded or high-RTT link runs deeper (keep the transfer stage fed), a
+healthy one shallower (latency).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ..utils.profiling import StageTimer
+from .link_monitor import LinkMonitor, LinkPolicy
+
+__all__ = ["IngestPipeline", "PipelineWindow"]
+
+logger = logging.getLogger(__name__)
+
+#: Worker poll tick: every blocking queue op times out at this interval
+#: to observe shutdown (JGL010 — no timeout-less blocking on threads
+#: that also dispatch jitted work).
+_TICK_S = 0.1
+
+
+@dataclass(slots=True)
+class PipelineWindow:
+    """One window moving through the stages."""
+
+    seq: int
+    payload: Any  # decode-stage input (MessageBatch or prebuilt window)
+    start: Any = None
+    end: Any = None
+    data: dict[str, Any] = field(default_factory=dict)
+    context: dict[str, Any] = field(default_factory=dict)
+    fresh_context: set[str] | None = None
+    generation: Any = None  # WindowGeneration, attached by the stage stage
+    policy: LinkPolicy | None = None
+    results: list = field(default_factory=list)
+    #: Wall seconds per stage for THIS window (the completion callback's
+    #: load signal: the slowest stage is the pipeline's service time).
+    stage_s: dict[str, float] = field(default_factory=dict)
+    t_submit: float = 0.0
+
+
+class IngestPipeline:
+    """Bounded multi-stage ingest executor (see module docstring).
+
+    Parameters
+    ----------
+    job_manager:
+        The service's JobManager; supplies ``open_window``,
+        ``prestage_window`` and ``process_jobs``.
+    decode:
+        ``decode(payload) -> (data, context, fresh_context)`` — the
+        processor's preprocess+collect+detach step. Receives the
+        submitted payload; ``None`` payloads (empty windows flushed for
+        finishing jobs) skip decode.
+    publish:
+        ``publish(results, end)`` — called from the step worker, in
+        submission order, only when results are nonempty.
+    on_complete:
+        Optional ``on_complete(window)`` called after publish with the
+        per-stage timings and the applied link policy (the processor
+        feeds the batcher and its metrics from this).
+    depth:
+        Base bound on in-flight windows (the link policy may raise it
+        up to ``max_depth``). Depth 1 degenerates to serial-with-threads.
+    max_depth:
+        Queue capacity and the ceiling for link-adaptive deepening.
+    flatten_workers:
+        >1 enables the chunked parallel host flatten in prestaging.
+    link_monitor:
+        Optional LinkMonitor; when present it is attached to the
+        JobManager's stage-once cache (bandwidth from real staging
+        timings), fed publish round-trip times, and consulted per
+        window for the wire/batch/depth policy.
+    """
+
+    def __init__(
+        self,
+        *,
+        job_manager,
+        decode: Callable[[Any], tuple[dict, dict, set[str] | None]],
+        publish: Callable[[list, Any], None],
+        on_complete: Callable[[PipelineWindow], None] | None = None,
+        depth: int = 2,
+        max_depth: int = 4,
+        flatten_workers: int = 0,
+        link_monitor: LinkMonitor | None = None,
+        name: str = "ingest",
+    ) -> None:
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self._job_manager = job_manager
+        self._decode = decode
+        self._publish = publish
+        self._on_complete = on_complete
+        self._base_depth = depth
+        self._max_depth = max(max_depth, depth)
+        self._link_monitor = link_monitor
+        if link_monitor is not None and hasattr(
+            job_manager, "set_link_observer"
+        ):
+            job_manager.set_link_observer(link_monitor)
+        self._flatten_pool = (
+            ThreadPoolExecutor(
+                max_workers=flatten_workers,
+                thread_name_prefix=f"{name}-flatten",
+            )
+            if flatten_workers > 1
+            else None
+        )
+        # Bounded stage hand-offs (JGL010): capacity = max depth; the
+        # real in-flight bound is the submit gate below, which follows
+        # the link policy between base and max depth.
+        self._decode_q: queue.Queue[PipelineWindow] = queue.Queue(
+            maxsize=self._max_depth
+        )
+        self._stage_q: queue.Queue[PipelineWindow] = queue.Queue(
+            maxsize=self._max_depth
+        )
+        self._step_q: queue.Queue[PipelineWindow] = queue.Queue(
+            maxsize=self._max_depth
+        )
+        self._inflight = 0
+        self._state_lock = threading.Condition()
+        self._seq = 0
+        self._last_completed_seq = -1
+        self._completed = 0
+        self._published = 0
+        self._accepting = True
+        self._stopped = threading.Event()
+        self._failure: BaseException | None = None
+        self._timer = StageTimer()
+        self._t_started = time.monotonic()
+        self.name = name
+        self._workers = [
+            threading.Thread(
+                target=self._guarded, args=(fn,), name=f"{name}-{label}",
+                daemon=True,
+            )
+            for label, fn in (
+                ("decode", self._decode_loop),
+                ("stage", self._stage_loop),
+                ("step", self._step_loop),
+            )
+        ]
+        for worker in self._workers:
+            worker.start()
+
+    # -- submission --------------------------------------------------------
+    @property
+    def depth(self) -> int:
+        """Current in-flight window bound: the link policy's depth,
+        clamped to this pipeline's ceiling. The monitor's neutral depth
+        is its ``base_depth`` — construct the two with the same base
+        (OrchestratingProcessor does) so a configured ``--pipeline-depth``
+        is honored verbatim until the link asks for more."""
+        if self._link_monitor is None:
+            return self._base_depth
+        return min(
+            self._max_depth, max(1, self._link_monitor.policy().depth)
+        )
+
+    def submit(self, payload, *, start=None, end=None) -> int:
+        """Enqueue one window; blocks while the pipeline is at depth
+        (backpressure — the caller's stall is the load signal). Returns
+        the window's sequence number. Raises a latched worker failure or
+        RuntimeError after ``stop()``."""
+        self._reraise_failure()
+        window = PipelineWindow(
+            seq=-1, payload=payload, start=start, end=end,
+            t_submit=time.monotonic(),
+        )
+        with self._state_lock:
+            while self._accepting and self._inflight >= self.depth:
+                self._state_lock.wait(timeout=_TICK_S)
+                self._reraise_failure()
+            if not self._accepting:
+                raise RuntimeError(f"pipeline {self.name} is stopped")
+            window.seq = self._seq
+            self._seq += 1
+            self._inflight += 1
+        if not self._put(self._decode_q, window):
+            self._reraise_failure()
+            raise RuntimeError(f"pipeline {self.name} is stopped")
+        return window.seq
+
+    def flush(self, timeout: float | None = None) -> bool:
+        """Wait until every submitted window has completed; True on
+        drained, False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._state_lock:
+            while self._inflight > 0:
+                self._reraise_failure()
+                remaining = (
+                    _TICK_S
+                    if deadline is None
+                    else min(_TICK_S, deadline - time.monotonic())
+                )
+                if remaining <= 0:
+                    return False
+                self._state_lock.wait(timeout=remaining)
+            return True
+
+    def stop(self, *, drain: bool = True, timeout: float = 30.0) -> bool:
+        """Refuse new submits, optionally drain all in-flight windows
+        through every stage (no drops, no reorders), stop the workers.
+        Returns True when the drain completed. Idempotent."""
+        with self._state_lock:
+            self._accepting = False
+            self._state_lock.notify_all()
+        drained = True
+        try:
+            if drain and self._failure is None:
+                drained = self.flush(timeout=timeout)
+                if not drained:
+                    logger.warning(
+                        "pipeline %s: drain timed out with %d windows in "
+                        "flight",
+                        self.name,
+                        self._inflight,
+                    )
+        finally:
+            # A failure latched mid-drain makes flush raise — the
+            # workers and the flatten pool must still be torn down, or
+            # every in-process restart leaks three polling threads.
+            self._stopped.set()
+            for worker in self._workers:
+                worker.join(timeout=5.0)
+            if self._flatten_pool is not None:
+                self._flatten_pool.shutdown(wait=False)
+        return drained
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def failure(self) -> BaseException | None:
+        return self._failure
+
+    def stats(self) -> dict[str, Any]:
+        """Per-stage busy time + utilization since the last drain.
+
+        ``utilization`` is stage busy seconds over pipeline wall
+        seconds: the slowest stage's utilization approaches 1.0 at
+        steady state, and the *sum* exceeding 1.0 is the overlap the
+        serial loop forfeits (bench.py --pipeline reports this)."""
+        wall = max(time.monotonic() - self._t_started, 1e-9)
+        stages = self._timer.drain()
+        self._t_started = time.monotonic()
+        with self._state_lock:
+            completed, published = self._completed, self._published
+            inflight = self._inflight
+        return {
+            "wall_s": wall,
+            "completed": completed,
+            "published": published,
+            "inflight": inflight,
+            "depth": self.depth,
+            "stages": stages,
+            "utilization": {
+                stage: entry["total_s"] / wall
+                for stage, entry in stages.items()
+            },
+        }
+
+    # -- stage workers -----------------------------------------------------
+    def _guarded(self, loop: Callable[[], None]) -> None:
+        try:
+            loop()
+        except BaseException as err:  # latch: resurfaced on submit
+            logger.exception("pipeline %s worker failed", self.name)
+            with self._state_lock:
+                self._failure = err
+                self._state_lock.notify_all()
+
+    def _reraise_failure(self) -> None:
+        if self._failure is not None:
+            raise RuntimeError(
+                f"pipeline {self.name} worker failed"
+            ) from self._failure
+
+    def _put(self, q: queue.Queue, window: PipelineWindow) -> bool:
+        """Bounded hand-off to the next stage. False = the pipeline was
+        stopped without drain; the caller discards the window."""
+        while not self._stopped.is_set():
+            try:
+                q.put(window, timeout=_TICK_S)
+                return True
+            except queue.Full:
+                if self._failure is not None:
+                    break
+        self._discard(window)
+        return False
+
+    def _discard(self, window: PipelineWindow) -> None:
+        """Account for a window abandoned by a no-drain stop."""
+        if window.generation is not None:
+            window.generation.close()
+        with self._state_lock:
+            self._inflight -= 1
+            self._state_lock.notify_all()
+
+    def _get(self, q: queue.Queue) -> PipelineWindow | None:
+        while not self._stopped.is_set():
+            try:
+                return q.get(timeout=_TICK_S)
+            except queue.Empty:
+                continue
+        return None
+
+    def _decode_loop(self) -> None:
+        while True:
+            window = self._get(self._decode_q)
+            if window is None:
+                return
+            t0 = time.perf_counter()
+            with self._timer.stage("decode"):
+                if window.payload is None:
+                    window.data, window.context = {}, {}
+                    window.fresh_context = None
+                else:
+                    (
+                        window.data,
+                        window.context,
+                        window.fresh_context,
+                    ) = self._decode(window.payload)
+                    window.payload = None  # drop message refs early
+            window.stage_s["decode"] = time.perf_counter() - t0
+            if not self._put(self._stage_q, window):
+                return
+
+    def _stage_loop(self) -> None:
+        while True:
+            window = self._get(self._stage_q)
+            if window is None:
+                return
+            t0 = time.perf_counter()
+            with self._timer.stage("stage"):
+                window.generation = self._job_manager.open_window(window.data)
+                if self._link_monitor is not None:
+                    window.policy = self._link_monitor.policy()
+                # Wire flips re-key staging — safe against the window
+                # currently mid-step because every staging pass
+                # snapshots the flag once, key and payload together
+                # (EventHistogrammer._staged_partition); the worst case
+                # at a flip boundary is one private re-stage, and flips
+                # are rare by construction (the policy latch has a
+                # hysteresis dead zone).
+                self._job_manager.prestage_window(
+                    window.data,
+                    pool=self._flatten_pool,
+                    wire_compact=(
+                        None
+                        if window.policy is None
+                        else window.policy.compact_wire
+                    ),
+                )
+            window.stage_s["stage"] = time.perf_counter() - t0
+            if not self._put(self._step_q, window):
+                return
+
+    def _step_loop(self) -> None:
+        while True:
+            window = self._get(self._step_q)
+            if window is None:
+                return
+            try:
+                t0 = time.perf_counter()
+                with self._timer.stage("step"):
+                    window.results = self._job_manager.process_jobs(
+                        window.data,
+                        context=window.context,
+                        fresh_context=window.fresh_context,
+                        start=window.start,
+                        end=window.end,
+                        prestaged=True,
+                    )
+                window.stage_s["step"] = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                with self._timer.stage("publish"):
+                    if window.results:
+                        self._publish(window.results, window.end)
+                dt_publish = time.perf_counter() - t0
+                window.stage_s["publish"] = dt_publish
+                if self._link_monitor is not None and window.results:
+                    self._link_monitor.observe_publish(dt_publish)
+            finally:
+                if window.generation is not None:
+                    window.generation.close()
+            if window.seq != self._last_completed_seq + 1:
+                # Single-worker FIFO stages make this structurally
+                # impossible; if it ever fires, ordering — a correctness
+                # guarantee consumers rely on — broke. Fail loudly.
+                raise RuntimeError(
+                    f"pipeline {self.name} reordered windows: completed "
+                    f"{window.seq} after {self._last_completed_seq}"
+                )
+            self._last_completed_seq = window.seq
+            if self._on_complete is not None:
+                try:
+                    self._on_complete(window)
+                except Exception:
+                    logger.exception(
+                        "pipeline %s completion callback failed", self.name
+                    )
+            with self._state_lock:
+                self._inflight -= 1
+                self._completed += 1
+                if window.results:
+                    self._published += 1
+                self._state_lock.notify_all()

@@ -401,6 +401,122 @@ class JobManager:
                 graduated.add(job_id)
         return graduated
 
+    # -- pipelined ingest (core/ingest_pipeline.py, ADR 0111) --------------
+    def set_link_observer(self, observer) -> None:
+        """Attach a LinkMonitor to the stage-once cache: every staging
+        miss reports (bytes, wall seconds) — the pipeline's bandwidth
+        estimate comes from real work, never probes."""
+        self._event_cache.link_observer = observer
+
+    def open_window(self, data: Mapping[str, Any]):
+        """Attach a fresh, caller-owned cache generation to this window's
+        staged event values and return it.
+
+        The pipelined ingest overlaps windows, so each in-flight window
+        gets its own generation (window i+1 prestages while window i
+        steps); the caller closes it after the window's publish. The
+        serial path never calls this — ``process_jobs`` manages the
+        cache-owned current generation itself.
+        """
+        generation = self._event_cache.new_generation()
+        for name, value in data.items():
+            if isinstance(value, StagedEvents):
+                value.cache = generation.slot(name)
+        return generation
+
+    def prestage_window(
+        self,
+        data: Mapping[str, Any],
+        *,
+        pool=None,
+        wire_compact: bool | None = None,
+    ) -> None:
+        """Warm the window's stream slots ahead of the job fan-out.
+
+        Runs on the pipeline's stage worker: for every event stream, ask
+        each subscribed active job's workflow for its ingest offer (the
+        same duck-typed ``event_ingest`` the fused-stepping planner uses
+        — offers are side-effect free) and run the offered histogrammer's
+        staging into the window's slot. When the step stage later runs
+        ``process_jobs``, workflows hit the warm slot and the host
+        flatten/partition + transfer cost has already overlapped the
+        previous window's step. Offers sharing a key stage once; streams
+        without offers (workflows with no ``event_ingest``) simply stage
+        at step time — prestaging is an overlap optimization, never a
+        correctness dependency. Failures are contained per offer: the
+        slot drops a poisoned entry, so the step stage retries privately.
+
+        ``wire_compact`` (link policy, ADR 0108) applies the int32 vs
+        uint16 partitioned-wire selection to each offered histogrammer
+        before staging, so the whole window stages in one format.
+        """
+        with self._lock:
+            # ACTIVE jobs, plus SCHEDULED ones with no start gate: the
+            # phase machine activates those on this very window (data
+            # time always reaches a None start), so their staging is
+            # needed — skipping them would cold-start every first
+            # window. Time- or context-gated jobs stay out: their
+            # activation depends on data the stage worker doesn't have,
+            # and a wrong guess is a wasted transfer.
+            records = [
+                rec
+                for rec in self._records.values()
+                if not rec.needs_reset
+                and (
+                    rec.phase == _Phase.ACTIVE
+                    or (
+                        rec.phase == _Phase.SCHEDULED
+                        and rec.job.schedule.start is None
+                        and not rec.job.context_keys
+                    )
+                )
+            ]
+        staged_keys: set[tuple] = set()
+        for name, value in data.items():
+            if not isinstance(value, StagedEvents) or value.cache is None:
+                continue
+            for rec in records:
+                if name not in rec.job.subscribed_streams:
+                    continue
+                ingest_fn = getattr(rec.job.workflow, "event_ingest", None)
+                if ingest_fn is None:
+                    continue
+                try:
+                    offer = ingest_fn(name, value)
+                except Exception:
+                    logger.exception(
+                        "event_ingest failed during prestage for %s",
+                        rec.job.job_id,
+                    )
+                    continue
+                if offer is None:
+                    continue
+                stage = getattr(offer.hist, "stage_events", None)
+                if stage is None:
+                    continue
+                if wire_compact is not None:
+                    set_wire = getattr(offer.hist, "set_wire_format", None)
+                    if set_wire is not None:
+                        set_wire(wire_compact)
+                key = (name, offer.key)
+                if key in staged_keys:
+                    continue
+                staged_keys.add(key)
+                try:
+                    stage(
+                        offer.batch,
+                        value.cache,
+                        batch_tag=offer.batch_tag,
+                        pool=pool,
+                    )
+                except Exception:
+                    logger.exception(
+                        "Prestage failed for stream %r (job %s); "
+                        "step-time staging will retry",
+                        name,
+                        rec.job.job_id,
+                    )
+
     def peek_pending_streams(self) -> set[str]:
         """Context streams still gating some job (the processor uses this
         to know which context to enrich; reference :503)."""
@@ -421,9 +537,17 @@ class JobManager:
         fresh_context: set[str] | None = None,
         start: Timestamp | None = None,
         end: Timestamp | None = None,
+        prestaged: bool = False,
     ) -> list[JobResult]:
         """One window: fire due resets, advance phases, open gates, fan
         per-job add+finalize over the thread pool, contain per-job errors.
+
+        ``prestaged`` marks a window whose staged-events values already
+        carry slots from a caller-owned cache generation (the pipelined
+        ingest: ``open_window`` + ``prestage_window`` ran on a stage
+        worker). The cache-owned window lifecycle is skipped — the
+        pipeline closes its generation after the window's publish, so an
+        overlapped next window can never drop this one's staged arrays.
 
         ``fresh_context`` names the context streams that received data in
         THIS batch; active jobs get ``set_context`` only for those, so an
@@ -438,14 +562,16 @@ class JobManager:
         """
         context = context or {}
         with self._lock:
-            # New window generation: previous staged slots drop, and this
-            # window's event batches get stream slots so every consumer —
-            # workflow-private stepping and the fused layer alike — stages
-            # each batch once per (stream, layout).
-            self._event_cache.begin_window()
-            for name, value in data.items():
-                if isinstance(value, StagedEvents):
-                    value.cache = self._event_cache.slot(name)
+            if not prestaged:
+                # New window generation: previous staged slots drop, and
+                # this window's event batches get stream slots so every
+                # consumer — workflow-private stepping and the fused
+                # layer alike — stages each batch once per (stream,
+                # layout).
+                self._event_cache.begin_window()
+                for name, value in data.items():
+                    if isinstance(value, StagedEvents):
+                        value.cache = self._event_cache.slot(name)
             if end is not None:
                 self._fire_pending_resets(end)
                 self._advance_to_time(end)
@@ -573,10 +699,13 @@ class JobManager:
                     # device-resident accumulator now instead of pinning
                     # it until an operator removes the stopped record.
                     rec.job.release()
-        # Drop this window's staged references: device memory frees once
-        # the last in-flight kernel completes, and next window's batches
-        # can never alias a stale generation.
-        self._event_cache.end_window()
+        if not prestaged:
+            # Drop this window's staged references: device memory frees
+            # once the last in-flight kernel completes, and next window's
+            # batches can never alias a stale generation. (Pipelined
+            # windows: the pipeline closes its own generation after the
+            # publish instead.)
+            self._event_cache.end_window()
         return [r for r in results if r is not None]
 
     def _plan_fused_steps(

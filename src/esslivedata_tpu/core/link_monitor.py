@@ -1,0 +1,196 @@
+"""Link monitor: EWMA bandwidth/RTT estimates driving ingest adaptation.
+
+The host→device link behind the network relay is the measured, binding
+and *volatile* constraint of the whole ingest tier: PERF.md records an
+~8× bandwidth swing between hours (2.36e8 ev/s on a healthy relay vs
+2.0–3.0e7 link-bound) with identical kernels and batch sizes. A fixed
+batch size and wire format are therefore tuned for exactly one of those
+regimes and wrong in the other. This module closes the loop (ADR 0111):
+
+- **Estimation costs nothing on the hot path.** There are no probes.
+  Bandwidth observations are the wall time of real staging work
+  (``DeviceEventCache`` times each stage-once miss and reports the bytes
+  it moved); RTT observations are the wall time of real publishes (one
+  execute + one fetch = one device round trip, ``ops/publish.py``).
+  Both fold into exponentially weighted moving averages under a lock —
+  observations arrive from stage workers, publish timings from the step
+  worker, and the 30 s metrics reader from the service thread.
+
+  The bandwidth estimate is *effective ingest throughput* — host
+  flatten + transfer, the number the policy must react to — not a pure
+  wire measurement. On a host-bound day it saturates at the flatten
+  rate, which is exactly when batch scaling stops helping; the policy
+  thresholds are set against the transfer-bound regime where adaptation
+  pays.
+
+- **Policy with hysteresis.** :meth:`policy` maps the estimates to a
+  :class:`LinkPolicy`:
+
+  (a) ``window_scale`` — the batch-size target multiplier fed to the
+      batcher (``RateAwareMessageBatcher.set_window`` when available;
+      the adaptive batcher reacts through ``report_processing_time``
+      backpressure either way). A degraded link amortizes per-batch
+      fixed costs (dispatch, publish round trip) over more events —
+      trading batch latency for link efficiency; a healthy link opens
+      the throttle back to the base window.
+  (b) ``compact_wire`` — the uint16 partitioned wire (ADR 0108):
+      2 B/event instead of 4 doubles the link-bound ceiling. ``True``
+      *forces* compact on every eligible histogrammer during prestage
+      (``EventHistogrammer.set_wire_format``); ``None`` — the healthy
+      state — leaves each histogrammer's construction-time default
+      untouched (ADR 0108 already picks compact wherever offsets fit;
+      the policy must never silently revert that to the wide wire).
+  (c) ``depth`` — in-flight window bound for the pipeline
+      (``core/ingest_pipeline.py``): a degraded or high-RTT link wants
+      more windows in flight to keep the transfer stage fed; a healthy
+      link wants the shallow bound for latency.
+
+  The degraded latch flips on below ``degraded_bandwidth_bps`` and off
+  only above ``recover_factor`` times that — the dead zone prevents the
+  policy from flapping across a noisy threshold, the same shape as
+  ``LoadGovernor``'s escalate/relax bands.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from dataclasses import dataclass
+
+__all__ = ["LinkMonitor", "LinkPolicy"]
+
+
+@dataclass(frozen=True, slots=True)
+class LinkPolicy:
+    """One consistent adaptation decision (see module docstring)."""
+
+    #: Multiplier on the batcher's base window (>= 1.0).
+    window_scale: float
+    #: True = force the uint16 compact partitioned wire (ADR 0108);
+    #: None = leave each histogrammer's construction default untouched.
+    compact_wire: bool | None
+    #: In-flight window bound for the ingest pipeline.
+    depth: int
+
+
+class LinkMonitor:
+    """Thread-safe EWMA link estimator + adaptation policy."""
+
+    def __init__(
+        self,
+        *,
+        target_bandwidth_bps: float = 4.0e8,
+        degraded_bandwidth_bps: float = 1.5e8,
+        recover_factor: float = 2.0,
+        rtt_deep_s: float = 0.03,
+        alpha: float = 0.25,
+        max_window_scale: float = 8.0,
+        base_depth: int = 2,
+        max_depth: int = 4,
+    ) -> None:
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError("alpha must be in (0, 1]")
+        if recover_factor < 1.0:
+            raise ValueError("recover_factor must be >= 1.0")
+        #: 4e8 B/s is the bandwidth that sustains the 1e8 ev/s target at
+        #: the 4 B/event flat wire (PERF.md) — at or above it there is
+        #: nothing to adapt.
+        self._target = float(target_bandwidth_bps)
+        self._degraded = float(degraded_bandwidth_bps)
+        self._recover = float(degraded_bandwidth_bps) * float(recover_factor)
+        self._rtt_deep = float(rtt_deep_s)
+        self._alpha = float(alpha)
+        self._max_scale = float(max_window_scale)
+        self._base_depth = int(base_depth)
+        self._max_depth = max(int(max_depth), int(base_depth))
+        self._lock = threading.Lock()
+        self._bw_bps: float | None = None
+        self._rtt_s: float | None = None
+        self._degraded_latch = False
+        self._n_staging = 0
+        self._n_publish = 0
+        self._bytes_observed = 0
+
+    # -- observations ------------------------------------------------------
+    def observe_staging(self, nbytes: int, seconds: float) -> None:
+        """Fold one staging event (bytes moved over wall seconds) in."""
+        if nbytes <= 0 or seconds <= 0.0:
+            return
+        sample = nbytes / seconds
+        with self._lock:
+            self._n_staging += 1
+            self._bytes_observed += int(nbytes)
+            self._bw_bps = (
+                sample
+                if self._bw_bps is None
+                else self._alpha * sample + (1.0 - self._alpha) * self._bw_bps
+            )
+
+    def observe_publish(self, seconds: float) -> None:
+        """Fold one publish round trip's wall time in."""
+        if seconds <= 0.0:
+            return
+        with self._lock:
+            self._n_publish += 1
+            self._rtt_s = (
+                seconds
+                if self._rtt_s is None
+                else self._alpha * seconds + (1.0 - self._alpha) * self._rtt_s
+            )
+
+    # -- estimates ---------------------------------------------------------
+    def bandwidth_bps(self) -> float | None:
+        with self._lock:
+            return self._bw_bps
+
+    def rtt_s(self) -> float | None:
+        with self._lock:
+            return self._rtt_s
+
+    # -- policy ------------------------------------------------------------
+    def policy(self) -> LinkPolicy:
+        """The current adaptation decision; neutral until the first
+        staging observation converges the bandwidth estimate."""
+        with self._lock:
+            bw = self._bw_bps
+            rtt = self._rtt_s
+            if bw is None:
+                return LinkPolicy(
+                    window_scale=1.0,
+                    compact_wire=None,
+                    depth=self._base_depth,
+                )
+            if self._degraded_latch:
+                if bw >= self._recover:
+                    self._degraded_latch = False
+            elif bw < self._degraded:
+                self._degraded_latch = True
+            degraded = self._degraded_latch
+            # Continuous target quantized to sqrt(2) steps: the batcher
+            # regates streams on every window change, so a smoothly
+            # drifting estimate must not retarget every batch.
+            raw = min(self._max_scale, max(1.0, self._target / bw))
+            step = round(math.log(raw, math.sqrt(2.0)))
+            scale = min(self._max_scale, max(1.0, math.sqrt(2.0) ** step))
+            deep = degraded or (rtt is not None and rtt > self._rtt_deep)
+            return LinkPolicy(
+                window_scale=scale,
+                compact_wire=True if degraded else None,
+                depth=self._max_depth if deep else self._base_depth,
+            )
+
+    def stats(self) -> dict[str, float | int | bool | None]:
+        """Snapshot for the 30 s metrics line."""
+        policy = self.policy()
+        with self._lock:
+            return {
+                "bandwidth_bps": self._bw_bps,
+                "rtt_s": self._rtt_s,
+                "n_staging": self._n_staging,
+                "n_publish": self._n_publish,
+                "bytes_observed": self._bytes_observed,
+                "degraded": self._degraded_latch,
+                "window_scale": policy.window_scale,
+                "compact_wire": policy.compact_wire,
+                "depth": policy.depth,
+            }

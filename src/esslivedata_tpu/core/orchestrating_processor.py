@@ -13,6 +13,7 @@ implicit load profiler.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from collections.abc import Iterable
 from typing import Any
@@ -70,6 +71,12 @@ class MessagePreprocessor:
         self._touched: set[StreamId] = set()
         self._dropped_streams: set[StreamId] = set()
         self.message_counts: dict[str, int] = {}
+        # Pipelined ingest moves preprocess onto the decode worker while
+        # the service thread keeps reading the counts for heartbeats —
+        # the increment is a read-modify-write and the status snapshot
+        # iterates the dict, so both sides take this lock (uncontended
+        # acquisition is tens of ns against the >= 71 ms window).
+        self._counts_lock = threading.Lock()
 
     def _get(self, stream: StreamId):
         if stream in self._accumulators:
@@ -94,9 +101,10 @@ class MessagePreprocessor:
                 logger.exception("Accumulator failed for %s", msg.stream)
                 continue
             self._touched.add(msg.stream)
-            self.message_counts[msg.stream.name] = (
-                self.message_counts.get(msg.stream.name, 0) + 1
-            )
+            with self._counts_lock:
+                self.message_counts[msg.stream.name] = (
+                    self.message_counts.get(msg.stream.name, 0) + 1
+                )
 
     def collect_window(self) -> dict[str, Any]:
         """Primary (non-context) data accumulated since last collect."""
@@ -152,6 +160,12 @@ class MessagePreprocessor:
                 out.add(stream.name)
         return out
 
+    def snapshot_counts(self) -> dict[str, int]:
+        """Copy of the per-stream message counts, safe against the
+        decode worker's concurrent increments."""
+        with self._counts_lock:
+            return dict(self.message_counts)
+
     def release(self) -> None:
         for stream in self._touched:
             self._accumulators[stream].release_buffers()
@@ -176,6 +190,10 @@ class OrchestratingProcessor:
         stream_counter=None,
         clock=time.monotonic,
         heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S,
+        pipelined: bool = False,
+        pipeline_depth: int = 2,
+        flatten_threads: int = 0,
+        link_monitor=None,
     ) -> None:
         self._source = source
         self._sink = sink
@@ -204,6 +222,37 @@ class OrchestratingProcessor:
         from ..utils.profiling import StageTimer
 
         self.stage_timer = StageTimer()
+        # Pipelined ingest (ADR 0111): decode | prestage | step/publish
+        # overlap across successive windows instead of summing on this
+        # thread. The link policy produced on the step worker is applied
+        # to the batcher HERE on the service thread (batcher mutable
+        # state is single-thread-owned by contract).
+        self._pipeline = None
+        self._link_monitor = None
+        self._pending_policy = None
+        self._applied_window_scale = 1.0
+        self._base_window = getattr(batcher, "window", None)
+        if pipelined:
+            from .ingest_pipeline import IngestPipeline
+            from .link_monitor import LinkMonitor
+
+            # The monitor's neutral depth IS the configured pipeline
+            # depth — otherwise a --pipeline-depth below the monitor's
+            # default would be silently deepened on healthy links.
+            self._link_monitor = link_monitor or LinkMonitor(
+                base_depth=pipeline_depth,
+                max_depth=max(4, pipeline_depth),
+            )
+            self._pipeline = IngestPipeline(
+                job_manager=job_manager,
+                decode=self._decode_window,
+                publish=self._publish_results,
+                on_complete=self._on_window_complete,
+                depth=pipeline_depth,
+                flatten_workers=flatten_threads,
+                link_monitor=self._link_monitor,
+                name=f"{service_name}-ingest",
+            )
 
     # -- cycle ------------------------------------------------------------
     def process(self) -> None:
@@ -223,7 +272,14 @@ class OrchestratingProcessor:
         batch = self._batcher.batch(data)
         if batch is not None:
             t0 = self._clock()
-            self._process_batch(batch)
+            if self._pipeline is not None:
+                self._submit_batch(batch)
+            else:
+                self._process_batch(batch)
+            # Pipelined: the duration is decode+submit, where submit
+            # blocks while the pipeline is at depth — backpressure from
+            # a slow stage reaches the adaptive batcher as load through
+            # the exact same channel serial processing time does.
             self._batcher.report_processing_time(
                 Duration.from_s(self._clock() - t0)
             )
@@ -233,9 +289,18 @@ class OrchestratingProcessor:
             # accumulation and leave the active set (otherwise a job
             # stopped during a beam-off period stays 'finishing'
             # forever and its delisting heartbeat never happens).
-            results = self._job_manager.process_jobs({})
-            if results:
-                self._publish_results(results, Timestamp.now())
+            if self._pipeline is not None:
+                # Through the pipeline, so the flush cannot overtake an
+                # in-flight window and publishes stay ordered. end=None
+                # keeps the serial semantics: no data time advances, and
+                # the publish (if any) stamps wall time at publish.
+                self._pipeline.submit(None)
+            else:
+                results = self._job_manager.process_jobs({})
+                if results:
+                    self._publish_results(results, Timestamp.now())
+        if self._pipeline is not None:
+            self._apply_link_policy()
 
         now = self._clock()
         if now - self._last_heartbeat >= self._heartbeat_interval_s:
@@ -244,6 +309,83 @@ class OrchestratingProcessor:
         if now - self._last_metrics >= METRICS_INTERVAL_S:
             self._last_metrics = now
             self._log_metrics()
+
+    # -- pipelined ingest (ADR 0111) ---------------------------------------
+    @property
+    def stop_grace_s(self) -> float:
+        """How long a stop should wait for finalize (core/service.py
+        reads this): pipelined processors drain in-flight windows
+        before the stopped statuses go out — worst case the pipeline's
+        30 s drain timeout plus three 5 s worker joins, with headroom
+        for the status publish."""
+        return 50.0 if self._pipeline is not None else 5.0
+
+    def _submit_batch(self, batch) -> None:
+        """Hand one closed batch to the pipeline; blocks at depth."""
+        self._last_batch_len = len(batch.messages)
+        self._record_lag(batch)
+        self._pipeline.submit(batch, start=batch.start, end=batch.end)
+
+    def _decode_window(self, batch):
+        """Decode stage (pipeline decode worker): accumulate + collect,
+        then detach the window so the NEXT batch's preprocess — on this
+        same worker — reuses the accumulators' buffers while the
+        detached window travels on. Staged events copy their arrays
+        (``StagedEvents.detach``); DataArray values copy too, because
+        some accumulators hand out live views into growable buffers
+        (``ToNXlog.get`` sorts its prefix in place on the next collect —
+        a window still in flight must not see that mutation)."""
+        self._preprocessor.preprocess(batch.messages)
+        window = self._preprocessor.collect_window()
+        context = self._preprocessor.collect_context()
+        fresh_context = self._preprocessor.fresh_context_names()
+        from ..preprocessors.event_data import StagedEvents
+
+        def detach(value):
+            if isinstance(value, StagedEvents):
+                return value.detach()
+            copy = getattr(value, "copy", None)
+            return copy() if callable(copy) else value
+
+        data = {name: detach(value) for name, value in window.items()}
+        context = {name: detach(value) for name, value in context.items()}
+        self._preprocessor.release()
+        return data, context, fresh_context
+
+    def _on_window_complete(self, window) -> None:
+        """Step-worker callback: fold the window's stage timings into
+        the metrics timer and queue the link policy for the service
+        thread (batcher state is single-thread-owned by contract, so it
+        is never touched from here)."""
+        for stage, seconds in window.stage_s.items():
+            self.stage_timer.record(stage, seconds)
+        if window.policy is not None:
+            self._pending_policy = window.policy
+
+    def _apply_link_policy(self) -> None:
+        """Service thread: retarget the batcher window per link policy.
+
+        Only batchers exposing ``set_window`` (rate-aware) retarget
+        explicitly; the adaptive batcher already reacts to the same
+        degradation through ``report_processing_time`` backpressure."""
+        policy, self._pending_policy = self._pending_policy, None
+        if policy is None or self._base_window is None:
+            return
+        if policy.window_scale == self._applied_window_scale:
+            return
+        set_window = getattr(self._batcher, "set_window", None)
+        if set_window is None:
+            return
+        set_window(
+            Duration(max(1, round(self._base_window.ns * policy.window_scale)))
+        )
+        self._applied_window_scale = policy.window_scale
+        logger.info(
+            "link policy: window_scale=%.2f compact_wire=%s depth=%d",
+            policy.window_scale,
+            policy.compact_wire,
+            policy.depth,
+        )
 
     def _process_batch(self, batch) -> None:
         self._last_batch_len = len(batch.messages)
@@ -307,8 +449,11 @@ class OrchestratingProcessor:
 
     # -- publishing -------------------------------------------------------
     def _publish_results(
-        self, results: list[JobResult], timestamp: Timestamp
+        self, results: list[JobResult], timestamp: Timestamp | None
     ) -> None:
+        if timestamp is None:
+            # Empty-window flushes carry no data time (pipelined path).
+            timestamp = Timestamp.now()
         messages: list[Message] = []
         for result in results:
             for key, da in zip(result.keys(), result.outputs.values(), strict=True):
@@ -349,7 +494,7 @@ class OrchestratingProcessor:
             state=state,
             jobs=self._job_manager.job_statuses(),
             last_batch_message_count=self._last_batch_len,
-            stream_message_counts=dict(self._preprocessor.message_counts),
+            stream_message_counts=self._preprocessor.snapshot_counts(),
             uptime_s=self._clock() - self._start_wall,
             lag_level=(report := self._current_lag_report()).worst_level,
             # The badge number must describe the lag that SET the level,
@@ -410,7 +555,7 @@ class OrchestratingProcessor:
         extra = {
             "service": self._service_name,
             "jobs": self._job_manager.n_jobs,
-            "stream_counts": dict(self._preprocessor.message_counts),
+            "stream_counts": self._preprocessor.snapshot_counts(),
             "lag_level": self._current_lag_report().worst_level,
         }
         # Stage-once cache counters (ADR 0110). The engagement signal is
@@ -446,6 +591,10 @@ class OrchestratingProcessor:
                 extra["producer_lag_level"] = lag_report.worst_level
         if stages := self.stage_timer.drain():
             extra["stages"] = stages
+        if self._pipeline is not None:
+            extra["pipeline"] = self._pipeline.stats()
+        if self._link_monitor is not None:
+            extra["link"] = self._link_monitor.stats()
         logger.info("processor_metrics", extra=extra)
 
     def finalize(self) -> None:
@@ -453,6 +602,14 @@ class OrchestratingProcessor:
         if self._finalized:
             return
         self._finalized = True
+        if self._pipeline is not None:
+            # Drain first: every accepted window flushes through step and
+            # publish before the stopped statuses go out — a service stop
+            # must not drop or reorder in-flight batches.
+            try:
+                self._pipeline.stop(drain=True)
+            except Exception:
+                logger.exception("Ingest pipeline drain failed")
         try:
             self._publish_status(state="stopped")
         except Exception:

@@ -266,5 +266,11 @@ class Service(ServiceBase):
 
     def _stop_impl(self) -> None:
         if self._thread is not None:
-            self._thread.join(timeout=5.0)
+            # Processors advertise how long their finalize may take
+            # (a pipelined processor drains in-flight windows, ADR 0111:
+            # no dropped batches on stop); default to the historical 5 s.
+            timeout = float(
+                getattr(self._processor, "stop_grace_s", 5.0)
+            )
+            self._thread.join(timeout=timeout)
             self._thread = None

@@ -130,10 +130,21 @@ _LIBRDKAFKA_KEYS = {
     "ssl_ca_location": "ssl.ca.location",
 }
 
-#: App-level tuning keys (consumed by the source layer, not librdkafka) that
-#: may legitimately sit in the same loader config dicts.
+#: App-level tuning keys (consumed by the source/ingest layers, not
+#: librdkafka) that may legitimately sit in the same loader config dicts:
+#: the source's batch/queue sizes plus the pipelined-ingest hand-off
+#: knobs (ADR 0111 — pipeline on/off, in-flight window bound, chunked
+#: flatten threads), so one kafka config namespace provisions the whole
+#: consume->ingest tier.
 _APP_TUNING_KEYS = frozenset(
-    {"max_poll_records", "poll_timeout_ms", "queue_max_batches"}
+    {
+        "max_poll_records",
+        "poll_timeout_ms",
+        "queue_max_batches",
+        "pipeline",
+        "pipeline_depth",
+        "flatten_threads",
+    }
 )
 
 

@@ -14,6 +14,7 @@ import json
 import logging
 import os
 import socket
+import threading
 from collections.abc import Sequence
 from dataclasses import dataclass
 from typing import Protocol, runtime_checkable
@@ -211,6 +212,13 @@ class KafkaSink:
         # trips its own breaker.
         self._consecutive_produce = 0
         self._consecutive_flush = 0
+        # Pipelined ingest publishes results from the step worker while
+        # the service thread publishes heartbeats/acks (ADR 0111): the
+        # error counters above are read-modify-writes, and interleaved
+        # streaks must not lose increments (a delayed breaker trip
+        # black-holes messages for longer). librdkafka's produce() is
+        # itself thread-safe; the lock covers this sink's accounting.
+        self._lock = threading.Lock()
 
     def _trip_or_warn(
         self, consecutive: int, what: str, exc: BaseException
@@ -232,7 +240,8 @@ class KafkaSink:
             try:
                 sm = self._serializer.serialize(msg)
             except Exception:
-                self.serialize_errors += 1
+                with self._lock:
+                    self.serialize_errors += 1
                 logger.exception("Failed to serialize %s", msg.stream)
                 continue
             try:
@@ -244,25 +253,31 @@ class KafkaSink:
                 # EXACTLY this way (the local queue never drains), so
                 # sustained drops must trip the breaker too instead of
                 # black-holing every message behind per-drop warnings.
-                self.dropped += 1
-                self._consecutive_produce += 1
-                self._trip_or_warn(
-                    self._consecutive_produce, "produce (queue full)", err
-                )
+                with self._lock:
+                    self.dropped += 1
+                    self._consecutive_produce += 1
+                    consecutive = self._consecutive_produce
+                self._trip_or_warn(consecutive, "produce (queue full)", err)
             except Exception as err:
-                self.produce_errors += 1
-                self._consecutive_produce += 1
-                self._trip_or_warn(self._consecutive_produce, "produce", err)
+                with self._lock:
+                    self.produce_errors += 1
+                    self._consecutive_produce += 1
+                    consecutive = self._consecutive_produce
+                self._trip_or_warn(consecutive, "produce", err)
             else:
-                self._consecutive_produce = 0
+                with self._lock:
+                    self._consecutive_produce = 0
         try:
             self._producer.flush(0)
         except Exception as err:
-            self.flush_errors += 1
-            self._consecutive_flush += 1
-            self._trip_or_warn(self._consecutive_flush, "flush", err)
+            with self._lock:
+                self.flush_errors += 1
+                self._consecutive_flush += 1
+                consecutive = self._consecutive_flush
+            self._trip_or_warn(consecutive, "flush", err)
         else:
-            self._consecutive_flush = 0
+            with self._lock:
+                self._consecutive_flush = 0
 
 
 class UnrollingSinkAdapter:

@@ -534,6 +534,7 @@ def flatten_events(
     inv_width: float,
     dump: int,
     edges=None,
+    out=None,
 ):
     """Native event -> flat-bin projection (see ingest.cpp ld_flatten).
 
@@ -543,6 +544,12 @@ def flatten_events(
     None. Passing ``edges`` (float32, n_toa + 1 entries) selects the
     non-uniform binning kernel (binary search, same float32 edges the
     device path bins with).
+
+    ``out`` optionally receives the result (contiguous int32, length of
+    ``pixel_id``): the pipelined ingest's chunked flatten hands worker
+    slices of one preallocated array so parallel chunks assemble without
+    a concatenation copy. The ctypes call releases the GIL, so chunked
+    callers overlap for real.
     """
     lib = load_library()
     if lib is None:
@@ -554,7 +561,14 @@ def flatten_events(
     pixel_id = np.ascontiguousarray(sanitize_pixel_id(pixel_id), dtype=np.int32)
     toa = np.ascontiguousarray(toa, dtype=np.float32)
     n = pixel_id.shape[0]
-    out = np.empty(n, dtype=np.int32)
+    if out is None:
+        out = np.empty(n, dtype=np.int32)
+    elif (
+        out.dtype != np.int32
+        or out.shape != (n,)
+        or not out.flags["C_CONTIGUOUS"]
+    ):
+        raise ValueError("out must be a contiguous int32 array of length n")
     i32p = ctypes.POINTER(ctypes.c_int32)
     f32p = ctypes.POINTER(ctypes.c_float)
     if lut is not None:

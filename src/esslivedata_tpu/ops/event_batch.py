@@ -81,6 +81,19 @@ class EventBatch:
     def padded_size(self) -> int:
         return int(self.pixel_id.shape[0])
 
+    def detach(self) -> EventBatch:
+        """An owned copy, safe to hold past the staging buffer's
+        ``release()``. The pipelined ingest hands windows across stage
+        threads while the service thread reuses the staging buffer for
+        the next window (ADR 0111); batches crossing that boundary must
+        own their memory. ~8 B/event memcpy — small against the flatten
+        it decouples."""
+        return EventBatch(
+            pixel_id=self.pixel_id.copy(),
+            toa=self.toa.copy(),
+            n_valid=self.n_valid,
+        )
+
     @classmethod
     def from_arrays(
         cls,

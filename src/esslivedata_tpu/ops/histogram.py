@@ -786,12 +786,19 @@ class EventHistogrammer:
     def partition_key(self) -> tuple:
         """Cache key for the pallas2d partitioned wire: the partition
         additionally depends on the block/chunk geometry and compaction."""
+        return self.partition_key_for(self._p2_compact)
+
+    def partition_key_for(self, compact: bool) -> tuple:
+        """``partition_key`` for an explicit compaction flag — staging
+        snapshots the flag once so a concurrent ``set_wire_format`` flip
+        (link policy, ADR 0111) can never cache a payload under a key
+        claiming the other wire."""
         return (
             "part",
             self._proj.layout_digest,
             self._bpb,
             self._p2_chunk,
-            self._p2_compact,
+            compact,
         )
 
     @property
@@ -814,27 +821,126 @@ class EventHistogrammer:
                      self._p2_precision)
         return base
 
-    def _staged_flat(self, pixel_id, toa, cache, tag: str):
+    def _staged_flat(self, pixel_id, toa, cache, tag: str, pool=None):
         """Host-flattened indices staged for dispatch — once per window
-        per (stream, tag, layout) when a cache slot is provided."""
+        per (stream, tag, layout) when a cache slot is provided.
+        ``pool`` (pipelined prestage only) chunks the flatten across a
+        thread pool; the result is bit-identical either way."""
+
+        def flatten():
+            if pool is not None:
+                return self.flatten_host_chunked(pixel_id, toa, pool)
+            return self.flatten_host(pixel_id, toa)
+
         if cache is None:
-            return dispatch_safe(self.flatten_host(pixel_id, toa))
+            return dispatch_safe(flatten())
         return cache.get_or_stage(
             (tag,) + self.stage_key,
-            lambda: dispatch_safe(self.flatten_host(pixel_id, toa)),
+            lambda: dispatch_safe(flatten()),
         )
 
     def _staged_partition(self, pixel_id, toa, cache, tag: str):
         """Block-partitioned (events, chunk_map) staged for the pallas2d
-        kernel — once per window per (stream, tag, partition layout)."""
+        kernel — once per window per (stream, tag, partition layout).
+
+        The compaction flag is read ONCE and threaded through both the
+        key and the partition pass: a link-policy wire flip arriving
+        between the two would otherwise cache a payload whose format
+        contradicts its key."""
+        compact = self._p2_compact
 
         def stage():
-            events, chunk_map = self.flatten_partition_host(pixel_id, toa)
+            events, chunk_map = self.flatten_partition_host(
+                pixel_id, toa, compact=compact
+            )
             return dispatch_safe(events), dispatch_safe(chunk_map)
 
         if cache is None:
             return stage()
-        return cache.get_or_stage((tag,) + self.partition_key, stage)
+        return cache.get_or_stage(
+            (tag,) + self.partition_key_for(compact), stage
+        )
+
+    def stage_events(
+        self, batch: EventBatch, cache, *, batch_tag: str = "", pool=None
+    ) -> None:
+        """Warm the window stream-cache with this configuration's wire.
+
+        The pipelined ingest's prestage entry (core/ingest_pipeline.py,
+        ADR 0111): runs exactly the staging — same keys, same functions —
+        that ``step_batch``/``step_many`` would run at step time, so the
+        host flatten/partition and the async device transfer happen on a
+        stage worker while the previous window's step executes. Step-time
+        consumers then hit the warm slot. ``pool`` optionally chunks the
+        flat-wire flatten across a thread pool (the native shim releases
+        the GIL per chunk); the pallas2d fused flatten+partition always
+        runs as the single native pass the step path would take, keeping
+        the staged value identical across paths. A miss here is never an
+        error amplifier: a staging failure poisons nothing — the slot
+        drops the entry and step time retries privately.
+        """
+        if cache is None:
+            return
+        if self._method == "pallas2d":
+            self._staged_partition(batch.pixel_id, batch.toa, cache, batch_tag)
+        elif self.supports_host_flatten:
+            self._staged_flat(
+                batch.pixel_id, batch.toa, cache, batch_tag, pool=pool
+            )
+        else:
+            stage_raw(batch, cache, batch_tag)
+
+    def set_wire_format(self, compact: bool) -> bool:
+        """Runtime int32 <-> uint16 wire switch for ``method='pallas2d'``
+        (ADR 0108/0111). Returns True when the format actually changed.
+
+        The partition/fuse keys carry the compaction flag, so a switch
+        re-keys staging (next window misses and stages in the new
+        format) and splits fused groups across the flip — never a stale
+        mixed wire. Counts are bit-identical across both wires (pinned
+        by the partition parity tests), so the link policy may flip this
+        mid-stream without touching results. No-op for other methods and
+        for block sizes whose offsets don't fit uint16."""
+        if self._method != "pallas2d":
+            return False
+        compact = bool(compact) and self._bpb <= 0xFFFF
+        if compact == self._p2_compact:
+            return False
+        self._p2_compact = compact
+        return True
+
+    #: Below this many events per chunk the pool dispatch overhead beats
+    #: the parallel flatten; chunks are sized to keep every worker fed.
+    _FLATTEN_CHUNK_MIN = 1 << 17
+
+    def flatten_host_chunked(
+        self, pixel_id: np.ndarray, toa: np.ndarray, pool
+    ) -> np.ndarray:
+        """``flatten_host`` split over a thread pool in contiguous
+        chunks, writing each chunk's result straight into one output
+        array. The projection is elementwise, so the result is
+        bit-identical to the unchunked pass; the native shim (and
+        numpy's ufunc cores) release the GIL, so chunks genuinely
+        overlap on multicore ingest hosts."""
+        n = int(np.asarray(pixel_id).shape[0])
+        workers = getattr(pool, "_max_workers", 1) if pool is not None else 1
+        if workers < 2 or n < 2 * self._FLATTEN_CHUNK_MIN:
+            return self.flatten_host(pixel_id, toa)
+        n_chunks = min(workers, -(-n // self._FLATTEN_CHUNK_MIN))
+        bounds = np.linspace(0, n, n_chunks + 1, dtype=np.int64)
+        out = np.empty(n, dtype=np.int32)
+
+        def run(lo: int, hi: int) -> None:
+            self.flatten_host(pixel_id[lo:hi], toa[lo:hi], out=out[lo:hi])
+
+        futures = [
+            pool.submit(run, int(lo), int(hi))
+            for lo, hi in zip(bounds[:-1], bounds[1:])
+            if hi > lo
+        ]
+        for future in futures:
+            future.result()
+        return out
 
     # -- public API -------------------------------------------------------
     def step(self, state: HistogramState, batch: EventBatch) -> HistogramState:
@@ -927,15 +1033,23 @@ class EventHistogrammer:
         return self._step_fused(states, self._proj.lut, pid, toa)
 
     def flatten_partition_host(
-        self, pixel_id: np.ndarray, toa: np.ndarray
+        self,
+        pixel_id: np.ndarray,
+        toa: np.ndarray,
+        *,
+        compact: bool | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Host ingest for ``method='pallas2d'``: raw (pixel_id, toa) to
         block-partitioned ``(events, chunk_map)`` for the tiled kernel.
 
         One fused native pass (``ld_flatten_partition``) when the
         configuration is uniform-edged and pixel-block-aligned; otherwise
-        ``flatten_host`` + ``partition_events_host``.
+        ``flatten_host`` + ``partition_events_host``. ``compact``
+        overrides the instance's wire flag (staging snapshots it so a
+        concurrent ``set_wire_format`` flip stays key-coherent).
         """
+        if compact is None:
+            compact = self._p2_compact
         from .pallas_hist2d import (
             bucketed_chunks,
             chunk_capacity,
@@ -965,7 +1079,7 @@ class EventHistogrammer:
                     ppb_shift=self._ppb_shift,
                     chunk=chunk,
                     cap_chunks=cap,
-                    compact=self._p2_compact,
+                    compact=compact,
                 )
                 if res is not None:
                     events, chunk_map, used = res
@@ -977,7 +1091,7 @@ class EventHistogrammer:
             self._n_bins + 1,
             bpb=self._bpb,
             chunk=self._p2_chunk,
-            compact=self._p2_compact,
+            compact=compact,
         )
 
     def step_flat(self, state: HistogramState, flat) -> HistogramState:
@@ -1015,7 +1129,13 @@ class EventHistogrammer:
             and self._n_bins < np.iinfo(np.int32).max
         )
 
-    def flatten_host(self, pixel_id: np.ndarray, toa: np.ndarray) -> np.ndarray:
+    def flatten_host(
+        self,
+        pixel_id: np.ndarray,
+        toa: np.ndarray,
+        *,
+        out: np.ndarray | None = None,
+    ) -> np.ndarray:
         """Host-side flat-index computation for ``step_flat``.
 
         Supports the no-LUT and single-replica-LUT configurations (the
@@ -1026,6 +1146,10 @@ class EventHistogrammer:
         when available; the numpy fallback is kept to a handful of
         int32/float32 passes — this runs on the host ingest thread per
         batch, so every extra temporary costs real pipeline time.
+
+        ``out`` optionally receives the result in place (int32, same
+        length) — the chunked parallel flatten writes worker slices
+        straight into one array instead of concatenating copies.
         """
         if self._proj.weights is not None:
             raise ValueError("flatten_host does not support pixel_weights")
@@ -1041,7 +1165,7 @@ class EventHistogrammer:
         except ImportError:
             flatten_events = None
         if flatten_events is not None:
-            out = flatten_events(
+            native_out = flatten_events(
                 pixel_id,
                 toa,
                 lut=None if lut_host is None else lut_host[0],
@@ -1052,9 +1176,10 @@ class EventHistogrammer:
                 inv_width=self._proj.inv_width,
                 dump=self._n_bins,
                 edges=None if self._proj.uniform else self._edges_f32,
+                out=out,
             )
-            if out is not None:
-                return out
+            if native_out is not None:
+                return native_out
         proj = self._proj
         if proj.uniform:
             tb = (toa - np.float32(proj.lo)) * np.float32(proj.inv_width)
@@ -1082,7 +1207,11 @@ class EventHistogrammer:
             ok = (pixel_id >= 0) & (pixel_id < self._n_screen) & t_ok
         # int32 multiply-add is safe: n_bins < 2**31 checked above; invalid
         # rows may wrap but are overwritten with the dump bin right after.
-        flat = screen.astype(np.int32, copy=True)
+        if out is not None:
+            np.copyto(out, screen, casting="unsafe")
+            flat = out
+        else:
+            flat = screen.astype(np.int32, copy=True)
         flat *= np.int32(self._n_toa)
         flat += tb
         flat[~ok] = self._n_bins

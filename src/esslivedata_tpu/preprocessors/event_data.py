@@ -65,6 +65,17 @@ class StagedEvents:
     def n_events(self) -> int:
         return self.batch.n_valid
 
+    def detach(self) -> StagedEvents:
+        """A copy owning its event arrays (see ``EventBatch.detach``) —
+        the pipelined hand-off form; the cache slot is dropped (the
+        pipeline's stage worker attaches the next window generation's)."""
+        return StagedEvents(
+            batch=self.batch.detach(),
+            first_timestamp=self.first_timestamp,
+            last_timestamp=self.last_timestamp,
+            n_chunks=self.n_chunks,
+        )
+
 
 class ToEventBatch:
     """Accumulator staging event chunks into one padded device batch.

@@ -87,6 +87,27 @@ class DataServiceBuilder:
             if snapshot_dir is not None
             else _os.environ.get("LIVEDATA_SNAPSHOT_DIR")
         )
+        # Pipelined ingest (ADR 0111). Precedence: kafka config
+        # namespace (the consume->ingest tier's app-tuning keys,
+        # kafka/consumer.py) < LIVEDATA_* env < the runner's
+        # --pipeline/--pipeline-depth/--flatten-threads flags, which
+        # override by assigning these public attributes after build.
+        tuning = self._ingest_tuning()
+        self.pipelined = (
+            _os.environ["LIVEDATA_PIPELINE"].lower() in ("1", "true", "yes")
+            if "LIVEDATA_PIPELINE" in _os.environ
+            else bool(tuning.get("pipeline", False))
+        )
+        self.pipeline_depth = int(
+            _os.environ.get(
+                "LIVEDATA_PIPELINE_DEPTH", tuning.get("pipeline_depth", 2)
+            )
+        )
+        self.flatten_threads = int(
+            _os.environ.get(
+                "LIVEDATA_FLATTEN_THREADS", tuning.get("flatten_threads", 0)
+            )
+        )
         self._instrument = instrument_registry[instrument]
         self._instrument.load_factories()
         # Subscribe only to streams the hosted specs consume (reference
@@ -96,6 +117,25 @@ class DataServiceBuilder:
         self.stream_mapping = scope_stream_mapping(
             self._instrument, get_stream_mapping(self._instrument, dev), service_name
         )
+
+    @staticmethod
+    def _ingest_tuning() -> dict:
+        """The kafka config namespace's ingest hand-off keys (see
+        kafka/consumer.py _APP_TUNING_KEYS); empty without a config."""
+        try:
+            from ..config.config_loader import load_config
+
+            conf = load_config(namespace="kafka") or {}
+        except Exception:
+            # Config files are optional (tests, fakes-only deployments);
+            # the env/CLI surface still configures the pipeline.
+            logger.debug("kafka config namespace unavailable", exc_info=True)
+            return {}
+        return {
+            key: conf[key]
+            for key in ("pipeline", "pipeline_depth", "flatten_threads")
+            if key in conf
+        }
 
     @property
     def topics(self) -> list[str]:
@@ -140,6 +180,9 @@ class DataServiceBuilder:
             device_extractor=DeviceExtractor(device_contract=contract),
             stream_counter=counter,
             heartbeat_interval_s=self._heartbeat_interval_s,
+            pipelined=self.pipelined,
+            pipeline_depth=self.pipeline_depth,
+            flatten_threads=self.flatten_threads,
         )
         return Service(
             processor=processor,
@@ -174,9 +217,34 @@ class DataServiceRunner:
         parser.add_argument(
             "--batcher",
             default="adaptive",
-            choices=["naive", "simple", "adaptive"],
+            choices=["naive", "simple", "adaptive", "rate_aware"],
+            help="rate_aware additionally accepts the link monitor's "
+            "explicit window retargeting under --pipeline (ADR 0111)",
         )
         parser.add_argument("--job-threads", type=int, default=5)
+        parser.add_argument(
+            "--pipeline",
+            action="store_true",
+            default=False,
+            help="pipelined ingest (ADR 0111): decode | prestage | "
+            "step/publish overlap across windows with bounded "
+            "backpressure and link-adaptive batching "
+            "(LIVEDATA_PIPELINE=1 equivalently)",
+        )
+        parser.add_argument(
+            "--pipeline-depth",
+            type=int,
+            default=None,
+            help="base in-flight window bound (the link monitor may "
+            "deepen it on degraded links)",
+        )
+        parser.add_argument(
+            "--flatten-threads",
+            type=int,
+            default=None,
+            help="chunk the host flatten across this many threads "
+            "during prestaging (multicore ingest hosts; 0/1 = off)",
+        )
         parser.add_argument(
             "--kafka-bootstrap",
             default=None,
@@ -222,6 +290,13 @@ class DataServiceRunner:
             batcher=make_batcher(args.batcher),
             job_threads=args.job_threads,
         )
+        # CLI overrides win over the builder's LIVEDATA_* env defaults.
+        if args.pipeline:
+            builder.pipelined = True
+        if args.pipeline_depth is not None:
+            builder.pipeline_depth = args.pipeline_depth
+        if args.flatten_threads is not None:
+            builder.flatten_threads = args.flatten_threads
         if args.check:
             print(
                 f"{self._service_name}: instrument={args.instrument} "

@@ -61,12 +61,17 @@ class StageTimer:
         try:
             yield
         finally:
-            dt = time.perf_counter() - start
-            with self._lock:
-                self._total_s[name] += dt
-                self._count[name] += 1
-                if dt > self._max_s[name]:
-                    self._max_s[name] = dt
+            self.record(name, time.perf_counter() - start)
+
+    def record(self, name: str, seconds: float) -> None:
+        """Fold an externally measured duration in — used where the
+        timing happened on another thread (pipeline stage workers) and
+        only the number crosses over."""
+        with self._lock:
+            self._total_s[name] += seconds
+            self._count[name] += 1
+            if seconds > self._max_s[name]:
+                self._max_s[name] = seconds
 
     def drain(self) -> dict[str, dict[str, float]]:
         """Per-stage {total_s, count, mean_ms, max_ms}; resets counters."""

@@ -77,6 +77,7 @@ class TestSlotSemantics:
             "hits": 0,
             "misses": 0,
             "bytes_staged": 0,
+            "staging_s": 0.0,
             "hit_rate": 0.0,
         }
 
@@ -194,3 +195,71 @@ class TestManagedStageOnce:
         # Smoke: the invalidation hook must not disturb processing.
         assert mgr.handle_command(JobCommand(action="remove")) == 1
         assert mgr.process_jobs({}, end=T(10)) == []
+
+
+class TestWindowGenerations:
+    """Caller-owned generations (pipelined ingest, ADR 0111): overlapped
+    windows must never alias each other's slots, and a closed
+    generation degrades to passthrough."""
+
+    def test_generations_are_independent(self):
+        cache = DeviceEventCache()
+        gen_a = cache.new_generation()
+        gen_b = cache.new_generation()
+        a = gen_a.slot("s").get_or_stage("k", lambda: np.arange(3))
+        b = gen_b.slot("s").get_or_stage("k", lambda: np.arange(3) * 2)
+        np.testing.assert_array_equal(a, [0, 1, 2])
+        np.testing.assert_array_equal(b, [0, 2, 4])
+        # Closing one generation leaves the other's slots warm.
+        gen_a.close()
+        again = gen_b.slot("s").get_or_stage("k", lambda: np.arange(3) * 9)
+        np.testing.assert_array_equal(again, b)
+
+    def test_closed_generation_is_passthrough(self):
+        cache = DeviceEventCache()
+        gen = cache.new_generation()
+        gen.close()
+        out = gen.slot("s").get_or_stage("k", lambda: np.arange(2))
+        np.testing.assert_array_equal(out, [0, 1])
+        # Nothing retained: a second call re-stages.
+        out2 = gen.slot("s").get_or_stage("k", lambda: np.arange(2) + 5)
+        np.testing.assert_array_equal(out2, [5, 6])
+
+    def test_begin_window_does_not_touch_caller_generations(self):
+        cache = DeviceEventCache()
+        gen = cache.new_generation()
+        gen.slot("s").get_or_stage("k", lambda: np.arange(4))
+        cache.begin_window()  # serial path churns the current generation
+        hit = gen.slot("s").get_or_stage("k", lambda: np.arange(4) * 7)
+        np.testing.assert_array_equal(hit, [0, 1, 2, 3])
+
+    def test_link_observer_fed_from_staging(self):
+        class Recorder:
+            def __init__(self):
+                self.samples = []
+
+            def observe_staging(self, nbytes, seconds):
+                self.samples.append((nbytes, seconds))
+
+        cache = DeviceEventCache()
+        cache.link_observer = Recorder()
+        gen = cache.new_generation()
+        arr = np.zeros(1024, np.int32)
+        gen.slot("s").get_or_stage("k", lambda: arr)
+        gen.slot("s").get_or_stage("k", lambda: arr)  # hit: no sample
+        samples = cache.link_observer.samples
+        assert len(samples) == 1
+        assert samples[0][0] == arr.nbytes
+        assert samples[0][1] >= 0.0
+
+    def test_broken_link_observer_is_contained(self):
+        class Broken:
+            def observe_staging(self, nbytes, seconds):
+                raise RuntimeError("observer bug")
+
+        cache = DeviceEventCache()
+        cache.link_observer = Broken()
+        gen = cache.new_generation()
+        out = gen.slot("s").get_or_stage("k", lambda: np.arange(2))
+        np.testing.assert_array_equal(out, [0, 1])
+        assert cache.stats()["misses"] == 1

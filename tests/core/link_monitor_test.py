@@ -1,0 +1,144 @@
+"""LinkMonitor: EWMA estimates, policy switching across a bandwidth
+step (ADR 0111 acceptance: batch-size target AND wire format must both
+flip), hysteresis, and cross-thread counter integrity (lock hammer)."""
+
+from __future__ import annotations
+
+import threading
+
+from esslivedata_tpu.core.link_monitor import LinkMonitor, LinkPolicy
+
+MB = 1_000_000
+
+
+def feed(monitor: LinkMonitor, bps: float, n: int = 40) -> None:
+    """Converge the EWMA onto ``bps`` with realistic 16 MB stagings."""
+    nbytes = 16 * MB
+    for _ in range(n):
+        monitor.observe_staging(nbytes, nbytes / bps)
+
+
+class TestPolicySwitching:
+    def test_neutral_before_any_observation(self):
+        policy = LinkMonitor().policy()
+        assert policy == LinkPolicy(
+            window_scale=1.0, compact_wire=None, depth=2
+        )
+
+    def test_bandwidth_step_switches_batch_target_and_wire(self):
+        """The acceptance scenario: healthy -> degraded -> healthy, with
+        injected timings, must flip the batch-size target AND the wire
+        format (and back)."""
+        monitor = LinkMonitor()
+        # Healthy relay: ~800 MB/s (round-3 measured regime).
+        feed(monitor, 8.0e8)
+        healthy = monitor.policy()
+        assert healthy.window_scale == 1.0
+        # None = leave the construction-time wire default (ADR 0108
+        # already prefers compact where it fits) — the policy forces
+        # compact only on a degraded link, and never forces wide.
+        assert healthy.compact_wire is None
+        assert healthy.depth == 2
+
+        # Bandwidth step down: ~40 MB/s (round-5 degraded regime).
+        feed(monitor, 4.0e7)
+        degraded = monitor.policy()
+        assert degraded.window_scale > healthy.window_scale
+        assert degraded.window_scale == 8.0  # target/bw capped at max
+        assert degraded.compact_wire is True
+        assert degraded.depth == 4
+
+        # Step back up: both decisions recover.
+        feed(monitor, 8.0e8)
+        recovered = monitor.policy()
+        assert recovered.window_scale == 1.0
+        assert recovered.compact_wire is None
+        assert recovered.depth == 2
+
+    def test_hysteresis_dead_zone(self):
+        """Between the degrade and recover thresholds the latch keeps
+        its last state — no flapping across a noisy boundary."""
+        monitor = LinkMonitor(
+            degraded_bandwidth_bps=1.0e8, recover_factor=2.0
+        )
+        feed(monitor, 5.0e7)
+        assert monitor.policy().compact_wire is True
+        # Inside the dead zone (above degrade, below recover): stays on.
+        feed(monitor, 1.5e8)
+        assert monitor.policy().compact_wire is True
+        # Past the recover threshold: releases.
+        feed(monitor, 2.5e8)
+        assert monitor.policy().compact_wire is None
+        # And re-engages only below the degrade threshold again.
+        feed(monitor, 1.2e8)
+        assert monitor.policy().compact_wire is None
+        feed(monitor, 5.0e7)
+        assert monitor.policy().compact_wire is True
+
+    def test_window_scale_quantized_and_bounded(self):
+        monitor = LinkMonitor(target_bandwidth_bps=4.0e8)
+        feed(monitor, 2.9e8)  # raw scale ~1.38 -> sqrt(2) step
+        scale = monitor.policy().window_scale
+        assert scale in (1.0, 2.0**0.5)
+        feed(monitor, 1.0)  # absurdly degraded: capped
+        assert monitor.policy().window_scale == 8.0
+
+    def test_rtt_alone_deepens_pipeline(self):
+        """A healthy-bandwidth but high-RTT link (the 78 ms relay round
+        trip) still wants more windows in flight."""
+        monitor = LinkMonitor()
+        feed(monitor, 8.0e8)
+        for _ in range(20):
+            monitor.observe_publish(0.078)
+        policy = monitor.policy()
+        assert policy.depth == 4
+        assert policy.compact_wire is None
+
+    def test_degenerate_observations_ignored(self):
+        monitor = LinkMonitor()
+        monitor.observe_staging(0, 0.1)
+        monitor.observe_staging(100, 0.0)
+        monitor.observe_staging(-5, -1.0)
+        monitor.observe_publish(0.0)
+        assert monitor.bandwidth_bps() is None
+        assert monitor.rtt_s() is None
+        stats = monitor.stats()
+        assert stats["n_staging"] == 0
+        assert stats["n_publish"] == 0
+
+
+class TestCrossThreadCounters:
+    def test_lock_hammer(self):
+        """Concurrent observers and policy readers: every observation
+        must be counted (a lost increment means the RMW is racy) and
+        the EWMA must stay inside the observed envelope."""
+        monitor = LinkMonitor()
+        n_threads, per_thread = 8, 500
+        barrier = threading.Barrier(n_threads)
+
+        def hammer(tid: int) -> None:
+            barrier.wait()
+            for i in range(per_thread):
+                # Alternate two honest rates so the EWMA has a bounded
+                # envelope to be checked against.
+                bps = 1.0e8 if (i + tid) % 2 else 4.0e8
+                monitor.observe_staging(1_000_000, 1_000_000 / bps)
+                monitor.observe_publish(0.001 + 0.0005 * (i % 3))
+                if i % 50 == 0:
+                    monitor.policy()
+                    monitor.stats()
+
+        threads = [
+            threading.Thread(target=hammer, args=(t,))
+            for t in range(n_threads)
+        ]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join()
+        stats = monitor.stats()
+        assert stats["n_staging"] == n_threads * per_thread
+        assert stats["n_publish"] == n_threads * per_thread
+        assert stats["bytes_observed"] == n_threads * per_thread * 1_000_000
+        assert 1.0e8 <= stats["bandwidth_bps"] <= 4.0e8
+        assert 0.001 <= stats["rtt_s"] <= 0.0025

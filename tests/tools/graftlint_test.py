@@ -104,6 +104,19 @@ def fan_out(jobs, batch, states):
     for job in jobs:
         states[job] = step(states[job], jax.device_put(batch))
 ''',
+    "JGL010": '''
+import queue
+import threading
+
+class Pipeline:
+    def __init__(self):
+        self._q = queue.Queue()
+
+    def worker(self):
+        while True:
+            item = self._q.get()
+            step(item)
+''',
 }
 
 NEGATIVE = {
@@ -233,6 +246,37 @@ def fan_out(jobs, batches, state):
     for job in jobs:
         parts = [jax.device_put(b) for b in batches]
     return step(state, staged)
+''',
+    # Bounded construction, timeboxed blocking ops, and the nonblocking
+    # forms all stay quiet; so does a Queue in a module without threads.
+    "JGL010": '''
+import queue
+import threading
+
+class Pipeline:
+    def __init__(self, depth):
+        self._q = queue.Queue(maxsize=depth)
+
+    def submit(self, item):
+        self._q.put(item, timeout=0.1)
+
+    def try_submit(self, item):
+        self._q.put_nowait(item)
+
+    def worker(self):
+        while True:
+            try:
+                item = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            step(item)
+
+    def drain_one(self):
+        return self._q.get(False)
+
+    def positional_forms(self, item):
+        self._q.put(item, True, 0.1)
+        return self._q.get(True, 0.1)
 ''',
 }
 # fmt: on

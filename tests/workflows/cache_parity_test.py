@@ -166,6 +166,129 @@ class TestMultiBankParity:
         )
 
 
+class TestPipelinedIngestParity:
+    """Pipelined vs serial ingest through the REAL JobManager path
+    (ADR 0111): detector-view and monitor outputs must be bit-identical,
+    and publishes must leave in submission order even under a randomized
+    slow-stage schedule (each pipeline stage sleeps a random amount per
+    window, maximizing overlap interleavings)."""
+
+    def _run_parity(self, make_workflow, windows, stream="det0"):
+        import threading
+        import time
+
+        from esslivedata_tpu.config import (
+            JobId,
+            WorkflowConfig,
+            WorkflowSpec,
+        )
+        from esslivedata_tpu.core.ingest_pipeline import IngestPipeline
+        from esslivedata_tpu.core.job_manager import JobFactory, JobManager
+        from esslivedata_tpu.workflows import WorkflowFactory
+
+        def make_manager():
+            reg = WorkflowFactory()
+            spec = WorkflowSpec(
+                instrument="test", name="parity", source_names=[stream]
+            )
+            reg.register_spec(spec).attach_factory(
+                lambda *, source_name, params: make_workflow()
+            )
+            mgr = JobManager(job_factory=JobFactory(reg), job_threads=2)
+            for _ in range(2):  # K=2: prestage + fused stepping engaged
+                mgr.schedule_job(
+                    WorkflowConfig(
+                        identifier=spec.identifier,
+                        job_id=JobId(source_name=stream),
+                    )
+                )
+            return mgr
+
+        def window_data(pid, toa):
+            return {stream: _staged(pid, toa)}
+
+        serial_mgr = make_manager()
+        serial_results = [
+            serial_mgr.process_jobs(
+                window_data(pid, toa), start=T(0), end=T(w + 1)
+            )
+            for w, (pid, toa) in enumerate(windows)
+        ]
+        serial_mgr.shutdown()
+
+        pipelined_mgr = make_manager()
+        rng = np.random.default_rng(23)
+        sleep_lock = threading.Lock()
+
+        def jitter():
+            with sleep_lock:  # rng is not thread-safe
+                delay = float(rng.uniform(0.0, 0.015))
+            time.sleep(delay)
+
+        real_prestage = pipelined_mgr.prestage_window
+        real_process = pipelined_mgr.process_jobs
+        pipelined_mgr.prestage_window = lambda *a, **k: (
+            jitter(),
+            real_prestage(*a, **k),
+        )[1]
+        pipelined_mgr.process_jobs = lambda *a, **k: (
+            jitter(),
+            real_process(*a, **k),
+        )[1]
+        published = []
+        pipeline = IngestPipeline(
+            job_manager=pipelined_mgr,
+            decode=lambda payload: (jitter(), (payload, {}, None))[1],
+            publish=lambda results, end: published.append((end, results)),
+            depth=3,
+        )
+        for w, (pid, toa) in enumerate(windows):
+            pipeline.submit(window_data(pid, toa), start=T(0), end=T(w + 1))
+        assert pipeline.stop(drain=True, timeout=120.0)
+        pipelined_mgr.shutdown()
+
+        # In-stream ordering: publishes in exact submission order.
+        assert [end for end, _ in published] == [
+            T(w + 1) for w in range(len(windows))
+        ]
+        # Bit-identical outputs, every window, every job.
+        for w, ((_, res_p), res_s) in enumerate(
+            zip(published, serial_results)
+        ):
+            assert len(res_p) == len(res_s) == 2
+            for rp, rs in zip(res_p, res_s):
+                outs_p = {k.to_string(): v for k, v in zip(
+                    rp.keys(), rp.outputs.values()
+                )}
+                outs_s = {k.to_string(): v for k, v in zip(
+                    rs.keys(), rs.outputs.values()
+                )}
+                # Keys differ only by the random job uuid; compare by
+                # output name in order.
+                _assert_outputs_identical(
+                    dict(zip(rp.outputs.keys(), rp.outputs.values())),
+                    dict(zip(rs.outputs.keys(), rs.outputs.values())),
+                    f"window {w} (pipelined vs serial)",
+                )
+                assert len(outs_p) == len(outs_s)
+
+    def test_detector_view_pipelined_parity_and_ordering(self):
+        det = np.arange(144).reshape(12, 12)
+        rng = np.random.default_rng(21)
+        self._run_parity(
+            lambda: DetectorViewWorkflow(projection=project_logical(det)),
+            _windows(rng, 6, 4000, -5, 150),
+        )
+
+    def test_monitor_pipelined_parity_and_ordering(self):
+        rng = np.random.default_rng(22)
+        self._run_parity(
+            lambda: MonitorWorkflow(),
+            _windows(rng, 5, 3000, -2, 5000),
+            stream="mon0",
+        )
+
+
 class TestFusedStepManyParity:
     @pytest.mark.parametrize("decay", [None, 0.93])
     def test_step_many_bit_identical_over_folds(self, decay):

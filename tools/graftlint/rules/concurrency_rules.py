@@ -1,5 +1,6 @@
-"""Thread-safety hazards: JGL004 (unlocked shared mutation) and JGL005
-(blocking calls in async bodies).
+"""Thread-safety hazards: JGL004 (unlocked shared mutation), JGL005
+(blocking calls in async bodies) and JGL010 (unbounded/untimeboxed
+queue hand-offs between threads that drive the device pipeline).
 
 JGL004 is a lightweight race detector scoped to modules that import
 ``threading`` (the Kafka consume thread / service worker split is this
@@ -139,3 +140,149 @@ def blocking_in_async(ctx: FileContext):
                     "run it in an executor (loop.run_in_executor) or "
                     "use the async client",
                 )
+
+
+#: stdlib queue constructors that accept a maxsize bound.
+_BOUNDABLE_QUEUES = frozenset(
+    {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue"}
+)
+
+
+def _maxsize_arg(call: ast.Call) -> ast.AST | None:
+    """The maxsize argument expression of a queue constructor, or None."""
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "maxsize":
+            return kw.value
+    return None
+
+
+def _const_false(expr: ast.AST | None) -> bool:
+    return isinstance(expr, ast.Constant) and expr.value is False
+
+
+def _queue_target_names(node: ast.Assign | ast.AnnAssign) -> set[str]:
+    """Plain and ``self.<attr>`` names a queue construction binds to."""
+    targets = (
+        node.targets if isinstance(node, ast.Assign) else [node.target]
+    )
+    names: set[str] = set()
+    for target in targets:
+        if isinstance(target, ast.Name):
+            names.add(target.id)
+        elif isinstance(target, ast.Attribute) and isinstance(
+            target.value, ast.Name
+        ):
+            names.add(target.attr)
+    return names
+
+
+@rule(
+    "JGL010",
+    "unbounded queue / timeout-less blocking hand-off on a "
+    "device-pipeline thread",
+)
+def unbounded_queue_handoff(ctx: FileContext):
+    """Scope: modules that import both ``threading`` and ``queue`` — the
+    cross-thread hand-off tier of a pipelined ingest. Two hazards:
+
+    - ``queue.Queue()`` with no (or non-positive) ``maxsize``: a slow
+      consumer turns backpressure into unbounded memory growth instead
+      of throttling the producer (the whole point of a bounded stage
+      hand-off, ADR 0111);
+    - blocking ``.put()``/``.get()`` with no ``timeout`` on such a
+      queue: a thread that also dispatches jitted computations can
+      never observe shutdown (or a peer stage's failure) while parked
+      in an untimeboxed wait — the service hangs instead of stopping.
+    """
+    imports = set(ctx._names.values())  # noqa: SLF001 - registry-internal
+    if not ctx.is_threaded_module or not any(
+        q == "queue" or q.startswith("queue.") for q in imports
+    ):
+        return
+
+    tracked: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        call = node.value
+        if not isinstance(call, ast.Call):
+            continue
+        qual = ctx.qualname(call.func)
+        if qual == "queue.SimpleQueue":
+            tracked |= _queue_target_names(node)
+            yield Finding(
+                ctx.path,
+                node.lineno,
+                "JGL010",
+                "queue.SimpleQueue has no capacity bound; use "
+                "queue.Queue(maxsize=...) so a slow stage throttles "
+                "its producer instead of growing memory",
+            )
+            continue
+        if qual not in _BOUNDABLE_QUEUES:
+            continue
+        tracked |= _queue_target_names(node)
+        maxsize = _maxsize_arg(call)
+        unbounded = maxsize is None or (
+            isinstance(maxsize, ast.Constant)
+            and isinstance(maxsize.value, int)
+            and maxsize.value <= 0
+        )
+        if unbounded:
+            yield Finding(
+                ctx.path,
+                node.lineno,
+                "JGL010",
+                f"unbounded {qual}() hand-off in a threaded module; "
+                "pass maxsize so a slow consumer throttles the "
+                "producer (bounded backpressure) instead of growing "
+                "memory without limit",
+            )
+
+    if not tracked:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (
+            isinstance(func, ast.Attribute) and func.attr in ("put", "get")
+        ):
+            continue
+        base = func.value
+        base_name = None
+        if isinstance(base, ast.Name):
+            base_name = base.id
+        elif isinstance(base, ast.Attribute):
+            base_name = base.attr
+        if base_name not in tracked:
+            continue
+        # Signatures: get(block=True, timeout=None) / put(item,
+        # block=True, timeout=None) — block and timeout may arrive
+        # positionally, and a positional timeout is just as timeboxed
+        # as a keyword one.
+        block_pos, timeout_pos = (0, 1) if func.attr == "get" else (1, 2)
+        has_timeout = any(
+            kw.arg == "timeout" for kw in node.keywords
+        ) or len(node.args) > timeout_pos
+        nonblocking = any(
+            _const_false(kw.value)
+            for kw in node.keywords
+            if kw.arg == "block"
+        ) or (
+            len(node.args) > block_pos
+            and _const_false(node.args[block_pos])
+        )
+        if has_timeout or nonblocking:
+            continue
+        yield Finding(
+            ctx.path,
+            node.lineno,
+            "JGL010",
+            f"blocking '.{func.attr}()' without a timeout on queue "
+            f"'{base_name}': a pipeline thread parked here can never "
+            "observe shutdown or a peer stage's failure; loop on "
+            f"'.{func.attr}(timeout=...)' and re-check the stop flag",
+        )
