@@ -362,6 +362,7 @@ class IngestPipeline:
                 continue
         return None
 
+    # graft: thread=decode
     def _decode_loop(self) -> None:
         while True:
             window = self._get(self._decode_q)
@@ -383,6 +384,7 @@ class IngestPipeline:
             if not self._put(self._stage_q, window):
                 return
 
+    # graft: thread=stage
     def _stage_loop(self) -> None:
         while True:
             window = self._get(self._stage_q)
@@ -413,6 +415,7 @@ class IngestPipeline:
             if not self._put(self._step_q, window):
                 return
 
+    # graft: thread=step
     def _step_loop(self) -> None:
         while True:
             window = self._get(self._step_q)

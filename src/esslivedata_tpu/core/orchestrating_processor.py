@@ -229,6 +229,12 @@ class OrchestratingProcessor:
         # state is single-thread-owned by contract).
         self._pipeline = None
         self._link_monitor = None
+        # Step-worker -> service-thread policy mailbox (graftlint JGL012:
+        # the step worker posts, the service thread swaps-and-applies;
+        # unlocked, the swap's read..None-store window can eat a
+        # concurrently posted policy and leave the batcher one decision
+        # stale until the next window completes).
+        self._policy_lock = threading.Lock()
         self._pending_policy = None
         self._applied_window_scale = 1.0
         self._base_window = getattr(batcher, "window", None)
@@ -326,6 +332,7 @@ class OrchestratingProcessor:
         self._record_lag(batch)
         self._pipeline.submit(batch, start=batch.start, end=batch.end)
 
+    # graft: thread=decode   (IngestPipeline decode worker callback)
     def _decode_window(self, batch):
         """Decode stage (pipeline decode worker): accumulate + collect,
         then detach the window so the NEXT batch's preprocess — on this
@@ -352,6 +359,7 @@ class OrchestratingProcessor:
         self._preprocessor.release()
         return data, context, fresh_context
 
+    # graft: thread=step   (IngestPipeline step-worker completion callback)
     def _on_window_complete(self, window) -> None:
         """Step-worker callback: fold the window's stage timings into
         the metrics timer and queue the link policy for the service
@@ -360,7 +368,8 @@ class OrchestratingProcessor:
         for stage, seconds in window.stage_s.items():
             self.stage_timer.record(stage, seconds)
         if window.policy is not None:
-            self._pending_policy = window.policy
+            with self._policy_lock:
+                self._pending_policy = window.policy
 
     def _apply_link_policy(self) -> None:
         """Service thread: retarget the batcher window per link policy.
@@ -368,7 +377,8 @@ class OrchestratingProcessor:
         Only batchers exposing ``set_window`` (rate-aware) retarget
         explicitly; the adaptive batcher already reacts to the same
         degradation through ``report_processing_time`` backpressure."""
-        policy, self._pending_policy = self._pending_policy, None
+        with self._policy_lock:
+            policy, self._pending_policy = self._pending_policy, None
         if policy is None or self._base_window is None:
             return
         if policy.window_scale == self._applied_window_scale:
@@ -448,6 +458,9 @@ class OrchestratingProcessor:
         )
 
     # -- publishing -------------------------------------------------------
+    # Pipelined mode publishes from the step worker; serial mode calls
+    # this from the service thread — both roles reach it.
+    # graft: thread=step
     def _publish_results(
         self, results: list[JobResult], timestamp: Timestamp | None
     ) -> None:

@@ -354,6 +354,10 @@ class EventHistogrammer:
             )
         self._edges = self._proj.edges
         self._edges_f32 = self._edges.astype(np.float32)
+        # graft: key-derived=_n_toa,_n_screen,_n_bins pure functions of
+        # the projection layout: layout_digest (in every key tuple)
+        # hashes the edges and LUT geometry these unpack from, so they
+        # cannot change without re-keying staging and fusion.
         self._n_toa = self._proj.n_toa
         self._n_screen = self._proj.n_screen
         self._n_bins = self._n_screen * self._n_toa

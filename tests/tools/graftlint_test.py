@@ -13,7 +13,12 @@ from pathlib import Path
 import pytest
 
 # tools.graftlint resolves via pythonpath = ["src", "."] in pyproject.
-from tools.graftlint import RULES, run_paths, run_source
+from tools.graftlint import (
+    RULES,
+    run_paths,
+    run_project_sources,
+    run_source,
+)
 from tools.graftlint.cli import main as cli_main
 
 REPO = Path(__file__).resolve().parent.parent.parent
@@ -116,6 +121,77 @@ class Pipeline:
         while True:
             item = self._q.get()
             step(item)
+''',
+    # Whole-program: A takes A._lock then B._lock (via call), B takes
+    # B._lock then A._lock — a cycle in the lock-order graph.
+    "JGL011": '''
+import threading
+
+class Batcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pipe = Pipeline()
+
+    def flush(self):
+        with self._lock:
+            self._pipe.submit()
+
+class Pipeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._batcher = Batcher()
+
+    def submit(self):
+        with self._lock:
+            pass
+
+    def drain(self):
+        with self._lock:
+            self._batcher.flush()
+''',
+    # A worker thread and the main thread both write self.count, no lock.
+    "JGL012": '''
+import threading
+
+class Svc:
+    def __init__(self):
+        self.count = 0
+        self._worker = threading.Thread(target=self._run)
+
+    def _run(self):
+        self.count = self.count + 1
+
+    def poll(self):
+        self.count = 0
+''',
+    # A mutable staged batch crosses a queue hand-off undetached.
+    "JGL013": '''
+import queue
+import threading
+
+class Stage:
+    def __init__(self):
+        self._q = queue.Queue(maxsize=2)
+
+    def feed(self, batch: EventBatch):
+        self._q.put(batch, timeout=0.1)
+''',
+    # The jitted step reads _scale; no key tuple mentions it.
+    "JGL014": '''
+import jax
+
+class Hist:
+    def __init__(self, bins, scale):
+        self._bins = bins
+        self._scale = scale
+        self._step = jax.jit(self._step_impl, donate_argnums=(0,))
+
+    @property
+    def fuse_key(self):
+        return ("fuse", self._bins)
+
+    def _step_impl(self, state, flat):
+        return state * self._scale
 ''',
 }
 
@@ -277,6 +353,86 @@ class Pipeline:
     def positional_forms(self, item):
         self._q.put(item, True, 0.1)
         return self._q.get(True, 0.1)
+''',
+    # Same two classes, one global order: A._lock -> B._lock only.
+    "JGL011": '''
+import threading
+
+class Batcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pipe = Pipeline()
+
+    def flush(self):
+        with self._lock:
+            self._pipe.submit()
+
+class Pipeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._batcher = Batcher()
+
+    def submit(self):
+        with self._lock:
+            pass
+
+    def drain(self):
+        self._batcher.flush()
+''',
+    # Both roles write under the one shared lock; __init__ is exempt.
+    "JGL012": '''
+import threading
+
+class Svc:
+    def __init__(self):
+        self.count = 0
+        self._lock = threading.Lock()
+        self._worker = threading.Thread(target=self._run)
+
+    def _run(self):
+        with self._lock:
+            self.count = self.count + 1
+
+    def poll(self):
+        with self._lock:
+            self.count = 0
+''',
+    # Detached before the hand-off (directly and via rebinding).
+    "JGL013": '''
+import queue
+import threading
+
+class Stage:
+    def __init__(self):
+        self._q = queue.Queue(maxsize=2)
+
+    def feed(self, batch: EventBatch):
+        self._q.put(batch.detach(), timeout=0.1)
+
+    def feed_rebound(self, batch: EventBatch):
+        owned = batch.detach()
+        self._q.put(owned, timeout=0.1)
+''',
+    # Every traced read is keyed, derived-declared, or a class constant.
+    "JGL014": '''
+import jax
+
+class Hist:
+    _FLOOR = 1e-12
+
+    def __init__(self, bins, scale):
+        self._bins = bins
+        # graft: key-derived=_scale recomputed from bins on rebuild
+        self._scale = scale
+        self._n = len(bins)
+        self._step = jax.jit(self._step_impl, donate_argnums=(0,))
+
+    @property
+    def fuse_key(self):
+        return ("fuse", self._bins, self._n)
+
+    def _step_impl(self, state, flat):
+        return state * self._scale * self._FLOOR
 ''',
 }
 # fmt: on
@@ -486,3 +642,320 @@ def test_tools_tree_is_clean():
     findings, errors = run_paths([str(REPO / "tools")])
     assert not errors, errors
     assert not findings, "\n".join(f.render() for f in findings)
+
+
+# -- whole-program pass (JGL011-014, docs/adr/0112) ------------------------
+
+# The regression fixture the tentpole demands: the real batcher/pipeline
+# lock pair split across TWO modules, inverted. Modeled on
+# core/rate_aware_batcher.py (RLock'd set_window) and
+# core/ingest_pipeline.py (Condition'd submit): if a completion callback
+# ever called back into the batcher under the pipeline's state lock
+# while the batcher submits under its own lock, these would deadlock.
+_BATCHER_MOD = '''
+import threading
+
+class RateAwareMessageBatcher:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._pipeline = None
+
+    def attach(self, pipeline: IngestPipeline):
+        self._pipeline = pipeline
+
+    def set_window(self, window):
+        with self._lock:
+            self._pipeline.submit(window)
+'''
+
+_PIPELINE_MOD = '''
+import threading
+
+from batcher import RateAwareMessageBatcher
+
+class IngestPipeline:
+    def __init__(self, batcher: RateAwareMessageBatcher):
+        self._state_lock = threading.Condition()
+        self._batcher = batcher
+
+    def submit(self, window):
+        with self._state_lock:
+            pass
+
+    def on_complete(self, window):
+        with self._state_lock:
+            self._batcher.set_window(window)
+'''
+
+
+def test_lock_order_inversion_detected_across_two_modules():
+    findings = run_project_sources(
+        {"batcher.py": _BATCHER_MOD, "pipeline.py": _PIPELINE_MOD}
+    )
+    hits = [f for f in findings if f.rule == "JGL011"]
+    # Both halves of the inversion report, each in its own module, each
+    # naming the counter-site in the other file.
+    assert {f.path for f in hits} == {"batcher.py", "pipeline.py"}
+    assert any("pipeline.py" in f.message for f in hits if f.path == "batcher.py")
+
+
+def test_consistent_cross_module_order_is_quiet():
+    consistent = _PIPELINE_MOD.replace(
+        """    def on_complete(self, window):
+        with self._state_lock:
+            self._batcher.set_window(window)""",
+        """    def on_complete(self, window):
+        self._batcher.set_window(window)""",
+    )
+    findings = run_project_sources(
+        {"batcher.py": _BATCHER_MOD, "pipeline.py": consistent}
+    )
+    assert not [f for f in findings if f.rule == "JGL011"]
+
+
+def test_thread_annotation_drives_role_inference():
+    # The escape hatch: without the annotation the callback's role is
+    # unknowable (it flows through a parameter) and JGL012 stays quiet;
+    # with it, the cross-role unlocked write fires.
+    template = '''
+import threading
+
+class Proc:
+    def __init__(self):
+        self._pending = None
+
+    {annot}
+    def on_complete(self, window):
+        self._pending = window
+
+    def apply(self):
+        policy, self._pending = self._pending, None
+'''
+    quiet = run_source(template.format(annot="# unannotated"))
+    assert not [f for f in quiet if f.rule == "JGL012"]
+    loud = run_source(template.format(annot="# graft: thread=step"))
+    assert [f for f in loud if f.rule == "JGL012"]
+
+
+def test_jgl012_requires_common_lock_not_just_any_lock():
+    src = '''
+import threading
+
+class Svc:
+    def __init__(self):
+        self.count = 0
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self._worker = threading.Thread(target=self._run)
+
+    def _run(self):
+        with self._lock_a:
+            self.count = 1
+
+    def poll(self):
+        with self._lock_b:
+            self.count = 0
+'''
+    findings = [f for f in run_source(src) if f.rule == "JGL012"]
+    assert findings and "DIFFERENT locks" in findings[0].message
+
+
+def test_jgl013_flags_forwarded_put_at_the_call_site():
+    src = '''
+import queue
+import threading
+
+class Stage:
+    def __init__(self):
+        self._q = queue.Queue(maxsize=2)
+
+    def _put(self, q, item):
+        q.put(item, timeout=0.1)
+
+    def feed(self, batch: EventBatch):
+        self._put(self._q, batch)
+
+    def feed_safe(self, batch: EventBatch):
+        self._put(self._q, batch.detach())
+'''
+    hits = [f for f in run_source(src) if f.rule == "JGL013"]
+    assert len(hits) == 1 and hits[0].line == 13
+
+
+def test_jgl014_key_derived_annotation_covers_attr():
+    src = POSITIVE["JGL014"].replace(
+        "self._scale = scale",
+        "# graft: key-derived=_scale recomputed on every rebuild\n"
+        "        self._scale = scale",
+    )
+    assert not [f for f in run_source(src) if f.rule == "JGL014"]
+
+
+def test_project_findings_obey_line_suppressions():
+    src = POSITIVE["JGL012"].replace(
+        "self.count = self.count + 1",
+        "self.count = self.count + 1  "
+        "# graftlint: disable=JGL012 single-writer handshake",
+    )
+    assert not [f for f in run_source(src) if f.rule == "JGL012"]
+
+
+def test_jobs_parallel_matches_serial(tmp_path):
+    (tmp_path / "a.py").write_text(POSITIVE["JGL007"])
+    (tmp_path / "b.py").write_text(POSITIVE["JGL012"])
+    (tmp_path / "c.py").write_text(_BATCHER_MOD)
+    serial = run_paths([str(tmp_path)], jobs=1)
+    parallel = run_paths([str(tmp_path)], jobs=2)
+    assert serial == parallel
+    assert any(f.rule == "JGL012" for f in serial[0])
+
+
+def test_helper_reached_only_from_thread_entry_is_single_role():
+    # "main" seeds only at call-graph sources: a helper reached solely
+    # through a thread entry has exactly that thread's role, so its
+    # single-writer state is not a race.
+    src = '''
+import threading
+
+class Svc:
+    def __init__(self):
+        self.count = 0
+        self._worker = threading.Thread(target=self._run)
+
+    def _run(self):
+        self._bump()
+
+    def _bump(self):
+        self.count = self.count + 1
+'''
+    assert not [f for f in run_source(src) if f.rule == "JGL012"]
+
+
+def test_imported_name_does_not_resolve_to_unrelated_module():
+    # 'from vendor import flush' (vendor unanalyzed) must not absorb
+    # into an unrelated module-level flush() and invent a lock edge.
+    mod_a = '''
+import threading
+from vendor import flush
+
+_alock = threading.Lock()
+
+def drain():
+    with _alock:
+        flush()
+'''
+    mod_b = '''
+import threading
+
+_block = threading.Lock()
+
+def flush():
+    with _block:
+        other()
+
+def other():
+    with _block:
+        pass
+'''
+    findings = run_project_sources({"a.py": mod_a, "b.py": mod_b})
+    assert not [f for f in findings if f.rule == "JGL011"]
+
+
+def test_thread_annotation_above_decorator_stack_is_honored():
+    src = '''
+import threading
+
+class Proc:
+    def __init__(self):
+        self._pending = None
+
+    # graft: thread=step
+    @staticmethod
+    def tick():
+        pass
+
+    # graft: thread=step
+    def on_complete(self, window):
+        self._pending = window
+
+    def apply(self):
+        policy, self._pending = self._pending, None
+'''
+    assert [f for f in run_source(src) if f.rule == "JGL012"]
+
+
+def test_jgl011_message_carries_no_counter_line_number():
+    # Baseline matching is line-insensitive (path, rule, message); a
+    # counter-site line in the message would break that contract.
+    import re
+
+    findings = run_project_sources(
+        {"batcher.py": _BATCHER_MOD, "pipeline.py": _PIPELINE_MOD}
+    )
+    for f in findings:
+        if f.rule == "JGL011":
+            assert not re.search(r"\.py:\d", f.message), f.message
+
+
+# -- baseline + SARIF (CI gating surfaces) ---------------------------------
+
+
+def test_baseline_roundtrip_and_stale_reporting(tmp_path, capsys):
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(POSITIVE["JGL007"])
+    baseline = tmp_path / "baseline.json"
+    # Snapshot, then the same tree gates green against it.
+    assert cli_main(
+        [str(dirty), "--baseline", str(baseline), "--write-baseline"]
+    ) == 0
+    assert cli_main([str(dirty), "--baseline", str(baseline)]) == 0
+    capsys.readouterr()
+    # A NEW finding still fails, reported alone.
+    dirty.write_text(POSITIVE["JGL007"] + "\nimport time\nasync def f():\n    time.sleep(1)\n")
+    assert cli_main([str(dirty), "--baseline", str(baseline)]) == 1
+    out = capsys.readouterr().out
+    assert "JGL005" in out and "JGL007" not in out
+    # Fixing the baselined finding reports the entry as stale.
+    dirty.write_text("x = 1\n")
+    assert cli_main([str(dirty), "--baseline", str(baseline)]) == 0
+    err = capsys.readouterr().err
+    assert "stale baseline entry" in err
+
+
+def test_missing_baseline_file_fails_the_gate(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert cli_main(
+        [str(clean), "--baseline", str(tmp_path / "nope.json")]
+    ) == 1
+
+
+def test_write_baseline_refuses_partly_unreadable_tree(tmp_path):
+    # A snapshot over a tree with parse errors would under-record and
+    # later mask findings; nothing may be written.
+    (tmp_path / "ok.py").write_text(POSITIVE["JGL007"])
+    (tmp_path / "broken.py").write_text("def broken(:\n")
+    baseline = tmp_path / "baseline.json"
+    assert cli_main(
+        [str(tmp_path), "--baseline", str(baseline), "--write-baseline"]
+    ) == 1
+    assert not baseline.exists()
+
+
+def test_sarif_report_written_even_when_failing(tmp_path):
+    import json
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(POSITIVE["JGL007"])
+    sarif = tmp_path / "out.sarif"
+    assert cli_main([str(dirty), "--sarif", str(sarif)]) == 1
+    doc = json.loads(sarif.read_text())
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    results = run["results"]
+    assert results and results[0]["ruleId"] == "JGL007"
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("dirty.py")
+    assert loc["region"]["startLine"] > 0
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert "JGL011" in rule_ids  # whole-program rules carry metadata too

@@ -1,23 +1,77 @@
 """graftlint — JAX-hazard and concurrency static analysis for the
 streaming hot path (docs/graftlint.md).
 
+Two passes share one run: the per-file lexical rules (JGL001–JGL010)
+and the whole-program pass (JGL011+ — project symbol table, call graph,
+thread roles; see ``project.py`` / docs/adr/0112). Every analyzed file
+contributes picklable ``FileFacts`` to the project pass, so ``jobs > 1``
+fans the parse+file-rules work across processes and only facts travel
+back.
+
 Programmatic API::
 
-    from tools.graftlint import run_source, run_paths
+    from tools.graftlint import run_source, run_paths, run_project_sources
     findings = run_source(code, path="snippet.py")
+    findings = run_project_sources({"a.py": src_a, "b.py": src_b})
 """
 
 from __future__ import annotations
 
+from concurrent.futures import ProcessPoolExecutor
 from pathlib import Path
 
 from . import rules  # noqa: F401  (registers all rules)
 from .context import FileContext
 from .findings import Finding
+from .project import FileFacts, ProjectContext, extract_facts
 from .registry import RULES
 from .suppress import Suppressions
 
-__all__ = ["Finding", "RULES", "run_paths", "run_source"]
+__all__ = [
+    "Finding",
+    "RULES",
+    "run_paths",
+    "run_project_sources",
+    "run_source",
+]
+
+
+def _file_findings(
+    ctx: FileContext, select: frozenset[str] | None
+) -> set[Finding]:
+    findings: set[Finding] = set()
+    for rule_id, rule in RULES.items():
+        if rule.scope != "file":
+            continue
+        if select is not None and rule_id not in select:
+            continue
+        findings.update(rule.check(ctx))
+    return findings
+
+
+def _project_findings(
+    project: ProjectContext, select: frozenset[str] | None
+) -> list[Finding]:
+    findings: set[Finding] = set()
+    for rule_id, rule in RULES.items():
+        if rule.scope != "project":
+            continue
+        if select is not None and rule_id not in select:
+            continue
+        findings.update(rule.check(project))
+    return sorted(findings)
+
+
+def _filter_by_file(
+    findings: list[Finding], suppressions: dict[str, Suppressions]
+) -> list[Finding]:
+    out = []
+    for f in findings:
+        sup = suppressions.get(f.path)
+        if sup is not None and sup.is_suppressed(f):
+            continue
+        out.append(f)
+    return out
 
 
 def run_source(
@@ -26,14 +80,28 @@ def run_source(
     path: str = "<string>",
     select: frozenset[str] | None = None,
 ) -> list[Finding]:
-    """Lint one source string; returns unsuppressed findings, sorted."""
-    ctx = FileContext(path, source)
+    """Lint one source string (file rules + the whole-program pass over
+    the one-file project); returns unsuppressed findings, sorted."""
+    return run_project_sources({path: source}, select=select)
+
+
+def run_project_sources(
+    sources: dict[str, str], *, select: frozenset[str] | None = None
+) -> list[Finding]:
+    """Lint several sources as ONE project — the multi-module entry the
+    cross-module fixtures (lock-order inversion across files) use."""
     findings: set[Finding] = set()
-    for rule_id, rule in RULES.items():
-        if select is not None and rule_id not in select:
-            continue
-        findings.update(rule.check(ctx))
-    return sorted(Suppressions(source).filter(sorted(findings)))
+    facts: list[FileFacts] = []
+    suppressions: dict[str, Suppressions] = {}
+    for path, source in sources.items():
+        ctx = FileContext(path, source)
+        findings.update(_file_findings(ctx, select))
+        facts.append(extract_facts(ctx))
+        suppressions[path] = Suppressions(source)
+    all_findings = sorted(findings) + _project_findings(
+        ProjectContext(facts), select
+    )
+    return sorted(set(_filter_by_file(all_findings, suppressions)))
 
 
 def iter_python_files(paths: list[str]):
@@ -55,10 +123,39 @@ def iter_python_files(paths: list[str]):
             yield p
 
 
+def _analyze_one(
+    path: str, select: frozenset[str] | None
+) -> tuple[list[Finding], FileFacts | None, Suppressions | None, str | None]:
+    """One file's full per-file analysis; the ``--jobs`` worker (facts
+    and findings are plain picklable dataclasses — ASTs never cross the
+    process boundary)."""
+    try:
+        source = Path(path).read_text(encoding="utf-8")
+        ctx = FileContext(path, source)
+    except (OSError, SyntaxError, ValueError) as exc:
+        # ValueError: ast.parse on null bytes (py <= 3.11) — one
+        # pathological file must not abort the whole run.
+        return [], None, None, f"{path}: {exc}"
+    return (
+        sorted(_file_findings(ctx, select)),
+        extract_facts(ctx),
+        Suppressions(source),
+        None,
+    )
+
+
 def run_paths(
-    paths: list[str], *, select: frozenset[str] | None = None
+    paths: list[str],
+    *,
+    select: frozenset[str] | None = None,
+    jobs: int = 1,
 ) -> tuple[list[Finding], list[str]]:
-    """Lint files/trees; returns (findings, path/parse errors)."""
+    """Lint files/trees; returns (findings, path/parse errors).
+
+    The whole-program pass sees exactly the files given: a full-tree run
+    gets full cross-module precision, a changed-files run (pre-commit)
+    gets a partial view — sound for what it sees, CI closes the gap.
+    """
     findings: list[Finding] = []
     errors: list[str] = []
     # A bad path argument must fail the gate, not turn it into a
@@ -70,14 +167,22 @@ def run_paths(
             errors.append(f"{raw}: no such file or directory")
         elif not p.is_dir() and p.suffix != ".py":
             errors.append(f"{raw}: not a directory or .py file")
-    for file in iter_python_files(paths):
-        try:
-            source = file.read_text(encoding="utf-8")
-            findings.extend(
-                run_source(source, path=str(file), select=select)
+    files = [str(f) for f in iter_python_files(paths)]
+    facts: list[FileFacts] = []
+    suppressions: dict[str, Suppressions] = {}
+    if jobs > 1 and len(files) > 1:
+        with ProcessPoolExecutor(max_workers=jobs) as pool:
+            results = list(
+                pool.map(_analyze_one, files, [select] * len(files))
             )
-        except (OSError, SyntaxError, ValueError) as exc:
-            # ValueError: ast.parse on null bytes (py <= 3.11) — one
-            # pathological file must not abort the whole run.
-            errors.append(f"{file}: {exc}")
-    return findings, errors
+    else:
+        results = [_analyze_one(f, select) for f in files]
+    for path, (file_findings, file_facts, sup, error) in zip(files, results):
+        if error is not None:
+            errors.append(error)
+            continue
+        findings.extend(file_findings)
+        facts.append(file_facts)
+        suppressions[path] = sup
+    findings.extend(_project_findings(ProjectContext(facts), select))
+    return sorted(set(_filter_by_file(findings, suppressions))), errors

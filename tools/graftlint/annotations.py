@@ -1,0 +1,66 @@
+"""``# graft: <key>=<value>`` source annotations.
+
+The whole-program pass needs facts static analysis cannot always
+recover, so the code may declare them where they hold (always next to
+the thing they describe, never in a config file):
+
+- ``# graft: thread=<role>`` on (or directly above) a ``def`` marks the
+  function as a thread entry point with that role — the escape hatch
+  for targets the ``threading.Thread(target=...)`` scan cannot resolve
+  (callables passed through parameters, e.g. the pipeline's per-stage
+  loops handed to ``_guarded``, or callbacks registered with another
+  component that invokes them from its worker).
+- ``# graft: key-derived=<attr>[,<attr>...]`` inside a class body
+  declares attributes that are pure functions of attributes already in
+  the class's staging/fusion key tuples (JGL014): reading them under
+  trace cannot drift from the key, so they need no key entry of their
+  own. The justification belongs in the same comment, after the list.
+
+Like suppressions, annotations are read from COMMENT tokens only — the
+same text inside a docstring documents the syntax without activating it.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+from .suppress import _iter_comments
+
+# Value stops at whitespace so trailing prose — the recommended
+# justification style — does not join the value.
+_ANNOT_RE = re.compile(r"#\s*graft:\s*([a-z][a-z-]*)\s*=\s*([^\s#]+)")
+
+
+@dataclass(frozen=True)
+class Annotation:
+    lineno: int
+    key: str
+    value: str
+
+
+def parse_annotations(source: str) -> list[Annotation]:
+    out: list[Annotation] = []
+    for lineno, comment in _iter_comments(source):
+        for m in _ANNOT_RE.finditer(comment):
+            out.append(Annotation(lineno, m.group(1), m.group(2)))
+    return out
+
+
+def thread_roles_by_line(annotations: list[Annotation]) -> dict[int, str]:
+    """{lineno: role} for every ``thread=`` annotation; a function picks
+    up the role when the annotation sits on its ``def`` line or the line
+    directly above it (same placement contract as suppressions)."""
+    return {a.lineno: a.value for a in annotations if a.key == "thread"}
+
+
+def key_derived_attrs(
+    annotations: list[Annotation], first_line: int, last_line: int
+) -> frozenset[str]:
+    """Attributes declared ``key-derived`` by annotations inside the
+    given class body line range."""
+    out: set[str] = set()
+    for a in annotations:
+        if a.key == "key-derived" and first_line <= a.lineno <= last_line:
+            out.update(s.strip() for s in a.value.split(",") if s.strip())
+    return frozenset(out)

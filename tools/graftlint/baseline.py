@@ -1,0 +1,72 @@
+"""Finding baselines: ratchet new code clean without a flag-day.
+
+A baseline is a JSON file of known findings. ``--baseline FILE``
+subtracts them from a run — matching on ``(path, rule, message)``,
+deliberately NOT on line number, so unrelated edits that shift lines do
+not resurrect baselined findings. ``--write-baseline`` snapshots the
+current findings into the file.
+
+The contract that keeps a baseline from becoming a landfill: entries
+are a debt ledger, not a suppression mechanism — new findings never
+enter it silently (the gate fails instead), stale entries (nothing
+matched them) are reported so they get pruned, and the acceptance bar
+for the hot path is *zero* entries for ``core/`` (ISSUE 4). Findings
+that are wrong-by-design belong in inline ``# graftlint: disable=``
+suppressions next to a justification, never here.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from .findings import Finding
+
+_VERSION = 1
+
+
+def _key(finding: Finding) -> tuple[str, str, str]:
+    return (finding.path, finding.rule, finding.message)
+
+
+def load_baseline(path: str | Path) -> set[tuple[str, str, str]]:
+    """Parse a baseline file into match keys. A missing file is an
+    error at the CLI layer (a typo'd path must not silently disable the
+    subtraction); an empty findings list is the normal clean state."""
+    data = json.loads(Path(path).read_text(encoding="utf-8"))
+    if not isinstance(data, dict) or data.get("version") != _VERSION:
+        raise ValueError(
+            f"{path}: not a graftlint baseline (want version {_VERSION})"
+        )
+    out: set[tuple[str, str, str]] = set()
+    for entry in data.get("findings", []):
+        out.add((entry["path"], entry["rule"], entry["message"]))
+    return out
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: set[tuple[str, str, str]]
+) -> tuple[list[Finding], list[tuple[str, str, str]]]:
+    """(new findings, stale baseline entries). A baseline entry masks
+    every finding with its key — one entry per distinct message, not
+    per occurrence, so a masked finding duplicated by a refactor stays
+    masked."""
+    kept = [f for f in findings if _key(f) not in baseline]
+    matched = {_key(f) for f in findings if _key(f) in baseline}
+    stale = sorted(baseline - matched)
+    return kept, stale
+
+
+def write_baseline(path: str | Path, findings: list[Finding]) -> None:
+    entries = sorted(
+        {_key(f) for f in findings}
+    )  # dedupe; order-stable for clean diffs
+    payload = {
+        "version": _VERSION,
+        "findings": [
+            {"path": p, "rule": r, "message": m} for p, r, m in entries
+        ],
+    }
+    Path(path).write_text(
+        json.dumps(payload, indent=2) + "\n", encoding="utf-8"
+    )

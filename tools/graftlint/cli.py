@@ -3,9 +3,12 @@
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from . import RULES, run_paths
+from .baseline import apply_baseline, load_baseline, write_baseline
+from .sarif import write_sarif
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -26,6 +29,39 @@ def main(argv: list[str] | None = None) -> int:
         help="comma-separated rule ids to run (default: all)",
     )
     parser.add_argument(
+        "--jobs",
+        type=int,
+        default=1,
+        metavar="N",
+        help=(
+            "parallel file-analysis processes (0 = one per CPU); the "
+            "whole-program pass always runs once, in this process"
+        ),
+    )
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        metavar="FILE",
+        help=(
+            "JSON baseline of known findings to subtract "
+            "(graftlint-baseline.json); stale entries are reported"
+        ),
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="snapshot current findings into --baseline FILE and exit 0",
+    )
+    parser.add_argument(
+        "--sarif",
+        default=None,
+        metavar="FILE",
+        help=(
+            "also write findings as a SARIF 2.1.0 report (written on "
+            "both clean and failing runs, for CI code-scanning upload)"
+        ),
+    )
+    parser.add_argument(
         "--list-rules", action="store_true", help="print rule ids and exit"
     )
     parser.add_argument(
@@ -37,6 +73,8 @@ def main(argv: list[str] | None = None) -> int:
         for rule_id, rule in sorted(RULES.items()):
             print(f"{rule_id}  {rule.summary}")
         return 0
+    if args.write_baseline and not args.baseline:
+        parser.error("--write-baseline requires --baseline FILE")
 
     select = (
         frozenset(s.strip() for s in args.select.split(",") if s.strip())
@@ -45,15 +83,58 @@ def main(argv: list[str] | None = None) -> int:
     )
     if select is not None and (unknown := select - set(RULES)):
         parser.error(f"unknown rule ids: {sorted(unknown)}")
+    jobs = args.jobs if args.jobs > 0 else (os.cpu_count() or 1)
 
-    findings, errors = run_paths(args.paths, select=select)
+    findings, errors = run_paths(args.paths, select=select, jobs=jobs)
+
+    if args.write_baseline:
+        # Parse/path errors abort BEFORE writing: a snapshot taken over
+        # a partly-unreadable tree would under-record, and the truncated
+        # file on disk would silently mask findings once the broken
+        # source parses again.
+        if errors:
+            for error in errors:
+                print(f"graftlint: cannot analyze {error}", file=sys.stderr)
+            print(
+                "graftlint: baseline NOT written (fix the errors above "
+                "first)",
+                file=sys.stderr,
+            )
+            return 1
+        write_baseline(args.baseline, findings)
+        if not args.quiet:
+            print(
+                f"graftlint: wrote {len(findings)} finding(s) to "
+                f"{args.baseline}"
+            )
+        return 0
+
+    stale: list[tuple[str, str, str]] = []
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError, TypeError) as exc:
+            print(f"graftlint: bad baseline: {exc}", file=sys.stderr)
+            return 1
+        findings, stale = apply_baseline(findings, baseline)
+
+    if args.sarif:
+        write_sarif(args.sarif, findings, errors)
+
     for finding in findings:
         print(finding.render())
     for error in errors:
         print(f"graftlint: cannot analyze {error}", file=sys.stderr)
+    for path, rule_id, _message in stale:
+        print(
+            f"graftlint: stale baseline entry {rule_id} for {path} "
+            "(nothing matches it; prune the baseline)",
+            file=sys.stderr,
+        )
     if not args.quiet:
         print(
             f"graftlint: {len(findings)} finding(s)"
             + (f", {len(errors)} file error(s)" if errors else "")
+            + (f", {len(stale)} stale baseline entr(y/ies)" if stale else "")
         )
     return 1 if findings or errors else 0

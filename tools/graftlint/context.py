@@ -33,8 +33,16 @@ class FileContext:
         self.path = path
         self.source = source
         self.tree = ast.parse(source)
+        # ONE tree walk feeds every index below; rules iterate the cached
+        # node lists (``nodes``/``all_nodes``) instead of re-walking the
+        # AST per rule set — the parse+walk cost is paid once per file
+        # across all rules, file-scoped and project-scoped alike.
+        self.all_nodes: list[ast.AST] = []
+        self._by_type: dict[type, list[ast.AST]] = defaultdict(list)
         self._parent: dict[ast.AST, ast.AST] = {}
         for node in ast.walk(self.tree):
+            self.all_nodes.append(node)
+            self._by_type[type(node)].append(node)
             for child in ast.iter_child_nodes(node):
                 self._parent[child] = node
         self._names: dict[str, str] = {}
@@ -44,31 +52,39 @@ class FileContext:
         #: by attribute name alone, conservatively to every same-named def).
         self.defs_by_name: dict[str, list[ast.AST]] = defaultdict(list)
         self.functions: list[FuncNode] = []
-        for node in ast.walk(self.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self.defs_by_name[node.name].append(node)
-                self.functions.append(node)
-            elif isinstance(node, ast.Lambda):
-                self.functions.append(node)
+        for node in self.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            self.defs_by_name[node.name].append(node)
+            self.functions.append(node)
+        self.functions.extend(self.nodes(ast.Lambda))
         self._partial_wrappers = self._collect_partial_wrappers()
         self.jit_calls: list[ast.Call] = []
         self.jit_regions: set[ast.AST] = set()
         self._collect_jit_regions()
         self._close_over_calls()
 
+    def nodes(self, *types: type) -> list[ast.AST]:
+        """All nodes of the given type(s), from the one cached walk."""
+        if len(types) == 1:
+            return self._by_type.get(types[0], [])
+        out: list[ast.AST] = []
+        for t in types:
+            out.extend(self._by_type.get(t, []))
+        return out
+
     # -- imports / name resolution ----------------------------------------
     def _collect_imports(self) -> None:
-        for node in ast.walk(self.tree):
-            if isinstance(node, ast.Import):
-                for alias in node.names:
-                    self._names[alias.asname or alias.name.split(".")[0]] = (
-                        alias.name if alias.asname else alias.name.split(".")[0]
-                    )
-            elif isinstance(node, ast.ImportFrom) and node.module:
-                for alias in node.names:
-                    self._names[alias.asname or alias.name] = (
-                        f"{node.module}.{alias.name}"
-                    )
+        for node in self.nodes(ast.Import):
+            for alias in node.names:
+                self._names[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0]
+                )
+        for node in self.nodes(ast.ImportFrom):
+            if not node.module:
+                continue
+            for alias in node.names:
+                self._names[alias.asname or alias.name] = (
+                    f"{node.module}.{alias.name}"
+                )
 
     def qualname(self, node: ast.AST) -> str | None:
         """Dotted name with import aliases resolved (``np.asarray`` ->
@@ -85,10 +101,9 @@ class FileContext:
         """Local names bound to ``partial(jax.jit, ...)``-style wrappers
         (the shard_map staging idiom in parallel/)."""
         out = set()
-        for node in ast.walk(self.tree):
+        for node in self.nodes(ast.Assign):
             if (
-                isinstance(node, ast.Assign)
-                and isinstance(node.value, ast.Call)
+                isinstance(node.value, ast.Call)
                 and self.qualname(node.value.func) == "functools.partial"
                 and node.value.args
                 and self.qualname(node.value.args[0]) in JIT_WRAPPERS
@@ -117,21 +132,21 @@ class FileContext:
                 self._seed(target.args[0])
 
     def _collect_jit_regions(self) -> None:
-        for node in ast.walk(self.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                for dec in node.decorator_list:
-                    if self.is_jit_wrapper(dec):
-                        self.jit_regions.add(node)
-                    elif isinstance(dec, ast.Call) and (
-                        self.is_jit_wrapper(dec.func)
-                        or (
-                            self.qualname(dec.func) == "functools.partial"
-                            and dec.args
-                            and self.qualname(dec.args[0]) in JIT_WRAPPERS
-                        )
-                    ):
-                        self.jit_regions.add(node)
-            elif isinstance(node, ast.Call) and self.is_jit_wrapper(node.func):
+        for node in self.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            for dec in node.decorator_list:
+                if self.is_jit_wrapper(dec):
+                    self.jit_regions.add(node)
+                elif isinstance(dec, ast.Call) and (
+                    self.is_jit_wrapper(dec.func)
+                    or (
+                        self.qualname(dec.func) == "functools.partial"
+                        and dec.args
+                        and self.qualname(dec.args[0]) in JIT_WRAPPERS
+                    )
+                ):
+                    self.jit_regions.add(node)
+        for node in self.nodes(ast.Call):
+            if self.is_jit_wrapper(node.func):
                 self.jit_calls.append(node)
                 if node.args:
                     self._seed(node.args[0])

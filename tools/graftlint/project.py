@@ -1,0 +1,1071 @@
+"""Whole-program analysis layer: symbol table, call graph, thread roles.
+
+The per-file rules (JGL001–JGL010) are lexical; the concurrency bugs
+that survived them live *between* modules — a lock taken in one order in
+``core/message_batcher.py`` and the opposite order in the pipeline, an
+attribute written from two thread entry points defined files apart, a
+``stage_key`` that drifts from the attributes its jitted kernel actually
+reads. This module builds the project-wide facts those rules need:
+
+- **FileFacts** — a picklable per-file summary (functions, resolved-ish
+  call sites, lock acquisitions with lexically-held locks, attribute
+  writes, thread entry points, queue hand-offs, key/jit attribute
+  reads). Extraction runs next to the per-file rules, so ``--jobs``
+  workers ship facts back instead of ASTs.
+- **ProjectContext** — aggregates facts: class/function symbol tables,
+  a call graph resolved only where the receiver type is known (self
+  calls, constructor-typed attributes/locals, annotated parameters,
+  module-level functions — precision over recall: an unresolved call
+  adds no edge, because a speculative edge in a gating linter
+  manufactures false cycles), thread-role inference, and the
+  cross-module lock-order graph.
+
+Thread roles
+------------
+Entry points are discovered from ``threading.Thread(target=...)``
+constructions and ``<executor>.submit(fn, ...)`` calls, plus the
+``# graft: thread=<role>`` annotation for targets that flow through
+parameters (the pipeline hands its stage loops to ``_guarded`` as
+``args`` — no static scan resolves that). Roles propagate caller →
+callee over the resolved call graph; the service thread, role
+``"main"``, seeds at call-graph *sources* (functions with no resolved
+in-project caller that are not thread entries — they may be called from
+anywhere) and propagates like any other role, so a helper reached only
+through a thread entry carries exactly that thread's role. The
+inference is an *under*-approximation by construction: a missing edge
+loses a role and can miss a race, but never invents one — the right
+direction for a linter that gates CI.
+
+Lock identity
+-------------
+``self._lock`` in a method of class ``C`` canonicalizes to ``C._lock``;
+a lock reached through a constructor-typed attribute or annotated
+parameter canonicalizes to its owner class the same way; module globals
+to ``module.NAME``; everything else is function-private (participates
+in nesting edges inside that function, never unifies across functions).
+Class names duplicated across modules are treated as unresolvable
+(edges involving them are dropped) rather than risking cross-class lock
+unification.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+from dataclasses import dataclass, field
+from pathlib import PurePath
+
+from .annotations import (
+    key_derived_attrs,
+    parse_annotations,
+    thread_roles_by_line,
+)
+from .context import FileContext
+
+__all__ = ["FileFacts", "ProjectContext", "extract_facts"]
+
+#: Mutable staged-event carriers that must be detached/copied before a
+#: cross-thread queue hand-off (JGL013, ADR 0111 detach discipline).
+TRACKED_MUTABLE = frozenset({"EventBatch", "StagedEvents", "DataArray"})
+
+#: Methods whose bodies define the staging/fusion cache keys (JGL014).
+_KEY_EXACT = ("stage_key", "partition_key", "fuse_key")
+
+#: Writes in these methods happen before any worker thread can exist.
+_PRE_THREAD_METHODS = frozenset({"__init__", "__post_init__", "__new__"})
+
+_METHODS_DETACH = frozenset({"detach", "copy", "deepcopy"})
+
+
+def _is_key_func(name: str) -> bool:
+    return name in _KEY_EXACT or name.startswith(
+        tuple(k + "_" for k in _KEY_EXACT)
+    )
+
+
+def module_of(path: str) -> str:
+    """Dotted module name for a file path; components after the LAST
+    ``src`` segment when present (the layout convention here)."""
+    parts = list(PurePath(path).parts)
+    if "src" in parts:
+        parts = parts[len(parts) - 1 - parts[::-1].index("src"):][1:]
+    name = ".".join(parts)
+    if name.endswith(".py"):
+        name = name[:-3]
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    return name or "<module>"
+
+
+# -- picklable per-file facts ----------------------------------------------
+
+
+@dataclass(frozen=True)
+class FuncFact:
+    qual: str  # "<path>::Class.method" | "<path>::func"
+    name: str
+    cls: str | None
+    module: str
+    path: str
+    lineno: int
+    roles: tuple[str, ...]  # annotated thread roles
+    params: tuple[str, ...]  # positional params, self/cls stripped
+
+
+@dataclass(frozen=True)
+class CallFact:
+    caller: str
+    callee: str  # bare name
+    receiver_cls: str | None  # resolved class (self calls: own class)
+    plain: bool  # bare-name call (module-level function)
+    module: str
+    lineno: int
+    held: tuple[str, ...]  # lock ids lexically held at the call site
+    #: Import-resolved dotted name when the callee is an imported name
+    #: ("pkg.mod.fn"); None for locally-defined names. Resolution uses
+    #: it to find the defining module instead of guessing globally.
+    hint: str | None = None
+
+
+@dataclass(frozen=True)
+class AcquireFact:
+    func: str
+    lock: str
+    path: str
+    lineno: int
+    held: tuple[str, ...]  # lock ids held when acquiring
+
+
+@dataclass(frozen=True)
+class WriteFact:
+    path: str
+    cls: str
+    attr: str
+    func: str  # qual of the (outermost) enclosing function
+    method: str  # bare method name
+    lineno: int
+    held: tuple[str, ...]
+    aug: bool
+
+
+@dataclass(frozen=True)
+class ThreadEntryFact:
+    target: str  # bare callee name
+    receiver_cls: str | None
+    plain: bool
+    module: str
+    role: str
+    path: str
+    lineno: int
+    hint: str | None = None
+
+
+@dataclass(frozen=True)
+class PutFact:
+    """Direct ``queue.put(<tracked mutable>)`` without detach/copy."""
+
+    func: str
+    value: str
+    type_name: str
+    path: str
+    lineno: int
+
+
+@dataclass(frozen=True)
+class ForwardFact:
+    """Function parameter that flows into a ``.put()`` in its body."""
+
+    func: str
+    index: int  # positional index, self excluded
+
+
+@dataclass(frozen=True)
+class TypedArgFact:
+    """Call site passing a tracked mutable value positionally."""
+
+    caller: str
+    callee: str
+    receiver_cls: str | None
+    plain: bool
+    module: str
+    index: int
+    value: str
+    type_name: str
+    path: str
+    lineno: int
+    hint: str | None = None
+
+
+@dataclass(frozen=True)
+class KeyClassFact:
+    """JGL014 inputs for one class that defines cache-key functions."""
+
+    path: str
+    cls: str
+    key_funcs: tuple[str, ...]
+    covered: tuple[str, ...]  # self-attr roots mentioned in key funcs
+    derived: tuple[str, ...]  # # graft: key-derived= declarations
+    jit_reads: tuple[tuple[str, int, str], ...]  # (attr, lineno, method)
+
+
+@dataclass
+class FileFacts:
+    path: str
+    module: str
+    functions: list[FuncFact] = field(default_factory=list)
+    calls: list[CallFact] = field(default_factory=list)
+    acquires: list[AcquireFact] = field(default_factory=list)
+    writes: list[WriteFact] = field(default_factory=list)
+    thread_entries: list[ThreadEntryFact] = field(default_factory=list)
+    puts: list[PutFact] = field(default_factory=list)
+    forwards: list[ForwardFact] = field(default_factory=list)
+    typed_args: list[TypedArgFact] = field(default_factory=list)
+    key_classes: list[KeyClassFact] = field(default_factory=list)
+    classes: dict[str, tuple[str, ...]] = field(default_factory=dict)
+
+
+# -- extraction -------------------------------------------------------------
+
+
+def _annotation_class(node: ast.AST | None) -> str | None:
+    """Bare class name from a parameter/attribute annotation, unwrapping
+    ``X | None`` and ``Optional[X]``."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        return _annotation_class(node.left) or _annotation_class(node.right)
+    if isinstance(node, ast.Subscript):  # Optional[X]
+        base = node.value
+        if isinstance(base, ast.Name) and base.id == "Optional":
+            return _annotation_class(node.slice)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:  # string annotation: "EventBatch"
+            return _annotation_class(ast.parse(node.value, mode="eval").body)
+        except SyntaxError:
+            return None
+    return None
+
+
+def _call_class(node: ast.AST) -> str | None:
+    """Bare class-name candidate from an assignment RHS: ``Foo(...)``,
+    ``x or Foo(...)``, ``Foo(...) if c else Bar(...)`` (first wins)."""
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            return fn.id
+        if isinstance(fn, ast.Attribute):
+            return fn.attr
+        return None
+    if isinstance(node, ast.BoolOp):
+        for value in node.values:
+            got = _call_class(value)
+            if got:
+                return got
+    if isinstance(node, ast.IfExp):
+        return _call_class(node.body) or _call_class(node.orelse)
+    return None
+
+
+def _queue_names(ctx: FileContext) -> frozenset[str]:
+    """Names (locals and ``self.<attr>`` attrs) bound to stdlib queue
+    constructors anywhere in the file."""
+    out: set[str] = set()
+    for node in ctx.nodes(ast.Assign, ast.AnnAssign):
+        call = node.value
+        if not isinstance(call, ast.Call):
+            continue
+        qual = ctx.qualname(call.func)
+        if qual is None or not qual.startswith("queue."):
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for target in targets:
+            if isinstance(target, ast.Name):
+                out.add(target.id)
+            elif isinstance(target, ast.Attribute):
+                out.add(target.attr)
+    return frozenset(out)
+
+
+def _module_lock_globals(ctx: FileContext) -> frozenset[str]:
+    out: set[str] = set()
+    for node in ast.iter_child_nodes(ctx.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        value_lockish = isinstance(node.value, ast.Call) and (
+            (ctx.qualname(node.value.func) or "").startswith("threading.")
+        )
+        for target in node.targets:
+            if isinstance(target, ast.Name) and (
+                FileContext._lockish(target) or value_lockish
+            ):
+                out.add(target.id)
+    return frozenset(out)
+
+
+class _FunctionExtractor:
+    """One outermost function's walk: tracks lexically-held locks and
+    local type bindings; nested defs/lambdas merge into their owner
+    (their facts attribute to it, with held locks reset — a closure body
+    does not run under the lock its definition site holds)."""
+
+    def __init__(
+        self,
+        facts: FileFacts,
+        ctx: FileContext,
+        fn: ast.FunctionDef | ast.AsyncFunctionDef,
+        qual: str,
+        cls: str | None,
+        attr_types: dict[tuple[str, str], str],
+        queue_names: frozenset[str],
+        lock_globals: frozenset[str],
+    ) -> None:
+        self.facts = facts
+        self.ctx = ctx
+        self.fn = fn
+        self.qual = qual
+        self.cls = cls
+        self.attr_types = attr_types
+        self.queue_names = queue_names
+        self.lock_globals = lock_globals
+        args = fn.args
+        ordered = [a.arg for a in (*args.posonlyargs, *args.args)]
+        if ordered and ordered[0] in ("self", "cls"):
+            ordered = ordered[1:]
+        self.params = tuple(ordered)
+        # name -> (type, clean) ; clean = produced by detach()/copy()
+        self.local_types: dict[str, tuple[str, bool]] = {}
+        for a in (*args.posonlyargs, *args.args, *args.kwonlyargs):
+            t = _annotation_class(a.annotation)
+            if t:
+                self.local_types[a.arg] = (t, False)
+        self.put_params: set[int] = set()
+
+    # -- naming -------------------------------------------------------------
+    def receiver_class(self, expr: ast.AST) -> str | None:
+        if isinstance(expr, ast.Name):
+            if expr.id == "self":
+                return self.cls
+            bound = self.local_types.get(expr.id)
+            return bound[0] if bound else None
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and self.cls is not None
+        ):
+            return self.attr_types.get((self.cls, expr.attr))
+        return None
+
+    def lock_id(self, expr: ast.AST) -> str:
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and self.cls is not None
+        ):
+            return f"{self.cls}.{expr.attr}"
+        if isinstance(expr, ast.Name):
+            if expr.id in self.lock_globals:
+                return f"{self.facts.module}.{expr.id}"
+            return f"{self.qual}:{expr.id}"  # function-private
+        if isinstance(expr, ast.Attribute):
+            owner = self.receiver_class(expr.value)
+            if owner is not None:
+                return f"{owner}.{expr.attr}"
+            return f"{self.qual}:?{expr.attr}"  # opaque, never unifies
+        return f"{self.qual}:?with"
+
+    def _is_executor(self, expr: ast.AST) -> bool:
+        typed = self.receiver_class(expr)
+        if typed is not None and ("Executor" in typed or "Pool" in typed):
+            return True
+        name = None
+        if isinstance(expr, ast.Name):
+            name = expr.id
+        elif isinstance(expr, ast.Attribute):
+            name = expr.attr
+        return name is not None and (
+            "pool" in name.lower() or "executor" in name.lower()
+        )
+
+    # -- taint helpers ------------------------------------------------------
+    def _tracked_value(self, expr: ast.AST) -> tuple[str, str] | None:
+        """(name, type) when ``expr`` is a name bound to a tracked
+        mutable type that has NOT been detached/copied."""
+        if not isinstance(expr, ast.Name):
+            return None
+        bound = self.local_types.get(expr.id)
+        if bound and bound[0] in TRACKED_MUTABLE and not bound[1]:
+            return expr.id, bound[0]
+        return None
+
+    def _is_detaching(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Call):
+            fn = expr.func
+            if isinstance(fn, ast.Attribute) and fn.attr in _METHODS_DETACH:
+                return True
+            if isinstance(fn, ast.Name) and fn.id in _METHODS_DETACH:
+                return True
+        return False
+
+    # -- the walk -----------------------------------------------------------
+    def run(self) -> None:
+        for stmt in self.fn.body:
+            self._visit(stmt, ())
+        if self.put_params:
+            for idx in sorted(self.put_params):
+                self.facts.forwards.append(ForwardFact(self.qual, idx))
+
+    def _visit(self, node: ast.AST, held: tuple[str, ...]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in node.items:
+                self._visit(item.context_expr, inner)
+                if FileContext._lockish(item.context_expr):
+                    lid = self.lock_id(item.context_expr)
+                    self.facts.acquires.append(
+                        AcquireFact(
+                            self.qual,
+                            lid,
+                            self.facts.path,
+                            item.context_expr.lineno,
+                            inner,
+                        )
+                    )
+                    inner = inner + (lid,)
+            for stmt in node.body:
+                self._visit(stmt, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested def: merge into owner, locks reset (see class doc).
+            for a in (
+                *node.args.posonlyargs,
+                *node.args.args,
+                *node.args.kwonlyargs,
+            ):
+                t = _annotation_class(a.annotation)
+                if t:
+                    self.local_types.setdefault(a.arg, (t, False))
+            for stmt in node.body:
+                self._visit(stmt, ())
+            return
+        if isinstance(node, ast.Lambda):
+            self._visit(node.body, ())
+            return
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._handle_assign(node, held)
+        if isinstance(node, ast.Call):
+            self._handle_call(node, held)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+    def _handle_assign(self, node, held: tuple[str, ...]) -> None:
+        if isinstance(node, ast.AugAssign):
+            targets, value, aug = [node.target], None, True
+        elif isinstance(node, ast.Assign):
+            targets, value, aug = node.targets, node.value, False
+        else:
+            if node.value is None:
+                return  # bare annotation, not a write
+            targets, value, aug = [node.target], node.value, False
+        for target in targets:
+            elts = (
+                target.elts
+                if isinstance(target, (ast.Tuple, ast.List))
+                else [target]
+            )
+            for t in elts:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    and self.cls is not None
+                ):
+                    self.facts.writes.append(
+                        WriteFact(
+                            self.facts.path,
+                            self.cls,
+                            t.attr,
+                            self.qual,
+                            getattr(self.fn, "name", "<lambda>"),
+                            node.lineno,
+                            held,
+                            aug,
+                        )
+                    )
+                elif isinstance(t, ast.Name) and value is not None:
+                    if self._is_detaching(value):
+                        src = value.func
+                        base = (
+                            src.value
+                            if isinstance(src, ast.Attribute)
+                            else (value.args[0] if value.args else None)
+                        )
+                        tv = (
+                            self._tracked_value(base)
+                            if base is not None
+                            else None
+                        )
+                        if tv:
+                            self.local_types[t.id] = (tv[1], True)
+                        continue
+                    typed = _call_class(value)
+                    if typed:
+                        self.local_types[t.id] = (typed, False)
+
+    def _handle_call(self, node: ast.Call, held: tuple[str, ...]) -> None:
+        ctx = self.ctx
+        qual = ctx.qualname(node.func)
+        # Thread entry points: threading.Thread(target=...).
+        if qual == "threading.Thread":
+            target = next(
+                (kw.value for kw in node.keywords if kw.arg == "target"),
+                None,
+            )
+            if target is not None:
+                name_kw = next(
+                    (kw.value for kw in node.keywords if kw.arg == "name"),
+                    None,
+                )
+                self._record_entry(target, name_kw, node.lineno)
+        # Executor submits: <pool>.submit(fn, ...) — only on receivers
+        # that look like executors (typed as one, or pool/executor in
+        # the name). Any-`.submit()` would also match data submissions
+        # (IngestPipeline.submit takes a *batch*) and could invent a
+        # thread role, violating the never-invent under-approximation.
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "submit"
+            and node.args
+            and self._is_executor(node.func.value)
+        ):
+            self._record_entry(node.args[0], None, node.lineno)
+
+        # Queue hand-offs (JGL013).
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("put", "put_nowait")
+            and node.args
+        ):
+            base = node.func.value
+            base_name = None
+            if isinstance(base, ast.Name):
+                base_name = base.id
+            elif isinstance(base, ast.Attribute):
+                base_name = base.attr
+            queue_like = base_name in self.queue_names or (
+                base_name in self.params
+            )
+            if queue_like:
+                self._record_put(node.args[0], node.lineno)
+
+        # Call-graph fact.
+        callee = None
+        receiver_cls = None
+        plain = False
+        hint = None
+        if isinstance(node.func, ast.Name):
+            callee = node.func.id
+            plain = True
+            # Imported names resolve through their defining module, not
+            # by a global bare-name guess (a same-named function in an
+            # unrelated module must never absorb this call).
+            if qual is not None and qual != callee:
+                hint = qual
+        elif isinstance(node.func, ast.Attribute):
+            callee = node.func.attr
+            receiver_cls = self.receiver_class(node.func.value)
+        if callee:
+            self.facts.calls.append(
+                CallFact(
+                    self.qual,
+                    callee,
+                    receiver_cls,
+                    plain,
+                    self.facts.module,
+                    node.lineno,
+                    held,
+                    hint,
+                )
+            )
+            # Tracked mutable values crossing a call boundary (JGL013
+            # put-forwarders resolve these at the project level).
+            for idx, arg in enumerate(node.args):
+                tv = self._tracked_value(arg)
+                if tv:
+                    self.facts.typed_args.append(
+                        TypedArgFact(
+                            self.qual,
+                            callee,
+                            receiver_cls,
+                            plain,
+                            self.facts.module,
+                            idx,
+                            tv[0],
+                            tv[1],
+                            self.facts.path,
+                            node.lineno,
+                            hint,
+                        )
+                    )
+
+    def _record_entry(
+        self, target: ast.AST, name_kw: ast.AST | None, lineno: int
+    ) -> None:
+        bare = None
+        receiver_cls = None
+        plain = False
+        hint = None
+        if isinstance(target, ast.Name):
+            bare, plain = target.id, True
+            resolved = self.ctx.qualname(target)
+            if resolved is not None and resolved != bare:
+                hint = resolved
+        elif isinstance(target, ast.Attribute):
+            bare = target.attr
+            receiver_cls = self.receiver_class(target.value)
+        if bare is None:
+            return
+        role = bare.lstrip("_")
+        if isinstance(name_kw, ast.Constant) and isinstance(
+            name_kw.value, str
+        ):
+            role = name_kw.value
+        self.facts.thread_entries.append(
+            ThreadEntryFact(
+                bare,
+                receiver_cls,
+                plain,
+                self.facts.module,
+                role,
+                self.facts.path,
+                lineno,
+                hint,
+            )
+        )
+
+    def _record_put(self, value: ast.AST, lineno: int) -> None:
+        elts = (
+            value.elts if isinstance(value, (ast.Tuple, ast.List)) else [value]
+        )
+        for elt in elts:
+            if self._is_detaching(elt):
+                continue
+            tv = self._tracked_value(elt)
+            if tv:
+                self.facts.puts.append(
+                    PutFact(self.qual, tv[0], tv[1], self.facts.path, lineno)
+                )
+            if isinstance(elt, ast.Name) and elt.id in self.params:
+                bound = self.local_types.get(elt.id)
+                # A param already typed+flagged is reported at the put
+                # itself; only untyped params become forwarders.
+                if not (bound and bound[0] in TRACKED_MUTABLE):
+                    self.put_params.add(self.params.index(elt.id))
+
+
+def extract_facts(ctx: FileContext) -> FileFacts:
+    """The whole-program facts for one analyzed file."""
+    facts = FileFacts(path=ctx.path, module=module_of(ctx.path))
+    annotations = parse_annotations(ctx.source)
+    roles_by_line = thread_roles_by_line(annotations)
+    queue_names = _queue_names(ctx)
+    lock_globals = _module_lock_globals(ctx)
+
+    # Pass 1: classes, methods, constructor-typed instance attributes.
+    attr_types: dict[tuple[str, str], str] = {}
+    class_methods: dict[str, tuple[str, ...]] = {}
+    for cls in ctx.nodes(ast.ClassDef):
+        methods = tuple(
+            n.name
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        )
+        class_methods[cls.name] = methods
+        for node in ast.walk(cls):
+            target = None
+            value = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign):
+                target = node.target
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                typed = None
+                if isinstance(node, ast.AnnAssign):
+                    typed = _annotation_class(node.annotation)
+                elif value is not None:
+                    typed = _call_class(value)
+                if typed:
+                    attr_types.setdefault((cls.name, target.attr), typed)
+    facts.classes = class_methods
+
+    # Param-annotation flow into instance attrs: self._x = param.
+    for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+        cls = ctx.enclosing_class(fn)
+        if cls is None:
+            continue
+        ann = {
+            a.arg: _annotation_class(a.annotation)
+            for a in (
+                *fn.args.posonlyargs,
+                *fn.args.args,
+                *fn.args.kwonlyargs,
+            )
+            if a.annotation is not None
+        }
+        if not ann:
+            continue
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Attribute)
+                and isinstance(node.targets[0].value, ast.Name)
+                and node.targets[0].value.id == "self"
+                and isinstance(node.value, ast.Name)
+            ):
+                typed = ann.get(node.value.id)
+                if typed:
+                    attr_types.setdefault(
+                        (cls.name, node.targets[0].attr), typed
+                    )
+
+    # Pass 2: outermost functions (methods + module-level defs).
+    for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+        if ctx.enclosing_function(fn) is not None:
+            continue  # nested defs merge into their owner
+        cls_node = ctx.enclosing_class(fn)
+        cls = cls_node.name if cls_node is not None else None
+        qual = (
+            f"{facts.path}::{cls}.{fn.name}"
+            if cls
+            else f"{facts.path}::{fn.name}"
+        )
+        # The annotation anchor is the line developers actually write it
+        # on: the def line, or directly above the def — which for a
+        # decorated function means above the decorator stack.
+        anchor = min(
+            [fn.lineno] + [d.lineno for d in fn.decorator_list]
+        )
+        roles = tuple(
+            r
+            for line in (anchor, anchor - 1)
+            if (r := roles_by_line.get(line)) is not None
+        )
+        extractor = _FunctionExtractor(
+            facts, ctx, fn, qual, cls, attr_types, queue_names, lock_globals
+        )
+        facts.functions.append(
+            FuncFact(
+                qual,
+                fn.name,
+                cls,
+                facts.module,
+                facts.path,
+                fn.lineno,
+                roles,
+                extractor.params,
+            )
+        )
+        extractor.run()
+
+    # Pass 3: jit-key coherence facts (JGL014).
+    for cls in ctx.nodes(ast.ClassDef):
+        key_funcs = [
+            n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and _is_key_func(n.name)
+        ]
+        if not key_funcs:
+            continue
+        covered: set[str] = set()
+        for kf in key_funcs:
+            for node in ast.walk(kf):
+                if (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                ):
+                    covered.add(node.attr)
+        methods = frozenset(class_methods.get(cls.name, ()))
+        end = getattr(cls, "end_lineno", cls.lineno) or cls.lineno
+        derived = key_derived_attrs(annotations, cls.lineno, end)
+        # Class-body constants are identical for every instance and can
+        # never drift from a key — exempt unless also written per
+        # instance somewhere.
+        class_consts = {
+            t.id
+            for n in cls.body
+            if isinstance(n, ast.Assign)
+            and isinstance(n.value, ast.Constant)
+            for t in n.targets
+            if isinstance(t, ast.Name)
+        }
+        self_stores = {
+            node.attr
+            for node in ast.walk(cls)
+            if isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and isinstance(node.ctx, ast.Store)
+        }
+        exempt = class_consts - self_stores
+        # Scope: a fuse key promises identical *step programs*, so every
+        # traced read in the class must be keyed. Stage/partition keys
+        # promise identical *staged bytes* only — a class without a fuse
+        # key (ShardedHistogrammer: per-instance jitted step, shared
+        # staged shards) is checked just for jit code reachable from its
+        # staging entry points.
+        has_fuse = any(
+            kf.name == "fuse_key" or kf.name.startswith("fuse_key_")
+            for kf in key_funcs
+        )
+        in_scope = None  # None = every jit region of the class
+        if not has_fuse:
+            seeds = [
+                n
+                for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and "stage" in n.name
+            ]
+            in_scope = set(seeds)
+            frontier = list(seeds)
+            while frontier:
+                fn = frontier.pop()
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = None
+                    if isinstance(node.func, ast.Name):
+                        name = node.func.id
+                    elif isinstance(node.func, ast.Attribute):
+                        name = node.func.attr
+                    for target in ctx.defs_by_name.get(name or "", ()):
+                        if target not in in_scope:
+                            in_scope.add(target)
+                            frontier.append(target)
+        jit_reads: list[tuple[str, int, str]] = []
+        for fn in ctx.jit_regions:
+            if isinstance(fn, ast.Lambda):
+                continue
+            if ctx.enclosing_class(fn) is not cls:
+                continue
+            if in_scope is not None and fn not in in_scope:
+                continue
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                    and isinstance(node.ctx, ast.Load)
+                    and node.attr not in methods
+                    and node.attr not in exempt
+                    and not node.attr.startswith("__")
+                ):
+                    jit_reads.append((node.attr, node.lineno, fn.name))
+        facts.key_classes.append(
+            KeyClassFact(
+                facts.path,
+                cls.name,
+                tuple(sorted(kf.name for kf in key_funcs)),
+                tuple(sorted(covered)),
+                tuple(sorted(derived)),
+                tuple(sorted(jit_reads, key=lambda r: (r[0], r[1]))),
+            )
+        )
+    return facts
+
+
+# -- the project view -------------------------------------------------------
+
+
+class ProjectContext:
+    """Aggregated facts + resolution, role inference and the lock graph."""
+
+    def __init__(self, facts: list[FileFacts]) -> None:
+        self.facts = facts
+        self.functions: dict[str, FuncFact] = {}
+        class_owners: dict[str, set[str]] = defaultdict(set)
+        for ff in facts:
+            for cls in ff.classes:
+                class_owners[cls].add(ff.path)
+            for fn in ff.functions:
+                self.functions[fn.qual] = fn
+        #: Class names defined in more than one file never resolve —
+        #: unifying them would invent edges between unrelated code.
+        self.ambiguous_classes = frozenset(
+            c for c, owners in class_owners.items() if len(owners) > 1
+        )
+        self._method_index: dict[tuple[str, str], str] = {}
+        self._module_fns: dict[tuple[str, str], str] = {}
+        self._fns_by_bare: dict[str, list[tuple[str, str]]] = defaultdict(
+            list
+        )
+        for fn in self.functions.values():
+            if fn.cls is not None:
+                if fn.cls not in self.ambiguous_classes:
+                    self._method_index[(fn.cls, fn.name)] = fn.qual
+            else:
+                self._module_fns[(fn.module, fn.name)] = fn.qual
+                self._fns_by_bare[fn.name].append((fn.module, fn.qual))
+        self.edges: dict[str, set[str]] = defaultdict(set)
+        self.all_calls: list[CallFact] = []
+        for ff in facts:
+            for call in ff.calls:
+                self.all_calls.append(call)
+                for target in self.resolve_call(call):
+                    self.edges[call.caller].add(target)
+        self.roles: dict[str, frozenset[str]] = self._infer_roles()
+        self.may_acquire: dict[str, frozenset[str]] = self._fix_acquires()
+
+    # -- resolution ---------------------------------------------------------
+    def _resolve_name(
+        self,
+        callee: str,
+        receiver_cls: str | None,
+        plain: bool,
+        module: str,
+        hint: str | None = None,
+    ) -> list[str]:
+        if receiver_cls is not None:
+            if receiver_cls in self.ambiguous_classes:
+                return []
+            target = self._method_index.get((receiver_cls, callee))
+            return [target] if target else []
+        if not plain:
+            return []
+        if hint is not None and "." in hint:
+            # Imported name: resolve through the defining module (suffix
+            # match tolerates relative imports). Never fall back to a
+            # bare-name guess — a same-named function in an unrelated
+            # module would absorb the call and invent edges.
+            mod_part, fn_name = hint.rsplit(".", 1)
+            candidates = [
+                target
+                for mod, target in self._fns_by_bare.get(fn_name, ())
+                if mod == mod_part or mod.endswith("." + mod_part)
+            ]
+            return candidates if len(candidates) == 1 else []
+        target = self._module_fns.get((module, callee))
+        return [target] if target else []
+
+    def resolve_call(self, call: CallFact) -> list[str]:
+        return self._resolve_name(
+            call.callee,
+            call.receiver_cls,
+            call.plain,
+            call.module,
+            call.hint,
+        )
+
+    # -- thread roles -------------------------------------------------------
+    def _infer_roles(self) -> dict[str, frozenset[str]]:
+        roles: dict[str, set[str]] = {q: set() for q in self.functions}
+        seeded: set[str] = set()
+        for ff in self.facts:
+            for entry in ff.thread_entries:
+                for target in self._resolve_name(
+                    entry.target,
+                    entry.receiver_cls,
+                    entry.plain,
+                    entry.module,
+                    entry.hint,
+                ):
+                    roles[target].add(entry.role)
+                    seeded.add(target)
+        for fn in self.functions.values():
+            if fn.roles:
+                roles[fn.qual].update(fn.roles)
+                seeded.add(fn.qual)
+        # "main" seeds only at call-graph sources (no resolved in-project
+        # caller) that are not thread entries: a helper reached *only*
+        # from a thread entry must not inherit main, or JGL012 would see
+        # two roles on single-writer state and invent a race. Functions
+        # with no callers at all may be called from anywhere — that is
+        # the service thread until proven otherwise.
+        has_caller: set[str] = set()
+        for callees in self.edges.values():
+            has_caller.update(callees)
+        for qual in roles:
+            if qual not in seeded and qual not in has_caller:
+                roles[qual].add("main")
+        # Propagate caller -> callee to fixpoint.
+        changed = True
+        while changed:
+            changed = False
+            for caller, callees in self.edges.items():
+                src = roles.get(caller)
+                if not src:
+                    continue
+                for callee in callees:
+                    dst = roles.get(callee)
+                    if dst is not None and not src <= dst:
+                        dst.update(src)
+                        changed = True
+        return {q: frozenset(r) for q, r in roles.items()}
+
+    def roles_of(self, qual: str) -> frozenset[str]:
+        return self.roles.get(qual, frozenset({"main"}))
+
+    # -- lock graph ---------------------------------------------------------
+    def _fix_acquires(self) -> dict[str, frozenset[str]]:
+        direct: dict[str, set[str]] = defaultdict(set)
+        for ff in self.facts:
+            for acq in ff.acquires:
+                direct[acq.func].add(acq.lock)
+        may: dict[str, set[str]] = {
+            q: set(direct.get(q, ())) for q in self.functions
+        }
+        changed = True
+        while changed:
+            changed = False
+            for caller, callees in self.edges.items():
+                acc = may.setdefault(caller, set())
+                for callee in callees:
+                    extra = may.get(callee)
+                    if extra and not extra <= acc:
+                        acc.update(extra)
+                        changed = True
+        return {q: frozenset(v) for q, v in may.items()}
+
+    def lock_edges(self) -> dict[tuple[str, str], tuple[str, int, str]]:
+        """{(held, acquired): (path, line, how)} — the cross-module
+        lock-acquisition order graph, first site per edge wins."""
+        edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+        for ff in self.facts:
+            for acq in ff.acquires:
+                for held in acq.held:
+                    if held == acq.lock:
+                        continue  # re-entrant RLock reentry is legal
+                    edges.setdefault(
+                        (held, acq.lock),
+                        (acq.path, acq.lineno, "acquired directly"),
+                    )
+        for call in self.all_calls:
+            if not call.held:
+                continue
+            for target in self.resolve_call(call):
+                for lock in self.may_acquire.get(target, ()):
+                    fn = self.functions.get(target)
+                    via = (
+                        f"via call to "
+                        f"'{(fn.cls + '.') if fn and fn.cls else ''}"
+                        f"{fn.name if fn else call.callee}()'"
+                    )
+                    for held in call.held:
+                        if held == lock:
+                            continue
+                        path = self.functions[call.caller].path
+                        edges.setdefault((held, lock), (path, call.lineno, via))
+        return edges

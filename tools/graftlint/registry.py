@@ -1,21 +1,28 @@
 """Rule registry.
 
-A rule is a function ``check(ctx: FileContext) -> Iterable[Finding]``
-registered under a stable ``JGLxxx`` id. Registration order is the
-report order for same-line findings, so register in id order.
+Two rule scopes share one id namespace and one ``RULES`` table:
+
+- ``scope="file"`` — ``check(ctx: FileContext) -> Iterable[Finding]``,
+  the per-file lexical rules (JGL001–JGL010).
+- ``scope="project"`` — ``check(project: ProjectContext) ->
+  Iterable[Finding]``, the whole-program rules (JGL011+) that see the
+  cross-module symbol table, call graph and thread roles.
+
+Registration order is the report order for same-line findings, so
+register in id order.
 """
 
 from __future__ import annotations
 
 from collections.abc import Callable, Iterable
-from dataclasses import dataclass
-from typing import TYPE_CHECKING
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Any
 
 if TYPE_CHECKING:
     from .context import FileContext
     from .findings import Finding
 
-Check = Callable[["FileContext"], Iterable["Finding"]]
+Check = Callable[[Any], Iterable["Finding"]]
 
 
 @dataclass(frozen=True)
@@ -23,18 +30,29 @@ class Rule:
     rule_id: str
     summary: str
     check: Check
+    scope: str = field(default="file")  # "file" | "project"
 
 
 RULES: dict[str, Rule] = {}
 
 
-def rule(rule_id: str, summary: str) -> Callable[[Check], Check]:
-    """Register ``check`` under ``rule_id``; duplicate ids are a bug."""
-
+def _register(rule_id: str, summary: str, scope: str) -> Callable[[Check], Check]:
     def register(check: Check) -> Check:
         if rule_id in RULES:
             raise ValueError(f"duplicate rule id {rule_id}")
-        RULES[rule_id] = Rule(rule_id=rule_id, summary=summary, check=check)
+        RULES[rule_id] = Rule(
+            rule_id=rule_id, summary=summary, check=check, scope=scope
+        )
         return check
 
     return register
+
+
+def rule(rule_id: str, summary: str) -> Callable[[Check], Check]:
+    """Register a per-file ``check(ctx)``; duplicate ids are a bug."""
+    return _register(rule_id, summary, "file")
+
+
+def project_rule(rule_id: str, summary: str) -> Callable[[Check], Check]:
+    """Register a whole-program ``check(project)``."""
+    return _register(rule_id, summary, "project")

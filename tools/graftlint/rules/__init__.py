@@ -1,3 +1,8 @@
 """Importing this package registers every rule with the registry."""
 
-from . import concurrency_rules, jax_rules, robustness_rules  # noqa: F401
+from . import (  # noqa: F401
+    concurrency_rules,
+    jax_rules,
+    robustness_rules,
+    whole_program,
+)

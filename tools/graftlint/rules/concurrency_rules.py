@@ -62,9 +62,7 @@ def unlocked_shared_mutation(ctx: FileContext):
                     )
 
     # self.<attr> read-modify-write shared across methods of one class.
-    for cls in (
-        n for n in ast.walk(ctx.tree) if isinstance(n, ast.ClassDef)
-    ):
+    for cls in ctx.nodes(ast.ClassDef):
         access: dict[str, set[str]] = defaultdict(set)
         methods = [
             n
@@ -109,9 +107,7 @@ def unlocked_shared_mutation(ctx: FileContext):
 
 @rule("JGL005", "blocking call inside an async function body")
 def blocking_in_async(ctx: FileContext):
-    for fn in (
-        n for n in ast.walk(ctx.tree) if isinstance(n, ast.AsyncFunctionDef)
-    ):
+    for fn in ctx.nodes(ast.AsyncFunctionDef):
         for node in ctx.walk_shallow(fn):
             if not isinstance(node, ast.Call):
                 continue
@@ -203,9 +199,7 @@ def unbounded_queue_handoff(ctx: FileContext):
         return
 
     tracked: set[str] = set()
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
-            continue
+    for node in ctx.nodes(ast.Assign, ast.AnnAssign):
         call = node.value
         if not isinstance(call, ast.Call):
             continue
@@ -243,9 +237,7 @@ def unbounded_queue_handoff(ctx: FileContext):
 
     if not tracked:
         return
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, ast.Call):
-            continue
+    for node in ctx.nodes(ast.Call):
         func = node.func
         if not (
             isinstance(func, ast.Attribute) and func.attr in ("put", "get")

@@ -225,9 +225,7 @@ def missing_donation(ctx: FileContext):
 @rule("JGL006", "per-call jnp dispatch of a Python scalar constant")
 def scalar_jnp_dispatch(ctx: FileContext):
     exempt = ("__init__", "init_state")
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, ast.Call):
-            continue
+    for node in ctx.nodes(ast.Call):
         qual = ctx.qualname(node.func)
         if qual is None or not qual.startswith("jax.numpy."):
             continue
@@ -258,9 +256,7 @@ def scalar_jnp_dispatch(ctx: FileContext):
 
 @rule("JGL008", "unhashable argument baked into a jitted partial")
 def unhashable_partial_arg(ctx: FileContext):
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, ast.Call):
-            continue
+    for node in ctx.nodes(ast.Call):
         if ctx.qualname(node.func) != "functools.partial":
             continue
         if not node.args:
@@ -338,9 +334,7 @@ def duplicate_staging_in_loop(ctx: FileContext):
     bytes over the host->device link, scaling the measured ingest
     bottleneck by K. Stage once before the loop, or route consumers
     through the per-stream DeviceEventCache (ADR 0110)."""
-    for loop in ast.walk(ctx.tree):
-        if not isinstance(loop, ast.For):
-            continue
+    for loop in ctx.nodes(ast.For):
         varying = None  # computed lazily: most loops stage nothing
         for node in ctx.walk_shallow(loop):
             if not isinstance(node, ast.Call) or not node.args:

@@ -34,9 +34,7 @@ def _is_silent(body: list[ast.stmt]) -> bool:
 
 @rule("JGL007", "broad exception handler that swallows errors silently")
 def silent_broad_except(ctx: FileContext):
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
+    for node in ctx.nodes(ast.ExceptHandler):
         if node.type is None:
             kind = "bare 'except:'"
         else:

@@ -1,0 +1,87 @@
+"""SARIF 2.1.0 output: findings as CI code-scanning annotations.
+
+One run, one tool (``graftlint``), one result per finding. The subset
+emitted here is what GitHub code scanning consumes: rule metadata with
+short descriptions, results with ``ruleId``/message/physical location.
+File errors (unparseable sources) become ``executionNotifications`` so
+a broken file is visible in the scan instead of silently shrinking it.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path, PurePath
+
+from .findings import Finding
+from .registry import RULES
+
+_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def _uri(path: str) -> str:
+    p = PurePath(path)
+    return "/".join(p.parts[1:] if p.is_absolute() else p.parts)
+
+
+def to_sarif(findings: list[Finding], errors: list[str]) -> dict:
+    used = sorted({f.rule for f in findings} | set(RULES))
+    rules = [
+        {
+            "id": rule_id,
+            "shortDescription": {"text": RULES[rule_id].summary},
+            "helpUri": "docs/graftlint.md",
+        }
+        for rule_id in used
+        if rule_id in RULES
+    ]
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": _uri(f.path)},
+                        "region": {"startLine": max(1, f.line)},
+                    }
+                }
+            ],
+        }
+        for f in findings
+    ]
+    invocation = {
+        "executionSuccessful": not errors,
+        "toolExecutionNotifications": [
+            {"level": "error", "message": {"text": err}} for err in errors
+        ],
+    }
+    return {
+        "$schema": _SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftlint",
+                        "informationUri": "docs/graftlint.md",
+                        "rules": rules,
+                    }
+                },
+                "invocations": [invocation],
+                "results": results,
+            }
+        ],
+    }
+
+
+def write_sarif(
+    path: str | Path, findings: list[Finding], errors: list[str]
+) -> None:
+    Path(path).write_text(
+        json.dumps(to_sarif(findings, errors), indent=2) + "\n",
+        encoding="utf-8",
+    )
