@@ -516,6 +516,144 @@ def bench_multijob(args) -> None:
     )
 
 
+def bench_publish(args) -> dict:
+    """Cross-job publish combining through the REAL JobManager path
+    (ADR 0113).
+
+    K detector-view jobs on one stream, publishing every window: before
+    the PublishCombiner each job paid its own publish execute + fetch
+    (K device round trips per tick, overlapped but not combined); with
+    combining every job due in a tick is served from ONE execute + ONE
+    packed fetch per device, and layout-constant outputs (the zero ROI
+    blocks here) are fetched once per layout digest instead of every
+    tick. Reads the process-wide publish counters (ops/publish.METRICS)
+    drained around the measured loop, so the reported executes/fetches
+    are exactly the device round trips the publish path performed.
+
+    Acceptance (asserted here AND in --smoke/CI): fetches per tick == 1
+    at K=4 — the K=4/K=1 round-trip ratio is 1.0 — and steady-state
+    static bytes == 0 (statics served from the host cache).
+    One JSON line per K plus a summary line, on stderr.
+    """
+    from esslivedata_tpu.config import JobId, WorkflowConfig, WorkflowSpec
+    from esslivedata_tpu.core.job_manager import JobFactory, JobManager
+    from esslivedata_tpu.core.timestamp import Timestamp
+    from esslivedata_tpu.ops import EventBatch
+    from esslivedata_tpu.ops.publish import METRICS
+    from esslivedata_tpu.preprocessors.event_data import StagedEvents
+    from esslivedata_tpu.workflows import WorkflowFactory
+    from esslivedata_tpu.workflows.detector_view import (
+        DetectorViewParams,
+        DetectorViewWorkflow,
+        project_logical,
+    )
+
+    side = int(np.sqrt(min(args.pixels, 1 << 14)))
+    det = np.arange(side * side).reshape(side, side)
+    n_events = min(args.events, 1 << 18)
+    n_windows = max(6, args.batches // 4)
+    n_distinct = 4
+    staged = []
+    for s in range(n_distinct):
+        pid, toa = make_batch(n_events, side * side, seed=300 + s)
+        staged.append(
+            StagedEvents(
+                batch=EventBatch.from_arrays(pid, toa),
+                first_timestamp=None,
+                last_timestamp=None,
+                n_chunks=1,
+            )
+        )
+    method = args.method if args.method in ("scatter", "sort") else "scatter"
+
+    results = {}
+    for k in (1, 4):
+        reg = WorkflowFactory()
+        spec = WorkflowSpec(
+            instrument="bench", name=f"dv_pub_k{k}", source_names=["det0"]
+        )
+        reg.register_spec(spec).attach_factory(
+            lambda *, source_name, params: DetectorViewWorkflow(
+                projection=project_logical(det),
+                params=DetectorViewParams(histogram_method=method),
+            )
+        )
+        mgr = JobManager(job_factory=JobFactory(reg), job_threads=min(4, k))
+        for _ in range(k):
+            mgr.schedule_job(
+                WorkflowConfig(
+                    identifier=spec.identifier,
+                    job_id=JobId(source_name="det0"),
+                )
+            )
+        t0 = Timestamp.from_ns(0)
+        # Two warm windows: the first compiles the static-inclusive
+        # publish (and fetches the layout's statics once), the second
+        # the steady-state dynamic-only program.
+        for w in range(2):
+            out = mgr.process_jobs(
+                {"det0": staged[w]}, start=t0, end=Timestamp.from_ns(1 + w)
+            )
+            assert len(out) == k
+        METRICS.drain()
+        start = time.perf_counter()
+        for i in range(n_windows):
+            out = mgr.process_jobs(
+                {"det0": staged[i % n_distinct]},
+                start=t0,
+                end=Timestamp.from_ns(3 + i),
+            )
+            assert len(out) == k, f"expected {k} results, got {len(out)}"
+        dt = time.perf_counter() - start
+        m = METRICS.drain()
+        mgr.shutdown()
+        line = {
+            "metric": "publish_combining",
+            "jobs": k,
+            "value": m["fetches"] / n_windows,
+            "unit": "fetches/tick",
+            "executes_per_tick": m["executes"] / n_windows,
+            "fetches_per_tick": m["fetches"] / n_windows,
+            "fetched_bytes_per_publish": (
+                (m["dynamic_bytes"] + m["static_bytes"])
+                / max(m["fetches"], 1)
+            ),
+            "dynamic_bytes_per_tick": m["dynamic_bytes"] / n_windows,
+            "static_bytes_total": m["static_bytes"],
+            "combined_jobs_per_publish": (
+                m["combined_jobs"] / m["combined_publishes"]
+                if m["combined_publishes"]
+                else 1.0
+            ),
+            "events_per_sec_aggregate": k * n_events * n_windows / dt,
+            "windows": n_windows,
+            "events_per_window": n_events,
+        }
+        results[k] = line
+        print(json.dumps(line), file=sys.stderr)
+    k1, k4 = results[1], results[4]
+    # The acceptance bound: K jobs due in one tick publish via exactly
+    # one execute + one fetch; statics never refetch in steady state.
+    assert k4["fetches_per_tick"] == 1.0, k4
+    assert k4["executes_per_tick"] == 1.0, k4
+    assert k1["fetches_per_tick"] == 1.0, k1
+    assert k4["static_bytes_total"] == 0, k4
+    summary = {
+        "metric": "publish_combining_summary",
+        # 1.0 = combining working: K=4 pays the same round trips per
+        # tick as K=1 (the pre-combining ratio was 4.0).
+        "k4_vs_k1_fetches_per_tick_ratio": (
+            k4["fetches_per_tick"] / k1["fetches_per_tick"]
+        ),
+        "k4_vs_k1_fetched_bytes_ratio": (
+            k4["fetched_bytes_per_publish"]
+            / max(k1["fetched_bytes_per_publish"], 1e-12)
+        ),
+    }
+    print(json.dumps(summary), file=sys.stderr)
+    return results[4]
+
+
 def bench_pipeline(args) -> dict:
     """Pipelined vs serial ingest through the REAL JobManager path
     (ADR 0111).
@@ -1088,6 +1226,7 @@ def run_benchmark(args, platform: str) -> dict:
         for section in (
             lambda: bench_secondary_configs(args, edges, batches, method),
             lambda: bench_multijob(args),
+            lambda: bench_publish(args),
             lambda: bench_pipeline(args),
             lambda: bench_latency(args),
         ):
@@ -1401,6 +1540,16 @@ def _parse_args():
         "--multijob; also runs under --all and --smoke)",
     )
     parser.add_argument(
+        "--publish",
+        action="store_true",
+        help="Run ONLY the cross-job publish-combining scenario "
+        "(ADR 0113) on the ambient backend and exit: executes + "
+        "fetches per tick and fetched bytes per publish at K=1 vs K=4 "
+        "through the real JobManager path, K=4 fetches/tick == 1 "
+        "asserted (dev flag, like --multijob; also runs under --all "
+        "and --smoke)",
+    )
+    parser.add_argument(
         "--smoke",
         action="store_true",
         help="CI smoke: tiny CPU-pinned headline run; asserts the graded "
@@ -1480,6 +1629,26 @@ def _smoke_main(args) -> int:
     for name in ("decode", "flatten_partition", "transfer", "step", "publish"):
         if name not in stages:
             problems.append(f"missing stage {name!r}")
+    # Publish-combining control (ADR 0113): tiny run through the real
+    # JobManager; the scenario itself asserts the 1-fetch-per-tick
+    # bound at K=4 and the static-cache steady state, and this guards
+    # the report's structure.
+    try:
+        pub_line = bench_publish(args)
+    except Exception:
+        traceback.print_exc()
+        problems.append("publish scenario raised")
+    else:
+        for field in (
+            "fetches_per_tick",
+            "executes_per_tick",
+            "fetched_bytes_per_publish",
+            "combined_jobs_per_publish",
+        ):
+            if pub_line.get(field) is None:
+                problems.append(f"publish line missing {field!r}")
+        if pub_line.get("fetches_per_tick") != 1.0:
+            problems.append("publish combining not at 1 fetch/tick")
     # Pipelined-ingest control (ADR 0111): tiny run through the real
     # JobManager + IngestPipeline; the scenario itself asserts parity,
     # ordering and drain, and this guards the report's structure — a
@@ -1505,7 +1674,8 @@ def _smoke_main(args) -> int:
         return 1
     print(
         "SMOKE OK: metric line parses, stage breakdown present, "
-        "pipelined ingest drained with parity",
+        "publish combining at 1 fetch/tick, pipelined ingest drained "
+        "with parity",
         file=sys.stderr,
     )
     return 0
@@ -1532,6 +1702,13 @@ def main() -> None:
         if args.batches is None:
             args.batches = 16
         bench_pipeline(args)
+        sys.exit(0)
+    if args.publish:
+        if args.events is None:
+            args.events = 1 << 17
+        if args.batches is None:
+            args.batches = 32
+        bench_publish(args)
         sys.exit(0)
 
     # Fail-open on driver kill: if SIGTERM arrives mid-ladder, emit the

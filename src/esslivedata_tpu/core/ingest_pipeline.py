@@ -125,9 +125,10 @@ class IngestPipeline:
         >1 enables the chunked parallel host flatten in prestaging.
     link_monitor:
         Optional LinkMonitor; when present it is attached to the
-        JobManager's stage-once cache (bandwidth from real staging
-        timings), fed publish round-trip times, and consulted per
-        window for the wire/batch/depth policy.
+        JobManager (bandwidth from the stage-once cache's real staging
+        timings, publish RTT from the combined publish's execute+fetch
+        round trips — ADR 0113) and consulted per window for the
+        wire/batch/depth/publish-coalescing policy.
     """
 
     def __init__(
@@ -437,10 +438,13 @@ class IngestPipeline:
                 with self._timer.stage("publish"):
                     if window.results:
                         self._publish(window.results, window.end)
-                dt_publish = time.perf_counter() - t0
-                window.stage_s["publish"] = dt_publish
-                if self._link_monitor is not None and window.results:
-                    self._link_monitor.observe_publish(dt_publish)
+                # Publish-stage time here is sink serialization only:
+                # the RTT observation moved to the device round trip
+                # itself (JobManager._run_combined_publish times every
+                # combined execute+fetch into the monitor, ADR 0113) —
+                # feeding sink time as "RTT" would anchor the
+                # publish-coalescing policy on the wrong quantity.
+                window.stage_s["publish"] = time.perf_counter() - t0
             finally:
                 if window.generation is not None:
                     window.generation.close()

@@ -18,6 +18,7 @@ from __future__ import annotations
 import bisect
 import logging
 import threading
+import time
 import uuid
 from collections.abc import Mapping
 from concurrent.futures import ThreadPoolExecutor
@@ -120,6 +121,10 @@ class _JobRecord:
     # the job may accumulate again, so data from the old and new run can
     # never mix in a wedged workflow.
     needs_reset: bool = False
+    # True once this record completed a finalize that was timed (or
+    # could have been): the FIRST offer-less finalize may compile its
+    # publish program, so its wall time must not feed the RTT estimate.
+    publish_timed: bool = False
     # Context streams whose latest cached value this job has not received
     # yet. Persisted across windows so an update arriving while the job is
     # idle (no data, nothing pending) is delivered before its next add —
@@ -153,8 +158,29 @@ class JobManager:
         job_factory: JobFactory | None = None,
         job_threads: int = 5,
         snapshot_store=None,
+        combine_publish: bool = True,
     ) -> None:
         self._factory = job_factory or JobFactory()
+        #: Cross-job publish combiner (ADR 0113): every job due in a
+        #: publish tick is served from ONE device execute + ONE packed
+        #: fetch per device. ``combine_publish=False`` keeps the per-job
+        #: path (the parity tests' reference).
+        from ..ops.publish import PublishCombiner
+
+        self._publish_combiner = (
+            PublishCombiner() if combine_publish else None
+        )
+        #: Publish-coalescing window (link policy, ADR 0113): finalize
+        #: only every Nth data window — accumulation continues every
+        #: window, so a degraded relay pays the publish round trip less
+        #: often. 1 = publish every window; finishing jobs and idle
+        #: flushes always publish.
+        self._publish_coalesce = 1
+        self._window_seq = 0
+        #: LinkMonitor (duck-typed ``observe_publish``), attached via
+        #: ``set_link_observer``: combined publishes time the real
+        #: device round trip into it.
+        self._link_observer = None
         #: Optional core.state_snapshot.SnapshotStore: device-resident
         #: accumulation is dumped at run boundaries + shutdown and
         #: restored when an identically-configured job is scheduled
@@ -401,12 +427,156 @@ class JobManager:
                 graduated.add(job_id)
         return graduated
 
+    # -- publish combining / coalescing (ADR 0113) -------------------------
+    def set_publish_coalesce(self, n: int) -> None:
+        """Retarget the publish-coalescing window (link policy): finalize
+        runs only every ``n``th data window, so K windows' accumulation
+        publishes in one device round trip on degraded-relay days.
+        Finishing jobs and idle flushes always publish immediately."""
+        with self._lock:
+            self._publish_coalesce = max(1, int(n))
+
+    def _run_combined_publish(self, due: list[_JobRecord]) -> set[int]:
+        """Serve every due job's publish from one execute + one packed
+        fetch per device (ADR 0113).
+
+        Jobs whose workflows offer ``publish_offer`` are grouped by the
+        device their state lives on; each group runs through the
+        :class:`~..ops.publish.PublishCombiner` and the unpacked per-job
+        trees are handed back via ``offer.consume`` — the subsequent
+        ``job.get()`` then consumes the prefetched outputs instead of
+        dispatching privately. Singletons ride the combiner too: in the
+        manager-driven flow the workflow's private publish jit never
+        compiles, so a K=1 program is the only compile either way, and
+        routing it here gives every publish the same timing probe. Each
+        group's execute+fetch wall time feeds the link monitor — the
+        EWMA RTT behind the publish-coalescing policy is measured on
+        the real device round trip, never on sink serialization.
+
+        Containment mirrors the fused stepping layer: a member whose
+        unpack failed still adopts its (valid) folded carry and
+        republishes privately; a dispatch failure that consumed the
+        donated buffers resets that member's state with a visible
+        warning; everyone else is unaffected.
+
+        Returns the ``id()`` set of the records served here (offer
+        collected): their device round trip is already timed into the
+        link monitor, so the finalize phase must not time them again —
+        and conversely, records NOT in the set publish inside their
+        finalize, which is where their round trip gets timed instead
+        (sharded collective reads, ``combine_publish=False``)."""
+        if self._publish_combiner is None:
+            return set()
+        from ..ops.publish import (
+            PublishRequest,
+            publish_args_consumed,
+            publish_device,
+        )
+
+        offers = []
+        for rec in due:
+            offer_fn = getattr(rec.job.workflow, "publish_offer", None)
+            if offer_fn is None:
+                continue
+            try:
+                offer = offer_fn()
+            except Exception:
+                logger.exception(
+                    "publish_offer failed for %s", rec.job.job_id
+                )
+                continue
+            if offer is not None:
+                offers.append((rec, offer))
+        groups: dict[Any, list] = {}
+        for rec, offer in offers:
+            groups.setdefault(publish_device(offer.args), []).append(
+                (rec, offer)
+            )
+        for members in groups.values():
+            requests = [
+                PublishRequest(o.publisher, o.args, o.static_token)
+                for _, o in members
+            ]
+            t0 = time.perf_counter()
+            try:
+                results = self._publish_combiner.publish(requests)
+            except Exception:
+                # The combiner contains plan/dispatch/unpack failures
+                # per member; anything escaping is a combiner bug — it
+                # must degrade this group to private publishes, never
+                # take the window (or the pipeline's step worker) down.
+                logger.exception(
+                    "combined publish failed (%d jobs); falling back to "
+                    "per-job publishes",
+                    len(members),
+                )
+                for rec, offer in members:
+                    if publish_args_consumed(offer.args):
+                        if offer.reset is not None:
+                            offer.reset()
+                        rec.warning = (
+                            "combined publish failed after buffer "
+                            "donation; accumulation reset (see service "
+                            "log)"
+                        )
+                continue
+            observer = self._link_observer
+            # Compile rounds are one-off XLA work, not round trips —
+            # feeding them would latch coalescing on every startup.
+            if (
+                observer is not None
+                and not self._publish_combiner.last_compiled
+                and any(res.error is None for res in results)
+            ):
+                try:
+                    observer.observe_publish(time.perf_counter() - t0)
+                except Exception:
+                    logger.debug("link observer failed", exc_info=True)
+            for (rec, offer), res in zip(members, results, strict=True):
+                if res.error is not None:
+                    if res.state_lost:
+                        # Donation already invalidated the buffers: the
+                        # pre-publish accumulation is unrecoverable in
+                        # place. Rebuild a fresh state and surface the
+                        # loss instead of erroring on a deleted array
+                        # every publish from here on.
+                        if offer.reset is not None:
+                            offer.reset()
+                        rec.warning = (
+                            "combined publish failed after buffer "
+                            "donation; accumulation reset (see service "
+                            "log)"
+                        )
+                    elif res.carry:
+                        # The fold already ran on device: adopt the new
+                        # state so the job keeps a live buffer, and let
+                        # finalize republish privately (this tick's
+                        # window summaries read zero; the cumulative is
+                        # intact).
+                        try:
+                            offer.consume(None, res.carry)
+                        except Exception:
+                            logger.exception(
+                                "publish carry adoption failed for %s",
+                                rec.job.job_id,
+                            )
+                    continue
+                try:
+                    offer.consume(res.outputs, res.carry)
+                except Exception:
+                    logger.exception(
+                        "publish consume failed for %s", rec.job.job_id
+                    )
+        return {id(rec) for rec, _offer in offers}
+
     # -- pipelined ingest (core/ingest_pipeline.py, ADR 0111) --------------
     def set_link_observer(self, observer) -> None:
-        """Attach a LinkMonitor to the stage-once cache: every staging
-        miss reports (bytes, wall seconds) — the pipeline's bandwidth
-        estimate comes from real work, never probes."""
+        """Attach a LinkMonitor: every staging miss reports (bytes,
+        wall seconds) through the stage-once cache, and every combined
+        publish reports its execute+fetch round trip (ADR 0113) — both
+        estimates come from real work, never probes."""
         self._event_cache.link_observer = observer
+        self._link_observer = observer
 
     def open_window(self, data: Mapping[str, Any]):
         """Attach a fresh, caller-owned cache generation to this window's
@@ -540,7 +710,13 @@ class JobManager:
         prestaged: bool = False,
     ) -> list[JobResult]:
         """One window: fire due resets, advance phases, open gates, fan
-        per-job add+finalize over the thread pool, contain per-job errors.
+        per-job add over the thread pool, then serve every due job's
+        publish from one combined device round trip per device and fan
+        the finalize/serialization back out — per-job errors contained
+        at every phase (ADR 0113). The publish-coalescing window
+        (``set_publish_coalesce``) may skip the finalize phase entirely
+        on intermediate windows; accumulation persists and flushes on
+        the next publish tick.
 
         ``prestaged`` marks a window whose staged-events values already
         carry slots from a caller-owned cache generation (the pipelined
@@ -612,13 +788,25 @@ class JobManager:
                 if job_data or rec.has_primary_data:
                     work.append((rec, job_data))
             fuse_groups = self._plan_fused_steps(work)
+            # Publish-coalescing gate (ADR 0113): on a widened tick,
+            # accumulation still runs every window but finalize (the
+            # device round trip) only fires every Nth — idle flushes
+            # (no data: a stop must complete) always publish, and a
+            # finishing job forces the tick below.
+            self._window_seq += 1
+            coalesce = max(1, self._publish_coalesce)
+            publish_now = (
+                coalesce <= 1
+                or not data
+                or self._window_seq % coalesce == 0
+            )
 
         # Fused stepping (outside the lock, same as the fan-out): each
         # group of >= 2 jobs sharing a (stream, fuse-key) advances all
         # its states in ONE jitted dispatch from ONE cached staging.
         fused_streams = self._run_fused_steps(fuse_groups)
 
-        def run_one(item: tuple[_JobRecord, dict[str, Any]]) -> JobResult | None:
+        def run_accumulate(item: tuple[_JobRecord, dict[str, Any]]) -> None:
             rec, job_data = item
             skip_streams = fused_streams.get(rec.job.job_id, frozenset())
             job = rec.job
@@ -660,29 +848,69 @@ class JobManager:
             except Exception as err:
                 rec.warning = f"{type(err).__name__}: {err}"
                 logger.exception("Job %s failed accumulating", job.job_id)
-            if not rec.has_primary_data:
-                return None
+
+        if self._executor is not None and len(work) > 1:
+            list(self._executor.map(run_accumulate, work))
+        else:
+            for item in work:
+                run_accumulate(item)
+
+        # Every accumulated state is final for this window: jobs due a
+        # publish (fresh or coalesced-over primary data) finalize below,
+        # prefetched through ONE combined device round trip per device.
+        due = [rec for rec, _ in work if rec.has_primary_data]
+        if due and not publish_now and any(rec.finishing for rec in due):
+            # A stop's final flush must not wait out the coalescing
+            # window (beam-off could stall it indefinitely).
+            publish_now = True
+
+        def run_finalize(rec: _JobRecord) -> JobResult | None:
             # Finalize: a failure here is an error; has_primary_data stays
             # set so the next window retries.
             try:
-                result = job.get()
+                t0 = time.perf_counter()
+                result = rec.job.get()
+                if id(rec) not in served:
+                    # Offer-less publish (sharded collective reads,
+                    # combining disabled): the device fetch happens
+                    # inside finalize, so time it here — the RTT axes
+                    # must never go dark for these deployments. The
+                    # record's FIRST offer-less finalize is skipped: it
+                    # may compile the private publish program (also
+                    # after ticks of combined serving — the private jit
+                    # never compiled there), and a compile sample would
+                    # latch coalescing on a healthy link.
+                    observer = self._link_observer
+                    if rec.publish_timed and observer is not None:
+                        try:
+                            observer.observe_publish(
+                                time.perf_counter() - t0
+                            )
+                        except Exception:
+                            logger.debug(
+                                "link observer failed", exc_info=True
+                            )
+                    rec.publish_timed = True
                 rec.error = ""
                 rec.has_primary_data = False
-                if job.none_outputs:
+                if rec.job.none_outputs:
                     rec.warning = (
                         "outputs returned None: "
-                        + ", ".join(job.none_outputs)
+                        + ", ".join(rec.job.none_outputs)
                     )
                 return result
             except Exception as err:
                 rec.error = f"{type(err).__name__}: {err}"
-                logger.exception("Job %s failed finalizing", job.job_id)
+                logger.exception("Job %s failed finalizing", rec.job.job_id)
                 return None
 
-        if self._executor is not None and len(work) > 1:
-            results = list(self._executor.map(run_one, work))
-        else:
-            results = [run_one(item) for item in work]
+        results: list[JobResult | None] = []
+        if due and publish_now:
+            served = self._run_combined_publish(due)
+            if self._executor is not None and len(due) > 1:
+                results = list(self._executor.map(run_finalize, due))
+            else:
+                results = [run_finalize(rec) for rec in due]
 
         with self._lock:
             for rec in list(self._records.values()):

@@ -44,6 +44,12 @@ regimes and wrong in the other. This module closes the loop (ADR 0111):
       (``core/ingest_pipeline.py``): a degraded or high-RTT link wants
       more windows in flight to keep the transfer stage fed; a healthy
       link wants the shallow bound for latency.
+  (d) ``publish_coalesce`` — the publish-tick width (ADR 0113, applied
+      via ``JobManager.set_publish_coalesce``): when the EWMA publish
+      RTT alone approaches the ingest->publish budget, finalize runs
+      only every Nth window so the (combined, one-per-device) publish
+      round trip amortizes over more accumulation; healthy-RTT days
+      keep N = 1 for latency. Hysteresis-latched like the other axes.
 
   The degraded latch flips on below ``degraded_bandwidth_bps`` and off
   only above ``recover_factor`` times that — the dead zone prevents the
@@ -71,6 +77,11 @@ class LinkPolicy:
     compact_wire: bool | None
     #: In-flight window bound for the ingest pipeline.
     depth: int
+    #: Publish-coalescing window (ADR 0113): finalize/publish only every
+    #: Nth data window. 1 = publish every window (healthy RTT); a
+    #: degraded relay widens the tick so the (combined) publish round
+    #: trip amortizes over more accumulation.
+    publish_coalesce: int = 1
 
 
 class LinkMonitor:
@@ -83,6 +94,8 @@ class LinkMonitor:
         degraded_bandwidth_bps: float = 1.5e8,
         recover_factor: float = 2.0,
         rtt_deep_s: float = 0.03,
+        rtt_coalesce_s: float = 0.05,
+        max_publish_coalesce: int = 8,
         alpha: float = 0.25,
         max_window_scale: float = 8.0,
         base_depth: int = 2,
@@ -98,7 +111,16 @@ class LinkMonitor:
         self._target = float(target_bandwidth_bps)
         self._degraded = float(degraded_bandwidth_bps)
         self._recover = float(degraded_bandwidth_bps) * float(recover_factor)
+        self._recover_factor = float(recover_factor)
         self._rtt_deep = float(rtt_deep_s)
+        #: Publish-coalescing latch threshold (ADR 0113): above this
+        #: publish RTT the round trip alone dominates a ~1 Hz tick, so
+        #: the policy widens the publish window; the latch releases only
+        #: below ``rtt_coalesce_s / recover_factor`` — the same dead-zone
+        #: shape as the bandwidth latch, so a noisy RTT can't flap the
+        #: publish cadence.
+        self._rtt_coalesce = float(rtt_coalesce_s)
+        self._max_coalesce = max(1, int(max_publish_coalesce))
         self._alpha = float(alpha)
         self._max_scale = float(max_window_scale)
         self._base_depth = int(base_depth)
@@ -107,6 +129,7 @@ class LinkMonitor:
         self._bw_bps: float | None = None
         self._rtt_s: float | None = None
         self._degraded_latch = False
+        self._coalesce_latch = False
         self._n_staging = 0
         self._n_publish = 0
         self._bytes_observed = 0
@@ -154,11 +177,13 @@ class LinkMonitor:
         with self._lock:
             bw = self._bw_bps
             rtt = self._rtt_s
+            coalesce = self._publish_coalesce_locked(rtt)
             if bw is None:
                 return LinkPolicy(
                     window_scale=1.0,
                     compact_wire=None,
                     depth=self._base_depth,
+                    publish_coalesce=coalesce,
                 )
             if self._degraded_latch:
                 if bw >= self._recover:
@@ -177,7 +202,31 @@ class LinkMonitor:
                 window_scale=scale,
                 compact_wire=True if degraded else None,
                 depth=self._max_depth if deep else self._base_depth,
+                publish_coalesce=coalesce,
             )
+
+    def _publish_coalesce_locked(self, rtt: float | None) -> int:
+        """The RTT-adaptive publish-coalescing window (caller holds the
+        lock). Latched with a dead zone; while latched the window is the
+        RTT over the latch threshold, doubled and quantized to the
+        NEAREST power of two (floor 2) — a barely-over-threshold 51 ms
+        RTT coalesces 2 windows, the round-5 88 ms RTT 4, a 200 ms
+        relay 8 (capped)."""
+        if rtt is None:
+            return 1
+        # "_locked" contract: every caller (policy, and stats through
+        # policy) already holds self._lock around this call.
+        if self._coalesce_latch:
+            if rtt <= self._rtt_coalesce / self._recover_factor:
+                # graftlint: disable=JGL012 caller holds self._lock
+                self._coalesce_latch = False
+        elif rtt > self._rtt_coalesce:
+            # graftlint: disable=JGL012 caller holds self._lock
+            self._coalesce_latch = True
+        if not self._coalesce_latch:
+            return 1
+        raw = max(2.0, 2.0 * rtt / self._rtt_coalesce)
+        return min(self._max_coalesce, 1 << round(math.log2(raw)))
 
     def stats(self) -> dict[str, float | int | bool | None]:
         """Snapshot for the 30 s metrics line."""
@@ -193,4 +242,5 @@ class LinkMonitor:
                 "window_scale": policy.window_scale,
                 "compact_wire": policy.compact_wire,
                 "depth": policy.depth,
+                "publish_coalesce": policy.publish_coalesce,
             }

@@ -237,6 +237,7 @@ class OrchestratingProcessor:
         self._policy_lock = threading.Lock()
         self._pending_policy = None
         self._applied_window_scale = 1.0
+        self._applied_publish_coalesce = 1
         self._base_window = getattr(batcher, "window", None)
         if pipelined:
             from .ingest_pipeline import IngestPipeline
@@ -379,7 +380,21 @@ class OrchestratingProcessor:
         degradation through ``report_processing_time`` backpressure."""
         with self._policy_lock:
             policy, self._pending_policy = self._pending_policy, None
-        if policy is None or self._base_window is None:
+        if policy is None:
+            return
+        # Publish-coalescing width (ADR 0113): idempotent retarget on
+        # the JobManager — applied independently of the batcher axis so
+        # a fixed-window batcher still gets the RTT adaptation.
+        coalesce = getattr(policy, "publish_coalesce", 1)
+        if coalesce != self._applied_publish_coalesce:
+            set_coalesce = getattr(
+                self._job_manager, "set_publish_coalesce", None
+            )
+            if set_coalesce is not None:
+                set_coalesce(coalesce)
+                self._applied_publish_coalesce = coalesce
+                logger.info("link policy: publish_coalesce=%d", coalesce)
+        if self._base_window is None:
             return
         if policy.window_scale == self._applied_window_scale:
             return
@@ -391,10 +406,12 @@ class OrchestratingProcessor:
         )
         self._applied_window_scale = policy.window_scale
         logger.info(
-            "link policy: window_scale=%.2f compact_wire=%s depth=%d",
+            "link policy: window_scale=%.2f compact_wire=%s depth=%d "
+            "publish_coalesce=%d",
             policy.window_scale,
             policy.compact_wire,
             policy.depth,
+            coalesce,
         )
 
     def _process_batch(self, batch) -> None:

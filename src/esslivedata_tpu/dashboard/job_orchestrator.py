@@ -106,6 +106,14 @@ class JobOrchestrator:
             aux_source_names=aux_source_names or {},
         )
         prev = self.active_config(workflow_id).get(source_name)
+        # Captured BEFORE _record_active pops the restored marker: the
+        # observed-alive guard below must know whether the predecessor
+        # record came from persistence (job possibly dead while the
+        # dashboard was down) or from a commit in THIS session.
+        with self._active_lock:
+            prev_restored = (
+                (str(workflow_id), source_name) in self._restored_pending
+            )
         self._transport.publish_command(
             {"kind": "start_job", "config": config.model_dump(mode="json")}
         )
@@ -124,16 +132,22 @@ class JobOrchestrator:
             # (workflow, source) supersedes its previous job — the new
             # job accumulates fresh and the old one is retired. Jobs of
             # OTHER workflows on the same source are untouched
-            # (multi-job stays a feature). Only a job still observed
-            # alive gets the stop: commanding a dead one would never be
-            # acked and would raise a spurious expiry alarm.
+            # (multi-job stays a feature). The observed-alive guard
+            # applies only to RESTORED records: a job from a previous
+            # dashboard session may have died while the dashboard was
+            # down, and commanding it would never be acked (spurious
+            # expiry alarm). A predecessor committed in THIS session is
+            # alive by construction and must always get its stop — its
+            # first status heartbeat may not have arrived yet (2 s
+            # cadence), and skipping the stop on that race leaves the
+            # superseded job accumulating forever.
             try:
                 prev_number = uuid.UUID(prev["job_number"])
             except (ValueError, KeyError, TypeError):
                 prev_number = None  # malformed restored record
-            if (
-                prev_number is not None
-                and self._job_service.job(source_name, prev_number)
+            if prev_number is not None and (
+                not prev_restored
+                or self._job_service.job(source_name, prev_number)
                 is not None
             ):
                 self._job_command(

@@ -561,7 +561,17 @@ int64_t ld_partition_u16(const int32_t* flat, const int32_t* blk_in,
 // the counting pass derives the block from the screen pixel with one
 // shift — no division, no intermediate flat array, no separate count
 // pass. Pass 2 recomputes the flat index (ALU is cheap next to the
-// memory traffic on the single-core ingest host) and places it.
+// memory traffic on the ingest host) and places it.
+//
+// Threaded like partition_core: per-(thread, block) counts over input
+// segments, an exclusive scan turns them into per-thread write cursors,
+// and each thread places its own segment — within a block, thread 0's
+// events land before thread 1's and segment order is preserved, so the
+// output is bit-identical to the serial pass (stable counting sort).
+// The projection runs twice per event (count + place); recomputing it
+// is cheaper than materializing an intermediate (flat, blk) array,
+// which would be the same memory traffic the fused pass exists to
+// avoid.
 //
 // Uniform TOA edges only (the non-uniform path goes flatten ->
 // ld_partition). Semantics match ld_flatten + ld_partition exactly,
@@ -580,6 +590,12 @@ static int64_t flatten_partition_core(
   const int64_t bpb = (int64_t(1) << ppb_shift) * n_toa64;
   const int64_t n_blocks = (n_bins + 1 + bpb - 1) / bpb;
   const int32_t dump_blk = static_cast<int32_t>(n_bins / bpb);
+
+  int n_threads = static_cast<int>(std::thread::hardware_concurrency());
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > 8) n_threads = 8;
+  if (n < (int64_t(1) << 16)) n_threads = 1;
+  const int64_t seg = (n + n_threads - 1) / n_threads;
 
   // flat index + block for one event; invalid -> (dump, dump_blk).
   auto project = [&](int64_t i, int32_t* blk) -> int32_t {
@@ -605,32 +621,62 @@ static int64_t flatten_partition_core(
     return screen * n_toa + tb;
   };
 
-  std::vector<int64_t> counts(n_blocks, 0);
-  for (int64_t i = 0; i < n; ++i) {
-    int32_t blk;
-    (void)project(i, &blk);
-    counts[blk]++;
+  // counts[t * n_blocks + b]
+  std::vector<int64_t> counts(
+      static_cast<size_t>(n_threads) * n_blocks, 0);
+  auto count_seg = [&](int t) {
+    const int64_t lo_i = t * seg;
+    const int64_t hi_i = std::min(n, lo_i + seg);
+    int64_t* c = counts.data() + static_cast<size_t>(t) * n_blocks;
+    for (int64_t i = lo_i; i < hi_i; ++i) {
+      int32_t blk;
+      (void)project(i, &blk);
+      c[blk]++;
+    }
+  };
+  {
+    std::vector<std::thread> ts;
+    for (int t = 1; t < n_threads; ++t) ts.emplace_back(count_seg, t);
+    count_seg(0);
+    for (auto& th : ts) th.join();
   }
 
-  std::vector<int64_t> cursor(n_blocks, 0);
+  // Per-block totals -> chunk-padded block starts + per-thread cursors.
+  std::vector<int64_t> cursor(
+      static_cast<size_t>(n_threads) * n_blocks, 0);
   int64_t n_chunks = 0;
   for (int64_t b = 0; b < n_blocks; ++b) {
-    cursor[b] = n_chunks * chunk;
-    const int64_t total = counts[b];
+    const int64_t bstart = n_chunks * chunk;
+    int64_t total = 0;
+    for (int t = 0; t < n_threads; ++t) {
+      cursor[static_cast<size_t>(t) * n_blocks + b] = bstart + total;
+      total += counts[static_cast<size_t>(t) * n_blocks + b];
+    }
     const int64_t k = (total + chunk - 1) / chunk;
     if (n_chunks + k > cap_chunks) return -1;
     for (int64_t c = 0; c < k; ++c)
       out_map[n_chunks + c] = static_cast<int32_t>(b);
-    for (int64_t i = cursor[b] + total; i < (n_chunks + k) * chunk; ++i)
+    for (int64_t i = bstart + total; i < (n_chunks + k) * chunk; ++i)
       out_events[i] = static_cast<OutT>(-1);
     n_chunks += k;
   }
 
-  for (int64_t i = 0; i < n; ++i) {
-    int32_t blk;
-    const int32_t v = project(i, &blk);
-    out_events[cursor[blk]++] =
-        static_cast<OutT>(LOCAL ? v - int64_t(blk) * bpb : v);
+  auto place_seg = [&](int t) {
+    const int64_t lo_i = t * seg;
+    const int64_t hi_i = std::min(n, lo_i + seg);
+    int64_t* cur = cursor.data() + static_cast<size_t>(t) * n_blocks;
+    for (int64_t i = lo_i; i < hi_i; ++i) {
+      int32_t blk;
+      const int32_t v = project(i, &blk);
+      out_events[cur[blk]++] =
+          static_cast<OutT>(LOCAL ? v - int64_t(blk) * bpb : v);
+    }
+  };
+  {
+    std::vector<std::thread> ts;
+    for (int t = 1; t < n_threads; ++t) ts.emplace_back(place_seg, t);
+    place_seg(0);
+    for (auto& th : ts) th.join();
   }
 
   const int32_t last = static_cast<int32_t>(n_blocks - 1);

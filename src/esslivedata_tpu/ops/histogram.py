@@ -465,6 +465,13 @@ class EventHistogrammer:
     def shape(self) -> tuple[int, int]:
         return (self._n_screen, self._n_toa)
 
+    @property
+    def layout_digest(self) -> str:
+        """The projection layout's content fingerprint (see
+        ``EventProjection.layout_digest``) — the static-publish cache
+        token (ops/publish.py, ADR 0113): a LUT/edge swap re-keys it."""
+        return self._proj.layout_digest
+
     # -- state ------------------------------------------------------------
     def init_state(self, device=None) -> HistogramState:
         zeros = jnp.zeros(self._n_state, dtype=self._dtype)

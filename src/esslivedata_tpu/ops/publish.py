@@ -1,4 +1,4 @@
-"""Single-round-trip publish programs.
+"""Single-round-trip publish programs + the cross-job publish combiner.
 
 A workflow's finalize used to cost three relay round trips: dispatch the
 summary program, fetch its output tree (one transfer per leaf on some
@@ -10,57 +10,265 @@ many jobs this dominated ingest->publish p99 (PERF.md round 2).
 program that returns the new (donated) state plus every output flattened
 into a single float32 vector, so a publish is exactly one execute call
 and one single-array device->host fetch. The host unpacks by precomputed
-offsets; output keys, shapes and order are recorded at trace time.
+offsets; output keys, shapes and order are derived by abstract
+evaluation per input signature.
+
+Round 5 measured ``device_roundtrip_p50 = 87.7 ms`` — the relay RTT
+*alone* exceeds the <100 ms ingest->publish budget, so a K-job service
+paying K publish round trips per tick (overlapped by the job pool, but
+still K executes + K fetches) is K-1 round trips too many. Two further
+layers close that gap (ADR 0113):
+
+- **Static/dynamic split.** A publisher may declare ``static_keys``:
+  outputs whose values depend only on the layout (coords, edges, zero
+  ROI blocks). Dynamic outputs pack into the per-tick float32 vector as
+  before; static outputs ride a separate native-dtype channel that is
+  included in the fetch ONLY when the caller's ``static_token`` (a
+  layout digest) misses the host-side cache — once per (publisher,
+  token), re-fetched only when the token changes (layout swap). Per-tick
+  fetch bytes then carry only the data that changed.
+
+- **Cross-job combining.** :class:`PublishCombiner` concatenates the
+  packed publish programs of every job due in a publish tick (grouped
+  by device by the caller) into ONE jitted mega-publish with per-job
+  offsets: one execute + one packed fetch serves every job, and the
+  host-side unpack fans the per-job output trees back out with per-job
+  error containment. The jit cache is keyed on the exact (publisher,
+  signature, static-inclusion) tuple per member, so a job-set change
+  compiles a new program (rare: job sets change at command time, not in
+  the data path).
+
+Every publish — private or combined — records into :data:`METRICS`
+(executes, fetches, dynamic/static fetched bytes), which the ``--publish``
+bench scenario and the parity tests read.
 """
 
 from __future__ import annotations
 
-from collections.abc import Callable
+import logging
+import threading
+from collections import OrderedDict
+from collections.abc import Callable, Hashable, Sequence
+from dataclasses import dataclass
+from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["PackedPublisher"]
+__all__ = [
+    "METRICS",
+    "CombinedPublish",
+    "PackedPublisher",
+    "PublishCombiner",
+    "PublishMetrics",
+    "PublishOffer",
+    "PublishRequest",
+    "make_publish_offer",
+    "publish_args_consumed",
+    "publish_device",
+]
+
+logger = logging.getLogger(__name__)
+
+
+class PublishMetrics:
+    """Process-wide publish round-trip counters.
+
+    One ``record`` per publish execute+fetch pair, whether private
+    (``PackedPublisher.__call__``) or combined (``PublishCombiner``).
+    ``dynamic_bytes`` is the packed per-tick vector; ``static_bytes``
+    counts only the tokens that actually missed the static cache — at
+    most once per (publisher, layout digest) by construction.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._executes = 0
+        self._fetches = 0
+        self._dynamic_bytes = 0
+        self._static_bytes = 0
+        self._combined_publishes = 0
+        self._combined_jobs = 0
+
+    def record(
+        self,
+        *,
+        executes: int = 0,
+        fetches: int = 0,
+        dynamic_bytes: int = 0,
+        static_bytes: int = 0,
+        combined_jobs: int = 0,
+    ) -> None:
+        with self._lock:
+            self._executes += executes
+            self._fetches += fetches
+            self._dynamic_bytes += dynamic_bytes
+            self._static_bytes += static_bytes
+            if combined_jobs:
+                self._combined_publishes += 1
+                self._combined_jobs += combined_jobs
+
+    def _dict(self) -> dict[str, int]:
+        return {
+            "executes": self._executes,
+            "fetches": self._fetches,
+            "dynamic_bytes": self._dynamic_bytes,
+            "static_bytes": self._static_bytes,
+            "combined_publishes": self._combined_publishes,
+            "combined_jobs": self._combined_jobs,
+        }
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return self._dict()
+
+    def drain(self) -> dict[str, int]:
+        with self._lock:
+            out = self._dict()
+            self._executes = 0
+            self._fetches = 0
+            self._dynamic_bytes = 0
+            self._static_bytes = 0
+            self._combined_publishes = 0
+            self._combined_jobs = 0
+        return out
+
+
+#: The process-wide publish counters (bench ``--publish``, tests).
+METRICS = PublishMetrics()
+
+
+def _unpack_segment(
+    flat: np.ndarray, spec: list[tuple[str, tuple[int, ...], int]]
+) -> dict[str, np.ndarray]:
+    """Fan one packed float32 segment back out by precomputed offsets."""
+    outputs: dict[str, np.ndarray] = {}
+    offset = 0
+    for key, shape, size in spec:
+        view = flat[offset : offset + size]
+        outputs[key] = view.reshape(shape) if shape else view[0]
+        offset += size
+    return outputs
+
+
+def publish_device(args):
+    """The device the first array leaf of ``args`` lives on (None for
+    host-only args). The JobManager groups publish offers by this so a
+    combined program never spans devices."""
+    for leaf in jax.tree_util.tree_leaves(args):
+        devices = getattr(leaf, "devices", None)
+        if not callable(devices):
+            continue
+        try:
+            ds = devices()
+        except Exception:  # pragma: no cover - non-committed arrays
+            logger.debug("publish_device probe failed", exc_info=True)
+            continue
+        if len(ds) == 1:
+            return next(iter(ds))
+    return None
+
+
+def publish_args_consumed(args) -> bool:
+    """True when any array leaf of ``args`` was invalidated by a donated
+    dispatch that subsequently failed (the caller's state is gone)."""
+    for leaf in jax.tree_util.tree_leaves(args):
+        deleted = getattr(leaf, "is_deleted", None)
+        try:
+            if deleted is not None and deleted():
+                return True
+        except Exception:  # pragma: no cover - defensive
+            return True
+    return False
 
 
 class PackedPublisher:
     """Wrap ``program(*args) -> (outputs, *carry)`` for one-fetch publish.
 
     ``program`` must be traceable; ``outputs`` is a dict of arrays (any
-    shapes/dtypes — packed as float32) and ``carry`` is whatever device
-    state flows to the next cycle (e.g. the cleared histogram state).
-    Calling the publisher returns ``(outputs_on_host, *carry)`` where
-    outputs are numpy arrays of the traced shapes.
+    shapes/dtypes — dynamic outputs are packed as float32) and ``carry``
+    is whatever device state flows to the next cycle (e.g. the cleared
+    histogram state). Calling the publisher returns
+    ``(outputs_on_host, *carry)`` where outputs are numpy arrays of the
+    traced shapes.
 
     ``donate`` names positional args whose buffers the program may reuse
     (pass the old state's index; defaults to arg 0).
+
+    ``static_keys`` names outputs whose values are layout-constant: they
+    are fetched (in their native traced dtype, not the float32 pack)
+    only when the per-call ``static_token`` misses the host-side cache,
+    and served from that cache on every later publish until the token
+    changes. A call without a token treats every output as dynamic.
     """
+
+    #: Static cache entries kept per publisher; tokens are layout
+    #: digests, so churn means live geometry flaps — keep a few.
+    _STATIC_CACHE_MAX = 8
 
     def __init__(
         self,
         program: Callable,
         *,
         donate: tuple[int, ...] = (0,),
+        static_keys: Sequence[str] = (),
     ) -> None:
         self._program = program
-        # Output spec (key -> shape) PER input signature: a jit cache can
-        # hold several entries (state rebuilt with different bins, a new
-        # batch shape), and a cached entry executes without retracing — a
-        # single mutable spec would then unpack with whatever the *latest*
-        # trace recorded, silently mislabeling every output. ``__call__``
-        # stamps the signature being dispatched before invoking the jit so
-        # the trace-time hook files its spec under the right key.
+        self._donate = tuple(donate)
+        self._static_keys = frozenset(static_keys)
+        # (signature, static-key split) -> (dynamic spec, static names).
+        # A jit cache can hold several entries (state rebuilt with
+        # different bins, a new batch shape) and a cached entry executes
+        # without retracing, so the unpack spec must be resolved per
+        # signature — abstract evaluation (no compile), cached forever.
         # Spec entries are (key, shape, size) with the element count
-        # precomputed at trace time: the unpack below runs once per
-        # publish per output key, and re-deriving sizes there (np.prod
-        # per key) is avoidable host work in the publish path.
+        # precomputed: the unpack runs once per publish per output key.
         self._spec_by_sig: dict[
-            tuple, list[tuple[str, tuple[int, ...], int]]
+            tuple, tuple[list[tuple[str, tuple[int, ...], int]], tuple[str, ...]]
         ] = {}
-        self._pending_sig: tuple | None = None
-        self._jit = jax.jit(self._packed, donate_argnums=donate)
+        # One jitted variant per (static split, statics included): the
+        # first publish under a fresh token includes the static leaves,
+        # every later publish runs the dynamic-only variant.
+        self._jits: dict[tuple[frozenset, bool], Callable] = {}
+        self._static_cache: OrderedDict[Hashable, dict[str, np.ndarray]] = (
+            OrderedDict()
+        )
 
+    # -- static split ------------------------------------------------------
+    @property
+    def static_keys(self) -> frozenset:
+        return self._static_keys
+
+    def set_static_keys(self, keys: Sequence[str]) -> None:
+        """Re-declare the static output set (e.g. detector-view flips
+        its ROI blocks dynamic once real masks are installed). Flushes
+        the static cache — cached entries were split under the old set."""
+        keys = frozenset(keys)
+        if keys == self._static_keys:
+            return
+        self._static_keys = keys
+        self._static_cache.clear()
+
+    def invalidate_static(self, token: Hashable | None = None) -> None:
+        """Drop one cached static entry (or all): the next publish under
+        that token re-fetches. Layout swaps normally invalidate by
+        *token change* (a new digest misses); this is the explicit hook."""
+        if token is None:
+            self._static_cache.clear()
+        else:
+            self._static_cache.pop(token, None)
+
+    def _store_static(
+        self, token: Hashable, values: dict[str, np.ndarray]
+    ) -> None:
+        cache = self._static_cache
+        cache[token] = values
+        cache.move_to_end(token)
+        while len(cache) > self._STATIC_CACHE_MAX:
+            cache.popitem(last=False)
+
+    # -- specs -------------------------------------------------------------
     @staticmethod
     def _signature(args) -> tuple:
         # Leaves AND treedef: jit keys its cache on both, so two arg
@@ -78,56 +286,374 @@ class PackedPublisher:
 
     @staticmethod
     def _spec_of(outputs) -> list[tuple[str, tuple[int, ...], int]]:
-        # SORTED key order — the one canonical pack order. jax.eval_shape
-        # (the cache-miss fallback in __call__) rebuilds dicts through
-        # pytree flattening, which sorts keys; if _packed concatenated in
-        # insertion order instead, a fallback-derived spec would silently
-        # unpack wrong data under wrong keys for non-alphabetical
-        # programs.
+        # SORTED key order — the one canonical pack order, matching the
+        # dict-key sorting jax's pytree flattening applies, so specs
+        # derived abstractly and packs built in the traced program can
+        # never disagree about which bytes belong to which key.
         return [
             (k, shape := tuple(v.shape), int(np.prod(shape)) if shape else 1)
             for k, v in sorted(outputs.items())
         ]
 
-    def _trace_spec(self, args) -> list[tuple[str, tuple[int, ...], int]]:
-        """Output spec for ``args`` via abstract evaluation (no compile)."""
-        out = jax.eval_shape(lambda *a: self._program(*a)[0], *args)
-        return self._spec_of(out)
+    def _spec_for(
+        self, args, skeys: frozenset
+    ) -> tuple[list[tuple[str, tuple[int, ...], int]], tuple[str, ...]]:
+        """(dynamic spec, static names) for ``args`` under ``skeys`` via
+        abstract evaluation (no compile); cached per signature."""
+        key = (self._signature(args), skeys)
+        spec = self._spec_by_sig.get(key)
+        if spec is None:
+            out = jax.eval_shape(lambda *a: self._program(*a)[0], *args)
+            dynamic = {k: v for k, v in out.items() if k not in skeys}
+            static_names = tuple(sorted(k for k in out if k in skeys))
+            spec = self._spec_by_sig[key] = (
+                self._spec_of(dynamic),
+                static_names,
+            )
+        return spec
 
-    def _packed(self, *args):
+    # -- traced body -------------------------------------------------------
+    def _packed_impl(
+        self, skeys: frozenset, include_static: bool, *args
+    ):
+        """The traceable publish body: ``(packed_dynamic, static_leaves,
+        *carry)``. The combiner inlines this per member, so private and
+        combined publishes run the exact same per-job ops."""
         outputs, *carry = self._program(*args)
-        spec = self._spec_of(outputs)
-        if self._pending_sig is not None:
-            self._spec_by_sig[self._pending_sig] = spec
-        if outputs:
-            # Same sorted order as _spec_of (see the comment there).
+        dynamic = sorted(
+            (k, v) for k, v in outputs.items() if k not in skeys
+        )
+        if dynamic:
             packed = jnp.concatenate(
-                [
-                    jnp.ravel(v).astype(jnp.float32)
-                    for _, v in sorted(outputs.items())
-                ]
+                [jnp.ravel(v).astype(jnp.float32) for _, v in dynamic]
             )
         else:
             packed = jnp.zeros((0,), jnp.float32)
-        return (packed, *carry)
+        statics = (
+            tuple(
+                outputs[k] for k in sorted(k for k in outputs if k in skeys)
+            )
+            if include_static
+            else ()
+        )
+        return (packed, statics, *carry)
 
-    def __call__(self, *args):
-        sig = self._signature(args)
-        self._pending_sig = sig
-        packed, *carry = self._jit(*args)
-        spec = self._spec_by_sig.get(sig)
-        if spec is None:
-            # A cache hit under a host signature we have not seen (e.g. a
-            # python float where a np scalar was traced): derive the spec
-            # with an abstract eval of the program at this signature.
-            spec = self._spec_by_sig[sig] = self._trace_spec(args)
-        # device_get already lands a numpy array: one bulk fetch, no
-        # second host copy.
-        flat = jax.device_get(packed)
-        outputs: dict[str, np.ndarray] = {}
-        offset = 0
-        for key, shape, size in spec:
-            view = flat[offset : offset + size]
-            outputs[key] = view.reshape(shape) if shape else view[0]
-            offset += size
+    def _jit_for(self, skeys: frozenset, include_static: bool) -> Callable:
+        key = (skeys, include_static)
+        fn = self._jits.get(key)
+        if fn is None:
+
+            def run(*args, _sk=skeys, _inc=include_static):
+                return self._packed_impl(_sk, _inc, *args)
+
+            fn = self._jits[key] = jax.jit(
+                run, donate_argnums=self._donate
+            )
+        return fn
+
+    def _static_plan(self, args, static_token: Hashable | None):
+        """(skeys, dynamic spec, static names, cached statics,
+        include_static) for one publish — the ONE place the cache-hit /
+        fetch-statics decision lives, shared verbatim by the private
+        path and the combiner so the two can never diverge."""
+        skeys = self._static_keys if static_token is not None else frozenset()
+        dyn_spec, static_names = self._spec_for(args, skeys)
+        cached = None
+        if static_names and static_token in self._static_cache:
+            cached = self._static_cache[static_token]
+            self._static_cache.move_to_end(static_token)  # LRU touch
+        include_static = bool(static_names) and cached is None
+        return skeys, dyn_spec, static_names, cached, include_static
+
+    def _static_adopt(
+        self, token: Hashable, names: tuple[str, ...], arrays
+    ) -> tuple[dict[str, np.ndarray], int]:
+        """Store freshly fetched static leaves under ``token``; returns
+        (cached dict, fetched bytes) — the counterpart of _static_plan."""
+        cached = {
+            name: np.asarray(a) for name, a in zip(names, arrays)
+        }
+        self._store_static(token, cached)
+        return cached, sum(a.nbytes for a in cached.values())
+
+    # -- publish -----------------------------------------------------------
+    def __call__(self, *args, static_token: Hashable | None = None):
+        skeys, dyn_spec, static_names, cached, include_static = (
+            self._static_plan(args, static_token)
+        )
+        packed, statics, *carry = self._jit_for(skeys, include_static)(*args)
+        # device_get already lands numpy arrays: one bulk fetch (the
+        # statics, when included, ride the same call), no second host
+        # copy.
+        flat, static_arrays = jax.device_get((packed, statics))
+        outputs = _unpack_segment(flat, dyn_spec)
+        static_bytes = 0
+        if static_names:
+            if include_static:
+                cached, static_bytes = self._static_adopt(
+                    static_token, static_names, static_arrays
+                )
+            outputs.update(cached)
+        METRICS.record(
+            executes=1,
+            fetches=1,
+            dynamic_bytes=int(flat.nbytes),
+            static_bytes=static_bytes,
+        )
         return (outputs, *carry)
+
+
+@dataclass(frozen=True)
+class PublishOffer:
+    """A workflow's offer to have its publish combined across jobs.
+
+    Workflows owning a :class:`PackedPublisher` expose
+    ``publish_offer() -> PublishOffer | None`` (duck-typed, like
+    ``event_ingest``). The JobManager collects offers from every job due
+    in a publish tick, groups them by device, and serves each group from
+    one combined execute + fetch; ``consume(outputs, carry)`` then hands
+    the job its unpacked output tree and new device state, after which
+    the job's ``finalize`` must use them instead of dispatching
+    privately. ``reset`` (optional) rebuilds a fresh state when a failed
+    combined dispatch consumed the donated buffers — mirror of the fused
+    stepping layer's donation-loss recovery.
+    """
+
+    publisher: PackedPublisher
+    args: tuple
+    consume: Callable[[dict, tuple], None]
+    static_token: Hashable | None = None
+    reset: Callable[[], None] | None = None
+
+
+def make_publish_offer(
+    owner,
+    publisher: PackedPublisher,
+    args: tuple,
+    *,
+    static_token: Hashable | None = None,
+    fresh_state: Callable[[], Any] | None = None,
+) -> PublishOffer:
+    """The one shared PublishOffer wiring for state-carrying workflows.
+
+    Contract (every offering workflow follows it): device state lives in
+    ``owner._state``, the prefetched output tree in
+    ``owner._prefetched_publish`` (consumed-and-cleared by finalize,
+    dropped by ``clear``), and the publish program's carry is exactly
+    ``(new_state,)``. ``fresh_state`` rebuilds a zeroed state after a
+    donation-losing dispatch failure. Centralized so a behavior fix
+    (carry handling, recovery) cannot silently diverge between the four
+    workflow families.
+    """
+
+    def consume(outputs, carry) -> None:
+        (owner._state,) = carry
+        owner._prefetched_publish = outputs
+
+    reset = None
+    if fresh_state is not None:
+
+        def reset() -> None:
+            owner._state = fresh_state()
+
+    return PublishOffer(
+        publisher=publisher,
+        args=args,
+        consume=consume,
+        static_token=static_token,
+        reset=reset,
+    )
+
+
+@dataclass(frozen=True)
+class PublishRequest:
+    """One member of a combined publish (offer minus the callbacks)."""
+
+    publisher: PackedPublisher
+    args: tuple
+    static_token: Hashable | None = None
+
+
+@dataclass
+class CombinedPublish:
+    """Per-member result of a combined publish.
+
+    ``error`` is set (and ``outputs`` None) when this member's unpack
+    failed or the whole dispatch did; ``state_lost`` additionally marks
+    a failed dispatch that had already consumed the member's donated
+    buffers — the caller must rebuild that state, the other members are
+    unaffected.
+    """
+
+    outputs: dict[str, np.ndarray] | None
+    carry: tuple = ()
+    error: BaseException | None = None
+    state_lost: bool = False
+
+
+class PublishCombiner:
+    """One execute + one packed fetch for K jobs' publish programs.
+
+    Builds (and LRU-caches) a jitted mega-program per exact member
+    tuple: each member's :meth:`PackedPublisher._packed_impl` is inlined
+    in order, the per-member packed vectors concatenate into one fetch,
+    and every member's donated args keep their donation at the shifted
+    position. Member composition changes at command time (jobs
+    scheduled/removed), so recompiles are rare; the cache bound caps
+    how many retired job-set programs (and the publishers they close
+    over) stay alive.
+    """
+
+    def __init__(self, max_programs: int = 16) -> None:
+        self._programs: OrderedDict[tuple, Callable] = OrderedDict()
+        self._max_programs = int(max_programs)
+        #: True when the last ``publish`` compiled its program (cache
+        #: miss). RTT observers must skip those rounds: a mega-publish
+        #: compile is hundreds of ms of one-off XLA work, and folding it
+        #: into the EWMA RTT would latch the publish-coalescing policy
+        #: on every startup regardless of relay health.
+        self.last_compiled = False
+
+    def publish(
+        self, requests: Sequence[PublishRequest]
+    ) -> list[CombinedPublish]:
+        # Per-member plan containment: a publish program that raises at
+        # abstract-evaluation time (bad restored state, workflow bug
+        # surfacing on first publish) drops ONLY that member — it gets
+        # an error result (caller falls back to its private path, where
+        # the same trace error lands in per-job containment) while the
+        # rest of the tick combines normally.
+        plan = []
+        planned_errors: dict[int, BaseException] = {}
+        for i, req in enumerate(requests):
+            try:
+                skeys, dyn_spec, static_names, cached, include_static = (
+                    req.publisher._static_plan(req.args, req.static_token)
+                )
+            except Exception as err:
+                logger.exception(
+                    "combined publish plan failed (member %d)", i
+                )
+                planned_errors[i] = err
+                continue
+            size = sum(s for _, _, s in dyn_spec)
+            plan.append(
+                (i, req, skeys, dyn_spec, static_names, include_static,
+                 cached, size)
+            )
+        if not plan:
+            return [
+                CombinedPublish(None, (), error=planned_errors.get(i))
+                for i in range(len(requests))
+            ]
+        key = tuple(
+            (req.publisher, req.publisher._signature(req.args), skeys,
+             include_static)
+            for _i, req, skeys, _spec, _names, include_static, _c, _s in plan
+        )
+        fn = self._programs.get(key)
+        self.last_compiled = fn is None
+        if fn is not None:
+            # LRU touch: the steady-state program runs every tick and
+            # must never be the eviction victim of key churn (layout
+            # swaps, ROI flips) — eviction means a surprise mega-publish
+            # recompile in the hot path.
+            self._programs.move_to_end(key)
+        else:
+            fn = self._build(
+                [
+                    (req.publisher, len(req.args), skeys, include_static)
+                    for _i, req, skeys, _spec, _names, include_static, _c, _s
+                    in plan
+                ]
+            )
+            self._programs[key] = fn
+            self._programs.move_to_end(key)
+            while len(self._programs) > self._max_programs:
+                self._programs.popitem(last=False)
+        flat_args = tuple(a for _i, req, *_ in plan for a in req.args)
+        by_index: dict[int, CombinedPublish] = {
+            i: CombinedPublish(None, (), error=err)
+            for i, err in planned_errors.items()
+        }
+        try:
+            packed, statics, carries = fn(*flat_args)
+            flat, static_fetched = jax.device_get((packed, statics))
+        except Exception as err:
+            # Dispatch-level failure: per-member containment happens at
+            # the caller, which needs to know whose donated state the
+            # failed dispatch already consumed.
+            logger.exception(
+                "combined publish dispatch failed (%d jobs)", len(plan)
+            )
+            for _i, req, *_ in plan:
+                by_index[_i] = CombinedPublish(
+                    None,
+                    (),
+                    error=err,
+                    state_lost=publish_args_consumed(req.args),
+                )
+            return [by_index[i] for i in range(len(requests))]
+        offset = 0
+        static_total = 0
+        for k, (
+            _i, req, _skeys, dyn_spec, static_names, include_static, cached,
+            size,
+        ) in enumerate(plan):
+            carry = tuple(carries[k])
+            # Per-member unpack containment: one bad spec/shape cannot
+            # poison the other members' trees (their offsets are fixed).
+            try:
+                outputs = _unpack_segment(flat[offset : offset + size], dyn_spec)
+                if static_names:
+                    if include_static:
+                        cached, nbytes = req.publisher._static_adopt(
+                            req.static_token, static_names, static_fetched[k]
+                        )
+                        static_total += nbytes
+                    outputs.update(cached)
+                by_index[_i] = CombinedPublish(outputs, carry)
+            except Exception as err:
+                logger.exception(
+                    "combined publish unpack failed (member %d)", _i
+                )
+                by_index[_i] = CombinedPublish(None, carry, error=err)
+            offset += size
+        METRICS.record(
+            executes=1,
+            fetches=1,
+            dynamic_bytes=int(flat.nbytes),
+            static_bytes=static_total,
+            combined_jobs=len(plan),
+        )
+        return [by_index[i] for i in range(len(requests))]
+
+    @staticmethod
+    def _build(
+        members: list[tuple[PackedPublisher, int, frozenset, bool]]
+    ) -> Callable:
+        def mega(*flat_args):
+            parts, statics, carries = [], [], []
+            offset = 0
+            for pub, n_args, skeys, include_static in members:
+                args = flat_args[offset : offset + n_args]
+                offset += n_args
+                packed, stat, *carry = pub._packed_impl(
+                    skeys, include_static, *args
+                )
+                parts.append(packed)
+                statics.append(stat)
+                carries.append(tuple(carry))
+            packed_all = (
+                jnp.concatenate(parts)
+                if parts
+                else jnp.zeros((0,), jnp.float32)
+            )
+            return packed_all, tuple(statics), tuple(carries)
+
+        donate: list[int] = []
+        offset = 0
+        for pub, n_args, _skeys, _inc in members:
+            donate.extend(offset + d for d in pub._donate if d < n_args)
+            offset += n_args
+        return jax.jit(mega, donate_argnums=tuple(donate))

@@ -158,9 +158,24 @@ class DetectorViewWorkflow:
 
         from ...ops.publish import PackedPublisher
 
-        self._publish = PackedPublisher(publish_program)
+        # The ROI spectra blocks are layout-constant (all zeros) until
+        # real masks are installed: on the common no-ROI dashboard they
+        # are 6.4 KB/tick of fetched-and-discarded data (the majority
+        # of the packed vector for small screens), so they ride the
+        # static channel — fetched once per layout digest, served from
+        # the host cache after (ADR 0113). ``set_rois`` flips them
+        # dynamic the moment masks make them carry data.
+        self._publish = PackedPublisher(
+            publish_program, static_keys=self._STATIC_ROI_KEYS
+        )
+        #: Combined-publish hand-off (ops/publish.py PublishOffer): the
+        #: JobManager prefetches this job's outputs through one fused
+        #: device round trip; finalize consumes instead of dispatching.
+        self._prefetched_publish: dict | None = None
         self._toa_edges_var = Variable(edges, ("toa",), "ns")
         assert n_toa == edges.size - 1
+
+    _STATIC_ROI_KEYS = ("roi_spectra", "roi_spectra_cumulative")
 
     def swap_projection(self, projection: ProjectionTable) -> bool:
         """Adopt a rebuilt projection WITHOUT recompiling anything.
@@ -186,6 +201,7 @@ class DetectorViewWorkflow:
             return False  # LUT shape mismatch: full rebuild
         self._proj = projection
         self._state = self._hist.clear(self._state)
+        self._prefetched_publish = None
         if self._rois_by_index:
             self.set_rois(
                 {name: roi for name, roi in self._rois_by_index.values()}
@@ -241,6 +257,12 @@ class DetectorViewWorkflow:
         self._rois_by_index = dict(sorted(indexed.items()))
         self._roi_names = [name for name, _ in self._rois_by_index.values()]
         self._roi_masks = jnp.asarray(masks)
+        # Installed masks make the ROI spectra carry data: publish them
+        # on the dynamic (per-tick) channel. Clearing every ROI flips
+        # them back to the static zero blocks.
+        self._publish.set_static_keys(
+            () if self._rois_by_index else self._STATIC_ROI_KEYS
+        )
 
     @property
     def roi_names(self) -> list[str]:
@@ -279,8 +301,30 @@ class DetectorViewWorkflow:
             set_state=set_state,
         )
 
+    def publish_offer(self):
+        """Combined-publish offer (core/job_manager.py, ADR 0113): this
+        job's packed publish program joins the tick's fused device round
+        trip; ``finalize`` then consumes the prefetched tree."""
+        from ...ops.publish import make_publish_offer
+
+        return make_publish_offer(
+            self,
+            self._publish,
+            (self._state, self._roi_masks),
+            static_token=self._hist.layout_digest,
+            fresh_state=self._hist.init_state,
+        )
+
     def finalize(self) -> dict[str, DataArray]:
-        out, self._state = self._publish(self._state, self._roi_masks)
+        out = self._prefetched_publish
+        if out is not None:
+            self._prefetched_publish = None
+        else:
+            out, self._state = self._publish(
+                self._state,
+                self._roi_masks,
+                static_token=self._hist.layout_digest,
+            )
 
         img_coords = {
             "x": self._proj.x_edges,
@@ -395,6 +439,9 @@ class DetectorViewWorkflow:
 
     def clear(self) -> None:
         self._state = self._hist.clear(self._state)
+        # A reset between prefetch and finalize must not resurrect the
+        # pre-reset window on the next publish.
+        self._prefetched_publish = None
 
     # -- state snapshots (core/state_snapshot.py) --------------------------
     def state_fingerprint(self) -> str:

@@ -214,6 +214,14 @@ class DynamicGeometryWorkflow:
     def accumulate(self, data: Mapping[str, Any]) -> None:
         self._inner.accumulate(data)
 
+    def publish_offer(self):
+        """Delegate combined-publish offers (ADR 0113): geometry can
+        only move in ``set_context``, which the JobManager delivers
+        before the publish phase — the inner workflow is stable between
+        the offer and its finalize."""
+        offer_fn = getattr(self._inner, "publish_offer", None)
+        return None if offer_fn is None else offer_fn()
+
     def finalize(self) -> dict[str, DataArray]:
         return self._inner.finalize()
 

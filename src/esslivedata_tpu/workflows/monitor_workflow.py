@@ -139,6 +139,9 @@ class MonitorWorkflow:
 
         # One execute + one fetch per publish (see ops/publish.py).
         self._publish = PackedPublisher(publish_program)
+        #: Combined-publish hand-off (ADR 0113): outputs prefetched by
+        #: the JobManager's fused tick round trip, consumed in finalize.
+        self._prefetched_publish: dict | None = None
         # Dense-mode accumulation happens host-side (tiny arrays).
         self._dense_cumulative = np.zeros(params.toa_bins)
         self._dense_window = np.zeros(params.toa_bins)
@@ -267,8 +270,25 @@ class MonitorWorkflow:
         self._dense_window += rebinned
         self._dense_cumulative += rebinned
 
+    def publish_offer(self):
+        """Combined-publish offer (ADR 0113): K monitor jobs due in one
+        tick share a single device round trip. The dense histogram-mode
+        accumulation is host-side and merges at finalize as always."""
+        from ..ops.publish import make_publish_offer
+
+        return make_publish_offer(
+            self,
+            self._publish,
+            (self._state,),
+            fresh_state=self._hist.init_state,
+        )
+
     def finalize(self) -> dict[str, DataArray]:
-        out, self._state = self._publish(self._state)
+        out = self._prefetched_publish
+        if out is not None:
+            self._prefetched_publish = None
+        else:
+            out, self._state = self._publish(self._state)
         win = out["win"] + self._dense_window
         cum = out["cum"] + self._dense_cumulative
         self._dense_window = np.zeros_like(self._dense_window)
@@ -294,6 +314,7 @@ class MonitorWorkflow:
         self._state = self._hist.clear(self._state)
         self._dense_cumulative[:] = 0.0
         self._dense_window[:] = 0.0
+        self._prefetched_publish = None
 
     # -- state snapshots (core/state_snapshot.py, ADR 0107) ----------------
     def state_fingerprint(self) -> str:

@@ -92,6 +92,7 @@ class MultiBankViewWorkflow:
         self._edges_var = Variable(edges, ("toa",), "ns")
         self._n_banks = n_banks
         self._publish = None
+        self._prefetched_publish: dict | None = None
 
     @property
     def is_sharded(self) -> bool:
@@ -166,9 +167,27 @@ class MultiBankViewWorkflow:
             self._publish = PackedPublisher(program)
         return self._publish
 
+    def publish_offer(self):
+        """Combined-publish offer (ADR 0113) — single-chip path only:
+        the sharded state spans the mesh and keeps its collective read."""
+        if self._sharded is not None:
+            return None
+        from ..ops.publish import make_publish_offer
+
+        return make_publish_offer(
+            self,
+            self._publisher(),
+            (self._state,),
+            fresh_state=self._hist.init_state,
+        )
+
     def finalize(self) -> dict[str, DataArray]:
         if self._sharded is None:
-            out, self._state = self._publisher()(self._state)
+            out = self._prefetched_publish
+            if out is not None:
+                self._prefetched_publish = None
+            else:
+                out, self._state = self._publisher()(self._state)
             win_spectra = out["bank_spectra_current"]
             cum_spectra = out["bank_spectra_cumulative"]
             win_counts = out["bank_counts_current"]
@@ -223,3 +242,4 @@ class MultiBankViewWorkflow:
             self._state = self._sharded.init_state()
         else:
             self._state = self._hist.clear(self._state)
+        self._prefetched_publish = None

@@ -47,6 +47,9 @@ class QStreamingMixin:
     _transmission_streams: frozenset[str] = frozenset()
     _trans_win: float = 0.0
     _trans_cum: float = 0.0
+    #: Combined-publish hand-off (ADR 0113): outputs prefetched by the
+    #: JobManager's fused tick round trip, consumed by ``_take_publish``.
+    _prefetched_publish: dict | None = None
 
     def accumulate(self, data: Mapping[str, Any]) -> None:
         monitor_count = 0.0
@@ -140,9 +143,7 @@ class QStreamingMixin:
         self._trans_cum = float(arrays.get("trans_cum", 0.0))
         return True
 
-    def _take_publish(self) -> tuple[np.ndarray, np.ndarray, float, float]:
-        """One fused publish: (window, cumulative, monitor_window,
-        monitor_cumulative) on host; the window folds."""
+    def _publisher(self):
         if self._publish is None:
             from ..ops.publish import PackedPublisher
 
@@ -156,7 +157,31 @@ class QStreamingMixin:
                 return outputs, self._hist.fold_window(state)
 
             self._publish = PackedPublisher(program)
-        out, self._state = self._publish(self._state)
+        return self._publish
+
+    def publish_offer(self):
+        """Combined-publish offer (ADR 0113): every QHistogrammer-backed
+        reduction due in a tick joins the one device round trip. The
+        host-side transmission counters never ride the device publish."""
+        if getattr(self, "_state", None) is None:
+            return None  # context-gated workflow before its first table
+        from ..ops.publish import make_publish_offer
+
+        return make_publish_offer(
+            self,
+            self._publisher(),
+            (self._state,),
+            fresh_state=self._hist.init_state,
+        )
+
+    def _take_publish(self) -> tuple[np.ndarray, np.ndarray, float, float]:
+        """One fused publish: (window, cumulative, monitor_window,
+        monitor_cumulative) on host; the window folds."""
+        out = self._prefetched_publish
+        if out is not None:
+            self._prefetched_publish = None
+        else:
+            out, self._state = self._publisher()(self._state)
         return (
             out["win"],
             out["cum"],
@@ -175,3 +200,4 @@ class QStreamingMixin:
         self._state = self._hist.clear()
         self._trans_win = 0.0
         self._trans_cum = 0.0
+        self._prefetched_publish = None

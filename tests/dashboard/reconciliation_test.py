@@ -219,21 +219,61 @@ class TestActiveConfigPersistence:
             job_id.job_number
         )
 
-    def test_recommit_supersedes_only_live_previous_job(self):
+    def test_recommit_stops_session_committed_predecessor_unconditionally(
+        self,
+    ):
+        """A predecessor committed in THIS session is alive by
+        construction: its retirement stop must not wait on (or be
+        skipped by) the first status heartbeat — the 2 s heartbeat
+        cadence races a fast recommit, and losing that race used to
+        leave the superseded job accumulating forever."""
         js, orch, transport = make_pair(MemoryConfigStore())
         first = self._commit(orch)
-        # Previous job NOT observed alive: no retirement stop published.
+        # Previous job not yet observed via heartbeat: the stop is
+        # published anyway (command-topic ordering guarantees the
+        # service sees its start first).
         self._commit(orch)
         stops = [c for c in transport.commands if c.get("action") == "stop"]
-        assert stops == []
-        # Now with the (new) job observed alive, a further recommit
-        # retires it.
+        assert len(stops) == 1
+        assert stops[0]["job_number"] == str(first.job_number)
+        # With the (new) job observed alive, a further recommit retires
+        # it too.
         current = orch.active_config(self.WID)["mon"]["job_number"]
         js.on_status(
             heartbeat("svc", [("mon", uuid.UUID(current), "active")])
         )
         self._commit(orch)
         stops = [c for c in transport.commands if c.get("action") == "stop"]
+        assert len(stops) == 2
+        assert stops[1]["job_number"] == current
+
+    def test_recommit_supersedes_restored_previous_job_only_when_live(self):
+        """RESTORED records (from persistence) keep the observed-alive
+        guard: the job may have died while the dashboard was down, and
+        commanding a dead job would never be acked (spurious expiry
+        alarm)."""
+        store = MemoryConfigStore()
+        js, orch, _ = make_pair(store)
+        first = self._commit(orch)
+        # Dashboard restart: the record comes back as restored.
+        js2, orch2, transport2 = make_pair(store)
+        # Never observed alive this session: recommit sends no stop.
+        self._commit(orch2)
+        stops = [
+            c for c in transport2.commands if c.get("action") == "stop"
+        ]
+        assert stops == []
+        # Same restart scenario, but the restored job IS observed alive
+        # before the recommit: it gets its retirement stop.
+        js3, orch3, transport3 = make_pair(store)
+        current = orch3.active_config(self.WID)["mon"]["job_number"]
+        js3.on_status(
+            heartbeat("svc", [("mon", uuid.UUID(current), "active")])
+        )
+        self._commit(orch3)
+        stops = [
+            c for c in transport3.commands if c.get("action") == "stop"
+        ]
         assert len(stops) == 1
         assert stops[0]["job_number"] == current
 

@@ -109,6 +109,21 @@ def fan_out(jobs, batch, states):
     for job in jobs:
         states[job] = step(states[job], jax.device_put(batch))
 ''',
+    "JGL015": '''
+import jax
+import numpy as np
+
+def publish_all(jobs, batch):
+    out = {}
+    for job in jobs:
+        out[job] = jax.device_get(job.state)
+    for job in jobs:
+        job.state.block_until_ready()
+    for rec in jobs:
+        summary = rec.hist.finalize(rec.state)
+        out[rec] = np.asarray(summary)
+    return out
+''',
     "JGL010": '''
 import queue
 import threading
@@ -302,6 +317,25 @@ def host_helper(xs):
     return xs
 
 helper = partial(host_helper, [1, 2])
+''',
+    # Fetch hoisted below the loop (one packed device_get), fetches in
+    # non-job loops, and np.asarray of host values all stay quiet.
+    "JGL015": '''
+import jax
+import numpy as np
+
+def publish_all(jobs, batches, precomputed):
+    packed = pack(jobs)
+    flat = jax.device_get(packed)
+    for job in jobs:
+        out = np.asarray(job.host_counts)
+    for batch in batches:
+        fetched = jax.device_get(batch)
+    # 'rec' must match whole tokens only: 'precomputed'/'recent' are
+    # not per-job loops.
+    for arr in precomputed:
+        recent = jax.device_get(arr)
+    return flat, out, fetched, recent
 ''',
     # Staging hoisted above the loop, per-iteration values staged inside
     # it, values derived from the loop variable, and nested-loop /

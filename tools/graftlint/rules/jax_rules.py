@@ -1,4 +1,4 @@
-"""JAX jit-boundary hazards: JGL001/002/003/006/008/009.
+"""JAX jit-boundary hazards: JGL001/002/003/006/008/009/015.
 
 All of these erase TPU throughput without failing a test — host syncs
 serialize the pipeline behind a device round trip, retraces recompile
@@ -363,3 +363,121 @@ def duplicate_staging_in_loop(ctx: FileContext):
                 "staging above the loop or share it through the "
                 "per-stream DeviceEventCache (ADR 0110)",
             )
+
+
+#: Loop target/iterable name TOKENS that mark a per-job fan-out: the
+#: loop body runs once per subscribed job, so any device->host fetch in
+#: it pays one relay round trip PER JOB per tick. Matched as whole
+#: underscore-separated identifier tokens — substring matching would
+#: have 'rec' flag loops over 'precomputed' or 'recent_batches'
+#: (precision over recall, the ADR 0112 contract).
+_JOBISH_TOKENS = frozenset(
+    {
+        "job", "jobs",
+        "rec", "recs", "record", "records",
+        "offer", "offers",
+        "member", "members",
+        "workflow", "workflows",
+    }
+)
+
+#: Method-call names whose results are (or may be) traced/device
+#: values: a ``np.asarray`` of one inside the loop is a disguised
+#: device->host fetch.
+_TRACED_PRODUCERS = frozenset(
+    {
+        "step",
+        "step_batch",
+        "step_flat",
+        "step_many",
+        "finalize",
+        "views",
+        "views_of",
+        "physical_window",
+        "fold_window",
+        "clear_window",
+    }
+)
+
+
+def _mentions_jobish(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        name = None
+        if isinstance(n, ast.Name):
+            name = n.id
+        elif isinstance(n, ast.Attribute):
+            name = n.attr
+        if name is not None and any(
+            tok in _JOBISH_TOKENS for tok in name.lower().split("_")
+        ):
+            return True
+    return False
+
+
+@rule("JGL015", "device->host fetch inside a per-job loop")
+def fetch_in_per_job_loop(ctx: FileContext):
+    """``jax.device_get`` / ``.block_until_ready()`` / ``np.asarray`` of
+    a traced result inside a loop over jobs — the K-round-trips publish
+    hazard (ADR 0113): each iteration forces its own device->host sync,
+    so K subscribed jobs pay K relay RTTs per tick where one combined
+    fetch would do. Batch device reads across the loop (pack outputs
+    into one array and fetch once — ops/publish.py), or let the
+    PublishCombiner serve the whole group from a single round trip."""
+    for loop in ctx.nodes(ast.For):
+        if not (
+            _mentions_jobish(loop.target) or _mentions_jobish(loop.iter)
+        ):
+            continue
+        # Names assigned in this loop from calls that produce traced
+        # values: np.asarray of one is a fetch in disguise.
+        traced_names: set[str] = set()
+        for sub in ctx.walk_shallow(loop):
+            if not isinstance(sub, ast.Assign):
+                continue
+            value = sub.value
+            call = value
+            if isinstance(call, ast.Call) and (
+                (
+                    isinstance(call.func, ast.Attribute)
+                    and call.func.attr in _TRACED_PRODUCERS
+                )
+                or (
+                    isinstance(call.func, ast.Name)
+                    and call.func.id in _TRACED_PRODUCERS
+                )
+            ):
+                for t in sub.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            traced_names.add(n.id)
+        for node in ctx.walk_shallow(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            qual = ctx.qualname(node.func)
+            hit = None
+            if qual == "jax.device_get":
+                hit = "jax.device_get()"
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready"
+            ):
+                hit = ".block_until_ready()"
+            elif (
+                qual in ("numpy.asarray", "numpy.array")
+                and node.args
+                and traced_names
+                and ctx.mentions_any(node.args[0], frozenset(traced_names))
+            ):
+                hit = f"{qual.replace('numpy.', 'np.', 1)}() of a traced result"
+            if hit:
+                yield Finding(
+                    ctx.path,
+                    node.lineno,
+                    "JGL015",
+                    f"{hit} inside a per-job loop forces one device->host "
+                    "round trip per job per tick (a relay RTT each, "
+                    "PERF.md round 5: 87.7 ms p50); pack the per-job "
+                    "outputs into one fetch (ops/publish.py "
+                    "PackedPublisher/PublishCombiner, ADR 0113) or hoist "
+                    "the fetch below the loop",
+                )
