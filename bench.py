@@ -578,7 +578,16 @@ def bench_publish(args) -> dict:
                 params=DetectorViewParams(histogram_method=method),
             )
         )
-        mgr = JobManager(job_factory=JobFactory(reg), job_threads=min(4, k))
+        # tick_program=False: this scenario measures the ADR 0113
+        # PublishCombiner path (the tick program would otherwise route
+        # around it and the publish_combining metric would silently
+        # change meaning vs the PERF.md round-7 numbers); the ADR 0114
+        # tick path has its own --tick scenario.
+        mgr = JobManager(
+            job_factory=JobFactory(reg),
+            job_threads=min(4, k),
+            tick_program=False,
+        )
         for _ in range(k):
             mgr.schedule_job(
                 WorkflowConfig(
@@ -652,6 +661,191 @@ def bench_publish(args) -> dict:
     }
     print(json.dumps(summary), file=sys.stderr)
     return results[4]
+
+
+def bench_tick(args) -> dict:
+    """One-dispatch tick programs through the REAL JobManager path
+    (ADR 0114).
+
+    K=4 same-layout detector-view jobs on one stream, publishing every
+    window. Without the tick program a steady-state window pays up to
+    three device round trips on the relay: the staging transfer
+    (stage-once cache miss — every window carries new events), the
+    fused ``step_many`` dispatch, and the combined publish execute +
+    fetch (ADR 0113). With it the step and publish fuse into ONE jitted
+    tick program: one execute + one fetch per tick, with the staging
+    transfer overlapped (async ``device_put``; prestaged entirely away
+    under the pipelined ingest).
+
+    Reads the process-wide publish counters (ops/publish.METRICS) and
+    the stage-once cache stats drained around the measured loop, so the
+    per-tick RTT decomposition (staging transfers / separate step
+    dispatches / publish executes / fetches) is exactly the device
+    traffic each path performed.
+
+    Acceptance (asserted here AND in --smoke/CI): with the tick program
+    a steady-state tick is exactly 1 execute + 1 fetch + 0 separate
+    step dispatches at K=4 (the no-tick reference pays 1 fetch but >=2
+    dispatches), steady-state static bytes == 0, every window actually
+    rode a tick program, and the da00 wire output is byte-identical to
+    the separate-dispatch path. One JSON line per mode plus a summary
+    line, on stderr.
+    """
+    from esslivedata_tpu.config import JobId, WorkflowConfig, WorkflowSpec
+    from esslivedata_tpu.core.job_manager import JobFactory, JobManager
+    from esslivedata_tpu.core.timestamp import Timestamp
+    from esslivedata_tpu.kafka.da00_compat import dataarray_to_da00
+    from esslivedata_tpu.kafka.wire import encode_da00
+    from esslivedata_tpu.ops import EventBatch
+    from esslivedata_tpu.ops.publish import METRICS
+    from esslivedata_tpu.preprocessors.event_data import StagedEvents
+    from esslivedata_tpu.workflows import WorkflowFactory
+    from esslivedata_tpu.workflows.detector_view import (
+        DetectorViewParams,
+        DetectorViewWorkflow,
+        project_logical,
+    )
+
+    side = int(np.sqrt(min(args.pixels, 1 << 14)))
+    det = np.arange(side * side).reshape(side, side)
+    n_events = min(args.events, 1 << 18)
+    n_windows = max(6, args.batches // 4)
+    n_distinct = 4
+    k = 4
+    staged_batches = []
+    for s in range(n_distinct):
+        pid, toa = make_batch(n_events, side * side, seed=400 + s)
+        staged_batches.append(EventBatch.from_arrays(pid, toa))
+
+    def staged(i: int) -> StagedEvents:
+        return StagedEvents(
+            batch=staged_batches[i % n_distinct],
+            first_timestamp=None,
+            last_timestamp=None,
+            n_chunks=1,
+        )
+
+    method = args.method if args.method in ("scatter", "sort") else "scatter"
+
+    def make_mgr(tick_program: bool) -> JobManager:
+        reg = WorkflowFactory()
+        spec = WorkflowSpec(
+            instrument="bench",
+            name=f"dv_tick_{int(tick_program)}",
+            source_names=["det0"],
+        )
+        reg.register_spec(spec).attach_factory(
+            lambda *, source_name, params: DetectorViewWorkflow(
+                projection=project_logical(det),
+                params=DetectorViewParams(histogram_method=method),
+            )
+        )
+        mgr = JobManager(
+            job_factory=JobFactory(reg),
+            job_threads=min(4, k),
+            tick_program=tick_program,
+        )
+        for _ in range(k):
+            mgr.schedule_job(
+                WorkflowConfig(
+                    identifier=spec.identifier,
+                    job_id=JobId(source_name="det0"),
+                )
+            )
+        return mgr
+
+    t0 = Timestamp.from_ns(0)
+    results = {}
+    wire: dict[bool, list[list[bytes]]] = {}
+    for tick_program in (False, True):
+        mgr = make_mgr(tick_program)
+        # Warm windows: the first compiles the static-inclusive program
+        # variant (and fetches the layout's statics once), the second
+        # the steady-state dynamic-only variant.
+        for w in range(2):
+            out = mgr.process_jobs(
+                {"det0": staged(w)}, start=t0, end=Timestamp.from_ns(1 + w)
+            )
+            assert len(out) == k
+        METRICS.drain()
+        mgr.event_cache_stats()  # drain staging counters
+        wire[tick_program] = []
+        start = time.perf_counter()
+        for i in range(n_windows):
+            out = mgr.process_jobs(
+                {"det0": staged(i)}, start=t0, end=Timestamp.from_ns(3 + i)
+            )
+            assert len(out) == k, f"expected {k} results, got {len(out)}"
+            wire[tick_program].append(
+                [
+                    encode_da00(name, 12345, dataarray_to_da00(da))
+                    for res in out
+                    for name, da in res.outputs.items()
+                ]
+            )
+        dt = time.perf_counter() - start
+        m = METRICS.drain()
+        cache = mgr.event_cache_stats()
+        mgr.shutdown()
+        # The per-tick RTT decomposition: every class of device traffic
+        # a steady-state window pays, per tick.
+        decomposition = {
+            "staging_transfers": cache["misses"] / n_windows,
+            "staged_bytes": cache["bytes_staged"] / n_windows,
+            "step_executes": m["step_executes"] / n_windows,
+            "publish_executes": m["executes"] / n_windows,
+            "fetches": m["fetches"] / n_windows,
+        }
+        line = {
+            "metric": "tick_program",
+            "tick_program": tick_program,
+            "jobs": k,
+            # Graded value: device dispatches per steady-state tick —
+            # the quantity the tick program collapses to 1.
+            "value": (m["executes"] + m["step_executes"]) / n_windows,
+            "unit": "dispatches/tick",
+            "executes_per_tick": m["executes"] / n_windows,
+            "fetches_per_tick": m["fetches"] / n_windows,
+            "step_executes_per_tick": m["step_executes"] / n_windows,
+            "tick_publishes": m["tick_publishes"],
+            "static_bytes_total": m["static_bytes"],
+            "rtt_decomposition_per_tick": decomposition,
+            "wall_ms_per_tick": 1e3 * dt / n_windows,
+            "events_per_sec_aggregate": k * n_events * n_windows / dt,
+            "windows": n_windows,
+            "events_per_window": n_events,
+        }
+        results[tick_program] = line
+        print(json.dumps(line), file=sys.stderr)
+
+    # Byte-identity: the tick program may not change a single da00 wire
+    # byte vs the separate fused-step + combined-publish dispatches.
+    for w, (ref, tick) in enumerate(zip(wire[False], wire[True])):
+        assert ref == tick, f"window {w}: tick da00 wire != combined wire"
+
+    ref, tick = results[False], results[True]
+    # The acceptance bound: a steady-state tick is exactly ONE device
+    # execute + ONE fetch with the tick program (vs >= 2 dispatches on
+    # the separate path; >= 3 round trips counting the staging
+    # transfer), every window actually ticked, and statics never
+    # refetch in steady state.
+    assert tick["executes_per_tick"] == 1.0, tick
+    assert tick["fetches_per_tick"] == 1.0, tick
+    assert tick["step_executes_per_tick"] == 0.0, tick
+    assert tick["tick_publishes"] == n_windows, tick
+    assert tick["static_bytes_total"] == 0, tick
+    assert ref["value"] >= 2.0, ref
+    summary = {
+        "metric": "tick_program_summary",
+        # >= 2.0 = the tick program halves (or better) the per-tick
+        # dispatch count; the staging transfer overlap is on top.
+        "dispatch_reduction": ref["value"] / tick["value"],
+        "wire_byte_identical": True,
+        "wall_ms_per_tick_ref": ref["wall_ms_per_tick"],
+        "wall_ms_per_tick_tick": tick["wall_ms_per_tick"],
+    }
+    print(json.dumps(summary), file=sys.stderr)
+    return tick
 
 
 def bench_pipeline(args) -> dict:
@@ -1227,6 +1421,7 @@ def run_benchmark(args, platform: str) -> dict:
             lambda: bench_secondary_configs(args, edges, batches, method),
             lambda: bench_multijob(args),
             lambda: bench_publish(args),
+            lambda: bench_tick(args),
             lambda: bench_pipeline(args),
             lambda: bench_latency(args),
         ):
@@ -1550,6 +1745,16 @@ def _parse_args():
         "and --smoke)",
     )
     parser.add_argument(
+        "--tick",
+        action="store_true",
+        help="Run ONLY the one-dispatch tick-program scenario "
+        "(ADR 0114) on the ambient backend and exit: K=4 same-layout "
+        "jobs through the real JobManager, steady-state 1 execute + "
+        "1 fetch per tick asserted with a per-tick RTT decomposition "
+        "and combined-vs-tick da00 byte identity (dev flag, like "
+        "--multijob; also runs under --all and --smoke)",
+    )
+    parser.add_argument(
         "--smoke",
         action="store_true",
         help="CI smoke: tiny CPU-pinned headline run; asserts the graded "
@@ -1649,6 +1854,27 @@ def _smoke_main(args) -> int:
                 problems.append(f"publish line missing {field!r}")
         if pub_line.get("fetches_per_tick") != 1.0:
             problems.append("publish combining not at 1 fetch/tick")
+    # Tick-program control (ADR 0114): tiny run through the real
+    # JobManager; the scenario itself asserts the 1-execute-1-fetch
+    # steady state at K=4 and the combined-vs-tick da00 byte identity,
+    # and this guards the report's structure.
+    try:
+        tick_line = bench_tick(args)
+    except Exception:
+        traceback.print_exc()
+        problems.append("tick scenario raised")
+    else:
+        for field in (
+            "value",
+            "executes_per_tick",
+            "fetches_per_tick",
+            "step_executes_per_tick",
+            "rtt_decomposition_per_tick",
+        ):
+            if tick_line.get(field) is None:
+                problems.append(f"tick line missing {field!r}")
+        if tick_line.get("value") != 1.0:
+            problems.append("tick program not at 1 dispatch/tick")
     # Pipelined-ingest control (ADR 0111): tiny run through the real
     # JobManager + IngestPipeline; the scenario itself asserts parity,
     # ordering and drain, and this guards the report's structure — a
@@ -1674,7 +1900,8 @@ def _smoke_main(args) -> int:
         return 1
     print(
         "SMOKE OK: metric line parses, stage breakdown present, "
-        "publish combining at 1 fetch/tick, pipelined ingest drained "
+        "publish combining at 1 fetch/tick, tick program at 1 "
+        "dispatch/tick with wire parity, pipelined ingest drained "
         "with parity",
         file=sys.stderr,
     )
@@ -1709,6 +1936,13 @@ def main() -> None:
         if args.batches is None:
             args.batches = 32
         bench_publish(args)
+        sys.exit(0)
+    if args.tick:
+        if args.events is None:
+            args.events = 1 << 17
+        if args.batches is None:
+            args.batches = 32
+        bench_tick(args)
         sys.exit(0)
 
     # Fail-open on driver kill: if SIGTERM arrives mid-ladder, emit the

@@ -88,6 +88,18 @@ class EventIngest:
     get_state: Callable[[], Any]
     set_state: Callable[[Any], None]
 
+    def stage(self, cache, *, pool=None) -> tuple:
+        """The staged device arrays for this offer's wire, handed
+        STRAIGHT into a fused/tick program (ops/tick.py, ADR 0114) as a
+        flat tuple — no per-job intermediate views are materialized.
+        Same keys and staging functions as ``step_many`` would use, so
+        the transfer happens once per (stream, layout) however many
+        jobs' states the program advances, and a prestaged window
+        (ADR 0111) is a guaranteed hit."""
+        return self.hist.tick_staging(
+            self.batch, cache, batch_tag=self.batch_tag, pool=pool
+        )
+
 
 def _staged_nbytes(obj: Any) -> int:
     """Approximate wire bytes of a staged object (array or tuple of

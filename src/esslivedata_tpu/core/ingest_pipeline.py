@@ -21,7 +21,12 @@ the loop into a bounded three-stage pipeline (ADR 0111):
   chunked over a thread pool — plus the async device transfer), warming
   the stage-once slots the step stage will hit.
 - **step** — ``JobManager.process_jobs(prestaged=True)`` + publish, the
-  only stage that touches job state, in submission order.
+  only stage that touches job state, in submission order. On the
+  tick-program fast path (ops/tick.py, ADR 0114) the stage's device
+  work collapses to ONE submit: the prestaged wire feeds a single
+  jitted step+publish program per group, so a steady-state window costs
+  this stage one execute + one fetch — the "publish" timing below is
+  sink serialization only, never a second device round trip.
 
 Ordering and parity
 -------------------
@@ -440,9 +445,10 @@ class IngestPipeline:
                         self._publish(window.results, window.end)
                 # Publish-stage time here is sink serialization only:
                 # the RTT observation moved to the device round trip
-                # itself (JobManager._run_combined_publish times every
-                # combined execute+fetch into the monitor, ADR 0113) —
-                # feeding sink time as "RTT" would anchor the
+                # itself (JobManager times every combined execute+fetch
+                # — and every whole-tick program — into the monitor,
+                # ADR 0113/0114, compile rounds excluded) — feeding
+                # sink time as "RTT" would anchor the
                 # publish-coalescing policy on the wrong quantity.
                 window.stage_s["publish"] = time.perf_counter() - t0
             finally:

@@ -159,6 +159,7 @@ class JobManager:
         job_threads: int = 5,
         snapshot_store=None,
         combine_publish: bool = True,
+        tick_program: bool = True,
     ) -> None:
         self._factory = job_factory or JobFactory()
         #: Cross-job publish combiner (ADR 0113): every job due in a
@@ -166,9 +167,20 @@ class JobManager:
         #: fetch per device. ``combine_publish=False`` keeps the per-job
         #: path (the parity tests' reference).
         from ..ops.publish import PublishCombiner
+        from ..ops.tick import TickCombiner
 
         self._publish_combiner = (
             PublishCombiner() if combine_publish else None
+        )
+        #: Whole-tick program (ADR 0114): a (stream, fuse-key) group
+        #: whose every member is due a publish steps AND publishes in
+        #: ONE jitted dispatch + ONE fetch, replacing the worst-case
+        #: stage/step/publish triple. ``tick_program=False`` keeps the
+        #: separate fused-step + combined-publish path (the parity
+        #: tests' reference); it requires combining — without the
+        #: combiner's offer plumbing there is nothing to fuse into.
+        self._tick_combiner = (
+            TickCombiner() if (combine_publish and tick_program) else None
         )
         #: Publish-coalescing window (link policy, ADR 0113): finalize
         #: only every Nth data window — accumulation continues every
@@ -569,6 +581,215 @@ class JobManager:
                     )
         return {id(rec) for rec, _offer in offers}
 
+    # -- one-dispatch tick programs (ops/tick.py, ADR 0114) ----------------
+    def _split_tick_groups(
+        self, work: list[tuple[_JobRecord, dict[str, Any]]], fuse_groups
+    ) -> tuple[dict[tuple, list], list[tuple[tuple, list]]]:
+        """Partition the fused-step groups into tick-program groups —
+        stepped AND published in one dispatch — and plain fused groups.
+
+        A group rides the tick fast path only when EVERY member can:
+        the member's window data is exactly the fused stream (any other
+        stream would accumulate into the state AFTER the tick published
+        it), the stream is primary (so the publish bookkeeping marks the
+        record due and finalize consumes the prefetched tree — an
+        aux-only window must never leave a stale prefetch behind), and
+        the workflow's ``publish_offer`` names the SAME state object the
+        ingest offer steps (the ``make_publish_offer`` args[0]/carry
+        contract — verified by identity, so a bespoke offer that breaks
+        it degrades to the separate-dispatch path instead of publishing
+        the wrong buffers). Mixed groups stay whole on the fused path —
+        splitting one would pay two dispatches for one group.
+
+        Context ordering is inherited, not re-checked: ``fuse_groups``
+        comes from ``_plan_fused_steps``, which already excludes any
+        record with queued context (``rec.stale_context``) — so a
+        window that carries a fresh geometry/position update never
+        ticks, and the set_context-before-accumulate-before-publish
+        contract holds on this path exactly as on the private one
+        (pinned in tick_program_test.py).
+
+        Unlike fused stepping, singleton groups DO tick: K=1 still
+        collapses step + publish from two dispatches to one.
+        """
+        if self._tick_combiner is None:
+            return fuse_groups, []
+        data_keys = {id(rec): frozenset(jd) for rec, jd in work}
+        rest: dict[tuple, list] = {}
+        ticks: list[tuple[tuple, list]] = []
+        for group_key, members in fuse_groups.items():
+            enriched: list | None = []
+            for rec, stream, value, ingest in members:
+                if (
+                    data_keys.get(id(rec)) != frozenset((stream,))
+                    or stream not in rec.job.primary_streams
+                ):
+                    enriched = None
+                    break
+                offer_fn = getattr(rec.job.workflow, "publish_offer", None)
+                if offer_fn is None:
+                    enriched = None
+                    break
+                try:
+                    offer = offer_fn()
+                except Exception:
+                    logger.exception(
+                        "publish_offer failed for %s", rec.job.job_id
+                    )
+                    enriched = None
+                    break
+                if (
+                    offer is None
+                    or not offer.args
+                    or offer.args[0] is not ingest.get_state()
+                ):
+                    enriched = None
+                    break
+                enriched.append((rec, stream, value, ingest, offer))
+            if enriched:
+                ticks.append((group_key, enriched))
+            else:
+                rest[group_key] = members
+        return rest, ticks
+
+    def _run_tick_programs(
+        self, tick_groups: list[tuple[tuple, list]]
+    ) -> tuple[set[int], dict[JobId, set[str]]]:
+        """Execute every tick group as ONE device dispatch + ONE fetch.
+
+        Returns (served record ids, job_id -> streams accumulated
+        out-of-band). Served records' publishes are complete — the
+        combined-publish pass must skip them and finalize consumes their
+        prefetched trees; the stream map feeds ``Job.add``'s
+        ``skip_accumulate`` exactly like the fused-step map.
+
+        Containment (mirrors ``_run_combined_publish`` +
+        ``_run_fused_steps``): a staging failure drops the whole group
+        to the separate-dispatch path (nothing was touched); a plan
+        failure drops only that member; an unpack failure adopts the
+        member's folded carry — the fold already ran on device, so the
+        stream is still marked accumulated and finalize republishes
+        privately; a dispatch failure after donation resets exactly the
+        members whose buffers were consumed (``state_lost``), with a
+        visible warning, and the private path re-adds THIS window's
+        batch into the fresh state.
+
+        Each group's execute+fetch wall time — the whole tick's device
+        round trip — feeds the link monitor, with compile rounds
+        excluded via ``TickCombiner.last_compiled`` (ADR 0113's
+        mechanism, threaded through this path too so a first-tick
+        compile cannot latch ``publish_coalesce`` spuriously).
+        """
+        served: set[int] = set()
+        streams_done: dict[JobId, set[str]] = {}
+        if not tick_groups:
+            return served, streams_done
+        from ..ops.publish import PublishRequest, publish_args_consumed
+
+        for (stream, key), members in tick_groups:
+            _rec0, _stream0, value0, ingest0, _offer0 = members[0]
+            try:
+                staged = ingest0.stage(value0.cache)
+            except Exception:
+                logger.exception(
+                    "tick staging failed for stream %r (%d jobs); "
+                    "falling back to separate dispatches",
+                    stream,
+                    len(members),
+                )
+                continue
+            requests = [
+                PublishRequest(o.publisher, o.args, o.static_token)
+                for _rec, _strm, _value, _ingest, o in members
+            ]
+            t0 = time.perf_counter()
+            try:
+                results = self._tick_combiner.publish(
+                    ingest0.hist, key, staged, requests
+                )
+            except Exception:
+                # The combiner contains plan/dispatch/unpack failures
+                # per member; anything escaping is a combiner bug — it
+                # must degrade this group to the separate path, never
+                # take the window down. States a partial dispatch
+                # already consumed are rebuilt with a visible warning.
+                logger.exception(
+                    "tick program failed (%d jobs); falling back to "
+                    "separate dispatches",
+                    len(members),
+                )
+                for rec, _strm, _value, _ingest, offer in members:
+                    if publish_args_consumed(offer.args):
+                        if offer.reset is not None:
+                            offer.reset()
+                        rec.warning = (
+                            "tick program failed after buffer donation; "
+                            "accumulation reset (see service log)"
+                        )
+                continue
+            observer = self._link_observer
+            # Compile rounds are one-off XLA work, not round trips —
+            # feeding them would latch coalescing on every startup,
+            # layout swap or wire flip (the combiner-path rule, threaded
+            # through the tick path too).
+            if (
+                observer is not None
+                and not self._tick_combiner.last_compiled
+                and any(res.error is None for res in results)
+            ):
+                try:
+                    observer.observe_publish(time.perf_counter() - t0)
+                except Exception:
+                    logger.debug("link observer failed", exc_info=True)
+            for (rec, strm, _value, _ingest, offer), res in zip(
+                members, results, strict=True
+            ):
+                if res.error is not None:
+                    if res.state_lost:
+                        # Donation already invalidated the buffers: the
+                        # pre-tick accumulation is unrecoverable in
+                        # place. Rebuild a fresh state (the private
+                        # fallback re-adds THIS window's batch) and
+                        # surface the loss instead of stepping a
+                        # deleted array forever.
+                        if offer.reset is not None:
+                            offer.reset()
+                        rec.warning = (
+                            "tick program failed after buffer donation; "
+                            "accumulation reset (see service log)"
+                        )
+                    elif res.carry:
+                        # The step+fold already ran on device: adopt the
+                        # new state, mark the stream accumulated (a
+                        # private re-add would double-count), and let
+                        # finalize republish privately — this tick's
+                        # window summaries read zero; the cumulative is
+                        # intact.
+                        try:
+                            offer.consume(None, res.carry)
+                            streams_done.setdefault(
+                                rec.job.job_id, set()
+                            ).add(strm)
+                        except Exception:
+                            logger.exception(
+                                "tick carry adoption failed for %s",
+                                rec.job.job_id,
+                            )
+                    # Plan-time error (no carry): state untouched — the
+                    # member takes the full private accumulate + publish
+                    # path this window.
+                    continue
+                try:
+                    offer.consume(res.outputs, res.carry)
+                except Exception:
+                    logger.exception(
+                        "tick consume failed for %s", rec.job.job_id
+                    )
+                    continue
+                served.add(id(rec))
+                streams_done.setdefault(rec.job.job_id, set()).add(strm)
+        return served, streams_done
+
     # -- pipelined ingest (core/ingest_pipeline.py, ADR 0111) --------------
     def set_link_observer(self, observer) -> None:
         """Attach a LinkMonitor: every staging miss reports (bytes,
@@ -718,6 +939,14 @@ class JobManager:
         on intermediate windows; accumulation persists and flushes on
         the next publish tick.
 
+        On publish ticks, fused-step groups whose every member is due
+        take the tick-program fast path (ops/tick.py, ADR 0114): step
+        AND publish ride one jitted dispatch + one fetch, so a
+        steady-state tick is a single device round trip instead of the
+        stage/step/publish triple. Groups that can't (extra streams in
+        the window, no publish offer, ``tick_program=False``) keep the
+        separate fused-step + combined-publish dispatches.
+
         ``prestaged`` marks a window whose staged-events values already
         carry slots from a caller-owned cache generation (the pipelined
         ingest: ``open_window`` + ``prestage_window`` ran on a stage
@@ -801,10 +1030,21 @@ class JobManager:
                 or self._window_seq % coalesce == 0
             )
 
-        # Fused stepping (outside the lock, same as the fan-out): each
-        # group of >= 2 jobs sharing a (stream, fuse-key) advances all
-        # its states in ONE jitted dispatch from ONE cached staging.
+        # Tick fast path (outside the lock, same as the fan-out): on a
+        # publish tick, groups whose every member is due step AND
+        # publish in ONE dispatch (ops/tick.py, ADR 0114). Remaining
+        # groups of >= 2 jobs sharing a (stream, fuse-key) advance all
+        # their states in ONE fused dispatch from ONE cached staging.
+        tick_served: set[int] = set()
+        tick_streams: dict[JobId, set[str]] = {}
+        if publish_now and self._tick_combiner is not None:
+            fuse_groups, tick_groups = self._split_tick_groups(
+                work, fuse_groups
+            )
+            tick_served, tick_streams = self._run_tick_programs(tick_groups)
         fused_streams = self._run_fused_steps(fuse_groups)
+        for job_id, streams in tick_streams.items():
+            fused_streams.setdefault(job_id, set()).update(streams)
 
         def run_accumulate(item: tuple[_JobRecord, dict[str, Any]]) -> None:
             rec, job_data = item
@@ -906,7 +1146,12 @@ class JobManager:
 
         results: list[JobResult | None] = []
         if due and publish_now:
-            served = self._run_combined_publish(due)
+            # Tick-served records already published inside their tick
+            # program; combining them again would dispatch a second
+            # publish over the already-folded state.
+            served = tick_served | self._run_combined_publish(
+                [rec for rec in due if id(rec) not in tick_served]
+            )
             if self._executor is not None and len(due) > 1:
                 results = list(self._executor.map(run_finalize, due))
             else:
@@ -992,6 +1237,8 @@ class JobManager:
         groups stay private: a K=1 fused program would compile a second
         identical kernel for no dispatch saving.
         """
+        from ..ops.publish import METRICS
+
         fused: dict[JobId, set[str]] = {}
         for (stream, _key), members in groups.items():
             if len(members) < 2:
@@ -1005,6 +1252,10 @@ class JobManager:
                     cache=value0.cache,
                     batch_tag=offer0.batch_tag,
                 )
+                # One separate step dispatch (the tick program folds
+                # this into the publish execute instead): the bench
+                # ``--tick`` dispatch-count decomposition reads it.
+                METRICS.record(step_executes=1)
             except Exception:
                 logger.exception(
                     "Fused step failed for stream %r (%d jobs); "

@@ -11,7 +11,11 @@ regimes and wrong in the other. This module closes the loop (ADR 0111):
   Bandwidth observations are the wall time of real staging work
   (``DeviceEventCache`` times each stage-once miss and reports the bytes
   it moved); RTT observations are the wall time of real publishes (one
-  execute + one fetch = one device round trip, ``ops/publish.py``).
+  execute + one fetch = one device round trip, ``ops/publish.py``) —
+  or, on the tick-program fast path (``ops/tick.py``, ADR 0114), of the
+  whole step+publish tick, which IS the round trip a steady-state
+  window pays. Compile rounds are excluded on both paths (the
+  combiner's and the tick combiner's ``last_compiled``).
   Both fold into exponentially weighted moving averages under a lock —
   observations arrive from stage workers, publish timings from the step
   worker, and the 30 s metrics reader from the service thread.
@@ -149,9 +153,26 @@ class LinkMonitor:
                 else self._alpha * sample + (1.0 - self._alpha) * self._bw_bps
             )
 
-    def observe_publish(self, seconds: float) -> None:
-        """Fold one publish round trip's wall time in."""
-        if seconds <= 0.0:
+    def observe_publish(self, seconds: float, *, compiled: bool = False) -> None:
+        """Fold one publish round trip's wall time in.
+
+        The observation is the wall time of one real execute+fetch pair
+        — a combined publish (ADR 0113) or a whole tick program
+        (ops/tick.py, ADR 0114: step AND publish in the one dispatch, so
+        the sample is the full device round trip a steady-state tick
+        pays). Compile rounds (``PublishCombiner.last_compiled`` /
+        ``TickCombiner.last_compiled``) are one-off XLA work worth
+        hundreds of ms and must never reach the EWMA — a first-tick
+        compile or a layout-swap/wire-flip recompile would otherwise
+        latch the publish-coalescing policy on a healthy relay. Two ways
+        to exclude them, by caller kind: the JobManager SKIPS the call
+        when ``last_compiled`` is set (the observer slot is duck-typed —
+        a stub observer need not accept this kwarg), while direct
+        LinkMonitor users pass ``compiled=True`` and this method drops
+        the sample. Both are load-bearing; a timing that might include
+        compilation must take one of them.
+        """
+        if compiled or seconds <= 0.0:
             return
         with self._lock:
             self._n_publish += 1

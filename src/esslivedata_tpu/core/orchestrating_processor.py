@@ -625,6 +625,17 @@ class OrchestratingProcessor:
             extra["pipeline"] = self._pipeline.stats()
         if self._link_monitor is not None:
             extra["link"] = self._link_monitor.stats()
+        # Device dispatch decomposition (ADR 0113/0114): publish/tick
+        # executes+fetches and separate step dispatches since process
+        # start. SNAPSHOT, not drain — the counters are process-wide and
+        # the bench/tests drain them around their own measured loops; a
+        # metrics tick must never zero a loop someone else is timing.
+        try:
+            from ..ops.publish import METRICS as publish_metrics
+
+            extra["publish"] = publish_metrics.snapshot()
+        except Exception:  # pragma: no cover - defensive
+            logger.debug("publish metrics unavailable", exc_info=True)
         logger.info("processor_metrics", extra=extra)
 
     def finalize(self) -> None:

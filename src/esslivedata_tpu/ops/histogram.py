@@ -1043,6 +1043,46 @@ class EventHistogrammer:
         pid, toa = stage_raw(batch, cache, batch_tag)
         return self._step_fused(states, self._proj.lut, pid, toa)
 
+    # -- one-dispatch tick program (ops/tick.py, ADR 0114) -----------------
+    def tick_staging(
+        self, batch: EventBatch, cache, *, batch_tag: str = "", pool=None
+    ) -> tuple:
+        """This configuration's staged wire as a flat tuple of device
+        arrays, shaped for ``tick_step``'s trailing arguments.
+
+        Runs exactly the staging ``step_batch``/``step_many`` would run
+        — same cache keys, same functions — so a window prestaged by the
+        pipelined ingest is a guaranteed hit (zero transfers at tick
+        time) and any other same-layout consumer shares the arrays by
+        reference. The device-path tuple leads with the LUT so a live
+        swap stays an argument change (ADR 0105), never a retrace of the
+        step body itself."""
+        if self._method == "pallas2d":
+            return self._staged_partition(
+                batch.pixel_id, batch.toa, cache, batch_tag
+            )
+        if self.supports_host_flatten:
+            return (
+                self._staged_flat(
+                    batch.pixel_id, batch.toa, cache, batch_tag, pool=pool
+                ),
+            )
+        pid, toa = stage_raw(batch, cache, batch_tag)
+        return (self._proj.lut, pid, toa)
+
+    def tick_step(self, states, *staged):
+        """TRACEABLE fused step over ``tick_staging``'s arrays — the tick
+        program (ops/tick.py) composes this with the members' packed
+        publish bodies so step + publish ride ONE dispatch. Applies the
+        exact per-state program the standalone fused ``step_many`` jits
+        run, so tick results are bit-identical to separate stepping."""
+        states = tuple(states)
+        if self._method == "pallas2d":
+            return self._step_part_fused_impl(states, *staged)
+        if self.supports_host_flatten:
+            return self._step_flat_fused_impl(states, *staged)
+        return self._step_fused_impl(states, *staged)
+
     def flatten_partition_host(
         self,
         pixel_id: np.ndarray,

@@ -41,6 +41,14 @@ layers close that gap (ADR 0113):
 Every publish — private or combined — records into :data:`METRICS`
 (executes, fetches, dynamic/static fetched bytes), which the ``--publish``
 bench scenario and the parity tests read.
+
+The third layer lives in :mod:`.tick` (ADR 0114): the per-device
+**tick program** composes the fused event step with the combined packed
+publish under ONE jit, so a steady-state tick is one execute + one
+fetch instead of the stage/step/publish triple. The per-member planning
+and unpack machinery is shared verbatim (:func:`plan_members` /
+:func:`unpack_members`), so tick and combined publishes cannot diverge
+in spec handling, static caching, or containment.
 """
 
 from __future__ import annotations
@@ -65,8 +73,11 @@ __all__ = [
     "PublishOffer",
     "PublishRequest",
     "make_publish_offer",
+    "member_signature",
+    "plan_members",
     "publish_args_consumed",
     "publish_device",
+    "unpack_members",
 ]
 
 logger = logging.getLogger(__name__)
@@ -76,10 +87,17 @@ class PublishMetrics:
     """Process-wide publish round-trip counters.
 
     One ``record`` per publish execute+fetch pair, whether private
-    (``PackedPublisher.__call__``) or combined (``PublishCombiner``).
+    (``PackedPublisher.__call__``), combined (``PublishCombiner``) or a
+    whole-tick program (``ops/tick.TickCombiner``, which sets ``tick``).
     ``dynamic_bytes`` is the packed per-tick vector; ``static_bytes``
     counts only the tokens that actually missed the static cache — at
     most once per (publisher, layout digest) by construction.
+
+    ``step_executes`` counts SEPARATE fused-step dispatches (the
+    stage→step→publish triple's middle round trip): the JobManager
+    records one per ``step_many`` group it runs outside a tick program,
+    so the bench ``--tick`` decomposition can show the dispatch count a
+    tick actually pays — 1 with the tick program, ≥2 without.
     """
 
     def __init__(self) -> None:
@@ -90,6 +108,9 @@ class PublishMetrics:
         self._static_bytes = 0
         self._combined_publishes = 0
         self._combined_jobs = 0
+        self._step_executes = 0
+        self._tick_publishes = 0
+        self._tick_jobs = 0
 
     def record(
         self,
@@ -99,15 +120,21 @@ class PublishMetrics:
         dynamic_bytes: int = 0,
         static_bytes: int = 0,
         combined_jobs: int = 0,
+        step_executes: int = 0,
+        tick: bool = False,
     ) -> None:
         with self._lock:
             self._executes += executes
             self._fetches += fetches
             self._dynamic_bytes += dynamic_bytes
             self._static_bytes += static_bytes
+            self._step_executes += step_executes
             if combined_jobs:
                 self._combined_publishes += 1
                 self._combined_jobs += combined_jobs
+            if tick:
+                self._tick_publishes += 1
+                self._tick_jobs += combined_jobs
 
     def _dict(self) -> dict[str, int]:
         return {
@@ -117,6 +144,9 @@ class PublishMetrics:
             "static_bytes": self._static_bytes,
             "combined_publishes": self._combined_publishes,
             "combined_jobs": self._combined_jobs,
+            "step_executes": self._step_executes,
+            "tick_publishes": self._tick_publishes,
+            "tick_jobs": self._tick_jobs,
         }
 
     def snapshot(self) -> dict[str, int]:
@@ -132,6 +162,9 @@ class PublishMetrics:
             self._static_bytes = 0
             self._combined_publishes = 0
             self._combined_jobs = 0
+            self._step_executes = 0
+            self._tick_publishes = 0
+            self._tick_jobs = 0
         return out
 
 
@@ -491,6 +524,88 @@ class CombinedPublish:
     state_lost: bool = False
 
 
+def plan_members(
+    requests: Sequence[PublishRequest],
+) -> tuple[list[tuple], dict[int, BaseException]]:
+    """Per-member publish plans for one combined/tick dispatch.
+
+    Each plan entry is ``(index, request, skeys, dyn_spec, static_names,
+    include_static, cached_statics, packed_size)`` — the resolved
+    ``PackedPublisher._static_plan`` for that member. Containment: a
+    member whose plan raises (bad restored state, workflow bug surfacing
+    at abstract-evaluation time) lands in the error dict and drops out
+    of the dispatch; the rest of the tick proceeds. Shared by
+    :class:`PublishCombiner` and :class:`~.tick.TickCombiner` so the two
+    cannot diverge in static-cache or spec handling.
+    """
+    plan: list[tuple] = []
+    planned_errors: dict[int, BaseException] = {}
+    for i, req in enumerate(requests):
+        try:
+            skeys, dyn_spec, static_names, cached, include_static = (
+                req.publisher._static_plan(req.args, req.static_token)
+            )
+        except Exception as err:
+            logger.exception("combined publish plan failed (member %d)", i)
+            planned_errors[i] = err
+            continue
+        size = sum(s for _, _, s in dyn_spec)
+        plan.append(
+            (i, req, skeys, dyn_spec, static_names, include_static,
+             cached, size)
+        )
+    return plan, planned_errors
+
+
+def member_signature(plan: list[tuple]) -> tuple:
+    """The jit-cache key fragment for a planned member set: publisher
+    identity, args signature, static split and static inclusion per
+    member — exactly what determines the compiled program."""
+    return tuple(
+        (req.publisher, req.publisher._signature(req.args), skeys,
+         include_static)
+        for _i, req, skeys, _spec, _names, include_static, _c, _s in plan
+    )
+
+
+def unpack_members(
+    plan: list[tuple],
+    flat: np.ndarray,
+    static_fetched,
+    carries,
+    by_index: dict[int, CombinedPublish],
+) -> int:
+    """Fan one packed fetch back out per planned member; returns the
+    static bytes adopted. Per-member unpack containment: one bad
+    spec/shape cannot poison the other members' trees (their offsets are
+    fixed), and an unpack-failed member still carries its (valid) folded
+    carry for adoption."""
+    offset = 0
+    static_total = 0
+    for k, (
+        _i, req, _skeys, dyn_spec, static_names, include_static, cached,
+        size,
+    ) in enumerate(plan):
+        carry = tuple(carries[k])
+        try:
+            outputs = _unpack_segment(flat[offset : offset + size], dyn_spec)
+            if static_names:
+                if include_static:
+                    cached, nbytes = req.publisher._static_adopt(
+                        req.static_token, static_names, static_fetched[k]
+                    )
+                    static_total += nbytes
+                outputs.update(cached)
+            by_index[_i] = CombinedPublish(outputs, carry)
+        except Exception as err:
+            logger.exception(
+                "combined publish unpack failed (member %d)", _i
+            )
+            by_index[_i] = CombinedPublish(None, carry, error=err)
+        offset += size
+    return static_total
+
+
 class PublishCombiner:
     """One execute + one packed fetch for K jobs' publish programs.
 
@@ -517,40 +632,19 @@ class PublishCombiner:
     def publish(
         self, requests: Sequence[PublishRequest]
     ) -> list[CombinedPublish]:
-        # Per-member plan containment: a publish program that raises at
-        # abstract-evaluation time (bad restored state, workflow bug
-        # surfacing on first publish) drops ONLY that member — it gets
-        # an error result (caller falls back to its private path, where
-        # the same trace error lands in per-job containment) while the
-        # rest of the tick combines normally.
-        plan = []
-        planned_errors: dict[int, BaseException] = {}
-        for i, req in enumerate(requests):
-            try:
-                skeys, dyn_spec, static_names, cached, include_static = (
-                    req.publisher._static_plan(req.args, req.static_token)
-                )
-            except Exception as err:
-                logger.exception(
-                    "combined publish plan failed (member %d)", i
-                )
-                planned_errors[i] = err
-                continue
-            size = sum(s for _, _, s in dyn_spec)
-            plan.append(
-                (i, req, skeys, dyn_spec, static_names, include_static,
-                 cached, size)
-            )
+        # Per-member plan containment (plan_members): a publish program
+        # that raises at abstract-evaluation time (bad restored state,
+        # workflow bug surfacing on first publish) drops ONLY that
+        # member — it gets an error result (caller falls back to its
+        # private path, where the same trace error lands in per-job
+        # containment) while the rest of the tick combines normally.
+        plan, planned_errors = plan_members(requests)
         if not plan:
             return [
                 CombinedPublish(None, (), error=planned_errors.get(i))
                 for i in range(len(requests))
             ]
-        key = tuple(
-            (req.publisher, req.publisher._signature(req.args), skeys,
-             include_static)
-            for _i, req, skeys, _spec, _names, include_static, _c, _s in plan
-        )
+        key = member_signature(plan)
         fn = self._programs.get(key)
         self.last_compiled = fn is None
         if fn is not None:
@@ -594,31 +688,9 @@ class PublishCombiner:
                     state_lost=publish_args_consumed(req.args),
                 )
             return [by_index[i] for i in range(len(requests))]
-        offset = 0
-        static_total = 0
-        for k, (
-            _i, req, _skeys, dyn_spec, static_names, include_static, cached,
-            size,
-        ) in enumerate(plan):
-            carry = tuple(carries[k])
-            # Per-member unpack containment: one bad spec/shape cannot
-            # poison the other members' trees (their offsets are fixed).
-            try:
-                outputs = _unpack_segment(flat[offset : offset + size], dyn_spec)
-                if static_names:
-                    if include_static:
-                        cached, nbytes = req.publisher._static_adopt(
-                            req.static_token, static_names, static_fetched[k]
-                        )
-                        static_total += nbytes
-                    outputs.update(cached)
-                by_index[_i] = CombinedPublish(outputs, carry)
-            except Exception as err:
-                logger.exception(
-                    "combined publish unpack failed (member %d)", _i
-                )
-                by_index[_i] = CombinedPublish(None, carry, error=err)
-            offset += size
+        static_total = unpack_members(
+            plan, flat, static_fetched, carries, by_index
+        )
         METRICS.record(
             executes=1,
             fetches=1,

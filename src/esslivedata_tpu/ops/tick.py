@@ -1,0 +1,247 @@
+"""One dispatch per tick: the fused stage→step→publish device program.
+
+A steady-state ingest tick used to pay up to three device round trips
+over a relay whose p50 RTT alone (87.7 ms, PERF.md round 7) consumes the
+<100 ms ingest→publish budget: the staging transfer on a
+``DeviceEventCache`` miss, the fused ``step_many`` dispatch, and the
+combined publish execute + fetch (ADR 0113). The step and publish halves
+were already each one dispatch — but they were *separate* dispatches,
+and on a network-attached accelerator every dispatch boundary is a relay
+round trip.
+
+:class:`TickCombiner` closes the gap (ADR 0114): for each (stream,
+fuse-key) group of same-layout jobs due in a publish tick it builds ONE
+jitted **tick program** that
+
+- consumes the group's staged event arrays exactly as ``step_many``
+  would (``EventHistogrammer.tick_staging`` — same cache keys, so a
+  prestaged window is a guaranteed hit and the wire stages once however
+  many jobs subscribe),
+- advances every member's donated rolling state with the SAME traceable
+  fused-step body the standalone ``step_many`` jit runs
+  (``EventHistogrammer.tick_step`` — per-state op order unchanged, so
+  tick results are bit-identical to the three-dispatch path), and
+- feeds each stepped state straight into that member's packed publish
+  body (``PackedPublisher._packed_impl``), concatenating the per-member
+  packed vectors into one fetch with the ADR 0113 static/dynamic output
+  split carried through verbatim.
+
+A steady-state tick is then ONE execute + ONE ``device_get``. Donation
+is shifted like the combiner's: each member's pre-step state enters at
+its flat position and is donated there (the step consumes it; the
+publish fold reuses the buffers), plus any further donated argnums the
+member's publisher declares. Staged event arrays are never donated —
+other consumers of the window (private-path fallbacks, parity paths)
+share them by reference.
+
+Keying: the jitted-program LRU is keyed on (histogrammer identity, the
+group's fuse key + batch tag, the staged wire's signature, the exact
+member tuple). The fuse key already folds in the projection layout
+digest and — for ``method='pallas2d'`` — the wire format, so a live LUT
+swap or a link-policy int32↔uint16 flip re-keys cleanly: the next tick
+compiles (marked via ``last_compiled`` so RTT observers skip it, the
+ADR 0113 mechanism) and staged payloads can never meet a program traced
+for the other wire. The staged signature is in the key so a batch-shape
+change is also visible as a compile, not silently folded into the RTT
+estimate.
+
+Containment mirrors ADR 0113 exactly (the plan/unpack machinery is
+shared with :class:`~.publish.PublishCombiner`): a member whose plan
+fails at abstract evaluation drops out before the dispatch; a member
+whose unpack fails still adopts its folded carry; a dispatch failure
+after donation reports ``state_lost`` per member so the caller can
+rebuild exactly the states that were consumed, leaving every other
+member intact.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import OrderedDict
+from collections.abc import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .publish import (
+    METRICS,
+    CombinedPublish,
+    PackedPublisher,
+    PublishRequest,
+    member_signature,
+    plan_members,
+    publish_args_consumed,
+    unpack_members,
+)
+
+__all__ = ["TickCombiner"]
+
+logger = logging.getLogger(__name__)
+
+
+class TickCombiner:
+    """One execute + one packed fetch for a whole (step + publish) tick.
+
+    Builds (and LRU-caches) a jitted tick program per exact
+    (histogrammer, group key, staged signature, member tuple): the
+    group's fused step runs first, then each member's packed publish
+    body over its stepped state, all under one ``jax.jit``. Member
+    composition changes at command time and layouts/wire formats flip
+    rarely (hysteresis-latched), so recompiles are rare; the cache bound
+    caps how many retired programs (and the publishers/histogrammers
+    they close over) stay alive.
+    """
+
+    def __init__(self, max_programs: int = 16) -> None:
+        self._programs: OrderedDict[tuple, Callable] = OrderedDict()
+        self._max_programs = int(max_programs)
+        #: True when the last ``publish`` compiled its program (cache
+        #: miss). RTT observers must skip those rounds — same contract
+        #: as ``PublishCombiner.last_compiled`` (ADR 0113): a tick
+        #: compile is one-off XLA work, and folding it into the EWMA
+        #: publish RTT would latch the coalescing policy on every
+        #: startup, layout swap or wire flip regardless of relay health.
+        self.last_compiled = False
+
+    def publish(
+        self,
+        hist,
+        group_key,
+        staged: tuple,
+        requests: Sequence[PublishRequest],
+    ) -> list[CombinedPublish]:
+        """Run one tick program: step every member's state (``args[0]``
+        of its request, the ``make_publish_offer`` contract) from the
+        shared ``staged`` arrays, then serve every member's publish from
+        the one packed fetch.
+
+        ``hist`` is the group's (shared-configuration) histogrammer —
+        its ``tick_step`` is the traceable fused step; ``group_key`` is
+        the fused-stepping group key (fuse key + batch tag);
+        ``staged`` is ``tick_staging``'s flat tuple of device arrays.
+        """
+        plan, planned_errors = plan_members(requests)
+        if not plan:
+            return [
+                CombinedPublish(None, (), error=planned_errors.get(i))
+                for i in range(len(requests))
+            ]
+        key = (
+            hist,
+            group_key,
+            PackedPublisher._signature(staged),
+            member_signature(plan),
+        )
+        fn = self._programs.get(key)
+        self.last_compiled = fn is None
+        if fn is not None:
+            # LRU touch: the steady-state program runs every tick and
+            # must never be the eviction victim of key churn (layout
+            # swaps, wire flips) — eviction means a surprise whole-tick
+            # recompile in the hot path.
+            self._programs.move_to_end(key)
+        else:
+            fn = self._build(
+                hist,
+                len(staged),
+                [
+                    (req.publisher, len(req.args), skeys, include_static)
+                    for _i, req, skeys, _spec, _names, include_static, _c, _s
+                    in plan
+                ],
+            )
+            self._programs[key] = fn
+            self._programs.move_to_end(key)
+            while len(self._programs) > self._max_programs:
+                self._programs.popitem(last=False)
+        flat_args = tuple(staged) + tuple(
+            a for _i, req, *_ in plan for a in req.args
+        )
+        by_index: dict[int, CombinedPublish] = {
+            i: CombinedPublish(None, (), error=err)
+            for i, err in planned_errors.items()
+        }
+        try:
+            packed, statics, carries = fn(*flat_args)
+            flat, static_fetched = jax.device_get((packed, statics))
+        except Exception as err:
+            # Dispatch-level failure: per-member containment happens at
+            # the caller, which needs to know whose donated state the
+            # failed dispatch already consumed (state_lost — the step
+            # donates every member state, so a runtime failure may have
+            # invalidated all of them).
+            logger.exception(
+                "tick program dispatch failed (%d jobs)", len(plan)
+            )
+            for _i, req, *_ in plan:
+                by_index[_i] = CombinedPublish(
+                    None,
+                    (),
+                    error=err,
+                    state_lost=publish_args_consumed(req.args),
+                )
+            return [by_index[i] for i in range(len(requests))]
+        static_total = unpack_members(
+            plan, flat, static_fetched, carries, by_index
+        )
+        METRICS.record(
+            executes=1,
+            fetches=1,
+            dynamic_bytes=int(flat.nbytes),
+            static_bytes=static_total,
+            combined_jobs=len(plan),
+            tick=True,
+        )
+        return [by_index[i] for i in range(len(requests))]
+
+    @staticmethod
+    def _build(
+        hist,
+        n_staged: int,
+        members: list[tuple[PackedPublisher, int, frozenset, bool]],
+    ) -> Callable:
+        # Flat argument layout: [staged wire..., member0 args...,
+        # member1 args..., ...] with each member's state at local
+        # position 0 (the make_publish_offer contract).
+        state_offsets: list[int] = []
+        offset = 0
+        for _pub, n_args, _skeys, _inc in members:
+            state_offsets.append(offset)
+            offset += n_args
+
+        def tick(*args):
+            staged = args[:n_staged]
+            flat = args[n_staged:]
+            states = tuple(flat[o] for o in state_offsets)
+            new_states = hist.tick_step(states, *staged)
+            parts, statics, carries = [], [], []
+            for j, (pub, n_args, skeys, include_static) in enumerate(
+                members
+            ):
+                o = state_offsets[j]
+                packed, stat, *carry = pub._packed_impl(
+                    skeys,
+                    include_static,
+                    new_states[j],
+                    *flat[o + 1 : o + n_args],
+                )
+                parts.append(packed)
+                statics.append(stat)
+                carries.append(tuple(carry))
+            packed_all = (
+                jnp.concatenate(parts)
+                if parts
+                else jnp.zeros((0,), jnp.float32)
+            )
+            return packed_all, tuple(statics), tuple(carries)
+
+        # Shifted donation: member states (and any further publisher
+        # donations) keep their donated positions behind the staged
+        # prefix. The staged arrays are shared with other window
+        # consumers and are NEVER donated.
+        donate: list[int] = []
+        offset = n_staged
+        for pub, n_args, _skeys, _inc in members:
+            donate.extend(offset + d for d in pub._donate if d < n_args)
+            offset += n_args
+        return jax.jit(tick, donate_argnums=tuple(donate))

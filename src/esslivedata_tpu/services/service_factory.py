@@ -108,6 +108,14 @@ class DataServiceBuilder:
                 "LIVEDATA_FLATTEN_THREADS", tuning.get("flatten_threads", 0)
             )
         )
+        # One-dispatch tick programs (ADR 0114): on by default — a
+        # steady-state window steps AND publishes in one device round
+        # trip. LIVEDATA_TICK_PROGRAM=0 (or --no-tick-program) keeps the
+        # separate fused-step + combined-publish dispatches, the
+        # triage/parity escape hatch.
+        self.tick_program = _os.environ.get(
+            "LIVEDATA_TICK_PROGRAM", "1"
+        ).lower() not in ("0", "false", "no")
         self._instrument = instrument_registry[instrument]
         self._instrument.load_factories()
         # Subscribe only to streams the hosted specs consume (reference
@@ -163,6 +171,7 @@ class DataServiceBuilder:
             job_factory=JobFactory(),
             job_threads=self._job_threads,
             snapshot_store=snapshot_store,
+            tick_program=self.tick_program,
         )
         # Contract derived from this instrument's registered specs: outputs
         # listed in ``device_outputs`` ride the stable NICOS device stream.
@@ -246,6 +255,14 @@ class DataServiceRunner:
             "during prestaging (multicore ingest hosts; 0/1 = off)",
         )
         parser.add_argument(
+            "--no-tick-program",
+            action="store_true",
+            default=False,
+            help="disable the one-dispatch tick program (ADR 0114) and "
+            "keep the separate fused-step + combined-publish dispatches "
+            "(LIVEDATA_TICK_PROGRAM=0 equivalently; parity/triage)",
+        )
+        parser.add_argument(
             "--kafka-bootstrap",
             default=None,
             help="override the broker from the kafka config namespace",
@@ -297,6 +314,8 @@ class DataServiceRunner:
             builder.pipeline_depth = args.pipeline_depth
         if args.flatten_threads is not None:
             builder.flatten_threads = args.flatten_threads
+        if args.no_tick_program:
+            builder.tick_program = False
         if args.check:
             print(
                 f"{self._service_name}: instrument={args.instrument} "

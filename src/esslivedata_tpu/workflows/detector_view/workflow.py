@@ -284,7 +284,12 @@ class DetectorViewWorkflow:
         """Fused-stepping offer (core/job_manager.py): ingesting a
         primary-stream batch is exactly one histogrammer step over
         this job's private state, so K same-layout detector views can
-        advance in one dispatch from one staged batch."""
+        advance in one dispatch from one staged batch. On publish ticks
+        the same offer feeds the tick program (ops/tick.py, ADR 0114),
+        which composes this step with the packed publish below into ONE
+        dispatch — ``get_state`` must return the same object
+        ``publish_offer`` passes as args[0] (the manager verifies the
+        identity and degrades to separate dispatches otherwise)."""
         if self._primary_stream is not None and stream != self._primary_stream:
             return None
         from ...core.device_event_cache import EventIngest
@@ -304,7 +309,12 @@ class DetectorViewWorkflow:
     def publish_offer(self):
         """Combined-publish offer (core/job_manager.py, ADR 0113): this
         job's packed publish program joins the tick's fused device round
-        trip; ``finalize`` then consumes the prefetched tree."""
+        trip; ``finalize`` then consumes the prefetched tree. Under the
+        tick program (ADR 0114) args[0] is the PRE-step state — the
+        program steps it in-dispatch and publishes the stepped result,
+        so one execute + one fetch covers the whole window. The ROI
+        static split and the layout-digest token carry through both
+        paths unchanged."""
         from ...ops.publish import make_publish_offer
 
         return make_publish_offer(

@@ -222,8 +222,13 @@ class MonitorWorkflow:
     def event_ingest(self, stream: str, staged: StagedEvents):
         """Fused-stepping offer (core/job_manager.py): K same-axis
         monitor jobs on one stream advance in a single dispatch from one
-        (possibly row0-clamped) staged batch. Dense histogram-mode data
-        never arrives as StagedEvents, so it keeps the private path."""
+        (possibly row0-clamped) staged batch; on publish ticks the tick
+        program (ops/tick.py, ADR 0114) fuses that step with the packed
+        publish into the same dispatch. The row0 clamp stays a
+        host-side batch transform keyed by its ``batch_tag``, so K
+        monitor ticks share one clamped staging either way. Dense
+        histogram-mode data never arrives as StagedEvents, so it keeps
+        the private path."""
         from ..core.device_event_cache import EventIngest
 
         batch, tag = self._row0_batch(staged.batch, staged.cache)
@@ -272,8 +277,14 @@ class MonitorWorkflow:
 
     def publish_offer(self):
         """Combined-publish offer (ADR 0113): K monitor jobs due in one
-        tick share a single device round trip. The dense histogram-mode
-        accumulation is host-side and merges at finalize as always."""
+        tick share a single device round trip — under the tick program
+        (ADR 0114) that round trip also carries the event step, args[0]
+        being the pre-step state per the make_publish_offer contract.
+        The dense histogram-mode accumulation is host-side and merges at
+        finalize as always (the device publish never sees it, so the
+        tick's in-dispatch publish stays correct when dense data and
+        staged events share a window — the manager only ticks
+        single-stream windows regardless)."""
         from ..ops.publish import make_publish_offer
 
         return make_publish_offer(

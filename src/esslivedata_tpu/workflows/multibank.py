@@ -124,7 +124,10 @@ class MultiBankViewWorkflow:
 
     def event_ingest(self, stream: str, staged: StagedEvents):
         """Fused-stepping offer for the single-chip path (the sharded
-        path keeps its collective dispatch — its state spans the mesh)."""
+        path keeps its collective dispatch — its state spans the mesh).
+        Feeds the tick program too (ops/tick.py, ADR 0114): the bank
+        reductions in the publish program below then ride the step's
+        dispatch, one round trip for the whole window."""
         if self._sharded is not None:
             return None
         from ..core.device_event_cache import EventIngest
@@ -169,7 +172,10 @@ class MultiBankViewWorkflow:
 
     def publish_offer(self):
         """Combined-publish offer (ADR 0113) — single-chip path only:
-        the sharded state spans the mesh and keeps its collective read."""
+        the sharded state spans the mesh and keeps its collective read.
+        Tick-capable (ADR 0114): args[0] is the pre-step state and the
+        carry is exactly ``(new_state,)``, the make_publish_offer
+        contract the tick program's donation layout relies on."""
         if self._sharded is not None:
             return None
         from ..ops.publish import make_publish_offer

@@ -162,7 +162,18 @@ class QStreamingMixin:
     def publish_offer(self):
         """Combined-publish offer (ADR 0113): every QHistogrammer-backed
         reduction due in a tick joins the one device round trip. The
-        host-side transmission counters never ride the device publish."""
+        host-side transmission counters never ride the device publish.
+
+        NOT tick-program-capable (ADR 0114): the Q family consumes the
+        stage-once cache but offers no ``event_ingest`` — QHistogrammer
+        steps carry per-job calibration tables (Q/wavelength LUTs as jit
+        arguments) rather than one shared fused-step program, so there
+        is no group step for the tick to compose with. The manager's
+        eligibility check (ingest offer required) routes these jobs to
+        the combined publish automatically; publish stays one combined
+        round trip per device, stepping stays one dispatch per job.
+        Extending ``QHistogrammer`` with a ``step_many``/``tick_step``
+        pair is the follow-up that would bring the family on."""
         if getattr(self, "_state", None) is None:
             return None  # context-gated workflow before its first table
         from ..ops.publish import make_publish_offer

@@ -208,6 +208,17 @@ class Hist:
     def _step_impl(self, state, flat):
         return state * self._scale
 ''',
+    # The donated state is read (and re-dispatched) after the dispatch
+    # consumed its buffers.
+    "JGL016": '''
+import numpy as np
+
+def tick_once(hist, state, staged):
+    new_state = hist.step_many((state,), staged)
+    total = np.sum(state.window)
+    state = hist.step_flat(state, staged)
+    return new_state, total
+''',
 }
 
 NEGATIVE = {
@@ -467,6 +478,20 @@ class Hist:
 
     def _step_impl(self, state, flat):
         return state * self._scale * self._FLOOR
+''',
+    # Rebinding the handle from the dispatch's return clears the taint;
+    # the except handler may probe consumed-ness and rebuild; a fresh
+    # loop iteration rebinds before it re-dispatches.
+    "JGL016": '''
+def tick_loop(hist, jobs, staged):
+    for job in jobs:
+        state = job.get_state()
+        try:
+            state = hist.step_many((state,), staged)
+        except RuntimeError:
+            if state_consumed(state):
+                state = hist.init_state()
+        job.set_state(state)
 ''',
 }
 # fmt: on

@@ -59,7 +59,14 @@ def _make_manager(
     make_workflows, stream="det0", *, combine_publish=True, job_threads=2
 ):
     """A JobManager with one job per workflow factory in
-    ``make_workflows``; returns (manager, created workflow instances)."""
+    ``make_workflows``; returns (manager, created workflow instances).
+
+    ``tick_program=False``: this suite pins the ADR 0113 PublishCombiner
+    path — with the ADR 0114 tick program on (the default), tick-eligible
+    groups would route around the combiner and these tests would stop
+    covering the production escape hatch (``--no-tick-program``) and the
+    fallback every non-tick-eligible group takes. The tick path has its
+    own suite (tick_program_test.py)."""
     from esslivedata_tpu.workflows import WorkflowFactory
 
     created = []
@@ -81,6 +88,7 @@ def _make_manager(
         job_factory=JobFactory(reg),
         job_threads=job_threads,
         combine_publish=combine_publish,
+        tick_program=False,
     )
     for identifier in identifiers:
         mgr.schedule_job(

@@ -1,0 +1,579 @@
+"""One-dispatch tick programs (ADR 0114): parity, metrics, containment.
+
+The TickCombiner fuses the fused event step and the combined packed
+publish into ONE jitted dispatch + ONE fetch per (stream, fuse-key)
+group. That may not change a single byte of the da00 wire output vs the
+separate-dispatch path, must actually collapse the dispatch count, and
+must contain failures per member exactly like the combiner it subsumes
+— pinned here through the REAL JobManager path (extends the
+publish_combine_test pattern).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.config import JobId, WorkflowConfig, WorkflowSpec
+from esslivedata_tpu.core.job_manager import JobFactory, JobManager
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.kafka.da00_compat import dataarray_to_da00
+from esslivedata_tpu.kafka.wire import encode_da00
+from esslivedata_tpu.ops import EventBatch
+from esslivedata_tpu.ops.publish import METRICS
+from esslivedata_tpu.preprocessors.event_data import StagedEvents
+from esslivedata_tpu.workflows.detector_view import (
+    DetectorViewParams,
+    DetectorViewWorkflow,
+    project_logical,
+)
+from esslivedata_tpu.workflows.monitor_workflow import MonitorWorkflow
+
+T = Timestamp.from_ns
+
+
+def _staged(pid, toa) -> StagedEvents:
+    return StagedEvents(
+        batch=EventBatch.from_arrays(
+            np.asarray(pid), np.asarray(toa, np.float32)
+        ),
+        first_timestamp=None,
+        last_timestamp=None,
+        n_chunks=1,
+    )
+
+
+def _windows(rng, n_windows, n_events, id_lo, id_hi):
+    return [
+        (
+            rng.integers(id_lo, id_hi, n_events).astype(np.int64),
+            rng.uniform(-1e6, 8e7, n_events).astype(np.float32),
+        )
+        for _ in range(n_windows)
+    ]
+
+
+def _make_manager(
+    make_workflows,
+    stream="det0",
+    *,
+    combine_publish=True,
+    tick_program=True,
+    job_threads=2,
+):
+    from esslivedata_tpu.workflows import WorkflowFactory
+
+    created = []
+    reg = WorkflowFactory()
+    identifiers = []
+    for i, make in enumerate(make_workflows):
+        spec = WorkflowSpec(
+            instrument="test", name=f"tick{i}", source_names=[stream]
+        )
+
+        def factory(*, source_name, params, _make=make):
+            wf = _make()
+            created.append(wf)
+            return wf
+
+        reg.register_spec(spec).attach_factory(factory)
+        identifiers.append(spec.identifier)
+    mgr = JobManager(
+        job_factory=JobFactory(reg),
+        job_threads=job_threads,
+        combine_publish=combine_publish,
+        tick_program=tick_program,
+    )
+    for identifier in identifiers:
+        mgr.schedule_job(
+            WorkflowConfig(
+                identifier=identifier, job_id=JobId(source_name=stream)
+            )
+        )
+    return mgr, created
+
+
+def _wire_bytes(result) -> list[bytes]:
+    return [
+        encode_da00(name, 12345, dataarray_to_da00(da))
+        for name, da in result.outputs.items()
+    ]
+
+
+def _det():
+    return np.arange(144).reshape(12, 12)
+
+
+class TestTickVsThreeDispatchParity:
+    def test_byte_identical_da00_wire_output(self):
+        """Two tick groups (detector views + row0-clamped monitors) vs
+        the separate fused-step + combined-publish path vs the fully
+        private path: every da00 byte identical, every window."""
+        det = _det()
+        makes = [
+            lambda: DetectorViewWorkflow(projection=project_logical(det)),
+            lambda: DetectorViewWorkflow(projection=project_logical(det)),
+            lambda: MonitorWorkflow(),
+            lambda: MonitorWorkflow(),
+        ]
+        tick, _ = _make_manager(makes)
+        combined, _ = _make_manager(makes, tick_program=False)
+        private, _ = _make_manager(
+            makes, combine_publish=False, tick_program=False
+        )
+        rng = np.random.default_rng(51)
+        windows = _windows(rng, 4, 3000, -5, 150)
+        for w, (pid, toa) in enumerate(windows):
+            res_t = tick.process_jobs(
+                {"det0": _staged(pid, toa)}, start=T(0), end=T(w + 1)
+            )
+            res_c = combined.process_jobs(
+                {"det0": _staged(pid, toa)}, start=T(0), end=T(w + 1)
+            )
+            res_p = private.process_jobs(
+                {"det0": _staged(pid, toa)}, start=T(0), end=T(w + 1)
+            )
+            assert len(res_t) == len(res_c) == len(res_p) == 4
+            for rt, rc, rp in zip(res_t, res_c, res_p):
+                assert rt.workflow_id == rc.workflow_id == rp.workflow_id
+                assert list(rt.outputs) == list(rc.outputs) == list(rp.outputs)
+                bt, bc, bp = (
+                    _wire_bytes(rt), _wire_bytes(rc), _wire_bytes(rp)
+                )
+                assert bt == bc, f"window {w}: tick wire != combined wire"
+                assert bt == bp, f"window {w}: tick wire != private wire"
+        for mgr in (tick, combined, private):
+            mgr.shutdown()
+
+    def test_one_dispatch_per_tick(self):
+        """Steady state at K=3 same-layout jobs: exactly one execute +
+        one fetch per tick, ZERO separate step dispatches, every window
+        served by a tick program, statics from the host cache."""
+        det = _det()
+        makes = [
+            lambda: DetectorViewWorkflow(projection=project_logical(det))
+        ] * 3
+        mgr, _ = _make_manager(makes)
+        rng = np.random.default_rng(52)
+        windows = _windows(rng, 4, 2000, -5, 150)
+        # Warm: static fetch + both tick-program variants compile.
+        for w in range(2):
+            pid, toa = windows[w]
+            assert len(
+                mgr.process_jobs(
+                    {"det0": _staged(pid, toa)}, start=T(0), end=T(w + 1)
+                )
+            ) == 3
+        METRICS.drain()
+        for w in (2, 3):
+            pid, toa = windows[w]
+            res = mgr.process_jobs(
+                {"det0": _staged(pid, toa)}, start=T(0), end=T(w + 1)
+            )
+            assert len(res) == 3
+        m = METRICS.drain()
+        assert m["executes"] == 2 and m["fetches"] == 2  # one per tick
+        assert m["step_executes"] == 0  # the step rode the tick program
+        assert m["tick_publishes"] == 2 and m["tick_jobs"] == 6
+        assert m["static_bytes"] == 0  # statics served from host cache
+        mgr.shutdown()
+
+    def test_tick_disabled_keeps_separate_dispatches(self):
+        det = _det()
+        makes = [
+            lambda: DetectorViewWorkflow(projection=project_logical(det))
+        ] * 3
+        mgr, _ = _make_manager(makes, tick_program=False)
+        rng = np.random.default_rng(53)
+        windows = _windows(rng, 3, 2000, -5, 150)
+        for w in range(2):
+            pid, toa = windows[w]
+            mgr.process_jobs(
+                {"det0": _staged(pid, toa)}, start=T(0), end=T(w + 1)
+            )
+        METRICS.drain()
+        pid, toa = windows[2]
+        mgr.process_jobs({"det0": _staged(pid, toa)}, start=T(0), end=T(3))
+        m = METRICS.drain()
+        assert m["tick_publishes"] == 0
+        assert m["step_executes"] == 1  # the separate fused step
+        assert m["executes"] == 1 and m["fetches"] == 1
+        mgr.shutdown()
+
+    def test_coalescing_ticks_only_on_publish_windows(self):
+        """Intermediate coalesced windows keep the fused-step dispatch;
+        the flush window ticks and publishes BOTH windows' counts."""
+        det = _det()
+        mgr, _ = _make_manager(
+            [lambda: DetectorViewWorkflow(projection=project_logical(det))]
+            * 2,
+        )
+        ref, _ = _make_manager(
+            [lambda: DetectorViewWorkflow(projection=project_logical(det))]
+            * 2,
+        )
+        mgr.set_publish_coalesce(2)
+        rng = np.random.default_rng(54)
+        windows = _windows(rng, 4, 1000, 0, 144)
+        counts = []
+        ref_counts = []
+        for w, (pid, toa) in enumerate(windows):
+            res = mgr.process_jobs(
+                {"det0": _staged(pid, toa)}, start=T(0), end=T(w + 1)
+            )
+            if res:
+                counts.append(
+                    float(res[0].outputs["counts_current"].values)
+                )
+            ref_counts.append(
+                float(
+                    ref.process_jobs(
+                        {"det0": _staged(pid, toa)},
+                        start=T(0),
+                        end=T(w + 1),
+                    )[0].outputs["counts_current"].values
+                )
+            )
+        assert counts[0] == ref_counts[0] + ref_counts[1]
+        assert counts[1] == ref_counts[2] + ref_counts[3]
+        mgr.shutdown()
+        ref.shutdown()
+
+
+class TestContextOrdering:
+    def test_fresh_context_windows_bypass_the_tick(self):
+        """A window that carries a fresh context update for a job never
+        ticks (the stale-context guard is inherited from the fused-step
+        planner): context applies before accumulate and publish, so a
+        position move clears accumulation identically on the tick and
+        separate-dispatch paths — bit-for-bit."""
+        from esslivedata_tpu.config import WorkflowSpec
+        from esslivedata_tpu.utils.labeled import DataArray, Variable
+        from esslivedata_tpu.workflows import WorkflowFactory
+        from esslivedata_tpu.workflows.monitor_workflow import MonitorParams
+
+        def make_mgr(tick):
+            reg = WorkflowFactory()
+            spec = WorkflowSpec(
+                instrument="test",
+                name=f"monctx{int(tick)}",
+                source_names=["mon0"],
+                optional_context_keys=("mon_pos",),
+            )
+
+            def fac(*, source_name, params):
+                return MonitorWorkflow(
+                    params=MonitorParams(position_tolerance=0.1),
+                    position_stream="mon_pos",
+                )
+
+            reg.register_spec(spec).attach_factory(fac)
+            mgr = JobManager(
+                job_factory=JobFactory(reg), job_threads=1,
+                tick_program=tick,
+            )
+            for _ in range(2):
+                mgr.schedule_job(
+                    WorkflowConfig(
+                        identifier=spec.identifier,
+                        job_id=JobId(source_name="mon0"),
+                    )
+                )
+            return mgr
+
+        def pos_sample(value):
+            return DataArray(
+                Variable(np.asarray([value]), ("time",), "mm"),
+                coords={"time": Variable(np.asarray([0]), ("time",), "ns")},
+            )
+
+        outs = {}
+        ticked = {}
+        for tick in (True, False):
+            rng = np.random.default_rng(62)  # identical windows per run
+            mgr = make_mgr(tick)
+            counts = []
+            METRICS.drain()
+            for w in range(5):
+                pid = rng.integers(0, 4, 500).astype(np.int64)
+                toa = rng.uniform(0, 7e7, 500).astype(np.float32)
+                ctx, fresh = {}, set()
+                if w == 1:  # anchor position
+                    ctx, fresh = {"mon_pos": pos_sample(0.0)}, {"mon_pos"}
+                if w == 3:  # MOVE beyond tolerance -> must clear
+                    ctx, fresh = {"mon_pos": pos_sample(99.0)}, {"mon_pos"}
+                res = mgr.process_jobs(
+                    {"mon0": _staged(pid, toa)},
+                    context=ctx,
+                    fresh_context=fresh,
+                    start=T(0),
+                    end=T(w + 1),
+                )
+                counts.append(
+                    [
+                        float(r.outputs["counts_cumulative"].values)
+                        for r in res
+                    ]
+                )
+            outs[tick] = counts
+            ticked[tick] = METRICS.drain()["tick_publishes"]
+            mgr.shutdown()
+        assert outs[True] == outs[False]
+        # The move window published the CLEARED accumulation: context
+        # was delivered before accumulate and publish.
+        assert outs[True][3] == [500.0, 500.0]
+        # Windows 1 and 3 carried queued context and stayed off the
+        # tick; the other three ticked.
+        assert ticked[True] == 3 and ticked[False] == 0
+
+
+class TestStaticOutputs:
+    def test_static_fetched_once_then_served_from_cache(self):
+        det = _det()
+        mgr, _ = _make_manager(
+            [lambda: DetectorViewWorkflow(projection=project_logical(det))]
+            * 2,
+        )
+        rng = np.random.default_rng(55)
+        windows = _windows(rng, 3, 2000, -5, 150)
+        METRICS.drain()
+        pid, toa = windows[0]
+        mgr.process_jobs({"det0": _staged(pid, toa)}, start=T(0), end=T(1))
+        first = METRICS.drain()
+        assert first["tick_publishes"] == 1
+        assert first["static_bytes"] > 0  # the zero ROI blocks, once
+        for w in (1, 2):
+            pid, toa = windows[w]
+            mgr.process_jobs(
+                {"det0": _staged(pid, toa)}, start=T(0), end=T(w + 1)
+            )
+        later = METRICS.drain()
+        assert later["tick_publishes"] == 2
+        assert later["static_bytes"] == 0
+        mgr.shutdown()
+
+    def test_layout_digest_swap_refetches_statics(self):
+        """A live-geometry LUT swap re-keys the tick program (the fuse
+        key carries the layout digest) and misses the static cache under
+        the new token — statics refetch exactly once."""
+        det = _det()
+        mgr, created = _make_manager(
+            [lambda: DetectorViewWorkflow(projection=project_logical(det))]
+            * 2,
+        )
+        rng = np.random.default_rng(56)
+        windows = _windows(rng, 3, 2000, -5, 150)
+        pid, toa = windows[0]
+        mgr.process_jobs({"det0": _staged(pid, toa)}, start=T(0), end=T(1))
+        old_digest = created[0].histogrammer.layout_digest
+        perm = np.random.default_rng(57).permutation(144)
+        for wf in created:
+            table = project_logical(det)
+            table.lut[0] = table.lut[0][perm]
+            assert wf.swap_projection(table)
+        assert created[0].histogrammer.layout_digest != old_digest
+        METRICS.drain()
+        pid, toa = windows[1]
+        res = mgr.process_jobs(
+            {"det0": _staged(pid, toa)}, start=T(0), end=T(2)
+        )
+        assert len(res) == 2
+        m = METRICS.drain()
+        assert m["tick_publishes"] == 1  # the swapped layout still ticks
+        assert m["static_bytes"] > 0  # refetched under the new digest
+        pid, toa = windows[2]
+        mgr.process_jobs({"det0": _staged(pid, toa)}, start=T(0), end=T(3))
+        assert METRICS.drain()["static_bytes"] == 0
+        mgr.shutdown()
+
+
+class TestWireFormatFlip:
+    def test_mid_stream_flip_stays_bit_identical(self):
+        """A link-policy int32<->uint16 wire flip between windows
+        re-keys staging AND the tick program (the fuse key carries the
+        compaction flag); counts stay bit-identical to the
+        separate-dispatch reference across the flip."""
+        det = _det()
+
+        def make():
+            return DetectorViewWorkflow(
+                projection=project_logical(det),
+                params=DetectorViewParams(histogram_method="pallas2d"),
+            )
+
+        if make()._hist._method != "pallas2d":  # config rejected it
+            pytest.skip("pallas2d unavailable for this configuration")
+        tick, created_t = _make_manager([make] * 2)
+        ref, created_r = _make_manager([make] * 2, tick_program=False)
+        rng = np.random.default_rng(58)
+        windows = _windows(rng, 4, 1000, 0, 144)
+        for w, (pid, toa) in enumerate(windows):
+            if w == 2:  # mid-stream flip, both managers identically
+                for wf in (*created_t, *created_r):
+                    assert wf.histogrammer.set_wire_format(False)
+            if w == 3:  # and back
+                for wf in (*created_t, *created_r):
+                    assert wf.histogrammer.set_wire_format(True)
+            res_t = tick.process_jobs(
+                {"det0": _staged(pid, toa)}, start=T(0), end=T(w + 1)
+            )
+            res_r = ref.process_jobs(
+                {"det0": _staged(pid, toa)}, start=T(0), end=T(w + 1)
+            )
+            assert len(res_t) == len(res_r) == 2
+            for rt, rr in zip(res_t, res_r):
+                for bt, br in zip(_wire_bytes(rt), _wire_bytes(rr)):
+                    assert bt == br, f"window {w}: flip broke parity"
+        states = {str(s.state) for s in tick.job_statuses()}
+        assert "error" not in states
+        tick.shutdown()
+        ref.shutdown()
+
+
+class TestContainment:
+    def test_state_lost_on_post_donation_dispatch_failure(self):
+        """A dispatch that fails AFTER consuming the donated states
+        resets exactly the affected group's members (fresh zeroed
+        accumulation, job still publishes) and recovers on the next
+        window; the other tick group is untouched."""
+        det = _det()
+        makes = [
+            lambda: DetectorViewWorkflow(projection=project_logical(det)),
+            lambda: DetectorViewWorkflow(projection=project_logical(det)),
+            lambda: MonitorWorkflow(),
+            lambda: MonitorWorkflow(),
+        ]
+        mgr, _ = _make_manager(makes)
+        rng = np.random.default_rng(59)
+        windows = _windows(rng, 4, 1000, 1, 144)
+        # Two warm windows: both tick-program variants (static-inclusive
+        # and dynamic-only) compile, so the poisoned entries below are
+        # the ones the failure window actually hits.
+        for w in range(2):
+            pid, toa = windows[w]
+            res = mgr.process_jobs(
+                {"det0": _staged(pid, toa)}, start=T(0), end=T(w + 1)
+            )
+            assert len(res) == 4
+        w1_monitor_cum = float(res[2].outputs["counts_cumulative"].values)
+
+        # Poison ONLY the detector-view group's cached tick programs
+        # (group key tag "" — the monitors' carry the row0-clamp tag):
+        # each runs the real dispatch, consuming the donated states,
+        # then raises — the post-donation failure mode.
+        combiner = mgr._tick_combiner
+        detector_keys = [
+            key for key in combiner._programs if key[1][-1] == ""
+        ]
+        assert detector_keys
+        saved = {k: combiner._programs[k] for k in detector_keys}
+
+        def poison(fn):
+            def boom(*args):
+                fn(*args)
+                raise RuntimeError("post-donation boom")
+
+            return boom
+
+        for k in detector_keys:
+            combiner._programs[k] = poison(combiner._programs[k])
+        pid, toa = windows[2]
+        res = mgr.process_jobs(
+            {"det0": _staged(pid, toa)}, start=T(0), end=T(3)
+        )
+        # Every job still publishes: the detector members fell back to
+        # the private path over FRESH states (cumulative == this window
+        # only — the pre-failure accumulation was consumed), the
+        # monitors ticked normally (cumulative keeps both windows).
+        assert len(res) == 4
+        det_cur = float(res[0].outputs["counts_current"].values)
+        det_cum = float(res[0].outputs["counts_cumulative"].values)
+        assert det_cum == det_cur  # reset: windows 0-1 are gone
+        mon_cum = float(res[2].outputs["counts_cumulative"].values)
+        assert mon_cum > w1_monitor_cum  # other group unaffected
+        states = {str(s.state) for s in mgr.job_statuses()}
+        assert "error" not in states
+
+        # Recovery: restore the programs; the next window ticks again
+        # and accumulates on top of the rebuilt state.
+        combiner._programs.update(saved)
+        METRICS.drain()
+        pid, toa = windows[3]
+        res = mgr.process_jobs(
+            {"det0": _staged(pid, toa)}, start=T(0), end=T(4)
+        )
+        assert len(res) == 4
+        m = METRICS.drain()
+        assert m["tick_publishes"] == 2  # both groups tick again
+        det_cum3 = float(res[0].outputs["counts_cumulative"].values)
+        assert det_cum3 > det_cum
+        mgr.shutdown()
+
+    def test_member_plan_failure_falls_back_privately(self):
+        """A member whose publish program fails abstract evaluation
+        drops out of the tick; it still accumulates and publishes via
+        its private path while the rest of the group ticks."""
+        det = _det()
+        makes = [
+            lambda: DetectorViewWorkflow(projection=project_logical(det))
+        ] * 3
+        mgr, created = _make_manager(makes)
+
+        def bad_offer():
+            raise RuntimeError("offer exploded")
+
+        created[1].publish_offer = bad_offer
+        rng = np.random.default_rng(60)
+        pid, toa = _windows(rng, 1, 1000, 0, 144)[0]
+        res = mgr.process_jobs(
+            {"det0": _staged(pid, toa)}, start=T(0), end=T(1)
+        )
+        assert len(res) == 3
+        states = {str(s.state) for s in mgr.job_statuses()}
+        assert "error" not in states
+        mgr.shutdown()
+
+
+class TestLinkObserver:
+    class _Observer:
+        def __init__(self):
+            self.publishes: list[float] = []
+            self.stagings: list[tuple[int, float]] = []
+
+        def observe_publish(self, seconds):
+            self.publishes.append(seconds)
+
+        def observe_staging(self, nbytes, seconds):
+            self.stagings.append((nbytes, seconds))
+
+    def test_compile_rounds_do_not_feed_the_rtt_estimate(self):
+        """The tick path threads last_compiled through: the first tick
+        (static-inclusive compile) and the second (dynamic-only compile)
+        are NOT observed; steady-state ticks are."""
+        det = _det()
+        mgr, _ = _make_manager(
+            [lambda: DetectorViewWorkflow(projection=project_logical(det))]
+            * 2,
+        )
+        observer = self._Observer()
+        mgr.set_link_observer(observer)
+        rng = np.random.default_rng(61)
+        windows = _windows(rng, 5, 1000, 0, 144)
+        for w, (pid, toa) in enumerate(windows):
+            mgr.process_jobs(
+                {"det0": _staged(pid, toa)}, start=T(0), end=T(w + 1)
+            )
+        # 5 windows: 2 compile ticks skipped, 3 steady ticks observed.
+        assert len(observer.publishes) == 3
+        assert all(s > 0 for s in observer.publishes)
+        mgr.shutdown()
+
+    def test_link_monitor_ignores_compiled_samples(self):
+        from esslivedata_tpu.core.link_monitor import LinkMonitor
+
+        mon = LinkMonitor(alpha=1.0)
+        mon.observe_publish(0.5, compiled=True)  # a compile round
+        assert mon.rtt_s() is None
+        assert mon.policy().publish_coalesce == 1
+        mon.observe_publish(0.0877)
+        assert mon.policy().publish_coalesce == 4

@@ -1,12 +1,15 @@
-"""JAX jit-boundary hazards: JGL001/002/003/006/008/009/015.
+"""JAX jit-boundary hazards: JGL001/002/003/006/008/009/015/016.
 
-All of these erase TPU throughput without failing a test — host syncs
+Most of these erase TPU throughput without failing a test — host syncs
 serialize the pipeline behind a device round trip, retraces recompile
 the hot kernel mid-stream, a missing donation doubles rolling-state HBM
 traffic, per-scalar ``jnp`` dispatch pays a device transfer per event
 batch, and re-staging a shared batch inside a per-job loop multiplies
-wire traffic by the job count. Rationale and bad/good pairs:
-docs/graftlint.md.
+wire traffic by the job count. JGL016 is the correctness twin: reading
+a state/staged array AFTER it was passed to a donated argnum of a
+tick/step/publish dispatch touches buffers XLA already reused (a
+deleted-array error at best, donation aliasing at worst). Rationale and
+bad/good pairs: docs/graftlint.md.
 """
 
 from __future__ import annotations
@@ -481,3 +484,274 @@ def fetch_in_per_job_loop(ctx: FileContext):
                     "PackedPublisher/PublishCombiner, ADR 0113) or hoist "
                     "the fetch below the loop",
                 )
+
+
+#: Dispatch names that donate their first positional argument — the
+#: state (or states tuple) contract shared by ops/histogram's step
+#: family, ``clear_window``, and the tick/publish combiners (the state
+#: is local arg 0 per the make_publish_offer contract). Matched by
+#: method/function NAME; the private jit handles (``_step_flat`` etc.)
+#: intentionally do not match — they live inside the owning class,
+#: where the wrapper methods are the audited surface.
+_DONATING_DISPATCHES = frozenset(
+    {
+        "step",
+        "step_batch",
+        "step_flat",
+        "step_arrays",
+        "step_many",
+        "tick_step",
+        "clear_window",
+    }
+)
+
+#: Names that donate only when the receiver names itself a
+#: publisher/combiner: ``combiner.publish(requests)`` donates the
+#: member states inside ``requests``; ``sink.publish(messages)`` is a
+#: Kafka call and must stay quiet (precision over recall, ADR 0112).
+_DONATING_GATED = frozenset({"publish", "tick"})
+_PUBLISHER_RECEIVER_TOKENS = frozenset({"publisher", "combiner"})
+
+#: Probe calls allowed on a consumed handle: they read buffer METADATA
+#: (deletion flags), never values — the documented failure-path idiom.
+_CONSUMED_PROBES = frozenset(
+    {
+        "is_deleted",
+        "publish_args_consumed",
+        "state_consumed",
+        "_state_consumed",
+    }
+)
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain of plain names, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return None if base is None else f"{base}.{node.attr}"
+    return None
+
+
+def _call_name(call: ast.Call) -> str | None:
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def _donated_names(call: ast.Call) -> list[str]:
+    """Dotted names whose buffers this call donates ([] = not a
+    donating dispatch, or the donated operand is not a plain name)."""
+    name = _call_name(call)
+    if name is None or not call.args:
+        return []
+    if name in _DONATING_GATED:
+        recv = (
+            _dotted(call.func.value)
+            if isinstance(call.func, ast.Attribute)
+            else None
+        )
+        tokens = set((recv or "").lower().replace(".", "_").split("_"))
+        if not tokens & _PUBLISHER_RECEIVER_TOKENS:
+            return []
+    elif name not in _DONATING_DISPATCHES:
+        return []
+    arg0 = call.args[0]
+    elts = arg0.elts if isinstance(arg0, (ast.Tuple, ast.List)) else [arg0]
+    return [d for e in elts if (d := _dotted(e)) is not None]
+
+
+def _clear_name(tainted: dict[str, tuple[int, str]], name: str) -> None:
+    """Rebinding ``name`` kills its taint (and any dotted extension)."""
+    for key in list(tainted):
+        if key == name or key.startswith(name + "."):
+            del tainted[key]
+
+
+def _clear_target(tgt: ast.AST, tainted: dict[str, tuple[int, str]]) -> None:
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        for elt in tgt.elts:
+            _clear_target(elt, tainted)
+        return
+    if isinstance(tgt, ast.Starred):
+        _clear_target(tgt.value, tainted)
+        return
+    name = _dotted(tgt)
+    if name is not None:
+        _clear_name(tainted, name)
+
+
+def _walk_skipping(node: ast.AST, skip: set):
+    """Child walk that descends into neither ``skip`` subtrees (donation
+    arg sites, probe calls) nor nested callables (their execution
+    context differs), nor compound-statement bodies (the block scanner
+    recurses into those itself)."""
+    for child in ast.iter_child_nodes(node):
+        if child in skip or isinstance(child, (*_SCOPE_NODES, ast.stmt)):
+            continue
+        yield child
+        yield from _walk_skipping(child, skip)
+
+
+class _DonationScan:
+    """Lexical post-donation-reuse scan over one function body.
+
+    Over-approximation contract (ADR 0112, precision over recall):
+    statements are processed in source order; loop bodies get a second
+    pass so a donation feeding back into the next iteration is seen;
+    ``except`` handlers are read-exempt (probing/rebuilding a consumed
+    state there is the documented recovery idiom) but their assignments
+    still clear taints.
+    """
+
+    def __init__(self, ctx: FileContext) -> None:
+        self.ctx = ctx
+        self.findings: list[Finding] = []
+
+    def run(self, fn) -> None:
+        self._block(fn.body, {}, report=True)
+
+    # -- statement dispatch -----------------------------------------------
+    def _block(self, stmts, tainted, *, report: bool) -> None:
+        for stmt in stmts:
+            self._stmt(stmt, tainted, report=report)
+
+    def _stmt(self, stmt, tainted, *, report: bool) -> None:
+        if isinstance(stmt, (*_SCOPE_NODES, ast.ClassDef)):
+            return  # nested scope: runs later, under other bindings
+        if isinstance(stmt, ast.Try):
+            self._block(stmt.body, tainted, report=report)
+            for handler in stmt.handlers:
+                self._block(handler.body, tainted, report=False)
+            self._block(stmt.orelse, tainted, report=report)
+            self._block(stmt.finalbody, tainted, report=report)
+            return
+        if isinstance(stmt, ast.If):
+            self._expr(stmt.test, tainted, report=report)
+            self._block(stmt.body, tainted, report=report)
+            self._block(stmt.orelse, tainted, report=report)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter, tainted, report=report)
+            _clear_target(stmt.target, tainted)
+            # Two passes: a donation late in the body reaches the reads
+            # at its top on the next iteration.
+            self._block(stmt.body, tainted, report=report)
+            _clear_target(stmt.target, tainted)
+            self._block(stmt.body, tainted, report=report)
+            self._block(stmt.orelse, tainted, report=report)
+            return
+        if isinstance(stmt, ast.While):
+            self._expr(stmt.test, tainted, report=report)
+            self._block(stmt.body, tainted, report=report)
+            self._expr(stmt.test, tainted, report=report)
+            self._block(stmt.body, tainted, report=report)
+            self._block(stmt.orelse, tainted, report=report)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._expr(item.context_expr, tainted, report=report)
+                if item.optional_vars is not None:
+                    _clear_target(item.optional_vars, tainted)
+            self._block(stmt.body, tainted, report=report)
+            return
+        # Simple statement: reads, donations, then target clears.
+        self._expr(stmt, tainted, report=report)
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                _clear_target(tgt, tainted)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            _clear_target(stmt.target, tainted)
+        elif isinstance(stmt, (ast.Delete,)):
+            for tgt in stmt.targets:
+                _clear_target(tgt, tainted)
+
+    # -- expression-level reads + donations -------------------------------
+    def _expr(self, node, tainted, *, report: bool) -> None:
+        donations: list[tuple[list[str], ast.Call]] = []
+        skip: set = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, _SCOPE_NODES):
+                continue
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _call_name(sub)
+            if name in _CONSUMED_PROBES:
+                skip.add(sub)
+                continue
+            donated = _donated_names(sub)
+            if donated:
+                donations.append((donated, sub))
+                skip.add(sub.args[0])
+        if report:
+            for sub in _walk_skipping(node, skip):
+                if not isinstance(sub, (ast.Name, ast.Attribute)):
+                    continue
+                if not isinstance(getattr(sub, "ctx", None), ast.Load):
+                    continue
+                name = _dotted(sub)
+                hit = tainted.get(name) if name is not None else None
+                if hit is not None:
+                    line, label = hit
+                    self.findings.append(
+                        Finding(
+                            self.ctx.path,
+                            sub.lineno,
+                            "JGL016",
+                            f"'{name}' is read after being donated to "
+                            f"{label}() on line {line}: the dispatch "
+                            "consumed its buffers (donate_argnums — XLA "
+                            "may already have reused them), so this "
+                            "reads a deleted array. Use the returned "
+                            "state, rebuild via init_state(), or probe "
+                            "only is_deleted()/publish_args_consumed() "
+                            "in the failure path (ADR 0114)",
+                        )
+                    )
+            for donated, call in donations:
+                label = _call_name(call)
+                for name in donated:
+                    hit = tainted.get(name)
+                    if hit is not None:
+                        self.findings.append(
+                            Finding(
+                                self.ctx.path,
+                                call.lineno,
+                                "JGL016",
+                                f"'{name}' is dispatched again via "
+                                f"{label}() after being donated to "
+                                f"{hit[1]}() on line {hit[0]}: the "
+                                "first dispatch consumed its buffers — "
+                                "re-stepping a consumed state reuses "
+                                "freed memory; thread the returned "
+                                "state through instead (ADR 0114)",
+                            )
+                        )
+        for donated, call in donations:
+            label = _call_name(call)
+            for name in donated:
+                tainted[name] = (call.lineno, label)
+
+
+@rule("JGL016", "read of a donated state after a tick/step/publish dispatch")
+def post_donation_reuse(ctx: FileContext):
+    """A tick/step/publish dispatch donates its state argument
+    (``donate_argnums``): after the call, the caller's handle points at
+    buffers XLA has already reused for the outputs. Reading it again —
+    or passing it to a second dispatch — is the post-donation-reuse
+    hazard the one-dispatch tick program (ops/tick.py, ADR 0114) makes
+    easy to write: the state now flows ``offer -> tick program ->
+    carry``, and any code still holding the pre-tick handle is reading
+    freed memory (a deleted-array error on JAX's slow path, silent
+    aliasing on fast ones). Rebinding the handle from the dispatch's
+    return clears the taint; ``except`` handlers may probe consumed-ness
+    (``is_deleted``/``publish_args_consumed``) and rebuild."""
+    for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+        scan = _DonationScan(ctx)
+        scan.run(fn)
+        yield from scan.findings
