@@ -88,16 +88,22 @@ class EventIngest:
     get_state: Callable[[], Any]
     set_state: Callable[[Any], None]
 
-    def stage(self, cache, *, pool=None) -> tuple:
+    def stage(self, cache, *, pool=None, device=None) -> tuple:
         """The staged device arrays for this offer's wire, handed
         STRAIGHT into a fused/tick program (ops/tick.py, ADR 0114) as a
         flat tuple — no per-job intermediate views are materialized.
         Same keys and staging functions as ``step_many`` would use, so
         the transfer happens once per (stream, layout) however many
         jobs' states the program advances, and a prestaged window
-        (ADR 0111) is a guaranteed hit."""
+        (ADR 0111) is a guaranteed hit. ``device`` is the group's mesh
+        slice (parallel/mesh_tick.py): the wire is committed there and
+        the stage-once key carries it, so staging is once per slice.
+        The kwarg is forwarded only when set — bespoke duck-typed
+        histogrammers predating slice placement keep working."""
+        kwargs = {} if device is None else {"device": device}
         return self.hist.tick_staging(
-            self.batch, cache, batch_tag=self.batch_tag, pool=pool
+            self.batch, cache, batch_tag=self.batch_tag, pool=pool,
+            **kwargs,
         )
 
 

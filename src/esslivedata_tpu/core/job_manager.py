@@ -160,6 +160,7 @@ class JobManager:
         snapshot_store=None,
         combine_publish: bool = True,
         tick_program: bool = True,
+        placement=None,
     ) -> None:
         self._factory = job_factory or JobFactory()
         #: Cross-job publish combiner (ADR 0113): every job due in a
@@ -182,6 +183,16 @@ class JobManager:
         self._tick_combiner = (
             TickCombiner() if (combine_publish and tick_program) else None
         )
+        #: Mesh-slice placement policy (parallel/mesh_tick.py,
+        #: ADR 0115): assigns every (stream, fuse-key) tick/fused group
+        #: a sticky mesh slice — a single device round-robin for
+        #: single-device histogrammers, the whole mesh for bank-sharded
+        #: ones. Staging keys carry the slice (one transfer per slice),
+        #: member states are committed to it once at assignment, mesh
+        #: groups run through the slice's MeshTickCombiner, and each
+        #: slice's publish RTT reports separately to the link monitor.
+        #: None = classic single-placement behavior, byte-identical.
+        self._placement = placement
         #: Publish-coalescing window (link policy, ADR 0113): finalize
         #: only every Nth data window — accumulation continues every
         #: window, so a degraded relay pays the publish round trip less
@@ -584,7 +595,7 @@ class JobManager:
     # -- one-dispatch tick programs (ops/tick.py, ADR 0114) ----------------
     def _split_tick_groups(
         self, work: list[tuple[_JobRecord, dict[str, Any]]], fuse_groups
-    ) -> tuple[dict[tuple, list], list[tuple[tuple, list]]]:
+    ) -> tuple[dict[tuple, list], list[tuple[tuple, Any, list]]]:
         """Partition the fused-step groups into tick-program groups —
         stepped AND published in one dispatch — and plain fused groups.
 
@@ -616,8 +627,15 @@ class JobManager:
             return fuse_groups, []
         data_keys = {id(rec): frozenset(jd) for rec, jd in work}
         rest: dict[tuple, list] = {}
-        ticks: list[tuple[tuple, list]] = []
+        ticks: list[tuple[tuple, Any, list]] = []
         for group_key, members in fuse_groups.items():
+            # Slice assignment happens BEFORE offers are collected: a
+            # member whose state must move to its slice gets the moved
+            # state captured in offer.args[0], keeping the identity
+            # check below (and the tick program's donation layout)
+            # honest. Assignment is sticky, so this is a metadata probe
+            # on every tick after a group's first.
+            plc = self._group_placement(group_key, members)
             enriched: list | None = []
             for rec, stream, value, ingest in members:
                 if (
@@ -647,15 +665,39 @@ class JobManager:
                     break
                 enriched.append((rec, stream, value, ingest, offer))
             if enriched:
-                ticks.append((group_key, enriched))
+                ticks.append((group_key, plc, enriched))
             else:
                 rest[group_key] = members
         return rest, ticks
 
+    def _group_placement(self, group_key: tuple, members: list):
+        """The (sticky) mesh slice for one (stream, fuse-key) group —
+        None without a placement policy. Member states are committed to
+        a single-device slice here, before state identity is captured
+        anywhere (publish offers, fused-step tuples); a move failure
+        degrades the group to its current placement rather than taking
+        the window down."""
+        if self._placement is None:
+            return None
+        stream, key = group_key
+        ingest0 = members[0][3]
+        try:
+            plc = self._placement.assign(stream, key, ingest0.hist)
+            if plc.device is not None:
+                for _rec, _strm, _value, ingest in members:
+                    self._placement.ensure_state_on(ingest, plc.device)
+            return plc
+        except Exception:
+            logger.exception(
+                "slice placement failed for group %r", group_key
+            )
+            return None
+
     def _run_tick_programs(
-        self, tick_groups: list[tuple[tuple, list]]
+        self, tick_groups: list[tuple[tuple, Any, list]]
     ) -> tuple[set[int], dict[JobId, set[str]]]:
-        """Execute every tick group as ONE device dispatch + ONE fetch.
+        """Execute every ((stream, key), slice, members) tick group as
+        ONE device dispatch + ONE fetch.
 
         Returns (served record ids, job_id -> streams accumulated
         out-of-band). Served records' publishes are complete — the
@@ -686,10 +728,13 @@ class JobManager:
             return served, streams_done
         from ..ops.publish import PublishRequest, publish_args_consumed
 
-        for (stream, key), members in tick_groups:
+        for (stream, key), plc, members in tick_groups:
             _rec0, _stream0, value0, ingest0, _offer0 = members[0]
             try:
-                staged = ingest0.stage(value0.cache)
+                staged = ingest0.stage(
+                    value0.cache,
+                    device=None if plc is None else plc.device,
+                )
             except Exception:
                 logger.exception(
                     "tick staging failed for stream %r (%d jobs); "
@@ -702,10 +747,21 @@ class JobManager:
                 PublishRequest(o.publisher, o.args, o.static_token)
                 for _rec, _strm, _value, _ingest, o in members
             ]
+            # Mesh-spanning groups run through their slice's
+            # MeshTickCombiner (replicated outputs, one fetch for the
+            # whole mesh); single-device slices share the manager's
+            # combiner — programs are keyed per (hist, group) anyway.
+            combiner = self._tick_combiner
+            slice_key = None
+            if plc is not None:
+                slice_key = plc.label
+                if plc.combiner is not None:
+                    combiner = plc.combiner
             t0 = time.perf_counter()
             try:
-                results = self._tick_combiner.publish(
-                    ingest0.hist, key, staged, requests
+                results = combiner.publish(
+                    ingest0.hist, key, staged, requests,
+                    slice_key=slice_key,
                 )
             except Exception:
                 # The combiner contains plan/dispatch/unpack failures
@@ -731,16 +787,17 @@ class JobManager:
             # Compile rounds are one-off XLA work, not round trips —
             # feeding them would latch coalescing on every startup,
             # layout swap or wire flip (the combiner-path rule, threaded
-            # through the tick path too).
+            # through the tick path too). Slice-placed groups report
+            # under their slice label so the policy reacts to the WORST
+            # slice (ADR 0115).
             if (
                 observer is not None
-                and not self._tick_combiner.last_compiled
+                and not combiner.last_compiled
                 and any(res.error is None for res in results)
             ):
-                try:
-                    observer.observe_publish(time.perf_counter() - t0)
-                except Exception:
-                    logger.debug("link observer failed", exc_info=True)
+                self._observe_publish(
+                    observer, time.perf_counter() - t0, slice_key
+                )
             for (rec, strm, _value, _ingest, offer), res in zip(
                 members, results, strict=True
             ):
@@ -789,6 +846,23 @@ class JobManager:
                 served.add(id(rec))
                 streams_done.setdefault(rec.job.job_id, set()).add(strm)
         return served, streams_done
+
+    @staticmethod
+    def _observe_publish(observer, seconds: float, slice_key) -> None:
+        """Feed one publish RTT sample, with the per-slice label when a
+        placement is active. The observer slot is duck-typed (stub
+        observers in tests take only ``seconds``), so the slice kwarg
+        degrades to the sliceless call instead of losing the sample."""
+        try:
+            if slice_key is None:
+                observer.observe_publish(seconds)
+            else:
+                try:
+                    observer.observe_publish(seconds, slice_key=slice_key)
+                except TypeError:
+                    observer.observe_publish(seconds)
+        except Exception:
+            logger.debug("link observer failed", exc_info=True)
 
     # -- pipelined ingest (core/ingest_pipeline.py, ADR 0111) --------------
     def set_link_observer(self, observer) -> None:
@@ -893,12 +967,31 @@ class JobManager:
                 if key in staged_keys:
                     continue
                 staged_keys.add(key)
+                # Warm the SLICE's key when a placement is active: the
+                # step path stages per-slice, so a default-device
+                # prestage would miss. Assignment is sticky and pure
+                # table lookup — state moves stay on the step thread
+                # (the stage worker must never mutate workflow state).
+                stage_kwargs = {}
+                if self._placement is not None:
+                    try:
+                        plc = self._placement.assign(
+                            name, offer.key, offer.hist
+                        )
+                        if plc.device is not None:
+                            stage_kwargs["device"] = plc.device
+                    except Exception:
+                        logger.debug(
+                            "prestage placement probe failed",
+                            exc_info=True,
+                        )
                 try:
                     stage(
                         offer.batch,
                         value.cache,
                         batch_tag=offer.batch_tag,
                         pool=pool,
+                        **stage_kwargs,
                     )
                 except Exception:
                     logger.exception(
@@ -1244,6 +1337,18 @@ class JobManager:
             if len(members) < 2:
                 continue
             rec0, _stream0, value0, offer0 = members[0]
+            # Same sticky slice as the tick path (coalesced windows run
+            # here; a group must not alternate devices between publish
+            # and non-publish windows — that would re-stage the wire
+            # and re-commit every state per window).
+            plc = self._group_placement((stream, _key), members)
+            device = None if plc is None else plc.device
+            # device is None for un-placed groups AND for bespoke
+            # histogrammers the placement pinned to the default slice
+            # (DevicePlacement probes for device-aware staging), so the
+            # kwarg is only ever forwarded to implementations that
+            # accept it.
+            step_kwargs = {} if device is None else {"device": device}
             states = tuple(m[3].get_state() for m in members)
             try:
                 new_states = offer0.hist.step_many(
@@ -1251,6 +1356,7 @@ class JobManager:
                     offer0.batch,
                     cache=value0.cache,
                     batch_tag=offer0.batch_tag,
+                    **step_kwargs,
                 )
                 # One separate step dispatch (the tick program folds
                 # this into the publish execute instead): the bench

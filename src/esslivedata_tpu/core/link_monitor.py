@@ -65,6 +65,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from dataclasses import dataclass
 
 __all__ = ["LinkMonitor", "LinkPolicy"]
@@ -132,6 +133,15 @@ class LinkMonitor:
         self._lock = threading.Lock()
         self._bw_bps: float | None = None
         self._rtt_s: float | None = None
+        #: Per-mesh-slice publish RTT EWMAs (ADR 0115): a multi-slice
+        #: service publishes concurrently from several devices, and one
+        #: congested slice must widen the publish tick even while the
+        #: others look healthy — the policy reads the WORST slice.
+        #: Entries carry their last-observation time and expire after
+        #: ``_SLICE_TTL_S``: a slice whose jobs stopped must not pin
+        #: the worst-slice RTT (and the coalesce latch) forever with
+        #: its final congested estimate.
+        self._rtt_by_slice: dict[str, tuple[float, float]] = {}
         self._degraded_latch = False
         self._coalesce_latch = False
         self._n_staging = 0
@@ -153,7 +163,13 @@ class LinkMonitor:
                 else self._alpha * sample + (1.0 - self._alpha) * self._bw_bps
             )
 
-    def observe_publish(self, seconds: float, *, compiled: bool = False) -> None:
+    def observe_publish(
+        self,
+        seconds: float,
+        *,
+        compiled: bool = False,
+        slice_key: str | None = None,
+    ) -> None:
         """Fold one publish round trip's wall time in.
 
         The observation is the wall time of one real execute+fetch pair
@@ -171,6 +187,12 @@ class LinkMonitor:
         LinkMonitor users pass ``compiled=True`` and this method drops
         the sample. Both are load-bearing; a timing that might include
         compilation must take one of them.
+
+        ``slice_key`` (mesh serving, ADR 0115) attributes the sample to
+        the mesh slice that executed the tick; per-slice EWMAs feed the
+        policy's worst-slice RTT so one congested device widens the
+        publish tick even while the others look healthy. Sliceless
+        samples (single-device deployments) keep the single estimate.
         """
         if compiled or seconds <= 0.0:
             return
@@ -181,15 +203,60 @@ class LinkMonitor:
                 if self._rtt_s is None
                 else self._alpha * seconds + (1.0 - self._alpha) * self._rtt_s
             )
+            if slice_key is not None:
+                now = time.monotonic()
+                entry = self._rtt_by_slice.get(slice_key)
+                prev = None if entry is None else entry[0]
+                self._rtt_by_slice[slice_key] = (
+                    (
+                        seconds
+                        if prev is None
+                        else self._alpha * seconds
+                        + (1.0 - self._alpha) * prev
+                    ),
+                    now,
+                )
 
     # -- estimates ---------------------------------------------------------
     def bandwidth_bps(self) -> float | None:
         with self._lock:
             return self._bw_bps
 
-    def rtt_s(self) -> float | None:
+    #: Per-slice RTT entries expire this long after their last sample:
+    #: long against any publish cadence (ticks are ~1 Hz, coalesced at
+    #: most 8x), short against a service lifetime — a retired slice
+    #: stops gating the policy within a minute.
+    _SLICE_TTL_S = 60.0
+
+    def rtt_s(self, slice_key: str | None = None) -> float | None:
         with self._lock:
+            if slice_key is not None:
+                entry = self._rtt_by_slice.get(slice_key)
+                return None if entry is None else entry[0]
             return self._rtt_s
+
+    def _policy_rtt_locked(self) -> float | None:
+        """The RTT the adaptation policy reacts to (caller holds the
+        lock): the WORST live per-slice estimate when slices report —
+        the publish tick must widen for the slowest slice, not the mean
+        — else the single global estimate. Expired slices (no sample
+        within the TTL: their jobs stopped or migrated) are pruned here
+        so a dead slice's last congested estimate cannot latch the
+        coalescing policy forever."""
+        if self._rtt_by_slice:
+            cutoff = time.monotonic() - self._SLICE_TTL_S
+            for key in [
+                k
+                for k, (_, seen) in self._rtt_by_slice.items()
+                if seen < cutoff
+            ]:
+                del self._rtt_by_slice[key]
+        if self._rtt_by_slice:
+            worst = max(rtt for rtt, _ in self._rtt_by_slice.values())
+            if self._rtt_s is None:
+                return worst
+            return max(worst, self._rtt_s)
+        return self._rtt_s
 
     # -- policy ------------------------------------------------------------
     def policy(self) -> LinkPolicy:
@@ -197,7 +264,7 @@ class LinkMonitor:
         staging observation converges the bandwidth estimate."""
         with self._lock:
             bw = self._bw_bps
-            rtt = self._rtt_s
+            rtt = self._policy_rtt_locked()
             coalesce = self._publish_coalesce_locked(rtt)
             if bw is None:
                 return LinkPolicy(
@@ -256,6 +323,9 @@ class LinkMonitor:
             return {
                 "bandwidth_bps": self._bw_bps,
                 "rtt_s": self._rtt_s,
+                "rtt_by_slice": {
+                    k: rtt for k, (rtt, _) in self._rtt_by_slice.items()
+                },
                 "n_staging": self._n_staging,
                 "n_publish": self._n_publish,
                 "bytes_observed": self._bytes_observed,

@@ -21,6 +21,8 @@ __all__ = [
     "EventBatch",
     "StagingBuffer",
     "bucket_size",
+    "device_token",
+    "leaf_device_set",
     "make_staging_buffer",
     "sanitize_pixel_id",
     "stage_raw",
@@ -217,7 +219,7 @@ def dispatch_safe(x):
     return x
 
 
-def stage_raw(batch: EventBatch, cache=None, tag: str = ""):
+def stage_raw(batch: EventBatch, cache=None, tag: str = "", device=None):
     """Stage a batch's raw ``(pixel_id, toa)`` pair for the device path.
 
     With a window's stream cache (``core/device_event_cache.py``) the
@@ -227,13 +229,52 @@ def stage_raw(batch: EventBatch, cache=None, tag: str = ""):
     not depend on any projection layout, so the key needs no layout
     fingerprint; ``tag`` distinguishes pre-staging content transforms
     (e.g. the monitor workflow's pixel-id clamp).
+
+    ``device`` (mesh-slice placement, parallel/mesh_tick.py) commits the
+    staged pair to that device instead of the default; the cache key
+    carries it, so two groups placed on different slices each stage once
+    — per slice, never per job (ADR 0115).
     """
+
+    def stage():
+        if device is None:
+            return dispatch_safe(batch.pixel_id), dispatch_safe(batch.toa)
+        return (
+            stage_for(batch.pixel_id, device),
+            stage_for(batch.toa, device),
+        )
+
     if cache is None:
-        return dispatch_safe(batch.pixel_id), dispatch_safe(batch.toa)
+        return stage()
     return cache.get_or_stage(
-        ("raw", tag, batch.padded_size),
-        lambda: (dispatch_safe(batch.pixel_id), dispatch_safe(batch.toa)),
+        ("raw", tag, batch.padded_size, device_token(device)), stage
     )
+
+
+def device_token(device) -> int | None:
+    """Hashable stage-cache token for a placement device (None = the
+    process default): the id is stable for the process lifetime and
+    cheap, unlike hashing the device object across jax versions."""
+    return None if device is None else int(device.id)
+
+
+def leaf_device_set(leaf, *, committed_only: bool = False):
+    """The device set of one array leaf, or None for host values (and,
+    under ``committed_only``, for uncommitted arrays — those follow
+    whatever placement a dispatch picks, so they carry no placement
+    information). The ONE probe shared by the placement layers
+    (ops/publish.publish_device, parallel/mesh_tick.state_on,
+    ops/histogram._state_slice_device) so a jax ``devices()``/
+    ``committed`` semantics change lands in one place."""
+    devices = getattr(leaf, "devices", None)
+    if not callable(devices):
+        return None
+    if committed_only and not getattr(leaf, "committed", False):
+        return None
+    try:
+        return devices()
+    except Exception:  # pragma: no cover - exotic array types
+        return None
 
 
 def stage_for(arr, sharding, *, dtype=None):

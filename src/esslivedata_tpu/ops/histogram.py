@@ -52,7 +52,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .event_batch import EventBatch, dispatch_safe, sanitize_pixel_id, stage_raw
+from .event_batch import (
+    EventBatch,
+    device_token,
+    dispatch_safe,
+    leaf_device_set,
+    sanitize_pixel_id,
+    stage_for,
+    stage_raw,
+)
 
 __all__ = ["EventHistogrammer", "EventProjection", "HistogramState"]
 
@@ -832,27 +840,37 @@ class EventHistogrammer:
                      self._p2_precision)
         return base
 
-    def _staged_flat(self, pixel_id, toa, cache, tag: str, pool=None):
+    def _staged_flat(
+        self, pixel_id, toa, cache, tag: str, pool=None, device=None
+    ):
         """Host-flattened indices staged for dispatch — once per window
-        per (stream, tag, layout) when a cache slot is provided.
+        per (stream, tag, layout, slice) when a cache slot is provided.
         ``pool`` (pipelined prestage only) chunks the flatten across a
-        thread pool; the result is bit-identical either way."""
-
+        thread pool; the result is bit-identical either way. ``device``
+        (mesh-slice placement, parallel/mesh_tick.py) commits the wire
+        to that slice and keys the cache by it, so each batch stages
+        once per slice."""
         def flatten():
             if pool is not None:
                 return self.flatten_host_chunked(pixel_id, toa, pool)
             return self.flatten_host(pixel_id, toa)
 
+        def stage():
+            flat = flatten()
+            if device is None:
+                return dispatch_safe(flat)
+            return stage_for(flat, device)
+
         if cache is None:
-            return dispatch_safe(flatten())
+            return stage()
         return cache.get_or_stage(
-            (tag,) + self.stage_key,
-            lambda: dispatch_safe(flatten()),
+            (tag,) + self.stage_key + (device_token(device),), stage
         )
 
-    def _staged_partition(self, pixel_id, toa, cache, tag: str):
+    def _staged_partition(self, pixel_id, toa, cache, tag: str, device=None):
         """Block-partitioned (events, chunk_map) staged for the pallas2d
-        kernel — once per window per (stream, tag, partition layout).
+        kernel — once per window per (stream, tag, partition layout,
+        slice).
 
         The compaction flag is read ONCE and threaded through both the
         key and the partition pass: a link-policy wire flip arriving
@@ -864,16 +882,26 @@ class EventHistogrammer:
             events, chunk_map = self.flatten_partition_host(
                 pixel_id, toa, compact=compact
             )
-            return dispatch_safe(events), dispatch_safe(chunk_map)
+            if device is None:
+                return dispatch_safe(events), dispatch_safe(chunk_map)
+            return stage_for(events, device), stage_for(chunk_map, device)
 
         if cache is None:
             return stage()
         return cache.get_or_stage(
-            (tag,) + self.partition_key_for(compact), stage
+            (tag,) + self.partition_key_for(compact)
+            + (device_token(device),),
+            stage,
         )
 
     def stage_events(
-        self, batch: EventBatch, cache, *, batch_tag: str = "", pool=None
+        self,
+        batch: EventBatch,
+        cache,
+        *,
+        batch_tag: str = "",
+        pool=None,
+        device=None,
     ) -> None:
         """Warm the window stream-cache with this configuration's wire.
 
@@ -893,13 +921,16 @@ class EventHistogrammer:
         if cache is None:
             return
         if self._method == "pallas2d":
-            self._staged_partition(batch.pixel_id, batch.toa, cache, batch_tag)
+            self._staged_partition(
+                batch.pixel_id, batch.toa, cache, batch_tag, device=device
+            )
         elif self.supports_host_flatten:
             self._staged_flat(
-                batch.pixel_id, batch.toa, cache, batch_tag, pool=pool
+                batch.pixel_id, batch.toa, cache, batch_tag, pool=pool,
+                device=device,
             )
         else:
-            stage_raw(batch, cache, batch_tag)
+            stage_raw(batch, cache, batch_tag, device=device)
 
     def set_wire_format(self, compact: bool) -> bool:
         """Runtime int32 <-> uint16 wire switch for ``method='pallas2d'``
@@ -979,6 +1010,34 @@ class EventHistogrammer:
             dispatch_safe(toa),
         )
 
+    @staticmethod
+    def _state_slice_device(state: HistogramState):
+        """The device a slice-placed state is COMMITTED to (mesh-slice
+        placement, parallel/mesh_tick.py), else None.
+
+        The private/fallback step paths resolve their staging placement
+        from the STATE: a slice-placed group that drops to the private
+        path (coalesced window, tick ineligibility, a contained tick
+        failure) must stage onto its slice — default-device staging
+        would hand the jitted step arguments committed to two devices,
+        which jax rejects on real multi-chip hardware (the CPU backend
+        masks it: ``dispatch_safe`` returns uncommitted numpy there).
+        Committedness is the discriminator, not device identity: a
+        group PLACED on the default device still returns it (so the
+        staging cache key matches the tick path's slice token — no
+        double staging for the 1/N of groups landing on device 0),
+        while un-placed states are uncommitted and return None, keeping
+        placement-less deployments' cache keys byte-identical.
+        """
+        for leaf in state:
+            ds = leaf_device_set(leaf, committed_only=True)
+            if ds is None:
+                continue
+            if len(ds) != 1:
+                return None  # mesh-sharded or replicated: not a slice
+            return next(iter(ds))
+        return None
+
     def step_batch(
         self,
         state: HistogramState,
@@ -986,6 +1045,7 @@ class EventHistogrammer:
         *,
         cache=None,
         batch_tag: str = "",
+        device=None,
     ) -> HistogramState:
         """One staged batch, taking the 4-byte/event ingest fast path
         (host flatten + flat scatter) whenever the configuration allows it
@@ -999,18 +1059,26 @@ class EventHistogrammer:
         per window per (stream, layout) no matter how many jobs step from
         the same batch; ``batch_tag`` marks pre-staging content
         transforms so transformed batches never collide with the raw
-        stream under the same layout key."""
+        stream under the same layout key. ``device`` defaults to the
+        state's own slice (``_state_slice_device``) so a placed group's
+        private path stages where its state lives — under the same
+        slice-keyed cache entry the tick path uses."""
+        if device is None:
+            device = self._state_slice_device(state)
         if self._method == "pallas2d":
             events, chunk_map = self._staged_partition(
-                batch.pixel_id, batch.toa, cache, batch_tag
+                batch.pixel_id, batch.toa, cache, batch_tag, device=device
             )
             return self._step_part(state, events, chunk_map)
         if self.supports_host_flatten:
             return self._step_flat(
                 state,
-                self._staged_flat(batch.pixel_id, batch.toa, cache, batch_tag),
+                self._staged_flat(
+                    batch.pixel_id, batch.toa, cache, batch_tag,
+                    device=device,
+                ),
             )
-        pid, toa = stage_raw(batch, cache, batch_tag)
+        pid, toa = stage_raw(batch, cache, batch_tag, device=device)
         return self._step(state, self._proj.lut, pid, toa)
 
     def step_many(
@@ -1020,32 +1088,48 @@ class EventHistogrammer:
         *,
         cache=None,
         batch_tag: str = "",
+        device=None,
     ) -> tuple[HistogramState, ...]:
         """Advance K independent states from ONE staged batch in ONE
         jitted dispatch (the fused-stepping layer's kernel entry,
         core/job_manager.py). All states are donated; per-state results
         are bit-identical to K private ``step_batch`` calls. The jit
         cache holds one program per K — group sizes are expected to be
-        few and stable (the number of co-subscribed jobs)."""
+        few and stable (the number of co-subscribed jobs). ``device``
+        (mesh-slice placement) stages the wire onto the group's slice —
+        the states were committed there at assignment time; when not
+        given it resolves from the first state's placement, so callers
+        outside the placement-aware manager cannot mix devices."""
         states = tuple(states)
         if not states:
             return ()
+        if device is None:
+            device = self._state_slice_device(states[0])
         if self._method == "pallas2d":
             events, chunk_map = self._staged_partition(
-                batch.pixel_id, batch.toa, cache, batch_tag
+                batch.pixel_id, batch.toa, cache, batch_tag, device=device
             )
             return self._step_part_fused(states, events, chunk_map)
         if self.supports_host_flatten:
             return self._step_flat_fused(
                 states,
-                self._staged_flat(batch.pixel_id, batch.toa, cache, batch_tag),
+                self._staged_flat(
+                    batch.pixel_id, batch.toa, cache, batch_tag,
+                    device=device,
+                ),
             )
-        pid, toa = stage_raw(batch, cache, batch_tag)
+        pid, toa = stage_raw(batch, cache, batch_tag, device=device)
         return self._step_fused(states, self._proj.lut, pid, toa)
 
     # -- one-dispatch tick program (ops/tick.py, ADR 0114) -----------------
     def tick_staging(
-        self, batch: EventBatch, cache, *, batch_tag: str = "", pool=None
+        self,
+        batch: EventBatch,
+        cache,
+        *,
+        batch_tag: str = "",
+        pool=None,
+        device=None,
     ) -> tuple:
         """This configuration's staged wire as a flat tuple of device
         arrays, shaped for ``tick_step``'s trailing arguments.
@@ -1059,15 +1143,16 @@ class EventHistogrammer:
         step body itself."""
         if self._method == "pallas2d":
             return self._staged_partition(
-                batch.pixel_id, batch.toa, cache, batch_tag
+                batch.pixel_id, batch.toa, cache, batch_tag, device=device
             )
         if self.supports_host_flatten:
             return (
                 self._staged_flat(
-                    batch.pixel_id, batch.toa, cache, batch_tag, pool=pool
+                    batch.pixel_id, batch.toa, cache, batch_tag, pool=pool,
+                    device=device,
                 ),
             )
-        pid, toa = stage_raw(batch, cache, batch_tag)
+        pid, toa = stage_raw(batch, cache, batch_tag, device=device)
         return (self._proj.lut, pid, toa)
 
     def tick_step(self, states, *staged):

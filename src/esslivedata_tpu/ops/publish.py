@@ -98,6 +98,12 @@ class PublishMetrics:
     records one per ``step_many`` group it runs outside a tick program,
     so the bench ``--tick`` decomposition can show the dispatch count a
     tick actually pays — 1 with the tick program, ≥2 without.
+
+    ``slice_key`` (mesh serving, ADR 0115) attributes a record to the
+    mesh slice — a device label or the whole-mesh label — that executed
+    it; the ``slices`` sub-dict lets the ``--mesh`` bench assert the
+    per-slice contract (ONE execute + ONE fetch per slice per tick)
+    instead of only the process-wide aggregate.
     """
 
     def __init__(self) -> None:
@@ -111,6 +117,7 @@ class PublishMetrics:
         self._step_executes = 0
         self._tick_publishes = 0
         self._tick_jobs = 0
+        self._slices: dict[str, dict[str, int]] = {}
 
     def record(
         self,
@@ -122,6 +129,7 @@ class PublishMetrics:
         combined_jobs: int = 0,
         step_executes: int = 0,
         tick: bool = False,
+        slice_key: str | None = None,
     ) -> None:
         with self._lock:
             self._executes += executes
@@ -135,8 +143,19 @@ class PublishMetrics:
             if tick:
                 self._tick_publishes += 1
                 self._tick_jobs += combined_jobs
+            if slice_key is not None:
+                per = self._slices.setdefault(
+                    slice_key,
+                    {"executes": 0, "fetches": 0, "tick_publishes": 0,
+                     "jobs": 0},
+                )
+                per["executes"] += executes
+                per["fetches"] += fetches
+                per["jobs"] += combined_jobs
+                if tick:
+                    per["tick_publishes"] += 1
 
-    def _dict(self) -> dict[str, int]:
+    def _dict(self) -> dict:
         return {
             "executes": self._executes,
             "fetches": self._fetches,
@@ -147,13 +166,14 @@ class PublishMetrics:
             "step_executes": self._step_executes,
             "tick_publishes": self._tick_publishes,
             "tick_jobs": self._tick_jobs,
+            "slices": {k: dict(v) for k, v in self._slices.items()},
         }
 
-    def snapshot(self) -> dict[str, int]:
+    def snapshot(self) -> dict:
         with self._lock:
             return self._dict()
 
-    def drain(self) -> dict[str, int]:
+    def drain(self) -> dict:
         with self._lock:
             out = self._dict()
             self._executes = 0
@@ -165,6 +185,7 @@ class PublishMetrics:
             self._step_executes = 0
             self._tick_publishes = 0
             self._tick_jobs = 0
+            self._slices = {}
         return out
 
 
@@ -186,20 +207,24 @@ def _unpack_segment(
 
 
 def publish_device(args):
-    """The device the first array leaf of ``args`` lives on (None for
-    host-only args). The JobManager groups publish offers by this so a
-    combined program never spans devices."""
+    """The placement key of the first array leaf of ``args`` (None for
+    host-only args): the device for single-device arrays, the sorted
+    device-id tuple for mesh-sharded ones. The JobManager groups publish
+    offers by this so a combined program never spans placements — two
+    single-device jobs on different slices stay separate dispatches, K
+    jobs sharing one mesh combine, and a mesh member can never be fused
+    with a default-device one (jit would reject the device mix at
+    dispatch time, costing the whole group its combine)."""
+    from .event_batch import leaf_device_set
+
     for leaf in jax.tree_util.tree_leaves(args):
-        devices = getattr(leaf, "devices", None)
-        if not callable(devices):
-            continue
-        try:
-            ds = devices()
-        except Exception:  # pragma: no cover - non-committed arrays
-            logger.debug("publish_device probe failed", exc_info=True)
+        ds = leaf_device_set(leaf)
+        if ds is None:
             continue
         if len(ds) == 1:
             return next(iter(ds))
+        if len(ds) > 1:
+            return tuple(sorted(d.id for d in ds))
     return None
 
 

@@ -109,6 +109,8 @@ class TickCombiner:
         group_key,
         staged: tuple,
         requests: Sequence[PublishRequest],
+        *,
+        slice_key: str | None = None,
     ) -> list[CombinedPublish]:
         """Run one tick program: step every member's state (``args[0]``
         of its request, the ``make_publish_offer`` contract) from the
@@ -119,6 +121,8 @@ class TickCombiner:
         its ``tick_step`` is the traceable fused step; ``group_key`` is
         the fused-stepping group key (fuse key + batch tag);
         ``staged`` is ``tick_staging``'s flat tuple of device arrays.
+        ``slice_key`` (mesh serving, ADR 0115) labels the mesh slice
+        this group executes on for the per-slice METRICS breakdown.
         """
         plan, planned_errors = plan_members(requests)
         if not plan:
@@ -191,11 +195,19 @@ class TickCombiner:
             static_bytes=static_total,
             combined_jobs=len(plan),
             tick=True,
+            slice_key=slice_key,
         )
         return [by_index[i] for i in range(len(requests))]
 
-    @staticmethod
+    def _finish_outputs(self, packed, statics):
+        """Hook between the traced publish bodies and the program's
+        outputs. The base combiner passes through; the mesh combiner
+        (parallel/mesh_tick.py) pins a replicated sharding here so one
+        ``device_get`` serves the whole mesh (ADR 0115)."""
+        return packed, statics
+
     def _build(
+        self,
         hist,
         n_staged: int,
         members: list[tuple[PackedPublisher, int, frozenset, bool]],
@@ -232,6 +244,9 @@ class TickCombiner:
                 jnp.concatenate(parts)
                 if parts
                 else jnp.zeros((0,), jnp.float32)
+            )
+            packed_all, statics = self._finish_outputs(
+                packed_all, statics
             )
             return packed_all, tuple(statics), tuple(carries)
 

@@ -22,11 +22,16 @@ ICI, no NCCL analog):
   reduction at all. Per-step traffic is O(n_events * (data-1)/data),
   independent of bin-space size.
 
-``exchange='auto'`` picks event_gather once a bank shard exceeds 1M bins
-(the crossover is roughly where a dense delta outweighs a 4M-event
-gather). Events are also replicated across the ``bank`` axis by their
-P('data') sharding, so each bank shard routes gather-free: it scatters
-the events landing in its rows and drops the rest via the dump bin.
+``exchange='auto'`` compares the two strategies' ACTUAL per-step wire
+bytes — the dense delta each device psums (rows_per_bank x n_toa x
+dtype itemsize) against the event bytes each device gathers from the
+other data shards (n_events x 8 B x (data-1)/data) — and picks the
+cheaper one. ``batch_hint`` (expected events per padded batch; default
+the 4M headline batch) supplies the event count the crossover needs at
+construction time. Events are also replicated across the ``bank`` axis
+by their P('data') sharding, so each bank shard routes gather-free: it
+scatters the events landing in its rows and drops the rest via the dump
+bin.
 """
 
 from __future__ import annotations
@@ -40,12 +45,17 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.histogram import EventProjection, HistogramState
+from .mesh import shard_map
 
 __all__ = ["ShardedHistogrammer"]
 
-#: Bins per bank shard above which 'auto' switches the data-shard merge
-#: from a dense delta psum to an event all_gather.
-_EVENT_GATHER_BINS = 1 << 20
+#: Default expected events per padded batch for the 'auto' exchange
+#: crossover when the caller gives no hint: the 4M-event headline batch
+#: the bench and the LOKI-scale ingest budget are sized around (PERF.md).
+_DEFAULT_BATCH_HINT = 1 << 22
+
+#: Wire bytes per event crossing the gather: int32 pixel_id + float32 toa.
+_EVENT_WIRE_BYTES = 8
 
 
 class ShardedHistogrammer:
@@ -68,6 +78,7 @@ class ShardedHistogrammer:
         decay: float | None = None,
         exchange: str = "auto",
         dtype=jnp.float32,
+        batch_hint: int | None = None,
     ) -> None:
         if exchange not in ("auto", "delta_psum", "event_gather"):
             raise ValueError(f"Unknown exchange {exchange!r}")
@@ -107,11 +118,16 @@ class ShardedHistogrammer:
         self._edges = self._proj.edges
         self._decay = decay
         self._dtype = dtype
+        self._batch_hint = int(
+            _DEFAULT_BATCH_HINT if batch_hint is None else batch_hint
+        )
         if exchange == "auto":
-            exchange = (
-                "event_gather"
-                if self._rows_per_bank * self._n_toa > _EVENT_GATHER_BINS
-                else "delta_psum"
+            exchange = self._resolve_exchange(
+                rows_per_bank=self._rows_per_bank,
+                n_toa=self._n_toa,
+                n_data=self._n_data,
+                dtype=dtype,
+                batch_hint=self._batch_hint,
             )
         self._exchange = exchange
 
@@ -127,7 +143,7 @@ class ShardedHistogrammer:
 
         lut_specs = (P(),) if self._has_lut else ()  # replicated LUT arg
         shard = partial(
-            jax.shard_map,
+            shard_map,
             mesh=mesh,
             in_specs=(
                 P("bank", None),  # window
@@ -155,6 +171,11 @@ class ShardedHistogrammer:
                 return self._step_local(win, pid, toa, inv_scale)
 
         sharded_step = shard(_local)
+        # The traceable (un-jitted) step body: the mesh tick program
+        # (parallel/mesh_tick.py, ADR 0115) composes it with the packed
+        # publish bodies under ONE outer jit via ``tick_step``.
+        self._step_body = sharded_step
+        self._decay_body = None
         self._step = jax.jit(sharded_step, donate_argnums=(0,))
 
         if decay is not None:
@@ -176,10 +197,17 @@ class ShardedHistogrammer:
                     scale,
                 )
 
+            self._decay_body = _step_decay
             self._step_decay = jax.jit(_step_decay, donate_argnums=(0,))
 
+        # Fused K-state variant (one dispatch advances K donated states
+        # from ONE staged batch; the jit caches one program per K) — the
+        # mesh counterpart of EventHistogrammer._step_fused, feeding the
+        # fused-stepping layer and the mesh tick program (ADR 0115).
+        self._fused = jax.jit(self._tick_step_impl, donate_argnums=(0,))
+
         norm = partial(
-            jax.shard_map,
+            shard_map,
             mesh=mesh,
             in_specs=(P("bank", None), P("data")),
             out_specs=P("bank", None),
@@ -203,6 +231,33 @@ class ShardedHistogrammer:
                 _physical(win, scale),
             )
         )
+
+    @staticmethod
+    def _resolve_exchange(
+        *, rows_per_bank: int, n_toa: int, n_data: int, dtype, batch_hint: int
+    ) -> str:
+        """The cheaper data-shard merge for this configuration, by ACTUAL
+        per-step bytes moved per device.
+
+        - delta_psum: every device reduces a dense copy of its bank rows
+          — ``rows_per_bank * n_toa * itemsize`` bytes, batch-size
+          independent.
+        - event_gather: every device receives the other data shards'
+          events — ``n_events * 8 B * (data-1)/data`` bytes, bin-space
+          independent (and zero when data == 1: the all_gather is the
+          identity, so gather always wins a single-data-shard mesh).
+
+        The old heuristic compared bins against a hard-coded 1<<20
+        constant regardless of batch size or dtype, which mispicks on
+        both sides of the crossover: a small-batch service on mid-size
+        banks paid dense deltas that a cheap gather would beat, and a
+        64M-event burst on just-over-threshold banks gathered more
+        bytes than the delta it avoided (pinned both ways in
+        tests/parallel/sharded_hist_test.py).
+        """
+        delta_bytes = rows_per_bank * n_toa * np.dtype(dtype).itemsize
+        gather_bytes = batch_hint * _EVENT_WIRE_BYTES * (n_data - 1) / n_data
+        return "event_gather" if gather_bytes < delta_bytes else "delta_psum"
 
     # -- local (per-shard) kernels ---------------------------------------
     def _step_local(self, win, pixel_id, toa, inv_scale, lut=None):
@@ -306,13 +361,222 @@ class ShardedHistogrammer:
         devices = tuple(int(d.id) for d in self._mesh.devices.flat)
         return ("shard1", devices, self._n_data)
 
-    def stage_events(self, pixel_id, toa):
-        """Place one padded global batch onto the event sharding (one
-        hop). ``step`` accepts the returned device arrays — already-placed
-        arrays pass through ``stage_for`` untouched — so K jobs sharing a
-        mesh stage each window's batch once via the window stream-cache
-        (core/device_event_cache.py)."""
-        return self._shard_events(pixel_id, toa)
+    # -- serving-tier surface (ADR 0110/0114/0115) -------------------------
+    # The same duck-typed contract EventHistogrammer exposes, so mesh-
+    # backed workflows ride the stage-once cache, the fused-stepping
+    # layer, the combined publish and the one-dispatch tick program
+    # exactly like single-device ones — the mesh stops being a
+    # standalone demo and becomes a serving topology.
+
+    @property
+    def n_toa(self) -> int:
+        return self._n_toa
+
+    @property
+    def n_screen(self) -> int:
+        return self._n_screen
+
+    @property
+    def toa_edges(self) -> np.ndarray:
+        return self._edges
+
+    @property
+    def decay(self) -> float | None:
+        return self._decay
+
+    @property
+    def layout_digest(self) -> str:
+        """The projection layout's content fingerprint (the static-
+        publish cache token, ADR 0113) — a LUT/edge swap re-keys it."""
+        return self._proj.layout_digest
+
+    @property
+    def supports_host_flatten(self) -> bool:
+        """The mesh kernel projects on DEVICE (each bank shard routes its
+        own rows); the host-flatten fast path does not apply."""
+        return False
+
+    @property
+    def fuse_key(self) -> tuple:
+        """Grouping key for fused stepping and tick programs
+        (core/job_manager.py): equal keys promise identical staged wire
+        AND an identical sharded step program — mesh devices, both axis
+        extents, the exchange strategy, accumulation semantics, and the
+        projection layout all participate."""
+        devices = tuple(int(d.id) for d in self._mesh.devices.flat)
+        return (
+            "fuse-mesh",
+            devices,
+            self._n_data,
+            self._n_bank,
+            self._exchange,
+            self._decay,
+            np.dtype(self._dtype).str,
+            self._proj.layout_digest,
+        )
+
+    def tick_staging(
+        self,
+        batch,
+        cache,
+        *,
+        batch_tag: str = "",
+        pool=None,
+        device=None,
+    ) -> tuple:
+        """The staged mesh wire as a flat tuple ``(lut, pixel_id, toa)``
+        shaped for ``tick_step``'s trailing arguments (ops/tick.py).
+
+        The (pixel_id, toa) pair is placed onto the P('data') event
+        sharding ONCE per window per (stream, tag, mesh) through the
+        stream cache — the staged shards are layout-independent, so
+        every kernel sharing the mesh shares them; the replicated LUT
+        rides as an argument (ADR 0105: swaps stay transfers, never
+        retraces). ``pool`` (host-flatten chunking) and ``device``
+        (single-device slice placement, parallel/mesh_tick.py) do not
+        apply to the mesh wire — the mesh IS the placement.
+        """
+        del pool, device  # single-device staging knobs; the mesh places
+        pid, toa = batch.pixel_id, batch.toa
+
+        def stage():
+            return self._shard_events(pid, toa)
+
+        if cache is None:
+            staged = stage()
+        else:
+            staged = cache.get_or_stage(
+                (batch_tag,) + self.stage_key, stage
+            )
+        return (self._lut_rep,) + tuple(staged)
+
+    def _tick_step_impl(self, states, lut, pixel_id, toa):
+        # graft: key-derived=_has_lut,_step_body,_decay_body,_unit_scale
+        # pure functions of keyed configuration: fuse_key carries the
+        # layout digest (which fingerprints the LUT _has_lut reflects),
+        # the exchange/decay/dtype the step bodies were compiled from,
+        # and the dtype the staged unit scale was built with.
+        states = tuple(states)
+        lut_args = (lut,) if self._has_lut else ()
+        if self._decay is None:
+            return tuple(
+                HistogramState(
+                    folded=s.folded,
+                    window=self._step_body(
+                        s.window, *lut_args, pixel_id, toa, self._unit_scale
+                    ),
+                    scale=None,
+                )
+                for s in states
+            )
+
+        def stepped(s: HistogramState) -> HistogramState:
+            win, scale = self._decay_body(
+                s.window, *lut_args, pixel_id, toa, s.scale
+            )
+            return HistogramState(folded=s.folded, window=win, scale=scale)
+
+        # Trace-unrolled over the (small, stable-K) states tuple — the
+        # same shape as EventHistogrammer's fused impls.
+        return tuple(stepped(s) for s in states)
+
+    def tick_step(self, states, *staged):
+        """TRACEABLE fused step over ``tick_staging``'s arrays — the tick
+        program (ops/tick.py / parallel/mesh_tick.py) composes this with
+        the members' packed publish bodies so the collective step and
+        the publish reductions ride ONE dispatch. Applies the exact
+        per-state program ``step`` runs (same shard_map body, same lazy
+        decay protocol), so tick results are identical to separate
+        stepping."""
+        return self._tick_step_impl(tuple(states), *staged)
+
+    def step_many(
+        self, states, batch, *, cache=None, batch_tag=""
+    ) -> tuple[HistogramState, ...]:
+        """Advance K independent mesh-sharded states from ONE staged
+        batch in ONE jitted dispatch (the fused-stepping layer's kernel
+        entry, core/job_manager.py). All states are donated."""
+        states = tuple(states)
+        if not states:
+            return ()
+        staged = self.tick_staging(batch, cache, batch_tag=batch_tag)
+        return self._fused(states, *staged)
+
+    def step_batch(
+        self, state: HistogramState, batch, *, cache=None, batch_tag=""
+    ) -> HistogramState:
+        """Accumulate one staged ``EventBatch`` through the stream cache
+        (the workflow-private path's entry; same keys as ``step_many``
+        and the tick program, so whichever consumer stages first, the
+        rest share the placed shards by reference)."""
+        staged = self.tick_staging(batch, cache, batch_tag=batch_tag)
+        (new,) = self._fused((state,), *staged)
+        return new
+
+    def stage_events(
+        self, batch, cache, *, batch_tag: str = "", pool=None
+    ) -> None:
+        """Warm the window stream-cache with this mesh's staged wire —
+        the pipelined ingest's prestage entry (ADR 0111), same contract
+        as ``EventHistogrammer.stage_events``: exactly the staging the
+        step/tick paths run, so a prestaged window is a guaranteed hit."""
+        if cache is None:
+            return
+        self.tick_staging(batch, cache, batch_tag=batch_tag, pool=pool)
+
+    def views_of(
+        self, state: HistogramState
+    ) -> tuple[jax.Array, jax.Array]:
+        """Traceable (cumulative, window) views, ``[n_screen, n_toa]``,
+        REPLICATED over the mesh — the composition surface the packed
+        publish programs consume (ops/publish.py).
+
+        The replication constraint is the publish-rate gather that keeps
+        readback O(1): downstream reductions run on a replicated value,
+        so the packed output vector is replicated by construction and
+        one ``device_get`` serves the whole mesh (and the reduction HLO
+        matches the single-device program's — the mesh↔single-device
+        parity contract, tests/parallel/mesh_tick_test.py). Per-step
+        collectives stay O(delta/gather); only the ~1 Hz publish pays
+        the window gather."""
+        replicated = NamedSharding(self._mesh, P())
+        win = self.physical_window(state)
+        win = jax.lax.with_sharding_constraint(win, replicated)
+        cum = win + jax.lax.with_sharding_constraint(
+            state.folded, replicated
+        )
+        return cum, win
+
+    def physical_window(self, state: HistogramState) -> jax.Array:
+        """The window in physical counts (applies the lazy decay scale);
+        traceable, sharding-preserving."""
+        if state.scale is None:
+            return state.window
+        return state.window * state.scale
+
+    def fold_window(self, state: HistogramState) -> HistogramState:
+        """Traceable window fold (the publish-program composition
+        counterpart of ``clear_window``): the cumulative absorbs the
+        physical window in place — both leaves keep their P('bank')
+        sharding, so the fold is collective-free."""
+        return HistogramState(
+            folded=state.folded + self.physical_window(state),
+            window=jnp.zeros_like(state.window),
+            scale=(
+                None if state.scale is None else jnp.ones_like(state.scale)
+            ),
+        )
+
+    def clear(self, state: HistogramState) -> HistogramState:
+        """Zero the full accumulation (run-transition reset), keeping
+        every leaf's mesh sharding."""
+        return HistogramState(
+            folded=jnp.zeros_like(state.folded),
+            window=jnp.zeros_like(state.window),
+            scale=(
+                None if state.scale is None else jnp.ones_like(state.scale)
+            ),
+        )
 
     def step(self, state: HistogramState, pixel_id, toa) -> HistogramState:
         """Accumulate one padded global batch (host or pre-staged device
@@ -342,7 +606,7 @@ class ShardedHistogrammer:
             or new.shape != self._proj.lut_host.shape
         ):
             return False
-        old_weights = self._proj.weights  # already mesh-replicated
+        old = self._proj
         self._proj = EventProjection(
             toa_edges=self._edges,
             pixel_lut=new,
@@ -353,8 +617,12 @@ class ShardedHistogrammer:
         # placement established in __init__. The new LUT is placed from
         # the host array directly — this is the per-swap live-geometry
         # path, so the default-device staging hop a jnp.asarray would add
-        # is paid on every swap, not once.
-        self._proj.weights = old_weights
+        # is paid on every swap, not once. The HOST weights copy rides
+        # along so the rebuilt layout_digest — the key every staging/
+        # fusion/static-publish cache hangs off (ADR 0110/0113) — still
+        # fingerprints the weights.
+        self._proj.weights = old.weights
+        self._proj._weights_host = old._weights_host
         self._lut_rep = self._replicate(new)
         return True
 

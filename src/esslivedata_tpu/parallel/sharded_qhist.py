@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.event_batch import sanitize_pixel_id, stage_for
 from ..ops.qhistogram import PixelBinMap, QState, table_scatter_delta
+from .mesh import shard_map
 
 __all__ = ["ShardedQHistogrammer"]
 
@@ -140,7 +141,7 @@ class ShardedQHistogrammer:
             monitor_window=P(),
         )
         self._step = jax.jit(
-            jax.shard_map(
+            shard_map(
                 _step,
                 mesh=mesh,
                 in_specs=(state_specs, P(axis, None), P(), P(), P()),

@@ -116,6 +116,14 @@ class DataServiceBuilder:
         self.tick_program = _os.environ.get(
             "LIVEDATA_TICK_PROGRAM", "1"
         ).lower() not in ("0", "false", "no")
+        # Mesh serving tier (parallel/mesh_tick.py, ADR 0115):
+        # "data,bank" (e.g. "2,4"), a device count, or "auto" = all
+        # visible devices on the bank axis. Empty/unset = single-
+        # placement serving (the classic path). The runner's --mesh
+        # flag overrides by assigning this attribute after build.
+        self.mesh_spec: str | None = (
+            _os.environ.get("LIVEDATA_MESH") or None
+        )
         self._instrument = instrument_registry[instrument]
         self._instrument.load_factories()
         # Subscribe only to streams the hosted specs consume (reference
@@ -167,11 +175,35 @@ class DataServiceBuilder:
             from ..core.state_snapshot import SnapshotStore
 
             snapshot_store = SnapshotStore(self._snapshot_dir)
+        placement = None
+        if self.mesh_spec:
+            # A bad mesh spec is a deployment configuration error: fail
+            # the build loudly rather than silently serving single-
+            # placement (the operator asked for a topology).
+            from ..parallel.mesh import mesh_from_spec, shard_map_available
+            from ..parallel.mesh_tick import DevicePlacement
+
+            if not shard_map_available():
+                raise RuntimeError(
+                    "--mesh/LIVEDATA_MESH requested but this jax "
+                    "provides no shard_map entry point (neither "
+                    "jax.shard_map nor jax.experimental.shard_map): "
+                    "mesh-sharded kernels cannot compile. Upgrade jax "
+                    "or drop the mesh spec."
+                )
+            mesh = mesh_from_spec(self.mesh_spec)
+            placement = DevicePlacement(mesh)
+            logger.info(
+                "mesh serving: %s over devices %s",
+                dict(mesh.shape),
+                [int(d.id) for d in mesh.devices.flat],
+            )
         job_manager = JobManager(
             job_factory=JobFactory(),
             job_threads=self._job_threads,
             snapshot_store=snapshot_store,
             tick_program=self.tick_program,
+            placement=placement,
         )
         # Contract derived from this instrument's registered specs: outputs
         # listed in ``device_outputs`` ride the stable NICOS device stream.
@@ -255,6 +287,17 @@ class DataServiceRunner:
             "during prestaging (multicore ingest hosts; 0/1 = off)",
         )
         parser.add_argument(
+            "--mesh",
+            default=None,
+            metavar="DATA,BANK",
+            help="mesh serving tier (ADR 0115): place tick groups on a "
+            "data x bank device mesh — '2,4' = 2-way event sharding x "
+            "4-way bank sharding, '8' or 'auto' = all devices on the "
+            "bank axis. Single-device jobs spread round-robin over the "
+            "mesh; bank-sharded jobs get the whole mesh "
+            "(LIVEDATA_MESH equivalently)",
+        )
+        parser.add_argument(
             "--no-tick-program",
             action="store_true",
             default=False,
@@ -316,6 +359,8 @@ class DataServiceRunner:
             builder.flatten_threads = args.flatten_threads
         if args.no_tick_program:
             builder.tick_program = False
+        if args.mesh is not None:
+            builder.mesh_spec = args.mesh or None
         if args.check:
             print(
                 f"{self._service_name}: instrument={args.instrument} "

@@ -12,7 +12,7 @@ same workflow shards that bank axis over devices via ShardedHistogrammer
 from __future__ import annotations
 
 from collections.abc import Mapping
-from typing import Any
+from typing import Any, Literal
 
 import numpy as np
 from pydantic import BaseModel, ConfigDict, Field
@@ -38,6 +38,11 @@ class MultiBankParams(BaseModel):
     toa_range: TOARange = Field(default_factory=TOARange)
     use_mesh: bool = True
     """Shard the bank axis over all visible devices when more than one."""
+    mesh_exchange: Literal["auto", "delta_psum", "event_gather"] = "auto"
+    """Data-shard merge strategy for the sharded kernel; 'auto' compares
+    actual delta vs gather bytes (parallel/sharded_hist.py)."""
+    mesh_batch_hint: int | None = None
+    """Expected events per padded batch for the 'auto' crossover."""
 
 
 class MultiBankViewWorkflow:
@@ -48,6 +53,7 @@ class MultiBankViewWorkflow:
         *,
         bank_detector_numbers: Mapping[str, np.ndarray],
         params: MultiBankParams | None = None,
+        mesh=None,
     ) -> None:
         params = params or MultiBankParams()
         self._params = params
@@ -71,17 +77,26 @@ class MultiBankViewWorkflow:
         )
         n_devices = len(jax.devices())
         # The bank axis shards only in whole banks; use the largest device
-        # count that divides n_screen bank-wise.
+        # count that divides n_screen bank-wise. An explicit ``mesh``
+        # (service placement, bench, tests) wins — the mesh serving tier
+        # (parallel/mesh_tick.py, ADR 0115) hands LOKI-scale jobs the
+        # whole serving mesh this way.
         self._sharded = None
-        if params.use_mesh and n_devices > 1:
+        if mesh is None and params.use_mesh and n_devices > 1:
             bank_axis = n_devices
             while bank_axis > 1 and n_banks % bank_axis:
                 bank_axis -= 1
             if bank_axis > 1:
                 mesh = make_mesh(bank_axis, bank=bank_axis)
-                self._sharded = ShardedHistogrammer(
-                    toa_edges=edges, n_screen=n_screen, mesh=mesh, pixel_lut=lut
-                )
+        if mesh is not None and params.use_mesh:
+            self._sharded = ShardedHistogrammer(
+                toa_edges=edges,
+                n_screen=n_screen,
+                mesh=mesh,
+                pixel_lut=lut,
+                exchange=params.mesh_exchange,
+                batch_hint=params.mesh_batch_hint,
+            )
         if self._sharded is not None:
             self._hist = self._sharded
         else:
@@ -101,35 +116,23 @@ class MultiBankViewWorkflow:
     def accumulate(self, data: Mapping[str, Any]) -> None:
         for value in data.values():
             if isinstance(value, StagedEvents):
-                if self._sharded is not None:
-                    # Pre-stage the shards through the window stream-cache
-                    # so K mesh-sharing jobs place the batch onto the
-                    # event sharding once; step() passes already-placed
-                    # device arrays through (parallel/sharded_hist.py).
-                    batch = value.batch
-                    if value.cache is not None:
-                        pid, toa = value.cache.get_or_stage(
-                            ("shard",) + self._sharded.stage_key,
-                            lambda: self._sharded.stage_events(
-                                batch.pixel_id, batch.toa
-                            ),
-                        )
-                    else:
-                        pid, toa = batch.pixel_id, batch.toa
-                    self._state = self._sharded.step(self._state, pid, toa)
-                else:
-                    self._state = self._hist.step_batch(
-                        self._state, value.batch, cache=value.cache
-                    )
+                # Single-chip and mesh-sharded kernels share the contract:
+                # stage through the window stream-cache (K jobs place the
+                # batch once — onto the default device or onto the mesh's
+                # P('data') event sharding) and advance the donated state
+                # in one dispatch.
+                self._state = self._hist.step_batch(
+                    self._state, value.batch, cache=value.cache
+                )
 
     def event_ingest(self, stream: str, staged: StagedEvents):
-        """Fused-stepping offer for the single-chip path (the sharded
-        path keeps its collective dispatch — its state spans the mesh).
-        Feeds the tick program too (ops/tick.py, ADR 0114): the bank
-        reductions in the publish program below then ride the step's
-        dispatch, one round trip for the whole window."""
-        if self._sharded is not None:
-            return None
+        """Fused-stepping offer — BOTH kernels (core/job_manager.py).
+        Feeds the tick program too (ops/tick.py, ADR 0114/0115): the
+        bank reductions in the publish program below then ride the
+        step's dispatch, one round trip for the whole window. On the
+        mesh, that one dispatch IS the collective step (shard_map body)
+        plus the replicated publish reductions — the whole serving mesh
+        turns over in one execute + one fetch per tick."""
         from ..core.device_event_cache import EventIngest
 
         def set_state(state) -> None:
@@ -145,10 +148,14 @@ class MultiBankViewWorkflow:
         )
 
     def _publisher(self):
-        """Lazy fused publish program (single-chip path): bank reductions
-        on device, one execute + one packed fetch, window fold included
-        (ops/publish.py). The sharded path keeps its collective read —
-        its state spans the mesh and publishes via the exchange kernels."""
+        """Lazy fused publish program, both kernels: bank reductions on
+        device, one execute + one packed fetch, window fold included
+        (ops/publish.py). ``views_of`` is the kernel-portable seam —
+        the single-chip kernel slices its flat state, the mesh kernel
+        gathers the window to a replicated value (so the reductions
+        below and the packed vector replicate, one fetch serves the
+        mesh, and the reduction HLO matches the single-device program:
+        the byte-parity contract of ADR 0115)."""
         if self._publish is None:
             from ..ops.publish import PackedPublisher
 
@@ -171,13 +178,12 @@ class MultiBankViewWorkflow:
         return self._publish
 
     def publish_offer(self):
-        """Combined-publish offer (ADR 0113) — single-chip path only:
-        the sharded state spans the mesh and keeps its collective read.
-        Tick-capable (ADR 0114): args[0] is the pre-step state and the
-        carry is exactly ``(new_state,)``, the make_publish_offer
-        contract the tick program's donation layout relies on."""
-        if self._sharded is not None:
-            return None
+        """Combined-publish offer (ADR 0113), both kernels. Tick-capable
+        (ADR 0114/0115): args[0] is the pre-step state and the carry is
+        exactly ``(new_state,)``, the make_publish_offer contract the
+        tick program's donation layout relies on. Mesh-sharded states
+        group by their device SET (ops/publish.publish_device), so a
+        combined program never mixes mesh and single-device members."""
         from ..ops.publish import make_publish_offer
 
         return make_publish_offer(
@@ -188,26 +194,17 @@ class MultiBankViewWorkflow:
         )
 
     def finalize(self) -> dict[str, DataArray]:
-        if self._sharded is None:
-            out = self._prefetched_publish
-            if out is not None:
-                self._prefetched_publish = None
-            else:
-                out, self._state = self._publisher()(self._state)
-            win_spectra = out["bank_spectra_current"]
-            cum_spectra = out["bank_spectra_cumulative"]
-            win_counts = out["bank_counts_current"]
-            cum_counts = out["bank_counts_cumulative"]
-            total_win = out["counts_current"]
-            total_cum = out["counts_cumulative"]
+        out = self._prefetched_publish
+        if out is not None:
+            self._prefetched_publish = None
         else:
-            cum, win = self._hist.read(self._state)
-            win = win.reshape(self._n_banks, self._pixels_per_bank, -1)
-            cum = cum.reshape(self._n_banks, self._pixels_per_bank, -1)
-            self._state = self._hist.clear_window(self._state)
-            win_spectra, cum_spectra = win.sum(axis=1), cum.sum(axis=1)
-            win_counts, cum_counts = win.sum(axis=(1, 2)), cum.sum(axis=(1, 2))
-            total_win, total_cum = win.sum(), cum.sum()
+            out, self._state = self._publisher()(self._state)
+        win_spectra = out["bank_spectra_current"]
+        cum_spectra = out["bank_spectra_cumulative"]
+        win_counts = out["bank_counts_current"]
+        cum_counts = out["bank_counts_cumulative"]
+        total_win = out["counts_current"]
+        total_cum = out["counts_cumulative"]
         bank_coord = Variable(
             np.arange(self._n_banks), ("bank",), ""
         )
@@ -244,8 +241,5 @@ class MultiBankViewWorkflow:
         }
 
     def clear(self) -> None:
-        if self._sharded is not None:
-            self._state = self._sharded.init_state()
-        else:
-            self._state = self._hist.clear(self._state)
+        self._state = self._hist.clear(self._state)
         self._prefetched_publish = None

@@ -142,18 +142,46 @@ def test_exchange_modes_equivalent(devices):
     )
 
 
-def test_auto_exchange_picks_event_gather_for_large_bins(devices):
-    edges = np.linspace(0.0, 71_000_000.0, 101)
-    mesh = make_mesh(8, bank=8)
+def test_auto_exchange_compares_actual_bytes(devices):
+    """The 'auto' crossover weighs the strategies' ACTUAL per-step wire
+    bytes — dense delta (rows_per_bank x n_toa x itemsize) vs gathered
+    events (batch x 8 B x (data-1)/data) — not a hard-coded bin
+    threshold. Both regimes pinned, plus the batch-size lever the old
+    1<<20-bins constant ignored."""
+    mesh = make_mesh(8, data=2, bank=4)
+    # LOKI-scale bank shards: the dense delta (500k rows x 100 bins x
+    # 4 B = 200 MB per device per step) dwarfs a 4M-event gather
+    # (~16 MB) — gather wins however sparse the batch.
     big = ShardedHistogrammer(
-        # 160k rows / 8 banks * 100 bins = 2M bins per shard > 1M threshold
-        toa_edges=edges, n_screen=2_000_000 // 100 * 8, mesh=mesh
+        toa_edges=np.linspace(0.0, 71e6, 101), n_screen=2_000_000, mesh=mesh
     )
     assert big.exchange == "event_gather"
+    # DREAM-size banks under the default (4M-event) batch hint: the
+    # delta is 16 rows x 10 bins x 4 B = 640 B — far below the 16 MB
+    # gather.
     small = ShardedHistogrammer(
         toa_edges=np.linspace(0.0, 71e6, 11), n_screen=64, mesh=mesh
     )
     assert small.exchange == "delta_psum"
+    # Same bank geometry, tiny batches: now the gather (64 ev x 8 B / 2
+    # = 256 B) undercuts the 640 B delta — the batch-size dependence the
+    # old constant could not express.
+    tiny_batches = ShardedHistogrammer(
+        toa_edges=np.linspace(0.0, 71e6, 11),
+        n_screen=64,
+        mesh=mesh,
+        batch_hint=64,
+    )
+    assert tiny_batches.exchange == "event_gather"
+    # data=1 (bank-only mesh): there is nothing to gather — all_gather
+    # over one shard is the identity — while delta_psum still
+    # materializes and reduces a dense copy. Gather is free, always.
+    bank_only = ShardedHistogrammer(
+        toa_edges=np.linspace(0.0, 71e6, 11),
+        n_screen=64,
+        mesh=make_mesh(8, bank=8),
+    )
+    assert bank_only.exchange == "event_gather"
 
 
 def test_sharded_replicas_and_weights_match_single(devices):

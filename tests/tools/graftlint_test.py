@@ -219,6 +219,19 @@ def tick_once(hist, state, staged):
     state = hist.step_flat(state, staged)
     return new_state, total
 ''',
+    # Mesh-scoped code (jax.sharding import): a placement-less
+    # device_put commits to the default device, and the per-job loop
+    # feeds it to a mesh-sharded dispatch — one implicit reshard per
+    # job (both shapes of the hazard in one fixture).
+    "JGL017": '''
+import jax
+from jax.sharding import NamedSharding
+
+def serve(jobs, sharded_hist, batch):
+    for job in jobs:
+        staged = jax.device_put(batch)
+        job.state = sharded_hist.step(job.state, staged, staged)
+''',
 }
 
 NEGATIVE = {
@@ -492,6 +505,20 @@ def tick_loop(hist, jobs, staged):
             if state_consumed(state):
                 state = hist.init_state()
         job.set_state(state)
+''',
+    # Explicitly placed: one hop onto the event NamedSharding before
+    # the loop (stage_for idiom) — no implicit reshard anywhere. The
+    # single-arg device_put lives in a NON-mesh-scoped helper in real
+    # code (ops/event_batch.dispatch_safe); here everything is placed.
+    "JGL017": '''
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+def serve(jobs, sharded_hist, batch, mesh):
+    sharding = NamedSharding(mesh, PartitionSpec("data"))
+    staged = jax.device_put(batch, sharding)
+    for job in jobs:
+        job.state = sharded_hist.step(job.state, staged, staged)
 ''',
 }
 # fmt: on

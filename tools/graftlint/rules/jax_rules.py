@@ -1,4 +1,4 @@
-"""JAX jit-boundary hazards: JGL001/002/003/006/008/009/015/016.
+"""JAX jit-boundary hazards: JGL001/002/003/006/008/009/015/016/017.
 
 Most of these erase TPU throughput without failing a test — host syncs
 serialize the pipeline behind a device round trip, retraces recompile
@@ -755,3 +755,131 @@ def post_donation_reuse(ctx: FileContext):
         scan = _DonationScan(ctx)
         scan.run(fn)
         yield from scan.findings
+
+
+#: Import-value markers of MESH-SCOPED code: modules that name jax
+#: sharding types or the repo's mesh layer in their imports. Detection
+#: is import-based (never docstrings/comments), the ADR 0112 precision
+#: contract.
+_MESH_IMPORT_MARKERS = (
+    "jax.sharding.",
+    "shard_map",
+    "sharded_hist",
+    "sharded_qhist",
+    "mesh_tick",
+    "make_mesh",
+    "mesh_from_spec",
+)
+
+#: Dispatch method names that consume staged arrays on a mesh-sharded
+#: receiver (receiver tokens below): feeding a default-placed array in
+#: forces an implicit reshard per call.
+_MESH_DISPATCH_NAMES = frozenset(
+    {"step", "step_batch", "step_many", "tick_step", "normalized"}
+)
+_MESH_RECEIVER_TOKENS = frozenset({"sharded", "mesh"})
+
+#: Calls whose result is committed to (or destined for) the DEFAULT
+#: placement: dispatch_safe by name, jnp.asarray/array by qualname, and
+#: single-argument jax.device_put (no device/sharding).
+_DEFAULT_STAGE_QUALNAMES = frozenset(
+    {"jax.numpy.asarray", "jax.numpy.array"}
+)
+
+
+def _is_mesh_scoped(ctx: FileContext) -> bool:
+    for qual in ctx._names.values():
+        if any(marker in qual for marker in _MESH_IMPORT_MARKERS):
+            return True
+    return False
+
+
+def _is_default_placed_stage(ctx: FileContext, call: ast.Call) -> bool:
+    qual = ctx.qualname(call.func)
+    if qual in _DEFAULT_STAGE_QUALNAMES:
+        return True
+    if qual == "jax.device_put":
+        return len(call.args) < 2 and not call.keywords
+    name = (
+        call.func.id
+        if isinstance(call.func, ast.Name)
+        else getattr(call.func, "attr", None)
+    )
+    return name == "dispatch_safe"
+
+
+@rule("JGL017", "implicit resharding in mesh-scoped code")
+def implicit_resharding(ctx: FileContext):
+    """Two shapes of the same hazard (ADR 0115): an array placed on the
+    DEFAULT device meeting a mesh-compiled dispatch. (a) ``jax.device_put``
+    without an explicit device/sharding inside mesh-scoped code — the
+    array commits to the default device, so the mesh program that
+    consumes it pays a second device->device copy per call (or rejects
+    the device mix outright, degrading the whole group). (b) a value
+    staged by ``dispatch_safe``/``jnp.asarray``/placement-less
+    ``device_put`` inside a per-job loop and fed to a mesh-sharded
+    receiver's dispatch — the K-jobs variant: one implicit reshard per
+    job per window. Stage onto the target NamedSharding in ONE hop
+    (``stage_for``) or through the slice-keyed stream cache instead."""
+    if not _is_mesh_scoped(ctx):
+        return
+    for node in ctx.nodes(ast.Call):
+        if ctx.qualname(node.func) != "jax.device_put":
+            continue
+        placed = len(node.args) >= 2 or bool(node.keywords)
+        if not placed:
+            yield Finding(
+                ctx.path,
+                node.lineno,
+                "JGL017",
+                "jax.device_put without an explicit device/sharding in "
+                "mesh-scoped code commits the array to the DEFAULT "
+                "device; a mesh-compiled dispatch consuming it must "
+                "implicitly reshard (a second device->device copy per "
+                "call) or reject the device mix. Place onto the target "
+                "NamedSharding/slice in one hop (stage_for, ADR 0115)",
+            )
+    for loop in ctx.nodes(ast.For):
+        if not (
+            _mentions_jobish(loop.target) or _mentions_jobish(loop.iter)
+        ):
+            continue
+        default_placed: set[str] = set()
+        for sub in ctx.walk_shallow(loop):
+            if not isinstance(sub, ast.Assign):
+                continue
+            value = sub.value
+            if isinstance(value, ast.Call) and _is_default_placed_stage(
+                ctx, value
+            ):
+                for t in sub.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            default_placed.add(n.id)
+        if not default_placed:
+            continue
+        frozen = frozenset(default_placed)
+        for node in ctx.walk_shallow(loop):
+            if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Attribute
+            ):
+                continue
+            if node.func.attr not in _MESH_DISPATCH_NAMES:
+                continue
+            recv = _dotted(node.func.value)
+            tokens = set((recv or "").lower().replace(".", "_").split("_"))
+            if not tokens & _MESH_RECEIVER_TOKENS:
+                continue
+            if any(ctx.mentions_any(arg, frozen) for arg in node.args):
+                yield Finding(
+                    ctx.path,
+                    node.lineno,
+                    "JGL017",
+                    f"default-placed staged value fed to mesh-sharded "
+                    f"dispatch '{node.func.attr}' inside a per-job loop: "
+                    "each call implicitly reshards the same bytes onto "
+                    "the mesh (K jobs = K redundant copies of one "
+                    "batch). Stage once onto the event NamedSharding "
+                    "(stage_for / the slice-keyed stream cache, "
+                    "ADR 0110/0115) before the loop",
+                )
