@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Metrics-plane smoke: fake-kafka service + --metrics-port -> scrape.
+
+CI's counterpart to the /metrics acceptance (ADR 0116): bring up a REAL
+detector service over the file-backed broker (the fake Kafka, ADR 0104)
+with ``--metrics-port``, feed it a start command and a few ev44 pulses,
+then
+
+1. ``GET /healthz`` answers 200 ``{"status": "ok"}``;
+2. ``GET /metrics`` answers Prometheus text exposition that the IN-TREE
+   promtext parser (telemetry/exposition.py — no prometheus_client
+   dependency) accepts: labels unescape, histogram bucket series are
+   monotone and closed at +Inf;
+3. the payload exposes the migrated producer families — publish
+   dispatch counters, pipeline/stage surfaces, stream counts, compile
+   histograms, span decomposition, HBM gauges — and, once data flowed,
+   nonzero publish executes.
+
+Exit 0 on success, 1 with a diagnostic otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "src"))
+
+TIMEOUT_S = float(os.environ.get("METRICS_SMOKE_TIMEOUT_S", "90"))
+PORT = int(os.environ.get("METRICS_SMOKE_PORT", "18917"))
+
+#: Families one scrape of a running service must expose (the /metrics
+#: acceptance list; livedata_hbm_bytes may be sample-less on CPU but
+#: its HELP/TYPE header must still be there).
+REQUIRED_FAMILIES = (
+    "livedata_publish_events",
+    "livedata_publish_slice_events",
+    "livedata_publish_rtt_seconds",
+    "livedata_jit_compiles_total",
+    "livedata_jit_compile_seconds",
+    "livedata_tick_span_seconds",
+    "livedata_stream_messages",
+    "livedata_kafka_sink_events",
+    "livedata_hbm_bytes",
+)
+
+
+def fetch(path: str, timeout: float = 5.0) -> tuple[int, bytes]:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{PORT}{path}", timeout=timeout
+    ) as response:
+        return response.status, response.read()
+
+
+def main() -> int:
+    import uuid
+
+    import numpy as np
+
+    from esslivedata_tpu.config import JobId, WorkflowConfig
+    from esslivedata_tpu.config.instruments.dummy.specs import (
+        DETECTOR_VIEW_HANDLE,
+        INSTRUMENT,
+    )
+    from esslivedata_tpu.kafka import wire
+    from esslivedata_tpu.kafka.file_broker import (
+        FileBrokerProducer,
+        ensure_topics,
+    )
+    from esslivedata_tpu.telemetry import parse_prometheus_text
+
+    deadline = time.time() + TIMEOUT_S
+    broker_dir = tempfile.mkdtemp(prefix="metrics-smoke-broker-")
+    ensure_topics(
+        broker_dir, ["dummy_detector", "dummy_livedata_commands"]
+    )
+    env = {
+        **os.environ,
+        "LIVEDATA_FORCE_CPU": "1",
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": str(Path(__file__).resolve().parent.parent / "src"),
+    }
+    service = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "esslivedata_tpu.services.detector_data",
+            "--instrument",
+            "dummy",
+            "--batcher",
+            "naive",
+            "--broker-dir",
+            broker_dir,
+            "--metrics-port",
+            str(PORT),
+        ],
+        env=env,
+    )
+    try:
+        producer = FileBrokerProducer(broker_dir)
+        config = WorkflowConfig(
+            identifier=DETECTOR_VIEW_HANDLE.workflow_id,
+            job_id=JobId(
+                source_name="panel_0", job_number=uuid.uuid4()
+            ),
+            params={},
+        )
+        command = json.dumps(
+            {"kind": "start_job", "config": config.model_dump(mode="json")}
+        ).encode()
+        det = INSTRUMENT.detectors["panel_0"]
+        ids_space = np.asarray(det.detector_number).reshape(-1)
+        rng = np.random.default_rng(7)
+
+        # 1. liveness first: the endpoint must come up with the service.
+        health = None
+        while time.time() < deadline:
+            if service.poll() is not None:
+                print(f"service died rc={service.returncode}")
+                return 1
+            try:
+                status, body = fetch("/healthz")
+                health = json.loads(body)
+                break
+            except Exception:
+                time.sleep(1.0)
+        if health != {"status": "ok"}:
+            print(f"/healthz wrong or never up: {health!r}")
+            return 1
+        print("healthz OK")
+
+        # 2. drive data so the publish/compile/span producers fire.
+        publishes = 0.0
+        parsed = None
+        pulse = 0
+        period_ns = int(1e9 / 14)
+        while time.time() < deadline and publishes < 1:
+            if service.poll() is not None:
+                print(f"service died rc={service.returncode}")
+                return 1
+            producer.produce("dummy_livedata_commands", command)
+            for _ in range(5):
+                t_pulse = 1_700_000_000_000_000_000 + pulse * period_ns
+                payload = wire.encode_ev44(
+                    det.source_name,
+                    pulse,
+                    np.array([t_pulse]),
+                    np.array([0]),
+                    rng.uniform(0, 7.0e7, 256).astype(np.int32),
+                    pixel_id=rng.choice(ids_space, 256).astype(np.int32),
+                )
+                producer.produce("dummy_detector", payload)
+                pulse += 1
+            time.sleep(2.0)
+            status, body = fetch("/metrics")
+            if status != 200:
+                print(f"/metrics HTTP {status}")
+                return 1
+            # 3. the payload must PARSE (in-tree promtext parser:
+            # escapes, bucket monotonicity) on every scrape, data or no.
+            parsed = parse_prometheus_text(body.decode())
+            publishes = sum(
+                value
+                for _n, labels, value in parsed[
+                    "livedata_publish_events"
+                ].samples
+                if labels.get("kind") == "executes"
+            ) if "livedata_publish_events" in parsed else 0.0
+        if parsed is None or publishes < 1:
+            print(
+                f"no publish executes after {TIMEOUT_S}s "
+                f"(families: {sorted(parsed) if parsed else None})"
+            )
+            return 1
+        missing = [f for f in REQUIRED_FAMILIES if f not in parsed]
+        if missing:
+            print(f"scrape missing families: {missing}")
+            return 1
+        compiles = sum(
+            value
+            for _n, _l, value in parsed["livedata_jit_compiles_total"].samples
+        )
+        if compiles < 1:
+            print("compile-event instrument saw no compiles")
+            return 1
+        print(
+            f"metrics smoke PASSED: {len(parsed)} families, "
+            f"publish executes={publishes:.0f}, compiles={compiles:.0f}"
+        )
+        return 0
+    finally:
+        service.terminate()
+        try:
+            service.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            service.kill()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -252,6 +252,13 @@ class DeviceEventCache:
         self._misses = 0
         self._bytes_staged = 0
         self._staging_s = 0.0
+        # Cumulative twins drain_stats() never resets — the telemetry
+        # collector (ADR 0116) needs monotone counters while the 30 s
+        # metrics line keeps draining its own interval totals.
+        self._cum_hits = 0
+        self._cum_misses = 0
+        self._cum_bytes_staged = 0
+        self._cum_staging_s = 0.0
         #: Optional core.link_monitor.LinkMonitor (duck-typed:
         #: ``observe_staging(nbytes, seconds)``) fed from real staging
         #: timings — the pipelined ingest attaches it (ADR 0111).
@@ -293,6 +300,9 @@ class DeviceEventCache:
             self._misses += 1
             self._bytes_staged += nbytes
             self._staging_s += seconds
+            self._cum_misses += 1
+            self._cum_bytes_staged += nbytes
+            self._cum_staging_s += seconds
         observer = self.link_observer
         if observer is not None:
             try:
@@ -305,6 +315,17 @@ class DeviceEventCache:
     def _record_hit(self) -> None:
         with self._stats_lock:
             self._hits += 1
+            self._cum_hits += 1
+
+    def cumulative_stats(self) -> dict[str, int | float]:
+        """Monotone totals since construction (telemetry collector)."""
+        with self._stats_lock:
+            return {
+                "hits": self._cum_hits,
+                "misses": self._cum_misses,
+                "bytes_staged": self._cum_bytes_staged,
+                "staging_s": self._cum_staging_s,
+            }
 
     def stats(self) -> dict[str, int | float]:
         """{hits, misses, bytes_staged, staging_s, hit_rate} since the

@@ -68,6 +68,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from ..telemetry.trace import TRACER
 from ..utils.profiling import StageTimer
 from .link_monitor import LinkMonitor, LinkPolicy
 
@@ -99,6 +100,10 @@ class PipelineWindow:
     #: load signal: the slowest stage is the pipeline's service time).
     stage_s: dict[str, float] = field(default_factory=dict)
     t_submit: float = 0.0
+    #: Telemetry trace id (ADR 0116), allocated at decode: every span
+    #: this window records — across all three stage workers and the
+    #: device layers — shares it, so a slow tick decomposes by phase.
+    trace: int | None = None
 
 
 class IngestPipeline:
@@ -323,6 +328,34 @@ class IngestPipeline:
             },
         }
 
+    def queue_depths(self) -> dict[str, int]:
+        """Instantaneous per-stage queue depths (telemetry gauges,
+        ADR 0116): a persistently full queue names the bottleneck stage
+        the utilization averages can only hint at. ``qsize`` is racy by
+        nature — that is fine for a gauge sampled at scrape time."""
+        return {
+            "decode": self._decode_q.qsize(),
+            "stage": self._stage_q.qsize(),
+            "step": self._step_q.qsize(),
+        }
+
+    def telemetry(self) -> dict[str, Any]:
+        """Scrape-time snapshot for the telemetry collector: queue
+        depths, in-flight/limit, window counts and CUMULATIVE per-stage
+        busy seconds (never drained — ``stats()`` keeps its 30 s
+        drain-and-reset semantics for the metrics log)."""
+        with self._state_lock:
+            completed, published = self._completed, self._published
+            inflight = self._inflight
+        return {
+            "queues": self.queue_depths(),
+            "inflight": inflight,
+            "depth": self.depth,
+            "completed": completed,
+            "published": published,
+            "stages": self._timer.cumulative(),
+        }
+
     # -- stage workers -----------------------------------------------------
     def _guarded(self, loop: Callable[[], None]) -> None:
         try:
@@ -374,6 +407,11 @@ class IngestPipeline:
             window = self._get(self._decode_q)
             if window is None:
                 return
+            # The trace id is born HERE, with the window's decode
+            # (ADR 0116): every later span — prestage on the stage
+            # worker, tick-execute/fetch in the device layers, finalize
+            # and sink on the step worker — records against it.
+            window.trace = TRACER.new_trace()
             t0 = time.perf_counter()
             with self._timer.stage("decode"):
                 if window.payload is None:
@@ -387,6 +425,9 @@ class IngestPipeline:
                     ) = self._decode(window.payload)
                     window.payload = None  # drop message refs early
             window.stage_s["decode"] = time.perf_counter() - t0
+            TRACER.record(
+                "decode", t0, window.stage_s["decode"], window.trace
+            )
             if not self._put(self._stage_q, window):
                 return
 
@@ -418,6 +459,9 @@ class IngestPipeline:
                     ),
                 )
             window.stage_s["stage"] = time.perf_counter() - t0
+            TRACER.record(
+                "prestage", t0, window.stage_s["stage"], window.trace
+            )
             if not self._put(self._step_q, window):
                 return
 
@@ -429,7 +473,11 @@ class IngestPipeline:
                 return
             try:
                 t0 = time.perf_counter()
-                with self._timer.stage("step"):
+                # Bind the window's trace for everything the step runs:
+                # the device layers (tick combiner execute/fetch spans,
+                # finalize) read the thread-bound id — they don't know
+                # the window.
+                with self._timer.stage("step"), TRACER.bind(window.trace):
                     window.results = self._job_manager.process_jobs(
                         window.data,
                         context=window.context,
@@ -442,7 +490,8 @@ class IngestPipeline:
                 t0 = time.perf_counter()
                 with self._timer.stage("publish"):
                     if window.results:
-                        self._publish(window.results, window.end)
+                        with TRACER.span("sink", window.trace):
+                            self._publish(window.results, window.end)
                 # Publish-stage time here is sink serialization only:
                 # the RTT observation moved to the device round trip
                 # itself (JobManager times every combined execute+fetch
@@ -463,6 +512,13 @@ class IngestPipeline:
                     f"{window.seq} after {self._last_completed_seq}"
                 )
             self._last_completed_seq = window.seq
+            if window.trace is not None:
+                # Slow-tick watchdog (ADR 0116): submit->published wall
+                # time against the latched threshold; a breach logs this
+                # window's full span breakdown.
+                TRACER.finish_tick(
+                    window.trace, time.monotonic() - window.t_submit
+                )
             if self._on_complete is not None:
                 try:
                     self._on_complete(window)

@@ -29,6 +29,7 @@ from pydantic import BaseModel, model_validator
 
 from ..config.workflow_spec import JobId, WorkflowConfig
 from ..preprocessors.event_data import StagedEvents
+from ..telemetry.trace import TRACER
 from ..utils.compat import StrEnum
 from ..workflows.workflow_factory import WorkflowFactory, workflow_registry
 from .device_event_cache import DeviceEventCache
@@ -1245,10 +1246,14 @@ class JobManager:
             served = tick_served | self._run_combined_publish(
                 [rec for rec in due if id(rec) not in tick_served]
             )
-            if self._executor is not None and len(due) > 1:
-                results = list(self._executor.map(run_finalize, due))
-            else:
-                results = [run_finalize(rec) for rec in due]
+            # One finalize span per window (ADR 0116), recorded from
+            # THIS thread (the step worker carries the window's bound
+            # trace id; the pool threads inside wouldn't).
+            with TRACER.span("finalize"):
+                if self._executor is not None and len(due) > 1:
+                    results = list(self._executor.map(run_finalize, due))
+                else:
+                    results = [run_finalize(rec) for rec in due]
 
         with self._lock:
             for rec in list(self._records.values()):
@@ -1410,6 +1415,12 @@ class JobManager:
         (hits/misses/bytes_staged/hit_rate) — the 30 s metrics line and
         the multi-job bench read these."""
         return self._event_cache.drain_stats()
+
+    def event_cache_cumulative_stats(self) -> dict[str, int | float]:
+        """Monotone stage-once cache totals since construction — the
+        telemetry collector's read (ADR 0116), independent of the 30 s
+        drain above."""
+        return self._event_cache.cumulative_stats()
 
     # -- introspection -----------------------------------------------------
     def has_finishing_jobs(self) -> bool:

@@ -68,6 +68,13 @@ import threading
 import time
 from dataclasses import dataclass
 
+#: Shared instrument (telemetry/instruments.py — defined there so a
+#: serial service, which never imports this module, still exposes the
+#: family). Recorded outside the monitor's lock: the instrument has its
+#: own, and telemetry must never extend the estimator's critical
+#: section.
+from ..telemetry.instruments import PUBLISH_RTT_SECONDS as _RTT_SECONDS
+
 __all__ = ["LinkMonitor", "LinkPolicy"]
 
 
@@ -196,6 +203,9 @@ class LinkMonitor:
         """
         if compiled or seconds <= 0.0:
             return
+        _RTT_SECONDS.observe(
+            seconds, slice="all" if slice_key is None else str(slice_key)
+        )
         with self._lock:
             self._n_publish += 1
             self._rtt_s = (
@@ -263,35 +273,44 @@ class LinkMonitor:
         """The current adaptation decision; neutral until the first
         staging observation converges the bandwidth estimate."""
         with self._lock:
-            bw = self._bw_bps
-            rtt = self._policy_rtt_locked()
-            coalesce = self._publish_coalesce_locked(rtt)
-            if bw is None:
-                return LinkPolicy(
-                    window_scale=1.0,
-                    compact_wire=None,
-                    depth=self._base_depth,
-                    publish_coalesce=coalesce,
-                )
-            if self._degraded_latch:
-                if bw >= self._recover:
-                    self._degraded_latch = False
-            elif bw < self._degraded:
-                self._degraded_latch = True
-            degraded = self._degraded_latch
-            # Continuous target quantized to sqrt(2) steps: the batcher
-            # regates streams on every window change, so a smoothly
-            # drifting estimate must not retarget every batch.
-            raw = min(self._max_scale, max(1.0, self._target / bw))
-            step = round(math.log(raw, math.sqrt(2.0)))
-            scale = min(self._max_scale, max(1.0, math.sqrt(2.0) ** step))
-            deep = degraded or (rtt is not None and rtt > self._rtt_deep)
+            return self._policy_locked()
+
+    def _policy_locked(self) -> LinkPolicy:
+        """Policy computation under the caller's lock acquisition —
+        shared by :meth:`policy` and :meth:`stats` so a stats snapshot
+        is ONE coherent read (policy fields and raw estimates from the
+        same critical section; see the stats docstring)."""
+        bw = self._bw_bps
+        rtt = self._policy_rtt_locked()
+        coalesce = self._publish_coalesce_locked(rtt)
+        if bw is None:
             return LinkPolicy(
-                window_scale=scale,
-                compact_wire=True if degraded else None,
-                depth=self._max_depth if deep else self._base_depth,
+                window_scale=1.0,
+                compact_wire=None,
+                depth=self._base_depth,
                 publish_coalesce=coalesce,
             )
+        if self._degraded_latch:
+            if bw >= self._recover:
+                # graftlint: disable=JGL012 caller holds self._lock
+                self._degraded_latch = False
+        elif bw < self._degraded:
+            # graftlint: disable=JGL012 caller holds self._lock
+            self._degraded_latch = True
+        degraded = self._degraded_latch
+        # Continuous target quantized to sqrt(2) steps: the batcher
+        # regates streams on every window change, so a smoothly
+        # drifting estimate must not retarget every batch.
+        raw = min(self._max_scale, max(1.0, self._target / bw))
+        step = round(math.log(raw, math.sqrt(2.0)))
+        scale = min(self._max_scale, max(1.0, math.sqrt(2.0) ** step))
+        deep = degraded or (rtt is not None and rtt > self._rtt_deep)
+        return LinkPolicy(
+            window_scale=scale,
+            compact_wire=True if degraded else None,
+            depth=self._max_depth if deep else self._base_depth,
+            publish_coalesce=coalesce,
+        )
 
     def _publish_coalesce_locked(self, rtt: float | None) -> int:
         """The RTT-adaptive publish-coalescing window (caller holds the
@@ -317,9 +336,18 @@ class LinkMonitor:
         return min(self._max_coalesce, 1 << round(math.log2(raw)))
 
     def stats(self) -> dict[str, float | int | bool | None]:
-        """Snapshot for the 30 s metrics line."""
-        policy = self.policy()
+        """Snapshot for the 30 s metrics line and the telemetry
+        collector — ONE lock acquisition for the whole read. The old
+        shape (``self.policy()`` then re-acquire for the raw fields)
+        could interleave with observations between the two critical
+        sections and report policy fields computed from DIFFERENT state
+        than the latches/estimates next to them — e.g. ``degraded:
+        True`` beside ``compact_wire: None``, an impossible pairing
+        that sends an operator chasing a phantom policy bug. Pinned by
+        the stats-coherence lock hammer in tests/core/link_monitor_test.
+        """
         with self._lock:
+            policy = self._policy_locked()
             return {
                 "bandwidth_bps": self._bw_bps,
                 "rtt_s": self._rtt_s,

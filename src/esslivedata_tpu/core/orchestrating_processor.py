@@ -20,6 +20,7 @@ from typing import Any
 
 from ..config.acknowledgement import CommandAcknowledgement
 from ..core.preprocessor import PreprocessorFactory
+from ..telemetry.trace import TRACER
 from .command_dispatcher import CommandDispatcher
 from .job_manager import JobManager
 from .job import JobResult, ServiceStatus, StreamLag, StreamLagReport
@@ -260,6 +261,18 @@ class OrchestratingProcessor:
                 link_monitor=self._link_monitor,
                 name=f"{service_name}-ingest",
             )
+        # Unified telemetry (ADR 0116): one keyed collector per
+        # processor feeding the process registry at scrape time — link
+        # estimates, pipeline depths/utilization, stream/sink/source
+        # counters, stage-once cache totals and HBM gauges all ride it.
+        # Keyed by service name so a rebuilt processor (tests, restarts)
+        # REPLACES its predecessor instead of stacking dead callbacks.
+        from ..telemetry.registry import REGISTRY as _registry
+
+        self._telemetry_key = f"processor:{service_name}"
+        _registry.register_collector(
+            self._telemetry_key, self._telemetry_families
+        )
 
     # -- cycle ------------------------------------------------------------
     def process(self) -> None:
@@ -416,13 +429,21 @@ class OrchestratingProcessor:
 
     def _process_batch(self, batch) -> None:
         self._last_batch_len = len(batch.messages)
-        with self.stage_timer.stage("preprocess"):
+        # Serial-path tracing (ADR 0116): the trace id is born at
+        # decode, exactly like the pipelined decode worker's, so the
+        # span names line up across both ingest modes (no prestage
+        # span here — the serial loop stages at step time).
+        trace_id = TRACER.new_trace()
+        t_start = time.monotonic()
+        with self.stage_timer.stage("preprocess"), TRACER.span(
+            "decode", trace_id
+        ):
             self._preprocessor.preprocess(batch.messages)
             window = self._preprocessor.collect_window()
             context = self._preprocessor.collect_context()
             fresh_context = self._preprocessor.fresh_context_names()
         self._record_lag(batch)
-        with self.stage_timer.stage("process_jobs"):
+        with self.stage_timer.stage("process_jobs"), TRACER.bind(trace_id):
             results = self._job_manager.process_jobs(
                 window,
                 context=context,
@@ -431,10 +452,13 @@ class OrchestratingProcessor:
                 end=batch.end,
             )
         try:
-            with self.stage_timer.stage("publish"):
+            with self.stage_timer.stage("publish"), TRACER.span(
+                "sink", trace_id
+            ):
                 self._publish_results(results, batch.end)
         finally:
             self._preprocessor.release()
+            TRACER.finish_tick(trace_id, time.monotonic() - t_start)
 
     def _record_lag(self, batch) -> None:
         now_ns = time.time_ns()
@@ -581,6 +605,255 @@ class OrchestratingProcessor:
             ]
         )
 
+    def _telemetry_families(self) -> list:
+        """Scrape-time collector (ADR 0116): every per-service metric
+        surface this processor owns, rendered as labeled families. The
+        hot path pays nothing here — each producer keeps its own
+        thread-safe counters and this only snapshots them when
+        ``/metrics`` is pulled (or bench embeds the registry)."""
+        from ..telemetry.registry import MetricFamily, Sample
+
+        svc = (("service", self._service_name),)
+
+        def family(name, kind, help, rows):
+            fam = MetricFamily(name, kind, help)
+            suffix = "_total" if kind == "counter" else ""
+            fam.samples = [
+                Sample(suffix, svc + tuple(labels), float(value))
+                for labels, value in rows
+            ]
+            return fam
+
+        families = [
+            family(
+                "livedata_stream_messages",
+                "counter",
+                "Messages mapped per (topic, source) by the adapter layer",
+                [
+                    ((("topic", t), ("source", s)), n)
+                    for (t, s), n in sorted(
+                        self._stream_counter.cumulative_counts().items()
+                    )
+                ]
+                if self._stream_counter is not None
+                else [],
+            ),
+            family(
+                "livedata_preprocessed_messages",
+                "counter",
+                "Messages accumulated per stream by the preprocessor",
+                [
+                    ((("stream", name),), n)
+                    for name, n in sorted(
+                        self._preprocessor.snapshot_counts().items()
+                    )
+                ],
+            ),
+            family(
+                "livedata_jobs",
+                "gauge",
+                "Jobs this service hosts",
+                [((), self._job_manager.n_jobs)],
+            ),
+            family(
+                "livedata_processor_stage_seconds",
+                "counter",
+                "Cumulative wall seconds per processor stage",
+                [
+                    ((("stage", stage),), entry["total_s"])
+                    for stage, entry in sorted(
+                        self.stage_timer.cumulative().items()
+                    )
+                ],
+            ),
+        ]
+        cache_stats = getattr(
+            self._job_manager, "event_cache_cumulative_stats", None
+        )
+        if cache_stats is not None:
+            families.append(
+                family(
+                    "livedata_event_cache_events",
+                    "counter",
+                    "Stage-once cache totals (ADR 0110): misses ~= one "
+                    "per (stream, window) regardless of job count; "
+                    "bytes_staged is the actual wire traffic",
+                    [
+                        ((("kind", kind),), value)
+                        for kind, value in sorted(cache_stats().items())
+                    ],
+                )
+            )
+        if self._link_monitor is not None:
+            link = self._link_monitor.stats()
+            families.append(
+                family(
+                    "livedata_link_bandwidth_bps",
+                    "gauge",
+                    "EWMA effective staging bandwidth (ADR 0111)",
+                    [((), link["bandwidth_bps"] or 0.0)],
+                )
+            )
+            rtt_rows = [((("slice", "all"),), link["rtt_s"] or 0.0)]
+            rtt_rows += [
+                ((("slice", str(slice_key)),), rtt)
+                for slice_key, rtt in sorted(link["rtt_by_slice"].items())
+            ]
+            families.append(
+                family(
+                    "livedata_link_rtt_ewma_seconds",
+                    "gauge",
+                    "EWMA publish RTT, per mesh slice (ADR 0115); the "
+                    "policy reacts to the worst slice",
+                    rtt_rows,
+                )
+            )
+            families.append(
+                family(
+                    "livedata_link_policy",
+                    "gauge",
+                    "Latched link-adaptation decision (ADR 0111): "
+                    "window_scale / depth / publish_coalesce / degraded "
+                    "(0|1) / compact_wire (0|1, -1 = construction default)",
+                    [
+                        ((("axis", "window_scale"),), link["window_scale"]),
+                        ((("axis", "depth"),), link["depth"]),
+                        (
+                            (("axis", "publish_coalesce"),),
+                            link["publish_coalesce"],
+                        ),
+                        ((("axis", "degraded"),), int(link["degraded"])),
+                        (
+                            (("axis", "compact_wire"),),
+                            -1
+                            if link["compact_wire"] is None
+                            else int(link["compact_wire"]),
+                        ),
+                    ],
+                )
+            )
+        if self._pipeline is not None:
+            pipe = self._pipeline.telemetry()
+            families.append(
+                family(
+                    "livedata_pipeline_queue_depth",
+                    "gauge",
+                    "Windows queued per pipeline stage (ADR 0111)",
+                    [
+                        ((("stage", stage),), depth)
+                        for stage, depth in sorted(pipe["queues"].items())
+                    ],
+                )
+            )
+            families.append(
+                family(
+                    "livedata_pipeline_inflight",
+                    "gauge",
+                    "In-flight windows vs the link-adaptive depth bound",
+                    [
+                        ((("kind", "inflight"),), pipe["inflight"]),
+                        ((("kind", "depth"),), pipe["depth"]),
+                    ],
+                )
+            )
+            families.append(
+                family(
+                    "livedata_pipeline_windows",
+                    "counter",
+                    "Windows completed/published through the pipeline",
+                    [
+                        ((("kind", "completed"),), pipe["completed"]),
+                        ((("kind", "published"),), pipe["published"]),
+                    ],
+                )
+            )
+            families.append(
+                family(
+                    "livedata_pipeline_stage_busy_seconds",
+                    "counter",
+                    "Cumulative busy seconds per pipeline stage "
+                    "(utilization = rate of this over wall time; the "
+                    "sum across stages exceeding 1 is the overlap the "
+                    "serial loop forfeits)",
+                    [
+                        ((("stage", stage),), entry["total_s"])
+                        for stage, entry in sorted(pipe["stages"].items())
+                    ],
+                )
+            )
+        sink_metrics = getattr(self._sink, "metrics", None)
+        if callable(sink_metrics):
+            try:
+                rows = sorted(sink_metrics().items())
+            except Exception:
+                rows = []
+            families.append(
+                family(
+                    "livedata_kafka_sink_events",
+                    "counter",
+                    "Sink drop/error counters incl. the per-path "
+                    "consecutive-failure streaks behind the breaker",
+                    [((("kind", kind),), value) for kind, value in rows],
+                )
+            )
+        transport = _transport_of(self._source)
+        if transport is not None:
+            families.append(
+                family(
+                    "livedata_kafka_source_events",
+                    "counter",
+                    "Raw transport counters (consumed/queued/dropped)",
+                    [
+                        ((("kind", kind),), value)
+                        for kind, value in sorted(transport.metrics.items())
+                    ],
+                )
+            )
+            health = transport.health
+            families.append(
+                family(
+                    "livedata_source_up",
+                    "gauge",
+                    "1 = consume transport healthy, 0 = stale/breaker open",
+                    [
+                        (
+                            (),
+                            int(
+                                getattr(health, "value", health) == "ok"
+                            ),
+                        )
+                    ],
+                )
+            )
+        hbm = MetricFamily(
+            "livedata_hbm_bytes",
+            "gauge",
+            "Per-device HBM statistics (bytes_in_use / peak_bytes_in_use "
+            "/ bytes_limit); empty on backends without memory_stats",
+        )
+        try:
+            from ..utils.profiling import device_memory_stats
+
+            # Service-labeled like every family here: two processors in
+            # one process must emit DISTINCT samples, not byte-identical
+            # duplicate lines (which real scrapers reject).
+            hbm.samples = [
+                Sample(
+                    "",
+                    svc
+                    + (
+                        ("device", key.partition(":")[0]),
+                        ("kind", key.partition(":")[2]),
+                    ),
+                    float(value),
+                )
+                for key, value in sorted(device_memory_stats().items())
+            ]
+        except Exception:  # pragma: no cover - backend without stats
+            logger.debug("device_memory_stats unavailable", exc_info=True)
+        families.append(hbm)
+        return families
+
     def _log_metrics(self) -> None:
         extra = {
             "service": self._service_name,
@@ -656,3 +929,14 @@ class OrchestratingProcessor:
         except Exception:
             logger.exception("Failed to publish final status")
         self._job_manager.shutdown()
+        # Drop this processor's scrape collector: the registry is
+        # process-wide and a finalized processor must not keep feeding
+        # stale families (or pin the whole object graph) forever.
+        # Identity-guarded: if a rebuilt processor already REPLACED the
+        # key, this late shutdown must not delete the successor's live
+        # collector.
+        from ..telemetry.registry import REGISTRY as _registry
+
+        _registry.unregister_collector(
+            self._telemetry_key, self._telemetry_families
+        )

@@ -82,10 +82,55 @@ def get_env_defaults(parser: argparse.ArgumentParser, prefix: str = ENV_PREFIX) 
     return defaults
 
 
+#: Metrics servers started by parse_args, keyed by the REQUESTED port
+#: (including 0, the ephemeral ask): a process that parses twice (tests
+#: driving main() repeatedly) must reuse its endpoint — keying by the
+#: resolved port would make every `--metrics-port 0` parse leak another
+#: listener, the exact accumulation this table exists to prevent. The
+#: bound port is `server.port` on the stored value.
+_metrics_servers: dict[int, Any] = {}
+_trace_dump_paths: set[str] = set()
+
+
+def _start_telemetry(parsed: argparse.Namespace) -> None:
+    """Telemetry plane wiring shared by every runner (ADR 0116):
+    ``--metrics-port``/``LIVEDATA_METRICS_PORT`` starts the /metrics +
+    /healthz endpoint; ``--trace-dump PATH`` registers an exit-time
+    Chrome trace_event dump of the tick tracer's ring."""
+    port = getattr(parsed, "metrics_port", None)
+    if port is None and os.environ.get("LIVEDATA_METRICS_PORT"):
+        # Belt-and-braces: the env default normally lands via
+        # get_env_defaults, but a runner that skips set_defaults still
+        # honors the operator's env.
+        port = int(os.environ["LIVEDATA_METRICS_PORT"])
+    if port is not None and int(port) not in _metrics_servers:
+        from ..telemetry.http import start_metrics_server
+
+        server = start_metrics_server(int(port))
+        if server is not None:
+            _metrics_servers[int(port)] = server
+    dump_path = getattr(parsed, "trace_dump", None)
+    if dump_path and dump_path not in _trace_dump_paths:
+        _trace_dump_paths.add(dump_path)
+        import atexit
+
+        from ..telemetry.trace import TRACER
+
+        def _dump() -> None:
+            try:
+                TRACER.dump(dump_path)
+            except Exception:  # pragma: no cover - exit-path best effort
+                logger.exception("trace dump to %s failed", dump_path)
+
+        atexit.register(_dump)
+
+
 class _ServiceArgumentParser(argparse.ArgumentParser):
-    """parse_args applies the CPU pin BEFORE returning: every service
-    main parses first and builds (touching JAX) after, so pinning here
-    covers --cpu, LIVEDATA_FORCE_CPU, and programmatic argv lists alike.
+    """parse_args applies the CPU pin (and starts the telemetry plane)
+    BEFORE returning: every service main parses first and builds
+    (touching JAX) after, so handling it here covers --cpu /
+    LIVEDATA_FORCE_CPU, --metrics-port / LIVEDATA_METRICS_PORT and
+    programmatic argv lists alike, for all eight runners.
     """
 
     def parse_args(self, *args, **kwargs):  # type: ignore[override]
@@ -99,6 +144,7 @@ class _ServiceArgumentParser(argparse.ArgumentParser):
             from ..utils.platform_pin import pin_cpu
 
             pin_cpu()
+        _start_telemetry(parsed)
         return parsed
 
 
@@ -121,6 +167,25 @@ def setup_arg_parser(description: str = "") -> argparse.ArgumentParser:
     )
     parser.add_argument("--log-level", default="INFO")
     parser.add_argument("--log-json-file", default=None)
+    parser.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve the process telemetry registry on this port "
+        "(GET /metrics: Prometheus text exposition; GET /healthz: "
+        "liveness). LIVEDATA_METRICS_PORT equivalently; 0 picks an "
+        "ephemeral port (ADR 0116)",
+    )
+    parser.add_argument(
+        "--trace-dump",
+        default=None,
+        metavar="PATH",
+        help="write the per-tick tracer's span ring as Chrome "
+        "trace_event JSON (chrome://tracing / Perfetto loadable) to "
+        "PATH at exit; span recording itself is on unless "
+        "LIVEDATA_TRACE=0 (ADR 0116)",
+    )
     return parser
 
 

@@ -220,6 +220,20 @@ class KafkaSink:
         # itself thread-safe; the lock covers this sink's accounting.
         self._lock = threading.Lock()
 
+    def metrics(self) -> dict[str, int]:
+        """Coherent snapshot of the sink/breaker counters — the
+        telemetry collector's read (ADR 0116); one lock acquisition so
+        a streak's dropped/consecutive pair can never tear."""
+        with self._lock:
+            return {
+                "dropped": self.dropped,
+                "serialize_errors": self.serialize_errors,
+                "produce_errors": self.produce_errors,
+                "flush_errors": self.flush_errors,
+                "consecutive_produce_failures": self._consecutive_produce,
+                "consecutive_flush_failures": self._consecutive_flush,
+            }
+
     def _trip_or_warn(
         self, consecutive: int, what: str, exc: BaseException
     ) -> None:
@@ -286,6 +300,12 @@ class UnrollingSinkAdapter:
 
     def __init__(self, sink) -> None:
         self._sink = sink
+
+    def metrics(self) -> dict[str, int]:
+        """Pass through the wrapped sink's counters (duck-typed; the
+        telemetry collector walks one adapter layer this way)."""
+        inner = getattr(self._sink, "metrics", None)
+        return inner() if callable(inner) else {}
 
     def publish_messages(self, messages: Sequence[Message]) -> None:
         flat: list[Message] = []

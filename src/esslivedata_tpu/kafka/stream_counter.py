@@ -63,6 +63,11 @@ class StreamCounter:
         self._counts: dict[tuple[str, str], tuple[str | None, int]] = {}
         self._lag: dict[tuple[str, str, str], _LagAgg] = {}
         self._out_of_scope = {(k.topic, k.source_name) for k in out_of_scope}
+        # Cumulative per-(topic, source) totals drain() never resets:
+        # the telemetry collector (ADR 0116) exposes monotone message
+        # counters while the 30 s metrics rollover keeps its own
+        # drain-and-reset window semantics.
+        self._cum_counts: dict[tuple[str, str], int] = {}
 
     def record(self, topic: str, source_name: str, stream: str | None) -> None:
         if source_name.endswith(_IGNORED_SOURCE_SUFFIXES):
@@ -73,6 +78,12 @@ class StreamCounter:
         with self._lock:
             _, count = self._counts.get(key, (None, 0))
             self._counts[key] = (stream, count + 1)
+            self._cum_counts[key] = self._cum_counts.get(key, 0) + 1
+
+    def cumulative_counts(self) -> dict[tuple[str, str], int]:
+        """Monotone per-(topic, source) totals since construction."""
+        with self._lock:
+            return dict(self._cum_counts)
 
     def record_lag(
         self, topic: str, source_name: str, schema: str, lag_s: float

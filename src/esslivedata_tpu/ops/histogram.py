@@ -46,6 +46,8 @@ way), so the simple unsorted scatter is used.
 
 from __future__ import annotations
 
+import logging
+import time
 from typing import NamedTuple
 
 import jax
@@ -63,6 +65,8 @@ from .event_batch import (
 )
 
 __all__ = ["EventHistogrammer", "EventProjection", "HistogramState"]
+
+logger = logging.getLogger(__name__)
 
 
 class EventProjection:
@@ -932,6 +936,17 @@ class EventHistogrammer:
         else:
             stage_raw(batch, cache, batch_tag, device=device)
 
+    @property
+    def wire_format(self) -> str | None:
+        """The current partitioned-wire format: ``"compact"`` (uint16) /
+        ``"wide"`` (int32) for ``method='pallas2d'``, None for methods
+        without a partitioned wire. The compile-event instrument
+        (telemetry, ADR 0116) reads this to label a tick-program
+        recompile as a wire flip vs a layout swap."""
+        if self._method != "pallas2d":
+            return None
+        return "compact" if self._p2_compact else "wide"
+
     def set_wire_format(self, compact: bool) -> bool:
         """Runtime int32 <-> uint16 wire switch for ``method='pallas2d'``
         (ADR 0108/0111). Returns True when the format actually changed.
@@ -1109,9 +1124,12 @@ class EventHistogrammer:
             events, chunk_map = self._staged_partition(
                 batch.pixel_id, batch.toa, cache, batch_tag, device=device
             )
-            return self._step_part_fused(states, events, chunk_map)
+            return self._dispatch_fused(
+                self._step_part_fused, states, events, chunk_map
+            )
         if self.supports_host_flatten:
-            return self._step_flat_fused(
+            return self._dispatch_fused(
+                self._step_flat_fused,
                 states,
                 self._staged_flat(
                     batch.pixel_id, batch.toa, cache, batch_tag,
@@ -1119,7 +1137,48 @@ class EventHistogrammer:
                 ),
             )
         pid, toa = stage_raw(batch, cache, batch_tag, device=device)
-        return self._step_fused(states, self._proj.lut, pid, toa)
+        return self._dispatch_fused(
+            self._step_fused, states, self._proj.lut, pid, toa
+        )
+
+    def _dispatch_fused(self, fn, states, *staged):
+        """Dispatch one fused-step jit with compile-event detection
+        (telemetry, ADR 0116): a cache miss on the jitted ``fn`` — a
+        new K, a layout swap re-keying the staged wire, a link-policy
+        wire flip — records its wall time into the labeled compile
+        histogram. The probe is jax's jit cache size (guarded: absent
+        on exotic wrappers), read before and after the call; compile is
+        synchronous at first call, so the unblocked wall time is the
+        stall the serving path actually saw. NOT traced code — this is
+        the host-side dispatch wrapper (JGL018 boundary)."""
+        probe = getattr(fn, "_cache_size", None)
+        if probe is None:
+            return fn(states, *staged)
+        try:
+            before = probe()
+        except Exception:  # pragma: no cover - probe API drift
+            return fn(states, *staged)
+        t0 = time.perf_counter()
+        out = fn(states, *staged)
+        try:
+            if probe() > before:
+                from ..telemetry.compile import COMPILE_EVENTS
+
+                COMPILE_EVENTS.classify_and_record(
+                    "step_many",
+                    (id(self), len(states)),
+                    time.perf_counter() - t0,
+                    layout_digest=self.layout_digest,
+                    wire=self.wire_format,
+                    staged_sig=tuple(
+                        (tuple(getattr(a, "shape", ())),
+                         str(getattr(a, "dtype", "")))
+                        for a in staged
+                    ),
+                )
+        except Exception:  # pragma: no cover - telemetry is advisory
+            logger.debug("compile-event recording failed", exc_info=True)
+        return out
 
     # -- one-dispatch tick program (ops/tick.py, ADR 0114) -----------------
     def tick_staging(

@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import OrderedDict
 from collections.abc import Callable, Hashable, Sequence
 from dataclasses import dataclass
@@ -63,6 +64,8 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..telemetry.trace import TRACER
 
 __all__ = [
     "METRICS",
@@ -77,6 +80,7 @@ __all__ = [
     "plan_members",
     "publish_args_consumed",
     "publish_device",
+    "signature_fingerprint",
     "unpack_members",
 ]
 
@@ -191,6 +195,65 @@ class PublishMetrics:
 
 #: The process-wide publish counters (bench ``--publish``, tests).
 METRICS = PublishMetrics()
+
+
+def _publish_metric_families():
+    """Telemetry collector (ADR 0116): the publish counters — including
+    the per-slice breakdown (ADR 0115) — as scrape families. Pull-time
+    only: the hot path keeps paying exactly the one ``record`` it
+    already paid. NOTE: benches/tests ``drain()`` these around measured
+    loops, so a scrape across a drain can observe a reset; the
+    operator-facing monotone signals are the direct telemetry
+    instruments (compile events, RTT/span histograms)."""
+    from ..telemetry.registry import MetricFamily, Sample
+
+    snap = METRICS.snapshot()
+    plain = MetricFamily(
+        "livedata_publish_events",
+        "gauge",
+        "Publish-path dispatch counters since process start (or the "
+        "last explicit drain): executes/fetches are device round "
+        "trips, step_executes are SEPARATE fused-step dispatches, "
+        "tick_publishes rode the one-dispatch tick program (ADR 0114)",
+    )
+    for key in (
+        "executes",
+        "fetches",
+        "dynamic_bytes",
+        "static_bytes",
+        "combined_publishes",
+        "combined_jobs",
+        "step_executes",
+        "tick_publishes",
+        "tick_jobs",
+    ):
+        plain.samples.append(Sample("", (("kind", key),), float(snap[key])))
+    per_slice = MetricFamily(
+        "livedata_publish_slice_events",
+        "gauge",
+        "Per-mesh-slice publish dispatch counters (ADR 0115): one "
+        "execute + one fetch per slice per steady-state tick is the "
+        "serving contract",
+    )
+    per_slice.samples = [
+        Sample(
+            "",
+            (("slice", str(slice_key)), ("kind", kind)),
+            float(value),
+        )
+        for slice_key, counts in sorted(snap["slices"].items())
+        for kind, value in sorted(counts.items())
+    ]
+    return [plain, per_slice]
+
+
+def _register_telemetry() -> None:
+    from ..telemetry.registry import REGISTRY
+
+    REGISTRY.register_collector("ops.publish.METRICS", _publish_metric_families)
+
+
+_register_telemetry()
 
 
 def _unpack_segment(
@@ -582,6 +645,21 @@ def plan_members(
     return plan, planned_errors
 
 
+def signature_fingerprint(msig: tuple) -> tuple:
+    """An object-free echo of :func:`member_signature` for the
+    compile-event memory (telemetry, ADR 0116): the signature itself
+    holds live ``PackedPublisher`` references, and parking those in the
+    recorder's bounded memory (capacity 64, wider than the 16-program
+    LRUs) would pin retired publishers — and the static caches they
+    close over — long after their programs evicted. Publisher identity
+    degrades to ``id()``; the shape/dtype leaf info, static split and
+    inclusion flag carry the classification signal."""
+    return tuple(
+        (id(pub), sig[1], tuple(sorted(skeys)), include_static)
+        for pub, sig, skeys, include_static in msig
+    )
+
+
 def member_signature(plan: list[tuple]) -> tuple:
     """The jit-cache key fragment for a planned member set: publisher
     identity, args signature, static split and static inclusion per
@@ -696,8 +774,25 @@ class PublishCombiner:
             for i, err in planned_errors.items()
         }
         try:
-            packed, statics, carries = fn(*flat_args)
-            flat, static_fetched = jax.device_get((packed, statics))
+            if self.last_compiled:
+                # Compile-event instrument (ADR 0116): the miss round's
+                # wall time (trace + XLA + first execute+fetch) becomes
+                # a labeled histogram sample instead of only an
+                # RTT-estimate exclusion. Job-set changes are command-
+                # time events, so the expected trigger here is
+                # new_group/regroup; per-member signature churn (batch
+                # shape, static inclusion) classifies via residual. No
+                # execute/fetch spans on compile rounds (same rule as
+                # the tick combiner's).
+                t0 = time.perf_counter()
+                packed, statics, carries = fn(*flat_args)
+                flat, static_fetched = jax.device_get((packed, statics))
+                self._record_compile(plan, key, time.perf_counter() - t0)
+            else:
+                with TRACER.span("publish_execute"):
+                    packed, statics, carries = fn(*flat_args)
+                with TRACER.span("fetch"):
+                    flat, static_fetched = jax.device_get((packed, statics))
         except Exception as err:
             # Dispatch-level failure: per-member containment happens at
             # the caller, which needs to know whose donated state the
@@ -724,6 +819,21 @@ class PublishCombiner:
             combined_jobs=len(plan),
         )
         return [by_index[i] for i in range(len(requests))]
+
+    @staticmethod
+    def _record_compile(plan, key, seconds: float) -> None:
+        """Best-effort compile-event recording (telemetry, ADR 0116)."""
+        try:
+            from ..telemetry.compile import COMPILE_EVENTS
+
+            COMPILE_EVENTS.classify_and_record(
+                "publish",
+                tuple(id(req.publisher) for _i, req, *_ in plan),
+                seconds,
+                residual=signature_fingerprint(key),
+            )
+        except Exception:  # pragma: no cover - telemetry is advisory
+            logger.debug("compile-event recording failed", exc_info=True)
 
     @staticmethod
     def _build(

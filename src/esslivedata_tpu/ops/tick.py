@@ -57,12 +57,14 @@ member intact.
 from __future__ import annotations
 
 import logging
+import time
 from collections import OrderedDict
 from collections.abc import Callable, Sequence
 
 import jax
 import jax.numpy as jnp
 
+from ..telemetry.trace import TRACER
 from .publish import (
     METRICS,
     CombinedPublish,
@@ -71,6 +73,7 @@ from .publish import (
     member_signature,
     plan_members,
     publish_args_consumed,
+    signature_fingerprint,
     unpack_members,
 )
 
@@ -166,8 +169,35 @@ class TickCombiner:
             for i, err in planned_errors.items()
         }
         try:
-            packed, statics, carries = fn(*flat_args)
-            flat, static_fetched = jax.device_get((packed, statics))
+            if self.last_compiled:
+                # Compile-event instrument (ADR 0116): the first call of
+                # a fresh program pays trace + XLA compile + execute —
+                # the stall PERF round 7 could only EXCLUDE from RTT
+                # estimates. Time it and label WHY the key missed
+                # (layout swap / wire flip / batch shape / new group) so
+                # compile spikes decompose on the scrape. The execute is
+                # async-dispatched; the device_get inside the timed
+                # region bounds the compile+first-round wall time. No
+                # tick/fetch spans on compile rounds — they would put a
+                # compile stall in the steady-state span histograms,
+                # the exact confusion the compile instrument exists to
+                # prevent.
+                t0 = time.perf_counter()
+                packed, statics, carries = fn(*flat_args)
+                flat, static_fetched = jax.device_get((packed, statics))
+                self._record_compile(
+                    hist, group_key, key, plan, time.perf_counter() - t0
+                )
+            else:
+                # Per-tick tracer spans (ADR 0116), against the step
+                # worker's thread-bound trace id: the dispatch (host
+                # Python + async submit) and the fetch (the device
+                # round trip a steady-state tick actually waits on)
+                # decompose separately in the slow-tick breakdown.
+                with TRACER.span("tick_execute"):
+                    packed, statics, carries = fn(*flat_args)
+                with TRACER.span("fetch"):
+                    flat, static_fetched = jax.device_get((packed, statics))
         except Exception as err:
             # Dispatch-level failure: per-member containment happens at
             # the caller, which needs to know whose donated state the
@@ -198,6 +228,37 @@ class TickCombiner:
             slice_key=slice_key,
         )
         return [by_index[i] for i in range(len(requests))]
+
+    #: Compile-site label for the instrument; the mesh subclass
+    #: (parallel/mesh_tick.py) overrides to "mesh_tick".
+    compile_site = "tick"
+
+    def _record_compile(
+        self, hist, group_key, key, plan, seconds: float
+    ) -> None:
+        """Classify + record one tick-program compile (best-effort: the
+        instrument must never take a tick down)."""
+        try:
+            from ..telemetry.compile import COMPILE_EVENTS
+
+            COMPILE_EVENTS.classify_and_record(
+                self.compile_site,
+                # WHO is compiling: this histogrammer serving this
+                # publisher set. The key dimensions that churn (layout,
+                # wire, staged shape, residual key material) are passed
+                # separately for trigger classification.
+                (id(hist), tuple(id(req.publisher) for _i, req, *_ in plan)),
+                seconds,
+                layout_digest=getattr(hist, "layout_digest", None),
+                wire=getattr(hist, "wire_format", None),
+                staged_sig=key[2],
+                # Object-free residual: the raw member signature holds
+                # live publishers, which must not be pinned in the
+                # recorder's memory past their program's LRU life.
+                residual=(group_key, signature_fingerprint(key[3])),
+            )
+        except Exception:  # pragma: no cover - telemetry is advisory
+            logger.debug("compile-event recording failed", exc_info=True)
 
     def _finish_outputs(self, packed, statics):
         """Hook between the traced publish bodies and the program's

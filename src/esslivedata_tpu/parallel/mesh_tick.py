@@ -68,6 +68,11 @@ class MeshTickCombiner(TickCombiner):
     diverge in spec handling or failure semantics.
     """
 
+    #: Compile-event site label (telemetry, ADR 0116): mesh-program
+    #: compiles are the expensive tier (GSPMD partitioning on top of
+    #: XLA) and must decompose separately from single-device ticks.
+    compile_site = "mesh_tick"
+
     def __init__(self, mesh: Mesh, max_programs: int = 16) -> None:
         super().__init__(max_programs)
         self._mesh = mesh

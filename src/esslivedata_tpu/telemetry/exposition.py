@@ -1,0 +1,222 @@
+"""Prometheus text exposition: renderer + in-tree parser.
+
+The renderer turns :meth:`MetricsRegistry.collect` output into the
+text/plain;version=0.0.4 format every Prometheus-compatible scraper
+speaks. The parser exists so CI and the tests can validate a scrape
+WITHOUT adding a dependency (the container bakes no prometheus_client):
+``scripts/metrics_smoke.py`` scrapes a live service and round-trips the
+payload through :func:`parse_prometheus_text`, and the exposition tests
+assert label escaping and histogram bucket monotonicity through it.
+
+Escaping rules (the spec's): label values escape backslash, double
+quote and newline; HELP text escapes backslash and newline.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Iterable
+from dataclasses import dataclass, field
+
+from .registry import MetricFamily
+
+__all__ = [
+    "CONTENT_TYPE",
+    "ParsedMetric",
+    "parse_prometheus_text",
+    "render_text",
+]
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_LABEL_ESCAPES = {"\\": "\\\\", '"': '\\"', "\n": "\\n"}
+_HELP_ESCAPES = {"\\": "\\\\", "\n": "\\n"}
+
+
+def _escape(value: str, table: dict[str, str]) -> str:
+    return "".join(table.get(ch, ch) for ch in value)
+
+
+def _format_value(value: float) -> str:
+    # Non-finite values render as the spec's literals — int(value)
+    # would raise, and ONE inf/NaN sample must not permanently 500
+    # every later scrape.
+    if value != value:
+        return "NaN"
+    if value == float("inf"):
+        return "+Inf"
+    if value == float("-inf"):
+        return "-Inf"
+    if value == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return repr(float(value))
+
+
+def render_text(families: Iterable[MetricFamily]) -> str:
+    """Render families to the text exposition format. Families with no
+    samples still emit their HELP/TYPE header: a scrape must EXPOSE the
+    instrument (HBM gauges on a backend without memory stats, compile
+    histograms before the first compile) even when it has no series yet
+    — an absent name reads as 'not instrumented', which is wrong.
+
+    Same-named families MERGE before rendering (first kind/help wins,
+    samples concatenate): several producers legitimately emit one
+    family distinguished only by labels — two services' keyed
+    collectors both report ``livedata_pipeline_queue_depth`` with their
+    own ``service`` label — and the text format allows exactly one
+    HELP/TYPE line per metric name (real scrapers reject a duplicate
+    TYPE line outright)."""
+    merged: dict[str, MetricFamily] = {}
+    for family in families:
+        existing = merged.get(family.name)
+        if existing is None:
+            merged[family.name] = MetricFamily(
+                family.name, family.kind, family.help, list(family.samples)
+            )
+        else:
+            existing.samples.extend(family.samples)
+    lines: list[str] = []
+    for family in merged.values():
+        lines.append(
+            f"# HELP {family.name} {_escape(family.help, _HELP_ESCAPES)}"
+        )
+        lines.append(f"# TYPE {family.name} {family.kind}")
+        for sample in family.samples:
+            name = family.name + sample.suffix
+            if sample.labels:
+                rendered = ",".join(
+                    f'{key}="{_escape(value, _LABEL_ESCAPES)}"'
+                    for key, value in sample.labels
+                )
+                lines.append(f"{name}{{{rendered}}} {_format_value(sample.value)}")
+            else:
+                lines.append(f"{name} {_format_value(sample.value)}")
+    return "\n".join(lines) + "\n"
+
+
+@dataclass(slots=True)
+class ParsedMetric:
+    """One parsed family: kind, help, and every sample line."""
+
+    name: str
+    kind: str = "untyped"
+    help: str = ""
+    #: (sample name incl. suffix, labels dict, value)
+    samples: list[tuple[str, dict[str, str], float]] = field(
+        default_factory=list
+    )
+
+
+def _parse_labels(text: str, lineno: int) -> dict[str, str]:
+    labels: dict[str, str] = {}
+    i = 0
+    while i < len(text):
+        eq = text.index("=", i)
+        key = text[i:eq].strip()
+        if text[eq + 1] != '"':
+            raise ValueError(f"line {lineno}: unquoted label value")
+        j = eq + 2
+        value = []
+        while True:
+            ch = text[j]
+            if ch == "\\":
+                nxt = text[j + 1]
+                value.append(
+                    {"\\": "\\", '"': '"', "n": "\n"}.get(nxt, "\\" + nxt)
+                )
+                j += 2
+            elif ch == '"':
+                break
+            else:
+                value.append(ch)
+                j += 1
+        labels[key] = "".join(value)
+        i = j + 1
+        if i < len(text) and text[i] == ",":
+            i += 1
+    return labels
+
+
+def parse_prometheus_text(payload: str) -> dict[str, ParsedMetric]:
+    """Parse a text exposition payload; raises ValueError on malformed
+    lines and on non-monotone histogram buckets — the validation CI's
+    metrics smoke and the exposition tests gate on."""
+    families: dict[str, ParsedMetric] = {}
+
+    def family_of(sample_name: str) -> ParsedMetric:
+        base = sample_name
+        for suffix in ("_bucket", "_sum", "_count", "_total"):
+            if base.endswith(suffix) and base[: -len(suffix)] in families:
+                base = base[: -len(suffix)]
+                break
+        return families.setdefault(base, ParsedMetric(name=base))
+
+    for lineno, raw in enumerate(payload.splitlines(), 1):
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            families.setdefault(name, ParsedMetric(name=name)).help = (
+                help_text.replace("\\n", "\n").replace("\\\\", "\\")
+            )
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            families.setdefault(name, ParsedMetric(name=name)).kind = kind
+            continue
+        if line.startswith("#"):
+            continue
+        if "{" in line:
+            name = line[: line.index("{")]
+            close = line.rindex("}")
+            labels = _parse_labels(line[line.index("{") + 1 : close], lineno)
+            value_text = line[close + 1 :].strip()
+        else:
+            name, _, value_text = line.partition(" ")
+            labels = {}
+        try:
+            value = float(value_text)
+        except ValueError as err:
+            raise ValueError(
+                f"line {lineno}: bad sample value {value_text!r}"
+            ) from err
+        family_of(name).samples.append((name, labels, value))
+
+    _validate_histograms(families)
+    return families
+
+
+def _validate_histograms(families: dict[str, ParsedMetric]) -> None:
+    for family in families.values():
+        if family.kind != "histogram":
+            continue
+        # Bucket series per non-le labelset must be cumulative
+        # (monotone non-decreasing in le) and end at +Inf == _count.
+        series: dict[tuple, list[tuple[float, float]]] = {}
+        counts: dict[tuple, float] = {}
+        for name, labels, value in family.samples:
+            key = tuple(
+                sorted((k, v) for k, v in labels.items() if k != "le")
+            )
+            if name.endswith("_bucket"):
+                le = labels.get("le", "")
+                bound = float("inf") if le == "+Inf" else float(le)
+                series.setdefault(key, []).append((bound, value))
+            elif name.endswith("_count"):
+                counts[key] = value
+        for key, points in series.items():
+            points.sort(key=lambda p: p[0])
+            values = [v for _, v in points]
+            for earlier, later in zip(values, values[1:], strict=False):
+                if later < earlier:
+                    raise ValueError(
+                        f"{family.name}{dict(key)}: non-monotone buckets"
+                    )
+            if points and points[-1][0] != float("inf"):
+                raise ValueError(f"{family.name}: missing +Inf bucket")
+            if key in counts and points and points[-1][1] != counts[key]:
+                raise ValueError(
+                    f"{family.name}: +Inf bucket != _count"
+                )

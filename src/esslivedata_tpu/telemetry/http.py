@@ -1,0 +1,109 @@
+"""The /metrics plane: a stdlib HTTP endpoint for scrapes + liveness.
+
+Every service runner grows ``--metrics-port`` / ``LIVEDATA_METRICS_PORT``
+(core/service.py ``setup_arg_parser``); when set, a
+:class:`MetricsServer` serves
+
+- ``GET /metrics`` — the process registry rendered in Prometheus text
+  exposition format (telemetry/exposition.py);
+- ``GET /healthz`` — ``200 {"status": "ok"}`` liveness (a supervisor's
+  restart probe; readiness semantics stay with the x5f2 status
+  heartbeats, which carry the real job/source health).
+
+stdlib only (``http.server`` ThreadingHTTPServer on a daemon thread):
+the container bakes no prometheus_client, and a scrape every 15 s is
+far below any load that would justify one. The server binds once per
+process — a second start on the same port raises loudly at startup
+(a deployment error), never mid-serve.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .exposition import CONTENT_TYPE, render_text
+from .registry import REGISTRY, MetricsRegistry
+
+__all__ = ["MetricsServer", "start_metrics_server"]
+
+logger = logging.getLogger(__name__)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    registry: MetricsRegistry = REGISTRY
+
+    def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            try:
+                payload = render_text(self.registry.collect()).encode()
+            except Exception:
+                logger.exception("metrics render failed")
+                self.send_error(500, "metrics render failed")
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+        elif path == "/healthz":
+            payload = json.dumps({"status": "ok"}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+        else:
+            self.send_error(404, "unknown path (try /metrics or /healthz)")
+
+    def log_message(self, format: str, *args) -> None:  # noqa: A002
+        # Scrapes every few seconds must not spam the service log.
+        logger.debug("metrics http: " + format, *args)
+
+
+class MetricsServer:
+    """ThreadingHTTPServer on a daemon thread; ``close()`` joins it."""
+
+    def __init__(
+        self,
+        port: int,
+        *,
+        host: str = "0.0.0.0",
+        registry: MetricsRegistry = REGISTRY,
+    ) -> None:
+        handler = type("_BoundHandler", (_Handler,), {"registry": registry})
+        self._server = ThreadingHTTPServer((host, port), handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"metrics-http-{port}",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info("metrics endpoint on %s:%d (/metrics, /healthz)", host, self.port)
+
+    @property
+    def port(self) -> int:
+        """The bound port (port 0 requests an ephemeral one — tests)."""
+        return self._server.server_address[1]
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+
+
+def start_metrics_server(
+    port: int | None, *, registry: MetricsRegistry = REGISTRY
+) -> MetricsServer | None:
+    """Start the plane when a port is configured; None otherwise.
+
+    A bind failure raises: an operator who asked for a metrics port
+    must not silently run blind (the same loud-failure rule as a bad
+    --mesh spec)."""
+    if port is None:
+        return None
+    return MetricsServer(int(port), registry=registry)

@@ -1,0 +1,28 @@
+"""Shared serving-path instruments, registered at import.
+
+Instruments defined here exist on the FIRST scrape of any process that
+imports telemetry at all — not only once their producer module happens
+to load. The concrete case: ``livedata_publish_rtt_seconds`` is
+recorded by ``core/link_monitor.py``, which only a pipelined service
+imports; a serial service must still EXPOSE the family (an absent name
+reads as 'not instrumented', the wrong answer) with zero samples.
+Span and compile-event instruments live with their single producers
+(telemetry/trace.py, telemetry/compile.py), which this package's
+``__init__`` imports for the same always-registered guarantee.
+"""
+
+from __future__ import annotations
+
+from .registry import REGISTRY
+
+__all__ = ["PUBLISH_RTT_SECONDS"]
+
+#: Publish/tick device round-trip wall times as a labeled histogram
+#: (ADR 0116): the EWMA drives the link policy, but a scrape needs the
+#: DISTRIBUTION — a bimodal RTT (healthy ticks + relay stalls) averages
+#: into a lie. ``slice`` carries the mesh slice (ADR 0115) or "all".
+PUBLISH_RTT_SECONDS = REGISTRY.histogram(
+    "livedata_publish_rtt_seconds",
+    "Publish/tick device round-trip wall time (compile rounds excluded)",
+    labelnames=("slice",),
+)

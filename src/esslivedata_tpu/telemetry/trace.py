@@ -1,0 +1,284 @@
+"""Per-tick tracer: trace ids, spans, Chrome trace_event export, watchdog.
+
+The 30 s metrics line says WHAT is slow on average; it cannot say what
+happened inside the one tick that blew the p99. This module closes that
+gap (ADR 0116): every ingest window gets a **trace id** when it is
+decoded, and each phase of its life — decode | prestage | tick-execute |
+fetch | finalize | sink — records a span ``(trace_id, name, start,
+duration, thread)`` into a bounded ring buffer. Correlation is the whole
+point: the spans of one window share its id across the three pipeline
+workers and the job threads, so a slow tick decomposes into which phase
+ate the time.
+
+Three consumers:
+
+- ``--trace-dump PATH`` on every service runner writes the ring as
+  Chrome ``trace_event`` JSON (chrome://tracing / Perfetto loadable) at
+  exit; tests and operators can also call :meth:`TickTracer.dump` live.
+- The **slow-tick watchdog**: :meth:`TickTracer.finish_tick` checks the
+  window's wall time against a latched threshold and logs the full span
+  breakdown of the offending tick — the threshold latches onto the
+  triggering duration and decays back toward the configured floor
+  (``LIVEDATA_SLOW_TICK_MS``, default 250), so a persistently slow
+  phase logs once per regime shift instead of once per tick.
+- Span durations feed the ``livedata_tick_span_seconds`` histogram in
+  the metrics registry, so the scrape carries the same decomposition
+  in aggregate.
+
+Hot-path cost: an enabled span is two ``perf_counter`` calls, one
+histogram observe and one deque append under the ring lock; a disabled
+tracer (``LIVEDATA_TRACE=0``) costs one attribute read. Span recording
+must NEVER run inside jit-traced code — it would measure trace time,
+not execution (graftlint JGL018 polices this).
+
+Thread the ACTIVE id, don't pass it: stages run on different workers,
+and the device layers (``ops/tick.py``) don't know the window. The
+step worker calls :meth:`set_current` before ``process_jobs``; anything
+downstream records against :meth:`current` via thread-local storage.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+from .registry import REGISTRY
+
+__all__ = ["TRACER", "Span", "TickTracer"]
+
+logger = logging.getLogger(__name__)
+
+#: Aggregate span-duration decomposition on the scrape; buckets from
+#: sub-ms host phases up through relay-RTT-dominated device ticks.
+_SPAN_SECONDS = REGISTRY.histogram(
+    "livedata_tick_span_seconds",
+    "Duration of per-tick phases (decode/prestage/tick_execute/fetch/"
+    "finalize/sink), labeled by span name",
+    labelnames=("span",),
+)
+
+
+@dataclass(frozen=True, slots=True)
+class Span:
+    """One recorded phase of one traced window."""
+
+    trace_id: int
+    name: str
+    start_s: float  # perf_counter timebase
+    duration_s: float
+    thread: str
+
+
+class TickTracer:
+    """Bounded ring of spans + trace-id allocation + slow-tick watchdog.
+
+    ``capacity`` bounds memory for long-running services: at the 14 Hz
+    pulse cadence and ~6 spans per window the default 8192 spans hold
+    the last ~90 s — enough to dump the context around any slow tick
+    the watchdog just logged.
+    """
+
+    def __init__(
+        self,
+        capacity: int = 8192,
+        *,
+        enabled: bool | None = None,
+        slow_tick_s: float | None = None,
+    ) -> None:
+        if enabled is None:
+            enabled = os.environ.get("LIVEDATA_TRACE", "1").lower() not in (
+                "0",
+                "false",
+                "no",
+            )
+        if slow_tick_s is None:
+            slow_tick_s = (
+                float(os.environ.get("LIVEDATA_SLOW_TICK_MS", "250")) / 1e3
+            )
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._spans: deque[Span] = deque(maxlen=int(capacity))
+        self._next_id = 1
+        self._local = threading.local()
+        #: Watchdog latch: starts at the configured floor; a triggering
+        #: tick raises it to the observed duration (so a sustained
+        #: regime logs once, not every tick) and every healthy tick
+        #: decays it back toward the floor.
+        self._slow_floor_s = float(slow_tick_s)
+        self._slow_latch_s = float(slow_tick_s)
+        self._slow_ticks = 0
+
+    # -- trace ids ---------------------------------------------------------
+    def new_trace(self) -> int:
+        """Allocate the id for one window — called at decode."""
+        with self._lock:
+            trace_id = self._next_id
+            self._next_id += 1
+        return trace_id
+
+    def set_current(self, trace_id: int | None) -> None:
+        """Bind ``trace_id`` as this thread's active trace (None clears):
+        downstream layers (tick combiners, finalize) record against it
+        without knowing the window."""
+        self._local.trace_id = trace_id
+
+    def current(self) -> int | None:
+        return getattr(self._local, "trace_id", None)
+
+    @contextmanager
+    def bind(self, trace_id: int | None):
+        previous = self.current()
+        self.set_current(trace_id)
+        try:
+            yield
+        finally:
+            self.set_current(previous)
+
+    # -- spans -------------------------------------------------------------
+    def record(
+        self, name: str, start_s: float, duration_s: float,
+        trace_id: int | None = None,
+    ) -> None:
+        """Fold one externally timed span in (hot path; see module
+        docstring for cost). ``trace_id=None`` uses the thread's bound
+        trace; spans with no trace at all still aggregate into the
+        histogram but skip the ring (a ring entry without an id cannot
+        be correlated, which is the ring's only job)."""
+        if not self.enabled:
+            return
+        _SPAN_SECONDS.observe(duration_s, span=name)
+        if trace_id is None:
+            trace_id = self.current()
+        if trace_id is None:
+            return
+        span = Span(
+            trace_id=trace_id,
+            name=name,
+            start_s=start_s,
+            duration_s=duration_s,
+            thread=threading.current_thread().name,
+        )
+        with self._lock:
+            self._spans.append(span)
+
+    @contextmanager
+    def span(self, name: str, trace_id: int | None = None):
+        """Record the wrapped region as one span. Never place this
+        inside jit-traced code (JGL018): it times Python trace/dispatch,
+        not device execution."""
+        if not self.enabled:
+            yield
+            return
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(
+                name, start, time.perf_counter() - start, trace_id
+            )
+
+    # -- watchdog ----------------------------------------------------------
+    def finish_tick(self, trace_id: int, total_s: float) -> None:
+        """Window completion hook: log the span breakdown of a tick
+        whose wall time exceeds the latched threshold (see class
+        docstring for the latch/decay shape)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            threshold = self._slow_latch_s
+            if total_s > threshold:
+                self._slow_latch_s = total_s
+                self._slow_ticks += 1
+                spans = [s for s in self._spans if s.trace_id == trace_id]
+            else:
+                # Decay toward the floor so the latch re-arms once the
+                # slow regime passes.
+                self._slow_latch_s = max(
+                    self._slow_floor_s, self._slow_latch_s * 0.95
+                )
+                return
+        # SUM same-named spans: a window legitimately records several
+        # (one tick_execute/fetch pair per tick group and per mesh
+        # slice) — keeping only the last would point the operator at a
+        # fraction of the dominant phase.
+        totals: dict[str, float] = {}
+        counts: dict[str, int] = {}
+        for span in spans:
+            totals[span.name] = totals.get(span.name, 0.0) + span.duration_s
+            counts[span.name] = counts.get(span.name, 0) + 1
+        breakdown = {
+            name: (
+                round(total * 1e3, 3)
+                if counts[name] == 1
+                else f"{round(total * 1e3, 3)}ms/{counts[name]}x"
+            )
+            for name, total in totals.items()
+        }
+        logger.warning(
+            "slow tick: trace=%d wall=%.1f ms (threshold %.1f ms) "
+            "span breakdown (ms): %s",
+            trace_id,
+            total_s * 1e3,
+            threshold * 1e3,
+            breakdown or "(no spans recorded)",
+        )
+
+    @property
+    def slow_ticks(self) -> int:
+        with self._lock:
+            return self._slow_ticks
+
+    # -- export ------------------------------------------------------------
+    def spans(self, trace_id: int | None = None) -> list[Span]:
+        with self._lock:
+            return [
+                s
+                for s in self._spans
+                if trace_id is None or s.trace_id == trace_id
+            ]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def chrome_trace(self) -> dict:
+        """The ring as Chrome ``trace_event`` JSON (object format).
+
+        Complete ('X') events in microseconds; the trace id rides
+        ``pid`` so chrome://tracing groups one window's spans into one
+        row-set, with the worker thread preserved in ``tid``/args."""
+        with self._lock:
+            spans = list(self._spans)
+        return {
+            "traceEvents": [
+                {
+                    "name": span.name,
+                    "cat": "tick",
+                    "ph": "X",
+                    "ts": span.start_s * 1e6,
+                    "dur": span.duration_s * 1e6,
+                    "pid": span.trace_id,
+                    "tid": span.thread,
+                    "args": {"trace_id": span.trace_id},
+                }
+                for span in spans
+            ],
+            "displayTimeUnit": "ms",
+        }
+
+    def dump(self, path: str) -> None:
+        """Write :meth:`chrome_trace` to ``path`` (--trace-dump)."""
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(self.chrome_trace(), fh)
+        logger.info("trace dumped to %s (%d spans)", path, len(self._spans))
+
+
+#: Process-wide tracer: the service runners, pipeline and device layers
+#: all record here (LIVEDATA_TRACE=0 disables span recording globally).
+TRACER = TickTracer()

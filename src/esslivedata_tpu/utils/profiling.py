@@ -54,6 +54,13 @@ class StageTimer:
         self._total_s: dict[str, float] = defaultdict(float)
         self._count: dict[str, int] = defaultdict(int)
         self._max_s: dict[str, float] = defaultdict(float)
+        # Cumulative twins that drain() does NOT reset: the telemetry
+        # collectors (ADR 0116) need monotone busy-seconds counters —
+        # Prometheus rate() is a subtraction of successive scrapes, and
+        # a 30 s-drained total would alias with any scrape interval
+        # that is not a divisor of the metrics cadence.
+        self._cum_total_s: dict[str, float] = defaultdict(float)
+        self._cum_count: dict[str, int] = defaultdict(int)
 
     @contextmanager
     def stage(self, name: str):
@@ -72,6 +79,20 @@ class StageTimer:
             self._count[name] += 1
             if seconds > self._max_s[name]:
                 self._max_s[name] = seconds
+            self._cum_total_s[name] += seconds
+            self._cum_count[name] += 1
+
+    def cumulative(self) -> dict[str, dict[str, float]]:
+        """Per-stage {total_s, count} since construction — never reset
+        by :meth:`drain` (the telemetry collector's read)."""
+        with self._lock:
+            return {
+                name: {
+                    "total_s": self._cum_total_s[name],
+                    "count": float(self._cum_count[name]),
+                }
+                for name in self._cum_total_s
+            }
 
     def drain(self) -> dict[str, dict[str, float]]:
         """Per-stage {total_s, count, mean_ms, max_ms}; resets counters."""

@@ -142,3 +142,92 @@ class TestCrossThreadCounters:
         assert stats["bytes_observed"] == n_threads * per_thread * 1_000_000
         assert 1.0e8 <= stats["bandwidth_bps"] <= 4.0e8
         assert 0.001 <= stats["rtt_s"] <= 0.0025
+
+    def test_stats_snapshot_is_one_coherent_read(self):
+        """The stats() bugfix pin: policy fields and latch state must
+        come from ONE lock acquisition. Writers slam the bandwidth
+        estimate across the degrade/recover thresholds while readers
+        assert the pairing that is impossible under a coherent snapshot
+        to break: ``compact_wire is True`` exactly when ``degraded``
+        (policy() forces compact iff the degraded latch is set). The
+        pre-fix two-acquisition snapshot let observations land between
+        computing the policy and reading the latch, so the pairing
+        could tear."""
+        monitor = LinkMonitor()
+        stop = threading.Event()
+        torn: list[dict] = []
+
+        def writer(tid: int) -> None:
+            nbytes = 16 * MB
+            while not stop.is_set():
+                # Full block convergence at each extreme: the EWMA (and
+                # with it the latch) crosses a threshold on every block.
+                for bps in (4.0e7, 8.0e8):
+                    for _ in range(30):
+                        monitor.observe_staging(nbytes, nbytes / bps)
+
+        def reader() -> None:
+            while not stop.is_set():
+                stats = monitor.stats()
+                if stats["degraded"] != (stats["compact_wire"] is True):
+                    torn.append(stats)
+                    return
+
+        writers = [
+            threading.Thread(target=writer, args=(t,)) for t in range(4)
+        ]
+        readers = [threading.Thread(target=reader) for _ in range(3)]
+        for thread in writers + readers:
+            thread.start()
+        try:
+            deadline = threading.Event()
+            deadline.wait(1.0)
+        finally:
+            stop.set()
+        for thread in writers + readers:
+            thread.join()
+        assert not torn, f"stats snapshot tore: {torn[0]}"
+
+
+class TestPerSliceRtt:
+    def test_worst_slice_drives_coalescing(self):
+        monitor = LinkMonitor()
+        for _ in range(20):
+            monitor.observe_publish(0.01, slice_key="cpu:0")
+            monitor.observe_publish(0.2, slice_key="cpu:1")
+        assert monitor.policy().publish_coalesce > 1
+        assert monitor.rtt_s("cpu:0") < monitor.rtt_s("cpu:1")
+
+    def test_retired_slice_entry_expires(self, monkeypatch):
+        """ADR 0115's 60 s rule: a slice whose jobs stopped must stop
+        gating the policy within the TTL — its final congested estimate
+        would otherwise latch publish coalescing forever."""
+        from esslivedata_tpu.core import link_monitor as lm
+
+        now = [1000.0]
+        monkeypatch.setattr(lm.time, "monotonic", lambda: now[0])
+        monitor = LinkMonitor()
+        for _ in range(20):
+            monitor.observe_publish(0.2, slice_key="cpu:1")  # congested
+            monitor.observe_publish(0.01, slice_key="cpu:0")  # healthy
+        assert monitor.policy().publish_coalesce > 1
+        # The congested slice retires; the healthy one keeps reporting.
+        now[0] += LinkMonitor._SLICE_TTL_S / 2
+        monitor.observe_publish(0.01, slice_key="cpu:0")
+        assert monitor.policy().publish_coalesce > 1  # cpu:1 still live
+        now[0] += LinkMonitor._SLICE_TTL_S / 2 + 1.0
+        monitor.observe_publish(0.01, slice_key="cpu:0")
+        # cpu:1's entry is past the TTL: pruned from the policy read AND
+        # from later snapshots; the latch releases on the healthy RTT.
+        for _ in range(20):
+            monitor.observe_publish(0.01, slice_key="cpu:0")
+        policy = monitor.policy()
+        assert policy.publish_coalesce == 1
+        assert "cpu:1" not in monitor.stats()["rtt_by_slice"]
+
+    def test_sliceless_samples_keep_global_estimate(self):
+        monitor = LinkMonitor()
+        for _ in range(10):
+            monitor.observe_publish(0.02)
+        assert monitor.rtt_s() is not None
+        assert monitor.stats()["rtt_by_slice"] == {}

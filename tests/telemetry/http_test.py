@@ -1,0 +1,128 @@
+"""The /metrics plane: scrape + liveness over real HTTP, validated with
+the in-tree promtext parser (what CI's metrics smoke runs against a
+live service)."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from esslivedata_tpu.telemetry import (
+    MetricsRegistry,
+    MetricsServer,
+    parse_prometheus_text,
+    start_metrics_server,
+)
+
+
+@pytest.fixture()
+def server():
+    registry = MetricsRegistry()
+    c = registry.counter("livedata_test_ticks", "ticks", labelnames=("site",))
+    c.inc(3, site="tick")
+    srv = MetricsServer(0, host="127.0.0.1", registry=registry)
+    try:
+        yield srv
+    finally:
+        srv.close()
+
+
+def fetch(server: MetricsServer, path: str):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}{path}", timeout=5
+    )
+
+
+class TestMetricsPlane:
+    def test_metrics_scrape_parses(self, server):
+        response = fetch(server, "/metrics")
+        assert response.status == 200
+        assert response.headers["Content-Type"].startswith("text/plain")
+        parsed = parse_prometheus_text(response.read().decode())
+        family = parsed["livedata_test_ticks"]
+        assert family.kind == "counter"
+        assert family.samples == [
+            ("livedata_test_ticks_total", {"site": "tick"}, 3.0)
+        ]
+
+    def test_healthz(self, server):
+        response = fetch(server, "/healthz")
+        assert response.status == 200
+        assert json.loads(response.read()) == {"status": "ok"}
+
+    def test_unknown_path_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            fetch(server, "/nope")
+        assert err.value.code == 404
+
+    def test_start_metrics_server_none_port_is_noop(self):
+        assert start_metrics_server(None) is None
+
+    def test_concurrent_scrapes(self, server):
+        import threading
+
+        payloads = []
+        lock = threading.Lock()
+
+        def scrape():
+            body = fetch(server, "/metrics").read().decode()
+            with lock:
+                payloads.append(body)
+
+        threads = [threading.Thread(target=scrape) for _ in range(8)]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join()
+        assert len(payloads) == 8
+        for body in payloads:
+            parse_prometheus_text(body)
+
+
+class TestServiceRunnerFlag:
+    def test_setup_arg_parser_starts_endpoint_on_metrics_port(self):
+        """--metrics-port 0 on the shared parser (every service runner's
+        surface) must bring up a live /metrics + /healthz endpoint."""
+        from esslivedata_tpu.core import service as service_mod
+
+        parser = service_mod.setup_arg_parser("test")
+        parser.parse_args(["--metrics-port", "0"])
+        # The table keys by REQUESTED port (0 = ephemeral ask); the
+        # bound port lives on the server. A second parse with the same
+        # request must REUSE the listener, not leak another one.
+        server = service_mod._metrics_servers.get(0)
+        assert server is not None, "no metrics server started"
+        parser.parse_args(["--metrics-port", "0"])
+        assert service_mod._metrics_servers[0] is server
+        port = server.port
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        parse_prometheus_text(body)
+        health = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5
+            ).read()
+        )
+        assert health == {"status": "ok"}
+        service_mod._metrics_servers.pop(0)
+        server.close()
+
+    def test_trace_dump_flag_registers_exit_dump(self, tmp_path):
+        from esslivedata_tpu.core import service as service_mod
+        from esslivedata_tpu.telemetry import TRACER
+
+        path = tmp_path / "trace.json"
+        parser = service_mod.setup_arg_parser("test")
+        parser.parse_args(["--trace-dump", str(path)])
+        assert str(path) in service_mod._trace_dump_paths
+        # The atexit hook is registered; dump directly to verify the
+        # ring serializes (exit-time behavior minus the interpreter
+        # teardown).
+        TRACER.dump(str(path))
+        doc = json.loads(path.read_text())
+        assert "traceEvents" in doc
+        service_mod._trace_dump_paths.discard(str(path))

@@ -1,0 +1,314 @@
+"""Telemetry registry + exposition: instrument semantics, label
+escaping, histogram bucket monotonicity — including under concurrent
+writers — and the collector contract (keyed replacement, failure
+containment). The in-tree promtext parser is both the test oracle here
+and what CI's metrics smoke validates a live scrape with."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from esslivedata_tpu.telemetry import (
+    MetricsRegistry,
+    parse_prometheus_text,
+    render_text,
+)
+from esslivedata_tpu.telemetry.registry import MetricFamily, Sample
+
+
+class TestInstruments:
+    def test_counter_labels_and_totals(self):
+        reg = MetricsRegistry()
+        c = reg.counter("ticks", "t", labelnames=("site",))
+        c.inc(site="a")
+        c.inc(2, site="b")
+        child = c.labels(site="a")
+        child.inc()
+        assert c.value(site="a") == 2
+        assert c.total() == 4
+
+    def test_counter_rejects_negative_and_label_mismatch(self):
+        reg = MetricsRegistry()
+        c = reg.counter("ticks", "t", labelnames=("site",))
+        with pytest.raises(ValueError):
+            c.inc(-1, site="a")
+        with pytest.raises(ValueError):
+            c.inc(wrong="a")
+        # The hot-path bound child enforces monotonicity too — a
+        # negative delta must never silently decrease the series.
+        with pytest.raises(ValueError):
+            c.labels(site="a").inc(-1)
+
+    def test_counter_named_total_does_not_double_suffix(self):
+        """A counter whose NAME already carries the conventional
+        ``_total`` (livedata_jit_compiles_total) must expose that exact
+        series — a naive suffix append would publish ``..._total_total``
+        and every documented query would return no data."""
+        reg = MetricsRegistry()
+        c = reg.counter("compiles_total", "compiles", labelnames=("site",))
+        c.inc(site="tick")
+        text = render_text(reg.collect())
+        assert "compiles_total{" in text
+        assert "compiles_total_total" not in text
+        parsed = parse_prometheus_text(text)
+        assert parsed["compiles_total"].samples == [
+            ("compiles_total", {"site": "tick"}, 1.0)
+        ]
+
+    def test_get_or_create_is_idempotent_and_type_checked(self):
+        reg = MetricsRegistry()
+        a = reg.counter("ticks", "t", labelnames=("site",))
+        assert reg.counter("ticks", "t", labelnames=("site",)) is a
+        with pytest.raises(TypeError):
+            reg.gauge("ticks", "t")
+        with pytest.raises(TypeError):
+            reg.counter("ticks", "t", labelnames=("other",))
+
+    def test_histogram_buckets_fixed_and_validated(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            reg.histogram("h", "h", buckets=(0.1, 0.1))
+        with pytest.raises(ValueError):
+            reg.histogram("h2", "h", buckets=(0.2, 0.1))
+        # Bucket layout is part of the wire contract: a re-registration
+        # asking for a DIFFERENT layout fails loudly instead of
+        # silently observing into the first caller's buckets.
+        reg.histogram("h4", "h", buckets=(0.01, 0.1))
+        with pytest.raises(TypeError):
+            reg.histogram("h4", "h", buckets=(1.0, 5.0))
+        assert reg.histogram("h4", "h", buckets=(0.01, 0.1)) is not None
+        h = reg.histogram("h3", "h", buckets=(0.01, 0.1, 1.0))
+        h.observe(0.005)
+        h.observe(0.05)
+        h.observe(50.0)  # above every bound -> +Inf only
+        assert h.count() == 3
+        assert h.sum() == pytest.approx(50.055)
+
+    def test_gauge_set_inc_dec(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("depth", "d", labelnames=("stage",))
+        g.set(3, stage="decode")
+        g.inc(stage="decode")
+        g.dec(2, stage="decode")
+        assert g.value(stage="decode") == 2
+
+
+class TestExposition:
+    def test_render_parse_roundtrip_with_hostile_labels(self):
+        reg = MetricsRegistry()
+        c = reg.counter("msgs", "messages", labelnames=("src",))
+        hostile = 'quote:" backslash:\\ newline:\nend'
+        c.inc(3, src=hostile)
+        text = render_text(reg.collect())
+        parsed = parse_prometheus_text(text)
+        samples = parsed["msgs"].samples
+        assert any(
+            labels.get("src") == hostile and value == 3
+            for _name, labels, value in samples
+        )
+
+    def test_histogram_exposition_is_cumulative_and_closed(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", "latency", buckets=(0.01, 0.1))
+        for v in (0.005, 0.005, 0.05, 5.0):
+            h.observe(v)
+        text = render_text(reg.collect())
+        parsed = parse_prometheus_text(text)  # validates monotonicity
+        rows = {
+            labels["le"]: value
+            for name, labels, value in parsed["lat"].samples
+            if name.endswith("_bucket")
+        }
+        assert rows == {"0.01": 2, "0.1": 3, "+Inf": 4}
+        counts = [
+            value
+            for name, _labels, value in parsed["lat"].samples
+            if name.endswith("_count")
+        ]
+        assert counts == [4]
+
+    def test_non_finite_values_render_as_spec_literals(self):
+        """One inf/NaN sample must render ('+Inf'/'-Inf'/'NaN'), never
+        raise — a crash here would 500 every later /metrics scrape."""
+        reg = MetricsRegistry()
+        g = reg.gauge("edges", "edge values", labelnames=("kind",))
+        g.set(float("inf"), kind="pos")
+        g.set(float("-inf"), kind="neg")
+        g.set(float("nan"), kind="nan")
+        text = render_text(reg.collect())
+        assert 'edges{kind="pos"} +Inf' in text
+        assert 'edges{kind="neg"} -Inf' in text
+        assert 'edges{kind="nan"} NaN' in text
+        parsed = parse_prometheus_text(text)
+        values = {
+            labels["kind"]: value
+            for _n, labels, value in parsed["edges"].samples
+        }
+        assert values["pos"] == float("inf")
+        assert values["nan"] != values["nan"]  # NaN round-trips
+
+    def test_empty_family_still_exposes_header(self):
+        reg = MetricsRegistry()
+        reg.gauge("hbm_bytes", "per-device HBM")
+        text = render_text(reg.collect())
+        assert "# HELP hbm_bytes per-device HBM" in text
+        assert "# TYPE hbm_bytes gauge" in text
+        assert "hbm_bytes" in parse_prometheus_text(text)
+
+    def test_same_named_families_merge_into_one_header(self):
+        """Two keyed collectors legitimately emit ONE family split only
+        by labels (two services' pipeline depths); the text format
+        allows exactly one HELP/TYPE line per name — real scrapers
+        reject a duplicate TYPE line, so render_text must merge."""
+        reg = MetricsRegistry()
+        for service in ("det", "mon"):
+            reg.register_collector(
+                f"svc:{service}",
+                lambda service=service: [
+                    MetricFamily(
+                        "queue_depth",
+                        "gauge",
+                        "queued windows",
+                        [Sample("", (("service", service),), 2.0)],
+                    )
+                ],
+            )
+        text = render_text(reg.collect())
+        assert text.count("# TYPE queue_depth gauge") == 1
+        parsed = parse_prometheus_text(text)
+        services = {
+            labels["service"]
+            for _n, labels, _v in parsed["queue_depth"].samples
+        }
+        assert services == {"det", "mon"}
+
+    def test_parser_rejects_non_monotone_buckets(self):
+        bad = (
+            "# TYPE lat histogram\n"
+            'lat_bucket{le="0.01"} 5\n'
+            'lat_bucket{le="0.1"} 3\n'
+            'lat_bucket{le="+Inf"} 5\n'
+            "lat_count 5\n"
+        )
+        with pytest.raises(ValueError, match="non-monotone"):
+            parse_prometheus_text(bad)
+
+    def test_parser_rejects_missing_inf_bucket(self):
+        bad = (
+            "# TYPE lat histogram\n"
+            'lat_bucket{le="0.01"} 5\n'
+        )
+        with pytest.raises(ValueError, match=r"\+Inf"):
+            parse_prometheus_text(bad)
+
+    def test_exposition_correct_under_concurrent_writers(self):
+        """The satellite pin: scrapes racing hot-path writers must
+        always render a PARSEABLE, internally consistent payload —
+        cumulative buckets monotone, +Inf == _count per labelset —
+        never a torn histogram row."""
+        reg = MetricsRegistry()
+        h = reg.histogram(
+            "lat", "latency", labelnames=("site",), buckets=(0.001, 0.01, 0.1)
+        )
+        c = reg.counter("ops", "ops", labelnames=("site",))
+        stop = threading.Event()
+
+        def writer(site: str) -> None:
+            child_h = h.labels(site=site)
+            child_c = c.labels(site=site)
+            i = 0
+            while not stop.is_set():
+                child_h.observe((i % 7) * 0.003)
+                child_c.inc()
+                i += 1
+
+        writers = [
+            threading.Thread(target=writer, args=(s,))
+            for s in ("tick", "publish", 'odd"site\n')
+        ]
+        for thread in writers:
+            thread.start()
+        failures = []
+        try:
+            for _ in range(200):
+                text = render_text(reg.collect())
+                try:
+                    parse_prometheus_text(text)  # monotone + closed
+                except ValueError as err:
+                    failures.append(str(err))
+                    break
+        finally:
+            stop.set()
+            for thread in writers:
+                thread.join()
+        assert not failures, failures
+        # Final state is quiescent: +Inf == count for every labelset.
+        parsed = parse_prometheus_text(render_text(reg.collect()))
+        for site in ("tick", "publish"):
+            assert h.count(site=site) > 0
+        assert parsed["ops"].kind == "counter"
+
+
+class TestCollectors:
+    def test_keyed_registration_replaces(self):
+        reg = MetricsRegistry()
+        reg.register_collector(
+            "svc", lambda: [MetricFamily("a", "gauge", "a")]
+        )
+        reg.register_collector(
+            "svc", lambda: [MetricFamily("b", "gauge", "b")]
+        )
+        names = [f.name for f in reg.collect()]
+        assert "b" in names and "a" not in names
+        reg.unregister_collector("svc")
+        assert [f.name for f in reg.collect()] == []
+
+    def test_owner_guarded_unregister_spares_the_successor(self):
+        """A predecessor's late shutdown must not delete the collector
+        that REPLACED its registration under the same key."""
+        reg = MetricsRegistry()
+
+        class Producer:
+            def __init__(self, name):
+                self.name = name
+
+            def families(self):
+                return [MetricFamily(self.name, "gauge", self.name)]
+
+        a, b = Producer("a"), Producer("b")
+        reg.register_collector("svc", a.families)
+        reg.register_collector("svc", b.families)  # replacement
+        reg.unregister_collector("svc", a.families)  # late A shutdown
+        assert [f.name for f in reg.collect()] == ["b"]
+        reg.unregister_collector("svc", b.families)
+        assert reg.collect() == []
+
+    def test_failing_collector_contained(self):
+        reg = MetricsRegistry()
+
+        def boom():
+            raise RuntimeError("dead producer")
+
+        reg.register_collector("bad", boom)
+        reg.register_collector(
+            "good",
+            lambda: [
+                MetricFamily(
+                    "ok", "gauge", "ok", [Sample("", (), 1.0)]
+                )
+            ],
+        )
+        families = reg.collect()
+        assert [f.name for f in families] == ["ok"]
+
+    def test_snapshot_compact_drops_buckets(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", "l", buckets=(0.1,))
+        h.observe(0.05)
+        full = reg.snapshot()
+        compact = reg.snapshot(compact=True)
+        assert any(k.startswith("_bucket") for k in full["lat"])
+        assert not any(k.startswith("_bucket") for k in compact["lat"])
+        assert compact["lat"]["_count"] == 1

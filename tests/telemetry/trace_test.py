@@ -1,0 +1,204 @@
+"""TickTracer: trace-id lifecycle, cross-thread span correlation,
+Chrome trace_event export, ring bound, slow-tick watchdog latch, and
+the compile-event recorder's trigger classification."""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from esslivedata_tpu.telemetry import CompileEventRecorder, TickTracer
+
+
+def make_tracer(**kwargs) -> TickTracer:
+    kwargs.setdefault("enabled", True)
+    kwargs.setdefault("slow_tick_s", 0.25)
+    return TickTracer(**kwargs)
+
+
+class TestSpans:
+    def test_spans_share_the_window_trace_id_across_threads(self):
+        """The correlation contract: decode on one worker, tick/fetch
+        on another, all against the id allocated at decode."""
+        tracer = make_tracer()
+        trace_id = tracer.new_trace()
+        with tracer.span("decode", trace_id):
+            pass
+
+        def step_worker() -> None:
+            with tracer.bind(trace_id):
+                with tracer.span("tick_execute"):
+                    pass
+                with tracer.span("fetch"):
+                    pass
+
+        thread = threading.Thread(target=step_worker)
+        thread.start()
+        thread.join()
+        spans = tracer.spans(trace_id)
+        assert [s.name for s in spans] == ["decode", "tick_execute", "fetch"]
+        assert {s.trace_id for s in spans} == {trace_id}
+        assert len({s.thread for s in spans}) == 2
+
+    def test_bind_restores_previous_trace(self):
+        tracer = make_tracer()
+        outer, inner = tracer.new_trace(), tracer.new_trace()
+        tracer.set_current(outer)
+        with tracer.bind(inner):
+            assert tracer.current() == inner
+        assert tracer.current() == outer
+
+    def test_disabled_tracer_records_nothing(self):
+        tracer = TickTracer(enabled=False)
+        trace_id = tracer.new_trace()
+        with tracer.span("decode", trace_id):
+            pass
+        tracer.record("fetch", 0.0, 1.0, trace_id)
+        assert tracer.spans() == []
+        tracer.finish_tick(trace_id, 100.0)
+        assert tracer.slow_ticks == 0
+
+    def test_ring_is_bounded(self):
+        tracer = make_tracer(capacity=8)
+        trace_id = tracer.new_trace()
+        for i in range(100):
+            tracer.record(f"s{i}", 0.0, 0.001, trace_id)
+        spans = tracer.spans()
+        assert len(spans) == 8
+        assert spans[-1].name == "s99"
+
+    def test_untraced_span_skips_ring(self):
+        tracer = make_tracer()
+        tracer.set_current(None)
+        with tracer.span("decode"):
+            pass
+        assert tracer.spans() == []
+
+
+class TestChromeExport:
+    def test_chrome_trace_loads_and_groups_by_trace_id(self, tmp_path):
+        tracer = make_tracer()
+        t1, t2 = tracer.new_trace(), tracer.new_trace()
+        for trace_id in (t1, t2):
+            for name in ("decode", "prestage", "tick_execute", "fetch"):
+                tracer.record(name, 0.001, 0.002, trace_id)
+        path = tmp_path / "trace.json"
+        tracer.dump(str(path))
+        doc = json.loads(path.read_text())
+        events = doc["traceEvents"]
+        assert len(events) == 8
+        # Chrome trace_event contract: complete events with microsecond
+        # timestamps, one pid per window so the viewer groups spans.
+        for event in events:
+            assert event["ph"] == "X"
+            assert event["dur"] == 2000.0
+            assert event["pid"] in (t1, t2)
+        names_t1 = [e["name"] for e in events if e["pid"] == t1]
+        assert names_t1 == ["decode", "prestage", "tick_execute", "fetch"]
+
+
+class TestWatchdog:
+    def test_slow_tick_logs_breakdown_and_latches(self, caplog):
+        tracer = make_tracer(slow_tick_s=0.1)
+        trace_id = tracer.new_trace()
+        tracer.record("fetch", 0.0, 0.19, trace_id)
+        with caplog.at_level("WARNING", logger="esslivedata_tpu.telemetry.trace"):
+            tracer.finish_tick(trace_id, 0.2)
+        assert tracer.slow_ticks == 1
+        assert "slow tick" in caplog.text
+        assert "fetch" in caplog.text
+        # Latched onto the triggering duration: an equally slow tick
+        # does NOT re-log; a slower one does.
+        tracer.finish_tick(tracer.new_trace(), 0.2)
+        assert tracer.slow_ticks == 1
+        tracer.finish_tick(tracer.new_trace(), 0.5)
+        assert tracer.slow_ticks == 2
+
+    def test_breakdown_sums_repeated_span_names(self, caplog):
+        """A window records one tick_execute/fetch pair PER tick group
+        (and per mesh slice): the watchdog breakdown must aggregate
+        them, not keep only the last — otherwise a tick dominated by
+        four 50 ms fetches logs 'fetch: 50'."""
+        tracer = make_tracer(slow_tick_s=0.1)
+        trace_id = tracer.new_trace()
+        for _ in range(4):
+            tracer.record("fetch", 0.0, 0.05, trace_id)
+        with caplog.at_level(
+            "WARNING", logger="esslivedata_tpu.telemetry.trace"
+        ):
+            tracer.finish_tick(trace_id, 0.21)
+        assert "200.0ms/4x" in caplog.text
+
+    def test_latch_decays_back_toward_floor(self):
+        tracer = make_tracer(slow_tick_s=0.1)
+        tracer.finish_tick(tracer.new_trace(), 10.0)
+        assert tracer.slow_ticks == 1
+        # Healthy ticks decay the latch (0.95^n); after enough of them
+        # a 0.2 s tick trips again even though 10 s once latched.
+        for _ in range(200):
+            tracer.finish_tick(tracer.new_trace(), 0.01)
+        tracer.finish_tick(tracer.new_trace(), 0.2)
+        assert tracer.slow_ticks == 2
+
+
+class TestCompileClassification:
+    def test_trigger_taxonomy(self):
+        rec = CompileEventRecorder()
+        group = ("hist", ("pub",))
+        base = dict(layout_digest="d1", wire="wide", staged_sig="s1")
+        assert rec.classify("tick", group, **base) == "new_group"
+        assert (
+            rec.classify("tick", group, **{**base, "layout_digest": "d2"})
+            == "layout_swap"
+        )
+        assert (
+            rec.classify(
+                "tick",
+                group,
+                **{**base, "layout_digest": "d2", "wire": "compact"},
+            )
+            == "wire_flip"
+        )
+        assert (
+            rec.classify(
+                "tick",
+                group,
+                layout_digest="d2",
+                wire="compact",
+                staged_sig="s2",
+            )
+            == "batch_shape"
+        )
+        assert (
+            rec.classify(
+                "tick",
+                group,
+                layout_digest="d2",
+                wire="compact",
+                staged_sig="s2",
+                residual="tag-b",
+            )
+            == "regroup"
+        )
+        # Byte-identical key missing anyway = LRU eviction recompile.
+        assert (
+            rec.classify(
+                "tick",
+                group,
+                layout_digest="d2",
+                wire="compact",
+                staged_sig="s2",
+                residual="tag-b",
+            )
+            == "evicted"
+        )
+        # Sites are independent: the same group is new at another site.
+        assert rec.classify("publish", group, **base) == "new_group"
+
+    def test_memory_is_bounded(self):
+        rec = CompileEventRecorder()
+        for i in range(rec._MEMORY_MAX + 10):
+            rec.classify("tick", f"group-{i}")
+        assert len(rec._memory) == rec._MEMORY_MAX
+        # The evicted earliest group classifies as new again.
+        assert rec.classify("tick", "group-0") == "new_group"

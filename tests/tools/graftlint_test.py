@@ -232,6 +232,23 @@ def serve(jobs, sharded_hist, batch):
         staged = jax.device_put(batch)
         job.state = sharded_hist.step(job.state, staged, staged)
 ''',
+    # Both shapes: a host clock read and a registry increment inside a
+    # traced body — each fires once per TRACE, not per execution.
+    "JGL018": '''
+import time
+import jax
+
+from esslivedata_tpu.telemetry import REGISTRY
+
+STEPS = REGISTRY.counter("steps_total", "steps")
+
+@jax.jit
+def step(state, batch):
+    t0 = time.perf_counter()
+    state = state + batch
+    STEPS.inc()
+    return state, time.perf_counter() - t0
+''',
 }
 
 NEGATIVE = {
@@ -519,6 +536,26 @@ def serve(jobs, sharded_hist, batch, mesh):
     staged = jax.device_put(batch, sharding)
     for job in jobs:
         job.state = sharded_hist.step(job.state, staged, staged)
+''',
+    # The worked pattern: the traced body stays pure; timing and the
+    # registry record happen on the host side, around the dispatch.
+    "JGL018": '''
+import time
+import jax
+
+from esslivedata_tpu.telemetry import REGISTRY
+
+STEPS = REGISTRY.counter("steps_total", "steps")
+
+@jax.jit
+def _step_impl(state, batch):
+    return state + batch
+
+def step(state, batch):
+    t0 = time.perf_counter()
+    out = _step_impl(state, batch)
+    STEPS.inc()
+    return out, time.perf_counter() - t0
 ''',
 }
 # fmt: on

@@ -883,3 +883,100 @@ def implicit_resharding(ctx: FileContext):
                     "(stage_for / the slice-keyed stream cache, "
                     "ADR 0110/0115) before the loop",
                 )
+
+
+#: Host clock reads: under trace these run ONCE, at trace time — the
+#: jitted program replays without them, so the "measurement" is the
+#: tracer's wall clock, not the execution's.
+_TIMING_QUALNAMES = frozenset(
+    {
+        "time.perf_counter",
+        "time.perf_counter_ns",
+        "time.monotonic",
+        "time.monotonic_ns",
+        "time.time",
+        "time.time_ns",
+    }
+)
+
+#: Telemetry mutation methods; gated on a telemetry-ish receiver below
+#: (``.set`` alone is far too common to flag bare).
+_TELEMETRY_METHODS = frozenset(
+    {"inc", "dec", "observe", "record", "set", "span", "stage"}
+)
+
+#: Receiver-name tokens that mark a telemetry/timing object: the
+#: process registry's instruments and children (counter/gauge/
+#: histogram), the tick tracer, StageTimer, and the conventional
+#: METRICS/metrics singletons.
+_TELEMETRY_RECEIVER_TOKENS = frozenset(
+    {
+        "metrics",
+        "metric",
+        "counter",
+        "counters",
+        "gauge",
+        "gauges",
+        "histogram",
+        "tracer",
+        "telemetry",
+        "timer",
+        "registry",
+        "instrument",
+    }
+)
+
+
+def _telemetry_receiver(node: ast.AST) -> bool:
+    recv = _dotted(node)
+    if recv is None:
+        return False
+    tokens = set(recv.lower().replace(".", "_").split("_"))
+    return bool(tokens & _TELEMETRY_RECEIVER_TOKENS)
+
+
+@rule("JGL018", "telemetry/timing call inside jit-traced code")
+def telemetry_in_jit(ctx: FileContext):
+    """Instrumentation that never measures what it claims (ADR 0116):
+    inside a jit-traced region, ``time.perf_counter()`` (and friends)
+    executes ONCE at trace time — the compiled program replays without
+    it, so the recorded 'duration' is trace overhead on the first call
+    and a stale constant forever after. The same applies to registry
+    increments (``counter.inc``, ``histogram.observe``,
+    ``METRICS.record``) and tracer span enter/exit: they fire per
+    TRACE, not per execution, silently under-counting by the cache hit
+    rate. Time and count around the dispatch on the host side
+    (ops/tick.py's combiner, EventHistogrammer._dispatch_fused are the
+    worked examples); keep traced bodies pure."""
+    for fn in ctx.jit_regions:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            qual = ctx.qualname(node.func)
+            if qual in _TIMING_QUALNAMES:
+                yield Finding(
+                    ctx.path,
+                    node.lineno,
+                    "JGL018",
+                    f"{qual}() {_jit_label(ctx, fn)} runs at TRACE time "
+                    "only: the compiled program replays without it, so "
+                    "it measures tracing, not execution (and reads as a "
+                    "frozen constant on cache hits). Time around the "
+                    "dispatch on the host side instead",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _TELEMETRY_METHODS
+                and _telemetry_receiver(node.func.value)
+            ):
+                yield Finding(
+                    ctx.path,
+                    node.lineno,
+                    "JGL018",
+                    f"telemetry call '.{node.func.attr}()' on "
+                    f"'{_dotted(node.func.value)}' {_jit_label(ctx, fn)} "
+                    "fires once per TRACE, not per execution — counters "
+                    "silently under-count by the jit cache hit rate and "
+                    "span timings measure trace overhead. Record on the "
+                    "host side, outside the jit boundary",
+                )
