@@ -883,6 +883,260 @@ def bench_tick(args) -> dict:
     return tick
 
 
+def bench_fanout(args, n_values: tuple[int, ...] | None = None) -> dict:
+    """Result fan-out tier through the REAL JobManager + ServingPlane
+    (ADR 0117).
+
+    K=4 detector-view jobs publish every window into the broadcast hub
+    while N simulated SSE subscribers are attached — the same
+    ``BroadcastServer.subscribe`` handles the real ``/streams/...``
+    connections, minus the socket. One designated subscriber per stream
+    drains and reconstructs every tick (DeltaDecoder) and its frames
+    are asserted BYTE-IDENTICAL to the sink's da00 wire; the rest stay
+    deliberately slow, so coalesce-on-overflow engages and their queues
+    stay bounded.
+
+    Acceptance (asserted here AND in --smoke/CI): publish-side device
+    executes + fetches per tick are IDENTICAL at every N — the whole
+    point of the tier is that subscribers cost the compute loop nothing
+    — and a keeping-up subscriber's served bytes are well under the
+    full-frame replay it would have paid without delta encoding. One
+    JSON line per N plus a summary line, on stderr.
+    """
+    from esslivedata_tpu.config import JobId, WorkflowConfig, WorkflowSpec
+    from esslivedata_tpu.core.job_manager import JobFactory, JobManager
+    from esslivedata_tpu.core.timestamp import Timestamp
+    from esslivedata_tpu.kafka.da00_compat import dataarray_to_da00
+    from esslivedata_tpu.kafka.wire import encode_da00
+    from esslivedata_tpu.ops import EventBatch
+    from esslivedata_tpu.ops.publish import METRICS
+    from esslivedata_tpu.preprocessors.event_data import StagedEvents
+    from esslivedata_tpu.serving import DeltaDecoder, ServingPlane, stream_key
+    from esslivedata_tpu.serving.broadcast import (
+        SERVING_BYTES,
+        SERVING_COALESCE_DROPS,
+    )
+    from esslivedata_tpu.workflows import WorkflowFactory
+    from esslivedata_tpu.workflows.detector_view import (
+        DetectorViewParams,
+        DetectorViewWorkflow,
+        project_logical,
+    )
+
+    side = int(np.sqrt(min(args.pixels, 1 << 14)))
+    det = np.arange(side * side).reshape(side, side)
+    # Modest per-window event counts keep the rolling histograms
+    # SPARSE between ticks — the regime the delta codec exists for
+    # (and the one the beam delivers at dashboard cadence): cap at
+    # 1/8th of the bin space so the per-tick changed-bin fraction
+    # stays representative regardless of --events.
+    n_events = min(args.events, max(256, (side * side) // 8))
+    n_windows = max(8, args.batches // 4)
+    n_distinct = 4
+    k = 4
+    # Small enough that the deliberately-slow subscribers overflow
+    # even at smoke sizes (n_windows >= 8), so the coalesce-on-overflow
+    # path is ASSERTED to engage below — not merely recorded.
+    queue_limit = 4
+    if n_values is None:
+        n_values = (1, 100, 2000)
+    method = args.method if args.method in ("scatter", "sort") else "scatter"
+    batches = []
+    for s in range(500, 500 + n_distinct):
+        pid, toa = make_batch(n_events, side * side, seed=s)
+        batches.append(EventBatch.from_arrays(pid, toa))
+
+    def staged(i: int) -> StagedEvents:
+        return StagedEvents(
+            batch=batches[i % n_distinct],
+            first_timestamp=None,
+            last_timestamp=None,
+            n_chunks=1,
+        )
+
+    t0 = Timestamp.from_ns(0)
+    results_by_n = {}
+    for n_subs in n_values:
+        reg = WorkflowFactory()
+        spec = WorkflowSpec(
+            instrument="bench",
+            name=f"dv_fanout_{n_subs}",
+            source_names=["det0"],
+        )
+        reg.register_spec(spec).attach_factory(
+            lambda *, source_name, params: DetectorViewWorkflow(
+                projection=project_logical(det),
+                params=DetectorViewParams(histogram_method=method),
+            )
+        )
+        mgr = JobManager(
+            job_factory=JobFactory(reg), job_threads=min(4, k)
+        )
+        for _ in range(k):
+            mgr.schedule_job(
+                WorkflowConfig(
+                    identifier=spec.identifier,
+                    job_id=JobId(source_name="det0"),
+                )
+            )
+        plane = ServingPlane(port=None, queue_limit=queue_limit)
+        # Warm windows: publish programs compile, statics fetch once,
+        # and the hub learns every stream (so subscribers can attach).
+        for w in range(2):
+            out = mgr.process_jobs(
+                {"det0": staged(w)}, start=t0, end=Timestamp.from_ns(1 + w)
+            )
+            assert len(out) == k
+            plane.publish_results(out, Timestamp.from_ns(10 + w))
+        streams = sorted(plane.cache.streams())
+        assert streams, "no streams cached after warm windows"
+        subs = [
+            plane.server.subscribe(streams[i % len(streams)])
+            for i in range(n_subs)
+        ]
+        # One keeping-up checker per stream (subscribers beyond the
+        # stream count stay slow on purpose); drain attach keyframes.
+        checkers: dict[str, tuple] = {}
+        for sub in subs:
+            blob = sub.next_blob(timeout=1.0)
+            assert blob is not None, "attach keyframe missing"
+            if sub.stream not in checkers:
+                decoder = DeltaDecoder()
+                decoder.apply(blob)
+                checkers[sub.stream] = (sub, decoder)
+        METRICS.drain()
+        delta_bytes0 = SERVING_BYTES.value(kind="delta")
+        key_bytes0 = SERVING_BYTES.value(kind="keyframe")
+        drops0 = SERVING_COALESCE_DROPS.total()
+        checker_bytes = 0
+        full_bytes = 0
+        last_reference: dict[str, bytes] = {}
+        start = time.perf_counter()
+        for i in range(n_windows):
+            out = mgr.process_jobs(
+                {"det0": staged(i)},
+                start=t0,
+                end=Timestamp.from_ns(3 + i),
+            )
+            assert len(out) == k
+            ts = Timestamp.from_ns(100 + i)
+            plane.publish_results(out, ts)
+            # Reconstruction oracle: the sink serializer's exact bytes.
+            for res in out:
+                job = f"{res.job_id.source_name}:{res.job_id.job_number}"
+                for key, da in zip(
+                    res.keys(), res.outputs.values(), strict=True
+                ):
+                    stream = stream_key(job, key.output_name)
+                    entry = checkers.get(stream)
+                    if entry is None:
+                        continue
+                    sub, decoder = entry
+                    reference = encode_da00(
+                        key.to_string(), ts.ns, dataarray_to_da00(da)
+                    )
+                    last_reference[stream] = reference
+                    full_bytes += len(reference)
+                    got = None
+                    while (blob := sub.next_blob(timeout=1.0)) is not None:
+                        checker_bytes += len(blob)
+                        got = decoder.apply(blob)
+                        if decoder.seq is not None and got == reference:
+                            break
+                    assert got == reference, (
+                        f"window {i}: subscriber reconstruction != "
+                        f"sink da00 wire for {stream}"
+                    )
+        dt = time.perf_counter() - start
+        m = METRICS.drain()
+        slow_subs = [
+            sub
+            for sub in subs
+            if checkers.get(sub.stream, (None,))[0] is not sub
+        ]
+        if slow_subs and n_windows > queue_limit:
+            # The deliberately-slow subscribers MUST have overflowed:
+            # the coalesce path is exercised here, not just recorded.
+            assert SERVING_COALESCE_DROPS.total() > drops0, (
+                "slow subscribers never coalesced"
+            )
+            # And a coalesced subscriber recovers the exact latest
+            # frame from its resync keyframe on the next drain.
+            probe = slow_subs[0]
+            decoder = DeltaDecoder()
+            got = None
+            while (blob := probe.next_blob(timeout=1.0)) is not None:
+                got = decoder.apply(blob)
+            assert got == last_reference[probe.stream], (
+                "coalesced subscriber did not recover the latest frame"
+            )
+        qos = plane.qos()
+        drops = SERVING_COALESCE_DROPS.total() - drops0
+        delta_bytes = SERVING_BYTES.value(kind="delta") - delta_bytes0
+        key_bytes = SERVING_BYTES.value(kind="keyframe") - key_bytes0
+        mgr.shutdown()
+        plane.close()
+        line = {
+            "metric": "fanout",
+            "subscribers": n_subs,
+            "jobs": k,
+            # Graded value: publish-side device round trips per tick —
+            # must not move with N.
+            "value": (m["executes"] + m["fetches"]) / n_windows,
+            "unit": "publish_device_ops/tick",
+            "executes_per_tick": m["executes"] / n_windows,
+            "fetches_per_tick": m["fetches"] / n_windows,
+            "streams": len(streams),
+            "windows": n_windows,
+            "events_per_window": n_events,
+            "wall_ms_per_tick": 1e3 * dt / n_windows,
+            # A keeping-up subscriber's wire cost vs replaying the full
+            # frame every tick — the delta-encoding claim.
+            "served_bytes_per_checker_tick": (
+                checker_bytes / (n_windows * len(checkers))
+            ),
+            "full_frame_bytes_per_tick": (
+                full_bytes / (n_windows * len(checkers))
+            ),
+            "delta_vs_replay_ratio": checker_bytes / max(full_bytes, 1),
+            "enqueued_delta_bytes": delta_bytes,
+            "enqueued_keyframe_bytes": key_bytes,
+            "coalesce_drops": drops,
+            "queue_pressure": qos["queue_pressure"],
+        }
+        results_by_n[n_subs] = line
+        emit_line(line)
+        # Keeping-up subscribers ride deltas: well under full replay.
+        assert line["delta_vs_replay_ratio"] < 0.8, line
+    ref = results_by_n[n_values[0]]
+    for n_subs in n_values[1:]:
+        cur = results_by_n[n_subs]
+        # THE acceptance bound: device work per tick identical in N.
+        assert cur["executes_per_tick"] == ref["executes_per_tick"], (
+            ref,
+            cur,
+        )
+        assert cur["fetches_per_tick"] == ref["fetches_per_tick"], (
+            ref,
+            cur,
+        )
+    summary = {
+        "metric": "fanout_summary",
+        "n_values": list(n_values),
+        "publish_ops_flat_in_n": True,
+        "executes_per_tick": ref["executes_per_tick"],
+        "fetches_per_tick": ref["fetches_per_tick"],
+        "delta_vs_replay_ratio": {
+            n: results_by_n[n]["delta_vs_replay_ratio"] for n in n_values
+        },
+        "wall_ms_per_tick": {
+            n: results_by_n[n]["wall_ms_per_tick"] for n in n_values
+        },
+    }
+    print(json.dumps(summary), file=sys.stderr)
+    return results_by_n[max(n_values)]
+
+
 def bench_telemetry(args, tick_wall_ms: float | None = None) -> dict:
     """Steady-state telemetry overhead guard (ADR 0116, PERF round 10).
 
@@ -1813,6 +2067,7 @@ def run_benchmark(args, platform: str) -> dict:
             lambda: bench_multijob(args),
             lambda: bench_publish(args),
             lambda: bench_tick(args),
+            lambda: bench_fanout(args),
             lambda: bench_telemetry(args),
             lambda: bench_mesh(args),
             lambda: bench_pipeline(args),
@@ -2161,6 +2416,19 @@ def _parse_args():
         "fresh-process driver)",
     )
     parser.add_argument(
+        "--fanout",
+        action="store_true",
+        help="Run ONLY the result fan-out tier scenario (ADR 0117) on "
+        "the ambient backend and exit: K=4 jobs publish through the "
+        "real JobManager + ServingPlane while N in {1, 100, 2000} "
+        "simulated SSE subscribers attach — asserts publish-side "
+        "device executes+fetches per tick are IDENTICAL across N, "
+        "subscriber reconstruction byte-identical to the sink da00 "
+        "wire, and delta bytes well under full-frame replay (dev "
+        "flag, like --multijob; also runs under --all and --smoke, "
+        "which uses N=50)",
+    )
+    parser.add_argument(
         "--telemetry",
         action="store_true",
         help="Run ONLY the telemetry-overhead guard (ADR 0116) and "
@@ -2306,6 +2574,31 @@ def _smoke_main(args) -> int:
             )
         if "telemetry" not in tick_line:
             problems.append("tick line missing telemetry snapshot")
+    # Result fan-out control (ADR 0117): tiny run through the real
+    # JobManager + ServingPlane at N=1 and N=50 simulated subscribers;
+    # the scenario itself asserts publish-side device ops identical
+    # across N, byte-identical subscriber reconstruction and bounded
+    # slow-consumer queues, and this guards the report's structure.
+    try:
+        fanout_line = bench_fanout(args, n_values=(1, 50))
+    except Exception:
+        traceback.print_exc()
+        problems.append("fanout scenario raised")
+    else:
+        for field in (
+            "value",
+            "executes_per_tick",
+            "fetches_per_tick",
+            "delta_vs_replay_ratio",
+            "served_bytes_per_checker_tick",
+            "coalesce_drops",
+        ):
+            if fanout_line.get(field) is None:
+                problems.append(f"fanout line missing {field!r}")
+        if not fanout_line.get("delta_vs_replay_ratio", 1.0) < 0.8:
+            problems.append(
+                "fanout delta encoding not under full-frame replay"
+            )
     # Telemetry-overhead guard (ADR 0116): instrument microcosts
     # bounded against the tick wall this very smoke just measured.
     try:
@@ -2377,9 +2670,10 @@ def _smoke_main(args) -> int:
         "publish combining at 1 fetch/tick, tick program at 1 "
         "dispatch/tick with wire parity, compile instrument saw the "
         "warmup miss and a clean steady state, telemetry overhead "
-        "under 1% of tick wall, mesh tier at 1 execute/slice/tick "
-        "with single-device parity, pipelined ingest drained with "
-        "parity",
+        "under 1% of tick wall, fan-out tier flat in subscribers with "
+        "byte-identical reconstruction, mesh tier at 1 "
+        "execute/slice/tick with single-device parity, pipelined "
+        "ingest drained with parity",
         file=sys.stderr,
     )
     return 0
@@ -2420,6 +2714,13 @@ def main() -> None:
         if args.batches is None:
             args.batches = 32
         bench_tick(args)
+        sys.exit(0)
+    if args.fanout:
+        if args.events is None:
+            args.events = 1 << 12
+        if args.batches is None:
+            args.batches = 48
+        bench_fanout(args)
         sys.exit(0)
     if args.telemetry:
         bench_telemetry(args)

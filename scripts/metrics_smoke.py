@@ -14,7 +14,12 @@ then
 3. the payload exposes the migrated producer families — publish
    dispatch counters, pipeline/stage surfaces, stream counts, compile
    histograms, span decomposition, HBM gauges — and, once data flowed,
-   nonzero publish executes.
+   nonzero publish executes;
+4. (ADR 0117) with ``--serve-port`` the result fan-out tier answers:
+   ``GET /results`` lists the job's streams, the first SSE event on
+   ``/streams/<job>/<output>`` is a valid keyframe whose payload
+   decodes as da00, and the ``livedata_serving_*`` families appear in
+   ``/metrics`` after the subscriber attached.
 
 Exit 0 on success, 1 with a diagnostic otherwise.
 """
@@ -34,6 +39,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "src"))
 
 TIMEOUT_S = float(os.environ.get("METRICS_SMOKE_TIMEOUT_S", "90"))
 PORT = int(os.environ.get("METRICS_SMOKE_PORT", "18917"))
+SERVE_PORT = int(os.environ.get("METRICS_SMOKE_SERVE_PORT", PORT + 1))
 
 #: Families one scrape of a running service must expose (the /metrics
 #: acceptance list; livedata_hbm_bytes may be sample-less on CPU but
@@ -99,6 +105,8 @@ def main() -> int:
             broker_dir,
             "--metrics-port",
             str(PORT),
+            "--serve-port",
+            str(SERVE_PORT),
         ],
         env=env,
     )
@@ -189,9 +197,84 @@ def main() -> int:
         if compiles < 1:
             print("compile-event instrument saw no compiles")
             return 1
+
+        # 4. result fan-out tier (ADR 0117): index, first SSE event a
+        # valid keyframe decoding as da00, serving families scraped.
+        import base64
+
+        from esslivedata_tpu.serving.delta import HEADER_SIZE, decode_header
+        from esslivedata_tpu.kafka.wire import decode_da00
+
+        def fetch_serve(path: str, timeout: float = 5.0):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{SERVE_PORT}{path}", timeout=timeout
+            ) as response:
+                return response.status, response.read()
+
+        index = None
+        while time.time() < deadline:
+            status, body = fetch_serve("/results")
+            if status != 200:
+                print(f"/results HTTP {status}")
+                return 1
+            index = json.loads(body)
+            if index.get("streams"):
+                break
+            time.sleep(1.0)
+        if not index or not index.get("streams"):
+            print(f"/results never listed a stream: {index!r}")
+            return 1
+        entry = index["streams"][0]
+        print(
+            f"serving index OK: {len(index['streams'])} streams, "
+            f"first={entry['stream']}"
+        )
+        sse = urllib.request.urlopen(
+            f"http://127.0.0.1:{SERVE_PORT}{entry['path']}", timeout=15
+        )
+        event_kind = blob = None
+        for raw in sse:
+            line = raw.decode().rstrip("\n")
+            if line.startswith("event: "):
+                event_kind = line[len("event: "):]
+            elif line.startswith("data: "):
+                blob = base64.b64decode(line[len("data: "):])
+                break
+        sse.close()
+        if blob is None or event_kind != "keyframe":
+            print(f"first SSE event not a keyframe: {event_kind!r}")
+            return 1
+        header = decode_header(blob)
+        if not header.keyframe:
+            print("SSE keyframe event carries a non-keyframe blob")
+            return 1
+        frame = blob[HEADER_SIZE:]
+        decoded = decode_da00(frame)
+        if not decoded.variables:
+            print("keyframe decoded as da00 but carries no variables")
+            return 1
+        print(
+            f"SSE keyframe OK: epoch={header.epoch} seq={header.seq} "
+            f"{len(frame)}B, {len(decoded.variables)} da00 variables"
+        )
+        status, body = fetch("/metrics")
+        parsed = parse_prometheus_text(body.decode())
+        serving_missing = [
+            family
+            for family in (
+                "livedata_serving_subscribers",
+                "livedata_serving_frames",
+                "livedata_serving_bytes",
+            )
+            if family not in parsed
+        ]
+        if serving_missing:
+            print(f"scrape missing serving families: {serving_missing}")
+            return 1
         print(
             f"metrics smoke PASSED: {len(parsed)} families, "
-            f"publish executes={publishes:.0f}, compiles={compiles:.0f}"
+            f"publish executes={publishes:.0f}, compiles={compiles:.0f}, "
+            f"serving plane live"
         )
         return 0
     finally:

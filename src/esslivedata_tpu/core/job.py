@@ -123,7 +123,14 @@ class ServiceStatus(BaseModel):
 class JobResult:
     """Finalized outputs of one job for one window."""
 
-    __slots__ = ("job_id", "workflow_id", "outputs", "start", "end")
+    __slots__ = (
+        "job_id",
+        "workflow_id",
+        "outputs",
+        "start",
+        "end",
+        "state_epoch",
+    )
 
     def __init__(
         self,
@@ -133,12 +140,16 @@ class JobResult:
         outputs: dict[str, DataArray],
         start: Timestamp | None,
         end: Timestamp | None,
+        state_epoch: int = 0,
     ) -> None:
         self.job_id = job_id
         self.workflow_id = workflow_id
         self.outputs = outputs
         self.start = start
         self.end = end
+        #: The producing job's state generation at finalize (see
+        #: ``Job.state_epoch``) — the fan-out tier's epoch signal.
+        self.state_epoch = state_epoch
 
     def keys(self) -> list[ResultKey]:
         return [
@@ -190,6 +201,13 @@ class Job:
         self._start_wall = time.time()
         #: Output names whose last finalize returned None (warning surface).
         self.none_outputs: tuple[str, ...] = ()
+        #: State-generation counter for downstream consumers (the result
+        #: fan-out tier, ADR 0117): bumped whenever the accumulation
+        #: restarts — clear()/reset and ``note_state_lost`` (a donated
+        #: dispatch failure rebuilt the buffers mid-generation). A delta
+        #: stream must never splice frames across a bump, so the serving
+        #: plane folds this into its epoch token.
+        self.state_epoch: int = 0
 
     @property
     def subscribed_streams(self) -> set[str]:
@@ -277,6 +295,7 @@ class Job:
             outputs=outputs,
             start=start,
             end=end,
+            state_epoch=self.state_epoch,
         )
 
     def process(
@@ -295,6 +314,15 @@ class Job:
             self.workflow.clear()
         self._generation_start = None
         self._window_end = None
+        self.state_epoch += 1
+
+    def note_state_lost(self) -> None:
+        """Record a mid-generation state rebuild (a donated dispatch
+        failed after consuming the buffers and the JobManager reset the
+        accumulator, ADR 0113/0114): downstream delta streams must
+        keyframe — the next published frame does not continue the
+        previous one."""
+        self.state_epoch += 1
 
     def release(self) -> None:
         """Drop the workflow instance (and with it the device-resident

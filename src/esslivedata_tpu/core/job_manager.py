@@ -205,6 +205,11 @@ class JobManager:
         #: ``set_link_observer``: combined publishes time the real
         #: device round trip into it.
         self._link_observer = None
+        #: Job-retirement observer (``set_retire_observer``): called
+        #: with each removed JobId so downstream caches — the result
+        #: fan-out tier's ResultCache (ADR 0117) — drop the job's
+        #: streams instead of serving stale keyframes forever.
+        self._retire_observer = None
         #: Optional core.state_snapshot.SnapshotStore: device-resident
         #: accumulation is dumped at run boundaries + shutdown and
         #: restored when an identically-configured job is scheduled
@@ -314,6 +319,7 @@ class JobManager:
         sees the shared commands topic but owns a disjoint job set, and a
         non-owner must stay silent (the dispatcher acks only on count > 0).
         """
+        removed: list[JobId] = []
         with self._lock:
             matched = [
                 (jid, rec)
@@ -330,9 +336,25 @@ class JobManager:
                     del self._records[jid]
                     # Consumer detach: flush staged slots (ADR 0110).
                     self._event_cache.invalidate()
+                    removed.append(jid)
                 elif command.action == "reset":
                     self._reset_record(rec)
-            return len(matched)
+        # Outside the lock: observers reach foreign subsystems (the
+        # fan-out tier's own hub lock) — never from inside ours.
+        observer = self._retire_observer
+        if observer is not None:
+            for jid in removed:
+                try:
+                    observer(jid)
+                except Exception:
+                    logger.exception("retire observer failed for %s", jid)
+        return len(matched)
+
+    def set_retire_observer(self, observer) -> None:
+        """Attach a ``fn(job_id)`` called after each job removal — the
+        serving plane drops the job's cached streams through this
+        (ADR 0117)."""
+        self._retire_observer = observer
 
     # -- run transitions ---------------------------------------------------
     def handle_run_transition(self, event: RunStart | RunStop) -> None:
@@ -538,6 +560,7 @@ class JobManager:
                     if publish_args_consumed(offer.args):
                         if offer.reset is not None:
                             offer.reset()
+                        rec.job.note_state_lost()
                         rec.warning = (
                             "combined publish failed after buffer "
                             "donation; accumulation reset (see service "
@@ -566,6 +589,7 @@ class JobManager:
                         # every publish from here on.
                         if offer.reset is not None:
                             offer.reset()
+                        rec.job.note_state_lost()
                         rec.warning = (
                             "combined publish failed after buffer "
                             "donation; accumulation reset (see service "
@@ -779,6 +803,7 @@ class JobManager:
                     if publish_args_consumed(offer.args):
                         if offer.reset is not None:
                             offer.reset()
+                        rec.job.note_state_lost()
                         rec.warning = (
                             "tick program failed after buffer donation; "
                             "accumulation reset (see service log)"
@@ -812,6 +837,7 @@ class JobManager:
                         # deleted array forever.
                         if offer.reset is not None:
                             offer.reset()
+                        rec.job.note_state_lost()
                         rec.warning = (
                             "tick program failed after buffer donation; "
                             "accumulation reset (see service log)"
@@ -1385,6 +1411,7 @@ class JobManager:
                         # surface the loss instead of erroring on a
                         # deleted array every window from here on.
                         offer.set_state(offer.hist.init_state())
+                        rec.job.note_state_lost()
                         rec.warning = (
                             "fused step failed after buffer donation; "
                             "accumulation reset (see service log)"

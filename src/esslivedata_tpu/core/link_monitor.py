@@ -94,6 +94,14 @@ class LinkPolicy:
     #: degraded relay widens the tick so the (combined) publish round
     #: trip amortizes over more accumulation.
     publish_coalesce: int = 1
+    #: Fan-out demand axis (ADR 0117): the serving tier's contribution
+    #: to ``publish_coalesce``. > 1 when nobody has been watching the
+    #: broadcast plane for the idle grace period (publish work nobody
+    #: consumes is pure relay load) or when every attached consumer is
+    #: drowning (pressure latch). 1 = live demand at normal pressure —
+    #: publish cadence stays RTT-governed. Already folded into
+    #: ``publish_coalesce``; exposed so stats/telemetry name the axis.
+    fanout_coalesce: int = 1
 
 
 class LinkMonitor:
@@ -108,6 +116,10 @@ class LinkMonitor:
         rtt_deep_s: float = 0.03,
         rtt_coalesce_s: float = 0.05,
         max_publish_coalesce: int = 8,
+        fanout_idle_coalesce: int = 4,
+        fanout_idle_grace_s: float = 10.0,
+        fanout_pressure_high: float = 0.75,
+        fanout_pressure_low: float = 0.25,
         alpha: float = 0.25,
         max_window_scale: float = 8.0,
         base_depth: int = 2,
@@ -154,6 +166,24 @@ class LinkMonitor:
         self._n_staging = 0
         self._n_publish = 0
         self._bytes_observed = 0
+        #: Fan-out demand axis (ADR 0117), fed by the broadcast plane
+        #: through ``observe_fanout``. ``None`` subscribers = no serving
+        #: plane has ever reported — the axis stays neutral, so a
+        #: deployment without a serve port behaves exactly as before.
+        #: Idle entry is time-latched (``fanout_idle_grace_s`` of
+        #: continuous zero-subscriber reports) so a dashboard reconnect
+        #: blip cannot flap the publish cadence; attach releases
+        #: INSTANTLY — a viewer must never wait out a hysteresis band
+        #: for fresh data. Queue pressure uses a high/low dead zone like
+        #: every other latch here.
+        self._fanout_idle_coalesce = max(1, int(fanout_idle_coalesce))
+        self._fanout_idle_grace_s = float(fanout_idle_grace_s)
+        self._fanout_pressure_high = float(fanout_pressure_high)
+        self._fanout_pressure_low = float(fanout_pressure_low)
+        self._fanout_subscribers: int | None = None
+        self._fanout_pressure = 0.0
+        self._fanout_idle_since: float | None = None
+        self._fanout_pressure_latch = False
 
     # -- observations ------------------------------------------------------
     def observe_staging(self, nbytes: int, seconds: float) -> None:
@@ -227,6 +257,32 @@ class LinkMonitor:
                     now,
                 )
 
+    def observe_fanout(
+        self, subscribers: int, queue_pressure: float
+    ) -> None:
+        """Fold one broadcast-plane QoS report in (ADR 0117).
+
+        ``subscribers`` is the attached-consumer count,
+        ``queue_pressure`` the worst per-subscriber send-queue fill in
+        [0, 1] (``BroadcastServer.qos``). Zero subscribers starts the
+        idle clock (publish coalescing backs off once it has run
+        ``fanout_idle_grace_s``); any subscriber clears it immediately
+        — cadence tightens the moment a viewer attaches.
+        """
+        now = time.monotonic()
+        with self._lock:
+            subscribers = max(0, int(subscribers))
+            self._fanout_pressure = min(1.0, max(0.0, float(queue_pressure)))
+            if subscribers == 0:
+                if (
+                    self._fanout_subscribers is None
+                    or self._fanout_subscribers > 0
+                ):
+                    self._fanout_idle_since = now
+            else:
+                self._fanout_idle_since = None
+            self._fanout_subscribers = subscribers
+
     # -- estimates ---------------------------------------------------------
     def bandwidth_bps(self) -> float | None:
         with self._lock:
@@ -282,13 +338,15 @@ class LinkMonitor:
         same critical section; see the stats docstring)."""
         bw = self._bw_bps
         rtt = self._policy_rtt_locked()
-        coalesce = self._publish_coalesce_locked(rtt)
+        fanout = self._fanout_coalesce_locked()
+        coalesce = self._publish_coalesce_locked(rtt, fanout)
         if bw is None:
             return LinkPolicy(
                 window_scale=1.0,
                 compact_wire=None,
                 depth=self._base_depth,
                 publish_coalesce=coalesce,
+                fanout_coalesce=fanout,
             )
         if self._degraded_latch:
             if bw >= self._recover:
@@ -310,30 +368,65 @@ class LinkMonitor:
             compact_wire=True if degraded else None,
             depth=self._max_depth if deep else self._base_depth,
             publish_coalesce=coalesce,
+            fanout_coalesce=fanout,
         )
 
-    def _publish_coalesce_locked(self, rtt: float | None) -> int:
+    def _fanout_coalesce_locked(self) -> int:
+        """The fan-out demand contribution to publish coalescing
+        (caller holds the lock; ADR 0117). Neutral (1) until a serving
+        plane reports. Zero subscribers for the idle grace period →
+        ``fanout_idle_coalesce`` (publish ticks nobody consumes are
+        pure relay load); an attach releases instantly. With live
+        subscribers, sustained worst-queue pressure over the high
+        watermark latches a mild widening (2) until pressure falls
+        under the low watermark — publishing less often is the only
+        lever that helps a consumer that cannot drain."""
+        if self._fanout_subscribers is None:
+            return 1
+        if self._fanout_subscribers == 0:
+            since = self._fanout_idle_since
+            if (
+                since is not None
+                and time.monotonic() - since >= self._fanout_idle_grace_s
+            ):
+                return min(self._max_coalesce, self._fanout_idle_coalesce)
+            return 1
+        if self._fanout_pressure_latch:
+            if self._fanout_pressure < self._fanout_pressure_low:
+                # graftlint: disable=JGL012 caller holds self._lock
+                self._fanout_pressure_latch = False
+        elif self._fanout_pressure > self._fanout_pressure_high:
+            # graftlint: disable=JGL012 caller holds self._lock
+            self._fanout_pressure_latch = True
+        return 2 if self._fanout_pressure_latch else 1
+
+    def _publish_coalesce_locked(
+        self, rtt: float | None, fanout: int = 1
+    ) -> int:
         """The RTT-adaptive publish-coalescing window (caller holds the
         lock). Latched with a dead zone; while latched the window is the
         RTT over the latch threshold, doubled and quantized to the
         NEAREST power of two (floor 2) — a barely-over-threshold 51 ms
         RTT coalesces 2 windows, the round-5 88 ms RTT 4, a 200 ms
-        relay 8 (capped)."""
-        if rtt is None:
-            return 1
+        relay 8 (capped). ``fanout`` (ADR 0117) is the demand axis:
+        the widest of the two wins, so an unwatched service backs off
+        even on a healthy relay and a congested relay keeps its RTT
+        width even with viewers attached."""
         # "_locked" contract: every caller (policy, and stats through
         # policy) already holds self._lock around this call.
-        if self._coalesce_latch:
-            if rtt <= self._rtt_coalesce / self._recover_factor:
+        if rtt is not None:
+            if self._coalesce_latch:
+                if rtt <= self._rtt_coalesce / self._recover_factor:
+                    # graftlint: disable=JGL012 caller holds self._lock
+                    self._coalesce_latch = False
+            elif rtt > self._rtt_coalesce:
                 # graftlint: disable=JGL012 caller holds self._lock
-                self._coalesce_latch = False
-        elif rtt > self._rtt_coalesce:
-            # graftlint: disable=JGL012 caller holds self._lock
-            self._coalesce_latch = True
-        if not self._coalesce_latch:
-            return 1
-        raw = max(2.0, 2.0 * rtt / self._rtt_coalesce)
-        return min(self._max_coalesce, 1 << round(math.log2(raw)))
+                self._coalesce_latch = True
+        rtt_width = 1
+        if rtt is not None and self._coalesce_latch:
+            raw = max(2.0, 2.0 * rtt / self._rtt_coalesce)
+            rtt_width = min(self._max_coalesce, 1 << round(math.log2(raw)))
+        return min(self._max_coalesce, max(rtt_width, fanout))
 
     def stats(self) -> dict[str, float | int | bool | None]:
         """Snapshot for the 30 s metrics line and the telemetry
@@ -362,4 +455,7 @@ class LinkMonitor:
                 "compact_wire": policy.compact_wire,
                 "depth": policy.depth,
                 "publish_coalesce": policy.publish_coalesce,
+                "fanout_coalesce": policy.fanout_coalesce,
+                "fanout_subscribers": self._fanout_subscribers,
+                "fanout_pressure": self._fanout_pressure,
             }

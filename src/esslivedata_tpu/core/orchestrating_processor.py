@@ -195,6 +195,7 @@ class OrchestratingProcessor:
         pipeline_depth: int = 2,
         flatten_threads: int = 0,
         link_monitor=None,
+        result_fanout=None,
     ) -> None:
         self._source = source
         self._sink = sink
@@ -230,6 +231,20 @@ class OrchestratingProcessor:
         # state is single-thread-owned by contract).
         self._pipeline = None
         self._link_monitor = None
+        #: Result fan-out tier (serving/plane.py, ADR 0117), duck-typed:
+        #: ``publish_results(results, timestamp)`` mirrors the sink
+        #: publish and ``qos()`` feeds the link monitor's demand axis.
+        #: None = no serving plane (classic deployments, tests).
+        self._result_fanout = result_fanout
+        self._last_fanout_qos = -float("inf")
+        if result_fanout is not None:
+            # Removed jobs drop their cached streams: without this the
+            # plane would list a dead job in /results and pin a ring of
+            # its full frames forever under job churn.
+            drop_job = getattr(result_fanout, "drop_job", None)
+            set_retire = getattr(job_manager, "set_retire_observer", None)
+            if drop_job is not None and set_retire is not None:
+                set_retire(drop_job)
         # Step-worker -> service-thread policy mailbox (graftlint JGL012:
         # the step worker posts, the service thread swaps-and-applies;
         # unlocked, the swap's read..None-store window can eat a
@@ -261,6 +276,16 @@ class OrchestratingProcessor:
                 link_monitor=self._link_monitor,
                 name=f"{service_name}-ingest",
             )
+        elif result_fanout is not None:
+            # Serial service with a serving plane (ADR 0117): no
+            # pipeline means no bandwidth/RTT observations, but the
+            # fan-out demand axis still applies — an unwatched service
+            # backs its publish cadence off, and the processor applies
+            # the (otherwise neutral) policy itself at heartbeat
+            # cadence since no step worker posts one.
+            from .link_monitor import LinkMonitor
+
+            self._link_monitor = link_monitor or LinkMonitor()
         # Unified telemetry (ADR 0116): one keyed collector per
         # processor feeding the process registry at scrape time — link
         # estimates, pipeline depths/utilization, stream/sink/source
@@ -323,6 +348,28 @@ class OrchestratingProcessor:
             self._apply_link_policy()
 
         now = self._clock()
+        if (
+            self._result_fanout is not None
+            and self._link_monitor is not None
+            and now - self._last_fanout_qos >= self._heartbeat_interval_s
+        ):
+            # Demand axis (ADR 0117): subscriber count + worst queue
+            # pressure from the broadcast plane, at heartbeat cadence —
+            # a hub-lock probe, far off the per-window hot path.
+            self._last_fanout_qos = now
+            try:
+                qos = self._result_fanout.qos()
+                self._link_monitor.observe_fanout(
+                    int(qos["subscribers"]), float(qos["queue_pressure"])
+                )
+            except Exception:
+                logger.debug("fan-out qos probe failed", exc_info=True)
+            if self._pipeline is None:
+                # Serial mode has no step worker posting policies:
+                # apply the (fanout-only) decision here.
+                with self._policy_lock:
+                    self._pending_policy = self._link_monitor.policy()
+                self._apply_link_policy()
         if now - self._last_heartbeat >= self._heartbeat_interval_s:
             self._last_heartbeat = now
             self._publish_status()
@@ -526,6 +573,16 @@ class OrchestratingProcessor:
             messages.extend(self._device_extractor.extract(results))
         if messages:
             self._sink.publish_messages(messages)
+        if results and self._result_fanout is not None:
+            # Result fan-out tier (ADR 0117): the broadcast plane gets
+            # the same finalized results the sink just published —
+            # bounded host work (one delta encode per output, one
+            # bounded enqueue per subscriber), contained so a fan-out
+            # failure can never take the publish path down.
+            try:
+                self._result_fanout.publish_results(results, timestamp)
+            except Exception:
+                logger.exception("result fan-out failed")
 
     def _publish_acks(self, acks: list[CommandAcknowledgement]) -> None:
         if not acks:
@@ -728,6 +785,18 @@ class OrchestratingProcessor:
                             -1
                             if link["compact_wire"] is None
                             else int(link["compact_wire"]),
+                        ),
+                        (
+                            (("axis", "fanout_coalesce"),),
+                            link.get("fanout_coalesce", 1),
+                        ),
+                        (
+                            # -1 = no serving plane has reported (axis
+                            # neutral), else the attached-viewer count.
+                            (("axis", "fanout_subscribers"),),
+                            -1
+                            if link.get("fanout_subscribers") is None
+                            else link["fanout_subscribers"],
                         ),
                     ],
                 )

@@ -178,6 +178,17 @@ def setup_arg_parser(description: str = "") -> argparse.ArgumentParser:
         "ephemeral port (ADR 0116)",
     )
     parser.add_argument(
+        "--serve-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve the result fan-out tier on this port (GET "
+        "/results: JSON stream index; GET /streams/<job>/<output>: "
+        "SSE keyframe-then-deltas broadcast of the job's da00 "
+        "outputs). LIVEDATA_SERVE_PORT equivalently; 0 picks an "
+        "ephemeral port (ADR 0117)",
+    )
+    parser.add_argument(
         "--trace-dump",
         default=None,
         metavar="PATH",

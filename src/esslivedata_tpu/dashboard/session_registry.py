@@ -59,7 +59,7 @@ class SessionRegistry:
             return self._config_generation
 
     # -- session lifecycle -------------------------------------------------
-    def _touch(
+    def _touch_locked(
         self, session_id: str | None, notification_cursor: int = 0
     ) -> Session:
         """Sweep idle sessions, then fetch-or-register + refresh one.
@@ -84,7 +84,7 @@ class SessionRegistry:
     def ensure(self, session_id: str | None = None) -> Session:
         """Register (or refresh) a session; expired sessions are dropped."""
         with self._lock:
-            return self._touch(session_id)
+            return self._touch_locked(session_id)
 
     def poll(
         self, session_id: str | None, notifications
@@ -93,7 +93,7 @@ class SessionRegistry:
         notification backlog, and reports whether configuration changed
         since the session last acknowledged it."""
         with self._lock:
-            session = self._touch(
+            session = self._touch_locked(
                 session_id, notification_cursor=notifications.latest_seq
             )
             fresh = notifications.since(session.notification_cursor)

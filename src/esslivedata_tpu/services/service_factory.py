@@ -124,6 +124,14 @@ class DataServiceBuilder:
         self.mesh_spec: str | None = (
             _os.environ.get("LIVEDATA_MESH") or None
         )
+        # Result fan-out tier (serving/, ADR 0117): when a port is
+        # configured the processor feeds every publish tick's da00
+        # outputs into a delta-encoded SSE broadcast plane. None =
+        # disabled. The runner's --serve-port overrides after build.
+        _serve_env = _os.environ.get("LIVEDATA_SERVE_PORT")
+        self.serve_port: int | None = (
+            int(_serve_env) if _serve_env else None
+        )
         self._instrument = instrument_registry[instrument]
         self._instrument.load_factories()
         # Subscribe only to streams the hosted specs consume (reference
@@ -210,6 +218,23 @@ class DataServiceBuilder:
         contract = DeviceContract.from_specs(
             workflow_registry.specs_for_instrument(self.instrument_name)
         )
+        result_fanout = None
+        if self.serve_port is not None:
+            # Keyed by requested port so repeated builds in one process
+            # (tests driving main()) reuse the listener — the
+            # core/service.py metrics-server rule. A bind failure
+            # raises loudly: an operator who asked for a serve port
+            # must not silently run without the fan-out tier.
+            from ..serving import get_or_create_plane
+
+            result_fanout = get_or_create_plane(
+                int(self.serve_port),
+                name=f"{self.instrument_name}_{self.service_name}",
+            )
+            logger.info(
+                "result fan-out tier on port %s (/results, /streams/...)",
+                result_fanout.port,
+            )
         processor = OrchestratingProcessor(
             source=source,
             sink=sink,
@@ -224,6 +249,7 @@ class DataServiceBuilder:
             pipelined=self.pipelined,
             pipeline_depth=self.pipeline_depth,
             flatten_threads=self.flatten_threads,
+            result_fanout=result_fanout,
         )
         return Service(
             processor=processor,
@@ -361,6 +387,8 @@ class DataServiceRunner:
             builder.tick_program = False
         if args.mesh is not None:
             builder.mesh_spec = args.mesh or None
+        if args.serve_port is not None:
+            builder.serve_port = args.serve_port
         if args.check:
             print(
                 f"{self._service_name}: instrument={args.instrument} "

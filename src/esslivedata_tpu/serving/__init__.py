@@ -1,0 +1,48 @@
+"""Result fan-out tier: publish once per tick, serve N dashboards.
+
+The subsystem that decouples viewers from the reduction stream
+(ROADMAP open item 3, ADR 0117). Four pieces:
+
+- :mod:`.result_cache` — host-side latest-frame + recent-ring cache per
+  (job, output), fed at finalize time; subscriber attach/resync never
+  touches the compute loop;
+- :mod:`.delta` — exact byte-run delta codec (keyframe + sparse deltas,
+  dense fallback, epoch-tagged) with byte-identical reconstruction of
+  the da00 wire;
+- :mod:`.broadcast` — SSE broadcast server with per-subscriber bounded
+  queues and coalesce-on-overflow, plus the ``/results`` index and the
+  ``livedata_serving_*`` telemetry families;
+- :mod:`.plane` — the ``ServingPlane`` processor hook wiring the above
+  into the service runners (``--serve-port``/``LIVEDATA_SERVE_PORT``).
+
+See docs/serving.md for endpoints, the delta wire format and the
+QoS/coalescing semantics.
+"""
+
+from .broadcast import BroadcastServer, Subscription, stream_key
+from .delta import (
+    DeltaDecoder,
+    DeltaEncoder,
+    DeltaError,
+    decode_header,
+    encode_delta,
+    encode_keyframe,
+)
+from .plane import ServingPlane, get_or_create_plane
+from .result_cache import CachedFrame, ResultCache
+
+__all__ = [
+    "BroadcastServer",
+    "CachedFrame",
+    "DeltaDecoder",
+    "DeltaEncoder",
+    "DeltaError",
+    "ResultCache",
+    "ServingPlane",
+    "Subscription",
+    "decode_header",
+    "encode_delta",
+    "encode_keyframe",
+    "get_or_create_plane",
+    "stream_key",
+]

@@ -1,0 +1,475 @@
+"""Broadcast plane: one publish in, N subscribers out (ADR 0117).
+
+The hub decouples every viewer from the compute loop. The service's
+publish hook calls :meth:`BroadcastServer.publish_frame` once per
+(job, output) per publish tick; the hub stores the frame in the
+:class:`~.result_cache.ResultCache`, delta-encodes it ONCE against the
+previous tick (serving/delta.py), and enqueues the resulting blob onto
+every attached subscriber's bounded queue. Per-subscriber cost is one
+``put_nowait`` — no encoding, no device work, no serialization — so
+publish-side work is flat in subscriber count (the bench ``--fanout``
+acceptance).
+
+Slow consumers are coalesced, never buffered unboundedly and never
+waited on: when a subscriber's queue is full, its backlog is dropped,
+a coalesce drop is counted, and a fresh keyframe of the CURRENT tick
+takes its place — the consumer loses intermediate deltas (each tick's
+frame supersedes the last; dashboards want now, not history) and
+recovers exact state from the keyframe. The publish hook therefore
+runs in O(subscribers) bounded, lock-cheap steps regardless of how
+wedged any consumer is.
+
+HTTP surface (stdlib ThreadingHTTPServer, the telemetry/http.py
+pattern — daemon threads, loud bind failure at startup):
+
+- ``GET /results`` — JSON index of every cached stream (job, output,
+  epoch, seq, frame bytes, subscriber count);
+- ``GET /streams/<job>/<output>`` — SSE: one ``keyframe`` event from
+  the cache immediately, then live ``keyframe``/``delta`` events as
+  ticks publish. ``data:`` is the base64 blob (serving/delta.py wire),
+  ``id:`` the publish seq. ``<job>`` is ``source_name:job_number``.
+
+``port=None`` runs the hub without HTTP — the bench's simulated
+subscribers and the unit tests attach through :meth:`subscribe`, the
+exact API the SSE handler uses.
+
+Telemetry (ADR 0116): ``livedata_serving_frames``/``_bytes`` counters
+(labeled keyframe|delta, counted per subscriber delivery — the fan-out
+volume), ``livedata_serving_coalesce_drops``, and a keyed collector
+exposing per-stream subscriber gauges and per-subscriber queue depths.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import unquote
+
+from ..telemetry.registry import REGISTRY, MetricFamily, Sample
+from .delta import DeltaEncoder, decode_header, encode_keyframe
+from .result_cache import ResultCache
+
+__all__ = ["BroadcastServer", "Subscription", "stream_key"]
+
+logger = logging.getLogger(__name__)
+
+#: Fan-out volume: frames/bytes enqueued per subscriber delivery, split
+#: keyframe vs delta — delta bytes ≪ keyframe bytes is the tier's
+#: bandwidth claim (bench --fanout records the ratio).
+SERVING_FRAMES = REGISTRY.counter(
+    "livedata_serving_frames",
+    "Frames enqueued to subscribers by the broadcast plane",
+    labelnames=("kind",),
+)
+SERVING_BYTES = REGISTRY.counter(
+    "livedata_serving_bytes",
+    "Bytes enqueued to subscribers by the broadcast plane",
+    labelnames=("kind",),
+)
+SERVING_COALESCE_DROPS = REGISTRY.counter(
+    "livedata_serving_coalesce_drops",
+    "Slow-subscriber backlogs dropped and replaced by a keyframe",
+)
+
+
+def stream_key(job: str, output: str) -> str:
+    """The hub's stream id — mirrors the SSE path ``/streams/<job>/<output>``."""
+    return f"{job}/{output}"
+
+
+class Subscription:
+    """One attached consumer: a bounded blob queue + resync flag.
+
+    The queue is the ONLY hand-off between the publish hook and the
+    consumer thread; it is bounded (coalesce-on-overflow, see module
+    docstring) and drained with timeouts, so neither side can park
+    forever (graftlint JGL010 discipline).
+    """
+
+    __slots__ = ("stream", "sub_id", "_queue", "delivered")
+
+    def __init__(self, stream: str, sub_id: int, limit: int) -> None:
+        self.stream = stream
+        self.sub_id = sub_id
+        self._queue: queue.Queue[bytes] = queue.Queue(maxsize=limit)
+        #: Blobs enqueued to this subscriber (hub-lock-guarded).
+        self.delivered = 0
+
+    def next_blob(self, timeout: float = 0.5) -> bytes | None:
+        """The next blob, or None after ``timeout`` — callers loop and
+        re-check their stop condition (never an untimeboxed park)."""
+        try:
+            return self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def depth(self) -> int:
+        return self._queue.qsize()
+
+    # -- hub side (caller holds the hub lock) ------------------------------
+    def _offer(self, blob: bytes, resync_keyframe) -> bool:
+        """Enqueue ``blob``; on overflow drop the backlog and enqueue a
+        fresh keyframe instead (``resync_keyframe`` is a thunk so the
+        keyframe encodes at most once per publish no matter how many
+        subscribers overflowed). Returns False when coalesced."""
+        try:
+            self._queue.put_nowait(blob)
+            return True
+        except queue.Full:
+            while True:
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    break
+            try:
+                self._queue.put_nowait(resync_keyframe())
+            except queue.Full:  # pragma: no cover - limit >= 1 by ctor
+                pass
+            return False
+
+
+class BroadcastServer:
+    """Subscriber hub + optional SSE/HTTP plane over a ResultCache."""
+
+    def __init__(
+        self,
+        *,
+        cache: ResultCache | None = None,
+        port: int | None = None,
+        host: str = "0.0.0.0",
+        queue_limit: int = 32,
+        name: str = "serving",
+        registry=REGISTRY,
+    ) -> None:
+        if queue_limit < 1:
+            raise ValueError("queue_limit must be >= 1")
+        self.cache = cache if cache is not None else ResultCache()
+        self._queue_limit = int(queue_limit)
+        self._name = name
+        self._lock = threading.Lock()
+        self._subscribers: dict[str, dict[int, Subscription]] = {}
+        self._next_sub_id = 0
+        #: Per-stream delta encoders — touched ONLY by the publish hook
+        #: (single-writer contract, serving/delta.py); subscriber attach
+        #: reads keyframes from the cache, never from here.
+        self._encoders: dict[str, DeltaEncoder] = {}
+        self._stopped = threading.Event()
+        self._registry = registry
+        self._collector_key = f"serving:{name}"
+        registry.register_collector(self._collector_key, self._telemetry)
+        self._frames_key = SERVING_FRAMES.labels(kind="keyframe")
+        self._frames_delta = SERVING_FRAMES.labels(kind="delta")
+        self._bytes_key = SERVING_BYTES.labels(kind="keyframe")
+        self._bytes_delta = SERVING_BYTES.labels(kind="delta")
+        self._server: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        if port is not None:
+            handler = type(
+                "_BoundHandler", (_Handler,), {"broadcast": self}
+            )
+            # A bind failure raises at startup — an operator who asked
+            # for a serve port must not silently run dark (the
+            # telemetry/http.py rule).
+            self._server = ThreadingHTTPServer((host, int(port)), handler)
+            self._server.daemon_threads = True
+            self._thread = threading.Thread(
+                target=self._server.serve_forever,
+                name=f"serving-http-{self.port}",
+                daemon=True,
+            )
+            self._thread.start()
+            logger.info(
+                "result fan-out endpoint on %s:%d (/results, /streams/...)",
+                host,
+                self.port,
+            )
+
+    @property
+    def port(self) -> int | None:
+        """The bound port (0 requests an ephemeral one); None = hub-only."""
+        return None if self._server is None else self._server.server_address[1]
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped.is_set()
+
+    # -- hub ---------------------------------------------------------------
+    def subscribe(self, stream: str) -> Subscription:
+        """Attach a consumer; a keyframe of the latest cached tick is
+        enqueued immediately (registration and the cache read happen
+        under the hub lock, so a concurrent publish either reaches this
+        subscriber's queue or is already inside its keyframe — the
+        stale-delta rule in DeltaDecoder absorbs the overlap)."""
+        with self._lock:
+            sub_id = self._next_sub_id
+            self._next_sub_id += 1
+            sub = Subscription(stream, sub_id, self._queue_limit)
+            self._subscribers.setdefault(stream, {})[sub_id] = sub
+            cached = self.cache.latest(stream)
+            if cached is not None:
+                blob = encode_keyframe(
+                    cached.frame, epoch=cached.epoch, seq=cached.seq
+                )
+                sub._offer(blob, lambda: blob)
+                sub.delivered += 1
+                self._frames_key.inc()
+                self._bytes_key.inc(len(blob))
+        return sub
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        with self._lock:
+            subs = self._subscribers.get(sub.stream)
+            if subs is not None:
+                subs.pop(sub.sub_id, None)
+                if not subs:
+                    del self._subscribers[sub.stream]
+
+    def publish_frame(self, stream: str, frame: bytes, token) -> None:
+        """One publish tick for one stream: cache it, delta-encode it
+        once, fan the blob out to every attached subscriber's bounded
+        queue. Called from the service's publish hook (step worker) —
+        everything here is host-side O(frame) + O(subscribers)."""
+        cached = self.cache.put(stream, frame, token)
+        encoder = self._encoders.get(stream)
+        if encoder is None:
+            encoder = self._encoders[stream] = DeltaEncoder()
+        blob = encoder.encode(frame, epoch=cached.epoch, seq=cached.seq)
+        is_keyframe = bool(decode_header(blob).keyframe)
+        resync: list[bytes] = []
+
+        def resync_keyframe() -> bytes:
+            # At most one keyframe encode per publish, shared by every
+            # overflowed subscriber; when the tick's own blob already IS
+            # the keyframe, reuse it outright.
+            if is_keyframe:
+                return blob
+            if not resync:
+                resync.append(
+                    encode_keyframe(
+                        frame, epoch=cached.epoch, seq=cached.seq
+                    )
+                )
+            return resync[0]
+
+        frames_child = self._frames_key if is_keyframe else self._frames_delta
+        bytes_child = self._bytes_key if is_keyframe else self._bytes_delta
+        with self._lock:
+            subs = self._subscribers.get(stream)
+            if not subs:
+                return
+            for sub in subs.values():
+                delivered = sub._offer(blob, resync_keyframe)
+                sub.delivered += 1
+                if delivered:
+                    frames_child.inc()
+                    bytes_child.inc(len(blob))
+                else:
+                    SERVING_COALESCE_DROPS.inc()
+                    self._frames_key.inc()
+                    self._bytes_key.inc(len(resync_keyframe()))
+
+    def drop_stream(self, stream: str) -> None:
+        """Forget a retired stream (job removed): cache entry and
+        encoder state go; attached subscribers simply stop receiving."""
+        self.cache.invalidate(stream)
+        self._encoders.pop(stream, None)
+
+    def drop_job(self, job: str) -> int:
+        """Forget every stream of one retired job (the JobManager's
+        remove command, via the retire observer): without this a
+        long-running service under job churn would cache a ring of
+        full frames per dead stream forever and keep listing it in
+        ``/results`` as if live. Returns how many streams dropped."""
+        prefix = f"{job}/"
+        streams = [
+            stream
+            for stream in self.cache.streams()
+            if stream.startswith(prefix)
+        ]
+        # Encoder keys are publish-hook-private, but a removed job
+        # publishes nothing further — popping here is safe and frees
+        # the prev-frame copy the encoder holds.
+        for stream in streams:
+            self.drop_stream(stream)
+        return len(streams)
+
+    # -- QoS ----------------------------------------------------------------
+    def qos(self) -> dict[str, float | int]:
+        """Subscriber count + worst send-queue pressure in [0, 1] — the
+        LinkMonitor's fan-out axis reads this (back off publish
+        coalescing when nobody is watching, hold cadence when someone
+        is; core/link_monitor.py)."""
+        with self._lock:
+            n = sum(len(subs) for subs in self._subscribers.values())
+            pressure = 0.0
+            for subs in self._subscribers.values():
+                for sub in subs.values():
+                    pressure = max(
+                        pressure, sub.depth() / self._queue_limit
+                    )
+            return {"subscribers": n, "queue_pressure": pressure}
+
+    # -- telemetry ----------------------------------------------------------
+    def _telemetry(self) -> list[MetricFamily]:
+        subs_fam = MetricFamily(
+            "livedata_serving_subscribers",
+            "gauge",
+            "Attached broadcast subscribers per stream",
+        )
+        depth_fam = MetricFamily(
+            "livedata_serving_queue_depth",
+            "gauge",
+            "Per-subscriber send-queue depth (bounded at queue_limit; "
+            "overflow coalesces to a keyframe instead of growing)",
+        )
+        base = (("server", self._name),)
+        with self._lock:
+            total = 0
+            for stream, subs in sorted(self._subscribers.items()):
+                total += len(subs)
+                subs_fam.samples.append(
+                    Sample("", base + (("stream", stream),), len(subs))
+                )
+                for sub_id, sub in sorted(subs.items()):
+                    depth_fam.samples.append(
+                        Sample(
+                            "",
+                            base
+                            + (
+                                ("stream", stream),
+                                ("subscriber", str(sub_id)),
+                            ),
+                            sub.depth(),
+                        )
+                    )
+        subs_fam.samples.append(
+            Sample("", base + (("stream", "all"),), total)
+        )
+        return [subs_fam, depth_fam]
+
+    def close(self) -> None:
+        self._stopped.set()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            if self._thread is not None:
+                self._thread.join(timeout=5.0)
+        # Owner-guarded: a successor server under the same name must
+        # not lose its live collector to our late close (ADR 0116).
+        self._registry.unregister_collector(
+            self._collector_key, self._telemetry
+        )
+
+
+#: Seconds between SSE keepalive comments while a stream is idle.
+_KEEPALIVE_S = 10.0
+
+
+class _Handler(BaseHTTPRequestHandler):
+    broadcast: BroadcastServer
+
+    protocol_version = "HTTP/1.1"
+
+    def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+        path = self.path.split("?", 1)[0]
+        if path == "/results":
+            self._serve_index()
+        elif path.startswith("/streams/"):
+            self._serve_stream(path)
+        else:
+            self._json_error(
+                404, "unknown path (try /results or /streams/<job>/<output>)"
+            )
+
+    def _json_error(self, code: int, message: str) -> None:
+        payload = json.dumps({"error": message}).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _serve_index(self) -> None:
+        hub = self.broadcast
+        streams = hub.cache.streams()
+        with hub._lock:
+            counts = {
+                stream: len(subs)
+                for stream, subs in hub._subscribers.items()
+            }
+        rows = []
+        for stream, cached in sorted(streams.items()):
+            job, _, output = stream.partition("/")
+            rows.append(
+                {
+                    "job": job,
+                    "output": output,
+                    "stream": stream,
+                    "epoch": cached.epoch,
+                    "seq": cached.seq,
+                    "frame_bytes": len(cached.frame),
+                    "subscribers": counts.get(stream, 0),
+                    "path": f"/streams/{stream}",
+                }
+            )
+        payload = json.dumps({"streams": rows}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _serve_stream(self, path: str) -> None:
+        parts = path.split("/", 3)
+        if len(parts) < 4 or not parts[2] or not parts[3]:
+            self._json_error(404, "expected /streams/<job>/<output>")
+            return
+        stream = stream_key(unquote(parts[2]), unquote(parts[3]))
+        hub = self.broadcast
+        if hub.cache.latest(stream) is None:
+            self._json_error(
+                404,
+                f"no published results for stream {stream!r} "
+                "(see /results for the index)",
+            )
+            return
+        sub = hub.subscribe(stream)
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            # SSE is an unbounded response: no Content-Length, and the
+            # connection closes when either side goes away.
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.wfile.write(b"retry: 3000\n\n")
+            last_write = time.monotonic()
+            while not hub.stopped:
+                blob = sub.next_blob(timeout=0.5)
+                if blob is None:
+                    if time.monotonic() - last_write >= _KEEPALIVE_S:
+                        self.wfile.write(b": keepalive\n\n")
+                        self.wfile.flush()
+                        last_write = time.monotonic()
+                    continue
+                header = decode_header(blob)
+                kind = b"keyframe" if header.keyframe else b"delta"
+                self.wfile.write(
+                    b"id: %d\nevent: %s\ndata: %s\n\n"
+                    % (header.seq, kind, base64.b64encode(blob))
+                )
+                self.wfile.flush()
+                last_write = time.monotonic()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # Consumer went away mid-stream: routine, not an error.
+            logger.debug("SSE subscriber %d disconnected", sub.sub_id)
+        finally:
+            hub.unsubscribe(sub)
+
+    def log_message(self, format: str, *args) -> None:  # noqa: A002
+        logger.debug("serving http: " + format, *args)

@@ -1,0 +1,290 @@
+"""Delta codec for the result fan-out tier (ADR 0117).
+
+Rolling histograms change sparsely between publish ticks: a window's
+events touch a few hundred bins of a multi-hundred-kB cumulative frame,
+and the rest of the da00 wire (coords, axes, masks, the flatbuffer
+scaffolding) is byte-identical from tick to tick. This module encodes
+that sparsity: a **delta blob** carries only the byte runs that changed
+against the previous frame, and a subscriber applying it to its copy of
+the previous frame reconstructs the new da00 frame **byte-identically**
+— the wire a Kafka consumer of the same publish would have seen
+(pinned in tests/serving/delta_codec_test.py and the fan-out
+integration suite).
+
+Diffing at the byte level (not per-variable) is deliberate: it makes
+exact round-trip a structural property instead of a per-schema promise
+— timestamps, end_time coords and normalization denominators that
+change every tick ride the same run encoding as the histogram bins, and
+a frame whose da00 *layout* changed (projection swap, new output shape)
+simply fails the equal-length precondition and degrades to a keyframe.
+
+Blob wire format (version 1, little-endian; see docs/serving.md):
+
+====== ====== ==========================================================
+offset size   field
+====== ====== ==========================================================
+0      2      magic ``LD``
+2      1      version (1)
+3      1      flags — bit 0: keyframe
+4      4      epoch (u32): bumped by the ResultCache on a layout-digest
+              swap or a ``state_lost``/reset generation change; a delta
+              never applies across epochs
+8      4      seq (u32): per-stream publish tick counter
+12     4      frame length (u32)
+16     ...    keyframe: the full frame. delta: u32 run count, then per
+              run u32 offset, u32 length, ``length`` raw bytes
+====== ====== ==========================================================
+
+**Dense fallback**: when the encoded runs would meet or exceed the full
+frame size (first frames after a counts reset, a dense current-window
+output, random noise), the encoder emits a keyframe instead — a delta
+blob is never larger than the keyframe for the same tick.
+
+Codec state is intentionally asymmetric:
+
+- :class:`DeltaEncoder` is single-writer (the service's publish hook;
+  one per stream) and encodes ONCE per tick no matter how many
+  subscribers are attached — that is the fan-out saving.
+- :class:`DeltaDecoder` is per-subscriber: keyframes (re)base it at any
+  time, stale deltas (seq <= current, same epoch — a race between
+  subscriber attach and an in-flight fan-out) are idempotent no-ops,
+  and a gap or epoch mismatch raises :class:`DeltaError` so a consumer
+  resyncs with a keyframe instead of silently diverging.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = [
+    "DeltaDecoder",
+    "DeltaEncoder",
+    "DeltaError",
+    "DeltaHeader",
+    "FLAG_KEYFRAME",
+    "HEADER_SIZE",
+    "decode_header",
+    "encode_delta",
+    "encode_keyframe",
+]
+
+_MAGIC = b"LD"
+_VERSION = 1
+FLAG_KEYFRAME = 0x01
+
+_HEADER = struct.Struct("<2sBBIII")
+HEADER_SIZE = _HEADER.size  # 16
+
+#: Two changed bytes closer than this are cheaper as one run than as
+#: two (a run costs 8 bytes of offset+length framing).
+_RUN_MERGE_GAP = 8
+
+
+class DeltaError(ValueError):
+    """Malformed blob, or a delta that cannot apply to the held base."""
+
+
+@dataclass(frozen=True, slots=True)
+class DeltaHeader:
+    keyframe: bool
+    epoch: int
+    seq: int
+    frame_len: int
+
+
+def decode_header(blob: bytes) -> DeltaHeader:
+    if len(blob) < HEADER_SIZE:
+        raise DeltaError(f"blob too short for header: {len(blob)} bytes")
+    magic, version, flags, epoch, seq, frame_len = _HEADER.unpack_from(blob)
+    if magic != _MAGIC:
+        raise DeltaError(f"bad magic {magic!r}")
+    if version != _VERSION:
+        raise DeltaError(f"unsupported delta version {version}")
+    return DeltaHeader(
+        keyframe=bool(flags & FLAG_KEYFRAME),
+        epoch=epoch,
+        seq=seq,
+        frame_len=frame_len,
+    )
+
+
+def encode_keyframe(frame: bytes, *, epoch: int, seq: int) -> bytes:
+    """The full frame, self-contained — what a fresh (or overflowed)
+    subscriber receives to (re)base its decoder."""
+    return (
+        _HEADER.pack(_MAGIC, _VERSION, FLAG_KEYFRAME, epoch, seq, len(frame))
+        + frame
+    )
+
+
+def _changed_runs(prev: bytes, cur: bytes) -> list[tuple[int, int]]:
+    """(offset, length) byte runs where ``cur`` differs from ``prev``
+    (equal lengths required), nearby runs merged so framing overhead
+    never dominates genuinely sparse change."""
+    a = np.frombuffer(prev, dtype=np.uint8)
+    b = np.frombuffer(cur, dtype=np.uint8)
+    idx = np.flatnonzero(a != b)
+    if idx.size == 0:
+        return []
+    breaks = np.flatnonzero(np.diff(idx) > _RUN_MERGE_GAP)
+    starts = idx[np.concatenate(([0], breaks + 1))]
+    ends = idx[np.concatenate((breaks, [idx.size - 1]))] + 1
+    return list(zip(starts.tolist(), (ends - starts).tolist()))
+
+
+def encode_delta(
+    prev: bytes, cur: bytes, *, epoch: int, seq: int
+) -> bytes:
+    """Delta blob of ``cur`` against ``prev`` — or a keyframe when the
+    lengths differ or the runs would not undercut the full frame (dense
+    fallback). The caller does not need to know which: the blob header
+    says, and :class:`DeltaDecoder` handles both."""
+    if len(prev) != len(cur):
+        return encode_keyframe(cur, epoch=epoch, seq=seq)
+    runs = _changed_runs(prev, cur)
+    payload = sum(length for _, length in runs)
+    if 4 + 8 * len(runs) + payload >= len(cur):
+        return encode_keyframe(cur, epoch=epoch, seq=seq)
+    parts = [
+        _HEADER.pack(_MAGIC, _VERSION, 0, epoch, seq, len(cur)),
+        struct.pack("<I", len(runs)),
+    ]
+    for offset, length in runs:
+        parts.append(struct.pack("<II", offset, length))
+        parts.append(cur[offset : offset + length])
+    return b"".join(parts)
+
+
+class DeltaEncoder:
+    """Per-stream encoder: previous frame + epoch, keyframe-on-change.
+
+    Single-writer by contract — the broadcast hub calls it from the one
+    publish hook (the service's step worker); it holds no lock of its
+    own. ``encode`` returns the blob every *attached* subscriber gets
+    (one encode per tick, shared), ``keyframe`` re-emits the current
+    state for a subscriber that attached late or overflowed.
+    """
+
+    __slots__ = ("_prev", "_epoch", "_seq")
+
+    def __init__(self) -> None:
+        self._prev: bytes | None = None
+        self._epoch: int | None = None
+        self._seq: int | None = None
+
+    @property
+    def seq(self) -> int | None:
+        return self._seq
+
+    def encode(self, frame: bytes, *, epoch: int, seq: int) -> bytes:
+        """The blob for this tick: a delta against the previous frame,
+        or a keyframe on the first frame, an epoch change (layout swap /
+        ``state_lost`` — a delta across state generations would splice
+        unrelated accumulations), or the dense fallback."""
+        prev, prev_epoch = self._prev, self._epoch
+        self._prev, self._epoch, self._seq = frame, epoch, seq
+        if prev is None or prev_epoch != epoch:
+            return encode_keyframe(frame, epoch=epoch, seq=seq)
+        return encode_delta(prev, frame, epoch=epoch, seq=seq)
+
+    def keyframe(self) -> bytes | None:
+        """A keyframe of the current state (same epoch/seq as the last
+        ``encode``), or None before the first frame."""
+        if self._prev is None:
+            return None
+        return encode_keyframe(
+            self._prev, epoch=self._epoch, seq=self._seq
+        )
+
+
+class DeltaDecoder:
+    """Per-subscriber reconstruction: keyframes rebase, deltas patch.
+
+    ``apply`` returns the full reconstructed frame — byte-identical to
+    the publisher's da00 wire for that tick. Stale deltas (seq <= the
+    held seq in the same epoch) return the held frame unchanged: the
+    attach flow enqueues a keyframe from the cache and an in-flight
+    fan-out may race one already-covered delta behind it. Anything the
+    decoder cannot prove applies (epoch mismatch, a seq gap, a length
+    mismatch) raises :class:`DeltaError` — the consumer's cue to
+    resubscribe for a keyframe, never to guess.
+    """
+
+    __slots__ = ("_frame", "_epoch", "_seq")
+
+    def __init__(self) -> None:
+        self._frame: bytearray | None = None
+        self._epoch: int | None = None
+        self._seq: int | None = None
+
+    @property
+    def epoch(self) -> int | None:
+        return self._epoch
+
+    @property
+    def seq(self) -> int | None:
+        return self._seq
+
+    def frame(self) -> bytes | None:
+        return None if self._frame is None else bytes(self._frame)
+
+    def apply(self, blob: bytes) -> bytes:
+        header = decode_header(blob)
+        body = blob[HEADER_SIZE:]
+        if header.keyframe:
+            if len(body) != header.frame_len:
+                raise DeltaError(
+                    f"keyframe length {len(body)} != header "
+                    f"{header.frame_len}"
+                )
+            self._frame = bytearray(body)
+            self._epoch = header.epoch
+            self._seq = header.seq
+            return bytes(self._frame)
+        if self._frame is None:
+            raise DeltaError("delta before any keyframe")
+        if header.epoch != self._epoch:
+            raise DeltaError(
+                f"delta epoch {header.epoch} != held epoch {self._epoch}"
+            )
+        if header.seq <= self._seq:
+            # Attach race: the cache keyframe already covers this tick.
+            return bytes(self._frame)
+        if header.seq != self._seq + 1:
+            raise DeltaError(
+                f"delta seq {header.seq} after {self._seq}: gap "
+                "(coalesced away?) — resync with a keyframe"
+            )
+        if header.frame_len != len(self._frame):
+            raise DeltaError(
+                f"delta frame length {header.frame_len} != held "
+                f"{len(self._frame)}"
+            )
+        if len(body) < 4:
+            raise DeltaError("delta body too short for run count")
+        (n_runs,) = struct.unpack_from("<I", body, 0)
+        pos = 4
+        frame = self._frame
+        for _ in range(n_runs):
+            if pos + 8 > len(body):
+                raise DeltaError("delta run header extends past blob")
+            offset, length = struct.unpack_from("<II", body, pos)
+            pos += 8
+            if pos + length > len(body):
+                raise DeltaError("delta run data extends past blob")
+            if offset + length > len(frame):
+                raise DeltaError(
+                    f"delta run [{offset}:{offset + length}] outside "
+                    f"frame of {len(frame)} bytes"
+                )
+            frame[offset : offset + length] = body[pos : pos + length]
+            pos += length
+        if pos != len(body):
+            raise DeltaError(
+                f"{len(body) - pos} trailing bytes after delta runs"
+            )
+        self._seq = header.seq
+        return bytes(frame)

@@ -231,3 +231,134 @@ class TestPerSliceRtt:
             monitor.observe_publish(0.02)
         assert monitor.rtt_s() is not None
         assert monitor.stats()["rtt_by_slice"] == {}
+
+
+class TestFanoutDemandAxis:
+    """ADR 0117: the broadcast plane's subscriber count + queue
+    pressure drive publish coalescing — back off when nobody watches,
+    tighten the instant a viewer attaches, mild widening under
+    sustained consumer pressure (dead-zoned)."""
+
+    def _clocked(self, monkeypatch, **kwargs):
+        from esslivedata_tpu.core import link_monitor as lm
+
+        now = [1000.0]
+        monkeypatch.setattr(lm.time, "monotonic", lambda: now[0])
+        return LinkMonitor(**kwargs), now
+
+    def test_neutral_until_a_plane_reports(self):
+        monitor = LinkMonitor()
+        policy = monitor.policy()
+        assert policy.publish_coalesce == 1
+        assert policy.fanout_coalesce == 1
+        assert monitor.stats()["fanout_subscribers"] is None
+
+    def test_idle_backoff_after_grace_not_before(self, monkeypatch):
+        monitor, now = self._clocked(monkeypatch)
+        monitor.observe_fanout(0, 0.0)
+        # Inside the grace window: a reconnect blip must not widen.
+        now[0] += 2.0
+        assert monitor.policy().fanout_coalesce == 1
+        # Grace elapsed with nobody watching: back off.
+        now[0] += 9.0
+        policy = monitor.policy()
+        assert policy.fanout_coalesce == 4
+        assert policy.publish_coalesce == 4
+
+    def test_attach_tightens_instantly(self, monkeypatch):
+        monitor, now = self._clocked(monkeypatch)
+        monitor.observe_fanout(0, 0.0)
+        now[0] += 60.0
+        assert monitor.policy().publish_coalesce == 4
+        # One subscriber attaches: no hysteresis wait for fresh data.
+        monitor.observe_fanout(1, 0.0)
+        policy = monitor.policy()
+        assert policy.fanout_coalesce == 1
+        assert policy.publish_coalesce == 1
+
+    def test_idle_clock_restarts_after_every_attach(self, monkeypatch):
+        monitor, now = self._clocked(monkeypatch)
+        monitor.observe_fanout(0, 0.0)
+        now[0] += 60.0
+        monitor.observe_fanout(3, 0.0)
+        monitor.observe_fanout(0, 0.0)  # viewers left again
+        now[0] += 5.0
+        assert monitor.policy().fanout_coalesce == 1  # grace restarted
+        now[0] += 6.0
+        assert monitor.policy().fanout_coalesce == 4
+
+    def test_pressure_latch_with_dead_zone(self):
+        monitor = LinkMonitor()
+        monitor.observe_fanout(5, 0.9)  # over the high watermark
+        assert monitor.policy().fanout_coalesce == 2
+        # Inside the dead zone: latched.
+        monitor.observe_fanout(5, 0.5)
+        assert monitor.policy().fanout_coalesce == 2
+        # Under the low watermark: released.
+        monitor.observe_fanout(5, 0.1)
+        assert monitor.policy().fanout_coalesce == 1
+
+    def test_widest_axis_wins_and_cap_holds(self, monkeypatch):
+        monitor, now = self._clocked(
+            monkeypatch, fanout_idle_coalesce=16, max_publish_coalesce=8
+        )
+        # RTT latch engaged at width 4 (88 ms over the 50 ms threshold).
+        for _ in range(40):
+            monitor.observe_publish(0.088)
+        assert monitor.policy().publish_coalesce == 4
+        # Idle backoff wider than RTT: fanout wins, capped at max.
+        monitor.observe_fanout(0, 0.0)
+        now[0] += 60.0
+        policy = monitor.policy()
+        assert policy.fanout_coalesce == 8  # capped
+        assert policy.publish_coalesce == 8
+        # Viewer attaches: RTT width remains the binding axis.
+        monitor.observe_fanout(2, 0.0)
+        policy = monitor.policy()
+        assert policy.fanout_coalesce == 1
+        assert policy.publish_coalesce == 4
+
+    def test_stats_surface_and_coherence(self):
+        monitor = LinkMonitor()
+        monitor.observe_fanout(7, 0.3)
+        stats = monitor.stats()
+        assert stats["fanout_subscribers"] == 7
+        assert stats["fanout_pressure"] == 0.3
+        assert stats["fanout_coalesce"] == stats["publish_coalesce"] == 1
+
+    def test_stats_lock_hammer_includes_fanout_fields(self):
+        """Extend the PR 9 stats-coherence contract: concurrent
+        observe_fanout + stats() never tear (fanout_coalesce > 1 must
+        imply the snapshot saw zero subscribers or high pressure)."""
+        monitor = LinkMonitor(fanout_idle_grace_s=0.0)
+        stop = threading.Event()
+        errors: list[str] = []
+
+        def feeder():
+            i = 0
+            while not stop.is_set():
+                monitor.observe_fanout(i % 2, 0.0)
+                i += 1
+
+        def reader():
+            while not stop.is_set():
+                stats = monitor.stats()
+                if (
+                    stats["fanout_coalesce"] > 1
+                    and stats["fanout_subscribers"] not in (0, None)
+                ):
+                    errors.append(str(stats))
+                    return
+
+        threads = [threading.Thread(target=feeder)] + [
+            threading.Thread(target=reader) for _ in range(2)
+        ]
+        for t in threads:
+            t.start()
+        import time as _time
+
+        _time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        assert not errors, errors[0]

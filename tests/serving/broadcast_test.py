@@ -1,0 +1,335 @@
+"""Broadcast plane (serving/broadcast.py, ADR 0117).
+
+Hub semantics (attach keyframes, shared-encode fan-out, slow-subscriber
+coalescing with bounded memory and keyframe recovery), the SSE/HTTP
+surface over real sockets, QoS and the ``livedata_serving_*``
+telemetry families.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.serving import (
+    BroadcastServer,
+    DeltaDecoder,
+    decode_header,
+)
+from esslivedata_tpu.serving.broadcast import SERVING_COALESCE_DROPS
+from esslivedata_tpu.telemetry import REGISTRY
+
+
+def frames(n: int, size: int = 4000, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    frame = rng.integers(0, 256, size).astype(np.uint8).tobytes()
+    out = [frame]
+    for _ in range(n - 1):
+        arr = bytearray(out[-1])
+        for i in rng.integers(0, size, 25):
+            arr[i] = (arr[i] + 1) % 256
+        out.append(bytes(arr))
+    return out
+
+
+class TestHub:
+    def test_attach_gets_cached_keyframe_then_deltas(self):
+        hub = BroadcastServer(port=None)
+        try:
+            series = frames(4)
+            hub.publish_frame("s", series[0], token="t")
+            sub = hub.subscribe("s")
+            decoder = DeltaDecoder()
+            blob = sub.next_blob(1.0)
+            assert decode_header(blob).keyframe
+            assert decoder.apply(blob) == series[0]
+            for cur in series[1:]:
+                hub.publish_frame("s", cur, token="t")
+                blob = sub.next_blob(1.0)
+                assert not decode_header(blob).keyframe
+                assert decoder.apply(blob) == cur
+        finally:
+            hub.close()
+
+    def test_attach_before_first_publish_waits_for_keyframe(self):
+        hub = BroadcastServer(port=None)
+        try:
+            sub = hub.subscribe("s")
+            assert sub.next_blob(0.05) is None
+            hub.publish_frame("s", b"first", token="t")
+            blob = sub.next_blob(1.0)
+            assert decode_header(blob).keyframe
+            assert DeltaDecoder().apply(blob) == b"first"
+        finally:
+            hub.close()
+
+    def test_every_subscriber_gets_the_same_shared_blob(self):
+        hub = BroadcastServer(port=None)
+        try:
+            series = frames(3)
+            hub.publish_frame("s", series[0], token="t")
+            subs = [hub.subscribe("s") for _ in range(5)]
+            for sub in subs:
+                sub.next_blob(1.0)  # attach keyframe
+            hub.publish_frame("s", series[1], token="t")
+            blobs = {sub.next_blob(1.0) for sub in subs}
+            # One encode per tick, shared across subscribers.
+            assert len(blobs) == 1
+        finally:
+            hub.close()
+
+    def test_unsubscribe_stops_delivery(self):
+        hub = BroadcastServer(port=None)
+        try:
+            hub.publish_frame("s", b"f0", token="t")
+            sub = hub.subscribe("s")
+            sub.next_blob(1.0)
+            hub.unsubscribe(sub)
+            hub.publish_frame("s", b"f1" * 100, token="t")
+            assert sub.next_blob(0.05) is None
+        finally:
+            hub.close()
+
+    def test_epoch_bump_reaches_subscriber_as_keyframe(self):
+        hub = BroadcastServer(port=None)
+        try:
+            series = frames(3)
+            hub.publish_frame("s", series[0], token="a")
+            sub = hub.subscribe("s")
+            decoder = DeltaDecoder()
+            decoder.apply(sub.next_blob(1.0))
+            hub.publish_frame("s", series[1], token="a")
+            decoder.apply(sub.next_blob(1.0))
+            # Token change (layout swap / state_lost): keyframe, epoch+1.
+            hub.publish_frame("s", series[2], token="b")
+            blob = sub.next_blob(1.0)
+            header = decode_header(blob)
+            assert header.keyframe and header.epoch == 1
+            assert decoder.apply(blob) == series[2]
+        finally:
+            hub.close()
+
+    def test_drop_stream_forgets_cache_and_encoder(self):
+        hub = BroadcastServer(port=None)
+        try:
+            hub.publish_frame("s", b"f0", token="t")
+            hub.drop_stream("s")
+            assert hub.cache.latest("s") is None
+            # Re-publish restarts at epoch 0/seq 0 with a keyframe.
+            hub.publish_frame("s", b"f1", token="t")
+            cached = hub.cache.latest("s")
+            assert (cached.epoch, cached.seq) == (0, 0)
+        finally:
+            hub.close()
+
+    def test_drop_job_forgets_every_stream_of_that_job_only(self):
+        hub = BroadcastServer(port=None)
+        try:
+            hub.publish_frame("job1:u/current", b"a", token="t")
+            hub.publish_frame("job1:u/cumulative", b"b", token="t")
+            hub.publish_frame("job2:v/current", b"c", token="t")
+            assert hub.drop_job("job1:u") == 2
+            assert set(hub.cache.streams()) == {"job2:v/current"}
+        finally:
+            hub.close()
+
+
+class TestSlowSubscriberCoalescing:
+    def test_bounded_memory_and_keyframe_recovery(self):
+        """The satellite acceptance: a consumer that never drains keeps
+        a queue bounded at ``queue_limit``, loses intermediate deltas
+        (counted as coalesce drops), and on its next drain recovers the
+        EXACT latest frame from the resync keyframe."""
+        limit = 4
+        hub = BroadcastServer(port=None, queue_limit=limit)
+        try:
+            drops0 = SERVING_COALESCE_DROPS.total()
+            series = frames(50, size=2000, seed=3)
+            hub.publish_frame("s", series[0], token="t")
+            sub = hub.subscribe("s")
+            for cur in series[1:]:
+                hub.publish_frame("s", cur, token="t")
+            assert sub.depth() <= limit
+            assert SERVING_COALESCE_DROPS.total() > drops0
+            decoder = DeltaDecoder()
+            out = None
+            while (blob := sub.next_blob(0.05)) is not None:
+                out = decoder.apply(blob)
+            assert out == series[-1]
+        finally:
+            hub.close()
+
+    def test_fast_subscriber_unaffected_by_slow_peer(self):
+        hub = BroadcastServer(port=None, queue_limit=3)
+        try:
+            series = frames(30, size=2000, seed=4)
+            hub.publish_frame("s", series[0], token="t")
+            fast = hub.subscribe("s")
+            slow = hub.subscribe("s")
+            decoder = DeltaDecoder()
+            decoder.apply(fast.next_blob(1.0))
+            for cur in series[1:]:
+                hub.publish_frame("s", cur, token="t")
+                assert decoder.apply(fast.next_blob(1.0)) == cur
+            assert slow.depth() <= 3
+        finally:
+            hub.close()
+
+    def test_publish_never_blocks_on_wedged_consumer(self):
+        """The publish hook must complete in bounded time no matter how
+        wedged a consumer is — enqueue is put_nowait + coalesce, never
+        a blocking put."""
+        hub = BroadcastServer(port=None, queue_limit=2)
+        try:
+            hub.publish_frame("s", b"0" * 1000, token="t")
+            hub.subscribe("s")  # never drained
+            start = time.monotonic()
+            for i in range(200):
+                hub.publish_frame("s", bytes([i % 256]) * 1000, token="t")
+            assert time.monotonic() - start < 5.0
+        finally:
+            hub.close()
+
+
+class TestQos:
+    def test_counts_and_pressure(self):
+        hub = BroadcastServer(port=None, queue_limit=4)
+        try:
+            assert hub.qos() == {"subscribers": 0, "queue_pressure": 0.0}
+            hub.publish_frame("s", b"f0", token="t")
+            sub = hub.subscribe("s")
+            hub.subscribe("other")
+            qos = hub.qos()
+            assert qos["subscribers"] == 2
+            assert qos["queue_pressure"] == pytest.approx(0.25)  # keyframe
+            sub.next_blob(1.0)
+            assert hub.qos()["queue_pressure"] == 0.0
+        finally:
+            hub.close()
+
+
+class TestTelemetry:
+    def test_serving_families_present_and_labeled(self):
+        hub = BroadcastServer(port=None, name="testsrv")
+        try:
+            hub.publish_frame("s", b"f0" * 50, token="t")
+            sub = hub.subscribe("s")
+            sub.next_blob(1.0)
+            families = {f.name: f for f in REGISTRY.collect()}
+            assert "livedata_serving_frames" in families
+            assert "livedata_serving_bytes" in families
+            assert "livedata_serving_coalesce_drops" in families
+            subs_family = families["livedata_serving_subscribers"]
+            rows = {
+                dict(s.labels).get("stream"): s.value
+                for s in subs_family.samples
+                if dict(s.labels).get("server") == "testsrv"
+            }
+            assert rows.get("s") == 1
+            assert rows.get("all") == 1
+            depth_family = families["livedata_serving_queue_depth"]
+            assert any(
+                dict(s.labels).get("server") == "testsrv"
+                for s in depth_family.samples
+            )
+        finally:
+            hub.close()
+
+    def test_collector_unregisters_on_close(self):
+        hub = BroadcastServer(port=None, name="closing")
+        hub.publish_frame("s", b"f0", token="t")
+        hub.subscribe("s")
+        hub.close()
+        families = [
+            s
+            for f in REGISTRY.collect()
+            if f.name == "livedata_serving_subscribers"
+            for s in f.samples
+            if dict(s.labels).get("server") == "closing"
+        ]
+        assert not families
+
+
+class TestHttpSurface:
+    @pytest.fixture()
+    def hub(self):
+        hub = BroadcastServer(port=0, host="127.0.0.1")
+        yield hub
+        hub.close()
+
+    def _get(self, hub, path, timeout=5.0):
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{hub.port}{path}", timeout=timeout
+        )
+
+    def test_results_index(self, hub):
+        hub.publish_frame("job1:u/current", b"x" * 100, token="t")
+        with self._get(hub, "/results") as response:
+            index = json.loads(response.read())
+        (row,) = index["streams"]
+        assert row["job"] == "job1:u"
+        assert row["output"] == "current"
+        assert row["frame_bytes"] == 100
+        assert row["path"] == "/streams/job1:u/current"
+
+    def test_sse_keyframe_then_delta(self, hub):
+        series = frames(2, size=3000, seed=7)
+        hub.publish_frame("j:u/out", series[0], token="t")
+        response = self._get(hub, "/streams/j:u/out", timeout=10)
+
+        def publish_later():
+            time.sleep(0.2)
+            hub.publish_frame("j:u/out", series[1], token="t")
+
+        threading.Thread(target=publish_later, daemon=True).start()
+        decoder = DeltaDecoder()
+        events = []
+        kind = None
+        for raw in response:
+            line = raw.decode().rstrip("\n")
+            if line.startswith("event: "):
+                kind = line[len("event: "):]
+            elif line.startswith("data: "):
+                blob = base64.b64decode(line[len("data: "):])
+                events.append((kind, decoder.apply(blob)))
+                if len(events) == 2:
+                    break
+        response.close()
+        assert events[0] == ("keyframe", series[0])
+        assert events[1] == ("delta", series[1])
+
+    def test_unknown_stream_404s_with_hint(self, hub):
+        with pytest.raises(urllib.error.HTTPError) as excinfo:
+            self._get(hub, "/streams/none/such")
+        assert excinfo.value.code == 404
+        assert "results" in json.loads(excinfo.value.read())["error"]
+
+    def test_unknown_path_404s(self, hub):
+        with pytest.raises(urllib.error.HTTPError) as excinfo:
+            self._get(hub, "/nope")
+        assert excinfo.value.code == 404
+
+    def test_subscriber_cleanup_after_disconnect(self, hub):
+        hub.publish_frame("j:u/out", b"f" * 50, token="t")
+        response = self._get(hub, "/streams/j:u/out", timeout=10)
+        # Read the attach keyframe, then hang up.
+        for raw in response:
+            if raw.startswith(b"data: "):
+                break
+        response.close()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if hub.qos()["subscribers"] == 0:
+                break
+            # The handler notices the closed socket on its next write
+            # attempt; publishes provoke one.
+            hub.publish_frame("j:u/out", b"g" * 50, token="t")
+            time.sleep(0.05)
+        assert hub.qos()["subscribers"] == 0

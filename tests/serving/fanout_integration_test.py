@@ -1,0 +1,513 @@
+"""Fan-out tier through the REAL JobManager path (ADR 0117).
+
+The acceptance contract: a subscriber's reconstructed frames are
+BYTE-IDENTICAL to the da00 wire the Kafka sink serializer produces for
+the same publish — keyframe and delta paths both — for detector-view,
+monitor and a Q-family (SANS I(Q)) workflow; epoch bumps fire on
+reset/``state_lost`` generation changes; and the processor hook feeds
+the plane exactly the results it feeds the sink.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from esslivedata_tpu.config import JobId, WorkflowConfig, WorkflowSpec
+from esslivedata_tpu.core.job import Job, JobResult
+from esslivedata_tpu.core.job_manager import (
+    JobCommand,
+    JobFactory,
+    JobManager,
+)
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.kafka.da00_compat import dataarray_to_da00
+from esslivedata_tpu.kafka.wire import decode_da00, encode_da00
+from esslivedata_tpu.ops import EventBatch
+from esslivedata_tpu.preprocessors import (
+    DetectorEvents,
+    MonitorEvents,
+    ToEventBatch,
+)
+from esslivedata_tpu.preprocessors.event_data import StagedEvents
+from esslivedata_tpu.serving import (
+    DeltaDecoder,
+    ServingPlane,
+    decode_header,
+    stream_key,
+)
+from esslivedata_tpu.workflows import WorkflowFactory
+from esslivedata_tpu.workflows.detector_view import (
+    DetectorViewWorkflow,
+    project_logical,
+)
+from esslivedata_tpu.workflows.monitor_workflow import MonitorWorkflow
+from esslivedata_tpu.workflows.sans import SansIQParams, SansIQWorkflow
+
+T = Timestamp.from_ns
+
+
+def staged(pid, toa) -> StagedEvents:
+    return StagedEvents(
+        batch=EventBatch.from_arrays(
+            np.asarray(pid), np.asarray(toa, np.float32)
+        ),
+        first_timestamp=None,
+        last_timestamp=None,
+        n_chunks=1,
+    )
+
+
+def staged_monitor(n: int) -> StagedEvents:
+    acc = ToEventBatch(min_bucket=16)
+    acc.add(
+        T(0),
+        MonitorEvents(
+            time_of_arrival=np.linspace(1e6, 6e7, n).astype(np.float32)
+        ),
+    )
+    return acc.get()
+
+
+def sink_wire(result, ts) -> dict[str, bytes]:
+    """stream -> the EXACT bytes the Kafka sink serializer publishes."""
+    job = f"{result.job_id.source_name}:{result.job_id.job_number}"
+    return {
+        stream_key(job, key.output_name): encode_da00(
+            key.to_string(), ts.ns, dataarray_to_da00(da)
+        )
+        for key, da in zip(
+            result.keys(), result.outputs.values(), strict=True
+        )
+    }
+
+
+class _Checker:
+    """One decoding subscriber per stream, asserting byte identity."""
+
+    def __init__(self, plane: ServingPlane) -> None:
+        self.plane = plane
+        self.subs: dict[str, tuple] = {}
+        self.saw_delta = False
+        self.saw_keyframe = False
+
+    def expect(self, references: dict[str, bytes], window: int) -> None:
+        for stream, reference in references.items():
+            entry = self.subs.get(stream)
+            if entry is None:
+                entry = self.subs[stream] = (
+                    self.plane.server.subscribe(stream),
+                    DeltaDecoder(),
+                )
+            sub, decoder = entry
+            got = None
+            while (blob := sub.next_blob(timeout=2.0)) is not None:
+                header = decode_header(blob)
+                if header.keyframe:
+                    self.saw_keyframe = True
+                else:
+                    self.saw_delta = True
+                got = decoder.apply(blob)
+                if got == reference:
+                    break
+            assert got == reference, (
+                f"window {window}: reconstruction != sink wire for "
+                f"{stream}"
+            )
+
+
+class TestByteIdentityThroughJobManager:
+    def _manager(self, makes, stream="det0", aux=None):
+        created = []
+        reg = WorkflowFactory()
+        identifiers = []
+        for i, make in enumerate(makes):
+            spec = WorkflowSpec(
+                instrument="test",
+                name=f"fanout{i}",
+                source_names=[stream],
+                aux_source_names={
+                    key: [value] for key, value in (aux or {}).items()
+                },
+            )
+
+            def factory(*, source_name, params, _make=make):
+                wf = _make()
+                created.append(wf)
+                return wf
+
+            reg.register_spec(spec).attach_factory(factory)
+            identifiers.append(spec.identifier)
+        mgr = JobManager(job_factory=JobFactory(reg), job_threads=2)
+        for identifier in identifiers:
+            mgr.schedule_job(
+                WorkflowConfig(
+                    identifier=identifier,
+                    job_id=JobId(source_name=stream),
+                    aux_source_names=aux or {},
+                )
+            )
+        return mgr, created
+
+    def test_detector_view_and_monitor_keyframe_and_delta_paths(self):
+        det = np.arange(144).reshape(12, 12)
+        mgr, _ = self._manager(
+            [
+                lambda: DetectorViewWorkflow(
+                    projection=project_logical(det)
+                ),
+                lambda: MonitorWorkflow(),
+            ]
+        )
+        plane = ServingPlane(port=None)
+        checker = _Checker(plane)
+        rng = np.random.default_rng(11)
+        try:
+            for w in range(5):
+                pid = rng.integers(-5, 150, 2500).astype(np.int64)
+                toa = rng.uniform(-1e6, 8e7, 2500).astype(np.float32)
+                results = mgr.process_jobs(
+                    {"det0": staged(pid, toa)}, start=T(0), end=T(w + 1)
+                )
+                assert len(results) == 2
+                ts = T(1000 + w)
+                plane.publish_results(results, ts)
+                for result in results:
+                    checker.expect(sink_wire(result, ts), w)
+            # Both wire paths exercised, both byte-identical.
+            assert checker.saw_keyframe and checker.saw_delta
+        finally:
+            mgr.shutdown()
+            plane.close()
+
+    def test_q_family_workflow_byte_identical(self):
+        ny = nx = 8
+        xs = np.linspace(-0.5, 0.5, nx)
+        gx, gy = np.meshgrid(xs, xs)
+        positions = np.stack(
+            [gx.reshape(-1), gy.reshape(-1), np.full(ny * nx, 5.0)],
+            axis=1,
+        )
+        pixel_ids = np.arange(1, ny * nx + 1)
+        mgr, _ = self._manager(
+            [
+                lambda: SansIQWorkflow(
+                    positions=positions,
+                    pixel_ids=pixel_ids,
+                    params=SansIQParams(q_bins=20),
+                    primary_stream="larmor_detector",
+                    monitor_streams={"monitor_1"},
+                )
+            ],
+            stream="larmor_detector",
+            aux={"monitor": "monitor_1"},
+        )
+        plane = ServingPlane(port=None)
+        checker = _Checker(plane)
+        rng = np.random.default_rng(12)
+        try:
+            for w in range(4):
+                pid = rng.integers(1, 65, 800).astype(np.int32)
+                toa = rng.uniform(1e6, 7e7, 800).astype(np.float32)
+                results = mgr.process_jobs(
+                    {
+                        "larmor_detector": staged(pid, toa),
+                        "monitor_1": staged_monitor(400),
+                    },
+                    start=T(0),
+                    end=T(w + 1),
+                )
+                assert len(results) == 1
+                ts = T(2000 + w)
+                plane.publish_results(results, ts)
+                checker.expect(sink_wire(results[0], ts), w)
+            assert checker.saw_delta
+        finally:
+            mgr.shutdown()
+            plane.close()
+
+    def test_remove_command_drops_the_jobs_streams(self):
+        """Job churn must not pin dead streams: the JobManager's
+        retire observer (wired by the processor; here directly) drops
+        the removed job's cache entries so /results stops listing it
+        and its frame ring frees."""
+        det = np.arange(64).reshape(8, 8)
+        mgr, _ = self._manager(
+            [lambda: DetectorViewWorkflow(projection=project_logical(det))]
+        )
+        plane = ServingPlane(port=None)
+        mgr.set_retire_observer(plane.drop_job)
+        rng = np.random.default_rng(14)
+        try:
+            pid = rng.integers(0, 64, 500).astype(np.int64)
+            toa = rng.uniform(0, 7e7, 500).astype(np.float32)
+            results = mgr.process_jobs(
+                {"det0": staged(pid, toa)}, start=T(0), end=T(1)
+            )
+            plane.publish_results(results, T(100))
+            assert plane.cache.streams()
+            assert mgr.handle_command(JobCommand(action="remove")) == 1
+            assert plane.cache.streams() == {}
+        finally:
+            mgr.shutdown()
+            plane.close()
+
+    def test_reset_bumps_epoch_and_forces_keyframe(self):
+        det = np.arange(64).reshape(8, 8)
+        mgr, _ = self._manager(
+            [lambda: DetectorViewWorkflow(projection=project_logical(det))]
+        )
+        plane = ServingPlane(port=None)
+        checker = _Checker(plane)
+        rng = np.random.default_rng(13)
+        try:
+            def window(w, ts_ns):
+                pid = rng.integers(0, 64, 1000).astype(np.int64)
+                toa = rng.uniform(0, 7e7, 1000).astype(np.float32)
+                results = mgr.process_jobs(
+                    {"det0": staged(pid, toa)}, start=T(0), end=T(w)
+                )
+                ts = T(ts_ns)
+                plane.publish_results(results, ts)
+                return results, ts
+
+            for w in range(3):
+                results, ts = window(w + 1, 3000 + w)
+                checker.expect(sink_wire(results[0], ts), w)
+            epochs_before = {
+                stream: decoder.epoch
+                for stream, (_, decoder) in checker.subs.items()
+            }
+            assert mgr.handle_command(JobCommand(action="reset")) == 1
+            results, ts = window(10, 3100)
+            references = sink_wire(results[0], ts)
+            for stream, reference in references.items():
+                sub, decoder = checker.subs[stream]
+                blob = sub.next_blob(timeout=2.0)
+                header = decode_header(blob)
+                assert header.keyframe, (
+                    f"{stream}: post-reset frame was not a keyframe"
+                )
+                assert header.epoch == epochs_before[stream] + 1
+                assert decoder.apply(blob) == reference
+        finally:
+            mgr.shutdown()
+            plane.close()
+
+
+class TestStateEpochSignals:
+    def test_job_clear_and_note_state_lost_bump(self):
+        class _Workflow:
+            def accumulate(self, data):
+                pass
+
+            def finalize(self):
+                return {}
+
+            def clear(self):
+                pass
+
+        from esslivedata_tpu.config.workflow_spec import WorkflowId
+
+        job = Job(
+            job_id=JobId(source_name="s"),
+            workflow_id=WorkflowId(
+                instrument="i", namespace="reduction", name="w", version=1
+            ),
+            workflow=_Workflow(),
+        )
+        assert job.state_epoch == 0
+        job.clear()
+        assert job.state_epoch == 1
+        job.note_state_lost()
+        assert job.state_epoch == 2
+
+    def test_job_result_carries_state_epoch(self):
+        class _Workflow:
+            def accumulate(self, data):
+                pass
+
+            def finalize(self):
+                return {}
+
+            def clear(self):
+                pass
+
+        from esslivedata_tpu.config.workflow_spec import WorkflowId
+
+        job = Job(
+            job_id=JobId(source_name="s"),
+            workflow_id=WorkflowId(
+                instrument="i", namespace="reduction", name="w", version=1
+            ),
+            workflow=_Workflow(),
+        )
+        job.note_state_lost()
+        assert job.get().state_epoch == 1
+
+    def test_state_epoch_alone_forces_keyframe_through_plane(self):
+        """Identical layout, identical bytes possible — the state_epoch
+        component of the token must still force keyframe + epoch bump
+        (the ``state_lost`` contract: a delta across a rebuilt
+        accumulator would splice unrelated generations)."""
+        from esslivedata_tpu.config.workflow_spec import ResultKey, WorkflowId
+        from esslivedata_tpu.utils.labeled import DataArray, Variable
+
+        wid = WorkflowId(
+            instrument="i", namespace="reduction", name="w", version=1
+        )
+        job_id = JobId(source_name="s")
+        da = DataArray(
+            Variable(np.arange(8, dtype=np.float64), ("x",), None),
+            name="out",
+        )
+
+        def result(epoch):
+            return JobResult(
+                job_id=job_id,
+                workflow_id=wid,
+                outputs={"out": da},
+                start=None,
+                end=None,
+                state_epoch=epoch,
+            )
+
+        plane = ServingPlane(port=None)
+        try:
+            plane.publish_results([result(0)], T(1))
+            stream = next(iter(plane.cache.streams()))
+            sub = plane.server.subscribe(stream)
+            decoder = DeltaDecoder()
+            decoder.apply(sub.next_blob(2.0))
+            epoch0 = decoder.epoch
+            plane.publish_results([result(0)], T(2))
+            assert not decode_header(
+                blob := sub.next_blob(2.0)
+            ).keyframe
+            decoder.apply(blob)
+            plane.publish_results([result(1)], T(3))
+            blob = sub.next_blob(2.0)
+            assert decode_header(blob).keyframe
+            decoder.apply(blob)
+            assert decoder.epoch == epoch0 + 1
+        finally:
+            plane.close()
+
+
+class TestPlaneReuse:
+    def test_closed_plane_is_not_reused(self):
+        from esslivedata_tpu.serving import get_or_create_plane
+        from esslivedata_tpu.serving.plane import _planes
+
+        _planes.pop(0, None)
+        first = get_or_create_plane(0, name="reuse-a")
+        try:
+            assert get_or_create_plane(0, name="reuse-a") is first
+        finally:
+            first.close()
+        second = get_or_create_plane(0, name="reuse-a")
+        try:
+            # A closed plane's listener is dead: the table must build a
+            # fresh one instead of silently running dark.
+            assert second is not first
+            assert second.port is not None
+        finally:
+            second.close()
+            _planes.pop(0, None)
+
+    def test_reuse_with_different_settings_warns(self, caplog):
+        import logging
+
+        from esslivedata_tpu.serving import get_or_create_plane
+        from esslivedata_tpu.serving.plane import _planes
+
+        _planes.pop(0, None)
+        plane = get_or_create_plane(0, name="warn-a")
+        try:
+            with caplog.at_level(
+                logging.WARNING, logger="esslivedata_tpu.serving.plane"
+            ):
+                assert get_or_create_plane(0, name="warn-b") is plane
+            assert any(
+                "different settings" in rec.message
+                for rec in caplog.records
+            )
+        finally:
+            plane.close()
+            _planes.pop(0, None)
+
+
+class TestProcessorHook:
+    def test_publish_results_mirrors_sink_and_is_contained(self):
+        """The OrchestratingProcessor hands the plane the same results
+        it hands the sink — and a raising fan-out must not break the
+        publish path."""
+        from esslivedata_tpu.core.orchestrating_processor import (
+            OrchestratingProcessor,
+        )
+        from esslivedata_tpu.core.fakes import (
+            FakeMessageSink,
+            FakeMessageSource,
+        )
+        from esslivedata_tpu.core.message_batcher import NaiveMessageBatcher
+        from esslivedata_tpu.core.preprocessor import PreprocessorFactory
+
+        class _Factory(PreprocessorFactory):
+            def make_preprocessor(self, stream):
+                return None
+
+        class _RecordingFanout:
+            def __init__(self, raise_on_publish=False):
+                self.calls = []
+                self.raise_on_publish = raise_on_publish
+
+            def publish_results(self, results, timestamp):
+                if self.raise_on_publish:
+                    raise RuntimeError("fanout down")
+                self.calls.append((list(results), timestamp))
+
+            def qos(self):
+                return {"subscribers": 0, "queue_pressure": 0.0}
+
+        for raising in (False, True):
+            fanout = _RecordingFanout(raise_on_publish=raising)
+            sink = FakeMessageSink()
+            processor = OrchestratingProcessor(
+                source=FakeMessageSource(),
+                sink=sink,
+                preprocessor_factory=_Factory(),
+                job_manager=JobManager(job_threads=1),
+                batcher=NaiveMessageBatcher(),
+                instrument="test",
+                service_name=f"fanout-hook-{raising}",
+                result_fanout=fanout,
+            )
+            from esslivedata_tpu.config.workflow_spec import WorkflowId
+            from esslivedata_tpu.utils.labeled import DataArray, Variable
+
+            result = JobResult(
+                job_id=JobId(source_name="s"),
+                workflow_id=WorkflowId(
+                    instrument="i",
+                    namespace="reduction",
+                    name="w",
+                    version=1,
+                ),
+                outputs={
+                    "out": DataArray(
+                        Variable(
+                            np.arange(4, dtype=np.float64), ("x",), None
+                        ),
+                        name="out",
+                    )
+                },
+                start=None,
+                end=None,
+            )
+            processor._publish_results([result], T(5))
+            assert sink.messages, "sink publish must happen either way"
+            if not raising:
+                assert len(fanout.calls) == 1
+                results, ts = fanout.calls[0]
+                assert results[0] is result
+                assert ts.ns == 5
+            processor.finalize()

@@ -249,6 +249,28 @@ def step(state, batch):
     STEPS.inc()
     return state, time.perf_counter() - t0
 ''',
+    # Both shapes of the broadcast fan-out hazard: the accept thread
+    # mutates the subscriber registry without the lock the publish
+    # thread's iteration holds, and per-tick frames append to a list
+    # nothing ever drains or bounds.
+    "JGL019": '''
+import threading
+
+class Hub:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subscribers = {}
+        self._frames = []
+
+    def subscribe(self, sub_id, sub):
+        self._subscribers[sub_id] = sub
+
+    def publish(self, frame):
+        self._frames.append(frame)
+        with self._lock:
+            for sub in self._subscribers.values():
+                sub.send(frame)
+''',
 }
 
 NEGATIVE = {
@@ -556,6 +578,40 @@ def step(state, batch):
     out = _step_impl(state, batch)
     STEPS.inc()
     return out, time.perf_counter() - t0
+''',
+    # The worked broadcast pattern: registry mutations under the lock
+    # (a *_locked helper trusted at its call site), the per-subscriber
+    # hand-off a bounded queue, and the only growable list drained by a
+    # method that reassigns it.
+    "JGL019": '''
+import queue
+import threading
+
+class Hub:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subscribers = {}
+        self._pending_frames = []
+        self._queue = queue.Queue(maxsize=8)
+
+    def subscribe(self, sub_id, sub):
+        with self._lock:
+            self._sweep_locked()
+            self._subscribers[sub_id] = sub
+
+    def _sweep_locked(self):
+        self._subscribers.pop("stale", None)
+
+    def publish(self, frame):
+        with self._lock:
+            self._pending_frames.append(frame)
+            for sub in self._subscribers.values():
+                sub.send(frame)
+
+    def drain(self):
+        with self._lock:
+            frames, self._pending_frames = self._pending_frames, []
+        return frames
 ''',
 }
 # fmt: on

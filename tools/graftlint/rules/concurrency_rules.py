@@ -1,6 +1,8 @@
 """Thread-safety hazards: JGL004 (unlocked shared mutation), JGL005
-(blocking calls in async bodies) and JGL010 (unbounded/untimeboxed
-queue hand-offs between threads that drive the device pipeline).
+(blocking calls in async bodies), JGL010 (unbounded/untimeboxed
+queue hand-offs between threads that drive the device pipeline) and
+JGL019 (broadcast fan-out state: unlocked subscriber-registry mutation,
+unbounded list fan-out buffers).
 
 JGL004 is a lightweight race detector scoped to modules that import
 ``threading`` (the Kafka consume thread / service worker split is this
@@ -14,6 +16,7 @@ store is atomic; it is the lost-update pattern that corrupts counters.
 from __future__ import annotations
 
 import ast
+import re
 from collections import defaultdict
 
 from ..context import FileContext
@@ -278,3 +281,268 @@ def unbounded_queue_handoff(ctx: FileContext):
             "observe shutdown or a peer stage's failure; loop on "
             f"'.{func.attr}(timeout=...)' and re-check the stop flag",
         )
+
+
+# -- JGL019: broadcast fan-out state --------------------------------------
+
+#: Attribute names that read as a per-subscriber registry: the mapping a
+#: broadcast accept thread mutates on attach/detach while the publish
+#: thread iterates it to fan out.
+_SUBSCRIBER_ATTR = re.compile(
+    r"subscriber|client|session|listener|watcher|viewer", re.IGNORECASE
+)
+#: Mutating calls on dict/set registries.
+_REGISTRY_MUTATORS = frozenset(
+    {"add", "append", "clear", "discard", "pop", "popitem", "remove",
+     "setdefault", "update"}
+)
+#: List attributes that read as per-message fan-out buffers (frames,
+#: backlogs...) — registration lists (listeners, plotters) grow per
+#: registration, not per message, and stay out of scope.
+_FANOUT_BUFFER_ATTR = re.compile(
+    r"buffer|backlog|pending|frame|blob|event|message|payload|queue",
+    re.IGNORECASE,
+)
+#: Test doubles intentionally record everything they are given.
+_DOUBLE_CLASS = re.compile(r"^(Fake|Stub|Mock|Recording)")
+#: Calls that bound a list (a class using any of these on the buffer is
+#: managing its growth).
+_LIST_BOUNDERS = frozenset({"pop", "clear", "remove"})
+
+
+def _self_attr_name(node: ast.AST) -> str | None:
+    """'x' for a ``self.x`` expression, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _flat_targets(targets: list[ast.AST]) -> list[ast.AST]:
+    """Assignment targets with tuple/list unpacking flattened — the
+    swap-drain idiom ``frames, self._buf = self._buf, []`` reassigns
+    ``self._buf`` just as surely as a plain store."""
+    out: list[ast.AST] = []
+    for target in targets:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            out.extend(_flat_targets(list(target.elts)))
+        else:
+            out.append(target)
+    return out
+
+
+def _init_container_attrs(
+    cls: ast.ClassDef,
+) -> tuple[set[str], set[str]]:
+    """(registry attrs, list attrs) assigned empty in ``__init__``:
+    ``self.x = {}`` / ``dict()`` / ``set()`` and ``self.y = []`` /
+    ``list()``."""
+    registries: set[str] = set()
+    lists: set[str] = set()
+    for method in cls.body:
+        if (
+            not isinstance(method, ast.FunctionDef)
+            or method.name != "__init__"
+        ):
+            continue
+        for node in ast.walk(method):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            value = node.value
+            is_registry = isinstance(value, (ast.Dict, ast.Set)) or (
+                isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Name)
+                and value.func.id in ("dict", "set")
+            )
+            is_list = isinstance(value, ast.List) or (
+                isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Name)
+                and value.func.id == "list"
+            )
+            if not (is_registry or is_list):
+                continue
+            for target in targets:
+                name = _self_attr_name(target)
+                if name is None:
+                    continue
+                if is_registry:
+                    registries.add(name)
+                else:
+                    lists.add(name)
+    return registries, lists
+
+
+@rule(
+    "JGL019",
+    "broadcast fan-out state: unlocked subscriber-registry mutation / "
+    "unbounded list fan-out buffer",
+)
+def broadcast_fanout_state(ctx: FileContext):
+    """Scope: threaded modules (the broadcast tier's accept threads vs
+    publish thread split, serving/broadcast.py). Two hazards:
+
+    - **Unlocked subscriber-registry mutation**: a dict/set attribute
+      whose name reads as a per-subscriber registry (``subscribers``,
+      ``_clients``, ``sessions``...) initialized empty in ``__init__``
+      and mutated outside a ``with <lock>:`` block. The HTTP accept
+      thread registers/removes subscribers while the service's publish
+      thread iterates the same mapping to fan a frame out — an unlocked
+      attach can vanish mid-iteration or never receive its keyframe.
+
+    - **Unbounded ``list.append`` fan-out buffer**: a buffer-named list
+      attribute (``_frames``, ``backlog``, ``pending``...) initialized
+      empty in ``__init__`` and only ever appended to from methods
+      (never popped/cleared/reassigned/length-gated). A slow consumer
+      turns such a buffer into unbounded memory — the exact failure
+      bounded queues with coalesce-on-overflow exist to prevent
+      (extends the JGL010 queue discipline to ad-hoc list buffers).
+      Registration lists (listeners, plotters) and test doubles
+      (``Fake*``/``Stub*``...) stay out of scope.
+
+    Methods named ``*_locked`` are exempt from the registry hazard —
+    the codebase's caller-holds-the-lock convention (see
+    ``LinkMonitor._policy_locked``); the lock discipline is checked at
+    their call sites.
+    """
+    if not ctx.is_threaded_module:
+        return
+    for cls in ctx.nodes(ast.ClassDef):
+        if _DOUBLE_CLASS.match(cls.name):
+            continue
+        registries, lists = _init_container_attrs(cls)
+        registries = {n for n in registries if _SUBSCRIBER_ATTR.search(n)}
+        lists = {n for n in lists if _FANOUT_BUFFER_ATTR.search(n)}
+        if not registries and not lists:
+            continue
+        methods = [
+            n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n.name != "__init__"
+        ]
+        # A list is "managed" when any method bounds or replaces it:
+        # .pop/.clear/.remove, `del self.y[...]`, slice/index stores,
+        # reassignment, or an append lexically inside an `if` whose
+        # test reads len(...) (an explicit growth gate).
+        managed_lists: set[str] = set()
+        appends: list[tuple[str, ast.Call, str]] = []
+        for method in methods:
+            for node in ast.walk(method):
+                if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute
+                ):
+                    owner = _self_attr_name(node.func.value)
+                    if owner in lists:
+                        if node.func.attr in _LIST_BOUNDERS:
+                            managed_lists.add(owner)
+                        elif node.func.attr == "append":
+                            appends.append((owner, node, method.name))
+                elif isinstance(node, ast.Delete):
+                    for target in node.targets:
+                        if isinstance(target, ast.Subscript):
+                            owner = _self_attr_name(target.value)
+                            if owner in lists:
+                                managed_lists.add(owner)
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = _flat_targets(
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for target in targets:
+                        owner = _self_attr_name(target)
+                        if owner in lists:
+                            # Reassignment (e.g. `self.buf = []` drain)
+                            managed_lists.add(owner)
+                        elif isinstance(target, ast.Subscript):
+                            owner = _self_attr_name(target.value)
+                            if owner in lists:
+                                managed_lists.add(owner)
+        # Hazard 1: registry mutation outside the lock.
+        for method in methods:
+            if method.name.endswith("_locked"):
+                continue
+            for node in ast.walk(method):
+                finding_attr = None
+                if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute
+                ):
+                    owner = _self_attr_name(node.func.value)
+                    if (
+                        owner in registries
+                        and node.func.attr in _REGISTRY_MUTATORS
+                    ):
+                        finding_attr = owner
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = _flat_targets(
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for target in targets:
+                        if isinstance(target, ast.Subscript):
+                            owner = _self_attr_name(target.value)
+                            if owner in registries:
+                                finding_attr = owner
+                        else:
+                            owner = _self_attr_name(target)
+                            if owner in registries:
+                                # Wholesale replacement races iteration
+                                # the same way item stores do.
+                                finding_attr = owner
+                elif isinstance(node, ast.Delete):
+                    for target in node.targets:
+                        if isinstance(target, ast.Subscript):
+                            owner = _self_attr_name(target.value)
+                            if owner in registries:
+                                finding_attr = owner
+                if finding_attr is not None and not ctx.under_lock(node):
+                    yield Finding(
+                        ctx.path,
+                        node.lineno,
+                        "JGL019",
+                        f"subscriber registry self.{finding_attr} "
+                        f"mutated in '{cls.name}.{method.name}' without "
+                        "holding the registry lock: the accept thread "
+                        "races the publish thread's fan-out iteration "
+                        "— take the lock that guards the fan-out",
+                    )
+        # Hazard 2: append-only fan-out buffers.
+        for owner, node, method_name in appends:
+            if owner in managed_lists:
+                continue
+            # An append under `if len(...)` (or any test naming len) is
+            # an explicit growth gate.
+            gated = False
+            parent = ctx.parent(node)
+            while parent is not None and not isinstance(
+                parent, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                if isinstance(parent, ast.If):
+                    for sub in ast.walk(parent.test):
+                        if (
+                            isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Name)
+                            and sub.func.id == "len"
+                        ):
+                            gated = True
+                parent = ctx.parent(parent)
+            if gated:
+                continue
+            yield Finding(
+                ctx.path,
+                node.lineno,
+                "JGL019",
+                f"append-only fan-out buffer self.{owner} in "
+                f"'{cls.name}.{method_name}': nothing in the class "
+                "bounds, drains or replaces it, so a slow consumer "
+                "grows it without limit — use a bounded queue.Queue "
+                "with coalesce-on-overflow (the JGL010 discipline), "
+                "or drain/cap the list",
+            )
