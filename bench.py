@@ -1137,6 +1137,269 @@ def bench_fanout(args, n_values: tuple[int, ...] | None = None) -> dict:
     return results_by_n[max(n_values)]
 
 
+def bench_churn(args) -> dict:
+    """Durability plane under churn (ADR 0118): kill-and-restart with
+    checkpoint/replay, and commit-time AOT warm-up.
+
+    K=3 detector-view jobs (fixed job ids, so the restarted process
+    serves the SAME streams) run through the real JobManager. A
+    checkpoint is taken mid-run (state + the window-index bookmark),
+    more windows flow, then the process "dies" — the manager is dropped
+    with no shutdown dump, exactly a crash. A second manager restores
+    from the checkpoint directory and replays from the bookmark through
+    the normal ingest path.
+
+    Acceptance (asserted here AND in --smoke/CI):
+
+    - every replayed window's da00 wire — including the windows the
+      doomed process had already published and the final one — is
+      BYTE-IDENTICAL to an uninterrupted control's;
+    - a subscriber reconnecting to the restarted serving hub gets a
+      keyframe carrying the restored accumulation (== the control's
+      cumulative at that window, byte-identical frame) — a gap, NOT a
+      reset to zero;
+    - committing a NEW job on the restarted manager with the warm-up
+      service attached costs 0 hot-path jit compiles
+      (``livedata_jit_compiles_total`` delta == 0 over the next
+      windows), while the identical commit on the control without
+      warm-up pays >= 1 — the instrument-verified half of ROADMAP
+      item 1.
+
+    One JSON line on stderr.
+    """
+    import tempfile
+    import uuid as _uuid
+
+    from esslivedata_tpu.config import JobId, WorkflowConfig, WorkflowSpec
+    from esslivedata_tpu.core.job_manager import JobFactory, JobManager
+    from esslivedata_tpu.core.timestamp import Timestamp
+    from esslivedata_tpu.durability import (
+        CheckpointPlane,
+        CompileWarmupService,
+    )
+    from esslivedata_tpu.kafka.da00_compat import dataarray_to_da00
+    from esslivedata_tpu.kafka.wire import decode_da00, encode_da00
+    from esslivedata_tpu.ops import EventBatch
+    from esslivedata_tpu.preprocessors.event_data import StagedEvents
+    from esslivedata_tpu.serving import DeltaDecoder, ServingPlane, stream_key
+    from esslivedata_tpu.telemetry import COMPILE_EVENTS
+    from esslivedata_tpu.workflows import WorkflowFactory
+    from esslivedata_tpu.workflows.detector_view import (
+        DetectorViewParams,
+        DetectorViewWorkflow,
+        project_logical,
+    )
+
+    side = int(np.sqrt(min(args.pixels, 1 << 14)))
+    det = np.arange(side * side).reshape(side, side)
+    n_events = min(args.events, 1 << 14)
+    n_windows = max(9, args.batches // 4)
+    checkpoint_at = n_windows // 3  # bookmark = checkpoint_at + 1
+    crash_at = 2 * n_windows // 3
+    k = 3
+    method = args.method if args.method in ("scatter", "sort") else "scatter"
+    batches = []
+    for s in range(n_windows):
+        pid, toa = make_batch(n_events, side * side, seed=700 + s)
+        batches.append(EventBatch.from_arrays(pid, toa))
+
+    def staged(w: int) -> StagedEvents:
+        return StagedEvents(
+            batch=batches[w],
+            first_timestamp=None,
+            last_timestamp=None,
+            n_chunks=1,
+        )
+
+    def make_mgr(tag: str, durability=None) -> JobManager:
+        # ONE spec name across control/doomed/restarted: the restarted
+        # process schedules the same workflow identity, and checkpoint
+        # entries match on (workflow_id, source, fingerprint). The
+        # registries are per-manager, so the shared name cannot clash.
+        del tag
+        reg = WorkflowFactory()
+        spec = WorkflowSpec(
+            instrument="bench", name="dv_churn", source_names=["det0"]
+        )
+        reg.register_spec(spec).attach_factory(
+            lambda *, source_name, params: DetectorViewWorkflow(
+                projection=project_logical(det),
+                params=DetectorViewParams(histogram_method=method),
+            )
+        )
+        mgr = JobManager(
+            job_factory=JobFactory(reg),
+            job_threads=1,
+            durability=durability,
+        )
+        # FIXED job numbers: the restarted process schedules the same
+        # jobs (restart semantics), so checkpoint entries and serving
+        # stream keys line up across the kill.
+        for i in range(k):
+            mgr.schedule_job(
+                WorkflowConfig(
+                    identifier=spec.identifier,
+                    job_id=JobId(
+                        source_name="det0", job_number=_uuid.UUID(int=i)
+                    ),
+                )
+            )
+        return mgr, spec
+
+    def run(mgr, w: int):
+        out = mgr.process_jobs(
+            {"det0": staged(w)},
+            start=Timestamp.from_ns(1 + w),
+            end=Timestamp.from_ns(2 + w),
+        )
+        return out
+
+    def wire_of(results, ts_ns: int) -> list[bytes]:
+        frames = []
+        for res in sorted(results, key=lambda r: str(r.job_id.job_number)):
+            for key, da in zip(
+                res.keys(), res.outputs.values(), strict=True
+            ):
+                frames.append(
+                    encode_da00(key.to_string(), ts_ns, dataarray_to_da00(da))
+                )
+        return frames
+
+    # ---- control: uninterrupted, plus the no-warm-up commit cost ----
+    control, control_spec = make_mgr("ctrl")
+    control_wire = []
+    control_results = []
+    for w in range(n_windows):
+        out = run(control, w)
+        assert len(out) == k
+        control_results.append(out)
+        control_wire.append(wire_of(out, 100 + w))
+    compiles0 = COMPILE_EVENTS.total()
+    control.schedule_job(
+        WorkflowConfig(
+            identifier=control_spec.identifier,
+            job_id=JobId(source_name="det0", job_number=_uuid.UUID(int=50)),
+        )
+    )
+    # One window after the cold commit: the re-keyed tick program
+    # compiles ON the hot path — the spike class warm-up removes.
+    assert len(run(control, n_windows - 1)) == k + 1
+    commit_compiles_cold = COMPILE_EVENTS.total() - compiles0
+    assert commit_compiles_cold >= 1, (
+        "cold commit paid no compile — the warm-up claim below would "
+        "be vacuous"
+    )
+
+    # ---- churn run: checkpoint, crash, restore, replay ----
+    ckdir = tempfile.mkdtemp(prefix="bench-churn-ck-")
+    plane_a = CheckpointPlane(ckdir, interval_s=0)
+    doomed, _spec = make_mgr("a", durability=plane_a)
+    for w in range(checkpoint_at + 1):
+        assert len(run(doomed, w)) == k
+    manifest = plane_a.checkpoint(
+        doomed.checkpoint_snapshot(),
+        offsets={"det0": checkpoint_at + 1},
+        reset_seq=doomed.reset_seq,
+    )
+    checkpoint_bytes = sum(
+        entry["nbytes"]
+        for entry in json.loads(manifest.read_bytes())["jobs"]
+    )
+    for w in range(checkpoint_at + 1, crash_at + 1):
+        assert len(run(doomed, w)) == k
+    plane_a.close()
+    del doomed  # crash: no shutdown dump, no final checkpoint
+
+    plane_b = CheckpointPlane(ckdir, interval_s=0)
+    t_restore = time.perf_counter()
+    restored, spec_b = make_mgr("b", durability=plane_b)
+    bookmark = plane_b.bookmarks()["det0"]
+    assert bookmark == checkpoint_at + 1
+    hub = ServingPlane(port=None)
+    replay_identical = True
+    for w in range(bookmark, n_windows):
+        out = run(restored, w)
+        assert len(out) == k
+        if wire_of(out, 100 + w) != control_wire[w]:
+            replay_identical = False
+        hub.publish_results(out, Timestamp.from_ns(100 + w))
+    replay_wall_s = time.perf_counter() - t_restore
+    assert replay_identical, (
+        "replayed da00 wire != uninterrupted control"
+    )
+
+    # ---- the reconnecting subscriber sees a gap, not a reset ----
+    job0 = f"det0:{_uuid.UUID(int=0)}"
+    sub = hub.server.subscribe(stream_key(job0, "image_cumulative"))
+    blob = sub.next_blob(timeout=1.0)
+    assert blob is not None, "reconnect keyframe missing"
+    decoder = DeltaDecoder()
+    frame = decoder.apply(blob)
+    decoded = decode_da00(frame)
+    cumulative = next(
+        np.asarray(v.data)
+        for v in decoded.variables
+        if v.name == "signal"
+    )
+    # The keyframe carries the FULL restored + replayed accumulation:
+    # n_windows x n_events counts. A reset would show only the
+    # post-restart windows' counts.
+    expected = n_windows * n_events
+    subscriber_not_reset = float(cumulative.sum()) == float(expected)
+    assert subscriber_not_reset, (
+        f"subscriber keyframe shows {cumulative.sum()} counts, "
+        f"expected the full {expected}: accumulation RESET across the "
+        "restart"
+    )
+    hub.close()
+
+    # ---- commit-time warm-up on the restarted manager ----
+    warmup = CompileWarmupService()
+    restored.set_warmup(warmup)
+    restored.schedule_job(
+        WorkflowConfig(
+            identifier=spec_b.identifier,
+            job_id=JobId(source_name="det0", job_number=_uuid.UUID(int=51)),
+        )
+    )
+    assert warmup.quiesce(120), "warm-up never drained"
+    compiles1 = COMPILE_EVENTS.total()
+    assert len(run(restored, n_windows - 1)) == k + 1
+    assert len(run(restored, n_windows - 2)) == k + 1
+    commit_compiles_warm = COMPILE_EVENTS.total() - compiles1
+    warmup.close()
+    plane_b.close()
+    restored.shutdown()
+    control.shutdown()
+    assert commit_compiles_warm == 0, (
+        f"warm-up left {commit_compiles_warm} compile(s) on the hot "
+        "path at commit time"
+    )
+
+    line = {
+        "metric": "churn",
+        # Graded value: hot-path jit compiles at commit time with
+        # warm-up on — the quantity the durability plane zeroes.
+        "value": commit_compiles_warm,
+        "unit": "hot_path_compiles_at_commit",
+        "jobs": k,
+        "windows": n_windows,
+        "events_per_window": n_events,
+        "checkpoint_window": checkpoint_at,
+        "crash_window": crash_at,
+        "bookmark": bookmark,
+        "replayed_windows": n_windows - bookmark,
+        "replay_wall_ms": 1e3 * replay_wall_s,
+        "checkpoint_bytes": checkpoint_bytes,
+        "wire_byte_identical_after_replay": replay_identical,
+        "subscriber_gap_not_reset": subscriber_not_reset,
+        "commit_compiles_without_warmup": commit_compiles_cold,
+        "commit_compiles_with_warmup": commit_compiles_warm,
+    }
+    emit_line(line)
+    return line
+
+
 def bench_telemetry(args, tick_wall_ms: float | None = None) -> dict:
     """Steady-state telemetry overhead guard (ADR 0116, PERF round 10).
 
@@ -2068,6 +2331,7 @@ def run_benchmark(args, platform: str) -> dict:
             lambda: bench_publish(args),
             lambda: bench_tick(args),
             lambda: bench_fanout(args),
+            lambda: bench_churn(args),
             lambda: bench_telemetry(args),
             lambda: bench_mesh(args),
             lambda: bench_pipeline(args),
@@ -2429,6 +2693,18 @@ def _parse_args():
         "which uses N=50)",
     )
     parser.add_argument(
+        "--churn",
+        action="store_true",
+        help="Run ONLY the durability-plane churn scenario (ADR 0118) "
+        "and exit: checkpoint mid-run, kill, restore + replay from "
+        "the bookmark — asserts the replayed da00 wire byte-identical "
+        "to an uninterrupted control, a reconnecting subscriber sees "
+        "the restored accumulation (a gap, not a reset), and a job "
+        "commit with AOT warm-up costs 0 hot-path jit compiles where "
+        "the cold commit pays >= 1 (dev flag, like --multijob; also "
+        "runs under --all and --smoke)",
+    )
+    parser.add_argument(
         "--telemetry",
         action="store_true",
         help="Run ONLY the telemetry-overhead guard (ADR 0116) and "
@@ -2599,6 +2875,32 @@ def _smoke_main(args) -> int:
             problems.append(
                 "fanout delta encoding not under full-frame replay"
             )
+    # Durability-plane churn control (ADR 0118): tiny kill-and-restart
+    # through the real JobManager + CheckpointPlane; the scenario
+    # itself asserts replay byte identity, the subscriber gap-not-
+    # reset, and the 0-compile warmed commit vs >= 1 cold, and this
+    # guards the report's structure.
+    try:
+        churn_line = bench_churn(args)
+    except Exception:
+        traceback.print_exc()
+        problems.append("churn scenario raised")
+    else:
+        for field in (
+            "value",
+            "replayed_windows",
+            "wire_byte_identical_after_replay",
+            "subscriber_gap_not_reset",
+            "commit_compiles_without_warmup",
+        ):
+            if churn_line.get(field) is None:
+                problems.append(f"churn line missing {field!r}")
+        if churn_line.get("value") != 0:
+            problems.append(
+                "warmed commit paid hot-path compiles (warm-up broken?)"
+            )
+        if not churn_line.get("wire_byte_identical_after_replay"):
+            problems.append("replay wire not byte-identical to control")
     # Telemetry-overhead guard (ADR 0116): instrument microcosts
     # bounded against the tick wall this very smoke just measured.
     try:
@@ -2671,9 +2973,10 @@ def _smoke_main(args) -> int:
         "dispatch/tick with wire parity, compile instrument saw the "
         "warmup miss and a clean steady state, telemetry overhead "
         "under 1% of tick wall, fan-out tier flat in subscribers with "
-        "byte-identical reconstruction, mesh tier at 1 "
-        "execute/slice/tick with single-device parity, pipelined "
-        "ingest drained with parity",
+        "byte-identical reconstruction, churn kill-and-restart "
+        "replayed byte-identical with a 0-compile warmed commit, mesh "
+        "tier at 1 execute/slice/tick with single-device parity, "
+        "pipelined ingest drained with parity",
         file=sys.stderr,
     )
     return 0
@@ -2721,6 +3024,13 @@ def main() -> None:
         if args.batches is None:
             args.batches = 48
         bench_fanout(args)
+        sys.exit(0)
+    if args.churn:
+        if args.events is None:
+            args.events = 1 << 13
+        if args.batches is None:
+            args.batches = 48
+        bench_churn(args)
         sys.exit(0)
     if args.telemetry:
         bench_telemetry(args)

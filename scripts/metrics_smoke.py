@@ -19,7 +19,12 @@ then
    ``GET /results`` lists the job's streams, the first SSE event on
    ``/streams/<job>/<output>`` is a valid keyframe whose payload
    decodes as da00, and the ``livedata_serving_*`` families appear in
-   ``/metrics`` after the subscriber attached.
+   ``/metrics`` after the subscriber attached;
+5. (ADR 0118) with ``--checkpoint-dir`` + ``--warmup`` the durability
+   plane's families scrape — snapshot age/bytes/epoch, checkpoint and
+   restore counters, replay lag, warm-up compiles — and once data
+   flowed, a checkpoint generation was actually written (snapshot age
+   sample >= 0, a ``manifest-*.json`` on disk).
 
 Exit 0 on success, 1 with a diagnostic otherwise.
 """
@@ -83,6 +88,7 @@ def main() -> int:
 
     deadline = time.time() + TIMEOUT_S
     broker_dir = tempfile.mkdtemp(prefix="metrics-smoke-broker-")
+    checkpoint_dir = tempfile.mkdtemp(prefix="metrics-smoke-ck-")
     ensure_topics(
         broker_dir, ["dummy_detector", "dummy_livedata_commands"]
     )
@@ -107,6 +113,13 @@ def main() -> int:
             str(PORT),
             "--serve-port",
             str(SERVE_PORT),
+            "--checkpoint-dir",
+            checkpoint_dir,
+            # Tight cadence so the smoke window reliably contains a
+            # written generation (prod default is 30 s).
+            "--checkpoint-interval",
+            "2",
+            "--warmup",
         ],
         env=env,
     )
@@ -271,10 +284,61 @@ def main() -> int:
         if serving_missing:
             print(f"scrape missing serving families: {serving_missing}")
             return 1
+        # 5. durability plane (ADR 0118): families scrape and a real
+        # checkpoint generation landed on disk within the window.
+        durability_missing = [
+            family
+            for family in (
+                "livedata_durability_snapshot_age_seconds",
+                "livedata_durability_snapshot_bytes",
+                "livedata_durability_checkpoint_epoch",
+                "livedata_durability_checkpoints_total",
+                "livedata_durability_restores_total",
+                "livedata_durability_replay_lag",
+                "livedata_durability_warmup_compiles_total",
+                "livedata_durability_warmup_seconds",
+            )
+            if family not in parsed
+        ]
+        if durability_missing:
+            print(f"scrape missing durability families: {durability_missing}")
+            return 1
+        manifest = None
+        age = None
+        while time.time() < deadline:
+            manifests = sorted(
+                Path(checkpoint_dir).glob("manifest-*.json")
+            )
+            status, body = fetch("/metrics")
+            parsed = parse_prometheus_text(body.decode())
+            samples = parsed[
+                "livedata_durability_snapshot_age_seconds"
+            ].samples
+            age = samples[0][2] if samples else None
+            if manifests and age is not None and age >= 0:
+                manifest = manifests[-1]
+                break
+            time.sleep(1.0)
+        if manifest is None:
+            print(
+                "durability plane never wrote a checkpoint "
+                f"(age={age!r}, dir={checkpoint_dir})"
+            )
+            return 1
+        entries = json.loads(manifest.read_bytes())
+        if not entries.get("jobs"):
+            print(f"checkpoint manifest carries no job states: {manifest}")
+            return 1
+        print(
+            f"durability OK: generation {entries['epoch']} with "
+            f"{len(entries['jobs'])} job state(s), "
+            f"{len(entries.get('offsets', {}))} bookmarked topic(s), "
+            f"snapshot age {age:.1f}s"
+        )
         print(
             f"metrics smoke PASSED: {len(parsed)} families, "
             f"publish executes={publishes:.0f}, compiles={compiles:.0f}, "
-            f"serving plane live"
+            f"serving plane live, durability plane checkpointing"
         )
         return 0
     finally:

@@ -324,6 +324,36 @@ class Job:
         previous one."""
         self.state_epoch += 1
 
+    @property
+    def generation_start_ns(self) -> int | None:
+        """The current generation's start time in ns (None before the
+        first accumulated message) — checkpointed by the durability
+        plane (ADR 0118) so a restored job stamps the same
+        ``start_time`` coord an uninterrupted process would have."""
+        start = self._generation_start
+        return None if start is None else int(start.ns)
+
+    def adopt_checkpoint(
+        self,
+        *,
+        state_epoch: int,
+        generation_start_ns: int | None,
+    ) -> None:
+        """Adopt a restored checkpoint's job-level metadata (ADR 0118):
+        the generation start (so ``start_time`` continues rather than
+        jumping, which NICOS reads as a reset — ADR 0006) and the
+        ``state_epoch`` (so the serving tier's delta/epoch discipline
+        continues the restored accumulation's lineage). Only called on
+        schedule-time restore, BEFORE any data reaches the job; the
+        mid-run ``state_lost`` recovery path must NOT adopt — its epoch
+        already bumped past the checkpoint's."""
+        self.state_epoch = int(state_epoch)
+        self._generation_start = (
+            None
+            if generation_start_ns is None
+            else Timestamp.from_ns(int(generation_start_ns))
+        )
+
     def release(self) -> None:
         """Drop the workflow instance (and with it the device-resident
         accumulator state). Called when the job reaches STOPPED: the

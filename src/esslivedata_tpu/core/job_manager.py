@@ -162,6 +162,7 @@ class JobManager:
         combine_publish: bool = True,
         tick_program: bool = True,
         placement=None,
+        durability=None,
     ) -> None:
         self._factory = job_factory or JobFactory()
         #: Cross-job publish combiner (ADR 0113): every job due in a
@@ -215,6 +216,30 @@ class JobManager:
         #: restored when an identically-configured job is scheduled
         #: (SURVEY §5 checkpoint note).
         self._snapshot_store = snapshot_store
+        #: Optional durability plane (durability/checkpoint.py,
+        #: ADR 0118): the periodic checkpoint channel. Consulted FIRST
+        #: at schedule-time restore (fresher than the shutdown-only
+        #: store), re-seeds fresh states at the state-loss containment
+        #: sites, and receives the run-boundary reset sequence so stale
+        #: manifests can never resurrect old-run data.
+        self._durability = durability
+        #: Run-boundary reset sequence — persisted by the durability
+        #: plane as the manifest staleness gate. Seeded from the
+        #: plane's persisted marker: a process that restarts AFTER a
+        #: reset must stamp new manifests at (or past) the marker, or
+        #: every post-restart checkpoint would be rejected as stale
+        #: forever (pinned in tests/durability).
+        self._reset_seq = self._seed_reset_seq(durability)
+        #: Optional AOT warm-up service (durability/warmup.py): job
+        #: commits/removals and wire flips plan the next tick's program
+        #: keys and compile them off the hot path before the change
+        #: goes live.
+        self._warmup = None
+        #: Last seen padded batch size per stream — the staged-signature
+        #: memory warm-up plans against (a tick program's key includes
+        #: the staged wire's shape, and commit-time warm-up must
+        #: compile against the shape the stream actually carries).
+        self._stream_batch_shapes: dict[str, int] = {}
         self._records: dict[JobId, _JobRecord] = {}
         #: Stage-once staging per stream (ADR 0110): every window's event
         #: batches decode/flatten/transfer ONCE per (stream, layout) no
@@ -247,10 +272,35 @@ class JobManager:
             # are window-scoped anyway; this keeps the rule explicit.
             self._event_cache.invalidate()
             self._maybe_restore(job)
-            return config.job_id
+        # Outside the lock: warm-up planning calls workflow offer code.
+        # The commit re-keys every tick group the new job joins (member
+        # tuple change), so the programs its FIRST live window needs
+        # compile on the warm-up thread now instead of stalling that
+        # window (ADR 0118).
+        self._queue_warmup("commit")
+        return config.job_id
 
     def _maybe_restore(self, job: Job) -> None:
-        """Adopt a prior process's accumulation for this configuration."""
+        """Adopt a prior process's accumulation for this configuration.
+
+        The durability plane's periodic checkpoint (ADR 0118) is
+        consulted first — it is at most one checkpoint interval stale,
+        against the shutdown-only store's crash-loses-everything — and
+        carries job-level meta (state_epoch, generation start) the old
+        channel never had. The ADR 0107 store stays as the fallback so
+        a deployment with only LIVEDATA_SNAPSHOT_DIR keeps its exact
+        pre-durability behavior.
+        """
+        if self._durability is not None:
+            try:
+                if self._durability.restore_job(job):
+                    return
+            except Exception:
+                logger.exception(
+                    "checkpoint restore failed for %s; trying the "
+                    "snapshot store",
+                    job.job_id,
+                )
         store, wf = self._snapshot_store, job.workflow
         if store is None or not supports_snapshot(wf):
             return
@@ -312,6 +362,283 @@ class JobManager:
                 if rec.phase != _Phase.STOPPED:
                     self._dump_snapshot(rec, reason)
 
+    # -- durability plane (durability/, ADR 0118) --------------------------
+    @staticmethod
+    def _seed_reset_seq(plane) -> int:
+        """The persisted reset marker (0 without a plane/marker)."""
+        marker = getattr(plane, "reset_marker", None)
+        if marker is None:
+            return 0
+        try:
+            return int(marker())
+        except Exception:
+            logger.exception("reset-marker read failed; seeding 0")
+            return 0
+
+    def set_durability(self, plane) -> None:
+        """Attach the periodic checkpoint plane (duck-typed:
+        ``restore_job``/``note_reset``/``reset_marker``)
+        post-construction; the reset sequence re-seeds from the
+        plane's persisted marker (never backward)."""
+        self._durability = plane
+        with self._lock:
+            self._reset_seq = max(
+                self._reset_seq, self._seed_reset_seq(plane)
+            )
+
+    def set_warmup(self, service) -> None:
+        """Attach the AOT warm-up service (durability/warmup.py):
+        commits, removals and wire flips submit tick-program warm-up
+        requests through it."""
+        self._warmup = service
+
+    @property
+    def reset_seq(self) -> int:
+        """Run-boundary resets fired since construction — rides every
+        checkpoint manifest as its staleness tag."""
+        with self._lock:
+            return self._reset_seq
+
+    def checkpoint_snapshot(self) -> list[dict]:
+        """Per-job state-dump entries for the CheckpointPlane: every
+        non-stopped snapshot-capable job's host arrays plus the meta a
+        restart needs (fingerprint gate, state_epoch, generation
+        start). The record list is captured under the lock; the
+        device→host fetches run outside it with per-job containment —
+        the plane's caller (the processor) only checkpoints at
+        quiescent window boundaries, so nothing steps these states
+        concurrently, and a job that still fails to dump is skipped
+        this generation rather than wedging the checkpoint."""
+        with self._lock:
+            records = [
+                rec
+                for rec in self._records.values()
+                if rec.phase != _Phase.STOPPED
+            ]
+        entries: list[dict] = []
+        for rec in records:
+            wf = rec.job.workflow
+            if wf is None or not supports_snapshot(wf):
+                continue
+            try:
+                arrays = wf.dump_state()
+                if not arrays:
+                    # Nothing accumulated yet (context-gated workflow
+                    # before its first table): no entry beats an empty
+                    # state resurrecting over a later restore.
+                    continue
+                entries.append(
+                    {
+                        "workflow_id": str(rec.job.workflow_id),
+                        "source_name": rec.job.job_id.source_name,
+                        "job_number": str(rec.job.job_id.job_number),
+                        "fingerprint": wf.state_fingerprint(),
+                        "state_epoch": rec.job.state_epoch,
+                        "generation_start_ns": rec.job.generation_start_ns,
+                        "arrays": arrays,
+                    }
+                )
+            except Exception:
+                logger.exception(
+                    "checkpoint dump failed for %s; skipped this "
+                    "generation",
+                    rec.job.job_id,
+                )
+        return entries
+
+    def _after_state_loss(self, rec: _JobRecord) -> None:
+        """Durability hook at every ``note_state_lost`` containment
+        site (ADR 0118): the fresh zeroed state just installed is
+        re-seeded from the newest checkpoint, so a donated-dispatch
+        failure costs the gap since the last checkpoint instead of the
+        whole accumulated run. ``adopt_meta=False`` — the epoch already
+        bumped, and regressing it would let a delta stream splice
+        across the rebuild (the next publish must keyframe)."""
+        plane = self._durability
+        if plane is None:
+            return
+        try:
+            if plane.restore_job(
+                rec.job, adopt_meta=False, reason="state_lost"
+            ):
+                rec.warning += "; re-seeded from last checkpoint"
+        except Exception:
+            logger.exception(
+                "state-loss checkpoint restore failed for %s",
+                rec.job.job_id,
+            )
+
+    def request_warmup(self, trigger: str) -> None:
+        """Plan + submit tick-program warm-up for the current job set
+        (ADR 0118). Called internally on commits/removals/wire flips;
+        public so the processor (policy changes) and layout-swap
+        appliers can pre-compile before a change goes live."""
+        self._queue_warmup(trigger)
+
+    def _queue_warmup(self, trigger: str) -> None:
+        warmup = self._warmup
+        if warmup is None or self._tick_combiner is None:
+            return
+        try:
+            requests = self.plan_warmup(trigger)
+        except Exception:
+            logger.exception("warm-up planning failed (%s)", trigger)
+            return
+        if requests:
+            warmup.submit(requests)
+
+    def plan_warmup(self, trigger: str = "commit") -> list:
+        """Plan one WarmupRequest per tick-eligible (stream, fuse-key)
+        group, against the batch shape each stream has actually been
+        carrying (``_stream_batch_shapes``) — the staged signature in a
+        tick program's key. Mirrors the live planners: the record
+        predicate is ``prestage_window``'s (active, or scheduled with
+        no gate — those activate on their first window), grouping is
+        ``_plan_fused_steps``'s (event_ingest offers keyed by (stream,
+        offer key)), and eligibility is ``_split_tick_groups``'s
+        (publish offer present, args[0] IS the ingest state). Offers
+        are side-effect free by contract, and member args travel as
+        ``jax.ShapeDtypeStruct`` trees — planning never touches (or
+        pins) a live device buffer. Streams with no remembered shape
+        (nothing consumed yet) are skipped: there is no signature to
+        warm against, and their first window compiles as a startup
+        ``new_group`` exactly as before.
+        """
+        import jax as _jax
+        import numpy as np
+
+        from ..durability.warmup import WarmupRequest
+        from ..ops.event_batch import EventBatch
+
+        with self._lock:
+            if self._tick_combiner is None:
+                return []
+            records = [
+                rec
+                for rec in self._records.values()
+                if not rec.needs_reset
+                and (
+                    rec.phase == _Phase.ACTIVE
+                    or (
+                        rec.phase == _Phase.SCHEDULED
+                        and rec.job.schedule.start is None
+                        and not rec.job.context_keys
+                    )
+                )
+            ]
+            shapes = dict(self._stream_batch_shapes)
+        groups: dict[tuple, list] = {}
+        for stream, padded in shapes.items():
+            value = StagedEvents(
+                batch=EventBatch(
+                    pixel_id=np.full(padded, -1, dtype=np.int32),
+                    toa=np.zeros(padded, dtype=np.float32),
+                    n_valid=0,
+                ),
+                first_timestamp=None,
+                last_timestamp=None,
+                n_chunks=1,
+            )
+            for rec in records:
+                if stream not in rec.job.subscribed_streams:
+                    continue
+                ingest_fn = getattr(rec.job.workflow, "event_ingest", None)
+                if ingest_fn is None:
+                    continue
+                try:
+                    offer = ingest_fn(stream, value)
+                except Exception:
+                    logger.exception(
+                        "event_ingest failed during warm-up planning "
+                        "for %s",
+                        rec.job.job_id,
+                    )
+                    continue
+                if offer is not None:
+                    groups.setdefault((stream, offer.key), []).append(
+                        (rec, offer)
+                    )
+        requests = []
+        for (stream, key), members in groups.items():
+            ingest0 = members[0][1]
+            device = combiner = None
+            if self._placement is not None:
+                # Sticky-assignment PROBE only: state moves stay on the
+                # step thread (``_group_placement``'s ensure_state_on),
+                # exactly like the prestage path's probe.
+                try:
+                    plc = self._placement.assign(stream, key, ingest0.hist)
+                    device, combiner = plc.device, plc.combiner
+                except Exception:
+                    logger.debug(
+                        "warm-up placement probe failed", exc_info=True
+                    )
+            member_specs = []
+            for rec, ingest in members:
+                offer_fn = getattr(rec.job.workflow, "publish_offer", None)
+                if offer_fn is None:
+                    member_specs = None
+                    break
+                try:
+                    offer = offer_fn()
+                    if (
+                        offer is None
+                        or not offer.args
+                        or offer.args[0] is not ingest.get_state()
+                    ):
+                        member_specs = None
+                        break
+                    sharding = (
+                        None
+                        if device is None
+                        else _jax.sharding.SingleDeviceSharding(device)
+                    )
+                    args = _jax.tree_util.tree_map(
+                        lambda a: _jax.ShapeDtypeStruct(
+                            tuple(a.shape),
+                            a.dtype,
+                            **(
+                                {}
+                                if sharding is None
+                                else {"sharding": sharding}
+                            ),
+                        ),
+                        offer.args,
+                    )
+                except Exception:
+                    logger.debug(
+                        "warm-up offer capture failed for %s",
+                        rec.job.job_id,
+                        exc_info=True,
+                    )
+                    member_specs = None
+                    break
+                member_specs.append(
+                    (offer.publisher, args, offer.static_token)
+                )
+            if not member_specs:
+                # Not tick-eligible: this group dispatches separately on
+                # the live path, where the fused-step/publish jits have
+                # their own (per-K) caches — nothing to warm here.
+                continue
+            requests.append(
+                WarmupRequest(
+                    combiner=(
+                        combiner
+                        if combiner is not None
+                        else self._tick_combiner
+                    ),
+                    hist=ingest0.hist,
+                    group_key=key,
+                    batch=ingest0.batch,
+                    batch_tag=ingest0.batch_tag,
+                    device=device,
+                    members=member_specs,
+                    trigger=trigger,
+                )
+            )
+        return requests
+
     def handle_command(self, command: JobCommand) -> int:
         """Apply ``command``; return how many jobs it acted on.
 
@@ -348,6 +675,11 @@ class JobManager:
                     observer(jid)
                 except Exception:
                     logger.exception("retire observer failed for %s", jid)
+        if removed:
+            # A removal re-keys every group the job belonged to (member
+            # tuple shrinks): warm the survivors' programs off the hot
+            # path (ADR 0118).
+            self._queue_warmup("regroup")
         return len(matched)
 
     def set_retire_observer(self, observer) -> None:
@@ -383,6 +715,22 @@ class JobManager:
         if not due:
             return
         del self._pending_reset_times[:due]
+        if any(
+            rec.job.reset_on_run_transition
+            for rec in self._records.values()
+        ):
+            # Run-boundary staleness gate (ADR 0118): once any job's
+            # accumulation resets at this boundary, every checkpoint
+            # written before it must never restore — the marker is
+            # persisted BEFORE the resets run, so a crash anywhere
+            # after this line cannot resurrect old-run state.
+            # graftlint: disable=JGL004 caller (process_jobs) holds self._lock
+            self._reset_seq += 1
+            if self._durability is not None:
+                try:
+                    self._durability.note_reset(self._reset_seq)
+                except Exception:
+                    logger.exception("reset-marker persist failed")
         for rec in self._records.values():
             if rec.job.reset_on_run_transition:
                 # The run's final accumulation, captured before the reset
@@ -566,6 +914,7 @@ class JobManager:
                             "donation; accumulation reset (see service "
                             "log)"
                         )
+                        self._after_state_loss(rec)
                 continue
             observer = self._link_observer
             # Compile rounds are one-off XLA work, not round trips —
@@ -595,6 +944,7 @@ class JobManager:
                             "donation; accumulation reset (see service "
                             "log)"
                         )
+                        self._after_state_loss(rec)
                     elif res.carry:
                         # The fold already ran on device: adopt the new
                         # state so the job keeps a live buffer, and let
@@ -808,6 +1158,7 @@ class JobManager:
                             "tick program failed after buffer donation; "
                             "accumulation reset (see service log)"
                         )
+                        self._after_state_loss(rec)
                 continue
             observer = self._link_observer
             # Compile rounds are one-off XLA work, not round trips —
@@ -842,6 +1193,7 @@ class JobManager:
                             "tick program failed after buffer donation; "
                             "accumulation reset (see service log)"
                         )
+                        self._after_state_loss(rec)
                     elif res.carry:
                         # The step+fold already ran on device: adopt the
                         # new state, mark the stream accumulated (a
@@ -964,6 +1316,7 @@ class JobManager:
                 )
             ]
         staged_keys: set[tuple] = set()
+        wire_flipped = False
         for name, value in data.items():
             if not isinstance(value, StagedEvents) or value.cache is None:
                 continue
@@ -988,8 +1341,8 @@ class JobManager:
                     continue
                 if wire_compact is not None:
                     set_wire = getattr(offer.hist, "set_wire_format", None)
-                    if set_wire is not None:
-                        set_wire(wire_compact)
+                    if set_wire is not None and set_wire(wire_compact):
+                        wire_flipped = True
                 key = (name, offer.key)
                 if key in staged_keys:
                     continue
@@ -1027,6 +1380,13 @@ class JobManager:
                         name,
                         rec.job.job_id,
                     )
+        if wire_flipped:
+            # The link policy just flipped the partitioned wire: every
+            # pallas2d tick program re-keys on its next publish. Warm
+            # the new-wire programs off the hot path (ADR 0118); the
+            # race with the very next window is best-effort — losing it
+            # costs exactly the compile the instrument reports today.
+            self._queue_warmup("wire_flip")
 
     def peek_pending_streams(self) -> set[str]:
         """Context streams still gating some job (the processor uses this
@@ -1087,6 +1447,15 @@ class JobManager:
         """
         context = context or {}
         with self._lock:
+            # Warm-up shape memory (ADR 0118): the padded batch size
+            # each stream carries is the staged-signature dimension of
+            # every tick-program key — commit-time warm-up compiles
+            # against the shape the stream is actually running at.
+            for name, value in data.items():
+                if isinstance(value, StagedEvents):
+                    self._stream_batch_shapes[name] = (
+                        value.batch.padded_size
+                    )
             if not prestaged:
                 # New window generation: previous staged slots drop, and
                 # this window's event batches get stream slots so every
@@ -1416,6 +1785,7 @@ class JobManager:
                             "fused step failed after buffer donation; "
                             "accumulation reset (see service log)"
                         )
+                        self._after_state_loss(rec)
                 continue
             for (rec, strm, _value, offer), new_state in zip(
                 members, new_states, strict=True

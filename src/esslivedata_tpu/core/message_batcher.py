@@ -75,6 +75,10 @@ class MessageBatcher(Protocol):
 class NaiveMessageBatcher:
     """Emit every nonempty poll as one batch with pulse-quantized bounds."""
 
+    #: Emits every poll's messages immediately — nothing ever pends
+    #: (the durability plane's quiescence probe, ADR 0118).
+    pending_messages = 0
+
     def batch(self, messages: list[Message]) -> MessageBatch | None:
         if not messages:
             return None
@@ -121,6 +125,16 @@ class SimpleMessageBatcher:
     def _window_pulses_next(self) -> int:
         """Hook for adaptive subclass: pulses for the next opened window."""
         return self._window_pulses
+
+    @property
+    def pending_messages(self) -> int:
+        """Messages buffered toward a not-yet-closed window. The
+        durability plane (ADR 0118) checkpoints only when this reads 0:
+        a bookmark taken while a partial window sits here would claim
+        data as processed that no job state yet contains — replay
+        would then skip it."""
+        with self._lock:
+            return len(self._buffer)
 
     def batch(self, messages: list[Message]) -> MessageBatch | None:
         with self._lock:

@@ -196,6 +196,7 @@ class OrchestratingProcessor:
         flatten_threads: int = 0,
         link_monitor=None,
         result_fanout=None,
+        durability=None,
     ) -> None:
         self._source = source
         self._sink = sink
@@ -237,6 +238,18 @@ class OrchestratingProcessor:
         #: None = no serving plane (classic deployments, tests).
         self._result_fanout = result_fanout
         self._last_fanout_qos = -float("inf")
+        #: Durability plane (durability/checkpoint.py, ADR 0118):
+        #: periodic state + offset checkpoints taken HERE, on the
+        #: service thread, only at quiescent window boundaries (no
+        #: partial window in the batcher, no in-flight pipeline
+        #: window) — the one point where "every delivered offset is in
+        #: job state" holds, which is what makes restore + replay
+        #: exactly-once instead of double-counting.
+        self._durability = durability
+        if durability is not None:
+            set_durability = getattr(job_manager, "set_durability", None)
+            if set_durability is not None:
+                set_durability(durability)
         if result_fanout is not None:
             # Removed jobs drop their cached streams: without this the
             # plane would list a dead job in /results and pin a ring of
@@ -286,6 +299,16 @@ class OrchestratingProcessor:
             from .link_monitor import LinkMonitor
 
             self._link_monitor = link_monitor or LinkMonitor()
+        if self._durability is not None and self._link_monitor is not None:
+            # Cadence governance (ADR 0118): the plane stretches its
+            # interval while the link is degraded or the publish tick
+            # widened — snapshot fetches must never compete with a
+            # congested publish path.
+            set_monitor = getattr(
+                self._durability, "set_link_monitor", None
+            )
+            if set_monitor is not None:
+                set_monitor(self._link_monitor)
         # Unified telemetry (ADR 0116): one keyed collector per
         # processor feeding the process registry at scrape time — link
         # estimates, pipeline depths/utilization, stream/sink/source
@@ -376,6 +399,67 @@ class OrchestratingProcessor:
         if now - self._last_metrics >= METRICS_INTERVAL_S:
             self._last_metrics = now
             self._log_metrics()
+        if self._durability is not None:
+            self._maybe_checkpoint()
+
+    # -- durability plane (durability/, ADR 0118) --------------------------
+    def _quiescent(self) -> bool:
+        """True when every delivered message is in job state: no
+        partial window buffered in the batcher, no window in flight in
+        the pipeline. Checkpoints only happen here — a bookmark taken
+        mid-window would either lose the buffered tail (too new) or
+        replay data the dumped states already contain (too old)."""
+        # A batcher that does NOT expose the probe is treated as
+        # never-quiescent (no checkpoint, no bookmark): a custom
+        # batcher with invisible buffering must not get bookmarks that
+        # silently skip its buffered tail on restore.
+        pending = getattr(self._batcher, "pending_messages", None)
+        if pending is None or pending:
+            return False
+        if self._pipeline is not None:
+            try:
+                if self._pipeline.telemetry()["inflight"]:
+                    return False
+            except Exception:  # pragma: no cover - defensive
+                return False
+        return True
+
+    def _bookmarks(self) -> dict[str, int]:
+        """Per-topic next-consume offsets of everything handed to this
+        processor, from the raw transport (duck-typed ``positions``;
+        in-memory fakes simply have none — the manifest then carries no
+        bookmarks and a restart pins to the high watermark, exactly the
+        pre-durability behavior)."""
+        transport = _transport_of(self._source)
+        positions = getattr(transport, "positions", None)
+        if positions is None:
+            return {}
+        try:
+            return dict(positions())
+        except Exception:  # pragma: no cover - defensive
+            logger.debug("bookmark probe failed", exc_info=True)
+            return {}
+
+    def _maybe_checkpoint(self, *, force: bool = False) -> None:
+        """Take one checkpoint when due AND quiescent (deferred
+        otherwise — the next quiescent cycle retries; replay covers
+        whatever the deferral leaves out)."""
+        plane = self._durability
+        try:
+            if not force and not plane.due():
+                return
+            if not self._quiescent():
+                return
+            entries = self._job_manager.checkpoint_snapshot()
+            if not entries:
+                return
+            plane.checkpoint(
+                entries,
+                offsets=self._bookmarks(),
+                reset_seq=getattr(self._job_manager, "reset_seq", 0),
+            )
+        except Exception:
+            logger.exception("checkpoint failed; will retry next cycle")
 
     # -- pipelined ingest (ADR 0111) ---------------------------------------
     @property
@@ -997,6 +1081,13 @@ class OrchestratingProcessor:
             self._publish_status(state="stopped")
         except Exception:
             logger.exception("Failed to publish final status")
+        if self._durability is not None:
+            # Final checkpoint on graceful stop (the pipeline just
+            # drained): the restart resumes from HERE, replaying only
+            # what arrived after the stop. Quiescence still gates it —
+            # a batcher holding a partial window defers to the last
+            # periodic generation, whose bookmark replays that window.
+            self._maybe_checkpoint(force=True)
         self._job_manager.shutdown()
         # Drop this processor's scrape collector: the registry is
         # process-wide and a finalized processor must not keep feeding

@@ -242,6 +242,21 @@ class RateAwareMessageBatcher:
     def window(self) -> Duration:
         return self._window
 
+    @property
+    def pending_messages(self) -> int:
+        """Messages buffered toward not-yet-closed windows across every
+        internal hold (non-gated flow, overflow, near-future, per-stream
+        gated slots) — the durability plane's quiescence probe
+        (ADR 0118): a checkpoint bookmark must not claim these as
+        processed. Read from the owning service thread (like the rest
+        of this batcher's unlocked state)."""
+        pending = (
+            len(self._non_gated) + len(self._overflow) + len(self._future)
+        )
+        for state in self._streams.values():
+            pending += len(state.bucket)
+        return pending
+
     def set_window(self, window: Duration) -> None:
         """Change the window length; takes effect at the next batch start."""
         self._pending_window = window

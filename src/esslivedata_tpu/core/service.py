@@ -189,6 +189,36 @@ def setup_arg_parser(description: str = "") -> argparse.ArgumentParser:
         "ephemeral port (ADR 0117)",
     )
     parser.add_argument(
+        "--checkpoint-dir",
+        default=None,
+        metavar="DIR",
+        help="durability plane (ADR 0118): periodically checkpoint "
+        "every job's device state + Kafka offset bookmarks into DIR "
+        "(atomic manifests); on restart the newest consistent "
+        "generation restores and consumers seek to the bookmarks, so "
+        "the gap replays instead of the accumulation resetting. "
+        "LIVEDATA_CHECKPOINT_DIR equivalently",
+    )
+    parser.add_argument(
+        "--checkpoint-interval",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="checkpoint cadence (default 30 s), stretched "
+        "automatically while the link is congested "
+        "(LIVEDATA_CHECKPOINT_INTERVAL equivalently)",
+    )
+    parser.add_argument(
+        "--warmup",
+        action="store_true",
+        default=False,
+        help="AOT warm-up (ADR 0118): compile tick programs on a "
+        "background thread at job-commit/policy-flip time so the hot "
+        "path never pays a jit compile at commit; with "
+        "--checkpoint-dir also enables JAX's persistent compilation "
+        "cache so restarts skip XLA (LIVEDATA_WARMUP equivalently)",
+    )
+    parser.add_argument(
         "--trace-dump",
         default=None,
         metavar="PATH",

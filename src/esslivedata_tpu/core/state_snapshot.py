@@ -118,6 +118,12 @@ class SnapshotStore:
             np.savez(
                 fh, __meta__=np.frombuffer(meta.encode(), np.uint8), **arrays
             )
+            fh.flush()
+            # fsync BEFORE the rename (graftlint JGL020): without it
+            # the rename can become durable before the data it names,
+            # and a crash leaves the final path pointing at garbage a
+            # restart would trust.
+            os.fsync(fh.fileno())
         os.replace(tmp, path)  # atomic: a reader never sees a torn file
         logger.info(
             "Snapshot saved for %s/%s (%s)", workflow_id, source_name, reason

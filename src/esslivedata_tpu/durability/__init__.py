@@ -1,0 +1,40 @@
+"""Durability plane (ADR 0118): churn made invisible, state survivable.
+
+Three pieces, composable and individually optional:
+
+- :mod:`.warmup` — ``CompileWarmupService``: a background thread that
+  AOT-lowers and compiles tick programs at job-commit (and policy-flip)
+  time, seeding the :class:`~..ops.tick.TickCombiner` program LRU so
+  the first post-commit tick is a cache hit — commit-time compile count
+  on the hot path is 0 (measured by the ADR 0116 instrument) and
+  first-tick latency equals steady state. Also enables JAX's persistent
+  compilation cache so process restarts skip XLA entirely.
+- :mod:`.checkpoint` — ``CheckpointPlane``: periodic, epoch-tagged
+  device→host snapshots of rolling-histogram state plus per-stream
+  Kafka offset bookmarks, written atomically under a manifest
+  (write-tmp/fsync/rename — the JGL020 discipline), on a cadence the
+  ``LinkMonitor`` stretches when the publish path is congested.
+- :mod:`.replay` — restore the newest consistent manifest on restart
+  (stale manifests from before the last run-boundary reset are
+  rejected), seek consumers to the bookmarks, and replay the gap
+  through the normal ingest path. The ADR 0117 ``state_epoch``/delta
+  discipline means restored jobs resume SSE subscribers with one
+  keyframe — viewers see a gap, not a reset.
+"""
+
+from .checkpoint import CheckpointPlane
+from .replay import load_latest_manifest, start_offsets
+from .warmup import (
+    CompileWarmupService,
+    WarmupRequest,
+    enable_persistent_compilation_cache,
+)
+
+__all__ = [
+    "CheckpointPlane",
+    "CompileWarmupService",
+    "WarmupRequest",
+    "enable_persistent_compilation_cache",
+    "load_latest_manifest",
+    "start_offsets",
+]

@@ -1,0 +1,172 @@
+"""Restore + gap replay: restart means catch up, not start over.
+
+The restart path (ADR 0118) is deliberately thin, because the heavy
+machinery already exists elsewhere:
+
+1. :func:`load_latest_manifest` picks the newest checkpoint generation
+   that is **consistent** (manifest parses, every referenced state file
+   exists with the recorded digest) and **not stale** (written at or
+   after the persisted run-boundary ``reset_seq`` marker — a manifest
+   from before the most recent reset would resurrect old-run state,
+   violating ADR 0107's no-blending guarantee). Older generations are
+   the fallback when the newest is torn (a crash mid-write leaves the
+   previous one whole by construction).
+2. :func:`start_offsets` hands the manifest's bookmarks to
+   ``kafka.consumer.assign_all_partitions(start_offsets=...)``: the
+   consumer seeks to the bookmark instead of the high watermark, and
+   the **normal ingest path replays the gap** — decode, stage, fused
+   step, tick program, publish, exactly as live data flows. Run
+   transitions that arrived inside the gap re-fire their resets at the
+   same data times, so replay reproduces boundary behavior too.
+3. State restore rides the existing schedule-time hook
+   (``JobManager._maybe_restore`` → ``CheckpointPlane.restore_job``),
+   fingerprint-gated per ADR 0107. The restored job carries its
+   checkpointed ``state_epoch`` and generation start, so outputs stamp
+   the same time coords an uninterrupted process would have and the
+   serving tier (ADR 0117) resumes subscribers with one keyframe —
+   viewers see a gap, not a reset.
+
+``livedata_durability_replay_lag`` records, per topic, how far behind
+the high watermark the seeked bookmark was — the size of the gap the
+restart is about to replay.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+from pathlib import Path
+
+from ..telemetry.registry import REGISTRY
+
+__all__ = [
+    "load_latest_manifest",
+    "record_replay_lag",
+    "start_offsets",
+]
+
+logger = logging.getLogger(__name__)
+
+_REPLAY_LAG = REGISTRY.gauge(
+    "livedata_durability_replay_lag",
+    "Distance (broker offset units; bytes on the file broker) between "
+    "the restored bookmark and the high watermark at seek time — the "
+    "gap the restart replays through the normal ingest path",
+    labelnames=("topic",),
+)
+
+
+def load_latest_manifest(directory) -> dict | None:
+    """The newest consistent, non-stale manifest as a dict, or None.
+
+    Consistency: the manifest parses AND every referenced state file
+    exists with its recorded sha256 (a crash between state writes and
+    the manifest rename cannot happen by construction — states land
+    first — but disk rot or manual deletion can). Staleness: the
+    manifest's ``reset_seq`` must be >= the persisted reset marker.
+    Older generations are tried in turn, so one torn/stale generation
+    degrades to the previous one instead of to nothing.
+    """
+    from .checkpoint import MANIFEST_RE, RESET_MARKER
+
+    directory = Path(directory)
+    try:
+        marker = int(
+            json.loads((directory / RESET_MARKER).read_bytes())["reset_seq"]
+        )
+    except FileNotFoundError:
+        marker = 0
+    except Exception:
+        logger.exception("unreadable reset marker; treating as 0")
+        marker = 0
+    manifests = sorted(
+        (
+            (int(m.group(1)), p)
+            for p in directory.glob("manifest-*.json")
+            if (m := MANIFEST_RE.match(p.name))
+        ),
+        reverse=True,
+    )
+    for epoch, path in manifests:
+        try:
+            doc = json.loads(path.read_bytes())
+        except Exception:
+            logger.warning("manifest %s unreadable; trying older", path)
+            continue
+        if doc.get("reset_seq", 0) < marker:
+            logger.info(
+                "manifest %s is stale (reset_seq %s < marker %s): a "
+                "run-boundary reset happened after it was written — "
+                "refusing to resurrect old-run state",
+                path.name,
+                doc.get("reset_seq", 0),
+                marker,
+            )
+            # Older manifests are older still: nothing restorable.
+            return None
+        consistent = True
+        for job in doc.get("jobs", ()):
+            state = directory / job["file"]
+            try:
+                payload = state.read_bytes()
+            except OSError:
+                consistent = False
+                break
+            if hashlib.sha256(payload).hexdigest() != job["sha256"]:
+                consistent = False
+                break
+        if not consistent:
+            logger.warning(
+                "manifest %s references missing/corrupt state; trying "
+                "older",
+                path.name,
+            )
+            continue
+        logger.info(
+            "restoring from checkpoint generation %d (%d jobs, %d "
+            "bookmarked topics)",
+            epoch,
+            len(doc.get("jobs", ())),
+            len(doc.get("offsets", {})),
+        )
+        return doc
+    return None
+
+
+def start_offsets(manifest: dict | None) -> dict[str, int]:
+    """The manifest's bookmarks in ``assign_all_partitions`` form
+    (empty dict = no manifest = every partition pins to the high
+    watermark, exactly the pre-durability behavior)."""
+    if not manifest:
+        return {}
+    return {
+        topic: int(offset)
+        for topic, offset in manifest.get("offsets", {}).items()
+    }
+
+
+def record_replay_lag(consumer, topics, offsets: dict[str, int]) -> int:
+    """Record (and return the sum of) the per-topic replay backlog:
+    high watermark minus bookmark at seek time. Best-effort — a broker
+    that cannot answer watermark queries just skips the gauge."""
+    total = 0
+    try:
+        from ..kafka.consumer import _topic_partition_type
+
+        TopicPartition = _topic_partition_type()
+        metadata = consumer.list_topics(timeout=10.0)
+        for topic in topics:
+            if topic not in offsets or topic not in metadata.topics:
+                continue
+            lag = 0
+            for partition_id in metadata.topics[topic].partitions:
+                _, high = consumer.get_watermark_offsets(
+                    TopicPartition(topic, partition_id), timeout=10.0
+                )
+                lag += max(0, int(high) - int(offsets[topic]))
+            _REPLAY_LAG.set(float(lag), topic=topic)
+            total += lag
+    except Exception:
+        logger.debug("replay-lag probe failed", exc_info=True)
+    return total

@@ -87,32 +87,67 @@ def _topic_partition_type():
 
 
 def assign_all_partitions(
-    consumer: AssignableConsumer, topics: Sequence[str]
+    consumer: AssignableConsumer,
+    topics: Sequence[str],
+    *,
+    start_offsets: dict[str, int] | None = None,
 ) -> int:
-    """Assign every partition of ``topics``, offsets at the high watermark.
+    """Assign every partition of ``topics``; offsets at the high
+    watermark, or at a caller-provided **bookmark** (durability plane,
+    ADR 0118).
 
-    Returns the number of partitions assigned. Topics are validated (from
-    the same single metadata fetch) so a typo fails loudly instead of
-    consuming nothing forever.
+    Without ``start_offsets`` every partition pins at the current high
+    watermark — exactly the data produced after assignment is consumed,
+    and a restart loses the gap (the documented reference behavior).
+    With it, a topic present in the dict seeks to its bookmarked offset
+    instead, CLAMPED to the broker's retained ``[low, high]`` range: a
+    bookmark below the low watermark (retention caught up) resumes at
+    the oldest retained data, one above the high watermark (topic
+    truncated/recreated since the checkpoint) falls back to live —
+    both logged, neither fatal, because a clamped replay beats no
+    replay. Topics absent from the dict keep the high-watermark pin.
+
+    Returns the number of partitions assigned. Topics are validated
+    (from the same single metadata fetch) so a typo fails loudly
+    instead of consuming nothing forever.
     """
     TopicPartition = _topic_partition_type()
 
     metadata = consumer.list_topics(timeout=_METADATA_TIMEOUT_S)
     _validate(metadata, topics)
     assignments: list[Any] = []
+    seeked = 0
     for topic in topics:
+        bookmark = (start_offsets or {}).get(topic)
         for partition_id in metadata.topics[topic].partitions:
             tp = TopicPartition(topic, partition_id)
-            _, high = consumer.get_watermark_offsets(
+            low, high = consumer.get_watermark_offsets(
                 tp, timeout=_METADATA_TIMEOUT_S
             )
-            tp.offset = high
+            if bookmark is None:
+                tp.offset = high
+            else:
+                tp.offset = max(low, min(int(bookmark), high))
+                seeked += 1
+                if tp.offset != int(bookmark):
+                    logger.warning(
+                        "bookmark %d for %s[%d] outside retained "
+                        "[%d, %d]; clamped to %d",
+                        bookmark,
+                        topic,
+                        partition_id,
+                        low,
+                        high,
+                        tp.offset,
+                    )
             assignments.append(tp)
     consumer.assign(assignments)
     logger.info(
-        "Assigned %d partitions across %d topics at high watermark",
+        "Assigned %d partitions across %d topics (%d at bookmarks, "
+        "rest at high watermark)",
         len(assignments),
         len(topics),
+        seeked,
     )
     return len(assignments)
 

@@ -59,12 +59,19 @@ def ensure_topics(root: str | Path, topics) -> None:
 class FileMessage:
     """confluent_kafka.Message-shaped record."""
 
-    __slots__ = ("_topic", "_value", "_key")
+    __slots__ = ("_topic", "_value", "_key", "_next_offset")
 
-    def __init__(self, topic: str, value: bytes, key: bytes | None) -> None:
+    def __init__(
+        self,
+        topic: str,
+        value: bytes,
+        key: bytes | None,
+        next_offset: int = -1,
+    ) -> None:
         self._topic = topic
         self._value = value
         self._key = key
+        self._next_offset = next_offset
 
     def topic(self) -> str:
         return self._topic
@@ -74,6 +81,15 @@ class FileMessage:
 
     def key(self) -> bytes | None:
         return self._key
+
+    def next_offset(self) -> int:
+        """The byte offset a consumer resuming AFTER this message
+        should seek to (the durability plane's bookmark unit on this
+        broker, ADR 0118). File-broker offsets are byte positions —
+        the confluent path uses message ``offset() + 1`` instead; the
+        transport layer (kafka/source.py) probes for whichever the
+        message carries."""
+        return self._next_offset
 
     def error(self):
         return None
@@ -208,16 +224,22 @@ class FileBrokerConsumer:
                 if len(payload) < key_len + value_len:
                     # Partial frame: a writer is mid-append; retry later.
                     break
+                offset = f.tell()
                 out.append(
                     FileMessage(
                         topic,
                         payload[key_len:],
                         payload[:key_len] or None,
+                        next_offset=offset,
                     )
                 )
-                offset = f.tell()
         self._offsets[topic] = offset
         return out
+
+    def positions(self) -> dict[str, int]:
+        """Next-read byte offset per assigned topic — the consumer-side
+        bookmark surface (durability plane, ADR 0118)."""
+        return dict(self._offsets)
 
     def close(self) -> None:
         self._offsets.clear()

@@ -156,6 +156,21 @@ class BackgroundMessageSource:
         self._last_success = time.monotonic()
         self._dropped_batches = 0
         self._consumed_messages = 0
+        # Next-consume offset per topic of everything HANDED TO the
+        # worker (not merely consumed into the queue): the durability
+        # plane's bookmark surface (ADR 0118). Updated under the queue
+        # lock on the worker side, so a checkpoint taken between
+        # process cycles sees exactly the delivered frontier.
+        # Bookmarks are PER TOPIC, which is only exact for topics with
+        # one partition (the file broker always; per-instrument Kafka
+        # topics typically): a topic observed on >= 2 partitions is
+        # excluded from positions() — one merged number would seek
+        # every partition to the max and silently skip the slower
+        # partitions' gap. Excluded topics resume at the high
+        # watermark, the documented pre-durability behavior.
+        self._delivered_offsets: dict[str, int] = {}
+        self._topic_partitions: dict[str, set] = {}
+        self._multi_partition_logged: set[str] = set()
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> None:
@@ -223,6 +238,32 @@ class BackgroundMessageSource:
                 return
 
     # -- worker side ------------------------------------------------------
+    @staticmethod
+    def _message_next_offset(message) -> int | None:
+        """The resume offset AFTER ``message``: file-broker messages
+        carry ``next_offset()`` (byte positions), confluent messages
+        ``offset()`` (message index — resume at +1). None when the
+        transport exposes neither (in-memory fakes): those deployments
+        simply have no bookmarks, which is the pre-durability
+        behavior."""
+        probe = getattr(message, "next_offset", None)
+        if probe is not None:
+            try:
+                value = probe()
+                return None if value is None or value < 0 else int(value)
+            except Exception:  # pragma: no cover - defensive
+                return None
+        probe = getattr(message, "offset", None)
+        if probe is not None:
+            try:
+                value = probe()
+                return (
+                    None if value is None or value < 0 else int(value) + 1
+                )
+            except Exception:  # pragma: no cover - defensive
+                return None
+        return None
+
     def get_messages(self) -> list[KafkaMessage]:
         # Drain before checking the breaker: good messages enqueued alongside
         # the fatal error event must still reach the worker; only once the
@@ -231,6 +272,24 @@ class BackgroundMessageSource:
             out: list[KafkaMessage] = []
             while self._queue:
                 out.extend(self._queue.popleft())
+            for message in out:
+                next_offset = self._message_next_offset(message)
+                if next_offset is not None:
+                    topic = message.topic()
+                    partition_probe = getattr(message, "partition", None)
+                    if partition_probe is not None:
+                        try:
+                            self._topic_partitions.setdefault(
+                                topic, set()
+                            ).add(partition_probe())
+                        except Exception:  # pragma: no cover
+                            logger.debug(
+                                "partition probe failed for %s",
+                                topic,
+                                exc_info=True,
+                            )
+                    if next_offset > self._delivered_offsets.get(topic, -1):
+                        self._delivered_offsets[topic] = next_offset
         if not out and self._broken:
             raise RuntimeError(
                 "Kafka consumer circuit breaker open (repeated consume errors)"
@@ -251,6 +310,32 @@ class BackgroundMessageSource:
     @property
     def is_healthy(self) -> bool:
         return self.health == ConsumerHealth.OK
+
+    def positions(self) -> dict[str, int]:
+        """Per-topic next-consume offsets of everything handed to the
+        worker — the processor's checkpoint bookmarks (ADR 0118). The
+        worker takes these only at quiescent window boundaries, where
+        delivered == folded-into-state, so bookmark + state restore +
+        replay is exactly-once. Topics observed on more than one
+        partition are EXCLUDED (logged once): a single merged offset
+        cannot bookmark several partitions without skipping the slower
+        ones' gap on restore — those topics resume at live instead."""
+        with self._lock:
+            out = {}
+            for topic, offset in self._delivered_offsets.items():
+                if len(self._topic_partitions.get(topic, ())) > 1:
+                    if topic not in self._multi_partition_logged:
+                        self._multi_partition_logged.add(topic)
+                        logger.warning(
+                            "topic %s spans multiple partitions: "
+                            "excluded from checkpoint bookmarks "
+                            "(restart resumes it at the high "
+                            "watermark)",
+                            topic,
+                        )
+                    continue
+                out[topic] = offset
+            return out
 
     @property
     def metrics(self) -> dict[str, int]:

@@ -57,6 +57,7 @@ member intact.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from collections import OrderedDict
 from collections.abc import Callable, Sequence
@@ -98,6 +99,13 @@ class TickCombiner:
     def __init__(self, max_programs: int = 16) -> None:
         self._programs: OrderedDict[tuple, Callable] = OrderedDict()
         self._max_programs = int(max_programs)
+        # The LRU is touched from TWO threads since ADR 0118: the step
+        # worker's publish() and the warm-up thread's warm() (insert +
+        # eviction). An unlocked move_to_end racing a concurrent
+        # eviction is a KeyError in the middle of a live tick; the
+        # lock covers only dict operations (never a build/compile), so
+        # it costs nanoseconds against a millisecond tick.
+        self._programs_lock = threading.Lock()
         #: True when the last ``publish`` compiled its program (cache
         #: miss). RTT observers must skip those rounds — same contract
         #: as ``PublishCombiner.last_compiled`` (ADR 0113): a tick
@@ -133,21 +141,17 @@ class TickCombiner:
                 CombinedPublish(None, (), error=planned_errors.get(i))
                 for i in range(len(requests))
             ]
-        key = (
-            hist,
-            group_key,
-            PackedPublisher._signature(staged),
-            member_signature(plan),
-        )
-        fn = self._programs.get(key)
-        self.last_compiled = fn is None
-        if fn is not None:
-            # LRU touch: the steady-state program runs every tick and
-            # must never be the eviction victim of key churn (layout
-            # swaps, wire flips) — eviction means a surprise whole-tick
-            # recompile in the hot path.
-            self._programs.move_to_end(key)
-        else:
+        key = self._program_key(hist, group_key, staged, plan)
+        with self._programs_lock:
+            fn = self._programs.get(key)
+            self.last_compiled = fn is None
+            if fn is not None:
+                # LRU touch: the steady-state program runs every tick
+                # and must never be the eviction victim of key churn
+                # (layout swaps, wire flips) — eviction means a
+                # surprise whole-tick recompile in the hot path.
+                self._programs.move_to_end(key)
+        if fn is None:
             fn = self._build(
                 hist,
                 len(staged),
@@ -157,10 +161,11 @@ class TickCombiner:
                     in plan
                 ],
             )
-            self._programs[key] = fn
-            self._programs.move_to_end(key)
-            while len(self._programs) > self._max_programs:
-                self._programs.popitem(last=False)
+            with self._programs_lock:
+                self._programs[key] = fn
+                self._programs.move_to_end(key)
+                while len(self._programs) > self._max_programs:
+                    self._programs.popitem(last=False)
         flat_args = tuple(staged) + tuple(
             a for _i, req, *_ in plan for a in req.args
         )
@@ -203,7 +208,13 @@ class TickCombiner:
             # the caller, which needs to know whose donated state the
             # failed dispatch already consumed (state_lost — the step
             # donates every member state, so a runtime failure may have
-            # invalidated all of them).
+            # invalidated all of them). The cached program is evicted:
+            # a poisoned entry (an AOT-warmed executable whose input
+            # placement drifted, a backend error pinned to this
+            # compilation) must not fail every later tick — the next
+            # tick recompiles fresh instead.
+            with self._programs_lock:
+                self._programs.pop(key, None)
             logger.exception(
                 "tick program dispatch failed (%d jobs)", len(plan)
             )
@@ -228,6 +239,97 @@ class TickCombiner:
             slice_key=slice_key,
         )
         return [by_index[i] for i in range(len(requests))]
+
+    @staticmethod
+    def _program_key(hist, group_key, staged: tuple, plan: list) -> tuple:
+        """The program-LRU key for one planned tick — shared by the
+        live path and the AOT warm-up (durability/warmup.py) so the two
+        can never compute different keys for the same program."""
+        return (
+            hist,
+            group_key,
+            PackedPublisher._signature(staged),
+            member_signature(plan),
+        )
+
+    def warm(
+        self,
+        hist,
+        group_key,
+        staged: tuple,
+        requests: Sequence[PublishRequest],
+    ) -> int:
+        """AOT-compile the tick program(s) for this group and seed the
+        program LRU, so the group's next LIVE tick is a cache hit — no
+        compile stall on the hot path, no ``livedata_jit_compiles_total``
+        event at commit time (the durability plane's warm-up contract,
+        ADR 0118).
+
+        ``staged`` may be synthetic (a zero-filled batch staged to the
+        group's device): only its signature reaches the key, and
+        lowering reads avals, never values. Member ``requests`` may
+        carry :class:`jax.ShapeDtypeStruct` trees in place of the live
+        state arrays — ``member_signature`` is shape/dtype-based, so
+        the warmed key equals the live key exactly, and nothing here
+        can touch (or donate) a live buffer.
+
+        Both program variants a fresh member set needs are warmed: the
+        plan as it stands now (static-inclusive for members whose
+        static token has not been fetched yet — the first post-commit
+        tick) and the all-static-excluded steady-state variant. Returns
+        the number of programs actually compiled (0 = already warm).
+        Failures raise to the caller (the warm-up service contains and
+        counts them); nothing is inserted on failure, so the live path
+        compiles honestly — the instrument then reports the miss
+        instead of a warmed lie.
+        """
+        plan, _planned_errors = plan_members(requests)
+        if not plan:
+            return 0
+        variants = [plan]
+        steady = [
+            (i, req, skeys, dyn_spec, static_names, False, cached, size)
+            for i, req, skeys, dyn_spec, static_names, _inc, cached, size
+            in plan
+        ]
+        if member_signature(steady) != member_signature(plan):
+            variants.append(steady)
+        compiled = 0
+        for variant in variants:
+            key = self._program_key(hist, group_key, staged, variant)
+            with self._programs_lock:
+                if key in self._programs:
+                    continue
+            fn = self._build(
+                hist,
+                len(staged),
+                [
+                    (req.publisher, len(req.args), skeys, include_static)
+                    for _i, req, skeys, _spec, _names, include_static, _c,
+                    _s in variant
+                ],
+            )
+            flat_args = tuple(staged) + tuple(
+                a for _i, req, *_ in variant for a in req.args
+            )
+            # The stored entry is the AOT EXECUTABLE, not the jit
+            # wrapper: a jit fn seeded here would still trace+compile on
+            # its first live call, making the warmed 0-compile claim a
+            # lie. ``Compiled`` validates avals at call time, so a
+            # signature drift surfaces as a contained dispatch error
+            # (and the eviction above recompiles fresh), never a wrong
+            # result.
+            executable = fn.lower(*flat_args).compile()
+            with self._programs_lock:
+                # A live tick may have compiled the same key while we
+                # lowered: its program is serving, never clobber it.
+                if key not in self._programs:
+                    self._programs[key] = executable
+                    self._programs.move_to_end(key)
+                    while len(self._programs) > self._max_programs:
+                        self._programs.popitem(last=False)
+            compiled += 1
+        return compiled
 
     #: Compile-site label for the instrument; the mesh subclass
     #: (parallel/mesh_tick.py) overrides to "mesh_tick".

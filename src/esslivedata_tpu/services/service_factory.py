@@ -132,6 +132,28 @@ class DataServiceBuilder:
         self.serve_port: int | None = (
             int(_serve_env) if _serve_env else None
         )
+        # Durability plane (durability/, ADR 0118): periodic state +
+        # offset checkpoints under --checkpoint-dir, AOT tick-program
+        # warm-up under --warmup. The runner's flags override after
+        # build, like every other axis here.
+        self.checkpoint_dir: str | None = (
+            _os.environ.get("LIVEDATA_CHECKPOINT_DIR") or None
+        )
+        # Empty-but-set env degrades to the default (the serve-port
+        # rule): a deployment template that exports the var
+        # unconditionally must not crash every service at build time.
+        _interval_env = _os.environ.get("LIVEDATA_CHECKPOINT_INTERVAL")
+        self.checkpoint_interval = (
+            float(_interval_env) if _interval_env else 30.0
+        )
+        self.warmup = _os.environ.get(
+            "LIVEDATA_WARMUP", ""
+        ).lower() in ("1", "true", "yes")
+        # Built lazily (durability_plane()) so the runner's restore
+        # path and from_raw_source share ONE plane — and therefore one
+        # sha256-verified manifest load — instead of each scanning the
+        # directory independently.
+        self._durability_plane = None
         self._instrument = instrument_registry[instrument]
         self._instrument.load_factories()
         # Subscribe only to streams the hosted specs consume (reference
@@ -160,6 +182,25 @@ class DataServiceBuilder:
             for key in ("pipeline", "pipeline_depth", "flatten_threads")
             if key in conf
         }
+
+    def durability_plane(self):
+        """The (lazily built, cached) CheckpointPlane for
+        ``checkpoint_dir`` — None when durability is off. Shared by the
+        runner's seek-to-bookmark path and the service build, so the
+        manifest is loaded and digest-verified exactly once."""
+        if self.checkpoint_dir and self._durability_plane is None:
+            from ..durability import CheckpointPlane
+
+            self._durability_plane = CheckpointPlane(
+                self.checkpoint_dir,
+                interval_s=self.checkpoint_interval,
+            )
+            logger.info(
+                "durability plane: checkpoints every %.0f s into %s",
+                self.checkpoint_interval,
+                self.checkpoint_dir,
+            )
+        return self._durability_plane
 
     @property
     def topics(self) -> list[str]:
@@ -206,13 +247,31 @@ class DataServiceBuilder:
                 dict(mesh.shape),
                 [int(d.id) for d in mesh.devices.flat],
             )
+        durability = self.durability_plane()
         job_manager = JobManager(
             job_factory=JobFactory(),
             job_threads=self._job_threads,
             snapshot_store=snapshot_store,
             tick_program=self.tick_program,
             placement=placement,
+            durability=durability,
         )
+        if self.warmup:
+            from ..durability import (
+                CompileWarmupService,
+                enable_persistent_compilation_cache,
+            )
+
+            job_manager.set_warmup(CompileWarmupService())
+            if self.checkpoint_dir:
+                # Restarts skip XLA entirely: the AOT warm-up path and
+                # the live jits share one on-disk compilation cache.
+                import os as _os
+
+                enable_persistent_compilation_cache(
+                    _os.path.join(self.checkpoint_dir, "xla-cache")
+                )
+            logger.info("AOT tick-program warm-up enabled")
         # Contract derived from this instrument's registered specs: outputs
         # listed in ``device_outputs`` ride the stable NICOS device stream.
         contract = DeviceContract.from_specs(
@@ -250,6 +309,7 @@ class DataServiceBuilder:
             pipeline_depth=self.pipeline_depth,
             flatten_threads=self.flatten_threads,
             result_fanout=result_fanout,
+            durability=durability,
         )
         return Service(
             processor=processor,
@@ -389,6 +449,12 @@ class DataServiceRunner:
             builder.mesh_spec = args.mesh or None
         if args.serve_port is not None:
             builder.serve_port = args.serve_port
+        if args.checkpoint_dir is not None:
+            builder.checkpoint_dir = args.checkpoint_dir or None
+        if args.checkpoint_interval is not None:
+            builder.checkpoint_interval = args.checkpoint_interval
+        if args.warmup:
+            builder.warmup = True
         if args.check:
             print(
                 f"{self._service_name}: instrument={args.instrument} "
@@ -434,10 +500,29 @@ class DataServiceRunner:
                 }
             )
             producer = Producer(client_conf)
-        # Manual assignment pinned at the high watermark — never subscribe:
-        # no group rebalancing, no offset commits; a restarted service
-        # resumes at live data (kafka/consumer.py, reference consumer.py:31).
-        assign_all_partitions(consumer, builder.topics)
+        # Manual assignment — never subscribe: no group rebalancing, no
+        # offset commits (kafka/consumer.py, reference consumer.py:31).
+        # Without a checkpoint, offsets pin at the high watermark (the
+        # documented resume-at-live-data gap); WITH one, each bookmarked
+        # topic seeks to its bookmark and the normal ingest path replays
+        # the gap into the restored states (durability/replay.py,
+        # ADR 0118).
+        offsets: dict[str, int] = {}
+        plane = builder.durability_plane()
+        if plane is not None:
+            from ..durability.replay import record_replay_lag
+
+            offsets = plane.bookmarks()
+            if offsets:
+                lag = record_replay_lag(consumer, builder.topics, offsets)
+                logger.info(
+                    "seeking %d bookmarked topic(s); replay backlog %d",
+                    len(offsets),
+                    lag,
+                )
+        assign_all_partitions(
+            consumer, builder.topics, start_offsets=offsets or None
+        )
         service = builder.from_consumer(consumer, producer)
         if args.profile:
             from ..utils.profiling import bounded_device_trace

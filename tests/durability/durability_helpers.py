@@ -1,0 +1,130 @@
+"""Shared builders for the durability-plane suite (ADR 0118)."""
+
+from __future__ import annotations
+
+import uuid
+
+import numpy as np
+
+from esslivedata_tpu.config import JobId, WorkflowConfig, WorkflowSpec
+from esslivedata_tpu.core.job_manager import JobFactory, JobManager
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.kafka.da00_compat import dataarray_to_da00
+from esslivedata_tpu.kafka.wire import encode_da00
+from esslivedata_tpu.ops import EventBatch
+from esslivedata_tpu.preprocessors.event_data import StagedEvents
+from esslivedata_tpu.workflows import WorkflowFactory
+from esslivedata_tpu.workflows.detector_view import (
+    DetectorViewParams,
+    DetectorViewWorkflow,
+    project_logical,
+)
+from esslivedata_tpu.workflows.monitor_workflow import MonitorWorkflow
+
+SIDE = 32
+DET = np.arange(SIDE * SIDE).reshape(SIDE, SIDE)
+
+
+def make_windows(n: int, seed: int = 7, events: int = 4096):
+    """Deterministic per-window staged data for one detector stream and
+    one monitor stream — shared by every manager in a test so replayed
+    windows are bit-for-bit the same input the control saw."""
+    rng = np.random.default_rng(seed)
+    windows = []
+    for _ in range(n):
+        det_pid = rng.choice(SIDE * SIDE, events).astype(np.int32)
+        det_toa = rng.uniform(0, 7.0e7, events).astype(np.float32)
+        mon_toa = rng.uniform(0, 7.0e7, events // 4).astype(np.float32)
+        windows.append(
+            {
+                "det0": StagedEvents(
+                    batch=EventBatch.from_arrays(det_pid, det_toa),
+                    first_timestamp=None,
+                    last_timestamp=None,
+                    n_chunks=1,
+                ),
+                "mon0": StagedEvents(
+                    batch=EventBatch.from_arrays(
+                        np.zeros(events // 4, dtype=np.int32), mon_toa
+                    ),
+                    first_timestamp=None,
+                    last_timestamp=None,
+                    n_chunks=1,
+                ),
+            }
+        )
+    return windows
+
+
+def make_manager(
+    *,
+    durability=None,
+    detector_jobs: int = 2,
+    monitor_jobs: int = 1,
+    toa_bins: int = 50,
+    job_threads: int = 1,
+) -> JobManager:
+    """A JobManager hosting detector_view jobs on det0 and monitor jobs
+    on mon0 — the two snapshot families the restore tests pin."""
+    reg = WorkflowFactory()
+    dv = WorkflowSpec(
+        instrument="durab", name="dv", source_names=["det0"]
+    )
+    reg.register_spec(dv).attach_factory(
+        lambda *, source_name, params: DetectorViewWorkflow(
+            projection=project_logical(DET),
+            params=DetectorViewParams(
+                histogram_method="scatter", toa_bins=toa_bins
+            ),
+        )
+    )
+    mon = WorkflowSpec(
+        instrument="durab", name="mon", source_names=["mon0"]
+    )
+    reg.register_spec(mon).attach_factory(
+        lambda *, source_name, params: MonitorWorkflow()
+    )
+    mgr = JobManager(
+        job_factory=JobFactory(reg),
+        job_threads=job_threads,
+        durability=durability,
+    )
+    for i in range(detector_jobs):
+        mgr.schedule_job(
+            WorkflowConfig(
+                identifier=dv.identifier,
+                job_id=JobId(
+                    source_name="det0", job_number=uuid.UUID(int=i)
+                ),
+            )
+        )
+    for i in range(monitor_jobs):
+        mgr.schedule_job(
+            WorkflowConfig(
+                identifier=mon.identifier,
+                job_id=JobId(
+                    source_name="mon0", job_number=uuid.UUID(int=100 + i)
+                ),
+            )
+        )
+    return mgr
+
+
+def run_window(mgr: JobManager, windows, w: int):
+    return mgr.process_jobs(
+        windows[w],
+        start=Timestamp.from_ns(1 + w),
+        end=Timestamp.from_ns(2 + w),
+    )
+
+
+def wire_of(results) -> list[bytes]:
+    """The exact da00 wire bytes of one window's results, in a
+    deterministic order — the byte-identity currency of this suite."""
+    frames = []
+    for result in sorted(
+        results, key=lambda r: (r.job_id.source_name, str(r.job_id.job_number))
+    ):
+        for name, da in sorted(result.outputs.items()):
+            frames.append(encode_da00(name, 12345, dataarray_to_da00(da)))
+    return frames

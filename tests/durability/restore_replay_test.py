@@ -1,0 +1,246 @@
+"""Restore-then-replay byte identity (ADR 0118): a killed process that
+restores the newest checkpoint and replays from the bookmark produces
+exactly the wire an uninterrupted process would have — for detector_view
+AND monitor, the two snapshot-capable families the suite pins."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from durability_helpers import (
+    make_manager,
+    make_windows,
+    run_window,
+    wire_of,
+)
+
+from esslivedata_tpu.durability import CheckpointPlane
+
+
+@pytest.fixture
+def plane(tmp_path):
+    plane = CheckpointPlane(tmp_path / "ck", interval_s=0)
+    yield plane
+    plane.close()
+
+
+def _checkpoint_after(mgr, plane, window_index: int):
+    return plane.checkpoint(
+        mgr.checkpoint_snapshot(),
+        offsets={"ingest": window_index + 1},
+        reset_seq=getattr(mgr, "reset_seq", 0),
+    )
+
+
+class TestRestoreReplayByteIdentity:
+    def test_detector_and_monitor_wire_identical_after_replay(
+        self, tmp_path, plane
+    ):
+        M = 9
+        windows = make_windows(M, seed=31)
+        control = make_manager()
+        control_wire = [
+            wire_of(run_window(control, windows, w)) for w in range(M)
+        ]
+        # Some windows must be non-trivial or byte-identity is vacuous:
+        # 2 detector jobs x 10 outputs + 1 monitor x 4 outputs.
+        assert all(len(frames) == 24 for frames in control_wire)
+
+        # The doomed process: checkpoint after window 3, keep running
+        # through window 6, then die without any shutdown dump.
+        doomed = make_manager(durability=plane)
+        for w in range(4):
+            run_window(doomed, windows, w)
+        _checkpoint_after(doomed, plane, 3)
+        for w in range(4, 7):
+            run_window(doomed, windows, w)
+        del doomed  # crash: no shutdown, no final checkpoint
+
+        # The restarted process: a FRESH plane over the same directory,
+        # schedule-time restore, replay from the bookmark. Every
+        # replayed window's da00 wire — including the ones the doomed
+        # process already published (4..6) and the final window — must
+        # be byte-identical to the uninterrupted control's.
+        restart_plane = CheckpointPlane(plane.directory, interval_s=0)
+        restored = make_manager(durability=restart_plane)
+        bookmark = restart_plane.bookmarks()["ingest"]
+        assert bookmark == 4
+        for w in range(bookmark, M):
+            assert wire_of(run_window(restored, windows, w)) == (
+                control_wire[w]
+            ), f"window {w}: replayed wire != control wire"
+        restart_plane.close()
+
+    def test_restored_job_continues_generation_not_resets(
+        self, tmp_path, plane
+    ):
+        """The 'gap, not reset' half: the restored accumulation is the
+        checkpointed one (nonzero, == control at the checkpoint), the
+        generation start is the ORIGINAL first-window time (NICOS'
+        reset detector must not fire), and the state_epoch continues
+        the checkpointed lineage (the serving tier resumes with one
+        keyframe, not an epoch regression)."""
+        windows = make_windows(6, seed=33)
+        doomed = make_manager(durability=plane, detector_jobs=1,
+                              monitor_jobs=0)
+        for w in range(3):
+            run_window(doomed, windows, w)
+        _checkpoint_after(doomed, plane, 2)
+        del doomed
+
+        restart_plane = CheckpointPlane(plane.directory, interval_s=0)
+        restored = make_manager(
+            durability=restart_plane, detector_jobs=1, monitor_jobs=0
+        )
+        rec = next(iter(restored._records.values()))
+        # Generation start restored to window 0's start time, not the
+        # replay's first window.
+        assert rec.job.generation_start_ns == 1
+        out = run_window(restored, windows, 3)
+        (result,) = out
+        cumulative = np.asarray(
+            result.outputs["image_cumulative"].data.numpy
+        )
+        # Accumulation continued: four windows' worth of counts, not
+        # one — a reset would have dropped the first three.
+        assert cumulative.sum() == 4 * 4096
+        restart_plane.close()
+
+    def test_second_identical_job_starts_fresh(self, tmp_path, plane):
+        """Schedule-time adoption is once per (workflow, source) per
+        process — the in-memory twin of ADR 0107's one-shot consume: a
+        SECOND identically-configured job committed later must start
+        from zero, not clone the restored accumulation."""
+        import uuid
+
+        from esslivedata_tpu.config import JobId, WorkflowConfig
+
+        windows = make_windows(4, seed=39)
+        doomed = make_manager(durability=plane, detector_jobs=1,
+                              monitor_jobs=0)
+        for w in range(2):
+            run_window(doomed, windows, w)
+        _checkpoint_after(doomed, plane, 1)
+        del doomed
+
+        restart_plane = CheckpointPlane(plane.directory, interval_s=0)
+        restored = make_manager(
+            durability=restart_plane, detector_jobs=1, monitor_jobs=0
+        )
+        first = next(iter(restored._records.values()))
+        restored.schedule_job(
+            WorkflowConfig(
+                identifier=first.job.workflow_id,
+                job_id=JobId(
+                    source_name="det0", job_number=uuid.UUID(int=55)
+                ),
+            )
+        )
+        out = {
+            str(r.job_id.job_number): r
+            for r in run_window(restored, windows, 2)
+        }
+        old = np.asarray(
+            out[str(uuid.UUID(int=0))]
+            .outputs["image_cumulative"].data.numpy
+        )
+        new = np.asarray(
+            out[str(uuid.UUID(int=55))]
+            .outputs["image_cumulative"].data.numpy
+        )
+        assert old.sum() == 3 * 4096  # restored 2 windows + this one
+        assert new.sum() == 4096  # fresh: this window only
+        restart_plane.close()
+
+    def test_fingerprint_mismatch_refuses_restore(self, tmp_path, plane):
+        windows = make_windows(4, seed=35)
+        doomed = make_manager(durability=plane, detector_jobs=1,
+                              monitor_jobs=0)
+        for w in range(2):
+            run_window(doomed, windows, w)
+        _checkpoint_after(doomed, plane, 1)
+        del doomed
+
+        restart_plane = CheckpointPlane(plane.directory, interval_s=0)
+        # Different binning = different fingerprint: the checkpointed
+        # bins mean something else, so the restore must refuse.
+        restored = make_manager(
+            durability=restart_plane,
+            detector_jobs=1,
+            monitor_jobs=0,
+            toa_bins=77,
+        )
+        out = run_window(restored, windows, 2)
+        (result,) = out
+        cumulative = np.asarray(
+            result.outputs["image_cumulative"].data.numpy
+        )
+        assert cumulative.sum() == 4096  # this window only: fresh state
+        restart_plane.close()
+
+
+class TestStateLossReseed:
+    def test_state_lost_reseeds_without_epoch_regression(
+        self, tmp_path, plane
+    ):
+        """The five note_state_lost containment sites re-seed the fresh
+        state from the newest checkpoint (the gap since it is lost, the
+        run is not) WITHOUT adopting the checkpointed epoch — the bump
+        already happened and the next frame must keyframe."""
+        windows = make_windows(5, seed=37)
+        mgr = make_manager(durability=plane, detector_jobs=1,
+                           monitor_jobs=0)
+        for w in range(3):
+            run_window(mgr, windows, w)
+        _checkpoint_after(mgr, plane, 2)
+        rec = next(iter(mgr._records.values()))
+        # Simulate exactly what a containment site does after a failed
+        # donated dispatch: fresh zeroed state + note_state_lost, then
+        # the durability hook.
+        wf = rec.job.workflow
+        wf._state = wf.histogrammer.init_state()
+        rec.job.note_state_lost()
+        epoch_after_loss = rec.job.state_epoch
+        mgr._after_state_loss(rec)
+        assert rec.job.state_epoch == epoch_after_loss, (
+            "re-seed must not regress the epoch"
+        )
+        assert "re-seeded from last checkpoint" in rec.warning
+        out = run_window(mgr, windows, 3)
+        (result,) = out
+        cumulative = np.asarray(
+            result.outputs["image_cumulative"].data.numpy
+        )
+        # Re-seeded from the 3-window checkpoint + this window: 4
+        # windows of counts, not 1.
+        assert cumulative.sum() == 4 * 4096
+
+    def test_reseed_refuses_pre_reset_checkpoint(self, tmp_path, plane):
+        """A run-boundary reset between the checkpoint and a state
+        loss must NOT let the re-seed resurrect pre-reset (old-run)
+        arrays — the plane's cached restore view invalidates on
+        note_reset and the marker gates whatever is cached."""
+        windows = make_windows(4, seed=41)
+        mgr = make_manager(durability=plane, detector_jobs=1,
+                           monitor_jobs=0)
+        for w in range(2):
+            run_window(mgr, windows, w)
+        _checkpoint_after(mgr, plane, 1)
+        # Run boundary: marker persists, accumulation resets.
+        plane.note_reset(1)
+        rec = next(iter(mgr._records.values()))
+        rec.job.clear()
+        # State loss BEFORE the next (post-reset) checkpoint: the only
+        # available generation is pre-reset and must be refused.
+        wf = rec.job.workflow
+        wf._state = wf.histogrammer.init_state()
+        rec.job.note_state_lost()
+        mgr._after_state_loss(rec)
+        assert "re-seeded" not in rec.warning
+        out = run_window(mgr, windows, 2)
+        (result,) = out
+        cumulative = np.asarray(
+            result.outputs["image_cumulative"].data.numpy
+        )
+        assert cumulative.sum() == 4096  # new run: this window only

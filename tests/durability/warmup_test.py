@@ -1,0 +1,160 @@
+"""AOT warm-up contract (ADR 0118): commit-time hot-path compiles are
+zero with warm-up on, and a warmed tick program is byte-identical to a
+cold-compiled one."""
+
+from __future__ import annotations
+
+import uuid
+
+import pytest
+
+from esslivedata_tpu.config import JobId, WorkflowConfig
+from esslivedata_tpu.durability import CompileWarmupService, WarmupRequest
+from esslivedata_tpu.telemetry import COMPILE_EVENTS
+
+from durability_helpers import make_manager, make_windows, run_window, wire_of
+
+
+@pytest.fixture
+def warmup():
+    service = CompileWarmupService()
+    yield service
+    service.close()
+
+
+def _commit_extra_detector_job(mgr, number: int = 99) -> None:
+    dv_id = next(
+        iter(
+            rec.job.workflow_id
+            for rec in mgr._records.values()
+            if rec.job.job_id.source_name == "det0"
+        )
+    )
+    mgr.schedule_job(
+        WorkflowConfig(
+            identifier=dv_id,
+            job_id=JobId(
+                source_name="det0", job_number=uuid.UUID(int=number)
+            ),
+        )
+    )
+
+
+class TestCommitTimeCompiles:
+    def test_commit_is_zero_compiles_with_warmup(self, warmup):
+        windows = make_windows(8)
+        mgr = make_manager()
+        mgr.set_warmup(warmup)
+        for w in range(3):
+            run_window(mgr, windows, w)
+        _commit_extra_detector_job(mgr)
+        assert warmup.quiesce(60), "warm-up never drained"
+        before = COMPILE_EVENTS.total()
+        out = run_window(mgr, windows, 3)
+        assert len(out) == 4  # 3 detector jobs + 1 monitor
+        out = run_window(mgr, windows, 4)
+        assert len(out) == 4
+        assert COMPILE_EVENTS.total() - before == 0, (
+            "commit-time compile leaked onto the hot path"
+        )
+
+    def test_commit_compiles_without_warmup(self):
+        """The control: the exact same commit WITHOUT warm-up pays at
+        least one hot-path compile — proving the zero above is the
+        warm-up working, not the instrument sleeping."""
+        windows = make_windows(8)
+        mgr = make_manager()
+        for w in range(3):
+            run_window(mgr, windows, w)
+        _commit_extra_detector_job(mgr)
+        before = COMPILE_EVENTS.total()
+        run_window(mgr, windows, 3)
+        assert COMPILE_EVENTS.total() - before >= 1
+
+    def test_removal_regroup_warms_survivors(self, warmup):
+        from esslivedata_tpu.core.job_manager import JobCommand
+
+        windows = make_windows(8)
+        mgr = make_manager(detector_jobs=3)
+        mgr.set_warmup(warmup)
+        for w in range(3):
+            run_window(mgr, windows, w)
+        mgr.handle_command(
+            JobCommand(
+                action="remove",
+                source_name="det0",
+                job_number=uuid.UUID(int=0),
+            )
+        )
+        assert warmup.quiesce(60)
+        before = COMPILE_EVENTS.total()
+        out = run_window(mgr, windows, 3)
+        assert len(out) == 3  # 2 surviving detectors + monitor
+        assert COMPILE_EVENTS.total() - before == 0
+
+
+class TestWarmedParity:
+    def test_warmed_tick_byte_identical_to_cold(self, warmup):
+        """The warmed executable must not change a single da00 byte vs
+        the cold-compiled program — AOT lowering is a latency move,
+        never a semantics one."""
+        windows = make_windows(10, seed=21)
+        cold = make_manager()
+        warm = make_manager()
+        warm.set_warmup(warmup)
+        for w in range(3):
+            run_window(cold, windows, w)
+            run_window(warm, windows, w)
+        _commit_extra_detector_job(cold)
+        _commit_extra_detector_job(warm)
+        assert warmup.quiesce(60)
+        for w in range(3, 8):
+            assert wire_of(run_window(cold, windows, w)) == wire_of(
+                run_window(warm, windows, w)
+            ), f"window {w}: warmed wire != cold wire"
+
+
+class TestContainment:
+    def test_failed_warmup_is_counted_and_live_path_survives(self, warmup):
+        class BrokenCombiner:
+            def warm(self, *args, **kwargs):
+                raise RuntimeError("boom")
+
+        class BrokenHist:
+            def tick_staging(self, *args, **kwargs):
+                raise RuntimeError("staging boom")
+
+        failures = _counter_total(
+            "livedata_durability_warmup_failures_total"
+        )
+        warmup.submit(
+            [
+                WarmupRequest(
+                    combiner=BrokenCombiner(),
+                    hist=BrokenHist(),
+                    group_key=("k",),
+                    batch=None,
+                    batch_tag="",
+                    device=None,
+                    members=[],
+                    trigger="commit",
+                )
+            ]
+        )
+        assert warmup.quiesce(30)
+        assert (
+            _counter_total("livedata_durability_warmup_failures_total")
+            > failures
+        )
+        # And the live path still works end-to-end after the failure.
+        windows = make_windows(3)
+        mgr = make_manager(detector_jobs=1, monitor_jobs=0)
+        mgr.set_warmup(warmup)
+        assert len(run_window(mgr, windows, 0)) == 1
+
+
+def _counter_total(name: str) -> float:
+    from esslivedata_tpu.telemetry import REGISTRY
+
+    snap = REGISTRY.snapshot()
+    return sum(snap.get(name, {}).values())

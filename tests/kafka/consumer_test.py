@@ -54,6 +54,34 @@ class TestAssignment:
         topics = {tp.topic for tp in consumer.assigned}
         assert topics == {"a_detector", "a_motion"}
 
+    def test_bookmarked_topic_seeks_others_pin_high(self) -> None:
+        consumer = FakeConsumer({"a_detector": 2, "a_motion": 1}, high=99)
+        assign_all_partitions(
+            consumer,
+            ["a_detector", "a_motion"],
+            start_offsets={"a_detector": 17},
+        )
+        by_topic = {}
+        for tp in consumer.assigned:
+            by_topic.setdefault(tp.topic, set()).add(tp.offset)
+        assert by_topic["a_detector"] == {17}
+        assert by_topic["a_motion"] == {99}
+
+    def test_bookmark_clamped_to_retained_range(self) -> None:
+        # Above high (topic truncated since the checkpoint) -> live;
+        # the FakeConsumer's low watermark is 0, so a negative bookmark
+        # clamps up to it.
+        consumer = FakeConsumer({"a_detector": 1}, high=50)
+        assign_all_partitions(
+            consumer, ["a_detector"], start_offsets={"a_detector": 777}
+        )
+        assert consumer.assigned[0].offset == 50
+        consumer = FakeConsumer({"a_detector": 1}, high=50)
+        assign_all_partitions(
+            consumer, ["a_detector"], start_offsets={"a_detector": -3}
+        )
+        assert consumer.assigned[0].offset == 0
+
     def test_missing_topic_fails_loudly(self) -> None:
         consumer = FakeConsumer({"a_detector": 1})
         with pytest.raises(ValueError, match="a_typo"):

@@ -271,6 +271,32 @@ class Hub:
             for sub in self._subscribers.values():
                 sub.send(frame)
 ''',
+    # Both shapes of the persistence hazard, in a module the atomic
+    # writer already marks as persistence-scoped: a second writer that
+    # skips the discipline entirely (direct final-path write), and one
+    # that renames but never fsyncs.
+    "JGL020": '''
+import os
+import numpy as np
+
+def save_manifest(path, blob):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+def save_state(path, arr):
+    with open(path, "wb") as f:
+        np.save(f, arr)
+
+def save_marker(path, blob):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+''',
 }
 
 NEGATIVE = {
@@ -612,6 +638,33 @@ class Hub:
         with self._lock:
             frames, self._pending_frames = self._pending_frames, []
         return frames
+''',
+    # The worked persistence pattern: every writer routes through one
+    # atomic helper (tmp + fsync + replace); readers and in-memory
+    # writes never fire; a tempfile scratch write in a NON-persistence
+    # module (no rename/fsync anywhere, neutral filename) is out of
+    # scope entirely.
+    "JGL020": '''
+import io
+import os
+import numpy as np
+
+def atomic_write(path, payload):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+def save_state(path, arr):
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    atomic_write(path, buf.getvalue())
+
+def load_state(path):
+    with open(path, "rb") as f:
+        return np.load(f)
 ''',
 }
 # fmt: on

@@ -10,6 +10,8 @@ the justification next to it.
 from __future__ import annotations
 
 import ast
+import re
+from pathlib import Path
 
 from ..context import FileContext
 from ..findings import Finding
@@ -52,3 +54,160 @@ def silent_broad_except(ctx: FileContext):
                 "running while data silently stops; log the exception "
                 "(logger.debug at minimum) or narrow the type",
             )
+
+
+# -- JGL020: non-atomic persistence writes --------------------------------
+
+#: Module names that read as durable-state persistence: these modules'
+#: writes are recovery-critical by construction.
+_PERSISTENCE_MODULE = re.compile(
+    r"snapshot|checkpoint|manifest|durab|persist|bookmark", re.IGNORECASE
+)
+#: Write APIs whose output is a durable artifact when it lands on a
+#: final path: numpy dumps and pickles.
+_DUMP_ATTRS = frozenset({"save", "savez", "savez_compressed", "dump"})
+_DUMP_RECEIVERS = frozenset({"np", "numpy", "pickle"})
+#: Rename-into-place calls (the atomic half of the discipline).
+_RENAME_ATTRS = frozenset({"replace", "rename", "renames"})
+
+
+def _open_write_mode(call: ast.Call) -> bool:
+    """True for ``open(path, mode)`` with a literal write mode."""
+    if not (isinstance(call.func, ast.Name) and call.func.id == "open"):
+        return False
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return False  # default 'r': a read
+    if not (isinstance(mode, ast.Constant) and isinstance(mode.value, str)):
+        return False  # dynamic mode: can't judge, stay quiet
+    return any(ch in mode.value for ch in "wax+")
+
+
+@rule(
+    "JGL020",
+    "durable write without the write-tmp/fsync/rename discipline",
+)
+def non_atomic_persistence_write(ctx: FileContext):
+    """Scope: persistence modules — the filename reads as one
+    (snapshot/checkpoint/manifest/durability/persist/bookmark), or the
+    module already performs atomic renames/fsyncs somewhere (evidence
+    it persists durable state, so EVERY writer in it is held to the
+    discipline; the classic regression is a second writer added later
+    that skips it).
+
+    Within scope, a function that writes durable bytes —
+    ``open(path, "w"/"wb"/"a"/"x")``, ``np.save``/``np.savez*``,
+    ``pickle.dump`` — must follow ADR 0107/0118's crash discipline:
+
+    - **rename into place** (``os.replace``/``os.rename``/
+      ``Path.rename``): a crash mid-write must leave the previous
+      file whole, never a torn one a restart then restores;
+    - **fsync before the rename** (``os.fsync``): on a crash the
+      rename may be durable before the data it names — the manifest
+      then points at garbage that passes ``exists()``.
+
+    The checks are per function, so a module that factors the
+    discipline into one ``atomic_write`` helper (the recommended
+    shape) is clean: writers call the helper and contain no raw write;
+    only the helper opens/fsyncs/renames. In-memory writes (BytesIO)
+    and reads never fire.
+    """
+    in_scope = bool(_PERSISTENCE_MODULE.search(Path(ctx.path).stem))
+    if not in_scope:
+        for node in ctx.nodes(ast.Call):
+            qual = ctx.qualname(node.func)
+            if qual in ("os.replace", "os.rename", "os.fsync"):
+                in_scope = True
+                break
+    if not in_scope:
+        return
+    for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+        # Nested defs get their own entry in ctx.nodes: exclude their
+        # bodies here so a write is attributed to exactly the function
+        # whose rename/fsync context governs it.
+        nested: set[int] = set()
+        for sub in ast.walk(fn):
+            if (
+                isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and sub is not fn
+            ):
+                nested.update(id(n) for n in ast.walk(sub))
+        # In-memory buffers (BytesIO/StringIO) are not durable targets:
+        # a dump into one is the RECOMMENDED shape (serialize in
+        # memory, persist via the atomic helper).
+        buffers: set[str] = set()
+        for node in ast.walk(fn):
+            if id(node) in nested or not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            if isinstance(value, ast.Call) and ctx.qualname(
+                value.func
+            ) in ("io.BytesIO", "BytesIO", "io.StringIO", "StringIO"):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        buffers.add(target.id)
+        writes: list[ast.Call] = []
+        has_rename = has_fsync = False
+        for node in ast.walk(fn):
+            if id(node) in nested or not isinstance(node, ast.Call):
+                continue
+            # Attribute calls: np.save / pickle.dump / x.rename / os.*
+            if isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                qual = ctx.qualname(node.func)
+                if qual == "os.fsync":
+                    has_fsync = True
+                elif qual in ("os.replace", "os.rename", "os.renames"):
+                    has_rename = True
+                elif attr in _RENAME_ATTRS and not (
+                    isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "os"
+                ):
+                    # tmp.rename(final) — Path-style receiver
+                    has_rename = True
+                elif (
+                    attr in _DUMP_ATTRS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in _DUMP_RECEIVERS
+                    and not any(
+                        isinstance(a, ast.Name) and a.id in buffers
+                        for a in node.args
+                    )
+                ):
+                    # (file target position differs by API — np.save's
+                    # arg 0 vs pickle.dump's arg 1 — so any buffer-name
+                    # argument exempts the call)
+                    writes.append(node)
+            elif _open_write_mode(node):
+                writes.append(node)
+        if not writes:
+            continue
+        if not has_rename:
+            for call in writes:
+                yield Finding(
+                    ctx.path,
+                    call.lineno,
+                    "JGL020",
+                    f"durable write in '{fn.name}' lands on its final "
+                    "path directly: a crash mid-write leaves a torn "
+                    "file a restart will trust — write a tmp sibling, "
+                    "fsync, then os.replace into place (or route "
+                    "through the module's atomic-write helper)",
+                )
+        elif not has_fsync:
+            for call in writes:
+                yield Finding(
+                    ctx.path,
+                    call.lineno,
+                    "JGL020",
+                    f"'{fn.name}' renames into place without fsync: "
+                    "the rename can become durable before the data it "
+                    "names, so a crash leaves the final path pointing "
+                    "at garbage — os.fsync the file (and ideally the "
+                    "directory) before os.replace",
+                )
