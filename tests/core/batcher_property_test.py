@@ -7,6 +7,10 @@ The scenario suites check dynamics; these properties check the
 bookkeeping that everything else stands on.
 """
 
+import pytest
+
+pytest.importorskip("hypothesis")  # absent on some CI containers
+
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

@@ -4,6 +4,8 @@ constructors, the closure table of arithmetic types, type-safety raises,
 comparisons/hash, and pulse-grid exactness at large indices."""
 
 import pytest
+pytest.importorskip("hypothesis")  # absent on some CI containers
+
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

@@ -10,6 +10,8 @@ crash the process or return mis-sized arrays.
 
 import numpy as np
 import pytest
+pytest.importorskip("hypothesis")  # absent on some CI containers
+
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

@@ -7,6 +7,10 @@ vanadium acceptance invariants.
 """
 
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")  # absent on some CI containers
+
 from hypothesis import given, settings
 from hypothesis import strategies as st
 from hypothesis.extra.numpy import (

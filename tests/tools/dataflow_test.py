@@ -1,0 +1,456 @@
+"""Dataflow engine contract tests (tools/graftlint/dataflow.py),
+independent of any lint rule: CFG shape on the compound-statement zoo,
+reaching definitions across rebinding, and lock-region facts under
+``with`` nesting and RLock acquire/release pairing — so rule authors
+can trust the engine without re-deriving it from rule fixtures."""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.dataflow import (
+    CFG,
+    build_cfg,
+    lock_regions,
+    paths_avoiding,
+    reaching_definitions,
+    walk_own,
+)
+
+
+def _fn(src: str) -> ast.FunctionDef:
+    return ast.parse(src).body[0]
+
+
+def _node(cfg: CFG, needle: str) -> int:
+    """CFG node of the unique SIMPLE statement whose source contains
+    ``needle`` (compound heads excluded — their unparse spans bodies)."""
+    hits = [
+        n
+        for n, s in cfg.statements()
+        if not isinstance(
+            s,
+            (ast.If, ast.While, ast.For, ast.Try, ast.With,
+             ast.ExceptHandler),
+        )
+        and needle in ast.unparse(s)
+    ]
+    assert len(hits) == 1, (needle, hits)
+    return hits[0]
+
+
+def _stmt_text(cfg: CFG, node: int) -> str:
+    stmt = cfg.stmt_of[node]
+    if isinstance(stmt, ast.ExceptHandler):
+        return "except"
+    return ast.unparse(stmt).split("\n")[0]
+
+
+# -- CFG shape --------------------------------------------------------------
+
+
+def test_if_without_else_falls_through():
+    cfg = build_cfg(_fn("""
+def f(c):
+    if c:
+        a()
+    b()
+"""))
+    head = next(
+        n for n, s in cfg.statements() if isinstance(s, ast.If)
+    )
+    b = _node(cfg, "b()")
+    a = _node(cfg, "a()")
+    assert set(cfg.succ[head]) == {a, b}  # taken arm + fall-through
+    assert set(cfg.succ[a]) == {b}
+
+
+def test_try_except_finally_shape():
+    cfg = build_cfg(_fn("""
+def f():
+    try:
+        risky()
+    except ValueError:
+        handled()
+    finally:
+        cleanup()
+    after()
+"""))
+    risky = _node(cfg, "risky()")
+    handled = _node(cfg, "handled()")
+    cleanup = _node(cfg, "cleanup()")
+    after = _node(cfg, "after()")
+    handler = next(
+        n for n, s in cfg.statements()
+        if isinstance(s, ast.ExceptHandler)
+    )
+    # The try body may raise into the handler; both the normal and the
+    # handled path funnel through finally before continuing.
+    assert handler in cfg.succ[risky]
+    assert cleanup in cfg.succ[risky]
+    assert set(cfg.succ[handled]) == {cleanup}
+    assert set(cfg.succ[cleanup]) == {after}
+
+
+def test_while_else_runs_only_on_normal_exhaustion():
+    cfg = build_cfg(_fn("""
+def f(xs):
+    while xs:
+        if bad(xs):
+            break
+        step(xs)
+    else:
+        exhausted()
+    after()
+"""))
+    head = next(
+        n for n, s in cfg.statements() if isinstance(s, ast.While)
+    )
+    brk = next(
+        n for n, s in cfg.statements() if isinstance(s, ast.Break)
+    )
+    exhausted = _node(cfg, "exhausted()")
+    after = _node(cfg, "after()")
+    # else: reached from the loop head only; break jumps past it.
+    assert exhausted in cfg.succ[head]
+    assert set(cfg.succ[brk]) == {after}
+    assert set(cfg.succ[exhausted]) == {after}
+    # no edge break -> else
+    assert exhausted not in cfg.succ[brk]
+
+
+def test_continue_targets_loop_head():
+    cfg = build_cfg(_fn("""
+def f(xs):
+    for x in xs:
+        if skip(x):
+            continue
+        use(x)
+"""))
+    head = next(
+        n for n, s in cfg.statements() if isinstance(s, ast.For)
+    )
+    cont = next(
+        n for n, s in cfg.statements() if isinstance(s, ast.Continue)
+    )
+    assert set(cfg.succ[cont]) == {head}
+
+
+def test_return_and_raise_terminate_paths():
+    cfg = build_cfg(_fn("""
+def f(c):
+    if c:
+        return 1
+    raise ValueError("no")
+"""))
+    ret = next(
+        n for n, s in cfg.statements() if isinstance(s, ast.Return)
+    )
+    rse = next(
+        n for n, s in cfg.statements() if isinstance(s, ast.Raise)
+    )
+    assert set(cfg.succ[ret]) == {CFG.EXIT}
+    assert set(cfg.succ[rse]) == {CFG.EXIT}
+
+
+def test_raise_inside_try_routes_to_handler_not_exit():
+    cfg = build_cfg(_fn("""
+def f():
+    try:
+        raise ValueError("no")
+    except Exception:
+        handled()
+"""))
+    rse = next(
+        n for n, s in cfg.statements() if isinstance(s, ast.Raise)
+    )
+    handler = next(
+        n for n, s in cfg.statements()
+        if isinstance(s, ast.ExceptHandler)
+    )
+    assert set(cfg.succ[rse]) == {handler}
+
+
+def test_with_body_follows_head():
+    cfg = build_cfg(_fn("""
+def f(lock):
+    with lock:
+        inside()
+    after()
+"""))
+    head = next(
+        n for n, s in cfg.statements() if isinstance(s, ast.With)
+    )
+    inside = _node(cfg, "inside()")
+    after = _node(cfg, "after()")
+    assert set(cfg.succ[head]) == {inside}
+    assert set(cfg.succ[inside]) == {after}
+
+
+def test_walk_own_does_not_leak_nested_bodies():
+    stmt = ast.parse("""
+if c:
+    hidden_call()
+""").body[0]
+    names = [
+        s.id for s in walk_own(stmt) if isinstance(s, ast.Name)
+    ]
+    assert names == ["c"]  # the test only, never the body
+
+
+# -- reaching definitions ---------------------------------------------------
+
+
+def test_rebinding_kills_prior_defs_per_path():
+    fn = _fn("""
+def f(c):
+    x = 1
+    if c:
+        x = 2
+    use(x)
+""")
+    cfg = build_cfg(fn)
+    rd = reaching_definitions(cfg, fn)
+    use = _node(cfg, "use(x)")
+    x_defs = {d for (name, d) in rd[use] if name == "x"}
+    # Both the initial and the rebound definition reach the use (one
+    # per path); the parameter binding of ``c`` also survives.
+    assert len(x_defs) == 2
+    assert ("c", CFG.ENTRY) in rd[use]
+
+
+def test_straight_line_rebinding_leaves_one_def():
+    fn = _fn("""
+def f():
+    x = 1
+    x = 2
+    use(x)
+""")
+    cfg = build_cfg(fn)
+    rd = reaching_definitions(cfg, fn)
+    use = _node(cfg, "use(x)")
+    x_defs = {d for (name, d) in rd[use] if name == "x"}
+    assert len(x_defs) == 1
+    assert cfg.stmt_of[next(iter(x_defs))].value.value == 2
+
+
+def test_loop_target_and_with_as_bind():
+    fn = _fn("""
+def f(xs, cm):
+    for x in xs:
+        use(x)
+    with cm as handle:
+        use2(handle)
+""")
+    cfg = build_cfg(fn)
+    rd = reaching_definitions(cfg, fn)
+    use = _node(cfg, "use(x)")
+    use2 = _node(cfg, "use2(handle)")
+    assert any(name == "x" for name, _ in rd[use])
+    assert any(name == "handle" for name, _ in rd[use2])
+
+
+# -- lock regions -----------------------------------------------------------
+
+
+def _lockish(expr: ast.AST) -> bool:
+    return any(
+        ("lock" in getattr(s, "attr", "").lower())
+        or ("lock" in getattr(s, "id", "").lower())
+        for s in ast.walk(expr)
+    )
+
+
+def _lock_id(expr: ast.AST) -> str:
+    return ast.unparse(expr)
+
+
+def _held(src: str) -> dict[str, frozenset[str]]:
+    fn = _fn(src)
+    cfg = build_cfg(fn)
+    held = lock_regions(fn, cfg, _lock_id, _lockish)
+    return {
+        _stmt_text(cfg, n): ids
+        for n, ids in held.items()
+        if not isinstance(
+            cfg.stmt_of[n],
+            (ast.With, ast.Try, ast.ExceptHandler),
+        )
+    }
+
+
+def test_with_region_is_exact():
+    held = _held("""
+def f(self):
+    before()
+    with self._lock:
+        inside()
+    after()
+""")
+    assert held["before()"] == frozenset()
+    assert held["inside()"] == {"self._lock"}
+    assert held["after()"] == frozenset()
+
+
+def test_nested_with_accumulates():
+    held = _held("""
+def f(self, other):
+    with self._lock:
+        with other.lock:
+            both()
+        one()
+""")
+    assert held["both()"] == {"self._lock", "other.lock"}
+    assert held["one()"] == {"self._lock"}
+
+
+def test_rlock_reacquire_needs_matching_releases():
+    held = _held("""
+def f(self):
+    self._rlock.acquire()
+    self._rlock.acquire()
+    twice()
+    self._rlock.release()
+    once()
+    self._rlock.release()
+    free()
+""")
+    assert held["twice()"] == {"self._rlock"}
+    assert held["once()"] == {"self._rlock"}  # count 2-1 = still held
+    assert held["free()"] == frozenset()
+
+
+def test_acquire_release_with_try_finally():
+    held = _held("""
+def f(self):
+    self._lock.acquire()
+    try:
+        work()
+    finally:
+        self._lock.release()
+    after()
+""")
+    assert held["work()"] == {"self._lock"}
+    assert held["after()"] == frozenset()
+
+
+def test_branch_held_is_must_not_may():
+    # Held on one arm only: the join must NOT claim the lock is held.
+    held = _held("""
+def f(self, c):
+    if c:
+        self._lock.acquire()
+        locked()
+        self._lock.release()
+    joined()
+""")
+    assert held["locked()"] == {"self._lock"}
+    assert held["joined()"] == frozenset()
+
+
+# -- path queries -----------------------------------------------------------
+
+
+def test_paths_avoiding_blocked_by_mandatory_node():
+    fn = _fn("""
+def f(c):
+    reset()
+    note()
+    return 1
+""")
+    cfg = build_cfg(fn)
+    reset = _node(cfg, "reset()")
+    note = _node(cfg, "note()")
+    assert not paths_avoiding(cfg, reset, {note}, {CFG.EXIT})
+
+
+def test_paths_avoiding_finds_the_bypass_branch():
+    fn = _fn("""
+def f(c):
+    reset()
+    if c:
+        note()
+    return 1
+""")
+    cfg = build_cfg(fn)
+    reset = _node(cfg, "reset()")
+    note = _node(cfg, "note()")
+    assert paths_avoiding(cfg, reset, {note}, {CFG.EXIT})
+
+
+def test_lock_regions_ignore_closure_bodies():
+    # Regression (review): an acquire() inside a nested worker closure
+    # runs on the worker thread, not at the def statement — it must
+    # not mark the enclosing function's statements as lock-held.
+    held = _held("""
+def f(self, g):
+    def worker():
+        self._lock.acquire()
+        self._lock.release()
+    spawn(worker)
+    outside()
+""")
+    assert held["spawn(worker)"] == frozenset()
+    assert held["outside()"] == frozenset()
+
+
+def test_return_threads_through_finally():
+    # Regression (review): Python always runs the finally on the way
+    # out — a return edge that skipped it would let path queries claim
+    # a finally-guaranteed statement can be bypassed.
+    fn = _fn("""
+def f(self):
+    try:
+        reset()
+        return 1
+    finally:
+        note()
+""")
+    cfg = build_cfg(fn)
+    reset = _node(cfg, "reset()")
+    notes = {
+        n for n, s in cfg.statements()
+        if isinstance(s, ast.Expr) and "note" in ast.unparse(s)
+    }
+    assert not paths_avoiding(cfg, reset, notes, {CFG.EXIT})
+
+
+def test_raise_in_try_finally_without_handlers_reaches_exit():
+    # Regression (review): a try with ONLY a finally pushes an empty
+    # handler list; the raise must still have an exceptional path —
+    # through the finally copy — to EXIT, not vanish from the CFG.
+    fn = _fn("""
+def f(self):
+    try:
+        reset()
+        raise RuntimeError("x")
+    finally:
+        log()
+""")
+    cfg = build_cfg(fn)
+    reset = _node(cfg, "reset()")
+    assert paths_avoiding(cfg, reset, set(), {CFG.EXIT})
+
+
+def test_break_threads_through_loop_finally_only():
+    fn = _fn("""
+def f(xs):
+    outer_try()
+    for x in xs:
+        try:
+            if bad(x):
+                break
+        finally:
+            inner_note()
+    after()
+""")
+    cfg = build_cfg(fn)
+    brk = next(
+        n for n, s in cfg.statements() if isinstance(s, ast.Break)
+    )
+    # break runs the loop's finally, then jumps past the loop.
+    succ_texts = {
+        ast.unparse(cfg.stmt_of[s]).split("\n")[0]
+        for s in cfg.succ[brk]
+    }
+    assert succ_texts == {"inner_note()"}

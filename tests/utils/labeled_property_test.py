@@ -6,6 +6,8 @@ histograms with different bin edges is scientifically wrong)."""
 
 import numpy as np
 import pytest
+pytest.importorskip("hypothesis")  # absent on some CI containers
+
 from hypothesis import assume, given, settings
 from hypothesis import strategies as st
 

@@ -1,12 +1,15 @@
 """graftlint — JAX-hazard and concurrency static analysis for the
 streaming hot path (docs/graftlint.md).
 
-Two passes share one run: the per-file lexical rules (JGL001–JGL010)
-and the whole-program pass (JGL011+ — project symbol table, call graph,
-thread roles; see ``project.py`` / docs/adr/0112). Every analyzed file
-contributes picklable ``FileFacts`` to the project pass, so ``jobs > 1``
-fans the parse+file-rules work across processes and only facts travel
-back.
+Three passes share one run: the per-file rules (JGL001–JGL010 lexical;
+JGL015–JGL022, the latter two dataflow-based on per-function CFGs —
+``dataflow.py`` / docs/adr/0119), the whole-program pass (JGL011–JGL014,
+JGL023 — project symbol table, call graph, thread roles, blocking
+summaries; see ``project.py`` / docs/adr/0112), and the meta pass
+(JGL024 — the stale-suppression audit over the run's own
+pre-suppression findings). Every analyzed file contributes picklable
+``FileFacts`` to the project pass, so ``jobs > 1`` fans the
+parse+file-rules work across processes and only facts travel back.
 
 Programmatic API::
 
@@ -62,6 +65,33 @@ def _project_findings(
     return sorted(findings)
 
 
+def _meta_findings(
+    findings: list[Finding],
+    suppressions: dict[str, Suppressions],
+    select: frozenset[str] | None,
+) -> list[Finding]:
+    """The run-level pass (JGL024 stale-suppression audit): sees every
+    PRE-suppression finding per file next to that file's directives —
+    a directive is live exactly when it masks something this run
+    found."""
+    metas = [
+        rule
+        for rule_id, rule in RULES.items()
+        if rule.scope == "meta"
+        and (select is None or rule_id in select)
+    ]
+    if not metas:
+        return []
+    by_path: dict[str, list[Finding]] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    out: list[Finding] = []
+    for path, sup in suppressions.items():
+        for rule in metas:
+            out.extend(rule.check(path, sup, by_path.get(path, []), select))
+    return out
+
+
 def _filter_by_file(
     findings: list[Finding], suppressions: dict[str, Suppressions]
 ) -> list[Finding]:
@@ -101,6 +131,7 @@ def run_project_sources(
     all_findings = sorted(findings) + _project_findings(
         ProjectContext(facts), select
     )
+    all_findings += _meta_findings(all_findings, suppressions, select)
     return sorted(set(_filter_by_file(all_findings, suppressions)))
 
 
@@ -149,12 +180,20 @@ def run_paths(
     *,
     select: frozenset[str] | None = None,
     jobs: int = 1,
+    audit: bool = True,
 ) -> tuple[list[Finding], list[str]]:
     """Lint files/trees; returns (findings, path/parse errors).
 
     The whole-program pass sees exactly the files given: a full-tree run
     gets full cross-module precision, a changed-files run (pre-commit)
     gets a partial view — sound for what it sees, CI closes the gap.
+
+    ``audit=False`` skips the meta pass (JGL024). The partial-view
+    argument INVERTS for the suppression audit: a project rule that
+    cannot fire for lack of cross-file facts makes its suppressions
+    look stale, so missing findings would CREATE findings and fail the
+    gate on unrelated commits. Diff-mode callers disable the audit;
+    the full-tree run judges the ledger.
     """
     findings: list[Finding] = []
     errors: list[str] = []
@@ -185,4 +224,6 @@ def run_paths(
         facts.append(file_facts)
         suppressions[path] = sup
     findings.extend(_project_findings(ProjectContext(facts), select))
+    if audit:
+        findings.extend(_meta_findings(findings, suppressions, select))
     return sorted(set(_filter_by_file(findings, suppressions))), errors

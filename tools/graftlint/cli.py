@@ -4,11 +4,76 @@ from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
+from pathlib import Path
 
-from . import RULES, run_paths
+from . import RULES, iter_python_files, run_paths
 from .baseline import apply_baseline, load_baseline, write_baseline
 from .sarif import write_sarif
+
+
+def changed_python_files(
+    paths: list[str], base: str, *, untracked: bool = True
+) -> list[str]:
+    """The subset of ``paths`` (expanded to .py files) that differ from
+    git ref ``base`` — committed, staged, unstaged and (by default)
+    untracked, so an interactive run sees exactly the work in flight.
+    Pre-commit passes ``untracked=False``: it stashes unstaged tracked
+    work before the hook, so the diff vs HEAD is exactly the staged
+    change — but untracked scratch files are NOT stashed and are not
+    part of the commit, and a finding in one must not block unrelated
+    commits.
+
+    Raises ``RuntimeError`` on git failures (not a repo, unknown ref):
+    a diff mode that silently linted nothing would turn the gate into
+    a permanent green no-op, the same failure class the bad-path check
+    guards against.
+    """
+
+    def git(*args: str) -> str:
+        proc = subprocess.run(
+            ["git", *args],
+            capture_output=True,
+            text=True,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git {' '.join(args)} failed: "
+                f"{proc.stderr.strip() or proc.stdout.strip()}"
+            )
+        return proc.stdout
+
+    for raw in paths:
+        if not Path(raw).exists():
+            # Same contract as the non-diff path check: a typo'd path
+            # must fail the gate, not become "no files changed".
+            raise RuntimeError(f"{raw}: no such file or directory")
+    top = Path(git("rev-parse", "--show-toplevel").strip())
+    listings = [git("diff", "--name-only", "-z", base, "--")]
+    if untracked:
+        # --full-name: ls-files prints cwd-relative paths from a
+        # subdirectory, while diff --name-only is always root-relative;
+        # without it the comparison below silently drops every
+        # untracked file on subdirectory runs.
+        listings.append(
+            git(
+                "ls-files", "--others", "--exclude-standard",
+                "--full-name", "-z",
+            )
+        )
+    changed: set[str] = set()
+    for out in listings:
+        changed.update(p for p in out.split("\0") if p)
+    out = []
+    for f in iter_python_files(paths):
+        try:
+            rel = Path(f).resolve().relative_to(top)
+        except ValueError:
+            continue  # outside the repo: never "changed vs a ref"
+        if str(rel) in changed:
+            out.append(str(f))
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -62,6 +127,33 @@ def main(argv: list[str] | None = None) -> int:
         ),
     )
     parser.add_argument(
+        "--diff",
+        default=None,
+        metavar="BASE",
+        help=(
+            "lint only files (within the given paths) changed vs git "
+            "ref BASE — committed, staged, unstaged and untracked; the "
+            "whole-program pass sees just those files (partial project "
+            "view: sound for what it sees, CI's full run closes the "
+            "gap)"
+        ),
+    )
+    parser.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="shorthand for --diff HEAD (the pre-commit fast path)",
+    )
+    parser.add_argument(
+        "--no-untracked",
+        action="store_true",
+        help=(
+            "with --diff/--changed-only: ignore untracked files "
+            "(pre-commit stashes unstaged tracked work, so the diff "
+            "vs HEAD is exactly the staged change; untracked scratch "
+            "files are not part of the commit)"
+        ),
+    )
+    parser.add_argument(
         "--list-rules", action="store_true", help="print rule ids and exit"
     )
     parser.add_argument(
@@ -85,7 +177,39 @@ def main(argv: list[str] | None = None) -> int:
         parser.error(f"unknown rule ids: {sorted(unknown)}")
     jobs = args.jobs if args.jobs > 0 else (os.cpu_count() or 1)
 
-    findings, errors = run_paths(args.paths, select=select, jobs=jobs)
+    lint_paths = args.paths
+    if args.changed_only and args.diff is None:
+        args.diff = "HEAD"
+    if args.diff is not None:
+        if args.write_baseline:
+            parser.error(
+                "--write-baseline needs the full tree, not a diff "
+                "(a partial snapshot would mask findings elsewhere)"
+            )
+        try:
+            lint_paths = changed_python_files(
+                args.paths, args.diff, untracked=not args.no_untracked
+            )
+        except RuntimeError as exc:
+            print(f"graftlint: {exc}", file=sys.stderr)
+            return 1
+        if not lint_paths:
+            if not args.quiet:
+                print(
+                    f"graftlint: no files changed vs {args.diff}; "
+                    "nothing to lint"
+                )
+            if args.sarif:
+                write_sarif(args.sarif, [], [])
+            return 0
+
+    # The stale-suppression audit (JGL024) only runs on full views: in
+    # diff mode, project rules starved of cross-file facts would make
+    # live suppressions look stale — missing findings would CREATE
+    # findings and block unrelated commits.
+    findings, errors = run_paths(
+        lint_paths, select=select, jobs=jobs, audit=args.diff is None
+    )
 
     if args.write_baseline:
         # Parse/path errors abort BEFORE writing: a snapshot taken over
@@ -117,6 +241,13 @@ def main(argv: list[str] | None = None) -> int:
             print(f"graftlint: bad baseline: {exc}", file=sys.stderr)
             return 1
         findings, stale = apply_baseline(findings, baseline)
+        if args.diff is not None:
+            # Same inverted-soundness trap as the JGL024 audit: a
+            # diff-mode run only sees changed files, so entries for
+            # unchanged files look unmatched. "Prune" advice here
+            # would resurrect the finding in the full-tree run —
+            # staleness is only judgeable on full views.
+            stale = []
 
     if args.sarif:
         write_sarif(args.sarif, findings, errors)

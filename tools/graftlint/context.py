@@ -14,6 +14,8 @@ from __future__ import annotations
 import ast
 from collections import defaultdict
 
+from .dataflow import CFG, build_cfg, lock_regions, reaching_definitions
+
 #: Callables whose first argument becomes a traced/staged program.
 JIT_WRAPPERS = frozenset(
     {
@@ -61,6 +63,12 @@ class FileContext:
         self.jit_regions: set[ast.AST] = set()
         self._collect_jit_regions()
         self._close_over_calls()
+        # Dataflow artifacts are built lazily and cached: several rules
+        # (JGL021–023) and the fact extractor share one CFG per
+        # function instead of each re-deriving it.
+        self._cfgs: dict[ast.AST, CFG] = {}
+        self._reaching: dict[ast.AST, dict] = {}
+        self._lock_regions: dict[ast.AST, dict] = {}
 
     def nodes(self, *types: type) -> list[ast.AST]:
         """All nodes of the given type(s), from the one cached walk."""
@@ -175,6 +183,43 @@ class FileContext:
                 if target not in self.jit_regions:
                     self.jit_regions.add(target)
                     frontier.append(target)
+
+    # -- dataflow ----------------------------------------------------------
+    def cfg(self, fn: ast.FunctionDef | ast.AsyncFunctionDef) -> CFG:
+        """The (cached) statement-level CFG of one function."""
+        got = self._cfgs.get(fn)
+        if got is None:
+            got = self._cfgs[fn] = build_cfg(fn)
+        return got
+
+    def reaching(
+        self, fn: ast.FunctionDef | ast.AsyncFunctionDef
+    ) -> dict[int, frozenset[tuple[str, int]]]:
+        """Cached reaching-definitions IN facts for one function."""
+        got = self._reaching.get(fn)
+        if got is None:
+            got = self._reaching[fn] = reaching_definitions(
+                self.cfg(fn), fn
+            )
+        return got
+
+    def lock_regions_of(
+        self,
+        fn: ast.FunctionDef | ast.AsyncFunctionDef,
+        lock_id,
+        lockish,
+    ) -> dict[int, frozenset[str]]:
+        """Cached lock-region facts for one function. The cache is
+        keyed on ``fn`` alone: ``lock_id``/``lockish`` must be the
+        same canonicalization for every call on a given function
+        (true today — both callers hand in the fact extractor's
+        owner-qualified ``lock_id`` and ``FileContext._lockish``)."""
+        got = self._lock_regions.get(fn)
+        if got is None:
+            got = self._lock_regions[fn] = lock_regions(
+                fn, self.cfg(fn), lock_id, lockish
+            )
+        return got
 
     # -- generic helpers ---------------------------------------------------
     def parent(self, node: ast.AST) -> ast.AST | None:

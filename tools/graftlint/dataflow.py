@@ -1,0 +1,635 @@
+"""Per-function dataflow: CFG construction, a generic forward solver,
+reaching definitions, and the lock-region analysis.
+
+The pattern rules (JGL001–020) ask *lexical* questions — "is this call
+inside that block?". The protocol rules (JGL021–024) ask *path*
+questions: "does every path from this state reset reach a
+``note_state_lost()``?", "is a lock still held when this fsync runs,
+counting ``acquire()``/``release()`` pairing?", "does a traced value
+defined here ever reach a ``self.*`` store?". Those need a control-flow
+graph and fixpoints over it, which is what lives here.
+
+Design constraints, in order:
+
+- **Statement granularity.** One CFG node per simple statement (plus a
+  synthetic entry/exit). Branch heads (``if``/``while`` tests, ``for``
+  iters) are nodes of their own so facts can differ across arms.
+- **Conservative exception edges, not pessimistic ones.** Statements in
+  a ``try`` body get an edge to each of their handlers (any of them may
+  raise); arbitrary calls do NOT get implicit raise-to-exit edges — a
+  linter that assumed every call may raise would flag every
+  reset-then-note pair in the tree ("the note might be skipped!") and
+  drown the real findings.
+- **finally runs, always.** The normal exit of a ``try`` flows through
+  its ``finally`` body; abnormal exits (``return``/``break``/
+  ``continue``, and ``raise`` with no handler in scope) thread through
+  their own COPIES of every enclosing finally body on the way out —
+  the CPython compilation strategy — so a statement a finally
+  guarantees is never reported as bypassable. One approximation
+  remains: a raise that does have a handler jumps straight to it,
+  skipping finallys of inner handler-less tries.
+- **Two meets, one solver.** ``solve_forward`` takes the meet: union
+  for may-analyses (reaching definitions), per-key ``min`` for the
+  must-analysis lock counts. Facts are immutable mappings so a worker
+  process can ship them if a rule ever needs to.
+
+Known precision limits are documented in docs/graftlint.md ("Dataflow
+engine"); the short version: no interprocedural CFG (call effects are
+handled by the project pass's summaries), ``with`` lock scoping is
+lexical (exact for the ``with`` idiom), and ``match`` statements are
+treated as opaque straight-line nodes.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+from collections.abc import Callable, Iterable
+
+__all__ = [
+    "CFG",
+    "build_cfg",
+    "solve_forward",
+    "reaching_definitions",
+    "lock_regions",
+    "paths_avoiding",
+]
+
+FuncNode = ast.FunctionDef | ast.AsyncFunctionDef
+
+#: Loop statement types — ``continue`` targets their head node.
+_LOOPS = (ast.While, ast.For, ast.AsyncFor)
+
+
+class CFG:
+    """Control-flow graph of one function body.
+
+    Nodes are integers; ``ENTRY`` is 0 and ``EXIT`` is 1. Every other
+    node maps to exactly one AST statement (``stmt_of``); compound
+    statements contribute their *head* (the ``if``/``while`` test line,
+    the ``for`` iter, the ``with`` items, the ``try`` keyword) and their
+    bodies contribute their own nodes.
+    """
+
+    ENTRY = 0
+    EXIT = 1
+
+    def __init__(self) -> None:
+        self.succ: dict[int, list[int]] = defaultdict(list)
+        self.pred: dict[int, list[int]] = defaultdict(list)
+        self.stmt_of: dict[int, ast.AST] = {}
+        self.node_of: dict[ast.AST, int] = {}
+        self._next = 2
+
+    def add_node(self, stmt: ast.AST) -> int:
+        node = self._next
+        self._next += 1
+        self.stmt_of[node] = stmt
+        # First node wins: a statement is its own head.
+        self.node_of.setdefault(stmt, node)
+        return node
+
+    def add_edge(self, src: int, dst: int) -> None:
+        if dst not in self.succ[src]:
+            self.succ[src].append(dst)
+            self.pred[dst].append(src)
+
+    @property
+    def nodes(self) -> list[int]:
+        return [self.ENTRY, self.EXIT, *self.stmt_of]
+
+    def statements(self) -> Iterable[tuple[int, ast.AST]]:
+        return self.stmt_of.items()
+
+
+class _Builder:
+    """Recursive-descent CFG builder.
+
+    ``_block`` threads a *frontier* (the set of nodes whose normal
+    successor is the next statement) through a statement list; loop and
+    try contexts ride on explicit stacks.
+    """
+
+    def __init__(self, fn: FuncNode) -> None:
+        self.cfg = CFG()
+        # (break targets get patched to the loop's after-set, continue
+        # to its head) — one entry per enclosing loop.
+        self._breaks: list[list[int]] = []
+        self._loop_heads: list[int] = []
+        # Innermost-first list of handler-entry lists for enclosing
+        # ``try`` bodies: a statement inside a try may raise into any of
+        # its own handlers (and, rule-of-thumb conservatism, any outer
+        # ones too).
+        self._handler_entries: list[list[int]] = []
+        # Open ``finally`` bodies, outermost first. Abnormal exits
+        # (return/break/continue, raise with no handler in scope) are
+        # THREADED through copies of these bodies — Python always runs
+        # them, and a CFG that skipped them would claim a
+        # finally-guaranteed statement can be bypassed (the JGL022
+        # false-positive shape). Copies, not shared nodes: the normal
+        # path builds its own finally nodes, so facts stay per-path.
+        self._finally_bodies: list[list[ast.stmt]] = []
+        # Finally-stack depth at each enclosing loop's entry: break and
+        # continue run only the finallys opened INSIDE the loop.
+        self._loop_finally_depth: list[int] = []
+        exits = self._block(fn.body, [CFG.ENTRY])
+        for node in exits:
+            self.cfg.add_edge(node, CFG.EXIT)
+
+    # -- plumbing ----------------------------------------------------------
+    def _link(self, preds: list[int], node: int) -> None:
+        for p in preds:
+            self.cfg.add_edge(p, node)
+
+    def _raise_edges(self, node: int) -> None:
+        """Exception edges from a try-body statement to its handlers."""
+        for entries in self._handler_entries:
+            for entry in entries:
+                self.cfg.add_edge(node, entry)
+
+    def _through_finallys(self, node: int, start_depth: int) -> list[int]:
+        """Thread an abnormal exit through copies of the open finally
+        bodies from the innermost down to (and excluding) depth
+        ``start_depth``; returns the frontier after the last copy
+        (empty when a finally itself diverts control). Each copy is
+        built with the finally stack sliced to the bodies OUTSIDE it,
+        so a ``return`` inside a finally threads outward instead of
+        recursing into itself."""
+        preds = [node]
+        saved = self._finally_bodies
+        try:
+            for i in range(len(saved) - 1, start_depth - 1, -1):
+                self._finally_bodies = saved[:i]
+                preds = self._block(saved[i], preds)
+                if not preds:
+                    break
+        finally:
+            self._finally_bodies = saved
+        return preds
+
+    # -- statement dispatch ------------------------------------------------
+    def _block(self, stmts: list[ast.stmt], preds: list[int]) -> list[int]:
+        frontier = preds
+        for stmt in stmts:
+            frontier = self._stmt(stmt, frontier)
+            if not frontier:
+                break  # unreachable code after return/raise/break
+        return frontier
+
+    def _stmt(self, stmt: ast.stmt, preds: list[int]) -> list[int]:
+        if isinstance(stmt, ast.If):
+            return self._if(stmt, preds)
+        if isinstance(stmt, _LOOPS):
+            return self._loop(stmt, preds)
+        if isinstance(stmt, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+            return self._try(stmt, preds)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._with(stmt, preds)
+        node = self.cfg.add_node(stmt)
+        self._link(preds, node)
+        if self._handler_entries:
+            self._raise_edges(node)
+        if isinstance(stmt, ast.Return):
+            # Python runs every enclosing finally on the way out.
+            for p in self._through_finallys(node, 0):
+                self.cfg.add_edge(p, CFG.EXIT)
+            return []
+        if isinstance(stmt, ast.Raise):
+            # any(): a try with ONLY a finally pushes an empty handler
+            # list — that must not swallow the exceptional path.
+            if any(self._handler_entries):
+                # Routed to the handlers (inner finallys between the
+                # raise and the handler are approximated away).
+                return []
+            for p in self._through_finallys(node, 0):
+                self.cfg.add_edge(p, CFG.EXIT)
+            return []
+        if isinstance(stmt, ast.Break):
+            if self._breaks:
+                exits = self._through_finallys(
+                    node, self._loop_finally_depth[-1]
+                )
+                self._breaks[-1].extend(exits)
+                return []
+            return [node]  # malformed code: degrade to fall-through
+        if isinstance(stmt, ast.Continue):
+            if self._loop_heads:
+                for p in self._through_finallys(
+                    node, self._loop_finally_depth[-1]
+                ):
+                    self.cfg.add_edge(p, self._loop_heads[-1])
+                return []
+            return [node]
+        return [node]
+
+    def _if(self, stmt: ast.If, preds: list[int]) -> list[int]:
+        head = self.cfg.add_node(stmt)
+        self._link(preds, head)
+        if self._handler_entries:
+            self._raise_edges(head)
+        out = self._block(stmt.body, [head])
+        if stmt.orelse:
+            out = out + self._block(stmt.orelse, [head])
+        else:
+            out = out + [head]  # false arm falls through
+        return out
+
+    def _loop(self, stmt: ast.stmt, preds: list[int]) -> list[int]:
+        head = self.cfg.add_node(stmt)
+        self._link(preds, head)
+        if self._handler_entries:
+            self._raise_edges(head)
+        self._breaks.append([])
+        self._loop_heads.append(head)
+        self._loop_finally_depth.append(len(self._finally_bodies))
+        body_exits = self._block(stmt.body, [head])
+        for node in body_exits:
+            self.cfg.add_edge(node, head)
+        self._loop_heads.pop()
+        self._loop_finally_depth.pop()
+        breaks = self._breaks.pop()
+        # ``while/else`` and ``for/else`` run the else block only on
+        # normal loop exhaustion (from the head), never after a break.
+        if stmt.orelse:
+            after = self._block(stmt.orelse, [head])
+        else:
+            after = [head]
+        return after + breaks
+
+    def _with(self, stmt: ast.With | ast.AsyncWith, preds: list[int]) -> list[int]:
+        head = self.cfg.add_node(stmt)
+        self._link(preds, head)
+        if self._handler_entries:
+            self._raise_edges(head)
+        return self._block(stmt.body, [head])
+
+    def _try(self, stmt: ast.Try, preds: list[int]) -> list[int]:
+        # Handler entries must exist before the try body is built so
+        # body statements can take exception edges into them; a handler
+        # head node per handler gives the edges a stable target even
+        # for empty-bodied handlers.
+        handler_heads: list[int] = []
+        for handler in stmt.handlers:
+            handler_heads.append(self.cfg.add_node(handler))
+        if stmt.finalbody:
+            # Open while body/handlers/else build: any abnormal exit in
+            # them threads through a copy of this finally.
+            self._finally_bodies.append(stmt.finalbody)
+        self._handler_entries.append(handler_heads)
+        body_exits = self._block(stmt.body, list(preds))
+        self._handler_entries.pop()
+        # Entering the try and raising before the first statement.
+        for entry in handler_heads:
+            self._link(list(preds), entry)
+        out: list[int] = []
+        for handler, head in zip(stmt.handlers, handler_heads):
+            out.extend(self._block(handler.body, [head]))
+        if stmt.orelse:
+            out.extend(self._block(stmt.orelse, body_exits))
+        else:
+            out.extend(body_exits)
+        if stmt.finalbody:
+            self._finally_bodies.pop()
+            return self._block(stmt.finalbody, out)
+        return out
+
+
+def build_cfg(fn: FuncNode) -> CFG:
+    """The statement-level CFG of one function body (nested ``def``s
+    and lambdas are single nodes — their bodies are separate CFGs)."""
+    return _Builder(fn).cfg
+
+
+# -- the generic forward solver ---------------------------------------------
+
+
+def solve_forward(
+    cfg: CFG,
+    transfer: Callable[[int, frozenset], frozenset],
+    init: frozenset,
+    meet: Callable[[list[frozenset]], frozenset] | None = None,
+) -> dict[int, frozenset]:
+    """Worklist fixpoint of a forward dataflow problem.
+
+    Returns IN facts per node: ``in[n] = meet(out[p] for p in pred(n))``
+    with ``out[n] = transfer(n, in[n])``. ``meet`` defaults to union
+    (may-analysis); pass an intersection-style meet for must-analyses.
+    ``init`` is the fact entering the function. Unreached predecessors
+    contribute nothing to a union meet; a must-meet sees only computed
+    predecessors (standard optimistic iteration), so it must be called
+    only with the non-empty list this solver guarantees.
+    """
+
+    def union_meet(facts: list[frozenset]) -> frozenset:
+        out: frozenset = frozenset()
+        for f in facts:
+            out = out | f
+        return out
+
+    meet = meet or union_meet
+    in_facts: dict[int, frozenset] = {CFG.ENTRY: init}
+    out_facts: dict[int, frozenset] = {
+        CFG.ENTRY: transfer(CFG.ENTRY, init)
+    }
+    work = list(cfg.succ.get(CFG.ENTRY, ()))
+    seen_in_work = set(work)
+    while work:
+        node = work.pop(0)
+        seen_in_work.discard(node)
+        pred_outs = [
+            out_facts[p] for p in cfg.pred.get(node, ()) if p in out_facts
+        ]
+        if not pred_outs:
+            continue  # unreachable so far; a later edge re-queues us
+        new_in = meet(pred_outs)
+        new_out = transfer(node, new_in)
+        if node in out_facts and new_out == out_facts[node] and (
+            in_facts.get(node) == new_in
+        ):
+            continue
+        in_facts[node] = new_in
+        out_facts[node] = new_out
+        for succ in cfg.succ.get(node, ()):
+            if succ not in seen_in_work:
+                work.append(succ)
+                seen_in_work.add(succ)
+    return in_facts
+
+
+# -- reaching definitions ----------------------------------------------------
+
+
+def _assigned_names(stmt: ast.AST) -> set[str]:
+    """Local names this statement (re)binds — assignment targets,
+    ``for`` targets, ``with ... as`` names, walrus targets in its head
+    expressions. Nested function bodies do not contribute (their stores
+    are a different scope)."""
+    names: set[str] = set()
+
+    def targets_of(node: ast.AST) -> Iterable[ast.AST]:
+        if isinstance(node, ast.Assign):
+            return node.targets
+        if isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            return [node.target]
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            return [node.target]
+        return []
+
+    def collect(target: ast.AST) -> None:
+        for sub in ast.walk(target):
+            if isinstance(sub, ast.Name) and isinstance(
+                sub.ctx, ast.Store
+            ):
+                names.add(sub.id)
+
+    for target in targets_of(stmt):
+        collect(target)
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                collect(item.optional_vars)
+    # Walrus in the statement head (if/while tests, call args...).
+    head = stmt
+    if isinstance(stmt, (ast.If, ast.While)):
+        head = stmt.test
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        head = stmt.iter
+    stack = [head]
+    while stack:
+        sub = stack.pop()
+        if isinstance(
+            sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue  # pruned: a nested scope's walrus is not ours
+        if isinstance(sub, ast.NamedExpr) and isinstance(
+            sub.target, ast.Name
+        ):
+            names.add(sub.target.id)
+        stack.extend(ast.iter_child_nodes(sub))
+    return names
+
+
+def reaching_definitions(
+    cfg: CFG, fn: FuncNode
+) -> dict[int, frozenset[tuple[str, int]]]:
+    """IN set of ``(name, def_node)`` pairs per node; ``def_node`` is
+    the CFG node of the binding statement, or ``CFG.ENTRY`` for
+    parameter bindings. A rebinding kills all prior defs of the name on
+    that path (gen/kill, union meet)."""
+    gens: dict[int, set[str]] = {
+        node: _assigned_names(stmt) for node, stmt in cfg.statements()
+    }
+    args = fn.args
+    params = {
+        a.arg
+        for a in (
+            *args.posonlyargs,
+            *args.args,
+            *args.kwonlyargs,
+        )
+    }
+    if args.vararg:
+        params.add(args.vararg.arg)
+    if args.kwarg:
+        params.add(args.kwarg.arg)
+    init = frozenset((p, CFG.ENTRY) for p in params)
+
+    def transfer(node: int, facts: frozenset) -> frozenset:
+        gen = gens.get(node)
+        if not gen:
+            return facts
+        kept = frozenset(f for f in facts if f[0] not in gen)
+        return kept | frozenset((name, node) for name in gen)
+
+    return solve_forward(cfg, transfer, init)
+
+
+# -- lock regions ------------------------------------------------------------
+
+
+def _call_attr(node: ast.Call) -> str | None:
+    return node.func.attr if isinstance(node.func, ast.Attribute) else None
+
+
+def lock_regions(
+    fn: FuncNode,
+    cfg: CFG,
+    lock_id: Callable[[ast.AST], str],
+    lockish: Callable[[ast.AST], bool],
+) -> dict[int, frozenset[str]]:
+    """Locks held when each statement *executes*.
+
+    Two sources compose:
+
+    - ``with <lock>:`` — exact and lexical: the lock is held by every
+      statement in the block (computed from the AST nesting, which is
+      precisely the language semantics for ``with``).
+    - ``<lock>.acquire()`` … ``<lock>.release()`` — a forward
+      must-analysis over the CFG: after an ``acquire`` the lock's count
+      is +1 on that path, after a ``release`` −1; a statement holds the
+      lock when its count is ≥1 on EVERY path reaching it (meet =
+      per-lock min). RLock re-acquisition nests naturally: two
+      acquires need two releases before the lock reads as free.
+
+    ``lock_id`` canonicalizes the lock expression (the extractor's
+    owner-qualified ids); ``lockish`` filters to lock-like receivers so
+    ``q.get()``-style acquire/release homonyms stay out.
+    """
+    # Per-statement count deltas from acquire/release calls. A single
+    # statement may contain both (pathological); net effect applies.
+    deltas: dict[int, dict[str, int]] = {}
+    for node, stmt in cfg.statements():
+        delta: dict[str, int] = {}
+        # walk_own PRUNES nested defs/lambdas (an acquire inside a
+        # worker closure runs in the worker, not at the def statement)
+        # and stops at compound-statement heads (body statements are
+        # their own CFG nodes).
+        for sub in walk_own(stmt):
+            if not isinstance(sub, ast.Call):
+                continue
+            attr = _call_attr(sub)
+            if attr not in ("acquire", "release"):
+                continue
+            recv = sub.func.value  # type: ignore[union-attr]
+            if not lockish(recv):
+                continue
+            lid = lock_id(recv)
+            delta[lid] = delta.get(lid, 0) + (
+                1 if attr == "acquire" else -1
+            )
+        if delta:
+            deltas[node] = delta
+
+    def transfer(node: int, facts: frozenset) -> frozenset:
+        delta = deltas.get(node)
+        if not delta:
+            return facts
+        counts = dict(facts)
+        for lid, d in delta.items():
+            counts[lid] = max(0, counts.get(lid, 0) + d)
+        return frozenset(
+            (lid, c) for lid, c in counts.items() if c > 0
+        )
+
+    def must_meet(fact_list: list[frozenset]) -> frozenset:
+        counts: dict[str, int] | None = None
+        for facts in fact_list:
+            m = dict(facts)
+            if counts is None:
+                counts = m
+            else:
+                counts = {
+                    lid: min(c, m.get(lid, 0))
+                    for lid, c in counts.items()
+                    if m.get(lid, 0) > 0
+                }
+        return frozenset((lid, c) for lid, c in (counts or {}).items())
+
+    in_facts = solve_forward(cfg, transfer, frozenset(), must_meet)
+
+    held: dict[int, set[str]] = {
+        node: {lid for lid, _c in in_facts.get(node, frozenset())}
+        for node in cfg.stmt_of
+    }
+
+    # Lexical ``with`` regions: every statement nested in a with-item
+    # that is lockish holds that lock (the With head itself does not —
+    # the lock is taken after its context expressions evaluate).
+    with_locks: list[tuple[ast.AST, set[str]]] = []
+    for node, stmt in cfg.statements():
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            ids = {
+                lock_id(item.context_expr)
+                for item in stmt.items
+                if lockish(item.context_expr)
+            }
+            if ids:
+                with_locks.append((stmt, ids))
+    if with_locks:
+        # Containment by line span — cheaper than parent chains and
+        # exact for block statements.
+        for node, stmt in cfg.statements():
+            for w, ids in with_locks:
+                if stmt is w:
+                    continue
+                end = getattr(w, "end_lineno", None)
+                if (
+                    end is not None
+                    and w.lineno <= stmt.lineno
+                    and getattr(stmt, "end_lineno", stmt.lineno) <= end
+                ):
+                    held[node] |= ids
+    return {node: frozenset(ids) for node, ids in held.items()}
+
+
+def own_exprs(stmt: ast.AST) -> list[ast.AST]:
+    """The expression subtrees that belong to this CFG node itself —
+    a compound statement contributes only its head (an ``if`` its
+    test, a ``for`` its iter, a ``with`` its items); its body
+    statements are separate CFG nodes and must not be re-scanned
+    through the head. Nested function/class definitions contribute
+    nothing (their bodies run in another activation)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.target, stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        out: list[ast.AST] = []
+        for item in stmt.items:
+            out.append(item.context_expr)
+            if item.optional_vars is not None:
+                out.append(item.optional_vars)
+        return out
+    if isinstance(stmt, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+        return []
+    if isinstance(stmt, ast.ExceptHandler):
+        return [stmt.type] if stmt.type is not None else []
+    if isinstance(
+        stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+    ):
+        return []
+    return [stmt]
+
+
+def walk_own(stmt: ast.AST):
+    """``ast.walk`` over a CFG node's own expressions, never descending
+    into nested statement bodies or nested callables (pruned, not just
+    skipped — a lambda's body must not leak through)."""
+    stack = list(own_exprs(stmt))
+    while stack:
+        sub = stack.pop()
+        if isinstance(
+            sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        yield sub
+        stack.extend(ast.iter_child_nodes(sub))
+
+
+# -- path queries ------------------------------------------------------------
+
+
+def paths_avoiding(
+    cfg: CFG,
+    start: int,
+    avoiding: set[int],
+    targets: set[int],
+) -> bool:
+    """True when some path from ``start`` (exclusive) reaches a node in
+    ``targets`` without passing through any node in ``avoiding`` — the
+    "can this reset escape to the exit without a note?" query. Cycles
+    are handled by the visited set; a path trapped forever in a cycle
+    never reaches a target and contributes nothing."""
+    work = [s for s in cfg.succ.get(start, ())]
+    visited: set[int] = set()
+    while work:
+        node = work.pop()
+        if node in visited:
+            continue
+        visited.add(node)
+        if node in targets:
+            return True
+        if node in avoiding:
+            continue
+        work.extend(cfg.succ.get(node, ()))
+    return False

@@ -61,6 +61,7 @@ from .annotations import (
     thread_roles_by_line,
 )
 from .context import FileContext
+from .dataflow import walk_own
 
 __all__ = ["FileFacts", "ProjectContext", "extract_facts"]
 
@@ -197,6 +198,25 @@ class TypedArgFact:
 
 
 @dataclass(frozen=True)
+class BlockFact:
+    """One directly-blocking call site (JGL023 inputs).
+
+    ``held`` comes from the dataflow lock-region analysis — lexical
+    ``with`` blocks AND ``acquire()``/``release()`` pairing over the
+    CFG — so a blocking call between an acquire and its release is
+    held even with no ``with`` in sight. A ``*_locked`` method's body
+    has an empty ``held`` (its lock is the caller's, invisible here),
+    which is exactly why such sites are not flagged locally: the
+    call-site half of JGL023 flags the lock-holding caller instead."""
+
+    func: str
+    op: str  # display label of the blocking operation
+    path: str
+    lineno: int
+    held: tuple[str, ...]
+
+
+@dataclass(frozen=True)
 class KeyClassFact:
     """JGL014 inputs for one class that defines cache-key functions."""
 
@@ -221,6 +241,7 @@ class FileFacts:
     forwards: list[ForwardFact] = field(default_factory=list)
     typed_args: list[TypedArgFact] = field(default_factory=list)
     key_classes: list[KeyClassFact] = field(default_factory=list)
+    blocking: list[BlockFact] = field(default_factory=list)
     classes: dict[str, tuple[str, ...]] = field(default_factory=dict)
 
 
@@ -670,6 +691,185 @@ class _FunctionExtractor:
                     self.put_params.add(self.params.index(elt.id))
 
 
+# -- blocking-call classification (JGL023) ----------------------------------
+
+#: Fully-qualified calls that block the calling thread (I/O, device
+#: round trips, compilation).
+_BLOCKING_QUALS = {
+    "os.fsync": "os.fsync()",
+    "os.fdatasync": "os.fdatasync()",
+    "os.replace": "os.replace()",
+    "jax.device_get": "jax.device_get()",
+    "jax.block_until_ready": "jax.block_until_ready()",
+}
+#: Method names that block regardless of receiver type.
+_BLOCKING_ATTRS = {
+    "fsync": "fsync()",
+    "block_until_ready": ".block_until_ready()",
+    "device_get": ".device_get()",
+    "recv": "socket .recv()",
+    "recv_into": "socket .recv_into()",
+    "sendall": "socket .sendall()",
+    "accept": "socket .accept()",
+    "connect": "socket .connect()",
+}
+#: Queue hand-off methods: blocking when they carry a timeout (bounded
+#: wait is still a wait) or sit on a queue-named receiver.
+_QUEUEISH_ATTRS = frozenset({"get", "put", "join"})
+
+
+def _queueish_name(expr: ast.AST) -> bool:
+    name = None
+    if isinstance(expr, ast.Name):
+        name = expr.id
+    elif isinstance(expr, ast.Attribute):
+        name = expr.attr
+    if name is None:
+        return False
+    low = name.lower()
+    return "queue" in low or low == "q" or low.endswith("_q")
+
+
+def classify_blocking(ctx: FileContext, call: ast.Call) -> str | None:
+    """Display label when ``call`` blocks the calling thread, else
+    None. Deliberately conservative: ``.get``/``.put``/``.join`` count
+    only with an explicit ``timeout=`` or a queue-named receiver
+    (``dict.get``/``str.join`` never match), ``.compile()`` only when
+    the receiver is not the ``re`` module."""
+    qual = ctx.qualname(call.func)
+    if qual in _BLOCKING_QUALS:
+        return _BLOCKING_QUALS[qual]
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    if attr in _BLOCKING_ATTRS:
+        return _BLOCKING_ATTRS[attr]
+    if attr == "compile":
+        recv_qual = ctx.qualname(call.func.value)
+        if recv_qual in ("re", "regex"):
+            return None
+        return ".compile()"
+    if "serialize" in attr.lower():
+        return f".{attr}() (serialization)"
+    if attr in _QUEUEISH_ATTRS:
+        has_timeout = any(kw.arg == "timeout" for kw in call.keywords)
+        if has_timeout or (
+            attr != "join" and _queueish_name(call.func.value)
+        ):
+            return f"queue .{attr}()"
+    return None
+
+
+def _augment_call_locks(
+    ctx: FileContext,
+    facts: FileFacts,
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+    extractor: _FunctionExtractor,
+    first_call_idx: int,
+) -> None:
+    """Fold ``acquire()``/``release()``-paired locks into the ``held``
+    sets of this function's CallFacts. The extractor's walk records
+    only lexical ``with``-held locks; without this pass, a call made
+    between an explicit acquire and its release would reach the
+    interprocedural rules (JGL011 via-call edges, JGL023's may-block
+    half) as unlocked — the exact hazard shape the manual-protocol
+    code uses. Runs only for functions that actually call
+    ``.acquire()`` (the common case pays nothing); mapping is by line,
+    which is exact for this codebase's one-statement-per-line style
+    and merely over-approximates on packed lines (toward flagging,
+    the right direction for a linter)."""
+    if not any(
+        isinstance(sub, ast.Call)
+        and isinstance(sub.func, ast.Attribute)
+        and sub.func.attr == "acquire"
+        for sub in ast.walk(fn)
+    ):
+        return
+    cfg = ctx.cfg(fn)
+    held_at = ctx.lock_regions_of(
+        fn, extractor.lock_id, FileContext._lockish
+    )
+    by_line: dict[int, set[str]] = {}
+    for node, stmt in cfg.statements():
+        held = held_at.get(node)
+        if not held:
+            continue
+        span_end = getattr(stmt, "end_lineno", stmt.lineno) or stmt.lineno
+        if isinstance(stmt, ast.stmt) and not isinstance(
+            stmt, (ast.If, ast.While, ast.For, ast.AsyncFor,
+                   ast.With, ast.AsyncWith, ast.Try,
+                   # Compound heads span their bodies; nested defs span
+                   # closure bodies that do NOT run under this lock.
+                   ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            for line in range(stmt.lineno, span_end + 1):
+                by_line.setdefault(line, set()).update(held)
+        else:
+            by_line.setdefault(stmt.lineno, set()).update(held)
+    if not by_line:
+        return
+    from dataclasses import replace
+
+    for i in range(first_call_idx, len(facts.calls)):
+        call = facts.calls[i]
+        extra = by_line.get(call.lineno)
+        if extra and not extra <= set(call.held):
+            facts.calls[i] = replace(
+                call, held=tuple(sorted(set(call.held) | extra))
+            )
+
+
+def _extract_blocking(
+    ctx: FileContext,
+    facts: FileFacts,
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+    qual: str,
+    extractor: _FunctionExtractor,
+) -> None:
+    """BlockFacts for one outermost function AND its nested defs, each
+    against its own CFG/lock regions (a worker closure's
+    ``with self._lock: fsync()`` is this codebase's dominant threading
+    idiom — pruning closures would blind the rule to exactly the
+    hazard it exists for). Closure facts carry a ``<locals>``-style
+    qual that no call-graph edge references: their direct
+    held-while-blocking findings fire, but they never feed
+    ``may_block`` — calling the owner does not execute the closure, so
+    propagating through it would invent hazards (the never-invent
+    direction)."""
+    targets: list[tuple[ast.FunctionDef | ast.AsyncFunctionDef, str]] = [
+        (fn, qual)
+    ]
+    for sub in ast.walk(fn):
+        if (
+            isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and sub is not fn
+        ):
+            targets.append((sub, f"{qual}.<locals>.{sub.name}"))
+    for target_fn, target_qual in targets:
+        blocking_nodes: list[tuple[ast.Call, str, ast.AST]] = []
+        cfg = ctx.cfg(target_fn)
+        for node, stmt in cfg.statements():
+            for sub in walk_own(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                label = classify_blocking(ctx, sub)
+                if label is not None:
+                    blocking_nodes.append((sub, label, stmt))
+        if not blocking_nodes:
+            continue
+        held_at = ctx.lock_regions_of(
+            target_fn, extractor.lock_id, FileContext._lockish
+        )
+        for call, label, stmt in blocking_nodes:
+            node = cfg.node_of.get(stmt)
+            held = tuple(sorted(held_at.get(node, frozenset())))
+            facts.blocking.append(
+                BlockFact(
+                    target_qual, label, facts.path, call.lineno, held
+                )
+            )
+
+
 def extract_facts(ctx: FileContext) -> FileFacts:
     """The whole-program facts for one analyzed file."""
     facts = FileFacts(path=ctx.path, module=module_of(ctx.path))
@@ -777,7 +977,10 @@ def extract_facts(ctx: FileContext) -> FileFacts:
                 extractor.params,
             )
         )
+        first_call_idx = len(facts.calls)
         extractor.run()
+        _augment_call_locks(ctx, facts, fn, extractor, first_call_idx)
+        _extract_blocking(ctx, facts, fn, qual, extractor)
 
     # Pass 3: jit-key coherence facts (JGL014).
     for cls in ctx.nodes(ast.ClassDef):
@@ -928,6 +1131,7 @@ class ProjectContext:
                     self.edges[call.caller].add(target)
         self.roles: dict[str, frozenset[str]] = self._infer_roles()
         self.may_acquire: dict[str, frozenset[str]] = self._fix_acquires()
+        self.may_block: dict[str, tuple[str, str]] = self._fix_blocking()
 
     # -- resolution ---------------------------------------------------------
     def _resolve_name(
@@ -1038,6 +1242,38 @@ class ProjectContext:
                         acc.update(extra)
                         changed = True
         return {q: frozenset(v) for q, v in may.items()}
+
+    # -- blocking closure (JGL023) -----------------------------------------
+    def _fix_blocking(self) -> dict[str, tuple[str, str]]:
+        """``{qual: (op label, originating site)}`` for every function
+        that may block, transitively over the resolved call graph: a
+        function blocks if it contains a blocking call or calls (only
+        resolved edges — the never-invent direction) something that
+        does. The recorded op/site is the underlying blocking call, so
+        a finding three frames up still names the fsync it bottoms out
+        in."""
+        may: dict[str, tuple[str, str]] = {}
+        for ff in self.facts:
+            for bf in ff.blocking:
+                may.setdefault(bf.func, (bf.op, f"{bf.path}:{bf.lineno}"))
+        changed = True
+        while changed:
+            changed = False
+            # sorted(): callee sets iterate in hash order, which varies
+            # with PYTHONHASHSEED across processes — the (op, site)
+            # adopted from "the first blocking callee" must be the same
+            # one every run, or JGL023 messages flap and break the
+            # message-keyed baseline.
+            for caller, callees in self.edges.items():
+                if caller in may:
+                    continue
+                for callee in sorted(callees):
+                    got = may.get(callee)
+                    if got is not None:
+                        may[caller] = got
+                        changed = True
+                        break
+        return may
 
     def lock_edges(self) -> dict[tuple[str, str], tuple[str, int, str]]:
         """{(held, acquired): (path, line, how)} — the cross-module

@@ -1,12 +1,16 @@
 """Rule registry.
 
-Two rule scopes share one id namespace and one ``RULES`` table:
+Three rule scopes share one id namespace and one ``RULES`` table:
 
 - ``scope="file"`` — ``check(ctx: FileContext) -> Iterable[Finding]``,
   the per-file lexical rules (JGL001–JGL010).
 - ``scope="project"`` — ``check(project: ProjectContext) ->
   Iterable[Finding]``, the whole-program rules (JGL011+) that see the
   cross-module symbol table, call graph and thread roles.
+- ``scope="meta"`` — ``check(path, suppressions, findings, select)``,
+  rules about the *run itself* (JGL024 stale-suppression audit): they
+  see every pre-suppression finding for a file plus its suppression
+  directives, and run last, from the driver in ``__init__``.
 
 Registration order is the report order for same-line findings, so
 register in id order.
@@ -56,3 +60,9 @@ def rule(rule_id: str, summary: str) -> Callable[[Check], Check]:
 def project_rule(rule_id: str, summary: str) -> Callable[[Check], Check]:
     """Register a whole-program ``check(project)``."""
     return _register(rule_id, summary, "project")
+
+
+def meta_rule(rule_id: str, summary: str) -> Callable[[Check], Check]:
+    """Register a run-level ``check(path, suppressions, findings,
+    select)`` applied per file after both analysis passes."""
+    return _register(rule_id, summary, "meta")

@@ -19,9 +19,14 @@ import ast
 import re
 from collections import defaultdict
 
+from typing import TYPE_CHECKING
+
 from ..context import FileContext
 from ..findings import Finding
-from ..registry import rule
+from ..registry import project_rule, rule
+
+if TYPE_CHECKING:
+    from ..project import ProjectContext
 
 #: Call names that block the event loop when not awaited.
 _BLOCKING_ATTRS = frozenset({"poll", "consume"})
@@ -545,4 +550,84 @@ def broadcast_fanout_state(ctx: FileContext):
                 "grows it without limit — use a bounded queue.Queue "
                 "with coalesce-on-overflow (the JGL010 discipline), "
                 "or drain/cap the list",
+            )
+
+
+# -- JGL023: blocking call while a lock is held -----------------------------
+
+
+@project_rule(
+    "JGL023",
+    "blocking operation (fsync/device fetch/compile/serialize/queue "
+    "wait) executed while a lock is held",
+)
+def blocking_while_locked(project: "ProjectContext"):
+    """A lock that guards the hot path must never be held across a
+    wall-clock wait: a checkpoint fsync inside the plane lock stalls
+    every publisher behind disk latency; a ``device_get`` under the
+    registry lock serializes the service behind a device round trip;
+    ``.compile()`` under a lock turns the first tick after a layout
+    swap into a global pause (exactly the class PR 11's review caught
+    by eye). Two halves, both on the dataflow lock-region analysis
+    (``with`` blocks plus ``acquire()``/``release()`` pairing):
+
+    - **direct** — a blocking call at a statement whose lock-region
+      set is non-empty;
+    - **interprocedural** — a call made while holding a lock into a
+      function that may (transitively, over resolved call-graph edges
+      only) reach a blocking call; reported at the lock-holding call
+      site and naming the operation it bottoms out in.
+
+    The ``*_locked`` caller-holds-the-lock convention (JGL019) is
+    honored: a blocking call inside a ``foo_locked()`` body with no
+    lexical lock is NOT flagged there — the lock belongs to the
+    caller, and the interprocedural half flags the call site where
+    that lock is visible. Move the wait outside the critical section:
+    snapshot under the lock, block after releasing it."""
+    direct_sites: set[tuple[str, int]] = set()
+    for ff in project.facts:
+        for bf in ff.blocking:
+            if not bf.held:
+                continue
+            direct_sites.add((bf.path, bf.lineno))
+            yield Finding(
+                bf.path,
+                bf.lineno,
+                "JGL023",
+                f"blocking {bf.op} while holding "
+                f"{sorted(bf.held)} — every thread contending on the "
+                "lock stalls behind this wait; snapshot under the "
+                "lock and do the blocking work after releasing it",
+            )
+    for call in project.all_calls:
+        if not call.held:
+            continue
+        for target in project.resolve_call(call):
+            got = project.may_block.get(target)
+            if got is None:
+                continue
+            op, site = got
+            fn = project.functions.get(target)
+            callee = (
+                f"{fn.cls + '.' if fn and fn.cls else ''}"
+                f"{fn.name if fn else call.callee}"
+            )
+            caller = project.functions.get(call.caller)
+            # Caller quals are "<path>::qualname" by construction.
+            path = caller.path if caller else call.caller.split("::")[0]
+            if (path, call.lineno) in direct_sites:
+                # A name-classified blocking call (serialize/compile/
+                # ...) that ALSO resolves to a may-block function is
+                # one hazard, already reported by the direct half.
+                continue
+            yield Finding(
+                path,
+                call.lineno,
+                "JGL023",
+                f"call to '{callee}()' while holding "
+                f"{sorted(call.held)} reaches blocking {op} "
+                f"(at {site}) — the lock is held across a wall-clock "
+                "wait; hoist the blocking work out of the critical "
+                "section (or snapshot under the lock and flush "
+                "outside it)",
             )

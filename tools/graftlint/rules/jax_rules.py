@@ -17,6 +17,7 @@ from __future__ import annotations
 import ast
 
 from ..context import FileContext
+from ..dataflow import walk_own
 from ..findings import Finding
 from ..registry import rule
 
@@ -980,3 +981,278 @@ def telemetry_in_jit(ctx: FileContext):
                     "span timings measure trace overhead. Record on the "
                     "host side, outside the jit boundary",
                 )
+
+
+# -- JGL021: traced-value escape --------------------------------------------
+
+#: Calls whose result is a traced array when they run under trace.
+_TRACED_PRODUCER_PREFIXES = (
+    "jax.numpy.",
+    "jax.lax.",
+    "jax.nn.",
+    "jax.scipy.",
+    "jax.random.",
+    "jax.ops.",
+)
+
+#: Container-mutating method calls through which a traced value can
+#: escape into state that outlives the traced call.
+_ESCAPE_MUTATORS = frozenset(
+    {"append", "add", "update", "extend", "insert", "setdefault",
+     "appendleft", "put", "put_nowait"}
+)
+
+
+def _store_roots(target: ast.AST):
+    """Flattened assignment-target leaves (tuple unpacking expanded)."""
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _store_roots(elt)
+    else:
+        yield target
+
+
+class _TaintState:
+    """Reaching-defs-based taint for one traced function: a definition
+    site is tainted when its RHS derives from a parameter or from a
+    traced-producer call; taint queries are then per-(statement,
+    expression), so a name rebound to a host constant after a traced
+    use stays clean from there on."""
+
+    def __init__(self, ctx: FileContext, fn) -> None:
+        self.ctx = ctx
+        self.fn = fn
+        self.cfg = ctx.cfg(fn)
+        self.reaching = ctx.reaching(fn)
+        self.tainted_defs: set[tuple[str, int]] = {
+            (p, self.cfg.ENTRY) for p in ctx.params(fn)
+        }
+        self._solve()
+
+    def _producer_call(self, expr: ast.AST) -> bool:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                qual = self.ctx.qualname(sub.func)
+                if qual is not None and qual.startswith(
+                    _TRACED_PRODUCER_PREFIXES
+                ):
+                    return True
+        return False
+
+    def name_tainted(self, node: int, name: str) -> bool:
+        """Is any definition of ``name`` reaching ``node`` tainted?"""
+        for n, def_node in self.reaching.get(node, frozenset()):
+            if n == name and (n, def_node) in self.tainted_defs:
+                return True
+        return False
+
+    def expr_tainted(self, node: int, expr: ast.AST) -> bool:
+        """Is ``expr``, evaluated at CFG node ``node``, traced-derived?"""
+        if self._producer_call(expr):
+            return True
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and isinstance(
+                sub.ctx, ast.Load
+            ):
+                if self.name_tainted(node, sub.id):
+                    return True
+        return False
+
+    def _solve(self) -> None:
+        binds: list[tuple[int, ast.AST, list[str]]] = []
+        #: (node, name) pairs where an AugAssign target also READS the
+        #: name — taint flows through even though the Name is a Store.
+        aug_reads: list[tuple[int, str]] = []
+        for node, stmt in self.cfg.statements():
+            value = None
+            names: list[str] = []
+            if isinstance(stmt, ast.Assign):
+                value = stmt.value
+                for t in stmt.targets:
+                    for leaf in _store_roots(t):
+                        if isinstance(leaf, ast.Name):
+                            names.append(leaf.id)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                value = stmt.value
+                if isinstance(stmt.target, ast.Name):
+                    names.append(stmt.target.id)
+            elif isinstance(stmt, ast.AugAssign):
+                # x += traced taints x; x += 1 KEEPS x's own taint —
+                # the target reads itself, but its Name is in Store
+                # context, so the taint query must name it explicitly
+                # (an expr-only check would wash x on every no-op
+                # augment).
+                value = stmt.value
+                if isinstance(stmt.target, ast.Name):
+                    names.append(stmt.target.id)
+                    aug_reads.append((node, stmt.target.id))
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                value = stmt.iter
+                for leaf in _store_roots(stmt.target):
+                    if isinstance(leaf, ast.Name):
+                        names.append(leaf.id)
+            if names and value is not None:
+                binds.append((node, value, names))
+        aug_by_node: dict[int, set[str]] = {}
+        for node, name in aug_reads:
+            aug_by_node.setdefault(node, set()).add(name)
+        changed = True
+        while changed:
+            changed = False
+            for node, value, names in binds:
+                hit = self.expr_tainted(node, value) or any(
+                    self.name_tainted(node, n)
+                    for n in aug_by_node.get(node, ())
+                )
+                if hit:
+                    for name in names:
+                        if (name, node) not in self.tainted_defs:
+                            self.tainted_defs.add((name, node))
+                            changed = True
+
+
+def _outer_scope_receiver(
+    ctx: FileContext, fn, expr: ast.AST, module_names: frozenset[str]
+) -> str | None:
+    """A receiver that outlives the traced call: ``self.<attr>``, a
+    module-level container, or a closure name from an enclosing def.
+    Returns a display name, or None for locals/params."""
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return f"self.{expr.attr}"
+    if isinstance(expr, ast.Name):
+        local = {
+            name
+            for name, _def in ctx.reaching(fn).get(
+                ctx.cfg(fn).EXIT, frozenset()
+            )
+        }
+        # Collect every name the function binds anywhere (reaching defs
+        # at EXIT can miss names bound only on abandoned paths).
+        bound: set[str] = set(ctx.params(fn))
+        for _node, stmt in ctx.cfg(fn).statements():
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Name) and isinstance(
+                    sub.ctx, ast.Store
+                ):
+                    bound.add(sub.id)
+        bound |= local
+        if expr.id in bound:
+            return None
+        if expr.id in module_names:
+            return expr.id
+        # Name from an enclosing function scope (closure).
+        for anc in ctx.ancestors(fn):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return expr.id
+        return None
+    return None
+
+
+@rule("JGL021", "traced value escaping the jit boundary into host state")
+def traced_value_escape(ctx: FileContext):
+    """The leaked-tracer class. A jit-traced body executes ONCE per
+    trace; any value it binds is a Tracer, and storing one into
+    ``self.*``, a module global, or a container that outlives the call
+    leaks it: the next host-side read raises
+    ``UnexpectedTracerError`` — or worse, silently holds a stale
+    trace-time constant that never updates again. Dataflow-precise:
+    taint starts at the traced parameters and jnp/lax producer calls
+    and follows reaching definitions, so binding a host constant to
+    ``self`` under trace (config captured at trace time, legal if
+    intentional) is not flagged — only traced data escaping is."""
+    module_names = frozenset(
+        t.id
+        for node in ast.iter_child_nodes(ctx.tree)
+        if isinstance(node, (ast.Assign, ast.AnnAssign))
+        for t in (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        if isinstance(t, ast.Name)
+    )
+    for fn in ctx.jit_regions:
+        if isinstance(fn, ast.Lambda):
+            continue
+        taint = _TaintState(ctx, fn)
+        for node, stmt in taint.cfg.statements():
+            if isinstance(stmt, ast.ExceptHandler):
+                continue
+            # Stores: self.x = traced / GLOBAL[k] = traced / outer = ...
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = (
+                    stmt.value
+                    if not isinstance(stmt, ast.AugAssign)
+                    else stmt
+                )
+                if value is None or not taint.expr_tainted(node, value):
+                    continue
+                targets = (
+                    stmt.targets
+                    if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+                for t in targets:
+                    for leaf in _store_roots(t):
+                        base = leaf
+                        via = "assigned to"
+                        if isinstance(leaf, ast.Subscript):
+                            base = leaf.value
+                            via = "stored into"
+                        dest = _outer_scope_receiver(
+                            ctx, fn, base, module_names
+                        )
+                        if dest is None and isinstance(
+                            base, ast.Attribute
+                        ):
+                            dest = _outer_scope_receiver(
+                                ctx, fn, base.value, module_names
+                            )
+                        if dest is not None:
+                            yield Finding(
+                                ctx.path,
+                                stmt.lineno,
+                                "JGL021",
+                                f"traced value {via} '{dest}' "
+                                f"{_jit_label(ctx, fn)} escapes the jit "
+                                "boundary: the store runs once at TRACE "
+                                "time and leaks a Tracer into host "
+                                "state (UnexpectedTracerError on the "
+                                "next host read, or a frozen stale "
+                                "constant). Return the value instead "
+                                "and store it outside the traced call",
+                            )
+            # Mutator calls: self._hist.append(traced) etc.
+            for sub in walk_own(stmt):
+                if not (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _ESCAPE_MUTATORS
+                ):
+                    continue
+                args_tainted = any(
+                    taint.expr_tainted(node, a) for a in sub.args
+                ) or any(
+                    taint.expr_tainted(node, kw.value)
+                    for kw in sub.keywords
+                )
+                if not args_tainted:
+                    continue
+                dest = _outer_scope_receiver(
+                    ctx, fn, sub.func.value, module_names
+                )
+                if dest is not None:
+                    yield Finding(
+                        ctx.path,
+                        sub.lineno,
+                        "JGL021",
+                        f"traced value passed to "
+                        f"'{dest}.{sub.func.attr}()' "
+                        f"{_jit_label(ctx, fn)} escapes into a "
+                        "container that outlives the trace — the "
+                        "mutation happens once at TRACE time and the "
+                        "container keeps a leaked Tracer. Return the "
+                        "value and collect it on the host side",
+                    )

@@ -211,3 +211,186 @@ def non_atomic_persistence_write(ctx: FileContext):
                     "at garbage — os.fsync the file (and ideally the "
                     "directory) before os.replace",
                 )
+
+
+# -- JGL022: state-loss protocol --------------------------------------------
+
+#: Methods whose call discards accumulated member state in place.
+_STATE_LOSING_CALLS = frozenset({"reset", "set_state", "clear"})
+#: Loss-context tokens: an ``if`` guard mentioning any of these marks a
+#: containment branch (donation-consumed checks, state_lost results).
+_LOSS_GUARD = re.compile(r"state_lost|consumed|lost|epoch", re.IGNORECASE)
+#: The protocol's notification surface.
+_NOTE_CALL = "note_state_lost"
+_EPOCH_ATTR = "state_epoch"
+
+
+def _file_in_protocol(ctx: FileContext) -> bool:
+    """The file participates in the state-epoch protocol: it calls
+    ``note_state_lost`` or touches ``state_epoch`` somewhere. Files
+    outside the protocol have no discipline to enforce."""
+    for node in ctx.all_nodes:
+        if isinstance(node, ast.Attribute) and node.attr in (
+            _NOTE_CALL,
+            _EPOCH_ATTR,
+        ):
+            return True
+        if isinstance(node, ast.Name) and node.id == _NOTE_CALL:
+            return True
+    return False
+
+
+def _stmt_has_note(stmt: ast.AST, noters: frozenset[str]) -> bool:
+    """Does this statement notify the protocol? A ``note_state_lost``
+    call, a ``state_epoch`` bump, or a call to a local helper whose
+    body (transitively) does either."""
+    for sub in ast.walk(stmt):
+        if isinstance(sub, ast.Call):
+            name = None
+            if isinstance(sub.func, ast.Attribute):
+                name = sub.func.attr
+            elif isinstance(sub.func, ast.Name):
+                name = sub.func.id
+            if name == _NOTE_CALL or name in noters:
+                return True
+        if isinstance(sub, (ast.Assign, ast.AugAssign)):
+            targets = (
+                sub.targets if isinstance(sub, ast.Assign) else [sub.target]
+            )
+            for t in targets:
+                if isinstance(t, ast.Attribute) and t.attr == _EPOCH_ATTR:
+                    return True
+                if isinstance(t, ast.Name) and t.id == _EPOCH_ATTR:
+                    return True
+    return False
+
+
+def _noting_helpers(ctx: FileContext) -> frozenset[str]:
+    """Names of functions in this file that (transitively) call
+    ``note_state_lost`` or bump ``state_epoch`` — calling one counts as
+    notifying, so a class that routes the bump through a helper
+    (``Job.note_state_lost`` itself, a ``_recover()`` wrapper) is not
+    re-flagged at every call site."""
+    noters: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            if fn.name in noters:
+                continue
+            for stmt in fn.body:
+                if _stmt_has_note(stmt, frozenset(noters)):
+                    noters.add(fn.name)
+                    changed = True
+                    break
+    return frozenset(noters)
+
+
+def _in_loss_context(ctx: FileContext, stmt: ast.AST) -> bool:
+    """The statement sits on a failure path: inside an except handler,
+    or under an ``if`` whose test mentions a loss token (``state_lost``,
+    ``*_consumed``...)."""
+    for anc in ctx.ancestors(stmt):
+        if isinstance(anc, ast.ExceptHandler):
+            return True
+        if isinstance(anc, ast.If) and _LOSS_GUARD.search(
+            ast.unparse(anc.test)
+        ):
+            return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break
+    return False
+
+
+def _loss_call(stmt: ast.AST) -> tuple[str, int] | None:
+    """(description, lineno) of a state-losing reassignment in a simple
+    statement: ``X.reset()`` / ``X.set_state(...)`` / ``X.clear()``."""
+    for sub in ast.walk(stmt):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr in _STATE_LOSING_CALLS
+        ):
+            return f"{ast.unparse(sub.func)}()", sub.lineno
+    return None
+
+
+@rule(
+    "JGL022",
+    "state-losing containment path missing its note_state_lost/"
+    "state_epoch bump",
+)
+def state_loss_protocol(ctx: FileContext):
+    """ADR 0117/0118 discipline, now checked instead of hand-reviewed:
+    every containment site that discards accumulated state in place
+    (``offer.reset()``, ``offer.set_state(init)``, ``.clear()`` on a
+    failure path) must ALSO notify the durability plane —
+    ``note_state_lost()`` or a ``state_epoch`` bump — on every path out
+    of the reset, or subscribers silently see a reset stream as
+    continuous data and checkpoint replay restores into the wrong
+    epoch. CFG-path-sensitive: the reset and the note may sit in
+    different branches, and only a genuinely note-free path to the
+    function exit fires. Scope: files already in the protocol (they
+    call ``note_state_lost``/touch ``state_epoch``); the reset must sit
+    on a failure path (inside an ``except`` handler or under a
+    loss-token guard like ``if res.state_lost:``)."""
+    from ..dataflow import CFG, paths_avoiding
+
+    if not _file_in_protocol(ctx):
+        return
+    noters = _noting_helpers(ctx)
+    for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+        if ctx.enclosing_function(fn) is not None:
+            continue
+        if fn.name == _NOTE_CALL:
+            # The protocol surface itself legitimately reassigns state
+            # while bumping the epoch (its bump IS the notification).
+            continue
+        cfg = ctx.cfg(fn)
+        note_nodes = {
+            node
+            for node, stmt in cfg.statements()
+            if not isinstance(
+                stmt, (ast.If, ast.While, ast.For, ast.AsyncFor,
+                       ast.With, ast.AsyncWith, ast.Try,
+                       ast.ExceptHandler)
+            )
+            and _stmt_has_note(stmt, noters)
+        }
+        for node, stmt in cfg.statements():
+            if isinstance(
+                stmt, (ast.If, ast.While, ast.For, ast.AsyncFor,
+                       ast.With, ast.AsyncWith, ast.Try,
+                       ast.ExceptHandler)
+            ):
+                continue  # compound heads: their bodies have own nodes
+            loss = _loss_call(stmt)
+            if loss is None:
+                continue
+            if not _in_loss_context(ctx, stmt):
+                continue
+            if _stmt_has_note(stmt, noters):
+                continue  # reset and note in one statement
+            desc, lineno = loss
+            # Compliant in either direction: every path OUT of the
+            # reset reaches a note, or every path INTO the reset
+            # already passed one (note-then-reset is the same protocol
+            # event written in the other order).
+            noted_before = not paths_avoiding(
+                cfg, CFG.ENTRY, note_nodes, {node}
+            )
+            if not noted_before and paths_avoiding(
+                cfg, node, note_nodes, {CFG.EXIT}
+            ):
+                yield Finding(
+                    ctx.path,
+                    lineno,
+                    "JGL022",
+                    f"state-losing '{desc}' on a containment path has "
+                    "an exit path that never reaches note_state_lost()/"
+                    "a state_epoch bump — subscribers would read the "
+                    "reset accumulation as continuous data and replay "
+                    "would restore into the wrong epoch; notify the "
+                    "protocol on every path out of the reset (ADR "
+                    "0117/0118)",
+                )

@@ -17,7 +17,7 @@ from collections import defaultdict
 
 from ..findings import Finding
 from ..project import _PRE_THREAD_METHODS, ProjectContext
-from ..registry import project_rule
+from ..registry import meta_rule, project_rule
 
 
 @project_rule(
@@ -135,17 +135,22 @@ def cross_role_unlocked_write(project: ProjectContext):
         writers = sorted({s.method for s in sites})
         unguarded = [s for s in sites if not s.held]
         if unguarded:
-            site = min(unguarded, key=lambda s: s.lineno)
-            yield Finding(
-                site.path,
-                site.lineno,
-                "JGL012",
-                f"self.{attr} is written from thread roles "
-                f"{sorted(roles)} (writers: {writers}) but this write in "
-                f"'{cls}.{site.method}' holds no lock — concurrent "
-                "writes interleave; guard every write with one shared "
-                "lock",
-            )
+            # One finding PER unguarded site (not just the first):
+            # every site is individually hazardous, and each needs its
+            # own suppression to stay visible in the ledger — a single
+            # collapsed finding would make the siblings' suppressions
+            # read as stale to the JGL024 audit.
+            for site in sorted(unguarded, key=lambda s: s.lineno):
+                yield Finding(
+                    site.path,
+                    site.lineno,
+                    "JGL012",
+                    f"self.{attr} is written from thread roles "
+                    f"{sorted(roles)} (writers: {writers}) but this "
+                    f"write in '{cls}.{site.method}' holds no lock — "
+                    "concurrent writes interleave; guard every write "
+                    "with one shared lock",
+                )
             continue
         common = set(sites[0].held)
         for site in sites[1:]:
@@ -253,3 +258,81 @@ def jit_key_coherence(project: ProjectContext):
                     f"'# graft: key-derived={attr} <why>' if it is a "
                     "pure function of keyed attributes",
                 )
+
+
+@meta_rule(
+    "JGL024",
+    "suppression comment whose rule no longer fires on that line",
+)
+def stale_suppression(path, suppressions, findings, select):
+    """The suppression ledger's rot guard. A ``# graftlint:
+    disable=JGLxxx`` earns its keep only while the named rule actually
+    fires on the suppressed line — after a refactor removes the hazard
+    (or moves it), the comment lingers and silently masks the NEXT
+    genuine finding someone introduces there. This audit runs after
+    both analysis passes over the pre-suppression findings: a line
+    directive is live when its rule fires on the directive's line or
+    the one below it (the two placements the suppression layer
+    honors); a ``disable-file=`` is live when the rule fires anywhere
+    in the file. Stale ones are reported at the directive.
+
+    Directives naming rules excluded by ``--select`` are not judged
+    (their rule did not run, so absence of findings proves nothing);
+    ``disable=all`` (generated files) is exempt — it cannot be
+    enumerated; ``JGL024`` entries are likewise skipped (a directive
+    suppressing this audit is self-referential). A directive naming a
+    rule id that does not exist at all is always stale."""
+    from ..registry import RULES
+
+    def audit(names, live):
+        stale: list[str] = []
+        for r in sorted(names):
+            if r in ("all", "JGL024"):
+                continue
+            if r not in RULES:
+                stale.append(f"{r} (no such rule)")
+                continue
+            if select is not None and r not in select:
+                continue
+            if not live(r):
+                stale.append(r)
+        return stale
+
+    for lineno, names in sorted(suppressions.by_line.items()):
+        stale = audit(
+            names,
+            lambda r: any(
+                f.rule == r and f.line in (lineno, lineno + 1)
+                for f in findings
+            ),
+        )
+        if stale:
+            yield Finding(
+                path,
+                lineno,
+                "JGL024",
+                f"stale suppression: {', '.join(stale)} no longer "
+                "fire(s) on this line — the comment now only masks "
+                "the next genuine finding here; delete it (or fix the "
+                "rule id)",
+            )
+    if suppressions.file_wide:
+        stale = audit(
+            suppressions.file_wide,
+            lambda r: any(f.rule == r for f in findings),
+        )
+        if stale:
+            lineno = min(
+                suppressions.file_wide_lines.get(
+                    s.split(" ")[0], 1
+                )
+                for s in stale
+            )
+            yield Finding(
+                path,
+                lineno,
+                "JGL024",
+                f"stale file-wide suppression: {', '.join(stale)} "
+                "fire(s) nowhere in this file — delete the "
+                "disable-file directive (or fix the rule id)",
+            )

@@ -23,8 +23,10 @@ import tokenize
 from .findings import Finding
 
 # The id list stops at the first non-id token so trailing prose on the
-# same comment ("# graftlint: disable=JGL007 best-effort wakeup") — the
-# justification style the docs recommend — does not break the match.
+# same comment (a directive followed by "best-effort wakeup" or similar
+# justification text, the style the docs recommend) does not break the
+# match. No literal example here: this is a COMMENT, so an example
+# directive would itself parse as one (and read as stale to JGL024).
 _IDS = r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
 _LINE_RE = re.compile(r"#\s*graftlint:\s*disable=" + _IDS)
 _FILE_RE = re.compile(r"#\s*graftlint:\s*disable-file=" + _IDS)
@@ -52,13 +54,19 @@ class Suppressions:
     def __init__(self, source: str) -> None:
         self.by_line: dict[int, frozenset[str]] = {}
         self.file_wide: frozenset[str] = frozenset()
+        #: rule -> line of the first disable-file directive naming it
+        #: (the stale-suppression audit reports AT the directive).
+        self.file_wide_lines: dict[str, int] = {}
         for lineno, comment in _iter_comments(source):
             if m := _LINE_RE.search(comment):
                 self.by_line[lineno] = self.by_line.get(
                     lineno, frozenset()
                 ) | _rules(m.group(1))
             if m := _FILE_RE.search(comment):
-                self.file_wide = self.file_wide | _rules(m.group(1))
+                named = _rules(m.group(1))
+                self.file_wide = self.file_wide | named
+                for r in named:
+                    self.file_wide_lines.setdefault(r, lineno)
 
     def _match(self, rules: frozenset[str], rule: str) -> bool:
         return rule in rules or "all" in rules
