@@ -1400,6 +1400,103 @@ def bench_churn(args) -> dict:
     return line
 
 
+def bench_slo(args, *, scale: float | None = None) -> dict:
+    """SLO plane acceptance (ADR 0120): the load+chaos harness through
+    the REAL JobManager + ServingPlane, gated by the declarative rule
+    file ``scripts/slo_rules/smoke.json``.
+
+    Reports the p99 consume->subscriber-delivered e2e latency
+    DECOMPOSED BY STAGE (consume / decode / published / fanout_encoded
+    / subscriber_delivered — ``livedata_e2e_latency_seconds``) over the
+    gated phase, and asserts the chaos drill's containment contracts:
+    injected post-donation state loss is SIGNALED (epoch bumps, zero
+    unsignaled resets), wire parity holds byte-exactly at every checker
+    subscriber, hot-path compiles stay 0 (the failover path is warmed),
+    queues stay bounded at the limit, coalesced subscribers recover.
+    Then the CONTROL: the same drill with the state-loss signal
+    disabled must make the gate exit non-zero — proving the gate can
+    catch the regression it exists for.
+    """
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "slo_gate", Path(__file__).resolve().parent / "scripts/slo_gate.py"
+    )
+    slo_gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(slo_gate)
+    from esslivedata_tpu.telemetry.e2e import E2E_STAGES
+
+    if scale is None:
+        # Rough size coupling to the headline knobs: --smoke budgets
+        # (events 8192 / batches 6) land ~0.5, a full run ~1.0.
+        scale = 0.5 if (args.events or 0) <= 65536 else 1.0
+    # THE drill is slo_gate's own (chaos schedule, scaling, scrape
+    # delta all included): the bench grades the exact scenario CI
+    # gates — a schedule tweak there can never silently diverge from
+    # what this scenario measures.
+    report, delta = slo_gate._smoke_report(None, scale)
+    rules = slo_gate._load_rules(
+        Path(__file__).resolve().parent / "scripts/slo_rules/smoke.json"
+    )
+    gate_ok, gate_results = slo_gate.evaluate(rules, delta)
+    e2e = delta.get("livedata_e2e_latency_seconds")
+    p99_by_stage = {}
+    if e2e is not None:
+        for stage in E2E_STAGES:
+            q = slo_gate.histogram_quantile(e2e, 0.99, {"stage": stage})
+            if q is not None:
+                p99_by_stage[stage] = None if q == float("inf") else q
+    # The acceptance contracts, asserted here AND gated by the rules.
+    assert report["chaos_injected"], "chaos schedule fired nothing"
+    assert report["parity_violations"] == 0, report
+    assert report["gap_violations"] == 0, report
+    assert report["steady_compiles"] == 0, report
+    assert report["coalesce_drops"] > 0, report
+    assert report["coalesce_recoveries"] > 0, report
+    assert report["peak_queue_depth"] <= report["queue_limit"], report
+    assert gate_ok, gate_results
+    assert "subscriber_delivered" in p99_by_stage, p99_by_stage
+    # CONTROL: the same drill with the state-loss epoch signal
+    # disabled; the gate MUST go red (unsignaled resets observed by
+    # subscribers).
+    control, control_delta = slo_gate._smoke_report(
+        "state-lost-signal", min(scale, 0.25)
+    )
+    control_ok, control_results = slo_gate.evaluate(rules, control_delta)
+    assert not control_ok, (
+        "gate stayed green with state-loss containment disabled",
+        control_results,
+    )
+    assert control["gap_violations"] > 0, control
+    line = {
+        "metric": "slo",
+        # Graded value: the headline — p99 consume->subscriber e2e
+        # freshness (seconds) under chaos, CPU-container scale.
+        "value": p99_by_stage.get("subscriber_delivered"),
+        "unit": "p99_e2e_seconds",
+        "e2e_p99_by_stage": p99_by_stage,
+        "windows": report["windows"],
+        "subscribers": report["subscribers"],
+        "jobs": report["jobs"],
+        "wall_ms_per_window": report["wall_ms_per_window"],
+        "chaos_injected": report["chaos_injected"],
+        "parity_checks": report["parity_checks"],
+        "parity_violations": report["parity_violations"],
+        "gap_violations": report["gap_violations"],
+        "steady_compiles": report["steady_compiles"],
+        "coalesce_drops": report["coalesce_drops"],
+        "coalesce_recoveries": report["coalesce_recoveries"],
+        "peak_queue_depth": report["peak_queue_depth"],
+        "healthz_after_chaos": report["healthz"],
+        "gate_passed": gate_ok,
+        "gate_rules": gate_results,
+        "control_gate_breached": not control_ok,
+        "control_gap_violations": control["gap_violations"],
+    }
+    emit_line(line)
+    return line
+
+
 def bench_telemetry(args, tick_wall_ms: float | None = None) -> dict:
     """Steady-state telemetry overhead guard (ADR 0116, PERF round 10).
 
@@ -2332,6 +2429,7 @@ def run_benchmark(args, platform: str) -> dict:
             lambda: bench_tick(args),
             lambda: bench_fanout(args),
             lambda: bench_churn(args),
+            lambda: bench_slo(args),
             lambda: bench_telemetry(args),
             lambda: bench_mesh(args),
             lambda: bench_pipeline(args),
@@ -2705,6 +2803,19 @@ def _parse_args():
         "runs under --all and --smoke)",
     )
     parser.add_argument(
+        "--slo",
+        action="store_true",
+        help="Run ONLY the SLO-plane scenario (ADR 0120) and exit: the "
+        "load+chaos harness through the real JobManager + ServingPlane "
+        "— p99 consume->subscriber e2e latency decomposed by stage, "
+        "injected state-loss/wedged-subscriber/slow-tick/consumer-"
+        "restart chaos with containment asserted (signaled resets, "
+        "wire parity, 0 hot-path compiles, bounded queues, coalesce "
+        "recovery), the scripts/slo_gate.py rule gate green, and a "
+        "containment-disabled control proving the gate goes red (dev "
+        "flag, like --multijob; also runs under --all and --smoke)",
+    )
+    parser.add_argument(
         "--telemetry",
         action="store_true",
         help="Run ONLY the telemetry-overhead guard (ADR 0116) and "
@@ -2901,6 +3012,35 @@ def _smoke_main(args) -> int:
             )
         if not churn_line.get("wire_byte_identical_after_replay"):
             problems.append("replay wire not byte-identical to control")
+    # SLO-plane control (ADR 0120): the load+chaos drill at smoke
+    # scale; the scenario itself asserts containment (signaled resets,
+    # wire parity, 0 hot-path compiles, bounded queues, coalesce
+    # recovery), the rule gate green and the containment-disabled
+    # control red, and this guards the report's structure.
+    try:
+        slo_line = bench_slo(args, scale=0.25)
+    except Exception:
+        traceback.print_exc()
+        problems.append("slo scenario raised")
+    else:
+        for field in (
+            "value",
+            "e2e_p99_by_stage",
+            "gate_passed",
+            "control_gate_breached",
+            "chaos_injected",
+        ):
+            if slo_line.get(field) is None:
+                problems.append(f"slo line missing {field!r}")
+        if not slo_line.get("gate_passed"):
+            problems.append("slo gate breached on the contained run")
+        if not slo_line.get("control_gate_breached"):
+            problems.append(
+                "slo gate stayed green with containment disabled"
+            )
+        stages = slo_line.get("e2e_p99_by_stage", {})
+        if "subscriber_delivered" not in stages:
+            problems.append("slo line missing subscriber_delivered p99")
     # Telemetry-overhead guard (ADR 0116): instrument microcosts
     # bounded against the tick wall this very smoke just measured.
     try:
@@ -2976,7 +3116,8 @@ def _smoke_main(args) -> int:
         "byte-identical reconstruction, churn kill-and-restart "
         "replayed byte-identical with a 0-compile warmed commit, mesh "
         "tier at 1 execute/slice/tick with single-device parity, "
-        "pipelined ingest drained with parity",
+        "pipelined ingest drained with parity, SLO chaos drill "
+        "contained with the rule gate green and the control red",
         file=sys.stderr,
     )
     return 0
@@ -3034,6 +3175,9 @@ def main() -> None:
         sys.exit(0)
     if args.telemetry:
         bench_telemetry(args)
+        sys.exit(0)
+    if args.slo:
+        bench_slo(args, scale=0.5)
         sys.exit(0)
     if args.mesh:
         # The virtual-device topology must be pinned BEFORE backend

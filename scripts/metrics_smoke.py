@@ -59,6 +59,10 @@ REQUIRED_FAMILIES = (
     "livedata_stream_messages",
     "livedata_kafka_sink_events",
     "livedata_hbm_bytes",
+    # SLO plane (ADR 0120): the e2e freshness histogram and the
+    # state-loss counter are always-registered instruments.
+    "livedata_e2e_latency_seconds",
+    "livedata_state_lost",
 )
 
 
@@ -151,10 +155,15 @@ def main() -> int:
                 break
             except Exception:
                 time.sleep(1.0)
-        if health != {"status": "ok"}:
+        # 'ok' normally; 'degraded' (with a reason, still 200) is a
+        # valid payload too — a starved CI runner can latch the
+        # slow-tick watchdog on the very first windows (ADR 0120).
+        if health.get("status") not in ("ok", "degraded") or (
+            health["status"] == "degraded" and not health.get("reason")
+        ):
             print(f"/healthz wrong or never up: {health!r}")
             return 1
-        print("healthz OK")
+        print(f"healthz OK ({health['status']})")
 
         # 2. drive data so the publish/compile/span producers fire.
         publishes = 0.0
@@ -210,6 +219,20 @@ def main() -> int:
         if compiles < 1:
             print("compile-event instrument saw no compiles")
             return 1
+        # E2E freshness (ADR 0120): the decode and published boundaries
+        # must have observed the driven windows.
+        e2e_counts = {
+            labels.get("stage"): value
+            for name, labels, value in parsed[
+                "livedata_e2e_latency_seconds"
+            ].samples
+            if name.endswith("_count")
+        }
+        for stage in ("decode", "published"):
+            if e2e_counts.get(stage, 0.0) < 1:
+                print(f"e2e latency stage {stage!r} never observed: {e2e_counts}")
+                return 1
+        print("e2e latency boundaries OK")
 
         # 4. result fan-out tier (ADR 0117): index, first SSE event a
         # valid keyframe decoding as da00, serving families scraped.

@@ -1,0 +1,404 @@
+#!/usr/bin/env python3
+"""SLO gate: scrape /metrics, evaluate a declarative rule file, exit
+non-zero on breach (ADR 0120).
+
+The checker is deliberately dumb: it reads ONE Prometheus text payload
+(a live ``--url`` scrape, or a ``--metrics-file`` dump), optionally
+subtracts a ``--baseline`` payload (so counters/histograms evaluate
+over exactly the measured phase — warm-up compiles and whatever else
+ran in the process never pollute the gate), and walks the rule file.
+All parsing uses the IN-TREE promtext parser
+(``esslivedata_tpu.telemetry.exposition``) — no prometheus_client.
+
+Rule file format (JSON; see docs/observability.md):
+
+    {"rules": [
+      {"name": "e2e_p99",
+       "metric": "livedata_e2e_latency_seconds",
+       "labels": {"stage": "subscriber_delivered"},
+       "agg": "p99", "op": "<=", "value": 0.1},
+      {"name": "hot_path_compiles",
+       "metric": "livedata_jit_compiles_total",
+       "agg": "sum", "op": "==", "value": 0},
+      ...
+    ]}
+
+- ``metric``: family name as exposed (counters WITHOUT the ``_total``
+  sample suffix — the parser folds suffixes into the family).
+- ``labels``: optional filter; a sample must carry every given pair.
+- ``agg``: ``sum`` | ``max`` | ``min`` | ``count`` (number of matching
+  samples) | ``p50``/``p90``/``p99`` (histogram quantile over the
+  matching bucket series, linear interpolation within the bucket; an
+  estimate in the +Inf bucket evaluates as infinity — a breach for any
+  upper bound, which is the honest reading).
+- ``op``: ``<=`` ``<`` ``>=`` ``>`` ``==`` ``!=`` against ``value``.
+- ``allow_missing``: true = a rule whose metric has no matching
+  samples passes with value 0 (for families absent on some backends,
+  e.g. HBM gauges on CPU). Default false: a missing metric is a
+  BREACH — a gate that silently passes because the instrument
+  disappeared is worse than no gate.
+
+Modes:
+
+- default: evaluate an existing scrape (CI against a deployed
+  service, an operator against a prod replica).
+- ``--smoke``: run the in-process load+chaos harness
+  (``esslivedata_tpu.harness``) at CPU-container scale first, then
+  gate its scrape delta with the smoke rule file (scaled latency
+  budget; the invariant SLOs — hot-path compiles 0, zero parity
+  violations, zero unsignaled resets, bounded queues, coalesce
+  recovery — are hard). This is the CI benchmark-smoke step.
+- ``--control CLASS`` (with ``--smoke``): disable one containment
+  class in the harness (``state-lost-signal`` | ``bounded-queues``)
+  and run the same gate. CI asserts the gate EXITS NON-ZERO here —
+  the control that proves the gate can actually catch the regression
+  it exists for.
+
+Exit codes: 0 = all rules pass, 1 = breach (or chaos did not run in a
+--smoke chaos gate), 2 = usage/scrape error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "src"))
+
+from esslivedata_tpu.telemetry.exposition import (  # noqa: E402
+    ParsedMetric,
+    parse_prometheus_text,
+)
+
+RULES_DIR = Path(__file__).resolve().parent / "slo_rules"
+
+_OPS = {
+    "<=": lambda a, b: a <= b,
+    "<": lambda a, b: a < b,
+    ">=": lambda a, b: a >= b,
+    ">": lambda a, b: a > b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+}
+
+
+# -- scrape algebra ---------------------------------------------------------
+def subtract(
+    after: dict[str, ParsedMetric], before: dict[str, ParsedMetric]
+) -> dict[str, ParsedMetric]:
+    """``after - before`` per sample for counters and histograms
+    (monotone series: the delta IS the measured phase). Gauges and
+    untyped families keep their ``after`` value — a queue depth is a
+    level, not a rate. Samples new in ``after`` keep their value."""
+    out: dict[str, ParsedMetric] = {}
+    for name, fam in after.items():
+        prev = before.get(name)
+        if prev is None or fam.kind not in ("counter", "histogram"):
+            out[name] = fam
+            continue
+        prev_values = {
+            (s_name, tuple(sorted(labels.items()))): value
+            for s_name, labels, value in prev.samples
+        }
+        delta = ParsedMetric(name=name, kind=fam.kind, help=fam.help)
+        for s_name, labels, value in fam.samples:
+            key = (s_name, tuple(sorted(labels.items())))
+            delta.samples.append(
+                (s_name, labels, value - prev_values.get(key, 0.0))
+            )
+        out[name] = delta
+    return out
+
+
+def _matches(labels: dict[str, str], want: dict[str, str]) -> bool:
+    return all(labels.get(k) == str(v) for k, v in want.items())
+
+
+def histogram_quantile(
+    family: ParsedMetric, q: float, want: dict[str, str]
+) -> float | None:
+    """Quantile estimate over the matching ``_bucket`` series (merged
+    across any remaining label splits, Prometheus-style). None when the
+    series is empty; +inf when the estimate lands in the +Inf bucket."""
+    buckets: dict[float, float] = {}
+    for s_name, labels, value in family.samples:
+        if not s_name.endswith("_bucket") or not _matches(labels, want):
+            continue
+        le = labels.get("le", "")
+        bound = math.inf if le == "+Inf" else float(le)
+        buckets[bound] = buckets.get(bound, 0.0) + value
+    if not buckets:
+        return None
+    bounds = sorted(buckets)
+    total = buckets[bounds[-1]]
+    if total <= 0:
+        return None
+    target = q * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound in bounds:
+        cum = buckets[bound]
+        if cum >= target:
+            if math.isinf(bound):
+                return math.inf
+            if cum == prev_cum:
+                return bound
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return prev_bound + frac * (bound - prev_bound)
+        prev_bound, prev_cum = bound, cum
+    return math.inf  # pragma: no cover - loop always hits total
+
+
+def evaluate_rule(
+    rule: dict, families: dict[str, ParsedMetric]
+) -> tuple[bool, float | None, str]:
+    """(passed, observed value, detail) for one rule dict."""
+    metric = rule["metric"]
+    want = {k: str(v) for k, v in rule.get("labels", {}).items()}
+    agg = rule.get("agg", "sum")
+    family = families.get(metric)
+    observed: float | None = None
+    if family is not None:
+        if agg.startswith("p") and agg[1:].isdigit():
+            observed = histogram_quantile(
+                family, int(agg[1:]) / 100.0, want
+            )
+        else:
+            values = [
+                value
+                for s_name, labels, value in family.samples
+                if _matches(labels, want)
+                # Histogram aggregates over raw buckets are
+                # meaningless; restrict non-quantile aggs to
+                # non-bucket samples.
+                and not s_name.endswith("_bucket")
+                and not s_name.endswith("_sum")
+            ]
+            if values:
+                observed = {
+                    "sum": sum,
+                    "max": max,
+                    "min": min,
+                    "count": len,
+                }[agg](values)
+            elif not want:
+                # The family IS exposed (HELP/TYPE header) with no
+                # series yet — a counter that never fired reads 0.
+                # With a label filter we stay strict: an absent
+                # labelset is indistinguishable from a typo'd filter.
+                observed = 0.0
+    if observed is None:
+        if rule.get("allow_missing", False):
+            observed = 0.0
+        else:
+            return False, None, "metric absent from scrape"
+    op = rule.get("op", "<=")
+    bound = float(rule["value"])
+    passed = _OPS[op](observed, bound)
+    return passed, observed, f"{observed!r} {op} {bound!r}"
+
+
+def evaluate(
+    rules: list[dict], families: dict[str, ParsedMetric]
+) -> tuple[bool, list[dict]]:
+    results = []
+    ok = True
+    for rule in rules:
+        passed, observed, detail = evaluate_rule(rule, families)
+        ok = ok and passed
+        results.append(
+            {
+                "name": rule.get("name", rule["metric"]),
+                "passed": passed,
+                "observed": (
+                    None
+                    if observed is None
+                    else (observed if math.isfinite(observed) else "inf")
+                ),
+                "detail": detail,
+            }
+        )
+    return ok, results
+
+
+# -- input ------------------------------------------------------------------
+def _load_payload(args) -> str:
+    if args.url:
+        with urllib.request.urlopen(args.url, timeout=10.0) as resp:
+            return resp.read().decode()
+    return Path(args.metrics_file).read_text()
+
+
+_KNOWN_AGGS = frozenset({"sum", "max", "min", "count", "p50", "p90", "p99"})
+
+
+def _load_rules(path: Path) -> list[dict]:
+    """Load + validate: a malformed rule is a CONFIG error (exit 2),
+    never a rule breach (exit 1) — wrappers scripted around the exit
+    codes must not misread a typo as an SLO regression."""
+    doc = json.loads(path.read_text())
+    rules = doc["rules"]
+    if not isinstance(rules, list) or not rules:
+        raise ValueError(f"{path}: empty rule list gates nothing")
+    for i, rule in enumerate(rules):
+        label = rule.get("name", f"#{i}")
+        for key in ("metric", "value"):
+            if key not in rule:
+                raise ValueError(f"{path}: rule {label}: missing {key!r}")
+        agg = rule.get("agg", "sum")
+        if agg not in _KNOWN_AGGS:
+            raise ValueError(
+                f"{path}: rule {label}: unknown agg {agg!r} "
+                f"(one of {sorted(_KNOWN_AGGS)})"
+            )
+        op = rule.get("op", "<=")
+        if op not in _OPS:
+            raise ValueError(
+                f"{path}: rule {label}: unknown op {op!r} "
+                f"(one of {sorted(_OPS)})"
+            )
+        float(rule["value"])  # a non-numeric bound raises here, not mid-gate
+    return rules
+
+
+# -- smoke mode -------------------------------------------------------------
+def _smoke_report(control: str | None, scale: float):
+    """Run the in-process harness with the CI chaos drill; returns
+    (report, families-delta)."""
+    from esslivedata_tpu.harness import ChaosSpec, LoadConfig, LoadHarness
+
+    base = LoadConfig().scaled(scale)
+    windows = base.windows
+    base.chaos = ChaosSpec(
+        seed=base.seed,
+        at={
+            # Post-donation dispatch failures: consultations advance
+            # once per tick group per window (streams groups/window) —
+            # two fires early, one late.
+            "tick_dispatch": frozenset(
+                {base.streams * 4 + 1, base.streams * (windows // 2)}
+            ),
+            # One slow-tick stall mid-run, one consumer restart.
+            "slow_tick": frozenset({windows // 3}),
+            "consumer_restart": frozenset({(2 * windows) // 3}),
+        },
+        delay_s={"slow_tick": 0.2},
+        restart_gap_windows=2,
+    )
+    if control == "state-lost-signal":
+        base.disable_containment = "state_lost_signal"
+    elif control == "bounded-queues":
+        base.disable_containment = "bounded_queues"
+    elif control is not None:
+        raise ValueError(f"unknown control class {control!r}")
+    report = LoadHarness(base).run()
+    families = subtract(
+        parse_prometheus_text(report.pop("scrape_after")),
+        parse_prometheus_text(report.pop("scrape_before")),
+    )
+    return report, families
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Evaluate SLO rules against a /metrics scrape"
+    )
+    parser.add_argument("--url", help="live /metrics endpoint to scrape")
+    parser.add_argument(
+        "--metrics-file", help="path to a saved text-exposition payload"
+    )
+    parser.add_argument(
+        "--baseline",
+        help="earlier payload to subtract (counters/histograms evaluate "
+        "over the delta)",
+    )
+    parser.add_argument(
+        "--rules",
+        help="JSON rule file (default: scripts/slo_rules/default.json, "
+        "or smoke.json under --smoke)",
+    )
+    parser.add_argument(
+        "--smoke",
+        action="store_true",
+        help="run the in-process load+chaos harness and gate its delta "
+        "(the CI benchmark-smoke step)",
+    )
+    parser.add_argument(
+        "--control",
+        choices=["state-lost-signal", "bounded-queues"],
+        help="with --smoke: disable one containment class; CI asserts "
+        "the gate exits NON-ZERO on these runs",
+    )
+    parser.add_argument(
+        "--scale",
+        type=float,
+        default=0.5,
+        help="--smoke size factor vs the bench --slo scale",
+    )
+    parser.add_argument(
+        "--report", help="write the full JSON report to this path"
+    )
+    args = parser.parse_args(argv)
+
+    report: dict = {}
+    try:
+        if args.smoke:
+            import os
+
+            # CPU-pin BEFORE jax initializes (the bench/_smoke rule).
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            harness_report, families = _smoke_report(
+                args.control, args.scale
+            )
+            report["harness"] = harness_report
+            rules_path = Path(
+                args.rules or RULES_DIR / "smoke.json"
+            )
+        else:
+            if bool(args.url) == bool(args.metrics_file):
+                parser.error("need exactly one of --url / --metrics-file")
+            families = parse_prometheus_text(_load_payload(args))
+            if args.baseline:
+                families = subtract(
+                    families,
+                    parse_prometheus_text(Path(args.baseline).read_text()),
+                )
+            rules_path = Path(args.rules or RULES_DIR / "default.json")
+        rules = _load_rules(rules_path)
+    except Exception as err:
+        print(f"slo_gate: error: {err!r}", file=sys.stderr)
+        return 2
+
+    ok, results = evaluate(rules, families)
+    if args.smoke and args.control is None:
+        # A green gate over a chaos drill that injected nothing proves
+        # nothing: require the schedule actually fired.
+        injected = report.get("harness", {}).get("chaos_injected", {})
+        if not injected:
+            results.append(
+                {
+                    "name": "chaos_ran",
+                    "passed": False,
+                    "observed": 0,
+                    "detail": "chaos schedule fired no faults",
+                }
+            )
+            ok = False
+    report["rules"] = results
+    report["passed"] = ok
+    report["rules_file"] = str(rules_path)
+    if args.report:
+        Path(args.report).write_text(json.dumps(report, indent=2))
+    for row in results:
+        status = "PASS" if row["passed"] else "BREACH"
+        print(f"{status:6s} {row['name']}: {row['detail']}", file=sys.stderr)
+    print(json.dumps({k: v for k, v in report.items() if k != "harness"}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,6 +68,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from ..telemetry.e2e import observe_stage
 from ..telemetry.trace import TRACER
 from ..utils.profiling import StageTimer
 from .link_monitor import LinkMonitor, LinkPolicy
@@ -104,6 +105,12 @@ class PipelineWindow:
     #: this window records — across all three stage workers and the
     #: device layers — shares it, so a slow tick decomposes by phase.
     trace: int | None = None
+    #: Source data timestamp (ns) of the newest message in this window
+    #: (ADR 0120): born at consume from ``MessageBatch.end``, it anchors
+    #: every ``livedata_e2e_latency_seconds`` boundary the window
+    #: crosses (decode/staged/published here; fanout/delivery in the
+    #: serving plane via ``JobResult.source_ts_ns``).
+    source_ts_ns: int | None = None
 
 
 class IngestPipeline:
@@ -198,6 +205,9 @@ class IngestPipeline:
         self._failure: BaseException | None = None
         self._timer = StageTimer()
         self._t_started = time.monotonic()
+        #: Fault-injection schedule (harness/chaos.py, ADR 0120): None
+        #: in production — every hook is a single attribute check.
+        self._chaos = None
         self.name = name
         self._workers = [
             threading.Thread(
@@ -236,6 +246,9 @@ class IngestPipeline:
         window = PipelineWindow(
             seq=-1, payload=payload, start=start, end=end,
             t_submit=time.monotonic(),
+            source_ts_ns=(
+                int(end.ns) if hasattr(end, "ns") else None
+            ),
         )
         with self._state_lock:
             while self._accepting and self._inflight >= self.depth:
@@ -296,6 +309,12 @@ class IngestPipeline:
             if self._flatten_pool is not None:
                 self._flatten_pool.shutdown(wait=False)
         return drained
+
+    def set_chaos(self, chaos) -> None:
+        """Install a fault-injection schedule (harness/chaos.py). The
+        hooks fire on the worker threads; the schedule's own seeded
+        draws keep runs reproducible."""
+        self._chaos = chaos
 
     # -- introspection -----------------------------------------------------
     @property
@@ -428,6 +447,12 @@ class IngestPipeline:
             TRACER.record(
                 "decode", t0, window.stage_s["decode"], window.trace
             )
+            observe_stage("decode", window.source_ts_ns)
+            if self._chaos is not None:
+                # Chaos site (ADR 0120): a stalled decode worker — the
+                # shape of a slow preprocessor or GC pause — backs the
+                # whole pipeline up into the submit gate.
+                self._chaos.maybe_delay("decode_stall")
             if not self._put(self._stage_q, window):
                 return
 
@@ -462,6 +487,7 @@ class IngestPipeline:
             TRACER.record(
                 "prestage", t0, window.stage_s["stage"], window.trace
             )
+            observe_stage("staged", window.source_ts_ns)
             if not self._put(self._step_q, window):
                 return
 
@@ -492,6 +518,9 @@ class IngestPipeline:
                     if window.results:
                         with TRACER.span("sink", window.trace):
                             self._publish(window.results, window.end)
+                        # "published" means results actually left: an
+                        # empty window (no jobs due) records nothing.
+                        observe_stage("published", window.source_ts_ns)
                 # Publish-stage time here is sink serialization only:
                 # the RTT observation moved to the device round trip
                 # itself (JobManager times every combined execute+fetch

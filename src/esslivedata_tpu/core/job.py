@@ -17,6 +17,7 @@ import numpy as np
 from pydantic import BaseModel, Field
 
 from ..config.workflow_spec import JobId, JobSchedule, ResultKey, WorkflowId
+from ..telemetry.health import HEALTH
 from ..utils.compat import StrEnum
 from ..utils.labeled import DataArray, Variable
 from ..workflows.workflow_factory import Workflow
@@ -150,6 +151,17 @@ class JobResult:
         #: The producing job's state generation at finalize (see
         #: ``Job.state_epoch``) — the fan-out tier's epoch signal.
         self.state_epoch = state_epoch
+
+    @property
+    def source_ts_ns(self) -> int | None:
+        """The source timestamp this result answers for (ADR 0120):
+        the window-end data time — the ev44 reference time / payload
+        timestamp of the newest message folded into these outputs.
+        Every e2e latency boundary downstream of finalize (publish,
+        fan-out encode, subscriber delivery) measures against it; None
+        for windows that carried no data time (empty finishing-job
+        flushes)."""
+        return None if self.end is None else int(self.end.ns)
 
     def keys(self) -> list[ResultKey]:
         return [
@@ -321,8 +333,11 @@ class Job:
         failed after consuming the buffers and the JobManager reset the
         accumulator, ADR 0113/0114): downstream delta streams must
         keyframe — the next published frame does not continue the
-        previous one."""
+        previous one. Also feeds the process health latch (ADR 0120):
+        /healthz reports degraded for an interval after a loss, and
+        ``livedata_state_lost_total`` counts the rate."""
         self.state_epoch += 1
+        HEALTH.note_state_lost()
 
     @property
     def generation_start_ns(self) -> int | None:

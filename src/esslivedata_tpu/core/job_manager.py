@@ -235,6 +235,9 @@ class JobManager:
         #: keys and compile them off the hot path before the change
         #: goes live.
         self._warmup = None
+        #: Fault-injection schedule (harness/chaos.py, ADR 0120);
+        #: None in production.
+        self._chaos = None
         #: Last seen padded batch size per stream — the staged-signature
         #: memory warm-up plans against (a tick program's key includes
         #: the staged wire's shape, and commit-time warm-up must
@@ -391,6 +394,16 @@ class JobManager:
         commits, removals and wire flips submit tick-program warm-up
         requests through it."""
         self._warmup = service
+
+    def set_chaos(self, chaos) -> None:
+        """Install a fault-injection schedule (harness/chaos.py,
+        ADR 0120). Two sites: ``slow_tick`` delays a window before any
+        lock is taken (a slow-tick storm, the watchdog's prey), and
+        ``tick_dispatch`` raises AFTER a tick program's dispatch ran —
+        the post-donation failure mode, exercising the exact
+        ``note_state_lost`` containment the live failure would. None
+        (production) costs one attribute check per window."""
+        self._chaos = chaos
 
     @property
     def reset_seq(self) -> int:
@@ -1138,6 +1151,13 @@ class JobManager:
                     ingest0.hist, key, staged, requests,
                     slice_key=slice_key,
                 )
+                if self._chaos is not None:
+                    # Chaos site (ADR 0120): the dispatch RAN — donated
+                    # member buffers are consumed — and then "fails".
+                    # The containment below sees exactly what a real
+                    # post-donation XLA failure produces: consumed args,
+                    # no adoptable results, note_state_lost + re-seed.
+                    self._chaos.check("tick_dispatch")
             except Exception:
                 # The combiner contains plan/dispatch/unpack failures
                 # per member; anything escaping is a combiner bug — it
@@ -1446,6 +1466,12 @@ class JobManager:
         never pays staging time for — another job's streams.
         """
         context = context or {}
+        if self._chaos is not None:
+            # Chaos site (ADR 0120): a slow-tick storm. BEFORE the
+            # manager lock — the injected stall models slow device/host
+            # work, not a lock convoy (and a sleep under the lock would
+            # stall scrape-time collectors, the JGL023 class).
+            self._chaos.maybe_delay("slow_tick")
         with self._lock:
             # Warm-up shape memory (ADR 0118): the padded batch size
             # each stream carries is the staged-signature dimension of

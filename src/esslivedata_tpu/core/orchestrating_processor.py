@@ -20,6 +20,7 @@ from typing import Any
 
 from ..config.acknowledgement import CommandAcknowledgement
 from ..core.preprocessor import PreprocessorFactory
+from ..telemetry.e2e import observe_stage
 from ..telemetry.trace import TRACER
 from .command_dispatcher import CommandDispatcher
 from .job_manager import JobManager
@@ -565,6 +566,12 @@ class OrchestratingProcessor:
         # span names line up across both ingest modes (no prestage
         # span here — the serial loop stages at step time).
         trace_id = TRACER.new_trace()
+        # The e2e anchor (ADR 0120): the window-end data time, same
+        # birth point as PipelineWindow.source_ts_ns ("staged" is
+        # pipelined-only — this loop stages at step time).
+        source_ts_ns = (
+            int(batch.end.ns) if hasattr(batch.end, "ns") else None
+        )
         t_start = time.monotonic()
         with self.stage_timer.stage("preprocess"), TRACER.span(
             "decode", trace_id
@@ -573,6 +580,7 @@ class OrchestratingProcessor:
             window = self._preprocessor.collect_window()
             context = self._preprocessor.collect_context()
             fresh_context = self._preprocessor.fresh_context_names()
+        observe_stage("decode", source_ts_ns)
         self._record_lag(batch)
         with self.stage_timer.stage("process_jobs"), TRACER.bind(trace_id):
             results = self._job_manager.process_jobs(
@@ -587,6 +595,10 @@ class OrchestratingProcessor:
                 "sink", trace_id
             ):
                 self._publish_results(results, batch.end)
+            if results:
+                # "published" means results actually left: a window
+                # with no due jobs records nothing.
+                observe_stage("published", source_ts_ns)
         finally:
             self._preprocessor.release()
             TRACER.finish_tick(trace_id, time.monotonic() - t_start)

@@ -1,0 +1,21 @@
+"""Production-traffic harness (ADR 0120): deterministic chaos + load.
+
+The serving stack asserts mechanism invariants (1 dispatch/tick, flat
+fan-out bytes) all over its test suite; this package asserts the
+*product* under adversity. ``chaos`` is the seeded fault-injection
+schedule the JobManager / ingest pipeline / broadcast hub consult;
+``load`` drives fake producers and simulated SSE subscribers through
+the real serving path and reports the SLO surface
+(``scripts/slo_gate.py`` evaluates it, ``bench.py --slo`` grades it).
+"""
+
+from .chaos import ChaosError, ChaosSchedule, ChaosSpec
+from .load import LoadConfig, LoadHarness
+
+__all__ = [
+    "ChaosError",
+    "ChaosSchedule",
+    "ChaosSpec",
+    "LoadConfig",
+    "LoadHarness",
+]

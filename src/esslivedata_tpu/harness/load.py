@@ -1,0 +1,526 @@
+"""Deterministic load harness for the SLO plane (ADR 0120).
+
+Drives the REAL serving path — ``JobManager`` tick programs into a
+``ServingPlane`` broadcast hub — under production-shaped load and a
+seeded chaos schedule, then hands the scrape to the SLO checker
+(``scripts/slo_gate.py``). The pieces:
+
+- **Fake producers**: S distinct streams, K detector-view jobs per
+  stream, every window stamped with a REAL wall-clock source timestamp
+  (the e2e latency boundaries measure against it; synthetic tiny
+  timestamps would land every sample in the +Inf bucket).
+- **Simulated SSE subscribers**: N consumers attached through the same
+  ``BroadcastServer.subscribe`` the socket handler uses, with
+  heavy-tailed consume periods (Pareto-drawn: most drain every window,
+  a tail drains rarely) plus a deterministic wedged subset that stops
+  consuming entirely and un-wedges late — the coalesce/QoS axes under
+  real pressure. Subscribers are driven SYNCHRONOUSLY from the window
+  loop: determinism is the point (a chaos run is a test, not a race),
+  and the concurrent-consumer paths have their own suites.
+- **Verification as metrics**: every checker subscriber byte-compares
+  its reconstruction against the sink serializer's exact da00 wire
+  (``livedata_slo_parity_*``); every cumulative-counts stream is
+  watched for an **unsignaled reset** — decoded counts dropping with
+  no epoch bump, the ADR 0117 discipline violation
+  (``livedata_slo_gap_violations_total``); every coalesced-then-drained
+  subscriber must recover the exact latest frame
+  (``livedata_slo_coalesce_recoveries_total``). The SLO rule file
+  gates on these counters, which is what makes "the chaos scenario
+  passed" a scrapeable fact instead of a log line.
+
+``disable_containment`` exists for the CONTROL run the acceptance
+demands — proving the gate goes red when a containment is off:
+
+- ``"state_lost_signal"``: ``Job.note_state_lost`` is patched to a
+  no-op for the run, so an injected post-donation failure still resets
+  the accumulation but never bumps ``state_epoch`` — subscribers see a
+  reset spliced into the delta stream and the gap counter goes
+  non-zero.
+- ``"bounded_queues"``: the hub is built with an effectively unbounded
+  per-subscriber queue, so wedged subscribers grow their backlog
+  instead of coalescing — the queue-depth SLO breaches.
+
+Scrapes: :meth:`LoadHarness.run` snapshots the registry AFTER the warm
+windows and again at the end; the gate evaluates the DELTA, so warm-up
+compiles and whatever ran earlier in the process can never pollute the
+gated phase.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from random import Random
+from typing import Any
+
+import numpy as np
+
+from ..telemetry.e2e import observe_stage
+from ..telemetry.health import HEALTH
+from ..telemetry.registry import REGISTRY
+from .chaos import ChaosSchedule, ChaosSpec
+
+__all__ = ["LoadConfig", "LoadHarness"]
+
+logger = logging.getLogger(__name__)
+
+#: Verification counters the SLO rules gate on (see module docstring).
+PARITY_CHECKS = REGISTRY.counter(
+    "livedata_slo_parity_checks",
+    "Checker-subscriber reconstructions byte-compared against the "
+    "sink da00 wire",
+)
+PARITY_VIOLATIONS = REGISTRY.counter(
+    "livedata_slo_parity_violations",
+    "Checker reconstructions that did NOT byte-match the sink wire",
+)
+GAP_VIOLATIONS = REGISTRY.counter(
+    "livedata_slo_gap_violations",
+    "Unsignaled resets observed by subscribers: decoded cumulative "
+    "counts dropped with no epoch bump (ADR 0117 discipline breach)",
+)
+COALESCE_RECOVERIES = REGISTRY.counter(
+    "livedata_slo_coalesce_recoveries",
+    "Coalesced (wedged/slow) subscribers that recovered the exact "
+    "latest frame from their resync keyframe",
+)
+WINDOWS_DRIVEN = REGISTRY.counter(
+    "livedata_slo_windows",
+    "Windows the load harness drove through the serving path",
+)
+PEAK_QUEUE_DEPTH = REGISTRY.gauge(
+    "livedata_slo_peak_queue_depth",
+    "Highest per-subscriber send-queue depth observed across the run "
+    "— the bounded-queue SLO gates it at the configured queue limit "
+    "(a scrape-time gauge can miss the peak; the harness samples "
+    "after every publish)",
+)
+
+
+@dataclass
+class LoadConfig:
+    """Harness shape; defaults are the bench ``--slo`` scale, shrink
+    for smoke (``scripts/slo_gate.py --smoke`` uses ~half)."""
+
+    streams: int = 4
+    jobs_per_stream: int = 2
+    subscribers: int = 240
+    windows: int = 48
+    warm_windows: int = 3
+    events_per_window: int = 2048
+    pixels: int = 1 << 12  # side^2 clamp — sparse frames, delta regime
+    queue_limit: int = 8
+    seed: int = 7
+    #: Pareto tail index for consume periods: ~alpha=1.2 gives mostly
+    #: period-1 consumers with a long slow tail.
+    heavy_tail_alpha: float = 1.2
+    #: Every Nth subscriber wedges (consumes nothing) until 2/3 of the
+    #: run, then drains and must recover exactly.
+    wedge_every: int = 7
+    chaos: ChaosSpec | None = None
+    #: None | "state_lost_signal" | "bounded_queues" — the acceptance
+    #: control runs (see module docstring). Production containment is
+    #: NEVER touched outside this harness.
+    disable_containment: str | None = None
+
+    def scaled(self, factor: float) -> "LoadConfig":
+        """A smaller copy for smoke budgets (chaos spec untouched —
+        explicit ticks must stay inside the window count, so smoke
+        specs are built against the scaled count)."""
+        cfg = LoadConfig(**{**self.__dict__})
+        cfg.subscribers = max(8, int(self.subscribers * factor))
+        cfg.windows = max(16, int(self.windows * factor))
+        cfg.events_per_window = max(256, int(self.events_per_window * factor))
+        return cfg
+
+
+@dataclass
+class _SimSubscriber:
+    """One simulated SSE consumer (driven synchronously)."""
+
+    sub: Any  # serving.broadcast.Subscription
+    stream: str
+    period: int  # drain every Nth window
+    wedged_until: int | None  # window index, None = never wedged
+    checker: bool  # byte-compares against the sink wire
+    decoder: Any = None  # DeltaDecoder, rebased lazily
+    frame: bytes | None = None
+    last_epoch: int | None = None
+    last_counts: float | None = None
+    was_coalesced: bool = False
+    #: Publishes this consumer slept through while wedged — once it
+    #: exceeds the queue limit the hub MUST have coalesced it.
+    missed: int = 0
+    delivered: int = 0
+
+
+class LoadHarness:
+    """Build once, :meth:`run` once; see module docstring."""
+
+    def __init__(self, config: LoadConfig | None = None) -> None:
+        self.config = config or LoadConfig()
+
+    # -- construction helpers ----------------------------------------------
+    def _build_manager(self):
+        from ..config import JobId, WorkflowConfig, WorkflowSpec
+        from ..core.job_manager import JobFactory, JobManager
+        from ..workflows import WorkflowFactory
+        from ..workflows.detector_view import (
+            DetectorViewWorkflow,
+            project_logical,
+        )
+
+        cfg = self.config
+        side = int(np.sqrt(min(cfg.pixels, 1 << 14)))
+        det = np.arange(side * side).reshape(side, side)
+        reg = WorkflowFactory()
+        streams = [f"slo_stream_{i}" for i in range(cfg.streams)]
+        for stream in streams:
+            spec = WorkflowSpec(
+                instrument="slo", name=f"dv_{stream}", source_names=[stream]
+            )
+            reg.register_spec(spec).attach_factory(
+                lambda *, source_name, params: DetectorViewWorkflow(
+                    projection=project_logical(det)
+                )
+            )
+            self._specs[stream] = spec
+        mgr = JobManager(
+            job_factory=JobFactory(reg),
+            job_threads=min(4, cfg.streams * cfg.jobs_per_stream),
+        )
+        for stream in streams:
+            for _ in range(cfg.jobs_per_stream):
+                mgr.schedule_job(
+                    WorkflowConfig(
+                        identifier=self._specs[stream].identifier,
+                        job_id=JobId(source_name=stream),
+                    )
+                )
+        return mgr, streams, side
+
+    def _staged(self, rng: np.random.Generator, side: int):
+        from ..ops import EventBatch
+        from ..preprocessors.event_data import StagedEvents
+
+        cfg = self.config
+        n = min(cfg.events_per_window, max(256, (side * side) // 8))
+        pid = rng.integers(0, side * side, n, dtype=np.int64).astype(
+            np.int32
+        )
+        toa = rng.uniform(0, 7.0e7, n).astype(np.float32)
+        return StagedEvents(
+            batch=EventBatch.from_arrays(pid, toa),
+            first_timestamp=None,
+            last_timestamp=None,
+            n_chunks=1,
+        )
+
+    def _watch_list(self, streams_cached) -> list[str]:
+        """The streams subscribers actually watch: real viewers
+        concentrate on a few dashboards, and the harness needs DEPTH
+        per stream (wedged + slow + checker on one stream is what
+        exercises coalescing), not one viewer per output. Cumulative
+        streams come first — they carry the gap-not-reset check."""
+        cumulative = [
+            s for s in streams_cached if s.endswith("/counts_cumulative")
+        ]
+        rest = [s for s in streams_cached if s not in set(cumulative)]
+        n_watch = max(
+            len(cumulative), min(len(streams_cached), self.config.subscribers // 8)
+        )
+        return (cumulative + rest)[:n_watch]
+
+    def _attach_subscribers(self, plane, streams_cached) -> None:
+        cfg = self.config
+        rng = Random(cfg.seed ^ 0x5105)
+        watch = self._watch_list(streams_cached)
+        per_stream_checker: set[str] = set()
+        for i in range(cfg.subscribers):
+            stream = watch[i % len(watch)]
+            checker = stream not in per_stream_checker
+            per_stream_checker.add(stream)
+            period = (
+                1
+                if checker
+                else max(1, min(16, int(rng.paretovariate(cfg.heavy_tail_alpha))))
+            )
+            wedged_until = None
+            if not checker and cfg.wedge_every and i % cfg.wedge_every == 0:
+                wedged_until = (cfg.windows * 2) // 3
+            self._subs.append(
+                _SimSubscriber(
+                    sub=plane.server.subscribe(stream),
+                    stream=stream,
+                    period=period,
+                    wedged_until=wedged_until,
+                    checker=checker,
+                )
+            )
+
+    # -- subscriber drive ---------------------------------------------------
+    def _drain(self, sim: _SimSubscriber, reference: dict[str, bytes]) -> None:
+        """Drain everything queued for one subscriber and fold the
+        verification counters (parity, gap-not-reset, coalesce
+        recovery). Synchronous: publish already happened, so ``depth``
+        is exact and an empty queue costs no timeout wait."""
+        from ..kafka.wire import decode_da00
+        from .. import serving
+
+        got_any = False
+        while sim.sub.depth() > 0:
+            blob = sim.sub.next_blob(timeout=1.0)
+            if blob is None:  # pragma: no cover - depth>0 guarantees one
+                break
+            got_any = True
+            sim.delivered += 1
+            header = serving.decode_header(blob)
+            if sim.decoder is None:
+                sim.decoder = serving.DeltaDecoder()
+            try:
+                sim.frame = sim.decoder.apply(blob)
+            except serving.DeltaError:
+                # A gap after coalesce resolves at the resync keyframe;
+                # rebase and keep consuming.
+                sim.decoder = serving.DeltaDecoder()
+                if header.keyframe:
+                    sim.frame = sim.decoder.apply(blob)
+                else:
+                    continue
+            # Gap-not-reset (ADR 0117): cumulative counts may only
+            # drop when the epoch bumped (signaled reset/state-loss).
+            if sim.stream.endswith("/counts_cumulative") and sim.frame:
+                msg = decode_da00(sim.frame)
+                signal = next(
+                    (v for v in msg.variables if v.name == "signal"), None
+                )
+                if signal is not None:
+                    counts = float(np.asarray(signal.data).sum())
+                    if (
+                        sim.last_counts is not None
+                        and counts < sim.last_counts - 1e-9
+                        and header.epoch == sim.last_epoch
+                    ):
+                        GAP_VIOLATIONS.inc()
+                    sim.last_counts = counts
+                    sim.last_epoch = header.epoch
+        if got_any and sim.was_coalesced and sim.stream in reference:
+            # A coalesced consumer's first full drain must land on the
+            # exact latest frame (resync keyframe + later deltas).
+            if sim.frame == reference[sim.stream]:
+                COALESCE_RECOVERIES.inc()
+            sim.was_coalesced = False
+        if got_any and sim.checker and sim.stream in reference:
+            PARITY_CHECKS.inc()
+            if sim.frame != reference[sim.stream]:
+                PARITY_VIOLATIONS.inc()
+
+    # -- the run -------------------------------------------------------------
+    def run(self) -> dict:
+        """Drive the configured load + chaos; returns the report dict
+        (scrapes included) the SLO gate and ``bench.py --slo`` consume."""
+        from ..core.job import Job
+        from ..core.timestamp import Timestamp
+        from ..kafka.da00_compat import dataarray_to_da00
+        from ..kafka.wire import encode_da00
+        from ..serving import ServingPlane, stream_key
+        from ..serving.broadcast import SERVING_COALESCE_DROPS
+        from ..telemetry.compile import COMPILE_EVENTS
+        from ..telemetry.exposition import render_text
+
+        cfg = self.config
+        self._specs: dict[str, Any] = {}
+        self._subs: list[_SimSubscriber] = []
+        chaos = (
+            ChaosSchedule(cfg.chaos) if cfg.chaos is not None else None
+        )
+        queue_limit = cfg.queue_limit
+        if cfg.disable_containment == "bounded_queues":
+            # CONTROL: wedged consumers buffer instead of coalescing.
+            queue_limit = 1 << 17
+        mgr, streams, side = self._build_manager()
+        plane = ServingPlane(port=None, queue_limit=queue_limit)
+        if chaos is not None:
+            # Subscriptions capture the schedule at attach, so the hub
+            # gets it before subscribers exist; the MANAGER gets it
+            # only after the warm windows (a drill starts at steady
+            # state — and explicit `at` ticks count steady
+            # consultations, not warm-up ones).
+            plane.server.set_chaos(chaos)
+        patched_note = None
+        if cfg.disable_containment == "state_lost_signal":
+            # CONTROL: the containment still resets state, but the
+            # epoch signal never fires — downstream MUST catch it.
+            patched_note = Job.note_state_lost
+            Job.note_state_lost = lambda self: None  # type: ignore[method-assign]
+        rng = np.random.default_rng(cfg.seed)
+        reference: dict[str, bytes] = {}
+        report: dict[str, Any] = {}
+        try:
+            # Warm phase: programs compile, statics fetch, hub learns
+            # the streams; the configured chaos does NOT run here (a
+            # drill starts at steady state) — but when chaos is
+            # configured, the warm-up ALSO fails each tick group once,
+            # one group per window, so the failover path (that group's
+            # members re-publishing alone through the combined-publish
+            # combiner after note_state_lost — a member-tuple jit key
+            # of its own) is compiled before the gated phase. The
+            # compiles=0 SLO covers the failure path too: a containment
+            # that pays a jit compile mid-incident blows the very p99
+            # it exists to protect.
+            warm_windows = cfg.warm_windows
+            if cfg.chaos is not None:
+                warm_windows = max(warm_windows, cfg.streams + 2)
+                # Window 1..streams: consultation (w-1)*streams + g
+                # fires where g == w-1 — exactly one group per window.
+                warm_poison = ChaosSchedule(
+                    ChaosSpec(
+                        at={
+                            "tick_dispatch": frozenset(
+                                k * (cfg.streams + 1)
+                                for k in range(cfg.streams)
+                            )
+                        }
+                    )
+                )
+            for w in range(warm_windows):
+                if cfg.chaos is not None:
+                    mgr.set_chaos(
+                        warm_poison if 1 <= w <= cfg.streams else None
+                    )
+                ts = time.time_ns()
+                window = {s: self._staged(rng, side) for s in streams}
+                mgr.process_jobs(
+                    window,
+                    start=Timestamp.from_ns(ts),
+                    end=Timestamp.from_ns(ts),
+                )
+            mgr.set_chaos(None)
+            ts = time.time_ns()
+            out = mgr.process_jobs(
+                {s: self._staged(rng, side) for s in streams},
+                start=Timestamp.from_ns(ts),
+                end=Timestamp.from_ns(ts),
+            )
+            plane.publish_results(out, Timestamp.from_ns(ts))
+            streams_cached = sorted(plane.cache.streams())
+            if not streams_cached:
+                raise RuntimeError("no streams cached after warm windows")
+            self._attach_subscribers(plane, streams_cached)
+            for sim in self._subs:
+                self._drain(sim, reference)  # attach keyframes
+            compiles_warm = COMPILE_EVENTS.total()
+            drops_before = SERVING_COALESCE_DROPS.total()
+            parity_checks0 = PARITY_CHECKS.total()
+            parity_bad0 = PARITY_VIOLATIONS.total()
+            gaps0 = GAP_VIOLATIONS.total()
+            recov0 = COALESCE_RECOVERIES.total()
+            scrape_before = render_text(REGISTRY.collect())
+            if chaos is not None:
+                mgr.set_chaos(chaos)
+            t_run = time.perf_counter()
+
+            pause = 0
+            paused_windows = 0
+            peak_depth = 0
+            for w in range(cfg.windows):
+                if pause > 0:
+                    # Consumer restarting: no messages arrive. Data
+                    # time keeps advancing; accumulation must resume
+                    # with a gap, never a reset.
+                    pause -= 1
+                    paused_windows += 1
+                    continue
+                if chaos is not None and chaos.fires("consumer_restart"):
+                    pause = cfg.chaos.restart_gap_windows
+                # "Consume": the window's source timestamp is born.
+                source_ts = time.time_ns()
+                observe_stage("consume", source_ts, now_ns=source_ts)
+                window = {s: self._staged(rng, side) for s in streams}
+                observe_stage("decode", source_ts)
+                end = Timestamp.from_ns(source_ts)
+                out = mgr.process_jobs(window, start=end, end=end)
+                # The sink serializer's exact bytes — the parity oracle
+                # (and the "sink publish" the plane mirrors).
+                for res in out:
+                    job = (
+                        f"{res.job_id.source_name}:{res.job_id.job_number}"
+                    )
+                    for key, da in zip(
+                        res.keys(), res.outputs.values(), strict=True
+                    ):
+                        reference[stream_key(job, key.output_name)] = (
+                            encode_da00(
+                                key.to_string(),
+                                source_ts,
+                                dataarray_to_da00(da),
+                            )
+                        )
+                observe_stage("published", source_ts)
+                plane.publish_results(out, end)
+                WINDOWS_DRIVEN.inc()
+                peak_depth = max(
+                    peak_depth,
+                    max(sim.sub.depth() for sim in self._subs),
+                )
+                for sim in self._subs:
+                    wedged = (
+                        sim.wedged_until is not None and w < sim.wedged_until
+                    )
+                    if wedged:
+                        sim.missed += 1
+                        if sim.missed > queue_limit:
+                            # More publishes than its queue holds: the
+                            # hub coalesced this consumer (or, in the
+                            # bounded_queues CONTROL, buffered — the
+                            # depth rule catches that).
+                            sim.was_coalesced = True
+                        continue
+                    if w % sim.period == 0 or sim.wedged_until == w:
+                        sim.missed = 0
+                        self._drain(sim, reference)
+            wall_s = time.perf_counter() - t_run
+            # Final full drain: every consumer ends at the last frame.
+            for sim in self._subs:
+                self._drain(sim, reference)
+            steady_compiles = COMPILE_EVENTS.total() - compiles_warm
+            PEAK_QUEUE_DEPTH.set(peak_depth)
+            qos = plane.qos()
+            report = {
+                "streams": cfg.streams,
+                "jobs": cfg.streams * cfg.jobs_per_stream,
+                "subscribers": cfg.subscribers,
+                "windows": cfg.windows,
+                "paused_windows": paused_windows,
+                "events_per_window": cfg.events_per_window,
+                "wall_ms_per_window": 1e3 * wall_s / max(1, cfg.windows),
+                "chaos_injected": (
+                    chaos.injected() if chaos is not None else {}
+                ),
+                "parity_checks": PARITY_CHECKS.total() - parity_checks0,
+                "parity_violations": (
+                    PARITY_VIOLATIONS.total() - parity_bad0
+                ),
+                "gap_violations": GAP_VIOLATIONS.total() - gaps0,
+                "coalesce_drops": (
+                    SERVING_COALESCE_DROPS.total() - drops_before
+                ),
+                "coalesce_recoveries": (
+                    COALESCE_RECOVERIES.total() - recov0
+                ),
+                "steady_compiles": steady_compiles,
+                "peak_queue_depth": peak_depth,
+                "queue_limit": queue_limit,
+                "queue_pressure": qos["queue_pressure"],
+                "healthz": HEALTH.healthz(),
+                "disable_containment": cfg.disable_containment,
+                "scrape_before": scrape_before,
+                "scrape_after": render_text(REGISTRY.collect()),
+            }
+        finally:
+            if patched_note is not None:
+                Job.note_state_lost = patched_note  # type: ignore[method-assign]
+            mgr.shutdown()
+            plane.close()
+        return report

@@ -25,6 +25,7 @@ import numpy as np
 from ..config.workflow_spec import WorkflowConfig
 from ..core.message import Message, RunStart, RunStop, StreamId, StreamKind
 from ..core.timestamp import Timestamp
+from ..telemetry.e2e import observe_stage
 from ..preprocessors.event_data import DetectorEvents, MonitorEvents
 from ..preprocessors.to_nxlog import LogData
 from . import wire
@@ -398,12 +399,19 @@ class AdaptingMessageSource:
             # timestamp is a production time; run-control/command timestamps
             # are schedule times, possibly far in the past by design.
             if m.stream.kind in _LAG_TRACKED_KINDS:
+                now_ns = time.time_ns()
                 self._counter.record_lag(
                     topic,
                     m.stream.name,
                     m.stream.kind.value,
-                    (time.time_ns() - m.timestamp.ns) / 1e9,
+                    (now_ns - m.timestamp.ns) / 1e9,
                 )
+                # The e2e birth boundary (ADR 0120): the source
+                # timestamp — ev44 reference time / payload time, just
+                # extracted by the adapter — measured against the wall
+                # clock AT CONSUME. Everything the later stages add on
+                # top of this is the service's own latency.
+                observe_stage("consume", m.timestamp.ns, now_ns=now_ns)
 
     def get_messages(self) -> list[Message]:
         out: list[Message] = []

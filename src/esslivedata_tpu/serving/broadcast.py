@@ -50,6 +50,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import unquote
 
+from ..telemetry.e2e import observe_stage
 from ..telemetry.registry import REGISTRY, MetricFamily, Sample
 from .delta import DeltaEncoder, decode_header, encode_keyframe
 from .result_cache import ResultCache
@@ -88,37 +89,62 @@ class Subscription:
     The queue is the ONLY hand-off between the publish hook and the
     consumer thread; it is bounded (coalesce-on-overflow, see module
     docstring) and drained with timeouts, so neither side can park
-    forever (graftlint JGL010 discipline).
+    forever (graftlint JGL010 discipline). Entries are
+    ``(blob, source_ts_ns)`` pairs internally: the source timestamp
+    rides along so dequeue can fold the ``subscriber_delivered`` e2e
+    boundary in (ADR 0120) — the blob wire itself is untouched.
     """
 
-    __slots__ = ("stream", "sub_id", "_queue", "delivered")
+    __slots__ = ("stream", "sub_id", "_queue", "delivered", "chaos")
 
-    def __init__(self, stream: str, sub_id: int, limit: int) -> None:
+    def __init__(
+        self, stream: str, sub_id: int, limit: int, chaos=None
+    ) -> None:
         self.stream = stream
         self.sub_id = sub_id
-        self._queue: queue.Queue[bytes] = queue.Queue(maxsize=limit)
+        self._queue: queue.Queue[tuple[bytes, int | None]] = queue.Queue(
+            maxsize=limit
+        )
         #: Blobs enqueued to this subscriber (hub-lock-guarded).
         self.delivered = 0
+        #: Fault-injection schedule (harness/chaos.py): a fired
+        #: ``subscriber_stall`` delays THIS consumer's dequeue — the
+        #: slow-reader shape the coalesce path exists for.
+        self.chaos = chaos
 
     def next_blob(self, timeout: float = 0.5) -> bytes | None:
         """The next blob, or None after ``timeout`` — callers loop and
         re-check their stop condition (never an untimeboxed park)."""
+        blob, _ts = self.next_blob_meta(timeout=timeout)
+        return blob
+
+    def next_blob_meta(
+        self, timeout: float = 0.5
+    ) -> tuple[bytes | None, int | None]:
+        """:meth:`next_blob` plus the blob's source timestamp (ns) —
+        the SSE handler emits it as frame metadata. Dequeue is the
+        ``subscriber_delivered`` boundary: the consumer owns the frame
+        from here, whatever it does with it next."""
+        if self.chaos is not None:
+            self.chaos.maybe_delay("subscriber_stall")
         try:
-            return self._queue.get(timeout=timeout)
+            blob, ts = self._queue.get(timeout=timeout)
         except queue.Empty:
-            return None
+            return None, None
+        observe_stage("subscriber_delivered", ts)
+        return blob, ts
 
     def depth(self) -> int:
         return self._queue.qsize()
 
     # -- hub side (caller holds the hub lock) ------------------------------
-    def _offer(self, blob: bytes, resync_keyframe) -> bool:
+    def _offer(self, blob: bytes, resync_keyframe, ts: int | None) -> bool:
         """Enqueue ``blob``; on overflow drop the backlog and enqueue a
         fresh keyframe instead (``resync_keyframe`` is a thunk so the
         keyframe encodes at most once per publish no matter how many
         subscribers overflowed). Returns False when coalesced."""
         try:
-            self._queue.put_nowait(blob)
+            self._queue.put_nowait((blob, ts))
             return True
         except queue.Full:
             while True:
@@ -127,7 +153,7 @@ class Subscription:
                 except queue.Empty:
                     break
             try:
-                self._queue.put_nowait(resync_keyframe())
+                self._queue.put_nowait((resync_keyframe(), ts))
             except queue.Full:  # pragma: no cover - limit >= 1 by ctor
                 pass
             return False
@@ -158,6 +184,13 @@ class BroadcastServer:
         #: (single-writer contract, serving/delta.py); subscriber attach
         #: reads keyframes from the cache, never from here.
         self._encoders: dict[str, DeltaEncoder] = {}
+        #: Last published source timestamp per stream (hub-lock-guarded):
+        #: attach keyframes inherit it, and the scrape-time freshness
+        #: collector reads it (ADR 0120).
+        self._last_source_ts: dict[str, int] = {}
+        #: Fault-injection schedule handed to new subscriptions
+        #: (harness/chaos.py); None in production.
+        self._chaos = None
         self._stopped = threading.Event()
         self._registry = registry
         self._collector_key = f"serving:{name}"
@@ -198,6 +231,12 @@ class BroadcastServer:
     def stopped(self) -> bool:
         return self._stopped.is_set()
 
+    def set_chaos(self, chaos) -> None:
+        """Install a fault-injection schedule (harness/chaos.py) handed
+        to every LATER subscription — existing consumers keep running
+        clean, which is exactly how a partial-outage drill looks."""
+        self._chaos = chaos
+
     # -- hub ---------------------------------------------------------------
     def subscribe(self, stream: str) -> Subscription:
         """Attach a consumer; a keyframe of the latest cached tick is
@@ -208,14 +247,18 @@ class BroadcastServer:
         with self._lock:
             sub_id = self._next_sub_id
             self._next_sub_id += 1
-            sub = Subscription(stream, sub_id, self._queue_limit)
+            sub = Subscription(
+                stream, sub_id, self._queue_limit, chaos=self._chaos
+            )
             self._subscribers.setdefault(stream, {})[sub_id] = sub
             cached = self.cache.latest(stream)
             if cached is not None:
                 blob = encode_keyframe(
                     cached.frame, epoch=cached.epoch, seq=cached.seq
                 )
-                sub._offer(blob, lambda: blob)
+                sub._offer(
+                    blob, lambda: blob, self._last_source_ts.get(stream)
+                )
                 sub.delivered += 1
                 self._frames_key.inc()
                 self._bytes_key.inc(len(blob))
@@ -229,11 +272,16 @@ class BroadcastServer:
                 if not subs:
                     del self._subscribers[sub.stream]
 
-    def publish_frame(self, stream: str, frame: bytes, token) -> None:
+    def publish_frame(
+        self, stream: str, frame: bytes, token, source_ts_ns: int | None = None
+    ) -> None:
         """One publish tick for one stream: cache it, delta-encode it
         once, fan the blob out to every attached subscriber's bounded
         queue. Called from the service's publish hook (step worker) —
-        everything here is host-side O(frame) + O(subscribers)."""
+        everything here is host-side O(frame) + O(subscribers).
+        ``source_ts_ns`` (ADR 0120) rides each queue entry so dequeue
+        records delivery freshness, and feeds the per-stream freshness
+        gauges the scrape collector exposes."""
         cached = self.cache.put(stream, frame, token)
         encoder = self._encoders.get(stream)
         if encoder is None:
@@ -259,11 +307,13 @@ class BroadcastServer:
         frames_child = self._frames_key if is_keyframe else self._frames_delta
         bytes_child = self._bytes_key if is_keyframe else self._bytes_delta
         with self._lock:
+            if source_ts_ns is not None:
+                self._last_source_ts[stream] = int(source_ts_ns)
             subs = self._subscribers.get(stream)
             if not subs:
                 return
             for sub in subs.values():
-                delivered = sub._offer(blob, resync_keyframe)
+                delivered = sub._offer(blob, resync_keyframe, source_ts_ns)
                 sub.delivered += 1
                 if delivered:
                     frames_child.inc()
@@ -274,10 +324,15 @@ class BroadcastServer:
                     self._bytes_key.inc(len(resync_keyframe()))
 
     def drop_stream(self, stream: str) -> None:
-        """Forget a retired stream (job removed): cache entry and
-        encoder state go; attached subscribers simply stop receiving."""
+        """Forget a retired stream (job removed): cache entry, encoder
+        state and freshness entry go; attached subscribers simply stop
+        receiving. (Dropping the freshness entry matters: a dead
+        stream's gauge would otherwise read ever-staler forever —
+        and pin the label set, the JGL025 cardinality leak.)"""
         self.cache.invalidate(stream)
         self._encoders.pop(stream, None)
+        with self._lock:
+            self._last_source_ts.pop(stream, None)
 
     def drop_job(self, job: str) -> int:
         """Forget every stream of one retired job (the JobManager's
@@ -327,6 +382,14 @@ class BroadcastServer:
             "Per-subscriber send-queue depth (bounded at queue_limit; "
             "overflow coalesces to a keyframe instead of growing)",
         )
+        fresh_fam = MetricFamily(
+            "livedata_result_freshness_seconds",
+            "gauge",
+            "Wall-clock age of the newest published source timestamp "
+            "per (job, output) stream (ADR 0120): how stale a viewer "
+            "attaching NOW would be",
+        )
+        now_ns = time.time_ns()
         base = (("server", self._name),)
         with self._lock:
             total = 0
@@ -347,10 +410,19 @@ class BroadcastServer:
                             sub.depth(),
                         )
                     )
+            for stream, ts in sorted(self._last_source_ts.items()):
+                job, _, output = stream.partition("/")
+                fresh_fam.samples.append(
+                    Sample(
+                        "",
+                        base + (("job", job), ("output", output)),
+                        max(0.0, (now_ns - ts) / 1e9),
+                    )
+                )
         subs_fam.samples.append(
             Sample("", base + (("stream", "all"),), total)
         )
-        return [subs_fam, depth_fam]
+        return [subs_fam, depth_fam, fresh_fam]
 
     def close(self) -> None:
         self._stopped.set()
@@ -450,7 +522,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(b"retry: 3000\n\n")
             last_write = time.monotonic()
             while not hub.stopped:
-                blob = sub.next_blob(timeout=0.5)
+                blob, source_ts = sub.next_blob_meta(timeout=0.5)
                 if blob is None:
                     if time.monotonic() - last_write >= _KEEPALIVE_S:
                         self.wfile.write(b": keepalive\n\n")
@@ -459,9 +531,19 @@ class _Handler(BaseHTTPRequestHandler):
                     continue
                 header = decode_header(blob)
                 kind = b"keyframe" if header.keyframe else b"delta"
+                # Frame metadata (ADR 0120): the source timestamp as an
+                # SSE comment — EventSource clients ignore comments, so
+                # the data wire is unchanged, but a latency-aware
+                # client (the SLO harness, dashboards) reads its
+                # freshness without decoding da00.
+                meta = (
+                    b""
+                    if source_ts is None
+                    else b": source_ts_ns=%d\n" % source_ts
+                )
                 self.wfile.write(
-                    b"id: %d\nevent: %s\ndata: %s\n\n"
-                    % (header.seq, kind, base64.b64encode(blob))
+                    b"%sid: %d\nevent: %s\ndata: %s\n\n"
+                    % (meta, header.seq, kind, base64.b64encode(blob))
                 )
                 self.wfile.flush()
                 last_write = time.monotonic()

@@ -36,12 +36,17 @@ import numpy as np
 
 from ..kafka.da00_compat import dataarray_to_da00
 from ..kafka.wire import encode_da00
+from ..telemetry.e2e import observe_stage
 from .broadcast import BroadcastServer, stream_key
 from .result_cache import ResultCache
 
 __all__ = ["ServingPlane", "get_or_create_plane"]
 
 logger = logging.getLogger(__name__)
+
+#: getattr sentinel: "the result type has no source_ts_ns at all"
+#: (bespoke/test doubles) — distinct from a real None (no data time).
+_NO_SOURCE_TS = object()
 
 
 class ServingPlane:
@@ -79,11 +84,27 @@ class ServingPlane:
         here is bounded host work (one da00 encode + one delta encode
         per output, one bounded enqueue per subscriber)."""
         ts = timestamp.ns
+        window_source_ts: int | None = None
         for result in results:
             job = (
                 f"{result.job_id.source_name}:{result.job_id.job_number}"
             )
             state_epoch = getattr(result, "state_epoch", 0)
+            # The e2e anchor rides the result (ADR 0120). Distinguish
+            # "bespoke result object without the attribute" (fall back
+            # to the publish data timestamp) from a real JobResult
+            # whose window carried NO data time (source_ts_ns is None):
+            # the latter must stay None — an invented latency is worse
+            # than a missing sample (telemetry/e2e.py), and the
+            # freshness gauge must not report a dataless flush as
+            # perfectly fresh.
+            source_ts = getattr(result, "source_ts_ns", _NO_SOURCE_TS)
+            if source_ts is _NO_SOURCE_TS:
+                source_ts = ts
+            if source_ts is not None and (
+                window_source_ts is None or source_ts > window_source_ts
+            ):
+                window_source_ts = source_ts
             for key, da in zip(
                 result.keys(), result.outputs.values(), strict=True
             ):
@@ -103,7 +124,10 @@ class ServingPlane:
                     )
                     frame = encode_da00(key.to_string(), ts, variables)
                     self.server.publish_frame(
-                        stream_key(job, key.output_name), frame, token
+                        stream_key(job, key.output_name),
+                        frame,
+                        token,
+                        source_ts_ns=source_ts,
                     )
                 except Exception:
                     logger.exception(
@@ -111,6 +135,9 @@ class ServingPlane:
                         job,
                         key.output_name,
                     )
+        # One boundary observation per publish tick (ADR 0120): every
+        # output of this window is now delta-encoded and enqueued.
+        observe_stage("fanout_encoded", window_source_ts)
 
     def drop_job(self, job_id) -> int:
         """Drop a removed job's streams (wired to

@@ -13,6 +13,8 @@ trace-id lifecycle and how to wire a new workflow metric.
 """
 
 from .compile import COMPILE_EVENTS, CompileEventRecorder
+from .e2e import E2E_LATENCY, E2E_STAGES, observe_stage
+from .health import HEALTH, STATE_LOST, HealthState
 from .instruments import PUBLISH_RTT_SECONDS
 from .exposition import (
     CONTENT_TYPE,
@@ -35,11 +37,16 @@ from .trace import TRACER, Span, TickTracer
 __all__ = [
     "COMPILE_EVENTS",
     "CONTENT_TYPE",
+    "E2E_LATENCY",
+    "E2E_STAGES",
+    "HEALTH",
     "REGISTRY",
+    "STATE_LOST",
     "TRACER",
     "CompileEventRecorder",
     "Counter",
     "Gauge",
+    "HealthState",
     "Histogram",
     "MetricFamily",
     "MetricsRegistry",
@@ -49,6 +56,7 @@ __all__ = [
     "Sample",
     "Span",
     "TickTracer",
+    "observe_stage",
     "parse_prometheus_text",
     "render_text",
     "start_metrics_server",

@@ -1,0 +1,91 @@
+"""Process health state behind ``/healthz`` (ADR 0120).
+
+The liveness probe used to answer an unconditional ``ok``, which made
+it useless the moment anything interesting happened: a service whose
+slow-tick watchdog is latched, or that just lost accumulated state to a
+post-donation dispatch failure, is *alive* (a restart would make things
+worse — it would lose MORE state) but an operator paging through
+replicas needs to see it is not *well*. ``/healthz`` therefore reports
+
+- ``{"status": "ok"}`` — healthy;
+- ``{"status": "degraded", "reason": "..."}`` — still HTTP 200 (the
+  supervisor must NOT restart-loop a degraded service; readiness
+  semantics stay with the x5f2 status heartbeats) while either
+
+  * the slow-tick watchdog is latched (:class:`~.trace.TickTracer`
+    breached and the latch has not decayed back to the floor), or
+  * a ``state_lost`` containment fired within the last
+    ``degraded_window_s`` (default 30 s — one metrics interval).
+
+``state_lost`` events arrive from ``Job.note_state_lost()`` (core/
+job.py) — the single choke point every containment site in the
+JobManager already goes through (graftlint JGL022 proves that) — and
+are also counted into ``livedata_state_lost_total`` so the SLO gate
+and dashboards see the rate, not just the latch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .registry import REGISTRY
+
+__all__ = ["HEALTH", "HealthState", "STATE_LOST"]
+
+#: Mid-generation state rebuilds (a donated dispatch failed after
+#: consuming the buffers): each one cost the accumulation since the
+#: last checkpoint. The chaos harness injects these on purpose; the
+#: SLO rules bound how many the serving plane may absorb.
+STATE_LOST = REGISTRY.counter(
+    "livedata_state_lost",
+    "Mid-generation state rebuilds (post-donation dispatch failures "
+    "contained via note_state_lost)",
+)
+
+
+class HealthState:
+    """Degraded-state latch for the ``/healthz`` endpoint.
+
+    ``clock`` is injectable for tests; production uses
+    ``time.monotonic``.
+    """
+
+    def __init__(
+        self, *, degraded_window_s: float = 30.0, clock=time.monotonic
+    ) -> None:
+        self._lock = threading.Lock()
+        self._clock = clock
+        self._window_s = float(degraded_window_s)
+        self._last_state_lost: float | None = None
+
+    def note_state_lost(self) -> None:
+        """One containment fired (called by ``Job.note_state_lost``)."""
+        STATE_LOST.inc()
+        with self._lock:
+            self._last_state_lost = self._clock()
+
+    def healthz(self) -> dict[str, str]:
+        """The ``/healthz`` payload. Imports the tracer lazily so this
+        module stays import-cycle-free (trace.py imports registry, not
+        health)."""
+        from .trace import TRACER
+
+        reasons = []
+        with self._lock:
+            last = self._last_state_lost
+            if last is not None and self._clock() - last < self._window_s:
+                reasons.append(
+                    "state_lost containment fired in the last "
+                    f"{self._window_s:.0f}s"
+                )
+        if TRACER.watchdog_latched:
+            reasons.append("slow-tick watchdog latched")
+        if not reasons:
+            return {"status": "ok"}
+        return {"status": "degraded", "reason": "; ".join(reasons)}
+
+
+#: Process-wide health state: core/job.py feeds it, telemetry/http.py
+#: serves it.
+HEALTH = HealthState()

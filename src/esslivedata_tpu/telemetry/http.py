@@ -6,9 +6,14 @@ Every service runner grows ``--metrics-port`` / ``LIVEDATA_METRICS_PORT``
 
 - ``GET /metrics`` — the process registry rendered in Prometheus text
   exposition format (telemetry/exposition.py);
-- ``GET /healthz`` — ``200 {"status": "ok"}`` liveness (a supervisor's
-  restart probe; readiness semantics stay with the x5f2 status
-  heartbeats, which carry the real job/source health).
+- ``GET /healthz`` — liveness plus a degraded latch (ADR 0120):
+  ``200 {"status": "ok"}`` normally, ``200 {"status": "degraded",
+  "reason": ...}`` while the slow-tick watchdog is latched or a
+  ``state_lost`` containment fired in the last interval
+  (telemetry/health.py). Always HTTP 200 — a supervisor's restart
+  probe must not restart-loop a degraded-but-alive service; readiness
+  semantics stay with the x5f2 status heartbeats, which carry the real
+  job/source health.
 
 stdlib only (``http.server`` ThreadingHTTPServer on a daemon thread):
 the container bakes no prometheus_client, and a scrape every 15 s is
@@ -25,6 +30,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .exposition import CONTENT_TYPE, render_text
+from .health import HEALTH
 from .registry import REGISTRY, MetricsRegistry
 
 __all__ = ["MetricsServer", "start_metrics_server"]
@@ -50,7 +56,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(payload)
         elif path == "/healthz":
-            payload = json.dumps({"status": "ok"}).encode()
+            payload = json.dumps(HEALTH.healthz()).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(payload)))

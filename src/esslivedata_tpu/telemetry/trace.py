@@ -113,6 +113,10 @@ class TickTracer:
         self._slow_floor_s = float(slow_tick_s)
         self._slow_latch_s = float(slow_tick_s)
         self._slow_ticks = 0
+        #: True from a breach until the latch decays back to the floor
+        #: — the /healthz degraded signal (telemetry/health.py): "a
+        #: slow-tick regime happened and has not yet cleared".
+        self._slow_latched = False
 
     # -- trace ids ---------------------------------------------------------
     def new_trace(self) -> int:
@@ -195,13 +199,17 @@ class TickTracer:
             if total_s > threshold:
                 self._slow_latch_s = total_s
                 self._slow_ticks += 1
+                self._slow_latched = True
                 spans = [s for s in self._spans if s.trace_id == trace_id]
             else:
                 # Decay toward the floor so the latch re-arms once the
-                # slow regime passes.
+                # slow regime passes; reaching the floor clears the
+                # degraded signal.
                 self._slow_latch_s = max(
                     self._slow_floor_s, self._slow_latch_s * 0.95
                 )
+                if self._slow_latch_s <= self._slow_floor_s:
+                    self._slow_latched = False
                 return
         # SUM same-named spans: a window legitimately records several
         # (one tick_execute/fetch pair per tick group and per mesh
@@ -234,27 +242,49 @@ class TickTracer:
         with self._lock:
             return self._slow_ticks
 
-    # -- export ------------------------------------------------------------
-    def spans(self, trace_id: int | None = None) -> list[Span]:
+    @property
+    def watchdog_latched(self) -> bool:
+        """True between a slow-tick breach and the latch's decay back
+        to the configured floor — /healthz reports ``degraded`` while
+        this holds (telemetry/health.py)."""
         with self._lock:
-            return [
-                s
-                for s in self._spans
-                if trace_id is None or s.trace_id == trace_id
-            ]
+            return self._slow_latched
+
+    # -- export ------------------------------------------------------------
+    def export(self) -> list[Span]:
+        """ONE consistent snapshot of the ring, taken under the lock.
+
+        Every exporter (:meth:`spans`, :meth:`chrome_trace`,
+        :meth:`dump`) goes through here: a consumer that read the ring
+        once and then came back for a count (or a second filtered view)
+        would otherwise race concurrent writers — the deque trims on
+        append, so spans recorded between the two reads silently
+        evict spans the first read promised were there. Pinned by the
+        export hammer in tests/telemetry/trace_test.py."""
+        with self._lock:
+            return list(self._spans)
+
+    def spans(self, trace_id: int | None = None) -> list[Span]:
+        snapshot = self.export()
+        if trace_id is None:
+            return snapshot
+        return [s for s in snapshot if s.trace_id == trace_id]
 
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
 
-    def chrome_trace(self) -> dict:
+    def chrome_trace(self, spans: list[Span] | None = None) -> dict:
         """The ring as Chrome ``trace_event`` JSON (object format).
 
         Complete ('X') events in microseconds; the trace id rides
         ``pid`` so chrome://tracing groups one window's spans into one
-        row-set, with the worker thread preserved in ``tid``/args."""
-        with self._lock:
-            spans = list(self._spans)
+        row-set, with the worker thread preserved in ``tid``/args.
+        ``spans`` lets a caller render an :meth:`export` snapshot it
+        already holds (dump does — payload and count must describe the
+        SAME snapshot)."""
+        if spans is None:
+            spans = self.export()
         return {
             "traceEvents": [
                 {
@@ -273,10 +303,14 @@ class TickTracer:
         }
 
     def dump(self, path: str) -> None:
-        """Write :meth:`chrome_trace` to ``path`` (--trace-dump)."""
+        """Write :meth:`chrome_trace` to ``path`` (--trace-dump). One
+        snapshot backs both the payload and the logged count — reading
+        the live ring again for the count would describe a different
+        (possibly trimmed) ring than the file holds."""
+        spans = self.export()
         with open(path, "w", encoding="utf-8") as fh:
-            json.dump(self.chrome_trace(), fh)
-        logger.info("trace dumped to %s (%d spans)", path, len(self._spans))
+            json.dump(self.chrome_trace(spans), fh)
+        logger.info("trace dumped to %s (%d spans)", path, len(spans))
 
 
 #: Process-wide tracer: the service runners, pipeline and device layers

@@ -1,0 +1,225 @@
+"""Chaos schedule semantics (ADR 0120): seeded determinism, explicit
+fire ticks, the JobManager post-donation hook driving the REAL
+note_state_lost containment, and the pipeline/broadcast stall hooks."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.harness.chaos import (
+    CHAOS_INJECTIONS,
+    ChaosError,
+    ChaosSchedule,
+    ChaosSpec,
+)
+
+
+class TestSchedule:
+    def test_explicit_ticks_fire_exactly(self):
+        sched = ChaosSchedule(ChaosSpec(at={"tick_dispatch": frozenset({1, 3})}))
+        fires = [sched.fires("tick_dispatch") for _ in range(5)]
+        assert fires == [False, True, False, True, False]
+        assert sched.injected() == {"tick_dispatch": 2}
+        assert sched.consultations() == {"tick_dispatch": 5}
+
+    def test_sites_count_independently(self):
+        sched = ChaosSchedule(
+            ChaosSpec(at={"a": frozenset({0}), "b": frozenset({1})})
+        )
+        assert sched.fires("a") is True
+        assert sched.fires("b") is False
+        assert sched.fires("b") is True
+
+    def test_rate_draws_are_seed_deterministic(self):
+        def pattern(seed: int) -> list[bool]:
+            sched = ChaosSchedule(
+                ChaosSpec(seed=seed, rate={"slow_tick": 0.3})
+            )
+            return [sched.fires("slow_tick") for _ in range(64)]
+
+        assert pattern(5) == pattern(5)
+        assert pattern(5) != pattern(6)
+        assert any(pattern(5)) and not all(pattern(5))
+
+    def test_adding_a_site_never_shifts_another_sites_draws(self):
+        one = ChaosSchedule(ChaosSpec(seed=9, rate={"a": 0.5}))
+        two = ChaosSchedule(ChaosSpec(seed=9, rate={"a": 0.5, "b": 0.5}))
+        assert [one.fires("a") for _ in range(32)] == [
+            two.fires("a") for _ in range(32)
+        ]
+
+    def test_check_raises_chaos_error(self):
+        sched = ChaosSchedule(ChaosSpec(at={"tick_dispatch": frozenset({0})}))
+        with pytest.raises(ChaosError):
+            sched.check("tick_dispatch")
+        sched.check("tick_dispatch")  # consultation 1: quiet
+
+    def test_fired_injections_count_into_the_registry(self):
+        before = CHAOS_INJECTIONS.value(site="slow_tick")
+        sched = ChaosSchedule(
+            ChaosSpec(at={"slow_tick": frozenset({0})}, delay_s={"slow_tick": 0.0})
+        )
+        sched.maybe_delay("slow_tick")
+        assert CHAOS_INJECTIONS.value(site="slow_tick") == before + 1
+
+    def test_with_site_builds_on_a_spec(self):
+        spec = ChaosSpec(seed=3).with_site("slow_tick", {2})
+        assert spec.at["slow_tick"] == frozenset({2})
+
+
+def _tiny_manager(k: int = 2):
+    from esslivedata_tpu.config import JobId, WorkflowConfig, WorkflowSpec
+    from esslivedata_tpu.core.job_manager import JobFactory, JobManager
+    from esslivedata_tpu.workflows import WorkflowFactory
+    from esslivedata_tpu.workflows.detector_view import (
+        DetectorViewWorkflow,
+        project_logical,
+    )
+
+    det = np.arange(16 * 16).reshape(16, 16)
+    reg = WorkflowFactory()
+    spec = WorkflowSpec(
+        instrument="chaos", name="dv", source_names=["det0"]
+    )
+    reg.register_spec(spec).attach_factory(
+        lambda *, source_name, params: DetectorViewWorkflow(
+            projection=project_logical(det)
+        )
+    )
+    mgr = JobManager(job_factory=JobFactory(reg), job_threads=1)
+    for _ in range(k):
+        mgr.schedule_job(
+            WorkflowConfig(
+                identifier=spec.identifier, job_id=JobId(source_name="det0")
+            )
+        )
+    return mgr
+
+
+def _staged(rng):
+    from esslivedata_tpu.ops import EventBatch
+    from esslivedata_tpu.preprocessors.event_data import StagedEvents
+
+    pid = rng.integers(0, 256, 512).astype(np.int32)
+    toa = rng.uniform(0, 7e7, 512).astype(np.float32)
+    return StagedEvents(
+        batch=EventBatch.from_arrays(pid, toa),
+        first_timestamp=None,
+        last_timestamp=None,
+        n_chunks=1,
+    )
+
+
+class TestJobManagerHook:
+    def test_tick_dispatch_fault_takes_the_state_lost_path(self):
+        """The injected post-donation failure exercises the REAL
+        containment: epoch bumps, jobs keep publishing (reset counts),
+        next window recovers on the cached program."""
+        from esslivedata_tpu.core.timestamp import Timestamp
+
+        T = Timestamp.from_ns
+        mgr = _tiny_manager()
+        rng = np.random.default_rng(3)
+        try:
+            for w in range(2):  # both tick-program variants compile
+                out = mgr.process_jobs(
+                    {"det0": _staged(rng)}, start=T(0), end=T(w + 1)
+                )
+                assert len(out) == 2
+            cum_before = float(out[0].outputs["counts_cumulative"].values)
+            epoch_before = out[0].state_epoch
+            # Steady consultation 0 fires: the dispatch runs (donating
+            # the states), then "fails".
+            mgr.set_chaos(
+                ChaosSchedule(
+                    ChaosSpec(at={"tick_dispatch": frozenset({0})})
+                )
+            )
+            out = mgr.process_jobs(
+                {"det0": _staged(rng)}, start=T(0), end=T(3)
+            )
+            assert len(out) == 2  # containment: every job published
+            cur = float(out[0].outputs["counts_current"].values)
+            cum = float(out[0].outputs["counts_cumulative"].values)
+            assert cum == cur  # fresh state: the accumulation reset
+            assert cum < cum_before
+            assert out[0].state_epoch > epoch_before  # loss SIGNALED
+            states = {str(s.state) for s in mgr.job_statuses()}
+            assert "error" not in states
+            # Recovery: the next window ticks again, accumulating.
+            out = mgr.process_jobs(
+                {"det0": _staged(rng)}, start=T(0), end=T(4)
+            )
+            assert (
+                float(out[0].outputs["counts_cumulative"].values) > cum
+            )
+        finally:
+            mgr.shutdown()
+
+
+class TestBroadcastHook:
+    def test_subscriptions_inherit_the_schedule_and_stall(self):
+        from esslivedata_tpu.serving.broadcast import BroadcastServer
+
+        hub = BroadcastServer(port=None)
+        try:
+            sched = ChaosSchedule(
+                ChaosSpec(
+                    at={"subscriber_stall": frozenset({0})},
+                    delay_s={"subscriber_stall": 0.15},
+                )
+            )
+            hub.set_chaos(sched)
+            hub.publish_frame("j/out", b"frame-bytes", ("tok",))
+            sub = hub.subscribe("j/out")
+            t0 = time.perf_counter()
+            blob = sub.next_blob(timeout=1.0)  # consultation 0: stalls
+            stalled = time.perf_counter() - t0
+            assert blob is not None
+            assert stalled >= 0.15
+            assert sched.injected() == {"subscriber_stall": 1}
+        finally:
+            hub.close()
+
+
+class TestPipelineHook:
+    def test_decode_stall_fires_and_windows_stay_ordered(self):
+        """An injected decode-worker stall slows the pipeline but must
+        never drop or reorder windows (the ADR 0111 ordering contract
+        holds under chaos)."""
+        from tests.core.ingest_pipeline_test import (
+            make_manager,
+            staged_window,
+        )
+        from esslivedata_tpu.core.ingest_pipeline import IngestPipeline
+        from esslivedata_tpu.core.timestamp import Timestamp
+
+        T = Timestamp.from_ns
+        mgr = make_manager()
+        published = []
+        pipe = IngestPipeline(
+            job_manager=mgr,
+            decode=lambda payload: (payload, {}, None),
+            publish=lambda results, end: published.append(end),
+            depth=2,
+        )
+        sched = ChaosSchedule(
+            ChaosSpec(
+                at={"decode_stall": frozenset({1})},
+                delay_s={"decode_stall": 0.2},
+            )
+        )
+        pipe.set_chaos(sched)
+        try:
+            for i in range(4):
+                pipe.submit(staged_window(i), start=T(0), end=T(i + 1))
+            assert pipe.flush(timeout=30.0)
+            assert sched.injected() == {"decode_stall": 1}
+            assert published == [T(1), T(2), T(3), T(4)]
+            assert pipe.failure is None
+        finally:
+            pipe.stop(drain=False)
+            mgr.shutdown()

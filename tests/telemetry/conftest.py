@@ -1,0 +1,22 @@
+"""Shared telemetry-suite fixtures."""
+
+from __future__ import annotations
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_health_latches():
+    """/healthz reads PROCESS state (ADR 0120): earlier service-driving
+    suites can leave the slow-tick watchdog latched (a starved CI
+    worker breaches it legitimately) or the state-lost window open.
+    The telemetry suites assert the plumbing and the latch SEMANTICS —
+    start every test from a clean latch, in ONE place (both latches'
+    privates are poked here and nowhere else in tests)."""
+    from esslivedata_tpu.telemetry import HEALTH, TRACER
+
+    with TRACER._lock:
+        TRACER._slow_latch_s = TRACER._slow_floor_s
+        TRACER._slow_latched = False
+    HEALTH._last_state_lost = None
+    yield

@@ -1,0 +1,209 @@
+"""E2E source-timestamp propagation (ADR 0120): ONE ev44 reference
+time, injected at the fake Kafka edge, must survive decode -> tick ->
+sink publish -> SSE frame BYTE-EXACTLY — serial AND pipelined — and
+the latency instrumentation along the way must never perturb the wire
+(telemetry on vs off byte-identical, serving plane attached)."""
+
+from __future__ import annotations
+
+import json
+import uuid
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.config import JobId, WorkflowConfig
+from esslivedata_tpu.config.instruments.dummy.specs import (
+    DETECTOR_VIEW_HANDLE,
+    INSTRUMENT,
+)
+from esslivedata_tpu.core.message_batcher import NaiveMessageBatcher
+from esslivedata_tpu.kafka import wire
+from esslivedata_tpu.kafka.sink import (
+    FakeProducer,
+    KafkaSink,
+    make_default_serializer,
+)
+from esslivedata_tpu.kafka.source import FakeKafkaMessage
+from esslivedata_tpu.serving import DeltaDecoder, ServingPlane
+from esslivedata_tpu.services.detector_data import make_detector_service_builder
+from esslivedata_tpu.services.fake_sources import PulsedRawSource
+from esslivedata_tpu.telemetry import TRACER
+from esslivedata_tpu.telemetry.e2e import E2E_LATENCY
+
+BASE_NS = 1_700_000_000_000_000_000
+PERIOD_NS = int(1e9 / 14)
+
+
+def run_service(*, pipelined: bool, subscribe_at: int = 4):
+    """Drive a real detector service over fakes with a hub-only
+    ServingPlane attached; returns (sink data messages, plane,
+    subscription, pulse reference times)."""
+    builder = make_detector_service_builder(
+        instrument="dummy", batcher=NaiveMessageBatcher(), job_threads=1
+    )
+    builder.pipelined = pipelined
+    raw = PulsedRawSource([])
+    producer = FakeProducer()
+    sink = KafkaSink(
+        producer,
+        make_default_serializer(builder.stream_mapping.livedata, "e2e"),
+    )
+    service = builder.from_raw_source(raw, sink)
+    plane = ServingPlane(port=None)
+    # The processor hook the service factory wires for --serve-port;
+    # hub-only here (no HTTP) — subscribe() IS the SSE handler's API.
+    service.processor._result_fanout = plane
+    config = WorkflowConfig(
+        identifier=DETECTOR_VIEW_HANDLE.workflow_id,
+        job_id=JobId(source_name="panel_0", job_number=uuid.UUID(int=9)),
+        params={},
+    )
+    raw.inject(
+        FakeKafkaMessage(
+            json.dumps(
+                {"kind": "start_job", "config": config.model_dump(mode="json")}
+            ).encode(),
+            "dummy_livedata_commands",
+        )
+    )
+    service.step()
+    det = INSTRUMENT.detectors["panel_0"]
+    ids_space = det.detector_number.reshape(-1)
+    rng = np.random.default_rng(23)
+    sub = None
+    pulse_times = []
+    for pulse in range(10):
+        t_pulse = BASE_NS + pulse * PERIOD_NS
+        pulse_times.append(t_pulse)
+        ids = rng.choice(ids_space, 256).astype(np.int32)
+        toa = rng.uniform(0, 7.0e7, 256).astype(np.int32)
+        payload = wire.encode_ev44(
+            det.source_name,
+            pulse,
+            np.array([t_pulse]),
+            np.array([0]),
+            toa,
+            pixel_id=ids,
+        )
+        raw.inject(FakeKafkaMessage(payload, "dummy_detector"))
+        service.step()
+        if pulse == subscribe_at:
+            if pipelined:
+                # The hub learns streams as publishes land on the step
+                # worker; wait for the in-flight windows first.
+                assert service.processor._pipeline.flush(timeout=60.0)
+            streams = sorted(plane.cache.streams())
+            target = next(
+                s for s in streams if s.endswith("/image_cumulative")
+            )
+            sub = plane.server.subscribe(target)
+    processor = service.processor
+    if pipelined:
+        assert processor._pipeline.flush(timeout=60.0)
+    processor.finalize()
+    data = [
+        m
+        for m in producer.messages
+        if m.key is not None
+        and (b"image" in m.key or b"spectrum" in m.key)
+    ]
+    return data, plane, sub, pulse_times
+
+
+def reconstruct(sub) -> bytes:
+    """Drain an SSE subscription's queue through the delta codec."""
+    decoder = DeltaDecoder()
+    frame = None
+    while sub.depth() > 0:
+        blob = sub.next_blob(timeout=1.0)
+        assert blob is not None
+        frame = decoder.apply(blob)
+    assert frame is not None, "subscriber received nothing"
+    return frame
+
+
+@pytest.mark.parametrize("pipelined", [False, True])
+class TestSourceTimestampSurvives:
+    def test_reference_time_reaches_sse_frame_byte_exactly(
+        self, pipelined
+    ):
+        stage_counts0 = {
+            stage: E2E_LATENCY.count(stage=stage)
+            for stage in (
+                "decode",
+                "staged",
+                "published",
+                "fanout_encoded",
+                "subscriber_delivered",
+            )
+        }
+        data, plane, sub, pulse_times = run_service(pipelined=pipelined)
+        try:
+            assert sub is not None
+            frame = reconstruct(sub)
+            decoded = wire.decode_da00(frame)
+            # THE contract: the frame's timestamp is the window-end
+            # DATA time — a pure function of the last injected ev44
+            # reference time (batcher pulse quantization, no wall
+            # clock anywhere on the way) — byte-exactly.
+            from esslivedata_tpu.core.timestamp import Timestamp
+
+            hi = Timestamp.from_ns(pulse_times[-1])
+            end = hi.quantize_up()
+            if end == hi:
+                end = Timestamp.from_pulse_index(hi.pulse_index() + 1)
+            assert decoded.timestamp_ns == end.ns
+            # ...and it stays within one pulse of the reference time:
+            # the source clock, not a republished wall clock.
+            assert 0 <= decoded.timestamp_ns - pulse_times[-1] <= PERIOD_NS
+            # And the SSE frame is the sink wire: the exact bytes a
+            # Kafka consumer of the same publish read.
+            sink_match = [
+                m
+                for m in data
+                if m.value == frame and b"image_cumulative" in m.key
+            ]
+            assert sink_match, (
+                "SSE reconstruction != any sink-published da00 message"
+            )
+            # Every boundary observed the window: the histogram counted
+            # each stage (staged is pipelined-only by design).
+            for stage in ("decode", "published", "fanout_encoded"):
+                assert (
+                    E2E_LATENCY.count(stage=stage) > stage_counts0[stage]
+                ), stage
+            assert (
+                E2E_LATENCY.count(stage="subscriber_delivered")
+                > stage_counts0["subscriber_delivered"]
+            )
+            staged_delta = (
+                E2E_LATENCY.count(stage="staged")
+                - stage_counts0["staged"]
+            )
+            assert (staged_delta > 0) == pipelined
+        finally:
+            plane.close()
+
+
+class TestWireParityTelemetryOnOffWithPlane:
+    @pytest.mark.parametrize("pipelined", [False, True])
+    def test_wire_and_sse_frames_byte_identical(self, pipelined):
+        """Telemetry on (tracer + e2e instrumentation recording) vs
+        off: the sink wire AND the SSE reconstruction are byte-for-byte
+        the same — the SLO plane observes the path, never perturbs it."""
+        TRACER.enabled = True
+        try:
+            on, plane_on, sub_on, _ = run_service(pipelined=pipelined)
+            frame_on = reconstruct(sub_on)
+            plane_on.close()
+            TRACER.enabled = False
+            off, plane_off, sub_off, _ = run_service(pipelined=pipelined)
+            frame_off = reconstruct(sub_off)
+            plane_off.close()
+        finally:
+            TRACER.enabled = True
+        assert len(on) == len(off) > 0
+        assert [m.key for m in on] == [m.key for m in off]
+        assert [m.value for m in on] == [m.value for m in off]
+        assert frame_on == frame_off

@@ -1,0 +1,110 @@
+"""/healthz degraded semantics (ADR 0120): state-lost latch, slow-tick
+watchdog latch, and the HTTP surface — always 200, never a restart
+loop."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from esslivedata_tpu.telemetry import HEALTH, STATE_LOST, TRACER, HealthState
+from esslivedata_tpu.telemetry.http import MetricsServer
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.now = 100.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+class TestHealthState:
+    def test_ok_by_default(self):
+        state = HealthState(clock=FakeClock())
+        assert state.healthz() == {"status": "ok"}
+
+    def test_state_lost_degrades_then_recovers(self):
+        clock = FakeClock()
+        state = HealthState(degraded_window_s=30.0, clock=clock)
+        before = STATE_LOST.total()
+        state.note_state_lost()
+        assert STATE_LOST.total() == before + 1
+        payload = state.healthz()
+        assert payload["status"] == "degraded"
+        assert "state_lost" in payload["reason"]
+        # The latch clears once the interval passes — a loss 5 minutes
+        # ago is history, not a current condition.
+        clock.now += 31.0
+        assert state.healthz() == {"status": "ok"}
+
+    def test_watchdog_latch_degrades(self):
+        state = HealthState(clock=FakeClock())
+        TRACER.enabled = True
+        trace_id = TRACER.new_trace()
+        floor = TRACER._slow_floor_s
+        try:
+            TRACER.finish_tick(trace_id, floor * 100)
+            payload = state.healthz()
+            assert payload["status"] == "degraded"
+            assert "watchdog" in payload["reason"]
+        finally:
+            # Decay the latch fully so later tests see a healthy tracer.
+            for _ in range(300):
+                TRACER.finish_tick(TRACER.new_trace(), 0.0)
+        assert state.healthz() == {"status": "ok"}
+
+    def test_job_note_state_lost_feeds_the_process_latch(self):
+        """The single choke point: every JobManager containment site
+        goes through Job.note_state_lost (JGL022), which must reach
+        the process health latch."""
+        from esslivedata_tpu.config.workflow_spec import JobId, WorkflowId
+
+        from esslivedata_tpu.core.job import Job
+
+        class _NullWorkflow:
+            def accumulate(self, data):
+                pass
+
+            def finalize(self):
+                return {}
+
+            def clear(self):
+                pass
+
+        job = Job(
+            job_id=JobId(source_name="det0"),
+            workflow_id=WorkflowId(
+                instrument="dummy", namespace="t", name="w", version=1
+            ),
+            workflow=_NullWorkflow(),
+        )
+        before = STATE_LOST.total()
+        epoch = job.state_epoch
+        job.note_state_lost()
+        assert job.state_epoch == epoch + 1
+        assert STATE_LOST.total() == before + 1
+        assert HEALTH.healthz()["status"] == "degraded"
+        # Reset the process-wide latch for neighboring tests.
+        HEALTH._last_state_lost = None
+
+
+class TestHealthzEndpoint:
+    def test_degraded_is_still_http_200_with_reason(self):
+        server = MetricsServer(0, host="127.0.0.1")
+        try:
+            url = f"http://127.0.0.1:{server.port}/healthz"
+            with urllib.request.urlopen(url) as resp:
+                assert resp.status == 200
+                assert json.loads(resp.read()) == {"status": "ok"}
+            HEALTH.note_state_lost()
+            with urllib.request.urlopen(url) as resp:
+                # STILL 200: degraded must not trip a supervisor's
+                # restart probe (a restart loses MORE state).
+                assert resp.status == 200
+                payload = json.loads(resp.read())
+            assert payload["status"] == "degraded"
+            assert "state_lost" in payload["reason"]
+        finally:
+            HEALTH._last_state_lost = None
+            server.close()

@@ -202,3 +202,88 @@ class TestCompileClassification:
         assert len(rec._memory) == rec._MEMORY_MAX
         # The evicted earliest group classifies as new again.
         assert rec.classify("tick", "group-0") == "new_group"
+
+
+class TestExportConsistency:
+    """The ring-export contract (ADR 0120 satellite): every exporter
+    reads ONE snapshot under the lock, so concurrent writers trimming
+    the ring can never make an export drop spans it promised."""
+
+    def test_export_is_one_consistent_snapshot(self, tmp_path):
+        tracer = make_tracer(capacity=100_000)
+        stop = threading.Event()
+        recorded = []
+
+        def writer(worker: int) -> None:
+            trace_id = tracer.new_trace()
+            n = 0
+            while not stop.is_set():
+                tracer.record(f"w{worker}", 0.0, 1e-6, trace_id)
+                n += 1
+            recorded.append(n)
+
+        def exporter() -> None:
+            last = 0
+            while not stop.is_set():
+                snapshot = tracer.export()
+                doc = tracer.chrome_trace(snapshot)
+                # Payload and snapshot describe the SAME ring state.
+                assert len(doc["traceEvents"]) == len(snapshot)
+                # While the ring is not full, exports only grow: a
+                # shrink means a snapshot raced a concurrent trim.
+                assert len(snapshot) >= last
+                last = len(snapshot)
+
+        writers = [
+            threading.Thread(target=writer, args=(i,)) for i in range(4)
+        ]
+        export_threads = [
+            threading.Thread(target=exporter) for _ in range(2)
+        ]
+        for t in writers + export_threads:
+            t.start()
+        import time as _time
+
+        _time.sleep(0.3)
+        stop.set()
+        for t in writers + export_threads:
+            t.join()
+        # Hammer postcondition: nothing below capacity was lost — the
+        # final export holds every span every writer recorded.
+        assert sum(recorded) <= 100_000, "raise capacity for this test"
+        assert len(tracer.export()) == sum(recorded)
+
+    def test_spans_recorded_before_export_always_appear(self):
+        tracer = make_tracer(capacity=4096)
+        trace_id = tracer.new_trace()
+        tracer.record("landed", 0.0, 1e-6, trace_id)
+        names = {s.name for s in tracer.export()}
+        assert "landed" in names
+
+    def test_dump_count_matches_payload(self, tmp_path, caplog):
+        import logging
+
+        tracer = make_tracer()
+        trace_id = tracer.new_trace()
+        for _ in range(5):
+            tracer.record("phase", 0.0, 1e-6, trace_id)
+        path = tmp_path / "trace.json"
+        with caplog.at_level(logging.INFO):
+            tracer.dump(str(path))
+        doc = json.loads(path.read_text())
+        assert len(doc["traceEvents"]) == 5
+        assert "5 spans" in caplog.text
+
+
+class TestWatchdogLatchSignal:
+    def test_latched_between_breach_and_decay(self):
+        tracer = make_tracer(slow_tick_s=0.1)
+        assert not tracer.watchdog_latched
+        trace_id = tracer.new_trace()
+        tracer.finish_tick(trace_id, 0.5)  # breach: latch to 0.5
+        assert tracer.watchdog_latched
+        # Healthy ticks decay the latch back toward the floor
+        # (0.95^n); latched stays True until the floor is reached.
+        for _ in range(50):
+            tracer.finish_tick(tracer.new_trace(), 0.01)
+        assert not tracer.watchdog_latched

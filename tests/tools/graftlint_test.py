@@ -345,6 +345,18 @@ class Plane:
 def healthy():
     return 1  # graftlint: disable=JGL007 vestigial after refactor
 ''',
+    # Both shapes of the cardinality leak: a job-id label bound on a
+    # direct counter child, and a per-subscriber gauge series.
+    "JGL025": '''
+from esslivedata_tpu.telemetry import REGISTRY
+
+FRAMES = REGISTRY.counter("frames_total", "frames", labelnames=("job",))
+DEPTH = REGISTRY.gauge("depth", "queue depth", labelnames=("subscriber",))
+
+def publish(result, sub):
+    FRAMES.labels(job=f"{result.job_id}").inc()
+    DEPTH.set(sub.depth(), subscriber=str(sub.sub_id))
+''',
 }
 
 NEGATIVE = {
@@ -799,6 +811,32 @@ def process(msgs):
             decode(m)
         except Exception:  # graftlint: disable=JGL007 poison drop is counted upstream
             pass
+''',
+    # The worked cardinality pattern: bounded literal/enum-style labels
+    # on direct instruments, and the per-entity series exposed through
+    # a keyed collector building Sample rows from live state.
+    "JGL025": '''
+from esslivedata_tpu.telemetry import REGISTRY, MetricFamily, Sample
+
+FRAMES = REGISTRY.counter("frames_total", "frames", labelnames=("kind",))
+LAT = REGISTRY.histogram("lat_seconds", "latency", labelnames=("stage",))
+
+def publish(blob, stage):
+    FRAMES.labels(kind="keyframe").inc(len(blob))
+    LAT.observe(0.5, stage=stage)
+
+class Hub:
+    def __init__(self):
+        self._subscribers = {}
+        REGISTRY.register_collector("hub", self._telemetry)
+
+    def _telemetry(self):
+        fam = MetricFamily("hub_queue_depth", "gauge", "depths")
+        for sub_id, sub in sorted(self._subscribers.items()):
+            fam.samples.append(
+                Sample("", (("subscriber", str(sub_id)),), sub.depth())
+            )
+        return [fam]
 ''',
 }
 # fmt: on

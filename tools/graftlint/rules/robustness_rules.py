@@ -394,3 +394,144 @@ def state_loss_protocol(ctx: FileContext):
                     "protocol on every path out of the reset (ADR "
                     "0117/0118)",
                 )
+
+
+# -- JGL025: unbounded metric-label cardinality ---------------------------
+
+#: Identifier tokens that mark a per-entity value: job ids/numbers,
+#: subscriber/session/client ids, uuids, trace ids, stream keys. A
+#: Prometheus label value built from one creates a NEW timeseries per
+#: entity — the registry (and every scraper downstream) holds each
+#: labelset forever, so job churn / subscriber churn becomes a
+#: process-lifetime memory leak and a scrape-size explosion.
+_UNBOUNDED_TOKENS = frozenset(
+    {
+        "job",
+        "subscriber",
+        "sub",
+        "session",
+        "client",
+        "uuid",
+        "trace",
+        "stream",
+    }
+)
+
+#: Direct-instrument methods whose keyword arguments are label VALUES
+#: (telemetry/registry.py API): ``labels(**kv)`` binds a child;
+#: ``inc``/``dec``/``set``/``observe`` accept inline labels.
+_LABEL_BINDING_ATTRS = frozenset({"labels", "inc", "dec", "set", "observe"})
+#: Keywords of those methods that are NOT labels.
+_NON_LABEL_KWARGS = frozenset({"amount", "value", "buckets"})
+
+
+def _identifier_tokens(node: ast.AST) -> set[str]:
+    """Lowercased underscore-split tokens of every identifier reachable
+    in the expression (names, attribute chains, f-string parts)."""
+    tokens: set[str] = set()
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name:
+            tokens.update(name.lower().split("_"))
+    return tokens
+
+
+def _tainted(value: ast.AST) -> str | None:
+    """The per-entity token a label-value expression derives from, or
+    None for bounded values. Literals are always bounded; everything
+    else is judged by the identifiers it mentions (precision over
+    recall: a dynamic value with neutral names stays quiet)."""
+    if isinstance(value, ast.Constant):
+        return None
+    hits = _identifier_tokens(value) & _UNBOUNDED_TOKENS
+    return sorted(hits)[0] if hits else None
+
+
+@rule(
+    "JGL025",
+    "unbounded metric-label cardinality (per-entity label value)",
+)
+def unbounded_label_cardinality(ctx: FileContext):
+    """Direct registry instruments (telemetry/registry.py Counter/
+    Gauge/Histogram) keep one series PER LABELSET, forever: a label
+    value derived from a job id, subscriber/session/client id, uuid,
+    trace id or stream key grows without bound under churn — the
+    registry pins every dead entity's series and the scrape grows
+    monotonically (the textbook Prometheus cardinality leak).
+
+    Flagged: ``.labels(...)`` / ``.inc(...)`` / ``.set(...)`` /
+    ``.observe(...)`` / ``.dec(...)`` on a telemetry-ish receiver where
+    a label keyword's value mentions a per-entity identifier
+    (job/subscriber/session/client/uuid/trace/stream tokens).
+
+    The sanctioned shape for per-entity series is a KEYED COLLECTOR
+    (``REGISTRY.register_collector``) building ``Sample`` rows at
+    scrape time from live state only — entries vanish with the entity
+    (``BroadcastServer._telemetry`` is the worked example), so the
+    label set is bounded by what is alive, not by history. Collectors
+    construct ``Sample``/``MetricFamily`` directly and are out of this
+    rule's scope by construction. Genuinely bounded dynamic values
+    (an enum rendered through a variable the heuristic misreads) carry
+    a suppression with the justification.
+    """
+    from .jax_rules import _telemetry_receiver
+
+    # Instruments resolved by provenance: names assigned from
+    # ``REGISTRY.counter/gauge/histogram(...)`` (any registry-ish
+    # receiver) — the constant-named handles (``FRAMES = REGISTRY.
+    # counter(...)``) the receiver-token heuristic alone cannot see.
+    instruments: set[str] = set()
+    for node in ctx.nodes(ast.Assign):
+        val = node.value
+        if (
+            isinstance(val, ast.Call)
+            and isinstance(val.func, ast.Attribute)
+            and val.func.attr in ("counter", "gauge", "histogram")
+            and _telemetry_receiver(val.func.value)
+        ):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    instruments.add(target.id)
+
+    def _instrument_receiver(recv: ast.AST) -> bool:
+        if isinstance(recv, ast.Name) and recv.id in instruments:
+            return True
+        if (
+            # Chained binding: REGISTRY.counter(...).labels(...).
+            isinstance(recv, ast.Call)
+            and isinstance(recv.func, ast.Attribute)
+            and recv.func.attr in ("counter", "gauge", "histogram", "labels")
+        ):
+            return True
+        return _telemetry_receiver(recv)
+
+    for node in ctx.nodes(ast.Call):
+        func = node.func
+        if not (
+            isinstance(func, ast.Attribute)
+            and func.attr in _LABEL_BINDING_ATTRS
+            and _instrument_receiver(func.value)
+        ):
+            continue
+        for kw in node.keywords:
+            if kw.arg is None or kw.arg in _NON_LABEL_KWARGS:
+                continue
+            token = _tainted(kw.value)
+            if token is None:
+                continue
+            yield Finding(
+                ctx.path,
+                node.lineno,
+                "JGL025",
+                f"label {kw.arg!r} is built from a per-entity value "
+                f"(mentions '{token}'): every distinct value becomes "
+                "a metric series the registry keeps forever — churn "
+                "turns this into a memory leak and a scrape-size "
+                "explosion. Expose per-entity series via a keyed "
+                "collector (register_collector + Sample rows from "
+                "live state) instead of direct instrument labels",
+            )
