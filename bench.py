@@ -1137,6 +1137,240 @@ def bench_fanout(args, n_values: tuple[int, ...] | None = None) -> dict:
     return results_by_n[max(n_values)]
 
 
+def bench_relay(args, r_values: tuple[int, ...] | None = None) -> dict:
+    """Relay-tree fan-out edge through the REAL JobManager + ServingPlane
+    + fleet relays (ADR 0121).
+
+    K=4 detector-view jobs publish every window into the compute-tier
+    hub; R in {1, 2, 4} relays (fleet/relay.py HubRelay — the same
+    RelayChannel state machine the ``livedata-relay`` SSE service runs,
+    driven through the hub API the SSE handler uses) each re-fan to
+    their own N subscribers. Every subscriber drains every window — the
+    capacity claim is that R relays serve R x N KEEPING-UP viewers —
+    and one checker per (relay, stream) reconstructs frames asserted
+    BYTE-IDENTICAL to a direct compute-hub subscription (and therefore
+    to the sink's da00 wire, per the --fanout acceptance).
+
+    Acceptance (asserted here AND in --smoke/CI):
+
+    - compute-tier publish executes + fetches per tick == 1.0 at every
+      R (subscriber/relay count costs the compute loop nothing);
+    - the COMPUTE hub encodes exactly once per stream per tick at
+      every R (``BroadcastServer.encodes`` — relays re-encode on their
+      own hubs, the compute tier never pays for them);
+    - downstream frames byte-identical to a direct subscription;
+    - served-subscriber count strictly increases 1 -> 2 -> 4 relays
+      with every subscriber fully served (monotone capacity in R).
+    """
+    from esslivedata_tpu.config import JobId, WorkflowConfig, WorkflowSpec
+    from esslivedata_tpu.core.job_manager import JobFactory, JobManager
+    from esslivedata_tpu.core.timestamp import Timestamp
+    from esslivedata_tpu.fleet.relay import HubRelay
+    from esslivedata_tpu.ops import EventBatch
+    from esslivedata_tpu.ops.publish import METRICS
+    from esslivedata_tpu.preprocessors.event_data import StagedEvents
+    from esslivedata_tpu.serving import DeltaDecoder, ServingPlane
+    from esslivedata_tpu.workflows import WorkflowFactory
+    from esslivedata_tpu.workflows.detector_view import (
+        DetectorViewParams,
+        DetectorViewWorkflow,
+        project_logical,
+    )
+
+    side = int(np.sqrt(min(args.pixels, 1 << 14)))
+    det = np.arange(side * side).reshape(side, side)
+    n_events = min(args.events, max(256, (side * side) // 8))
+    n_windows = max(8, args.batches // 4)
+    n_distinct = 4
+    k = 4
+    subs_per_relay = 16
+    if r_values is None:
+        r_values = (1, 2, 4)
+    method = args.method if args.method in ("scatter", "sort") else "scatter"
+    batches = []
+    for s in range(700, 700 + n_distinct):
+        pid, toa = make_batch(n_events, side * side, seed=s)
+        batches.append(EventBatch.from_arrays(pid, toa))
+
+    def staged(i: int) -> StagedEvents:
+        return StagedEvents(
+            batch=batches[i % n_distinct],
+            first_timestamp=None,
+            last_timestamp=None,
+            n_chunks=1,
+        )
+
+    t0 = Timestamp.from_ns(0)
+    results_by_r = {}
+    for n_relays in r_values:
+        reg = WorkflowFactory()
+        spec = WorkflowSpec(
+            instrument="bench",
+            name=f"dv_relay_{n_relays}",
+            source_names=["det0"],
+        )
+        reg.register_spec(spec).attach_factory(
+            lambda *, source_name, params: DetectorViewWorkflow(
+                projection=project_logical(det),
+                params=DetectorViewParams(histogram_method=method),
+            )
+        )
+        mgr = JobManager(
+            job_factory=JobFactory(reg), job_threads=min(4, k)
+        )
+        for _ in range(k):
+            mgr.schedule_job(
+                WorkflowConfig(
+                    identifier=spec.identifier,
+                    job_id=JobId(source_name="det0"),
+                )
+            )
+        plane = ServingPlane(port=None, queue_limit=32)
+        relays = [
+            HubRelay(plane.server, name=f"bench_relay_{n_relays}_{i}")
+            for i in range(n_relays)
+        ]
+        for w in range(2):
+            out = mgr.process_jobs(
+                {"det0": staged(w)}, start=t0, end=Timestamp.from_ns(1 + w)
+            )
+            plane.publish_results(out, Timestamp.from_ns(10 + w))
+            for relay in relays:
+                relay.pump()
+        streams = sorted(plane.cache.streams())
+        assert streams, "no streams cached after warm windows"
+        for relay in relays:
+            assert sorted(relay.hub.cache.streams()) == streams, (
+                "relay hub did not mirror the upstream stream set"
+            )
+        # Direct compute-hub checkers: the byte-identity oracle.
+        direct = {}
+        for stream in streams:
+            sub = plane.server.subscribe(stream)
+            decoder = DeltaDecoder()
+            blob = sub.next_blob(timeout=1.0)
+            assert blob is not None
+            decoder.apply(blob)
+            direct[stream] = (sub, decoder)
+        # R x N downstream subscribers, one checker per (relay, stream).
+        downstream = []  # (relay_idx, stream, sub, decoder-or-None)
+        for r_i, relay in enumerate(relays):
+            checked: set[str] = set()
+            for i in range(subs_per_relay):
+                stream = streams[i % len(streams)]
+                sub = relay.hub.subscribe(stream)
+                blob = sub.next_blob(timeout=1.0)
+                assert blob is not None, "relay attach keyframe missing"
+                decoder = None
+                if stream not in checked:
+                    checked.add(stream)
+                    decoder = DeltaDecoder()
+                    decoder.apply(blob)
+                downstream.append((r_i, stream, sub, decoder))
+        METRICS.drain()
+        hub_encodes0 = plane.server.encodes
+        delivered = 0
+        start = time.perf_counter()
+        for i in range(n_windows):
+            out = mgr.process_jobs(
+                {"det0": staged(i)}, start=t0, end=Timestamp.from_ns(3 + i)
+            )
+            plane.publish_results(out, Timestamp.from_ns(100 + i))
+            for relay in relays:
+                relay.pump()
+            reference = {}
+            for stream, (sub, decoder) in direct.items():
+                got = None
+                while (blob := sub.next_blob(timeout=1.0)) is not None:
+                    got = decoder.apply(blob)
+                    if sub.depth() == 0:
+                        break
+                assert got is not None, f"direct subscriber starved ({stream})"
+                reference[stream] = got
+            for _r_i, stream, sub, decoder in downstream:
+                got = None
+                while (blob := sub.next_blob(timeout=1.0)) is not None:
+                    delivered += 1
+                    if decoder is not None:
+                        got = decoder.apply(blob)
+                    if sub.depth() == 0:
+                        break
+                if decoder is not None:
+                    assert got == reference[stream], (
+                        f"window {i}: relay frame != direct frame for "
+                        f"{stream}"
+                    )
+        dt = time.perf_counter() - start
+        m = METRICS.drain()
+        hub_encodes = plane.server.encodes - hub_encodes0
+        relay_encode_total = sum(r.hub.encodes for r in relays)
+        served = len(downstream)
+        for relay in relays:
+            relay.close()
+        mgr.shutdown()
+        plane.close()
+        line = {
+            "metric": "relay",
+            "relays": n_relays,
+            "jobs": k,
+            # Graded value: compute-tier device round trips per tick —
+            # must not move with relay count.
+            "value": (m["executes"] + m["fetches"]) / n_windows,
+            "unit": "publish_device_ops/tick",
+            "executes_per_tick": m["executes"] / n_windows,
+            "fetches_per_tick": m["fetches"] / n_windows,
+            "hub_encodes_per_tick": hub_encodes / n_windows,
+            "streams": len(streams),
+            "served_subscribers": served,
+            "frames_delivered": delivered,
+            "frames_delivered_per_s": delivered / dt,
+            "relay_hub_encodes": relay_encode_total,
+            "windows": n_windows,
+            "events_per_window": n_events,
+            "wall_ms_per_tick": 1e3 * dt / n_windows,
+        }
+        results_by_r[n_relays] = line
+        emit_line(line)
+        # THE hub contract: one encode per stream per tick, whatever R.
+        assert hub_encodes == n_windows * len(streams), line
+    ref = results_by_r[r_values[0]]
+    prev_served = 0
+    for n_relays in r_values:
+        cur = results_by_r[n_relays]
+        # Compute-tier work flat in relay count.
+        assert cur["executes_per_tick"] == ref["executes_per_tick"], (
+            ref,
+            cur,
+        )
+        assert cur["fetches_per_tick"] == ref["fetches_per_tick"], (
+            ref,
+            cur,
+        )
+        assert cur["hub_encodes_per_tick"] == ref["hub_encodes_per_tick"], (
+            ref,
+            cur,
+        )
+        # Monotone capacity: every downstream subscriber was fully
+        # served at every R, and the served count strictly grows.
+        assert cur["served_subscribers"] > prev_served, (prev_served, cur)
+        prev_served = cur["served_subscribers"]
+    summary = {
+        "metric": "relay_summary",
+        "r_values": list(r_values),
+        "compute_ops_flat_in_r": True,
+        "executes_per_tick": ref["executes_per_tick"],
+        "hub_encodes_per_tick": ref["hub_encodes_per_tick"],
+        "served_subscribers": {
+            r: results_by_r[r]["served_subscribers"] for r in r_values
+        },
+        "frames_delivered_per_s": {
+            r: results_by_r[r]["frames_delivered_per_s"] for r in r_values
+        },
+    }
+    print(json.dumps(summary), file=sys.stderr)
+    return results_by_r[max(r_values)]
+
+
 def bench_churn(args) -> dict:
     """Durability plane under churn (ADR 0118): kill-and-restart with
     checkpoint/replay, and commit-time AOT warm-up.
@@ -2428,6 +2662,7 @@ def run_benchmark(args, platform: str) -> dict:
             lambda: bench_publish(args),
             lambda: bench_tick(args),
             lambda: bench_fanout(args),
+            lambda: bench_relay(args),
             lambda: bench_churn(args),
             lambda: bench_slo(args),
             lambda: bench_telemetry(args),
@@ -2791,6 +3026,19 @@ def _parse_args():
         "which uses N=50)",
     )
     parser.add_argument(
+        "--relay",
+        action="store_true",
+        help="Run ONLY the relay-tree fan-out edge scenario (ADR 0121) "
+        "on the ambient backend and exit: K=4 jobs publish through the "
+        "real JobManager + ServingPlane while R in {1, 2, 4} fleet "
+        "relays each re-fan to their own subscribers — asserts "
+        "compute-tier publish executes/tick == 1.0 and hub encodes == "
+        "one per stream per tick at every R, downstream frames "
+        "byte-identical to a direct subscription, and served-"
+        "subscriber capacity monotone in R (dev flag, like --multijob; "
+        "also runs under --all and --smoke, which uses R in {1, 2})",
+    )
+    parser.add_argument(
         "--churn",
         action="store_true",
         help="Run ONLY the durability-plane churn scenario (ADR 0118) "
@@ -2986,6 +3234,31 @@ def _smoke_main(args) -> int:
             problems.append(
                 "fanout delta encoding not under full-frame replay"
             )
+    # Relay-tree control (ADR 0121): tiny run through the real
+    # JobManager + ServingPlane + fleet relays at R=1 and R=2; the
+    # scenario itself asserts compute-tier device ops and hub encodes
+    # flat in R, byte-identical downstream frames and monotone served-
+    # subscriber capacity, and this guards the report's structure.
+    try:
+        relay_line = bench_relay(args, r_values=(1, 2))
+    except Exception:
+        traceback.print_exc()
+        problems.append("relay scenario raised")
+    else:
+        for field in (
+            "value",
+            "executes_per_tick",
+            "hub_encodes_per_tick",
+            "served_subscribers",
+            "frames_delivered_per_s",
+        ):
+            if relay_line.get(field) is None:
+                problems.append(f"relay line missing {field!r}")
+        if relay_line.get("value") != 2.0:
+            problems.append(
+                "relay: compute publish ops/tick not at 1 execute + "
+                "1 fetch"
+            )
     # Durability-plane churn control (ADR 0118): tiny kill-and-restart
     # through the real JobManager + CheckpointPlane; the scenario
     # itself asserts replay byte identity, the subscriber gap-not-
@@ -3165,6 +3438,13 @@ def main() -> None:
         if args.batches is None:
             args.batches = 48
         bench_fanout(args)
+        sys.exit(0)
+    if args.relay:
+        if args.events is None:
+            args.events = 1 << 12
+        if args.batches is None:
+            args.batches = 48
+        bench_relay(args)
         sys.exit(0)
     if args.churn:
         if args.events is None:

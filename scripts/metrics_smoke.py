@@ -45,6 +45,10 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "src"))
 TIMEOUT_S = float(os.environ.get("METRICS_SMOKE_TIMEOUT_S", "90"))
 PORT = int(os.environ.get("METRICS_SMOKE_PORT", "18917"))
 SERVE_PORT = int(os.environ.get("METRICS_SMOKE_SERVE_PORT", PORT + 1))
+RELAY_PORT = int(os.environ.get("METRICS_SMOKE_RELAY_PORT", PORT + 2))
+RELAY_METRICS_PORT = int(
+    os.environ.get("METRICS_SMOKE_RELAY_METRICS_PORT", PORT + 3)
+)
 
 #: Families one scrape of a running service must expose (the /metrics
 #: acceptance list; livedata_hbm_bytes may be sample-less on CPU but
@@ -358,10 +362,133 @@ def main() -> int:
             f"{len(entries.get('offsets', {}))} bookmarked topic(s), "
             f"snapshot age {age:.1f}s"
         )
+        # 6. fleet plane (ADR 0121): boot a REAL relay against the
+        # service's fan-out endpoint; its federated /results must list
+        # the upstream streams, its SSE must serve a valid da00
+        # keyframe at hop >= 1, and the livedata_relay_* families must
+        # scrape from ITS /metrics.
+        relay = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "esslivedata_tpu.fleet.service",
+                "--upstream",
+                f"http://127.0.0.1:{SERVE_PORT}",
+                "--serve-port",
+                str(RELAY_PORT),
+                "--metrics-port",
+                str(RELAY_METRICS_PORT),
+                "--poll-interval",
+                "0.5",
+                "--name",
+                "smoke-relay",
+            ],
+            env=env,
+        )
+        try:
+
+            def fetch_relay(path: str, port: int = RELAY_PORT, timeout=5.0):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=timeout
+                ) as response:
+                    return response.status, response.read()
+
+            relay_rows = None
+            while time.time() < deadline:
+                if relay.poll() is not None:
+                    print(f"relay died rc={relay.returncode}")
+                    return 1
+                try:
+                    status, body = fetch_relay("/results")
+                except Exception:
+                    time.sleep(0.5)
+                    continue
+                rows = json.loads(body).get("streams", [])
+                local = [
+                    row
+                    for row in rows
+                    if row.get("node") == "smoke-relay"
+                ]
+                if local:
+                    relay_rows = local
+                    break
+                time.sleep(0.5)
+            if not relay_rows:
+                print("relay /results never listed a relayed stream")
+                return 1
+            row = relay_rows[0]
+            if row.get("hop", 0) < 1:
+                print(f"relay row carries hop {row.get('hop')!r} (< 1)")
+                return 1
+            print(
+                f"relay index OK: {len(relay_rows)} relayed stream(s), "
+                f"hop={row['hop']}"
+            )
+            sse = urllib.request.urlopen(
+                f"http://127.0.0.1:{RELAY_PORT}{row['path']}", timeout=15
+            )
+            event_kind = blob = None
+            for raw in sse:
+                line = raw.decode().rstrip("\n")
+                if line.startswith("event: "):
+                    event_kind = line[len("event: "):]
+                elif line.startswith("data: "):
+                    blob = base64.b64decode(line[len("data: "):])
+                    break
+            sse.close()
+            if blob is None or event_kind != "keyframe":
+                print(f"relay SSE first event not a keyframe: {event_kind!r}")
+                return 1
+            header = decode_header(blob)
+            decoded = decode_da00(blob[HEADER_SIZE:])
+            if not decoded.variables:
+                print("relay keyframe decoded as da00 but carries nothing")
+                return 1
+            print(
+                f"relay SSE keyframe OK: epoch={header.epoch} "
+                f"seq={header.seq}, {len(decoded.variables)} da00 variables"
+            )
+            status, body = fetch_relay(
+                "/metrics", port=RELAY_METRICS_PORT
+            )
+            relay_parsed = parse_prometheus_text(body.decode())
+            relay_missing = [
+                family
+                for family in (
+                    "livedata_relay_frames",
+                    "livedata_relay_streams",
+                    "livedata_relay_hop",
+                    "livedata_relay_upstream_lag_seconds",
+                    "livedata_serving_encodes",
+                )
+                if family not in relay_parsed
+            ]
+            if relay_missing:
+                print(f"relay scrape missing families: {relay_missing}")
+                return 1
+            relayed_frames = sum(
+                value
+                for _n, _l, value in relay_parsed[
+                    "livedata_relay_frames"
+                ].samples
+            )
+            if relayed_frames < 1:
+                print("relay scraped but relayed no frames")
+                return 1
+            print(
+                f"relay metrics OK: {relayed_frames:.0f} frames relayed"
+            )
+        finally:
+            relay.terminate()
+            try:
+                relay.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                relay.kill()
         print(
             f"metrics smoke PASSED: {len(parsed)} families, "
             f"publish executes={publishes:.0f}, compiles={compiles:.0f}, "
-            f"serving plane live, durability plane checkpointing"
+            f"serving plane live, durability plane checkpointing, "
+            f"relay plane relaying"
         )
         return 0
     finally:

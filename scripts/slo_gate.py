@@ -285,6 +285,11 @@ def _smoke_report(control: str | None, scale: float):
             # One slow-tick stall mid-run, one consumer restart.
             "slow_tick": frozenset({windows // 3}),
             "consumer_restart": frozenset({(2 * windows) // 3}),
+            # One relay upstream drop (ADR 0121): the drill runs
+            # through a relay hop, and the hop must resync — one
+            # keyframe rebase per stream, zero unsignaled resets —
+            # with the parity/gap rules still green ACROSS it.
+            "relay_upstream_drop": frozenset({windows // 2}),
         },
         delay_s={"slow_tick": 0.2},
         restart_gap_windows=2,

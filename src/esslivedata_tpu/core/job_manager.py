@@ -238,6 +238,10 @@ class JobManager:
         #: Fault-injection schedule (harness/chaos.py, ADR 0120);
         #: None in production.
         self._chaos = None
+        #: Fleet assignment (fleet/assignment.py, ADR 0121): when set,
+        #: each window processes only the (stream, fuse-key) groups
+        #: this replica owns; None = single-replica (everything local).
+        self._fleet = None
         #: Last seen padded batch size per stream — the staged-signature
         #: memory warm-up plans against (a tick program's key includes
         #: the staged wire's shape, and commit-time warm-up must
@@ -404,6 +408,18 @@ class JobManager:
         ``note_state_lost`` containment the live failure would. None
         (production) costs one attribute check per window."""
         self._chaos = chaos
+
+    def set_fleet(self, assignment) -> None:
+        """Partition this manager across a replica fleet (duck-typed:
+        ``owns(stream, fuse_tag)`` — fleet/assignment.py, ADR 0121).
+        Each window then processes only the (stream, fuse-key) groups
+        rendezvous-hashed to THIS replica: fresh data for unowned
+        groups is dropped (a peer replica is accumulating it), while
+        state already accumulated here still flushes — so a rebalance
+        drains cleanly and the new owner's checkpoint-restore + replay
+        (ADR 0118) carries the group forward as a gap, not a reset."""
+        with self._lock:
+            self._fleet = assignment
 
     @property
     def reset_seq(self) -> int:
@@ -1532,6 +1548,10 @@ class JobManager:
                 if job_data or rec.has_primary_data:
                     work.append((rec, job_data))
             fuse_groups = self._plan_fused_steps(work)
+            if self._fleet is not None:
+                work, fuse_groups = self._apply_fleet_filter(
+                    work, fuse_groups
+                )
             # Publish-coalescing gate (ADR 0113): on a widened tick,
             # accumulation still runs every window but finalize (the
             # device round trip) only fires every Nth — idle flushes
@@ -1699,6 +1719,64 @@ class JobManager:
             # publish instead.)
             self._event_cache.end_window()
         return [r for r in results if r is not None]
+
+    def _apply_fleet_filter(
+        self,
+        work: list[tuple["_JobRecord", dict[str, Any]]],
+        fuse_groups: dict[tuple, list],
+    ) -> tuple[list, dict[tuple, list]]:
+        """Drop the groups a peer replica owns (ADR 0121; caller holds
+        the manager lock).
+
+        Ownership is decided at GROUP granularity: a job riding a fused
+        group follows its ``(stream, fuse-key)`` rendezvous hash — the
+        exact key ADR 0115 places on mesh slices — and an ungrouped job
+        follows its primary stream with a None fuse tag. A filtered job
+        keeps an EMPTY work entry when it has accumulation pending
+        (``has_primary_data``): a group that just moved away must still
+        flush what this replica already folded in, which is what makes
+        a rebalance a drain + replay instead of data loss."""
+        fleet = self._fleet
+        member_owned: dict[tuple[int, str], bool] = {}
+        kept_groups: dict[tuple, list] = {}
+        for (stream, fkey), members in fuse_groups.items():
+            owned = fleet.owns(stream, fkey)
+            if owned:
+                kept_groups[(stream, fkey)] = members
+            for rec, member_stream, _value, _offer in members:
+                member_owned[(id(rec), member_stream)] = owned
+        new_work: list[tuple[_JobRecord, dict[str, Any]]] = []
+        for rec, job_data in work:
+            grouped = [
+                s for s in job_data if (id(rec), s) in member_owned
+            ]
+            if grouped:
+                owned = any(
+                    member_owned[(id(rec), s)] for s in grouped
+                )
+            elif job_data:
+                # Ungrouped work keys by the job's FIXED anchor stream
+                # — its first declared primary (or, for primary-less
+                # jobs, its first subscribed stream) — NOT whichever
+                # streams happened to arrive this window: a window
+                # carrying only auxiliary data must land on the same
+                # replica as every other window of the job, or the
+                # partition stops being sticky and aux updates
+                # accumulate on an orphan copy.
+                anchor = sorted(
+                    rec.job.primary_streams
+                    or rec.job.subscribed_streams
+                )
+                owned = (
+                    fleet.owns(anchor[0], None) if anchor else True
+                )
+            else:
+                owned = True  # pure flush entry: always local
+            if owned:
+                new_work.append((rec, job_data))
+            elif rec.has_primary_data:
+                new_work.append((rec, {}))
+        return new_work, kept_groups
 
     def _plan_fused_steps(
         self, work: list[tuple[_JobRecord, dict[str, Any]]]

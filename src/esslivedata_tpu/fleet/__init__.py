@@ -1,0 +1,38 @@
+"""Fleet plane: horizontal scale-out past one process (ADR 0121).
+
+Three coupled pieces, one goal — serve "millions of users" from a
+topology of small processes instead of one big one:
+
+- **Relay tree** (:mod:`.relay`, :mod:`.sse_client`): chainable
+  fan-out hops. A relay consumes an upstream broadcast stream exactly
+  like any SSE client (resumable keyframe-then-delta wire, ADR 0117)
+  and re-fans through its own hub, so subscriber capacity scales with
+  relay count while the compute tier encodes once per tick.
+  ``livedata-relay`` (:mod:`.service`) is the container entry point.
+- **Replica partitioning** (:mod:`.assignment`): deterministic
+  rendezvous-hashed ``(stream, fuse-key) -> replica`` assignment —
+  ADR 0115's sticky placement generalized from mesh slices to service
+  replicas, membership-driven, with checkpoint/bookmark replay
+  (ADR 0118) turning reassignment into a gap, not a reset.
+- **Control plane** (:mod:`.control`): ``/results`` federation across
+  replicas and relays, and job-commit -> owning-replica routing.
+"""
+
+from .assignment import FleetAssignment, rendezvous_owner
+from .control import CommitRouter, fetch_index, peer_index
+from .relay import HubRelay, RelayChannel, RelayPlane
+from .sse_client import SSEClient, SSEFrame, SSEParser
+
+__all__ = [
+    "CommitRouter",
+    "FleetAssignment",
+    "HubRelay",
+    "RelayChannel",
+    "RelayPlane",
+    "SSEClient",
+    "SSEFrame",
+    "SSEParser",
+    "fetch_index",
+    "peer_index",
+    "rendezvous_owner",
+]

@@ -1,0 +1,257 @@
+"""Sticky (stream, fuse-key) -> replica partitioning (ADR 0121).
+
+ADR 0115's :class:`~..parallel.mesh_tick.DevicePlacement` spreads tick
+groups across the chips of ONE process; this module generalizes the
+same key — the ``(stream, fuse-key)`` tick/fused group — to a fleet of
+service replicas. Every replica computes the SAME deterministic
+assignment from the SAME replica set, with no coordinator:
+
+**Rendezvous (HRW) hashing**: for each group key, every replica id is
+scored with ``blake2b(replica | key)`` and the highest score owns the
+group. The property that matters operationally is *minimal movement*:
+when a replica joins or leaves, only the groups whose argmax changes
+move — exactly the joining/leaving replica's share (~1/N) — so a
+rebalance re-keys a handful of groups instead of reshuffling the world
+(pinned in tests/fleet/assignment_test.py). A moved group's state is a
+**replay-the-gap** event, not a reset: the new owner restores from the
+newest checkpoint and replays from the Kafka bookmark through the
+normal ingest path (ADR 0118) — nothing about the group's accumulation
+is lost, subscribers see one keyframe.
+
+The replica set is **membership-driven**: a static ``--fleet-replicas``
+list works for compose topologies, and the Kafka consumer-group
+monitor (kafka/consumer.py ``GroupMembership``) supplies the rebalance
+TRIGGER — its observer fires on every assignment, the caller
+re-resolves the replica roster from its configured source and applies
+it via :meth:`FleetAssignment.apply_membership` — so a crashed
+replica's groups fail over at the group-protocol cadence.
+
+The JobManager consults :meth:`owns` once per group per window
+(``JobManager.set_fleet``): owned groups process, unowned groups'
+fresh data is dropped on this replica (another replica is processing
+it) while already-accumulated state still flushes. Consults count into
+``livedata_fleet_group_checks{decision}`` so an operator can see the
+partition working from any replica's scrape.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections.abc import Callable, Iterable
+from hashlib import blake2b
+
+from ..telemetry.registry import REGISTRY, MetricFamily, Sample
+
+__all__ = ["FleetAssignment", "rendezvous_owner"]
+
+#: Ownership consults from the JobManager window path, by decision —
+#: ``owned`` groups process here, ``skipped`` groups belong to a peer.
+FLEET_GROUP_CHECKS = REGISTRY.counter(
+    "livedata_fleet_group_checks",
+    "Fleet-assignment ownership consults by the window path, by "
+    "decision (owned = processed on this replica)",
+    labelnames=("decision",),
+)
+
+
+def _score(replica: str, key: str) -> int:
+    digest = blake2b(
+        f"{replica}|{key}".encode(), digest_size=8
+    ).digest()
+    return int.from_bytes(digest, "big")
+
+
+def rendezvous_owner(replicas: Iterable[str], key: str) -> str:
+    """The HRW winner for ``key`` over ``replicas`` (must be
+    non-empty). Pure and stateless — every replica computing this over
+    the same set gets the same answer, which IS the protocol."""
+    best = None
+    best_score = -1
+    for replica in replicas:
+        score = _score(replica, key)
+        if score > best_score or (
+            score == best_score and (best is None or replica < best)
+        ):
+            best, best_score = replica, score
+    if best is None:
+        raise ValueError("empty replica set owns nothing")
+    return best
+
+
+class FleetAssignment:
+    """Deterministic group->replica table for one fleet.
+
+    ``self_id`` names THIS replica (required for :meth:`owns`; a
+    router/observer-only assignment may omit it). ``set_replicas`` /
+    ``apply_membership`` swap the replica set at runtime; observers
+    (registered with :meth:`add_observer`) fire outside the lock with
+    the new generation so the serving layer can trigger
+    checkpoint-restore replay for newly-owned groups.
+    """
+
+    def __init__(
+        self,
+        replicas: Iterable[str],
+        self_id: str | None = None,
+        *,
+        name: str = "fleet",
+    ) -> None:
+        replica_set = tuple(sorted(set(replicas)))
+        if not replica_set:
+            raise ValueError("a fleet needs at least one replica")
+        if self_id is not None and self_id not in replica_set:
+            raise ValueError(
+                f"self_id {self_id!r} not in replica set {replica_set}"
+            )
+        self._lock = threading.Lock()
+        self._replicas = replica_set
+        self.self_id = self_id
+        self._name = name
+        self._generation = 0
+        self._rebalances = 0
+        self._observers: list[Callable[[int, tuple[str, ...]], None]] = []
+        self._owned_child = FLEET_GROUP_CHECKS.labels(decision="owned")
+        self._skipped_child = FLEET_GROUP_CHECKS.labels(
+            decision="skipped"
+        )
+        self._collector_key = f"fleet:assignment:{name}"
+        REGISTRY.register_collector(self._collector_key, self._telemetry)
+
+    # -- assignment ---------------------------------------------------------
+    @staticmethod
+    def group_key(stream: str, fuse_tag=None) -> str:
+        """The canonical hash key for a tick/fused group. ``fuse_tag``
+        is the group's fuse key (``offer.key`` in the JobManager's
+        grouping) — deterministic across replicas because it derives
+        from layout digests and wire formats, not object ids; None
+        keys ungrouped work by stream alone."""
+        return stream if fuse_tag is None else f"{stream}|{fuse_tag!r}"
+
+    def owner(self, stream: str, fuse_tag=None) -> str:
+        with self._lock:
+            replicas = self._replicas
+        return rendezvous_owner(replicas, self.group_key(stream, fuse_tag))
+
+    def owns(self, stream: str, fuse_tag=None) -> bool:
+        """True when THIS replica owns the group (requires
+        ``self_id``). Counts the consult into the decision counter."""
+        if self.self_id is None:
+            raise ValueError("owns() needs a self_id; use owner()")
+        owned = self.owner(stream, fuse_tag) == self.self_id
+        (self._owned_child if owned else self._skipped_child).inc()
+        return owned
+
+    # -- membership ---------------------------------------------------------
+    @property
+    def replicas(self) -> tuple[str, ...]:
+        with self._lock:
+            return self._replicas
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    def set_replicas(
+        self,
+        replicas: Iterable[str],
+        *,
+        generation: int | None = None,
+    ) -> bool:
+        """Swap the replica set; returns True when it actually changed
+        (observers fire only then, OUTSIDE the lock). ``generation``
+        adopts the consumer-group generation when membership-driven;
+        otherwise a local counter increments."""
+        replica_set = tuple(sorted(set(replicas)))
+        if not replica_set:
+            raise ValueError("a fleet needs at least one replica")
+        with self._lock:
+            if replica_set == self._replicas:
+                if generation is not None:
+                    self._generation = max(self._generation, generation)
+                return False
+            if (
+                self.self_id is not None
+                and self.self_id not in replica_set
+            ):
+                raise ValueError(
+                    f"self_id {self.self_id!r} left the replica set "
+                    f"{replica_set}; a departing replica must stop, "
+                    "not silently own nothing"
+                )
+            self._replicas = replica_set
+            self._rebalances += 1
+            self._generation = (
+                generation
+                if generation is not None
+                else self._generation + 1
+            )
+            observers = list(self._observers)
+            gen = self._generation
+        for observer in observers:
+            observer(gen, replica_set)
+        return True
+
+    def apply_membership(
+        self, members: Iterable[str], generation: int
+    ) -> bool:
+        """Adopt a membership view: ``members`` are REPLICA IDS (from
+        static config or a deployment registry), ``generation`` the
+        rebalance generation that triggered the refresh. The Kafka
+        ``GroupMembership`` observer (kafka/consumer.py) supplies the
+        trigger and the generation — not the roster: a group member
+        only sees its own partition assignment, so the caller
+        re-resolves the replica set and passes it here."""
+        return self.set_replicas(members, generation=generation)
+
+    def add_observer(
+        self, observer: Callable[[int, tuple[str, ...]], None]
+    ) -> None:
+        with self._lock:
+            self._observers.append(observer)
+
+    # -- introspection ------------------------------------------------------
+    def moved_keys(
+        self, keys: Iterable[str], old_replicas: Iterable[str]
+    ) -> list[str]:
+        """Which of ``keys`` changed owner between ``old_replicas`` and
+        the current set — the operator's rebalance-impact probe (HRW
+        guarantees this is ~the joining/leaving replica's share)."""
+        with self._lock:
+            current = self._replicas
+        old = tuple(sorted(set(old_replicas)))
+        return [
+            key
+            for key in keys
+            if rendezvous_owner(old, key) != rendezvous_owner(current, key)
+        ]
+
+    def _telemetry(self) -> list[MetricFamily]:
+        replicas_fam = MetricFamily(
+            "livedata_fleet_replicas",
+            "gauge",
+            "Replicas in the fleet assignment's current view",
+        )
+        gen_fam = MetricFamily(
+            "livedata_fleet_generation",
+            "gauge",
+            "Membership generation the assignment was computed from",
+        )
+        rebalance_fam = MetricFamily(
+            "livedata_fleet_rebalances",
+            "counter",
+            "Replica-set changes applied to the assignment",
+        )
+        base = (("fleet", self._name),)
+        with self._lock:
+            replicas_fam.samples.append(
+                Sample("", base, len(self._replicas))
+            )
+            gen_fam.samples.append(Sample("", base, self._generation))
+            rebalance_fam.samples.append(
+                Sample("_total", base, self._rebalances)
+            )
+        return [replicas_fam, gen_fam, rebalance_fam]
+
+    def close(self) -> None:
+        REGISTRY.unregister_collector(self._collector_key, self._telemetry)

@@ -1,0 +1,122 @@
+"""Fleet control plane: federated results index + commit routing.
+
+Two small, deliberately stateless pieces (ADR 0121):
+
+**Federated ``/results``** — every node already serves its local index
+(serving/broadcast.py); federation is a peer hook
+(``BroadcastServer.set_index_peers``) returning EXTRA rows for streams
+served elsewhere, each with a ``url`` pointing at the right hop:
+
+- a **replica** lists its fleet peers' streams (jobs partitioned by the
+  rendezvous assignment live on exactly one replica each), so a client
+  asking any replica finds every stream and is pointed at its owner;
+- a **relay** lists upstream streams it has not cached yet
+  (:meth:`~.relay.RelayPlane._peer_rows`), so a client landing mid
+  warm-up is routed upstream instead of 404ed.
+
+Peer outages degrade the index to the reachable subset — federation
+must never make a healthy node's own streams unlistable.
+
+**Commit routing** — a job commit belongs on the replica that owns the
+job's source stream. :class:`CommitRouter` answers ``owner``/
+``owner_url`` from the same :class:`~.assignment.FleetAssignment` the
+window path uses, so the control plane and the data plane can never
+disagree about ownership. In the Kafka deployment the command topic is
+broadcast and every replica sees every commit; each replica starts the
+job (cheap: a scheduled job with no owned data never processes a
+window) but only the owner accumulates — the router exists for
+operators and HTTP surfaces that want to talk to the owner directly
+(job status, checkpoint inspection, targeted drain).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.request
+from collections.abc import Callable, Mapping
+
+from .assignment import FleetAssignment
+
+__all__ = ["CommitRouter", "fetch_index", "peer_index"]
+
+logger = logging.getLogger(__name__)
+
+
+def fetch_index(base_url: str, *, timeout: float = 5.0) -> list[dict]:
+    """One node's ``/results`` rows (raises on unreachable/malformed —
+    callers own the degrade policy)."""
+    with urllib.request.urlopen(
+        f"{base_url.rstrip('/')}/results", timeout=timeout
+    ) as response:
+        payload = json.loads(response.read())
+    rows = payload.get("streams")
+    if not isinstance(rows, list):
+        raise ValueError(f"{base_url}/results carried no stream list")
+    return rows
+
+
+def peer_index(
+    peers: Mapping[str, str], *, timeout: float = 5.0
+) -> Callable[[], list[dict]]:
+    """A ``BroadcastServer.set_index_peers`` hook federating the given
+    ``{node name: base url}`` peers. Each returned row gains ``node``
+    (who serves it) and ``url`` (the absolute SSE endpoint at that
+    node). An unreachable peer contributes nothing this scrape — and a
+    warning, once per outage transition, not per poll."""
+    down: set[str] = set()
+
+    def rows() -> list[dict]:
+        out: list[dict] = []
+        for name, base in peers.items():
+            try:
+                peer_rows = fetch_index(base, timeout=timeout)
+            except Exception as err:
+                if name not in down:
+                    logger.warning(
+                        "fleet peer %s (%s) unreachable: %s", name, base, err
+                    )
+                    down.add(name)
+                continue
+            down.discard(name)
+            for row in peer_rows:
+                merged = dict(row)
+                merged.setdefault("node", name)
+                merged["url"] = base.rstrip("/") + merged.get("path", "")
+                out.append(merged)
+        return out
+
+    return rows
+
+
+class CommitRouter:
+    """Job-commit -> owning-replica lookup over the fleet assignment.
+
+    ``replica_urls`` maps replica ids (the assignment's members) to
+    their base URLs; ids without a URL still resolve by name (the
+    Kafka-broadcast deployment needs no address to route correctness,
+    only the data-plane filter).
+    """
+
+    def __init__(
+        self,
+        assignment: FleetAssignment,
+        replica_urls: Mapping[str, str] | None = None,
+    ) -> None:
+        self.assignment = assignment
+        self.replica_urls = dict(replica_urls or {})
+
+    def owner(self, source_name: str, fuse_tag=None) -> str:
+        """The replica that owns ``source_name``'s groups — where a
+        commit for that source actually accumulates."""
+        return self.assignment.owner(source_name, fuse_tag)
+
+    def owner_url(self, source_name: str, fuse_tag=None) -> str | None:
+        return self.replica_urls.get(self.owner(source_name, fuse_tag))
+
+    def route(self, config) -> tuple[str, str | None]:
+        """(owner replica, owner base url) for a WorkflowConfig-shaped
+        commit (anything with ``job_id.source_name``)."""
+        source = config.job_id.source_name
+        owner = self.owner(source)
+        return owner, self.replica_urls.get(owner)

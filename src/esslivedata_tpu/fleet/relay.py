@@ -1,0 +1,535 @@
+"""Relay tree: re-fan an upstream broadcast stream CDN-style (ADR 0121).
+
+One compute-tier process encodes each publish tick ONCE (ADR 0117);
+its subscriber capacity is bounded by that one process's sockets and
+fan-out loop. A **relay** breaks the wall: it subscribes upstream
+exactly like any SSE client, reconstructs every frame with the delta
+decoder, and republishes through its OWN embedded
+:class:`~..serving.broadcast.BroadcastServer` hub — so subscriber
+capacity scales with relay count while the compute tier's work stays
+one encode per stream per tick (``bench.py --relay`` gates both ends).
+Relays chain: a relay's hub is itself a valid upstream, and every
+``/results`` row carries its ``hop`` distance from the compute tier.
+
+Resync discipline (the gap-not-reset contract across a hop):
+
+- A mid-stream delta gap (the relay itself was coalesced upstream, or
+  bytes were lost) makes the decoder raise; the relay drops its resume
+  position and re-subscribes for a keyframe (``reason="gap"``).
+- A reconnect that resumes cleanly — the upstream honored
+  ``Last-Event-ID`` and continued with deltas, or re-sent a keyframe in
+  the SAME epoch at a seq >= the held one — is a **soft** rebase: the
+  downstream token is unchanged, so downstream subscribers keep riding
+  deltas (no keyframe at all, the ideal outcome).
+- A reconnect keyframe whose epoch differs or whose seq REGRESSED means
+  the upstream restarted (fresh hub, epoch numbering reset — durability
+  restored the accumulation but not the serving counters): the relay
+  bumps its downstream generation, so its hub emits exactly ONE
+  epoch-bumped resync keyframe. Downstream sees a signaled rebase whose
+  decoded counts CONTINUE (a gap, never a reset) — pinned in
+  tests/fleet/relay_resume_test.py.
+
+Frame freshness (ADR 0120): the upstream's ``source_ts_ns`` metadata is
+re-attached on the downstream publish, so the e2e freshness histogram
+spans the whole tree; ``relay_ingress``/``relay_published`` stages
+decompose the hop's cost.
+
+Three faces, one core: :class:`RelayChannel` is the per-stream
+transport-independent state machine; :class:`HubRelay` drives it from
+an in-process upstream hub (the bench and the SLO drill — synchronous,
+deterministic, chaos-injectable via ``relay_upstream_drop``);
+:class:`RelayPlane` drives it from a real HTTP upstream via
+:class:`.sse_client.SSEClient` (the ``livedata-relay`` service).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import urllib.request
+from collections.abc import Callable
+
+from ..serving.broadcast import BroadcastServer, Subscription
+from ..serving.delta import DeltaDecoder, DeltaError, decode_header
+from ..telemetry.e2e import E2E_BUCKETS, observe_stage
+from ..telemetry.registry import REGISTRY, MetricFamily, Sample
+from .sse_client import SSEClient
+
+__all__ = ["HubRelay", "RelayChannel", "RelayPlane"]
+
+logger = logging.getLogger(__name__)
+
+#: Frames the relay ingested from upstream, by blob kind.
+RELAY_FRAMES = REGISTRY.counter(
+    "livedata_relay_frames",
+    "Frames a relay ingested from its upstream, by blob kind",
+    labelnames=("kind",),
+)
+#: Resyncs by class: ``reconnect`` = hard (upstream restart, downstream
+#: generation bump -> one keyframe), ``rebase`` = soft (same-epoch
+#: keyframe after reconnect, downstream continuity preserved), ``gap`` =
+#: mid-stream decoder gap (re-subscribe for a keyframe).
+RELAY_RESYNCS = REGISTRY.counter(
+    "livedata_relay_resyncs",
+    "Relay resynchronizations against upstream, by class",
+    labelnames=("reason",),
+)
+RELAY_RECONNECTS = REGISTRY.counter(
+    "livedata_relay_reconnects",
+    "Upstream connections the relay re-established after a drop",
+)
+#: Wall-clock age of upstream frames at relay ingress — how far behind
+#: the compute tier this hop runs (the headline relay-health signal).
+RELAY_UPSTREAM_LAG = REGISTRY.histogram(
+    "livedata_relay_upstream_lag_seconds",
+    "Freshness (wall minus source timestamp) of upstream frames at "
+    "relay ingress (ADR 0121)",
+    buckets=E2E_BUCKETS,
+)
+
+
+class RelayChannel:
+    """Per-stream relay state: upstream decoder -> downstream publish.
+
+    Transport-independent: callers hand it blobs (plus the frame's
+    source timestamp and whether a reconnect preceded it) and it owns
+    the resync classification described in the module docstring. The
+    downstream epoch token is ``(generation, upstream epoch)``: an
+    upstream IN-STREAM epoch bump (signaled reset/layout swap)
+    propagates as-is, and a ``generation`` bump marks an upstream
+    RESTART whose epoch numbering can no longer be compared.
+    """
+
+    __slots__ = (
+        "stream",
+        "hub",
+        "_decoder",
+        "_generation",
+        "_last_boot",
+        "_last_epoch",
+        "_last_seq",
+        "_observe_ingress",
+        "frames_relayed",
+    )
+
+    def __init__(
+        self,
+        stream: str,
+        hub: BroadcastServer,
+        *,
+        observe_ingress: bool = True,
+    ) -> None:
+        self.stream = stream
+        self.hub = hub
+        self._decoder = DeltaDecoder()
+        self._generation = 0
+        self._last_boot: str | None = None
+        self._last_epoch: int | None = None
+        self._last_seq: int | None = None
+        #: False when the transport already observed the
+        #: ``relay_ingress`` boundary (a HubRelay's upstream
+        #: Subscription dequeues with that stage) — the channel must
+        #: not fold the same crossing in twice.
+        self._observe_ingress = observe_ingress
+        self.frames_relayed = 0
+
+    @property
+    def generation(self) -> int:
+        return self._generation
+
+    def on_blob(
+        self,
+        blob: bytes,
+        source_ts_ns: int | None,
+        *,
+        after_reconnect: bool = False,
+        boot: str | None = None,
+    ) -> bool:
+        """Ingest one upstream blob; republish the reconstructed frame
+        downstream. Returns False when the channel hit an unrecoverable
+        gap — the caller must re-subscribe for a keyframe (with the
+        resume position dropped). ``boot`` is the upstream hub's
+        incarnation id (SSE ``id:`` prefix) when the transport carries
+        one: a changed boot across a reconnect IS an upstream restart,
+        however plausible the epoch/seq numbers look."""
+        header = decode_header(blob)
+        if self._observe_ingress:
+            observe_stage("relay_ingress", source_ts_ns)
+        if source_ts_ns is not None:
+            RELAY_UPSTREAM_LAG.observe(
+                max(0.0, (time.time_ns() - source_ts_ns) / 1e9)
+            )
+        restarted = (
+            boot is not None
+            and self._last_boot is not None
+            and boot != self._last_boot
+        )
+        if after_reconnect and header.keyframe and (
+            restarted
+            or (
+                self._last_epoch is not None
+                and (
+                    header.epoch != self._last_epoch
+                    or header.seq < (self._last_seq or 0)
+                )
+            )
+        ):
+            # Hard resync: the upstream restarted (boot changed, or its
+            # epoch/seq numbering regressed). Its state may well
+            # CONTINUE the old accumulation (durability restore), but
+            # the wire cannot prove it — a fresh process could equally
+            # have come back EMPTY with numbering that happens to look
+            # contiguous — so downstream gets one signaled keyframe.
+            # A channel is single-owner: one worker thread (RelayPlane)
+            # or one driver (HubRelay) each.
+            # graftlint: disable=JGL004 - single-owner channel instance
+            self._generation += 1
+            self._decoder = DeltaDecoder()
+            RELAY_RESYNCS.labels(reason="reconnect").inc()
+        elif after_reconnect and header.keyframe:
+            RELAY_RESYNCS.labels(reason="rebase").inc()
+        stale = (
+            not header.keyframe
+            and header.epoch == self._last_epoch
+            and self._last_seq is not None
+            and header.seq <= self._last_seq
+        )
+        try:
+            frame = self._decoder.apply(blob)
+        except DeltaError:
+            if header.keyframe:
+                # A keyframe always rebases cleanly on a fresh decoder.
+                self._decoder = DeltaDecoder()
+                frame = self._decoder.apply(blob)
+                RELAY_RESYNCS.labels(reason="rebase").inc()
+            else:
+                RELAY_RESYNCS.labels(reason="gap").inc()
+                return False
+        if boot is not None:
+            self._last_boot = boot
+        self._last_epoch, self._last_seq = header.epoch, header.seq
+        if stale:
+            # Attach-race duplicate (already covered by a keyframe):
+            # decoded to the held frame; republishing would burn a
+            # downstream encode for an unchanged tick.
+            return True
+        RELAY_FRAMES.labels(
+            kind="keyframe" if header.keyframe else "delta"
+        ).inc()
+        self.hub.publish_frame(
+            self.stream,
+            frame,
+            token=("relay", self._generation, header.epoch),
+            source_ts_ns=source_ts_ns,
+        )
+        observe_stage("relay_published", source_ts_ns)
+        self.frames_relayed += 1
+        return True
+
+
+class HubRelay:
+    """In-process relay hop over hub APIs (bench + SLO drill).
+
+    Subscribes to the upstream hub through the same
+    :meth:`BroadcastServer.subscribe` the SSE handler uses (with the
+    ``relay_ingress`` e2e stage) and republishes through its own hub.
+    Driven synchronously: callers :meth:`pump` after each upstream
+    publish tick — determinism is the point (harness/load.py), and the
+    socket transport has its own :class:`RelayPlane` + tests.
+
+    Chaos: a fired ``relay_upstream_drop`` (harness/chaos.py) drops
+    every upstream subscription; the next pump re-subscribes, which
+    lands fresh attach keyframes and exercises the resync
+    classification exactly as a socket drop would.
+    """
+
+    def __init__(
+        self,
+        upstream: BroadcastServer,
+        *,
+        name: str = "relay",
+        queue_limit: int = 32,
+        hub: BroadcastServer | None = None,
+        chaos=None,
+    ) -> None:
+        self.upstream = upstream
+        self.hub = (
+            hub
+            if hub is not None
+            else BroadcastServer(
+                port=None,
+                name=name,
+                queue_limit=queue_limit,
+                hop=upstream.hop + 1,
+            )
+        )
+        self._chaos = chaos
+        self._subs: dict[str, Subscription] = {}
+        self._channels: dict[str, RelayChannel] = {}
+        self._pending_reconnect: set[str] = set()
+
+    def set_chaos(self, chaos) -> None:
+        """Install the fault schedule post-warm-up (the harness rule:
+        explicit ``at`` ticks count steady consultations, and the warm
+        phase pumps too)."""
+        self._chaos = chaos
+
+    def attach(self) -> int:
+        """Subscribe to upstream streams not yet relayed; returns how
+        many were added. Called from every pump, so streams that appear
+        upstream mid-run (new jobs) are picked up."""
+        added = 0
+        for stream in self.upstream.cache.streams():
+            if stream in self._subs:
+                continue
+            self._subs[stream] = self.upstream.subscribe(
+                stream, stage="relay_ingress"
+            )
+            self._channels.setdefault(
+                stream,
+                # The Subscription's dequeue observes relay_ingress;
+                # the channel must not double-count the boundary.
+                RelayChannel(stream, self.hub, observe_ingress=False),
+            )
+            added += 1
+        return added
+
+    def _drop_upstream(self) -> None:
+        """The ``relay_upstream_drop`` chaos fault: every upstream
+        subscription dies; channels keep their decoder state (the relay
+        process did not restart) and the next pump re-attaches."""
+        for sub in self._subs.values():
+            self.upstream.unsubscribe(sub)
+        self._pending_reconnect.update(self._subs)
+        self._subs.clear()
+        RELAY_RECONNECTS.inc()
+
+    def pump(self, timeout: float = 1.0) -> int:
+        """Drain every upstream subscription into the downstream hub;
+        returns frames relayed. Synchronous-driver contract: upstream
+        publishes already happened, so ``depth`` is exact."""
+        if self._chaos is not None and self._chaos.fires(
+            "relay_upstream_drop"
+        ):
+            self._drop_upstream()
+        self.attach()
+        relayed = 0
+        for stream, sub in list(self._subs.items()):
+            channel = self._channels[stream]
+            while sub.depth() > 0:
+                blob, ts = sub.next_blob_meta(timeout=timeout)
+                if blob is None:  # pragma: no cover - depth>0 guarantees
+                    break
+                ok = channel.on_blob(
+                    blob,
+                    ts,
+                    after_reconnect=stream in self._pending_reconnect,
+                    boot=self.upstream.boot,
+                )
+                self._pending_reconnect.discard(stream)
+                if not ok:
+                    # Unrecoverable gap: re-subscribe for a keyframe.
+                    self.upstream.unsubscribe(sub)
+                    self._subs[stream] = self.upstream.subscribe(
+                        stream, stage="relay_ingress"
+                    )
+                    self._pending_reconnect.add(stream)
+                    sub = self._subs[stream]
+                    continue
+                relayed += 1
+        return relayed
+
+    def close(self) -> None:
+        for sub in self._subs.values():
+            self.upstream.unsubscribe(sub)
+        self._subs.clear()
+        self.hub.close()
+
+
+class RelayPlane:
+    """The ``livedata-relay`` service core: HTTP upstream -> local hub.
+
+    A discovery thread polls the upstream ``/results`` index; each
+    discovered stream gets a worker thread running an
+    :class:`.sse_client.SSEClient` loop into a :class:`RelayChannel`.
+    The local hub's ``/results`` federates: streams not yet relayed are
+    listed with a ``url`` pointing at the upstream hop
+    (fleet/control.py), so a client landing here mid-warm-up is routed
+    rather than 404ed.
+
+    ``upstream`` is a base URL (``http://host:port``) or a zero-arg
+    callable returning one (restart/failover tests).
+    """
+
+    def __init__(
+        self,
+        upstream: str | Callable[[], str],
+        hub: BroadcastServer,
+        *,
+        poll_interval_s: float = 2.0,
+        idle_timeout_s: float = 30.0,
+        name: str = "relay",
+        seed: int | None = None,
+    ) -> None:
+        self._upstream = (
+            upstream if callable(upstream) else (lambda u=upstream: u)
+        )
+        self.hub = hub
+        self._poll_interval_s = float(poll_interval_s)
+        self._idle_timeout_s = float(idle_timeout_s)
+        self._name = name
+        self._seed = seed
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._clients: dict[str, SSEClientWorker] = {}
+        self._upstream_rows: list[dict] = []
+        self._collector_key = f"fleet:relay:{name}"
+        REGISTRY.register_collector(self._collector_key, self._telemetry)
+        self.hub.set_index_peers(self._peer_rows)
+        self._discovery = threading.Thread(
+            target=self._discover_loop,
+            name=f"relay-discovery-{name}",
+            daemon=True,
+        )
+        self._discovery.start()
+
+    # -- discovery ----------------------------------------------------------
+    def upstream_url(self) -> str:
+        return self._upstream().rstrip("/")
+
+    def _fetch_index(self) -> list[dict]:
+        import json
+
+        with urllib.request.urlopen(
+            f"{self.upstream_url()}/results", timeout=5.0
+        ) as response:
+            return json.loads(response.read()).get("streams", [])
+
+    def _discover_loop(self) -> None:  # graft: thread=relay-discovery
+        while not self._stop.is_set():
+            try:
+                rows = self._fetch_index()
+            except Exception as err:
+                logger.debug("upstream index poll failed: %s", err)
+                self._stop.wait(self._poll_interval_s)
+                continue
+            with self._lock:
+                self._upstream_rows = rows
+                known = set(self._clients)
+            max_hop = max((row.get("hop", 0) for row in rows), default=0)
+            self.hub.hop = max_hop + 1
+            for row in rows:
+                stream = row.get("stream")
+                if not stream or stream in known:
+                    continue
+                self._start_worker(stream)
+            self._stop.wait(self._poll_interval_s)
+
+    def _start_worker(self, stream: str) -> None:
+        worker = SSEClientWorker(
+            stream,
+            self,
+            idle_timeout_s=self._idle_timeout_s,
+            seed=self._seed,
+        )
+        with self._lock:
+            if stream in self._clients:  # pragma: no cover - races only
+                return
+            self._clients[stream] = worker
+        worker.start()
+
+    # -- federation ---------------------------------------------------------
+    def _peer_rows(self) -> list[dict]:
+        """Upstream index rows for streams this relay has not cached
+        yet — the federated ``/results`` points clients at the right
+        hop instead of 404ing during warm-up."""
+        base = self.upstream_url()
+        with self._lock:
+            rows = list(self._upstream_rows)
+        out = []
+        for row in rows:
+            merged = dict(row)
+            merged["url"] = base + merged.get("path", "")
+            out.append(merged)
+        return out
+
+    # -- telemetry ----------------------------------------------------------
+    def _telemetry(self) -> list[MetricFamily]:
+        streams_fam = MetricFamily(
+            "livedata_relay_streams",
+            "gauge",
+            "Streams this relay is actively relaying from upstream",
+        )
+        hop_fam = MetricFamily(
+            "livedata_relay_hop",
+            "gauge",
+            "This relay's distance from the compute tier in hops",
+        )
+        base = (("relay", self._name),)
+        with self._lock:
+            n = len(self._clients)
+        streams_fam.samples.append(Sample("", base, n))
+        hop_fam.samples.append(Sample("", base, self.hub.hop))
+        return [streams_fam, hop_fam]
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._lock:
+            workers = list(self._clients.values())
+            self._clients.clear()
+        for worker in workers:
+            worker.stop()
+        self._discovery.join(timeout=5.0)
+        for worker in workers:
+            worker.join(timeout=5.0)
+        REGISTRY.unregister_collector(self._collector_key, self._telemetry)
+
+    @property
+    def stopped(self) -> bool:
+        return self._stop.is_set()
+
+
+class SSEClientWorker(threading.Thread):
+    """One stream's SSE consume loop (RelayPlane worker)."""
+
+    def __init__(
+        self,
+        stream: str,
+        plane: RelayPlane,
+        *,
+        idle_timeout_s: float,
+        seed: int | None,
+    ) -> None:
+        super().__init__(name=f"relay-{stream}", daemon=True)
+        self.stream = stream
+        self._plane = plane
+        self.channel = RelayChannel(stream, plane.hub)
+        self.client = SSEClient(
+            lambda: f"{plane.upstream_url()}/streams/{stream}",
+            idle_timeout_s=idle_timeout_s,
+            seed=seed,
+        )
+
+    def run(self) -> None:  # graft: thread=relay-stream
+        reconnects_seen = 0
+        for frame in self.client.frames():
+            if self._plane.stopped:
+                break
+            if self.client.reconnects > reconnects_seen:
+                RELAY_RECONNECTS.inc(
+                    self.client.reconnects - reconnects_seen
+                )
+                reconnects_seen = self.client.reconnects
+            ok = self.channel.on_blob(
+                frame.blob,
+                frame.source_ts_ns,
+                after_reconnect=frame.resumed,
+                boot=frame.boot,
+            )
+            if not ok:
+                # Unrecoverable gap: clean keyframe re-subscribe.
+                self.client.request_resync()
+
+    def stop(self) -> None:
+        self.client.stop()

@@ -1,0 +1,178 @@
+"""``livedata-relay``: the fan-out edge service (ADR 0121).
+
+A relay container runs this entry point with an ``--upstream`` base URL
+(the compute-tier service's ``--serve-port`` endpoint, or another
+relay's — relays chain) and its own ``--serve-port``. It consumes every
+upstream stream over SSE (fleet/sse_client.py: Last-Event-ID resume,
+bounded jittered reconnect backoff), reconstructs frames with the delta
+decoder, and re-fans them through its own BroadcastServer hub — the
+``docker-compose.fleet.yml`` topology scales subscriber capacity by
+adding relay replicas while the compute tier still encodes once per
+tick.
+
+Operational surface, same rules as every service runner:
+
+- ``--metrics-port`` serves ``/metrics`` + ``/healthz``
+  (telemetry/http.py) with the ``livedata_relay_*`` families
+  (docs/fleet.md has the reading guide);
+- ``--serve-port`` serves the standard fan-out endpoints
+  (docs/serving.md) with ``hop`` = upstream hop + 1 on every
+  ``/results`` row, federated so streams not yet relayed point at the
+  upstream hop;
+- SIGTERM/SIGINT drain and exit 0; a bind failure raises at startup
+  (the loud-bind rule).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import threading
+
+__all__ = ["build_arg_parser", "main"]
+
+logger = logging.getLogger(__name__)
+
+
+def _env_default(name: str, fallback=None):
+    value = os.environ.get(name)
+    return value if value not in (None, "") else fallback
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        description="esslivedata-tpu relay: re-fan an upstream result "
+        "stream (ADR 0121)"
+    )
+    parser.add_argument(
+        "--upstream",
+        default=_env_default("LIVEDATA_RELAY_UPSTREAM"),
+        help="upstream base URL, e.g. http://detector-data:5011 "
+        "(env: LIVEDATA_RELAY_UPSTREAM)",
+    )
+    parser.add_argument(
+        "--serve-port",
+        type=int,
+        default=_env_default("LIVEDATA_SERVE_PORT"),
+        help="port for this relay's /results + /streams endpoints "
+        "(env: LIVEDATA_SERVE_PORT)",
+    )
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument(
+        "--metrics-port",
+        type=int,
+        default=_env_default("LIVEDATA_METRICS_PORT"),
+        help="/metrics + /healthz port (env: LIVEDATA_METRICS_PORT)",
+    )
+    parser.add_argument(
+        "--queue-limit",
+        type=int,
+        default=32,
+        help="per-subscriber bounded queue (overflow coalesces, "
+        "ADR 0117)",
+    )
+    parser.add_argument(
+        "--heartbeat-s",
+        type=float,
+        default=10.0,
+        help="idle-stream SSE heartbeat interval on THIS relay's hub",
+    )
+    parser.add_argument(
+        "--poll-interval",
+        type=float,
+        default=2.0,
+        help="seconds between upstream /results discovery polls",
+    )
+    parser.add_argument(
+        "--idle-timeout",
+        type=float,
+        default=30.0,
+        help="seconds of upstream silence (no frames, no heartbeats) "
+        "before a stream connection is declared dead and redialed",
+    )
+    parser.add_argument(
+        "--name",
+        default=_env_default("LIVEDATA_RELAY_NAME", "relay"),
+        help="relay name on telemetry labels and /results rows",
+    )
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="validate configuration and exit (container smoke)",
+    )
+    return parser
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=os.environ.get("LIVEDATA_LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    args = build_arg_parser().parse_args(argv)
+    if not args.upstream:
+        build_arg_parser().error(
+            "--upstream (or LIVEDATA_RELAY_UPSTREAM) is required"
+        )
+    if args.serve_port is None:
+        build_arg_parser().error(
+            "--serve-port (or LIVEDATA_SERVE_PORT) is required"
+        )
+    if args.check:
+        print(
+            f"relay config OK: upstream={args.upstream} "
+            f"serve_port={args.serve_port} metrics_port={args.metrics_port}"
+        )
+        return 0
+
+    # Imports after --check so the container smoke stays dependency-light.
+    from ..serving.plane import ServingPlane
+    from ..telemetry.http import start_metrics_server
+    from .relay import RelayPlane
+
+    metrics = start_metrics_server(
+        None if args.metrics_port is None else int(args.metrics_port)
+    )
+    plane = ServingPlane(
+        port=int(args.serve_port),
+        host=args.host,
+        queue_limit=args.queue_limit,
+        name=args.name,
+        heartbeat_s=args.heartbeat_s,
+    )
+    relay = RelayPlane(
+        args.upstream,
+        plane.server,
+        poll_interval_s=args.poll_interval,
+        idle_timeout_s=args.idle_timeout,
+        name=args.name,
+    )
+    logger.info(
+        "relay %s up: upstream=%s serve=:%s metrics=%s",
+        args.name,
+        args.upstream,
+        plane.port,
+        "off" if metrics is None else f":{metrics.port}",
+    )
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):  # pragma: no cover - signal path
+        logger.info("signal %d: draining relay", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    try:
+        while not stop.is_set():
+            stop.wait(1.0)
+    finally:
+        relay.close()
+        plane.close()
+        if metrics is not None:
+            metrics.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,309 @@
+"""SSE consumption for the relay tree (ADR 0121).
+
+A relay subscribes to its upstream exactly like any browser: ``GET
+/streams/<job>/<output>`` on the upstream :class:`~..serving.broadcast.
+BroadcastServer` and reads the keyframe-then-delta event stream
+(docs/serving.md). This module is the transport half of that — the
+protocol parser plus a reconnecting client — and it is deliberately
+telemetry-free: :mod:`.relay` owns the ``livedata_relay_*`` counters,
+this layer just hands it frames.
+
+Wire dialect (what the hub's SSE handler emits, serving/broadcast.py):
+
+- ``id: <boot>:<epoch>:<seq>`` — the hub's incarnation id plus the
+  delta-codec position of the event; the client retains the last one
+  and echoes it as a ``Last-Event-ID`` header on reconnect, which lets
+  an upstream whose boot + epoch still match resume with DELTAS from
+  its recent-frame ring instead of a full keyframe. A boot change
+  across a reconnect means the upstream RESTARTED — its epoch/seq
+  numbering is no longer comparable, and the relay hard-resyncs.
+- ``event: keyframe|delta`` + ``data: <base64 blob>`` — the delta-codec
+  blob (serving/delta.py wire).
+- ``: source_ts_ns=<int>`` — frame freshness metadata (ADR 0120),
+  parsed so the relay can propagate the SOURCE timestamp downstream and
+  the e2e histogram spans the whole tree.
+- ``: keepalive`` — idle-stream heartbeat; carries no event but resets
+  the client's idle clock, so a silent-but-alive upstream is never
+  mistaken for a dead one.
+
+Reconnect discipline: every reconnect waits a **bounded, jittered
+exponential backoff** — base doubling per consecutive failure, capped
+at ``backoff_cap_s``, multiplied by a seeded uniform jitter in
+[0.5, 1.5) so a fleet of relays that lost the same upstream never
+reconnects in lockstep (graftlint JGL026 polices exactly this shape in
+client/relay modules). A successfully parsed frame resets the ladder.
+The wait runs on the stop event, so ``stop()`` interrupts a sleeping
+client immediately.
+"""
+
+from __future__ import annotations
+
+# graftlint: disable-file=JGL012 - parser/client state is single-owner by
+# contract: every SSEParser/SSEClient instance is created and driven by
+# exactly ONE consume loop (a relay stream worker, or a test's main
+# thread). The multi-role report is an aliasing artifact of analyzing
+# the in-process HubRelay drivers together with the socket workers —
+# no instance is ever shared across those roles.
+
+import base64
+import http.client
+import logging
+import threading
+from collections.abc import Callable, Iterator
+from dataclasses import dataclass
+from random import Random
+from urllib.parse import urlsplit
+
+__all__ = ["SSEClient", "SSEFrame", "SSEParser"]
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True, slots=True)
+class SSEFrame:
+    """One decoded SSE event from the upstream hub."""
+
+    kind: str  #: ``keyframe`` | ``delta`` (the hub's event names)
+    blob: bytes  #: the delta-codec blob (serving/delta.py wire)
+    boot: str | None  #: hub incarnation from ``id: <boot>:<epoch>:<seq>``
+    epoch: int | None
+    seq: int | None
+    source_ts_ns: int | None  #: from the ``: source_ts_ns=`` comment
+    resumed: bool = False  #: first frame after a reconnect (relay.py
+    #: uses it to classify hard-vs-soft resyncs)
+
+
+class SSEParser:
+    """Incremental line-fed SSE parser for the hub dialect.
+
+    Feed raw lines (bytes, newline included or not); a completed event
+    block (terminated by a blank line) with a ``data:`` field yields an
+    :class:`SSEFrame`. Comment-only blocks (keepalives) yield None but
+    count as liveness — the client resets its idle clock on EVERY line.
+    """
+
+    def __init__(self) -> None:
+        self._reset_block()
+
+    def _reset_block(self) -> None:
+        self._kind: str | None = None
+        self._data: bytes | None = None
+        self._id: tuple[str | None, int, int] | None = None
+        self._source_ts: int | None = None
+
+    def feed(self, raw: bytes) -> SSEFrame | None:
+        line = raw.rstrip(b"\r\n")
+        if line == b"":
+            frame = self._flush()
+            self._reset_block()
+            return frame
+        if line.startswith(b":"):
+            comment = line[1:].strip()
+            if comment.startswith(b"source_ts_ns="):
+                try:
+                    self._source_ts = int(comment.partition(b"=")[2])
+                except ValueError:
+                    self._source_ts = None
+            return None
+        field, _, value = line.partition(b":")
+        value = value.lstrip(b" ")
+        if field == b"event":
+            self._kind = value.decode("ascii", "replace")
+        elif field == b"data":
+            self._data = value
+        elif field == b"id":
+            parts = value.split(b":")
+            try:
+                if len(parts) == 3:
+                    self._id = (
+                        parts[0].decode("ascii"),
+                        int(parts[1]),
+                        int(parts[2]),
+                    )
+                elif len(parts) == 2:  # bootless dialect (tests, older)
+                    self._id = (None, int(parts[0]), int(parts[1]))
+            except (ValueError, UnicodeDecodeError):
+                self._id = None
+        # ``retry:`` and unknown fields: ignored (the client owns its
+        # own backoff policy).
+        return None
+
+    def _flush(self) -> SSEFrame | None:
+        if self._data is None:
+            return None
+        try:
+            blob = base64.b64decode(self._data, validate=True)
+        except Exception:
+            logger.warning("undecodable SSE data field (%d bytes)",
+                           len(self._data))
+            return None
+        boot, epoch, seq = (
+            self._id if self._id is not None else (None, None, None)
+        )
+        return SSEFrame(
+            kind=self._kind or "message",
+            blob=blob,
+            boot=boot,
+            epoch=epoch,
+            seq=seq,
+            source_ts_ns=self._source_ts,
+        )
+
+
+class SSEClient:
+    """Reconnecting SSE consumer of one upstream stream.
+
+    ``url`` is the stream endpoint, or a zero-arg callable returning it
+    — the provider form lets a restarted upstream come back on a new
+    address (kill-and-restart tests; DNS does this in production).
+
+    :meth:`frames` is the single public loop: it yields
+    :class:`SSEFrame` objects forever, reconnecting through errors with
+    the bounded jittered backoff described in the module docstring and
+    carrying ``Last-Event-ID`` resume metadata across reconnects. The
+    first frame after any reconnect is marked ``resumed=True``.
+
+    ``request_resync()`` drops the held resume position and the current
+    connection: the next attach is a clean keyframe subscribe — the
+    relay calls it when its decoder hits an unrecoverable gap.
+    """
+
+    def __init__(
+        self,
+        url: str | Callable[[], str],
+        *,
+        idle_timeout_s: float = 30.0,
+        backoff_base_s: float = 0.5,
+        backoff_cap_s: float = 10.0,
+        seed: int | None = None,
+    ) -> None:
+        self._url = url if callable(url) else (lambda u=url: u)
+        self._idle_timeout_s = float(idle_timeout_s)
+        self._backoff_base_s = float(backoff_base_s)
+        self._backoff_cap_s = float(backoff_cap_s)
+        self._rng = Random(seed)
+        self._stop = threading.Event()
+        self._conn: http.client.HTTPConnection | None = None
+        self._last_event_id: tuple[str, int, int] | None = None
+        self._lock = threading.Lock()
+        #: Completed (re)connect attempts after the first successful
+        #: one — the relay's reconnect counter reads this.
+        self.reconnects = 0
+
+    @property
+    def last_event_id(self) -> tuple[str, int, int] | None:
+        with self._lock:
+            return self._last_event_id
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._close_conn()
+
+    def request_resync(self) -> None:
+        """Forget the resume position and force a reconnect — the next
+        attach starts from a full keyframe."""
+        with self._lock:
+            self._last_event_id = None
+        self._close_conn()
+
+    def _close_conn(self) -> None:
+        with self._lock:
+            conn, self._conn = self._conn, None
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:  # pragma: no cover - close is best-effort
+                pass
+
+    def _connect(self) -> http.client.HTTPResponse:
+        url = self._url()
+        parts = urlsplit(url)
+        if parts.scheme != "http":
+            raise ValueError(f"SSEClient supports http:// only, got {url!r}")
+        conn = http.client.HTTPConnection(
+            parts.hostname,
+            parts.port or 80,
+            timeout=self._idle_timeout_s,
+        )
+        headers = {"Accept": "text/event-stream"}
+        with self._lock:
+            if self._last_event_id is not None:
+                headers["Last-Event-ID"] = "%s:%d:%d" % self._last_event_id
+            self._conn = conn
+        path = parts.path or "/"
+        if parts.query:
+            path += "?" + parts.query
+        conn.request("GET", path, headers=headers)
+        response = conn.getresponse()
+        if response.status != 200:
+            body = response.read(200)
+            conn.close()
+            raise ConnectionError(
+                f"upstream {url} answered {response.status}: {body!r}"
+            )
+        return response
+
+    def _backoff(self, attempts: int) -> None:
+        """Bounded exponential backoff with seeded jitter; waits on the
+        stop event so ``stop()`` interrupts it immediately."""
+        delay = min(
+            self._backoff_cap_s,
+            self._backoff_base_s * (2 ** min(attempts - 1, 16)),
+        )
+        delay *= 0.5 + self._rng.random()  # jitter: [0.5, 1.5) of base
+        self._stop.wait(delay)
+
+    def frames(self) -> Iterator[SSEFrame]:
+        attempts = 0
+        connected_before = False
+        while not self._stop.is_set():
+            try:
+                response = self._connect()
+            except (OSError, ValueError, http.client.HTTPException) as err:
+                attempts += 1
+                logger.debug("upstream connect failed (%s); backing off", err)
+                self._backoff(attempts)
+                continue
+            resumed = connected_before
+            if connected_before:
+                self.reconnects += 1
+            connected_before = True
+            parser = SSEParser()
+            try:
+                while not self._stop.is_set():
+                    line = response.readline()
+                    if not line:
+                        break  # upstream closed the stream
+                    frame = parser.feed(line)
+                    if frame is None:
+                        continue
+                    attempts = 0
+                    if (
+                        frame.boot is not None
+                        and frame.epoch is not None
+                        and frame.seq is not None
+                    ):
+                        with self._lock:
+                            self._last_event_id = (
+                                frame.boot,
+                                frame.epoch,
+                                frame.seq,
+                            )
+                    yield SSEFrame(
+                        kind=frame.kind,
+                        blob=frame.blob,
+                        boot=frame.boot,
+                        epoch=frame.epoch,
+                        seq=frame.seq,
+                        source_ts_ns=frame.source_ts_ns,
+                        resumed=resumed,
+                    )
+                    resumed = False
+            except (TimeoutError, OSError, http.client.HTTPException) as err:
+                logger.debug("upstream stream dropped (%s)", err)
+            finally:
+                self._close_conn()
+            if self._stop.is_set():
+                return
+            attempts += 1
+            self._backoff(attempts)

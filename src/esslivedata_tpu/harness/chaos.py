@@ -73,6 +73,11 @@ __all__ = [
 #:                     ingest for ``restart_gap_windows`` (the consume
 #:                     thread died and came back; accumulation must show
 #:                     a gap, never a reset)
+#: ``relay_upstream_drop``  fleet/relay.py's pump/worker loop — a fire
+#:                     drops the relay's upstream subscription(s) so it
+#:                     must reconnect and resync (ADR 0121); downstream
+#:                     subscribers must see at most one resync keyframe
+#:                     per stream and NO unsignaled reset
 #: ==================  ====================================================
 SITES = (
     "tick_dispatch",
@@ -80,6 +85,7 @@ SITES = (
     "decode_stall",
     "subscriber_stall",
     "consumer_restart",
+    "relay_upstream_drop",
 )
 
 CHAOS_INJECTIONS = REGISTRY.counter(

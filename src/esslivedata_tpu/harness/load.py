@@ -119,6 +119,13 @@ class LoadConfig:
     #: run, then drains and must recover exactly.
     wedge_every: int = 7
     chaos: ChaosSpec | None = None
+    #: Relay hops between the compute hub and the subscribers (ADR
+    #: 0121): the default drill runs THROUGH one in-process relay hop
+    #: (fleet/relay.py HubRelay, pumped synchronously per window), so
+    #: the parity/gap-discipline gates hold ACROSS the hop and the
+    #: ``relay_upstream_drop`` chaos site has a live target. 0 = the
+    #: pre-fleet direct topology.
+    relay_hops: int = 1
     #: None | "state_lost_signal" | "bounded_queues" — the acceptance
     #: control runs (see module docstring). Production containment is
     #: NEVER touched outside this harness.
@@ -232,7 +239,7 @@ class LoadHarness:
         )
         return (cumulative + rest)[:n_watch]
 
-    def _attach_subscribers(self, plane, streams_cached) -> None:
+    def _attach_subscribers(self, hub, streams_cached) -> None:
         cfg = self.config
         rng = Random(cfg.seed ^ 0x5105)
         watch = self._watch_list(streams_cached)
@@ -251,7 +258,7 @@ class LoadHarness:
                 wedged_until = (cfg.windows * 2) // 3
             self._subs.append(
                 _SimSubscriber(
-                    sub=plane.server.subscribe(stream),
+                    sub=hub.subscribe(stream),
                     stream=stream,
                     period=period,
                     wedged_until=wedged_until,
@@ -324,6 +331,7 @@ class LoadHarness:
         from ..core.timestamp import Timestamp
         from ..kafka.da00_compat import dataarray_to_da00
         from ..kafka.wire import encode_da00
+        from ..fleet.relay import RELAY_FRAMES, RELAY_RESYNCS, HubRelay
         from ..serving import ServingPlane, stream_key
         from ..serving.broadcast import SERVING_COALESCE_DROPS
         from ..telemetry.compile import COMPILE_EVENTS
@@ -341,13 +349,29 @@ class LoadHarness:
             queue_limit = 1 << 17
         mgr, streams, side = self._build_manager()
         plane = ServingPlane(port=None, queue_limit=queue_limit)
+        # Relay tree (ADR 0121): the drill's subscribers sit BEHIND
+        # ``relay_hops`` in-process relay hops, pumped synchronously
+        # after every publish — parity and gap-discipline are therefore
+        # gated ACROSS the tree, and the relay_upstream_drop chaos site
+        # drills the resync path.
+        relays: list[HubRelay] = []
+        upstream_hub = plane.server
+        for hop in range(max(0, cfg.relay_hops)):
+            relay = HubRelay(
+                upstream_hub,
+                name=f"slo_relay_{hop}",
+                queue_limit=queue_limit,
+            )
+            relays.append(relay)
+            upstream_hub = relay.hub
+        edge_hub = upstream_hub
         if chaos is not None:
             # Subscriptions capture the schedule at attach, so the hub
-            # gets it before subscribers exist; the MANAGER gets it
-            # only after the warm windows (a drill starts at steady
-            # state — and explicit `at` ticks count steady
+            # gets it before subscribers exist; the MANAGER and the
+            # relays get it only after the warm windows (a drill starts
+            # at steady state — and explicit `at` ticks count steady
             # consultations, not warm-up ones).
-            plane.server.set_chaos(chaos)
+            edge_hub.set_chaos(chaos)
         patched_note = None
         if cfg.disable_containment == "state_lost_signal":
             # CONTROL: the containment still resets state, but the
@@ -404,14 +428,18 @@ class LoadHarness:
                 end=Timestamp.from_ns(ts),
             )
             plane.publish_results(out, Timestamp.from_ns(ts))
-            streams_cached = sorted(plane.cache.streams())
+            for relay in relays:
+                relay.pump()
+            streams_cached = sorted(edge_hub.cache.streams())
             if not streams_cached:
                 raise RuntimeError("no streams cached after warm windows")
-            self._attach_subscribers(plane, streams_cached)
+            self._attach_subscribers(edge_hub, streams_cached)
             for sim in self._subs:
                 self._drain(sim, reference)  # attach keyframes
             compiles_warm = COMPILE_EVENTS.total()
             drops_before = SERVING_COALESCE_DROPS.total()
+            relay_resyncs0 = RELAY_RESYNCS.total()
+            relay_frames0 = RELAY_FRAMES.total()
             parity_checks0 = PARITY_CHECKS.total()
             parity_bad0 = PARITY_VIOLATIONS.total()
             gaps0 = GAP_VIOLATIONS.total()
@@ -419,6 +447,14 @@ class LoadHarness:
             scrape_before = render_text(REGISTRY.collect())
             if chaos is not None:
                 mgr.set_chaos(chaos)
+                if relays:
+                    # Only the FIRST hop consults relay_upstream_drop:
+                    # the site's per-consultation counter is shared, so
+                    # a second consulting relay would halve the
+                    # schedule's window arithmetic (an `at` tick meant
+                    # for window N would fire at N/2) and split fires
+                    # across hops nondeterministically.
+                    relays[0].set_chaos(chaos)
             t_run = time.perf_counter()
 
             pause = 0
@@ -459,6 +495,8 @@ class LoadHarness:
                         )
                 observe_stage("published", source_ts)
                 plane.publish_results(out, end)
+                for relay in relays:
+                    relay.pump()
                 WINDOWS_DRIVEN.inc()
                 peak_depth = max(
                     peak_depth,
@@ -486,12 +524,15 @@ class LoadHarness:
                 self._drain(sim, reference)
             steady_compiles = COMPILE_EVENTS.total() - compiles_warm
             PEAK_QUEUE_DEPTH.set(peak_depth)
-            qos = plane.qos()
+            qos = edge_hub.qos()
             report = {
                 "streams": cfg.streams,
                 "jobs": cfg.streams * cfg.jobs_per_stream,
                 "subscribers": cfg.subscribers,
                 "windows": cfg.windows,
+                "relay_hops": len(relays),
+                "relay_resyncs": RELAY_RESYNCS.total() - relay_resyncs0,
+                "relay_frames": RELAY_FRAMES.total() - relay_frames0,
                 "paused_windows": paused_windows,
                 "events_per_window": cfg.events_per_window,
                 "wall_ms_per_window": 1e3 * wall_s / max(1, cfg.windows),
@@ -522,5 +563,7 @@ class LoadHarness:
             if patched_note is not None:
                 Job.note_state_lost = patched_note  # type: ignore[method-assign]
             mgr.shutdown()
+            for relay in relays:
+                relay.close()
             plane.close()
         return report

@@ -15,16 +15,21 @@ protocol keeps it testable (and usable) without the library.
 from __future__ import annotations
 
 import logging
-from collections.abc import Sequence
+import threading
+from collections.abc import Callable, Sequence
 from contextlib import contextmanager
 from typing import Any, Protocol
 
+from ..telemetry.registry import REGISTRY, MetricFamily, Sample
+
 __all__ = [
     "AssignableConsumer",
+    "GroupMembership",
     "assign_all_partitions",
     "consumer_from_config",
     "kafka_client_config",
     "librdkafka_config",
+    "subscribe_with_group",
     "validate_topics_exist",
 ]
 
@@ -150,6 +155,153 @@ def assign_all_partitions(
         seeked,
     )
     return len(assignments)
+
+
+class GroupMembership:
+    """Consumer-group membership/generation as scrapeable telemetry.
+
+    Rebalances used to be invisible outside librdkafka's own logs: a
+    replica could lose half its partitions and nothing on ``/metrics``
+    moved. This class is the keyed collector that fixes it (the fleet
+    plane's rebalance signal, ADR 0121): wire its ``on_assign``/
+    ``on_revoke`` as the rebalance callbacks (or call
+    :func:`subscribe_with_group`) and every rebalance surfaces as
+
+    - ``livedata_kafka_group_generation{group}`` — assignments seen by
+      THIS member (a local, monotone stand-in for the group protocol's
+      generation, which librdkafka does not expose per-callback);
+    - ``livedata_kafka_group_assigned_partitions{group}`` — current
+      partition count (0 while revoked mid-rebalance);
+    - ``livedata_kafka_group_rebalances_total{group,event}`` — assign/
+      revoke callback fires.
+
+    ``observer`` (optional) is called OUTSIDE the lock after every
+    assign with ``(generation, partitions)`` — the REBALANCE SIGNAL,
+    not a membership list: a member only learns its own
+    ``TopicPartition`` assignment from the group protocol, never the
+    peer roster. A fleet-aware caller reacts by re-resolving the
+    replica set from its own source (static ``--fleet-replicas``
+    config, a deployment registry) and handing THAT to
+    ``FleetAssignment.apply_membership(members, generation)`` — the
+    signal makes failover happen at group-protocol cadence, the
+    roster comes from elsewhere.
+    """
+
+    def __init__(
+        self,
+        group_id: str,
+        *,
+        observer: Callable[[int, tuple], None] | None = None,
+    ) -> None:
+        self.group_id = group_id
+        self._lock = threading.Lock()
+        self._generation = 0
+        self._assigns = 0
+        self._revokes = 0
+        self._partitions: tuple = ()
+        self._observer = observer
+        self._collector_key = f"kafka:group:{group_id}"
+        REGISTRY.register_collector(self._collector_key, self._telemetry)
+
+    # confluent_kafka rebalance-callback signatures -------------------------
+    def on_assign(self, consumer, partitions) -> None:
+        with self._lock:
+            self._generation += 1
+            self._assigns += 1
+            self._partitions = tuple(partitions)
+            generation = self._generation
+            observer = self._observer
+        logger.info(
+            "group %s rebalance: %d partition(s) assigned "
+            "(generation %d)",
+            self.group_id,
+            len(partitions),
+            generation,
+        )
+        if observer is not None:
+            observer(generation, tuple(partitions))
+
+    def on_revoke(self, consumer, partitions) -> None:
+        with self._lock:
+            self._revokes += 1
+            self._partitions = ()
+        logger.info(
+            "group %s rebalance: %d partition(s) revoked",
+            self.group_id,
+            len(partitions),
+        )
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    @property
+    def partitions(self) -> tuple:
+        with self._lock:
+            return self._partitions
+
+    def _telemetry(self) -> list[MetricFamily]:
+        gen_fam = MetricFamily(
+            "livedata_kafka_group_generation",
+            "gauge",
+            "Consumer-group assignments this member has seen (monotone "
+            "per process; a jump means a rebalance happened)",
+        )
+        parts_fam = MetricFamily(
+            "livedata_kafka_group_assigned_partitions",
+            "gauge",
+            "Partitions currently assigned to this group member "
+            "(0 while revoked mid-rebalance)",
+        )
+        rebalance_fam = MetricFamily(
+            "livedata_kafka_group_rebalances",
+            "counter",
+            "Rebalance callbacks fired on this member, by event",
+        )
+        base = (("group", self.group_id),)
+        with self._lock:
+            gen_fam.samples.append(Sample("", base, self._generation))
+            parts_fam.samples.append(
+                Sample("", base, len(self._partitions))
+            )
+            rebalance_fam.samples.append(
+                Sample(
+                    "_total", base + (("event", "assign"),), self._assigns
+                )
+            )
+            rebalance_fam.samples.append(
+                Sample(
+                    "_total", base + (("event", "revoke"),), self._revokes
+                )
+            )
+        return [gen_fam, parts_fam, rebalance_fam]
+
+    def close(self) -> None:
+        REGISTRY.unregister_collector(self._collector_key, self._telemetry)
+
+
+def subscribe_with_group(
+    consumer, topics: Sequence[str], membership: GroupMembership
+) -> None:
+    """Group-managed subscription (the fleet-mode exception to this
+    module's assign-at-high-watermark rule): the broker's group
+    protocol partitions ``topics`` across every live member, and the
+    ``membership`` monitor surfaces each rebalance as telemetry + the
+    fleet observer hook. Topics are validated first, same as the
+    assign path — a typo must fail loudly."""
+    validate_topics_exist(consumer, topics)
+    consumer.subscribe(
+        list(topics),
+        on_assign=membership.on_assign,
+        on_revoke=membership.on_revoke,
+    )
+    logger.info(
+        "subscribed %d topic(s) under group %s (membership-driven)",
+        len(topics),
+        membership.group_id,
+    )
 
 
 # Loader-config keys -> librdkafka settings. Everything the defaults/

@@ -132,6 +132,17 @@ class DataServiceBuilder:
         self.serve_port: int | None = (
             int(_serve_env) if _serve_env else None
         )
+        # Fleet partitioning (fleet/assignment.py, ADR 0121): the full
+        # replica-id set plus this replica's id — both required
+        # together; the JobManager then processes only the
+        # (stream, fuse-key) groups rendezvous-hashed here. The
+        # runner's --fleet-replicas/--fleet-self override after build.
+        self.fleet_replicas: str | None = (
+            _os.environ.get("LIVEDATA_FLEET_REPLICAS") or None
+        )
+        self.fleet_self: str | None = (
+            _os.environ.get("LIVEDATA_FLEET_SELF") or None
+        )
         # Durability plane (durability/, ADR 0118): periodic state +
         # offset checkpoints under --checkpoint-dir, AOT tick-program
         # warm-up under --warmup. The runner's flags override after
@@ -256,6 +267,32 @@ class DataServiceBuilder:
             placement=placement,
             durability=durability,
         )
+        if bool(self.fleet_replicas) != bool(self.fleet_self):
+            raise ValueError(
+                "--fleet-replicas and --fleet-self must be set "
+                "together (a replica that doesn't know the set, or a "
+                "set without an identity, would silently own the "
+                "wrong groups)"
+            )
+        if self.fleet_replicas and self.fleet_self:
+            from ..fleet import FleetAssignment
+
+            replica_ids = [
+                r.strip()
+                for r in self.fleet_replicas.split(",")
+                if r.strip()
+            ]
+            assignment = FleetAssignment(
+                replica_ids,
+                self.fleet_self,
+                name=f"{self.instrument_name}_{self.service_name}",
+            )
+            job_manager.set_fleet(assignment)
+            logger.info(
+                "fleet partitioning: replica %r of %s",
+                self.fleet_self,
+                replica_ids,
+            )
         if self.warmup:
             from ..durability import (
                 CompileWarmupService,
@@ -392,6 +429,23 @@ class DataServiceRunner:
             "(LIVEDATA_TICK_PROGRAM=0 equivalently; parity/triage)",
         )
         parser.add_argument(
+            "--fleet-replicas",
+            default=None,
+            metavar="ID,ID,...",
+            help="fleet partitioning (ADR 0121): the full replica-id "
+            "set this service belongs to; each (stream, fuse-key) "
+            "group is rendezvous-hashed onto exactly one replica "
+            "(LIVEDATA_FLEET_REPLICAS equivalently; requires "
+            "--fleet-self)",
+        )
+        parser.add_argument(
+            "--fleet-self",
+            default=None,
+            metavar="ID",
+            help="this replica's id within --fleet-replicas "
+            "(LIVEDATA_FLEET_SELF equivalently)",
+        )
+        parser.add_argument(
             "--kafka-bootstrap",
             default=None,
             help="override the broker from the kafka config namespace",
@@ -449,6 +503,10 @@ class DataServiceRunner:
             builder.mesh_spec = args.mesh or None
         if args.serve_port is not None:
             builder.serve_port = args.serve_port
+        if args.fleet_replicas is not None:
+            builder.fleet_replicas = args.fleet_replicas or None
+        if args.fleet_self is not None:
+            builder.fleet_self = args.fleet_self or None
         if args.checkpoint_dir is not None:
             builder.checkpoint_dir = args.checkpoint_dir or None
         if args.checkpoint_interval is not None:

@@ -44,6 +44,7 @@ from __future__ import annotations
 import base64
 import json
 import logging
+import os
 import queue
 import threading
 import time
@@ -52,7 +53,12 @@ from urllib.parse import unquote
 
 from ..telemetry.e2e import observe_stage
 from ..telemetry.registry import REGISTRY, MetricFamily, Sample
-from .delta import DeltaEncoder, decode_header, encode_keyframe
+from .delta import (
+    DeltaEncoder,
+    decode_header,
+    encode_delta,
+    encode_keyframe,
+)
 from .result_cache import ResultCache
 
 __all__ = ["BroadcastServer", "Subscription", "stream_key"]
@@ -76,6 +82,25 @@ SERVING_COALESCE_DROPS = REGISTRY.counter(
     "livedata_serving_coalesce_drops",
     "Slow-subscriber backlogs dropped and replaced by a keyframe",
 )
+#: Hub-side encodes per publish tick — ONE per (stream, tick) however
+#: many subscribers or relays are attached (the fan-out saving; the
+#: relay bench gates encodes/tick at the compute hub directly).
+SERVING_ENCODES = REGISTRY.counter(
+    "livedata_serving_encodes",
+    "Delta/keyframe encodes performed by the broadcast hub (one per "
+    "stream per publish tick, independent of subscriber count)",
+    labelnames=("kind",),
+)
+#: Last-Event-ID resume outcomes (relay reconnects, browser refreshes):
+#: ``delta`` = the gap was served from the recent-frame ring without a
+#: full keyframe, ``current`` = the client was already at the head,
+#: ``keyframe`` = epoch mismatch or ring miss forced a full rebase.
+SERVING_RESUMES = REGISTRY.counter(
+    "livedata_serving_resumes",
+    "Subscriber attaches that carried Last-Event-ID resume metadata, "
+    "by outcome",
+    labelnames=("result",),
+)
 
 
 def stream_key(job: str, output: str) -> str:
@@ -95,10 +120,15 @@ class Subscription:
     boundary in (ADR 0120) — the blob wire itself is untouched.
     """
 
-    __slots__ = ("stream", "sub_id", "_queue", "delivered", "chaos")
+    __slots__ = ("stream", "sub_id", "_queue", "delivered", "chaos", "stage")
 
     def __init__(
-        self, stream: str, sub_id: int, limit: int, chaos=None
+        self,
+        stream: str,
+        sub_id: int,
+        limit: int,
+        chaos=None,
+        stage: str = "subscriber_delivered",
     ) -> None:
         self.stream = stream
         self.sub_id = sub_id
@@ -111,6 +141,12 @@ class Subscription:
         #: ``subscriber_stall`` delays THIS consumer's dequeue — the
         #: slow-reader shape the coalesce path exists for.
         self.chaos = chaos
+        #: The e2e boundary this consumer's dequeue observes (ADR
+        #: 0120/0121): end viewers record ``subscriber_delivered``; a
+        #: relay's upstream subscription records ``relay_ingress`` so
+        #: the freshness histogram decomposes per hop instead of
+        #: double-counting the headline stage.
+        self.stage = stage
 
     def next_blob(self, timeout: float = 0.5) -> bytes | None:
         """The next blob, or None after ``timeout`` — callers loop and
@@ -131,7 +167,7 @@ class Subscription:
             blob, ts = self._queue.get(timeout=timeout)
         except queue.Empty:
             return None, None
-        observe_stage("subscriber_delivered", ts)
+        observe_stage(self.stage, ts)
         return blob, ts
 
     def depth(self) -> int:
@@ -170,13 +206,40 @@ class BroadcastServer:
         host: str = "0.0.0.0",
         queue_limit: int = 32,
         name: str = "serving",
+        heartbeat_s: float = 10.0,
+        hop: int = 0,
         registry=REGISTRY,
     ) -> None:
         if queue_limit < 1:
             raise ValueError("queue_limit must be >= 1")
+        if heartbeat_s <= 0:
+            raise ValueError("heartbeat_s must be positive")
         self.cache = cache if cache is not None else ResultCache()
         self._queue_limit = int(queue_limit)
         self._name = name
+        #: Seconds between SSE heartbeat comments on an idle stream —
+        #: how fast a downstream relay/browser can tell a dead upstream
+        #: from a quiet one (fleet/sse_client.py sizes its idle timeout
+        #: from this).
+        self.heartbeat_s = float(heartbeat_s)
+        #: Distance from the compute tier in relay hops: 0 at the
+        #: publishing service, upstream+1 at each relay. Rides every
+        #: ``/results`` row so clients (and the metrics smoke) can see
+        #: which tier they landed on.
+        self.hop = int(hop)
+        #: Hub incarnation id, leading every SSE event id
+        #: (``<boot>:<epoch>:<seq>``). Epoch/seq numbering restarts
+        #: with the process, so a ``Last-Event-ID`` from a PREVIOUS
+        #: incarnation is not comparable — a boot mismatch forces the
+        #: keyframe attach instead of silently treating the client as
+        #: caught up (and lets a relay tell "upstream restarted" from
+        #: "my connection blipped", ADR 0121).
+        self.boot = os.urandom(4).hex()
+        #: Optional callable returning extra ``/results`` rows for
+        #: streams served by PEER nodes (fleet/control.py): each row
+        #: carries a ``url`` pointing at the right hop. None = local
+        #: index only.
+        self._index_peers = None
         self._lock = threading.Lock()
         self._subscribers: dict[str, dict[int, Subscription]] = {}
         self._next_sub_id = 0
@@ -191,6 +254,12 @@ class BroadcastServer:
         #: Fault-injection schedule handed to new subscriptions
         #: (harness/chaos.py); None in production.
         self._chaos = None
+        #: THIS hub's publish-tick encodes (hub-lock-guarded): the
+        #: global ``livedata_serving_encodes`` counter sums every hub
+        #: in the process, but the relay bench must prove the COMPUTE
+        #: hub alone encodes once per stream per tick however many
+        #: relays fan it out (ADR 0121).
+        self.encodes = 0
         self._stopped = threading.Event()
         self._registry = registry
         self._collector_key = f"serving:{name}"
@@ -237,32 +306,134 @@ class BroadcastServer:
         clean, which is exactly how a partial-outage drill looks."""
         self._chaos = chaos
 
+    def set_index_peers(self, peers) -> None:
+        """Install a callable returning extra ``/results`` rows for
+        streams served by peer nodes (fleet/control.py federation):
+        replicas list each other's partitions, a relay lists upstream
+        streams it has not (yet) relayed — each row's ``url`` points
+        the client at the right hop. None removes the hook."""
+        self._index_peers = peers
+
     # -- hub ---------------------------------------------------------------
-    def subscribe(self, stream: str) -> Subscription:
+    def subscribe(
+        self,
+        stream: str,
+        *,
+        resume: tuple[int, int] | None = None,
+        stage: str = "subscriber_delivered",
+    ) -> Subscription:
         """Attach a consumer; a keyframe of the latest cached tick is
         enqueued immediately (registration and the cache read happen
         under the hub lock, so a concurrent publish either reaches this
         subscriber's queue or is already inside its keyframe — the
-        stale-delta rule in DeltaDecoder absorbs the overlap)."""
+        stale-delta rule in DeltaDecoder absorbs the overlap).
+
+        ``resume`` is Last-Event-ID-style metadata ``(epoch, seq)`` — a
+        reconnecting client that still holds the frame it decoded at
+        that tick. When the epoch still matches and the recent-frame
+        ring covers the gap, the missed ticks are served as DELTAS
+        against the client's held frame instead of a full keyframe (the
+        relay reconnect path, ADR 0121); an epoch mismatch or a gap
+        older than the ring falls back to today's keyframe attach, and
+        a client already at the head gets nothing queued (live frames
+        follow). Outcomes count into ``livedata_serving_resumes``.
+
+        ``stage`` names the e2e boundary this consumer's dequeues
+        observe (see :class:`Subscription`).
+        """
         with self._lock:
             sub_id = self._next_sub_id
             self._next_sub_id += 1
             sub = Subscription(
-                stream, sub_id, self._queue_limit, chaos=self._chaos
+                stream,
+                sub_id,
+                self._queue_limit,
+                chaos=self._chaos,
+                stage=stage,
             )
             self._subscribers.setdefault(stream, {})[sub_id] = sub
             cached = self.cache.latest(stream)
             if cached is not None:
-                blob = encode_keyframe(
-                    cached.frame, epoch=cached.epoch, seq=cached.seq
-                )
-                sub._offer(
-                    blob, lambda: blob, self._last_source_ts.get(stream)
-                )
-                sub.delivered += 1
-                self._frames_key.inc()
-                self._bytes_key.inc(len(blob))
+                ts = self._last_source_ts.get(stream)
+                blobs, outcome = self._attach_blobs(stream, cached, resume)
+                resync: list[bytes] = []
+
+                def resync_keyframe() -> bytes:
+                    # Overflow during a multi-delta resume must coalesce
+                    # to a REAL keyframe (enqueuing a later delta would
+                    # hand the client an unsignaled seq gap); encoded at
+                    # most once, and reused when the attach blob already
+                    # is that keyframe.
+                    if blobs and decode_header(blobs[-1]).keyframe:
+                        return blobs[-1]
+                    if not resync:
+                        resync.append(
+                            encode_keyframe(
+                                cached.frame,
+                                epoch=cached.epoch,
+                                seq=cached.seq,
+                            )
+                        )
+                    return resync[0]
+
+                for blob in blobs:
+                    header = decode_header(blob)
+                    if sub._offer(blob, resync_keyframe, ts):
+                        sub.delivered += 1
+                        if header.keyframe:
+                            self._frames_key.inc()
+                            self._bytes_key.inc(len(blob))
+                        else:
+                            self._frames_delta.inc()
+                            self._bytes_delta.inc(len(blob))
+                    else:
+                        sub.delivered += 1
+                        SERVING_COALESCE_DROPS.inc()
+                        self._frames_key.inc()
+                        self._bytes_key.inc(len(resync_keyframe()))
+                if resume is not None:
+                    SERVING_RESUMES.labels(result=outcome).inc()
         return sub
+
+    def _attach_blobs(
+        self, stream: str, latest, resume: tuple[int, int] | None
+    ) -> tuple[list[bytes], str]:
+        """The blobs a fresh subscription starts with (caller holds the
+        hub lock): a keyframe normally; under a matching ``resume``,
+        the ring-served delta gap or nothing at all. The keyframe is
+        only encoded on the branches that return it — a clean resume
+        must not pay an O(frame) copy under the hub lock."""
+
+        def keyframe() -> list[bytes]:
+            return [
+                encode_keyframe(
+                    latest.frame, epoch=latest.epoch, seq=latest.seq
+                )
+            ]
+
+        if resume is None:
+            return keyframe(), "keyframe"
+        epoch, seq = resume
+        if epoch != latest.epoch:
+            return keyframe(), "keyframe"
+        if seq >= latest.seq:
+            # Already at (or somehow past) the head: live deltas apply
+            # directly to the client's held frame.
+            return [], "current"
+        ring = {
+            frame.seq: frame.frame for frame in self.cache.recent(stream)
+        }
+        if any(s not in ring for s in range(seq, latest.seq + 1)):
+            # The gap predates the ring (or spans an epoch reset that
+            # cleared it): only a full rebase is sound.
+            return keyframe(), "keyframe"
+        deltas = [
+            encode_delta(
+                ring[s - 1], ring[s], epoch=latest.epoch, seq=s
+            )
+            for s in range(seq + 1, latest.seq + 1)
+        ]
+        return deltas, "delta"
 
     def unsubscribe(self, sub: Subscription) -> None:
         with self._lock:
@@ -288,6 +459,9 @@ class BroadcastServer:
             encoder = self._encoders[stream] = DeltaEncoder()
         blob = encoder.encode(frame, epoch=cached.epoch, seq=cached.seq)
         is_keyframe = bool(decode_header(blob).keyframe)
+        SERVING_ENCODES.labels(
+            kind="keyframe" if is_keyframe else "delta"
+        ).inc()
         resync: list[bytes] = []
 
         def resync_keyframe() -> bytes:
@@ -302,11 +476,13 @@ class BroadcastServer:
                         frame, epoch=cached.epoch, seq=cached.seq
                     )
                 )
+                SERVING_ENCODES.labels(kind="resync").inc()
             return resync[0]
 
         frames_child = self._frames_key if is_keyframe else self._frames_delta
         bytes_child = self._bytes_key if is_keyframe else self._bytes_delta
         with self._lock:
+            self.encodes += 1
             if source_ts_ns is not None:
                 self._last_source_ts[stream] = int(source_ts_ns)
             subs = self._subscribers.get(stream)
@@ -438,10 +614,6 @@ class BroadcastServer:
         )
 
 
-#: Seconds between SSE keepalive comments while a stream is idle.
-_KEEPALIVE_S = 10.0
-
-
 class _Handler(BaseHTTPRequestHandler):
     broadcast: BroadcastServer
 
@@ -474,6 +646,7 @@ class _Handler(BaseHTTPRequestHandler):
                 stream: len(subs)
                 for stream, subs in hub._subscribers.items()
             }
+            peers = hub._index_peers
         rows = []
         for stream, cached in sorted(streams.items()):
             job, _, output = stream.partition("/")
@@ -487,8 +660,23 @@ class _Handler(BaseHTTPRequestHandler):
                     "frame_bytes": len(cached.frame),
                     "subscribers": counts.get(stream, 0),
                     "path": f"/streams/{stream}",
+                    "node": hub._name,
+                    "hop": hub.hop,
                 }
             )
+        if peers is not None:
+            # Federation (ADR 0121): append peer rows for streams this
+            # node does not serve locally — a peer outage degrades the
+            # index to local-only instead of 500ing it.
+            local = {row["stream"] for row in rows}
+            try:
+                rows.extend(
+                    row
+                    for row in peers()
+                    if row.get("stream") not in local
+                )
+            except Exception:
+                logger.exception("peer index federation failed")
         payload = json.dumps({"streams": rows}).encode()
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
@@ -510,7 +698,22 @@ class _Handler(BaseHTTPRequestHandler):
                 "(see /results for the index)",
             )
             return
-        sub = hub.subscribe(stream)
+        # Last-Event-ID resume (ADR 0121): the SSE ``id:`` field is
+        # ``<boot>:<epoch>:<seq>``; a reconnecting EventSource (or
+        # relay) echoes it back and, boot + epoch permitting, resumes
+        # on deltas instead of a full keyframe. An id minted by a
+        # PREVIOUS hub incarnation (boot mismatch) or a malformed one
+        # degrades to the plain keyframe attach.
+        resume = None
+        raw_id = self.headers.get("Last-Event-ID")
+        if raw_id:
+            parts = raw_id.strip().split(":")
+            if len(parts) == 3 and parts[0] == hub.boot:
+                try:
+                    resume = (int(parts[1]), int(parts[2]))
+                except ValueError:
+                    resume = None
+        sub = hub.subscribe(stream, resume=resume)
         try:
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
@@ -521,10 +724,17 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(b"retry: 3000\n\n")
             last_write = time.monotonic()
+            heartbeat_s = hub.heartbeat_s
             while not hub.stopped:
-                blob, source_ts = sub.next_blob_meta(timeout=0.5)
+                blob, source_ts = sub.next_blob_meta(
+                    timeout=min(0.5, heartbeat_s / 2)
+                )
                 if blob is None:
-                    if time.monotonic() - last_write >= _KEEPALIVE_S:
+                    if time.monotonic() - last_write >= heartbeat_s:
+                        # Idle-stream heartbeat: lets a client (relay,
+                        # EventSource wrapper) distinguish "no new
+                        # ticks" from "dead upstream" without waiting
+                        # out a TCP timeout (ADR 0121).
                         self.wfile.write(b": keepalive\n\n")
                         self.wfile.flush()
                         last_write = time.monotonic()
@@ -542,8 +752,15 @@ class _Handler(BaseHTTPRequestHandler):
                     else b": source_ts_ns=%d\n" % source_ts
                 )
                 self.wfile.write(
-                    b"%sid: %d\nevent: %s\ndata: %s\n\n"
-                    % (meta, header.seq, kind, base64.b64encode(blob))
+                    b"%sid: %s:%d:%d\nevent: %s\ndata: %s\n\n"
+                    % (
+                        meta,
+                        hub.boot.encode(),
+                        header.epoch,
+                        header.seq,
+                        kind,
+                        base64.b64encode(blob),
+                    )
                 )
                 self.wfile.flush()
                 last_write = time.monotonic()

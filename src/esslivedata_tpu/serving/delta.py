@@ -185,6 +185,10 @@ class DeltaEncoder:
         ``state_lost`` — a delta across state generations would splice
         unrelated accumulations), or the dense fallback."""
         prev, prev_epoch = self._prev, self._epoch
+        # Single-writer by contract (class docstring): each stream's
+        # encoder is called by exactly one publish hook; relay workers
+        # publish disjoint streams.
+        # graftlint: disable=JGL012 - single-writer encoder contract
         self._prev, self._epoch, self._seq = frame, epoch, seq
         if prev is None or prev_epoch != epoch:
             return encode_keyframe(frame, epoch=epoch, seq=seq)

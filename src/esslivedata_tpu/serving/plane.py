@@ -60,6 +60,8 @@ class ServingPlane:
         ring: int = 8,
         queue_limit: int = 32,
         name: str = "serving",
+        heartbeat_s: float = 10.0,
+        hop: int = 0,
     ) -> None:
         self.cache = ResultCache(ring=ring)
         self.server = BroadcastServer(
@@ -68,6 +70,8 @@ class ServingPlane:
             host=host,
             queue_limit=queue_limit,
             name=name,
+            heartbeat_s=heartbeat_s,
+            hop=hop,
         )
         #: True after close(): the reuse table must not hand a plane
         #: with a dead listener to a later service build.

@@ -26,6 +26,10 @@ stage                   observed at
                         the serial loop stages at step time)
 ``published``           results finalized + sink publish done
 ``fanout_encoded``      serving plane encoded the da00 frame + delta blob
+``relay_ingress``       a relay received/decoded a frame from its
+                        upstream hop (fleet/relay.py, ADR 0121; absent
+                        without a relay in the path)
+``relay_published``     the relay re-encoded the frame into its own hub
 ``subscriber_delivered``  a subscriber dequeued the blob
                         (serving/broadcast.py ``Subscription.next_blob``)
 ======================  ====================================================
@@ -60,12 +64,20 @@ from .registry import REGISTRY
 __all__ = ["E2E_BUCKETS", "E2E_LATENCY", "E2E_STAGES", "observe_stage"]
 
 #: Pipeline stages in boundary order (see module docstring table).
+#: The two relay stages (ADR 0121) only record when a relay hop is in
+#: the path: ``relay_ingress`` when a relay dequeues/receives a frame
+#: from its upstream, ``relay_published`` when it has re-encoded and
+#: fanned the frame into its own hub — so the freshness histogram
+#: spans the whole relay tree and the hop's cost is the difference
+#: between ``fanout_encoded`` and ``relay_published``.
 E2E_STAGES = (
     "consume",
     "decode",
     "staged",
     "published",
     "fanout_encoded",
+    "relay_ingress",
+    "relay_published",
     "subscriber_delivered",
 )
 

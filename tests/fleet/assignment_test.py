@@ -1,0 +1,310 @@
+"""Fleet assignment (fleet/assignment.py): rendezvous determinism,
+minimal movement on membership change, and the JobManager's group
+filter — partition without loss, rebalance as replay (ADR 0121)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.fleet.assignment import (
+    FleetAssignment,
+    rendezvous_owner,
+)
+
+KEYS = [f"stream_{i}|layout_{i % 3}" for i in range(200)]
+
+
+class TestRendezvous:
+    def test_deterministic_across_instances(self):
+        a = FleetAssignment(["r0", "r1", "r2"])
+        b = FleetAssignment(["r2", "r0", "r1"])  # order-independent
+        try:
+            for key in KEYS:
+                assert a.owner(key) == b.owner(key)
+        finally:
+            a.close()
+            b.close()
+
+    def test_every_replica_gets_a_share(self):
+        a = FleetAssignment([f"r{i}" for i in range(4)])
+        try:
+            owners = {a.owner(key) for key in KEYS}
+            assert owners == {f"r{i}" for i in range(4)}
+        finally:
+            a.close()
+
+    def test_join_moves_only_the_joiners_share(self):
+        old = ["r0", "r1", "r2"]
+        new = old + ["r3"]
+        moved = [
+            key
+            for key in KEYS
+            if rendezvous_owner(old, key) != rendezvous_owner(new, key)
+        ]
+        # Everything that moved went TO the joiner (HRW property)...
+        assert all(
+            rendezvous_owner(new, key) == "r3" for key in moved
+        )
+        # ...and the share is ~1/4, never a reshuffle of the world.
+        assert 0 < len(moved) < len(KEYS) // 2
+
+    def test_leave_moves_only_the_leavers_groups(self):
+        old = ["r0", "r1", "r2", "r3"]
+        new = ["r0", "r1", "r2"]
+        for key in KEYS:
+            if rendezvous_owner(old, key) != "r3":
+                assert rendezvous_owner(new, key) == rendezvous_owner(
+                    old, key
+                )
+
+    def test_empty_set_rejected(self):
+        with pytest.raises(ValueError):
+            rendezvous_owner([], "k")
+        with pytest.raises(ValueError):
+            FleetAssignment([])
+
+    def test_self_id_must_be_a_member(self):
+        with pytest.raises(ValueError):
+            FleetAssignment(["r0"], "r9")
+
+
+class TestMembership:
+    def test_set_replicas_bumps_generation_and_notifies(self):
+        a = FleetAssignment(["r0", "r1"], "r0")
+        try:
+            seen = []
+            a.add_observer(lambda gen, replicas: seen.append((gen, replicas)))
+            assert a.set_replicas(["r0", "r1", "r2"]) is True
+            assert seen == [(1, ("r0", "r1", "r2"))]
+            # No-op change: no observer fire, no rebalance.
+            assert a.set_replicas(["r2", "r1", "r0"]) is False
+            assert len(seen) == 1
+        finally:
+            a.close()
+
+    def test_apply_membership_adopts_group_generation(self):
+        a = FleetAssignment(["r0"], "r0")
+        try:
+            assert a.apply_membership(["r0", "r1"], generation=7)
+            assert a.generation == 7
+        finally:
+            a.close()
+
+    def test_departing_self_raises(self):
+        a = FleetAssignment(["r0", "r1"], "r0")
+        try:
+            with pytest.raises(ValueError):
+                a.set_replicas(["r1"])
+        finally:
+            a.close()
+
+    def test_moved_keys_probe(self):
+        a = FleetAssignment(["r0", "r1", "r2", "r3"])
+        try:
+            moved = a.moved_keys(KEYS, ["r0", "r1", "r2"])
+            assert moved == [
+                key for key in KEYS if a.owner(key) == "r3"
+            ]
+        finally:
+            a.close()
+
+
+def _make_manager(streams, det, fleet=None):
+    from esslivedata_tpu.config import JobId, WorkflowConfig, WorkflowSpec
+    from esslivedata_tpu.core.job_manager import JobFactory, JobManager
+    from esslivedata_tpu.workflows import WorkflowFactory
+    from esslivedata_tpu.workflows.detector_view import (
+        DetectorViewWorkflow,
+        project_logical,
+    )
+
+    reg = WorkflowFactory()
+    specs = {}
+    for stream in streams:
+        spec = WorkflowSpec(
+            instrument="fleet_test",
+            name=f"dv_{stream}",
+            source_names=[stream],
+        )
+        reg.register_spec(spec).attach_factory(
+            lambda *, source_name, params: DetectorViewWorkflow(
+                projection=project_logical(det)
+            )
+        )
+        specs[stream] = spec
+    mgr = JobManager(job_factory=JobFactory(reg), job_threads=2)
+    for stream in streams:
+        mgr.schedule_job(
+            WorkflowConfig(
+                identifier=specs[stream].identifier,
+                job_id=JobId(source_name=stream),
+            )
+        )
+    if fleet is not None:
+        mgr.set_fleet(fleet)
+    return mgr
+
+
+def _staged(rng, side, n=512):
+    from esslivedata_tpu.ops import EventBatch
+    from esslivedata_tpu.preprocessors.event_data import StagedEvents
+
+    pid = rng.integers(0, side * side, n, dtype=np.int64).astype(np.int32)
+    toa = rng.uniform(0, 7.0e7, n).astype(np.float32)
+    return StagedEvents(
+        batch=EventBatch.from_arrays(pid, toa),
+        first_timestamp=None,
+        last_timestamp=None,
+        n_chunks=1,
+    )
+
+
+def _counts(results):
+    """job source -> cumulative counts sum, from the finalized da00."""
+    out = {}
+    for res in results:
+        for key, da in zip(res.keys(), res.outputs.values(), strict=True):
+            if key.output_name == "counts_cumulative":
+                out[res.job_id.source_name] = float(
+                    np.asarray(da.data.values).sum()
+                )
+    return out
+
+
+class TestJobManagerFleetFilter:
+    def test_two_replicas_partition_without_loss_or_overlap(self):
+        from esslivedata_tpu.core.timestamp import Timestamp
+
+        side = 24
+        det = np.arange(side * side).reshape(side, side)
+        streams = [f"fs_{i}" for i in range(4)]
+        fleet_a = FleetAssignment(["a", "b"], "a", name="part_a")
+        fleet_b = FleetAssignment(["a", "b"], "b", name="part_b")
+        mgr_a = _make_manager(streams, det, fleet_a)
+        mgr_b = _make_manager(streams, det, fleet_b)
+        mgr_ctl = _make_manager(streams, det)
+        try:
+            final_a = final_b = final_ctl = None
+            for w in range(4):
+                rng = np.random.default_rng(100 + w)
+                window = {s: _staged(rng, side) for s in streams}
+                rng_b = np.random.default_rng(100 + w)
+                window_b = {s: _staged(rng_b, side) for s in streams}
+                rng_c = np.random.default_rng(100 + w)
+                window_c = {s: _staged(rng_c, side) for s in streams}
+                end = Timestamp.from_ns(1000 + w)
+                final_a = _counts(
+                    mgr_a.process_jobs(window, start=end, end=end)
+                )
+                final_b = _counts(
+                    mgr_b.process_jobs(window_b, start=end, end=end)
+                )
+                final_ctl = _counts(
+                    mgr_ctl.process_jobs(window_c, start=end, end=end)
+                )
+            # Each stream accumulated on EXACTLY one replica (the two
+            # managers compute the same rendezvous hash over the same
+            # (stream, fuse-key) groups, so the partition is exact —
+            # no stream lost, none double-processed)...
+            owned_a = {s for s, c in final_a.items() if c > 0}
+            owned_b = {s for s, c in final_b.items() if c > 0}
+            assert owned_a | owned_b == set(streams)
+            assert not (owned_a & owned_b)
+            # ...and the union of accumulations equals the
+            # single-replica control exactly (nothing lost, nothing
+            # double-counted).
+            for stream in streams:
+                merged = final_a.get(stream, 0.0) + final_b.get(
+                    stream, 0.0
+                )
+                assert merged == final_ctl[stream], stream
+        finally:
+            mgr_a.shutdown()
+            mgr_b.shutdown()
+            mgr_ctl.shutdown()
+            fleet_a.close()
+            fleet_b.close()
+
+    def test_rebalance_is_replay_the_gap_not_reset(self):
+        """A group moving to a new owner replays the missed windows
+        through the NORMAL ingest path (the ADR 0118 bookmark replay)
+        and lands byte-equal with an unpartitioned control."""
+        from esslivedata_tpu.core.timestamp import Timestamp
+
+        side = 24
+        det = np.arange(side * side).reshape(side, side)
+        # One stream whose HRW owner flips when r_new joins.
+        fleet_probe = FleetAssignment(["old", "new"], name="probe")
+        stream = next(
+            f"mv_{i}"
+            for i in range(64)
+            if fleet_probe.owner(f"mv_{i}", None) == "old"
+            and FleetAssignment(["new"], name=f"p{i}").owner(f"mv_{i}")
+            == "new"
+        )
+        fleet_probe.close()
+        fleet_new = FleetAssignment(["old", "new"], "new", name="takeover")
+        mgr_new = _make_manager([stream], det, fleet_new)
+        mgr_ctl = _make_manager([stream], det)
+        try:
+            windows = []
+            for w in range(6):
+                rng = np.random.default_rng(w)
+                windows.append(_staged(rng, side))
+            # Phase 1: "old" owns the stream; the new replica drops its
+            # data (windows 0-2 accumulate elsewhere).
+            final_new = None
+            for w in range(3):
+                rng = np.random.default_rng(w)
+                end = Timestamp.from_ns(1 + w)
+                final_new = _counts(
+                    mgr_new.process_jobs(
+                        {stream: _staged(rng, side)}, start=end, end=end
+                    )
+                )
+            assert final_new.get(stream, 0.0) == 0.0  # not ours yet
+            # Phase 2: "old" leaves. The checkpoint/bookmark machinery
+            # (ADR 0118) replays the gap through the normal path: the
+            # new owner re-consumes windows 0-2, then serves live.
+            fleet_new.set_replicas(["new"])
+            for w in range(6):
+                rng = np.random.default_rng(w)
+                end = Timestamp.from_ns(10 + w)
+                final_new = _counts(
+                    mgr_new.process_jobs(
+                        {stream: _staged(rng, side)}, start=end, end=end
+                    )
+                )
+            for w in range(6):
+                rng = np.random.default_rng(w)
+                end = Timestamp.from_ns(10 + w)
+                final_ctl = _counts(
+                    mgr_ctl.process_jobs(
+                        {stream: _staged(rng, side)}, start=end, end=end
+                    )
+                )
+            # The moved group's accumulation equals the control that
+            # never rebalanced: a gap replayed, not a reset kept.
+            assert final_new[stream] == final_ctl[stream] > 0
+        finally:
+            mgr_new.shutdown()
+            mgr_ctl.shutdown()
+            fleet_new.close()
+
+    def test_group_checks_counted(self):
+        from esslivedata_tpu.fleet.assignment import FLEET_GROUP_CHECKS
+
+        a = FleetAssignment(["a", "b"], "a", name="counted")
+        try:
+            owned0 = FLEET_GROUP_CHECKS.value(decision="owned")
+            skipped0 = FLEET_GROUP_CHECKS.value(decision="skipped")
+            decisions = [a.owns(f"s{i}", None) for i in range(8)]
+            assert FLEET_GROUP_CHECKS.value(decision="owned") - owned0 == sum(
+                decisions
+            )
+            assert FLEET_GROUP_CHECKS.value(
+                decision="skipped"
+            ) - skipped0 == len(decisions) - sum(decisions)
+        finally:
+            a.close()

@@ -1,0 +1,122 @@
+"""Fleet control plane (fleet/control.py): /results federation across
+real hubs and job-commit routing over the assignment (ADR 0121)."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from dataclasses import dataclass
+
+from esslivedata_tpu.fleet.assignment import FleetAssignment
+from esslivedata_tpu.fleet.control import (
+    CommitRouter,
+    fetch_index,
+    peer_index,
+)
+from esslivedata_tpu.serving import BroadcastServer
+
+
+def _hub(name: str) -> BroadcastServer:
+    return BroadcastServer(port=0, host="127.0.0.1", name=name)
+
+
+class TestFederation:
+    def test_fetch_index_returns_rows(self):
+        hub = _hub("n1")
+        try:
+            hub.publish_frame("j:1/out", b"x" * 32, token="t")
+            rows = fetch_index(f"http://127.0.0.1:{hub.port}")
+            assert [row["stream"] for row in rows] == ["j:1/out"]
+            assert rows[0]["node"] == "n1"
+        finally:
+            hub.close()
+
+    def test_two_replicas_federate_each_others_streams(self):
+        hub_a, hub_b = _hub("replica-a"), _hub("replica-b")
+        try:
+            hub_a.publish_frame("a:1/out", b"x" * 32, token="t")
+            hub_b.publish_frame("b:1/out", b"y" * 32, token="t")
+            hub_a.set_index_peers(
+                peer_index(
+                    {"replica-b": f"http://127.0.0.1:{hub_b.port}"}
+                )
+            )
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{hub_a.port}/results", timeout=5
+            ) as response:
+                rows = json.loads(response.read())["streams"]
+            by_stream = {row["stream"]: row for row in rows}
+            assert set(by_stream) == {"a:1/out", "b:1/out"}
+            # The peer row points the client at the RIGHT hop.
+            assert by_stream["b:1/out"]["url"] == (
+                f"http://127.0.0.1:{hub_b.port}/streams/b:1/out"
+            )
+            assert by_stream["b:1/out"]["node"] == "replica-b"
+        finally:
+            hub_a.close()
+            hub_b.close()
+
+    def test_unreachable_peer_degrades_to_local(self):
+        hub = _hub("lonely")
+        try:
+            hub.publish_frame("a:1/out", b"x" * 32, token="t")
+            hub.set_index_peers(
+                peer_index({"gone": "http://127.0.0.1:9"})
+            )
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{hub.port}/results", timeout=5
+            ) as response:
+                rows = json.loads(response.read())["streams"]
+            assert [row["stream"] for row in rows] == ["a:1/out"]
+        finally:
+            hub.close()
+
+
+@dataclass
+class _Config:
+    @dataclass
+    class _JobId:
+        source_name: str
+
+    job_id: "_Config._JobId"
+
+
+class TestCommitRouter:
+    def test_routes_to_the_assignment_owner(self):
+        assignment = FleetAssignment(["a", "b", "c"], name="router")
+        try:
+            router = CommitRouter(
+                assignment,
+                {"a": "http://a:5010", "b": "http://b:5010"},
+            )
+            for i in range(8):
+                source = f"det_{i}"
+                owner, url = router.route(
+                    _Config(job_id=_Config._JobId(source_name=source))
+                )
+                assert owner == assignment.owner(source)
+                assert url == router.replica_urls.get(owner)
+            # Router and data plane can never disagree: same object.
+            assert router.owner("det_0") == assignment.owner("det_0")
+        finally:
+            assignment.close()
+
+    def test_rebalance_moves_routing_with_the_data_plane(self):
+        assignment = FleetAssignment(["a", "b"], name="router2")
+        try:
+            router = CommitRouter(assignment)
+            before = {
+                f"s{i}": router.owner(f"s{i}") for i in range(32)
+            }
+            assignment.set_replicas(["a", "b", "c"])
+            moved = {
+                source
+                for source, owner in before.items()
+                if router.owner(source) != owner
+            }
+            # Every move lands on the joiner — commits follow the
+            # exact same minimal-movement property the data plane has.
+            assert moved
+            assert all(router.owner(s) == "c" for s in moved)
+        finally:
+            assignment.close()

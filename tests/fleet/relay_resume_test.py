@@ -1,0 +1,192 @@
+"""Relay resume semantics across an upstream kill-and-restart — the
+gap-not-reset contract across a hop (ADR 0121 acceptance).
+
+A RelayPlane consumes a real BroadcastServer over sockets. The upstream
+process is killed mid-stream and comes back (on a fresh port, as a
+restarted container would behind DNS) with its accumulation RESTORED by
+the durability plane (ADR 0118) — modeled here by republishing the
+continued accumulation into the fresh hub, whose epoch/seq numbering
+restarts the way a fresh process's does. The relay must:
+
+- reconnect (bounded jittered backoff) and hard-resync exactly once;
+- hand its downstream subscribers EXACTLY ONE resync keyframe whose
+  decoded content CONTINUES the accumulation (a gap, never a reset);
+- stay byte-identical with a direct subscription to the new upstream.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.fleet.relay import RelayPlane
+from esslivedata_tpu.serving import (
+    BroadcastServer,
+    DeltaDecoder,
+    decode_header,
+)
+
+
+def _accumulation(n: int, size: int = 4000, seed: int = 5):
+    """Frames of a growing cumulative histogram: monotone uint32 bins,
+    so 'gap not reset' is checkable on the decoded content."""
+    rng = np.random.default_rng(seed)
+    counts = np.zeros(size // 4, dtype=np.uint32)
+    out = []
+    for _ in range(n):
+        idx = rng.integers(0, counts.size, 40)
+        np.add.at(counts, idx, 1)
+        out.append(counts.tobytes())
+    return out
+
+
+def _sum(frame: bytes) -> int:
+    return int(np.frombuffer(frame, dtype=np.uint32).sum())
+
+
+def _wait(predicate, timeout=15.0, interval=0.05, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    pytest.fail(f"timed out waiting for {message}")
+
+
+def test_upstream_kill_and_restart_is_one_keyframe_gap_not_reset():
+    series = _accumulation(8)
+    upstream = BroadcastServer(port=0, host="127.0.0.1", name="up")
+    current_url = [f"http://127.0.0.1:{upstream.port}"]
+    relay_hub = BroadcastServer(port=None, name="edge")
+    relay = RelayPlane(
+        lambda: current_url[0],
+        relay_hub,
+        poll_interval_s=0.1,
+        idle_timeout_s=2.0,
+        name="resume-test",
+        seed=7,
+    )
+    new_upstream = None
+    try:
+        for cur in series[:3]:
+            upstream.publish_frame("j:1/out", cur, token="t")
+        _wait(
+            lambda: relay_hub.cache.latest("j:1/out") is not None,
+            message="relay to mirror the stream",
+        )
+        down = relay_hub.subscribe("j:1/out")
+        decoder = DeltaDecoder()
+        observed: list[tuple[bool, int, int]] = []  # (keyframe, epoch, sum)
+
+        def drain():
+            while down.depth() > 0:
+                blob = down.next_blob(1.0)
+                frame = decoder.apply(blob)
+                header = decode_header(blob)
+                observed.append(
+                    (header.keyframe, header.epoch, _sum(frame))
+                )
+
+        # Catch up to pre-kill steady state, then publish one more
+        # tick to prove delta continuity.
+        drain()
+        upstream.publish_frame("j:1/out", series[3], token="t")
+        _wait(
+            lambda: (drain(), bool(observed))[1]
+            and observed[-1][2] == _sum(series[3]),
+            message="pre-kill tick to reach the subscriber",
+        )
+        pre_kill = list(observed)
+        assert pre_kill[0][0] is True  # attach keyframe
+        assert all(not k for k, _e, _s in pre_kill[1:])
+
+        # KILL: the upstream process dies mid-stream.
+        upstream.close()
+        # ...and comes back on a fresh port with the accumulation
+        # RESTORED (ADR 0118): epoch/seq numbering restarts at 0 the
+        # way a fresh hub's does, content continues where it left off.
+        new_upstream = BroadcastServer(
+            port=0, host="127.0.0.1", name="up-restored"
+        )
+        current_url[0] = f"http://127.0.0.1:{new_upstream.port}"
+        for cur in series[4:]:
+            new_upstream.publish_frame("j:1/out", cur, token="t")
+            time.sleep(0.1)
+        _wait(
+            lambda: (drain(), bool(observed))[1]
+            and observed[-1][2] == _sum(series[-1]),
+            timeout=30.0,
+            message="relay to reconnect and resume through the restart",
+        )
+        post_kill = observed[len(pre_kill):]
+        keyframes = [entry for entry in post_kill if entry[0]]
+        # EXACTLY one resync keyframe spans the restart...
+        assert len(keyframes) == 1, post_kill
+        # ...with a bumped downstream epoch (signaled rebase)...
+        assert keyframes[0][1] == pre_kill[-1][1] + 1
+        # ...and the decoded accumulation NEVER went backwards: a gap,
+        # not a reset, across the hop.
+        sums = [s for _k, _e, s in observed]
+        assert sums == sorted(sums), sums
+        assert sums[-1] == _sum(series[-1])
+        # Byte identity vs a direct subscription to the new upstream.
+        direct = new_upstream.subscribe("j:1/out")
+        direct_frame = DeltaDecoder().apply(direct.next_blob(1.0))
+        assert decoder.frame() == direct_frame
+    finally:
+        relay.close()
+        relay_hub.close()
+        if new_upstream is not None:
+            new_upstream.close()
+
+
+def test_relay_reconnect_to_same_upstream_resumes_on_deltas():
+    """A transient connection drop (upstream alive, epoch intact) must
+    resume via Last-Event-ID with NO keyframe at all downstream."""
+    series = _accumulation(6, seed=9)
+    upstream = BroadcastServer(port=0, host="127.0.0.1", heartbeat_s=0.5)
+    relay_hub = BroadcastServer(port=None)
+    relay = RelayPlane(
+        f"http://127.0.0.1:{upstream.port}",
+        relay_hub,
+        poll_interval_s=0.1,
+        idle_timeout_s=2.0,
+        seed=3,
+    )
+    try:
+        upstream.publish_frame("j:1/out", series[0], token="t")
+        _wait(
+            lambda: relay_hub.cache.latest("j:1/out") is not None,
+            message="relay warm-up",
+        )
+        down = relay_hub.subscribe("j:1/out")
+        decoder = DeltaDecoder()
+        decoder.apply(down.next_blob(1.0))
+        # Sever every live upstream connection; the workers redial the
+        # SAME upstream and resume via Last-Event-ID.
+        with relay._lock:
+            workers = list(relay._clients.values())
+        for worker in workers:
+            worker.client._close_conn()
+        kinds = []
+        for cur in series[1:]:
+            upstream.publish_frame("j:1/out", cur, token="t")
+            time.sleep(0.15)
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            while down.depth() > 0:
+                blob = down.next_blob(1.0)
+                kinds.append(decode_header(blob).keyframe)
+                decoder.apply(blob)
+            if _sum(decoder.frame()) == _sum(series[-1]):
+                break
+            time.sleep(0.05)
+        assert _sum(decoder.frame()) == _sum(series[-1])
+        # Same epoch, resumable position: downstream saw deltas only.
+        assert not any(kinds), kinds
+    finally:
+        relay.close()
+        relay_hub.close()
+        upstream.close()

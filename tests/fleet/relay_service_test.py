@@ -1,0 +1,53 @@
+"""``livedata-relay`` entry point (fleet/service.py): argument
+surface, env defaults, and the --check container smoke."""
+
+from __future__ import annotations
+
+import pytest
+
+from esslivedata_tpu.fleet.service import build_arg_parser, main
+
+
+class TestArgs:
+    def test_check_mode_validates_and_exits_zero(self, capsys):
+        rc = main(
+            [
+                "--upstream",
+                "http://compute:5011",
+                "--serve-port",
+                "5012",
+                "--check",
+            ]
+        )
+        assert rc == 0
+        assert "http://compute:5011" in capsys.readouterr().out
+
+    def test_missing_upstream_is_a_usage_error(self):
+        with pytest.raises(SystemExit) as excinfo:
+            main(["--serve-port", "5012", "--check"])
+        assert excinfo.value.code == 2
+
+    def test_missing_serve_port_is_a_usage_error(self):
+        with pytest.raises(SystemExit) as excinfo:
+            main(["--upstream", "http://compute:5011", "--check"])
+        assert excinfo.value.code == 2
+
+    def test_env_defaults(self, monkeypatch):
+        monkeypatch.setenv(
+            "LIVEDATA_RELAY_UPSTREAM", "http://env-upstream:5011"
+        )
+        monkeypatch.setenv("LIVEDATA_SERVE_PORT", "5099")
+        monkeypatch.setenv("LIVEDATA_METRICS_PORT", "8099")
+        args = build_arg_parser().parse_args([])
+        assert args.upstream == "http://env-upstream:5011"
+        assert int(args.serve_port) == 5099
+        assert int(args.metrics_port) == 8099
+
+    def test_defaults_are_operational(self):
+        args = build_arg_parser().parse_args(
+            ["--upstream", "u", "--serve-port", "1"]
+        )
+        assert args.queue_limit == 32
+        assert args.heartbeat_s == 10.0
+        assert args.poll_interval == 2.0
+        assert args.idle_timeout == 30.0

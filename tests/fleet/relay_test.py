@@ -1,0 +1,277 @@
+"""Relay hop semantics (fleet/relay.py): byte parity across the hop,
+epoch propagation, resync classification, chaos drop recovery, and
+source-timestamp propagation (ADR 0121)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from esslivedata_tpu.fleet.relay import (
+    RELAY_FRAMES,
+    RELAY_RESYNCS,
+    HubRelay,
+    RelayChannel,
+)
+from esslivedata_tpu.harness.chaos import ChaosSchedule, ChaosSpec
+from esslivedata_tpu.serving import BroadcastServer, DeltaDecoder, decode_header
+from esslivedata_tpu.serving.delta import encode_keyframe
+from esslivedata_tpu.telemetry.registry import REGISTRY
+
+
+def _frames(n: int, size: int = 3000, seed: int = 11):
+    rng = np.random.default_rng(seed)
+    frame = rng.integers(0, 256, size).astype(np.uint8).tobytes()
+    out = [frame]
+    for _ in range(n - 1):
+        arr = bytearray(out[-1])
+        for i in rng.integers(0, size, 30):
+            arr[i] = (arr[i] + 1) % 256
+        out.append(bytes(arr))
+    return out
+
+
+def _drain_frames(sub, decoder):
+    got = []
+    while sub.depth() > 0:
+        blob = sub.next_blob(1.0)
+        got.append((decode_header(blob), decoder.apply(blob)))
+    return got
+
+
+class TestHubRelay:
+    def test_downstream_frames_byte_identical_across_hop(self):
+        upstream = BroadcastServer(port=None, name="up")
+        relay = HubRelay(upstream, name="hop1")
+        try:
+            series = _frames(5)
+            upstream.publish_frame("j:1/out", series[0], token="t")
+            relay.pump()
+            direct = upstream.subscribe("j:1/out")
+            down = relay.hub.subscribe("j:1/out")
+            d_dec, r_dec = DeltaDecoder(), DeltaDecoder()
+            assert _drain_frames(direct, d_dec)[-1][1] == series[0]
+            assert _drain_frames(down, r_dec)[-1][1] == series[0]
+            for cur in series[1:]:
+                upstream.publish_frame("j:1/out", cur, token="t")
+                relay.pump()
+                direct_got = _drain_frames(direct, d_dec)
+                down_got = _drain_frames(down, r_dec)
+                assert direct_got[-1][1] == cur
+                assert down_got[-1][1] == cur
+                # Steady state rides deltas across the hop too.
+                assert not down_got[-1][0].keyframe
+        finally:
+            relay.close()
+            upstream.close()
+
+    def test_hop_count_and_stream_mirroring(self):
+        upstream = BroadcastServer(port=None, name="up")
+        relay = HubRelay(upstream, name="hop1")
+        second = HubRelay(relay.hub, name="hop2")
+        try:
+            assert relay.hub.hop == 1
+            assert second.hub.hop == 2
+            upstream.publish_frame("a:1/x", b"f" * 64, token="t")
+            upstream.publish_frame("b:1/y", b"g" * 64, token="t")
+            relay.pump()
+            second.pump()
+            assert sorted(second.hub.cache.streams()) == [
+                "a:1/x",
+                "b:1/y",
+            ]
+        finally:
+            second.close()
+            relay.close()
+            upstream.close()
+
+    def test_upstream_epoch_bump_propagates_as_signaled_keyframe(self):
+        upstream = BroadcastServer(port=None)
+        relay = HubRelay(upstream)
+        try:
+            series = _frames(3)
+            upstream.publish_frame("j:1/out", series[0], token="t1")
+            relay.pump()
+            down = relay.hub.subscribe("j:1/out")
+            decoder = DeltaDecoder()
+            _drain_frames(down, decoder)
+            epoch_before = decoder.epoch
+            # A signaled upstream reset (state_epoch bump -> new token).
+            upstream.publish_frame("j:1/out", series[1], token="t2")
+            relay.pump()
+            got = _drain_frames(down, decoder)
+            assert got[-1][0].keyframe
+            assert decoder.epoch == epoch_before + 1
+            assert got[-1][1] == series[1]
+        finally:
+            relay.close()
+            upstream.close()
+
+    def test_chaos_drop_resyncs_without_unsignaled_reset(self):
+        upstream = BroadcastServer(port=None)
+        chaos = ChaosSchedule(
+            ChaosSpec(at={"relay_upstream_drop": frozenset({2})})
+        )
+        relay = HubRelay(upstream, chaos=chaos)
+        try:
+            series = _frames(6)
+            upstream.publish_frame("j:1/out", series[0], token="t")
+            relay.pump()  # consultation 0
+            down = relay.hub.subscribe("j:1/out")
+            decoder = DeltaDecoder()
+            _drain_frames(down, decoder)
+            resyncs0 = RELAY_RESYNCS.total()
+            epochs = set()
+            for i, cur in enumerate(series[1:], start=1):
+                upstream.publish_frame("j:1/out", cur, token="t")
+                relay.pump()  # consultation i; fires at i == 2
+                got = _drain_frames(down, decoder)
+                assert got[-1][1] == cur, f"window {i} diverged"
+                epochs.add(decoder.epoch)
+            # The drop forced a resync at the relay's upstream edge...
+            assert RELAY_RESYNCS.total() > resyncs0
+            assert chaos.injected() == {"relay_upstream_drop": 1}
+            # ...but downstream continuity held: same hub instance, so
+            # the rebase is soft — no downstream epoch churn at all.
+            assert epochs == {decoder.epoch}
+        finally:
+            relay.close()
+            upstream.close()
+
+    def test_source_ts_propagates_to_downstream_freshness(self):
+        upstream = BroadcastServer(port=None)
+        relay = HubRelay(upstream)
+        try:
+            ingress0 = _e2e_count("relay_ingress")
+            published0 = _e2e_count("relay_published")
+            import time as _time
+
+            ts = _time.time_ns()
+            upstream.publish_frame(
+                "j:1/out", b"f" * 128, token="t", source_ts_ns=ts
+            )
+            relay.pump()
+            down = relay.hub.subscribe("j:1/out")
+            blob, got_ts = down.next_blob_meta(1.0)
+            assert blob is not None
+            assert got_ts == ts  # the SOURCE stamp, not a relay stamp
+            assert _e2e_count("relay_ingress") == ingress0 + 1
+            assert _e2e_count("relay_published") == published0 + 1
+        finally:
+            relay.close()
+            upstream.close()
+
+
+def _e2e_count(stage: str) -> float:
+    for family in REGISTRY.collect():
+        if family.name == "livedata_e2e_latency_seconds":
+            return sum(
+                s.value
+                for s in family.samples
+                if s.suffix == "_count"
+                and dict(s.labels).get("stage") == stage
+            )
+    return 0.0
+
+
+class TestRelayChannel:
+    def _hub(self):
+        return BroadcastServer(port=None)
+
+    def test_hard_resync_on_seq_regression_bumps_generation(self):
+        hub = self._hub()
+        try:
+            channel = RelayChannel("s", hub)
+            series = _frames(3)
+            channel.on_blob(
+                encode_keyframe(series[0], epoch=0, seq=5), None
+            )
+            down = hub.subscribe("s")
+            decoder = DeltaDecoder()
+            _drain_frames(down, decoder)
+            epoch_before = decoder.epoch
+            # Reconnect keyframe with seq REGRESSED in the same epoch:
+            # a restarted upstream whose counters reset — exactly one
+            # signaled keyframe downstream.
+            assert channel.on_blob(
+                encode_keyframe(series[1], epoch=0, seq=0),
+                None,
+                after_reconnect=True,
+            )
+            assert channel.generation == 1
+            got = _drain_frames(down, decoder)
+            assert [h.keyframe for h, _ in got] == [True]
+            assert decoder.epoch == epoch_before + 1
+            assert got[-1][1] == series[1]
+        finally:
+            hub.close()
+
+    def test_soft_rebase_keeps_downstream_continuity(self):
+        hub = self._hub()
+        try:
+            channel = RelayChannel("s", hub)
+            series = _frames(3)
+            channel.on_blob(
+                encode_keyframe(series[0], epoch=0, seq=0), None
+            )
+            down = hub.subscribe("s")
+            decoder = DeltaDecoder()
+            _drain_frames(down, decoder)
+            epoch_before = decoder.epoch
+            # Reconnect keyframe, same epoch, seq moved FORWARD (resume
+            # miss): continuation — downstream rides a delta.
+            assert channel.on_blob(
+                encode_keyframe(series[1], epoch=0, seq=3),
+                None,
+                after_reconnect=True,
+            )
+            assert channel.generation == 0
+            got = _drain_frames(down, decoder)
+            assert not got[-1][0].keyframe
+            assert decoder.epoch == epoch_before
+            assert got[-1][1] == series[1]
+        finally:
+            hub.close()
+
+    def test_mid_stream_gap_requests_keyframe_resubscribe(self):
+        from esslivedata_tpu.serving.delta import encode_delta
+
+        hub = self._hub()
+        try:
+            channel = RelayChannel("s", hub)
+            series = _frames(4)
+            channel.on_blob(
+                encode_keyframe(series[0], epoch=0, seq=0), None
+            )
+            gaps0 = RELAY_RESYNCS.value(reason="gap")
+            # seq 2 after 0: a gap the decoder cannot bridge.
+            delta = encode_delta(series[1], series[2], epoch=0, seq=2)
+            assert channel.on_blob(delta, None) is False
+            assert RELAY_RESYNCS.value(reason="gap") == gaps0 + 1
+            # The resync keyframe then recovers exactly.
+            assert channel.on_blob(
+                encode_keyframe(series[2], epoch=0, seq=2),
+                None,
+                after_reconnect=True,
+            )
+        finally:
+            hub.close()
+
+    def test_stale_duplicate_is_not_republished(self):
+        hub = self._hub()
+        try:
+            channel = RelayChannel("s", hub)
+            series = _frames(2)
+            from esslivedata_tpu.serving.delta import encode_delta
+
+            channel.on_blob(
+                encode_keyframe(series[0], epoch=0, seq=1), None
+            )
+            frames0 = RELAY_FRAMES.total()
+            encodes0 = hub.encodes
+            # An attach-race duplicate (seq already covered).
+            stale = encode_delta(series[0], series[1], epoch=0, seq=1)
+            assert channel.on_blob(stale, None) is True
+            assert hub.encodes == encodes0
+            assert RELAY_FRAMES.total() == frames0
+        finally:
+            hub.close()

@@ -1,0 +1,180 @@
+"""SSE client protocol + reconnect discipline (fleet/sse_client.py).
+
+The parser's contract against the hub's exact wire dialect, the
+bounded-jittered backoff ladder (the JGL026 shape), and a live
+socket round trip against a real BroadcastServer.
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.fleet.sse_client import SSEClient, SSEParser
+from esslivedata_tpu.serving import BroadcastServer
+
+
+def _frames(n: int, size: int = 2000, seed: int = 3):
+    rng = np.random.default_rng(seed)
+    frame = rng.integers(0, 256, size).astype(np.uint8).tobytes()
+    out = [frame]
+    for _ in range(n - 1):
+        arr = bytearray(out[-1])
+        for i in rng.integers(0, size, 20):
+            arr[i] = (arr[i] + 1) % 256
+        out.append(bytes(arr))
+    return out
+
+
+class TestParser:
+    def test_parses_hub_event_block(self):
+        parser = SSEParser()
+        blob = b"\x01\x02payload"
+        lines = [
+            b": source_ts_ns=123456789\n",
+            b"id: 2:7\n",
+            b"event: delta\n",
+            b"data: " + base64.b64encode(blob) + b"\n",
+            b"\n",
+        ]
+        got = [parser.feed(line) for line in lines]
+        assert got[:-1] == [None, None, None, None]
+        frame = got[-1]
+        assert frame.kind == "delta"
+        assert frame.blob == blob
+        assert (frame.epoch, frame.seq) == (2, 7)
+        assert frame.source_ts_ns == 123456789
+
+    def test_keepalive_block_yields_no_frame(self):
+        parser = SSEParser()
+        assert parser.feed(b": keepalive\n") is None
+        assert parser.feed(b"\n") is None
+
+    def test_comment_state_resets_between_blocks(self):
+        parser = SSEParser()
+        parser.feed(b": source_ts_ns=5\n")
+        parser.feed(b"data: " + base64.b64encode(b"a") + b"\n")
+        first = parser.feed(b"\n")
+        assert first.source_ts_ns == 5
+        parser.feed(b"data: " + base64.b64encode(b"b") + b"\n")
+        second = parser.feed(b"\n")
+        assert second.source_ts_ns is None
+
+    def test_malformed_id_and_data_are_tolerated(self):
+        parser = SSEParser()
+        parser.feed(b"id: not-an-id\n")
+        parser.feed(b"data: %%%not-base64%%%\n")
+        assert parser.feed(b"\n") is None  # undecodable data dropped
+        parser.feed(b"retry: 3000\n")  # ignored field
+        parser.feed(b"data: " + base64.b64encode(b"ok") + b"\n")
+        frame = parser.feed(b"\n")
+        assert frame.blob == b"ok"
+        assert frame.epoch is None and frame.seq is None
+
+    def test_crlf_lines_parse(self):
+        parser = SSEParser()
+        parser.feed(b"event: keyframe\r\n")
+        parser.feed(b"data: " + base64.b64encode(b"x") + b"\r\n")
+        frame = parser.feed(b"\r\n")
+        assert frame.kind == "keyframe"
+
+
+class TestBackoff:
+    def _delays(self, seed, attempts=8):
+        client = SSEClient(
+            "http://127.0.0.1:1/streams/x",
+            backoff_base_s=0.5,
+            backoff_cap_s=10.0,
+            seed=seed,
+        )
+        delays = []
+        client._stop.wait = lambda d: delays.append(d)  # type: ignore
+        for attempt in range(1, attempts + 1):
+            client._backoff(attempt)
+        return delays
+
+    def test_backoff_is_bounded(self):
+        delays = self._delays(seed=1, attempts=12)
+        # Exponential up to the cap, jitter multiplier < 1.5: a long
+        # outage can never park the client for more than cap * 1.5.
+        assert all(d <= 10.0 * 1.5 for d in delays)
+        assert delays[0] <= 0.5 * 1.5  # first retry is prompt
+
+    def test_backoff_is_jittered_and_seed_deterministic(self):
+        a = self._delays(seed=1)
+        b = self._delays(seed=2)
+        c = self._delays(seed=1)
+        assert a != b  # different seeds spread (no lockstep stampede)
+        assert a == c  # same seed reproduces (a chaos run is a test)
+
+    def test_stop_interrupts_backoff_immediately(self):
+        client = SSEClient(
+            "http://127.0.0.1:1/streams/x",
+            backoff_base_s=5.0,
+            backoff_cap_s=5.0,
+        )
+        client.stop()
+        t0 = time.monotonic()
+        client._backoff(4)  # stop already set: wait returns instantly
+        assert time.monotonic() - t0 < 1.0
+
+
+class TestLiveSocket:
+    def test_keyframe_then_delta_round_trip(self):
+        hub = BroadcastServer(port=0, host="127.0.0.1")
+        series = _frames(3)
+        hub.publish_frame("j:u/out", series[0], token="t")
+        client = SSEClient(
+            f"http://127.0.0.1:{hub.port}/streams/j:u/out",
+            idle_timeout_s=10.0,
+        )
+        got = []
+
+        def consume():
+            for frame in client.frames():
+                got.append(frame)
+                if len(got) == 3:
+                    client.stop()
+                    return
+
+        thread = threading.Thread(target=consume, daemon=True)
+        thread.start()
+        try:
+            deadline = time.monotonic() + 10.0
+            while not got and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert got, "client never received the attach keyframe"
+            for cur in series[1:]:
+                hub.publish_frame("j:u/out", cur, token="t")
+            while len(got) < 3 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert len(got) == 3
+            assert got[0].kind == "keyframe" and not got[0].resumed
+            assert [f.kind for f in got[1:]] == ["delta", "delta"]
+            assert client.last_event_id == (hub.boot, 0, 2)
+        finally:
+            client.stop()
+            thread.join(timeout=5.0)
+            hub.close()
+
+    def test_non_200_upstream_raises_connection_error(self):
+        hub = BroadcastServer(port=0, host="127.0.0.1")
+        client = SSEClient(
+            f"http://127.0.0.1:{hub.port}/streams/none/such"
+        )
+        try:
+            with pytest.raises(ConnectionError):
+                client._connect()
+        finally:
+            client.stop()
+            hub.close()
+
+    def test_request_resync_drops_resume_position(self):
+        client = SSEClient("http://127.0.0.1:1/streams/x")
+        client._last_event_id = ("aabbccdd", 1, 5)
+        client.request_resync()
+        assert client.last_event_id is None

@@ -1,0 +1,62 @@
+"""The SLO drill through a relay hop (harness/load.py + fleet/relay.py,
+ADR 0121): parity and gap-discipline gated ACROSS the hop, and the
+``relay_upstream_drop`` chaos site actually drilling the resync path."""
+
+from __future__ import annotations
+
+from esslivedata_tpu.harness import ChaosSpec, LoadConfig, LoadHarness
+from esslivedata_tpu.harness.chaos import SITES
+
+
+def _tiny(**overrides) -> LoadConfig:
+    cfg = LoadConfig(
+        streams=2,
+        jobs_per_stream=1,
+        subscribers=12,
+        windows=12,
+        warm_windows=2,
+        events_per_window=512,
+        pixels=1 << 10,
+        queue_limit=4,
+        seed=3,
+    )
+    for key, value in overrides.items():
+        setattr(cfg, key, value)
+    return cfg
+
+
+def test_relay_upstream_drop_is_a_known_site():
+    assert "relay_upstream_drop" in SITES
+
+
+def test_drill_runs_through_one_relay_hop_with_parity():
+    report = LoadHarness(_tiny()).run()
+    assert report["relay_hops"] == 1
+    assert report["relay_frames"] > 0
+    assert report["parity_checks"] > 0
+    assert report["parity_violations"] == 0
+    assert report["gap_violations"] == 0
+
+
+def test_relay_drop_chaos_resyncs_without_gap_violation():
+    cfg = _tiny(
+        chaos=ChaosSpec(
+            seed=3,
+            at={"relay_upstream_drop": frozenset({4})},
+        )
+    )
+    report = LoadHarness(cfg).run()
+    assert report["chaos_injected"] == {"relay_upstream_drop": 1}
+    # The hop resynced (keyframe rebases at the relay's upstream
+    # edge), and downstream discipline held: byte parity intact,
+    # zero unsignaled resets across the hop.
+    assert report["relay_resyncs"] >= 1
+    assert report["parity_violations"] == 0
+    assert report["gap_violations"] == 0
+
+
+def test_direct_topology_still_available():
+    report = LoadHarness(_tiny(relay_hops=0)).run()
+    assert report["relay_hops"] == 0
+    assert report["relay_frames"] == 0
+    assert report["parity_violations"] == 0

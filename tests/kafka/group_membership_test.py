@@ -1,0 +1,113 @@
+"""Consumer-group membership telemetry (kafka/consumer.py, ADR 0121
+satellite): rebalances become scrapeable and drive the fleet observer
+— they used to be visible only in librdkafka logs."""
+
+from __future__ import annotations
+
+from esslivedata_tpu.kafka.consumer import (
+    GroupMembership,
+    subscribe_with_group,
+)
+from esslivedata_tpu.telemetry.registry import REGISTRY
+
+
+def _family_samples(name: str, group: str):
+    for family in REGISTRY.collect():
+        if family.name == name:
+            return [
+                (sample.suffix, dict(sample.labels), sample.value)
+                for sample in family.samples
+                if dict(sample.labels).get("group") == group
+            ]
+    return []
+
+
+class _FakeMetadata:
+    def __init__(self, topics):
+        self.topics = {t: None for t in topics}
+
+
+class _FakeConsumer:
+    def __init__(self, topics):
+        self._topics = topics
+        self.subscribed = None
+        self.callbacks = None
+
+    def list_topics(self, timeout):
+        return _FakeMetadata(self._topics)
+
+    def subscribe(self, topics, on_assign=None, on_revoke=None):
+        self.subscribed = topics
+        self.callbacks = (on_assign, on_revoke)
+
+
+class TestGroupMembership:
+    def test_rebalance_surfaces_as_telemetry(self):
+        monitor = GroupMembership("fleet-svc")
+        try:
+            monitor.on_assign(None, ["t[0]", "t[1]", "t[2]"])
+            assert monitor.generation == 1
+            assert len(monitor.partitions) == 3
+            samples = _family_samples(
+                "livedata_kafka_group_generation", "fleet-svc"
+            )
+            assert samples and samples[0][2] == 1
+            parts = _family_samples(
+                "livedata_kafka_group_assigned_partitions", "fleet-svc"
+            )
+            assert parts[0][2] == 3
+            # A revoke mid-rebalance zeroes the assignment gauge and
+            # counts separately from assigns.
+            monitor.on_revoke(None, ["t[0]"])
+            assert monitor.partitions == ()
+            rebalances = {
+                labels["event"]: value
+                for _suffix, labels, value in _family_samples(
+                    "livedata_kafka_group_rebalances", "fleet-svc"
+                )
+            }
+            assert rebalances == {"assign": 1, "revoke": 1}
+            monitor.on_assign(None, ["t[1]"])
+            assert monitor.generation == 2
+        finally:
+            monitor.close()
+
+    def test_observer_drives_the_fleet_assignment(self):
+        seen = []
+        monitor = GroupMembership(
+            "fleet-svc-2",
+            observer=lambda gen, parts: seen.append((gen, len(parts))),
+        )
+        try:
+            monitor.on_assign(None, ["a", "b"])
+            monitor.on_assign(None, ["a"])
+            assert seen == [(1, 2), (2, 1)]
+        finally:
+            monitor.close()
+
+    def test_subscribe_with_group_wires_callbacks_and_validates(self):
+        import pytest
+
+        monitor = GroupMembership("fleet-svc-3")
+        consumer = _FakeConsumer(["topic_a", "topic_b"])
+        try:
+            subscribe_with_group(
+                consumer, ["topic_a", "topic_b"], monitor
+            )
+            assert consumer.subscribed == ["topic_a", "topic_b"]
+            on_assign, on_revoke = consumer.callbacks
+            assert on_assign == monitor.on_assign
+            assert on_revoke == monitor.on_revoke
+            # Topic validation still fails loudly, like the assign path.
+            with pytest.raises(ValueError, match="not found"):
+                subscribe_with_group(consumer, ["missing"], monitor)
+        finally:
+            monitor.close()
+
+    def test_collector_unregisters_on_close(self):
+        monitor = GroupMembership("closing-group")
+        monitor.on_assign(None, ["p"])
+        monitor.close()
+        assert not _family_samples(
+            "livedata_kafka_group_generation", "closing-group"
+        )

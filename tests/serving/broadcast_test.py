@@ -333,3 +333,232 @@ class TestHttpSurface:
             hub.publish_frame("j:u/out", b"g" * 50, token="t")
             time.sleep(0.05)
         assert hub.qos()["subscribers"] == 0
+
+
+class TestResumeAndHeartbeat:
+    """Last-Event-ID resume + idle heartbeats (ADR 0121 satellite)."""
+
+    def test_resume_same_epoch_serves_deltas_from_ring(self):
+        hub = BroadcastServer(port=None)
+        try:
+            series = frames(4)
+            for cur in series:
+                hub.publish_frame("s", cur, token="t")
+            # A client that decoded seq 0 reconnects: the ring covers
+            # seqs 0..3, so the gap arrives as deltas — no keyframe.
+            from esslivedata_tpu.serving.delta import encode_keyframe
+
+            sub = hub.subscribe("s", resume=(0, 0))
+            decoder = DeltaDecoder()
+            decoder.apply(encode_keyframe(series[0], epoch=0, seq=0))
+            got = []
+            while sub.depth() > 0:
+                blob = sub.next_blob(1.0)
+                assert not decode_header(blob).keyframe, (
+                    "resume within the ring must not replay a keyframe"
+                )
+                got.append(decoder.apply(blob))
+            assert got[-1] == series[-1]
+            assert decoder.seq == 3
+        finally:
+            hub.close()
+
+    def test_resume_at_head_enqueues_nothing(self):
+        hub = BroadcastServer(port=None)
+        try:
+            series = frames(2)
+            hub.publish_frame("s", series[0], token="t")
+            sub = hub.subscribe("s", resume=(0, 0))
+            assert sub.depth() == 0
+            # Live publishes then apply directly to the held frame.
+            hub.publish_frame("s", series[1], token="t")
+            blob = sub.next_blob(1.0)
+            assert not decode_header(blob).keyframe
+        finally:
+            hub.close()
+
+    def test_resume_epoch_mismatch_falls_back_to_keyframe(self):
+        hub = BroadcastServer(port=None)
+        try:
+            series = frames(2)
+            hub.publish_frame("s", series[0], token="t1")
+            hub.publish_frame("s", series[1], token="t2")  # epoch bump
+            sub = hub.subscribe("s", resume=(0, 0))
+            blob = sub.next_blob(1.0)
+            header = decode_header(blob)
+            assert header.keyframe and header.epoch == 1
+        finally:
+            hub.close()
+
+    def test_resume_older_than_ring_falls_back_to_keyframe(self):
+        from esslivedata_tpu.serving import ResultCache
+
+        hub = BroadcastServer(cache=ResultCache(ring=2), port=None)
+        try:
+            series = frames(6)
+            for cur in series:
+                hub.publish_frame("s", cur, token="t")
+            sub = hub.subscribe("s", resume=(0, 0))  # ring holds 4, 5
+            blob = sub.next_blob(1.0)
+            assert decode_header(blob).keyframe
+        finally:
+            hub.close()
+
+    def test_sse_id_carries_epoch_and_seq(self):
+        hub = BroadcastServer(port=0, host="127.0.0.1")
+        try:
+            series = frames(1)
+            hub.publish_frame("j:u/out", series[0], token="t")
+            response = urllib.request.urlopen(
+                f"http://127.0.0.1:{hub.port}/streams/j:u/out", timeout=10
+            )
+            for raw in response:
+                line = raw.decode().rstrip("\n")
+                if line.startswith("id: "):
+                    boot, epoch_s, seq_s = line[len("id: "):].split(":")
+                    assert boot == hub.boot
+                    assert (int(epoch_s), int(seq_s)) == (0, 0)
+                    break
+            response.close()
+        finally:
+            hub.close()
+
+    def test_socket_level_last_event_id_resume_without_keyframe(self):
+        """The relay reconnect path over a REAL socket: a client that
+        echoes the last SSE id back resumes on deltas when the epoch
+        still matches — and detects liveness from heartbeats."""
+        hub = BroadcastServer(port=0, host="127.0.0.1", heartbeat_s=0.2)
+        try:
+            series = frames(5)
+            hub.publish_frame("j:u/out", series[0], token="t")
+            # First connection: read the attach keyframe + its id.
+            response = urllib.request.urlopen(
+                f"http://127.0.0.1:{hub.port}/streams/j:u/out", timeout=10
+            )
+            decoder = DeltaDecoder()
+            last_id = None
+            for raw in response:
+                line = raw.decode().rstrip("\n")
+                if line.startswith("id: "):
+                    last_id = line[len("id: "):]
+                elif line.startswith("data: "):
+                    decoder.apply(base64.b64decode(line[len("data: "):]))
+                    break
+            response.close()
+            assert last_id == f"{hub.boot}:0:0"
+            # Frames published while disconnected...
+            for cur in series[1:3]:
+                hub.publish_frame("j:u/out", cur, token="t")
+            # ...resume with Last-Event-ID: deltas only, no keyframe.
+            request = urllib.request.Request(
+                f"http://127.0.0.1:{hub.port}/streams/j:u/out",
+                headers={"Last-Event-ID": last_id},
+            )
+            response = urllib.request.urlopen(request, timeout=10)
+            kinds, got = [], None
+            saw_heartbeat = False
+            for raw in response:
+                line = raw.decode().rstrip("\n")
+                if line.startswith("event: "):
+                    kinds.append(line[len("event: "):])
+                elif line.startswith(": keepalive"):
+                    saw_heartbeat = True
+                    break
+                elif line.startswith("data: "):
+                    got = decoder.apply(
+                        base64.b64decode(line[len("data: "):])
+                    )
+            response.close()
+            assert kinds == ["delta", "delta"]
+            assert got == series[2]
+            # Idle heartbeat arrived well under the client's patience.
+            assert saw_heartbeat
+        finally:
+            hub.close()
+
+    def test_resume_outcomes_count_into_registry(self):
+        from esslivedata_tpu.serving.broadcast import SERVING_RESUMES
+
+        hub = BroadcastServer(port=None)
+        try:
+            series = frames(3)
+            for cur in series:
+                hub.publish_frame("s", cur, token="t")
+            delta0 = SERVING_RESUMES.value(result="delta")
+            current0 = SERVING_RESUMES.value(result="current")
+            key0 = SERVING_RESUMES.value(result="keyframe")
+            hub.subscribe("s", resume=(0, 1))
+            hub.subscribe("s", resume=(0, 2))
+            hub.subscribe("s", resume=(9, 0))
+            assert SERVING_RESUMES.value(result="delta") == delta0 + 1
+            assert SERVING_RESUMES.value(result="current") == current0 + 1
+            assert SERVING_RESUMES.value(result="keyframe") == key0 + 1
+        finally:
+            hub.close()
+
+    def test_federated_index_appends_peer_rows(self):
+        hub = BroadcastServer(port=0, host="127.0.0.1", name="local")
+        try:
+            hub.publish_frame("j:u/out", b"x" * 64, token="t")
+            hub.set_index_peers(
+                lambda: [
+                    {
+                        "stream": "peer:j/out",
+                        "node": "peer-1",
+                        "path": "/streams/peer:j/out",
+                        "url": "http://peer:5012/streams/peer:j/out",
+                        "hop": 1,
+                    },
+                    # A stream the local hub already serves must not be
+                    # duplicated by federation.
+                    {"stream": "j:u/out", "node": "peer-1"},
+                ]
+            )
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{hub.port}/results", timeout=5
+            ) as response:
+                rows = json.loads(response.read())["streams"]
+            by_stream = {row["stream"]: row for row in rows}
+            assert by_stream["j:u/out"]["node"] == "local"
+            assert by_stream["j:u/out"]["hop"] == 0
+            assert by_stream["peer:j/out"]["url"].startswith("http://peer")
+            assert len(rows) == 2
+        finally:
+            hub.close()
+
+    def test_peer_index_failure_degrades_to_local_rows(self):
+        hub = BroadcastServer(port=0, host="127.0.0.1")
+        try:
+            hub.publish_frame("j:u/out", b"x" * 64, token="t")
+
+            def broken():
+                raise OSError("peer down")
+
+            hub.set_index_peers(broken)
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{hub.port}/results", timeout=5
+            ) as response:
+                rows = json.loads(response.read())["streams"]
+            assert [row["stream"] for row in rows] == ["j:u/out"]
+        finally:
+            hub.close()
+
+    def test_resume_overflow_coalesces_to_a_real_keyframe(self):
+        """A multi-delta resume into a tiny queue must coalesce to a
+        KEYFRAME of the latest tick — enqueuing a later delta instead
+        would hand the client an unsignaled seq gap."""
+        hub = BroadcastServer(port=None, queue_limit=1)
+        try:
+            series = frames(5)
+            for cur in series:
+                hub.publish_frame("s", cur, token="t")
+            # Gap of 4 deltas into a 1-slot queue: everything past the
+            # first enqueue overflows and coalesces.
+            sub = hub.subscribe("s", resume=(0, 0))
+            assert sub.depth() == 1
+            blob = sub.next_blob(1.0)
+            header = decode_header(blob)
+            assert header.keyframe and header.seq == 4
+            assert DeltaDecoder().apply(blob) == series[-1]
+        finally:
+            hub.close()

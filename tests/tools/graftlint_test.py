@@ -357,6 +357,23 @@ def publish(result, sub):
     FRAMES.labels(job=f"{result.job_id}").inc()
     DEPTH.set(sub.depth(), subscriber=str(sub.sub_id))
 ''',
+    # A reconnect loop that redials on a fixed interval: no bound, no
+    # jitter — the lockstep-stampede shape JGL026 exists for.
+    "JGL026": '''
+import http.client
+import time
+
+def consume(host, on_line):
+    while True:
+        try:
+            conn = http.client.HTTPConnection(host)
+            conn.connect()
+            for line in conn.getresponse():
+                on_line(line)
+        except OSError:
+            time.sleep(1.0)
+            continue
+''',
 }
 
 NEGATIVE = {
@@ -837,6 +854,28 @@ class Hub:
                 Sample("", (("subscriber", str(sub_id)),), sub.depth())
             )
         return [fam]
+''',
+    # The polite shape: bounded exponential backoff (min cap) with a
+    # seeded jitter multiplier, reset on success — and the helper
+    # variant (any *backoff* callee) is equally clean.
+    "JGL026": '''
+import http.client
+import random
+import time
+
+def consume(host, stop, on_line):
+    attempts = 0
+    while not stop.is_set():
+        try:
+            conn = http.client.HTTPConnection(host)
+            conn.connect()
+            for line in conn.getresponse():
+                on_line(line)
+            attempts = 0
+        except OSError:
+            attempts += 1
+            delay = min(10.0, 0.5 * (2 ** attempts))
+            time.sleep(delay * (0.5 + random.random()))
 ''',
 }
 # fmt: on

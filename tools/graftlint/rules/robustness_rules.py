@@ -535,3 +535,155 @@ def unbounded_label_cardinality(ctx: FileContext):
                 "collector (register_collector + Sample rows from "
                 "live state) instead of direct instrument labels",
             )
+
+
+# -- JGL026: reconnect loops without bounded backoff + jitter --------------
+
+#: Module scope: the filename reads as a connection client (relay/
+#: client/sse), or the module imports a client-side connection library
+#: — evidence it dials out and may loop on failure.
+_CLIENT_MODULE = re.compile(r"client|relay|sse", re.IGNORECASE)
+_CLIENT_IMPORTS = frozenset(
+    {"http.client", "websocket", "websockets", "socket"}
+)
+#: Callee names that read as "establish a connection / subscription".
+_CONNECT_CALL = re.compile(
+    r"(^|_)(re)?(connect|dial|subscribe|attach_upstream)", re.IGNORECASE
+)
+#: Sleep-ish callee attrs/names (time.sleep, event.wait, asyncio.sleep).
+_SLEEP_ATTRS = frozenset({"sleep", "wait"})
+#: Jitter evidence: a randomness source feeding the delay.
+_JITTER = re.compile(r"random|uniform|jitter", re.IGNORECASE)
+
+
+def _callee_name(call: ast.Call) -> str:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return ""
+
+
+def _swallowing_handler(handler: ast.ExceptHandler) -> bool:
+    """True when the handler lets the loop continue (no bare/direct
+    re-raise anywhere in its body)."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return False
+    return True
+
+
+@rule(
+    "JGL026",
+    "reconnect loop without bounded, jittered backoff",
+)
+def reconnect_without_backoff(ctx: FileContext):
+    """Scope: client/relay modules — the filename reads as one
+    (client/relay/sse), or the module imports a client-side connection
+    library (http.client, websocket(s), socket).
+
+    Within scope, a **reconnect loop** — a ``while`` whose body makes a
+    connect-shaped call (``connect``/``reconnect``/``dial``/
+    ``subscribe``) under a try whose handler swallows the error, so the
+    loop retries — must retry politely. A fleet of relays that lost the
+    same upstream and redials in a tight (or fixed-interval, in-phase)
+    loop is a thundering herd aimed at the process that just came back
+    (ADR 0121). The function must show EITHER:
+
+    - a call to a dedicated backoff helper (callee name contains
+      ``backoff`` — the recommended shape: one audited policy, every
+      loop uses it), OR
+    - all three ingredients inline: a sleep (``time.sleep`` /
+      ``Event.wait``), a bound (a ``min(...)`` cap on the delay), and
+      a jitter source (``random``/``uniform``/``jitter``) — bounded so
+      a long outage doesn't park the client for hours, jittered so
+      recovering clients spread instead of stampeding.
+
+    A loop that re-raises out of its handler is not a reconnect loop
+    (the caller owns the retry policy); connect calls outside a
+    swallowing try are startup dials, not retry storms.
+    """
+    in_scope = bool(_CLIENT_MODULE.search(Path(ctx.path).stem))
+    if not in_scope:
+        for node in ctx.nodes(ast.Import):
+            if any(alias.name in _CLIENT_IMPORTS for alias in node.names):
+                in_scope = True
+                break
+    if not in_scope:
+        for node in ctx.nodes(ast.ImportFrom):
+            if node.module in _CLIENT_IMPORTS:
+                in_scope = True
+                break
+    if not in_scope:
+        return
+    for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+        nested: set[int] = set()
+        for sub in ast.walk(fn):
+            if (
+                isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and sub is not fn
+            ):
+                nested.update(id(n) for n in ast.walk(sub))
+        has_backoff_call = has_sleep = has_min = has_jitter = False
+        for node in ast.walk(fn):
+            if id(node) in nested:
+                continue
+            if isinstance(node, ast.Call):
+                name = _callee_name(node)
+                if "backoff" in name.lower():
+                    has_backoff_call = True
+                if name in _SLEEP_ATTRS:
+                    has_sleep = True
+                if isinstance(node.func, ast.Name) and node.func.id == "min":
+                    has_min = True
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                ident = (
+                    node.id if isinstance(node, ast.Name) else node.attr
+                )
+                if _JITTER.search(ident):
+                    has_jitter = True
+        polite = has_backoff_call or (has_sleep and has_min and has_jitter)
+        if polite:
+            continue
+        for loop in ast.walk(fn):
+            if id(loop) in nested or not isinstance(loop, ast.While):
+                continue
+            reconnecting = None
+            for handler in ast.walk(loop):
+                if not isinstance(handler, ast.ExceptHandler):
+                    continue
+                if not _swallowing_handler(handler):
+                    continue
+                # The try this handler guards must contain (or the loop
+                # body around it) a connect-shaped call; checking the
+                # whole loop body keeps the heuristic simple and errs
+                # quiet only when no connect call exists at all.
+                for call in ast.walk(loop):
+                    if isinstance(call, ast.Call) and _CONNECT_CALL.search(
+                        _callee_name(call)
+                    ):
+                        reconnecting = call
+                        break
+                if reconnecting is not None:
+                    break
+            if reconnecting is None:
+                continue
+            missing = []
+            if not has_sleep:
+                missing.append("a backoff sleep")
+            if not has_min:
+                missing.append("a min(...) cap bounding the delay")
+            if not has_jitter:
+                missing.append("a jitter source (random/uniform)")
+            yield Finding(
+                ctx.path,
+                loop.lineno,
+                "JGL026",
+                f"reconnect loop in '{fn.name}' retries without "
+                f"{', '.join(missing)}: a fleet of clients that lost "
+                "the same upstream will redial in lockstep and "
+                "stampede the process that just came back — use a "
+                "bounded, jittered exponential backoff (or route "
+                "through a shared *backoff* helper)",
+            )
+            break  # one finding per function names the whole gap
