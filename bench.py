@@ -883,6 +883,282 @@ def bench_tick(args) -> dict:
     return tick
 
 
+def bench_workloads(args) -> dict:
+    """Workload plane through the REAL JobManager path (ADR 0122).
+
+    Three families on one stream — powder focusing (calibration-LUT
+    TOF->d, veto-filtered), a pass-all-filtered detector view, and the
+    imaging view (flat-field at publish) — each a (stream, fuse-key)
+    tick group of K=2 jobs.
+
+    Acceptance (asserted here AND in --smoke/CI):
+
+    - With per-event filters ACTIVE, a steady-state tick is still
+      exactly 1 execute + 1 fetch per group and 0 separate step
+      dispatches — filtering is a host batch transform, zero extra
+      device round trips.
+    - The pass-all-filtered detector view's da00 wire is BYTE-IDENTICAL
+      to an unfiltered reference (predicates-pass-all identity).
+    - A live calibration swap re-keys the tick program and the ADR 0116
+      instrument classifies the resulting compile as ``layout_swap``;
+      with the AOT warm-up attached (ADR 0118) the same swap's compile
+      lands OFF the hot path — commit-time ``livedata_jit_compiles``
+      delta 0.
+
+    One JSON line on stderr.
+    """
+    from esslivedata_tpu.config import JobId, WorkflowConfig, WorkflowSpec
+    from esslivedata_tpu.core.job_manager import JobFactory, JobManager
+    from esslivedata_tpu.core.timestamp import Timestamp
+    from esslivedata_tpu.durability import CompileWarmupService
+    from esslivedata_tpu.kafka.da00_compat import dataarray_to_da00
+    from esslivedata_tpu.kafka.wire import encode_da00
+    from esslivedata_tpu.ops import EventBatch
+    from esslivedata_tpu.ops.publish import METRICS
+    from esslivedata_tpu.preprocessors.event_data import StagedEvents
+    from esslivedata_tpu.telemetry import COMPILE_EVENTS
+    from esslivedata_tpu.workflows import WorkflowFactory
+    from esslivedata_tpu.workflows.detector_view import (
+        DetectorViewWorkflow,
+        project_logical,
+    )
+    from esslivedata_tpu.workloads import (
+        CalibrationTable,
+        FilterChain,
+        ImagingViewParams,
+        ImagingViewWorkflow,
+        PowderFocusParams,
+        PowderFocusWorkflow,
+        PulseVetoFilter,
+        ToaRangeFilter,
+    )
+
+    n_pix = 1 << 10
+    side = int(np.sqrt(n_pix))
+    det = np.arange(n_pix).reshape(side, side)
+    n_events = min(args.events, 1 << 16)
+    n_windows = max(6, args.batches // 4)
+    toa_hi = 71e6
+
+    def make_calib(version=1, tzero=0.0) -> CalibrationTable:
+        return CalibrationTable(
+            name="bench_cal",
+            version=version,
+            columns={
+                "difc": np.linspace(2.0e7, 3.0e7, n_pix),
+                "tzero": np.full(n_pix, tzero),
+            },
+        )
+
+    veto = FilterChain(
+        [PulseVetoFilter(windows=((1e6, 4e6),), period_ns=toa_hi)]
+    )
+    passall = FilterChain([ToaRangeFilter(lo_ns=-1e18, hi_ns=1e18)])
+
+    makes = {
+        "powder": lambda: PowderFocusWorkflow(
+            calibration=make_calib(),
+            params=PowderFocusParams(d_bins=256),
+            filters=veto,
+        ),
+        "detview": lambda: DetectorViewWorkflow(
+            projection=project_logical(det), filters=passall
+        ),
+        "imaging": lambda: ImagingViewWorkflow(
+            detector_number=det,
+            params=ImagingViewParams(frames=4, toa_high=toa_hi),
+            filters=veto,
+        ),
+    }
+
+    def make_mgr(factories) -> JobManager:
+        reg = WorkflowFactory()
+        mgr = JobManager(job_factory=JobFactory(reg), job_threads=4)
+        for name, make in factories.items():
+            spec = WorkflowSpec(
+                instrument="bench_wl", name=name, source_names=["det0"]
+            )
+            reg.register_spec(spec).attach_factory(
+                lambda *, source_name, params, _m=make: _m()
+            )
+            for _ in range(2):
+                mgr.schedule_job(
+                    WorkflowConfig(
+                        identifier=spec.identifier,
+                        job_id=JobId(source_name="det0"),
+                    )
+                )
+        return mgr
+
+    def layout_swaps() -> float:
+        return COMPILE_EVENTS.total(trigger="layout_swap")
+
+    t0 = Timestamp.from_ns(0)
+    rng = np.random.default_rng(4600)
+    batches = [
+        EventBatch.from_arrays(
+            rng.integers(0, n_pix, n_events),
+            rng.uniform(0, toa_hi, n_events).astype(np.float32),
+        )
+        for _ in range(4)
+    ]
+
+    def staged(i: int) -> StagedEvents:
+        return StagedEvents(
+            batch=batches[i % len(batches)],
+            first_timestamp=None,
+            last_timestamp=None,
+            n_chunks=1,
+        )
+
+    mgr = make_mgr(makes)
+    # Unfiltered reference detector views for the pass-all identity.
+    ref = make_mgr(
+        {
+            "detview": lambda: DetectorViewWorkflow(
+                projection=project_logical(det)
+            )
+        }
+    )
+    n_groups, k = 3, 2
+    for w in range(2):  # warm: program variants + static fetches
+        out = mgr.process_jobs(
+            {"det0": staged(w)}, start=t0, end=Timestamp.from_ns(1 + w)
+        )
+        assert len(out) == n_groups * k
+        ref.process_jobs(
+            {"det0": staged(w)}, start=t0, end=Timestamp.from_ns(1 + w)
+        )
+    from esslivedata_tpu.telemetry.instruments import EVENTS_FILTERED
+
+    METRICS.drain()
+    mgr.event_cache_stats()
+    compiles_warm = COMPILE_EVENTS.total()
+    filtered_before = EVENTS_FILTERED.total()
+    dv_wire: list[list[bytes]] = []
+    events_seen = 0
+    start = time.perf_counter()
+    # Measured loop: ONLY the workload manager (the unfiltered
+    # reference runs after, outside the drained counters).
+    for i in range(n_windows):
+        out = mgr.process_jobs(
+            {"det0": staged(i)}, start=t0, end=Timestamp.from_ns(3 + i)
+        )
+        assert len(out) == n_groups * k
+        dv_wire.append(
+            [
+                encode_da00(name, 1, dataarray_to_da00(da))
+                for r in out
+                if "detview" in str(r.workflow_id)
+                for name, da in r.outputs.items()
+            ]
+        )
+        events_seen += int(batches[i % len(batches)].n_valid)
+    dt = time.perf_counter() - start
+    m = METRICS.drain()
+    compiles_steady = COMPILE_EVENTS.total() - compiles_warm
+    # Veto drop rate over the measured loop: powder + imaging both run
+    # the chain, so normalize per consuming family pass.
+    events_filtered = EVENTS_FILTERED.total() - filtered_before
+
+    # Pass-all identity: the filtered detector view's wire == the
+    # unfiltered reference's, byte for byte, every window.
+    for i in range(n_windows):
+        out_ref = ref.process_jobs(
+            {"det0": staged(i)}, start=t0, end=Timestamp.from_ns(3 + i)
+        )
+        ref_wire = [
+            encode_da00(name, 1, dataarray_to_da00(da))
+            for r in out_ref
+            for name, da in r.outputs.items()
+        ]
+        assert dv_wire[i] == ref_wire, (
+            f"window {i}: pass-all filter changed the da00 wire"
+        )
+
+    # Live calibration swap, COLD: the next tick compiles on the hot
+    # path and the instrument classifies it layout_swap.
+    swaps_before = layout_swaps()
+    cold_before = COMPILE_EVENTS.total()
+    for rec in mgr._records.values():
+        wf = rec.job.workflow
+        if hasattr(wf, "set_calibration"):
+            assert wf.set_calibration(make_calib(version=2, tzero=5e4))
+    out = mgr.process_jobs(
+        {"det0": staged(0)}, start=t0, end=Timestamp.from_ns(500)
+    )
+    assert len(out) == n_groups * k
+    cold_swap_compiles = COMPILE_EVENTS.total() - cold_before
+    swap_classified = layout_swaps() - swaps_before
+
+    # The same swap WARMED (ADR 0118): request_warmup drains before the
+    # next window, so the hot-path compile delta is 0.
+    warmup = CompileWarmupService()
+    mgr.set_warmup(warmup)
+    try:
+        for rec in mgr._records.values():
+            wf = rec.job.workflow
+            if hasattr(wf, "set_calibration"):
+                assert wf.set_calibration(
+                    make_calib(version=3, tzero=1e5)
+                )
+        mgr.request_warmup("layout_swap")
+        assert warmup.quiesce(120), "warm-up never drained"
+        warm_before = COMPILE_EVENTS.total()
+        out = mgr.process_jobs(
+            {"det0": staged(1)}, start=t0, end=Timestamp.from_ns(501)
+        )
+        assert len(out) == n_groups * k
+        warmed_swap_compiles = COMPILE_EVENTS.total() - warm_before
+    finally:
+        warmup.close()
+    mgr.shutdown()
+    ref.shutdown()
+
+    line = {
+        "metric": "workload_plane",
+        "families": ["powder_focus", "detector_view", "imaging_view"],
+        "jobs": n_groups * k,
+        # Graded value: device dispatches per steady-state FILTERED
+        # tick, per group — the zero-extra-dispatch filtering claim.
+        "value": (m["executes"] + m["step_executes"])
+        / (n_windows * n_groups),
+        "unit": "dispatches/tick/group",
+        "executes_per_tick": m["executes"] / n_windows,
+        "fetches_per_tick": m["fetches"] / n_windows,
+        "step_executes_per_tick": m["step_executes"] / n_windows,
+        "tick_publishes": m["tick_publishes"],
+        "static_bytes_steady": m["static_bytes"],
+        # One memoized chain pass per window (powder + imaging share
+        # the chain digest), so the ratio is the per-event drop rate.
+        "filtered_fraction": events_filtered / max(1, events_seen),
+        "passall_wire_byte_identical": True,
+        "compile_events_steady": compiles_steady,
+        "cold_swap_compiles": cold_swap_compiles,
+        "cold_swap_classified_layout_swap": swap_classified,
+        "warmed_swap_compiles": warmed_swap_compiles,
+        "wall_ms_per_tick": 1e3 * dt / n_windows,
+        "windows": n_windows,
+        "events_per_window": n_events,
+        "telemetry": telemetry_snapshot(),
+    }
+    emit_line(line)
+    # Acceptance: filters active, still one dispatch per group tick.
+    assert line["value"] == 1.0, line
+    assert m["fetches"] == n_windows * n_groups, line
+    assert m["step_executes"] == 0, line
+    assert m["static_bytes"] == 0, line
+    assert compiles_steady == 0, line
+    # The veto actually filtered (powder counts < raw events).
+    assert 0.0 < line["filtered_fraction"] < 1.0, line
+    # Cold swap: compiled on the hot path AND classified layout_swap.
+    assert cold_swap_compiles >= 1, line
+    assert swap_classified >= 1, line
+    # Warmed swap: zero hot-path compiles (the ADR 0122 acceptance).
+    assert warmed_swap_compiles == 0, line
+    return line
+
+
 def bench_fanout(args, n_values: tuple[int, ...] | None = None) -> dict:
     """Result fan-out tier through the REAL JobManager + ServingPlane
     (ADR 0117).
@@ -2661,6 +2937,7 @@ def run_benchmark(args, platform: str) -> dict:
             lambda: bench_multijob(args),
             lambda: bench_publish(args),
             lambda: bench_tick(args),
+            lambda: bench_workloads(args),
             lambda: bench_fanout(args),
             lambda: bench_relay(args),
             lambda: bench_churn(args),
@@ -3000,6 +3277,17 @@ def _parse_args():
         "--multijob; also runs under --all and --smoke)",
     )
     parser.add_argument(
+        "--workloads",
+        action="store_true",
+        help="Run ONLY the workload-plane scenario (ADR 0122) and "
+        "exit: powder-focus + filtered detector-view + imaging through "
+        "the real JobManager — 1 execute + 1 fetch per FILTERED tick "
+        "asserted, pass-all-filter da00 byte identity, calibration "
+        "LUT-swap compile classified layout_swap (and 0 hot-path "
+        "compiles with the AOT warm-up attached) (dev flag, like "
+        "--multijob; also runs under --all and --smoke)",
+    )
+    parser.add_argument(
         "--mesh",
         action="store_true",
         help="Run ONLY the mesh serving-tier scenario (ADR 0115) on an "
@@ -3209,6 +3497,34 @@ def _smoke_main(args) -> int:
             )
         if "telemetry" not in tick_line:
             problems.append("tick line missing telemetry snapshot")
+    # Workload-plane control (ADR 0122): tiny run through the real
+    # JobManager; the scenario itself asserts 1-dispatch filtered
+    # ticks, pass-all byte identity, layout_swap classification and
+    # the warmed 0-compile swap, and this guards the report structure.
+    try:
+        wl_line = bench_workloads(args)
+    except Exception:
+        traceback.print_exc()
+        problems.append("workloads scenario raised")
+    else:
+        for field in (
+            "value",
+            "executes_per_tick",
+            "fetches_per_tick",
+            "filtered_fraction",
+            "cold_swap_classified_layout_swap",
+            "warmed_swap_compiles",
+        ):
+            if wl_line.get(field) is None:
+                problems.append(f"workloads line missing {field!r}")
+        if wl_line.get("value") != 1.0:
+            problems.append(
+                "filtered workload tick not at 1 dispatch/group"
+            )
+        if wl_line.get("warmed_swap_compiles") != 0:
+            problems.append(
+                "warmed calibration swap still compiled on the hot path"
+            )
     # Result fan-out control (ADR 0117): tiny run through the real
     # JobManager + ServingPlane at N=1 and N=50 simulated subscribers;
     # the scenario itself asserts publish-side device ops identical
@@ -3431,6 +3747,13 @@ def main() -> None:
         if args.batches is None:
             args.batches = 32
         bench_tick(args)
+        sys.exit(0)
+    if args.workloads:
+        if args.events is None:
+            args.events = 1 << 15
+        if args.batches is None:
+            args.batches = 32
+        bench_workloads(args)
         sys.exit(0)
     if args.fanout:
         if args.events is None:

@@ -67,6 +67,11 @@ REQUIRED_FAMILIES = (
     # state-loss counter are always-registered instruments.
     "livedata_e2e_latency_seconds",
     "livedata_state_lost",
+    # Workload plane (ADR 0122): calibration-swap and filter-drop
+    # counters are always-registered — a service hosting no workload
+    # family still exposes them with zero samples.
+    "livedata_calibration_swaps",
+    "livedata_events_filtered",
 )
 
 

@@ -5,14 +5,23 @@ from __future__ import annotations
 
 from functools import lru_cache
 
+import numpy as np
+
 from ....workflows.detector_view.projectors import ProjectionTable, project_logical
 from ....workflows.detector_view.workflow import DetectorViewWorkflow
 from ....workflows.monitor_workflow import MonitorWorkflow
 from ....workflows.timeseries import TimeseriesWorkflow
+from ....workloads.calibration import CalibrationTable
+from ....workloads.imaging import ImagingViewWorkflow
+from ....workloads.powder_focus import PowderFocusWorkflow
+from ....workloads.correlation import TimeseriesCorrelationWorkflow
 from .specs import (
     DETECTOR_VIEW_HANDLE,
+    IMAGING_VIEW_HANDLE,
     INSTRUMENT,
+    LOG_CORRELATION_HANDLE,
     MONITOR_HANDLE,
+    POWDER_FOCUS_HANDLE,
     TIMESERIES_HANDLE,
 )
 
@@ -38,3 +47,49 @@ def make_monitor(*, source_name: str, params) -> MonitorWorkflow:
 @TIMESERIES_HANDLE.attach_factory
 def make_timeseries(*, source_name: str, params) -> TimeseriesWorkflow:
     return TimeseriesWorkflow()
+
+
+# -- workload plane (ADR 0122) ---------------------------------------------
+@lru_cache(maxsize=None)
+def _default_calibration(detector_name: str) -> CalibrationTable:
+    """A physically-plausible default GSAS calibration for the dummy
+    panel (d = toa / difc): real deployments load versioned tables from
+    calibration files (workloads.calibration.load_calibration) or the
+    CalibrationStore; the dummy ships a synthetic v1 so the family runs
+    out of the box."""
+    det = INSTRUMENT.detectors[detector_name]
+    n_pixel = int(det.detector_number.max()) + 1
+    # A gentle per-pixel spread mimics path-length variation.
+    difc = 25_000_000.0 * (1.0 + 0.1 * np.linspace(0, 1, n_pixel))
+    return CalibrationTable(
+        name=f"dummy_{detector_name}",
+        version=1,
+        columns={"difc": difc, "tzero": np.zeros(n_pixel)},
+    )
+
+
+@POWDER_FOCUS_HANDLE.attach_factory
+def make_powder_focus(*, source_name: str, params) -> PowderFocusWorkflow:
+    return PowderFocusWorkflow(
+        calibration=_default_calibration(source_name), params=params
+    )
+
+
+@IMAGING_VIEW_HANDLE.attach_factory
+def make_imaging_view(*, source_name: str, params) -> ImagingViewWorkflow:
+    det = INSTRUMENT.detectors[source_name]
+    return ImagingViewWorkflow(
+        detector_number=det.detector_number, params=params
+    )
+
+
+@LOG_CORRELATION_HANDLE.attach_factory
+def make_log_correlation(
+    *, source_name: str, params, aux_source_names=None
+) -> TimeseriesCorrelationWorkflow:
+    # The matrix spans the job's source plus its AUX-bound partner
+    # logs — and only those: a job never receives streams it doesn't
+    # subscribe, so correlating unsubscribed sources would silently
+    # never sample (the aligned-vector gate needs every stream).
+    streams = [source_name] + sorted((aux_source_names or {}).values())
+    return TimeseriesCorrelationWorkflow(streams=streams)

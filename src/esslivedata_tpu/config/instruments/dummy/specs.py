@@ -89,3 +89,89 @@ TIMESERIES_HANDLE = workflow_registry.register_spec(
         reset_on_run_transition=False,
     )
 )
+
+# -- workload plane (ADR 0122) ---------------------------------------------
+from ....workloads.imaging import ImagingViewParams  # noqa: E402
+from ....workloads.powder_focus import PowderFocusParams  # noqa: E402
+
+POWDER_FOCUS_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="dummy",
+        namespace="data_reduction",
+        name="powder_focus",
+        title="Powder focusing (calibration LUT TOF→d)",
+        source_names=INSTRUMENT.detector_names,
+        params_model=PowderFocusParams,
+        outputs={
+            "dspacing_current": OutputSpec(title="I(d) (window)"),
+            "dspacing_cumulative": OutputSpec(
+                title="I(d) (since start)", view="since_start"
+            ),
+            "dspacing_focussed": OutputSpec(
+                title="Focussed I(d) / acceptance", view="since_start"
+            ),
+            "dspacing_banked_cumulative": OutputSpec(
+                title="I(d) per bank", view="since_start"
+            ),
+            "acceptance": OutputSpec(title="Calibration acceptance"),
+            "counts_current": OutputSpec(title="Counts (window)"),
+            "counts_cumulative": OutputSpec(
+                title="Counts (since start)", view="since_start"
+            ),
+            "calibration_version": OutputSpec(
+                title="Active calibration version"
+            ),
+        },
+    )
+)
+
+IMAGING_VIEW_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="dummy",
+        namespace="detector_view",
+        name="imaging_view",
+        title="Imaging view (dense 2-D, flat-field corrected)",
+        source_names=INSTRUMENT.detector_names,
+        params_model=ImagingViewParams,
+        outputs={
+            "image_current": OutputSpec(title="Image (window)"),
+            "image_cumulative": OutputSpec(
+                title="Image (since start)", view="since_start"
+            ),
+            "image_corrected": OutputSpec(
+                title="Flat-field-corrected image", view="since_start"
+            ),
+            "flatfield": OutputSpec(title="Applied flat-field"),
+            "frame_counts_current": OutputSpec(title="Frame-gate counts"),
+            "counts_current": OutputSpec(title="Counts (window)"),
+            "counts_cumulative": OutputSpec(
+                title="Counts (since start)", view="since_start"
+            ),
+        },
+    )
+)
+
+LOG_CORRELATION_HANDLE = workflow_registry.register_spec(
+    WorkflowSpec(
+        instrument="dummy",
+        namespace="timeseries",
+        name="log_correlation",
+        title="Timeseries correlation analytics",
+        source_names=sorted(INSTRUMENT.log_sources),
+        # Partner logs bind as AUX streams: a job only RECEIVES streams
+        # it subscribes (core/job.py filters to subscribed_streams), so
+        # every correlated stream beyond the job's own source must be
+        # an aux binding or the matrix would silently never sample.
+        aux_source_names={
+            "partner_a": sorted(INSTRUMENT.log_sources),
+            "partner_b": sorted(INSTRUMENT.log_sources),
+        },
+        reset_on_run_transition=False,
+        outputs={
+            "correlation": OutputSpec(title="Correlation matrix"),
+            "mean": OutputSpec(title="Stream means"),
+            "stddev": OutputSpec(title="Stream std deviations"),
+            "samples": OutputSpec(title="Aligned samples"),
+        },
+    )
+)

@@ -301,13 +301,20 @@ class Job:
                 da.coords["end_time"] = Variable(
                     np.asarray(end.ns, dtype=np.int64), (), "ns"
                 )
+        # Workflows may carry their own epoch contribution (duck-typed
+        # ``publish_epoch``): a calibration swap (ADR 0122) keeps the
+        # accumulation — no clear, no state loss — but downstream delta
+        # streams must still resync on ONE keyframe at the handover.
+        # Summing keeps both counters monotone and independent; the
+        # serving tier only compares tokens for equality.
+        wf_epoch = int(getattr(self.workflow, "publish_epoch", 0) or 0)
         return JobResult(
             job_id=self.job_id,
             workflow_id=self.workflow_id,
             outputs=outputs,
             start=start,
             end=end,
-            state_epoch=self.state_epoch,
+            state_epoch=self.state_epoch + wf_epoch,
         )
 
     def process(

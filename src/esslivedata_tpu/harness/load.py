@@ -96,6 +96,24 @@ PEAK_QUEUE_DEPTH = REGISTRY.gauge(
     "(a scrape-time gauge can miss the peak; the harness samples "
     "after every publish)",
 )
+#: Workload-plane rows (ADR 0122): the drill runs a veto-filtered
+#: powder-focus stream alongside the detector views, and its parity /
+#: freshness gate separately — a new family that silently fell off the
+#: serving path would otherwise hide inside the global counters.
+WORKLOAD_PARITY_CHECKS = REGISTRY.counter(
+    "livedata_slo_workload_parity_checks",
+    "Workload-family (powder-focus) checker reconstructions "
+    "byte-compared against the sink da00 wire",
+)
+WORKLOAD_PARITY_VIOLATIONS = REGISTRY.counter(
+    "livedata_slo_workload_parity_violations",
+    "Workload-family checker reconstructions that did NOT byte-match",
+)
+WORKLOAD_FRESHNESS = REGISTRY.histogram(
+    "livedata_slo_workload_freshness_seconds",
+    "Source-timestamp age of workload-family frames at checker "
+    "delivery (the per-family freshness SLO)",
+)
 
 
 @dataclass
@@ -105,6 +123,10 @@ class LoadConfig:
 
     streams: int = 4
     jobs_per_stream: int = 2
+    #: Workload-plane streams (ADR 0122): each runs one veto-filtered
+    #: powder-focus job — the new-family presence the SLO rules gate
+    #: (parity + freshness rows). 0 = pre-workload drill.
+    workload_streams: int = 1
     subscribers: int = 240
     windows: int = 48
     warm_windows: int = 3
@@ -178,9 +200,18 @@ class LoadHarness:
             project_logical,
         )
 
+        from ..workloads import (
+            CalibrationTable,
+            FilterChain,
+            PowderFocusParams,
+            PowderFocusWorkflow,
+            PulseVetoFilter,
+        )
+
         cfg = self.config
         side = int(np.sqrt(min(cfg.pixels, 1 << 14)))
         det = np.arange(side * side).reshape(side, side)
+        n_pix = side * side
         reg = WorkflowFactory()
         streams = [f"slo_stream_{i}" for i in range(cfg.streams)]
         for stream in streams:
@@ -193,12 +224,47 @@ class LoadHarness:
                 )
             )
             self._specs[stream] = spec
+        # Workload plane (ADR 0122): veto-filtered powder-focus streams
+        # — a calibration-LUT family with per-event filtering riding the
+        # same tick path, gated by its own parity/freshness rows.
+        calib = CalibrationTable(
+            name="slo_cal",
+            version=1,
+            columns={
+                "difc": np.linspace(2.0e7, 3.0e7, n_pix),
+                "tzero": np.zeros(n_pix),
+            },
+        )
+        chain = FilterChain(
+            [PulseVetoFilter(windows=((1e6, 4e6),), period_ns=7.0e7)]
+        )
+        workload_streams = [
+            f"slo_powder_{i}" for i in range(max(0, cfg.workload_streams))
+        ]
+        for stream in workload_streams:
+            spec = WorkflowSpec(
+                instrument="slo", name=f"pf_{stream}", source_names=[stream]
+            )
+            reg.register_spec(spec).attach_factory(
+                lambda *, source_name, params: PowderFocusWorkflow(
+                    calibration=calib,
+                    params=PowderFocusParams(d_bins=128),
+                    filters=chain,
+                )
+            )
+            self._specs[stream] = spec
+        streams = streams + workload_streams
         mgr = JobManager(
             job_factory=JobFactory(reg),
-            job_threads=min(4, cfg.streams * cfg.jobs_per_stream),
+            job_threads=min(4, len(streams) * cfg.jobs_per_stream),
         )
         for stream in streams:
-            for _ in range(cfg.jobs_per_stream):
+            jobs = (
+                cfg.jobs_per_stream
+                if stream not in workload_streams
+                else 1
+            )
+            for _ in range(jobs):
                 mgr.schedule_job(
                     WorkflowConfig(
                         identifier=self._specs[stream].identifier,
@@ -276,10 +342,13 @@ class LoadHarness:
         from .. import serving
 
         got_any = False
+        last_frame_ts: int | None = None
         while sim.sub.depth() > 0:
-            blob = sim.sub.next_blob(timeout=1.0)
+            blob, frame_ts = sim.sub.next_blob_meta(timeout=1.0)
             if blob is None:  # pragma: no cover - depth>0 guarantees one
                 break
+            if frame_ts is not None:
+                last_frame_ts = frame_ts
             got_any = True
             sim.delivered += 1
             header = serving.decode_header(blob)
@@ -320,8 +389,23 @@ class LoadHarness:
             sim.was_coalesced = False
         if got_any and sim.checker and sim.stream in reference:
             PARITY_CHECKS.inc()
-            if sim.frame != reference[sim.stream]:
+            violated = sim.frame != reference[sim.stream]
+            if violated:
                 PARITY_VIOLATIONS.inc()
+            if sim.stream.startswith("slo_powder"):
+                # Workload-plane rows (ADR 0122): the new family's
+                # parity and freshness gate on their own counters.
+                # Freshness against the DELIVERED frame's own source
+                # timestamp (the broadcast queue carries it per entry)
+                # — measuring against the current window's ts would
+                # score a k-window-late frame as fresh.
+                WORKLOAD_PARITY_CHECKS.inc()
+                if violated:
+                    WORKLOAD_PARITY_VIOLATIONS.inc()
+                if last_frame_ts is not None:
+                    WORKLOAD_FRESHNESS.observe(
+                        max(0.0, (time.time_ns() - last_frame_ts) / 1e9)
+                    )
 
     # -- the run -------------------------------------------------------------
     def run(self) -> dict:
@@ -394,16 +478,21 @@ class LoadHarness:
             # that pays a jit compile mid-incident blows the very p99
             # it exists to protect.
             warm_windows = cfg.warm_windows
+            # Tick groups per window: one per detector-view stream
+            # (jobs_per_stream jobs fuse) + one singleton per workload
+            # (powder-focus) stream — the warm-poison arithmetic below
+            # fails each group exactly once.
+            n_groups = cfg.streams + max(0, cfg.workload_streams)
             if cfg.chaos is not None:
-                warm_windows = max(warm_windows, cfg.streams + 2)
-                # Window 1..streams: consultation (w-1)*streams + g
+                warm_windows = max(warm_windows, n_groups + 2)
+                # Window 1..n_groups: consultation (w-1)*n_groups + g
                 # fires where g == w-1 — exactly one group per window.
                 warm_poison = ChaosSchedule(
                     ChaosSpec(
                         at={
                             "tick_dispatch": frozenset(
-                                k * (cfg.streams + 1)
-                                for k in range(cfg.streams)
+                                k * (n_groups + 1)
+                                for k in range(n_groups)
                             )
                         }
                     )
@@ -411,7 +500,7 @@ class LoadHarness:
             for w in range(warm_windows):
                 if cfg.chaos is not None:
                     mgr.set_chaos(
-                        warm_poison if 1 <= w <= cfg.streams else None
+                        warm_poison if 1 <= w <= n_groups else None
                     )
                 ts = time.time_ns()
                 window = {s: self._staged(rng, side) for s in streams}
@@ -527,7 +616,10 @@ class LoadHarness:
             qos = edge_hub.qos()
             report = {
                 "streams": cfg.streams,
-                "jobs": cfg.streams * cfg.jobs_per_stream,
+                "workload_streams": max(0, cfg.workload_streams),
+                "jobs": cfg.streams * cfg.jobs_per_stream
+                + max(0, cfg.workload_streams),
+                "workload_parity_checks": WORKLOAD_PARITY_CHECKS.total(),
                 "subscribers": cfg.subscribers,
                 "windows": cfg.windows,
                 "relay_hops": len(relays),

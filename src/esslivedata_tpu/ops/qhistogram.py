@@ -12,13 +12,14 @@ on host and swaps it in without stalling the stream.
 
 from __future__ import annotations
 
+import itertools
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .event_batch import EventBatch, stage_raw
+from .event_batch import EventBatch, stage_for, stage_raw
 
 __all__ = [
     "QHistogrammer",
@@ -440,9 +441,35 @@ def table_scatter_delta(
     return delta.at[qb].add(1.0, mode="drop")
 
 
+#: Process-unique instance tokens for Q fuse keys: two histogrammers
+#: carry independent tables, so only states of the SAME instance may
+#: fuse — id() would recycle after GC, a monotone counter cannot.
+_INSTANCE_TOKENS = itertools.count()
+
+
 class QHistogrammer:
     """Scatter-add into Q bins via a precompiled (pixel, toa_bin) map,
-    with monitor counts accumulated on device for normalization."""
+    with monitor counts accumulated on device for normalization.
+
+    Tick-program contract (ADR 0114): ``tick_staging``/``tick_step``/
+    ``step_many``/``stage_events``/``fuse_key`` give QHistogrammer-backed
+    reductions (SANS I(Q), QE, powder, reflectometry, elastic,
+    wavelength — ``QStreamingMixin``) the ONE-dispatch steady-state tick
+    and mesh placement, closing the PR 6 coverage gap. Two deliberate
+    asymmetries vs ``EventHistogrammer``:
+
+    - The bin table rides the staged tuple as a jit ARGUMENT (the
+      ADR 0105 discipline this kernel was built on), so a live
+      ``swap_table`` — a reflectometry omega move, a powder emission
+      recalibration — stays one device transfer and NEVER recompiles
+      the tick program (the program key sees only the staged
+      signature, which a same-shape swap preserves).
+    - ``fuse_key`` carries a process-unique instance token: every job
+      owns its own table, and fusing two jobs' states under member[0]'s
+      table would silently reduce job 2 with job 1's calibration. Q
+      groups are therefore singletons — which still halves the
+      steady-state dispatch count (step + publish ride one program).
+    """
 
     def __init__(
         self,
@@ -493,10 +520,20 @@ class QHistogrammer:
         self._lo = float(toa_edges[0])
         self._hi = float(toa_edges[-1])
         self._n_toa = toa_edges.size - 1
+        # graft: key-derived=_inv_width pure function of _lo/_hi/_n_toa,
+        # all of which ride fuse_key — it cannot change under an
+        # unchanged key.
         self._inv_width = float(self._n_toa / (self._hi - self._lo))
         self._dtype = dtype
         self._method = method
+        self._instance_token = next(_INSTANCE_TOKENS)
+        self._table_version = 0
+        #: Per-slice device copies of the table (mesh placement stages
+        #: the wire onto a slice; the table argument must live there
+        #: too). Rebuilt lazily, dropped on every swap_table.
+        self._qmap_by_device: dict[int, jax.Array] = {}
         self._step = jax.jit(self._step_impl, donate_argnums=(0,))
+        self._step_fused = jax.jit(self._step_fused_impl, donate_argnums=(0,))
         self._clear_window = jax.jit(self._clear_window_impl, donate_argnums=(0,))
 
     @property
@@ -534,6 +571,16 @@ class QHistogrammer:
             monitor_window=state.monitor_window + mc,
         )
 
+    def _step_fused_impl(self, states, qmap, pixel_id, toa, monitor_count):
+        # The exact per-state program ``_step_impl`` runs, trace-unrolled
+        # over the states tuple (the EventHistogrammer fused-stepping
+        # shape): per-state float op order is unchanged, so fused/tick
+        # results are bit-identical to private stepping.
+        return tuple(
+            self._step_impl(s, qmap, pixel_id, toa, monitor_count)
+            for s in states
+        )
+
     @staticmethod
     def _clear_window_impl(state: QState) -> QState:
         return QState(
@@ -541,6 +588,121 @@ class QHistogrammer:
             window=jnp.zeros_like(state.window),
             monitor_cumulative=state.monitor_cumulative,
             monitor_window=jnp.zeros_like(state.monitor_window),
+        )
+
+    # -- stage-once / fused-stepping / tick contract (ADR 0110/0114) --------
+    @property
+    def layout_digest(self) -> str:
+        """Identity label for the compile/telemetry instruments: the
+        binning geometry plus the table EPOCH (not its bytes — digesting
+        a GB-scale map per omega move would stall the stream; the tick
+        program never keys on this, so the label only needs to move
+        when the mapping does)."""
+        return (
+            f"q{self._instance_token}:{self._table_version}:"
+            f"{self._table_shape[0]}x{self._table_shape[1]}:{self._n_q}"
+        )
+
+    @property
+    def fuse_key(self) -> tuple:
+        """Fused-group key: the instance token scopes fusion to states
+        stepped by THIS kernel (each job owns its own table — see class
+        docstring), the rest pins the program-shaping constants."""
+        return (
+            "qfuse1",
+            self._instance_token,
+            self._id_base,
+            self._lo,
+            self._hi,
+            self._n_toa,
+            self._n_q,
+            np.dtype(self._dtype).str,
+            self._method,
+        )
+
+    def _qmap_for(self, device):
+        """The table committed to one mesh slice, staged once per
+        (device, table epoch) — the stage-once rule for the argument
+        channel. Default placement returns the resident copy."""
+        if device is None:
+            return self._qmap
+        token = int(device.id)
+        cached = self._qmap_by_device.get(token)
+        if cached is None:
+            cached = stage_for(self._qmap, device)
+            self._qmap_by_device[token] = cached
+        return cached
+
+    def stage_events(
+        self,
+        batch: EventBatch,
+        cache,
+        *,
+        batch_tag: str = "",
+        pool=None,
+        device=None,
+    ) -> None:
+        """Prestage hook (ADR 0111): warm the window's raw-wire slot
+        with exactly the staging ``step``/``tick_staging`` run — same
+        keys, so the step-time consumer is a guaranteed hit."""
+        if cache is None:
+            return
+        kwargs = {} if device is None else {"device": device}
+        stage_raw(batch, cache, batch_tag, **kwargs)
+
+    def tick_staging(
+        self,
+        batch: EventBatch,
+        cache,
+        *,
+        batch_tag: str = "",
+        pool=None,
+        device=None,
+    ) -> tuple:
+        """The staged wire for ``tick_step``: (table, pixel_id, toa).
+
+        The raw pair stages once per (stream, tag, slice) and is shared
+        with every other device-path consumer; the table leads the
+        tuple as a jit ARGUMENT so a live swap stays an argument change
+        (ADR 0105) — never a retrace of the tick program."""
+        kwargs = {} if device is None else {"device": device}
+        pid, toa = stage_raw(batch, cache, batch_tag, **kwargs)
+        return (self._qmap_for(device), pid, toa)
+
+    def tick_step(self, states, *staged):
+        """TRACEABLE fused step over ``tick_staging``'s tuple — the tick
+        program (ops/tick.py) composes this with the members' packed
+        publish bodies. Monitor counts never ride the tick: the manager
+        only ticks single-stream windows (a window also carrying
+        monitor events takes the private path), so the in-dispatch
+        monitor delta is exactly 0 — bit-identical to the private
+        step's ``monitor_count=0.0`` argument."""
+        qmap, pixel_id, toa = staged
+        return self._step_fused_impl(
+            tuple(states), qmap, pixel_id, toa, 0.0
+        )
+
+    def step_many(
+        self,
+        states,
+        batch: EventBatch,
+        *,
+        monitor_count: float = 0.0,
+        cache=None,
+        batch_tag: str = "",
+        device=None,
+    ) -> tuple[QState, ...]:
+        """Advance K states of THIS kernel from one staged batch in one
+        fused dispatch (the coalesced-window path between publish
+        ticks). Equal fuse keys imply the same instance, so all states
+        reduce under the one live table."""
+        states = tuple(states)
+        if not states:
+            return ()
+        kwargs = {} if device is None else {"device": device}
+        pid, toa = stage_raw(batch, cache, batch_tag, **kwargs)
+        return self._step_fused(
+            states, self._qmap_for(device), pid, toa, monitor_count
         )
 
     # -- public API -------------------------------------------------------
@@ -589,6 +751,12 @@ class QHistogrammer:
                 "TOA-binning change"
             )
         self._qmap = jnp.asarray(table)
+        # New table epoch: per-slice copies restage lazily and the
+        # layout label moves. Deliberately NOT in any staging/fuse key —
+        # the table is a jit argument (ADR 0105), so a same-shape swap
+        # must never recompile or re-stage the raw wire.
+        self._table_version += 1
+        self._qmap_by_device = {}
 
     def fold_window(self, state: QState) -> QState:
         """Traceable window fold, for composition into fused publish

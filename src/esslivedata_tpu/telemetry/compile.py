@@ -142,13 +142,18 @@ class CompileEventRecorder:
         return trigger
 
     # -- test/bench conveniences -------------------------------------------
-    def total(self, site: str | None = None) -> float:
-        """Total recorded compile events (optionally one site) — what
-        the bench's 'warmup compiles >= 1, steady state 0' guard reads."""
+    def total(
+        self, site: str | None = None, *, trigger: str | None = None
+    ) -> float:
+        """Total recorded compile events, optionally filtered by site
+        and/or trigger — what the bench's 'warmup compiles >= 1, steady
+        state 0' guard and the layout_swap-classification asserts read
+        (the ONE public read surface over the labeled counter)."""
         return sum(
             value
             for labels, value in _COMPILES_TOTAL.items()
-            if site is None or labels.get("site") == site
+            if (site is None or labels.get("site") == site)
+            and (trigger is None or labels.get("trigger") == trigger)
         )
 
 

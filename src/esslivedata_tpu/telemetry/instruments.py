@@ -15,7 +15,11 @@ from __future__ import annotations
 
 from .registry import REGISTRY
 
-__all__ = ["PUBLISH_RTT_SECONDS"]
+__all__ = [
+    "CALIBRATION_SWAPS",
+    "EVENTS_FILTERED",
+    "PUBLISH_RTT_SECONDS",
+]
 
 #: Publish/tick device round-trip wall times as a labeled histogram
 #: (ADR 0116): the EWMA drives the link policy, but a scrape needs the
@@ -25,4 +29,26 @@ PUBLISH_RTT_SECONDS = REGISTRY.histogram(
     "livedata_publish_rtt_seconds",
     "Publish/tick device round-trip wall time (compile rounds excluded)",
     labelnames=("slice",),
+)
+
+#: Calibration-plane swaps (workloads/calibration.py, ADR 0122): every
+#: live table replacement that re-keyed staged wires/tick programs,
+#: labeled by table kind (tof_dspacing/flatfield/...). Registered here
+#: so a service that hosts no workload family still EXPOSES the family
+#: with zero samples (scripts/metrics_smoke.py gates its presence).
+CALIBRATION_SWAPS = REGISTRY.counter(
+    "livedata_calibration_swaps",
+    "Live calibration-table swaps adopted by workload kernels "
+    "(each re-keys staging + tick programs under the new digest)",
+    labelnames=("kind",),
+)
+
+#: Per-event filter drops (workloads/filters.py, ADR 0122): events a
+#: composable predicate chain rejected before histogramming, labeled by
+#: filter kind. Counted at the host filter pass — the device sees zero
+#: extra dispatches, so this is the only place the drop rate exists.
+EVENTS_FILTERED = REGISTRY.counter(
+    "livedata_events_filtered",
+    "Events rejected by per-event filter chains before histogramming",
+    labelnames=("kind",),
 )

@@ -76,10 +76,21 @@ class DetectorViewWorkflow:
         projection: ProjectionTable,
         params: DetectorViewParams | None = None,
         primary_stream: str | None = None,
+        filters=None,
     ) -> None:
         params = params or DetectorViewParams()
         self._proj = projection
         self._params = params
+        # Optional per-event filter chain (workloads/filters.py, ADR
+        # 0122): a digest-tagged host batch transform — rejected events
+        # become pixel_id -1 before staging, so filtering costs zero
+        # extra device dispatches and same-chain jobs share one
+        # filtered wire. None/empty = identity (tag "").
+        if filters is None:
+            from ...workloads.filters import FilterChain
+
+            filters = FilterChain()
+        self._filters = filters
         edges = np.linspace(
             params.toa_range.low, params.toa_range.high, params.toa_bins + 1
         )
@@ -276,8 +287,12 @@ class DetectorViewWorkflow:
                     # value.cache (the window's stream slot, attached by
                     # the JobManager) makes flatten + transfer run once
                     # per (stream, layout) across every subscribed job.
+                    batch, tag = self._filters.apply(
+                        value.batch, value.cache
+                    )
                     self._state = self._hist.step_batch(
-                        self._state, value.batch, cache=value.cache
+                        self._state, batch, cache=value.cache,
+                        batch_tag=tag,
                     )
 
     def event_ingest(self, stream: str, staged: StagedEvents):
@@ -290,20 +305,15 @@ class DetectorViewWorkflow:
         dispatch — ``get_state`` must return the same object
         ``publish_offer`` passes as args[0] (the manager verifies the
         identity and degrades to separate dispatches otherwise)."""
-        if self._primary_stream is not None and stream != self._primary_stream:
-            return None
-        from ...core.device_event_cache import EventIngest
+        from ...workloads.filters import filtered_event_ingest
 
-        def set_state(state) -> None:
-            self._state = state
-
-        return EventIngest(
-            key=self._hist.fuse_key + ("",),
+        return filtered_event_ingest(
+            self,
             hist=self._hist,
-            batch=staged.batch,
-            batch_tag="",
-            get_state=lambda: self._state,
-            set_state=set_state,
+            filters=self._filters,
+            primary_stream=self._primary_stream,
+            stream=stream,
+            staged=staged,
         )
 
     def publish_offer(self):
@@ -477,6 +487,9 @@ class DetectorViewWorkflow:
                 sort_keys=True,
             ).encode()
         )
+        # Filtered and unfiltered accumulations must never exchange
+        # state: the bins mean "events that PASSED this chain".
+        h.update(self._filters.digest.encode())
         return h.hexdigest()
 
     def dump_state(self) -> dict[str, np.ndarray]:

@@ -159,21 +159,49 @@ class QStreamingMixin:
             self._publish = PackedPublisher(program)
         return self._publish
 
+    def event_ingest(self, stream: str, staged: StagedEvents):
+        """Fused-stepping/tick offer (core/job_manager.py, ADR 0114):
+        the Q family's detector ingest is one table-gather step over
+        this job's private state, so a detector-only window steps AND
+        publishes in ONE tick dispatch (``QHistogrammer.tick_staging``/
+        ``tick_step`` — the PR 6 coverage gap, closed). The fuse key
+        carries the kernel's instance token, so Q groups are
+        singletons: each job owns its own calibration table, and
+        member[0]'s table must never reduce another job's events.
+        Monitor/transmission streams decline — their counts fold
+        host-side in ``accumulate``, and a window carrying them is not
+        tick-eligible anyway (the manager requires a single-stream
+        window)."""
+        if getattr(self, "_state", None) is None:
+            return None  # context-gated workflow before its first table
+        if (
+            stream in self._monitor_streams
+            or stream in self._transmission_streams
+        ):
+            return None
+        if self._primary_stream is not None and stream != self._primary_stream:
+            return None
+        from ..core.device_event_cache import EventIngest
+
+        def set_state(state) -> None:
+            self._state = state
+
+        return EventIngest(
+            key=self._hist.fuse_key + ("",),
+            hist=self._hist,
+            batch=staged.batch,
+            batch_tag="",
+            get_state=lambda: self._state,
+            set_state=set_state,
+        )
+
     def publish_offer(self):
         """Combined-publish offer (ADR 0113): every QHistogrammer-backed
-        reduction due in a tick joins the one device round trip. The
-        host-side transmission counters never ride the device publish.
-
-        NOT tick-program-capable (ADR 0114): the Q family consumes the
-        stage-once cache but offers no ``event_ingest`` — QHistogrammer
-        steps carry per-job calibration tables (Q/wavelength LUTs as jit
-        arguments) rather than one shared fused-step program, so there
-        is no group step for the tick to compose with. The manager's
-        eligibility check (ingest offer required) routes these jobs to
-        the combined publish automatically; publish stays one combined
-        round trip per device, stepping stays one dispatch per job.
-        Extending ``QHistogrammer`` with a ``step_many``/``tick_step``
-        pair is the follow-up that would bring the family on."""
+        reduction due in a tick joins the one device round trip; with
+        the ingest offer above, a detector-only window upgrades to the
+        full tick program (ADR 0114) — step + publish in one dispatch.
+        The host-side transmission counters never ride the device
+        publish."""
         if getattr(self, "_state", None) is None:
             return None  # context-gated workflow before its first table
         from ..ops.publish import make_publish_offer

@@ -1,0 +1,61 @@
+"""Workload plane (ADR 0122): calibration LUTs, per-event filters, and
+the reduction families built on them.
+
+Three pillars, one discipline:
+
+- :mod:`.calibration` — versioned, content-fingerprinted per-pixel
+  tables staged once per device; consumers fold the digest into every
+  staging/fusion/static key so a swap re-keys cleanly (JGL027 polices
+  the bypasses).
+- :mod:`.filters` — composable per-event predicates applied as a
+  digest-tagged host batch transform: zero extra device dispatches,
+  stage-once sharing across same-chain jobs.
+- The families — :mod:`.powder_focus` (TOF→d via calibration LUTs,
+  static-channel acceptance), :mod:`.imaging` (dense 2-D, pallas2d's
+  second customer, flat-field at publish), :mod:`.correlation`
+  (non-event da00 analytics) — each implementing ``event_ingest`` +
+  ``publish_offer`` so they ride the one-dispatch tick program
+  (ADR 0114), mesh placement (ADR 0115), warm-up/checkpointing
+  (ADR 0118) and the serving plane (ADR 0117) for free.
+"""
+
+from .calibration import (
+    CalibratedHistogrammer,
+    CalibrationStore,
+    CalibrationTable,
+    load_calibration,
+    save_calibration,
+    staged_column,
+)
+from .correlation import CorrelationState, TimeseriesCorrelationWorkflow
+from .filters import (
+    ChopperPhaseGate,
+    EventFilter,
+    FilterChain,
+    PixelWeightFilter,
+    PulseVetoFilter,
+    ToaRangeFilter,
+)
+from .imaging import ImagingViewParams, ImagingViewWorkflow
+from .powder_focus import PowderFocusParams, PowderFocusWorkflow
+
+__all__ = [
+    "CalibratedHistogrammer",
+    "CalibrationStore",
+    "CalibrationTable",
+    "ChopperPhaseGate",
+    "CorrelationState",
+    "EventFilter",
+    "FilterChain",
+    "ImagingViewParams",
+    "ImagingViewWorkflow",
+    "PixelWeightFilter",
+    "PowderFocusParams",
+    "PowderFocusWorkflow",
+    "PulseVetoFilter",
+    "TimeseriesCorrelationWorkflow",
+    "ToaRangeFilter",
+    "load_calibration",
+    "save_calibration",
+    "staged_column",
+]

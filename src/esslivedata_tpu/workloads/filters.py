@@ -1,0 +1,381 @@
+"""Composable per-event filters, compiled into the staged wire
+(ADR 0122).
+
+The reference applies event predicates (chopper-phase selection, pulse
+vetoes, pixel masks) inside its per-workflow reduction graphs. Here a
+filter is a **host-side batch transform** that marks rejected events
+with the universal drop sentinel (``pixel_id = -1``) *before* staging —
+the same mechanism as the monitor workflow's row0 clamp:
+
+- **Zero extra device dispatches.** The filtered batch flows through
+  ``tick_staging``/``step_many`` untouched; rejected events land in the
+  dump bin the kernels already have. A filtered tick is still ONE
+  execute + ONE fetch (asserted in ``bench.py --workloads``).
+- **Stage-once sharing.** The chain's content digest is the
+  ``batch_tag``: K jobs with the same filter chain share one filter
+  pass AND one staged wire per window (the filter memoizes through the
+  window's stream slot), while differently-filtered jobs key apart —
+  filters can never collide with the raw stream (ADR 0110's
+  keys-capture-everything rule).
+- **Composability.** A :class:`FilterChain` ANDs any number of
+  predicates; the digest covers each member's parameters, so editing a
+  veto window re-keys staging and the tick program exactly like a
+  layout swap.
+
+Predicates shipped here: :class:`ChopperPhaseGate` (accept only events
+inside the cascade's transmitted arrival windows — built from
+``ops/chopper_cascade.py``'s exact polygon propagation),
+:class:`PulseVetoFilter` (reject TOA windows, e.g. prompt-pulse vetoes),
+:class:`ToaRangeFilter`, and :class:`PixelWeightFilter` (threshold on a
+per-pixel calibration column — dead/noisy pixel suppression).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections.abc import Sequence
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..ops.chopper_cascade import DiskChopper, propagate_cascade
+from ..ops.event_batch import EventBatch
+from ..telemetry.instruments import EVENTS_FILTERED
+
+__all__ = [
+    "ChopperPhaseGate",
+    "EventFilter",
+    "FilterChain",
+    "PixelWeightFilter",
+    "PulseVetoFilter",
+    "ToaRangeFilter",
+    "filtered_event_ingest",
+    "merge_windows",
+]
+
+
+class EventFilter:
+    """One per-event predicate. Subclasses implement ``key()`` (the
+    parameter fingerprint material — every value that changes the mask
+    must appear) and ``accept(pixel_id, toa) -> bool mask``."""
+
+    #: Telemetry label for drop counting (bounded set: one per class).
+    kind: str = "filter"
+
+    def key(self) -> tuple:
+        raise NotImplementedError
+
+    def accept(
+        self, pixel_id: np.ndarray, toa: np.ndarray
+    ) -> np.ndarray:
+        raise NotImplementedError
+
+
+def merge_windows(
+    windows: Sequence[tuple[float, float]],
+) -> list[tuple[float, float]]:
+    """Sorted, overlap-merged copy of (lo, hi) intervals; empty/inverted
+    intervals drop."""
+    cleaned = sorted(
+        (float(lo), float(hi)) for lo, hi in windows if hi > lo
+    )
+    merged: list[tuple[float, float]] = []
+    for lo, hi in cleaned:
+        if merged and lo <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], hi))
+        else:
+            merged.append((lo, hi))
+    return merged
+
+
+def _in_any_window(
+    toa: np.ndarray,
+    windows: Sequence[tuple[float, float]],
+    period_ns: float | None,
+) -> np.ndarray:
+    """Boolean mask: TOA (folded modulo ``period_ns`` when given) falls
+    inside any [lo, hi) window. Vectorized over a handful of windows —
+    chopper cascades produce a few subframes, not thousands."""
+    t = np.asarray(toa, dtype=np.float64)
+    if period_ns:
+        t = np.mod(t, period_ns)
+    mask = np.zeros(t.shape, dtype=bool)
+    for lo, hi in windows:
+        mask |= (t >= lo) & (t < hi)
+    return mask
+
+
+@dataclass(frozen=True)
+class ToaRangeFilter(EventFilter):
+    """Accept only events with ``lo_ns <= toa < hi_ns``."""
+
+    lo_ns: float
+    hi_ns: float
+    kind = "toa_range"
+
+    def key(self) -> tuple:
+        return ("toa_range", float(self.lo_ns), float(self.hi_ns))
+
+    def accept(self, pixel_id, toa):
+        t = np.asarray(toa)
+        return (t >= np.float32(self.lo_ns)) & (t < np.float32(self.hi_ns))
+
+
+@dataclass(frozen=True)
+class PulseVetoFilter(EventFilter):
+    """Reject events whose TOA (modulo ``period_ns`` when set) falls in
+    any veto window — prompt-pulse / frame-boundary vetoes."""
+
+    windows: tuple[tuple[float, float], ...]
+    period_ns: float | None = None
+
+    kind = "pulse_veto"
+
+    def key(self) -> tuple:
+        return (
+            "pulse_veto",
+            tuple(merge_windows(self.windows)),
+            None if self.period_ns is None else float(self.period_ns),
+        )
+
+    def accept(self, pixel_id, toa):
+        return ~_in_any_window(
+            toa, merge_windows(self.windows), self.period_ns
+        )
+
+
+@dataclass(frozen=True)
+class ChopperPhaseGate(EventFilter):
+    """Accept only events arriving inside the chopper cascade's
+    transmitted windows at this detector's flight distance.
+
+    ``windows`` are (lo, hi) arrival-time intervals within one frame
+    period — precompute them with :meth:`from_cascade`, which clips the
+    source pulse through every chopper (``ops/chopper_cascade.py``) and
+    projects the surviving subframes to the given distance, folding
+    modulo the frame period (wrap-straddling subframes split in two).
+    """
+
+    windows: tuple[tuple[float, float], ...]
+    period_ns: float
+
+    kind = "chopper_phase"
+
+    @classmethod
+    def from_cascade(
+        cls,
+        choppers: Sequence[DiskChopper],
+        *,
+        distance_m: float,
+        pulse_period_ns: float,
+        pulse_length_ns: float,
+        stride: int = 1,
+        wavelength_min_a: float = 0.1,
+        wavelength_max_a: float = 25.0,
+        pad_ns: float = 0.0,
+    ) -> "ChopperPhaseGate":
+        """Build the gate from chopper setpoints: one clipped-polygon
+        propagation on the host (cold path — recomputed only when
+        setpoints change), a handful of float windows on the hot path.
+        ``pad_ns`` widens each window symmetrically (timing jitter)."""
+        from ..ops.chopper_cascade import _arrival_times
+
+        subframes = propagate_cascade(
+            choppers,
+            pulse_period_ns=pulse_period_ns,
+            pulse_length_ns=pulse_length_ns,
+            wavelength_min_a=wavelength_min_a,
+            wavelength_max_a=wavelength_max_a,
+            stride=stride,
+        )
+        period = stride * pulse_period_ns
+        windows: list[tuple[float, float]] = []
+        for poly in subframes:
+            t = _arrival_times(poly, distance_m)
+            lo = float(t.min()) - pad_ns
+            hi = float(t.max()) + pad_ns
+            if hi - lo >= period:
+                windows.append((0.0, period))
+                continue
+            lo_m, hi_m = np.mod(lo, period), np.mod(hi, period)
+            if lo_m <= hi_m:
+                windows.append((lo_m, hi_m))
+            else:  # wrap straddle: split at the frame boundary
+                windows.append((lo_m, period))
+                windows.append((0.0, hi_m))
+        return cls(
+            windows=tuple(merge_windows(windows)), period_ns=float(period)
+        )
+
+    def key(self) -> tuple:
+        return (
+            "chopper_phase",
+            tuple(merge_windows(self.windows)),
+            float(self.period_ns),
+        )
+
+    def accept(self, pixel_id, toa):
+        return _in_any_window(
+            toa, merge_windows(self.windows), self.period_ns
+        )
+
+
+class PixelWeightFilter(EventFilter):
+    """Reject events on pixels whose per-pixel weight (a calibration
+    column, e.g. efficiency) is below a threshold — dead/noisy pixel
+    suppression as a predicate instead of a rebuilt projection."""
+
+    kind = "pixel_weight"
+
+    def __init__(
+        self, weights: np.ndarray, *, min_weight: float, digest: str = ""
+    ) -> None:
+        self._weights = np.asarray(weights, dtype=np.float32).reshape(-1)
+        self._min = float(min_weight)
+        # Content digest: callers holding a CalibrationTable pass its
+        # digest (cheap, already computed); raw arrays fingerprint here.
+        self._digest = digest or hashlib.sha1(
+            self._weights.tobytes()
+        ).hexdigest()
+
+    @classmethod
+    def from_calibration(
+        cls, table, column: str = "efficiency", *, min_weight: float
+    ) -> "PixelWeightFilter":
+        return cls(
+            table.column(column),
+            min_weight=min_weight,
+            digest=f"{table.digest}:{column}",
+        )
+
+    def key(self) -> tuple:
+        return ("pixel_weight", self._digest, self._min)
+
+    def accept(self, pixel_id, toa):
+        pid = np.asarray(pixel_id)
+        n = self._weights.shape[0]
+        in_range = (pid >= 0) & (pid < n)
+        ok = np.zeros(pid.shape, dtype=bool)
+        idx = np.clip(pid, 0, n - 1)
+        ok[in_range] = self._weights[idx[in_range]] >= self._min
+        return ok
+
+
+class FilterChain:
+    """An AND-composition of :class:`EventFilter` predicates with a
+    content digest, applied as a memoized host batch transform.
+
+    ``apply(batch, cache)`` returns ``(filtered_batch, batch_tag)``:
+    rejected events get ``pixel_id = -1`` (every kernel's drop
+    sentinel), the tag is the chain digest so the filtered wire keys
+    apart from the raw stream and identically-filtered jobs share one
+    staging (ADR 0110). An empty chain is the identity with tag ``""``
+    — predicates-pass-all composes to byte-identical output (pinned in
+    tests and bench ``--workloads``).
+    """
+
+    def __init__(self, filters: Sequence[EventFilter] = ()) -> None:
+        self._filters = tuple(filters)
+        if self._filters:
+            h = hashlib.sha1()
+            for f in self._filters:
+                h.update(repr(f.key()).encode())
+            self._digest = h.hexdigest()
+            self._tag = f"filt-{self._digest[:12]}"
+        else:
+            self._digest = ""
+            self._tag = ""
+
+    def __len__(self) -> int:
+        return len(self._filters)
+
+    def __iter__(self):
+        return iter(self._filters)
+
+    @property
+    def digest(self) -> str:
+        return self._digest
+
+    @property
+    def tag(self) -> str:
+        """The ``batch_tag`` for the filtered wire ("" = identity)."""
+        return self._tag
+
+    def _mask(self, pixel_id: np.ndarray, toa: np.ndarray) -> np.ndarray:
+        pixel_id = np.asarray(pixel_id)
+        keep = np.ones(pixel_id.shape, dtype=bool)
+        # Padding rows (pixel_id == -1, toa == 0 — every EventBatch pads
+        # to a power-of-two bucket) are not events: predicates that
+        # happen to reject them (pixel thresholds, toa ranges excluding
+        # 0) must not count them as drops, or a sparse window would
+        # report thousands of phantom rejections per batch.
+        real = pixel_id >= 0
+        for f in self._filters:
+            accepted = np.asarray(f.accept(pixel_id, toa), dtype=bool)
+            dropped = int(np.count_nonzero(keep & ~accepted & real))
+            if dropped:
+                # Count at the predicate that did the dropping (first
+                # rejecting filter wins for double-rejected events —
+                # the chain is an AND; per-filter exact attribution
+                # would cost a second pass for no operational signal).
+                EVENTS_FILTERED.inc(dropped, kind=f.kind)
+            keep &= accepted
+        return keep
+
+    def _apply_impl(self, batch: EventBatch) -> tuple[EventBatch, str]:
+        keep = self._mask(batch.pixel_id, batch.toa)
+        # Padding (pixel_id == -1) is already dropped by every kernel;
+        # rewriting it would be a no-op, so only real rejections copy.
+        pid = np.where(keep, batch.pixel_id, np.int32(-1)).astype(
+            np.int32, copy=False
+        )
+        return (
+            EventBatch(
+                pixel_id=pid,
+                toa=batch.toa,
+                n_valid=batch.n_valid,
+                owner=batch.owner,
+            ),
+            self._tag,
+        )
+
+    def apply(
+        self, batch: EventBatch, cache=None
+    ) -> tuple[EventBatch, str]:
+        """The filtered (batch, tag) pair, memoized through the window's
+        stream slot so K same-chain jobs pay one mask pass per window
+        (the monitor row0-clamp sharing pattern)."""
+        if not self._filters:
+            return batch, ""
+        if cache is None:
+            return self._apply_impl(batch)
+        return cache.get_or_stage(
+            ("filter-host", self._digest, batch.padded_size),
+            lambda: self._apply_impl(batch),
+        )
+
+
+def filtered_event_ingest(owner, *, hist, filters, primary_stream, stream, staged):
+    """The ONE EventIngest construction for filter-aware event families
+    (powder focus, imaging, detector view): primary-stream gate, the
+    memoized filter transform, and the fuse-key/tag contract — so a fix
+    to how tags compose with fuse keys cannot drift between workflows.
+    ``owner`` follows the make_publish_offer state convention
+    (``owner._state`` is the device state the tick steps)."""
+    if primary_stream is not None and stream != primary_stream:
+        return None
+    from ..core.device_event_cache import EventIngest
+
+    batch, tag = filters.apply(staged.batch, staged.cache)
+
+    def set_state(state) -> None:
+        owner._state = state
+
+    return EventIngest(
+        key=hist.fuse_key + (tag,),
+        hist=hist,
+        batch=batch,
+        batch_tag=tag,
+        get_state=lambda: owner._state,
+        set_state=set_state,
+    )

@@ -154,9 +154,13 @@ class TestReductionServiceInFakeBackend:
         }
         assert {"iq_current", "transmission_current"} <= outputs
 
-    def test_dummy_has_no_reduction_service(self):
-        # dummy declares no data_reduction specs: the demo backend must
-        # not spin an idle fourth service for it.
+    def test_dummy_service_set_follows_declared_namespaces(self):
+        # The demo backend spins exactly the services the instrument's
+        # specs call for — since the workload plane (ADR 0122) gave
+        # dummy a data_reduction spec (powder_focus), that includes the
+        # reduction service; an instrument with NO data_reduction specs
+        # must still not get an idle fourth service (pinned by the
+        # service-derivation logic this asserts through).
         transport = InProcessBackendTransport("dummy", events_per_pulse=10)
         services = DashboardServices(transport=transport)
         for _ in range(8):
@@ -166,7 +170,12 @@ class TestReductionServiceInFakeBackend:
             s.service_id.split(":")[1]
             for s in services.job_service.services()
         }
-        assert kinds == {"detector_data", "monitor_data", "timeseries"}
+        assert kinds == {
+            "detector_data",
+            "monitor_data",
+            "timeseries",
+            "data_reduction",
+        }
 
 
 class TestNullUI(AsyncHTTPTestCase):

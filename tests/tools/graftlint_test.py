@@ -374,6 +374,28 @@ def consume(host, on_line):
             time.sleep(1.0)
             continue
 ''',
+    # A digest-keyed class whose message handlers replace the LUT —
+    # by rebind AND by in-place slice store (the sneakier form: the
+    # object identity survives, so even identity-keyed caches rot):
+    # every staging/tick/static cache keyed on the old digest keeps
+    # serving stale results — the ADR 0110/0113 bypass JGL027 exists
+    # for. Both shapes must fire.
+    "JGL027": '''
+class Hist:
+    def __init__(self):
+        self._lut = None
+        self._digest = "a"
+
+    @property
+    def layout_digest(self):
+        return self._digest
+
+    def on_geometry_message(self, lut):
+        self._lut = lut
+
+    def on_refill(self, lut):
+        self._lut[:] = lut
+''',
 }
 
 NEGATIVE = {
@@ -876,6 +898,31 @@ def consume(host, stop, on_line):
             attempts += 1
             delay = min(10.0, 0.5 * (2 ** attempts))
             time.sleep(delay * (0.5 + random.random()))
+''',
+    # The sanctioned shape: the swap_* path replaces the table AND
+    # re-fingerprints, so every key misses cleanly; the lazy device
+    # materialization from the host twin is content-neutral.
+    "JGL027": '''
+class Hist:
+    def __init__(self):
+        self.lut_host = None
+        self._lut_dev = None
+        self._digest = "a"
+
+    @property
+    def layout_digest(self):
+        return self._digest
+
+    @property
+    def lut(self):
+        if self._lut_dev is None:
+            self._lut_dev = list(self.lut_host)
+        return self._lut_dev
+
+    def swap_lut(self, lut):
+        self.lut_host = lut
+        self._lut_dev = None
+        self._digest = None
 ''',
 }
 # fmt: on

@@ -1,0 +1,231 @@
+"""Q-family tick coverage (ROADMAP item 3, closed): QHistogrammer's
+``step_many``/``tick_staging``/``tick_step`` contract brings the
+QStreamingMixin reductions onto the one-dispatch tick program.
+
+Pinned in the tick_program_test pattern: byte-identity tick vs combined
+vs per-job reference, the 1-execute-1-fetch steady state (singleton Q
+groups tick — each job owns its table), live table swaps staying
+recompile-free (the ADR 0105 argument discipline carried through the
+tick program), and mixed detector+monitor windows degrading to the
+private path with identical results."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from esslivedata_tpu.config import JobId, WorkflowConfig, WorkflowSpec
+from esslivedata_tpu.core.job_manager import JobFactory, JobManager
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.kafka.da00_compat import dataarray_to_da00
+from esslivedata_tpu.kafka.wire import encode_da00
+from esslivedata_tpu.ops import EventBatch
+from esslivedata_tpu.ops.publish import METRICS
+from esslivedata_tpu.ops.qhistogram import QHistogrammer, build_sans_qmap
+from esslivedata_tpu.preprocessors.event_data import StagedEvents
+from esslivedata_tpu.telemetry import COMPILE_EVENTS
+from esslivedata_tpu.workflows import WorkflowFactory
+from esslivedata_tpu.workflows.sans import SansIQParams, SansIQWorkflow
+
+T = Timestamp.from_ns
+N_PIX = 64
+
+
+def positions():
+    rng = np.random.default_rng(7)
+    return rng.uniform(-1, 1, (N_PIX, 3)) + np.array([0.0, 0.0, 5.0])
+
+
+def make_sans(monitor: str | None = None):
+    return SansIQWorkflow(
+        positions=positions(),
+        pixel_ids=np.arange(N_PIX),
+        params=SansIQParams(q_bins=80),
+        monitor_streams={monitor} if monitor else None,
+    )
+
+
+def staged(pid, toa) -> StagedEvents:
+    return StagedEvents(
+        batch=EventBatch.from_arrays(
+            np.asarray(pid), np.asarray(toa, np.float32)
+        ),
+        first_timestamp=None,
+        last_timestamp=None,
+        n_chunks=1,
+    )
+
+
+def make_manager(makes, *, combine=True, tick=True, aux=None):
+    reg = WorkflowFactory()
+    identifiers = []
+    for i, make in enumerate(makes):
+        spec = WorkflowSpec(
+            instrument="qt",
+            name=f"q{i}",
+            source_names=["det0"],
+            aux_source_names={} if aux is None else {"mon": [aux]},
+        )
+        reg.register_spec(spec).attach_factory(
+            lambda *, source_name, params, _m=make: _m()
+        )
+        identifiers.append(spec.identifier)
+    mgr = JobManager(
+        job_factory=JobFactory(reg),
+        job_threads=2,
+        combine_publish=combine,
+        tick_program=tick,
+    )
+    for identifier in identifiers:
+        mgr.schedule_job(
+            WorkflowConfig(
+                identifier=identifier,
+                job_id=JobId(source_name="det0"),
+                aux_source_names={} if aux is None else {"mon": aux},
+            )
+        )
+    return mgr
+
+
+def wire_bytes(result) -> list[bytes]:
+    return [
+        encode_da00(name, 12345, dataarray_to_da00(da))
+        for name, da in result.outputs.items()
+    ]
+
+
+def windows(seed, n, n_events=3000):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            rng.integers(-3, N_PIX + 4, n_events).astype(np.int64),
+            rng.uniform(0, 7e7, n_events).astype(np.float32),
+        )
+        for _ in range(n)
+    ]
+
+
+class TestQTickParity:
+    def test_byte_identical_across_tick_combined_private(self):
+        makes = [make_sans, make_sans]
+        tick = make_manager(makes)
+        comb = make_manager(makes, tick=False)
+        priv = make_manager(makes, combine=False, tick=False)
+        for w, (pid, toa) in enumerate(windows(41, 4)):
+            res = [
+                m.process_jobs(
+                    {"det0": staged(pid, toa)}, start=T(0), end=T(w + 1)
+                )
+                for m in (tick, comb, priv)
+            ]
+            assert [len(r) for r in res] == [2, 2, 2]
+            for rt, rc, rp in zip(*res):
+                bt, bc, bp = map(wire_bytes, (rt, rc, rp))
+                assert bt == bc, f"window {w}: tick != combined"
+                assert bt == bp, f"window {w}: tick != private"
+        for m in (tick, comb, priv):
+            m.shutdown()
+
+    def test_singleton_q_groups_tick_at_one_dispatch(self):
+        """Two Q jobs = two singleton groups (each owns its table);
+        steady state must be exactly one execute + one fetch PER GROUP
+        and zero separate step dispatches — the separate-path reference
+        pays the same fetches but an extra per-job step dispatch."""
+        mgr = make_manager([make_sans, make_sans])
+        ws = windows(42, 4)
+        for w in range(2):  # warm both program variants
+            pid, toa = ws[w]
+            mgr.process_jobs(
+                {"det0": staged(pid, toa)}, start=T(0), end=T(w + 1)
+            )
+        METRICS.drain()
+        for w in (2, 3):
+            pid, toa = ws[w]
+            out = mgr.process_jobs(
+                {"det0": staged(pid, toa)}, start=T(0), end=T(w + 1)
+            )
+            assert len(out) == 2
+        m = METRICS.drain()
+        assert m["executes"] == 4 and m["fetches"] == 4  # 2 groups x 2
+        assert m["step_executes"] == 0
+        assert m["tick_publishes"] == 4 and m["tick_jobs"] == 4
+        mgr.shutdown()
+
+    def test_live_table_swap_does_not_recompile_the_tick(self):
+        """A same-shape qmap swap (reflectometry omega move, powder
+        emission recalibration) rides the tick program as an ARGUMENT
+        (ADR 0105): zero compile events, counts follow the new table."""
+        mgr = make_manager([make_sans])
+        wf = next(iter(mgr._records.values())).job.workflow
+        ws = windows(43, 4)
+        for w in range(2):
+            pid, toa = ws[w]
+            mgr.process_jobs(
+                {"det0": staged(pid, toa)}, start=T(0), end=T(w + 1)
+            )
+        # Rebuild the map under a shifted beam center: same shape, new
+        # content.
+        params = SansIQParams(q_bins=80)
+        q_edges = np.linspace(params.q_min, params.q_max, 81)
+        toa_edges = np.linspace(
+            params.toa_range.low, params.toa_range.high, params.toa_bins + 1
+        )
+        new_map = build_sans_qmap(
+            positions=positions(),
+            pixel_ids=np.arange(N_PIX),
+            toa_edges=toa_edges,
+            q_edges=q_edges,
+            l1=params.l1,
+            beam_center=(0.15, -0.1),
+        )
+        digest_before = wf._hist.layout_digest
+        before = COMPILE_EVENTS.total()
+        wf._hist.swap_table(new_map)
+        assert wf._hist.layout_digest != digest_before  # epoch label moved
+        pid, toa = ws[2]
+        out = mgr.process_jobs(
+            {"det0": staged(pid, toa)}, start=T(0), end=T(3)
+        )
+        assert len(out) == 1
+        assert COMPILE_EVENTS.total() - before == 0, (
+            "a same-shape table swap must never recompile the tick"
+        )
+        # Reference: a fresh workflow with the swapped table from the
+        # start accumulates this window identically.
+        ref = SansIQWorkflow(
+            positions=positions(),
+            pixel_ids=np.arange(N_PIX),
+            params=params,
+        )
+        ref._hist.swap_table(new_map)
+        ref.accumulate({"det0": staged(pid, toa)})
+        want = ref.finalize()["counts_q_current"].values
+        got = out[0].outputs["counts_q_current"].values
+        assert np.array_equal(got, want)
+        mgr.shutdown()
+
+    def test_mixed_detector_monitor_window_takes_private_path(self):
+        """A window carrying detector AND aux monitor events is not
+        tick-eligible (the monitor count must fold into the same step);
+        results must equal the no-tick reference exactly and the
+        monitor normalization must see the counts."""
+        makes = [lambda: make_sans("mon0")]
+        tick = make_manager(makes, aux="mon0")
+        ref = make_manager(makes, tick=False, aux="mon0")
+        rng = np.random.default_rng(44)
+        mon_pid = np.zeros(500, dtype=np.int64)
+        mon_toa = rng.uniform(0, 7e7, 500).astype(np.float32)
+        METRICS.drain()
+        for w, (pid, toa) in enumerate(windows(45, 3)):
+            data = {
+                "det0": staged(pid, toa),
+                "mon0": staged(mon_pid, mon_toa),
+            }
+            rt = tick.process_jobs(data, start=T(0), end=T(w + 1))
+            rr = ref.process_jobs(data, start=T(0), end=T(w + 1))
+            assert len(rt) == len(rr) == 1
+            assert wire_bytes(rt[0]) == wire_bytes(rr[0])
+        assert METRICS.drain()["tick_publishes"] == 0
+        mon = float(rt[0].outputs["monitor_counts_current"].values)
+        assert mon == 500.0
+        tick.shutdown()
+        ref.shutdown()

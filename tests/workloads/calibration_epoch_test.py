@@ -1,0 +1,229 @@
+"""Calibration-swap epoch discipline, end to end (ADR 0122 acceptance).
+
+The full lifecycle of a live recalibration: the swap bumps the
+calibrated layout digest, the AOT warm-up service (ADR 0118)
+pre-compiles the re-keyed tick program so the hot path compiles 0,
+serving-plane subscribers see exactly ONE epoch-tagged keyframe whose
+decoded counts CONTINUE (a marked handover — gap-not-reset, never a
+silent splice), and a checkpoint/restore round-trips the active
+calibration version + serving epoch."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from esslivedata_tpu.config import JobId, WorkflowConfig, WorkflowSpec
+from esslivedata_tpu.core.job_manager import JobFactory, JobManager
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.durability import CompileWarmupService
+from esslivedata_tpu.kafka.wire import decode_da00
+from esslivedata_tpu.ops import EventBatch
+from esslivedata_tpu.preprocessors.event_data import StagedEvents
+from esslivedata_tpu.telemetry import COMPILE_EVENTS
+from esslivedata_tpu.workloads import (
+    CalibrationTable,
+    PowderFocusParams,
+    PowderFocusWorkflow,
+)
+
+T = Timestamp.from_ns
+N_PIX = 48
+
+
+def calib(version=1, tzero=0.0) -> CalibrationTable:
+    return CalibrationTable(
+        name="epoch_cal",
+        version=version,
+        columns={
+            "difc": np.linspace(4000.0, 6000.0, N_PIX),
+            "tzero": np.full(N_PIX, tzero),
+        },
+    )
+
+
+def staged(rng, n=2000) -> StagedEvents:
+    return StagedEvents(
+        batch=EventBatch.from_arrays(
+            rng.integers(0, N_PIX, n),
+            rng.uniform(0, 20000.0, n).astype(np.float32),
+        ),
+        first_timestamp=None,
+        last_timestamp=None,
+        n_chunks=1,
+    )
+
+
+def build_manager(k=2):
+    from esslivedata_tpu.workflows import WorkflowFactory
+
+    reg = WorkflowFactory()
+    spec = WorkflowSpec(instrument="ep", name="pf", source_names=["det0"])
+    reg.register_spec(spec).attach_factory(
+        lambda *, source_name, params: PowderFocusWorkflow(
+            calibration=calib(), params=PowderFocusParams(d_bins=96)
+        )
+    )
+    mgr = JobManager(job_factory=JobFactory(reg), job_threads=1)
+    for _ in range(k):
+        mgr.schedule_job(
+            WorkflowConfig(
+                identifier=spec.identifier, job_id=JobId(source_name="det0")
+            )
+        )
+    return mgr
+
+
+class TestSwapWarmup:
+    def test_swap_then_warmup_keeps_hot_path_compile_free(self):
+        """After a calibration swap, ``request_warmup('layout_swap')``
+        pre-compiles the re-keyed tick program off the hot path: the
+        next live window's compile-event delta is 0 — vs >= 1 for the
+        cold control."""
+        warm_mgr, cold_mgr = build_manager(), build_manager()
+        warmup = CompileWarmupService()
+        warm_mgr.set_warmup(warmup)
+        rng = np.random.default_rng(31)
+        try:
+            # Both managers reach steady state at the SAME batch shape.
+            for w in range(3):
+                s = staged(rng)
+                warm_mgr.process_jobs({"det0": s}, start=T(0), end=T(w + 1))
+                cold_mgr.process_jobs({"det0": s}, start=T(0), end=T(w + 1))
+            swapped = calib(version=2, tzero=333.0)
+            for mgr in (warm_mgr, cold_mgr):
+                for rec in mgr._records.values():
+                    assert rec.job.workflow.set_calibration(swapped)
+            warm_mgr.request_warmup("layout_swap")
+            assert warmup.quiesce(60), "warm-up never drained"
+            s = staged(rng)
+            before = COMPILE_EVENTS.total()
+            out = warm_mgr.process_jobs({"det0": s}, start=T(0), end=T(10))
+            assert len(out) == 2
+            assert COMPILE_EVENTS.total() - before == 0, (
+                "warmed swap still compiled on the hot path"
+            )
+            before = COMPILE_EVENTS.total()
+            out = cold_mgr.process_jobs({"det0": s}, start=T(0), end=T(10))
+            assert len(out) == 2
+            assert COMPILE_EVENTS.total() - before >= 1, (
+                "cold control should have compiled (did the swap re-key?)"
+            )
+        finally:
+            warmup.close()
+            warm_mgr.shutdown()
+            cold_mgr.shutdown()
+
+
+class TestSwapServingEpoch:
+    def test_subscribers_see_one_keyframe_with_continuing_counts(self):
+        from esslivedata_tpu.serving import (
+            DeltaDecoder,
+            ServingPlane,
+            decode_header,
+        )
+
+        mgr = build_manager(k=1)
+        plane = ServingPlane(port=None)
+        rng = np.random.default_rng(32)
+        try:
+            ts = 0
+
+            def drive() -> None:
+                nonlocal ts
+                ts += 1
+                out = mgr.process_jobs(
+                    {"det0": staged(rng)}, start=T(0), end=T(ts)
+                )
+                assert len(out) == 1
+                plane.publish_results(out, T(ts))
+
+            drive()
+            stream = next(
+                s
+                for s in plane.server.cache.streams()
+                if s.endswith("/counts_cumulative")
+            )
+            sub = plane.server.subscribe(stream)
+            decoder = DeltaDecoder()
+            frames: list[tuple[bool, int, float]] = []
+
+            def drain() -> None:
+                while sub.depth() > 0:
+                    blob = sub.next_blob(timeout=1.0)
+                    header = decode_header(blob)
+                    frame = decoder.apply(blob)
+                    msg = decode_da00(frame)
+                    counts = float(
+                        np.asarray(
+                            next(
+                                v.data
+                                for v in msg.variables
+                                if v.name == "signal"
+                            )
+                        ).sum()
+                    )
+                    frames.append((header.keyframe, header.epoch, counts))
+
+            for _ in range(2):
+                drive()
+            drain()
+            pre_epoch = frames[-1][1]
+            pre_counts = frames[-1][2]
+            assert not frames[-1][0]  # steady state rides deltas
+            # The swap: same d space, counts must persist.
+            wf = next(iter(mgr._records.values())).job.workflow
+            assert wf.set_calibration(calib(version=2, tzero=250.0))
+            drive()
+            drain()
+            keyframe, epoch, counts = frames[-1]
+            assert keyframe, "calibration handover must force a keyframe"
+            assert epoch == pre_epoch + 1, "handover must be epoch-tagged"
+            assert counts > pre_counts, (
+                "decoded counts must CONTINUE across the swap "
+                "(gap-not-reset: accumulation survives)"
+            )
+            # Exactly one keyframe: the next window is a delta again.
+            drive()
+            drain()
+            assert not frames[-1][0]
+            assert frames[-1][1] == epoch
+        finally:
+            mgr.shutdown()
+            plane.close()
+
+
+class TestSwapCheckpointRoundTrip:
+    def test_dump_restore_round_trips_calibration_version_and_epoch(self):
+        rng = np.random.default_rng(33)
+        wf = PowderFocusWorkflow(
+            calibration=calib(), params=PowderFocusParams(d_bins=96)
+        )
+        wf.accumulate({"det0": staged(rng)})
+        assert wf.set_calibration(calib(version=5, tzero=100.0))
+        wf.accumulate({"det0": staged(rng)})
+        counts = float(wf.finalize()["counts_cumulative"].values)
+        dump = wf.dump_state()
+        assert int(dump["calibration_version"]) == 5
+        assert int(dump["publish_epoch"]) == 1
+
+        # Restart with the SAME active calibration: epoch restores
+        # as-is, counts identical, no spurious handover.
+        fresh = PowderFocusWorkflow(
+            calibration=calib(version=5, tzero=100.0),
+            params=PowderFocusParams(d_bins=96),
+        )
+        assert fresh.state_fingerprint() == wf.state_fingerprint()
+        assert fresh.restore_state(dump)
+        assert fresh.publish_epoch == 1
+        assert (
+            float(fresh.finalize()["counts_cumulative"].values) == counts
+        )
+
+        # Restart that boots on a DIFFERENT calibration epoch than the
+        # dump's: counts still adopt (same bin space) but the mismatch
+        # must surface as one more epoch bump — subscribers resync.
+        older = PowderFocusWorkflow(
+            calibration=calib(), params=PowderFocusParams(d_bins=96)
+        )
+        assert older.restore_state(dump)
+        assert older.publish_epoch == 2

@@ -1,0 +1,267 @@
+"""Calibration-table plane (ADR 0122): fingerprinting, persistence,
+store semantics, device staging, and the calibrated focusing kernel's
+key discipline."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.ops import EventBatch
+from esslivedata_tpu.workloads.calibration import (
+    CalibratedHistogrammer,
+    CalibrationStore,
+    CalibrationTable,
+    load_calibration,
+    save_calibration,
+    staged_column,
+)
+
+
+def table(n=64, version=1, **extra) -> CalibrationTable:
+    cols = {
+        "difc": np.linspace(4000.0, 6000.0, n),
+        "tzero": np.zeros(n),
+    }
+    cols.update(extra)
+    return CalibrationTable(name="t", version=version, columns=cols)
+
+
+class TestCalibrationTable:
+    def test_digest_covers_content_name_and_version(self):
+        a = table()
+        assert a.digest == table().digest  # deterministic
+        assert a.digest != table(version=2).digest
+        assert (
+            a.digest
+            != CalibrationTable(
+                name="other", version=1, columns=dict(a.columns)
+            ).digest
+        )
+        bumped = dict(a.columns)
+        bumped["difc"] = np.asarray(bumped["difc"]).copy()
+        bumped["difc"][3] += 1.0
+        assert (
+            a.digest
+            != CalibrationTable(name="t", version=1, columns=bumped).digest
+        )
+
+    def test_columns_are_read_only(self):
+        t = table()
+        with pytest.raises(ValueError):
+            t.column("difc")[0] = 99.0
+
+    def test_with_columns_bumps_version(self):
+        t = table()
+        t2 = t.with_columns(tzero=np.full(64, 5.0))
+        assert t2.version == t.version + 1
+        assert t2.digest != t.digest
+        assert np.array_equal(t2.column("difc"), t.column("difc"))
+
+    def test_require_names_missing_columns(self):
+        with pytest.raises(ValueError, match="difa"):
+            table().require("difc", "difa")
+
+    @pytest.mark.parametrize("suffix", [".npz", ".json"])
+    def test_save_load_round_trip_is_digest_identical(self, tmp_path, suffix):
+        t = table(version=7)
+        path = tmp_path / f"cal{suffix}"
+        save_calibration(path, t)
+        loaded = load_calibration(path)
+        assert loaded.name == t.name
+        assert loaded.version == 7
+        assert loaded.digest == t.digest
+
+
+class TestCalibrationStore:
+    def test_latest_and_explicit_versions(self):
+        store = CalibrationStore()
+        store.add(table(version=1))
+        store.add(table(version=3))
+        assert store.latest("t").version == 3
+        assert store.get("t", 1).version == 1
+        assert store.versions("t") == [1, 3]
+        with pytest.raises(KeyError):
+            store.get("t", 2)
+
+    def test_same_version_different_content_rejected(self):
+        store = CalibrationStore()
+        store.add(table(version=1))
+        store.add(table(version=1))  # idempotent re-add is fine
+        clashing = table(version=1, tzero=np.full(64, 1.0))
+        with pytest.raises(ValueError, match="new version"):
+            store.add(clashing)
+
+    def test_load_dir_skips_corrupt_files(self, tmp_path):
+        store = CalibrationStore()
+        save_calibration(tmp_path / "good.npz", table())
+        (tmp_path / "bad.json").write_text("{not json")
+        assert store.load_dir(tmp_path) == 1
+        assert store.names() == ["t"]
+
+
+class TestStagedColumn:
+    def test_staged_once_per_digest(self):
+        t = table()
+        a = staged_column(t, "difc")
+        b = staged_column(t, "difc")
+        assert a is b  # cache hit: one transfer per (digest, column)
+        c = staged_column(t.with_columns(tzero=np.ones(64)), "difc")
+        assert c is not a  # new digest -> new entry
+
+
+def reference_d_flat(hist, calib, pid, toa, d_edges, bank=None):
+    """Independent numpy oracle for the calibrated flatten."""
+    difc = np.asarray(calib.column("difc"), dtype=np.float32)
+    tzero = np.asarray(calib.column("tzero"), dtype=np.float32)
+    n_d = len(d_edges) - 1
+    out = np.full(pid.shape, hist._n_bins, dtype=np.int32)
+    for i, (p, t) in enumerate(zip(pid, toa)):
+        if p < 0 or p >= difc.shape[0] or difc[p] <= 0:
+            continue
+        d = np.float32(t - tzero[p]) / difc[p]
+        lo, hi = np.float32(d_edges[0]), np.float32(d_edges[-1])
+        if not (d >= lo and d < hi):
+            continue
+        db = min(
+            int(
+                np.floor(
+                    (d - lo) * np.float32(n_d / (d_edges[-1] - d_edges[0]))
+                )
+            ),
+            n_d - 1,
+        )
+        row = 0 if bank is None else int(bank[p])
+        out[i] = row * n_d + db
+    return out
+
+
+class TestCalibratedHistogrammer:
+    def make(self, calib=None, bank=None, **kw):
+        calib = calib or table()
+        return (
+            CalibratedHistogrammer(
+                calibration=calib,
+                d_edges=np.linspace(0.4, 2.8, 121),
+                bank_ids=bank,
+                **kw,
+            ),
+            calib,
+        )
+
+    def test_flatten_matches_reference(self):
+        hist, calib = self.make()
+        rng = np.random.default_rng(11)
+        pid = rng.integers(-2, 70, 4000).astype(np.int32)
+        toa = rng.uniform(-1000, 20000, 4000).astype(np.float32)
+        d_edges = np.linspace(0.4, 2.8, 121)
+        got = hist.flatten_host(pid, toa)
+        want = reference_d_flat(hist, calib, pid, toa, d_edges)
+        assert np.array_equal(got, want)
+
+    def test_banked_flatten_routes_rows(self):
+        bank = (np.arange(64) % 3).astype(np.int32)
+        hist, calib = self.make(bank=bank)
+        assert hist.n_screen == 3
+        rng = np.random.default_rng(12)
+        pid = rng.integers(0, 64, 2000).astype(np.int32)
+        toa = rng.uniform(0, 20000, 2000).astype(np.float32)
+        d_edges = np.linspace(0.4, 2.8, 121)
+        got = hist.flatten_host(pid, toa)
+        want = reference_d_flat(hist, calib, pid, toa, d_edges, bank=bank)
+        assert np.array_equal(got, want)
+
+    def test_difa_quadratic_inverts_gsas_forward_model(self):
+        """toa = difc*d + difa*d^2 + tzero must invert to the original
+        d (the positive root) within float32 tolerance."""
+        n = 32
+        calib = CalibrationTable(
+            name="q",
+            version=1,
+            columns={
+                "difc": np.full(n, 5000.0),
+                "difa": np.full(n, 40.0),
+                "tzero": np.full(n, 25.0),
+            },
+        )
+        hist = CalibratedHistogrammer(
+            calibration=calib, d_edges=np.linspace(0.4, 2.8, 241)
+        )
+        d_true = np.linspace(0.5, 2.7, 200)
+        pid = np.arange(200, dtype=np.int32) % n
+        toa = (5000.0 * d_true + 40.0 * d_true**2 + 25.0).astype(np.float32)
+        flat = hist.flatten_host(pid, toa)
+        edges = np.linspace(0.4, 2.8, 241)
+        expected_bin = np.clip(
+            np.searchsorted(edges, d_true, side="right") - 1, 0, 239
+        )
+        # float32 edge-adjacent events may land one bin off; everything
+        # else must match exactly.
+        assert np.all(np.abs(flat - expected_bin) <= 1)
+        assert np.mean(flat == expected_bin) > 0.95
+
+    def test_step_batch_counts_match_flatten(self):
+        hist, calib = self.make()
+        rng = np.random.default_rng(13)
+        pid = rng.integers(0, 64, 3000)
+        toa = rng.uniform(0, 20000, 3000).astype(np.float32)
+        batch = EventBatch.from_arrays(pid, toa)
+        state = hist.step_batch(hist.init_state(), batch)
+        cum, _win = hist.read(state)
+        flat = hist.flatten_host(batch.pixel_id, batch.toa)
+        want = np.bincount(
+            flat[flat < hist._n_bins], minlength=hist._n_bins
+        ).reshape(cum.shape)
+        assert np.array_equal(cum, want)
+
+    def test_swap_rekeys_everything_and_counts_persist(self):
+        hist, calib = self.make()
+        rng = np.random.default_rng(14)
+        batch = EventBatch.from_arrays(
+            rng.integers(0, 64, 2000), rng.uniform(0, 20000, 2000).astype(np.float32)
+        )
+        state = hist.step_batch(hist.init_state(), batch)
+        before = (hist.layout_digest, hist.stage_key, hist.fuse_key)
+        counts_before = hist.read(state)[0].sum()
+        swapped = calib.with_columns(tzero=np.full(64, 50.0))
+        assert hist.swap_calibration(swapped)
+        assert hist.layout_digest != before[0]
+        assert hist.stage_key != before[1]
+        assert hist.fuse_key != before[2]
+        assert hist.calibration.version == 2
+        # Counts persist: the d bin space is unchanged.
+        assert hist.read(state)[0].sum() == counts_before
+        # And the NEW flatten reflects the new tzero.
+        assert not np.array_equal(
+            hist.flatten_host(batch.pixel_id, batch.toa),
+            CalibratedHistogrammer(
+                calibration=calib, d_edges=np.linspace(0.4, 2.8, 121)
+            ).flatten_host(batch.pixel_id, batch.toa),
+        )
+
+    def test_swap_rejects_incompatible_tables_untouched(self):
+        hist, _calib = self.make()
+        before = hist.layout_digest
+        wrong_len = CalibrationTable(
+            name="t", version=9, columns={"difc": np.full(32, 5000.0)}
+        )
+        assert not hist.swap_calibration(wrong_len)
+        missing = CalibrationTable(
+            name="t", version=9, columns={"tzero": np.zeros(64)}
+        )
+        assert not hist.swap_calibration(missing)
+        assert hist.layout_digest == before
+
+    def test_acceptance_counts_pixel_coverage(self):
+        hist, _ = self.make()
+        acc = hist.acceptance(toa_lo=0.0, toa_hi=20000.0)
+        assert acc.shape == (1, 120)
+        assert acc.min() >= 0
+        populated = acc[acc > 0]
+        assert populated.size and np.isclose(populated.mean(), 1.0)
+
+    def test_equal_digests_share_staged_wire_keys(self):
+        h1, _ = self.make(calib=table())
+        h2, _ = self.make(calib=table())
+        assert h1.stage_key == h2.stage_key
+        assert h1.fuse_key == h2.fuse_key

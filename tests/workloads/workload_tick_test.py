@@ -1,0 +1,420 @@
+"""Workload families on the tick program (ADR 0122): the PR 6 bar.
+
+Every new family (powder focusing, imaging view, timeseries
+correlation) must pass byte-identity parity on the tick path — tick vs
+combined vs per-job reference — with filters active, collapse to ONE
+execute + ONE fetch per steady-state tick, carry its calibration
+statics on the ADR 0113 static channel, and stream through the serving
+plane byte-identically to the sink wire."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from esslivedata_tpu.config import JobId, WorkflowConfig, WorkflowSpec
+from esslivedata_tpu.core.job_manager import JobFactory, JobManager
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.kafka.da00_compat import dataarray_to_da00
+from esslivedata_tpu.kafka.wire import encode_da00
+from esslivedata_tpu.ops import EventBatch
+from esslivedata_tpu.ops.publish import METRICS
+from esslivedata_tpu.preprocessors.event_data import StagedEvents
+from esslivedata_tpu.utils.labeled import DataArray, Variable
+from esslivedata_tpu.workflows import WorkflowFactory
+from esslivedata_tpu.workloads import (
+    CalibrationTable,
+    FilterChain,
+    ImagingViewParams,
+    ImagingViewWorkflow,
+    PowderFocusParams,
+    PowderFocusWorkflow,
+    PulseVetoFilter,
+    TimeseriesCorrelationWorkflow,
+    ToaRangeFilter,
+)
+
+T = Timestamp.from_ns
+N_PIX = 64
+DET = np.arange(N_PIX).reshape(8, 8)
+
+
+def calib(version=1, tzero=0.0) -> CalibrationTable:
+    return CalibrationTable(
+        name="tick_cal",
+        version=version,
+        columns={
+            "difc": np.linspace(4000.0, 6000.0, N_PIX),
+            "tzero": np.full(N_PIX, tzero),
+            "bank": (np.arange(N_PIX) % 2),
+        },
+    )
+
+
+def veto_chain() -> FilterChain:
+    return FilterChain(
+        [
+            PulseVetoFilter(windows=((1000.0, 3000.0),), period_ns=20000.0),
+            ToaRangeFilter(lo_ns=0.0, hi_ns=19000.0),
+        ]
+    )
+
+
+def make_powder(filters=None):
+    return PowderFocusWorkflow(
+        calibration=calib(),
+        params=PowderFocusParams(d_bins=120),
+        filters=filters,
+    )
+
+
+def make_imaging(filters=None):
+    return ImagingViewWorkflow(
+        detector_number=DET,
+        params=ImagingViewParams(frames=4, toa_high=20000.0),
+        calibration=CalibrationTable(
+            name="ff",
+            version=1,
+            columns={"flatfield": np.linspace(0.5, 1.5, N_PIX)},
+        ),
+        filters=filters,
+    )
+
+
+def staged(pid, toa) -> StagedEvents:
+    return StagedEvents(
+        batch=EventBatch.from_arrays(
+            np.asarray(pid), np.asarray(toa, np.float32)
+        ),
+        first_timestamp=None,
+        last_timestamp=None,
+        n_chunks=1,
+    )
+
+
+def make_manager(makes, stream="det0", *, combine=True, tick=True):
+    reg = WorkflowFactory()
+    identifiers = []
+    for i, make in enumerate(makes):
+        spec = WorkflowSpec(
+            instrument="wl", name=f"w{i}", source_names=[stream]
+        )
+        reg.register_spec(spec).attach_factory(
+            lambda *, source_name, params, _m=make: _m()
+        )
+        identifiers.append(spec.identifier)
+    mgr = JobManager(
+        job_factory=JobFactory(reg),
+        job_threads=2,
+        combine_publish=combine,
+        tick_program=tick,
+    )
+    for identifier in identifiers:
+        mgr.schedule_job(
+            WorkflowConfig(
+                identifier=identifier, job_id=JobId(source_name=stream)
+            )
+        )
+    return mgr
+
+
+def wire_bytes(result) -> list[bytes]:
+    return [
+        encode_da00(name, 12345, dataarray_to_da00(da))
+        for name, da in result.outputs.items()
+    ]
+
+
+def windows(seed, n, n_events=2500, toa_hi=20000.0):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            rng.integers(-3, N_PIX + 5, n_events).astype(np.int64),
+            rng.uniform(-500.0, toa_hi, n_events).astype(np.float32),
+        )
+        for _ in range(n)
+    ]
+
+
+class TestFilteredTickParity:
+    def test_powder_and_imaging_byte_identical_across_paths(self):
+        """Two filtered tick groups (K=2 powder focus + K=2 imaging) vs
+        the separate fused-step + combined-publish path vs the fully
+        private path: every da00 byte identical, every window — filters
+        active on both families."""
+        chain = veto_chain()
+        makes = [
+            lambda: make_powder(chain),
+            lambda: make_powder(chain),
+            lambda: make_imaging(chain),
+            lambda: make_imaging(chain),
+        ]
+        tick = make_manager(makes)
+        comb = make_manager(makes, tick=False)
+        priv = make_manager(makes, combine=False, tick=False)
+        for w, (pid, toa) in enumerate(windows(21, 4)):
+            res = [
+                m.process_jobs(
+                    {"det0": staged(pid, toa)}, start=T(0), end=T(w + 1)
+                )
+                for m in (tick, comb, priv)
+            ]
+            assert [len(r) for r in res] == [4, 4, 4]
+            for rt, rc, rp in zip(*res):
+                assert rt.workflow_id == rc.workflow_id == rp.workflow_id
+                bt, bc, bp = map(wire_bytes, (rt, rc, rp))
+                assert bt == bc, f"window {w}: tick wire != combined"
+                assert bt == bp, f"window {w}: tick wire != private"
+        for m in (tick, comb, priv):
+            m.shutdown()
+
+    def test_filtered_tick_is_one_dispatch(self):
+        """Steady state with filters ACTIVE: one execute + one fetch
+        per (stream, fuse-key) tick, zero separate step dispatches,
+        calibration statics served from the host cache."""
+        chain = veto_chain()
+        makes = [lambda: make_powder(chain)] * 3
+        mgr = make_manager(makes)
+        ws = windows(22, 4)
+        for w in range(2):  # warm: both program variants + static fetch
+            pid, toa = ws[w]
+            assert (
+                len(
+                    mgr.process_jobs(
+                        {"det0": staged(pid, toa)}, start=T(0), end=T(w + 1)
+                    )
+                )
+                == 3
+            )
+        METRICS.drain()
+        for w in (2, 3):
+            pid, toa = ws[w]
+            mgr.process_jobs(
+                {"det0": staged(pid, toa)}, start=T(0), end=T(w + 1)
+            )
+        m = METRICS.drain()
+        assert m["executes"] == 2 and m["fetches"] == 2
+        assert m["step_executes"] == 0
+        assert m["tick_publishes"] == 2 and m["tick_jobs"] == 6
+        assert m["static_bytes"] == 0  # acceptance from the host cache
+        mgr.shutdown()
+
+    def test_filters_actually_filter_and_pass_all_is_identity(self):
+        """A real veto drops counts vs unfiltered; a pass-all chain is
+        byte-identical to no chain (the acceptance criterion's
+        predicates-pass-all identity)."""
+        filtered = make_manager([lambda: make_powder(veto_chain())])
+        passall = make_manager(
+            [
+                lambda: make_powder(
+                    FilterChain([ToaRangeFilter(lo_ns=-1e18, hi_ns=1e18)])
+                )
+            ]
+        )
+        plain = make_manager([lambda: make_powder(None)])
+        pid, toa = windows(23, 1)[0]
+        rf = filtered.process_jobs(
+            {"det0": staged(pid, toa)}, start=T(0), end=T(1)
+        )[0]
+        rp = passall.process_jobs(
+            {"det0": staged(pid, toa)}, start=T(0), end=T(1)
+        )[0]
+        rn = plain.process_jobs(
+            {"det0": staged(pid, toa)}, start=T(0), end=T(1)
+        )[0]
+        assert wire_bytes(rp) == wire_bytes(rn)
+        assert (
+            float(rf.outputs["counts_cumulative"].values)
+            < float(rn.outputs["counts_cumulative"].values)
+        )
+        for m in (filtered, passall, plain):
+            m.shutdown()
+
+
+class TestCalibrationStatics:
+    def test_acceptance_fetched_once_then_cached_then_refetched_on_swap(self):
+        mgr = make_manager([lambda: make_powder()] * 2)
+        created = []
+        # reach the live workflows through the manager's records
+        created = [
+            rec.job.workflow for rec in mgr._records.values()
+        ]
+        ws = windows(24, 4)
+        METRICS.drain()
+        pid, toa = ws[0]
+        mgr.process_jobs({"det0": staged(pid, toa)}, start=T(0), end=T(1))
+        first = METRICS.drain()
+        assert first["static_bytes"] > 0  # the acceptance block, once
+        pid, toa = ws[1]
+        mgr.process_jobs({"det0": staged(pid, toa)}, start=T(0), end=T(2))
+        assert METRICS.drain()["static_bytes"] == 0
+        # Live recalibration: same d space, new tzero.
+        for wf in created:
+            assert wf.set_calibration(calib(version=2, tzero=400.0))
+        pid, toa = ws[2]
+        res = mgr.process_jobs(
+            {"det0": staged(pid, toa)}, start=T(0), end=T(3)
+        )
+        assert len(res) == 2
+        m = METRICS.drain()
+        assert m["tick_publishes"] == 1  # the swapped layout still ticks
+        assert m["static_bytes"] > 0  # refetched under the new digest
+        pid, toa = ws[3]
+        mgr.process_jobs({"det0": staged(pid, toa)}, start=T(0), end=T(4))
+        assert METRICS.drain()["static_bytes"] == 0
+        mgr.shutdown()
+
+    def test_swap_compile_classified_as_layout_swap(self):
+        """The calibration swap re-keys the tick program; the ADR 0116
+        instrument must classify the resulting compile as layout_swap
+        (the digest moved, shapes did not)."""
+        from esslivedata_tpu.telemetry.compile import COMPILE_EVENTS
+
+        mgr = make_manager([lambda: make_powder()] * 2)
+        created = [rec.job.workflow for rec in mgr._records.values()]
+        ws = windows(25, 3)
+        for w in range(2):
+            pid, toa = ws[w]
+            mgr.process_jobs(
+                {"det0": staged(pid, toa)}, start=T(0), end=T(w + 1)
+            )
+        def layout_swaps() -> float:
+            return COMPILE_EVENTS.total(trigger="layout_swap")
+
+        total_before = COMPILE_EVENTS.total()
+        swaps_before = layout_swaps()
+        for wf in created:
+            assert wf.set_calibration(calib(version=3, tzero=777.0))
+        pid, toa = ws[2]
+        mgr.process_jobs({"det0": staged(pid, toa)}, start=T(0), end=T(3))
+        assert COMPILE_EVENTS.total() > total_before
+        assert layout_swaps() > swaps_before
+        mgr.shutdown()
+
+
+class TestCorrelationFamily:
+    def log(self, value: float) -> DataArray:
+        return DataArray(
+            Variable(np.asarray([value]), ("time",), ""),
+            coords={"time": Variable(np.asarray([0]), ("time",), "ns")},
+        )
+
+    def make_mgr(self, combine=True):
+        reg = WorkflowFactory()
+        spec = WorkflowSpec(
+            instrument="wl",
+            name="corr",
+            source_names=["log_a"],
+            aux_source_names={"partner": ["log_b"]},
+        )
+        reg.register_spec(spec).attach_factory(
+            lambda *, source_name, params: TimeseriesCorrelationWorkflow(
+                streams=["log_a", "log_b"]
+            )
+        )
+        mgr = JobManager(
+            job_factory=JobFactory(reg),
+            job_threads=1,
+            combine_publish=combine,
+        )
+        for _ in range(2):
+            mgr.schedule_job(
+                WorkflowConfig(
+                    identifier=spec.identifier,
+                    job_id=JobId(source_name="log_a"),
+                    aux_source_names={"partner": "log_b"},
+                )
+            )
+        return mgr
+
+    def test_combined_publish_parity_and_correlation_value(self):
+        """The da00-path family: combined-publish output byte-identical
+        to the per-job reference, and the analytics are right (two
+        linearly-dependent logs correlate to ~1)."""
+        comb, priv = self.make_mgr(True), self.make_mgr(False)
+        rng = np.random.default_rng(26)
+        for w in range(12):
+            a = float(rng.normal())
+            data = {"log_a": self.log(a), "log_b": self.log(2 * a + 1)}
+            rc = comb.process_jobs(data, start=T(0), end=T(w + 1))
+            rp = priv.process_jobs(data, start=T(0), end=T(w + 1))
+            assert len(rc) == len(rp) == 2
+            for c, p in zip(rc, rp):
+                assert wire_bytes(c) == wire_bytes(p)
+        corr = rc[0].outputs["correlation"].values
+        assert corr.shape == (2, 2)
+        assert np.allclose(corr, 1.0, atol=1e-3)
+        assert float(rc[0].outputs["samples"].values) == 12.0
+        comb.shutdown()
+        priv.shutdown()
+
+    def test_event_ingest_declines(self):
+        wf = TimeseriesCorrelationWorkflow(streams=["a"])
+        assert wf.event_ingest("a", object()) is None
+
+    def test_misaligned_windows_defer_sampling(self):
+        wf = TimeseriesCorrelationWorkflow(streams=["a", "b"])
+        wf.accumulate({"a": self.log(1.0)})  # b never seen: no sample
+        assert float(wf.finalize()["samples"].values) == 0.0
+        wf.accumulate({"b": self.log(2.0)})  # now aligned
+        assert float(wf.finalize()["samples"].values) == 1.0
+
+
+class TestServingPlaneStreamability:
+    def test_all_three_families_stream_byte_identical(self):
+        """ADR 0117 acceptance for the new families: a subscriber's
+        reconstructed frames equal the sink serializer's exact da00
+        wire for every output of every family, keyframe and delta."""
+        from esslivedata_tpu.serving import (
+            DeltaDecoder,
+            ServingPlane,
+            stream_key,
+        )
+
+        makes = [lambda: make_powder(veto_chain()), lambda: make_imaging()]
+        mgr = make_manager(makes)
+        plane = ServingPlane(port=None)
+        decoders: dict[str, DeltaDecoder] = {}
+        frames: dict[str, bytes] = {}
+        reference: dict[str, bytes] = {}
+        subs: dict[str, object] = {}
+        try:
+            for w, (pid, toa) in enumerate(windows(27, 3)):
+                ts = T(1000 + w)
+                out = mgr.process_jobs(
+                    {"det0": staged(pid, toa)}, start=T(0), end=ts
+                )
+                assert len(out) == 2
+                for res in out:
+                    job = (
+                        f"{res.job_id.source_name}:{res.job_id.job_number}"
+                    )
+                    for key, da in zip(
+                        res.keys(), res.outputs.values(), strict=True
+                    ):
+                        reference[stream_key(job, key.output_name)] = (
+                            encode_da00(
+                                key.to_string(),
+                                ts.ns,
+                                dataarray_to_da00(da),
+                            )
+                        )
+                plane.publish_results(out, ts)
+                for stream in plane.server.cache.streams():
+                    if stream not in subs:
+                        subs[stream] = plane.server.subscribe(stream)
+                        decoders[stream] = DeltaDecoder()
+                for stream, sub in subs.items():
+                    while sub.depth() > 0:
+                        blob = sub.next_blob(timeout=1.0)
+                        frames[stream] = decoders[stream].apply(blob)
+                for stream, frame in frames.items():
+                    assert frame == reference[stream], (
+                        f"window {w}: {stream} reconstruction != sink wire"
+                    )
+            # Every output of both families streamed.
+            assert len(frames) == len(
+                out[0].outputs
+            ) + len(out[1].outputs)
+        finally:
+            mgr.shutdown()
+            plane.close()

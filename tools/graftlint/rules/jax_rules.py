@@ -1,4 +1,4 @@
-"""JAX jit-boundary hazards: JGL001/002/003/006/008/009/015/016/017.
+"""JAX jit-boundary hazards: JGL001/002/003/006/008/009/015/016/017/027.
 
 Most of these erase TPU throughput without failing a test — host syncs
 serialize the pipeline behind a device round trip, retraces recompile
@@ -15,6 +15,7 @@ bad/good pairs: docs/graftlint.md.
 from __future__ import annotations
 
 import ast
+from pathlib import Path
 
 from ..context import FileContext
 from ..dataflow import walk_own
@@ -1256,3 +1257,184 @@ def traced_value_escape(ctx: FileContext):
                         "container keeps a leaked Tracer. Return the "
                         "value and collect it on the host side",
                     )
+
+
+# -- JGL027: static-table mutation without digest invalidation ---------------
+
+#: Self-attribute stems that read as a device-resident constant/LUT —
+#: the data every staging/fusion/static-publish key fingerprints.
+_TABLE_STEMS = ("lut", "qmap", "table", "calib", "flatfield")
+#: Substrings that mark an attr as table METADATA, not the table
+#: (shape/sharding descriptors, names, the invalidation fields
+#: themselves, and content-neutral residence caches — per-device
+#: copies of already-digested bytes).
+_TABLE_META = (
+    "shape", "sharding", "name", "digest", "version", "token", "epoch",
+    "cache", "by_device",
+)
+#: Methods whose writes are the sanctioned mutation paths: construction,
+#: the swap_*/set_* re-fingerprinting surface, placement re-staging, and
+#: the adopt/install/build helpers those route through.
+_SANCTIONED_PREFIXES = (
+    "swap_", "set_", "place_", "load_", "restore_", "_build", "_adopt",
+    "_install",
+)
+_SANCTIONED_EXACT = frozenset({"__init__", "__post_init__", "clear"})
+#: Attr-write (or callee-name) evidence that the method feeds the
+#: invalidation path itself.
+_INVALIDATION_HINTS = ("digest", "version", "token", "epoch", "invalidate")
+#: Class methods/properties whose presence marks the class as carrying a
+#: key surface (ADR 0110/0113): only these classes are in scope — a
+#: plain cache dict named `_table` in an unrelated class is not a
+#: staged-wire hazard.
+_KEY_SURFACE = frozenset(
+    {"layout_digest", "stage_key", "partition_key", "partition_key_for",
+     "fuse_key"}
+)
+
+
+def _table_attr(name: str) -> bool:
+    lowered = name.lower()
+    if any(meta in lowered for meta in _TABLE_META):
+        return False
+    return any(stem in lowered for stem in _TABLE_STEMS)
+
+
+def _self_attr_targets(stmt: ast.AST):
+    """Attribute targets on ``self`` of one assignment statement,
+    including tuple-unpacking targets AND subscript stores
+    (``self._lut[:] = new`` mutates the table in place without even
+    changing the object identity — the sneakiest instance of the
+    staleness class, since cached digests AND staged device copies
+    keep pointing at the mutated buffer)."""
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    else:
+        return
+    for target in targets:
+        stack = [target]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.Tuple, ast.List)):
+                stack.extend(node.elts)
+            elif isinstance(node, ast.Subscript):
+                stack.append(node.value)
+            elif (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                yield node
+
+
+def _method_invalidates(fn: ast.FunctionDef) -> bool:
+    """True when the method also touches the invalidation surface: a
+    self-attr write whose name carries digest/version/token/epoch, or a
+    call to an invalidate/re-digest helper."""
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            for attr in _self_attr_targets(node):
+                lowered = attr.attr.lower()
+                if any(h in lowered for h in _INVALIDATION_HINTS):
+                    return True
+        if isinstance(node, ast.Call):
+            callee = (
+                node.func.attr
+                if isinstance(node.func, ast.Attribute)
+                else node.func.id if isinstance(node.func, ast.Name) else ""
+            )
+            if any(h in callee.lower() for h in _INVALIDATION_HINTS):
+                return True
+    return False
+
+
+def _rhs_reads_host_twin(stmt: ast.AST) -> bool:
+    """True when the assignment's value reads a ``self.*host*`` attr —
+    the lazy device materialization of a content-equal host copy
+    (``self._lut_dev = jnp.asarray(self.lut_host)``): the content (and
+    so the digest) is unchanged, only the residence moves."""
+    value = getattr(stmt, "value", None)
+    if value is None:
+        return False
+    for node in ast.walk(value):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and "host" in node.attr.lower()
+        ):
+            return True
+    return False
+
+
+@rule(
+    "JGL027",
+    "device-resident table mutated outside a digest-invalidating path",
+)
+def table_mutation_without_invalidation(ctx: FileContext):
+    """Scope: classes exposing a staging-key surface (``layout_digest``
+    / ``stage_key`` / ``partition_key`` / ``fuse_key`` — the ADR 0110
+    fingerprint methods), plus any module whose filename says
+    calibration. In scope, a write to a self-attr that reads as a
+    static table (``*lut*``/``*qmap*``/``*table*``/``*calib*``/
+    ``*flatfield*``, metadata names excluded) must happen on a
+    sanctioned path: ``__init__``/``__post_init__``/``clear``, a
+    ``swap_*``/``set_*``/``place_*``/``load_*``/``restore_*`` method,
+    an ``_adopt*``/``_install*``/``_build*`` helper, a method that also
+    writes a digest/version/token/epoch attr (or calls an
+    ``invalidate``/re-digest helper), or a lazy device materialization
+    reading the ``*host*`` twin.
+
+    Anything else is the silent-staleness bug class ADR 0110/0113 key
+    discipline exists to prevent: the staged wire, the jitted tick
+    program and the static-publish cache are all keyed on the table's
+    fingerprint — a bare ``self._lut = new`` keeps serving results
+    computed under the OLD table for as long as those keys survive,
+    with no error and no metric. Route the write through a
+    ``swap_*``/``set_*`` method that re-fingerprints (see
+    workloads/calibration.py for the pattern).
+    """
+    module_scope = "calib" in Path(ctx.path).stem.lower()
+    for cls in ctx.nodes(ast.ClassDef):
+        methods = [
+            node
+            for node in cls.body
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        in_scope = module_scope or any(
+            m.name in _KEY_SURFACE or m.name.endswith("static_token")
+            for m in methods
+        )
+        if not in_scope:
+            continue
+        for fn in methods:
+            name = fn.name
+            if name in _SANCTIONED_EXACT or name.startswith(
+                _SANCTIONED_PREFIXES
+            ):
+                continue
+            hits = [
+                (stmt, attr)
+                for stmt in ast.walk(fn)
+                for attr in _self_attr_targets(stmt)
+                if _table_attr(attr.attr)
+                and not _rhs_reads_host_twin(stmt)
+            ]
+            if not hits or _method_invalidates(fn):
+                continue
+            stmt, attr = hits[0]
+            yield Finding(
+                ctx.path,
+                stmt.lineno,
+                "JGL027",
+                f"'{cls.name}.{name}' writes static-table attr "
+                f"'self.{attr.attr}' outside a swap_*/set_* path and "
+                "without bumping a digest/version/token — staged wires, "
+                "tick programs and static-publish caches keyed on the "
+                "old fingerprint will keep serving results computed "
+                "under the OLD table (ADR 0110/0113 invalidation rule). "
+                "Route the write through a swap_*/set_* method that "
+                "re-fingerprints",
+            )
