@@ -57,7 +57,11 @@ docs:
 # the JAX-hazard/concurrency pass (tools/graftlint, docs/graftlint.md):
 # per-file rules + the whole-program thread/lock/jit-key pass, gated
 # against the known-findings baseline (currently empty — keep it that
-# way for core/; see docs/adr/0112).
+# way for core/; see docs/adr/0112) — plus the trace pass (ADR 0123):
+# every registered tick program is AOT-lowered (CPU backend, no
+# device) and its contract fingerprint is diffed against
+# tickcontract-baseline.json. No jax in the environment = a visible
+# SKIPPED notice from the trace pass, never a silent green.
 lint:
 	$(PY) -m compileall -q src/ tests/ tools/ bench.py __graft_entry__.py
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -65,7 +69,8 @@ lint:
 	else \
 		echo "lint: ruff not installed, skipping (config in pyproject.toml)"; \
 	fi
-	$(PY) -m tools.graftlint src/ --jobs 0 --baseline graftlint-baseline.json
+	$(PY) -m tools.graftlint src/ --jobs 0 --baseline graftlint-baseline.json \
+		--trace --trace-baseline tickcontract-baseline.json
 
 # Apply ruff autofixes, then report what graftlint still sees (graftlint
 # never rewrites code — its fixes are reviewed hunks by design).

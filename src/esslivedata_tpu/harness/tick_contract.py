@@ -1,0 +1,486 @@
+"""Tick-program contract registry: the bridge graftlint's trace pass
+lowers (ADR 0123).
+
+Every workflow family whose hot path is the one-dispatch tick program
+(ADR 0114) registers a :class:`TickProgramSpec` here: a device-free
+builder that constructs a small synthetic instance of the family and
+assembles the EXACT jitted program the live ``JobManager`` would
+dispatch — same ``event_ingest`` offer, same ``publish_offer``, same
+``plan_members`` plan, same ``TickCombiner._build`` — against a
+zero-filled padded batch (the ``plan_warmup`` extraction pattern:
+offers are side-effect free, lowering reads avals, never values).
+
+The trace pass (``tools/graftlint/trace``) AOT-lowers each build under
+``JAX_PLATFORMS=cpu`` and proves the performance contract statically:
+one executable per tick (JGL101), every rolling-state invar donated in
+the lowered computation (JGL102), digest-keyed table swaps re-lower to
+an identical program (JGL103), no host callbacks in the traced body
+(JGL104), and output avals matching the family's declared wire schema
+(JGL105 — the ``TICK_WIRE_SCHEMA`` constant each family module pins
+next to its publish program).
+
+Builders run on the CPU backend with no accelerator attached; the
+synthetic geometries are deliberately tiny (a 12x12 logical grid, 48
+calibrated pixels) so a full registry sweep lowers in seconds. The
+``variant`` argument selects the table epoch: ``"base"`` is the
+shipped configuration, ``"swap"`` rebuilds with a different
+digest-keyed table of identical shapes (a recalibration, a flat-field
+update, a re-centred Q map) — the JGL103 proof compares the two
+lowerings byte for byte.
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+from collections.abc import Callable, Mapping
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = [
+    "ContractBuildError",
+    "REGISTRY",
+    "TickProgram",
+    "TickProgramBuild",
+    "TickProgramSpec",
+    "iter_contracts",
+    "register_tick_program",
+]
+
+#: Synthetic staged-batch padding: one power-of-two bucket, matching
+#: what a quiet live stream carries (ops/event_batch.bucket_size).
+_PADDED = 256
+
+
+class ContractBuildError(RuntimeError):
+    """A family's builder could not assemble its tick program — the
+    family is NOT contract-verifiable, which the trace pass reports as
+    a run error (never a silent skip)."""
+
+
+@dataclass(frozen=True)
+class TickProgram:
+    """One lowered-checkable program of a family's tick.
+
+    ``state_positions`` are the flat argument positions that hold
+    rolling device state (``args[0]`` of each planned member — the
+    ``make_publish_offer`` contract), derived from the *protocol*, not
+    from the publisher's declared ``donate`` tuple, so JGL102 proves
+    donation rather than echoing the call site. ``staged_positions``
+    are the shared staged-wire arguments, which must NEVER be donated
+    (other window consumers hold references). ``outputs`` is the
+    abstract output tree of the member publish program(s) —
+    name -> ``jax.ShapeDtypeStruct``.
+    """
+
+    label: str
+    fn: Callable
+    args: tuple
+    state_positions: tuple[int, ...]
+    staged_positions: tuple[int, ...]
+    outputs: Mapping[str, object]
+
+
+@dataclass(frozen=True)
+class TickProgramBuild:
+    """Builder result: the tick's program set plus identity-free
+    program-key material (staged + member arg signatures, static split,
+    inclusion flags) — what :meth:`~..ops.tick.TickCombiner._program_key`
+    keys on with object identities erased, so two independently built
+    epochs can be compared for swap-stability."""
+
+    programs: tuple[TickProgram, ...]
+    key_material: tuple
+
+
+@dataclass(frozen=True)
+class TickProgramSpec:
+    family: str
+    build: Callable[[str], TickProgramBuild]
+    #: Declared wire schema: output name -> (ndim, dtype name). The
+    #: family module pins this next to its publish program
+    #: (``TICK_WIRE_SCHEMA``); JGL105 proves the traced avals match.
+    wire_schema: Mapping[str, tuple[int, str]]
+    #: ``"module.path:ClassName"`` of the owning workflow — findings
+    #: anchor to its defining file so suppressions/baselines work.
+    anchor: str
+    #: What the ``"swap"`` variant swaps (None: the family has no
+    #: digest-keyed table and JGL103 does not apply).
+    swap_variant: str | None = None
+
+    def source_location(self) -> tuple[str, int]:
+        """(repo-relative path, line) of the owning workflow class;
+        falls back to this registry when the anchor will not resolve."""
+        try:
+            mod_name, cls_name = self.anchor.split(":")
+            cls = getattr(importlib.import_module(mod_name), cls_name)
+            path = inspect.getsourcefile(cls)
+            line = inspect.getsourcelines(cls)[1]
+            return _repo_relative(path), line
+        except Exception:
+            return _repo_relative(__file__), 1
+
+
+def _repo_relative(path: str) -> str:
+    """Best-effort repo-relative form (``src/...``) so trace findings
+    match the paths the static passes lint (suppression + baseline
+    matching is path-keyed)."""
+    import os
+
+    p = os.path.abspath(path)
+    for cwd in (os.getcwd(),):
+        if p.startswith(cwd + os.sep):
+            return os.path.relpath(p, cwd)
+    return path
+
+
+REGISTRY: dict[str, TickProgramSpec] = {}
+
+
+def register_tick_program(
+    family: str,
+    *,
+    anchor: str,
+    wire_schema: Mapping[str, tuple[int, str]],
+    swap_variant: str | None = None,
+) -> Callable:
+    """Register ``build(variant) -> TickProgramBuild`` for a family."""
+
+    def register(build: Callable[[str], TickProgramBuild]):
+        if family in REGISTRY:
+            raise ValueError(f"duplicate tick-contract family {family!r}")
+        REGISTRY[family] = TickProgramSpec(
+            family=family,
+            build=build,
+            wire_schema=dict(wire_schema),
+            anchor=anchor,
+            swap_variant=swap_variant,
+        )
+        return build
+
+    return register
+
+
+def iter_contracts() -> list[TickProgramSpec]:
+    return [REGISTRY[name] for name in sorted(REGISTRY)]
+
+
+# -- shared assembly -------------------------------------------------------
+
+
+def _zero_staged(padded: int = _PADDED):
+    """A zero-filled padded window, the ``plan_warmup`` synthetic batch:
+    every entry is pixel_id -1 padding, so staging it is value-inert —
+    only its signature (and the staged avals) reach the program."""
+    from ..ops.event_batch import EventBatch
+    from ..preprocessors.event_data import StagedEvents
+
+    return StagedEvents(
+        batch=EventBatch(
+            pixel_id=np.full(padded, -1, dtype=np.int32),
+            toa=np.zeros(padded, dtype=np.float32),
+            n_valid=0,
+        ),
+        first_timestamp=None,
+        last_timestamp=None,
+        n_chunks=1,
+    )
+
+
+def _member_key_material(plan) -> tuple:
+    """``member_signature`` with publisher identity erased: the args
+    signature, static split and inclusion flag per member — everything
+    identity-free that determines the compiled program."""
+    return tuple(
+        (req.publisher._signature(req.args), tuple(sorted(skeys)), inc)
+        for _i, req, skeys, _spec, _names, inc, _c, _s in plan
+    )
+
+
+def _plan_one(workflow):
+    """Plan the workflow's single-member publish exactly as the live
+    tick planner would; raises :class:`ContractBuildError` when the
+    family is not tick-eligible (that would itself be a regression —
+    every registered family rides the one-dispatch tick)."""
+    from ..ops.publish import PublishRequest, plan_members
+
+    offer = workflow.publish_offer()
+    if offer is None:
+        raise ContractBuildError("publish_offer() returned None")
+    plan, errors = plan_members(
+        [PublishRequest(offer.publisher, offer.args, offer.static_token)]
+    )
+    if errors or not plan:
+        raise ContractBuildError(
+            f"publish plan failed: {errors.get(0)!r}"
+        )
+    return offer, plan
+
+
+def _member_outputs(offer):
+    """Abstract output tree of the member's publish program — the
+    JGL105 subject, evaluated with ``jax.eval_shape`` (no compile)."""
+    import jax
+
+    return jax.eval_shape(
+        lambda *a: offer.publisher._program(*a)[0], *offer.args
+    )
+
+
+def event_family_build(workflow, *, stream: str) -> TickProgramBuild:
+    """Assemble the one-dispatch tick program for an event family:
+    ingest offer -> publish offer -> planned member -> staged wire ->
+    ``TickCombiner._build`` — the exact live composition (ADR 0114),
+    against a zero-filled padded batch."""
+    from ..ops.publish import PackedPublisher
+    from ..ops.tick import TickCombiner
+
+    ingest = workflow.event_ingest(stream, _zero_staged())
+    if ingest is None:
+        raise ContractBuildError(
+            f"event_ingest({stream!r}) declined the synthetic window"
+        )
+    offer, plan = _plan_one(workflow)
+    if not offer.args or offer.args[0] is not ingest.get_state():
+        # The _split_tick_groups eligibility check: args[0] IS the
+        # rolling ingest state, or the family cannot ride the tick.
+        raise ContractBuildError(
+            "publish_offer args[0] is not the ingest state — the "
+            "family would degrade to separate dispatches"
+        )
+    staged = ingest.hist.tick_staging(
+        ingest.batch, None, batch_tag=ingest.batch_tag
+    )
+    members = [
+        (req.publisher, len(req.args), skeys, inc)
+        for _i, req, skeys, _spec, _names, inc, _c, _s in plan
+    ]
+    fn = TickCombiner()._build(ingest.hist, len(staged), members)
+    flat_args = tuple(staged) + tuple(
+        a for _i, req, *_ in plan for a in req.args
+    )
+    return TickProgramBuild(
+        programs=(
+            TickProgram(
+                label="tick",
+                fn=fn,
+                args=flat_args,
+                # Single member: its rolling state sits right behind
+                # the staged prefix (the make_publish_offer contract).
+                state_positions=(len(staged),),
+                staged_positions=tuple(range(len(staged))),
+                outputs=_member_outputs(offer),
+            ),
+        ),
+        key_material=(
+            PackedPublisher._signature(tuple(staged)),
+            _member_key_material(plan),
+        ),
+    )
+
+
+def publish_family_build(workflow) -> TickProgramBuild:
+    """Assemble the combined-publish program for a non-event family
+    (the da00-path workloads): no staged wire, the member's packed
+    publish is the whole per-tick dispatch (ADR 0113)."""
+    from ..ops.publish import PublishCombiner
+
+    offer, plan = _plan_one(workflow)
+    members = [
+        (req.publisher, len(req.args), skeys, inc)
+        for _i, req, skeys, _spec, _names, inc, _c, _s in plan
+    ]
+    fn = PublishCombiner._build(members)
+    flat_args = tuple(a for _i, req, *_ in plan for a in req.args)
+    return TickProgramBuild(
+        programs=(
+            TickProgram(
+                label="publish",
+                fn=fn,
+                args=flat_args,
+                state_positions=(0,),
+                staged_positions=(),
+                outputs=_member_outputs(offer),
+            ),
+        ),
+        key_material=(None, _member_key_material(plan)),
+    )
+
+
+# -- family registrations --------------------------------------------------
+#
+# Geometries are the test-suite synthetics (tests/workflows,
+# tests/workloads): tiny, deterministic, and shaped like the real
+# thing. The "swap" variant of each table-carrying family rebuilds
+# with a same-shape different-content table — the digest changes, the
+# lowering must not.
+
+
+def _logical_grid(*, swapped: bool = False) -> np.ndarray:
+    det = np.arange(144, dtype=np.int64).reshape(12, 12)
+    return np.flipud(det).copy() if swapped else det
+
+
+@register_tick_program(
+    "detector_view",
+    anchor="esslivedata_tpu.workflows.detector_view.workflow:"
+    "DetectorViewWorkflow",
+    wire_schema={},  # installed below, next to the family module's pin
+    swap_variant="projection LUT rebuilt from a flipped logical grid",
+)
+def _build_detector_view(variant: str) -> TickProgramBuild:
+    from ..workflows.detector_view.projectors import project_logical
+    from ..workflows.detector_view.workflow import DetectorViewWorkflow
+
+    projection = project_logical(_logical_grid(swapped=variant == "swap"))
+    return event_family_build(
+        DetectorViewWorkflow(projection=projection), stream="det0"
+    )
+
+
+@register_tick_program(
+    "monitor",
+    anchor="esslivedata_tpu.workflows.monitor_workflow:MonitorWorkflow",
+    wire_schema={},
+)
+def _build_monitor(variant: str) -> TickProgramBuild:
+    from ..workflows.monitor_workflow import MonitorWorkflow
+
+    return event_family_build(MonitorWorkflow(), stream="mon0")
+
+
+@register_tick_program(
+    "q_sans",
+    anchor="esslivedata_tpu.workflows.sans:SansIQWorkflow",
+    wire_schema={},
+    swap_variant="Q map rebuilt under a shifted beam centre",
+)
+def _build_q_sans(variant: str) -> TickProgramBuild:
+    from ..workflows.sans import SansIQParams, SansIQWorkflow
+
+    n_pix = 64
+    rng = np.random.default_rng(7)
+    positions = np.column_stack(
+        [
+            rng.uniform(-0.3, 0.3, n_pix),
+            rng.uniform(-0.3, 0.3, n_pix),
+            np.full(n_pix, 5.0),
+        ]
+    )
+    params = SansIQParams(
+        beam_center_x=0.01 if variant == "swap" else 0.0
+    )
+    workflow = SansIQWorkflow(
+        positions=positions,
+        pixel_ids=np.arange(n_pix),
+        params=params,
+    )
+    return event_family_build(workflow, stream="det0")
+
+
+@register_tick_program(
+    "powder_focus",
+    anchor="esslivedata_tpu.workloads.powder_focus:PowderFocusWorkflow",
+    wire_schema={},
+    swap_variant="calibration epoch bumped via with_columns(difc=...)",
+)
+def _build_powder_focus(variant: str) -> TickProgramBuild:
+    from ..workloads.calibration import CalibrationTable
+    from ..workloads.powder_focus import PowderFocusWorkflow
+
+    n_pix = 48
+    table = CalibrationTable(
+        name="contract_cal",
+        version=1,
+        columns={
+            "difc": np.linspace(4000.0, 6000.0, n_pix),
+            "tzero": np.full(n_pix, -2.0),
+        },
+    )
+    if variant == "swap":
+        table = table.with_columns(
+            difc=np.asarray(table.columns["difc"]) * 1.01
+        )
+    return event_family_build(
+        PowderFocusWorkflow(calibration=table), stream="det0"
+    )
+
+
+@register_tick_program(
+    "imaging",
+    anchor="esslivedata_tpu.workloads.imaging:ImagingViewWorkflow",
+    wire_schema={},
+    swap_variant="flat-field table swapped via set_flatfield's epoch",
+)
+def _build_imaging(variant: str) -> TickProgramBuild:
+    from ..workloads.calibration import CalibrationTable
+    from ..workloads.imaging import ImagingViewWorkflow
+
+    ny, nx = 8, 8
+    det = np.arange(ny * nx, dtype=np.int64).reshape(ny, nx)
+    flat = np.ones(ny * nx, dtype=np.float32)
+    if variant == "swap":
+        flat = flat * 1.25
+    calibration = CalibrationTable(
+        name="contract_ff", version=1, columns={"flatfield": flat}
+    )
+    return event_family_build(
+        ImagingViewWorkflow(detector_number=det, calibration=calibration),
+        stream="det0",
+    )
+
+
+@register_tick_program(
+    "correlation",
+    anchor="esslivedata_tpu.workloads.correlation:"
+    "TimeseriesCorrelationWorkflow",
+    wire_schema={},
+)
+def _build_correlation(variant: str) -> TickProgramBuild:
+    from ..workloads.correlation import TimeseriesCorrelationWorkflow
+
+    return publish_family_build(
+        TimeseriesCorrelationWorkflow(streams=("a", "b", "c"))
+    )
+
+
+def _install_wire_schemas() -> None:
+    """Adopt each family module's ``TICK_WIRE_SCHEMA`` pin. Kept IN the
+    family modules (next to the publish programs they constrain) so a
+    program edit and its schema ride the same diff; resolved lazily so
+    importing this registry stays cheap."""
+    anchors = {
+        "detector_view": (
+            "esslivedata_tpu.workflows.detector_view.workflow"
+        ),
+        "monitor": "esslivedata_tpu.workflows.monitor_workflow",
+        "q_sans": "esslivedata_tpu.workflows.qshared",
+        "powder_focus": "esslivedata_tpu.workloads.powder_focus",
+        "imaging": "esslivedata_tpu.workloads.imaging",
+        "correlation": "esslivedata_tpu.workloads.correlation",
+    }
+    for family, module_name in anchors.items():
+        module = importlib.import_module(module_name)
+        schema = getattr(module, "TICK_WIRE_SCHEMA")
+        spec = REGISTRY[family]
+        REGISTRY[family] = TickProgramSpec(
+            family=spec.family,
+            build=spec.build,
+            wire_schema=dict(schema),
+            anchor=spec.anchor,
+            swap_variant=spec.swap_variant,
+        )
+
+
+_SCHEMAS_INSTALLED = False
+
+
+def load_registry() -> list[TickProgramSpec]:
+    """The trace pass's entry point: registrations plus the family
+    modules' wire-schema pins, resolved once."""
+    global _SCHEMAS_INSTALLED
+    if not _SCHEMAS_INSTALLED:
+        _install_wire_schemas()
+        _SCHEMAS_INSTALLED = True
+    return iter_contracts()

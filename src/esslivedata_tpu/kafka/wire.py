@@ -293,6 +293,18 @@ _AD00_DTYPES: list[np.dtype] = [
 _AD00_CODE = {dt: i for i, dt in enumerate(_AD00_DTYPES)}
 
 
+def da00_encodable(dtype) -> bool:
+    """True when ``dtype`` maps into the da00 dtype enum above — i.e.
+    the wire serializer (and the delta codec downstream of it) can
+    carry an array of it. The trace pass (JGL105) proves every tick
+    publish output against this, so a program edit cannot route an
+    unencodable dtype at the wire only to fail at runtime."""
+    try:
+        return np.dtype(dtype) in _DA00_CODE
+    except TypeError:
+        return False
+
+
 def _dtype_code(arr: np.ndarray, table: dict) -> int:
     try:
         return table[arr.dtype]

@@ -514,3 +514,23 @@ class DetectorViewWorkflow:
     @property
     def state(self) -> HistogramState:
         return self._state
+
+
+#: Wire-schema contract (graftlint trace pass, JGL105 / ADR 0123):
+#: publish output name -> (ndim, dtype) as serialized on the da00
+#: wire. Pinned HERE, next to the publish program it constrains, so a
+#: program edit and its schema change ride the same diff — drift
+#: between the two breaks the delta codec's keyframe contract and is
+#: caught at lint time, not by a subscriber.
+TICK_WIRE_SCHEMA = {
+    "counts_cumulative": (0, "float32"),
+    "counts_current": (0, "float32"),
+    "counts_in_range_cumulative": (0, "float32"),
+    "counts_in_range_current": (0, "float32"),
+    "image_cumulative": (2, "float32"),
+    "image_current": (2, "float32"),
+    "roi_spectra": (2, "float32"),
+    "roi_spectra_cumulative": (2, "float32"),
+    "spectrum_cumulative": (1, "float32"),
+    "spectrum_current": (1, "float32"),
+}

@@ -368,3 +368,11 @@ class MonitorWorkflow:
         if "position" in arrays:
             self._position = float(arrays["position"])
         return True
+
+
+#: Wire-schema contract (graftlint trace pass, JGL105 / ADR 0123):
+#: output name -> (ndim, dtype); see detector_view/workflow.py.
+TICK_WIRE_SCHEMA = {
+    "cum": (1, "float32"),
+    "win": (1, "float32"),
+}

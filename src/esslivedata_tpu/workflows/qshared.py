@@ -240,3 +240,14 @@ class QStreamingMixin:
         self._trans_win = 0.0
         self._trans_cum = 0.0
         self._prefetched_publish = None
+
+
+#: Wire-schema contract (graftlint trace pass, JGL105 / ADR 0123) for
+#: every QHistogrammer-backed family publishing through _publisher():
+#: output name -> (ndim, dtype); see detector_view/workflow.py.
+TICK_WIRE_SCHEMA = {
+    "cum": (1, "float32"),
+    "mon_cum": (0, "float32"),
+    "mon_win": (0, "float32"),
+    "win": (1, "float32"),
+}

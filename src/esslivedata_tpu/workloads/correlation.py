@@ -234,3 +234,13 @@ class TimeseriesCorrelationWorkflow:
         if "publish_epoch" in arrays:
             self.publish_epoch = int(np.asarray(arrays["publish_epoch"]))
         return True
+
+
+#: Wire-schema contract (graftlint trace pass, JGL105 / ADR 0123):
+#: output name -> (ndim, dtype); see detector_view/workflow.py.
+TICK_WIRE_SCHEMA = {
+    "correlation": (2, "float32"),
+    "mean": (1, "float32"),
+    "samples": (0, "float32"),
+    "stddev": (1, "float32"),
+}

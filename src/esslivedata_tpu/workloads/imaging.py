@@ -282,3 +282,16 @@ class ImagingViewWorkflow:
     @property
     def state(self) -> HistogramState:
         return self._state
+
+
+#: Wire-schema contract (graftlint trace pass, JGL105 / ADR 0123):
+#: output name -> (ndim, dtype); see detector_view/workflow.py.
+TICK_WIRE_SCHEMA = {
+    "counts_cumulative": (0, "float32"),
+    "counts_current": (0, "float32"),
+    "flatfield": (2, "float32"),
+    "frame_counts_current": (1, "float32"),
+    "image_corrected": (2, "float32"),
+    "image_cumulative": (2, "float32"),
+    "image_current": (2, "float32"),
+}

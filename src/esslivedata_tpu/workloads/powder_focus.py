@@ -303,3 +303,15 @@ class PowderFocusWorkflow:
     @property
     def state(self) -> HistogramState:
         return self._state
+
+
+#: Wire-schema contract (graftlint trace pass, JGL105 / ADR 0123):
+#: output name -> (ndim, dtype); see detector_view/workflow.py.
+TICK_WIRE_SCHEMA = {
+    "acceptance": (2, "float32"),
+    "counts_cumulative": (0, "float32"),
+    "counts_current": (0, "float32"),
+    "dspacing_banked_cumulative": (2, "float32"),
+    "dspacing_cumulative": (1, "float32"),
+    "dspacing_current": (1, "float32"),
+}

@@ -1,13 +1,16 @@
 """graftlint — JAX-hazard and concurrency static analysis for the
 streaming hot path (docs/graftlint.md).
 
-Three passes share one run: the per-file rules (JGL001–JGL010 lexical;
+Four passes share one run: the per-file rules (JGL001–JGL010 lexical;
 JGL015–JGL022, the latter two dataflow-based on per-function CFGs —
 ``dataflow.py`` / docs/adr/0119), the whole-program pass (JGL011–JGL014,
 JGL023 — project symbol table, call graph, thread roles, blocking
-summaries; see ``project.py`` / docs/adr/0112), and the meta pass
-(JGL024 — the stale-suppression audit over the run's own
-pre-suppression findings). Every analyzed file contributes picklable
+summaries; see ``project.py`` / docs/adr/0112), the meta pass (JGL024 —
+the stale-suppression audit over the run's own pre-suppression
+findings), and the trace pass (JGL100-series — AOT-lowers the real
+tick programs and proves the 1-dispatch/donation/swap-stability
+contract; ``trace/`` / docs/adr/0123, CLI-driven via ``--trace``).
+Every analyzed file contributes picklable
 ``FileFacts`` to the project pass, so ``jobs > 1`` fans the
 parse+file-rules work across processes and only facts travel back.
 
@@ -181,6 +184,7 @@ def run_paths(
     select: frozenset[str] | None = None,
     jobs: int = 1,
     audit: bool = True,
+    extra_findings: list[Finding] | None = None,
 ) -> tuple[list[Finding], list[str]]:
     """Lint files/trees; returns (findings, path/parse errors).
 
@@ -194,6 +198,17 @@ def run_paths(
     look stale, so missing findings would CREATE findings and fail the
     gate on unrelated commits. Diff-mode callers disable the audit;
     the full-tree run judges the ledger.
+
+    ``extra_findings`` merges findings produced OUTSIDE the static
+    passes (the trace pass, which anchors its JGL10x findings at the
+    owning workflow files) into this run before suppression filtering
+    and the meta pass — so inline ``# graftlint: disable=JGL10x``
+    directives work on them, and the JGL024 audit judges the trace
+    suppression ledger against real trace findings. Callers that did
+    NOT run the producing pass must exclude its rule ids via
+    ``select`` (the CLI does), for the same inverted-soundness reason
+    as diff mode: absent findings would make live directives look
+    stale.
     """
     findings: list[Finding] = []
     errors: list[str] = []
@@ -223,6 +238,8 @@ def run_paths(
         findings.extend(file_findings)
         facts.append(file_facts)
         suppressions[path] = sup
+    if extra_findings:
+        findings.extend(extra_findings)
     findings.extend(_project_findings(ProjectContext(facts), select))
     if audit:
         findings.extend(_meta_findings(findings, suppressions, select))

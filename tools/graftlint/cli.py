@@ -154,6 +154,43 @@ def main(argv: list[str] | None = None) -> int:
         ),
     )
     parser.add_argument(
+        "--trace",
+        action="store_true",
+        help=(
+            "also run the trace pass (JGL100-series): AOT-lower every "
+            "registered tick program (JAX_PLATFORMS=cpu, no device) "
+            "and verify the 1-dispatch/donation/swap-stability/"
+            "callback/wire-schema contract (docs/adr/0123)"
+        ),
+    )
+    parser.add_argument(
+        "--trace-baseline",
+        default=None,
+        metavar="FILE",
+        help=(
+            "tickcontract baseline of pinned per-program contract "
+            "fingerprints (tickcontract-baseline.json); drift from it "
+            "is a JGL100 finding (implies --trace)"
+        ),
+    )
+    parser.add_argument(
+        "--trace-write-baseline",
+        action="store_true",
+        help=(
+            "snapshot current contract fingerprints into "
+            "--trace-baseline FILE and exit 0"
+        ),
+    )
+    parser.add_argument(
+        "--explain",
+        default=None,
+        metavar="JGLxxx",
+        help=(
+            "print one rule's documentation (summary + minimal "
+            "bad/good example from docs/graftlint.md) and exit"
+        ),
+    )
+    parser.add_argument(
         "--list-rules", action="store_true", help="print rule ids and exit"
     )
     parser.add_argument(
@@ -165,8 +202,20 @@ def main(argv: list[str] | None = None) -> int:
         for rule_id, rule in sorted(RULES.items()):
             print(f"{rule_id}  {rule.summary}")
         return 0
+    if args.explain:
+        from .explain import explain
+
+        text = explain(args.explain)
+        if text is None:
+            parser.error(f"unknown rule id: {args.explain}")
+        print(text)
+        return 0
     if args.write_baseline and not args.baseline:
         parser.error("--write-baseline requires --baseline FILE")
+    if args.trace_write_baseline and not args.trace_baseline:
+        parser.error("--trace-write-baseline requires --trace-baseline FILE")
+    if args.trace_baseline or args.trace_write_baseline:
+        args.trace = True
 
     select = (
         frozenset(s.strip() for s in args.select.split(",") if s.strip())
@@ -203,13 +252,84 @@ def main(argv: list[str] | None = None) -> int:
                 write_sarif(args.sarif, [], [])
             return 0
 
+    # Trace pass first (when enabled): its JGL10x findings anchor at
+    # the owning workflow files and ride the normal findings stream, so
+    # inline suppressions, the findings baseline, SARIF and the JGL024
+    # ledger audit all apply to them unchanged.
+    trace_findings: list = []
+    trace_errors: list[str] = []
+    if args.trace:
+        from .trace import run_trace
+
+        trace_baseline = None
+        if args.trace_baseline and not args.trace_write_baseline:
+            from .trace.contract_baseline import load_contract_baseline
+
+            try:
+                trace_baseline = load_contract_baseline(args.trace_baseline)
+            except (OSError, ValueError, KeyError, TypeError) as exc:
+                print(
+                    f"graftlint: bad tickcontract baseline: {exc}",
+                    file=sys.stderr,
+                )
+                return 1
+        report = run_trace(select=select, baseline=trace_baseline)
+        if report.skipped:
+            # Visible notice, never a silent pass: an environment that
+            # cannot lower (no jax) still gates on the static passes,
+            # but the log says exactly what did NOT run.
+            print(
+                f"graftlint: trace pass SKIPPED: {report.skipped}",
+                file=sys.stderr,
+            )
+        trace_findings = report.findings
+        trace_errors = report.errors
+        if args.trace_write_baseline:
+            if report.skipped or trace_errors:
+                for error in trace_errors:
+                    print(f"graftlint: {error}", file=sys.stderr)
+                print(
+                    "graftlint: tickcontract baseline NOT written "
+                    "(trace pass must run clean of errors first)",
+                    file=sys.stderr,
+                )
+                return 1
+            from .trace.contract_baseline import write_contract_baseline
+
+            write_contract_baseline(
+                args.trace_baseline, report.fingerprints
+            )
+            if not args.quiet:
+                print(
+                    f"graftlint: pinned {len(report.fingerprints)} "
+                    f"contract fingerprint(s) to {args.trace_baseline}"
+                )
+            return 0
+    elif select is None:
+        # The trace pass did not run, so its rules must not be judged
+        # by the JGL024 staleness audit (same inverted-soundness trap
+        # as diff mode: absent findings would make live trace-ledger
+        # directives look stale). Excluding the trace scope from the
+        # effective select leaves every static rule's behavior
+        # unchanged and tells the audit those rules did not run.
+        select = frozenset(
+            rule_id
+            for rule_id, rule in RULES.items()
+            if rule.scope != "trace"
+        )
+
     # The stale-suppression audit (JGL024) only runs on full views: in
     # diff mode, project rules starved of cross-file facts would make
     # live suppressions look stale — missing findings would CREATE
     # findings and block unrelated commits.
     findings, errors = run_paths(
-        lint_paths, select=select, jobs=jobs, audit=args.diff is None
+        lint_paths,
+        select=select,
+        jobs=jobs,
+        audit=args.diff is None,
+        extra_findings=trace_findings,
     )
+    errors.extend(trace_errors)
 
     if args.write_baseline:
         # Parse/path errors abort BEFORE writing: a snapshot taken over

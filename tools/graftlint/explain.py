@@ -1,0 +1,60 @@
+"""``--explain JGLxxx``: print one rule's documentation inline.
+
+The rule docs (docs/graftlint.md) are the single source of truth —
+each rule has a ``### JGLxxx — title`` section with a minimal bad/good
+example. This module extracts that section verbatim rather than
+duplicating prose in code: the doc a reviewer links and the doc the
+CLI prints can never diverge. A registered rule whose section is
+missing still explains from its registry summary (with a pointer to
+add the section), so ``--explain`` never dead-ends on a valid id.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from .registry import RULES
+
+#: docs/graftlint.md relative to the repo root (this file lives in
+#: tools/graftlint/).
+_DOCS = Path(__file__).resolve().parent.parent.parent / "docs" / "graftlint.md"
+
+_SECTION_RE = re.compile(r"^###\s+(JGL\d+)\b.*$", re.MULTILINE)
+
+
+def _sections(text: str) -> dict[str, str]:
+    """rule id -> its full ``###`` section (heading through the line
+    before the next ``###``/``##`` heading)."""
+    out: dict[str, str] = {}
+    matches = list(_SECTION_RE.finditer(text))
+    boundaries = [m.start() for m in matches] + [len(text)]
+    next_heading = re.compile(r"^##", re.MULTILINE)
+    for i, m in enumerate(matches):
+        start = m.start()
+        stop = boundaries[i + 1]
+        nxt = next_heading.search(text, m.end(), stop)
+        if nxt is not None:
+            stop = nxt.start()
+        out[m.group(1)] = text[start:stop].rstrip()
+    return out
+
+
+def explain(rule_id: str, docs_path: Path | None = None) -> str | None:
+    """The explanation text for ``rule_id``; None for an unknown rule
+    (the CLI turns that into a usage error — a typo'd id must not
+    print an empty success)."""
+    if rule_id not in RULES:
+        return None
+    path = docs_path or _DOCS
+    try:
+        section = _sections(path.read_text(encoding="utf-8")).get(rule_id)
+    except OSError:
+        section = None
+    if section is not None:
+        return section
+    return (
+        f"### {rule_id} — {RULES[rule_id].summary}\n\n"
+        f"(no docs/graftlint.md section yet — add one with a minimal "
+        f"bad/good example)"
+    )

@@ -11,6 +11,11 @@ Three rule scopes share one id namespace and one ``RULES`` table:
   rules about the *run itself* (JGL024 stale-suppression audit): they
   see every pre-suppression finding for a file plus its suppression
   directives, and run last, from the driver in ``__init__``.
+- ``scope="trace"`` — the JGL100-series contract rules. Their findings
+  come from the lowering engine (``trace/engine.py``), never from the
+  per-file/project dispatchers; the registry entry exists so rule
+  identity (``--select``/``--explain``/SARIF metadata/JGL024) works
+  even where jax is unavailable and the pass is skipped.
 
 Registration order is the report order for same-line findings, so
 register in id order.
@@ -66,3 +71,9 @@ def meta_rule(rule_id: str, summary: str) -> Callable[[Check], Check]:
     """Register a run-level ``check(path, suppressions, findings,
     select)`` applied per file after both analysis passes."""
     return _register(rule_id, summary, "meta")
+
+
+def trace_rule(rule_id: str, summary: str) -> Callable[[Check], Check]:
+    """Register a trace-pass rule id (JGL100-series). The check is a
+    placeholder — findings are produced by the lowering engine."""
+    return _register(rule_id, summary, "trace")

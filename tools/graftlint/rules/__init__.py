@@ -6,3 +6,4 @@ from . import (  # noqa: F401
     robustness_rules,
     whole_program,
 )
+from ..trace import rules as trace_rules  # noqa: F401  (JGL100-series)

@@ -1,0 +1,29 @@
+"""graftlint trace pass (JGL100-series): lower the real tick programs
+and prove the 1-dispatch / donation / swap-stability / no-callback /
+wire-schema contract without a device (ADR 0123).
+
+``rules`` registers the JGL10x ids (metadata only — importable
+everywhere); ``engine`` does the lowering and is imported lazily by
+the CLI so environments without jax still run the static passes and
+get a visible skip notice for this one.
+"""
+
+from __future__ import annotations
+
+from . import rules  # noqa: F401  (registers JGL100-series ids)
+
+__all__ = ["run_trace", "TraceReport"]
+
+
+def run_trace(**kwargs):
+    from .engine import run_trace as _run
+
+    return _run(**kwargs)
+
+
+def __getattr__(name: str):
+    if name == "TraceReport":
+        from .engine import TraceReport
+
+        return TraceReport
+    raise AttributeError(name)

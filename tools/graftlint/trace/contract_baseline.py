@@ -1,0 +1,73 @@
+"""Contract fingerprints: the tickcontract baseline.
+
+Where ``graftlint-baseline.json`` is a debt ledger of *findings*, the
+tickcontract baseline pins what is RIGHT: one fingerprint per program
+family — executable count, donated leaf positions, output avals, swap
+stability — so CI diffs contract *drift*, not just violations. A
+program edit that stays within the contract but changes its shape
+(new output, different donation set, a dtype change) shows up as a
+JGL100 finding until the baseline is regenerated with
+``--trace-write-baseline`` and the diff is reviewed like any other.
+
+Fingerprints are deliberately free of HLO text and object identity:
+they must be stable across machines and jax patch releases, so they
+record only what the contract rules themselves prove.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+_VERSION = 1
+
+
+def load_contract_baseline(path: str | Path) -> dict[str, dict]:
+    """family -> fingerprint. A missing file is the caller's error (a
+    typo'd path must not silently disable the drift gate)."""
+    data = json.loads(Path(path).read_text(encoding="utf-8"))
+    if not isinstance(data, dict) or data.get("version") != _VERSION:
+        raise ValueError(
+            f"{path}: not a tickcontract baseline (want version "
+            f"{_VERSION})"
+        )
+    programs = data.get("programs", {})
+    if not isinstance(programs, dict):
+        raise ValueError(f"{path}: 'programs' must be an object")
+    return programs
+
+
+def write_contract_baseline(
+    path: str | Path, fingerprints: dict[str, dict]
+) -> None:
+    payload = {
+        "version": _VERSION,
+        "programs": {k: fingerprints[k] for k in sorted(fingerprints)},
+    }
+    Path(path).write_text(
+        json.dumps(payload, indent=2, sort_keys=True) + "\n",
+        encoding="utf-8",
+    )
+
+
+def diff_fingerprint(family: str, current: dict, pinned: dict) -> list[str]:
+    """Human-readable drift lines for one family (empty = no drift).
+    Key-by-key, so a one-dtype edit reads as exactly that in CI instead
+    of two opaque JSON blobs."""
+    out: list[str] = []
+    for key in sorted(set(current) | set(pinned)):
+        if key not in pinned:
+            out.append(f"{key}: unpinned -> {current[key]!r}")
+        elif key not in current:
+            out.append(f"{key}: {pinned[key]!r} -> gone")
+        elif key == "outputs":
+            cur, pin = current[key], pinned[key]
+            for name in sorted(set(cur) | set(pin)):
+                if cur.get(name) != pin.get(name):
+                    out.append(
+                        f"output {name!r}: {pin.get(name)!r} -> "
+                        f"{cur.get(name)!r}"
+                    )
+        elif current[key] != pinned[key]:
+            out.append(f"{key}: {pinned[key]!r} -> {current[key]!r}")
+    return out

@@ -1,0 +1,55 @@
+"""JGL100-series rule registrations (the trace pass, ADR 0123).
+
+Metadata only: trace rules are driven by the lowering engine
+(``engine.py``), not dispatched per file/project like the static
+scopes, but they live in the one ``RULES`` table so ``--list-rules``,
+``--select`` validation, ``--explain``, SARIF rule metadata and the
+JGL024 stale-suppression audit all see them. This module imports
+neither jax nor the program registry — rule *identity* must exist even
+where the trace pass itself cannot run.
+"""
+
+from __future__ import annotations
+
+from ..registry import trace_rule
+
+
+def _engine_driven(*_args, **_kwargs):
+    """Trace checks run in ``trace.engine`` against lowered programs;
+    the registry entry carries identity and summary only."""
+    return ()
+
+
+for _rule_id, _summary in (
+    (
+        "JGL100",
+        "tick-program contract fingerprint drifted from the committed "
+        "tickcontract baseline",
+    ),
+    (
+        "JGL101",
+        "tick comprises more than one executable (hidden secondary "
+        "dispatch)",
+    ),
+    (
+        "JGL102",
+        "rolling-state buffer not donated in the lowered tick program "
+        "(or a shared staged array donated)",
+    ),
+    (
+        "JGL103",
+        "digest-keyed table swap changes the lowered program "
+        "(recompile on swap)",
+    ),
+    (
+        "JGL104",
+        "host callback (pure/io/debug_callback) or host transfer "
+        "inside the traced tick program",
+    ),
+    (
+        "JGL105",
+        "publish output avals drifted from the family's declared wire "
+        "schema",
+    ),
+):
+    trace_rule(_rule_id, _summary)(_engine_driven)
