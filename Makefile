@@ -60,8 +60,13 @@ docs:
 # way for core/; see docs/adr/0112) — plus the trace pass (ADR 0123):
 # every registered tick program is AOT-lowered (CPU backend, no
 # device) and its contract fingerprint is diffed against
-# tickcontract-baseline.json. No jax in the environment = a visible
-# SKIPPED notice from the trace pass, never a silent green.
+# tickcontract-baseline.json, with the lowering cache under build/
+# replaying an unchanged tree without importing jax — and the protocol
+# pass (ADR 0124): the checkpoint/replay/relay/fleet/epoch protocols
+# are model-checked over every interleaving and crash point, bound to
+# the real source by structural probes. No jax in the environment = a
+# visible SKIPPED notice from the trace pass and the protocol codec
+# leg, never a silent green.
 lint:
 	$(PY) -m compileall -q src/ tests/ tools/ bench.py __graft_entry__.py
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -70,7 +75,8 @@ lint:
 		echo "lint: ruff not installed, skipping (config in pyproject.toml)"; \
 	fi
 	$(PY) -m tools.graftlint src/ --jobs 0 --baseline graftlint-baseline.json \
-		--trace --trace-baseline tickcontract-baseline.json
+		--trace --trace-baseline tickcontract-baseline.json \
+		--trace-cache build/graftlint-trace-cache.json --protocol
 
 # Apply ruff autofixes, then report what graftlint still sees (graftlint
 # never rewrites code — its fixes are reviewed hunks by design).

@@ -327,6 +327,8 @@ class Job:
         self.add(data, start=start, end=end)
         return self.get()
 
+    # graft: protocol=epoch (ADR 0124: the state_epoch bumps below must
+    # reach every exit path — the modeled epoch-bump⇒keyframe guard)
     def clear(self) -> None:
         """Reset accumulation; starts a new generation (start_time jumps)."""
         if self.workflow is not None:

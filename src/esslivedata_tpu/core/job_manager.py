@@ -1720,6 +1720,8 @@ class JobManager:
             self._event_cache.end_window()
         return [r for r in results if r is not None]
 
+    # graft: protocol=fleet (ADR 0124: the per-group owns() consult
+    # below is the modeled filter of the single-owner invariant)
     def _apply_fleet_filter(
         self,
         work: list[tuple["_JobRecord", dict[str, Any]]],

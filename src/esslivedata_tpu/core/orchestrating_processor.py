@@ -404,6 +404,8 @@ class OrchestratingProcessor:
             self._maybe_checkpoint()
 
     # -- durability plane (durability/, ADR 0118) --------------------------
+    # graft: protocol=replay (ADR 0124: the quiescent gate below is the
+    # modeled guard of the exactly-once bookmark arithmetic)
     def _quiescent(self) -> bool:
         """True when every delivered message is in job state: no
         partial window buffered in the batcher, no window in flight in

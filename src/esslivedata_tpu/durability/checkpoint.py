@@ -82,6 +82,8 @@ def fsync_dir(directory: Path) -> None:
         os.close(fd)
 
 
+# graft: protocol=checkpoint (ADR 0124: every fsync/os.replace below is
+# a crash candidate in the model-checked write/GC protocol)
 def atomic_write(path: Path, payload: bytes) -> None:
     """The JGL020 discipline: write a tmp sibling, flush, fsync,
     rename over the final name, fsync the directory."""

@@ -57,6 +57,8 @@ _REPLAY_LAG = REGISTRY.gauge(
 )
 
 
+# graft: protocol=checkpoint (ADR 0124: this walk is the recovery
+# simulation the checkpoint crash model replays at every crash point)
 def load_latest_manifest(directory) -> dict | None:
     """The newest consistent, non-stale manifest as a dict, or None.
 

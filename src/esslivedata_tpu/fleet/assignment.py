@@ -132,6 +132,9 @@ class FleetAssignment:
             replicas = self._replicas
         return rendezvous_owner(replicas, self.group_key(stream, fuse_tag))
 
+    # graft: protocol=fleet (ADR 0124: the self_id compare below is the
+    # modeled ownership guard; rendezvous_owner itself is imported by
+    # the model, never reimplemented)
     def owns(self, stream: str, fuse_tag=None) -> bool:
         """True when THIS replica owns the group (requires
         ``self_id``). Counts the consult into the decision counter."""

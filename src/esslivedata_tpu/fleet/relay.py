@@ -138,6 +138,8 @@ class RelayChannel:
     def generation(self) -> int:
         return self._generation
 
+    # graft: protocol=relay (ADR 0124: the boot/epoch/seq classification
+    # below is the modeled resync protocol over <boot>:<epoch>:<seq>)
     def on_blob(
         self,
         blob: bytes,

@@ -1,0 +1,816 @@
+"""Explicit state-machine models of the guarded distributed protocols
+(graftlint protocol pass, JGL200-series — the ADR 0124 companion of
+``tick_contract.py``).
+
+Each model writes one protocol down as a tiny, explicitly-enumerable
+transition system whose *shape* mirrors the owning source module:
+
+- :class:`CheckpointModel` — the write-tmp/fsync/rename/gc discipline
+  of ``durability/checkpoint.py``, with a crash candidate at every
+  micro-step boundary (each ``os.replace``/fsync is one transition).
+- :class:`ReplayModel` — the quiescent-checkpoint + seek-to-bookmark
+  exactly-once arithmetic of ``core/orchestrating_processor.py`` and
+  ``durability/replay.py``.
+- :class:`RelayModel` — the resync classification of
+  ``fleet/relay.py`` over ``<boot>:<epoch>:<seq>`` ids.
+- :class:`FleetModel` — rendezvous ownership of
+  ``fleet/assignment.py`` under membership churn, using the REAL
+  :func:`~..fleet.assignment.rendezvous_owner` (the model checks the
+  protocol around the hash, never a reimplementation of the hash).
+- :class:`EpochModel` — the epoch-bump⇒keyframe discipline spanning
+  ``core/job.py`` and ``serving/delta.py``.
+
+Models are **parameterized by source-derived facts**: the protocol
+pass's binding layer (``tools/graftlint/protocol/bindings.py``)
+inspects the real functions with the v3 dataflow machinery and answers
+questions like "does ``atomic_write`` fsync before ``os.replace`` on
+every path?". A guard that is present keeps its transition in the
+model; a guard the source has lost WEAKENS the model, and exhaustive
+exploration then finds the interleaving/crash point the guard existed
+to exclude — reported with a minimal counterexample trace under the
+invariant's own rule id (JGL201–JGL204), not as generic drift.
+
+Crash semantics are *pessimistic and deterministic*: at a crash, every
+non-durable artifact is lost (a rename without a directory fsync is
+undone, file content never fsynced is torn). Sound for safety — the
+adversarial disk does the worst thing it is allowed to — and it keeps
+the crash branch singular, so state spaces stay in the hundreds.
+
+This module imports no jax and is importable everywhere the static
+passes run; only ``fleet.assignment`` (pure Python) is reached.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import ClassVar, Hashable, NamedTuple
+
+__all__ = [
+    "CheckpointModel",
+    "EpochModel",
+    "FleetModel",
+    "MODELS",
+    "ProtocolModel",
+    "ReplayModel",
+    "RelayModel",
+    "Step",
+    "build_model",
+]
+
+
+@dataclass(frozen=True)
+class Step:
+    """One enabled transition out of a state.
+
+    ``invisible`` marks a transition the explorer may use for
+    partial-order reduction: the model asserts it commutes with every
+    other enabled transition AND cannot change the invariant's verdict
+    on any state it is taken from (the ample-set conditions). Flag
+    conservatively — a wrongly-flagged transition hides interleavings.
+    """
+
+    label: str
+    target: Hashable
+    invisible: bool = False
+
+
+@dataclass
+class ProtocolModel:
+    """Base: a named, fact-parameterized transition system.
+
+    Subclasses define ``FACTS`` (every fact key they understand, all
+    defaulting True = "the guard is present in the source"), ``RULE``
+    (the invariant's finding code) and the three exploration hooks.
+    """
+
+    facts: dict[str, bool] = field(default_factory=dict)
+
+    NAME: ClassVar[str] = ""
+    RULE: ClassVar[str] = ""
+    FACTS: ClassVar[tuple[str, ...]] = ()
+
+    def __post_init__(self) -> None:
+        unknown = set(self.facts) - set(self.FACTS)
+        if unknown:
+            raise ValueError(
+                f"{self.NAME} model: unknown fact(s) {sorted(unknown)}"
+            )
+        merged = {key: True for key in self.FACTS}
+        merged.update(self.facts)
+        self.facts = merged
+
+    def fact(self, key: str) -> bool:
+        return self.facts[key]
+
+    # -- exploration hooks --------------------------------------------------
+    def initial(self) -> Hashable:
+        raise NotImplementedError
+
+    def steps(self, state: Hashable) -> list[Step]:
+        raise NotImplementedError
+
+    def invariant(self, state: Hashable) -> str | None:
+        """A violation message for ``state``, or None. Most models
+        stamp the message into the state at the offending transition
+        and just read it back here."""
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Model 1: checkpoint write/GC with crash points (JGL202)
+# ---------------------------------------------------------------------------
+
+#: Artifact lifecycle phases (one per ``atomic_write`` micro-step).
+_ABSENT, _TMP, _RENAMED, _DURABLE = 0, 1, 2, 3
+
+
+class _CkptState(NamedTuple):
+    pc: int
+    s2_phase: int
+    s2_synced: bool
+    m2_phase: int
+    m2_synced: bool
+    g1_present: bool
+    crashed: bool
+    crash_msg: str  # invariant verdict, computed at crash time
+
+
+class CheckpointModel(ProtocolModel):
+    """``CheckpointPlane.checkpoint`` as micro-steps over two artifacts
+    (the generation-2 state file and manifest) plus GC of generation 1,
+    starting from a durable generation 1 and ``keep=1`` (the smallest
+    retention where GC has teeth). A crash is enabled at every step
+    boundary; the invariant is JGL202's first clause: after ANY crash,
+    at least one fully-consistent generation is recoverable by the
+    ``load_latest_manifest`` fallback walk."""
+
+    NAME = "checkpoint"
+    RULE = "JGL202"
+    FACTS = (
+        "atomic_write.fsync_file",
+        "atomic_write.fsync_dir",
+        "checkpoint.states_before_manifest",
+        "checkpoint.gc_after_manifest",
+    )
+
+    def _program(self) -> list[str]:
+        """The writer's micro-step sequence, shaped by the facts: a
+        missing fsync drops its step, a wrong ordering reorders the
+        blocks exactly as the mutated source would execute them."""
+        write = ["write_tmp", "fsync_tmp", "rename", "fsync_dir"]
+        if not self.fact("atomic_write.fsync_file"):
+            write.remove("fsync_tmp")
+        if not self.fact("atomic_write.fsync_dir"):
+            write.remove("fsync_dir")
+        states = [f"state2.{op}" for op in write]
+        manifest = [f"manifest2.{op}" for op in write]
+        if not self.fact("checkpoint.states_before_manifest"):
+            # Manifest-first source order: the GC call keeps its place
+            # right after the manifest write inside checkpoint().
+            return manifest + ["gc_generation1"] + states
+        if not self.fact("checkpoint.gc_after_manifest"):
+            return states + ["gc_generation1"] + manifest
+        return states + manifest + ["gc_generation1"]
+
+    def initial(self) -> _CkptState:
+        return _CkptState(0, _ABSENT, False, _ABSENT, False, True, False, "")
+
+    def _apply(self, state: _CkptState, op: str) -> _CkptState:
+        if op == "gc_generation1":
+            return state._replace(g1_present=False)
+        artifact, micro = op.split(".")
+        phase_f, sync_f = (
+            ("s2_phase", "s2_synced")
+            if artifact == "state2"
+            else ("m2_phase", "m2_synced")
+        )
+        phase = getattr(state, phase_f)
+        synced = getattr(state, sync_f)
+        if micro == "write_tmp":
+            phase, synced = _TMP, False
+        elif micro == "fsync_tmp":
+            synced = True
+        elif micro == "rename":
+            phase = _RENAMED
+        elif micro == "fsync_dir":
+            phase = _DURABLE
+        return state._replace(**{phase_f: phase, sync_f: synced})
+
+    @staticmethod
+    def _after_crash(phase: int, synced: bool) -> str:
+        """What the adversarial disk leaves of one artifact: 'ok',
+        'torn' (entry survived, content never fsynced) or 'absent'."""
+        if phase == _DURABLE:
+            return "ok" if synced else "torn"
+        return "absent"
+
+    def _recoverable(self, state: _CkptState) -> bool:
+        """``load_latest_manifest``'s walk over the post-crash disk:
+        newest manifest first, a generation counts only when its
+        manifest is readable AND its state file matches the digest."""
+        m2 = self._after_crash(state.m2_phase, state.m2_synced)
+        s2 = self._after_crash(state.s2_phase, state.s2_synced)
+        if m2 == "ok" and s2 == "ok":
+            return True
+        # Torn/absent newest generation: fall back to generation 1.
+        return state.g1_present
+
+    def steps(self, state: _CkptState) -> list[Step]:
+        if state.crashed:
+            return []
+        program = self._program()
+        out: list[Step] = []
+        if state.pc < len(program):
+            op = program[state.pc]
+            out.append(
+                Step(op, self._apply(state, op)._replace(pc=state.pc + 1))
+            )
+        # A crash candidate at every os.replace/fsync boundary (and
+        # everywhere between): the defining feature of the model.
+        crashed = state._replace(crashed=True)
+        if not self._recoverable(state):
+            crashed = crashed._replace(
+                crash_msg=(
+                    "a crash here leaves NO consistent checkpoint "
+                    "generation on disk (newest manifest torn or its "
+                    "state file unrecoverable, older generation "
+                    "already garbage-collected)"
+                )
+            )
+        out.append(Step("crash", crashed))
+        return out
+
+    def invariant(self, state: _CkptState) -> str | None:
+        return state.crash_msg or None
+
+
+# ---------------------------------------------------------------------------
+# Model 2: restore/replay exactly-once bookmark arithmetic (JGL202)
+# ---------------------------------------------------------------------------
+
+
+class _ReplayState(NamedTuple):
+    next_consume: int
+    pending: tuple[int, ...]  # batcher queue (message ids, in order)
+    inflight: tuple[int, ...]  # pipeline queue
+    counts: tuple[int, ...]  # per-message apply count (the state)
+    ckpt: tuple[int, tuple[int, ...]] | None  # (bookmark, counts)
+    crashed: bool
+    crashes_left: int
+
+
+_N_MESSAGES = 3
+
+
+class ReplayModel(ProtocolModel):
+    """Consume → batch → apply over three messages, with checkpoint,
+    crash and restore+replay transitions. ``_maybe_checkpoint``'s
+    quiescence gate (batcher pending == 0, pipeline inflight == 0) is
+    the modeled guard: without it a bookmark taken mid-window names an
+    offset ahead of the dumped state, and the replay silently skips
+    the buffered tail. Invariant (JGL202, second clause): every
+    message is applied exactly once by the time the stream drains."""
+
+    NAME = "replay"
+    RULE = "JGL202"
+    FACTS = ("checkpoint.quiescent_gate",)
+
+    def initial(self) -> _ReplayState:
+        return _ReplayState(0, (), (), (0,) * _N_MESSAGES, None, False, 1)
+
+    def steps(self, state: _ReplayState) -> list[Step]:
+        out: list[Step] = []
+        if state.crashed:
+            bookmark, counts = state.ckpt if state.ckpt else (0, (0,) * _N_MESSAGES)
+            out.append(
+                Step(
+                    "restore_and_seek",
+                    state._replace(
+                        next_consume=bookmark,
+                        pending=(),
+                        inflight=(),
+                        counts=counts,
+                        crashed=False,
+                    ),
+                )
+            )
+            return out
+        if state.next_consume < _N_MESSAGES:
+            out.append(
+                Step(
+                    f"consume_m{state.next_consume}",
+                    state._replace(
+                        next_consume=state.next_consume + 1,
+                        pending=state.pending + (state.next_consume,),
+                    ),
+                )
+            )
+        if state.pending:
+            out.append(
+                Step(
+                    f"close_batch_m{state.pending[0]}",
+                    state._replace(
+                        pending=state.pending[1:],
+                        inflight=state.inflight + (state.pending[0],),
+                    ),
+                )
+            )
+        if state.inflight:
+            msg = state.inflight[0]
+            counts = list(state.counts)
+            counts[msg] += 1
+            out.append(
+                Step(
+                    f"apply_m{msg}",
+                    state._replace(
+                        inflight=state.inflight[1:], counts=tuple(counts)
+                    ),
+                )
+            )
+        quiescent = not state.pending and not state.inflight
+        if (quiescent or not self.fact("checkpoint.quiescent_gate")) and (
+            state.ckpt != (state.next_consume, state.counts)
+        ):
+            out.append(
+                Step(
+                    "checkpoint",
+                    state._replace(
+                        ckpt=(state.next_consume, state.counts)
+                    ),
+                )
+            )
+        if state.crashes_left > 0:
+            out.append(
+                Step(
+                    "crash",
+                    state._replace(
+                        crashed=True, crashes_left=state.crashes_left - 1
+                    ),
+                )
+            )
+        return out
+
+    def invariant(self, state: _ReplayState) -> str | None:
+        for msg, count in enumerate(state.counts):
+            if count > 1:
+                return (
+                    f"message {msg} applied {count} times — replay from "
+                    "the bookmark re-delivered data the restored state "
+                    "already contains"
+                )
+        drained = (
+            state.next_consume == _N_MESSAGES
+            and not state.pending
+            and not state.inflight
+            and not state.crashed
+        )
+        if drained:
+            lost = [m for m, c in enumerate(state.counts) if c == 0]
+            if lost:
+                return (
+                    f"message(s) {lost} never applied — the checkpoint "
+                    "bookmark ran ahead of the dumped state (taken "
+                    "while windows were still buffered/in flight), so "
+                    "the restart seeked past them"
+                )
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Model 3: relay resync classification over <boot>:<epoch>:<seq> (JGL203)
+# ---------------------------------------------------------------------------
+
+
+class _RelayState(NamedTuple):
+    # upstream hub
+    boot: int
+    epoch: int
+    seq: int
+    lineage: int  # accumulation-content identity
+    next_lineage: int
+    # relay channel
+    last_boot: int | None
+    last_epoch: int | None
+    last_seq: int | None
+    generation: int
+    dec_lineage: int | None
+    dec_epoch: int | None
+    dec_seq: int | None
+    # downstream subscriber
+    down_token: tuple[int, int] | None  # (generation, epoch)
+    down_lineage: int | None
+    # plumbing + budgets
+    connected: bool
+    sends_left: int
+    restarts_left: int
+    violation: str
+
+
+class RelayModel(ProtocolModel):
+    """One upstream hub, one relay channel, one downstream subscriber.
+    The hub ticks deltas, loses frames, and restarts — either restoring
+    its accumulation (durability) or coming back EMPTY with numbering
+    that happens to look contiguous, the case only the boot id can
+    catch. The relay runs ``RelayChannel.on_blob``'s classification,
+    fact-weakened where the source lost a guard. Invariant (JGL203):
+    downstream never receives content from a different upstream
+    incarnation under an unchanged ``(generation, epoch)`` token, and a
+    fresh keyframe is never discarded as stale (the park)."""
+
+    NAME = "relay"
+    RULE = "JGL203"
+    FACTS = (
+        "on_blob.checks_boot",
+        "on_blob.bumps_generation",
+        "on_blob.stale_excludes_keyframes",
+    )
+
+    def initial(self) -> _RelayState:
+        return _RelayState(
+            boot=0, epoch=0, seq=0, lineage=0, next_lineage=1,
+            last_boot=None, last_epoch=None, last_seq=None,
+            generation=0, dec_lineage=None, dec_epoch=None, dec_seq=None,
+            down_token=None, down_lineage=None,
+            connected=False, sends_left=4, restarts_left=1, violation="",
+        )
+
+    # -- RelayChannel.on_blob, fact-parameterized ---------------------------
+    def _deliver(
+        self, state: _RelayState, *, keyframe: bool, after_reconnect: bool
+    ) -> _RelayState:
+        epoch, seq, lineage = state.epoch, state.seq, state.lineage
+        restarted = (
+            self.fact("on_blob.checks_boot")
+            and state.last_boot is not None
+            and state.boot != state.last_boot
+        )
+        generation = state.generation
+        dec_lineage, dec_epoch, dec_seq = (
+            state.dec_lineage, state.dec_epoch, state.dec_seq,
+        )
+        if after_reconnect and keyframe and (
+            restarted
+            or (
+                state.last_epoch is not None
+                and (
+                    epoch != state.last_epoch
+                    or seq < (state.last_seq or 0)
+                )
+            )
+        ):
+            # Hard resync: signal the discontinuity downstream.
+            if self.fact("on_blob.bumps_generation"):
+                generation += 1
+            dec_lineage = dec_epoch = dec_seq = None
+        stale = (
+            (
+                not keyframe
+                if self.fact("on_blob.stale_excludes_keyframes")
+                else True
+            )
+            and epoch == state.last_epoch
+            and state.last_seq is not None
+            and seq <= state.last_seq
+        )
+        violation = state.violation
+        publish = False
+        spliced = False
+        if keyframe:
+            dec_lineage, dec_epoch, dec_seq = lineage, epoch, seq
+            publish = True
+        else:
+            if dec_epoch is None or epoch != dec_epoch:
+                # DeltaError on a delta: unrecoverable gap — signal the
+                # caller to resubscribe (connection drops, keyframe on
+                # reattach). Never reaches publish.
+                return state._replace(connected=False)
+            if seq <= (dec_seq or 0):
+                publish = False  # decoder holds this tick already
+            elif seq != (dec_seq or 0) + 1:
+                return state._replace(connected=False)
+            else:
+                spliced = dec_lineage != lineage
+                dec_lineage, dec_seq = lineage, seq
+                publish = True
+        state = state._replace(
+            last_boot=state.boot, last_epoch=epoch, last_seq=seq,
+            generation=generation,
+            dec_lineage=dec_lineage, dec_epoch=dec_epoch, dec_seq=dec_seq,
+        )
+        if stale:
+            if keyframe and not violation:
+                violation = (
+                    "a fresh keyframe was discarded as stale — the "
+                    "relay parks on the restarted hub's pre-restart "
+                    "frame and never recovers"
+                )
+            return state._replace(violation=violation)
+        if not publish:
+            return state
+        token = (generation, epoch)
+        if not violation and spliced:
+            violation = (
+                "a delta from a different upstream incarnation was "
+                "spliced onto the held frame — the restarted hub's "
+                "numbering looked contiguous and nothing checked the "
+                "boot id"
+            )
+        if (
+            not violation
+            and state.down_token == token
+            and state.down_lineage is not None
+            and state.down_lineage != lineage
+        ):
+            violation = (
+                "downstream received a DIFFERENT accumulation under an "
+                "UNCHANGED (generation, epoch) token — an unsignaled "
+                "reset spliced into the delta stream"
+            )
+        return state._replace(
+            down_token=token, down_lineage=lineage, violation=violation
+        )
+
+    def steps(self, state: _RelayState) -> list[Step]:
+        if state.violation:
+            return []  # absorbing: the counterexample ends here
+        out: list[Step] = []
+        if state.connected and state.sends_left > 0:
+            ticked = state._replace(
+                seq=state.seq + 1, sends_left=state.sends_left - 1
+            )
+            out.append(
+                Step(
+                    "hub_tick_delta",
+                    self._deliver(
+                        ticked, keyframe=False, after_reconnect=False
+                    ),
+                )
+            )
+            # The frame never arrives (coalesced/lost): the next
+            # delivery has a seq gap.
+            out.append(Step("hub_tick_lost", ticked))
+        if not state.connected:
+            out.append(
+                Step(
+                    "reconnect_keyframe",
+                    self._deliver(
+                        state._replace(connected=True),
+                        keyframe=True,
+                        after_reconnect=True,
+                    ),
+                )
+            )
+        if state.restarts_left > 0:
+            restarted = state._replace(
+                boot=state.boot + 1,
+                connected=False,
+                restarts_left=state.restarts_left - 1,
+            )
+            # Durability restore: the accumulation genuinely continues.
+            out.append(Step("hub_restart_restored", restarted))
+            # Fresh process, EMPTY state, plausible numbering: the wire
+            # cannot distinguish this from the restore — only the boot
+            # id can.
+            out.append(
+                Step(
+                    "hub_restart_empty",
+                    restarted._replace(
+                        lineage=state.next_lineage,
+                        next_lineage=state.next_lineage + 1,
+                    ),
+                )
+            )
+        return out
+
+    def invariant(self, state: _RelayState) -> str | None:
+        return state.violation or None
+
+
+# ---------------------------------------------------------------------------
+# Model 4: rendezvous fleet ownership under membership churn (JGL201)
+# ---------------------------------------------------------------------------
+
+
+class _FleetState(NamedTuple):
+    version: int
+    views: tuple[int, ...]  # per-replica membership-view version
+
+
+class FleetModel(ProtocolModel):
+    """Three replicas, a membership history (join then leave), each
+    replica applying membership events at its own pace. Ownership per
+    group uses the REAL ``rendezvous_owner``. Invariant (JGL201),
+    checked at quiescent states (every view converged): each group is
+    processed by EXACTLY one live replica — never two (overlapping
+    accumulation), never zero (dropped stream) — matching the paper
+    system's single-writer-per-source contract."""
+
+    NAME = "fleet"
+    RULE = "JGL201"
+    FACTS = ("owns.compares_self", "filter.consults_owns")
+
+    #: Membership history: r3 joins, then r2 departs (a departing
+    #: replica stops — ``set_replicas`` raises on self-departure, the
+    #: structurally-bound guard).
+    VERSIONS: tuple[tuple[str, ...], ...] = (
+        ("r1", "r2"),
+        ("r1", "r2", "r3"),
+        ("r1", "r3"),
+    )
+    REPLICAS: tuple[str, ...] = ("r1", "r2", "r3")
+    GROUPS: tuple[str, ...] = ("det0", "mon0", "sans0|('q', 1)")
+
+    def initial(self) -> _FleetState:
+        return _FleetState(0, (0,) * len(self.REPLICAS))
+
+    def _processes(self, state: _FleetState, idx: int, group: str) -> bool:
+        from ..fleet.assignment import rendezvous_owner
+
+        replica = self.REPLICAS[idx]
+        roster = self.VERSIONS[state.views[idx]]
+        if replica not in roster:
+            return False  # departed replicas stop; they own nothing
+        if not self.fact("filter.consults_owns"):
+            return True  # the window path lost its ownership filter
+        if not self.fact("owns.compares_self"):
+            return True  # owns() no longer compares against self_id
+        return rendezvous_owner(roster, group) == replica
+
+    def steps(self, state: _FleetState) -> list[Step]:
+        out: list[Step] = []
+        for idx in range(len(self.REPLICAS)):
+            if state.views[idx] < state.version:
+                views = list(state.views)
+                views[idx] += 1
+                out.append(
+                    Step(
+                        f"{self.REPLICAS[idx]}_applies_v{views[idx]}",
+                        state._replace(views=tuple(views)),
+                        # Ample-set safe: advances only move this
+                        # replica's view toward the current version
+                        # (confluent with each other and with later
+                        # membership events), and the invariant only
+                        # judges quiescent states, which every
+                        # reduced path still reaches.
+                        invisible=True,
+                    )
+                )
+        if state.version < len(self.VERSIONS) - 1:
+            out.append(
+                Step(
+                    f"membership_event_v{state.version + 1}",
+                    state._replace(version=state.version + 1),
+                )
+            )
+        return out
+
+    def invariant(self, state: _FleetState) -> str | None:
+        if any(view != state.version for view in state.views):
+            return None  # churn in progress: replay covers the overlap
+        for group in self.GROUPS:
+            owners = [
+                self.REPLICAS[idx]
+                for idx in range(len(self.REPLICAS))
+                if self._processes(state, idx, group)
+            ]
+            if len(owners) > 1:
+                return (
+                    f"group {group!r} processed by {owners} after "
+                    "quiesce — two replicas accumulate the same "
+                    "stream and publish diverging views"
+                )
+            if not owners:
+                return (
+                    f"group {group!r} processed by NO replica after "
+                    "quiesce — the stream silently stops"
+                )
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Model 5: epoch-bump ⇒ keyframe discipline (JGL204)
+# ---------------------------------------------------------------------------
+
+
+class _EpochState(NamedTuple):
+    lineage: int
+    state_epoch: int
+    publish_epoch: int
+    enc_token: int | None
+    down_lineage: int | None
+    publishes_left: int
+    clear_left: int
+    lost_left: int
+    swap_left: int
+    violation: str
+
+
+class EpochModel(ProtocolModel):
+    """The job's content lineage vs the epoch token the serving tier
+    compares: ``clear()``/``note_state_lost()`` bump ``state_epoch``,
+    a calibration swap bumps the workflow's ``publish_epoch``,
+    ``Job.get()`` folds both into the published token, and the delta
+    encoder keyframes whenever the token changes. Invariant (JGL204):
+    every state-mutating path publishes an epoch bump before the next
+    frame — a delta never bridges two accumulations."""
+
+    NAME = "epoch"
+    RULE = "JGL204"
+    FACTS = (
+        "clear.bumps_epoch",
+        "note_state_lost.bumps_epoch",
+        "get.folds_publish_epoch",
+        "encoder.keyframes_on_epoch_change",
+    )
+
+    def initial(self) -> _EpochState:
+        return _EpochState(0, 0, 0, None, None, 3, 1, 1, 1, "")
+
+    def steps(self, state: _EpochState) -> list[Step]:
+        if state.violation:
+            return []
+        out: list[Step] = []
+        if state.publishes_left > 0:
+            token = state.state_epoch + (
+                state.publish_epoch
+                if self.fact("get.folds_publish_epoch")
+                else 0
+            )
+            keyframe = state.enc_token is None or (
+                token != state.enc_token
+                and self.fact("encoder.keyframes_on_epoch_change")
+            )
+            nxt = state._replace(
+                enc_token=token, publishes_left=state.publishes_left - 1
+            )
+            if keyframe:
+                nxt = nxt._replace(down_lineage=state.lineage)
+            elif (
+                state.down_lineage is not None
+                and state.down_lineage != state.lineage
+            ):
+                nxt = nxt._replace(
+                    violation=(
+                        "a DELTA was published across a state "
+                        "discontinuity — the mutation reached the next "
+                        "frame without an epoch bump, so the decoder "
+                        "splices two unrelated accumulations"
+                    )
+                )
+            else:
+                nxt = nxt._replace(down_lineage=state.lineage)
+            out.append(
+                Step("publish_" + ("keyframe" if keyframe else "delta"), nxt)
+            )
+        if state.clear_left > 0:
+            nxt = state._replace(
+                lineage=state.lineage + 1, clear_left=state.clear_left - 1
+            )
+            if self.fact("clear.bumps_epoch"):
+                nxt = nxt._replace(state_epoch=state.state_epoch + 1)
+            out.append(Step("job_clear", nxt))
+        if state.lost_left > 0:
+            nxt = state._replace(
+                lineage=state.lineage + 1, lost_left=state.lost_left - 1
+            )
+            if self.fact("note_state_lost.bumps_epoch"):
+                nxt = nxt._replace(state_epoch=state.state_epoch + 1)
+            out.append(Step("note_state_lost", nxt))
+        if state.swap_left > 0:
+            out.append(
+                Step(
+                    "calibration_swap",
+                    state._replace(
+                        lineage=state.lineage + 1,
+                        publish_epoch=state.publish_epoch + 1,
+                        swap_left=state.swap_left - 1,
+                    ),
+                )
+            )
+        return out
+
+    def invariant(self, state: _EpochState) -> str | None:
+        return state.violation or None
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+MODELS: dict[str, type[ProtocolModel]] = {
+    cls.NAME: cls
+    for cls in (
+        CheckpointModel,
+        ReplayModel,
+        RelayModel,
+        FleetModel,
+        EpochModel,
+    )
+}
+
+
+def build_model(name: str, facts: dict[str, bool] | None = None) -> ProtocolModel:
+    """Instantiate one model with source-derived facts (missing keys
+    default to True — the guard is assumed present until a binding
+    proves otherwise)."""
+    return MODELS[name](facts=dict(facts or {}))

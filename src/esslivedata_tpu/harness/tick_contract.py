@@ -31,6 +31,7 @@ lowerings byte for byte.
 
 from __future__ import annotations
 
+import dataclasses
 import importlib
 import inspect
 from collections.abc import Callable, Mapping
@@ -108,6 +109,14 @@ class TickProgramSpec:
     #: What the ``"swap"`` variant swaps (None: the family has no
     #: digest-keyed table and JGL103 does not apply).
     swap_variant: str | None = None
+    #: The factored halves of ``build``: ``make_workflow(variant)``
+    #: constructs the synthetic workflow instance, ``assemble(wf)``
+    #: turns one into the tick program. ``build`` is their composition.
+    #: The protocol pass (JGL205) needs them separately: it dumps one
+    #: instance's state into a second and re-assembles, proving the
+    #: checkpoint codec round-trips the family at lowering level.
+    make_workflow: Callable[[str], object] | None = None
+    assemble: Callable[[object], TickProgramBuild] | None = None
 
     def source_location(self) -> tuple[str, int]:
         """(repo-relative path, line) of the owning workflow class;
@@ -144,20 +153,40 @@ def register_tick_program(
     anchor: str,
     wire_schema: Mapping[str, tuple[int, str]],
     swap_variant: str | None = None,
+    stream: str | None = None,
 ) -> Callable:
-    """Register ``build(variant) -> TickProgramBuild`` for a family."""
+    """Register ``make_workflow(variant) -> workflow`` for a family.
 
-    def register(build: Callable[[str], TickProgramBuild]):
+    ``stream`` names the synthetic event stream the tick ingests (an
+    event family assembles via :func:`event_family_build`); None marks
+    a publish-only family (:func:`publish_family_build`). The spec's
+    ``build`` stays the one-call composition the trace pass lowers;
+    the factored halves let the protocol pass re-assemble a restored
+    instance (JGL205)."""
+
+    def register(make_workflow: Callable[[str], object]):
         if family in REGISTRY:
             raise ValueError(f"duplicate tick-contract family {family!r}")
+        if stream is None:
+            def assemble(workflow) -> TickProgramBuild:
+                return publish_family_build(workflow)
+        else:
+            def assemble(workflow) -> TickProgramBuild:
+                return event_family_build(workflow, stream=stream)
+
+        def build(variant: str) -> TickProgramBuild:
+            return assemble(make_workflow(variant))
+
         REGISTRY[family] = TickProgramSpec(
             family=family,
             build=build,
             wire_schema=dict(wire_schema),
             anchor=anchor,
             swap_variant=swap_variant,
+            make_workflow=make_workflow,
+            assemble=assemble,
         )
-        return build
+        return make_workflow
 
     return register
 
@@ -328,26 +357,26 @@ def _logical_grid(*, swapped: bool = False) -> np.ndarray:
     "DetectorViewWorkflow",
     wire_schema={},  # installed below, next to the family module's pin
     swap_variant="projection LUT rebuilt from a flipped logical grid",
+    stream="det0",
 )
-def _build_detector_view(variant: str) -> TickProgramBuild:
+def _make_detector_view(variant: str):
     from ..workflows.detector_view.projectors import project_logical
     from ..workflows.detector_view.workflow import DetectorViewWorkflow
 
     projection = project_logical(_logical_grid(swapped=variant == "swap"))
-    return event_family_build(
-        DetectorViewWorkflow(projection=projection), stream="det0"
-    )
+    return DetectorViewWorkflow(projection=projection)
 
 
 @register_tick_program(
     "monitor",
     anchor="esslivedata_tpu.workflows.monitor_workflow:MonitorWorkflow",
     wire_schema={},
+    stream="mon0",
 )
-def _build_monitor(variant: str) -> TickProgramBuild:
+def _make_monitor(variant: str):
     from ..workflows.monitor_workflow import MonitorWorkflow
 
-    return event_family_build(MonitorWorkflow(), stream="mon0")
+    return MonitorWorkflow()
 
 
 @register_tick_program(
@@ -355,8 +384,9 @@ def _build_monitor(variant: str) -> TickProgramBuild:
     anchor="esslivedata_tpu.workflows.sans:SansIQWorkflow",
     wire_schema={},
     swap_variant="Q map rebuilt under a shifted beam centre",
+    stream="det0",
 )
-def _build_q_sans(variant: str) -> TickProgramBuild:
+def _make_q_sans(variant: str):
     from ..workflows.sans import SansIQParams, SansIQWorkflow
 
     n_pix = 64
@@ -371,12 +401,11 @@ def _build_q_sans(variant: str) -> TickProgramBuild:
     params = SansIQParams(
         beam_center_x=0.01 if variant == "swap" else 0.0
     )
-    workflow = SansIQWorkflow(
+    return SansIQWorkflow(
         positions=positions,
         pixel_ids=np.arange(n_pix),
         params=params,
     )
-    return event_family_build(workflow, stream="det0")
 
 
 @register_tick_program(
@@ -384,8 +413,9 @@ def _build_q_sans(variant: str) -> TickProgramBuild:
     anchor="esslivedata_tpu.workloads.powder_focus:PowderFocusWorkflow",
     wire_schema={},
     swap_variant="calibration epoch bumped via with_columns(difc=...)",
+    stream="det0",
 )
-def _build_powder_focus(variant: str) -> TickProgramBuild:
+def _make_powder_focus(variant: str):
     from ..workloads.calibration import CalibrationTable
     from ..workloads.powder_focus import PowderFocusWorkflow
 
@@ -402,9 +432,7 @@ def _build_powder_focus(variant: str) -> TickProgramBuild:
         table = table.with_columns(
             difc=np.asarray(table.columns["difc"]) * 1.01
         )
-    return event_family_build(
-        PowderFocusWorkflow(calibration=table), stream="det0"
-    )
+    return PowderFocusWorkflow(calibration=table)
 
 
 @register_tick_program(
@@ -412,8 +440,9 @@ def _build_powder_focus(variant: str) -> TickProgramBuild:
     anchor="esslivedata_tpu.workloads.imaging:ImagingViewWorkflow",
     wire_schema={},
     swap_variant="flat-field table swapped via set_flatfield's epoch",
+    stream="det0",
 )
-def _build_imaging(variant: str) -> TickProgramBuild:
+def _make_imaging(variant: str):
     from ..workloads.calibration import CalibrationTable
     from ..workloads.imaging import ImagingViewWorkflow
 
@@ -425,10 +454,7 @@ def _build_imaging(variant: str) -> TickProgramBuild:
     calibration = CalibrationTable(
         name="contract_ff", version=1, columns={"flatfield": flat}
     )
-    return event_family_build(
-        ImagingViewWorkflow(detector_number=det, calibration=calibration),
-        stream="det0",
-    )
+    return ImagingViewWorkflow(detector_number=det, calibration=calibration)
 
 
 @register_tick_program(
@@ -437,12 +463,10 @@ def _build_imaging(variant: str) -> TickProgramBuild:
     "TimeseriesCorrelationWorkflow",
     wire_schema={},
 )
-def _build_correlation(variant: str) -> TickProgramBuild:
+def _make_correlation(variant: str):
     from ..workloads.correlation import TimeseriesCorrelationWorkflow
 
-    return publish_family_build(
-        TimeseriesCorrelationWorkflow(streams=("a", "b", "c"))
-    )
+    return TimeseriesCorrelationWorkflow(streams=("a", "b", "c"))
 
 
 def _install_wire_schemas() -> None:
@@ -463,13 +487,8 @@ def _install_wire_schemas() -> None:
     for family, module_name in anchors.items():
         module = importlib.import_module(module_name)
         schema = getattr(module, "TICK_WIRE_SCHEMA")
-        spec = REGISTRY[family]
-        REGISTRY[family] = TickProgramSpec(
-            family=spec.family,
-            build=spec.build,
-            wire_schema=dict(schema),
-            anchor=spec.anchor,
-            swap_variant=spec.swap_variant,
+        REGISTRY[family] = dataclasses.replace(
+            REGISTRY[family], wire_schema=dict(schema)
         )
 
 

@@ -179,6 +179,8 @@ class DeltaEncoder:
     def seq(self) -> int | None:
         return self._seq
 
+    # graft: protocol=epoch (ADR 0124: the epoch-change keyframe branch
+    # below is the serving half of the modeled epoch discipline)
     def encode(self, frame: bytes, *, epoch: int, seq: int) -> bytes:
         """The blob for this tick: a delta against the previous frame,
         or a keyframe on the first frame, an epoch change (layout swap /

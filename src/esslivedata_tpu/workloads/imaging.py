@@ -147,6 +147,8 @@ class ImagingViewWorkflow:
         calib = "none" if self._calib is None else self._calib.digest
         return f"{self._hist.layout_digest}:{calib}"
 
+    # graft: protocol=epoch (ADR 0124: a flat-field swap is a modeled
+    # state mutation — publish_epoch must bump before the next frame)
     def set_flatfield(self, calibration: CalibrationTable) -> bool:
         """Swap the flat-field correction live. The map is a publish-
         program ARGUMENT (ADR 0105), so the swap is one device transfer

@@ -129,6 +129,8 @@ class PowderFocusWorkflow:
     def histogrammer(self) -> CalibratedHistogrammer:
         return self._hist
 
+    # graft: protocol=epoch (ADR 0124: a calibration swap is a modeled
+    # state mutation — publish_epoch must bump before the next frame)
     def set_calibration(self, table: CalibrationTable) -> bool:
         """Adopt a new calibration epoch live: counts persist, the
         digest re-keys staging/tick/static caches, the acceptance

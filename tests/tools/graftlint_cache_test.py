@@ -1,0 +1,183 @@
+"""Lowering-cache suite (ADR 0124 satellite of ADR 0123): the trace
+pass's source-digest cache must replay an unchanged tree byte-for-byte
+(findings, fingerprints) without lowering, miss on ANY relevant source
+edit or version change, and never store a run that skipped or errored
+— a cached skip replayed as green would be the exact silent-pass
+failure the visible SKIPPED notice exists to prevent.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tools.graftlint.lowering_cache import (
+    load_cache,
+    source_digest,
+    store_cache,
+)
+
+# -- digest semantics -------------------------------------------------------
+
+
+def _tree(tmp_path, content: str):
+    root = tmp_path / "repo"
+    pkg = root / "src" / "esslivedata_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "mod.py").write_text(content)
+    (root / "tools" / "graftlint").mkdir(parents=True)
+    (root / "tools" / "graftlint" / "lint.py").write_text("x = 1\n")
+    return root
+
+
+def test_digest_stable_for_identical_trees(tmp_path):
+    a = _tree(tmp_path / "a", "y = 2\n")
+    b = _tree(tmp_path / "b", "y = 2\n")
+    assert source_digest(a) == source_digest(b)
+
+
+def test_digest_changes_on_source_edit(tmp_path):
+    root = _tree(tmp_path, "y = 2\n")
+    before = source_digest(root)
+    (root / "src" / "esslivedata_tpu" / "mod.py").write_text("y = 3\n")
+    assert source_digest(root) != before
+
+
+def test_digest_changes_on_linter_edit(tmp_path):
+    # The checker's own code is part of the key: a new rule must not
+    # be masked by a cache recorded under the old rule set.
+    root = _tree(tmp_path, "y = 2\n")
+    before = source_digest(root)
+    (root / "tools" / "graftlint" / "lint.py").write_text("x = 2\n")
+    assert source_digest(root) != before
+
+
+def test_digest_changes_on_new_file(tmp_path):
+    root = _tree(tmp_path, "y = 2\n")
+    before = source_digest(root)
+    (root / "src" / "esslivedata_tpu" / "extra.py").write_text("z = 1\n")
+    assert source_digest(root) != before
+
+
+# -- load/store round-trip --------------------------------------------------
+
+
+class _F:
+    def __init__(self, path, line, rule, message):
+        self.path, self.line = path, line
+        self.rule, self.message = rule, message
+
+
+def test_store_then_load_round_trips(tmp_path):
+    cache = tmp_path / "cache.json"
+    store_cache(
+        cache,
+        "d1",
+        findings=[_F("a.py", 3, "JGL101", "two dispatches")],
+        errors=[],
+        fingerprints={"fam": {"k": "v"}},
+    )
+    doc = load_cache(cache, "d1")
+    assert doc is not None
+    assert doc["findings"] == [
+        {"path": "a.py", "line": 3, "rule": "JGL101",
+         "message": "two dispatches"}
+    ]
+    assert doc["fingerprints"] == {"fam": {"k": "v"}}
+
+
+def test_digest_mismatch_is_a_miss(tmp_path):
+    cache = tmp_path / "cache.json"
+    store_cache(cache, "d1", findings=[], errors=[], fingerprints={})
+    assert load_cache(cache, "d2") is None
+
+
+def test_corrupt_cache_is_a_miss_not_an_error(tmp_path):
+    cache = tmp_path / "cache.json"
+    cache.write_text("{not json")
+    assert load_cache(cache, "d1") is None
+    cache.write_text(json.dumps({"digest": "d1", "version": 999}))
+    assert load_cache(cache, "d1") is None
+    cache.write_text(json.dumps(["wrong", "shape"]))
+    assert load_cache(cache, "d1") is None
+
+
+def test_missing_cache_is_a_miss(tmp_path):
+    assert load_cache(tmp_path / "absent.json", "d1") is None
+
+
+def test_store_is_best_effort(tmp_path):
+    target = tmp_path / "file"
+    target.write_text("occupied")
+    # Parent "directory" is a file: mkdir/write fail, store must not
+    # raise — an unwritable cache costs the speedup, never the run.
+    store_cache(
+        target / "cache.json", "d1", findings=[], errors=[],
+        fingerprints={},
+    )
+
+
+# -- run_trace integration --------------------------------------------------
+
+
+def test_run_trace_cold_stores_then_warm_replays(tmp_path):
+    pytest.importorskip("jax")
+    from tools.graftlint.trace import run_trace
+
+    cache = tmp_path / "trace-cache.json"
+    cold = run_trace(cache_path=str(cache))
+    assert cold.skipped is None
+    assert not cold.cache_hit
+    assert cache.exists()
+
+    warm = run_trace(cache_path=str(cache))
+    assert warm.cache_hit
+    assert warm.skipped is None
+    assert warm.findings == cold.findings
+    assert warm.fingerprints == cold.fingerprints
+
+
+def test_cached_run_still_applies_baseline_drift(tmp_path):
+    # The cache stores RAW results; drift against a baseline edited
+    # AFTER the cache was written must still fire on a hit.
+    pytest.importorskip("jax")
+    from tools.graftlint.trace import run_trace
+
+    cache = tmp_path / "trace-cache.json"
+    cold = run_trace(cache_path=str(cache))
+    assert cold.fingerprints
+    warm = run_trace(
+        cache_path=str(cache),
+        baseline={"no_such_family": {"fingerprint": "bogus"}},
+    )
+    assert warm.cache_hit
+    assert any(f.rule == "JGL100" for f in warm.findings)
+
+
+def test_explicit_specs_bypass_the_cache(tmp_path):
+    pytest.importorskip("jax")
+    from tools.graftlint.trace import run_trace
+
+    cache = tmp_path / "trace-cache.json"
+    report = run_trace(specs=[], cache_path=str(cache))
+    assert not report.cache_hit
+    # Synthetic specs describe nothing on disk: storing them would
+    # poison the next real run.
+    assert not cache.exists()
+
+
+def test_skipped_run_is_never_stored(tmp_path, monkeypatch):
+    # A no-jax environment must re-announce SKIPPED every run: caching
+    # it would replay an empty result as a clean green later.
+    import tools.graftlint.trace.engine as engine
+
+    def _no_jax():
+        raise ImportError("jax gated out for this test")
+
+    monkeypatch.setattr(engine, "_import_jax", _no_jax)
+    cache = tmp_path / "trace-cache.json"
+    report = engine.run_trace(cache_path=str(cache))
+    assert report.skipped is not None
+    assert not report.cache_hit
+    assert not cache.exists()

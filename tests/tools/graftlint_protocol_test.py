@@ -1,0 +1,248 @@
+"""Protocol-pass suite (JGL200–JGL206, ADR 0124): the five models
+explore clean with their source-derived facts, every individually
+weakened guard produces a violation with a minimal counterexample
+trace, and the engine's binding/budget/select/skip plumbing behaves.
+
+The per-fact sweep here is the models' contract the same way the
+seeded specs in ``graftlint_trace_test.py`` are the trace rules': each
+fact corresponds to one real guard in src/ (an fsync, a quiescence
+check, an ownership compare, a boot-id check, an epoch bump), and
+flipping it False must make the exhaustive exploration find the
+exact failure the guard exists to prevent. The *mutation* guards —
+regex-gutting the real source and asserting the binding probes flip
+the facts — live in ``protocol_mutation_test.py``.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from esslivedata_tpu.harness.protocol_models import (
+    MODELS,
+    build_model,
+)
+from tools.graftlint import RULES
+from tools.graftlint.cli import main as cli_main
+from tools.graftlint.protocol import run_protocol
+from tools.graftlint.protocol.bindings import BINDINGS
+from tools.graftlint.protocol.explore import explore
+
+# -- registration -----------------------------------------------------------
+
+
+def test_protocol_rules_registered_with_protocol_scope():
+    protocol_rules = {
+        r for r, rule in RULES.items() if rule.scope == "protocol"
+    }
+    assert protocol_rules == {
+        "JGL200", "JGL201", "JGL202", "JGL203", "JGL204", "JGL205",
+        "JGL206",
+    }
+
+
+def test_every_model_has_bindings_and_registered_rule():
+    bound_models = {b.model for b in BINDINGS}
+    assert bound_models == set(MODELS)
+    for cls in MODELS.values():
+        assert cls.RULE in RULES
+        assert RULES[cls.RULE].scope == "protocol"
+
+
+def test_bindings_cover_every_fact():
+    # A model fact nothing probes would silently stay True forever —
+    # the model would "verify" a guard no binding ever checks.
+    probed: dict[str, set[str]] = {}
+    for binding in BINDINGS:
+        for probe in binding.probes:
+            if probe.fact is not None:
+                probed.setdefault(binding.model, set()).add(probe.fact)
+    for name, cls in MODELS.items():
+        assert probed.get(name, set()) == set(cls.FACTS), name
+
+
+# -- the models, with all guards present ------------------------------------
+
+
+@pytest.mark.parametrize("name", sorted(MODELS))
+def test_model_clean_with_all_guards_present(name):
+    result = explore(build_model(name))
+    assert result.violation is None, result.violation
+    assert not result.truncated
+    # Well under the shipped budget: a model edit that balloons the
+    # space should fail here before it slows the lint job.
+    assert result.states < 10_000
+
+
+# -- the per-fact violation sweep -------------------------------------------
+
+_ALL_FACTS = [
+    (name, fact)
+    for name, cls in sorted(MODELS.items())
+    for fact in cls.FACTS
+]
+
+
+@pytest.mark.parametrize("name,fact", _ALL_FACTS)
+def test_each_weakened_guard_is_a_reachable_violation(name, fact):
+    """Every modeled fact has teeth: flipping exactly one guard False
+    must make the exploration reach an invariant violation — otherwise
+    the binding probe guards nothing and the model is decorative."""
+    model = build_model(name, {fact: False})
+    result = explore(model)
+    assert result.violation is not None, (
+        f"weakening {fact!r} produced no violation — the model does "
+        "not actually depend on that guard"
+    )
+    message, trace = result.violation
+    assert message
+    # BFS with parent pointers: the witness is minimal, and for these
+    # bounded models minimal is humanly short.
+    assert len(trace) <= 12, trace
+
+
+def test_counterexample_is_minimal_bfs_witness():
+    # The quiescence-gate failure needs the full consume->checkpoint->
+    # crash->restore arc; BFS must find exactly that arc and nothing
+    # longer (a DFS-style witness could wander the interleavings).
+    result = explore(build_model("replay", {"checkpoint.quiescent_gate": False}))
+    assert result.violation is not None
+    _message, trace = result.violation
+    assert trace[-1] == "restore_and_seek"
+    assert "checkpoint" in trace
+    # Minimality: every strictly shorter prefix-length exploration of
+    # the same model finds nothing (the witness length is the true
+    # BFS distance).
+    assert len(trace) <= 7
+
+
+def test_unknown_fact_rejected():
+    with pytest.raises(ValueError):
+        build_model("checkpoint", {"no.such.guard": False})
+
+
+# -- engine: real tree ------------------------------------------------------
+
+
+def test_real_tree_models_lint_clean():
+    # The tier-1 guard: the shipped src/ binds every model, all facts
+    # probe True, and exhaustive exploration finds no violation. The
+    # jax-needing codec leg has its own test below.
+    report = run_protocol(codec=False)
+    assert report.skipped is None
+    assert report.errors == []
+    assert report.findings == []
+    assert set(report.stats) == set(MODELS)
+    for name, stats in report.stats.items():
+        assert not stats["violated"], name
+        assert not stats["truncated"], name
+
+
+def test_real_tree_codec_round_trips_every_family():
+    pytest.importorskip("jax")
+    report = run_protocol()
+    assert report.skipped is None
+    assert report.codec_skipped is None
+    assert report.errors == []
+    assert [f for f in report.findings if f.rule == "JGL205"] == []
+
+
+# -- engine: budget, select, overrides --------------------------------------
+
+
+def test_budget_overrun_is_jgl206_not_silence():
+    report = run_protocol(codec=False, max_states=3)
+    rules = {f.rule for f in report.findings}
+    assert rules == {"JGL206"}
+    # Every model blows a 3-state budget; none may pass silently.
+    assert len(report.findings) == len(MODELS)
+    for finding in report.findings:
+        assert "proves nothing" in finding.message
+
+
+def test_select_filters_protocol_findings():
+    report = run_protocol(
+        codec=False, max_states=3, select=frozenset({"JGL202"})
+    )
+    assert report.findings == []
+
+
+def test_source_override_syntax_error_is_an_error_not_a_pass():
+    target = BINDINGS[0].path
+    report = run_protocol(
+        codec=False, source_overrides={target: "def broken(:\n"}
+    )
+    assert any(
+        target in err and "parse" in err for err in report.errors
+    )
+
+
+def test_lost_marker_is_jgl200_drift():
+    # Strip the `# graft: protocol=` marker from a bound file: the
+    # binding must report model drift — the marker is the contract
+    # that tells an editor a lint-time model watches this function.
+    from tools.graftlint.protocol.engine import _repo_root
+
+    path = "src/esslivedata_tpu/fleet/assignment.py"
+    source = (_repo_root() / path).read_text(encoding="utf-8")
+    assert "graft: protocol=fleet" in source
+    stripped = source.replace("graft: protocol=fleet", "graft-was-here")
+    report = run_protocol(
+        codec=False, source_overrides={path: stripped}
+    )
+    drift = [f for f in report.findings if f.rule == "JGL200"]
+    assert drift and all(f.path == path for f in drift)
+    assert any("marker" in f.message for f in drift)
+
+
+# -- CLI integration --------------------------------------------------------
+
+
+def test_cli_select_protocol_without_flag_is_usage_error(capsys):
+    with pytest.raises(SystemExit) as exc:
+        cli_main(["src/", "--select", "protocol"])
+    assert exc.value.code == 2
+    err = capsys.readouterr().err
+    assert "--protocol" in err
+
+
+def test_cli_select_unknown_scope_is_usage_error(capsys):
+    with pytest.raises(SystemExit) as exc:
+        cli_main(["src/", "--select", "bogus-scope"])
+    assert exc.value.code == 2
+    err = capsys.readouterr().err
+    assert "unknown rule ids or scopes" in err
+
+
+def test_cli_diff_mode_skips_protocol_visibly(
+    tmp_path, monkeypatch, capsys
+):
+    # Diff mode must not run the models (they bind the full tree) and
+    # must say so — never a silent green for a pass that did not run.
+    # A scratch repo with one untracked file makes the changed set
+    # non-empty deterministically (a clean checkout would take the
+    # nothing-to-lint early exit before the protocol block).
+    monkeypatch.chdir(tmp_path)
+    subprocess.run(["git", "init", "-q"], check=True)
+    subprocess.run(
+        [
+            "git", "-c", "user.email=t@t", "-c", "user.name=t",
+            "commit", "-q", "--allow-empty", "-m", "seed",
+        ],
+        check=True,
+    )
+    (tmp_path / "mod.py").write_text("x = 1\n")
+    rc = cli_main(["mod.py", "--diff", "HEAD", "--protocol", "-q"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "protocol pass skipped in diff mode" in captured.err
+
+
+def test_explain_fallback_names_protocol_scope():
+    from tools.graftlint.explain import explain
+
+    text = explain("JGL206", docs_path=Path("/nonexistent"))
+    assert "protocol" in text
+    assert "--protocol" in text

@@ -947,10 +947,15 @@ def test_negative_quiet(rule_id):
 
 
 def test_every_rule_has_fixtures():
-    # Trace-scope rules (JGL10x) fire on lowered programs, not source
-    # snippets — their seeded positive/negative fixtures live in
-    # graftlint_trace_test.py.
-    ast_rules = {r for r, rule in RULES.items() if rule.scope != "trace"}
+    # Trace-scope rules (JGL10x) fire on lowered programs and
+    # protocol-scope rules (JGL20x) on explored state machines, not
+    # source snippets — their seeded positive/negative fixtures live in
+    # graftlint_trace_test.py and protocol_mutation_test.py.
+    ast_rules = {
+        r
+        for r, rule in RULES.items()
+        if rule.scope not in ("trace", "protocol")
+    }
     assert set(POSITIVE) == ast_rules
     assert set(NEGATIVE) == ast_rules
 

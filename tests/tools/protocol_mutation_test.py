@@ -1,0 +1,210 @@
+"""Mutation guards for the protocol pass (ADR 0124 acceptance): gut
+each modeled source guard in a SCRATCH copy of the real tree (via
+``source_overrides`` — disk is never touched) and assert the checker
+goes red with the exact JGL2xx code and a minimal counterexample.
+
+This is the pass's reason to exist, tested end to end: the binding
+probe must notice the gutted guard (fact -> False), the weakened model
+must reach the failure the guard prevents, and the finding must anchor
+at the weakened function with a humanly-short transition trace. A
+mutation that stays green means the model never depended on that
+guard — the checker is decorative for it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tools.graftlint.protocol import run_protocol
+from tools.graftlint.protocol.engine import _repo_root
+
+
+def _mutated(path: str, old: str, new: str) -> dict[str, str]:
+    source = (_repo_root() / path).read_text(encoding="utf-8")
+    assert old in source, f"mutation target drifted: {old!r} not in {path}"
+    return {path: source.replace(old, new)}
+
+
+def _findings_for(overrides: dict[str, str]):
+    report = run_protocol(codec=False, source_overrides=overrides)
+    assert report.errors == []
+    return report.findings
+
+
+def _the_one_finding(overrides: dict[str, str], rule: str):
+    findings = _findings_for(overrides)
+    matching = [f for f in findings if f.rule == rule]
+    assert matching, (
+        f"mutation did not flip {rule}; findings: {findings}"
+    )
+    return matching[0]
+
+
+# -- JGL202: delete the checkpoint file fsync -------------------------------
+
+
+def test_deleting_checkpoint_fsync_is_jgl202():
+    finding = _the_one_finding(
+        _mutated(
+            "src/esslivedata_tpu/durability/checkpoint.py",
+            "os.fsync(fh.fileno())",
+            "pass  # fsync deleted by mutation",
+        ),
+        "JGL202",
+    )
+    assert finding.path == "src/esslivedata_tpu/durability/checkpoint.py"
+    assert "counterexample: init ->" in finding.message
+    assert "crash" in finding.message
+    # The finding names the gutted guard, not just the model.
+    assert "guard not found in source" in finding.message
+
+
+# -- JGL204: gut the state-loss epoch bump ----------------------------------
+
+
+def test_gutting_state_lost_epoch_bump_is_jgl204():
+    finding = _the_one_finding(
+        _mutated(
+            "src/esslivedata_tpu/core/job.py",
+            "self.state_epoch += 1\n        HEALTH.note_state_lost()",
+            "HEALTH.note_state_lost()",
+        ),
+        "JGL204",
+    )
+    assert finding.path == "src/esslivedata_tpu/core/job.py"
+    assert "counterexample: init ->" in finding.message
+
+
+# -- JGL201: short-circuit the fleet ownership compare ----------------------
+
+
+def test_owns_without_self_compare_is_jgl201():
+    finding = _the_one_finding(
+        _mutated(
+            "src/esslivedata_tpu/fleet/assignment.py",
+            "owned = self.owner(stream, fuse_tag) == self.self_id",
+            "owned = True",
+        ),
+        "JGL201",
+    )
+    assert finding.path == "src/esslivedata_tpu/fleet/assignment.py"
+    # An unfiltered fleet violates single-ownership immediately: the
+    # minimal witness is the initial state itself.
+    assert "counterexample: init" in finding.message
+    # Two replicas accumulating one group is the modeled failure.
+    assert "processed by" in finding.message
+
+
+# -- JGL203: drop the relay's boot-id check ---------------------------------
+
+
+def test_dropping_relay_boot_check_is_jgl203():
+    finding = _the_one_finding(
+        _mutated(
+            "src/esslivedata_tpu/fleet/relay.py",
+            "and boot != self._last_boot",
+            "and False",
+        ),
+        "JGL203",
+    )
+    assert finding.path == "src/esslivedata_tpu/fleet/relay.py"
+    assert "counterexample: init ->" in finding.message
+
+
+# -- JGL205: a codec that cannot round-trip ---------------------------------
+
+
+class _LossyWorkflow:
+    """dump_state drops the dtype, restore rebuilds float64: the
+    re-assembled program's avals drift — exactly what JGL205 exists to
+    catch before a restart streams the checkpoint."""
+
+    def __init__(self) -> None:
+        self.state = np.zeros(8, dtype=np.float32)
+
+    def state_fingerprint(self) -> str:
+        return "lossy"
+
+    def dump_state(self) -> dict:
+        return {"state": self.state.tolist()}
+
+    def restore_state(self, arrays: dict) -> bool:
+        self.state = np.asarray(arrays["state"], dtype=np.float64)
+        return True
+
+
+class _FakeSpec:
+    family = "lossy_fixture"
+
+    def source_location(self):
+        return "tests/tools/protocol_mutation_test.py", 1
+
+    @staticmethod
+    def make_workflow(variant: str) -> _LossyWorkflow:
+        return _LossyWorkflow()
+
+    @staticmethod
+    def assemble(wf: _LossyWorkflow):
+        from esslivedata_tpu.harness.tick_contract import (
+            TickProgram,
+            TickProgramBuild,
+        )
+
+        program = TickProgram(
+            label="publish",
+            fn=lambda s: {"counts": s},
+            args=(wf.state,),
+            state_positions=(0,),
+            staged_positions=(),
+            outputs={"counts": wf.state},
+        )
+        return TickProgramBuild(
+            programs=(program,), key_material=(str(wf.state.dtype),)
+        )
+
+
+def test_lossy_codec_spec_is_jgl205():
+    report = run_protocol(codec_specs=[_FakeSpec()])
+    findings = [f for f in report.findings if f.rule == "JGL205"]
+    assert findings, report.findings
+    assert any("round-trip" in f.message for f in findings)
+
+
+def test_spec_without_factored_build_is_jgl205():
+    class _Opaque:
+        family = "opaque_fixture"
+        make_workflow = None
+        assemble = None
+
+        def source_location(self):
+            return "tests/tools/protocol_mutation_test.py", 1
+
+    report = run_protocol(codec_specs=[_Opaque()])
+    findings = [f for f in report.findings if f.rule == "JGL205"]
+    assert findings
+    assert "make_workflow" in findings[0].message
+
+
+# -- control: the unmutated tree is clean -----------------------------------
+
+
+def test_unmutated_tree_is_clean():
+    report = run_protocol(codec=False)
+    assert report.findings == []
+    assert report.errors == []
+
+
+# -- every modeled guard class has a mutation above -------------------------
+
+
+def test_mutation_coverage_spans_all_model_rules():
+    # JGL201..JGL205 each have a seeded mutation in this file (the
+    # ISSUE's acceptance bar); this meta-assert keeps the set honest
+    # if a rule is added without its mutation.
+    import inspect
+    import sys
+
+    source = inspect.getsource(sys.modules[__name__])
+    for rule in ("JGL201", "JGL202", "JGL203", "JGL204", "JGL205"):
+        assert f'"{rule}"' in source

@@ -15,6 +15,14 @@ the thing they describe, never in a config file):
   the class's staging/fusion key tuples (JGL014): reading them under
   trace cannot drift from the key, so they need no key entry of their
   own. The justification belongs in the same comment, after the list.
+- ``# graft: protocol=<model>`` on (or directly above) a ``def`` binds
+  the function to a protocol model (ADR 0124: ``checkpoint``,
+  ``replay``, ``relay``, ``fleet``, ``epoch`` — see
+  ``harness/protocol_models.py``). The protocol pass cross-checks the
+  function's structure against the model's assumed facts; a bound
+  function whose file has lost the marker is JGL200 model drift — the
+  marker is how an editor of this code learns a lint-time model
+  depends on its exact guard ordering.
 
 Like suppressions, annotations are read from COMMENT tokens only — the
 same text inside a docstring documents the syntax without activating it.

@@ -90,8 +90,13 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--select",
         default=None,
-        metavar="JGL001,JGL004",
-        help="comma-separated rule ids to run (default: all)",
+        metavar="JGL001,trace",
+        help=(
+            "comma-separated rule ids and/or scope names (file, "
+            "project, meta, trace, protocol) to run (default: all); "
+            "unknown tokens are a usage error, and selecting trace/"
+            "protocol rules without enabling their pass is too"
+        ),
     )
     parser.add_argument(
         "--jobs",
@@ -182,6 +187,29 @@ def main(argv: list[str] | None = None) -> int:
         ),
     )
     parser.add_argument(
+        "--trace-cache",
+        default=None,
+        metavar="FILE",
+        help=(
+            "lowering cache for the trace pass, keyed by a digest over "
+            "src/ + tools/graftlint sources and the jax/python "
+            "versions: an unchanged tree replays the recorded results "
+            "with no jax import (implies --trace)"
+        ),
+    )
+    parser.add_argument(
+        "--protocol",
+        action="store_true",
+        help=(
+            "also run the protocol pass (JGL200-series): model-check "
+            "the checkpoint/replay/relay/fleet/epoch protocols — "
+            "source-bound state machines explored over every "
+            "interleaving and crash point, plus the dump_state/restore "
+            "codec round-trip (docs/adr/0124); skipped in diff mode "
+            "(models bind the full tree)"
+        ),
+    )
+    parser.add_argument(
         "--explain",
         default=None,
         metavar="JGLxxx",
@@ -200,7 +228,7 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.list_rules:
         for rule_id, rule in sorted(RULES.items()):
-            print(f"{rule_id}  {rule.summary}")
+            print(f"{rule_id}  [{rule.scope:8s}]  {rule.summary}")
         return 0
     if args.explain:
         from .explain import explain
@@ -214,16 +242,50 @@ def main(argv: list[str] | None = None) -> int:
         parser.error("--write-baseline requires --baseline FILE")
     if args.trace_write_baseline and not args.trace_baseline:
         parser.error("--trace-write-baseline requires --trace-baseline FILE")
-    if args.trace_baseline or args.trace_write_baseline:
+    if args.trace_baseline or args.trace_write_baseline or args.trace_cache:
         args.trace = True
 
-    select = (
-        frozenset(s.strip() for s in args.select.split(",") if s.strip())
-        if args.select
-        else None
-    )
-    if select is not None and (unknown := select - set(RULES)):
-        parser.error(f"unknown rule ids: {sorted(unknown)}")
+    select: frozenset[str] | None = None
+    if args.select:
+        scopes = {rule.scope for rule in RULES.values()}
+        expanded: set[str] = set()
+        unknown: list[str] = []
+        for token in (s.strip() for s in args.select.split(",")):
+            if not token:
+                continue
+            if token in RULES:
+                expanded.add(token)
+            elif token in scopes:
+                expanded.update(
+                    rule_id
+                    for rule_id, rule in RULES.items()
+                    if rule.scope == token
+                )
+            else:
+                unknown.append(token)
+        if unknown:
+            parser.error(
+                f"unknown rule ids or scopes: {sorted(unknown)} "
+                f"(scopes: {', '.join(sorted(scopes))})"
+            )
+        select = frozenset(expanded)
+        # A selected rule whose pass is not enabled would be a silent
+        # no-op — the run exits 0 having checked nothing the user asked
+        # for. Fail loudly instead.
+        for scope, flag, enable in (
+            ("trace", args.trace, "--trace"),
+            ("protocol", args.protocol, "--protocol"),
+        ):
+            missing = sorted(
+                rule_id
+                for rule_id in select
+                if RULES[rule_id].scope == scope
+            )
+            if missing and not flag:
+                parser.error(
+                    f"--select includes {scope} rules {missing} but "
+                    f"the {scope} pass is not enabled; add {enable}"
+                )
     jobs = args.jobs if args.jobs > 0 else (os.cpu_count() or 1)
 
     lint_paths = args.paths
@@ -258,6 +320,7 @@ def main(argv: list[str] | None = None) -> int:
     # ledger audit all apply to them unchanged.
     trace_findings: list = []
     trace_errors: list[str] = []
+    trace_ran = False
     if args.trace:
         from .trace import run_trace
 
@@ -273,7 +336,11 @@ def main(argv: list[str] | None = None) -> int:
                     file=sys.stderr,
                 )
                 return 1
-        report = run_trace(select=select, baseline=trace_baseline)
+        report = run_trace(
+            select=select,
+            baseline=trace_baseline,
+            cache_path=args.trace_cache,
+        )
         if report.skipped:
             # Visible notice, never a silent pass: an environment that
             # cannot lower (no jax) still gates on the static passes,
@@ -282,6 +349,13 @@ def main(argv: list[str] | None = None) -> int:
                 f"graftlint: trace pass SKIPPED: {report.skipped}",
                 file=sys.stderr,
             )
+        else:
+            trace_ran = True
+            if report.cache_hit and not args.quiet:
+                print(
+                    "graftlint: trace pass replayed from lowering "
+                    f"cache ({args.trace_cache}); sources unchanged"
+                )
         trace_findings = report.findings
         trace_errors = report.errors
         if args.trace_write_baseline:
@@ -305,18 +379,72 @@ def main(argv: list[str] | None = None) -> int:
                     f"contract fingerprint(s) to {args.trace_baseline}"
                 )
             return 0
-    elif select is None:
-        # The trace pass did not run, so its rules must not be judged
-        # by the JGL024 staleness audit (same inverted-soundness trap
-        # as diff mode: absent findings would make live trace-ledger
-        # directives look stale). Excluding the trace scope from the
-        # effective select leaves every static rule's behavior
-        # unchanged and tells the audit those rules did not run.
-        select = frozenset(
-            rule_id
-            for rule_id, rule in RULES.items()
-            if rule.scope != "trace"
+
+    # Protocol pass (when enabled): JGL20x findings anchor at the
+    # modeled transition sites in src/ and ride the same findings
+    # stream as everything else — suppressions, baseline, SARIF and
+    # the JGL024 ledger audit apply unchanged.
+    protocol_findings: list = []
+    protocol_errors: list[str] = []
+    protocol_ran = False
+    protocol_codec_skipped = False
+    if args.protocol and args.diff is not None:
+        # The protocol models bind the FULL tree (each model cross-
+        # checks transition sites across several files), so a partial
+        # diff view cannot evaluate them soundly — same reasoning as
+        # the JGL024 audit skip below. Visible notice, never silent.
+        print(
+            "graftlint: protocol pass skipped in diff mode (models "
+            "bind the full tree; CI's full run closes the gap)",
+            file=sys.stderr,
         )
+    elif args.protocol:
+        from .protocol import run_protocol
+
+        preport = run_protocol(select=select)
+        if preport.skipped:
+            print(
+                f"graftlint: protocol pass SKIPPED: {preport.skipped}",
+                file=sys.stderr,
+            )
+        else:
+            protocol_ran = True
+            if preport.codec_skipped:
+                protocol_codec_skipped = True
+                print(
+                    "graftlint: protocol codec leg (JGL205) SKIPPED: "
+                    f"{preport.codec_skipped}",
+                    file=sys.stderr,
+                )
+        protocol_findings = preport.findings
+        protocol_errors = preport.errors
+
+    if select is None:
+        # Rules whose pass did not run must not be judged by the
+        # JGL024 staleness audit (same inverted-soundness trap as diff
+        # mode: absent findings would make live ledger directives look
+        # stale). Excluding those scopes from the effective select
+        # leaves every static rule's behavior unchanged and tells the
+        # audit exactly which rules did not run. JGL205 alone drops
+        # out when the codec leg skipped (no jax) but the model leg
+        # still ran.
+        excluded: set[str] = set()
+        if not trace_ran:
+            excluded.update(
+                rule_id
+                for rule_id, rule in RULES.items()
+                if rule.scope == "trace"
+            )
+        if not protocol_ran:
+            excluded.update(
+                rule_id
+                for rule_id, rule in RULES.items()
+                if rule.scope == "protocol"
+            )
+        elif protocol_codec_skipped:
+            excluded.add("JGL205")
+        if excluded:
+            select = frozenset(set(RULES) - excluded)
 
     # The stale-suppression audit (JGL024) only runs on full views: in
     # diff mode, project rules starved of cross-file facts would make
@@ -327,9 +455,10 @@ def main(argv: list[str] | None = None) -> int:
         select=select,
         jobs=jobs,
         audit=args.diff is None,
-        extra_findings=trace_findings,
+        extra_findings=trace_findings + protocol_findings,
     )
     errors.extend(trace_errors)
+    errors.extend(protocol_errors)
 
     if args.write_baseline:
         # Parse/path errors abort BEFORE writing: a snapshot taken over

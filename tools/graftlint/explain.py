@@ -53,8 +53,14 @@ def explain(rule_id: str, docs_path: Path | None = None) -> str | None:
         section = None
     if section is not None:
         return section
+    rule = RULES[rule_id]
+    run_hint = {
+        "trace": " — runs in the trace pass (graftlint --trace)",
+        "protocol": " — runs in the protocol pass (graftlint --protocol)",
+    }.get(rule.scope, "")
     return (
-        f"### {rule_id} — {RULES[rule_id].summary}\n\n"
+        f"### {rule_id} — {rule.summary}\n\n"
+        f"Scope: {rule.scope}{run_hint}.\n\n"
         f"(no docs/graftlint.md section yet — add one with a minimal "
         f"bad/good example)"
     )

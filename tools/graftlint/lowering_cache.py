@@ -1,0 +1,141 @@
+"""Source-digest cache for the trace pass's AOT lowerings (ADR 0124
+satellite of ADR 0123).
+
+Lowering every registered tick program imports jax and traces six
+families — by far the dominant cost of ``make lint --trace``. The
+contract it proves is a pure function of (a) the Python sources that
+build and check the programs and (b) the jax/Python versions doing the
+lowering, so a cache keyed by a digest over exactly those inputs can
+skip the whole leg — including the jax import — when nothing relevant
+changed, which is the common CI case (a docs or test edit rebuilding
+the lint job).
+
+The cache stores the trace pass's RAW results: pre-baseline,
+pre-select findings plus errors and fingerprints. Baseline drift and
+``--select`` filtering are applied after load, same as on a fresh run
+— a cached run with a newly-edited baseline still reports drift, and a
+narrowed select never poisons the cache for the next full run. Runs
+that skipped (no jax) or errored are never stored: a cache hit always
+replays a clean, complete lowering sweep. Explicit ``specs=`` runs
+(tests injecting synthetic families) bypass the cache entirely — the
+digest only covers the on-disk tree.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+
+#: Cache format version — bump on any change to what the trace pass
+#: records, so stale layouts never half-parse.
+_VERSION = 1
+
+#: Source trees whose content determines the lowering result: the
+#: package being lowered and the linter doing the checking.
+_SOURCE_TREES = ("src/esslivedata_tpu", "tools/graftlint")
+
+
+def _repo_root() -> Path:
+    # lowering_cache.py -> graftlint -> tools -> repo root
+    return Path(__file__).resolve().parents[2]
+
+
+def _tool_versions() -> str:
+    """Version material WITHOUT importing jax (the whole point of a
+    cache hit is skipping that import)."""
+    import platform
+
+    try:
+        from importlib.metadata import version
+
+        jax_version = version("jax")
+    except Exception:
+        jax_version = "absent"
+    return f"python={platform.python_version()};jax={jax_version}"
+
+
+def source_digest(root: Path | None = None) -> str:
+    """sha256 over every .py file (path + content) in the trees that
+    feed the lowering, plus interpreter/jax versions."""
+    root = _repo_root() if root is None else Path(root)
+    acc = hashlib.sha256()
+    acc.update(f"v{_VERSION};{_tool_versions()}".encode())
+    for tree in _SOURCE_TREES:
+        base = root / tree
+        if not base.is_dir():
+            acc.update(f"missing:{tree}".encode())
+            continue
+        for path in sorted(base.rglob("*.py")):
+            rel = path.relative_to(root).as_posix()
+            acc.update(rel.encode())
+            try:
+                acc.update(path.read_bytes())
+            except OSError:
+                acc.update(b"<unreadable>")
+    return acc.hexdigest()
+
+
+def load_cache(path: str | Path, digest: str) -> dict | None:
+    """The cached raw results when ``digest`` matches, else None.
+    Unreadable/corrupt/mismatched caches are a miss, never an error —
+    the fresh run rewrites them."""
+    try:
+        doc = json.loads(Path(path).read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or doc.get("digest") != digest:
+        return None
+    if doc.get("version") != _VERSION:
+        return None
+    findings = doc.get("findings")
+    errors = doc.get("errors")
+    fingerprints = doc.get("fingerprints")
+    if not (
+        isinstance(findings, list)
+        and isinstance(errors, list)
+        and isinstance(fingerprints, dict)
+    ):
+        return None
+    for entry in findings:
+        if not (
+            isinstance(entry, dict)
+            and {"path", "line", "rule", "message"} <= set(entry)
+        ):
+            return None
+    return doc
+
+
+def store_cache(
+    path: str | Path,
+    digest: str,
+    *,
+    findings,
+    errors: list[str],
+    fingerprints: dict,
+) -> None:
+    """Persist raw trace results under ``digest``. Best-effort: an
+    unwritable cache directory costs the speedup, never the run."""
+    doc = {
+        "version": _VERSION,
+        "digest": digest,
+        "findings": [
+            {
+                "path": f.path,
+                "line": f.line,
+                "rule": f.rule,
+                "message": f.message,
+            }
+            for f in findings
+        ],
+        "errors": list(errors),
+        "fingerprints": fingerprints,
+    }
+    target = Path(path)
+    try:
+        target.parent.mkdir(parents=True, exist_ok=True)
+        target.write_text(
+            json.dumps(doc, sort_keys=True, indent=1), encoding="utf-8"
+        )
+    except OSError:
+        pass

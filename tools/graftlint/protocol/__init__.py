@@ -1,0 +1,36 @@
+"""graftlint protocol pass (JGL200-series): model-check the crash /
+membership / epoch protocols at lint time (ADR 0124).
+
+Each guarded protocol is written down as an explicit state machine
+(``esslivedata_tpu.harness.protocol_models``), *bound* to the real
+source by dataflow probes (``bindings.py``) so a model that drifts
+from the code is itself a finding (JGL200), and then explored
+exhaustively — every interleaving and crash point within the model's
+bounds (``explore.py``) — checking the five safety invariants
+JGL201–JGL205. Counterexamples print as minimal transition traces.
+
+``rules`` registers the JGL20x ids (metadata only — importable
+everywhere); ``engine`` binds + explores and is imported lazily by the
+CLI so the static passes never pay for it, and the JGL205 codec leg
+(which needs jax, like the trace pass) degrades to a visible notice.
+"""
+
+from __future__ import annotations
+
+from . import rules  # noqa: F401  (registers JGL200-series ids)
+
+__all__ = ["run_protocol", "ProtocolReport"]
+
+
+def run_protocol(**kwargs):
+    from .engine import run_protocol as _run
+
+    return _run(**kwargs)
+
+
+def __getattr__(name: str):
+    if name == "ProtocolReport":
+        from .engine import ProtocolReport
+
+        return ProtocolReport
+    raise AttributeError(name)

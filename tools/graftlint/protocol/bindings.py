@@ -1,0 +1,625 @@
+"""Model↔source bindings: the probes that keep the protocol models
+honest (ADR 0124).
+
+A model is only worth exploring while it still describes the code, so
+every modeled guard is *bound* to its transition site by a dataflow
+probe over the real source: the function must exist, the file must
+carry its ``# graft: protocol=<model>`` marker, and the guard's shape
+must be found where the model claims it (an fsync on every path before
+the rename, an epoch bump on every exit path, a compare against
+``self_id``). Three outcomes per probe:
+
+- **structural** (``fact=None``): the probe verifies a property the
+  model relies on but does not parameterize (GC under the lock, the
+  sha256 verify in the recovery walk). A miss is model drift — JGL200
+  at the function's line.
+- **fact probe** (``fact="..."``): the result parameterizes the model.
+  A guard the source lost WEAKENS the model instead of erroring, and
+  exploration then produces the concrete interleaving the guard
+  excluded — reported under the invariant's own rule (JGL201–204) with
+  a minimal counterexample, anchored at the gutted function.
+- **missing function / marker**: JGL200 — the model is talking about
+  code that no longer exists.
+
+Probes read the same :class:`~..context.FileContext` facts as the v3
+dataflow rules (CFGs, qualnames, lock regions), so their precision
+envelope is documented in one place (docs/graftlint.md "Precision").
+"""
+
+from __future__ import annotations
+
+import ast
+from collections.abc import Callable
+from dataclasses import dataclass, field
+
+from ..annotations import parse_annotations
+from ..context import FileContext
+from ..dataflow import CFG, paths_avoiding, walk_own
+
+__all__ = ["BINDINGS", "Binding", "BindingOutcome", "Probe", "evaluate_binding"]
+
+
+@dataclass(frozen=True)
+class Probe:
+    #: Model fact key this probe answers, or None for a structural
+    #: (must-hold) property.
+    fact: str | None
+    #: ``"Class.method"`` or a module-level ``"name"``.
+    function: str
+    #: ``check(ctx, fn) -> bool`` — True when the guard is present.
+    check: Callable[[FileContext, ast.AST], bool]
+    #: What the probe verifies, quoted in findings.
+    describe: str
+
+
+@dataclass(frozen=True)
+class Binding:
+    model: str
+    path: str  # repo-relative
+    probes: tuple[Probe, ...]
+
+
+@dataclass
+class BindingOutcome:
+    binding: Binding
+    #: fact key -> probe result (only fact probes).
+    facts: dict[str, bool] = field(default_factory=dict)
+    #: fact key -> (line, describe) — where a weakened guard anchors.
+    anchors: dict[str, tuple[int, str]] = field(default_factory=dict)
+    #: JGL200 material: (line, message).
+    drift: list[tuple[int, str]] = field(default_factory=list)
+
+
+# -- probe helpers -----------------------------------------------------------
+
+
+def _find_function(ctx: FileContext, spec: str) -> ast.AST | None:
+    cls_name, _, fn_name = spec.rpartition(".")
+    for fn in ctx.defs_by_name.get(fn_name, ()):
+        owner = ctx.enclosing_class(fn)
+        if cls_name:
+            if owner is not None and owner.name == cls_name:
+                return fn
+        elif owner is None:
+            return fn
+    return None
+
+
+def _is_call_to(ctx: FileContext, call: ast.Call, name: str) -> bool:
+    """Call whose target resolves to ``name``: a full qualname
+    (``os.replace``), a bare function name, or a method attribute."""
+    if ctx.qualname(call.func) == name:
+        return True
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        return func.attr == name
+    return isinstance(func, ast.Name) and func.id == name
+
+
+def _call_nodes(ctx: FileContext, fn: ast.AST, name: str) -> set[int]:
+    """CFG nodes of statements whose own expressions call ``name``."""
+    cfg = ctx.cfg(fn)
+    out: set[int] = set()
+    for node, stmt in cfg.statements():
+        if any(
+            isinstance(sub, ast.Call) and _is_call_to(ctx, sub, name)
+            for sub in walk_own(stmt)
+        ):
+            out.add(node)
+    return out
+
+
+def _always_before(
+    ctx: FileContext, fn: ast.AST, guard: str, action: str
+) -> bool:
+    """Every path from ENTRY to a statement calling ``action`` passes
+    through a statement calling ``guard`` first."""
+    guards = _call_nodes(ctx, fn, guard)
+    actions = _call_nodes(ctx, fn, action)
+    if not guards or not actions:
+        return False
+    return not paths_avoiding(ctx.cfg(fn), CFG.ENTRY, guards, actions)
+
+
+def _always_after(
+    ctx: FileContext, fn: ast.AST, action: str, guard: str
+) -> bool:
+    """Every path from every statement calling ``action`` to EXIT
+    passes through a statement calling ``guard``."""
+    guards = _call_nodes(ctx, fn, guard)
+    actions = _call_nodes(ctx, fn, action)
+    if not guards or not actions:
+        return False
+    cfg = ctx.cfg(fn)
+    return all(
+        not paths_avoiding(cfg, node, guards, {CFG.EXIT})
+        for node in actions
+    )
+
+
+def _augassign_nodes(ctx: FileContext, fn: ast.AST, attr: str) -> set[int]:
+    cfg = ctx.cfg(fn)
+    out: set[int] = set()
+    for node, stmt in cfg.statements():
+        if (
+            isinstance(stmt, ast.AugAssign)
+            and isinstance(stmt.target, ast.Attribute)
+            and stmt.target.attr == attr
+        ):
+            out.add(node)
+    return out
+
+
+def _bumps_on_every_path(ctx: FileContext, fn: ast.AST, attr: str) -> bool:
+    """An ``<attr> += ...`` sits on EVERY path from entry to exit —
+    the "reaches every exit path" discipline."""
+    bumps = _augassign_nodes(ctx, fn, attr)
+    if not bumps:
+        return False
+    return not paths_avoiding(ctx.cfg(fn), CFG.ENTRY, bumps, {CFG.EXIT})
+
+
+def _mentions_attr(fn: ast.AST, attr: str) -> bool:
+    return any(
+        isinstance(sub, ast.Attribute) and sub.attr == attr
+        for sub in ast.walk(fn)
+    )
+
+
+def _mentions_str(fn: ast.AST, text: str) -> bool:
+    """A string-constant mention — the duck-typed ``getattr``/key
+    idiom (``getattr(wf, "publish_epoch", 0)``, ``doc["reset_seq"]``)."""
+    return any(
+        isinstance(sub, ast.Constant) and sub.value == text
+        for sub in ast.walk(fn)
+    )
+
+
+def _compare_mentions(fn: ast.AST, attr: str) -> bool:
+    """Some EQUALITY comparison in ``fn`` has ``attr`` as an operand —
+    the "does the classification actually consult this field?" probe.
+    Restricted to ``==``/``!=`` deliberately: the identity guards
+    (``self._last_boot is not None``-style presence checks) survive
+    gutting the decisive compare, so counting them would let a
+    mutation that short-circuits the real check pass the probe."""
+    for sub in ast.walk(fn):
+        if not isinstance(sub, ast.Compare):
+            continue
+        if not any(isinstance(op, (ast.Eq, ast.NotEq)) for op in sub.ops):
+            continue
+        if any(
+            isinstance(part, ast.Attribute) and part.attr == attr
+            for operand in (sub.left, *sub.comparators)
+            for part in ast.walk(operand)
+        ):
+            return True
+    return False
+
+
+# -- the probes themselves ---------------------------------------------------
+
+
+def _p_fsync_file(ctx: FileContext, fn: ast.AST) -> bool:
+    return _always_before(ctx, fn, "os.fsync", "os.replace")
+
+
+def _p_fsync_dir(ctx: FileContext, fn: ast.AST) -> bool:
+    return _always_after(ctx, fn, "os.replace", "fsync_dir")
+
+
+def _p_states_before_manifest(ctx: FileContext, fn: ast.AST) -> bool:
+    """The per-entry state writes (the ``atomic_write`` inside the for
+    loop) come before the manifest write (the one outside). Lexical
+    line order, deliberately: the CFG's zero-iteration loop edge means
+    "some path reaches the manifest without a state write" is true
+    even for correct code (no entries → early return anyway), so the
+    ordering question here is about SOURCE order of the two call
+    sites, which is what a reordering mutation changes."""
+    looped: list[int] = []
+    straight: list[int] = []
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call) and _is_call_to(ctx, sub, "atomic_write"):
+            in_loop = any(
+                isinstance(anc, (ast.For, ast.While))
+                for anc in ctx.ancestors(sub)
+                if anc is not fn
+            )
+            (looped if in_loop else straight).append(sub.lineno)
+    if not looped or not straight:
+        return False
+    return max(looped) < min(straight)
+
+
+def _p_gc_after_manifest(ctx: FileContext, fn: ast.AST) -> bool:
+    return _always_before(ctx, fn, "atomic_write", "_gc_locked")
+
+
+def _p_gc_under_lock(ctx: FileContext, fn: ast.AST) -> bool:
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call) and _is_call_to(ctx, sub, "_gc_locked"):
+            if not ctx.under_lock(sub):
+                return False
+            return True
+    return False
+
+
+def _p_recovery_walk(ctx: FileContext, fn: ast.AST) -> bool:
+    """The fallback walk the checkpoint model's recovery simulation
+    mirrors: per-job sha256 verification AND a ``continue`` to older
+    generations on inconsistency AND the reset-marker staleness gate."""
+    has_sha = any(
+        isinstance(sub, ast.Call) and _is_call_to(ctx, sub, "sha256")
+        for sub in ast.walk(fn)
+    )
+    has_continue = any(
+        isinstance(sub, ast.Continue) for sub in ast.walk(fn)
+    )
+    return has_sha and has_continue and _mentions_str(fn, "reset_seq")
+
+
+def _p_quiescent_gate(ctx: FileContext, fn: ast.AST) -> bool:
+    return _always_before(ctx, fn, "_quiescent", "checkpoint")
+
+
+def _p_quiescent_probes(ctx: FileContext, fn: ast.AST) -> bool:
+    return _mentions_str(fn, "pending_messages") and _mentions_str(
+        fn, "inflight"
+    )
+
+
+def _p_owns_compares_self(ctx: FileContext, fn: ast.AST) -> bool:
+    return _compare_mentions(fn, "self_id")
+
+
+def _p_departing_self_raises(ctx: FileContext, fn: ast.AST) -> bool:
+    return any(isinstance(sub, ast.Raise) for sub in ast.walk(fn))
+
+
+def _p_filter_consults_owns(ctx: FileContext, fn: ast.AST) -> bool:
+    return any(
+        isinstance(sub, ast.Call) and _is_call_to(ctx, sub, "owns")
+        for sub in ast.walk(fn)
+    )
+
+
+def _p_checks_boot(ctx: FileContext, fn: ast.AST) -> bool:
+    return _compare_mentions(fn, "_last_boot")
+
+
+def _p_bumps_generation(ctx: FileContext, fn: ast.AST) -> bool:
+    return any(
+        isinstance(sub, ast.AugAssign)
+        and isinstance(sub.target, ast.Attribute)
+        and sub.target.attr == "_generation"
+        for sub in ast.walk(fn)
+    )
+
+
+def _p_stale_excludes_keyframes(ctx: FileContext, fn: ast.AST) -> bool:
+    """The staleness classification must start from ``not
+    header.keyframe`` — a keyframe classified stale is the park."""
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "stale"
+            for t in sub.targets
+        ):
+            return any(
+                isinstance(part, ast.UnaryOp)
+                and isinstance(part.op, ast.Not)
+                and _mentions_attr(part, "keyframe")
+                for part in ast.walk(sub.value)
+            )
+    return False
+
+
+def _p_clear_bumps(ctx: FileContext, fn: ast.AST) -> bool:
+    return _bumps_on_every_path(ctx, fn, "state_epoch")
+
+
+def _p_get_folds_publish_epoch(ctx: FileContext, fn: ast.AST) -> bool:
+    return _mentions_str(fn, "publish_epoch") or _mentions_attr(
+        fn, "publish_epoch"
+    )
+
+
+def _p_encode_keyframes_on_epoch_change(
+    ctx: FileContext, fn: ast.AST
+) -> bool:
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Compare) and any(
+            isinstance(op, ast.NotEq) for op in sub.ops
+        ):
+            mentions_epoch = any(
+                isinstance(part, ast.Name) and "epoch" in part.id
+                for operand in (sub.left, *sub.comparators)
+                for part in ast.walk(operand)
+            )
+            if mentions_epoch:
+                return True
+    return False
+
+
+def _p_swap_bumps_publish_epoch(ctx: FileContext, fn: ast.AST) -> bool:
+    return bool(_augassign_nodes(ctx, fn, "publish_epoch"))
+
+
+# -- the binding table -------------------------------------------------------
+
+_SRC = "src/esslivedata_tpu"
+
+BINDINGS: tuple[Binding, ...] = (
+    Binding(
+        model="checkpoint",
+        path=f"{_SRC}/durability/checkpoint.py",
+        probes=(
+            Probe(
+                "atomic_write.fsync_file",
+                "atomic_write",
+                _p_fsync_file,
+                "os.fsync(file) on every path before os.replace",
+            ),
+            Probe(
+                "atomic_write.fsync_dir",
+                "atomic_write",
+                _p_fsync_dir,
+                "fsync_dir on every path after os.replace",
+            ),
+            Probe(
+                "checkpoint.states_before_manifest",
+                "CheckpointPlane.checkpoint",
+                _p_states_before_manifest,
+                "per-entry state writes before the manifest write",
+            ),
+            Probe(
+                "checkpoint.gc_after_manifest",
+                "CheckpointPlane.checkpoint",
+                _p_gc_after_manifest,
+                "_gc_locked only after a successful manifest write",
+            ),
+            Probe(
+                None,
+                "CheckpointPlane.checkpoint",
+                _p_gc_under_lock,
+                "_gc_locked called inside the plane's lock region",
+            ),
+            Probe(
+                None,
+                "CheckpointPlane.note_reset",
+                lambda ctx, fn: any(
+                    isinstance(sub, ast.Call)
+                    and _is_call_to(ctx, sub, "atomic_write")
+                    for sub in ast.walk(fn)
+                ),
+                "reset marker persisted via atomic_write",
+            ),
+        ),
+    ),
+    Binding(
+        model="checkpoint",
+        path=f"{_SRC}/durability/replay.py",
+        probes=(
+            Probe(
+                None,
+                "load_latest_manifest",
+                _p_recovery_walk,
+                "recovery walk: sha256 verify + older-generation "
+                "fallback (continue) + reset-marker staleness gate",
+            ),
+        ),
+    ),
+    Binding(
+        model="replay",
+        path=f"{_SRC}/core/orchestrating_processor.py",
+        probes=(
+            Probe(
+                "checkpoint.quiescent_gate",
+                "OrchestratingProcessor._maybe_checkpoint",
+                _p_quiescent_gate,
+                "_quiescent() gates every path to plane.checkpoint",
+            ),
+            Probe(
+                None,
+                "OrchestratingProcessor._quiescent",
+                _p_quiescent_probes,
+                "quiescence probes both batcher pending_messages and "
+                "pipeline inflight",
+            ),
+            Probe(
+                None,
+                "OrchestratingProcessor._bookmarks",
+                lambda ctx, fn: _mentions_str(fn, "positions"),
+                "bookmarks come from the transport's positions()",
+            ),
+        ),
+    ),
+    Binding(
+        model="fleet",
+        path=f"{_SRC}/fleet/assignment.py",
+        probes=(
+            Probe(
+                "owns.compares_self",
+                "FleetAssignment.owns",
+                _p_owns_compares_self,
+                "owns() compares the rendezvous owner against self_id",
+            ),
+            Probe(
+                None,
+                "FleetAssignment.set_replicas",
+                _p_departing_self_raises,
+                "set_replicas raises instead of letting a departed "
+                "self keep processing",
+            ),
+            Probe(
+                None,
+                "FleetAssignment.group_key",
+                lambda ctx, fn: any(
+                    isinstance(sub, ast.Name) and sub.id == "fuse_tag"
+                    for sub in ast.walk(fn)
+                ),
+                "canonical group key folds the fuse tag in (stream "
+                "alone would collide fused groups across replicas)",
+            ),
+        ),
+    ),
+    Binding(
+        model="fleet",
+        path=f"{_SRC}/core/job_manager.py",
+        probes=(
+            Probe(
+                "filter.consults_owns",
+                "JobManager._apply_fleet_filter",
+                _p_filter_consults_owns,
+                "the window path consults fleet.owns() per fuse group",
+            ),
+        ),
+    ),
+    Binding(
+        model="relay",
+        path=f"{_SRC}/fleet/relay.py",
+        probes=(
+            Probe(
+                "on_blob.checks_boot",
+                "RelayChannel.on_blob",
+                _p_checks_boot,
+                "resync classification compares the upstream boot id "
+                "against _last_boot",
+            ),
+            Probe(
+                "on_blob.bumps_generation",
+                "RelayChannel.on_blob",
+                _p_bumps_generation,
+                "hard resync bumps _generation (the downstream token)",
+            ),
+            Probe(
+                "on_blob.stale_excludes_keyframes",
+                "RelayChannel.on_blob",
+                _p_stale_excludes_keyframes,
+                "staleness classification excludes keyframes "
+                "(not header.keyframe and ...)",
+            ),
+        ),
+    ),
+    Binding(
+        model="epoch",
+        path=f"{_SRC}/core/job.py",
+        probes=(
+            Probe(
+                "clear.bumps_epoch",
+                "Job.clear",
+                _p_clear_bumps,
+                "clear() bumps state_epoch on every exit path",
+            ),
+            Probe(
+                "note_state_lost.bumps_epoch",
+                "Job.note_state_lost",
+                _p_clear_bumps,
+                "note_state_lost() bumps state_epoch on every exit "
+                "path",
+            ),
+            Probe(
+                "get.folds_publish_epoch",
+                "Job.get",
+                _p_get_folds_publish_epoch,
+                "get() folds the workflow's publish_epoch into the "
+                "published token",
+            ),
+        ),
+    ),
+    Binding(
+        model="epoch",
+        path=f"{_SRC}/serving/delta.py",
+        probes=(
+            Probe(
+                "encoder.keyframes_on_epoch_change",
+                "DeltaEncoder.encode",
+                _p_encode_keyframes_on_epoch_change,
+                "encode() keyframes when the epoch token changes",
+            ),
+        ),
+    ),
+    Binding(
+        model="epoch",
+        path=f"{_SRC}/workloads/powder_focus.py",
+        probes=(
+            Probe(
+                None,
+                "PowderFocusWorkflow.set_calibration",
+                _p_swap_bumps_publish_epoch,
+                "calibration swap bumps publish_epoch",
+            ),
+        ),
+    ),
+    Binding(
+        model="epoch",
+        path=f"{_SRC}/workloads/imaging.py",
+        probes=(
+            Probe(
+                None,
+                "ImagingViewWorkflow.set_flatfield",
+                _p_swap_bumps_publish_epoch,
+                "flat-field swap bumps publish_epoch",
+            ),
+        ),
+    ),
+)
+
+
+def _find_method_anywhere(ctx: FileContext, spec: str) -> ast.AST | None:
+    """Fallback for probes specified by bare method name where the
+    owning class name is an implementation detail (workload modules)."""
+    _, _, fn_name = spec.rpartition(".")
+    defs = ctx.defs_by_name.get(fn_name, ())
+    return defs[0] if defs else None
+
+
+def evaluate_binding(binding: Binding, source: str) -> BindingOutcome:
+    """Run one binding's probes over one file's source. Raises
+    ``SyntaxError`` upward (an unparseable protocol module is an
+    analysis error, not drift)."""
+    outcome = BindingOutcome(binding)
+    ctx = FileContext(binding.path, source)
+    marked = any(
+        a.key == "protocol" and a.value == binding.model
+        for a in parse_annotations(source)
+    )
+    if not marked:
+        outcome.drift.append(
+            (
+                1,
+                f"file is bound to the {binding.model!r} protocol model "
+                f"but carries no '# graft: protocol={binding.model}' "
+                "marker — add the marker at the protocol's transition "
+                "site (or update the binding if the protocol moved)",
+            )
+        )
+    for probe in binding.probes:
+        fn = _find_function(ctx, probe.function)
+        if fn is None and "." in probe.function:
+            fn = _find_method_anywhere(ctx, probe.function)
+        if fn is None:
+            outcome.drift.append(
+                (
+                    1,
+                    f"{binding.model!r} model binds "
+                    f"{probe.function}() but the function no longer "
+                    f"exists in {binding.path} — update the model and "
+                    "binding together",
+                )
+            )
+            continue
+        held = bool(probe.check(ctx, fn))
+        if probe.fact is None:
+            if not held:
+                outcome.drift.append(
+                    (
+                        fn.lineno,
+                        f"{binding.model!r} model requires "
+                        f"[{probe.describe}] in {probe.function}(), "
+                        "not found — the model has drifted from the "
+                        "source (or the guard was lost)",
+                    )
+                )
+        else:
+            outcome.facts[probe.fact] = held
+            outcome.anchors[probe.fact] = (fn.lineno, probe.describe)
+    return outcome

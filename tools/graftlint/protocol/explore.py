@@ -1,0 +1,98 @@
+"""Bounded exhaustive exploration of protocol models (ADR 0124).
+
+Breadth-first search over the model's transition system with parent
+pointers, so the first invariant violation found is automatically a
+*minimal* counterexample (fewest transitions from the initial state) —
+the trace a human debugs from, not an arbitrary witness.
+
+Partial-order reduction, ample-set style but deliberately modest: a
+model may flag a :class:`~esslivedata_tpu.harness.protocol_models.Step`
+``invisible`` when it commutes with every co-enabled transition and
+cannot change the invariant's verdict (the model documents the
+argument at the flag site). From a state offering invisible steps the
+explorer expands only the FIRST one — unless its target was already
+visited, in which case it falls back to full expansion (the cycle
+proviso: a reduction that re-enters explored territory could starve
+the visible transitions forever). Everything else is plain BFS with
+hash-consed states, which for these models (hundreds to a few
+thousand states) is the real workhorse; the reduction exists for the
+fleet model's view-advance lattice, where it cuts the interleaving
+factorial to a single representative per antichain.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Hashable
+
+if TYPE_CHECKING:
+    from esslivedata_tpu.harness.protocol_models import ProtocolModel
+
+
+@dataclass
+class ExplorationResult:
+    #: ``(message, trace)`` for the first (minimal) violation found,
+    #: where ``trace`` is the step-label path from the initial state.
+    violation: tuple[str, tuple[str, ...]] | None = None
+    #: Distinct states visited.
+    states: int = 0
+    #: True when the state budget cut exploration short (JGL206): the
+    #: absence of a violation then proves nothing.
+    truncated: bool = False
+    #: Step labels observed (diagnostics / model-coverage asserts).
+    labels: set[str] = field(default_factory=set)
+
+
+def explore(model: "ProtocolModel", *, max_states: int = 20000) -> ExplorationResult:
+    """Exhaustively explore ``model`` up to ``max_states`` distinct
+    states; returns the minimal counterexample if any invariant
+    violation is reachable."""
+    result = ExplorationResult()
+    init = model.initial()
+    verdict = model.invariant(init)
+    if verdict:
+        result.violation = (verdict, ())
+        result.states = 1
+        return result
+
+    visited: set[Hashable] = {init}
+    # parent[state] = (previous state, step label) for trace rebuild.
+    parent: dict[Hashable, tuple[Hashable, str] | None] = {init: None}
+    frontier: list[Hashable] = [init]
+
+    while frontier:
+        next_frontier: list[Hashable] = []
+        for state in frontier:
+            steps = model.steps(state)
+            invisible = [s for s in steps if s.invisible]
+            if invisible and invisible[0].target not in visited:
+                # Ample set: one representative of the commuting
+                # antichain; the proviso above forces full expansion
+                # whenever the representative makes no progress.
+                steps = [invisible[0]]
+            for step in steps:
+                result.labels.add(step.label)
+                if step.target in visited:
+                    continue
+                visited.add(step.target)
+                parent[step.target] = (state, step.label)
+                verdict = model.invariant(step.target)
+                if verdict:
+                    trace: list[str] = []
+                    cursor: Hashable = step.target
+                    while parent[cursor] is not None:
+                        prev, label = parent[cursor]  # type: ignore[misc]
+                        trace.append(label)
+                        cursor = prev
+                    result.violation = (verdict, tuple(reversed(trace)))
+                    result.states = len(visited)
+                    return result
+                if len(visited) >= max_states:
+                    result.truncated = True
+                    result.states = len(visited)
+                    return result
+                next_frontier.append(step.target)
+        frontier = next_frontier
+
+    result.states = len(visited)
+    return result

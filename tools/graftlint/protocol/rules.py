@@ -1,0 +1,64 @@
+"""JGL200-series rule registrations (the protocol pass, ADR 0124).
+
+Metadata only: protocol rules are driven by the model-checking engine
+(``engine.py``), not dispatched per file/project like the static
+scopes, but they live in the one ``RULES`` table so ``--list-rules``,
+``--select`` validation, ``--explain``, SARIF rule metadata and the
+JGL024 stale-suppression audit all see them. This module imports
+neither the models nor the source modules — rule *identity* must exist
+even where the pass itself cannot run (diff mode, codec sub-skip).
+"""
+
+from __future__ import annotations
+
+from ..registry import protocol_rule
+
+
+def _engine_driven(*_args, **_kwargs):
+    """Protocol checks run in ``protocol.engine`` by exploring the
+    bound models; the registry entry carries identity and summary."""
+    return ()
+
+
+for _rule_id, _summary in (
+    (
+        "JGL200",
+        "protocol model drifted from the source it claims to bind "
+        "(function missing, annotation marker absent, or a "
+        "structurally-required guard not found)",
+    ),
+    (
+        "JGL201",
+        "fleet ownership violated: two replicas own one (stream, "
+        "fuse-key) group, or a group is unowned after quiesce",
+    ),
+    (
+        "JGL202",
+        "checkpoint durability violated: a crash point leaves no "
+        "consistent recoverable generation, or replay from the "
+        "bookmark is not exactly-once",
+    ),
+    (
+        "JGL203",
+        "relay resync violated: an unsignaled reset can splice into "
+        "the delta stream, or the relay parks on a restarted hub",
+    ),
+    (
+        "JGL204",
+        "epoch discipline violated: a state-mutating path reaches the "
+        "next published frame without an epoch bump (delta bridges "
+        "two accumulations)",
+    ),
+    (
+        "JGL205",
+        "dump_state/restore codec does not round-trip a tick_contract "
+        "family to identical avals and staging keys at lowering level",
+    ),
+    (
+        "JGL206",
+        "protocol exploration exceeded its state budget (model too "
+        "large to verify exhaustively — shrink it or raise the budget "
+        "deliberately)",
+    ),
+):
+    protocol_rule(_rule_id, _summary)(_engine_driven)

@@ -16,6 +16,12 @@ Three rule scopes share one id namespace and one ``RULES`` table:
   per-file/project dispatchers; the registry entry exists so rule
   identity (``--select``/``--explain``/SARIF metadata/JGL024) works
   even where jax is unavailable and the pass is skipped.
+- ``scope="protocol"`` — the JGL200-series model-checker rules. Their
+  findings come from the protocol engine (``protocol/engine.py``):
+  state-machine models of the crash/membership/epoch protocols, bound
+  to the source by dataflow probes and explored exhaustively. Same
+  registration contract as trace: identity lives here, findings come
+  from the engine.
 
 Registration order is the report order for same-line findings, so
 register in id order.
@@ -77,3 +83,9 @@ def trace_rule(rule_id: str, summary: str) -> Callable[[Check], Check]:
     """Register a trace-pass rule id (JGL100-series). The check is a
     placeholder — findings are produced by the lowering engine."""
     return _register(rule_id, summary, "trace")
+
+
+def protocol_rule(rule_id: str, summary: str) -> Callable[[Check], Check]:
+    """Register a protocol-pass rule id (JGL200-series). The check is a
+    placeholder — findings are produced by the model-checking engine."""
+    return _register(rule_id, summary, "protocol")

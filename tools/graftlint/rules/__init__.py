@@ -6,4 +6,5 @@ from . import (  # noqa: F401
     robustness_rules,
     whole_program,
 )
+from ..protocol import rules as protocol_rules  # noqa: F401  (JGL200-series)
 from ..trace import rules as trace_rules  # noqa: F401  (JGL100-series)

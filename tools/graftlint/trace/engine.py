@@ -64,6 +64,9 @@ class TraceReport:
     skipped: str | None = None
     #: family -> contract fingerprint (baseline material).
     fingerprints: dict[str, dict] = field(default_factory=dict)
+    #: True when the results were replayed from the lowering cache
+    #: (no jax import, no lowering — see ``lowering_cache``).
+    cache_hit: bool = False
 
 
 def _import_jax():
@@ -343,17 +346,47 @@ def run_trace(
     specs=None,
     select: frozenset[str] | None = None,
     baseline: dict[str, dict] | None = None,
+    cache_path: str | None = None,
 ) -> TraceReport:
     """Run the trace pass; never raises for environment gaps — a
     missing jax (or registry) sets ``skipped`` so callers surface a
-    visible notice instead of a silent green."""
+    visible notice instead of a silent green.
+
+    ``cache_path`` enables the lowering cache: when the source digest
+    matches, the raw results replay from disk with no jax import.
+    Baseline drift and ``select`` apply AFTER either path, so a cache
+    hit behaves identically to a fresh run. Explicit ``specs`` bypass
+    the cache (the digest only describes the on-disk tree)."""
     report = TraceReport()
+
+    digest: str | None = None
+    if cache_path is not None and specs is None:
+        from ..lowering_cache import load_cache, source_digest
+
+        digest = source_digest()
+        cached = load_cache(cache_path, digest)
+        if cached is not None:
+            report.findings = [
+                Finding(
+                    entry["path"],
+                    int(entry["line"]),
+                    entry["rule"],
+                    entry["message"],
+                )
+                for entry in cached["findings"]
+            ]
+            report.errors = list(cached["errors"])
+            report.fingerprints = dict(cached["fingerprints"])
+            report.cache_hit = True
+            return _post_process(report, select, baseline)
+
     try:
         jax = _import_jax()
     except ImportError as exc:
         report.skipped = f"jax unavailable ({exc})"
         return report
     try:
+        explicit = specs is not None
         if specs is None:
             specs = _load_specs()
     except Exception as exc:
@@ -379,6 +412,34 @@ def run_trace(
         if fingerprint is not None:
             report.fingerprints[spec.family] = fingerprint
 
+    if (
+        cache_path is not None
+        and not explicit
+        and digest is not None
+        and not report.errors
+    ):
+        # Only clean, complete sweeps are worth replaying: an errored
+        # run must re-lower next time so the error stays visible.
+        from ..lowering_cache import store_cache
+
+        store_cache(
+            cache_path,
+            digest,
+            findings=report.findings,
+            errors=report.errors,
+            fingerprints=report.fingerprints,
+        )
+
+    return _post_process(report, select, baseline)
+
+
+def _post_process(
+    report: TraceReport,
+    select: frozenset[str] | None,
+    baseline: dict[str, dict] | None,
+) -> TraceReport:
+    """The shared tail of fresh and cached runs: baseline drift, then
+    the select filter, then deterministic ordering."""
     if baseline is not None:
         report.findings.extend(
             _baseline_drift(report.fingerprints, baseline)
