@@ -2521,6 +2521,244 @@ def bench_pipeline(args) -> dict:
     return line
 
 
+def bench_decode(args) -> dict:
+    """Batch decode plane vs the per-message reference decoder (ADR 0125).
+
+    Builds real ev44 wire polls and measures the decode STAGE both ways
+    through the real adapter + accumulator path: (a) per message —
+    ``adapt`` -> ``DetectorEvents`` ndarrays -> staging-buffer append
+    per message; (b) batched — ``adapt_batch`` -> ``EventChunkRef``
+    headers -> one arena landing at ``get()``. Asserts the da00 wire
+    out of a real JobManager is byte-identical across the two decode
+    modes (the rollout gate's non-negotiable), that the batch decoder
+    clears the >= 3x decode-stage events/s floor, and — through a real
+    IngestPipeline whose decode worker runs the batch decoder — that
+    decode is no longer the max-utilization stage. One JSON line on
+    stderr.
+    """
+    from esslivedata_tpu.config import JobId, WorkflowConfig, WorkflowSpec
+    from esslivedata_tpu.core.ingest_pipeline import IngestPipeline
+    from esslivedata_tpu.core.job_manager import JobFactory, JobManager
+    from esslivedata_tpu.core.timestamp import Timestamp
+    from esslivedata_tpu.kafka import wire
+    from esslivedata_tpu.kafka.da00_compat import dataarray_to_da00
+    from esslivedata_tpu.kafka.message_adapter import (
+        KafkaToDetectorEventsAdapter,
+    )
+    from esslivedata_tpu.kafka.source import FakeKafkaMessage
+    from esslivedata_tpu.kafka.stream_mapping import (
+        InputStreamKey,
+        StreamMapping,
+    )
+    from esslivedata_tpu.kafka.wire import encode_da00
+    from esslivedata_tpu.preprocessors.event_data import ToEventBatch
+    from esslivedata_tpu.workflows import WorkflowFactory
+    from esslivedata_tpu.workflows.detector_view import (
+        DetectorViewParams,
+        DetectorViewWorkflow,
+        project_logical,
+    )
+
+    side = int(np.sqrt(min(args.pixels, 1 << 16)))
+    n_pixel = side * side
+    det = np.arange(n_pixel).reshape(side, side)
+    # ~200 events/message is a representative ESS pulse chunk: small
+    # enough that per-message Python+allocation overhead dominates the
+    # reference path, exactly the regime the batch decoder targets.
+    events_per_msg = 200
+    n_msgs = int(max(128, min(1200, args.events // events_per_msg)))
+    n_polls = max(4, args.batches)
+    # Enough decoded messages per mode that the faster path still
+    # accumulates a stable wall time on a noisy CI host.
+    reps = max(1, -(-4000 // (n_msgs * n_polls)))
+
+    mapping = StreamMapping(
+        instrument="bench",
+        detectors={
+            InputStreamKey(topic="bench_det", source_name="panel_a"): "det0"
+        },
+    )
+
+    rng = np.random.default_rng(125)
+    polls: list[list] = []
+    for p in range(n_polls):
+        raws = []
+        for m in range(n_msgs):
+            tof = rng.uniform(0.0, 71e6, events_per_msg).astype(np.int32)
+            pid = rng.integers(0, n_pixel, events_per_msg).astype(np.int32)
+            buf = wire.encode_ev44(
+                "panel_a",
+                p * n_msgs + m,
+                np.array([1_000_000 + p * n_msgs + m], dtype=np.int64),
+                np.array([0], dtype=np.int32),
+                tof,
+                pixel_id=pid,
+            )
+            raws.append(FakeKafkaMessage(buf, "bench_det"))
+        polls.append(raws)
+    poll_bytes = sum(len(r.value()) for r in polls[0])
+
+    adapters = {
+        "per_message": KafkaToDetectorEventsAdapter(
+            mapping, batch_wire=False
+        ),
+        "batch": KafkaToDetectorEventsAdapter(mapping, batch_wire=True),
+    }
+
+    def decode_poll(mode: str, acc: ToEventBatch, raws):
+        adapter = adapters[mode]
+        if mode == "batch":
+            for msg in adapter.adapt_batch(raws):
+                acc.add(msg.timestamp, msg.value)
+        else:
+            for raw in raws:
+                msg = adapter.adapt(raw)
+                acc.add(msg.timestamp, msg.value)
+        return acc.get()
+
+    events_per_sec: dict[str, float] = {}
+    staged_n: dict[str, int] = {}
+    for mode in ("per_message", "batch"):
+        acc = ToEventBatch()
+        staged = decode_poll(mode, acc, polls[0])  # warm pools/buffers
+        staged_n[mode] = staged.n_events
+        del staged
+        acc.release_buffers()
+        start = time.perf_counter()
+        for _ in range(reps):
+            for raws in polls:
+                staged = decode_poll(mode, acc, raws)
+                del staged  # returns the arena lease to the pool
+                acc.release_buffers()
+        dt = time.perf_counter() - start
+        events_per_sec[mode] = (
+            reps * n_polls * n_msgs * events_per_msg / dt
+        )
+    assert staged_n["per_message"] == staged_n["batch"], staged_n
+    speedup = events_per_sec["batch"] / events_per_sec["per_message"]
+
+    # Byte-identity: decode mode may not change a single da00 wire byte
+    # out of the real JobManager path (same windows, same job sequence).
+    method = args.method if args.method in ("scatter", "sort") else "scatter"
+
+    def make_mgr() -> JobManager:
+        reg = WorkflowFactory()
+        spec = WorkflowSpec(
+            instrument="bench", name="dv_decode", source_names=["det0"]
+        )
+        reg.register_spec(spec).attach_factory(
+            lambda *, source_name, params: DetectorViewWorkflow(
+                projection=project_logical(det),
+                params=DetectorViewParams(histogram_method=method),
+            )
+        )
+        mgr = JobManager(job_factory=JobFactory(reg), job_threads=2)
+        mgr.schedule_job(
+            WorkflowConfig(
+                identifier=spec.identifier, job_id=JobId(source_name="det0")
+            )
+        )
+        return mgr
+
+    t0 = Timestamp.from_ns(0)
+    n_windows = min(n_polls, 4)
+    wire_out: dict[str, list[list[bytes]]] = {}
+    for mode in ("per_message", "batch"):
+        mgr = make_mgr()
+        acc = ToEventBatch()
+        staged = decode_poll(mode, acc, polls[0])
+        mgr.process_jobs(
+            {"det0": staged}, start=t0, end=Timestamp.from_ns(1)
+        )  # warm/compile
+        acc.release_buffers()
+        wire_out[mode] = []
+        for i in range(n_windows):
+            staged = decode_poll(mode, acc, polls[i])
+            out = mgr.process_jobs(
+                {"det0": staged}, start=t0, end=Timestamp.from_ns(2 + i)
+            )
+            acc.release_buffers()
+            wire_out[mode].append(
+                [
+                    encode_da00(name, 12345, dataarray_to_da00(da))
+                    for res in out
+                    for name, da in res.outputs.items()
+                ]
+            )
+        mgr.shutdown()
+    for w, (ref, bat) in enumerate(
+        zip(wire_out["per_message"], wire_out["batch"])
+    ):
+        assert ref == bat, (
+            f"window {w}: batch-decode da00 wire != per-message wire"
+        )
+
+    # Utilization: a real IngestPipeline whose decode worker runs the
+    # batch decoder end to end (adapt_batch -> arena -> StagedEvents).
+    # The acceptance claim is relative — decode is no longer the
+    # bottleneck stage — so it holds at smoke scale too.
+    mgr_p = make_mgr()
+    published: list = []
+
+    def pipe_decode(raws):
+        acc = ToEventBatch()
+        for msg in adapters["batch"].adapt_batch(raws):
+            acc.add(msg.timestamp, msg.value)
+        staged = acc.get().detach()
+        acc.release_buffers()
+        return {"det0": staged}, {}, None
+
+    pipe = IngestPipeline(
+        job_manager=mgr_p,
+        decode=pipe_decode,
+        publish=lambda results, end: published.append(results),
+        depth=2,
+        flatten_workers=2,
+        name="bench-decode",
+    )
+    pipe.submit(polls[0], start=t0, end=Timestamp.from_ns(1))  # warm
+    assert pipe.flush(timeout=120), "decode pipeline warm-up did not drain"
+    pipe.stats()  # reset timers: compile cost stays out of utilization
+    published.clear()
+    for i in range(n_polls):
+        pipe.submit(polls[i], start=t0, end=Timestamp.from_ns(2 + i))
+    assert pipe.flush(timeout=300), "decode pipeline did not drain"
+    stats = pipe.stats()
+    pipe.stop(drain=True)
+    mgr_p.shutdown()
+    assert len(published) == n_polls, (
+        f"dropped polls: published {len(published)} of {n_polls}"
+    )
+    util = stats["utilization"]
+    max_stage = max(util, key=util.get) if util else None
+
+    line = {
+        "metric": "decode_plane",
+        "unit": "events/s",
+        # Graded value: decode-stage throughput with the batch decoder.
+        "value": events_per_sec["batch"],
+        "per_message_events_per_sec": events_per_sec["per_message"],
+        "batch_vs_per_message_speedup": round(speedup, 2),
+        "wire_mb_per_poll": round(poll_bytes / 1e6, 3),
+        "messages_per_poll": n_msgs,
+        "events_per_message": events_per_msg,
+        "polls": n_polls,
+        "wire_byte_identical": True,
+        "pipeline_stage_utilization": {
+            k: round(v, 4) for k, v in util.items()
+        },
+        "pipeline_max_stage": max_stage,
+        "decode_not_max_stage": max_stage != "decode",
+    }
+    emit_line(line)
+    # The acceptance floor (ADR 0125): batch decode >= 3x the
+    # per-message reference on the decode stage, and decode off the
+    # critical path of the pipelined ingest.
+    assert speedup >= 3.0, line
+    assert max_stage != "decode", line
+    return line
+
+
 def bench_latency(args) -> None:
     """p99 ingest->publish latency through a real detector service.
 
@@ -2945,6 +3183,7 @@ def run_benchmark(args, platform: str) -> dict:
             lambda: bench_telemetry(args),
             lambda: bench_mesh(args),
             lambda: bench_pipeline(args),
+            lambda: bench_decode(args),
             lambda: bench_latency(args),
         ):
             try:
@@ -3255,6 +3494,17 @@ def _parse_args():
         "(ADR 0111) on the ambient backend and exit: stage overlap, "
         "per-stage utilization, bit-identical parity (dev flag, like "
         "--multijob; also runs under --all and --smoke)",
+    )
+    parser.add_argument(
+        "--decode",
+        action="store_true",
+        help="Run ONLY the batch-decode-plane scenario (ADR 0125) and "
+        "exit: per-message vs batched ev44 wire decode through the real "
+        "adapter + accumulator path — batch decoder >= 3x decode-stage "
+        "events/s asserted, da00 wire byte-identical across decode "
+        "modes, and decode no longer the max-utilization stage of a "
+        "real IngestPipeline (dev flag, like --multijob; also runs "
+        "under --all and --smoke)",
     )
     parser.add_argument(
         "--publish",
@@ -3693,6 +3943,37 @@ def _smoke_main(args) -> int:
                 problems.append(f"pipeline line missing {field!r}")
         if not pipe_line.get("value", 0) > 0:
             problems.append("pipeline throughput non-positive")
+    # Batch-decode-plane control (ADR 0125): real ev44 wire through the
+    # real adapter + accumulator + JobManager path in both decode
+    # modes; the scenario itself asserts the >= 3x decode-stage floor,
+    # the cross-mode da00 byte identity and decode off the pipeline's
+    # critical path, and this guards the report's structure.
+    try:
+        dec_line = bench_decode(args)
+    except Exception:
+        traceback.print_exc()
+        problems.append("decode scenario raised")
+    else:
+        for field in (
+            "value",
+            "per_message_events_per_sec",
+            "batch_vs_per_message_speedup",
+            "wire_byte_identical",
+            "pipeline_stage_utilization",
+            "decode_not_max_stage",
+        ):
+            if dec_line.get(field) is None:
+                problems.append(f"decode line missing {field!r}")
+        if not dec_line.get("batch_vs_per_message_speedup", 0.0) >= 3.0:
+            problems.append(
+                "batch decoder under the 3x decode-stage floor"
+            )
+        if not dec_line.get("wire_byte_identical"):
+            problems.append("decode modes not da00 byte-identical")
+        if not dec_line.get("decode_not_max_stage"):
+            problems.append(
+                "decode still the max-utilization pipeline stage"
+            )
     if problems:
         print("SMOKE FAIL: " + "; ".join(problems), file=sys.stderr)
         return 1
@@ -3705,8 +3986,10 @@ def _smoke_main(args) -> int:
         "byte-identical reconstruction, churn kill-and-restart "
         "replayed byte-identical with a 0-compile warmed commit, mesh "
         "tier at 1 execute/slice/tick with single-device parity, "
-        "pipelined ingest drained with parity, SLO chaos drill "
-        "contained with the rule gate green and the control red",
+        "pipelined ingest drained with parity, batch decode plane over "
+        "the 3x floor with cross-mode da00 parity and decode off the "
+        "critical path, SLO chaos drill contained with the rule gate "
+        "green and the control red",
         file=sys.stderr,
     )
     return 0
@@ -3733,6 +4016,13 @@ def main() -> None:
         if args.batches is None:
             args.batches = 16
         bench_pipeline(args)
+        sys.exit(0)
+    if args.decode:
+        if args.events is None:
+            args.events = 1 << 17
+        if args.batches is None:
+            args.batches = 8
+        bench_decode(args)
         sys.exit(0)
     if args.publish:
         if args.events is None:

@@ -72,6 +72,11 @@ REQUIRED_FAMILIES = (
     # family still exposes them with zero samples.
     "livedata_calibration_swaps",
     "livedata_events_filtered",
+    # Batch decode plane (ADR 0125): poll-size histogram, wire-byte
+    # counter and the quarantine counter are always-registered.
+    "livedata_decode_batch_size",
+    "livedata_decode_bytes_total",
+    "livedata_decode_errors_total",
 )
 
 
@@ -110,6 +115,10 @@ def main() -> int:
         "LIVEDATA_FORCE_CPU": "1",
         "JAX_PLATFORMS": "cpu",
         "PYTHONPATH": str(Path(__file__).resolve().parent.parent / "src"),
+        # The smoke exercises the batch decode plane (ADR 0125): the
+        # gated rollout path must keep the whole metrics/serving/
+        # checkpoint surface green, not just the per-message default.
+        "LIVEDATA_BATCH_DECODE": "1",
     }
     service = subprocess.Popen(
         [

@@ -53,14 +53,153 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Hashable
 
+import numpy as np
+
 __all__ = [
+    "DecodeArena",
+    "DecodeArenaPool",
     "DeviceEventCache",
     "EventIngest",
     "StreamStageSlot",
     "WindowGeneration",
+    "default_decode_pool",
 ]
 
 logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Decode arenas (ADR 0125): reusable staging landing zones for batch decode
+# ---------------------------------------------------------------------------
+
+#: Floor arena capacity: below this, growth churn dominates reuse.
+_ARENA_MIN = 1 << 12
+#: Free-list depth: the pipelined ingest keeps at most a few windows in
+#: flight, so a deeper pool would only pin dead memory.
+_ARENA_POOL_DEPTH = 4
+
+
+def _arena_capacity(n: int) -> int:
+    """Power-of-two capacity ≥ max(n, floor) — mirrors the event-batch
+    bucketing (ops/event_batch.py) so one steady-state arena per pool
+    slot absorbs every poll size without reallocating."""
+    cap = _ARENA_MIN
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+class DecodeArena:
+    """One pinned (page-locked where the allocator provides it; plain
+    host-contiguous otherwise) staging landing zone for the batch wire
+    decoder: an int32 pixel lane and a float32 time-of-arrival lane that
+    grow geometrically and are reused poll after poll.
+
+    Ownership contract: whoever holds the :class:`_ArenaLease` wrapping
+    an arena owns BOTH lanes outright — views into them
+    (``kafka.wire.Ev44Batch``, the ``EventBatch`` a ref-mode
+    ``ToEventBatch`` emits) stay valid exactly as long as the lease is
+    referenced, and the arena re-enters its pool only when the lease is
+    garbage-collected."""
+
+    __slots__ = ("pixel", "toa", "capacity")
+
+    def __init__(self, capacity: int = _ARENA_MIN) -> None:
+        capacity = _arena_capacity(capacity)
+        self.capacity = capacity
+        self.pixel = np.empty(capacity, dtype=np.int32)
+        self.toa = np.empty(capacity, dtype=np.float32)
+
+    def ensure(self, n: int) -> None:
+        """Grow (never shrink) to hold at least ``n`` events."""
+        if n > self.capacity:
+            cap = _arena_capacity(n)
+            self.capacity = cap
+            self.pixel = np.empty(cap, dtype=np.int32)
+            self.toa = np.empty(cap, dtype=np.float32)
+
+
+class _ArenaLease:
+    """Checkout handle for one arena: proxies the lanes, returns the
+    arena to its pool on finalization. The return is reference-counted
+    by Python itself — a decoded batch keeps its lease alive through
+    ``EventBatch.owner``, so an arena can never be handed to the next
+    poll while a previous window still reads it."""
+
+    __slots__ = ("_pool", "_arena")
+
+    def __init__(self, pool: DecodeArenaPool, arena: DecodeArena) -> None:
+        self._pool = pool
+        self._arena = arena
+
+    @property
+    def pixel(self) -> np.ndarray:
+        return self._arena.pixel
+
+    @property
+    def toa(self) -> np.ndarray:
+        return self._arena.toa
+
+    @property
+    def capacity(self) -> int:
+        return self._arena.capacity
+
+    def __del__(self) -> None:
+        # A finalizer may run during interpreter shutdown, when the
+        # pool's lock/module globals are already torn down — logging
+        # here can itself raise, so this swallow stays silent.
+        try:
+            self._pool._release(self._arena)
+        except Exception:  # graftlint: disable=JGL007
+            pass  # pragma: no cover - interpreter shutdown
+
+
+class DecodeArenaPool:
+    """Bounded free list of :class:`DecodeArena`.
+
+    ``lease(n)`` hands out an arena sized for ``n`` events (reusing a
+    pooled one when available, growing it in place if undersized); the
+    lease's finalizer returns it. Keeping the pool bounded means a
+    pathological burst allocates transient arenas that simply drop on
+    release instead of ratcheting resident memory."""
+
+    def __init__(self, depth: int = _ARENA_POOL_DEPTH) -> None:
+        self._lock = threading.Lock()
+        self._free: list[DecodeArena] = []
+        self._depth = depth
+
+    def lease(self, n: int) -> _ArenaLease:
+        with self._lock:
+            arena = self._free.pop() if self._free else None
+        if arena is None:
+            arena = DecodeArena(n)
+        else:
+            arena.ensure(n)
+        return _ArenaLease(self, arena)
+
+    def _release(self, arena: DecodeArena) -> None:
+        with self._lock:
+            if len(self._free) < self._depth:
+                self._free.append(arena)
+
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+
+_DEFAULT_POOL: DecodeArenaPool | None = None
+_DEFAULT_POOL_LOCK = threading.Lock()
+
+
+def default_decode_pool() -> DecodeArenaPool:
+    """Process-wide arena pool the batch wire decoder leases from when
+    the caller does not bring its own."""
+    global _DEFAULT_POOL
+    if _DEFAULT_POOL is None:
+        with _DEFAULT_POOL_LOCK:
+            if _DEFAULT_POOL is None:
+                _DEFAULT_POOL = DecodeArenaPool()
+    return _DEFAULT_POOL
 
 
 @dataclass(frozen=True)

@@ -108,9 +108,17 @@ class PipelineWindow:
     #: Source data timestamp (ns) of the newest message in this window
     #: (ADR 0120): born at consume from ``MessageBatch.end``, it anchors
     #: every ``livedata_e2e_latency_seconds`` boundary the window
-    #: crosses (decode/staged/published here; fanout/delivery in the
-    #: serving plane via ``JobResult.source_ts_ns``).
+    #: crosses (staged/published here; fanout/delivery in the serving
+    #: plane via ``JobResult.source_ts_ns``).
     source_ts_ns: int | None = None
+    #: Source timestamp (ns) of the OLDEST message in this window: the
+    #: ``stage=decode`` observation anchors here (ADR 0125). Decode is
+    #: batch-granular — one observation per window, not per message —
+    #: and anchoring at the oldest member keeps the histogram an upper
+    #: bound on any single message's decode latency instead of
+    #: understating it by up to the window span. Falls back to
+    #: ``source_ts_ns`` when the batcher provides no per-message view.
+    oldest_ts_ns: int | None = None
 
 
 class IngestPipeline:
@@ -237,17 +245,24 @@ class IngestPipeline:
             self._max_depth, max(1, self._link_monitor.policy().depth)
         )
 
-    def submit(self, payload, *, start=None, end=None) -> int:
+    def submit(
+        self, payload, *, start=None, end=None, oldest_ts_ns=None
+    ) -> int:
         """Enqueue one window; blocks while the pipeline is at depth
         (backpressure — the caller's stall is the load signal). Returns
-        the window's sequence number. Raises a latched worker failure or
-        RuntimeError after ``stop()``."""
+        the window's sequence number. ``oldest_ts_ns`` anchors the
+        batch-granular ``stage=decode`` e2e observation (ADR 0125);
+        omitted, it falls back to the window-end timestamp. Raises a
+        latched worker failure or RuntimeError after ``stop()``."""
         self._reraise_failure()
         window = PipelineWindow(
             seq=-1, payload=payload, start=start, end=end,
             t_submit=time.monotonic(),
             source_ts_ns=(
                 int(end.ns) if hasattr(end, "ns") else None
+            ),
+            oldest_ts_ns=(
+                int(oldest_ts_ns) if oldest_ts_ns is not None else None
             ),
         )
         with self._state_lock:
@@ -447,7 +462,12 @@ class IngestPipeline:
             TRACER.record(
                 "decode", t0, window.stage_s["decode"], window.trace
             )
-            observe_stage("decode", window.source_ts_ns)
+            observe_stage(
+                "decode",
+                window.oldest_ts_ns
+                if window.oldest_ts_ns is not None
+                else window.source_ts_ns,
+            )
             if self._chaos is not None:
                 # Chaos site (ADR 0120): a stalled decode worker — the
                 # shape of a slow preprocessor or GC pause — backs the

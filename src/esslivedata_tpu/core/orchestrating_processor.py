@@ -64,6 +64,20 @@ def _transport_of(source, max_depth: int = 8):
     return None
 
 
+def _oldest_ts_ns(batch) -> int | None:
+    """Oldest member timestamp (ns) of a closed MessageBatch — the
+    batch-granular ``stage=decode`` e2e anchor (ADR 0125). Batches are
+    mostly time-ordered but merge multiple streams, so take the true
+    minimum; None when the batch carries no timestamped messages."""
+    messages = getattr(batch, "messages", None)
+    if not messages:
+        return None
+    try:
+        return min(int(m.timestamp.ns) for m in messages)
+    except (AttributeError, TypeError, ValueError):
+        return None
+
+
 class MessagePreprocessor:
     """Routes batch messages into per-stream accumulators."""
 
@@ -478,7 +492,12 @@ class OrchestratingProcessor:
         """Hand one closed batch to the pipeline; blocks at depth."""
         self._last_batch_len = len(batch.messages)
         self._record_lag(batch)
-        self._pipeline.submit(batch, start=batch.start, end=batch.end)
+        self._pipeline.submit(
+            batch,
+            start=batch.start,
+            end=batch.end,
+            oldest_ts_ns=_oldest_ts_ns(batch),
+        )
 
     # graft: thread=decode   (IngestPipeline decode worker callback)
     def _decode_window(self, batch):
@@ -574,6 +593,14 @@ class OrchestratingProcessor:
         source_ts_ns = (
             int(batch.end.ns) if hasattr(batch.end, "ns") else None
         )
+        # Decode is batch-granular (ADR 0125): one observation per
+        # window, anchored at the OLDEST member so the histogram upper-
+        # bounds any single message's decode latency (same rule as the
+        # pipelined decode worker).
+        oldest_ts_ns = _oldest_ts_ns(batch)
+        decode_ts_ns = (
+            oldest_ts_ns if oldest_ts_ns is not None else source_ts_ns
+        )
         t_start = time.monotonic()
         with self.stage_timer.stage("preprocess"), TRACER.span(
             "decode", trace_id
@@ -582,7 +609,7 @@ class OrchestratingProcessor:
             window = self._preprocessor.collect_window()
             context = self._preprocessor.collect_context()
             fresh_context = self._preprocessor.fresh_context_names()
-        observe_stage("decode", source_ts_ns)
+        observe_stage("decode", decode_ts_ns)
         self._record_lag(batch)
         with self.stage_timer.stage("process_jobs"), TRACER.bind(trace_id):
             results = self._job_manager.process_jobs(

@@ -219,6 +219,17 @@ def setup_arg_parser(description: str = "") -> argparse.ArgumentParser:
         "cache so restarts skip XLA (LIVEDATA_WARMUP equivalently)",
     )
     parser.add_argument(
+        "--batch-decode",
+        action="store_true",
+        default=False,
+        help="batch decode plane (ADR 0125): adapt a whole consume "
+        "poll per dispatch — ev44 headers walked once, payloads landed "
+        "zero-copy into reusable decode arenas, pixel-id sanitize "
+        "fused into device staging. Byte-identical da00 output vs the "
+        "per-message reference path (LIVEDATA_BATCH_DECODE=1 "
+        "equivalently)",
+    )
+    parser.add_argument(
         "--trace-dump",
         default=None,
         metavar="PATH",

@@ -16,8 +16,10 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import time
 from collections.abc import Mapping, Sequence
+from dataclasses import dataclass
 from typing import Any, Protocol, runtime_checkable
 
 import numpy as np
@@ -26,7 +28,16 @@ from ..config.workflow_spec import WorkflowConfig
 from ..core.message import Message, RunStart, RunStop, StreamId, StreamKind
 from ..core.timestamp import Timestamp
 from ..telemetry.e2e import observe_stage
-from ..preprocessors.event_data import DetectorEvents, MonitorEvents
+from ..telemetry.instruments import (
+    DECODE_BATCH_SIZE,
+    DECODE_BYTES,
+    DECODE_ERRORS,
+)
+from ..preprocessors.event_data import (
+    DetectorEvents,
+    EventChunkRef,
+    MonitorEvents,
+)
 from ..preprocessors.to_nxlog import LogData
 from . import wire
 from .da00_compat import da00_to_dataarray
@@ -51,6 +62,7 @@ _LAG_TRACKED_KINDS = frozenset(
 )
 
 __all__ = [
+    "AdaptFailure",
     "AdaptingMessageSource",
     "ChainedAdapter",
     "CommandsAdapter",
@@ -78,6 +90,55 @@ class UnroutedError(KeyError):
     """No route/stream mapping for a message."""
 
 
+@dataclass(slots=True)
+class AdaptFailure:
+    """Batch-adapt contract's per-message failure slot (ADR 0125).
+
+    ``adapt_batch(raws)`` returns a list aligned 1:1 with its input
+    where each entry is ``Message | list[Message] | None`` (the
+    ``adapt`` result forms) or an ``AdaptFailure`` wrapping the
+    exception that message raised — quarantine without poisoning the
+    poll. ``AdaptingMessageSource`` folds failures into the same
+    containment accounting as the per-message path (``UnroutedError``
+    inside counts as unrouted, anything else as an adapt error).
+    ``schema`` is the wire schema when known, for the
+    ``livedata_decode_errors_total{schema}`` label.
+    """
+
+    error: Exception
+    schema: str = ""
+
+
+def _env_batch_decode() -> bool:
+    """The LIVEDATA_BATCH_DECODE rollout gate (ADR 0125), resolved at
+    adapter construction — same env-as-plumbing convention as
+    LIVEDATA_PIPELINE. Default off: the per-message path stays the
+    reference until the flag opts a service in."""
+    return os.environ.get("LIVEDATA_BATCH_DECODE", "").strip().lower() in (
+        "1",
+        "true",
+        "yes",
+        "on",
+    )
+
+
+def _schema_of(raw) -> str:
+    """Best-effort schema label of a raw message (error accounting)."""
+    try:
+        return wire.get_schema(raw.value())
+    except Exception:
+        return ""
+
+
+def _adapt_one(adapter, raw):
+    """One message through ``adapt`` with failures reified in-band —
+    the per-adapter fallback the batch-adapt combinators build on."""
+    try:
+        return adapter.adapt(raw)
+    except Exception as err:
+        return AdaptFailure(error=err, schema=_schema_of(raw))
+
+
 class NullAdapter:
     """Deliberate drop: the schema is known, expected on the topic, and
     carries nothing we consume (reference: kafka/message_adapter.py:130).
@@ -98,13 +159,62 @@ def _resolve(
 
 
 class KafkaToDetectorEventsAdapter:
-    """ev44 -> Message[DetectorEvents] with (topic, source) -> stream name."""
+    """ev44 -> Message[DetectorEvents] with (topic, source) -> stream name.
 
-    def __init__(self, mapping: StreamMapping, *, merge_detectors: bool = False):
+    Under the batch decode gate (``batch_wire`` / LIVEDATA_BATCH_DECODE,
+    ADR 0125) the payload becomes an :class:`EventChunkRef` over a
+    single header walk — no payload ndarrays are decoded here; the
+    accumulator lands them straight into a decode arena. Timestamps and
+    routing come from the same header fields either way, so window
+    membership (MessageBatcher) is byte-identical across modes.
+    """
+
+    def __init__(
+        self,
+        mapping: StreamMapping,
+        *,
+        merge_detectors: bool = False,
+        batch_wire: bool | None = None,
+    ):
         self._mapping = mapping
         self._merge = merge_detectors
+        self._batch = (
+            _env_batch_decode() if batch_wire is None else bool(batch_wire)
+        )
+        #: StreamId interning: one frozen StreamId per stream name
+        #: instead of a fresh dataclass per message (the detector topic
+        #: set is small and fixed; this is a per-message allocation on
+        #: the consume hot path either decode mode pays).
+        self._sids: dict[str, StreamId] = {}
+
+    def _stream(self, name: str) -> StreamId:
+        sid = self._sids.get(name)
+        if sid is None:
+            sid = self._sids[name] = StreamId(
+                kind=StreamKind.DETECTOR_EVENTS, name=name
+            )
+        return sid
 
     def adapt(self, message: KafkaMessage) -> Message | None:
+        if self._batch:
+            v = wire.walk_ev44(message.value())
+            name = _resolve(
+                self._mapping.detectors, message.topic(), v.source_name
+            )
+            if name is None:
+                return None
+            if self._merge:
+                name = MERGED_DETECTOR_STREAM
+            ts = (
+                Timestamp.from_ns(v.reference_time_ns)
+                if v.reference_time_ns is not None
+                else Timestamp.now()
+            )
+            return Message(
+                timestamp=ts,
+                stream=self._stream(name),
+                value=EventChunkRef(view=v),
+            )
         ev = wire.decode_ev44(message.value())
         name = _resolve(self._mapping.detectors, message.topic(), ev.source_name)
         if name is None:
@@ -119,12 +229,25 @@ class KafkaToDetectorEventsAdapter:
         )
         return Message(
             timestamp=ts,
-            stream=StreamId(kind=StreamKind.DETECTOR_EVENTS, name=name),
+            stream=self._stream(name),
             value=DetectorEvents(
                 pixel_id=ev.pixel_id,
                 time_of_arrival=ev.time_of_flight.astype(np.float32),
             ),
         )
+
+    def adapt_batch(self, raws: Sequence[KafkaMessage]) -> list:
+        """Whole-poll form (see :class:`AdaptFailure`): one header walk
+        per message, malformed wire quarantined in-band."""
+        out = []
+        for raw in raws:
+            try:
+                out.append(self.adapt(raw))
+            except wire.WireError as err:
+                out.append(AdaptFailure(error=err, schema="ev44"))
+            except Exception as err:
+                out.append(AdaptFailure(error=err, schema=_schema_of(raw)))
+        return out
 
 
 class KafkaToMonitorEventsAdapter:
@@ -136,10 +259,50 @@ class KafkaToMonitorEventsAdapter:
     MONITOR_EVENTS either way (routing and job dispatch are by kind +
     name; the payload type carries the pixel ids)."""
 
-    def __init__(self, mapping: StreamMapping):
+    def __init__(
+        self, mapping: StreamMapping, *, batch_wire: bool | None = None
+    ):
         self._mapping = mapping
+        self._batch = (
+            _env_batch_decode() if batch_wire is None else bool(batch_wire)
+        )
+        self._sids: dict[str, StreamId] = {}  # see detector adapter
+
+    def _stream(self, name: str) -> StreamId:
+        sid = self._sids.get(name)
+        if sid is None:
+            sid = self._sids[name] = StreamId(
+                kind=StreamKind.MONITOR_EVENTS, name=name
+            )
+        return sid
 
     def adapt(self, message: KafkaMessage) -> Message | None:
+        if self._batch:
+            v = wire.walk_ev44(message.value())
+            name = _resolve(
+                self._mapping.monitors, message.topic(), v.source_name
+            )
+            if name is None:
+                return None
+            ts = (
+                Timestamp.from_ns(v.reference_time_ns)
+                if v.reference_time_ns is not None
+                else Timestamp.now()
+            )
+            # Same routing decision as the eager branch below, off the
+            # header counts alone: pixellated + consistent ids ride as a
+            # detector-style chunk; everything else (incl. mismatched or
+            # absent ids) takes the pixel-less monitor semantics.
+            pixellated = (
+                name in self._mapping.pixellated_monitors
+                and v.n_pid == v.n_tof
+                and v.n_pid > 0
+            )
+            return Message(
+                timestamp=ts,
+                stream=self._stream(name),
+                value=EventChunkRef(view=v, monitor=not pixellated),
+            )
         ev = wire.decode_ev44(message.value())
         name = _resolve(self._mapping.monitors, message.topic(), ev.source_name)
         if name is None:
@@ -169,9 +332,21 @@ class KafkaToMonitorEventsAdapter:
             )
         return Message(
             timestamp=ts,
-            stream=StreamId(kind=StreamKind.MONITOR_EVENTS, name=name),
+            stream=self._stream(name),
             value=value,
         )
+
+    def adapt_batch(self, raws: Sequence[KafkaMessage]) -> list:
+        """Whole-poll form (see :class:`AdaptFailure`)."""
+        out = []
+        for raw in raws:
+            try:
+                out.append(self.adapt(raw))
+            except wire.WireError as err:
+                out.append(AdaptFailure(error=err, schema="ev44"))
+            except Exception as err:
+                out.append(AdaptFailure(error=err, schema=_schema_of(raw)))
+        return out
 
 
 class KafkaToDa00Adapter:
@@ -330,6 +505,41 @@ class RouteBySchemaAdapter:
             raise UnroutedError(f"No adapter for schema {schema!r}")
         return adapter.adapt(message)
 
+    def adapt_batch(self, raws: Sequence[KafkaMessage]) -> list:
+        """Whole-poll dispatch: consecutive same-schema runs go down to
+        the route's own ``adapt_batch`` when it has one (the ev44
+        adapters' single-pass loop), one at a time otherwise; an
+        unreadable identifier or unknown schema quarantines that message
+        alone (:class:`AdaptFailure`)."""
+        keys: list[str | AdaptFailure] = []
+        for raw in raws:
+            try:
+                keys.append(wire.get_schema(raw.value()))
+            except Exception as err:
+                keys.append(AdaptFailure(error=err))
+        out: list = [None] * len(raws)
+        i, n = 0, len(raws)
+        while i < n:
+            key = keys[i]
+            if isinstance(key, AdaptFailure):
+                out[i] = key
+                i += 1
+                continue
+            j = i
+            while j < n and keys[j] == key:
+                j += 1
+            adapter = self._routes.get(key)
+            if adapter is None:
+                for k in range(i, j):
+                    out[k] = AdaptFailure(
+                        error=UnroutedError(f"No adapter for schema {key!r}"),
+                        schema=key,
+                    )
+            else:
+                out[i:j] = _adapt_run(adapter, raws[i:j])
+            i = j
+        return out
+
 
 class RouteByTopicAdapter:
     """Dispatch on the Kafka topic."""
@@ -346,6 +556,37 @@ class RouteByTopicAdapter:
         if adapter is None:
             raise UnroutedError(f"No adapter for topic {message.topic()!r}")
         return adapter.adapt(message)
+
+    def adapt_batch(self, raws: Sequence[KafkaMessage]) -> list:
+        """Whole-poll dispatch on topic runs (a consumer poll drains
+        partitions in topic runs, so grouping is near-free); each run
+        goes to its route's ``adapt_batch`` when present."""
+        out: list = [None] * len(raws)
+        i, n = 0, len(raws)
+        while i < n:
+            topic = raws[i].topic()
+            j = i
+            while j < n and raws[j].topic() == topic:
+                j += 1
+            adapter = self._routes.get(topic)
+            if adapter is None:
+                for k in range(i, j):
+                    out[k] = AdaptFailure(
+                        error=UnroutedError(f"No adapter for topic {topic!r}")
+                    )
+            else:
+                out[i:j] = _adapt_run(adapter, raws[i:j])
+            i = j
+        return out
+
+
+def _adapt_run(adapter, raws: Sequence[KafkaMessage]) -> list:
+    """One homogeneous run through an adapter's batch form when it has
+    one, else per-message with in-band failures."""
+    sub = getattr(adapter, "adapt_batch", None)
+    if sub is not None:
+        return sub(raws)
+    return [_adapt_one(adapter, raw) for raw in raws]
 
 
 class AdaptingMessageSource:
@@ -413,29 +654,58 @@ class AdaptingMessageSource:
                 # top of this is the service's own latency.
                 observe_stage("consume", m.timestamp.ns, now_ns=now_ns)
 
+    def _observe_poll(self, raws: Sequence) -> None:
+        """Per-poll decode telemetry (ADR 0125): batch size is the
+        amortization factor of every whole-poll optimization, bytes the
+        decode plane's throughput denominator."""
+        DECODE_BATCH_SIZE.observe(float(len(raws)))
+        nbytes = 0
+        for raw in raws:
+            value = getattr(raw, "value", None)
+            if callable(value):
+                try:
+                    nbytes += len(value())
+                except Exception as err:
+                    # Telemetry must never break the consume path; the
+                    # adapter layer will surface the broken message.
+                    logger.debug("unsized raw message in poll: %s", err)
+        if nbytes:
+            DECODE_BYTES.inc(float(nbytes))
+
     def get_messages(self) -> list[Message]:
+        raws = self._source.get_messages()
+        if raws:
+            self._observe_poll(raws)
+        adapt_batch = getattr(self._adapter, "adapt_batch", None)
+        if adapt_batch is not None:
+            entries = adapt_batch(raws)
+        else:
+            entries = [_adapt_one(self._adapter, raw) for raw in raws]
         out: list[Message] = []
-        for raw in self._source.get_messages():
-            try:
-                adapted = self._adapter.adapt(raw)
-            except UnroutedError as err:
-                self.unrouted_count += 1
-                if self._counter is not None:
-                    self._counter.record(
-                        getattr(raw, "topic", lambda: "?")(),
-                        self._raw_source_name(raw),
-                        None,
-                    )
-                logger.debug("Unrouted message: %s", err)
-                continue
-            except Exception:
+        for raw, adapted in zip(raws, entries):
+            if isinstance(adapted, AdaptFailure):
+                err = adapted.error
+                if isinstance(err, UnroutedError):
+                    self.unrouted_count += 1
+                    if self._counter is not None:
+                        self._counter.record(
+                            getattr(raw, "topic", lambda: "?")(),
+                            self._raw_source_name(raw),
+                            None,
+                        )
+                    logger.debug("Unrouted message: %s", err)
+                    continue
                 self.error_count += 1
-                logger.exception(
+                DECODE_ERRORS.inc(
+                    schema=adapted.schema or _schema_of(raw) or "unknown"
+                )
+                logger.error(
                     "Failed to adapt message on topic %s",
                     getattr(raw, "topic", lambda: "?")(),
+                    exc_info=err,
                 )
                 if self._raise:
-                    raise
+                    raise err
                 continue
             if self._counter is not None:
                 self._count(raw, adapted)

@@ -23,8 +23,16 @@ __all__ = ["RoutingAdapterBuilder"]
 
 
 class RoutingAdapterBuilder:
-    def __init__(self, *, stream_mapping: StreamMapping) -> None:
+    def __init__(
+        self,
+        *,
+        stream_mapping: StreamMapping,
+        batch_decode: bool | None = None,
+    ) -> None:
+        #: Forwarded to the ev44 adapters (ADR 0125): None defers to the
+        #: LIVEDATA_BATCH_DECODE env gate at adapter construction.
         self._mapping = stream_mapping
+        self._batch_decode = batch_decode
         self._routes: dict[str, MessageAdapter] = {}
 
     def _add_topics(self, topics, adapter: MessageAdapter) -> None:
@@ -40,7 +48,9 @@ class RoutingAdapterBuilder:
             RouteBySchemaAdapter(
                 {
                     "ev44": KafkaToDetectorEventsAdapter(
-                        self._mapping, merge_detectors=merge_detectors
+                        self._mapping,
+                        merge_detectors=merge_detectors,
+                        batch_wire=self._batch_decode,
                     )
                 }
             ),
@@ -52,7 +62,9 @@ class RoutingAdapterBuilder:
             self._mapping.monitor_topics,
             RouteBySchemaAdapter(
                 {
-                    "ev44": KafkaToMonitorEventsAdapter(self._mapping),
+                    "ev44": KafkaToMonitorEventsAdapter(
+                        self._mapping, batch_wire=self._batch_decode
+                    ),
                     "da00": KafkaToDa00Adapter(self._mapping),
                 }
             ),

@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import struct
 from dataclasses import dataclass, field
+from typing import Any
 
 import flatbuffers
 import numpy as np
@@ -49,7 +50,9 @@ import numpy as np
 __all__ = [
     "Ad00Image",
     "Da00Variable",
+    "Ev44Batch",
     "Ev44Message",
+    "Ev44View",
     "F144Message",
     "RunStartMessage",
     "RunStopMessage",
@@ -58,6 +61,7 @@ __all__ = [
     "decode_ad00",
     "decode_da00",
     "decode_ev44",
+    "decode_ev44_batch",
     "decode_f144",
     "decode_pl72",
     "decode_x5f2",
@@ -69,6 +73,7 @@ __all__ = [
     "encode_pl72",
     "encode_x5f2",
     "get_schema",
+    "walk_ev44",
 ]
 
 
@@ -377,6 +382,301 @@ def decode_ev44(buf: bytes) -> Ev44Message:
 
 
 # ---------------------------------------------------------------------------
+# ev44 batch decode plane (ADR 0125)
+# ---------------------------------------------------------------------------
+
+#: Module-level precompiled structs for the header walk: ``walk_ev44``
+#: is the per-message cost of a whole poll's decode, so even the
+#: ``_STRUCTS`` dict lookup is off its path.
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_I32 = struct.Struct("<i")
+_I64 = struct.Struct("<q")
+
+_INT32_SIZE = 4
+_INT64_SIZE = 8
+
+
+@dataclass(slots=True)
+class Ev44View:
+    """Header-only view of one ev44 message: the routing fields plus the
+    (offset, count) coordinates of the payload vectors — NO payload
+    ndarrays are materialized. ``walk_ev44`` builds one per message with
+    a single vtable walk; payloads land later, straight into a batch
+    arena via :meth:`fill_into` (or lazily via the ``time_of_flight`` /
+    ``pixel_id`` properties for per-message consumers). Treat as
+    immutable; not ``frozen`` because the per-field
+    ``object.__setattr__`` would double construction cost on the
+    per-message hot path.
+
+    ``reference_time_ns`` is the LAST pulse time (what the adapters
+    timestamp messages with), or ``None`` when the vector is empty.
+    """
+
+    buf: bytes  # the whole wire buffer (any buffer protocol object)
+    source_name: str
+    message_id: int
+    reference_time_ns: int | None
+    tof_off: int  # byte offset of time_of_flight data (int32)
+    n_tof: int
+    pid_off: int  # byte offset of pixel_id data (int32)
+    n_pid: int  # 0 for monitor events
+
+    @property
+    def n_events(self) -> int:
+        return self.n_tof
+
+    @property
+    def nbytes(self) -> int:
+        return len(self.buf)
+
+    @property
+    def time_of_flight(self) -> np.ndarray:
+        """Zero-copy int32 view into the wire buffer."""
+        return np.frombuffer(
+            self.buf, dtype=np.int32, count=self.n_tof, offset=self.tof_off
+        )
+
+    @property
+    def pixel_id(self) -> np.ndarray:
+        """Zero-copy int32 view into the wire buffer (empty: monitor)."""
+        return np.frombuffer(
+            self.buf, dtype=np.int32, count=self.n_pid, offset=self.pid_off
+        )
+
+    def fill_into(self, pid_dst: np.ndarray, toa_dst: np.ndarray) -> None:
+        """Land this message's payload into arena slices of length
+        ``n_events``: pixel ids copy int32→int32, times of flight cast
+        int32→float32 fused into the assignment (no intermediate array).
+        Monitor messages (``n_pid == 0``) zero-fill the pixel slice —
+        the same pixel-0 convention ``ToEventBatch`` applies to
+        ``MonitorEvents``. A populated ``pixel_id`` whose length
+        disagrees with ``time_of_flight`` raises :class:`WireError`
+        (never a numpy broadcast error)."""
+        toa_dst[:] = self.time_of_flight
+        if not self.n_pid:
+            pid_dst[:] = 0
+        elif self.n_pid == self.n_tof:
+            pid_dst[:] = self.pixel_id
+        else:
+            raise WireError(
+                f"ev44 pixel_id length {self.n_pid} != "
+                f"time_of_flight length {self.n_tof}"
+            )
+
+
+def walk_ev44(buf) -> Ev44View:
+    """One bounds-checked vtable walk over an ev44 header.
+
+    Reads every field the ingress path needs (source name, message id,
+    last pulse time, payload vector coordinates) in a single pass with
+    module-level precompiled structs — no :class:`_Tbl` object, no
+    per-vector ndarray. Raises :class:`WireError` for every malformed
+    input (the per-message containment contract). A ``pixel_id`` length
+    disagreeing with ``time_of_flight`` is NOT rejected here — the
+    monitor adapters accept such messages as pixel-less (reference
+    behavior), so length policy belongs to the consumer
+    (:meth:`Ev44View.fill_into` / ``decode_ev44_batch`` quarantine).
+    """
+    n = len(buf)
+    if n < 8:
+        raise WireError(f"Buffer too short for flatbuffer: {n} bytes")
+    if bytes(buf[4:8]) != b"ev44":
+        raise WireError(f"Expected schema 'ev44', got {get_schema(buf)!r}")
+    # Straight-line walk, ~16 precompiled struct reads, ONE containment
+    # boundary: every corrupt-offset shape either trips an explicit
+    # range check below or runs ``unpack_from`` past the buffer end,
+    # which raises ``struct.error`` — converted to :class:`WireError` in
+    # the except arm. Negative read offsets cannot occur (all offsets
+    # are u16/u32 reads; the one subtraction, ``vt``, is checked), so
+    # ``unpack_from``'s from-the-end negative indexing is unreachable.
+    u16 = _U16.unpack_from
+    u32 = _U32.unpack_from
+    i64 = _I64.unpack_from
+    try:
+        pos = u32(buf, 0)[0]
+        vt = pos - _I32.unpack_from(buf, pos)[0]
+        if vt < 0:
+            raise WireError("Corrupt vtable offset")
+        vt_len = u16(buf, vt)[0]
+
+        # source_name (slot 0): string = u32 length + utf-8 bytes.
+        source_name = ""
+        foff = u16(buf, vt + 4)[0] if vt_len >= 6 else 0
+        if foff:
+            p = pos + foff
+            sp = p + u32(buf, p)[0]
+            slen = u32(buf, sp)[0]
+            if sp + 4 + slen > n:
+                raise WireError("String extends past buffer end")
+            try:
+                source_name = bytes(buf[sp + 4 : sp + 4 + slen]).decode(
+                    "utf-8"
+                )
+            except UnicodeDecodeError as err:
+                raise WireError(f"Invalid UTF-8 string: {err}") from err
+
+        foff = u16(buf, vt + 6)[0] if vt_len >= 8 else 0
+        message_id = i64(buf, pos + foff)[0] if foff else 0
+
+        # reference_time (slot 2, int64): only the LAST element is read
+        # — the adapters' message timestamp — not the whole vector.
+        reference_time_ns = None
+        foff = u16(buf, vt + 8)[0] if vt_len >= 10 else 0
+        if foff:
+            p = pos + foff
+            vp = p + u32(buf, p)[0]
+            n_rt = u32(buf, vp)[0]
+            if vp + 4 + n_rt * _INT64_SIZE > n:
+                raise WireError("Vector extends past buffer end")
+            if n_rt:
+                reference_time_ns = i64(
+                    buf, vp + 4 + (n_rt - 1) * _INT64_SIZE
+                )[0]
+
+        # time_of_flight (slot 4) / pixel_id (slot 5), int32 vectors.
+        tof_off = n_tof = 0
+        foff = u16(buf, vt + 12)[0] if vt_len >= 14 else 0
+        if foff:
+            p = pos + foff
+            vp = p + u32(buf, p)[0]
+            n_tof = u32(buf, vp)[0]
+            if vp + 4 + n_tof * _INT32_SIZE > n:
+                raise WireError("Vector extends past buffer end")
+            tof_off = vp + 4
+
+        pid_off = n_pid = 0
+        foff = u16(buf, vt + 14)[0] if vt_len >= 16 else 0
+        if foff:
+            p = pos + foff
+            vp = p + u32(buf, p)[0]
+            n_pid = u32(buf, vp)[0]
+            if vp + 4 + n_pid * _INT32_SIZE > n:
+                raise WireError("Vector extends past buffer end")
+            pid_off = vp + 4
+    except struct.error as err:
+        raise WireError(f"Offset out of range: {err}") from err
+    return Ev44View(
+        buf=buf,
+        source_name=source_name,
+        message_id=message_id,
+        reference_time_ns=reference_time_ns,
+        tof_off=tof_off,
+        n_tof=n_tof,
+        pid_off=pid_off,
+        n_pid=n_pid,
+    )
+
+
+@dataclass(slots=True)
+class Ev44Batch:
+    """One poll's worth of ev44 payloads as a single contiguous triple.
+
+    ``pixel_id``/``toa`` are views over a reusable decode arena
+    (``core.device_event_cache.DecodeArenaPool``) of exactly
+    ``n_events`` elements; ``offsets`` is the int64 prefix-sum such that
+    message ``i``'s events live at ``[offsets[i]:offsets[i+1])``.
+    ``views`` holds the per-message headers (routing metadata only);
+    ``errors`` the quarantined ``(input index, WireError)`` pairs.
+    ``lease`` owns the arena — the arrays stay valid (and the arena out
+    of the pool) for exactly as long as the batch/lease is referenced.
+    """
+
+    pixel_id: np.ndarray  # int32 [n_events]
+    toa: np.ndarray  # float32 [n_events]
+    offsets: np.ndarray  # int64 [len(views) + 1]
+    views: list[Ev44View]
+    errors: list[tuple[int, WireError]]
+    n_messages: int  # input buffers, including quarantined ones
+    nbytes: int  # wire bytes of the decoded (non-quarantined) messages
+    lease: Any = None
+
+    @property
+    def n_events(self) -> int:
+        return int(self.offsets[-1])
+
+
+def decode_ev44_batch(buffers, *, arena=None) -> Ev44Batch:
+    """Vectorized decode of a whole poll of ev44 buffers.
+
+    Pass 1 walks each header once (:func:`walk_ev44`); a malformed
+    message is quarantined into ``errors`` (and counted on
+    ``livedata_decode_errors_total{schema="ev44"}``) WITHOUT poisoning
+    the rest of the batch. Pass 2 leases a pinned staging arena sized to
+    the total event count and lands every payload zero-copy-from-wire
+    into it — one contiguous (toa, pixel, offsets) triple, no
+    per-message ndarray or :class:`Ev44Message` allocation.
+
+    ``arena`` overrides the arena lease (object with ``pixel``/``toa``
+    ndarrays of at least ``n_events`` elements) for callers that manage
+    their own reuse; by default one is leased from
+    ``core.device_event_cache.default_decode_pool()``.
+    """
+    views: list[Ev44View] = []
+    errors: list[tuple[int, WireError]] = []
+    nbytes = 0
+    n_in = 0
+    for i, buf in enumerate(buffers):
+        n_in += 1
+        try:
+            v = walk_ev44(buf)
+            if v.n_pid and v.n_pid != v.n_tof:
+                raise WireError(
+                    f"ev44 pixel_id length {v.n_pid} != "
+                    f"time_of_flight length {v.n_tof}"
+                )
+            views.append(v)
+        except WireError as err:
+            errors.append((i, err))
+        else:
+            nbytes += len(buf)
+    if errors:
+        _count_decode_errors("ev44", len(errors))
+    offsets = np.empty(len(views) + 1, dtype=np.int64)
+    offsets[0] = 0
+    for j, v in enumerate(views):
+        offsets[j + 1] = offsets[j] + v.n_tof
+    total = int(offsets[-1])
+    lease = arena
+    if lease is None:
+        from ..core.device_event_cache import default_decode_pool
+
+        lease = default_decode_pool().lease(total)
+    pid = lease.pixel[:total]
+    toa = lease.toa[:total]
+    for j, v in enumerate(views):
+        start = int(offsets[j])
+        stop = int(offsets[j + 1])
+        v.fill_into(pid[start:stop], toa[start:stop])
+    return Ev44Batch(
+        pixel_id=pid,
+        toa=toa,
+        offsets=offsets,
+        views=views,
+        errors=errors,
+        n_messages=n_in,
+        nbytes=nbytes,
+        lease=lease,
+    )
+
+
+def _count_decode_errors(schema: str, amount: int) -> None:
+    """Best-effort bump of ``livedata_decode_errors_total{schema}``.
+
+    Lazy import: the wire codecs must stay importable (and unit-testable)
+    without dragging the telemetry package in at module load."""
+    try:
+        from ..telemetry.instruments import DECODE_ERRORS
+
+        DECODE_ERRORS.inc(amount, schema=schema)
+    # Silent by design: the wire codec has no logger (this module stays
+    # importable without the telemetry/logging stack) and the quarantine
+    # itself is already surfaced through Ev44Batch.errors.
+    except Exception:  # graftlint: disable=JGL007
+        pass  # pragma: no cover - telemetry is advisory
+
+
+# ---------------------------------------------------------------------------
 # f144 — log data
 # ---------------------------------------------------------------------------
 
@@ -578,7 +878,10 @@ def _encode_da00_native(
         dims_start[i] = len(shapes_flat)
         dims_count[i] = len(shape)
         shapes_flat.extend(int(s) for s in shape)
-        raw = data.tobytes()
+        # Encode side, per VARIABLE not per message: the native builder
+        # needs one contiguous serialization of each payload to splice
+        # into the flatbuffer — there is no zero-copy alternative here.
+        raw = data.tobytes()  # graftlint: disable=JGL028
         data_parts.append(raw)
         data_offs[i + 1] = data_offs[i] + len(raw)
     return da00_encode_raw(

@@ -76,8 +76,18 @@ class EventBatch:
     toa: np.ndarray  # float32 [B] time-of-arrival within pulse (ns)
     n_valid: int
     # Keeps the memory owner alive when pixel_id/toa are zero-copy views
-    # into a native staging buffer (numpy cannot track C-owned memory).
+    # into a native staging buffer (numpy cannot track C-owned memory),
+    # or the arena lease when they view a decode arena (ADR 0125).
     owner: object = None
+    #: True when ``owner`` is an exclusive lease (decode arena): the
+    #: arrays outlive the producer's release() on their own, so
+    #: ``detach`` is a no-op instead of an 8 B/event memcpy.
+    owned: bool = False
+    #: True when pixel ids were landed straight off the wire without the
+    #: host sanitize pass (batch decode): ``stage_raw`` fuses the device
+    #: decode prologue (ops/decode_prologue.py) into staging so the
+    #: validation runs on device, once per (stream, tag).
+    prologue: bool = False
 
     @property
     def padded_size(self) -> int:
@@ -89,7 +99,12 @@ class EventBatch:
         threads while the service thread reuses the staging buffer for
         the next window (ADR 0111); batches crossing that boundary must
         own their memory. ~8 B/event memcpy — small against the flatten
-        it decouples."""
+        it decouples. Arena-leased batches (``owned``) already own their
+        memory through the lease: the pool cannot re-issue the arena
+        while this batch references it, so they pass through unchanged.
+        """
+        if self.owned:
+            return self
         return EventBatch(
             pixel_id=self.pixel_id.copy(),
             toa=self.toa.copy(),
@@ -234,15 +249,28 @@ def stage_raw(batch: EventBatch, cache=None, tag: str = "", device=None):
     staged pair to that device instead of the default; the cache key
     carries it, so two groups placed on different slices each stage once
     — per slice, never per job (ADR 0115).
+
+    Batches carrying ``prologue=True`` (batch-decoded wire, ADR 0125)
+    get the device decode prologue fused in here: the pixel-id sanitize
+    the per-message host path does eagerly runs as one jitted device op
+    on the staged pair instead. The cache key is unchanged — the staged
+    VALUE is what downstream kernels consume either way, and the
+    prologue's canonicalization (out-of-range → -1) is exactly what
+    every kernel already treats as the drop marker.
     """
 
     def stage():
         if device is None:
-            return dispatch_safe(batch.pixel_id), dispatch_safe(batch.toa)
-        return (
-            stage_for(batch.pixel_id, device),
-            stage_for(batch.toa, device),
-        )
+            pid = dispatch_safe(batch.pixel_id)
+            toa = dispatch_safe(batch.toa)
+        else:
+            pid = stage_for(batch.pixel_id, device)
+            toa = stage_for(batch.toa, device)
+        if getattr(batch, "prologue", False):
+            from .decode_prologue import decode_prologue
+
+            pid, toa = decode_prologue(pid, toa)
+        return pid, toa
 
     if cache is None:
         return stage()

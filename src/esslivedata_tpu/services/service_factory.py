@@ -513,6 +513,14 @@ class DataServiceRunner:
             builder.checkpoint_interval = args.checkpoint_interval
         if args.warmup:
             builder.warmup = True
+        if args.batch_decode:
+            # The ev44 adapters resolve the gate from the environment at
+            # construction (inside from_raw_source's route build, after
+            # this point) — env-as-plumbing, same convention the
+            # LIVEDATA_* builder defaults use (ADR 0125).
+            import os
+
+            os.environ["LIVEDATA_BATCH_DECODE"] = "1"
         if args.check:
             print(
                 f"{self._service_name}: instrument={args.instrument} "

@@ -21,7 +21,12 @@ stage                   observed at
 ``consume``             adapter decode on the consume path (per message,
                         kafka/message_adapter.py — producer+transport lag)
 ``decode``              window decoded (pipeline decode worker / serial
-                        preprocess)
+                        preprocess). Batch-granular (ADR 0125): ONE
+                        observation per window, anchored at the OLDEST
+                        member's source timestamp — the upper bound on
+                        any single message's decode staleness — so the
+                        sample count tracks windows, not messages, and
+                        per-message fidelity is preserved conservatively
 ``staged``              window prestaged onto the device (pipelined only —
                         the serial loop stages at step time)
 ``published``           results finalized + sink publish done

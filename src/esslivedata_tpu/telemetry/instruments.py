@@ -17,6 +17,9 @@ from .registry import REGISTRY
 
 __all__ = [
     "CALIBRATION_SWAPS",
+    "DECODE_BATCH_SIZE",
+    "DECODE_BYTES",
+    "DECODE_ERRORS",
     "EVENTS_FILTERED",
     "PUBLISH_RTT_SECONDS",
 ]
@@ -51,4 +54,34 @@ EVENTS_FILTERED = REGISTRY.counter(
     "livedata_events_filtered",
     "Events rejected by per-event filter chains before histogramming",
     labelnames=("kind",),
+)
+
+#: Messages per consume poll reaching the adapter layer (ADR 0125): the
+#: batch decode plane amortizes per-poll overhead across this count, so
+#: its distribution IS the amortization factor — a mode stuck at 1-2
+#: messages/poll means batching buys nothing and the broker fetch
+#: configuration is the lever, not the decoder.
+DECODE_BATCH_SIZE = REGISTRY.histogram(
+    "livedata_decode_batch_size",
+    "Raw messages per consume poll handed to the adapter layer",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+             512.0, 1024.0),
+)
+
+#: Wire bytes entering decode. With the PERF.md ~4 B/event wire cost
+#: this is the decode plane's throughput denominator (bytes/s scraped
+#: against `livedata_e2e_latency{stage="decode"}`).
+DECODE_BYTES = REGISTRY.counter(
+    "livedata_decode_bytes_total",
+    "Raw wire bytes handed to the decode plane",
+)
+
+#: Quarantined messages (ADR 0125): malformed wire contained per
+#: message — a bad buffer raises WireError and is skipped (batch mode:
+#: without poisoning the rest of its poll). Labeled by schema so a
+#: producer-side corruption shows WHICH codec is affected.
+DECODE_ERRORS = REGISTRY.counter(
+    "livedata_decode_errors_total",
+    "Messages dropped by the decode plane as malformed wire",
+    labelnames=("schema",),
 )

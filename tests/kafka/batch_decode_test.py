@@ -1,0 +1,392 @@
+"""Batch decode plane (ADR 0125): adapter + accumulator parity tests.
+
+The rollout contract is byte-identity — the same wire messages must
+stage the same events in the same order whether they travel the
+per-message reference path (eager ``DetectorEvents`` arrays) or the
+batch plane (``EventChunkRef`` headers landed into a decode arena by
+the ref-mode accumulator). These tests pin that equivalence at every
+seam the two paths share: adapter routing/timestamps, the pixellated
+monitor decision, quarantine accounting, window staging, and the
+mixed-producer windows where one mode's chunks arrive into the other
+mode's window.
+"""
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.core.message import Message, StreamKind
+from esslivedata_tpu.core.timestamp import Timestamp
+from esslivedata_tpu.kafka import wire
+from esslivedata_tpu.kafka.message_adapter import (
+    AdaptFailure,
+    AdaptingMessageSource,
+    KafkaToDetectorEventsAdapter,
+    KafkaToMonitorEventsAdapter,
+    RouteBySchemaAdapter,
+    RouteByTopicAdapter,
+)
+from esslivedata_tpu.kafka.source import FakeKafkaMessage
+from esslivedata_tpu.kafka.stream_mapping import InputStreamKey, StreamMapping
+from esslivedata_tpu.preprocessors.event_data import (
+    DetectorEvents,
+    EventChunkRef,
+    MonitorEvents,
+    ToEventBatch,
+)
+from esslivedata_tpu.telemetry.instruments import (
+    DECODE_BATCH_SIZE,
+    DECODE_BYTES,
+    DECODE_ERRORS,
+)
+
+
+@pytest.fixture
+def mapping():
+    return StreamMapping(
+        instrument="dummy",
+        detectors={
+            InputStreamKey(topic="det_topic", source_name="panel_a"): "bank0",
+            InputStreamKey(topic="det_topic", source_name="panel_b"): "bank1",
+        },
+        monitors={
+            InputStreamKey(topic="mon_topic", source_name="mon_src"): "mon0",
+            InputStreamKey(topic="mon_topic", source_name="pix_src"): "pixmon",
+        },
+        pixellated_monitors=("pixmon",),
+    )
+
+
+def ev44_msg(
+    topic="det_topic", source="panel_a", n=4, base=0, pixels=True, ref_ns=1_000
+):
+    buf = wire.encode_ev44(
+        source,
+        base,
+        np.array([ref_ns], dtype=np.int64),
+        np.array([0], dtype=np.int32),
+        np.arange(n, dtype=np.int32) * 7 + base,
+        pixel_id=(
+            np.arange(n, dtype=np.int32) + 1 + base if pixels else None
+        ),
+    )
+    return FakeKafkaMessage(buf, topic)
+
+
+class TestDetectorAdapterParity:
+    def test_batch_mode_routing_and_timestamp_match_eager(self, mapping):
+        raw = ev44_msg(ref_ns=123_456)
+        eager = KafkaToDetectorEventsAdapter(mapping, batch_wire=False)
+        batch = KafkaToDetectorEventsAdapter(mapping, batch_wire=True)
+        a, b = eager.adapt(raw), batch.adapt(raw)
+        assert a.stream == b.stream
+        assert a.timestamp == b.timestamp == Timestamp.from_ns(123_456)
+        assert isinstance(a.value, DetectorEvents)
+        assert isinstance(b.value, EventChunkRef)
+        np.testing.assert_array_equal(a.value.pixel_id, b.value.pixel_id)
+        np.testing.assert_array_equal(
+            a.value.time_of_arrival, b.value.time_of_arrival
+        )
+        assert b.value.time_of_arrival.dtype == np.float32
+
+    def test_batch_mode_drops_unmapped_source(self, mapping):
+        adapter = KafkaToDetectorEventsAdapter(mapping, batch_wire=True)
+        assert adapter.adapt(ev44_msg(source="ghost")) is None
+
+    def test_stream_ids_are_interned(self, mapping):
+        adapter = KafkaToDetectorEventsAdapter(mapping, batch_wire=True)
+        m1 = adapter.adapt(ev44_msg(base=0))
+        m2 = adapter.adapt(ev44_msg(base=9))
+        assert m1.stream is m2.stream
+
+    def test_adapt_batch_quarantines_in_band(self, mapping):
+        adapter = KafkaToDetectorEventsAdapter(mapping, batch_wire=True)
+        good = ev44_msg()
+        bad = FakeKafkaMessage(good.value()[:16], "det_topic")
+        unmapped = ev44_msg(source="ghost")
+        out = adapter.adapt_batch([good, bad, unmapped])
+        assert isinstance(out[0], Message)
+        assert isinstance(out[1], AdaptFailure)
+        assert out[1].schema == "ev44"
+        assert isinstance(out[1].error, wire.WireError)
+        assert out[2] is None
+
+
+class TestMonitorAdapterParity:
+    def test_plain_monitor_rides_as_pixel_less_ref(self, mapping):
+        raw = ev44_msg(topic="mon_topic", source="mon_src", pixels=False)
+        eager = KafkaToMonitorEventsAdapter(mapping, batch_wire=False)
+        batch = KafkaToMonitorEventsAdapter(mapping, batch_wire=True)
+        a, b = eager.adapt(raw), batch.adapt(raw)
+        assert isinstance(a.value, MonitorEvents)
+        assert isinstance(b.value, EventChunkRef)
+        assert b.value.monitor
+        np.testing.assert_array_equal(
+            a.value.time_of_arrival, b.value.time_of_arrival
+        )
+        # Monitor refs zero-fill pixel ids — the screen-row-0 convention.
+        np.testing.assert_array_equal(
+            b.value.pixel_id, np.zeros(a.value.n_events, dtype=np.int32)
+        )
+
+    def test_pixellated_monitor_keeps_ids(self, mapping):
+        raw = ev44_msg(topic="mon_topic", source="pix_src", pixels=True)
+        eager = KafkaToMonitorEventsAdapter(mapping, batch_wire=False)
+        batch = KafkaToMonitorEventsAdapter(mapping, batch_wire=True)
+        a, b = eager.adapt(raw), batch.adapt(raw)
+        assert isinstance(a.value, DetectorEvents)
+        assert not b.value.monitor
+        np.testing.assert_array_equal(a.value.pixel_id, b.value.pixel_id)
+
+    def test_pixellated_monitor_without_ids_takes_fast_path(self, mapping):
+        raw = ev44_msg(topic="mon_topic", source="pix_src", pixels=False)
+        a = KafkaToMonitorEventsAdapter(mapping, batch_wire=False).adapt(raw)
+        b = KafkaToMonitorEventsAdapter(mapping, batch_wire=True).adapt(raw)
+        assert isinstance(a.value, MonitorEvents)
+        assert b.value.monitor
+
+    def test_mismatched_ids_take_monitor_semantics_both_modes(self, mapping):
+        buf = wire.encode_ev44(
+            "pix_src",
+            1,
+            np.array([5], dtype=np.int64),
+            np.array([0], dtype=np.int32),
+            np.array([10, 20, 30], dtype=np.int32),
+            pixel_id=np.array([1], dtype=np.int32),
+        )
+        raw = FakeKafkaMessage(buf, "mon_topic")
+        a = KafkaToMonitorEventsAdapter(mapping, batch_wire=False).adapt(raw)
+        b = KafkaToMonitorEventsAdapter(mapping, batch_wire=True).adapt(raw)
+        assert isinstance(a.value, MonitorEvents)
+        assert b.value.monitor
+        assert a.value.n_events == b.value.n_events == 3
+
+
+def _stage(messages):
+    """Run adapted messages through a fresh accumulator, return the
+    staged (pixel, toa, n_valid) triple and release the arena."""
+    acc = ToEventBatch()
+    for m in messages:
+        acc.add(m.timestamp, m.value)
+    staged = acc.get()
+    batch = staged.batch
+    triple = (
+        batch.pixel_id[: batch.n_valid].copy(),
+        batch.toa[: batch.n_valid].copy(),
+        batch.n_valid,
+        batch.pixel_id[batch.n_valid :].copy(),
+        staged.first_timestamp,
+        staged.last_timestamp,
+    )
+    del staged, batch
+    acc.release_buffers()
+    return triple
+
+
+class TestWindowByteIdentity:
+    """Same wire, same staged window, either decode mode."""
+
+    def _raws(self):
+        return [
+            ev44_msg(base=0, n=5, ref_ns=3_000),
+            ev44_msg(base=100, n=3, ref_ns=1_000),
+            ev44_msg(source="panel_b", base=50, n=4, ref_ns=2_000),
+        ]
+
+    def test_detector_window_identical(self, mapping):
+        raws = self._raws()
+        eager = KafkaToDetectorEventsAdapter(mapping, batch_wire=False)
+        batch = KafkaToDetectorEventsAdapter(mapping, batch_wire=True)
+        pid_a, toa_a, n_a, pad_a, first_a, last_a = _stage(
+            [eager.adapt(r) for r in raws]
+        )
+        pid_b, toa_b, n_b, pad_b, first_b, last_b = _stage(
+            [m for m in batch.adapt_batch(raws)]
+        )
+        assert n_a == n_b == 12
+        np.testing.assert_array_equal(pid_a, pid_b)
+        np.testing.assert_array_equal(toa_a, toa_b)
+        assert (first_a, last_a) == (first_b, last_b)
+        # Ref-mode padding carries the universal drop marker.
+        assert (pad_b == -1).all()
+
+    def test_monitor_window_identical(self, mapping):
+        raws = [
+            ev44_msg(topic="mon_topic", source="mon_src", pixels=False, n=6),
+            ev44_msg(topic="mon_topic", source="mon_src", pixels=False, n=2),
+        ]
+        eager = KafkaToMonitorEventsAdapter(mapping, batch_wire=False)
+        batch = KafkaToMonitorEventsAdapter(mapping, batch_wire=True)
+        a = _stage([eager.adapt(r) for r in raws])
+        b = _stage([batch.adapt(r) for r in raws])
+        np.testing.assert_array_equal(a[0], b[0])
+        np.testing.assert_array_equal(a[1], b[1])
+        assert a[2] == b[2] == 8
+        assert (b[0] == 0).all()  # monitors stage as pixel 0
+
+    def test_eager_chunk_into_ref_window_is_adopted(self, mapping):
+        raws = self._raws()
+        eager = KafkaToDetectorEventsAdapter(mapping, batch_wire=False)
+        batch = KafkaToDetectorEventsAdapter(mapping, batch_wire=True)
+        pure = _stage([eager.adapt(r) for r in raws])
+        msgs = [batch.adapt(raws[0]), eager.adapt(raws[1]), batch.adapt(raws[2])]
+        mixed = _stage(msgs)
+        np.testing.assert_array_equal(pure[0], mixed[0])
+        np.testing.assert_array_equal(pure[1], mixed[1])
+
+    def test_ref_chunk_into_eager_window_materializes(self, mapping):
+        raws = self._raws()
+        eager = KafkaToDetectorEventsAdapter(mapping, batch_wire=False)
+        batch = KafkaToDetectorEventsAdapter(mapping, batch_wire=True)
+        pure = _stage([eager.adapt(r) for r in raws])
+        msgs = [eager.adapt(raws[0]), batch.adapt(raws[1]), batch.adapt(raws[2])]
+        mixed = _stage(msgs)
+        np.testing.assert_array_equal(pure[0], mixed[0])
+        np.testing.assert_array_equal(pure[1], mixed[1])
+
+    def test_ref_batch_flags_device_prologue(self, mapping):
+        batch = KafkaToDetectorEventsAdapter(mapping, batch_wire=True)
+        acc = ToEventBatch()
+        m = batch.adapt(ev44_msg())
+        acc.add(m.timestamp, m.value)
+        staged = acc.get()
+        assert staged.batch.prologue
+        assert staged.batch.owned
+        del staged
+        acc.release_buffers()
+
+    def test_mismatched_detector_ref_rejected_at_add(self, mapping):
+        buf = wire.encode_ev44(
+            "panel_a",
+            1,
+            np.array([5], dtype=np.int64),
+            np.array([0], dtype=np.int32),
+            np.array([10, 20], dtype=np.int32),
+            pixel_id=np.array([1], dtype=np.int32),
+        )
+        m = KafkaToDetectorEventsAdapter(mapping, batch_wire=True).adapt(
+            FakeKafkaMessage(buf, "det_topic")
+        )
+        acc = ToEventBatch()
+        with pytest.raises(ValueError, match="pixel_id length"):
+            acc.add(m.timestamp, m.value)
+
+    def test_add_after_get_requires_release(self, mapping):
+        batch = KafkaToDetectorEventsAdapter(mapping, batch_wire=True)
+        acc = ToEventBatch()
+        m = batch.adapt(ev44_msg())
+        acc.add(m.timestamp, m.value)
+        staged = acc.get()
+        with pytest.raises(RuntimeError, match="release_buffers"):
+            acc.add(m.timestamp, m.value)
+        del staged
+        acc.release_buffers()
+        acc.add(m.timestamp, m.value)  # released: window restarts cleanly
+
+
+class _ListSource:
+    def __init__(self, polls):
+        self._polls = list(polls)
+
+    def get_messages(self):
+        return self._polls.pop(0) if self._polls else []
+
+
+class TestAdaptingSourceBatchFold:
+    def test_failures_fold_into_containment_accounting(self, mapping):
+        good = ev44_msg()
+        bad = FakeKafkaMessage(good.value()[:16], "det_topic")
+        unrouted = ev44_msg(topic="other_topic")
+        routes = RouteByTopicAdapter(
+            {"det_topic": KafkaToDetectorEventsAdapter(mapping, batch_wire=True)}
+        )
+        src = AdaptingMessageSource(
+            _ListSource([[good, bad, unrouted]]), routes
+        )
+        errors_before = DECODE_ERRORS.value(schema="ev44")
+        out = src.get_messages()
+        assert len(out) == 1
+        assert out[0].stream.name == "bank0"
+        assert src.error_count == 1
+        assert src.unrouted_count == 1
+        assert DECODE_ERRORS.value(schema="ev44") == errors_before + 1
+
+    def test_poll_telemetry_observed_at_batch_granularity(self, mapping):
+        raws = [ev44_msg(base=i) for i in range(3)]
+        nbytes = sum(len(r.value()) for r in raws)
+        src = AdaptingMessageSource(
+            _ListSource([raws]),
+            KafkaToDetectorEventsAdapter(mapping, batch_wire=True),
+        )
+        count_before = DECODE_BATCH_SIZE.count()
+        sum_before = DECODE_BATCH_SIZE.sum()
+        bytes_before = DECODE_BYTES.value()
+        src.get_messages()
+        assert DECODE_BATCH_SIZE.count() == count_before + 1
+        assert DECODE_BATCH_SIZE.sum() == sum_before + 3.0
+        assert DECODE_BYTES.value() == bytes_before + nbytes
+
+    def test_empty_poll_records_nothing(self, mapping):
+        src = AdaptingMessageSource(
+            _ListSource([]),
+            KafkaToDetectorEventsAdapter(mapping, batch_wire=True),
+        )
+        count_before = DECODE_BATCH_SIZE.count()
+        assert src.get_messages() == []
+        assert DECODE_BATCH_SIZE.count() == count_before
+
+    def test_raise_on_error_propagates_batch_failures(self, mapping):
+        bad = FakeKafkaMessage(b"\xff" * 16, "det_topic")
+        src = AdaptingMessageSource(
+            _ListSource([[bad]]),
+            KafkaToDetectorEventsAdapter(mapping, batch_wire=True),
+            raise_on_error=True,
+        )
+        with pytest.raises(wire.WireError):
+            src.get_messages()
+
+
+class TestRouterBatchDispatch:
+    def test_schema_runs_dispatch_to_batch_forms(self, mapping):
+        det = KafkaToDetectorEventsAdapter(mapping, batch_wire=True)
+        router = RouteBySchemaAdapter({"ev44": det})
+        f144 = FakeKafkaMessage(
+            wire.encode_f144("mtr1", 1.0, 7), "det_topic"
+        )
+        raws = [ev44_msg(base=0), ev44_msg(base=1), f144, ev44_msg(base=2)]
+        out = router.adapt_batch(raws)
+        assert len(out) == 4
+        assert all(isinstance(out[i], Message) for i in (0, 1, 3))
+        assert isinstance(out[2], AdaptFailure)  # no f144 route
+        assert out[3].value.view.message_id == 2
+
+    def test_unreadable_schema_quarantined_alone(self, mapping):
+        det = KafkaToDetectorEventsAdapter(mapping, batch_wire=True)
+        router = RouteBySchemaAdapter({"ev44": det})
+        out = router.adapt_batch(
+            [ev44_msg(), FakeKafkaMessage(b"\x01", "det_topic"), ev44_msg()]
+        )
+        assert isinstance(out[0], Message)
+        assert isinstance(out[1], AdaptFailure)
+        assert isinstance(out[2], Message)
+
+    def test_topic_runs_dispatch_to_batch_forms(self, mapping):
+        router = RouteByTopicAdapter(
+            {
+                "det_topic": KafkaToDetectorEventsAdapter(
+                    mapping, batch_wire=True
+                ),
+                "mon_topic": KafkaToMonitorEventsAdapter(
+                    mapping, batch_wire=True
+                ),
+            }
+        )
+        raws = [
+            ev44_msg(base=0),
+            ev44_msg(base=1),
+            ev44_msg(topic="mon_topic", source="mon_src", pixels=False),
+            ev44_msg(topic="nope"),
+        ]
+        out = router.adapt_batch(raws)
+        assert out[0].stream.kind == StreamKind.DETECTOR_EVENTS
+        assert out[2].stream.kind == StreamKind.MONITOR_EVENTS
+        assert isinstance(out[3], AdaptFailure)

@@ -1,0 +1,263 @@
+"""Deterministic wire-decoder fuzz: the per-message containment contract.
+
+ADR 0125 pins the decode plane to one error surface: every malformed
+buffer — truncated, offset-corrupted, or with over-length vector counts
+— must raise :class:`wire.WireError`, never ``struct.error`` or
+``IndexError`` (the raw failure modes of an unchecked flatbuffers walk).
+Unlike the hypothesis suite (wire_property_test.py, skipped where
+hypothesis is absent) these sweeps are exhaustive and deterministic:
+every truncation length and every byte position of a representative
+message, so a bounds-check regression in ``walk_ev44``'s straight-line
+walk or ``_Tbl._read`` fails loudly on every run.
+
+The batch form adds the quarantine contract: one bad message in a poll
+lands in ``Ev44Batch.errors`` (and on
+``livedata_decode_errors_total{schema="ev44"}``) without poisoning its
+neighbours' payloads.
+"""
+
+import numpy as np
+import pytest
+
+from esslivedata_tpu.kafka import wire
+from esslivedata_tpu.telemetry.instruments import DECODE_ERRORS
+
+#: Exceptions that must NEVER escape a decoder. ``struct.error`` is a
+#: subclass of neither, so it is listed via the module to keep the
+#: intent readable at the assertion site.
+import struct
+
+_FORBIDDEN = (struct.error, IndexError)
+
+
+def _ev44(n=5, source="det0"):
+    return wire.encode_ev44(
+        source,
+        11,
+        np.array([1_000_000, 2_000_000], dtype=np.int64),
+        np.array([0, 3], dtype=np.int32),
+        np.arange(n, dtype=np.int32) * 10,
+        pixel_id=np.arange(n, dtype=np.int32) + 1,
+    )
+
+
+def _f144():
+    return wire.encode_f144("mtr1", [1.5, 2.5, 3.5], 42_000)
+
+
+def _da00():
+    var = wire.Da00Variable(
+        name="signal",
+        data=np.arange(12, dtype=np.float32).reshape(3, 4),
+        axes=("y", "x"),
+        unit="counts",
+    )
+    return wire.encode_da00("src0", 99_000, [var])
+
+
+def _assert_contained(decoder, buf):
+    """Decode either succeeds or raises WireError; the raw flatbuffers
+    failure modes must not escape."""
+    try:
+        decoder(buf)
+    except wire.WireError:
+        pass
+    except _FORBIDDEN as err:  # pragma: no cover - the failure being hunted
+        pytest.fail(
+            f"{decoder.__name__} leaked {type(err).__name__} "
+            f"instead of WireError: {err}"
+        )
+
+
+_CASES = [
+    (wire.decode_ev44, _ev44()),
+    (wire.walk_ev44, _ev44()),
+    (wire.decode_f144, _f144()),
+    (wire.decode_da00, _da00()),
+]
+
+
+class TestTruncation:
+    """Every prefix of a valid message decodes or raises WireError."""
+
+    @pytest.mark.parametrize(
+        "decoder,buf", _CASES, ids=["ev44", "walk_ev44", "f144", "da00"]
+    )
+    def test_every_truncation_length(self, decoder, buf):
+        for cut in range(len(buf)):
+            _assert_contained(decoder, buf[:cut])
+
+    @pytest.mark.parametrize(
+        "decoder,buf", _CASES, ids=["ev44", "walk_ev44", "f144", "da00"]
+    )
+    def test_empty_and_tiny(self, decoder, buf):
+        for hostile in (b"", b"\x00", b"\xff" * 7):
+            with pytest.raises(wire.WireError):
+                decoder(hostile)
+
+
+class TestCorruptOffsets:
+    """Every single-byte corruption of a valid message is contained.
+
+    0xFF maximizes offsets (pointing reads far past the buffer end);
+    XOR 0x80 flips sign/high bits (hostile vtable and soffset shapes).
+    Together the two sweeps hit every offset, length, and count field.
+    """
+
+    @pytest.mark.parametrize(
+        "decoder,buf", _CASES, ids=["ev44", "walk_ev44", "f144", "da00"]
+    )
+    @pytest.mark.parametrize("mutate", [lambda b: 0xFF, lambda b: b ^ 0x80])
+    def test_every_byte_position(self, decoder, buf, mutate):
+        for pos in range(len(buf)):
+            hostile = bytearray(buf)
+            hostile[pos] = mutate(hostile[pos])
+            _assert_contained(decoder, bytes(hostile))
+
+
+class TestOverLengthVectors:
+    """A count field claiming more elements than the buffer holds must
+    trip the explicit extent check, not produce a wild frombuffer view."""
+
+    @pytest.mark.parametrize("field", ["tof", "pid"])
+    def test_ev44_vector_count_patched_huge(self, field):
+        buf = _ev44(n=8)
+        v = wire.walk_ev44(buf)
+        # The u32 count sits 4 bytes before the payload data.
+        count_at = (v.tof_off if field == "tof" else v.pid_off) - 4
+        hostile = bytearray(buf)
+        hostile[count_at : count_at + 4] = (2**31).to_bytes(4, "little")
+        with pytest.raises(wire.WireError):
+            wire.walk_ev44(bytes(hostile))
+        with pytest.raises(wire.WireError):
+            wire.decode_ev44(bytes(hostile))
+
+    def test_ev44_reference_time_count_patched_huge(self):
+        buf = _ev44(n=4)
+        # Locate the reference_time vector through the decoded values:
+        # its data holds 1_000_000 at the start of the int64 payload.
+        needle = (1_000_000).to_bytes(8, "little", signed=True)
+        data_at = bytes(buf).index(needle)
+        hostile = bytearray(buf)
+        hostile[data_at - 8 : data_at - 4] = (2**30).to_bytes(4, "little")
+        _assert_contained(wire.walk_ev44, bytes(hostile))
+        _assert_contained(wire.decode_ev44, bytes(hostile))
+
+    def test_f144_string_length_patched_huge(self):
+        buf = _f144()
+        name_at = bytes(buf).index(b"mtr1")
+        hostile = bytearray(buf)
+        hostile[name_at - 4 : name_at] = (2**30).to_bytes(4, "little")
+        with pytest.raises(wire.WireError):
+            wire.decode_f144(bytes(hostile))
+
+    def test_da00_data_length_patched_huge(self):
+        buf = _da00()
+        # The float32 payload starts with 0.0, 1.0, 2.0 ...
+        needle = np.arange(3, dtype=np.float32).tobytes()
+        data_at = bytes(buf).index(needle)
+        hostile = bytearray(buf)
+        hostile[data_at - 8 : data_at - 4] = (2**30).to_bytes(4, "little")
+        _assert_contained(wire.decode_da00, bytes(hostile))
+
+
+class TestWalkParity:
+    """walk_ev44's header view agrees with the reference decoder."""
+
+    @pytest.mark.parametrize("n", [0, 1, 7, 256])
+    def test_fields_match_decode_ev44(self, n):
+        buf = _ev44(n=n, source="parity_bank")
+        ref = wire.decode_ev44(buf)
+        v = wire.walk_ev44(buf)
+        assert v.source_name == ref.source_name
+        assert v.message_id == ref.message_id
+        assert v.reference_time_ns == int(ref.reference_time[-1])
+        np.testing.assert_array_equal(v.time_of_flight, ref.time_of_flight)
+        np.testing.assert_array_equal(v.pixel_id, ref.pixel_id)
+        assert v.n_events == n
+
+    def test_monitor_message_has_no_pixels(self):
+        buf = wire.encode_ev44(
+            "mon0",
+            3,
+            np.array([5], dtype=np.int64),
+            np.array([0], dtype=np.int32),
+            np.array([10, 20, 30], dtype=np.int32),
+        )
+        v = wire.walk_ev44(buf)
+        assert v.n_pid == 0
+        assert v.n_tof == 3
+        assert v.pixel_id.size == 0
+
+    def test_mismatched_pixel_length_is_lenient_in_walk(self):
+        """Length policy belongs to the consumer (fill_into / batch
+        quarantine), not the walk — monitor adapters accept these."""
+        buf = wire.encode_ev44(
+            "det0",
+            1,
+            np.array([5], dtype=np.int64),
+            np.array([0], dtype=np.int32),
+            np.array([10, 20, 30], dtype=np.int32),
+            pixel_id=np.array([1], dtype=np.int32),
+        )
+        v = wire.walk_ev44(buf)  # must not raise
+        assert (v.n_tof, v.n_pid) == (3, 1)
+        with pytest.raises(wire.WireError):
+            v.fill_into(
+                np.empty(3, dtype=np.int32), np.empty(3, dtype=np.float32)
+            )
+
+
+class TestBatchQuarantine:
+    """decode_ev44_batch contains bad messages without poisoning the poll."""
+
+    def test_bad_message_quarantined_neighbours_intact(self):
+        good_a = _ev44(n=3)
+        good_b = _ev44(n=2, source="det1")
+        before = DECODE_ERRORS.value(schema="ev44")
+        batch = wire.decode_ev44_batch([good_a, good_a[:20], good_b])
+        assert batch.n_messages == 3
+        assert len(batch.views) == 2
+        assert [i for i, _ in batch.errors] == [1]
+        assert isinstance(batch.errors[0][1], wire.WireError)
+        # Neighbours landed contiguously at the right offsets.
+        np.testing.assert_array_equal(batch.offsets, [0, 3, 5])
+        ref_a = wire.decode_ev44(good_a)
+        ref_b = wire.decode_ev44(good_b)
+        np.testing.assert_array_equal(
+            batch.pixel_id[:3], ref_a.pixel_id
+        )
+        np.testing.assert_array_equal(batch.pixel_id[3:5], ref_b.pixel_id)
+        np.testing.assert_array_equal(
+            batch.toa, np.concatenate(
+                [ref_a.time_of_flight, ref_b.time_of_flight]
+            ).astype(np.float32),
+        )
+        assert batch.nbytes == len(good_a) + len(good_b)
+        assert DECODE_ERRORS.value(schema="ev44") == before + 1
+
+    def test_mismatched_pixel_length_quarantined(self):
+        bad = wire.encode_ev44(
+            "det0",
+            1,
+            np.array([5], dtype=np.int64),
+            np.array([0], dtype=np.int32),
+            np.array([10, 20], dtype=np.int32),
+            pixel_id=np.array([1], dtype=np.int32),
+        )
+        batch = wire.decode_ev44_batch([bad, _ev44(n=2)])
+        assert [i for i, _ in batch.errors] == [0]
+        assert batch.n_events == 2
+
+    def test_all_bad_batch_is_empty_not_an_error(self):
+        batch = wire.decode_ev44_batch([b"", b"\xff" * 12])
+        assert batch.n_messages == 2
+        assert batch.n_events == 0
+        assert len(batch.errors) == 2
+        assert batch.views == []
+
+    def test_empty_input(self):
+        batch = wire.decode_ev44_batch([])
+        assert batch.n_messages == 0
+        assert batch.n_events == 0
+        assert batch.errors == []

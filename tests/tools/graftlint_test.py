@@ -396,6 +396,20 @@ class Hist:
     def on_refill(self, lut):
         self._lut[:] = lut
 ''',
+    # In scope via the wire import; copies the payload and accumulates
+    # a fresh ndarray per message inside the consume loop.
+    "JGL028": '''
+import numpy as np
+from esslivedata_tpu.kafka import wire
+
+def consume(raws):
+    chunks = []
+    for raw in raws:
+        buf = bytes(raw.value())
+        msg = wire.decode_ev44(buf)
+        chunks.append(np.asarray(msg.time_of_flight))
+    return np.concatenate(chunks)
+''',
 }
 
 NEGATIVE = {
@@ -923,6 +937,32 @@ class Hist:
         self.lut_host = lut
         self._lut_dev = None
         self._digest = None
+''',
+    # The batch decode shape: header views appended (no ndarray
+    # allocation in the loop), one arena fill outside it. The single
+    # upfront allocations (empty/zeros) sit outside the loop too.
+    "JGL028": '''
+import numpy as np
+from esslivedata_tpu.kafka import wire
+
+def consume(raws, arena):
+    views = []
+    errors = []
+    for i, raw in enumerate(raws):
+        try:
+            views.append(wire.walk_ev44(raw.value()))
+        except wire.WireError as err:
+            errors.append((i, err))
+    offsets = np.zeros(len(views) + 1, dtype=np.int64)
+    for j, v in enumerate(views):
+        offsets[j + 1] = offsets[j] + v.n_tof
+    total = int(offsets[-1])
+    pid = arena.pixel[:total]
+    toa = arena.toa[:total]
+    for j, v in enumerate(views):
+        v.fill_into(pid[offsets[j]:offsets[j + 1]],
+                    toa[offsets[j]:offsets[j + 1]])
+    return pid, toa, offsets, errors
 ''',
 }
 # fmt: on

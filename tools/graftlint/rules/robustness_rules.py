@@ -687,3 +687,123 @@ def reconnect_without_backoff(ctx: FileContext):
                 "through a shared *backoff* helper)",
             )
             break  # one finding per function names the whole gap
+
+
+# -- JGL028: per-message allocation in a decode-path loop -------------------
+
+#: Module scope: the file lives on the decode path (wire codecs,
+#: adapters, decode/preprocess stages) by path, or imports one of those
+#: modules — evidence it handles per-message wire payloads.
+_DECODE_PATH = re.compile(r"wire|adapter|decode|preprocess", re.IGNORECASE)
+
+#: ndarray-allocating callees whose result, appended per message, is the
+#: list-of-ndarray accumulation the batch decode plane replaces.
+_NDARRAY_ALLOC = frozenset(
+    {
+        "array",
+        "asarray",
+        "ascontiguousarray",
+        "astype",
+        "concatenate",
+        "copy",
+        "empty",
+        "frombuffer",
+        "ones",
+        "zeros",
+    }
+)
+
+
+@rule("JGL028", "per-message allocation in a decode-path loop")
+def per_message_decode_allocation(ctx: FileContext):
+    """Scope: decode-path modules — the file's path reads as one
+    (wire/adapter/decode/preprocess), or the module imports one.
+
+    Within scope, a ``for``/``while`` loop body must not allocate per
+    iteration the things the batch decode plane (ADR 0125) exists to
+    amortize:
+
+    - ``bytes(...)`` / ``.tobytes()`` — a full payload copy per message
+      where a memoryview or ``np.frombuffer`` view is free;
+    - ``list.append(<fresh ndarray>)`` (``np.asarray``/``frombuffer``/
+      ``.astype``/``.copy``/...) — the per-message list-of-ndarray
+      accumulation pattern, which the arena-landing accumulator
+      (``ToEventBatch`` ref mode / ``decode_ev44_batch``) replaces with
+      offset bookkeeping and one contiguous fill;
+    - ``concatenate`` — inside a consume/decode loop this re-copies the
+      accumulated prefix every iteration (quadratic in poll size).
+
+    At ESS poll rates these allocations dominate the decode stage (the
+    bench.py ``--decode`` scenario measures the gap); keep the hot loop
+    allocation-free and land payloads straight into a decode arena.
+    Encode-side serialization that genuinely must copy (e.g. the da00
+    writer's per-variable ``tobytes``) carries an inline suppression
+    with the justification next to it.
+    """
+    in_scope = bool(_DECODE_PATH.search(Path(ctx.path).as_posix()))
+    if not in_scope:
+        for node in ctx.nodes(ast.Import):
+            if any(
+                _DECODE_PATH.search(alias.name) for alias in node.names
+            ):
+                in_scope = True
+                break
+    if not in_scope:
+        for node in ctx.nodes(ast.ImportFrom):
+            if (node.module and _DECODE_PATH.search(node.module)) or any(
+                _DECODE_PATH.search(alias.name) for alias in node.names
+            ):
+                in_scope = True
+                break
+    if not in_scope:
+        return
+    seen: set[int] = set()
+    for loop in ctx.nodes(ast.For, ast.AsyncFor, ast.While):
+        for node in ast.walk(loop):
+            if id(node) in seen or not isinstance(node, ast.Call):
+                continue
+            seen.add(id(node))
+            name = _callee_name(node)
+            if name == "tobytes" or (
+                isinstance(node.func, ast.Name)
+                and node.func.id == "bytes"
+                and node.args
+                and not isinstance(node.args[0], ast.Constant)
+            ):
+                yield Finding(
+                    ctx.path,
+                    node.lineno,
+                    "JGL028",
+                    "payload copy per loop iteration on the decode path "
+                    f"({'bytes(...)' if name != 'tobytes' else '.tobytes()'}): "
+                    "a memoryview or np.frombuffer view reads the wire "
+                    "zero-copy — at poll rates this copy dominates the "
+                    "decode stage (ADR 0125)",
+                )
+            elif name == "concatenate":
+                yield Finding(
+                    ctx.path,
+                    node.lineno,
+                    "JGL028",
+                    "concatenate inside a decode-path loop re-copies the "
+                    "accumulated prefix every iteration (quadratic in "
+                    "poll size) — record offsets and land chunks into a "
+                    "preallocated arena in one pass (ADR 0125)",
+                )
+            elif (
+                name == "append"
+                and node.args
+                and isinstance(node.args[0], ast.Call)
+                and _callee_name(node.args[0]) in _NDARRAY_ALLOC
+            ):
+                yield Finding(
+                    ctx.path,
+                    node.lineno,
+                    "JGL028",
+                    "per-message ndarray accumulation "
+                    f"(append of {_callee_name(node.args[0])}(...)): the "
+                    "batch decode plane replaces list-of-ndarray with "
+                    "offset bookkeeping plus one contiguous arena fill "
+                    "(ToEventBatch ref mode / decode_ev44_batch, "
+                    "ADR 0125)",
+                )
